@@ -1,0 +1,89 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's layered-fake strategy (SURVEY.md §4): all scheduler/
+KV-manager logic runs device-free; worker/model/kernel tests run on 8
+virtual CPU devices so every multi-chip sharding path is exercised without
+TPU hardware (reference TPU CI does the analogous thing with
+xla_force_host_platform_device_count).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# Pallas kernels run in interpret mode on CPU.
+os.environ.setdefault("VDT_PALLAS_INTERPRET", "1")
+
+import pytest  # noqa: E402
+
+from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                         ModelConfig, SchedulerConfig)
+from vllm_distributed_tpu.request import Request
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+_REQ_COUNTER = [0]
+
+
+def make_config(
+    *,
+    block_size: int = 4,
+    num_blocks: int = 64,
+    max_num_batched_tokens: int = 64,
+    max_num_seqs: int = 8,
+    max_model_len: int = 128,
+    enable_prefix_caching: bool = True,
+    enable_chunked_prefill: bool = True,
+    policy: str = "fcfs",
+) -> EngineConfig:
+    cfg = EngineConfig(
+        model_config=ModelConfig(model="dummy", max_model_len=max_model_len),
+        cache_config=CacheConfig(
+            block_size=block_size,
+            num_gpu_blocks=num_blocks,
+            enable_prefix_caching=enable_prefix_caching,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_batched_tokens=max_num_batched_tokens,
+            max_num_seqs=max_num_seqs,
+            max_model_len=max_model_len,
+            enable_chunked_prefill=enable_chunked_prefill,
+            policy=policy,
+        ),
+    )
+    return cfg
+
+
+def make_request(
+    num_tokens: int = 8,
+    *,
+    req_id: str | None = None,
+    max_tokens: int = 16,
+    priority: int = 0,
+    token_ids: list[int] | None = None,
+    **sp_kwargs,
+) -> Request:
+    if req_id is None:
+        _REQ_COUNTER[0] += 1
+        req_id = f"req-{_REQ_COUNTER[0]}"
+    if token_ids is None:
+        # Unique tokens per request so tests don't hit the prefix cache
+        # accidentally (pass token_ids explicitly to test sharing).
+        base = 1000 * _REQ_COUNTER[0]
+        token_ids = list(range(base + 1, base + num_tokens + 1))
+    return Request(
+        request_id=req_id,
+        prompt_token_ids=token_ids,
+        sampling_params=SamplingParams(temperature=0.0,
+                                       max_tokens=max_tokens,
+                                       **sp_kwargs),
+        eos_token_id=2,
+        priority=priority,
+    )
+
+
+@pytest.fixture
+def config() -> EngineConfig:
+    return make_config()

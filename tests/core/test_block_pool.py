@@ -1,0 +1,77 @@
+"""BlockPool unit tests (model: reference tests/v1/core/)."""
+
+import pytest
+
+from vllm_distributed_tpu.core.block_pool import BlockPool
+from vllm_distributed_tpu.core.kv_cache_utils import hash_block_tokens
+
+
+def test_alloc_free_roundtrip():
+    pool = BlockPool(num_blocks=8)
+    assert pool.get_num_free_blocks() == 8
+    blocks = pool.get_new_blocks(3)
+    assert pool.get_num_free_blocks() == 5
+    assert len({b.block_id for b in blocks}) == 3
+    pool.free_blocks(list(reversed(blocks)))
+    assert pool.get_num_free_blocks() == 8
+
+
+def test_overallocation_raises():
+    pool = BlockPool(num_blocks=2)
+    with pytest.raises(ValueError):
+        pool.get_new_blocks(3)
+
+
+def test_prefix_cache_hit_and_touch():
+    pool = BlockPool(num_blocks=4)
+    blocks = pool.get_new_blocks(2)
+    h0 = hash_block_tokens(None, (1, 2, 3, 4))
+    h1 = hash_block_tokens(h0.hash_value, (5, 6, 7, 8))
+    pool.cache_full_blocks(blocks, [h0, h1], 0, 2)
+
+    # Free the blocks: they stay in the cache index until evicted.
+    pool.free_blocks(list(reversed(blocks)))
+    hit = pool.get_cached_block(h0)
+    assert hit is blocks[0]
+
+    # touch() takes a ref and removes from the free queue.
+    pool.touch([hit])
+    assert pool.get_num_free_blocks() == 3
+    assert hit.ref_cnt == 1
+    pool.free_blocks([hit])
+
+
+def test_eviction_removes_hash():
+    pool = BlockPool(num_blocks=2)
+    blocks = pool.get_new_blocks(2)
+    h0 = hash_block_tokens(None, (1, 2))
+    pool.cache_full_blocks(blocks, [h0], 0, 1)
+    pool.free_blocks(list(reversed(blocks)))
+
+    # Allocating all blocks evicts the cached one (LRU order: blocks[1]
+    # freed first, then blocks[0] — eviction pops blocks[1] first).
+    newly = pool.get_new_blocks(2)
+    assert pool.get_cached_block(h0) is None
+    assert {b.block_id for b in newly} == {0, 1}
+
+
+def test_lru_eviction_order_prefers_prefix():
+    pool = BlockPool(num_blocks=3)
+    blocks = pool.get_new_blocks(3)
+    # Freed tail-first: eviction order is tail, middle, head.
+    pool.free_blocks(list(reversed(blocks)))
+    popped = pool.get_new_blocks(3)
+    assert [b.block_id for b in popped] == \
+        [blocks[2].block_id, blocks[1].block_id, blocks[0].block_id]
+
+
+def test_reset_prefix_cache():
+    pool = BlockPool(num_blocks=2)
+    blocks = pool.get_new_blocks(1)
+    h0 = hash_block_tokens(None, (9,))
+    pool.cache_full_blocks(blocks, [h0], 0, 1)
+    # In use -> refuse.
+    assert not pool.reset_prefix_cache()
+    pool.free_blocks(blocks)
+    assert pool.reset_prefix_cache()
+    assert pool.get_cached_block(h0) is None

@@ -1,0 +1,103 @@
+"""KVCacheManager unit tests (model: reference tests/v1/core/test_prefix_caching.py)."""
+
+from vllm_distributed_tpu.core.kv_cache_manager import KVCacheManager
+from tests.conftest import make_request
+
+
+def make_manager(block_size=4, num_blocks=16, caching=True):
+    return KVCacheManager(block_size=block_size, num_blocks=num_blocks,
+                          enable_caching=caching)
+
+
+def test_allocate_and_free():
+    mgr = make_manager()
+    req = make_request(num_tokens=10)
+    blocks = mgr.allocate_slots(req, 10)
+    assert blocks is not None
+    # 10 tokens / block_size 4 -> 3 pages.
+    assert len(blocks.blocks) == 3
+    assert mgr.get_num_free_blocks() == 13
+    req.status = 3  # FINISHED_STOPPED (free requires finished? manager doesn't check)
+    mgr.free(req)
+    assert mgr.get_num_free_blocks() == 16
+
+
+def test_allocation_failure_returns_none():
+    mgr = make_manager(num_blocks=2)
+    req = make_request(num_tokens=12)
+    assert mgr.allocate_slots(req, 12) is None
+    # Failed allocation must not leak blocks.
+    assert mgr.get_num_free_blocks() == 2
+
+
+def test_incremental_decode_allocation():
+    mgr = make_manager(block_size=4)
+    req = make_request(num_tokens=7)
+    first = mgr.allocate_slots(req, 7)
+    assert len(first.blocks) == 2  # 7 tokens -> 2 pages
+    req.num_computed_tokens = 7
+    req.append_output_token_ids(100)
+    # Token 8 fits in page 2 (slot 7).
+    more = mgr.allocate_slots(req, 1)
+    assert len(more.blocks) == 0
+    req.num_computed_tokens = 8
+    req.append_output_token_ids(101)
+    # Token 9 needs a third page.
+    more = mgr.allocate_slots(req, 1)
+    assert len(more.blocks) == 1
+
+
+def test_prefix_cache_hit_across_requests():
+    mgr = make_manager(block_size=4)
+    req_a = make_request(token_ids=list(range(100, 112)))  # 12 tokens
+    blocks = mgr.allocate_slots(req_a, 12)
+    assert blocks is not None
+    req_a.num_computed_tokens = 12
+
+    # Same first 8 tokens, different tail.
+    req_b = make_request(token_ids=list(range(100, 108)) + [7, 8, 9, 10])
+    cached, num_computed = mgr.get_computed_blocks(req_b)
+    assert num_computed == 8
+    assert len(cached.blocks) == 2
+    assert cached.blocks[0] is mgr.req_to_blocks[req_a.request_id][0]
+
+    new_blocks = mgr.allocate_slots(req_b, 12 - num_computed, cached)
+    assert new_blocks is not None
+    # Shared pages are ref-counted, not copied.
+    assert cached.blocks[0].ref_cnt == 2
+
+
+def test_full_prompt_cached_leaves_last_token():
+    mgr = make_manager(block_size=4)
+    req_a = make_request(token_ids=list(range(100, 108)))
+    mgr.allocate_slots(req_a, 8)
+
+    # Identical prompt: hit is capped below the full prompt so the last
+    # token still produces a logit.
+    req_b = make_request(token_ids=list(range(100, 108)))
+    cached, num_computed = mgr.get_computed_blocks(req_b)
+    assert num_computed == 4  # capped to < 8 at page granularity
+
+
+def test_no_caching_mode():
+    mgr = make_manager(caching=False)
+    req_a = make_request(num_tokens=8)
+    mgr.allocate_slots(req_a, 8)
+    req_b = make_request(num_tokens=8)
+    cached, num_computed = mgr.get_computed_blocks(req_b)
+    assert num_computed == 0 and not cached.blocks
+
+
+def test_freed_blocks_reusable_as_prefix():
+    mgr = make_manager(block_size=4, num_blocks=4)
+    req_a = make_request(token_ids=[5, 6, 7, 8, 9])
+    mgr.allocate_slots(req_a, 5)
+    req_a.num_computed_tokens = 5
+    mgr.free(req_a)
+    mgr.free_block_hashes(req_a)
+    assert mgr.get_num_free_blocks() == 4
+
+    # New request with the same first page hits the dangling cached page.
+    req_b = make_request(token_ids=[5, 6, 7, 8, 1, 2])
+    cached, num_computed = mgr.get_computed_blocks(req_b)
+    assert num_computed == 4

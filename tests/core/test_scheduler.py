@@ -1,0 +1,268 @@
+"""Scheduler unit tests (model: reference tests/v1/core/test_scheduler.py —
+construct the Scheduler directly with synthetic requests, no model/device)."""
+
+from vllm_distributed_tpu.core.sched.output import ModelRunnerOutput
+from vllm_distributed_tpu.core.sched.scheduler import Scheduler
+from vllm_distributed_tpu.request import RequestStatus
+from tests.conftest import make_config, make_request
+
+
+def make_scheduler(**kwargs):
+    return Scheduler(make_config(**kwargs))
+
+
+def fake_output(scheduler_output, sample_token=42):
+    """Simulate the workers: one sampled token for every request whose
+    scheduled tokens reached the end of its known tokens."""
+    req_ids, sampled = [], []
+    for req_id, _ in scheduler_output.num_scheduled_tokens.items():
+        req_ids.append(req_id)
+        sampled.append([sample_token])
+    return ModelRunnerOutput(req_ids=req_ids, sampled_token_ids=sampled)
+
+
+def step(scheduler, sample_token=42):
+    out = scheduler.schedule()
+    if out.total_num_scheduled_tokens == 0:
+        return out, []
+    # Partial-prefill requests produce no sample.
+    req_ids, sampled = [], []
+    for req_id, n in out.num_scheduled_tokens.items():
+        req = scheduler.requests[req_id]
+        req_ids.append(req_id)
+        done_prefill = req.num_computed_tokens + n >= req.num_tokens
+        sampled.append([sample_token] if done_prefill else [])
+    mro = ModelRunnerOutput(req_ids=req_ids, sampled_token_ids=sampled)
+    eco = scheduler.update_from_output(out, mro)
+    return out, eco
+
+
+def test_basic_prefill_then_decode():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=8, max_tokens=4)
+    scheduler.add_request(req)
+
+    out, _ = step(scheduler)
+    assert out.num_scheduled_tokens[req.request_id] == 8
+    assert len(out.scheduled_new_reqs) == 1
+    assert req.num_computed_tokens == 8
+    assert req.output_token_ids == [42]
+
+    out, _ = step(scheduler)
+    assert out.num_scheduled_tokens[req.request_id] == 1
+    assert len(out.scheduled_new_reqs) == 0
+    assert out.scheduled_cached_reqs.req_ids == [req.request_id]
+
+
+def test_max_tokens_finishes_request():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=4, max_tokens=3)
+    scheduler.add_request(req)
+    for _ in range(3):
+        step(scheduler)
+    assert req.status == RequestStatus.FINISHED_LENGTH_CAPPED
+    assert not scheduler.has_requests()
+    # Pages returned.
+    assert scheduler.kv_cache_manager.get_num_free_blocks() == 64
+
+
+def test_eos_stops_request():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=4, max_tokens=10)
+    scheduler.add_request(req)
+    step(scheduler, sample_token=2)  # eos_token_id=2 in conftest
+    assert req.status == RequestStatus.FINISHED_STOPPED
+    assert req.get_finished_reason() == "stop"
+
+
+def test_stop_token_ids():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=4, max_tokens=10, stop_token_ids=[77])
+    scheduler.add_request(req)
+    step(scheduler, sample_token=77)
+    assert req.status == RequestStatus.FINISHED_STOPPED
+    assert req.stop_reason == 77
+
+
+def test_chunked_prefill_respects_token_budget():
+    scheduler = make_scheduler(max_num_batched_tokens=16)
+    req = make_request(num_tokens=40, max_tokens=2)
+    scheduler.add_request(req)
+
+    out, eco = step(scheduler)
+    assert out.num_scheduled_tokens[req.request_id] == 16
+    assert req.num_computed_tokens == 16
+    assert not eco  # no token sampled mid-prefill
+
+    step(scheduler)
+    assert req.num_computed_tokens == 32
+    out, eco = step(scheduler)
+    assert out.num_scheduled_tokens[req.request_id] == 8
+    assert req.output_token_ids == [42]
+
+
+def test_budget_shared_across_requests():
+    scheduler = make_scheduler(max_num_batched_tokens=16)
+    reqs = [make_request(num_tokens=10, max_tokens=2) for _ in range(3)]
+    for r in reqs:
+        scheduler.add_request(r)
+    out, _ = step(scheduler)
+    # First request fits (10), second chunked to 6, third not scheduled.
+    assert out.num_scheduled_tokens[reqs[0].request_id] == 10
+    assert out.num_scheduled_tokens[reqs[1].request_id] == 6
+    assert reqs[2].request_id not in out.num_scheduled_tokens
+
+
+def test_max_num_seqs_limit():
+    scheduler = make_scheduler(max_num_seqs=2)
+    reqs = [make_request(num_tokens=4) for _ in range(4)]
+    for r in reqs:
+        scheduler.add_request(r)
+    out, _ = step(scheduler)
+    assert len(out.num_scheduled_tokens) == 2
+    assert len(scheduler.running) == 2
+    assert len(scheduler.waiting) == 2
+
+
+def test_decode_batch_mixed_with_prefill():
+    scheduler = make_scheduler()
+    req_a = make_request(num_tokens=8, max_tokens=8)
+    scheduler.add_request(req_a)
+    step(scheduler)
+    req_b = make_request(num_tokens=8, max_tokens=8)
+    scheduler.add_request(req_b)
+    out, _ = step(scheduler)
+    # a decodes 1 token while b prefills 8 in the same step.
+    assert out.num_scheduled_tokens[req_a.request_id] == 1
+    assert out.num_scheduled_tokens[req_b.request_id] == 8
+
+
+def test_preemption_on_memory_pressure():
+    # 8 pages of 4 tokens = 32 token slots.
+    scheduler = make_scheduler(num_blocks=8, max_num_batched_tokens=32)
+    req_a = make_request(num_tokens=15, max_tokens=30)
+    req_b = make_request(num_tokens=15, max_tokens=30)
+    scheduler.add_request(req_a)
+    scheduler.add_request(req_b)
+    step(scheduler)  # both prefill: 4 pages each
+    # Decode until the pool is exhausted; the scheduler must preempt b
+    # (last in running) rather than deadlock.
+    for _ in range(10):
+        out, _ = step(scheduler)
+        if req_b.num_preemptions > 0:
+            break
+    assert req_b.num_preemptions == 1
+    assert req_b.status == RequestStatus.PREEMPTED
+    assert req_b in scheduler.waiting
+    # a keeps making progress.
+    assert req_a.status == RequestStatus.RUNNING
+
+    # Finish a -> b resumes and its re-prefill re-runs from scratch.
+    scheduler.finish_requests(req_a.request_id,
+                              RequestStatus.FINISHED_ABORTED)
+    out, _ = step(scheduler)
+    assert req_b.status == RequestStatus.RUNNING
+    assert out.scheduled_cached_reqs.resumed_from_preemption == [True]
+
+
+def test_prefix_cache_reduces_prefill():
+    scheduler = make_scheduler(block_size=4)
+    req_a = make_request(token_ids=list(range(100, 116)), max_tokens=1)
+    scheduler.add_request(req_a)
+    step(scheduler)  # prefill 16 + sample -> finished (max_tokens=1)
+    assert req_a.is_finished
+
+    req_b = make_request(token_ids=list(range(100, 116)) + [7, 8],
+                         max_tokens=1)
+    scheduler.add_request(req_b)
+    out, _ = step(scheduler)
+    # First 16 tokens cached -> only 2 new tokens scheduled.
+    assert out.num_scheduled_tokens[req_b.request_id] == 2
+    assert out.scheduled_new_reqs[0].num_computed_tokens == 16
+
+
+def test_priority_policy_orders_waiting():
+    scheduler = make_scheduler(policy="priority", max_num_seqs=1)
+    req_low = make_request(num_tokens=4, priority=10)
+    req_high = make_request(num_tokens=4, priority=0)
+    scheduler.add_request(req_low)
+    scheduler.add_request(req_high)
+    out, _ = step(scheduler)
+    assert list(out.num_scheduled_tokens) == [req_high.request_id]
+
+
+def test_abort_frees_blocks():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=8)
+    scheduler.add_request(req)
+    step(scheduler)
+    free_before = scheduler.kv_cache_manager.get_num_free_blocks()
+    scheduler.finish_requests(req.request_id, RequestStatus.FINISHED_ABORTED)
+    assert scheduler.kv_cache_manager.get_num_free_blocks() > free_before
+    out = scheduler.schedule()
+    assert req.request_id in out.finished_req_ids
+
+
+def test_finished_req_ids_propagated_once():
+    scheduler = make_scheduler()
+    req = make_request(num_tokens=4, max_tokens=1)
+    scheduler.add_request(req)
+    step(scheduler)
+    out = scheduler.schedule()
+    assert req.request_id in out.finished_req_ids
+    out2 = scheduler.schedule()
+    assert req.request_id not in out2.finished_req_ids
+
+
+def test_context_window_cap():
+    scheduler = make_scheduler(max_model_len=16)
+    req = make_request(num_tokens=12, max_tokens=100)
+    scheduler.add_request(req)
+    for _ in range(10):
+        step(scheduler)
+        if req.is_finished:
+            break
+    assert req.status == RequestStatus.FINISHED_LENGTH_CAPPED
+    assert req.num_tokens <= 16
+
+
+def test_overlong_prompt_rejected():
+    scheduler = make_scheduler(max_model_len=16)
+    req = make_request(num_tokens=20, max_tokens=4)
+    scheduler.add_request(req)
+    out, _ = step(scheduler)
+    assert req.status == RequestStatus.FINISHED_IGNORED
+    assert req.request_id not in out.num_scheduled_tokens
+    assert not scheduler.has_requests()
+
+
+def test_shared_sampling_params_not_mutated():
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.request import Request
+    sp = SamplingParams(temperature=0.0, max_tokens=None)
+    req_a = Request("sa", [1, 2, 3], sp, eos_token_id=5)
+    req_b = Request("sb", [1, 2, 3], sp, eos_token_id=9)
+    assert sp.max_tokens is None  # caller's object untouched
+    assert req_a.sampling_params.all_stop_token_ids == {5}
+    assert req_b.sampling_params.all_stop_token_ids == {9}
+
+
+def test_priority_preemption_never_evicts_scheduled():
+    # Pool sized so the second decode allocation fails while the
+    # high-priority request was already scheduled this step.
+    scheduler = make_scheduler(policy="priority", num_blocks=8,
+                               max_num_batched_tokens=32)
+    req_high = make_request(num_tokens=15, max_tokens=30, priority=0)
+    req_low = make_request(num_tokens=15, max_tokens=30, priority=5)
+    scheduler.add_request(req_high)
+    scheduler.add_request(req_low)
+    step(scheduler)
+    for _ in range(10):
+        out, _ = step(scheduler)
+        # Invariant: no request in the output was preempted.
+        for rid in out.num_scheduled_tokens:
+            assert scheduler.requests[rid].status == RequestStatus.RUNNING
+        if req_low.num_preemptions:
+            break
+    assert req_low.num_preemptions == 1
+    assert req_high.status == RequestStatus.RUNNING

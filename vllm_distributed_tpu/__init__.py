@@ -1,0 +1,36 @@
+"""vllm_distributed_tpu: a TPU-native distributed LLM inference framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of
+susavlsh10/vllm-distributed (a vLLM fork): continuous-batching engine with a
+paged KV cache, prefix caching, chunked prefill, tensor/pipeline/data/expert
+parallelism plus token-parallel decode attention, disaggregated prefill via a
+KV-transfer connector, and an OpenAI-compatible server.
+
+The control plane follows the reference's V1 architecture
+(/root/reference/vllm/v1/); the data plane is TPU-first: models are sharded
+with jit + NamedSharding over a jax.sharding.Mesh, attention and KV-cache
+update are Pallas kernels, and collectives ride ICI via XLA.
+"""
+
+from vllm_distributed_tpu.version import __version__
+
+__all__ = [
+    "__version__",
+    "LLM",
+    "SamplingParams",
+    "EngineArgs",
+]
+
+
+def __getattr__(name: str):
+    # Lazy imports keep `import vllm_distributed_tpu` light (no jax import).
+    if name == "LLM":
+        from vllm_distributed_tpu.entrypoints.llm import LLM
+        return LLM
+    if name == "SamplingParams":
+        from vllm_distributed_tpu.sampling_params import SamplingParams
+        return SamplingParams
+    if name == "EngineArgs":
+        from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+        return EngineArgs
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

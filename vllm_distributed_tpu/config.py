@@ -1,0 +1,328 @@
+"""Configuration system.
+
+Mirrors the reference's single-aggregate design (vllm/config.py:4364
+``VllmConfig`` holding ~15 sub-configs, each a validated dataclass) but is
+TPU-native: parallelism is expressed as mesh axis sizes (data/pipe/model/
+token/expert) that map onto a ``jax.sharding.Mesh``, and cache sizing speaks
+HBM pages instead of CUDA blocks.
+
+The aggregate ``EngineConfig`` is passed down through every layer as one
+object, exactly like the reference's ``VllmConfig``.
+"""
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import cdiv
+
+logger = init_logger(__name__)
+
+# ---------------------------------------------------------------------------
+# ModelConfig (reference: vllm/config.py:230)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModelConfig:
+    """Which model to run and how to interpret its checkpoint."""
+
+    model: str = "meta-llama/Meta-Llama-3-8B"
+    tokenizer: Optional[str] = None
+    trust_remote_code: bool = False
+    dtype: str = "bfloat16"  # bfloat16 | float32 (TPU-native dtypes)
+    seed: int = 0
+    max_model_len: Optional[int] = None
+    # Overrides applied on top of the HF config (tests use this to build tiny
+    # models without a checkpoint on disk).
+    hf_overrides: dict[str, Any] = field(default_factory=dict)
+    # Populated lazily by maybe_load_hf_config().
+    hf_config: Any = None
+
+    def __post_init__(self) -> None:
+        if self.tokenizer is None:
+            self.tokenizer = self.model
+        if self.dtype not in ("bfloat16", "float32", "float16"):
+            raise ValueError(f"unsupported dtype {self.dtype!r}")
+
+    def maybe_load_hf_config(self) -> Any:
+        """Load (and cache) the HF config for the model."""
+        if self.hf_config is None:
+            from transformers import AutoConfig
+            hf_config = AutoConfig.from_pretrained(
+                self.model, trust_remote_code=self.trust_remote_code)
+            for k, v in self.hf_overrides.items():
+                setattr(hf_config, k, v)
+            self.hf_config = hf_config
+        if self.max_model_len is None:
+            derived = getattr(self.hf_config, "max_position_embeddings", 2048)
+            self.max_model_len = int(derived)
+        return self.hf_config
+
+    # -- Introspection helpers used by the worker/scheduler ---------------
+    def get_vocab_size(self) -> int:
+        return int(self.maybe_load_hf_config().vocab_size)
+
+    def get_hidden_size(self) -> int:
+        return int(self.maybe_load_hf_config().hidden_size)
+
+    def get_num_layers(self) -> int:
+        return int(self.maybe_load_hf_config().num_hidden_layers)
+
+    def get_num_attention_heads(self) -> int:
+        return int(self.maybe_load_hf_config().num_attention_heads)
+
+    def get_num_kv_heads(self) -> int:
+        cfg = self.maybe_load_hf_config()
+        return int(
+            getattr(cfg, "num_key_value_heads", cfg.num_attention_heads))
+
+    def get_head_size(self) -> int:
+        cfg = self.maybe_load_hf_config()
+        if getattr(cfg, "head_dim", None) is not None:
+            return int(cfg.head_dim)
+        return cfg.hidden_size // cfg.num_attention_heads
+
+
+# ---------------------------------------------------------------------------
+# CacheConfig (reference: vllm/config.py:1511)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CacheConfig:
+    """Paged-KV-cache geometry and sizing."""
+
+    # Tokens per KV page. On TPU the page size interacts with the ragged
+    # paged attention kernel's block shapes; multiples of 16 keep bf16 tiles
+    # aligned (reference TPU backend pads similarly: v1/attention/backends/
+    # pallas.py:71-76 derives min page size from SMEM budget).
+    block_size: int = 16
+    # Fraction of device HBM the engine may use (weights + KV + workspace).
+    gpu_memory_utilization: float = 0.90
+    # Explicit page count override (None -> profiled at startup).
+    num_gpu_blocks_override: Optional[int] = None
+    # Number of pages decided at init time (set by the engine after
+    # profiling, like determine_available_memory in the reference).
+    num_gpu_blocks: Optional[int] = None
+    enable_prefix_caching: bool = True
+    # KV cache dtype ("auto" follows model dtype).
+    cache_dtype: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.block_size <= 0:
+            raise ValueError("block_size must be positive")
+        if not 0.0 < self.gpu_memory_utilization <= 1.0:
+            raise ValueError("gpu_memory_utilization must be in (0, 1]")
+
+
+# ---------------------------------------------------------------------------
+# ParallelConfig (reference: vllm/config.py:1798, incl. the fork's
+# token_parallel_size at :1899)
+# ---------------------------------------------------------------------------
+
+MESH_AXIS_DATA = "data"
+MESH_AXIS_PIPE = "pipe"
+MESH_AXIS_MODEL = "model"
+# The fork's token-parallel (TKNP) axis: extra devices that hold only KV
+# cache + attention state (reference: parallel_state.py:883-913).  On TPU we
+# realize it as a mesh axis that shards requests' KV across devices while
+# weights live on the "model" axis only.
+MESH_AXIS_TOKEN = "token"
+# Expert parallelism for MoE dispatch (reference: parallel_state.py:1189).
+MESH_AXIS_EXPERT = "expert"
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh geometry.
+
+    The reference builds process groups ExternalDP x (DP|TKNP) x PP x TP
+    (parallel_state.py:1116-1126); here the same axes are sizes of a single
+    ``jax.sharding.Mesh`` and XLA inserts the collectives.
+    """
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    token_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+    # Multi-host: processes per pod slice (jax.distributed).
+    distributed_init_method: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        for name in ("tensor_parallel_size", "pipeline_parallel_size",
+                     "data_parallel_size", "token_parallel_size"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if self.token_parallel_size > 1 and self.data_parallel_size > 1:
+            # Mirrors the reference's DP|TKNP exclusivity
+            # (parallel_state.py:1116-1126).
+            raise ValueError(
+                "token parallelism and data parallelism are mutually "
+                "exclusive")
+
+    @property
+    def world_size(self) -> int:
+        return (self.tensor_parallel_size * self.pipeline_parallel_size *
+                self.data_parallel_size * self.token_parallel_size)
+
+    @property
+    def mesh_shape(self) -> dict[str, int]:
+        """Axis-name -> size for the device mesh (order matters: outermost
+        axes map to DCN, innermost to ICI)."""
+        return {
+            MESH_AXIS_DATA: self.data_parallel_size,
+            MESH_AXIS_TOKEN: self.token_parallel_size,
+            MESH_AXIS_PIPE: self.pipeline_parallel_size,
+            MESH_AXIS_MODEL: self.tensor_parallel_size,
+        }
+
+
+# ---------------------------------------------------------------------------
+# SchedulerConfig (reference: vllm/config.py:2139)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SchedulerConfig:
+    """Continuous-batching budget knobs."""
+
+    max_num_batched_tokens: int = 8192
+    max_num_seqs: int = 256
+    max_model_len: int = 8192
+    enable_chunked_prefill: bool = True
+    # Requests with more than this many prompt tokens remaining are
+    # considered "long" and capped per step (reference:
+    # sched/scheduler.py:457 long_prefill_token_threshold).
+    long_prefill_token_threshold: int = 0
+    policy: str = "fcfs"  # fcfs | priority
+
+    def __post_init__(self) -> None:
+        if self.policy not in ("fcfs", "priority"):
+            raise ValueError(f"unknown scheduling policy {self.policy!r}")
+        if not self.enable_chunked_prefill:
+            # Without chunked prefill a whole prompt must fit in one step.
+            self.max_num_batched_tokens = max(self.max_num_batched_tokens,
+                                              self.max_model_len)
+
+
+# ---------------------------------------------------------------------------
+# Remaining sub-configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceConfig:
+    """Which JAX platform to run on ("auto" picks TPU when present)."""
+
+    device: str = "auto"  # auto | tpu | cpu
+
+
+@dataclass
+class LoadConfig:
+    """Weight loading (reference: vllm/config.py:1711 + model_loader/)."""
+
+    load_format: str = "auto"  # auto | safetensors | dummy
+    download_dir: Optional[str] = None
+
+
+@dataclass
+class SpeculativeConfig:
+    """Speculative decoding (reference: vllm/config.py:2502)."""
+
+    method: Optional[str] = None  # ngram | None
+    num_speculative_tokens: int = 0
+    # ngram proposer window (reference: v1/spec_decode/ngram_proposer.py).
+    prompt_lookup_max: int = 4
+    prompt_lookup_min: int = 1
+
+
+@dataclass
+class KVTransferConfig:
+    """Disaggregated prefill/decode (reference: vllm/config.py:3826)."""
+
+    kv_connector: Optional[str] = None
+    kv_role: Optional[str] = None  # kv_producer | kv_consumer | kv_both
+    kv_connector_extra_config: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def is_kv_producer(self) -> bool:
+        return self.kv_role in ("kv_producer", "kv_both")
+
+    @property
+    def is_kv_consumer(self) -> bool:
+        return self.kv_role in ("kv_consumer", "kv_both")
+
+
+@dataclass
+class ObservabilityConfig:
+    """Tracing/metrics switches (reference: vllm/config.py:3735)."""
+
+    otlp_traces_endpoint: Optional[str] = None
+    collect_detailed_traces: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Aggregate
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EngineConfig:
+    """The one object passed through every layer (reference: VllmConfig,
+    vllm/config.py:4364)."""
+
+    model_config: ModelConfig = field(default_factory=ModelConfig)
+    cache_config: CacheConfig = field(default_factory=CacheConfig)
+    parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
+    scheduler_config: SchedulerConfig = field(default_factory=SchedulerConfig)
+    device_config: DeviceConfig = field(default_factory=DeviceConfig)
+    load_config: LoadConfig = field(default_factory=LoadConfig)
+    speculative_config: SpeculativeConfig = field(
+        default_factory=SpeculativeConfig)
+    kv_transfer_config: KVTransferConfig = field(
+        default_factory=KVTransferConfig)
+    observability_config: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
+
+    def __post_init__(self) -> None:
+        # Clamp scheduler limits to the model context window once known.
+        if self.model_config.max_model_len is not None:
+            self.scheduler_config.max_model_len = \
+                self.model_config.max_model_len
+
+    def compute_hash(self) -> str:
+        """Stable hash of the config for compilation-cache keys."""
+        parts = repr((self.model_config, self.cache_config,
+                      self.parallel_config, self.scheduler_config))
+        return hashlib.sha256(parts.encode()).hexdigest()[:16]
+
+    @property
+    def max_pages_per_req(self) -> int:
+        return cdiv(self.scheduler_config.max_model_len,
+                    self.cache_config.block_size)
+
+
+_current_engine_config: list[EngineConfig] = []
+
+
+def get_current_engine_config() -> Optional[EngineConfig]:
+    """Contextvar-style accessor so deep code can read the config without
+    threading it (reference: get_current_vllm_config,
+    parallel_state.py:1087-1093)."""
+    return _current_engine_config[-1] if _current_engine_config else None
+
+
+class set_current_engine_config:
+    def __init__(self, config: EngineConfig) -> None:
+        self.config = config
+
+    def __enter__(self) -> EngineConfig:
+        _current_engine_config.append(self.config)
+        return self.config
+
+    def __exit__(self, *args) -> None:
+        _current_engine_config.pop()

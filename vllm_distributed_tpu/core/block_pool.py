@@ -1,0 +1,195 @@
+"""Free-list + prefix-cache index over KV pages.
+
+Reference: vllm/v1/core/block_pool.py (``BlockPool``: get_new_blocks:202,
+cache_full_blocks:96, LRU eviction via a doubly-linked free queue). The
+logic is device-agnostic control plane and ports conceptually: a pool of
+page ids, a ref-counted LRU free list, and a hash->page index that lets new
+requests reuse pages holding an identical prefix.
+"""
+
+from typing import Optional
+
+from vllm_distributed_tpu.core.kv_cache_utils import BlockHash
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class KVCacheBlock:
+    """One KV page's bookkeeping record."""
+
+    __slots__ = ("block_id", "ref_cnt", "block_hash", "prev_free_block",
+                 "next_free_block")
+
+    def __init__(self, block_id: int) -> None:
+        self.block_id = block_id
+        self.ref_cnt = 0
+        self.block_hash: Optional[BlockHash] = None
+        # Doubly-linked free-list pointers (None when not free).
+        self.prev_free_block: Optional[KVCacheBlock] = None
+        self.next_free_block: Optional[KVCacheBlock] = None
+
+    def __repr__(self) -> str:
+        return (f"KVCacheBlock(id={self.block_id}, ref={self.ref_cnt}, "
+                f"hashed={self.block_hash is not None})")
+
+
+class FreeKVCacheBlockQueue:
+    """LRU doubly-linked list of free blocks.
+
+    popleft() evicts the least-recently-freed block; blocks reused via a
+    prefix-cache hit are unlinked from the middle in O(1).
+    Reference: v1/core/kv_cache_utils.py FreeKVCacheBlockQueue.
+    """
+
+    def __init__(self, blocks: list[KVCacheBlock]) -> None:
+        self.num_free_blocks = 0
+        # Sentinel head/tail simplify edge cases.
+        self._head = KVCacheBlock(-1)
+        self._tail = KVCacheBlock(-2)
+        self._head.next_free_block = self._tail
+        self._tail.prev_free_block = self._head
+        for block in blocks:
+            self.append(block)
+
+    def popleft(self) -> KVCacheBlock:
+        block = self._head.next_free_block
+        assert block is not None and block is not self._tail, \
+            "no free blocks"
+        self.remove(block)
+        return block
+
+    def remove(self, block: KVCacheBlock) -> None:
+        prev, nxt = block.prev_free_block, block.next_free_block
+        assert prev is not None and nxt is not None, \
+            f"{block} is not in the free queue"
+        prev.next_free_block = nxt
+        nxt.prev_free_block = prev
+        block.prev_free_block = None
+        block.next_free_block = None
+        self.num_free_blocks -= 1
+
+    def append(self, block: KVCacheBlock) -> None:
+        last = self._tail.prev_free_block
+        assert last is not None
+        last.next_free_block = block
+        block.prev_free_block = last
+        block.next_free_block = self._tail
+        self._tail.prev_free_block = block
+        self.num_free_blocks += 1
+
+    def get_all_free_blocks(self) -> list[KVCacheBlock]:
+        out = []
+        node = self._head.next_free_block
+        while node is not None and node is not self._tail:
+            out.append(node)
+            node = node.next_free_block
+        return out
+
+
+class BlockPool:
+    """Pool of KV pages shared by all requests.
+
+    Reference semantics (v1/core/block_pool.py):
+      - ref-counted pages; pages with ref 0 sit in an LRU free queue but
+        keep their hash so they remain prefix-cache hits until evicted;
+      - ``cache_full_blocks`` assigns chained hashes to newly-filled pages;
+      - eviction (popping a hashed free page) removes it from the index.
+    """
+
+    def __init__(self, num_blocks: int, enable_caching: bool = True) -> None:
+        assert num_blocks > 0
+        self.num_blocks = num_blocks
+        self.enable_caching = enable_caching
+        self.blocks = [KVCacheBlock(i) for i in range(num_blocks)]
+        self.free_block_queue = FreeKVCacheBlockQueue(self.blocks)
+        # hash -> block holding that content (at most one per hash).
+        self.cached_block_hash_to_block: dict[bytes, KVCacheBlock] = {}
+
+    def get_num_free_blocks(self) -> int:
+        return self.free_block_queue.num_free_blocks
+
+    @property
+    def usage(self) -> float:
+        return 1.0 - self.get_num_free_blocks() / self.num_blocks
+
+    # ------------------------------------------------------------------
+    def get_cached_block(self, block_hash: BlockHash) -> Optional[KVCacheBlock]:
+        return self.cached_block_hash_to_block.get(block_hash.hash_value)
+
+    def touch(self, blocks: list[KVCacheBlock]) -> None:
+        """Take a reference on blocks (removing ref-0 ones from the free
+        queue) — used when a new request reuses cached blocks."""
+        for block in blocks:
+            if block.ref_cnt == 0:
+                self.free_block_queue.remove(block)
+            block.ref_cnt += 1
+
+    def get_new_blocks(self, num_blocks: int) -> list[KVCacheBlock]:
+        """Pop ``num_blocks`` from the free queue (caller must have checked
+        availability). Evicts any prefix-cache entries the popped blocks
+        still carry."""
+        if num_blocks > self.get_num_free_blocks():
+            raise ValueError("cannot allocate more blocks than are free")
+        out: list[KVCacheBlock] = []
+        for _ in range(num_blocks):
+            block = self.free_block_queue.popleft()
+            self._maybe_evict_cached_block(block)
+            block.ref_cnt = 1
+            out.append(block)
+        return out
+
+    def _maybe_evict_cached_block(self, block: KVCacheBlock) -> None:
+        if block.block_hash is not None:
+            self.cached_block_hash_to_block.pop(
+                block.block_hash.hash_value, None)
+            block.block_hash = None
+
+    def cache_full_blocks(
+        self,
+        blocks: list[KVCacheBlock],
+        block_hashes: list[BlockHash],
+        num_cached_blocks: int,
+        num_full_blocks: int,
+    ) -> None:
+        """Register hashes for blocks [num_cached_blocks, num_full_blocks)
+        that have just become full."""
+        if not self.enable_caching:
+            return
+        assert num_full_blocks <= len(blocks)
+        assert num_full_blocks <= len(block_hashes)
+        for i in range(num_cached_blocks, num_full_blocks):
+            block = blocks[i]
+            block_hash = block_hashes[i]
+            if block.block_hash is not None:
+                continue  # already cached (shared hit)
+            existing = self.cached_block_hash_to_block.get(
+                block_hash.hash_value)
+            if existing is not None and existing is not block:
+                # Another block already holds this content; keep the index
+                # pointing at the existing one.
+                continue
+            block.block_hash = block_hash
+            self.cached_block_hash_to_block[block_hash.hash_value] = block
+
+    def free_blocks(self, ordered_blocks: list[KVCacheBlock]) -> None:
+        """Drop one reference on each block; ref-0 blocks enter the free
+        queue in the given order (callers pass tail-first so that the
+        *front* of a sequence — the most reusable prefix — is evicted
+        last)."""
+        for block in ordered_blocks:
+            block.ref_cnt -= 1
+            assert block.ref_cnt >= 0, f"double free of {block}"
+            if block.ref_cnt == 0:
+                self.free_block_queue.append(block)
+
+    def reset_prefix_cache(self) -> bool:
+        """Drop all cached hashes (only valid when no request holds refs).
+        Reference: block_pool.py reset_prefix_cache."""
+        if self.get_num_free_blocks() != self.num_blocks:
+            logger.warning("reset_prefix_cache failed: blocks are in use")
+            return False
+        for block in self.blocks:
+            block.block_hash = None
+        self.cached_block_hash_to_block.clear()
+        return True

@@ -1,0 +1,207 @@
+"""Per-request KV page allocation on top of the BlockPool.
+
+Reference: vllm/v1/core/kv_cache_manager.py (``KVCacheManager``:
+get_computed_blocks:137 for prefix-cache hits, allocate_slots:195 — incl.
+the fork's ``tknp_skip_allocation`` used when a token-parallel peer owns the
+request's KV, which we express via ``skip_allocation``).
+"""
+
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Optional
+
+from vllm_distributed_tpu.core.block_pool import BlockPool, KVCacheBlock
+from vllm_distributed_tpu.core.kv_cache_utils import (BlockHash,
+                                                      hash_block_tokens,
+                                                      hash_request_tokens)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import Request
+from vllm_distributed_tpu.utils import cdiv
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class KVCacheBlocks:
+    """Opaque result of an allocation: the page ids newly visible to the
+    worker for this request."""
+
+    blocks: list[KVCacheBlock]
+
+    def get_block_ids(self) -> list[int]:
+        return [b.block_id for b in self.blocks]
+
+    def __add__(self, other: "KVCacheBlocks") -> "KVCacheBlocks":
+        return KVCacheBlocks(self.blocks + other.blocks)
+
+
+class KVCacheManager:
+
+    def __init__(
+        self,
+        block_size: int,
+        num_blocks: int,
+        enable_caching: bool = True,
+    ) -> None:
+        self.block_size = block_size
+        self.enable_caching = enable_caching
+        self.block_pool = BlockPool(num_blocks, enable_caching)
+
+        # req_id -> pages owned (ordered by position in sequence).
+        self.req_to_blocks: dict[str, list[KVCacheBlock]] = defaultdict(list)
+        # req_id -> chained hashes of its full pages (grows lazily).
+        self.req_to_block_hashes: dict[str, list[BlockHash]] = \
+            defaultdict(list)
+        # req_id -> number of pages already registered in the prefix cache.
+        self.num_cached_block: dict[str, int] = {}
+
+        # Stats (reference: PrefixCacheStats).
+        self.prefix_cache_queries = 0
+        self.prefix_cache_hits = 0
+
+    @property
+    def usage(self) -> float:
+        return self.block_pool.usage
+
+    def get_num_free_blocks(self) -> int:
+        return self.block_pool.get_num_free_blocks()
+
+    # ------------------------------------------------------------------
+    def get_computed_blocks(
+            self, request: Request) -> tuple[KVCacheBlocks, int]:
+        """Longest cached-prefix lookup for a WAITING request.
+
+        Returns (cached blocks, num_computed_tokens). Never returns the
+        *entire* prompt as cached — the last token must be recomputed so a
+        logit is produced for it (reference: kv_cache_manager.py:137).
+        """
+        if not self.enable_caching:
+            return KVCacheBlocks([]), 0
+
+        block_hashes = self.req_to_block_hashes[request.request_id]
+        if not block_hashes:
+            block_hashes = hash_request_tokens(self.block_size, request)
+            self.req_to_block_hashes[request.request_id] = block_hashes
+
+        self.prefix_cache_queries += 1
+        computed: list[KVCacheBlock] = []
+        # Cap so at least one prompt token remains to be computed.
+        max_cache_hit_tokens = request.num_tokens - 1
+        for i, bh in enumerate(block_hashes):
+            if (i + 1) * self.block_size > max_cache_hit_tokens:
+                break
+            block = self.block_pool.get_cached_block(bh)
+            if block is None:
+                break
+            computed.append(block)
+        if computed:
+            self.prefix_cache_hits += 1
+        return KVCacheBlocks(computed), len(computed) * self.block_size
+
+    def allocate_slots(
+        self,
+        request: Request,
+        num_new_tokens: int,
+        new_computed_blocks: Optional[KVCacheBlocks] = None,
+        num_lookahead_tokens: int = 0,
+        skip_allocation: bool = False,
+    ) -> Optional[KVCacheBlocks]:
+        """Ensure the request has pages for ``num_new_tokens`` more tokens.
+
+        Returns the newly-allocated pages, or None if the pool cannot
+        satisfy the allocation (caller preempts). ``skip_allocation``
+        mirrors the fork's tknp_skip_allocation (scheduler.py:494-500):
+        the tokens are scheduled but a token-parallel peer holds the KV.
+        """
+        assert num_new_tokens > 0
+        if skip_allocation:
+            return KVCacheBlocks([])
+
+        computed_blocks = (new_computed_blocks.blocks
+                           if new_computed_blocks else [])
+        req_blocks = self.req_to_blocks[request.request_id]
+
+        num_computed_tokens = (request.num_computed_tokens +
+                               len(computed_blocks) * self.block_size)
+        total_tokens = (num_computed_tokens + num_new_tokens +
+                        num_lookahead_tokens)
+        num_required_blocks = cdiv(total_tokens, self.block_size)
+        num_new_blocks = (num_required_blocks - len(req_blocks) -
+                          len(computed_blocks))
+
+        # Cache-hit blocks with ref 0 still sit in the free queue; taking a
+        # ref on them consumes free capacity, so discount them (reference:
+        # kv_cache_manager.py:195 num_evictable_computed_blocks).
+        num_evictable_computed = sum(1 for b in computed_blocks
+                                     if b.ref_cnt == 0)
+        if (num_new_blocks >
+                self.block_pool.get_num_free_blocks() -
+                num_evictable_computed):
+            return None  # cannot allocate; caller decides to preempt
+
+        # Commit: take refs on the cache-hit blocks, then allocate new ones.
+        if computed_blocks:
+            self.block_pool.touch(computed_blocks)
+            req_blocks.extend(computed_blocks)
+
+        new_blocks: list[KVCacheBlock] = []
+        if num_new_blocks > 0:
+            new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
+            req_blocks.extend(new_blocks)
+
+        if self.enable_caching:
+            self._cache_full_blocks(request, num_computed_tokens,
+                                    num_new_tokens)
+
+        return KVCacheBlocks(new_blocks)
+
+    def _cache_full_blocks(self, request: Request,
+                           num_computed_tokens: int,
+                           num_new_tokens: int) -> None:
+        """Register hashes for pages that become full once the scheduled
+        tokens are computed. Hashes only cover tokens that *exist* now
+        (prompt + already-sampled); a decode step filling a page registers
+        it on the following step via the growing hash list."""
+        req_blocks = self.req_to_blocks[request.request_id]
+        block_hashes = self.req_to_block_hashes[request.request_id]
+        # Extend hashes to cover any newly-complete full pages.
+        num_full_after = min(num_computed_tokens + num_new_tokens,
+                             request.num_tokens) // self.block_size
+        parent = (block_hashes[-1].hash_value if block_hashes else None)
+        while len(block_hashes) < num_full_after:
+            start = len(block_hashes) * self.block_size
+            chunk = tuple(request.all_token_ids[start:start +
+                                                self.block_size])
+            bh = hash_block_tokens(parent, chunk)
+            block_hashes.append(bh)
+            parent = bh.hash_value
+        num_cached = self.num_cached_block.get(request.request_id, 0)
+        if num_full_after > num_cached:
+            self.block_pool.cache_full_blocks(req_blocks, block_hashes,
+                                              num_cached, num_full_after)
+            self.num_cached_block[request.request_id] = num_full_after
+
+    # ------------------------------------------------------------------
+    def free(self, request: Request) -> None:
+        """Release all pages of a finished/preempted request. Pages are
+        returned tail-first so prefixes are evicted last."""
+        blocks = self.req_to_blocks.pop(request.request_id, [])
+        self.num_cached_block.pop(request.request_id, None)
+        self.block_pool.free_blocks(list(reversed(blocks)))
+
+    def free_block_hashes(self, request: Request) -> None:
+        """Forget the request's hash list (on finish — distinct from free()
+        because preempted requests keep hashes for re-prefill)."""
+        self.req_to_block_hashes.pop(request.request_id, None)
+
+    def get_block_ids(self, request_id: str) -> list[int]:
+        return [b.block_id for b in self.req_to_blocks[request_id]]
+
+    def reset_prefix_cache(self) -> bool:
+        return self.block_pool.reset_prefix_cache()
+
+    def make_prefix_cache_stats(self) -> dict[str, float]:
+        return {
+            "queries": self.prefix_cache_queries,
+            "hits": self.prefix_cache_hits,
+        }

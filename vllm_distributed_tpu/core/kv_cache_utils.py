@@ -1,0 +1,83 @@
+"""KV-cache block hashing and page-geometry helpers.
+
+Reference: vllm/v1/core/kv_cache_utils.py (block hashing incl. chained
+parent hashes used by the prefix cache) — re-implemented with deterministic
+sha256 hashes so prefix-cache behavior is reproducible across processes
+(the reference uses Python hash() with PYTHONHASHSEED pinning; sha256 avoids
+the pinning requirement entirely).
+"""
+
+import hashlib
+import struct
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+from vllm_distributed_tpu.request import Request
+
+
+class BlockHash(NamedTuple):
+    """Hash of one full KV page: chained over the parent page so equal
+    hashes imply equal full prefixes."""
+
+    hash_value: bytes
+    # Kept for collision resistance checks / debugging.
+    token_ids: tuple[int, ...]
+
+
+NONE_HASH = b"\x00" * 16
+
+
+def hash_block_tokens(
+    parent_hash: Optional[bytes],
+    token_ids: tuple[int, ...],
+    extra_keys: Optional[tuple] = None,
+) -> BlockHash:
+    """Chained hash of a full block of tokens.
+
+    ``extra_keys`` carries things that change KV content beyond token ids
+    (LoRA id, multimodal content hashes) — reference:
+    v1/core/kv_cache_utils.py generate_block_hash_extra_keys.
+    """
+    h = hashlib.sha256()
+    h.update(parent_hash or NONE_HASH)
+    h.update(struct.pack(f"<{len(token_ids)}q", *token_ids))
+    if extra_keys:
+        h.update(repr(extra_keys).encode())
+    return BlockHash(h.digest()[:16], token_ids)
+
+
+def hash_request_tokens(block_size: int,
+                        request: Request) -> list[BlockHash]:
+    """Hash all *full* blocks of the request's current tokens."""
+    token_ids = request.all_token_ids
+    hashes: list[BlockHash] = []
+    parent: Optional[bytes] = None
+    for start in range(0, len(token_ids) - block_size + 1, block_size):
+        chunk = tuple(token_ids[start:start + block_size])
+        bh = hash_block_tokens(parent, chunk)
+        hashes.append(bh)
+        parent = bh.hash_value
+    return hashes
+
+
+@dataclass
+class KVCacheSpec:
+    """Geometry of one KV cache group (reference:
+    v1/kv_cache_interface.py:20-208 FullAttentionSpec et al.).
+
+    Round 1 supports full attention only; sliding-window/mamba groups slot in
+    as additional specs later.
+    """
+
+    block_size: int
+    num_kv_heads: int
+    head_size: int
+    dtype: str
+    num_layers: int
+
+    @property
+    def page_size_bytes(self) -> int:
+        itemsize = {"bfloat16": 2, "float16": 2, "float32": 4}[self.dtype]
+        # K and V planes.
+        return (2 * self.block_size * self.num_kv_heads * self.head_size *
+                itemsize * self.num_layers)

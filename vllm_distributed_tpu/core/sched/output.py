@@ -1,0 +1,92 @@
+"""Scheduler <-> worker wire types.
+
+Reference: vllm/v1/core/sched/output.py (``SchedulerOutput`` carrying
+NewRequestData/CachedRequestData, plus the fork's ``TokenParallelAllocation``
+at output.py:84 carried on SchedulerOutput at output.py:168) and
+vllm/v1/outputs.py (``ModelRunnerOutput``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@dataclass
+class NewRequestData:
+    """First time a request is handed to the workers."""
+
+    req_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    block_ids: list[int]
+    num_computed_tokens: int
+
+
+@dataclass
+class CachedRequestData:
+    """Incremental update for requests the workers already know."""
+
+    req_ids: list[str] = field(default_factory=list)
+    resumed_from_preemption: list[bool] = field(default_factory=list)
+    # Tokens appended since last step (resumed requests carry all tokens).
+    new_token_ids: list[list[int]] = field(default_factory=list)
+    new_block_ids: list[list[int]] = field(default_factory=list)
+    num_computed_tokens: list[int] = field(default_factory=list)
+
+
+@dataclass
+class TokenParallelAllocation:
+    """Which token-parallel rank owns each scheduled request's KV.
+
+    TPU analogue of the fork's TokenParallelAllocation
+    (v1/core/sched/output.py:84): rank indexes the "token" mesh axis.
+    """
+
+    req_to_rank: dict[str, int] = field(default_factory=dict)
+    tokens_per_rank: list[int] = field(default_factory=list)
+
+
+@dataclass
+class SchedulerOutput:
+    scheduled_new_reqs: list[NewRequestData] = field(default_factory=list)
+    scheduled_cached_reqs: CachedRequestData = field(
+        default_factory=CachedRequestData)
+    # req_id -> tokens to run this step (new prompt chunk or 1 + spec len).
+    num_scheduled_tokens: dict[str, int] = field(default_factory=dict)
+    total_num_scheduled_tokens: int = 0
+    # req_id -> speculative draft tokens being verified this step.
+    scheduled_spec_decode_tokens: dict[str, list[int]] = \
+        field(default_factory=dict)
+    finished_req_ids: set[str] = field(default_factory=set)
+    # Disaggregated-prefill metadata piggybacking on the step, consumed by
+    # the worker-side connector (reference: base.py build_connector_meta).
+    kv_connector_metadata: Optional[Any] = None
+    # Token-parallel ownership for this step (None when tknp disabled).
+    token_parallel_allocation: Optional[TokenParallelAllocation] = None
+
+
+EMPTY_MODEL_RUNNER_OUTPUT: "ModelRunnerOutput"
+
+
+@dataclass
+class ModelRunnerOutput:
+    """Per-step result shipped from workers back to the scheduler
+    (reference: vllm/v1/outputs.py ModelRunnerOutput)."""
+
+    # Requests in batch order.
+    req_ids: list[str] = field(default_factory=list)
+    # Sampled token ids per request (len 0 for partial-prefill steps,
+    # >1 with accepted speculative tokens).
+    sampled_token_ids: list[list[int]] = field(default_factory=list)
+    # Optional per-request, per-token logprobs: list aligned with
+    # sampled_token_ids; each entry maps token_id -> logprob.
+    logprobs: Optional[list[list[dict[int, float]]]] = None
+    # Draft tokens proposed for the *next* step (spec decode).
+    spec_token_ids: Optional[list[list[int]]] = None
+    # KV-transfer completion notifications (disagg).
+    finished_sending: Optional[set[str]] = None
+    finished_recving: Optional[set[str]] = None
+
+
+EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()

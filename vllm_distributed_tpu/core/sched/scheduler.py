@@ -1,0 +1,448 @@
+"""Continuous-batching scheduler.
+
+Reference: vllm/v1/core/sched/scheduler.py (``Scheduler.schedule``:413,
+``update_from_output``:1012). One token-budget loop unifies prefill, decode,
+chunked prefill and speculative verification: each step, every scheduled
+request contributes ``num_new_tokens`` (a prompt chunk, or 1 + draft length
+for decode) against ``max_num_batched_tokens``. Preemption pops the
+lowest-priority running request and returns it to the waiting queue with its
+pages freed.
+
+TPU note: the scheduler is pure control plane (no device code) and runs on
+the host exactly as in the reference; static-shape discipline lives in the
+worker, which pads this scheduler's ragged output to bucketed shapes.
+"""
+
+import time
+from collections import deque
+from typing import Iterable, Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.kv_cache_manager import (KVCacheBlocks,
+                                                        KVCacheManager)
+from vllm_distributed_tpu.core.sched.output import (CachedRequestData,
+                                                    ModelRunnerOutput,
+                                                    NewRequestData,
+                                                    SchedulerOutput)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import Request, RequestStatus
+
+logger = init_logger(__name__)
+
+
+class EngineCoreOutput:
+    """Per-request delta shipped to the engine front-end
+    (reference: v1/engine/__init__.py EngineCoreOutput)."""
+
+    __slots__ = ("req_id", "new_token_ids", "finish_reason", "stop_reason",
+                 "num_cached_tokens", "logprobs")
+
+    def __init__(self, req_id: str, new_token_ids: list[int],
+                 finish_reason: Optional[str] = None,
+                 stop_reason: Optional[int | str] = None,
+                 num_cached_tokens: int = 0,
+                 logprobs: Optional[list[dict[int, float]]] = None) -> None:
+        self.req_id = req_id
+        self.new_token_ids = new_token_ids
+        self.finish_reason = finish_reason
+        self.stop_reason = stop_reason
+        self.num_cached_tokens = num_cached_tokens
+        self.logprobs = logprobs
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+class Scheduler:
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        num_blocks: Optional[int] = None,
+        kv_connector=None,
+    ) -> None:
+        self.config = config
+        sched_cfg = config.scheduler_config
+        self.max_num_batched_tokens = sched_cfg.max_num_batched_tokens
+        self.max_num_seqs = sched_cfg.max_num_seqs
+        self.max_model_len = sched_cfg.max_model_len
+        self.enable_chunked_prefill = sched_cfg.enable_chunked_prefill
+        self.long_prefill_token_threshold = \
+            sched_cfg.long_prefill_token_threshold
+        self.policy = sched_cfg.policy
+
+        if num_blocks is None:
+            num_blocks = config.cache_config.num_gpu_blocks
+        assert num_blocks is not None and num_blocks > 0, \
+            "scheduler needs the page count (set cache_config.num_gpu_blocks)"
+        self.kv_cache_manager = KVCacheManager(
+            block_size=config.cache_config.block_size,
+            num_blocks=num_blocks,
+            enable_caching=config.cache_config.enable_prefix_caching,
+        )
+        # Disaggregated-prefill hook (reference: scheduler holds the
+        # scheduler-side KVConnector, sched/scheduler.py KVConnector calls).
+        self.kv_connector = kv_connector
+
+        self.requests: dict[str, Request] = {}
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+        # Finished ids to tell the workers to drop state for.
+        self.finished_req_ids: set[str] = set()
+
+        # Stats for the metrics subsystem.
+        self.num_scheduled_steps = 0
+        self.num_preemptions = 0
+
+    # ------------------------------------------------------------------
+    # Request intake / teardown
+    # ------------------------------------------------------------------
+    def add_request(self, request: Request) -> None:
+        assert request.request_id not in self.requests
+        self.requests[request.request_id] = request
+        request.status = RequestStatus.WAITING
+        if self.policy == "priority":
+            self._insert_by_priority(request)
+        else:
+            self.waiting.append(request)
+
+    def _insert_by_priority(self, request: Request) -> None:
+        key = (request.priority, request.arrival_time)
+        for i, r in enumerate(self.waiting):
+            if key < (r.priority, r.arrival_time):
+                self.waiting.insert(i, request)
+                return
+        self.waiting.append(request)
+
+    def finish_requests(self, request_ids: str | Iterable[str],
+                        status: RequestStatus) -> None:
+        """External finish (abort, stop-string hit detected by the
+        front-end detokenizer). Reference: scheduler.py finish_requests."""
+        if isinstance(request_ids, str):
+            request_ids = (request_ids, )
+        for req_id in request_ids:
+            request = self.requests.get(req_id)
+            if request is None or request.is_finished:
+                continue
+            if request.status == RequestStatus.RUNNING:
+                self.running.remove(request)
+            else:
+                try:
+                    self.waiting.remove(request)
+                except ValueError:
+                    pass
+            request.status = status
+            self._free_request(request)
+
+    def _free_request(self, request: Request) -> None:
+        assert request.is_finished
+        self.kv_cache_manager.free(request)
+        self.kv_cache_manager.free_block_hashes(request)
+        self.finished_req_ids.add(request.request_id)
+        del self.requests[request.request_id]
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+    def has_requests(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.has_requests()
+
+    def get_num_unfinished_requests(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    # ------------------------------------------------------------------
+    # The hot loop
+    # ------------------------------------------------------------------
+    def schedule(self) -> SchedulerOutput:
+        scheduled_new_reqs: list[NewRequestData] = []
+        cached_reqs = CachedRequestData()
+        num_scheduled_tokens: dict[str, int] = {}
+        scheduled_spec_tokens: dict[str, list[int]] = {}
+        token_budget = self.max_num_batched_tokens
+        preempted: list[Request] = []
+
+        # ---- 1. Running requests (decode / ongoing chunked prefill) ----
+        req_index = 0
+        while req_index < len(self.running) and token_budget > 0:
+            request = self.running[req_index]
+            num_new_tokens = (request.num_tokens_with_spec -
+                              request.num_computed_tokens)
+            if self.long_prefill_token_threshold > 0:
+                num_new_tokens = min(num_new_tokens,
+                                     self.long_prefill_token_threshold)
+            num_new_tokens = min(num_new_tokens, token_budget)
+            # Never run past the context window.
+            num_new_tokens = min(
+                num_new_tokens,
+                self.max_model_len - request.num_computed_tokens)
+            if num_new_tokens <= 0:
+                req_index += 1
+                continue
+
+            scheduled = True
+            while True:
+                new_blocks = self.kv_cache_manager.allocate_slots(
+                    request, num_new_tokens)
+                if new_blocks is not None:
+                    break
+                # Out of pages: preempt the lowest-priority running request
+                # that has NOT been scheduled this step (evicting a
+                # scheduled one would leave SchedulerOutput entries
+                # pointing at freed pages).
+                victim = self._select_preemption_victim(req_index)
+                self._preempt(victim)
+                preempted.append(victim)
+                if victim is request:
+                    scheduled = False
+                    break
+            if not scheduled:
+                # The current request itself was preempted; its slot in
+                # self.running is gone — do not advance req_index.
+                continue
+
+            num_scheduled_tokens[request.request_id] = num_new_tokens
+            token_budget -= num_new_tokens
+            if request.spec_token_ids:
+                scheduled_spec_tokens[request.request_id] = \
+                    list(request.spec_token_ids)
+            cached_reqs.req_ids.append(request.request_id)
+            cached_reqs.resumed_from_preemption.append(False)
+            cached_reqs.new_token_ids.append(
+                request.all_token_ids[request.num_computed_tokens:
+                                      request.num_computed_tokens +
+                                      num_new_tokens])
+            cached_reqs.new_block_ids.append(new_blocks.get_block_ids())
+            cached_reqs.num_computed_tokens.append(
+                request.num_computed_tokens)
+            req_index += 1
+
+        # ---- 2. Waiting requests (new or resumed-from-preemption) ----
+        # Don't admit new work in a step where we had to preempt.
+        if not preempted:
+            while (self.waiting and token_budget > 0
+                   and len(self.running) < self.max_num_seqs):
+                request = self.waiting[0]
+
+                if request.num_prompt_tokens >= self.max_model_len:
+                    # The prompt alone fills (or overflows) the context
+                    # window: it could never produce a token. Reject it
+                    # instead of admitting a request that can never finish.
+                    logger.warning(
+                        "Request %s prompt (%d tokens) exceeds "
+                        "max_model_len (%d); ignoring.",
+                        request.request_id, request.num_prompt_tokens,
+                        self.max_model_len)
+                    self.waiting.popleft()
+                    request.status = RequestStatus.FINISHED_IGNORED
+                    self._free_request(request)
+                    continue
+
+                num_computed_tokens = request.num_computed_tokens
+                new_computed_blocks: Optional[KVCacheBlocks] = None
+                if num_computed_tokens == 0:
+                    # Fresh request: prefix-cache lookup.
+                    new_computed_blocks, num_computed_tokens = \
+                        self.kv_cache_manager.get_computed_blocks(request)
+                    if request.num_cached_tokens < 0:
+                        request.num_cached_tokens = num_computed_tokens
+
+                num_new_tokens = request.num_tokens - num_computed_tokens
+                if self.long_prefill_token_threshold > 0:
+                    num_new_tokens = min(num_new_tokens,
+                                         self.long_prefill_token_threshold)
+                if num_new_tokens > token_budget:
+                    if not self.enable_chunked_prefill:
+                        break  # must fit in one step
+                    num_new_tokens = token_budget
+                assert num_new_tokens > 0
+
+                new_blocks = self.kv_cache_manager.allocate_slots(
+                    request, num_new_tokens, new_computed_blocks)
+                if new_blocks is None:
+                    break  # out of pages; retry next step
+
+                self.waiting.popleft()
+                resumed = request.status == RequestStatus.PREEMPTED
+                request.status = RequestStatus.RUNNING
+                request.num_computed_tokens = num_computed_tokens
+                self.running.append(request)
+
+                num_scheduled_tokens[request.request_id] = num_new_tokens
+                token_budget -= num_new_tokens
+
+                all_block_ids = self.kv_cache_manager.get_block_ids(
+                    request.request_id)
+                if resumed:
+                    cached_reqs.req_ids.append(request.request_id)
+                    cached_reqs.resumed_from_preemption.append(True)
+                    cached_reqs.new_token_ids.append(
+                        list(request.all_token_ids))
+                    cached_reqs.new_block_ids.append(all_block_ids)
+                    cached_reqs.num_computed_tokens.append(
+                        num_computed_tokens)
+                else:
+                    scheduled_new_reqs.append(
+                        NewRequestData(
+                            req_id=request.request_id,
+                            prompt_token_ids=list(request.prompt_token_ids),
+                            sampling_params=request.sampling_params,
+                            block_ids=all_block_ids,
+                            num_computed_tokens=num_computed_tokens,
+                        ))
+
+        self.num_scheduled_steps += 1
+        total = sum(num_scheduled_tokens.values())
+        output = SchedulerOutput(
+            scheduled_new_reqs=scheduled_new_reqs,
+            scheduled_cached_reqs=cached_reqs,
+            num_scheduled_tokens=num_scheduled_tokens,
+            total_num_scheduled_tokens=total,
+            scheduled_spec_decode_tokens=scheduled_spec_tokens,
+            finished_req_ids=self.finished_req_ids,
+        )
+        self.finished_req_ids = set()
+        if self.kv_connector is not None:
+            output.kv_connector_metadata = \
+                self.kv_connector.build_connector_meta(output)
+        return output
+
+    def _select_preemption_victim(self, req_index: int) -> Request:
+        """Pick a victim among requests not yet scheduled this step
+        (self.running[req_index:]). Under the priority policy the
+        lowest-priority *unscheduled* request is chosen — a request already
+        granted tokens this step is never evicted mid-step."""
+        candidates = self.running[req_index:]
+        if self.policy == "priority":
+            return max(candidates,
+                       key=lambda r: (r.priority, r.arrival_time))
+        return candidates[-1]
+
+    def _preempt(self, request: Request) -> None:
+        self.running.remove(request)
+        self.kv_cache_manager.free(request)
+        request.status = RequestStatus.PREEMPTED
+        request.num_computed_tokens = 0
+        request.spec_token_ids = []
+        request.num_preemptions += 1
+        self.num_preemptions += 1
+        if self.policy == "priority":
+            self._insert_by_priority(request)
+        else:
+            self.waiting.appendleft(request)
+
+    # ------------------------------------------------------------------
+    # Post-step accounting
+    # ------------------------------------------------------------------
+    def update_from_output(
+        self,
+        scheduler_output: SchedulerOutput,
+        runner_output: ModelRunnerOutput,
+    ) -> list[EngineCoreOutput]:
+        """Fold sampled tokens back into request state; detect token-level
+        stops; free finished requests. Reference: scheduler.py:1012."""
+        num_scheduled = scheduler_output.num_scheduled_tokens
+        sampled_by_req: dict[str, list[int]] = {
+            req_id: tokens
+            for req_id, tokens in zip(runner_output.req_ids,
+                                      runner_output.sampled_token_ids)
+        }
+        logprobs_by_req: dict[str, list[dict[int, float]]] = {}
+        if runner_output.logprobs is not None:
+            logprobs_by_req = {
+                req_id: lps
+                for req_id, lps in zip(runner_output.req_ids,
+                                       runner_output.logprobs)
+            }
+        spec_by_req: dict[str, list[int]] = {}
+        if runner_output.spec_token_ids is not None:
+            spec_by_req = {
+                req_id: spec
+                for req_id, spec in zip(runner_output.req_ids,
+                                        runner_output.spec_token_ids)
+            }
+
+        outputs: list[EngineCoreOutput] = []
+        finished: list[Request] = []
+        for request in self.running:
+            req_id = request.request_id
+            if req_id not in num_scheduled:
+                continue
+            scheduled = num_scheduled[req_id]
+            generated = sampled_by_req.get(req_id, [])
+
+            # Speculative verification: some scheduled draft tokens may
+            # have been rejected (reference: scheduler.py:1012 spec path).
+            num_spec = len(
+                scheduler_output.scheduled_spec_decode_tokens.get(req_id, []))
+            if num_spec > 0:
+                num_rejected = num_spec + 1 - len(generated)
+                scheduled -= max(num_rejected, 0)
+            request.num_computed_tokens += scheduled
+            request.spec_token_ids = spec_by_req.get(req_id, [])
+
+            if not generated:
+                continue  # partial prefill chunk; nothing sampled yet
+
+            new_token_ids: list[int] = []
+            stop_reason: Optional[int | str] = None
+            for token_id in generated:
+                request.append_output_token_ids(token_id)
+                new_token_ids.append(token_id)
+                stopped, stop_reason = self._check_stop(request, token_id)
+                if stopped:
+                    # Discard any extra accepted spec tokens past the stop.
+                    request.spec_token_ids = []
+                    break
+
+            if request.is_finished:
+                finished.append(request)
+            # Logprobs trimmed to the tokens actually kept after stop
+            # handling (a stop may discard trailing accepted spec tokens).
+            logprobs = logprobs_by_req.get(req_id)
+            if logprobs is not None:
+                logprobs = logprobs[:len(new_token_ids)]
+            outputs.append(
+                EngineCoreOutput(
+                    req_id=req_id,
+                    new_token_ids=new_token_ids,
+                    finish_reason=request.get_finished_reason(),
+                    stop_reason=stop_reason,
+                    num_cached_tokens=max(request.num_cached_tokens, 0),
+                    logprobs=logprobs,
+                ))
+
+        for request in finished:
+            self.running.remove(request)
+            self._free_request(request)
+        return outputs
+
+    def _check_stop(
+            self, request: Request,
+            last_token_id: int) -> tuple[bool, Optional[int | str]]:
+        sp = request.sampling_params
+        if (request.num_tokens >= self.max_model_len
+                or request.num_output_tokens >= sp.max_tokens):
+            request.status = RequestStatus.FINISHED_LENGTH_CAPPED
+            return True, None
+        if request.num_output_tokens < sp.min_tokens:
+            return False, None
+        if last_token_id in sp.all_stop_token_ids:
+            request.status = RequestStatus.FINISHED_STOPPED
+            if last_token_id != request.eos_token_id or sp.ignore_eos:
+                request.stop_reason = last_token_id
+            return True, request.stop_reason
+        return False, None
+
+    # ------------------------------------------------------------------
+    def get_stats(self) -> dict[str, float]:
+        return {
+            "num_running_reqs": len(self.running),
+            "num_waiting_reqs": len(self.waiting),
+            "kv_cache_usage": self.kv_cache_manager.usage,
+            "num_preemptions": self.num_preemptions,
+            **self.kv_cache_manager.make_prefix_cache_stats(),
+        }

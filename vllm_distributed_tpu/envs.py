@@ -1,0 +1,64 @@
+"""Environment variable registry.
+
+Mirrors the reference's vllm/envs.py (125 lazily-evaluated VLLM_* vars): every
+framework knob that is not part of EngineArgs lives here, is lazily evaluated
+at first access, and is documented in one place. Prefix is VDT_ (and we also
+honor the corresponding VLLM_ spelling for drop-in compatibility where the
+semantic matches).
+"""
+
+import os
+from typing import Any, Callable
+
+environment_variables: dict[str, Callable[[], Any]] = {
+    # Logging level for the framework's logger tree (DEBUG/INFO/WARNING...).
+    "VDT_LOGGING_LEVEL":
+    lambda: os.getenv("VDT_LOGGING_LEVEL", os.getenv("VLLM_LOGGING_LEVEL", "INFO")).upper(),
+    # Optional prefix prepended to every log line.
+    "VDT_LOGGING_PREFIX":
+    lambda: os.getenv("VDT_LOGGING_PREFIX", os.getenv("VLLM_LOGGING_PREFIX", "")),
+    # Use the pure-XLA reference attention instead of the Pallas kernels
+    # (debugging / CPU execution).
+    "VDT_ATTENTION_BACKEND":
+    lambda: os.getenv("VDT_ATTENTION_BACKEND", "auto"),  # auto|pallas|xla
+    # Run Pallas kernels in interpret mode (CPU tests).
+    "VDT_PALLAS_INTERPRET":
+    lambda: os.getenv("VDT_PALLAS_INTERPRET", "0") == "1",
+    # Fraction of HBM usable for weights+KV (analogue of gpu_memory_utilization
+    # default source).
+    "VDT_MEMORY_FRACTION":
+    lambda: float(os.getenv("VDT_MEMORY_FRACTION", "0.9")),
+    # Directory for JAX persistent compilation cache ("" disables).
+    "VDT_XLA_CACHE_DIR":
+    lambda: os.getenv("VDT_XLA_CACHE_DIR",
+                      os.path.expanduser("~/.cache/vdt_xla_cache")),
+    # RPC timeout (seconds) for engine-core client handshakes.
+    "VDT_RPC_TIMEOUT":
+    lambda: float(os.getenv("VDT_RPC_TIMEOUT", "600")),
+    # Port for the ZMQ engine-core transport (0 = auto).
+    "VDT_ENGINE_CORE_PORT":
+    lambda: int(os.getenv("VDT_ENGINE_CORE_PORT", "0")),
+    # API key for the OpenAI server ("" disables auth).
+    "VDT_API_KEY":
+    lambda: os.getenv("VDT_API_KEY", os.getenv("VLLM_API_KEY", "")),
+    # Host IP override used for distributed bootstrap.
+    "VDT_HOST_IP":
+    lambda: os.getenv("VDT_HOST_IP", os.getenv("VLLM_HOST_IP", "")),
+    # Enable torch/XLA profiler dir ("" disables).
+    "VDT_PROFILER_DIR":
+    lambda: os.getenv("VDT_PROFILER_DIR", ""),
+    # Disable the usage-stats style telemetry (always disabled by default;
+    # kept for CLI parity).
+    "VDT_NO_USAGE_STATS":
+    lambda: os.getenv("VDT_NO_USAGE_STATS", "1") == "1",
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in environment_variables:
+        return environment_variables[name]()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__() -> list[str]:
+    return list(environment_variables.keys())

@@ -1,0 +1,41 @@
+"""Per-module logging, mirroring the reference's vllm/logger.py.
+
+Behavior is controlled by env vars (see envs.py): VDT_LOGGING_LEVEL,
+VDT_LOGGING_PREFIX.
+"""
+
+import logging
+import sys
+
+_FORMAT = "%(levelname)s %(asctime)s [%(name)s:%(lineno)d] %(message)s"
+_DATE_FORMAT = "%m-%d %H:%M:%S"
+
+_root_configured = False
+
+
+def _configure_root() -> None:
+    global _root_configured
+    if _root_configured:
+        return
+    from vllm_distributed_tpu import envs
+
+    root = logging.getLogger("vllm_distributed_tpu")
+    root.setLevel(envs.VDT_LOGGING_LEVEL)
+    handler = logging.StreamHandler(sys.stdout)
+    prefix = envs.VDT_LOGGING_PREFIX
+    handler.setFormatter(
+        logging.Formatter(prefix + _FORMAT, datefmt=_DATE_FORMAT))
+    root.addHandler(handler)
+    root.propagate = False
+    _root_configured = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Return a logger under the framework's logging tree.
+
+    Mirrors vllm/logger.py:init_logger in the reference.
+    """
+    _configure_root()
+    if not name.startswith("vllm_distributed_tpu"):
+        name = f"vllm_distributed_tpu.{name}"
+    return logging.getLogger(name)

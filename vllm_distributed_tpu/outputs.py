@@ -1,0 +1,46 @@
+"""User-facing request outputs (reference: vllm/outputs.py)."""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class CompletionOutput:
+    """One generated completion for a request."""
+
+    index: int
+    text: str
+    token_ids: list[int]
+    cumulative_logprob: Optional[float] = None
+    logprobs: Optional[list[dict[int, float]]] = None
+    finish_reason: Optional[str] = None  # "stop" | "length" | "abort"
+    stop_reason: Optional[int | str] = None
+
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+@dataclass
+class RequestOutput:
+    """Aggregated output returned from LLMEngine.step() / AsyncLLM.generate."""
+
+    request_id: str
+    prompt: Optional[str]
+    prompt_token_ids: list[int]
+    outputs: list[CompletionOutput]
+    finished: bool
+    metrics: Optional[dict] = None
+    num_cached_tokens: int = 0
+
+    @property
+    def text(self) -> str:
+        return self.outputs[0].text if self.outputs else ""
+
+
+@dataclass
+class PoolingOutput:
+    """Embedding/pooling result (reference: vllm/outputs.py pooling path)."""
+
+    request_id: str
+    embedding: list[float] = field(default_factory=list)
+    finished: bool = True

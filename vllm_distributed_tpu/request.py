@@ -1,0 +1,159 @@
+"""Engine-core request state (reference: vllm/v1/request.py).
+
+A ``Request`` is the scheduler-side record of one in-flight generation: its
+token ids, how many tokens have KV computed, its lifecycle status, and the
+bookkeeping the KV-cache manager needs (block hashes are kept separately in
+the manager).
+"""
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class RequestStatus(enum.IntEnum):
+    """Lifecycle of a request (reference: v1/request.py RequestStatus)."""
+
+    WAITING = 0
+    RUNNING = 1
+    PREEMPTED = 2
+    # Terminal states below.
+    FINISHED_STOPPED = 3
+    FINISHED_LENGTH_CAPPED = 4
+    FINISHED_ABORTED = 5
+    FINISHED_IGNORED = 6
+
+    @staticmethod
+    def is_finished(status: "RequestStatus") -> bool:
+        return status >= RequestStatus.FINISHED_STOPPED
+
+    @staticmethod
+    def get_finished_reason(status: "RequestStatus") -> Optional[str]:
+        return _FINISHED_REASONS.get(status)
+
+
+_FINISHED_REASONS: dict[RequestStatus, str] = {
+    RequestStatus.FINISHED_STOPPED: "stop",
+    RequestStatus.FINISHED_LENGTH_CAPPED: "length",
+    RequestStatus.FINISHED_ABORTED: "abort",
+    RequestStatus.FINISHED_IGNORED: "length",
+}
+
+
+@dataclass
+class EngineCoreRequest:
+    """Wire format between the engine front-end and the core
+    (reference: v1/engine/__init__.py EngineCoreRequest)."""
+
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    eos_token_id: Optional[int] = None
+    arrival_time: float = field(default_factory=time.time)
+    priority: int = 0
+    # Disaggregated prefill routing (reference: kv_transfer_params on the
+    # request, nixl_connector.py:205).
+    kv_transfer_params: Optional[dict[str, Any]] = None
+
+
+class Request:
+    """Scheduler-side mutable request state."""
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt_token_ids: list[int],
+        sampling_params: SamplingParams,
+        eos_token_id: Optional[int] = None,
+        arrival_time: Optional[float] = None,
+        priority: int = 0,
+        kv_transfer_params: Optional[dict[str, Any]] = None,
+    ) -> None:
+        self.request_id = request_id
+        self.prompt_token_ids = prompt_token_ids
+        # Deep-copy: the engine mutates stop sets / max_tokens below, and
+        # callers routinely share one SamplingParams across a batch.
+        self.sampling_params = copy.deepcopy(sampling_params)
+        sampling_params = self.sampling_params
+        self.eos_token_id = eos_token_id
+        self.arrival_time = arrival_time or time.time()
+        self.priority = priority
+        self.kv_transfer_params = kv_transfer_params
+
+        self.status = RequestStatus.WAITING
+        self.stop_reason: Optional[int | str] = None
+
+        # All token ids: prompt + generated. The scheduler appends sampled
+        # tokens in update_from_output.
+        self._all_token_ids: list[int] = list(prompt_token_ids)
+        self.output_token_ids: list[int] = []
+        self.spec_token_ids: list[int] = []
+
+        # Tokens whose KV is present on device. Grows by num_scheduled
+        # each step (speculative: adjusted down on rejection).
+        self.num_computed_tokens = 0
+        # Prefix-cache hits recorded at first schedule, for stats.
+        self.num_cached_tokens = -1
+        # Number of preemptions experienced (stats).
+        self.num_preemptions = 0
+
+        sampling_params.update_from_tokenizer(eos_token_id)
+
+        if sampling_params.max_tokens is None:
+            sampling_params.max_tokens = 2**31
+
+    @classmethod
+    def from_engine_core_request(cls, req: EngineCoreRequest) -> "Request":
+        return cls(
+            request_id=req.request_id,
+            prompt_token_ids=req.prompt_token_ids,
+            sampling_params=req.sampling_params,
+            eos_token_id=req.eos_token_id,
+            arrival_time=req.arrival_time,
+            priority=req.priority,
+            kv_transfer_params=req.kv_transfer_params,
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.output_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self._all_token_ids)
+
+    @property
+    def num_tokens_with_spec(self) -> int:
+        return len(self._all_token_ids) + len(self.spec_token_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self._all_token_ids
+
+    def append_output_token_ids(self, token_ids: int | list[int]) -> None:
+        if isinstance(token_ids, int):
+            token_ids = [token_ids]
+        self.output_token_ids.extend(token_ids)
+        self._all_token_ids.extend(token_ids)
+
+    @property
+    def is_finished(self) -> bool:
+        return RequestStatus.is_finished(self.status)
+
+    def get_finished_reason(self) -> Optional[str]:
+        return RequestStatus.get_finished_reason(self.status)
+
+    def __repr__(self) -> str:
+        return (f"Request(id={self.request_id}, status={self.status.name}, "
+                f"prompt={self.num_prompt_tokens}t, "
+                f"out={self.num_output_tokens}t, "
+                f"computed={self.num_computed_tokens}t)")

@@ -1,0 +1,97 @@
+"""Small shared utilities (analogue of vllm/utils.py)."""
+
+import socket
+import time
+import uuid
+from collections.abc import Sequence
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(a // -b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return cdiv(x, multiple) * multiple
+
+
+def next_power_of_2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def random_uuid() -> str:
+    return str(uuid.uuid4().hex)
+
+
+def get_open_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_buckets(min_size: int, max_size: int, *,
+                 padding_gap: int = 0) -> list[int]:
+    """Exponential (power-of-2) bucket ladder from min_size up to max_size.
+
+    Used for padding dynamic token/request counts to a small set of
+    precompiled shapes, following the reference TPU runner's bucketing
+    (reference: vllm/v1/worker/tpu_model_runner.py:1248-1443). If
+    ``padding_gap`` is nonzero, buckets grow exponentially until the gap, then
+    linearly by ``padding_gap``.
+    """
+    assert min_size >= 1
+    buckets: list[int] = []
+    size = next_power_of_2(min_size)
+    if padding_gap == 0:
+        while size < max_size:
+            buckets.append(size)
+            size *= 2
+    else:
+        while size < max_size and size < padding_gap:
+            buckets.append(size)
+            size *= 2
+        size = round_up(max(size, padding_gap), padding_gap)
+        while size < max_size:
+            buckets.append(size)
+            size += padding_gap
+    buckets.append(max_size)
+    # Deduplicate while preserving ascending order.
+    out: list[int] = []
+    for b in buckets:
+        if not out or b > out[-1]:
+            out.append(b)
+    return out
+
+
+def pad_to_bucket(x: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= x (buckets must be sorted ascending)."""
+    for b in buckets:
+        if x <= b:
+            return b
+    return buckets[-1]
+
+
+class Counter:
+    """Monotonic counter (request id generation)."""
+
+    def __init__(self, start: int = 0) -> None:
+        self.counter = start
+
+    def __next__(self) -> int:
+        i = self.counter
+        self.counter += 1
+        return i
+
+    def reset(self) -> None:
+        self.counter = 0
+
+
+class StopWatch:
+    def __enter__(self) -> "StopWatch":
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.elapsed = time.perf_counter() - self.start
