@@ -1,0 +1,113 @@
+"""Headline benchmark: decode throughput on one TPU chip.
+
+Mirrors the reference fork's TKNP harness defaults (tknp_inference_
+benchmarks.py:31-58: Llama-3.2-1B architecture, batch 8, 128-token prompt,
+100 decode steps) driven through THIS framework's full engine stack
+(scheduler -> runner -> jitted forward+sample).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against a conservative single-chip reference
+estimate for the same workload (see BASELINE.md: the reference publishes
+no absolute numbers; we anchor to ~8 * 45 tok/s/stream ≈ 360 tok/s
+aggregate for Llama-3.2-1B bs=8 on one accelerator of this class).
+"""
+
+import json
+import os
+import sys
+import time
+
+# Keep the engine quiet so stdout stays a single JSON line.
+os.environ.setdefault("VDT_LOGGING_LEVEL", "WARNING")
+
+import numpy as np  # noqa: E402
+
+TINY = os.environ.get("VDT_BENCH_TINY", "0") == "1"  # CPU smoke mode
+
+BATCH = 8
+PROMPT_LEN = 16 if TINY else 128
+DECODE_STEPS = 8 if TINY else 100
+BASELINE_TOKS_PER_S = 360.0
+
+
+def main() -> None:
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, ModelConfig,
+                                             SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    # Llama-3.2-1B architecture with dummy weights (no checkpoint on the
+    # bench host; compute cost is identical to real weights).
+    config = EngineConfig(
+        model_config=ModelConfig(
+            model="llama-3.2-1b-dummy",
+            dtype="bfloat16",
+            max_model_len=2048,
+            hf_overrides=(dict(
+                vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=2048,
+                architectures=["LlamaForCausalLM"],
+            ) if TINY else dict(
+                vocab_size=128256, hidden_size=2048,
+                intermediate_size=8192, num_hidden_layers=16,
+                num_attention_heads=32, num_key_value_heads=8,
+                head_dim=64, rope_theta=500000.0,
+                max_position_embeddings=2048,
+                architectures=["LlamaForCausalLM"],
+            )),
+        ),
+        cache_config=CacheConfig(block_size=16),
+        scheduler_config=SchedulerConfig(max_num_batched_tokens=2048,
+                                         max_num_seqs=64,
+                                         max_model_len=2048),
+        load_config=LoadConfig(load_format="dummy"),
+    )
+    # Build the HF config locally (no hub access).
+    from transformers import LlamaConfig
+    config.model_config.hf_config = LlamaConfig(
+        **config.model_config.hf_overrides)
+
+    engine = LLMEngine(config, load_tokenizer=False)
+    rng = np.random.default_rng(0)
+    sp = SamplingParams(temperature=0.0, max_tokens=DECODE_STEPS,
+                        ignore_eos=True)
+    prompts = [[int(x) for x in rng.integers(10, 100000, size=PROMPT_LEN)]
+               for _ in range(BATCH)]
+
+    # Warmup: compiles the prefill and decode shapes.
+    engine.add_request("warmup", prompts[0][:PROMPT_LEN],
+                       SamplingParams(temperature=0.0, max_tokens=4,
+                                      ignore_eos=True))
+    while engine.has_unfinished_requests():
+        engine.step()
+
+    for i, p in enumerate(prompts):
+        engine.add_request(f"bench-{i}", p, sp)
+    # Prefill phase (untimed): step until every request emitted its first
+    # token (matches the reference harness separating prefill time from
+    # decode throughput, tknp_inference_benchmarks.py:66-90).
+    produced = {f"bench-{i}": 0 for i in range(BATCH)}
+    while any(v == 0 for v in produced.values()):
+        for o in engine.step():
+            produced[o.request_id] = len(o.outputs[0].token_ids)
+    tokens_at_decode_start = sum(produced.values())
+    t0 = time.perf_counter()
+    while engine.has_unfinished_requests():
+        for o in engine.step():
+            produced[o.request_id] = len(o.outputs[0].token_ids)
+    decode_time = time.perf_counter() - t0
+    decode_tok_s = (sum(produced.values()) -
+                    tokens_at_decode_start) / decode_time
+
+    print(json.dumps({
+        "metric": "decode_throughput_llama1b_bs8",
+        "value": round(decode_tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,13 +9,21 @@ xla_force_host_platform_device_count).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pre-sets a TPU platform: unit tests
+# must run on the 8-device virtual CPU mesh, never the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 # Pallas kernels run in interpret mode on CPU.
 os.environ.setdefault("VDT_PALLAS_INTERPRET", "1")
+
+import jax  # noqa: E402
+
+# The installed TPU plugin ignores JAX_PLATFORMS; the config flag wins.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must not run on the TPU chip"
 
 import pytest  # noqa: E402
 

@@ -266,3 +266,23 @@ def test_priority_preemption_never_evicts_scheduled():
             break
     assert req_low.num_preemptions == 1
     assert req_high.status == RequestStatus.RUNNING
+
+
+def test_spec_tokens_trimmed_to_budget():
+    scheduler = make_scheduler(max_num_batched_tokens=64)
+    req = make_request(num_tokens=8, max_tokens=20)
+    scheduler.add_request(req)
+    step(scheduler)  # prefill + first token
+    # Pretend the worker proposed 4 draft tokens but shrink the budget so
+    # only 2 tokens (1 committed + 1 draft) can run.
+    req.spec_token_ids = [201, 202, 203, 204]
+    scheduler.max_num_batched_tokens = 2
+    out = scheduler.schedule()
+    assert out.num_scheduled_tokens[req.request_id] == 2
+    assert out.scheduled_spec_decode_tokens[req.request_id] == [201]
+    # Worker accepts the draft: returns committed + accepted draft.
+    mro = ModelRunnerOutput(req_ids=[req.request_id],
+                            sampled_token_ids=[[42, 43]])
+    scheduler.update_from_output(out, mro)
+    assert req.num_computed_tokens == 10  # 8 prefill + 2 this step
+    assert req.output_token_ids[-2:] == [42, 43]

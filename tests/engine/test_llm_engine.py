@@ -1,0 +1,142 @@
+"""End-to-end engine tests against a tiny on-disk HF checkpoint (model:
+reference tests/basic_correctness/ comparing VllmRunner vs HfRunner)."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config(),
+                     load_tokenizer=False)
+
+
+@pytest.fixture(scope="module")
+def engine(checkpoint):
+    path, _ = checkpoint
+    return make_engine(path)
+
+
+def hf_greedy(hf, prompt, n):
+    with torch.no_grad():
+        out = hf.generate(torch.tensor([prompt]), max_new_tokens=n,
+                          do_sample=False, eos_token_id=None)
+    return out[0].tolist()[len(prompt):]
+
+
+def run_engine(engine, prompts, sps):
+    for i, (p, sp) in enumerate(zip(prompts, sps)):
+        engine.add_request(f"t{engine.engine_core.scheduler.num_scheduled_steps}-{i}", p, sp)
+    done = {}
+    for _ in range(500):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    return [done[k] for k in sorted(done, key=lambda s: int(s.split("-")[1]))]
+
+
+def test_greedy_matches_hf(engine, checkpoint):
+    _, hf = checkpoint
+    prompt = [3, 17, 92, 45, 8]
+    outs = run_engine(engine, [prompt],
+                      [SamplingParams(temperature=0.0, max_tokens=10,
+                                      ignore_eos=True)])
+    assert outs[0].outputs[0].token_ids == hf_greedy(hf, prompt, 10)
+    assert outs[0].outputs[0].finish_reason == "length"
+
+
+def test_batch_of_ragged_prompts(engine, checkpoint):
+    _, hf = checkpoint
+    prompts = [[5, 9, 101], [7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7],
+               [120, 44], [1, 2, 3, 4, 5, 6]]
+    sps = [SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+           for _ in prompts]
+    outs = run_engine(engine, prompts, sps)
+    for prompt, out in zip(prompts, outs):
+        assert out.outputs[0].token_ids == hf_greedy(hf, prompt, 6), \
+            f"mismatch for prompt {prompt}"
+
+
+def test_chunked_prefill_e2e(checkpoint):
+    path, hf = checkpoint
+    # Budget 16 forces a 40-token prompt through 3 prefill chunks.
+    engine = make_engine(path, max_num_batched_tokens=16)
+    prompt = list(np.random.default_rng(0).integers(2, 127, size=40))
+    prompt = [int(x) for x in prompt]
+    outs = run_engine(engine, [prompt],
+                      [SamplingParams(temperature=0.0, max_tokens=5,
+                                      ignore_eos=True)])
+    assert outs[0].outputs[0].token_ids == hf_greedy(hf, prompt, 5)
+
+
+def test_eos_stop(checkpoint):
+    path, hf = checkpoint
+    engine = make_engine(path)
+    # Find a prompt whose greedy continuation hits token 1 (eos) — craft
+    # via stop_token_ids instead: stop on whatever HF emits 3rd.
+    prompt = [3, 17, 92, 45, 8]
+    hf_tokens = hf_greedy(hf, prompt, 10)
+    stop_tok = hf_tokens[2]
+    outs = run_engine(engine, [prompt],
+                      [SamplingParams(temperature=0.0, max_tokens=10,
+                                      ignore_eos=True,
+                                      stop_token_ids=[stop_tok])])
+    assert outs[0].outputs[0].token_ids == hf_tokens[:3]
+    assert outs[0].outputs[0].finish_reason == "stop"
+    assert outs[0].outputs[0].stop_reason == stop_tok
+
+
+def test_prefix_cache_second_request_consistent(checkpoint):
+    path, hf = checkpoint
+    engine = make_engine(path)
+    base = [9, 8, 7, 6, 5, 4, 3, 2]
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    first = run_engine(engine, [base], [sp])
+    second = run_engine(engine, [base + [60, 61]], [sp])
+    assert first[0].outputs[0].token_ids == hf_greedy(hf, base, 4)
+    assert second[0].outputs[0].token_ids == hf_greedy(hf, base + [60, 61],
+                                                       4)
+    # The second run must actually have hit the cache.
+    stats = engine.get_stats()
+    assert stats["hits"] >= 1
+
+
+def test_seeded_sampling_reproducible(checkpoint):
+    path, _ = checkpoint
+    engine = make_engine(path)
+    prompt = [10, 20, 30]
+    sp = SamplingParams(temperature=1.0, seed=1234, max_tokens=8,
+                        ignore_eos=True)
+    a = run_engine(engine, [prompt], [sp])[0].outputs[0].token_ids
+    b = run_engine(engine, [prompt], [sp])[0].outputs[0].token_ids
+    assert a == b
+    sp2 = SamplingParams(temperature=1.0, seed=99, max_tokens=8,
+                         ignore_eos=True)
+    c = run_engine(engine, [prompt], [sp2])[0].outputs[0].token_ids
+    assert a != c  # overwhelmingly likely

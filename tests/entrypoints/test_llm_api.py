@@ -1,0 +1,51 @@
+"""LLM offline API smoke tests (token-id prompts; no tokenizer on disk)."""
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu import LLM, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_api")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def test_generate_batch(checkpoint):
+    path, hf = checkpoint
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=64, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=4)
+    prompts = [[3, 17, 92], [5, 6, 7, 8, 9]]
+    outs = llm.generate(prompts,
+                        SamplingParams(temperature=0.0, max_tokens=5,
+                                       ignore_eos=True))
+    assert len(outs) == 2
+    for p, o in zip(prompts, outs):
+        with torch.no_grad():
+            hf_out = hf.generate(torch.tensor([p]), max_new_tokens=5,
+                                 do_sample=False, eos_token_id=None)
+        assert o.outputs[0].token_ids == hf_out[0].tolist()[len(p):]
+        assert o.finished
+        assert o.prompt_token_ids == p
+
+
+def test_single_prompt_token_ids(checkpoint):
+    path, _ = checkpoint
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=64, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=4)
+    outs = llm.generate([1, 2, 3],
+                        SamplingParams(temperature=0.0, max_tokens=3,
+                                       ignore_eos=True))
+    assert len(outs) == 1
+    assert len(outs[0].outputs[0].token_ids) == 3

@@ -1,0 +1,171 @@
+"""Llama parity vs HuggingFace transformers (model: reference
+tests/conftest.py HfRunner ground-truth comparison, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.models.common import AttentionBatch
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+
+PAGE_SIZE = 4
+NUM_PAGES = 32
+
+
+def tiny_hf_config(**overrides):
+    cfg = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+               num_hidden_layers=3, num_attention_heads=4,
+               num_key_value_heads=2, max_position_embeddings=64,
+               rope_theta=10000.0, tie_word_embeddings=False)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch.manual_seed(0)
+    return HFLlama(tiny_hf_config()).eval()
+
+
+@pytest.fixture(scope="module")
+def jax_model_and_params(hf_model):
+    arch = LlamaArchConfig.from_hf_config(hf_model.config,
+                                          dtype=jnp.float32)
+    model = LlamaForCausalLM(arch)
+    tensors = {k: v.detach().numpy() for k, v in
+               hf_model.state_dict().items()}
+    params = model.params_from_hf_state_dict(tensors)
+    return model, params
+
+
+def run_ours(model, params, token_ids, *, positions=None, kv_caches=None,
+             block_table=(1, 2, 3, 4)):
+    """Single-request helper: prefill/decode token_ids at positions."""
+    T = len(token_ids)
+    if positions is None:
+        positions = list(range(T))
+    if kv_caches is None:
+        kv_caches = model.make_kv_caches(NUM_PAGES, PAGE_SIZE)
+    bt = np.zeros((1, max(8, len(block_table))), np.int32)
+    bt[0, :len(block_table)] = block_table
+    slot = [bt[0, p // PAGE_SIZE] * PAGE_SIZE + p % PAGE_SIZE
+            for p in positions]
+    batch = AttentionBatch(
+        req_idx=jnp.zeros((T, ), jnp.int32),
+        positions=jnp.asarray(positions, jnp.int32),
+        slot_mapping=jnp.asarray(slot, jnp.int32),
+        block_tables=jnp.asarray(bt),
+        seq_lens=jnp.asarray([positions[-1] + 1], jnp.int32),
+    )
+    hidden, kv_caches = model.forward(params, kv_caches,
+                                      jnp.asarray(token_ids, jnp.int32),
+                                      batch)
+    logits = model.compute_logits(params, hidden)
+    return np.asarray(logits), kv_caches
+
+
+def test_prefill_logits_match_hf(hf_model, jax_model_and_params):
+    model, params = jax_model_and_params
+    prompt = [3, 17, 92, 45, 8, 77, 23, 55, 10]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    ours, _ = run_ours(model, params, prompt)
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_prefill_plus_decode_matches_full(hf_model,
+                                                  jax_model_and_params):
+    """Prefill in two chunks then decode one token; logits must match a
+    single-shot HF forward over the whole sequence."""
+    model, params = jax_model_and_params
+    seq = [5, 9, 101, 33, 2, 64, 18, 120, 7, 81, 44]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([seq])).logits[0].numpy()
+
+    kv = model.make_kv_caches(NUM_PAGES, PAGE_SIZE)
+    out1, kv = run_ours(model, params, seq[:6], positions=list(range(6)),
+                        kv_caches=kv)
+    out2, kv = run_ours(model, params, seq[6:10],
+                        positions=list(range(6, 10)), kv_caches=kv)
+    out3, kv = run_ours(model, params, seq[10:],
+                        positions=[10], kv_caches=kv)
+    np.testing.assert_allclose(out1[-1], hf_logits[5], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out2[-1], hf_logits[9], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out3[-1], hf_logits[10], rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_greedy_generation_matches_hf(hf_model, jax_model_and_params):
+    model, params = jax_model_and_params
+    prompt = [11, 29, 3, 47]
+    steps = 8
+    with torch.no_grad():
+        hf_out = hf_model.generate(torch.tensor([prompt]),
+                                   max_new_tokens=steps, do_sample=False)
+    hf_tokens = hf_out[0].tolist()[len(prompt):]
+
+    kv = model.make_kv_caches(NUM_PAGES, PAGE_SIZE)
+    logits, kv = run_ours(model, params, prompt, kv_caches=kv)
+    ours = []
+    tok = int(logits[-1].argmax())
+    ours.append(tok)
+    pos = len(prompt)
+    for _ in range(steps - 1):
+        logits, kv = run_ours(model, params, [tok], positions=[pos],
+                              kv_caches=kv)
+        tok = int(logits[-1].argmax())
+        ours.append(tok)
+        pos += 1
+    assert ours == hf_tokens
+
+
+def test_qwen2_style_attention_bias():
+    torch.manual_seed(1)
+    hf = HFLlama(tiny_hf_config(attention_bias=True)).eval()
+    arch = LlamaArchConfig.from_hf_config(hf.config, dtype=jnp.float32)
+    assert arch.attention_bias
+    model = LlamaForCausalLM(arch)
+    tensors = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = model.params_from_hf_state_dict(tensors)
+    prompt = [4, 9, 2, 61, 33]
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([prompt])).logits[0].numpy()
+    ours, _ = run_ours(model, params, prompt)
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_tied_embeddings():
+    torch.manual_seed(2)
+    hf = HFLlama(tiny_hf_config(tie_word_embeddings=True)).eval()
+    arch = LlamaArchConfig.from_hf_config(hf.config, dtype=jnp.float32)
+    model = LlamaForCausalLM(arch)
+    tensors = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = model.params_from_hf_state_dict(tensors)
+    prompt = [1, 2, 3, 4, 5]
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([prompt])).logits[0].numpy()
+    ours, _ = run_ours(model, params, prompt)
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_llama31_rope_scaling():
+    torch.manual_seed(3)
+    scaling = {"rope_type": "llama3", "factor": 8.0,
+               "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+               "original_max_position_embeddings": 32}
+    hf = HFLlama(tiny_hf_config(rope_scaling=scaling,
+                                max_position_embeddings=256)).eval()
+    arch = LlamaArchConfig.from_hf_config(hf.config, dtype=jnp.float32)
+    model = LlamaForCausalLM(arch)
+    tensors = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = model.params_from_hf_state_dict(tensors)
+    prompt = list(range(40, 80))  # long enough to engage scaled freqs
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([prompt])).logits[0].numpy()
+    ours, _ = run_ours(model, params, prompt,
+                       block_table=tuple(range(1, 11)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)

@@ -1,0 +1,115 @@
+"""Sampler unit tests (model: reference tests/v1/sample/)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.sample.metadata import SamplingMetadata
+from vllm_distributed_tpu.sample.sampler import (compute_topk_logprobs,
+                                                 sample_tokens)
+
+
+def md(R, temperature=1.0, top_k=0, top_p=1.0, min_p=0.0, seeds=None):
+    return SamplingMetadata(
+        temperature=jnp.full((R, ), temperature, jnp.float32),
+        top_k=jnp.full((R, ), top_k, jnp.int32),
+        top_p=jnp.full((R, ), top_p, jnp.float32),
+        min_p=jnp.full((R, ), min_p, jnp.float32),
+        seeds=jnp.asarray(seeds if seeds is not None else range(R),
+                          jnp.int64),
+    )
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 3.0, -1.0, 2.0], [5.0, 0.0, 0.0, 6.0]])
+    ids, lps = sample_tokens(logits, md(2, temperature=0.0))
+    assert ids.tolist() == [1, 3]
+    # Reported logprob is log_softmax at the chosen token.
+    expect = np.log(np.exp(3.0) / np.exp(
+        np.asarray([0.1, 3.0, -1.0, 2.0])).sum())
+    np.testing.assert_allclose(float(lps[0]), expect, rtol=1e-5)
+
+
+def test_top_k_one_equals_greedy():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 16),
+                                                                  ),
+                         jnp.float32)
+    ids_g, _ = sample_tokens(logits, md(4, temperature=0.0))
+    ids_k, _ = sample_tokens(logits, md(4, temperature=1.0, top_k=1))
+    assert ids_g.tolist() == ids_k.tolist()
+
+
+def test_top_k_restricts_support():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.standard_normal((1, 32)), jnp.float32)
+    top5 = set(np.asarray(logits)[0].argsort()[-5:].tolist())
+    seen = set()
+    for seed in range(200):
+        ids, _ = sample_tokens(logits, md(1, temperature=2.0, top_k=5,
+                                          seeds=[seed]))
+        seen.add(int(ids[0]))
+    assert seen <= top5
+    assert len(seen) >= 3  # actually explores the allowed set
+
+
+def test_top_p_restricts_support():
+    # 90% mass on token 0, ~10% on token 1, rest tiny.
+    logits = jnp.log(jnp.asarray([[0.9, 0.0999, 1e-4, 1e-6]]))
+    seen = set()
+    for seed in range(100):
+        ids, _ = sample_tokens(logits, md(1, temperature=1.0, top_p=0.95,
+                                          seeds=[seed]))
+        seen.add(int(ids[0]))
+    assert seen <= {0, 1}
+
+
+def test_min_p_restricts_support():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.1]]))
+    seen = set()
+    for seed in range(100):
+        ids, _ = sample_tokens(logits, md(1, temperature=1.0, min_p=0.5,
+                                          seeds=[seed]))
+        seen.add(int(ids[0]))
+    # min_p=0.5 keeps tokens with p >= 0.5 * 0.5 = 0.25.
+    assert seen <= {0, 1}
+
+
+def test_seeded_determinism():
+    logits = jnp.asarray(np.random.default_rng(2).standard_normal((3, 64)),
+                         jnp.float32)
+    a, _ = sample_tokens(logits, md(3, temperature=1.5, seeds=[7, 8, 9]))
+    b, _ = sample_tokens(logits, md(3, temperature=1.5, seeds=[7, 8, 9]))
+    c, _ = sample_tokens(logits, md(3, temperature=1.5, seeds=[10, 11, 12]))
+    assert a.tolist() == b.tolist()
+    assert a.tolist() != c.tolist()  # overwhelmingly likely
+
+
+def test_sampling_roughly_matches_distribution():
+    # Two tokens with 80/20 split; frequencies should track.
+    logits = jnp.log(jnp.asarray([[0.8, 0.2]]))
+    counts = [0, 0]
+    for seed in range(400):
+        ids, _ = sample_tokens(logits, md(1, temperature=1.0,
+                                          seeds=[seed]))
+        counts[int(ids[0])] += 1
+    assert 240 <= counts[0] <= 380  # ~320 expected
+
+
+def test_mixed_batch_greedy_and_random():
+    logits = jnp.asarray([[10.0, 0.0, 0.0], [0.0, 0.0, 10.0]])
+    m = SamplingMetadata(
+        temperature=jnp.asarray([0.0, 1.0], jnp.float32),
+        top_k=jnp.asarray([0, 1], jnp.int32),
+        top_p=jnp.ones((2, ), jnp.float32),
+        min_p=jnp.zeros((2, ), jnp.float32),
+        seeds=jnp.asarray([0, 1], jnp.int64),
+    )
+    ids, _ = sample_tokens(logits, m)
+    assert ids.tolist() == [0, 2]
+
+
+def test_topk_logprobs():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+    vals, ids = compute_topk_logprobs(logits, 2)
+    assert ids[0].tolist() == [1, 2]
+    total = np.exp(np.asarray(vals[0])).sum()
+    assert total < 1.0

@@ -289,10 +289,16 @@ class EngineConfig:
         default_factory=ObservabilityConfig)
 
     def __post_init__(self) -> None:
-        # Clamp scheduler limits to the model context window once known.
+        # Clamp scheduler limits to the model context window once known,
+        # re-applying the non-chunked-prefill invariant (a whole prompt
+        # must fit in one step's budget) on the updated value.
         if self.model_config.max_model_len is not None:
             self.scheduler_config.max_model_len = \
                 self.model_config.max_model_len
+            if not self.scheduler_config.enable_chunked_prefill:
+                self.scheduler_config.max_num_batched_tokens = max(
+                    self.scheduler_config.max_num_batched_tokens,
+                    self.scheduler_config.max_model_len)
 
     def compute_hash(self) -> str:
         """Stable hash of the config for compilation-cache keys."""

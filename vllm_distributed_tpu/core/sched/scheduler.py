@@ -207,8 +207,15 @@ class Scheduler:
             num_scheduled_tokens[request.request_id] = num_new_tokens
             token_budget -= num_new_tokens
             if request.spec_token_ids:
-                scheduled_spec_tokens[request.request_id] = \
-                    list(request.spec_token_ids)
+                # Trim drafts to the granted token count (1 committed token
+                # + at most num_new_tokens-1 drafts); publishing untrimmed
+                # drafts would desync num_computed_tokens accounting when
+                # the budget caps num_new_tokens.
+                num_drafts = max(num_new_tokens - 1, 0)
+                request.spec_token_ids = request.spec_token_ids[:num_drafts]
+                if request.spec_token_ids:
+                    scheduled_spec_tokens[request.request_id] = \
+                        list(request.spec_token_ids)
             cached_reqs.req_ids.append(request.request_id)
             cached_reqs.resumed_from_preemption.append(False)
             cached_reqs.new_token_ids.append(

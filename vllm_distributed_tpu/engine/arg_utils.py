@@ -1,0 +1,126 @@
+"""CLI/programmatic engine arguments -> EngineConfig.
+
+Reference: vllm/engine/arg_utils.py (``EngineArgs`` mirrors every config
+field as a --kebab-case flag; the fork's TKNP flags at arg_utils.py:339).
+"""
+
+import argparse
+from dataclasses import dataclass, fields
+from typing import Optional
+
+from vllm_distributed_tpu.config import (CacheConfig, DeviceConfig,
+                                         EngineConfig, KVTransferConfig,
+                                         LoadConfig, ModelConfig,
+                                         ObservabilityConfig,
+                                         ParallelConfig, SchedulerConfig,
+                                         SpeculativeConfig)
+
+
+@dataclass
+class EngineArgs:
+    model: str = "meta-llama/Meta-Llama-3-8B"
+    tokenizer: Optional[str] = None
+    trust_remote_code: bool = False
+    dtype: str = "bfloat16"
+    seed: int = 0
+    max_model_len: Optional[int] = None
+
+    block_size: int = 16
+    gpu_memory_utilization: float = 0.90
+    num_gpu_blocks_override: Optional[int] = None
+    enable_prefix_caching: bool = True
+    swap_space: int = 0  # accepted for CLI parity; unused on TPU
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    token_parallel_size: int = 1
+    enable_expert_parallel: bool = False
+
+    max_num_batched_tokens: int = 8192
+    max_num_seqs: int = 256
+    enable_chunked_prefill: bool = True
+    long_prefill_token_threshold: int = 0
+    scheduling_policy: str = "fcfs"
+
+    device: str = "auto"
+    load_format: str = "auto"
+
+    speculative_method: Optional[str] = None
+    num_speculative_tokens: int = 0
+
+    kv_connector: Optional[str] = None
+    kv_role: Optional[str] = None
+
+    otlp_traces_endpoint: Optional[str] = None
+
+    def create_engine_config(self) -> EngineConfig:
+        model_config = ModelConfig(
+            model=self.model,
+            tokenizer=self.tokenizer,
+            trust_remote_code=self.trust_remote_code,
+            dtype=self.dtype,
+            seed=self.seed,
+            max_model_len=self.max_model_len,
+        )
+        model_config.maybe_load_hf_config()
+        return EngineConfig(
+            model_config=model_config,
+            cache_config=CacheConfig(
+                block_size=self.block_size,
+                gpu_memory_utilization=self.gpu_memory_utilization,
+                num_gpu_blocks_override=self.num_gpu_blocks_override,
+                enable_prefix_caching=self.enable_prefix_caching,
+            ),
+            parallel_config=ParallelConfig(
+                tensor_parallel_size=self.tensor_parallel_size,
+                pipeline_parallel_size=self.pipeline_parallel_size,
+                data_parallel_size=self.data_parallel_size,
+                token_parallel_size=self.token_parallel_size,
+                enable_expert_parallel=self.enable_expert_parallel,
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=self.max_num_batched_tokens,
+                max_num_seqs=self.max_num_seqs,
+                max_model_len=model_config.max_model_len or 8192,
+                enable_chunked_prefill=self.enable_chunked_prefill,
+                long_prefill_token_threshold=self.
+                long_prefill_token_threshold,
+                policy=self.scheduling_policy,
+            ),
+            device_config=DeviceConfig(device=self.device),
+            load_config=LoadConfig(load_format=self.load_format),
+            speculative_config=SpeculativeConfig(
+                method=self.speculative_method,
+                num_speculative_tokens=self.num_speculative_tokens,
+            ),
+            kv_transfer_config=KVTransferConfig(
+                kv_connector=self.kv_connector,
+                kv_role=self.kv_role,
+            ),
+            observability_config=ObservabilityConfig(
+                otlp_traces_endpoint=self.otlp_traces_endpoint),
+        )
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        for f in fields(EngineArgs):
+            name = "--" + f.name.replace("_", "-")
+            if f.type in ("bool", bool):
+                parser.add_argument(name,
+                                    action=argparse.BooleanOptionalAction,
+                                    default=f.default)
+            else:
+                typ = str
+                if f.type in ("int", int, "Optional[int]"):
+                    typ = int
+                elif f.type in ("float", float):
+                    typ = float
+                parser.add_argument(name, type=typ, default=f.default)
+        return parser
+
+    @classmethod
+    def from_cli_args(cls, args: argparse.Namespace) -> "EngineArgs":
+        attrs = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in vars(args).items() if k in attrs})

@@ -1,0 +1,65 @@
+"""Engine core: the scheduler+executor busy loop.
+
+Reference: vllm/v1/engine/core.py:55 (``EngineCore``: step:223,
+_initialize_kv_caches:133; the multiprocess EngineCoreProc/DPEngineCoreProc
+variants layer transport on top — here the in-process core comes first and
+the ZMQ front-ends reuse it unchanged, mirroring InprocClient).
+"""
+
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.scheduler import (EngineCoreOutput,
+                                                       Scheduler)
+from vllm_distributed_tpu.executor import Executor
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import (EngineCoreRequest, Request,
+                                          RequestStatus)
+
+logger = init_logger(__name__)
+
+
+class EngineCore:
+
+    def __init__(self, config: EngineConfig,
+                 executor_class: Optional[type] = None) -> None:
+        self.config = config
+        executor_class = executor_class or Executor.get_class(config)
+        self.executor = executor_class(config)
+
+        num_pages = self._initialize_kv_caches()
+        config.cache_config.num_gpu_blocks = num_pages
+        self.scheduler = Scheduler(config, num_blocks=num_pages)
+
+    def _initialize_kv_caches(self) -> int:
+        num_pages = self.executor.determine_num_available_blocks()
+        logger.info("allocating %d KV pages (%d tokens)", num_pages,
+                    num_pages * self.config.cache_config.block_size)
+        self.executor.initialize_kv_cache(num_pages)
+        return num_pages
+
+    # ------------------------------------------------------------------
+    def add_request(self, request: EngineCoreRequest) -> None:
+        self.scheduler.add_request(Request.from_engine_core_request(request))
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        self.scheduler.finish_requests(request_ids,
+                                       RequestStatus.FINISHED_ABORTED)
+
+    def step(self) -> list[EngineCoreOutput]:
+        """One scheduling iteration (reference: core.py:223)."""
+        if not self.scheduler.has_requests():
+            return []
+        scheduler_output = self.scheduler.schedule()
+        runner_output = self.executor.execute_model(scheduler_output)
+        return self.scheduler.update_from_output(scheduler_output,
+                                                 runner_output)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_unfinished_requests()
+
+    def get_stats(self) -> dict:
+        return self.scheduler.get_stats()
+
+    def shutdown(self) -> None:
+        self.executor.shutdown()

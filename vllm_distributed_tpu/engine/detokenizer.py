@@ -1,0 +1,70 @@
+"""Incremental detokenization.
+
+Reference: vllm/v1/engine/detokenizer.py (per-request incremental decode
+with stable-prefix emission and stop-string scanning back-off).
+
+The incremental algorithm keeps a small suffix window of token ids: a
+token's text is only emitted once decoding a longer suffix no longer
+changes it (byte-level BPE can merge with following tokens; multi-byte
+unicode may be split across tokens).
+"""
+
+from typing import Optional
+
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class IncrementalDetokenizer:
+    """Per-request detokenizer state."""
+
+    def __init__(self, tokenizer, params: SamplingParams,
+                 prompt_token_ids: list[int]) -> None:
+        self.tokenizer = tokenizer
+        self.skip_special_tokens = params.skip_special_tokens
+        self.stop_strings = params.stop or []
+        # Longest stop string bounds how much emitted text we must retain
+        # to detect a stop spanning an emission boundary.
+        self._max_stop_len = max((len(s) for s in self.stop_strings),
+                                 default=0)
+        self.token_ids: list[int] = []
+        self.output_text = ""
+        # Decoded-but-unstable tail start index into token_ids.
+        self._stable_len = 0
+        self._stable_text = ""
+
+    def update(self, new_token_ids: list[int]) -> Optional[str]:
+        """Append tokens; returns the stop string hit, if any."""
+        if self.tokenizer is None:
+            return None
+        self.token_ids.extend(new_token_ids)
+        # Decode the unstable tail plus one extra token of context.
+        tail = self.token_ids[self._stable_len:]
+        text_tail = self.tokenizer.decode(
+            tail, skip_special_tokens=self.skip_special_tokens)
+        # A tail ending in the unicode replacement char may be a split
+        # multi-byte sequence: hold it back until completed.
+        if text_tail.endswith("�"):
+            self.output_text = self._stable_text + text_tail
+        else:
+            self._stable_text = self._stable_text + text_tail
+            self._stable_len = len(self.token_ids)
+            self.output_text = self._stable_text
+
+        if self.stop_strings:
+            # Scan only the recently-produced region.
+            window_start = max(
+                0,
+                len(self.output_text) - len(text_tail) - self._max_stop_len)
+            window = self.output_text[window_start:]
+            for stop in self.stop_strings:
+                idx = window.find(stop)
+                if idx != -1:
+                    # Truncate at the stop string (excluded from output).
+                    self.output_text = \
+                        self.output_text[:window_start + idx]
+                    return stop
+        return None
+
+    def get_next_output_text(self, prev_len: int) -> str:
+        """Delta since the caller's last read."""
+        return self.output_text[prev_len:]

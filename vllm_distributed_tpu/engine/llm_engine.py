@@ -1,0 +1,81 @@
+"""Synchronous engine facade (reference: vllm/v1/engine/llm_engine.py:41 —
+add_request -> step -> RequestOutput)."""
+
+from typing import Optional, Union
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.core import EngineCore
+from vllm_distributed_tpu.engine.output_processor import OutputProcessor
+from vllm_distributed_tpu.engine.processor import Processor
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+def _load_tokenizer(config: EngineConfig):
+    from transformers import AutoTokenizer
+    try:
+        return AutoTokenizer.from_pretrained(
+            config.model_config.tokenizer,
+            trust_remote_code=config.model_config.trust_remote_code)
+    except Exception as e:
+        logger.warning("could not load tokenizer %s (%s); token-id I/O only",
+                       config.model_config.tokenizer, e)
+        return None
+
+
+class LLMEngine:
+
+    def __init__(self, config: EngineConfig,
+                 tokenizer=None, *, load_tokenizer: bool = True) -> None:
+        self.config = config
+        config.model_config.maybe_load_hf_config()
+        if tokenizer is None and load_tokenizer:
+            tokenizer = _load_tokenizer(config)
+        self.tokenizer = tokenizer
+        self.processor = Processor(config, tokenizer)
+        self.output_processor = OutputProcessor(config, tokenizer)
+        self.engine_core = EngineCore(config)
+
+    @classmethod
+    def from_engine_args(cls, engine_args) -> "LLMEngine":
+        return cls(engine_args.create_engine_config())
+
+    # ------------------------------------------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Union[str, list[int]],
+        sampling_params: Optional[SamplingParams] = None,
+        priority: int = 0,
+    ) -> None:
+        sampling_params = sampling_params or SamplingParams()
+        core_req = self.processor.process_inputs(request_id, prompt,
+                                                 sampling_params,
+                                                 priority=priority)
+        self.output_processor.add_request(
+            core_req, prompt=prompt if isinstance(prompt, str) else None)
+        self.engine_core.add_request(core_req)
+
+    def abort_request(self, request_ids: list[str]) -> None:
+        self.output_processor.abort_requests(request_ids)
+        self.engine_core.abort_requests(request_ids)
+
+    def step(self) -> list[RequestOutput]:
+        core_outputs = self.engine_core.step()
+        processed = self.output_processor.process_outputs(core_outputs)
+        if processed.reqs_to_abort:
+            self.engine_core.abort_requests(processed.reqs_to_abort)
+        return processed.request_outputs
+
+    def has_unfinished_requests(self) -> bool:
+        return (self.engine_core.has_unfinished_requests()
+                or self.output_processor.has_unfinished_requests())
+
+    def get_stats(self) -> dict:
+        return self.engine_core.get_stats()
+
+    def shutdown(self) -> None:
+        self.engine_core.shutdown()

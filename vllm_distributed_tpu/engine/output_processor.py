@@ -1,0 +1,131 @@
+"""Engine-core outputs -> user-facing RequestOutputs.
+
+Reference: vllm/v1/engine/output_processor.py (per-request state in the
+client process: detokenize, stop-string detection -> abort signal back to
+the core, RequestOutput assembly).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.engine.detokenizer import IncrementalDetokenizer
+from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@dataclass
+class RequestState:
+    request_id: str
+    prompt: Optional[str]
+    prompt_token_ids: list[int]
+    params: SamplingParams
+    detokenizer: Optional[IncrementalDetokenizer]
+    output_token_ids: list[int] = field(default_factory=list)
+    logprobs: list[dict[int, float]] = field(default_factory=list)
+    num_cached_tokens: int = 0
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    stop_reason: Optional[int | str] = None
+
+
+@dataclass
+class ProcessedOutputs:
+    request_outputs: list[RequestOutput]
+    # Requests the front-end decided to finish (stop string hit): the
+    # caller must abort them in the scheduler.
+    reqs_to_abort: list[str]
+
+
+class OutputProcessor:
+
+    def __init__(self, config: EngineConfig, tokenizer) -> None:
+        self.config = config
+        self.tokenizer = tokenizer
+        self.request_states: dict[str, RequestState] = {}
+
+    def add_request(self, request: EngineCoreRequest,
+                    prompt: Optional[str] = None) -> None:
+        params = request.sampling_params
+        detok = None
+        if self.tokenizer is not None and params.detokenize:
+            detok = IncrementalDetokenizer(self.tokenizer, params,
+                                           request.prompt_token_ids)
+        self.request_states[request.request_id] = RequestState(
+            request_id=request.request_id,
+            prompt=prompt,
+            prompt_token_ids=request.prompt_token_ids,
+            params=params,
+            detokenizer=detok,
+        )
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        for req_id in request_ids:
+            self.request_states.pop(req_id, None)
+
+    def get_num_unfinished_requests(self) -> int:
+        return len(self.request_states)
+
+    def has_unfinished_requests(self) -> bool:
+        return bool(self.request_states)
+
+    # ------------------------------------------------------------------
+    def process_outputs(
+            self, core_outputs: list[EngineCoreOutput]) -> ProcessedOutputs:
+        request_outputs: list[RequestOutput] = []
+        reqs_to_abort: list[str] = []
+        for out in core_outputs:
+            state = self.request_states.get(out.req_id)
+            if state is None:
+                continue  # aborted while output was in flight
+            state.output_token_ids.extend(out.new_token_ids)
+            if out.logprobs:
+                state.logprobs.extend(out.logprobs)
+            state.num_cached_tokens = out.num_cached_tokens
+
+            stop_str = None
+            if state.detokenizer is not None:
+                stop_str = state.detokenizer.update(out.new_token_ids)
+
+            finish_reason = out.finish_reason
+            stop_reason = out.stop_reason
+            if stop_str is not None and finish_reason is None:
+                # Front-end stop: tell the core to abort the request.
+                finish_reason = "stop"
+                stop_reason = stop_str
+                reqs_to_abort.append(out.req_id)
+
+            finished = finish_reason is not None
+            state.finished = finished
+            state.finish_reason = finish_reason
+            state.stop_reason = stop_reason
+
+            request_outputs.append(self._make_request_output(state))
+            if finished:
+                del self.request_states[out.req_id]
+        return ProcessedOutputs(request_outputs, reqs_to_abort)
+
+    def _make_request_output(self, state: RequestState) -> RequestOutput:
+        text = (state.detokenizer.output_text
+                if state.detokenizer is not None else "")
+        completion = CompletionOutput(
+            index=0,
+            text=text,
+            token_ids=list(state.output_token_ids),
+            logprobs=list(state.logprobs) if state.logprobs else None,
+            cumulative_logprob=(sum(
+                next(iter(lp.values())) for lp in state.logprobs)
+                                if state.logprobs else None),
+            finish_reason=state.finish_reason,
+            stop_reason=state.stop_reason,
+        )
+        return RequestOutput(
+            request_id=state.request_id,
+            prompt=state.prompt,
+            prompt_token_ids=state.prompt_token_ids,
+            outputs=[completion],
+            finished=state.finished,
+            num_cached_tokens=state.num_cached_tokens,
+        )

@@ -1,0 +1,54 @@
+"""Request pre-processing: tokenize + validate -> EngineCoreRequest.
+
+Reference: vllm/v1/engine/processor.py (tokenization, validation; runs in
+the client process, never on the device path).
+"""
+
+import time
+from typing import Optional, Union
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class Processor:
+
+    def __init__(self, config: EngineConfig, tokenizer) -> None:
+        self.config = config
+        self.tokenizer = tokenizer
+        self.eos_token_id: Optional[int] = None
+        if tokenizer is not None:
+            self.eos_token_id = tokenizer.eos_token_id
+
+    def process_inputs(
+        self,
+        request_id: str,
+        prompt: Union[str, list[int]],
+        sampling_params: SamplingParams,
+        arrival_time: Optional[float] = None,
+        priority: int = 0,
+        kv_transfer_params: Optional[dict] = None,
+    ) -> EngineCoreRequest:
+        if isinstance(prompt, str):
+            assert self.tokenizer is not None, \
+                "string prompts require a tokenizer"
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        else:
+            prompt_token_ids = list(prompt)
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        max_len = self.config.scheduler_config.max_model_len
+        if len(prompt_token_ids) >= max_len:
+            raise ValueError(
+                f"prompt ({len(prompt_token_ids)} tokens) is longer than "
+                f"the maximum model length of {max_len}")
+        return EngineCoreRequest(
+            request_id=request_id,
+            prompt_token_ids=prompt_token_ids,
+            sampling_params=sampling_params,
+            eos_token_id=self.eos_token_id,
+            arrival_time=arrival_time or time.time(),
+            priority=priority,
+            kv_transfer_params=kv_transfer_params,
+        )

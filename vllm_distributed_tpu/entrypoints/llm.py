@@ -1,0 +1,75 @@
+"""Offline inference API (reference: vllm/entrypoints/llm.py:64 ``LLM`` —
+generate/chat with an internal _run_engine loop at :1694)."""
+
+from typing import Optional, Union
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import Counter
+
+logger = init_logger(__name__)
+
+PromptType = Union[str, list[int]]
+
+
+class LLM:
+
+    def __init__(self, model: str, **kwargs) -> None:
+        engine_args = EngineArgs(model=model, **kwargs)
+        self.llm_engine = LLMEngine.from_engine_args(engine_args)
+        self.request_counter = Counter()
+
+    def get_tokenizer(self):
+        return self.llm_engine.tokenizer
+
+    def generate(
+        self,
+        prompts: Union[PromptType, list[PromptType]],
+        sampling_params: Optional[Union[SamplingParams,
+                                        list[SamplingParams]]] = None,
+    ) -> list[RequestOutput]:
+        if isinstance(prompts, (str, )) or (isinstance(prompts, list)
+                                            and prompts
+                                            and isinstance(prompts[0], int)):
+            prompts = [prompts]  # single prompt (str or token ids)
+        if sampling_params is None:
+            sampling_params = SamplingParams()
+        if isinstance(sampling_params, SamplingParams):
+            sampling_params = [sampling_params] * len(prompts)
+        assert len(sampling_params) == len(prompts)
+
+        request_ids = []
+        for prompt, sp in zip(prompts, sampling_params):
+            request_id = str(next(self.request_counter))
+            self.llm_engine.add_request(request_id, prompt, sp)
+            request_ids.append(request_id)
+        outputs = self._run_engine()
+        # Return in submission order.
+        by_id = {out.request_id: out for out in outputs}
+        return [by_id[rid] for rid in request_ids]
+
+    def chat(self, messages, sampling_params=None) -> list[RequestOutput]:
+        tokenizer = self.get_tokenizer()
+        assert tokenizer is not None, "chat requires a tokenizer"
+        if messages and isinstance(messages[0], dict):
+            messages = [messages]
+        prompts = [
+            tokenizer.apply_chat_template(conv, tokenize=False,
+                                          add_generation_prompt=True)
+            for conv in messages
+        ]
+        return self.generate(prompts, sampling_params)
+
+    def _run_engine(self) -> list[RequestOutput]:
+        finished: list[RequestOutput] = []
+        while self.llm_engine.has_unfinished_requests():
+            for out in self.llm_engine.step():
+                if out.finished:
+                    finished.append(out)
+        return finished
+
+    def shutdown(self) -> None:
+        self.llm_engine.shutdown()

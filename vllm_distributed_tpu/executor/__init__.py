@@ -1,0 +1,58 @@
+"""Executor layer (reference: vllm/v1/executor/abstract.py:30 Executor with
+UniProc/Multiproc/Ray variants).
+
+On TPU, SPMD over a mesh removes the per-GPU process fan-out inside one
+host: ``UniProcExecutor`` drives the whole local mesh. Multi-host executors
+(one process per pod host via jax.distributed) layer on later without
+changing this interface.
+"""
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
+                                                    SchedulerOutput)
+from vllm_distributed_tpu.worker.worker import TPUWorker
+
+
+class Executor:
+    """Interface the engine core drives."""
+
+    @staticmethod
+    def get_class(config: EngineConfig) -> type["Executor"]:
+        return UniProcExecutor
+
+    def __init__(self, config: EngineConfig) -> None:
+        self.config = config
+
+    def determine_num_available_blocks(self) -> int:
+        raise NotImplementedError
+
+    def initialize_kv_cache(self, num_pages: int) -> None:
+        raise NotImplementedError
+
+    def execute_model(self,
+                      scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class UniProcExecutor(Executor):
+    """Single-process executor over the local device mesh."""
+
+    def __init__(self, config: EngineConfig) -> None:
+        super().__init__(config)
+        self.worker = TPUWorker(config)
+        self.worker.init_device()
+        self.worker.load_model()
+
+    def determine_num_available_blocks(self) -> int:
+        return self.worker.determine_num_available_blocks()
+
+    def initialize_kv_cache(self, num_pages: int) -> None:
+        self.worker.initialize_kv_cache(num_pages)
+        self.worker.compile_or_warm_up_model()
+
+    def execute_model(self,
+                      scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        return self.worker.execute_model(scheduler_output)

@@ -1,0 +1,108 @@
+"""Shared model-layer math: RMSNorm, rotary embeddings, attention batch
+descriptor.
+
+Equivalents of the reference's vllm/model_executor/layers/{layernorm.py,
+rotary_embedding.py}; on TPU these are plain jnp expressions XLA fuses into
+the surrounding matmuls (SURVEY.md §2.7: "XLA fuses this natively").
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class AttentionBatch:
+    """Flat ragged batch descriptor consumed by every attention layer.
+
+    Built once per step by the model runner (equivalent of the reference's
+    per-backend AttentionMetadata, v1/attention/backends/pallas.py
+    PallasMetadata).
+    """
+
+    # [T] int32: owning request row for each token.
+    req_idx: jax.Array
+    # [T] int32: absolute sequence position of each token.
+    positions: jax.Array
+    # [T] int32: flat KV slot (page * page_size + offset), -1 for padding.
+    slot_mapping: jax.Array
+    # [max_reqs, pages_per_req] int32 page table.
+    block_tables: jax.Array
+    # [max_reqs] int32 total context length per request (0 = inactive).
+    seq_lens: jax.Array
+
+
+def rms_norm(x: jax.Array, weight: jax.Array,
+             eps: float = 1e-6) -> jax.Array:
+    """Llama RMSNorm; accumulate in fp32 regardless of activation dtype."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def make_inv_freq(head_dim: int, rope_theta: float,
+                  rope_scaling: dict | None = None) -> jax.Array:
+    """Rotary inverse frequencies, with Llama-3.1-style piecewise NTK
+    scaling when ``rope_scaling["rope_type"] == "llama3"`` (reference:
+    vllm/model_executor/layers/rotary_embedding.py Llama3RotaryEmbedding)."""
+    inv_freq = 1.0 / (rope_theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if rope_scaling and rope_scaling.get("rope_type",
+                                        rope_scaling.get("type")) == "llama3":
+        factor = rope_scaling["factor"]
+        low = rope_scaling["low_freq_factor"]
+        high = rope_scaling["high_freq_factor"]
+        orig = rope_scaling["original_max_position_embeddings"]
+        wavelen = 2 * jnp.pi / inv_freq
+        low_wavelen = orig / low
+        high_wavelen = orig / high
+        # Long wavelengths scaled down by factor; short kept; smooth ramp
+        # in between.
+        smooth = (orig / wavelen - low) / (high - low)
+        scaled = jnp.where(
+            wavelen > low_wavelen, inv_freq / factor,
+            jnp.where(wavelen < high_wavelen, inv_freq,
+                      (1 - smooth) * inv_freq / factor + smooth * inv_freq))
+        inv_freq = scaled
+    return inv_freq
+
+
+def compute_rope_cos_sin(positions: jax.Array, head_dim: int,
+                         rope_theta: float,
+                         rope_scaling: dict | None = None,
+                         dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions, HF-llama layout: inv_freq
+    over even dims, duplicated across both halves of the head."""
+    inv_freq = make_inv_freq(head_dim, rope_theta, rope_scaling)
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [T, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q: jax.Array, k: jax.Array, cos: jax.Array,
+               sin: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Apply rotary embedding; q/k are [T, heads, head_dim], cos/sin [T, D].
+    Matches HF transformers' apply_rotary_pos_emb exactly (parity tests
+    depend on bit-level agreement up to dtype rounding)."""
+    cos = cos[:, None, :]
+    sin = sin[:, None, :]
+    q_out = q * cos + _rotate_half(q) * sin
+    k_out = k * cos + _rotate_half(k) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
+           down_w: jax.Array) -> jax.Array:
+    """SiLU-gated MLP (reference: csrc/activation_kernels.cu fused
+    silu-mul; XLA fuses the elementwise chain into the matmuls)."""
+    gate = jax.nn.silu(x @ gate_w)
+    return (gate * (x @ up_w)) @ down_w

@@ -1,0 +1,39 @@
+"""Architecture registry (reference: vllm/model_executor/models/registry.py:32
+maps HF ``architectures`` strings to model classes; ~180 entries there).
+
+The Llama-family functional model covers every config that is structurally
+a pre-norm RoPE decoder with SwiGLU MLP and optional QKV bias — which is
+Llama 2/3, Mistral, Qwen2, and friends.
+"""
+
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+
+_REGISTRY: dict[str, type] = {
+    "LlamaForCausalLM": LlamaForCausalLM,
+    "MistralForCausalLM": LlamaForCausalLM,
+    "Qwen2ForCausalLM": LlamaForCausalLM,
+}
+
+
+def resolve_architecture(hf_config) -> type:
+    for arch in getattr(hf_config, "architectures", None) or []:
+        if arch in _REGISTRY:
+            return _REGISTRY[arch]
+    # Config-shape fallback (tiny test configs may lack architectures).
+    if hasattr(hf_config, "num_hidden_layers"):
+        return LlamaForCausalLM
+    raise ValueError(
+        f"no supported architecture in {getattr(hf_config, 'architectures', None)}")
+
+
+def supported_architectures() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+__all__ = [
+    "resolve_architecture",
+    "supported_architectures",
+    "LlamaArchConfig",
+    "LlamaForCausalLM",
+]

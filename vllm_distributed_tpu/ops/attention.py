@@ -1,0 +1,144 @@
+"""Ragged paged attention over a paged KV cache — XLA implementation.
+
+This is the TPU-native equivalent of the reference's unified attention path
+(vllm/attention/layer.py:398 ``unified_attention`` dispatching to the CUDA
+paged-attention kernels in csrc/attention/ and, on its TPU backend, to
+torch.ops.xla.ragged_paged_attention — v1/attention/backends/pallas.py:232).
+
+Two ops:
+
+* ``write_kv_pages`` — scatter newly-computed K/V for a flat batch of
+  tokens into the paged cache via a precomputed slot mapping (equivalent of
+  csrc/cache_kernels.cu reshape_and_cache, pallas_kv_cache_update.py).
+  On TPU this lowers to a dynamic-update-scatter XLA handles well.
+
+* ``ragged_paged_attention`` — token-centric unified prefill/decode
+  attention: every query token attends to its request's pages up to its own
+  position. Implemented as a lax.scan over page indices with an online
+  (flash-style) softmax so peak memory is O(T * page_size) instead of
+  O(T * max_model_len). Handles GQA, mixed prefill+decode in one batch,
+  and same-step prefix sharing (KV must be written before calling).
+
+A Pallas kernel (ops/pallas/) replaces the scan for performance; this XLA
+version is the correctness reference and the CPU/interpret fallback
+(selected via VDT_ATTENTION_BACKEND).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Set to a large negative number rather than -inf so fully-masked rows
+# produce 0-weight rows instead of NaNs.
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def write_kv_pages(
+    k_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    v_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    k_new: jax.Array,  # [T, num_kv_heads, head_dim]
+    v_new: jax.Array,  # [T, num_kv_heads, head_dim]
+    slot_mapping: jax.Array,  # [T] int32 flat slot = page*page_size + off
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new K/V rows into the paged cache.
+
+    Padded tokens must carry an out-of-range slot (e.g. -1): scatter mode
+    'drop' discards them.
+    """
+    num_pages, page_size, num_kv_heads, head_dim = k_pages.shape
+    total_slots = num_pages * page_size
+    flat_shape = (total_slots, num_kv_heads, head_dim)
+    # JAX wraps negative indices; remap them out of range so mode='drop'
+    # actually discards padding slots.
+    slots = jnp.where(slot_mapping < 0, total_slots, slot_mapping)
+    k_flat = k_pages.reshape(flat_shape)
+    v_flat = v_pages.reshape(flat_shape)
+    k_flat = k_flat.at[slots].set(k_new.astype(k_flat.dtype), mode="drop")
+    v_flat = v_flat.at[slots].set(v_new.astype(v_flat.dtype), mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+@partial(jax.jit, static_argnames=("sm_scale", ))
+def ragged_paged_attention(
+    q: jax.Array,  # [T, num_q_heads, head_dim]
+    k_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    v_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
+    req_idx: jax.Array,  # [T] int32: owning request row per token
+    q_pos: jax.Array,  # [T] int32: absolute position of each query token
+    *,
+    sm_scale: float,
+) -> jax.Array:  # [T, num_q_heads, head_dim]
+    """Unified ragged attention: token t attends to kv positions
+    0..q_pos[t] of request req_idx[t] (causal over the paged cache)."""
+    T, num_q_heads, head_dim = q.shape
+    num_pages, page_size, num_kv_heads, _ = k_pages.shape
+    assert num_q_heads % num_kv_heads == 0
+    group = num_q_heads // num_kv_heads
+    pages_per_req = block_tables.shape[1]
+
+    # [T, Hkv, G, D] queries grouped by kv head.
+    qg = q.reshape(T, num_kv_heads, group, head_dim).astype(jnp.float32)
+    qg = qg * sm_scale
+    # Per-token page lists: [T, pages_per_req].
+    token_pages = block_tables[req_idx]
+
+    def body(carry, page_i):
+        m, l, acc = carry  # [T,Hkv,G,1], [T,Hkv,G,1], [T,Hkv,G,D]
+        page_ids = token_pages[:, page_i]  # [T]
+        k_blk = k_pages[page_ids].astype(jnp.float32)  # [T,ps,Hkv,D]
+        v_blk = v_pages[page_ids].astype(jnp.float32)
+        # scores [T, Hkv, G, ps]
+        scores = jnp.einsum("thgd,tphd->thgp", qg, k_blk)
+        kv_pos = page_i * page_size + jnp.arange(page_size, dtype=jnp.int32)
+        valid = kv_pos[None, :] <= q_pos[:, None]  # [T, ps] causal
+        scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)  # [T,Hkv,G,ps]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("thgp,tphd->thgd", p, v_blk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((T, num_kv_heads, group, 1), _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((T, num_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((T, num_kv_heads, group, head_dim), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0),
+                                  jnp.arange(pages_per_req,
+                                             dtype=jnp.int32))
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
+
+
+def naive_ragged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    req_idx: jax.Array,
+    q_pos: jax.Array,
+    *,
+    sm_scale: float,
+) -> jax.Array:
+    """O(T * max_kv) dense-gather reference used only by unit tests."""
+    T, num_q_heads, head_dim = q.shape
+    num_pages, page_size, num_kv_heads, _ = k_pages.shape
+    group = num_q_heads // num_kv_heads
+    pages_per_req = block_tables.shape[1]
+    max_kv = pages_per_req * page_size
+
+    token_pages = block_tables[req_idx]  # [T, P]
+    # Gather each token's full KV run: [T, P, ps, Hkv, D] -> [T, max_kv, ...]
+    k_all = k_pages[token_pages].reshape(T, max_kv, num_kv_heads, head_dim)
+    v_all = v_pages[token_pages].reshape(T, max_kv, num_kv_heads, head_dim)
+    qg = q.reshape(T, num_kv_heads, group, head_dim).astype(jnp.float32)
+    scores = jnp.einsum("thgd,tjhd->thgj", qg * sm_scale,
+                        k_all.astype(jnp.float32))
+    kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
+    valid = kv_pos[None, :] <= q_pos[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("thgj,tjhd->thgd", weights, v_all.astype(jnp.float32))
+    return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)

@@ -80,7 +80,8 @@ class Request:
         self.sampling_params = copy.deepcopy(sampling_params)
         sampling_params = self.sampling_params
         self.eos_token_id = eos_token_id
-        self.arrival_time = arrival_time or time.time()
+        self.arrival_time = (time.time()
+                             if arrival_time is None else arrival_time)
         self.priority = priority
         self.kv_transfer_params = kv_transfer_params
 

@@ -1,0 +1,26 @@
+"""Per-step sampling tensors (reference: vllm/v1/sample/metadata.py
+``SamplingMetadata`` + the TPU variant in v1/sample/tpu/).
+
+Every field is a dense [R] array so any mix of per-request parameters
+lowers to the same compiled graph — adding a request never recompiles.
+"""
+
+from dataclasses import dataclass
+
+import jax
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SamplingMetadata:
+    # [R] float32; 0.0 means greedy.
+    temperature: jax.Array
+    # [R] int32; 0 disables top-k.
+    top_k: jax.Array
+    # [R] float32; 1.0 disables top-p.
+    top_p: jax.Array
+    # [R] float32; 0.0 disables min-p.
+    min_p: jax.Array
+    # [R] int64 per-step fold-in values: derived from (user seed, step) for
+    # seeded requests or (engine rng, step) otherwise, built on the host.
+    seeds: jax.Array

@@ -1,0 +1,85 @@
+"""Token sampler: greedy / temperature / top-k / top-p / min-p.
+
+Reference: vllm/v1/sample/sampler.py:18 and
+v1/sample/ops/topk_topp_sampler.py:296. TPU-native design: one fused
+static-shape computation over the padded request batch — a single
+descending sort serves top-k, top-p and min-p masking, and sampling is
+Gumbel-argmax over the masked, sorted logits (no host sync, no dynamic
+shapes, vmapped per-request PRNG via fold_in).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from vllm_distributed_tpu.sample.metadata import SamplingMetadata
+
+_NEG_INF = float("-inf")
+
+
+@partial(jax.jit, static_argnames=())
+def sample_tokens(
+    logits: jax.Array,  # [R, V] float32
+    md: SamplingMetadata,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (sampled token ids [R] int32, logprob of the sampled token
+    [R] float32 under the *unmasked* temperature-scaled distribution —
+    matching the reference's sampled-logprob semantics)."""
+    R, V = logits.shape
+
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Temperature scale (guard greedy rows against /0; their result is
+    # discarded by the final where()).
+    temp = jnp.maximum(md.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # One descending sort powers all three truncations.
+    sorted_logits, sorted_idx = jax.lax.top_k(scaled, V)
+
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    # top-k: keep the first k sorted entries (k=0 -> keep all).
+    k = jnp.where(md.top_k > 0, md.top_k, V)[:, None]
+    keep = ranks < k
+
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # top-p: keep the smallest prefix with cumulative prob >= top_p.
+    # (cumsum - prob) is the mass strictly before each entry; once that
+    # reaches top_p the entry is dropped.
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep &= cum_before < md.top_p[:, None]
+    # min-p: drop tokens below min_p * max_prob.
+    keep &= probs >= (md.min_p[:, None] * probs[:, 0:1])
+
+    masked = jnp.where(keep, sorted_logits, _NEG_INF)
+
+    # Gumbel-argmax over the masked sorted logits; per-request keys.
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+        md.seeds.astype(jnp.uint32))
+    gumbel = jax.vmap(
+        lambda key, row: jax.random.gumbel(key, row.shape))(keys, masked)
+    choice_rank = jnp.argmax(masked + gumbel, axis=-1)
+    sampled_ids = jnp.take_along_axis(sorted_idx, choice_rank[:, None],
+                                      axis=1)[:, 0].astype(jnp.int32)
+
+    token_ids = jnp.where(md.temperature < 1e-5, greedy_ids, sampled_ids)
+
+    # Logprob of the chosen token under the temperature-scaled (but
+    # untruncated) distribution; greedy rows report the raw distribution.
+    report_scale = jnp.where(md.temperature[:, None] < 1e-5,
+                             logits, scaled)
+    logprobs = jax.nn.log_softmax(report_scale, axis=-1)
+    chosen_logprob = jnp.take_along_axis(logprobs, token_ids[:, None],
+                                         axis=1)[:, 0]
+    return token_ids, chosen_logprob
+
+
+def compute_topk_logprobs(logits: jax.Array,
+                          num_logprobs: int) -> tuple[jax.Array, jax.Array]:
+    """Top-k logprobs for API `logprobs=k` requests (reference:
+    v1/sample/logits_processor.py logprobs path)."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    top_vals, top_ids = jax.lax.top_k(logprobs, num_logprobs)
+    return top_vals, top_ids.astype(jnp.int32)

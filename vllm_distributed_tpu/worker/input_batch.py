@@ -1,0 +1,117 @@
+"""Persistent host-side batch state for in-flight requests.
+
+Reference: vllm/v1/worker/gpu_input_batch.py (persistent token/block-table/
+sampling arrays updated incrementally from SchedulerOutput) and
+tpu_input_batch.py. Rows are slotted (free-list), not compacted: padding
+discipline lives in the per-step flat arrays the runner builds, so row
+stability is worth more than density.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.core.sched.output import (CachedRequestData,
+                                                    NewRequestData,
+                                                    SchedulerOutput)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+class InputBatch:
+
+    def __init__(self, max_num_reqs: int, max_model_len: int,
+                 max_pages_per_req: int, page_size: int) -> None:
+        self.max_num_reqs = max_num_reqs
+        self.max_model_len = max_model_len
+        self.max_pages_per_req = max_pages_per_req
+        self.page_size = page_size
+
+        R, L, P = max_num_reqs, max_model_len, max_pages_per_req
+        self.token_ids = np.zeros((R, L), np.int32)
+        self.num_tokens = np.zeros((R, ), np.int32)
+        self.num_computed = np.zeros((R, ), np.int32)
+        self.block_table = np.zeros((R, P), np.int32)
+        self.num_blocks = np.zeros((R, ), np.int32)
+
+        self.temperature = np.zeros((R, ), np.float32)
+        self.top_k = np.zeros((R, ), np.int32)
+        self.top_p = np.ones((R, ), np.float32)
+        self.min_p = np.zeros((R, ), np.float32)
+        self.seed = np.full((R, ), -1, np.int64)
+
+        self.req_id_to_index: dict[str, int] = {}
+        self.index_to_req_id: dict[int, str] = {}
+        self._free_rows = list(range(R - 1, -1, -1))
+
+    @property
+    def num_reqs(self) -> int:
+        return len(self.req_id_to_index)
+
+    # ------------------------------------------------------------------
+    def add_request(self, data: NewRequestData) -> int:
+        assert data.req_id not in self.req_id_to_index
+        assert self._free_rows, "input batch overflow"
+        row = self._free_rows.pop()
+        self.req_id_to_index[data.req_id] = row
+        self.index_to_req_id[row] = data.req_id
+
+        tokens = data.prompt_token_ids
+        n = len(tokens)
+        self.token_ids[row, :n] = tokens
+        self.token_ids[row, n:] = 0
+        self.num_tokens[row] = n
+        self.num_computed[row] = data.num_computed_tokens
+        nb = len(data.block_ids)
+        self.block_table[row, :nb] = data.block_ids
+        self.block_table[row, nb:] = 0
+        self.num_blocks[row] = nb
+
+        sp: SamplingParams = data.sampling_params
+        self.temperature[row] = sp.temperature
+        self.top_k[row] = sp.top_k
+        self.top_p[row] = sp.top_p
+        self.min_p[row] = sp.min_p
+        self.seed[row] = -1 if sp.seed is None else sp.seed
+        return row
+
+    def update_cached(self, data: CachedRequestData) -> None:
+        for i, req_id in enumerate(data.req_ids):
+            row = self.req_id_to_index[req_id]
+            if data.resumed_from_preemption[i]:
+                # Full state replacement: block table was re-allocated.
+                tokens = data.new_token_ids[i]
+                self.token_ids[row, :len(tokens)] = tokens
+                self.num_tokens[row] = len(tokens)
+                nb = len(data.new_block_ids[i])
+                self.block_table[row, :nb] = data.new_block_ids[i]
+                self.block_table[row, nb:] = 0
+                self.num_blocks[row] = nb
+            else:
+                new_blocks = data.new_block_ids[i]
+                if new_blocks:
+                    nb = self.num_blocks[row]
+                    self.block_table[row, nb:nb + len(new_blocks)] = \
+                        new_blocks
+                    self.num_blocks[row] = nb + len(new_blocks)
+            self.num_computed[row] = data.num_computed_tokens[i]
+
+    def append_token(self, req_id: str, token_id: int) -> None:
+        """Record a token sampled this step (so the next step's input
+        includes it)."""
+        row = self.req_id_to_index[req_id]
+        n = self.num_tokens[row]
+        if n < self.max_model_len:
+            self.token_ids[row, n] = token_id
+            self.num_tokens[row] = n + 1
+
+    def remove_request(self, req_id: str) -> Optional[int]:
+        row = self.req_id_to_index.pop(req_id, None)
+        if row is None:
+            return None
+        del self.index_to_req_id[row]
+        self._free_rows.append(row)
+        self.num_tokens[row] = 0
+        self.num_computed[row] = 0
+        self.num_blocks[row] = 0
+        self.block_table[row, :] = 0
+        return row
