@@ -28,7 +28,7 @@ def build_batch(seqs, page_size=4, num_kv_heads=2, num_q_heads=4,
     op needs plus per-request dense K/V for the reference check."""
     rng = np.random.default_rng(seed)
     max_reqs = len(seqs)
-    k_pages = np.zeros((num_pages, page_size, num_kv_heads, head_dim),
+    k_pages = np.zeros((num_pages, num_kv_heads, page_size, head_dim),
                        np.float32)
     v_pages = np.zeros_like(k_pages)
     block_tables = np.zeros((max_reqs, pages_per_req), np.int32)
@@ -47,8 +47,8 @@ def build_batch(seqs, page_size=4, num_kv_heads=2, num_q_heads=4,
         block_tables[r, :npages] = pages
         for i in range(total):
             p, off = pages[i // page_size], i % page_size
-            k_pages[p, off] = k_full[i]
-            v_pages[p, off] = v_full[i]
+            k_pages[p, :, off] = k_full[i]
+            v_pages[p, :, off] = v_full[i]
         q_new = rng.standard_normal((new, num_q_heads, head_dim),
                                     dtype=np.float32)
         qs.append(q_new)
@@ -99,7 +99,7 @@ def test_gqa_groups():
 
 def test_write_then_read_roundtrip():
     page_size, num_kv_heads, head_dim = 4, 2, 8
-    k_pages = jnp.zeros((8, page_size, num_kv_heads, head_dim))
+    k_pages = jnp.zeros((8, num_kv_heads, page_size, head_dim))
     v_pages = jnp.zeros_like(k_pages)
     k_new = jnp.arange(3 * num_kv_heads * head_dim,
                        dtype=jnp.float32).reshape(3, num_kv_heads, head_dim)
@@ -107,18 +107,18 @@ def test_write_then_read_roundtrip():
     # Tokens land at slots: page 2 offset 1, page 2 offset 2, page 5 off 0.
     slots = jnp.asarray([2 * 4 + 1, 2 * 4 + 2, 5 * 4 + 0], jnp.int32)
     k_pages, v_pages = write_kv_pages(k_pages, v_pages, k_new, v_new, slots)
-    np.testing.assert_array_equal(np.asarray(k_pages[2, 1]),
+    np.testing.assert_array_equal(np.asarray(k_pages[2, :, 1]),
                                   np.asarray(k_new[0]))
-    np.testing.assert_array_equal(np.asarray(k_pages[2, 2]),
+    np.testing.assert_array_equal(np.asarray(k_pages[2, :, 2]),
                                   np.asarray(k_new[1]))
-    np.testing.assert_array_equal(np.asarray(v_pages[5, 0]),
+    np.testing.assert_array_equal(np.asarray(v_pages[5, :, 0]),
                                   np.asarray(v_new[2]))
     # Untouched slots remain zero.
     assert float(jnp.abs(k_pages[0]).sum()) == 0.0
 
 
 def test_write_padded_slots_dropped():
-    k_pages = jnp.ones((2, 4, 1, 4))
+    k_pages = jnp.ones((2, 1, 4, 4))
     v_pages = jnp.ones_like(k_pages)
     k_new = jnp.full((2, 1, 4), 9.0)
     # Slot -1 and out-of-range slot are both dropped.

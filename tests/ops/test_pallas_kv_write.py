@@ -1,0 +1,88 @@
+"""Pallas KV-write kernel vs the XLA scatter reference (interpret mode)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_distributed_tpu.ops.pallas_kv_write import write_kv_pages_pallas
+
+
+def run_pallas(k_all, v_all, k_new, v_new, runs, num_runs, layer, ps):
+    # k_new [T, KVH, D] -> head-leading with PS front / 2*PS back padding.
+    pad = [(0, 0), (ps, 2 * ps), (0, 0)]
+    k_hl = jnp.pad(jnp.asarray(k_new).swapaxes(0, 1), pad)
+    v_hl = jnp.pad(jnp.asarray(v_new).swapaxes(0, 1), pad)
+    return write_kv_pages_pallas(
+        jnp.asarray(k_all), jnp.asarray(v_all), k_hl, v_hl,
+        jnp.asarray(runs, jnp.int32), jnp.asarray([num_runs], jnp.int32),
+        jnp.asarray([layer], jnp.int32), interpret=True)
+
+
+def reference(k_all, k_new, runs, num_runs, layer, ps):
+    out = np.array(k_all)
+    for page, off_start, window_start, run_len in runs[:num_runs]:
+        if run_len == 0:
+            continue
+        src0 = window_start - ps + off_start
+        for i in range(run_len):
+            out[layer, page, :, off_start + i] = k_new[src0 + i]
+    return out
+
+
+def make_runs(slot_spans, ps):
+    """slot_spans: list of (first_slot, length) with flat src order."""
+    runs, src = [], 0
+    for slot, length in slot_spans:
+        consumed = 0
+        while consumed < length:
+            s = slot + consumed
+            off = s % ps
+            run_len = min(ps - off, length - consumed)
+            runs.append((s // ps, off, (src + consumed) - off + ps,
+                         run_len))
+            consumed += run_len
+        src += length
+    return runs
+
+
+@pytest.mark.parametrize("spans,layer", [
+    ([(3, 1), (17, 1), (40, 1)], 0),        # decode: single tokens
+    ([(0, 8), (32, 8)], 1),                  # full pages
+    ([(5, 20)], 2),                          # partial + full + partial
+    ([(2, 3), (24, 8), (50, 5)], 0),         # mixed
+])
+def test_matches_reference(spans, layer):
+    rng = np.random.default_rng(0)
+    L, N, KVH, PS, D = 3, 8, 2, 8, 128
+    k_all = rng.standard_normal((L, N, KVH, PS, D)).astype(np.float32)
+    v_all = rng.standard_normal((L, N, KVH, PS, D)).astype(np.float32)
+    T = sum(n for _, n in spans)
+    k_new = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    v_new = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    runs = make_runs(spans, PS)
+    G = len(runs) + 2  # padded rows must be ignored
+    runs_arr = np.zeros((G, 4), np.int32)
+    runs_arr[:len(runs)] = runs
+
+    k_out, v_out = run_pallas(k_all, v_all, k_new, v_new, runs_arr,
+                              len(runs), layer, PS)
+    np.testing.assert_allclose(
+        np.asarray(k_out), reference(k_all, k_new, runs, len(runs), layer,
+                                     PS))
+    np.testing.assert_allclose(
+        np.asarray(v_out), reference(v_all, v_new, runs, len(runs), layer,
+                                     PS))
+
+
+def test_inactive_and_zero_len_runs_ignored():
+    L, N, KVH, PS, D = 1, 4, 1, 8, 128
+    k_all = np.zeros((L, N, KVH, PS, D), np.float32)
+    k_new = np.ones((4, KVH, D), np.float32)
+    runs = np.zeros((4, 4), np.int32)
+    runs[0] = (2, 0, PS, 0)     # zero-length: skip
+    runs[1] = (1, 0, PS, 1)     # active
+    runs[2] = (3, 0, PS, PS)    # beyond num_runs: skip
+    k_out, _ = run_pallas(k_all, k_all, k_new, k_new, runs, 2, 0, PS)
+    k_out = np.asarray(k_out)
+    assert k_out[0, 1, 0, 0].sum() == D  # written
+    assert k_out[0, 2].sum() == 0 and k_out[0, 3].sum() == 0

@@ -199,6 +199,10 @@ class SchedulerConfig:
     # sched/scheduler.py:457 long_prefill_token_threshold).
     long_prefill_token_threshold: int = 0
     policy: str = "fcfs"  # fcfs | priority
+    # Fused decode steps per host roundtrip (reference: V0 multi-step
+    # scheduling / --num-scheduler-steps; on TPU the burst is one jitted
+    # lax.scan, see worker/model_runner.py). 1 disables.
+    num_scheduler_steps: int = 1
 
     def __post_init__(self) -> None:
         if self.policy not in ("fcfs", "priority"):

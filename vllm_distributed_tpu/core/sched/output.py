@@ -64,6 +64,12 @@ class SchedulerOutput:
     kv_connector_metadata: Optional[Any] = None
     # Token-parallel ownership for this step (None when tknp disabled).
     token_parallel_allocation: Optional[TokenParallelAllocation] = None
+    # >1: the worker runs this many fused decode steps device-side before
+    # returning (TPU analogue of the reference's multi-step scheduling +
+    # csrc/prepare_inputs/advance_step.cu — the host loop costs one
+    # roundtrip per burst instead of per token). Slots for all steps are
+    # pre-allocated via num_lookahead_tokens.
+    multi_step: int = 1
 
 
 EMPTY_MODEL_RUNNER_OUTPUT: "ModelRunnerOutput"

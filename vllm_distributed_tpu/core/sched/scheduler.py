@@ -71,6 +71,8 @@ class Scheduler:
         self.long_prefill_token_threshold = \
             sched_cfg.long_prefill_token_threshold
         self.policy = sched_cfg.policy
+        self.num_scheduler_steps = getattr(sched_cfg,
+                                           "num_scheduler_steps", 1)
 
         if num_blocks is None:
             num_blocks = config.cache_config.num_gpu_blocks
@@ -165,6 +167,28 @@ class Scheduler:
         token_budget = self.max_num_batched_tokens
         preempted: list[Request] = []
 
+        # Multi-step decode burst: when every running request is in plain
+        # decode and nothing is waiting, the worker can run N fused decode
+        # steps on-device per host roundtrip. All N slots are allocated up
+        # front via num_lookahead_tokens; the burst is disabled for any
+        # request that could finish or hit the context window mid-burst.
+        multi_step = self.num_scheduler_steps
+        if multi_step > 1:
+            if self.waiting or not self.running:
+                multi_step = 1
+            else:
+                for request in self.running:
+                    sp = request.sampling_params
+                    if (request.num_tokens_with_spec -
+                            request.num_computed_tokens != 1
+                            or request.spec_token_ids
+                            or sp.max_tokens - request.num_output_tokens <
+                            multi_step
+                            or self.max_model_len -
+                            request.num_computed_tokens < multi_step):
+                        multi_step = 1
+                        break
+
         # ---- 1. Running requests (decode / ongoing chunked prefill) ----
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
@@ -186,9 +210,17 @@ class Scheduler:
             scheduled = True
             while True:
                 new_blocks = self.kv_cache_manager.allocate_slots(
-                    request, num_new_tokens)
+                    request, num_new_tokens,
+                    num_lookahead_tokens=multi_step - 1)
                 if new_blocks is not None:
                     break
+                if multi_step > 1:
+                    # Not enough pages for the whole burst: degrade to
+                    # single-step before resorting to preemption. (Earlier
+                    # requests keep their lookahead pages — they will be
+                    # used by the following decode steps anyway.)
+                    multi_step = 1
+                    continue
                 # Out of pages: preempt the lowest-priority running request
                 # that has NOT been scheduled this step (evicting a
                 # scheduled one would leave SchedulerOutput entries
@@ -310,6 +342,7 @@ class Scheduler:
             total_num_scheduled_tokens=total,
             scheduled_spec_decode_tokens=scheduled_spec_tokens,
             finished_req_ids=self.finished_req_ids,
+            multi_step=multi_step if num_scheduled_tokens else 1,
         )
         self.finished_req_ids = set()
         if self.kv_connector is not None:
@@ -379,6 +412,9 @@ class Scheduler:
             if req_id not in num_scheduled:
                 continue
             scheduled = num_scheduled[req_id]
+            if scheduler_output.multi_step > 1:
+                # The worker computed KV for one token per fused step.
+                scheduled = scheduler_output.multi_step
             generated = sampled_by_req.get(req_id, [])
 
             # Speculative verification: some scheduled draft tokens may

@@ -42,6 +42,7 @@ class EngineArgs:
     enable_chunked_prefill: bool = True
     long_prefill_token_threshold: int = 0
     scheduling_policy: str = "fcfs"
+    num_scheduler_steps: int = 1
 
     device: str = "auto"
     load_format: str = "auto"
@@ -87,6 +88,7 @@ class EngineArgs:
                 long_prefill_token_threshold=self.
                 long_prefill_token_threshold,
                 policy=self.scheduling_policy,
+                num_scheduler_steps=self.num_scheduler_steps,
             ),
             device_config=DeviceConfig(device=self.device),
             load_config=LoadConfig(load_format=self.load_format),

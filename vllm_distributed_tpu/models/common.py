@@ -6,20 +6,22 @@ rotary_embedding.py}; on TPU these are plain jnp expressions XLA fuses into
 the surrounding matmuls (SURVEY.md §2.7: "XLA fuses this natively").
 """
 
+import dataclasses
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
-@jax.tree_util.register_dataclass
 @dataclass
 class AttentionBatch:
     """Flat ragged batch descriptor consumed by every attention layer.
 
     Built once per step by the model runner (equivalent of the reference's
     per-backend AttentionMetadata, v1/attention/backends/pallas.py
-    PallasMetadata).
+    PallasMetadata). Carries both token-centric metadata (XLA reference
+    attention path) and sequence-centric run metadata (Pallas kernel path).
     """
 
     # [T] int32: owning request row for each token.
@@ -32,6 +34,29 @@ class AttentionBatch:
     block_tables: jax.Array
     # [max_reqs] int32 total context length per request (0 = inactive).
     seq_lens: jax.Array
+    # [max_reqs, 4] int32 per-sequence runs in batch order:
+    # (q_start, q_len, kv_len_incl_new, batch_row). Rows >= num_seqs zero.
+    seq_info: Optional[jax.Array] = None
+    # [1] int32: number of active runs in seq_info.
+    num_seqs: Optional[jax.Array] = None
+    # [G, 4] int32 page-write runs for the Pallas KV-write kernel:
+    # (page, off_start, window_start, run_len); see ops/pallas_kv_write.py.
+    kv_runs: Optional[jax.Array] = None
+    # [1] int32: number of active rows in kv_runs.
+    num_kv_runs: Optional[jax.Array] = None
+    # Static: per-sequence query-length bucket (1 for pure decode);
+    # changing it recompiles, like every other shape bucket.
+    max_q: int = 1
+
+
+jax.tree_util.register_dataclass(
+    AttentionBatch,
+    data_fields=[
+        f.name for f in dataclasses.fields(AttentionBatch)
+        if f.name != "max_q"
+    ],
+    meta_fields=["max_q"],
+)
 
 
 def rms_norm(x: jax.Array, weight: jax.Array,

@@ -24,8 +24,8 @@ from jax.sharding import PartitionSpec as P
 from vllm_distributed_tpu.models.common import (AttentionBatch, apply_rope,
                                                 compute_rope_cos_sin,
                                                 rms_norm, swiglu)
-from vllm_distributed_tpu.ops.attention import (ragged_paged_attention,
-                                                write_kv_pages)
+from vllm_distributed_tpu.ops.attention import (paged_attention,
+                                                write_kv_cache)
 
 MODEL_AXIS = "model"
 
@@ -111,9 +111,11 @@ class LlamaForCausalLM:
         }
 
     def kv_cache_specs(self) -> dict:
+        # [L, pages, kv_heads, page_size, head_dim]: shard kv heads on the
+        # TP axis (head-major page layout; see ops/attention.write_kv_pages).
         return {
-            "k": P(None, None, None, MODEL_AXIS, None),
-            "v": P(None, None, None, MODEL_AXIS, None),
+            "k": P(None, None, MODEL_AXIS, None, None),
+            "v": P(None, None, MODEL_AXIS, None, None),
         }
 
     def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
@@ -156,9 +158,10 @@ class LlamaForCausalLM:
 
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None) -> dict:
+        from vllm_distributed_tpu.ops.attention import storage_head_dim
         c = self.cfg
-        shape = (c.num_layers, num_pages, page_size, c.num_kv_heads,
-                 c.head_dim)
+        shape = (c.num_layers, num_pages, c.num_kv_heads, page_size,
+                 storage_head_dim(c.head_dim))
         dtype = cache_dtype or c.dtype
         return {
             "k": jnp.zeros(shape, dtype),
@@ -245,8 +248,15 @@ class LlamaForCausalLM:
 
         has_bias = c.attention_bias
 
-        def layer_fn(h, xs):
-            lp, k_cache, v_cache = xs
+        # The stacked caches thread through the layer scan as CARRIES and
+        # every cache op indexes [layer, ...] internally: slicing the
+        # cache per layer (scan xs/ys) would copy the entire cache through
+        # HBM every step — the Pallas write kernel updates pages in place
+        # via input/output aliasing instead (reference analogue:
+        # v1/attention/backends/pallas.py:282 aliased kv_cache_update).
+        def layer_fn(carry, xs):
+            h, k_all, v_all = carry
+            lp, layer_idx = xs
             x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
             q = x @ lp["wq"]
             k = x @ lp["wk"]
@@ -263,21 +273,20 @@ class LlamaForCausalLM:
                               cos, sin)
             q = q.astype(c.dtype)
             k = k.astype(c.dtype)
-            k_cache, v_cache = write_kv_pages(k_cache, v_cache, k, v,
-                                              batch.slot_mapping)
-            attn = ragged_paged_attention(q, k_cache, v_cache,
-                                          batch.block_tables, batch.req_idx,
-                                          batch.positions,
-                                          sm_scale=sm_scale)
+            k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
+                                          layer_idx)
+            attn = paged_attention(q, k_all, v_all, batch,
+                                   sm_scale=sm_scale, layer=layer_idx)
             h = h + attn.reshape(T, -1) @ lp["wo"]
             x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
             h = h + swiglu(x2, lp["gate"], lp["up"], lp["down"])
-            return h, (k_cache, v_cache)
+            return (h, k_all, v_all), None
 
-        hidden, (k_new, v_new) = jax.lax.scan(
-            layer_fn, hidden,
-            (params["layers"], kv_caches["k"], kv_caches["v"]))
-        return hidden, {"k": k_new, "v": v_new}
+        layer_ids = jnp.arange(c.num_layers, dtype=jnp.int32)[:, None]
+        (hidden, k_all, v_all), _ = jax.lax.scan(
+            layer_fn, (hidden, kv_caches["k"], kv_caches["v"]),
+            (params["layers"], layer_ids))
+        return hidden, {"k": k_all, "v": v_all}
 
     def compute_logits(self, params: dict,
                        hidden: jax.Array) -> jax.Array:

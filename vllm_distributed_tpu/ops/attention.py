@@ -29,41 +29,75 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from vllm_distributed_tpu import envs
+
 # Set to a large negative number rather than -inf so fully-masked rows
 # produce 0-weight rows instead of NaNs.
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def storage_head_dim(head_dim: int) -> int:
+    """Head dim used for KV-cache storage: padded to the 128-lane tile on
+    TPU (reference: v1/attention/backends/pallas.py:25 pads head size to
+    128; Mosaic cannot DMA sub-tile lane slices). Zero-padding K and V
+    leaves attention numerics unchanged."""
+    if jax.default_backend() == "tpu":
+        return -(-head_dim // 128) * 128
+    return head_dim
+
+
+def _pad_last_dim(x: jax.Array, target: int) -> jax.Array:
+    if x.shape[-1] == target:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, target - x.shape[-1])]
+    return jnp.pad(x, pad)
+
+
 def write_kv_pages(
-    k_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
-    v_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    k_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
+    v_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
     k_new: jax.Array,  # [T, num_kv_heads, head_dim]
     v_new: jax.Array,  # [T, num_kv_heads, head_dim]
     slot_mapping: jax.Array,  # [T] int32 flat slot = page*page_size + off
 ) -> tuple[jax.Array, jax.Array]:
-    """Scatter new K/V rows into the paged cache.
+    """Scatter new K/V rows into a single-layer paged cache (XLA path).
 
+    The cache page layout is head-major [page, kv_head, page_size, head_dim]
+    so the Pallas attention kernel can DMA each page directly into
+    head-leading VMEM blocks (Mosaic wants batch/head dims leading). The
+    scatter is expressed as contiguous [1, head_dim] row updates on the
+    flattened cache — the only scatter shape XLA lowers efficiently.
     Padded tokens must carry an out-of-range slot (e.g. -1): scatter mode
     'drop' discards them.
     """
-    num_pages, page_size, num_kv_heads, head_dim = k_pages.shape
-    total_slots = num_pages * page_size
-    flat_shape = (total_slots, num_kv_heads, head_dim)
-    # JAX wraps negative indices; remap them out of range so mode='drop'
-    # actually discards padding slots.
-    slots = jnp.where(slot_mapping < 0, total_slots, slot_mapping)
-    k_flat = k_pages.reshape(flat_shape)
-    v_flat = v_pages.reshape(flat_shape)
-    k_flat = k_flat.at[slots].set(k_new.astype(k_flat.dtype), mode="drop")
-    v_flat = v_flat.at[slots].set(v_new.astype(v_flat.dtype), mode="drop")
+    num_pages, num_kv_heads, page_size, head_dim = k_pages.shape
+    T = k_new.shape[0]
+    k_new = _pad_last_dim(k_new, head_dim)
+    v_new = _pad_last_dim(v_new, head_dim)
+    page = slot_mapping // page_size
+    off = slot_mapping % page_size
+    # Flat row per (token, head): ((page * KVH) + h) * PS + off.
+    rows = ((page[:, None] * num_kv_heads +
+             jnp.arange(num_kv_heads, dtype=jnp.int32)[None, :]) *
+            page_size + off[:, None])
+    total = num_pages * num_kv_heads * page_size
+    rows = jnp.where(slot_mapping[:, None] < 0, total, rows).reshape(-1)
+    k_flat = k_pages.reshape(total, head_dim)
+    v_flat = v_pages.reshape(total, head_dim)
+    k_flat = k_flat.at[rows].set(
+        k_new.reshape(T * num_kv_heads, head_dim).astype(k_flat.dtype),
+        mode="drop")
+    v_flat = v_flat.at[rows].set(
+        v_new.reshape(T * num_kv_heads, head_dim).astype(v_flat.dtype),
+        mode="drop")
     return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
 
 
 @partial(jax.jit, static_argnames=("sm_scale", ))
 def ragged_paged_attention(
     q: jax.Array,  # [T, num_q_heads, head_dim]
-    k_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
-    v_pages: jax.Array,  # [num_pages, page_size, num_kv_heads, head_dim]
+    k_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
+    v_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
     block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
     req_idx: jax.Array,  # [T] int32: owning request row per token
     q_pos: jax.Array,  # [T] int32: absolute position of each query token
@@ -73,7 +107,7 @@ def ragged_paged_attention(
     """Unified ragged attention: token t attends to kv positions
     0..q_pos[t] of request req_idx[t] (causal over the paged cache)."""
     T, num_q_heads, head_dim = q.shape
-    num_pages, page_size, num_kv_heads, _ = k_pages.shape
+    num_pages, num_kv_heads, page_size, _ = k_pages.shape
     assert num_q_heads % num_kv_heads == 0
     group = num_q_heads // num_kv_heads
     pages_per_req = block_tables.shape[1]
@@ -87,10 +121,10 @@ def ragged_paged_attention(
     def body(carry, page_i):
         m, l, acc = carry  # [T,Hkv,G,1], [T,Hkv,G,1], [T,Hkv,G,D]
         page_ids = token_pages[:, page_i]  # [T]
-        k_blk = k_pages[page_ids].astype(jnp.float32)  # [T,ps,Hkv,D]
-        v_blk = v_pages[page_ids].astype(jnp.float32)
+        k_blk = k_pages[page_ids, ..., :head_dim].astype(jnp.float32)
+        v_blk = v_pages[page_ids, ..., :head_dim].astype(jnp.float32)
         # scores [T, Hkv, G, ps]
-        scores = jnp.einsum("thgd,tphd->thgp", qg, k_blk)
+        scores = jnp.einsum("thgd,thpd->thgp", qg, k_blk)
         kv_pos = page_i * page_size + jnp.arange(page_size, dtype=jnp.int32)
         valid = kv_pos[None, :] <= q_pos[:, None]  # [T, ps] causal
         scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
@@ -99,7 +133,7 @@ def ragged_paged_attention(
         p = jnp.exp(scores - m_new)  # [T,Hkv,G,ps]
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum("thgp,tphd->thgd", p, v_blk)
+        acc_new = acc * alpha + jnp.einsum("thgp,thpd->thgd", p, v_blk)
         return (m_new, l_new, acc_new), None
 
     m0 = jnp.full((T, num_kv_heads, group, 1), _MASK_VALUE, jnp.float32)
@@ -124,21 +158,166 @@ def naive_ragged_attention(
 ) -> jax.Array:
     """O(T * max_kv) dense-gather reference used only by unit tests."""
     T, num_q_heads, head_dim = q.shape
-    num_pages, page_size, num_kv_heads, _ = k_pages.shape
+    num_pages, num_kv_heads, page_size, _ = k_pages.shape
     group = num_q_heads // num_kv_heads
     pages_per_req = block_tables.shape[1]
     max_kv = pages_per_req * page_size
 
     token_pages = block_tables[req_idx]  # [T, P]
-    # Gather each token's full KV run: [T, P, ps, Hkv, D] -> [T, max_kv, ...]
-    k_all = k_pages[token_pages].reshape(T, max_kv, num_kv_heads, head_dim)
-    v_all = v_pages[token_pages].reshape(T, max_kv, num_kv_heads, head_dim)
+    # Gather each token's full KV run: [T, P, Hkv, ps, D] -> [T, Hkv, max_kv, D]
+    k_all = jnp.moveaxis(k_pages[token_pages, ..., :head_dim], 2,
+                         1).reshape(T, num_kv_heads, max_kv, head_dim)
+    v_all = jnp.moveaxis(v_pages[token_pages, ..., :head_dim], 2,
+                         1).reshape(T, num_kv_heads, max_kv, head_dim)
     qg = q.reshape(T, num_kv_heads, group, head_dim).astype(jnp.float32)
-    scores = jnp.einsum("thgd,tjhd->thgj", qg * sm_scale,
+    scores = jnp.einsum("thgd,thjd->thgj", qg * sm_scale,
                         k_all.astype(jnp.float32))
     kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
     valid = kv_pos[None, :] <= q_pos[:, None]
     scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("thgj,tjhd->thgd", weights, v_all.astype(jnp.float32))
+    out = jnp.einsum("thgj,thjd->thgd", weights, v_all.astype(jnp.float32))
     return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
+
+
+def resolve_attention_backend() -> str:
+    """Pick the attention implementation: 'pallas' on TPU (or when
+    interpret-mode testing requests it), 'xla' otherwise (reference:
+    vllm/attention/selector.py:109 get_attn_backend / platforms/tpu.py:45).
+
+    The platform is taken from the engine mesh when one is set (the
+    process default backend can be TPU while a test mesh runs on virtual
+    CPU devices), else from the default backend."""
+    backend = envs.VDT_ATTENTION_BACKEND
+    if backend == "auto":
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if mesh_state.has_global_mesh():
+            platform = next(iter(
+                mesh_state.get_global_mesh().devices.flat)).platform
+        else:
+            platform = jax.default_backend()
+        return "pallas" if platform == "tpu" else "xla"
+    return backend
+
+
+def write_kv_cache(
+    k_all: jax.Array,  # [L, N, KVH, PS, D]
+    v_all: jax.Array,
+    k_new: jax.Array,  # [T, KVH, d_model]
+    v_new: jax.Array,
+    batch,  # AttentionBatch
+    layer: jax.Array,  # [1] int32
+) -> tuple[jax.Array, jax.Array]:
+    """Write the step's K/V into layer ``layer`` of the stacked cache.
+
+    Pallas path: in-place aliased page RMW kernel (no cache copy; see
+    ops/pallas_kv_write.py). XLA path: flat row scatter with a layer
+    offset (CPU tests / debugging).
+    """
+    L, N, KVH, PS, D = k_all.shape
+    if (resolve_attention_backend() == "pallas"
+            and getattr(batch, "kv_runs", None) is not None):
+        from vllm_distributed_tpu.ops.pallas_kv_write import (
+            write_kv_pages_pallas)
+
+        def call(k_all_, v_all_, k_new_, v_new_):
+            pad = [(0, 0), (PS, 2 * PS), (0, 0)]
+            k_hl = jnp.pad(
+                _pad_last_dim(k_new_, D).swapaxes(0, 1), pad)
+            v_hl = jnp.pad(
+                _pad_last_dim(v_new_, D).swapaxes(0, 1), pad)
+            return write_kv_pages_pallas(
+                k_all_, v_all_, k_hl.astype(k_all_.dtype),
+                v_hl.astype(v_all_.dtype), batch.kv_runs,
+                batch.num_kv_runs, layer)
+
+        from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if mesh_state.has_global_mesh() and mesh_state.tp_size() > 1:
+            from jax.sharding import PartitionSpec as P
+            cache_spec = P(None, None, MESH_AXIS_MODEL, None, None)
+            new_spec = P(None, MESH_AXIS_MODEL, None)
+            return jax.shard_map(
+                call, mesh=mesh_state.get_global_mesh(),
+                in_specs=(cache_spec, cache_spec, new_spec, new_spec),
+                out_specs=(cache_spec, cache_spec),
+                check_vma=False)(k_all, v_all, k_new, v_new)
+        return call(k_all, v_all, k_new, v_new)
+
+    # XLA fallback: contiguous-row scatter over the flattened cache.
+    T = k_new.shape[0]
+    k_new = _pad_last_dim(k_new, D)
+    v_new = _pad_last_dim(v_new, D)
+    slot = batch.slot_mapping
+    page = slot // PS
+    off = slot % PS
+    rows = (((layer[0] * N + page[:, None]) * KVH +
+             jnp.arange(KVH, dtype=jnp.int32)[None, :]) * PS +
+            off[:, None])
+    total = L * N * KVH * PS
+    rows = jnp.where(slot[:, None] < 0, total, rows).reshape(-1)
+    k_flat = k_all.reshape(total, D)
+    v_flat = v_all.reshape(total, D)
+    k_flat = k_flat.at[rows].set(
+        k_new.reshape(T * KVH, D).astype(k_flat.dtype), mode="drop")
+    v_flat = v_flat.at[rows].set(
+        v_new.reshape(T * KVH, D).astype(v_flat.dtype), mode="drop")
+    return k_flat.reshape(k_all.shape), v_flat.reshape(v_all.shape)
+
+
+def paged_attention(
+    q: jax.Array,  # [T, num_q_heads, head_dim]
+    k_pages: jax.Array,  # [L, N, KVH, PS, D] stacked cache
+    v_pages: jax.Array,
+    batch,  # AttentionBatch
+    *,
+    sm_scale: float,
+    layer: jax.Array | None = None,  # [1] int32
+) -> jax.Array:
+    """Unified entry used by every model's attention layer; dispatches to
+    the Pallas kernel or the XLA reference path per backend selection.
+
+    On a >1-wide tensor-parallel mesh the Pallas call is wrapped in
+    shard_map over the "model" (head) axis — pallas_call is opaque to
+    GSPMD, so the kernel must be launched per-shard with local head counts
+    (the TPU analogue of the reference's per-rank attention backends).
+    """
+    if layer is None:
+        layer = jnp.zeros((1, ), jnp.int32)
+    if (resolve_attention_backend() == "pallas"
+            and batch.seq_info is not None):
+        from vllm_distributed_tpu.ops.pallas_attention import (
+            ragged_paged_attention_pallas)
+
+        head_dim = q.shape[-1]
+
+        def call(q_, k_, v_):
+            # Cache storage may be lane-padded (storage_head_dim); pad q to
+            # match and slice the padding back off the output.
+            q_ = _pad_last_dim(q_, k_.shape[-1])
+            out = ragged_paged_attention_pallas(
+                q_, k_, v_, batch.seq_info, batch.num_seqs,
+                batch.block_tables, layer, sm_scale=sm_scale,
+                max_q=batch.max_q)
+            return out[..., :head_dim]
+
+        from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if (mesh_state.has_global_mesh()
+                and mesh_state.tp_size() > 1):
+            from jax.sharding import PartitionSpec as P
+            head_spec = P(None, MESH_AXIS_MODEL, None)
+            kv_spec = P(None, None, MESH_AXIS_MODEL, None, None)
+            return jax.shard_map(
+                call, mesh=mesh_state.get_global_mesh(),
+                in_specs=(head_spec, kv_spec, kv_spec),
+                out_specs=head_spec, check_vma=False)(q, k_pages, v_pages)
+        return call(q, k_pages, v_pages)
+    if k_pages.ndim == 5:
+        k_layer = k_pages[layer[0]]
+        v_layer = v_pages[layer[0]]
+    else:
+        k_layer, v_layer = k_pages, v_pages
+    return ragged_paged_attention(q, k_layer, v_layer, batch.block_tables,
+                                  batch.req_idx, batch.positions,
+                                  sm_scale=sm_scale)

@@ -1,0 +1,295 @@
+"""Pallas ragged paged attention kernel for TPU.
+
+TPU-native replacement for the reference's paged-attention CUDA kernels
+(csrc/attention/paged_attention_v{1,2}.cu) and the torch_xla
+ragged_paged_attention op its TPU backend calls
+(vllm/v1/attention/backends/pallas.py:232). Re-designed for Pallas rather
+than translated:
+
+* Grid ``(seq, q_tile)``; each program runs the whole flash-attention
+  loop over that sequence's KV pages as a dynamic-trip-count
+  ``fori_loop`` (decode cost is O(kv_len), not O(max_model_len)), with
+  online-softmax accumulators as loop carries.
+* Per-sequence metadata (q_start, q_len, kv_len, batch row) is
+  scalar-prefetched into SMEM; KV pages are gathered from HBM by manual
+  async DMA using page ids read from the prefetched block table (the
+  paging side of csrc/attention is pure DMA here).
+* Mixed prefill/decode in one call: each sequence brings q_len query rows
+  (1 for decode, up to max_q for a chunked-prefill step).
+* Mosaic-friendly compute: the KV cache page layout is head-major
+  [page, kv_head, page_size, head_dim] so each page DMAs into a
+  contiguous [kv_head, block, head_dim] VMEM block; scores are 2-D
+  matmuls per kv head (GQA queries of a group fold into rows), avoiding
+  batched dots and sub-tile DMA slices entirely.
+
+Layout contract with the model runner:
+
+* Token arrays are the flat ragged batch; each sequence's q rows are
+  contiguous, sequence runs are back-to-back in run order r = 0..num_seqs.
+* ``q`` and the returned output have at least ``q_tile`` padding rows at
+  the end: a sequence's final tile may spill past its q_len; spilled rows
+  of sequence r are garbage but are rewritten by sequence r+1's own tile
+  flush (the TPU grid executes sequentially in order), and the last
+  sequence spills into the padding rows.
+* ``seq_info[r] = (q_start, q_len, kv_len, batch_row)``; ``kv_len``
+  includes tokens written this step. ``block_tables[batch_row]`` holds the
+  page ids (rows are input-batch rows, indirected through batch_row).
+* ``page_size`` must be a multiple of 8 (sublane tiling of the DMA
+  destination slices).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_distributed_tpu import envs
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _kernel(
+    # scalar prefetch
+    seq_info_ref,  # [R, 4] int32: q_start, q_len, kv_len, batch_row
+    num_seqs_ref,  # [1] int32
+    layer_ref,  # [1] int32
+    block_tables_ref,  # [max_reqs, pages_per_req] int32
+    # tensor inputs (HBM)
+    q_hbm,  # [T_pad, QH, D]
+    k_hbm,  # [L, num_pages, KVH, PS, D] (full stacked cache)
+    v_hbm,
+    # output (HBM)
+    out_hbm,  # [T_pad, QH, D]
+    # scratch
+    q_vmem,  # [BQ, QH, D] q.dtype
+    k_vmem,  # [KVH, BLK, D]
+    v_vmem,  # [KVH, BLK, D]
+    out_stage,  # [BQ, QH, D] q.dtype
+    q_sem,
+    kv_sems,  # DMA sems [2, PPB]
+    out_sem,
+    *,
+    sm_scale: float,
+    bq: int,
+    ppb: int,
+    page_size: int,
+    group: int,
+):
+    r = pl.program_id(0)
+    qt = pl.program_id(1)
+
+    q_start = seq_info_ref[r, 0]
+    q_len = seq_info_ref[r, 1]
+    kv_len = seq_info_ref[r, 2]
+    row = seq_info_ref[r, 3]
+    num_seqs = num_seqs_ref[0]
+    layer = layer_ref[0]
+    num_q_heads = q_vmem.shape[1]
+    num_kv_heads = k_vmem.shape[0]
+    head_dim = q_vmem.shape[2]
+
+    blk = ppb * page_size
+    tile_start = qt * bq
+    # Absolute position of the last query row in this tile; kv blocks past
+    # it are causally invisible and never fetched.
+    q_pos_max = kv_len - q_len + jnp.minimum(tile_start + bq, q_len) - 1
+    active = jnp.logical_and(
+        r < num_seqs,
+        jnp.logical_and(tile_start < q_len, kv_len > 0))
+
+    @pl.when(active)
+    def _run():
+        # Whole q tile in one leading-dim DMA (token rows are the major
+        # axis; head/lane dims stay intact — Mosaic constrains sub-tile
+        # slicing of the minor two dims).
+        q_dma = pltpu.make_async_copy(
+            q_hbm.at[pl.ds(q_start + tile_start, bq)], q_vmem, q_sem)
+        q_dma.start()
+        num_blocks = q_pos_max // blk + 1
+        q_dma.wait()
+
+        q_tile = q_vmem[...].astype(jnp.float32) * sm_scale  # [BQ, QH, D]
+        if bq == 1:
+            # Decode: rows are heads; group slices are leading-dim slices.
+            q_flat = q_tile.reshape(num_q_heads, head_dim)
+            q_heads = [
+                q_flat[h * group:(h + 1) * group]
+                for h in range(num_kv_heads)
+            ]
+        else:
+            q_heads = [
+                q_tile[:, h * group:(h + 1) * group, :].reshape(
+                    bq * group, head_dim) for h in range(num_kv_heads)
+            ]
+        rows = bq * group
+
+        row_pos = (kv_len - q_len + tile_start +
+                   jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0) //
+                   group)
+        col_base = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+        row_valid = (jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0) //
+                     group + tile_start) < q_len
+
+        def body(b, carry):
+            ms, ls, accs = carry
+            kv_start = b * blk
+            for i in range(ppb):
+                page_id = block_tables_ref[row, b * ppb + i]
+                pltpu.make_async_copy(
+                    k_hbm.at[layer, page_id],
+                    k_vmem.at[:, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[0, i]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[layer, page_id],
+                    v_vmem.at[:, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[1, i]).start()
+            for i in range(ppb):
+                pltpu.make_async_copy(
+                    k_hbm.at[0, 0],
+                    k_vmem.at[:, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[0, i]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[0, 0],
+                    v_vmem.at[:, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[1, i]).wait()
+
+            kv_pos = kv_start + col_base
+            mask = jnp.logical_and(kv_pos <= row_pos, row_valid)
+
+            new_ms, new_ls, new_accs = [], [], []
+            for h in range(num_kv_heads):
+                k_h = k_vmem[h]  # [BLK, D]
+                v_h = v_vmem[h]
+                s = jax.lax.dot_general(
+                    q_heads[h], k_h.astype(jnp.float32),
+                    dimension_numbers=(((1, ), (1, )), ((), ())),
+                    preferred_element_type=jnp.float32)  # [rows, BLK]
+                s = jnp.where(mask, s, _MASK_VALUE)
+                m_prev, l_prev, acc_prev = ms[h], ls[h], accs[h]
+                m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m_prev - m_new)
+                l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    p.astype(v_h.dtype), v_h,
+                    dimension_numbers=(((1, ), (0, )), ((), ())),
+                    preferred_element_type=jnp.float32)  # [rows, D]
+                acc_new = acc_prev * alpha + pv
+                new_ms.append(m_new)
+                new_ls.append(l_new)
+                new_accs.append(acc_new)
+            return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+        init = (
+            tuple(
+                jnp.full((rows, 1), _MASK_VALUE, jnp.float32)
+                for _ in range(num_kv_heads)),
+            tuple(
+                jnp.zeros((rows, 1), jnp.float32)
+                for _ in range(num_kv_heads)),
+            tuple(
+                jnp.zeros((rows, head_dim), jnp.float32)
+                for _ in range(num_kv_heads)),
+        )
+        ms, ls, accs = jax.lax.fori_loop(0, num_blocks, body, init)
+
+        for h in range(num_kv_heads):
+            o_h = accs[h] / jnp.maximum(ls[h], 1e-20)  # [rows, D]
+            if bq == 1:
+                out_stage[0, h * group:(h + 1) * group, :] = (
+                    o_h.astype(out_stage.dtype))
+            else:
+                out_stage[:, h * group:(h + 1) * group, :] = (
+                    o_h.reshape(bq, group, head_dim).astype(
+                        out_stage.dtype))
+        out_dma = pltpu.make_async_copy(
+            out_stage, out_hbm.at[pl.ds(q_start + tile_start, bq)],
+            out_sem)
+        out_dma.start()
+        out_dma.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "max_q", "interpret"))
+def ragged_paged_attention_pallas(
+    q: jax.Array,  # [T_pad, QH, D]; T_pad >= T + q_tile padding
+    k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] full stacked cache
+    v_pages: jax.Array,
+    seq_info: jax.Array,  # [R, 4] int32 (q_start, q_len, kv_len, row)
+    num_seqs: jax.Array,  # [1] int32
+    block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
+    layer: jax.Array | None = None,  # [1] int32
+    *,
+    sm_scale: float,
+    max_q: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Unified prefill/decode attention over the paged KV cache.
+
+    ``max_q`` is the static per-sequence query bucket (1 for pure decode).
+    The cache keeps its stacked layer dim; ``layer`` selects the slice to
+    read (pages are DMA'd as [layer, page] — no layer copy materializes).
+    Returns [T_pad, QH, D]; rows past each sequence's q_len are garbage.
+    """
+    if interpret is None:
+        interpret = envs.VDT_PALLAS_INTERPRET
+    if k_pages.ndim == 4:
+        # Single-layer convenience form (tests).
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+    if layer is None:
+        layer = jnp.zeros((1, ), jnp.int32)
+    T_pad, num_q_heads, head_dim = q.shape
+    _, num_pages, num_kv_heads, page_size, _ = k_pages.shape
+    assert num_q_heads % num_kv_heads == 0
+    group = num_q_heads // num_kv_heads
+    R = seq_info.shape[0]
+    pages_per_req = block_tables.shape[1]
+
+    bq = min(max_q, 128)
+    # Keep the per-program footprint (q/out staging, f32 accumulators and
+    # their loop-carry double buffers, per-head score tiles) inside the
+    # ~16MB VMEM budget: shrink the q tile for wide-head models.
+    while bq > 8 and bq * num_q_heads * head_dim * 32 > 12 * 1024**2:
+        bq //= 2
+    num_q_tiles = pl.cdiv(max_q, bq)
+    assert T_pad >= bq, "q must be padded to at least one tile"
+    # ~128 kv positions per block, at least one page.
+    ppb = max(1, min(128 // page_size, pages_per_req))
+    while pages_per_req % ppb:
+        ppb -= 1
+    blk = ppb * page_size
+
+    grid = (R, num_q_tiles)
+    kernel = functools.partial(
+        _kernel, sm_scale=sm_scale, bq=bq, ppb=ppb, page_size=page_size,
+        group=group)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # q
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k_pages
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v_pages
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
+            pltpu.VMEM((num_kv_heads, blk, head_dim), k_pages.dtype),
+            pltpu.VMEM((num_kv_heads, blk, head_dim), v_pages.dtype),
+            pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2, ppb)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(seq_info, num_seqs, layer, block_tables, q, k_pages, v_pages)

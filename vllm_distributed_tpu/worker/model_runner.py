@@ -61,6 +61,16 @@ class TPUModelRunner:
         self.token_buckets = make_buckets(
             16, sched_cfg.max_num_batched_tokens)
         self.req_buckets = make_buckets(8, self.max_num_reqs)
+        # Per-sequence query-length buckets for the attention kernel:
+        # 1 (pure decode) then powers of 4 up to the token budget.
+        self.max_q_buckets = [1] + [
+            b for b in make_buckets(8, sched_cfg.max_num_batched_tokens)
+            if b > 1
+        ]
+        # KV-write runs: worst case one partial + the full pages per req.
+        max_runs = (cdiv(sched_cfg.max_num_batched_tokens, self.page_size)
+                    + self.max_num_reqs)
+        self.kv_run_buckets = make_buckets(8, max_runs)
 
         self._step_fn = None
         self._rng = np.random.default_rng(config.model_config.seed)
@@ -86,10 +96,11 @@ class TPUModelRunner:
         self._build_step_fn()
 
     def kv_cache_bytes_per_page(self) -> int:
+        from vllm_distributed_tpu.ops.attention import storage_head_dim
         c = self.model.cfg
         itemsize = jnp.dtype(c.dtype).itemsize
         return (2 * c.num_layers * self.page_size * c.num_kv_heads *
-                c.head_dim * itemsize)
+                storage_head_dim(c.head_dim) * itemsize)
 
     def _build_step_fn(self) -> None:
         model = self.model
@@ -105,6 +116,51 @@ class TPUModelRunner:
 
         # Donate the caches: XLA aliases them in place of a copy.
         self._step_fn = jax.jit(step, donate_argnums=(1, ))
+        self._build_multi_step_fn()
+
+    def _build_multi_step_fn(self) -> None:
+        """N fused decode steps in one jitted lax.scan: the host pays one
+        dispatch+sync per burst instead of per token (TPU answer to the
+        reference's multi-step scheduling + advance_step.cu in-place input
+        update; sampled tokens feed the next step on-device)."""
+        import dataclasses
+
+        model = self.model
+        page_size = self.page_size
+
+        def multi_step(params, kv_caches, tok0, pos0, block_tables,
+                       sampling_md: SamplingMetadata, seeds, num_active):
+            R = tok0.shape[0]
+            rows = jnp.arange(R, dtype=jnp.int32)
+            ones = jnp.ones((R, ), jnp.int32)
+
+            def one(carry, seeds_t):
+                kv, tok, pos = carry
+                active = rows < num_active[0]
+                page = block_tables[rows, pos // page_size]
+                off = pos % page_size
+                slot = jnp.where(active, page * page_size + off, -1)
+                seq_info = jnp.stack([rows, ones, pos + 1, rows], axis=1)
+                # One single-token page-write run per active request.
+                kv_runs = jnp.stack(
+                    [page, off, rows - off + page_size,
+                     jnp.where(active, 1, 0)], axis=1)
+                batch = AttentionBatch(
+                    req_idx=rows, positions=pos, slot_mapping=slot,
+                    block_tables=block_tables, seq_lens=pos + 1,
+                    seq_info=seq_info, num_seqs=num_active,
+                    kv_runs=kv_runs, num_kv_runs=num_active, max_q=1)
+                hidden, kv = model.forward(params, kv, tok, batch)
+                logits = model.compute_logits(params, hidden)
+                md_t = dataclasses.replace(sampling_md, seeds=seeds_t)
+                tok_next, logprobs = sample_tokens(logits, md_t)
+                return (kv, tok_next, pos + 1), (tok_next, logprobs)
+
+            (kv, _, _), (toks, lps) = jax.lax.scan(
+                one, (kv_caches, tok0, pos0), seeds)
+            return kv, toks, lps
+
+        self._multi_step_fn = jax.jit(multi_step, donate_argnums=(1, ))
 
     # ------------------------------------------------------------------
     def _update_states(self, scheduler_output: SchedulerOutput) -> None:
@@ -119,18 +175,27 @@ class TPUModelRunner:
         ib = self.input_batch
         num_sched = scheduler_output.num_scheduled_tokens
         total_tokens = scheduler_output.total_num_scheduled_tokens
-        T = pad_to_bucket(total_tokens, self.token_buckets)
+        # Static q-length bucket for the Pallas kernel (1 = pure decode);
+        # token arrays carry one extra q tile of padding so a sequence's
+        # final tile may spill past its q_len (see ops/pallas_attention.py).
+        max_q = pad_to_bucket(max(num_sched.values()), self.max_q_buckets)
+        q_tile = min(max_q, 128)
+        T = pad_to_bucket(total_tokens, self.token_buckets) + q_tile
 
         token_ids = np.zeros((T, ), np.int32)
         positions = np.zeros((T, ), np.int32)
         req_idx = np.zeros((T, ), np.int32)
         slot_mapping = np.full((T, ), -1, np.int32)
+        seq_info = np.zeros((self.max_num_reqs, 4), np.int32)
+        kv_runs: list[tuple[int, int, int, int]] = []
+        ps = self.page_size
 
         sampling_rows: list[int] = []
         sampling_req_ids: list[str] = []
         logits_idx: list[int] = []
 
         t = 0
+        num_runs = 0
         for req_id, n in num_sched.items():
             row = ib.req_id_to_index[req_id]
             start = ib.num_computed[row]
@@ -140,14 +205,31 @@ class TPUModelRunner:
             req_idx[t:t + n] = row
             pos = np.arange(start, end)
             slot_mapping[t:t + n] = (
-                ib.block_table[row, pos // self.page_size] *
-                self.page_size + pos % self.page_size)
+                ib.block_table[row, pos // ps] * ps + pos % ps)
+            seq_info[num_runs] = (t, n, end, row)
+            num_runs += 1
+            # Page-write runs for the Pallas KV-write kernel: maximal
+            # consecutive-slot spans within one page.
+            consumed = 0
+            while consumed < n:
+                p = start + consumed
+                off = p % ps
+                run_len = min(ps - off, n - consumed)
+                src = t + consumed
+                kv_runs.append((int(ib.block_table[row, p // ps]), off,
+                                src - off + ps, run_len))
+                consumed += run_len
             if end >= ib.num_tokens[row]:
                 # This step finishes all known tokens: sample.
                 sampling_rows.append(row)
                 sampling_req_ids.append(req_id)
                 logits_idx.append(t + n - 1)
             t += n
+
+        G = pad_to_bucket(max(len(kv_runs), 1), self.kv_run_buckets)
+        kv_runs_arr = np.zeros((G, 4), np.int32)
+        if kv_runs:
+            kv_runs_arr[:len(kv_runs)] = kv_runs
 
         R = pad_to_bucket(max(len(sampling_rows), 1), self.req_buckets)
         rows = np.asarray(sampling_rows +
@@ -176,6 +258,11 @@ class TPUModelRunner:
             slot_mapping=jnp.asarray(slot_mapping),
             block_tables=jnp.asarray(ib.block_table),
             seq_lens=jnp.asarray(ib.num_computed),
+            seq_info=jnp.asarray(seq_info),
+            num_seqs=jnp.asarray([num_runs], np.int32),
+            kv_runs=jnp.asarray(kv_runs_arr),
+            num_kv_runs=jnp.asarray([len(kv_runs)], np.int32),
+            max_q=max_q,
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
@@ -187,6 +274,8 @@ class TPUModelRunner:
         self._update_states(scheduler_output)
         if scheduler_output.total_num_scheduled_tokens == 0:
             return ModelRunnerOutput()
+        if scheduler_output.multi_step > 1:
+            return self._execute_multi_step(scheduler_output)
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
          shape) = self._prepare_inputs(scheduler_output)
@@ -224,6 +313,70 @@ class TPUModelRunner:
         return ModelRunnerOutput(req_ids=req_ids,
                                  sampled_token_ids=sampled,
                                  logprobs=lps)
+
+    # ------------------------------------------------------------------
+    def _execute_multi_step(
+            self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        """Run scheduler_output.multi_step fused decode steps (pure-decode
+        batch; one host roundtrip for the whole burst)."""
+        ib = self.input_batch
+        n_steps = scheduler_output.multi_step
+        req_ids = list(scheduler_output.num_scheduled_tokens)
+        num_active = len(req_ids)
+        R = pad_to_bucket(num_active, self.req_buckets)
+        rows = np.zeros((R, ), np.int32)
+        rows[:num_active] = [ib.req_id_to_index[r] for r in req_ids]
+
+        pos0 = ib.num_computed[rows].astype(np.int32)
+        tok0 = ib.token_ids[rows, pos0].astype(np.int32)
+        block_tables = ib.block_table[rows]
+
+        user_seed = ib.seed[rows]
+        step_in_req = ib.num_tokens[rows].astype(np.int64)
+        seeds = np.empty((n_steps, R), np.int64)
+        for t in range(n_steps):
+            random_part = self._rng.integers(0, 2**31 - 1, size=R)
+            seeds[t] = np.where(user_seed >= 0,
+                                user_seed * 1000003 + step_in_req + t,
+                                random_part)
+        sampling_md = SamplingMetadata(
+            temperature=jnp.asarray(ib.temperature[rows]),
+            top_k=jnp.asarray(ib.top_k[rows]),
+            top_p=jnp.asarray(ib.top_p[rows]),
+            min_p=jnp.asarray(ib.min_p[rows]),
+            seeds=jnp.asarray(seeds[0]),
+        )
+
+        shape = (-n_steps, R)
+        if shape not in self._compiled_shapes:
+            logger.info("compiling multi-step fn (steps=%d, reqs=%d)",
+                        n_steps, R)
+            start = time.perf_counter()
+        with self.mesh:
+            self.kv_caches, toks, lps = self._multi_step_fn(
+                self.params, self.kv_caches, jnp.asarray(tok0),
+                jnp.asarray(pos0), jnp.asarray(block_tables), sampling_md,
+                jnp.asarray(seeds),
+                jnp.asarray([num_active], np.int32))
+        if shape not in self._compiled_shapes:
+            self._compiled_shapes.add(shape)
+            logger.info("compiled in %.1fs", time.perf_counter() - start)
+
+        toks_np = np.asarray(jax.device_get(toks))  # [n_steps, R]
+        lps_np = np.asarray(jax.device_get(lps))
+
+        out_req_ids, sampled, out_lps = [], [], []
+        for i, req_id in enumerate(req_ids):
+            tokens = [int(t) for t in toks_np[:, i]]
+            for tok in tokens:
+                self.input_batch.append_token(req_id, tok)
+            out_req_ids.append(req_id)
+            sampled.append(tokens)
+            out_lps.append([{tok: float(lp)}
+                            for tok, lp in zip(tokens, lps_np[:, i])])
+        return ModelRunnerOutput(req_ids=out_req_ids,
+                                 sampled_token_ids=sampled,
+                                 logprobs=out_lps)
 
     # ------------------------------------------------------------------
     def precompile(self) -> None:
