@@ -1,0 +1,55 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from tests.ops.test_pallas_attention import build_case
+from vllm_distributed_tpu.ops.pallas_attention import (
+    ragged_paged_attention_pallas)
+from vllm_distributed_tpu.ops.attention import naive_ragged_attention
+from vllm_distributed_tpu.parallel.mesh import build_mesh
+from vllm_distributed_tpu.config import ParallelConfig
+
+
+def run(case, L=2, layer=1, mesh=None, shard=False):
+    k1 = case["k_pages"]
+    # stack L layers; put real data at `layer`, garbage elsewhere
+    k = jnp.stack([jnp.full_like(k1, jnp.nan)] * L).at[layer].set(k1)
+    v = jnp.stack([jnp.full_like(k1, jnp.nan)] * L).at[layer].set(
+        case["v_pages"])
+    q = case["q"]
+    if shard and mesh is not None:
+        k = jax.device_put(k, NamedSharding(mesh, P(None, None, "model", None, None)))
+        v = jax.device_put(v, NamedSharding(mesh, P(None, None, "model", None, None)))
+        q = jax.device_put(q, NamedSharding(mesh, P(None, "model", None)))
+    ctx = mesh if mesh is not None else jax.sharding.Mesh(np.array(jax.devices()[:1]), ("x",))
+    with ctx:
+        out = ragged_paged_attention_pallas(
+            q, k, v, case["seq_info"], case["num_seqs"],
+            case["block_tables"], jnp.asarray([layer], jnp.int32),
+            sm_scale=0.125, max_q=case["max_q"], interpret=True)
+    ref = naive_ragged_attention(
+        case["q"], case["k_pages"], case["v_pages"], case["block_tables"],
+        case["req_idx"], case["q_pos"], sm_scale=0.125)
+    T = case["T"]
+    return np.asarray(out)[:T], np.asarray(ref)[:T]
+
+
+def test_stacked_layer_nomesh():
+    rng = np.random.default_rng(0)
+    case = build_case(rng, seqs=[(5, 5)], page_size=4, pages_per_req=16,
+                      num_q_heads=4, num_kv_heads=2, head_dim=16, max_q=8)
+    got, want = run(case, mesh=None)
+    print("nomesh max diff:", np.abs(got - want).max())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_stacked_layer_mesh():
+    rng = np.random.default_rng(0)
+    case = build_case(rng, seqs=[(5, 5)], page_size=4, pages_per_req=16,
+                      num_q_heads=4, num_kv_heads=2, head_dim=16, max_q=8)
+    mesh = build_mesh(ParallelConfig(tensor_parallel_size=1,
+                                     data_parallel_size=1))
+    print("mesh:", mesh)
+    got, want = run(case, mesh=mesh, shard=True)
+    print("mesh max diff:", np.abs(got - want).max())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

@@ -1,0 +1,17 @@
+from tests.engine.test_llm_engine import (checkpoint, make_engine, hf_greedy,
+                                          run_engine)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def test_debug_single(checkpoint, monkeypatch):
+    monkeypatch.setenv("VDT_ATTENTION_BACKEND", "pallas")
+    path, hf = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16)
+    prompt = [3, 17, 92, 45, 8]
+    sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
+    outs = run_engine(engine, [prompt], [sp])
+    got = outs[0].outputs[0].token_ids
+    want = hf_greedy(hf, prompt, 5)
+    print("single got :", got)
+    print("single want:", want)
+    assert got == want

@@ -1,0 +1,23 @@
+import numpy as np
+import pytest
+from tests.ops.test_pallas_kv_write import (run_pallas, reference, make_runs)
+
+
+@pytest.mark.parametrize("PS,D", [(4, 16), (4, 128), (8, 16), (16, 64)])
+def test_small(PS, D):
+    rng = np.random.default_rng(0)
+    L, N, KVH = 2, 8, 2
+    spans = [(1, 5), (PS * 4, 1)]
+    k_all = rng.standard_normal((L, N, KVH, PS, D)).astype(np.float32)
+    v_all = rng.standard_normal((L, N, KVH, PS, D)).astype(np.float32)
+    T = sum(n for _, n in spans)
+    k_new = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    v_new = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    runs = make_runs(spans, PS)
+    G = len(runs)
+    runs_arr = np.zeros((G, 4), np.int32)
+    runs_arr[:len(runs)] = runs
+    k_out, v_out = run_pallas(k_all, v_all, k_new, v_new, runs_arr,
+                              len(runs), 1, PS)
+    np.testing.assert_allclose(
+        np.asarray(k_out), reference(k_all, k_new, runs, len(runs), 1, PS))

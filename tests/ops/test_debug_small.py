@@ -1,0 +1,19 @@
+import numpy as np
+import pytest
+from tests.ops.test_pallas_attention import build_case, run_both
+
+
+@pytest.mark.parametrize("ps,ppr,hd,maxq", [
+    (4, 16, 16, 8),   # engine e2e config
+    (4, 4, 16, 8),
+    (8, 4, 16, 8),
+    (4, 16, 128, 8),
+    (8, 4, 128, 8),
+])
+def test_small(ps, ppr, hd, maxq):
+    rng = np.random.default_rng(0)
+    case = build_case(rng, seqs=[(5, 5)], page_size=ps, pages_per_req=ppr,
+                      num_q_heads=4, num_kv_heads=2, head_dim=hd,
+                      max_q=maxq)
+    got, want = run_both(case)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
