@@ -48,11 +48,39 @@ class ModelConfig:
             raise ValueError(f"unsupported dtype {self.dtype!r}")
 
     def maybe_load_hf_config(self) -> Any:
-        """Load (and cache) the HF config for the model."""
+        """Load (and cache) the HF config for the model.
+
+        Non-path model names normally resolve via the HF hub; when the hub
+        is unreachable (air-gapped TPU pods, CI) and ``hf_overrides``
+        describes the architecture, fall back to a LlamaConfig built purely
+        from the overrides so dummy-weight runs never need the network.
+        """
         if self.hf_config is None:
-            from transformers import AutoConfig
-            hf_config = AutoConfig.from_pretrained(
-                self.model, trust_remote_code=self.trust_remote_code)
+            try:
+                from transformers import AutoConfig
+                try:
+                    # Local path / populated cache first: skips minutes of
+                    # hub-retry backoff on air-gapped hosts.
+                    hf_config = AutoConfig.from_pretrained(
+                        self.model, trust_remote_code=self.trust_remote_code,
+                        local_files_only=True)
+                except Exception:
+                    hf_config = AutoConfig.from_pretrained(
+                        self.model,
+                        trust_remote_code=self.trust_remote_code)
+            except Exception:
+                # Only fall back when the overrides actually pin down the
+                # architecture — a partial override on top of LlamaConfig
+                # defaults would silently run a different model.
+                required = {"vocab_size", "hidden_size",
+                            "num_hidden_layers", "num_attention_heads"}
+                if not required.issubset(self.hf_overrides):
+                    raise
+                from transformers import LlamaConfig
+                logger.warning(
+                    "could not resolve HF config for %r; building a "
+                    "LlamaConfig from hf_overrides", self.model)
+                hf_config = LlamaConfig()
             for k, v in self.hf_overrides.items():
                 setattr(hf_config, k, v)
             self.hf_config = hf_config

@@ -17,9 +17,16 @@ logger = init_logger(__name__)
 def _load_tokenizer(config: EngineConfig):
     from transformers import AutoTokenizer
     try:
-        return AutoTokenizer.from_pretrained(
-            config.model_config.tokenizer,
-            trust_remote_code=config.model_config.trust_remote_code)
+        try:
+            # Local path / cache first: avoids hub-retry backoff offline.
+            return AutoTokenizer.from_pretrained(
+                config.model_config.tokenizer,
+                trust_remote_code=config.model_config.trust_remote_code,
+                local_files_only=True)
+        except Exception:
+            return AutoTokenizer.from_pretrained(
+                config.model_config.tokenizer,
+                trust_remote_code=config.model_config.trust_remote_code)
     except Exception as e:
         logger.warning("could not load tokenizer %s (%s); token-id I/O only",
                        config.model_config.tokenizer, e)

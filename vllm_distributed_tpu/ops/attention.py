@@ -299,7 +299,12 @@ def paged_attention(
                 q_, k_, v_, batch.seq_info, batch.num_seqs,
                 batch.block_tables, layer, sm_scale=sm_scale,
                 max_q=batch.max_q)
-            return out[..., :head_dim]
+            # Rows the kernel never writes (padding tokens, tile spill past
+            # the last sequence) are uninitialized HBM — possibly NaN/Inf
+            # bit patterns. Zero them so garbage can't propagate through
+            # later layers' projections (padding tokens have slot -1).
+            valid = (batch.slot_mapping >= 0)[:, None, None]
+            return jnp.where(valid, out[..., :head_dim], 0)
 
         from vllm_distributed_tpu.config import MESH_AXIS_MODEL
         from vllm_distributed_tpu.parallel import mesh as mesh_state

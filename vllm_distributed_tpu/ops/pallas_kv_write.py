@@ -105,9 +105,19 @@ def _kernel(
                                          (page_size, 2 * page_size), 0)
         sel = (w_ids == p_ids + shift).astype(jnp.float32)
 
+        # Window rows outside the run hold neighbouring flat-batch tokens
+        # (or padding garbage, possibly NaN/Inf): zero them before the
+        # selection matmul — 0 * NaN = NaN would otherwise poison every
+        # selected row of the page.
+        w_row = jax.lax.broadcasted_iota(jnp.int32, (2 * page_size, 1), 0)
+        w_valid = jnp.logical_and(w_row >= shift + off_start,
+                                  w_row < shift + off_start + run_len)
+
         def shifted(win_ref):
             return jnp.stack([
-                jax.lax.dot(sel, win_ref[h].astype(jnp.float32),
+                jax.lax.dot(sel,
+                            jnp.where(w_valid,
+                                      win_ref[h].astype(jnp.float32), 0.0),
                             preferred_element_type=jnp.float32)
                 for h in range(num_kv_heads)
             ]).astype(k_page.dtype)
