@@ -1,0 +1,66 @@
+"""Precompile warm-up + recompile guard (reference:
+tpu_model_runner.py:1248-1443 precompilation suite and :318
+_update_num_xla_graphs recompile detection)."""
+
+import numpy as np
+import pytest
+
+from tests.engine.test_llm_engine import checkpoint, make_engine  # noqa: F401
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def _runner(engine):
+    return engine.engine_core.executor.worker.model_runner
+
+
+def test_forward_shapes_closed_lattice(checkpoint, monkeypatch):
+    """Every shape mixed traffic can hit is in forward_shapes()."""
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16)
+    r = _runner(engine)
+    shapes = r.forward_shapes()
+    # Decode at every request count and prefill at every token count must
+    # land inside the precomputed lattice.
+    for n_reqs in range(1, r.max_num_reqs + 1):
+        assert r._batch_shape(n_reqs, 1) in shapes
+    for total in range(1, 17):
+        assert r._batch_shape(total, 2) in shapes
+
+
+def test_no_recompile_after_warmup(checkpoint, monkeypatch):
+    """Mixed traffic (ragged prefills, chunked prefill, decode, stops)
+    after precompile() must never compile a new graph."""
+    monkeypatch.setenv("VDT_PRECOMPILE", "1")
+    monkeypatch.setenv("VDT_ASSERT_NO_RECOMPILE", "1")
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=4)
+    rng = np.random.default_rng(0)
+    prompts = [[int(x) for x in rng.integers(2, 127, size=n)]
+               for n in (3, 11, 23, 2, 7)]  # 23 forces chunked prefill
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r{i}", p,
+                           SamplingParams(temperature=0.0,
+                                          max_tokens=4 + i % 3,
+                                          ignore_eos=True))
+    for _ in range(200):
+        engine.step()  # raises RuntimeError on any post-warmup compile
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+
+
+def test_no_recompile_multi_step(checkpoint, monkeypatch):
+    monkeypatch.setenv("VDT_PRECOMPILE", "1")
+    monkeypatch.setenv("VDT_ASSERT_NO_RECOMPILE", "1")
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=4,
+                         num_scheduler_steps=4)
+    for i in range(3):
+        engine.add_request(f"m{i}", [5 + i, 9, 3],
+                           SamplingParams(temperature=0.0, max_tokens=8,
+                                          ignore_eos=True))
+    for _ in range(200):
+        engine.step()
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()

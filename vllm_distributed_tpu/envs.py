@@ -21,6 +21,24 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (debugging / CPU execution).
     "VDT_ATTENTION_BACKEND":
     lambda: os.getenv("VDT_ATTENTION_BACKEND", "auto"),  # auto|pallas|xla
+    # JAX platform to pin before backend init ("auto" = JAX default).
+    # Setting "cpu" defeats a TPU plugin whose init can hang for minutes
+    # on hosts where the chip is tunnelled (reference analogue: the
+    # platforms/ device plumbing; see worker.init_device).
+    "VDT_PLATFORM":
+    lambda: os.getenv("VDT_PLATFORM", "auto"),  # auto|cpu|tpu|...
+    # Seconds the bench harness waits for TPU backend init in its probe
+    # subprocess before falling back to CPU.
+    "VDT_TPU_PROBE_TIMEOUT":
+    lambda: float(os.getenv("VDT_TPU_PROBE_TIMEOUT", "240")),
+    # Precompile the full shape lattice at startup: "auto" = on for
+    # accelerator platforms, off on CPU; "1"/"0" force.
+    "VDT_PRECOMPILE":
+    lambda: os.getenv("VDT_PRECOMPILE", "auto"),
+    # Raise (instead of warn) if a serving step compiles a new XLA graph
+    # after precompile warm-up (recompile-storm guard; used in tests).
+    "VDT_ASSERT_NO_RECOMPILE":
+    lambda: os.getenv("VDT_ASSERT_NO_RECOMPILE", "0") == "1",
     # Run Pallas kernels in interpret mode (CPU tests).
     "VDT_PALLAS_INTERPRET":
     lambda: os.getenv("VDT_PALLAS_INTERPRET", "0") == "1",

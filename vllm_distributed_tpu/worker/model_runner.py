@@ -16,6 +16,7 @@ the TPU variant tpu_model_runner.py:98 (bucketed precompilation
 """
 
 import time
+from contextlib import contextmanager
 from typing import Any, Optional
 
 import jax
@@ -61,20 +62,21 @@ class TPUModelRunner:
         self.token_buckets = make_buckets(
             16, sched_cfg.max_num_batched_tokens)
         self.req_buckets = make_buckets(8, self.max_num_reqs)
-        # Per-sequence query-length buckets for the attention kernel:
-        # 1 (pure decode) then powers of 4 up to the token budget.
-        self.max_q_buckets = [1] + [
-            b for b in make_buckets(8, sched_cfg.max_num_batched_tokens)
-            if b > 1
-        ]
-        # KV-write runs: worst case one partial + the full pages per req.
-        max_runs = (cdiv(sched_cfg.max_num_batched_tokens, self.page_size)
-                    + self.max_num_reqs)
+        # KV-write runs: worst case one partial page per request plus the
+        # full pages the step writes. Padded as a deterministic function of
+        # T (see _batch_shape) so it adds no lattice dimension.
+        max_runs = (cdiv(sched_cfg.max_num_batched_tokens + 128,
+                         self.page_size) + self.max_num_reqs)
         self.kv_run_buckets = make_buckets(8, max_runs)
 
-        self._step_fn = None
+        self._forward_fn = None
+        self._sample_fn = None
         self._rng = np.random.default_rng(config.model_config.seed)
-        self._compiled_shapes: set[tuple[int, int]] = set()
+        # Shapes warmed by precompile(); execute-time compiles outside this
+        # set are recompile-guard violations (reference:
+        # tpu_model_runner.py:318 _update_num_xla_graphs).
+        self._compiled_shapes: set[tuple] = set()
+        self._precompiled = False
 
     # ------------------------------------------------------------------
     def load_model(self) -> None:
@@ -82,18 +84,22 @@ class TPUModelRunner:
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
 
-    def initialize_kv_cache(self, num_pages: int) -> None:
+    def _make_sharded_caches(self, num_pages: int) -> dict:
         from jax.sharding import NamedSharding
-        assert self.model is not None
-        self.num_pages = num_pages
         with self.mesh:
             caches = self.model.make_kv_caches(num_pages, self.page_size)
             specs = self.model.kv_cache_specs()
-            self.kv_caches = jax.tree.map(
+            return jax.tree.map(
                 lambda x, s: jax.device_put(
                     x, NamedSharding(self.mesh, s)), caches, specs,
                 is_leaf=lambda x: isinstance(x, jax.Array))
-        self._build_step_fn()
+
+    def initialize_kv_cache(self, num_pages: int) -> None:
+        assert self.model is not None
+        self.num_pages = num_pages
+        self.kv_caches = self._make_sharded_caches(num_pages)
+        if self._forward_fn is None:
+            self._build_step_fn()
 
     def kv_cache_bytes_per_page(self) -> int:
         from vllm_distributed_tpu.ops.attention import storage_head_dim
@@ -103,19 +109,27 @@ class TPUModelRunner:
                 storage_head_dim(c.head_dim) * itemsize)
 
     def _build_step_fn(self) -> None:
+        """Two jits instead of one: forward (shapes keyed by the token
+        bucket T) and logits+sample (keyed by the sampling-rows bucket R).
+        The split makes the precompile lattice ADDITIVE (|T| + |R| graphs)
+        instead of multiplicative (|T| x |R|) — the TPU answer to the
+        reference's per-shape warm-up suite (tpu_model_runner.py:1248).
+        The [R]-row gather between them runs op-by-op (one XLA gather)."""
         model = self.model
 
-        def step(params, kv_caches, token_ids, batch: AttentionBatch,
-                 logits_indices, sampling_md: SamplingMetadata):
+        def forward(params, kv_caches, token_ids, batch: AttentionBatch):
             hidden, kv_caches = model.forward(params, kv_caches, token_ids,
                                               batch)
-            sel = hidden[logits_indices]
-            logits = model.compute_logits(params, sel)
+            return kv_caches, hidden
+
+        def sample(params, hidden_sel, sampling_md: SamplingMetadata):
+            logits = model.compute_logits(params, hidden_sel)
             tokens, logprobs = sample_tokens(logits, sampling_md)
-            return kv_caches, tokens, logprobs
+            return tokens, logprobs
 
         # Donate the caches: XLA aliases them in place of a copy.
-        self._step_fn = jax.jit(step, donate_argnums=(1, ))
+        self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
+        self._sample_fn = jax.jit(sample)
         self._build_multi_step_fn()
 
     def _build_multi_step_fn(self) -> None:
@@ -170,17 +184,32 @@ class TPUModelRunner:
             self.input_batch.add_request(new_req)
         self.input_batch.update_cached(scheduler_output.scheduled_cached_reqs)
 
+    def _batch_shape(self, total_tokens: int,
+                     max_sched: int) -> tuple[int, int, int]:
+        """Static (T, max_q, G) for a step. ``max_q`` (the per-sequence
+        query bucket of the attention kernel) is 1 for pure decode, else
+        the token bucket itself — the kernel's grid skips tiles past each
+        sequence's q_len at negligible cost, and tying max_q to T keeps
+        the compile lattice one-dimensional. G (KV-write run bucket) is a
+        deterministic function of T for the same reason."""
+        t_bucket = pad_to_bucket(total_tokens, self.token_buckets)
+        max_q = 1 if max_sched <= 1 else t_bucket
+        q_tile = min(max_q, 128)
+        T = t_bucket + q_tile
+        G = pad_to_bucket(cdiv(T, self.page_size) + self.max_num_reqs,
+                          self.kv_run_buckets)
+        return T, max_q, G
+
     def _prepare_inputs(self, scheduler_output: SchedulerOutput):
         """Flatten the scheduled requests into padded per-token arrays."""
         ib = self.input_batch
         num_sched = scheduler_output.num_scheduled_tokens
         total_tokens = scheduler_output.total_num_scheduled_tokens
-        # Static q-length bucket for the Pallas kernel (1 = pure decode);
-        # token arrays carry one extra q tile of padding so a sequence's
-        # final tile may spill past its q_len (see ops/pallas_attention.py).
-        max_q = pad_to_bucket(max(num_sched.values()), self.max_q_buckets)
-        q_tile = min(max_q, 128)
-        T = pad_to_bucket(total_tokens, self.token_buckets) + q_tile
+        # Static shape bucket; token arrays carry one extra q tile of
+        # padding so a sequence's final tile may spill past its q_len
+        # (see ops/pallas_attention.py).
+        T, max_q, G = self._batch_shape(total_tokens,
+                                        max(num_sched.values()))
 
         token_ids = np.zeros((T, ), np.int32)
         positions = np.zeros((T, ), np.int32)
@@ -226,7 +255,6 @@ class TPUModelRunner:
                 logits_idx.append(t + n - 1)
             t += n
 
-        G = pad_to_bucket(max(len(kv_runs), 1), self.kv_run_buckets)
         kv_runs_arr = np.zeros((G, 4), np.int32)
         if kv_runs:
             kv_runs_arr[:len(kv_runs)] = kv_runs
@@ -266,7 +294,7 @@ class TPUModelRunner:
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
-                sampling_req_ids, (T, R))
+                sampling_req_ids, (T, max_q, G), R)
 
     # ------------------------------------------------------------------
     def execute_model(self,
@@ -278,19 +306,16 @@ class TPUModelRunner:
             return self._execute_multi_step(scheduler_output)
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         shape) = self._prepare_inputs(scheduler_output)
+         fwd_shape, R) = self._prepare_inputs(scheduler_output)
 
-        if shape not in self._compiled_shapes:
-            logger.info("compiling step for shape (tokens=%d, reqs=%d)",
-                        *shape)
-            start = time.perf_counter()
         with self.mesh:
-            self.kv_caches, tokens, logprobs = self._step_fn(
-                self.params, self.kv_caches, token_ids, batch,
-                logits_indices, sampling_md)
-        if shape not in self._compiled_shapes:
-            self._compiled_shapes.add(shape)
-            logger.info("compiled in %.1fs", time.perf_counter() - start)
+            with self._compile_watch(("fwd", ) + fwd_shape):
+                self.kv_caches, hidden = self._forward_fn(
+                    self.params, self.kv_caches, token_ids, batch)
+            hidden_sel = self._gather_sample_rows(hidden, logits_indices)
+            with self._compile_watch(("sample", R)):
+                tokens, logprobs = self._sample_fn(self.params, hidden_sel,
+                                                   sampling_md)
 
         tokens_np = np.asarray(jax.device_get(tokens))
         logprobs_np = np.asarray(jax.device_get(logprobs))
@@ -347,20 +372,13 @@ class TPUModelRunner:
             seeds=jnp.asarray(seeds[0]),
         )
 
-        shape = (-n_steps, R)
-        if shape not in self._compiled_shapes:
-            logger.info("compiling multi-step fn (steps=%d, reqs=%d)",
-                        n_steps, R)
-            start = time.perf_counter()
         with self.mesh:
-            self.kv_caches, toks, lps = self._multi_step_fn(
-                self.params, self.kv_caches, jnp.asarray(tok0),
-                jnp.asarray(pos0), jnp.asarray(block_tables), sampling_md,
-                jnp.asarray(seeds),
-                jnp.asarray([num_active], np.int32))
-        if shape not in self._compiled_shapes:
-            self._compiled_shapes.add(shape)
-            logger.info("compiled in %.1fs", time.perf_counter() - start)
+            with self._compile_watch(("multi", n_steps, R)):
+                self.kv_caches, toks, lps = self._multi_step_fn(
+                    self.params, self.kv_caches, jnp.asarray(tok0),
+                    jnp.asarray(pos0), jnp.asarray(block_tables),
+                    sampling_md, jnp.asarray(seeds),
+                    jnp.asarray([num_active], np.int32))
 
         toks_np = np.asarray(jax.device_get(toks))  # [n_steps, R]
         lps_np = np.asarray(jax.device_get(lps))
@@ -379,21 +397,169 @@ class TPUModelRunner:
                                  logprobs=out_lps)
 
     # ------------------------------------------------------------------
+    @contextmanager
+    def _compile_watch(self, key: tuple):
+        """Track/log compilations; after precompile() has run, any new
+        shape is a recompile-guard violation (reference:
+        tpu_model_runner.py:318 _update_num_xla_graphs /
+        _verify_num_xla_graphs)."""
+        new = key not in self._compiled_shapes
+        if new:
+            if self._precompiled:
+                from vllm_distributed_tpu import envs
+                msg = (f"compiling shape {key} AFTER precompile warm-up - "
+                       "the shape lattice is leaking")
+                if envs.VDT_ASSERT_NO_RECOMPILE:
+                    raise RuntimeError(msg)
+                logger.warning(msg)
+            else:
+                logger.info("compiling shape %s", key)
+            start = time.perf_counter()
+        yield
+        if new:
+            self._compiled_shapes.add(key)
+            logger.info("compiled %s in %.1fs", key,
+                        time.perf_counter() - start)
+
+    def _gather_sample_rows(self, hidden, logits_indices):
+        """[R]-row gather between the forward and sample jits, committed to
+        a REPLICATED sharding: jax.jit keys its cache on input sharding, so
+        the sampler must see the same sharding at warm-up and serving or
+        every ('sample', R) shape would recompile on a >1-device mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        sel = hidden[logits_indices]
+        return jax.device_put(sel, NamedSharding(self.mesh,
+                                                 PartitionSpec()))
+
+    def _dummy_step_inputs(self, T: int, max_q: int, G: int):
+        """Inert inputs for one forward at shape (T, max_q, G): padding
+        slots (-1) and zero run/seq counts make every write a no-op."""
+        batch = AttentionBatch(
+            req_idx=jnp.zeros((T, ), jnp.int32),
+            positions=jnp.zeros((T, ), jnp.int32),
+            slot_mapping=jnp.full((T, ), -1, jnp.int32),
+            block_tables=jnp.zeros(
+                (self.max_num_reqs, self.max_pages_per_req), jnp.int32),
+            seq_lens=jnp.zeros((self.max_num_reqs, ), jnp.int32),
+            seq_info=jnp.zeros((self.max_num_reqs, 4), jnp.int32),
+            num_seqs=jnp.zeros((1, ), jnp.int32),
+            kv_runs=jnp.zeros((G, 4), jnp.int32),
+            num_kv_runs=jnp.zeros((1, ), jnp.int32),
+            max_q=max_q,
+        )
+        return jnp.zeros((T, ), jnp.int32), batch
+
+    def forward_shapes(self) -> set[tuple[int, int, int]]:
+        """Every (T, max_q, G) the runner can present: decode shapes (one
+        per request bucket) plus prefill/mixed shapes (one per token
+        bucket)."""
+        shapes = set()
+        for r in self.req_buckets:
+            shapes.add(self._batch_shape(r, 1))
+        for t in self.token_buckets:
+            shapes.add(self._batch_shape(t, 2))
+        return shapes
+
     def precompile(self) -> None:
-        """Warm the (T, R) lattice ahead of serving (reference:
-        tpu_model_runner.py:1248 precompilation suite). Compiles the
-        smallest and largest shapes; the rest compile on demand."""
-        pass
+        """Warm every step graph before serving (reference:
+        tpu_model_runner.py:1248-1443 precompilation suite): all forward
+        shapes, all sampler shapes, and the fused multi-step graph. After
+        this, a compile during serving is a bug (_compile_watch)."""
+        assert self.kv_caches is not None, "initialize_kv_cache first"
+        start = time.perf_counter()
+        n = 0
+        with self.mesh:
+            for T, max_q, G in sorted(self.forward_shapes()):
+                token_ids, batch = self._dummy_step_inputs(T, max_q, G)
+                with self._compile_watch(("fwd", T, max_q, G)):
+                    self.kv_caches, hidden = self._forward_fn(
+                        self.params, self.kv_caches, token_ids, batch)
+                jax.block_until_ready(hidden)
+                n += 1
+            for R in self.req_buckets:
+                md = SamplingMetadata(
+                    temperature=jnp.zeros((R, ), jnp.float32),
+                    top_k=jnp.zeros((R, ), jnp.int32),
+                    top_p=jnp.ones((R, ), jnp.float32),
+                    min_p=jnp.zeros((R, ), jnp.float32),
+                    seeds=jnp.zeros((R, ), jnp.int64),
+                )
+                hidden_sel = self._gather_sample_rows(
+                    jnp.zeros((R, self.model.cfg.hidden_size),
+                              self.model.cfg.dtype),
+                    jnp.arange(R, dtype=jnp.int32))
+                with self._compile_watch(("sample", R)):
+                    tokens, _ = self._sample_fn(self.params, hidden_sel, md)
+                jax.block_until_ready(tokens)
+                n += 1
+            n_steps = self.config.scheduler_config.num_scheduler_steps
+            if n_steps > 1:
+                for R in self.req_buckets:
+                    self._precompile_multi_step(n_steps, R)
+                    n += 1
+        self._precompiled = True
+        logger.info("precompiled %d graphs in %.1fs", n,
+                    time.perf_counter() - start)
+
+    def _precompile_multi_step(self, n_steps: int, R: int) -> None:
+        md = SamplingMetadata(
+            temperature=jnp.zeros((R, ), jnp.float32),
+            top_k=jnp.zeros((R, ), jnp.int32),
+            top_p=jnp.ones((R, ), jnp.float32),
+            min_p=jnp.zeros((R, ), jnp.float32),
+            seeds=jnp.zeros((R, ), jnp.int64),
+        )
+        with self._compile_watch(("multi", n_steps, R)):
+            self.kv_caches, toks, _ = self._multi_step_fn(
+                self.params, self.kv_caches, jnp.zeros((R, ), jnp.int32),
+                jnp.zeros((R, ), jnp.int32),
+                jnp.zeros((R, self.max_pages_per_req), jnp.int32), md,
+                jnp.zeros((n_steps, R), jnp.int64),
+                jnp.zeros((1, ), jnp.int32))
+        jax.block_until_ready(toks)
 
     def profile_memory_bytes(self) -> int:
-        """Bytes of HBM available for KV pages after weights."""
+        """Bytes of HBM available for KV pages, from a MEASURED peak: run
+        the largest-shape forward against a small scratch cache and read
+        the device's peak allocation (weights + real activation/workspace
+        footprint), mirroring the reference's profile run
+        (gpu_worker.py:200, tpu_worker.py:163). Returns 0 when the
+        platform exposes no memory stats (CPU tests)."""
         try:
-            stats = jax.local_devices()[0].memory_stats()
-            limit = stats.get("bytes_limit")
-            in_use = stats.get("bytes_in_use")
-            if limit:
-                util = self.config.cache_config.gpu_memory_utilization
-                return max(int(limit * util) - int(in_use or 0), 0)
+            dev = next(iter(self.mesh.devices.flat))
+            stats = dev.memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
         except Exception:  # pragma: no cover - platform specific
-            pass
-        return 0
+            return 0
+        if not limit:
+            return 0
+        util = self.config.cache_config.gpu_memory_utilization
+        try:
+            peak = self._profile_peak_bytes(dev)
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("profile run failed (%s); using current usage",
+                           e)
+            peak = int(stats.get("peak_bytes_in_use",
+                                 stats.get("bytes_in_use", 0)))
+        return max(int(limit * util) - peak, 0)
+
+    def _profile_peak_bytes(self, dev) -> int:
+        """Execute the largest forward shape with a 16-page scratch cache
+        and return the device peak bytes."""
+        assert self.model is not None
+        scratch = self._make_sharded_caches(16)
+        if self._forward_fn is None:
+            self._build_step_fn()
+        T, max_q, G = max(self.forward_shapes())
+        token_ids, batch = self._dummy_step_inputs(T, max_q, G)
+        with self.mesh:
+            scratch, hidden = self._forward_fn(self.params, scratch,
+                                               token_ids, batch)
+            jax.block_until_ready(hidden)
+        del scratch, hidden
+        stats = dev.memory_stats() or {}
+        peak = int(stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0)))
+        logger.info("profiled peak HBM (weights + workspace): %.2f GiB",
+                    peak / 2**30)
+        return peak

@@ -34,6 +34,16 @@ class TPUWorker:
 
     # ------------------------------------------------------------------
     def init_device(self) -> None:
+        from vllm_distributed_tpu import envs
+        platform = envs.VDT_PLATFORM
+        if platform != "auto":
+            # Pin before any backend initializes: a bare jax.devices() lets
+            # every installed plugin init, and a tunnelled TPU plugin can
+            # block for minutes on non-TPU hosts.
+            try:
+                jax.config.update("jax_platforms", platform)
+            except Exception as e:  # pragma: no cover - jax internals
+                logger.warning("could not pin platform %r: %s", platform, e)
         devices = jax.devices()
         logger.info("devices: %s", devices)
         self.mesh = build_mesh(self.config.parallel_config, devices)
@@ -44,10 +54,10 @@ class TPUWorker:
         self.model_runner.load_model()
 
     def determine_num_available_blocks(self) -> int:
-        """Size the KV pool from device HBM after weights are resident
-        (reference: gpu_worker.py:200 profiles a forward pass; here the
-        jitted step's workspace is small and bounded by the bucket sizes,
-        so a fixed headroom fraction suffices)."""
+        """Size the KV pool from measured HBM after a profiled dummy
+        forward at the largest token shape (reference: gpu_worker.py:200
+        determine_available_memory runs profile_run before reading free
+        memory; TPU variant tpu_worker.py:163)."""
         override = self.config.cache_config.num_gpu_blocks_override
         if override:
             return override
@@ -60,8 +70,7 @@ class TPUWorker:
                      max(self.config.scheduler_config.max_num_seqs // 4, 4))
             logger.info("no memory stats; defaulting to %d KV pages", pages)
             return max(pages, _MIN_PAGES)
-        # Keep 10% slack below the utilization target for workspace.
-        pages = int(avail * 0.9) // page_bytes
+        pages = avail // page_bytes
         logger.info("HBM for KV: %.2f GiB -> %d pages of %d bytes",
                     avail / 2**30, pages, page_bytes)
         return max(pages, _MIN_PAGES)
@@ -70,6 +79,13 @@ class TPUWorker:
         self.model_runner.initialize_kv_cache(num_pages)
 
     def compile_or_warm_up_model(self) -> None:
+        from vllm_distributed_tpu import envs
+        mode = envs.VDT_PRECOMPILE
+        if mode == "0":
+            return
+        platform = next(iter(self.mesh.devices.flat)).platform
+        if mode == "auto" and platform == "cpu":
+            return  # lazy compiles are cheap on the CPU test mesh
         self.model_runner.precompile()
 
     # ------------------------------------------------------------------
