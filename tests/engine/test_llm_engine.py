@@ -47,9 +47,13 @@ def hf_greedy(hf, prompt, n):
     return out[0].tolist()[len(prompt):]
 
 
+_RUN_COUNTER = [0]
+
+
 def run_engine(engine, prompts, sps):
+    _RUN_COUNTER[0] += 1
     for i, (p, sp) in enumerate(zip(prompts, sps)):
-        engine.add_request(f"t{engine.engine_core.scheduler.num_scheduled_steps}-{i}", p, sp)
+        engine.add_request(f"t{_RUN_COUNTER[0]}-{i}", p, sp)
     done = {}
     for _ in range(500):
         for out in engine.step():

@@ -1,0 +1,21 @@
+"""CLI smoke tests (reference: vllm/entrypoints/cli/main.py:23 `vllm
+serve|bench`)."""
+
+import json
+
+from tests.engine.test_llm_engine import checkpoint  # noqa: F401
+from vllm_distributed_tpu.entrypoints.cli.main import main
+
+
+def test_bench_latency_smoke(checkpoint, capsys):
+    path, _ = checkpoint
+    rc = main(["bench", "latency", "--model", path, "--dtype", "float32",
+               "--block-size", "4", "--num-gpu-blocks-override", "128",
+               "--max-model-len", "64", "--max-num-batched-tokens", "64",
+               "--max-num-seqs", "8", "--input-len", "4",
+               "--output-len", "4", "--num-prompts", "2", "--warmup", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert result["generated_tokens"] == 8
+    assert result["tokens_per_s"] > 0

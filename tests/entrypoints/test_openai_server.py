@@ -1,0 +1,185 @@
+"""OpenAI-server HTTP tests over a live aiohttp server + tiny checkpoint
+(reference pattern: tests/utils.py:74 RemoteOpenAIServer speaking real
+HTTP to a served model)."""
+
+import asyncio
+import json
+import threading
+
+import httpx
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.utils import get_open_port
+
+VOCAB = 128
+
+
+def _save_checkpoint_with_tokenizer(path) -> HFLlama:
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    hf.save_pretrained(path, safe_serialization=True)
+
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(VOCAB - 2)}
+    vocab["<unk>"] = VOCAB - 2
+    vocab["</s>"] = 1
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token="<unk>", eos_token="</s>")
+    fast.save_pretrained(path)
+    return hf
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tiny_served"))
+    hf = _save_checkpoint_with_tokenizer(path)
+
+    engine_args = EngineArgs(model=path, dtype="float32", block_size=4,
+                             num_gpu_blocks_override=128, max_model_len=64,
+                             max_num_batched_tokens=64, max_num_seqs=8)
+    engine = AsyncLLM(engine_args.create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    stop_holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        stop_holder["stop"] = stop
+        stop_holder["loop"] = loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready, stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120), "server did not start"
+    yield f"http://127.0.0.1:{port}", hf
+    stop_holder["loop"].call_soon_threadsafe(stop_holder["stop"].set)
+    t.join(timeout=30)
+
+
+def hf_greedy(hf, prompt_ids, n):
+    with torch.no_grad():
+        out = hf.generate(torch.tensor([prompt_ids]), max_new_tokens=n,
+                          do_sample=False, eos_token_id=None)
+    return out[0].tolist()[len(prompt_ids):]
+
+
+def test_health_and_models(server):
+    base, _ = server
+    assert httpx.get(f"{base}/health", timeout=30).status_code == 200
+    models = httpx.get(f"{base}/v1/models", timeout=30).json()
+    assert models["object"] == "list" and len(models["data"]) == 1
+
+
+def test_completion_token_parity(server):
+    base, hf = server
+    prompt = "w3 w17 w92 w45 w8"
+    prompt_ids = [3, 17, 92, 45, 8]
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": prompt, "max_tokens": 6,
+        "temperature": 0.0, "ignore_eos": True,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    want = hf_greedy(hf, prompt_ids, 6)
+    got_text = body["choices"][0]["text"]
+    assert got_text.split() == [f"w{t}" for t in want]
+    assert body["usage"]["prompt_tokens"] == 5
+    assert body["usage"]["completion_tokens"] == 6
+    assert body["choices"][0]["finish_reason"] == "length"
+
+
+def test_completion_streaming_matches_nonstream(server):
+    base, _ = server
+    req = {"model": "tiny", "prompt": "w9 w8 w7", "max_tokens": 8,
+           "temperature": 0.0, "ignore_eos": True}
+    full = httpx.post(f"{base}/v1/completions", timeout=300,
+                      json=req).json()["choices"][0]["text"]
+    chunks = []
+    with httpx.stream("POST", f"{base}/v1/completions", timeout=300,
+                      json=dict(req, stream=True)) as r:
+        assert r.headers["content-type"].startswith("text/event-stream")
+        for line in r.iter_lines():
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunks.append(json.loads(payload))
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert text == full
+    assert len(chunks) >= 2, "streaming must deliver incremental chunks"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_completion_n_gt_1(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w5 w6", "n": 2, "max_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True,
+    }).json()
+    assert len(r["choices"]) == 2
+    assert [c["index"] for c in r["choices"]] == [0, 1]
+    # Greedy: both samples identical.
+    assert r["choices"][0]["text"] == r["choices"][1]["text"]
+
+
+def test_chat_completion(server):
+    base, _ = server
+    req = {"model": "tiny",
+           "messages": [{"role": "user", "content": "w11 w12"}],
+           "max_tokens": 4, "temperature": 0.0, "ignore_eos": True}
+    r = httpx.post(f"{base}/v1/chat/completions", timeout=300, json=req)
+    assert r.status_code == 200, r.text
+    body = r.json()
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert msg["content"]
+    # Streaming variant assembles to the same content.
+    deltas = []
+    with httpx.stream("POST", f"{base}/v1/chat/completions", timeout=300,
+                      json=dict(req, stream=True)) as s:
+        for line in s.iter_lines():
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            c = json.loads(payload)["choices"][0]
+            deltas.append(c["delta"].get("content", ""))
+    assert "".join(deltas) == msg["content"]
+
+
+def test_validation_errors(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=30, json={
+        "model": "tiny", "max_tokens": 4})
+    assert r.status_code == 400
+    assert r.json()["error"]["type"] == "invalid_request_error"
+    r = httpx.post(f"{base}/v1/completions", timeout=30, json={
+        "model": "tiny", "prompt": "w1", "temperature": -1.0})
+    assert r.status_code == 400
+
+
+def test_metrics_endpoint(server):
+    base, _ = server
+    r = httpx.get(f"{base}/metrics", timeout=60)
+    assert r.status_code == 200
+    assert "vdt:num_requests_running" in r.text
+    assert "vdt:prefix_cache_hits_total" in r.text

@@ -17,6 +17,7 @@ from vllm_distributed_tpu.version import __version__
 __all__ = [
     "__version__",
     "LLM",
+    "AsyncLLM",
     "SamplingParams",
     "EngineArgs",
 ]
@@ -27,6 +28,9 @@ def __getattr__(name: str):
     if name == "LLM":
         from vllm_distributed_tpu.entrypoints.llm import LLM
         return LLM
+    if name == "AsyncLLM":
+        from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+        return AsyncLLM
     if name == "SamplingParams":
         from vllm_distributed_tpu.sampling_params import SamplingParams
         return SamplingParams

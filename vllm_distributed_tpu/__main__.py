@@ -1,0 +1,9 @@
+"""`python -m vllm_distributed_tpu serve|bench ...` (reference: the
+`vllm` console script -> entrypoints/cli/main.py:23)."""
+
+import sys
+
+from vllm_distributed_tpu.entrypoints.cli.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

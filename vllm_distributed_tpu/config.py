@@ -177,6 +177,9 @@ class ParallelConfig:
     data_parallel_size: int = 1
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    # Run the engine core (scheduler + executor busy loop) in its own
+    # process with ZMQ transport (reference: EngineCoreProc, core.py:362).
+    multiprocess_engine_core: bool = False
     # Multi-host: processes per pod slice (jax.distributed).
     distributed_init_method: Optional[str] = None
 

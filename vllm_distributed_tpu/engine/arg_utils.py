@@ -36,6 +36,7 @@ class EngineArgs:
     data_parallel_size: int = 1
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    multiprocess_engine_core: bool = False
 
     max_num_batched_tokens: int = 8192
     max_num_seqs: int = 256
@@ -79,6 +80,7 @@ class EngineArgs:
                 data_parallel_size=self.data_parallel_size,
                 token_parallel_size=self.token_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
+                multiprocess_engine_core=self.multiprocess_engine_core,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,
@@ -109,15 +111,18 @@ class EngineArgs:
     def add_cli_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
         for f in fields(EngineArgs):
             name = "--" + f.name.replace("_", "-")
-            if f.type in ("bool", bool):
+            # f.type may be the annotation object or its string form
+            # depending on `from __future__ import annotations`.
+            ts = f.type if isinstance(f.type, str) else str(f.type)
+            if ts in ("bool", str(bool)) or "bool" in ts:
                 parser.add_argument(name,
                                     action=argparse.BooleanOptionalAction,
                                     default=f.default)
             else:
                 typ = str
-                if f.type in ("int", int, "Optional[int]"):
+                if "int" in ts:
                     typ = int
-                elif f.type in ("float", float):
+                elif "float" in ts:
                     typ = float
                 parser.add_argument(name, type=typ, default=f.default)
         return parser

@@ -1,0 +1,198 @@
+"""Async engine for online serving.
+
+Reference: vllm/v1/engine/async_llm.py:46 (``AsyncLLM``: generate :277
+returning an async generator fed by per-request output queues, background
+output handler :361, errored/dead_error :621). TPU-native differences:
+the engine core runs either on a daemon thread (single process) or in an
+EngineCoreProc subprocess (ZMQ); a pump thread marshals output batches
+into the asyncio loop with call_soon_threadsafe — the GIL-friendly
+equivalent of the reference's asyncio socket handler.
+"""
+
+import asyncio
+import threading
+from typing import AsyncGenerator, Optional, Union
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.core_client import (EngineDeadError,
+                                                     SyncMPClient)
+from vllm_distributed_tpu.engine.core_proc import BackgroundEngineCore
+from vllm_distributed_tpu.engine.llm_engine import _load_tokenizer
+from vllm_distributed_tpu.engine.output_processor import OutputProcessor
+from vllm_distributed_tpu.engine.processor import Processor
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.outputs import RequestOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+# Sentinel delivered to a generate() consumer whose request was aborted
+# out-of-band (AsyncLLM.abort): ends the stream without an error.
+_ABORTED = object()
+
+
+class AsyncLLM:
+
+    def __init__(self, config: EngineConfig, tokenizer=None, *,
+                 load_tokenizer: bool = True) -> None:
+        self.config = config
+        config.model_config.maybe_load_hf_config()
+        if tokenizer is None and load_tokenizer:
+            tokenizer = _load_tokenizer(config)
+        self.tokenizer = tokenizer
+        self.processor = Processor(config, tokenizer)
+        self.output_processor = OutputProcessor(config, tokenizer)
+
+        from vllm_distributed_tpu import envs
+        if config.parallel_config.multiprocess_engine_core or \
+                envs.VDT_ENABLE_MP_ENGINE:
+            self.core = SyncMPClient(config)
+        else:
+            self.core = BackgroundEngineCore(config)
+
+        self.request_queues: dict[str, asyncio.Queue] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pump: Optional[threading.Thread] = None
+        self._stopped = False
+        self._dead_error: Optional[Exception] = None
+
+    @classmethod
+    def from_engine_args(cls, engine_args) -> "AsyncLLM":
+        return cls(engine_args.create_engine_config())
+
+    # ------------------------------------------------------------------
+    @property
+    def errored(self) -> bool:
+        return self._dead_error is not None
+
+    @property
+    def dead_error(self) -> Exception:
+        return self._dead_error or EngineDeadError("engine is dead")
+
+    def _ensure_pump(self) -> None:
+        if self._pump is not None:
+            return
+        self._loop = asyncio.get_running_loop()
+        self._pump = threading.Thread(target=self._pump_outputs,
+                                      daemon=True, name="output-pump")
+        self._pump.start()
+
+    def _pump_outputs(self) -> None:
+        """Blocking-side reader: ships each output batch into the event
+        loop (reference: async_llm.py:361 _run_output_handler)."""
+        while not self._stopped:
+            try:
+                outs = self._blocking_recv(timeout_s=0.2)
+            except Exception as e:  # noqa: BLE001 - engine died
+                self._loop.call_soon_threadsafe(self._fail_all, e)
+                return
+            if outs:
+                self._loop.call_soon_threadsafe(self._process_batch, outs)
+
+    def _blocking_recv(self, timeout_s: float):
+        if isinstance(self.core, BackgroundEngineCore):
+            import queue
+            try:
+                item = self.core.output_queue.get(timeout=timeout_s)
+            except queue.Empty:
+                return None
+            if isinstance(item, Exception):
+                raise item
+            return item
+        return self.core.recv_outputs(timeout_ms=int(timeout_s * 1000))
+
+    def _process_batch(self, core_outputs) -> None:
+        processed = self.output_processor.process_outputs(core_outputs)
+        if processed.reqs_to_abort:
+            try:
+                self.core.abort_requests(processed.reqs_to_abort)
+            except Exception:  # noqa: BLE001 - core racing shutdown
+                pass
+        for ro in processed.request_outputs:
+            q = self.request_queues.get(ro.request_id)
+            if q is None:
+                continue
+            q.put_nowait(ro)
+            if ro.finished:
+                self.request_queues.pop(ro.request_id, None)
+
+    def _fail_all(self, err: Exception) -> None:
+        self._dead_error = err
+        logger.error("engine core died: %s", err)
+        for q in self.request_queues.values():
+            q.put_nowait(err)
+        self.request_queues.clear()
+
+    # ------------------------------------------------------------------
+    async def generate(
+        self,
+        prompt: Union[str, list[int]],
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+        priority: int = 0,
+    ) -> AsyncGenerator[RequestOutput, None]:
+        """Async stream of accumulated RequestOutputs for one request
+        (reference: async_llm.py:277)."""
+        if self._dead_error is not None:
+            raise self._dead_error
+        self._ensure_pump()
+        if request_id is None:
+            from vllm_distributed_tpu.utils import random_uuid
+            request_id = random_uuid()
+        sampling_params = sampling_params or SamplingParams()
+        core_req = self.processor.process_inputs(request_id, prompt,
+                                                 sampling_params,
+                                                 priority=priority)
+        queue: asyncio.Queue = asyncio.Queue()
+        self.request_queues[request_id] = queue
+        self.output_processor.add_request(
+            core_req, prompt=prompt if isinstance(prompt, str) else None)
+        self.core.add_request(core_req)
+        try:
+            while True:
+                item = await queue.get()
+                if item is _ABORTED:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            if self.request_queues.pop(request_id, None) is not None:
+                # Consumer cancelled / errored mid-stream: abort upstream.
+                self.output_processor.abort_requests([request_id])
+                try:
+                    self.core.abort_requests([request_id])
+                except Exception:  # noqa: BLE001
+                    pass
+
+    async def abort(self, request_id: str) -> None:
+        q = self.request_queues.pop(request_id, None)
+        if q is not None:
+            # Wake any generate() consumer blocked on this queue.
+            q.put_nowait(_ABORTED)
+        self.output_processor.abort_requests([request_id])
+        self.core.abort_requests([request_id])
+
+    async def get_stats(self) -> dict:
+        if isinstance(self.core, BackgroundEngineCore):
+            return self.core.core.get_stats()
+        # MP core: the pump thread owns the output socket; poll for the
+        # stashed result.
+        call_id = self.core.send_utility("get_stats")
+        sentinel = object()
+        for _ in range(500):
+            value = self.core.fetch_result(call_id, sentinel)
+            if value is not sentinel:
+                if isinstance(value, Exception):
+                    raise value
+                return value
+            await asyncio.sleep(0.02)
+        raise TimeoutError("get_stats RPC timed out")
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        if self._pump is not None:
+            self._pump.join(timeout=5)
+        self.core.shutdown()

@@ -1,0 +1,188 @@
+"""Engine core as a subprocess: ZMQ transport + busy loop.
+
+Reference: vllm/v1/engine/core.py:362 (``EngineCoreProc``: run_busy_loop
+:598, _process_input_queue :608, _send_engine_dead :679). The TPU variant
+keeps the same actor shape — requests in over one socket, outputs out over
+another, a ready handshake, and a dead sentinel — with msgpack instead of
+msgspec and a single-threaded poll loop (the GIL-heavy input/output
+threads of the reference buy nothing under an in-process XLA dispatch).
+"""
+
+import queue
+import signal
+import threading
+import time
+import traceback
+
+import zmq
+
+from vllm_distributed_tpu.engine import serial
+from vllm_distributed_tpu.engine.core import EngineCore
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Poll interval while idle (ms); while busy the input queue is drained
+# without blocking between steps.
+_IDLE_POLL_MS = 100
+
+
+def run_engine_core(config, input_addr: str, output_addr: str) -> None:
+    """Subprocess entry: build the core, handshake, busy-loop until a
+    shutdown message (or parent death) arrives."""
+    # Die cleanly with the parent instead of leaking the device.
+    signal.signal(signal.SIGTERM, lambda *_: _raise_shutdown())
+
+    ctx = zmq.Context()
+    inp = ctx.socket(zmq.PULL)
+    inp.connect(input_addr)
+    out = ctx.socket(zmq.PUSH)
+    out.connect(output_addr)
+
+    core = None
+    try:
+        core = EngineCore(config)
+        out.send(serial.pack({
+            "t": "ready",
+            "num_pages": config.cache_config.num_gpu_blocks,
+        }))
+        _busy_loop(core, inp, out)
+    except _Shutdown:
+        pass
+    except Exception as e:  # noqa: BLE001 - report then die
+        logger.error("engine core died: %s", e)
+        traceback.print_exc()
+        try:
+            out.send(serial.pack({
+                "t": "dead",
+                "error": f"{type(e).__name__}: {e}",
+            }))
+            time.sleep(0.2)  # let the sentinel flush
+        except Exception:
+            pass
+    finally:
+        if core is not None:
+            core.shutdown()
+        inp.close(linger=0)
+        out.close(linger=0)
+        ctx.term()
+
+
+class _Shutdown(Exception):
+    pass
+
+
+def _raise_shutdown() -> None:
+    raise _Shutdown()
+
+
+def _handle_msg(core: EngineCore, out: zmq.Socket, msg: dict) -> None:
+    t = msg["t"]
+    if t == "add":
+        core.add_request(serial.decode_request(msg["req"]))
+    elif t == "abort":
+        core.abort_requests(list(msg["ids"]))
+    elif t == "call":
+        # Generic utility RPC (get_stats, profiling hooks, ...). A bad
+        # RPC must not take the core (and every in-flight request) down:
+        # failures travel back as an error result.
+        try:
+            value = getattr(core, msg["method"])(*msg.get("args", ()))
+            reply = {"t": "result", "call_id": msg["call_id"],
+                     "value": value}
+            out.send(serial.pack(reply))
+        except Exception as e:  # noqa: BLE001 - reported to caller
+            logger.warning("utility RPC %s failed: %s", msg["method"], e)
+            out.send(serial.pack({
+                "t": "result", "call_id": msg["call_id"], "value": None,
+                "error": f"{type(e).__name__}: {e}",
+            }))
+    elif t == "shutdown":
+        raise _Shutdown()
+    else:  # pragma: no cover - protocol error
+        raise ValueError(f"unknown message type {t!r}")
+
+
+def _busy_loop(core: EngineCore, inp: zmq.Socket, out: zmq.Socket) -> None:
+    """reference: core.py:598 run_busy_loop — block on input when idle,
+    otherwise drain input without blocking and step."""
+    poller = zmq.Poller()
+    poller.register(inp, zmq.POLLIN)
+    while True:
+        timeout = 0 if core.has_unfinished_requests() else _IDLE_POLL_MS
+        while poller.poll(timeout):
+            _handle_msg(core, out, serial.unpack(inp.recv()))
+            timeout = 0
+        if not core.has_unfinished_requests():
+            continue
+        outputs = core.step()
+        if outputs:
+            out.send(serial.pack({
+                "t": "outputs",
+                "outs": [serial.encode_output(o) for o in outputs],
+            }))
+
+
+# ---------------------------------------------------------------------------
+# In-process background core (thread) — used by AsyncLLM when a subprocess
+# is unnecessary; shares run-loop semantics with the proc variant.
+# ---------------------------------------------------------------------------
+
+
+class BackgroundEngineCore:
+    """EngineCore driven by a daemon thread with queue transport.
+
+    Same contract as the ZMQ proc (add/abort in, output batches out) for
+    single-process async serving; reference analogue: the in-process
+    core_client InprocClient paired with AsyncLLM's output handler.
+    """
+
+    def __init__(self, config) -> None:
+        self.core = EngineCore(config)
+        self.input_queue: "queue.Queue[tuple]" = queue.Queue()
+        self.output_queue: "queue.Queue[object]" = queue.Queue()
+        self._dead = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="engine-core")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while True:
+                block = not self.core.has_unfinished_requests()
+                try:
+                    while True:
+                        kind, payload = self.input_queue.get(
+                            block=block, timeout=None if block else 0)
+                        if kind == "add":
+                            self.core.add_request(payload)
+                        elif kind == "abort":
+                            self.core.abort_requests(payload)
+                        elif kind == "shutdown":
+                            return
+                        block = False
+                except queue.Empty:
+                    pass
+                outputs = self.core.step()
+                if outputs:
+                    self.output_queue.put(outputs)
+        except Exception as e:  # noqa: BLE001
+            logger.error("background engine core died: %s", e)
+            traceback.print_exc()
+            self._dead = True
+            self.output_queue.put(e)
+
+    @property
+    def is_alive(self) -> bool:
+        return self._thread.is_alive() and not self._dead
+
+    def add_request(self, req) -> None:
+        self.input_queue.put(("add", req))
+
+    def abort_requests(self, ids: list[str]) -> None:
+        self.input_queue.put(("abort", ids))
+
+    def shutdown(self) -> None:
+        self.input_queue.put(("shutdown", None))
+        self._thread.join(timeout=5)
+        self.core.shutdown()

@@ -28,33 +28,39 @@ class IncrementalDetokenizer:
                                  default=0)
         self.token_ids: list[int] = []
         self.output_text = ""
-        # Decoded-but-unstable tail start index into token_ids.
-        self._stable_len = 0
-        self._stable_text = ""
+        # Prefix/read-offset incremental decode (reference:
+        # detokenize_incrementally): text is emitted as the difference
+        # between decoding [prefix:] and [prefix:read] — decoding tail
+        # segments independently would drop the separators a tokenizer
+        # inserts BETWEEN tokens (spaces in word-level/SentencePiece).
+        self._prefix_offset = 0
+        self._read_offset = 0
 
     def update(self, new_token_ids: list[int]) -> Optional[str]:
         """Append tokens; returns the stop string hit, if any."""
         if self.tokenizer is None:
             return None
         self.token_ids.extend(new_token_ids)
-        # Decode the unstable tail plus one extra token of context.
-        tail = self.token_ids[self._stable_len:]
-        text_tail = self.tokenizer.decode(
-            tail, skip_special_tokens=self.skip_special_tokens)
-        # A tail ending in the unicode replacement char may be a split
+        prefix_text = self.tokenizer.decode(
+            self.token_ids[self._prefix_offset:self._read_offset],
+            skip_special_tokens=self.skip_special_tokens)
+        full_text = self.tokenizer.decode(
+            self.token_ids[self._prefix_offset:],
+            skip_special_tokens=self.skip_special_tokens)
+        # A window ending in the unicode replacement char may be a split
         # multi-byte sequence: hold it back until completed.
-        if text_tail.endswith("�"):
-            self.output_text = self._stable_text + text_tail
-        else:
-            self._stable_text = self._stable_text + text_tail
-            self._stable_len = len(self.token_ids)
-            self.output_text = self._stable_text
+        if len(full_text) <= len(prefix_text) or full_text.endswith("�"):
+            return None
+        new_text = full_text[len(prefix_text):]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self.token_ids)
+        self.output_text += new_text
 
         if self.stop_strings:
             # Scan only the recently-produced region.
             window_start = max(
                 0,
-                len(self.output_text) - len(text_tail) - self._max_stop_len)
+                len(self.output_text) - len(new_text) - self._max_stop_len)
             window = self.output_text[window_start:]
             for stop in self.stop_strings:
                 idx = window.find(stop)
@@ -64,6 +70,22 @@ class IncrementalDetokenizer:
                         self.output_text[:window_start + idx]
                     return stop
         return None
+
+    def flush(self) -> None:
+        """Emit any held-back tail at end of generation (text withheld by
+        update() because it ended in a split multi-byte sequence)."""
+        if self.tokenizer is None or self._read_offset >= len(
+                self.token_ids):
+            return
+        prefix_text = self.tokenizer.decode(
+            self.token_ids[self._prefix_offset:self._read_offset],
+            skip_special_tokens=self.skip_special_tokens)
+        full_text = self.tokenizer.decode(
+            self.token_ids[self._prefix_offset:],
+            skip_special_tokens=self.skip_special_tokens)
+        if len(full_text) > len(prefix_text):
+            self.output_text += full_text[len(prefix_text):]
+        self._prefix_offset = self._read_offset = len(self.token_ids)
 
     def get_next_output_text(self, prev_len: int) -> str:
         """Delta since the caller's last read."""

@@ -4,7 +4,7 @@ add_request -> step -> RequestOutput)."""
 from typing import Optional, Union
 
 from vllm_distributed_tpu.config import EngineConfig
-from vllm_distributed_tpu.engine.core import EngineCore
+from vllm_distributed_tpu.engine.core_client import EngineCoreClient
 from vllm_distributed_tpu.engine.output_processor import OutputProcessor
 from vllm_distributed_tpu.engine.processor import Processor
 from vllm_distributed_tpu.logger import init_logger
@@ -44,7 +44,7 @@ class LLMEngine:
         self.tokenizer = tokenizer
         self.processor = Processor(config, tokenizer)
         self.output_processor = OutputProcessor(config, tokenizer)
-        self.engine_core = EngineCore(config)
+        self.engine_core = EngineCoreClient.make_client(config)
 
     @classmethod
     def from_engine_args(cls, engine_args) -> "LLMEngine":
@@ -71,7 +71,7 @@ class LLMEngine:
         self.engine_core.abort_requests(request_ids)
 
     def step(self) -> list[RequestOutput]:
-        core_outputs = self.engine_core.step()
+        core_outputs = self.engine_core.get_output()
         processed = self.output_processor.process_outputs(core_outputs)
         if processed.reqs_to_abort:
             self.engine_core.abort_requests(processed.reqs_to_abort)

@@ -101,6 +101,9 @@ class OutputProcessor:
             state.finished = finished
             state.finish_reason = finish_reason
             state.stop_reason = stop_reason
+            if finished and state.detokenizer is not None:
+                # Emit any text held back waiting for more context.
+                state.detokenizer.flush()
 
             request_outputs.append(self._make_request_output(state))
             if finished:

@@ -1,0 +1,70 @@
+"""msgpack serialization for the client <-> engine-core boundary.
+
+Reference: vllm/v1/serial_utils.py (MsgpackEncoder/Decoder over msgspec).
+msgspec is not in this image, so the wire format is plain msgpack with
+explicit to/from-dict converters for the two dataclasses that cross the
+process boundary (EngineCoreRequest in, EngineCoreOutput out). Tensors
+never cross this boundary — token ids and logprobs are plain ints/floats.
+"""
+
+from dataclasses import asdict
+from typing import Any
+
+import msgpack
+
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    # strict_map_key=False: logprob maps are keyed by int token ids.
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+# ---------------------------------------------------------------------------
+def encode_request(req: EngineCoreRequest) -> dict:
+    sp = asdict(req.sampling_params)
+    sp.pop("_all_stop_token_ids", None)
+    return {
+        "request_id": req.request_id,
+        "prompt_token_ids": req.prompt_token_ids,
+        "sampling_params": sp,
+        "eos_token_id": req.eos_token_id,
+        "arrival_time": req.arrival_time,
+        "priority": req.priority,
+        "kv_transfer_params": req.kv_transfer_params,
+    }
+
+
+def decode_request(d: dict) -> EngineCoreRequest:
+    return EngineCoreRequest(
+        request_id=d["request_id"],
+        prompt_token_ids=list(d["prompt_token_ids"]),
+        sampling_params=SamplingParams(**d["sampling_params"]),
+        eos_token_id=d["eos_token_id"],
+        arrival_time=d["arrival_time"],
+        priority=d["priority"],
+        kv_transfer_params=d["kv_transfer_params"],
+    )
+
+
+def encode_output(out: EngineCoreOutput) -> list:
+    return [out.req_id, out.new_token_ids, out.finish_reason,
+            out.stop_reason, out.num_cached_tokens, out.logprobs]
+
+
+def decode_output(v: list) -> EngineCoreOutput:
+    req_id, new_token_ids, finish_reason, stop_reason, cached, lps = v
+    return EngineCoreOutput(
+        req_id=req_id,
+        new_token_ids=list(new_token_ids),
+        finish_reason=finish_reason,
+        stop_reason=stop_reason,
+        num_cached_tokens=cached,
+        logprobs=lps,
+    )

@@ -1,0 +1,94 @@
+"""``vdt`` command line (reference: vllm/entrypoints/cli/main.py:23 —
+`vllm serve|bench|...`; invoked here as `python -m vllm_distributed_tpu`
+or the `vdt` console script)."""
+
+import argparse
+import json
+import sys
+import time
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+
+
+def _add_serve(sub) -> None:
+    p = sub.add_parser("serve", help="start the OpenAI-compatible server")
+    p.add_argument("model_pos", nargs="?", default=None,
+                   help="model name or path (positional, like vllm serve)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    EngineArgs.add_cli_args(p)
+
+
+def _add_bench(sub) -> None:
+    p = sub.add_parser("bench", help="offline latency/throughput benchmark")
+    p.add_argument("mode", choices=["latency", "throughput"])
+    p.add_argument("--input-len", type=int, default=128)
+    p.add_argument("--output-len", type=int, default=128)
+    p.add_argument("--num-prompts", type=int, default=8)
+    p.add_argument("--warmup", type=int, default=1)
+    EngineArgs.add_cli_args(p)
+
+
+def cmd_serve(args) -> None:
+    from vllm_distributed_tpu.entrypoints.openai.api_server import \
+        run_server
+    if args.model_pos:
+        args.model = args.model_pos
+    engine_args = EngineArgs.from_cli_args(args)
+    run_server(engine_args, host=args.host, port=args.port)
+
+
+def cmd_bench(args) -> None:
+    """reference: vllm/benchmarks/latency.py:36 / throughput.py via the
+    `vllm bench` CLI (entrypoints/cli/benchmark/)."""
+    import numpy as np
+
+    from vllm_distributed_tpu.entrypoints.llm import LLM
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    engine_args = EngineArgs.from_cli_args(args)
+    llm = LLM(**{f: getattr(engine_args, f)
+                 for f in engine_args.__dataclass_fields__})
+    vocab = llm.llm_engine.config.model_config.get_vocab_size()
+    rng = np.random.default_rng(0)
+    prompts = [[int(x) for x in rng.integers(10, vocab - 1,
+                                             size=args.input_len)]
+               for _ in range(args.num_prompts)]
+    sp = SamplingParams(temperature=0.0, max_tokens=args.output_len,
+                        ignore_eos=True)
+    for _ in range(args.warmup):
+        llm.generate(prompts, sp)
+    start = time.perf_counter()
+    outs = llm.generate(prompts, sp)
+    elapsed = time.perf_counter() - start
+    gen_tokens = sum(len(o.outputs[0].token_ids) for o in outs)
+    result = {
+        "mode": args.mode,
+        "elapsed_s": round(elapsed, 3),
+        "num_prompts": args.num_prompts,
+        "input_len": args.input_len,
+        "output_len": args.output_len,
+        "generated_tokens": gen_tokens,
+        "tokens_per_s": round(gen_tokens / elapsed, 2),
+        "latency_per_token_ms": round(1000 * elapsed / max(gen_tokens, 1),
+                                      3),
+    }
+    print(json.dumps(result))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vdt",
+                                     description="vllm-distributed-tpu CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+    _add_serve(sub)
+    _add_bench(sub)
+    args = parser.parse_args(argv)
+    if args.command == "serve":
+        cmd_serve(args)
+    elif args.command == "bench":
+        cmd_bench(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

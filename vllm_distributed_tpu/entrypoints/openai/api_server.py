@@ -1,0 +1,373 @@
+"""OpenAI-compatible HTTP server on aiohttp.
+
+Reference: vllm/entrypoints/openai/api_server.py (run_server :1672,
+build_async_engine_client :149, route set) and serving_chat/completion.
+FastAPI/uvicorn are not in this image; aiohttp provides the same
+lifecycle (background AsyncLLM, SSE streaming, graceful shutdown on
+engine death — reference: entrypoints/launcher.py).
+"""
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.engine.core_client import EngineDeadError
+from vllm_distributed_tpu.entrypoints.openai import protocol
+from vllm_distributed_tpu.entrypoints.openai.protocol import RequestError
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import random_uuid
+
+logger = init_logger(__name__)
+
+ENGINE_KEY = web.AppKey("engine", AsyncLLM)
+MODEL_KEY = web.AppKey("model_name", str)
+
+
+def _error_response(e: Exception) -> web.Response:
+    if isinstance(e, RequestError):
+        return web.json_response(e.json(), status=e.code)
+    if isinstance(e, EngineDeadError):
+        return web.json_response(
+            {"error": {"message": str(e), "type": "internal_server_error",
+                       "code": 500}}, status=500)
+    return web.json_response(
+        {"error": {"message": f"{type(e).__name__}: {e}",
+                   "type": "internal_server_error", "code": 500}},
+        status=500)
+
+
+async def _auth_middleware_factory(app, handler):
+    from vllm_distributed_tpu import envs
+    api_key = envs.VDT_API_KEY
+
+    async def middleware(request: web.Request):
+        if api_key and request.path.startswith("/v1"):
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {api_key}":
+                return web.json_response(
+                    {"error": {"message": "invalid API key",
+                               "type": "authentication_error",
+                               "code": 401}}, status=401)
+        return await handler(request)
+
+    return middleware
+
+
+# ---------------------------------------------------------------------------
+async def health(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    if engine.errored:
+        return web.Response(status=500, text="engine dead")
+    return web.Response(text="OK")
+
+
+async def list_models(request: web.Request) -> web.Response:
+    return web.json_response({
+        "object": "list",
+        "data": [protocol.model_card(request.app[MODEL_KEY])],
+    })
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus-format scrape of engine stats (reference:
+    v1/metrics/prometheus.py mounted at /metrics)."""
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    engine = request.app[ENGINE_KEY]
+    try:
+        stats = await engine.get_stats()
+    except Exception:  # noqa: BLE001 - engine busy/dead
+        stats = {}
+    return web.Response(text=render_metrics(stats),
+                        content_type="text/plain")
+
+
+# ---------------------------------------------------------------------------
+def _gen_prompts(body: dict) -> list:
+    """Completions `prompt` can be str | [str] | [int] | [[int]]."""
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise RequestError("`prompt` is required")
+    if isinstance(prompt, str):
+        return [prompt]
+    if isinstance(prompt, list):
+        if not prompt:
+            raise RequestError("`prompt` must not be empty")
+        if isinstance(prompt[0], int):
+            return [prompt]
+        return list(prompt)
+    raise RequestError("`prompt` must be a string or list")
+
+
+async def completions(request: web.Request) -> web.StreamResponse:
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        prompts = _gen_prompts(body)
+        n = int(body.get("n", 1) or 1)
+        max_len = engine.config.scheduler_config.max_model_len
+        params = protocol.sampling_params_from_request(body, max_len)
+        stream = bool(body.get("stream", False))
+        cid = protocol.completion_id()
+        created = int(time.time())
+
+        # Fan out: one engine request per (prompt, sample) pair; choice
+        # index follows OpenAI semantics (prompt-major, then n).
+        gens = []
+        for pi, prompt in enumerate(prompts):
+            for s in range(n):
+                idx = pi * n + s
+                gens.append((idx, engine.generate(
+                    prompt, params, request_id=f"{cid}-{idx}")))
+
+        if stream:
+            return await _stream_completions(request, cid, created, model,
+                                             gens)
+        # Drain all generators CONCURRENTLY: engine.generate is an async
+        # generator, so nothing is submitted until iteration starts —
+        # sequential draining would serialize the batch.
+        finals = await asyncio.gather(*(_drain(gen) for _, gen in gens))
+        choices = [None] * len(gens)
+        prompt_tokens = 0
+        completion_tokens = 0
+        for (idx, _), final in zip(gens, finals):
+            prompt_tokens += len(final.prompt_token_ids) if idx % n == 0 \
+                else 0
+            completion_tokens += len(final.outputs[0].token_ids)
+            choices[idx] = _completion_choice(idx, final, body)
+        return web.json_response({
+            "id": cid,
+            "object": "text_completion",
+            "created": created,
+            "model": model,
+            "choices": choices,
+            "usage": protocol.usage(prompt_tokens, completion_tokens),
+        })
+    except (RequestError, EngineDeadError, ValueError) as e:
+        return _error_response(e)
+
+
+async def _drain(gen):
+    final = None
+    async for out in gen:
+        final = out
+    return final
+
+
+def _completion_choice(idx: int, out, body: dict) -> dict:
+    comp = out.outputs[0]
+    choice = {
+        "index": idx,
+        "text": comp.text,
+        "finish_reason": comp.finish_reason,
+    }
+    if body.get("logprobs") is not None and comp.logprobs:
+        choice["logprobs"] = {
+            # The sampled token's own logprob (keyed lookup — the map may
+            # also carry top-k alternatives with higher probability).
+            "token_logprobs": [
+                lp.get(tok) if lp else None
+                for tok, lp in zip(comp.token_ids, comp.logprobs)
+            ],
+            "tokens": [str(t) for t in comp.token_ids],
+            "top_logprobs": [{str(k): v for k, v in lp.items()}
+                             for lp in comp.logprobs],
+        }
+    return choice
+
+
+async def _stream_completions(request, cid, created, model,
+                              gens) -> web.StreamResponse:
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    })
+    await resp.prepare(request)
+
+    async def pump(idx, gen):
+        sent = 0
+        async for out in gen:
+            text = out.outputs[0].text
+            delta = text[sent:]
+            sent = len(text)
+            finish = out.outputs[0].finish_reason
+            if delta or finish:
+                chunk = {
+                    "id": cid,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": model,
+                    "choices": [{
+                        "index": idx,
+                        "text": delta,
+                        "finish_reason": finish,
+                    }],
+                }
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode())
+
+    try:
+        await asyncio.gather(*(pump(idx, gen) for idx, gen in gens))
+        await resp.write(b"data: [DONE]\n\n")
+    except (EngineDeadError, ConnectionResetError) as e:
+        logger.warning("stream aborted: %s", e)
+    await resp.write_eof()
+    return resp
+
+
+# ---------------------------------------------------------------------------
+def _chat_prompt(engine: AsyncLLM, messages: list) -> str | list[int]:
+    tokenizer = engine.tokenizer
+    if tokenizer is None:
+        raise RequestError("chat requires a tokenizer for this model")
+    if getattr(tokenizer, "chat_template", None):
+        return tokenizer.apply_chat_template(messages, tokenize=True,
+                                             add_generation_prompt=True)
+    # Template-less tiny/test models: plain role-prefixed transcript.
+    text = "".join(f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+                   for m in messages) + "assistant:"
+    return text
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise RequestError("`messages` must be a non-empty list")
+        prompt = _chat_prompt(engine, messages)
+        n = int(body.get("n", 1) or 1)
+        max_len = engine.config.scheduler_config.max_model_len
+        params = protocol.sampling_params_from_request(body, max_len)
+        stream = bool(body.get("stream", False))
+        cid = protocol.chat_id()
+        created = int(time.time())
+        gens = [(i, engine.generate(prompt, params,
+                                    request_id=f"{cid}-{i}"))
+                for i in range(n)]
+        if stream:
+            return await _stream_chat(request, cid, created, model, gens)
+        finals = await asyncio.gather(*(_drain(gen) for _, gen in gens))
+        choices = [None] * n
+        prompt_tokens = 0
+        completion_tokens = 0
+        for (idx, _), final in zip(gens, finals):
+            if idx == 0:
+                prompt_tokens = len(final.prompt_token_ids)
+            completion_tokens += len(final.outputs[0].token_ids)
+            choices[idx] = {
+                "index": idx,
+                "message": {
+                    "role": "assistant",
+                    "content": final.outputs[0].text,
+                },
+                "finish_reason": final.outputs[0].finish_reason,
+            }
+        return web.json_response({
+            "id": cid,
+            "object": "chat.completion",
+            "created": created,
+            "model": model,
+            "choices": choices,
+            "usage": protocol.usage(prompt_tokens, completion_tokens),
+        })
+    except (RequestError, EngineDeadError, ValueError) as e:
+        return _error_response(e)
+
+
+async def _stream_chat(request, cid, created, model,
+                       gens) -> web.StreamResponse:
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    })
+    await resp.prepare(request)
+
+    async def send(choices):
+        chunk = {
+            "id": cid,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": model,
+            "choices": choices,
+        }
+        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+
+    async def pump(idx, gen):
+        await send([{"index": idx,
+                     "delta": {"role": "assistant", "content": ""},
+                     "finish_reason": None}])
+        sent = 0
+        async for out in gen:
+            text = out.outputs[0].text
+            delta = text[sent:]
+            sent = len(text)
+            finish = out.outputs[0].finish_reason
+            if delta or finish:
+                await send([{"index": idx,
+                             "delta": ({"content": delta} if delta else {}),
+                             "finish_reason": finish}])
+
+    try:
+        await asyncio.gather(*(pump(idx, gen) for idx, gen in gens))
+        await resp.write(b"data: [DONE]\n\n")
+    except (EngineDeadError, ConnectionResetError) as e:
+        logger.warning("stream aborted: %s", e)
+    await resp.write_eof()
+    return resp
+
+
+# ---------------------------------------------------------------------------
+def build_app(engine: AsyncLLM, model_name: str) -> web.Application:
+    app = web.Application(middlewares=[_auth_middleware_factory])
+    app[ENGINE_KEY] = engine
+    app[MODEL_KEY] = model_name
+    app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    return app
+
+
+async def serve(engine: AsyncLLM, model_name: str, host: str,
+                port: int, ready_event=None,
+                stop_event: Optional[asyncio.Event] = None) -> None:
+    """Run until stop_event (or forever); graceful engine shutdown on
+    exit (reference: entrypoints/launcher.py serve_http)."""
+    app = build_app(engine, model_name)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("serving on http://%s:%d", host, port)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        if stop_event is None:
+            while True:
+                await asyncio.sleep(3600)
+        else:
+            await stop_event.wait()
+    finally:
+        await runner.cleanup()
+        engine.shutdown()
+
+
+def run_server(engine_args, host: str = "0.0.0.0",
+               port: int = 8000) -> None:
+    """Blocking entry used by the CLI (reference: api_server.py:1672)."""
+    engine = AsyncLLM.from_engine_args(engine_args)
+    asyncio.run(serve(engine, engine_args.model, host, port))

@@ -1,0 +1,99 @@
+"""OpenAI-compatible request/response schemas (subset).
+
+Reference: vllm/entrypoints/openai/protocol.py (pydantic models for
+/v1/completions and /v1/chat/completions). pydantic is not a hard
+dependency here: plain dict parsing with explicit validation keeps the
+server dependency-light; the wire shapes match the reference.
+"""
+
+import time
+from typing import Any, Optional
+
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import random_uuid
+
+
+class RequestError(ValueError):
+    """400-level error with an OpenAI-style error body."""
+
+    def __init__(self, message: str, code: int = 400) -> None:
+        super().__init__(message)
+        self.code = code
+
+    def json(self) -> dict:
+        return {
+            "error": {
+                "message": str(self),
+                "type": "invalid_request_error",
+                "code": self.code,
+            }
+        }
+
+
+_SAMPLING_KEYS = dict(
+    temperature=float,
+    top_p=float,
+    top_k=int,
+    min_p=float,
+    seed=int,
+    presence_penalty=float,
+    frequency_penalty=float,
+    repetition_penalty=float,
+    min_tokens=int,
+    ignore_eos=bool,
+)
+
+
+def sampling_params_from_request(body: dict,
+                                 default_max_tokens: int) -> SamplingParams:
+    kwargs: dict[str, Any] = {}
+    max_tokens = body.get("max_tokens", body.get("max_completion_tokens"))
+    kwargs["max_tokens"] = (int(max_tokens)
+                            if max_tokens is not None else
+                            default_max_tokens)
+    for key, cast in _SAMPLING_KEYS.items():
+        if body.get(key) is not None:
+            kwargs[key] = cast(body[key])
+    stop = body.get("stop")
+    if stop is not None:
+        kwargs["stop"] = [stop] if isinstance(stop, str) else list(stop)
+    if body.get("stop_token_ids") is not None:
+        kwargs["stop_token_ids"] = list(body["stop_token_ids"])
+    if body.get("logprobs") is not None:
+        lp = body["logprobs"]
+        # Completions API: logprobs=<int>; chat API: logprobs=true +
+        # top_logprobs=<int>.
+        if isinstance(lp, bool):
+            if lp:
+                kwargs["logprobs"] = int(body.get("top_logprobs", 1) or 1)
+        else:
+            kwargs["logprobs"] = int(lp)
+    try:
+        return SamplingParams(**kwargs)
+    except ValueError as e:
+        raise RequestError(str(e)) from e
+
+
+def completion_id() -> str:
+    return f"cmpl-{random_uuid()}"
+
+
+def chat_id() -> str:
+    return f"chatcmpl-{random_uuid()}"
+
+
+def usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def model_card(model: str) -> dict:
+    return {
+        "id": model,
+        "object": "model",
+        "created": int(time.time()),
+        "owned_by": "vllm-distributed-tpu",
+    }

@@ -39,6 +39,10 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # after precompile warm-up (recompile-storm guard; used in tests).
     "VDT_ASSERT_NO_RECOMPILE":
     lambda: os.getenv("VDT_ASSERT_NO_RECOMPILE", "0") == "1",
+    # Force the engine core into a subprocess regardless of config
+    # (reference: VLLM_ENABLE_V1_MULTIPROCESSING).
+    "VDT_ENABLE_MP_ENGINE":
+    lambda: os.getenv("VDT_ENABLE_MP_ENGINE", "0") == "1",
     # Run Pallas kernels in interpret mode (CPU tests).
     "VDT_PALLAS_INTERPRET":
     lambda: os.getenv("VDT_PALLAS_INTERPRET", "0") == "1",

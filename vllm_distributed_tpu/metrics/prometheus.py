@@ -1,0 +1,42 @@
+"""Prometheus text rendering of engine stats.
+
+Reference: vllm/v1/metrics/prometheus.py + loggers.py:143
+(PrometheusStatLogger gauges/counters served at /metrics). The stats dict
+comes from Scheduler.get_stats() — rendered directly into the exposition
+format so scraping works without the prometheus_client registry (which
+is process-global and complicates multi-engine tests); names mirror the
+reference's vllm:* metric family.
+"""
+
+_GAUGES = {
+    "num_running_reqs": ("vdt:num_requests_running",
+                         "Number of requests currently running"),
+    "num_waiting_reqs": ("vdt:num_requests_waiting",
+                         "Number of requests waiting to be scheduled"),
+    "kv_cache_usage": ("vdt:kv_cache_usage_perc",
+                       "Fraction of KV pages in use"),
+}
+
+_COUNTERS = {
+    "num_preemptions": ("vdt:num_preemptions_total",
+                        "Cumulative preempted requests"),
+    "hits": ("vdt:prefix_cache_hits_total",
+             "Cumulative prefix-cache token hits"),
+    "queries": ("vdt:prefix_cache_queries_total",
+                "Cumulative prefix-cache token queries"),
+}
+
+
+def render_metrics(stats: dict) -> str:
+    lines: list[str] = []
+    for key, (name, help_text) in _GAUGES.items():
+        if key in stats:
+            lines += [f"# HELP {name} {help_text}",
+                      f"# TYPE {name} gauge",
+                      f"{name} {float(stats[key])}"]
+    for key, (name, help_text) in _COUNTERS.items():
+        if key in stats:
+            lines += [f"# HELP {name} {help_text}",
+                      f"# TYPE {name} counter",
+                      f"{name} {float(stats[key])}"]
+    return "\n".join(lines) + "\n"
