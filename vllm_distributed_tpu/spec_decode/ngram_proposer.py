@@ -1,0 +1,42 @@
+"""N-gram draft proposer (prompt-lookup decoding).
+
+Reference: vllm/v1/spec_decode/ngram_proposer.py:11 (``NgramProposer``:
+match the longest recent suffix n-gram, n in [prompt_lookup_min,
+prompt_lookup_max], against the token history; propose the k tokens that
+followed the most recent earlier occurrence). Pure numpy — runs on the
+host between steps, no device work.
+"""
+
+import numpy as np
+
+from vllm_distributed_tpu.config import SpeculativeConfig
+
+
+class NgramProposer:
+
+    def __init__(self, config: SpeculativeConfig) -> None:
+        self.k = config.num_speculative_tokens
+        self.max_n = config.prompt_lookup_max
+        self.min_n = config.prompt_lookup_min
+        assert self.min_n >= 1 and self.max_n >= self.min_n and self.k >= 1
+
+    def propose(self, token_ids: np.ndarray) -> list[int]:
+        """Draft up to k continuation tokens for the given history
+        (prompt + generated so far); [] when no n-gram matches."""
+        total = len(token_ids)
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if total < n + 1:
+                continue
+            suffix = token_ids[total - n:]
+            # Candidate windows exclude the suffix itself; matching the
+            # MOST RECENT earlier occurrence (reference behavior).
+            windows = np.lib.stride_tricks.sliding_window_view(
+                token_ids[:total - 1], n)
+            matches = np.nonzero((windows == suffix).all(axis=1))[0]
+            if len(matches) == 0:
+                continue
+            start = int(matches[-1]) + n
+            cont = token_ids[start:start + self.k]
+            if len(cont) > 0:
+                return [int(t) for t in cont]
+        return []

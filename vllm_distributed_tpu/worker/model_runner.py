@@ -62,6 +62,22 @@ class TPUModelRunner:
         self.token_buckets = make_buckets(
             16, sched_cfg.max_num_batched_tokens)
         self.req_buckets = make_buckets(8, self.max_num_reqs)
+
+        # Speculative decoding (ngram drafts verified in-step; reference:
+        # v1/spec_decode/ngram_proposer.py + rejection_sampler.py). The
+        # sampler runs on S+1 positions per sampling request; acceptance
+        # is a host-side prefix match of the per-position target samples
+        # against the drafts — unbiased (the emitted token at each
+        # position IS the target sample) and zero extra device code.
+        spec = config.speculative_config
+        self.spec_k = (spec.num_speculative_tokens
+                       if spec and spec.method == "ngram" else 0)
+        if self.spec_k:
+            from vllm_distributed_tpu.spec_decode.ngram_proposer import \
+                NgramProposer
+            self.proposer = NgramProposer(spec)
+        else:
+            self.proposer = None
         # KV-write runs: worst case one partial page per request plus the
         # full pages the step writes. Padded as a deterministic function of
         # T (see _batch_shape) so it adds no lattice dimension.
@@ -222,6 +238,7 @@ class TPUModelRunner:
         sampling_rows: list[int] = []
         sampling_req_ids: list[str] = []
         logits_idx: list[int] = []
+        spec_drafts: list[list[int]] = []
 
         t = 0
         num_runs = 0
@@ -229,6 +246,13 @@ class TPUModelRunner:
             row = ib.req_id_to_index[req_id]
             start = ib.num_computed[row]
             end = start + n
+            drafts = (scheduler_output.scheduled_spec_decode_tokens.get(
+                req_id, []) if self.spec_k else [])
+            if drafts:
+                # Draft tokens are not committed history: stage them into
+                # the row's scratch tail so the flat slice below sees them
+                # (they sit exactly at positions [end-D, end)).
+                ib.token_ids[row, end - len(drafts):end] = drafts
             token_ids[t:t + n] = ib.token_ids[row, start:end]
             positions[t:t + n] = np.arange(start, end, dtype=np.int32)
             req_idx[t:t + n] = row
@@ -253,17 +277,35 @@ class TPUModelRunner:
                 sampling_rows.append(row)
                 sampling_req_ids.append(req_id)
                 logits_idx.append(t + n - 1)
+                spec_drafts.append(drafts)
             t += n
 
         kv_runs_arr = np.zeros((G, 4), np.int32)
         if kv_runs:
             kv_runs_arr[:len(kv_runs)] = kv_runs
 
+        S1 = self.spec_k + 1  # sampled positions per sampling request
         R = pad_to_bucket(max(len(sampling_rows), 1), self.req_buckets)
         rows = np.asarray(sampling_rows +
                           [0] * (R - len(sampling_rows)), np.int32)
-        logits_indices = np.asarray(logits_idx + [0] *
-                                    (R - len(logits_idx)), np.int32)
+        if self.spec_k:
+            # Each sampling request samples at its last D+1 positions
+            # (the committed token + its drafts), padded to S+1 rows by
+            # repeating the last index; drafts pad with -1 (never equal a
+            # sampled token, so padding positions reject).
+            verify_idx = np.zeros((R, S1), np.int32)
+            drafts_arr = np.full((R, self.spec_k), -1, np.int32)
+            for i, li in enumerate(logits_idx):
+                D = len(spec_drafts[i])
+                verify_idx[i] = li  # default: repeat the last position
+                verify_idx[i, :D + 1] = np.arange(li - D, li + 1)
+                if D:
+                    drafts_arr[i, :D] = spec_drafts[i]
+            logits_indices = verify_idx.reshape(-1)
+        else:
+            drafts_arr = None
+            logits_indices = np.asarray(logits_idx + [0] *
+                                        (R - len(logits_idx)), np.int32)
 
         # Seeds: seeded requests fold (user_seed, step-in-request) so runs
         # reproduce; unseeded draw from the engine rng.
@@ -273,12 +315,19 @@ class TPUModelRunner:
         seeds = np.where(user_seed >= 0,
                          user_seed * 1000003 + step_in_req, random_part)
 
+        def expand(x):
+            return np.repeat(x, S1) if self.spec_k else x
+
+        # Per-position seed offsets keep sampled positions independent.
+        seeds_e = expand(seeds)
+        if self.spec_k:
+            seeds_e = seeds_e + 7919 * np.tile(np.arange(S1), R)
         sampling_md = SamplingMetadata(
-            temperature=jnp.asarray(ib.temperature[rows]),
-            top_k=jnp.asarray(ib.top_k[rows]),
-            top_p=jnp.asarray(ib.top_p[rows]),
-            min_p=jnp.asarray(ib.min_p[rows]),
-            seeds=jnp.asarray(seeds),
+            temperature=jnp.asarray(expand(ib.temperature[rows])),
+            top_k=jnp.asarray(expand(ib.top_k[rows])),
+            top_p=jnp.asarray(expand(ib.top_p[rows])),
+            min_p=jnp.asarray(expand(ib.min_p[rows])),
+            seeds=jnp.asarray(seeds_e),
         )
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
@@ -294,7 +343,7 @@ class TPUModelRunner:
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
-                sampling_req_ids, (T, max_q, G), R)
+                sampling_req_ids, (T, max_q, G), R, drafts_arr)
 
     # ------------------------------------------------------------------
     def execute_model(self,
@@ -306,28 +355,52 @@ class TPUModelRunner:
             return self._execute_multi_step(scheduler_output)
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R) = self._prepare_inputs(scheduler_output)
+         fwd_shape, R, drafts_arr) = self._prepare_inputs(scheduler_output)
 
+        n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
         with self.mesh:
             with self._compile_watch(("fwd", ) + fwd_shape):
                 self.kv_caches, hidden = self._forward_fn(
                     self.params, self.kv_caches, token_ids, batch)
             hidden_sel = self._gather_sample_rows(hidden, logits_indices)
-            with self._compile_watch(("sample", R)):
+            with self._compile_watch(("sample", n_rows)):
                 tokens, logprobs = self._sample_fn(self.params, hidden_sel,
                                                    sampling_md)
 
         tokens_np = np.asarray(jax.device_get(tokens))
         logprobs_np = np.asarray(jax.device_get(logprobs))
 
-        # Record sampled tokens so next step's decode inputs include them.
         req_ids, sampled, lps = [], [], []
-        for i, req_id in enumerate(sampling_req_ids):
-            token = int(tokens_np[i])
-            self.input_batch.append_token(req_id, token)
-            req_ids.append(req_id)
-            sampled.append([token])
-            lps.append([{token: float(logprobs_np[i])}])
+        spec_out: Optional[list[list[int]]] = [] if self.spec_k else None
+        if self.spec_k:
+            S1 = self.spec_k + 1
+            toks = tokens_np.reshape(R, S1)
+            lp2 = logprobs_np.reshape(R, S1)
+            # Accept the longest draft prefix the per-position target
+            # samples agree with; position i's sample IS the emitted
+            # token, so the output distribution equals non-spec sampling
+            # (reference: v1/sample/rejection_sampler.py semantics for
+            # deterministic ngram drafts).
+            match = toks[:, :self.spec_k] == drafts_arr
+            accepted = np.cumprod(match.astype(np.int64), axis=1)
+            num_emitted = 1 + accepted.sum(axis=1)
+            for i, req_id in enumerate(sampling_req_ids):
+                emitted = [int(t) for t in toks[i, :num_emitted[i]]]
+                for tok in emitted:
+                    self.input_batch.append_token(req_id, tok)
+                req_ids.append(req_id)
+                sampled.append(emitted)
+                lps.append([{tok: float(lp)} for tok, lp in
+                            zip(emitted, lp2[i, :num_emitted[i]])])
+                spec_out.append(self._propose_drafts(req_id))
+        else:
+            # Record sampled tokens so next step's inputs include them.
+            for i, req_id in enumerate(sampling_req_ids):
+                token = int(tokens_np[i])
+                self.input_batch.append_token(req_id, token)
+                req_ids.append(req_id)
+                sampled.append([token])
+                lps.append([{token: float(logprobs_np[i])}])
         # Partial-prefill requests report no samples.
         sampling_set = set(sampling_req_ids)
         for req_id in scheduler_output.num_scheduled_tokens:
@@ -335,9 +408,23 @@ class TPUModelRunner:
                 req_ids.append(req_id)
                 sampled.append([])
                 lps.append([])
+                if spec_out is not None:
+                    spec_out.append([])
         return ModelRunnerOutput(req_ids=req_ids,
                                  sampled_token_ids=sampled,
-                                 logprobs=lps)
+                                 logprobs=lps,
+                                 spec_token_ids=spec_out)
+
+    def _propose_drafts(self, req_id: str) -> list[int]:
+        """Ngram drafts for the next step from the request's full token
+        history (reference: gpu_model_runner.py:1925 propose_draft_
+        token_ids)."""
+        ib = self.input_batch
+        row = ib.req_id_to_index[req_id]
+        n = int(ib.num_tokens[row])
+        if n >= self.max_model_len:
+            return []
+        return self.proposer.propose(ib.token_ids[row, :n])
 
     # ------------------------------------------------------------------
     def _execute_multi_step(
