@@ -1,0 +1,162 @@
+"""End-to-end tests for the extended sampling path (penalties, logit
+bias, allowed tokens, min_tokens, logprobs=k) through the full engine
+(model: reference tests/v1/sample/ + tests/entrypoints behavior)."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_feat")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+@pytest.fixture(scope="module")
+def engine(checkpoint):
+    path, _ = checkpoint
+    return LLMEngine(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+    ).create_engine_config(), load_tokenizer=False)
+
+
+_RUN = [0]
+
+
+def run(engine, prompt, sp):
+    _RUN[0] += 1
+    engine.add_request(f"feat-{_RUN[0]}", prompt, sp)
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                return out
+    raise AssertionError("engine did not finish")
+
+
+def hf_stepwise_greedy(hf, prompt, n, penalty_fn):
+    """Greedy decode with a numpy logits post-processor applied per step:
+    the exact reference for penalty semantics."""
+    tokens = list(prompt)
+    out = []
+    for _ in range(n):
+        with torch.no_grad():
+            logits = hf(torch.tensor([tokens])).logits[0, -1].numpy().copy()
+        logits = penalty_fn(logits, tokens, out)
+        tok = int(np.argmax(logits))
+        tokens.append(tok)
+        out.append(tok)
+    return out
+
+
+def test_repetition_penalty_matches_manual_reference(engine, checkpoint):
+    _, hf = checkpoint
+    prompt = [3, 17, 92, 45, 8]
+    rp = 1.7
+
+    def penalize(logits, tokens, out):
+        seen = set(tokens)
+        for t in seen:
+            logits[t] = logits[t] / rp if logits[t] > 0 else logits[t] * rp
+        return logits
+
+    expect = hf_stepwise_greedy(hf, prompt, 6, penalize)
+    got = run(engine, prompt,
+              SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
+                             repetition_penalty=rp))
+    assert got.outputs[0].token_ids == expect
+
+
+def test_frequency_presence_penalties_match_manual_reference(
+        engine, checkpoint):
+    _, hf = checkpoint
+    prompt = [5, 9, 33, 71]
+    fp, pp = 0.9, 0.6
+
+    def penalize(logits, tokens, out):
+        counts = np.bincount(out, minlength=128) if out else np.zeros(128)
+        return logits - fp * counts - pp * (counts > 0)
+
+    expect = hf_stepwise_greedy(hf, prompt, 6, penalize)
+    got = run(engine, prompt,
+              SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
+                             frequency_penalty=fp, presence_penalty=pp))
+    assert got.outputs[0].token_ids == expect
+
+
+def test_logit_bias_forces_token(engine):
+    got = run(engine, [3, 17, 92],
+              SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True,
+                             logit_bias={77: 100.0}))
+    assert got.outputs[0].token_ids == [77, 77, 77]
+
+
+def test_allowed_token_ids_restricts_output(engine):
+    allowed = [10, 11, 12]
+    got = run(engine, [3, 17, 92],
+              SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
+                             allowed_token_ids=allowed))
+    assert set(got.outputs[0].token_ids) <= set(allowed)
+
+
+def test_min_tokens_suppresses_eos(engine):
+    # Bias pushes EOS (id 1) to the top; min_tokens must suppress it for
+    # the first 3 tokens, after which the request stops on EOS.
+    got = run(engine, [3, 17, 92],
+              SamplingParams(temperature=0.0, max_tokens=10, min_tokens=3,
+                             logit_bias={1: 100.0}))
+    toks = got.outputs[0].token_ids
+    assert len(toks) == 4
+    assert all(t != 1 for t in toks[:3])
+    assert toks[3] == 1
+    assert got.outputs[0].finish_reason == "stop"
+
+
+def test_logprobs_k_returned(engine):
+    k = 5
+    got = run(engine, [3, 17, 92, 45],
+              SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True,
+                             logprobs=k))
+    comp = got.outputs[0]
+    assert comp.logprobs is not None
+    assert len(comp.logprobs) == len(comp.token_ids)
+    for tok, lp in zip(comp.token_ids, comp.logprobs):
+        # Sampled token first; at least k entries; greedy sample = top-1,
+        # so its logprob is the max.
+        keys = list(lp)
+        assert keys[0] == tok
+        assert len(lp) >= k
+        assert abs(lp[tok] - max(lp.values())) < 1e-6
+    # Cumulative logprob equals the sum of sampled-token logprobs.
+    expect_cum = sum(lp[t] for t, lp in zip(comp.token_ids, comp.logprobs))
+    np.testing.assert_allclose(comp.cumulative_logprob, expect_cum,
+                               rtol=1e-6)
+
+
+def test_plain_requests_unaffected(engine, checkpoint):
+    """A penalty-free request decodes on the fast path and still matches
+    HF greedy exactly."""
+    _, hf = checkpoint
+    prompt = [7, 44, 101, 13]
+    with torch.no_grad():
+        out = hf.generate(torch.tensor([prompt]), max_new_tokens=5,
+                          do_sample=False, eos_token_id=None)
+    expect = out[0].tolist()[len(prompt):]
+    got = run(engine, prompt,
+              SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True))
+    assert got.outputs[0].token_ids == expect

@@ -3,9 +3,13 @@
 import jax.numpy as jnp
 import numpy as np
 
-from vllm_distributed_tpu.sample.metadata import SamplingMetadata
-from vllm_distributed_tpu.sample.sampler import (compute_topk_logprobs,
-                                                 sample_tokens)
+from vllm_distributed_tpu.sample.metadata import (ExtendedSamplingMetadata,
+                                                  SamplingMetadata)
+from vllm_distributed_tpu.sample.sampler import (MAX_LOGPROBS,
+                                                 apply_logits_processors,
+                                                 compute_topk_logprobs,
+                                                 sample_tokens,
+                                                 sample_tokens_extended)
 
 
 def md(R, temperature=1.0, top_k=0, top_p=1.0, min_p=0.0, seeds=None):
@@ -16,6 +20,37 @@ def md(R, temperature=1.0, top_k=0, top_p=1.0, min_p=0.0, seeds=None):
         min_p=jnp.full((R, ), min_p, jnp.float32),
         seeds=jnp.asarray(seeds if seeds is not None else range(R),
                           jnp.int64),
+    )
+
+
+def ext_md(R, V, L=16, B=8, hist=None, prompt_len=None, total_len=None,
+           presence=0.0, frequency=0.0, repetition=1.0, bias=None,
+           base_fill=0.0):
+    """Build an ExtendedSamplingMetadata; ``bias`` is a per-row list of
+    (token, value) pairs."""
+    hist_arr = np.zeros((R, L), np.int32)
+    if hist is not None:
+        for r, toks in enumerate(hist):
+            hist_arr[r, :len(toks)] = toks
+    bias_ids = np.full((R, B), V, np.int32)
+    bias_vals = np.zeros((R, B), np.float32)
+    if bias is not None:
+        for r, entries in enumerate(bias):
+            for j, (t, v) in enumerate(entries):
+                bias_ids[r, j] = t
+                bias_vals[r, j] = v
+    return ExtendedSamplingMetadata(
+        hist_tokens=jnp.asarray(hist_arr),
+        prompt_len=jnp.asarray(
+            prompt_len if prompt_len is not None else [0] * R, jnp.int32),
+        total_len=jnp.asarray(
+            total_len if total_len is not None else [0] * R, jnp.int32),
+        presence_penalty=jnp.full((R, ), presence, jnp.float32),
+        frequency_penalty=jnp.full((R, ), frequency, jnp.float32),
+        repetition_penalty=jnp.full((R, ), repetition, jnp.float32),
+        bias_ids=jnp.asarray(bias_ids),
+        bias_vals=jnp.asarray(bias_vals),
+        base_fill=jnp.full((R, ), base_fill, jnp.float32),
     )
 
 
@@ -113,3 +148,84 @@ def test_topk_logprobs():
     assert ids[0].tolist() == [1, 2]
     total = np.exp(np.asarray(vals[0])).sum()
     assert total < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Extended path: penalties / bias / masks (reference:
+# vllm/v1/sample/ops/penalties.py, logits_processor.py)
+# ---------------------------------------------------------------------------
+
+
+def test_repetition_penalty_divides_positive_and_multiplies_negative():
+    V = 8
+    logits = jnp.asarray([[2.0, -2.0, 1.0, 0.5, 0, 0, 0, 0]], jnp.float32)
+    # Tokens 0 (positive logit) and 1 (negative logit) appear in history.
+    ext = ext_md(1, V, hist=[[0, 1]], prompt_len=[1], total_len=[2],
+                 repetition=2.0)
+    out = np.asarray(apply_logits_processors(logits, ext))
+    np.testing.assert_allclose(out[0, 0], 1.0)   # 2.0 / 2
+    np.testing.assert_allclose(out[0, 1], -4.0)  # -2.0 * 2
+    np.testing.assert_allclose(out[0, 2], 1.0)   # untouched
+
+
+def test_frequency_and_presence_penalties_count_output_only():
+    V = 8
+    logits = jnp.zeros((1, V), jnp.float32)
+    # History: prompt [5, 5], output [5, 3] -> output counts: 5 -> 1, 3 -> 1.
+    ext = ext_md(1, V, hist=[[5, 5, 5, 3]], prompt_len=[2], total_len=[4],
+                 frequency=0.5, presence=0.25)
+    out = np.asarray(apply_logits_processors(logits, ext))
+    np.testing.assert_allclose(out[0, 5], -0.75)  # -0.5*1 - 0.25
+    np.testing.assert_allclose(out[0, 3], -0.75)
+    np.testing.assert_allclose(out[0, 0], 0.0)  # prompt-only would be 0 too
+
+
+def test_history_padding_is_ignored():
+    V = 8
+    logits = jnp.zeros((1, V), jnp.float32)
+    # total_len=0: nothing in history even though the buffer holds zeros
+    # (token id 0 must NOT be penalized).
+    ext = ext_md(1, V, hist=[[0, 0, 0]], prompt_len=[0], total_len=[0],
+                 frequency=1.0, presence=1.0, repetition=5.0)
+    out = np.asarray(apply_logits_processors(logits, ext))
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_logit_bias_scatter():
+    V = 8
+    logits = jnp.zeros((1, V), jnp.float32)
+    ext = ext_md(1, V, bias=[[(3, 5.0), (4, -5.0)]])
+    out = np.asarray(apply_logits_processors(logits, ext))
+    np.testing.assert_allclose(out[0, 3], 5.0)
+    np.testing.assert_allclose(out[0, 4], -5.0)
+    np.testing.assert_allclose(out[0, 0], 0.0)
+
+
+def test_allowed_token_ids_masks_everything_else():
+    V = 8
+    logits = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, V)), jnp.float32)
+    # base_fill=-inf with 0-valued entries at allowed ids {2, 6}.
+    ext = ext_md(1, V, bias=[[(2, 0.0), (6, 0.0)]],
+                 base_fill=float("-inf"))
+    ids, _, _, _ = sample_tokens_extended(logits, md(1, temperature=0.0),
+                                          ext)
+    assert int(ids[0]) in (2, 6)
+
+
+def test_extended_no_op_matches_plain():
+    V = 32
+    logits = jnp.asarray(
+        np.random.default_rng(3).standard_normal((4, V)), jnp.float32)
+    m = md(4, temperature=0.0)
+    plain_ids, _ = sample_tokens(logits, m)
+    ids, chosen, top_vals, top_ids = sample_tokens_extended(
+        logits, m, ext_md(4, V))
+    assert ids.tolist() == plain_ids.tolist()
+    assert top_vals.shape == (4, MAX_LOGPROBS)
+    # Chosen logprob appears at the right place in the topk list (greedy
+    # choice = top-1).
+    np.testing.assert_allclose(np.asarray(chosen), np.asarray(top_vals[:,
+                                                                       0]),
+                               rtol=1e-5)
+    assert top_ids[:, 0].tolist() == ids.tolist()

@@ -31,6 +31,9 @@ class ModelConfig:
 
     model: str = "meta-llama/Meta-Llama-3-8B"
     tokenizer: Optional[str] = None
+    # Skip tokenizer loading; prompts/outputs are token ids only
+    # (reference: vllm/config.py ModelConfig.skip_tokenizer_init).
+    skip_tokenizer_init: bool = False
     trust_remote_code: bool = False
     dtype: str = "bfloat16"  # bfloat16 | float32 (TPU-native dtypes)
     seed: int = 0

@@ -182,6 +182,7 @@ class Scheduler:
                     if (request.num_tokens_with_spec -
                             request.num_computed_tokens != 1
                             or request.spec_token_ids
+                            or sp.needs_extended_sampling
                             or sp.max_tokens - request.num_output_tokens <
                             multi_step
                             or self.max_model_len -

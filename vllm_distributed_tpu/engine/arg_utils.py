@@ -20,6 +20,7 @@ from vllm_distributed_tpu.config import (CacheConfig, DeviceConfig,
 class EngineArgs:
     model: str = "meta-llama/Meta-Llama-3-8B"
     tokenizer: Optional[str] = None
+    skip_tokenizer_init: bool = False
     trust_remote_code: bool = False
     dtype: str = "bfloat16"
     seed: int = 0
@@ -60,6 +61,7 @@ class EngineArgs:
         model_config = ModelConfig(
             model=self.model,
             tokenizer=self.tokenizer,
+            skip_tokenizer_init=self.skip_tokenizer_init,
             trust_remote_code=self.trust_remote_code,
             dtype=self.dtype,
             seed=self.seed,

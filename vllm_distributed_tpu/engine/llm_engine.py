@@ -39,6 +39,8 @@ class LLMEngine:
                  tokenizer=None, *, load_tokenizer: bool = True) -> None:
         self.config = config
         config.model_config.maybe_load_hf_config()
+        if config.model_config.skip_tokenizer_init:
+            load_tokenizer = False
         if tokenizer is None and load_tokenizer:
             tokenizer = _load_tokenizer(config)
         self.tokenizer = tokenizer

@@ -20,6 +20,14 @@ class Processor:
         self.eos_token_id: Optional[int] = None
         if tokenizer is not None:
             self.eos_token_id = tokenizer.eos_token_id
+        if self.eos_token_id is None:
+            # Tokenizer-free runs still stop on the model's EOS
+            # (reference: processor reads generation_config/hf_config).
+            hf = config.model_config.maybe_load_hf_config()
+            eos = getattr(hf, "eos_token_id", None)
+            if isinstance(eos, (list, tuple)):
+                eos = eos[0] if eos else None
+            self.eos_token_id = eos
 
     def process_inputs(
         self,

@@ -24,3 +24,36 @@ class SamplingMetadata:
     # [R] int64 per-step fold-in values: derived from (user seed, step) for
     # seeded requests or (engine rng, step) otherwise, built on the host.
     seeds: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ExtendedSamplingMetadata:
+    """Logits-processor inputs for the extended sampling path (penalties,
+    logit bias, allowed-token masks, min-tokens stop suppression;
+    reference: vllm/v1/sample/sampler.py:18 apply_penalties +
+    logits_processor.py:517). Static shapes: the history buffer is always
+    [R, max_model_len] and the sparse bias buffer is a fixed [R, B] so the
+    extended graph is keyed only by R.
+    """
+
+    # [R, L] int32 token history (prompt + generated), padded with an
+    # out-of-vocab id so scatter mode="drop" ignores padding.
+    hist_tokens: jax.Array
+    # [R] int32 prompt length (presence/frequency penalize output only).
+    prompt_len: jax.Array
+    # [R] int32 total tokens so far (prompt + output).
+    total_len: jax.Array
+    # [R] float32 penalties; 0 / 0 / 1 disable.
+    presence_penalty: jax.Array
+    frequency_penalty: jax.Array
+    repetition_penalty: jax.Array
+    # Sparse additive bias applied with set(): [R, B] token ids (pad: out of
+    # vocab, dropped) and values. Carries user logit_bias, min-tokens stop
+    # suppression (-inf at stop ids), and allowed_token_ids (base_fill=-inf
+    # with 0-valued entries at the allowed ids).
+    bias_ids: jax.Array
+    bias_vals: jax.Array
+    # [R] float32 fill applied to the whole row before the sparse set():
+    # 0.0 normally, -inf for allowed_token_ids rows.
+    base_fill: jax.Array

@@ -13,19 +13,23 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from vllm_distributed_tpu.sample.metadata import SamplingMetadata
+from vllm_distributed_tpu.sample.metadata import (ExtendedSamplingMetadata,
+                                                  SamplingMetadata)
 
 _NEG_INF = float("-inf")
 
+# OpenAI-compatible cap on `logprobs=k`; the extended sampler always
+# computes this many so K adds no compile-lattice dimension.
+MAX_LOGPROBS = 20
 
-@partial(jax.jit, static_argnames=())
-def sample_tokens(
+
+def _sample_from_logits(
     logits: jax.Array,  # [R, V] float32
     md: SamplingMetadata,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (sampled token ids [R] int32, logprob of the sampled token
-    [R] float32 under the *unmasked* temperature-scaled distribution —
-    matching the reference's sampled-logprob semantics)."""
+    """Core fused sampler: returns (sampled token ids [R] int32, logprob of
+    the sampled token [R] float32 under the *unmasked* temperature-scaled
+    distribution — matching the reference's sampled-logprob semantics)."""
     R, V = logits.shape
 
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -74,6 +78,80 @@ def sample_tokens(
     chosen_logprob = jnp.take_along_axis(logprobs, token_ids[:, None],
                                          axis=1)[:, 0]
     return token_ids, chosen_logprob
+
+
+@partial(jax.jit, static_argnames=())
+def sample_tokens(
+    logits: jax.Array,  # [R, V] float32
+    md: SamplingMetadata,
+) -> tuple[jax.Array, jax.Array]:
+    return _sample_from_logits(logits, md)
+
+
+def apply_logits_processors(
+    logits: jax.Array,  # [R, V] float32
+    ext: ExtendedSamplingMetadata,
+) -> jax.Array:
+    """Penalties + sparse bias/mask, fused and static-shape.
+
+    Reference semantics (vllm/v1/sample/ops/penalties.py):
+    * repetition_penalty: tokens seen in prompt OR output — positive
+      logits divided by rp, negative multiplied by rp.
+    * frequency_penalty: logits -= fp * count-in-output.
+    * presence_penalty: logits -= pp * (appeared-in-output).
+    Then the sparse row mask: ``logits + base_fill`` with ``bias_vals``
+    set() at ``bias_ids`` (carries logit_bias, allowed_token_ids and
+    min-tokens stop suppression; see ExtendedSamplingMetadata).
+    """
+    R, V = logits.shape
+    L = ext.hist_tokens.shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_output = ((pos >= ext.prompt_len[:, None]) &
+                 (pos < ext.total_len[:, None]))
+    in_any = pos < ext.total_len[:, None]
+    rows = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[:, None], (R, L))
+
+    out_counts = jnp.zeros((R, V), jnp.float32).at[
+        rows, ext.hist_tokens].add(in_output.astype(jnp.float32),
+                                   mode="drop")
+    seen = jnp.zeros((R, V), jnp.bool_).at[
+        rows, ext.hist_tokens].max(in_any, mode="drop")
+
+    rp = ext.repetition_penalty[:, None]
+    logits = jnp.where(seen,
+                       jnp.where(logits > 0, logits / rp, logits * rp),
+                       logits)
+    logits = logits - ext.frequency_penalty[:, None] * out_counts
+    logits = logits - ext.presence_penalty[:, None] * (out_counts > 0)
+
+    B = ext.bias_ids.shape[1]
+    brows = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[:, None], (R, B))
+    mask = jnp.broadcast_to(ext.base_fill[:, None], (R, V))
+    mask = mask.at[brows, ext.bias_ids].set(ext.bias_vals, mode="drop")
+    return logits + mask
+
+
+def sample_tokens_extended(
+    logits: jax.Array,  # [R, V] float32
+    md: SamplingMetadata,
+    ext: ExtendedSamplingMetadata,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Extended path: logits processors, sampling, and top-K logprobs in
+    one graph. Returns (token ids [R], chosen logprob [R],
+    topk logprob values [R, MAX_LOGPROBS], topk ids [R, MAX_LOGPROBS]).
+
+    Logprobs here (chosen and top-k) are reported under the PROCESSED,
+    untempered distribution — the reference's V1 semantics (logprobs
+    computed from post-processor raw logits, v1/sample/sampler.py).
+    """
+    logits = apply_logits_processors(logits, ext)
+    token_ids, _ = _sample_from_logits(logits, md)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    chosen_logprob = jnp.take_along_axis(logprobs, token_ids[:, None],
+                                         axis=1)[:, 0]
+    k = min(MAX_LOGPROBS, logits.shape[-1])
+    top_vals, top_ids = jax.lax.top_k(logprobs, k)
+    return token_ids, chosen_logprob, top_vals, top_ids.astype(jnp.int32)
 
 
 def compute_topk_logprobs(logits: jax.Array,

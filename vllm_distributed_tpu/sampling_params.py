@@ -38,6 +38,12 @@ class SamplingParams:
     ignore_eos: bool = False
     logprobs: Optional[int] = None
     prompt_logprobs: Optional[int] = None
+    # Sparse additive bias {token_id: bias}; OpenAI-compatible
+    # (reference: v1/sample/logits_processor.py LogitBiasLogitsProcessor).
+    logit_bias: Optional[dict[int, float]] = None
+    # Restrict sampling to this token set (reference:
+    # logits_processor.py AllowedTokenIdsLogitsProcessor).
+    allowed_token_ids: Optional[list[int]] = None
     detokenize: bool = True
     skip_special_tokens: bool = True
     spaces_between_special_tokens: bool = True
@@ -78,6 +84,14 @@ class SamplingParams:
         if self.stop_token_ids is None:
             self.stop_token_ids = []
         self._all_stop_token_ids = set(self.stop_token_ids)
+        if self.logprobs is not None and not 0 <= self.logprobs <= 20:
+            raise ValueError("logprobs must be in [0, 20]")
+        if self.logit_bias is not None:
+            self.logit_bias = {int(k): float(v)
+                               for k, v in self.logit_bias.items()}
+        if self.allowed_token_ids is not None \
+                and not self.allowed_token_ids:
+            raise ValueError("allowed_token_ids must be non-empty")
 
     @property
     def sampling_type(self) -> SamplingType:
@@ -90,6 +104,21 @@ class SamplingParams:
     @property
     def all_stop_token_ids(self) -> set[int]:
         return self._all_stop_token_ids
+
+    @property
+    def has_penalties(self) -> bool:
+        return (self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or self.repetition_penalty != 1.0)
+
+    @property
+    def needs_extended_sampling(self) -> bool:
+        """True when sampling needs the extended (logits-processor) graph:
+        penalties, logit bias, allowed-token masks, top-k logprobs, or
+        min-tokens stop suppression."""
+        return (self.has_penalties or bool(self.logit_bias)
+                or self.allowed_token_ids is not None
+                or bool(self.logprobs) or self.min_tokens > 0)
 
     def update_from_tokenizer(self, eos_token_id: Optional[int]) -> None:
         """Fold the model's EOS into the stop set unless ignore_eos."""

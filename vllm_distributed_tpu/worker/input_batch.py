@@ -39,6 +39,20 @@ class InputBatch:
         self.min_p = np.zeros((R, ), np.float32)
         self.seed = np.full((R, ), -1, np.int64)
 
+        # Extended sampling (penalties / bias / logprobs / min-tokens).
+        self.presence_penalty = np.zeros((R, ), np.float32)
+        self.frequency_penalty = np.zeros((R, ), np.float32)
+        self.repetition_penalty = np.ones((R, ), np.float32)
+        self.min_tokens = np.zeros((R, ), np.int32)
+        self.num_logprobs = np.zeros((R, ), np.int32)  # 0 = sampled only
+        self.prompt_len = np.zeros((R, ), np.int32)
+        self.needs_extended = np.zeros((R, ), np.bool_)
+        # Sparse per-row python state (lowered to fixed [R, B] arrays in
+        # the runner only when a batch contains extended rows).
+        self.logit_bias: list[Optional[dict[int, float]]] = [None] * R
+        self.allowed_token_ids: list[Optional[list[int]]] = [None] * R
+        self.stop_token_ids: list[tuple[int, ...]] = [()] * R
+
         self.req_id_to_index: dict[str, int] = {}
         self.index_to_req_id: dict[int, str] = {}
         self._free_rows = list(range(R - 1, -1, -1))
@@ -72,6 +86,17 @@ class InputBatch:
         self.top_p[row] = sp.top_p
         self.min_p[row] = sp.min_p
         self.seed[row] = -1 if sp.seed is None else sp.seed
+
+        self.presence_penalty[row] = sp.presence_penalty
+        self.frequency_penalty[row] = sp.frequency_penalty
+        self.repetition_penalty[row] = sp.repetition_penalty
+        self.min_tokens[row] = sp.min_tokens
+        self.num_logprobs[row] = sp.logprobs or 0
+        self.prompt_len[row] = n
+        self.needs_extended[row] = sp.needs_extended_sampling
+        self.logit_bias[row] = sp.logit_bias
+        self.allowed_token_ids[row] = sp.allowed_token_ids
+        self.stop_token_ids[row] = tuple(sp.all_stop_token_ids)
         return row
 
     def update_cached(self, data: CachedRequestData) -> None:
@@ -114,4 +139,13 @@ class InputBatch:
         self.num_computed[row] = 0
         self.num_blocks[row] = 0
         self.block_table[row, :] = 0
+        self.needs_extended[row] = False
+        self.num_logprobs[row] = 0
+        self.min_tokens[row] = 0
+        self.presence_penalty[row] = 0.0
+        self.frequency_penalty[row] = 0.0
+        self.repetition_penalty[row] = 1.0
+        self.logit_bias[row] = None
+        self.allowed_token_ids[row] = None
+        self.stop_token_ids[row] = ()
         return row

@@ -28,8 +28,10 @@ from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
                                                     SchedulerOutput)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.models.common import AttentionBatch
-from vllm_distributed_tpu.sample.metadata import SamplingMetadata
-from vllm_distributed_tpu.sample.sampler import sample_tokens
+from vllm_distributed_tpu.sample.metadata import (ExtendedSamplingMetadata,
+                                                  SamplingMetadata)
+from vllm_distributed_tpu.sample.sampler import (MAX_LOGPROBS, sample_tokens,
+                                                 sample_tokens_extended)
 from vllm_distributed_tpu.utils import cdiv, make_buckets, pad_to_bucket
 from vllm_distributed_tpu.worker.input_batch import InputBatch
 
@@ -143,9 +145,15 @@ class TPUModelRunner:
             tokens, logprobs = sample_tokens(logits, sampling_md)
             return tokens, logprobs
 
+        def sample_ext(params, hidden_sel, sampling_md: SamplingMetadata,
+                       ext: ExtendedSamplingMetadata):
+            logits = model.compute_logits(params, hidden_sel)
+            return sample_tokens_extended(logits, sampling_md, ext)
+
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
         self._sample_fn = jax.jit(sample)
+        self._sample_ext_fn = jax.jit(sample_ext)
         self._build_multi_step_fn()
 
     def _build_multi_step_fn(self) -> None:
@@ -316,7 +324,7 @@ class TPUModelRunner:
                          user_seed * 1000003 + step_in_req, random_part)
 
         def expand(x):
-            return np.repeat(x, S1) if self.spec_k else x
+            return np.repeat(x, S1, axis=0) if self.spec_k else x
 
         # Per-position seed offsets keep sampled positions independent.
         seeds_e = expand(seeds)
@@ -329,6 +337,9 @@ class TPUModelRunner:
             min_p=jnp.asarray(expand(ib.min_p[rows])),
             seeds=jnp.asarray(seeds_e),
         )
+        ext_md = None
+        if any(ib.needs_extended[r] for r in sampling_rows):
+            ext_md = self._build_extended_md(rows, expand)
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
             positions=jnp.asarray(positions),
@@ -343,7 +354,55 @@ class TPUModelRunner:
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
-                sampling_req_ids, (T, max_q, G), R, drafts_arr)
+                sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md)
+
+    _BIAS_BUF = 128  # fixed sparse-bias width; keeps the graph keyed by R
+
+    def _build_extended_md(self, rows: np.ndarray,
+                           expand) -> ExtendedSamplingMetadata:
+        """Lower per-row python sampling extras to the fixed-shape
+        ExtendedSamplingMetadata (see sample/metadata.py). ``rows`` is the
+        padded [R] array of input-batch row indices."""
+        ib = self.input_batch
+        R = len(rows)
+        B = self._BIAS_BUF
+        pad_id = self.model.cfg.vocab_size  # out of vocab -> scatter drops
+        bias_ids = np.full((R, B), pad_id, np.int32)
+        bias_vals = np.zeros((R, B), np.float32)
+        base_fill = np.zeros((R, ), np.float32)
+        for i, row in enumerate(rows):
+            allowed = ib.allowed_token_ids[row]
+            bias = ib.logit_bias[row]
+            entries: dict[int, float] = {}
+            if allowed is not None:
+                base_fill[i] = float("-inf")
+                entries = {t: (bias or {}).get(t, 0.0) for t in allowed}
+            elif bias:
+                entries = dict(bias)
+            n_out = int(ib.num_tokens[row] - ib.prompt_len[row])
+            if n_out < ib.min_tokens[row]:
+                for s in ib.stop_token_ids[row]:
+                    entries[s] = float("-inf")
+            if len(entries) > B:
+                raise ValueError(
+                    f"request needs {len(entries)} logit-bias/mask entries; "
+                    f"the static buffer holds {B}")
+            for j, (t, v) in enumerate(entries.items()):
+                bias_ids[i, j] = t
+                bias_vals[i, j] = v
+        return ExtendedSamplingMetadata(
+            hist_tokens=jnp.asarray(expand(ib.token_ids[rows])),
+            prompt_len=jnp.asarray(expand(ib.prompt_len[rows])),
+            total_len=jnp.asarray(expand(ib.num_tokens[rows])),
+            presence_penalty=jnp.asarray(expand(ib.presence_penalty[rows])),
+            frequency_penalty=jnp.asarray(expand(
+                ib.frequency_penalty[rows])),
+            repetition_penalty=jnp.asarray(expand(
+                ib.repetition_penalty[rows])),
+            bias_ids=jnp.asarray(expand(bias_ids)),
+            bias_vals=jnp.asarray(expand(bias_vals)),
+            base_fill=jnp.asarray(expand(base_fill)),
+        )
 
     # ------------------------------------------------------------------
     def execute_model(self,
@@ -355,17 +414,26 @@ class TPUModelRunner:
             return self._execute_multi_step(scheduler_output)
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R, drafts_arr) = self._prepare_inputs(scheduler_output)
+         fwd_shape, R, drafts_arr, ext_md) = \
+            self._prepare_inputs(scheduler_output)
 
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
+        topk_np = None
         with self.mesh:
             with self._compile_watch(("fwd", ) + fwd_shape):
                 self.kv_caches, hidden = self._forward_fn(
                     self.params, self.kv_caches, token_ids, batch)
             hidden_sel = self._gather_sample_rows(hidden, logits_indices)
-            with self._compile_watch(("sample", n_rows)):
-                tokens, logprobs = self._sample_fn(self.params, hidden_sel,
-                                                   sampling_md)
+            if ext_md is not None:
+                with self._compile_watch(("sampleX", n_rows)):
+                    tokens, logprobs, topv, topi = self._sample_ext_fn(
+                        self.params, hidden_sel, sampling_md, ext_md)
+                topk_np = (np.asarray(jax.device_get(topv)),
+                           np.asarray(jax.device_get(topi)))
+            else:
+                with self._compile_watch(("sample", n_rows)):
+                    tokens, logprobs = self._sample_fn(
+                        self.params, hidden_sel, sampling_md)
 
         tokens_np = np.asarray(jax.device_get(tokens))
         logprobs_np = np.asarray(jax.device_get(logprobs))
@@ -390,8 +458,11 @@ class TPUModelRunner:
                     self.input_batch.append_token(req_id, tok)
                 req_ids.append(req_id)
                 sampled.append(emitted)
-                lps.append([{tok: float(lp)} for tok, lp in
-                            zip(emitted, lp2[i, :num_emitted[i]])])
+                lps.append([
+                    self._lp_dict(req_id, i * S1 + p, tok,
+                                  lp2[i, p], topk_np)
+                    for p, tok in enumerate(emitted)
+                ])
                 spec_out.append(self._propose_drafts(req_id))
         else:
             # Record sampled tokens so next step's inputs include them.
@@ -400,7 +471,8 @@ class TPUModelRunner:
                 self.input_batch.append_token(req_id, token)
                 req_ids.append(req_id)
                 sampled.append([token])
-                lps.append([{token: float(logprobs_np[i])}])
+                lps.append([self._lp_dict(req_id, i, token,
+                                          logprobs_np[i], topk_np)])
         # Partial-prefill requests report no samples.
         sampling_set = set(sampling_req_ids)
         for req_id in scheduler_output.num_scheduled_tokens:
@@ -415,12 +487,30 @@ class TPUModelRunner:
                                  logprobs=lps,
                                  spec_token_ids=spec_out)
 
+    def _lp_dict(self, req_id: str, flat_row: int, token: int,
+                 chosen_lp: float, topk_np) -> dict[int, float]:
+        """Per-token logprob dict: the sampled token first (the output
+        processor's cumulative-logprob reads the first value), then the
+        request's `logprobs=k` top entries when requested."""
+        d = {int(token): float(chosen_lp)}
+        row = self.input_batch.req_id_to_index[req_id]
+        k = int(self.input_batch.num_logprobs[row])
+        if topk_np is not None and k > 0:
+            vals, ids = topk_np
+            for v, t in zip(vals[flat_row, :k], ids[flat_row, :k]):
+                d.setdefault(int(t), float(v))
+        return d
+
     def _propose_drafts(self, req_id: str) -> list[int]:
         """Ngram drafts for the next step from the request's full token
         history (reference: gpu_model_runner.py:1925 propose_draft_
-        token_ids)."""
+        token_ids). Requests on the extended sampling path get no drafts:
+        penalties change the target distribution position-by-position, so
+        draft verification there would be biased."""
         ib = self.input_batch
         row = ib.req_id_to_index[req_id]
+        if ib.needs_extended[row]:
+            return []
         n = int(ib.num_tokens[row])
         if n >= self.max_model_len:
             return []
@@ -563,20 +653,40 @@ class TPUModelRunner:
                         self.params, self.kv_caches, token_ids, batch)
                 jax.block_until_ready(hidden)
                 n += 1
+            S1 = self.spec_k + 1
             for R in self.req_buckets:
+                rows = R * S1  # sampler sees S+1 rows/request with spec
                 md = SamplingMetadata(
-                    temperature=jnp.zeros((R, ), jnp.float32),
-                    top_k=jnp.zeros((R, ), jnp.int32),
-                    top_p=jnp.ones((R, ), jnp.float32),
-                    min_p=jnp.zeros((R, ), jnp.float32),
-                    seeds=jnp.zeros((R, ), jnp.int64),
+                    temperature=jnp.zeros((rows, ), jnp.float32),
+                    top_k=jnp.zeros((rows, ), jnp.int32),
+                    top_p=jnp.ones((rows, ), jnp.float32),
+                    min_p=jnp.zeros((rows, ), jnp.float32),
+                    seeds=jnp.zeros((rows, ), jnp.int64),
                 )
                 hidden_sel = self._gather_sample_rows(
-                    jnp.zeros((R, self.model.cfg.hidden_size),
+                    jnp.zeros((rows, self.model.cfg.hidden_size),
                               self.model.cfg.dtype),
-                    jnp.arange(R, dtype=jnp.int32))
-                with self._compile_watch(("sample", R)):
+                    jnp.arange(rows, dtype=jnp.int32))
+                with self._compile_watch(("sample", rows)):
                     tokens, _ = self._sample_fn(self.params, hidden_sel, md)
+                jax.block_until_ready(tokens)
+                n += 1
+                ext = ExtendedSamplingMetadata(
+                    hist_tokens=jnp.zeros((rows, self.max_model_len),
+                                          jnp.int32),
+                    prompt_len=jnp.zeros((rows, ), jnp.int32),
+                    total_len=jnp.zeros((rows, ), jnp.int32),
+                    presence_penalty=jnp.zeros((rows, ), jnp.float32),
+                    frequency_penalty=jnp.zeros((rows, ), jnp.float32),
+                    repetition_penalty=jnp.ones((rows, ), jnp.float32),
+                    bias_ids=jnp.zeros((rows, self._BIAS_BUF), jnp.int32),
+                    bias_vals=jnp.zeros((rows, self._BIAS_BUF),
+                                        jnp.float32),
+                    base_fill=jnp.zeros((rows, ), jnp.float32),
+                )
+                with self._compile_watch(("sampleX", rows)):
+                    tokens, _, _, _ = self._sample_ext_fn(
+                        self.params, hidden_sel, md, ext)
                 jax.block_until_ready(tokens)
                 n += 1
             n_steps = self.config.scheduler_config.num_scheduler_steps
