@@ -183,3 +183,27 @@ def test_metrics_endpoint(server):
     assert r.status_code == 200
     assert "vdt:num_requests_running" in r.text
     assert "vdt:prefix_cache_hits_total" in r.text
+
+
+def test_logit_bias_over_api(server):
+    """OpenAI-style logit_bias (string token-id keys) is honored."""
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w3 w17 w92", "max_tokens": 3,
+        "temperature": 0.0, "ignore_eos": True,
+        "logit_bias": {"77": 100.0},
+    })
+    assert r.status_code == 200, r.text
+    assert r.json()["choices"][0]["text"].split() == ["w77"] * 3
+
+
+def test_logprobs_over_api(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w3 w17 w92 w45", "max_tokens": 3,
+        "temperature": 0.0, "ignore_eos": True, "logprobs": 4,
+    })
+    assert r.status_code == 200, r.text
+    lp = r.json()["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(len(d) >= 4 for d in lp["top_logprobs"])

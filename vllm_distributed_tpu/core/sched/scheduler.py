@@ -182,7 +182,8 @@ class Scheduler:
                     if (request.num_tokens_with_spec -
                             request.num_computed_tokens != 1
                             or request.spec_token_ids
-                            or sp.needs_extended_sampling
+                            or sp.needs_extended_static
+                            or request.num_output_tokens < sp.min_tokens
                             or sp.max_tokens - request.num_output_tokens <
                             multi_step
                             or self.max_model_len -

@@ -59,7 +59,9 @@ class EngineCore:
         return self.scheduler.has_unfinished_requests()
 
     def get_stats(self) -> dict:
-        return self.scheduler.get_stats()
+        stats = self.scheduler.get_stats()
+        stats.update(self.executor.get_stats())
+        return stats
 
     def shutdown(self) -> None:
         self.executor.shutdown()

@@ -59,6 +59,17 @@ def sampling_params_from_request(body: dict,
         kwargs["stop"] = [stop] if isinstance(stop, str) else list(stop)
     if body.get("stop_token_ids") is not None:
         kwargs["stop_token_ids"] = list(body["stop_token_ids"])
+    if body.get("logit_bias") is not None:
+        # OpenAI sends {"<token_id>": bias} with string keys.
+        try:
+            kwargs["logit_bias"] = {
+                int(k): float(v) for k, v in body["logit_bias"].items()
+            }
+        except (AttributeError, TypeError, ValueError) as e:
+            raise RequestError(f"invalid logit_bias: {e}") from e
+    if body.get("allowed_token_ids") is not None:
+        kwargs["allowed_token_ids"] = [int(t)
+                                       for t in body["allowed_token_ids"]]
     if body.get("logprobs") is not None:
         lp = body["logprobs"]
         # Completions API: logprobs=<int>; chat API: logprobs=true +

@@ -33,6 +33,9 @@ class Executor:
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         raise NotImplementedError
 
+    def get_stats(self) -> dict:
+        return {}
+
     def shutdown(self) -> None:
         pass
 
@@ -56,3 +59,6 @@ class UniProcExecutor(Executor):
     def execute_model(self,
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         return self.worker.execute_model(scheduler_output)
+
+    def get_stats(self) -> dict:
+        return self.worker.get_stats()

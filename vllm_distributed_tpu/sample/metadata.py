@@ -37,8 +37,10 @@ class ExtendedSamplingMetadata:
     extended graph is keyed only by R.
     """
 
-    # [R, L] int32 token history (prompt + generated), padded with an
-    # out-of-vocab id so scatter mode="drop" ignores padding.
+    # [R, L] int32 token history (prompt + generated). Entries past
+    # total_len may hold ANY id (the input batch pads with 0): the
+    # penalty scatters weight them by the in-window masks, so padding
+    # contributes zero regardless of its value.
     hist_tokens: jax.Array
     # [R] int32 prompt length (presence/frequency penalize output only).
     prompt_len: jax.Array

@@ -28,8 +28,10 @@ def _sample_from_logits(
     md: SamplingMetadata,
 ) -> tuple[jax.Array, jax.Array]:
     """Core fused sampler: returns (sampled token ids [R] int32, logprob of
-    the sampled token [R] float32 under the *unmasked* temperature-scaled
-    distribution — matching the reference's sampled-logprob semantics)."""
+    the sampled token [R] float32 under the RAW untempered distribution —
+    the reference's semantics: v1/sample/sampler.py computes logprobs from
+    the unprocessed logits, so reported values do not depend on
+    temperature or batch composition)."""
     R, V = logits.shape
 
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -70,11 +72,9 @@ def _sample_from_logits(
 
     token_ids = jnp.where(md.temperature < 1e-5, greedy_ids, sampled_ids)
 
-    # Logprob of the chosen token under the temperature-scaled (but
-    # untruncated) distribution; greedy rows report the raw distribution.
-    report_scale = jnp.where(md.temperature[:, None] < 1e-5,
-                             logits, scaled)
-    logprobs = jax.nn.log_softmax(report_scale, axis=-1)
+    # Logprob of the chosen token under the raw (untempered, untruncated)
+    # distribution.
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
     chosen_logprob = jnp.take_along_axis(logprobs, token_ids[:, None],
                                          axis=1)[:, 0]
     return token_ids, chosen_logprob
@@ -135,22 +135,29 @@ def sample_tokens_extended(
     logits: jax.Array,  # [R, V] float32
     md: SamplingMetadata,
     ext: ExtendedSamplingMetadata,
+    want_topk: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Extended path: logits processors, sampling, and top-K logprobs in
-    one graph. Returns (token ids [R], chosen logprob [R],
-    topk logprob values [R, MAX_LOGPROBS], topk ids [R, MAX_LOGPROBS]).
+    """Extended path: logits processors + sampling (+ top-K logprobs when
+    ``want_topk``) in one graph. Returns (token ids [R], chosen logprob
+    [R], topk logprob values [R, K], topk ids [R, K]); the topk pair is
+    None when ``want_topk`` is False (penalties-only batches skip the
+    vocab-wide top_k and its transfer).
 
-    Logprobs here (chosen and top-k) are reported under the PROCESSED,
-    untempered distribution — the reference's V1 semantics (logprobs
-    computed from post-processor raw logits, v1/sample/sampler.py).
+    Logprobs (chosen and top-k) are reported under the RAW untempered
+    pre-processor distribution — the reference's V1 semantics
+    (v1/sample/sampler.py computes logprobs from the unprocessed logits),
+    so a request's reported logprobs never depend on which other requests
+    share its batch.
     """
+    raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
     logits = apply_logits_processors(logits, ext)
     token_ids, _ = _sample_from_logits(logits, md)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    chosen_logprob = jnp.take_along_axis(logprobs, token_ids[:, None],
+    chosen_logprob = jnp.take_along_axis(raw_logprobs, token_ids[:, None],
                                          axis=1)[:, 0]
+    if not want_topk:
+        return token_ids, chosen_logprob, None, None
     k = min(MAX_LOGPROBS, logits.shape[-1])
-    top_vals, top_ids = jax.lax.top_k(logprobs, k)
+    top_vals, top_ids = jax.lax.top_k(raw_logprobs, k)
     return token_ids, chosen_logprob, top_vals, top_ids.astype(jnp.int32)
 
 

@@ -19,6 +19,13 @@ class SamplingType(IntEnum):
 
 _SAMPLING_EPS = 1e-5
 
+# Static sparse-bias buffer width in the sampler ([R, B] scatter; see
+# worker/model_runner.py _BIAS_BUF). Validated at request admission so an
+# oversized request is rejected instead of killing the engine mid-step.
+# Reserve headroom for min-tokens stop-suppression entries sharing the
+# buffer.
+MAX_BIAS_ENTRIES = 112
+
 
 @dataclass
 class SamplingParams:
@@ -89,9 +96,17 @@ class SamplingParams:
         if self.logit_bias is not None:
             self.logit_bias = {int(k): float(v)
                                for k, v in self.logit_bias.items()}
-        if self.allowed_token_ids is not None \
-                and not self.allowed_token_ids:
-            raise ValueError("allowed_token_ids must be non-empty")
+            if len(self.logit_bias) > MAX_BIAS_ENTRIES:
+                raise ValueError(
+                    f"logit_bias supports at most {MAX_BIAS_ENTRIES} "
+                    "entries")
+        if self.allowed_token_ids is not None:
+            if not self.allowed_token_ids:
+                raise ValueError("allowed_token_ids must be non-empty")
+            if len(self.allowed_token_ids) > MAX_BIAS_ENTRIES:
+                raise ValueError(
+                    f"allowed_token_ids supports at most "
+                    f"{MAX_BIAS_ENTRIES} ids")
 
     @property
     def sampling_type(self) -> SamplingType:
@@ -112,13 +127,19 @@ class SamplingParams:
                 or self.repetition_penalty != 1.0)
 
     @property
-    def needs_extended_sampling(self) -> bool:
-        """True when sampling needs the extended (logits-processor) graph:
-        penalties, logit bias, allowed-token masks, top-k logprobs, or
-        min-tokens stop suppression."""
+    def needs_extended_static(self) -> bool:
+        """Lifetime need for the extended (logits-processor) sampling
+        graph: penalties, logit bias, allowed-token masks, top-k
+        logprobs. min_tokens is NOT included — its stop suppression only
+        matters while output < min_tokens (checked dynamically)."""
         return (self.has_penalties or bool(self.logit_bias)
                 or self.allowed_token_ids is not None
-                or bool(self.logprobs) or self.min_tokens > 0)
+                or bool(self.logprobs))
+
+    @property
+    def needs_extended_sampling(self) -> bool:
+        """True when sampling may ever need the extended graph."""
+        return self.needs_extended_static or self.min_tokens > 0
 
     def update_from_tokenizer(self, eos_token_id: Optional[int]) -> None:
         """Fold the model's EOS into the stop set unless ignore_eos."""

@@ -46,6 +46,8 @@ class InputBatch:
         self.min_tokens = np.zeros((R, ), np.int32)
         self.num_logprobs = np.zeros((R, ), np.int32)  # 0 = sampled only
         self.prompt_len = np.zeros((R, ), np.int32)
+        # Lifetime (static) extended-graph need; min-tokens activity is
+        # checked dynamically via extended_active().
         self.needs_extended = np.zeros((R, ), np.bool_)
         # Sparse per-row python state (lowered to fixed [R, B] arrays in
         # the runner only when a batch contains extended rows).
@@ -93,7 +95,7 @@ class InputBatch:
         self.min_tokens[row] = sp.min_tokens
         self.num_logprobs[row] = sp.logprobs or 0
         self.prompt_len[row] = n
-        self.needs_extended[row] = sp.needs_extended_sampling
+        self.needs_extended[row] = sp.needs_extended_static
         self.logit_bias[row] = sp.logit_bias
         self.allowed_token_ids[row] = sp.allowed_token_ids
         self.stop_token_ids[row] = tuple(sp.all_stop_token_ids)
@@ -119,6 +121,14 @@ class InputBatch:
                         new_blocks
                     self.num_blocks[row] = nb + len(new_blocks)
             self.num_computed[row] = data.num_computed_tokens[i]
+
+    def extended_active(self, row: int) -> bool:
+        """Does this row need the extended sampling graph RIGHT NOW?
+        (static features, or min-tokens stop suppression still in its
+        window)."""
+        return bool(self.needs_extended[row]
+                    or (self.num_tokens[row] - self.prompt_len[row]
+                        < self.min_tokens[row]))
 
     def append_token(self, req_id: str, token_id: int) -> None:
         """Record a token sampled this step (so the next step's input

@@ -90,6 +90,11 @@ class TPUModelRunner:
         self._forward_fn = None
         self._sample_fn = None
         self._rng = np.random.default_rng(config.model_config.seed)
+        # Spec-decode acceptance counters (reference:
+        # v1/metrics SpecDecodingStats).
+        self.spec_num_drafts = 0
+        self.spec_num_draft_tokens = 0
+        self.spec_num_accepted_tokens = 0
         # Shapes warmed by precompile(); execute-time compiles outside this
         # set are recompile-guard violations (reference:
         # tpu_model_runner.py:318 _update_num_xla_graphs).
@@ -146,14 +151,16 @@ class TPUModelRunner:
             return tokens, logprobs
 
         def sample_ext(params, hidden_sel, sampling_md: SamplingMetadata,
-                       ext: ExtendedSamplingMetadata):
+                       ext: ExtendedSamplingMetadata, want_topk: bool):
             logits = model.compute_logits(params, hidden_sel)
-            return sample_tokens_extended(logits, sampling_md, ext)
+            return sample_tokens_extended(logits, sampling_md, ext,
+                                          want_topk)
 
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
         self._sample_fn = jax.jit(sample)
-        self._sample_ext_fn = jax.jit(sample_ext)
+        self._sample_ext_fn = jax.jit(sample_ext,
+                                      static_argnames=("want_topk", ))
         self._build_multi_step_fn()
 
     def _build_multi_step_fn(self) -> None:
@@ -338,8 +345,11 @@ class TPUModelRunner:
             seeds=jnp.asarray(seeds_e),
         )
         ext_md = None
-        if any(ib.needs_extended[r] for r in sampling_rows):
+        want_topk = False
+        if any(ib.extended_active(r) for r in sampling_rows):
             ext_md = self._build_extended_md(rows, expand)
+            want_topk = bool(any(ib.num_logprobs[r] > 0
+                                 for r in sampling_rows))
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
             positions=jnp.asarray(positions),
@@ -354,7 +364,8 @@ class TPUModelRunner:
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
-                sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md)
+                sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md,
+                want_topk)
 
     _BIAS_BUF = 128  # fixed sparse-bias width; keeps the graph keyed by R
 
@@ -414,7 +425,7 @@ class TPUModelRunner:
             return self._execute_multi_step(scheduler_output)
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R, drafts_arr, ext_md) = \
+         fwd_shape, R, drafts_arr, ext_md, want_topk) = \
             self._prepare_inputs(scheduler_output)
 
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
@@ -425,11 +436,13 @@ class TPUModelRunner:
                     self.params, self.kv_caches, token_ids, batch)
             hidden_sel = self._gather_sample_rows(hidden, logits_indices)
             if ext_md is not None:
-                with self._compile_watch(("sampleX", n_rows)):
+                with self._compile_watch(("sampleX", n_rows, want_topk)):
                     tokens, logprobs, topv, topi = self._sample_ext_fn(
-                        self.params, hidden_sel, sampling_md, ext_md)
-                topk_np = (np.asarray(jax.device_get(topv)),
-                           np.asarray(jax.device_get(topi)))
+                        self.params, hidden_sel, sampling_md, ext_md,
+                        want_topk)
+                if want_topk:
+                    topk_np = (np.asarray(jax.device_get(topv)),
+                               np.asarray(jax.device_get(topi)))
             else:
                 with self._compile_watch(("sample", n_rows)):
                     tokens, logprobs = self._sample_fn(
@@ -452,6 +465,12 @@ class TPUModelRunner:
             match = toks[:, :self.spec_k] == drafts_arr
             accepted = np.cumprod(match.astype(np.int64), axis=1)
             num_emitted = 1 + accepted.sum(axis=1)
+            for i in range(len(sampling_req_ids)):
+                n_draft = int((drafts_arr[i] >= 0).sum())
+                if n_draft:
+                    self.spec_num_drafts += 1
+                    self.spec_num_draft_tokens += n_draft
+                    self.spec_num_accepted_tokens += int(num_emitted[i] - 1)
             for i, req_id in enumerate(sampling_req_ids):
                 emitted = [int(t) for t in toks[i, :num_emitted[i]]]
                 for tok in emitted:
@@ -509,7 +528,7 @@ class TPUModelRunner:
         draft verification there would be biased."""
         ib = self.input_batch
         row = ib.req_id_to_index[req_id]
-        if ib.needs_extended[row]:
+        if ib.extended_active(row):
             return []
         n = int(ib.num_tokens[row])
         if n >= self.max_model_len:
@@ -684,11 +703,12 @@ class TPUModelRunner:
                                         jnp.float32),
                     base_fill=jnp.zeros((rows, ), jnp.float32),
                 )
-                with self._compile_watch(("sampleX", rows)):
-                    tokens, _, _, _ = self._sample_ext_fn(
-                        self.params, hidden_sel, md, ext)
-                jax.block_until_ready(tokens)
-                n += 1
+                for want_topk in (False, True):
+                    with self._compile_watch(("sampleX", rows, want_topk)):
+                        tokens, _, _, _ = self._sample_ext_fn(
+                            self.params, hidden_sel, md, ext, want_topk)
+                    jax.block_until_ready(tokens)
+                    n += 1
             n_steps = self.config.scheduler_config.num_scheduler_steps
             if n_steps > 1:
                 for R in self.req_buckets:
@@ -714,6 +734,20 @@ class TPUModelRunner:
                 jnp.zeros((n_steps, R), jnp.int64),
                 jnp.zeros((1, ), jnp.int32))
         jax.block_until_ready(toks)
+
+    def get_stats(self) -> dict[str, float]:
+        """Runner-side stats (spec-decode acceptance; reference:
+        v1/metrics/stats.py SpecDecodingStats)."""
+        if not self.spec_k:
+            return {}
+        return {
+            "spec_num_drafts": self.spec_num_drafts,
+            "spec_num_draft_tokens": self.spec_num_draft_tokens,
+            "spec_num_accepted_tokens": self.spec_num_accepted_tokens,
+            "spec_acceptance_rate":
+            (self.spec_num_accepted_tokens /
+             max(self.spec_num_draft_tokens, 1)),
+        }
 
     def profile_memory_bytes(self) -> int:
         """Bytes of HBM available for KV pages, from a MEASURED peak: run

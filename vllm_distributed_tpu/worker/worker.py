@@ -92,3 +92,6 @@ class TPUWorker:
     def execute_model(self,
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         return self.model_runner.execute_model(scheduler_output)
+
+    def get_stats(self) -> dict:
+        return self.model_runner.get_stats()
