@@ -337,6 +337,11 @@ class EngineConfig:
                 self.scheduler_config.max_num_batched_tokens = max(
                     self.scheduler_config.max_num_batched_tokens,
                     self.scheduler_config.max_model_len)
+        if (self.parallel_config.token_parallel_size > 1
+                and self.scheduler_config.num_scheduler_steps > 1):
+            # The fused multi-step burst cannot refresh per-rank token-
+            # parallel metadata on device; fall back to single-step.
+            self.scheduler_config.num_scheduler_steps = 1
 
     def compute_hash(self) -> str:
         """Stable hash of the config for compilation-cache keys."""

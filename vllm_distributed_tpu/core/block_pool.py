@@ -97,11 +97,18 @@ class BlockPool:
       - eviction (popping a hashed free page) removes it from the index.
     """
 
-    def __init__(self, num_blocks: int, enable_caching: bool = True) -> None:
+    def __init__(self, num_blocks: int, enable_caching: bool = True,
+                 id_offset: int = 0) -> None:
+        """``id_offset`` shifts this pool's page ids: token-parallel
+        KV management partitions the global page array into per-rank
+        pools whose ids index directly into the rank's slice (TPU
+        analogue of the fork's per-rank KV allocation,
+        vllm/v1/core/sched/scheduler.py:55 TokenParallelScheduler)."""
         assert num_blocks > 0
         self.num_blocks = num_blocks
         self.enable_caching = enable_caching
-        self.blocks = [KVCacheBlock(i) for i in range(num_blocks)]
+        self.blocks = [KVCacheBlock(id_offset + i)
+                       for i in range(num_blocks)]
         self.free_block_queue = FreeKVCacheBlockQueue(self.blocks)
         # hash -> block holding that content (at most one per hash).
         self.cached_block_hash_to_block: dict[bytes, KVCacheBlock] = {}

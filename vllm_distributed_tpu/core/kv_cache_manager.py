@@ -42,10 +42,11 @@ class KVCacheManager:
         block_size: int,
         num_blocks: int,
         enable_caching: bool = True,
+        id_offset: int = 0,
     ) -> None:
         self.block_size = block_size
         self.enable_caching = enable_caching
-        self.block_pool = BlockPool(num_blocks, enable_caching)
+        self.block_pool = BlockPool(num_blocks, enable_caching, id_offset)
 
         # req_id -> pages owned (ordered by position in sequence).
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = defaultdict(list)
@@ -204,4 +205,125 @@ class KVCacheManager:
         return {
             "queries": self.prefix_cache_queries,
             "hits": self.prefix_cache_hits,
+        }
+
+
+class TokenParallelKVCacheManager:
+    """Partitioned KV management for token parallelism: the global page
+    array is split into ``num_ranks`` contiguous per-rank pools, and every
+    request's pages come exclusively from its assigned rank's pool — so a
+    request's KV physically lives on one ``token``-axis shard of the
+    sharded cache.
+
+    TPU-native analogue of the fork's TokenParallelScheduler KV
+    bookkeeping (vllm/v1/core/sched/scheduler.py:55-255 assign_ranks +
+    per-rank free-block accounting, kv_cache_manager.py
+    tknp_skip_allocation): instead of peer processes owning separate
+    caches, one SPMD cache is sharded on the page axis and ownership is a
+    page-range invariant maintained here. Page ids are GLOBAL (rank r owns
+    [r*N/K, (r+1)*N/K)), so the worker's block tables and slot mappings
+    need no translation — the runner derives each request's rank from its
+    first page id.
+
+    Requests must be assigned a rank (``request.tknp_rank``) before any
+    call; the scheduler assigns ranks free-page-aware at admission.
+    Prefix-cache lookups are per-rank: a prefix cached on rank 0 cannot
+    serve a rank-1 request (its KV lives in rank 0's HBM shard), matching
+    the reference's per-rank cache separation.
+    """
+
+    def __init__(
+        self,
+        block_size: int,
+        num_blocks: int,
+        num_ranks: int,
+        enable_caching: bool = True,
+    ) -> None:
+        assert num_ranks > 1
+        assert num_blocks % num_ranks == 0, \
+            "page count must divide evenly across token-parallel ranks"
+        self.block_size = block_size
+        self.num_ranks = num_ranks
+        self.blocks_per_rank = num_blocks // num_ranks
+        self.managers = [
+            KVCacheManager(block_size, self.blocks_per_rank,
+                           enable_caching,
+                           id_offset=r * self.blocks_per_rank)
+            for r in range(num_ranks)
+        ]
+        # req_id -> rank, recorded at first allocation-path call.
+        self.req_rank: dict[str, int] = {}
+
+    def _mgr(self, request: Request) -> KVCacheManager:
+        rank = getattr(request, "tknp_rank", None)
+        assert rank is not None, \
+            f"request {request.request_id} has no token-parallel rank"
+        self.req_rank[request.request_id] = rank
+        return self.managers[rank]
+
+    def _maybe_mgr(self, request: Request) -> Optional[KVCacheManager]:
+        """Manager for the request's rank, or None when no rank was ever
+        assigned (a request aborted/rejected while still WAITING holds no
+        pages and no hashes, so teardown is a no-op)."""
+        if getattr(request, "tknp_rank", None) is None:
+            return None
+        return self._mgr(request)
+
+    @property
+    def usage(self) -> float:
+        return sum(m.usage for m in self.managers) / self.num_ranks
+
+    def get_num_free_blocks(self) -> int:
+        return sum(m.get_num_free_blocks() for m in self.managers)
+
+    def free_blocks_on_rank(self, rank: int) -> int:
+        return self.managers[rank].get_num_free_blocks()
+
+    def get_computed_blocks(self, request: Request):
+        return self._mgr(request).get_computed_blocks(request)
+
+    def allocate_slots(self, request: Request, num_new_tokens: int,
+                       new_computed_blocks=None,
+                       num_lookahead_tokens: int = 0,
+                       skip_allocation: bool = False):
+        return self._mgr(request).allocate_slots(
+            request, num_new_tokens, new_computed_blocks,
+            num_lookahead_tokens, skip_allocation)
+
+    def free(self, request: Request) -> None:
+        mgr = self._maybe_mgr(request)
+        if mgr is not None:
+            mgr.free(request)
+
+    def free_block_hashes(self, request: Request) -> None:
+        """Terminal teardown: also drops the rank record (it is only
+        needed while block tables can still be queried)."""
+        mgr = self._maybe_mgr(request)
+        if mgr is not None:
+            mgr.free_block_hashes(request)
+        self.req_rank.pop(request.request_id, None)
+
+    def release_rank(self, request: Request) -> None:
+        """Un-assign a request that holds no pages so the next admission
+        attempt re-picks the least-loaded rank (prevents a stalled queue
+        head from pinning itself to a full rank)."""
+        mgr = self._maybe_mgr(request)
+        if mgr is not None:
+            assert not mgr.req_to_blocks.get(request.request_id), \
+                "cannot release the rank of a request holding pages"
+            mgr.free_block_hashes(request)
+        self.req_rank.pop(request.request_id, None)
+        request.tknp_rank = None
+
+    def get_block_ids(self, request_id: str) -> list[int]:
+        return self.managers[self.req_rank[request_id]].get_block_ids(
+            request_id)
+
+    def reset_prefix_cache(self) -> bool:
+        return all([m.reset_prefix_cache() for m in self.managers])
+
+    def make_prefix_cache_stats(self) -> dict[str, float]:
+        return {
+            "queries": sum(m.prefix_cache_queries for m in self.managers),
+            "hits": sum(m.prefix_cache_hits for m in self.managers),
         }

@@ -41,6 +41,10 @@ class TokenParallelAllocation:
 
     TPU analogue of the fork's TokenParallelAllocation
     (v1/core/sched/output.py:84): rank indexes the "token" mesh axis.
+    Carried for observability/stats and wire parity — the runner itself
+    derives ownership from each request's page range (every page of a
+    request lives inside its rank's pool partition), which stays correct
+    across preemption and needs no extra trust in the wire data.
     """
 
     req_to_rank: dict[str, int] = field(default_factory=dict)

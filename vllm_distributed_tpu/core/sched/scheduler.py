@@ -18,12 +18,13 @@ from collections import deque
 from typing import Iterable, Optional
 
 from vllm_distributed_tpu.config import EngineConfig
-from vllm_distributed_tpu.core.kv_cache_manager import (KVCacheBlocks,
-                                                        KVCacheManager)
+from vllm_distributed_tpu.core.kv_cache_manager import (
+    KVCacheBlocks, KVCacheManager, TokenParallelKVCacheManager)
 from vllm_distributed_tpu.core.sched.output import (CachedRequestData,
                                                     ModelRunnerOutput,
                                                     NewRequestData,
-                                                    SchedulerOutput)
+                                                    SchedulerOutput,
+                                                    TokenParallelAllocation)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.request import Request, RequestStatus
 
@@ -78,11 +79,26 @@ class Scheduler:
             num_blocks = config.cache_config.num_gpu_blocks
         assert num_blocks is not None and num_blocks > 0, \
             "scheduler needs the page count (set cache_config.num_gpu_blocks)"
-        self.kv_cache_manager = KVCacheManager(
-            block_size=config.cache_config.block_size,
-            num_blocks=num_blocks,
-            enable_caching=config.cache_config.enable_prefix_caching,
-        )
+        # Token parallelism (the fork's TKNP, re-expressed for SPMD): the
+        # page pool is partitioned per token-axis rank and the scheduler
+        # assigns each request to a rank at admission (reference:
+        # v1/core/sched/scheduler.py:55 TokenParallelScheduler).
+        self.tknp_size = config.parallel_config.token_parallel_size
+        if self.tknp_size > 1:
+            self.kv_cache_manager = TokenParallelKVCacheManager(
+                block_size=config.cache_config.block_size,
+                num_blocks=num_blocks,
+                num_ranks=self.tknp_size,
+                enable_caching=config.cache_config.enable_prefix_caching,
+            )
+            # Per-rank scheduled-token counts (load-balance signal).
+            self.tknp_tokens_per_rank = [0] * self.tknp_size
+        else:
+            self.kv_cache_manager = KVCacheManager(
+                block_size=config.cache_config.block_size,
+                num_blocks=num_blocks,
+                enable_caching=config.cache_config.enable_prefix_caching,
+            )
         # Disaggregated-prefill hook (reference: scheduler holds the
         # scheduler-side KVConnector, sched/scheduler.py KVConnector calls).
         self.kv_connector = kv_connector
@@ -172,6 +188,8 @@ class Scheduler:
         # steps on-device per host roundtrip. All N slots are allocated up
         # front via num_lookahead_tokens; the burst is disabled for any
         # request that could finish or hit the context window mid-burst.
+        # (Token parallelism forces num_scheduler_steps=1 at config
+        # normalization: the fused burst cannot refresh per-rank metadata.)
         multi_step = self.num_scheduler_steps
         if multi_step > 1:
             if self.waiting or not self.running:
@@ -227,7 +245,7 @@ class Scheduler:
                 # that has NOT been scheduled this step (evicting a
                 # scheduled one would leave SchedulerOutput entries
                 # pointing at freed pages).
-                victim = self._select_preemption_victim(req_index)
+                victim = self._select_preemption_victim(req_index, request)
                 self._preempt(victim)
                 preempted.append(victim)
                 if victim is request:
@@ -282,6 +300,9 @@ class Scheduler:
                     self._free_request(request)
                     continue
 
+                if self.tknp_size > 1 and request.tknp_rank is None:
+                    self._assign_tknp_rank(request)
+
                 num_computed_tokens = request.num_computed_tokens
                 new_computed_blocks: Optional[KVCacheBlocks] = None
                 if num_computed_tokens == 0:
@@ -304,7 +325,16 @@ class Scheduler:
                 new_blocks = self.kv_cache_manager.allocate_slots(
                     request, num_new_tokens, new_computed_blocks)
                 if new_blocks is None:
-                    break  # out of pages; retry next step
+                    # Out of pages; retry next step. A fresh token-parallel
+                    # request holding nothing un-pins from its rank so the
+                    # next attempt re-picks by load (a full rank must not
+                    # stall the queue head while others have room).
+                    if (self.tknp_size > 1
+                            and request.num_computed_tokens == 0
+                            and not (new_computed_blocks
+                                     and new_computed_blocks.blocks)):
+                        self.kv_cache_manager.release_rank(request)
+                    break
 
                 self.waiting.popleft()
                 resumed = request.status == RequestStatus.PREEMPTED
@@ -337,6 +367,19 @@ class Scheduler:
 
         self.num_scheduled_steps += 1
         total = sum(num_scheduled_tokens.values())
+        tknp_alloc = None
+        if self.tknp_size > 1:
+            req_to_rank = {
+                req_id: self.requests[req_id].tknp_rank
+                for req_id in num_scheduled_tokens
+            }
+            tokens_per_rank = [0] * self.tknp_size
+            for req_id, n in num_scheduled_tokens.items():
+                tokens_per_rank[req_to_rank[req_id]] += n
+            self.tknp_tokens_per_rank = tokens_per_rank
+            tknp_alloc = TokenParallelAllocation(
+                req_to_rank=req_to_rank,
+                tokens_per_rank=tokens_per_rank)
         output = SchedulerOutput(
             scheduled_new_reqs=scheduled_new_reqs,
             scheduled_cached_reqs=cached_reqs,
@@ -345,6 +388,7 @@ class Scheduler:
             scheduled_spec_decode_tokens=scheduled_spec_tokens,
             finished_req_ids=self.finished_req_ids,
             multi_step=multi_step if num_scheduled_tokens else 1,
+            token_parallel_allocation=tknp_alloc,
         )
         self.finished_req_ids = set()
         if self.kv_connector is not None:
@@ -352,12 +396,36 @@ class Scheduler:
                 self.kv_connector.build_connector_meta(output)
         return output
 
-    def _select_preemption_victim(self, req_index: int) -> Request:
+    def _assign_tknp_rank(self, request: Request) -> None:
+        """Assign a token-parallel rank: most free pages first, then
+        lightest current token load (reference: TokenParallelScheduler
+        .assign_ranks, scheduler.py:88 — round-robin made free-block and
+        load aware)."""
+        mgr: TokenParallelKVCacheManager = self.kv_cache_manager
+        request.tknp_rank = max(
+            range(self.tknp_size),
+            key=lambda r: (mgr.free_blocks_on_rank(r),
+                           -self.tknp_tokens_per_rank[r], -r))
+        logger.debug("request %s -> token-parallel rank %d",
+                     request.request_id, request.tknp_rank)
+
+    def _select_preemption_victim(self, req_index: int,
+                                  request: Request) -> Request:
         """Pick a victim among requests not yet scheduled this step
         (self.running[req_index:]). Under the priority policy the
         lowest-priority *unscheduled* request is chosen — a request already
-        granted tokens this step is never evicted mid-step."""
+        granted tokens this step is never evicted mid-step.
+
+        Token parallelism: only same-rank victims free pages in the
+        exhausted rank's pool partition, so other ranks' requests are
+        never evicted for this allocation; with no same-rank candidate
+        the request preempts itself."""
         candidates = self.running[req_index:]
+        if self.tknp_size > 1:
+            candidates = [r for r in candidates
+                          if r.tknp_rank == request.tknp_rank]
+            if not candidates:
+                return request
         if self.policy == "priority":
             return max(candidates,
                        key=lambda r: (r.priority, r.arrival_time))
@@ -484,10 +552,16 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def get_stats(self) -> dict[str, float]:
-        return {
+        stats = {
             "num_running_reqs": len(self.running),
             "num_waiting_reqs": len(self.waiting),
             "kv_cache_usage": self.kv_cache_manager.usage,
             "num_preemptions": self.num_preemptions,
             **self.kv_cache_manager.make_prefix_cache_stats(),
         }
+        if self.tknp_size > 1:
+            for r, n in enumerate(self.tknp_tokens_per_rank):
+                stats[f"tknp_tokens_rank{r}"] = n
+                stats[f"tknp_free_blocks_rank{r}"] = \
+                    self.kv_cache_manager.free_blocks_on_rank(r)
+        return stats

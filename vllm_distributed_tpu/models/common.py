@@ -15,6 +15,42 @@ import jax.numpy as jnp
 
 
 @dataclass
+class TknpAttentionBatch:
+    """Per-token-parallel-rank attention metadata, stacked on a leading
+    rank axis that is sharded over the ``token`` mesh axis inside
+    shard_map — each rank reads only its own slab.
+
+    TPU analogue of the fork's TokenParallelMetadata + _tknp_slicing
+    (vllm/v1/worker/gpu_model_runner.py:334,392): the host slices the
+    step's metadata per rank; page ids are LOCAL to the rank's shard of
+    the page-sharded KV cache, and tokens of requests owned by other
+    ranks appear as padding (slot -1), so each rank computes attention
+    only for its own requests and a psum over the token axis merges the
+    disjoint outputs.
+    """
+
+    # [K, T] int32 local flat slots; -1 where this rank does not own the
+    # token's request.
+    slot_mapping: jax.Array
+    # [K, max_reqs, pages_per_req] int32 rank-local page tables (rows of
+    # non-owned requests are garbage; never dereferenced).
+    block_tables: jax.Array
+    # [K, max_reqs, 4] / [K, 1]: per-rank compacted seq runs.
+    seq_info: jax.Array
+    num_seqs: jax.Array
+    # [K, G, 4] / [K, 1]: per-rank KV-write runs with local page ids.
+    kv_runs: jax.Array
+    num_kv_runs: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    TknpAttentionBatch,
+    data_fields=[f.name for f in dataclasses.fields(TknpAttentionBatch)],
+    meta_fields=[],
+)
+
+
+@dataclass
 class AttentionBatch:
     """Flat ragged batch descriptor consumed by every attention layer.
 
@@ -44,6 +80,9 @@ class AttentionBatch:
     kv_runs: Optional[jax.Array] = None
     # [1] int32: number of active rows in kv_runs.
     num_kv_runs: Optional[jax.Array] = None
+    # Per-rank stacked metadata when token parallelism is on (see
+    # TknpAttentionBatch); None otherwise.
+    tknp: Optional[TknpAttentionBatch] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
     # changing it recompiles, like every other shape bucket.
     max_q: int = 1

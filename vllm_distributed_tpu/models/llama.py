@@ -28,6 +28,7 @@ from vllm_distributed_tpu.ops.attention import (paged_attention,
                                                 write_kv_cache)
 
 MODEL_AXIS = "model"
+TOKEN_AXIS = "token"
 
 
 @dataclass
@@ -111,11 +112,13 @@ class LlamaForCausalLM:
         }
 
     def kv_cache_specs(self) -> dict:
-        # [L, pages, kv_heads, page_size, head_dim]: shard kv heads on the
-        # TP axis (head-major page layout; see ops/attention.write_kv_pages).
+        # [L, pages, kv_heads, page_size, head_dim]: pages shard on the
+        # token-parallel axis (each rank's page-pool partition is its
+        # shard; no-op when the axis is 1) and kv heads on the TP axis
+        # (head-major page layout; see ops/attention.write_kv_pages).
         return {
-            "k": P(None, None, MODEL_AXIS, None, None),
-            "v": P(None, None, MODEL_AXIS, None, None),
+            "k": P(None, TOKEN_AXIS, MODEL_AXIS, None, None),
+            "v": P(None, TOKEN_AXIS, MODEL_AXIS, None, None),
         }
 
     def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:

@@ -200,6 +200,77 @@ def resolve_attention_backend() -> str:
     return backend
 
 
+def _scatter_kv_flat(k_all, v_all, k_new, v_new, slot, layer, PS):
+    """Contiguous-row scatter of new K/V into the flattened stacked cache
+    (XLA path; slots < 0 drop)."""
+    L, N, KVH, _, D = k_all.shape
+    T = k_new.shape[0]
+    k_new = _pad_last_dim(k_new, D)
+    v_new = _pad_last_dim(v_new, D)
+    page = slot // PS
+    off = slot % PS
+    rows = (((layer[0] * N + page[:, None]) * KVH +
+             jnp.arange(KVH, dtype=jnp.int32)[None, :]) * PS +
+            off[:, None])
+    total = L * N * KVH * PS
+    rows = jnp.where(slot[:, None] < 0, total, rows).reshape(-1)
+    k_flat = k_all.reshape(total, D)
+    v_flat = v_all.reshape(total, D)
+    k_flat = k_flat.at[rows].set(
+        k_new.reshape(T * KVH, D).astype(k_flat.dtype), mode="drop")
+    v_flat = v_flat.at[rows].set(
+        v_new.reshape(T * KVH, D).astype(v_flat.dtype), mode="drop")
+    return k_flat.reshape(k_all.shape), v_flat.reshape(v_all.shape)
+
+
+def _tknp_cache_specs():
+    from jax.sharding import PartitionSpec as P
+
+    from vllm_distributed_tpu.config import (MESH_AXIS_MODEL,
+                                             MESH_AXIS_TOKEN)
+    cache = P(None, MESH_AXIS_TOKEN, MESH_AXIS_MODEL, None, None)
+    heads = P(None, MESH_AXIS_MODEL, None)
+    return cache, heads, MESH_AXIS_TOKEN
+
+
+def _write_kv_cache_tknp(k_all, v_all, k_new, v_new, batch, layer):
+    """Token-parallel KV write: the cache page axis is sharded over the
+    ``token`` mesh axis; each rank applies only its own KV-write runs /
+    slots (local page ids, prepared by the runner — TPU analogue of the
+    fork's per-rank KV write path)."""
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    tk = batch.tknp
+    L, N, KVH, PS, D = k_all.shape
+    use_pallas = resolve_attention_backend() == "pallas"
+    cache_spec, new_spec, token_axis = _tknp_cache_specs()
+    from jax.sharding import PartitionSpec as P
+
+    def call(k_all_, v_all_, k_new_, v_new_, kv_runs_, n_runs_, slot_):
+        kv_runs_ = kv_runs_[0]
+        n_runs_ = n_runs_[0]
+        slot_ = slot_[0]
+        if use_pallas:
+            from vllm_distributed_tpu.ops.pallas_kv_write import (
+                write_kv_pages_pallas)
+            pad = [(0, 0), (PS, 2 * PS), (0, 0)]
+            k_hl = jnp.pad(_pad_last_dim(k_new_, D).swapaxes(0, 1), pad)
+            v_hl = jnp.pad(_pad_last_dim(v_new_, D).swapaxes(0, 1), pad)
+            return write_kv_pages_pallas(
+                k_all_, v_all_, k_hl.astype(k_all_.dtype),
+                v_hl.astype(v_all_.dtype), kv_runs_, n_runs_, layer)
+        return _scatter_kv_flat(k_all_, v_all_, k_new_, v_new_, slot_,
+                                layer, PS)
+
+    return jax.shard_map(
+        call, mesh=mesh_state.get_global_mesh(),
+        in_specs=(cache_spec, cache_spec, new_spec, new_spec,
+                  P(token_axis, None, None), P(token_axis, None),
+                  P(token_axis, None)),
+        out_specs=(cache_spec, cache_spec),
+        check_vma=False)(k_all, v_all, k_new, v_new, tk.kv_runs,
+                         tk.num_kv_runs, tk.slot_mapping)
+
+
 def write_kv_cache(
     k_all: jax.Array,  # [L, N, KVH, PS, D]
     v_all: jax.Array,
@@ -212,8 +283,12 @@ def write_kv_cache(
 
     Pallas path: in-place aliased page RMW kernel (no cache copy; see
     ops/pallas_kv_write.py). XLA path: flat row scatter with a layer
-    offset (CPU tests / debugging).
+    offset (CPU tests / debugging). Token-parallel batches route to the
+    page-sharded per-rank write.
     """
+    if getattr(batch, "tknp", None) is not None:
+        return _write_kv_cache_tknp(k_all, v_all, k_new, v_new, batch,
+                                    layer)
     L, N, KVH, PS, D = k_all.shape
     if (resolve_attention_backend() == "pallas"
             and getattr(batch, "kv_runs", None) is not None):
@@ -245,24 +320,60 @@ def write_kv_cache(
         return call(k_all, v_all, k_new, v_new)
 
     # XLA fallback: contiguous-row scatter over the flattened cache.
-    T = k_new.shape[0]
-    k_new = _pad_last_dim(k_new, D)
-    v_new = _pad_last_dim(v_new, D)
-    slot = batch.slot_mapping
-    page = slot // PS
-    off = slot % PS
-    rows = (((layer[0] * N + page[:, None]) * KVH +
-             jnp.arange(KVH, dtype=jnp.int32)[None, :]) * PS +
-            off[:, None])
-    total = L * N * KVH * PS
-    rows = jnp.where(slot[:, None] < 0, total, rows).reshape(-1)
-    k_flat = k_all.reshape(total, D)
-    v_flat = v_all.reshape(total, D)
-    k_flat = k_flat.at[rows].set(
-        k_new.reshape(T * KVH, D).astype(k_flat.dtype), mode="drop")
-    v_flat = v_flat.at[rows].set(
-        v_new.reshape(T * KVH, D).astype(v_flat.dtype), mode="drop")
-    return k_flat.reshape(k_all.shape), v_flat.reshape(v_all.shape)
+    return _scatter_kv_flat(k_all, v_all, k_new, v_new,
+                            batch.slot_mapping, layer, PS)
+
+
+def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
+    """Token-parallel attention: each ``token``-axis rank computes
+    attention only for the requests whose KV pages live in its shard
+    (per-rank compacted seq lists / local page tables built by the
+    runner), zeroes the rows it does not own, and a psum over the token
+    axis merges the disjoint per-rank outputs.
+
+    This is the SPMD re-expression of the fork's TKNP decode-attention
+    scaling (token_parallel_linear.py:39 scatter -> per-rank attention on
+    local KV -> gather): activations stay replicated over the token axis
+    (no scatter/gather), while KV memory and attention FLOPs/bandwidth
+    split K ways.
+    """
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    tk = batch.tknp
+    head_dim = q.shape[-1]
+    use_pallas = (resolve_attention_backend() == "pallas"
+                  and batch.seq_info is not None)
+    cache_spec, head_spec, token_axis = _tknp_cache_specs()
+    from jax.sharding import PartitionSpec as P
+
+    def call(q_, k_, v_, seq_info_, num_seqs_, bt_, slot_):
+        seq_info_ = seq_info_[0]
+        num_seqs_ = num_seqs_[0]
+        bt_ = bt_[0]
+        slot_ = slot_[0]
+        if use_pallas:
+            from vllm_distributed_tpu.ops.pallas_attention import (
+                ragged_paged_attention_pallas)
+            q_p = _pad_last_dim(q_, k_.shape[-1])
+            out = ragged_paged_attention_pallas(
+                q_p, k_, v_, seq_info_, num_seqs_, bt_, layer,
+                sm_scale=sm_scale, max_q=batch.max_q)[..., :head_dim]
+        else:
+            out = ragged_paged_attention(
+                q_, k_[layer[0]], v_[layer[0]], bt_, batch.req_idx,
+                batch.positions, sm_scale=sm_scale)
+        # Zero rows this rank does not own (incl. padding / kernel spill),
+        # then merge the disjoint rank outputs.
+        out = jnp.where((slot_ >= 0)[:, None, None], out, 0)
+        return jax.lax.psum(out, token_axis)
+
+    return jax.shard_map(
+        call, mesh=mesh_state.get_global_mesh(),
+        in_specs=(head_spec, cache_spec, cache_spec,
+                  P(token_axis, None, None), P(token_axis, None),
+                  P(token_axis, None, None), P(token_axis, None)),
+        out_specs=head_spec,
+        check_vma=False)(q, k_pages, v_pages, tk.seq_info, tk.num_seqs,
+                         tk.block_tables, tk.slot_mapping)
 
 
 def paged_attention(
@@ -284,6 +395,9 @@ def paged_attention(
     """
     if layer is None:
         layer = jnp.zeros((1, ), jnp.int32)
+    if getattr(batch, "tknp", None) is not None:
+        return _paged_attention_tknp(q, k_pages, v_pages, batch,
+                                     sm_scale=sm_scale, layer=layer)
     if (resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (

@@ -95,3 +95,7 @@ def tp_size(mesh: Optional[Mesh] = None) -> int:
 
 def dp_size(mesh: Optional[Mesh] = None) -> int:
     return (mesh or get_global_mesh()).shape[MESH_AXIS_DATA]
+
+
+def tknp_size(mesh: Optional[Mesh] = None) -> int:
+    return (mesh or get_global_mesh()).shape[MESH_AXIS_TOKEN]

@@ -101,6 +101,10 @@ class Request:
         self.num_cached_tokens = -1
         # Number of preemptions experienced (stats).
         self.num_preemptions = 0
+        # Token-parallel rank owning this request's KV (assigned by the
+        # scheduler at admission when token_parallel_size > 1; sticky
+        # across preemption so resume refills the same shard's pool).
+        self.tknp_rank: Optional[int] = None
 
         sampling_params.update_from_tokenizer(eos_token_id)
 

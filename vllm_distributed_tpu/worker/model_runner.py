@@ -27,7 +27,8 @@ from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
                                                     SchedulerOutput)
 from vllm_distributed_tpu.logger import init_logger
-from vllm_distributed_tpu.models.common import AttentionBatch
+from vllm_distributed_tpu.models.common import (AttentionBatch,
+                                                TknpAttentionBatch)
 from vllm_distributed_tpu.sample.metadata import (ExtendedSamplingMetadata,
                                                   SamplingMetadata)
 from vllm_distributed_tpu.sample.sampler import (MAX_LOGPROBS, sample_tokens,
@@ -53,6 +54,10 @@ class TPUModelRunner:
         self.model = model
         self.params = params
         self.kv_caches: Optional[dict] = None
+        # Token parallelism: requests' pages live on one token-axis rank;
+        # per-rank metadata is built each step (reference:
+        # gpu_model_runner.py:334 _build_token_parallel_metadata).
+        self.tknp_size = config.parallel_config.token_parallel_size
 
         self.input_batch = InputBatch(
             max_num_reqs=self.max_num_reqs,
@@ -250,6 +255,20 @@ class TPUModelRunner:
         kv_runs: list[tuple[int, int, int, int]] = []
         ps = self.page_size
 
+        K = self.tknp_size
+        if K > 1:
+            # Per-rank views: a request's owner rank is implied by its
+            # page range (the scheduler allocates each request's pages
+            # from one rank's pool partition).
+            Nl = self.num_pages // K
+            tk_slot = np.full((K, T), -1, np.int32)
+            tk_bt = np.zeros(
+                (K, self.max_num_reqs, self.max_pages_per_req), np.int32)
+            tk_seq_info = np.zeros((K, self.max_num_reqs, 4), np.int32)
+            tk_num_seqs = np.zeros((K, 1), np.int32)
+            tk_kv_runs = np.zeros((K, G, 4), np.int32)
+            tk_num_kv_runs = np.zeros((K, 1), np.int32)
+
         sampling_rows: list[int] = []
         sampling_req_ids: list[str] = []
         logits_idx: list[int] = []
@@ -276,6 +295,15 @@ class TPUModelRunner:
                 ib.block_table[row, pos // ps] * ps + pos % ps)
             seq_info[num_runs] = (t, n, end, row)
             num_runs += 1
+            if K > 1:
+                owner = int(ib.block_table[row, 0]) // Nl
+                tk_slot[owner, t:t + n] = \
+                    slot_mapping[t:t + n] - owner * Nl * ps
+                tk_bt[owner, row] = np.maximum(
+                    ib.block_table[row] - owner * Nl, 0)
+                i_r = tk_num_seqs[owner, 0]
+                tk_seq_info[owner, i_r] = (t, n, end, row)
+                tk_num_seqs[owner, 0] = i_r + 1
             # Page-write runs for the Pallas KV-write kernel: maximal
             # consecutive-slot spans within one page.
             consumed = 0
@@ -284,8 +312,13 @@ class TPUModelRunner:
                 off = p % ps
                 run_len = min(ps - off, n - consumed)
                 src = t + consumed
-                kv_runs.append((int(ib.block_table[row, p // ps]), off,
-                                src - off + ps, run_len))
+                page_id = int(ib.block_table[row, p // ps])
+                kv_runs.append((page_id, off, src - off + ps, run_len))
+                if K > 1:
+                    g = tk_num_kv_runs[owner, 0]
+                    tk_kv_runs[owner, g] = (page_id - owner * Nl, off,
+                                            src - off + ps, run_len)
+                    tk_num_kv_runs[owner, 0] = g + 1
                 consumed += run_len
             if end >= ib.num_tokens[row]:
                 # This step finishes all known tokens: sample.
@@ -350,6 +383,16 @@ class TPUModelRunner:
             ext_md = self._build_extended_md(rows, expand)
             want_topk = bool(any(ib.num_logprobs[r] > 0
                                  for r in sampling_rows))
+        tknp = None
+        if K > 1:
+            tknp = TknpAttentionBatch(
+                slot_mapping=jnp.asarray(tk_slot),
+                block_tables=jnp.asarray(tk_bt),
+                seq_info=jnp.asarray(tk_seq_info),
+                num_seqs=jnp.asarray(tk_num_seqs),
+                kv_runs=jnp.asarray(tk_kv_runs),
+                num_kv_runs=jnp.asarray(tk_num_kv_runs),
+            )
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
             positions=jnp.asarray(positions),
@@ -360,6 +403,7 @@ class TPUModelRunner:
             num_seqs=jnp.asarray([num_runs], np.int32),
             kv_runs=jnp.asarray(kv_runs_arr),
             num_kv_runs=jnp.asarray([len(kv_runs)], np.int32),
+            tknp=tknp,
             max_q=max_q,
         )
         return (jnp.asarray(token_ids), batch,
@@ -630,6 +674,19 @@ class TPUModelRunner:
     def _dummy_step_inputs(self, T: int, max_q: int, G: int):
         """Inert inputs for one forward at shape (T, max_q, G): padding
         slots (-1) and zero run/seq counts make every write a no-op."""
+        K = self.tknp_size
+        tknp = None
+        if K > 1:
+            tknp = TknpAttentionBatch(
+                slot_mapping=jnp.full((K, T), -1, jnp.int32),
+                block_tables=jnp.zeros(
+                    (K, self.max_num_reqs, self.max_pages_per_req),
+                    jnp.int32),
+                seq_info=jnp.zeros((K, self.max_num_reqs, 4), jnp.int32),
+                num_seqs=jnp.zeros((K, 1), jnp.int32),
+                kv_runs=jnp.zeros((K, G, 4), jnp.int32),
+                num_kv_runs=jnp.zeros((K, 1), jnp.int32),
+            )
         batch = AttentionBatch(
             req_idx=jnp.zeros((T, ), jnp.int32),
             positions=jnp.zeros((T, ), jnp.int32),
@@ -641,6 +698,7 @@ class TPUModelRunner:
             num_seqs=jnp.zeros((1, ), jnp.int32),
             kv_runs=jnp.zeros((G, 4), jnp.int32),
             num_kv_runs=jnp.zeros((1, ), jnp.int32),
+            tknp=tknp,
             max_q=max_q,
         )
         return jnp.zeros((T, ), jnp.int32), batch

@@ -58,9 +58,18 @@ class TPUWorker:
         forward at the largest token shape (reference: gpu_worker.py:200
         determine_available_memory runs profile_run before reading free
         memory; TPU variant tpu_worker.py:163)."""
+        # The page array shards evenly over the token-parallel axis.
+        tknp = self.config.parallel_config.token_parallel_size
+
+        def rounded(pages: int) -> int:
+            pages = max(pages, _MIN_PAGES)
+            return (pages // tknp) * tknp if tknp > 1 else pages
+
         override = self.config.cache_config.num_gpu_blocks_override
         if override:
-            return override
+            # Honor the override verbatim (tests use tiny pools to force
+            # preemption); only the token-axis divisibility is enforced.
+            return (override // tknp) * tknp if tknp > 1 else override
         avail = self.model_runner.profile_memory_bytes()
         page_bytes = self.model_runner.kv_cache_bytes_per_page()
         if avail <= 0:
@@ -69,11 +78,11 @@ class TPUWorker:
             pages = (self.config.max_pages_per_req *
                      max(self.config.scheduler_config.max_num_seqs // 4, 4))
             logger.info("no memory stats; defaulting to %d KV pages", pages)
-            return max(pages, _MIN_PAGES)
+            return rounded(pages)
         pages = avail // page_bytes
         logger.info("HBM for KV: %.2f GiB -> %d pages of %d bytes",
                     avail / 2**30, pages, page_bytes)
-        return max(pages, _MIN_PAGES)
+        return rounded(pages)
 
     def initialize_kv_cache(self, num_pages: int) -> None:
         self.model_runner.initialize_kv_cache(num_pages)
