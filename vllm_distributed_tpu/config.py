@@ -342,6 +342,18 @@ class EngineConfig:
             # The fused multi-step burst cannot refresh per-rank token-
             # parallel metadata on device; fall back to single-step.
             self.scheduler_config.num_scheduler_steps = 1
+        if (self.kv_transfer_config.kv_connector
+                and self.scheduler_config.num_scheduler_steps > 1):
+            # Connector load/save hooks run at step boundaries; the fused
+            # burst would silently skip them (e.g. a producer's
+            # prefill-completing save staged on a burst step).
+            self.scheduler_config.num_scheduler_steps = 1
+        override = self.cache_config.num_gpu_blocks_override
+        tknp = self.parallel_config.token_parallel_size
+        if override and tknp > 1 and (override % tknp or override < tknp):
+            raise ValueError(
+                f"num_gpu_blocks_override={override} must be a positive "
+                f"multiple of token_parallel_size={tknp}")
 
     def compute_hash(self) -> str:
         """Stable hash of the config for compilation-cache keys."""

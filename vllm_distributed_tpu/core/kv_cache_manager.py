@@ -311,6 +311,9 @@ class TokenParallelKVCacheManager:
         if mgr is not None:
             assert not mgr.req_to_blocks.get(request.request_id), \
                 "cannot release the rank of a request holding pages"
+            # A failed allocate_slots touches the defaultdict; drop the
+            # empty entry or the old rank's manager leaks it forever.
+            mgr.req_to_blocks.pop(request.request_id, None)
             mgr.free_block_hashes(request)
         self.req_rank.pop(request.request_id, None)
         request.tknp_rank = None

@@ -102,6 +102,10 @@ class Scheduler:
         # Disaggregated-prefill hook (reference: scheduler holds the
         # scheduler-side KVConnector, sched/scheduler.py KVConnector calls).
         self.kv_connector = kv_connector
+        if kv_connector is not None:
+            # Let the connector query current block ids directly instead
+            # of threading them through every hook.
+            kv_connector.kv_manager = self.kv_cache_manager
 
         self.requests: dict[str, Request] = {}
         self.waiting: deque[Request] = deque()
@@ -155,6 +159,17 @@ class Scheduler:
 
     def _free_request(self, request: Request) -> None:
         assert request.is_finished
+        if self.kv_connector is not None:
+            # Teardown hook (reference: base.py request_finished).
+            # Synchronous connectors never defer the free; async
+            # (pull-based) connectors will return defer=True here and the
+            # free then waits on the worker's finished_sending notice.
+            defer, _params = self.kv_connector.request_finished(
+                request,
+                self.kv_cache_manager.get_block_ids(request.request_id)
+                if request.request_id in getattr(
+                    self.kv_cache_manager, "req_to_blocks", {}) else [])
+            assert not defer, "deferred free not supported yet"
         self.kv_cache_manager.free(request)
         self.kv_cache_manager.free_block_hashes(request)
         self.finished_req_ids.add(request.request_id)
@@ -312,7 +327,24 @@ class Scheduler:
                     if request.num_cached_tokens < 0:
                         request.num_cached_tokens = num_computed_tokens
 
-                num_new_tokens = request.num_tokens - num_computed_tokens
+                # Disaggregated prefill: KV for part of the prompt may be
+                # loadable from outside (reference: scheduler.py waiting
+                # loop KVConnector.get_num_new_matched_tokens). External
+                # pages are allocated now and filled by the worker-side
+                # connector before the forward pass.
+                num_external = 0
+                if self.kv_connector is not None:
+                    num_external, load_async = \
+                        self.kv_connector.get_num_new_matched_tokens(
+                            request, num_computed_tokens)
+                    # Async (pull-based) loads need the hold-until-loaded
+                    # state machine; fail loudly rather than read pages
+                    # before the transfer lands.
+                    assert not load_async, \
+                        "async KV loads not supported yet"
+
+                num_new_tokens = (request.num_tokens - num_computed_tokens -
+                                  num_external)
                 if self.long_prefill_token_threshold > 0:
                     num_new_tokens = min(num_new_tokens,
                                          self.long_prefill_token_threshold)
@@ -323,7 +355,8 @@ class Scheduler:
                 assert num_new_tokens > 0
 
                 new_blocks = self.kv_cache_manager.allocate_slots(
-                    request, num_new_tokens, new_computed_blocks)
+                    request, num_external + num_new_tokens,
+                    new_computed_blocks)
                 if new_blocks is None:
                     # Out of pages; retry next step. A fresh token-parallel
                     # request holding nothing un-pins from its rank so the
@@ -340,6 +373,17 @@ class Scheduler:
                 resumed = request.status == RequestStatus.PREEMPTED
                 request.status = RequestStatus.RUNNING
                 request.num_computed_tokens = num_computed_tokens
+                if num_external:
+                    # Externally-loaded tokens count as computed; the
+                    # worker-side connector fills their pages before the
+                    # step's forward.
+                    self.kv_connector.update_state_after_alloc(
+                        request,
+                        self.kv_cache_manager.get_block_ids(
+                            request.request_id),
+                        num_external)
+                    num_computed_tokens += num_external
+                    request.num_computed_tokens = num_computed_tokens
                 self.running.append(request)
 
                 num_scheduled_tokens[request.request_id] = num_new_tokens

@@ -1,0 +1,24 @@
+"""KV-transfer connector factory (reference:
+vllm/distributed/kv_transfer/kv_connector/factory.py)."""
+
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.distributed.kv_transfer.base import (
+    KVConnectorBase, KVConnectorRole)
+
+__all__ = ["KVConnectorBase", "KVConnectorRole", "create_kv_connector"]
+
+
+def create_kv_connector(config: EngineConfig,
+                        role: KVConnectorRole) -> Optional[KVConnectorBase]:
+    """Build the configured connector for one side (scheduler or worker);
+    None when KV transfer is disabled."""
+    name = config.kv_transfer_config.kv_connector
+    if not name:
+        return None
+    if name == "SharedStorageConnector":
+        from vllm_distributed_tpu.distributed.kv_transfer.shared_storage \
+            import SharedStorageConnector
+        return SharedStorageConnector(config, role)
+    raise ValueError(f"unknown kv connector {name!r}")

@@ -1,0 +1,95 @@
+"""KV-transfer connector API: the seam for disaggregated prefill/decode.
+
+Reference: vllm/distributed/kv_transfer/kv_connector/v1/base.py:1-288 —
+the same scheduler-side / worker-side split:
+
+* Scheduler side (runs in the engine-core process, no device access):
+  ``get_num_new_matched_tokens`` (how much of a waiting prompt's KV can
+  come from outside), ``update_state_after_alloc`` (pages granted for the
+  external span), ``build_connector_meta`` (per-step instructions
+  piggybacked on SchedulerOutput), ``request_finished`` (deferred-free /
+  handoff params).
+* Worker side (runs next to the model runner, owns device transfers):
+  ``start_load_kv`` before the forward pass, ``save_kv`` after it,
+  ``get_finished`` for async completion notifications.
+
+TPU adaptation: the KV cache is a sharded jax array owned by the model
+runner, so worker-side methods receive the runner and mutate
+``runner.kv_caches`` with scatter/gather device ops instead of writing
+GPU tensors layer-by-layer during the forward (XLA owns the forward; KV
+moves happen at step boundaries).
+"""
+
+import enum
+from typing import TYPE_CHECKING, Any, Optional
+
+if TYPE_CHECKING:
+    from vllm_distributed_tpu.request import Request
+
+
+class KVConnectorRole(enum.Enum):
+    SCHEDULER = "scheduler"
+    WORKER = "worker"
+
+
+class KVConnectorBase:
+    """Both halves of the connector API; subclasses implement the side(s)
+    they support (reference: base.py:53 role enum + split)."""
+
+    def __init__(self, config, role: KVConnectorRole) -> None:
+        self.config = config
+        self.role = role
+        # Scheduler side: set by the Scheduler so connectors can query
+        # current block ids without threading them through every hook.
+        self.kv_manager = None
+
+    # ------------------------------------------------------------------
+    # Scheduler side
+    # ------------------------------------------------------------------
+    def get_num_new_matched_tokens(
+            self, request: "Request",
+            num_computed_tokens: int) -> tuple[int, bool]:
+        """Tokens beyond ``num_computed_tokens`` whose KV can be loaded
+        externally (multiple of the page size; capped so at least one
+        prompt token remains to compute). Second element: True when the
+        load is asynchronous (the scheduler must hold the request until
+        the worker reports the load finished)."""
+        return 0, False
+
+    def update_state_after_alloc(self, request: "Request",
+                                 block_ids: list[int],
+                                 num_external_tokens: int) -> None:
+        """Called after pages were allocated for a request with external
+        tokens; ``block_ids`` is the request's full page list."""
+
+    def build_connector_meta(self, scheduler_output) -> Optional[Any]:
+        """Per-step worker instructions; attached to
+        ``SchedulerOutput.kv_connector_metadata`` (must be picklable for
+        the multiprocess engine core)."""
+        return None
+
+    def request_finished(
+            self, request: "Request",
+            block_ids: list[int]) -> tuple[bool, Optional[dict]]:
+        """Request teardown hook. Returns (defer_free, kv_transfer_params):
+        defer_free=True keeps the pages alive until the peer pulled them
+        (reference: nixl_connector.py:295)."""
+        return False, None
+
+    # ------------------------------------------------------------------
+    # Worker side
+    # ------------------------------------------------------------------
+    def start_load_kv(self, metadata, runner) -> None:
+        """Load external KV into ``runner.kv_caches`` pages BEFORE the
+        step's forward (reference: base.py start_load_kv +
+        wait_for_layer_load, collapsed: XLA runs the whole forward as one
+        program, so loads complete up front)."""
+
+    def save_kv(self, metadata, runner) -> None:
+        """Persist/send KV pages AFTER the step's forward wrote them
+        (reference: save_kv_layer + wait_for_save, collapsed)."""
+
+    def get_finished(self) -> tuple[set[str], set[str]]:
+        """(finished_sending, finished_recving) request ids for async
+        transfers; synchronous connectors return empty sets."""
+        return set(), set()

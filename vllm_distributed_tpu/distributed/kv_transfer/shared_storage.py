@@ -1,0 +1,231 @@
+"""Filesystem KV connector: disaggregated prefill via a shared directory.
+
+Reference: vllm/distributed/kv_transfer/kv_connector/v1/
+shared_storage_connector.py — the simple/testing connector proving the
+producer -> consumer lifecycle. A prefill engine (kv_role=kv_producer)
+saves each full prompt page's K/V under the page's CHAINED CONTENT HASH
+(the same hashing the prefix cache uses, core/kv_cache_utils.py), and a
+decode engine (kv_role=kv_consumer) looks prompt pages up by hash and
+loads hits directly into its paged cache, skipping prefill compute for
+the matched prefix.
+
+Content-hash keying makes the store position-independent and
+prefix-granular: a consumer prompt that extends a producer prompt hits
+on the shared page prefix. Files are one .npz per page, written
+atomically (tmp + rename) so concurrent engines never read torn pages.
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.core.kv_cache_utils import hash_request_tokens
+from vllm_distributed_tpu.distributed.kv_transfer.base import (
+    KVConnectorBase, KVConnectorRole)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import Request
+
+logger = init_logger(__name__)
+
+DEFAULT_STORAGE_PATH = "/tmp/vdt_kv_storage"
+
+
+@dataclass
+class _ReqLoad:
+    """One request's pending external load."""
+
+    req_id: str
+    page_ids: list[int]
+    hashes: list[str]  # hex file keys, aligned with page_ids
+
+
+@dataclass
+class _ReqSave:
+    req_id: str
+    page_ids: list[int]
+    hashes: list[str]
+
+
+@dataclass
+class SharedStorageConnectorMetadata:
+    """Per-step worker instructions (picklable; rides on
+    SchedulerOutput.kv_connector_metadata)."""
+
+    loads: list[_ReqLoad] = field(default_factory=list)
+    saves: list[_ReqSave] = field(default_factory=list)
+
+
+class SharedStorageConnector(KVConnectorBase):
+
+    def __init__(self, config, role: KVConnectorRole) -> None:
+        super().__init__(config, role)
+        extra = config.kv_transfer_config.kv_connector_extra_config or {}
+        self.path = extra.get("shared_storage_path", DEFAULT_STORAGE_PATH)
+        os.makedirs(self.path, exist_ok=True)
+        self.block_size = config.cache_config.block_size
+        self.is_producer = config.kv_transfer_config.is_kv_producer
+        self.is_consumer = config.kv_transfer_config.is_kv_consumer
+
+        # Scheduler-side state.
+        self._reqs: dict[str, Request] = {}
+        self._pending_loads: dict[str, _ReqLoad] = {}
+        self._saved: set[str] = set()
+        # req_id -> (num_computed_tokens, hit hashes): admission-retry memo.
+        self._lookup_memo: dict[str, tuple[int, list[str]]] = {}
+        # Stats (tests + observability).
+        self.num_pages_loaded = 0
+        self.num_pages_saved = 0
+        self.num_lookup_hits = 0
+
+    # ------------------------------------------------------------------
+    def _file(self, hash_hex: str) -> str:
+        return os.path.join(self.path, f"{hash_hex}.npz")
+
+    # ------------------------------------------------------------------
+    # Scheduler side
+    # ------------------------------------------------------------------
+    def get_num_new_matched_tokens(
+            self, request: Request,
+            num_computed_tokens: int) -> tuple[int, bool]:
+        self._reqs[request.request_id] = request
+        if not self.is_consumer:
+            return 0, False
+        # A failed admission retries the same queue head every step;
+        # memoize so retries cost no re-hash / filesystem stats. (Hit
+        # stats count staged loads in build_connector_meta, so retries
+        # are not double-counted.)
+        memo = self._lookup_memo.get(request.request_id)
+        if memo is not None and memo[0] == num_computed_tokens:
+            hit_hashes = memo[1]
+        else:
+            bs = self.block_size
+            hashes = hash_request_tokens(bs, request)
+            # Cap so at least one prompt token remains to be computed
+            # (the last token must produce a logit — same rule as the
+            # local prefix cache, kv_cache_manager.py
+            # get_computed_blocks).
+            max_hit_pages = (request.num_tokens - 1) // bs
+            start = num_computed_tokens // bs
+            hit_hashes = []
+            for i in range(start, min(len(hashes), max_hit_pages)):
+                key = hashes[i].hash_value.hex()
+                if not os.path.exists(self._file(key)):
+                    break
+                hit_hashes.append(key)
+            self._lookup_memo[request.request_id] = (num_computed_tokens,
+                                                     hit_hashes)
+        if not hit_hashes:
+            return 0, False
+        self._pending_loads[request.request_id] = _ReqLoad(
+            req_id=request.request_id, page_ids=[], hashes=list(hit_hashes))
+        logger.info("external KV hit: %s pages for request %s",
+                    len(hit_hashes), request.request_id)
+        return len(hit_hashes) * self.block_size, False  # synchronous
+
+    def update_state_after_alloc(self, request: Request,
+                                 block_ids: list[int],
+                                 num_external_tokens: int) -> None:
+        load = self._pending_loads.get(request.request_id)
+        if load is None or num_external_tokens == 0:
+            return
+        bs = self.block_size
+        start = (request.num_computed_tokens // bs)
+        n = num_external_tokens // bs
+        load.page_ids = block_ids[start:start + n]
+        load.hashes = load.hashes[:n]
+
+    def build_connector_meta(self,
+                             scheduler_output
+                             ) -> SharedStorageConnectorMetadata:
+        meta = SharedStorageConnectorMetadata()
+        # Loads staged by the waiting-queue admissions this step.
+        for req_id in list(self._pending_loads):
+            if req_id in scheduler_output.num_scheduled_tokens:
+                load = self._pending_loads.pop(req_id)
+                if load.page_ids:
+                    meta.loads.append(load)
+                    self.num_lookup_hits += 1
+                    self._lookup_memo.pop(req_id, None)
+        # Saves: producer requests whose prompt prefill completes this
+        # step (their full prompt pages' KV exists after the forward).
+        if self.is_producer:
+            for req_id, n_sched in \
+                    scheduler_output.num_scheduled_tokens.items():
+                request = self._reqs.get(req_id)
+                if request is None or req_id in self._saved:
+                    continue
+                done = request.num_computed_tokens + n_sched
+                if done < request.num_prompt_tokens:
+                    continue  # still prefilling
+                bs = self.block_size
+                n_full = request.num_prompt_tokens // bs
+                if n_full == 0:
+                    self._saved.add(req_id)
+                    continue
+                hashes = [
+                    bh.hash_value.hex()
+                    for bh in hash_request_tokens(bs, request)[:n_full]
+                ]
+                page_ids = self.kv_manager.get_block_ids(req_id)[:n_full]
+                meta.saves.append(
+                    _ReqSave(req_id=req_id, page_ids=page_ids,
+                             hashes=hashes))
+                self._saved.add(req_id)
+        # Teardown bookkeeping.
+        for req_id in scheduler_output.finished_req_ids:
+            self._reqs.pop(req_id, None)
+            self._pending_loads.pop(req_id, None)
+            self._lookup_memo.pop(req_id, None)
+            self._saved.discard(req_id)
+        return meta
+
+    # ------------------------------------------------------------------
+    # Worker side
+    # ------------------------------------------------------------------
+    def start_load_kv(self, metadata, runner) -> None:
+        if not metadata or not metadata.loads:
+            return
+        k_all = runner.kv_caches["k"]
+        v_all = runner.kv_caches["v"]
+        for load in metadata.loads:
+            ks, vs = [], []
+            for key in load.hashes:
+                with np.load(self._file(key)) as f:
+                    ks.append(f["k"])
+                    vs.append(f["v"])
+            pages = np.asarray(load.page_ids, np.int32)
+            # [n, L, KVH, PS, D] -> set at [:, pages]: move L in front.
+            k_new = np.stack(ks, axis=1)  # [L, n, KVH, PS, D]
+            v_new = np.stack(vs, axis=1)
+            k_all = k_all.at[:, pages].set(k_new.astype(k_all.dtype))
+            v_all = v_all.at[:, pages].set(v_new.astype(v_all.dtype))
+            self.num_pages_loaded += len(pages)
+            logger.info("loaded %d external KV pages for %s", len(pages),
+                        load.req_id)
+        runner.kv_caches = {"k": k_all, "v": v_all}
+
+    def save_kv(self, metadata, runner) -> None:
+        if not metadata or not metadata.saves:
+            return
+        import jax
+        k_all = runner.kv_caches["k"]
+        v_all = runner.kv_caches["v"]
+        for save in metadata.saves:
+            todo = [(pid, key)
+                    for pid, key in zip(save.page_ids, save.hashes)
+                    if not os.path.exists(self._file(key))]
+            if not todo:
+                continue
+            pages = np.asarray([pid for pid, _ in todo], np.int32)
+            k_np = np.asarray(jax.device_get(k_all[:, pages]))
+            v_np = np.asarray(jax.device_get(v_all[:, pages]))
+            for i, (_, key) in enumerate(todo):
+                tmp = self._file(key) + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    np.savez(f, k=k_np[:, i], v=v_np[:, i])
+                os.replace(tmp, self._file(key))
+            self.num_pages_saved += len(todo)
+            logger.info("saved %d KV pages for %s", len(todo),
+                        save.req_id)

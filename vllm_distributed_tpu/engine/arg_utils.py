@@ -54,6 +54,7 @@ class EngineArgs:
 
     kv_connector: Optional[str] = None
     kv_role: Optional[str] = None
+    kv_connector_extra_config: Optional[dict] = None
 
     otlp_traces_endpoint: Optional[str] = None
 
@@ -103,6 +104,8 @@ class EngineArgs:
             kv_transfer_config=KVTransferConfig(
                 kv_connector=self.kv_connector,
                 kv_role=self.kv_role,
+                kv_connector_extra_config=(
+                    self.kv_connector_extra_config or {}),
             ),
             observability_config=ObservabilityConfig(
                 otlp_traces_endpoint=self.otlp_traces_endpoint),

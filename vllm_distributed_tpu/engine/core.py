@@ -29,7 +29,13 @@ class EngineCore:
 
         num_pages = self._initialize_kv_caches()
         config.cache_config.num_gpu_blocks = num_pages
-        self.scheduler = Scheduler(config, num_blocks=num_pages)
+        # Scheduler-side KV connector (disaggregated prefill; reference:
+        # core.py constructs the connector beside the scheduler).
+        from vllm_distributed_tpu.distributed.kv_transfer import (
+            KVConnectorRole, create_kv_connector)
+        kv_connector = create_kv_connector(config, KVConnectorRole.SCHEDULER)
+        self.scheduler = Scheduler(config, num_blocks=num_pages,
+                                   kv_connector=kv_connector)
 
     def _initialize_kv_caches(self) -> int:
         num_pages = self.executor.determine_num_available_blocks()

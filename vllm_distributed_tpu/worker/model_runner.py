@@ -59,6 +59,17 @@ class TPUModelRunner:
         # gpu_model_runner.py:334 _build_token_parallel_metadata).
         self.tknp_size = config.parallel_config.token_parallel_size
 
+        # Worker-side KV connector (disaggregated prefill; reference:
+        # gpu_model_runner.py maybe_setup_kv_connector :2047).
+        from vllm_distributed_tpu.distributed.kv_transfer import (
+            KVConnectorRole, create_kv_connector)
+        self.kv_connector = create_kv_connector(config,
+                                                KVConnectorRole.WORKER)
+        if self.kv_connector is not None and self.tknp_size > 1:
+            raise NotImplementedError(
+                "KV transfer with token parallelism needs per-rank page "
+                "routing in the connector; not wired yet")
+
         self.input_batch = InputBatch(
             max_num_reqs=self.max_num_reqs,
             max_model_len=self.max_model_len,
@@ -474,6 +485,11 @@ class TPUModelRunner:
 
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
         topk_np = None
+        kv_meta = scheduler_output.kv_connector_metadata
+        if self.kv_connector is not None and kv_meta is not None:
+            # External KV lands in the paged cache BEFORE the forward
+            # (reference: maybe_setup_kv_connector/start_load_kv).
+            self.kv_connector.start_load_kv(kv_meta, self)
         with self.mesh:
             with self._compile_watch(("fwd", ) + fwd_shape):
                 self.kv_caches, hidden = self._forward_fn(
@@ -494,6 +510,12 @@ class TPUModelRunner:
 
         tokens_np = np.asarray(jax.device_get(tokens))
         logprobs_np = np.asarray(jax.device_get(logprobs))
+
+        if self.kv_connector is not None and kv_meta is not None:
+            # The forward wrote this step's KV; persist producer pages
+            # (reference: save_kv_layer/wait_for_save, collapsed to one
+            # post-step call — XLA ran the whole forward already).
+            self.kv_connector.save_kv(kv_meta, self)
 
         req_ids, sampled, lps = [], [], []
         spec_out: Optional[list[list[int]]] = [] if self.spec_k else None

@@ -67,9 +67,9 @@ class TPUWorker:
 
         override = self.config.cache_config.num_gpu_blocks_override
         if override:
-            # Honor the override verbatim (tests use tiny pools to force
-            # preemption); only the token-axis divisibility is enforced.
-            return (override // tknp) * tknp if tknp > 1 else override
+            # Honored verbatim (tests use tiny pools to force preemption);
+            # token-axis divisibility was validated at config time.
+            return override
         avail = self.model_runner.profile_memory_bytes()
         page_bytes = self.model_runner.kv_cache_bytes_per_page()
         if avail <= 0:
