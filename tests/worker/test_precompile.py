@@ -49,6 +49,29 @@ def test_no_recompile_after_warmup(checkpoint, monkeypatch):
     assert not engine.has_unfinished_requests()
 
 
+def test_no_recompile_after_warmup_pp(checkpoint, monkeypatch):
+    """The pipeline-parallel runner's per-stage warm-up must also close
+    the lattice: mixed traffic after precompile() never compiles."""
+    monkeypatch.setenv("VDT_PRECOMPILE", "1")
+    monkeypatch.setenv("VDT_ASSERT_NO_RECOMPILE", "1")
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=4,
+                         pipeline_parallel_size=2)
+    rng = np.random.default_rng(1)
+    prompts = [[int(x) for x in rng.integers(2, 127, size=n)]
+               for n in (3, 11, 23, 2)]
+    for i, p in enumerate(prompts):
+        engine.add_request(f"pp{i}", p,
+                           SamplingParams(temperature=0.0,
+                                          max_tokens=4 + i % 3,
+                                          ignore_eos=True))
+    for _ in range(200):
+        engine.step()
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+
+
 def test_no_recompile_multi_step(checkpoint, monkeypatch):
     monkeypatch.setenv("VDT_PRECOMPILE", "1")
     monkeypatch.setenv("VDT_ASSERT_NO_RECOMPILE", "1")

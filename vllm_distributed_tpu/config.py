@@ -342,6 +342,12 @@ class EngineConfig:
             # The fused multi-step burst cannot refresh per-rank token-
             # parallel metadata on device; fall back to single-step.
             self.scheduler_config.num_scheduler_steps = 1
+        if (self.parallel_config.pipeline_parallel_size > 1
+                and self.scheduler_config.num_scheduler_steps > 1):
+            # The fused multi-step burst is a single-program graph; the
+            # staged pipeline replaces it (consecutive steps already
+            # overlap across stages via async dispatch).
+            self.scheduler_config.num_scheduler_steps = 1
         if (self.kv_transfer_config.kv_connector
                 and self.scheduler_config.num_scheduler_steps > 1):
             # Connector load/save hooks run at step boundaries; the fused

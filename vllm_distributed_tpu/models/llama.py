@@ -160,11 +160,15 @@ class LlamaForCausalLM:
         }
 
     def make_kv_caches(self, num_pages: int, page_size: int,
-                       cache_dtype=None) -> dict:
+                       cache_dtype=None,
+                       num_layers: Optional[int] = None) -> dict:
+        """Stacked [L, ...] cache; ``num_layers`` overrides the depth for
+        a pipeline stage's local slice."""
         from vllm_distributed_tpu.ops.attention import storage_head_dim
         c = self.cfg
-        shape = (c.num_layers, num_pages, c.num_kv_heads, page_size,
-                 storage_head_dim(c.head_dim))
+        depth = num_layers if num_layers is not None else c.num_layers
+        shape = (depth, num_pages, c.num_kv_heads,
+                 page_size, storage_head_dim(c.head_dim))
         dtype = cache_dtype or c.dtype
         return {
             "k": jnp.zeros(shape, dtype),
@@ -231,20 +235,28 @@ class LlamaForCausalLM:
     # ------------------------------------------------------------------
     # Forward
     # ------------------------------------------------------------------
-    def forward(
+    def embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
+        """Token embedding (pipeline stage 0 front; reference: the
+        VocabParallelEmbedding layer)."""
+        return params["embed"][token_ids]
+
+    def run_layers(
         self,
-        params: dict,
+        layer_params: dict,
         kv_caches: dict,
-        token_ids: jax.Array,  # [T] int32
+        hidden: jax.Array,  # [T, H]
         batch: AttentionBatch,
     ) -> tuple[jax.Array, dict]:
-        """Run the decoder over a flat ragged token batch; returns final
-        hidden states [T, H] and the updated KV caches."""
+        """Run a contiguous slice of decoder layers over the hidden
+        states. ``layer_params`` is a stacked [Ls, ...] subtree and
+        ``kv_caches`` that slice's own [Ls, ...] cache — under pipeline
+        parallelism each stage calls this with its local slice
+        (reference: the per-stage module list built by get_pp_indices,
+        distributed/utils.py:89)."""
         c = self.cfg
-        T = token_ids.shape[0]
+        T = hidden.shape[0]
         sm_scale = c.head_dim ** -0.5
-
-        hidden = params["embed"][token_ids]  # [T, H]
+        num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
         cos, sin = compute_rope_cos_sin(batch.positions, c.head_dim,
                                         c.rope_theta, c.rope_scaling,
                                         dtype=jnp.float32)
@@ -285,11 +297,23 @@ class LlamaForCausalLM:
             h = h + swiglu(x2, lp["gate"], lp["up"], lp["down"])
             return (h, k_all, v_all), None
 
-        layer_ids = jnp.arange(c.num_layers, dtype=jnp.int32)[:, None]
+        layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]
         (hidden, k_all, v_all), _ = jax.lax.scan(
             layer_fn, (hidden, kv_caches["k"], kv_caches["v"]),
-            (params["layers"], layer_ids))
+            (layer_params, layer_ids))
         return hidden, {"k": k_all, "v": v_all}
+
+    def forward(
+        self,
+        params: dict,
+        kv_caches: dict,
+        token_ids: jax.Array,  # [T] int32
+        batch: AttentionBatch,
+    ) -> tuple[jax.Array, dict]:
+        """Run the decoder over a flat ragged token batch; returns final
+        hidden states [T, H] and the updated KV caches."""
+        hidden = self.embed(params, token_ids)
+        return self.run_layers(params["layers"], kv_caches, hidden, batch)
 
     def compute_logits(self, params: dict,
                        hidden: jax.Array) -> jax.Array:

@@ -53,9 +53,12 @@ def load_hf_state_dict(model_path: str) -> dict[str, np.ndarray]:
         f"no safetensors/pytorch_model.bin under {model_path}")
 
 
-def get_model(config: EngineConfig, mesh) -> tuple[Any, dict]:
+def get_model(config: EngineConfig, mesh,
+              shard: bool = True) -> tuple[Any, dict]:
     """Build the model class for the config and return (model, params) with
-    params placed on the mesh according to the model's PartitionSpecs."""
+    params placed on the mesh according to the model's PartitionSpecs.
+    ``shard=False`` returns host-resident params (the pipeline-parallel
+    runner slices layers per stage and places each slice itself)."""
     hf_config = config.model_config.maybe_load_hf_config()
     model_cls = resolve_architecture(hf_config)
     dtype = _dtype_from_str(config.model_config.dtype)
@@ -77,6 +80,9 @@ def get_model(config: EngineConfig, mesh) -> tuple[Any, dict]:
         tensors = load_hf_state_dict(model_path)
         params = model.params_from_hf_state_dict(tensors)
         logger.info("loaded %d tensors from %s", len(tensors), model_path)
+
+    if not shard:
+        return model, params
 
     specs = model.param_specs()
 

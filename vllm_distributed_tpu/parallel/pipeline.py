@@ -1,0 +1,44 @@
+"""Pipeline-parallel layout helpers.
+
+TPU-native PP design (vs the reference's per-rank processes exchanging
+IntermediateTensors over NCCL, vllm/distributed/utils.py:89
+``get_pp_indices`` + parallel_state.py send/recv): the global device mesh
+is sliced along the ``pipe`` axis into per-stage sub-meshes; each stage
+is its own jitted program (embed + its layer slice, or layers + sampler)
+holding that slice's weights and KV cache, and activations hop stages
+with ``jax.device_put`` — an ICI/DCN transfer the runtime overlaps with
+compute thanks to JAX async dispatch. Consecutive engine steps pipeline
+naturally: stage p of step i runs while stage p-1 processes step i+1.
+"""
+
+import numpy as np
+from jax.sharding import Mesh
+
+from vllm_distributed_tpu.config import MESH_AXIS_PIPE
+
+from vllm_distributed_tpu.parallel.mesh import AXIS_ORDER
+
+
+def partition_layers(num_layers: int, pp_size: int) -> list[tuple[int, int]]:
+    """Contiguous [start, end) layer ranges per stage; remainder layers go
+    to the earlier stages (reference: distributed/utils.py:89
+    get_pp_indices semantics with even spread)."""
+    base = num_layers // pp_size
+    extra = num_layers % pp_size
+    ranges = []
+    start = 0
+    for p in range(pp_size):
+        n = base + (1 if p < extra else 0)
+        ranges.append((start, start + n))
+        start += n
+    assert start == num_layers
+    return ranges
+
+
+def stage_submesh(mesh: Mesh, stage: int) -> Mesh:
+    """Sub-mesh of one pipeline stage: the slice of the device array at
+    pipe index ``stage``, with the pipe axis kept at size 1 so every
+    PartitionSpec naming it still resolves."""
+    axis = AXIS_ORDER.index(MESH_AXIS_PIPE)
+    devs = np.take(mesh.devices, [stage], axis=axis)
+    return Mesh(devs, AXIS_ORDER)

@@ -483,33 +483,15 @@ class TPUModelRunner:
          fwd_shape, R, drafts_arr, ext_md, want_topk) = \
             self._prepare_inputs(scheduler_output)
 
-        n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
-        topk_np = None
         kv_meta = scheduler_output.kv_connector_metadata
         if self.kv_connector is not None and kv_meta is not None:
             # External KV lands in the paged cache BEFORE the forward
             # (reference: maybe_setup_kv_connector/start_load_kv).
             self.kv_connector.start_load_kv(kv_meta, self)
-        with self.mesh:
-            with self._compile_watch(("fwd", ) + fwd_shape):
-                self.kv_caches, hidden = self._forward_fn(
-                    self.params, self.kv_caches, token_ids, batch)
-            hidden_sel = self._gather_sample_rows(hidden, logits_indices)
-            if ext_md is not None:
-                with self._compile_watch(("sampleX", n_rows, want_topk)):
-                    tokens, logprobs, topv, topi = self._sample_ext_fn(
-                        self.params, hidden_sel, sampling_md, ext_md,
-                        want_topk)
-                if want_topk:
-                    topk_np = (np.asarray(jax.device_get(topv)),
-                               np.asarray(jax.device_get(topi)))
-            else:
-                with self._compile_watch(("sample", n_rows)):
-                    tokens, logprobs = self._sample_fn(
-                        self.params, hidden_sel, sampling_md)
 
-        tokens_np = np.asarray(jax.device_get(tokens))
-        logprobs_np = np.asarray(jax.device_get(logprobs))
+        tokens_np, logprobs_np, topk_np = self._run_device_step(
+            token_ids, batch, logits_indices, sampling_md, fwd_shape,
+            ext_md, want_topk)
 
         if self.kv_connector is not None and kv_meta is not None:
             # The forward wrote this step's KV; persist producer pages
@@ -571,6 +553,41 @@ class TPUModelRunner:
                                  sampled_token_ids=sampled,
                                  logprobs=lps,
                                  spec_token_ids=spec_out)
+
+    def _run_device_step(self, token_ids, batch, logits_indices,
+                         sampling_md, fwd_shape, ext_md, want_topk):
+        """The device part of one step: forward + row gather + sampling.
+        Returns host numpy (tokens, logprobs, topk or None). The
+        pipeline-parallel runner overrides only the forward half."""
+        with self.mesh:
+            with self._compile_watch(("fwd", ) + fwd_shape):
+                self.kv_caches, hidden = self._forward_fn(
+                    self.params, self.kv_caches, token_ids, batch)
+            return self._run_sample(hidden, logits_indices, sampling_md,
+                                    ext_md, want_topk, self.mesh)
+
+    def _run_sample(self, hidden, logits_indices, sampling_md, ext_md,
+                    want_topk, mesh):
+        """Row gather + (extended) sampling on ``mesh``; shared by the
+        single-program and pipeline-parallel step paths."""
+        n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
+        topk_np = None
+        hidden_sel = self._gather_sample_rows(hidden, logits_indices,
+                                              mesh=mesh)
+        if ext_md is not None:
+            with self._compile_watch(("sampleX", n_rows, want_topk)):
+                tokens, logprobs, topv, topi = self._sample_ext_fn(
+                    self.params, hidden_sel, sampling_md, ext_md,
+                    want_topk)
+            if want_topk:
+                topk_np = (np.asarray(jax.device_get(topv)),
+                           np.asarray(jax.device_get(topi)))
+        else:
+            with self._compile_watch(("sample", n_rows)):
+                tokens, logprobs = self._sample_fn(
+                    self.params, hidden_sel, sampling_md)
+        return (np.asarray(jax.device_get(tokens)),
+                np.asarray(jax.device_get(logprobs)), topk_np)
 
     def _lp_dict(self, req_id: str, flat_row: int, token: int,
                  chosen_lp: float, topk_np) -> dict[int, float]:
@@ -683,14 +700,14 @@ class TPUModelRunner:
             logger.info("compiled %s in %.1fs", key,
                         time.perf_counter() - start)
 
-    def _gather_sample_rows(self, hidden, logits_indices):
+    def _gather_sample_rows(self, hidden, logits_indices, mesh=None):
         """[R]-row gather between the forward and sample jits, committed to
         a REPLICATED sharding: jax.jit keys its cache on input sharding, so
         the sampler must see the same sharding at warm-up and serving or
         every ('sample', R) shape would recompile on a >1-device mesh."""
         from jax.sharding import NamedSharding, PartitionSpec
         sel = hidden[logits_indices]
-        return jax.device_put(sel, NamedSharding(self.mesh,
+        return jax.device_put(sel, NamedSharding(mesh or self.mesh,
                                                  PartitionSpec()))
 
     def _dummy_step_inputs(self, T: int, max_q: int, G: int):
@@ -752,43 +769,7 @@ class TPUModelRunner:
                         self.params, self.kv_caches, token_ids, batch)
                 jax.block_until_ready(hidden)
                 n += 1
-            S1 = self.spec_k + 1
-            for R in self.req_buckets:
-                rows = R * S1  # sampler sees S+1 rows/request with spec
-                md = SamplingMetadata(
-                    temperature=jnp.zeros((rows, ), jnp.float32),
-                    top_k=jnp.zeros((rows, ), jnp.int32),
-                    top_p=jnp.ones((rows, ), jnp.float32),
-                    min_p=jnp.zeros((rows, ), jnp.float32),
-                    seeds=jnp.zeros((rows, ), jnp.int64),
-                )
-                hidden_sel = self._gather_sample_rows(
-                    jnp.zeros((rows, self.model.cfg.hidden_size),
-                              self.model.cfg.dtype),
-                    jnp.arange(rows, dtype=jnp.int32))
-                with self._compile_watch(("sample", rows)):
-                    tokens, _ = self._sample_fn(self.params, hidden_sel, md)
-                jax.block_until_ready(tokens)
-                n += 1
-                ext = ExtendedSamplingMetadata(
-                    hist_tokens=jnp.zeros((rows, self.max_model_len),
-                                          jnp.int32),
-                    prompt_len=jnp.zeros((rows, ), jnp.int32),
-                    total_len=jnp.zeros((rows, ), jnp.int32),
-                    presence_penalty=jnp.zeros((rows, ), jnp.float32),
-                    frequency_penalty=jnp.zeros((rows, ), jnp.float32),
-                    repetition_penalty=jnp.ones((rows, ), jnp.float32),
-                    bias_ids=jnp.zeros((rows, self._BIAS_BUF), jnp.int32),
-                    bias_vals=jnp.zeros((rows, self._BIAS_BUF),
-                                        jnp.float32),
-                    base_fill=jnp.zeros((rows, ), jnp.float32),
-                )
-                for want_topk in (False, True):
-                    with self._compile_watch(("sampleX", rows, want_topk)):
-                        tokens, _, _, _ = self._sample_ext_fn(
-                            self.params, hidden_sel, md, ext, want_topk)
-                    jax.block_until_ready(tokens)
-                    n += 1
+            n += self._precompile_samplers(self.mesh)
             n_steps = self.config.scheduler_config.num_scheduler_steps
             if n_steps > 1:
                 for R in self.req_buckets:
@@ -797,6 +778,49 @@ class TPUModelRunner:
         self._precompiled = True
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
+
+    def _precompile_samplers(self, mesh) -> int:
+        """Warm the plain + extended sampler graphs for every row bucket
+        on ``mesh`` (the last stage's sub-mesh under PP). Returns the
+        number of graphs compiled."""
+        n = 0
+        S1 = self.spec_k + 1
+        for R in self.req_buckets:
+            rows = R * S1  # sampler sees S+1 rows/request with spec
+            md = SamplingMetadata(
+                temperature=jnp.zeros((rows, ), jnp.float32),
+                top_k=jnp.zeros((rows, ), jnp.int32),
+                top_p=jnp.ones((rows, ), jnp.float32),
+                min_p=jnp.zeros((rows, ), jnp.float32),
+                seeds=jnp.zeros((rows, ), jnp.int64),
+            )
+            hidden_sel = self._gather_sample_rows(
+                jnp.zeros((rows, self.model.cfg.hidden_size),
+                          self.model.cfg.dtype),
+                jnp.arange(rows, dtype=jnp.int32), mesh=mesh)
+            with self._compile_watch(("sample", rows)):
+                tokens, _ = self._sample_fn(self.params, hidden_sel, md)
+            jax.block_until_ready(tokens)
+            n += 1
+            ext = ExtendedSamplingMetadata(
+                hist_tokens=jnp.zeros((rows, self.max_model_len),
+                                      jnp.int32),
+                prompt_len=jnp.zeros((rows, ), jnp.int32),
+                total_len=jnp.zeros((rows, ), jnp.int32),
+                presence_penalty=jnp.zeros((rows, ), jnp.float32),
+                frequency_penalty=jnp.zeros((rows, ), jnp.float32),
+                repetition_penalty=jnp.ones((rows, ), jnp.float32),
+                bias_ids=jnp.zeros((rows, self._BIAS_BUF), jnp.int32),
+                bias_vals=jnp.zeros((rows, self._BIAS_BUF), jnp.float32),
+                base_fill=jnp.zeros((rows, ), jnp.float32),
+            )
+            for want_topk in (False, True):
+                with self._compile_watch(("sampleX", rows, want_topk)):
+                    tokens, _, _, _ = self._sample_ext_fn(
+                        self.params, hidden_sel, md, ext, want_topk)
+                jax.block_until_ready(tokens)
+                n += 1
+        return n
 
     def _precompile_multi_step(self, n_steps: int, R: int) -> None:
         md = SamplingMetadata(

@@ -48,7 +48,11 @@ class TPUWorker:
         logger.info("devices: %s", devices)
         self.mesh = build_mesh(self.config.parallel_config, devices)
         set_global_mesh(self.mesh)
-        self.model_runner = TPUModelRunner(self.config, self.mesh)
+        if self.config.parallel_config.pipeline_parallel_size > 1:
+            from vllm_distributed_tpu.worker.pp_runner import PPModelRunner
+            self.model_runner = PPModelRunner(self.config, self.mesh)
+        else:
+            self.model_runner = TPUModelRunner(self.config, self.mesh)
 
     def load_model(self) -> None:
         self.model_runner.load_model()
