@@ -47,6 +47,13 @@ class LlamaArchConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # Qwen2-style qkv bias
+    # Mixture-of-experts (Mixtral-style); 0 experts = dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Shard experts over the "model" mesh axis (EP spans the TP group,
+    # reference: parallel_state.py:1189-1204) instead of TP inside each
+    # expert's FFN.
+    expert_parallel: bool = False
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -67,6 +74,8 @@ class LlamaArchConfig:
             rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
             tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
             attention_bias=getattr(hf, "attention_bias", False),
+            num_experts=getattr(hf, "num_local_experts", 0),
+            num_experts_per_tok=getattr(hf, "num_experts_per_tok", 2),
             dtype=dtype,
         )
 
@@ -235,6 +244,11 @@ class LlamaForCausalLM:
     # ------------------------------------------------------------------
     # Forward
     # ------------------------------------------------------------------
+    def mlp_block(self, lp: dict, x: jax.Array) -> jax.Array:
+        """Per-layer feed-forward; MoE models override this (the MLP is
+        the only structural difference in the decoder block)."""
+        return swiglu(x, lp["gate"], lp["up"], lp["down"])
+
     def embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
         """Token embedding (pipeline stage 0 front; reference: the
         VocabParallelEmbedding layer)."""
@@ -294,7 +308,7 @@ class LlamaForCausalLM:
                                    sm_scale=sm_scale, layer=layer_idx)
             h = h + attn.reshape(T, -1) @ lp["wo"]
             x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
-            h = h + swiglu(x2, lp["gate"], lp["up"], lp["down"])
+            h = h + self.mlp_block(lp, x2)
             return (h, k_all, v_all), None
 
         layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]

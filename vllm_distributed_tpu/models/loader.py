@@ -63,6 +63,7 @@ def get_model(config: EngineConfig, mesh,
     model_cls = resolve_architecture(hf_config)
     dtype = _dtype_from_str(config.model_config.dtype)
     arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
+    arch.expert_parallel = config.parallel_config.enable_expert_parallel
     model = model_cls(arch)
 
     load_format = config.load_config.load_format

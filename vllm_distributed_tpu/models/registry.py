@@ -8,11 +8,13 @@ Llama 2/3, Mistral, Qwen2, and friends.
 
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
+from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
 
 _REGISTRY: dict[str, type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
     "MistralForCausalLM": LlamaForCausalLM,
     "Qwen2ForCausalLM": LlamaForCausalLM,
+    "MixtralForCausalLM": MixtralForCausalLM,
 }
 
 
