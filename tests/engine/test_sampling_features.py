@@ -160,3 +160,33 @@ def test_plain_requests_unaffected(engine, checkpoint):
     got = run(engine, prompt,
               SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True))
     assert got.outputs[0].token_ids == expect
+
+
+def test_oversized_sampler_buffer_rejected_at_admission():
+    """min_tokens stop suppression shares the static sampler buffer with
+    logit_bias; an over-budget combination must be rejected when the
+    SamplingParams is constructed, never inside the engine step (which
+    would kill every in-flight request)."""
+    from vllm_distributed_tpu.sampling_params import (BIAS_BUF_WIDTH,
+                                                      MAX_BIAS_ENTRIES)
+    # Stop ids alone overflowing the buffer.
+    with pytest.raises(ValueError, match="sampler-buffer"):
+        SamplingParams(min_tokens=1,
+                       stop_token_ids=list(range(BIAS_BUF_WIDTH)))
+    # Max bias entries plus enough DISJOINT stop ids to spill (the
+    # runner merges by token id, so only the union counts).
+    with pytest.raises(ValueError, match="sampler-buffer"):
+        SamplingParams(min_tokens=1,
+                       logit_bias={t: 1.0 for t in range(MAX_BIAS_ENTRIES)},
+                       stop_token_ids=list(
+                           range(MAX_BIAS_ENTRIES, BIAS_BUF_WIDTH + 1)))
+    # Overlapping stop ids cost nothing extra.
+    SamplingParams(min_tokens=1,
+                   logit_bias={t: 1.0 for t in range(MAX_BIAS_ENTRIES)},
+                   stop_token_ids=list(range(16)))
+    # The same shapes are fine without min_tokens (stops never enter the
+    # buffer) or within budget.
+    SamplingParams(stop_token_ids=list(range(BIAS_BUF_WIDTH)))
+    SamplingParams(min_tokens=1,
+                   logit_bias={t: 1.0 for t in range(MAX_BIAS_ENTRIES)},
+                   stop_token_ids=[1, 2, 3])

@@ -1,0 +1,121 @@
+"""KV-head replication for tp > num_kv_heads (reference:
+QKVParallelLinear kv-head replication in
+vllm/model_executor/layers/linear.py; the Llama-3-70B shape class —
+8 kv heads, TP=16 — needs this on any pod slice wider than the head
+count)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+from vllm_distributed_tpu.models.common import AttentionBatch
+
+PAGE_SIZE = 4
+NUM_PAGES = 32
+
+
+def tiny_hf_config(**overrides):
+    cfg = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+               num_hidden_layers=3, num_attention_heads=4,
+               num_key_value_heads=2, max_position_embeddings=64,
+               rope_theta=10000.0, tie_word_embeddings=False)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+def run_ours(model, params, token_ids):
+    T = len(token_ids)
+    positions = list(range(T))
+    kv_caches = model.make_kv_caches(NUM_PAGES, PAGE_SIZE)
+    bt = np.zeros((1, 8), np.int32)
+    bt[0, :4] = (1, 2, 3, 4)
+    slot = [bt[0, p // PAGE_SIZE] * PAGE_SIZE + p % PAGE_SIZE
+            for p in positions]
+    batch = AttentionBatch(
+        req_idx=jnp.zeros((T, ), jnp.int32),
+        positions=jnp.asarray(positions, jnp.int32),
+        slot_mapping=jnp.asarray(slot, jnp.int32),
+        block_tables=jnp.asarray(bt),
+        seq_lens=jnp.asarray([T], jnp.int32),
+    )
+    hidden, kv_caches = model.forward(params, kv_caches,
+                                      jnp.asarray(token_ids, jnp.int32),
+                                      batch)
+    logits = model.compute_logits(params, hidden)
+    return np.asarray(logits), kv_caches
+
+
+def test_replicated_kv_logits_match_unreplicated():
+    """Replicated heads (repeat-per-head) must be a numerical no-op."""
+    torch.manual_seed(4)
+    hf = HFLlama(tiny_hf_config()).eval()
+    tensors = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    base_arch = LlamaArchConfig.from_hf_config(hf.config, dtype=jnp.float32)
+    base = LlamaForCausalLM(base_arch)
+    base_params = base.params_from_hf_state_dict(tensors)
+
+    rep_arch = LlamaArchConfig.from_hf_config(hf.config, dtype=jnp.float32)
+    rep_arch.num_kv_head_replicas = 2  # 2 kv heads -> 4 cache heads
+    rep = LlamaForCausalLM(rep_arch)
+    rep_params = rep.params_from_hf_state_dict(tensors)
+    assert rep_params["layers"]["wk"].shape[-1] == \
+        2 * base_params["layers"]["wk"].shape[-1]
+
+    prompt = [3, 17, 92, 45, 8, 77]
+    want, _ = run_ours(base, base_params, prompt)
+    got, _ = run_ours(rep, rep_params, prompt)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=8,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_gqa")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def test_tp8_with_2_kv_heads_matches_hf(checkpoint):
+    """TP wider than the kv-head count through the full engine: 8-way
+    model axis over 2 checkpoint kv heads (x4 replication)."""
+    path, hf = checkpoint
+    engine = LLMEngine(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=4,
+        tensor_parallel_size=8,
+        skip_tokenizer_init=True).create_engine_config())
+    prompts = [[3, 17, 92, 45, 8], [5, 9, 33, 71]]
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r{i}", p, SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True))
+    done = {}
+    for _ in range(100):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    got = [done[f"r{i}"].outputs[0].token_ids for i in range(len(prompts))]
+    want = []
+    for p in prompts:
+        with torch.no_grad():
+            out = hf.generate(torch.tensor([p]), max_new_tokens=6,
+                              do_sample=False, eos_token_id=None)
+        want.append(out[0].tolist()[len(p):])
+    assert got == want

@@ -91,17 +91,28 @@ def test_mixtral_tp_inside_experts_matches_hf(checkpoint):
     assert got == want
 
 
-def test_mixtral_prefill_logits_match_hf(checkpoint):
-    """Dense prefill logits parity (tighter than greedy tokens)."""
-    import jax
+def test_mixtral_prefill_logprobs_match_hf(checkpoint):
+    """Prefill logprob parity (tighter than greedy tokens): the engine's
+    top-k logprobs on the first generated position must match HF's
+    log-softmax over the last prompt position."""
     path, hf = checkpoint
     engine = make_engine(path)
-    runner = engine.engine_core.engine_core.executor.worker.model_runner
     prompt = PROMPTS[0]
+    k = 5
     engine.add_request("lg-0", prompt,
-                       SamplingParams(temperature=0.0, max_tokens=1))
-    engine.step()
+                       SamplingParams(temperature=0.0, max_tokens=1,
+                                      ignore_eos=True, logprobs=k))
+    outs = []
+    for _ in range(50):
+        outs += [o for o in engine.step() if o.finished]
+        if not engine.has_unfinished_requests():
+            break
+    (out, ) = outs
+    got = out.outputs[0].logprobs[0]  # dict[token_id, logprob]
     with torch.no_grad():
-        hf_logits = hf(torch.tensor([prompt])).logits[0, -1].numpy()
-    # Recompute our last-position logits via the model pieces.
-    del engine, runner, jax, hf_logits  # smoke: engine path covered above
+        hf_logits = hf(torch.tensor([prompt])).logits[0, -1]
+    hf_lp = torch.log_softmax(hf_logits.float(), dim=-1)
+    want_vals, want_ids = torch.topk(hf_lp, k)
+    assert set(got) >= set(want_ids.tolist())
+    for tok, val in zip(want_ids.tolist(), want_vals.tolist()):
+        assert abs(got[tok] - val) < 5e-3, (tok, got[tok], val)

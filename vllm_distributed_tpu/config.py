@@ -337,23 +337,26 @@ class EngineConfig:
                 self.scheduler_config.max_num_batched_tokens = max(
                     self.scheduler_config.max_num_batched_tokens,
                     self.scheduler_config.max_model_len)
-        if (self.parallel_config.token_parallel_size > 1
-                and self.scheduler_config.num_scheduler_steps > 1):
-            # The fused multi-step burst cannot refresh per-rank token-
-            # parallel metadata on device; fall back to single-step.
-            self.scheduler_config.num_scheduler_steps = 1
-        if (self.parallel_config.pipeline_parallel_size > 1
-                and self.scheduler_config.num_scheduler_steps > 1):
-            # The fused multi-step burst is a single-program graph; the
-            # staged pipeline replaces it (consecutive steps already
-            # overlap across stages via async dispatch).
-            self.scheduler_config.num_scheduler_steps = 1
-        if (self.kv_transfer_config.kv_connector
-                and self.scheduler_config.num_scheduler_steps > 1):
-            # Connector load/save hooks run at step boundaries; the fused
-            # burst would silently skip them (e.g. a producer's
-            # prefill-completing save staged on a burst step).
-            self.scheduler_config.num_scheduler_steps = 1
+        for reason, incompatible in (
+                # The fused multi-step burst cannot refresh per-rank
+                # token-parallel metadata on device.
+                ("token parallelism",
+                 self.parallel_config.token_parallel_size > 1),
+                # The fused burst is a single-program graph; the staged
+                # pipeline replaces it.
+                ("pipeline parallelism",
+                 self.parallel_config.pipeline_parallel_size > 1),
+                # Connector load/save hooks run at step boundaries; the
+                # fused burst would silently skip them.
+                ("a KV-transfer connector",
+                 bool(self.kv_transfer_config.kv_connector)),
+        ):
+            if incompatible and self.scheduler_config.num_scheduler_steps > 1:
+                logger.warning(
+                    "num_scheduler_steps=%d is incompatible with %s; "
+                    "forcing single-step scheduling",
+                    self.scheduler_config.num_scheduler_steps, reason)
+                self.scheduler_config.num_scheduler_steps = 1
         override = self.cache_config.num_gpu_blocks_override
         tknp = self.parallel_config.token_parallel_size
         if override and tknp > 1 and (override % tknp or override < tknp):

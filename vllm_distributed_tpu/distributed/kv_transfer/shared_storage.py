@@ -189,12 +189,20 @@ class SharedStorageConnector(KVConnectorBase):
             return
         k_all = runner.kv_caches["k"]
         v_all = runner.kv_caches["v"]
+        # Stored pages always hold CHECKPOINT kv heads; re-expand for this
+        # deployment's replication factor so the store stays TP-invariant
+        # (a tp=16 producer and tp=8 consumer exchange pages fine).
+        r = getattr(runner.model.cfg, "num_kv_head_replicas", 1)
         for load in metadata.loads:
             ks, vs = [], []
             for key in load.hashes:
                 with np.load(self._file(key)) as f:
-                    ks.append(f["k"])
-                    vs.append(f["v"])
+                    k, v = f["k"], f["v"]
+                if r > 1:
+                    k = np.repeat(k, r, axis=1)
+                    v = np.repeat(v, r, axis=1)
+                ks.append(k)
+                vs.append(v)
             pages = np.asarray(load.page_ids, np.int32)
             # [n, L, KVH, PS, D] -> set at [:, pages]: move L in front.
             k_new = np.stack(ks, axis=1)  # [L, n, KVH, PS, D]
@@ -212,6 +220,10 @@ class SharedStorageConnector(KVConnectorBase):
         import jax
         k_all = runner.kv_caches["k"]
         v_all = runner.kv_caches["v"]
+        # De-replicate to checkpoint kv heads before persisting (replica
+        # heads are identical by construction; stride-r picks the first
+        # copy of each) so the store layout never depends on TP width.
+        r = getattr(runner.model.cfg, "num_kv_head_replicas", 1)
         for save in metadata.saves:
             todo = [(pid, key)
                     for pid, key in zip(save.page_ids, save.hashes)
@@ -219,8 +231,8 @@ class SharedStorageConnector(KVConnectorBase):
             if not todo:
                 continue
             pages = np.asarray([pid for pid, _ in todo], np.int32)
-            k_np = np.asarray(jax.device_get(k_all[:, pages]))
-            v_np = np.asarray(jax.device_get(v_all[:, pages]))
+            k_np = np.asarray(jax.device_get(k_all[:, pages]))[:, :, ::r]
+            v_np = np.asarray(jax.device_get(v_all[:, pages]))[:, :, ::r]
             for i, (_, key) in enumerate(todo):
                 tmp = self._file(key) + f".tmp{os.getpid()}"
                 with open(tmp, "wb") as f:

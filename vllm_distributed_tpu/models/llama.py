@@ -31,6 +31,23 @@ MODEL_AXIS = "model"
 TOKEN_AXIS = "token"
 
 
+def _replicate_kv_heads(w: jax.Array, num_kv_heads: int,
+                        replicas: int) -> jax.Array:
+    """Repeat each KV head's slice of the last axis ``replicas`` times.
+
+    Per-head repetition (not tiling) keeps the q-head→kv-head map of GQA
+    intact: q heads grouped onto checkpoint head h land on one of h's
+    replicas, so attention output is bit-identical to the un-replicated
+    model."""
+    if replicas == 1:
+        return w
+    *lead, dkv = w.shape
+    head_dim = dkv // num_kv_heads
+    w = w.reshape(*lead, num_kv_heads, head_dim)
+    w = jnp.repeat(w, replicas, axis=-2)
+    return w.reshape(*lead, num_kv_heads * replicas * head_dim)
+
+
 @dataclass
 class LlamaArchConfig:
     """Subset of the HF config the forward pass needs (static)."""
@@ -54,7 +71,20 @@ class LlamaArchConfig:
     # reference: parallel_state.py:1189-1204) instead of TP inside each
     # expert's FFN.
     expert_parallel: bool = False
+    # KV-head replication factor for tp > num_kv_heads (reference:
+    # QKVParallelLinear kv-head replication in
+    # vllm/model_executor/layers/linear.py — each rank holds one whole
+    # KV head when TP exceeds the head count). Each checkpoint KV head's
+    # weights and cache rows are repeated this many times so the kv-head
+    # dimension divides the model mesh axis; repeat-per-head preserves
+    # GQA grouping exactly.
+    num_kv_head_replicas: int = 1
     dtype: Any = jnp.bfloat16
+
+    @property
+    def total_kv_heads(self) -> int:
+        """KV heads actually materialized (checkpoint heads × replicas)."""
+        return self.num_kv_heads * self.num_kv_head_replicas
 
     @classmethod
     def from_hf_config(cls, hf, dtype=jnp.bfloat16) -> "LlamaArchConfig":
@@ -159,6 +189,7 @@ class LlamaForCausalLM:
                 "bk": jnp.zeros((L, Dkv), c.dtype),
                 "bv": jnp.zeros((L, Dkv), c.dtype),
             })
+        self._maybe_replicate_kv(layers)
         embed = norm(next(keys), (c.vocab_size, H))
         return {
             "embed": embed,
@@ -168,6 +199,17 @@ class LlamaForCausalLM:
                 next(keys), (H, c.vocab_size))),
         }
 
+    def _maybe_replicate_kv(self, layers: dict) -> None:
+        """Expand K/V projection weights in place when KV heads are
+        replicated for tp > num_kv_heads."""
+        c = self.cfg
+        if c.num_kv_head_replicas == 1:
+            return
+        for name in ("wk", "wv", "bk", "bv"):
+            if name in layers:
+                layers[name] = _replicate_kv_heads(
+                    layers[name], c.num_kv_heads, c.num_kv_head_replicas)
+
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None,
                        num_layers: Optional[int] = None) -> dict:
@@ -176,7 +218,7 @@ class LlamaForCausalLM:
         from vllm_distributed_tpu.ops.attention import storage_head_dim
         c = self.cfg
         depth = num_layers if num_layers is not None else c.num_layers
-        shape = (depth, num_pages, c.num_kv_heads,
+        shape = (depth, num_pages, c.total_kv_heads,
                  page_size, storage_head_dim(c.head_dim))
         dtype = cache_dtype or c.dtype
         return {
@@ -229,6 +271,7 @@ class LlamaForCausalLM:
                 "bv": stack("model.layers.{}.self_attn.v_proj.bias",
                             transpose=False),
             })
+        self._maybe_replicate_kv(layers)
         embed = jnp.asarray(t("model.embed_tokens.weight"), dtype=c.dtype)
         if c.tie_word_embeddings or "lm_head.weight" not in tensors:
             lm_head = embed.T
@@ -295,8 +338,8 @@ class LlamaForCausalLM:
                 k = k + lp["bk"]
                 v = v + lp["bv"]
             q = q.reshape(T, c.num_q_heads, c.head_dim)
-            k = k.reshape(T, c.num_kv_heads, c.head_dim)
-            v = v.reshape(T, c.num_kv_heads, c.head_dim)
+            k = k.reshape(T, c.total_kv_heads, c.head_dim)
+            v = v.reshape(T, c.total_kv_heads, c.head_dim)
             # RoPE in fp32 for parity with the HF reference, then back.
             q, k = apply_rope(q.astype(jnp.float32), k.astype(jnp.float32),
                               cos, sin)

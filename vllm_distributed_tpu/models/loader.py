@@ -64,6 +64,17 @@ def get_model(config: EngineConfig, mesh,
     dtype = _dtype_from_str(config.model_config.dtype)
     arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
+    # KV-head replication when TP exceeds the checkpoint's KV-head count
+    # (reference: QKVParallelLinear kv replication, layers/linear.py):
+    # repeat heads to the lcm so the kv-head dim divides the model axis.
+    tp = config.parallel_config.tensor_parallel_size
+    if arch.num_kv_heads % tp != 0:
+        import math
+        arch.num_kv_head_replicas = (
+            math.lcm(arch.num_kv_heads, tp) // arch.num_kv_heads)
+        logger.info(
+            "replicating %d KV heads x%d to cover tensor_parallel_size=%d",
+            arch.num_kv_heads, arch.num_kv_head_replicas, tp)
     model = model_cls(arch)
 
     load_format = config.load_config.load_format

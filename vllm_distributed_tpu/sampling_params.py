@@ -24,7 +24,8 @@ _SAMPLING_EPS = 1e-5
 # oversized request is rejected instead of killing the engine mid-step.
 # Reserve headroom for min-tokens stop-suppression entries sharing the
 # buffer.
-MAX_BIAS_ENTRIES = 112
+BIAS_BUF_WIDTH = 128
+MAX_BIAS_ENTRIES = BIAS_BUF_WIDTH - 16  # headroom for stop suppression
 
 
 @dataclass
@@ -107,6 +108,23 @@ class SamplingParams:
                 raise ValueError(
                     f"allowed_token_ids supports at most "
                     f"{MAX_BIAS_ENTRIES} ids")
+        if self.min_tokens > 0:
+            # Stop-suppression entries share the sampler's static bias
+            # buffer with logit_bias/allowed_token_ids while output <
+            # min_tokens; the runner merges entries by token id, so count
+            # the union. +1 reserves room for the tokenizer's EOS folded
+            # in later by update_from_tokenizer (unless ignore_eos).
+            bias_keys = (set(self.allowed_token_ids)
+                         if self.allowed_token_ids is not None else
+                         set(self.logit_bias or ()))
+            need = (len(bias_keys | self._all_stop_token_ids) +
+                    (0 if self.ignore_eos else 1))
+            if need > BIAS_BUF_WIDTH:
+                raise ValueError(
+                    f"min_tokens with {len(self._all_stop_token_ids)} stop "
+                    f"token ids plus {len(bias_keys)} bias/allowed entries "
+                    f"needs {need} sampler-buffer slots; at most "
+                    f"{BIAS_BUF_WIDTH} are available")
 
     @property
     def sampling_type(self) -> SamplingType:

@@ -144,7 +144,7 @@ class TPUModelRunner:
         from vllm_distributed_tpu.ops.attention import storage_head_dim
         c = self.model.cfg
         itemsize = jnp.dtype(c.dtype).itemsize
-        return (2 * c.num_layers * self.page_size * c.num_kv_heads *
+        return (2 * c.num_layers * self.page_size * c.total_kv_heads *
                 storage_head_dim(c.head_dim) * itemsize)
 
     def _build_step_fn(self) -> None:
@@ -422,7 +422,10 @@ class TPUModelRunner:
                 sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md,
                 want_topk)
 
-    _BIAS_BUF = 128  # fixed sparse-bias width; keeps the graph keyed by R
+    # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
+    # validation in SamplingParams guarantees every request fits.
+    from vllm_distributed_tpu.sampling_params import \
+        BIAS_BUF_WIDTH as _BIAS_BUF
 
     def _build_extended_md(self, rows: np.ndarray,
                            expand) -> ExtendedSamplingMetadata:
