@@ -3,9 +3,11 @@
 Mirrors the reference fork's TKNP harness defaults (tknp_inference_
 benchmarks.py:31-58: Llama-3.2-1B architecture, batch 8, 128-token prompt,
 100 decode steps) driven through THIS framework's full engine stack
-(scheduler -> runner -> jitted forward+sample).
+(scheduler -> runner -> jitted forward+sample), and like that harness
+(tknp_inference_benchmarks.py:66-90) reports BOTH prefill time and decode
+throughput, plus a computed MFU (model FLOPs / chip peak).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` compares against a conservative single-chip reference
 estimate for the same workload (see BASELINE.md: the reference publishes
 no absolute numbers; we anchor to ~8 * 45 tok/s/stream ≈ 360 tok/s
@@ -31,32 +33,52 @@ PROMPT_LEN = 16 if TINY else 128
 DECODE_STEPS = 8 if TINY else 100
 BASELINE_TOKS_PER_S = 360.0
 
-_PROBE = ("import jax; d = jax.devices(); "
-          "print('PLATFORM=' + d[0].platform, len(d))")
+# Peak dense bf16 FLOP/s per chip by device generation (public specs).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+_PROBE = ("import jax, time; t0=time.time(); d = jax.devices(); "
+          "import jax.numpy as jnp; "
+          "x = jnp.ones((256, 256), jnp.bfloat16); "
+          "(x @ x).block_until_ready(); "
+          "print('PLATFORM=' + d[0].platform, 'KIND=' + d[0].device_kind, "
+          "'INIT_S=%.1f' % (time.time() - t0))")
+
+_PROBE_LOG: list[str] = []  # diagnostics carried into the final JSON
 
 
 def _probe_accelerator() -> bool:
-    """Check in a SUBPROCESS that the default JAX backend initializes:
-    a broken/tunnelled TPU plugin can hang jax.devices() for many minutes
-    or die with Unavailable (round-1 bench rc=1); probing out-of-process
-    keeps this process clean for the CPU fallback."""
+    """Check in a SUBPROCESS that the default JAX backend initializes AND
+    executes a matmul: the tunnelled TPU plugin can hang jax.devices()
+    for many minutes or die with Unavailable; probing out-of-process
+    keeps this process clean for the CPU fallback. Failed init is cached
+    per-process in jax, so every retry must be a fresh subprocess."""
     from vllm_distributed_tpu import envs
     timeout = envs.VDT_TPU_PROBE_TIMEOUT
-    for attempt, backoff in enumerate((10, 30, 0)):
+    for attempt, backoff in enumerate((20, 60, 120, 0)):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", _PROBE],
                 capture_output=True, text=True, timeout=timeout)
             if out.returncode == 0 and "PLATFORM=" in out.stdout:
                 platform = out.stdout.split("PLATFORM=")[1].split()[0]
+                _PROBE_LOG.append(f"attempt {attempt}: {out.stdout.strip()}")
                 if platform != "cpu":
                     return True
                 return False  # only CPU available; use the fallback path
-            print(f"bench: probe attempt {attempt} rc={out.returncode}: "
-                  f"{out.stderr[-300:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"bench: probe attempt {attempt} timed out after "
-                  f"{timeout}s", file=sys.stderr)
+            msg = (f"attempt {attempt} rc={out.returncode}: "
+                   f"{out.stderr.strip()[-300:]}")
+            _PROBE_LOG.append(msg)
+            print(f"bench: probe {msg}", file=sys.stderr)
+        except subprocess.TimeoutExpired as e:
+            msg = (f"attempt {attempt} timed out after {timeout}s: "
+                   f"{((e.stderr or b'').decode() if isinstance(e.stderr, bytes) else (e.stderr or ''))[-300:]}")
+            _PROBE_LOG.append(msg)
+            print(f"bench: probe {msg}", file=sys.stderr)
         if backoff:
             time.sleep(backoff)
     return False
@@ -73,6 +95,30 @@ def _enter_cpu_fallback() -> None:
     DECODE_STEPS = 8
 
 
+def _model_params(hf: dict) -> int:
+    """Parameter count of the bench model from its dims (embed + lm_head
+    counted once each; decode FLOPs/token ≈ 2 * params)."""
+    H = hf["hidden_size"]
+    L = hf["num_hidden_layers"]
+    I = hf["intermediate_size"]
+    V = hf["vocab_size"]
+    hd = hf.get("head_dim") or H // hf["num_attention_heads"]
+    Dq = hf["num_attention_heads"] * hd
+    Dkv = hf["num_key_value_heads"] * hd
+    per_layer = H * Dq + 2 * H * Dkv + Dq * H + 3 * H * I + 2 * H
+    return L * per_layer + 2 * V * H + H
+
+
+def _peak_flops() -> float:
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for gen, peak in _PEAK_FLOPS.items():
+        if gen in kind:
+            return peak
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
+
+
 def main() -> None:
     from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
                                              LoadConfig, ModelConfig,
@@ -82,24 +128,25 @@ def main() -> None:
 
     # Llama-3.2-1B architecture with dummy weights (no checkpoint on the
     # bench host; compute cost is identical to real weights).
+    hf_dims = (dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=2048,
+        architectures=["LlamaForCausalLM"],
+    ) if TINY else dict(
+        vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_hidden_layers=16,
+        num_attention_heads=32, num_key_value_heads=8,
+        head_dim=64, rope_theta=500000.0,
+        max_position_embeddings=2048,
+        architectures=["LlamaForCausalLM"],
+    ))
     config = EngineConfig(
         model_config=ModelConfig(
             model="llama-3.2-1b-dummy",
             dtype="bfloat16",
             max_model_len=2048,
-            hf_overrides=(dict(
-                vocab_size=512, hidden_size=64, intermediate_size=128,
-                num_hidden_layers=2, num_attention_heads=4,
-                num_key_value_heads=2, max_position_embeddings=2048,
-                architectures=["LlamaForCausalLM"],
-            ) if TINY else dict(
-                vocab_size=128256, hidden_size=2048,
-                intermediate_size=8192, num_hidden_layers=16,
-                num_attention_heads=32, num_key_value_heads=8,
-                head_dim=64, rope_theta=500000.0,
-                max_position_embeddings=2048,
-                architectures=["LlamaForCausalLM"],
-            )),
+            hf_overrides=hf_dims,
         ),
         cache_config=CacheConfig(block_size=16),
         scheduler_config=SchedulerConfig(max_num_batched_tokens=2048,
@@ -131,13 +178,15 @@ def main() -> None:
 
     for i, p in enumerate(prompts):
         engine.add_request(f"bench-{i}", p, sp)
-    # Prefill phase (untimed): step until every request emitted its first
-    # token (matches the reference harness separating prefill time from
-    # decode throughput, tknp_inference_benchmarks.py:66-90).
+    # Prefill phase (timed separately): step until every request emitted
+    # its first token (matches the reference harness separating prefill
+    # time from decode throughput, tknp_inference_benchmarks.py:66-90).
     produced = {f"bench-{i}": 0 for i in range(BATCH)}
+    t_prefill = time.perf_counter()
     while any(v == 0 for v in produced.values()):
         for o in engine.step():
             produced[o.request_id] = len(o.outputs[0].token_ids)
+    prefill_ms = (time.perf_counter() - t_prefill) * 1e3
     tokens_at_decode_start = sum(produced.values())
     t0 = time.perf_counter()
     while engine.has_unfinished_requests():
@@ -147,13 +196,27 @@ def main() -> None:
     decode_tok_s = (sum(produced.values()) -
                     tokens_at_decode_start) / decode_time
 
-    print(json.dumps({
+    import jax
+    backend = jax.devices()[0].platform
+    is_tpu = backend not in ("cpu", )
+    params = _model_params(hf_dims)
+    # Decode MFU: 2 FLOPs per param per generated token over peak.
+    mfu = (decode_tok_s * 2 * params) / _peak_flops() if is_tpu else None
+
+    record = {
         "metric": "decode_throughput_llama1b_bs8",
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
-        "backend": "cpu-fallback" if TINY else "tpu",
-    }))
+        "backend": "tpu" if is_tpu else "cpu-fallback",
+        "device_kind": jax.devices()[0].device_kind,
+        "prefill_ms_bs8": round(prefill_ms, 1),
+        "decode_mfu": round(mfu, 4) if mfu is not None else None,
+        "model_params": params,
+    }
+    if not is_tpu and _PROBE_LOG:
+        record["probe_log"] = _PROBE_LOG[-4:]
+    print(json.dumps(record))
 
 
 def _run_with_retries() -> Exception | None:
@@ -218,5 +281,6 @@ if __name__ == "__main__":
             "unit": "tok/s",
             "vs_baseline": 0.0,
             "error": f"{type(err).__name__}: {err}",
+            "probe_log": _PROBE_LOG[-4:],
         }))
         sys.exit(0)

@@ -180,6 +180,21 @@ class ParallelConfig:
     data_parallel_size: int = 1
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    # How data parallelism is realized (reference: one DPEngineCoreProc
+    # per DP rank behind a balancing coordinator, v1/engine/core.py:812 +
+    # coordinator.py:21):
+    #  - "engine": data_parallel_size full engine replicas (scheduler +
+    #    KV pool + mesh slice each) behind a balancing front-end client.
+    #    The serving path. Replicas share no collectives, so the
+    #    reference's lockstep dummy batches / wave sync are unnecessary
+    #    by construction (EP spans the model axis inside one replica,
+    #    never the data axis across replicas).
+    #  - "mesh": a single engine whose mesh carries a "data" axis and
+    #    shards the batch SPMD (the dryrun/training-style layout).
+    data_parallel_mode: str = "engine"
+    # This replica's rank under "engine" mode (set by the DP front-end;
+    # selects the replica's device slice).
+    data_parallel_rank: int = 0
     # Run the engine core (scheduler + executor busy loop) in its own
     # process with ZMQ transport (reference: EngineCoreProc, core.py:362).
     multiprocess_engine_core: bool = False
@@ -191,6 +206,10 @@ class ParallelConfig:
                      "data_parallel_size", "token_parallel_size"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
+        if self.data_parallel_mode not in ("engine", "mesh"):
+            raise ValueError(
+                f"data_parallel_mode must be 'engine' or 'mesh', got "
+                f"{self.data_parallel_mode!r}")
         if self.token_parallel_size > 1 and self.data_parallel_size > 1:
             # Mirrors the reference's DP|TKNP exclusivity
             # (parallel_state.py:1116-1126).

@@ -35,6 +35,7 @@ class EngineArgs:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
+    data_parallel_mode: str = "engine"  # engine replicas | mesh axis
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
     multiprocess_engine_core: bool = False
@@ -81,6 +82,7 @@ class EngineArgs:
                 tensor_parallel_size=self.tensor_parallel_size,
                 pipeline_parallel_size=self.pipeline_parallel_size,
                 data_parallel_size=self.data_parallel_size,
+                data_parallel_mode=self.data_parallel_mode,
                 token_parallel_size=self.token_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
                 multiprocess_engine_core=self.multiprocess_engine_core,

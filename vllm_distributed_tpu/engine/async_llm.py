@@ -44,8 +44,14 @@ class AsyncLLM:
         self.output_processor = OutputProcessor(config, tokenizer)
 
         from vllm_distributed_tpu import envs
-        if config.parallel_config.multiprocess_engine_core or \
-                envs.VDT_ENABLE_MP_ENGINE:
+        pc = config.parallel_config
+        if pc.data_parallel_size > 1 and pc.data_parallel_mode == "engine":
+            # DP replicas under the async server always run as
+            # subprocesses: the pump thread needs a non-blocking poll
+            # surface and the replicas must overlap compute.
+            from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+            self.core = DPEngineClient(config, force_mp=True)
+        elif pc.multiprocess_engine_core or envs.VDT_ENABLE_MP_ENGINE:
             self.core = SyncMPClient(config)
         else:
             self.core = BackgroundEngineCore(config)

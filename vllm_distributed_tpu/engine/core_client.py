@@ -29,8 +29,11 @@ class EngineCoreClient:
     @staticmethod
     def make_client(config: EngineConfig) -> "EngineCoreClient":
         from vllm_distributed_tpu import envs
-        if config.parallel_config.multiprocess_engine_core or \
-                envs.VDT_ENABLE_MP_ENGINE:
+        pc = config.parallel_config
+        if pc.data_parallel_size > 1 and pc.data_parallel_mode == "engine":
+            from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+            return DPEngineClient(config)
+        if pc.multiprocess_engine_core or envs.VDT_ENABLE_MP_ENGINE:
             return SyncMPClient(config)
         return InprocClient(config)
 

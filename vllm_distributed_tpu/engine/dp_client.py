@@ -1,0 +1,221 @@
+"""Data-parallel engine replication: N engine cores behind one balancer.
+
+Reference: one ``DPEngineCoreProc`` per DP rank plus a ``DPCoordinator``
+process that publishes per-engine request counts to the front-end
+balancer (vllm/v1/engine/core.py:812, coordinator.py:21). TPU-native
+redesign: each replica is a full engine core (scheduler + KV pool) on
+its own contiguous device slice of the host mesh; the front-end client
+routes by live request count (the coordinator's queue-length publishing
+collapses into client-side accounting because one front-end owns all
+replicas — a separate coordinator process only pays off with multiple
+API servers, which multi-host serving adds later). The reference's
+lockstep dummy batches / wave sync (core.py:929-969) are unnecessary
+here by construction: expert parallelism spans the ``model`` mesh axis
+INSIDE a replica, so idle replicas participate in no collective and can
+simply sleep.
+
+Transport per replica follows the parent config: in-process cores for
+offline/sync use (each replica's worker re-asserts its own global mesh
+per call), or one ZMQ subprocess per replica for serving — the
+subprocess layout is what actually overlaps replica compute on CPU
+hosts and keeps replicas isolated on TPU hosts.
+"""
+
+import copy
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
+                                                     InprocClient,
+                                                     SyncMPClient)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import EngineCoreRequest
+
+logger = init_logger(__name__)
+
+
+def make_replica_config(config: EngineConfig, rank: int) -> EngineConfig:
+    """A deep copy of the engine config describing ONE replica: dp size 1
+    at dp rank ``rank`` (the worker slices its devices from the rank)."""
+    rc = copy.deepcopy(config)
+    rc.parallel_config.data_parallel_size = 1
+    rc.parallel_config.data_parallel_rank = rank
+    return rc
+
+
+class DPEngineClient(EngineCoreClient):
+    """Balancing front-end over data_parallel_size engine replicas."""
+
+    def __init__(self, config: EngineConfig, *,
+                 force_mp: Optional[bool] = None) -> None:
+        from vllm_distributed_tpu import envs
+        n = config.parallel_config.data_parallel_size
+        assert n > 1, "DPEngineClient requires data_parallel_size > 1"
+        if force_mp is None:
+            force_mp = (config.parallel_config.multiprocess_engine_core
+                        or envs.VDT_ENABLE_MP_ENGINE)
+        self.is_mp = bool(force_mp)
+        self.clients: list[EngineCoreClient] = []
+        for rank in range(n):
+            rc = make_replica_config(config, rank)
+            client = SyncMPClient(rc) if self.is_mp else InprocClient(rc)
+            self.clients.append(client)
+            # Propagate the replica-profiled KV pool size so the parent
+            # config reflects reality (replicas are symmetric).
+            if rc.cache_config.num_gpu_blocks:
+                config.cache_config.num_gpu_blocks = \
+                    rc.cache_config.num_gpu_blocks
+        logger.info("DP front-end: %d engine replicas (%s)", n,
+                    "subprocess" if self.is_mp else "in-process")
+        # Balancer state: request ownership + live counts per replica
+        # (the coordinator's published queue lengths, client-side).
+        self._owner: dict[str, int] = {}
+        self._live: list[set[str]] = [set() for _ in range(n)]
+        self._rr = 0  # round-robin tiebreak cursor
+        # Fan-out utility RPC bookkeeping (async/pump mode).
+        self._util_id = 0
+        self._pending_util: dict[int, list[tuple]] = {}
+        self._util_partial: dict[int, dict[int, object]] = {}
+
+    # ------------------------------------------------------------------
+    def _pick_replica(self) -> int:
+        n = len(self.clients)
+        best, best_load = None, None
+        for off in range(n):
+            i = (self._rr + off) % n
+            load = len(self._live[i])
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        self._rr = (best + 1) % n
+        return best
+
+    def add_request(self, request: EngineCoreRequest) -> None:
+        i = self._pick_replica()
+        self._owner[request.request_id] = i
+        self._live[i].add(request.request_id)
+        self.clients[i].add_request(request)
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        by_replica: dict[int, list[str]] = {}
+        for rid in request_ids:
+            i = self._owner.pop(rid, None)
+            if i is not None:
+                self._live[i].discard(rid)
+                by_replica.setdefault(i, []).append(rid)
+        for i, rids in by_replica.items():
+            self.clients[i].abort_requests(rids)
+
+    def _mark_finished(self, outs: list[EngineCoreOutput]) -> None:
+        for o in outs:
+            if o.finished:
+                i = self._owner.pop(o.req_id, None)
+                if i is not None:
+                    self._live[i].discard(o.req_id)
+
+    # ------------------------------------------------------------------
+    def get_output(self) -> list[EngineCoreOutput]:
+        """Merged next outputs across replicas.
+
+        In-process replicas are stepped inline (each busy replica once);
+        subprocess replicas are polled, blocking until at least one batch
+        arrives while any request is live."""
+        outs: list[EngineCoreOutput] = []
+        if not self.is_mp:
+            for i, client in enumerate(self.clients):
+                if self._live[i]:
+                    outs.extend(client.get_output())
+            self._mark_finished(outs)
+            return outs
+        while any(self._live):
+            for i, client in enumerate(self.clients):
+                if not self._live[i]:
+                    continue
+                batch = client.recv_outputs(timeout_ms=20)
+                if batch:
+                    outs.extend(batch)
+            if outs:
+                break
+        self._mark_finished(outs)
+        return outs
+
+    def recv_outputs(
+            self, timeout_ms: int) -> Optional[list[EngineCoreOutput]]:
+        """Pump-thread receive (AsyncLLM): poll every replica once within
+        the timeout budget; None when nothing arrived."""
+        assert self.is_mp, "recv_outputs requires subprocess replicas"
+        per = max(timeout_ms // len(self.clients), 1)
+        outs: list[EngineCoreOutput] = []
+        for client in self.clients:
+            batch = client.recv_outputs(timeout_ms=per)
+            if batch:
+                outs.extend(batch)
+        self._mark_finished(outs)
+        return outs or None
+
+    # ------------------------------------------------------------------
+    def send_utility(self, method: str, *args) -> int:
+        """Fan a utility RPC out to every replica; the combined result
+        lands in fetch_result() once the pump thread drains each child
+        (AsyncLLM's thread-safe stats path)."""
+        assert self.is_mp
+        self._util_id += 1
+        self._pending_util[self._util_id] = [
+            (idx, c, c.send_utility(method, *args))
+            for idx, c in enumerate(self.clients)
+        ]
+        self._util_partial[self._util_id] = {}
+        return self._util_id
+
+    def fetch_result(self, call_id: int, default=None):
+        pending = self._pending_util.get(call_id)
+        if pending is None:
+            return default
+        partial = self._util_partial[call_id]
+        sentinel = object()
+        for idx, client, child_id in pending:
+            if idx in partial:
+                continue
+            value = client.fetch_result(child_id, sentinel)
+            if value is not sentinel:
+                partial[idx] = value
+        if len(partial) < len(pending):
+            return default
+        del self._pending_util[call_id]
+        values = [self._util_partial.pop(call_id)[i]
+                  for i in range(len(pending))]
+        for v in values:
+            if isinstance(v, Exception):
+                return v
+        if all(isinstance(v, dict) for v in values):
+            return self._aggregate_stats(values)
+        return values
+
+    def has_unfinished_requests(self) -> bool:
+        return any(self._live)
+
+    def request_counts(self) -> list[int]:
+        """Per-replica live request counts (the coordinator's published
+        load snapshot; exposed for /metrics and tests)."""
+        return [len(s) for s in self._live]
+
+    def _aggregate_stats(self, per: list[dict]) -> dict:
+        agg: dict = {"dp_size": len(self.clients),
+                     "dp_request_counts": self.request_counts(),
+                     "dp_replicas": per}
+        # Sum numeric leaves across replicas for the headline counters.
+        for stats in per:
+            for k, v in stats.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def get_stats(self) -> dict:
+        return self._aggregate_stats([c.get_stats() for c in self.clients])
+
+    def shutdown(self) -> None:
+        for c in self.clients:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass

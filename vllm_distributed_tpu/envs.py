@@ -28,9 +28,10 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_PLATFORM":
     lambda: os.getenv("VDT_PLATFORM", "auto"),  # auto|cpu|tpu|...
     # Seconds the bench harness waits for TPU backend init in its probe
-    # subprocess before falling back to CPU.
+    # subprocess before falling back to CPU. The tunnelled axon plugin can
+    # take many minutes to become reachable, so the default is patient.
     "VDT_TPU_PROBE_TIMEOUT":
-    lambda: float(os.getenv("VDT_TPU_PROBE_TIMEOUT", "240")),
+    lambda: float(os.getenv("VDT_TPU_PROBE_TIMEOUT", "900")),
     # Precompile the full shape lattice at startup: "auto" = on for
     # accelerator platforms, off on CPU; "1"/"0" force.
     "VDT_PRECOMPILE":

@@ -15,7 +15,8 @@ from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
                                                     SchedulerOutput)
 from vllm_distributed_tpu.logger import init_logger
-from vllm_distributed_tpu.parallel.mesh import build_mesh, set_global_mesh
+from vllm_distributed_tpu.parallel.mesh import (build_mesh, global_mesh,
+                                                set_global_mesh)
 from vllm_distributed_tpu.worker.model_runner import TPUModelRunner
 
 logger = init_logger(__name__)
@@ -46,7 +47,20 @@ class TPUWorker:
                 logger.warning("could not pin platform %r: %s", platform, e)
         devices = jax.devices()
         logger.info("devices: %s", devices)
-        self.mesh = build_mesh(self.config.parallel_config, devices)
+        pc = self.config.parallel_config
+        if pc.data_parallel_mode == "engine" and pc.data_parallel_rank:
+            # Engine-replicated DP: each replica owns a disjoint
+            # contiguous device slice (requires all replica devices
+            # visible in-process — single host; multi-host DP carves by
+            # process instead).
+            per = pc.world_size
+            start = pc.data_parallel_rank * per
+            if start + per > len(devices):
+                raise ValueError(
+                    f"DP rank {pc.data_parallel_rank} needs devices "
+                    f"[{start}, {start + per}), only {len(devices)} exist")
+            devices = devices[start:start + per]
+        self.mesh = build_mesh(pc, devices)
         set_global_mesh(self.mesh)
         if self.config.parallel_config.pipeline_parallel_size > 1:
             from vllm_distributed_tpu.worker.pp_runner import PPModelRunner
@@ -55,7 +69,12 @@ class TPUWorker:
             self.model_runner = TPUModelRunner(self.config, self.mesh)
 
     def load_model(self) -> None:
-        self.model_runner.load_model()
+        # Every entry point re-asserts this worker's mesh as the global
+        # one: with in-process DP engine replicas, another replica's init
+        # may have pointed the global mesh elsewhere between calls (the
+        # collective helpers in ops/ read it during jit tracing).
+        with global_mesh(self.mesh):
+            self.model_runner.load_model()
 
     def determine_num_available_blocks(self) -> int:
         """Size the KV pool from measured HBM after a profiled dummy
@@ -74,7 +93,8 @@ class TPUWorker:
             # Honored verbatim (tests use tiny pools to force preemption);
             # token-axis divisibility was validated at config time.
             return override
-        avail = self.model_runner.profile_memory_bytes()
+        with global_mesh(self.mesh):
+            avail = self.model_runner.profile_memory_bytes()
         page_bytes = self.model_runner.kv_cache_bytes_per_page()
         if avail <= 0:
             # No memory stats (CPU tests): cover max_model_len for
@@ -89,7 +109,8 @@ class TPUWorker:
         return rounded(pages)
 
     def initialize_kv_cache(self, num_pages: int) -> None:
-        self.model_runner.initialize_kv_cache(num_pages)
+        with global_mesh(self.mesh):
+            self.model_runner.initialize_kv_cache(num_pages)
 
     def compile_or_warm_up_model(self) -> None:
         from vllm_distributed_tpu import envs
@@ -99,12 +120,14 @@ class TPUWorker:
         platform = next(iter(self.mesh.devices.flat)).platform
         if mode == "auto" and platform == "cpu":
             return  # lazy compiles are cheap on the CPU test mesh
-        self.model_runner.precompile()
+        with global_mesh(self.mesh):
+            self.model_runner.precompile()
 
     # ------------------------------------------------------------------
     def execute_model(self,
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
-        return self.model_runner.execute_model(scheduler_output)
+        with global_mesh(self.mesh):
+            return self.model_runner.execute_model(scheduler_output)
 
     def get_stats(self) -> dict:
         return self.model_runner.get_stats()
