@@ -106,6 +106,7 @@ class KVCacheManager:
         new_computed_blocks: Optional[KVCacheBlocks] = None,
         num_lookahead_tokens: int = 0,
         skip_allocation: bool = False,
+        delay_caching: bool = False,
     ) -> Optional[KVCacheBlocks]:
         """Ensure the request has pages for ``num_new_tokens`` more tokens.
 
@@ -150,7 +151,13 @@ class KVCacheManager:
             new_blocks = self.block_pool.get_new_blocks(num_new_blocks)
             req_blocks.extend(new_blocks)
 
-        if self.enable_caching:
+        # delay_caching: pages allocated for an ASYNC external load must
+        # not enter the prefix-cache index yet — the data isn't on device,
+        # and a failed pull would otherwise poison every future lookup of
+        # these hashes (reference: kv_cache_manager.py delay_cache_blocks
+        # for the nixl path). They register later, when the request's
+        # post-load allocations cover them.
+        if self.enable_caching and not delay_caching:
             self._cache_full_blocks(request, num_computed_tokens,
                                     num_new_tokens)
 
@@ -285,10 +292,11 @@ class TokenParallelKVCacheManager:
     def allocate_slots(self, request: Request, num_new_tokens: int,
                        new_computed_blocks=None,
                        num_lookahead_tokens: int = 0,
-                       skip_allocation: bool = False):
+                       skip_allocation: bool = False,
+                       delay_caching: bool = False):
         return self._mgr(request).allocate_slots(
             request, num_new_tokens, new_computed_blocks,
-            num_lookahead_tokens, skip_allocation)
+            num_lookahead_tokens, skip_allocation, delay_caching)
 
     def free(self, request: Request) -> None:
         mgr = self._maybe_mgr(request)

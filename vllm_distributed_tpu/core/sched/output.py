@@ -97,6 +97,10 @@ class ModelRunnerOutput:
     # KV-transfer completion notifications (disagg).
     finished_sending: Optional[set[str]] = None
     finished_recving: Optional[set[str]] = None
+    # Pulls that errored (peer unreachable / timed out): the scheduler
+    # re-queues these requests for LOCAL prefill of the span instead of
+    # marking never-written pages computed.
+    failed_recving: Optional[set[str]] = None
 
 
 EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()

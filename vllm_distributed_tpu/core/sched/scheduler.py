@@ -36,19 +36,23 @@ class EngineCoreOutput:
     (reference: v1/engine/__init__.py EngineCoreOutput)."""
 
     __slots__ = ("req_id", "new_token_ids", "finish_reason", "stop_reason",
-                 "num_cached_tokens", "logprobs")
+                 "num_cached_tokens", "logprobs", "kv_transfer_params")
 
     def __init__(self, req_id: str, new_token_ids: list[int],
                  finish_reason: Optional[str] = None,
                  stop_reason: Optional[int | str] = None,
                  num_cached_tokens: int = 0,
-                 logprobs: Optional[list[dict[int, float]]] = None) -> None:
+                 logprobs: Optional[list[dict[int, float]]] = None,
+                 kv_transfer_params: Optional[dict] = None) -> None:
         self.req_id = req_id
         self.new_token_ids = new_token_ids
         self.finish_reason = finish_reason
         self.stop_reason = stop_reason
         self.num_cached_tokens = num_cached_tokens
         self.logprobs = logprobs
+        # Producer handoff coordinates on the final output (disagg;
+        # reference: v1/engine/__init__.py EngineCoreOutput).
+        self.kv_transfer_params = kv_transfer_params
 
     @property
     def finished(self) -> bool:
@@ -112,6 +116,17 @@ class Scheduler:
         self.running: list[Request] = []
         # Finished ids to tell the workers to drop state for.
         self.finished_req_ids: set[str] = set()
+        # Async KV transfer state (reference: scheduler.py
+        # WAITING_FOR_REMOTE_KVS handling + nixl_connector.py:295
+        # deferred free). Requests held until their KV pull lands, and
+        # finished producer requests whose pages stay alive until the
+        # consumer pulled them.
+        self.waiting_for_remote_kv: dict[str, Request] = {}
+        self.reqs_pending_send: dict[str, Request] = {}
+        # Aborted while a pull was in flight: pages stay allocated until
+        # the worker reports the (now moot) pull finished, so a late
+        # apply can never write into reallocated pages.
+        self.cancelled_remote_kv: dict[str, Request] = {}
 
         # Stats for the metrics subsystem.
         self.num_scheduled_steps = 0
@@ -149,6 +164,16 @@ class Scheduler:
                 continue
             if request.status == RequestStatus.RUNNING:
                 self.running.remove(request)
+            elif request.status == RequestStatus.WAITING_FOR_REMOTE_KVS:
+                # The worker's pull is still in flight; keep the pages
+                # alive until it reports in, then free (see
+                # _update_kv_transfer_state).
+                self.waiting_for_remote_kv.pop(req_id, None)
+                request.status = status
+                self.cancelled_remote_kv[req_id] = request
+                self.finished_req_ids.add(req_id)
+                del self.requests[req_id]
+                continue
             else:
                 try:
                     self.waiting.remove(request)
@@ -157,29 +182,47 @@ class Scheduler:
             request.status = status
             self._free_request(request)
 
-    def _free_request(self, request: Request) -> None:
+    def _free_request(self, request: Request) -> Optional[dict]:
+        """Tear a finished request down. Returns the connector's
+        kv_transfer_params to hand back to the client (a producer's
+        pull coordinates), or None."""
         assert request.is_finished
+        params = None
+        defer = False
         if self.kv_connector is not None:
             # Teardown hook (reference: base.py request_finished).
             # Synchronous connectors never defer the free; async
-            # (pull-based) connectors will return defer=True here and the
-            # free then waits on the worker's finished_sending notice.
-            defer, _params = self.kv_connector.request_finished(
+            # (pull-based) connectors return defer=True and the free
+            # then waits on the worker's finished_sending notice
+            # (reference: nixl_connector.py:295 deferred block free).
+            defer, params = self.kv_connector.request_finished(
                 request,
                 self.kv_cache_manager.get_block_ids(request.request_id)
                 if request.request_id in getattr(
                     self.kv_cache_manager, "req_to_blocks", {}) else [])
-            assert not defer, "deferred free not supported yet"
-        self.kv_cache_manager.free(request)
-        self.kv_cache_manager.free_block_hashes(request)
+        if defer:
+            self.reqs_pending_send[request.request_id] = request
+        else:
+            self.kv_cache_manager.free(request)
+            self.kv_cache_manager.free_block_hashes(request)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
+        return params
 
     # ------------------------------------------------------------------
     # Introspection
     # ------------------------------------------------------------------
     def has_requests(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running
+                    or self.waiting_for_remote_kv)
+
+    def has_kv_transfer_work(self) -> bool:
+        """True while async KV transfers are in flight: held consumer
+        requests or producer pages awaiting a peer's pull. The engine
+        core keeps stepping (with possibly-empty scheduler outputs) so
+        the worker's get_finished() poll services them."""
+        return bool(self.waiting_for_remote_kv or self.reqs_pending_send
+                    or self.cancelled_remote_kv)
 
     def has_unfinished_requests(self) -> bool:
         return self.has_requests()
@@ -333,15 +376,35 @@ class Scheduler:
                 # pages are allocated now and filled by the worker-side
                 # connector before the forward pass.
                 num_external = 0
+                load_async = False
                 if self.kv_connector is not None:
                     num_external, load_async = \
                         self.kv_connector.get_num_new_matched_tokens(
                             request, num_computed_tokens)
-                    # Async (pull-based) loads need the hold-until-loaded
-                    # state machine; fail loudly rather than read pages
-                    # before the transfer lands.
-                    assert not load_async, \
-                        "async KV loads not supported yet"
+
+                if load_async and num_external > 0:
+                    # Async pull: allocate the external span now, then
+                    # hold the request out of the queue until the worker
+                    # reports the transfer landed (reference: scheduler
+                    # WAITING_FOR_REMOTE_KVS + nixl start_load_kv). The
+                    # local prefix hit is committed first so the pull
+                    # only covers the missing pages.
+                    new_blocks = self.kv_cache_manager.allocate_slots(
+                        request, num_external, new_computed_blocks,
+                        delay_caching=True)
+                    if new_blocks is None:
+                        break  # no room; retry next step
+                    self.waiting.popleft()
+                    request.status = RequestStatus.WAITING_FOR_REMOTE_KVS
+                    request.num_computed_tokens = num_computed_tokens
+                    request.num_external_computed_tokens = num_external
+                    self.kv_connector.update_state_after_alloc(
+                        request,
+                        self.kv_cache_manager.get_block_ids(
+                            request.request_id),
+                        num_external)
+                    self.waiting_for_remote_kv[request.request_id] = request
+                    continue
 
                 num_new_tokens = (request.num_tokens - num_computed_tokens -
                                   num_external)
@@ -519,6 +582,8 @@ class Scheduler:
                                         runner_output.spec_token_ids)
             }
 
+        self._update_kv_transfer_state(runner_output)
+
         outputs: list[EngineCoreOutput] = []
         finished: list[Request] = []
         for request in self.running:
@@ -574,8 +639,100 @@ class Scheduler:
 
         for request in finished:
             self.running.remove(request)
-            self._free_request(request)
+            params = self._free_request(request)
+            if params is not None:
+                # Producer handoff coordinates ride on the final output
+                # (reference: EngineCoreOutput.kv_transfer_params) so the
+                # client/proxy can route the decode-side request.
+                for out in outputs:
+                    if out.req_id == request.request_id:
+                        out.kv_transfer_params = params
+                        break
         return outputs
+
+    def _update_kv_transfer_state(
+            self, runner_output: ModelRunnerOutput) -> None:
+        """Fold the worker's async-transfer notifications back in:
+        pulled-in requests rejoin the waiting queue with their external
+        span marked computed; pulled-from producer pages are freed
+        (reference: scheduler.py update_from_output finished_recving/
+        finished_sending handling)."""
+        for req_id in (runner_output.finished_recving or ()):
+            cancelled = self.cancelled_remote_kv.pop(req_id, None)
+            if cancelled is not None:
+                self.kv_cache_manager.free(cancelled)
+                self.kv_cache_manager.free_block_hashes(cancelled)
+                continue
+            request = self.waiting_for_remote_kv.pop(req_id, None)
+            if request is None:
+                continue
+            request.num_computed_tokens += \
+                request.num_external_computed_tokens
+            # Externally-loaded tokens were never computed locally:
+            # count them as cached for stats/billing parity.
+            request.num_cached_tokens = (
+                max(request.num_cached_tokens, 0) +
+                request.num_external_computed_tokens)
+            request.num_external_computed_tokens = 0
+            self._requeue_after_hold(request)
+        for req_id in (runner_output.failed_recving or ()):
+            cancelled = self.cancelled_remote_kv.pop(req_id, None)
+            if cancelled is not None:
+                self.kv_cache_manager.free(cancelled)
+                self.kv_cache_manager.free_block_hashes(cancelled)
+                continue
+            request = self.waiting_for_remote_kv.pop(req_id, None)
+            if request is None:
+                continue
+            # The span's pages were allocated but never written. Free
+            # everything and rejoin the queue as a fresh request: local
+            # prefill recomputes the whole prompt (the connector
+            # remembers the request and won't re-stage a pull). Freeing
+            # matters for ordering — keeping the unwritten span pages
+            # while re-running the prefix lookup could append
+            # later-cached prefix blocks AFTER them, corrupting the
+            # request's page order.
+            logger.warning(
+                "KV pull failed for %s; recomputing %d tokens locally",
+                req_id, request.num_external_computed_tokens)
+            self.kv_cache_manager.free(request)
+            request.num_computed_tokens = 0
+            request.num_external_computed_tokens = 0
+            self._requeue_after_hold(request)
+        for req_id in (runner_output.finished_sending or ()):
+            request = self.reqs_pending_send.pop(req_id, None)
+            if request is not None:
+                self.kv_cache_manager.free(request)
+                self.kv_cache_manager.free_block_hashes(request)
+        # Backstop expiry for deferred frees nobody pulled: the worker's
+        # serve registration expires first (send_timeout_s) and reports
+        # finished_sending; this 2x backstop only fires if the worker
+        # poll itself is wedged, so pages still can't leak forever.
+        if self.reqs_pending_send:
+            now = time.time()
+            timeout = 2 * (self.config.kv_transfer_config
+                           .kv_connector_extra_config
+                           .get("send_timeout_s", 300.0)
+                           if self.config.kv_transfer_config else 300.0)
+            for req_id in list(self.reqs_pending_send):
+                request = self.reqs_pending_send[req_id]
+                deadline = getattr(request, "_send_deadline", None)
+                if deadline is None:
+                    request._send_deadline = now + float(timeout)
+                elif now > deadline:
+                    logger.warning(
+                        "deferred KV pages for %s expired unpulled after "
+                        "%.0fs; freeing", req_id, float(timeout))
+                    del self.reqs_pending_send[req_id]
+                    self.kv_cache_manager.free(request)
+                    self.kv_cache_manager.free_block_hashes(request)
+
+    def _requeue_after_hold(self, request: Request) -> None:
+        request.status = RequestStatus.WAITING
+        if self.policy == "priority":
+            self._insert_by_priority(request)
+        else:
+            self.waiting.appendleft(request)
 
     def _check_stop(
             self, request: Request,

@@ -21,4 +21,8 @@ def create_kv_connector(config: EngineConfig,
         from vllm_distributed_tpu.distributed.kv_transfer.shared_storage \
             import SharedStorageConnector
         return SharedStorageConnector(config, role)
+    if name == "DCNPullConnector":
+        from vllm_distributed_tpu.distributed.kv_transfer.dcn_pull \
+            import DCNPullConnector
+        return DCNPullConnector(config, role)
     raise ValueError(f"unknown kv connector {name!r}")

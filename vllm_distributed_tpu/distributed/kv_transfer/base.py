@@ -89,7 +89,15 @@ class KVConnectorBase:
         """Persist/send KV pages AFTER the step's forward wrote them
         (reference: save_kv_layer + wait_for_save, collapsed)."""
 
-    def get_finished(self) -> tuple[set[str], set[str]]:
-        """(finished_sending, finished_recving) request ids for async
-        transfers; synchronous connectors return empty sets."""
-        return set(), set()
+    def get_finished(self, runner) -> tuple[set[str], set[str], set[str]]:
+        """(finished_sending, finished_recving, failed_recving) request
+        ids for async transfers; synchronous connectors return empty
+        sets. Failed pulls re-queue for local recompute of the span.
+
+        Called on the runner's main thread EVERY step (including steps
+        that schedule zero tokens) — this is where async connectors apply
+        completed pulls to ``runner.kv_caches`` and service queued peer
+        reads, keeping all device access off background threads (the
+        jitted step donates the cache buffers, so only the main thread
+        ever holds the live array reference)."""
+        return set(), set(), set()

@@ -1,0 +1,518 @@
+"""Async KV-pull connector: disaggregated prefill over a network channel.
+
+Reference: vllm/distributed/kv_transfer/kv_connector/v1/nixl_connector.py —
+the decode engine PULLS finished-prefill KV pages from the prefill
+engine's memory, asynchronously, with completion notifications on both
+sides and deferred page free on the producer (nixl_connector.py:295,
+823-894). The reference transport is RDMA (NIXL); TPUs have no NIXL, so
+this connector is the DCN-equivalent: a socket side-channel between the
+hosts, with pages read out of / written into the paged HBM cache at step
+boundaries on each engine's main thread.
+
+Lifecycle (mirrors nixl_connector.py):
+
+1. Prefill (producer) engine finishes a request. ``request_finished``
+   returns ``defer=True`` — the pages stay allocated — plus
+   ``kv_transfer_params`` = {pull host/port, remote request id, token
+   count}. The params ride the final RequestOutput to the proxy, which
+   forwards them on the decode-side request.
+2. Decode (consumer) engine admits the request:
+   ``get_num_new_matched_tokens`` -> (page-aligned external span, True);
+   the scheduler allocates pages, holds the request in
+   WAITING_FOR_REMOTE_KVS, and ``build_connector_meta`` emits a pull
+   instruction.
+3. Consumer worker: ``start_load_kv`` hands the pull to a background
+   thread (socket IO only — no device access off the main thread). The
+   fetched pages are queued; the next ``get_finished`` applies them to
+   ``runner.kv_caches`` and reports ``finished_recving`` -> the scheduler
+   re-queues the request, which now skips prefill for the pulled span.
+4. The pull thread sends DONE to the producer; the producer's server
+   queues the notification, its ``get_finished`` reports
+   ``finished_sending`` -> the scheduler frees the deferred pages.
+
+Device-access discipline: the jitted step DONATES the KV cache buffers,
+so only the engine's main thread ever holds the live array reference.
+Background threads do socket work exclusively; every device read (serve
+a peer's page request) and write (apply a finished pull) happens inside
+``get_finished``, which the model runner calls every step — including
+steps that schedule zero tokens (the engine core keeps stepping while
+transfers are in flight).
+"""
+
+import os
+import queue
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+from vllm_distributed_tpu.distributed.kv_transfer import page_io
+from vllm_distributed_tpu.distributed.kv_transfer.base import (
+    KVConnectorBase, KVConnectorRole)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.request import Request
+
+logger = init_logger(__name__)
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length, ) = _LEN.unpack(header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return msgpack.unpackb(payload, raw=False, strict_map_key=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+@dataclass
+class _PullInstruction:
+    """One held request's pull order (scheduler -> worker, rides on
+    SchedulerOutput.kv_connector_metadata)."""
+
+    req_id: str
+    local_page_ids: list[int]
+    host: str
+    port: int
+    remote_req_id: str
+    # Producer-side page ids to read (from kv_transfer_params; the NIXL
+    # handshake's block-descriptor exchange, nixl_connector.py:695).
+    remote_page_ids: list[int] = field(default_factory=list)
+
+
+@dataclass
+class _SendRegistration:
+    """Producer-side: one finished request's deferred pages, valid for
+    serving until ``deadline`` (unix seconds)."""
+
+    req_id: str
+    page_ids: list[int]
+    deadline: float
+
+
+@dataclass
+class DCNPullConnectorMetadata:
+    pulls: list[_PullInstruction] = field(default_factory=list)
+    # Producer: deferred pages to (un)register for serving. The worker
+    # serves ONLY registered pages — once a registration expires or a
+    # DONE lands, a late pull gets an error instead of silently reading
+    # pages the scheduler may have reallocated to another request.
+    register: list[_SendRegistration] = field(default_factory=list)
+
+
+@dataclass
+class _ServeJob:
+    """A peer's page-read request, parked until the main thread can
+    read HBM; the server thread waits on ``done``."""
+
+    remote_req_id: str
+    request_pages: Optional[list[int]] = None
+    reply: dict = field(default_factory=dict)
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class _FinishedPull:
+    req_id: str
+    page_ids: list[int]
+    k: Optional[np.ndarray]  # [L, n_pages, KVH_ckpt, PS, D]; None on error
+    v: Optional[np.ndarray]
+    error: Optional[str] = None
+
+
+class DCNPullConnector(KVConnectorBase):
+    """NIXL-equivalent async pull connector (see module docstring)."""
+
+    def __init__(self, config, role: KVConnectorRole) -> None:
+        super().__init__(config, role)
+        kv_cfg = config.kv_transfer_config
+        extra = kv_cfg.kv_connector_extra_config or {}
+        self.block_size = config.cache_config.block_size
+        self.is_producer = kv_cfg.is_kv_producer
+        self.is_consumer = kv_cfg.is_kv_consumer
+        self.pull_host = extra.get("pull_host", "127.0.0.1")
+        self.pull_port = int(extra.get("pull_port", 0))
+
+        if role == KVConnectorRole.SCHEDULER:
+            # ---- scheduler-side state ----
+            # Requests whose pull was staged but not yet shipped to the
+            # worker, and requests already pulled (admission re-pass must
+            # return 0).
+            self._staged_pulls: list[_PullInstruction] = []
+            self._pulled: set[str] = set()
+            self._staged_registrations: list[_SendRegistration] = []
+            # Producer: finished requests' page counts (stats/tests).
+            self.num_deferred_frees = 0
+        else:
+            # ---- worker-side state ----
+            self._serve_queue: "queue.Queue[_ServeJob]" = queue.Queue()
+            self._done_notifications: "queue.Queue[str]" = queue.Queue()
+            self._finished_pulls: "queue.Queue[_FinishedPull]" = queue.Queue()
+            # Producer: currently-serveable deferred pages.
+            self._registrations: dict[str, _SendRegistration] = {}
+            # Producer pages staged for serving: remote_req_id -> page ids
+            # (registered when the scheduler defers the free — the worker
+            # learns them from the pull request itself; the page list
+            # travels in the wire request).
+            self._server: Optional[socket.socket] = None
+            self._server_thread: Optional[threading.Thread] = None
+            self._shutdown = threading.Event()
+            if self.is_producer:
+                self._start_server()
+
+    # ==================================================================
+    # Scheduler side
+    # ==================================================================
+    def get_num_new_matched_tokens(
+            self, request: Request,
+            num_computed_tokens: int) -> tuple[int, bool]:
+        if not self.is_consumer:
+            return 0, False
+        params = request.kv_transfer_params
+        if not self._valid_params(params):
+            return 0, False
+        if request.request_id in self._pulled:
+            return 0, False  # re-admission after the pull landed
+        bs = self.block_size
+        # Whole pages only, and the last prompt token always recomputes
+        # locally so it produces the first logit (same cap as the local
+        # prefix cache).
+        usable = min(int(params["num_tokens"]), request.num_tokens - 1)
+        n_pages = usable // bs - num_computed_tokens // bs
+        if n_pages <= 0:
+            return 0, False
+        return n_pages * bs, True
+
+    @staticmethod
+    def _valid_params(params) -> bool:
+        """Client-supplied kv_transfer_params must never crash the core:
+        a malformed dict simply disables the pull (local prefill runs)."""
+        if not isinstance(params, dict):
+            return False
+        try:
+            return (bool(params.get("remote_req_id"))
+                    and int(params["num_tokens"]) > 0
+                    and int(params["pull_port"]) > 0)
+        except (KeyError, TypeError, ValueError):
+            return False
+
+    def update_state_after_alloc(self, request: Request,
+                                 block_ids: list[int],
+                                 num_external_tokens: int) -> None:
+        if not self.is_consumer or num_external_tokens == 0:
+            return
+        params = request.kv_transfer_params
+        if not self._valid_params(params):
+            return
+        bs = self.block_size
+        start = request.num_computed_tokens // bs
+        n = num_external_tokens // bs
+        self._staged_pulls.append(
+            _PullInstruction(
+                req_id=request.request_id,
+                local_page_ids=block_ids[start:start + n],
+                host=params.get("pull_host", "127.0.0.1"),
+                port=int(params["pull_port"]),
+                remote_req_id=params["remote_req_id"],
+                remote_page_ids=list(params.get("remote_page_ids",
+                                                ()))[start:start + n],
+            ))
+        self._pulled.add(request.request_id)
+
+    def build_connector_meta(
+            self, scheduler_output) -> Optional[DCNPullConnectorMetadata]:
+        meta = DCNPullConnectorMetadata()
+        if self._staged_pulls:
+            meta.pulls = self._staged_pulls
+            self._staged_pulls = []
+        if self._staged_registrations:
+            meta.register = self._staged_registrations
+            self._staged_registrations = []
+        for req_id in scheduler_output.finished_req_ids:
+            self._pulled.discard(req_id)
+        return meta
+
+    def request_finished(
+            self, request: Request,
+            block_ids: list[int]) -> tuple[bool, Optional[dict]]:
+        if not self.is_producer or not block_ids:
+            return False, None
+        from vllm_distributed_tpu.request import RequestStatus
+        if request.status == RequestStatus.FINISHED_ABORTED:
+            # Nobody will ever receive these coordinates; deferring the
+            # free would leak the pages until the send timeout.
+            return False, None
+        # Hand the decode side its pull coordinates; pages stay alive
+        # until it reports the pull done (deferred free,
+        # nixl_connector.py:295). Only full prompt pages are usable.
+        n_full = request.num_computed_tokens // self.block_size
+        if n_full == 0:
+            return False, None
+        self.num_deferred_frees += 1
+        extra = self.config.kv_transfer_config.kv_connector_extra_config \
+            or {}
+        import time
+        self._staged_registrations.append(
+            _SendRegistration(
+                req_id=request.request_id,
+                page_ids=block_ids[:n_full],
+                deadline=time.time() +
+                float(extra.get("send_timeout_s", 300.0))))
+        return True, {
+            "remote_req_id": request.request_id,
+            "pull_host": self.pull_host,
+            "pull_port": int(extra.get("pull_port", self.pull_port)),
+            "num_tokens": n_full * self.block_size,
+            "remote_page_ids": block_ids[:n_full],
+        }
+
+    # ==================================================================
+    # Worker side: producer page server
+    # ==================================================================
+    def _start_server(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.pull_host, self.pull_port))
+        self.pull_port = srv.getsockname()[1]
+        srv.listen(16)
+        # Publish the actual bound port (port 0 auto-assigns) through the
+        # shared config so the scheduler-side half hands peers the right
+        # coordinates (worker half is constructed first: executor init
+        # precedes scheduler init in EngineCore.__init__).
+        kv_cfg = self.config.kv_transfer_config
+        if kv_cfg.kv_connector_extra_config is None:
+            kv_cfg.kv_connector_extra_config = {}
+        kv_cfg.kv_connector_extra_config["pull_port"] = \
+            srv.getsockname()[1]
+        self._server = srv
+        self._server_thread = threading.Thread(
+            target=self._serve_loop, name="dcn-pull-server", daemon=True)
+        self._server_thread.start()
+        logger.info("DCN pull server listening on %s:%d", self.pull_host,
+                    self.pull_port)
+
+    def _serve_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(target=self._serve_conn, args=(conn, ),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                if msg["op"] == "pull":
+                    # Unregistered requests get a fast rejection from
+                    # this thread (no device access needed) instead of a
+                    # 120s queue-drain timeout — with a short grace poll
+                    # for a registration still in flight from the
+                    # scheduler to the worker (one step of latency).
+                    if not self._await_registration(msg["req_id"]):
+                        _send_msg(conn, {
+                            "ok": False,
+                            "error": f"{msg['req_id']} not registered "
+                                     "(never deferred, already pulled, "
+                                     "or expired)"})
+                        continue
+                    job = _ServeJob(remote_req_id=msg["req_id"],
+                                    request_pages=msg["page_ids"])
+                    self._serve_queue.put(job)
+                    # Wait for the main thread to read HBM (bounded so a
+                    # dead engine can't wedge the peer forever).
+                    if not job.done.wait(timeout=120.0):
+                        _send_msg(conn, {"ok": False,
+                                         "error": "page read timed out"})
+                        continue
+                    _send_msg(conn, job.reply)
+                elif msg["op"] == "done":
+                    self._done_notifications.put(msg["req_id"])
+                    _send_msg(conn, {"ok": True})
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _await_registration(self, req_id: str, grace_s: float = 5.0) -> bool:
+        """Server-thread check that ``req_id``'s pages are serveable,
+        polling briefly in case the registration is still riding the
+        scheduler->worker metadata (dict reads are GIL-safe)."""
+        import time
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if req_id in self._registrations:
+                return True
+            if self._shutdown.is_set():
+                return False
+            time.sleep(0.02)
+        return False
+
+    # ==================================================================
+    # Worker side: consumer pull
+    # ==================================================================
+    def start_load_kv(self, metadata, runner) -> None:
+        if not isinstance(metadata, DCNPullConnectorMetadata):
+            return
+        for reg in metadata.register:
+            self._registrations[reg.req_id] = reg
+        for pull in metadata.pulls:
+            threading.Thread(target=self._pull_worker, args=(pull, ),
+                             name=f"dcn-pull-{pull.req_id}",
+                             daemon=True).start()
+
+    def _pull_worker(self, pull: _PullInstruction) -> None:
+        """Background thread: socket IO only. Fetch the remote pages,
+        queue them for main-thread application, notify the producer."""
+        try:
+            with socket.create_connection((pull.host, pull.port),
+                                          timeout=120.0) as sock:
+                _send_msg(sock, {"op": "pull",
+                                 "req_id": pull.remote_req_id,
+                                 "page_ids": pull.remote_page_ids})
+                reply = _recv_msg(sock)
+                if reply is None or not reply.get("ok"):
+                    raise RuntimeError(
+                        (reply or {}).get("error", "connection dropped"))
+                k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
+                    reply["k_shape"])
+                v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
+                    reply["v_shape"])
+                n = len(pull.local_page_ids)
+                if k.shape[1] < n:
+                    raise RuntimeError(
+                        f"producer served {k.shape[1]} pages, "
+                        f"consumer allocated {n}")
+                self._finished_pulls.put(
+                    _FinishedPull(req_id=pull.req_id,
+                                  page_ids=pull.local_page_ids,
+                                  k=k[:, :n], v=v[:, :n]))
+                _send_msg(sock, {"op": "done",
+                                 "req_id": pull.remote_req_id})
+                _recv_msg(sock)  # ack
+        except Exception as e:  # noqa: BLE001 - surfaced via error pull
+            logger.error("KV pull for %s failed: %s", pull.req_id, e)
+            self._finished_pulls.put(
+                _FinishedPull(req_id=pull.req_id,
+                              page_ids=pull.local_page_ids,
+                              k=None, v=None, error=str(e)))
+
+    # ==================================================================
+    # Worker side: main-thread device access
+    # ==================================================================
+    def get_finished(self, runner) -> tuple[set[str], set[str], set[str]]:
+        finished_sending: set[str] = set()
+        finished_recving: set[str] = set()
+        failed_recving: set[str] = set()
+
+        # Producer: serve queued peer reads from HBM.
+        while True:
+            try:
+                job = self._serve_queue.get_nowait()
+            except queue.Empty:
+                break
+            job.reply = self._read_pages(job, runner)
+            job.done.set()
+
+        # Producer: drain DONE notifications and expire stale
+        # registrations — either way the pages stop being serveable
+        # BEFORE the scheduler frees them (finished_sending triggers the
+        # free), so a late pull can never read reallocated pages.
+        while True:
+            try:
+                req_id = self._done_notifications.get_nowait()
+            except queue.Empty:
+                break
+            self._registrations.pop(req_id, None)
+            finished_sending.add(req_id)
+        if self._registrations:
+            import time
+            now = time.time()
+            for req_id in list(self._registrations):
+                if now > self._registrations[req_id].deadline:
+                    logger.warning(
+                        "deferred pages for %s expired unpulled; "
+                        "releasing", req_id)
+                    del self._registrations[req_id]
+                    finished_sending.add(req_id)
+
+        # Consumer: apply finished pulls to the paged cache. Errored
+        # pulls go back as FAILED so the scheduler recomputes the span
+        # locally instead of reading never-written pages.
+        while True:
+            try:
+                done = self._finished_pulls.get_nowait()
+            except queue.Empty:
+                break
+            if done.error is None:
+                self._apply_pull(done, runner)
+                finished_recving.add(done.req_id)
+            else:
+                logger.error(
+                    "request %s: external KV unavailable (%s); span will "
+                    "be recomputed locally", done.req_id, done.error)
+                failed_recving.add(done.req_id)
+        return finished_sending, finished_recving, failed_recving
+
+    def _read_pages(self, job: _ServeJob, runner) -> dict:
+        """Main-thread HBM read of one finished request's pages. Pages are
+        de-replicated to checkpoint KV heads so the store is TP-invariant
+        (a tp=16 producer serves a tp=8 consumer fine)."""
+        page_ids = job.request_pages
+        reg = self._registrations.get(job.remote_req_id)
+        if reg is None:
+            return {"ok": False,
+                    "error": f"{job.remote_req_id} not registered "
+                             "(never deferred, already pulled, or "
+                             "expired)"}
+        if not page_ids or not set(page_ids).issubset(reg.page_ids):
+            return {"ok": False,
+                    "error": f"pages {page_ids} not registered for "
+                             f"{job.remote_req_id}"}
+        k, v = page_io.gather_pages(runner, page_ids)
+        return {
+            "ok": True,
+            "k": k.tobytes(),
+            "v": v.tobytes(),
+            "k_shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "dtype": str(k.dtype),
+        }
+
+    def _apply_pull(self, done: _FinishedPull, runner) -> None:
+        page_io.scatter_pages(runner, done.page_ids, done.k, done.v)
+        logger.info("applied %d pulled KV pages for %s",
+                    len(done.page_ids), done.req_id)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass

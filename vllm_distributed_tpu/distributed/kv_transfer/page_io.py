@@ -1,0 +1,43 @@
+"""Paged-cache <-> host page movement shared by KV connectors.
+
+Every connector exchanges pages in a TP-invariant wire layout: checkpoint
+KV heads only (replica heads added for tp > num_kv_heads are identical by
+construction, models/llama.py kv-head replication). These helpers own the
+de-replicate / re-replicate transform and the device gather/scatter so
+the layout lives in exactly one place.
+"""
+
+import numpy as np
+
+
+def _replication(runner) -> int:
+    return getattr(runner.model.cfg, "num_kv_head_replicas", 1)
+
+
+def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
+    """Read pages out of the device cache as host numpy in wire layout:
+    [L, n_pages, KVH_checkpoint, page_size, head_dim]."""
+    import jax
+    pages = np.asarray(page_ids, np.int32)
+    r = _replication(runner)
+    k = np.asarray(jax.device_get(runner.kv_caches["k"][:, pages]))[:, :, ::r]
+    v = np.asarray(jax.device_get(runner.kv_caches["v"][:, pages]))[:, :, ::r]
+    return k, v
+
+
+def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
+    """Write wire-layout pages into the device cache, re-expanding KV
+    heads for this deployment's replication factor. Updates
+    ``runner.kv_caches`` in place (new arrays; the old buffers are
+    donated away by the next jitted step)."""
+    pages = np.asarray(page_ids, np.int32)
+    r = _replication(runner)
+    if r > 1:
+        k = np.repeat(k, r, axis=2)
+        v = np.repeat(v, r, axis=2)
+    k_all = runner.kv_caches["k"]
+    v_all = runner.kv_caches["v"]
+    runner.kv_caches = {
+        "k": k_all.at[:, pages].set(k.astype(k_all.dtype)),
+        "v": v_all.at[:, pages].set(v.astype(v_all.dtype)),
+    }

@@ -22,6 +22,7 @@ from typing import Optional
 import numpy as np
 
 from vllm_distributed_tpu.core.kv_cache_utils import hash_request_tokens
+from vllm_distributed_tpu.distributed.kv_transfer import page_io
 from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorBase, KVConnectorRole)
 from vllm_distributed_tpu.logger import init_logger
@@ -187,52 +188,35 @@ class SharedStorageConnector(KVConnectorBase):
     def start_load_kv(self, metadata, runner) -> None:
         if not metadata or not metadata.loads:
             return
-        k_all = runner.kv_caches["k"]
-        v_all = runner.kv_caches["v"]
-        # Stored pages always hold CHECKPOINT kv heads; re-expand for this
-        # deployment's replication factor so the store stays TP-invariant
-        # (a tp=16 producer and tp=8 consumer exchange pages fine).
-        r = getattr(runner.model.cfg, "num_kv_head_replicas", 1)
+        # Stored pages always hold CHECKPOINT kv heads (wire layout,
+        # page_io): the store stays TP-invariant, so a tp=16 producer
+        # and a tp=8 consumer exchange pages fine.
         for load in metadata.loads:
             ks, vs = [], []
             for key in load.hashes:
                 with np.load(self._file(key)) as f:
-                    k, v = f["k"], f["v"]
-                if r > 1:
-                    k = np.repeat(k, r, axis=1)
-                    v = np.repeat(v, r, axis=1)
-                ks.append(k)
-                vs.append(v)
-            pages = np.asarray(load.page_ids, np.int32)
-            # [n, L, KVH, PS, D] -> set at [:, pages]: move L in front.
-            k_new = np.stack(ks, axis=1)  # [L, n, KVH, PS, D]
-            v_new = np.stack(vs, axis=1)
-            k_all = k_all.at[:, pages].set(k_new.astype(k_all.dtype))
-            v_all = v_all.at[:, pages].set(v_new.astype(v_all.dtype))
-            self.num_pages_loaded += len(pages)
-            logger.info("loaded %d external KV pages for %s", len(pages),
-                        load.req_id)
-        runner.kv_caches = {"k": k_all, "v": v_all}
+                    ks.append(f["k"])
+                    vs.append(f["v"])
+            # Files hold [L, KVH, PS, D] per page; stack to wire layout
+            # [L, n, KVH, PS, D].
+            page_io.scatter_pages(runner, load.page_ids,
+                                  np.stack(ks, axis=1),
+                                  np.stack(vs, axis=1))
+            self.num_pages_loaded += len(load.page_ids)
+            logger.info("loaded %d external KV pages for %s",
+                        len(load.page_ids), load.req_id)
 
     def save_kv(self, metadata, runner) -> None:
         if not metadata or not metadata.saves:
             return
-        import jax
-        k_all = runner.kv_caches["k"]
-        v_all = runner.kv_caches["v"]
-        # De-replicate to checkpoint kv heads before persisting (replica
-        # heads are identical by construction; stride-r picks the first
-        # copy of each) so the store layout never depends on TP width.
-        r = getattr(runner.model.cfg, "num_kv_head_replicas", 1)
         for save in metadata.saves:
             todo = [(pid, key)
                     for pid, key in zip(save.page_ids, save.hashes)
                     if not os.path.exists(self._file(key))]
             if not todo:
                 continue
-            pages = np.asarray([pid for pid, _ in todo], np.int32)
-            k_np = np.asarray(jax.device_get(k_all[:, pages]))[:, :, ::r]
-            v_np = np.asarray(jax.device_get(v_all[:, pages]))[:, :, ::r]
+            k_np, v_np = page_io.gather_pages(
+                runner, [pid for pid, _ in todo])
             for i, (_, key) in enumerate(todo):
                 tmp = self._file(key) + f".tmp{os.getpid()}"
                 with open(tmp, "wb") as f:

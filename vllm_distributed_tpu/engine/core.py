@@ -24,6 +24,10 @@ class EngineCore:
     def __init__(self, config: EngineConfig,
                  executor_class: Optional[type] = None) -> None:
         self.config = config
+        # True when the most recent step() ran device work; busy loops
+        # pace themselves when steps degenerate to host-only polls
+        # (async KV transfers in flight, requests held on a pull).
+        self.last_step_scheduled = False
         executor_class = executor_class or Executor.get_class(config)
         self.executor = executor_class(config)
 
@@ -54,15 +58,24 @@ class EngineCore:
 
     def step(self) -> list[EngineCoreOutput]:
         """One scheduling iteration (reference: core.py:223)."""
-        if not self.scheduler.has_requests():
+        self.last_step_scheduled = False
+        if not (self.scheduler.has_requests()
+                or self.scheduler.has_kv_transfer_work()):
             return []
         scheduler_output = self.scheduler.schedule()
+        self.last_step_scheduled = \
+            scheduler_output.total_num_scheduled_tokens > 0
         runner_output = self.executor.execute_model(scheduler_output)
         return self.scheduler.update_from_output(scheduler_output,
                                                  runner_output)
 
     def has_unfinished_requests(self) -> bool:
         return self.scheduler.has_unfinished_requests()
+
+    def has_kv_transfer_work(self) -> bool:
+        """Async KV transfers needing step-polls even with no live
+        requests (a producer's deferred frees)."""
+        return self.scheduler.has_kv_transfer_work()
 
     def get_stats(self) -> dict:
         stats = self.scheduler.get_stats()

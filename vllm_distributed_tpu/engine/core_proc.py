@@ -109,11 +109,14 @@ def _busy_loop(core: EngineCore, inp: zmq.Socket, out: zmq.Socket) -> None:
     poller = zmq.Poller()
     poller.register(inp, zmq.POLLIN)
     while True:
-        timeout = 0 if core.has_unfinished_requests() else _IDLE_POLL_MS
+        busy = (core.has_unfinished_requests()
+                or core.has_kv_transfer_work())
+        timeout = 0 if busy else _IDLE_POLL_MS
         while poller.poll(timeout):
             _handle_msg(core, out, serial.unpack(inp.recv()))
             timeout = 0
-        if not core.has_unfinished_requests():
+        if not (core.has_unfinished_requests()
+                or core.has_kv_transfer_work()):
             continue
         outputs = core.step()
         if outputs:
@@ -121,6 +124,12 @@ def _busy_loop(core: EngineCore, inp: zmq.Socket, out: zmq.Socket) -> None:
                 "t": "outputs",
                 "outs": [serial.encode_output(o) for o in outputs],
             }))
+        elif not core.last_step_scheduled:
+            # Nothing ran on device (all requests held on async KV
+            # transfers / deferred sends): each step is a host-only
+            # poll, so pace it instead of busy-spinning a core for the
+            # transfer's duration.
+            time.sleep(0.005)
 
 
 # ---------------------------------------------------------------------------
@@ -148,12 +157,21 @@ class BackgroundEngineCore:
 
     def _run(self) -> None:
         try:
+            has_kv_connector = \
+                self.core.scheduler.kv_connector is not None
             while True:
-                block = not self.core.has_unfinished_requests()
+                busy = (self.core.has_unfinished_requests()
+                        or self.core.has_kv_transfer_work())
+                block = not busy
+                # Bounded block only when a KV connector exists: async
+                # work can then arrive from a peer's socket with no local
+                # input message. Without one, idle blocks indefinitely.
+                idle_timeout = 0.05 if has_kv_connector else None
                 try:
                     while True:
                         kind, payload = self.input_queue.get(
-                            block=block, timeout=None if block else 0)
+                            block=block,
+                            timeout=idle_timeout if block else 0)
                         if kind == "add":
                             self.core.add_request(payload)
                         elif kind == "abort":
@@ -166,6 +184,10 @@ class BackgroundEngineCore:
                 outputs = self.core.step()
                 if outputs:
                     self.output_queue.put(outputs)
+                elif busy and not self.core.last_step_scheduled:
+                    # Host-only poll step (async KV transfer in
+                    # flight): pace instead of spinning.
+                    time.sleep(0.005)
         except Exception as e:  # noqa: BLE001
             logger.error("background engine core died: %s", e)
             traceback.print_exc()

@@ -182,8 +182,8 @@ class DPEngineClient(EngineCoreClient):
         if len(partial) < len(pending):
             return default
         del self._pending_util[call_id]
-        values = [self._util_partial.pop(call_id)[i]
-                  for i in range(len(pending))]
+        by_idx = self._util_partial.pop(call_id)
+        values = [by_idx[i] for i in range(len(pending))]
         for v in values:
             if isinstance(v, Exception):
                 return v

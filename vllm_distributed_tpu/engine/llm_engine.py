@@ -59,11 +59,12 @@ class LLMEngine:
         prompt: Union[str, list[int]],
         sampling_params: Optional[SamplingParams] = None,
         priority: int = 0,
+        kv_transfer_params: Optional[dict] = None,
     ) -> None:
         sampling_params = sampling_params or SamplingParams()
-        core_req = self.processor.process_inputs(request_id, prompt,
-                                                 sampling_params,
-                                                 priority=priority)
+        core_req = self.processor.process_inputs(
+            request_id, prompt, sampling_params, priority=priority,
+            kv_transfer_params=kv_transfer_params)
         self.output_processor.add_request(
             core_req, prompt=prompt if isinstance(prompt, str) else None)
         self.engine_core.add_request(core_req)

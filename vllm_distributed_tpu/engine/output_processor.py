@@ -29,6 +29,7 @@ class RequestState:
     finished: bool = False
     finish_reason: Optional[str] = None
     stop_reason: Optional[int | str] = None
+    kv_transfer_params: Optional[dict] = None
 
 
 @dataclass
@@ -101,6 +102,8 @@ class OutputProcessor:
             state.finished = finished
             state.finish_reason = finish_reason
             state.stop_reason = stop_reason
+            if out.kv_transfer_params is not None:
+                state.kv_transfer_params = out.kv_transfer_params
             if finished and state.detokenizer is not None:
                 # Emit any text held back waiting for more context.
                 state.detokenizer.flush()
@@ -131,4 +134,5 @@ class OutputProcessor:
             outputs=[completion],
             finished=state.finished,
             num_cached_tokens=state.num_cached_tokens,
+            kv_transfer_params=state.kv_transfer_params,
         )

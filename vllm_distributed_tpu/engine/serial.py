@@ -55,11 +55,13 @@ def decode_request(d: dict) -> EngineCoreRequest:
 
 def encode_output(out: EngineCoreOutput) -> list:
     return [out.req_id, out.new_token_ids, out.finish_reason,
-            out.stop_reason, out.num_cached_tokens, out.logprobs]
+            out.stop_reason, out.num_cached_tokens, out.logprobs,
+            out.kv_transfer_params]
 
 
 def decode_output(v: list) -> EngineCoreOutput:
-    req_id, new_token_ids, finish_reason, stop_reason, cached, lps = v
+    (req_id, new_token_ids, finish_reason, stop_reason, cached, lps,
+     kv_params) = v
     return EngineCoreOutput(
         req_id=req_id,
         new_token_ids=list(new_token_ids),
@@ -67,4 +69,5 @@ def decode_output(v: list) -> EngineCoreOutput:
         stop_reason=stop_reason,
         num_cached_tokens=cached,
         logprobs=lps,
+        kv_transfer_params=kv_params,
     )

@@ -62,3 +62,8 @@ class UniProcExecutor(Executor):
 
     def get_stats(self) -> dict:
         return self.worker.get_stats()
+
+    def shutdown(self) -> None:
+        connector = getattr(self.worker.model_runner, "kv_connector", None)
+        if connector is not None and hasattr(connector, "shutdown"):
+            connector.shutdown()

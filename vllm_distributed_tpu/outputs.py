@@ -31,6 +31,10 @@ class RequestOutput:
     finished: bool
     metrics: Optional[dict] = None
     num_cached_tokens: int = 0
+    # Disaggregated prefill: a producer's final output carries the pull
+    # coordinates the decode-side request needs (reference: vllm/outputs.py
+    # RequestOutput.kv_transfer_params).
+    kv_transfer_params: Optional[dict] = None
 
     @property
     def text(self) -> str:

@@ -21,11 +21,14 @@ class RequestStatus(enum.IntEnum):
     WAITING = 0
     RUNNING = 1
     PREEMPTED = 2
+    # Held out of the waiting queue until an async KV pull lands
+    # (reference: v1/request.py WAITING_FOR_REMOTE_KVS).
+    WAITING_FOR_REMOTE_KVS = 3
     # Terminal states below.
-    FINISHED_STOPPED = 3
-    FINISHED_LENGTH_CAPPED = 4
-    FINISHED_ABORTED = 5
-    FINISHED_IGNORED = 6
+    FINISHED_STOPPED = 4
+    FINISHED_LENGTH_CAPPED = 5
+    FINISHED_ABORTED = 6
+    FINISHED_IGNORED = 7
 
     @staticmethod
     def is_finished(status: "RequestStatus") -> bool:
@@ -99,6 +102,10 @@ class Request:
         self.num_computed_tokens = 0
         # Prefix-cache hits recorded at first schedule, for stats.
         self.num_cached_tokens = -1
+        # Tokens an async KV pull will make computed once it lands
+        # (WAITING_FOR_REMOTE_KVS bookkeeping; applied by the scheduler
+        # when the worker reports finished_recving).
+        self.num_external_computed_tokens = 0
         # Number of preemptions experienced (stats).
         self.num_preemptions = 0
         # Token-parallel rank owning this request's KV (assigned by the

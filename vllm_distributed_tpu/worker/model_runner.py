@@ -478,7 +478,13 @@ class TPUModelRunner:
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         self._update_states(scheduler_output)
         if scheduler_output.total_num_scheduled_tokens == 0:
-            return ModelRunnerOutput()
+            # Nothing to run, but async KV transfers may need servicing:
+            # hand queued peer reads / completed pulls to the connector
+            # and report completion notifications (reference:
+            # gpu_model_runner.py kv_connector_no_forward path).
+            out = ModelRunnerOutput()
+            self._poll_kv_connector(scheduler_output, out)
+            return out
         if scheduler_output.multi_step > 1:
             return self._execute_multi_step(scheduler_output)
 
@@ -552,10 +558,31 @@ class TPUModelRunner:
                 lps.append([])
                 if spec_out is not None:
                     spec_out.append([])
-        return ModelRunnerOutput(req_ids=req_ids,
-                                 sampled_token_ids=sampled,
-                                 logprobs=lps,
-                                 spec_token_ids=spec_out)
+        out = ModelRunnerOutput(req_ids=req_ids,
+                                sampled_token_ids=sampled,
+                                logprobs=lps,
+                                spec_token_ids=spec_out)
+        self._poll_kv_connector(scheduler_output, out)
+        return out
+
+    def _poll_kv_connector(self, scheduler_output: SchedulerOutput,
+                           out: ModelRunnerOutput) -> None:
+        """Give the connector its per-step main-thread slot: service
+        queued async work against the live ``kv_caches`` reference and
+        collect (finished_sending, finished_recving) notifications
+        (reference: gpu_model_runner.py get_finished_kv_transfers)."""
+        if self.kv_connector is None:
+            return
+        meta = scheduler_output.kv_connector_metadata
+        if meta is not None and scheduler_output.total_num_scheduled_tokens == 0:
+            # The pre-forward start_load_kv site didn't run this step
+            # (nothing scheduled); async pull kickoffs still must.
+            self.kv_connector.start_load_kv(meta, self)
+        sending, recving, failed = self.kv_connector.get_finished(self)
+        if sending or recving or failed:
+            out.finished_sending = sending
+            out.finished_recving = recving
+            out.failed_recving = failed
 
     def _run_device_step(self, token_ids, batch, logits_indices,
                          sampling_md, fwd_shape, ext_md, want_topk):
@@ -674,9 +701,14 @@ class TPUModelRunner:
             sampled.append(tokens)
             out_lps.append([{tok: float(lp)}
                             for tok, lp in zip(tokens, lps_np[:, i])])
-        return ModelRunnerOutput(req_ids=out_req_ids,
-                                 sampled_token_ids=sampled,
-                                 logprobs=out_lps)
+        out = ModelRunnerOutput(req_ids=out_req_ids,
+                                sampled_token_ids=sampled,
+                                logprobs=out_lps)
+        # Config normalization forces num_scheduler_steps=1 whenever a
+        # KV connector is configured, so this is a no-op today — kept so
+        # the invariant lives here, not in a distant config rule.
+        self._poll_kv_connector(scheduler_output, out)
+        return out
 
     # ------------------------------------------------------------------
     @contextmanager
