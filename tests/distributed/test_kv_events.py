@@ -1,0 +1,93 @@
+"""KV cache event publishing: an external subscriber sees BlockStored /
+BlockRemoved as the prefix cache changes (model: reference
+tests/distributed/test_events.py over kv_events.py)."""
+
+import time
+
+import pytest
+import torch
+import zmq
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine import serial
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import get_open_port
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_ev")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def test_block_events_published(checkpoint):
+    port = get_open_port()
+    endpoint = f"tcp://127.0.0.1:{port}"
+
+    ctx = zmq.Context.instance()
+    sub = ctx.socket(zmq.SUB)
+    sub.setsockopt(zmq.SUBSCRIBE, b"kv-events")
+
+    engine = LLMEngine(EngineArgs(
+        model=checkpoint, dtype="float32", block_size=4,
+        num_gpu_blocks_override=16, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True, enable_kv_cache_events=True,
+        kv_events_endpoint=endpoint).create_engine_config())
+    sub.connect(endpoint)
+    time.sleep(0.3)  # PUB/SUB slow-joiner settle
+
+    prompt = [3, 17, 92, 45, 8, 21, 33, 64, 90]  # 2 full pages
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    engine.add_request("e-0", prompt, sp)
+    while engine.has_unfinished_requests():
+        engine.step()
+
+    events = []
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            _topic, _seq, payload = sub.recv_multipart(
+                flags=zmq.NOBLOCK)
+            events.extend(serial.unpack(payload)["events"])
+        except zmq.Again:
+            if any(e[0] == "stored" for e in events):
+                break
+            time.sleep(0.05)
+    stored = [e for e in events if e[0] == "stored"]
+    assert stored, "no BlockStored events received"
+    # First stored block's tokens = the first full prompt page.
+    assert stored[0][3] == prompt[:4]
+    assert stored[0][2] is None  # no parent for the first page
+    if len(stored) > 1:
+        assert stored[1][2] == stored[0][1][0]  # chained parent hash
+
+    # Fill the tiny pool with fresh prompts until eviction fires.
+    for i in range(8):
+        engine.add_request(f"f-{i}", [40 + i, 50 + i, 60 + i, 70 + i,
+                                      80 + i], sp)
+    while engine.has_unfinished_requests():
+        engine.step()
+    deadline = time.time() + 10
+    removed = []
+    while time.time() < deadline and not removed:
+        try:
+            _t, _s, payload = sub.recv_multipart(flags=zmq.NOBLOCK)
+            removed += [e for e in serial.unpack(payload)["events"]
+                        if e[0] == "removed"]
+        except zmq.Again:
+            time.sleep(0.05)
+    assert removed, "no BlockRemoved events after cache pressure"
+
+    engine.shutdown()
+    sub.close(linger=0)

@@ -110,3 +110,59 @@ def test_pp2_spec_decode_matches_baseline(checkpoint, baseline):
                           speculative_method="ngram",
                           num_speculative_tokens=3), PROMPTS, "pp2spec")
     assert got == baseline
+
+
+def test_pp2_batch_queue_overlaps_microbatches(checkpoint):
+    """The PP engine core must keep >1 batch in flight (reference:
+    core.py:242 step_with_batch_queue): with a token budget that fits
+    only half the requests per batch, the two halves pipeline — and
+    the interleaved decode still matches the sequential baseline."""
+    prompts = [[i * 7 + j for j in range(1, 9)] for i in range(4)]
+    baseline = run(make_engine(checkpoint), prompts, "bq-base")
+
+    engine = make_engine(checkpoint, pipeline_parallel_size=2,
+                         max_num_batched_tokens=16)
+    core = engine.engine_core.engine_core
+    assert core.batch_queue is not None
+    assert core.batch_queue_size == 2
+    got = run(engine, prompts, "bq")
+    assert got == baseline
+    # The load was split into >=2 concurrent microbatches at some point
+    # (prefill splits 4x8 tokens over a 16-token budget, decode then
+    # alternates the two halves through the queue).
+    assert core.max_concurrent_batches == 2
+
+
+def test_pp2_batch_queue_abort_in_flight_is_safe(checkpoint):
+    """Aborting a request while its batch is dispatched defers the
+    finish until the batch retires; other requests are unaffected."""
+    prompts = [[i * 7 + j for j in range(1, 9)] for i in range(4)]
+    engine = make_engine(checkpoint, pipeline_parallel_size=2,
+                         max_num_batched_tokens=16)
+    core = engine.engine_core.engine_core
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"ab-{i}", p, sp)
+    # Step until at least one batch is in flight, then abort a request
+    # that is part of it.
+    aborted = None
+    for _ in range(50):
+        engine.step()
+        if core.scheduler.in_flight_req_ids:
+            aborted = next(iter(core.scheduler.in_flight_req_ids))
+            engine.abort_request([aborted])
+            break
+    assert aborted is not None
+    done = set()
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done.add(out.request_id)
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    assert done == {f"ab-{i}" for i in range(4)} - {aborted}
+    assert not core.scheduler._deferred_finishes
+    # All pages returned (no leak from the deferred finish).
+    pool = core.scheduler.kv_cache_manager.block_pool
+    assert pool.get_num_free_blocks() == pool.num_blocks

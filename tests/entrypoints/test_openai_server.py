@@ -30,7 +30,13 @@ def _save_checkpoint_with_tokenizer(path) -> HFLlama:
 
     from tokenizers import Tokenizer, models, pre_tokenizers
     from transformers import PreTrainedTokenizerFast
-    vocab = {f"w{i}": i for i in range(VOCAB - 2)}
+    # Ids 110-117 carry JSON-ish / choice words so structured-output
+    # grammars have something to allow.
+    special_words = {"{": 110, "}": 111, '"a"': 112, ":": 113,
+                     "true": 114, "false": 115, "yes": 116, "no": 117}
+    vocab = {f"w{i}": i for i in range(VOCAB - 2)
+             if i not in special_words.values()}
+    vocab.update(special_words)
     vocab["<unk>"] = VOCAB - 2
     vocab["</s>"] = 1
     tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
@@ -126,6 +132,76 @@ def test_completion_streaming_matches_nonstream(server):
     assert text == full
     assert len(chunks) >= 2, "streaming must deliver incremental chunks"
     assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_metrics_expose_latency_histograms(server):
+    """After at least one completion, /metrics must expose the TTFT /
+    ITL / e2e histograms with real observations (reference:
+    v1/metrics/loggers.py:143 histogram families)."""
+    base, _ = server
+    httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w2 w3", "max_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True,
+    })
+    text = httpx.get(f"{base}/metrics", timeout=30).text
+    assert "vdt:time_to_first_token_seconds_bucket" in text
+    assert "vdt:inter_token_latency_seconds_bucket" in text
+    assert "vdt:e2e_request_latency_seconds_count" in text
+    count = [line for line in text.splitlines()
+             if line.startswith("vdt:time_to_first_token_seconds_count")]
+    assert count and float(count[0].split()[-1]) >= 1
+    gen = [line for line in text.splitlines()
+           if line.startswith("vdt:generation_tokens_total ")]
+    assert gen and float(gen[0].split()[-1]) >= 4
+
+
+def test_profile_rpc_produces_trace(server, tmp_path, monkeypatch):
+    """start/stop profile RPC drives jax.profiler on the core
+    (reference: tpu_worker.py:246-256 profile RPC)."""
+    import os
+    base, _ = server
+    r = httpx.post(f"{base}/start_profile", timeout=60)
+    assert r.status_code == 200, r.text
+    httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w4", "max_tokens": 2,
+        "temperature": 0.0, "ignore_eos": True,
+    })
+    r = httpx.post(f"{base}/stop_profile", timeout=60)
+    assert r.status_code == 200, r.text
+    trace_dir = r.json()["dir"]
+    assert os.path.isdir(trace_dir)
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += [f for f in files if "trace" in f or f.endswith(".pb")]
+    assert found, f"no trace artifacts under {trace_dir}"
+
+
+def test_json_mode_always_parses(server):
+    """Served structured output: response_format json_object makes the
+    (random-weight) model emit valid JSON, every time."""
+    base, _ = server
+    for seed in range(3):
+        r = httpx.post(f"{base}/v1/chat/completions", timeout=300, json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "w1 w2"}],
+            "max_tokens": 40, "temperature": 1.0, "seed": seed,
+            "response_format": {"type": "json_object"},
+        })
+        assert r.status_code == 200, r.text
+        content = r.json()["choices"][0]["message"]["content"]
+        parsed = json.loads(content)
+        assert isinstance(parsed, dict), content
+
+
+def test_guided_choice_served(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w3 w4", "max_tokens": 10,
+        "temperature": 1.0, "seed": 5, "guided_choice": ["yes", "no"],
+    })
+    assert r.status_code == 200, r.text
+    text = r.json()["choices"][0]["text"].strip()
+    assert text in ("yes", "no"), text
 
 
 def test_completion_n_gt_1(server):

@@ -136,21 +136,36 @@ def test_async_pull_lifecycle_and_parity(checkpoint):
 
 def test_other_requests_progress_while_pull_held(checkpoint):
     """The hold-until-loaded state must not stall the engine: a local
-    request keeps decoding while another waits on a remote pull that is
-    never served (no producer stepping)."""
-    producer = make_engine(checkpoint, role="kv_producer")
-    prod_out = run(producer, [PROMPTS[1]], "prod", max_tokens=1)
-    params = prod_out[0].kv_transfer_params
+    request keeps decoding while another waits on a pull from a peer
+    that accepts the connection but never answers."""
+    import socket as _socket
+    import threading
+    import time as _time
+
+    baseline = [o.outputs[0].token_ids
+                for o in run(make_engine(checkpoint), [PROMPTS[1]],
+                             "base", max_tokens=5)]
+
+    # A silent peer: accepts connections, never replies, so the pull
+    # stays genuinely in flight.
+    silent = _socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(4)
+    conns = []
+    threading.Thread(target=lambda: conns.append(silent.accept()),
+                     daemon=True).start()
+    params = {"remote_req_id": "held", "pull_host": "127.0.0.1",
+              "pull_port": silent.getsockname()[1], "num_tokens": 12,
+              "remote_page_ids": [0, 1, 2]}
 
     consumer = make_engine(checkpoint, role="kv_consumer")
     sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
-    consumer.add_request("held-0", PROMPTS[1], sp, kv_transfer_params=params)
+    consumer.add_request("held-0", PROMPTS[1], sp,
+                         kv_transfer_params=params)
     consumer.add_request("local-0", PROMPTS[0], sp)
 
-    # Never step the producer: the pull can't complete promptly; the
-    # local request must still finish.
     local_done = None
-    for _ in range(200):
+    for _ in range(300):
         for out in consumer.step():
             if out.finished and out.request_id == "local-0":
                 local_done = out
@@ -158,19 +173,23 @@ def test_other_requests_progress_while_pull_held(checkpoint):
             break
     assert local_done is not None
     csched = scheduler(consumer)
-    assert ("held-0" in csched.waiting_for_remote_kv
-            or not consumer.has_unfinished_requests())
+    assert "held-0" in csched.waiting_for_remote_kv
 
-    # Let the pull complete so engine teardown is clean.
-    done = dict()
-    for _ in range(2000):
+    # Kill the silent peer: the pull errors, the span recomputes
+    # locally, and the held request still produces correct output.
+    for c, _addr in conns:
+        c.close()
+    silent.close()
+    done = {}
+    for _ in range(3000):
         for out in consumer.step():
             if out.finished:
                 done[out.request_id] = out
-        producer.step()
         if "held-0" in done:
             break
+        _time.sleep(0.002)
     assert "held-0" in done
+    assert done["held-0"].outputs[0].token_ids == baseline[0]
 
 
 def test_failed_pull_recomputes_locally(checkpoint):

@@ -1,0 +1,140 @@
+"""EPLB: placement algorithm invariants + end-to-end redundant-expert
+routing parity (model: reference tests/distributed/test_eplb_algo.py /
+test_eplb_execute.py, pure-CPU)."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import MixtralConfig
+from transformers import MixtralForCausalLM as HFMixtral
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.parallel.eplb import (EplbState, rank_loads,
+                                                rebalance_experts)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+def test_placement_invariants():
+    rng = np.random.default_rng(0)
+    loads = rng.gamma(1.0, 1.0, size=(3, 8))
+    p = rebalance_experts(loads, num_physical=12, num_ranks=4)
+    L, P = p.phys_to_logical.shape
+    assert (L, P) == (3, 12)
+    for layer in range(L):
+        # Every logical expert hosted at least once; replica counts match.
+        counts = np.bincount(p.phys_to_logical[layer], minlength=8)
+        assert (counts >= 1).all()
+        assert (counts == p.logical_replicas[layer]).all()
+        # logical_to_phys inverts phys_to_logical.
+        for e in range(8):
+            ids = p.logical_to_phys[layer, e]
+            ids = ids[ids >= 0]
+            assert len(ids) == counts[e]
+            assert all(p.phys_to_logical[layer, i] == e for i in ids)
+
+
+def test_replicas_go_to_hot_experts_and_balance_ranks():
+    # One extremely hot expert: it must get the spare slots, and the
+    # packed per-rank load must beat the naive contiguous layout.
+    loads = np.asarray([[100.0, 1, 1, 1, 1, 1, 1, 2]])
+    p = rebalance_experts(loads, num_physical=12, num_ranks=4)
+    assert p.logical_replicas[0, 0] == 5  # all 4 spares + original
+    balanced = rank_loads(p, loads, 4)[0]
+    naive = np.asarray(
+        [loads[0, 0] + loads[0, 1], loads[0, 2] + loads[0, 3],
+         loads[0, 4] + loads[0, 5], loads[0, 6] + loads[0, 7]])
+    assert balanced.max() < naive.max() / 2
+    # Replicas of the hot expert spread across ranks.
+    hot_ranks = {i // 3 for i in p.logical_to_phys[0, 0] if i >= 0}
+    assert len(hot_ranks) >= 3
+
+
+def test_eplb_state_ema_and_cadence():
+    st = EplbState(num_layers=1, num_experts=4, ema_decay=0.5,
+                   rebalance_interval=3)
+    for _ in range(3):
+        st.record(np.asarray([[8.0, 0, 0, 0]]))
+    assert st.should_rebalance()
+    assert st.loads[0, 0] > st.loads[0, 1]
+    p = st.make_placement(num_physical=6, num_ranks=2)
+    assert not st.should_rebalance()
+    assert p.logical_replicas[0, 0] == 3  # hot expert got both spares
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = MixtralConfig(vocab_size=128, hidden_size=64,
+                        intermediate_size=96, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        num_local_experts=4, num_experts_per_tok=2,
+                        max_position_embeddings=64, eos_token_id=1)
+    hf = HFMixtral(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_mixtral_eplb")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def run(engine, prompts, tag):
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"{tag}-{i}", p, sp)
+    done = {}
+    for _ in range(200):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+    return [done[k].outputs[0].token_ids for k in order]
+
+
+PROMPTS = [[3, 17, 92, 45, 8, 21, 33], [5, 9, 33, 71, 14]]
+
+
+def test_redundant_experts_preserve_hf_parity(checkpoint):
+    """Physical replicas + routing indirection must be numerically
+    invisible: redundant-expert engines match the plain engine exactly
+    (replica weights are copies; per-token replica choice is arbitrary
+    but the weights are identical)."""
+    path, _hf = checkpoint
+
+    def make(**overrides):
+        args = dict(model=path, dtype="float32", block_size=4,
+                    num_gpu_blocks_override=128, max_model_len=64,
+                    max_num_batched_tokens=64, max_num_seqs=8,
+                    skip_tokenizer_init=True)
+        args.update(overrides)
+        return LLMEngine(EngineArgs(**args).create_engine_config())
+
+    base = run(make(), PROMPTS, "b")
+    redundant = run(make(num_redundant_experts=2), PROMPTS, "r")
+    assert redundant == base
+    # And under expert parallelism over the padded physical count
+    # (6 physical experts NOT divisible by tp=2? use 4+4=8 phys, tp=4).
+    ep = run(make(num_redundant_experts=4, enable_expert_parallel=True,
+                  tensor_parallel_size=4), PROMPTS, "e")
+    assert ep == base
+
+
+def test_live_rebalance_keeps_outputs(checkpoint):
+    """apply_rebalance moves weights to a new placement mid-flight;
+    outputs after the move stay identical to before."""
+    path, _hf = checkpoint
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True, num_redundant_experts=2)
+    engine = LLMEngine(EngineArgs(**args).create_engine_config())
+    first = run(engine, PROMPTS, "a")
+
+    runner = engine.engine_core.engine_core.executor.worker.model_runner
+    model = runner.model
+    loads = np.asarray([[5.0, 1.0, 9.0, 2.0]] * 2)
+    placement = rebalance_experts(loads, model.num_physical, 1)
+    runner.params = model.apply_rebalance(runner.params, placement)
+
+    second = run(engine, PROMPTS, "b")
+    assert second == first

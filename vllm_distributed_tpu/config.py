@@ -36,6 +36,10 @@ class ModelConfig:
     skip_tokenizer_init: bool = False
     trust_remote_code: bool = False
     dtype: str = "bfloat16"  # bfloat16 | float32 (TPU-native dtypes)
+    # Weight quantization: None (full precision) or "int8" — w8a16
+    # quantize-on-load with per-output-channel scales (reference:
+    # model_executor/layers/quantization/tpu_int8.py).
+    quantization: Optional[str] = None
     seed: int = 0
     max_model_len: Optional[int] = None
     # Overrides applied on top of the HF config (tests use this to build tiny
@@ -49,6 +53,10 @@ class ModelConfig:
             self.tokenizer = self.model
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"unsupported quantization {self.quantization!r} "
+                "(supported: int8)")
 
     def maybe_load_hf_config(self) -> Any:
         """Load (and cache) the HF config for the model.
@@ -180,6 +188,9 @@ class ParallelConfig:
     data_parallel_size: int = 1
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    # EPLB: extra physical expert slots hosting replicas of hot experts
+    # (reference: ParallelConfig num_redundant_experts + eplb config).
+    num_redundant_experts: int = 0
     # How data parallelism is realized (reference: one DPEngineCoreProc
     # per DP rank behind a balancing coordinator, v1/engine/core.py:812 +
     # coordinator.py:21):
@@ -198,6 +209,17 @@ class ParallelConfig:
     # Run the engine core (scheduler + executor busy loop) in its own
     # process with ZMQ transport (reference: EngineCoreProc, core.py:362).
     multiprocess_engine_core: bool = False
+    # Multi-host SPMD (reference boundary: one worker process per host,
+    # v1/executor/multiproc_executor.py:42 + StatelessProcessGroup
+    # bootstrap, distributed/utils.py:138; JAX analogue:
+    # jax.distributed.initialize + one controller process per host whose
+    # jax.devices() spans the whole pod). num_hosts > 1 makes the worker
+    # initialize the distributed runtime before touching devices.
+    num_hosts: int = 1
+    host_rank: int = 0
+    # coordinator "ip:port" (host 0); None lets JAX auto-detect on TPU
+    # pods (GCE metadata).
+    coordinator_address: Optional[str] = None
     # Multi-host: processes per pod slice (jax.distributed).
     distributed_init_method: Optional[str] = None
 
@@ -282,8 +304,13 @@ class DeviceConfig:
 class LoadConfig:
     """Weight loading (reference: vllm/config.py:1711 + model_loader/)."""
 
-    load_format: str = "auto"  # auto | safetensors | dummy
+    # auto | safetensors | dummy | sharded_state (orbax tree saved by
+    # save_sharded_state; model still names the HF dir for the config).
+    load_format: str = "auto"
     download_dir: Optional[str] = None
+    # Directory of the orbax tree for load_format="sharded_state"
+    # (defaults to model_config.model).
+    sharded_state_path: Optional[str] = None
 
 
 @dataclass
@@ -315,6 +342,39 @@ class KVTransferConfig:
 
 
 @dataclass
+class KVEventsConfig:
+    """ZMQ publishing of prefix-cache block events for external routers
+    (reference: vllm/config.py:3922 KVEventsConfig +
+    distributed/kv_events.py)."""
+
+    enable_kv_cache_events: bool = False
+    endpoint: str = "tcp://127.0.0.1:5557"
+    replay_endpoint: Optional[str] = None
+    buffer_steps: int = 1000
+
+
+@dataclass
+class LoRAConfig:
+    """Multi-LoRA serving (reference: vllm/config.py:2999 LoRAConfig).
+
+    Static-shape discipline: ``max_loras`` adapter SLOTS of fixed
+    ``max_lora_rank`` are baked into the compiled graphs (slot 0 is the
+    always-zero "no adapter" slot); adapters hot-swap by writing slot
+    buffers, never by recompiling."""
+
+    enable_lora: bool = False
+    max_loras: int = 4
+    max_lora_rank: int = 16
+
+    def __post_init__(self) -> None:
+        if self.enable_lora:
+            if self.max_loras < 1:
+                raise ValueError("max_loras must be >= 1")
+            if self.max_lora_rank < 1:
+                raise ValueError("max_lora_rank must be >= 1")
+
+
+@dataclass
 class ObservabilityConfig:
     """Tracing/metrics switches (reference: vllm/config.py:3735)."""
 
@@ -342,6 +402,9 @@ class EngineConfig:
         default_factory=SpeculativeConfig)
     kv_transfer_config: KVTransferConfig = field(
         default_factory=KVTransferConfig)
+    lora_config: LoRAConfig = field(default_factory=LoRAConfig)
+    kv_events_config: KVEventsConfig = field(
+        default_factory=KVEventsConfig)
     observability_config: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
 
@@ -369,6 +432,9 @@ class EngineConfig:
                 # fused burst would silently skip them.
                 ("a KV-transfer connector",
                  bool(self.kv_transfer_config.kv_connector)),
+                # The burst's scanned decode graph carries no per-token
+                # adapter slots.
+                ("LoRA", self.lora_config.enable_lora),
         ):
             if incompatible and self.scheduler_config.num_scheduler_steps > 1:
                 logger.warning(

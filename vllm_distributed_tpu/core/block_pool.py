@@ -112,6 +112,17 @@ class BlockPool:
         self.free_block_queue = FreeKVCacheBlockQueue(self.blocks)
         # hash -> block holding that content (at most one per hash).
         self.cached_block_hash_to_block: dict[bytes, KVCacheBlock] = {}
+        # When enabled, block cache mutations append events here; the
+        # scheduler drains them each step into the KV event publisher
+        # (reference: block_pool's kv_cache_events plumbing).
+        self.pending_events: Optional[list] = None
+
+    def enable_events(self) -> None:
+        self.pending_events = []
+
+    def take_events(self) -> list:
+        events, self.pending_events = self.pending_events or [], []
+        return events
 
     def get_num_free_blocks(self) -> int:
         return self.free_block_queue.num_free_blocks
@@ -150,6 +161,11 @@ class BlockPool:
         if block.block_hash is not None:
             self.cached_block_hash_to_block.pop(
                 block.block_hash.hash_value, None)
+            if self.pending_events is not None:
+                from vllm_distributed_tpu.distributed.kv_events import \
+                    BlockRemoved
+                self.pending_events.append(BlockRemoved(
+                    block_hashes=[block.block_hash.hash_value]))
             block.block_hash = None
 
     def cache_full_blocks(
@@ -178,6 +194,16 @@ class BlockPool:
                 continue
             block.block_hash = block_hash
             self.cached_block_hash_to_block[block_hash.hash_value] = block
+            if self.pending_events is not None:
+                from vllm_distributed_tpu.distributed.kv_events import \
+                    BlockStored
+                parent = (block_hashes[i - 1].hash_value
+                          if i > 0 else None)
+                self.pending_events.append(BlockStored(
+                    block_hashes=[block_hash.hash_value],
+                    parent_block_hash=parent,
+                    token_ids=list(block_hash.token_ids),
+                    block_size=len(block_hash.token_ids)))
 
     def free_blocks(self, ordered_blocks: list[KVCacheBlock]) -> None:
         """Drop one reference on each block; ref-0 blocks enter the free
@@ -199,4 +225,8 @@ class BlockPool:
         for block in self.blocks:
             block.block_hash = None
         self.cached_block_hash_to_block.clear()
+        if self.pending_events is not None:
+            from vllm_distributed_tpu.distributed.kv_events import \
+                AllBlocksCleared
+            self.pending_events.append(AllBlocksCleared())
         return True

@@ -21,6 +21,8 @@ class NewRequestData:
     sampling_params: SamplingParams
     block_ids: list[int]
     num_computed_tokens: int
+    # Multi-LoRA adapter selection ({"name", "path"}; see models/lora.py).
+    lora_request: "dict | None" = None
 
 
 @dataclass
@@ -66,6 +68,10 @@ class SchedulerOutput:
     # Disaggregated-prefill metadata piggybacking on the step, consumed by
     # the worker-side connector (reference: base.py build_connector_meta).
     kv_connector_metadata: Optional[Any] = None
+    # Structured output: req_id -> [V] bool numpy mask for the request's
+    # next sampled token (reference: the grammar bitmask shipped with the
+    # scheduler output and applied at gpu_model_runner.py:1433).
+    structured_masks: Optional[dict[str, Any]] = None
     # Token-parallel ownership for this step (None when tknp disabled).
     token_parallel_allocation: Optional[TokenParallelAllocation] = None
     # >1: the worker runs this many fused decode steps device-side before

@@ -103,6 +103,23 @@ class Scheduler:
                 num_blocks=num_blocks,
                 enable_caching=config.cache_config.enable_prefix_caching,
             )
+        # Structured output (reference: the engine core's
+        # StructuredOutputManager beside the scheduler,
+        # v1/structured_output/__init__.py); set by EngineCore when the
+        # first structured request arrives.
+        self.structured_manager = None
+        # KV cache events for external prefix-aware routers (reference:
+        # distributed/kv_events.py ZmqEventPublisher).
+        self.kv_event_publisher = None
+        ev_cfg = config.kv_events_config
+        if ev_cfg.enable_kv_cache_events:
+            from vllm_distributed_tpu.distributed.kv_events import \
+                KVEventPublisher
+            self.kv_event_publisher = KVEventPublisher(
+                ev_cfg.endpoint, ev_cfg.replay_endpoint,
+                ev_cfg.buffer_steps)
+            for pool in self._block_pools():
+                pool.enable_events()
         # Disaggregated-prefill hook (reference: scheduler holds the
         # scheduler-side KVConnector, sched/scheduler.py KVConnector calls).
         self.kv_connector = kv_connector
@@ -127,6 +144,13 @@ class Scheduler:
         # the worker reports the (now moot) pull finished, so a late
         # apply can never write into reallocated pages.
         self.cancelled_remote_kv: dict[str, Request] = {}
+        # Pipeline-parallel batch queue (managed by the engine core):
+        # requests inside a dispatched-but-unretired batch. They are
+        # skipped by schedule() (their next token depends on in-flight
+        # device work), protected from preemption (that work is writing
+        # their pages), and external finishes defer until retirement.
+        self.in_flight_req_ids: set[str] = set()
+        self._deferred_finishes: dict[str, RequestStatus] = {}
 
         # Stats for the metrics subsystem.
         self.num_scheduled_steps = 0
@@ -162,6 +186,12 @@ class Scheduler:
             request = self.requests.get(req_id)
             if request is None or request.is_finished:
                 continue
+            if req_id in self.in_flight_req_ids:
+                # A dispatched batch is still writing this request's
+                # pages; freeing them now would hand them to another
+                # request mid-write. Finish when the batch retires.
+                self._deferred_finishes[req_id] = status
+                continue
             if request.status == RequestStatus.RUNNING:
                 self.running.remove(request)
             elif request.status == RequestStatus.WAITING_FOR_REMOTE_KVS:
@@ -171,6 +201,8 @@ class Scheduler:
                 self.waiting_for_remote_kv.pop(req_id, None)
                 request.status = status
                 self.cancelled_remote_kv[req_id] = request
+                if self.structured_manager is not None:
+                    self.structured_manager.remove_request(req_id)
                 self.finished_req_ids.add(req_id)
                 del self.requests[req_id]
                 continue
@@ -205,6 +237,8 @@ class Scheduler:
         else:
             self.kv_cache_manager.free(request)
             self.kv_cache_manager.free_block_hashes(request)
+        if self.structured_manager is not None:
+            self.structured_manager.remove_request(request.request_id)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
         return params
@@ -215,6 +249,15 @@ class Scheduler:
     def has_requests(self) -> bool:
         return bool(self.waiting or self.running
                     or self.waiting_for_remote_kv)
+
+    def has_schedulable_requests(self) -> bool:
+        """Work the next schedule() call could actually grant tokens to
+        (in-flight requests excluded) — gates dispatching another batch
+        in the engine core's PP batch queue."""
+        if self.waiting:
+            return True
+        return any(r.request_id not in self.in_flight_req_ids
+                   for r in self.running)
 
     def has_kv_transfer_work(self) -> bool:
         """True while async KV transfers are in flight: held consumer
@@ -271,6 +314,12 @@ class Scheduler:
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
             request = self.running[req_index]
+            if request.request_id in self.in_flight_req_ids:
+                # Another dispatched batch owns this request's next
+                # token (PP batch queue); it becomes schedulable when
+                # that batch retires.
+                req_index += 1
+                continue
             num_new_tokens = (request.num_tokens_with_spec -
                               request.num_computed_tokens)
             if self.long_prefill_token_threshold > 0:
@@ -343,6 +392,13 @@ class Scheduler:
             while (self.waiting and token_budget > 0
                    and len(self.running) < self.max_num_seqs):
                 request = self.waiting[0]
+
+                if not self._lora_admittable(request):
+                    # Admitting would need more distinct adapters than
+                    # the runner has slots (reference: the scheduler's
+                    # lora constraint); wait for a lora request to
+                    # finish rather than crash the runner's slot pool.
+                    break
 
                 if request.num_prompt_tokens >= self.max_model_len:
                     # The prompt alone fills (or overflows) the context
@@ -470,6 +526,7 @@ class Scheduler:
                             sampling_params=request.sampling_params,
                             block_ids=all_block_ids,
                             num_computed_tokens=num_computed_tokens,
+                            lora_request=request.lora_request,
                         ))
 
         self.num_scheduled_steps += 1
@@ -487,6 +544,14 @@ class Scheduler:
             tknp_alloc = TokenParallelAllocation(
                 req_to_rank=req_to_rank,
                 tokens_per_rank=tokens_per_rank)
+        structured_masks = None
+        if self.structured_manager is not None:
+            masks = {
+                req_id: self.structured_manager.mask_for(req_id)
+                for req_id in num_scheduled_tokens
+                if self.structured_manager.has(req_id)
+            }
+            structured_masks = masks or None
         output = SchedulerOutput(
             scheduled_new_reqs=scheduled_new_reqs,
             scheduled_cached_reqs=cached_reqs,
@@ -496,12 +561,41 @@ class Scheduler:
             finished_req_ids=self.finished_req_ids,
             multi_step=multi_step if num_scheduled_tokens else 1,
             token_parallel_allocation=tknp_alloc,
+            structured_masks=structured_masks,
         )
         self.finished_req_ids = set()
         if self.kv_connector is not None:
             output.kv_connector_metadata = \
                 self.kv_connector.build_connector_meta(output)
+        if self.kv_event_publisher is not None:
+            events = []
+            for pool in self._block_pools():
+                events.extend(pool.take_events())
+            self.kv_event_publisher.publish(events)
         return output
+
+    def _block_pools(self):
+        mgr = self.kv_cache_manager
+        if hasattr(mgr, "block_pool"):
+            return [mgr.block_pool]
+        return [m.block_pool for m in mgr.managers]
+
+    def shutdown(self) -> None:
+        if self.kv_event_publisher is not None:
+            self.kv_event_publisher.shutdown()
+
+    def _lora_admittable(self, request: Request) -> bool:
+        """Distinct adapters among live requests + this one must fit the
+        runner's slot count. ALL unfinished lora requests count —
+        preempted ones still hold their worker slot until they finish
+        (the runner releases at removal, not preemption)."""
+        if request.lora_request is None:
+            return True
+        max_loras = self.config.lora_config.max_loras
+        names = {r.lora_request["name"] for r in self.requests.values()
+                 if r.lora_request is not None}
+        names.add(request.lora_request["name"])
+        return len(names) <= max_loras
 
     def _assign_tknp_rank(self, request: Request) -> None:
         """Assign a token-parallel rank: most free pages first, then
@@ -527,7 +621,10 @@ class Scheduler:
         exhausted rank's pool partition, so other ranks' requests are
         never evicted for this allocation; with no same-rank candidate
         the request preempts itself."""
-        candidates = self.running[req_index:]
+        candidates = [r for r in self.running[req_index:]
+                      if r.request_id not in self.in_flight_req_ids]
+        if not candidates:
+            return request
         if self.tknp_size > 1:
             candidates = [r for r in candidates
                           if r.tknp_rank == request.tknp_rank]
@@ -584,6 +681,17 @@ class Scheduler:
 
         self._update_kv_transfer_state(runner_output)
 
+        # External finishes (aborts, stop strings) that arrived while
+        # the request sat in a dispatched batch: the batch has retired
+        # (the engine core clears in_flight before calling here), so the
+        # normal finish path is safe now.
+        if self._deferred_finishes:
+            ready = [req_id for req_id in self._deferred_finishes
+                     if req_id not in self.in_flight_req_ids]
+            for req_id in ready:
+                self.finish_requests(req_id,
+                                     self._deferred_finishes.pop(req_id))
+
         outputs: list[EngineCoreOutput] = []
         finished: list[Request] = []
         for request in self.running:
@@ -619,6 +727,11 @@ class Scheduler:
                     # Discard any extra accepted spec tokens past the stop.
                     request.spec_token_ids = []
                     break
+
+            if self.structured_manager is not None and new_token_ids:
+                # Advance the grammar with exactly the kept tokens (a
+                # stop may have trimmed trailing accepted drafts).
+                self.structured_manager.advance(req_id, new_token_ids)
 
             if request.is_finished:
                 finished.append(request)

@@ -1,0 +1,130 @@
+"""KV cache block events: ZMQ pub/sub for external prefix-cache routers.
+
+Reference: vllm/distributed/kv_events.py:104 ``ZmqEventPublisher`` —
+the scheduler's block pool reports BlockStored / BlockRemoved /
+AllBlocksCleared; an external router subscribes and steers requests to
+the engine already holding their prefix. Wire shape kept compatible in
+spirit: msgpack batches tagged with a monotonically increasing sequence
+number, plus a bounded replay buffer served over a side ROUTER socket so
+a late subscriber can backfill missed batches.
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class BlockStored:
+    block_hashes: list[bytes]
+    parent_block_hash: Optional[bytes]
+    token_ids: list[int]
+    block_size: int
+    lora_id: Optional[int] = None
+
+    def to_wire(self) -> list:
+        return ["stored", self.block_hashes, self.parent_block_hash,
+                self.token_ids, self.block_size, self.lora_id]
+
+
+@dataclass
+class BlockRemoved:
+    block_hashes: list[bytes]
+
+    def to_wire(self) -> list:
+        return ["removed", self.block_hashes]
+
+
+@dataclass
+class AllBlocksCleared:
+    def to_wire(self) -> list:
+        return ["cleared"]
+
+
+@dataclass
+class EventBatch:
+    ts: float
+    events: list = field(default_factory=list)
+
+
+class KVEventPublisher:
+    """Batches block events and publishes them on a ZMQ PUB socket from
+    a background thread (the scheduler's hot loop only appends to an
+    in-memory queue). A bounded replay buffer answers REQ backfills for
+    sequence gaps (reference: kv_events.py replay mechanism)."""
+
+    def __init__(self, endpoint: str, replay_endpoint: Optional[str] = None,
+                 buffer_steps: int = 1000,
+                 topic: bytes = b"kv-events") -> None:
+        import zmq
+        self.topic = topic
+        self.ctx = zmq.Context.instance()
+        self.pub = self.ctx.socket(zmq.PUB)
+        self.pub.bind(endpoint)
+        self.endpoint = endpoint
+        self.replay = None
+        if replay_endpoint:
+            self.replay = self.ctx.socket(zmq.ROUTER)
+            self.replay.bind(replay_endpoint)
+        self._queue: "queue.Queue[EventBatch]" = queue.Queue()
+        self._buffer: dict[int, bytes] = {}
+        self._buffer_steps = buffer_steps
+        self._seq = 0
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kv-event-publisher")
+        self._thread.start()
+
+    # -- producer side (scheduler thread) ------------------------------
+    def publish(self, events: list) -> None:
+        if events:
+            self._queue.put(EventBatch(ts=time.time(),
+                                       events=list(events)))
+
+    # -- background IO --------------------------------------------------
+    def _run(self) -> None:
+        import zmq
+
+        from vllm_distributed_tpu.engine import serial
+        poller = zmq.Poller()
+        if self.replay is not None:
+            poller.register(self.replay, zmq.POLLIN)
+        while not self._shutdown.is_set():
+            try:
+                batch = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                batch = None
+            if batch is not None:
+                payload = serial.pack({
+                    "seq": self._seq,
+                    "ts": batch.ts,
+                    "events": [e.to_wire() for e in batch.events],
+                })
+                self.pub.send_multipart(
+                    [self.topic, str(self._seq).encode(), payload])
+                self._buffer[self._seq] = payload
+                self._seq += 1
+                if len(self._buffer) > self._buffer_steps:
+                    del self._buffer[min(self._buffer)]
+            if self.replay is not None and poller.poll(0):
+                ident, _, want = self.replay.recv_multipart()
+                start = int(want.decode())
+                for seq in sorted(self._buffer):
+                    if seq >= start:
+                        self.replay.send_multipart(
+                            [ident, b"", str(seq).encode(),
+                             self._buffer[seq]])
+                self.replay.send_multipart([ident, b"", b"-1", b""])
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._thread.join(timeout=5)
+        self.pub.close(linger=0)
+        if self.replay is not None:
+            self.replay.close(linger=0)

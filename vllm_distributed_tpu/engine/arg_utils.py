@@ -9,8 +9,9 @@ from dataclasses import dataclass, fields
 from typing import Optional
 
 from vllm_distributed_tpu.config import (CacheConfig, DeviceConfig,
-                                         EngineConfig, KVTransferConfig,
-                                         LoadConfig, ModelConfig,
+                                         EngineConfig, KVEventsConfig,
+                                         KVTransferConfig, LoadConfig,
+                                         LoRAConfig, ModelConfig,
                                          ObservabilityConfig,
                                          ParallelConfig, SchedulerConfig,
                                          SpeculativeConfig)
@@ -23,6 +24,7 @@ class EngineArgs:
     skip_tokenizer_init: bool = False
     trust_remote_code: bool = False
     dtype: str = "bfloat16"
+    quantization: Optional[str] = None
     seed: int = 0
     max_model_len: Optional[int] = None
 
@@ -38,7 +40,17 @@ class EngineArgs:
     data_parallel_mode: str = "engine"  # engine replicas | mesh axis
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    num_redundant_experts: int = 0
     multiprocess_engine_core: bool = False
+    # Multi-host SPMD: this engine process's place in the pod.
+    num_hosts: int = 1
+    host_rank: int = 0
+    coordinator_address: Optional[str] = None
+
+    # Multi-LoRA serving.
+    enable_lora: bool = False
+    max_loras: int = 4
+    max_lora_rank: int = 16
 
     max_num_batched_tokens: int = 8192
     max_num_seqs: int = 256
@@ -49,6 +61,7 @@ class EngineArgs:
 
     device: str = "auto"
     load_format: str = "auto"
+    sharded_state_path: Optional[str] = None
 
     speculative_method: Optional[str] = None
     num_speculative_tokens: int = 0
@@ -59,6 +72,11 @@ class EngineArgs:
 
     otlp_traces_endpoint: Optional[str] = None
 
+    # KV cache event publishing (external prefix-aware routers).
+    enable_kv_cache_events: bool = False
+    kv_events_endpoint: str = "tcp://127.0.0.1:5557"
+    kv_events_replay_endpoint: Optional[str] = None
+
     def create_engine_config(self) -> EngineConfig:
         model_config = ModelConfig(
             model=self.model,
@@ -66,6 +84,7 @@ class EngineArgs:
             skip_tokenizer_init=self.skip_tokenizer_init,
             trust_remote_code=self.trust_remote_code,
             dtype=self.dtype,
+            quantization=self.quantization,
             seed=self.seed,
             max_model_len=self.max_model_len,
         )
@@ -85,7 +104,11 @@ class EngineArgs:
                 data_parallel_mode=self.data_parallel_mode,
                 token_parallel_size=self.token_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
+                num_redundant_experts=self.num_redundant_experts,
                 multiprocess_engine_core=self.multiprocess_engine_core,
+                num_hosts=self.num_hosts,
+                host_rank=self.host_rank,
+                coordinator_address=self.coordinator_address,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_batched_tokens=self.max_num_batched_tokens,
@@ -98,7 +121,9 @@ class EngineArgs:
                 num_scheduler_steps=self.num_scheduler_steps,
             ),
             device_config=DeviceConfig(device=self.device),
-            load_config=LoadConfig(load_format=self.load_format),
+            load_config=LoadConfig(
+                load_format=self.load_format,
+                sharded_state_path=self.sharded_state_path),
             speculative_config=SpeculativeConfig(
                 method=self.speculative_method,
                 num_speculative_tokens=self.num_speculative_tokens,
@@ -108,6 +133,16 @@ class EngineArgs:
                 kv_role=self.kv_role,
                 kv_connector_extra_config=(
                     self.kv_connector_extra_config or {}),
+            ),
+            lora_config=LoRAConfig(
+                enable_lora=self.enable_lora,
+                max_loras=self.max_loras,
+                max_lora_rank=self.max_lora_rank,
+            ),
+            kv_events_config=KVEventsConfig(
+                enable_kv_cache_events=self.enable_kv_cache_events,
+                endpoint=self.kv_events_endpoint,
+                replay_endpoint=self.kv_events_replay_endpoint,
             ),
             observability_config=ObservabilityConfig(
                 otlp_traces_endpoint=self.otlp_traces_endpoint),

@@ -136,6 +136,7 @@ class AsyncLLM:
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         priority: int = 0,
+        lora_request: Optional[dict] = None,
     ) -> AsyncGenerator[RequestOutput, None]:
         """Async stream of accumulated RequestOutputs for one request
         (reference: async_llm.py:277)."""
@@ -148,7 +149,8 @@ class AsyncLLM:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(request_id, prompt,
                                                  sampling_params,
-                                                 priority=priority)
+                                                 priority=priority,
+                                                 lora_request=lora_request)
         queue: asyncio.Queue = asyncio.Queue()
         self.request_queues[request_id] = queue
         self.output_processor.add_request(
@@ -182,11 +184,20 @@ class AsyncLLM:
         self.core.abort_requests([request_id])
 
     async def get_stats(self) -> dict:
+        return await self._utility("get_stats")
+
+    async def profile(self, action: str = "start"):
+        """Start/stop a device trace on the core (reference:
+        AsyncLLM.start_profile/stop_profile RPCs). Returns the trace
+        dir, or a per-replica list under multiprocess DP."""
+        return await self._utility("profile", action)
+
+    async def _utility(self, method: str, *args):
         if isinstance(self.core, BackgroundEngineCore):
-            return self.core.core.get_stats()
+            return getattr(self.core.core, method)(*args)
         # MP core: the pump thread owns the output socket; poll for the
         # stashed result.
-        call_id = self.core.send_utility("get_stats")
+        call_id = self.core.send_utility(method, *args)
         sentinel = object()
         for _ in range(500):
             value = self.core.fetch_result(call_id, sentinel)
@@ -195,7 +206,7 @@ class AsyncLLM:
                     raise value
                 return value
             await asyncio.sleep(0.02)
-        raise TimeoutError("get_stats RPC timed out")
+        raise TimeoutError(f"{method} RPC timed out")
 
     def shutdown(self) -> None:
         self._stopped = True

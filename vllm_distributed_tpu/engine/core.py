@@ -4,8 +4,19 @@ Reference: vllm/v1/engine/core.py:55 (``EngineCore``: step:223,
 _initialize_kv_caches:133; the multiprocess EngineCoreProc/DPEngineCoreProc
 variants layer transport on top — here the in-process core comes first and
 the ZMQ front-ends reuse it unchanged, mirroring InprocClient).
+
+Pipeline parallelism gets its throughput from the batch queue
+(reference: core.py:242 ``step_with_batch_queue``): up to
+pipeline_parallel_size scheduler outputs are dispatched before blocking
+on the oldest, so stage p of batch i+1 executes under stage p+1 of batch
+i. On TPU the overlap itself comes from JAX async dispatch — the runner's
+dispatch half enqueues per-stage programs without blocking, and each
+stage's KV cache chains only to its own previous-batch output, so the
+device runtime pipelines the stages; the queue's job is to keep the host
+from blocking and the scheduler from re-granting in-flight requests.
 """
 
+from collections import deque
 from typing import Optional
 
 from vllm_distributed_tpu.config import EngineConfig
@@ -40,6 +51,36 @@ class EngineCore:
         kv_connector = create_kv_connector(config, KVConnectorRole.SCHEDULER)
         self.scheduler = Scheduler(config, num_blocks=num_pages,
                                    kv_connector=kv_connector)
+        # PP microbatch overlap: in-flight (scheduler_output, handle)
+        # pairs, newest first; depth = stage count (a deeper queue only
+        # adds latency once every stage has work).
+        self.batch_queue_size = \
+            config.parallel_config.pipeline_parallel_size
+        self.batch_queue: Optional[deque] = (
+            deque(maxlen=self.batch_queue_size)
+            if self.batch_queue_size > 1 else None)
+        # Peak in-flight depth (tests/metrics: proves overlap happened).
+        self.max_concurrent_batches = 0
+        # Structured output: the grammar layer needs a token-bytes table
+        # (a tokenizer load + per-token decode sweep). Prefetch it off
+        # the busy loop so the FIRST structured request doesn't stall
+        # every in-flight stream for the load's duration.
+        self._vocab_bytes_cache: Optional[list[bytes]] = None
+        self._vocab_bytes_thread = None
+        if (not config.model_config.skip_tokenizer_init
+                and getattr(config.model_config,
+                            "structured_vocab_bytes", None) is None
+                and self._tokenizer_files_present()):
+            # Cost/latency tradeoff: the background load burns one
+            # duplicate tokenizer load per engine even if structured
+            # output never arrives, but the FIRST structured request
+            # then never stalls the busy loop for the load's duration.
+            # The file check skips weights-only dirs (most tests).
+            import threading
+            self._vocab_bytes_thread = threading.Thread(
+                target=self._prefetch_vocab_bytes, daemon=True,
+                name="vocab-bytes-prefetch")
+            self._vocab_bytes_thread.start()
 
     def _initialize_kv_caches(self) -> int:
         num_pages = self.executor.determine_num_available_blocks()
@@ -50,7 +91,66 @@ class EngineCore:
 
     # ------------------------------------------------------------------
     def add_request(self, request: EngineCoreRequest) -> None:
+        if request.sampling_params.structured is not None:
+            self._register_structured(request)
         self.scheduler.add_request(Request.from_engine_core_request(request))
+
+    def _register_structured(self, request: EngineCoreRequest) -> None:
+        """Compile the request's grammar in the core, beside the
+        scheduler (reference: v1/structured_output/__init__.py
+        StructuredOutputManager). The manager (and its token-bytes
+        table) is built on the first structured request."""
+        if self.scheduler.structured_manager is None:
+            from vllm_distributed_tpu.structured_output.manager import \
+                StructuredOutputManager
+            self.scheduler.structured_manager = \
+                StructuredOutputManager(self._vocab_bytes())
+        self.scheduler.structured_manager.add_request(
+            request.request_id, request.sampling_params.structured,
+            eos_token_id=request.eos_token_id)
+
+    def _tokenizer_files_present(self) -> bool:
+        import os
+        path = (self.config.model_config.tokenizer
+                or self.config.model_config.model)
+        if not os.path.isdir(path):
+            return False  # hub refs resolve lazily; don't prefetch
+        return any(
+            os.path.exists(os.path.join(path, f))
+            for f in ("tokenizer.json", "tokenizer.model",
+                      "tokenizer_config.json"))
+
+    def _prefetch_vocab_bytes(self) -> None:
+        try:
+            self._vocab_bytes_cache = self._load_vocab_bytes()
+        except Exception as e:  # noqa: BLE001 - surfaced on first use
+            logger.debug("vocab-bytes prefetch failed (%s); structured "
+                         "requests will retry inline", e)
+
+    def _load_vocab_bytes(self) -> list[bytes]:
+        from transformers import AutoTokenizer
+
+        from vllm_distributed_tpu.structured_output.manager import \
+            vocab_bytes_from_tokenizer
+        tok = AutoTokenizer.from_pretrained(
+            self.config.model_config.tokenizer
+            or self.config.model_config.model)
+        return vocab_bytes_from_tokenizer(tok)
+
+    def _vocab_bytes(self) -> list[bytes]:
+        """token id -> utf-8 bytes for grammar mask precomputation.
+        Tests inject ``model_config.structured_vocab_bytes``; otherwise
+        the prefetch thread's table (or an inline load as last resort)."""
+        override = getattr(self.config.model_config,
+                           "structured_vocab_bytes", None)
+        if override is not None:
+            return override
+        if self._vocab_bytes_thread is not None:
+            self._vocab_bytes_thread.join(timeout=120)
+            self._vocab_bytes_thread = None
+        if self._vocab_bytes_cache is None:
+            self._vocab_bytes_cache = self._load_vocab_bytes()
+        return self._vocab_bytes_cache
 
     def abort_requests(self, request_ids: list[str]) -> None:
         self.scheduler.finish_requests(request_ids,
@@ -58,6 +158,8 @@ class EngineCore:
 
     def step(self) -> list[EngineCoreOutput]:
         """One scheduling iteration (reference: core.py:223)."""
+        if self.batch_queue is not None:
+            return self.step_with_batch_queue()
         self.last_step_scheduled = False
         if not (self.scheduler.has_requests()
                 or self.scheduler.has_kv_transfer_work()):
@@ -66,6 +168,54 @@ class EngineCore:
         self.last_step_scheduled = \
             scheduler_output.total_num_scheduled_tokens > 0
         runner_output = self.executor.execute_model(scheduler_output)
+        return self.scheduler.update_from_output(scheduler_output,
+                                                 runner_output)
+
+    def step_with_batch_queue(self) -> list[EngineCoreOutput]:
+        """One iteration of the pipeline-parallel batch queue
+        (reference: core.py:242): dispatch a fresh batch whenever there
+        is room and schedulable work; otherwise retire the oldest. Each
+        call does at most one of the two, so dispatches outnumber waits
+        until the pipeline fills."""
+        self.last_step_scheduled = False
+        if (len(self.batch_queue) < self.batch_queue_size
+                and self.scheduler.has_schedulable_requests()):
+            scheduler_output = self.scheduler.schedule()
+            if scheduler_output.total_num_scheduled_tokens > 0:
+                self.scheduler.in_flight_req_ids.update(
+                    scheduler_output.num_scheduled_tokens)
+                handle = self.executor.execute_model_async(
+                    scheduler_output)
+                self.batch_queue.appendleft((scheduler_output, handle))
+                self.last_step_scheduled = True
+                self.max_concurrent_batches = max(
+                    self.max_concurrent_batches, len(self.batch_queue))
+                return []
+            # An empty grant despite schedulable work (pool exhausted,
+            # budget edge). The output still carries finished_req_ids
+            # for worker-side row cleanup — run it through synchronously
+            # rather than dropping it, then retire a batch to free
+            # pages/slots for the next attempt.
+            runner_output = self.executor.execute_model(scheduler_output)
+            self.scheduler.update_from_output(scheduler_output,
+                                              runner_output)
+        if not self.batch_queue:
+            if self.scheduler.has_kv_transfer_work():
+                # No schedulable tokens and nothing in flight, but async
+                # KV transfers still need the runner's get_finished poll
+                # (PP + connector is rejected by PPModelRunner.__init__
+                # today; this keeps the queue path honest when that gate
+                # lifts).
+                scheduler_output = self.scheduler.schedule()
+                runner_output = self.executor.execute_model(
+                    scheduler_output)
+                return self.scheduler.update_from_output(
+                    scheduler_output, runner_output)
+            return []
+        scheduler_output, handle = self.batch_queue.pop()
+        runner_output = self.executor.wait_model(handle)
+        self.scheduler.in_flight_req_ids.difference_update(
+            scheduler_output.num_scheduled_tokens)
         return self.scheduler.update_from_output(scheduler_output,
                                                  runner_output)
 
@@ -82,5 +232,49 @@ class EngineCore:
         stats.update(self.executor.get_stats())
         return stats
 
+    def save_sharded_state(self, path: str) -> None:
+        """Persist the (sharded, post-quantization) weights for fast
+        reload via load_format='sharded_state' (reference:
+        EngineCore.save_sharded_state, core.py:336)."""
+        self.executor.worker.model_runner.save_sharded_state(path)
+
+    def sleep(self, level: int = 1) -> int:
+        """Release device memory while idle (RLHF colocation;
+        reference: EngineCore.sleep -> CuMemAllocator discard/offload,
+        core.py:312-319 + cumem.py:106). Requires an idle engine —
+        in-flight KV would be lost."""
+        if self.scheduler.has_requests():
+            raise ValueError("cannot sleep with in-flight requests")
+        if self.config.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError("sleep/wake under pipeline parallelism "
+                             "needs per-stage restore; not wired yet")
+        freed = self.executor.worker.model_runner.sleep(level)
+        # The device pages are gone: cached prefix blocks must stop
+        # advertising their contents or post-wake requests would "hit"
+        # zeroed pages (reference: sleep implies reset_prefix_cache).
+        if not self.scheduler.kv_cache_manager.reset_prefix_cache():
+            logger.warning("prefix cache reset failed during sleep")
+        return freed
+
+    def wake_up(self) -> None:
+        self.executor.worker.model_runner.wake_up()
+
+    def profile(self, action: str = "start") -> str:
+        """Start/stop a device trace (reference: EngineCore.profile RPC,
+        core.py:297; TPU variant tpu_worker.py:246-256 — here
+        jax.profiler, viewable in TensorBoard/XProf)."""
+        import jax
+
+        from vllm_distributed_tpu import envs
+        trace_dir = envs.VDT_PROFILER_DIR
+        if action == "start":
+            jax.profiler.start_trace(trace_dir)
+            logger.info("profiling started -> %s", trace_dir)
+        else:
+            jax.profiler.stop_trace()
+            logger.info("profiling stopped -> %s", trace_dir)
+        return trace_dir
+
     def shutdown(self) -> None:
+        self.scheduler.shutdown()
         self.executor.shutdown()

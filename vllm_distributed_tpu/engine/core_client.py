@@ -55,6 +55,10 @@ class EngineCoreClient:
     def get_stats(self) -> dict:
         raise NotImplementedError
 
+    def call_utility(self, method: str, *args):
+        """Generic core RPC (sleep/wake_up/profile/...)."""
+        raise NotImplementedError
+
     def shutdown(self) -> None:
         pass
 
@@ -80,6 +84,9 @@ class InprocClient(EngineCoreClient):
 
     def get_stats(self) -> dict:
         return self.engine_core.get_stats()
+
+    def call_utility(self, method: str, *args):
+        return getattr(self.engine_core, method)(*args)
 
     def shutdown(self) -> None:
         self.engine_core.shutdown()

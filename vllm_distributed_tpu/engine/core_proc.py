@@ -68,6 +68,25 @@ def run_engine_core(config, input_addr: str, output_addr: str) -> None:
         ctx.term()
 
 
+def _try_add(core: EngineCore, req):
+    """Add a request; a rejectable failure (e.g. a grammar the
+    front-end validator missed) must not take the busy loop down — it
+    bounces back as an aborted output so the client unblocks. Returns
+    the synthetic output, or None on success."""
+    try:
+        core.add_request(req)
+        return None
+    except Exception as e:  # noqa: BLE001 - any admission failure is
+        # rejectable (grammar compile, tokenizer load, bad params);
+        # request state hasn't entered the scheduler yet, so bouncing is
+        # always safe and beats killing every in-flight request.
+        logger.warning("rejected request %s: %s", req.request_id, e)
+        from vllm_distributed_tpu.core.sched.scheduler import \
+            EngineCoreOutput
+        return EngineCoreOutput(req_id=req.request_id, new_token_ids=[],
+                                finish_reason="abort")
+
+
 class _Shutdown(Exception):
     pass
 
@@ -79,7 +98,13 @@ def _raise_shutdown() -> None:
 def _handle_msg(core: EngineCore, out: zmq.Socket, msg: dict) -> None:
     t = msg["t"]
     if t == "add":
-        core.add_request(serial.decode_request(msg["req"]))
+        req = serial.decode_request(msg["req"])
+        rejected = _try_add(core, req)
+        if rejected is not None:
+            out.send(serial.pack({
+                "t": "outputs",
+                "outs": [serial.encode_output(rejected)],
+            }))
     elif t == "abort":
         core.abort_requests(list(msg["ids"]))
     elif t == "call":
@@ -173,7 +198,9 @@ class BackgroundEngineCore:
                             block=block,
                             timeout=idle_timeout if block else 0)
                         if kind == "add":
-                            self.core.add_request(payload)
+                            rejected = _try_add(self.core, payload)
+                            if rejected is not None:
+                                self.output_queue.put([rejected])
                         elif kind == "abort":
                             self.core.abort_requests(payload)
                         elif kind == "shutdown":

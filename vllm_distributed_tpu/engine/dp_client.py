@@ -194,6 +194,15 @@ class DPEngineClient(EngineCoreClient):
     def has_unfinished_requests(self) -> bool:
         return any(self._live)
 
+    def call_utility(self, method: str, *args):
+        """Blocking fan-out RPC (sleep/wake_up/profile/...): every
+        replica runs it; dict results aggregate, others come back as a
+        per-replica list."""
+        values = [c.call_utility(method, *args) for c in self.clients]
+        if all(isinstance(v, dict) for v in values):
+            return self._aggregate_stats(values)
+        return values
+
     def request_counts(self) -> list[int]:
         """Per-replica live request counts (the coordinator's published
         load snapshot; exposed for /metrics and tests)."""

@@ -60,11 +60,13 @@ class LLMEngine:
         sampling_params: Optional[SamplingParams] = None,
         priority: int = 0,
         kv_transfer_params: Optional[dict] = None,
+        lora_request: Optional[dict] = None,
     ) -> None:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
-            kv_transfer_params=kv_transfer_params)
+            kv_transfer_params=kv_transfer_params,
+            lora_request=lora_request)
         self.output_processor.add_request(
             core_req, prompt=prompt if isinstance(prompt, str) else None)
         self.engine_core.add_request(core_req)
@@ -86,6 +88,14 @@ class LLMEngine:
 
     def get_stats(self) -> dict:
         return self.engine_core.get_stats()
+
+    def sleep(self, level: int = 1) -> int:
+        """Release device memory while idle (RLHF colocation; see
+        EngineCore.sleep). Returns approximate bytes released."""
+        return self.engine_core.call_utility("sleep", level)
+
+    def wake_up(self) -> None:
+        self.engine_core.call_utility("wake_up")
 
     def shutdown(self) -> None:
         self.engine_core.shutdown()

@@ -11,6 +11,7 @@ from typing import Optional
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
 from vllm_distributed_tpu.engine.detokenizer import IncrementalDetokenizer
+from vllm_distributed_tpu.metrics.stats import RequestTimes
 from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
 from vllm_distributed_tpu.request import EngineCoreRequest
 from vllm_distributed_tpu.sampling_params import SamplingParams
@@ -30,6 +31,7 @@ class RequestState:
     finish_reason: Optional[str] = None
     stop_reason: Optional[int | str] = None
     kv_transfer_params: Optional[dict] = None
+    times: Optional["RequestTimes"] = None
 
 
 @dataclass
@@ -46,6 +48,11 @@ class OutputProcessor:
         self.config = config
         self.tokenizer = tokenizer
         self.request_states: dict[str, RequestState] = {}
+        # Front-end latency/throughput stats (reference:
+        # v1/metrics/stats.py IterationStats maintained in the output
+        # path); rendered into /metrics beside the core's stats.
+        from vllm_distributed_tpu.metrics.stats import FrontendStats
+        self.stats = FrontendStats()
 
     def add_request(self, request: EngineCoreRequest,
                     prompt: Optional[str] = None) -> None:
@@ -54,12 +61,14 @@ class OutputProcessor:
         if self.tokenizer is not None and params.detokenize:
             detok = IncrementalDetokenizer(self.tokenizer, params,
                                            request.prompt_token_ids)
+        import time as _time
         self.request_states[request.request_id] = RequestState(
             request_id=request.request_id,
             prompt=prompt,
             prompt_token_ids=request.prompt_token_ids,
             params=params,
             detokenizer=detok,
+            times=RequestTimes(arrival=_time.monotonic()),
         )
 
     def abort_requests(self, request_ids: list[str]) -> None:
@@ -82,6 +91,8 @@ class OutputProcessor:
             if state is None:
                 continue  # aborted while output was in flight
             state.output_token_ids.extend(out.new_token_ids)
+            if out.new_token_ids:
+                self.stats.on_tokens(state.times, len(out.new_token_ids))
             if out.logprobs:
                 state.logprobs.extend(out.logprobs)
             state.num_cached_tokens = out.num_cached_tokens
@@ -104,9 +115,12 @@ class OutputProcessor:
             state.stop_reason = stop_reason
             if out.kv_transfer_params is not None:
                 state.kv_transfer_params = out.kv_transfer_params
-            if finished and state.detokenizer is not None:
-                # Emit any text held back waiting for more context.
-                state.detokenizer.flush()
+            if finished:
+                self.stats.on_finished(state.times,
+                                       len(state.prompt_token_ids))
+                if state.detokenizer is not None:
+                    # Emit any text held back waiting for more context.
+                    state.detokenizer.flush()
 
             request_outputs.append(self._make_request_output(state))
             if finished:

@@ -4,12 +4,45 @@ Reference: vllm/v1/engine/processor.py (tokenization, validation; runs in
 the client process, never on the device path).
 """
 
+import json as json_module
 import time
 from typing import Optional, Union
+
+from functools import lru_cache
 
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.request import EngineCoreRequest
 from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@lru_cache(maxsize=64)
+def _validate_lora_path(path: str, max_rank: int) -> None:
+    """Admission-time adapter check (cached): a bad path or oversized
+    rank must 400 at the front-end, never surface inside the engine
+    core's step path."""
+    import json
+    import os
+    cfg_file = os.path.join(path, "adapter_config.json")
+    if not os.path.isfile(cfg_file):
+        raise ValueError(f"no adapter_config.json under {path!r}")
+    with open(cfg_file) as f:
+        rank = int(json.load(f)["r"])
+    if rank > max_rank:
+        raise ValueError(
+            f"adapter rank {rank} exceeds max_lora_rank {max_rank}")
+    if not any(os.path.exists(os.path.join(path, fname))
+               for fname in ("adapter_model.safetensors",
+                             "adapter_model.bin")):
+        raise ValueError(f"no adapter weights under {path!r}")
+
+
+@lru_cache(maxsize=256)
+def _validate_grammar(pattern: str) -> None:
+    """Admission-time grammar check, cached by pattern so repeated
+    requests with the same schema don't recompile the DFA the core's
+    manager also caches."""
+    from vllm_distributed_tpu.structured_output.fsm import compile_regex
+    compile_regex(pattern)
 
 
 class Processor:
@@ -37,6 +70,7 @@ class Processor:
         arrival_time: Optional[float] = None,
         priority: int = 0,
         kv_transfer_params: Optional[dict] = None,
+        lora_request: Optional[dict] = None,
     ) -> EngineCoreRequest:
         if isinstance(prompt, str):
             assert self.tokenizer is not None, \
@@ -46,6 +80,28 @@ class Processor:
             prompt_token_ids = list(prompt)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if lora_request is not None:
+            if not self.config.lora_config.enable_lora:
+                raise ValueError(
+                    "request selects a LoRA adapter but the engine was "
+                    "started without enable_lora")
+            try:
+                _validate_lora_path(
+                    str(lora_request["path"]),
+                    self.config.lora_config.max_lora_rank)
+            except (KeyError, OSError, TypeError,
+                    json_module.JSONDecodeError) as e:
+                raise ValueError(f"invalid lora_request: {e}") from e
+        if sampling_params.structured is not None:
+            # Reject uncompilable grammars at admission (client-side
+            # error) instead of inside the engine core's busy loop.
+            from vllm_distributed_tpu.structured_output.manager import \
+                spec_to_regex
+            try:
+                _validate_grammar(spec_to_regex(
+                    sampling_params.structured))
+            except ValueError as e:
+                raise ValueError(f"invalid structured spec: {e}") from e
         max_len = self.config.scheduler_config.max_model_len
         if len(prompt_token_ids) >= max_len:
             raise ValueError(
@@ -59,4 +115,5 @@ class Processor:
             arrival_time=arrival_time or time.time(),
             priority=priority,
             kv_transfer_params=kv_transfer_params,
+            lora_request=lora_request,
         )

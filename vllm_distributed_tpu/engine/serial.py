@@ -38,6 +38,7 @@ def encode_request(req: EngineCoreRequest) -> dict:
         "arrival_time": req.arrival_time,
         "priority": req.priority,
         "kv_transfer_params": req.kv_transfer_params,
+        "lora_request": req.lora_request,
     }
 
 
@@ -50,6 +51,7 @@ def decode_request(d: dict) -> EngineCoreRequest:
         arrival_time=d["arrival_time"],
         priority=d["priority"],
         kv_transfer_params=d["kv_transfer_params"],
+        lora_request=d.get("lora_request"),
     )
 
 

@@ -16,6 +16,10 @@ def _add_serve(sub) -> None:
                    help="model name or path (positional, like vllm serve)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--lora-modules", nargs="*", default=[],
+                   metavar="NAME=PATH",
+                   help="served LoRA adapters; request them via the "
+                        "'model' field (requires --enable-lora)")
     EngineArgs.add_cli_args(p)
 
 
@@ -34,8 +38,18 @@ def cmd_serve(args) -> None:
         run_server
     if args.model_pos:
         args.model = args.model_pos
+    lora_modules = {}
+    for item in args.lora_modules:
+        name, _, path = item.partition("=")
+        if not path:
+            raise SystemExit(
+                f"--lora-modules entries are NAME=PATH, got {item!r}")
+        lora_modules[name] = path
+    if lora_modules and not args.enable_lora:
+        raise SystemExit("--lora-modules requires --enable-lora")
     engine_args = EngineArgs.from_cli_args(args)
-    run_server(engine_args, host=args.host, port=args.port)
+    run_server(engine_args, host=args.host, port=args.port,
+               lora_modules=lora_modules or None)
 
 
 def cmd_bench(args) -> None:

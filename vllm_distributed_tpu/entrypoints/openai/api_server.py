@@ -25,6 +25,9 @@ logger = init_logger(__name__)
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
+# Served LoRA adapters: name -> checkpoint path (reference: the
+# --lora-modules serve flag; requests select one via the "model" field).
+LORA_MODULES_KEY = web.AppKey("lora_modules", dict)
 
 
 def _error_response(e: Exception) -> web.Response:
@@ -68,7 +71,10 @@ async def health(request: web.Request) -> web.Response:
 async def list_models(request: web.Request) -> web.Response:
     return web.json_response({
         "object": "list",
-        "data": [protocol.model_card(request.app[MODEL_KEY])],
+        "data": [protocol.model_card(request.app[MODEL_KEY])] + [
+            protocol.model_card(name)
+            for name in request.app[LORA_MODULES_KEY]
+        ],
     })
 
 
@@ -81,8 +87,31 @@ async def metrics(request: web.Request) -> web.Response:
         stats = await engine.get_stats()
     except Exception:  # noqa: BLE001 - engine busy/dead
         stats = {}
-    return web.Response(text=render_metrics(stats),
-                        content_type="text/plain")
+    text = render_metrics(stats)
+    # Front-end latency histograms (TTFT / ITL / e2e; reference:
+    # v1/metrics/loggers.py:143 PrometheusStatLogger families).
+    processor = getattr(engine, "output_processor", None)
+    if processor is not None:
+        text += processor.stats.render()
+    return web.Response(text=text, content_type="text/plain")
+
+
+def _profile_dirs(result) -> list[str]:
+    # DP fan-out returns one dir per replica; uniproc returns a string.
+    return result if isinstance(result, list) else [result]
+
+
+async def start_profile(request: web.Request) -> web.Response:
+    """Begin a device trace (reference: api_server /start_profile)."""
+    dirs = _profile_dirs(await request.app[ENGINE_KEY].profile("start"))
+    return web.json_response({"status": "profiling", "dir": dirs[0],
+                              "dirs": dirs})
+
+
+async def stop_profile(request: web.Request) -> web.Response:
+    dirs = _profile_dirs(await request.app[ENGINE_KEY].profile("stop"))
+    return web.json_response({"status": "stopped", "dir": dirs[0],
+                              "dirs": dirs})
 
 
 # ---------------------------------------------------------------------------
@@ -120,12 +149,14 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
         # Fan out: one engine request per (prompt, sample) pair; choice
         # index follows OpenAI semantics (prompt-major, then n).
+        lora = _resolve_lora(request.app, body)
         gens = []
         for pi, prompt in enumerate(prompts):
             for s in range(n):
                 idx = pi * n + s
                 gens.append((idx, engine.generate(
-                    prompt, params, request_id=f"{cid}-{idx}")))
+                    prompt, params, request_id=f"{cid}-{idx}",
+                    lora_request=lora)))
 
         if stream:
             return await _stream_completions(request, cid, created, model,
@@ -254,8 +285,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         stream = bool(body.get("stream", False))
         cid = protocol.chat_id()
         created = int(time.time())
+        lora = _resolve_lora(request.app, body)
         gens = [(i, engine.generate(prompt, params,
-                                    request_id=f"{cid}-{i}"))
+                                    request_id=f"{cid}-{i}",
+                                    lora_request=lora))
                 for i in range(n)]
         if stream:
             return await _stream_chat(request, cid, created, model, gens)
@@ -330,24 +363,39 @@ async def _stream_chat(request, cid, created, model,
 
 
 # ---------------------------------------------------------------------------
-def build_app(engine: AsyncLLM, model_name: str) -> web.Application:
+def _resolve_lora(app: web.Application, body: dict) -> Optional[dict]:
+    """A request whose ``model`` names a served adapter gets that
+    adapter (reference: lora-modules model aliasing)."""
+    name = body.get("model")
+    path = app[LORA_MODULES_KEY].get(name)
+    if path is None:
+        return None
+    return {"name": name, "path": path}
+
+
+def build_app(engine: AsyncLLM, model_name: str,
+              lora_modules: Optional[dict] = None) -> web.Application:
     app = web.Application(middlewares=[_auth_middleware_factory])
     app[ENGINE_KEY] = engine
     app[MODEL_KEY] = model_name
+    app[LORA_MODULES_KEY] = dict(lora_modules or {})
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/start_profile", start_profile)
+    app.router.add_post("/stop_profile", stop_profile)
     return app
 
 
 async def serve(engine: AsyncLLM, model_name: str, host: str,
                 port: int, ready_event=None,
-                stop_event: Optional[asyncio.Event] = None) -> None:
+                stop_event: Optional[asyncio.Event] = None,
+                lora_modules: Optional[dict] = None) -> None:
     """Run until stop_event (or forever); graceful engine shutdown on
     exit (reference: entrypoints/launcher.py serve_http)."""
-    app = build_app(engine, model_name)
+    app = build_app(engine, model_name, lora_modules)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
@@ -366,8 +414,9 @@ async def serve(engine: AsyncLLM, model_name: str, host: str,
         engine.shutdown()
 
 
-def run_server(engine_args, host: str = "0.0.0.0",
-               port: int = 8000) -> None:
+def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
+               lora_modules: Optional[dict] = None) -> None:
     """Blocking entry used by the CLI (reference: api_server.py:1672)."""
     engine = AsyncLLM.from_engine_args(engine_args)
-    asyncio.run(serve(engine, engine_args.model, host, port))
+    asyncio.run(serve(engine, engine_args.model, host, port,
+                      lora_modules=lora_modules))

@@ -79,10 +79,46 @@ def sampling_params_from_request(body: dict,
                 kwargs["logprobs"] = int(body.get("top_logprobs", 1) or 1)
         else:
             kwargs["logprobs"] = int(lp)
+    structured = _structured_from_request(body)
+    if structured is not None:
+        kwargs["structured"] = structured
     try:
         return SamplingParams(**kwargs)
     except ValueError as e:
         raise RequestError(str(e)) from e
+
+
+def _structured_from_request(body: dict) -> Optional[dict]:
+    """OpenAI structured-output surfaces -> SamplingParams.structured.
+
+    ``response_format``: {"type": "json_object"} or {"type":
+    "json_schema", "json_schema": {"schema": ...}} (reference:
+    protocol.py response_format handling); plus the guided_* extensions
+    (guided_regex / guided_choice / guided_json) the reference accepts
+    as extra body fields."""
+    if body.get("guided_regex") is not None:
+        return {"regex": str(body["guided_regex"])}
+    if body.get("guided_choice") is not None:
+        return {"choice": [str(c) for c in body["guided_choice"]]}
+    if body.get("guided_json") is not None:
+        return {"json": body["guided_json"]}
+    rf = body.get("response_format")
+    if not rf:
+        return None
+    if not isinstance(rf, dict) or "type" not in rf:
+        raise RequestError(f"invalid response_format: {rf!r}")
+    if rf["type"] == "text":
+        return None
+    if rf["type"] == "json_object":
+        return {"json_object": True}
+    if rf["type"] == "json_schema":
+        js = rf.get("json_schema") or {}
+        schema = js.get("schema") if isinstance(js, dict) else None
+        if schema is None:
+            raise RequestError(
+                "response_format.json_schema.schema is required")
+        return {"json": schema}
+    raise RequestError(f"unsupported response_format type {rf['type']!r}")
 
 
 def completion_id() -> str:

@@ -21,12 +21,24 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (debugging / CPU execution).
     "VDT_ATTENTION_BACKEND":
     lambda: os.getenv("VDT_ATTENTION_BACKEND", "auto"),  # auto|pallas|xla
+    # MoE compute path: grouped ragged_dot dispatch (default) or the
+    # all-expert einsum baseline (A/B + FLOP regression tests).
+    "VDT_MOE_BACKEND":
+    lambda: os.getenv("VDT_MOE_BACKEND", "ragged"),  # ragged|dense
     # JAX platform to pin before backend init ("auto" = JAX default).
     # Setting "cpu" defeats a TPU plugin whose init can hang for minutes
     # on hosts where the chip is tunnelled (reference analogue: the
     # platforms/ device plumbing; see worker.init_device).
+    # Platform pin applied via jax.config BEFORE backend init. Falls
+    # back to a single-platform JAX_PLATFORMS value so SPAWNED engine
+    # cores inherit the parent's pin through the environment: some
+    # installed accelerator plugins ignore the JAX_PLATFORMS env var
+    # itself, and an un-pinned child would hang initializing a tunnelled
+    # TPU the parent deliberately avoided.
     "VDT_PLATFORM":
-    lambda: os.getenv("VDT_PLATFORM", "auto"),  # auto|cpu|tpu|...
+    lambda: os.getenv(
+        "VDT_PLATFORM",
+        os.getenv("JAX_PLATFORMS", "auto").split(",")[0] or "auto"),
     # Seconds the bench harness waits for TPU backend init in its probe
     # subprocess before falling back to CPU. The tunnelled axon plugin can
     # take many minutes to become reachable, so the default is patient.
@@ -67,9 +79,10 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # Host IP override used for distributed bootstrap.
     "VDT_HOST_IP":
     lambda: os.getenv("VDT_HOST_IP", os.getenv("VLLM_HOST_IP", "")),
-    # Enable torch/XLA profiler dir ("" disables).
+    # jax.profiler trace output directory for the profile RPC
+    # (reference: VLLM_TORCH_PROFILER_DIR).
     "VDT_PROFILER_DIR":
-    lambda: os.getenv("VDT_PROFILER_DIR", ""),
+    lambda: os.getenv("VDT_PROFILER_DIR", "/tmp/vdt_profile"),
     # Disable the usage-stats style telemetry (always disabled by default;
     # kept for CLI parity).
     "VDT_NO_USAGE_STATS":

@@ -33,6 +33,15 @@ class Executor:
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         raise NotImplementedError
 
+    def execute_model_async(self, scheduler_output: SchedulerOutput):
+        """Dispatch a step without blocking; returns a handle for
+        wait_model(). Used by the engine core's pipeline-parallel batch
+        queue to keep several microbatches in flight."""
+        raise NotImplementedError
+
+    def wait_model(self, handle) -> ModelRunnerOutput:
+        raise NotImplementedError
+
     def get_stats(self) -> dict:
         return {}
 
@@ -59,6 +68,12 @@ class UniProcExecutor(Executor):
     def execute_model(self,
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         return self.worker.execute_model(scheduler_output)
+
+    def execute_model_async(self, scheduler_output: SchedulerOutput):
+        return self.worker.dispatch_model(scheduler_output)
+
+    def wait_model(self, handle) -> ModelRunnerOutput:
+        return self.worker.wait_model(handle)
 
     def get_stats(self) -> dict:
         return self.worker.get_stats()

@@ -1,0 +1,144 @@
+"""Front-end request latency stats: TTFT / ITL / e2e histograms.
+
+Reference: vllm/v1/metrics/stats.py (IterationStats computing TTFT and
+inter-token latency from arrival/first-token timestamps) + loggers.py:50
+(LoggingStatLogger's periodic throughput lines) and :143
+(PrometheusStatLogger histogram families). Rendered without the
+prometheus_client registry for the same reason as metrics/prometheus.py:
+the global registry complicates multi-engine tests.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Bucket boundaries (seconds) mirroring the reference's latency families.
+TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25,
+                0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0, 80.0)
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1,
+               0.25, 0.5, 1.0, 2.5, 5.0)
+E2E_BUCKETS = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 30.0,
+               40.0, 50.0, 60.0, 120.0, 240.0, 480.0, 960.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram in Prometheus exposition shape."""
+
+    def __init__(self, buckets: tuple[float, ...]) -> None:
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, help_text: str) -> list[str]:
+        lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
+        cumulative = 0
+        for b, c in zip(self.buckets, self.counts):
+            cumulative += c
+            lines.append(f'{name}_bucket{{le="{b}"}} {cumulative}')
+        cumulative += self.counts[-1]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{name}_sum {self.total}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+
+@dataclass
+class RequestTimes:
+    """Per-request timestamps the output processor maintains."""
+
+    arrival: float
+    first_token: Optional[float] = None
+    last_token: Optional[float] = None
+
+
+@dataclass
+class FrontendStats:
+    """Latency histograms + throughput counters, updated by the output
+    processor as tokens stream out, rendered into /metrics."""
+
+    ttft: Histogram = field(default_factory=lambda: Histogram(TTFT_BUCKETS))
+    itl: Histogram = field(default_factory=lambda: Histogram(ITL_BUCKETS))
+    e2e: Histogram = field(default_factory=lambda: Histogram(E2E_BUCKETS))
+    num_prompt_tokens: int = 0
+    num_generation_tokens: int = 0
+    num_finished: int = 0
+    # Periodic logging window (LoggingStatLogger equivalent).
+    _window_start: float = field(default_factory=time.monotonic)
+    _window_gen_tokens: int = 0
+    log_interval_s: float = 10.0
+
+    def on_tokens(self, times: RequestTimes, num_new: int,
+                  now: Optional[float] = None) -> None:
+        if num_new <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        if times.first_token is None:
+            times.first_token = now
+            self.ttft.observe(now - times.arrival)
+            extra = num_new - 1
+        else:
+            extra = num_new
+        if extra > 0 and times.last_token is not None:
+            per_token = (now - times.last_token) / extra
+            for _ in range(extra):
+                self.itl.observe(per_token)
+        times.last_token = now
+        self.num_generation_tokens += num_new
+        self._window_gen_tokens += num_new
+        self._maybe_log(now)
+
+    def on_finished(self, times: RequestTimes, num_prompt_tokens: int,
+                    now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.e2e.observe(now - times.arrival)
+        self.num_prompt_tokens += num_prompt_tokens
+        self.num_finished += 1
+
+    def _maybe_log(self, now: float) -> None:
+        dt = now - self._window_start
+        if dt < self.log_interval_s:
+            return
+        logger.info("engine throughput: %.1f tok/s generation, "
+                    "%d finished requests total",
+                    self._window_gen_tokens / dt, self.num_finished)
+        self._window_start = now
+        self._window_gen_tokens = 0
+
+    # ------------------------------------------------------------------
+    def render(self) -> str:
+        lines = self.ttft.render(
+            "vdt:time_to_first_token_seconds",
+            "Time from request arrival to first output token")
+        lines += self.itl.render(
+            "vdt:inter_token_latency_seconds",
+            "Latency between consecutive output tokens")
+        lines += self.e2e.render(
+            "vdt:e2e_request_latency_seconds",
+            "Request arrival to completion latency")
+        for name, help_text, value in (
+            ("vdt:prompt_tokens_total",
+             "Cumulative prompt tokens of finished requests",
+             self.num_prompt_tokens),
+            ("vdt:generation_tokens_total",
+             "Cumulative generated output tokens",
+             self.num_generation_tokens),
+            ("vdt:request_success_total",
+             "Cumulative finished requests", self.num_finished),
+        ):
+            lines += [f"# HELP {name} {help_text}",
+                      f"# TYPE {name} counter", f"{name} {value}"]
+        return "\n".join(lines) + "\n"

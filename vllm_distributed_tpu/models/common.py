@@ -83,9 +83,36 @@ class AttentionBatch:
     # Per-rank stacked metadata when token parallelism is on (see
     # TknpAttentionBatch); None otherwise.
     tknp: Optional[TknpAttentionBatch] = None
+    # Multi-LoRA token routing (None when LoRA is disabled): tokens
+    # sorted by adapter slot, consumed by the grouped-GEMM LoRA apply
+    # (models/lora.py; the TPU answer to the reference's punica SGMV).
+    lora: Optional["LoraBatch"] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
     # changing it recompiles, like every other shape bucket.
     max_q: int = 1
+
+
+@dataclasses.dataclass
+class LoraBatch:
+    """Token->adapter-slot grouping, built once per step and shared by
+    every LoRA-wrapped matmul in the forward."""
+
+    # [T] int32 permutation sorting tokens by adapter slot.
+    order: jax.Array
+    # [T] int32 inverse permutation (back to batch order).
+    inv: jax.Array
+    # [S] int32 tokens per slot in sorted order (S = max_loras + 1).
+    group_sizes: jax.Array
+    # [T] float32 per-token adapter scaling (alpha/r; 0 for slot 0), in
+    # SORTED order.
+    scaling: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    LoraBatch,
+    data_fields=[f.name for f in dataclasses.fields(LoraBatch)],
+    meta_fields=[],
+)
 
 
 jax.tree_util.register_dataclass(

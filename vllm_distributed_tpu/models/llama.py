@@ -67,10 +67,18 @@ class LlamaArchConfig:
     # Mixture-of-experts (Mixtral-style); 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Physical expert slots for EPLB (reference: distributed/eplb/):
+    # 0 means = num_experts (no redundancy). Extra slots host replicas
+    # of hot experts; the router maps logical -> physical through a
+    # param-tree buffer so rebalances never recompile.
+    num_physical_experts: int = 0
     # Shard experts over the "model" mesh axis (EP spans the TP group,
     # reference: parallel_state.py:1189-1204) instead of TP inside each
     # expert's FFN.
     expert_parallel: bool = False
+    # Rank count of the expert-sharding axis (loader sets = tp under
+    # expert_parallel); EPLB packs replicas rank-aware with it.
+    expert_parallel_ranks: int = 1
     # KV-head replication factor for tp > num_kv_heads (reference:
     # QKVParallelLinear kv-head replication in
     # vllm/model_executor/layers/linear.py — each rank holds one whole
@@ -79,6 +87,11 @@ class LlamaArchConfig:
     # dimension divides the model mesh axis; repeat-per-head preserves
     # GQA grouping exactly.
     num_kv_head_replicas: int = 1
+    # Weight quantization scheme (None | "int8"); see quantize_params.
+    quantization: Optional[str] = None
+    # Multi-LoRA slots (0 disables; see models/lora.py).
+    max_loras: int = 0
+    max_lora_rank: int = 16
     dtype: Any = jnp.bfloat16
 
     @property
@@ -113,8 +126,48 @@ class LlamaArchConfig:
 class LlamaForCausalLM:
     """Stateless model: holds config + param specs; params live outside."""
 
+    # Matrix weights eligible for int8 quantize-on-load (reference:
+    # quantization/tpu_int8.py quantizes the linear layers; embed stays
+    # fp for the gather, lm_head for logit fidelity).
+    QUANT_TARGETS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+    # Matrices that accept LoRA adapters (reference: lora/layers.py
+    # wrapping every parallel linear; MoE models restrict to attention).
+    LORA_TARGETS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+
     def __init__(self, cfg: LlamaArchConfig) -> None:
         self.cfg = cfg
+
+    # ------------------------------------------------------------------
+    # Quantization (w8a16)
+    # ------------------------------------------------------------------
+    def quantize_params(self, params: dict) -> dict:
+        """Symmetric per-output-channel int8 for the listed layer
+        matrices: w ~= q * scale with scale = absmax/127 reduced over
+        the input (second-to-last) axis. Halves weight HBM; the matmuls
+        dequantize at read (w8a16 — XLA fuses convert*scale into the
+        dot's operand load)."""
+        if self.cfg.quantization != "int8":
+            return params
+        layers = params["layers"]
+        for name in self.QUANT_TARGETS:
+            w = layers.get(name)
+            if w is None:
+                continue
+            w32 = np.asarray(w, np.float32)
+            scale = np.max(np.abs(w32), axis=-2, keepdims=True) / 127.0
+            scale = np.maximum(scale, 1e-8)
+            q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+            layers[name] = jnp.asarray(q)
+            layers[name + "_scale"] = jnp.asarray(scale, jnp.float32)
+        return params
+
+    def _w(self, lp: dict, name: str) -> jax.Array:
+        """Dequantizing weight accessor: identity for fp weights."""
+        w = lp[name]
+        if w.dtype == jnp.int8:
+            return (w.astype(self.cfg.dtype) *
+                    lp[name + "_scale"].astype(self.cfg.dtype))
+        return w
 
     # ------------------------------------------------------------------
     # Parameter tree
@@ -143,12 +196,60 @@ class LlamaForCausalLM:
                 "bk": P(None, MODEL_AXIS),
                 "bv": P(None, MODEL_AXIS),
             })
+        self._add_scale_specs(layer)
+        self._add_lora_specs(layer)
         return {
             "embed": P(None, None),
             "layers": layer,
             "final_ln": P(None),
             "lm_head": P(None, MODEL_AXIS),
         }
+
+    def _add_lora_specs(self, layer: dict) -> None:
+        """Adapter-buffer shardings: B follows the base weight's output
+        sharding, A its input sharding; rank never shards."""
+        if self.cfg.max_loras == 0:
+            return
+        for name in self.LORA_TARGETS:
+            wspec = layer.get(name)
+            if wspec is None:
+                continue
+            entries = list(wspec)  # [L, in, out]
+            layer[name + "_a"] = P(None, None, entries[1], None)
+            layer[name + "_b"] = P(None, None, None, entries[2])
+
+    def _install_lora_buffers(self, layers: dict) -> None:
+        if self.cfg.max_loras == 0:
+            return
+        from vllm_distributed_tpu.models.lora import init_lora_buffers
+        targets = [t for t in self.LORA_TARGETS if t in layers]
+        layers.update(init_lora_buffers(self.cfg, targets))
+
+    def _lora_delta(self, lp: dict, name: str, x, ctx):
+        """Adapter contribution for one matmul; zero-cost branch when
+        LoRA is disabled (static)."""
+        if ctx is None or (name + "_a") not in lp:
+            return 0
+        from vllm_distributed_tpu.models.lora import lora_apply
+        return lora_apply(x, lp[name + "_a"], lp[name + "_b"], ctx)
+
+    def _add_scale_specs(self, layer: dict) -> None:
+        """Per-channel scale specs mirror their weight's spec with the
+        reduced (input) axis unsharded — scales broadcast over it. All
+        weight specs here are written at full rank, so the scale keeps
+        the spec with only the second-to-last entry cleared."""
+        for name in list(layer):
+            if name.endswith("_scale"):
+                del layer[name]
+        if self.cfg.quantization != "int8":
+            return
+        for name in self.QUANT_TARGETS:
+            spec = layer.get(name)
+            if spec is None:
+                continue
+            entries = list(spec)
+            entries[-2] = None
+            layer[name + "_scale"] = P(*entries)
 
     def kv_cache_specs(self) -> dict:
         # [L, pages, kv_heads, page_size, head_dim]: pages shard on the
@@ -190,6 +291,7 @@ class LlamaForCausalLM:
                 "bv": jnp.zeros((L, Dkv), c.dtype),
             })
         self._maybe_replicate_kv(layers)
+        self._install_lora_buffers(layers)
         embed = norm(next(keys), (c.vocab_size, H))
         return {
             "embed": embed,
@@ -277,6 +379,7 @@ class LlamaForCausalLM:
             lm_head = embed.T
         else:
             lm_head = jnp.asarray(t("lm_head.weight").T, dtype=c.dtype)
+        self._install_lora_buffers(layers)
         return {
             "embed": embed,
             "layers": layers,
@@ -287,10 +390,20 @@ class LlamaForCausalLM:
     # ------------------------------------------------------------------
     # Forward
     # ------------------------------------------------------------------
-    def mlp_block(self, lp: dict, x: jax.Array) -> jax.Array:
+    def mlp_block(self, lp: dict, x: jax.Array,
+                  lora_ctx=None) -> jax.Array:
         """Per-layer feed-forward; MoE models override this (the MLP is
         the only structural difference in the decoder block)."""
-        return swiglu(x, lp["gate"], lp["up"], lp["down"])
+        if lora_ctx is None or ("gate_a") not in lp:
+            return swiglu(x, self._w(lp, "gate"), self._w(lp, "up"),
+                          self._w(lp, "down"))
+        g = jax.nn.silu(x @ self._w(lp, "gate") +
+                        self._lora_delta(lp, "gate", x, lora_ctx))
+        u = (x @ self._w(lp, "up") +
+             self._lora_delta(lp, "up", x, lora_ctx))
+        gu = g * u
+        return (gu @ self._w(lp, "down") +
+                self._lora_delta(lp, "down", gu, lora_ctx))
 
     def embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
         """Token embedding (pipeline stage 0 front; reference: the
@@ -326,13 +439,18 @@ class LlamaForCausalLM:
         # HBM every step — the Pallas write kernel updates pages in place
         # via input/output aliasing instead (reference analogue:
         # v1/attention/backends/pallas.py:282 aliased kv_cache_update).
+        lora_ctx = batch.lora
+
         def layer_fn(carry, xs):
             h, k_all, v_all = carry
             lp, layer_idx = xs
             x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
-            q = x @ lp["wq"]
-            k = x @ lp["wk"]
-            v = x @ lp["wv"]
+            q = x @ self._w(lp, "wq") + self._lora_delta(lp, "wq", x,
+                                                         lora_ctx)
+            k = x @ self._w(lp, "wk") + self._lora_delta(lp, "wk", x,
+                                                         lora_ctx)
+            v = x @ self._w(lp, "wv") + self._lora_delta(lp, "wv", x,
+                                                         lora_ctx)
             if has_bias:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
@@ -349,9 +467,11 @@ class LlamaForCausalLM:
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,
                                    sm_scale=sm_scale, layer=layer_idx)
-            h = h + attn.reshape(T, -1) @ lp["wo"]
+            attn2d = attn.reshape(T, -1)
+            h = h + (attn2d @ self._w(lp, "wo") +
+                     self._lora_delta(lp, "wo", attn2d, lora_ctx))
             x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
-            h = h + self.mlp_block(lp, x2)
+            h = h + self.mlp_block(lp, x2, lora_ctx)
             return (h, k_all, v_all), None
 
         layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]

@@ -64,6 +64,24 @@ def get_model(config: EngineConfig, mesh,
     dtype = _dtype_from_str(config.model_config.dtype)
     arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
+    arch.quantization = config.model_config.quantization
+    if arch.num_experts and config.parallel_config.num_redundant_experts:
+        arch.num_physical_experts = (
+            arch.num_experts +
+            config.parallel_config.num_redundant_experts)
+    if arch.num_experts and arch.expert_parallel:
+        ep = config.parallel_config.tensor_parallel_size
+        arch.expert_parallel_ranks = ep
+        physical = arch.num_physical_experts or arch.num_experts
+        if physical % ep != 0:
+            raise ValueError(
+                f"expert parallelism needs the physical expert count "
+                f"({physical} = {arch.num_experts} experts + "
+                f"{physical - arch.num_experts} redundant) divisible by "
+                f"tensor_parallel_size={ep}")
+    if config.lora_config.enable_lora:
+        arch.max_loras = config.lora_config.max_loras
+        arch.max_lora_rank = config.lora_config.max_lora_rank
     # KV-head replication when TP exceeds the checkpoint's KV-head count
     # (reference: QKVParallelLinear kv replication, layers/linear.py):
     # repeat heads to the lcm so the kv-head dim divides the model axis.
@@ -79,8 +97,19 @@ def get_model(config: EngineConfig, mesh,
 
     load_format = config.load_config.load_format
     model_path = config.model_config.model
-    if load_format == "dummy" or (load_format == "auto"
-                                  and not os.path.isdir(model_path)):
+    if load_format == "sharded_state":
+        # Orbax tree written by save_sharded_state: already transposed,
+        # stacked, replicated and quantized — restore host-side and let
+        # the placement pass below shard it (reference:
+        # model_loader/sharded_state_loader.py skipping the per-tensor
+        # weight_loader work).
+        import orbax.checkpoint as ocp
+        ckpt_dir = config.load_config.sharded_state_path or model_path
+        params = ocp.StandardCheckpointer().restore(
+            os.path.abspath(ckpt_dir))
+        logger.info("restored sharded state from %s", ckpt_dir)
+    elif load_format == "dummy" or (load_format == "auto"
+                                    and not os.path.isdir(model_path)):
         if load_format != "dummy":
             logger.warning(
                 "%s is not a local directory; using dummy weights "
@@ -92,6 +121,12 @@ def get_model(config: EngineConfig, mesh,
         tensors = load_hf_state_dict(model_path)
         params = model.params_from_hf_state_dict(tensors)
         logger.info("loaded %d tensors from %s", len(tensors), model_path)
+
+    # Quantize-on-load (reference: tpu_int8.py process_weights_after_
+    # loading) before placement, so only int8 bytes hit device HBM.
+    # Sharded-state trees were saved post-quantization already.
+    if load_format != "sharded_state":
+        params = model.quantize_params(params)
 
     if not shard:
         return model, params

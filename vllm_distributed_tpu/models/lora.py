@@ -1,0 +1,206 @@
+"""Multi-LoRA: slot-stacked adapter buffers + grouped-GEMM apply.
+
+Reference: vllm/lora/ (~6.7k LoC — LoRA layer wrappers around every
+parallel linear, punica SGMV/BGMV Triton kernels in lora/ops/, worker
+adapter manager; the TPU punica wrapper is selected at
+platforms/tpu.py:79). TPU-native redesign:
+
+* ``max_loras`` adapter SLOTS of fixed ``max_lora_rank`` live in the
+  param tree as stacked buffers — A: [L, S, in, r], B: [L, S, r, out]
+  with slot 0 all-zero ("no adapter"). Loading an adapter WRITES a slot;
+  shapes never change, so nothing recompiles (the same discipline the
+  engine applies everywhere else).
+* Per-token adapter routing reuses the MoE machinery: tokens sort by
+  slot once per step and each LoRA-wrapped matmul adds two
+  ``jax.lax.ragged_dot`` grouped GEMMs (x @ A)[slot-grouped] @ B — the
+  XLA equivalent of punica's segmented SGMV.
+* PEFT checkpoints (adapter_config.json + adapter safetensors) load
+  directly; ranks below max_lora_rank zero-pad.
+"""
+
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.models.common import LoraBatch
+
+logger = init_logger(__name__)
+
+# Target matrices and their PEFT module names ((proj name, fused slice)).
+# Fused qkv/gate-up don't exist here — each projection is its own matmul,
+# so the mapping is 1:1.
+PEFT_TARGETS = {
+    "wq": "q_proj",
+    "wk": "k_proj",
+    "wv": "v_proj",
+    "wo": "o_proj",
+    "gate": "gate_proj",
+    "up": "up_proj",
+    "down": "down_proj",
+}
+
+
+def init_lora_buffers(cfg, targets) -> dict:
+    """Zero adapter buffers for the requested targets (host numpy; the
+    loader's placement pass moves them to device). Slot 0 stays zero
+    forever — requests without an adapter route there."""
+    L = cfg.num_layers
+    S = cfg.max_loras + 1
+    r = cfg.max_lora_rank
+    H = cfg.hidden_size
+    I = cfg.intermediate_size
+    Dq = cfg.num_q_heads * cfg.head_dim
+    Dkv = cfg.total_kv_heads * cfg.head_dim
+    dims = {
+        "wq": (H, Dq), "wk": (H, Dkv), "wv": (H, Dkv), "wo": (Dq, H),
+        "gate": (H, I), "up": (H, I), "down": (I, H),
+    }
+    out = {}
+    for name in targets:
+        if name not in dims:
+            continue
+        din, dout = dims[name]
+        out[name + "_a"] = np.zeros((L, S, din, r), np.dtype(cfg.dtype))
+        out[name + "_b"] = np.zeros((L, S, r, dout), np.dtype(cfg.dtype))
+    return out
+
+
+def lora_apply(x: jax.Array, a: jax.Array, b: jax.Array,
+               ctx: LoraBatch) -> jax.Array:
+    """delta = scaling * (x @ A[slot]) @ B[slot], token-grouped by slot.
+
+    ``a``/``b`` are one layer's stacks ([S, in, r], [S, r, out]); slot
+    0's zeros make un-adapted tokens free of numerical effect (they
+    still ride the grouped GEMM — static shapes beat a gather-free
+    special case)."""
+    xs = x[ctx.order]
+    t = jax.lax.ragged_dot(xs, a, ctx.group_sizes)
+    d = jax.lax.ragged_dot(t, b, ctx.group_sizes)
+    d = d * ctx.scaling[:, None].astype(d.dtype)
+    return d[ctx.inv]
+
+
+# ---------------------------------------------------------------------------
+# Worker-side adapter slot manager
+# ---------------------------------------------------------------------------
+
+
+class LoRASlotManager:
+    """Resolves adapter names to device slots, loading PEFT checkpoints
+    on first use (reference: lora/worker_manager.py LRUCacheWorkerLoRA
+    Manager). Slot weights are written with .at[].set — the buffers'
+    shapes (and thus every compiled graph) never change."""
+
+    def __init__(self, cfg, max_loras: int) -> None:
+        self.cfg = cfg
+        self.max_loras = max_loras
+        self.name_to_slot: dict[str, int] = {}
+        self.active_counts: dict[int, int] = {}
+        self.scaling: np.ndarray = np.zeros(max_loras + 1, np.float32)
+
+    # -- lifecycle -----------------------------------------------------
+    def acquire(self, name: str, path: str, runner) -> int:
+        slot = self.name_to_slot.get(name)
+        if slot is None:
+            slot = self._free_slot()
+            self._load_into_slot(slot, path, runner)
+            self.name_to_slot[name] = slot
+        self.active_counts[slot] = self.active_counts.get(slot, 0) + 1
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot in self.active_counts:
+            self.active_counts[slot] -= 1
+            if self.active_counts[slot] <= 0:
+                del self.active_counts[slot]
+                # Adapter stays resident (LRU-ish: evicted only when a
+                # new adapter needs the slot).
+
+    def _free_slot(self) -> int:
+        used = set(self.name_to_slot.values())
+        for slot in range(1, self.max_loras + 1):
+            if slot not in used:
+                return slot
+        # All slots named; evict an inactive one.
+        for name, slot in list(self.name_to_slot.items()):
+            if slot not in self.active_counts:
+                del self.name_to_slot[name]
+                logger.info("evicting LoRA %r from slot %d", name, slot)
+                return slot
+        raise ValueError(
+            f"all {self.max_loras} LoRA slots are serving active "
+            "requests; raise max_loras")
+
+    # -- loading -------------------------------------------------------
+    def _load_into_slot(self, slot: int, path: str, runner) -> None:
+        cfg_path = os.path.join(path, "adapter_config.json")
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        rank = int(acfg["r"])
+        alpha = float(acfg.get("lora_alpha", rank))
+        r_max = self.cfg.max_lora_rank
+        if rank > r_max:
+            raise ValueError(
+                f"adapter rank {rank} exceeds max_lora_rank {r_max}")
+        tensors = _load_adapter_tensors(path)
+        self.scaling[slot] = alpha / rank
+
+        L = self.cfg.num_layers
+        lora = runner.params["layers"]
+        for name, proj in PEFT_TARGETS.items():
+            a_key, b_key = name + "_a", name + "_b"
+            if a_key not in lora:
+                continue  # target not LoRA-enabled for this model
+            a_buf, b_buf = lora[a_key], lora[b_key]
+            a_np = np.zeros((L, ) + a_buf.shape[2:], np.float32)
+            b_np = np.zeros((L, ) + b_buf.shape[2:], np.float32)
+            found = False
+            for layer in range(L):
+                a_t = _find_tensor(tensors, layer, proj, "lora_A")
+                b_t = _find_tensor(tensors, layer, proj, "lora_B")
+                if a_t is None or b_t is None:
+                    continue
+                found = True
+                # PEFT stores A [r, in] and B [out, r]; ours are
+                # right-multiply transposed.
+                a_np[layer, :, :rank] = a_t.T
+                b_np[layer, :rank, :] = b_t.T
+            if found:
+                lora[a_key] = a_buf.at[:, slot].set(
+                    jnp.asarray(a_np, a_buf.dtype))
+                lora[b_key] = b_buf.at[:, slot].set(
+                    jnp.asarray(b_np, b_buf.dtype))
+            else:
+                # Target not in this adapter: zero the slot.
+                lora[a_key] = a_buf.at[:, slot].set(0.0)
+                lora[b_key] = b_buf.at[:, slot].set(0.0)
+        logger.info("loaded LoRA %s (rank %d, alpha %.1f) into slot %d",
+                    path, rank, alpha, slot)
+
+
+def _load_adapter_tensors(path: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+    for fname in ("adapter_model.safetensors", "adapter_model.bin"):
+        full = os.path.join(path, fname)
+        if os.path.exists(full):
+            if fname.endswith(".safetensors"):
+                return load_file(full)
+            import torch
+            return {k: v.float().numpy()
+                    for k, v in torch.load(full,
+                                           map_location="cpu").items()}
+    raise FileNotFoundError(f"no adapter weights under {path}")
+
+
+def _find_tensor(tensors: dict, layer: int, proj: str,
+                 kind: str) -> Optional[np.ndarray]:
+    for key, val in tensors.items():
+        if (f"layers.{layer}." in key and f"{proj}" in key
+                and kind in key and key.endswith("weight")):
+            return np.asarray(val, np.float32)
+    return None

@@ -27,6 +27,24 @@ from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
 
 class MixtralForCausalLM(LlamaForCausalLM):
 
+    QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    # LoRA on the attention projections only (per-expert adapters would
+    # need expert-grouped LoRA GEMMs; the reference likewise restricts
+    # MoE LoRA support).
+    LORA_TARGETS = ("wq", "wk", "wv", "wo")
+    _EXPERT_WEIGHTS = ("w_gate", "w_up", "w_down")
+
+    @property
+    def num_physical(self) -> int:
+        """Physical expert slots (>= logical; extra slots hold EPLB
+        replicas of hot experts, reference: distributed/eplb/)."""
+        return self.cfg.num_physical_experts or self.cfg.num_experts
+
+    @property
+    def _replica_cap(self) -> int:
+        # One expert could absorb every spare slot: static buffer bound.
+        return self.num_physical - self.cfg.num_experts + 1
+
     # ------------------------------------------------------------------
     def param_specs(self) -> dict:
         specs = super().param_specs()
@@ -34,6 +52,10 @@ class MixtralForCausalLM(LlamaForCausalLM):
         for k in ("gate", "up", "down"):
             layer.pop(k)
         layer["router"] = P(None, None, None)  # [L, H, E] replicated
+        if self.num_physical > self.cfg.num_experts:
+            # EPLB routing buffers: replicated (tiny int tables).
+            layer["expert_map"] = P(None, None, None)
+            layer["expert_replicas"] = P(None, None)
         if self.cfg.expert_parallel:
             # Experts sharded over the model axis: each rank holds
             # E/ep_size whole experts (reference: FusedMoE EP path).
@@ -46,6 +68,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 "w_up": P(None, None, None, MODEL_AXIS),
                 "w_down": P(None, None, MODEL_AXIS, None),
             })
+        self._add_scale_specs(layer)
         return specs
 
     def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
@@ -66,6 +89,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
         layers["w_gate"] = norm(next(keys), (L, E, H, I))
         layers["w_up"] = norm(next(keys), (L, E, H, I))
         layers["w_down"] = norm(next(keys), (L, E, I, H))
+        self._install_physical_experts(layers)
         return params
 
     def params_from_hf_state_dict(self, tensors: dict[str, np.ndarray],
@@ -110,37 +134,198 @@ class MixtralForCausalLM(LlamaForCausalLM):
             "model.layers.{}.block_sparse_moe.experts.{}.w3.weight")
         layers["w_down"] = stack_experts(
             "model.layers.{}.block_sparse_moe.experts.{}.w2.weight")
+        self._install_physical_experts(layers)
         return params
 
     # ------------------------------------------------------------------
-    def mlp_block(self, lp: dict, x: jax.Array) -> jax.Array:
-        """Sparse-MoE FFN, computed exactly (every selected token):
+    # EPLB: physical expert slots + logical->physical routing buffers
+    # ------------------------------------------------------------------
+    def _install_physical_experts(self, layers: dict) -> None:
+        """Expand the logical [L, E, ...] expert stacks to the physical
+        slot count with an initial balanced placement, and install the
+        routing buffers the forward reads."""
+        if self.num_physical == self.cfg.num_experts:
+            return
+        from vllm_distributed_tpu.parallel.eplb import rebalance_experts
+        L, E = self.cfg.num_layers, self.cfg.num_experts
+        placement = rebalance_experts(np.ones((L, E)), self.num_physical,
+                                      self.cfg.expert_parallel_ranks)
+        self._scatter_placement(layers, placement)
+
+    def _scatter_placement(self, layers: dict, placement) -> None:
+        """Gather expert weights into physical-slot order and refresh
+        the routing buffers. The logical source for slot p is the
+        CURRENT first replica of placement's logical id — so this works
+        both at install time (logical order) and on a live rebalance."""
+        L, E = self.cfg.num_layers, self.cfg.num_experts
+        p2l = placement.phys_to_logical  # [L, P]
+        have_map = "expert_map" in layers
+
+        def logical_index(arr):
+            if not have_map:
+                return arr  # still in logical order
+            cur_first = np.asarray(layers["expert_map"])[:, :, 0]  # [L, E]
+            return np.stack([np.asarray(arr)[l][cur_first[l]]
+                             for l in range(L)])
+
+        for name in self._EXPERT_WEIGHTS:
+            for key in (name, name + "_scale"):
+                if key not in layers:
+                    continue
+                logical = logical_index(layers[key])
+                layers[key] = jnp.asarray(
+                    np.stack([logical[l][p2l[l]] for l in range(L)]))
+        r_cap = self._replica_cap
+        emap = np.zeros((L, E, r_cap), np.int32)
+        for l in range(L):
+            for e in range(E):
+                ids = placement.logical_to_phys[l, e]
+                ids = ids[ids >= 0]
+                emap[l, e, :len(ids)] = ids
+                emap[l, e, len(ids):] = ids[0]  # safe padding
+        layers["expert_map"] = jnp.asarray(emap)
+        layers["expert_replicas"] = jnp.asarray(
+            placement.logical_replicas.astype(np.int32))
+
+    def apply_rebalance(self, params: dict, placement) -> dict:
+        """Live EPLB step: move expert weights to the new placement and
+        swap the routing buffers (reference: rebalance_execute.py, done
+        here as host gathers + re-placement; the runner re-places the
+        returned tree with its param shardings)."""
+        self._scatter_placement(params["layers"], placement)
+        return params
+
+    # ------------------------------------------------------------------
+    def mlp_block(self, lp: dict, x: jax.Array,
+                  lora_ctx=None) -> jax.Array:
+        """Sparse-MoE FFN via grouped (ragged) matmuls, computed exactly
+        for every selected token:
 
         router softmax -> top-k -> renormalize (HF Mixtral semantics,
-        reference models/mixtral.py MixtralMoE.forward), then a dense
-        gate matrix [T, E] weights batched all-expert FFN outputs. Cost
-        is E/k times the active FLOPs — the all-to-all dispatch kernel
-        (fused_moe) replaces this when token counts grow; the einsum
-        form is the compiler-friendly baseline and the combine
-        contraction IS the EP psum under GSPMD."""
+        reference models/mixtral.py MixtralMoE.forward), then the TPU
+        dispatch: flatten the T*k (token, expert) assignments, sort by
+        expert, run ``jax.lax.ragged_dot`` against the expert-stacked
+        weights (the XLA grouped-GEMM that replaces the reference's
+        fused_moe CUDA kernels / moe_pallas.py seed), and segment-sum
+        the weighted rows back. Cost is k/E of the dense all-expert
+        form — only selected (token, expert) pairs hit the MXU.
+
+        VDT_MOE_BACKEND=dense restores the all-expert einsum baseline
+        (also used by the FLOP-reduction regression test)."""
+        from vllm_distributed_tpu import envs
         c = self.cfg
         T = x.shape[0]
         k = c.num_experts_per_tok
+        E = c.num_experts
         # Router in fp32 for parity with the HF reference.
         logits = (x.astype(jnp.float32)
                   @ lp["router"].astype(jnp.float32))  # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
         top_vals, top_idx = jax.lax.top_k(probs, k)
         top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
-        rows = jnp.broadcast_to(
-            jnp.arange(T, dtype=jnp.int32)[:, None], (T, k))
-        gates = jnp.zeros((T, c.num_experts), jnp.float32).at[
-            rows, top_idx].set(top_vals)
 
-        # Batched all-expert FFN: [E, T, I] intermediates.
-        g = jax.nn.silu(jnp.einsum("th,ehi->eti", x, lp["w_gate"]))
-        u = jnp.einsum("th,ehi->eti", x, lp["w_up"])
-        y = jnp.einsum("eti,eih->eth", g * u, lp["w_down"])
-        # Weighted combine; contraction over e lowers to the EP psum.
+        if envs.VDT_MOE_BACKEND == "dense":
+            return self._moe_dense(lp, x, top_idx, top_vals)
+
+        # Flatten assignments and sort by expert id: each expert's rows
+        # become contiguous, exactly what ragged_dot's group_sizes
+        # describe (reference: moe_align_block_size kernels, csrc/moe/).
+        flat_e = top_idx.astype(jnp.int32).reshape(-1)        # [T*k]
+        flat_t = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+        flat_w = top_vals.reshape(-1)
+        Pn = self.num_physical
+        if Pn > E:
+            # EPLB indirection: each assignment picks one of its logical
+            # expert's physical replicas, spread round-robin by token
+            # row (reference: eplb_state.py replica selection).
+            choice = flat_t % lp["expert_replicas"][flat_e]
+            flat_e = lp["expert_map"][flat_e, choice]
+        order = jnp.argsort(flat_e, stable=True)
+        se, st = flat_e[order], flat_t[order]
+        sw = flat_w[order]
+        xs = x[st]                                            # [T*k, H]
+
+        if c.expert_parallel:
+            y = self._moe_ep_ragged(lp, xs, se, sw)
+        else:
+            group_sizes = jnp.bincount(se, length=Pn)
+            y = self._expert_ffn(lp, xs, group_sizes)
+            y = y * sw[:, None].astype(y.dtype)
+        # Un-sort + combine the k expert outputs per token.
+        out = jax.ops.segment_sum(y, st, num_segments=T)
+        return out.astype(x.dtype)
+
+    def _expert_ffn(self, lp: dict, xs: jax.Array,
+                    group_sizes: jax.Array) -> jax.Array:
+        """SwiGLU over expert-sorted rows: three grouped GEMMs. Rows
+        beyond sum(group_sizes) come back zero (ragged_dot semantics) —
+        the EP path exploits that for its padding."""
+        g = jax.nn.silu(
+            jax.lax.ragged_dot(xs, self._w(lp, "w_gate"), group_sizes))
+        u = jax.lax.ragged_dot(xs, self._w(lp, "w_up"), group_sizes)
+        return jax.lax.ragged_dot(g * u, self._w(lp, "w_down"),
+                                  group_sizes)
+
+    def _moe_ep_ragged(self, lp: dict, xs: jax.Array, se: jax.Array,
+                       sw: jax.Array) -> jax.Array:
+        """Expert-parallel dispatch: each rank of the ``model`` axis
+        holds E/ep whole experts (reference: FusedMoE EP + all2all
+        managers, device_communicators/all2all.py). Activations are
+        replicated across the axis, so "dispatch" is a local partition —
+        every rank stable-partitions ITS experts' rows to the front,
+        runs the grouped GEMMs on its local expert slab (ragged_dot
+        zero-fills the foreign rows), and the combine is one psum over
+        ICI. Exact compute: no capacity factor, no dropped tokens."""
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        mesh = mesh_state.get_global_mesh()
+        ep = mesh.shape[MODEL_AXIS]
+        E_local = self.num_physical // ep
+
+        def rank_fn(w_gate, w_up, w_down, xs_, se_, sw_):
+            r = jax.lax.axis_index(MODEL_AXIS)
+            lo = r * E_local
+            is_local = (se_ >= lo) & (se_ < lo + E_local)
+            part = jnp.argsort(~is_local, stable=True)  # local rows first
+            xs_l = xs_[part]
+            local_ids = jnp.where(is_local[part], se_[part] - lo, E_local)
+            # Foreign rows bucket into a virtual group E_local that is
+            # dropped from group_sizes; ragged_dot then zero-fills them.
+            group_sizes = jnp.bincount(local_ids, length=E_local + 1)[:-1]
+            w = jnp.where(is_local[part], sw_[part], 0.0)
+            g = jax.nn.silu(jax.lax.ragged_dot(xs_l, w_gate, group_sizes))
+            u = jax.lax.ragged_dot(xs_l, w_up, group_sizes)
+            y = jax.lax.ragged_dot(g * u, w_down, group_sizes)
+            y = y * w[:, None].astype(y.dtype)
+            y = y[jnp.argsort(part)]  # back to expert-sorted order
+            return jax.lax.psum(y, MODEL_AXIS)
+
+        return jax.shard_map(
+            rank_fn, mesh=mesh,
+            in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS, None, None),
+                      P(MODEL_AXIS, None, None), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False)(self._w(lp, "w_gate"),
+                             self._w(lp, "w_up"),
+                             self._w(lp, "w_down"), xs, se, sw)
+
+    def _moe_dense(self, lp: dict, x: jax.Array, top_idx: jax.Array,
+                   top_vals: jax.Array) -> jax.Array:
+        """All-expert einsum baseline (E/k x the needed FLOPs); kept for
+        A/B testing and the FLOP-reduction regression test."""
+        c = self.cfg
+        T = x.shape[0]
+        rows = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[:, None],
+            (T, c.num_experts_per_tok))
+        if self.num_physical > c.num_experts:
+            # EPLB: address each logical expert's first physical replica
+            # (the dense baseline doesn't spread load).
+            top_idx = lp["expert_map"][top_idx, 0]
+        gates = jnp.zeros((T, self.num_physical), jnp.float32).at[
+            rows, top_idx].set(top_vals)
+        g = jax.nn.silu(
+            jnp.einsum("th,ehi->eti", x, self._w(lp, "w_gate")))
+        u = jnp.einsum("th,ehi->eti", x, self._w(lp, "w_up"))
+        y = jnp.einsum("eti,eih->eth", g * u, self._w(lp, "w_down"))
         out = jnp.einsum("te,eth->th", gates.astype(y.dtype), y)
         return out.astype(x.dtype)

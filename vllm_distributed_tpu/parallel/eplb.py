@@ -1,0 +1,149 @@
+"""Expert-parallel load balancing (EPLB).
+
+Reference: vllm/distributed/eplb/ — ``EplbState`` (eplb_state.py:48)
+tracks per-expert load; ``rebalance_experts`` (rebalance_algo.py:179,
+after DeepSeek EPLB) computes a physical-expert placement that REPLICATES
+hot experts into spare physical slots and PACKS physical experts onto EP
+ranks so per-rank load balances; rebalance_execute.py then moves weights.
+
+TPU redesign: placement is pure host math (numpy, unit-testable); weight
+movement is one ``jnp.take`` over the expert axis followed by re-placement
+with the same NamedSharding — XLA turns that into the ICI shuffles the
+reference does with P2P sends. The MoE router maps logical->physical
+through a small per-layer index buffer that rides in the param tree, so
+the jitted forward never recompiles on a rebalance (only buffer VALUES
+change).
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class EplbPlacement:
+    """One rebalance decision.
+
+    phys_to_logical: [L, P] — which logical expert each physical slot
+      hosts (P = num physical slots, a multiple of the EP rank count).
+    logical_replicas: [L, E] — replica count per logical expert.
+    logical_to_phys: [L, E, R_max] — physical slot ids per logical
+      expert, -1 padded to the max replica count.
+    """
+
+    phys_to_logical: np.ndarray
+    logical_replicas: np.ndarray
+    logical_to_phys: np.ndarray
+
+    @property
+    def max_replicas(self) -> int:
+        return self.logical_to_phys.shape[-1]
+
+
+def rebalance_experts(loads: np.ndarray, num_physical: int,
+                      num_ranks: int) -> EplbPlacement:
+    """Compute a balanced placement from per-layer expert loads [L, E].
+
+    Per layer: (1) hand the P - E spare physical slots out greedily to
+    the expert with the highest load-per-replica; (2) pack the resulting
+    physical experts onto ranks — heaviest first, each to the least
+    loaded rank with a free slot, avoiding ranks that already host a
+    replica of the same expert when possible (a replica on the same rank
+    adds no bandwidth).
+    """
+    loads = np.asarray(loads, np.float64)
+    L, E = loads.shape
+    assert num_physical >= E, "need at least one slot per expert"
+    assert num_physical % num_ranks == 0, \
+        "physical slots must split evenly over ranks"
+    slots_per_rank = num_physical // num_ranks
+
+    phys_to_logical = np.zeros((L, num_physical), np.int32)
+    logical_replicas = np.zeros((L, E), np.int32)
+
+    for layer in range(L):
+        w = np.maximum(loads[layer], 1e-9)
+        # --- replication: spare slots to the heaviest load/replica ---
+        replicas = np.ones(E, np.int64)
+        for _ in range(num_physical - E):
+            replicas[np.argmax(w / replicas)] += 1
+        # --- physical item list (expert id, weight share) ---
+        items: list[tuple[int, float]] = []
+        for e in range(E):
+            items += [(e, w[e] / replicas[e])] * int(replicas[e])
+        items.sort(key=lambda t: -t[1])
+        # --- balanced packing onto ranks ---
+        rank_load = np.zeros(num_ranks, np.float64)
+        rank_fill = np.zeros(num_ranks, np.int64)
+        rank_has: list[set[int]] = [set() for _ in range(num_ranks)]
+        placement = np.full(num_physical, -1, np.int32)
+        for e, share in items:
+            open_ranks = [r for r in range(num_ranks)
+                          if rank_fill[r] < slots_per_rank]
+            fresh = [r for r in open_ranks if e not in rank_has[r]]
+            pool = fresh or open_ranks
+            r = min(pool, key=lambda r: rank_load[r])
+            placement[r * slots_per_rank + rank_fill[r]] = e
+            rank_fill[r] += 1
+            rank_load[r] += share
+            rank_has[r].add(e)
+        phys_to_logical[layer] = placement
+        logical_replicas[layer] = replicas
+
+    r_max = int(logical_replicas.max())
+    logical_to_phys = np.full((L, E, r_max), -1, np.int32)
+    for layer in range(L):
+        seen = np.zeros(E, np.int64)
+        for p, e in enumerate(phys_to_logical[layer]):
+            logical_to_phys[layer, e, seen[e]] = p
+            seen[e] += 1
+    return EplbPlacement(phys_to_logical=phys_to_logical,
+                         logical_replicas=logical_replicas,
+                         logical_to_phys=logical_to_phys)
+
+
+def rank_loads(placement: EplbPlacement, loads: np.ndarray,
+               num_ranks: int) -> np.ndarray:
+    """Per-layer per-rank load under a placement (test/metric helper):
+    each logical expert's load splits evenly across its replicas."""
+    L, P = placement.phys_to_logical.shape
+    slots = P // num_ranks
+    out = np.zeros((L, num_ranks), np.float64)
+    for layer in range(L):
+        share = (loads[layer] /
+                 np.maximum(placement.logical_replicas[layer], 1))
+        for p, e in enumerate(placement.phys_to_logical[layer]):
+            out[layer, p // slots] += share[e]
+    return out
+
+
+@dataclass
+class EplbState:
+    """Per-expert load tracking + rebalance cadence (reference:
+    eplb_state.py:48 — EMA over per-step token counts)."""
+
+    num_layers: int
+    num_experts: int
+    ema_decay: float = 0.9
+    rebalance_interval: int = 100
+    loads: np.ndarray = field(init=False)
+    steps_since_rebalance: int = 0
+
+    def __post_init__(self) -> None:
+        self.loads = np.zeros((self.num_layers, self.num_experts),
+                              np.float64)
+
+    def record(self, step_counts: np.ndarray) -> None:
+        """Fold one step's per-layer logical-expert token counts in."""
+        self.loads = (self.ema_decay * self.loads +
+                      (1.0 - self.ema_decay) *
+                      np.asarray(step_counts, np.float64))
+        self.steps_since_rebalance += 1
+
+    def should_rebalance(self) -> bool:
+        return self.steps_since_rebalance >= self.rebalance_interval
+
+    def make_placement(self, num_physical: int,
+                       num_ranks: int) -> EplbPlacement:
+        self.steps_since_rebalance = 0
+        return rebalance_experts(self.loads, num_physical, num_ranks)

@@ -61,6 +61,9 @@ class EngineCoreRequest:
     # Disaggregated prefill routing (reference: kv_transfer_params on the
     # request, nixl_connector.py:205).
     kv_transfer_params: Optional[dict[str, Any]] = None
+    # Multi-LoRA: {"name": ..., "path": ...} selecting the adapter
+    # (reference: LoRARequest on add_request, vllm/lora/request.py).
+    lora_request: Optional[dict[str, str]] = None
 
 
 class Request:
@@ -75,6 +78,7 @@ class Request:
         arrival_time: Optional[float] = None,
         priority: int = 0,
         kv_transfer_params: Optional[dict[str, Any]] = None,
+        lora_request: Optional[dict[str, str]] = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -87,6 +91,7 @@ class Request:
                              if arrival_time is None else arrival_time)
         self.priority = priority
         self.kv_transfer_params = kv_transfer_params
+        self.lora_request = lora_request
 
         self.status = RequestStatus.WAITING
         self.stop_reason: Optional[int | str] = None
@@ -128,6 +133,7 @@ class Request:
             arrival_time=req.arrival_time,
             priority=req.priority,
             kv_transfer_params=req.kv_transfer_params,
+            lora_request=req.lora_request,
         )
 
     # ------------------------------------------------------------------

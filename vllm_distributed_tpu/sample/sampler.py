@@ -136,6 +136,7 @@ def sample_tokens_extended(
     md: SamplingMetadata,
     ext: ExtendedSamplingMetadata,
     want_topk: bool = True,
+    vocab_mask: jax.Array = None,  # [R, V] bool; True = token allowed
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Extended path: logits processors + sampling (+ top-K logprobs when
     ``want_topk``) in one graph. Returns (token ids [R], chosen logprob
@@ -151,6 +152,11 @@ def sample_tokens_extended(
     """
     raw_logprobs = jax.nn.log_softmax(logits, axis=-1)
     logits = apply_logits_processors(logits, ext)
+    if vocab_mask is not None:
+        # Structured-output grammar bitmask (reference: bitmask applied
+        # to the logits at gpu_model_runner.py:1433). Reported logprobs
+        # stay raw, matching the unmasked-logprob semantics above.
+        logits = jnp.where(vocab_mask, logits, jnp.float32(-jnp.inf))
     token_ids, _ = _sample_from_logits(logits, md)
     chosen_logprob = jnp.take_along_axis(raw_logprobs, token_ids[:, None],
                                          axis=1)[:, 0]

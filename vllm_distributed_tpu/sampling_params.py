@@ -52,6 +52,11 @@ class SamplingParams:
     # Restrict sampling to this token set (reference:
     # logits_processor.py AllowedTokenIdsLogitsProcessor).
     allowed_token_ids: Optional[list[int]] = None
+    # Structured output / guided decoding (reference:
+    # sampling_params.py GuidedDecodingParams + v1/structured_output/).
+    # One of: {"regex": str}, {"choice": [str, ...]},
+    # {"json": schema-dict-or-string}, {"json_object": True}.
+    structured: Optional[dict] = None
     detokenize: bool = True
     skip_special_tokens: bool = True
     spaces_between_special_tokens: bool = True
@@ -101,6 +106,13 @@ class SamplingParams:
                 raise ValueError(
                     f"logit_bias supports at most {MAX_BIAS_ENTRIES} "
                     "entries")
+        if self.structured is not None:
+            keys = set(self.structured) & {"regex", "choice", "json",
+                                           "json_object"}
+            if len(keys) != 1:
+                raise ValueError(
+                    "structured needs exactly one of regex / choice / "
+                    f"json / json_object, got {sorted(self.structured)}")
         if self.allowed_token_ids is not None:
             if not self.allowed_token_ids:
                 raise ValueError("allowed_token_ids must be non-empty")
@@ -152,7 +164,8 @@ class SamplingParams:
         matters while output < min_tokens (checked dynamically)."""
         return (self.has_penalties or bool(self.logit_bias)
                 or self.allowed_token_ids is not None
-                or bool(self.logprobs))
+                or bool(self.logprobs)
+                or self.structured is not None)
 
     @property
     def needs_extended_sampling(self) -> bool:

@@ -1,0 +1,472 @@
+"""Byte-level regex -> DFA engine for structured output.
+
+Reference: vllm/v1/structured_output/ compiles grammars (xgrammar /
+guidance / outlines backends) into per-step token bitmasks applied to the
+logits (gpu_model_runner.py:1433). The TPU design keeps that split: this
+module is the grammar half — a self-contained regex compiler (no
+third-party grammar libs in the image) producing a byte-alphabet DFA,
+plus a token-mask table that turns DFA states into vocabulary bitmasks.
+
+Supported regex subset (enough for the JSON-schema compiler in
+json_schema.py and typical guided_regex use): literals, ``.``, escapes
+(``\\d \\w \\s \\n \\t \\r`` and escaped punctuation), character classes
+``[...]``/``[^...]`` with ranges, groups ``(...)``, alternation ``|``,
+quantifiers ``* + ? {m} {m,} {m,n}``, anchors are implicit (the whole
+output must match).
+
+The DFA is a dense ``[S, 256] -> S`` byte-transition table (state 0 =
+dead). Token masks are computed lazily per visited state by vectorised
+numpy walks of every vocab token's bytes — visited states during one
+generation are few, so the S x V precompute cost is never paid up front.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Regex parsing -> NFA (Thompson construction)
+# ---------------------------------------------------------------------------
+
+_DIGITS = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = (frozenset(range(ord("a"), ord("z") + 1))
+         | frozenset(range(ord("A"), ord("Z") + 1)) | _DIGITS
+         | {ord("_")})
+_SPACE = frozenset(map(ord, " \t\n\r\f\v"))
+_ALL = frozenset(range(256))
+
+
+class _Parser:
+    """Recursive-descent regex parser producing an NFA fragment list.
+
+    NFA representation: states are ints; transitions are
+    (state, byteset | None, next) — None byteset = epsilon.
+    """
+
+    def __init__(self, pattern: str) -> None:
+        self.src = pattern
+        self.pos = 0
+        self.transitions: list[tuple[int, Optional[frozenset], int]] = []
+        self.num_states = 0
+
+    def new_state(self) -> int:
+        self.num_states += 1
+        return self.num_states - 1
+
+    def edge(self, a: int, byteset: Optional[frozenset], b: int) -> None:
+        self.transitions.append((a, byteset, b))
+
+    # -- tokenizer helpers ------------------------------------------------
+    def peek(self) -> Optional[str]:
+        return self.src[self.pos] if self.pos < len(self.src) else None
+
+    def take(self) -> str:
+        ch = self.src[self.pos]
+        self.pos += 1
+        return ch
+
+    # -- grammar ----------------------------------------------------------
+    def parse(self) -> tuple[int, int]:
+        start, end = self.alternation()
+        if self.pos != len(self.src):
+            raise ValueError(
+                f"unexpected {self.src[self.pos]!r} at {self.pos} in "
+                f"{self.src!r}")
+        return start, end
+
+    def alternation(self) -> tuple[int, int]:
+        frags = [self.concat()]
+        while self.peek() == "|":
+            self.take()
+            frags.append(self.concat())
+        if len(frags) == 1:
+            return frags[0]
+        s, e = self.new_state(), self.new_state()
+        for fs, fe in frags:
+            self.edge(s, None, fs)
+            self.edge(fe, None, e)
+        return s, e
+
+    def concat(self) -> tuple[int, int]:
+        frags = []
+        while self.peek() not in (None, "|", ")"):
+            frags.append(self.repeat())
+        if not frags:
+            s = self.new_state()
+            return s, s
+        for (_, e1), (s2, _) in zip(frags, frags[1:]):
+            self.edge(e1, None, s2)
+        return frags[0][0], frags[-1][1]
+
+    def repeat(self) -> tuple[int, int]:
+        frag = self.atom()
+        while self.peek() in ("*", "+", "?", "{"):
+            ch = self.peek()
+            if ch == "{":
+                save = self.pos
+                bounds = self._parse_bounds()
+                if bounds is None:
+                    self.pos = save
+                    break
+                frag = self._repeat_bounds(frag, *bounds)
+            else:
+                self.take()
+                if ch == "*":
+                    frag = self._star(frag)
+                elif ch == "+":
+                    frag = self._plus(frag)
+                else:
+                    frag = self._opt(frag)
+        return frag
+
+    def _parse_bounds(self) -> Optional[tuple[int, Optional[int]]]:
+        # at self.src[self.pos] == "{"; returns (m, n|None) or None if not
+        # a quantifier (treat "{" as a literal then).
+        import re as _re
+        m = _re.match(r"\{(\d+)(,(\d*))?\}", self.src[self.pos:])
+        if not m:
+            return None
+        self.pos += m.end()
+        lo = int(m.group(1))
+        if m.group(2) is None:
+            return lo, lo
+        hi = int(m.group(3)) if m.group(3) else None
+        return lo, hi
+
+    # -- fragment combinators --------------------------------------------
+    def _star(self, frag):
+        s, e = self.new_state(), self.new_state()
+        fs, fe = frag
+        self.edge(s, None, fs)
+        self.edge(s, None, e)
+        self.edge(fe, None, fs)
+        self.edge(fe, None, e)
+        return s, e
+
+    def _plus(self, frag):
+        fs, fe = frag
+        e = self.new_state()
+        self.edge(fe, None, e)
+        self.edge(e, None, fs)
+        return fs, e
+
+    def _opt(self, frag):
+        s, e = self.new_state(), self.new_state()
+        fs, fe = frag
+        self.edge(s, None, fs)
+        self.edge(s, None, e)
+        self.edge(fe, None, e)
+        return s, e
+
+    def _clone(self, frag):
+        """Deep-copy a fragment's states/transitions (for {m,n})."""
+        fs, fe = frag
+        reachable = self._frag_states(frag)
+        mapping = {old: self.new_state() for old in reachable}
+        for a, bs, b in list(self.transitions):
+            if a in mapping and b in mapping:
+                self.edge(mapping[a], bs, mapping[b])
+        return mapping[fs], mapping[fe]
+
+    def _frag_states(self, frag) -> set[int]:
+        fs, fe = frag
+        adj: dict[int, list[int]] = {}
+        for a, _bs, b in self.transitions:
+            adj.setdefault(a, []).append(b)
+        seen = {fs}
+        stack = [fs]
+        while stack:
+            s = stack.pop()
+            for nxt in adj.get(s, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        seen.add(fe)
+        return seen
+
+    def _repeat_bounds(self, frag, lo: int, hi: Optional[int]):
+        parts = [frag]
+        total = (hi if hi is not None else max(lo, 1))
+        for _ in range(total - 1):
+            parts.append(self._clone(frag))
+        if hi is None:
+            parts[-1] = self._plus(parts[-1]) if lo > 0 else \
+                self._star(parts[-1])
+            if lo == 0 and len(parts) == 1:
+                return parts[0]
+        opt_from = lo if lo > 0 else 1
+        for i in range(opt_from, len(parts) - (1 if hi is None else 0)):
+            parts[i] = self._opt(parts[i])
+        if lo == 0 and hi is not None:
+            parts[0] = self._opt(parts[0])
+        for (_, e1), (s2, _) in zip(parts, parts[1:]):
+            self.edge(e1, None, s2)
+        return parts[0][0], parts[-1][1]
+
+    # -- atoms ------------------------------------------------------------
+    def atom(self) -> tuple[int, int]:
+        ch = self.take()
+        if ch == "(":
+            if self.src[self.pos:self.pos + 2] == "?:":
+                self.pos += 2
+            frag = self.alternation()
+            if self.peek() != ")":
+                raise ValueError(f"unclosed group in {self.src!r}")
+            self.take()
+            return frag
+        if ch == "[":
+            return self._charset(self._parse_class())
+        if ch == ".":
+            return self._charset(_ALL - {ord("\n")})
+        if ch == "\\":
+            return self._charset(self._escape(self.take()))
+        if ch in ")|*+?":
+            raise ValueError(f"unexpected {ch!r} in {self.src!r}")
+        return self._charset(frozenset(ch.encode("utf-8"))
+                             if ord(ch) < 128 else
+                             self._literal_bytes(ch))
+
+    def _literal_bytes(self, ch: str) -> tuple[int, int]:
+        # Multi-byte utf-8 literal: a byte chain, returned as a fragment.
+        bs = ch.encode("utf-8")
+        s = self.new_state()
+        cur = s
+        for b in bs:
+            nxt = self.new_state()
+            self.edge(cur, frozenset((b, )), nxt)
+            cur = nxt
+        # Sentinel: caller expects a charset for 1-byte atoms; for
+        # multibyte we already built the chain — wrap via a tuple tag.
+        self._mb_frag = (s, cur)
+        return self._mb_frag
+
+    def _charset(self, byteset) -> tuple[int, int]:
+        if isinstance(byteset, tuple):  # multibyte chain fragment
+            return byteset
+        s, e = self.new_state(), self.new_state()
+        self.edge(s, frozenset(byteset), e)
+        return s, e
+
+    def _escape(self, ch: str) -> frozenset:
+        table = {
+            "d": _DIGITS, "D": _ALL - _DIGITS,
+            "w": _WORD, "W": _ALL - _WORD,
+            "s": _SPACE, "S": _ALL - _SPACE,
+            "n": frozenset((10, )), "t": frozenset((9, )),
+            "r": frozenset((13, )), "f": frozenset((12, )),
+            "v": frozenset((11, )), "0": frozenset((0, )),
+        }
+        if ch in table:
+            return table[ch]
+        if ch == "x":
+            hexs = self.take() + self.take()
+            return frozenset((int(hexs, 16), ))
+        return frozenset(ch.encode("utf-8"))
+
+    def _parse_class(self) -> frozenset:
+        negate = False
+        if self.peek() == "^":
+            self.take()
+            negate = True
+        members: set[int] = set()
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                raise ValueError(f"unclosed class in {self.src!r}")
+            if ch == "]" and not first:
+                self.take()
+                break
+            first = False
+            self.take()
+            if ch == "\\":
+                sub = self._escape(self.take())
+                members |= sub
+                continue
+            lo = ord(ch)
+            if (self.peek() == "-" and self.pos + 1 < len(self.src)
+                    and self.src[self.pos + 1] != "]"):
+                self.take()
+                hi_ch = self.take()
+                if hi_ch == "\\":
+                    hi_set = self._escape(self.take())
+                    hi = max(hi_set)
+                else:
+                    hi = ord(hi_ch)
+                members |= set(range(lo, hi + 1))
+            else:
+                if lo < 128:
+                    members.add(lo)
+                else:
+                    members |= set(ch.encode("utf-8"))
+        return frozenset(_ALL - members if negate else members)
+
+
+# ---------------------------------------------------------------------------
+# NFA -> DFA (subset construction over the byte alphabet)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DFA:
+    """Dense byte DFA. State 0 is the dead state; start state is 1."""
+
+    trans: np.ndarray  # [S, 256] int32
+    accept: np.ndarray  # [S] bool
+    # live[s]: some accepting state is reachable from s (s != dead).
+    live: np.ndarray  # [S] bool
+
+    @property
+    def num_states(self) -> int:
+        return self.trans.shape[0]
+
+    def walk_bytes(self, state: int, data: bytes) -> int:
+        for b in data:
+            state = int(self.trans[state, b])
+            if state == 0:
+                return 0
+        return state
+
+
+MAX_NFA_STATES = 200_000
+MAX_DFA_STATES = 20_000
+
+
+def compile_regex(pattern: str) -> DFA:
+    parser = _Parser(pattern)
+    start, end = parser.parse()
+    n = parser.num_states
+    if n > MAX_NFA_STATES:
+        raise ValueError(
+            f"grammar too complex ({n} NFA states; bounded repetitions "
+            "clone their fragment — prefer * / + loops)")
+
+    eps: list[list[int]] = [[] for _ in range(n)]
+    by_byte: list[list[tuple[frozenset, int]]] = [[] for _ in range(n)]
+    for a, bs, b in parser.transitions:
+        if bs is None:
+            eps[a].append(b)
+        else:
+            by_byte[a].append((bs, b))
+
+    def closure(states: frozenset) -> frozenset:
+        stack = list(states)
+        seen = set(states)
+        while stack:
+            s = stack.pop()
+            for nxt in eps[s]:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return frozenset(seen)
+
+    start_set = closure(frozenset((start, )))
+    dfa_ids: dict[frozenset, int] = {frozenset(): 0, start_set: 1}
+    rows: list[np.ndarray] = [np.zeros(256, np.int32),
+                              np.zeros(256, np.int32)]
+    accepts: list[bool] = [False, end in start_set]
+    work = [start_set]
+    while work:
+        cur = work.pop()
+        cur_id = dfa_ids[cur]
+        # Gather per-byte targets.
+        targets: dict[int, set[int]] = {}
+        for s in cur:
+            for bs, nxt in by_byte[s]:
+                for b in bs:
+                    targets.setdefault(b, set()).add(nxt)
+        row = np.zeros(256, np.int32)
+        # Group identical target sets to avoid recomputing closures.
+        by_set: dict[frozenset, list[int]] = {}
+        for b, tset in targets.items():
+            by_set.setdefault(frozenset(tset), []).append(b)
+        for tset, byte_list in by_set.items():
+            nxt_set = closure(tset)
+            if nxt_set not in dfa_ids:
+                if len(rows) >= MAX_DFA_STATES:
+                    raise ValueError(
+                        f"grammar too complex (> {MAX_DFA_STATES} DFA "
+                        "states)")
+                dfa_ids[nxt_set] = len(rows)
+                rows.append(np.zeros(256, np.int32))
+                accepts.append(end in nxt_set)
+                work.append(nxt_set)
+            nid = dfa_ids[nxt_set]
+            for b in byte_list:
+                row[b] = nid
+        rows[cur_id] = row
+
+    trans = np.stack(rows)
+    accept = np.asarray(accepts, bool)
+    # Liveness: backward reachability from accepting states.
+    S = trans.shape[0]
+    live = accept.copy()
+    changed = True
+    while changed:
+        changed = False
+        reaches = live[trans].any(axis=1) & (np.arange(S) != 0)
+        new_live = live | reaches
+        if (new_live != live).any():
+            live = new_live
+            changed = True
+    return DFA(trans=trans, accept=accept, live=live)
+
+
+# ---------------------------------------------------------------------------
+# Token-mask table: DFA states -> vocab bitmasks
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TokenMaskTable:
+    """Lazily-computed per-state vocabulary masks for one DFA + vocab.
+
+    allow(state)[t] is True when emitting token t from ``state`` keeps
+    the automaton in a LIVE state (an accepting state stays reachable).
+    next_states(state)[t] is the state after emitting t (0 = dead).
+    EOS handling is the manager's job: EOS is allowed iff the current
+    state is accepting.
+    """
+
+    dfa: DFA
+    token_bytes: list[bytes]
+    max_len: int = field(init=False)
+    _tok_mat: np.ndarray = field(init=False)  # [V, Lmax] int16 (-1 pad)
+    _cache: dict[int, tuple[np.ndarray, np.ndarray]] = \
+        field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        V = len(self.token_bytes)
+        self.max_len = max((len(b) for b in self.token_bytes), default=1)
+        mat = np.full((V, max(self.max_len, 1)), -1, np.int16)
+        for i, bs in enumerate(self.token_bytes):
+            if bs:
+                mat[i, :len(bs)] = np.frombuffer(bs, np.uint8)
+        self._tok_mat = mat
+
+    def _compute(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        V, L = self._tok_mat.shape
+        cur = np.full(V, state, np.int32)
+        for j in range(L):
+            col = self._tok_mat[:, j]
+            active = col >= 0
+            nxt = self.dfa.trans[cur, np.where(active, col, 0)]
+            cur = np.where(active, nxt, cur)
+        # Empty tokens (no bytes) keep the state; dead-end tokens -> 0.
+        allow = self.dfa.live[cur]
+        # Tokens with no bytes cannot advance the grammar; disallow them
+        # so generation always makes progress.
+        empty = self._tok_mat[:, 0] < 0
+        allow = allow & ~empty
+        return allow, cur
+
+    def allow(self, state: int) -> np.ndarray:
+        if state not in self._cache:
+            self._cache[state] = self._compute(state)
+        return self._cache[state][0]
+
+    def next_states(self, state: int) -> np.ndarray:
+        if state not in self._cache:
+            self._cache[state] = self._compute(state)
+        return self._cache[state][1]

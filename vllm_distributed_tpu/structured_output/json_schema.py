@@ -1,0 +1,139 @@
+"""JSON-schema -> regex compiler (outlines-style) for structured output.
+
+Reference: vllm/v1/structured_output/ backends compile
+``response_format={"type": "json_schema"}`` into a token-level grammar.
+Context-free JSON needs a pushdown automaton in general; like outlines,
+this compiler sidesteps that by bounding nesting depth and emitting a
+plain regex for the schema (or for generic JSON-object mode), which the
+fsm module turns into a DFA + token masks.
+
+Supported schema subset: type object (properties in declaration order,
+``required`` honoured — optional properties may be omitted only from the
+tail), string, integer, number, boolean, null, enum (of scalars), const,
+array (items, minItems/maxItems up to a small bound), anyOf, and
+``{}``/missing-type (any bounded-depth JSON value).
+"""
+
+import json
+import re as _stdre
+from typing import Any
+
+_WS = r"[ \n\t]*"
+_STRING = r'"([^"\\\x00-\x1f]|\\["\\/bfnrtu])*"'
+_INTEGER = r"-?(0|[1-9][0-9]*)"
+_NUMBER = _INTEGER + r"(\.[0-9]+)?([eE][+-]?[0-9]+)?"
+_BOOLEAN = r"(true|false)"
+_NULL = r"null"
+
+# NFA size grows ~4x per nesting level (a value appears twice in the
+# array form and twice in the object form), so unbounded ``*`` loops are
+# essential (a bounded {0,n} would CLONE the value fragment n times) and
+# depth stays small. Deeper nesting than this in json mode falls back to
+# the model simply not closing braces it cannot open.
+MAX_ARRAY_ITEMS = 8
+ANY_VALUE_DEPTH = 3
+
+
+def _list_of(item: str) -> str:
+    return rf"({item}({_WS},{_WS}{item})*)?"
+
+
+def _any_value(depth: int) -> str:
+    """Regex for an arbitrary JSON value with nesting bounded at
+    ``depth`` (generic json_object mode)."""
+    scalar = f"({_STRING}|{_NUMBER}|{_BOOLEAN}|{_NULL})"
+    value = scalar
+    for _ in range(depth):
+        arr = rf"\[{_WS}{_list_of(value)}{_WS}\]"
+        member = rf"{_STRING}{_WS}:{_WS}{value}"
+        obj = rf"\{{{_WS}{_list_of(member)}{_WS}\}}"
+        value = f"({scalar}|{arr}|{obj})"
+    return value
+
+
+def json_object_regex() -> str:
+    """Generic ``response_format: json_object``: one JSON object."""
+    member = rf"{_STRING}{_WS}:{_WS}{_any_value(ANY_VALUE_DEPTH - 1)}"
+    return rf"\{{{_WS}{_list_of(member)}{_WS}\}}"
+
+
+def schema_to_regex(schema: Any) -> str:
+    if isinstance(schema, str):
+        schema = json.loads(schema)
+    return _compile(schema, depth=ANY_VALUE_DEPTH)
+
+
+def _literal(value: Any) -> str:
+    return _stdre.escape(json.dumps(value))
+
+
+def _compile(schema: Any, depth: int) -> str:
+    if not isinstance(schema, dict) or not schema:
+        return _any_value(max(depth - 1, 0))
+    if "const" in schema:
+        return _literal(schema["const"])
+    if "enum" in schema:
+        return "(" + "|".join(_literal(v) for v in schema["enum"]) + ")"
+    if "anyOf" in schema:
+        return ("(" + "|".join(_compile(s, depth)
+                               for s in schema["anyOf"]) + ")")
+    t = schema.get("type")
+    if isinstance(t, list):
+        return ("(" + "|".join(_compile({**schema, "type": one}, depth)
+                               for one in t) + ")")
+    if t == "string":
+        if "pattern" in schema:
+            # The schema's pattern matches the string CONTENT.
+            return f'"{schema["pattern"]}"'
+        return _STRING
+    if t == "integer":
+        return _INTEGER
+    if t == "number":
+        return _NUMBER
+    if t == "boolean":
+        return _BOOLEAN
+    if t == "null":
+        return _NULL
+    if t == "array":
+        item = _compile(schema.get("items", {}), depth - 1)
+        lo = int(schema.get("minItems", 0))
+        hi = schema.get("maxItems")
+        if lo == 0 and hi is None:
+            body = _list_of(item)
+        else:
+            hi = MAX_ARRAY_ITEMS if hi is None else \
+                min(int(hi), MAX_ARRAY_ITEMS)
+            lo = min(lo, hi)
+            if lo == 0:
+                body = (f"({item}({_WS},{_WS}{item}){{0,{hi - 1}}})?"
+                        if hi > 0 else "")
+            else:
+                body = f"{item}({_WS},{_WS}{item}){{{lo - 1},{hi - 1}}}"
+        return rf"\[{_WS}{body}{_WS}\]"
+    if t == "object" or "properties" in schema:
+        props = schema.get("properties", {})
+        required = set(schema.get("required", props.keys()))
+        if not props:
+            return json_object_regex()
+        parts = []
+        for name, sub in props.items():
+            entry = (rf"{_stdre.escape(json.dumps(name))}{_WS}:{_WS}"
+                     + _compile(sub, depth - 1))
+            parts.append((entry, name in required))
+        # Declaration order; optional properties may drop from the tail
+        # (full optionality of middle keys would blow the regex up
+        # combinatorially).
+        body = ""
+        for i in reversed(range(len(parts))):
+            entry, is_req = parts[i]
+            sep = rf"{_WS},{_WS}" if i > 0 else ""
+            if body:
+                seg = f"{sep}{entry}{body}"
+            else:
+                seg = f"{sep}{entry}"
+            if not is_req:
+                seg = f"({seg})?"
+            body = seg
+        return rf"\{{{_WS}{body}{_WS}\}}"
+    # Unknown schema form: any value.
+    return _any_value(max(depth - 1, 0))

@@ -1,0 +1,147 @@
+"""Structured-output manager: per-request grammar state in the engine
+core, per-step vocab bitmasks shipped to the worker.
+
+Reference: vllm/v1/structured_output/__init__.py
+``StructuredOutputManager`` — grammars compile next to the scheduler,
+each step fills a token bitmask for the scheduled structured requests
+(riding SchedulerOutput), the model runner applies it to the logits
+(gpu_model_runner.py:1433), and sampled tokens advance the grammar FSM.
+
+The TPU twist: masks must be static-shape, so each mask is a dense
+[V] bool array; the runner stacks them into the extended-sampling
+graph's [R, V] mask input (a separate compiled variant keyed by a
+static want_mask flag, like want_topk).
+"""
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.structured_output.fsm import (TokenMaskTable,
+                                                        compile_regex)
+from vllm_distributed_tpu.structured_output.json_schema import (
+    json_object_regex, schema_to_regex)
+
+logger = init_logger(__name__)
+
+
+def spec_to_regex(spec: dict) -> str:
+    """A request's structured spec -> regex. Spec forms (mirroring the
+    reference's GuidedDecodingParams): {"regex": ...}, {"choice": [...]},
+    {"json": schema-or-string}, {"json_object": True}."""
+    if "regex" in spec:
+        return spec["regex"]
+    if "choice" in spec:
+        import re as _stdre
+        return "(" + "|".join(_stdre.escape(str(c))
+                              for c in spec["choice"]) + ")"
+    if "json" in spec:
+        return schema_to_regex(spec["json"])
+    if spec.get("json_object"):
+        return json_object_regex()
+    raise ValueError(f"unsupported structured spec {spec!r}")
+
+
+class _RequestGrammar:
+    __slots__ = ("table", "state", "eos_token_id")
+
+    def __init__(self, table: TokenMaskTable,
+                 eos_token_id: Optional[int]) -> None:
+        self.table = table
+        self.state = 1  # DFA start
+        self.eos_token_id = eos_token_id
+
+
+class StructuredOutputManager:
+
+    def __init__(self, vocab_bytes: list[bytes]) -> None:
+        self.vocab_bytes = vocab_bytes
+        self.vocab_size = len(vocab_bytes)
+        # Compiled DFAs shared across requests with the same spec.
+        self._tables: dict[str, TokenMaskTable] = {}
+        self._requests: dict[str, _RequestGrammar] = {}
+
+    # ------------------------------------------------------------------
+    def add_request(self, req_id: str, spec: dict,
+                    eos_token_id: Optional[int] = None) -> None:
+        pattern = spec_to_regex(spec)
+        key = hashlib.sha256(pattern.encode()).hexdigest()
+        table = self._tables.get(key)
+        if table is None:
+            dfa = compile_regex(pattern)
+            table = TokenMaskTable(dfa=dfa, token_bytes=self.vocab_bytes)
+            self._tables[key] = table
+            logger.info("compiled grammar (%d DFA states) for %r...",
+                        dfa.num_states, pattern[:60])
+        self._requests[req_id] = _RequestGrammar(table, eos_token_id)
+
+    def remove_request(self, req_id: str) -> None:
+        self._requests.pop(req_id, None)
+
+    def has(self, req_id: str) -> bool:
+        return req_id in self._requests
+
+    # ------------------------------------------------------------------
+    def mask_for(self, req_id: str) -> Optional[np.ndarray]:
+        """[V] bool mask for the request's NEXT token; None if the
+        request has no grammar. EOS is allowed exactly in accepting
+        states; if the grammar is complete-and-closed (accepting with no
+        live continuation) only EOS remains."""
+        g = self._requests.get(req_id)
+        if g is None:
+            return None
+        allow = g.table.allow(g.state).copy()
+        eos = g.eos_token_id
+        if eos is not None and 0 <= eos < self.vocab_size:
+            allow[eos] = bool(g.table.dfa.accept[g.state])
+        if not allow.any():
+            # Dead grammar (shouldn't happen: advance() rejects dead
+            # transitions) — allow EOS so the request can terminate.
+            if eos is not None and 0 <= eos < self.vocab_size:
+                allow[eos] = True
+        return allow
+
+    def advance(self, req_id: str, token_ids: list[int]) -> None:
+        g = self._requests.get(req_id)
+        if g is None:
+            return
+        for t in token_ids:
+            if t == g.eos_token_id:
+                self.remove_request(req_id)
+                return
+            nxt = int(g.table.next_states(g.state)[t])
+            if nxt == 0:
+                # The sampler should make this impossible; a desync
+                # (e.g. stop-string cut) must not crash the core.
+                logger.warning(
+                    "structured request %s: token %d leaves the "
+                    "grammar; freezing state", req_id, t)
+                return
+            g.state = nxt
+
+
+def vocab_bytes_from_tokenizer(tokenizer) -> list[bytes]:
+    """token id -> utf-8 bytes table for mask precomputation.
+
+    Uses per-token decode with a leading anchor token where needed so
+    sentencepiece-style leading-space markers decode faithfully."""
+    V = getattr(tokenizer, "vocab_size", None) or len(tokenizer)
+    try:
+        V = max(V, len(tokenizer))
+    except TypeError:
+        pass
+    out: list[bytes] = []
+    specials = set(getattr(tokenizer, "all_special_ids", ()) or ())
+    for i in range(V):
+        if i in specials:
+            out.append(b"")
+            continue
+        try:
+            s = tokenizer.decode([i], skip_special_tokens=False,
+                                 clean_up_tokenization_spaces=False)
+        except Exception:  # noqa: BLE001 - holes in exotic vocabs
+            s = ""
+        out.append(s.encode("utf-8"))
+    return out

@@ -49,6 +49,8 @@ class InputBatch:
         # Lifetime (static) extended-graph need; min-tokens activity is
         # checked dynamically via extended_active().
         self.needs_extended = np.zeros((R, ), np.bool_)
+        # Multi-LoRA adapter slot per row (0 = no adapter).
+        self.lora_slot = np.zeros((R, ), np.int32)
         # Sparse per-row python state (lowered to fixed [R, B] arrays in
         # the runner only when a batch contains extended rows).
         self.logit_bias: list[Optional[dict[int, float]]] = [None] * R
@@ -96,6 +98,7 @@ class InputBatch:
         self.num_logprobs[row] = sp.logprobs or 0
         self.prompt_len[row] = n
         self.needs_extended[row] = sp.needs_extended_static
+        self.lora_slot[row] = 0  # runner sets after adapter resolution
         self.logit_bias[row] = sp.logit_bias
         self.allowed_token_ids[row] = sp.allowed_token_ids
         self.stop_token_ids[row] = tuple(sp.all_stop_token_ids)
@@ -150,6 +153,7 @@ class InputBatch:
         self.num_blocks[row] = 0
         self.block_table[row, :] = 0
         self.needs_extended[row] = False
+        self.lora_slot[row] = 0
         self.num_logprobs[row] = 0
         self.min_tokens[row] = 0
         self.presence_penalty[row] = 0.0

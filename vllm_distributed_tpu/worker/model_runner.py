@@ -15,6 +15,7 @@ the TPU variant tpu_model_runner.py:98 (bucketed precompilation
   same runner code is TP=1 and TP=N (GSPMD inserts the collectives).
 """
 
+import os
 import time
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -61,6 +62,9 @@ class TPUModelRunner:
 
         # Worker-side KV connector (disaggregated prefill; reference:
         # gpu_model_runner.py maybe_setup_kv_connector :2047).
+        # Multi-LoRA adapter slots (set up in load_model, which knows
+        # the arch config).
+        self.lora_manager = None
         from vllm_distributed_tpu.distributed.kv_transfer import (
             KVConnectorRole, create_kv_connector)
         self.kv_connector = create_kv_connector(config,
@@ -122,6 +126,10 @@ class TPUModelRunner:
         """Build the model and load weights per LoadConfig."""
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
+        if self.config.lora_config.enable_lora:
+            from vllm_distributed_tpu.models.lora import LoRASlotManager
+            self.lora_manager = LoRASlotManager(
+                self.model.cfg, self.config.lora_config.max_loras)
 
     def _make_sharded_caches(self, num_pages: int) -> dict:
         from jax.sharding import NamedSharding
@@ -139,6 +147,91 @@ class TPUModelRunner:
         self.kv_caches = self._make_sharded_caches(num_pages)
         if self._forward_fn is None:
             self._build_step_fn()
+
+    # ------------------------------------------------------------------
+    # Sharded-state checkpoints (reference: model_loader/
+    # sharded_state_loader.py + Worker.save_sharded_state — pre-sharded
+    # per-rank checkpoints for fast reload; here orbax writes each
+    # array's shards in parallel from wherever they live on the mesh)
+    # ------------------------------------------------------------------
+    def save_sharded_state(self, path: str) -> None:
+        import orbax.checkpoint as ocp
+        ckpt = ocp.StandardCheckpointer()
+        ckpt.save(os.path.abspath(path), self.params)
+        ckpt.wait_until_finished()
+        logger.info("saved sharded state to %s", path)
+
+    # ------------------------------------------------------------------
+    # Sleep / wake (RLHF colocation; reference: CuMemAllocator tag-based
+    # discard/offload, device_allocator/cumem.py:106, driven by the
+    # EngineCore.sleep/wake_up RPCs, core.py:312-319)
+    # ------------------------------------------------------------------
+    def sleep(self, level: int = 1) -> int:
+        """Release device HBM. Level 1 offloads weights to host and
+        frees the KV cache; level 2 also drops the host copy (wake
+        reloads from the checkpoint). Returns bytes released (approx:
+        weights + KV)."""
+        assert self.kv_caches is not None, "engine not initialized"
+        freed = sum(x.nbytes
+                    for x in jax.tree_util.tree_leaves(self.params))
+        freed += sum(x.nbytes
+                     for x in jax.tree_util.tree_leaves(self.kv_caches))
+        if level == 1:
+            self._host_params = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), self.params)
+        else:
+            self._host_params = None
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            leaf.delete()
+        for leaf in jax.tree_util.tree_leaves(self.kv_caches):
+            leaf.delete()
+        self.params = None
+        self.kv_caches = None
+        self._sleeping = True
+        logger.info("sleeping: released ~%.2f GiB HBM (level %d)",
+                    freed / 2**30, level)
+        return freed
+
+    def wake_up(self) -> None:
+        """Restore weights + a fresh (empty) KV cache. Compiled step
+        functions persist — shapes are unchanged, so no recompiles."""
+        assert getattr(self, "_sleeping", False), "not sleeping"
+        from jax.sharding import NamedSharding
+        if self._host_params is not None:
+            specs = self.model.param_specs()
+            flat_specs = {
+                "embed": specs["embed"],
+                "final_ln": specs["final_ln"],
+                "lm_head": specs["lm_head"],
+            }
+            self.params = {
+                "layers": {
+                    k: jax.device_put(
+                        v, NamedSharding(self.mesh, specs["layers"][k]))
+                    for k, v in self._host_params["layers"].items()
+                },
+                **{
+                    k: jax.device_put(self._host_params[k],
+                                      NamedSharding(self.mesh, s))
+                    for k, s in flat_specs.items()
+                },
+            }
+            self._host_params = None
+        else:
+            from vllm_distributed_tpu.models.loader import get_model
+            self.model, self.params = get_model(self.config, self.mesh)
+            if self.lora_manager is not None:
+                # The reload came with fresh zero adapter buffers; the
+                # slot map must forget its names or old adapters would
+                # "resolve" to zeroed slots and silently serve the base
+                # model. Safe: sleep requires an idle engine.
+                from vllm_distributed_tpu.models.lora import \
+                    LoRASlotManager
+                self.lora_manager = LoRASlotManager(
+                    self.model.cfg, self.config.lora_config.max_loras)
+        self.kv_caches = self._make_sharded_caches(self.num_pages)
+        self._sleeping = False
+        logger.info("awake: weights restored, KV cache reset")
 
     def kv_cache_bytes_per_page(self) -> int:
         from vllm_distributed_tpu.ops.attention import storage_head_dim
@@ -167,10 +260,11 @@ class TPUModelRunner:
             return tokens, logprobs
 
         def sample_ext(params, hidden_sel, sampling_md: SamplingMetadata,
-                       ext: ExtendedSamplingMetadata, want_topk: bool):
+                       ext: ExtendedSamplingMetadata, want_topk: bool,
+                       vocab_mask=None):
             logits = model.compute_logits(params, hidden_sel)
             return sample_tokens_extended(logits, sampling_md, ext,
-                                          want_topk)
+                                          want_topk, vocab_mask)
 
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
@@ -226,9 +320,23 @@ class TPUModelRunner:
     # ------------------------------------------------------------------
     def _update_states(self, scheduler_output: SchedulerOutput) -> None:
         for req_id in scheduler_output.finished_req_ids:
+            if self.lora_manager is not None:
+                row = self.input_batch.req_id_to_index.get(req_id)
+                if row is not None and self.input_batch.lora_slot[row]:
+                    self.lora_manager.release(
+                        int(self.input_batch.lora_slot[row]))
             self.input_batch.remove_request(req_id)
         for new_req in scheduler_output.scheduled_new_reqs:
-            self.input_batch.add_request(new_req)
+            row = self.input_batch.add_request(new_req)
+            if new_req.lora_request is not None:
+                if self.lora_manager is None:
+                    raise ValueError(
+                        "request carries a LoRA adapter but the engine "
+                        "was built without enable_lora")
+                self.input_batch.lora_slot[row] = \
+                    self.lora_manager.acquire(
+                        new_req.lora_request["name"],
+                        new_req.lora_request["path"], self)
         self.input_batch.update_cached(scheduler_output.scheduled_cached_reqs)
 
     def _batch_shape(self, total_tokens: int,
@@ -394,6 +502,34 @@ class TPUModelRunner:
             ext_md = self._build_extended_md(rows, expand)
             want_topk = bool(any(ib.num_logprobs[r] > 0
                                  for r in sampling_rows))
+        # Structured-output grammar masks (reference: grammar bitmask on
+        # the scheduler output, applied at gpu_model_runner.py:1433).
+        # Dense [R, V] bool, padding/unconstrained rows all-True; only
+        # built when a scheduled sampling request has a grammar.
+        vocab_mask = None
+        struct_masks = getattr(scheduler_output, "structured_masks",
+                               None) or {}
+        if struct_masks and any(rid in struct_masks
+                                for rid in sampling_req_ids):
+            V = self.model.cfg.vocab_size
+            mask_np = np.ones((R, V), bool)
+            for i, rid in enumerate(sampling_req_ids):
+                m = struct_masks.get(rid)
+                if m is not None:
+                    # Tokenizer and model vocab sizes can differ (padded
+                    # embeddings / unused ids): ids beyond the grammar
+                    # table are never valid grammar bytes -> disallowed.
+                    n = min(len(m), V)
+                    mask_np[i, :n] = m[:n]
+                    mask_np[i, n:] = False
+            if self.spec_k:
+                # Structured rows never carry drafts (the extended path
+                # disables proposals), so only position 0 of each S1
+                # group is ever emitted — repeating the pre-advance mask
+                # across the group masks real samples correctly and the
+                # discarded padding positions don't matter.
+                mask_np = np.repeat(mask_np, S1, axis=0)
+            vocab_mask = jnp.asarray(mask_np)
         tknp = None
         if K > 1:
             tknp = TknpAttentionBatch(
@@ -403,6 +539,23 @@ class TPUModelRunner:
                 num_seqs=jnp.asarray(tk_num_seqs),
                 kv_runs=jnp.asarray(tk_kv_runs),
                 num_kv_runs=jnp.asarray(tk_num_kv_runs),
+            )
+        lora_ctx = None
+        if self.lora_manager is not None:
+            # Token -> adapter-slot grouping, shared by every LoRA
+            # matmul this step (padding tokens inherit row 0's slot —
+            # their outputs are never read).
+            from vllm_distributed_tpu.models.common import LoraBatch
+            slots = ib.lora_slot[req_idx]
+            order = np.argsort(slots, kind="stable")
+            S = self.config.lora_config.max_loras + 1
+            lora_ctx = LoraBatch(
+                order=jnp.asarray(order.astype(np.int32)),
+                inv=jnp.asarray(np.argsort(order).astype(np.int32)),
+                group_sizes=jnp.asarray(
+                    np.bincount(slots, minlength=S)[:S].astype(np.int32)),
+                scaling=jnp.asarray(
+                    self.lora_manager.scaling[slots[order]]),
             )
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
@@ -415,12 +568,13 @@ class TPUModelRunner:
             kv_runs=jnp.asarray(kv_runs_arr),
             num_kv_runs=jnp.asarray([len(kv_runs)], np.int32),
             tknp=tknp,
+            lora=lora_ctx,
             max_q=max_q,
         )
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
                 sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md,
-                want_topk)
+                want_topk, vocab_mask)
 
     # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
     # validation in SamplingParams guarantees every request fits.
@@ -476,6 +630,15 @@ class TPUModelRunner:
     # ------------------------------------------------------------------
     def execute_model(self,
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        return self.wait_model(self.dispatch_model(scheduler_output))
+
+    def dispatch_model(self, scheduler_output: SchedulerOutput) -> dict:
+        """Non-blocking half of a step: sync batch state, enqueue the
+        device work, return a handle for wait_model(). The engine core's
+        pipeline-parallel batch queue dispatches several of these before
+        waiting on the oldest (reference: core.py:242
+        step_with_batch_queue); requests in a dispatched batch are
+        excluded from scheduling until their batch retires."""
         self._update_states(scheduler_output)
         if scheduler_output.total_num_scheduled_tokens == 0:
             # Nothing to run, but async KV transfers may need servicing:
@@ -484,12 +647,12 @@ class TPUModelRunner:
             # gpu_model_runner.py kv_connector_no_forward path).
             out = ModelRunnerOutput()
             self._poll_kv_connector(scheduler_output, out)
-            return out
+            return {"ready": out}
         if scheduler_output.multi_step > 1:
-            return self._execute_multi_step(scheduler_output)
+            return {"ready": self._execute_multi_step(scheduler_output)}
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R, drafts_arr, ext_md, want_topk) = \
+         fwd_shape, R, drafts_arr, ext_md, want_topk, vocab_mask) = \
             self._prepare_inputs(scheduler_output)
 
         kv_meta = scheduler_output.kv_connector_metadata
@@ -498,9 +661,25 @@ class TPUModelRunner:
             # (reference: maybe_setup_kv_connector/start_load_kv).
             self.kv_connector.start_load_kv(kv_meta, self)
 
-        tokens_np, logprobs_np, topk_np = self._run_device_step(
-            token_ids, batch, logits_indices, sampling_md, fwd_shape,
-            ext_md, want_topk)
+        dev = self._launch_device_step(token_ids, batch, logits_indices,
+                                       sampling_md, fwd_shape, ext_md,
+                                       want_topk, vocab_mask)
+        return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
+                "sampling_req_ids": sampling_req_ids,
+                "drafts_arr": drafts_arr, "R": R}
+
+    def wait_model(self, handle: dict) -> ModelRunnerOutput:
+        """Blocking half: fetch the sampled tokens, fold them into the
+        persistent batch, build the runner output."""
+        if "ready" in handle:
+            return handle["ready"]
+        scheduler_output = handle["so"]
+        kv_meta = handle["kv_meta"]
+        sampling_req_ids = handle["sampling_req_ids"]
+        drafts_arr = handle["drafts_arr"]
+        R = handle["R"]
+
+        tokens_np, logprobs_np, topk_np = self._fetch_sample(handle["dev"])
 
         if self.kv_connector is not None and kv_meta is not None:
             # The forward wrote this step's KV; persist producer pages
@@ -584,38 +763,54 @@ class TPUModelRunner:
             out.finished_recving = recving
             out.failed_recving = failed
 
-    def _run_device_step(self, token_ids, batch, logits_indices,
-                         sampling_md, fwd_shape, ext_md, want_topk):
-        """The device part of one step: forward + row gather + sampling.
-        Returns host numpy (tokens, logprobs, topk or None). The
+    def _launch_device_step(self, token_ids, batch, logits_indices,
+                            sampling_md, fwd_shape, ext_md, want_topk,
+                            vocab_mask=None):
+        """Enqueue one step's device work WITHOUT blocking: JAX dispatch
+        is asynchronous, so the host returns as soon as the programs are
+        queued. The pipeline-parallel engine core exploits this to keep
+        several microbatches in flight (its batch queue blocks only on
+        the oldest, reference core.py:242 step_with_batch_queue); the
         pipeline-parallel runner overrides only the forward half."""
         with self.mesh:
             with self._compile_watch(("fwd", ) + fwd_shape):
                 self.kv_caches, hidden = self._forward_fn(
                     self.params, self.kv_caches, token_ids, batch)
-            return self._run_sample(hidden, logits_indices, sampling_md,
-                                    ext_md, want_topk, self.mesh)
+            return self._launch_sample(hidden, logits_indices, sampling_md,
+                                       ext_md, want_topk, self.mesh,
+                                       vocab_mask)
 
-    def _run_sample(self, hidden, logits_indices, sampling_md, ext_md,
-                    want_topk, mesh):
-        """Row gather + (extended) sampling on ``mesh``; shared by the
-        single-program and pipeline-parallel step paths."""
+    def _launch_sample(self, hidden, logits_indices, sampling_md, ext_md,
+                       want_topk, mesh, vocab_mask=None):
+        """Row gather + (extended) sampling on ``mesh``, dispatch only;
+        shared by the single-program and pipeline-parallel step paths.
+        Returns device arrays (tokens, logprobs, (topv, topi) | None)."""
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
-        topk_np = None
+        topk_dev = None
         hidden_sel = self._gather_sample_rows(hidden, logits_indices,
                                               mesh=mesh)
         if ext_md is not None:
-            with self._compile_watch(("sampleX", n_rows, want_topk)):
+            with self._compile_watch(("sampleX", n_rows, want_topk,
+                                      vocab_mask is not None)):
                 tokens, logprobs, topv, topi = self._sample_ext_fn(
                     self.params, hidden_sel, sampling_md, ext_md,
-                    want_topk)
+                    want_topk, vocab_mask)
             if want_topk:
-                topk_np = (np.asarray(jax.device_get(topv)),
-                           np.asarray(jax.device_get(topi)))
+                topk_dev = (topv, topi)
         else:
             with self._compile_watch(("sample", n_rows)):
                 tokens, logprobs = self._sample_fn(
                     self.params, hidden_sel, sampling_md)
+        return tokens, logprobs, topk_dev
+
+    @staticmethod
+    def _fetch_sample(dev):
+        """Blocking half: device arrays -> host numpy."""
+        tokens, logprobs, topk_dev = dev
+        topk_np = None
+        if topk_dev is not None:
+            topk_np = (np.asarray(jax.device_get(topk_dev[0])),
+                       np.asarray(jax.device_get(topk_dev[1])))
         return (np.asarray(jax.device_get(tokens)),
                 np.asarray(jax.device_get(logprobs)), topk_np)
 
@@ -773,9 +968,26 @@ class TPUModelRunner:
             kv_runs=jnp.zeros((G, 4), jnp.int32),
             num_kv_runs=jnp.zeros((1, ), jnp.int32),
             tknp=tknp,
+            lora=self._dummy_lora_batch(T),
             max_q=max_q,
         )
         return jnp.zeros((T, ), jnp.int32), batch
+
+    def _dummy_lora_batch(self, T: int):
+        """Inert LoRA routing for warm-up (all tokens in slot 0): the
+        compiled graph's pytree must match real steps' when LoRA is on."""
+        if self.lora_manager is None:
+            return None
+        from vllm_distributed_tpu.models.common import LoraBatch
+        S = self.config.lora_config.max_loras + 1
+        gs = np.zeros((S, ), np.int32)
+        gs[0] = T
+        return LoraBatch(
+            order=jnp.arange(T, dtype=jnp.int32),
+            inv=jnp.arange(T, dtype=jnp.int32),
+            group_sizes=jnp.asarray(gs),
+            scaling=jnp.zeros((T, ), jnp.float32),
+        )
 
     def forward_shapes(self) -> set[tuple[int, int, int]]:
         """Every (T, max_q, G) the runner can present: decode shapes (one
@@ -849,12 +1061,16 @@ class TPUModelRunner:
                 bias_vals=jnp.zeros((rows, self._BIAS_BUF), jnp.float32),
                 base_fill=jnp.zeros((rows, ), jnp.float32),
             )
+            mask = jnp.ones((rows, self.model.cfg.vocab_size), jnp.bool_)
             for want_topk in (False, True):
-                with self._compile_watch(("sampleX", rows, want_topk)):
-                    tokens, _, _, _ = self._sample_ext_fn(
-                        self.params, hidden_sel, md, ext, want_topk)
-                jax.block_until_ready(tokens)
-                n += 1
+                for vocab_mask in (None, mask):
+                    with self._compile_watch(("sampleX", rows, want_topk,
+                                              vocab_mask is not None)):
+                        tokens, _, _, _ = self._sample_ext_fn(
+                            self.params, hidden_sel, md, ext, want_topk,
+                            vocab_mask)
+                    jax.block_until_ready(tokens)
+                    n += 1
         return n
 
     def _precompile_multi_step(self, n_steps: int, R: int) -> None:

@@ -133,8 +133,9 @@ class PPModelRunner(TPUModelRunner):
         raise RuntimeError("single-program forward is not used under PP")
 
     # ------------------------------------------------------------------
-    def _run_device_step(self, token_ids, batch, logits_indices,
-                         sampling_md, fwd_shape, ext_md, want_topk):
+    def _launch_device_step(self, token_ids, batch, logits_indices,
+                            sampling_md, fwd_shape, ext_md, want_topk,
+                            vocab_mask=None):
         sm0 = self.stage_meshes[0]
         with global_mesh(sm0), sm0:
             with self._compile_watch(("embed", fwd_shape[0])):
@@ -144,7 +145,11 @@ class PPModelRunner(TPUModelRunner):
             # Activation handoff: ICI/DCN copy to the next stage's
             # sub-mesh (reference analogue: IntermediateTensors
             # send/recv). Replicated over the stage's (token, model)
-            # axes; GSPMD re-partitions inside as needed.
+            # axes; GSPMD re-partitions inside as needed. Dispatch is
+            # async end-to-end: nothing here blocks the host, so when
+            # the engine core keeps multiple batches in flight, stage p
+            # of batch i+1 runs under stage p+1 of batch i (each stage's
+            # KV cache chains only to ITS OWN previous-batch output).
             hidden = jax.device_put(
                 hidden, NamedSharding(sm, PartitionSpec()))
             with global_mesh(sm), sm:
@@ -154,8 +159,9 @@ class PPModelRunner(TPUModelRunner):
                         batch)
         sml = self.stage_meshes[-1]
         with global_mesh(sml), sml:
-            return self._run_sample(hidden, logits_indices, sampling_md,
-                                    ext_md, want_topk, sml)
+            return self._launch_sample(hidden, logits_indices,
+                                       sampling_md, ext_md, want_topk,
+                                       sml, vocab_mask)
 
     # ------------------------------------------------------------------
     def precompile(self) -> None:

@@ -45,6 +45,7 @@ class TPUWorker:
                 jax.config.update("jax_platforms", platform)
             except Exception as e:  # pragma: no cover - jax internals
                 logger.warning("could not pin platform %r: %s", platform, e)
+        self._maybe_init_multihost()
         devices = jax.devices()
         logger.info("devices: %s", devices)
         pc = self.config.parallel_config
@@ -67,6 +68,30 @@ class TPUWorker:
             self.model_runner = PPModelRunner(self.config, self.mesh)
         else:
             self.model_runner = TPUModelRunner(self.config, self.mesh)
+
+    def _maybe_init_multihost(self) -> None:
+        """Join the pod-wide distributed runtime BEFORE any device access
+        (reference boundary: per-rank process bootstrap,
+        multiproc_executor.py:42 / StatelessProcessGroup,
+        distributed/utils.py:138). After this, ``jax.devices()`` spans
+        every host's chips and one SPMD mesh covers the pod; each host
+        runs this same engine program multi-controller style."""
+        pc = self.config.parallel_config
+        if pc.num_hosts <= 1:
+            return
+        # NOTE: jax.process_count() would itself initialize the backend,
+        # which must not happen before jax.distributed.initialize —
+        # consult the distributed runtime's own state instead.
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already joined (e.g. a second engine in-process)
+        logger.info("joining multi-host runtime: rank %d/%d via %s",
+                    pc.host_rank, pc.num_hosts,
+                    pc.coordinator_address or "auto-detect")
+        jax.distributed.initialize(
+            coordinator_address=pc.coordinator_address,
+            num_processes=pc.num_hosts,
+            process_id=pc.host_rank)
 
     def load_model(self) -> None:
         # Every entry point re-asserts this worker's mesh as the global
@@ -128,6 +153,14 @@ class TPUWorker:
                       scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         with global_mesh(self.mesh):
             return self.model_runner.execute_model(scheduler_output)
+
+    def dispatch_model(self, scheduler_output: SchedulerOutput):
+        with global_mesh(self.mesh):
+            return self.model_runner.dispatch_model(scheduler_output)
+
+    def wait_model(self, handle) -> ModelRunnerOutput:
+        with global_mesh(self.mesh):
+            return self.model_runner.wait_model(handle)
 
     def get_stats(self) -> dict:
         return self.model_runner.get_stats()
