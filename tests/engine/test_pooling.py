@@ -1,0 +1,122 @@
+"""Embedding/pooling API: LLM.encode returns the final-norm last-token
+hidden state, matching HF last_hidden_state (model: reference pooling
+models tests over the encode path)."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.outputs import PoolingOutput
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_pool")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+PROMPTS = [[3, 17, 92, 45, 8], [5, 9, 33, 71, 14, 62, 77]]
+
+
+def encode(engine, prompts, tag="e"):
+    for i, p in enumerate(prompts):
+        engine.add_request(f"{tag}-{i}", p,
+                           SamplingParams(temperature=0.0, max_tokens=1),
+                           pooling_params={"type": "last"})
+    done = {}
+    for _ in range(200):
+        for out in engine.step():
+            if isinstance(out, PoolingOutput) or out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    return [done[f"{tag}-{i}"] for i in range(len(prompts))]
+
+
+def test_encode_matches_hf_last_hidden_state(checkpoint):
+    path, hf = checkpoint
+    engine = make_engine(path)
+    outs = encode(engine, PROMPTS)
+    for prompt, out in zip(PROMPTS, outs):
+        assert isinstance(out, PoolingOutput)
+        with torch.no_grad():
+            want = hf.model(torch.tensor([prompt])
+                            ).last_hidden_state[0, -1].numpy()
+        got = np.asarray(out.embedding, np.float32)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_encode_mixes_with_generation(checkpoint):
+    """Pooling and generation requests share one batch."""
+    path, hf = checkpoint
+    engine = make_engine(path)
+    engine.add_request("gen-0", PROMPTS[0],
+                       SamplingParams(temperature=0.0, max_tokens=5,
+                                      ignore_eos=True))
+    engine.add_request("pool-0", PROMPTS[1], SamplingParams(max_tokens=1),
+                       pooling_params={"type": "last"})
+    done = {}
+    for _ in range(200):
+        for out in engine.step():
+            if getattr(out, "finished", True):
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert isinstance(done["pool-0"], PoolingOutput)
+    assert len(done["gen-0"].outputs[0].token_ids) == 5
+    with torch.no_grad():
+        want = hf.model(torch.tensor([PROMPTS[1]])
+                        ).last_hidden_state[0, -1].numpy()
+    np.testing.assert_allclose(np.asarray(done["pool-0"].embedding),
+                               want, rtol=2e-4, atol=2e-4)
+
+
+def test_llm_encode_api(checkpoint):
+    path, _ = checkpoint
+    from vllm_distributed_tpu.entrypoints.llm import LLM
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=64, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=8,
+              skip_tokenizer_init=True)
+    outs = llm.encode(PROMPTS)
+    assert len(outs) == 2
+    assert all(isinstance(o, PoolingOutput) for o in outs)
+    assert all(len(o.embedding) == 64 for o in outs)
+
+
+def test_encode_over_multiprocess_core(checkpoint):
+    """pooling_params must survive the ZMQ request codec (subprocess
+    engine core)."""
+    path, hf = checkpoint
+    engine = make_engine(path, multiprocess_engine_core=True)
+    try:
+        outs = encode(engine, [PROMPTS[0]], tag="mp")
+        assert isinstance(outs[0], PoolingOutput)
+        with torch.no_grad():
+            want = hf.model(torch.tensor([PROMPTS[0]])
+                            ).last_hidden_state[0, -1].numpy()
+        np.testing.assert_allclose(np.asarray(outs[0].embedding), want,
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        engine.shutdown()

@@ -19,3 +19,48 @@ def test_bench_latency_smoke(checkpoint, capsys):
     result = json.loads(out)
     assert result["generated_tokens"] == 8
     assert result["tokens_per_s"] > 0
+
+
+def test_run_batch_completions(tmp_path, capsys):
+    """run-batch processes an OpenAI batch JSONL offline (reference:
+    entrypoints/openai/run_batch.py)."""
+    from tests.entrypoints.test_openai_server import \
+        _save_checkpoint_with_tokenizer
+    path = str(tmp_path / "model")
+    _save_checkpoint_with_tokenizer(path)
+
+    inp = tmp_path / "batch.jsonl"
+    out_path = tmp_path / "out.jsonl"
+    reqs = [
+        {"custom_id": "a", "method": "POST", "url": "/v1/completions",
+         "body": {"model": "tiny", "prompt": "w3 w17 w92",
+                  "max_tokens": 4, "temperature": 0.0,
+                  "ignore_eos": True}},
+        {"custom_id": "b", "method": "POST", "url": "/v1/completions",
+         "body": {"model": "tiny", "prompt": "w5 w6",
+                  "max_tokens": 3, "temperature": 0.0,
+                  "ignore_eos": True}},
+    ]
+    inp.write_text("\n".join(json.dumps(r) for r in reqs) + "\n")
+
+    rc = main(["run-batch", "-i", str(inp), "-o", str(out_path),
+               "--model", path, "--dtype", "float32", "--block-size", "4",
+               "--num-gpu-blocks-override", "128", "--max-model-len", "64",
+               "--max-num-batched-tokens", "64", "--max-num-seqs", "8"])
+    assert rc == 0
+    lines = [json.loads(line)
+             for line in out_path.read_text().splitlines()]
+    assert [r["custom_id"] for r in lines] == ["a", "b"]
+    for rec, want_tokens in zip(lines, (4, 3)):
+        assert rec["error"] is None
+        body = rec["response"]["body"]
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == want_tokens
+        assert body["choices"][0]["text"].strip()
+
+
+def test_collect_env_smoke(capsys):
+    rc = main(["collect-env"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["jax"] and info["framework_version"]

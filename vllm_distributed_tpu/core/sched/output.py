@@ -23,6 +23,8 @@ class NewRequestData:
     num_computed_tokens: int
     # Multi-LoRA adapter selection ({"name", "path"}; see models/lora.py).
     lora_request: "dict | None" = None
+    # Pooling/embedding request marker ({"type": "last"}).
+    pooling_params: "dict | None" = None
 
 
 @dataclass
@@ -107,6 +109,9 @@ class ModelRunnerOutput:
     # re-queues these requests for LOCAL prefill of the span instead of
     # marking never-written pages computed.
     failed_recving: Optional[set[str]] = None
+    # Pooled hidden states for embedding requests that completed their
+    # prompt this step: req_id -> list[float].
+    pooled: Optional[dict[str, list[float]]] = None
 
 
 EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()

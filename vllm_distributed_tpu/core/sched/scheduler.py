@@ -36,14 +36,16 @@ class EngineCoreOutput:
     (reference: v1/engine/__init__.py EngineCoreOutput)."""
 
     __slots__ = ("req_id", "new_token_ids", "finish_reason", "stop_reason",
-                 "num_cached_tokens", "logprobs", "kv_transfer_params")
+                 "num_cached_tokens", "logprobs", "kv_transfer_params",
+                 "pooled")
 
     def __init__(self, req_id: str, new_token_ids: list[int],
                  finish_reason: Optional[str] = None,
                  stop_reason: Optional[int | str] = None,
                  num_cached_tokens: int = 0,
                  logprobs: Optional[list[dict[int, float]]] = None,
-                 kv_transfer_params: Optional[dict] = None) -> None:
+                 kv_transfer_params: Optional[dict] = None,
+                 pooled: Optional[list[float]] = None) -> None:
         self.req_id = req_id
         self.new_token_ids = new_token_ids
         self.finish_reason = finish_reason
@@ -53,6 +55,9 @@ class EngineCoreOutput:
         # Producer handoff coordinates on the final output (disagg;
         # reference: v1/engine/__init__.py EngineCoreOutput).
         self.kv_transfer_params = kv_transfer_params
+        # Embedding result for pooling requests (reference: pooling
+        # outputs on the core output path, v1/outputs.py).
+        self.pooled = pooled
 
     @property
     def finished(self) -> bool:
@@ -527,6 +532,7 @@ class Scheduler:
                             block_ids=all_block_ids,
                             num_computed_tokens=num_computed_tokens,
                             lora_request=request.lora_request,
+                            pooling_params=request.pooling_params,
                         ))
 
         self.num_scheduled_steps += 1
@@ -692,6 +698,7 @@ class Scheduler:
                 self.finish_requests(req_id,
                                      self._deferred_finishes.pop(req_id))
 
+        pooled_map = runner_output.pooled or {}
         outputs: list[EngineCoreOutput] = []
         finished: list[Request] = []
         for request in self.running:
@@ -699,6 +706,18 @@ class Scheduler:
             if req_id not in num_scheduled:
                 continue
             scheduled = num_scheduled[req_id]
+            if req_id in pooled_map:
+                # Embedding request: the prompt finished this step; the
+                # pooled hidden state IS the result (no sampling).
+                request.num_computed_tokens += scheduled
+                request.status = RequestStatus.FINISHED_STOPPED
+                finished.append(request)
+                outputs.append(EngineCoreOutput(
+                    req_id=req_id, new_token_ids=[],
+                    finish_reason=request.get_finished_reason(),
+                    num_cached_tokens=max(request.num_cached_tokens, 0),
+                    pooled=pooled_map[req_id]))
+                continue
             if scheduler_output.multi_step > 1:
                 # The worker computed KV for one token per fused step.
                 scheduled = scheduler_output.multi_step

@@ -61,12 +61,13 @@ class LLMEngine:
         priority: int = 0,
         kv_transfer_params: Optional[dict] = None,
         lora_request: Optional[dict] = None,
+        pooling_params: Optional[dict] = None,
     ) -> None:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
             kv_transfer_params=kv_transfer_params,
-            lora_request=lora_request)
+            lora_request=lora_request, pooling_params=pooling_params)
         self.output_processor.add_request(
             core_req, prompt=prompt if isinstance(prompt, str) else None)
         self.engine_core.add_request(core_req)

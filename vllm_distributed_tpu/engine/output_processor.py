@@ -12,7 +12,9 @@ from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
 from vllm_distributed_tpu.engine.detokenizer import IncrementalDetokenizer
 from vllm_distributed_tpu.metrics.stats import RequestTimes
-from vllm_distributed_tpu.outputs import CompletionOutput, RequestOutput
+from vllm_distributed_tpu.outputs import (CompletionOutput,
+                                          PoolingOutput,
+                                          RequestOutput)
 from vllm_distributed_tpu.request import EngineCoreRequest
 from vllm_distributed_tpu.sampling_params import SamplingParams
 
@@ -90,6 +92,14 @@ class OutputProcessor:
             state = self.request_states.get(out.req_id)
             if state is None:
                 continue  # aborted while output was in flight
+            if out.pooled is not None:
+                # Embedding request: one terminal pooled result.
+                self.stats.on_finished(state.times,
+                                       len(state.prompt_token_ids))
+                request_outputs.append(PoolingOutput(
+                    request_id=out.req_id, embedding=out.pooled))
+                del self.request_states[out.req_id]
+                continue
             state.output_token_ids.extend(out.new_token_ids)
             if out.new_token_ids:
                 self.stats.on_tokens(state.times, len(out.new_token_ids))

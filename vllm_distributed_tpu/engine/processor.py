@@ -71,6 +71,7 @@ class Processor:
         priority: int = 0,
         kv_transfer_params: Optional[dict] = None,
         lora_request: Optional[dict] = None,
+        pooling_params: Optional[dict] = None,
     ) -> EngineCoreRequest:
         if isinstance(prompt, str):
             assert self.tokenizer is not None, \
@@ -80,6 +81,12 @@ class Processor:
             prompt_token_ids = list(prompt)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if pooling_params is not None:
+            if pooling_params.get("type", "last") != "last":
+                raise ValueError(
+                    "only 'last' pooling is supported (mean pooling "
+                    "needs per-chunk accumulation; not wired yet)")
+            pooling_params = {"type": "last"}
         if lora_request is not None:
             if not self.config.lora_config.enable_lora:
                 raise ValueError(
@@ -116,4 +123,5 @@ class Processor:
             priority=priority,
             kv_transfer_params=kv_transfer_params,
             lora_request=lora_request,
+            pooling_params=pooling_params,
         )

@@ -39,6 +39,7 @@ def encode_request(req: EngineCoreRequest) -> dict:
         "priority": req.priority,
         "kv_transfer_params": req.kv_transfer_params,
         "lora_request": req.lora_request,
+        "pooling_params": req.pooling_params,
     }
 
 
@@ -52,18 +53,19 @@ def decode_request(d: dict) -> EngineCoreRequest:
         priority=d["priority"],
         kv_transfer_params=d["kv_transfer_params"],
         lora_request=d.get("lora_request"),
+        pooling_params=d.get("pooling_params"),
     )
 
 
 def encode_output(out: EngineCoreOutput) -> list:
     return [out.req_id, out.new_token_ids, out.finish_reason,
             out.stop_reason, out.num_cached_tokens, out.logprobs,
-            out.kv_transfer_params]
+            out.kv_transfer_params, out.pooled]
 
 
 def decode_output(v: list) -> EngineCoreOutput:
     (req_id, new_token_ids, finish_reason, stop_reason, cached, lps,
-     kv_params) = v
+     kv_params, pooled) = v
     return EngineCoreOutput(
         req_id=req_id,
         new_token_ids=list(new_token_ids),
@@ -72,4 +74,5 @@ def decode_output(v: list) -> EngineCoreOutput:
         num_cached_tokens=cached,
         logprobs=lps,
         kv_transfer_params=kv_params,
+        pooled=pooled,
     )

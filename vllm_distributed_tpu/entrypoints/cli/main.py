@@ -90,17 +90,152 @@ def cmd_bench(args) -> None:
     print(json.dumps(result))
 
 
+def _add_run_batch(sub) -> None:
+    p = sub.add_parser(
+        "run-batch",
+        help="process an OpenAI batch-API JSONL file offline")
+    p.add_argument("-i", "--input-file", required=True)
+    p.add_argument("-o", "--output-file", required=True)
+    EngineArgs.add_cli_args(p)
+
+
+def cmd_run_batch(args) -> None:
+    """OpenAI batch format: one request per line with
+    {custom_id, method, url, body}; results mirror the batch output
+    shape (reference: entrypoints/openai/run_batch.py)."""
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.entrypoints.openai import protocol
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    engine = LLMEngine(EngineArgs.from_cli_args(args).
+                       create_engine_config())
+    tokenizer = engine.processor.tokenizer
+
+    requests = []
+    with open(args.input_file) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                requests.append(json.loads(line))
+
+    id_to_custom: dict[str, dict] = {}
+    for i, req in enumerate(requests):
+        body = req.get("body", {})
+        url = req.get("url", "/v1/completions")
+        rid = f"batch-{i}"
+        # Any malformed line becomes an error RECORD; the rest of the
+        # batch still runs (OpenAI batch semantics).
+        try:
+            params = protocol.sampling_params_from_request(
+                body, default_max_tokens=64)
+            if url.endswith("/chat/completions"):
+                prompt = tokenizer.apply_chat_template(
+                    body["messages"], tokenize=False,
+                    add_generation_prompt=True)
+            else:
+                prompt = body["prompt"]
+            id_to_custom[rid] = {"req": req, "url": url, "error": None}
+            engine.add_request(rid, prompt, params)
+        except Exception as e:  # noqa: BLE001 - per-line error record
+            id_to_custom[rid] = {"req": req, "url": url,
+                                 "error": f"{type(e).__name__}: {e}"}
+
+    results: dict[str, dict] = {}
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if getattr(out, "finished", False):
+                results[out.request_id] = {
+                    "text": out.outputs[0].text,
+                    "token_ids": out.outputs[0].token_ids,
+                    "finish_reason": out.outputs[0].finish_reason,
+                    "prompt_tokens": len(out.prompt_token_ids),
+                }
+
+    with open(args.output_file, "w") as f:
+        for rid, meta in id_to_custom.items():
+            req = meta["req"]
+            if meta["error"] is not None:
+                record = {
+                    "custom_id": req.get("custom_id"),
+                    "response": None,
+                    "error": {"message": meta["error"]},
+                }
+            else:
+                r = results.get(rid, {})
+                completion = len(r.get("token_ids", []))
+                is_chat = meta["url"].endswith("/chat/completions")
+                body = {
+                    "id": (protocol.chat_id() if is_chat
+                           else protocol.completion_id()),
+                    "object": ("chat.completion" if is_chat
+                               else "text_completion"),
+                    "model": args.model,
+                    "choices": [{
+                        "index": 0,
+                        "finish_reason": r.get("finish_reason"),
+                        **({"message": {"role": "assistant",
+                                        "content": r.get("text", "")}}
+                           if is_chat else {"text": r.get("text", "")}),
+                    }],
+                    "usage": protocol.usage(r.get("prompt_tokens", 0),
+                                            completion),
+                }
+                record = {
+                    "custom_id": req.get("custom_id"),
+                    "response": {"status_code": 200, "body": body},
+                    "error": None,
+                }
+            f.write(json.dumps(record) + "\n")
+    print(f"wrote {len(id_to_custom)} results to {args.output_file}")
+
+
+def cmd_collect_env(_args) -> None:
+    """Environment report (reference: vllm collect-env CLI)."""
+    import platform
+
+    import jax
+
+    import vllm_distributed_tpu
+    info = {
+        "framework_version": vllm_distributed_tpu.__version__,
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "jax": jax.__version__,
+        "default_backend": None,
+        "devices": None,
+    }
+    try:
+        info["default_backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001 - report, don't crash
+        info["devices_error"] = str(e)
+    for mod in ("flax", "optax", "orbax.checkpoint", "transformers",
+                "numpy", "zmq", "msgpack"):
+        try:
+            import importlib
+            info[mod] = importlib.import_module(mod).__version__
+        except Exception:  # noqa: BLE001
+            info[mod] = None
+    print(json.dumps(info, indent=2))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="vdt",
                                      description="vllm-distributed-tpu CLI")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_serve(sub)
     _add_bench(sub)
+    _add_run_batch(sub)
+    sub.add_parser("collect-env", help="print environment/debug info")
     args = parser.parse_args(argv)
     if args.command == "serve":
         cmd_serve(args)
     elif args.command == "bench":
         cmd_bench(args)
+    elif args.command == "run-batch":
+        cmd_run_batch(args)
+    elif args.command == "collect-env":
+        cmd_collect_env(args)
     return 0
 
 

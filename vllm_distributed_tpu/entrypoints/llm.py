@@ -51,6 +51,26 @@ class LLM:
         by_id = {out.request_id: out for out in outputs}
         return [by_id[rid] for rid in request_ids]
 
+    def encode(self, prompts) -> list:
+        """Embedding API: pooled last-position hidden state per prompt
+        (reference: entrypoints/llm.py LLM.encode -> PoolingOutput)."""
+        from vllm_distributed_tpu.sampling_params import SamplingParams
+        if isinstance(prompts, (str, )) or (isinstance(prompts, list)
+                                            and prompts
+                                            and isinstance(prompts[0], int)):
+            prompts = [prompts]
+        request_ids = []
+        for prompt in prompts:
+            request_id = str(next(self.request_counter))
+            self.llm_engine.add_request(
+                request_id, prompt,
+                SamplingParams(temperature=0.0, max_tokens=1),
+                pooling_params={"type": "last"})
+            request_ids.append(request_id)
+        outputs = self._run_engine()
+        by_id = {out.request_id: out for out in outputs}
+        return [by_id[rid] for rid in request_ids]
+
     def chat(self, messages, sampling_params=None) -> list[RequestOutput]:
         tokenizer = self.get_tokenizer()
         assert tokenizer is not None, "chat requires a tokenizer"

@@ -83,6 +83,15 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (reference: VLLM_TORCH_PROFILER_DIR).
     "VDT_PROFILER_DIR":
     lambda: os.getenv("VDT_PROFILER_DIR", "/tmp/vdt_profile"),
+    # Cascade (shared-prefix) attention on the XLA path: "1" enables the
+    # detection + split; opt-in because it adds a second compiled
+    # forward variant per shape bucket.
+    "VDT_CASCADE_ATTENTION":
+    lambda: os.getenv("VDT_CASCADE_ATTENTION", "0") == "1",
+    # Page count of the dense shared phase (cascade fires only when the
+    # batch-wide common prefix covers at least this many pages).
+    "VDT_CASCADE_SHARED_PAGES":
+    lambda: int(os.getenv("VDT_CASCADE_SHARED_PAGES", "4")),
     # Disable the usage-stats style telemetry (always disabled by default;
     # kept for CLI parity).
     "VDT_NO_USAGE_STATS":

@@ -87,6 +87,10 @@ class AttentionBatch:
     # sorted by adapter slot, consumed by the grouped-GEMM LoRA apply
     # (models/lora.py; the TPU answer to the reference's punica SGMV).
     lora: Optional["LoraBatch"] = None
+    # Cascade attention: page ids of the batch-wide shared prefix
+    # ([S] int32, static S; None disables — see
+    # ops/attention.cascade_ragged_paged_attention).
+    cascade_shared_ids: Optional[jax.Array] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
     # changing it recompiles, like every other shape bucket.
     max_q: int = 1
@@ -192,8 +196,8 @@ def apply_rope(q: jax.Array, k: jax.Array, cos: jax.Array,
 
 
 def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
-           down_w: jax.Array) -> jax.Array:
-    """SiLU-gated MLP (reference: csrc/activation_kernels.cu fused
-    silu-mul; XLA fuses the elementwise chain into the matmuls)."""
-    gate = jax.nn.silu(x @ gate_w)
+           down_w: jax.Array, act=jax.nn.silu) -> jax.Array:
+    """Gated MLP (reference: csrc/activation_kernels.cu fused silu-mul /
+    gelu variants; XLA fuses the elementwise chain into the matmuls)."""
+    gate = act(x @ gate_w)
     return (gate * (x @ up_w)) @ down_w

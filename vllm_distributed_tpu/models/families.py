@@ -1,0 +1,75 @@
+"""Llama-shaped model families beyond the base class.
+
+Reference: vllm/model_executor/models/{gemma,qwen3,phi3}.py — each is the
+Llama decoder with a small twist, so each maps to a thin subclass here
+(the registry covers the long tail of HF ``architectures`` strings the
+same way the reference's ~180-entry table does):
+
+* Gemma: sqrt(H)-scaled embeddings, tanh-GELU gated MLP, RMSNorm with a
+  +1 weight offset (folded into the stored weights at load so the
+  forward stays branch-free), tied LM head.
+* Qwen3: per-head RMSNorm on q/k ahead of RoPE.
+* Phi-3: identical math to Llama with FUSED qkv_proj / gate_up_proj
+  checkpoint tensors — a pure name-mapping subclass.
+"""
+
+import math
+
+import numpy as np
+
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+
+
+class GemmaForCausalLM(LlamaForCausalLM):
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.embed_scale = math.sqrt(arch.hidden_size)
+        arch.hidden_act = "gelu_tanh"
+        arch.tie_word_embeddings = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        params = super().params_from_hf_state_dict(tensors)
+        # Gemma's RMSNorm computes x * (1 + w): fold the offset into the
+        # stored weights so rms_norm needs no model-specific branch.
+        layers = params["layers"]
+        for key in ("input_ln", "post_ln"):
+            layers[key] = layers[key] + 1.0
+        params["final_ln"] = params["final_ln"] + 1.0
+        return params
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        # Random init is already offset-free; nothing to fold.
+        return super().init_params(rng, scale)
+
+
+class Qwen3ForCausalLM(LlamaForCausalLM):
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.qk_norm = True
+
+
+class Phi3ForCausalLM(LlamaForCausalLM):
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        """Split Phi-3's fused projections into the base layout."""
+        c = self.cfg
+        Dq = c.num_q_heads * c.head_dim
+        Dkv = c.num_kv_heads * c.head_dim
+        out = dict(tensors)
+        for i in range(c.num_layers):
+            qkv = np.asarray(
+                tensors[f"model.layers.{i}.self_attn.qkv_proj.weight"])
+            out[f"model.layers.{i}.self_attn.q_proj.weight"] = qkv[:Dq]
+            out[f"model.layers.{i}.self_attn.k_proj.weight"] = \
+                qkv[Dq:Dq + Dkv]
+            out[f"model.layers.{i}.self_attn.v_proj.weight"] = \
+                qkv[Dq + Dkv:]
+            gu = np.asarray(
+                tensors[f"model.layers.{i}.mlp.gate_up_proj.weight"])
+            half = gu.shape[0] // 2
+            out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:half]
+            out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[half:]
+        return super().params_from_hf_state_dict(out)

@@ -92,6 +92,12 @@ class LlamaArchConfig:
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
+    # Family knobs reused by Llama-shaped variants: embedding scale
+    # (Gemma multiplies by sqrt(H)), MLP activation, per-head q/k
+    # RMSNorm (Qwen3).
+    embed_scale: float = 1.0
+    hidden_act: str = "silu"  # silu | gelu_tanh
+    qk_norm: bool = False
     dtype: Any = jnp.bfloat16
 
     @property
@@ -136,6 +142,11 @@ class LlamaForCausalLM:
 
     def __init__(self, cfg: LlamaArchConfig) -> None:
         self.cfg = cfg
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        """Family-specific arch-config tweaks, applied by the loader
+        after the generic from_hf_config mapping (subclass hook)."""
 
     # ------------------------------------------------------------------
     # Quantization (w8a16)
@@ -195,6 +206,11 @@ class LlamaForCausalLM:
                 "bq": P(None, MODEL_AXIS),
                 "bk": P(None, MODEL_AXIS),
                 "bv": P(None, MODEL_AXIS),
+            })
+        if c.qk_norm:
+            layer.update({
+                "q_norm": P(None, None),
+                "k_norm": P(None, None),
             })
         self._add_scale_specs(layer)
         self._add_lora_specs(layer)
@@ -290,6 +306,11 @@ class LlamaForCausalLM:
                 "bk": jnp.zeros((L, Dkv), c.dtype),
                 "bv": jnp.zeros((L, Dkv), c.dtype),
             })
+        if c.qk_norm:
+            layers.update({
+                "q_norm": jnp.ones((L, c.head_dim), c.dtype),
+                "k_norm": jnp.ones((L, c.head_dim), c.dtype),
+            })
         self._maybe_replicate_kv(layers)
         self._install_lora_buffers(layers)
         embed = norm(next(keys), (c.vocab_size, H))
@@ -373,6 +394,13 @@ class LlamaForCausalLM:
                 "bv": stack("model.layers.{}.self_attn.v_proj.bias",
                             transpose=False),
             })
+        if c.qk_norm:
+            layers.update({
+                "q_norm": stack("model.layers.{}.self_attn.q_norm.weight",
+                                transpose=False),
+                "k_norm": stack("model.layers.{}.self_attn.k_norm.weight",
+                                transpose=False),
+            })
         self._maybe_replicate_kv(layers)
         embed = jnp.asarray(t("model.embed_tokens.weight"), dtype=c.dtype)
         if c.tie_word_embeddings or "lm_head.weight" not in tensors:
@@ -390,15 +418,20 @@ class LlamaForCausalLM:
     # ------------------------------------------------------------------
     # Forward
     # ------------------------------------------------------------------
+    def _act(self, x: jax.Array) -> jax.Array:
+        if self.cfg.hidden_act == "gelu_tanh":
+            return jax.nn.gelu(x, approximate=True)
+        return jax.nn.silu(x)
+
     def mlp_block(self, lp: dict, x: jax.Array,
                   lora_ctx=None) -> jax.Array:
         """Per-layer feed-forward; MoE models override this (the MLP is
         the only structural difference in the decoder block)."""
         if lora_ctx is None or ("gate_a") not in lp:
             return swiglu(x, self._w(lp, "gate"), self._w(lp, "up"),
-                          self._w(lp, "down"))
-        g = jax.nn.silu(x @ self._w(lp, "gate") +
-                        self._lora_delta(lp, "gate", x, lora_ctx))
+                          self._w(lp, "down"), act=self._act)
+        g = self._act(x @ self._w(lp, "gate") +
+                      self._lora_delta(lp, "gate", x, lora_ctx))
         u = (x @ self._w(lp, "up") +
              self._lora_delta(lp, "up", x, lora_ctx))
         gu = g * u
@@ -408,7 +441,12 @@ class LlamaForCausalLM:
     def embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
         """Token embedding (pipeline stage 0 front; reference: the
         VocabParallelEmbedding layer)."""
-        return params["embed"][token_ids]
+        h = params["embed"][token_ids]
+        if self.cfg.embed_scale != 1.0:
+            # Gemma normalizer semantics: the scale is cast to the
+            # activation dtype before multiplying (HF parity).
+            h = h * jnp.asarray(self.cfg.embed_scale, h.dtype)
+        return h
 
     def run_layers(
         self,
@@ -457,6 +495,10 @@ class LlamaForCausalLM:
                 v = v + lp["bv"]
             q = q.reshape(T, c.num_q_heads, c.head_dim)
             k = k.reshape(T, c.total_kv_heads, c.head_dim)
+            if c.qk_norm:
+                # Qwen3-style per-head RMSNorm ahead of RoPE.
+                q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+                k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
             v = v.reshape(T, c.total_kv_heads, c.head_dim)
             # RoPE in fp32 for parity with the HF reference, then back.
             q, k = apply_rope(q.astype(jnp.float32), k.astype(jnp.float32),

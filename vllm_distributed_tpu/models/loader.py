@@ -63,6 +63,7 @@ def get_model(config: EngineConfig, mesh,
     model_cls = resolve_architecture(hf_config)
     dtype = _dtype_from_str(config.model_config.dtype)
     arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
+    model_cls.configure_arch(arch, hf_config)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
     arch.quantization = config.model_config.quantization
     if arch.num_experts and config.parallel_config.num_redundant_experts:

@@ -6,6 +6,9 @@ a pre-norm RoPE decoder with SwiGLU MLP and optional QKV bias — which is
 Llama 2/3, Mistral, Qwen2, and friends.
 """
 
+from vllm_distributed_tpu.models.families import (GemmaForCausalLM,
+                                                  Phi3ForCausalLM,
+                                                  Qwen3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
@@ -14,7 +17,13 @@ _REGISTRY: dict[str, type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
     "MistralForCausalLM": LlamaForCausalLM,
     "Qwen2ForCausalLM": LlamaForCausalLM,
+    # Llama-weight-compatible forks (identical tensor naming + math).
+    "AquilaForCausalLM": LlamaForCausalLM,
+    "YiForCausalLM": LlamaForCausalLM,
     "MixtralForCausalLM": MixtralForCausalLM,
+    "GemmaForCausalLM": GemmaForCausalLM,
+    "Qwen3ForCausalLM": Qwen3ForCausalLM,
+    "Phi3ForCausalLM": Phi3ForCausalLM,
 }
 
 

@@ -146,6 +146,102 @@ def ragged_paged_attention(
     return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
 
 
+def merge_attention_states(state_a, state_b):
+    """Combine two online-softmax partial states (m, l, acc) over
+    disjoint KV ranges — the XLA equivalent of the reference's
+    csrc/attention/merge_attn_states.cu (used there for cascade and
+    split-KV attention)."""
+    m_a, l_a, acc_a = state_a
+    m_b, l_b, acc_b = state_b
+    m = jnp.maximum(m_a, m_b)
+    alpha_a = jnp.exp(m_a - m)
+    alpha_b = jnp.exp(m_b - m)
+    l = l_a * alpha_a + l_b * alpha_b
+    acc = acc_a * alpha_a + acc_b * alpha_b
+    return m, l, acc
+
+
+def cascade_ragged_paged_attention(
+    q: jax.Array,  # [T, num_q_heads, head_dim]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [max_reqs, pages_per_req]
+    req_idx: jax.Array,  # [T]
+    q_pos: jax.Array,  # [T]
+    shared_page_ids: jax.Array,  # [S] int32: batch-wide common prefix
+    *,
+    sm_scale: float,
+) -> jax.Array:
+    """Shared-prefix (cascade) attention: every scheduled request's
+    first S page-table slots hold the SAME pages (prefix-cache hits), so
+    their KV is loaded ONCE and attended as a dense block for all T
+    query tokens — one gather and one MXU-friendly matmul instead of T
+    per-token page gathers (reference: the cascade path of
+    v1/attention/backends/flash_attn.py + merge_attn_states.cu). The
+    remaining per-request suffix runs the normal online-softmax page
+    scan over a STATICALLY shortened slot range, and the two partial
+    states merge exactly."""
+    T, num_q_heads, head_dim = q.shape
+    num_pages, num_kv_heads, page_size, _ = k_pages.shape
+    group = num_q_heads // num_kv_heads
+    S = shared_page_ids.shape[0]
+    pages_per_req = block_tables.shape[1]
+
+    qg = (q.reshape(T, num_kv_heads, group, head_dim)
+          .astype(jnp.float32) * sm_scale)
+
+    # ---- shared phase: dense attention over the common S pages ----
+    k_sh = k_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
+    v_sh = v_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
+    # [T, Hkv, G, S, ps]
+    scores = jnp.einsum("thgd,shpd->thgsp", qg, k_sh)
+    kv_pos = (jnp.arange(S, dtype=jnp.int32)[:, None] * page_size +
+              jnp.arange(page_size, dtype=jnp.int32)[None, :])
+    valid = kv_pos.reshape(-1)[None, :] <= q_pos[:, None]  # [T, S*ps]
+    scores = scores.reshape(T, num_kv_heads, group, S * page_size)
+    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+    m_sh = scores.max(axis=-1, keepdims=True)
+    p = jnp.exp(scores - m_sh)
+    l_sh = p.sum(axis=-1, keepdims=True)
+    acc_sh = jnp.einsum(
+        "thgj,thjd->thgd", p,
+        jnp.broadcast_to(
+            v_sh.swapaxes(0, 1).reshape(1, num_kv_heads,
+                                        S * page_size, head_dim),
+            (T, num_kv_heads, S * page_size, head_dim)))
+
+    # ---- suffix phase: the usual scan, slots [S, pages_per_req) ----
+    token_pages = block_tables[req_idx]
+
+    def body(carry, page_i):
+        m, l, acc = carry
+        page_ids = token_pages[:, page_i]
+        k_blk = k_pages[page_ids, ..., :head_dim].astype(jnp.float32)
+        v_blk = v_pages[page_ids, ..., :head_dim].astype(jnp.float32)
+        s = jnp.einsum("thgd,thpd->thgp", qg, k_blk)
+        pos = page_i * page_size + jnp.arange(page_size, dtype=jnp.int32)
+        ok = pos[None, :] <= q_pos[:, None]
+        s = jnp.where(ok[:, None, None, :], s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        pj = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + pj.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("thgp,thpd->thgd", pj, v_blk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((T, num_kv_heads, group, 1), _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((T, num_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((T, num_kv_heads, group, head_dim), jnp.float32)
+    (m_sf, l_sf, acc_sf), _ = jax.lax.scan(
+        body, (m0, l0, acc0),
+        jnp.arange(S, pages_per_req, dtype=jnp.int32))
+
+    _, l, acc = merge_attention_states((m_sh, l_sh, acc_sh),
+                                       (m_sf, l_sf, acc_sf))
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
+
+
 def naive_ragged_attention(
     q: jax.Array,
     k_pages: jax.Array,
@@ -437,6 +533,11 @@ def paged_attention(
         v_layer = v_pages[layer[0]]
     else:
         k_layer, v_layer = k_pages, v_pages
+    if getattr(batch, "cascade_shared_ids", None) is not None:
+        return cascade_ragged_paged_attention(
+            q, k_layer, v_layer, batch.block_tables, batch.req_idx,
+            batch.positions, batch.cascade_shared_ids,
+            sm_scale=sm_scale)
     return ragged_paged_attention(q, k_layer, v_layer, batch.block_tables,
                                   batch.req_idx, batch.positions,
                                   sm_scale=sm_scale)

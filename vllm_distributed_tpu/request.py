@@ -64,6 +64,9 @@ class EngineCoreRequest:
     # Multi-LoRA: {"name": ..., "path": ...} selecting the adapter
     # (reference: LoRARequest on add_request, vllm/lora/request.py).
     lora_request: Optional[dict[str, str]] = None
+    # Embedding/pooling request: {"type": "last"} (reference:
+    # vllm/pooling_params.py; pooled hidden state instead of sampling).
+    pooling_params: Optional[dict[str, Any]] = None
 
 
 class Request:
@@ -79,6 +82,7 @@ class Request:
         priority: int = 0,
         kv_transfer_params: Optional[dict[str, Any]] = None,
         lora_request: Optional[dict[str, str]] = None,
+        pooling_params: Optional[dict[str, Any]] = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -92,6 +96,7 @@ class Request:
         self.priority = priority
         self.kv_transfer_params = kv_transfer_params
         self.lora_request = lora_request
+        self.pooling_params = pooling_params
 
         self.status = RequestStatus.WAITING
         self.stop_reason: Optional[int | str] = None
@@ -134,6 +139,7 @@ class Request:
             priority=req.priority,
             kv_transfer_params=req.kv_transfer_params,
             lora_request=req.lora_request,
+            pooling_params=req.pooling_params,
         )
 
     # ------------------------------------------------------------------

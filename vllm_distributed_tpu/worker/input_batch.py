@@ -51,6 +51,8 @@ class InputBatch:
         self.needs_extended = np.zeros((R, ), np.bool_)
         # Multi-LoRA adapter slot per row (0 = no adapter).
         self.lora_slot = np.zeros((R, ), np.int32)
+        # Pooling type per row (None = generation request).
+        self.pooling: list = [None] * R
         # Sparse per-row python state (lowered to fixed [R, B] arrays in
         # the runner only when a batch contains extended rows).
         self.logit_bias: list[Optional[dict[int, float]]] = [None] * R
@@ -99,6 +101,8 @@ class InputBatch:
         self.prompt_len[row] = n
         self.needs_extended[row] = sp.needs_extended_static
         self.lora_slot[row] = 0  # runner sets after adapter resolution
+        self.pooling[row] = (data.pooling_params or {}).get("type") \
+            if data.pooling_params else None
         self.logit_bias[row] = sp.logit_bias
         self.allowed_token_ids[row] = sp.allowed_token_ids
         self.stop_token_ids[row] = tuple(sp.all_stop_token_ids)
@@ -154,6 +158,7 @@ class InputBatch:
         self.block_table[row, :] = 0
         self.needs_extended[row] = False
         self.lora_slot[row] = 0
+        self.pooling[row] = None
         self.num_logprobs[row] = 0
         self.min_tokens[row] = 0
         self.presence_penalty[row] = 0.0

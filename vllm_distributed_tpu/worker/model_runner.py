@@ -30,6 +30,7 @@ from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.models.common import (AttentionBatch,
                                                 TknpAttentionBatch)
+from vllm_distributed_tpu.ops.attention import resolve_attention_backend
 from vllm_distributed_tpu.sample.metadata import (ExtendedSamplingMetadata,
                                                   SamplingMetadata)
 from vllm_distributed_tpu.sample.sampler import (MAX_LOGPROBS, sample_tokens,
@@ -115,6 +116,8 @@ class TPUModelRunner:
         self.spec_num_drafts = 0
         self.spec_num_draft_tokens = 0
         self.spec_num_accepted_tokens = 0
+        # Steps that took the cascade (shared-prefix) attention path.
+        self.cascade_steps = 0
         # Shapes warmed by precompile(); execute-time compiles outside this
         # set are recompile-guard violations (reference:
         # tpu_model_runner.py:318 _update_num_xla_graphs).
@@ -540,6 +543,7 @@ class TPUModelRunner:
                 kv_runs=jnp.asarray(tk_kv_runs),
                 num_kv_runs=jnp.asarray(tk_num_kv_runs),
             )
+        cascade_ids = self._detect_cascade(scheduler_output)
         lora_ctx = None
         if self.lora_manager is not None:
             # Token -> adapter-slot grouping, shared by every LoRA
@@ -569,6 +573,7 @@ class TPUModelRunner:
             num_kv_runs=jnp.asarray([len(kv_runs)], np.int32),
             tknp=tknp,
             lora=lora_ctx,
+            cascade_shared_ids=cascade_ids,
             max_q=max_q,
         )
         return (jnp.asarray(token_ids), batch,
@@ -681,6 +686,37 @@ class TPUModelRunner:
 
         tokens_np, logprobs_np, topk_np = self._fetch_sample(handle["dev"])
 
+        # Embedding requests: the pooled hidden state of the sampled row
+        # is the result; no token is emitted (reference: pooling path of
+        # the runner, v1/pool/). "last" pooling = the final prompt
+        # position's hidden state, exact under chunked prefill too.
+        pooled: dict[str, list[float]] = {}
+        pool_rows = [
+            (i, rid)
+            for i, rid in enumerate(handle["sampling_req_ids"])
+            if self.input_batch.pooling[
+                self.input_batch.req_id_to_index[rid]] is not None
+        ]
+        if pool_rows:
+            S1 = self.spec_k + 1
+            hidden_sel = handle["dev"][3]
+            # Final-norm the pooled vectors so they match HF
+            # last_hidden_state semantics (the model applies model.norm
+            # before returning hidden states). One host transfer for
+            # the weight (cached) and one for all pooled rows.
+            if not hasattr(self, "_final_ln_np"):
+                self._final_ln_np = np.asarray(
+                    jax.device_get(self.params["final_ln"]), np.float32)
+            w = self._final_ln_np
+            eps = self.model.cfg.rms_norm_eps
+            idx = np.asarray([i * S1 for i, _ in pool_rows], np.int32)
+            vecs = np.asarray(jax.device_get(hidden_sel[idx]), np.float32)
+            norms = np.sqrt(np.mean(vecs * vecs, axis=-1,
+                                    keepdims=True) + eps)
+            normed = vecs / norms * w
+            for (_, rid), vec in zip(pool_rows, normed):
+                pooled[rid] = [float(x) for x in vec]
+
         if self.kv_connector is not None and kv_meta is not None:
             # The forward wrote this step's KV; persist producer pages
             # (reference: save_kv_layer/wait_for_save, collapsed to one
@@ -708,6 +744,12 @@ class TPUModelRunner:
                     self.spec_num_draft_tokens += n_draft
                     self.spec_num_accepted_tokens += int(num_emitted[i] - 1)
             for i, req_id in enumerate(sampling_req_ids):
+                if req_id in pooled:
+                    req_ids.append(req_id)
+                    sampled.append([])
+                    lps.append([])
+                    spec_out.append([])
+                    continue
                 emitted = [int(t) for t in toks[i, :num_emitted[i]]]
                 for tok in emitted:
                     self.input_batch.append_token(req_id, tok)
@@ -722,6 +764,11 @@ class TPUModelRunner:
         else:
             # Record sampled tokens so next step's inputs include them.
             for i, req_id in enumerate(sampling_req_ids):
+                if req_id in pooled:
+                    req_ids.append(req_id)
+                    sampled.append([])
+                    lps.append([])
+                    continue
                 token = int(tokens_np[i])
                 self.input_batch.append_token(req_id, token)
                 req_ids.append(req_id)
@@ -740,9 +787,36 @@ class TPUModelRunner:
         out = ModelRunnerOutput(req_ids=req_ids,
                                 sampled_token_ids=sampled,
                                 logprobs=lps,
-                                spec_token_ids=spec_out)
+                                spec_token_ids=spec_out,
+                                pooled=pooled or None)
         self._poll_kv_connector(scheduler_output, out)
         return out
+
+    def _detect_cascade(self, scheduler_output: SchedulerOutput):
+        """Batch-wide shared-prefix detection for cascade attention
+        (reference: use_cascade_attention, gpu_model_runner.py:1111):
+        fires when EVERY scheduled request's first S page-table slots
+        hold identical page ids (prefix-cache hits make them literally
+        the same pages). Opt-in via VDT_CASCADE_ATTENTION."""
+        from vllm_distributed_tpu import envs
+        if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
+                or self.config.parallel_config.pipeline_parallel_size > 1
+                or resolve_attention_backend() == "pallas"):
+            return None
+        S = envs.VDT_CASCADE_SHARED_PAGES
+        rows = [self.input_batch.req_id_to_index[r]
+                for r in scheduler_output.num_scheduled_tokens]
+        if len(rows) < 2:
+            return None
+        ib = self.input_batch
+        if any(ib.num_blocks[r] < S for r in rows):
+            return None
+        first = ib.block_table[rows[0], :S]
+        for r in rows[1:]:
+            if not np.array_equal(ib.block_table[r, :S], first):
+                return None
+        self.cascade_steps += 1
+        return jnp.asarray(first)
 
     def _poll_kv_connector(self, scheduler_output: SchedulerOutput,
                            out: ModelRunnerOutput) -> None:
@@ -773,7 +847,8 @@ class TPUModelRunner:
         the oldest, reference core.py:242 step_with_batch_queue); the
         pipeline-parallel runner overrides only the forward half."""
         with self.mesh:
-            with self._compile_watch(("fwd", ) + fwd_shape):
+            cascade = batch.cascade_shared_ids is not None
+            with self._compile_watch(("fwd", ) + fwd_shape + (cascade, )):
                 self.kv_caches, hidden = self._forward_fn(
                     self.params, self.kv_caches, token_ids, batch)
             return self._launch_sample(hidden, logits_indices, sampling_md,
@@ -801,12 +876,13 @@ class TPUModelRunner:
             with self._compile_watch(("sample", n_rows)):
                 tokens, logprobs = self._sample_fn(
                     self.params, hidden_sel, sampling_md)
-        return tokens, logprobs, topk_dev
+        # hidden_sel rides along for pooling requests (fetched lazily).
+        return tokens, logprobs, topk_dev, hidden_sel
 
     @staticmethod
     def _fetch_sample(dev):
         """Blocking half: device arrays -> host numpy."""
-        tokens, logprobs, topk_dev = dev
+        tokens, logprobs, topk_dev, _hidden_sel = dev
         topk_np = None
         if topk_dev is not None:
             topk_np = (np.asarray(jax.device_get(topk_dev[0])),
@@ -1011,11 +1087,24 @@ class TPUModelRunner:
         with self.mesh:
             for T, max_q, G in sorted(self.forward_shapes()):
                 token_ids, batch = self._dummy_step_inputs(T, max_q, G)
-                with self._compile_watch(("fwd", T, max_q, G)):
+                with self._compile_watch(("fwd", T, max_q, G, False)):
                     self.kv_caches, hidden = self._forward_fn(
                         self.params, self.kv_caches, token_ids, batch)
                 jax.block_until_ready(hidden)
                 n += 1
+                from vllm_distributed_tpu import envs as _envs
+                if _envs.VDT_CASCADE_ATTENTION:
+                    import dataclasses as _dc
+                    S = _envs.VDT_CASCADE_SHARED_PAGES
+                    cbatch = _dc.replace(
+                        batch,
+                        cascade_shared_ids=jnp.zeros((S, ), jnp.int32))
+                    with self._compile_watch(("fwd", T, max_q, G, True)):
+                        self.kv_caches, hidden = self._forward_fn(
+                            self.params, self.kv_caches, token_ids,
+                            cbatch)
+                    jax.block_until_ready(hidden)
+                    n += 1
             n += self._precompile_samplers(self.mesh)
             n_steps = self.config.scheduler_config.num_scheduler_steps
             if n_steps > 1:
