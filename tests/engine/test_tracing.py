@@ -1,0 +1,54 @@
+"""Request tracing spans (reference: vllm/tracing.py + tests/tracing/):
+one span per finished request with latency/usage attributes, via the
+built-in JSONL exporter."""
+
+import json
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    HFLlama(cfg).eval().save_pretrained(
+        tmp_path_factory.mktemp("tiny_llama_tr"), safe_serialization=True)
+    return str(tmp_path_factory.getbasetemp() / "tiny_llama_tr0")
+
+
+def test_spans_written_per_request(checkpoint, tmp_path):
+    trace_file = str(tmp_path / "spans.jsonl")
+    engine = LLMEngine(EngineArgs(
+        model=checkpoint, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True,
+        otlp_traces_endpoint=f"file://{trace_file}",
+    ).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
+    for i in range(3):
+        engine.add_request(f"t-{i}", [3 + i, 17, 92, 45], sp)
+    for _ in range(200):
+        engine.step()
+        if not engine.has_unfinished_requests():
+            break
+    spans = [json.loads(line) for line in open(trace_file)]
+    assert len(spans) == 3
+    for span in spans:
+        a = span["attributes"]
+        assert a["gen_ai.usage.completion_tokens"] == 5
+        assert a["gen_ai.usage.prompt_tokens"] == 4
+        assert a["gen_ai.latency.time_to_first_token"] > 0
+        assert a["gen_ai.latency.e2e"] >= \
+            a["gen_ai.latency.time_to_first_token"]
+        assert a["gen_ai.response.finish_reason"] == "length"

@@ -283,3 +283,18 @@ def test_logprobs_over_api(server):
     lp = r.json()["choices"][0]["logprobs"]
     assert len(lp["token_logprobs"]) == 3
     assert all(len(d) >= 4 for d in lp["top_logprobs"])
+
+
+def test_embeddings_endpoint(server):
+    """/v1/embeddings over the pooling path (reference:
+    serving_embedding.py)."""
+    base, _ = server
+    r = httpx.post(f"{base}/v1/embeddings", timeout=300, json={
+        "model": "tiny", "input": ["w1 w2 w3", "w4 w5"],
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["object"] == "list"
+    assert len(body["data"]) == 2
+    assert all(len(d["embedding"]) == 64 for d in body["data"])
+    assert body["usage"]["prompt_tokens"] == 5

@@ -138,3 +138,33 @@ def test_kv_both_round_trip(checkpoint, tmp_path):
     assert first == second
     assert worker_connector(engine).num_pages_saved == 2
     assert worker_connector(engine).num_pages_loaded == 2
+
+
+def test_multi_connector_storage_plus_pull(checkpoint, tmp_path):
+    """MultiConnector composes children: the SharedStorage child serves
+    the hit; lifecycle hooks fan out without interference (reference:
+    v1/multi_connector.py)."""
+    storage = str(tmp_path / "kv_multi")
+
+    producer = make_engine(
+        checkpoint, kv_connector="MultiConnector", kv_role="kv_producer",
+        kv_connector_extra_config={
+            "connectors": ["SharedStorageConnector", "DCNPullConnector"],
+            "shared_storage_path": storage, "pull_port": 0,
+        })
+    baseline = run(make_engine(checkpoint), PROMPTS, "base")
+    prod_out = run(producer, PROMPTS, "prod")
+    assert prod_out == baseline
+    assert len(os.listdir(storage)) == 5  # storage child saved pages
+
+    consumer = make_engine(
+        checkpoint, kv_connector="MultiConnector", kv_role="kv_consumer",
+        kv_connector_extra_config={
+            "connectors": ["SharedStorageConnector", "DCNPullConnector"],
+            "shared_storage_path": storage, "pull_port": 0,
+        })
+    cons_out = run(consumer, PROMPTS, "cons")
+    assert cons_out == baseline
+    wc = worker_connector(consumer)
+    # The storage child (first in order) owned the loads.
+    assert wc.children[0].num_pages_loaded == 5

@@ -305,6 +305,7 @@ class Scheduler:
                     sp = request.sampling_params
                     if (request.num_tokens_with_spec -
                             request.num_computed_tokens != 1
+                            or request.pooling_params is not None
                             or request.spec_token_ids
                             or sp.needs_extended_static
                             or request.num_output_tokens < sp.min_tokens

@@ -10,11 +10,13 @@ from vllm_distributed_tpu.distributed.kv_transfer.base import (
 __all__ = ["KVConnectorBase", "KVConnectorRole", "create_kv_connector"]
 
 
-def create_kv_connector(config: EngineConfig,
-                        role: KVConnectorRole) -> Optional[KVConnectorBase]:
+def create_kv_connector(config: EngineConfig, role: KVConnectorRole,
+                        name: Optional[str] = None,
+                        ) -> Optional[KVConnectorBase]:
     """Build the configured connector for one side (scheduler or worker);
-    None when KV transfer is disabled."""
-    name = config.kv_transfer_config.kv_connector
+    None when KV transfer is disabled. ``name`` overrides the configured
+    connector (MultiConnector building its children)."""
+    name = name or config.kv_transfer_config.kv_connector
     if not name:
         return None
     if name == "SharedStorageConnector":
@@ -25,4 +27,8 @@ def create_kv_connector(config: EngineConfig,
         from vllm_distributed_tpu.distributed.kv_transfer.dcn_pull \
             import DCNPullConnector
         return DCNPullConnector(config, role)
+    if name == "MultiConnector":
+        from vllm_distributed_tpu.distributed.kv_transfer \
+            .multi_connector import MultiConnector
+        return MultiConnector(config, role)
     raise ValueError(f"unknown kv connector {name!r}")

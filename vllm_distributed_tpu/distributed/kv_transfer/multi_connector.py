@@ -1,0 +1,113 @@
+"""Compose several KV connectors into one (reference:
+vllm/distributed/kv_transfer/kv_connector/v1/multi_connector.py — e.g. a
+fast local SharedStorage cache in front of the cross-host DCN pull).
+
+Semantics follow the reference: lookups take the FIRST child reporting
+external tokens (that child then owns the request's load lifecycle);
+saves/teardown fan out to every child; async completion sets union."""
+
+from typing import Optional
+
+from vllm_distributed_tpu.distributed.kv_transfer.base import (
+    KVConnectorBase, KVConnectorRole)
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class MultiConnector(KVConnectorBase):
+
+    def __init__(self, config, role: KVConnectorRole) -> None:
+        super().__init__(config, role)
+        from vllm_distributed_tpu.distributed.kv_transfer import \
+            create_kv_connector
+        extra = config.kv_transfer_config.kv_connector_extra_config or {}
+        names = extra.get("connectors")
+        if not names:
+            raise ValueError(
+                "MultiConnector needs kv_connector_extra_config"
+                "['connectors'] = [connector name, ...]")
+        self.children: list[KVConnectorBase] = []
+        for name in names:
+            child = create_kv_connector(config, role, name=name)
+            assert child is not None
+            self.children.append(child)
+        # Scheduler side: which child claimed each request's load.
+        self._owner: dict[str, KVConnectorBase] = {}
+
+    # -- scheduler side -------------------------------------------------
+    @property
+    def kv_manager(self):
+        return getattr(self, "_kv_manager", None)
+
+    @kv_manager.setter
+    def kv_manager(self, mgr) -> None:
+        self._kv_manager = mgr
+        # The base __init__ assigns kv_manager=None before the children
+        # list exists.
+        for child in getattr(self, "children", ()):
+            child.kv_manager = mgr
+
+    def get_num_new_matched_tokens(self, request, num_computed_tokens):
+        for child in self.children:
+            n, load_async = child.get_num_new_matched_tokens(
+                request, num_computed_tokens)
+            if n > 0:
+                self._owner[request.request_id] = child
+                return n, load_async
+        return 0, False
+
+    def update_state_after_alloc(self, request, block_ids,
+                                 num_external_tokens) -> None:
+        owner = self._owner.get(request.request_id)
+        if owner is not None:
+            owner.update_state_after_alloc(request, block_ids,
+                                           num_external_tokens)
+
+    def build_connector_meta(self, scheduler_output):
+        metas = [child.build_connector_meta(scheduler_output)
+                 for child in self.children]
+        for req_id in scheduler_output.finished_req_ids:
+            self._owner.pop(req_id, None)
+        return metas
+
+    def request_finished(self, request, block_ids):
+        defer = False
+        params: Optional[dict] = None
+        for child in self.children:
+            child_defer, child_params = child.request_finished(
+                request, block_ids)
+            defer = defer or child_defer
+            if child_params and params is None:
+                params = child_params
+        return defer, params
+
+    # -- worker side ----------------------------------------------------
+    def start_load_kv(self, metadata, runner) -> None:
+        for child, meta in zip(self.children, metadata or []):
+            if meta is not None:
+                child.start_load_kv(meta, runner)
+
+    def save_kv(self, metadata, runner) -> None:
+        for child, meta in zip(self.children, metadata or []):
+            if meta is not None:
+                child.save_kv(meta, runner)
+
+    def get_finished(self, runner):
+        sending: set[str] = set()
+        recving: set[str] = set()
+        failed: set[str] = set()
+        for child in self.children:
+            s, r, x = child.get_finished(runner)
+            sending |= s
+            recving |= r
+            failed |= x
+        # A request another child already completed must not be failed
+        # by a child that never owned it.
+        failed -= recving
+        return sending, recving, failed
+
+    def shutdown(self) -> None:
+        for child in self.children:
+            if hasattr(child, "shutdown"):
+                child.shutdown()

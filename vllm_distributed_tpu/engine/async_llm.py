@@ -137,6 +137,7 @@ class AsyncLLM:
         request_id: Optional[str] = None,
         priority: int = 0,
         lora_request: Optional[dict] = None,
+        pooling_params: Optional[dict] = None,
     ) -> AsyncGenerator[RequestOutput, None]:
         """Async stream of accumulated RequestOutputs for one request
         (reference: async_llm.py:277)."""
@@ -147,10 +148,9 @@ class AsyncLLM:
             from vllm_distributed_tpu.utils import random_uuid
             request_id = random_uuid()
         sampling_params = sampling_params or SamplingParams()
-        core_req = self.processor.process_inputs(request_id, prompt,
-                                                 sampling_params,
-                                                 priority=priority,
-                                                 lora_request=lora_request)
+        core_req = self.processor.process_inputs(
+            request_id, prompt, sampling_params, priority=priority,
+            lora_request=lora_request, pooling_params=pooling_params)
         queue: asyncio.Queue = asyncio.Queue()
         self.request_queues[request_id] = queue
         self.output_processor.add_request(
@@ -182,6 +182,18 @@ class AsyncLLM:
             q.put_nowait(_ABORTED)
         self.output_processor.abort_requests([request_id])
         self.core.abort_requests([request_id])
+
+    async def encode(self, prompt,
+                     request_id: Optional[str] = None):
+        """Embedding request: returns the terminal PoolingOutput
+        (reference: AsyncLLM.encode)."""
+        async for out in self.generate(
+                prompt, SamplingParams(temperature=0.0, max_tokens=1),
+                request_id=request_id,
+                pooling_params={"type": "last"}):
+            if getattr(out, "finished", True):
+                return out
+        raise RuntimeError("encode stream ended without a result")
 
     async def get_stats(self) -> dict:
         return await self._utility("get_stats")

@@ -55,6 +55,11 @@ class OutputProcessor:
         # path); rendered into /metrics beside the core's stats.
         from vllm_distributed_tpu.metrics.stats import FrontendStats
         self.stats = FrontendStats()
+        # Per-request spans (reference: tracing.py spans emitted from
+        # the output path; gated by otlp_traces_endpoint).
+        from vllm_distributed_tpu.tracing import init_tracer
+        self.tracer = init_tracer(
+            config.observability_config.otlp_traces_endpoint)
 
     def add_request(self, request: EngineCoreRequest,
                     prompt: Optional[str] = None) -> None:
@@ -96,8 +101,13 @@ class OutputProcessor:
                 # Embedding request: one terminal pooled result.
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
+                state.finished = True
+                state.finish_reason = out.finish_reason
+                if self.tracer is not None:
+                    self._emit_span(state)
                 request_outputs.append(PoolingOutput(
-                    request_id=out.req_id, embedding=out.pooled))
+                    request_id=out.req_id, embedding=out.pooled,
+                    num_prompt_tokens=len(state.prompt_token_ids)))
                 del self.request_states[out.req_id]
                 continue
             state.output_token_ids.extend(out.new_token_ids)
@@ -128,6 +138,8 @@ class OutputProcessor:
             if finished:
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
+                if self.tracer is not None:
+                    self._emit_span(state)
                 if state.detokenizer is not None:
                     # Emit any text held back waiting for more context.
                     state.detokenizer.flush()
@@ -136,6 +148,26 @@ class OutputProcessor:
             if finished:
                 del self.request_states[out.req_id]
         return ProcessedOutputs(request_outputs, reqs_to_abort)
+
+    def _emit_span(self, state: RequestState) -> None:
+        import time as _time
+
+        from vllm_distributed_tpu.tracing import SpanAttributes as SA
+        now = _time.monotonic()
+        t = state.times
+        self.tracer.emit({
+            SA.GEN_AI_REQUEST_ID: state.request_id,
+            SA.GEN_AI_REQUEST_MAX_TOKENS: state.params.max_tokens,
+            SA.GEN_AI_REQUEST_TEMPERATURE: state.params.temperature,
+            SA.GEN_AI_USAGE_PROMPT_TOKENS: len(state.prompt_token_ids),
+            SA.GEN_AI_USAGE_COMPLETION_TOKENS:
+                len(state.output_token_ids),
+            SA.GEN_AI_LATENCY_TIME_TO_FIRST_TOKEN:
+                (t.first_token - t.arrival
+                 if t and t.first_token is not None else None),
+            SA.GEN_AI_LATENCY_E2E: (now - t.arrival) if t else None,
+            SA.GEN_AI_RESPONSE_FINISH_REASON: state.finish_reason,
+        })
 
     def _make_request_output(self, state: RequestState) -> RequestOutput:
         text = (state.detokenizer.output_text

@@ -87,6 +87,9 @@ class Processor:
                     "only 'last' pooling is supported (mean pooling "
                     "needs per-chunk accumulation; not wired yet)")
             pooling_params = {"type": "last"}
+            # A pooling request never decodes: clamp so the scheduler's
+            # fused multi-step burst (which never pools) can't claim it.
+            sampling_params.max_tokens = 1
         if lora_request is not None:
             if not self.config.lora_config.enable_lora:
                 raise ValueError(

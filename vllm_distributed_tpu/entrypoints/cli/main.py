@@ -115,14 +115,22 @@ def cmd_run_batch(args) -> None:
     with open(args.input_file) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 requests.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                requests.append({"_parse_error": f"invalid JSON: {e}"})
 
     id_to_custom: dict[str, dict] = {}
     for i, req in enumerate(requests):
         body = req.get("body", {})
         url = req.get("url", "/v1/completions")
         rid = f"batch-{i}"
+        if "_parse_error" in req:
+            id_to_custom[rid] = {"req": req, "url": url,
+                                 "error": req["_parse_error"]}
+            continue
         # Any malformed line becomes an error RECORD; the rest of the
         # batch still runs (OpenAI batch semantics).
         try:

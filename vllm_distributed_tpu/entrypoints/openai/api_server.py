@@ -101,6 +101,43 @@ def _profile_dirs(result) -> list[str]:
     return result if isinstance(result, list) else [result]
 
 
+async def embeddings(request: web.Request) -> web.Response:
+    """OpenAI /v1/embeddings over the pooling path (reference:
+    serving_embedding.py)."""
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        inputs = body.get("input")
+        if inputs is None:
+            raise RequestError("embeddings need 'input'")
+        if isinstance(inputs, str) or (isinstance(inputs, list) and inputs
+                                       and isinstance(inputs[0], int)):
+            inputs = [inputs]
+        results = await asyncio.gather(
+            *(engine.encode(item) for item in inputs))
+        data = [{
+            "object": "embedding",
+            "index": i,
+            "embedding": out.embedding,
+        } for i, out in enumerate(results)]
+        prompt_tokens = sum(out.num_prompt_tokens for out in results)
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", model),
+            "usage": protocol.usage(prompt_tokens, 0),
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+    except EngineDeadError as e:
+        return _error_response(RequestError(str(e), code=500))
+
+
 async def start_profile(request: web.Request) -> web.Response:
     """Begin a device trace (reference: api_server /start_profile)."""
     dirs = _profile_dirs(await request.app[ENGINE_KEY].profile("start"))
@@ -384,6 +421,7 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_post("/start_profile", start_profile)
     app.router.add_post("/stop_profile", stop_profile)
     return app

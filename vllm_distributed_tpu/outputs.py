@@ -47,4 +47,5 @@ class PoolingOutput:
 
     request_id: str
     embedding: list[float] = field(default_factory=list)
+    num_prompt_tokens: int = 0
     finished: bool = True

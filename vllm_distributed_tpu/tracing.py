@@ -1,0 +1,103 @@
+"""Per-request tracing spans.
+
+Reference: vllm/tracing.py:52 — ``init_tracer`` builds an OTLP exporter
+and the engine emits one span per finished request with SpanAttributes
+(:98) covering queue/prefill/e2e latencies and token counts, enabled by
+ObservabilityConfig.otlp_traces_endpoint.
+
+This environment ships only the opentelemetry API shim (no SDK), so the
+tracer degrades gracefully: an ``http(s)://``/``grpc://`` endpoint uses
+the OTel SDK when importable, and a ``file://`` (or bare path) endpoint
+appends one JSON line per span — same attribute names, no dependency.
+"""
+
+import json
+import threading
+import time
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class SpanAttributes:
+    """Attribute names (reference: tracing.py:98 SpanAttributes)."""
+
+    GEN_AI_REQUEST_ID = "gen_ai.request.id"
+    GEN_AI_REQUEST_MAX_TOKENS = "gen_ai.request.max_tokens"
+    GEN_AI_REQUEST_TEMPERATURE = "gen_ai.request.temperature"
+    GEN_AI_USAGE_PROMPT_TOKENS = "gen_ai.usage.prompt_tokens"
+    GEN_AI_USAGE_COMPLETION_TOKENS = "gen_ai.usage.completion_tokens"
+    GEN_AI_LATENCY_TIME_TO_FIRST_TOKEN = \
+        "gen_ai.latency.time_to_first_token"
+    GEN_AI_LATENCY_E2E = "gen_ai.latency.e2e"
+    GEN_AI_RESPONSE_FINISH_REASON = "gen_ai.response.finish_reason"
+
+
+class RequestTracer:
+    """Emits one span per finished request."""
+
+    def emit(self, attributes: dict) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class JsonlTracer(RequestTracer):
+    """Dependency-free exporter: one JSON object per span, appended to a
+    file (endpoint "file:///path" or a bare path)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        logger.info("request tracing -> %s (jsonl)", path)
+
+    def emit(self, attributes: dict) -> None:
+        record = {"name": "llm_request", "ts": time.time(),
+                  "attributes": attributes}
+        with self._lock, open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+class OtelTracer(RequestTracer):
+    def __init__(self, endpoint: str) -> None:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
+            import OTLPSpanExporter
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        provider = TracerProvider()
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint)))
+        self._provider = provider
+        self._tracer = trace.get_tracer("vllm_distributed_tpu",
+                                        tracer_provider=provider)
+        logger.info("request tracing -> %s (otlp)", endpoint)
+
+    def emit(self, attributes: dict) -> None:
+        with self._tracer.start_as_current_span("llm_request") as span:
+            for key, value in attributes.items():
+                span.set_attribute(key, value)
+
+    def shutdown(self) -> None:
+        self._provider.shutdown()
+
+
+def init_tracer(endpoint: Optional[str]) -> Optional[RequestTracer]:
+    """None endpoint disables tracing (reference: is_otel_available +
+    init_tracer gating)."""
+    if not endpoint:
+        return None
+    if endpoint.startswith(("http://", "https://", "grpc://")):
+        try:
+            return OtelTracer(endpoint)
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            logger.warning(
+                "OTLP exporter unavailable (%s); tracing disabled "
+                "(use a file:// endpoint for the built-in exporter)", e)
+            return None
+    path = endpoint[len("file://"):] if endpoint.startswith("file://") \
+        else endpoint
+    return JsonlTracer(path)
