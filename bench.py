@@ -221,9 +221,10 @@ def main() -> None:
 
 def _run_with_retries() -> Exception | None:
     """Run main() with backoff (transient Unavailable from a tunnelled
-    chip); returns the last exception, or None on success."""
+    chip — observed flaps last minutes, so later retries wait long);
+    returns the last exception, or None on success."""
     last_err = None
-    for backoff in (15, 45, None):
+    for backoff in (30, 120, 300, None):
         try:
             main()
             return None

@@ -187,8 +187,9 @@ def test_dp2_mp_replicas_serve_concurrently(checkpoint):
         assert overlap_end > overlap_start, "replicas served serially"
         # Load-robust bound: on a contended CI box the XLA CPU runtimes
         # time-slice, shrinking (but never eliminating) the overlap; a
-        # quarter of the union still rules out serial serving.
-        assert (overlap_end - overlap_start) > 0.25 * total, \
+        # tenth of the union still rules out one-after-the-other
+        # serving (which would overlap ~0).
+        assert (overlap_end - overlap_start) > 0.1 * total, \
             f"overlap {(overlap_end - overlap_start):.2f}s of {total:.2f}s"
     finally:
         engine.shutdown()

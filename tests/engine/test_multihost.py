@@ -75,6 +75,102 @@ print("RESULT", rank, sorted(done.items()), flush=True)
 """
 
 
+_DRIVER = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; bport = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["VDT_PALLAS_INTERPRET"] = "1"
+os.environ["VDT_PLATFORM"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                         LoadConfig, ModelConfig,
+                                         ParallelConfig, SchedulerConfig)
+from transformers import LlamaConfig
+
+def make_config(rank, port, bport):
+    config = EngineConfig(
+        model_config=ModelConfig(
+            model="dummy-mh-exec", dtype="float32", max_model_len=64,
+            skip_tokenizer_init=True,
+            hf_overrides=dict(vocab_size=128, hidden_size=64,
+                              intermediate_size=128, num_hidden_layers=2,
+                              num_attention_heads=8, num_key_value_heads=8,
+                              max_position_embeddings=64,
+                              architectures=["LlamaForCausalLM"])),
+        cache_config=CacheConfig(block_size=4, num_gpu_blocks=64,
+                                 num_gpu_blocks_override=64),
+        scheduler_config=SchedulerConfig(max_num_batched_tokens=64,
+                                         max_num_seqs=8, max_model_len=64),
+        load_config=LoadConfig(load_format="dummy"),
+        parallel_config=ParallelConfig(
+            tensor_parallel_size=8, num_hosts=2, host_rank=rank,
+            coordinator_address=f"127.0.0.1:{port}",
+            broadcast_addr=f"tcp://127.0.0.1:{bport}"),
+    )
+    config.model_config.hf_config = LlamaConfig(
+        **config.model_config.hf_overrides)
+    return config
+
+config = make_config(rank, port, bport)
+if rank == 0:
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    engine = LLMEngine(config, load_tokenizer=False)
+    from vllm_distributed_tpu.executor.multihost import MultiHostExecutor
+    assert isinstance(engine.engine_core.engine_core.executor,
+                      MultiHostExecutor)
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    engine.add_request("mh-0", [3, 17, 92, 45, 8], sp)
+    engine.add_request("mh-1", [5, 9, 33, 71], sp)
+    done = {}
+    for _ in range(100):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out.outputs[0].token_ids
+        if len(done) == 2:
+            break
+    print("RESULT", rank, sorted(done.items()), flush=True)
+    engine.shutdown()
+else:
+    from vllm_distributed_tpu.executor.multihost import run_worker_follower
+    steps = run_worker_follower(config)
+    assert steps >= 2, steps
+    print("RESULT", rank, "follower-steps", steps, flush=True)
+"""
+
+
+def test_scheduler_broadcast_executor(tmp_path):
+    """Host 0 schedules + broadcasts; host 1 replays worker steps SPMD
+    (the MultiprocExecutor-boundary equivalent)."""
+    port, bport = get_open_port(), get_open_port()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _DRIVER, str(rank),
+                          str(port), str(bport)],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+    assert any("RESULT 0" in o for o in outs)
+    assert any("follower-steps" in o for o in outs)
+    driver_line = [ln for ln in outs[0].splitlines()
+                   if ln.startswith("RESULT 0")]
+    assert driver_line and "mh-0" in driver_line[0]
+
+
 def test_two_process_spmd_engine_step(tmp_path):
     port = get_open_port()
     procs = [

@@ -49,3 +49,37 @@ def test_single_prompt_token_ids(checkpoint):
                                        ignore_eos=True))
     assert len(outs) == 1
     assert len(outs[0].outputs[0].token_ids) == 3
+
+
+def test_beam_search_beats_greedy_cumlogprob(checkpoint):
+    """Beam search's best beam must score at least greedy's cumulative
+    logprob (reference: LLM.beam_search semantics)."""
+    from vllm_distributed_tpu.entrypoints.llm import LLM
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    path, _ = checkpoint
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=128, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=8,
+              skip_tokenizer_init=True)
+    prompt = [3, 17, 92, 45]
+    beams = llm.beam_search(prompt, beam_width=3, max_tokens=4)
+    assert len(beams) == 3
+    assert all(len(b["token_ids"]) >= 1 for b in beams)
+    # Greedy = beam_width 1; wider beams can only match or improve.
+    greedy = llm.beam_search(prompt, beam_width=1, max_tokens=4)
+    assert beams[0]["cum_logprob"] >= greedy[0]["cum_logprob"] - 1e-6
+
+
+def test_score_ranks_identical_higher(checkpoint):
+    from vllm_distributed_tpu.entrypoints.llm import LLM
+    path, _ = checkpoint
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=128, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=8,
+              skip_tokenizer_init=True)
+    q = [3, 17, 92, 45, 8]
+    same = [3, 17, 92, 45, 8]
+    other = [90, 81, 72, 63, 54]
+    scores = llm.score([q, q], [same, other])
+    assert scores[0] > scores[1]
+    assert abs(scores[0] - 1.0) < 1e-5  # identical prompts -> cosine 1

@@ -298,3 +298,29 @@ def test_embeddings_endpoint(server):
     assert len(body["data"]) == 2
     assert all(len(d["embedding"]) == 64 for d in body["data"])
     assert body["usage"]["prompt_tokens"] == 5
+
+
+def test_tool_calls_forced_function(server):
+    """Forced tool choice rides structured output: arguments ALWAYS
+    parse against the function schema (reference: serving_chat tool
+    handling + tool parsers)."""
+    base, _ = server
+    r = httpx.post(f"{base}/v1/chat/completions", timeout=300, json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "w1 w2"}],
+        "max_tokens": 30, "temperature": 1.0, "seed": 11,
+        "tools": [{"type": "function", "function": {
+            "name": "set_flag",
+            "parameters": {"type": "object",
+                           "properties": {"a": {"type": "boolean"}},
+                           "required": ["a"]}}}],
+        "tool_choice": {"type": "function",
+                        "function": {"name": "set_flag"}},
+    })
+    assert r.status_code == 200, r.text
+    choice = r.json()["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    (call, ) = choice["message"]["tool_calls"]
+    assert call["function"]["name"] == "set_flag"
+    args = json.loads(call["function"]["arguments"])
+    assert isinstance(args.get("a"), bool)

@@ -220,6 +220,10 @@ class ParallelConfig:
     # coordinator "ip:port" (host 0); None lets JAX auto-detect on TPU
     # pods (GCE metadata).
     coordinator_address: Optional[str] = None
+    # ZMQ endpoint host 0 binds for SchedulerOutput broadcast to
+    # follower hosts (e.g. "tcp://0.0.0.0:5560"); required when
+    # num_hosts > 1 with the MultiHostExecutor.
+    broadcast_addr: Optional[str] = None
     # Multi-host: processes per pod slice (jax.distributed).
     distributed_init_method: Optional[str] = None
 

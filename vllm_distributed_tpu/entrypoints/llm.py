@@ -83,6 +83,96 @@ class LLM:
         ]
         return self.generate(prompts, sampling_params)
 
+    def beam_search(self, prompt, beam_width: int = 4,
+                    max_tokens: int = 16) -> list[dict]:
+        """Client-side beam search (reference: entrypoints/llm.py
+        beam_search — V1 runs beams as ordinary engine requests ranked
+        by cumulative logprob). Returns beams sorted best-first as
+        {"token_ids", "cum_logprob"}."""
+        import math
+
+        from vllm_distributed_tpu.sampling_params import SamplingParams
+        if isinstance(prompt, str):
+            tokenizer = self.get_tokenizer()
+            assert tokenizer is not None, "string prompts need a tokenizer"
+            prompt = tokenizer.encode(prompt)
+        beams = [{"token_ids": list(prompt), "cum_logprob": 0.0,
+                  "finished": False}]
+        eos = self.llm_engine.processor.eos_token_id
+        for _ in range(max_tokens):
+            live = [b for b in beams if not b["finished"]]
+            if not live:
+                break
+            sp = SamplingParams(temperature=0.0, max_tokens=1,
+                                ignore_eos=True, logprobs=beam_width)
+            ids = []
+            for b in live:
+                rid = str(next(self.request_counter))
+                self.llm_engine.add_request(rid, b["token_ids"], sp)
+                ids.append(rid)
+            outs = {o.request_id: o for o in self._run_engine()}
+            candidates = [b for b in beams if b["finished"]]
+            for b, rid in zip(live, ids):
+                lps = outs[rid].outputs[0].logprobs[0]
+                for tok, lp in sorted(lps.items(), key=lambda kv: -kv[1]
+                                      )[:beam_width]:
+                    candidates.append({
+                        "token_ids": b["token_ids"] + [tok],
+                        "cum_logprob": b["cum_logprob"] + lp,
+                        "finished": tok == eos,
+                    })
+            # One metric everywhere: length-normalized cumulative
+            # logprob (the reference's sort_beams_key with
+            # length_penalty=1).
+            def score_key(b):
+                return -b["cum_logprob"] / max(
+                    len(b["token_ids"]) - len(prompt), 1)
+
+            candidates.sort(key=score_key)
+            beams = candidates[:beam_width]
+        beams.sort(key=score_key)
+        return [{"token_ids": b["token_ids"][len(prompt):],
+                 "cum_logprob": b["cum_logprob"]} for b in beams]
+
+    def score(self, queries, documents) -> list[float]:
+        """Similarity scoring via pooled embeddings (reference:
+        LLM.score; cosine over the encode path — cross-encoder heads
+        are a model-zoo extension)."""
+        import math
+        if isinstance(queries, (str, )) or (isinstance(queries, list)
+                                            and queries
+                                            and isinstance(queries[0],
+                                                           int)):
+            queries = [queries]
+        if isinstance(documents, (str, )) or (isinstance(documents, list)
+                                              and documents
+                                              and isinstance(documents[0],
+                                                             int)):
+            documents = [documents]
+        if len(queries) == 1:
+            queries = queries * len(documents)
+        assert len(queries) == len(documents)
+        # Encode each distinct prompt once (a single query against N
+        # documents costs 1 + N forwards, not 2N).
+        def key(p):
+            return p if isinstance(p, str) else tuple(p)
+
+        unique: dict = {}
+        for p in list(queries) + list(documents):
+            unique.setdefault(key(p), p)
+        embs = self.encode(list(unique.values()))
+        by_key = {k: e.embedding
+                  for k, e in zip(unique.keys(), embs)}
+
+        def cos(a, b):
+            dot = sum(x * y for x, y in zip(a, b))
+            na = math.sqrt(sum(x * x for x in a))
+            nb = math.sqrt(sum(x * x for x in b))
+            return dot / (na * nb + 1e-12)
+
+        return [cos(by_key[key(q)], by_key[key(d)])
+                for q, d in zip(queries, documents)]
+
     def _run_engine(self) -> list[RequestOutput]:
         finished: list[RequestOutput] = []
         while self.llm_engine.has_unfinished_requests():

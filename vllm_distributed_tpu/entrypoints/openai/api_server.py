@@ -323,6 +323,11 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         cid = protocol.chat_id()
         created = int(time.time())
         lora = _resolve_lora(request.app, body)
+        forced_tool = protocol.apply_tool_constraints(body, params)
+        if stream and forced_tool is not None:
+            raise RequestError(
+                "streaming with a forced tool_choice is not supported "
+                "yet; set stream=false")
         gens = [(i, engine.generate(prompt, params,
                                     request_id=f"{cid}-{i}",
                                     lora_request=lora))
@@ -337,13 +342,22 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             if idx == 0:
                 prompt_tokens = len(final.prompt_token_ids)
             completion_tokens += len(final.outputs[0].token_ids)
+            text = final.outputs[0].text
+            parse_tools = (None if body.get("tool_choice") == "none"
+                           else body.get("tools"))
+            tool_calls = protocol.parse_tool_calls(
+                text, forced_tool, parse_tools)
+            if tool_calls is not None:
+                message = {"role": "assistant", "content": None,
+                           "tool_calls": tool_calls}
+                finish = "tool_calls"
+            else:
+                message = {"role": "assistant", "content": text}
+                finish = final.outputs[0].finish_reason
             choices[idx] = {
                 "index": idx,
-                "message": {
-                    "role": "assistant",
-                    "content": final.outputs[0].text,
-                },
-                "finish_reason": final.outputs[0].finish_reason,
+                "message": message,
+                "finish_reason": finish,
             }
         return web.json_response({
             "id": cid,

@@ -121,6 +121,82 @@ def _structured_from_request(body: dict) -> Optional[dict]:
     raise RequestError(f"unsupported response_format type {rf['type']!r}")
 
 
+def apply_tool_constraints(body: dict, params) -> Optional[str]:
+    """OpenAI function calling (reference: serving_chat tool handling +
+    tool_parsers/). A forced tool choice ("required" or a named
+    function) constrains generation to the function's argument schema
+    via structured output, so the emitted arguments ALWAYS parse.
+    Returns the forced function name (or "*" for required-any) — the
+    marker parse_tool_calls uses."""
+    tools = body.get("tools")
+    choice = body.get("tool_choice", "auto" if tools else "none")
+    if not tools or choice == "none":
+        return None
+    functions = {t["function"]["name"]: t["function"]
+                 for t in tools if t.get("type") == "function"}
+    if isinstance(choice, dict):
+        name = choice.get("function", {}).get("name")
+        fn = functions.get(name)
+        if fn is None:
+            raise RequestError(f"unknown tool {name!r}")
+        params.structured = {"json": fn.get("parameters")
+                             or {"type": "object"}}
+        return name
+    if choice == "required":
+        # One branch per function, binding the name to ITS argument
+        # schema so emitted arguments always validate.
+        params.structured = {"json": {"anyOf": [{
+            "type": "object",
+            "properties": {
+                "name": {"const": name},
+                "arguments": fn.get("parameters") or {"type": "object"},
+            },
+            "required": ["name", "arguments"],
+        } for name, fn in functions.items()]}}
+        return "*"
+    return None  # auto: unconstrained; parsed best-effort
+
+
+def parse_tool_calls(text: str, forced_tool: Optional[str],
+                     tools) -> Optional[list[dict]]:
+    """Build OpenAI tool_calls from generated text (reference: the
+    JSON-style tool parsers under openai/tool_parsers/)."""
+    import json as _json
+    if forced_tool is None:
+        if not tools:
+            return None
+        # auto: accept a bare {"name": ..., "arguments": {...}} object.
+        try:
+            obj = _json.loads(text)
+        except (ValueError, TypeError):
+            return None
+        if not (isinstance(obj, dict) and "name" in obj
+                and "arguments" in obj):
+            return None
+        name, arguments = obj["name"], obj["arguments"]
+    elif forced_tool == "*":
+        try:
+            obj = _json.loads(text)
+        except (ValueError, TypeError):
+            # The grammar guarantees parseability EXCEPT under
+            # max_tokens truncation; fall back to plain content so the
+            # client sees the length finish instead of an error.
+            return None
+        name, arguments = obj["name"], obj["arguments"]
+    else:
+        try:
+            arguments = _json.loads(text)
+        except (ValueError, TypeError):
+            return None  # truncated mid-JSON (finish_reason length)
+        name = forced_tool
+    return [{
+        "id": f"call-{random_uuid()[:24]}",
+        "type": "function",
+        "function": {"name": name,
+                     "arguments": _json.dumps(arguments)},
+    }]
+
+
 def completion_id() -> str:
     return f"cmpl-{random_uuid()}"
 

@@ -10,7 +10,10 @@ changing this interface.
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
                                                     SchedulerOutput)
+from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.worker.worker import TPUWorker
+
+logger = init_logger(__name__)
 
 
 class Executor:
@@ -18,6 +21,21 @@ class Executor:
 
     @staticmethod
     def get_class(config: EngineConfig) -> type["Executor"]:
+        pc = config.parallel_config
+        if pc.num_hosts > 1 and pc.host_rank == 0 and pc.broadcast_addr:
+            from vllm_distributed_tpu.executor.multihost import \
+                MultiHostExecutor
+            return MultiHostExecutor
+        if pc.num_hosts > 1:
+            # No broadcast feed: LOCKSTEP mode — every host must run
+            # this identical engine program on the identical request
+            # stream (the torchrun/ExternalLauncher pattern); a host
+            # that instead waits in run_worker_follower would deadlock
+            # the pod's collectives, so say which mode this is.
+            logger.info(
+                "multi-host without broadcast_addr: lockstep SPMD mode "
+                "(every host drives the same engine); set "
+                "broadcast_addr for scheduler-broadcast mode")
         return UniProcExecutor
 
     def __init__(self, config: EngineConfig) -> None:

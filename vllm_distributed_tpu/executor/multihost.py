@@ -1,0 +1,133 @@
+"""Multi-host executor: one scheduler (host 0) driving SPMD workers on
+every host of a pod.
+
+Reference boundary: vllm/v1/executor/multiproc_executor.py:42 — the
+driver broadcasts SchedulerOutput to worker processes over the shm
+MessageQueue and collects outputs. The TPU multi-controller analogue:
+every HOST runs the same jitted programs over one global mesh
+(jax.distributed), so the only control-plane traffic needed is the
+SchedulerOutput itself — host 0 publishes each step over ZMQ, follower
+hosts replay ``worker.execute_model`` with identical inputs, and the
+XLA collectives tie the hosts' device programs together. Follower host
+outputs are identical by construction (replicated sampling outputs), so
+only host 0's are consumed.
+
+Wire format: pickle — hosts of one pod run the same build and the
+channel carries internal dataclasses (SchedulerOutput incl. numpy
+masks), exactly like the reference's mp pickling.
+
+Usage: host 0 builds the engine normally with
+ParallelConfig(num_hosts=N, host_rank=0, broadcast_addr=...); hosts
+1..N-1 call ``run_worker_follower(config)``.
+"""
+
+import pickle
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
+                                                    SchedulerOutput)
+from vllm_distributed_tpu.executor import Executor, UniProcExecutor
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_STOP = b"__stop__"
+
+
+class MultiHostExecutor(UniProcExecutor):
+    """Host 0's executor: local SPMD worker + step broadcast to the
+    other hosts' followers."""
+
+    def __init__(self, config: EngineConfig) -> None:
+        import zmq
+        pc = config.parallel_config
+        assert pc.num_hosts > 1 and pc.host_rank == 0, \
+            "MultiHostExecutor runs on host 0 of a multi-host pod"
+        self._ctx = zmq.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        addr = pc.broadcast_addr
+        assert addr, "ParallelConfig.broadcast_addr required (host0 ip)"
+        self._pub.bind(addr)
+        super().__init__(config)  # device init joins jax.distributed
+
+    def _broadcast(self, payload: bytes) -> None:
+        self._pub.send(payload)
+
+    def execute_model(self,
+                      scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        # Followers must enter the same jitted computation: ship the
+        # step before launching locally (collectives would deadlock if
+        # any host skipped a program).
+        self._broadcast(pickle.dumps(scheduler_output))
+        return super().execute_model(scheduler_output)
+
+    def initialize_kv_cache(self, num_pages: int) -> None:
+        # Followers size their caches identically from the broadcast.
+        self._broadcast(pickle.dumps(("init_kv", num_pages)))
+        super().initialize_kv_cache(num_pages)
+
+    def determine_num_available_blocks(self) -> int:
+        # Deterministic across hosts (same profile program over the same
+        # mesh); run locally everywhere, broadcast host 0's result so
+        # followers don't rely on float-identical HBM readings.
+        num = super().determine_num_available_blocks()
+        self._broadcast(pickle.dumps(("num_blocks", num)))
+        return num
+
+    def shutdown(self) -> None:
+        try:
+            self._broadcast(_STOP)
+        except Exception:  # noqa: BLE001 - best effort
+            pass
+        self._pub.close(linger=200)
+        super().shutdown()
+
+
+def run_worker_follower(config: EngineConfig,
+                        max_steps: Optional[int] = None) -> int:
+    """Follower-host loop (reference analogue:
+    WorkerProc.worker_busy_loop, multiproc_executor.py:603): join the
+    pod, build the local worker, replay broadcast steps until the stop
+    sentinel. Returns the number of steps executed."""
+    import zmq
+
+    from vllm_distributed_tpu.worker.worker import TPUWorker
+    pc = config.parallel_config
+    assert pc.num_hosts > 1 and pc.host_rank > 0
+
+    ctx = zmq.Context.instance()
+    sub = ctx.socket(zmq.SUB)
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    sub.connect(pc.broadcast_addr)
+
+    # Every jitted program over the global mesh is a COLLECTIVE across
+    # hosts: the follower must enter the same programs in the same
+    # order as host 0's UniProc lifecycle — device init (barrier via
+    # jax.distributed), weight placement, the HBM profile forward, KV
+    # init + warm-up lattice, then the per-step programs from the
+    # broadcast. Data-dependent decisions (page count) come from host 0
+    # so rounding differences can't desynchronize the pod.
+    worker = TPUWorker(config)
+    worker.init_device()
+    worker.load_model()
+    worker.determine_num_available_blocks()  # mirrors host 0's profile
+
+    steps = 0
+    while True:
+        payload = sub.recv()
+        if payload == _STOP:
+            break
+        msg = pickle.loads(payload)
+        if isinstance(msg, tuple) and msg[0] == "num_blocks":
+            continue  # host 0's authoritative count follows in init_kv
+        if isinstance(msg, tuple) and msg[0] == "init_kv":
+            worker.initialize_kv_cache(msg[1])
+            worker.compile_or_warm_up_model()
+            continue
+        worker.execute_model(msg)  # output identical to host 0's; drop
+        steps += 1
+        if max_steps is not None and steps >= max_steps:
+            break
+    logger.info("follower done after %d steps", steps)
+    return steps
