@@ -15,6 +15,14 @@ logger = init_logger(__name__)
 PromptType = Union[str, list[int]]
 
 
+def _listify_prompts(prompts):
+    """A single prompt (str or token list) becomes a one-element list."""
+    if isinstance(prompts, str) or (isinstance(prompts, list) and prompts
+                                    and isinstance(prompts[0], int)):
+        return [prompts]
+    return list(prompts)
+
+
 class LLM:
 
     def __init__(self, model: str, **kwargs) -> None:
@@ -31,10 +39,7 @@ class LLM:
         sampling_params: Optional[Union[SamplingParams,
                                         list[SamplingParams]]] = None,
     ) -> list[RequestOutput]:
-        if isinstance(prompts, (str, )) or (isinstance(prompts, list)
-                                            and prompts
-                                            and isinstance(prompts[0], int)):
-            prompts = [prompts]  # single prompt (str or token ids)
+        prompts = _listify_prompts(prompts)
         if sampling_params is None:
             sampling_params = SamplingParams()
         if isinstance(sampling_params, SamplingParams):
@@ -55,10 +60,7 @@ class LLM:
         """Embedding API: pooled last-position hidden state per prompt
         (reference: entrypoints/llm.py LLM.encode -> PoolingOutput)."""
         from vllm_distributed_tpu.sampling_params import SamplingParams
-        if isinstance(prompts, (str, )) or (isinstance(prompts, list)
-                                            and prompts
-                                            and isinstance(prompts[0], int)):
-            prompts = [prompts]
+        prompts = _listify_prompts(prompts)
         request_ids = []
         for prompt in prompts:
             request_id = str(next(self.request_counter))
@@ -99,6 +101,13 @@ class LLM:
         beams = [{"token_ids": list(prompt), "cum_logprob": 0.0,
                   "finished": False}]
         eos = self.llm_engine.processor.eos_token_id
+
+        # One metric everywhere: length-normalized cumulative logprob
+        # (the reference's sort_beams_key with length_penalty=1).
+        def score_key(b):
+            return -b["cum_logprob"] / max(
+                len(b["token_ids"]) - len(prompt), 1)
+
         for _ in range(max_tokens):
             live = [b for b in beams if not b["finished"]]
             if not live:
@@ -121,13 +130,6 @@ class LLM:
                         "cum_logprob": b["cum_logprob"] + lp,
                         "finished": tok == eos,
                     })
-            # One metric everywhere: length-normalized cumulative
-            # logprob (the reference's sort_beams_key with
-            # length_penalty=1).
-            def score_key(b):
-                return -b["cum_logprob"] / max(
-                    len(b["token_ids"]) - len(prompt), 1)
-
             candidates.sort(key=score_key)
             beams = candidates[:beam_width]
         beams.sort(key=score_key)
@@ -139,19 +141,18 @@ class LLM:
         LLM.score; cosine over the encode path — cross-encoder heads
         are a model-zoo extension)."""
         import math
-        if isinstance(queries, (str, )) or (isinstance(queries, list)
-                                            and queries
-                                            and isinstance(queries[0],
-                                                           int)):
-            queries = [queries]
-        if isinstance(documents, (str, )) or (isinstance(documents, list)
-                                              and documents
-                                              and isinstance(documents[0],
-                                                             int)):
-            documents = [documents]
-        if len(queries) == 1:
+        queries = _listify_prompts(queries)
+        documents = _listify_prompts(documents)
+        # Broadcast a single side against the other (reference
+        # LLM.score semantics).
+        if len(queries) == 1 and len(documents) > 1:
             queries = queries * len(documents)
-        assert len(queries) == len(documents)
+        elif len(documents) == 1 and len(queries) > 1:
+            documents = documents * len(queries)
+        if len(queries) != len(documents):
+            raise ValueError(
+                f"score needs matching (or broadcastable) counts; got "
+                f"{len(queries)} queries x {len(documents)} documents")
         # Encode each distinct prompt once (a single query against N
         # documents costs 1 + N forwards, not 2N).
         def key(p):

@@ -170,8 +170,10 @@ def parse_tool_calls(text: str, forced_tool: Optional[str],
             obj = _json.loads(text)
         except (ValueError, TypeError):
             return None
-        if not (isinstance(obj, dict) and "name" in obj
-                and "arguments" in obj):
+        declared = {t["function"]["name"] for t in tools
+                    if t.get("type") == "function"}
+        if not (isinstance(obj, dict) and obj.get("name") in declared
+                and isinstance(obj.get("arguments"), dict)):
             return None
         name, arguments = obj["name"], obj["arguments"]
     elif forced_tool == "*":

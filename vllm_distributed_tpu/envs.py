@@ -83,6 +83,14 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (reference: VLLM_TORCH_PROFILER_DIR).
     "VDT_PROFILER_DIR":
     lambda: os.getenv("VDT_PROFILER_DIR", "/tmp/vdt_profile"),
+    # Persistent XLA compilation cache directory ("" disables). On the
+    # tunnelled TPU, first compiles are the dominant bench cost and the
+    # tunnel can drop mid-run; caching makes retried runs resume almost
+    # instantly (reference analogue: VLLM_XLA_CACHE_PATH for torch_xla).
+    "VDT_COMPILE_CACHE_DIR":
+    lambda: os.getenv("VDT_COMPILE_CACHE_DIR",
+                      os.getenv("VLLM_XLA_CACHE_PATH",
+                                "/tmp/vdt_compile_cache")),
     # Cascade (shared-prefix) attention on the XLA path: "1" enables the
     # detection + split; opt-in because it adds a second compiled
     # forward variant per shape bucket.

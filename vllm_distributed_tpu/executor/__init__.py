@@ -22,6 +22,12 @@ class Executor:
     @staticmethod
     def get_class(config: EngineConfig) -> type["Executor"]:
         pc = config.parallel_config
+        if pc.num_hosts > 1 and pc.host_rank > 0 and pc.broadcast_addr:
+            raise ValueError(
+                "host_rank > 0 with broadcast_addr set: follower hosts "
+                "run executor.multihost.run_worker_follower, not a full "
+                "engine (a second scheduler would desynchronize the "
+                "pod's collectives)")
         if pc.num_hosts > 1 and pc.host_rank == 0 and pc.broadcast_addr:
             from vllm_distributed_tpu.executor.multihost import \
                 MultiHostExecutor

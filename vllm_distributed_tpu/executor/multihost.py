@@ -44,6 +44,11 @@ class MultiHostExecutor(UniProcExecutor):
         pc = config.parallel_config
         assert pc.num_hosts > 1 and pc.host_rank == 0, \
             "MultiHostExecutor runs on host 0 of a multi-host pod"
+        if pc.pipeline_parallel_size > 1:
+            raise ValueError(
+                "pipeline parallelism with the broadcast executor needs "
+                "async-dispatch broadcasting (execute_model_async); not "
+                "wired yet — use lockstep mode (no broadcast_addr)")
         self._ctx = zmq.Context.instance()
         self._pub = self._ctx.socket(zmq.PUB)
         addr = pc.broadcast_addr

@@ -48,6 +48,21 @@ class TPUWorker:
         self._maybe_init_multihost()
         devices = jax.devices()
         logger.info("devices: %s", devices)
+        cache_dir = envs.VDT_COMPILE_CACHE_DIR
+        if cache_dir and devices[0].platform != "cpu":
+            # Persistent compile cache: on the tunnelled TPU first
+            # compiles dominate bench time and the tunnel can drop
+            # mid-run — cached retries resume almost instantly. CPU is
+            # excluded: its AOT cache reload warns about machine-feature
+            # mismatches (possible SIGILL) and CPU compiles are cheap.
+            try:
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                # Cache every graph: the bucketed lattice is many small
+                # compiles below the default time threshold.
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            except Exception as e:  # pragma: no cover - jax internals
+                logger.warning("compile cache unavailable: %s", e)
         pc = self.config.parallel_config
         if pc.data_parallel_mode == "engine" and pc.data_parallel_rank:
             # Engine-replicated DP: each replica owns a disjoint
