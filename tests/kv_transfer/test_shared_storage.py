@@ -168,3 +168,20 @@ def test_multi_connector_storage_plus_pull(checkpoint, tmp_path):
     wc = worker_connector(consumer)
     # The storage child (first in order) owned the loads.
     assert wc.children[0].num_pages_loaded == 5
+
+
+def test_shared_storage_under_token_parallelism(checkpoint, tmp_path):
+    """Disaggregated prefill composes with TKNP: the consumer's pages
+    live in per-rank pool partitions (global ids), and the connector's
+    gather/scatter addresses the token-axis-sharded cache directly."""
+    storage = str(tmp_path / "kv_tknp")
+    baseline = run(make_engine(checkpoint), PROMPTS, "base")
+
+    producer = make_engine(checkpoint, storage=storage, role="kv_producer",
+                           token_parallel_size=2)
+    assert run(producer, PROMPTS, "prod") == baseline
+
+    consumer = make_engine(checkpoint, storage=storage, role="kv_consumer",
+                           token_parallel_size=2)
+    assert run(consumer, PROMPTS, "cons") == baseline
+    assert worker_connector(consumer).num_pages_loaded == 5

@@ -175,3 +175,28 @@ def test_lora_slot_reuse_and_eviction(setup):
     got1_again = run_one(
         "c", {"name": "ad1", "path": str(setup["root"] / "ad1")})
     assert got1_again == got1
+
+
+def test_lora_under_pipeline_parallelism(setup):
+    """PP slices the stacked LoRA buffers per stage like any layer
+    weight; adapter output must still match merged HF."""
+    engine = LLMEngine(EngineArgs(
+        model=str(setup["root"] / "base"), dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True, enable_lora=True, max_loras=2,
+        max_lora_rank=8,
+        pipeline_parallel_size=2).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    engine.add_request("pp-0", PROMPTS[0], sp,
+                       lora_request={"name": "ad1",
+                                     "path": str(setup["root"] / "ad1")})
+    done = {}
+    for _ in range(200):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out.outputs[0].token_ids
+        if done:
+            break
+    hf1 = _merge_into_hf(setup["hf"], setup["t1"])
+    assert done["pp-0"] == hf_greedy(hf1, PROMPTS[0], 6)

@@ -123,7 +123,9 @@ class DPEngineClient(EngineCoreClient):
         outs: list[EngineCoreOutput] = []
         if not self.is_mp:
             for i, client in enumerate(self.clients):
-                if self._live[i]:
+                if self._live[i] or self._has_kv_work(client):
+                    # KV-transfer work (deferred sends, held pulls)
+                    # needs step-polls even with no live requests.
                     outs.extend(client.get_output())
             self._mark_finished(outs)
             return outs
@@ -190,6 +192,11 @@ class DPEngineClient(EngineCoreClient):
         if all(isinstance(v, dict) for v in values):
             return self._aggregate_stats(values)
         return values
+
+    @staticmethod
+    def _has_kv_work(client) -> bool:
+        core = getattr(client, "engine_core", None)
+        return core is not None and core.has_kv_transfer_work()
 
     def has_unfinished_requests(self) -> bool:
         return any(self._live)

@@ -172,10 +172,19 @@ def parse_tool_calls(text: str, forced_tool: Optional[str],
             return None
         declared = {t["function"]["name"] for t in tools
                     if t.get("type") == "function"}
-        if not (isinstance(obj, dict) and obj.get("name") in declared
-                and isinstance(obj.get("arguments"), dict)):
+        if not (isinstance(obj, dict) and obj.get("name") in declared):
             return None
-        name, arguments = obj["name"], obj["arguments"]
+        arguments = obj.get("arguments")
+        if isinstance(arguments, str):
+            # Many fine-tunes imitate the OpenAI wire format, where
+            # arguments is a JSON-encoded STRING.
+            try:
+                arguments = _json.loads(arguments)
+            except ValueError:
+                return None
+        if not isinstance(arguments, dict):
+            return None
+        name = obj["name"]
     elif forced_tool == "*":
         try:
             obj = _json.loads(text)

@@ -150,35 +150,36 @@ class LoRASlotManager:
         tensors = _load_adapter_tensors(path)
         self.scaling[slot] = alpha / rank
 
-        L = self.cfg.num_layers
-        lora = runner.params["layers"]
-        for name, proj in PEFT_TARGETS.items():
-            a_key, b_key = name + "_a", name + "_b"
-            if a_key not in lora:
-                continue  # target not LoRA-enabled for this model
-            a_buf, b_buf = lora[a_key], lora[b_key]
-            a_np = np.zeros((L, ) + a_buf.shape[2:], np.float32)
-            b_np = np.zeros((L, ) + b_buf.shape[2:], np.float32)
-            found = False
-            for layer in range(L):
-                a_t = _find_tensor(tensors, layer, proj, "lora_A")
-                b_t = _find_tensor(tensors, layer, proj, "lora_B")
-                if a_t is None or b_t is None:
-                    continue
-                found = True
-                # PEFT stores A [r, in] and B [out, r]; ours are
-                # right-multiply transposed.
-                a_np[layer, :, :rank] = a_t.T
-                b_np[layer, :rank, :] = b_t.T
-            if found:
-                lora[a_key] = a_buf.at[:, slot].set(
-                    jnp.asarray(a_np, a_buf.dtype))
-                lora[b_key] = b_buf.at[:, slot].set(
-                    jnp.asarray(b_np, b_buf.dtype))
-            else:
-                # Target not in this adapter: zero the slot.
-                lora[a_key] = a_buf.at[:, slot].set(0.0)
-                lora[b_key] = b_buf.at[:, slot].set(0.0)
+        # One tree for the single-program runner; one per stage (with
+        # its layer slice) under pipeline parallelism.
+        for lora, (lo, hi) in runner.lora_buffer_trees():
+            for name, proj in PEFT_TARGETS.items():
+                a_key, b_key = name + "_a", name + "_b"
+                if a_key not in lora:
+                    continue  # target not LoRA-enabled for this model
+                a_buf, b_buf = lora[a_key], lora[b_key]
+                a_np = np.zeros((hi - lo, ) + a_buf.shape[2:], np.float32)
+                b_np = np.zeros((hi - lo, ) + b_buf.shape[2:], np.float32)
+                found = False
+                for layer in range(lo, hi):
+                    a_t = _find_tensor(tensors, layer, proj, "lora_A")
+                    b_t = _find_tensor(tensors, layer, proj, "lora_B")
+                    if a_t is None or b_t is None:
+                        continue
+                    found = True
+                    # PEFT stores A [r, in] and B [out, r]; ours are
+                    # right-multiply transposed.
+                    a_np[layer - lo, :, :rank] = a_t.T
+                    b_np[layer - lo, :rank, :] = b_t.T
+                if found:
+                    lora[a_key] = a_buf.at[:, slot].set(
+                        jnp.asarray(a_np, a_buf.dtype))
+                    lora[b_key] = b_buf.at[:, slot].set(
+                        jnp.asarray(b_np, b_buf.dtype))
+                else:
+                    # Target not in this adapter: zero the slot.
+                    lora[a_key] = a_buf.at[:, slot].set(0.0)
+                    lora[b_key] = b_buf.at[:, slot].set(0.0)
         logger.info("loaded LoRA %s (rank %d, alpha %.1f) into slot %d",
                     path, rank, alpha, slot)
 

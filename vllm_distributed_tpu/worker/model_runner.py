@@ -70,10 +70,11 @@ class TPUModelRunner:
             KVConnectorRole, create_kv_connector)
         self.kv_connector = create_kv_connector(config,
                                                 KVConnectorRole.WORKER)
-        if self.kv_connector is not None and self.tknp_size > 1:
-            raise NotImplementedError(
-                "KV transfer with token parallelism needs per-rank page "
-                "routing in the connector; not wired yet")
+        # KV transfer composes with token parallelism: connectors
+        # address pages by GLOBAL page id, and the eager gather/scatter
+        # in kv_transfer/page_io works on the token-axis-sharded cache
+        # (XLA moves the touched shards; validated by
+        # tests/kv_transfer/test_shared_storage.py tknp case).
 
         self.input_batch = InputBatch(
             max_num_reqs=self.max_num_reqs,
@@ -129,10 +130,19 @@ class TPUModelRunner:
         """Build the model and load weights per LoadConfig."""
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
+        self._init_lora_manager()
+
+    def _init_lora_manager(self) -> None:
         if self.config.lora_config.enable_lora:
             from vllm_distributed_tpu.models.lora import LoRASlotManager
             self.lora_manager = LoRASlotManager(
                 self.model.cfg, self.config.lora_config.max_loras)
+
+    def lora_buffer_trees(self):
+        """(param-dict, (layer_start, layer_end)) pairs holding the
+        stacked LoRA buffers — one pair for the single-program runner,
+        one per stage under pipeline parallelism."""
+        return [(self.params["layers"], (0, self.model.cfg.num_layers))]
 
     def _make_sharded_caches(self, num_pages: int) -> dict:
         from jax.sharding import NamedSharding
@@ -228,10 +238,7 @@ class TPUModelRunner:
                 # slot map must forget its names or old adapters would
                 # "resolve" to zeroed slots and silently serve the base
                 # model. Safe: sleep requires an idle engine.
-                from vllm_distributed_tpu.models.lora import \
-                    LoRASlotManager
-                self.lora_manager = LoRASlotManager(
-                    self.model.cfg, self.config.lora_config.max_loras)
+                self._init_lora_manager()
         self.kv_caches = self._make_sharded_caches(self.num_pages)
         self._sleeping = False
         logger.info("awake: weights restored, KV cache reset")

@@ -73,6 +73,7 @@ class PPModelRunner(TPUModelRunner):
             "embed": jax.device_put(host_params["embed"],
                                     NamedSharding(sm0, specs["embed"])),
         }
+        self._init_lora_manager()
         # The sampler's params (final norm + LM head) live with the last
         # stage; the base class passes self.params to the sample fns.
         self.params = {
@@ -83,6 +84,10 @@ class PPModelRunner(TPUModelRunner):
                 host_params["lm_head"],
                 NamedSharding(sml, specs["lm_head"])),
         }
+
+    def lora_buffer_trees(self):
+        return [(self.stage_params[p], rng)
+                for p, rng in enumerate(self.layer_ranges)]
 
     # ------------------------------------------------------------------
     def _stage_caches(self, num_pages: int) -> list[dict]:
