@@ -56,9 +56,14 @@ def param_bytes(engine):
 PROMPT = [3, 17, 92, 45, 8, 21, 33]
 
 
-def test_int8_logit_parity_and_memory(checkpoint):
+@pytest.mark.parametrize("scheme,tol,dtype_name", [
+    ("int8", 0.15, "int8"),
+    ("fp8", 0.25, "float8_e4m3fn"),
+])
+def test_quant_logit_parity_and_memory(checkpoint, scheme, tol,
+                                       dtype_name):
     fp = make_engine(checkpoint)
-    q8 = make_engine(checkpoint, quantization="int8")
+    q8 = make_engine(checkpoint, quantization=scheme)
 
     lp_fp = first_logprobs(fp, PROMPT)
     lp_q8 = first_logprobs(q8, PROMPT)
@@ -67,19 +72,19 @@ def test_int8_logit_parity_and_memory(checkpoint):
     common = set(lp_fp) & set(lp_q8)
     assert len(common) >= 3
     for tok in common:
-        assert abs(lp_fp[tok] - lp_q8[tok]) < 0.15, (
+        assert abs(lp_fp[tok] - lp_q8[tok]) < tol, (
             tok, lp_fp[tok], lp_q8[tok])
 
-    # Weight footprint: ~4x smaller vs float32 engine weights (int8 vs
-    # f32, scales negligible; embed/lm_head stay fp).
+    # Weight footprint: ~4x smaller vs float32 engine weights (8-bit
+    # payloads, scales negligible; embed/lm_head stay fp).
     b_fp, b_q8 = param_bytes(fp), param_bytes(q8)
     assert b_q8 < 0.55 * b_fp, (b_q8, b_fp)
 
-    # The runner's weight tree really holds int8 leaves.
+    # The runner's weight tree really holds quantized leaves.
     runner = q8.engine_core.engine_core.executor.worker.model_runner
     dtypes = {str(x.dtype)
               for x in jax.tree_util.tree_leaves(runner.params)}
-    assert "int8" in dtypes
+    assert dtype_name in dtypes
 
 
 def test_int8_greedy_decode_stable_under_tp(checkpoint):

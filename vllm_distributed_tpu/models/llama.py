@@ -87,7 +87,8 @@ class LlamaArchConfig:
     # dimension divides the model mesh axis; repeat-per-head preserves
     # GQA grouping exactly.
     num_kv_head_replicas: int = 1
-    # Weight quantization scheme (None | "int8"); see quantize_params.
+    # Weight quantization scheme (None | "int8" | "fp8"); see
+    # quantize_params.
     quantization: Optional[str] = None
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
@@ -152,12 +153,17 @@ class LlamaForCausalLM:
     # Quantization (w8a16)
     # ------------------------------------------------------------------
     def quantize_params(self, params: dict) -> dict:
-        """Symmetric per-output-channel int8 for the listed layer
-        matrices: w ~= q * scale with scale = absmax/127 reduced over
-        the input (second-to-last) axis. Halves weight HBM; the matmuls
-        dequantize at read (w8a16 — XLA fuses convert*scale into the
-        dot's operand load)."""
-        if self.cfg.quantization != "int8":
+        """Weight-only quantization of the listed layer matrices, w8a16
+        style (reference: quantization/tpu_int8.py + the fp8 configs):
+
+        * "int8": symmetric per-output-channel, scale = absmax/127.
+        * "fp8": float8_e4m3fn payloads with the same per-channel
+          scaling (absmax mapped to the e4m3 max of 448).
+
+        Either halves weight HBM; matmuls dequantize at read (XLA fuses
+        convert*scale into the dot's operand load)."""
+        scheme = self.cfg.quantization
+        if scheme not in ("int8", "fp8"):
             return params
         layers = params["layers"]
         for name in self.QUANT_TARGETS:
@@ -165,17 +171,29 @@ class LlamaForCausalLM:
             if w is None:
                 continue
             w32 = np.asarray(w, np.float32)
-            scale = np.max(np.abs(w32), axis=-2, keepdims=True) / 127.0
-            scale = np.maximum(scale, 1e-8)
-            q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
-            layers[name] = jnp.asarray(q)
+            absmax = np.max(np.abs(w32), axis=-2, keepdims=True)
+            if scheme == "int8":
+                scale = np.maximum(absmax / 127.0, 1e-8)
+                q = jnp.asarray(
+                    np.clip(np.round(w32 / scale), -127,
+                            127).astype(np.int8))
+            else:
+                import ml_dtypes
+                scale = np.maximum(absmax / 448.0, 1e-8)
+                # Cast HOST-side so only fp8 bytes ever hit device HBM
+                # (same contract as the int8 branch).
+                q = jnp.asarray(
+                    (w32 / scale).astype(ml_dtypes.float8_e4m3fn))
+            layers[name] = q
             layers[name + "_scale"] = jnp.asarray(scale, jnp.float32)
         return params
+
+    _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn)
 
     def _w(self, lp: dict, name: str) -> jax.Array:
         """Dequantizing weight accessor: identity for fp weights."""
         w = lp[name]
-        if w.dtype == jnp.int8:
+        if w.dtype in self._QUANT_DTYPES:
             return (w.astype(self.cfg.dtype) *
                     lp[name + "_scale"].astype(self.cfg.dtype))
         return w
@@ -257,7 +275,7 @@ class LlamaForCausalLM:
         for name in list(layer):
             if name.endswith("_scale"):
                 del layer[name]
-        if self.cfg.quantization != "int8":
+        if self.cfg.quantization not in ("int8", "fp8"):
             return
         for name in self.QUANT_TARGETS:
             spec = layer.get(name)
