@@ -105,3 +105,21 @@ def test_phi3_greedy_matches_hf(tmp_path_factory):
     got = run(path, PROMPTS)
     want = [hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_mistral_sliding_window_matches_hf(tmp_path_factory):
+    """Sliding-window attention (window smaller than the prompt) must
+    match HF MistralForCausalLM exactly."""
+    from transformers import MistralConfig, MistralForCausalLM
+    torch.manual_seed(0)
+    cfg = MistralConfig(vocab_size=128, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        sliding_window=8, max_position_embeddings=64,
+                        eos_token_id=1, attn_implementation="eager")
+    path, hf = _save(tmp_path_factory, "tiny_mistral_sw",
+                     MistralForCausalLM(cfg))
+    long_prompt = [3, 17, 92, 45, 8, 21, 33, 64, 90, 11, 12, 13]  # > W
+    got = run(path, [long_prompt], max_model_len=32)
+    want = [hf_greedy(hf, long_prompt, 6)]
+    assert got == want

@@ -137,3 +137,43 @@ def test_padded_tokens_do_not_nan():
     out = ragged_paged_attention(pad_q, kp, vp, bt, pad_ri, pad_qp,
                                  sm_scale=0.35)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_sliding_window_masks_old_positions():
+    """window=W must equal full attention restricted to the last W
+    positions (checked against the naive reference with an explicit
+    window, and window >= seqlen must equal full causal)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from vllm_distributed_tpu.ops.attention import (
+        naive_ragged_attention, ragged_paged_attention)
+
+    rng = np.random.default_rng(0)
+    T, Hq, Hkv, D, PS, P = 10, 4, 2, 16, 4, 6
+    q = jnp.asarray(rng.standard_normal((T, Hq, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((24, Hkv, PS, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((24, Hkv, PS, D)).astype(np.float32))
+    bt = jnp.asarray(np.arange(2 * P, dtype=np.int32).reshape(2, P))
+    req_idx = jnp.asarray([0] * 5 + [1] * 5, jnp.int32)
+    q_pos = jnp.asarray(list(range(15, 20)) + list(range(10, 15)),
+                        jnp.int32)
+
+    for W in (4, 8):
+        got = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                     sm_scale=0.25, window=W)
+        want = naive_ragged_attention(q, k, v, bt, req_idx, q_pos,
+                                      sm_scale=0.25, window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        # Windowed differs from full for small W.
+        full = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                      sm_scale=0.25)
+        assert not np.allclose(np.asarray(got), np.asarray(full))
+    # Huge window == full causal.
+    wide = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                  sm_scale=0.25, window=1000)
+    full = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                  sm_scale=0.25)
+    np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)

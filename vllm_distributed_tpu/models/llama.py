@@ -93,6 +93,10 @@ class LlamaArchConfig:
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
+    # Sliding-window attention size (Mistral-style); None = full
+    # causal. Compute-level only: pages outside the window stay
+    # allocated (freeing them is a kv-cache-manager extension).
+    sliding_window: Optional[int] = None
     # Family knobs reused by Llama-shaped variants: embedding scale
     # (Gemma multiplies by sqrt(H)), MLP activation, per-head q/k
     # RMSNorm (Qwen3).
@@ -105,6 +109,26 @@ class LlamaArchConfig:
     def total_kv_heads(self) -> int:
         """KV heads actually materialized (checkpoint heads × replicas)."""
         return self.num_kv_heads * self.num_kv_head_replicas
+
+    @staticmethod
+    def _resolve_sliding_window(hf):
+        """HF sliding-window semantics: Mistral-style (window applies to
+        every layer) is supported; Qwen2-style mixed layouts (the first
+        max_window_layers layers full-causal, the rest windowed) are
+        rejected — the scanned uniform layer stack can't vary the mask
+        per layer yet."""
+        window = getattr(hf, "sliding_window", None)
+        if not window or not getattr(hf, "use_sliding_window", True):
+            return None
+        mwl = getattr(hf, "max_window_layers", None)
+        if mwl is not None and 0 < mwl < hf.num_hidden_layers:
+            raise ValueError(
+                f"mixed full/sliding-window layers (max_window_layers="
+                f"{mwl} of {hf.num_hidden_layers}) are not supported "
+                "yet; set use_sliding_window=False or a uniform layout")
+        if mwl is not None and mwl >= hf.num_hidden_layers:
+            return None  # every layer below the threshold: full attention
+        return int(window)
 
     @classmethod
     def from_hf_config(cls, hf, dtype=jnp.bfloat16) -> "LlamaArchConfig":
@@ -124,6 +148,7 @@ class LlamaArchConfig:
             rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
             tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
             attention_bias=getattr(hf, "attention_bias", False),
+            sliding_window=cls._resolve_sliding_window(hf),
             num_experts=getattr(hf, "num_local_experts", 0),
             num_experts_per_tok=getattr(hf, "num_experts_per_tok", 2),
             dtype=dtype,
@@ -526,7 +551,8 @@ class LlamaForCausalLM:
             k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,
-                                   sm_scale=sm_scale, layer=layer_idx)
+                                   sm_scale=sm_scale, layer=layer_idx,
+                                   window=c.sliding_window or 0)
             attn2d = attn.reshape(T, -1)
             h = h + (attn2d @ self._w(lp, "wo") +
                      self._lora_delta(lp, "wo", attn2d, lora_ctx))

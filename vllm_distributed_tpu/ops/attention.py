@@ -93,7 +93,7 @@ def write_kv_pages(
     return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
 
 
-@partial(jax.jit, static_argnames=("sm_scale", ))
+@partial(jax.jit, static_argnames=("sm_scale", "window"))
 def ragged_paged_attention(
     q: jax.Array,  # [T, num_q_heads, head_dim]
     k_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
@@ -103,9 +103,13 @@ def ragged_paged_attention(
     q_pos: jax.Array,  # [T] int32: absolute position of each query token
     *,
     sm_scale: float,
+    window: int = 0,  # sliding window size; 0 = full causal
 ) -> jax.Array:  # [T, num_q_heads, head_dim]
     """Unified ragged attention: token t attends to kv positions
-    0..q_pos[t] of request req_idx[t] (causal over the paged cache)."""
+    0..q_pos[t] of request req_idx[t] (causal over the paged cache);
+    a positive ``window`` restricts to the last ``window`` positions
+    (Mistral-style sliding window, reference: sliding_window plumbed
+    through the attention backends)."""
     T, num_q_heads, head_dim = q.shape
     num_pages, num_kv_heads, page_size, _ = k_pages.shape
     assert num_q_heads % num_kv_heads == 0
@@ -127,6 +131,8 @@ def ragged_paged_attention(
         scores = jnp.einsum("thgd,thpd->thgp", qg, k_blk)
         kv_pos = page_i * page_size + jnp.arange(page_size, dtype=jnp.int32)
         valid = kv_pos[None, :] <= q_pos[:, None]  # [T, ps] causal
+        if window > 0:
+            valid &= kv_pos[None, :] > (q_pos[:, None] - window)
         scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
 
         m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
@@ -251,6 +257,7 @@ def naive_ragged_attention(
     q_pos: jax.Array,
     *,
     sm_scale: float,
+    window: int = 0,
 ) -> jax.Array:
     """O(T * max_kv) dense-gather reference used only by unit tests."""
     T, num_q_heads, head_dim = q.shape
@@ -270,6 +277,8 @@ def naive_ragged_attention(
                         k_all.astype(jnp.float32))
     kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
     valid = kv_pos[None, :] <= q_pos[:, None]
+    if window > 0:
+        valid &= kv_pos[None, :] > (q_pos[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("thgj,thjd->thgd", weights, v_all.astype(jnp.float32))
@@ -480,9 +489,12 @@ def paged_attention(
     *,
     sm_scale: float,
     layer: jax.Array | None = None,  # [1] int32
+    window: int = 0,  # sliding window; 0 = full causal
 ) -> jax.Array:
     """Unified entry used by every model's attention layer; dispatches to
     the Pallas kernel or the XLA reference path per backend selection.
+    Sliding-window models take the XLA path (the Pallas kernel's
+    per-sequence runs don't carry a window bound yet).
 
     On a >1-wide tensor-parallel mesh the Pallas call is wrapped in
     shard_map over the "model" (head) axis — pallas_call is opaque to
@@ -494,7 +506,7 @@ def paged_attention(
     if getattr(batch, "tknp", None) is not None:
         return _paged_attention_tknp(q, k_pages, v_pages, batch,
                                      sm_scale=sm_scale, layer=layer)
-    if (resolve_attention_backend() == "pallas"
+    if (window == 0 and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (
             ragged_paged_attention_pallas)
@@ -533,11 +545,12 @@ def paged_attention(
         v_layer = v_pages[layer[0]]
     else:
         k_layer, v_layer = k_pages, v_pages
-    if getattr(batch, "cascade_shared_ids", None) is not None:
+    if (window == 0
+            and getattr(batch, "cascade_shared_ids", None) is not None):
         return cascade_ragged_paged_attention(
             q, k_layer, v_layer, batch.block_tables, batch.req_idx,
             batch.positions, batch.cascade_shared_ids,
             sm_scale=sm_scale)
     return ragged_paged_attention(q, k_layer, v_layer, batch.block_tables,
                                   batch.req_idx, batch.positions,
-                                  sm_scale=sm_scale)
+                                  sm_scale=sm_scale, window=window)

@@ -808,6 +808,7 @@ class TPUModelRunner:
         from vllm_distributed_tpu import envs
         if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
                 or self.config.parallel_config.pipeline_parallel_size > 1
+                or getattr(self.model.cfg, "sliding_window", None)
                 or resolve_attention_backend() == "pallas"):
             return None
         S = envs.VDT_CASCADE_SHARED_PAGES
