@@ -159,6 +159,8 @@ def test_sliding_window_masks_old_positions():
     q_pos = jnp.asarray(list(range(15, 20)) + list(range(10, 15)),
                         jnp.int32)
 
+    full = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                  sm_scale=0.25)
     for W in (4, 8):
         got = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
                                      sm_scale=0.25, window=W)
@@ -167,13 +169,9 @@ def test_sliding_window_masks_old_positions():
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
         # Windowed differs from full for small W.
-        full = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
-                                      sm_scale=0.25)
         assert not np.allclose(np.asarray(got), np.asarray(full))
     # Huge window == full causal.
     wide = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
                                   sm_scale=0.25, window=1000)
-    full = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
-                                  sm_scale=0.25)
     np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
                                rtol=1e-6, atol=1e-6)
