@@ -123,3 +123,78 @@ def test_mistral_sliding_window_matches_hf(tmp_path_factory):
     got = run(path, [long_prompt], max_model_len=32)
     want = [hf_greedy(hf, long_prompt, 6)]
     assert got == want
+
+
+def test_gemma2_greedy_matches_hf(tmp_path_factory):
+    """Gemma2: sandwich norms, logit soft-capping, query_pre_attn_scalar
+    scaling, and alternating sliding/full layers (hf.layer_types) must
+    match HF Gemma2ForCausalLM (eager — sdpa drops the softcap)."""
+    from transformers import Gemma2Config
+    from transformers import Gemma2ForCausalLM as HFGemma2
+    torch.manual_seed(0)
+    cfg = Gemma2Config(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=4,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=16, sliding_window=4,
+                       max_position_embeddings=64, eos_token_id=1,
+                       attn_implementation="eager")
+    path, hf = _save(tmp_path_factory, "tiny_gemma2", HFGemma2(cfg))
+    long_prompt = [3, 17, 92, 45, 8, 21, 33, 64, 90, 11, 12, 13]  # > W
+    got = run(path, [long_prompt, PROMPTS[1]])
+    want = [hf_greedy(hf, p, 6) for p in [long_prompt, PROMPTS[1]]]
+    assert got == want
+
+
+def test_gemma2_pp2_matches_hf(tmp_path_factory):
+    """PP=2 over the alternating window pattern: each stage's jit must
+    pick up its own slice of the layout (first_layer offsets)."""
+    from transformers import Gemma2Config
+    from transformers import Gemma2ForCausalLM as HFGemma2
+    torch.manual_seed(1)
+    cfg = Gemma2Config(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=4,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=16, sliding_window=4,
+                       max_position_embeddings=64, eos_token_id=1,
+                       attn_implementation="eager")
+    path, hf = _save(tmp_path_factory, "tiny_gemma2_pp", HFGemma2(cfg))
+    long_prompt = [3, 17, 92, 45, 8, 21, 33, 64, 90, 11, 12, 13]
+    got = run(path, [long_prompt], pipeline_parallel_size=2)
+    want = [hf_greedy(hf, long_prompt, 6)]
+    assert got == want
+
+
+def test_qwen2_mixed_window_layout_matches_hf(tmp_path_factory):
+    """Qwen2 max_window_layers (first N layers full-causal, the rest
+    windowed) runs as two scan segments and must match HF."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Qwen2Config(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=4,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      sliding_window=4, use_sliding_window=True,
+                      max_window_layers=2, max_position_embeddings=64,
+                      eos_token_id=1, attn_implementation="eager")
+    path, hf = _save(tmp_path_factory, "tiny_qwen2_mixed",
+                     Qwen2ForCausalLM(cfg))
+    long_prompt = [3, 17, 92, 45, 8, 21, 33, 64, 90, 11, 12, 13]
+    got = run(path, [long_prompt])
+    want = [hf_greedy(hf, long_prompt, 6)]
+    assert got == want
+
+
+def test_window_segment_planner():
+    """Unit: period grouping for alternating layouts, run segmentation
+    for prefix layouts, single segment for uniform ones."""
+    from vllm_distributed_tpu.models.llama import LlamaForCausalLM
+    plan = LlamaForCausalLM._plan_window_segments
+    assert plan((0, 0, 0, 0)) == [(0, 4, (0, ))]
+    assert plan((8, 8, 8)) == [(0, 3, (8, ))]
+    # Gemma2 alternating: one scan over pairs.
+    assert plan((4, 0, 4, 0)) == [(0, 4, (4, 0))]
+    # Qwen2 prefix layout (non-periodic): two constant runs.
+    assert plan((0, ) * 5 + (4, ) * 5) == [(0, 5, (0, )), (5, 5, (4, ))]
+    # Odd-length slice of an alternating layout (a Gemma2 PP stage):
+    # periodic bulk + one-layer remainder, NOT a per-layer unroll.
+    assert plan((4, 0, 4, 0, 4)) == [(0, 4, (4, 0)), (4, 1, (4, ))]
+    assert plan((4, 0) * 10 + (4, )) == [(0, 20, (4, 0)), (20, 1, (4, ))]

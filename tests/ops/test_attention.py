@@ -175,3 +175,32 @@ def test_sliding_window_masks_old_positions():
                                   sm_scale=0.25, window=1000)
     np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_logit_softcap_matches_naive():
+    """Gemma2 attn soft-capping: paged path == dense reference, and a
+    cap actually changes the output (scores get bounded)."""
+    import jax.numpy as jnp
+    from vllm_distributed_tpu.ops.attention import (
+        naive_ragged_attention, ragged_paged_attention)
+
+    rng = np.random.default_rng(1)
+    T, Hq, Hkv, D, PS, P = 8, 4, 2, 16, 4, 4
+    q = jnp.asarray(3 * rng.standard_normal((T, Hq, D)).astype(np.float32))
+    k = jnp.asarray(3 * rng.standard_normal((16, Hkv, PS, D)).astype(
+        np.float32))
+    v = jnp.asarray(rng.standard_normal((16, Hkv, PS, D)).astype(
+        np.float32))
+    bt = jnp.asarray(np.arange(2 * P, dtype=np.int32).reshape(2, P))
+    req_idx = jnp.asarray([0] * 4 + [1] * 4, jnp.int32)
+    q_pos = jnp.asarray(list(range(8, 12)) + list(range(6, 10)), jnp.int32)
+
+    got = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                 sm_scale=0.25, logit_cap=5.0)
+    want = naive_ragged_attention(q, k, v, bt, req_idx, q_pos,
+                                  sm_scale=0.25, logit_cap=5.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    uncapped = ragged_paged_attention(q, k, v, bt, req_idx, q_pos,
+                                      sm_scale=0.25)
+    assert not np.allclose(np.asarray(got), np.asarray(uncapped))

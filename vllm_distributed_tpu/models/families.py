@@ -23,6 +23,9 @@ from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
 
 class GemmaForCausalLM(LlamaForCausalLM):
 
+    # RMSNorm weights stored as offsets from 1 in Gemma checkpoints.
+    _NORM_FOLD_KEYS = ("input_ln", "post_ln")
+
     @classmethod
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
         arch.embed_scale = math.sqrt(arch.hidden_size)
@@ -34,7 +37,7 @@ class GemmaForCausalLM(LlamaForCausalLM):
         # Gemma's RMSNorm computes x * (1 + w): fold the offset into the
         # stored weights so rms_norm needs no model-specific branch.
         layers = params["layers"]
-        for key in ("input_ln", "post_ln"):
+        for key in self._NORM_FOLD_KEYS:
             layers[key] = layers[key] + 1.0
         params["final_ln"] = params["final_ln"] + 1.0
         return params
@@ -42,6 +45,38 @@ class GemmaForCausalLM(LlamaForCausalLM):
     def init_params(self, rng, scale: float = 0.02) -> dict:
         # Random init is already offset-free; nothing to fold.
         return super().init_params(rng, scale)
+
+
+class Gemma2ForCausalLM(GemmaForCausalLM):
+    """Gemma 2 (reference: vllm/model_executor/models/gemma2.py): the
+    Gemma block plus sandwich norms around both sub-blocks, attention
+    and final logit soft-capping, query_pre_attn_scalar score scaling,
+    and alternating sliding/full attention layers. The alternating
+    layout arrives via hf.layer_types through the generic
+    window-pattern resolver; run_layers executes it as one lax.scan
+    over layer PAIRS so every mask stays static."""
+
+    _NORM_FOLD_KEYS = ("input_ln", "post_ln", "post_attn_ln",
+                       "post_ffw_ln")
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        super().configure_arch(arch, hf)
+        arch.extra_layer_norms = True
+        arch.attn_logit_softcap = float(
+            getattr(hf, "attn_logit_softcapping", None) or 0.0)
+        arch.final_logit_softcap = float(
+            getattr(hf, "final_logit_softcapping", None) or 0.0)
+        qpas = getattr(hf, "query_pre_attn_scalar", None)
+        arch.query_pre_attn_scalar = float(qpas) if qpas else None
+        if arch.sliding_window and arch.window_pattern is None:
+            # Older transformers Gemma2Configs predate layer_types, so
+            # the generic resolver sees a uniform window — which would
+            # silently window the full-attention layers too. Gemma2's
+            # layout is fixed: even layers sliding, odd layers full.
+            arch.window_pattern = tuple(
+                arch.sliding_window if i % 2 == 0 else 0
+                for i in range(arch.num_layers))
 
 
 class Qwen3ForCausalLM(LlamaForCausalLM):

@@ -97,6 +97,24 @@ class LlamaArchConfig:
     # causal. Compute-level only: pages outside the window stay
     # allocated (freeing them is a kv-cache-manager extension).
     sliding_window: Optional[int] = None
+    # Per-layer window layout for models mixing sliding and full-causal
+    # layers: entry i is layer i's window (0 = full causal). None means
+    # ``sliding_window`` applies uniformly. Gemma2 alternates
+    # sliding/full; Qwen2's max_window_layers keeps the first N layers
+    # full (reference: per-layer sliding_window in models/gemma2.py and
+    # models/qwen2.py attention construction).
+    window_pattern: Optional[tuple] = None
+    # Logit soft-capping, cap*tanh(x/cap); 0 disables (Gemma2,
+    # reference: attn_logit_softcapping/final_logit_softcapping in
+    # models/gemma2.py).
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    # Attention score scale override: scores use this value**-0.5
+    # instead of head_dim**-0.5 (Gemma2 query_pre_attn_scalar).
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma2-style sandwich norms: an extra RMSNorm on each sub-block's
+    # OUTPUT (attention and MLP) before the residual add.
+    extra_layer_norms: bool = False
     # Family knobs reused by Llama-shaped variants: embedding scale
     # (Gemma multiplies by sqrt(H)), MLP activation, per-head q/k
     # RMSNorm (Qwen3).
@@ -112,28 +130,41 @@ class LlamaArchConfig:
 
     @staticmethod
     def _resolve_sliding_window(hf):
-        """HF sliding-window semantics: Mistral-style (window applies to
-        every layer) is supported; Qwen2-style mixed layouts (the first
-        max_window_layers layers full-causal, the rest windowed) are
-        rejected — the scanned uniform layer stack can't vary the mask
-        per layer yet."""
+        """HF sliding-window semantics -> (window, per_layer_pattern).
+
+        Uniform layouts (Mistral) give (window, None); mixed layouts
+        give a per-layer pattern — preferably from ``hf.layer_types``
+        ("sliding_attention"/"full_attention" per layer: Gemma2
+        alternates, Qwen2 marks layers >= max_window_layers), falling
+        back to max_window_layers arithmetic for configs without it.
+        Returns (None, None) when no layer is windowed."""
         window = getattr(hf, "sliding_window", None)
         if not window or not getattr(hf, "use_sliding_window", True):
-            return None
+            return None, None
+        window = int(window)
+        L = hf.num_hidden_layers
+        layer_types = getattr(hf, "layer_types", None)
+        if layer_types:
+            pattern = tuple(window if t == "sliding_attention" else 0
+                            for t in layer_types)
+            if not any(pattern):
+                return None, None
+            if all(pattern):
+                return window, None
+            return window, pattern
         mwl = getattr(hf, "max_window_layers", None)
-        if mwl is not None and 0 < mwl < hf.num_hidden_layers:
-            raise ValueError(
-                f"mixed full/sliding-window layers (max_window_layers="
-                f"{mwl} of {hf.num_hidden_layers}) are not supported "
-                "yet; set use_sliding_window=False or a uniform layout")
-        if mwl is not None and mwl >= hf.num_hidden_layers:
-            return None  # every layer below the threshold: full attention
-        return int(window)
+        if mwl is None or mwl <= 0:
+            return window, None
+        if mwl >= L:
+            return None, None  # every layer full attention
+        # First mwl layers full-causal, the rest windowed (Qwen2).
+        return window, (0, ) * mwl + (window, ) * (L - mwl)
 
     @classmethod
     def from_hf_config(cls, hf, dtype=jnp.bfloat16) -> "LlamaArchConfig":
         head_dim = getattr(hf, "head_dim", None) or (
             hf.hidden_size // hf.num_attention_heads)
+        sliding_window, window_pattern = cls._resolve_sliding_window(hf)
         return cls(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
@@ -148,7 +179,8 @@ class LlamaArchConfig:
             rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
             tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
             attention_bias=getattr(hf, "attention_bias", False),
-            sliding_window=cls._resolve_sliding_window(hf),
+            sliding_window=sliding_window,
+            window_pattern=window_pattern,
             num_experts=getattr(hf, "num_local_experts", 0),
             num_experts_per_tok=getattr(hf, "num_experts_per_tok", 2),
             dtype=dtype,
@@ -255,6 +287,11 @@ class LlamaForCausalLM:
                 "q_norm": P(None, None),
                 "k_norm": P(None, None),
             })
+        if c.extra_layer_norms:
+            layer.update({
+                "post_attn_ln": P(None, None),
+                "post_ffw_ln": P(None, None),
+            })
         self._add_scale_specs(layer)
         self._add_lora_specs(layer)
         return {
@@ -354,6 +391,11 @@ class LlamaForCausalLM:
                 "q_norm": jnp.ones((L, c.head_dim), c.dtype),
                 "k_norm": jnp.ones((L, c.head_dim), c.dtype),
             })
+        if c.extra_layer_norms:
+            layers.update({
+                "post_attn_ln": jnp.ones((L, H), c.dtype),
+                "post_ffw_ln": jnp.ones((L, H), c.dtype),
+            })
         self._maybe_replicate_kv(layers)
         self._install_lora_buffers(layers)
         embed = norm(next(keys), (c.vocab_size, H))
@@ -444,6 +486,22 @@ class LlamaForCausalLM:
                 "k_norm": stack("model.layers.{}.self_attn.k_norm.weight",
                                 transpose=False),
             })
+        if c.extra_layer_norms:
+            # Gemma2's 4-norm block renames the roles: HF
+            # post_attention_layernorm norms the attention OUTPUT (our
+            # post_attn_ln) and pre_feedforward_layernorm is the
+            # pre-MLP norm (our post_ln).
+            layers.update({
+                "post_ln": stack(
+                    "model.layers.{}.pre_feedforward_layernorm.weight",
+                    transpose=False),
+                "post_attn_ln": stack(
+                    "model.layers.{}.post_attention_layernorm.weight",
+                    transpose=False),
+                "post_ffw_ln": stack(
+                    "model.layers.{}.post_feedforward_layernorm.weight",
+                    transpose=False),
+            })
         self._maybe_replicate_kv(layers)
         embed = jnp.asarray(t("model.embed_tokens.weight"), dtype=c.dtype)
         if c.tie_word_embeddings or "lm_head.weight" not in tensors:
@@ -491,22 +549,71 @@ class LlamaForCausalLM:
             h = h * jnp.asarray(self.cfg.embed_scale, h.dtype)
         return h
 
+    @staticmethod
+    def _plan_window_segments(windows: tuple) -> list:
+        """Split a per-layer window tuple into scan segments.
+
+        Returns [(start, count, pattern)]: layers [start, start+count)
+        repeat ``pattern``. A short repeating period (Gemma2 alternates
+        sliding/full -> period 2) becomes ONE lax.scan whose step
+        unrolls the period with a static window each; otherwise runs of
+        constant window (Qwen2's first-N-full layouts -> 2 runs) each
+        get their own scan. Every attention mask stays STATIC per scan
+        step — the XLA-friendly alternative to a traced window bound.
+
+        Odd-length slices of a periodic layout (a Gemma2 PP stage with
+        21 of 42 layers) keep the periodic bulk and peel only the
+        remainder — two scans, not a per-layer unroll."""
+        n = len(windows)
+        for period in range(1, min(8, n) + 1):
+            bulk = period * (n // period)
+            # Require >= 2 repetitions: any period trivially "matches" a
+            # bulk of itself, which would mis-plan run layouts.
+            if n // period >= 2 and all(windows[i] == windows[i % period]
+                                        for i in range(bulk)):
+                segments = [(0, bulk, tuple(windows[:period]))]
+                if bulk < n:
+                    segments.append(
+                        (bulk, n - bulk, tuple(windows[bulk:])))
+                return segments
+        segments = []
+        i = 0
+        while i < n:
+            j = i
+            while j < n and windows[j] == windows[i]:
+                j += 1
+            segments.append((i, j - i, (windows[i], )))
+            i = j
+        return segments
+
+    def _layer_windows(self, first_layer: int, num_layers: int) -> tuple:
+        """Static window per layer for a [first_layer, +num_layers)
+        slice of the model."""
+        c = self.cfg
+        if c.window_pattern is not None:
+            return tuple(
+                c.window_pattern[first_layer:first_layer + num_layers])
+        return (c.sliding_window or 0, ) * num_layers
+
     def run_layers(
         self,
         layer_params: dict,
         kv_caches: dict,
         hidden: jax.Array,  # [T, H]
         batch: AttentionBatch,
+        first_layer: int = 0,
     ) -> tuple[jax.Array, dict]:
         """Run a contiguous slice of decoder layers over the hidden
         states. ``layer_params`` is a stacked [Ls, ...] subtree and
         ``kv_caches`` that slice's own [Ls, ...] cache — under pipeline
         parallelism each stage calls this with its local slice
         (reference: the per-stage module list built by get_pp_indices,
-        distributed/utils.py:89)."""
+        distributed/utils.py:89). ``first_layer`` is the slice's global
+        offset, selecting the right rows of mixed window layouts
+        (static — PP keys its stage jit on it for patterned models)."""
         c = self.cfg
         T = hidden.shape[0]
-        sm_scale = c.head_dim ** -0.5
+        sm_scale = (c.query_pre_attn_scalar or c.head_dim) ** -0.5
         num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
         cos, sin = compute_rope_cos_sin(batch.positions, c.head_dim,
                                         c.rope_theta, c.rope_scaling,
@@ -522,9 +629,7 @@ class LlamaForCausalLM:
         # v1/attention/backends/pallas.py:282 aliased kv_cache_update).
         lora_ctx = batch.lora
 
-        def layer_fn(carry, xs):
-            h, k_all, v_all = carry
-            lp, layer_idx = xs
+        def layer_body(h, k_all, v_all, lp, layer_idx, window):
             x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
             q = x @ self._w(lp, "wq") + self._lora_delta(lp, "wq", x,
                                                          lora_ctx)
@@ -552,18 +657,52 @@ class LlamaForCausalLM:
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,
                                    sm_scale=sm_scale, layer=layer_idx,
-                                   window=c.sliding_window or 0)
+                                   window=window,
+                                   logit_cap=c.attn_logit_softcap)
             attn2d = attn.reshape(T, -1)
-            h = h + (attn2d @ self._w(lp, "wo") +
-                     self._lora_delta(lp, "wo", attn2d, lora_ctx))
+            attn_out = (attn2d @ self._w(lp, "wo") +
+                        self._lora_delta(lp, "wo", attn2d, lora_ctx))
+            if "post_attn_ln" in lp:
+                # Gemma2 sandwich norm on the attention output.
+                attn_out = rms_norm(attn_out, lp["post_attn_ln"],
+                                    c.rms_norm_eps)
+            h = h + attn_out
             x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
-            h = h + self.mlp_block(lp, x2, lora_ctx)
-            return (h, k_all, v_all), None
+            mlp_out = self.mlp_block(lp, x2, lora_ctx)
+            if "post_ffw_ln" in lp:
+                mlp_out = rms_norm(mlp_out, lp["post_ffw_ln"],
+                                   c.rms_norm_eps)
+            h = h + mlp_out
+            return h, k_all, v_all
 
+        windows = self._layer_windows(first_layer, num_layers)
+        segments = self._plan_window_segments(windows)
         layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]
-        (hidden, k_all, v_all), _ = jax.lax.scan(
-            layer_fn, (hidden, kv_caches["k"], kv_caches["v"]),
-            (layer_params, layer_ids))
+        carry = (hidden, kv_caches["k"], kv_caches["v"])
+        for start, count, pattern in segments:
+            if len(segments) == 1:
+                lp_seg, ids_seg = layer_params, layer_ids
+            else:
+                lp_seg = jax.tree.map(lambda a: a[start:start + count],
+                                      layer_params)
+                ids_seg = layer_ids[start:start + count]
+            period = len(pattern)
+            steps = count // period
+            lp_seg = jax.tree.map(
+                lambda a: a.reshape(steps, period, *a.shape[1:]), lp_seg)
+            ids_seg = ids_seg.reshape(steps, period, 1)
+
+            def scan_fn(car, xs, pattern=pattern):
+                h, k_all, v_all = car
+                lp_grp, id_grp = xs
+                for j, w in enumerate(pattern):
+                    lp_j = jax.tree.map(lambda a: a[j], lp_grp)
+                    h, k_all, v_all = layer_body(h, k_all, v_all, lp_j,
+                                                 id_grp[j], w)
+                return (h, k_all, v_all), None
+
+            carry, _ = jax.lax.scan(scan_fn, carry, (lp_seg, ids_seg))
+        hidden, k_all, v_all = carry
         return hidden, {"k": k_all, "v": v_all}
 
     def forward(
@@ -582,5 +721,11 @@ class LlamaForCausalLM:
                        hidden: jax.Array) -> jax.Array:
         """Final norm + LM head on selected rows; fp32 logits."""
         x = rms_norm(hidden, params["final_ln"], self.cfg.rms_norm_eps)
-        return jnp.dot(x, params["lm_head"],
-                       preferred_element_type=jnp.float32)
+        logits = jnp.dot(x, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        cap = self.cfg.final_logit_softcap
+        if cap:
+            # Gemma2 final soft-capping (monotone: greedy order kept,
+            # logprobs match HF).
+            logits = cap * jnp.tanh(logits / cap)
+        return logits

@@ -83,12 +83,14 @@ def get_model(config: EngineConfig, mesh,
     if config.lora_config.enable_lora:
         arch.max_loras = config.lora_config.max_loras
         arch.max_lora_rank = config.lora_config.max_lora_rank
-    if (arch.sliding_window
+    if ((arch.sliding_window or arch.window_pattern
+         or arch.attn_logit_softcap)
             and config.parallel_config.token_parallel_size > 1):
         raise ValueError(
-            "sliding-window attention under token parallelism is not "
-            "wired yet (the per-rank attention path has no window "
-            "bound); disable one of the two")
+            "sliding-window attention / attention logit soft-capping "
+            "under token parallelism is not wired yet (the per-rank "
+            "attention path carries neither bound); disable one of the "
+            "two")
     # KV-head replication when TP exceeds the checkpoint's KV-head count
     # (reference: QKVParallelLinear kv replication, layers/linear.py):
     # repeat heads to the lcm so the kv-head dim divides the model axis.

@@ -6,7 +6,8 @@ a pre-norm RoPE decoder with SwiGLU MLP and optional QKV bias — which is
 Llama 2/3, Mistral, Qwen2, and friends.
 """
 
-from vllm_distributed_tpu.models.families import (GemmaForCausalLM,
+from vllm_distributed_tpu.models.families import (Gemma2ForCausalLM,
+                                                  GemmaForCausalLM,
                                                   Phi3ForCausalLM,
                                                   Qwen3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
@@ -22,6 +23,7 @@ _REGISTRY: dict[str, type] = {
     "YiForCausalLM": LlamaForCausalLM,
     "MixtralForCausalLM": MixtralForCausalLM,
     "GemmaForCausalLM": GemmaForCausalLM,
+    "Gemma2ForCausalLM": Gemma2ForCausalLM,
     "Qwen3ForCausalLM": Qwen3ForCausalLM,
     "Phi3ForCausalLM": Phi3ForCausalLM,
 }

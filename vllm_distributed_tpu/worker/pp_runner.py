@@ -119,13 +119,15 @@ class PPModelRunner(TPUModelRunner):
         def embed(params, token_ids):
             return model.embed(params, token_ids)
 
-        def stage(layer_params, kv_caches, hidden, batch):
+        def stage(layer_params, kv_caches, hidden, batch, first_layer=0):
             hidden, kv_caches = model.run_layers(layer_params, kv_caches,
-                                                 hidden, batch)
+                                                 hidden, batch,
+                                                 first_layer=first_layer)
             return kv_caches, hidden
 
         self._embed_fn = jax.jit(embed)
-        self._stage_fn = jax.jit(stage, donate_argnums=(1, ))
+        self._stage_fn = jax.jit(stage, donate_argnums=(1, ),
+                                 static_argnames=("first_layer", ))
         # Base sampler jits (compute_logits + sampling) work unchanged —
         # they only touch self.params (final_ln/lm_head on the last
         # stage's sub-mesh).
@@ -138,6 +140,13 @@ class PPModelRunner(TPUModelRunner):
         raise RuntimeError("single-program forward is not used under PP")
 
     # ------------------------------------------------------------------
+    def _stage_first_layer(self, p: int) -> int:
+        """Global layer offset of stage p — nonzero only for mixed
+        window layouts, so uniform models keep sharing one compiled
+        stage program across equal-shape stages."""
+        return (self.layer_ranges[p][0]
+                if self.model.cfg.window_pattern else 0)
+
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
                             vocab_mask=None):
@@ -161,7 +170,7 @@ class PPModelRunner(TPUModelRunner):
                 with self._compile_watch(("stage", p) + fwd_shape):
                     self.kv_caches[p], hidden = self._stage_fn(
                         self.stage_params[p], self.kv_caches[p], hidden,
-                        batch)
+                        batch, first_layer=self._stage_first_layer(p))
         sml = self.stage_meshes[-1]
         with global_mesh(sml), sml:
             return self._launch_sample(hidden, logits_indices,
@@ -189,7 +198,8 @@ class PPModelRunner(TPUModelRunner):
                     with self._compile_watch(("stage", p, T, max_q, G)):
                         self.kv_caches[p], hidden = self._stage_fn(
                             self.stage_params[p], self.kv_caches[p],
-                            hidden, batch)
+                            hidden, batch,
+                            first_layer=self._stage_first_layer(p))
             jax.block_until_ready(hidden)
         sml = self.stage_meshes[-1]
         with global_mesh(sml), sml:
@@ -229,7 +239,8 @@ class PPModelRunner(TPUModelRunner):
                                     NamedSharding(sm, PartitionSpec()))
             with global_mesh(sm), sm:
                 scratch[p], hidden = self._stage_fn(
-                    self.stage_params[p], scratch[p], hidden, batch)
+                    self.stage_params[p], scratch[p], hidden, batch,
+                    first_layer=self._stage_first_layer(p))
         jax.block_until_ready(hidden)
         del scratch, hidden
         peak = 0
