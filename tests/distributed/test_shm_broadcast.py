@@ -1,0 +1,111 @@
+"""Native shared-memory broadcast MessageQueue (model: the reference's
+tests/distributed/test_shm_broadcast.py exercising ShmRingBuffer /
+MessageQueue): FIFO broadcast to every reader, multi-chunk framing,
+join handshake, and writer backpressure when a reader stalls."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from vllm_distributed_tpu.distributed.shm_broadcast import (MessageQueue,
+                                                            ShmRingError)
+
+
+def _name(tag):
+    return f"/vdt_shmtest_{tag}_{os.getpid()}"
+
+
+def test_roundtrip_including_multichunk():
+    name = _name("rt")
+    w = MessageQueue.create(name, num_readers=1, chunk_size=64,
+                            num_chunks=4)
+    r = MessageQueue.join(name)
+    msgs = ["hello", {"a": 1, "b": [2, 3]}, list(range(400)),
+            b"x" * 5000, None]
+    got = []
+    t = threading.Thread(
+        target=lambda: [got.append(r.dequeue(10)) for _ in msgs])
+    t.start()
+    for m in msgs:
+        w.enqueue(m, timeout=10)
+    t.join(20)
+    assert not t.is_alive()
+    assert got == msgs
+    r.close()
+    w.close()
+
+
+def test_writer_handshake_times_out_without_readers():
+    name = _name("hs")
+    w = MessageQueue.create(name, num_readers=1)
+    with pytest.raises(ShmRingError, match="readers joined"):
+        w.enqueue("x", timeout=0.2)
+    w.close()
+
+
+def test_writer_blocks_on_stalled_reader():
+    """Ring full + a reader that never drains -> bounded enqueue error,
+    not silent overwrite (broadcast must be lossless)."""
+    name = _name("bp")
+    w = MessageQueue.create(name, num_readers=1, chunk_size=32,
+                            num_chunks=2)
+    r = MessageQueue.join(name)
+    w.enqueue("a", timeout=5)
+    w.enqueue("b", timeout=5)  # ring now full, reader consumed nothing
+    with pytest.raises(ShmRingError, match="not drained"):
+        w.enqueue("c", timeout=0.3)
+    # Draining un-wedges the writer.
+    assert r.dequeue(5) == "a"
+    w.enqueue("c", timeout=5)
+    assert r.dequeue(5) == "b"
+    assert r.dequeue(5) == "c"
+    r.close()
+    w.close()
+
+
+_READER = r"""
+import sys
+from vllm_distributed_tpu.distributed.shm_broadcast import MessageQueue
+mq = MessageQueue.join(sys.argv[1], timeout=30)
+got = []
+while True:
+    m = mq.dequeue(timeout=30)
+    if m == "__done__":
+        break
+    got.append(m)
+print("GOT", got, flush=True)
+mq.close()
+"""
+
+
+def test_two_process_broadcast_every_reader_sees_every_message():
+    name = _name("mp")
+    w = MessageQueue.create(name, num_readers=2, chunk_size=128,
+                            num_chunks=8)
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _READER, name],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True, env=env)
+        for _ in range(2)
+    ]
+    msgs = [f"m{i}" for i in range(20)] + [{"big": "y" * 600}]
+    for m in msgs:
+        w.enqueue(m, timeout=30)
+    w.enqueue("__done__", timeout=30)
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    w.close()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"reader {i} failed:\n{out[-2000:]}"
+        assert f"GOT {msgs!r}"[:40] in out or str(msgs) in out, out[-500:]

@@ -11,6 +11,7 @@ across them — the same multi-controller layout a v5e pod uses, here with
 2 processes x 4 virtual CPU devices.
 """
 
+import os
 import subprocess
 import sys
 
@@ -77,7 +78,7 @@ print("RESULT", rank, sorted(done.items()), flush=True)
 
 _DRIVER = r"""
 import os, sys
-rank = int(sys.argv[1]); port = sys.argv[2]; bport = sys.argv[3]
+rank = int(sys.argv[1]); port = sys.argv[2]; baddr = sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["VDT_PALLAS_INTERPRET"] = "1"
@@ -90,7 +91,7 @@ from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
                                          ParallelConfig, SchedulerConfig)
 from transformers import LlamaConfig
 
-def make_config(rank, port, bport):
+def make_config(rank, port, baddr):
     config = EngineConfig(
         model_config=ModelConfig(
             model="dummy-mh-exec", dtype="float32", max_model_len=64,
@@ -108,13 +109,13 @@ def make_config(rank, port, bport):
         parallel_config=ParallelConfig(
             tensor_parallel_size=8, num_hosts=2, host_rank=rank,
             coordinator_address=f"127.0.0.1:{port}",
-            broadcast_addr=f"tcp://127.0.0.1:{bport}"),
+            broadcast_addr=baddr),
     )
     config.model_config.hf_config = LlamaConfig(
         **config.model_config.hf_overrides)
     return config
 
-config = make_config(rank, port, bport)
+config = make_config(rank, port, baddr)
 if rank == 0:
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     from vllm_distributed_tpu.sampling_params import SamplingParams
@@ -142,13 +143,17 @@ else:
 """
 
 
-def test_scheduler_broadcast_executor(tmp_path):
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_scheduler_broadcast_executor(tmp_path, transport):
     """Host 0 schedules + broadcasts; host 1 replays worker steps SPMD
-    (the MultiprocExecutor-boundary equivalent)."""
+    (the MultiprocExecutor-boundary equivalent). Runs over both the ZMQ
+    TCP transport and the native shared-memory ring (shm://)."""
     port, bport = get_open_port(), get_open_port()
+    baddr = (f"tcp://127.0.0.1:{bport}" if transport == "tcp"
+             else f"shm://vdt_mh_{os.getpid()}_{bport}")
     procs = [
         subprocess.Popen([sys.executable, "-c", _DRIVER, str(rank),
-                          str(port), str(bport)],
+                          str(port), baddr],
                          stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
         for rank in range(2)

@@ -35,12 +35,49 @@ logger = init_logger(__name__)
 _STOP = b"__stop__"
 
 
+class _ShmPub:
+    """Publisher over the native shared-memory ring (broadcast_addr
+    "shm://<name>"). Same-host pods skip the TCP hop — the reference's
+    shm MessageQueue fast path (device_communicators/shm_broadcast.py)."""
+
+    def __init__(self, name: str, num_readers: int) -> None:
+        from vllm_distributed_tpu.distributed.shm_broadcast import (
+            MessageQueue)
+        self._mq = MessageQueue.create("/" + name, num_readers)
+
+    def send(self, payload: bytes) -> None:
+        # Callers pass pickled bytes already; skip a second pickle.
+        self._mq.enqueue_bytes(payload, timeout=120.0)
+
+    def close(self, linger: int = 0) -> None:
+        self._mq.close()
+
+
+class _ShmSub:
+
+    def __init__(self, name: str) -> None:
+        from vllm_distributed_tpu.distributed.shm_broadcast import (
+            MessageQueue)
+        self._mq = MessageQueue.join("/" + name, timeout=120.0)
+
+    def recv(self) -> bytes:
+        # Generous: the writer may spend minutes in model load / HBM
+        # profiling between messages.
+        return self._mq.dequeue_bytes(timeout=3600.0)
+
+    def close(self) -> None:
+        self._mq.close()
+
+
+def _shm_name(addr: str) -> Optional[str]:
+    return addr[len("shm://"):] if addr.startswith("shm://") else None
+
+
 class MultiHostExecutor(UniProcExecutor):
     """Host 0's executor: local SPMD worker + step broadcast to the
     other hosts' followers."""
 
     def __init__(self, config: EngineConfig) -> None:
-        import zmq
         pc = config.parallel_config
         assert pc.num_hosts > 1 and pc.host_rank == 0, \
             "MultiHostExecutor runs on host 0 of a multi-host pod"
@@ -49,11 +86,16 @@ class MultiHostExecutor(UniProcExecutor):
                 "pipeline parallelism with the broadcast executor needs "
                 "async-dispatch broadcasting (execute_model_async); not "
                 "wired yet — use lockstep mode (no broadcast_addr)")
-        self._ctx = zmq.Context.instance()
-        self._pub = self._ctx.socket(zmq.PUB)
         addr = pc.broadcast_addr
         assert addr, "ParallelConfig.broadcast_addr required (host0 ip)"
-        self._pub.bind(addr)
+        shm = _shm_name(addr)
+        if shm is not None:
+            self._pub = _ShmPub(shm, num_readers=pc.num_hosts - 1)
+        else:
+            import zmq
+            self._ctx = zmq.Context.instance()
+            self._pub = self._ctx.socket(zmq.PUB)
+            self._pub.bind(addr)
         super().__init__(config)  # device init joins jax.distributed
 
     def _broadcast(self, payload: bytes) -> None:
@@ -95,16 +137,19 @@ def run_worker_follower(config: EngineConfig,
     WorkerProc.worker_busy_loop, multiproc_executor.py:603): join the
     pod, build the local worker, replay broadcast steps until the stop
     sentinel. Returns the number of steps executed."""
-    import zmq
-
     from vllm_distributed_tpu.worker.worker import TPUWorker
     pc = config.parallel_config
     assert pc.num_hosts > 1 and pc.host_rank > 0
 
-    ctx = zmq.Context.instance()
-    sub = ctx.socket(zmq.SUB)
-    sub.setsockopt(zmq.SUBSCRIBE, b"")
-    sub.connect(pc.broadcast_addr)
+    shm = _shm_name(pc.broadcast_addr)
+    if shm is not None:
+        sub = _ShmSub(shm)
+    else:
+        import zmq
+        ctx = zmq.Context.instance()
+        sub = ctx.socket(zmq.SUB)
+        sub.setsockopt(zmq.SUBSCRIBE, b"")
+        sub.connect(pc.broadcast_addr)
 
     # Every jitted program over the global mesh is a COLLECTIVE across
     # hosts: the follower must enter the same programs in the same

@@ -1,0 +1,287 @@
+// Lock-free single-writer / multi-reader broadcast ring over POSIX
+// shared memory.
+//
+// Native-runtime equivalent of the reference's
+// vllm/distributed/device_communicators/shm_broadcast.py (ShmRingBuffer +
+// MessageQueue): one producer broadcasts serialized control messages
+// (scheduler outputs, engine RPCs) to N same-host consumer processes
+// without a socket hop or per-message syscalls. The Python layer
+// (distributed/shm_broadcast.py) chunks pickled payloads into fixed-size
+// slots; this file owns the shared-memory layout and the atomic
+// slot-handoff protocol only — no serialization, no Python objects.
+//
+// Layout (all cache-line aligned):
+//   Header { magic, chunk_size, num_chunks, max_readers, num_readers,
+//            writer_seq }                     -- one per segment
+//   SlotState[num_chunks] { seq, read_mask }  -- per-slot handoff state
+//   data[num_chunks][chunk_size]              -- payload slots
+//
+// Protocol (seqlock-flavored, same invariants as the reference's
+// written_flag/read_count bytes but word-sized and explicitly atomic):
+//   * Writer claims slot (writer_seq % num_chunks) and spins until every
+//     registered reader has consumed the slot's PREVIOUS lap (read_mask
+//     full or slot never written). It then copies the payload, publishes
+//     by storing seq = writer_seq + 1 (release), clears read_mask, and
+//     bumps writer_seq.
+//   * Reader r spins on slot (reader_seq % num_chunks) until seq ==
+//     reader_seq + 1 (acquire), copies the payload out, then sets bit r
+//     in read_mask (release) and bumps its private reader_seq.
+//   * All waits are bounded by a caller deadline; -2 = timeout.
+//
+// Spin-waits sleep 50us after a short hot phase: control messages are
+// ~KHz, so the writer/readers are usually first-try; the sleep bounds
+// burn when a reader stalls (e.g. under a debugger).
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x53484d52494e4731ull;  // "SHMRING1"
+constexpr int kMaxReaders = 64;
+
+struct alignas(64) Header {
+  std::atomic<uint64_t> magic;
+  uint64_t chunk_size;
+  uint64_t num_chunks;
+  uint64_t max_readers;
+  std::atomic<uint64_t> num_readers;
+  std::atomic<uint64_t> writer_seq;
+};
+
+struct alignas(64) SlotState {
+  std::atomic<uint64_t> seq;        // last published lap + 1; 0 = never
+  std::atomic<uint64_t> read_mask;  // bit r: reader r consumed this lap
+  std::atomic<uint64_t> len;        // payload bytes in this slot's lap
+};
+
+struct Ring {
+  int fd;
+  size_t map_len;
+  Header* hdr;
+  SlotState* slots;
+  uint8_t* data;
+};
+
+size_t segment_len(uint64_t chunk_size, uint64_t num_chunks) {
+  return sizeof(Header) + num_chunks * sizeof(SlotState) +
+         num_chunks * chunk_size;
+}
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + 1e-9 * ts.tv_nsec;
+}
+
+// Bounded spin: hot for ~20us, then 50us sleeps until the deadline.
+// Returns false on timeout.
+template <typename Cond>
+bool spin_until(Cond cond, double timeout_s) {
+  const double deadline = now_s() + timeout_s;
+  for (int i = 0; i < 200; ++i) {
+    if (cond()) return true;
+  }
+  while (now_s() < deadline) {
+    if (cond()) return true;
+    struct timespec ts = {0, 50 * 1000};
+    nanosleep(&ts, nullptr);
+  }
+  return cond();
+}
+
+Ring* map_ring(int fd, size_t len) {
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->fd = fd;
+  r->map_len = len;
+  r->hdr = static_cast<Header*>(mem);
+  r->slots = reinterpret_cast<SlotState*>(static_cast<uint8_t*>(mem) +
+                                          sizeof(Header));
+  r->data = reinterpret_cast<uint8_t*>(r->slots) +
+            r->hdr->num_chunks * sizeof(SlotState);
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a fresh segment (unlinks any stale one). Returns handle or null.
+void* shm_ring_create(const char* name, uint64_t chunk_size,
+                      uint64_t num_chunks) {
+  if (num_chunks == 0 || chunk_size == 0) return nullptr;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = segment_len(chunk_size, num_chunks);
+  if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = map_ring(fd, len);
+  if (!r) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  std::memset(static_cast<void*>(r->hdr), 0, sizeof(Header));
+  r->hdr->chunk_size = chunk_size;
+  r->hdr->num_chunks = num_chunks;
+  r->hdr->max_readers = kMaxReaders;
+  // data pointer depends on num_chunks, recompute after init
+  r->slots = reinterpret_cast<SlotState*>(
+      reinterpret_cast<uint8_t*>(r->hdr) + sizeof(Header));
+  r->data = reinterpret_cast<uint8_t*>(r->slots) +
+            num_chunks * sizeof(SlotState);
+  for (uint64_t i = 0; i < num_chunks; ++i) {
+    r->slots[i].seq.store(0, std::memory_order_relaxed);
+    r->slots[i].read_mask.store(0, std::memory_order_relaxed);
+    r->slots[i].len.store(0, std::memory_order_relaxed);
+  }
+  r->hdr->magic.store(kMagic, std::memory_order_release);
+  return r;
+}
+
+// Attach to an existing segment; spins until the creator published the
+// magic or the timeout lapses. Returns handle or null.
+void* shm_ring_open(const char* name, double timeout_s) {
+  const double deadline = now_s() + timeout_s;
+  int fd = -1;
+  while (fd < 0) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) {
+      if (now_s() >= deadline) return nullptr;
+      struct timespec ts = {0, 200 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  // Header first, to learn the geometry.
+  void* head = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED, fd, 0);
+  if (head == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(head);
+  bool ok = spin_until(
+      [&] { return h->magic.load(std::memory_order_acquire) == kMagic; },
+      timeout_s);
+  uint64_t chunk_size = h->chunk_size;
+  uint64_t num_chunks = h->num_chunks;
+  munmap(head, sizeof(Header));
+  if (!ok) {
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = map_ring(fd, segment_len(chunk_size, num_chunks));
+  if (!r) close(fd);
+  return r;
+}
+
+// Register this process as a reader; returns the reader rank, or -1 when
+// the reader table is full.
+int64_t shm_ring_register_reader(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t rank = r->hdr->num_readers.fetch_add(1);
+  if (rank >= r->hdr->max_readers) return -1;
+  return static_cast<int64_t>(rank);
+}
+
+uint64_t shm_ring_chunk_size(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->chunk_size;
+}
+
+uint64_t shm_ring_num_chunks(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->num_chunks;
+}
+
+// Broadcast one chunk (len <= chunk_size). Blocks until the target slot
+// has been drained by every registered reader from the previous lap.
+// Returns 0 ok, -1 bad args, -2 timeout.
+int64_t shm_ring_write(void* handle, const uint8_t* buf, uint64_t len,
+                       double timeout_s) {
+  Ring* r = static_cast<Ring*>(handle);
+  if (len > r->hdr->chunk_size) return -1;
+  const uint64_t wseq = r->hdr->writer_seq.load(std::memory_order_relaxed);
+  SlotState& slot = r->slots[wseq % r->hdr->num_chunks];
+  // Previous lap fully consumed? Readers registered NOW must have read
+  // it; readers that joined later start at the current writer_seq and
+  // never touch old laps (the Python layer hands them the start seq).
+  bool ok = spin_until(
+      [&] {
+        if (slot.seq.load(std::memory_order_acquire) == 0) return true;
+        uint64_t readers = r->hdr->num_readers.load();
+        uint64_t want = readers >= 64 ? ~0ull : ((1ull << readers) - 1);
+        uint64_t mask = slot.read_mask.load(std::memory_order_acquire);
+        return (mask & want) == want;
+      },
+      timeout_s);
+  if (!ok) return -2;
+  uint8_t* dst = r->data + (wseq % r->hdr->num_chunks) * r->hdr->chunk_size;
+  std::memcpy(dst, buf, len);
+  slot.len.store(len, std::memory_order_relaxed);
+  slot.read_mask.store(0, std::memory_order_relaxed);
+  slot.seq.store(wseq + 1, std::memory_order_release);
+  r->hdr->writer_seq.store(wseq + 1, std::memory_order_release);
+  return 0;
+}
+
+// Registered reader count (writer-side join handshake).
+uint64_t shm_ring_reader_count(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->num_readers.load(
+      std::memory_order_acquire);
+}
+
+// Current writer sequence — a new reader's starting cursor.
+uint64_t shm_ring_writer_seq(void* handle) {
+  return static_cast<Ring*>(handle)
+      ->hdr->writer_seq.load(std::memory_order_acquire);
+}
+
+// Read the chunk at sequence `seq` as reader `rank` into buf. Blocks
+// until the writer publishes it. Returns the payload length (only that
+// many bytes are copied — control messages are ~KB in MB-sized slots),
+// -2 timeout, -3 overrun (writer lapped this reader: the slot now holds
+// a NEWER lap — the queue was sized too small for the lag).
+int64_t shm_ring_read(void* handle, int64_t rank, uint64_t seq,
+                      uint8_t* buf, double timeout_s) {
+  Ring* r = static_cast<Ring*>(handle);
+  SlotState& slot = r->slots[seq % r->hdr->num_chunks];
+  bool ok = spin_until(
+      [&] {
+        return slot.seq.load(std::memory_order_acquire) >= seq + 1;
+      },
+      timeout_s);
+  if (!ok) return -2;
+  if (slot.seq.load(std::memory_order_acquire) != seq + 1) return -3;
+  const uint64_t len = slot.len.load(std::memory_order_relaxed);
+  const uint8_t* src =
+      r->data + (seq % r->hdr->num_chunks) * r->hdr->chunk_size;
+  std::memcpy(buf, src, len);
+  // Torn read if the writer lapped mid-copy (it can't — it waits for
+  // read_mask — but a reader that never registered could race): verify.
+  if (slot.seq.load(std::memory_order_acquire) != seq + 1) return -3;
+  slot.read_mask.fetch_or(1ull << rank, std::memory_order_release);
+  return static_cast<int64_t>(len);
+}
+
+void shm_ring_close(void* handle, const char* unlink_name) {
+  Ring* r = static_cast<Ring*>(handle);
+  munmap(static_cast<void*>(r->hdr), r->map_len);
+  close(r->fd);
+  if (unlink_name != nullptr) shm_unlink(unlink_name);
+  delete r;
+}
+
+}  // extern "C"
