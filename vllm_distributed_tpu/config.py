@@ -188,6 +188,14 @@ class ParallelConfig:
     data_parallel_size: int = 1
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    # Megatron-style sequence parallelism over the TP group (reference:
+    # CompilationConfig.pass_config.enable_sequence_parallelism + the
+    # sequence_parallelism.py compile pass): the residual stream is
+    # constrained token-sharded on the "model" axis between blocks, so
+    # XLA turns each TP all-reduce into reduce-scatter + all-gather and
+    # norms/elementwise run on 1/tp of the tokens. GSPMD does the
+    # rewrite the reference implements as a custom torch.fx pass.
+    enable_sequence_parallel: bool = False
     # EPLB: extra physical expert slots hosting replicas of hot experts
     # (reference: ParallelConfig num_redundant_experts + eplb config).
     num_redundant_experts: int = 0

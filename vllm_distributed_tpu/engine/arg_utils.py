@@ -40,6 +40,7 @@ class EngineArgs:
     data_parallel_mode: str = "engine"  # engine replicas | mesh axis
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
+    enable_sequence_parallel: bool = False
     num_redundant_experts: int = 0
     multiprocess_engine_core: bool = False
     # Multi-host SPMD: this engine process's place in the pod.
@@ -104,6 +105,7 @@ class EngineArgs:
                 data_parallel_mode=self.data_parallel_mode,
                 token_parallel_size=self.token_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
+                enable_sequence_parallel=self.enable_sequence_parallel,
                 num_redundant_experts=self.num_redundant_experts,
                 multiprocess_engine_core=self.multiprocess_engine_core,
                 num_hosts=self.num_hosts,

@@ -115,6 +115,10 @@ class LlamaArchConfig:
     # Gemma2-style sandwich norms: an extra RMSNorm on each sub-block's
     # OUTPUT (attention and MLP) before the residual add.
     extra_layer_norms: bool = False
+    # Sequence parallelism: constrain the residual stream token-sharded
+    # on the model axis between blocks (see ParallelConfig.
+    # enable_sequence_parallel).
+    sequence_parallel: bool = False
     # Family knobs reused by Llama-shaped variants: embedding scale
     # (Gemma multiplies by sqrt(H)), MLP activation, per-head q/k
     # RMSNorm (Qwen3).
@@ -629,6 +633,33 @@ class LlamaForCausalLM:
         # v1/attention/backends/pallas.py:282 aliased kv_cache_update).
         lora_ctx = batch.lora
 
+        # Sequence parallelism (reference: the sequence_parallelism
+        # compile pass rewriting allreduce -> reduce_scatter +
+        # all_gather): pin the residual stream token-sharded on the
+        # model axis at block boundaries; GSPMD then scatters the
+        # row-parallel matmul reductions and gathers before the next
+        # column-parallel one, and norms/adds run on T/tp tokens. The
+        # sharding binds to the registered engine mesh so the constraint
+        # works under jit without an ambient mesh context.
+        # The token dim shards over data x model jointly so mesh-mode DP
+        # keeps its batch split (equivalent to model-only when the data
+        # axis is 1, i.e. the serving engine path).
+        sp_spec = P(("data", MODEL_AXIS), None)
+        sp_sharding = None
+        if c.sequence_parallel:
+            from jax.sharding import NamedSharding
+
+            from vllm_distributed_tpu.parallel import mesh as mesh_state
+            if mesh_state.has_global_mesh():
+                sp_sharding = NamedSharding(
+                    mesh_state.get_global_mesh(), sp_spec)
+
+        def sp(h):
+            if not c.sequence_parallel:
+                return h
+            return jax.lax.with_sharding_constraint(
+                h, sp_sharding if sp_sharding is not None else sp_spec)
+
         def layer_body(h, k_all, v_all, lp, layer_idx, window):
             x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
             q = x @ self._w(lp, "wq") + self._lora_delta(lp, "wq", x,
@@ -666,19 +697,19 @@ class LlamaForCausalLM:
                 # Gemma2 sandwich norm on the attention output.
                 attn_out = rms_norm(attn_out, lp["post_attn_ln"],
                                     c.rms_norm_eps)
-            h = h + attn_out
+            h = sp(h + attn_out)
             x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
             mlp_out = self.mlp_block(lp, x2, lora_ctx)
             if "post_ffw_ln" in lp:
                 mlp_out = rms_norm(mlp_out, lp["post_ffw_ln"],
                                    c.rms_norm_eps)
-            h = h + mlp_out
+            h = sp(h + mlp_out)
             return h, k_all, v_all
 
         windows = self._layer_windows(first_layer, num_layers)
         segments = self._plan_window_segments(windows)
         layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]
-        carry = (hidden, kv_caches["k"], kv_caches["v"])
+        carry = (sp(hidden), kv_caches["k"], kv_caches["v"])
         for start, count, pattern in segments:
             if len(segments) == 1:
                 lp_seg, ids_seg = layer_params, layer_ids

@@ -65,6 +65,15 @@ def get_model(config: EngineConfig, mesh,
     arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
     model_cls.configure_arch(arch, hf_config)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
+    if (config.parallel_config.enable_sequence_parallel
+            and config.parallel_config.token_parallel_size > 1):
+        raise ValueError(
+            "sequence parallelism under token parallelism is not wired "
+            "(the TKNP attention shard_maps assume token-replicated "
+            "activations); disable one of the two")
+    arch.sequence_parallel = (
+        config.parallel_config.enable_sequence_parallel
+        and config.parallel_config.tensor_parallel_size > 1)
     arch.quantization = config.model_config.quantization
     if arch.num_experts and config.parallel_config.num_redundant_experts:
         arch.num_physical_experts = (
