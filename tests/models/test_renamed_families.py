@@ -1,0 +1,131 @@
+"""InternLM2 / Baichuan: Llama math behind renamed + fused checkpoint
+tensors (reference: models/internlm2.py split_qkv, models/baichuan.py
+W_pack). transformers ships neither class (both are trust_remote_code
+upstream), so parity is proven by EQUIVALENCE: rewrite a Llama
+checkpoint into each format and require byte-identical engine outputs
+on the same underlying weights."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import torch
+from safetensors.numpy import save_file
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+CFG = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=64,
+           eos_token_id=1)
+HEAD_DIM = 16
+
+
+@pytest.fixture(scope="module")
+def llama_ckpt(tmp_path_factory):
+    torch.manual_seed(0)
+    hf = HFLlama(LlamaConfig(**CFG))
+    path = tmp_path_factory.mktemp("tiny_llama_base")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def _state(path):
+    from safetensors import safe_open
+    import glob
+    out = {}
+    for f in glob.glob(os.path.join(path, "*.safetensors")):
+        with safe_open(f, framework="np") as r:
+            for k in r.keys():
+                out[k] = r.get_tensor(k)
+    return out
+
+
+def _save_variant(tmp_path_factory, name, arch, tensors):
+    path = str(tmp_path_factory.mktemp(name))
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    cfg = dict(CFG, architectures=[arch], model_type="llama")
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    return path
+
+
+def run(path, prompts):
+    engine = LLMEngine(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r-{i}", p, sp)
+    done = {}
+    for _ in range(200):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out.outputs[0].token_ids
+        if not engine.has_unfinished_requests():
+            break
+    return [done[f"r-{i}"] for i in range(len(prompts))]
+
+
+PROMPTS = [[3, 17, 92, 45, 8], [5, 9, 33, 71]]
+
+
+def test_internlm2_grouped_wqkv_equivalence(llama_ckpt, tmp_path_factory):
+    sd = _state(llama_ckpt)
+    kv, q_per_kv, hd = 2, 2, HEAD_DIM
+    H = CFG["hidden_size"]
+    out = {"model.tok_embeddings.weight": sd["model.embed_tokens.weight"],
+           "model.norm.weight": sd["model.norm.weight"],
+           "output.weight": sd["lm_head.weight"]}
+    for i in range(CFG["num_hidden_layers"]):
+        pre = f"model.layers.{i}."
+        q = sd[f"{pre}self_attn.q_proj.weight"].reshape(kv, q_per_kv, hd, H)
+        k = sd[f"{pre}self_attn.k_proj.weight"].reshape(kv, 1, hd, H)
+        v = sd[f"{pre}self_attn.v_proj.weight"].reshape(kv, 1, hd, H)
+        out[f"{pre}attention.wqkv.weight"] = np.concatenate(
+            [q, k, v], axis=1).reshape(-1, H)
+        out[f"{pre}attention.wo.weight"] = \
+            sd[f"{pre}self_attn.o_proj.weight"]
+        out[f"{pre}feed_forward.w1.weight"] = \
+            sd[f"{pre}mlp.gate_proj.weight"]
+        out[f"{pre}feed_forward.w3.weight"] = sd[f"{pre}mlp.up_proj.weight"]
+        out[f"{pre}feed_forward.w2.weight"] = \
+            sd[f"{pre}mlp.down_proj.weight"]
+        out[f"{pre}attention_norm.weight"] = \
+            sd[f"{pre}input_layernorm.weight"]
+        out[f"{pre}ffn_norm.weight"] = \
+            sd[f"{pre}post_attention_layernorm.weight"]
+    path = _save_variant(tmp_path_factory, "tiny_internlm2",
+                         "InternLM2ForCausalLM", out)
+    assert run(path, PROMPTS) == run(llama_ckpt, PROMPTS)
+
+
+def test_baichuan_wpack_equivalence(llama_ckpt, tmp_path_factory):
+    sd = _state(llama_ckpt)
+    out = dict(sd)
+    for i in range(CFG["num_hidden_layers"]):
+        pre = f"model.layers.{i}.self_attn."
+        out[f"{pre}W_pack.weight"] = np.concatenate(
+            [out.pop(f"{pre}q_proj.weight"), out.pop(f"{pre}k_proj.weight"),
+             out.pop(f"{pre}v_proj.weight")])
+    path = _save_variant(tmp_path_factory, "tiny_baichuan",
+                         "BaichuanForCausalLM", out)
+    assert run(path, PROMPTS) == run(llama_ckpt, PROMPTS)
+
+
+def test_baichuan_13b_alibi_rejected(llama_ckpt, tmp_path_factory):
+    sd = _state(llama_ckpt)
+    path = _save_variant(tmp_path_factory, "tiny_baichuan13b", "x", sd)
+    cfg = dict(CFG, architectures=["BaichuanForCausalLM"],
+               model_type="llama", hidden_size=5120)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    with pytest.raises(ValueError, match="ALiBi"):
+        run(path, PROMPTS)

@@ -45,6 +45,7 @@ def run(path, **overrides):
                 done[out.request_id] = out
         if not engine.has_unfinished_requests():
             break
+    assert len(done) == len(prompts)
     return done
 
 

@@ -108,3 +108,84 @@ class Phi3ForCausalLM(LlamaForCausalLM):
             out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:half]
             out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[half:]
         return super().params_from_hf_state_dict(out)
+
+
+class InternLM2ForCausalLM(LlamaForCausalLM):
+    """InternLM2 (reference: vllm/model_executor/models/internlm2.py):
+    Llama math with renamed tensors and a GROUPED fused wqkv — per kv
+    group the checkpoint packs q_per_kv query heads, then that group's
+    k head, then its v head (the reference's split_qkv at
+    internlm2.py:119 undoes the same layout per TP rank)."""
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        H = c.hidden_size
+        q_per_kv = c.num_q_heads // c.num_kv_heads
+        out = {}
+        for i in range(c.num_layers):
+            pre = f"model.layers.{i}."
+            wqkv = np.asarray(tensors[f"{pre}attention.wqkv.weight"])
+            grouped = wqkv.reshape(c.num_kv_heads, q_per_kv + 2,
+                                   c.head_dim, H)
+            out[f"{pre}self_attn.q_proj.weight"] = \
+                grouped[:, :q_per_kv].reshape(-1, H)
+            out[f"{pre}self_attn.k_proj.weight"] = \
+                grouped[:, q_per_kv].reshape(-1, H)
+            out[f"{pre}self_attn.v_proj.weight"] = \
+                grouped[:, q_per_kv + 1].reshape(-1, H)
+            out[f"{pre}self_attn.o_proj.weight"] = \
+                tensors[f"{pre}attention.wo.weight"]
+            out[f"{pre}mlp.gate_proj.weight"] = \
+                tensors[f"{pre}feed_forward.w1.weight"]
+            out[f"{pre}mlp.up_proj.weight"] = \
+                tensors[f"{pre}feed_forward.w3.weight"]
+            out[f"{pre}mlp.down_proj.weight"] = \
+                tensors[f"{pre}feed_forward.w2.weight"]
+            out[f"{pre}input_layernorm.weight"] = \
+                tensors[f"{pre}attention_norm.weight"]
+            out[f"{pre}post_attention_layernorm.weight"] = \
+                tensors[f"{pre}ffn_norm.weight"]
+        out["model.embed_tokens.weight"] = \
+            tensors["model.tok_embeddings.weight"]
+        out["model.norm.weight"] = tensors["model.norm.weight"]
+        if "output.weight" in tensors:
+            out["lm_head.weight"] = tensors["output.weight"]
+        return super().params_from_hf_state_dict(out)
+
+
+class BaichuanForCausalLM(LlamaForCausalLM):
+    """Baichuan-7B (reference: vllm/model_executor/models/baichuan.py):
+    Llama math with a fused W_pack = [q; k; v] projection. The 13B
+    variant replaces RoPE with ALiBi, which this decoder does not
+    implement — rejected in configure_arch (the reference keys the same
+    split on position_embedding, baichuan.py:330)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if getattr(hf, "hidden_size", 0) >= 5120:
+            raise ValueError(
+                "Baichuan-13B uses ALiBi position embeddings, which are "
+                "not supported; only the RoPE (7B-style) variant loads")
+
+    # Baichuan2's vocab size — its NormHead lm_head stores unnormalized
+    # rows that the forward L2-normalizes (reference: baichuan.py keying
+    # the row normalization on this constant).
+    _BAICHUAN2_VOCAB = 125696
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        Dq = c.num_q_heads * c.head_dim
+        Dkv = c.num_kv_heads * c.head_dim
+        out = dict(tensors)
+        for i in range(c.num_layers):
+            pre = f"model.layers.{i}.self_attn."
+            w = np.asarray(tensors[f"{pre}W_pack.weight"])
+            out[f"{pre}q_proj.weight"] = w[:Dq]
+            out[f"{pre}k_proj.weight"] = w[Dq:Dq + Dkv]
+            out[f"{pre}v_proj.weight"] = w[Dq + Dkv:]
+        if (c.vocab_size == self._BAICHUAN2_VOCAB
+                and "lm_head.weight" in out):
+            head = np.asarray(out["lm_head.weight"], np.float32)
+            norms = np.linalg.norm(head, axis=-1, keepdims=True)
+            out["lm_head.weight"] = head / np.maximum(norms, 1e-7)
+        return super().params_from_hf_state_dict(out)

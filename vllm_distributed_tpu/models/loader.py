@@ -71,6 +71,12 @@ def get_model(config: EngineConfig, mesh,
             "sequence parallelism under token parallelism is not wired "
             "(the TKNP attention shard_maps assume token-replicated "
             "activations); disable one of the two")
+    if (config.parallel_config.enable_sequence_parallel
+            and config.parallel_config.pipeline_parallel_size > 1):
+        raise ValueError(
+            "sequence parallelism under pipeline parallelism is not "
+            "wired (the SP constraint binds the full mesh, but PP "
+            "stages jit over per-stage sub-meshes); disable one")
     arch.sequence_parallel = (
         config.parallel_config.enable_sequence_parallel
         and config.parallel_config.tensor_parallel_size > 1)

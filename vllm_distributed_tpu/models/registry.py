@@ -6,8 +6,10 @@ a pre-norm RoPE decoder with SwiGLU MLP and optional QKV bias — which is
 Llama 2/3, Mistral, Qwen2, and friends.
 """
 
-from vllm_distributed_tpu.models.families import (Gemma2ForCausalLM,
+from vllm_distributed_tpu.models.families import (BaichuanForCausalLM,
+                                                  Gemma2ForCausalLM,
                                                   GemmaForCausalLM,
+                                                  InternLM2ForCausalLM,
                                                   Phi3ForCausalLM,
                                                   Qwen3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
@@ -26,6 +28,10 @@ _REGISTRY: dict[str, type] = {
     "Gemma2ForCausalLM": Gemma2ForCausalLM,
     "Qwen3ForCausalLM": Qwen3ForCausalLM,
     "Phi3ForCausalLM": Phi3ForCausalLM,
+    "InternLM2ForCausalLM": InternLM2ForCausalLM,
+    # Both checkpoint spellings; 13B (ALiBi) is rejected at load.
+    "BaichuanForCausalLM": BaichuanForCausalLM,
+    "BaiChuanForCausalLM": BaichuanForCausalLM,
 }
 
 
