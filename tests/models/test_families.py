@@ -198,3 +198,45 @@ def test_window_segment_planner():
     # periodic bulk + one-layer remainder, NOT a per-layer unroll.
     assert plan((4, 0, 4, 0, 4)) == [(0, 4, (4, 0)), (4, 1, (4, ))]
     assert plan((4, 0) * 10 + (4, )) == [(0, 20, (4, 0)), (20, 1, (4, ))]
+
+
+def test_qwen2_moe_greedy_matches_hf(tmp_path_factory):
+    """Qwen2-MoE: routed experts without top-k renorm + sigmoid-gated
+    shared expert + qkv bias must match HF Qwen2MoeForCausalLM."""
+    from transformers import Qwen2MoeConfig
+    from transformers import Qwen2MoeForCausalLM as HFQwen2Moe
+    torch.manual_seed(0)
+    cfg = Qwen2MoeConfig(vocab_size=128, hidden_size=64,
+                         intermediate_size=128, moe_intermediate_size=32,
+                         shared_expert_intermediate_size=64,
+                         num_experts=4, num_experts_per_tok=2,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2,
+                         max_position_embeddings=64, eos_token_id=1,
+                         decoder_sparse_step=1, mlp_only_layers=[])
+    path, hf = _save(tmp_path_factory, "tiny_qwen2moe", HFQwen2Moe(cfg))
+    got = run(path, PROMPTS)
+    want = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want
+
+
+def test_qwen2_moe_ep2_matches_hf(tmp_path_factory):
+    """Qwen2-MoE under expert parallelism (experts sharded over the
+    model axis; the shared expert stays TP-dense)."""
+    from transformers import Qwen2MoeConfig
+    from transformers import Qwen2MoeForCausalLM as HFQwen2Moe
+    torch.manual_seed(1)
+    cfg = Qwen2MoeConfig(vocab_size=128, hidden_size=64,
+                         intermediate_size=128, moe_intermediate_size=32,
+                         shared_expert_intermediate_size=64,
+                         num_experts=4, num_experts_per_tok=2,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2,
+                         max_position_embeddings=64, eos_token_id=1,
+                         decoder_sparse_step=1, mlp_only_layers=[])
+    path, hf = _save(tmp_path_factory, "tiny_qwen2moe_ep",
+                     HFQwen2Moe(cfg))
+    got = run(path, PROMPTS, tensor_parallel_size=2,
+              enable_expert_parallel=True)
+    want = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

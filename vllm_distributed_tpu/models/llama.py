@@ -67,6 +67,15 @@ class LlamaArchConfig:
     # Mixture-of-experts (Mixtral-style); 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Renormalize top-k routing weights (Mixtral yes, Qwen2-MoE no —
+    # reference: the renormalize flag of FusedMoE).
+    norm_topk_prob: bool = True
+    # Per-expert FFN width when it differs from intermediate_size
+    # (Qwen2-MoE moe_intermediate_size); None = intermediate_size.
+    moe_intermediate_size: Optional[int] = None
+    # Qwen2-MoE shared expert: a dense SwiGLU of this width runs for
+    # every token, sigmoid-gated, added to the routed output. 0 = none.
+    shared_expert_intermediate_size: int = 0
     # Physical expert slots for EPLB (reference: distributed/eplb/):
     # 0 means = num_experts (no redundancy). Extra slots host replicas
     # of hot experts; the router maps logical -> physical through a

@@ -74,8 +74,8 @@ class MixtralForCausalLM(LlamaForCausalLM):
     def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
         params = super().init_params(rng, scale)
         c = self.cfg
-        L, H, I, E = (c.num_layers, c.hidden_size, c.intermediate_size,
-                      c.num_experts)
+        L, H, E = c.num_layers, c.hidden_size, c.num_experts
+        I = c.moe_intermediate_size or c.intermediate_size
         keys = iter(jax.random.split(jax.random.fold_in(rng, 17), 4))
 
         def norm(key, shape):
@@ -222,7 +222,8 @@ class MixtralForCausalLM(LlamaForCausalLM):
                   @ lp["router"].astype(jnp.float32))  # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
         top_vals, top_idx = jax.lax.top_k(probs, k)
-        top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
+        if c.norm_topk_prob:
+            top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
 
         if envs.VDT_MOE_BACKEND == "dense":
             return self._moe_dense(lp, x, top_idx, top_vals)
@@ -329,3 +330,109 @@ class MixtralForCausalLM(LlamaForCausalLM):
         y = jnp.einsum("eti,eih->eth", g * u, self._w(lp, "w_down"))
         out = jnp.einsum("te,eth->th", gates.astype(y.dtype), y)
         return out.astype(x.dtype)
+
+
+class Qwen2MoeForCausalLM(MixtralForCausalLM):
+    """Qwen2-MoE (reference: vllm/model_executor/models/qwen2_moe.py):
+    the Mixtral routed-expert block plus a sigmoid-gated SHARED expert
+    that runs densely for every token, qkv bias, non-renormalized top-k
+    routing weights, and a narrower per-expert FFN
+    (moe_intermediate_size). Checkpoint names map onto the Mixtral
+    layout; the shared expert adds three stacked dense tensors and the
+    [H, 1] gate."""
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.num_experts = hf.num_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.attention_bias = True  # Qwen2-style qkv bias, always on
+        arch.norm_topk_prob = bool(getattr(hf, "norm_topk_prob", False))
+        arch.moe_intermediate_size = hf.moe_intermediate_size
+        arch.shared_expert_intermediate_size = \
+            hf.shared_expert_intermediate_size
+        if (getattr(hf, "mlp_only_layers", None)
+                or getattr(hf, "decoder_sparse_step", 1) != 1):
+            raise ValueError(
+                "Qwen2-MoE layouts mixing dense and sparse MLP layers "
+                "(mlp_only_layers / decoder_sparse_step != 1) are not "
+                "supported; every layer must be sparse")
+
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        layer = specs["layers"]
+        # Shared expert: Megatron dense-MLP layout; the tiny sigmoid
+        # gate is replicated.
+        layer.update({
+            "shared_gate": P(None, None, MODEL_AXIS),
+            "shared_up": P(None, None, MODEL_AXIS),
+            "shared_down": P(None, MODEL_AXIS, None),
+            "shared_egate": P(None, None, None),
+        })
+        return specs
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        params = super().init_params(rng, scale)
+        c = self.cfg
+        L, H = c.num_layers, c.hidden_size
+        Is = c.shared_expert_intermediate_size or c.intermediate_size
+        keys = iter(jax.random.split(jax.random.fold_in(rng, 23), 4))
+
+        def norm(key, shape):
+            return (scale * jax.random.normal(key, shape,
+                                              jnp.float32)).astype(c.dtype)
+
+        params["layers"].update({
+            "shared_gate": norm(next(keys), (L, H, Is)),
+            "shared_up": norm(next(keys), (L, H, Is)),
+            "shared_down": norm(next(keys), (L, Is, H)),
+            "shared_egate": norm(next(keys), (L, H, 1)),
+        })
+        return params
+
+    def params_from_hf_state_dict(self, tensors: dict[str, np.ndarray],
+                                  ) -> dict:
+        c = self.cfg
+        L, E = c.num_layers, c.num_experts
+        # Rename onto the Mixtral checkpoint layout, then stack the
+        # shared-expert tensors on top.
+        alias = dict(tensors)
+        for i in range(L):
+            src = f"model.layers.{i}.mlp"
+            dst = f"model.layers.{i}.block_sparse_moe"
+            alias[f"{dst}.gate.weight"] = tensors[f"{src}.gate.weight"]
+            for e in range(E):
+                for a, b in (("gate_proj", "w1"), ("down_proj", "w2"),
+                             ("up_proj", "w3")):
+                    alias[f"{dst}.experts.{e}.{b}.weight"] = \
+                        tensors[f"{src}.experts.{e}.{a}.weight"]
+        params = super().params_from_hf_state_dict(alias)
+
+        def stack(fmt):
+            return jnp.asarray(
+                np.stack([np.asarray(tensors[fmt.format(i)]).T
+                          for i in range(L)]), dtype=c.dtype)
+
+        params["layers"].update({
+            "shared_gate": stack(
+                "model.layers.{}.mlp.shared_expert.gate_proj.weight"),
+            "shared_up": stack(
+                "model.layers.{}.mlp.shared_expert.up_proj.weight"),
+            "shared_down": stack(
+                "model.layers.{}.mlp.shared_expert.down_proj.weight"),
+            "shared_egate": stack(
+                "model.layers.{}.mlp.shared_expert_gate.weight"),
+        })
+        return params
+
+    # ------------------------------------------------------------------
+    def mlp_block(self, lp: dict, x: jax.Array,
+                  lora_ctx=None) -> jax.Array:
+        routed = super().mlp_block(lp, x, lora_ctx)
+        from vllm_distributed_tpu.models.common import swiglu
+        shared = swiglu(x, lp["shared_gate"], lp["shared_up"],
+                        lp["shared_down"], act=self._act)
+        # Sigmoid gate in fp32 (HF computes the gate on fp hidden).
+        gate = jax.nn.sigmoid(x.astype(jnp.float32)
+                              @ lp["shared_egate"].astype(jnp.float32))
+        return routed + gate.astype(x.dtype) * shared

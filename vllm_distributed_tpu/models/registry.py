@@ -14,7 +14,8 @@ from vllm_distributed_tpu.models.families import (BaichuanForCausalLM,
                                                   Qwen3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
-from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
+from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                 Qwen2MoeForCausalLM)
 
 _REGISTRY: dict[str, type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
@@ -24,6 +25,7 @@ _REGISTRY: dict[str, type] = {
     "AquilaForCausalLM": LlamaForCausalLM,
     "YiForCausalLM": LlamaForCausalLM,
     "MixtralForCausalLM": MixtralForCausalLM,
+    "Qwen2MoeForCausalLM": Qwen2MoeForCausalLM,
     "GemmaForCausalLM": GemmaForCausalLM,
     "Gemma2ForCausalLM": Gemma2ForCausalLM,
     "Qwen3ForCausalLM": Qwen3ForCausalLM,
