@@ -240,3 +240,26 @@ def test_qwen2_moe_ep2_matches_hf(tmp_path_factory):
               enable_expert_parallel=True)
     want = [hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_gemma2_int8_quant_keeps_top1(tmp_path_factory):
+    """Gemma2 + int8 weight quantization: the extra norms/softcap path
+    must compose with the dequantizing weight accessor (top-1 greedy
+    token preserved on a tiny model)."""
+    from transformers import Gemma2Config
+    from transformers import Gemma2ForCausalLM as HFGemma2
+    torch.manual_seed(2)
+    cfg = Gemma2Config(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=4,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=16, sliding_window=4,
+                       max_position_embeddings=64, eos_token_id=1,
+                       attn_implementation="eager")
+    path, _ = _save(tmp_path_factory, "tiny_gemma2_q8", HFGemma2(cfg))
+    prompt = [3, 17, 92, 45, 8, 21, 33, 64]
+    fp = run(path, [prompt])
+    q8 = run(path, [prompt], quantization="int8")
+    # First greedy token agrees (full-sequence drift is allowed for a
+    # quantized tiny model; divergence-at-step-0 would mean the scales
+    # or extra-norm keys broke).
+    assert fp[0][0] == q8[0][0]

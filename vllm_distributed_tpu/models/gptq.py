@@ -1,0 +1,129 @@
+"""GPTQ checkpoint loading: dequantize-on-load.
+
+Reference: vllm/model_executor/layers/quantization/gptq.py (runtime
+4-bit CUDA kernels over the packed layout). TPU-first translation: the
+MXU has no 4-bit datapath, so packed GPTQ tensors are unpacked and
+dequantized HOST-SIDE into ordinary fp weights during load — after
+which the standard pipeline applies (optionally re-quantizing to the
+w8a16 int8/fp8 schemes via --quantization, which halves HBM again).
+
+Layout handled (AutoGPTQ v1 safetensors, the format of the vast
+majority of HF "-GPTQ" checkpoints):
+  * ``qweight`` int32 [in/pack, out] — ``pack``=32/bits values per
+    word along the INPUT dim, low bits first.
+  * ``qzeros`` int32 [groups, out/pack] — packed along OUTPUT; stores
+    zero-point MINUS ONE (the historical AutoGPTQ bias, re-added here).
+  * ``scales`` fp16 [groups, out].
+  * ``g_idx`` int32 [in] — input row -> group map (covers desc_act
+    act-order checkpoints; absent means contiguous groups).
+Dequant: W[i, o] = scales[g_idx[i], o] * (q[i, o] - (z[g_idx[i], o]+1)).
+"""
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _unpack(packed: np.ndarray, bits: int, axis: int) -> np.ndarray:
+    """Unpack int32 words into ``32/bits`` unsigned values along
+    ``axis`` (low bits first, matching AutoGPTQ's pack order)."""
+    pack = 32 // bits
+    mask = (1 << bits) - 1
+    shifts = (np.arange(pack, dtype=np.uint32) * bits)
+    words = packed.astype(np.uint32)
+    expanded = (words[..., None] >> shifts) & mask  # [..., pack] last
+    # Move the pack dim next to `axis` and merge.
+    expanded = np.moveaxis(expanded, -1, axis + 1)
+    shape = list(packed.shape)
+    shape[axis] *= pack
+    return expanded.reshape(shape)
+
+
+def dequantize_gptq_layer(qweight: np.ndarray, qzeros: np.ndarray,
+                          scales: np.ndarray, g_idx, bits: int,
+                          group_size: int) -> np.ndarray:
+    """One packed linear -> fp32 [out, in] (torch Linear orientation)."""
+    q = _unpack(qweight, bits, axis=0)          # [in, out]
+    z = _unpack(qzeros, bits, axis=1)           # [groups, out]
+    in_dim = q.shape[0]
+    if group_size <= 0:
+        group_size = in_dim  # group_size=-1: one group spans the input
+    if g_idx is None:
+        g_idx = np.arange(in_dim, dtype=np.int64) // group_size
+    else:
+        g_idx = np.asarray(g_idx, np.int64)
+    w = (scales.astype(np.float32)[g_idx]
+         * (q.astype(np.float32) - (z.astype(np.float32) + 1.0)[g_idx]))
+    # C-contiguous, not a transpose view: astype(order='K') keeps
+    # F-order, and safetensors serializes raw buffers assuming C-order.
+    return np.ascontiguousarray(w.T)  # [out, in]
+
+
+def dequantize_gptq_state_dict(tensors: dict, bits: int,
+                               group_size: int) -> dict:
+    """Replace every packed GPTQ linear in an HF state dict with its
+    dequantized ``.weight``; non-quantized tensors (embeddings, norms,
+    lm_head) pass through."""
+    out = {}
+    n = 0
+    for name, val in tensors.items():
+        if name.endswith(".qweight"):
+            base = name[:-len(".qweight")]
+            out[base + ".weight"] = dequantize_gptq_layer(
+                np.asarray(val), np.asarray(tensors[base + ".qzeros"]),
+                np.asarray(tensors[base + ".scales"]),
+                tensors.get(base + ".g_idx"), bits, group_size)
+            n += 1
+        elif name.endswith((".qzeros", ".scales", ".g_idx")) and (
+                name.rsplit(".", 1)[0] + ".qweight") in tensors:
+            continue
+        else:
+            out[name] = val
+    logger.info("dequantized %d GPTQ linears (%d-bit, group %d)", n,
+                bits, group_size)
+    return out
+
+
+def maybe_dequantize_gptq(tensors: dict, hf_config,
+                          model_path: str = "") -> dict:
+    """Apply GPTQ dequant when the HF config declares it; no-op
+    otherwise. Raises for formats this loader does not handle.
+
+    Older AutoGPTQ exports ship ``quantize_config.json`` beside the
+    shards instead of a config.json quantization_config entry — read it
+    as a fallback so those checkpoints load too."""
+    qcfg = getattr(hf_config, "quantization_config", None)
+    if qcfg is None and model_path:
+        import json
+        import os
+        legacy = os.path.join(model_path, "quantize_config.json")
+        if os.path.exists(legacy):
+            with open(legacy) as f:
+                qcfg = dict(json.load(f), quant_method="gptq")
+    if qcfg is None:
+        if any(name.endswith(".qweight") for name in tensors):
+            raise ValueError(
+                "checkpoint contains packed .qweight tensors but "
+                "declares no quantization_config (and has no "
+                "quantize_config.json); cannot identify the "
+                "quantization format")
+        return tensors
+    get = (qcfg.get if isinstance(qcfg, dict)
+           else lambda k, d=None: getattr(qcfg, k, d))
+    method = get("quant_method")
+    if method != "gptq":
+        raise ValueError(
+            f"checkpoint declares quantization_config.quant_method="
+            f"{method!r}; only 'gptq' checkpoints are supported "
+            "(AWQ/others need their own unpackers)")
+    if get("checkpoint_format", "gptq") not in ("gptq", None):
+        raise ValueError(
+            "only the v1 'gptq' checkpoint_format is supported "
+            f"(got {get('checkpoint_format')!r})")
+    bits = int(get("bits", 4))
+    if 32 % bits != 0:
+        raise ValueError(f"unsupported GPTQ bits={bits}")
+    group_size = int(get("group_size", 128))
+    return dequantize_gptq_state_dict(tensors, bits, group_size)

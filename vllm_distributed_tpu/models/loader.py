@@ -143,6 +143,9 @@ def get_model(config: EngineConfig, mesh,
         params = model.init_params(rng)
     else:
         tensors = load_hf_state_dict(model_path)
+        from vllm_distributed_tpu.models.gptq import maybe_dequantize_gptq
+        tensors = maybe_dequantize_gptq(tensors, hf_config,
+                                        model_path)
         params = model.params_from_hf_state_dict(tensors)
         logger.info("loaded %d tensors from %s", len(tensors), model_path)
 
