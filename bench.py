@@ -16,6 +16,7 @@ aggregate for Llama-3.2-1B bs=8 on one accelerator of this class).
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -49,6 +50,48 @@ _PROBE = ("import jax, time; t0=time.time(); d = jax.devices(); "
           "'INIT_S=%.1f' % (time.time() - t0))")
 
 _PROBE_LOG: list[str] = []  # diagnostics carried into the final JSON
+_JSON_EMITTED = False  # set once the one JSON line has been printed
+
+# Hard wall-clock caps (seconds). The driver kills bench.py at an unknown
+# wall clock; round 3 proved the probe budget alone can exceed it
+# (rc=124, no JSON). Everything before the fallback JSON must be bounded:
+# probing <= _PROBE_BUDGET total, and a SIGTERM/SIGALRM backstop prints
+# the best-known record if we are killed anyway.
+_PROBE_BUDGET = float(os.environ.get("VDT_BENCH_PROBE_BUDGET", "300"))
+_TOTAL_DEADLINE = float(os.environ.get("VDT_BENCH_DEADLINE", "3300"))
+
+
+def _emit(record: dict) -> None:
+    """Print the single JSON line exactly once, even under signals."""
+    global _JSON_EMITTED
+    if _JSON_EMITTED:
+        return
+    _JSON_EMITTED = True
+    print(json.dumps(record), flush=True)
+
+
+def _fallback_record(reason: str) -> dict:
+    return {
+        "metric": "decode_throughput_llama1b_bs8",
+        "value": 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "error": reason,
+        "probe_log": _PROBE_LOG[-4:],
+    }
+
+
+def _install_backstop() -> None:
+    """If the driver SIGTERMs us (timeout) or our own alarm fires, emit
+    the diagnostic JSON line and exit 0 — a run with no parsed record
+    must be impossible."""
+    def _handler(signum, frame):  # noqa: ARG001
+        _emit(_fallback_record(f"killed by signal {signum} before a bench "
+                               f"record was produced"))
+        os._exit(0)
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(int(_TOTAL_DEADLINE))
 
 
 def _probe_accelerator() -> bool:
@@ -56,10 +99,19 @@ def _probe_accelerator() -> bool:
     executes a matmul: the tunnelled TPU plugin can hang jax.devices()
     for many minutes or die with Unavailable; probing out-of-process
     keeps this process clean for the CPU fallback. Failed init is cached
-    per-process in jax, so every retry must be a fresh subprocess."""
+    per-process in jax, so every retry must be a fresh subprocess.
+
+    Total wall clock here is hard-capped at _PROBE_BUDGET regardless of
+    the per-attempt timeout."""
     from vllm_distributed_tpu import envs
-    timeout = envs.VDT_TPU_PROBE_TIMEOUT
-    for attempt, backoff in enumerate((20, 60, 120, 0)):
+    deadline = time.monotonic() + _PROBE_BUDGET
+    for attempt, backoff in enumerate((20, 40, 0)):
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            _PROBE_LOG.append(f"probe budget ({_PROBE_BUDGET}s) exhausted "
+                              f"before attempt {attempt}")
+            break
+        timeout = min(envs.VDT_TPU_PROBE_TIMEOUT, remaining)
         try:
             out = subprocess.run(
                 [sys.executable, "-c", _PROBE],
@@ -80,7 +132,7 @@ def _probe_accelerator() -> bool:
             _PROBE_LOG.append(msg)
             print(f"bench: probe {msg}", file=sys.stderr)
         if backoff:
-            time.sleep(backoff)
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
     return False
 
 
@@ -216,7 +268,7 @@ def main() -> None:
     }
     if not is_tpu and _PROBE_LOG:
         record["probe_log"] = _PROBE_LOG[-4:]
-    print(json.dumps(record))
+    _emit(record)
 
 
 def _run_with_retries() -> Exception | None:
@@ -224,7 +276,7 @@ def _run_with_retries() -> Exception | None:
     chip — observed flaps last minutes, so later retries wait long);
     returns the last exception, or None on success."""
     last_err = None
-    for backoff in (30, 120, 300, None):
+    for backoff in (30, 90, None):
         try:
             main()
             return None
@@ -244,17 +296,21 @@ def _reexec_cpu_fallback() -> Exception | None:
     try:
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              env=env, capture_output=True, text=True,
-                             timeout=1800)
+                             timeout=900)
     except subprocess.TimeoutExpired:
         return RuntimeError("cpu fallback subprocess timed out")
     if out.returncode == 0 and out.stdout.strip():
-        sys.stdout.write(out.stdout)
-        return None
+        try:
+            _emit(json.loads(out.stdout.strip().splitlines()[-1]))
+            return None
+        except ValueError:
+            return RuntimeError("cpu fallback subprocess emitted non-JSON")
     return RuntimeError(f"cpu fallback subprocess rc={out.returncode}: "
                         f"{out.stderr[-400:]}")
 
 
 if __name__ == "__main__":
+    _install_backstop()
     if TINY:
         # CPU smoke mode: pin the platform so a tunnelled TPU plugin can't
         # hang backend init (the plugin ignores the JAX_PLATFORMS env var;
@@ -276,12 +332,5 @@ if __name__ == "__main__":
             err = _reexec_cpu_fallback()
     if err is not None:
         # Always emit a parseable JSON line with a diagnostic.
-        print(json.dumps({
-            "metric": "decode_throughput_llama1b_bs8",
-            "value": 0.0,
-            "unit": "tok/s",
-            "vs_baseline": 0.0,
-            "error": f"{type(err).__name__}: {err}",
-            "probe_log": _PROBE_LOG[-4:],
-        }))
+        _emit(_fallback_record(f"{type(err).__name__}: {err}"))
         sys.exit(0)

@@ -109,3 +109,23 @@ def test_two_process_broadcast_every_reader_sees_every_message():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"reader {i} failed:\n{out[-2000:]}"
         assert f"GOT {msgs!r}"[:40] in out or str(msgs) in out, out[-500:]
+
+
+def test_rejected_reader_does_not_wedge_the_writer():
+    """A 65th registration must be refused WITHOUT bumping the reader
+    count, or the writer's drained-by-all accounting can never be
+    satisfied again (ADVICE round 3)."""
+    name = _name("full")
+    w = MessageQueue.create(name, num_readers=1, chunk_size=64,
+                            num_chunks=4)
+    readers = [MessageQueue.join(name) for _ in range(64)]
+    with pytest.raises(ShmRingError, match="table full"):
+        MessageQueue.join(name)
+    # The failed join left accounting intact: broadcasting to the 64
+    # registered readers still completes.
+    w.enqueue("after-reject", timeout=10)
+    assert readers[0].dequeue(10) == "after-reject"
+    assert readers[63].dequeue(10) == "after-reject"
+    for r in readers:
+        r.close()
+    w.close()

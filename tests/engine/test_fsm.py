@@ -1,0 +1,67 @@
+"""Unit tests for the regex->byte-DFA compiler's edge cases
+(ADVICE round 3: {0} bounds, non-ASCII class members/escapes)."""
+
+import pytest
+
+from vllm_distributed_tpu.structured_output.fsm import compile_regex
+
+
+def _accepts(dfa, text) -> bool:
+    data = text.encode("utf-8") if isinstance(text, str) else text
+    state = dfa.walk_bytes(1, data)
+    return state != 0 and bool(dfa.accept[state])
+
+
+@pytest.mark.parametrize("pattern", ["a{0}b", "a{0,0}b"])
+def test_zero_repeat_is_epsilon(pattern):
+    dfa = compile_regex(pattern)
+    assert _accepts(dfa, "b")
+    assert not _accepts(dfa, "ab")
+    assert not _accepts(dfa, "aab")
+
+
+def test_bounded_repeats_still_work():
+    dfa = compile_regex("a{2,3}b")
+    assert not _accepts(dfa, "ab")
+    assert _accepts(dfa, "aab")
+    assert _accepts(dfa, "aaab")
+    assert not _accepts(dfa, "aaaab")
+
+
+def test_nonascii_class_member_matches_full_sequence():
+    dfa = compile_regex("[é]")
+    assert _accepts(dfa, "é")
+    assert not _accepts(dfa, b"\xc3")   # lone lead byte
+    assert not _accepts(dfa, b"\xa9")   # lone continuation byte
+
+
+def test_mixed_class_ascii_and_multibyte():
+    dfa = compile_regex("[aé]x")
+    assert _accepts(dfa, "ax")
+    assert _accepts(dfa, "éx")
+    assert not _accepts(dfa, b"\xc3x")
+
+
+def test_escaped_nonascii_is_byte_chain():
+    dfa = compile_regex("\\é!")
+    assert _accepts(dfa, "é!")
+    assert not _accepts(dfa, b"\xa9!")
+
+
+def test_negated_class_with_multibyte_rejected():
+    with pytest.raises(ValueError):
+        compile_regex("[^é]")
+
+
+def test_nonascii_range_endpoint_rejected():
+    with pytest.raises(ValueError):
+        compile_regex("[a-é]")
+
+
+def test_hex_escape_range_endpoint_past_ascii_rejected():
+    with pytest.raises(ValueError):
+        compile_regex("[a-\\xe9]")
+    # In-ASCII hex endpoints still fine.
+    dfa = compile_regex("[\\x41-\\x43]")
+    assert _accepts(dfa, "B")
+    assert not _accepts(dfa, "D")

@@ -196,3 +196,16 @@ def test_multi_step_seeded_matches_single_step(checkpoint):
     a = run_engine(single, [prompt], [sp])[0].outputs[0].token_ids
     b = run_engine(multi, [prompt], [sp])[0].outputs[0].token_ids
     assert a == b
+
+
+def test_zero_token_dispatch_does_no_device_work(engine):
+    """Contract relied on by the PP batch queue's sync fallback
+    (engine/core.py): a zero-token SchedulerOutput must resolve entirely
+    at dispatch time (connector polls + row cleanup), never launching
+    device work that could interleave with in-flight async batches."""
+    from vllm_distributed_tpu.core.sched.output import SchedulerOutput
+    runner = engine.engine_core.engine_core.executor.worker.model_runner
+    handle = runner.dispatch_model(SchedulerOutput())
+    assert "ready" in handle and "dev" not in handle
+    out = runner.wait_model(handle)
+    assert not out.sampled_token_ids

@@ -1,0 +1,97 @@
+"""vocab_bytes_from_tokenizer against real HF fast tokenizers.
+
+The grammar byte table must reflect each token's true text contribution:
+sentencepiece vocabs strip the leading-space marker on lone-token decode
+and byte-level BPE vocabs decode partial-UTF-8 pieces to U+FFFD, so the
+table cannot be built from plain per-token decode (ADVICE round 3).
+"""
+
+import pytest
+
+from vllm_distributed_tpu.structured_output.manager import (
+    vocab_bytes_from_tokenizer)
+
+
+def _fast(tok, **kw):
+    from transformers import PreTrainedTokenizerFast
+    return PreTrainedTokenizerFast(tokenizer_object=tok, **kw)
+
+
+@pytest.fixture(scope="module")
+def byte_level_tokenizer():
+    """GPT-2/Llama-3-style byte-level BPE: pieces are byte-mapped chars;
+    'é' (UTF-8 c3 a9) appears both whole and split across two pieces."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    # Byte-level piece strings: space -> 'Ġ', 0xC3 -> 'Ã', 0xA9 -> '©'.
+    vocab = {"<unk>": 0, "<eos>": 1, "hello": 2, "Ġworld": 3,
+             "Ã©": 4, "Ã": 5, "©": 6, "ĊĊ": 7}
+    tok = Tokenizer(models.BPE(vocab=vocab, merges=[], unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    return _fast(tok, unk_token="<unk>", eos_token="<eos>")
+
+
+@pytest.fixture(scope="module")
+def sentencepiece_tokenizer():
+    """Llama-2/Mistral-style: pieces carry the U+2581 space marker and
+    <0xHH> byte-fallback entries."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    pieces = [("<unk>", 0.0), ("</s>", 0.0), ("▁hello", -1.0),
+              ("▁", -2.0), ("hello", -3.0), ("<0x0A>", -4.0),
+              ("▁the", -1.5), ("é", -5.0)]
+    tok = Tokenizer(models.Unigram(pieces, unk_id=0, byte_fallback=True))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.decoder = decoders.Metaspace()
+    return _fast(tok, unk_token="<unk>", eos_token="</s>")
+
+
+def test_byte_level_pieces_map_to_raw_bytes(byte_level_tokenizer):
+    table = vocab_bytes_from_tokenizer(byte_level_tokenizer)
+    ids = {t: i for t, i in byte_level_tokenizer.get_vocab().items()}
+    assert table[ids["hello"]] == b"hello"
+    assert table[ids["Ġworld"]] == b" world"           # space restored
+    assert table[ids["Ã©"]] == "é".encode("utf-8")      # c3 a9
+    assert table[ids["Ã"]] == b"\xc3"                   # NOT U+FFFD
+    assert table[ids["©"]] == b"\xa9"                   # NOT U+FFFD
+    assert table[ids["ĊĊ"]] == b"\n\n"
+    # Specials contribute nothing.
+    assert table[ids["<eos>"]] == b""
+
+
+def test_partial_utf8_decode_is_lossy_without_piece_mapping(
+        byte_level_tokenizer):
+    """The failure mode the table derivation must avoid: lone-token
+    decode of a continuation-byte piece yields U+FFFD."""
+    ids = byte_level_tokenizer.get_vocab()
+    s = byte_level_tokenizer.decode([ids["Ã"]])
+    assert "�" in s
+
+
+def test_sentencepiece_marker_and_byte_fallback(sentencepiece_tokenizer):
+    table = vocab_bytes_from_tokenizer(sentencepiece_tokenizer)
+    ids = sentencepiece_tokenizer.get_vocab()
+    assert table[ids["▁hello"]] == b" hello"            # marker -> space
+    assert table[ids["▁the"]] == b" the"
+    assert table[ids["▁"]] == b" "
+    assert table[ids["hello"]] == b"hello"
+    assert table[ids["<0x0A>"]] == b"\n"                # byte fallback
+    assert table[ids["é"]] == "é".encode("utf-8")
+    assert table[ids["</s>"]] == b""
+
+
+def test_sentencepiece_masks_follow_real_token_text(sentencepiece_tokenizer):
+    """End-to-end through the manager: a grammar over ' hello' must allow
+    exactly the marker-bearing piece, which lone-token decode misreports."""
+    from vllm_distributed_tpu.structured_output.manager import (
+        StructuredOutputManager)
+    table = vocab_bytes_from_tokenizer(sentencepiece_tokenizer)
+    mgr = StructuredOutputManager(table)
+    ids = sentencepiece_tokenizer.get_vocab()
+    eos = sentencepiece_tokenizer.eos_token_id
+    mgr.add_request("r", {"regex": " hello"}, eos_token_id=eos)
+    mask = mgr.mask_for("r")
+    assert mask[ids["▁hello"]]
+    assert not mask[ids["hello"]]
+    mgr.advance("r", [ids["▁hello"]])
+    mask = mgr.mask_for("r")
+    assert mask[eos]

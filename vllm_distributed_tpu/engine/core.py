@@ -195,7 +195,11 @@ class EngineCore:
             # budget edge). The output still carries finished_req_ids
             # for worker-side row cleanup — run it through synchronously
             # rather than dropping it, then retire a batch to free
-            # pages/slots for the next attempt.
+            # pages/slots for the next attempt. Safe to run while async
+            # batches are in flight ONLY because a zero-token batch does
+            # no device dispatch (dispatch_model's total==0 early return
+            # — connector polls + row cleanup only; contract locked by
+            # test_zero_token_dispatch_does_no_device_work).
             runner_output = self.executor.execute_model(scheduler_output)
             self.scheduler.update_from_output(scheduler_output,
                                               runner_output)

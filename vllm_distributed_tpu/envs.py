@@ -39,11 +39,13 @@ environment_variables: dict[str, Callable[[], Any]] = {
     lambda: os.getenv(
         "VDT_PLATFORM",
         os.getenv("JAX_PLATFORMS", "auto").split(",")[0] or "auto"),
-    # Seconds the bench harness waits for TPU backend init in its probe
-    # subprocess before falling back to CPU. The tunnelled axon plugin can
-    # take many minutes to become reachable, so the default is patient.
+    # Seconds the bench harness waits for TPU backend init in ONE probe
+    # subprocess attempt. Kept short: bench.py additionally hard-caps the
+    # total probe budget (VDT_BENCH_PROBE_BUDGET, default 300 s) so the
+    # probe phase can never exceed the driver's wall clock — a dead
+    # tunnel must still end with a parseable CPU-fallback record.
     "VDT_TPU_PROBE_TIMEOUT":
-    lambda: float(os.getenv("VDT_TPU_PROBE_TIMEOUT", "900")),
+    lambda: float(os.getenv("VDT_TPU_PROBE_TIMEOUT", "120")),
     # Precompile the full shape lattice at startup: "auto" = on for
     # accelerator platforms, off on CPU; "1"/"0" force.
     "VDT_PRECOMPILE":

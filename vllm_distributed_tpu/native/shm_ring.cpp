@@ -193,8 +193,13 @@ void* shm_ring_open(const char* name, double timeout_s) {
 // the reader table is full.
 int64_t shm_ring_register_reader(void* handle) {
   Ring* r = static_cast<Ring*>(handle);
-  uint64_t rank = r->hdr->num_readers.fetch_add(1);
-  if (rank >= r->hdr->max_readers) return -1;
+  // CAS loop: a rejected (table-full) registration must NOT bump the
+  // count, or the writer's all-readers-drained accounting becomes
+  // permanently unsatisfiable and every write times out.
+  uint64_t rank = r->hdr->num_readers.load();
+  do {
+    if (rank >= r->hdr->max_readers) return -1;
+  } while (!r->hdr->num_readers.compare_exchange_weak(rank, rank + 1));
   return static_cast<int64_t>(rank);
 }
 

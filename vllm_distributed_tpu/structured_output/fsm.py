@@ -186,6 +186,11 @@ class _Parser:
         return seen
 
     def _repeat_bounds(self, frag, lo: int, hi: Optional[int]):
+        if hi == 0:
+            # a{0} / a{0,0}: exactly zero occurrences — an epsilon
+            # fragment, NOT an optional copy.
+            s = self.new_state()
+            return s, s
         parts = [frag]
         total = (hi if hi is not None else max(lo, 1))
         for _ in range(total - 1):
@@ -216,11 +221,16 @@ class _Parser:
             self.take()
             return frag
         if ch == "[":
-            return self._charset(self._parse_class())
+            return self._class_frag(*self._parse_class())
         if ch == ".":
             return self._charset(_ALL - {ord("\n")})
         if ch == "\\":
-            return self._charset(self._escape(self.take()))
+            nxt = self.take()
+            if ord(nxt) >= 128:
+                # Escaped non-ASCII char: the full UTF-8 byte chain,
+                # not a set of its bytes.
+                return self._charset(self._literal_bytes(nxt))
+            return self._charset(self._escape(nxt))
         if ch in ")|*+?":
             raise ValueError(f"unexpected {ch!r} in {self.src!r}")
         return self._charset(frozenset(ch.encode("utf-8"))
@@ -248,6 +258,25 @@ class _Parser:
         self.edge(s, frozenset(byteset), e)
         return s, e
 
+    def _class_frag(self, byteset: frozenset,
+                    multibyte: frozenset) -> tuple[int, int]:
+        """A character class with possible non-ASCII members: the ASCII
+        byteset plus one full UTF-8 byte chain per multibyte member,
+        joined as alternatives (so e.g. [aé] matches 'a' or the
+        two-byte 'é' sequence — never a lone continuation byte)."""
+        if not multibyte:
+            return self._charset(byteset)
+        s, e = self.new_state(), self.new_state()
+        if byteset:
+            ms, me = self._charset(byteset)
+            self.edge(s, None, ms)
+            self.edge(me, None, e)
+        for chs in sorted(multibyte):
+            ms, me = self._literal_bytes(chs)
+            self.edge(s, None, ms)
+            self.edge(me, None, e)
+        return s, e
+
     def _escape(self, ch: str) -> frozenset:
         table = {
             "d": _DIGITS, "D": _ALL - _DIGITS,
@@ -264,12 +293,17 @@ class _Parser:
             return frozenset((int(hexs, 16), ))
         return frozenset(ch.encode("utf-8"))
 
-    def _parse_class(self) -> frozenset:
+    def _parse_class(self) -> tuple[frozenset, frozenset]:
+        """-> (ASCII byteset, set of non-ASCII member chars). Non-ASCII
+        members become whole UTF-8 sequences in _class_frag, never a
+        set of their bytes; they are rejected in ranges and negations,
+        where byte semantics would be ill-defined."""
         negate = False
         if self.peek() == "^":
             self.take()
             negate = True
         members: set[int] = set()
+        multibyte: set[str] = set()
         first = True
         while True:
             ch = self.peek()
@@ -281,26 +315,58 @@ class _Parser:
             first = False
             self.take()
             if ch == "\\":
-                sub = self._escape(self.take())
-                members |= sub
-                continue
-            lo = ord(ch)
+                esc = self.take()
+                if ord(esc) >= 128:
+                    multibyte.add(esc)
+                    continue
+                sub = self._escape(esc)
+                if (len(sub) == 1
+                        and self.peek() == "-"
+                        and self.pos + 1 < len(self.src)
+                        and self.src[self.pos + 1] != "]"):
+                    # Single-byte escape starting a range: [\x20-\x7e].
+                    lo = next(iter(sub))
+                else:
+                    members |= sub
+                    continue
+            else:
+                lo = ord(ch)
             if (self.peek() == "-" and self.pos + 1 < len(self.src)
                     and self.src[self.pos + 1] != "]"):
                 self.take()
                 hi_ch = self.take()
                 if hi_ch == "\\":
-                    hi_set = self._escape(self.take())
-                    hi = max(hi_set)
+                    esc = self.take()
+                    if ord(esc) >= 128:
+                        raise ValueError(
+                            f"non-ASCII range endpoint in {self.src!r}")
+                    hi = max(self._escape(esc))
+                    if hi >= 128:
+                        # e.g. [a-\xe9]: the escape RESOLVES past ASCII,
+                        # where a byte range would span UTF-8 lead/
+                        # continuation bytes.
+                        raise ValueError(
+                            f"non-ASCII range endpoint in {self.src!r}")
                 else:
+                    if ord(hi_ch) >= 128:
+                        raise ValueError(
+                            f"non-ASCII range endpoint in {self.src!r}")
                     hi = ord(hi_ch)
+                if lo >= 128:
+                    raise ValueError(
+                        f"non-ASCII range endpoint in {self.src!r}")
                 members |= set(range(lo, hi + 1))
             else:
                 if lo < 128:
                     members.add(lo)
                 else:
-                    members |= set(ch.encode("utf-8"))
-        return frozenset(_ALL - members if negate else members)
+                    multibyte.add(ch)
+        if negate:
+            if multibyte:
+                raise ValueError(
+                    f"negated class with non-ASCII member in {self.src!r}")
+            return frozenset(_ALL - members), frozenset()
+        return frozenset(members), frozenset(multibyte)
 
 
 # ---------------------------------------------------------------------------

@@ -122,26 +122,99 @@ class StructuredOutputManager:
             g.state = nxt
 
 
+_BYTE_FALLBACK_RE = None  # lazily compiled <0xHH> matcher
+
+
+def _bytes_to_unicode_map() -> dict[str, int]:
+    """Inverse of GPT-2's public byte->printable-unicode table (the one
+    byte-level BPE vocabs — GPT-2, Llama-3, Qwen — store pieces in):
+    printable bytes map to themselves, the rest shift into U+0100+."""
+    bs = (list(range(ord("!"), ord("~") + 1)) +
+          list(range(0xA1, 0xAC + 1)) + list(range(0xAE, 0xFF + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
 def vocab_bytes_from_tokenizer(tokenizer) -> list[bytes]:
     """token id -> utf-8 bytes table for mask precomputation.
 
-    Uses per-token decode with a leading anchor token where needed so
-    sentencepiece-style leading-space markers decode faithfully."""
+    Plain per-token ``decode([i])`` is wrong for the two dominant vocab
+    encodings: sentencepiece (Llama-2/Mistral) strips the leading-space
+    marker on a lone token, and byte-level BPE (GPT-2/Llama-3/Qwen)
+    decodes partial-UTF-8 pieces to U+FFFD. So this derives bytes from
+    the raw vocab pieces instead (ref: what xgrammar/outlines do before
+    handing vllm its token tables, vllm/v1/structured_output/backend_*):
+
+    - ``<0xHH>`` byte-fallback pieces -> that raw byte;
+    - pieces containing the sentencepiece space marker U+2581 -> marker
+      replaced by a real space, then UTF-8;
+    - byte-level-BPE vocabs (detected by the GPT-2 marker chars) ->
+      each piece char mapped through the inverse byte table;
+    - anything else -> per-token decode (correct for WordLevel-style
+      vocabs, where token text is the piece itself).
+    """
+    import re as _stdre
+    global _BYTE_FALLBACK_RE
+    if _BYTE_FALLBACK_RE is None:
+        _BYTE_FALLBACK_RE = _stdre.compile(r"<0x([0-9A-Fa-f]{2})>\Z")
     V = getattr(tokenizer, "vocab_size", None) or len(tokenizer)
     try:
         V = max(V, len(tokenizer))
     except TypeError:
         pass
-    out: list[bytes] = []
     specials = set(getattr(tokenizer, "all_special_ids", ()) or ())
-    for i in range(V):
-        if i in specials:
-            out.append(b"")
-            continue
+    try:
+        pieces = tokenizer.convert_ids_to_tokens(list(range(V)))
+    except Exception:  # noqa: BLE001 - tokenizer without piece access
+        pieces = [None] * V
+    # Classify the vocab encoding from its BASE pieces (added tokens are
+    # stored literally and must not flip the mode): sentencepiece pieces
+    # carry U+2581; byte-level BPE pieces carry U+0120 ('Ġ', the space
+    # byte) or U+010A ('Ċ', newline). Majority vote — a real vocab has
+    # thousands of its own marker and ~none of the other; a vocab with
+    # neither (WordLevel) decodes per token.
+    base_v = getattr(tokenizer, "vocab_size", None) or len(pieces)
+    base = [p for p in pieces[:base_v] if isinstance(p, str)]
+    n_sp = sum(1 for p in base if "▁" in p)
+    n_bl = sum(1 for p in base if "Ġ" in p or "Ċ" in p)
+    sp_mode = n_sp > n_bl
+    byte_mode = n_bl > n_sp
+    u2b = _bytes_to_unicode_map() if byte_mode else None
+
+    def _decode(i: int) -> bytes:
         try:
             s = tokenizer.decode([i], skip_special_tokens=False,
                                  clean_up_tokenization_spaces=False)
         except Exception:  # noqa: BLE001 - holes in exotic vocabs
             s = ""
-        out.append(s.encode("utf-8"))
+        return s.encode("utf-8")
+
+    out: list[bytes] = []
+    for i in range(V):
+        if i in specials:
+            out.append(b"")
+            continue
+        p = pieces[i]
+        if not isinstance(p, str):
+            out.append(_decode(i))
+            continue
+        m = _BYTE_FALLBACK_RE.match(p)
+        if m:
+            out.append(bytes([int(m.group(1), 16)]))
+        elif sp_mode:
+            out.append(p.replace("▁", " ").encode("utf-8"))
+        elif byte_mode:
+            try:
+                out.append(bytes(u2b[c] for c in p))
+            except KeyError:
+                # Added token (stored literally, not byte-mapped).
+                out.append(_decode(i))
+        else:
+            out.append(_decode(i))
     return out

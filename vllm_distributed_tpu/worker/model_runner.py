@@ -657,6 +657,9 @@ class TPUModelRunner:
             # hand queued peer reads / completed pulls to the connector
             # and report completion notifications (reference:
             # gpu_model_runner.py kv_connector_no_forward path).
+            # CONTRACT: no device dispatch on this path — the PP batch
+            # queue's sync fallback (engine/core.py) runs zero-token
+            # batches while async batches are in flight and relies on it.
             out = ModelRunnerOutput()
             self._poll_kv_connector(scheduler_output, out)
             return {"ready": out}
