@@ -201,3 +201,89 @@ def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
     gelu variants; XLA fuses the elementwise chain into the matmuls)."""
     gate = act(x @ gate_w)
     return (gate * (x @ up_w)) @ down_w
+
+
+def yarn_inv_freq(head_dim: int, rope_theta: float, scaling: dict,
+                  max_position_embeddings: int) -> tuple[jax.Array, float]:
+    """YaRN NTK-by-parts inverse frequencies -> (inv_freq, attention
+    factor). Mirrors transformers' modeling_rope_utils.
+    _compute_yarn_parameters (the init the reference's
+    DeepseekScalingRotaryEmbedding shares, vllm/model_executor/layers/
+    rotary_embedding.py yarn_* helpers); the attention factor multiplies
+    cos/sin downstream."""
+    import math
+    factor = scaling["factor"]
+    attention_factor = scaling.get("attention_factor")
+    mscale = scaling.get("mscale")
+    mscale_all_dim = scaling.get("mscale_all_dim")
+    orig = (scaling.get("original_max_position_embeddings")
+            or max_position_embeddings)
+
+    def get_mscale(scale: float, ms: float = 1.0) -> float:
+        return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = float(
+                get_mscale(factor, mscale) / get_mscale(factor,
+                                                        mscale_all_dim))
+        else:
+            attention_factor = get_mscale(factor)
+    beta_fast = scaling.get("beta_fast") or 32
+    beta_slow = scaling.get("beta_slow") or 1
+
+    def corr_dim(num_rotations: float) -> float:
+        return (head_dim * math.log(orig / (num_rotations * 2 * math.pi))
+                ) / (2 * math.log(rope_theta))
+
+    low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+    if scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, head_dim - 1)
+    if low == high:
+        high += 0.001  # avoid the ramp singularity
+    pos_freqs = rope_theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+    ramp = jnp.clip(
+        (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) /
+        (high - low), 0, 1)
+    inv_freq = interp * ramp + extrap * (1 - ramp)
+    return inv_freq, float(attention_factor)
+
+
+def compute_rope_cos_sin_pairwise(
+        positions: jax.Array, head_dim: int, rope_theta: float,
+        rope_scaling: dict | None = None,
+        max_position_embeddings: int = 4096,
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin [T, head_dim//2] for PAIRWISE (complex) rotary — the form
+    DeepSeek MLA applies to its decoupled rope dims (HF
+    modeling_deepseek_v2.apply_rotary_emb on freqs_cis; V3's
+    de-interleave variant is score-equivalent because the same
+    permutation hits q and k). YaRN scaling folds its attention factor
+    into the returned tables, matching HF's freqs_cis * scaling."""
+    rtype = (rope_scaling or {}).get(
+        "rope_type", (rope_scaling or {}).get("type"))
+    if rope_scaling and rtype == "yarn":
+        inv_freq, att = yarn_inv_freq(head_dim, rope_theta, rope_scaling,
+                                      max_position_embeddings)
+    else:
+        inv_freq = make_inv_freq(head_dim, rope_theta, rope_scaling)
+        att = 1.0
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(freqs) * att, jnp.sin(freqs) * att
+
+
+def apply_rope_pairwise(x: jax.Array, cos: jax.Array,
+                        sin: jax.Array) -> jax.Array:
+    """Rotate adjacent pairs (x[2i], x[2i+1]) of [T, heads, D] by the
+    i-th angle — HF DeepSeek's complex-multiply rope."""
+    T, H, D = x.shape
+    xr = x.astype(jnp.float32).reshape(T, H, D // 2, 2)
+    x0, x1 = xr[..., 0], xr[..., 1]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out = jnp.stack([x0 * c - x1 * s, x0 * s + x1 * c], axis=-1)
+    return out.reshape(T, H, D).astype(x.dtype)

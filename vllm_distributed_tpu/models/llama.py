@@ -431,6 +431,21 @@ class LlamaForCausalLM:
                 layers[name] = _replicate_kv_heads(
                     layers[name], c.num_kv_heads, c.num_kv_head_replicas)
 
+    def kv_cache_page_bytes(self, page_size: int) -> int:
+        """HBM bytes one page costs across all layers (the worker sizes
+        the pool from this; models with non-K/V cache layouts override)."""
+        from vllm_distributed_tpu.ops.attention import storage_head_dim
+        c = self.cfg
+        return (2 * c.num_layers * page_size * c.total_kv_heads *
+                storage_head_dim(c.head_dim) *
+                jnp.dtype(c.dtype).itemsize)
+
+    def slice_layer_params(self, layers: dict, start: int,
+                           end: int) -> dict:
+        """A pipeline stage's slice of the stacked per-layer params;
+        models whose stacks have per-kind depths override (deepseek)."""
+        return {k: v[start:end] for k, v in layers.items()}
+
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None,
                        num_layers: Optional[int] = None) -> dict:

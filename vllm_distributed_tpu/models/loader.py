@@ -10,7 +10,7 @@ callbacks on ColumnParallelLinear et al.).
 
 import glob
 import os
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -106,11 +106,24 @@ def get_model(config: EngineConfig, mesh,
             "under token parallelism is not wired yet (the per-rank "
             "attention path carries neither bound); disable one of the "
             "two")
+    if getattr(arch, "mla", False):
+        # MLA family intersections not wired this round; reject with
+        # clear errors instead of silently mis-serving.
+        if config.parallel_config.token_parallel_size > 1:
+            raise ValueError(
+                "MLA (DeepSeek) under token parallelism is not wired "
+                "yet (per-rank latent page pools); disable one")
+        if config.parallel_config.num_redundant_experts:
+            raise ValueError(
+                "EPLB redundant experts are not wired for the DeepSeek "
+                "family yet")
     # KV-head replication when TP exceeds the checkpoint's KV-head count
     # (reference: QKVParallelLinear kv replication, layers/linear.py):
     # repeat heads to the lcm so the kv-head dim divides the model axis.
     tp = config.parallel_config.tensor_parallel_size
-    if arch.num_kv_heads % tp != 0:
+    if getattr(arch, "mla", False):
+        pass  # latent cache is MQA-shared; no KV-head replication
+    elif arch.num_kv_heads % tp != 0:
         import math
         arch.num_kv_head_replicas = (
             math.lcm(arch.num_kv_heads, tp) // arch.num_kv_heads)
@@ -174,3 +187,31 @@ def get_model(config: EngineConfig, mesh,
         "lm_head": place(params["lm_head"], specs["lm_head"]),
     }
     return model, params
+
+
+def resolve_free_window(model_config) -> Optional[int]:
+    """Token window below which KV pages can be freed mid-request: the
+    minimum layer window when EVERY attention layer is windowed, else
+    None (any full-attention layer needs the whole history). Resolved
+    through the same arch hooks get_model uses, so family overrides
+    (Gemma2 alternating layouts, Qwen2 max_window_layers) are honored
+    (reference: the per-group window specs of v1/kv_cache_interface.py
+    SlidingWindowSpec)."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+        arch = LlamaArchConfig.from_hf_config(hf_config)
+        model_cls.configure_arch(arch, hf_config)
+    except Exception:  # noqa: BLE001 - conservative: no freeing
+        return None
+    if arch.window_pattern is not None:
+        pattern = arch.window_pattern
+        # Only a UNIFORM all-windowed pattern is safe to free against:
+        # with unequal windows the larger-window layers still attend
+        # pages the smaller window has left behind (freeing at
+        # min(pattern) would hand live history to the pool). Mixed and
+        # unequal layouts need per-group hybrid caches — not wired.
+        if all(pattern) and len(set(pattern)) == 1:
+            return pattern[0]
+        return None
+    return arch.sliding_window

@@ -12,6 +12,8 @@ from vllm_distributed_tpu.models.families import (BaichuanForCausalLM,
                                                   InternLM2ForCausalLM,
                                                   Phi3ForCausalLM,
                                                   Qwen3ForCausalLM)
+from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
+                                                  DeepseekV3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
@@ -34,6 +36,9 @@ _REGISTRY: dict[str, type] = {
     # Both checkpoint spellings; 13B (ALiBi) is rejected at load.
     "BaichuanForCausalLM": BaichuanForCausalLM,
     "BaiChuanForCausalLM": BaichuanForCausalLM,
+    # MLA + DeepSeekMoE family (latent KV cache; models/deepseek.py).
+    "DeepseekV2ForCausalLM": DeepseekV2ForCausalLM,
+    "DeepseekV3ForCausalLM": DeepseekV3ForCausalLM,
 }
 
 

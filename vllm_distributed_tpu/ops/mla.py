@@ -1,0 +1,136 @@
+"""Multi-head Latent Attention (MLA) ops over a paged latent cache.
+
+TPU-native equivalent of the reference's MLA backend family
+(vllm/v1/attention/backends/mla/common.py, csrc/attention/mla/): the KV
+cache stores ONE compressed row per token — the kv_lora_rank latent
+``kv_c`` concatenated with the shared rope key ``k_pe`` — instead of
+per-head K and V, cutting KV memory by ~an order of magnitude for
+DeepSeek-shaped models.
+
+Design choice: this implementation uses the reference's "data-movement
+friendly" ABSORBED form (common.py:96-120 `_forward_decode`) uniformly
+for prefill and decode. The model absorbs W_UK into the query
+(`ql = q_nope · W_UK`, done once per step outside this op) so attention
+is MQA with qk dim = Lkv + R and v dim = Lkv; W_UV is applied to the
+output afterwards. One uniform path keeps the jit bucket lattice
+additive (the reference keeps separate prefill/decode MLA kernels and
+pays a dispatch split); the compute overhead vs the "compute friendly"
+prefill form is bounded by (Lkv+R)/(P+R) on the score matmul, which the
+MXU absorbs at these widths. A Pallas kernel can later replace the page
+scan without changing this interface.
+
+Sharding: the latent cache is REPLICATED over the model (TP) axis —
+kv_c/k_pe are shared by all heads (that is the point of MLA), so each
+TP rank attends with its local head shard against the full cache, and
+GSPMD needs no collective inside the op. Pages still shard over the
+token-parallel axis like the standard cache (not yet wired: the loader
+rejects MLA x TKNP).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from vllm_distributed_tpu.ops.attention import _MASK_VALUE, _pad_last_dim
+
+
+def latent_storage_dim(kv_lora_rank: int, rope_dim: int) -> int:
+    """Last-dim storage size for the latent cache: Lkv + R padded to the
+    128-lane tile on TPU (see ops/attention.storage_head_dim)."""
+    c = kv_lora_rank + rope_dim
+    if jax.default_backend() == "tpu":
+        return -(-c // 128) * 128
+    return c
+
+
+def write_latent_cache(
+    c_all: jax.Array,  # [L, num_pages, page_size, Cs] stacked cache
+    c_new: jax.Array,  # [T, Lkv + R] new latent rows (kv_c ++ k_pe)
+    batch,  # AttentionBatch
+    layer: jax.Array,  # [1] int32
+) -> jax.Array:
+    """Scatter the step's latent rows into layer ``layer`` of the stacked
+    cache (XLA contiguous-row scatter; slots < 0 drop). Equivalent of
+    the reference's concat_and_cache_mla (csrc/cache_kernels.cu)."""
+    L, NP, PS, Cs = c_all.shape
+    c_new = _pad_last_dim(c_new, Cs)
+    slot = batch.slot_mapping
+    total = L * NP * PS
+    rows = layer[0] * (NP * PS) + slot
+    rows = jnp.where(slot < 0, total, rows)
+    flat = c_all.reshape(total, Cs)
+    flat = flat.at[rows].set(c_new.astype(flat.dtype), mode="drop")
+    return flat.reshape(c_all.shape)
+
+
+def ragged_latent_attention(
+    ql: jax.Array,  # [T, N, Lkv] absorbed no-rope queries (q_nope · W_UK)
+    q_pe: jax.Array,  # [T, N, R] rope queries
+    c_pages: jax.Array,  # [num_pages, page_size, Cs] one layer's cache
+    block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
+    req_idx: jax.Array,  # [T] int32 owning request row per token
+    q_pos: jax.Array,  # [T] int32 absolute position per query token
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    rope_dim: int,
+) -> jax.Array:  # [T, N, Lkv] latent-space attention output
+    """Unified ragged MQA over the latent cache: token t attends to
+    latent rows 0..q_pos[t] of its request. Scores are
+    ql·kv_c + q_pe·k_pe (the absorbed form); the value accumulated is
+    kv_c itself, so the caller applies W_UV to the [T, N, Lkv] result.
+    Online-softmax scan over pages, like ops/attention.
+    ragged_paged_attention."""
+    T, N, Lkv = ql.shape
+    PS = c_pages.shape[1]
+    pages_per_req = block_tables.shape[1]
+    # [T, N, Lkv + R] combined queries, pre-scaled.
+    qc = jnp.concatenate([ql.astype(jnp.float32),
+                          q_pe.astype(jnp.float32)], axis=-1) * sm_scale
+    token_pages = block_tables[req_idx]  # [T, pages_per_req]
+    kdim = kv_lora_rank + rope_dim
+
+    def body(carry, page_i):
+        m, l, acc = carry  # [T,N,1], [T,N,1], [T,N,Lkv]
+        page_ids = token_pages[:, page_i]  # [T]
+        blk = c_pages[page_ids, :, :kdim].astype(jnp.float32)  # [T,PS,kd]
+        scores = jnp.einsum("tnc,tpc->tnp", qc, blk)  # [T, N, PS]
+        kv_pos = page_i * PS + jnp.arange(PS, dtype=jnp.int32)
+        valid = kv_pos[None, :] <= q_pos[:, None]  # [T, PS] causal
+        scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("tnp,tpl->tnl", p,
+                                           blk[..., :Lkv])
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((T, N, 1), _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((T, N, 1), jnp.float32)
+    acc0 = jnp.zeros((T, N, Lkv), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0),
+        jnp.arange(pages_per_req, dtype=jnp.int32))
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.astype(ql.dtype)
+
+
+def latent_attention(q_absorbed, q_pe, c_all, batch, *, sm_scale,
+                     kv_lora_rank, rope_dim, layer=None):
+    """Model-facing entry: select the layer's page slab and run the
+    ragged latent attention (the MLA analogue of ops/attention.
+    paged_attention). Token parallelism is rejected upstream by the
+    loader; there is no Pallas variant yet, so every backend takes this
+    XLA path."""
+    if getattr(batch, "tknp", None) is not None:
+        raise NotImplementedError(
+            "MLA under token parallelism (per-rank latent page pools "
+            "are not wired; models/loader.py rejects the combination "
+            "at admission — this trace-time guard is the backstop)")
+    if layer is None:
+        layer = jnp.zeros((1, ), jnp.int32)
+    c_layer = c_all[layer[0]] if c_all.ndim == 4 else c_all
+    return ragged_latent_attention(
+        q_absorbed, q_pe, c_layer, batch.block_tables, batch.req_idx,
+        batch.positions, sm_scale=sm_scale, kv_lora_rank=kv_lora_rank,
+        rope_dim=rope_dim)

@@ -244,11 +244,9 @@ class TPUModelRunner:
         logger.info("awake: weights restored, KV cache reset")
 
     def kv_cache_bytes_per_page(self) -> int:
-        from vllm_distributed_tpu.ops.attention import storage_head_dim
-        c = self.model.cfg
-        itemsize = jnp.dtype(c.dtype).itemsize
-        return (2 * c.num_layers * self.page_size * c.total_kv_heads *
-                storage_head_dim(c.head_dim) * itemsize)
+        # The model owns its cache layout (MLA stores one latent row per
+        # token instead of per-head K/V).
+        return self.model.kv_cache_page_bytes(self.page_size)
 
     def _build_step_fn(self) -> None:
         """Two jits instead of one: forward (shapes keyed by the token

@@ -63,10 +63,12 @@ class PPModelRunner(TPUModelRunner):
         self.stage_params = []
         for p, (s, e) in enumerate(self.layer_ranges):
             sm = self.stage_meshes[p]
+            sliced = self.model.slice_layer_params(
+                host_params["layers"], s, e)
             self.stage_params.append({
-                k: jax.device_put(v[s:e],
-                                  NamedSharding(sm, specs["layers"][k]))
-                for k, v in host_params["layers"].items()
+                k: jax.device_put(v, NamedSharding(sm,
+                                                   specs["layers"][k]))
+                for k, v in sliced.items()
             })
         sm0, sml = self.stage_meshes[0], self.stage_meshes[-1]
         self.embed_params = {
