@@ -25,6 +25,12 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # all-expert einsum baseline (A/B + FLOP regression tests).
     "VDT_MOE_BACKEND":
     lambda: os.getenv("VDT_MOE_BACKEND", "ragged"),  # ragged|dense
+    # Expert-parallel dispatch mechanism: "a2a" = token-sharded
+    # all-to-all rows to expert-owner ranks (falls back automatically
+    # when inapplicable, e.g. EPLB replicas or indivisible buckets);
+    # "replicate" forces the replicate+psum path.
+    "VDT_MOE_EP_MODE":
+    lambda: os.getenv("VDT_MOE_EP_MODE", "a2a"),
     # JAX platform to pin before backend init ("auto" = JAX default).
     # Setting "cpu" defeats a TPU plugin whose init can hang for minutes
     # on hosts where the chip is tunnelled (reference analogue: the

@@ -212,21 +212,39 @@ class MixtralForCausalLM(LlamaForCausalLM):
 
         VDT_MOE_BACKEND=dense restores the all-expert einsum baseline
         (also used by the FLOP-reduction regression test)."""
-        from vllm_distributed_tpu import envs
+        top_idx, top_vals = self._route(lp, x)
+        return self.moe_dispatch(lp, x, top_idx, top_vals)
+
+    def _route(self, lp: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Router softmax -> top-k -> optional renormalize (HF Mixtral
+        semantics); subclasses override for other gating schemes."""
         c = self.cfg
-        T = x.shape[0]
-        k = c.num_experts_per_tok
-        E = c.num_experts
         # Router in fp32 for parity with the HF reference.
         logits = (x.astype(jnp.float32)
                   @ lp["router"].astype(jnp.float32))  # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        top_vals, top_idx = jax.lax.top_k(probs, k)
+        top_vals, top_idx = jax.lax.top_k(probs, c.num_experts_per_tok)
         if c.norm_topk_prob:
             top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
+        return top_idx, top_vals
+
+    def moe_dispatch(self, lp: dict, x: jax.Array, top_idx: jax.Array,
+                     top_vals: jax.Array) -> jax.Array:
+        """Routing-agnostic grouped-GEMM dispatch of (token, expert)
+        assignments (see mlp_block docstring for the mechanism)."""
+        from vllm_distributed_tpu import envs
+        c = self.cfg
+        T = x.shape[0]
+        k = top_idx.shape[-1]
+        E = c.num_experts
 
         if envs.VDT_MOE_BACKEND == "dense":
             return self._moe_dense(lp, x, top_idx, top_vals)
+
+        if c.expert_parallel and self._a2a_applicable(T):
+            # True all-to-all dispatch: tokens shard over the EP axis,
+            # rows travel to their expert-owner rank and back.
+            return self._moe_ep_a2a(lp, x, top_idx, top_vals)
 
         # Flatten assignments and sort by expert id: each expert's rows
         # become contiguous, exactly what ragged_dot's group_sizes
@@ -266,6 +284,120 @@ class MixtralForCausalLM(LlamaForCausalLM):
         u = jax.lax.ragged_dot(xs, self._w(lp, "w_up"), group_sizes)
         return jax.lax.ragged_dot(g * u, self._w(lp, "w_down"),
                                   group_sizes)
+
+    def _a2a_applicable(self, T: int) -> bool:
+        """The all-to-all dispatch needs the token bucket divisible by
+        the EP width (static per-rank slices), no EPLB physical-replica
+        indirection (replica choice is token-global), and the mode not
+        forced off. Non-applicable cases fall back to the exact
+        replicate+psum path."""
+        from vllm_distributed_tpu import envs
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if envs.VDT_MOE_EP_MODE != "a2a":
+            return False
+        if not mesh_state.has_global_mesh():
+            return False
+        ep = mesh_state.get_global_mesh().shape[MODEL_AXIS]
+        return (ep > 1 and T % ep == 0
+                and self.num_physical == self.cfg.num_experts
+                and self.cfg.num_experts % ep == 0)
+
+    def _moe_ep_a2a(self, lp: dict, x: jax.Array, top_idx: jax.Array,
+                    top_vals: jax.Array) -> jax.Array:
+        """Expert-parallel MoE with TRUE all-to-all dispatch (reference:
+        device_communicators/all2all.py NaiveAll2AllManager and the
+        dispatch/combine hooks at parallel_state.py:790-803).
+
+        Each rank of the ``model`` axis owns E/ep whole experts AND a
+        T/ep slice of the token batch. A rank buckets its own (token,
+        expert) assignments by owner rank into fixed-capacity send
+        buffers (capacity = its full T/ep*k rows, so no assignment is
+        ever dropped — static shapes, exact compute), `lax.all_to_all`s
+        rows to their expert owners, runs the grouped GEMMs locally,
+        `all_to_all`s the weighted outputs back, combines its own
+        tokens' k rows, and one tiled all_gather re-replicates the
+        output for the (activation-replicated) engine.
+
+        ICI volume per MoE layer is O(T*k*H) each way plus the [T, H]
+        gather — vs the replicate+psum path's O(ep * T * k * H) psum.
+        The worst-case capacity keeps this exact; a capacity-factor
+        (dropping) variant would trade exactness for bandwidth, which
+        the parity tests forbid."""
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        mesh = mesh_state.get_global_mesh()
+        ep = mesh.shape[MODEL_AXIS]
+        E_local = self.cfg.num_experts // ep
+        T = x.shape[0]
+        k = top_idx.shape[-1]
+        Tl = T // ep
+        Rk = Tl * k  # send capacity per destination (worst case)
+        H = x.shape[-1]
+
+        def rank_fn(w_gate, w_up, w_down, x_, ti_, tv_):
+            r = jax.lax.axis_index(MODEL_AXIS)
+            xs = jax.lax.dynamic_slice_in_dim(x_, r * Tl, Tl)
+            til = jax.lax.dynamic_slice_in_dim(ti_, r * Tl, Tl)
+            tvl = jax.lax.dynamic_slice_in_dim(tv_, r * Tl, Tl)
+            flat_e = til.astype(jnp.int32).reshape(-1)       # [Rk]
+            flat_w = tvl.reshape(-1)
+            flat_tok = jnp.repeat(jnp.arange(Tl, dtype=jnp.int32), k)
+            dest = flat_e // E_local
+            order = jnp.argsort(dest, stable=True)
+            d_sorted = dest[order]
+            # Position within the destination bucket: index minus the
+            # bucket's first index in the sorted order.
+            within = (jnp.arange(Rk, dtype=jnp.int32) -
+                      jnp.searchsorted(d_sorted, d_sorted,
+                                       side="left").astype(jnp.int32))
+            slot = d_sorted * Rk + within                    # unique
+            send_x = jnp.zeros((ep * Rk, H), x_.dtype).at[slot].set(
+                xs[flat_tok[order]])
+            send_e = jnp.full((ep * Rk, ), -1, jnp.int32).at[slot].set(
+                flat_e[order] % E_local)
+            send_w = jnp.zeros((ep * Rk, ), flat_w.dtype).at[slot].set(
+                flat_w[order])
+            # Rows travel to their expert owner...
+            recv_x = jax.lax.all_to_all(
+                send_x.reshape(ep, Rk, H), MODEL_AXIS, 0, 0)
+            recv_e = jax.lax.all_to_all(
+                send_e.reshape(ep, Rk), MODEL_AXIS, 0, 0).reshape(-1)
+            recv_w = jax.lax.all_to_all(
+                send_w.reshape(ep, Rk), MODEL_AXIS, 0, 0).reshape(-1)
+            # ...grouped GEMMs over the received rows (padding rows sort
+            # into the dropped E_local bucket and come back zero)...
+            eid = jnp.where(recv_e >= 0, recv_e, E_local)
+            order2 = jnp.argsort(eid, stable=True)
+            xs2 = recv_x.reshape(ep * Rk, H)[order2]
+            gs = jnp.bincount(eid[order2], length=E_local + 1)[:-1]
+            g = jax.nn.silu(jax.lax.ragged_dot(xs2, w_gate, gs))
+            u = jax.lax.ragged_dot(xs2, w_up, gs)
+            y = jax.lax.ragged_dot(g * u, w_down, gs)
+            y = y * recv_w[order2][:, None].astype(y.dtype)
+            y = y[jnp.argsort(order2)]                       # recv order
+            # ...and back to their owner (all_to_all is positionally an
+            # involution here: my receive slice j returns as slice j).
+            back = jax.lax.all_to_all(
+                y.reshape(ep, Rk, H), MODEL_AXIS, 0, 0).reshape(
+                    ep * Rk, H)
+            # Combine this rank's k rows per token; slot layout gives
+            # each row's source token.
+            src_tok = jnp.full((ep * Rk, ), Tl, jnp.int32).at[slot].set(
+                flat_tok[order])
+            out_local = jax.ops.segment_sum(back, src_tok,
+                                            num_segments=Tl + 1)[:Tl]
+            # Re-replicate for the activation-replicated engine.
+            return jax.lax.all_gather(out_local, MODEL_AXIS, tiled=True)
+
+        out = jax.shard_map(
+            rank_fn, mesh=mesh,
+            in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS, None, None),
+                      P(MODEL_AXIS, None, None), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False)(self._w(lp, "w_gate"), self._w(lp, "w_up"),
+                             self._w(lp, "w_down"), x,
+                             top_idx.astype(jnp.int32),
+                             top_vals.astype(jnp.float32))
+        return out.astype(x.dtype)
 
     def _moe_ep_ragged(self, lp: dict, xs: jax.Array, se: jax.Array,
                        sw: jax.Array) -> jax.Array:
