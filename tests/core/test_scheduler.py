@@ -286,3 +286,59 @@ def test_spec_tokens_trimmed_to_budget():
     scheduler.update_from_output(out, mro)
     assert req.num_computed_tokens == 10  # 8 prefill + 2 this step
     assert req.output_token_ids[-2:] == [42, 43]
+
+
+def test_sliding_window_frees_dead_pages():
+    """Uniform-window models free pages that leave every future query's
+    window (reference: SlidingWindowManager null-block replacement,
+    v1/core/single_type_kv_cache_manager.py:444): steady-state page usage
+    is bounded by the window, not the generated length."""
+    from transformers import MistralConfig
+    cfg = make_config(num_blocks=64, max_model_len=128,
+                      max_num_batched_tokens=128)
+    cfg.model_config.hf_config = MistralConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=8, max_position_embeddings=128)
+    scheduler = Scheduler(cfg)
+    assert scheduler.kv_cache_manager.free_window == 8
+
+    req = make_request(num_tokens=16, max_tokens=60)
+    scheduler.add_request(req)
+    step(scheduler)  # prefill
+    peak_used = 0
+    for _ in range(59):
+        step(scheduler)
+        used = 64 - scheduler.kv_cache_manager.get_num_free_blocks()
+        peak_used = max(peak_used, used)
+    # Window 8 + current page + allocation slack: never the 19 pages a
+    # 76-token history would need.
+    assert peak_used <= 4, peak_used
+    assert req.status == RequestStatus.FINISHED_LENGTH_CAPPED
+    # Everything returns to the pool (no double-free of nulled slots).
+    assert scheduler.kv_cache_manager.get_num_free_blocks() == 64
+
+
+def test_full_attention_models_do_not_window_free():
+    from transformers import LlamaConfig
+    cfg = make_config()
+    cfg.model_config.hf_config = LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2)
+    scheduler = Scheduler(cfg)
+    assert scheduler.kv_cache_manager.free_window is None
+
+
+def test_mixed_window_layout_does_not_free():
+    """Any full-attention layer needs the whole history: Gemma2-style
+    alternating layouts must not free (per-group freeing needs hybrid
+    cache groups — not wired)."""
+    from transformers import Qwen2Config
+    cfg = make_config()
+    cfg.model_config.hf_config = Qwen2Config(
+        vocab_size=128, hidden_size=64, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=8, use_sliding_window=True, max_window_layers=2,
+        max_position_embeddings=128)
+    scheduler = Scheduler(cfg)
+    assert scheduler.kv_cache_manager.free_window is None

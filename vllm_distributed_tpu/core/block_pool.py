@@ -184,6 +184,10 @@ class BlockPool:
         for i in range(num_cached_blocks, num_full_blocks):
             block = blocks[i]
             block_hash = block_hashes[i]
+            if block is None:
+                # Sliding-window-freed slot (kv_cache_manager nulls the
+                # dead prefix); nothing to register.
+                continue
             if block.block_hash is not None:
                 continue  # already cached (shared hit)
             existing = self.cached_block_hash_to_block.get(

@@ -43,10 +43,21 @@ class KVCacheManager:
         num_blocks: int,
         enable_caching: bool = True,
         id_offset: int = 0,
+        free_window: Optional[int] = None,
     ) -> None:
         self.block_size = block_size
         self.enable_caching = enable_caching
         self.block_pool = BlockPool(num_blocks, enable_caching, id_offset)
+        # Sliding-window page freeing (reference: the SlidingWindowManager
+        # of v1/core/single_type_kv_cache_manager.py:444 replacing
+        # out-of-window blocks with the null block): when EVERY attention
+        # layer is windowed, pages whose last position can never again
+        # fall inside any future query's window are freed mid-request and
+        # their req_to_blocks slot nulled. The attention mask already
+        # excludes those positions, so a stale (possibly reused) page id
+        # in the block table is never read into a live score. None =
+        # some layer needs full history; no mid-request freeing.
+        self.free_window = free_window
 
         # req_id -> pages owned (ordered by position in sequence).
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = defaultdict(list)
@@ -119,6 +130,10 @@ class KVCacheManager:
         if skip_allocation:
             return KVCacheBlocks([])
 
+        # Free the dead window prefix FIRST so the released pages can
+        # satisfy this very allocation.
+        self._free_out_of_window(request)
+
         computed_blocks = (new_computed_blocks.blocks
                            if new_computed_blocks else [])
         req_blocks = self.req_to_blocks[request.request_id]
@@ -189,13 +204,36 @@ class KVCacheManager:
                                               num_cached, num_full_after)
             self.num_cached_block[request.request_id] = num_full_after
 
+    def _free_out_of_window(self, request: Request) -> None:
+        """Null + free every block whose last position precedes
+        num_computed_tokens - window (no future query can attend it;
+        the window mask in ops/attention guarantees it is never read)."""
+        if self.free_window is None:
+            return
+        num_dead = max(
+            0, request.num_computed_tokens - self.free_window + 1
+        ) // self.block_size
+        if num_dead <= 0:
+            return
+        blocks = self.req_to_blocks.get(request.request_id)
+        if not blocks:
+            return
+        dead = []
+        for i in range(min(num_dead, len(blocks))):
+            if blocks[i] is not None:
+                dead.append(blocks[i])
+                blocks[i] = None
+        if dead:
+            self.block_pool.free_blocks(dead)
+
     # ------------------------------------------------------------------
     def free(self, request: Request) -> None:
         """Release all pages of a finished/preempted request. Pages are
         returned tail-first so prefixes are evicted last."""
         blocks = self.req_to_blocks.pop(request.request_id, [])
         self.num_cached_block.pop(request.request_id, None)
-        self.block_pool.free_blocks(list(reversed(blocks)))
+        self.block_pool.free_blocks(
+            [b for b in reversed(blocks) if b is not None])
 
     def free_block_hashes(self, request: Request) -> None:
         """Forget the request's hash list (on finish — distinct from free()
@@ -203,7 +241,11 @@ class KVCacheManager:
         self.req_to_block_hashes.pop(request.request_id, None)
 
     def get_block_ids(self, request_id: str) -> list[int]:
-        return [b.block_id for b in self.req_to_blocks[request_id]]
+        # Window-freed slots keep a position-aligned placeholder id; the
+        # attention window mask guarantees those positions are never
+        # read (see _free_out_of_window).
+        return [0 if b is None else b.block_id
+                for b in self.req_to_blocks[request_id]]
 
     def reset_prefix_cache(self) -> bool:
         return self.block_pool.reset_prefix_cache()

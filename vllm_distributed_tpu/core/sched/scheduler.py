@@ -93,6 +93,13 @@ class Scheduler:
         # assigns each request to a rank at admission (reference:
         # v1/core/sched/scheduler.py:55 TokenParallelScheduler).
         self.tknp_size = config.parallel_config.token_parallel_size
+        # Sliding-window page freeing: only when every layer is windowed
+        # AND no KV connector is attached (a connector may still read a
+        # request's prompt pages for a peer pull after they leave the
+        # window; its deferred-free holds don't cover mid-request frees).
+        from vllm_distributed_tpu.models.loader import resolve_free_window
+        free_window = (None if kv_connector is not None
+                       else resolve_free_window(config.model_config))
         if self.tknp_size > 1:
             self.kv_cache_manager = TokenParallelKVCacheManager(
                 block_size=config.cache_config.block_size,
@@ -107,6 +114,7 @@ class Scheduler:
                 block_size=config.cache_config.block_size,
                 num_blocks=num_blocks,
                 enable_caching=config.cache_config.enable_prefix_caching,
+                free_window=free_window,
             )
         # Structured output (reference: the engine core's
         # StructuredOutputManager beside the scheduler,
