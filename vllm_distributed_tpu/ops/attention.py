@@ -157,6 +157,39 @@ def ragged_paged_attention(
     return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
 
 
+def _shared_prefix_state(q, k_pages, v_pages, shared_page_ids, q_pos,
+                         sm_scale):
+    """Dense online-softmax partial state of all T query tokens against
+    the batch-wide shared-prefix pages: one gather + MXU matmuls,
+    loaded once for the whole batch. Returns (m, l, acc) shaped
+    [T, QH, 1/1/D] for merging with a suffix phase."""
+    T, num_q_heads, head_dim = q.shape
+    num_kv_heads, page_size = k_pages.shape[1], k_pages.shape[2]
+    group = num_q_heads // num_kv_heads
+    S = shared_page_ids.shape[0]
+    qg = (q.reshape(T, num_kv_heads, group, head_dim)
+          .astype(jnp.float32) * sm_scale)
+    k_sh = k_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
+    v_sh = v_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
+    scores = jnp.einsum("thgd,shpd->thgsp", qg, k_sh)
+    kv_pos = (jnp.arange(S, dtype=jnp.int32)[:, None] * page_size +
+              jnp.arange(page_size, dtype=jnp.int32)[None, :])
+    valid = kv_pos.reshape(-1)[None, :] <= q_pos[:, None]  # [T, S*ps]
+    scores = scores.reshape(T, num_kv_heads, group, S * page_size)
+    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+    m = scores.max(axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = p.sum(axis=-1, keepdims=True)
+    acc = jnp.einsum(
+        "thgj,thjd->thgd", p,
+        jnp.broadcast_to(
+            v_sh.swapaxes(0, 1).reshape(1, num_kv_heads,
+                                        S * page_size, head_dim),
+            (T, num_kv_heads, S * page_size, head_dim)))
+    return (m.reshape(T, num_q_heads, 1), l.reshape(T, num_q_heads, 1),
+            acc.reshape(T, num_q_heads, head_dim))
+
+
 def merge_attention_states(state_a, state_b):
     """Combine two online-softmax partial states (m, l, acc) over
     disjoint KV ranges — the XLA equivalent of the reference's
@@ -202,24 +235,12 @@ def cascade_ragged_paged_attention(
           .astype(jnp.float32) * sm_scale)
 
     # ---- shared phase: dense attention over the common S pages ----
-    k_sh = k_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
-    v_sh = v_pages[shared_page_ids, ..., :head_dim].astype(jnp.float32)
-    # [T, Hkv, G, S, ps]
-    scores = jnp.einsum("thgd,shpd->thgsp", qg, k_sh)
-    kv_pos = (jnp.arange(S, dtype=jnp.int32)[:, None] * page_size +
-              jnp.arange(page_size, dtype=jnp.int32)[None, :])
-    valid = kv_pos.reshape(-1)[None, :] <= q_pos[:, None]  # [T, S*ps]
-    scores = scores.reshape(T, num_kv_heads, group, S * page_size)
-    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
-    m_sh = scores.max(axis=-1, keepdims=True)
-    p = jnp.exp(scores - m_sh)
-    l_sh = p.sum(axis=-1, keepdims=True)
-    acc_sh = jnp.einsum(
-        "thgj,thjd->thgd", p,
-        jnp.broadcast_to(
-            v_sh.swapaxes(0, 1).reshape(1, num_kv_heads,
-                                        S * page_size, head_dim),
-            (T, num_kv_heads, S * page_size, head_dim)))
+    m_sh, l_sh, acc_sh = _shared_prefix_state(q, k_pages, v_pages,
+                                              shared_page_ids, q_pos,
+                                              sm_scale)
+    m_sh = m_sh.reshape(T, num_kv_heads, group, 1)
+    l_sh = l_sh.reshape(T, num_kv_heads, group, 1)
+    acc_sh = acc_sh.reshape(T, num_kv_heads, group, head_dim)
 
     # ---- suffix phase: the usual scan, slots [S, pages_per_req) ----
     token_pages = block_tables[req_idx]
@@ -489,6 +510,43 @@ def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
                          tk.block_tables, tk.slot_mapping)
 
 
+def _pallas_cascade(q, q_p, k_all, v_all, batch, layer, sm_scale,
+                    head_dim):
+    """Cascade attention on the Pallas backend: the batch-wide shared
+    prefix runs as ONE dense XLA phase (a single gather + MXU matmuls —
+    there is nothing a kernel would add over XLA's own fusion here),
+    the per-request suffix runs the Pallas kernel over a block table
+    with the shared slots stripped and kv_len shifted (relative
+    causality is preserved), and the kernel's exported (m, l) state
+    merges the two exactly (reference: flash_attn.py cascade +
+    merge_attn_states.cu)."""
+    from vllm_distributed_tpu.ops.pallas_attention import (
+        ragged_paged_attention_pallas)
+    shared = batch.cascade_shared_ids
+    S = shared.shape[0]
+    page_size = k_all.shape[3]
+    D = k_all.shape[-1]
+    k_layer = k_all[layer[0]]
+    v_layer = v_all[layer[0]]
+    m_sh, l_sh, acc_sh = _shared_prefix_state(
+        q, k_layer, v_layer, shared, batch.positions, sm_scale)
+
+    shift = S * page_size
+    si = batch.seq_info
+    si_sfx = si.at[:, 2].set(jnp.maximum(si[:, 2] - shift, 0))
+    out_sf, st_sf = ragged_paged_attention_pallas(
+        q_p, k_all, v_all, si_sfx, batch.num_seqs,
+        batch.block_tables[:, S:], layer, sm_scale=sm_scale,
+        max_q=batch.max_q, emit_state=True)
+    m_sf = st_sf[..., 0:1]                      # [T, QH, 1] f32
+    l_sf = st_sf[..., D // 2:D // 2 + 1]
+    acc_sf = out_sf[..., :head_dim].astype(jnp.float32) * l_sf
+
+    _, l, acc = merge_attention_states((m_sh, l_sh, acc_sh),
+                                       (m_sf, l_sf, acc_sf))
+    return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
+
+
 def paged_attention(
     q: jax.Array,  # [T, num_q_heads, head_dim]
     k_pages: jax.Array,  # [L, N, KVH, PS, D] stacked cache
@@ -532,17 +590,22 @@ def paged_attention(
         def call(q_, k_, v_):
             # Cache storage may be lane-padded (storage_head_dim); pad q to
             # match and slice the padding back off the output.
-            q_ = _pad_last_dim(q_, k_.shape[-1])
-            out = ragged_paged_attention_pallas(
-                q_, k_, v_, batch.seq_info, batch.num_seqs,
-                batch.block_tables, layer, sm_scale=sm_scale,
-                max_q=batch.max_q)
+            q_p = _pad_last_dim(q_, k_.shape[-1])
+            shared = getattr(batch, "cascade_shared_ids", None)
+            if shared is not None:
+                out = _pallas_cascade(q_, q_p, k_, v_, batch, layer,
+                                      sm_scale, head_dim)
+            else:
+                out = ragged_paged_attention_pallas(
+                    q_p, k_, v_, batch.seq_info, batch.num_seqs,
+                    batch.block_tables, layer, sm_scale=sm_scale,
+                    max_q=batch.max_q)[..., :head_dim]
             # Rows the kernel never writes (padding tokens, tile spill past
             # the last sequence) are uninitialized HBM — possibly NaN/Inf
             # bit patterns. Zero them so garbage can't propagate through
             # later layers' projections (padding tokens have slot -1).
             valid = (batch.slot_mapping >= 0)[:, None, None]
-            return jnp.where(valid, out[..., :head_dim], 0)
+            return jnp.where(valid, out, 0)
 
         from vllm_distributed_tpu.config import MESH_AXIS_MODEL
         from vllm_distributed_tpu.parallel import mesh as mesh_state

@@ -60,23 +60,22 @@ def _kernel(
     q_hbm,  # [T_pad, QH, D]
     k_hbm,  # [L, num_pages, KVH, PS, D] (full stacked cache)
     v_hbm,
-    # output (HBM)
-    out_hbm,  # [T_pad, QH, D]
-    # scratch
-    q_vmem,  # [BQ, QH, D] q.dtype
-    k_vmem,  # [KVH, BLK, D]
-    v_vmem,  # [KVH, BLK, D]
-    out_stage,  # [BQ, QH, D] q.dtype
-    q_sem,
-    kv_sems,  # DMA sems [2, PPB]
-    out_sem,
-    *,
+    # outputs (HBM): out_hbm, then state_hbm when emit_state
+    *refs,
     sm_scale: float,
     bq: int,
     ppb: int,
     page_size: int,
     group: int,
+    emit_state: bool,
 ):
+    if emit_state:
+        (out_hbm, state_hbm, q_vmem, k_vmem, v_vmem, out_stage,
+         state_stage, q_sem, kv_sems, out_sem, state_sem) = refs
+    else:
+        (out_hbm, q_vmem, k_vmem, v_vmem, out_stage, q_sem, kv_sems,
+         out_sem) = refs
+        state_hbm = state_stage = state_sem = None
     r = pl.program_id(0)
     qt = pl.program_id(1)
 
@@ -195,6 +194,7 @@ def _kernel(
         )
         ms, ls, accs = jax.lax.fori_loop(0, num_blocks, body, init)
 
+        half = head_dim // 2
         for h in range(num_kv_heads):
             o_h = accs[h] / jnp.maximum(ls[h], 1e-20)  # [rows, D]
             if bq == 1:
@@ -204,16 +204,36 @@ def _kernel(
                 out_stage[:, h * group:(h + 1) * group, :] = (
                     o_h.reshape(bq, group, head_dim).astype(
                         out_stage.dtype))
+            if emit_state:
+                # Online-softmax partial state for exact merging with
+                # another KV range (cascade): m broadcast over the low
+                # lanes, l over the high — lane-sliced out by the
+                # caller. Full-D staging keeps the DMA tile-aligned.
+                st = jnp.concatenate([
+                    jnp.broadcast_to(ms[h], (rows, half)),
+                    jnp.broadcast_to(ls[h], (rows, head_dim - half)),
+                ], axis=-1)
+                if bq == 1:
+                    state_stage[0, h * group:(h + 1) * group, :] = st
+                else:
+                    state_stage[:, h * group:(h + 1) * group, :] = (
+                        st.reshape(bq, group, head_dim))
         out_dma = pltpu.make_async_copy(
             out_stage, out_hbm.at[pl.ds(q_start + tile_start, bq)],
             out_sem)
         out_dma.start()
+        if emit_state:
+            st_dma = pltpu.make_async_copy(
+                state_stage,
+                state_hbm.at[pl.ds(q_start + tile_start, bq)], state_sem)
+            st_dma.start()
+            st_dma.wait()
         out_dma.wait()
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "max_q", "interpret"))
+    static_argnames=("sm_scale", "max_q", "interpret", "emit_state"))
 def ragged_paged_attention_pallas(
     q: jax.Array,  # [T_pad, QH, D]; T_pad >= T + q_tile padding
     k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] full stacked cache
@@ -226,13 +246,21 @@ def ragged_paged_attention_pallas(
     sm_scale: float,
     max_q: int,
     interpret: bool | None = None,
-) -> jax.Array:
+    emit_state: bool = False,
+):
     """Unified prefill/decode attention over the paged KV cache.
 
     ``max_q`` is the static per-sequence query bucket (1 for pure decode).
     The cache keeps its stacked layer dim; ``layer`` selects the slice to
     read (pages are DMA'd as [layer, page] — no layer copy materializes).
     Returns [T_pad, QH, D]; rows past each sequence's q_len are garbage.
+
+    ``emit_state=True`` additionally returns the online-softmax partial
+    state as an f32 [T_pad, QH, D] array with the row max broadcast over
+    lanes [0, D/2) and the exp-sum over [D/2, D) — what cascade needs to
+    merge this call's KV range with a shared-prefix phase exactly
+    (reference: csrc/attention/merge_attn_states.cu exports the same
+    (max, sumexp) pair).
     """
     if interpret is None:
         interpret = envs.VDT_PALLAS_INTERPRET
@@ -266,7 +294,29 @@ def ragged_paged_attention_pallas(
     grid = (R, num_q_tiles)
     kernel = functools.partial(
         _kernel, sm_scale=sm_scale, bq=bq, ppb=ppb, page_size=page_size,
-        group=group)
+        group=group, emit_state=emit_state)
+
+    scratch = [
+        pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
+        pltpu.VMEM((num_kv_heads, blk, head_dim), k_pages.dtype),
+        pltpu.VMEM((num_kv_heads, blk, head_dim), v_pages.dtype),
+        pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
+    ]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]
+    if emit_state:
+        scratch.append(
+            pltpu.VMEM((bq, num_q_heads, head_dim), jnp.float32))
+        out_shape.append(
+            jax.ShapeDtypeStruct(q.shape, jnp.float32))
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    scratch += [
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((2, ppb)),
+        pltpu.SemaphoreType.DMA(()),
+    ]
+    if emit_state:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -276,20 +326,15 @@ def ragged_paged_attention_pallas(
             pl.BlockSpec(memory_space=pltpu.ANY),  # k_pages
             pl.BlockSpec(memory_space=pltpu.ANY),  # v_pages
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-        scratch_shapes=[
-            pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
-            pltpu.VMEM((num_kv_heads, blk, head_dim), k_pages.dtype),
-            pltpu.VMEM((num_kv_heads, blk, head_dim), v_pages.dtype),
-            pltpu.VMEM((bq, num_q_heads, head_dim), q.dtype),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2, ppb)),
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    return pl.pallas_call(
+    result = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(seq_info, num_seqs, layer, block_tables, q, k_pages, v_pages)
+    if emit_state:
+        return tuple(result)
+    return result[0]
