@@ -58,6 +58,9 @@ class KVCacheManager:
         # in the block table is never read into a live score. None =
         # some layer needs full history; no mid-request freeing.
         self.free_window = free_window
+        # req_id -> count of leading slots already window-freed (loop
+        # resume point; dropped with the request's block list).
+        self._num_window_freed: dict[str, int] = {}
 
         # req_id -> pages owned (ordered by position in sequence).
         self.req_to_blocks: dict[str, list[KVCacheBlock]] = defaultdict(list)
@@ -218,11 +221,17 @@ class KVCacheManager:
         blocks = self.req_to_blocks.get(request.request_id)
         if not blocks:
             return
+        # Start at the first live slot (persisted) so steady-state decode
+        # frees at most one new block in O(1), not O(dead prefix).
+        start = self._num_window_freed.get(request.request_id, 0)
+        end = min(num_dead, len(blocks))
         dead = []
-        for i in range(min(num_dead, len(blocks))):
+        for i in range(start, end):
             if blocks[i] is not None:
                 dead.append(blocks[i])
                 blocks[i] = None
+        if end > start:
+            self._num_window_freed[request.request_id] = end
         if dead:
             self.block_pool.free_blocks(dead)
 
@@ -232,6 +241,7 @@ class KVCacheManager:
         returned tail-first so prefixes are evicted last."""
         blocks = self.req_to_blocks.pop(request.request_id, [])
         self.num_cached_block.pop(request.request_id, None)
+        self._num_window_freed.pop(request.request_id, None)
         self.block_pool.free_blocks(
             [b for b in reversed(blocks) if b is not None])
 

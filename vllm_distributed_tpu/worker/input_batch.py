@@ -59,6 +59,13 @@ class InputBatch:
         self.allowed_token_ids: list[Optional[list[int]]] = [None] * R
         self.stop_token_ids: list[tuple[int, ...]] = [()] * R
 
+        # Bumped whenever a row's token content is REWRITTEN (not
+        # appended): admission, preemption resume. The runner's
+        # device-resident history mirror re-uploads such rows in full
+        # and follows appends with small deltas (model_runner.
+        # _hist_rows_device).
+        self.row_version = np.zeros((R, ), np.int64)
+
         self.req_id_to_index: dict[str, int] = {}
         self.index_to_req_id: dict[int, str] = {}
         self._free_rows = list(range(R - 1, -1, -1))
@@ -80,6 +87,7 @@ class InputBatch:
         self.token_ids[row, :n] = tokens
         self.token_ids[row, n:] = 0
         self.num_tokens[row] = n
+        self.row_version[row] += 1
         self.num_computed[row] = data.num_computed_tokens
         nb = len(data.block_ids)
         self.block_table[row, :nb] = data.block_ids
@@ -116,6 +124,7 @@ class InputBatch:
                 tokens = data.new_token_ids[i]
                 self.token_ids[row, :len(tokens)] = tokens
                 self.num_tokens[row] = len(tokens)
+                self.row_version[row] += 1
                 nb = len(data.new_block_ids[i])
                 self.block_table[row, :nb] = data.new_block_ids[i]
                 self.block_table[row, nb:] = 0

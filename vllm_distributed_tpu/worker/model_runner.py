@@ -15,6 +15,7 @@ the TPU variant tpu_model_runner.py:98 (bucketed precompilation
   same runner code is TP=1 and TP=N (GSPMD inserts the collectives).
 """
 
+import functools
 import os
 import time
 from contextlib import contextmanager
@@ -43,6 +44,25 @@ logger = init_logger(__name__)
 
 class TPUModelRunner:
 
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _hist_apply_full(dev, rows, vals):
+        """Overwrite whole history rows (admission/resume); padding rows
+        carry an out-of-range index and drop."""
+        return dev.at[rows].set(vals, mode="drop")
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _hist_apply_delta(dev, d_rows, d_start, d_toks, d_len):
+        """Append newly committed tokens per row (width = the
+        runner's _hist_delta)."""
+        D = d_toks.shape[1]
+        pos = d_start[:, None] + jnp.arange(D, dtype=jnp.int32)[None, :]
+        valid = jnp.arange(D, dtype=jnp.int32)[None, :] < d_len[:, None]
+        rowm = jnp.broadcast_to(d_rows[:, None], pos.shape)
+        pos = jnp.where(valid, pos, dev.shape[1])
+        return dev.at[rowm, pos].set(d_toks, mode="drop")
+
     def __init__(self, config: EngineConfig, mesh,
                  model=None, params=None) -> None:
         self.config = config
@@ -56,6 +76,10 @@ class TPUModelRunner:
         self.model = model
         self.params = params
         self.kv_caches: Optional[dict] = None
+        # Device-resident sampling-history mirror (see _hist_rows_device).
+        self._hist_dev: Optional[jax.Array] = None
+        self._hist_len = np.zeros((self.max_num_reqs, ), np.int32)
+        self._hist_ver = np.full((self.max_num_reqs, ), -1, np.int64)
         # Token parallelism: requests' pages live on one token-axis rank;
         # per-rank metadata is built each step (reference:
         # gpu_model_runner.py:334 _build_token_parallel_metadata).
@@ -102,6 +126,10 @@ class TPUModelRunner:
             self.proposer = NgramProposer(spec)
         else:
             self.proposer = None
+        # Max per-step append a history row can absorb without a full
+        # re-upload: a step commits up to spec_k + 1 tokens per row
+        # (accepted drafts + the target sample).
+        self._hist_delta = max(8, self.spec_k + 1)
         # KV-write runs: worst case one partial page per request plus the
         # full pages the step writes. Padded as a deterministic function of
         # T (see _batch_shape) so it adds no lattice dimension.
@@ -194,6 +222,10 @@ class TPUModelRunner:
                 lambda x: np.asarray(jax.device_get(x)), self.params)
         else:
             self._host_params = None
+        if self._hist_dev is not None:
+            self._hist_dev.delete()
+            self._hist_dev = None
+            self._hist_ver[:] = -1
         for leaf in jax.tree_util.tree_leaves(self.params):
             leaf.delete()
         for leaf in jax.tree_util.tree_leaves(self.kv_caches):
@@ -591,6 +623,62 @@ class TPUModelRunner:
     from vllm_distributed_tpu.sampling_params import \
         BIAS_BUF_WIDTH as _BIAS_BUF
 
+    def _hist_rows_device(self, rows: np.ndarray, expand) -> jax.Array:
+        """[R(*S1), max_model_len] token history for the penalty kernels,
+        gathered from a DEVICE-RESIDENT mirror of the input batch's
+        token table. Per-step host->device traffic is O(R * _hist_delta)
+        (the newly committed tokens), independent of max_model_len —
+        round-2/3 ADVICE flagged the previous full [R, max_model_len]
+        upload every penalty step. Rows re-upload in full only when
+        their content was rewritten (admission, preemption resume) or
+        drifted more than _hist_delta tokens while off the extended
+        path."""
+        ib = self.input_batch
+        L = self.max_model_len
+        max_reqs = ib.token_ids.shape[0]
+        if self._hist_dev is None:
+            self._hist_dev = jnp.zeros((max_reqs, L), jnp.int32)
+        D = self._hist_delta
+        R = len(rows)
+        uniq = np.unique(rows)
+        full_rows: list[int] = []
+        d_rows = np.full((R, ), max_reqs, np.int32)  # pad -> dropped
+        d_start = np.zeros((R, ), np.int32)
+        d_toks = np.zeros((R, D), np.int32)
+        d_len = np.zeros((R, ), np.int32)
+        nd = 0
+        for r in uniq:
+            r = int(r)
+            n = int(ib.num_tokens[r])
+            behind = n - int(self._hist_len[r])
+            if (self._hist_ver[r] != ib.row_version[r]
+                    or not 0 <= behind <= D):
+                full_rows.append(r)
+                self._hist_ver[r] = ib.row_version[r]
+            elif behind:
+                s = n - behind
+                d_rows[nd] = r
+                d_start[nd] = s
+                d_toks[nd, :behind] = ib.token_ids[r, s:n]
+                d_len[nd] = behind
+                nd += 1
+            self._hist_len[r] = n
+        if full_rows:
+            fr = np.full((R, ), max_reqs, np.int32)
+            fr[:len(full_rows)] = full_rows
+            vals = np.zeros((R, L), np.int32)
+            vals[:len(full_rows)] = ib.token_ids[full_rows]
+            self._hist_dev = self._hist_apply_full(self._hist_dev,
+                                              jnp.asarray(fr),
+                                              jnp.asarray(vals))
+        if nd:
+            self._hist_dev = self._hist_apply_delta(
+                self._hist_dev, jnp.asarray(d_rows),
+                jnp.asarray(d_start), jnp.asarray(d_toks),
+                jnp.asarray(d_len))
+        rows_pad = np.asarray(expand(rows), np.int32)
+        return self._hist_dev[jnp.asarray(rows_pad)]
+
     def _build_extended_md(self, rows: np.ndarray,
                            expand) -> ExtendedSamplingMetadata:
         """Lower per-row python sampling extras to the fixed-shape
@@ -624,7 +712,7 @@ class TPUModelRunner:
                 bias_ids[i, j] = t
                 bias_vals[i, j] = v
         return ExtendedSamplingMetadata(
-            hist_tokens=jnp.asarray(expand(ib.token_ids[rows])),
+            hist_tokens=self._hist_rows_device(rows, expand),
             prompt_len=jnp.asarray(expand(ib.prompt_len[rows])),
             total_len=jnp.asarray(expand(ib.num_tokens[rows])),
             presence_penalty=jnp.asarray(expand(ib.presence_penalty[rows])),
@@ -810,7 +898,11 @@ class TPUModelRunner:
         if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
                 or self.config.parallel_config.pipeline_parallel_size > 1
                 or getattr(self.model.cfg, "sliding_window", None)
-                or resolve_attention_backend() == "pallas"):
+                or not hasattr(self.model, "kv_cache_specs")
+                or "k" not in self.model.kv_cache_specs()):
+            # Cascade rides the standard K/V page layout (MLA's latent
+            # cache has its own attention path); both backends (XLA scan
+            # and the Pallas kernel via its emit_state merge) support it.
             return None
         S = envs.VDT_CASCADE_SHARED_PAGES
         rows = [self.input_batch.req_id_to_index[r]
@@ -818,7 +910,9 @@ class TPUModelRunner:
         if len(rows) < 2:
             return None
         ib = self.input_batch
-        if any(ib.num_blocks[r] < S for r in rows):
+        # Strictly more than S blocks: the suffix phase needs at least
+        # one per-request page past the shared prefix.
+        if any(ib.num_blocks[r] <= S for r in rows):
             return None
         first = ib.block_table[rows[0], :S]
         for r in rows[1:]:
