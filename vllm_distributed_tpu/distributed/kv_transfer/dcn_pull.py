@@ -137,9 +137,14 @@ class _ServeJob:
 class _FinishedPull:
     req_id: str
     page_ids: list[int]
-    k: Optional[np.ndarray]  # [L, n_pages, KVH_ckpt, PS, D]; None on error
-    v: Optional[np.ndarray]
+    # Pulled pages, staged as DEVICE arrays by the transfer thread when
+    # possible (host numpy fallback): [L, n_pages, KVH_cache, PS, D].
+    k: Optional[object]  # jax.Array | np.ndarray; None on error
+    v: Optional[object]
     error: Optional[str] = None
+    staged_on_device: bool = False
+    # Chunked-apply progress (pages [0, applied) already scattered).
+    applied: int = 0
 
 
 class DCNPullConnector(KVConnectorBase):
@@ -170,6 +175,10 @@ class DCNPullConnector(KVConnectorBase):
             self._serve_queue: "queue.Queue[_ServeJob]" = queue.Queue()
             self._done_notifications: "queue.Queue[str]" = queue.Queue()
             self._finished_pulls: "queue.Queue[_FinishedPull]" = queue.Queue()
+            # Pulls mid-way through the chunked apply (see get_finished).
+            self._applying: list[_FinishedPull] = []
+            # Stats: pages applied on the largest single step (tests).
+            self.max_pages_applied_per_step = 0
             # Producer: currently-serveable deferred pages.
             self._registrations: dict[str, _SendRegistration] = {}
             # Producer pages staged for serving: remote_req_id -> page ids
@@ -382,13 +391,15 @@ class DCNPullConnector(KVConnectorBase):
         for reg in metadata.register:
             self._registrations[reg.req_id] = reg
         for pull in metadata.pulls:
-            threading.Thread(target=self._pull_worker, args=(pull, ),
+            threading.Thread(target=self._pull_worker,
+                             args=(pull, runner),
                              name=f"dcn-pull-{pull.req_id}",
                              daemon=True).start()
 
-    def _pull_worker(self, pull: _PullInstruction) -> None:
+    def _pull_worker(self, pull: _PullInstruction, runner) -> None:
         """Background thread: socket IO only. Fetch the remote pages,
         queue them for main-thread application, notify the producer."""
+        delivered = False
         try:
             with socket.create_connection((pull.host, pull.port),
                                           timeout=120.0) as sock:
@@ -408,14 +419,37 @@ class DCNPullConnector(KVConnectorBase):
                     raise RuntimeError(
                         f"producer served {k.shape[1]} pages, "
                         f"consumer allocated {n}")
+                # Stage host->device ON THIS THREAD: the PCIe copy
+                # overlaps the main thread's compute, and the main
+                # thread's apply is then just the donated scatter.
+                try:
+                    k_s, v_s = page_io.stage_pages(runner, k[:, :n],
+                                                   v[:, :n])
+                    staged = True
+                except Exception:  # noqa: BLE001 - host fallback
+                    k_s, v_s = page_io.stage_pages(runner, k[:, :n],
+                                                   v[:, :n],
+                                                   on_device=False)
+                    staged = False
                 self._finished_pulls.put(
                     _FinishedPull(req_id=pull.req_id,
                                   page_ids=pull.local_page_ids,
-                                  k=k[:, :n], v=v[:, :n]))
+                                  k=k_s, v=v_s,
+                                  staged_on_device=staged))
+                delivered = True
                 _send_msg(sock, {"op": "done",
                                  "req_id": pull.remote_req_id})
                 _recv_msg(sock)  # ack
         except Exception as e:  # noqa: BLE001 - surfaced via error pull
+            if delivered:
+                # The pages landed; only the producer's DONE handshake
+                # failed (it expires the registration on its own). A
+                # second, errored report for the same request would
+                # double-handle it (resume AND local recompute).
+                logger.warning(
+                    "KV pull for %s: done-notification failed after a "
+                    "successful transfer: %s", pull.req_id, e)
+                return
             logger.error("KV pull for %s failed: %s", pull.req_id, e)
             self._finished_pulls.put(
                 _FinishedPull(req_id=pull.req_id,
@@ -461,22 +495,52 @@ class DCNPullConnector(KVConnectorBase):
                     del self._registrations[req_id]
                     finished_sending.add(req_id)
 
-        # Consumer: apply finished pulls to the paged cache. Errored
-        # pulls go back as FAILED so the scheduler recomputes the span
-        # locally instead of reading never-written pages.
+        # Consumer: apply finished pulls to the paged cache in bounded
+        # page CHUNKS via the donated in-place scatter — a large pull
+        # spreads over several steps instead of stalling one (the pages
+        # were already staged on device by the transfer thread, so each
+        # chunk is HBM work only; reference: the layerwise
+        # wait_for_layer_load overlap contract of kv_connector/v1/base.py
+        # + nixl_connector.py async completion). Errored pulls go back
+        # as FAILED so the scheduler recomputes the span locally instead
+        # of reading never-written pages.
+        from vllm_distributed_tpu import envs
+        chunk = envs.VDT_KV_APPLY_CHUNK_PAGES
         while True:
             try:
-                done = self._finished_pulls.get_nowait()
+                self._applying.append(self._finished_pulls.get_nowait())
             except queue.Empty:
                 break
-            if done.error is None:
-                self._apply_pull(done, runner)
-                finished_recving.add(done.req_id)
-            else:
+        budget = chunk
+        pages_this_step = 0
+        still_applying: list[_FinishedPull] = []
+        for done in self._applying:
+            if done.error is not None:
                 logger.error(
                     "request %s: external KV unavailable (%s); span will "
                     "be recomputed locally", done.req_id, done.error)
                 failed_recving.add(done.req_id)
+                continue
+            n = len(done.page_ids)
+            while done.applied < n:
+                take = min(chunk, n - done.applied)
+                if take > budget:
+                    break  # resume next step
+                page_io.scatter_pages_chunk(runner, done.page_ids,
+                                            done.k, done.v,
+                                            done.applied, chunk)
+                done.applied += take
+                budget -= take
+                pages_this_step += take
+            if done.applied >= n:
+                finished_recving.add(done.req_id)
+                logger.info("applied %d pulled KV pages for %s",
+                            n, done.req_id)
+            else:
+                still_applying.append(done)
+        self._applying = still_applying
+        self.max_pages_applied_per_step = max(
+            self.max_pages_applied_per_step, pages_this_step)
         return finished_sending, finished_recving, failed_recving
 
     def _read_pages(self, job: _ServeJob, runner) -> dict:
@@ -503,11 +567,6 @@ class DCNPullConnector(KVConnectorBase):
             "v_shape": list(v.shape),
             "dtype": str(k.dtype),
         }
-
-    def _apply_pull(self, done: _FinishedPull, runner) -> None:
-        page_io.scatter_pages(runner, done.page_ids, done.k, done.v)
-        logger.info("applied %d pulled KV pages for %s",
-                    len(done.page_ids), done.req_id)
 
     def shutdown(self) -> None:
         self._shutdown.set()

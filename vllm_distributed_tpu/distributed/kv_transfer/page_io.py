@@ -41,3 +41,64 @@ def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
         "k": k_all.at[:, pages].set(k.astype(k_all.dtype)),
         "v": v_all.at[:, pages].set(v.astype(v_all.dtype)),
     }
+
+
+_scatter_donated_fn = None  # built lazily (module import stays jax-free)
+
+
+def _scatter_donated():
+    """In-place (donated) page write — no full-cache copy, unlike a bare
+    .at[].set on a live array. Padding slots carry an out-of-range page
+    id and drop."""
+    global _scatter_donated_fn
+    if _scatter_donated_fn is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def fn(k_all, v_all, pages, k, v):
+            return (k_all.at[:, pages].set(k.astype(k_all.dtype),
+                                           mode="drop"),
+                    v_all.at[:, pages].set(v.astype(v_all.dtype),
+                                           mode="drop"))
+
+        _scatter_donated_fn = fn
+    return _scatter_donated_fn
+
+
+def stage_pages(runner, k: np.ndarray, v: np.ndarray,
+                on_device: bool = True):
+    """Wire-layout pages -> CACHE layout (replication re-applied) — the
+    single home of that transform for the staging path. With
+    ``on_device`` the result is device arrays; safe from a transfer
+    thread (only dispatches an async host->device copy, overlapping
+    PCIe with the main thread's compute). ``on_device=False`` keeps
+    host numpy (fallback when a thread cannot touch the device)."""
+    r = _replication(runner)
+    if r > 1:
+        k = np.repeat(k, r, axis=2)
+        v = np.repeat(v, r, axis=2)
+    if not on_device:
+        return k, v
+    import jax.numpy as jnp
+    return jnp.asarray(k), jnp.asarray(v)
+
+
+def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
+                        chunk: int) -> None:
+    """Apply pages [lo, lo+chunk) of a staged pull via the donated
+    scatter; page id padding (for the fixed chunk shape) drops."""
+    import jax.numpy as jnp
+    n = len(page_ids)
+    num_pages = runner.kv_caches["k"].shape[1]
+    ids = np.full((chunk, ), num_pages, np.int32)
+    take = min(chunk, n - lo)
+    ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
+    k_all, v_all = runner.kv_caches["k"], runner.kv_caches["v"]
+    pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
+    k_c = jnp.pad(k_dev[:, lo:lo + take], pad)
+    v_c = jnp.pad(v_dev[:, lo:lo + take], pad)
+    k_new, v_new = _scatter_donated()(k_all, v_all, jnp.asarray(ids),
+                                      k_c, v_c)
+    runner.kv_caches = {"k": k_new, "v": v_new}

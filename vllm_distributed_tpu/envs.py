@@ -31,6 +31,12 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # "replicate" forces the replicate+psum path.
     "VDT_MOE_EP_MODE":
     lambda: os.getenv("VDT_MOE_EP_MODE", "a2a"),
+    # Max KV pages a finished pull applies to the cache per engine step
+    # (the donated scatter runs on the scheduling thread; chunking keeps
+    # any single step's apply bounded so co-resident decode latency
+    # doesn't spike while a large pull lands).
+    "VDT_KV_APPLY_CHUNK_PAGES":
+    lambda: max(1, int(os.getenv("VDT_KV_APPLY_CHUNK_PAGES", "64"))),
     # JAX platform to pin before backend init ("auto" = JAX default).
     # Setting "cpu" defeats a TPU plugin whose init can hang for minutes
     # on hosts where the chip is tunnelled (reference analogue: the

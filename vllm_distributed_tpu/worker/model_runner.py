@@ -147,6 +147,9 @@ class TPUModelRunner:
         self.spec_num_accepted_tokens = 0
         # Steps that took the cascade (shared-prefix) attention path.
         self.cascade_steps = 0
+        # Memoized "model uses the standard K/V page layout" (see
+        # _detect_cascade); None until the model is loaded.
+        self._cascade_layout_ok: Optional[bool] = None
         # Shapes warmed by precompile(); execute-time compiles outside this
         # set are recompile-guard violations (reference:
         # tpu_model_runner.py:318 _update_num_xla_graphs).
@@ -895,14 +898,15 @@ class TPUModelRunner:
         hold identical page ids (prefix-cache hits make them literally
         the same pages). Opt-in via VDT_CASCADE_ATTENTION."""
         from vllm_distributed_tpu import envs
-        if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
-                or self.config.parallel_config.pipeline_parallel_size > 1
-                or getattr(self.model.cfg, "sliding_window", None)
-                or not hasattr(self.model, "kv_cache_specs")
-                or "k" not in self.model.kv_cache_specs()):
+        if self._cascade_layout_ok is None:
             # Cascade rides the standard K/V page layout (MLA's latent
             # cache has its own attention path); both backends (XLA scan
             # and the Pallas kernel via its emit_state merge) support it.
+            self._cascade_layout_ok = "k" in self.model.kv_cache_specs()
+        if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
+                or self.config.parallel_config.pipeline_parallel_size > 1
+                or getattr(self.model.cfg, "sliding_window", None)
+                or not self._cascade_layout_ok):
             return None
         S = envs.VDT_CASCADE_SHARED_PAGES
         rows = [self.input_batch.req_id_to_index[r]
