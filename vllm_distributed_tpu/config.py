@@ -329,11 +329,20 @@ class LoadConfig:
 class SpeculativeConfig:
     """Speculative decoding (reference: vllm/config.py:2502)."""
 
-    method: Optional[str] = None  # ngram | None
+    method: Optional[str] = None  # ngram | draft_model | None
     num_speculative_tokens: int = 0
     # ngram proposer window (reference: v1/spec_decode/ngram_proposer.py).
     prompt_lookup_max: int = 4
     prompt_lookup_min: int = 1
+    # draft_model proposer (reference: the draft-model speculative path,
+    # vllm/v1/spec_decode/eagle.py + config.py SpeculativeConfig.model):
+    # local checkpoint of a small causal LM proposing k greedy tokens,
+    # verified in-step by the existing S+1-position sampler.
+    model: Optional[str] = None
+    # Context window the draft sees (stateless re-prefill of the last
+    # W tokens each proposal — no second paged cache to manage; RoPE
+    # scores depend on relative distance so the window offset is sound).
+    draft_window: int = 32
 
 
 @dataclass

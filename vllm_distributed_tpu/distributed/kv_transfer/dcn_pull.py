@@ -142,7 +142,6 @@ class _FinishedPull:
     k: Optional[object]  # jax.Array | np.ndarray; None on error
     v: Optional[object]
     error: Optional[str] = None
-    staged_on_device: bool = False
     # Chunked-apply progress (pages [0, applied) already scattered).
     applied: int = 0
 
@@ -425,17 +424,17 @@ class DCNPullConnector(KVConnectorBase):
                 try:
                     k_s, v_s = page_io.stage_pages(runner, k[:, :n],
                                                    v[:, :n])
-                    staged = True
-                except Exception:  # noqa: BLE001 - host fallback
+                except Exception as stage_err:  # noqa: BLE001
+                    logger.warning(
+                        "KV pull for %s: device staging failed (%s); "
+                        "host fallback", pull.req_id, stage_err)
                     k_s, v_s = page_io.stage_pages(runner, k[:, :n],
                                                    v[:, :n],
                                                    on_device=False)
-                    staged = False
                 self._finished_pulls.put(
                     _FinishedPull(req_id=pull.req_id,
                                   page_ids=pull.local_page_ids,
-                                  k=k_s, v=v_s,
-                                  staged_on_device=staged))
+                                  k=k_s, v=v_s))
                 delivered = True
                 _send_msg(sock, {"op": "done",
                                  "req_id": pull.remote_req_id})

@@ -31,10 +31,7 @@ def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
     ``runner.kv_caches`` in place (new arrays; the old buffers are
     donated away by the next jitted step)."""
     pages = np.asarray(page_ids, np.int32)
-    r = _replication(runner)
-    if r > 1:
-        k = np.repeat(k, r, axis=2)
-        v = np.repeat(v, r, axis=2)
+    k, v = stage_pages(runner, k, v, on_device=False)
     k_all = runner.kv_caches["k"]
     v_all = runner.kv_caches["v"]
     runner.kv_caches = {

@@ -66,6 +66,8 @@ class EngineArgs:
 
     speculative_method: Optional[str] = None
     num_speculative_tokens: int = 0
+    speculative_model: Optional[str] = None
+    speculative_draft_window: int = 32
 
     kv_connector: Optional[str] = None
     kv_role: Optional[str] = None
@@ -129,6 +131,8 @@ class EngineArgs:
             speculative_config=SpeculativeConfig(
                 method=self.speculative_method,
                 num_speculative_tokens=self.num_speculative_tokens,
+                model=self.speculative_model,
+                draft_window=self.speculative_draft_window,
             ),
             kv_transfer_config=KVTransferConfig(
                 kv_connector=self.kv_connector,

@@ -119,13 +119,18 @@ class TPUModelRunner:
         # position IS the target sample) and zero extra device code.
         spec = config.speculative_config
         self.spec_k = (spec.num_speculative_tokens
-                       if spec and spec.method == "ngram" else 0)
-        if self.spec_k:
+                       if spec and spec.method in ("ngram",
+                                                   "draft_model") else 0)
+        self.proposer = None
+        self._draft_spec = None
+        if self.spec_k and spec.method == "ngram":
             from vllm_distributed_tpu.spec_decode.ngram_proposer import \
                 NgramProposer
             self.proposer = NgramProposer(spec)
-        else:
-            self.proposer = None
+        elif self.spec_k:
+            # Draft model loads with the target model (load_model knows
+            # the dtype); until then proposals are empty.
+            self._draft_spec = spec
         # Max per-step append a history row can absorb without a full
         # re-upload: a step commits up to spec_k + 1 tokens per row
         # (accepted drafts + the target sample).
@@ -162,6 +167,12 @@ class TPUModelRunner:
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
         self._init_lora_manager()
+        if self._draft_spec is not None:
+            from vllm_distributed_tpu.spec_decode.draft_model import \
+                DraftModelProposer
+            self.proposer = DraftModelProposer(
+                self._draft_spec, self.model.cfg.dtype,
+                max_num_reqs=self.max_num_reqs)
 
     def _init_lora_manager(self) -> None:
         if self.config.lora_config.enable_lora:
@@ -847,7 +858,6 @@ class TPUModelRunner:
                     req_ids.append(req_id)
                     sampled.append([])
                     lps.append([])
-                    spec_out.append([])
                     continue
                 emitted = [int(t) for t in toks[i, :num_emitted[i]]]
                 for tok in emitted:
@@ -859,7 +869,12 @@ class TPUModelRunner:
                                   lp2[i, p], topk_np)
                     for p, tok in enumerate(emitted)
                 ])
-                spec_out.append(self._propose_drafts(req_id))
+            # Next-step drafts AFTER every row committed its tokens —
+            # one batched call for draft-model proposers.
+            draft_map = self._propose_drafts_all(
+                [r for r in sampling_req_ids if r not in pooled])
+            spec_out.extend(draft_map.get(r, []) if r not in pooled
+                            else [] for r in sampling_req_ids)
         else:
             # Record sampled tokens so next step's inputs include them.
             for i, req_id in enumerate(sampling_req_ids):
@@ -1011,20 +1026,40 @@ class TPUModelRunner:
                 d.setdefault(int(t), float(v))
         return d
 
-    def _propose_drafts(self, req_id: str) -> list[int]:
-        """Ngram drafts for the next step from the request's full token
-        history (reference: gpu_model_runner.py:1925 propose_draft_
-        token_ids). Requests on the extended sampling path get no drafts:
-        penalties change the target distribution position-by-position, so
-        draft verification there would be biased."""
+    def _draft_eligible(self, req_id: str) -> Optional[np.ndarray]:
+        """The request's committed token history, or None when it must
+        not receive drafts. Extended-sampling rows get none: penalties
+        change the target distribution position-by-position, so draft
+        verification there would be biased."""
         ib = self.input_batch
         row = ib.req_id_to_index[req_id]
         if ib.extended_active(row):
-            return []
+            return None
         n = int(ib.num_tokens[row])
         if n >= self.max_model_len:
-            return []
-        return self.proposer.propose(ib.token_ids[row, :n])
+            return None
+        return ib.token_ids[row, :n]
+
+    def _propose_drafts_all(self,
+                            req_ids: list[str]) -> dict[str, list[int]]:
+        """Next-step drafts for every eligible request (reference:
+        gpu_model_runner.py:1925 propose_draft_token_ids). Ngram runs
+        per-request on the host; the draft model proposes the whole
+        batch in one jitted call."""
+        if self.proposer is None:
+            return {}
+        eligible: list[tuple[str, np.ndarray]] = []
+        for req_id in req_ids:
+            hist = self._draft_eligible(req_id)
+            if hist is not None:
+                eligible.append((req_id, hist))
+        if not eligible:
+            return {}
+        if hasattr(self.proposer, "propose_batch"):
+            drafts = self.proposer.propose_batch(
+                [h for _, h in eligible])
+            return {rid: d for (rid, _), d in zip(eligible, drafts)}
+        return {rid: self.proposer.propose(h) for rid, h in eligible}
 
     # ------------------------------------------------------------------
     def _execute_multi_step(
@@ -1218,6 +1253,9 @@ class TPUModelRunner:
                 for R in self.req_buckets:
                     self._precompile_multi_step(n_steps, R)
                     n += 1
+            if self.proposer is not None and hasattr(
+                    self.proposer, "precompile"):
+                n += self.proposer.precompile()
         self._precompiled = True
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
