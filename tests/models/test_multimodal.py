@@ -1,0 +1,224 @@
+"""Multimodal minimum slice: llava-style image+text generation with
+pre-computed projector embeddings, HF parity, encoder-cache budgeting,
+and prefix-cache safety (reference: vllm/multimodal/ +
+v1/core/encoder_cache_manager.py)."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import (CLIPVisionConfig, LlamaConfig, LlavaConfig,
+                          LlavaForConditionalGeneration)
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+IMG = 99  # image_token_index
+
+
+@pytest.fixture(scope="module")
+def llava_checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlavaConfig(
+        text_config=LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128),
+        vision_config=CLIPVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=2, image_size=16, patch_size=8,
+            projection_dim=32),
+        image_token_index=IMG)
+    hf = LlavaForConditionalGeneration(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llava")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=128,
+                max_num_batched_tokens=128, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def run(engine, jobs, tag, max_tokens=6):
+    """jobs: list of (prompt_ids, mm_dict_or_None)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, (p, mm) in enumerate(jobs):
+        engine.add_request(f"{tag}-{i}", p, sp, multi_modal_data=mm)
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+    return [done[k].outputs[0].token_ids for k in order]
+
+
+def _features(hf, pixel) -> np.ndarray:
+    with torch.no_grad():
+        (feats, ) = hf.get_image_features(pixel)  # [n_tokens, H]
+    return feats.numpy()
+
+
+def test_llava_image_prompt_matches_hf(llava_checkpoint):
+    """LLM-level e2e: prompt with ONE placeholder + projector embeddings
+    must match HF llava generate with pixel_values exactly."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(1)
+    pixel = torch.randn(1, 3, 16, 16)
+    feats = _features(hf, pixel)
+    n_img = feats.shape[0]
+
+    prompt = [3, 17, IMG, 45, 8]
+    # HF wants the placeholder pre-expanded to n_img tokens.
+    hf_ids = [3, 17] + [IMG] * n_img + [45, 8]
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=torch.tensor([hf_ids]), pixel_values=pixel,
+            max_new_tokens=6, do_sample=False)
+    want = hf_out[0].tolist()[len(hf_ids):]
+
+    engine = make_engine(path)
+    (got, ) = run(engine, [(prompt, {"image_embeds": feats})], "mm")
+    assert got == want
+
+
+def test_text_only_requests_still_work(llava_checkpoint):
+    path, hf = llava_checkpoint
+    prompt = [3, 17, 45, 8, 21]
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor([prompt]),
+                             max_new_tokens=6, do_sample=False)
+    want = hf_out[0].tolist()[len(prompt):]
+    (got, ) = run(make_engine(path), [(prompt, None)], "txt")
+    assert got == want
+
+
+def test_mixed_batch_and_two_images(llava_checkpoint):
+    """Text and image requests in one batch; a prompt with two images."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(2)
+    pix = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        f1, f2 = (f.numpy() for f in hf.get_image_features(pix))
+    n = f1.shape[0]
+    p2 = [5, IMG, 9, IMG, 11]
+    hf_ids = [5] + [IMG] * n + [9] + [IMG] * n + [11]
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor([hf_ids]),
+                             pixel_values=pix, max_new_tokens=5,
+                             do_sample=False)
+    want2 = hf_out[0].tolist()[len(hf_ids):]
+
+    engine = make_engine(path)
+    got = run(engine, [
+        ([3, 17, 45], None),
+        (p2, {"image_embeds": [f1, f2]}),
+    ], "mix", max_tokens=5)
+    assert got[1] == want2
+
+
+def test_different_images_never_share_prefix_cache(llava_checkpoint):
+    """Identical expanded token ids with DIFFERENT images must not hit
+    each other's prefix-cache pages (the mm content hash salts the
+    block-hash chain)."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(3)
+    pix = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        f1, f2 = (f.numpy() for f in hf.get_image_features(pix))
+    prompt = [IMG, 45, 8]
+
+    engine = make_engine(path)
+    (a, ) = run(engine, [(prompt, {"image_embeds": f1})], "pc1")
+    (b, ) = run(engine, [(prompt, {"image_embeds": f2})], "pc2")
+    # Fresh engine, no cache: ground truth per image.
+    (a0, ) = run(make_engine(path), [(prompt, {"image_embeds": f1})],
+                 "pc3")
+    (b0, ) = run(make_engine(path), [(prompt, {"image_embeds": f2})],
+                 "pc4")
+    assert a == a0
+    assert b == b0
+
+
+def test_encoder_budget_queues_image_requests(llava_checkpoint):
+    """Requests past the encoder-token budget wait instead of
+    overcommitting; they complete once earlier image requests free."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(4)
+    pixel = torch.randn(1, 3, 16, 16)
+    feats = _features(hf, pixel)
+    n = feats.shape[0]
+    engine = make_engine(path, encoder_cache_budget=n)  # one image max
+    sched = engine.engine_core.engine_core.scheduler
+    jobs = [([3, IMG, 45 + i], {"image_embeds": feats})
+            for i in range(3)]
+    got = run(engine, jobs, "bud")
+    assert len(got) == 3
+    assert sched.encoder_cache.used == 0  # all freed
+
+
+def test_oversized_image_request_rejected(llava_checkpoint):
+    """A request that could never fit the encoder budget is a client
+    error at admission, not a silent queue-head deadlock."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(5)
+    feats = _features(hf, torch.randn(1, 3, 16, 16))
+    engine = make_engine(path, encoder_cache_budget=1)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    with pytest.raises(ValueError, match="encoder_cache_budget"):
+        engine.add_request("big-0", [3, IMG, 45], sp,
+                           multi_modal_data={"image_embeds": feats})
+
+
+def test_mm_request_survives_zmq_serialization():
+    """The msgpack boundary (multiprocess engine core) round-trips the
+    embedding payloads bit-exactly."""
+    from vllm_distributed_tpu.engine.serial import (decode_request,
+                                                    encode_request, pack,
+                                                    unpack)
+    from vllm_distributed_tpu.multimodal import MultiModalInput
+    from vllm_distributed_tpu.request import EngineCoreRequest
+    emb = np.random.default_rng(0).standard_normal((4, 8)).astype(
+        np.float32)
+    req = EngineCoreRequest(
+        request_id="mm-1", prompt_token_ids=[1, IMG, IMG, IMG, IMG, 2],
+        sampling_params=SamplingParams(max_tokens=4),
+        mm_inputs=[MultiModalInput(embeds=emb, offset=1)])
+    back = decode_request(unpack(pack(encode_request(req))))
+    assert back.mm_inputs is not None and len(back.mm_inputs) == 1
+    assert back.mm_inputs[0].offset == 1
+    np.testing.assert_array_equal(back.mm_inputs[0].embeds, emb)
+
+
+def test_subblock_mm_prompt_does_not_poison_prefix_cache(llava_checkpoint):
+    """An expanded mm prompt SHORTER than one block starts with an empty
+    hash list; the chain restarted during decode must still carry the
+    image salt (code-review r4 finding) — different images with
+    identical token ids must never share pages."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(6)
+    pix = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        f1, f2 = (f.numpy() for f in hf.get_image_features(pix))
+    prompt = [IMG, 45]  # expands to 6 tokens < block_size 8
+
+    engine = make_engine(path, block_size=8)
+    (a, ) = run(engine, [(prompt, {"image_embeds": f1})], "sb1",
+                max_tokens=10)
+    (b, ) = run(engine, [(prompt, {"image_embeds": f2})], "sb2",
+                max_tokens=10)
+    (a0, ) = run(make_engine(path, block_size=8),
+                 [(prompt, {"image_embeds": f1})], "sb3", max_tokens=10)
+    (b0, ) = run(make_engine(path, block_size=8),
+                 [(prompt, {"image_embeds": f2})], "sb4", max_tokens=10)
+    assert a == a0
+    assert b == b0

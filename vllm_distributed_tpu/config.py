@@ -290,6 +290,11 @@ class SchedulerConfig:
     # scheduling / --num-scheduler-steps; on TPU the burst is one jitted
     # lax.scan, see worker/model_runner.py). 1 disables.
     num_scheduler_steps: int = 1
+    # Total encoder (vision) output tokens admitted concurrently
+    # (reference: encoder_cache_size / max_num_encoder_input_tokens,
+    # v1/core/encoder_cache_manager.py); image requests past the budget
+    # wait.
+    encoder_cache_budget: int = 8192
 
     def __post_init__(self) -> None:
         if self.policy not in ("fcfs", "priority"):

@@ -1,0 +1,46 @@
+"""Budgeted cache of encoder (vision) outputs.
+
+Reference: vllm/v1/core/encoder_cache_manager.py:254 — the scheduler
+admits a multimodal request's encoder inputs only while their token
+count fits the encoder-cache budget; entries free when the request no
+longer needs them. Here the cached payloads (pre-computed embedding
+rows) live worker-side per request; this manager owns the BUDGET
+accounting on the scheduler side, so a flood of image-heavy requests
+queues instead of overcommitting worker host memory.
+
+Allocation lifetime: a request's inputs allocate at admission and free
+when the request finishes or is preempted-and-freed (a preempted
+request re-prefills, so its embeddings must survive preemption — they
+re-allocate with the request's re-admission)."""
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class EncoderCacheManager:
+
+    def __init__(self, budget_tokens: int) -> None:
+        self.budget = budget_tokens
+        self._allocated: dict[str, int] = {}  # req_id -> encoder tokens
+
+    @property
+    def used(self) -> int:
+        return sum(self._allocated.values())
+
+    def has(self, req_id: str) -> bool:
+        return req_id in self._allocated
+
+    def can_allocate(self, req_id: str, num_tokens: int) -> bool:
+        if req_id in self._allocated:
+            return True
+        return self.used + num_tokens <= self.budget
+
+    def allocate(self, req_id: str, num_tokens: int) -> None:
+        if req_id in self._allocated:
+            return
+        assert self.used + num_tokens <= self.budget
+        self._allocated[req_id] = num_tokens
+
+    def free(self, req_id: str) -> None:
+        self._allocated.pop(req_id, None)

@@ -193,7 +193,10 @@ class KVCacheManager:
         # Extend hashes to cover any newly-complete full pages.
         num_full_after = min(num_computed_tokens + num_new_tokens,
                              request.num_tokens) // self.block_size
-        parent = (block_hashes[-1].hash_value if block_hashes else None)
+        from vllm_distributed_tpu.core.kv_cache_utils import \
+            request_hash_seed
+        parent = (block_hashes[-1].hash_value if block_hashes
+                  else request_hash_seed(request))
         while len(block_hashes) < num_full_after:
             start = len(block_hashes) * self.block_size
             chunk = tuple(request.all_token_ids[start:start +

@@ -46,12 +46,22 @@ def hash_block_tokens(
     return BlockHash(h.digest()[:16], token_ids)
 
 
+def request_hash_seed(request: Request) -> Optional[bytes]:
+    """Chain seed for a request's block hashes: multimodal requests
+    salt with the image content hash — same token ids + different
+    images must never collide (reference: the mm hash keys folded into
+    block hashing, v1/core/kv_cache_utils). EVERY place that (re)starts
+    a hash chain must seed from here, or an unsalted chain could hand
+    one user's image-conditioned KV to another."""
+    return getattr(request, "mm_hash", None)
+
+
 def hash_request_tokens(block_size: int,
                         request: Request) -> list[BlockHash]:
     """Hash all *full* blocks of the request's current tokens."""
     token_ids = request.all_token_ids
     hashes: list[BlockHash] = []
-    parent: Optional[bytes] = None
+    parent: Optional[bytes] = request_hash_seed(request)
     for start in range(0, len(token_ids) - block_size + 1, block_size):
         chunk = tuple(token_ids[start:start + block_size])
         bh = hash_block_tokens(parent, chunk)

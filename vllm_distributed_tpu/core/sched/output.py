@@ -25,6 +25,10 @@ class NewRequestData:
     lora_request: "dict | None" = None
     # Pooling/embedding request marker ({"type": "last"}).
     pooling_params: "dict | None" = None
+    # Positioned pre-computed image embeddings (multimodal/
+    # MultiModalInput list); the runner substitutes their rows at the
+    # placeholder positions during prefill.
+    mm_inputs: "list | None" = None
 
 
 @dataclass

@@ -141,6 +141,14 @@ class Scheduler:
             # of threading them through every hook.
             kv_connector.kv_manager = self.kv_cache_manager
 
+        # Encoder (vision) output budget (reference:
+        # v1/core/encoder_cache_manager.py); payloads live worker-side,
+        # the scheduler owns admission accounting.
+        from vllm_distributed_tpu.core.encoder_cache_manager import \
+            EncoderCacheManager
+        self.encoder_cache = EncoderCacheManager(
+            config.scheduler_config.encoder_cache_budget)
+
         self.requests: dict[str, Request] = {}
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
@@ -227,11 +235,19 @@ class Scheduler:
             request.status = status
             self._free_request(request)
 
+    def _commit_encoder_budget(self, request: Request) -> None:
+        if request.mm_inputs and not self.encoder_cache.has(
+                request.request_id):
+            self.encoder_cache.allocate(
+                request.request_id,
+                sum(m.num_tokens for m in request.mm_inputs))
+
     def _free_request(self, request: Request) -> Optional[dict]:
         """Tear a finished request down. Returns the connector's
         kv_transfer_params to hand back to the client (a producer's
         pull coordinates), or None."""
         assert request.is_finished
+        self.encoder_cache.free(request.request_id)
         params = None
         defer = False
         if self.kv_connector is not None:
@@ -428,6 +444,28 @@ class Scheduler:
                     self._free_request(request)
                     continue
 
+                if request.mm_inputs and not self.encoder_cache.has(
+                        request.request_id):
+                    n_enc = sum(m.num_tokens for m in request.mm_inputs)
+                    if n_enc > self.encoder_cache.budget:
+                        logger.warning(
+                            "Request %s needs %d encoder tokens; the "
+                            "budget is %d; ignoring.",
+                            request.request_id, n_enc,
+                            self.encoder_cache.budget)
+                        self.waiting.popleft()
+                        request.status = RequestStatus.FINISHED_IGNORED
+                        self._free_request(request)
+                        continue
+                    if not self.encoder_cache.can_allocate(
+                            request.request_id, n_enc):
+                        break  # encoder budget full; wait
+                    # NOTE: allocation is COMMITTED only at the popleft
+                    # points below — a later admission failure (e.g. no
+                    # KV pages) must not leave a still-waiting request
+                    # holding budget, or a higher-priority arrival could
+                    # deadlock the queue head against it.
+
                 if self.tknp_size > 1 and request.tknp_rank is None:
                     self._assign_tknp_rank(request)
 
@@ -465,6 +503,7 @@ class Scheduler:
                     if new_blocks is None:
                         break  # no room; retry next step
                     self.waiting.popleft()
+                    self._commit_encoder_budget(request)
                     request.status = RequestStatus.WAITING_FOR_REMOTE_KVS
                     request.num_computed_tokens = num_computed_tokens
                     request.num_external_computed_tokens = num_external
@@ -503,6 +542,7 @@ class Scheduler:
                     break
 
                 self.waiting.popleft()
+                self._commit_encoder_budget(request)
                 resumed = request.status == RequestStatus.PREEMPTED
                 request.status = RequestStatus.RUNNING
                 request.num_computed_tokens = num_computed_tokens
@@ -542,6 +582,7 @@ class Scheduler:
                             num_computed_tokens=num_computed_tokens,
                             lora_request=request.lora_request,
                             pooling_params=request.pooling_params,
+                            mm_inputs=request.mm_inputs,
                         ))
 
         self.num_scheduled_steps += 1

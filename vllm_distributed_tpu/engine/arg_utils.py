@@ -59,6 +59,7 @@ class EngineArgs:
     long_prefill_token_threshold: int = 0
     scheduling_policy: str = "fcfs"
     num_scheduler_steps: int = 1
+    encoder_cache_budget: int = 8192
 
     device: str = "auto"
     load_format: str = "auto"
@@ -123,6 +124,7 @@ class EngineArgs:
                 long_prefill_token_threshold,
                 policy=self.scheduling_policy,
                 num_scheduler_steps=self.num_scheduler_steps,
+                encoder_cache_budget=self.encoder_cache_budget,
             ),
             device_config=DeviceConfig(device=self.device),
             load_config=LoadConfig(

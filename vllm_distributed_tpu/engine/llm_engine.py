@@ -62,12 +62,14 @@ class LLMEngine:
         kv_transfer_params: Optional[dict] = None,
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
+        multi_modal_data: Optional[dict] = None,
     ) -> None:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
             kv_transfer_params=kv_transfer_params,
-            lora_request=lora_request, pooling_params=pooling_params)
+            lora_request=lora_request, pooling_params=pooling_params,
+            multi_modal_data=multi_modal_data)
         self.output_processor.add_request(
             core_req, prompt=prompt if isinstance(prompt, str) else None)
         self.engine_core.add_request(core_req)

@@ -72,6 +72,7 @@ class Processor:
         kv_transfer_params: Optional[dict] = None,
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
+        multi_modal_data: Optional[dict] = None,
     ) -> EngineCoreRequest:
         if isinstance(prompt, str):
             assert self.tokenizer is not None, \
@@ -81,6 +82,10 @@ class Processor:
             prompt_token_ids = list(prompt)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        mm_inputs = None
+        if multi_modal_data:
+            mm_inputs, prompt_token_ids = self._process_mm(
+                multi_modal_data, prompt_token_ids)
         if pooling_params is not None:
             if pooling_params.get("type", "last") != "last":
                 raise ValueError(
@@ -127,4 +132,50 @@ class Processor:
             kv_transfer_params=kv_transfer_params,
             lora_request=lora_request,
             pooling_params=pooling_params,
+            mm_inputs=mm_inputs,
         )
+
+    def _process_mm(self, multi_modal_data: dict,
+                    prompt_token_ids: list[int]):
+        """Validate image embeddings and expand prompt placeholders
+        (reference: the multimodal input processing of
+        v1/engine/processor.py + vllm/multimodal/processing.py; this
+        slice takes PRE-COMPUTED embeddings — projector outputs — and
+        leaves the in-engine vision tower as follow-up)."""
+        import numpy as np
+
+        from vllm_distributed_tpu.multimodal import \
+            expand_image_placeholders
+        unknown = set(multi_modal_data) - {"image_embeds"}
+        if unknown:
+            raise ValueError(
+                f"unsupported multi_modal_data keys {sorted(unknown)}; "
+                "this engine accepts pre-computed 'image_embeds'")
+        images = multi_modal_data["image_embeds"]
+        if isinstance(images, (list, tuple)):
+            images = [np.asarray(im) for im in images]
+        else:
+            images = [np.asarray(images)]
+        hf = self.config.model_config.maybe_load_hf_config()
+        image_token = getattr(hf, "image_token_index",
+                              getattr(hf, "image_token_id", None))
+        if image_token is None:
+            raise ValueError(
+                "model config has no image_token_index; this model "
+                "cannot take image inputs")
+        text_cfg = getattr(hf, "text_config", hf)
+        H = text_cfg.hidden_size
+        for im in images:
+            if im.ndim != 2 or im.shape[1] != H:
+                raise ValueError(
+                    f"image embeddings must be [n_tokens, {H}]; got "
+                    f"{im.shape}")
+        expanded, mm_inputs = expand_image_placeholders(
+            prompt_token_ids, int(image_token), images)
+        budget = self.config.scheduler_config.encoder_cache_budget
+        n_enc = sum(m.num_tokens for m in mm_inputs)
+        if n_enc > budget:
+            raise ValueError(
+                f"request needs {n_enc} encoder tokens; the engine's "
+                f"encoder_cache_budget is {budget}")
+        return mm_inputs, expanded

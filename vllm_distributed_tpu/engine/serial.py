@@ -11,8 +11,10 @@ from dataclasses import asdict
 from typing import Any
 
 import msgpack
+import numpy as np
 
 from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.multimodal import MultiModalInput
 from vllm_distributed_tpu.request import EngineCoreRequest
 from vllm_distributed_tpu.sampling_params import SamplingParams
 
@@ -40,6 +42,12 @@ def encode_request(req: EngineCoreRequest) -> dict:
         "kv_transfer_params": req.kv_transfer_params,
         "lora_request": req.lora_request,
         "pooling_params": req.pooling_params,
+        "mm_inputs": ([{
+            "embeds": np.ascontiguousarray(m.embeds).tobytes(),
+            "shape": list(m.embeds.shape),
+            "dtype": str(m.embeds.dtype),
+            "offset": m.offset,
+        } for m in req.mm_inputs] if req.mm_inputs else None),
     }
 
 
@@ -54,6 +62,13 @@ def decode_request(d: dict) -> EngineCoreRequest:
         kv_transfer_params=d["kv_transfer_params"],
         lora_request=d.get("lora_request"),
         pooling_params=d.get("pooling_params"),
+        mm_inputs=([
+            MultiModalInput(
+                embeds=np.frombuffer(m["embeds"],
+                                     dtype=m["dtype"]).reshape(
+                                         m["shape"]),
+                offset=m["offset"]) for m in d["mm_inputs"]
+        ] if d.get("mm_inputs") else None),
     )
 
 

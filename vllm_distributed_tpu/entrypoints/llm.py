@@ -38,6 +38,7 @@ class LLM:
         prompts: Union[PromptType, list[PromptType]],
         sampling_params: Optional[Union[SamplingParams,
                                         list[SamplingParams]]] = None,
+        multi_modal_data: Optional[Union[dict, list[Optional[dict]]]] = None,
     ) -> list[RequestOutput]:
         prompts = _listify_prompts(prompts)
         if sampling_params is None:
@@ -45,11 +46,16 @@ class LLM:
         if isinstance(sampling_params, SamplingParams):
             sampling_params = [sampling_params] * len(prompts)
         assert len(sampling_params) == len(prompts)
+        if multi_modal_data is None or isinstance(multi_modal_data, dict):
+            multi_modal_data = [multi_modal_data] * len(prompts)
+        assert len(multi_modal_data) == len(prompts)
 
         request_ids = []
-        for prompt, sp in zip(prompts, sampling_params):
+        for prompt, sp, mm in zip(prompts, sampling_params,
+                                  multi_modal_data):
             request_id = str(next(self.request_counter))
-            self.llm_engine.add_request(request_id, prompt, sp)
+            self.llm_engine.add_request(request_id, prompt, sp,
+                                        multi_modal_data=mm)
             request_ids.append(request_id)
         outputs = self._run_engine()
         # Return in submission order.

@@ -91,6 +91,12 @@ class AttentionBatch:
     # ([S] int32, static S; None disables — see
     # ops/attention.cascade_ragged_paged_attention).
     cascade_shared_ids: Optional[jax.Array] = None
+    # Multimodal: [T, H] embedding-override rows + [T] bool mask
+    # (placeholder positions take the image rows; None on text-only
+    # steps — a distinct pytree, so mm steps compile their own variant
+    # like every other static flag).
+    mm_embeds: Optional[jax.Array] = None
+    mm_mask: Optional[jax.Array] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
     # changing it recompiles, like every other shape bucket.
     max_q: int = 1

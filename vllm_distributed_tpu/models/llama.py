@@ -215,6 +215,12 @@ class LlamaForCausalLM:
         self.cfg = cfg
 
     @classmethod
+    def arch_config_source(cls, hf):
+        """The HF (sub-)config carrying the decoder dims (wrapper
+        configs like llava point at text_config)."""
+        return hf
+
+    @classmethod
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
         """Family-specific arch-config tweaks, applied by the loader
         after the generic from_hf_config mapping (subclass hook)."""
@@ -770,6 +776,15 @@ class LlamaForCausalLM:
         """Run the decoder over a flat ragged token batch; returns final
         hidden states [T, H] and the updated KV caches."""
         hidden = self.embed(params, token_ids)
+        if getattr(batch, "mm_embeds", None) is not None:
+            # Image placeholder positions take their pre-computed
+            # encoder rows (reference: the inputs_embeds merge of
+            # llava-style models, get_input_embeddings + masked_scatter
+            # in vllm/model_executor/models/llava.py). The override
+            # rows arrive post-projector, so no embed scaling applies.
+            hidden = jnp.where(batch.mm_mask[:, None],
+                               batch.mm_embeds.astype(hidden.dtype),
+                               hidden)
         return self.run_layers(params["layers"], kv_caches, hidden, batch)
 
     def compute_logits(self, params: dict,

@@ -1,0 +1,41 @@
+"""Llava-style image+text models — text-decoder side.
+
+Reference: vllm/model_executor/models/llava.py. The engine serves the
+TEXT decoder of a llava checkpoint; image inputs arrive as pre-computed
+projector outputs (multimodal/__init__.py) and replace the placeholder
+rows after embedding (models/llama.py forward). Running the CLIP vision
+tower + projector in-engine is the follow-up slice; until then clients
+compute features with the HF tower (the parity test does exactly that).
+"""
+
+import numpy as np
+
+from vllm_distributed_tpu.models.llama import LlamaForCausalLM
+
+
+class LlavaForConditionalGeneration(LlamaForCausalLM):
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        # Decoder dims live on the nested text_config.
+        return hf.text_config
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        super().configure_arch(arch, hf.text_config)
+
+    def params_from_hf_state_dict(self, tensors: dict[str, np.ndarray],
+                                  ) -> dict:
+        # Strip the language-model prefix (hub checkpoints say
+        # "language_model.model.*", in-memory state dicts
+        # "model.language_model.*"); the vision tower + projector are
+        # not served (clients ship projector outputs).
+        renamed = {}
+        for name, t in tensors.items():
+            if "vision_tower." in name or "multi_modal_projector." in name:
+                continue
+            name = name.replace("language_model.model.", "model.")
+            name = name.replace("model.language_model.", "model.")
+            name = name.replace("language_model.lm_head.", "lm_head.")
+            renamed[name] = t
+        return super().params_from_hf_state_dict(renamed)

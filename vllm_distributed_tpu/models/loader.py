@@ -62,7 +62,8 @@ def get_model(config: EngineConfig, mesh,
     hf_config = config.model_config.maybe_load_hf_config()
     model_cls = resolve_architecture(hf_config)
     dtype = _dtype_from_str(config.model_config.dtype)
-    arch = LlamaArchConfig.from_hf_config(hf_config, dtype=dtype)
+    arch = LlamaArchConfig.from_hf_config(
+        model_cls.arch_config_source(hf_config), dtype=dtype)
     model_cls.configure_arch(arch, hf_config)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
     if (config.parallel_config.enable_sequence_parallel
@@ -200,7 +201,8 @@ def resolve_free_window(model_config) -> Optional[int]:
     try:
         hf_config = model_config.maybe_load_hf_config()
         model_cls = resolve_architecture(hf_config)
-        arch = LlamaArchConfig.from_hf_config(hf_config)
+        arch = LlamaArchConfig.from_hf_config(
+            model_cls.arch_config_source(hf_config))
         model_cls.configure_arch(arch, hf_config)
     except Exception:  # noqa: BLE001 - conservative: no freeing
         return None

@@ -16,6 +16,7 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
                                                   DeepseekV3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
+from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
 
@@ -39,6 +40,8 @@ _REGISTRY: dict[str, type] = {
     # MLA + DeepSeekMoE family (latent KV cache; models/deepseek.py).
     "DeepseekV2ForCausalLM": DeepseekV2ForCausalLM,
     "DeepseekV3ForCausalLM": DeepseekV3ForCausalLM,
+    # Image+text (pre-computed projector embeddings; models/llava.py).
+    "LlavaForConditionalGeneration": LlavaForConditionalGeneration,
 }
 
 

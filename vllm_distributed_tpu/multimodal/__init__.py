@@ -1,0 +1,78 @@
+"""Multimodal inputs — minimum image slice.
+
+Reference: vllm/multimodal/ (registry + processors, ~5.2k LoC) and the
+V1 engine's encoder plumbing (v1/core/encoder_cache_manager.py). This
+slice covers the llava-style flow with PRE-COMPUTED image embeddings
+(the output of the vision tower + projector): the prompt carries one
+placeholder token per image, the processor expands each to the image's
+token count, and the runner substitutes the embedding rows for the
+placeholder positions at prefill. Running the vision tower in-engine is
+the follow-up step; the cache/scheduler/runner plumbing is identical.
+"""
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class MultiModalInput:
+    """One image's contribution to a request."""
+
+    # Pre-computed embedding rows [n_tokens, hidden_size] (llava: the
+    # projector output; reference: get_multimodal_embeddings()).
+    embeds: np.ndarray
+    # Index of the first placeholder position in the EXPANDED prompt.
+    offset: int
+
+    @property
+    def num_tokens(self) -> int:
+        return int(self.embeds.shape[0])
+
+    def content_hash(self) -> bytes:
+        return hashlib.sha256(
+            np.ascontiguousarray(self.embeds).tobytes()).digest()
+
+
+def expand_image_placeholders(
+    prompt_token_ids: list[int],
+    image_token_id: int,
+    images: list[np.ndarray],
+) -> tuple[list[int], list[MultiModalInput]]:
+    """Each placeholder token becomes image.shape[0] repeated placeholder
+    tokens (reference: the prompt-replacement pass of
+    multimodal/processing.py); returns the expanded ids and the
+    positioned inputs."""
+    n_ph = sum(1 for t in prompt_token_ids if t == image_token_id)
+    if n_ph != len(images):
+        raise ValueError(
+            f"prompt has {n_ph} image placeholder tokens but "
+            f"{len(images)} images were provided")
+    out: list[int] = []
+    inputs: list[MultiModalInput] = []
+    it = iter(images)
+    for t in prompt_token_ids:
+        if t == image_token_id:
+            emb = np.asarray(next(it))
+            if emb.ndim != 2:
+                raise ValueError(
+                    "image embeddings must be [n_tokens, hidden_size]; "
+                    f"got shape {emb.shape}")
+            inputs.append(MultiModalInput(embeds=emb, offset=len(out)))
+            out.extend([image_token_id] * emb.shape[0])
+        else:
+            out.append(t)
+    return out, inputs
+
+
+def mm_content_hash(inputs: list[MultiModalInput]) -> bytes:
+    """Combined content hash of a request's images — folded into the
+    request's block hashes so two prompts with identical token ids but
+    different images can never share prefix-cache pages (reference:
+    the mm_hash keys of v1/core/kv_cache_utils.py block hashing)."""
+    h = hashlib.sha256()
+    for inp in inputs:
+        h.update(inp.content_hash())
+        h.update(inp.offset.to_bytes(8, "little"))
+    return h.digest()

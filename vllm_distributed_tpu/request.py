@@ -67,6 +67,10 @@ class EngineCoreRequest:
     # Embedding/pooling request: {"type": "last"} (reference:
     # vllm/pooling_params.py; pooled hidden state instead of sampling).
     pooling_params: Optional[dict[str, Any]] = None
+    # Multimodal: positioned pre-computed encoder outputs, one per image
+    # (multimodal/__init__.py MultiModalInput; reference: the mm_inputs
+    # of v1/engine/__init__.py EngineCoreRequest).
+    mm_inputs: Optional[list] = None
 
 
 class Request:
@@ -83,6 +87,7 @@ class Request:
         kv_transfer_params: Optional[dict[str, Any]] = None,
         lora_request: Optional[dict[str, str]] = None,
         pooling_params: Optional[dict[str, Any]] = None,
+        mm_inputs: Optional[list] = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -97,6 +102,14 @@ class Request:
         self.kv_transfer_params = kv_transfer_params
         self.lora_request = lora_request
         self.pooling_params = pooling_params
+        self.mm_inputs = mm_inputs
+        # Content hash of the images, salted into the block hashes so
+        # identical placeholder token ids with different images never
+        # share prefix-cache pages (kv_cache_utils.hash_request_tokens).
+        self.mm_hash: Optional[bytes] = None
+        if mm_inputs:
+            from vllm_distributed_tpu.multimodal import mm_content_hash
+            self.mm_hash = mm_content_hash(mm_inputs)
 
         self.status = RequestStatus.WAITING
         self.stop_reason: Optional[int | str] = None
@@ -140,6 +153,7 @@ class Request:
             kv_transfer_params=req.kv_transfer_params,
             lora_request=req.lora_request,
             pooling_params=req.pooling_params,
+            mm_inputs=req.mm_inputs,
         )
 
     # ------------------------------------------------------------------

@@ -53,6 +53,8 @@ class InputBatch:
         self.lora_slot = np.zeros((R, ), np.int32)
         # Pooling type per row (None = generation request).
         self.pooling: list = [None] * R
+        # Multimodal inputs per row (list[MultiModalInput] | None).
+        self.mm: list = [None] * R
         # Sparse per-row python state (lowered to fixed [R, B] arrays in
         # the runner only when a batch contains extended rows).
         self.logit_bias: list[Optional[dict[int, float]]] = [None] * R
@@ -114,6 +116,7 @@ class InputBatch:
         self.logit_bias[row] = sp.logit_bias
         self.allowed_token_ids[row] = sp.allowed_token_ids
         self.stop_token_ids[row] = tuple(sp.all_stop_token_ids)
+        self.mm[row] = data.mm_inputs
         return row
 
     def update_cached(self, data: CachedRequestData) -> None:
@@ -176,4 +179,5 @@ class InputBatch:
         self.logit_bias[row] = None
         self.allowed_token_ids[row] = None
         self.stop_token_ids[row] = ()
+        self.mm[row] = None
         return row

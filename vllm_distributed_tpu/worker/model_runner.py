@@ -612,6 +612,33 @@ class TPUModelRunner:
                 scaling=jnp.asarray(
                     self.lora_manager.scaling[slots[order]]),
             )
+        # Multimodal: placeholder positions scheduled this step take
+        # their pre-computed encoder rows (reference: the scheduled
+        # encoder inputs of v1/core/sched/output.py + the embedding
+        # merge in gpu_model_runner._execute_mm_encoder). Host loop over
+        # real tokens only, and only on steps with an image request.
+        mm_embeds = mm_mask = None
+        if any(ib.mm[ib.req_id_to_index[r]] for r in num_sched):
+            Hd = self.model.cfg.hidden_size
+            ov = np.zeros((T, Hd), np.float32)
+            mk = np.zeros((T, ), bool)
+            for ti in range(total_tokens):
+                mm_list = ib.mm[req_idx[ti]]
+                if not mm_list:
+                    continue
+                p = int(positions[ti])
+                for inp in mm_list:
+                    if inp.offset <= p < inp.offset + inp.num_tokens:
+                        ov[ti] = inp.embeds[p - inp.offset]
+                        mk[ti] = True
+                        break
+            if mk.any():
+                mm_embeds = jnp.asarray(ov)
+                mm_mask = jnp.asarray(mk)
+            # else: pure-decode step of an image request — no placeholder
+            # positions scheduled; take the text-only graph (no [T, H]
+            # upload, no mm-variant compile).
+
         batch = AttentionBatch(
             req_idx=jnp.asarray(req_idx),
             positions=jnp.asarray(positions),
@@ -625,6 +652,8 @@ class TPUModelRunner:
             tknp=tknp,
             lora=lora_ctx,
             cascade_shared_ids=cascade_ids,
+            mm_embeds=mm_embeds,
+            mm_mask=mm_mask,
             max_q=max_q,
         )
         return (jnp.asarray(token_ids), batch,
