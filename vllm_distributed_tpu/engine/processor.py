@@ -146,6 +146,11 @@ class Processor:
 
         from vllm_distributed_tpu.multimodal import \
             expand_image_placeholders
+        if self.config.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError(
+                "image inputs under pipeline parallelism are not wired "
+                "yet (the staged embed path does not apply embedding "
+                "overrides); disable one")
         unknown = set(multi_modal_data) - {"image_embeds"}
         if unknown:
             raise ValueError(

@@ -293,3 +293,12 @@ def apply_rope_pairwise(x: jax.Array, cos: jax.Array,
     s = sin[:, None, :]
     out = jnp.stack([x0 * c - x1 * s, x0 * s + x1 * c], axis=-1)
     return out.reshape(T, H, D).astype(x.dtype)
+
+
+def apply_rope_single(x: jax.Array, cos: jax.Array,
+                      sin: jax.Array) -> jax.Array:
+    """Rotate-half rope on one [T, heads, D] tensor (partial-rotary
+    callers rope q and k slices independently)."""
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    return (x * c + _rotate_half(x) * s).astype(x.dtype)

@@ -1,0 +1,290 @@
+"""Extended model families on the generic block knobs.
+
+Reference: vllm/model_executor/models/{gpt_neox,phi,stablelm,
+starcoder2,commandr,olmo2,granite,qwen3_moe,nemotron}.py — each family
+is the Llama decoder with a structural twist now expressed as
+LlamaArchConfig knobs (norm flavor, partial rotary, parallel residual,
+non-gated MLP, multipliers); these subclasses set the knobs and map the
+checkpoint tensor names onto the canonical layout."""
+
+import numpy as np
+
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+
+def _rename(tensors: dict, table: list[tuple[str, str]]) -> dict:
+    out = {}
+    for name, t in tensors.items():
+        for old, new in table:
+            if old in name:
+                name = name.replace(old, new)
+        out[name] = t
+    return out
+
+
+class GraniteForCausalLM(LlamaForCausalLM):
+    """IBM Granite: Llama weights + the four scale multipliers
+    (reference: models/granite.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.embed_scale = float(getattr(hf, "embedding_multiplier", 1.0))
+        arch.residual_multiplier = float(
+            getattr(hf, "residual_multiplier", 1.0))
+        arch.sm_scale_override = float(
+            getattr(hf, "attention_multiplier", None)
+            or arch.head_dim ** -0.5)
+        ls = float(getattr(hf, "logits_scaling", 1.0) or 1.0)
+        arch.logit_multiplier = 1.0 / ls
+        arch.attention_bias = bool(getattr(hf, "attention_bias", False))
+
+
+class Qwen3MoeForCausalLM(MixtralForCausalLM):
+    """Qwen3-MoE: Mixtral-style routed experts (normalized top-k) +
+    Qwen3 per-head qk norm, no shared expert (reference:
+    models/qwen3_moe.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.num_experts = hf.num_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.norm_topk_prob = bool(getattr(hf, "norm_topk_prob", True))
+        arch.moe_intermediate_size = hf.moe_intermediate_size
+        arch.qk_norm = True
+        if getattr(hf, "mlp_only_layers", None) or \
+                getattr(hf, "decoder_sparse_step", 1) != 1:
+            raise ValueError(
+                "Qwen3-MoE layouts mixing dense and sparse layers are "
+                "not supported; every layer must be sparse")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        # Alias the Qwen expert naming onto the Mixtral layout the base
+        # loader stacks.
+        alias = dict(tensors)
+        for i in range(c.num_layers):
+            for e in range(c.num_experts):
+                for src, dst in (("gate_proj", "w1"), ("down_proj", "w2"),
+                                 ("up_proj", "w3")):
+                    alias[f"model.layers.{i}.block_sparse_moe.experts."
+                          f"{e}.{dst}.weight"] = tensors[
+                              f"model.layers.{i}.mlp.experts.{e}."
+                              f"{src}.weight"]
+            alias[f"model.layers.{i}.block_sparse_moe.gate.weight"] = \
+                tensors[f"model.layers.{i}.mlp.gate.weight"]
+        return super().params_from_hf_state_dict(alias)
+
+
+class Starcoder2ForCausalLM(LlamaForCausalLM):
+    """StarCoder2: LayerNorm(+bias), non-gated gelu MLP with biases,
+    qkv + output biases (reference: models/starcoder2.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = bool(getattr(hf, "use_bias", True))
+        arch.attention_bias = bool(getattr(hf, "use_bias", True))
+        arch.attention_out_bias = bool(getattr(hf, "use_bias", True))
+        arch.hidden_act = getattr(hf, "hidden_act", "gelu_pytorch_tanh")
+        arch.rms_norm_eps = float(getattr(hf, "norm_epsilon", 1e-5))
+        arch.tie_word_embeddings = bool(
+            getattr(hf, "tie_word_embeddings", True))
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        return super().params_from_hf_state_dict(_rename(tensors, [
+            (".mlp.c_fc.", ".mlp.fc1."),
+            (".mlp.c_proj.", ".mlp.fc2."),
+        ]))
+
+
+class StableLmForCausalLM(LlamaForCausalLM):
+    """StableLM: partial rotary + LayerNorm(+bias) around a gated silu
+    MLP (reference: models/stablelm.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = bool(getattr(hf, "layer_norm_bias", True))
+        arch.rotary_dim = int(arch.head_dim *
+                              float(getattr(hf, "partial_rotary_factor",
+                                            0.25)))
+        arch.attention_bias = bool(getattr(hf, "use_qkv_bias", False))
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
+
+
+class GPTNeoXForCausalLM(LlamaForCausalLM):
+    """GPT-NeoX (Pythia): parallel residual with separate norms,
+    LayerNorm(+bias), fused per-head-interleaved QKV, partial rotary,
+    non-gated gelu MLP, every Linear biased (reference:
+    models/gpt_neox.py incl. its fused-QKV de-interleave)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.parallel_block = bool(
+            getattr(hf, "use_parallel_residual", True))
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "hidden_act", "gelu")
+        arch.rotary_dim = int(arch.head_dim *
+                              float(getattr(hf, "rotary_pct", 0.25)))
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
+        arch.tie_word_embeddings = False
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        D, H = c.head_dim, c.hidden_size
+        N = c.num_q_heads
+        out = {}
+        for name, t in tensors.items():
+            name = name.replace("gpt_neox.layers.", "model.layers.")
+            name = name.replace("gpt_neox.final_layer_norm.",
+                                "model.norm.")
+            name = name.replace("gpt_neox.embed_in.",
+                                "model.embed_tokens.")
+            name = name.replace("embed_out.", "lm_head.")
+            name = name.replace(".attention.dense.", ".self_attn.o_proj.")
+            name = name.replace(".mlp.dense_h_to_4h.", ".mlp.fc1.")
+            name = name.replace(".mlp.dense_4h_to_h.", ".mlp.fc2.")
+            out[name] = t
+        # De-interleave the fused QKV: rows pack [h0_q, h0_k, h0_v,
+        # h1_q, ...] (reference: gpt_neox.py attention weight loader).
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.attention.query_key_value"
+            w = np.asarray(out.pop(base + ".weight"))  # [3*N*D, H]
+            b = np.asarray(out.pop(base + ".bias"))
+            w = w.reshape(N, 3, D, H)
+            b = b.reshape(N, 3, D)
+            A = f"model.layers.{i}.self_attn."
+            out[A + "q_proj.weight"] = w[:, 0].reshape(N * D, H)
+            out[A + "k_proj.weight"] = w[:, 1].reshape(N * D, H)
+            out[A + "v_proj.weight"] = w[:, 2].reshape(N * D, H)
+            out[A + "q_proj.bias"] = b[:, 0].reshape(N * D)
+            out[A + "k_proj.bias"] = b[:, 1].reshape(N * D)
+            out[A + "v_proj.bias"] = b[:, 2].reshape(N * D)
+        return super().params_from_hf_state_dict(out)
+
+
+class PhiForCausalLM(LlamaForCausalLM):
+    """Phi-1/1.5/2: parallel residual from ONE shared input norm,
+    LayerNorm(+bias), partial rotary, non-gated gelu MLP with biases,
+    biased LM head (reference: models/phi.py)."""
+
+    LM_HEAD_BIAS = True
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.parallel_block = True
+        arch.shared_block_ln = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "hidden_act", "gelu_new")
+        arch.rotary_dim = int(arch.head_dim *
+                              float(getattr(hf, "partial_rotary_factor",
+                                            0.5)))
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
+
+    def param_specs(self) -> dict:
+        from jax.sharding import PartitionSpec as P
+
+        from vllm_distributed_tpu.models.llama import MODEL_AXIS
+        specs = super().param_specs()
+        specs["lm_head_b"] = P(MODEL_AXIS)
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        import jax.numpy as jnp
+        params = super().init_params(rng, scale)
+        params["lm_head_b"] = jnp.zeros((self.cfg.vocab_size, ),
+                                        self.cfg.dtype)
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = _rename(tensors, [
+            (".self_attn.dense.", ".self_attn.o_proj."),
+            ("model.final_layernorm.", "model.norm."),
+        ])
+        params = super().params_from_hf_state_dict(renamed)
+        import jax.numpy as jnp
+        params["lm_head_b"] = jnp.asarray(
+            np.asarray(renamed.get(
+                "lm_head.bias",
+                np.zeros((self.cfg.vocab_size, ), np.float32))),
+            self.cfg.dtype)
+        return params
+
+
+class CohereForCausalLM(LlamaForCausalLM):
+    """Cohere Command-R: parallel residual from one shared LayerNorm
+    (no bias), interleaved rope, logit_scale, tied embeddings
+    (reference: models/commandr.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.parallel_block = True
+        arch.shared_block_ln = True
+        arch.rope_interleaved = True
+        arch.logit_multiplier = float(getattr(hf, "logit_scale", 1.0))
+        arch.tie_word_embeddings = True
+        arch.attention_bias = bool(getattr(hf, "attention_bias", False))
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
+        if getattr(hf, "use_qk_norm", False):
+            raise ValueError("Cohere use_qk_norm checkpoints are not "
+                             "supported yet")
+
+
+class Olmo2ForCausalLM(LlamaForCausalLM):
+    """OLMo 2: post-norm block (sub-layers read the raw residual
+    stream, outputs are RMS-normed before the add) + full-row q/k norms
+    (reference: models/olmo2.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.pre_norm = False
+        arch.extra_layer_norms = True
+        arch.qk_norm_full = True
+
+    # The base loader handles the post-norm layout directly: with
+    # pre_norm=False it skips input_ln/post_ln and stacks only the two
+    # output norms (post_attention/post_feedforward), which is exactly
+    # olmo2's checkpoint naming — no override needed.
+
+
+class NemotronForCausalLM(LlamaForCausalLM):
+    """Nemotron: LayerNorm1p (weight+1, folded at load), relu^2
+    non-gated MLP, partial rotary (reference: models/nemotron.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.hidden_act = "relu2"
+        arch.rotary_dim = int(
+            arch.head_dim * float(getattr(hf, "partial_rotary_factor",
+                                          0.5)))
+        arch.rms_norm_eps = float(getattr(hf, "norm_eps", 1e-5))
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        params = super().params_from_hf_state_dict(_rename(tensors, [
+            (".mlp.up_proj.", ".mlp.fc1."),
+            (".mlp.down_proj.", ".mlp.fc2."),
+        ]))
+        # LayerNorm1p: (1 + w) * normed + b — fold the +1.
+        layers = params["layers"]
+        for key in ("input_ln", "post_ln"):
+            layers[key] = layers[key] + 1.0
+        params["final_ln"] = params["final_ln"] + 1.0
+        return params

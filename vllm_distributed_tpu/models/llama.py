@@ -132,8 +132,41 @@ class LlamaArchConfig:
     # (Gemma multiplies by sqrt(H)), MLP activation, per-head q/k
     # RMSNorm (Qwen3).
     embed_scale: float = 1.0
-    hidden_act: str = "silu"  # silu | gelu_tanh
+    hidden_act: str = "silu"  # silu | gelu_tanh | gelu | relu2
     qk_norm: bool = False
+    # ---- generic block-structure knobs (GPT-NeoX / Phi / StableLM /
+    # Starcoder2 / Cohere / Olmo2 / Granite families) ----
+    # Norm flavor: "rms" or mean-centering "layernorm" (+ optional beta).
+    norm_type: str = "rms"
+    norm_bias: bool = False
+    # Partial rotary: rope covers only the first rotary_dim lanes of
+    # each head (GPT-NeoX rotary_pct, Phi partial_rotary_factor);
+    # None = full head_dim.
+    rotary_dim: Optional[int] = None
+    # Pairwise (complex) rope instead of rotate-half (Cohere, GLM).
+    rope_interleaved: bool = False
+    # Parallel residual: h += attn(ln1(h)) + mlp(ln2(h)) (GPT-NeoX);
+    # shared_block_ln feeds BOTH sub-blocks from ln1 (Phi, Cohere).
+    parallel_block: bool = False
+    shared_block_ln: bool = False
+    # False + extra_layer_norms: post-norm block (Olmo2 — sublayer
+    # inputs un-normed, outputs normed before the residual add).
+    pre_norm: bool = True
+    # Non-gated MLP: fc1 -> act -> fc2 (GPT-NeoX/Phi/Starcoder2).
+    mlp_gated: bool = True
+    mlp_bias: bool = False
+    attention_out_bias: bool = False
+    # Full-row q/k RMSNorm before the head reshape (Olmo2) — distinct
+    # from the per-head qk_norm.
+    qk_norm_full: bool = False
+    # Score scale as a direct multiplier (Granite attention_multiplier);
+    # overrides the head-dim rule and query_pre_attn_scalar.
+    sm_scale_override: Optional[float] = None
+    # Residual-branch multiplier (Granite residual_multiplier).
+    residual_multiplier: float = 1.0
+    # Final-logit multiplier (Cohere logit_scale; Granite
+    # 1/logits_scaling).
+    logit_multiplier: float = 1.0
     dtype: Any = jnp.bfloat16
 
     @property
@@ -291,17 +324,42 @@ class LlamaForCausalLM:
             "wv": P(None, None, MODEL_AXIS),
             "wo": P(None, MODEL_AXIS, None),
             "post_ln": P(None, None),
-            "gate": P(None, None, MODEL_AXIS),
-            "up": P(None, None, MODEL_AXIS),
-            "down": P(None, MODEL_AXIS, None),
         }
+        if c.mlp_gated:
+            layer.update({
+                "gate": P(None, None, MODEL_AXIS),
+                "up": P(None, None, MODEL_AXIS),
+                "down": P(None, MODEL_AXIS, None),
+            })
+        else:
+            layer.update({
+                "fc1": P(None, None, MODEL_AXIS),
+                "fc2": P(None, MODEL_AXIS, None),
+            })
+            if c.mlp_bias:
+                layer.update({"fc1_b": P(None, MODEL_AXIS),
+                              "fc2_b": P(None, None)})
+        if c.norm_bias:
+            layer.update({"input_ln_b": P(None, None),
+                          "post_ln_b": P(None, None)})
+        if c.attention_out_bias:
+            layer["bo"] = P(None, None)
+        if c.parallel_block and c.shared_block_ln:
+            # Single shared pre-norm: no post_ln in the block.
+            layer.pop("post_ln")
+            layer.pop("post_ln_b", None)
+        if not c.pre_norm:
+            layer.pop("input_ln")
+            layer.pop("post_ln", None)
+            layer.pop("input_ln_b", None)
+            layer.pop("post_ln_b", None)
         if c.attention_bias:
             layer.update({
                 "bq": P(None, MODEL_AXIS),
                 "bk": P(None, MODEL_AXIS),
                 "bv": P(None, MODEL_AXIS),
             })
-        if c.qk_norm:
+        if c.qk_norm or c.qk_norm_full:
             layer.update({
                 "q_norm": P(None, None),
                 "k_norm": P(None, None),
@@ -313,12 +371,15 @@ class LlamaForCausalLM:
             })
         self._add_scale_specs(layer)
         self._add_lora_specs(layer)
-        return {
+        specs = {
             "embed": P(None, None),
             "layers": layer,
             "final_ln": P(None),
             "lm_head": P(None, MODEL_AXIS),
         }
+        if c.norm_bias:
+            specs["final_ln_b"] = P(None)
+        return specs
 
     def _add_lora_specs(self, layer: dict) -> None:
         """Adapter-buffer shardings: B follows the base weight's output
@@ -395,10 +456,32 @@ class LlamaForCausalLM:
             "wv": norm(next(keys), (L, H, Dkv)),
             "wo": norm(next(keys), (L, Dq, H)),
             "post_ln": jnp.ones((L, H), c.dtype),
-            "gate": norm(next(keys), (L, H, I)),
-            "up": norm(next(keys), (L, H, I)),
-            "down": norm(next(keys), (L, I, H)),
         }
+        if c.mlp_gated:
+            layers.update({
+                "gate": norm(next(keys), (L, H, I)),
+                "up": norm(next(keys), (L, H, I)),
+                "down": norm(next(keys), (L, I, H)),
+            })
+        else:
+            layers.update({
+                "fc1": norm(next(keys), (L, H, I)),
+                "fc2": norm(next(keys), (L, I, H)),
+            })
+            if c.mlp_bias:
+                layers.update({"fc1_b": jnp.zeros((L, I), c.dtype),
+                               "fc2_b": jnp.zeros((L, H), c.dtype)})
+        if c.norm_bias:
+            layers.update({"input_ln_b": jnp.zeros((L, H), c.dtype),
+                           "post_ln_b": jnp.zeros((L, H), c.dtype)})
+        if c.attention_out_bias:
+            layers["bo"] = jnp.zeros((L, H), c.dtype)
+        if c.parallel_block and c.shared_block_ln:
+            layers.pop("post_ln")
+            layers.pop("post_ln_b", None)
+        if not c.pre_norm:
+            for k in ("input_ln", "post_ln", "input_ln_b", "post_ln_b"):
+                layers.pop(k, None)
         if c.attention_bias:
             layers.update({
                 "bq": jnp.zeros((L, Dq), c.dtype),
@@ -410,6 +493,11 @@ class LlamaForCausalLM:
                 "q_norm": jnp.ones((L, c.head_dim), c.dtype),
                 "k_norm": jnp.ones((L, c.head_dim), c.dtype),
             })
+        if c.qk_norm_full:
+            layers.update({
+                "q_norm": jnp.ones((L, Dq), c.dtype),
+                "k_norm": jnp.ones((L, Dkv), c.dtype),
+            })
         if c.extra_layer_norms:
             layers.update({
                 "post_attn_ln": jnp.ones((L, H), c.dtype),
@@ -418,13 +506,16 @@ class LlamaForCausalLM:
         self._maybe_replicate_kv(layers)
         self._install_lora_buffers(layers)
         embed = norm(next(keys), (c.vocab_size, H))
-        return {
+        out = {
             "embed": embed,
             "layers": layers,
             "final_ln": jnp.ones((H, ), c.dtype),
             "lm_head": (embed.T if c.tie_word_embeddings else norm(
                 next(keys), (H, c.vocab_size))),
         }
+        if c.norm_bias:
+            out["final_ln_b"] = jnp.zeros((H, ), c.dtype)
+        return out
 
     def _maybe_replicate_kv(self, layers: dict) -> None:
         """Expand K/V projection weights in place when KV heads are
@@ -432,7 +523,11 @@ class LlamaForCausalLM:
         c = self.cfg
         if c.num_kv_head_replicas == 1:
             return
-        for name in ("wk", "wv", "bk", "bv"):
+        names = ["wk", "wv", "bk", "bv"]
+        if c.qk_norm_full:
+            # Olmo2's full-row k norm is per-lane; widen with the heads.
+            names.append("k_norm")
+        for name in names:
             if name in layers:
                 layers[name] = _replicate_kv_heads(
                     layers[name], c.num_kv_heads, c.num_kv_head_replicas)
@@ -491,19 +586,51 @@ class LlamaForCausalLM:
             return jnp.asarray(arr, dtype=c.dtype)
 
         layers = {
-            "input_ln": stack("model.layers.{}.input_layernorm.weight",
-                              transpose=False),
             "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
             "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
             "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "post_ln": stack(
-                "model.layers.{}.post_attention_layernorm.weight",
-                transpose=False),
-            "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "down": stack("model.layers.{}.mlp.down_proj.weight"),
         }
+        if c.pre_norm:
+            layers["input_ln"] = stack(
+                "model.layers.{}.input_layernorm.weight",
+                transpose=False)
+            if not (c.parallel_block and c.shared_block_ln):
+                layers["post_ln"] = stack(
+                    "model.layers.{}.post_attention_layernorm.weight",
+                    transpose=False)
+            if c.norm_bias:
+                layers["input_ln_b"] = stack(
+                    "model.layers.{}.input_layernorm.bias",
+                    transpose=False)
+                if "post_ln" in layers:
+                    layers["post_ln_b"] = stack(
+                        "model.layers.{}.post_attention_layernorm.bias",
+                        transpose=False)
+        if c.mlp_gated:
+            layers.update({
+                "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+                "up": stack("model.layers.{}.mlp.up_proj.weight"),
+                "down": stack("model.layers.{}.mlp.down_proj.weight"),
+            })
+        else:
+            # Canonical plain-MLP names; family subclasses rename their
+            # checkpoint tensors (dense_h_to_4h, c_fc, ...) onto these.
+            layers.update({
+                "fc1": stack("model.layers.{}.mlp.fc1.weight"),
+                "fc2": stack("model.layers.{}.mlp.fc2.weight"),
+            })
+            if c.mlp_bias:
+                layers.update({
+                    "fc1_b": stack("model.layers.{}.mlp.fc1.bias",
+                                   transpose=False),
+                    "fc2_b": stack("model.layers.{}.mlp.fc2.bias",
+                                   transpose=False),
+                })
+        if c.attention_out_bias:
+            layers["bo"] = stack(
+                "model.layers.{}.self_attn.o_proj.bias",
+                transpose=False)
         if c.attention_bias:
             layers.update({
                 "bq": stack("model.layers.{}.self_attn.q_proj.bias",
@@ -513,7 +640,7 @@ class LlamaForCausalLM:
                 "bv": stack("model.layers.{}.self_attn.v_proj.bias",
                             transpose=False),
             })
-        if c.qk_norm:
+        if c.qk_norm or c.qk_norm_full:
             layers.update({
                 "q_norm": stack("model.layers.{}.self_attn.q_norm.weight",
                                 transpose=False),
@@ -524,11 +651,9 @@ class LlamaForCausalLM:
             # Gemma2's 4-norm block renames the roles: HF
             # post_attention_layernorm norms the attention OUTPUT (our
             # post_attn_ln) and pre_feedforward_layernorm is the
-            # pre-MLP norm (our post_ln).
+            # pre-MLP norm (our post_ln). Post-norm blocks (Olmo2,
+            # pre_norm=False) have only the two output norms.
             layers.update({
-                "post_ln": stack(
-                    "model.layers.{}.pre_feedforward_layernorm.weight",
-                    transpose=False),
                 "post_attn_ln": stack(
                     "model.layers.{}.post_attention_layernorm.weight",
                     transpose=False),
@@ -536,6 +661,10 @@ class LlamaForCausalLM:
                     "model.layers.{}.post_feedforward_layernorm.weight",
                     transpose=False),
             })
+            if c.pre_norm:
+                layers["post_ln"] = stack(
+                    "model.layers.{}.pre_feedforward_layernorm.weight",
+                    transpose=False)
         self._maybe_replicate_kv(layers)
         embed = jnp.asarray(t("model.embed_tokens.weight"), dtype=c.dtype)
         if c.tie_word_embeddings or "lm_head.weight" not in tensors:
@@ -543,25 +672,63 @@ class LlamaForCausalLM:
         else:
             lm_head = jnp.asarray(t("lm_head.weight").T, dtype=c.dtype)
         self._install_lora_buffers(layers)
-        return {
+        out = {
             "embed": embed,
             "layers": layers,
             "final_ln": jnp.asarray(t("model.norm.weight"), dtype=c.dtype),
             "lm_head": lm_head,
         }
+        if c.norm_bias and "model.norm.bias" in tensors:
+            out["final_ln_b"] = jnp.asarray(t("model.norm.bias"),
+                                            dtype=c.dtype)
+        return out
 
     # ------------------------------------------------------------------
     # Forward
     # ------------------------------------------------------------------
     def _act(self, x: jax.Array) -> jax.Array:
-        if self.cfg.hidden_act == "gelu_tanh":
+        act = self.cfg.hidden_act
+        if act in ("gelu_tanh", "gelu_new", "gelu_pytorch_tanh"):
             return jax.nn.gelu(x, approximate=True)
-        return jax.nn.silu(x)
+        if act == "gelu":
+            return jax.nn.gelu(x, approximate=False)
+        if act == "relu2":
+            r = jax.nn.relu(x)
+            return r * r
+        if act in ("silu", "swish", None):
+            return jax.nn.silu(x)
+        raise ValueError(
+            f"unsupported hidden_act {act!r} (add it to _act rather "
+            "than silently running the wrong activation)")
+
+    def _norm(self, x: jax.Array, w: jax.Array,
+              b: Optional[jax.Array] = None) -> jax.Array:
+        """RMSNorm or mean-centering LayerNorm per cfg.norm_type."""
+        c = self.cfg
+        if c.norm_type == "rms":
+            return rms_norm(x, w, c.rms_norm_eps)
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(axis=-1, keepdims=True)
+        var = ((x32 - mu) ** 2).mean(axis=-1, keepdims=True)
+        out = (x32 - mu) * jax.lax.rsqrt(var + c.rms_norm_eps)
+        out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(x.dtype)
 
     def mlp_block(self, lp: dict, x: jax.Array,
                   lora_ctx=None) -> jax.Array:
         """Per-layer feed-forward; MoE models override this (the MLP is
         the only structural difference in the decoder block)."""
+        c = self.cfg
+        if not c.mlp_gated:
+            h = x @ self._w(lp, "fc1")
+            if c.mlp_bias:
+                h = h + lp["fc1_b"]
+            h = self._act(h) @ self._w(lp, "fc2")
+            if c.mlp_bias:
+                h = h + lp["fc2_b"]
+            return h
         if lora_ctx is None or ("gate_a") not in lp:
             return swiglu(x, self._w(lp, "gate"), self._w(lp, "up"),
                           self._w(lp, "down"), act=self._act)
@@ -647,11 +814,21 @@ class LlamaForCausalLM:
         (static — PP keys its stage jit on it for patterned models)."""
         c = self.cfg
         T = hidden.shape[0]
-        sm_scale = (c.query_pre_attn_scalar or c.head_dim) ** -0.5
+        if c.sm_scale_override is not None:
+            sm_scale = c.sm_scale_override
+        else:
+            sm_scale = (c.query_pre_attn_scalar or c.head_dim) ** -0.5
         num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
-        cos, sin = compute_rope_cos_sin(batch.positions, c.head_dim,
-                                        c.rope_theta, c.rope_scaling,
-                                        dtype=jnp.float32)
+        rd = c.rotary_dim or c.head_dim
+        if c.rope_interleaved:
+            from vllm_distributed_tpu.models.common import \
+                compute_rope_cos_sin_pairwise
+            cos, sin = compute_rope_cos_sin_pairwise(
+                batch.positions, rd, c.rope_theta, c.rope_scaling)
+        else:
+            cos, sin = compute_rope_cos_sin(batch.positions, rd,
+                                            c.rope_theta, c.rope_scaling,
+                                            dtype=jnp.float32)
 
         has_bias = c.attention_bias
 
@@ -690,8 +867,28 @@ class LlamaForCausalLM:
             return jax.lax.with_sharding_constraint(
                 h, sp_sharding if sp_sharding is not None else sp_spec)
 
+        def apply_rotary(x):
+            """Rope on the first ``rd`` lanes (fp32; partial rotary
+            passes the tail through — GPT-NeoX rotary_pct semantics)."""
+            from vllm_distributed_tpu.models.common import (
+                apply_rope_pairwise, apply_rope_single)
+            x32 = x.astype(jnp.float32)
+            rot = x32[..., :rd]
+            rot = (apply_rope_pairwise(rot, cos, sin)
+                   if c.rope_interleaved else
+                   apply_rope_single(rot, cos, sin))
+            if rd == c.head_dim:
+                return rot.astype(c.dtype)
+            return jnp.concatenate([rot, x32[..., rd:]],
+                                   axis=-1).astype(c.dtype)
+
+        rm = c.residual_multiplier
+
         def layer_body(h, k_all, v_all, lp, layer_idx, window):
-            x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
+            if c.pre_norm:
+                x = self._norm(h, lp["input_ln"], lp.get("input_ln_b"))
+            else:
+                x = h  # Olmo2 post-norm block: sub-layers see raw h
             q = x @ self._w(lp, "wq") + self._lora_delta(lp, "wq", x,
                                                          lora_ctx)
             k = x @ self._w(lp, "wk") + self._lora_delta(lp, "wk", x,
@@ -702,6 +899,11 @@ class LlamaForCausalLM:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
                 v = v + lp["bv"]
+            if c.qk_norm_full:
+                # Olmo2: RMSNorm over the whole projection row, before
+                # the head reshape.
+                q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+                k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
             q = q.reshape(T, c.num_q_heads, c.head_dim)
             k = k.reshape(T, c.total_kv_heads, c.head_dim)
             if c.qk_norm:
@@ -709,11 +911,8 @@ class LlamaForCausalLM:
                 q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
                 k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
             v = v.reshape(T, c.total_kv_heads, c.head_dim)
-            # RoPE in fp32 for parity with the HF reference, then back.
-            q, k = apply_rope(q.astype(jnp.float32), k.astype(jnp.float32),
-                              cos, sin)
-            q = q.astype(c.dtype)
-            k = k.astype(c.dtype)
+            q = apply_rotary(q)
+            k = apply_rotary(k)
             k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,
@@ -723,17 +922,29 @@ class LlamaForCausalLM:
             attn2d = attn.reshape(T, -1)
             attn_out = (attn2d @ self._w(lp, "wo") +
                         self._lora_delta(lp, "wo", attn2d, lora_ctx))
+            if c.attention_out_bias:
+                attn_out = attn_out + lp["bo"]
             if "post_attn_ln" in lp:
-                # Gemma2 sandwich norm on the attention output.
-                attn_out = rms_norm(attn_out, lp["post_attn_ln"],
-                                    c.rms_norm_eps)
-            h = sp(h + attn_out)
-            x2 = rms_norm(h, lp["post_ln"], c.rms_norm_eps)
+                # Sandwich/post norm on the attention output (Gemma2,
+                # Olmo2).
+                attn_out = self._norm(attn_out, lp["post_attn_ln"],
+                                      lp.get("post_attn_ln_b"))
+            if c.parallel_block:
+                # GPT-NeoX/Phi/Cohere: both sub-blocks read the same
+                # input state; one residual add.
+                x2 = (x if c.shared_block_ln else
+                      self._norm(h, lp["post_ln"], lp.get("post_ln_b")))
+                mlp_out = self.mlp_block(lp, x2, lora_ctx)
+                h = sp(h + rm * (attn_out + mlp_out))
+                return h, k_all, v_all
+            h = sp(h + rm * attn_out)
+            x2 = (self._norm(h, lp["post_ln"], lp.get("post_ln_b"))
+                  if c.pre_norm else h)
             mlp_out = self.mlp_block(lp, x2, lora_ctx)
             if "post_ffw_ln" in lp:
-                mlp_out = rms_norm(mlp_out, lp["post_ffw_ln"],
-                                   c.rms_norm_eps)
-            h = sp(h + mlp_out)
+                mlp_out = self._norm(mlp_out, lp["post_ffw_ln"],
+                                     lp.get("post_ffw_ln_b"))
+            h = sp(h + rm * mlp_out)
             return h, k_all, v_all
 
         windows = self._layer_windows(first_layer, num_layers)
@@ -790,9 +1001,15 @@ class LlamaForCausalLM:
     def compute_logits(self, params: dict,
                        hidden: jax.Array) -> jax.Array:
         """Final norm + LM head on selected rows; fp32 logits."""
-        x = rms_norm(hidden, params["final_ln"], self.cfg.rms_norm_eps)
+        x = self._norm(hidden, params["final_ln"],
+                       params.get("final_ln_b"))
         logits = jnp.dot(x, params["lm_head"],
                          preferred_element_type=jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        if self.cfg.logit_multiplier != 1.0:
+            # Cohere logit_scale / Granite 1/logits_scaling.
+            logits = logits * self.cfg.logit_multiplier
         cap = self.cfg.final_logit_softcap
         if cap:
             # Gemma2 final soft-capping (monotone: greedy order kept,

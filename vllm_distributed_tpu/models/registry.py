@@ -16,6 +16,15 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
                                                   DeepseekV3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
+from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
+                                                      GPTNeoXForCausalLM,
+                                                      GraniteForCausalLM,
+                                                      NemotronForCausalLM,
+                                                      Olmo2ForCausalLM,
+                                                      PhiForCausalLM,
+                                                      Qwen3MoeForCausalLM,
+                                                      StableLmForCausalLM,
+                                                      Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
@@ -42,6 +51,16 @@ _REGISTRY: dict[str, type] = {
     "DeepseekV3ForCausalLM": DeepseekV3ForCausalLM,
     # Image+text (pre-computed projector embeddings; models/llava.py).
     "LlavaForConditionalGeneration": LlavaForConditionalGeneration,
+    # Families on the generic block knobs (models/families_ext.py).
+    "GraniteForCausalLM": GraniteForCausalLM,
+    "Qwen3MoeForCausalLM": Qwen3MoeForCausalLM,
+    "Starcoder2ForCausalLM": Starcoder2ForCausalLM,
+    "StableLmForCausalLM": StableLmForCausalLM,
+    "GPTNeoXForCausalLM": GPTNeoXForCausalLM,
+    "PhiForCausalLM": PhiForCausalLM,
+    "CohereForCausalLM": CohereForCausalLM,
+    "Olmo2ForCausalLM": Olmo2ForCausalLM,
+    "NemotronForCausalLM": NemotronForCausalLM,
 }
 
 

@@ -618,7 +618,19 @@ class TPUModelRunner:
         # merge in gpu_model_runner._execute_mm_encoder). Host loop over
         # real tokens only, and only on steps with an image request.
         mm_embeds = mm_mask = None
-        if any(ib.mm[ib.req_id_to_index[r]] for r in num_sched):
+        def _mm_scheduled():
+            # Cheap gate: a row needs substitution only while scheduled
+            # positions can still fall inside a placeholder span (never
+            # on decode steps; the row's first position this step is its
+            # pre-step num_computed).
+            for r in num_sched:
+                row = ib.req_id_to_index[r]
+                mm_list = ib.mm[row]
+                if mm_list and ib.num_computed[row] < max(
+                        inp.offset + inp.num_tokens for inp in mm_list):
+                    return True
+            return False
+        if _mm_scheduled():
             Hd = self.model.cfg.hidden_size
             ov = np.zeros((T, Hd), np.float32)
             mk = np.zeros((T, ), bool)

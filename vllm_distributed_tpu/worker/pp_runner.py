@@ -86,6 +86,10 @@ class PPModelRunner(TPUModelRunner):
                 host_params["lm_head"],
                 NamedSharding(sml, specs["lm_head"])),
         }
+        for extra in ("final_ln_b", "lm_head_b"):
+            if extra in host_params:
+                self.params[extra] = jax.device_put(
+                    host_params[extra], NamedSharding(sml, specs[extra]))
 
     def lora_buffer_trees(self):
         return [(self.stage_params[p], rng)
