@@ -124,3 +124,31 @@ def test_family_tp2_spot_check(tmp_path_factory):
     got = _run_engine(path, PROMPTS, "neoxtp", tensor_parallel_size=2)
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+@pytest.mark.parametrize("family", ["olmo", "olmoe", "glm"])
+def test_second_wave_families_match_hf(family, tmp_path_factory):
+    from transformers import (GlmConfig, GlmForCausalLM, OlmoConfig,
+                              OlmoeConfig, OlmoeForCausalLM,
+                              OlmoForCausalLM)
+    cases = {
+        "olmo": (OlmoForCausalLM, OlmoConfig(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            clip_qkv=8.0)),
+        "olmoe": (OlmoeForCausalLM, OlmoeConfig(
+            **_COMMON, intermediate_size=96, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2,
+            norm_topk_prob=False)),
+        "glm": (GlmForCausalLM, GlmConfig(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, partial_rotary_factor=0.5,
+            attention_bias=True, pad_token_id=0)),
+    }
+    hf_cls, cfg = cases[family]
+    torch.manual_seed(0)
+    hf = hf_cls(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_{family}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, family)
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want, family

@@ -74,10 +74,10 @@ def test_pack_dequant_roundtrip():
         np.float32).max() * 0.51 + 1e-6
 
 
-def test_rejects_non_gptq_methods():
+def test_rejects_unknown_quant_methods():
     class Cfg:
-        quantization_config = {"quant_method": "awq"}
-    with pytest.raises(ValueError, match="only 'gptq'"):
+        quantization_config = {"quant_method": "squeezellm"}
+    with pytest.raises(ValueError, match="only 'gptq' and 'awq'"):
         maybe_dequantize_gptq({}, Cfg())
 
 

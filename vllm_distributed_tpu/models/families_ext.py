@@ -14,6 +14,24 @@ from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
 from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
 
 
+def _alias_moe_experts(tensors: dict, num_layers: int,
+                       num_experts: int) -> dict:
+    """Map the HF mlp.experts.{e}.{gate,up,down}_proj / mlp.gate naming
+    (Qwen3-MoE, OLMoE) onto the Mixtral layout the base loader stacks."""
+    alias = dict(tensors)
+    for i in range(num_layers):
+        for e in range(num_experts):
+            for src, dst in (("gate_proj", "w1"), ("down_proj", "w2"),
+                             ("up_proj", "w3")):
+                alias[f"model.layers.{i}.block_sparse_moe.experts."
+                      f"{e}.{dst}.weight"] = tensors[
+                          f"model.layers.{i}.mlp.experts.{e}."
+                          f"{src}.weight"]
+        alias[f"model.layers.{i}.block_sparse_moe.gate.weight"] = \
+            tensors[f"model.layers.{i}.mlp.gate.weight"]
+    return alias
+
+
 def _rename(tensors: dict, table: list[tuple[str, str]]) -> dict:
     out = {}
     for name, t in tensors.items():
@@ -60,21 +78,8 @@ class Qwen3MoeForCausalLM(MixtralForCausalLM):
                 "not supported; every layer must be sparse")
 
     def params_from_hf_state_dict(self, tensors) -> dict:
-        c = self.cfg
-        # Alias the Qwen expert naming onto the Mixtral layout the base
-        # loader stacks.
-        alias = dict(tensors)
-        for i in range(c.num_layers):
-            for e in range(c.num_experts):
-                for src, dst in (("gate_proj", "w1"), ("down_proj", "w2"),
-                                 ("up_proj", "w3")):
-                    alias[f"model.layers.{i}.block_sparse_moe.experts."
-                          f"{e}.{dst}.weight"] = tensors[
-                              f"model.layers.{i}.mlp.experts.{e}."
-                              f"{src}.weight"]
-            alias[f"model.layers.{i}.block_sparse_moe.gate.weight"] = \
-                tensors[f"model.layers.{i}.mlp.gate.weight"]
-        return super().params_from_hf_state_dict(alias)
+        return super().params_from_hf_state_dict(_alias_moe_experts(
+            tensors, self.cfg.num_layers, self.cfg.num_experts))
 
 
 class Starcoder2ForCausalLM(LlamaForCausalLM):
@@ -288,3 +293,68 @@ class NemotronForCausalLM(LlamaForCausalLM):
             layers[key] = layers[key] + 1.0
         params["final_ln"] = params["final_ln"] + 1.0
         return params
+
+
+class OlmoForCausalLM(LlamaForCausalLM):
+    """OLMo v1: NON-parametric LayerNorm (no weight/bias tensors in the
+    checkpoint — synthesized as ones/zeros at load), optional qkv
+    clamping (reference: models/olmo.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.rms_norm_eps = 1e-5  # OlmoLayerNorm's fixed eps
+        clip = getattr(hf, "clip_qkv", None)
+        arch.qkv_clip = float(clip) if clip else None
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        ones = np.ones((c.hidden_size, ), np.float32)
+        alias = dict(tensors)
+        for i in range(c.num_layers):
+            alias[f"model.layers.{i}.input_layernorm.weight"] = ones
+            alias[f"model.layers.{i}.post_attention_layernorm.weight"] \
+                = ones
+        alias["model.norm.weight"] = ones
+        return super().params_from_hf_state_dict(alias)
+
+
+class OlmoeForCausalLM(MixtralForCausalLM):
+    """OLMoE: Mixtral-style routed experts (softmax, norm_topk_prob
+    False) + full-row q/k RMSNorms (reference: models/olmoe.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.num_experts = hf.num_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.norm_topk_prob = bool(getattr(hf, "norm_topk_prob", False))
+        arch.qk_norm_full = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        return super().params_from_hf_state_dict(_alias_moe_experts(
+            tensors, self.cfg.num_layers, self.cfg.num_experts))
+
+
+class GlmForCausalLM(LlamaForCausalLM):
+    """GLM-4 (hf-format): partial INTERLEAVED rotary on the first half
+    of each head, qkv bias, standard pre-norm gated block (reference:
+    models/glm.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.rope_interleaved = True
+        arch.rotary_dim = int(arch.head_dim *
+                              float(getattr(hf, "partial_rotary_factor",
+                                            0.5)))
+        arch.attention_bias = bool(getattr(hf, "attention_bias", True))
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        # GLM fuses gate|up like Phi-3; split for the base layout.
+        out = dict(tensors)
+        for i in range(self.cfg.num_layers):
+            gu = np.asarray(
+                tensors[f"model.layers.{i}.mlp.gate_up_proj.weight"])
+            half = gu.shape[0] // 2
+            out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:half]
+            out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[half:]
+        return super().params_from_hf_state_dict(out)

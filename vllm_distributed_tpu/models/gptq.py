@@ -66,24 +66,13 @@ def dequantize_gptq_state_dict(tensors: dict, bits: int,
     """Replace every packed GPTQ linear in an HF state dict with its
     dequantized ``.weight``; non-quantized tensors (embeddings, norms,
     lm_head) pass through."""
-    out = {}
-    n = 0
-    for name, val in tensors.items():
-        if name.endswith(".qweight"):
-            base = name[:-len(".qweight")]
-            out[base + ".weight"] = dequantize_gptq_layer(
-                np.asarray(val), np.asarray(tensors[base + ".qzeros"]),
-                np.asarray(tensors[base + ".scales"]),
-                tensors.get(base + ".g_idx"), bits, group_size)
-            n += 1
-        elif name.endswith((".qzeros", ".scales", ".g_idx")) and (
-                name.rsplit(".", 1)[0] + ".qweight") in tensors:
-            continue
-        else:
-            out[name] = val
-    logger.info("dequantized %d GPTQ linears (%d-bit, group %d)", n,
-                bits, group_size)
-    return out
+    return _dequantize_state_dict(
+        tensors, "GPTQ", (".qzeros", ".scales", ".g_idx"),
+        lambda base: dequantize_gptq_layer(
+            np.asarray(tensors[base + ".qweight"]),
+            np.asarray(tensors[base + ".qzeros"]),
+            np.asarray(tensors[base + ".scales"]),
+            tensors.get(base + ".g_idx"), bits, group_size))
 
 
 def maybe_dequantize_gptq(tensors: dict, hf_config,
@@ -113,11 +102,26 @@ def maybe_dequantize_gptq(tensors: dict, hf_config,
     get = (qcfg.get if isinstance(qcfg, dict)
            else lambda k, d=None: getattr(qcfg, k, d))
     method = get("quant_method")
+    if method == "awq":
+        if int(get("bits", get("w_bit", 4))) != 4:
+            raise ValueError("only 4-bit AWQ checkpoints are supported")
+        version = get("version", get("backend", "gemm"))
+        if version is not None:
+            version = str(version).lower().rsplit(".", 1)[-1]
+        if version not in ("gemm", None):
+            raise ValueError(
+                f"only AWQ 'gemm'-format checkpoints are supported "
+                f"(got version={version!r})")
+        if get("zero_point", True) is False:
+            raise ValueError("symmetric (zero_point=false) AWQ "
+                             "checkpoints are not supported")
+        gs = int(get("group_size", get("q_group_size", 128)))
+        return dequantize_awq_state_dict(tensors, gs)
     if method != "gptq":
         raise ValueError(
             f"checkpoint declares quantization_config.quant_method="
-            f"{method!r}; only 'gptq' checkpoints are supported "
-            "(AWQ/others need their own unpackers)")
+            f"{method!r}; only 'gptq' and 'awq' checkpoints are "
+            "supported")
     if get("checkpoint_format", "gptq") not in ("gptq", None):
         raise ValueError(
             "only the v1 'gptq' checkpoint_format is supported "
@@ -127,3 +131,69 @@ def maybe_dequantize_gptq(tensors: dict, hf_config,
         raise ValueError(f"unsupported GPTQ bits={bits}")
     group_size = int(get("group_size", 128))
     return dequantize_gptq_state_dict(tensors, bits, group_size)
+
+
+# ---------------------------------------------------------------------------
+# AWQ (AutoAWQ "gemm" format)
+# ---------------------------------------------------------------------------
+
+# AWQ packs 8 int4 values per word along the OUTPUT dim in the
+# interleaved order [0, 2, 4, 6, 1, 3, 5, 7]; this is the inverse
+# permutation restoring real column order after a low-bits-first unpack
+# (the AWQ_REVERSE_ORDER constant of AutoAWQ / the reference's
+# awq dequant kernels, csrc/quantization/awq/dequantize.cuh).
+_AWQ_REVERSE_ORDER = np.array([0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def _awq_reorder(a: np.ndarray) -> np.ndarray:
+    out = a.shape[1]
+    idx = np.arange(out).reshape(-1, 8)[:, _AWQ_REVERSE_ORDER].reshape(-1)
+    return a[:, idx]
+
+
+def dequantize_awq_layer(qweight: np.ndarray, qzeros: np.ndarray,
+                         scales: np.ndarray,
+                         group_size: int) -> np.ndarray:
+    """One packed AWQ linear -> fp32 [out, in].
+
+    Layout (AutoAWQ gemm): ``qweight`` int32 [in, out/8] and ``qzeros``
+    int32 [in/group, out/8], both packed along OUTPUT in AWQ order;
+    ``scales`` fp16 [in/group, out].
+    Dequant: W[i, o] = scales[g, o] * (q[i, o] - z[g, o])."""
+    q = _awq_reorder(_unpack(np.asarray(qweight), 4, axis=1))
+    z = _awq_reorder(_unpack(np.asarray(qzeros), 4, axis=1))
+    in_dim = q.shape[0]
+    gs = group_size if group_size > 0 else in_dim
+    g_idx = np.arange(in_dim, dtype=np.int64) // gs
+    w = (np.asarray(scales, np.float32)[g_idx]
+         * (q.astype(np.float32) - z.astype(np.float32)[g_idx]))
+    return np.ascontiguousarray(w.T)
+
+
+def dequantize_awq_state_dict(tensors: dict, group_size: int) -> dict:
+    return _dequantize_state_dict(
+        tensors, "AWQ", (".qzeros", ".scales"),
+        lambda base: dequantize_awq_layer(
+            tensors[base + ".qweight"], tensors[base + ".qzeros"],
+            tensors[base + ".scales"], group_size))
+
+
+def _dequantize_state_dict(tensors: dict, tag: str,
+                           companions: tuple, dequant_one) -> dict:
+    """Shared packed-linear walker: every ``.qweight`` becomes a plain
+    ``.weight`` via ``dequant_one(base)``; companion tensors of a packed
+    linear are dropped; everything else passes through."""
+    out = {}
+    n = 0
+    for name, val in tensors.items():
+        if name.endswith(".qweight"):
+            base = name[:-len(".qweight")]
+            out[base + ".weight"] = dequant_one(base)
+            n += 1
+        elif name.endswith(companions) and (
+                name.rsplit(".", 1)[0] + ".qweight") in tensors:
+            continue
+        else:
+            out[name] = val
+    logger.info("dequantized %d %s linears on load", n, tag)
+    return out

@@ -159,6 +159,8 @@ class LlamaArchConfig:
     # Full-row q/k RMSNorm before the head reshape (Olmo2) — distinct
     # from the per-head qk_norm.
     qk_norm_full: bool = False
+    # Clamp q/k/v projections to [-clip, clip] (OLMo clip_qkv).
+    qkv_clip: Optional[float] = None
     # Score scale as a direct multiplier (Granite attention_multiplier);
     # overrides the head-dim rule and query_pre_attn_scalar.
     sm_scale_override: Optional[float] = None
@@ -899,6 +901,10 @@ class LlamaForCausalLM:
                 q = q + lp["bq"]
                 k = k + lp["bk"]
                 v = v + lp["bv"]
+            if c.qkv_clip is not None:
+                q = jnp.clip(q, -c.qkv_clip, c.qkv_clip)
+                k = jnp.clip(k, -c.qkv_clip, c.qkv_clip)
+                v = jnp.clip(v, -c.qkv_clip, c.qkv_clip)
             if c.qk_norm_full:
                 # Olmo2: RMSNorm over the whole projection row, before
                 # the head reshape.

@@ -17,6 +17,9 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
+                                                      GlmForCausalLM,
+                                                      OlmoeForCausalLM,
+                                                      OlmoForCausalLM,
                                                       GPTNeoXForCausalLM,
                                                       GraniteForCausalLM,
                                                       NemotronForCausalLM,
@@ -61,6 +64,9 @@ _REGISTRY: dict[str, type] = {
     "CohereForCausalLM": CohereForCausalLM,
     "Olmo2ForCausalLM": Olmo2ForCausalLM,
     "NemotronForCausalLM": NemotronForCausalLM,
+    "OlmoForCausalLM": OlmoForCausalLM,
+    "OlmoeForCausalLM": OlmoeForCausalLM,
+    "GlmForCausalLM": GlmForCausalLM,
 }
 
 
