@@ -74,7 +74,7 @@ def test_awq_checkpoint_matches_plain_engine(tmp_path_factory):
                       num_attention_heads=4, num_key_value_heads=2,
                       max_position_embeddings=64, eos_token_id=1)
     hf = HFLlama(cfg).eval()
-    sd = {k: v.numpy() for k, v in hf.state_dict.__call__().items()}
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
 
     packed_sd, plain_sd = {}, {}
     quant_suffixes = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
@@ -83,8 +83,7 @@ def test_awq_checkpoint_matches_plain_engine(tmp_path_factory):
     for name, w in sd.items():
         if name.endswith(quant_suffixes):
             packed, deq = quantize_awq(np.asarray(w, np.float32))
-            base = name[:-len(".weight")] if False else name.rsplit(
-                ".weight", 1)[0]
+            base = name.rsplit(".weight", 1)[0]
             for suffix, t in packed.items():
                 packed_sd[f"{base}.{suffix}"] = t
             plain_sd[name] = deq.astype(np.float32)

@@ -222,3 +222,25 @@ def test_subblock_mm_prompt_does_not_poison_prefix_cache(llava_checkpoint):
                  [(prompt, {"image_embeds": f2})], "sb4", max_tokens=10)
     assert a == a0
     assert b == b0
+
+
+def test_pixel_values_through_in_engine_vision_tower(llava_checkpoint):
+    """PIXELS in: the in-engine CLIP tower + projector (multimodal/
+    vision.py) must reproduce HF llava generate from raw pixel_values —
+    no client-side feature extraction."""
+    path, hf = llava_checkpoint
+    torch.manual_seed(7)
+    pixel = torch.randn(1, 3, 16, 16)
+    n_img = _features(hf, pixel).shape[0]
+    prompt = [3, 17, IMG, 45, 8]
+    hf_ids = [3, 17] + [IMG] * n_img + [45, 8]
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=torch.tensor([hf_ids]), pixel_values=pixel,
+            max_new_tokens=6, do_sample=False)
+    want = hf_out[0].tolist()[len(hf_ids):]
+
+    engine = make_engine(path)
+    (got, ) = run(engine, [(prompt,
+                            {"pixel_values": pixel.numpy()})], "pix")
+    assert got == want

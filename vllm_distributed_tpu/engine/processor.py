@@ -135,6 +135,26 @@ class Processor:
             mm_inputs=mm_inputs,
         )
 
+    def _encode_pixels(self, pixel_values) -> list:
+        """Run the in-engine vision tower at admission (reference: the
+        encoder pass of gpu_model_runner._execute_mm_encoder; here the
+        tower runs client-side once per request, feeding the same
+        embedding path the scheduler budgets)."""
+        import numpy as np
+        if not hasattr(self, "_vision_encoder"):
+            from vllm_distributed_tpu.multimodal.vision import \
+                build_vision_encoder
+            self._vision_encoder = build_vision_encoder(
+                self.config.model_config.model,
+                self.config.model_config.maybe_load_hf_config())
+        if self._vision_encoder is None:
+            raise ValueError(
+                "this model has no supported vision tower; pass "
+                "pre-computed image_embeds instead")
+        if isinstance(pixel_values, (list, tuple)):
+            pixel_values = np.stack([np.asarray(p) for p in pixel_values])
+        return self._vision_encoder.encode(pixel_values)
+
     def _process_mm(self, multi_modal_data: dict,
                     prompt_token_ids: list[int]):
         """Validate image embeddings and expand prompt placeholders
@@ -151,12 +171,19 @@ class Processor:
                 "image inputs under pipeline parallelism are not wired "
                 "yet (the staged embed path does not apply embedding "
                 "overrides); disable one")
-        unknown = set(multi_modal_data) - {"image_embeds"}
+        unknown = set(multi_modal_data) - {"image_embeds", "pixel_values"}
         if unknown:
             raise ValueError(
                 f"unsupported multi_modal_data keys {sorted(unknown)}; "
-                "this engine accepts pre-computed 'image_embeds'")
-        images = multi_modal_data["image_embeds"]
+                "this engine accepts 'image_embeds' (pre-computed) or "
+                "'pixel_values' (encoded by the in-engine vision tower)")
+        if "pixel_values" in multi_modal_data:
+            if "image_embeds" in multi_modal_data:
+                raise ValueError(
+                    "pass either pixel_values or image_embeds, not both")
+            images = self._encode_pixels(multi_modal_data["pixel_values"])
+        else:
+            images = multi_modal_data["image_embeds"]
         if isinstance(images, (list, tuple)):
             images = [np.asarray(im) for im in images]
         else:

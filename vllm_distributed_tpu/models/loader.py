@@ -33,8 +33,15 @@ def _dtype_from_str(name: str):
     }[name]
 
 
-def load_hf_state_dict(model_path: str) -> dict[str, np.ndarray]:
-    """Read every tensor of a local HF checkpoint into numpy."""
+def load_hf_state_dict(model_path: str,
+                       prefixes: tuple = ()) -> dict[str, np.ndarray]:
+    """Read a local HF checkpoint into numpy; with ``prefixes``, only
+    tensors whose name starts with one of them (partial reads keep the
+    vision-tower load off the full-checkpoint path)."""
+
+    def want(name: str) -> bool:
+        return not prefixes or name.startswith(prefixes)
+
     st_files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
     tensors: dict[str, np.ndarray] = {}
     if st_files:
@@ -42,13 +49,14 @@ def load_hf_state_dict(model_path: str) -> dict[str, np.ndarray]:
         for path in st_files:
             with safe_open(path, framework="np") as f:
                 for name in f.keys():
-                    tensors[name] = f.get_tensor(name)
+                    if want(name):
+                        tensors[name] = f.get_tensor(name)
         return tensors
     bin_path = os.path.join(model_path, "pytorch_model.bin")
     if os.path.exists(bin_path):
         import torch
         sd = torch.load(bin_path, map_location="cpu", weights_only=True)
-        return {k: v.float().numpy() for k, v in sd.items()}
+        return {k: v.float().numpy() for k, v in sd.items() if want(k)}
     raise FileNotFoundError(
         f"no safetensors/pytorch_model.bin under {model_path}")
 

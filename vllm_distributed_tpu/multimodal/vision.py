@@ -1,0 +1,196 @@
+"""In-engine CLIP vision tower + projector for llava-style models.
+
+Reference: the vision encoder path of vllm/model_executor/models/
+llava.py + clip.py (CLIPVisionModel run inside the engine,
+get_image_features -> multi_modal_projector). Functional JAX
+implementation: pixel inputs are encoded at ADMISSION (the processor),
+producing the same pre-computed embedding rows the rest of the
+multimodal path already handles — the engine core, scheduler budget and
+runner substitution are identical for pixels and embeddings.
+
+The tower runs under jit on the default backend; image batches are tiny
+next to decode traffic, and encoding at admission (not per step) mirrors
+the reference's encoder-cache design.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTS = {
+    "quick_gelu": _quick_gelu,
+    "gelu": functools.partial(jax.nn.gelu, approximate=False),
+    "gelu_new": functools.partial(jax.nn.gelu, approximate=True),
+    "gelu_pytorch_tanh": functools.partial(jax.nn.gelu, approximate=True),
+}
+
+
+def _ln(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+class ClipVisionEncoder:
+    """CLIP vision tower + llava projector from a llava checkpoint."""
+
+    def __init__(self, tensors: dict, hf_config) -> None:
+        vc = hf_config.vision_config
+        self.patch = vc.patch_size
+        self.image_size = vc.image_size
+        self.heads = vc.num_attention_heads
+        self.eps = getattr(vc, "layer_norm_eps", 1e-5)
+        self.act = _ACTS[getattr(vc, "hidden_act", "quick_gelu")]
+        # The llava PROJECTOR has its own activation (default exact
+        # gelu) — distinct from the tower's quick_gelu.
+        self.proj_act = _ACTS[getattr(hf_config, "projector_hidden_act",
+                                      "gelu")]
+        # Llava selection: hidden state index (-2 = features after the
+        # second-to-last layer) and CLS handling.
+        self.feature_layer = getattr(hf_config, "vision_feature_layer",
+                                     -2)
+        self.drop_cls = getattr(hf_config,
+                                "vision_feature_select_strategy",
+                                "default") == "default"
+        self.params = self._load(tensors, vc.num_hidden_layers)
+        self._fn = jax.jit(self._forward)
+
+    # ------------------------------------------------------------------
+    def _load(self, tensors: dict, L: int) -> dict:
+        def t(name, prefix=True):
+            for cand in (f"model.vision_tower.vision_model.{name}",
+                         f"vision_tower.vision_model.{name}"):
+                if cand in tensors:
+                    return jnp.asarray(np.asarray(tensors[cand]),
+                                       jnp.float32)
+            raise KeyError(name)
+
+        def stack(fmt, transpose=False):
+            mats = [np.asarray(t(fmt.format(i))) for i in range(L)]
+            return jnp.asarray(
+                np.stack([m.T if transpose else m for m in mats]))
+
+        E = "encoder.layers.{}."
+        params = {
+            "patch": t("embeddings.patch_embedding.weight"),
+            "cls": t("embeddings.class_embedding"),
+            "pos": t("embeddings.position_embedding.weight"),
+            "pre_ln_w": t("pre_layrnorm.weight"),
+            "pre_ln_b": t("pre_layrnorm.bias"),
+            "ln1_w": stack(E + "layer_norm1.weight"),
+            "ln1_b": stack(E + "layer_norm1.bias"),
+            "ln2_w": stack(E + "layer_norm2.weight"),
+            "ln2_b": stack(E + "layer_norm2.bias"),
+        }
+        for proj in ("q", "k", "v", "out"):
+            params[f"w{proj}"] = stack(
+                E + f"self_attn.{proj}_proj.weight", transpose=True)
+            params[f"b{proj}"] = stack(E + f"self_attn.{proj}_proj.bias")
+        params["fc1"] = stack(E + "mlp.fc1.weight", transpose=True)
+        params["fc1_b"] = stack(E + "mlp.fc1.bias")
+        params["fc2"] = stack(E + "mlp.fc2.weight", transpose=True)
+        params["fc2_b"] = stack(E + "mlp.fc2.bias")
+
+        def p(name):
+            for cand in (f"model.multi_modal_projector.{name}",
+                         f"multi_modal_projector.{name}"):
+                if cand in tensors:
+                    return jnp.asarray(np.asarray(tensors[cand]),
+                                       jnp.float32)
+            raise KeyError(name)
+
+        params["proj1"] = p("linear_1.weight").T
+        params["proj1_b"] = p("linear_1.bias")
+        params["proj2"] = p("linear_2.weight").T
+        params["proj2_b"] = p("linear_2.bias")
+        return params
+
+    # ------------------------------------------------------------------
+    def _forward(self, params: dict, pixels: jax.Array) -> jax.Array:
+        """[N, 3, S, S] -> [N, n_tokens, H_text]."""
+        N = pixels.shape[0]
+        # Patch embed: conv with stride=kernel=patch, no bias.
+        feat = jax.lax.conv_general_dilated(
+            pixels.astype(jnp.float32), params["patch"],
+            window_strides=(self.patch, self.patch), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        H = feat.shape[1]
+        feat = feat.reshape(N, H, -1).transpose(0, 2, 1)  # [N, P, H]
+        cls = jnp.broadcast_to(params["cls"], (N, 1, H))
+        h = jnp.concatenate([cls, feat], axis=1) + params["pos"][None]
+        h = _ln(h, params["pre_ln_w"], params["pre_ln_b"], self.eps)
+
+        L = params["ln1_w"].shape[0]
+        # vision_feature_layer indexes the hidden-states tuple
+        # (embeddings first): -2 means stop after layer L-2.
+        fl = self.feature_layer
+        stop = fl + 1 + L if fl < 0 else fl
+        nh = self.heads
+        scale = (H // nh) ** -0.5
+
+        def layer(h, i):
+            x = _ln(h, params["ln1_w"][i], params["ln1_b"][i], self.eps)
+            T = x.shape[1]
+            q = (x @ params["wq"][i] + params["bq"][i]) * scale
+            k = x @ params["wk"][i] + params["bk"][i]
+            v = x @ params["wv"][i] + params["bv"][i]
+            q = q.reshape(N, T, nh, -1).transpose(0, 2, 1, 3)
+            k = k.reshape(N, T, nh, -1).transpose(0, 2, 1, 3)
+            v = v.reshape(N, T, nh, -1).transpose(0, 2, 1, 3)
+            a = jax.nn.softmax(q @ k.transpose(0, 1, 3, 2), axis=-1)
+            o = (a @ v).transpose(0, 2, 1, 3).reshape(N, T, H)
+            h = h + (o @ params["wout"][i] + params["bout"][i])
+            x2 = _ln(h, params["ln2_w"][i], params["ln2_b"][i], self.eps)
+            m = self.act(x2 @ params["fc1"][i] + params["fc1_b"][i])
+            h = h + (m @ params["fc2"][i] + params["fc2_b"][i])
+            return h
+
+        for i in range(stop):
+            h = layer(h, i)
+        if self.drop_cls:
+            h = h[:, 1:]
+        h = self.proj_act(h @ params["proj1"] + params["proj1_b"])
+        return h @ params["proj2"] + params["proj2_b"]
+
+    def encode(self, pixel_values: np.ndarray) -> list[np.ndarray]:
+        """[N, 3, S, S] pixels -> one [n_tokens, H_text] array per
+        image (the projector output the mm path substitutes)."""
+        pixels = np.asarray(pixel_values, np.float32)
+        if pixels.ndim == 3:
+            pixels = pixels[None]
+        out = np.asarray(self._fn(self.params, jnp.asarray(pixels)))
+        return [out[i] for i in range(out.shape[0])]
+
+
+def build_vision_encoder(model_path: str,
+                         hf_config) -> Optional[ClipVisionEncoder]:
+    """Load the vision tower from the checkpoint; None when the model
+    has no (supported) tower."""
+    if getattr(hf_config, "vision_config", None) is None:
+        return None
+    if hf_config.vision_config.model_type not in ("clip_vision_model", ):
+        logger.warning("unsupported vision tower %s; pixel inputs "
+                       "disabled (pass image_embeds instead)",
+                       hf_config.vision_config.model_type)
+        return None
+    from vllm_distributed_tpu.models.loader import load_hf_state_dict
+    # Only the tower + projector tensors — not a second full-checkpoint
+    # read on the admission path.
+    tensors = load_hf_state_dict(
+        model_path, prefixes=("vision_tower.", "model.vision_tower.",
+                              "multi_modal_projector.",
+                              "model.multi_modal_projector."))
+    return ClipVisionEncoder(tensors, hf_config)
