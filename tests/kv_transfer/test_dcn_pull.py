@@ -290,3 +290,28 @@ def test_large_pull_applies_in_chunks_without_stalling_a_step(
     # 7 pulled pages with a 2-page budget: at least 4 steps, and no
     # step ever applied more than the chunk bound.
     assert 0 < conn.max_pages_applied_per_step <= 2
+
+
+def test_async_pull_under_pipeline_parallelism(checkpoint):
+    """Disaggregated prefill with pp=2 on both sides (BASELINE config
+    #5 shape): wire pages span all stages' layer slices; parity with a
+    plain pp=2 engine."""
+    pp = dict(pipeline_parallel_size=2)
+    baseline = [o.outputs[0].token_ids
+                for o in run(make_engine(checkpoint, **pp), PROMPTS,
+                             "ppbase")]
+
+    producer = make_engine(checkpoint, role="kv_producer", **pp)
+    prod_outs = run(producer, PROMPTS, "ppprod", max_tokens=1)
+    params = [o.kv_transfer_params for o in prod_outs]
+    assert all(p is not None for p in params)
+
+    consumer = make_engine(checkpoint, role="kv_consumer", **pp)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    for i, (p, kvp) in enumerate(zip(PROMPTS, params)):
+        consumer.add_request(f"ppcons-{i}", p, sp, kv_transfer_params=kvp)
+    outs = _pump_until(consumer, producer, "ppcons", len(PROMPTS))
+    got = [o.outputs[0].token_ids for o in outs]
+    assert got == baseline
+    # The pulled span skipped local prefill.
+    assert all(o.num_cached_tokens > 0 for o in outs)

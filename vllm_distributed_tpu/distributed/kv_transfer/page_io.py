@@ -14,15 +14,42 @@ def _replication(runner) -> int:
     return getattr(runner.model.cfg, "num_kv_head_replicas", 1)
 
 
+def _stage_views(runner):
+    """[(cache_dict, (layer_lo, layer_hi), store)] — one entry for the
+    flat runner, one per stage for the pipeline-parallel runner (whose
+    kv_caches is a LIST of per-stage slices; the wire layout is always
+    the full [L_total, ...] stack, so connectors stay PP-agnostic)."""
+    kv = runner.kv_caches
+    if isinstance(kv, list):
+        ranges = runner.layer_ranges
+
+        def store(idx):
+            def put(new):
+                runner.kv_caches[idx] = new
+            return put
+
+        return [(kv[p], ranges[p], store(p)) for p in range(len(kv))]
+
+    def put(new):
+        runner.kv_caches = new
+
+    return [(kv, (0, kv["k"].shape[0]), put)]
+
+
 def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
     """Read pages out of the device cache as host numpy in wire layout:
-    [L, n_pages, KVH_checkpoint, page_size, head_dim]."""
+    [L, n_pages, KVH_checkpoint, page_size, head_dim] (stages
+    concatenated on the layer dim under pipeline parallelism)."""
     import jax
     pages = np.asarray(page_ids, np.int32)
     r = _replication(runner)
-    k = np.asarray(jax.device_get(runner.kv_caches["k"][:, pages]))[:, :, ::r]
-    v = np.asarray(jax.device_get(runner.kv_caches["v"][:, pages]))[:, :, ::r]
-    return k, v
+    # Dispatch every stage's gather before fetching any: the N
+    # device->host copies are independent and overlap.
+    slices = [(cache["k"][:, pages], cache["v"][:, pages])
+              for cache, _, _ in _stage_views(runner)]
+    ks = [np.asarray(jax.device_get(k))[:, :, ::r] for k, _ in slices]
+    vs = [np.asarray(jax.device_get(v))[:, :, ::r] for _, v in slices]
+    return np.concatenate(ks, axis=0), np.concatenate(vs, axis=0)
 
 
 def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
@@ -32,12 +59,12 @@ def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
     donated away by the next jitted step)."""
     pages = np.asarray(page_ids, np.int32)
     k, v = stage_pages(runner, k, v, on_device=False)
-    k_all = runner.kv_caches["k"]
-    v_all = runner.kv_caches["v"]
-    runner.kv_caches = {
-        "k": k_all.at[:, pages].set(k.astype(k_all.dtype)),
-        "v": v_all.at[:, pages].set(v.astype(v_all.dtype)),
-    }
+    for cache, (lo, hi), put in _stage_views(runner):
+        k_all, v_all = cache["k"], cache["v"]
+        put({
+            "k": k_all.at[:, pages].set(k[lo:hi].astype(k_all.dtype)),
+            "v": v_all.at[:, pages].set(v[lo:hi].astype(v_all.dtype)),
+        })
 
 
 _scatter_donated_fn = None  # built lazily (module import stays jax-free)
@@ -88,14 +115,15 @@ def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
     scatter; page id padding (for the fixed chunk shape) drops."""
     import jax.numpy as jnp
     n = len(page_ids)
-    num_pages = runner.kv_caches["k"].shape[1]
-    ids = np.full((chunk, ), num_pages, np.int32)
     take = min(chunk, n - lo)
-    ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
-    k_all, v_all = runner.kv_caches["k"], runner.kv_caches["v"]
-    pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
-    k_c = jnp.pad(k_dev[:, lo:lo + take], pad)
-    v_c = jnp.pad(v_dev[:, lo:lo + take], pad)
-    k_new, v_new = _scatter_donated()(k_all, v_all, jnp.asarray(ids),
-                                      k_c, v_c)
-    runner.kv_caches = {"k": k_new, "v": v_new}
+    for cache, (llo, lhi), put in _stage_views(runner):
+        k_all, v_all = cache["k"], cache["v"]
+        num_pages = k_all.shape[1]
+        ids = np.full((chunk, ), num_pages, np.int32)
+        ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
+        pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
+        k_c = jnp.pad(k_dev[llo:lhi, lo:lo + take], pad)
+        v_c = jnp.pad(v_dev[llo:lhi, lo:lo + take], pad)
+        k_new, v_new = _scatter_donated()(k_all, v_all,
+                                          jnp.asarray(ids), k_c, v_c)
+        put({"k": k_new, "v": v_new})

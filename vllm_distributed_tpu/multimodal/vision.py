@@ -70,13 +70,17 @@ class ClipVisionEncoder:
 
     # ------------------------------------------------------------------
     def _load(self, tensors: dict, L: int) -> dict:
-        def t(name, prefix=True):
-            for cand in (f"model.vision_tower.vision_model.{name}",
-                         f"vision_tower.vision_model.{name}"):
-                if cand in tensors:
-                    return jnp.asarray(np.asarray(tensors[cand]),
-                                       jnp.float32)
+        def lookup(bases, name):
+            for base in bases:
+                for wrap in ("model.", ""):
+                    cand = f"{wrap}{base}.{name}"
+                    if cand in tensors:
+                        return jnp.asarray(np.asarray(tensors[cand]),
+                                           jnp.float32)
             raise KeyError(name)
+
+        def t(name):
+            return lookup(("vision_tower.vision_model", ), name)
 
         def stack(fmt, transpose=False):
             mats = [np.asarray(t(fmt.format(i))) for i in range(L)]
@@ -105,12 +109,7 @@ class ClipVisionEncoder:
         params["fc2_b"] = stack(E + "mlp.fc2.bias")
 
         def p(name):
-            for cand in (f"model.multi_modal_projector.{name}",
-                         f"multi_modal_projector.{name}"):
-                if cand in tensors:
-                    return jnp.asarray(np.asarray(tensors[cand]),
-                                       jnp.float32)
-            raise KeyError(name)
+            return lookup(("multi_modal_projector", ), name)
 
         params["proj1"] = p("linear_1.weight").T
         params["proj1_b"] = p("linear_1.bias")

@@ -38,10 +38,6 @@ class PPModelRunner(TPUModelRunner):
         super().__init__(config, mesh, model, params)
         self.pp = config.parallel_config.pipeline_parallel_size
         assert self.pp > 1
-        if self.kv_connector is not None:
-            raise NotImplementedError(
-                "KV transfer with pipeline parallelism needs per-stage "
-                "cache routing in the connector; not wired yet")
         self.stage_meshes = [stage_submesh(mesh, p) for p in range(self.pp)]
         self.layer_ranges: Optional[list[tuple[int, int]]] = None
         self.stage_params: list[dict] = []
