@@ -244,3 +244,14 @@ def test_v3_yarn_mscale_matches_hf(tmp_path_factory):
     got = run(make_engine(path), PROMPTS, "ds3y")
     want = [hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_v2_pallas_backend_matches_hf(v2_checkpoint, monkeypatch):
+    """End to end on the Pallas backend (interpret): the latent kernel
+    (ops/pallas_mla.py) carries the MLA attention."""
+    monkeypatch.setenv("VDT_ATTENTION_BACKEND", "pallas")
+    monkeypatch.setenv("VDT_PALLAS_INTERPRET", "1")
+    path, hf = v2_checkpoint
+    got = run(make_engine(path, block_size=8), PROMPTS, "dspl")
+    want = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

@@ -1,0 +1,62 @@
+"""Pallas MLA (latent MQA) kernel vs the XLA reference path
+(ops/pallas_mla.py vs ops/mla.ragged_latent_attention), interpret mode."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vllm_distributed_tpu.ops.mla import ragged_latent_attention
+from vllm_distributed_tpu.ops.pallas_mla import \
+    ragged_latent_attention_pallas
+
+
+@pytest.mark.parametrize("max_q", [1, 8])
+def test_kernel_matches_xla_reference(max_q):
+    rng = np.random.default_rng(0)
+    N, Lkv, R_dim, PS = 4, 32, 8, 8
+    num_pages, PPR = 16, 4
+    L = 2
+    layer = 1
+    kdim = Lkv + R_dim
+
+    # Two sequences: a decode row and (for max_q=8) a prefill chunk.
+    if max_q == 1:
+        runs = [(0, 1, 13, 0), (1, 1, 7, 1)]   # (q_start, q_len, kv, row)
+        T = 2
+    else:
+        runs = [(0, 6, 14, 0), (6, 1, 9, 1)]
+        T = 7
+    T_pad = T + max_q
+
+    c_pages = jnp.asarray(
+        rng.standard_normal((L, num_pages, PS, kdim)).astype(np.float32))
+    bt = np.zeros((4, PPR), np.int32)
+    bt[0, :PPR] = [3, 5, 7, 9]
+    bt[1, :PPR] = [2, 4, 6, 8]
+    ql = rng.standard_normal((T_pad, N, Lkv)).astype(np.float32)
+    qpe = rng.standard_normal((T_pad, N, R_dim)).astype(np.float32)
+
+    req_idx, q_pos = [], []
+    for (qs, qlen, kv, row) in runs:
+        for j in range(qlen):
+            req_idx.append(row)
+            q_pos.append(kv - qlen + j)
+    want = ragged_latent_attention(
+        jnp.asarray(ql[:T]), jnp.asarray(qpe[:T]), c_pages[layer],
+        jnp.asarray(bt), jnp.asarray(req_idx, jnp.int32),
+        jnp.asarray(q_pos, jnp.int32), sm_scale=0.25,
+        kv_lora_rank=Lkv, rope_dim=R_dim)
+
+    seq_info = np.zeros((4, 4), np.int32)
+    for i, r in enumerate(runs):
+        seq_info[i] = r
+    qc = jnp.concatenate([jnp.asarray(ql), jnp.asarray(qpe)], axis=-1)
+    got = ragged_latent_attention_pallas(
+        qc, c_pages, jnp.asarray(seq_info),
+        jnp.asarray([len(runs)], jnp.int32), jnp.asarray(bt),
+        jnp.asarray([layer], jnp.int32), sm_scale=0.25, max_q=max_q,
+        kv_lora_rank=Lkv, rope_dim=R_dim, interpret=True)
+
+    np.testing.assert_allclose(np.asarray(got[:T, :, :Lkv]),
+                               np.asarray(want), rtol=2e-5, atol=2e-5)

@@ -116,14 +116,18 @@ def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
     import jax.numpy as jnp
     n = len(page_ids)
     take = min(chunk, n - lo)
-    for cache, (llo, lhi), put in _stage_views(runner):
+    views = _stage_views(runner)
+    # Every stage shares the pool geometry; build the padded id vector
+    # (out-of-range sentinel drops) and upload it once.
+    num_pages = views[0][0]["k"].shape[1]
+    ids = np.full((chunk, ), num_pages, np.int32)
+    ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
+    ids_dev = jnp.asarray(ids)
+    pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
+    for cache, (llo, lhi), put in views:
         k_all, v_all = cache["k"], cache["v"]
-        num_pages = k_all.shape[1]
-        ids = np.full((chunk, ), num_pages, np.int32)
-        ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
-        pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
         k_c = jnp.pad(k_dev[llo:lhi, lo:lo + take], pad)
         v_c = jnp.pad(v_dev[llo:lhi, lo:lo + take], pad)
-        k_new, v_new = _scatter_donated()(k_all, v_all,
-                                          jnp.asarray(ids), k_c, v_c)
+        k_new, v_new = _scatter_donated()(k_all, v_all, ids_dev,
+                                          k_c, v_c)
         put({"k": k_new, "v": v_new})

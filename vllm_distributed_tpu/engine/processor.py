@@ -144,16 +144,12 @@ class Processor:
         if not hasattr(self, "_vision_encoder"):
             from vllm_distributed_tpu.multimodal.vision import \
                 build_vision_encoder
-            try:
-                self._vision_encoder = build_vision_encoder(
-                    self.config.model_config.model,
-                    self.config.model_config.maybe_load_hf_config())
-            except KeyError as e:
-                # Admission failures are ValueErrors by contract.
-                raise ValueError(
-                    f"vision tower tensor {e} not found in the "
-                    "checkpoint (unsupported naming variant); pass "
-                    "pre-computed image_embeds instead") from e
+            # build_vision_encoder raises ValueError for every
+            # admission-level failure (missing tensors, unsupported
+            # activations) — the contract of this path.
+            self._vision_encoder = build_vision_encoder(
+                self.config.model_config.model,
+                self.config.model_config.maybe_load_hf_config())
         if self._vision_encoder is None:
             raise ValueError(
                 "this model has no supported vision tower; pass "

@@ -37,6 +37,14 @@ _ACTS = {
 }
 
 
+def _lookup_act(name: str):
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported vision activation {name!r}") from None
+
+
 def _ln(x, w, b, eps):
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
@@ -53,11 +61,11 @@ class ClipVisionEncoder:
         self.image_size = vc.image_size
         self.heads = vc.num_attention_heads
         self.eps = getattr(vc, "layer_norm_eps", 1e-5)
-        self.act = _ACTS[getattr(vc, "hidden_act", "quick_gelu")]
+        self.act = _lookup_act(getattr(vc, "hidden_act", "quick_gelu"))
         # The llava PROJECTOR has its own activation (default exact
         # gelu) — distinct from the tower's quick_gelu.
-        self.proj_act = _ACTS[getattr(hf_config, "projector_hidden_act",
-                                      "gelu")]
+        self.proj_act = _lookup_act(
+            getattr(hf_config, "projector_hidden_act", "gelu"))
         # Llava selection: hidden state index (-2 = features after the
         # second-to-last layer) and CLS handling.
         self.feature_layer = getattr(hf_config, "vision_feature_layer",
@@ -77,7 +85,10 @@ class ClipVisionEncoder:
                     if cand in tensors:
                         return jnp.asarray(np.asarray(tensors[cand]),
                                            jnp.float32)
-            raise KeyError(name)
+            raise ValueError(
+                f"vision tower tensor {name!r} not found in the "
+                "checkpoint (unsupported naming variant); pass "
+                "pre-computed image_embeds instead")
 
         def t(name):
             return lookup(("vision_tower.vision_model", ), name)

@@ -119,9 +119,12 @@ def latent_attention(q_absorbed, q_pe, c_all, batch, *, sm_scale,
                      kv_lora_rank, rope_dim, layer=None):
     """Model-facing entry: select the layer's page slab and run the
     ragged latent attention (the MLA analogue of ops/attention.
-    paged_attention). Token parallelism is rejected upstream by the
-    loader; there is no Pallas variant yet, so every backend takes this
-    XLA path."""
+    paged_attention). Dispatches to the Pallas latent kernel
+    (ops/pallas_mla.py) on the pallas backend; the XLA scan is the
+    correctness reference and CPU fallback. Token parallelism is
+    rejected upstream by the loader."""
+    from vllm_distributed_tpu.ops.attention import \
+        resolve_attention_backend
     if getattr(batch, "tknp", None) is not None:
         raise NotImplementedError(
             "MLA under token parallelism (per-rank latent page pools "
@@ -129,6 +132,41 @@ def latent_attention(q_absorbed, q_pe, c_all, batch, *, sm_scale,
             "at admission — this trace-time guard is the backstop)")
     if layer is None:
         layer = jnp.zeros((1, ), jnp.int32)
+    if (resolve_attention_backend() == "pallas"
+            and getattr(batch, "seq_info", None) is not None
+            and c_all.ndim == 4):
+        from vllm_distributed_tpu.ops.pallas_mla import \
+            ragged_latent_attention_pallas
+        qc = jnp.concatenate([q_absorbed, q_pe], axis=-1)
+        Cs = c_all.shape[-1]
+        qc = _pad_last_dim(qc, Cs)
+
+        def call(q_):
+            out = ragged_latent_attention_pallas(
+                q_, c_all, batch.seq_info, batch.num_seqs,
+                batch.block_tables, layer, sm_scale=sm_scale,
+                max_q=batch.max_q, kv_lora_rank=kv_lora_rank,
+                rope_dim=rope_dim)
+            # Rows the kernel never writes are uninitialized HBM; zero
+            # them (padding tokens carry slot -1).
+            valid = (batch.slot_mapping >= 0)[:, None, None]
+            return jnp.where(valid, out[..., :kv_lora_rank], 0)
+
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if mesh_state.has_global_mesh() and mesh_state.tp_size() > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+
+            # q heads shard; the latent cache is MQA-shared and
+            # replicated, so each rank runs the kernel on its head
+            # slice against the full cache.
+            head_spec = P(None, MESH_AXIS_MODEL, None)
+            return jax.shard_map(
+                call, mesh=mesh_state.get_global_mesh(),
+                in_specs=(head_spec, ),
+                out_specs=head_spec, check_vma=False)(qc)
+        return call(qc)
     c_layer = c_all[layer[0]] if c_all.ndim == 4 else c_all
     return ragged_latent_attention(
         q_absorbed, q_pe, c_layer, batch.block_tables, batch.req_idx,
