@@ -134,7 +134,7 @@ def test_second_wave_families_match_hf(family, tmp_path_factory):
     cases = {
         "olmo": (OlmoForCausalLM, OlmoConfig(
             **_COMMON, intermediate_size=128, num_key_value_heads=2,
-            clip_qkv=8.0)),
+            clip_qkv=0.05)),
         "olmoe": (OlmoeForCausalLM, OlmoeConfig(
             **_COMMON, intermediate_size=96, num_key_value_heads=2,
             num_experts=4, num_experts_per_tok=2,
@@ -152,3 +152,29 @@ def test_second_wave_families_match_hf(family, tmp_path_factory):
     got = _run_engine(path, PROMPTS, family)
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want, family
+
+
+def test_gemma3_mixed_rope_bases_match_hf(tmp_path_factory):
+    """Gemma3: sliding layers rope with rope_local_base_freq while full
+    layers use the global theta + linear scaling; sandwich norms and
+    folded (1+w) qk norms."""
+    from transformers import Gemma3ForCausalLM as HFG3
+    from transformers import Gemma3TextConfig
+    torch.manual_seed(0)
+    cfg = Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=64, eos_token_id=1,
+        sliding_window=8, layer_types=[
+            "sliding_attention", "full_attention",
+            "sliding_attention", "full_attention"],
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        query_pre_attn_scalar=16)
+    hf = HFG3(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_gemma3"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "g3")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

@@ -151,6 +151,11 @@ def make_inv_freq(head_dim: int, rope_theta: float,
     vllm/model_executor/layers/rotary_embedding.py Llama3RotaryEmbedding)."""
     inv_freq = 1.0 / (rope_theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    rtype = (rope_scaling or {}).get(
+        "rope_type", (rope_scaling or {}).get("type"))
+    if rope_scaling and rtype == "linear":
+        # Position-interpolation scaling (Gemma3 global layers).
+        inv_freq = inv_freq / rope_scaling["factor"]
     if rope_scaling and rope_scaling.get("rope_type",
                                         rope_scaling.get("type")) == "llama3":
         factor = rope_scaling["factor"]

@@ -189,3 +189,26 @@ class BaichuanForCausalLM(LlamaForCausalLM):
             norms = np.linalg.norm(head, axis=-1, keepdims=True)
             out["lm_head.weight"] = head / np.maximum(norms, 1e-7)
         return super().params_from_hf_state_dict(out)
+
+
+class Gemma3ForCausalLM(Gemma2ForCausalLM):
+    """Gemma 3 text decoder (reference: vllm/model_executor/models/
+    gemma3.py): the Gemma2 sandwich-norm block minus the softcaps, plus
+    per-head qk RMSNorms (gemma-style 1+w weights) and a SEPARATE rope
+    base for sliding layers (rope_local_base_freq) while full layers
+    use the global theta with linear scaling."""
+
+    _NORM_FOLD_KEYS = ("input_ln", "post_ln", "post_attn_ln",
+                       "post_ffw_ln", "q_norm", "k_norm")
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        super().configure_arch(arch, hf)
+        arch.qk_norm = True
+        local = getattr(hf, "rope_local_base_freq", None)
+        if local and any(w == 0 for w in (arch.window_pattern or ())):
+            # Mixed layouts rope sliding layers with the local base.
+            arch.rope_theta_local = float(local)
+        elif local and arch.sliding_window:
+            # All-sliding tiny configs: the local base IS the base.
+            arch.rope_theta = float(local)

@@ -161,6 +161,10 @@ class LlamaArchConfig:
     qk_norm_full: bool = False
     # Clamp q/k/v projections to [-clip, clip] (OLMo clip_qkv).
     qkv_clip: Optional[float] = None
+    # Separate rope base for SLIDING-window layers (Gemma3: local
+    # theta 10k on sliding layers, global theta 1M + scaling on full
+    # layers). None = one table for every layer.
+    rope_theta_local: Optional[float] = None
     # Score scale as a direct multiplier (Granite attention_multiplier);
     # overrides the head-dim rule and query_pre_attn_scalar.
     sm_scale_override: Optional[float] = None
@@ -831,6 +835,14 @@ class LlamaForCausalLM:
             cos, sin = compute_rope_cos_sin(batch.positions, rd,
                                             c.rope_theta, c.rope_scaling,
                                             dtype=jnp.float32)
+        if c.rope_theta_local is not None:
+            # Gemma3: sliding layers rope with the LOCAL base and no
+            # scaling; full layers keep the global table above.
+            cos_l, sin_l = compute_rope_cos_sin(
+                batch.positions, rd, c.rope_theta_local, None,
+                dtype=jnp.float32)
+        else:
+            cos_l, sin_l = cos, sin
 
         has_bias = c.attention_bias
 
@@ -869,16 +881,18 @@ class LlamaForCausalLM:
             return jax.lax.with_sharding_constraint(
                 h, sp_sharding if sp_sharding is not None else sp_spec)
 
-        def apply_rotary(x):
+        def apply_rotary(x, local=False):
             """Rope on the first ``rd`` lanes (fp32; partial rotary
-            passes the tail through — GPT-NeoX rotary_pct semantics)."""
+            passes the tail through — GPT-NeoX rotary_pct semantics);
+            ``local`` picks the sliding-layer table (Gemma3)."""
             from vllm_distributed_tpu.models.common import (
                 apply_rope_pairwise, apply_rope_single)
+            cs, sn = (cos_l, sin_l) if local else (cos, sin)
             x32 = x.astype(jnp.float32)
             rot = x32[..., :rd]
-            rot = (apply_rope_pairwise(rot, cos, sin)
+            rot = (apply_rope_pairwise(rot, cs, sn)
                    if c.rope_interleaved else
-                   apply_rope_single(rot, cos, sin))
+                   apply_rope_single(rot, cs, sn))
             if rd == c.head_dim:
                 return rot.astype(c.dtype)
             return jnp.concatenate([rot, x32[..., rd:]],
@@ -917,8 +931,9 @@ class LlamaForCausalLM:
                 q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
                 k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
             v = v.reshape(T, c.total_kv_heads, c.head_dim)
-            q = apply_rotary(q)
-            k = apply_rotary(k)
+            local_rope = bool(window) and c.rope_theta_local is not None
+            q = apply_rotary(q, local=local_rope)
+            k = apply_rotary(k, local=local_rope)
             k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,

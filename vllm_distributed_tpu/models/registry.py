@@ -8,6 +8,7 @@ Llama 2/3, Mistral, Qwen2, and friends.
 
 from vllm_distributed_tpu.models.families import (BaichuanForCausalLM,
                                                   Gemma2ForCausalLM,
+                                                  Gemma3ForCausalLM,
                                                   GemmaForCausalLM,
                                                   InternLM2ForCausalLM,
                                                   Phi3ForCausalLM,
@@ -43,6 +44,7 @@ _REGISTRY: dict[str, type] = {
     "Qwen2MoeForCausalLM": Qwen2MoeForCausalLM,
     "GemmaForCausalLM": GemmaForCausalLM,
     "Gemma2ForCausalLM": Gemma2ForCausalLM,
+    "Gemma3ForCausalLM": Gemma3ForCausalLM,
     "Qwen3ForCausalLM": Qwen3ForCausalLM,
     "Phi3ForCausalLM": Phi3ForCausalLM,
     "InternLM2ForCausalLM": InternLM2ForCausalLM,
