@@ -178,3 +178,28 @@ def test_gemma3_mixed_rope_bases_match_hf(tmp_path_factory):
     got = _run_engine(path, PROMPTS, "g3")
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+@pytest.mark.parametrize("style", ["new", "7b"])
+def test_falcon_matches_hf(style, tmp_path_factory):
+    """Both Falcon generations: new decoder architecture (separate
+    ln_attn/ln_mlp, grouped kv) and 7B-style (shared norm,
+    multi-query)."""
+    from transformers import FalconConfig
+    from transformers import FalconForCausalLM as HFFalcon
+    kw = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+              num_attention_heads=4, eos_token_id=1,
+              parallel_attn=True, bias=False, alibi=False)
+    if style == "new":
+        cfg = FalconConfig(**kw, new_decoder_architecture=True,
+                           num_kv_heads=2)
+    else:
+        cfg = FalconConfig(**kw, new_decoder_architecture=False,
+                           multi_query=True)
+    torch.manual_seed(0)
+    hf = HFFalcon(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_falcon_{style}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, f"falc{style}")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

@@ -358,3 +358,76 @@ class GlmForCausalLM(LlamaForCausalLM):
             out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:half]
             out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[half:]
         return super().params_from_hf_state_dict(out)
+
+
+class FalconForCausalLM(LlamaForCausalLM):
+    """Falcon (reference: models/falcon.py): parallel-residual block —
+    one shared norm for 7B-style checkpoints, separate ln_attn/ln_mlp
+    for the new decoder architecture (40B/180B) — non-gated gelu MLP,
+    grouped fused QKV (q heads of each kv group packed with that
+    group's k and v), multi-query or grouped kv."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if getattr(hf, "alibi", False):
+            raise ValueError("ALiBi Falcon checkpoints (falcon-rw) are "
+                             "not supported (no rotary)")
+        if not getattr(hf, "parallel_attn", True):
+            raise ValueError("sequential-attention Falcon "
+                             "(parallel_attn=false) is not supported")
+        new = bool(getattr(hf, "new_decoder_architecture", False))
+        arch.parallel_block = True
+        arch.shared_block_ln = not new
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        bias = bool(getattr(hf, "bias", False))
+        arch.mlp_bias = bias
+        arch.attention_bias = bias
+        arch.attention_out_bias = bias
+        arch.hidden_act = "gelu"
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_epsilon",
+                                          1e-5))
+        if new:
+            arch.num_kv_heads = int(hf.num_kv_heads)
+        elif getattr(hf, "multi_query", True):
+            arch.num_kv_heads = 1
+        arch.tie_word_embeddings = False
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        D, H = c.head_dim, c.hidden_size
+        G = c.num_kv_heads
+        qpg = c.num_q_heads // G
+        out = {}
+        for name, t in tensors.items():
+            name = name.replace("transformer.h.", "model.layers.")
+            name = name.replace("transformer.ln_f.", "model.norm.")
+            name = name.replace("transformer.word_embeddings.",
+                                "model.embed_tokens.")
+            name = name.replace(".self_attention.dense.",
+                                ".self_attn.o_proj.")
+            name = name.replace(".mlp.dense_h_to_4h.", ".mlp.fc1.")
+            name = name.replace(".mlp.dense_4h_to_h.", ".mlp.fc2.")
+            # ln_attn feeds attention (our input_ln); ln_mlp the MLP
+            # (our post_ln); old-style shares input_layernorm.
+            name = name.replace(".ln_attn.", ".input_layernorm.")
+            name = name.replace(".ln_mlp.", ".post_attention_layernorm.")
+            out[name] = t
+        # Grouped fused QKV: per kv group, q_per_group q heads then that
+        # group's k and v (reference: falcon.py _split_heads).
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.self_attention.query_key_value"
+            w = np.asarray(out.pop(base + ".weight"))
+            w = w.reshape(G, qpg + 2, D, H)
+            A = f"model.layers.{i}.self_attn."
+            out[A + "q_proj.weight"] = w[:, :qpg].reshape(-1, H)
+            out[A + "k_proj.weight"] = w[:, qpg].reshape(-1, H)
+            out[A + "v_proj.weight"] = w[:, qpg + 1].reshape(-1, H)
+            if base + ".bias" in out:
+                b = np.asarray(out.pop(base + ".bias")).reshape(
+                    G, qpg + 2, D)
+                out[A + "q_proj.bias"] = b[:, :qpg].reshape(-1)
+                out[A + "k_proj.bias"] = b[:, qpg].reshape(-1)
+                out[A + "v_proj.bias"] = b[:, qpg + 1].reshape(-1)
+        return super().params_from_hf_state_dict(out)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from vllm_distributed_tpu.models.common import (AttentionBatch, apply_rope,
+from vllm_distributed_tpu.models.common import (AttentionBatch,
                                                 compute_rope_cos_sin,
                                                 rms_norm, swiglu)
 from vllm_distributed_tpu.ops.attention import (paged_attention,
@@ -220,7 +220,10 @@ class LlamaArchConfig:
         return cls(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
-            intermediate_size=hf.intermediate_size,
+            intermediate_size=(
+                getattr(hf, "intermediate_size", None)
+                or getattr(hf, "ffn_hidden_size", None)  # Falcon
+                or 4 * hf.hidden_size),
             num_layers=hf.num_hidden_layers,
             num_q_heads=hf.num_attention_heads,
             num_kv_heads=getattr(hf, "num_key_value_heads",

@@ -18,6 +18,7 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
+                                                      FalconForCausalLM,
                                                       GlmForCausalLM,
                                                       OlmoeForCausalLM,
                                                       OlmoForCausalLM,
@@ -69,6 +70,7 @@ _REGISTRY: dict[str, type] = {
     "OlmoForCausalLM": OlmoForCausalLM,
     "OlmoeForCausalLM": OlmoeForCausalLM,
     "GlmForCausalLM": GlmForCausalLM,
+    "FalconForCausalLM": FalconForCausalLM,
 }
 
 
