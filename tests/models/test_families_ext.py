@@ -203,3 +203,22 @@ def test_falcon_matches_hf(style, tmp_path_factory):
     got = _run_engine(path, PROMPTS, f"falc{style}")
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_falcon2_single_ln_new_arch(tmp_path_factory):
+    """Falcon2-11B shape: new_decoder_architecture with ONE shared norm
+    (num_ln_in_parallel_attn=1)."""
+    from transformers import FalconConfig
+    from transformers import FalconForCausalLM as HFFalcon
+    cfg = FalconConfig(vocab_size=128, hidden_size=64,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       eos_token_id=1, parallel_attn=True, bias=False,
+                       alibi=False, new_decoder_architecture=True,
+                       num_kv_heads=2, num_ln_in_parallel_attn=1)
+    torch.manual_seed(0)
+    hf = HFFalcon(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_falcon2"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "falc2")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

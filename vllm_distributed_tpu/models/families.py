@@ -21,6 +21,18 @@ from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 
 
+def split_grouped_qkv(w: "np.ndarray", num_kv_heads: int,
+                      q_per_kv: int, head_dim: int):
+    """Undo the grouped fused-QKV layout shared by InternLM2 and
+    Falcon: per kv group the rows pack q_per_kv query heads, then that
+    group's k head, then its v head. Returns (q, k, v) row blocks."""
+    H = w.shape[-1]
+    g = w.reshape(num_kv_heads, q_per_kv + 2, head_dim, H)
+    return (g[:, :q_per_kv].reshape(-1, H),
+            g[:, q_per_kv].reshape(-1, H),
+            g[:, q_per_kv + 1].reshape(-1, H))
+
+
 class GemmaForCausalLM(LlamaForCausalLM):
 
     # RMSNorm weights stored as offsets from 1 in Gemma checkpoints.
@@ -125,14 +137,10 @@ class InternLM2ForCausalLM(LlamaForCausalLM):
         for i in range(c.num_layers):
             pre = f"model.layers.{i}."
             wqkv = np.asarray(tensors[f"{pre}attention.wqkv.weight"])
-            grouped = wqkv.reshape(c.num_kv_heads, q_per_kv + 2,
-                                   c.head_dim, H)
-            out[f"{pre}self_attn.q_proj.weight"] = \
-                grouped[:, :q_per_kv].reshape(-1, H)
-            out[f"{pre}self_attn.k_proj.weight"] = \
-                grouped[:, q_per_kv].reshape(-1, H)
-            out[f"{pre}self_attn.v_proj.weight"] = \
-                grouped[:, q_per_kv + 1].reshape(-1, H)
+            (out[f"{pre}self_attn.q_proj.weight"],
+             out[f"{pre}self_attn.k_proj.weight"],
+             out[f"{pre}self_attn.v_proj.weight"]) = split_grouped_qkv(
+                wqkv, c.num_kv_heads, q_per_kv, c.head_dim)
             out[f"{pre}self_attn.o_proj.weight"] = \
                 tensors[f"{pre}attention.wo.weight"]
             out[f"{pre}mlp.gate_proj.weight"] = \

@@ -377,7 +377,10 @@ class FalconForCausalLM(LlamaForCausalLM):
                              "(parallel_attn=false) is not supported")
         new = bool(getattr(hf, "new_decoder_architecture", False))
         arch.parallel_block = True
-        arch.shared_block_ln = not new
+        # Falcon2-11B is new-arch but keeps ONE shared norm
+        # (num_ln_in_parallel_attn=1 -> no ln_attn/ln_mlp tensors).
+        arch.shared_block_ln = (not new or getattr(
+            hf, "num_ln_in_parallel_attn", None) == 1)
         arch.norm_type = "layernorm"
         arch.norm_bias = True
         arch.mlp_gated = False
@@ -385,7 +388,9 @@ class FalconForCausalLM(LlamaForCausalLM):
         arch.mlp_bias = bias
         arch.attention_bias = bias
         arch.attention_out_bias = bias
-        arch.hidden_act = "gelu"
+        # HF FalconMLP honors config.activation; _act raises on
+        # anything unmappable instead of silently running gelu.
+        arch.hidden_act = getattr(hf, "activation", "gelu") or "gelu"
         arch.rms_norm_eps = float(getattr(hf, "layer_norm_epsilon",
                                           1e-5))
         if new:
@@ -416,18 +421,19 @@ class FalconForCausalLM(LlamaForCausalLM):
             out[name] = t
         # Grouped fused QKV: per kv group, q_per_group q heads then that
         # group's k and v (reference: falcon.py _split_heads).
+        from vllm_distributed_tpu.models.families import \
+            split_grouped_qkv
         for i in range(c.num_layers):
             base = f"model.layers.{i}.self_attention.query_key_value"
             w = np.asarray(out.pop(base + ".weight"))
-            w = w.reshape(G, qpg + 2, D, H)
             A = f"model.layers.{i}.self_attn."
-            out[A + "q_proj.weight"] = w[:, :qpg].reshape(-1, H)
-            out[A + "k_proj.weight"] = w[:, qpg].reshape(-1, H)
-            out[A + "v_proj.weight"] = w[:, qpg + 1].reshape(-1, H)
+            (out[A + "q_proj.weight"], out[A + "k_proj.weight"],
+             out[A + "v_proj.weight"]) = split_grouped_qkv(
+                w, G, qpg, D)
             if base + ".bias" in out:
-                b = np.asarray(out.pop(base + ".bias")).reshape(
-                    G, qpg + 2, D)
-                out[A + "q_proj.bias"] = b[:, :qpg].reshape(-1)
-                out[A + "k_proj.bias"] = b[:, qpg].reshape(-1)
-                out[A + "v_proj.bias"] = b[:, qpg + 1].reshape(-1)
+                b = np.asarray(out.pop(base + ".bias")).reshape(-1, 1)
+                qb, kb, vb = split_grouped_qkv(b, G, qpg, D)
+                out[A + "q_proj.bias"] = qb.reshape(-1)
+                out[A + "k_proj.bias"] = kb.reshape(-1)
+                out[A + "v_proj.bias"] = vb.reshape(-1)
         return super().params_from_hf_state_dict(out)
