@@ -222,3 +222,22 @@ def test_falcon2_single_ln_new_arch(tmp_path_factory):
     got = _run_engine(path, PROMPTS, "falc2")
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_persimmon_matches_hf(tmp_path_factory):
+    """Persimmon: per-head qk LayerNorms with biases + relu^2 MLP +
+    partial rotary + interleaved fused QKV."""
+    from transformers import PersimmonConfig
+    from transformers import PersimmonForCausalLM as HFPersimmon
+    cfg = PersimmonConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, eos_token_id=1,
+        partial_rotary_factor=0.5, qk_layernorm=True)
+    torch.manual_seed(0)
+    hf = HFPersimmon(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_persimmon"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "persimmon")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

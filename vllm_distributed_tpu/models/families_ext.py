@@ -437,3 +437,60 @@ class FalconForCausalLM(LlamaForCausalLM):
                 out[A + "k_proj.bias"] = kb.reshape(-1)
                 out[A + "v_proj.bias"] = vb.reshape(-1)
         return super().params_from_hf_state_dict(out)
+
+
+class PersimmonForCausalLM(LlamaForCausalLM):
+    """Persimmon (Adept; reference: models/persimmon.py): LayerNorm
+    block with biases, relu^2 non-gated MLP, partial rotary, per-head
+    qk LayerNorms WITH biases, NeoX-style per-head-interleaved fused
+    QKV."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "hidden_act", "relu2")
+        arch.rotary_dim = int(arch.head_dim *
+                              float(getattr(hf, "partial_rotary_factor",
+                                            0.5)))
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
+        if getattr(hf, "qk_layernorm", True):
+            arch.qk_norm = True
+            arch.qk_norm_bias = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        D, H = c.head_dim, c.hidden_size
+        N = c.num_q_heads
+        out = {}
+        for name, t in tensors.items():
+            name = name.replace(".self_attn.dense.", ".self_attn.o_proj.")
+            name = name.replace(".self_attn.q_layernorm.",
+                                ".self_attn.q_norm.")
+            name = name.replace(".self_attn.k_layernorm.",
+                                ".self_attn.k_norm.")
+            name = name.replace("model.final_layernorm.", "model.norm.")
+            name = name.replace(".mlp.dense_h_to_4h.", ".mlp.fc1.")
+            name = name.replace(".mlp.dense_4h_to_h.", ".mlp.fc2.")
+            out[name] = t
+        from vllm_distributed_tpu.models.families import \
+            split_grouped_qkv
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.self_attn.query_key_value"
+            # NeoX-style per-head [q, k, v] triplets = the grouped
+            # layout with one q head per "group".
+            w = np.asarray(out.pop(base + ".weight")).reshape(
+                N, 3, D, H).reshape(N * 3 * D, H)
+            A = f"model.layers.{i}.self_attn."
+            (out[A + "q_proj.weight"], out[A + "k_proj.weight"],
+             out[A + "v_proj.weight"]) = split_grouped_qkv(w, N, 1, D)
+            b = np.asarray(out.pop(base + ".bias")).reshape(-1, 1)
+            qb, kb, vb = split_grouped_qkv(b, N, 1, D)
+            out[A + "q_proj.bias"] = qb.reshape(-1)
+            out[A + "k_proj.bias"] = kb.reshape(-1)
+            out[A + "v_proj.bias"] = vb.reshape(-1)
+        return super().params_from_hf_state_dict(out)

@@ -159,6 +159,9 @@ class LlamaArchConfig:
     # Full-row q/k RMSNorm before the head reshape (Olmo2) — distinct
     # from the per-head qk_norm.
     qk_norm_full: bool = False
+    # Per-head qk norms carry a bias (Persimmon's LayerNorm flavor;
+    # the norm kind follows norm_type).
+    qk_norm_bias: bool = False
     # Clamp q/k/v projections to [-clip, clip] (OLMo clip_qkv).
     qkv_clip: Optional[float] = None
     # Separate rope base for SLIDING-window layers (Gemma3: local
@@ -373,6 +376,9 @@ class LlamaForCausalLM:
                 "q_norm": P(None, None),
                 "k_norm": P(None, None),
             })
+            if c.qk_norm_bias:
+                layer.update({"q_norm_b": P(None, None),
+                              "k_norm_b": P(None, None)})
         if c.extra_layer_norms:
             layer.update({
                 "post_attn_ln": P(None, None),
@@ -502,6 +508,11 @@ class LlamaForCausalLM:
                 "q_norm": jnp.ones((L, c.head_dim), c.dtype),
                 "k_norm": jnp.ones((L, c.head_dim), c.dtype),
             })
+            if c.qk_norm_bias:
+                layers.update({
+                    "q_norm_b": jnp.zeros((L, c.head_dim), c.dtype),
+                    "k_norm_b": jnp.zeros((L, c.head_dim), c.dtype),
+                })
         if c.qk_norm_full:
             layers.update({
                 "q_norm": jnp.ones((L, Dq), c.dtype),
@@ -656,6 +667,15 @@ class LlamaForCausalLM:
                 "k_norm": stack("model.layers.{}.self_attn.k_norm.weight",
                                 transpose=False),
             })
+            if c.qk_norm_bias:
+                layers.update({
+                    "q_norm_b": stack(
+                        "model.layers.{}.self_attn.q_norm.bias",
+                        transpose=False),
+                    "k_norm_b": stack(
+                        "model.layers.{}.self_attn.k_norm.bias",
+                        transpose=False),
+                })
         if c.extra_layer_norms:
             # Gemma2's 4-norm block renames the roles: HF
             # post_attention_layernorm norms the attention OUTPUT (our
@@ -930,9 +950,10 @@ class LlamaForCausalLM:
             q = q.reshape(T, c.num_q_heads, c.head_dim)
             k = k.reshape(T, c.total_kv_heads, c.head_dim)
             if c.qk_norm:
-                # Qwen3-style per-head RMSNorm ahead of RoPE.
-                q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
-                k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
+                # Per-head norm ahead of RoPE (Qwen3 RMS; Persimmon
+                # LayerNorm+bias via norm_type/qk_norm_bias).
+                q = self._norm(q, lp["q_norm"], lp.get("q_norm_b"))
+                k = self._norm(k, lp["k_norm"], lp.get("k_norm_b"))
             v = v.reshape(T, c.total_kv_heads, c.head_dim)
             local_rope = bool(window) and c.rope_theta_local is not None
             q = apply_rotary(q, local=local_rope)

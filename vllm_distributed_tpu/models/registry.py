@@ -26,6 +26,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       GraniteForCausalLM,
                                                       NemotronForCausalLM,
                                                       Olmo2ForCausalLM,
+                                                      PersimmonForCausalLM,
                                                       PhiForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
@@ -71,6 +72,7 @@ _REGISTRY: dict[str, type] = {
     "OlmoeForCausalLM": OlmoeForCausalLM,
     "GlmForCausalLM": GlmForCausalLM,
     "FalconForCausalLM": FalconForCausalLM,
+    "PersimmonForCausalLM": PersimmonForCausalLM,
 }
 
 
