@@ -280,11 +280,16 @@ class LlamaForCausalLM:
         * "int8": symmetric per-output-channel, scale = absmax/127.
         * "fp8": float8_e4m3fn payloads with the same per-channel
           scaling (absmax mapped to the e4m3 max of 448).
+        * "int4": symmetric per-channel absmax/7 in jnp.int4 — XLA
+          packs int4 two-per-byte in TPU HBM, a native 4-bit weight
+          datapath (a "-GPTQ"/"-AWQ" checkpoint + --quantization int4
+          keeps the 4-bit HBM footprint after the load-time dequant;
+          reference: the W4A16 serving path of quantization/gptq.py).
 
-        Either halves weight HBM; matmuls dequantize at read (XLA fuses
-        convert*scale into the dot's operand load)."""
+        Matmuls dequantize at read (XLA fuses convert*scale into the
+        dot's operand load)."""
         scheme = self.cfg.quantization
-        if scheme not in ("int8", "fp8"):
+        if scheme not in ("int4", "int8", "fp8"):
             return params
         layers = params["layers"]
         for name in self.QUANT_TARGETS:
@@ -298,6 +303,12 @@ class LlamaForCausalLM:
                 q = jnp.asarray(
                     np.clip(np.round(w32 / scale), -127,
                             127).astype(np.int8))
+            elif scheme == "int4":
+                import ml_dtypes
+                scale = np.maximum(absmax / 7.0, 1e-8)
+                q = jnp.asarray(
+                    np.clip(np.round(w32 / scale), -8,
+                            7).astype(ml_dtypes.int4))
             else:
                 import ml_dtypes
                 scale = np.maximum(absmax / 448.0, 1e-8)
@@ -309,7 +320,7 @@ class LlamaForCausalLM:
             layers[name + "_scale"] = jnp.asarray(scale, jnp.float32)
         return params
 
-    _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn)
+    _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn, jnp.int4)
 
     def _w(self, lp: dict, name: str) -> jax.Array:
         """Dequantizing weight accessor: identity for fp weights."""
@@ -432,7 +443,7 @@ class LlamaForCausalLM:
         for name in list(layer):
             if name.endswith("_scale"):
                 del layer[name]
-        if self.cfg.quantization not in ("int8", "fp8"):
+        if self.cfg.quantization not in ("int4", "int8", "fp8"):
             return
         for name in self.QUANT_TARGETS:
             spec = layer.get(name)
