@@ -99,3 +99,48 @@ def test_indivisible_bucket_falls_back(ep_setup, monkeypatch):
         out = np.asarray(model.mlp_block(lp, x7))
     assert out.shape == (7, H)
     assert np.isfinite(out).all()
+
+
+def test_a2a_composes_with_eplb(monkeypatch):
+    """EPLB physical replicas under the all-to-all dispatch must match
+    the replicate+psum path exactly (same global-token replica
+    choice)."""
+    mesh = build_mesh(ParallelConfig(tensor_parallel_size=EP),
+                      devices=jax.devices("cpu")[:EP])
+    cfg = LlamaArchConfig(
+        vocab_size=64, hidden_size=H, intermediate_size=I,
+        num_layers=1, num_q_heads=4, num_kv_heads=4, head_dim=8,
+        num_experts=E, num_experts_per_tok=K, norm_topk_prob=True,
+        num_physical_experts=E + EP,  # one replica slot per rank
+        expert_parallel=True, expert_parallel_ranks=EP,
+        dtype=jnp.float32)
+    model = MixtralForCausalLM(cfg)
+    rng = np.random.default_rng(3)
+    Pn = model.num_physical
+    lp = {
+        "router": jnp.asarray(rng.normal(size=(H, E)), jnp.float32),
+        "w_gate": jnp.asarray(rng.normal(size=(Pn, H, I)) * 0.1,
+                              jnp.float32),
+        "w_up": jnp.asarray(rng.normal(size=(Pn, H, I)) * 0.1,
+                            jnp.float32),
+        "w_down": jnp.asarray(rng.normal(size=(Pn, I, H)) * 0.1,
+                              jnp.float32),
+        # Logical e maps to itself plus one replica at slot E+e%EP...
+        "expert_map": jnp.asarray(
+            np.stack([np.arange(E), E + np.arange(E) % EP],
+                     axis=1).astype(np.int32)),
+        "expert_replicas": jnp.full((E, ), 2, jnp.int32),
+    }
+    # Replicas must hold the SAME weights as their logical expert for
+    # output equality.
+    emap = np.asarray(lp["expert_map"])
+    for e in range(E):
+        for w in ("w_gate", "w_up", "w_down"):
+            lp[w] = lp[w].at[emap[e, 1]].set(lp[w][e])
+    x = jnp.asarray(rng.normal(size=(T, H)), jnp.float32)
+    with global_mesh(mesh), mesh:
+        monkeypatch.setenv("VDT_MOE_EP_MODE", "a2a")
+        got = np.asarray(model.mlp_block(lp, x))
+        monkeypatch.setenv("VDT_MOE_EP_MODE", "replicate")
+        want = np.asarray(model.mlp_block(lp, x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

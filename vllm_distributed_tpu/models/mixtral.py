@@ -287,10 +287,11 @@ class MixtralForCausalLM(LlamaForCausalLM):
 
     def _a2a_applicable(self, T: int) -> bool:
         """The all-to-all dispatch needs the token bucket divisible by
-        the EP width (static per-rank slices), no EPLB physical-replica
-        indirection (replica choice is token-global), and the mode not
-        forced off. Non-applicable cases fall back to the exact
-        replicate+psum path."""
+        the EP width (static per-rank slices) and the mode not forced
+        off; EPLB redundancy composes (the physical-replica indirection
+        runs per rank on its token slice with global token indices).
+        Non-applicable cases fall back to the exact replicate+psum
+        path."""
         from vllm_distributed_tpu import envs
         from vllm_distributed_tpu.parallel import mesh as mesh_state
         if envs.VDT_MOE_EP_MODE != "a2a":
@@ -299,8 +300,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             return False
         ep = mesh_state.get_global_mesh().shape[MODEL_AXIS]
         return (ep > 1 and T % ep == 0
-                and self.num_physical == self.cfg.num_experts
-                and self.cfg.num_experts % ep == 0)
+                and self.num_physical % ep == 0)
 
     def _moe_ep_a2a(self, lp: dict, x: jax.Array, top_idx: jax.Array,
                     top_vals: jax.Array) -> jax.Array:
@@ -326,14 +326,15 @@ class MixtralForCausalLM(LlamaForCausalLM):
         from vllm_distributed_tpu.parallel import mesh as mesh_state
         mesh = mesh_state.get_global_mesh()
         ep = mesh.shape[MODEL_AXIS]
-        E_local = self.cfg.num_experts // ep
+        E_local = self.num_physical // ep
         T = x.shape[0]
         k = top_idx.shape[-1]
         Tl = T // ep
         Rk = Tl * k  # send capacity per destination (worst case)
         H = x.shape[-1]
+        eplb = self.num_physical > self.cfg.num_experts
 
-        def rank_fn(w_gate, w_up, w_down, x_, ti_, tv_):
+        def rank_fn(w_gate, w_up, w_down, x_, ti_, tv_, emap_, erep_):
             r = jax.lax.axis_index(MODEL_AXIS)
             xs = jax.lax.dynamic_slice_in_dim(x_, r * Tl, Tl)
             til = jax.lax.dynamic_slice_in_dim(ti_, r * Tl, Tl)
@@ -341,6 +342,13 @@ class MixtralForCausalLM(LlamaForCausalLM):
             flat_e = til.astype(jnp.int32).reshape(-1)       # [Rk]
             flat_w = tvl.reshape(-1)
             flat_tok = jnp.repeat(jnp.arange(Tl, dtype=jnp.int32), k)
+            if eplb:
+                # EPLB indirection with GLOBAL token indices so replica
+                # spreading matches the replicate-path semantics
+                # (dispatch docstring; eplb_state.py replica choice).
+                gtok = r * Tl + flat_tok
+                choice = gtok % erep_[flat_e]
+                flat_e = emap_[flat_e, choice]
             dest = flat_e // E_local
             order = jnp.argsort(dest, stable=True)
             d_sorted = dest[order]
@@ -388,15 +396,19 @@ class MixtralForCausalLM(LlamaForCausalLM):
             # Re-replicate for the activation-replicated engine.
             return jax.lax.all_gather(out_local, MODEL_AXIS, tiled=True)
 
+        emap = (lp["expert_map"] if eplb else
+                jnp.zeros((1, 1), jnp.int32))
+        erep = (lp["expert_replicas"] if eplb else
+                jnp.ones((1, ), jnp.int32))
         out = jax.shard_map(
             rank_fn, mesh=mesh,
             in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS, None, None),
-                      P(MODEL_AXIS, None, None), P(), P(), P()),
+                      P(MODEL_AXIS, None, None), P(), P(), P(), P(), P()),
             out_specs=P(),
             check_vma=False)(self._w(lp, "w_gate"), self._w(lp, "w_up"),
                              self._w(lp, "w_down"), x,
                              top_idx.astype(jnp.int32),
-                             top_vals.astype(jnp.float32))
+                             top_vals.astype(jnp.float32), emap, erep)
         return out.astype(x.dtype)
 
     def _moe_ep_ragged(self, lp: dict, xs: jax.Array, se: jax.Array,
