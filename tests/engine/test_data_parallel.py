@@ -202,3 +202,60 @@ def test_dp2_mp_replicas_serve_concurrently(checkpoint):
                 f"{load_per_core:.2f}/core)")
     finally:
         engine.shutdown()
+
+
+def test_coordinator_process_routes_and_drains(checkpoint):
+    """The out-of-process DP coordinator (reference: v1/engine/
+    coordinator.py) owns the routing table: admissions balance through
+    it, finishes report back, and the table drains to zero."""
+    path, hf = checkpoint
+    engine = make_engine(path, data_parallel_size=2,
+                         data_parallel_coordinator=True)
+    core = engine.engine_core
+    try:
+        assert core.coordinator is not None
+        sp = SamplingParams(temperature=0.0, max_tokens=4,
+                            ignore_eos=True)
+        for i in range(4):
+            engine.add_request(f"coord-{i}", [3 + i, 17, 92, 45], sp)
+        assert core.coordinator.counts() == [2, 2]
+        assert core.coordinator.engines_running() == [True, True]
+        done = {}
+        for _ in range(200):
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out.outputs[0].token_ids
+            if not engine.has_unfinished_requests():
+                break
+        assert len(done) == 4
+        want = [hf_greedy(hf, [3 + i, 17, 92, 45], 4) for i in range(4)]
+        assert [done[f"coord-{i}"] for i in range(4)] == want
+        assert core.coordinator.counts() == [0, 0]
+        assert core.coordinator.engines_running() == [False, False]
+    finally:
+        core.shutdown()
+
+
+def test_coordinator_aggregates_multiple_reporters():
+    """Two front-end clients share one coordinator: routing reflects the
+    GLOBAL load, not either client's local view."""
+    from vllm_distributed_tpu.engine.coordinator import (
+        DPCoordinatorClient, spawn_coordinator)
+    proc, addr = spawn_coordinator(num_engines=2)
+    a = DPCoordinatorClient(addr)
+    b = DPCoordinatorClient(addr)
+    try:
+        assert a.route() == 0      # [1, 0] after
+        assert b.route() == 1      # [1, 1]
+        assert b.route() == 0      # [2, 1]
+        # Client A finishes its engine-0 request; next global route
+        # must prefer engine 0 again even though B never touched it.
+        a.report(0, -1)            # [1, 1]
+        a.report(0, -1)            # [0, 1]
+        assert b.route() == 0
+        assert a.counts() == [1, 1]
+    finally:
+        a.shutdown_coordinator()
+        a.close()
+        b.close()
+        proc.join(timeout=5)

@@ -214,6 +214,10 @@ class ParallelConfig:
     # This replica's rank under "engine" mode (set by the DP front-end;
     # selects the replica's device slice).
     data_parallel_rank: int = 0
+    # Route DP requests through a separate coordinator PROCESS (the
+    # reference's DPCoordinator, v1/engine/coordinator.py) instead of
+    # front-end-local accounting — the serving-plane scale-out hook.
+    data_parallel_coordinator: bool = False
     # Run the engine core (scheduler + executor busy loop) in its own
     # process with ZMQ transport (reference: EngineCoreProc, core.py:362).
     multiprocess_engine_core: bool = False

@@ -38,6 +38,7 @@ class EngineArgs:
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
     data_parallel_mode: str = "engine"  # engine replicas | mesh axis
+    data_parallel_coordinator: bool = False
     token_parallel_size: int = 1
     enable_expert_parallel: bool = False
     enable_sequence_parallel: bool = False
@@ -106,6 +107,7 @@ class EngineArgs:
                 pipeline_parallel_size=self.pipeline_parallel_size,
                 data_parallel_size=self.data_parallel_size,
                 data_parallel_mode=self.data_parallel_mode,
+                data_parallel_coordinator=self.data_parallel_coordinator,
                 token_parallel_size=self.token_parallel_size,
                 enable_expert_parallel=self.enable_expert_parallel,
                 enable_sequence_parallel=self.enable_sequence_parallel,

@@ -1,0 +1,160 @@
+"""Data-parallel coordinator process.
+
+Reference: vllm/v1/engine/coordinator.py:21 ``DPCoordinator`` — a
+separate process that aggregates per-engine request counts and serves
+them to the front-end balancer(s), so routing state lives outside any
+single API server. This implementation keeps the reference's
+architecture at TPU-appropriate scope: a ZMQ REP loop owning the
+count table; front-ends report +/- deltas on admission/finish and ask
+``route`` for the least-loaded engine. One front-end uses it as an
+out-of-process routing brain (enabled by
+``ParallelConfig.data_parallel_coordinator``); multiple front-ends
+sharing engine procs plug into the same protocol (the counts are
+already globally aggregated — the remaining work is shared engine
+endpoints, not coordination).
+
+The reference's wave-lockstep dummy batches (core.py:929-969) remain
+unnecessary here by construction: expert parallelism spans the model
+mesh axis INSIDE a replica, so an idle replica participates in no
+collective. The coordinator still tracks an ``engines_running`` view
+(count > 0) mirroring the reference's wave state for observability.
+"""
+
+import tempfile
+import threading
+import uuid
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _coordinator_loop(addr: str, num_engines: int) -> None:
+    import zmq
+
+    from vllm_distributed_tpu.engine.serial import pack, unpack
+    ctx = zmq.Context()
+    sock = ctx.socket(zmq.REP)
+    sock.bind(addr)
+    counts = [0] * num_engines
+    try:
+        while True:
+            raw = sock.recv()
+            # A malformed message must produce an error REPLY, never
+            # kill the loop — a dead REP socket strands every client.
+            try:
+                msg = unpack(raw)
+                op = msg.get("op")
+                if op == "report":
+                    engine = int(msg["engine"])
+                    if not 0 <= engine < num_engines:
+                        raise ValueError(f"engine {engine} out of range")
+                    counts[engine] += int(msg["delta"])
+                    reply = {"ok": True}
+                elif op == "route":
+                    engine = min(range(num_engines),
+                                 key=counts.__getitem__)
+                    counts[engine] += 1  # route implies one admission
+                    reply = {"engine": engine}
+                elif op == "counts":
+                    reply = {"counts": list(counts),
+                             "engines_running": [c > 0 for c in counts]}
+                elif op == "shutdown":
+                    sock.send(pack({"ok": True}))
+                    break
+                else:
+                    reply = {"error": f"unknown op {op!r}"}
+            except Exception as e:  # noqa: BLE001 - reply, keep serving
+                reply = {"error": f"{type(e).__name__}: {e}"}
+            sock.send(pack(reply))
+    finally:
+        sock.close(0)
+        ctx.term()
+
+
+class DPCoordinatorClient:
+    """Front-end handle to the coordinator (REQ socket; one in-flight
+    request at a time per client, matching the balancer's call sites)."""
+
+    TIMEOUT_MS = 10_000
+
+    def __init__(self, addr: str) -> None:
+        import zmq
+
+        from vllm_distributed_tpu.engine import serial
+        self._serial = serial
+        self.ctx = zmq.Context()
+        self.sock = self.ctx.socket(zmq.REQ)
+        # Bounded waits: a dead coordinator must FAIL the front-end,
+        # not wedge it (REQ_RELAXED lets the socket recover after a
+        # timed-out request).
+        self.sock.setsockopt(zmq.RCVTIMEO, self.TIMEOUT_MS)
+        self.sock.setsockopt(zmq.SNDTIMEO, self.TIMEOUT_MS)
+        self.sock.setsockopt(zmq.REQ_RELAXED, 1)
+        self.sock.setsockopt(zmq.REQ_CORRELATE, 1)
+        self.sock.connect(addr)
+        self._lock = threading.Lock()
+
+    def _call(self, **msg) -> dict:
+        import zmq
+        with self._lock:
+            try:
+                self.sock.send(self._serial.pack(msg))
+                reply = self._serial.unpack(self.sock.recv())
+            except zmq.error.Again as e:
+                raise RuntimeError(
+                    "DP coordinator did not respond within "
+                    f"{self.TIMEOUT_MS} ms (dead process?)") from e
+        if "error" in reply:
+            raise RuntimeError(f"DP coordinator: {reply['error']}")
+        return reply
+
+    def route(self) -> int:
+        return int(self._call(op="route")["engine"])
+
+    def report(self, engine: int, delta: int) -> None:
+        self._call(op="report", engine=engine, delta=delta)
+
+    def counts(self) -> list[int]:
+        return list(self._call(op="counts")["counts"])
+
+    def engines_running(self) -> list[bool]:
+        return list(self._call(op="counts")["engines_running"])
+
+    def shutdown_coordinator(self) -> None:
+        try:
+            self._call(op="shutdown")
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+
+    def close(self) -> None:
+        self.sock.close(0)
+        self.ctx.term()
+
+
+def spawn_coordinator(num_engines: int,
+                      addr: Optional[str] = None):
+    """Start the coordinator in its own process; returns (proc, addr).
+    The process is daemonic and exits with a 'shutdown' op."""
+    import multiprocessing
+    if addr is None:
+        d = tempfile.mkdtemp(prefix="vdt-coord-")
+        addr = f"ipc://{d}/coord-{uuid.uuid4().hex[:8]}"
+    mp_ctx = multiprocessing.get_context("spawn")
+    proc = mp_ctx.Process(target=_coordinator_loop,
+                          args=(addr, num_engines), daemon=True,
+                          name="vdt-dp-coordinator")
+    proc.start()
+    return proc, addr
+
+
+def cleanup_socket_dir(addr: str) -> None:
+    """Remove the ipc socket directory spawn_coordinator created
+    (mirrors SyncMPClient's vdt-zmq-* cleanup)."""
+    import os
+    import shutil
+    if addr.startswith("ipc://"):
+        d = os.path.dirname(addr[len("ipc://"):])
+        if os.path.basename(d).startswith("vdt-coord-"):
+            shutil.rmtree(d, ignore_errors=True)

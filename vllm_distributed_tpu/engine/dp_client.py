@@ -68,6 +68,19 @@ class DPEngineClient(EngineCoreClient):
                     rc.cache_config.num_gpu_blocks
         logger.info("DP front-end: %d engine replicas (%s)", n,
                     "subprocess" if self.is_mp else "in-process")
+        # Optional out-of-process routing brain (reference:
+        # coordinator.py DPCoordinator): admission/finish deltas report
+        # to it and routing asks it, so multiple front-ends could share
+        # the aggregated view.
+        self.coordinator = None
+        self._coord_proc = None
+        if config.parallel_config.data_parallel_coordinator:
+            from vllm_distributed_tpu.engine.coordinator import (
+                DPCoordinatorClient, spawn_coordinator)
+            self._coord_proc, addr = spawn_coordinator(n)
+            self._coord_addr = addr
+            self.coordinator = DPCoordinatorClient(addr)
+            logger.info("DP coordinator process at %s", addr)
         # Balancer state: request ownership + live counts per replica
         # (the coordinator's published queue lengths, client-side).
         self._owner: dict[str, int] = {}
@@ -80,6 +93,9 @@ class DPEngineClient(EngineCoreClient):
 
     # ------------------------------------------------------------------
     def _pick_replica(self) -> int:
+        if self.coordinator is not None:
+            # The coordinator's route() already accounts the admission.
+            return self.coordinator.route()
         n = len(self.clients)
         best, best_load = None, None
         for off in range(n):
@@ -105,13 +121,21 @@ class DPEngineClient(EngineCoreClient):
                 by_replica.setdefault(i, []).append(rid)
         for i, rids in by_replica.items():
             self.clients[i].abort_requests(rids)
+            if self.coordinator is not None:
+                self.coordinator.report(i, -len(rids))
 
     def _mark_finished(self, outs: list[EngineCoreOutput]) -> None:
+        finished_per: dict[int, int] = {}
         for o in outs:
             if o.finished:
                 i = self._owner.pop(o.req_id, None)
                 if i is not None:
                     self._live[i].discard(o.req_id)
+                    finished_per[i] = finished_per.get(i, 0) + 1
+        if self.coordinator is not None:
+            # One batched delta per replica (output hot path).
+            for i, k in finished_per.items():
+                self.coordinator.report(i, -k)
 
     # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
@@ -230,6 +254,14 @@ class DPEngineClient(EngineCoreClient):
         return self._aggregate_stats([c.get_stats() for c in self.clients])
 
     def shutdown(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.shutdown_coordinator()
+            self.coordinator.close()
+            if self._coord_proc is not None:
+                self._coord_proc.join(timeout=5)
+            from vllm_distributed_tpu.engine.coordinator import \
+                cleanup_socket_dir
+            cleanup_socket_dir(self._coord_addr)
         for c in self.clients:
             try:
                 c.shutdown()
