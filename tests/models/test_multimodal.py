@@ -3,6 +3,9 @@ pre-computed projector embeddings, HF parity, encoder-cache budgeting,
 and prefix-cache safety (reference: vllm/multimodal/ +
 v1/core/encoder_cache_manager.py)."""
 
+import json
+import os
+
 import numpy as np
 import pytest
 import torch
@@ -244,3 +247,149 @@ def test_pixel_values_through_in_engine_vision_tower(llava_checkpoint):
     (got, ) = run(engine, [(prompt,
                             {"pixel_values": pixel.numpy()})], "pix")
     assert got == want
+
+
+def test_image_preprocessing_matches_hf_clip_processor(tmp_path):
+    """Our preprocessor_config-driven pipeline matches transformers'
+    CLIPImageProcessor output."""
+    from PIL import Image
+    from transformers import CLIPImageProcessor
+
+    from vllm_distributed_tpu.multimodal.image_processing import \
+        ImagePreprocessor
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(
+        rng.integers(0, 255, size=(40, 56, 3), dtype=np.uint8))
+    hf_proc = CLIPImageProcessor(size={"shortest_edge": 16},
+                                 crop_size={"height": 16, "width": 16})
+    hf_proc.save_pretrained(tmp_path)
+
+    class HFC:
+        class vision_config:
+            image_size = 16
+    ours = ImagePreprocessor(str(tmp_path), HFC)
+    got = ours(img)
+    want = hf_proc(img, return_tensors="np")["pixel_values"][0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_openai_chat_accepts_data_url_images(llava_checkpoint,
+                                             tmp_path_factory):
+    """OpenAI chat completions with an image_url content part: the
+    server decodes + preprocesses the image, inserts the placeholder
+    token, and matches the offline engine fed the same pixels."""
+    import asyncio
+    import base64
+    import io
+    import threading
+    import urllib.request
+
+    from PIL import Image
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import CLIPImageProcessor, PreTrainedTokenizerFast
+
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.multimodal.image_processing import \
+        ImagePreprocessor
+    from vllm_distributed_tpu.utils import get_open_port
+
+    path, hf = llava_checkpoint
+    served = str(tmp_path_factory.mktemp("llava_served"))
+    import shutil
+    for f in os.listdir(path):
+        shutil.copy(os.path.join(path, f), served)
+    # Tokenizer with the image placeholder in-vocab.
+    vocab = {f"w{i}": i for i in range(128)}
+    vocab.update({"<image>": IMG, "hello": 3, "cat": 17, "dog": 45,
+                  "<unk>": 126, "</s>": 1})
+    vocab = {k: v for k, v in vocab.items()
+             if list(vocab.values()).count(v) == 1 or not k.startswith("w")}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    # The placeholder must tokenize ATOMICALLY (real llava tokenizers
+    # register <image> as an added special token).
+    PreTrainedTokenizerFast(
+        tokenizer_object=tok, unk_token="<unk>", eos_token="</s>",
+        additional_special_tokens=["<image>"]).save_pretrained(served)
+    CLIPImageProcessor(size={"shortest_edge": 16},
+                       crop_size={"height": 16,
+                                  "width": 16}).save_pretrained(served)
+
+    engine_args = EngineArgs(model=served, dtype="float32", block_size=4,
+                             num_gpu_blocks_override=128,
+                             max_model_len=128,
+                             max_num_batched_tokens=128, max_num_seqs=8)
+    engine = AsyncLLM(engine_args.create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    stop_holder = {}
+
+    def serve_thread():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import \
+            serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        stop_holder.update(stop=stop, loop=loop)
+        loop.run_until_complete(serve(engine, served, "127.0.0.1", port,
+                                      ready_event=ready,
+                                      stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=serve_thread, daemon=True)
+    t.start()
+    assert ready.wait(timeout=180)
+    try:
+        rng = np.random.default_rng(9)
+        img = Image.fromarray(
+            rng.integers(0, 255, size=(16, 16, 3), dtype=np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        url = ("data:image/png;base64," +
+               base64.b64encode(buf.getvalue()).decode())
+        body = json.dumps({
+            "model": "m",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "hello "},
+                {"type": "image_url", "image_url": {"url": url}},
+                {"type": "text", "text": " cat"},
+            ]}],
+            "max_tokens": 5, "temperature": 0.0,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            resp = json.loads(r.read())
+        text = resp["choices"][0]["message"]["content"]
+        assert resp["choices"][0]["finish_reason"] in ("length", "stop")
+
+        # Offline reference: same pixels through the same preprocessor
+        # and the template-less chat transcript.
+
+        class HFC:
+            class vision_config:
+                image_size = 16
+        pix = ImagePreprocessor(served, HFC)(img)
+        from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+        off = LLMEngine(EngineArgs(
+            model=served, dtype="float32", block_size=4,
+            num_gpu_blocks_override=128, max_model_len=128,
+            max_num_batched_tokens=128,
+            max_num_seqs=8).create_engine_config())
+        tokenizer = off.processor.tokenizer
+        prompt = tokenizer.encode("user: hello <image>  cat\nassistant:")
+        off.add_request("off-0", prompt,
+                        SamplingParams(temperature=0.0, max_tokens=5),
+                        multi_modal_data={"pixel_values": [pix]})
+        outs = []
+        for _ in range(200):
+            outs += [o for o in off.step() if o.finished]
+            if outs:
+                break
+        want = tokenizer.decode(outs[0].outputs[0].token_ids)
+        assert text == want, (text, want)
+    finally:
+        stop_holder["loop"].call_soon_threadsafe(stop_holder["stop"].set)
+        t.join(timeout=30)

@@ -138,6 +138,7 @@ class AsyncLLM:
         priority: int = 0,
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
+        multi_modal_data: Optional[dict] = None,
     ) -> AsyncGenerator[RequestOutput, None]:
         """Async stream of accumulated RequestOutputs for one request
         (reference: async_llm.py:277)."""
@@ -150,7 +151,8 @@ class AsyncLLM:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
-            lora_request=lora_request, pooling_params=pooling_params)
+            lora_request=lora_request, pooling_params=pooling_params,
+            multi_modal_data=multi_modal_data)
         queue: asyncio.Queue = asyncio.Queue()
         self.request_queues[request_id] = queue
         self.output_processor.add_request(

@@ -110,7 +110,16 @@ class DPEngineClient(EngineCoreClient):
         i = self._pick_replica()
         self._owner[request.request_id] = i
         self._live[i].add(request.request_id)
-        self.clients[i].add_request(request)
+        try:
+            self.clients[i].add_request(request)
+        except Exception:
+            # Unwind the admission accounting (route() already
+            # incremented the coordinator's count).
+            self._owner.pop(request.request_id, None)
+            self._live[i].discard(request.request_id)
+            if self.coordinator is not None:
+                self.coordinator.report(i, -1)
+            raise
 
     def abort_requests(self, request_ids: list[str]) -> None:
         by_replica: dict[int, list[str]] = {}

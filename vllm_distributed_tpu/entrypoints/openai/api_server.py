@@ -291,17 +291,61 @@ async def _stream_completions(request, cid, created, model,
 
 
 # ---------------------------------------------------------------------------
-def _chat_prompt(engine: AsyncLLM, messages: list) -> str | list[int]:
+def _chat_prompt(engine: AsyncLLM, messages: list):
+    """-> (prompt, multi_modal_data | None). OpenAI structured content
+    parts flatten to text with the model's image placeholder token
+    standing in for each image (reference: entrypoints/chat_utils.py);
+    data-URL images preprocess through the checkpoint's CLIP recipe."""
     tokenizer = engine.tokenizer
     if tokenizer is None:
         raise RequestError("chat requires a tokenizer for this model")
+    image_urls: list[str] = []
+    flat: list[dict] = []
+    for m in messages:
+        content = m.get("content")
+        if isinstance(content, list):
+            from vllm_distributed_tpu.multimodal.image_processing import \
+                image_token_string
+            hf = engine.config.model_config.maybe_load_hf_config()
+            tok = image_token_string(tokenizer, hf)
+            parts: list[str] = []
+            for part in content:
+                ptype = part.get("type")
+                if ptype == "text":
+                    parts.append(part.get("text", ""))
+                elif ptype == "image_url":
+                    if tok is None:
+                        raise RequestError(
+                            "this model does not accept image inputs")
+                    image_urls.append(
+                        (part.get("image_url") or {}).get("url", ""))
+                    parts.append(tok)
+                else:
+                    raise RequestError(
+                        f"unsupported content part type {ptype!r}")
+            flat.append(dict(m, content="".join(parts)))
+        else:
+            flat.append(m)
+    mm = None
+    if image_urls:
+        from vllm_distributed_tpu.multimodal.image_processing import \
+            preprocess_data_urls
+        try:
+            pixels = preprocess_data_urls(
+                image_urls, engine.config.model_config.model,
+                engine.config.model_config.maybe_load_hf_config())
+        except ValueError as e:
+            raise RequestError(str(e)) from e
+        mm = {"pixel_values": pixels}
     if getattr(tokenizer, "chat_template", None):
-        return tokenizer.apply_chat_template(messages, tokenize=True,
-                                             add_generation_prompt=True)
-    # Template-less tiny/test models: plain role-prefixed transcript.
-    text = "".join(f"{m.get('role', 'user')}: {m.get('content', '')}\n"
-                   for m in messages) + "assistant:"
-    return text
+        prompt = tokenizer.apply_chat_template(
+            flat, tokenize=True, add_generation_prompt=True)
+    else:
+        # Template-less tiny/test models: plain role-prefixed transcript.
+        prompt = "".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+            for m in flat) + "assistant:"
+    return prompt, mm
 
 
 async def chat_completions(request: web.Request) -> web.StreamResponse:
@@ -315,8 +359,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             raise RequestError("`messages` must be a non-empty list")
-        prompt = _chat_prompt(engine, messages)
+        prompt, mm = _chat_prompt(engine, messages)
         n = int(body.get("n", 1) or 1)
+        if mm is not None:
+            # Encode pixels ONCE; the n samples (and the scheduler)
+            # reuse the embeddings instead of n vision-tower passes.
+            mm = {"image_embeds": engine.processor._encode_pixels(
+                mm["pixel_values"])}
         max_len = engine.config.scheduler_config.max_model_len
         params = protocol.sampling_params_from_request(body, max_len)
         stream = bool(body.get("stream", False))
@@ -330,7 +379,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 "yet; set stream=false")
         gens = [(i, engine.generate(prompt, params,
                                     request_id=f"{cid}-{i}",
-                                    lora_request=lora))
+                                    lora_request=lora,
+                                    multi_modal_data=mm))
                 for i in range(n)]
         if stream:
             return await _stream_chat(request, cid, created, model, gens)

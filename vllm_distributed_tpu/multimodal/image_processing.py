@@ -1,0 +1,122 @@
+"""Image decoding + CLIP preprocessing for the serving path.
+
+Reference: the multimodal input mapper of vllm/multimodal/image.py +
+entrypoints/chat_utils.py (data-URL images in chat content become
+pixel tensors via the model's HF image processor). Implemented
+directly against the checkpoint's ``preprocessor_config.json`` (CLIP
+semantics: resize shortest side, center crop, rescale, normalize) so
+serving needs no torch/transformers processor objects in the request
+path."""
+
+import base64
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# CLIP defaults (openai/clip-vit-*): used when the checkpoint ships no
+# preprocessor_config.json.
+_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+class ImagePreprocessor:
+    """pixel pipeline: PIL image -> [3, S, S] float32 (CHW)."""
+
+    def __init__(self, model_path: str, hf_config) -> None:
+        size = getattr(getattr(hf_config, "vision_config", None),
+                       "image_size", 224)
+        cfg: dict = {}
+        pp = os.path.join(model_path, "preprocessor_config.json")
+        if os.path.isfile(pp):
+            with open(pp) as f:
+                cfg = json.load(f)
+        csize = cfg.get("crop_size", size)
+        if isinstance(csize, dict):
+            csize = csize.get("height", size)
+        rsize = cfg.get("size", size)
+        if isinstance(rsize, dict):
+            rsize = rsize.get("shortest_edge",
+                              rsize.get("height", size))
+        self.resize_to = int(rsize)
+        self.crop_to = int(csize)
+        self.do_center_crop = bool(cfg.get("do_center_crop", True))
+        self.rescale = float(cfg.get("rescale_factor", 1 / 255))
+        self.mean = np.asarray(cfg.get("image_mean", _CLIP_MEAN),
+                               np.float32)
+        self.std = np.asarray(cfg.get("image_std", _CLIP_STD),
+                              np.float32)
+
+    def __call__(self, image) -> np.ndarray:
+        from PIL import Image
+        if not isinstance(image, Image.Image):
+            image = Image.open(image)
+        image = image.convert("RGB")
+        # Resize shortest edge (CLIP), bicubic; the long edge TRUNCATES
+        # like HF's get_resize_output_image_size (int(), not round()).
+        w, h = image.size
+        if w <= h:
+            new_w = self.resize_to
+            new_h = max(1, int(self.resize_to * h / w))
+        else:
+            new_h = self.resize_to
+            new_w = max(1, int(self.resize_to * w / h))
+        image = image.resize((new_w, new_h), Image.Resampling.BICUBIC)
+        if self.do_center_crop:
+            w, h = image.size
+            left = (w - self.crop_to) // 2
+            top = (h - self.crop_to) // 2
+            image = image.crop((left, top, left + self.crop_to,
+                                top + self.crop_to))
+        arr = np.asarray(image, np.float32) * self.rescale  # [H, W, 3]
+        arr = (arr - self.mean) / self.std
+        return arr.transpose(2, 0, 1)  # [3, S, S]
+
+
+def decode_data_url(url: str):
+    """'data:image/...;base64,...' -> PIL image."""
+    import io
+
+    from PIL import Image
+    if not url.startswith("data:"):
+        raise ValueError(
+            "only data: image URLs are supported (no egress from the "
+            "serving host); got a remote URL")
+    try:
+        payload = url.split(",", 1)[1]
+        image = Image.open(io.BytesIO(base64.b64decode(payload)))
+        image.load()  # PIL is lazy: force the full decode HERE so a
+        # truncated payload is a client error, not a later 500
+        return image
+    except Exception as e:  # noqa: BLE001 - client error
+        raise ValueError(f"could not decode image data URL: {e}") from e
+
+
+_PREPROCESSORS: dict[str, ImagePreprocessor] = {}
+
+
+def preprocess_data_urls(urls: list[str], model_path: str,
+                         hf_config) -> list[np.ndarray]:
+    pre = _PREPROCESSORS.get(model_path)
+    if pre is None:
+        pre = ImagePreprocessor(model_path, hf_config)
+        _PREPROCESSORS[model_path] = pre
+    return [pre(decode_data_url(u)) for u in urls]
+
+
+def image_token_string(tokenizer, hf_config) -> Optional[str]:
+    """The placeholder token's string form (e.g. '<image>') for chat
+    prompt construction; None when the model has no image token."""
+    idx = getattr(hf_config, "image_token_index",
+                  getattr(hf_config, "image_token_id", None))
+    if idx is None or tokenizer is None:
+        return None
+    try:
+        return tokenizer.convert_ids_to_tokens(int(idx))
+    except Exception:  # noqa: BLE001
+        return None
