@@ -1,0 +1,131 @@
+"""HF greedy parity + engine behavior for the Mamba (SSM) family.
+
+Same harness as tests/models/test_families.py (reference pattern:
+tests/models/ per-arch correctness vs HfRunner), plus SSM-specific
+checks: chunked prefill must thread state between chunks, and prefix
+caching must be auto-disabled for stateful models.
+"""
+
+import pytest
+import torch
+from transformers import MambaConfig, MambaForCausalLM
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+PROMPTS = [
+    [3, 17, 92, 45, 8, 21, 60, 5, 44, 71],
+    [5, 9, 33, 71],
+    [2, 2, 7],
+]
+
+
+def hf_greedy(hf, prompt, n):
+    with torch.no_grad():
+        out = hf.generate(torch.tensor([prompt]), max_new_tokens=n,
+                          do_sample=False, eos_token_id=None)
+    return out[0].tolist()[len(prompt):]
+
+
+def run(path, prompts, max_tokens=6, **overrides):
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    engine = LLMEngine(EngineArgs(**args).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r-{i}", p, sp)
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    return [done[f"r-{i}"].outputs[0].token_ids
+            for i in range(len(prompts))]
+
+
+@pytest.fixture(scope="module")
+def mamba_ckpt(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = MambaConfig(vocab_size=128, hidden_size=32, state_size=8,
+                      num_hidden_layers=2, conv_kernel=4, expand=2,
+                      time_step_rank=4, use_conv_bias=True,
+                      use_bias=False, eos_token_id=1)
+    hf = MambaForCausalLM(cfg)
+    path = tmp_path_factory.mktemp("mamba-tiny")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf.eval()
+
+
+def test_mamba_greedy_matches_hf(mamba_ckpt):
+    path, hf = mamba_ckpt
+    expect = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    got = run(path, PROMPTS)
+    assert got == expect
+
+
+def test_mamba_chunked_prefill_threads_state(mamba_ckpt):
+    """A prompt longer than the token budget forces multi-chunk prefill:
+    every chunk after the first must resume carried conv+ssm state."""
+    path, hf = mamba_ckpt
+    long_prompt = [(i * 7 + 3) % 128 for i in range(40)]
+    expect = [hf_greedy(hf, long_prompt, 6)]
+    got = run(path, [long_prompt], max_num_batched_tokens=16,
+              max_model_len=64)
+    assert got == expect
+
+
+def test_mamba_preemption_recomputes(mamba_ckpt):
+    """A tiny page pool forces preemption; resumed requests must restart
+    their recurrence from scratch and still match HF."""
+    path, hf = mamba_ckpt
+    prompts = [[(i * 5 + j) % 128 for j in range(8)] for i in range(4)]
+    expect = [hf_greedy(hf, p, 8) for p in prompts]
+    got = run(path, prompts, max_tokens=8, num_gpu_blocks_override=20,
+              max_num_seqs=4)
+    assert got == expect
+
+
+def test_mamba_disables_prefix_caching(mamba_ckpt):
+    path, _ = mamba_ckpt
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=4,
+                enable_prefix_caching=True, skip_tokenizer_init=True)
+    engine = LLMEngine(EngineArgs(**args).create_engine_config())
+    sched = engine.engine_core.scheduler
+    assert not sched.kv_cache_manager.enable_caching
+
+
+def test_mamba_rejects_unwired_intersections(mamba_ckpt):
+    """Spec decode (state rows cannot rewind rejected drafts) and KV
+    transfer (state is not in pages) are rejected at load with clear
+    errors, like the loader's other feature-intersection guards."""
+    path, _ = mamba_ckpt
+    base = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=4,
+                skip_tokenizer_init=True)
+    with pytest.raises(ValueError, match="stateful"):
+        LLMEngine(EngineArgs(
+            speculative_method="ngram", num_speculative_tokens=2,
+            **base).create_engine_config())
+    with pytest.raises(ValueError, match="stateful"):
+        LLMEngine(EngineArgs(
+            kv_connector="SharedStorageConnector", kv_role="kv_both",
+            **base).create_engine_config())
+
+
+def test_mamba_tp2_matches_single_chip(mamba_ckpt):
+    """d_inner shards over the model axis; greedy tokens must match the
+    single-device run exactly."""
+    path, hf = mamba_ckpt
+    expect = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    got = run(path, PROMPTS, tensor_parallel_size=2)
+    assert got == expect

@@ -97,15 +97,22 @@ class Scheduler:
         # AND no KV connector is attached (a connector may still read a
         # request's prompt pages for a peer pull after they leave the
         # window; its deferred-free holds don't cover mid-request frees).
-        from vllm_distributed_tpu.models.loader import resolve_free_window
+        from vllm_distributed_tpu.models.loader import (resolve_free_window,
+                                                        resolve_stateful)
         free_window = (None if kv_connector is not None
                        else resolve_free_window(config.model_config))
+        enable_caching = config.cache_config.enable_prefix_caching
+        if enable_caching and resolve_stateful(config.model_config):
+            # SSM state cannot re-enter at a cached page boundary; the
+            # reference disables prefix caching for mamba models too.
+            logger.info("stateful (SSM) model: prefix caching disabled")
+            enable_caching = False
         if self.tknp_size > 1:
             self.kv_cache_manager = TokenParallelKVCacheManager(
                 block_size=config.cache_config.block_size,
                 num_blocks=num_blocks,
                 num_ranks=self.tknp_size,
-                enable_caching=config.cache_config.enable_prefix_caching,
+                enable_caching=enable_caching,
             )
             # Per-rank scheduled-token counts (load-balance signal).
             self.tknp_tokens_per_rank = [0] * self.tknp_size
@@ -113,7 +120,7 @@ class Scheduler:
             self.kv_cache_manager = KVCacheManager(
                 block_size=config.cache_config.block_size,
                 num_blocks=num_blocks,
-                enable_caching=config.cache_config.enable_prefix_caching,
+                enable_caching=enable_caching,
                 free_window=free_window,
             )
         # Structured output (reference: the engine core's

@@ -107,6 +107,23 @@ def get_model(config: EngineConfig, mesh,
     if config.lora_config.enable_lora:
         arch.max_loras = config.lora_config.max_loras
         arch.max_lora_rank = config.lora_config.max_lora_rank
+    if getattr(arch, "stateful", False):
+        # Stateful (SSM) families: one state row per schedulable request
+        # (the TPU form of the reference's MambaSpec one-block-per-
+        # request cache, v1/kv_cache_interface.py).
+        arch.state_slots = config.scheduler_config.max_num_seqs
+        if config.speculative_config.num_speculative_tokens:
+            # Draft rejection rolls num_computed_tokens back, but a
+            # recurrence's state row cannot rewind past verified tokens.
+            raise ValueError(
+                "speculative decoding over stateful (SSM) models is not "
+                "wired (rejected drafts cannot rewind recurrence state); "
+                "disable speculative decoding")
+        if config.kv_transfer_config.kv_connector:
+            raise ValueError(
+                "KV transfer for stateful (SSM) models is not wired "
+                "(their state lives in per-request rows, not pages); "
+                "drop the kv-transfer config")
     if ((arch.sliding_window or arch.window_pattern
          or arch.attn_logit_softcap)
             and config.parallel_config.token_parallel_size > 1):
@@ -196,6 +213,19 @@ def get_model(config: EngineConfig, mesh,
         "lm_head": place(params["lm_head"], specs["lm_head"]),
     }
     return model, params
+
+
+def resolve_stateful(model_config) -> bool:
+    """True when the model carries non-pageable per-request state (SSM
+    layers): the scheduler must disable prefix caching — a cached page
+    boundary is not a re-enterable point for a recurrence (the
+    reference likewise disables prefix caching for mamba models)."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+    except Exception:  # noqa: BLE001 - conservative
+        return False
+    return bool(getattr(model_cls, "STATEFUL", False))
 
 
 def resolve_free_window(model_config) -> Optional[int]:

@@ -1,0 +1,343 @@
+"""Mamba (selective state-space) model family.
+
+Reference surface: vllm/model_executor/models/mamba.py (pure Mamba-1
+MambaForCausalLM) built on layers/mamba/mamba_mixer.py, with per-request
+SSM state held in the KV cache as a MambaSpec "one block per request"
+group (vllm/v1/kv_cache_interface.py) and chunk metadata from
+v1/attention/backends/mamba_attn.py.
+
+TPU design: the mixer runs directly on the engine's flat ragged token
+batch via the segmented associative scan in ops/mamba.py — no
+prefill/decode split, no chunk-index tables; decode, chunked prefill and
+mixed batches are one compiled program per token bucket. State lives in
+fixed-size per-request rows indexed by the runner's persistent
+input-batch slots (state is O(1) per request, so paging buys nothing);
+the page pool is sized to "free" (kv_cache_page_bytes == 0) and the
+worker charges the fixed state bytes instead (fixed_cache_bytes).
+
+Tensor parallelism shards the d_inner channel axis: in/out projections
+column/row-parallel, conv + scan fully elementwise in the shard, B/C/dt
+projections replicated (they are per-token vectors of rank << d_inner).
+Prefix caching is disabled for stateful families at scheduler
+construction (models/loader.resolve_stateful): SSM state cannot be
+re-entered at an arbitrary page boundary — matching the reference,
+which likewise rejects prefix caching for mamba models.
+"""
+
+from __future__ import annotations
+
+import math
+from types import SimpleNamespace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.common import rms_norm
+from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
+                                               LlamaForCausalLM)
+from vllm_distributed_tpu.ops.mamba import (build_segment_info,
+                                            causal_conv1d_ragged,
+                                            selective_scan_ragged)
+
+
+def _softplus(x: jax.Array) -> jax.Array:
+    return jax.nn.softplus(x)
+
+
+class MambaForCausalLM(LlamaForCausalLM):
+    """Pure Mamba-1 stack: L x (RMSNorm -> MambaMixer) + final norm.
+
+    HF checkpoint layout: backbone.embeddings, backbone.layers.{i}.norm
+    + .mixer.{in_proj,conv1d,x_proj,dt_proj,A_log,D,out_proj},
+    backbone.norm_f, tied lm_head.
+    """
+
+    QUANT_TARGETS = ()  # weight quantization for SSM stacks: follow-up
+    LORA_TARGETS = ()
+    STATEFUL = True
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        """MambaConfig lacks the attention fields from_hf_config reads;
+        present a shim with inert attention values."""
+        d_inner = getattr(hf, "intermediate_size", None) or (
+            hf.expand * hf.hidden_size)
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=d_inner,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=1,
+            num_key_value_heads=1,
+            head_dim=hf.hidden_size,
+            rms_norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=getattr(hf, "tie_word_embeddings", True),
+        )
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.stateful = True
+        arch.ssm_state_size = hf.state_size
+        arch.conv_kernel = hf.conv_kernel
+        arch.d_inner = arch.intermediate_size
+        dt_rank = getattr(hf, "time_step_rank", None)
+        if dt_rank is None or dt_rank == "auto":
+            dt_rank = math.ceil(hf.hidden_size / 16)
+        arch.dt_rank = int(dt_rank)
+        arch.use_conv_bias = bool(getattr(hf, "use_conv_bias", True))
+        arch.use_bias = bool(getattr(hf, "use_bias", False))
+        # Filled by the loader from SchedulerConfig.max_num_seqs; tests
+        # constructing the model directly set it on the arch first.
+        if not hasattr(arch, "state_slots"):
+            arch.state_slots = 0
+
+    def quantize_params(self, params: dict) -> dict:
+        if self.cfg.quantization:
+            raise ValueError(
+                "weight quantization for SSM stacks is not wired yet; "
+                "drop --quantization for Mamba-family models")
+        return params
+
+    # ------------------------------------------------------------------
+    # Parameters
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        c = self.cfg
+        layer = {
+            "norm": P(None, None),
+            "in_x": P(None, None, MODEL_AXIS),
+            "in_z": P(None, None, MODEL_AXIS),
+            "conv_w": P(None, None, MODEL_AXIS),
+            "x_proj": P(None, MODEL_AXIS, None),
+            "dt_w": P(None, None, MODEL_AXIS),
+            "dt_b": P(None, MODEL_AXIS),
+            "A_log": P(None, MODEL_AXIS, None),
+            "D": P(None, MODEL_AXIS),
+            "out_proj": P(None, MODEL_AXIS, None),
+        }
+        if c.use_conv_bias:
+            layer["conv_b"] = P(None, MODEL_AXIS)
+        if c.use_bias:
+            layer["in_x_b"] = P(None, MODEL_AXIS)
+            layer["in_z_b"] = P(None, MODEL_AXIS)
+            layer["out_b"] = P(None, None)
+        return {
+            "embed": P(None, None),
+            "layers": layer,
+            "final_ln": P(None, ),
+            "lm_head": P(None, MODEL_AXIS),
+        }
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        L, H = c.num_layers, c.hidden_size
+        Di, N, K, R = c.d_inner, c.ssm_state_size, c.conv_kernel, c.dt_rank
+        keys = iter(jax.random.split(rng, 10))
+
+        def norm(key, shape):
+            return (scale * jax.random.normal(key, shape,
+                                              jnp.float32)).astype(c.dtype)
+
+        layers = {
+            "norm": jnp.ones((L, H), c.dtype),
+            "in_x": norm(next(keys), (L, H, Di)),
+            "in_z": norm(next(keys), (L, H, Di)),
+            "conv_w": norm(next(keys), (L, K, Di)),
+            "x_proj": norm(next(keys), (L, Di, R + 2 * N)),
+            "dt_w": norm(next(keys), (L, R, Di)),
+            "dt_b": jnp.zeros((L, Di), jnp.float32),
+            # S4D-real init: A = -(1..N) per channel, like the published
+            # Mamba initialization.
+            "A_log": jnp.broadcast_to(
+                jnp.log(jnp.arange(1, N + 1, dtype=jnp.float32)),
+                (L, Di, N)) * jnp.ones((L, Di, 1), jnp.float32),
+            "D": jnp.ones((L, Di), jnp.float32),
+            "out_proj": norm(next(keys), (L, Di, H)),
+        }
+        if c.use_conv_bias:
+            layers["conv_b"] = jnp.zeros((L, Di), c.dtype)
+        if c.use_bias:
+            layers["in_x_b"] = jnp.zeros((L, Di), c.dtype)
+            layers["in_z_b"] = jnp.zeros((L, Di), c.dtype)
+            layers["out_b"] = jnp.zeros((L, H), c.dtype)
+        embed = norm(next(keys), (c.vocab_size, H))
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln": jnp.ones((H, ), c.dtype),
+            "lm_head": (embed.T if c.tie_word_embeddings else norm(
+                next(keys), (H, c.vocab_size))),
+        }
+
+    def params_from_hf_state_dict(self, tensors: dict,
+                                  prefix: str = "backbone") -> dict:
+        c = self.cfg
+        L = c.num_layers
+        Di = c.d_inner
+
+        def t(name):
+            return np.asarray(tensors[name])
+
+        def stack(fmt, f):
+            return jnp.asarray(
+                np.stack([f(t(fmt.format(i))) for i in range(L)]))
+
+        def lin(a):  # torch Linear weight [out, in] -> [in, out]
+            return a.T
+
+        mx = prefix + ".layers.{}.mixer."
+        layers = {
+            "norm":
+            stack(prefix + ".layers.{}.norm.weight",
+                  lambda a: a).astype(c.dtype),
+            "in_x":
+            stack(mx + "in_proj.weight",
+                  lambda a: lin(a[:Di])).astype(c.dtype),
+            "in_z":
+            stack(mx + "in_proj.weight",
+                  lambda a: lin(a[Di:])).astype(c.dtype),
+            # conv1d depthwise weight [Di, 1, K] -> taps-major [K, Di].
+            "conv_w":
+            stack(mx + "conv1d.weight",
+                  lambda a: a[:, 0, :].T).astype(c.dtype),
+            "x_proj":
+            stack(mx + "x_proj.weight", lin).astype(c.dtype),
+            "dt_w":
+            stack(mx + "dt_proj.weight", lin).astype(c.dtype),
+            "dt_b":
+            stack(mx + "dt_proj.bias", lambda a: a).astype(jnp.float32),
+            "A_log":
+            stack(mx + "A_log", lambda a: a).astype(jnp.float32),
+            "D":
+            stack(mx + "D", lambda a: a).astype(jnp.float32),
+            "out_proj":
+            stack(mx + "out_proj.weight", lin).astype(c.dtype),
+        }
+        if c.use_conv_bias:
+            layers["conv_b"] = stack(mx + "conv1d.bias",
+                                     lambda a: a).astype(c.dtype)
+        if c.use_bias:
+            layers["in_x_b"] = stack(mx + "in_proj.bias",
+                                     lambda a: a[:Di]).astype(c.dtype)
+            layers["in_z_b"] = stack(mx + "in_proj.bias",
+                                     lambda a: a[Di:]).astype(c.dtype)
+            layers["out_b"] = stack(mx + "out_proj.bias",
+                                    lambda a: a).astype(c.dtype)
+        embed = jnp.asarray(t(prefix + ".embeddings.weight")).astype(
+            c.dtype)
+        if c.tie_word_embeddings or "lm_head.weight" not in tensors:
+            lm_head = embed.T
+        else:
+            lm_head = jnp.asarray(t("lm_head.weight")).T.astype(c.dtype)
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln":
+            jnp.asarray(t(prefix + ".norm_f.weight")).astype(c.dtype),
+            "lm_head": lm_head,
+        }
+
+    # ------------------------------------------------------------------
+    # State cache (replaces paged KV)
+    # ------------------------------------------------------------------
+    def kv_cache_specs(self) -> dict:
+        return {
+            "conv": P(None, None, None, MODEL_AXIS),
+            "ssm": P(None, None, MODEL_AXIS, None),
+        }
+
+    def _state_shapes(self, depth: int) -> dict:
+        """One source of truth for state-cache shapes/dtypes, shared by
+        make_kv_caches and fixed_cache_bytes so the worker's memory
+        accounting can never drift from the arrays it allocates."""
+        c = self.cfg
+        S = (c.state_slots or 256) + 1  # +1 dump row for padding writes
+        return {
+            "conv": ((depth, S, c.conv_kernel - 1, c.d_inner), c.dtype),
+            "ssm": ((depth, S, c.d_inner, c.ssm_state_size),
+                    jnp.float32),
+        }
+
+    def make_kv_caches(self, num_pages: int, page_size: int,
+                       cache_dtype=None,
+                       num_layers: Optional[int] = None) -> dict:
+        c = self.cfg
+        depth = num_layers if num_layers is not None else c.num_layers
+        return {
+            name: jnp.zeros(shape, dtype)
+            for name, (shape, dtype) in self._state_shapes(depth).items()
+        }
+
+    def kv_cache_page_bytes(self, page_size: int) -> int:
+        # SSM state is per-request, not per-token: pages are free; the
+        # worker charges fixed_cache_bytes instead.
+        return 0
+
+    def fixed_cache_bytes(self) -> int:
+        return sum(
+            int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+            for shape, dtype in self._state_shapes(
+                self.cfg.num_layers).values())
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+    def _mixer(self, lp: dict, x: jax.Array, conv_state, ssm_state, seg):
+        """One Mamba-1 mixer over flat tokens x [T, Di-projected]."""
+        c = self.cfg
+        N, R = c.ssm_state_size, c.dt_rank
+        xin = x @ lp["in_x"]
+        z = x @ lp["in_z"]
+        if c.use_bias:
+            xin = xin + lp["in_x_b"]
+            z = z + lp["in_z_b"]
+        xc, conv_state = causal_conv1d_ragged(
+            xin, lp["conv_w"], lp.get("conv_b"), conv_state, seg)
+        xc = jax.nn.silu(xc)
+        ssm_p = xc @ lp["x_proj"]  # [T, R + 2N]
+        dt = _softplus(
+            ssm_p[:, :R] @ lp["dt_w"] + lp["dt_b"])  # [T, Di] f32 bias
+        B = ssm_p[:, R:R + N]
+        C = ssm_p[:, R + N:]
+        A = -jnp.exp(lp["A_log"])  # [Di, N] f32
+        y, ssm_state = selective_scan_ragged(
+            xc.astype(jnp.float32), dt, A, B, C, lp["D"], ssm_state, seg)
+        y = y * jax.nn.silu(z.astype(jnp.float32))
+        out = y.astype(c.dtype) @ lp["out_proj"]
+        if c.use_bias:
+            out = out + lp["out_b"]
+        return out, conv_state, ssm_state
+
+    def run_layers(
+        self,
+        layer_params: dict,
+        kv_caches: dict,
+        hidden: jax.Array,  # [T, H]
+        batch,
+        first_layer: int = 0,
+    ) -> tuple[jax.Array, dict]:
+        c = self.cfg
+        seg = build_segment_info(batch, kv_caches["ssm"].shape[1] - 1)
+        num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+        layer_ids = jnp.arange(num_layers, dtype=jnp.int32)
+
+        def layer_body(carry, xs):
+            h, conv_all, ssm_all = carry
+            lp, li = xs
+            x = rms_norm(h, lp["norm"], c.rms_norm_eps)
+            out, conv_new, ssm_new = self._mixer(
+                lp, x, conv_all[li], ssm_all[li], seg)
+            conv_all = jax.lax.dynamic_update_index_in_dim(
+                conv_all, conv_new, li, 0)
+            ssm_all = jax.lax.dynamic_update_index_in_dim(
+                ssm_all, ssm_new, li, 0)
+            return (h + out, conv_all, ssm_all), None
+
+        carry = (hidden, kv_caches["conv"], kv_caches["ssm"])
+        carry, _ = jax.lax.scan(layer_body, carry,
+                                (layer_params, layer_ids))
+        hidden, conv_all, ssm_all = carry
+        return hidden, {"conv": conv_all, "ssm": ssm_all}

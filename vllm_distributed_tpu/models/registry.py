@@ -32,6 +32,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.mamba import MambaForCausalLM
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
 
@@ -73,6 +74,8 @@ _REGISTRY: dict[str, type] = {
     "GlmForCausalLM": GlmForCausalLM,
     "FalconForCausalLM": FalconForCausalLM,
     "PersimmonForCausalLM": PersimmonForCausalLM,
+    # Selective state-space family (segmented-scan SSM; models/mamba.py).
+    "MambaForCausalLM": MambaForCausalLM,
 }
 
 

@@ -294,6 +294,12 @@ class TPUModelRunner:
         # token instead of per-head K/V).
         return self.model.kv_cache_page_bytes(self.page_size)
 
+    def model_fixed_cache_bytes(self) -> int:
+        """Per-request fixed state bytes (SSM rows); 0 for paged-KV-only
+        models."""
+        fn = getattr(self.model, "fixed_cache_bytes", None)
+        return fn() if fn is not None else 0
+
     def _build_step_fn(self) -> None:
         """Two jits instead of one: forward (shapes keyed by the token
         bucket T) and logits+sample (keyed by the sampling-rows bucket R).

@@ -136,6 +136,23 @@ class TPUWorker:
         with global_mesh(self.mesh):
             avail = self.model_runner.profile_memory_bytes()
         page_bytes = self.model_runner.kv_cache_bytes_per_page()
+        # Fixed-size per-request state (SSM conv/ssm rows) is charged up
+        # front; the page pool only gets what remains.
+        fixed = self.model_runner.model_fixed_cache_bytes()
+        if avail > 0 and fixed > avail:
+            raise RuntimeError(
+                f"per-request SSM state ({fixed / 2**30:.2f} GiB for "
+                f"{self.config.scheduler_config.max_num_seqs} slots) "
+                f"exceeds free HBM ({avail / 2**30:.2f} GiB); lower "
+                f"max_num_seqs")
+        avail -= fixed
+        if page_bytes == 0:
+            # Stateful-only models (pure Mamba): pages carry no bytes, so
+            # give every schedulable request full-length coverage.
+            pages = (self.config.max_pages_per_req *
+                     self.config.scheduler_config.max_num_seqs)
+            logger.info("no paged layers; %d free KV pages", pages)
+            return rounded(pages)
         if avail <= 0:
             # No memory stats (CPU tests): cover max_model_len for
             # max_num_seqs/4 requests.
