@@ -40,7 +40,8 @@ from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.ops.mamba import (build_segment_info,
                                             causal_conv1d_ragged,
-                                            selective_scan_ragged)
+                                            selective_scan_ragged,
+                                            ssd_scan_ragged)
 
 
 def _softplus(x: jax.Array) -> jax.Array:
@@ -172,11 +173,9 @@ class MambaForCausalLM(LlamaForCausalLM):
                 next(keys), (H, c.vocab_size))),
         }
 
-    def params_from_hf_state_dict(self, tensors: dict,
-                                  prefix: str = "backbone") -> dict:
-        c = self.cfg
-        L = c.num_layers
-        Di = c.d_inner
+    def _hf_stackers(self, tensors: dict):
+        """(t, stack) helpers shared by the family's checkpoint maps."""
+        L = self.cfg.num_layers
 
         def t(name):
             return np.asarray(tensors[name])
@@ -184,6 +183,34 @@ class MambaForCausalLM(LlamaForCausalLM):
         def stack(fmt, f):
             return jnp.asarray(
                 np.stack([f(t(fmt.format(i))) for i in range(L)]))
+
+        return t, stack
+
+    def _hf_tail(self, tensors: dict, layers: dict,
+                 prefix: str) -> dict:
+        """Assemble the param tree around a family's layers dict
+        (embeddings / final norm / tied-or-separate lm_head)."""
+        c = self.cfg
+        t, _ = self._hf_stackers(tensors)
+        embed = jnp.asarray(t(prefix + ".embeddings.weight")).astype(
+            c.dtype)
+        if c.tie_word_embeddings or "lm_head.weight" not in tensors:
+            lm_head = embed.T
+        else:
+            lm_head = jnp.asarray(t("lm_head.weight")).T.astype(c.dtype)
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln":
+            jnp.asarray(t(prefix + ".norm_f.weight")).astype(c.dtype),
+            "lm_head": lm_head,
+        }
+
+    def params_from_hf_state_dict(self, tensors: dict,
+                                  prefix: str = "backbone") -> dict:
+        c = self.cfg
+        Di = c.d_inner
+        t, stack = self._hf_stackers(tensors)
 
         def lin(a):  # torch Linear weight [out, in] -> [in, out]
             return a.T
@@ -226,19 +253,7 @@ class MambaForCausalLM(LlamaForCausalLM):
                                      lambda a: a[Di:]).astype(c.dtype)
             layers["out_b"] = stack(mx + "out_proj.bias",
                                     lambda a: a).astype(c.dtype)
-        embed = jnp.asarray(t(prefix + ".embeddings.weight")).astype(
-            c.dtype)
-        if c.tie_word_embeddings or "lm_head.weight" not in tensors:
-            lm_head = embed.T
-        else:
-            lm_head = jnp.asarray(t("lm_head.weight")).T.astype(c.dtype)
-        return {
-            "embed": embed,
-            "layers": layers,
-            "final_ln":
-            jnp.asarray(t(prefix + ".norm_f.weight")).astype(c.dtype),
-            "lm_head": lm_head,
-        }
+        return self._hf_tail(tensors, layers, prefix)
 
     # ------------------------------------------------------------------
     # State cache (replaces paged KV)
@@ -298,10 +313,18 @@ class MambaForCausalLM(LlamaForCausalLM):
             xin, lp["conv_w"], lp.get("conv_b"), conv_state, seg)
         xc = jax.nn.silu(xc)
         ssm_p = xc @ lp["x_proj"]  # [T, R + 2N]
-        dt = _softplus(
-            ssm_p[:, :R] @ lp["dt_w"] + lp["dt_b"])  # [T, Di] f32 bias
+        dt_r = ssm_p[:, :R]
         B = ssm_p[:, R:R + N]
         C = ssm_p[:, R + N:]
+        eps = getattr(c, "mixer_rms_eps", None)
+        if eps is not None:
+            # FalconMamba: weightless RMSNorm on dt/B/C before use.
+            ones = jnp.ones((1, ), jnp.float32)
+            dt_r = rms_norm(dt_r.astype(jnp.float32), ones, eps)
+            B = rms_norm(B.astype(jnp.float32), ones, eps)
+            C = rms_norm(C.astype(jnp.float32), ones, eps)
+        dt = _softplus(
+            dt_r @ lp["dt_w"] + lp["dt_b"])  # [T, Di] f32 bias
         A = -jnp.exp(lp["A_log"])  # [Di, N] f32
         y, ssm_state = selective_scan_ragged(
             xc.astype(jnp.float32), dt, A, B, C, lp["D"], ssm_state, seg)
@@ -341,3 +364,275 @@ class MambaForCausalLM(LlamaForCausalLM):
                                 (layer_params, layer_ids))
         hidden, conv_all, ssm_all = carry
         return hidden, {"conv": conv_all, "ssm": ssm_all}
+
+
+class FalconMambaForCausalLM(MambaForCausalLM):
+    """FalconMamba: Mamba-1 with a weightless RMSNorm applied to the
+    dt/B/C selection vectors (reference:
+    vllm/model_executor/models/falcon_mamba.py mixer_rms_eps)."""
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        MambaForCausalLM.configure_arch(arch, hf)
+        arch.mixer_rms_eps = getattr(hf, "mixer_rms_eps", 1e-6)
+
+
+class Mamba2ForCausalLM(MambaForCausalLM):
+    """Mamba-2 (SSD) stack: scalar decay per head, grouped B/C, x/B/C
+    convolved together, gated RMSNorm before out_proj.
+
+    Reference: vllm/model_executor/models/mamba2.py on
+    layers/mamba/mamba_mixer2.py (chunked-SSD CUDA kernels). Here the
+    recurrence is the same segmented scan as Mamba-1 with head-major
+    shapes (ops/mamba.ssd_scan_ragged); the conv splits into x and B/C
+    halves (depthwise, so two convs == one) to keep x head-sharded and
+    B/C replicated under TP.
+    """
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        src = MambaForCausalLM.arch_config_source(hf)
+        src.tie_word_embeddings = getattr(hf, "tie_word_embeddings",
+                                          False)
+        return src
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.stateful = True
+        arch.ssm_state_size = hf.state_size
+        arch.conv_kernel = hf.conv_kernel
+        arch.d_inner = arch.intermediate_size
+        arch.num_ssm_heads = hf.num_heads
+        arch.ssm_head_dim = getattr(hf, "head_dim",
+                                    arch.d_inner // hf.num_heads)
+        arch.n_groups = getattr(hf, "n_groups", 1)
+        arch.time_step_limit = tuple(
+            getattr(hf, "time_step_limit", (0.0, float("inf"))))
+        arch.use_conv_bias = bool(getattr(hf, "use_conv_bias", True))
+        arch.use_bias = bool(getattr(hf, "use_bias", False))
+        if not hasattr(arch, "state_slots"):
+            arch.state_slots = 0
+
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        c = self.cfg
+        layer = {
+            "norm": P(None, None),
+            "gated_norm": P(None, MODEL_AXIS),
+            "in_gate": P(None, None, MODEL_AXIS),
+            "in_x": P(None, None, MODEL_AXIS),
+            "in_bc": P(None, None, None),
+            "in_dt": P(None, None, MODEL_AXIS),
+            "conv_x_w": P(None, None, MODEL_AXIS),
+            "conv_bc_w": P(None, None, None),
+            "dt_bias": P(None, MODEL_AXIS),
+            "A_log": P(None, MODEL_AXIS),
+            "D": P(None, MODEL_AXIS),
+            "out_proj": P(None, MODEL_AXIS, None),
+        }
+        if c.use_conv_bias:
+            layer["conv_x_b"] = P(None, MODEL_AXIS)
+            layer["conv_bc_b"] = P(None, None)
+        if c.use_bias:
+            layer["in_b"] = P(None, None)
+            layer["out_b"] = P(None, None)
+        return {
+            "embed": P(None, None),
+            "layers": layer,
+            "final_ln": P(None, ),
+            "lm_head": P(None, MODEL_AXIS),
+        }
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        L, H = c.num_layers, c.hidden_size
+        Di, N, K = c.d_inner, c.ssm_state_size, c.conv_kernel
+        Hm, G = c.num_ssm_heads, c.n_groups
+        keys = iter(jax.random.split(rng, 10))
+
+        def norm(key, shape):
+            return (scale * jax.random.normal(key, shape,
+                                              jnp.float32)).astype(c.dtype)
+
+        layers = {
+            "norm": jnp.ones((L, H), c.dtype),
+            "gated_norm": jnp.ones((L, Di), c.dtype),
+            "in_gate": norm(next(keys), (L, H, Di)),
+            "in_x": norm(next(keys), (L, H, Di)),
+            "in_bc": norm(next(keys), (L, H, 2 * G * N)),
+            "in_dt": norm(next(keys), (L, H, Hm)),
+            "conv_x_w": norm(next(keys), (L, K, Di)),
+            "conv_bc_w": norm(next(keys), (L, K, 2 * G * N)),
+            "dt_bias": jnp.zeros((L, Hm), jnp.float32),
+            "A_log": jnp.broadcast_to(
+                jnp.log(jnp.arange(1, Hm + 1, dtype=jnp.float32)),
+                (L, Hm)),
+            "D": jnp.ones((L, Hm), jnp.float32),
+            "out_proj": norm(next(keys), (L, Di, H)),
+        }
+        if c.use_conv_bias:
+            layers["conv_x_b"] = jnp.zeros((L, Di), c.dtype)
+            layers["conv_bc_b"] = jnp.zeros((L, 2 * G * N), c.dtype)
+        if c.use_bias:
+            layers["in_b"] = jnp.zeros((L, 2 * Di + 2 * G * N + Hm),
+                                       c.dtype)
+            layers["out_b"] = jnp.zeros((L, H), c.dtype)
+        embed = norm(next(keys), (c.vocab_size, H))
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln": jnp.ones((H, ), c.dtype),
+            "lm_head": (embed.T if c.tie_word_embeddings else norm(
+                next(keys), (H, c.vocab_size))),
+        }
+
+    def params_from_hf_state_dict(self, tensors: dict,
+                                  prefix: str = "backbone") -> dict:
+        c = self.cfg
+        Di = c.d_inner
+        GN2 = 2 * c.n_groups * c.ssm_state_size
+        t, stack = self._hf_stackers(tensors)
+        mx = prefix + ".layers.{}.mixer."
+        # in_proj rows: [gate(Di), x(Di), B/C(2GN), dt(Hm)] (d_mlp = 0
+        # for the published Mamba-2 checkpoints).
+        layers = {
+            "norm":
+            stack(prefix + ".layers.{}.norm.weight",
+                  lambda a: a).astype(c.dtype),
+            "gated_norm":
+            stack(mx + "norm.weight", lambda a: a).astype(c.dtype),
+            "in_gate":
+            stack(mx + "in_proj.weight",
+                  lambda a: a[:Di].T).astype(c.dtype),
+            "in_x":
+            stack(mx + "in_proj.weight",
+                  lambda a: a[Di:2 * Di].T).astype(c.dtype),
+            "in_bc":
+            stack(mx + "in_proj.weight",
+                  lambda a: a[2 * Di:2 * Di + GN2].T).astype(c.dtype),
+            "in_dt":
+            stack(mx + "in_proj.weight",
+                  lambda a: a[2 * Di + GN2:].T).astype(c.dtype),
+            "conv_x_w":
+            stack(mx + "conv1d.weight",
+                  lambda a: a[:Di, 0, :].T).astype(c.dtype),
+            "conv_bc_w":
+            stack(mx + "conv1d.weight",
+                  lambda a: a[Di:, 0, :].T).astype(c.dtype),
+            "dt_bias":
+            stack(mx + "dt_bias", lambda a: a).astype(jnp.float32),
+            "A_log":
+            stack(mx + "A_log", lambda a: a).astype(jnp.float32),
+            "D":
+            stack(mx + "D", lambda a: a).astype(jnp.float32),
+            "out_proj":
+            stack(mx + "out_proj.weight", lambda a: a.T).astype(c.dtype),
+        }
+        if c.use_conv_bias:
+            layers["conv_x_b"] = stack(mx + "conv1d.bias",
+                                       lambda a: a[:Di]).astype(c.dtype)
+            layers["conv_bc_b"] = stack(mx + "conv1d.bias",
+                                        lambda a: a[Di:]).astype(c.dtype)
+        if c.use_bias:
+            layers["in_b"] = stack(mx + "in_proj.bias",
+                                   lambda a: a).astype(c.dtype)
+            layers["out_b"] = stack(mx + "out_proj.bias",
+                                    lambda a: a).astype(c.dtype)
+        return self._hf_tail(tensors, layers, prefix)
+
+    # ------------------------------------------------------------------
+    def kv_cache_specs(self) -> dict:
+        return {
+            "conv": P(None, None, None, MODEL_AXIS),
+            "conv_bc": P(None, None, None, None),
+            "ssm": P(None, None, MODEL_AXIS, None, None),
+        }
+
+    def _state_shapes(self, depth: int) -> dict:
+        c = self.cfg
+        S = (c.state_slots or 256) + 1
+        GN2 = 2 * c.n_groups * c.ssm_state_size
+        return {
+            # x and B/C carry separate conv states (their convs split).
+            "conv": ((depth, S, c.conv_kernel - 1, c.d_inner), c.dtype),
+            "conv_bc": ((depth, S, c.conv_kernel - 1, GN2), c.dtype),
+            "ssm": ((depth, S, c.num_ssm_heads, c.ssm_head_dim,
+                     c.ssm_state_size), jnp.float32),
+        }
+
+    def _mixer(self, lp: dict, x: jax.Array, conv_state, conv_bc_state,
+               ssm_state, seg):
+        c = self.cfg
+        Hm, Pd, N, G = (c.num_ssm_heads, c.ssm_head_dim,
+                        c.ssm_state_size, c.n_groups)
+        gate = x @ lp["in_gate"]
+        xin = x @ lp["in_x"]
+        bc = x @ lp["in_bc"]
+        dt_r = x @ lp["in_dt"]  # [T, Hm]
+        if c.use_bias:
+            b = lp["in_b"]
+            Di = c.d_inner
+            gate = gate + b[:Di]
+            xin = xin + b[Di:2 * Di]
+            bc = bc + b[2 * Di:2 * Di + 2 * G * N]
+            dt_r = dt_r + b[2 * Di + 2 * G * N:]
+        xc, conv_state = causal_conv1d_ragged(
+            xin, lp["conv_x_w"], lp.get("conv_x_b"), conv_state, seg)
+        bcc, conv_bc_state = causal_conv1d_ragged(
+            bc, lp["conv_bc_w"], lp.get("conv_bc_b"), conv_bc_state, seg)
+        xc = jax.nn.silu(xc)
+        bcc = jax.nn.silu(bcc)
+        B = bcc[:, :G * N].reshape(-1, G, N)
+        C = bcc[:, G * N:].reshape(-1, G, N)
+        dt = _softplus(dt_r.astype(jnp.float32) + lp["dt_bias"])
+        lo, hi = c.time_step_limit
+        if lo > 0.0 or hi != float("inf"):
+            dt = jnp.clip(dt, lo, hi)
+        A = -jnp.exp(lp["A_log"])  # [Hm]
+        xh = xc.astype(jnp.float32).reshape(-1, Hm, Pd)
+        y, ssm_state = ssd_scan_ragged(xh, dt, A, B, C, lp["D"],
+                                       ssm_state, seg)
+        y = y.reshape(-1, Hm * Pd)
+        # Gated RMSNorm (norm(y * silu(gate)) * weight), f32 like HF.
+        y = y * jax.nn.silu(gate.astype(jnp.float32))
+        y = rms_norm(y, lp["gated_norm"].astype(jnp.float32),
+                     c.rms_norm_eps)
+        out = y.astype(c.dtype) @ lp["out_proj"]
+        if c.use_bias:
+            out = out + lp["out_b"]
+        return out, conv_state, conv_bc_state, ssm_state
+
+    def run_layers(
+        self,
+        layer_params: dict,
+        kv_caches: dict,
+        hidden: jax.Array,
+        batch,
+        first_layer: int = 0,
+    ) -> tuple[jax.Array, dict]:
+        c = self.cfg
+        seg = build_segment_info(batch, kv_caches["ssm"].shape[1] - 1)
+        num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+        layer_ids = jnp.arange(num_layers, dtype=jnp.int32)
+
+        def layer_body(carry, xs):
+            h, conv_all, conv_bc_all, ssm_all = carry
+            lp, li = xs
+            x = rms_norm(h, lp["norm"], c.rms_norm_eps)
+            out, conv_new, conv_bc_new, ssm_new = self._mixer(
+                lp, x, conv_all[li], conv_bc_all[li], ssm_all[li], seg)
+            conv_all = jax.lax.dynamic_update_index_in_dim(
+                conv_all, conv_new, li, 0)
+            conv_bc_all = jax.lax.dynamic_update_index_in_dim(
+                conv_bc_all, conv_bc_new, li, 0)
+            ssm_all = jax.lax.dynamic_update_index_in_dim(
+                ssm_all, ssm_new, li, 0)
+            return (h + out, conv_all, conv_bc_all, ssm_all), None
+
+        carry = (hidden, kv_caches["conv"], kv_caches["conv_bc"],
+                 kv_caches["ssm"])
+        carry, _ = jax.lax.scan(layer_body, carry,
+                                (layer_params, layer_ids))
+        hidden, conv_all, conv_bc_all, ssm_all = carry
+        return hidden, {"conv": conv_all, "conv_bc": conv_bc_all,
+                        "ssm": ssm_all}

@@ -32,7 +32,9 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
-from vllm_distributed_tpu.models.mamba import MambaForCausalLM
+from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
+                                               Mamba2ForCausalLM,
+                                               MambaForCausalLM)
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
 
@@ -76,6 +78,8 @@ _REGISTRY: dict[str, type] = {
     "PersimmonForCausalLM": PersimmonForCausalLM,
     # Selective state-space family (segmented-scan SSM; models/mamba.py).
     "MambaForCausalLM": MambaForCausalLM,
+    "Mamba2ForCausalLM": Mamba2ForCausalLM,
+    "FalconMambaForCausalLM": FalconMambaForCausalLM,
 }
 
 

@@ -275,9 +275,11 @@ def ssd_scan_ragged(
     dt32 = jnp.where(_bshape(seg.valid, dt), dt.astype(jnp.float32), 0.0)
     Bh = jnp.repeat(B.astype(jnp.float32), rep, axis=1)  # [T, Hm, N]
     Ch = jnp.repeat(C.astype(jnp.float32), rep, axis=1)
-    a = jnp.exp(dt32 * A[None, :])  # [T, Hm]
-    a4 = jnp.broadcast_to(a[:, :, None, None], (T, Hm, P,
-                                                ssm_state.shape[-1]))
+    # Decay stays a broadcastable [T, Hm, 1, 1] leaf through the scan
+    # (the combine a1*a2 preserves it; a2*b1+b2 broadcasts), so the
+    # scalar-per-head structure costs 1/(P*N) of the drive's traffic —
+    # the same trick segmented_linear_scan applies to the reset flag.
+    a4 = jnp.exp(dt32 * A[None, :])[:, :, None, None]  # [T, Hm, 1, 1]
     b = (dt32[:, :, None] * x32)[..., None] * Bh[:, :, None, :]
 
     h_carry = ssm_state[seg.row]
