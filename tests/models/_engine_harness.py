@@ -1,0 +1,47 @@
+"""Shared tiny-model engine harness for per-family parity tests
+(reference pattern: the HfRunner/VllmRunner pair of tests/conftest.py
+in the reference repo — build a tiny HF checkpoint, drive the full
+engine, compare greedy tokens)."""
+
+import torch
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+PROMPTS = [
+    [3, 17, 92, 45, 8, 21, 60, 5],
+    [5, 9, 33, 71],
+    [2, 7],
+]
+
+
+def hf_greedy(hf, prompt, n):
+    with torch.no_grad():
+        out = hf.generate(torch.tensor([prompt]), max_new_tokens=n,
+                          do_sample=False, eos_token_id=None)
+    return out[0].tolist()[len(prompt):]
+
+
+def run_engine(path, prompts, max_tokens=6, **overrides):
+    """Greedy-decode ``prompts`` through the full engine; returns the
+    generated token id lists in prompt order."""
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    engine = LLMEngine(EngineArgs(**args).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r-{i}", p, sp)
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    return [done[f"r-{i}"].outputs[0].token_ids
+            for i in range(len(prompts))]

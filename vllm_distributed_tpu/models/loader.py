@@ -124,6 +124,24 @@ def get_model(config: EngineConfig, mesh,
                 "KV transfer for stateful (SSM) models is not wired "
                 "(their state lives in per-request rows, not pages); "
                 "drop the kv-transfer config")
+        if config.parallel_config.token_parallel_size > 1:
+            raise ValueError(
+                "token parallelism over stateful (SSM) models is not "
+                "wired (state rows are not partitioned per rank); "
+                "disable one")
+        if config.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError(
+                "pipeline parallelism over stateful (SSM) models is "
+                "not wired (hybrid per-kind stacks don't slice per "
+                "stage); disable one")
+        if config.parallel_config.enable_expert_parallel:
+            raise ValueError(
+                "expert parallelism over stateful hybrid models is not "
+                "wired; disable enable_expert_parallel")
+        if config.parallel_config.num_redundant_experts:
+            raise ValueError(
+                "EPLB redundant experts over stateful hybrid models "
+                "are not wired; drop num_redundant_experts")
     if ((arch.sliding_window or arch.window_pattern
          or arch.attn_logit_softcap)
             and config.parallel_config.token_parallel_size > 1):

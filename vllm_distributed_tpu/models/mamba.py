@@ -323,6 +323,12 @@ class MambaForCausalLM(LlamaForCausalLM):
             dt_r = rms_norm(dt_r.astype(jnp.float32), ones, eps)
             B = rms_norm(B.astype(jnp.float32), ones, eps)
             C = rms_norm(C.astype(jnp.float32), ones, eps)
+        if "dt_ln" in lp:
+            # Jamba: learned RMSNorms on the selection vectors
+            # (dt_layernorm/b_layernorm/c_layernorm).
+            dt_r = rms_norm(dt_r, lp["dt_ln"], c.rms_norm_eps)
+            B = rms_norm(B, lp["b_ln"], c.rms_norm_eps)
+            C = rms_norm(C, lp["c_ln"], c.rms_norm_eps)
         dt = _softplus(
             dt_r @ lp["dt_w"] + lp["dt_b"])  # [T, Di] f32 bias
         A = -jnp.exp(lp["A_log"])  # [Di, N] f32

@@ -32,6 +32,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.jamba import JambaForCausalLM
 from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
                                                Mamba2ForCausalLM,
                                                MambaForCausalLM)
@@ -80,6 +81,8 @@ _REGISTRY: dict[str, type] = {
     "MambaForCausalLM": MambaForCausalLM,
     "Mamba2ForCausalLM": Mamba2ForCausalLM,
     "FalconMambaForCausalLM": FalconMambaForCausalLM,
+    # Hybrid attention/mamba/MoE (hybrid cache groups; models/jamba.py).
+    "JambaForCausalLM": JambaForCausalLM,
 }
 
 
