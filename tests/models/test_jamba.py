@@ -11,7 +11,6 @@ from transformers import JambaConfig, JambaForCausalLM
 
 from _engine_harness import PROMPTS, hf_greedy, run_engine as run
 from vllm_distributed_tpu.engine.arg_utils import EngineArgs
-from vllm_distributed_tpu.engine.llm_engine import LLMEngine
 
 
 @pytest.fixture(scope="module")
@@ -53,6 +52,48 @@ def test_jamba_chunked_prefill_threads_state(jamba_ckpt):
 
 def test_jamba_tp2_matches_single_chip(jamba_ckpt):
     path, hf = jamba_ckpt
+    expect = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    got = run(path, PROMPTS, tensor_parallel_size=2)
+    assert got == expect
+
+
+@pytest.fixture(scope="module")
+def bamba_ckpt(tmp_path_factory):
+    """3 layers: mamba2 / attention(partial rotary) / mamba2."""
+    from transformers import BambaConfig, BambaForCausalLM
+    torch.manual_seed(4)
+    cfg = BambaConfig(vocab_size=128, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=3,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      attn_layer_indices=[1], mamba_n_heads=8,
+                      mamba_d_head=8, mamba_n_groups=2, mamba_d_state=8,
+                      mamba_d_conv=4, mamba_expand=2,
+                      max_position_embeddings=64, eos_token_id=1,
+                      tie_word_embeddings=False)
+    hf = BambaForCausalLM(cfg)
+    path = tmp_path_factory.mktemp("bamba-tiny")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf.eval()
+
+
+def test_bamba_greedy_matches_hf(bamba_ckpt):
+    path, hf = bamba_ckpt
+    expect = [hf_greedy(hf, p, 6) for p in PROMPTS]
+    got = run(path, PROMPTS)
+    assert got == expect
+
+
+def test_bamba_chunked_prefill_threads_state(bamba_ckpt):
+    path, hf = bamba_ckpt
+    long_prompt = [(i * 13 + 1) % 128 for i in range(40)]
+    expect = [hf_greedy(hf, long_prompt, 6)]
+    got = run(path, [long_prompt], max_num_batched_tokens=16,
+              max_model_len=64)
+    assert got == expect
+
+
+def test_bamba_tp2_matches_single_chip(bamba_ckpt):
+    path, hf = bamba_ckpt
     expect = [hf_greedy(hf, p, 6) for p in PROMPTS]
     got = run(path, PROMPTS, tensor_parallel_size=2)
     assert got == expect

@@ -1,0 +1,330 @@
+"""Bamba: hybrid Mamba-2 / attention decoder (IBM Bamba family).
+
+Reference surface: vllm/model_executor/models/bamba.py — Mamba-2 (SSD)
+mixers on most layers, GQA attention with PARTIAL rotary embeddings on
+the layers named by attn_layer_indices, a dense SwiGLU FFN on every
+layer, hybrid cache groups sizing attention pages separately from SSM
+state.
+
+TPU design mirrors models/jamba.py (per-kind stacked parameter
+subtrees, unrolled heterogeneous layer walk) with the Mamba-2 mixer of
+models/mamba.py (segmented SSD scan, split x / B-C depthwise convs)
+and llama-style partial rotary on the attention layers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.common import (apply_rope_single,
+                                                compute_rope_cos_sin,
+                                                rms_norm, swiglu)
+from vllm_distributed_tpu.models.jamba import JambaForCausalLM
+from vllm_distributed_tpu.models.llama import MODEL_AXIS
+from vllm_distributed_tpu.models.mamba import Mamba2ForCausalLM
+from vllm_distributed_tpu.ops.attention import (paged_attention,
+                                                write_kv_cache)
+from vllm_distributed_tpu.ops.mamba import build_segment_info
+
+
+class BambaForCausalLM(JambaForCausalLM):
+    """Hybrid Mamba-2 / partial-rotary-attention stack."""
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.stateful = True
+        # Mamba-2 mixer geometry (names shared with models/mamba.py
+        # Mamba2ForCausalLM._mixer).
+        arch.ssm_state_size = hf.mamba_d_state
+        arch.conv_kernel = hf.mamba_d_conv
+        arch.d_inner = hf.mamba_expand * hf.hidden_size
+        arch.num_ssm_heads = hf.mamba_n_heads
+        arch.ssm_head_dim = getattr(
+            hf, "mamba_d_head", arch.d_inner // hf.mamba_n_heads)
+        arch.n_groups = getattr(hf, "mamba_n_groups", 1)
+        arch.time_step_limit = tuple(
+            getattr(hf, "time_step_limit", None)
+            or (0.0, float("inf")))
+        arch.use_conv_bias = bool(getattr(hf, "mamba_conv_bias", True))
+        if getattr(hf, "mamba_proj_bias", False):
+            raise ValueError(
+                "Bamba mamba_proj_bias checkpoints are not supported")
+        arch.use_bias = False
+        # Attention layer set + partial rotary.
+        idx = getattr(hf, "attn_layer_indices", None) or []
+        arch.attn_indices = tuple(idx)
+        factor = getattr(hf, "partial_rotary_factor", None) or 1.0
+        arch.rotary_dim = int(arch.head_dim * factor)
+        arch.num_experts = 0
+        if not hasattr(arch, "state_slots"):
+            arch.state_slots = 0
+
+    def _is_attn(self, i: int) -> bool:
+        return i in self.cfg.attn_indices
+
+    def _is_moe(self, i: int) -> bool:
+        return False
+
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        c = self.cfg
+        col = P(None, None, MODEL_AXIS)
+        row = P(None, MODEL_AXIS, None)
+        layer = {
+            "a_ln": P(None, None),
+            "a_wq": col, "a_wk": col, "a_wv": col, "a_wo": row,
+            "a_pre_ln": P(None, None),
+            "a_gate": col, "a_up": col, "a_down": row,
+            "m_norm": P(None, None),
+            "m_gated_norm": P(None, MODEL_AXIS),
+            "m_in_gate": col, "m_in_x": col,
+            "m_in_bc": P(None, None, None),
+            "m_in_dt": col,
+            "m_conv_x_w": col,
+            "m_conv_bc_w": P(None, None, None),
+            "m_dt_bias": P(None, MODEL_AXIS),
+            "m_A_log": P(None, MODEL_AXIS),
+            "m_D": P(None, MODEL_AXIS),
+            "m_out_proj": row,
+            "m_pre_ln": P(None, None),
+            "m_gate": col, "m_up": col, "m_down": row,
+        }
+        if c.use_conv_bias:
+            layer["m_conv_x_b"] = P(None, MODEL_AXIS)
+            layer["m_conv_bc_b"] = P(None, None)
+        return {
+            "embed": P(None, None),
+            "layers": layer,
+            "final_ln": P(None, ),
+            "lm_head": P(None, MODEL_AXIS),
+        }
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        H, I = c.hidden_size, c.intermediate_size
+        Di, N, K = c.d_inner, c.ssm_state_size, c.conv_kernel
+        Hm, G = c.num_ssm_heads, c.n_groups
+        La, Lm = len(self._attn_layers), len(self._mamba_layers)
+        Dq = c.num_q_heads * c.head_dim
+        Dkv = c.total_kv_heads * c.head_dim
+        keys = iter(jax.random.split(rng, 24))
+
+        def norm(key, shape):
+            return (scale * jax.random.normal(key, shape,
+                                              jnp.float32)).astype(c.dtype)
+
+        layers = {
+            "a_ln": jnp.ones((La, H), c.dtype),
+            "a_wq": norm(next(keys), (La, H, Dq)),
+            "a_wk": norm(next(keys), (La, H, Dkv)),
+            "a_wv": norm(next(keys), (La, H, Dkv)),
+            "a_wo": norm(next(keys), (La, Dq, H)),
+            "a_pre_ln": jnp.ones((La, H), c.dtype),
+            "a_gate": norm(next(keys), (La, H, I)),
+            "a_up": norm(next(keys), (La, H, I)),
+            "a_down": norm(next(keys), (La, I, H)),
+            "m_norm": jnp.ones((Lm, H), c.dtype),
+            "m_gated_norm": jnp.ones((Lm, Di), c.dtype),
+            "m_in_gate": norm(next(keys), (Lm, H, Di)),
+            "m_in_x": norm(next(keys), (Lm, H, Di)),
+            "m_in_bc": norm(next(keys), (Lm, H, 2 * G * N)),
+            "m_in_dt": norm(next(keys), (Lm, H, Hm)),
+            "m_conv_x_w": norm(next(keys), (Lm, K, Di)),
+            "m_conv_bc_w": norm(next(keys), (Lm, K, 2 * G * N)),
+            "m_dt_bias": jnp.zeros((Lm, Hm), jnp.float32),
+            "m_A_log": jnp.broadcast_to(
+                jnp.log(jnp.arange(1, Hm + 1, dtype=jnp.float32)),
+                (Lm, Hm)),
+            "m_D": jnp.ones((Lm, Hm), jnp.float32),
+            "m_out_proj": norm(next(keys), (Lm, Di, H)),
+            "m_pre_ln": jnp.ones((Lm, H), c.dtype),
+            "m_gate": norm(next(keys), (Lm, H, I)),
+            "m_up": norm(next(keys), (Lm, H, I)),
+            "m_down": norm(next(keys), (Lm, I, H)),
+        }
+        if c.use_conv_bias:
+            layers["m_conv_x_b"] = jnp.zeros((Lm, Di), c.dtype)
+            layers["m_conv_bc_b"] = jnp.zeros((Lm, 2 * G * N), c.dtype)
+        embed = norm(next(keys), (c.vocab_size, H))
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln": jnp.ones((H, ), c.dtype),
+            "lm_head": (embed.T if c.tie_word_embeddings else norm(
+                next(keys), (H, c.vocab_size))),
+        }
+
+    def params_from_hf_state_dict(self, tensors: dict,
+                                  prefix: str = "model") -> dict:
+        c = self.cfg
+        Di = c.d_inner
+        GN2 = 2 * c.n_groups * c.ssm_state_size
+
+        def t(name):
+            return np.asarray(tensors[name])
+
+        def stack(ids, fmt, f=lambda a: a, dtype=None):
+            return jnp.asarray(np.stack(
+                [f(t(fmt.format(i))) for i in ids])).astype(
+                    dtype or c.dtype)
+
+        A, M = self._attn_layers, self._mamba_layers
+        ly = prefix + ".layers.{}."
+        layers = {
+            "a_ln": stack(A, ly + "input_layernorm.weight"),
+            "a_wq": stack(A, ly + "self_attn.q_proj.weight",
+                          lambda a: a.T),
+            "a_wk": stack(A, ly + "self_attn.k_proj.weight",
+                          lambda a: a.T),
+            "a_wv": stack(A, ly + "self_attn.v_proj.weight",
+                          lambda a: a.T),
+            "a_wo": stack(A, ly + "self_attn.o_proj.weight",
+                          lambda a: a.T),
+            "a_pre_ln": stack(A, ly + "pre_ff_layernorm.weight"),
+            "a_gate": stack(A, ly + "feed_forward.gate_proj.weight",
+                            lambda a: a.T),
+            "a_up": stack(A, ly + "feed_forward.up_proj.weight",
+                          lambda a: a.T),
+            "a_down": stack(A, ly + "feed_forward.down_proj.weight",
+                            lambda a: a.T),
+            "m_norm": stack(M, ly + "input_layernorm.weight"),
+            "m_gated_norm": stack(M, ly + "mamba.norm.weight"),
+            "m_in_gate": stack(M, ly + "mamba.in_proj.weight",
+                               lambda a: a[:Di].T),
+            "m_in_x": stack(M, ly + "mamba.in_proj.weight",
+                            lambda a: a[Di:2 * Di].T),
+            "m_in_bc": stack(M, ly + "mamba.in_proj.weight",
+                             lambda a: a[2 * Di:2 * Di + GN2].T),
+            "m_in_dt": stack(M, ly + "mamba.in_proj.weight",
+                             lambda a: a[2 * Di + GN2:].T),
+            "m_conv_x_w": stack(M, ly + "mamba.conv1d.weight",
+                                lambda a: a[:Di, 0, :].T),
+            "m_conv_bc_w": stack(M, ly + "mamba.conv1d.weight",
+                                 lambda a: a[Di:, 0, :].T),
+            "m_dt_bias": stack(M, ly + "mamba.dt_bias",
+                               dtype=jnp.float32),
+            "m_A_log": stack(M, ly + "mamba.A_log", dtype=jnp.float32),
+            "m_D": stack(M, ly + "mamba.D", dtype=jnp.float32),
+            "m_out_proj": stack(M, ly + "mamba.out_proj.weight",
+                                lambda a: a.T),
+            "m_pre_ln": stack(M, ly + "pre_ff_layernorm.weight"),
+            "m_gate": stack(M, ly + "feed_forward.gate_proj.weight",
+                            lambda a: a.T),
+            "m_up": stack(M, ly + "feed_forward.up_proj.weight",
+                          lambda a: a.T),
+            "m_down": stack(M, ly + "feed_forward.down_proj.weight",
+                            lambda a: a.T),
+        }
+        if c.use_conv_bias:
+            layers["m_conv_x_b"] = stack(M, ly + "mamba.conv1d.bias",
+                                         lambda a: a[:Di])
+            layers["m_conv_bc_b"] = stack(M, ly + "mamba.conv1d.bias",
+                                          lambda a: a[Di:])
+        if c.num_kv_head_replicas > 1:
+            from vllm_distributed_tpu.models.llama import \
+                _replicate_kv_heads
+            for name in ("a_wk", "a_wv"):
+                layers[name] = _replicate_kv_heads(
+                    layers[name], c.num_kv_heads, c.num_kv_head_replicas)
+        embed = jnp.asarray(t(prefix + ".embed_tokens.weight")).astype(
+            c.dtype)
+        if c.tie_word_embeddings or "lm_head.weight" not in tensors:
+            lm_head = embed.T
+        else:
+            lm_head = jnp.asarray(t("lm_head.weight")).T.astype(c.dtype)
+        return {
+            "embed": embed,
+            "layers": layers,
+            "final_ln": jnp.asarray(
+                t(prefix + ".final_layernorm.weight")).astype(c.dtype),
+            "lm_head": lm_head,
+        }
+
+    # ------------------------------------------------------------------
+    def _state_shapes(self, depth: int) -> dict:
+        # Must match the Mamba-2 mixer's state layout exactly: delegate
+        # to the single source of truth in models/mamba.py.
+        return Mamba2ForCausalLM._state_shapes(self, depth)
+
+    def kv_cache_specs(self) -> dict:
+        # Paged K/V specs from the hybrid base + Mamba-2 state specs.
+        return {**JambaForCausalLM.kv_cache_specs(self),
+                **Mamba2ForCausalLM.kv_cache_specs(self)}
+
+    # ------------------------------------------------------------------
+    def run_layers(
+        self,
+        layer_params: dict,
+        kv_caches: dict,
+        hidden: jax.Array,
+        batch,
+        first_layer: int = 0,
+    ) -> tuple[jax.Array, dict]:
+        c = self.cfg
+        T = hidden.shape[0]
+        seg = build_segment_info(batch, kv_caches["ssm"].shape[1] - 1)
+        sm_scale = c.head_dim**-0.5
+        rd = c.rotary_dim or c.head_dim
+        cos, sin = compute_rope_cos_sin(batch.positions, rd,
+                                        c.rope_theta, c.rope_scaling,
+                                        dtype=jnp.float32)
+
+        def rope(x):
+            x32 = x.astype(jnp.float32)
+            rot = apply_rope_single(x32[..., :rd], cos, sin)
+            if rd == c.head_dim:
+                return rot.astype(c.dtype)
+            return jnp.concatenate([rot, x32[..., rd:]],
+                                   axis=-1).astype(c.dtype)
+
+        def sub(prefix, j):
+            return {
+                k[len(prefix):]: v[j]
+                for k, v in layer_params.items() if k.startswith(prefix)
+            }
+
+        h = hidden
+        k_all, v_all = kv_caches["k"], kv_caches["v"]
+        conv_all = kv_caches["conv"]
+        conv_bc_all = kv_caches["conv_bc"]
+        ssm_all = kv_caches["ssm"]
+        ai = mi = 0
+        for i in range(c.num_layers):
+            if self._is_attn(i):
+                lp = sub("a_", ai)
+                x = rms_norm(h, lp["ln"], c.rms_norm_eps)
+                q = rope((x @ lp["wq"]).reshape(T, c.num_q_heads,
+                                                c.head_dim))
+                k = rope((x @ lp["wk"]).reshape(T, c.total_kv_heads,
+                                                c.head_dim))
+                v = (x @ lp["wv"]).reshape(T, c.total_kv_heads,
+                                           c.head_dim)
+                li = jnp.full((1, ), ai, jnp.int32)
+                k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
+                                              li)
+                attn = paged_attention(q, k_all, v_all, batch,
+                                       sm_scale=sm_scale, layer=li,
+                                       window=0)
+                h = h + attn.reshape(T, -1) @ lp["wo"]
+                x2 = rms_norm(h, lp["pre_ln"], c.rms_norm_eps)
+                h = h + swiglu(x2, lp["gate"], lp["up"], lp["down"])
+                ai += 1
+            else:
+                lp = sub("m_", mi)
+                x = rms_norm(h, lp["norm"], c.rms_norm_eps)
+                out, conv_new, conv_bc_new, ssm_new = \
+                    Mamba2ForCausalLM._mixer(
+                        self, lp, x, conv_all[mi], conv_bc_all[mi],
+                        ssm_all[mi], seg)
+                conv_all = conv_all.at[mi].set(conv_new)
+                conv_bc_all = conv_bc_all.at[mi].set(conv_bc_new)
+                ssm_all = ssm_all.at[mi].set(ssm_new)
+                h = h + out
+                x2 = rms_norm(h, lp["pre_ln"], c.rms_norm_eps)
+                h = h + swiglu(x2, lp["gate"], lp["up"], lp["down"])
+                mi += 1
+        return h, {"k": k_all, "v": v_all, "conv": conv_all,
+                   "conv_bc": conv_bc_all, "ssm": ssm_all}

@@ -32,6 +32,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.bamba import BambaForCausalLM
 from vllm_distributed_tpu.models.jamba import JambaForCausalLM
 from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
                                                Mamba2ForCausalLM,
@@ -83,6 +84,8 @@ _REGISTRY: dict[str, type] = {
     "FalconMambaForCausalLM": FalconMambaForCausalLM,
     # Hybrid attention/mamba/MoE (hybrid cache groups; models/jamba.py).
     "JambaForCausalLM": JambaForCausalLM,
+    # Hybrid Mamba-2/attention (models/bamba.py).
+    "BambaForCausalLM": BambaForCausalLM,
 }
 
 
