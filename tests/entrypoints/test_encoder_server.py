@@ -1,0 +1,149 @@
+"""HTTP tests for the encoder-model serving surface: /v1/embeddings on a
+BERT checkpoint, /v1/score and /v1/rerank on a cross-encoder (reference:
+serving_embedding.py + serving_score.py of the reference's OpenAI
+server)."""
+
+import asyncio
+import threading
+
+import httpx
+import numpy as np
+import pytest
+import torch
+import transformers
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.utils import get_open_port
+
+VOCAB = 96
+
+
+def _save_tokenizer(path):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(VOCAB - 2)}
+    vocab["<unk>"] = VOCAB - 2
+    vocab["</s>"] = VOCAB - 1
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token="<unk>", eos_token="</s>")
+    fast.save_pretrained(path)
+    return fast
+
+
+def _serve(path):
+    engine_args = EngineArgs(model=path, dtype="float32", block_size=4,
+                             max_model_len=32, max_num_batched_tokens=64,
+                             max_num_seqs=8)
+    engine = AsyncLLM(engine_args.create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["stop"], holder["loop"] = stop, loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready, stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120), "server did not start"
+    return f"http://127.0.0.1:{port}", holder, t
+
+
+@pytest.fixture(scope="module")
+def cross_server(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tiny_cross_served"))
+    cfg = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2, num_labels=1)
+    torch.manual_seed(7)
+    hf = transformers.BertForSequenceClassification(cfg).eval()
+    hf.save_pretrained(path, safe_serialization=True)
+    tok = _save_tokenizer(path)
+    base, holder, t = _serve(path)
+    yield base, hf, tok
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    t.join(timeout=30)
+
+
+def _hf_score(hf, tok, q, d):
+    enc = tok(q, d)
+    ids = torch.tensor([enc["input_ids"]], dtype=torch.long)
+    tt = enc.get("token_type_ids")
+    tt = torch.tensor([tt if tt else [0] * ids.shape[1]], dtype=torch.long)
+    with torch.no_grad():
+        return float(hf(input_ids=ids, token_type_ids=tt)
+                     .logits.numpy()[0, 0])
+
+
+def test_score_endpoint_matches_hf(cross_server):
+    base, hf, tok = cross_server
+    r = httpx.post(f"{base}/v1/score", timeout=300, json={
+        "text_1": "w3 w17 w45",
+        "text_2": ["w8 w21 w5", "w60 w2"],
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert [d["index"] for d in body["data"]] == [0, 1]
+    for d, doc in zip(body["data"], ["w8 w21 w5", "w60 w2"]):
+        ref = _hf_score(hf, tok, "w3 w17 w45", doc)
+        np.testing.assert_allclose(d["score"], ref, atol=5e-4, rtol=5e-3)
+    assert body["usage"]["prompt_tokens"] > 0
+
+
+def test_rerank_endpoint_orders_by_score(cross_server):
+    base, hf, tok = cross_server
+    docs = ["w8 w21 w5", "w60 w2", "w11 w12 w13"]
+    r = httpx.post(f"{base}/v1/rerank", timeout=300, json={
+        "query": "w3 w17 w45",
+        "documents": docs,
+        "top_n": 2,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert len(body["results"]) == 2
+    refs = sorted(((_hf_score(hf, tok, "w3 w17 w45", d), i)
+                   for i, d in enumerate(docs)), reverse=True)
+    got = [res["index"] for res in body["results"]]
+    assert got == [i for _, i in refs[:2]]
+    scores = [res["relevance_score"] for res in body["results"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_rerank_accepts_bare_string_document(cross_server):
+    base, _, _ = cross_server
+    r = httpx.post(f"{base}/v1/rerank", timeout=300, json={
+        "query": "w3 w17", "documents": "w8 w21 w5",
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert len(body["results"]) == 1
+    assert body["results"][0]["document"]["text"] == "w8 w21 w5"
+
+
+def test_embeddings_endpoint_on_encoder_model(cross_server):
+    base, _, _ = cross_server
+    r = httpx.post(f"{base}/v1/embeddings", timeout=300, json={
+        "input": ["w3 w17 w45", "w8 w21"],
+    })
+    assert r.status_code == 200, r.text
+    data = r.json()["data"]
+    assert len(data) == 2 and len(data[0]["embedding"]) == 32
+
+
+def test_completions_rejected_on_encoder_model(cross_server):
+    base, _, _ = cross_server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "prompt": "w3 w17", "max_tokens": 4,
+    })
+    assert r.status_code == 400
+    assert "encoder-only" in r.text

@@ -1,0 +1,281 @@
+"""Encoder-only (BERT/RoBERTa) parity + e2e embedding/scoring tests
+(reference pattern: the embedding-model parity tests of the reference's
+tests/models/embedding/, exercising BertEmbeddingModel / cross-encoder
+checkpoints through the engine)."""
+
+import numpy as np
+import pytest
+import torch
+import transformers
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
+                                              BertForSequenceClassification,
+                                              RobertaEmbeddingModel)
+from vllm_distributed_tpu.models.llama import LlamaArchConfig
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+import jax.numpy as jnp
+
+
+def _tiny_bert_cfg(**kw):
+    return transformers.BertConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_act="gelu", **kw)
+
+
+def _build(model_cls, hf_model, hf_cfg):
+    arch = LlamaArchConfig.from_hf_config(
+        model_cls.arch_config_source(hf_cfg), dtype=jnp.float32)
+    model_cls.configure_arch(arch, hf_cfg)
+    model = model_cls(arch)
+    sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = model.params_from_hf_state_dict(sd, dtype=jnp.float32)
+    return model, params
+
+
+def _pad_batch(prompts, L):
+    R = len(prompts)
+    ids = np.zeros((R, L), np.int32)
+    valid = np.zeros((R, L), bool)
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+        valid[i, :len(p)] = True
+    return ids, valid
+
+
+PROMPTS = [[2, 17, 45, 8, 21, 5], [2, 9, 33, 5], [2, 7, 5]]
+
+
+def test_bert_hidden_state_matches_hf():
+    cfg = _tiny_bert_cfg()
+    torch.manual_seed(0)
+    hf = transformers.BertModel(cfg)
+    hf.eval()
+    model, params = _build(BertEmbeddingModel, hf, cfg)
+
+    L = 8
+    ids, valid = _pad_batch(PROMPTS, L)
+    hidden = model.encode(params, jnp.asarray(ids),
+                          jnp.zeros_like(jnp.asarray(ids)),
+                          jnp.asarray(valid))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(valid, dtype=torch.long))
+    ref = out.last_hidden_state.numpy()
+    for i, p in enumerate(PROMPTS):
+        np.testing.assert_allclose(np.asarray(hidden)[i, :len(p)],
+                                   ref[i, :len(p)], atol=2e-4, rtol=2e-3)
+
+    # Pooling variants agree with their definitions on the valid span.
+    pooled = model.pool(params, hidden, jnp.asarray(valid))
+    for i, p in enumerate(PROMPTS):
+        np.testing.assert_allclose(np.asarray(pooled["cls"])[i],
+                                   ref[i, 0], atol=2e-4, rtol=2e-3)
+        np.testing.assert_allclose(np.asarray(pooled["mean"])[i],
+                                   ref[i, :len(p)].mean(0), atol=2e-4,
+                                   rtol=2e-3)
+        np.testing.assert_allclose(np.asarray(pooled["last"])[i],
+                                   ref[i, len(p) - 1], atol=2e-4,
+                                   rtol=2e-3)
+
+
+def test_bert_cross_encoder_score_matches_hf():
+    cfg = _tiny_bert_cfg(num_labels=1)
+    torch.manual_seed(1)
+    hf = transformers.BertForSequenceClassification(cfg)
+    hf.eval()
+    model, params = _build(BertForSequenceClassification, hf, cfg)
+
+    L = 8
+    ids, valid = _pad_batch(PROMPTS, L)
+    type_ids = np.zeros((len(PROMPTS), L), np.int32)
+    type_ids[0, 3:6] = 1  # second segment of a (query, doc) pair
+    hidden = model.encode(params, jnp.asarray(ids),
+                          jnp.asarray(type_ids), jnp.asarray(valid))
+    pooled = model.pool(params, hidden, jnp.asarray(valid))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids, dtype=torch.long),
+                 token_type_ids=torch.tensor(type_ids, dtype=torch.long),
+                 attention_mask=torch.tensor(valid, dtype=torch.long))
+    np.testing.assert_allclose(np.asarray(pooled["logits"]),
+                               out.logits.numpy(), atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(pooled["score"]),
+                               out.logits.numpy()[:, 0], atol=2e-4,
+                               rtol=2e-3)
+
+
+def test_roberta_position_offset_matches_hf():
+    cfg = transformers.RobertaConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=68, type_vocab_size=1,
+        pad_token_id=1)
+    torch.manual_seed(2)
+    hf = transformers.RobertaModel(cfg)
+    hf.eval()
+    model, params = _build(RobertaEmbeddingModel, hf, cfg)
+
+    L = 8
+    ids, valid = _pad_batch(PROMPTS, L)
+    hidden = model.encode(params, jnp.asarray(ids),
+                          jnp.zeros_like(jnp.asarray(ids)),
+                          jnp.asarray(valid))
+    # HF roberta computes positions from the attention mask (offset by
+    # padding_idx + 1 = 2 for left-aligned rows, same as our arange).
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(valid, dtype=torch.long))
+    ref = out.last_hidden_state.numpy()
+    for i, p in enumerate(PROMPTS):
+        np.testing.assert_allclose(np.asarray(hidden)[i, :len(p)],
+                                   ref[i, :len(p)], atol=2e-4, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the engine (encoder runner + scheduler).
+# ---------------------------------------------------------------------------
+def _save(tmp_path_factory, name, hf):
+    path = str(tmp_path_factory.mktemp(name))
+    hf.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+@pytest.fixture(scope="module")
+def bert_ckpt(tmp_path_factory):
+    cfg = _tiny_bert_cfg()
+    torch.manual_seed(3)
+    hf = transformers.BertModel(cfg)
+    hf.eval()
+    return _save(tmp_path_factory, "tiny_bert", hf), hf
+
+
+@pytest.fixture(scope="module")
+def cross_encoder_ckpt(tmp_path_factory):
+    cfg = _tiny_bert_cfg(num_labels=1)
+    torch.manual_seed(4)
+    hf = transformers.BertForSequenceClassification(cfg)
+    hf.eval()
+    return _save(tmp_path_factory, "tiny_cross", hf), hf
+
+
+def _make_engine(path, **overrides):
+    args = dict(model=path, dtype="float32", block_size=4,
+                max_model_len=32, max_num_batched_tokens=64,
+                max_num_seqs=8, skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def _run_pooling(engine, prompts, pooling_list):
+    sp = SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True)
+    for i, (p, pool) in enumerate(zip(prompts, pooling_list)):
+        engine.add_request(f"e-{i}", p, sp, pooling_params=pool)
+    done = {}
+    for _ in range(100):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    return [np.asarray(done[f"e-{i}"].embedding, np.float32)
+            for i in range(len(prompts))]
+
+
+def test_encoder_e2e_embeddings_match_hf(bert_ckpt):
+    path, hf = bert_ckpt
+    engine = _make_engine(path)
+    embs = _run_pooling(
+        engine, PROMPTS,
+        [{"type": "cls"}, {"type": "mean"}, {"type": "cls"}])
+    L = max(len(p) for p in PROMPTS)
+    ids, valid = _pad_batch(PROMPTS, L)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(valid, dtype=torch.long)
+                 ).last_hidden_state.numpy()
+    np.testing.assert_allclose(embs[0], ref[0, 0], atol=5e-4, rtol=5e-3)
+    np.testing.assert_allclose(
+        embs[1], ref[1, :len(PROMPTS[1])].mean(0), atol=5e-4, rtol=5e-3)
+    np.testing.assert_allclose(embs[2], ref[2, 0], atol=5e-4, rtol=5e-3)
+
+
+def test_encoder_e2e_generate_rejected(bert_ckpt):
+    path, _ = bert_ckpt
+    engine = _make_engine(path)
+    with pytest.raises(ValueError, match="encoder-only"):
+        engine.add_request(
+            "g-0", [2, 7, 5],
+            SamplingParams(temperature=0.0, max_tokens=4))
+
+
+def test_score_pooling_rejected_without_head(bert_ckpt):
+    """'score' on a plain embedding checkpoint must 400 at admission —
+    a runner-side raise would kill the engine core for everyone."""
+    path, _ = bert_ckpt
+    engine = _make_engine(path)
+    with pytest.raises(ValueError, match="classification"):
+        engine.add_request(
+            "s-0", [2, 7, 5],
+            SamplingParams(temperature=0.0, max_tokens=1),
+            pooling_params={"type": "score"})
+    # The engine survives and still serves embedding requests.
+    embs = _run_pooling(engine, [PROMPTS[2]], [{"type": "cls"}])
+    assert len(embs[0]) == 32
+
+
+def test_roberta_prompt_beyond_position_capacity_rejected(
+        tmp_path_factory):
+    """RoBERTa's position table minus its offset bounds admissible
+    prompts (a 20-row table with offset 2 holds 18 tokens)."""
+    cfg = transformers.RobertaConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=20, type_vocab_size=1, pad_token_id=1)
+    torch.manual_seed(5)
+    hf = transformers.RobertaModel(cfg)
+    path = _save(tmp_path_factory, "tiny_roberta_cap", hf)
+    engine = _make_engine(path, max_model_len=20)
+    sp = SamplingParams(temperature=0.0, max_tokens=1)
+    with pytest.raises(ValueError, match="position capacity"):
+        engine.add_request("c-0", list(range(2, 21)), sp,
+                           pooling_params={"type": "cls"})
+    # 18 tokens fit.
+    embs = _run_pooling(engine, [list(range(2, 20))], [{"type": "cls"}])
+    assert len(embs[0]) == 32
+
+
+def test_llm_score_uses_cross_encoder_head(cross_encoder_ckpt):
+    """LLM.score on a classification checkpoint runs the pair through
+    the head (reference: the cross-encoder mode of LLM.score)."""
+    from vllm_distributed_tpu.entrypoints.llm import LLM
+    path, hf = cross_encoder_ckpt
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              max_model_len=32, max_num_batched_tokens=64,
+              max_num_seqs=8, skip_tokenizer_init=True)
+    q, d = [2, 17, 45], [60, 8, 21, 5]
+    scores = llm.score([q], [d])
+    with torch.no_grad():
+        ids = torch.tensor([q + d], dtype=torch.long)
+        tt = torch.tensor([[0] * len(q) + [1] * len(d)], dtype=torch.long)
+        ref = hf(input_ids=ids, token_type_ids=tt).logits.numpy()[0, 0]
+    assert len(scores) == 1
+    np.testing.assert_allclose(scores[0], ref, atol=5e-4, rtol=5e-3)
+
+
+def test_cross_encoder_e2e_score_matches_hf(cross_encoder_ckpt):
+    path, hf = cross_encoder_ckpt
+    engine = _make_engine(path)
+    pair = [2, 17, 45, 60, 8, 21, 5]           # [CLS] q [SEP] d [SEP]
+    tt = [0, 0, 0, 0, 1, 1, 1]
+    embs = _run_pooling(engine, [pair],
+                        [{"type": "score", "token_type_ids": tt}])
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor([pair], dtype=torch.long),
+                 token_type_ids=torch.tensor([tt], dtype=torch.long))
+    assert len(embs[0]) == 1
+    np.testing.assert_allclose(embs[0][0], out.logits.numpy()[0, 0],
+                               atol=5e-4, rtol=5e-3)

@@ -97,11 +97,22 @@ class Scheduler:
         # AND no KV connector is attached (a connector may still read a
         # request's prompt pages for a peer pull after they leave the
         # window; its deferred-free holds don't cover mid-request frees).
-        from vllm_distributed_tpu.models.loader import (resolve_free_window,
-                                                        resolve_stateful)
+        from vllm_distributed_tpu.models.loader import (
+            resolve_encoder_only, resolve_free_window, resolve_stateful)
         free_window = (None if kv_connector is not None
                        else resolve_free_window(config.model_config))
         enable_caching = config.cache_config.enable_prefix_caching
+        if resolve_encoder_only(config.model_config):
+            # Encoder-only (BERT-family) archs: a bidirectional layer
+            # needs the full sequence in one step, and there is no
+            # causal KV to re-enter — whole-prompt scheduling, no
+            # prefix reuse (the processor bounds prompts to the token
+            # budget at admission).
+            if self.enable_chunked_prefill or enable_caching:
+                logger.info("encoder-only model: chunked prefill and "
+                            "prefix caching disabled")
+            self.enable_chunked_prefill = False
+            enable_caching = False
         if enable_caching and resolve_stateful(config.model_config):
             # SSM state cannot re-enter at a cached page boundary; the
             # reference disables prefix caching for mamba models too.

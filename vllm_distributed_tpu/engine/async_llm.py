@@ -186,13 +186,15 @@ class AsyncLLM:
         self.core.abort_requests([request_id])
 
     async def encode(self, prompt,
-                     request_id: Optional[str] = None):
+                     request_id: Optional[str] = None,
+                     pooling_params: Optional[dict] = None):
         """Embedding request: returns the terminal PoolingOutput
-        (reference: AsyncLLM.encode)."""
+        (reference: AsyncLLM.encode). The processor fills the pooling
+        default per model kind (last for decoders, cls for encoders)."""
         async for out in self.generate(
                 prompt, SamplingParams(temperature=0.0, max_tokens=1),
                 request_id=request_id,
-                pooling_params={"type": "last"}):
+                pooling_params=pooling_params or {}):
             if getattr(out, "finished", True):
                 return out
         raise RuntimeError("encode stream ended without a result")

@@ -50,6 +50,11 @@ class Processor:
     def __init__(self, config: EngineConfig, tokenizer) -> None:
         self.config = config
         self.tokenizer = tokenizer
+        from vllm_distributed_tpu.models.loader import (
+            resolve_encoder_limits, resolve_encoder_only)
+        self.is_encoder_only = resolve_encoder_only(config.model_config)
+        self.is_cross_encoder, self.encoder_token_limit = \
+            resolve_encoder_limits(config.model_config)
         self.eos_token_id: Optional[int] = None
         if tokenizer is not None:
             self.eos_token_id = tokenizer.eos_token_id
@@ -86,12 +91,44 @@ class Processor:
         if multi_modal_data:
             mm_inputs, prompt_token_ids = self._process_mm(
                 multi_modal_data, prompt_token_ids)
+        if self.is_encoder_only and pooling_params is None:
+            raise ValueError(
+                "this model is encoder-only: it serves embedding/"
+                "scoring requests (LLM.encode / LLM.score / "
+                "/v1/embeddings), not generation")
         if pooling_params is not None:
-            if pooling_params.get("type", "last") != "last":
-                raise ValueError(
-                    "only 'last' pooling is supported (mean pooling "
-                    "needs per-chunk accumulation; not wired yet)")
-            pooling_params = {"type": "last"}
+            ptype = pooling_params.get("type",
+                                       "cls" if self.is_encoder_only
+                                       else "last")
+            if self.is_encoder_only:
+                # The dense encoder pools any variant on-device; score
+                # must be refused HERE for plain embedding checkpoints —
+                # a runner-side raise would kill the engine core.
+                if ptype not in ("cls", "mean", "last", "score"):
+                    raise ValueError(f"unknown pooling type {ptype!r}")
+                if ptype == "score" and not self.is_cross_encoder:
+                    raise ValueError(
+                        "score pooling needs a classification "
+                        "checkpoint (e.g. BertForSequenceClassification)"
+                        "; this model only embeds")
+                clean = {"type": ptype}
+                tt = pooling_params.get("token_type_ids")
+                if tt is not None:
+                    if len(tt) > len(prompt_token_ids):
+                        raise ValueError(
+                            "token_type_ids longer than the prompt")
+                    clean["token_type_ids"] = [int(x) for x in tt]
+                pooling_params = clean
+            else:
+                # Decoder pooling rides the causal step: only the final
+                # prompt position's hidden state is exact under chunked
+                # prefill (mean needs per-chunk accumulation).
+                if ptype != "last":
+                    raise ValueError(
+                        "only 'last' pooling is supported on decoder "
+                        "models (cls/mean pooling needs an encoder-only "
+                        "arch)")
+                pooling_params = {"type": "last"}
             # A pooling request never decodes: clamp so the scheduler's
             # fused multi-step burst (which never pools) can't claim it.
             sampling_params.max_tokens = 1
@@ -118,10 +155,29 @@ class Processor:
             except ValueError as e:
                 raise ValueError(f"invalid structured spec: {e}") from e
         max_len = self.config.scheduler_config.max_model_len
-        if len(prompt_token_ids) >= max_len:
+        # Pooling requests generate nothing, so a prompt may fill the
+        # whole window; generation needs at least one free position.
+        limit = max_len if pooling_params is not None else max_len - 1
+        if len(prompt_token_ids) > limit:
             raise ValueError(
                 f"prompt ({len(prompt_token_ids)} tokens) is longer than "
                 f"the maximum model length of {max_len}")
+        if self.is_encoder_only:
+            budget = self.config.scheduler_config.max_num_batched_tokens
+            if len(prompt_token_ids) > budget:
+                raise ValueError(
+                    f"encoder prompt ({len(prompt_token_ids)} tokens) "
+                    f"exceeds max_num_batched_tokens ({budget}): a "
+                    f"bidirectional layer needs the whole sequence in "
+                    f"one step")
+            if (self.encoder_token_limit is not None
+                    and len(prompt_token_ids) > self.encoder_token_limit):
+                # e.g. RoBERTa's 514-row table holds 512 tokens (offset
+                # 2); admitting more would silently alias positions.
+                raise ValueError(
+                    f"encoder prompt ({len(prompt_token_ids)} tokens) "
+                    f"exceeds the model's position capacity "
+                    f"({self.encoder_token_limit})")
         return EngineCoreRequest(
             request_id=request_id,
             prompt_token_ids=prompt_token_ids,

@@ -62,18 +62,24 @@ class LLM:
         by_id = {out.request_id: out for out in outputs}
         return [by_id[rid] for rid in request_ids]
 
-    def encode(self, prompts) -> list:
-        """Embedding API: pooled last-position hidden state per prompt
-        (reference: entrypoints/llm.py LLM.encode -> PoolingOutput)."""
+    def encode(self, prompts, pooling_type: str = None,
+               _extra_pooling: list = None) -> list:
+        """Embedding API: pooled hidden state per prompt (reference:
+        entrypoints/llm.py LLM.encode -> PoolingOutput). Decoder models
+        pool the last position; encoder-only (BERT-family) models
+        default to CLS, with "mean"/"last" selectable."""
         from vllm_distributed_tpu.sampling_params import SamplingParams
         prompts = _listify_prompts(prompts)
         request_ids = []
-        for prompt in prompts:
+        for i, prompt in enumerate(prompts):
+            pooling = dict(_extra_pooling[i]) if _extra_pooling else {}
+            if pooling_type is not None:
+                pooling["type"] = pooling_type
             request_id = str(next(self.request_counter))
             self.llm_engine.add_request(
                 request_id, prompt,
                 SamplingParams(temperature=0.0, max_tokens=1),
-                pooling_params={"type": "last"})
+                pooling_params=pooling)
             request_ids.append(request_id)
         outputs = self._run_engine()
         by_id = {out.request_id: out for out in outputs}
@@ -143,9 +149,12 @@ class LLM:
                  "cum_logprob": b["cum_logprob"]} for b in beams]
 
     def score(self, queries, documents) -> list[float]:
-        """Similarity scoring via pooled embeddings (reference:
-        LLM.score; cosine over the encode path — cross-encoder heads
-        are a model-zoo extension)."""
+        """Relevance scoring (reference: LLM.score / serving_score.py).
+
+        Cross-encoder checkpoints (e.g. BertForSequenceClassification)
+        run each (query, document) pair through the classification
+        head; embedding models fall back to cosine similarity over the
+        encode path — matching the reference's two scoring modes."""
         import math
         queries = _listify_prompts(queries)
         documents = _listify_prompts(documents)
@@ -159,6 +168,8 @@ class LLM:
             raise ValueError(
                 f"score needs matching (or broadcastable) counts; got "
                 f"{len(queries)} queries x {len(documents)} documents")
+        if self._is_cross_encoder():
+            return self._score_cross_encoder(queries, documents)
         # Encode each distinct prompt once (a single query against N
         # documents costs 1 + N forwards, not 2N).
         def key(p):
@@ -179,6 +190,32 @@ class LLM:
 
         return [cos(by_key[key(q)], by_key[key(d)])
                 for q, d in zip(queries, documents)]
+
+    def _is_cross_encoder(self) -> bool:
+        try:
+            from vllm_distributed_tpu.models.registry import (
+                resolve_architecture)
+            hf = (self.llm_engine.processor.config.model_config
+                  .maybe_load_hf_config())
+            cls = resolve_architecture(hf)
+        except Exception:  # noqa: BLE001
+            return False
+        return bool(getattr(cls, "CLASSIFY", False))
+
+    def _score_cross_encoder(self, queries, documents) -> list[float]:
+        """Each pair runs as ONE encoder forward: [CLS] q [SEP] d [SEP]
+        with token_type 1 on the document segment, scored by the
+        checkpoint's classification head."""
+        from vllm_distributed_tpu.entrypoints.score_utils import (
+            build_score_pair)
+        tokenizer = self.get_tokenizer()
+        pairs, poolings = [], []
+        for q, d in zip(queries, documents):
+            ids, pooling = build_score_pair(tokenizer, q, d)
+            pairs.append(ids)
+            poolings.append(pooling)
+        outs = self.encode(pairs, _extra_pooling=poolings)
+        return [float(o.embedding[0]) for o in outs]
 
     def _run_engine(self) -> list[RequestOutput]:
         finished: list[RequestOutput] = []

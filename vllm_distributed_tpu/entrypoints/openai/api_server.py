@@ -37,6 +37,12 @@ def _error_response(e: Exception) -> web.Response:
         return web.json_response(
             {"error": {"message": str(e), "type": "internal_server_error",
                        "code": 500}}, status=500)
+    if isinstance(e, ValueError):
+        # Admission-time validation (processor rejects) is the client's
+        # fault: 400, matching the reference server's error mapping.
+        return web.json_response(
+            {"error": {"message": str(e), "type": "invalid_request_error",
+                       "code": 400}}, status=400)
     return web.json_response(
         {"error": {"message": f"{type(e).__name__}: {e}",
                    "type": "internal_server_error", "code": 500}},
@@ -129,6 +135,104 @@ async def embeddings(request: web.Request) -> web.Response:
             "object": "list",
             "data": data,
             "model": body.get("model", model),
+            "usage": protocol.usage(prompt_tokens, 0),
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+    except EngineDeadError as e:
+        return _error_response(RequestError(str(e), code=500))
+
+
+def _score_pairs(engine, queries, documents):
+    """Build (token_ids, pooling) per pair for cross-encoder scoring."""
+    from vllm_distributed_tpu.entrypoints.score_utils import (
+        build_score_pair)
+    return [build_score_pair(engine.tokenizer, q, d)
+            for q, d in zip(queries, documents)]
+
+
+async def score(request: web.Request) -> web.Response:
+    """/v1/score: cross-encoder relevance of text_1 x text_2 pairs
+    (reference: serving_score.py)."""
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        t1, t2 = body.get("text_1"), body.get("text_2")
+        if t1 is None or t2 is None:
+            raise RequestError("score needs 'text_1' and 'text_2'")
+        if isinstance(t1, str):
+            t1 = [t1]
+        if isinstance(t2, str):
+            t2 = [t2]
+        if len(t1) == 1 and len(t2) > 1:
+            t1 = t1 * len(t2)
+        elif len(t2) == 1 and len(t1) > 1:
+            t2 = t2 * len(t1)
+        if len(t1) != len(t2):
+            raise RequestError(
+                f"text_1 x text_2 must match (or broadcast); got "
+                f"{len(t1)} x {len(t2)}")
+        pairs = _score_pairs(engine, t1, t2)
+        results = await asyncio.gather(
+            *(engine.encode(ids, pooling_params=pooling)
+              for ids, pooling in pairs))
+        data = [{
+            "object": "score",
+            "index": i,
+            "score": out.embedding[0],
+        } for i, out in enumerate(results)]
+        prompt_tokens = sum(out.num_prompt_tokens for out in results)
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", model),
+            "usage": protocol.usage(prompt_tokens, 0),
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+    except EngineDeadError as e:
+        return _error_response(RequestError(str(e), code=500))
+
+
+async def rerank(request: web.Request) -> web.Response:
+    """/v1/rerank (and /rerank): order documents by cross-encoder
+    relevance to a query (reference: serving_score.py rerank API)."""
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        query = body.get("query")
+        documents = body.get("documents")
+        if isinstance(documents, str):
+            documents = [documents]
+        if query is None or not documents:
+            raise RequestError("rerank needs 'query' and 'documents'")
+        pairs = _score_pairs(engine, [query] * len(documents), documents)
+        results = await asyncio.gather(
+            *(engine.encode(ids, pooling_params=pooling)
+              for ids, pooling in pairs))
+        ranked = sorted(
+            ((out.embedding[0], i) for i, out in enumerate(results)),
+            reverse=True)
+        top_n = body.get("top_n", len(documents))
+        data = [{
+            "index": i,
+            "relevance_score": s,
+            "document": {"text": documents[i]},
+        } for s, i in ranked[:top_n]]
+        prompt_tokens = sum(out.num_prompt_tokens for out in results)
+        return web.json_response({
+            "model": body.get("model", model),
+            "results": data,
             "usage": protocol.usage(prompt_tokens, 0),
         })
     except (RequestError, ValueError) as e:
@@ -486,6 +590,9 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/v1/score", score)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/rerank", rerank)
     app.router.add_post("/start_profile", start_profile)
     app.router.add_post("/stop_profile", stop_profile)
     return app

@@ -1,0 +1,22 @@
+"""Shared cross-encoder pair construction for LLM.score and the
+/v1/score + /v1/rerank endpoints (reference: the prompt assembly of
+serving_score.py): one sequence per (query, document) pair with
+token_type 1 on the document segment, scored by the checkpoint's
+classification head via "score" pooling."""
+
+
+def build_score_pair(tokenizer, query, document):
+    """Returns (token_ids, pooling_params) for one pair. String inputs
+    use the tokenizer's own pair encoding ([CLS] q [SEP] d [SEP] with
+    its token_type_ids); token-list inputs are concatenated with
+    type 1 on the document."""
+    if isinstance(query, str) or isinstance(document, str):
+        if tokenizer is None:
+            raise ValueError("string inputs to score require a tokenizer")
+        enc = tokenizer(query, document)
+        ids = enc["input_ids"]
+        tt = enc.get("token_type_ids") or [0] * len(ids)
+    else:
+        ids = list(query) + list(document)
+        tt = [0] * len(query) + [1] * len(document)
+    return ids, {"type": "score", "token_type_ids": list(tt)}

@@ -1,0 +1,389 @@
+"""Encoder-only (BERT / RoBERTa) families: embeddings + cross-encoder
+scoring.
+
+Reference surface: vllm/model_executor/models/bert.py (BertModel /
+BertEmbeddingModel with CLS-pooled embeddings, the ``_EMBEDDING_MODELS``
+registry map), roberta.py (RobertaEmbeddingModel with its
+padding-offset learned positions and the classification head of
+RobertaForSequenceClassification), and the cross-encoder path of
+vllm/entrypoints/llm.py ``LLM.score`` / serving_score.py.
+
+TPU design: encoder inference is pure prefill — no KV cache, no paging,
+no sampling. Instead of threading bidirectional masks through the
+ragged paged-attention machinery, the whole batch runs as ONE dense
+[R, L, H] program: padded row-major batches are exactly what the MXU
+wants (large static matmuls), and the O(L^2) score tensor is tiny at
+encoder lengths (<=512 tokens). A dedicated runner
+(worker/encoder_runner.py) buckets (R, L) and jits a single forward
+that returns every pooling variant at once; the scheduler runs
+unchanged with chunked prefill disabled (a bidirectional layer needs
+the full sequence in one step).
+
+Architecture notes (post-LN transformer, HF ``BertModel`` semantics):
+  x   = LN(word[ids] + pos[positions] + type[type_ids])
+  h   = LN(h + Wo @ MHA(h))       (attention.output.LayerNorm)
+  h   = LN(h + W2 @ gelu(W1 @ h)) (output.LayerNorm)
+pooling: "cls" (default, matches the reference's BertEmbeddingModel),
+"mean" (sentence-transformers style masked mean), or "last".
+Cross-encoder checkpoints add dense+tanh (BERT pooler / Roberta head
+dense) and a classifier projection; their score is the single logit
+(num_labels == 1) or softmax[1] for 2-label heads, as in the
+reference's cross-encoder scoring.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.llama import MODEL_AXIS, LlamaForCausalLM
+
+_NEG = -1e9  # additive mask for padded keys (fp32 scores)
+
+
+class BertEmbeddingModel(LlamaForCausalLM):
+    """BERT encoder serving embedding requests (arch "BertModel")."""
+
+    ENCODER_ONLY = True
+    CLASSIFY = False
+    QUANT_TARGETS = ()
+    LORA_TARGETS = ()
+    # Candidate HF checkpoint prefixes, tried in order.
+    HF_PREFIXES = ("", "bert.")
+    # RoBERTa writes positions starting at padding_idx + 1 == 2.
+    POS_OFFSET = 0
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def arch_config_source(cls, hf):
+        """BertConfig lacks the decoder fields from_hf_config reads;
+        shim them (attention values are real, rope fields inert)."""
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=getattr(hf, "layer_norm_eps", 1e-12),
+            tie_word_embeddings=False,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.encoder_only = True
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.hidden_act = getattr(hf, "hidden_act", "gelu")
+        arch.max_position_embeddings = hf.max_position_embeddings
+        arch.type_vocab_size = max(int(getattr(hf, "type_vocab_size", 0)),
+                                   1)
+        arch.pos_offset = cls.POS_OFFSET
+        arch.classify = cls.CLASSIFY
+        arch.num_labels = int(getattr(hf, "num_labels", 2))
+
+    def quantize_params(self, params: dict) -> dict:
+        if self.cfg.quantization:
+            raise ValueError(
+                "weight quantization for encoder models is not wired "
+                "yet; drop --quantization")
+        return params
+
+    # ------------------------------------------------------------------
+    # Parameters
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        layer = {
+            "wq": P(None, None, MODEL_AXIS),
+            "wk": P(None, None, MODEL_AXIS),
+            "wv": P(None, None, MODEL_AXIS),
+            "bq": P(None, MODEL_AXIS),
+            "bk": P(None, MODEL_AXIS),
+            "bv": P(None, MODEL_AXIS),
+            "wo": P(None, MODEL_AXIS, None),
+            "bo": P(None, None),
+            "ln_attn": P(None, None),
+            "ln_attn_b": P(None, None),
+            "fc1": P(None, None, MODEL_AXIS),
+            "fc1_b": P(None, MODEL_AXIS),
+            "fc2": P(None, MODEL_AXIS, None),
+            "fc2_b": P(None, None),
+            "ln_mlp": P(None, None),
+            "ln_mlp_b": P(None, None),
+        }
+        specs = {
+            "embed": P(None, None),
+            "embed_pos": P(None, None),
+            "embed_type": P(None, None),
+            "embed_ln": P(None),
+            "embed_ln_b": P(None),
+            "layers": layer,
+        }
+        if self.cfg.classify:
+            specs.update({
+                "pooler_w": P(None, None),
+                "pooler_b": P(None),
+                "cls_w": P(None, None),
+                "cls_b": P(None),
+            })
+        return specs
+
+    def kv_cache_specs(self) -> dict:
+        return {}
+
+    def kv_cache_page_bytes(self, page_size: int) -> int:
+        return 0
+
+    def make_kv_caches(self, num_pages: int, page_size: int,
+                       mesh=None) -> dict:
+        return {}
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        H, I, L = c.hidden_size, c.intermediate_size, c.num_layers
+        keys = iter(jax.random.split(rng, 16))
+
+        def rnd(shape):
+            return (jax.random.normal(next(keys), shape, jnp.float32) *
+                    scale).astype(c.dtype)
+
+        layer = {
+            "wq": rnd((L, H, H)),
+            "wk": rnd((L, H, H)),
+            "wv": rnd((L, H, H)),
+            "bq": jnp.zeros((L, H), c.dtype),
+            "bk": jnp.zeros((L, H), c.dtype),
+            "bv": jnp.zeros((L, H), c.dtype),
+            "wo": rnd((L, H, H)),
+            "bo": jnp.zeros((L, H), c.dtype),
+            "ln_attn": jnp.ones((L, H), c.dtype),
+            "ln_attn_b": jnp.zeros((L, H), c.dtype),
+            "fc1": rnd((L, H, I)),
+            "fc1_b": jnp.zeros((L, I), c.dtype),
+            "fc2": rnd((L, I, H)),
+            "fc2_b": jnp.zeros((L, H), c.dtype),
+            "ln_mlp": jnp.ones((L, H), c.dtype),
+            "ln_mlp_b": jnp.zeros((L, H), c.dtype),
+        }
+        params = {
+            "embed": rnd((c.vocab_size, H)),
+            "embed_pos": rnd((c.max_position_embeddings, H)),
+            "embed_type": rnd((c.type_vocab_size, H)),
+            "embed_ln": jnp.ones((H, ), c.dtype),
+            "embed_ln_b": jnp.zeros((H, ), c.dtype),
+            "layers": layer,
+        }
+        if c.classify:
+            params.update({
+                "pooler_w": rnd((H, H)),
+                "pooler_b": jnp.zeros((H, ), c.dtype),
+                "cls_w": rnd((H, c.num_labels)),
+                "cls_b": jnp.zeros((c.num_labels, ), c.dtype),
+            })
+        return params
+
+    # ------------------------------------------------------------------
+    def params_from_hf_state_dict(self, tensors: dict[str, np.ndarray],
+                                  dtype=None) -> dict:
+        c = self.cfg
+        dtype = dtype or c.dtype
+        prefix = ""
+        for cand in self.HF_PREFIXES:
+            if f"{cand}embeddings.word_embeddings.weight" in tensors:
+                prefix = cand
+                break
+
+        def t(name, required=True):
+            full = prefix + name
+            if full not in tensors and not required:
+                return None
+            return tensors[full]
+
+        def a(x):
+            return jnp.asarray(np.ascontiguousarray(x), dtype)
+
+        def stack(fmt, transpose=True):
+            mats = [np.asarray(tensors[prefix + fmt.format(i=i)])
+                    for i in range(c.num_layers)]
+            if transpose:
+                mats = [m.T for m in mats]
+            return a(np.stack(mats))
+
+        type_emb = t("embeddings.token_type_embeddings.weight",
+                     required=False)
+        if type_emb is None:
+            type_emb = np.zeros((c.type_vocab_size, c.hidden_size),
+                                np.float32)
+        params = {
+            "embed": a(t("embeddings.word_embeddings.weight")),
+            "embed_pos": a(t("embeddings.position_embeddings.weight")),
+            "embed_type": a(type_emb),
+            "embed_ln": a(t("embeddings.LayerNorm.weight")),
+            "embed_ln_b": a(t("embeddings.LayerNorm.bias")),
+            "layers": {
+                "wq": stack("encoder.layer.{i}.attention.self.query.weight"),
+                "wk": stack("encoder.layer.{i}.attention.self.key.weight"),
+                "wv": stack("encoder.layer.{i}.attention.self.value.weight"),
+                "bq": stack("encoder.layer.{i}.attention.self.query.bias",
+                            transpose=False),
+                "bk": stack("encoder.layer.{i}.attention.self.key.bias",
+                            transpose=False),
+                "bv": stack("encoder.layer.{i}.attention.self.value.bias",
+                            transpose=False),
+                "wo": stack("encoder.layer.{i}.attention.output.dense.weight"),
+                "bo": stack("encoder.layer.{i}.attention.output.dense.bias",
+                            transpose=False),
+                "ln_attn": stack(
+                    "encoder.layer.{i}.attention.output.LayerNorm.weight",
+                    transpose=False),
+                "ln_attn_b": stack(
+                    "encoder.layer.{i}.attention.output.LayerNorm.bias",
+                    transpose=False),
+                "fc1": stack("encoder.layer.{i}.intermediate.dense.weight"),
+                "fc1_b": stack("encoder.layer.{i}.intermediate.dense.bias",
+                               transpose=False),
+                "fc2": stack("encoder.layer.{i}.output.dense.weight"),
+                "fc2_b": stack("encoder.layer.{i}.output.dense.bias",
+                               transpose=False),
+                "ln_mlp": stack("encoder.layer.{i}.output.LayerNorm.weight",
+                                transpose=False),
+                "ln_mlp_b": stack("encoder.layer.{i}.output.LayerNorm.bias",
+                                  transpose=False),
+            },
+        }
+        if c.classify:
+            self._load_head(tensors, params, a)
+        return params
+
+    def _load_head(self, tensors, params, a) -> None:
+        """Classification head: BERT = pooler.dense + classifier;
+        RoBERTa = classifier.dense + classifier.out_proj (both are
+        dense -> tanh -> proj over the CLS position)."""
+        if "classifier.dense.weight" in tensors:  # roberta-style head
+            params["pooler_w"] = a(np.asarray(
+                tensors["classifier.dense.weight"]).T)
+            params["pooler_b"] = a(tensors["classifier.dense.bias"])
+            params["cls_w"] = a(np.asarray(
+                tensors["classifier.out_proj.weight"]).T)
+            params["cls_b"] = a(tensors["classifier.out_proj.bias"])
+            return
+        prefix = self.HF_PREFIXES[-1]
+        pooler_w = tensors.get(f"{prefix}pooler.dense.weight")
+        if pooler_w is None:
+            pooler_w = tensors.get("pooler.dense.weight")
+            prefix = ""
+        params["pooler_w"] = a(np.asarray(pooler_w).T)
+        params["pooler_b"] = a(tensors[f"{prefix}pooler.dense.bias"])
+        params["cls_w"] = a(np.asarray(tensors["classifier.weight"]).T)
+        params["cls_b"] = a(tensors["classifier.bias"])
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+    def _ln(self, x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.cfg.rms_norm_eps)
+        return (y * w + b).astype(x.dtype)
+
+    def _gelu(self, x: jax.Array) -> jax.Array:
+        if self.cfg.hidden_act in ("gelu", "gelu_new", "gelu_tanh",
+                                   "gelu_pytorch_tanh"):
+            approx = self.cfg.hidden_act != "gelu"
+            return jax.nn.gelu(x, approximate=approx)
+        if self.cfg.hidden_act == "relu":
+            return jax.nn.relu(x)
+        return jax.nn.silu(x)
+
+    def encode(self, params: dict, token_ids: jax.Array,
+               type_ids: jax.Array, valid: jax.Array) -> jax.Array:
+        """Dense padded forward.
+
+        token_ids/type_ids: [R, L] int32; valid: [R, L] bool.
+        Returns last_hidden_state [R, L, H] (matches HF ``BertModel``).
+        """
+        c = self.cfg
+        R, L = token_ids.shape
+        nh, hd = c.num_q_heads, c.head_dim
+        positions = jnp.clip(
+            jnp.arange(L, dtype=jnp.int32) + c.pos_offset,
+            0, c.max_position_embeddings - 1)
+        x = (params["embed"][token_ids] +
+             params["embed_pos"][positions][None, :, :] +
+             params["embed_type"][jnp.clip(type_ids, 0,
+                                           c.type_vocab_size - 1)])
+        h = self._ln(x, params["embed_ln"], params["embed_ln_b"])
+        # Additive key mask, shared across layers/heads/queries.
+        bias = jnp.where(valid[:, None, None, :], 0.0, _NEG)  # [R,1,1,L]
+        scale = hd**-0.5
+
+        def body(h, lp):
+            q = (h @ lp["wq"] + lp["bq"]).reshape(R, L, nh, hd)
+            k = (h @ lp["wk"] + lp["bk"]).reshape(R, L, nh, hd)
+            v = (h @ lp["wv"] + lp["bv"]).reshape(R, L, nh, hd)
+            scores = jnp.einsum("rinh,rjnh->rnij", q, k,
+                                preferred_element_type=jnp.float32)
+            probs = jax.nn.softmax(scores * scale + bias, axis=-1)
+            ctx = jnp.einsum("rnij,rjnh->rinh",
+                             probs.astype(h.dtype), v).reshape(R, L, -1)
+            h = self._ln(h + ctx @ lp["wo"] + lp["bo"],
+                         lp["ln_attn"], lp["ln_attn_b"])
+            m = self._gelu(h @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"]
+            h = self._ln(h + m + lp["fc2_b"], lp["ln_mlp"], lp["ln_mlp_b"])
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, params["layers"])
+        return h
+
+    def pool(self, params: dict, hidden: jax.Array,
+             valid: jax.Array) -> dict:
+        """All pooling variants at once (cheap relative to the encode):
+        cls / mean / last vectors [R, H] and, for cross-encoder
+        checkpoints, the per-row relevance score [R]."""
+        validf = valid.astype(jnp.float32)[:, :, None]
+        hf32 = hidden.astype(jnp.float32)
+        lengths = jnp.maximum(validf.sum(axis=1), 1.0)
+        mean = (hf32 * validf).sum(axis=1) / lengths
+        cls = hf32[:, 0, :]
+        last_idx = jnp.maximum(
+            valid.astype(jnp.int32).sum(axis=1) - 1, 0)
+        last = jnp.take_along_axis(
+            hf32, last_idx[:, None, None], axis=1)[:, 0, :]
+        out = {"cls": cls, "mean": mean, "last": last}
+        if self.cfg.classify:
+            pooled = jnp.tanh(
+                cls.astype(self.cfg.dtype) @ params["pooler_w"] +
+                params["pooler_b"]).astype(jnp.float32)
+            logits = (pooled @ params["cls_w"].astype(jnp.float32) +
+                      params["cls_b"].astype(jnp.float32))
+            if self.cfg.num_labels == 1:
+                score = logits[:, 0]
+            else:
+                score = jax.nn.softmax(logits, axis=-1)[:, -1]
+            out["score"] = score
+            out["logits"] = logits
+        return out
+
+
+class BertForSequenceClassification(BertEmbeddingModel):
+    """Cross-encoder scoring (arch "BertForSequenceClassification")."""
+
+    CLASSIFY = True
+
+
+class RobertaEmbeddingModel(BertEmbeddingModel):
+    """RoBERTa / XLM-R encoder: positions offset by padding_idx + 1."""
+
+    HF_PREFIXES = ("", "roberta.")
+    POS_OFFSET = 2
+
+
+class RobertaForSequenceClassification(RobertaEmbeddingModel):
+    CLASSIFY = True

@@ -142,6 +142,26 @@ def get_model(config: EngineConfig, mesh,
             raise ValueError(
                 "EPLB redundant experts over stateful hybrid models "
                 "are not wired; drop num_redundant_experts")
+    if getattr(arch, "encoder_only", False):
+        pc = config.parallel_config
+        bad = []
+        if pc.pipeline_parallel_size > 1:
+            bad.append("pipeline parallelism")
+        if pc.token_parallel_size > 1:
+            bad.append("token parallelism")
+        if pc.enable_sequence_parallel:
+            bad.append("sequence parallelism")
+        if config.lora_config.enable_lora:
+            bad.append("LoRA")
+        if config.speculative_config.num_speculative_tokens:
+            bad.append("speculative decoding")
+        if config.kv_transfer_config.kv_connector:
+            bad.append("KV transfer")
+        if bad:
+            raise ValueError(
+                f"encoder-only models do not compose with "
+                f"{', '.join(bad)} (no KV cache, no decode steps); "
+                f"drop those options")
     if ((arch.sliding_window or arch.window_pattern
          or arch.attn_logit_softcap)
             and config.parallel_config.token_parallel_size > 1):
@@ -220,17 +240,53 @@ def get_model(config: EngineConfig, mesh,
     def place(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
-    # The layers subtree shares one spec dict across stacked tensors.
-    params = {
-        "embed": place(params["embed"], specs["embed"]),
-        "layers": {
-            k: place(v, specs["layers"][k])
-            for k, v in params["layers"].items()
-        },
-        "final_ln": place(params["final_ln"], specs["final_ln"]),
-        "lm_head": place(params["lm_head"], specs["lm_head"]),
-    }
+    # Walk the params tree key-by-key so family-specific extras
+    # (final_ln_b / lm_head_b biases, encoder embedding tables,
+    # pooler/classifier heads) get their shardings too.
+    def place_tree(p, s):
+        if isinstance(p, dict):
+            return {k: place_tree(v, s[k]) for k, v in p.items()}
+        return place(p, s)
+
+    params = place_tree(params, specs)
     return model, params
+
+
+def resolve_encoder_only(model_config) -> bool:
+    """True for encoder-only (BERT-family) archs: the worker swaps in
+    the dense EncoderModelRunner and the scheduler disables chunked
+    prefill + prefix caching (a bidirectional layer needs the whole
+    sequence in one step; a cached page boundary is meaningless without
+    causality). Reference: the pooling-model runner split of
+    v1/worker/gpu_model_runner.py + models/bert.py."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+    except Exception:  # noqa: BLE001 - conservative
+        return False
+    return bool(getattr(model_cls, "ENCODER_ONLY", False))
+
+
+def resolve_encoder_limits(model_config) -> "tuple[bool, Optional[int]]":
+    """(is_cross_encoder, max_encodable_tokens) for encoder-only archs.
+
+    Cross-encoder = checkpoint with a classification head ("score"
+    pooling is only admissible there — a bad request must 400 at the
+    front-end, never raise inside the engine step). The token bound is
+    the position table minus the family's position offset (RoBERTa
+    writes positions starting at padding_idx + 1 = 2, so a 514-row
+    table only covers 512 tokens)."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+        if not getattr(model_cls, "ENCODER_ONLY", False):
+            return False, None
+        offset = int(getattr(model_cls, "POS_OFFSET", 0))
+        max_pos = int(getattr(hf_config, "max_position_embeddings", 0))
+    except Exception:  # noqa: BLE001 - conservative
+        return False, None
+    limit = max_pos - offset if max_pos else None
+    return bool(getattr(model_cls, "CLASSIFY", False)), limit
 
 
 def resolve_stateful(model_config) -> bool:

@@ -31,6 +31,10 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
+from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
+                                              BertForSequenceClassification,
+                                              RobertaEmbeddingModel,
+                                              RobertaForSequenceClassification)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
 from vllm_distributed_tpu.models.bamba import BambaForCausalLM
 from vllm_distributed_tpu.models.jamba import JambaForCausalLM
@@ -86,6 +90,15 @@ _REGISTRY: dict[str, type] = {
     "JambaForCausalLM": JambaForCausalLM,
     # Hybrid Mamba-2/attention (models/bamba.py).
     "BambaForCausalLM": BambaForCausalLM,
+    # Encoder-only embedding + cross-encoder families (models/bert.py;
+    # reference: the _EMBEDDING_MODELS / _CROSS_ENCODER_MODELS maps of
+    # model_executor/models/registry.py).
+    "BertModel": BertEmbeddingModel,
+    "BertForSequenceClassification": BertForSequenceClassification,
+    "RobertaModel": RobertaEmbeddingModel,
+    "XLMRobertaModel": RobertaEmbeddingModel,
+    "RobertaForSequenceClassification": RobertaForSequenceClassification,
+    "XLMRobertaForSequenceClassification": RobertaForSequenceClassification,
 }
 
 

@@ -1,0 +1,198 @@
+"""Runner for encoder-only (BERT/RoBERTa) models.
+
+Reference surface: the pooling-model path of the reference runner
+(vllm/v1/worker/gpu_model_runner.py ``_pool`` + v1/pool/) serving
+BertEmbeddingModel / cross-encoder checkpoints
+(vllm/model_executor/models/bert.py, roberta.py).
+
+TPU design: encoder inference has no KV cache, no sampling and no
+decode steps — every request is one full-prompt prefill. So instead of
+flowing through the ragged paged decoder step, batches run as a dense
+padded [R, L] program jitted per (R, L) bucket: large static matmuls
+(MXU-shaped), bidirectional attention as one [R, heads, L, L] einsum,
+every pooling variant computed on-device in the same program. The
+scheduler is unchanged — chunked prefill and prefix caching are
+disabled for encoder archs (a bidirectional layer needs the whole
+sequence at once; see core/sched/scheduler.py construction), so each
+scheduled request carries its complete prompt and finishes in the same
+step (the ``pooled`` path of scheduler.update_from_output).
+"""
+
+import functools
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
+                                                    SchedulerOutput)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import make_buckets, pad_to_bucket
+
+logger = init_logger(__name__)
+
+
+class EncoderModelRunner:
+    """Drop-in for TPUModelRunner when the arch is encoder-only."""
+
+    def __init__(self, config: EngineConfig, mesh,
+                 model=None, params=None) -> None:
+        self.config = config
+        self.mesh = mesh
+        self.model = model
+        self.params = params
+        sched_cfg = config.scheduler_config
+        self.max_num_reqs = sched_cfg.max_num_seqs
+        self.max_model_len = sched_cfg.max_model_len
+        self.req_buckets = make_buckets(8, self.max_num_reqs)
+        # Length buckets up to the model's position table (the processor
+        # rejects longer prompts at admission).
+        self.len_buckets = make_buckets(16, self.max_model_len)
+        # req_id -> (prompt_token_ids, pooling_params); kept until the
+        # request finishes or is aborted (covers resume-from-preemption,
+        # where CachedRequestData carries no pooling params).
+        self._req_meta: dict[str, tuple[list[int], dict]] = {}
+        self._steps = 0
+
+    # ------------------------------------------------------------------
+    def load_model(self) -> None:
+        from vllm_distributed_tpu.models.loader import get_model
+        if self.model is None:
+            self.model, self.params = get_model(self.config, self.mesh)
+        assert getattr(self.model, "ENCODER_ONLY", False), \
+            "EncoderModelRunner requires an encoder-only arch"
+
+        model = self.model
+
+        @functools.partial(jax.jit, static_argnums=())
+        def _step(params, token_ids, type_ids, valid):
+            hidden = model.encode(params, token_ids, type_ids, valid)
+            return model.pool(params, hidden, valid)
+
+        self._jit_step = _step
+
+    # ------------------------------------------------------------------
+    # Sizing hooks (worker.determine_num_available_blocks): pages carry
+    # no bytes — the pool is sized to cover every schedulable request.
+    # ------------------------------------------------------------------
+    def profile_memory_bytes(self) -> int:
+        return 0
+
+    def kv_cache_bytes_per_page(self) -> int:
+        return 0
+
+    def model_fixed_cache_bytes(self) -> int:
+        return 0
+
+    def initialize_kv_cache(self, num_pages: int) -> None:
+        self.num_pages = num_pages
+
+    def precompile(self) -> None:
+        """Warm the FULL (R, L) lattice — jit caches per exact shape,
+        so every pair must compile up front or the first batch that
+        pads to it stalls a serving step (the VDT_PRECOMPILE contract
+        of the decoder runner)."""
+        start = time.perf_counter()
+        n = 0
+        with self.mesh:
+            for L in self.len_buckets:
+                for R in self.req_buckets:
+                    self._run(np.zeros((R, L), np.int32),
+                              np.zeros((R, L), np.int32),
+                              np.zeros((R, L), bool))
+                    n += 1
+        logger.info("encoder precompile: %d shapes in %.1fs", n,
+                    time.perf_counter() - start)
+
+    def _run(self, token_ids, type_ids, valid):
+        with self.mesh:
+            return self._jit_step(self.params, token_ids, type_ids, valid)
+
+    # ------------------------------------------------------------------
+    def dispatch_model(self, scheduler_output: SchedulerOutput):
+        for req_id in scheduler_output.finished_req_ids:
+            self._req_meta.pop(req_id, None)
+
+        rows: list[tuple[str, list[int], dict]] = []
+        for nr in scheduler_output.scheduled_new_reqs:
+            pooling = nr.pooling_params or {"type": "cls"}
+            self._req_meta[nr.req_id] = (list(nr.prompt_token_ids), pooling)
+            rows.append((nr.req_id, list(nr.prompt_token_ids), pooling))
+        cached = scheduler_output.scheduled_cached_reqs
+        for i, req_id in enumerate(cached.req_ids):
+            # Only resume-from-preemption reaches here (encoder requests
+            # never persist across steps); tokens were stashed at
+            # admission.
+            toks, pooling = self._req_meta[req_id]
+            rows.append((req_id, toks, pooling))
+
+        if not rows:
+            return {"ready": ModelRunnerOutput()}
+
+        for req_id, toks, _ in rows:
+            n = scheduler_output.num_scheduled_tokens[req_id]
+            assert n == len(toks), (
+                f"encoder request {req_id} scheduled {n}/{len(toks)} "
+                f"tokens: chunked prefill must be disabled for "
+                f"encoder-only models")
+
+        R = pad_to_bucket(len(rows), self.req_buckets)
+        L = pad_to_bucket(max(len(t) for _, t, _ in rows),
+                          self.len_buckets)
+        token_ids = np.zeros((R, L), np.int32)
+        type_ids = np.zeros((R, L), np.int32)
+        valid = np.zeros((R, L), bool)
+        for i, (_, toks, pooling) in enumerate(rows):
+            token_ids[i, :len(toks)] = toks
+            valid[i, :len(toks)] = True
+            tt = pooling.get("token_type_ids")
+            if tt:
+                type_ids[i, :min(len(tt), len(toks))] = \
+                    tt[:len(toks)]
+        dev = self._run(token_ids, type_ids, valid)
+        self._steps += 1
+        return {"dev": dev, "rows": rows}
+
+    def wait_model(self, handle: dict) -> ModelRunnerOutput:
+        if "ready" in handle:
+            return handle["ready"]
+        rows = handle["rows"]
+        host = jax.device_get(handle["dev"])
+        pooled: dict[str, list[float]] = {}
+        req_ids = []
+        for i, (req_id, _, pooling) in enumerate(rows):
+            req_ids.append(req_id)
+            ptype = pooling.get("type", "cls")
+            if ptype == "score":
+                if "score" not in host:
+                    raise ValueError(
+                        "score pooling needs a classification "
+                        "checkpoint (BertForSequenceClassification)")
+                pooled[req_id] = [float(host["score"][i])]
+            else:
+                vec = host.get(ptype)
+                if vec is None:
+                    raise ValueError(f"unknown pooling type {ptype!r}")
+                pooled[req_id] = np.asarray(
+                    vec[i], np.float32).tolist()
+            self._req_meta.pop(req_id, None)
+        return ModelRunnerOutput(
+            req_ids=req_ids,
+            sampled_token_ids=[[] for _ in req_ids],
+            pooled=pooled)
+
+    def execute_model(
+            self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
+        return self.wait_model(self.dispatch_model(scheduler_output))
+
+    # ------------------------------------------------------------------
+    def get_stats(self) -> dict:
+        return {"encoder_steps": float(self._steps)}
+
+    def save_sharded_state(self, path: str) -> None:
+        import orbax.checkpoint as ocp
+        import os
+        ocp.StandardCheckpointer().save(os.path.abspath(path),
+                                        jax.device_get(self.params))

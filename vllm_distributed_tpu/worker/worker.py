@@ -78,7 +78,12 @@ class TPUWorker:
             devices = devices[start:start + per]
         self.mesh = build_mesh(pc, devices)
         set_global_mesh(self.mesh)
-        if self.config.parallel_config.pipeline_parallel_size > 1:
+        from vllm_distributed_tpu.models.loader import resolve_encoder_only
+        if resolve_encoder_only(self.config.model_config):
+            from vllm_distributed_tpu.worker.encoder_runner import (
+                EncoderModelRunner)
+            self.model_runner = EncoderModelRunner(self.config, self.mesh)
+        elif self.config.parallel_config.pipeline_parallel_size > 1:
             from vllm_distributed_tpu.worker.pp_runner import PPModelRunner
             self.model_runner = PPModelRunner(self.config, self.mesh)
         else:
