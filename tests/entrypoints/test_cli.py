@@ -64,3 +64,54 @@ def test_collect_env_smoke(capsys):
     assert rc == 0
     info = json.loads(capsys.readouterr().out)
     assert info["jax"] and info["framework_version"]
+
+
+def test_chat_and_complete_clients(tmp_path, capsys):
+    """`vdt chat -q` / `vdt complete -q` drive a live server over HTTP
+    (reference: vllm/entrypoints/cli/openai.py)."""
+    import asyncio
+    import threading
+
+    from tests.entrypoints.test_openai_server import \
+        _save_checkpoint_with_tokenizer
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.utils import get_open_port
+
+    path = str(tmp_path / "model")
+    _save_checkpoint_with_tokenizer(path)
+    engine = AsyncLLM(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8).create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["stop"], holder["loop"] = stop, loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready, stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120)
+    try:
+        url = f"http://127.0.0.1:{port}/v1"
+        rc = main(["complete", "--url", url, "-q", "w3 w17 w92",
+                   "--max-tokens", "4", "--temperature", "0"])
+        assert rc == 0
+        text = capsys.readouterr().out.strip()
+        assert text  # greedy tokens detokenized as wNN words
+        rc = main(["chat", "--url", url, "-q", "w3 w17",
+                   "--max-tokens", "4", "--temperature", "0"])
+        assert rc == 0
+        assert capsys.readouterr().out.strip()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=30)

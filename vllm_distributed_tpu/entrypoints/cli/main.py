@@ -197,6 +197,141 @@ def cmd_run_batch(args) -> None:
     print(f"wrote {len(id_to_custom)} results to {args.output_file}")
 
 
+def _add_openai_client(sub) -> None:
+    """reference: vllm/entrypoints/cli/openai.py — `vllm chat` and
+    `vllm complete` talk to a RUNNING server over HTTP."""
+    for name, help_ in (("chat", "interactive chat against a running "
+                                 "server (/v1/chat/completions)"),
+                        ("complete", "one-shot completions against a "
+                                     "running server (/v1/completions)")):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--url", default="http://localhost:8000/v1",
+                       help="server base URL (with /v1)")
+        p.add_argument("--model-name", default=None,
+                       help="model field for requests (default: first "
+                            "model the server lists)")
+        p.add_argument("--api-key", default=None)
+        p.add_argument("-q", "--quick", default=None,
+                       help="send one message/prompt, print the "
+                            "response, exit")
+        p.add_argument("--max-tokens", type=int, default=256)
+        p.add_argument("--temperature", type=float, default=0.7)
+        if name == "chat":
+            p.add_argument("--system-prompt", default=None)
+
+
+class _ClientError(Exception):
+    """Server-side rejection, surfaced as a message (the REPL keeps its
+    history and continues; --quick exits non-zero)."""
+
+
+def _client_request(url, api_key, path, body):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url.rstrip("/") + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {api_key}"}
+                    if api_key else {})})
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        try:
+            detail = json.loads(detail)["error"]["message"]
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            pass
+        raise _ClientError(f"server returned {e.code}: {detail}") from e
+    except urllib.error.URLError as e:
+        raise _ClientError(f"cannot reach {url}: {e.reason}") from e
+
+
+def _client_model(args) -> str:
+    if args.model_name:
+        return args.model_name
+    import urllib.request
+    req = urllib.request.Request(
+        args.url.rstrip("/") + "/models",
+        headers=({"Authorization": f"Bearer {args.api_key}"}
+                 if args.api_key else {}))
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        models = json.loads(resp.read())["data"]
+    if not models:
+        raise SystemExit("server lists no models")
+    return models[0]["id"]
+
+
+def cmd_chat(args) -> None:
+    model = _client_model(args)
+    messages = []
+    if args.system_prompt:
+        messages.append({"role": "system", "content": args.system_prompt})
+
+    def turn(content: str) -> str:
+        messages.append({"role": "user", "content": content})
+        out = _client_request(args.url, args.api_key,
+                              "/chat/completions", {
+                                  "model": model,
+                                  "messages": messages,
+                                  "max_tokens": args.max_tokens,
+                                  "temperature": args.temperature,
+                              })
+        reply = out["choices"][0]["message"]["content"]
+        messages.append({"role": "assistant", "content": reply})
+        return reply
+
+    if args.quick is not None:
+        print(turn(args.quick))
+        return
+    print(f"chatting with {model} (ctrl-d to exit)")
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            print()
+            return
+        if not line.strip():
+            continue
+        try:
+            print(turn(line))
+        except _ClientError as e:
+            # Keep the session (and its history) alive on a rejection.
+            messages.pop()  # the user turn that failed
+            print(f"error: {e}", file=sys.stderr)
+
+
+def cmd_complete(args) -> None:
+    model = _client_model(args)
+
+    def complete(prompt: str) -> str:
+        out = _client_request(args.url, args.api_key, "/completions", {
+            "model": model,
+            "prompt": prompt,
+            "max_tokens": args.max_tokens,
+            "temperature": args.temperature,
+        })
+        return out["choices"][0]["text"]
+
+    if args.quick is not None:
+        print(complete(args.quick))
+        return
+    print(f"completing with {model} (ctrl-d to exit)")
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            print()
+            return
+        if not line.strip():
+            continue
+        try:
+            print(complete(line))
+        except _ClientError as e:
+            print(f"error: {e}", file=sys.stderr)
+
+
 def cmd_collect_env(_args) -> None:
     """Environment report (reference: vllm collect-env CLI)."""
     import platform
@@ -234,6 +369,7 @@ def main(argv=None) -> int:
     _add_serve(sub)
     _add_bench(sub)
     _add_run_batch(sub)
+    _add_openai_client(sub)
     sub.add_parser("collect-env", help="print environment/debug info")
     args = parser.parse_args(argv)
     if args.command == "serve":
@@ -242,6 +378,11 @@ def main(argv=None) -> int:
         cmd_bench(args)
     elif args.command == "run-batch":
         cmd_run_batch(args)
+    elif args.command in ("chat", "complete"):
+        try:
+            (cmd_chat if args.command == "chat" else cmd_complete)(args)
+        except _ClientError as e:
+            raise SystemExit(f"error: {e}")
     elif args.command == "collect-env":
         cmd_collect_env(args)
     return 0

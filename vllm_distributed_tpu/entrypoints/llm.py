@@ -192,15 +192,8 @@ class LLM:
                 for q, d in zip(queries, documents)]
 
     def _is_cross_encoder(self) -> bool:
-        try:
-            from vllm_distributed_tpu.models.registry import (
-                resolve_architecture)
-            hf = (self.llm_engine.processor.config.model_config
-                  .maybe_load_hf_config())
-            cls = resolve_architecture(hf)
-        except Exception:  # noqa: BLE001
-            return False
-        return bool(getattr(cls, "CLASSIFY", False))
+        # The processor resolved this at engine construction.
+        return self.llm_engine.processor.is_cross_encoder
 
     def _score_cross_encoder(self, queries, documents) -> list[float]:
         """Each pair runs as ONE encoder forward: [CLS] q [SEP] d [SEP]

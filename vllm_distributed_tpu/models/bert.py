@@ -294,13 +294,21 @@ class BertEmbeddingModel(LlamaForCausalLM):
         return (y * w + b).astype(x.dtype)
 
     def _gelu(self, x: jax.Array) -> jax.Array:
-        if self.cfg.hidden_act in ("gelu", "gelu_new", "gelu_tanh",
-                                   "gelu_pytorch_tanh"):
-            approx = self.cfg.hidden_act != "gelu"
-            return jax.nn.gelu(x, approximate=approx)
-        if self.cfg.hidden_act == "relu":
+        act = self.cfg.hidden_act
+        if act == "gelu":
+            return jax.nn.gelu(x, approximate=False)
+        if act in ("gelu_new", "gelu_tanh", "gelu_pytorch_tanh",
+                   "gelu_fast"):
+            return jax.nn.gelu(x, approximate=True)
+        if act == "quick_gelu":
+            return x * jax.nn.sigmoid(1.702 * x)
+        if act == "relu":
             return jax.nn.relu(x)
-        return jax.nn.silu(x)
+        if act == "silu":
+            return jax.nn.silu(x)
+        # Fail fast: a silent fallback would serve numerically wrong
+        # embeddings with no error.
+        raise ValueError(f"unsupported encoder hidden_act {act!r}")
 
     def encode(self, params: dict, token_ids: jax.Array,
                type_ids: jax.Array, valid: jax.Array) -> jax.Array:

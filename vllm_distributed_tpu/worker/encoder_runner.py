@@ -47,9 +47,17 @@ class EncoderModelRunner:
         self.max_num_reqs = sched_cfg.max_num_seqs
         self.max_model_len = sched_cfg.max_model_len
         self.req_buckets = make_buckets(8, self.max_num_reqs)
-        # Length buckets up to the model's position table (the processor
-        # rejects longer prompts at admission).
-        self.len_buckets = make_buckets(16, self.max_model_len)
+        # Length buckets only up to what admission can actually let
+        # through: the model window, the one-step token budget, and the
+        # position-table capacity — anything larger would precompile
+        # unreachable shapes (minutes of XLA time on TPU).
+        from vllm_distributed_tpu.models.loader import (
+            resolve_encoder_limits)
+        _, pos_capacity = resolve_encoder_limits(config.model_config)
+        max_len = min(self.max_model_len,
+                      sched_cfg.max_num_batched_tokens,
+                      pos_capacity or self.max_model_len)
+        self.len_buckets = make_buckets(16, max_len)
         # req_id -> (prompt_token_ids, pooling_params); kept until the
         # request finishes or is aborted (covers resume-from-preemption,
         # where CachedRequestData carries no pooling params).
