@@ -1,0 +1,185 @@
+"""GPT-lineage family parity (reference pattern: per-family HF-vs-engine
+greedy comparisons, tests/models/ of the reference repo). GPT-2 / GPT-J /
+GPTBigCode / OPT compare against their transformers implementations;
+MiniCPM and EXAONE (trust_remote_code upstream, no HF class baked in)
+are proven by renamed-checkpoint equivalence against Llama."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import torch
+import transformers
+from safetensors.numpy import save_file
+
+from tests.models._engine_harness import PROMPTS, hf_greedy, run_engine
+
+
+def _save(tmp_path_factory, name, hf):
+    path = str(tmp_path_factory.mktemp(name))
+    hf.save_pretrained(path, safe_serialization=True)
+    return path, hf
+
+
+def _check(path, hf, n=6, **overrides):
+    got = run_engine(path, PROMPTS, max_tokens=n, **overrides)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, n), f"prompt {p}"
+
+
+def test_gpt2_matches_hf(tmp_path_factory):
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        n_inner=None, activation_function="gelu_new", eos_token_id=1)
+    torch.manual_seed(0)
+    path, hf = _save(tmp_path_factory, "tiny_gpt2",
+                     transformers.GPT2LMHeadModel(cfg).eval())
+    _check(path, hf)
+
+
+def test_gptj_matches_hf(tmp_path_factory):
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=64, n_inner=None, activation_function="gelu_new",
+        eos_token_id=1, tie_word_embeddings=False)
+    torch.manual_seed(1)
+    path, hf = _save(tmp_path_factory, "tiny_gptj",
+                     transformers.GPTJForCausalLM(cfg).eval())
+    _check(path, hf)
+
+
+def test_gpt_bigcode_mqa_matches_hf(tmp_path_factory):
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        n_inner=128, activation_function="gelu_pytorch_tanh",
+        multi_query=True, eos_token_id=1)
+    torch.manual_seed(2)
+    path, hf = _save(tmp_path_factory, "tiny_bigcode",
+                     transformers.GPTBigCodeForCausalLM(cfg).eval())
+    _check(path, hf)
+
+
+def test_opt_matches_hf(tmp_path_factory):
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, do_layer_norm_before=True,
+        activation_function="relu", eos_token_id=1)
+    torch.manual_seed(3)
+    path, hf = _save(tmp_path_factory, "tiny_opt",
+                     transformers.OPTForCausalLM(cfg).eval())
+    _check(path, hf)
+
+
+def test_learned_positions_reject_overlong_max_model_len(
+        tmp_path_factory):
+    """An explicit --max-model-len past the wpe table must refuse at
+    load (a clip would silently reuse the last position row)."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=32,
+        activation_function="gelu_new", eos_token_id=1)
+    torch.manual_seed(9)
+    path, _ = _save(tmp_path_factory, "tiny_gpt2_cap",
+                    transformers.GPT2LMHeadModel(cfg).eval())
+    with pytest.raises(ValueError, match="learned-position capacity"):
+        run_engine(path, [PROMPTS[0]], max_tokens=2, max_model_len=64)
+
+
+def test_gpt2_matches_hf_under_tp2(tmp_path_factory):
+    """Learned positions + packed-QKV split survive GSPMD TP."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        activation_function="gelu_new", eos_token_id=1)
+    torch.manual_seed(4)
+    path, hf = _save(tmp_path_factory, "tiny_gpt2_tp",
+                     transformers.GPT2LMHeadModel(cfg).eval())
+    _check(path, hf, tensor_parallel_size=2)
+
+
+# ---------------------------------------------------------------------------
+# Renamed-checkpoint equivalence for families without a baked HF class.
+# ---------------------------------------------------------------------------
+CFG = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=64,
+           eos_token_id=1)
+
+
+@pytest.fixture(scope="module")
+def llama_base(tmp_path_factory):
+    torch.manual_seed(5)
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(**CFG))
+    path = str(tmp_path_factory.mktemp("tiny_llama_gptfam"))
+    hf.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def _state(path):
+    import glob
+
+    from safetensors import safe_open
+    out = {}
+    for f in glob.glob(os.path.join(path, "*.safetensors")):
+        with safe_open(f, framework="np") as r:
+            for k in r.keys():
+                out[k] = r.get_tensor(k)
+    return out
+
+
+def _save_variant(tmp_path_factory, name, arch, tensors, extra_cfg=None):
+    path = str(tmp_path_factory.mktemp(name))
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    cfg = dict(CFG, architectures=[arch], model_type="llama",
+               **(extra_cfg or {}))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    return path
+
+
+def _run(path):
+    return run_engine(path, PROMPTS, max_tokens=6,
+                      num_gpu_blocks_override=64)
+
+
+def test_minicpm_neutral_scales_equivalence(llama_base,
+                                            tmp_path_factory):
+    """MiniCPM with neutral MUP scales == the Llama it was renamed
+    from; non-neutral scales change outputs (knob is live)."""
+    sd = _state(llama_base)
+    neutral = _save_variant(
+        tmp_path_factory, "tiny_minicpm", "MiniCPMForCausalLM", sd,
+        {"scale_emb": 1.0,
+         "scale_depth": float(np.sqrt(CFG["num_hidden_layers"])),
+         "dim_model_base": CFG["hidden_size"]})
+    assert _run(neutral) == _run(llama_base)
+    scaled = _save_variant(
+        tmp_path_factory, "tiny_minicpm_sc", "MiniCPMForCausalLM", sd,
+        {"scale_emb": 4.0, "scale_depth": 1.4,
+         "dim_model_base": CFG["hidden_size"] // 2})
+    assert _run(scaled) != _run(llama_base)
+
+
+def test_exaone_renamed_equivalence(llama_base, tmp_path_factory):
+    sd = _state(llama_base)
+    out = {"transformer.wte.weight": sd["model.embed_tokens.weight"],
+           "transformer.ln_f.weight": sd["model.norm.weight"],
+           "lm_head.weight": sd["lm_head.weight"]}
+    for i in range(CFG["num_hidden_layers"]):
+        src = f"model.layers.{i}."
+        dst = f"transformer.h.{i}."
+        out[dst + "ln_1.weight"] = sd[src + "input_layernorm.weight"]
+        out[dst + "ln_2.weight"] = \
+            sd[src + "post_attention_layernorm.weight"]
+        for p in ("q", "k", "v"):
+            out[dst + f"attn.attention.{p}_proj.weight"] = \
+                sd[src + f"self_attn.{p}_proj.weight"]
+        out[dst + "attn.attention.out_proj.weight"] = \
+            sd[src + "self_attn.o_proj.weight"]
+        out[dst + "mlp.c_fc_0.weight"] = sd[src + "mlp.gate_proj.weight"]
+        out[dst + "mlp.c_fc_1.weight"] = sd[src + "mlp.up_proj.weight"]
+        out[dst + "mlp.c_proj.weight"] = sd[src + "mlp.down_proj.weight"]
+    path = _save_variant(tmp_path_factory, "tiny_exaone",
+                         "ExaoneForCausalLM", out,
+                         {"activation_function": "silu"})
+    assert _run(path) == _run(llama_base)

@@ -225,12 +225,14 @@ class _ClientError(Exception):
     history and continues; --quick exits non-zero)."""
 
 
-def _client_request(url, api_key, path, body):
+def _client_request(url, api_key, path, body=None):
+    """POST (or GET when body is None) with server errors wrapped as
+    _ClientError."""
     import urllib.error
     import urllib.request
     req = urllib.request.Request(
         url.rstrip("/") + path,
-        data=json.dumps(body).encode(),
+        data=None if body is None else json.dumps(body).encode(),
         headers={"Content-Type": "application/json",
                  **({"Authorization": f"Bearer {api_key}"}
                     if api_key else {})})
@@ -251,15 +253,9 @@ def _client_request(url, api_key, path, body):
 def _client_model(args) -> str:
     if args.model_name:
         return args.model_name
-    import urllib.request
-    req = urllib.request.Request(
-        args.url.rstrip("/") + "/models",
-        headers=({"Authorization": f"Bearer {args.api_key}"}
-                 if args.api_key else {}))
-    with urllib.request.urlopen(req, timeout=60) as resp:
-        models = json.loads(resp.read())["data"]
+    models = _client_request(args.url, args.api_key, "/models")["data"]
     if not models:
-        raise SystemExit("server lists no models")
+        raise _ClientError("server lists no models")
     return models[0]["id"]
 
 

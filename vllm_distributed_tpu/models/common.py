@@ -135,6 +135,18 @@ jax.tree_util.register_dataclass(
 )
 
 
+def rename_tensors(tensors: dict, table) -> dict:
+    """Substring-rename checkpoint tensor names onto the canonical
+    layout (shared by the family loaders; rules apply in order)."""
+    out = {}
+    for name, t in tensors.items():
+        for old, new in table:
+            if old in name:
+                name = name.replace(old, new)
+        out[name] = t
+    return out
+
+
 def rms_norm(x: jax.Array, weight: jax.Array,
              eps: float = 1e-6) -> jax.Array:
     """Llama RMSNorm; accumulate in fp32 regardless of activation dtype."""

@@ -32,14 +32,7 @@ def _alias_moe_experts(tensors: dict, num_layers: int,
     return alias
 
 
-def _rename(tensors: dict, table: list[tuple[str, str]]) -> dict:
-    out = {}
-    for name, t in tensors.items():
-        for old, new in table:
-            if old in name:
-                name = name.replace(old, new)
-        out[name] = t
-    return out
+from vllm_distributed_tpu.models.common import rename_tensors as _rename
 
 
 class GraniteForCausalLM(LlamaForCausalLM):
@@ -200,34 +193,12 @@ class PhiForCausalLM(LlamaForCausalLM):
                                             0.5)))
         arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-5))
 
-    def param_specs(self) -> dict:
-        from jax.sharding import PartitionSpec as P
-
-        from vllm_distributed_tpu.models.llama import MODEL_AXIS
-        specs = super().param_specs()
-        specs["lm_head_b"] = P(MODEL_AXIS)
-        return specs
-
-    def init_params(self, rng, scale: float = 0.02) -> dict:
-        import jax.numpy as jnp
-        params = super().init_params(rng, scale)
-        params["lm_head_b"] = jnp.zeros((self.cfg.vocab_size, ),
-                                        self.cfg.dtype)
-        return params
-
     def params_from_hf_state_dict(self, tensors) -> dict:
-        renamed = _rename(tensors, [
+        # lm_head.bias flows through the base LM_HEAD_BIAS hook.
+        return super().params_from_hf_state_dict(_rename(tensors, [
             (".self_attn.dense.", ".self_attn.o_proj."),
             ("model.final_layernorm.", "model.norm."),
-        ])
-        params = super().params_from_hf_state_dict(renamed)
-        import jax.numpy as jnp
-        params["lm_head_b"] = jnp.asarray(
-            np.asarray(renamed.get(
-                "lm_head.bias",
-                np.zeros((self.cfg.vocab_size, ), np.float32))),
-            self.cfg.dtype)
-        return params
+        ]))
 
 
 class CohereForCausalLM(LlamaForCausalLM):

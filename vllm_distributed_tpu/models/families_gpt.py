@@ -1,0 +1,342 @@
+"""GPT-lineage families on the learned-position / parallel-block knobs.
+
+Reference: vllm/model_executor/models/{gpt2,gpt_j,gpt_bigcode,opt,
+minicpm,exaone}.py — each is the canonical decoder with structural
+twists now expressed as LlamaArchConfig knobs (learned absolute
+positions, fused/packed QKV checkpoint layouts, Conv1D weight storage,
+MQA, MUP-style multipliers); the subclasses set the knobs and map the
+checkpoint tensor names onto the canonical layout models/llama.py
+stacks."""
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from vllm_distributed_tpu.models.common import rename_tensors as _rename
+from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                               LlamaForCausalLM)
+
+
+class GPT2LMHeadModel(LlamaForCausalLM):
+    """GPT-2: learned positions (wpe), pre-LN LayerNorm+bias blocks,
+    fused Conv1D c_attn split into q/k/v, gelu_new MLP, tied LM head
+    (reference: models/gpt2.py incl. its Conv1D transpose and c_attn
+    split in the weight loader)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=(getattr(hf, "n_inner", None)
+                               or 4 * hf.hidden_size),
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.pos_embedding = "learned"
+        arch.max_position_embeddings = int(hf.max_position_embeddings)
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "activation_function", "gelu_new")
+        arch.tie_word_embeddings = True
+        if getattr(hf, "scale_attn_by_inverse_layer_idx", False):
+            raise ValueError(
+                "GPT-2 scale_attn_by_inverse_layer_idx checkpoints are "
+                "not supported")
+
+    # Conv1D stores [in, out]; the canonical loader transposes torch
+    # Linear [out, in] — so Conv1D mats are pre-transposed here.
+    _CONV1D = (".attn.c_proj.weight", ".mlp.c_fc.weight",
+               ".mlp.c_proj.weight")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        H = c.hidden_size
+        out = {}
+        for name, t in tensors.items():
+            if name.endswith(".attn.bias") or name.endswith(
+                    ".attn.masked_bias"):
+                continue  # causal-mask buffers
+            t = np.asarray(t)
+            if any(name.endswith(suf) for suf in self._CONV1D):
+                t = t.T
+            name = name.replace("transformer.h.", "model.layers.")
+            name = name.replace("transformer.wte.",
+                                "model.embed_tokens.")
+            name = name.replace("transformer.wpe.",
+                                "model.embed_positions.")
+            name = name.replace("transformer.ln_f.", "model.norm.")
+            name = name.replace(".ln_1.", ".input_layernorm.")
+            name = name.replace(".ln_2.", ".post_attention_layernorm.")
+            name = name.replace(".attn.c_proj.", ".self_attn.o_proj.")
+            name = name.replace(".mlp.c_fc.", ".mlp.fc1.")
+            name = name.replace(".mlp.c_proj.", ".mlp.fc2.")
+            out[name] = t
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.attn.c_attn"
+            w = np.asarray(out.pop(base + ".weight"))  # Conv1D [H, 3H]
+            b = np.asarray(out.pop(base + ".bias"))
+            A = f"model.layers.{i}.self_attn."
+            # Canonical layout is torch-Linear [out, in].
+            out[A + "q_proj.weight"] = w[:, :H].T
+            out[A + "k_proj.weight"] = w[:, H:2 * H].T
+            out[A + "v_proj.weight"] = w[:, 2 * H:].T
+            out[A + "q_proj.bias"] = b[:H]
+            out[A + "k_proj.bias"] = b[H:2 * H]
+            out[A + "v_proj.bias"] = b[2 * H:]
+        return super().params_from_hf_state_dict(out)
+
+
+class GPTJForCausalLM(LlamaForCausalLM):
+    """GPT-J: parallel residual from ONE shared ln_1, interleaved
+    partial rotary, unbiased separate q/k/v, biased fc_in/fc_out MLP
+    and a biased LM head (reference: models/gpt_j.py)."""
+
+    LM_HEAD_BIAS = True
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=(getattr(hf, "n_inner", None)
+                               or 4 * hf.hidden_size),
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            tie_word_embeddings=False,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.parallel_block = True
+        arch.shared_block_ln = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.rope_interleaved = True
+        arch.rotary_dim = int(getattr(hf, "rotary_dim", None)
+                              or arch.head_dim)
+        arch.hidden_act = getattr(hf, "activation_function", "gelu_new")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        # lm_head.bias flows through the base LM_HEAD_BIAS hook.
+        renamed = _rename(tensors, [
+            ("transformer.h.", "model.layers."),
+            ("transformer.wte.", "model.embed_tokens."),
+            ("transformer.ln_f.", "model.norm."),
+            (".ln_1.", ".input_layernorm."),
+            (".attn.out_proj.", ".self_attn.o_proj."),
+            (".attn.q_proj.", ".self_attn.q_proj."),
+            (".attn.k_proj.", ".self_attn.k_proj."),
+            (".attn.v_proj.", ".self_attn.v_proj."),
+            (".mlp.fc_in.", ".mlp.fc1."),
+            (".mlp.fc_out.", ".mlp.fc2."),
+        ])
+        renamed = {k: v for k, v in renamed.items()
+                   if not k.endswith((".attn.bias", ".attn.masked_bias"))}
+        return super().params_from_hf_state_dict(renamed)
+
+
+class GPTBigCodeForCausalLM(LlamaForCausalLM):
+    """GPTBigCode (StarCoder 1 / SantaCoder): multi-query attention
+    (one KV head), learned positions, LayerNorm+bias, packed Linear
+    c_attn [H + 2*head_dim rows] (reference: models/gpt_bigcode.py)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        mq = bool(getattr(hf, "multi_query", True))
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=(getattr(hf, "n_inner", None)
+                               or 4 * hf.hidden_size),
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=1 if mq else hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.pos_embedding = "learned"
+        arch.max_position_embeddings = int(hf.max_position_embeddings)
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "activation_function",
+                                  "gelu_pytorch_tanh")
+        arch.tie_word_embeddings = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        H = c.hidden_size
+        kv = c.num_kv_heads * c.head_dim
+        out = {}
+        for name, t in tensors.items():
+            name = name.replace("transformer.h.", "model.layers.")
+            name = name.replace("transformer.wte.",
+                                "model.embed_tokens.")
+            name = name.replace("transformer.wpe.",
+                                "model.embed_positions.")
+            name = name.replace("transformer.ln_f.", "model.norm.")
+            name = name.replace(".ln_1.", ".input_layernorm.")
+            name = name.replace(".ln_2.", ".post_attention_layernorm.")
+            name = name.replace(".attn.c_proj.", ".self_attn.o_proj.")
+            name = name.replace(".mlp.c_fc.", ".mlp.fc1.")
+            name = name.replace(".mlp.c_proj.", ".mlp.fc2.")
+            out[name] = np.asarray(t)
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.attn.c_attn"
+            w = np.asarray(out.pop(base + ".weight"))  # [H + 2kv, H]
+            b = np.asarray(out.pop(base + ".bias"))
+            A = f"model.layers.{i}.self_attn."
+            out[A + "q_proj.weight"] = w[:H]
+            out[A + "k_proj.weight"] = w[H:H + kv]
+            out[A + "v_proj.weight"] = w[H + kv:]
+            out[A + "q_proj.bias"] = b[:H]
+            out[A + "k_proj.bias"] = b[H:H + kv]
+            out[A + "v_proj.bias"] = b[H + kv:]
+        return super().params_from_hf_state_dict(out)
+
+
+class OPTForCausalLM(LlamaForCausalLM):
+    """OPT: learned positions written from offset 2, ReLU MLP,
+    LayerNorm+bias, biased projections, tied embeddings (reference:
+    models/opt.py incl. OPTLearnedPositionalEmbedding's offset)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.ffn_dim,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=bool(
+                getattr(hf, "tie_word_embeddings", True)),
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if getattr(hf, "word_embed_proj_dim",
+                   hf.hidden_size) != hf.hidden_size:
+            raise ValueError(
+                "OPT word_embed_proj_dim != hidden_size (opt-350m "
+                "projection layout) is not supported")
+        if not getattr(hf, "do_layer_norm_before", True):
+            raise ValueError(
+                "OPT post-norm (do_layer_norm_before=False) "
+                "checkpoints are not supported")
+        arch.pos_embedding = "learned"
+        # The HF table physically holds offset + max positions.
+        arch.pos_offset = 2
+        arch.max_position_embeddings = int(
+            hf.max_position_embeddings) + 2
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "activation_function", "relu")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = _rename(tensors, [
+            ("model.decoder.layers.", "model.layers."),
+            ("model.decoder.embed_tokens.", "model.embed_tokens."),
+            ("model.decoder.embed_positions.",
+             "model.embed_positions."),
+            ("model.decoder.final_layer_norm.", "model.norm."),
+            (".self_attn.out_proj.", ".self_attn.o_proj."),
+            (".self_attn_layer_norm.", ".input_layernorm."),
+            (".final_layer_norm.", ".post_attention_layernorm."),
+            (".fc1.", ".mlp.fc1."),
+            (".fc2.", ".mlp.fc2."),
+        ])
+        return super().params_from_hf_state_dict(renamed)
+
+
+class MiniCPMForCausalLM(LlamaForCausalLM):
+    """MiniCPM 1/2: Llama weights + MUP-style multipliers (scale_emb,
+    depth-scaled residuals, logits over dim_model_base; reference:
+    models/minicpm.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if getattr(hf, "num_experts", 0):
+            raise ValueError("MiniCPM-MoE checkpoints are not supported")
+        import math
+        arch.embed_scale = float(getattr(hf, "scale_emb", 1.0))
+        depth = float(getattr(hf, "scale_depth", 1.0))
+        arch.residual_multiplier = depth / math.sqrt(arch.num_layers)
+        base = float(getattr(hf, "dim_model_base", arch.hidden_size)
+                     or arch.hidden_size)
+        arch.logit_multiplier = base / arch.hidden_size
+
+
+class ExaoneForCausalLM(LlamaForCausalLM):
+    """LG EXAONE 3: Llama block under transformer.h naming
+    (reference: models/exaone.py)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=getattr(hf, "num_key_value_heads",
+                                        hf.num_attention_heads),
+            head_dim=getattr(hf, "head_dim", None) or (
+                hf.hidden_size // hf.num_attention_heads),
+            rms_norm_eps=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            tie_word_embeddings=bool(
+                getattr(hf, "tie_word_embeddings", False)),
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            rope_scaling=getattr(hf, "rope_scaling", None),
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.hidden_act = getattr(hf, "activation_function", "silu")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = _rename(tensors, [
+            ("transformer.h.", "model.layers."),
+            ("transformer.wte.", "model.embed_tokens."),
+            ("transformer.ln_f.", "model.norm."),
+            (".ln_1.", ".input_layernorm."),
+            (".ln_2.", ".post_attention_layernorm."),
+            (".attn.attention.q_proj.", ".self_attn.q_proj."),
+            (".attn.attention.k_proj.", ".self_attn.k_proj."),
+            (".attn.attention.v_proj.", ".self_attn.v_proj."),
+            (".attn.attention.out_proj.", ".self_attn.o_proj."),
+            (".mlp.c_fc_0.", ".mlp.gate_proj."),
+            (".mlp.c_fc_1.", ".mlp.up_proj."),
+            (".mlp.c_proj.", ".mlp.down_proj."),
+        ])
+        return super().params_from_hf_state_dict(renamed)

@@ -171,6 +171,14 @@ class LlamaArchConfig:
     # Score scale as a direct multiplier (Granite attention_multiplier);
     # overrides the head-dim rule and query_pre_attn_scalar.
     sm_scale_override: Optional[float] = None
+    # Position encoding: "rope" (default) or "learned" absolute tables
+    # added at embed time (GPT-2 / OPT / GPTBigCode lineage; reference:
+    # the get_position_embeddings path of models/gpt2.py, opt.py). The
+    # table is params["embed_pos"] [max_position_embeddings, H];
+    # pos_offset shifts lookups (OPT writes positions starting at 2).
+    pos_embedding: str = "rope"
+    max_position_embeddings: int = 0
+    pos_offset: int = 0
     # Residual-branch multiplier (Granite residual_multiplier).
     residual_multiplier: float = 1.0
     # Final-logit multiplier (Cohere logit_scale; Granite
@@ -255,6 +263,9 @@ class LlamaForCausalLM:
     # Matrices that accept LoRA adapters (reference: lora/layers.py
     # wrapping every parallel linear; MoE models restrict to attention).
     LORA_TARGETS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+    # Families with a biased LM head (Phi, GPT-J): specs/init/load key
+    # on this; the forward applies params["lm_head_b"] when present.
+    LM_HEAD_BIAS = False
 
     def __init__(self, cfg: LlamaArchConfig) -> None:
         self.cfg = cfg
@@ -403,6 +414,10 @@ class LlamaForCausalLM:
             "final_ln": P(None),
             "lm_head": P(None, MODEL_AXIS),
         }
+        if c.pos_embedding == "learned":
+            specs["embed_pos"] = P(None, None)
+        if self.LM_HEAD_BIAS:
+            specs["lm_head_b"] = P(MODEL_AXIS)
         if c.norm_bias:
             specs["final_ln_b"] = P(None)
         return specs
@@ -544,6 +559,11 @@ class LlamaForCausalLM:
             "lm_head": (embed.T if c.tie_word_embeddings else norm(
                 next(keys), (H, c.vocab_size))),
         }
+        if c.pos_embedding == "learned":
+            out["embed_pos"] = norm(next(keys),
+                                    (c.max_position_embeddings, H))
+        if self.LM_HEAD_BIAS:
+            out["lm_head_b"] = jnp.zeros((c.vocab_size, ), c.dtype)
         if c.norm_bias:
             out["final_ln_b"] = jnp.zeros((H, ), c.dtype)
         return out
@@ -718,6 +738,16 @@ class LlamaForCausalLM:
             "final_ln": jnp.asarray(t("model.norm.weight"), dtype=c.dtype),
             "lm_head": lm_head,
         }
+        if c.pos_embedding == "learned":
+            # Families rename their table to this canonical name.
+            out["embed_pos"] = jnp.asarray(
+                t("model.embed_positions.weight"), dtype=c.dtype)
+        if self.LM_HEAD_BIAS:
+            out["lm_head_b"] = jnp.asarray(
+                np.asarray(tensors.get(
+                    "lm_head.bias",
+                    np.zeros((c.vocab_size, ), np.float32))),
+                dtype=c.dtype)
         if c.norm_bias and "model.norm.bias" in tensors:
             out["final_ln_b"] = jnp.asarray(t("model.norm.bias"),
                                             dtype=c.dtype)
@@ -735,6 +765,10 @@ class LlamaForCausalLM:
         if act == "relu2":
             r = jax.nn.relu(x)
             return r * r
+        if act == "relu":
+            return jax.nn.relu(x)
+        if act == "quick_gelu":
+            return x * jax.nn.sigmoid(1.702 * x)
         if act in ("silu", "swish", None):
             return jax.nn.silu(x)
         raise ValueError(
@@ -780,14 +814,22 @@ class LlamaForCausalLM:
         return (gu @ self._w(lp, "down") +
                 self._lora_delta(lp, "down", gu, lora_ctx))
 
-    def embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
+    def embed(self, params: dict, token_ids: jax.Array,
+              positions: jax.Array = None) -> jax.Array:
         """Token embedding (pipeline stage 0 front; reference: the
-        VocabParallelEmbedding layer)."""
+        VocabParallelEmbedding layer; learned-position families add
+        their absolute table here like GPT2Model.wpe)."""
         h = params["embed"][token_ids]
         if self.cfg.embed_scale != 1.0:
             # Gemma normalizer semantics: the scale is cast to the
             # activation dtype before multiplying (HF parity).
             h = h * jnp.asarray(self.cfg.embed_scale, h.dtype)
+        if self.cfg.pos_embedding == "learned":
+            assert positions is not None, \
+                "learned-position models need positions at embed time"
+            idx = jnp.clip(positions + self.cfg.pos_offset, 0,
+                           self.cfg.max_position_embeddings - 1)
+            h = h + params["embed_pos"][idx]
         return h
 
     @staticmethod
@@ -860,7 +902,9 @@ class LlamaForCausalLM:
             sm_scale = (c.query_pre_attn_scalar or c.head_dim) ** -0.5
         num_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
         rd = c.rotary_dim or c.head_dim
-        if c.rope_interleaved:
+        if c.pos_embedding != "rope":
+            cos = sin = cos_l = sin_l = None
+        elif c.rope_interleaved:
             from vllm_distributed_tpu.models.common import \
                 compute_rope_cos_sin_pairwise
             cos, sin = compute_rope_cos_sin_pairwise(
@@ -918,7 +962,10 @@ class LlamaForCausalLM:
         def apply_rotary(x, local=False):
             """Rope on the first ``rd`` lanes (fp32; partial rotary
             passes the tail through — GPT-NeoX rotary_pct semantics);
-            ``local`` picks the sliding-layer table (Gemma3)."""
+            ``local`` picks the sliding-layer table (Gemma3). Learned-
+            position families skip rotation entirely."""
+            if c.pos_embedding != "rope":
+                return x
             from vllm_distributed_tpu.models.common import (
                 apply_rope_pairwise, apply_rope_single)
             cs, sn = (cos_l, sin_l) if local else (cos, sin)
@@ -1042,7 +1089,7 @@ class LlamaForCausalLM:
     ) -> tuple[jax.Array, dict]:
         """Run the decoder over a flat ragged token batch; returns final
         hidden states [T, H] and the updated KV caches."""
-        hidden = self.embed(params, token_ids)
+        hidden = self.embed(params, token_ids, batch.positions)
         if getattr(batch, "mm_embeds", None) is not None:
             # Image placeholder positions take their pre-computed
             # encoder rows (reference: the inputs_embeds merge of

@@ -142,6 +142,15 @@ def get_model(config: EngineConfig, mesh,
             raise ValueError(
                 "EPLB redundant experts over stateful hybrid models "
                 "are not wired; drop num_redundant_experts")
+    if arch.pos_embedding == "learned":
+        capacity = arch.max_position_embeddings - arch.pos_offset
+        if config.scheduler_config.max_model_len > capacity:
+            # A clip would silently reuse the last table row past the
+            # window (degenerate output, no error) — refuse instead.
+            raise ValueError(
+                f"max_model_len={config.scheduler_config.max_model_len} "
+                f"exceeds the model's learned-position capacity "
+                f"({capacity}); lower --max-model-len")
     if getattr(arch, "encoder_only", False):
         pc = config.parallel_config
         bad = []

@@ -31,6 +31,12 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
+from vllm_distributed_tpu.models.families_gpt import (ExaoneForCausalLM,
+                                                      GPT2LMHeadModel,
+                                                      GPTBigCodeForCausalLM,
+                                                      GPTJForCausalLM,
+                                                      MiniCPMForCausalLM,
+                                                      OPTForCausalLM)
 from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
                                               BertForSequenceClassification,
                                               RobertaEmbeddingModel,
@@ -90,6 +96,14 @@ _REGISTRY: dict[str, type] = {
     "JambaForCausalLM": JambaForCausalLM,
     # Hybrid Mamba-2/attention (models/bamba.py).
     "BambaForCausalLM": BambaForCausalLM,
+    # GPT lineage: learned positions / parallel blocks / packed QKV
+    # (models/families_gpt.py).
+    "GPT2LMHeadModel": GPT2LMHeadModel,
+    "GPTJForCausalLM": GPTJForCausalLM,
+    "GPTBigCodeForCausalLM": GPTBigCodeForCausalLM,
+    "OPTForCausalLM": OPTForCausalLM,
+    "MiniCPMForCausalLM": MiniCPMForCausalLM,
+    "ExaoneForCausalLM": ExaoneForCausalLM,
     # Encoder-only embedding + cross-encoder families (models/bert.py;
     # reference: the _EMBEDDING_MODELS / _CROSS_ENCODER_MODELS maps of
     # model_executor/models/registry.py).

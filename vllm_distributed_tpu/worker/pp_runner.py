@@ -71,6 +71,11 @@ class PPModelRunner(TPUModelRunner):
             "embed": jax.device_put(host_params["embed"],
                                     NamedSharding(sm0, specs["embed"])),
         }
+        if "embed_pos" in host_params:
+            # Learned-position families add their table at stage 0.
+            self.embed_params["embed_pos"] = jax.device_put(
+                host_params["embed_pos"],
+                NamedSharding(sm0, specs["embed_pos"]))
         self._init_lora_manager()
         # The sampler's params (final norm + LM head) live with the last
         # stage; the base class passes self.params to the sample fns.
@@ -118,8 +123,8 @@ class PPModelRunner(TPUModelRunner):
     def _build_step_fn(self) -> None:
         model = self.model
 
-        def embed(params, token_ids):
-            return model.embed(params, token_ids)
+        def embed(params, token_ids, positions=None):
+            return model.embed(params, token_ids, positions)
 
         def stage(layer_params, kv_caches, hidden, batch, first_layer=0):
             hidden, kv_caches = model.run_layers(layer_params, kv_caches,
@@ -155,7 +160,8 @@ class PPModelRunner(TPUModelRunner):
         sm0 = self.stage_meshes[0]
         with global_mesh(sm0), sm0:
             with self._compile_watch(("embed", fwd_shape[0])):
-                hidden = self._embed_fn(self.embed_params, token_ids)
+                hidden = self._embed_fn(self.embed_params, token_ids,
+                                 batch.positions)
         for p in range(self.pp):
             sm = self.stage_meshes[p]
             # Activation handoff: ICI/DCN copy to the next stage's
@@ -191,7 +197,8 @@ class PPModelRunner(TPUModelRunner):
             sm0 = self.stage_meshes[0]
             with global_mesh(sm0), sm0:
                 with self._compile_watch(("embed", T)):
-                    hidden = self._embed_fn(self.embed_params, token_ids)
+                    hidden = self._embed_fn(self.embed_params, token_ids,
+                                 batch.positions)
             for p in range(self.pp):
                 sm = self.stage_meshes[p]
                 hidden = jax.device_put(
@@ -234,7 +241,8 @@ class PPModelRunner(TPUModelRunner):
         token_ids, batch = self._dummy_step_inputs(T, max_q, G)
         sm0 = self.stage_meshes[0]
         with global_mesh(sm0), sm0:
-            hidden = self._embed_fn(self.embed_params, token_ids)
+            hidden = self._embed_fn(self.embed_params, token_ids,
+                                 batch.positions)
         for p in range(self.pp):
             sm = self.stage_meshes[p]
             hidden = jax.device_put(hidden,
