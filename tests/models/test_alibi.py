@@ -1,0 +1,68 @@
+"""ALiBi families (Bloom/MPT) and the slope-bias attention path
+(reference: models/bloom.py, mpt.py and the alibi_slopes arg threaded
+through the reference attention backends)."""
+
+import numpy as np
+import torch
+import transformers
+
+from tests.models._engine_harness import PROMPTS, hf_greedy, run_engine
+from vllm_distributed_tpu.models.common import alibi_slopes
+
+
+def test_alibi_slopes_match_published_recipe():
+    # Power-of-two head counts: geometric 2^(-8i/n).
+    np.testing.assert_allclose(
+        alibi_slopes(8), [2.0 ** (-(i + 1)) for i in range(8)])
+    # Non-power-of-two (e.g. 12 heads): 8-head ladder + every other
+    # entry of the 16-head ladder.
+    s12 = alibi_slopes(12)
+    assert len(s12) == 12
+    np.testing.assert_allclose(s12[:8], alibi_slopes(8))
+    np.testing.assert_allclose(s12[8:], alibi_slopes(16)[0::2][:4])
+
+
+def _save(tmp_path_factory, name, hf):
+    path = str(tmp_path_factory.mktemp(name))
+    hf.save_pretrained(path, safe_serialization=True)
+    return path, hf
+
+
+def _check(path, hf, n=6, **overrides):
+    got = run_engine(path, PROMPTS, max_tokens=n, **overrides)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, n), f"prompt {p}"
+
+
+def test_bloom_matches_hf(tmp_path_factory):
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        eos_token_id=1)
+    torch.manual_seed(0)
+    path, hf = _save(tmp_path_factory, "tiny_bloom",
+                     transformers.BloomForCausalLM(cfg).eval())
+    _check(path, hf)
+
+
+def test_mpt_matches_hf(tmp_path_factory):
+    cfg = transformers.MptConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+        expansion_ratio=2, no_bias=True,
+        attn_config={"alibi": True, "qk_ln": False},
+        eos_token_id=1)
+    torch.manual_seed(1)
+    path, hf = _save(tmp_path_factory, "tiny_mpt",
+                     transformers.MptForCausalLM(cfg).eval())
+    _check(path, hf)
+
+
+def test_bloom_matches_hf_under_tp2(tmp_path_factory):
+    """The XLA alibi path under GSPMD TP: per-head slopes must follow
+    their heads across the model-axis shards."""
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        eos_token_id=1)
+    torch.manual_seed(2)
+    path, hf = _save(tmp_path_factory, "tiny_bloom_tp",
+                     transformers.BloomForCausalLM(cfg).eval())
+    _check(path, hf, tensor_parallel_size=2)

@@ -135,6 +135,24 @@ jax.tree_util.register_dataclass(
 )
 
 
+def alibi_slopes(num_heads: int) -> tuple:
+    """Standard ALiBi head slopes (geometric 2^(-8i/n) ladder, with the
+    interleaved extension for non-power-of-two head counts; reference:
+    the _get_alibi_slopes helpers of models/bloom.py / mpt.py — the
+    published train-short-test-long recipe)."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        return tuple(pow2(num_heads))
+    closest = 2 ** math.floor(math.log2(num_heads))
+    return tuple(pow2(closest) +
+                 pow2(2 * closest)[0::2][:num_heads - closest])
+
+
 def rename_tensors(tensors: dict, table) -> dict:
     """Substring-rename checkpoint tensor names onto the canonical
     layout (shared by the family loaders; rules apply in order)."""

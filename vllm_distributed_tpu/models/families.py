@@ -162,18 +162,17 @@ class InternLM2ForCausalLM(LlamaForCausalLM):
 
 
 class BaichuanForCausalLM(LlamaForCausalLM):
-    """Baichuan-7B (reference: vllm/model_executor/models/baichuan.py):
-    Llama math with a fused W_pack = [q; k; v] projection. The 13B
-    variant replaces RoPE with ALiBi, which this decoder does not
-    implement — rejected in configure_arch (the reference keys the same
-    split on position_embedding, baichuan.py:330)."""
+    """Baichuan 7B/13B (reference: vllm/model_executor/models/
+    baichuan.py): Llama math with a fused W_pack = [q; k; v]
+    projection. The 13B variant replaces RoPE with ALiBi — keyed on
+    hidden size like the reference keys position_embedding on the
+    model name (baichuan.py:330)."""
 
     @classmethod
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
         if getattr(hf, "hidden_size", 0) >= 5120:
-            raise ValueError(
-                "Baichuan-13B uses ALiBi position embeddings, which are "
-                "not supported; only the RoPE (7B-style) variant loads")
+            arch.alibi = True
+            arch.pos_embedding = "none"
 
     # Baichuan2's vocab size — its NormHead lm_head stores unnormalized
     # rows that the forward L2-normalizes (reference: baichuan.py keying

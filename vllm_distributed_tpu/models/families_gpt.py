@@ -16,6 +16,29 @@ from vllm_distributed_tpu.models.common import rename_tensors as _rename
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 
+# Shared GPT-2-style transformer.h naming (also GPTBigCode).
+_GPT2_RENAMES = [
+    ("transformer.h.", "model.layers."),
+    ("transformer.wte.", "model.embed_tokens."),
+    ("transformer.wpe.", "model.embed_positions."),
+    ("transformer.ln_f.", "model.norm."),
+    (".ln_1.", ".input_layernorm."),
+    (".ln_2.", ".post_attention_layernorm."),
+    (".attn.c_proj.", ".self_attn.o_proj."),
+    (".mlp.c_fc.", ".mlp.fc1."),
+    (".mlp.c_proj.", ".mlp.fc2."),
+]
+
+
+def _attn_get(hf, key, default):
+    """Read a key from MPT's attn_config (dict or sub-config object)."""
+    attn = getattr(hf, "attn_config", None)
+    if attn is None:
+        return default
+    if isinstance(attn, dict):
+        return attn.get(key, default)
+    return getattr(attn, key, default)
+
 
 class GPT2LMHeadModel(LlamaForCausalLM):
     """GPT-2: learned positions (wpe), pre-LN LayerNorm+bias blocks,
@@ -63,26 +86,15 @@ class GPT2LMHeadModel(LlamaForCausalLM):
     def params_from_hf_state_dict(self, tensors) -> dict:
         c = self.cfg
         H = c.hidden_size
-        out = {}
+        filtered = {}
         for name, t in tensors.items():
-            if name.endswith(".attn.bias") or name.endswith(
-                    ".attn.masked_bias"):
+            if name.endswith((".attn.bias", ".attn.masked_bias")):
                 continue  # causal-mask buffers
             t = np.asarray(t)
             if any(name.endswith(suf) for suf in self._CONV1D):
                 t = t.T
-            name = name.replace("transformer.h.", "model.layers.")
-            name = name.replace("transformer.wte.",
-                                "model.embed_tokens.")
-            name = name.replace("transformer.wpe.",
-                                "model.embed_positions.")
-            name = name.replace("transformer.ln_f.", "model.norm.")
-            name = name.replace(".ln_1.", ".input_layernorm.")
-            name = name.replace(".ln_2.", ".post_attention_layernorm.")
-            name = name.replace(".attn.c_proj.", ".self_attn.o_proj.")
-            name = name.replace(".mlp.c_fc.", ".mlp.fc1.")
-            name = name.replace(".mlp.c_proj.", ".mlp.fc2.")
-            out[name] = t
+            filtered[name] = t
+        out = _rename(filtered, _GPT2_RENAMES)
         for i in range(c.num_layers):
             base = f"model.layers.{i}.attn.c_attn"
             w = np.asarray(out.pop(base + ".weight"))  # Conv1D [H, 3H]
@@ -191,20 +203,8 @@ class GPTBigCodeForCausalLM(LlamaForCausalLM):
         c = self.cfg
         H = c.hidden_size
         kv = c.num_kv_heads * c.head_dim
-        out = {}
-        for name, t in tensors.items():
-            name = name.replace("transformer.h.", "model.layers.")
-            name = name.replace("transformer.wte.",
-                                "model.embed_tokens.")
-            name = name.replace("transformer.wpe.",
-                                "model.embed_positions.")
-            name = name.replace("transformer.ln_f.", "model.norm.")
-            name = name.replace(".ln_1.", ".input_layernorm.")
-            name = name.replace(".ln_2.", ".post_attention_layernorm.")
-            name = name.replace(".attn.c_proj.", ".self_attn.o_proj.")
-            name = name.replace(".mlp.c_fc.", ".mlp.fc1.")
-            name = name.replace(".mlp.c_proj.", ".mlp.fc2.")
-            out[name] = np.asarray(t)
+        out = _rename({k: np.asarray(v) for k, v in tensors.items()},
+                      _GPT2_RENAMES)
         for i in range(c.num_layers):
             base = f"model.layers.{i}.attn.c_attn"
             w = np.asarray(out.pop(base + ".weight"))  # [H + 2kv, H]
@@ -277,6 +277,146 @@ class OPTForCausalLM(LlamaForCausalLM):
             (".fc2.", ".mlp.fc2."),
         ])
         return super().params_from_hf_state_dict(renamed)
+
+
+class BloomForCausalLM(LlamaForCausalLM):
+    """Bloom: ALiBi (no position embeddings), post-embedding LayerNorm,
+    per-head-interleaved fused QKV, gelu-tanh MLP, tied embeddings
+    (reference: models/bloom.py incl. its _get_alibi_slopes and the
+    query_key_value de-interleave)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=4 * hf.hidden_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.alibi = True
+        arch.pos_embedding = "none"
+        arch.embed_ln = True
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = "gelu_tanh"  # BloomGelu = tanh approximation
+        arch.tie_word_embeddings = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        N, D, H = c.num_q_heads, c.head_dim, c.hidden_size
+        prefixed = {
+            (k if k.startswith("transformer.") else "transformer." + k):
+            np.asarray(v)  # some dumps drop the prefix
+            for k, v in tensors.items()
+        }
+        out = _rename(prefixed, [
+            ("transformer.h.", "model.layers."),
+            ("transformer.word_embeddings_layernorm.",
+             "model.embed_layernorm."),
+            ("transformer.word_embeddings.", "model.embed_tokens."),
+            ("transformer.ln_f.", "model.norm."),
+            (".self_attention.dense.", ".self_attn.o_proj."),
+            (".mlp.dense_h_to_4h.", ".mlp.fc1."),
+            (".mlp.dense_4h_to_h.", ".mlp.fc2."),
+        ])
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.self_attention.query_key_value"
+            # Rows pack [h0_q, h0_k, h0_v, h1_q, ...] like GPT-NeoX.
+            w = out.pop(base + ".weight").reshape(N, 3, D, H)
+            b = out.pop(base + ".bias").reshape(N, 3, D)
+            A = f"model.layers.{i}.self_attn."
+            out[A + "q_proj.weight"] = w[:, 0].reshape(N * D, H)
+            out[A + "k_proj.weight"] = w[:, 1].reshape(N * D, H)
+            out[A + "v_proj.weight"] = w[:, 2].reshape(N * D, H)
+            out[A + "q_proj.bias"] = b[:, 0].reshape(N * D)
+            out[A + "k_proj.bias"] = b[:, 1].reshape(N * D)
+            out[A + "v_proj.bias"] = b[:, 2].reshape(N * D)
+        return super().params_from_hf_state_dict(out)
+
+
+class MPTForCausalLM(LlamaForCausalLM):
+    """MPT: ALiBi, fused straight-concat Wqkv, optional qkv clipping,
+    bias-free norms/linears under no_bias, non-gated gelu FFN
+    (reference: models/mpt.py)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        heads = hf.n_heads
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.d_model,
+            intermediate_size=int(
+                getattr(hf, "expansion_ratio", 4) * hf.d_model),
+            num_hidden_layers=hf.n_layers,
+            num_attention_heads=heads,
+            num_key_value_heads=int(_attn_get(hf, "kv_n_heads", heads)),
+            head_dim=hf.d_model // heads,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if not _attn_get(hf, "alibi", True):
+            raise ValueError(
+                "MPT checkpoints without ALiBi (learned-position "
+                "variants) are not supported")
+        if _attn_get(hf, "qk_ln", False):
+            raise ValueError("MPT qk_ln checkpoints are not supported")
+        arch.alibi = True
+        arch.pos_embedding = "none"
+        arch.norm_type = "layernorm"
+        no_bias = bool(getattr(hf, "no_bias", True))
+        arch.norm_bias = not no_bias
+        arch.mlp_gated = False
+        arch.mlp_bias = not no_bias
+        arch.attention_bias = not no_bias
+        arch.attention_out_bias = not no_bias
+        clip = _attn_get(hf, "clip_qkv", None)
+        arch.qkv_clip = float(clip) if clip else None
+        arch.hidden_act = "gelu"
+        arch.tie_word_embeddings = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        H = c.hidden_size
+        kv = c.num_kv_heads * c.head_dim
+        out = {}
+        for name, t in tensors.items():
+            name = name.replace("transformer.blocks.", "model.layers.")
+            name = name.replace("transformer.wte.", "model.embed_tokens.")
+            name = name.replace("transformer.norm_f.", "model.norm.")
+            name = name.replace(".norm_1.", ".input_layernorm.")
+            name = name.replace(".norm_2.", ".post_attention_layernorm.")
+            name = name.replace(".attn.out_proj.", ".self_attn.o_proj.")
+            name = name.replace(".ffn.up_proj.", ".mlp.fc1.")
+            name = name.replace(".ffn.down_proj.", ".mlp.fc2.")
+            out[name] = np.asarray(t)
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}.attn.Wqkv"
+            w = out.pop(base + ".weight")  # [H + 2kv, H] straight concat
+            A = f"model.layers.{i}.self_attn."
+            out[A + "q_proj.weight"] = w[:H]
+            out[A + "k_proj.weight"] = w[H:H + kv]
+            out[A + "v_proj.weight"] = w[H + kv:]
+            if base + ".bias" in out:
+                b = out.pop(base + ".bias")
+                out[A + "q_proj.bias"] = b[:H]
+                out[A + "k_proj.bias"] = b[H:H + kv]
+                out[A + "v_proj.bias"] = b[H + kv:]
+        return super().params_from_hf_state_dict(out)
 
 
 class MiniCPMForCausalLM(LlamaForCausalLM):

@@ -179,6 +179,12 @@ class LlamaArchConfig:
     pos_embedding: str = "rope"
     max_position_embeddings: int = 0
     pos_offset: int = 0
+    # ALiBi attention bias (Bloom/MPT; usually with pos_embedding =
+    # "none"): slope * (kv_pos - q_pos) added per head before masking.
+    alibi: bool = False
+    # LayerNorm directly after the embedding lookup (Bloom's
+    # word_embeddings_layernorm).
+    embed_ln: bool = False
     # Residual-branch multiplier (Granite residual_multiplier).
     residual_multiplier: float = 1.0
     # Final-logit multiplier (Cohere logit_scale; Granite
@@ -416,6 +422,10 @@ class LlamaForCausalLM:
         }
         if c.pos_embedding == "learned":
             specs["embed_pos"] = P(None, None)
+        if c.embed_ln:
+            specs["embed_ln_w"] = P(None)
+            if c.norm_bias:
+                specs["embed_ln_b"] = P(None)
         if self.LM_HEAD_BIAS:
             specs["lm_head_b"] = P(MODEL_AXIS)
         if c.norm_bias:
@@ -562,6 +572,10 @@ class LlamaForCausalLM:
         if c.pos_embedding == "learned":
             out["embed_pos"] = norm(next(keys),
                                     (c.max_position_embeddings, H))
+        if c.embed_ln:
+            out["embed_ln_w"] = jnp.ones((H, ), c.dtype)
+            if c.norm_bias:
+                out["embed_ln_b"] = jnp.zeros((H, ), c.dtype)
         if self.LM_HEAD_BIAS:
             out["lm_head_b"] = jnp.zeros((c.vocab_size, ), c.dtype)
         if c.norm_bias:
@@ -742,6 +756,12 @@ class LlamaForCausalLM:
             # Families rename their table to this canonical name.
             out["embed_pos"] = jnp.asarray(
                 t("model.embed_positions.weight"), dtype=c.dtype)
+        if c.embed_ln:
+            out["embed_ln_w"] = jnp.asarray(
+                t("model.embed_layernorm.weight"), dtype=c.dtype)
+            if c.norm_bias:
+                out["embed_ln_b"] = jnp.asarray(
+                    t("model.embed_layernorm.bias"), dtype=c.dtype)
         if self.LM_HEAD_BIAS:
             out["lm_head_b"] = jnp.asarray(
                 np.asarray(tensors.get(
@@ -830,6 +850,9 @@ class LlamaForCausalLM:
             idx = jnp.clip(positions + self.cfg.pos_offset, 0,
                            self.cfg.max_position_embeddings - 1)
             h = h + params["embed_pos"][idx]
+        if self.cfg.embed_ln:
+            h = self._norm(h, params["embed_ln_w"],
+                           params.get("embed_ln_b"))
         return h
 
     @staticmethod
@@ -923,6 +946,11 @@ class LlamaForCausalLM:
             cos_l, sin_l = cos, sin
 
         has_bias = c.attention_bias
+        if c.alibi:
+            from vllm_distributed_tpu.models.common import alibi_slopes
+            slopes = alibi_slopes(c.num_q_heads)
+        else:
+            slopes = None
 
         # The stacked caches thread through the layer scan as CARRIES and
         # every cache op indexes [layer, ...] internally: slicing the
@@ -1021,7 +1049,8 @@ class LlamaForCausalLM:
             attn = paged_attention(q, k_all, v_all, batch,
                                    sm_scale=sm_scale, layer=layer_idx,
                                    window=window,
-                                   logit_cap=c.attn_logit_softcap)
+                                   logit_cap=c.attn_logit_softcap,
+                                   alibi_slopes=slopes)
             attn2d = attn.reshape(T, -1)
             attn_out = (attn2d @ self._w(lp, "wo") +
                         self._lora_delta(lp, "wo", attn2d, lora_ctx))

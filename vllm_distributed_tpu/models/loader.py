@@ -172,13 +172,13 @@ def get_model(config: EngineConfig, mesh,
                 f"{', '.join(bad)} (no KV cache, no decode steps); "
                 f"drop those options")
     if ((arch.sliding_window or arch.window_pattern
-         or arch.attn_logit_softcap)
+         or arch.attn_logit_softcap or arch.alibi)
             and config.parallel_config.token_parallel_size > 1):
         raise ValueError(
-            "sliding-window attention / attention logit soft-capping "
-            "under token parallelism is not wired yet (the per-rank "
-            "attention path carries neither bound); disable one of the "
-            "two")
+            "sliding-window attention / attention logit soft-capping / "
+            "ALiBi under token parallelism is not wired yet (the "
+            "per-rank attention path carries none of these); serve "
+            "this model without token parallelism")
     if getattr(arch, "mla", False):
         # MLA family intersections not wired this round; reject with
         # clear errors instead of silently mis-serving.

@@ -31,11 +31,13 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
-from vllm_distributed_tpu.models.families_gpt import (ExaoneForCausalLM,
+from vllm_distributed_tpu.models.families_gpt import (BloomForCausalLM,
+                                                      ExaoneForCausalLM,
                                                       GPT2LMHeadModel,
                                                       GPTBigCodeForCausalLM,
                                                       GPTJForCausalLM,
                                                       MiniCPMForCausalLM,
+                                                      MPTForCausalLM,
                                                       OPTForCausalLM)
 from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
                                               BertForSequenceClassification,
@@ -104,6 +106,10 @@ _REGISTRY: dict[str, type] = {
     "OPTForCausalLM": OPTForCausalLM,
     "MiniCPMForCausalLM": MiniCPMForCausalLM,
     "ExaoneForCausalLM": ExaoneForCausalLM,
+    # ALiBi families (slope bias in ops/attention.py).
+    "BloomForCausalLM": BloomForCausalLM,
+    "MptForCausalLM": MPTForCausalLM,
+    "MPTForCausalLM": MPTForCausalLM,
     # Encoder-only embedding + cross-encoder families (models/bert.py;
     # reference: the _EMBEDDING_MODELS / _CROSS_ENCODER_MODELS maps of
     # model_executor/models/registry.py).

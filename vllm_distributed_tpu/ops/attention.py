@@ -93,7 +93,8 @@ def write_kv_pages(
     return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
 
 
-@partial(jax.jit, static_argnames=("sm_scale", "window", "logit_cap"))
+@partial(jax.jit, static_argnames=("sm_scale", "window", "logit_cap",
+                                   "alibi_slopes"))
 def ragged_paged_attention(
     q: jax.Array,  # [T, num_q_heads, head_dim]
     k_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
@@ -105,6 +106,7 @@ def ragged_paged_attention(
     sm_scale: float,
     window: int = 0,  # sliding window size; 0 = full causal
     logit_cap: float = 0.0,  # Gemma2 attn soft-capping; 0 = off
+    alibi_slopes: tuple = None,  # per-q-head ALiBi slopes; None = off
 ) -> jax.Array:  # [T, num_q_heads, head_dim]
     """Unified ragged attention: token t attends to kv positions
     0..q_pos[t] of request req_idx[t] (causal over the paged cache);
@@ -112,7 +114,10 @@ def ragged_paged_attention(
     (Mistral-style sliding window, reference: sliding_window plumbed
     through the attention backends); a positive ``logit_cap`` bounds
     scores with cap*tanh(s/cap) before masking (Gemma2 soft-capping,
-    reference: the softcap arg of the attention backends)."""
+    reference: the softcap arg of the attention backends);
+    ``alibi_slopes`` adds slope * (kv_pos - q_pos) per head before
+    masking (Bloom/MPT ALiBi, reference: the alibi_slopes arg of the
+    attention backends / csrc attention kernels)."""
     T, num_q_heads, head_dim = q.shape
     num_pages, num_kv_heads, page_size, _ = k_pages.shape
     assert num_q_heads % num_kv_heads == 0
@@ -135,6 +140,12 @@ def ragged_paged_attention(
         if logit_cap > 0:
             scores = logit_cap * jnp.tanh(scores / logit_cap)
         kv_pos = page_i * page_size + jnp.arange(page_size, dtype=jnp.int32)
+        if alibi_slopes is not None:
+            slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+                num_kv_heads, group)
+            dist = (kv_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+            scores = scores + (slopes[None, :, :, None] *
+                               dist[:, None, None, :])
         valid = kv_pos[None, :] <= q_pos[:, None]  # [T, ps] causal
         if window > 0:
             valid &= kv_pos[None, :] > (q_pos[:, None] - window)
@@ -285,6 +296,7 @@ def naive_ragged_attention(
     sm_scale: float,
     window: int = 0,
     logit_cap: float = 0.0,
+    alibi_slopes: tuple = None,
 ) -> jax.Array:
     """O(T * max_kv) dense-gather reference used only by unit tests."""
     T, num_q_heads, head_dim = q.shape
@@ -305,6 +317,11 @@ def naive_ragged_attention(
     if logit_cap > 0:
         scores = logit_cap * jnp.tanh(scores / logit_cap)
     kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            num_kv_heads, group)
+        dist = (kv_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+        scores = scores + slopes[None, :, :, None] * dist[:, None, None, :]
     valid = kv_pos[None, :] <= q_pos[:, None]
     if window > 0:
         valid &= kv_pos[None, :] > (q_pos[:, None] - window)
@@ -557,6 +574,7 @@ def paged_attention(
     layer: jax.Array | None = None,  # [1] int32
     window: int = 0,  # sliding window; 0 = full causal
     logit_cap: float = 0.0,  # attn logit soft-capping; 0 = off
+    alibi_slopes: tuple = None,  # Bloom/MPT ALiBi; None = off
 ) -> jax.Array:
     """Unified entry used by every model's attention layer; dispatches to
     the Pallas kernel or the XLA reference path per backend selection.
@@ -571,15 +589,16 @@ def paged_attention(
     if layer is None:
         layer = jnp.zeros((1, ), jnp.int32)
     if getattr(batch, "tknp", None) is not None:
-        if window or logit_cap:
+        if window or logit_cap or alibi_slopes:
             raise NotImplementedError(
-                "sliding window / logit softcap under token parallelism "
-                "(the per-rank attention path carries neither bound; "
-                "models/loader.py get_model rejects these combinations "
-                "at admission — this trace-time guard is the backstop)")
+                "sliding window / logit softcap / ALiBi under token "
+                "parallelism (the per-rank attention path carries none "
+                "of these; models/loader.py get_model rejects the "
+                "combinations at admission — this trace-time guard is "
+                "the backstop)")
         return _paged_attention_tknp(q, k_pages, v_pages, batch,
                                      sm_scale=sm_scale, layer=layer)
-    if (window == 0 and logit_cap == 0
+    if (window == 0 and logit_cap == 0 and alibi_slopes is None
             and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (
@@ -624,7 +643,7 @@ def paged_attention(
         v_layer = v_pages[layer[0]]
     else:
         k_layer, v_layer = k_pages, v_pages
-    if (window == 0 and logit_cap == 0
+    if (window == 0 and logit_cap == 0 and alibi_slopes is None
             and getattr(batch, "cascade_shared_ids", None) is not None):
         return cascade_ragged_paged_attention(
             q, k_layer, v_layer, batch.block_tables, batch.req_idx,
@@ -633,4 +652,5 @@ def paged_attention(
     return ragged_paged_attention(q, k_layer, v_layer, batch.block_tables,
                                   batch.req_idx, batch.positions,
                                   sm_scale=sm_scale, window=window,
-                                  logit_cap=logit_cap)
+                                  logit_cap=logit_cap,
+                                  alibi_slopes=alibi_slopes)

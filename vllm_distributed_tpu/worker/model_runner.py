@@ -968,6 +968,7 @@ class TPUModelRunner:
         if (not envs.VDT_CASCADE_ATTENTION or self.tknp_size > 1
                 or self.config.parallel_config.pipeline_parallel_size > 1
                 or getattr(self.model.cfg, "sliding_window", None)
+                or getattr(self.model.cfg, "alibi", False)
                 or not self._cascade_layout_ok):
             return None
         S = envs.VDT_CASCADE_SHARED_PAGES

@@ -71,11 +71,11 @@ class PPModelRunner(TPUModelRunner):
             "embed": jax.device_put(host_params["embed"],
                                     NamedSharding(sm0, specs["embed"])),
         }
-        if "embed_pos" in host_params:
-            # Learned-position families add their table at stage 0.
-            self.embed_params["embed_pos"] = jax.device_put(
-                host_params["embed_pos"],
-                NamedSharding(sm0, specs["embed_pos"]))
+        for extra in ("embed_pos", "embed_ln_w", "embed_ln_b"):
+            # Learned-position tables / embedding norms ride stage 0.
+            if extra in host_params:
+                self.embed_params[extra] = jax.device_put(
+                    host_params[extra], NamedSharding(sm0, specs[extra]))
         self._init_lora_manager()
         # The sampler's params (final norm + LM head) live with the last
         # stage; the base class passes self.params to the sample fns.
