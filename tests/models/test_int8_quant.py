@@ -63,6 +63,9 @@ PROMPT = [3, 17, 92, 45, 8, 21, 33]
     # in TPU HBM; the CPU test backend stores bytes, so only the dtype
     # is asserted here, not the footprint).
     ("int4", 0.9, "int4"),
+    # w8a8: int8 weights AND dynamic per-token int8 activations — the
+    # dots run int8 x int8 with int32 accumulation (MXU-native).
+    ("w8a8", 0.3, "int8"),
 ])
 def test_quant_logit_parity_and_memory(checkpoint, scheme, tol,
                                        dtype_name):
@@ -93,11 +96,14 @@ def test_quant_logit_parity_and_memory(checkpoint, scheme, tol,
     assert dtype_name in dtypes
 
 
-def test_int8_greedy_decode_stable_under_tp(checkpoint):
-    """int8 + TP=2: scale sharding must match the weight sharding; the
-    TP engine's output must equal the single-device int8 engine's."""
-    base = make_engine(checkpoint, quantization="int8")
-    tp2 = make_engine(checkpoint, quantization="int8",
+@pytest.mark.parametrize("scheme", ["int8", "w8a8"])
+def test_quant_greedy_decode_stable_under_tp(checkpoint, scheme):
+    """Quantized + TP=2 must equal the single-device engine. int8:
+    scale sharding must match the weight sharding. w8a8: the per-token
+    activation absmax must cover the FULL feature row (GSPMD reduces
+    across shards for the row-parallel dots)."""
+    base = make_engine(checkpoint, quantization=scheme)
+    tp2 = make_engine(checkpoint, quantization=scheme,
                       tensor_parallel_size=2)
     sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
 

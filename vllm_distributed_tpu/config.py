@@ -36,7 +36,8 @@ class ModelConfig:
     skip_tokenizer_init: bool = False
     trust_remote_code: bool = False
     dtype: str = "bfloat16"  # bfloat16 | float32 (TPU-native dtypes)
-    # Weight quantization: None (full precision), "int4", "int8", or "fp8"
+    # Quantization: None (full precision), weight-only "int4" / "int8" /
+    # "fp8", or "w8a8" (int8 weights + dynamic int8 activations)
     # (float8_e4m3fn) — w8a16 quantize-on-load with per-output-channel
     # scales (reference: quantization/tpu_int8.py + fp8 configs).
     quantization: Optional[str] = None
@@ -53,10 +54,11 @@ class ModelConfig:
             self.tokenizer = self.model
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
-        if self.quantization not in (None, "int4", "int8", "fp8"):
+        if self.quantization not in (None, "int4", "int8", "fp8",
+                                     "w8a8"):
             raise ValueError(
                 f"unsupported quantization {self.quantization!r} "
-                "(supported: int4, int8, fp8)")
+                "(supported: int4, int8, fp8, w8a8)")
 
     def maybe_load_hf_config(self) -> Any:
         """Load (and cache) the HF config for the model.

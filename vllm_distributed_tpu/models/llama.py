@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from vllm_distributed_tpu.models.common import (AttentionBatch,
                                                 compute_rope_cos_sin,
-                                                rms_norm, swiglu)
+                                                rms_norm)
 from vllm_distributed_tpu.ops.attention import (paged_attention,
                                                 write_kv_cache)
 
@@ -303,9 +303,18 @@ class LlamaForCausalLM:
           keeps the 4-bit HBM footprint after the load-time dequant;
           reference: the W4A16 serving path of quantization/gptq.py).
 
+        * "w8a8": int8 weights (same per-channel scaling) AND dynamic
+          per-token int8 activations — the dot runs int8 x int8 on the
+          MXU with an int32 accumulator, rescaled by the product of
+          scales (reference: the w8a8 schemes of
+          quantization/compressed_tensors + csrc int8 quant kernels).
+
         Matmuls dequantize at read (XLA fuses convert*scale into the
-        dot's operand load)."""
+        dot's operand load); w8a8 instead quantizes the activation at
+        the dot via _mm."""
         scheme = self.cfg.quantization
+        if scheme == "w8a8":
+            scheme = "int8"  # same weight payloads; _mm changes the dot
         if scheme not in ("int4", "int8", "fp8"):
             return params
         layers = params["layers"]
@@ -346,6 +355,28 @@ class LlamaForCausalLM:
             return (w.astype(self.cfg.dtype) *
                     lp[name + "_scale"].astype(self.cfg.dtype))
         return w
+
+    def _mm(self, lp: dict, name: str, x: jax.Array) -> jax.Array:
+        """Quantization-aware matmul ``x @ w``: under w8a8 the
+        activation is dynamically quantized per token (absmax/127) and
+        the dot runs int8 x int8 -> int32 on the MXU, rescaled by
+        act_scale * weight_scale; every other scheme dequantizes the
+        weight into a normal fp dot (reference: the per-token dynamic
+        activation quant of csrc/quantization/ int8 kernels)."""
+        w = lp[name]
+        if self.cfg.quantization == "w8a8" and w.dtype == jnp.int8:
+            amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                           keepdims=True)
+            xs = jnp.maximum(amax / 127.0, 1e-8)
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w, (((x.ndim - 1, ), (0, )), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = (acc.astype(jnp.float32) * xs *
+                   lp[name + "_scale"].astype(jnp.float32))
+            return out.astype(x.dtype)
+        return x @ self._w(lp, name)
 
     # ------------------------------------------------------------------
     # Parameter tree
@@ -468,7 +499,7 @@ class LlamaForCausalLM:
         for name in list(layer):
             if name.endswith("_scale"):
                 del layer[name]
-        if self.cfg.quantization not in ("int4", "int8", "fp8"):
+        if self.cfg.quantization not in ("int4", "int8", "fp8", "w8a8"):
             return
         for name in self.QUANT_TARGETS:
             spec = layer.get(name)
@@ -816,22 +847,22 @@ class LlamaForCausalLM:
         the only structural difference in the decoder block)."""
         c = self.cfg
         if not c.mlp_gated:
-            h = x @ self._w(lp, "fc1")
+            h = self._mm(lp, "fc1", x)
             if c.mlp_bias:
                 h = h + lp["fc1_b"]
-            h = self._act(h) @ self._w(lp, "fc2")
+            h = self._mm(lp, "fc2", self._act(h))
             if c.mlp_bias:
                 h = h + lp["fc2_b"]
             return h
         if lora_ctx is None or ("gate_a") not in lp:
-            return swiglu(x, self._w(lp, "gate"), self._w(lp, "up"),
-                          self._w(lp, "down"), act=self._act)
-        g = self._act(x @ self._w(lp, "gate") +
+            g = self._act(self._mm(lp, "gate", x))
+            return self._mm(lp, "down", g * self._mm(lp, "up", x))
+        g = self._act(self._mm(lp, "gate", x) +
                       self._lora_delta(lp, "gate", x, lora_ctx))
-        u = (x @ self._w(lp, "up") +
+        u = (self._mm(lp, "up", x) +
              self._lora_delta(lp, "up", x, lora_ctx))
         gu = g * u
-        return (gu @ self._w(lp, "down") +
+        return (self._mm(lp, "down", gu) +
                 self._lora_delta(lp, "down", gu, lora_ctx))
 
     def embed(self, params: dict, token_ids: jax.Array,
@@ -1014,11 +1045,11 @@ class LlamaForCausalLM:
                 x = self._norm(h, lp["input_ln"], lp.get("input_ln_b"))
             else:
                 x = h  # Olmo2 post-norm block: sub-layers see raw h
-            q = x @ self._w(lp, "wq") + self._lora_delta(lp, "wq", x,
+            q = self._mm(lp, "wq", x) + self._lora_delta(lp, "wq", x,
                                                          lora_ctx)
-            k = x @ self._w(lp, "wk") + self._lora_delta(lp, "wk", x,
+            k = self._mm(lp, "wk", x) + self._lora_delta(lp, "wk", x,
                                                          lora_ctx)
-            v = x @ self._w(lp, "wv") + self._lora_delta(lp, "wv", x,
+            v = self._mm(lp, "wv", x) + self._lora_delta(lp, "wv", x,
                                                          lora_ctx)
             if has_bias:
                 q = q + lp["bq"]
@@ -1052,7 +1083,7 @@ class LlamaForCausalLM:
                                    logit_cap=c.attn_logit_softcap,
                                    alibi_slopes=slopes)
             attn2d = attn.reshape(T, -1)
-            attn_out = (attn2d @ self._w(lp, "wo") +
+            attn_out = (self._mm(lp, "wo", attn2d) +
                         self._lora_delta(lp, "wo", attn2d, lora_ctx))
             if c.attention_out_bias:
                 attn_out = attn_out + lp["bo"]

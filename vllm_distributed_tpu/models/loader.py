@@ -90,6 +90,14 @@ def get_model(config: EngineConfig, mesh,
         config.parallel_config.enable_sequence_parallel
         and config.parallel_config.tensor_parallel_size > 1)
     arch.quantization = config.model_config.quantization
+    if arch.quantization == "w8a8" and getattr(arch, "num_experts", 0):
+        # The MoE expert dots (the dominant FLOPs) run through
+        # ragged_dot/shard_map paths that dequantize weights (w8a16);
+        # serving "w8a8" there would silently not apply where its
+        # benefit lies — refuse instead.
+        raise ValueError(
+            "w8a8 is not wired for MoE expert layers yet; use "
+            "--quantization int8 (weight-only) for MoE models")
     if arch.num_experts and config.parallel_config.num_redundant_experts:
         arch.num_physical_experts = (
             arch.num_experts +
