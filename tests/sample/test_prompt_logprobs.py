@@ -1,0 +1,109 @@
+"""Prompt logprobs: per-prompt-position log-softmax scored during
+prefill (reference: SamplingParams.prompt_logprobs +
+gpu_model_runner._get_prompt_logprobs_dict), exact under chunked
+prefill and prefix-caching bypass."""
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+PROMPT = [3, 17, 92, 45, 8, 21, 33, 60]
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_plp")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path), hf
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def run_one(engine, prompt, **sp_kw):
+    sp = SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True,
+                        **sp_kw)
+    engine.add_request("p", prompt, sp)
+    final = None
+    for _ in range(100):
+        for out in engine.step():
+            if out.request_id == "p":
+                final = out
+        if not engine.has_unfinished_requests():
+            return final
+    raise AssertionError("did not finish")
+
+
+def hf_prompt_logprobs(hf, prompt):
+    with torch.no_grad():
+        logits = hf(torch.tensor([prompt])).logits[0]  # [L, V]
+    lps = torch.log_softmax(logits.float(), dim=-1).numpy()
+    # Entry i (i >= 1) = logprob of prompt[i] from position i-1.
+    return [None] + [float(lps[i - 1, prompt[i]])
+                     for i in range(1, len(prompt))]
+
+
+def _check(out, hf, prompt, k=5):
+    got = out.prompt_logprobs
+    ref = hf_prompt_logprobs(hf, prompt)
+    assert got is not None and len(got) == len(prompt)
+    assert got[0] is None
+    for i in range(1, len(prompt)):
+        assert prompt[i] in got[i], f"entry {i} missing its own token"
+        np.testing.assert_allclose(got[i][prompt[i]], ref[i], atol=1e-3,
+                                   rtol=1e-3)
+        # top-k alternatives present and no worse than the actual token.
+        assert len(got[i]) >= min(k, 1)
+        assert max(got[i].values()) >= got[i][prompt[i]] - 1e-6
+
+
+def test_prompt_logprobs_match_hf(checkpoint):
+    path, hf = checkpoint
+    engine = make_engine(path)
+    out = run_one(engine, PROMPT, prompt_logprobs=5)
+    _check(out, hf, PROMPT)
+
+
+def test_prompt_logprobs_exact_under_chunked_prefill(checkpoint):
+    path, hf = checkpoint
+    # 4-token budget chunks the 8-token prompt across steps.
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=2,
+                        )
+    out = run_one(engine, PROMPT, prompt_logprobs=5)
+    _check(out, hf, PROMPT)
+
+
+def test_prompt_logprobs_bypass_prefix_cache(checkpoint):
+    """A cached prefix would skip the forward for those positions; the
+    scheduler must recompute so every entry is scored."""
+    path, hf = checkpoint
+    engine = make_engine(path, enable_prefix_caching=True)
+    # Warm the prefix cache with the same prompt (no plp).
+    run_one(engine, PROMPT)
+    out = run_one(engine, PROMPT, prompt_logprobs=5)
+    _check(out, hf, PROMPT)
+
+
+def test_prompt_logprobs_absent_when_not_requested(checkpoint):
+    path, _ = checkpoint
+    engine = make_engine(path)
+    out = run_one(engine, PROMPT)
+    assert out.prompt_logprobs is None

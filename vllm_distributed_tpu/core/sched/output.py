@@ -116,6 +116,11 @@ class ModelRunnerOutput:
     # Pooled hidden states for embedding requests that completed their
     # prompt this step: req_id -> list[float].
     pooled: Optional[dict[str, list[float]]] = None
+    # Prompt logprobs scored this step: req_id -> list of
+    # (prompt_position, {token_id: logprob}) chunk entries; the
+    # scheduler buffers them on the request until its first emitted
+    # output (reference: prompt_logprobs_dict of v1/outputs.py).
+    prompt_logprobs: Optional[dict[str, list]] = None
 
 
 EMPTY_MODEL_RUNNER_OUTPUT = ModelRunnerOutput()

@@ -37,7 +37,7 @@ class EngineCoreOutput:
 
     __slots__ = ("req_id", "new_token_ids", "finish_reason", "stop_reason",
                  "num_cached_tokens", "logprobs", "kv_transfer_params",
-                 "pooled")
+                 "pooled", "prompt_logprobs")
 
     def __init__(self, req_id: str, new_token_ids: list[int],
                  finish_reason: Optional[str] = None,
@@ -45,7 +45,8 @@ class EngineCoreOutput:
                  num_cached_tokens: int = 0,
                  logprobs: Optional[list[dict[int, float]]] = None,
                  kv_transfer_params: Optional[dict] = None,
-                 pooled: Optional[list[float]] = None) -> None:
+                 pooled: Optional[list[float]] = None,
+                 prompt_logprobs: Optional[list] = None) -> None:
         self.req_id = req_id
         self.new_token_ids = new_token_ids
         self.finish_reason = finish_reason
@@ -58,6 +59,10 @@ class EngineCoreOutput:
         # Embedding result for pooling requests (reference: pooling
         # outputs on the core output path, v1/outputs.py).
         self.pooled = pooled
+        # Full prompt-logprob list (entry 0 = None), attached to the
+        # request's FIRST emitted output once the prompt completes
+        # (reference: prompt_logprobs on the engine-core output path).
+        self.prompt_logprobs = prompt_logprobs
 
     @property
     def finished(self) -> bool:
@@ -489,8 +494,13 @@ class Scheduler:
 
                 num_computed_tokens = request.num_computed_tokens
                 new_computed_blocks: Optional[KVCacheBlocks] = None
-                if num_computed_tokens == 0:
-                    # Fresh request: prefix-cache lookup.
+                if (num_computed_tokens == 0
+                        and request.sampling_params.prompt_logprobs
+                        is None):
+                    # Fresh request: prefix-cache lookup. Skipped for
+                    # prompt_logprobs requests — cached positions never
+                    # run a forward, so their entries could not be
+                    # scored (the reference likewise recomputes).
                     new_computed_blocks, num_computed_tokens = \
                         self.kv_cache_manager.get_computed_blocks(request)
                     if request.num_cached_tokens < 0:
@@ -767,6 +777,7 @@ class Scheduler:
                                      self._deferred_finishes.pop(req_id))
 
         pooled_map = runner_output.pooled or {}
+        plp_map = runner_output.prompt_logprobs or {}
         outputs: list[EngineCoreOutput] = []
         finished: list[Request] = []
         for request in self.running:
@@ -774,6 +785,14 @@ class Scheduler:
             if req_id not in num_scheduled:
                 continue
             scheduled = num_scheduled[req_id]
+            if not request.prompt_lp_delivered:
+                # Buffered until the first emitted output (mid-prompt
+                # chunks produce no EngineCoreOutput); dict-keyed so a
+                # preemption re-run overwrites, not duplicates. Entries
+                # scored by a preempt-resume AFTER delivery are dropped
+                # (the runner also stops scoring completed prompts).
+                for entry, d in plp_map.get(req_id, ()):
+                    request.prompt_lp_entries[entry] = d
             if req_id in pooled_map:
                 # Embedding request: the prompt finished this step; the
                 # pooled hidden state IS the result (no sampling).
@@ -827,6 +846,16 @@ class Scheduler:
             logprobs = logprobs_by_req.get(req_id)
             if logprobs is not None:
                 logprobs = logprobs[:len(new_token_ids)]
+            prompt_lps = None
+            if (request.sampling_params.prompt_logprobs is not None
+                    and not request.prompt_lp_delivered):
+                n_prompt = len(request.prompt_token_ids)
+                prompt_lps = [None] + [
+                    request.prompt_lp_entries.get(i)
+                    for i in range(1, n_prompt)
+                ]
+                request.prompt_lp_delivered = True
+                request.prompt_lp_entries.clear()
             outputs.append(
                 EngineCoreOutput(
                     req_id=req_id,
@@ -835,6 +864,7 @@ class Scheduler:
                     stop_reason=stop_reason,
                     num_cached_tokens=max(request.num_cached_tokens, 0),
                     logprobs=logprobs,
+                    prompt_logprobs=prompt_lps,
                 ))
 
         for request in finished:

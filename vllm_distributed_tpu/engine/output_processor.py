@@ -33,6 +33,9 @@ class RequestState:
     finish_reason: Optional[str] = None
     stop_reason: Optional[int | str] = None
     kv_transfer_params: Optional[dict] = None
+    # Per-prompt-token logprob dicts (entry 0 None), delivered once by
+    # the core after the prompt completes.
+    prompt_logprobs: Optional[list] = None
     times: Optional["RequestTimes"] = None
 
 
@@ -135,6 +138,12 @@ class OutputProcessor:
             state.stop_reason = stop_reason
             if out.kv_transfer_params is not None:
                 state.kv_transfer_params = out.kv_transfer_params
+            if out.prompt_logprobs is not None:
+                state.prompt_logprobs = [
+                    ({int(k): float(v) for k, v in d.items()}
+                     if d is not None else None)
+                    for d in out.prompt_logprobs
+                ]
             if finished:
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
@@ -191,4 +200,5 @@ class OutputProcessor:
             finished=state.finished,
             num_cached_tokens=state.num_cached_tokens,
             kv_transfer_params=state.kv_transfer_params,
+            prompt_logprobs=state.prompt_logprobs,
         )

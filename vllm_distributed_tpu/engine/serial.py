@@ -75,12 +75,12 @@ def decode_request(d: dict) -> EngineCoreRequest:
 def encode_output(out: EngineCoreOutput) -> list:
     return [out.req_id, out.new_token_ids, out.finish_reason,
             out.stop_reason, out.num_cached_tokens, out.logprobs,
-            out.kv_transfer_params, out.pooled]
+            out.kv_transfer_params, out.pooled, out.prompt_logprobs]
 
 
 def decode_output(v: list) -> EngineCoreOutput:
     (req_id, new_token_ids, finish_reason, stop_reason, cached, lps,
-     kv_params, pooled) = v
+     kv_params, pooled, prompt_lps) = v
     return EngineCoreOutput(
         req_id=req_id,
         new_token_ids=list(new_token_ids),
@@ -90,4 +90,5 @@ def decode_output(v: list) -> EngineCoreOutput:
         logprobs=lps,
         kv_transfer_params=kv_params,
         pooled=pooled,
+        prompt_logprobs=prompt_lps,
     )

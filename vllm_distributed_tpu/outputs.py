@@ -35,6 +35,11 @@ class RequestOutput:
     # coordinates the decode-side request needs (reference: vllm/outputs.py
     # RequestOutput.kv_transfer_params).
     kv_transfer_params: Optional[dict] = None
+    # Per-prompt-token logprob dicts when SamplingParams.prompt_logprobs
+    # was set: entry 0 is None, entry i maps token_id -> logprob of
+    # prompt[i] given the prefix (reference: vllm/outputs.py
+    # RequestOutput.prompt_logprobs).
+    prompt_logprobs: Optional[list] = None
 
     @property
     def text(self) -> str:

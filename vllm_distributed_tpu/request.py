@@ -120,6 +120,13 @@ class Request:
         self.output_token_ids: list[int] = []
         self.spec_token_ids: list[int] = []
 
+        # Prompt-logprob entries scored so far (entry index ->
+        # {token: lp}); assembled into the first emitted output once
+        # the prompt completes. Dict-keyed so a preemption re-run
+        # overwrites rather than duplicates.
+        self.prompt_lp_entries: dict[int, dict] = {}
+        self.prompt_lp_delivered = False
+
         # Tokens whose KV is present on device. Grows by num_scheduled
         # each step (speculative: adjusted down on rejection).
         self.num_computed_tokens = 0

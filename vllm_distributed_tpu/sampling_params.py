@@ -99,12 +99,9 @@ class SamplingParams:
         self._all_stop_token_ids = set(self.stop_token_ids)
         if self.logprobs is not None and not 0 <= self.logprobs <= 20:
             raise ValueError("logprobs must be in [0, 20]")
-        if self.prompt_logprobs is not None:
-            # Honest rejection beats a silent no-op: prompt logprobs
-            # need vocab-wide log-softmax at every prefill position,
-            # which the bucketed prefill graph doesn't compute yet.
-            raise ValueError(
-                "prompt_logprobs is not supported yet")
+        if (self.prompt_logprobs is not None
+                and not 0 <= self.prompt_logprobs <= 20):
+            raise ValueError("prompt_logprobs must be in [0, 20]")
         if self.logit_bias is not None:
             self.logit_bias = {int(k): float(v)
                                for k, v in self.logit_bias.items()}

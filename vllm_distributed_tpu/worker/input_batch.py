@@ -45,6 +45,9 @@ class InputBatch:
         self.repetition_penalty = np.ones((R, ), np.float32)
         self.min_tokens = np.zeros((R, ), np.int32)
         self.num_logprobs = np.zeros((R, ), np.int32)  # 0 = sampled only
+        # prompt_logprobs top-k per row; -1 = not requested (reference:
+        # SamplingParams.prompt_logprobs).
+        self.prompt_logprobs = np.full((R, ), -1, np.int32)
         self.prompt_len = np.zeros((R, ), np.int32)
         # Lifetime (static) extended-graph need; min-tokens activity is
         # checked dynamically via extended_active().
@@ -108,6 +111,8 @@ class InputBatch:
         self.repetition_penalty[row] = sp.repetition_penalty
         self.min_tokens[row] = sp.min_tokens
         self.num_logprobs[row] = sp.logprobs or 0
+        self.prompt_logprobs[row] = (-1 if sp.prompt_logprobs is None
+                                     else sp.prompt_logprobs)
         self.prompt_len[row] = n
         self.needs_extended[row] = sp.needs_extended_static
         self.lora_slot[row] = 0  # runner sets after adapter resolution
@@ -172,6 +177,7 @@ class InputBatch:
         self.lora_slot[row] = 0
         self.pooling[row] = None
         self.num_logprobs[row] = 0
+        self.prompt_logprobs[row] = -1
         self.min_tokens[row] = 0
         self.presence_penalty[row] = 0.0
         self.frequency_penalty[row] = 0.0

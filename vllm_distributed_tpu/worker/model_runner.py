@@ -326,8 +326,24 @@ class TPUModelRunner:
             return sample_tokens_extended(logits, sampling_md, ext,
                                           want_topk, vocab_mask)
 
+        def prompt_lp(params, sel, targets):
+            """Score prompt positions: log-softmax over the LM head at
+            the pre-gathered rows [P, H], returning the target (= actual
+            next prompt token) logprob plus the top-k alternatives
+            (reference: the prompt_logprobs gather of
+            gpu_model_runner._get_prompt_logprobs_dict). The row gather
+            runs op-by-op outside so the graph keys only on the P
+            bucket — ADDITIVE with the forward lattice, like the
+            forward/sample split."""
+            logits = model.compute_logits(params, sel)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            tgt = jnp.take_along_axis(lp, targets[:, None], axis=1)[:, 0]
+            topv, topi = jax.lax.top_k(lp, MAX_LOGPROBS)
+            return tgt, topv, topi
+
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
+        self._plp_fn = jax.jit(prompt_lp)
         self._sample_fn = jax.jit(sample)
         self._sample_ext_fn = jax.jit(sample_ext,
                                       static_argnames=("want_topk", ))
@@ -452,6 +468,13 @@ class TPUModelRunner:
         sampling_req_ids: list[str] = []
         logits_idx: list[int] = []
         spec_drafts: list[list[int]] = []
+        # Prompt-logprob rows: flat row index + next-prompt-token target
+        # per scored position (reference: the prompt_logprobs path of
+        # gpu_model_runner._get_prompt_logprobs_dict).
+        plp_rows: list[int] = []
+        plp_targets: list[int] = []
+        # (req_id, entry_index, k, target_token) per scored position.
+        plp_meta: list[tuple[str, int, int, int]] = []
 
         t = 0
         num_runs = 0
@@ -474,6 +497,22 @@ class TPUModelRunner:
                 ib.block_table[row, pos // ps] * ps + pos % ps)
             seq_info[num_runs] = (t, n, end, row)
             num_runs += 1
+            k_plp = int(ib.prompt_logprobs[row])
+            if k_plp >= 0 and start < int(ib.prompt_len[row]):
+                # Row at position p predicts prompt token p+1; the row
+                # at prompt_len-1 predicts the first OUTPUT token and is
+                # the sampling row, not a prompt entry.
+                for p in range(start,
+                               min(end, int(ib.prompt_len[row]) - 1)):
+                    tgt = int(ib.token_ids[row, p + 1])
+                    plp_rows.append(t + (p - start))
+                    plp_targets.append(tgt)
+                    plp_meta.append((req_id, p + 1, k_plp, tgt))
+                if end >= int(ib.prompt_len[row]):
+                    # Final chunk scored: stop re-scoring on a
+                    # preempt-resume re-run of an already-delivered
+                    # prompt (the row persists across preemption).
+                    ib.prompt_logprobs[row] = -1
             if K > 1:
                 owner = int(ib.block_table[row, 0]) // Nl
                 tk_slot[owner, t:t + n] = \
@@ -674,10 +713,18 @@ class TPUModelRunner:
             mm_mask=mm_mask,
             max_q=max_q,
         )
+        plp = None
+        if plp_rows:
+            Pb = pad_to_bucket(len(plp_rows), self.token_buckets)
+            rows_np = np.zeros((Pb, ), np.int32)
+            tgt_np = np.zeros((Pb, ), np.int32)
+            rows_np[:len(plp_rows)] = plp_rows
+            tgt_np[:len(plp_targets)] = plp_targets
+            plp = (jnp.asarray(rows_np), jnp.asarray(tgt_np), plp_meta)
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
                 sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md,
-                want_topk, vocab_mask)
+                want_topk, vocab_mask, plp)
 
     # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
     # validation in SamplingParams guarantees every request fits.
@@ -814,8 +861,8 @@ class TPUModelRunner:
             return {"ready": self._execute_multi_step(scheduler_output)}
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R, drafts_arr, ext_md, want_topk, vocab_mask) = \
-            self._prepare_inputs(scheduler_output)
+         fwd_shape, R, drafts_arr, ext_md, want_topk, vocab_mask,
+         plp) = self._prepare_inputs(scheduler_output)
 
         kv_meta = scheduler_output.kv_connector_metadata
         if self.kv_connector is not None and kv_meta is not None:
@@ -825,10 +872,11 @@ class TPUModelRunner:
 
         dev = self._launch_device_step(token_ids, batch, logits_indices,
                                        sampling_md, fwd_shape, ext_md,
-                                       want_topk, vocab_mask)
+                                       want_topk, vocab_mask, plp=plp)
         return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
                 "sampling_req_ids": sampling_req_ids,
-                "drafts_arr": drafts_arr, "R": R}
+                "drafts_arr": drafts_arr, "R": R,
+                "plp_meta": plp[2] if plp else None}
 
     def wait_model(self, handle: dict) -> ModelRunnerOutput:
         """Blocking half: fetch the sampled tokens, fold them into the
@@ -949,9 +997,28 @@ class TPUModelRunner:
                                 sampled_token_ids=sampled,
                                 logprobs=lps,
                                 spec_token_ids=spec_out,
-                                pooled=pooled or None)
+                                pooled=pooled or None,
+                                prompt_logprobs=self._fetch_plp(handle))
         self._poll_kv_connector(scheduler_output, out)
         return out
+
+    @staticmethod
+    def _fetch_plp(handle) -> Optional[dict[str, list]]:
+        """Assemble this step's prompt-logprob chunk: per scored prompt
+        position, {actual_token: lp} plus the request's top-k."""
+        meta = handle.get("plp_meta")
+        if not meta:
+            return None
+        tgt, topv, topi = (np.asarray(jax.device_get(x))
+                           for x in handle["dev"][4])
+        chunks: dict[str, list] = {}
+        for i, (req_id, entry, k, target) in enumerate(meta):
+            d = {int(topi[i, j]): float(topv[i, j])
+                 for j in range(min(k, MAX_LOGPROBS))}
+            # The actual prompt token's logprob is always present.
+            d[int(target)] = float(tgt[i])
+            chunks.setdefault(req_id, []).append((entry, d))
+        return chunks
 
     def _detect_cascade(self, scheduler_output: SchedulerOutput):
         """Batch-wide shared-prefix detection for cascade attention
@@ -1009,7 +1076,7 @@ class TPUModelRunner:
 
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
-                            vocab_mask=None):
+                            vocab_mask=None, plp=None):
         """Enqueue one step's device work WITHOUT blocking: JAX dispatch
         is asynchronous, so the host returns as soon as the programs are
         queued. The pipeline-parallel engine core exploits this to keep
@@ -1023,15 +1090,21 @@ class TPUModelRunner:
                     self.params, self.kv_caches, token_ids, batch)
             return self._launch_sample(hidden, logits_indices, sampling_md,
                                        ext_md, want_topk, self.mesh,
-                                       vocab_mask)
+                                       vocab_mask, plp=plp)
 
     def _launch_sample(self, hidden, logits_indices, sampling_md, ext_md,
-                       want_topk, mesh, vocab_mask=None):
+                       want_topk, mesh, vocab_mask=None, plp=None):
         """Row gather + (extended) sampling on ``mesh``, dispatch only;
         shared by the single-program and pipeline-parallel step paths.
         Returns device arrays (tokens, logprobs, (topv, topi) | None)."""
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
         topk_dev = None
+        plp_dev = None
+        if plp is not None:
+            rows, targets, _meta = plp
+            sel = self._gather_sample_rows(hidden, rows, mesh=mesh)
+            with self._compile_watch(("plp", rows.shape[0])):
+                plp_dev = self._plp_fn(self.params, sel, targets)
         hidden_sel = self._gather_sample_rows(hidden, logits_indices,
                                               mesh=mesh)
         if ext_md is not None:
@@ -1047,12 +1120,12 @@ class TPUModelRunner:
                 tokens, logprobs = self._sample_fn(
                     self.params, hidden_sel, sampling_md)
         # hidden_sel rides along for pooling requests (fetched lazily).
-        return tokens, logprobs, topk_dev, hidden_sel
+        return tokens, logprobs, topk_dev, hidden_sel, plp_dev
 
     @staticmethod
     def _fetch_sample(dev):
         """Blocking half: device arrays -> host numpy."""
-        tokens, logprobs, topk_dev, _hidden_sel = dev
+        tokens, logprobs, topk_dev, _hidden_sel, _plp_dev = dev
         topk_np = None
         if topk_dev is not None:
             topk_np = (np.asarray(jax.device_get(topk_dev[0])),
@@ -1296,6 +1369,7 @@ class TPUModelRunner:
                     jax.block_until_ready(hidden)
                     n += 1
             n += self._precompile_samplers(self.mesh)
+            n += self._precompile_plp(self.mesh)
             n_steps = self.config.scheduler_config.num_scheduler_steps
             if n_steps > 1:
                 for R in self.req_buckets:
@@ -1307,6 +1381,23 @@ class TPUModelRunner:
         self._precompiled = True
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
+
+    def _precompile_plp(self, mesh) -> int:
+        """Warm the prompt-logprob graphs — one per P bucket (the row
+        gather runs outside the jit, so the lattice is additive with
+        the forward shapes)."""
+        n = 0
+        for P_ in self.token_buckets:
+            sel = self._gather_sample_rows(
+                jnp.zeros((P_, self.model.cfg.hidden_size),
+                          self.model.cfg.dtype),
+                jnp.arange(P_, dtype=jnp.int32), mesh=mesh)
+            with self._compile_watch(("plp", P_)):
+                tgt, _, _ = self._plp_fn(
+                    self.params, sel, jnp.zeros((P_, ), jnp.int32))
+            jax.block_until_ready(tgt)
+            n += 1
+        return n
 
     def _precompile_samplers(self, mesh) -> int:
         """Warm the plain + extended sampler graphs for every row bucket

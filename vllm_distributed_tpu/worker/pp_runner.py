@@ -156,7 +156,7 @@ class PPModelRunner(TPUModelRunner):
 
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
-                            vocab_mask=None):
+                            vocab_mask=None, plp=None):
         sm0 = self.stage_meshes[0]
         with global_mesh(sm0), sm0:
             with self._compile_watch(("embed", fwd_shape[0])):
@@ -183,7 +183,7 @@ class PPModelRunner(TPUModelRunner):
         with global_mesh(sml), sml:
             return self._launch_sample(hidden, logits_indices,
                                        sampling_md, ext_md, want_topk,
-                                       sml, vocab_mask)
+                                       sml, vocab_mask, plp=plp)
 
     # ------------------------------------------------------------------
     def precompile(self) -> None:
@@ -213,6 +213,7 @@ class PPModelRunner(TPUModelRunner):
         sml = self.stage_meshes[-1]
         with global_mesh(sml), sml:
             self._precompile_samplers(sml)
+            self._precompile_plp(sml)
         self._precompiled = True
         logger.info("PP precompile done in %.1fs",
                     time.perf_counter() - start)
