@@ -83,3 +83,34 @@ def test_score_ranks_identical_higher(checkpoint):
     scores = llm.score([q, q], [same, other])
     assert scores[0] > scores[1]
     assert abs(scores[0] - 1.0) < 1e-5  # identical prompts -> cosine 1
+
+
+def test_generate_parallel_sampling_n(checkpoint):
+    """n > 1 fans out child requests and merges n CompletionOutputs
+    (reference: v1 parallel sampling via ParentRequest)."""
+    path, hf = checkpoint
+    llm = LLM(model=path, dtype="float32", block_size=4,
+              num_gpu_blocks_override=64, max_model_len=64,
+              max_num_batched_tokens=64, max_num_seqs=8)
+    prompt = [3, 17, 92]
+    outs = llm.generate([prompt],
+                        SamplingParams(temperature=0.0, n=3, max_tokens=4,
+                                       ignore_eos=True))
+    assert len(outs) == 1
+    comps = outs[0].outputs
+    assert [c.index for c in comps] == [0, 1, 2]
+    # Greedy: all three children agree and match HF.
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([prompt]), max_new_tokens=4,
+                          do_sample=False,
+                          eos_token_id=None)[0].tolist()[len(prompt):]
+    for c in comps:
+        assert c.token_ids == ref
+
+    # Seeded sampling: children get distinct seeds (and so can differ).
+    outs = llm.generate([prompt],
+                        SamplingParams(temperature=5.0, n=3, seed=7,
+                                       max_tokens=4, ignore_eos=True))
+    texts = [tuple(c.token_ids) for c in outs[0].outputs]
+    assert len(texts) == 3
+    assert len(set(texts)) > 1, "children must not share one seed"

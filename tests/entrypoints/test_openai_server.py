@@ -324,3 +324,40 @@ def test_tool_calls_forced_function(server):
     assert call["function"]["name"] == "set_flag"
     args = json.loads(call["function"]["arguments"])
     assert isinstance(args.get("a"), bool)
+
+
+def test_completion_echo_with_logprobs(server):
+    """echo=true returns prompt + completion text and leads the
+    logprobs arrays with the scored prompt positions (first None)."""
+    base, hf = server
+    prompt = "w3 w17 w92 w45 w8"
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": prompt, "max_tokens": 3,
+        "temperature": 0.0, "ignore_eos": True, "echo": True,
+        "logprobs": 3,
+    })
+    assert r.status_code == 200, r.text
+    choice = r.json()["choices"][0]
+    assert choice["text"].startswith(prompt)
+    lp = choice["logprobs"]
+    # 5 prompt tokens + 3 completion tokens; first prompt entry None.
+    assert len(lp["tokens"]) == 8
+    assert lp["token_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    import torch as _torch
+    ids = [3, 17, 92, 45, 8]
+    with _torch.no_grad():
+        ref = _torch.log_softmax(
+            hf(_torch.tensor([ids])).logits[0].float(), -1).numpy()
+    for i in range(1, 5):
+        assert abs(lp["token_logprobs"][i] - float(ref[i - 1, ids[i]])) \
+            < 1e-3
+
+
+def test_completion_echo_stream_rejected(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "model": "tiny", "prompt": "w1 w2", "max_tokens": 2,
+        "stream": True, "echo": True,
+    })
+    assert r.status_code == 400

@@ -85,8 +85,7 @@ def test_prompt_logprobs_match_hf(checkpoint):
 def test_prompt_logprobs_exact_under_chunked_prefill(checkpoint):
     path, hf = checkpoint
     # 4-token budget chunks the 8-token prompt across steps.
-    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=2,
-                        )
+    engine = make_engine(path, max_num_batched_tokens=4, max_num_seqs=2)
     out = run_one(engine, PROMPT, prompt_logprobs=5)
     _check(out, hf, PROMPT)
 

@@ -513,7 +513,12 @@ class Scheduler:
                 # connector before the forward pass.
                 num_external = 0
                 load_async = False
-                if self.kv_connector is not None:
+                if (self.kv_connector is not None
+                        and request.sampling_params.prompt_logprobs
+                        is None):
+                    # Externally-loaded positions never run a forward,
+                    # so prompt_logprobs requests recompute locally
+                    # (same reason as the prefix-cache bypass above).
                     num_external, load_async = \
                         self.kv_connector.get_num_new_matched_tokens(
                             request, num_computed_tokens)

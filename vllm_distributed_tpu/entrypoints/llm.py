@@ -50,17 +50,43 @@ class LLM:
             multi_modal_data = [multi_modal_data] * len(prompts)
         assert len(multi_modal_data) == len(prompts)
 
-        request_ids = []
+        # Parallel sampling: n > 1 fans out into n engine requests per
+        # prompt, merged into one RequestOutput with n CompletionOutputs
+        # (reference: the ParentRequest fan-out of v1/engine/
+        # parallel_sampling.py). Seeded requests vary the seed per child
+        # so samples differ.
+        import copy
+        groups: list[list[str]] = []
         for prompt, sp, mm in zip(prompts, sampling_params,
                                   multi_modal_data):
-            request_id = str(next(self.request_counter))
-            self.llm_engine.add_request(request_id, prompt, sp,
-                                        multi_modal_data=mm)
-            request_ids.append(request_id)
+            ids = []
+            for s in range(sp.n):
+                child = sp
+                if sp.n > 1:
+                    child = copy.copy(sp)
+                    child.n = 1
+                    if sp.seed is not None:
+                        child.seed = sp.seed + s
+                request_id = str(next(self.request_counter))
+                self.llm_engine.add_request(request_id, prompt, child,
+                                            multi_modal_data=mm)
+                ids.append(request_id)
+            groups.append(ids)
         outputs = self._run_engine()
-        # Return in submission order.
         by_id = {out.request_id: out for out in outputs}
-        return [by_id[rid] for rid in request_ids]
+        merged: list[RequestOutput] = []
+        for ids in groups:
+            outs = [by_id[rid] for rid in ids]
+            first = outs[0]
+            if len(outs) > 1:
+                completions = []
+                for i, o in enumerate(outs):
+                    comp = o.outputs[0]
+                    comp.index = i
+                    completions.append(comp)
+                first.outputs = completions
+            merged.append(first)
+        return merged
 
     def encode(self, prompts, pooling_type: str = None,
                _extra_pooling: list = None) -> list:

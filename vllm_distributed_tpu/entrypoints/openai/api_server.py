@@ -285,18 +285,42 @@ async def completions(request: web.Request) -> web.StreamResponse:
         max_len = engine.config.scheduler_config.max_model_len
         params = protocol.sampling_params_from_request(body, max_len)
         stream = bool(body.get("stream", False))
+        echo_texts = None
+        if body.get("echo"):
+            if stream:
+                raise RequestError(
+                    "echo with stream is not supported")
+            if params.logprobs is not None:
+                # Echoed logprobs need the prompt positions scored
+                # (reference: the echo path of serving_completion.py).
+                params.prompt_logprobs = params.logprobs
+            # Token-id prompts echo their detokenized text so the text
+            # stays aligned with the echoed logprobs arrays.
+            tokenizer = engine.tokenizer
+            echo_texts = [
+                p if isinstance(p, str) else
+                (tokenizer.decode(p) if tokenizer is not None else
+                 " ".join(str(t) for t in p))
+                for p in prompts
+            ]
         cid = protocol.completion_id()
         created = int(time.time())
 
         # Fan out: one engine request per (prompt, sample) pair; choice
-        # index follows OpenAI semantics (prompt-major, then n).
+        # index follows OpenAI semantics (prompt-major, then n). Seeded
+        # requests offset the seed per child so samples differ.
         lora = _resolve_lora(request.app, body)
         gens = []
         for pi, prompt in enumerate(prompts):
             for s in range(n):
                 idx = pi * n + s
+                child = params
+                if n > 1 and params.seed is not None:
+                    import copy as _copy
+                    child = _copy.copy(params)
+                    child.seed = params.seed + s
                 gens.append((idx, engine.generate(
-                    prompt, params, request_id=f"{cid}-{idx}",
+                    prompt, child, request_id=f"{cid}-{idx}",
                     lora_request=lora)))
 
         if stream:
@@ -313,7 +337,10 @@ async def completions(request: web.Request) -> web.StreamResponse:
             prompt_tokens += len(final.prompt_token_ids) if idx % n == 0 \
                 else 0
             completion_tokens += len(final.outputs[0].token_ids)
-            choices[idx] = _completion_choice(idx, final, body)
+            choices[idx] = _completion_choice(
+                idx, final, body,
+                echo_text=(echo_texts[idx // n]
+                           if echo_texts is not None else None))
         return web.json_response({
             "id": cid,
             "object": "text_completion",
@@ -333,24 +360,42 @@ async def _drain(gen):
     return final
 
 
-def _completion_choice(idx: int, out, body: dict) -> dict:
+def _completion_choice(idx: int, out, body: dict,
+                       echo_text: str = None) -> dict:
     comp = out.outputs[0]
+    echo = bool(body.get("echo"))
+    prefix = (echo_text if echo_text is not None else
+              (out.prompt or "")) if echo else ""
     choice = {
         "index": idx,
-        "text": comp.text,
+        "text": prefix + comp.text,
         "finish_reason": comp.finish_reason,
     }
     if body.get("logprobs") is not None and comp.logprobs:
+        token_ids = list(comp.token_ids)
+        token_lps = [lp.get(tok) if lp else None
+                     for tok, lp in zip(comp.token_ids, comp.logprobs)]
+        top = [{str(k): v for k, v in lp.items()} for lp in comp.logprobs]
+        if echo and out.prompt_logprobs is not None:
+            # Prompt positions lead (first entry None, OpenAI echo
+            # semantics); ids follow the same str() convention as the
+            # completion tokens.
+            p_ids = list(out.prompt_token_ids)
+            p_lps = [None] + [
+                (d.get(t) if d else None)
+                for t, d in zip(p_ids[1:], out.prompt_logprobs[1:])
+            ]
+            p_top = [({str(k): v for k, v in d.items()} if d else None)
+                     for d in out.prompt_logprobs]
+            token_ids = p_ids + token_ids
+            token_lps = p_lps + token_lps
+            top = p_top + top
         choice["logprobs"] = {
             # The sampled token's own logprob (keyed lookup — the map may
             # also carry top-k alternatives with higher probability).
-            "token_logprobs": [
-                lp.get(tok) if lp else None
-                for tok, lp in zip(comp.token_ids, comp.logprobs)
-            ],
-            "tokens": [str(t) for t in comp.token_ids],
-            "top_logprobs": [{str(k): v for k, v in lp.items()}
-                             for lp in comp.logprobs],
+            "token_logprobs": token_lps,
+            "tokens": [str(t) for t in token_ids],
+            "top_logprobs": top,
         }
     return choice
 

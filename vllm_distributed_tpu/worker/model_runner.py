@@ -338,7 +338,8 @@ class TPUModelRunner:
             logits = model.compute_logits(params, sel)
             lp = jax.nn.log_softmax(logits, axis=-1)
             tgt = jnp.take_along_axis(lp, targets[:, None], axis=1)[:, 0]
-            topv, topi = jax.lax.top_k(lp, MAX_LOGPROBS)
+            topv, topi = jax.lax.top_k(
+                lp, min(MAX_LOGPROBS, lp.shape[-1]))
             return tgt, topv, topi
 
         # Donate the caches: XLA aliases them in place of a copy.
@@ -1014,7 +1015,7 @@ class TPUModelRunner:
         chunks: dict[str, list] = {}
         for i, (req_id, entry, k, target) in enumerate(meta):
             d = {int(topi[i, j]): float(topv[i, j])
-                 for j in range(min(k, MAX_LOGPROBS))}
+                 for j in range(min(k, topi.shape[1]))}
             # The actual prompt token's logprob is always present.
             d[int(target)] = float(tgt[i])
             chunks.setdefault(req_id, []).append((entry, d))
