@@ -120,12 +120,25 @@ def test_baichuan_wpack_equivalence(llama_ckpt, tmp_path_factory):
     assert run(path, PROMPTS) == run(llama_ckpt, PROMPTS)
 
 
-def test_baichuan_13b_alibi_rejected(llama_ckpt, tmp_path_factory):
-    sd = _state(llama_ckpt)
-    path = _save_variant(tmp_path_factory, "tiny_baichuan13b", "x", sd)
-    cfg = dict(CFG, architectures=["BaichuanForCausalLM"],
-               model_type="llama", hidden_size=5120)
-    with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(cfg, f)
-    with pytest.raises(ValueError, match="ALiBi"):
-        run(path, PROMPTS)
+def test_baichuan_13b_selects_alibi(tmp_path_factory):
+    """hidden_size >= 5120 flips the family to ALiBi + no rope (the
+    reference keys position_embedding on the 13B name,
+    baichuan.py:330); the arch knobs must reflect it."""
+    from types import SimpleNamespace
+
+    from vllm_distributed_tpu.models.families import BaichuanForCausalLM
+    from vllm_distributed_tpu.models.llama import LlamaArchConfig
+    hf = SimpleNamespace(vocab_size=64, hidden_size=5120,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=40, num_key_value_heads=40,
+                         head_dim=128, rms_norm_eps=1e-6,
+                         tie_word_embeddings=False)
+    arch = LlamaArchConfig.from_hf_config(
+        BaichuanForCausalLM.arch_config_source(hf))
+    BaichuanForCausalLM.configure_arch(arch, hf)
+    assert arch.alibi and arch.pos_embedding == "none"
+    hf.hidden_size = 4096  # 7B stays rope
+    arch7 = LlamaArchConfig.from_hf_config(
+        BaichuanForCausalLM.arch_config_source(hf))
+    BaichuanForCausalLM.configure_arch(arch7, hf)
+    assert not arch7.alibi and arch7.pos_embedding == "rope"
