@@ -241,3 +241,49 @@ def test_persimmon_matches_hf(tmp_path_factory):
     got = _run_engine(path, PROMPTS, "persimmon")
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_granitemoe_matches_hf(tmp_path_factory):
+    """GraniteMoe: fused [gate; up] expert tensors + the four Granite
+    multipliers (reference: models/granitemoe.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.GraniteMoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        embedding_multiplier=2.0, attention_multiplier=0.2,
+        residual_multiplier=0.8, logits_scaling=1.5, eos_token_id=1)
+    torch.manual_seed(11)
+    hf = transformers.GraniteMoeForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_granitemoe"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_dbrx_matches_hf(tmp_path_factory):
+    """DBRX: flat stacked expert tensors, fused clipped Wqkv, bias-free
+    LayerNorms (reference: models/dbrx.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.DbrxConfig(
+        d_model=64, n_heads=4, n_layers=2, max_seq_len=64,
+        vocab_size=128,
+        attn_config=dict(kv_n_heads=2, clip_qkv=8.0,
+                         rope_theta=10000.0),
+        ffn_config=dict(ffn_hidden_size=32, moe_num_experts=4,
+                        moe_top_k=2), eos_token_id=1)
+    torch.manual_seed(12)
+    hf = transformers.DbrxForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_dbrx"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"

@@ -153,6 +153,16 @@ def alibi_slopes(num_heads: int) -> tuple:
                  pow2(2 * closest)[0::2][:num_heads - closest])
 
 
+def subconfig_get(cfg, key, default):
+    """Read a key from an HF sub-config that may be a dict or an
+    attribute-style object (MPT attn_config, DBRX attn/ffn_config)."""
+    if cfg is None:
+        return default
+    if isinstance(cfg, dict):
+        return cfg.get(key, default)
+    return getattr(cfg, key, default)
+
+
 def rename_tensors(tensors: dict, table) -> dict:
     """Substring-rename checkpoint tensor names onto the canonical
     layout (shared by the family loaders; rules apply in order)."""

@@ -75,6 +75,132 @@ class Qwen3MoeForCausalLM(MixtralForCausalLM):
             tensors, self.cfg.num_layers, self.cfg.num_experts))
 
 
+class GraniteMoeForCausalLM(MixtralForCausalLM):
+    """IBM Granite-MoE: Mixtral-style routed experts stored as FUSED
+    per-expert tensors (input_linear packs [gate; up] rows) + the four
+    Granite multipliers (reference: models/granitemoe.py). Its top-k-
+    then-softmax gating equals Mixtral's softmax-then-renormalize, so
+    norm_topk_prob=True reproduces it exactly."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        # The four multipliers + attention bias are exactly Granite's.
+        GraniteForCausalLM.configure_arch(arch, hf)
+        arch.num_experts = hf.num_local_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.norm_topk_prob = True
+        arch.moe_intermediate_size = hf.intermediate_size
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        I = c.moe_intermediate_size
+        alias = dict(tensors)
+        for i in range(c.num_layers):
+            pre = f"model.layers.{i}.block_sparse_moe."
+            fused = np.asarray(alias.pop(pre + "input_linear.weight"))
+            out_w = np.asarray(alias.pop(pre + "output_linear.weight"))
+            alias[pre + "gate.weight"] = np.asarray(
+                alias.pop(pre + "router.layer.weight"))
+            for e in range(c.num_experts):
+                # [2I, H] per expert: first I rows gate, rest up
+                # (HF chunk(2, dim=-1) of the fused projection).
+                alias[pre + f"experts.{e}.w1.weight"] = fused[e, :I]
+                alias[pre + f"experts.{e}.w3.weight"] = fused[e, I:]
+                alias[pre + f"experts.{e}.w2.weight"] = out_w[e]
+        return super().params_from_hf_state_dict(alias)
+
+
+class DbrxForCausalLM(MixtralForCausalLM):
+    """Databricks DBRX: MoE with experts stored as FLAT stacked
+    [E*ffn, H] tensors (w1 gate, v1 up, w2 down applied untransposed),
+    fused Wqkv with clipping, bias-free LayerNorms (reference:
+    models/dbrx.py incl. its expert unflatten in the weight loader)."""
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        from types import SimpleNamespace
+
+        from vllm_distributed_tpu.models.common import subconfig_get \
+            as get
+        attn = getattr(hf, "attn_config", None)
+        ffn = getattr(hf, "ffn_config", None)
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.d_model,
+            intermediate_size=int(get(ffn, "ffn_hidden_size",
+                                      4 * hf.d_model)),
+            num_hidden_layers=hf.n_layers,
+            num_attention_heads=hf.n_heads,
+            num_key_value_heads=int(get(attn, "kv_n_heads", hf.n_heads)),
+            head_dim=hf.d_model // hf.n_heads,
+            rms_norm_eps=1e-5,
+            rope_theta=float(get(attn, "rope_theta", 10000.0)),
+            tie_word_embeddings=False,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        from vllm_distributed_tpu.models.common import subconfig_get \
+            as get
+        ffn = getattr(hf, "ffn_config", None)
+        attn = getattr(hf, "attn_config", None)
+        arch.num_experts = int(get(ffn, "moe_num_experts", 8))
+        arch.num_experts_per_tok = int(get(ffn, "moe_top_k", 2))
+        # moe_normalize_expert_weights is a p-norm order; only the L1
+        # renormalization (1 / None) maps onto the router — reject
+        # other orders rather than silently approximating them.
+        p_norm = get(ffn, "moe_normalize_expert_weights", 1)
+        if p_norm not in (None, 0, 1, 1.0):
+            raise ValueError(
+                f"DBRX moe_normalize_expert_weights={p_norm} is not "
+                f"supported (only L1 renormalization)")
+        arch.norm_topk_prob = bool(p_norm)
+        arch.moe_intermediate_size = arch.intermediate_size
+        arch.norm_type = "layernorm"
+        clip = get(attn, "clip_qkv", None)
+        arch.qkv_clip = float(clip) if clip else None
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        H = c.hidden_size
+        I = c.moe_intermediate_size
+        kv = c.num_kv_heads * c.head_dim
+        alias = {}
+        for name, t in tensors.items():
+            name = name.replace("transformer.blocks.", "model.layers.")
+            name = name.replace("transformer.wte.", "model.embed_tokens.")
+            name = name.replace("transformer.norm_f.", "model.norm.")
+            name = name.replace(".norm_attn_norm.norm_1.",
+                                ".input_layernorm.")
+            name = name.replace(".norm_attn_norm.norm_2.",
+                                ".post_attention_layernorm.")
+            name = name.replace(".norm_attn_norm.attn.out_proj.",
+                                ".self_attn.o_proj.")
+            alias[name] = np.asarray(t)
+        for i in range(c.num_layers):
+            base = f"model.layers.{i}."
+            w = alias.pop(base + "norm_attn_norm.attn.Wqkv.weight")
+            A = base + "self_attn."
+            alias[A + "q_proj.weight"] = w[:H]
+            alias[A + "k_proj.weight"] = w[H:H + kv]
+            alias[A + "v_proj.weight"] = w[H + kv:]
+            moe = base + "block_sparse_moe."
+            alias[moe + "gate.weight"] = alias.pop(
+                base + "ffn.router.layer.weight")
+            w1 = alias.pop(base + "ffn.experts.mlp.w1")  # [E*I, H]
+            v1 = alias.pop(base + "ffn.experts.mlp.v1")
+            w2 = alias.pop(base + "ffn.experts.mlp.w2")
+            for e in range(c.num_experts):
+                rows = slice(e * I, (e + 1) * I)
+                alias[moe + f"experts.{e}.w1.weight"] = w1[rows]
+                alias[moe + f"experts.{e}.w3.weight"] = v1[rows]
+                # w2 chunks apply UNtransposed (h @ w2_e); canonical
+                # w2.weight is torch [out, in], so hand over the
+                # transpose.
+                alias[moe + f"experts.{e}.w2.weight"] = w2[rows].T
+        return super().params_from_hf_state_dict(alias)
+
+
 class Starcoder2ForCausalLM(LlamaForCausalLM):
     """StarCoder2: LayerNorm(+bias), non-gated gelu MLP with biases,
     qkv + output biases (reference: models/starcoder2.py)."""

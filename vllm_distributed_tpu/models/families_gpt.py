@@ -32,12 +32,8 @@ _GPT2_RENAMES = [
 
 def _attn_get(hf, key, default):
     """Read a key from MPT's attn_config (dict or sub-config object)."""
-    attn = getattr(hf, "attn_config", None)
-    if attn is None:
-        return default
-    if isinstance(attn, dict):
-        return attn.get(key, default)
-    return getattr(attn, key, default)
+    from vllm_distributed_tpu.models.common import subconfig_get
+    return subconfig_get(getattr(hf, "attn_config", None), key, default)
 
 
 class GPT2LMHeadModel(LlamaForCausalLM):

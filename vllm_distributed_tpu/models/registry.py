@@ -18,8 +18,10 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
+                                                      DbrxForCausalLM,
                                                       FalconForCausalLM,
                                                       GlmForCausalLM,
+                                                      GraniteMoeForCausalLM,
                                                       OlmoeForCausalLM,
                                                       OlmoForCausalLM,
                                                       GPTNeoXForCausalLM,
@@ -77,6 +79,8 @@ _REGISTRY: dict[str, type] = {
     "LlavaForConditionalGeneration": LlavaForConditionalGeneration,
     # Families on the generic block knobs (models/families_ext.py).
     "GraniteForCausalLM": GraniteForCausalLM,
+    "GraniteMoeForCausalLM": GraniteMoeForCausalLM,
+    "DbrxForCausalLM": DbrxForCausalLM,
     "Qwen3MoeForCausalLM": Qwen3MoeForCausalLM,
     "Starcoder2ForCausalLM": Starcoder2ForCausalLM,
     "StableLmForCausalLM": StableLmForCausalLM,
