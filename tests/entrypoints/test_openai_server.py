@@ -361,3 +361,71 @@ def test_completion_echo_stream_rejected(server):
         "stream": True, "echo": True,
     })
     assert r.status_code == 400
+
+
+def test_tokenize_detokenize_roundtrip(server):
+    base, _ = server
+    r = httpx.post(f"{base}/tokenize", timeout=60,
+                   json={"prompt": "w3 w17 w92"})
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["tokens"] == [3, 17, 92] and body["count"] == 3
+    r2 = httpx.post(f"{base}/detokenize", timeout=60,
+                    json={"tokens": body["tokens"]})
+    assert r2.status_code == 200
+    assert r2.json()["prompt"].split() == ["w3", "w17", "w92"]
+
+
+def test_responses_api_minimal(server):
+    """/v1/responses wraps a chat completion in the Responses item
+    shape (reference: serving_responses.py)."""
+    base, _ = server
+    r = httpx.post(f"{base}/v1/responses", timeout=300, json={
+        "input": "w3 w17 w92", "max_output_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["object"] == "response"
+    assert body["status"] == "completed"
+    item = body["output"][0]
+    assert item["role"] == "assistant"
+    text = item["content"][0]["text"]
+    assert text and body["output_text"] == text
+    assert body["usage"]["output_tokens"] == 4
+    # background mode refuses honestly
+    r2 = httpx.post(f"{base}/v1/responses", timeout=60, json={
+        "input": "w3", "background": True})
+    assert r2.status_code == 400
+
+
+def test_responses_typed_input_and_stream_rejection(server):
+    base, _ = server
+    r = httpx.post(f"{base}/v1/responses", timeout=300, json={
+        "input": [{"role": "user", "content": [
+            {"type": "input_text", "text": "w3 w17"}]}],
+        "max_output_tokens": 3, "temperature": 0.0, "ignore_eos": True,
+    })
+    assert r.status_code == 200, r.text
+    assert r.json()["output_text"]
+    r2 = httpx.post(f"{base}/v1/responses", timeout=60, json={
+        "input": "w3", "stream": True})
+    assert r2.status_code == 400
+
+
+def test_detokenize_rejects_string_tokens(server):
+    base, _ = server
+    r = httpx.post(f"{base}/detokenize", timeout=60,
+                   json={"tokens": "123"})
+    assert r.status_code == 400
+
+
+def test_tokenize_messages_path(server):
+    base, _ = server
+    r = httpx.post(f"{base}/tokenize", timeout=60, json={
+        "messages": [{"role": "user", "content": "w3 w17"}]})
+    assert r.status_code == 200, r.text
+    body = r.json()
+    # Template-less fallback: role-prefixed prompt, same path chat
+    # generation uses; the real ids 3 and 17 appear in the encoding.
+    assert body["count"] == len(body["tokens"]) > 0

@@ -242,6 +242,149 @@ async def rerank(request: web.Request) -> web.Response:
         return _error_response(RequestError(str(e), code=500))
 
 
+async def tokenize(request: web.Request) -> web.Response:
+    """/tokenize (reference: the tokenize route of api_server.py:453):
+    text (or chat messages) -> token ids."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        tokenizer = engine.tokenizer
+        if tokenizer is None:
+            raise RequestError("server has no tokenizer", code=400)
+        if body.get("messages") is not None:
+            # Same templating path chat generation uses (incl. the
+            # template-less fallback); special tokens default OFF for
+            # chat — the template already embeds them (reference:
+            # the tokenize route's chat defaults, api_server.py:453).
+            prompt, _mm = _chat_prompt(engine, body["messages"])
+            add_special = bool(body.get("add_special_tokens", False))
+        else:
+            prompt = body.get("prompt")
+            if prompt is None:
+                raise RequestError("tokenize needs 'prompt' or "
+                                   "'messages'")
+            add_special = bool(body.get("add_special_tokens", True))
+        ids = tokenizer.encode(prompt, add_special_tokens=add_special)
+        return web.json_response({
+            "tokens": ids,
+            "count": len(ids),
+            "max_model_len":
+                engine.config.scheduler_config.max_model_len,
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+
+
+async def detokenize(request: web.Request) -> web.Response:
+    """/detokenize (reference: api_server.py:491): token ids -> text."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        tokenizer = engine.tokenizer
+        if tokenizer is None:
+            raise RequestError("server has no tokenizer", code=400)
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list):
+            raise RequestError("detokenize needs 'tokens' as a list "
+                               "of token ids")
+        text = tokenizer.decode([int(t) for t in tokens])
+        return web.json_response({"prompt": text})
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+
+
+async def responses(request: web.Request) -> web.Response:
+    """/v1/responses minimal surface (reference: serving_responses.py):
+    'input' (string or message list) + optional 'instructions' run as a
+    chat completion; the reply is wrapped in the Responses output item
+    shape. Background mode / response stores are not implemented."""
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error_response(RequestError(f"invalid JSON: {e}"))
+    try:
+        if body.get("background"):
+            raise RequestError(
+                "background responses are not supported")
+        if body.get("stream"):
+            raise RequestError(
+                "streaming responses are not supported; set "
+                "stream=false")
+        inp = body.get("input")
+        if inp is None:
+            raise RequestError("responses need 'input'")
+        messages = ([{"role": "user", "content": inp}]
+                    if isinstance(inp, str) else list(inp))
+        # Normalize Responses-typed content parts onto the chat part
+        # types _chat_prompt knows (input_text -> text, input_image ->
+        # image_url).
+        for m in messages:
+            parts = m.get("content")
+            if isinstance(parts, list):
+                m["content"] = [
+                    ({**p, "type": "text"}
+                     if p.get("type") == "input_text" else
+                     {"type": "image_url",
+                      "image_url": {"url": p.get("image_url")}}
+                     if p.get("type") == "input_image" else p)
+                    for p in parts
+                ]
+        if body.get("instructions"):
+            messages.insert(
+                0, {"role": "system", "content": body["instructions"]})
+        max_len = engine.config.scheduler_config.max_model_len
+        chat_body = dict(body, messages=messages)
+        chat_body.pop("input", None)
+        if "max_output_tokens" in body:
+            chat_body["max_tokens"] = body["max_output_tokens"]
+        params = protocol.sampling_params_from_request(chat_body,
+                                                       max_len)
+        prompt, _mm = _chat_prompt(engine, messages)
+        lora = _resolve_lora(request.app, body)
+        rid = protocol.completion_id().replace("cmpl", "resp")
+        final = await _drain(engine.generate(prompt, params,
+                                             request_id=rid,
+                                             lora_request=lora))
+        text = final.outputs[0].text
+        return web.json_response({
+            "id": rid,
+            "object": "response",
+            "created_at": int(time.time()),
+            "model": body.get("model", model),
+            "status": "completed",
+            "output": [{
+                "type": "message",
+                "id": f"msg-{rid}",
+                "role": "assistant",
+                "status": "completed",
+                "content": [{"type": "output_text", "text": text,
+                             "annotations": []}],
+            }],
+            "output_text": text,
+            "usage": {
+                "input_tokens": len(final.prompt_token_ids),
+                "output_tokens": len(final.outputs[0].token_ids),
+                "total_tokens": (len(final.prompt_token_ids) +
+                                 len(final.outputs[0].token_ids)),
+            },
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+    except EngineDeadError as e:
+        return _error_response(RequestError(str(e), code=500))
+
+
 async def start_profile(request: web.Request) -> web.Response:
     """Begin a device trace (reference: api_server /start_profile)."""
     dirs = _profile_dirs(await request.app[ENGINE_KEY].profile("start"))
@@ -636,6 +779,9 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_post("/v1/score", score)
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
+    app.router.add_post("/v1/responses", responses)
     app.router.add_post("/v1/rerank", rerank)
     app.router.add_post("/rerank", rerank)
     app.router.add_post("/start_profile", start_profile)
