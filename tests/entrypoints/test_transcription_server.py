@@ -1,0 +1,121 @@
+"""/v1/audio/transcriptions over a live server + tiny Whisper
+checkpoint (reference: serving_transcription.py)."""
+
+import asyncio
+import base64
+import io
+import threading
+import wave
+
+import httpx
+import numpy as np
+import pytest
+import torch
+import transformers
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.utils import get_open_port
+
+
+def _wav_bytes(wav: np.ndarray, rate: int = 16000) -> bytes:
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes((np.clip(wav, -1, 1) * 32767).astype("<i2")
+                      .tobytes())
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def whisper_server(tmp_path_factory):
+    cfg = transformers.WhisperConfig(
+        vocab_size=96, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, num_mel_bins=8,
+        max_source_positions=16, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=1, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = transformers.WhisperForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_whisper_served"))
+    hf.save_pretrained(path, safe_serialization=True)
+    # 0.32 s chunks -> 32 mel frames, matching max_source_positions=16
+    # after the stride-2 conv.
+    transformers.WhisperFeatureExtractor(
+        feature_size=8, chunk_length=1).save_pretrained(path)
+    import json
+    import os
+    with open(os.path.join(path, "preprocessor_config.json")) as f:
+        pc = json.load(f)
+    pc["chunk_length"] = 0.32
+    pc["n_samples"] = 5120
+    pc["nb_max_frames"] = 32
+    with open(os.path.join(path, "preprocessor_config.json"), "w") as f:
+        json.dump(pc, f)
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(94)}
+    vocab["<unk>"] = 94
+    vocab["</s>"] = 95
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(path)
+
+    engine = AsyncLLM(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64,
+        max_num_seqs=8).create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import \
+            serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["stop"], holder["loop"] = stop, loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready,
+                                      stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120)
+    yield f"http://127.0.0.1:{port}"
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    t.join(timeout=30)
+
+
+def test_transcription_multipart_and_b64(whisper_server):
+    base = whisper_server
+    rng = np.random.default_rng(0)
+    wav = (0.1 * rng.standard_normal(5120)).astype(np.float32)
+    data = _wav_bytes(wav)
+    r = httpx.post(f"{base}/v1/audio/transcriptions", timeout=300,
+                   files={"file": ("a.wav", data, "audio/wav")})
+    assert r.status_code == 200, r.text
+    text1 = r.json()["text"]
+    assert isinstance(text1, str) and text1
+    # Same audio via JSON base64 gives the same transcription.
+    r2 = httpx.post(f"{base}/v1/audio/transcriptions", timeout=300,
+                    json={"audio": base64.b64encode(data).decode()})
+    assert r2.status_code == 200, r2.text
+    assert r2.json()["text"] == text1
+
+
+def test_transcription_rejects_wrong_rate(whisper_server):
+    base = whisper_server
+    wav = np.zeros(4000, np.float32)
+    r = httpx.post(f"{base}/v1/audio/transcriptions", timeout=60,
+                   files={"file": ("a.wav",
+                                   _wav_bytes(wav, rate=8000),
+                                   "audio/wav")})
+    assert r.status_code == 400
+    assert "16 kHz" in r.text

@@ -259,11 +259,14 @@ class Scheduler:
             self._free_request(request)
 
     def _commit_encoder_budget(self, request: Request) -> None:
-        if request.mm_inputs and not self.encoder_cache.has(
-                request.request_id):
+        # offset < 0 marks cross-attention payloads (whisper audio):
+        # they live in fixed state rows, not the encoder cache.
+        budgeted = [m for m in (request.mm_inputs or ())
+                    if m.offset >= 0]
+        if budgeted and not self.encoder_cache.has(request.request_id):
             self.encoder_cache.allocate(
                 request.request_id,
-                sum(m.num_tokens for m in request.mm_inputs))
+                sum(m.num_tokens for m in budgeted))
 
     def _free_request(self, request: Request) -> Optional[dict]:
         """Tear a finished request down. Returns the connector's
@@ -467,9 +470,11 @@ class Scheduler:
                     self._free_request(request)
                     continue
 
-                if request.mm_inputs and not self.encoder_cache.has(
+                budgeted_mm = [m for m in (request.mm_inputs or ())
+                               if m.offset >= 0]
+                if budgeted_mm and not self.encoder_cache.has(
                         request.request_id):
-                    n_enc = sum(m.num_tokens for m in request.mm_inputs)
+                    n_enc = sum(m.num_tokens for m in budgeted_mm)
                     if n_enc > self.encoder_cache.budget:
                         logger.warning(
                             "Request %s needs %d encoder tokens; the "

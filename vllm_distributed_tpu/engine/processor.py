@@ -191,6 +191,71 @@ class Processor:
             mm_inputs=mm_inputs,
         )
 
+    def _process_audio(self, multi_modal_data: dict,
+                       prompt_token_ids: list[int]):
+        """Whisper-family audio: run the front-end audio encoder at
+        admission; the [frames, H] hidden states ride the request
+        (offset=-1 marks a cross-attention payload, not prompt-row
+        substitution). Reference: the transcription input path of
+        serving_transcription.py + models/whisper.py."""
+        import numpy as np
+
+        from vllm_distributed_tpu.models.registry import \
+            resolve_architecture
+        from vllm_distributed_tpu.multimodal import MultiModalInput
+        hf = self.config.model_config.maybe_load_hf_config()
+        cls = resolve_architecture(hf)
+        if not getattr(cls, "CROSS_ATTENTION", False):
+            raise ValueError(
+                "audio inputs need an encoder-decoder (Whisper-family) "
+                "model")
+        if "input_features" in multi_modal_data:
+            feats = np.asarray(multi_modal_data["input_features"],
+                               np.float32)
+        else:
+            feats = self._extract_audio_features(
+                multi_modal_data["audio"])
+        if feats.ndim == 3:
+            feats = feats[0]
+        mel = int(getattr(hf, "num_mel_bins", feats.shape[0]))
+        frames = 2 * int(hf.max_source_positions)
+        if feats.shape != (mel, frames):
+            # A wrong shape would shape-mismatch inside the worker's
+            # cross-state scatter mid-step, killing the engine —
+            # refuse at admission instead.
+            raise ValueError(
+                f"input_features must be [{mel}, {frames}] "
+                f"(num_mel_bins x 2*max_source_positions); got "
+                f"{tuple(feats.shape)}")
+        if self._audio_encoder is None:
+            from vllm_distributed_tpu.multimodal.audio import \
+                build_audio_encoder
+            self._audio_encoder = build_audio_encoder(
+                self.config.model_config.model, hf)
+            if self._audio_encoder is None:
+                raise ValueError(
+                    "audio inputs need a local Whisper checkpoint "
+                    "(the front-end encoder loads model.encoder.*)")
+        hidden = self._audio_encoder.encode(feats)
+        return [MultiModalInput(embeds=hidden, offset=-1)], \
+            prompt_token_ids
+
+    def _extract_audio_features(self, audio) -> "np.ndarray":
+        """Raw waveform -> log-mel features via the checkpoint's
+        feature extractor (reference: the WhisperFeatureExtractor use
+        of serving_transcription.py)."""
+        import numpy as np
+        if self._audio_fe is None:
+            from transformers import WhisperFeatureExtractor
+            self._audio_fe = WhisperFeatureExtractor.from_pretrained(
+                self.config.model_config.model)
+        out = self._audio_fe(np.asarray(audio, np.float32),
+                             sampling_rate=16000, return_tensors="np")
+        return out["input_features"][0]
+
+    _audio_encoder = None
+    _audio_fe = None
+
     def _encode_pixels(self, pixel_values) -> list:
         """Run the in-engine vision tower at admission (reference: the
         encoder pass of gpu_model_runner._execute_mm_encoder; here the
@@ -230,12 +295,17 @@ class Processor:
                 "image inputs under pipeline parallelism are not wired "
                 "yet (the staged embed path does not apply embedding "
                 "overrides); disable one")
+        if ("audio" in multi_modal_data
+                or "input_features" in multi_modal_data):
+            return self._process_audio(multi_modal_data,
+                                       prompt_token_ids)
         unknown = set(multi_modal_data) - {"image_embeds", "pixel_values"}
         if unknown:
             raise ValueError(
                 f"unsupported multi_modal_data keys {sorted(unknown)}; "
-                "this engine accepts 'image_embeds' (pre-computed) or "
-                "'pixel_values' (encoded by the in-engine vision tower)")
+                "this engine accepts 'image_embeds' (pre-computed), "
+                "'pixel_values' (in-engine vision tower), or "
+                "'audio'/'input_features' (Whisper-family models)")
         if "pixel_values" in multi_modal_data:
             if "image_embeds" in multi_modal_data:
                 raise ValueError(

@@ -267,7 +267,12 @@ async def tokenize(request: web.Request) -> web.Response:
                 raise RequestError("tokenize needs 'prompt' or "
                                    "'messages'")
             add_special = bool(body.get("add_special_tokens", True))
-        ids = tokenizer.encode(prompt, add_special_tokens=add_special)
+        if isinstance(prompt, list):
+            # Templated chat paths return token ids directly.
+            ids = [int(t) for t in prompt]
+        else:
+            ids = tokenizer.encode(prompt,
+                                   add_special_tokens=add_special)
         return web.json_response({
             "tokens": ids,
             "count": len(ids),
@@ -324,11 +329,18 @@ async def responses(request: web.Request) -> web.Response:
         if inp is None:
             raise RequestError("responses need 'input'")
         messages = ([{"role": "user", "content": inp}]
-                    if isinstance(inp, str) else list(inp))
+                    if isinstance(inp, str) else [
+                        ({"role": "user", "content": m}
+                         if isinstance(m, str) else m)
+                        for m in inp
+                    ])
         # Normalize Responses-typed content parts onto the chat part
         # types _chat_prompt knows (input_text -> text, input_image ->
         # image_url).
         for m in messages:
+            if not isinstance(m, dict):
+                raise RequestError(
+                    "input items must be strings or message objects")
             parts = m.get("content")
             if isinstance(parts, list):
                 m["content"] = [
@@ -349,12 +361,17 @@ async def responses(request: web.Request) -> web.Response:
             chat_body["max_tokens"] = body["max_output_tokens"]
         params = protocol.sampling_params_from_request(chat_body,
                                                        max_len)
-        prompt, _mm = _chat_prompt(engine, messages)
+        prompt, mm = _chat_prompt(engine, messages)
+        if mm is not None:
+            # Image parts: encode pixels once, like chat_completions.
+            mm = {"image_embeds": engine.processor._encode_pixels(
+                mm["pixel_values"])}
         lora = _resolve_lora(request.app, body)
         rid = protocol.completion_id().replace("cmpl", "resp")
         final = await _drain(engine.generate(prompt, params,
                                              request_id=rid,
-                                             lora_request=lora))
+                                             lora_request=lora,
+                                             multi_modal_data=mm))
         text = final.outputs[0].text
         return web.json_response({
             "id": rid,
@@ -377,6 +394,97 @@ async def responses(request: web.Request) -> web.Response:
                 "total_tokens": (len(final.prompt_token_ids) +
                                  len(final.outputs[0].token_ids)),
             },
+        })
+    except (RequestError, ValueError) as e:
+        return _error_response(e if isinstance(e, RequestError)
+                               else RequestError(str(e)))
+    except EngineDeadError as e:
+        return _error_response(RequestError(str(e), code=500))
+
+
+def _decode_wav(data: bytes):
+    """PCM WAV bytes -> (mono float32 waveform, sample_rate) using only
+    the stdlib (no audio libs in the image)."""
+    import io
+    import wave
+
+    import numpy as np
+    try:
+        with wave.open(io.BytesIO(data)) as w:
+            rate = w.getframerate()
+            n = w.getnframes()
+            width = w.getsampwidth()
+            channels = w.getnchannels()
+            raw = w.readframes(n)
+    except (wave.Error, EOFError) as e:
+        raise RequestError(f"invalid WAV payload: {e}") from e
+    if width == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+    elif width == 1:
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128) / 128
+    else:
+        raise RequestError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    return x, rate
+
+
+def _transcription_prompt(engine) -> list[int]:
+    """Decoder prompt for transcription: decoder_start + any forced ids
+    from the generation config (<|lang|><|transcribe|><|notimestamps|>;
+    reference: the prompt assembly of serving_transcription.py)."""
+    hf = engine.config.model_config.maybe_load_hf_config()
+    ids = [int(getattr(hf, "decoder_start_token_id", 0) or 0)]
+    forced = getattr(hf, "forced_decoder_ids", None)
+    if forced:
+        ids.extend(int(t) for _, t in forced)
+    return ids
+
+
+async def transcriptions(request: web.Request) -> web.Response:
+    """/v1/audio/transcriptions (reference: serving_transcription.py):
+    multipart form with a WAV `file`, or JSON {"audio": <base64 wav>}.
+    Requires a Whisper-family model."""
+    engine = request.app[ENGINE_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        if request.content_type.startswith("multipart/"):
+            reader = await request.multipart()
+            data = None
+            async for part in reader:
+                if part.name == "file":
+                    data = await part.read()
+                else:
+                    await part.read()
+            if data is None:
+                raise RequestError("multipart needs a 'file' part")
+        else:
+            import base64
+            body = await request.json()
+            if body.get("audio") is None:
+                raise RequestError(
+                    "transcriptions need a multipart 'file' or JSON "
+                    "'audio' (base64 WAV)")
+            data = base64.b64decode(body["audio"])
+        wav, rate = _decode_wav(data)
+        if rate != 16000:
+            raise RequestError(
+                f"audio must be 16 kHz PCM WAV (got {rate} Hz); "
+                f"resample client-side")
+        from vllm_distributed_tpu.sampling_params import SamplingParams
+        params = SamplingParams(
+            temperature=0.0,
+            max_tokens=engine.config.scheduler_config.max_model_len // 2)
+        prompt = _transcription_prompt(engine)
+        final = await _drain(engine.generate(
+            prompt, params,
+            request_id=protocol.completion_id().replace("cmpl", "trsc"),
+            multi_modal_data={"audio": wav}))
+        return web.json_response({
+            "text": final.outputs[0].text,
+            "model": model,
         })
     except (RequestError, ValueError) as e:
         return _error_response(e if isinstance(e, RequestError)
@@ -782,6 +890,7 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_post("/tokenize", tokenize)
     app.router.add_post("/detokenize", detokenize)
     app.router.add_post("/v1/responses", responses)
+    app.router.add_post("/v1/audio/transcriptions", transcriptions)
     app.router.add_post("/v1/rerank", rerank)
     app.router.add_post("/rerank", rerank)
     app.router.add_post("/start_profile", start_profile)

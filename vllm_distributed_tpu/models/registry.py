@@ -46,6 +46,8 @@ from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
                                               RobertaEmbeddingModel,
                                               RobertaForSequenceClassification)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.whisper import \
+    WhisperForConditionalGeneration
 from vllm_distributed_tpu.models.bamba import BambaForCausalLM
 from vllm_distributed_tpu.models.jamba import JambaForCausalLM
 from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
@@ -114,6 +116,9 @@ _REGISTRY: dict[str, type] = {
     "BloomForCausalLM": BloomForCausalLM,
     "MptForCausalLM": MPTForCausalLM,
     "MPTForCausalLM": MPTForCausalLM,
+    # Encoder-decoder audio (cross-attention state rows;
+    # models/whisper.py + multimodal/audio.py).
+    "WhisperForConditionalGeneration": WhisperForConditionalGeneration,
     # Encoder-only embedding + cross-encoder families (models/bert.py;
     # reference: the _EMBEDDING_MODELS / _CROSS_ENCODER_MODELS maps of
     # model_executor/models/registry.py).

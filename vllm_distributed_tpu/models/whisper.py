@@ -1,0 +1,341 @@
+"""Whisper encoder-decoder (audio transcription).
+
+Reference surface: vllm/model_executor/models/whisper.py
+(WhisperForConditionalGeneration: a conv-subsampled audio encoder whose
+output feeds per-layer cross-attention in the decoder; the decoder's
+self-attention KV is paged while the cross-attention KV is computed
+once per request) and the transcription serving path
+(entrypoints/openai/serving_transcription.py).
+
+TPU design: the AUDIO ENCODER runs front-end-side at admission (the
+multimodal/audio.py module, mirroring the CLIP vision tower's
+placement) and ships the [frames, H] hidden states on the request like
+an image's embeddings. Worker-side, ``install_cross_states`` projects
+them through the decoder's per-layer cross K/V weights ONCE and
+scatters the result into fixed per-request state rows — the same
+slot-indexed state-row machinery the SSM families use
+(models/mamba.py), because cross KV is O(1) per request (every audio
+clip encodes to the same static frame count) and paging buys nothing.
+The decoder itself runs on the ordinary ragged paged engine: learned
+positions, bias-carrying LayerNorm blocks, causal paged self-attention
+(no rope), and a dense cross-attention over the request's state row.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.llama import (MODEL_AXIS, TOKEN_AXIS,
+                                               LlamaForCausalLM)
+from vllm_distributed_tpu.ops.attention import (paged_attention,
+                                                storage_head_dim,
+                                                write_kv_cache)
+
+
+class WhisperForConditionalGeneration(LlamaForCausalLM):
+    """Whisper decoder on the paged engine + cross-attention state."""
+
+    STATEFUL = True        # fixed per-request rows; no prefix caching
+    CROSS_ATTENTION = True
+    QUANT_TARGETS = ()
+    LORA_TARGETS = ()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.d_model,
+            intermediate_size=hf.decoder_ffn_dim,
+            num_hidden_layers=hf.decoder_layers,
+            num_attention_heads=hf.decoder_attention_heads,
+            num_key_value_heads=hf.decoder_attention_heads,
+            head_dim=hf.d_model // hf.decoder_attention_heads,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        arch.stateful = True
+        arch.pos_embedding = "learned"
+        arch.max_position_embeddings = int(hf.max_target_positions)
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "activation_function", "gelu")
+        arch.tie_word_embeddings = True
+        # Encoder frame count after the stride-2 conv subsampling.
+        arch.num_audio_frames = int(hf.max_source_positions)
+        if not hasattr(arch, "state_slots"):
+            arch.state_slots = 0
+
+    def quantize_params(self, params: dict) -> dict:
+        if self.cfg.quantization:
+            raise ValueError(
+                "quantization for Whisper is not wired yet; drop "
+                "--quantization")
+        return params
+
+    # ------------------------------------------------------------------
+    # Parameters
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        c = self.cfg
+        col = P(None, None, MODEL_AXIS)
+        colb = P(None, MODEL_AXIS)
+        row = P(None, MODEL_AXIS, None)
+        ln = P(None, None)
+        layer = {}
+        for pre in ("", "c"):
+            layer.update({
+                pre + "wq": col, pre + "bq": colb,
+                pre + "wk": col,
+                pre + "wv": col, pre + "bv": colb,
+                pre + "wo": row, pre + "bo": ln,
+            })
+        layer.update({
+            "ln1": ln, "ln1_b": ln,
+            "ln2": ln, "ln2_b": ln,
+            "ln3": ln, "ln3_b": ln,
+            "fc1": col, "fc1_b": colb,
+            "fc2": row, "fc2_b": ln,
+        })
+        return {
+            "embed": P(None, None),
+            "embed_pos": P(None, None),
+            "layers": layer,
+            "final_ln": P(None),
+            "final_ln_b": P(None),
+            "lm_head": P(None, MODEL_AXIS),
+        }
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        L, H, I = c.num_layers, c.hidden_size, c.intermediate_size
+        keys = iter(jax.random.split(rng, 24))
+
+        def rnd(shape):
+            return (scale * jax.random.normal(next(keys), shape,
+                                              jnp.float32)).astype(c.dtype)
+
+        layer = {}
+        for pre in ("", "c"):
+            layer.update({
+                pre + "wq": rnd((L, H, H)),
+                pre + "bq": jnp.zeros((L, H), c.dtype),
+                pre + "wk": rnd((L, H, H)),
+                pre + "wv": rnd((L, H, H)),
+                pre + "bv": jnp.zeros((L, H), c.dtype),
+                pre + "wo": rnd((L, H, H)),
+                pre + "bo": jnp.zeros((L, H), c.dtype),
+            })
+        layer.update({
+            "ln1": jnp.ones((L, H), c.dtype),
+            "ln1_b": jnp.zeros((L, H), c.dtype),
+            "ln2": jnp.ones((L, H), c.dtype),
+            "ln2_b": jnp.zeros((L, H), c.dtype),
+            "ln3": jnp.ones((L, H), c.dtype),
+            "ln3_b": jnp.zeros((L, H), c.dtype),
+            "fc1": rnd((L, H, I)),
+            "fc1_b": jnp.zeros((L, I), c.dtype),
+            "fc2": rnd((L, I, H)),
+            "fc2_b": jnp.zeros((L, H), c.dtype),
+        })
+        embed = rnd((c.vocab_size, H))
+        return {
+            "embed": embed,
+            "embed_pos": rnd((c.max_position_embeddings, H)),
+            "layers": layer,
+            "final_ln": jnp.ones((H, ), c.dtype),
+            "final_ln_b": jnp.zeros((H, ), c.dtype),
+            "lm_head": embed.T,
+        }
+
+    def params_from_hf_state_dict(self, tensors, dtype=None) -> dict:
+        c = self.cfg
+        dt = dtype or c.dtype
+        L = c.num_layers
+
+        def t(name):
+            return np.asarray(tensors[name])
+
+        def stack(fmt, transpose=True):
+            mats = [t(fmt.format(i)) for i in range(L)]
+            return jnp.asarray(
+                np.stack([m.T if transpose else m for m in mats]), dt)
+
+        D = "model.decoder.layers.{}."
+        layer = {
+            "ln1": stack(D + "self_attn_layer_norm.weight", False),
+            "ln1_b": stack(D + "self_attn_layer_norm.bias", False),
+            "wq": stack(D + "self_attn.q_proj.weight"),
+            "bq": stack(D + "self_attn.q_proj.bias", False),
+            "wk": stack(D + "self_attn.k_proj.weight"),
+            "wv": stack(D + "self_attn.v_proj.weight"),
+            "bv": stack(D + "self_attn.v_proj.bias", False),
+            "wo": stack(D + "self_attn.out_proj.weight"),
+            "bo": stack(D + "self_attn.out_proj.bias", False),
+            "ln2": stack(D + "encoder_attn_layer_norm.weight", False),
+            "ln2_b": stack(D + "encoder_attn_layer_norm.bias", False),
+            "cwq": stack(D + "encoder_attn.q_proj.weight"),
+            "cbq": stack(D + "encoder_attn.q_proj.bias", False),
+            "cwk": stack(D + "encoder_attn.k_proj.weight"),
+            "cwv": stack(D + "encoder_attn.v_proj.weight"),
+            "cbv": stack(D + "encoder_attn.v_proj.bias", False),
+            "cwo": stack(D + "encoder_attn.out_proj.weight"),
+            "cbo": stack(D + "encoder_attn.out_proj.bias", False),
+            "ln3": stack(D + "final_layer_norm.weight", False),
+            "ln3_b": stack(D + "final_layer_norm.bias", False),
+            "fc1": stack(D + "fc1.weight"),
+            "fc1_b": stack(D + "fc1.bias", False),
+            "fc2": stack(D + "fc2.weight"),
+            "fc2_b": stack(D + "fc2.bias", False),
+        }
+        embed = jnp.asarray(t("model.decoder.embed_tokens.weight"), dt)
+        return {
+            "embed": embed,
+            "embed_pos": jnp.asarray(
+                t("model.decoder.embed_positions.weight"), dt),
+            "layers": layer,
+            "final_ln": jnp.asarray(t("model.decoder.layer_norm.weight"),
+                                    dt),
+            "final_ln_b": jnp.asarray(
+                t("model.decoder.layer_norm.bias"), dt),
+            # proj_out is tied to the decoder embedding.
+            "lm_head": embed.T,
+        }
+
+    # ------------------------------------------------------------------
+    # Caches: paged decoder KV + fixed cross-KV state rows
+    # ------------------------------------------------------------------
+    def kv_cache_specs(self) -> dict:
+        return {
+            "k": P(None, TOKEN_AXIS, MODEL_AXIS, None, None),
+            "v": P(None, TOKEN_AXIS, MODEL_AXIS, None, None),
+            "xk": P(None, None, None, MODEL_AXIS, None),
+            "xv": P(None, None, None, MODEL_AXIS, None),
+        }
+
+    def _cross_shapes(self) -> dict:
+        c = self.cfg
+        S = (c.state_slots or 256) + 1  # +1 dump row
+        shape = (c.num_layers, S, c.num_audio_frames, c.num_q_heads,
+                 c.head_dim)
+        return {"xk": (shape, c.dtype), "xv": (shape, c.dtype)}
+
+    def make_kv_caches(self, num_pages: int, page_size: int,
+                       cache_dtype=None,
+                       num_layers: Optional[int] = None) -> dict:
+        c = self.cfg
+        assert num_layers is None or num_layers == c.num_layers, \
+            "whisper stacks are not sliceable per stage (no PP)"
+        dtype = cache_dtype or c.dtype
+        shape = (c.num_layers, num_pages, c.total_kv_heads, page_size,
+                 storage_head_dim(c.head_dim))
+        caches = {"k": jnp.zeros(shape, dtype),
+                  "v": jnp.zeros(shape, dtype)}
+        caches.update({
+            name: jnp.zeros(s, dt)
+            for name, (s, dt) in self._cross_shapes().items()
+        })
+        return caches
+
+    def fixed_cache_bytes(self) -> int:
+        return sum(int(np.prod(s)) * jnp.dtype(dt).itemsize
+                   for s, dt in self._cross_shapes().values())
+
+    # ------------------------------------------------------------------
+    def install_cross_states(self, kv_caches: dict, slot: int,
+                             enc_hidden: np.ndarray) -> dict:
+        """Project the encoder hidden states through every decoder
+        layer's cross K/V weights and write the request's state row
+        (runs once at admission; donated in-place update)."""
+        if self._install_fn is None:
+            def project(layers, h):
+                # h [F, H] -> k/v [L, F, NH, D]
+                c = self.cfg
+                k = jnp.einsum("fh,lhd->lfd", h, layers["cwk"])
+                v = jnp.einsum("fh,lhd->lfd", h,
+                               layers["cwv"]) + layers["cbv"][:, None, :]
+                L, F = k.shape[0], k.shape[1]
+                return (k.reshape(L, F, c.num_q_heads, c.head_dim),
+                        v.reshape(L, F, c.num_q_heads, c.head_dim))
+
+            def scatter(xk, xv, k, v, slot):
+                return (xk.at[:, slot].set(k.astype(xk.dtype)),
+                        xv.at[:, slot].set(v.astype(xv.dtype)))
+
+            self._install_fn = (jax.jit(project),
+                                jax.jit(scatter, donate_argnums=(0, 1)))
+        project, scatter = self._install_fn
+        h = jnp.asarray(np.asarray(enc_hidden), self.cfg.dtype)
+        k, v = project(self.params_ref["layers"], h)
+        kv_caches["xk"], kv_caches["xv"] = scatter(
+            kv_caches["xk"], kv_caches["xv"], k, v,
+            jnp.asarray(slot, jnp.int32))
+        return kv_caches
+
+    _install_fn = None
+    params_ref: dict = None  # set by the runner after load
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+    def run_layers(self, layer_params, kv_caches, hidden, batch,
+                   first_layer: int = 0):
+        c = self.cfg
+        T = hidden.shape[0]
+        sm_scale = c.head_dim ** -0.5
+        slots = batch.req_idx  # input-batch row == state slot
+
+        def ln(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + c.rms_norm_eps) *
+                    w + b).astype(x.dtype)
+
+        h = hidden
+        k_all, v_all = kv_caches["k"], kv_caches["v"]
+        xk_all, xv_all = kv_caches["xk"], kv_caches["xv"]
+        for i in range(c.num_layers):
+            lp = {k: v[i] for k, v in layer_params.items()}
+            li = jnp.full((1, ), i, jnp.int32)
+            # Self-attention (causal, paged, no rope).
+            x = ln(h, lp["ln1"], lp["ln1_b"])
+            q = (x @ lp["wq"] + lp["bq"]).reshape(T, c.num_q_heads,
+                                                  c.head_dim)
+            k = (x @ lp["wk"]).reshape(T, c.total_kv_heads, c.head_dim)
+            v = (x @ lp["wv"] + lp["bv"]).reshape(T, c.total_kv_heads,
+                                                  c.head_dim)
+            k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch, li)
+            attn = paged_attention(q, k_all, v_all, batch,
+                                   sm_scale=sm_scale, layer=li)
+            h = h + attn.reshape(T, -1) @ lp["wo"] + lp["bo"]
+            # Cross-attention over the request's encoder-state row
+            # (every frame valid: audio pads to the model's static
+            # frame count).
+            x = ln(h, lp["ln2"], lp["ln2_b"])
+            q = ((x @ lp["cwq"] + lp["cbq"]) * sm_scale).reshape(
+                T, c.num_q_heads, c.head_dim)
+            xk = xk_all[i][slots]  # [T, F, NH, D]
+            xv = xv_all[i][slots]
+            scores = jnp.einsum("tnd,tfnd->tnf", q.astype(jnp.float32),
+                                xk.astype(jnp.float32))
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("tnf,tfnd->tnd", probs.astype(h.dtype), xv)
+            h = h + ctx.reshape(T, -1) @ lp["cwo"] + lp["cbo"]
+            # MLP.
+            x = ln(h, lp["ln3"], lp["ln3_b"])
+            m = self._act(x @ lp["fc1"] + lp["fc1_b"])
+            h = h + m @ lp["fc2"] + lp["fc2_b"]
+        return h, {"k": k_all, "v": v_all, "xk": xk_all, "xv": xv_all}

@@ -74,5 +74,6 @@ def mm_content_hash(inputs: list[MultiModalInput]) -> bytes:
     h = hashlib.sha256()
     for inp in inputs:
         h.update(inp.content_hash())
-        h.update(inp.offset.to_bytes(8, "little"))
+        # signed: offset -1 marks cross-attention payloads (audio).
+        h.update(inp.offset.to_bytes(8, "little", signed=True))
     return h.digest()

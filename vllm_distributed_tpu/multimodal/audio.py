@@ -1,0 +1,144 @@
+"""Whisper audio encoder, run front-end-side at admission.
+
+Reference: the encoder half of vllm/model_executor/models/whisper.py
+(WhisperEncoder: two mel convolutions — the second stride-2 — plus
+sinusoidal positions and a bidirectional pre-LN transformer). Placed
+like the CLIP vision tower (multimodal/vision.py): audio encodes ONCE
+at admission, and the [frames, d_model] hidden states ride the request
+to the worker, which projects them into per-layer cross-KV state rows
+(models/whisper.py install_cross_states).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _ln(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+class WhisperAudioEncoder:
+    """Functional JAX Whisper encoder from an HF checkpoint."""
+
+    def __init__(self, tensors: dict, hf_config) -> None:
+        self.heads = hf_config.encoder_attention_heads
+        self.hidden = hf_config.d_model
+        self.head_dim = self.hidden // self.heads
+        self.frames = int(hf_config.max_source_positions)
+        L = hf_config.encoder_layers
+        self.params = self._load(tensors, L)
+        self._jit = jax.jit(self._forward)
+
+    def _load(self, tensors: dict, L: int) -> dict:
+        E = "model.encoder."
+
+        def t(name):
+            return np.asarray(tensors[E + name])
+
+        def stack(fmt, transpose=True):
+            mats = [t(fmt.format(i)) for i in range(L)]
+            return jnp.asarray(
+                np.stack([m.T if transpose else m for m in mats]),
+                jnp.float32)
+
+        lay = "layers.{}."
+        return {
+            # Conv1d weight [out, in, k] -> [k, in, out] for lax.conv.
+            "conv1_w": jnp.asarray(
+                np.transpose(t("conv1.weight"), (2, 1, 0)), jnp.float32),
+            "conv1_b": jnp.asarray(t("conv1.bias"), jnp.float32),
+            "conv2_w": jnp.asarray(
+                np.transpose(t("conv2.weight"), (2, 1, 0)), jnp.float32),
+            "conv2_b": jnp.asarray(t("conv2.bias"), jnp.float32),
+            "pos": jnp.asarray(t("embed_positions.weight"), jnp.float32),
+            "ln1": stack(lay + "self_attn_layer_norm.weight", False),
+            "ln1_b": stack(lay + "self_attn_layer_norm.bias", False),
+            "wq": stack(lay + "self_attn.q_proj.weight"),
+            "bq": stack(lay + "self_attn.q_proj.bias", False),
+            "wk": stack(lay + "self_attn.k_proj.weight"),
+            "wv": stack(lay + "self_attn.v_proj.weight"),
+            "bv": stack(lay + "self_attn.v_proj.bias", False),
+            "wo": stack(lay + "self_attn.out_proj.weight"),
+            "bo": stack(lay + "self_attn.out_proj.bias", False),
+            "ln2": stack(lay + "final_layer_norm.weight", False),
+            "ln2_b": stack(lay + "final_layer_norm.bias", False),
+            "fc1": stack(lay + "fc1.weight"),
+            "fc1_b": stack(lay + "fc1.bias", False),
+            "fc2": stack(lay + "fc2.weight"),
+            "fc2_b": stack(lay + "fc2.bias", False),
+            "ln_f": jnp.asarray(t("layer_norm.weight"), jnp.float32),
+            "ln_f_b": jnp.asarray(t("layer_norm.bias"), jnp.float32),
+        }
+
+    def _forward(self, params: dict, mel: jax.Array) -> jax.Array:
+        """mel [num_mel_bins, 2*frames] -> hidden [frames, d_model]."""
+        x = mel.T[None, :, :]  # [1, T, C]
+        x = jax.nn.gelu(jax.lax.conv_general_dilated(
+            x, params["conv1_w"], (1, ), "SAME",
+            dimension_numbers=("NWC", "WIO", "NWC")) + params["conv1_b"],
+            approximate=False)
+        x = jax.nn.gelu(jax.lax.conv_general_dilated(
+            x, params["conv2_w"], (2, ), "SAME",
+            dimension_numbers=("NWC", "WIO", "NWC")) + params["conv2_b"],
+            approximate=False)
+        h = x[0] + params["pos"]  # [frames, H]
+        nh, hd = self.heads, self.head_dim
+        F = h.shape[0]
+        scale = hd ** -0.5
+
+        _LAYER_KEYS = ("ln1", "ln1_b", "wq", "bq", "wk", "wv", "bv",
+                       "wo", "bo", "ln2", "ln2_b", "fc1", "fc1_b",
+                       "fc2", "fc2_b")
+
+        def layer(h, i):
+            p = {k: params[k][i] for k in _LAYER_KEYS}
+            x = _ln(h, p["ln1"], p["ln1_b"])
+            q = ((x @ p["wq"] + p["bq"]) * scale).reshape(F, nh, hd)
+            k = (x @ p["wk"]).reshape(F, nh, hd)
+            v = (x @ p["wv"] + p["bv"]).reshape(F, nh, hd)
+            s = jnp.einsum("ind,jnd->nij", q, k)
+            a = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("nij,jnd->ind", a, v).reshape(F, -1)
+            h = h + ctx @ p["wo"] + p["bo"]
+            x = _ln(h, p["ln2"], p["ln2_b"])
+            m = jax.nn.gelu(x @ p["fc1"] + p["fc1_b"], approximate=False)
+            return h + m @ p["fc2"] + p["fc2_b"]
+
+        for i in range(params["wq"].shape[0]):
+            h = layer(h, i)
+        return _ln(h, params["ln_f"], params["ln_f_b"])
+
+    def encode(self, input_features: np.ndarray) -> np.ndarray:
+        """[num_mel_bins, 2*frames] (or batched [1, ...]) -> [frames, H]
+        float32 numpy."""
+        mel = np.asarray(input_features, np.float32)
+        if mel.ndim == 3:
+            mel = mel[0]
+        out = self._jit(self.params, jnp.asarray(mel))
+        return np.asarray(jax.device_get(out), np.float32)
+
+
+def build_audio_encoder(model_path: str,
+                        hf_config) -> Optional[WhisperAudioEncoder]:
+    """Load the encoder half of a Whisper checkpoint (None when the
+    path is not a local checkpoint — dummy-weight runs)."""
+    import os
+    if not os.path.isdir(model_path):
+        return None
+    from vllm_distributed_tpu.models.loader import load_hf_state_dict
+    tensors = load_hf_state_dict(model_path,
+                                 prefixes=("model.encoder.", ))
+    if not any(k.startswith("model.encoder.") for k in tensors):
+        return None
+    logger.info("loaded whisper audio encoder (%d tensors)", len(tensors))
+    return WhisperAudioEncoder(tensors, hf_config)

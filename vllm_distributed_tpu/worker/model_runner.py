@@ -166,6 +166,10 @@ class TPUModelRunner:
         """Build the model and load weights per LoadConfig."""
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
+        if getattr(self.model, "CROSS_ATTENTION", False):
+            # install_cross_states projects through the loaded cross
+            # weights at admission time.
+            self.model.params_ref = self.params
         self._init_lora_manager()
         if self._draft_spec is not None:
             from vllm_distributed_tpu.spec_decode.draft_model import \
@@ -405,6 +409,16 @@ class TPUModelRunner:
             self.input_batch.remove_request(req_id)
         for new_req in scheduler_output.scheduled_new_reqs:
             row = self.input_batch.add_request(new_req)
+            if (getattr(self.model, "CROSS_ATTENTION", False)
+                    and new_req.mm_inputs):
+                # Encoder-decoder (whisper): project the audio
+                # encoder's hidden states into this request's
+                # cross-KV state row (offset=-1 payloads; reference:
+                # the cross-attn KV fill of models/whisper.py).
+                for inp in new_req.mm_inputs:
+                    if inp.offset < 0:
+                        self.kv_caches = self.model.install_cross_states(
+                            self.kv_caches, row, inp.embeds)
             if new_req.lora_request is not None:
                 if self.lora_manager is None:
                     raise ValueError(
@@ -671,7 +685,10 @@ class TPUModelRunner:
             # pre-step num_computed).
             for r in num_sched:
                 row = ib.req_id_to_index[r]
-                mm_list = ib.mm[row]
+                # offset < 0 marks cross-attention payloads (whisper
+                # audio), consumed at admission, never substituted.
+                mm_list = [inp for inp in (ib.mm[row] or ())
+                           if inp.offset >= 0]
                 if mm_list and ib.num_computed[row] < max(
                         inp.offset + inp.num_tokens for inp in mm_list):
                     return True
@@ -686,7 +703,7 @@ class TPUModelRunner:
                     continue
                 p = int(positions[ti])
                 for inp in mm_list:
-                    if inp.offset <= p < inp.offset + inp.num_tokens:
+                    if 0 <= inp.offset <= p < inp.offset + inp.num_tokens:
                         ov[ti] = inp.embeds[p - inp.offset]
                         mk[ti] = True
                         break
