@@ -205,7 +205,7 @@ class Processor:
         from vllm_distributed_tpu.multimodal import MultiModalInput
         hf = self.config.model_config.maybe_load_hf_config()
         cls = resolve_architecture(hf)
-        if not getattr(cls, "CROSS_ATTENTION", False):
+        if getattr(cls, "CROSS_MODALITY", None) != "audio":
             raise ValueError(
                 "audio inputs need an encoder-decoder (Whisper-family) "
                 "model")
@@ -239,6 +239,45 @@ class Processor:
         hidden = self._audio_encoder.encode(feats)
         return [MultiModalInput(embeds=hidden, offset=-1)], \
             prompt_token_ids
+
+    def _process_encoder_text(self, multi_modal_data: dict,
+                              prompt_token_ids: list[int]):
+        """Encoder-decoder TEXT (BART-family): run the front-end text
+        encoder at admission; hidden states ride the request like audio
+        (offset=-1 cross-attention payload). Reference: the
+        encoder_prompt path of the reference's encoder-decoder serving
+        (models/bart.py)."""
+        from vllm_distributed_tpu.models.registry import \
+            resolve_architecture
+        from vllm_distributed_tpu.multimodal import MultiModalInput
+        hf = self.config.model_config.maybe_load_hf_config()
+        cls = resolve_architecture(hf)
+        if getattr(cls, "CROSS_MODALITY", None) != "text":
+            raise ValueError(
+                "encoder inputs need an encoder-decoder (BART-family) "
+                "model")
+        if "encoder_input_ids" in multi_modal_data:
+            ids = [int(t) for t in multi_modal_data["encoder_input_ids"]]
+        else:
+            assert self.tokenizer is not None, \
+                "encoder_text requires a tokenizer"
+            ids = self.tokenizer.encode(multi_modal_data["encoder_text"])
+        if not ids:
+            raise ValueError("empty encoder input")
+        if self._text_encoder is None:
+            from vllm_distributed_tpu.multimodal.text_encoder import \
+                build_text_encoder
+            self._text_encoder = build_text_encoder(
+                self.config.model_config.model, hf)
+            if self._text_encoder is None:
+                raise ValueError(
+                    "encoder inputs need a local BART checkpoint "
+                    "(the front-end encoder loads model.encoder.*)")
+        hidden = self._text_encoder.encode(ids)
+        return [MultiModalInput(embeds=hidden, offset=-1)], \
+            prompt_token_ids
+
+    _text_encoder = None
 
     def _extract_audio_features(self, audio) -> "np.ndarray":
         """Raw waveform -> log-mel features via the checkpoint's
@@ -299,6 +338,10 @@ class Processor:
                 or "input_features" in multi_modal_data):
             return self._process_audio(multi_modal_data,
                                        prompt_token_ids)
+        if ("encoder_input_ids" in multi_modal_data
+                or "encoder_text" in multi_modal_data):
+            return self._process_encoder_text(multi_modal_data,
+                                              prompt_token_ids)
         unknown = set(multi_modal_data) - {"image_embeds", "pixel_values"}
         if unknown:
             raise ValueError(

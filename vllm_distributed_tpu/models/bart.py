@@ -1,0 +1,167 @@
+"""BART encoder-decoder text generation (summarization/translation).
+
+Reference surface: vllm/model_executor/models/bart.py
+(BartForConditionalGeneration: the reference's encoder-decoder TEXT
+family, registry.py:129). Rides the Whisper cross-attention machinery
+(models/whisper.py): the text encoder runs front-end-side at admission
+(multimodal/text_encoder.py) and its hidden states install into the
+per-request cross-KV state rows with a valid-length mask (BART sources
+vary, unlike Whisper's fixed audio frames). Structural deltas from
+Whisper: POST-norm blocks, learned positions written from offset 2,
+an embedding LayerNorm, k-projection biases, no final decoder norm,
+and a final_logits_bias on the tied LM head.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.llama import MODEL_AXIS
+from vllm_distributed_tpu.models.whisper import \
+    WhisperForConditionalGeneration
+
+
+def _with_model_prefix(tensors: dict) -> dict:
+    """``BartModel`` checkpoints store unprefixed keys (shared.weight,
+    decoder.layers...); normalize onto the ForConditionalGeneration
+    ``model.`` layout both loaders expect."""
+    if any(k.startswith("model.") for k in tensors):
+        return tensors
+    return {("model." + k if not k.startswith(("final_logits_bias",
+                                               "lm_head")) else k): v
+            for k, v in tensors.items()}
+
+
+class BartForConditionalGeneration(WhisperForConditionalGeneration):
+
+    LM_HEAD_BIAS = True  # final_logits_bias
+    CROSS_MODALITY = "text"
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.d_model,
+            intermediate_size=hf.decoder_ffn_dim,
+            num_hidden_layers=hf.decoder_layers,
+            num_attention_heads=hf.decoder_attention_heads,
+            num_key_value_heads=hf.decoder_attention_heads,
+            head_dim=hf.d_model // hf.decoder_attention_heads,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        import math
+        arch.stateful = True
+        arch.pos_embedding = "learned"
+        # HF's learned table physically holds offset + max positions.
+        arch.pos_offset = 2
+        arch.max_position_embeddings = int(hf.max_position_embeddings) + 2
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_out_bias = True
+        arch.pre_norm = False           # BART is post-norm
+        arch.final_norm = False         # no final decoder LayerNorm
+        arch.embed_ln = True            # layernorm_embedding
+        arch.hidden_act = getattr(hf, "activation_function", "gelu")
+        arch.embed_scale = (math.sqrt(hf.d_model)
+                            if getattr(hf, "scale_embedding", False)
+                            else 1.0)
+        arch.tie_word_embeddings = True
+        # Cross state holds up to the encoder's position capacity.
+        arch.num_audio_frames = int(hf.max_position_embeddings)
+        if not hasattr(arch, "state_slots"):
+            arch.state_slots = 0
+
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        colb = P(None, MODEL_AXIS)
+        specs["layers"]["bk"] = colb
+        specs["layers"]["cbk"] = colb
+        specs["embed_ln_w"] = P(None)
+        specs["embed_ln_b"] = P(None)
+        specs["lm_head_b"] = P(MODEL_AXIS)
+        del specs["final_ln"], specs["final_ln_b"]
+        return specs
+
+    def init_params(self, rng: jax.Array, scale: float = 0.02) -> dict:
+        c = self.cfg
+        params = super().init_params(rng, scale)
+        L, H = c.num_layers, c.hidden_size
+        params["layers"]["bk"] = jnp.zeros((L, H), c.dtype)
+        params["layers"]["cbk"] = jnp.zeros((L, H), c.dtype)
+        params["embed_ln_w"] = jnp.ones((H, ), c.dtype)
+        params["embed_ln_b"] = jnp.zeros((H, ), c.dtype)
+        params["lm_head_b"] = jnp.zeros((c.vocab_size, ), c.dtype)
+        del params["final_ln"], params["final_ln_b"]
+        return params
+
+    def params_from_hf_state_dict(self, tensors, dtype=None) -> dict:
+        c = self.cfg
+        dt = dtype or c.dtype
+        L = c.num_layers
+        tensors = _with_model_prefix(tensors)
+
+        def t(name):
+            return np.asarray(tensors[name])
+
+        def stack(fmt, transpose=True):
+            mats = [t(fmt.format(i)) for i in range(L)]
+            return jnp.asarray(
+                np.stack([m.T if transpose else m for m in mats]), dt)
+
+        D = "model.decoder.layers.{}."
+        layer = {
+            "ln1": stack(D + "self_attn_layer_norm.weight", False),
+            "ln1_b": stack(D + "self_attn_layer_norm.bias", False),
+            "wq": stack(D + "self_attn.q_proj.weight"),
+            "bq": stack(D + "self_attn.q_proj.bias", False),
+            "wk": stack(D + "self_attn.k_proj.weight"),
+            "bk": stack(D + "self_attn.k_proj.bias", False),
+            "wv": stack(D + "self_attn.v_proj.weight"),
+            "bv": stack(D + "self_attn.v_proj.bias", False),
+            "wo": stack(D + "self_attn.out_proj.weight"),
+            "bo": stack(D + "self_attn.out_proj.bias", False),
+            "ln2": stack(D + "encoder_attn_layer_norm.weight", False),
+            "ln2_b": stack(D + "encoder_attn_layer_norm.bias", False),
+            "cwq": stack(D + "encoder_attn.q_proj.weight"),
+            "cbq": stack(D + "encoder_attn.q_proj.bias", False),
+            "cwk": stack(D + "encoder_attn.k_proj.weight"),
+            "cbk": stack(D + "encoder_attn.k_proj.bias", False),
+            "cwv": stack(D + "encoder_attn.v_proj.weight"),
+            "cbv": stack(D + "encoder_attn.v_proj.bias", False),
+            "cwo": stack(D + "encoder_attn.out_proj.weight"),
+            "cbo": stack(D + "encoder_attn.out_proj.bias", False),
+            "ln3": stack(D + "final_layer_norm.weight", False),
+            "ln3_b": stack(D + "final_layer_norm.bias", False),
+            "fc1": stack(D + "fc1.weight"),
+            "fc1_b": stack(D + "fc1.bias", False),
+            "fc2": stack(D + "fc2.weight"),
+            "fc2_b": stack(D + "fc2.bias", False),
+        }
+        embed = jnp.asarray(t("model.shared.weight"), dt)
+        flb = tensors.get("final_logits_bias")
+        return {
+            "embed": embed,
+            "embed_pos": jnp.asarray(
+                t("model.decoder.embed_positions.weight"), dt),
+            "embed_ln_w": jnp.asarray(
+                t("model.decoder.layernorm_embedding.weight"), dt),
+            "embed_ln_b": jnp.asarray(
+                t("model.decoder.layernorm_embedding.bias"), dt),
+            "layers": layer,
+            "lm_head": embed.T,
+            "lm_head_b": jnp.asarray(
+                np.asarray(flb).reshape(-1) if flb is not None
+                else np.zeros((c.vocab_size, ), np.float32), dt),
+        }

@@ -182,6 +182,10 @@ class LlamaArchConfig:
     # ALiBi attention bias (Bloom/MPT; usually with pos_embedding =
     # "none"): slope * (kv_pos - q_pos) added per head before masking.
     alibi: bool = False
+    # Apply the final norm before the LM head (False for post-norm
+    # encoder-decoder stacks like BART, whose last sublayer already
+    # normalized).
+    final_norm: bool = True
     # LayerNorm directly after the embedding lookup (Bloom's
     # word_embeddings_layernorm).
     embed_ln: bool = False
@@ -1164,8 +1168,11 @@ class LlamaForCausalLM:
     def compute_logits(self, params: dict,
                        hidden: jax.Array) -> jax.Array:
         """Final norm + LM head on selected rows; fp32 logits."""
-        x = self._norm(hidden, params["final_ln"],
-                       params.get("final_ln_b"))
+        if self.cfg.final_norm:
+            x = self._norm(hidden, params["final_ln"],
+                           params.get("final_ln_b"))
+        else:
+            x = hidden
         logits = jnp.dot(x, params["lm_head"],
                          preferred_element_type=jnp.float32)
         if "lm_head_b" in params:

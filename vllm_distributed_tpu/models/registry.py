@@ -46,6 +46,7 @@ from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
                                               RobertaEmbeddingModel,
                                               RobertaForSequenceClassification)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.bart import BartForConditionalGeneration
 from vllm_distributed_tpu.models.whisper import \
     WhisperForConditionalGeneration
 from vllm_distributed_tpu.models.bamba import BambaForCausalLM
@@ -119,6 +120,9 @@ _REGISTRY: dict[str, type] = {
     # Encoder-decoder audio (cross-attention state rows;
     # models/whisper.py + multimodal/audio.py).
     "WhisperForConditionalGeneration": WhisperForConditionalGeneration,
+    # Encoder-decoder text (models/bart.py + multimodal/text_encoder.py).
+    "BartForConditionalGeneration": BartForConditionalGeneration,
+    "BartModel": BartForConditionalGeneration,
     # Encoder-only embedding + cross-encoder families (models/bert.py;
     # reference: the _EMBEDDING_MODELS / _CROSS_ENCODER_MODELS maps of
     # model_executor/models/registry.py).

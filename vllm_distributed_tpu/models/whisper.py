@@ -43,6 +43,7 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
 
     STATEFUL = True        # fixed per-request rows; no prefix caching
     CROSS_ATTENTION = True
+    CROSS_MODALITY = "audio"
     QUANT_TARGETS = ()
     LORA_TARGETS = ()
 
@@ -223,6 +224,7 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
             "v": P(None, TOKEN_AXIS, MODEL_AXIS, None, None),
             "xk": P(None, None, None, MODEL_AXIS, None),
             "xv": P(None, None, None, MODEL_AXIS, None),
+            "xlen": P(None),
         }
 
     def _cross_shapes(self) -> dict:
@@ -230,7 +232,10 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
         S = (c.state_slots or 256) + 1  # +1 dump row
         shape = (c.num_layers, S, c.num_audio_frames, c.num_q_heads,
                  c.head_dim)
-        return {"xk": (shape, c.dtype), "xv": (shape, c.dtype)}
+        return {"xk": (shape, c.dtype), "xv": (shape, c.dtype),
+                # Valid source length per slot (whisper audio is always
+                # full-frame; BART text varies).
+                "xlen": ((S, ), jnp.int32)}
 
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None,
@@ -264,24 +269,39 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
                 # h [F, H] -> k/v [L, F, NH, D]
                 c = self.cfg
                 k = jnp.einsum("fh,lhd->lfd", h, layers["cwk"])
-                v = jnp.einsum("fh,lhd->lfd", h,
-                               layers["cwv"]) + layers["cbv"][:, None, :]
+                if "cbk" in layers:
+                    k = k + layers["cbk"][:, None, :]
+                v = jnp.einsum("fh,lhd->lfd", h, layers["cwv"])
+                if "cbv" in layers:
+                    v = v + layers["cbv"][:, None, :]
                 L, F = k.shape[0], k.shape[1]
                 return (k.reshape(L, F, c.num_q_heads, c.head_dim),
                         v.reshape(L, F, c.num_q_heads, c.head_dim))
 
-            def scatter(xk, xv, k, v, slot):
+            def scatter(xk, xv, xlen, k, v, n, slot):
                 return (xk.at[:, slot].set(k.astype(xk.dtype)),
-                        xv.at[:, slot].set(v.astype(xv.dtype)))
+                        xv.at[:, slot].set(v.astype(xv.dtype)),
+                        xlen.at[slot].set(n))
 
             self._install_fn = (jax.jit(project),
-                                jax.jit(scatter, donate_argnums=(0, 1)))
+                                jax.jit(scatter,
+                                        donate_argnums=(0, 1, 2)))
         project, scatter = self._install_fn
-        h = jnp.asarray(np.asarray(enc_hidden), self.cfg.dtype)
-        k, v = project(self.params_ref["layers"], h)
-        kv_caches["xk"], kv_caches["xv"] = scatter(
-            kv_caches["xk"], kv_caches["xv"], k, v,
-            jnp.asarray(slot, jnp.int32))
+        h = np.asarray(enc_hidden)
+        n = h.shape[0]
+        F = self.cfg.num_audio_frames
+        if n > F:
+            raise ValueError(
+                f"encoder output has {n} frames; this model's "
+                f"cross-attention state holds {F}")
+        if n < F:  # variable-length sources (BART text) pad; the
+            h = np.concatenate(  # xlen mask hides the padding
+                [h, np.zeros((F - n, h.shape[1]), h.dtype)])
+        k, v = project(self.params_ref["layers"],
+                       jnp.asarray(h, self.cfg.dtype))
+        kv_caches["xk"], kv_caches["xv"], kv_caches["xlen"] = scatter(
+            kv_caches["xk"], kv_caches["xv"], kv_caches["xlen"], k, v,
+            jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32))
         return kv_caches
 
     _install_fn = None
@@ -307,35 +327,52 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
         h = hidden
         k_all, v_all = kv_caches["k"], kv_caches["v"]
         xk_all, xv_all = kv_caches["xk"], kv_caches["xv"]
+        xlen = kv_caches["xlen"][slots]  # [T] valid source frames
+        F = xk_all.shape[2]
+        frame_valid = (jnp.arange(F, dtype=jnp.int32)[None, :]
+                       < xlen[:, None])  # [T, F]
+        pre = c.pre_norm  # whisper pre-LN; BART post-LN
+
         for i in range(c.num_layers):
             lp = {k: v[i] for k, v in layer_params.items()}
             li = jnp.full((1, ), i, jnp.int32)
             # Self-attention (causal, paged, no rope).
-            x = ln(h, lp["ln1"], lp["ln1_b"])
+            x = ln(h, lp["ln1"], lp["ln1_b"]) if pre else h
             q = (x @ lp["wq"] + lp["bq"]).reshape(T, c.num_q_heads,
                                                   c.head_dim)
-            k = (x @ lp["wk"]).reshape(T, c.total_kv_heads, c.head_dim)
+            k = x @ lp["wk"]
+            if "bk" in lp:
+                k = k + lp["bk"]
+            k = k.reshape(T, c.total_kv_heads, c.head_dim)
             v = (x @ lp["wv"] + lp["bv"]).reshape(T, c.total_kv_heads,
                                                   c.head_dim)
             k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch, li)
             attn = paged_attention(q, k_all, v_all, batch,
                                    sm_scale=sm_scale, layer=li)
             h = h + attn.reshape(T, -1) @ lp["wo"] + lp["bo"]
-            # Cross-attention over the request's encoder-state row
-            # (every frame valid: audio pads to the model's static
-            # frame count).
-            x = ln(h, lp["ln2"], lp["ln2_b"])
+            if not pre:
+                h = ln(h, lp["ln1"], lp["ln1_b"])
+            # Cross-attention over the request's encoder-state row;
+            # frames past xlen are masked (whisper audio is always
+            # full-frame, BART text varies).
+            x = ln(h, lp["ln2"], lp["ln2_b"]) if pre else h
             q = ((x @ lp["cwq"] + lp["cbq"]) * sm_scale).reshape(
                 T, c.num_q_heads, c.head_dim)
             xk = xk_all[i][slots]  # [T, F, NH, D]
             xv = xv_all[i][slots]
             scores = jnp.einsum("tnd,tfnd->tnf", q.astype(jnp.float32),
                                 xk.astype(jnp.float32))
+            scores = jnp.where(frame_valid[:, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             ctx = jnp.einsum("tnf,tfnd->tnd", probs.astype(h.dtype), xv)
             h = h + ctx.reshape(T, -1) @ lp["cwo"] + lp["cbo"]
+            if not pre:
+                h = ln(h, lp["ln2"], lp["ln2_b"])
             # MLP.
-            x = ln(h, lp["ln3"], lp["ln3_b"])
+            x = ln(h, lp["ln3"], lp["ln3_b"]) if pre else h
             m = self._act(x @ lp["fc1"] + lp["fc1_b"])
             h = h + m @ lp["fc2"] + lp["fc2_b"]
-        return h, {"k": k_all, "v": v_all, "xk": xk_all, "xv": xv_all}
+            if not pre:
+                h = ln(h, lp["ln3"], lp["ln3_b"])
+        return h, {"k": k_all, "v": v_all, "xk": xk_all, "xv": xv_all,
+                   "xlen": kv_caches["xlen"]}

@@ -1,0 +1,153 @@
+"""BART text encoder, run front-end-side at admission.
+
+Reference: the encoder half of vllm/model_executor/models/bart.py
+(BartEncoder: learned offset-2 positions, embedding LayerNorm,
+post-norm bidirectional blocks). Placement mirrors the Whisper audio
+encoder (multimodal/audio.py): the source text encodes ONCE at
+admission and the [src, d_model] hidden states install into the
+decoder's cross-KV state rows."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.multimodal.audio import _ln
+
+logger = init_logger(__name__)
+
+
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+class BartTextEncoder:
+    """Functional JAX BART encoder from an HF checkpoint."""
+
+    def __init__(self, tensors: dict, hf_config) -> None:
+        self.heads = hf_config.encoder_attention_heads
+        self.hidden = hf_config.d_model
+        self.head_dim = self.hidden // self.heads
+        self.max_src = int(hf_config.max_position_embeddings)
+        import math
+        self.scale = (math.sqrt(self.hidden)
+                      if getattr(hf_config, "scale_embedding", False)
+                      else 1.0)
+        act = getattr(hf_config, "activation_function", "gelu")
+        if act not in _ACTS:
+            # Silent substitution would yield wrong encoder states.
+            raise ValueError(
+                f"unsupported encoder activation {act!r}")
+        self.act = act
+        self.params = self._load(tensors, hf_config.encoder_layers)
+        self._jit = jax.jit(self._forward)
+
+    def _load(self, tensors: dict, L: int) -> dict:
+        E = "model.encoder."
+
+        def t(name):
+            return np.asarray(tensors[name])
+
+        def stack(fmt, transpose=True):
+            mats = [t(fmt.format(i)) for i in range(L)]
+            return jnp.asarray(
+                np.stack([m.T if transpose else m for m in mats]),
+                jnp.float32)
+
+        lay = "layers.{}."
+        return {
+            "embed": jnp.asarray(np.asarray(
+                tensors["model.shared.weight"]), jnp.float32),
+            "pos": jnp.asarray(t(E + "embed_positions.weight"),
+                               jnp.float32),
+            "emb_ln": jnp.asarray(t(E + "layernorm_embedding.weight"),
+                                  jnp.float32),
+            "emb_ln_b": jnp.asarray(t(E + "layernorm_embedding.bias"),
+                                    jnp.float32),
+            "ln1": stack(E + lay + "self_attn_layer_norm.weight", False),
+            "ln1_b": stack(E + lay + "self_attn_layer_norm.bias", False),
+            "wq": stack(E + lay + "self_attn.q_proj.weight"),
+            "bq": stack(E + lay + "self_attn.q_proj.bias", False),
+            "wk": stack(E + lay + "self_attn.k_proj.weight"),
+            "bk": stack(E + lay + "self_attn.k_proj.bias", False),
+            "wv": stack(E + lay + "self_attn.v_proj.weight"),
+            "bv": stack(E + lay + "self_attn.v_proj.bias", False),
+            "wo": stack(E + lay + "self_attn.out_proj.weight"),
+            "bo": stack(E + lay + "self_attn.out_proj.bias", False),
+            "ln2": stack(E + lay + "final_layer_norm.weight", False),
+            "ln2_b": stack(E + lay + "final_layer_norm.bias", False),
+            "fc1": stack(E + lay + "fc1.weight"),
+            "fc1_b": stack(E + lay + "fc1.bias", False),
+            "fc2": stack(E + lay + "fc2.weight"),
+            "fc2_b": stack(E + lay + "fc2.bias", False),
+        }
+
+    def _forward(self, params: dict, ids: jax.Array,
+                 n: jax.Array) -> jax.Array:
+        """ids padded to a length bucket; ``n`` = valid tokens (padding
+        keys are masked out of the bidirectional attention so results
+        are exact while the jit keys only on the bucket)."""
+        F = ids.shape[0]
+        valid = jnp.arange(F, dtype=jnp.int32) < n
+        h = params["embed"][ids] * self.scale
+        h = h + params["pos"][2 + jnp.arange(F)]  # offset-2 table
+        h = _ln(h, params["emb_ln"], params["emb_ln_b"])
+        nh, hd = self.heads, self.head_dim
+        scale = hd ** -0.5
+        _KEYS = ("ln1", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv",
+                 "wo", "bo", "ln2", "ln2_b", "fc1", "fc1_b", "fc2",
+                 "fc2_b")
+        act = _ACTS[self.act]
+        kmask = jnp.where(valid, 0.0, -1e30)[None, None, :]
+
+        for i in range(params["wq"].shape[0]):
+            p = {k: params[k][i] for k in _KEYS}
+            q = ((h @ p["wq"] + p["bq"]) * scale).reshape(F, nh, hd)
+            k = (h @ p["wk"] + p["bk"]).reshape(F, nh, hd)
+            v = (h @ p["wv"] + p["bv"]).reshape(F, nh, hd)
+            s = jnp.einsum("ind,jnd->nij", q, k) + kmask
+            a = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("nij,jnd->ind", a, v).reshape(F, -1)
+            h = _ln(h + ctx @ p["wo"] + p["bo"], p["ln1"], p["ln1_b"])
+            m = act(h @ p["fc1"] + p["fc1_b"])
+            h = _ln(h + m @ p["fc2"] + p["fc2_b"], p["ln2"], p["ln2_b"])
+        return h
+
+    def encode(self, input_ids) -> np.ndarray:
+        from vllm_distributed_tpu.utils import make_buckets, \
+            pad_to_bucket
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        n = ids.shape[0]
+        if n > self.max_src:
+            raise ValueError(
+                f"encoder input has {n} tokens; the model's "
+                f"source capacity is {self.max_src}")
+        Fb = pad_to_bucket(n, make_buckets(16, self.max_src))
+        padded = np.zeros((Fb, ), np.int32)
+        padded[:n] = ids
+        out = self._jit(self.params, jnp.asarray(padded),
+                        jnp.asarray(n, jnp.int32))
+        return np.asarray(jax.device_get(out), np.float32)[:n]
+
+
+def build_text_encoder(model_path: str,
+                       hf_config) -> Optional[BartTextEncoder]:
+    import os
+    if not os.path.isdir(model_path):
+        return None
+    from vllm_distributed_tpu.models.bart import _with_model_prefix
+    from vllm_distributed_tpu.models.loader import load_hf_state_dict
+    tensors = _with_model_prefix(load_hf_state_dict(
+        model_path, prefixes=("model.encoder.", "model.shared.",
+                              "encoder.", "shared.")))
+    if not any(k.startswith("model.encoder.") for k in tensors):
+        return None
+    logger.info("loaded bart text encoder (%d tensors)", len(tensors))
+    return BartTextEncoder(tensors, hf_config)

@@ -261,24 +261,17 @@ class TPUModelRunner:
         assert getattr(self, "_sleeping", False), "not sleeping"
         from jax.sharding import NamedSharding
         if self._host_params is not None:
+            # Walk the saved tree generically — families carry extra
+            # top-level keys (embed_pos, embed_ln, encoder heads) and
+            # some drop final_ln (post-norm BART).
             specs = self.model.param_specs()
-            flat_specs = {
-                "embed": specs["embed"],
-                "final_ln": specs["final_ln"],
-                "lm_head": specs["lm_head"],
-            }
-            self.params = {
-                "layers": {
-                    k: jax.device_put(
-                        v, NamedSharding(self.mesh, specs["layers"][k]))
-                    for k, v in self._host_params["layers"].items()
-                },
-                **{
-                    k: jax.device_put(self._host_params[k],
-                                      NamedSharding(self.mesh, s))
-                    for k, s in flat_specs.items()
-                },
-            }
+
+            def place(p, s):
+                if isinstance(p, dict):
+                    return {k: place(v, s[k]) for k, v in p.items()}
+                return jax.device_put(p, NamedSharding(self.mesh, s))
+
+            self.params = place(self._host_params, specs)
             self._host_params = None
         else:
             from vllm_distributed_tpu.models.loader import get_model
@@ -289,6 +282,8 @@ class TPUModelRunner:
                 # "resolve" to zeroed slots and silently serve the base
                 # model. Safe: sleep requires an idle engine.
                 self._init_lora_manager()
+        if getattr(self.model, "CROSS_ATTENTION", False):
+            self.model.params_ref = self.params  # old arrays deleted
         self.kv_caches = self._make_sharded_caches(self.num_pages)
         self._sleeping = False
         logger.info("awake: weights restored, KV cache reset")
