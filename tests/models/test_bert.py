@@ -279,3 +279,14 @@ def test_cross_encoder_e2e_score_matches_hf(cross_encoder_ckpt):
     assert len(embs[0]) == 1
     np.testing.assert_allclose(embs[0][0], out.logits.numpy()[0, 0],
                                atol=5e-4, rtol=5e-3)
+
+
+def test_encoder_e2e_tp2_matches_single_device(bert_ckpt):
+    """GSPMD TP over the dense encoder: head/ffn sharding must not
+    change the pooled embeddings."""
+    path, _ = bert_ckpt
+    single = _make_engine(path)
+    tp2 = _make_engine(path, tensor_parallel_size=2)
+    e1 = _run_pooling(single, [PROMPTS[0]], [{"type": "cls"}])[0]
+    e2 = _run_pooling(tp2, [PROMPTS[0]], [{"type": "cls"}])[0]
+    np.testing.assert_allclose(e1, e2, atol=1e-5, rtol=1e-5)
