@@ -115,3 +115,57 @@ def test_chat_and_complete_clients(tmp_path, capsys):
     finally:
         holder["loop"].call_soon_threadsafe(holder["stop"].set)
         t.join(timeout=30)
+
+
+def test_bench_serve_against_live_server(tmp_path, capsys):
+    """`vdt bench serve` drives a running server over streaming HTTP
+    and reports TTFT/ITL percentiles (reference:
+    benchmarks/benchmark_serving.py fixed-QPS mode)."""
+    import asyncio
+    import threading
+
+    from tests.entrypoints.test_openai_server import \
+        _save_checkpoint_with_tokenizer
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.utils import get_open_port
+
+    path = str(tmp_path / "model")
+    _save_checkpoint_with_tokenizer(path)
+    engine = AsyncLLM(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8).create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["stop"], holder["loop"] = stop, loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready, stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120)
+    try:
+        rc = main(["bench", "serve", "--url",
+                   f"http://127.0.0.1:{port}/v1", "--model", path,
+                   "--num-prompts", "4", "--input-len", "8",
+                   "--output-len", "4", "--request-rate", "50",
+                   "--prompt-vocab", "120"])
+        assert rc == 0
+        result = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert result["completed"] == 4 and result["errors"] == 0
+        assert result["output_tokens"] == 16
+        assert result["ttft_ms"]["p50"] > 0
+        assert result["itl_ms"]["p50"] is not None
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=30)

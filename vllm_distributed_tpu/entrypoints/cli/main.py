@@ -24,12 +24,25 @@ def _add_serve(sub) -> None:
 
 
 def _add_bench(sub) -> None:
-    p = sub.add_parser("bench", help="offline latency/throughput benchmark")
-    p.add_argument("mode", choices=["latency", "throughput"])
+    p = sub.add_parser("bench", help="offline latency/throughput or "
+                                     "online serving benchmark")
+    p.add_argument("mode", choices=["latency", "throughput", "serve"])
     p.add_argument("--input-len", type=int, default=128)
     p.add_argument("--output-len", type=int, default=128)
     p.add_argument("--num-prompts", type=int, default=8)
     p.add_argument("--warmup", type=int, default=1)
+    # serve mode (reference: benchmarks/benchmark_serving.py — fixed-QPS
+    # Poisson arrivals against a RUNNING server, TTFT/ITL percentiles).
+    p.add_argument("--url", default="http://localhost:8000/v1",
+                   help="[serve] server base URL (with /v1)")
+    p.add_argument("--request-rate", type=float, default=4.0,
+                   help="[serve] Poisson arrival rate (QPS); 0 = all "
+                        "at once")
+    p.add_argument("--bench-seed", type=int, default=0)
+    p.add_argument("--prompt-vocab", type=int, default=1000,
+                   help="[serve] exclusive upper bound for random "
+                        "prompt token ids (set to the model's vocab "
+                        "for offline-comparable distributions)")
     EngineArgs.add_cli_args(p)
 
 
@@ -54,7 +67,11 @@ def cmd_serve(args) -> None:
 
 def cmd_bench(args) -> None:
     """reference: vllm/benchmarks/latency.py:36 / throughput.py via the
-    `vllm bench` CLI (entrypoints/cli/benchmark/)."""
+    `vllm bench` CLI (entrypoints/cli/benchmark/); serve mode =
+    benchmark_serving.py (Poisson arrivals over HTTP)."""
+    if args.mode == "serve":
+        # Pure HTTP client: no engine imports (runs from any box).
+        return cmd_bench_serve(args)
     import numpy as np
 
     from vllm_distributed_tpu.entrypoints.llm import LLM
@@ -88,6 +105,89 @@ def cmd_bench(args) -> None:
                                       3),
     }
     print(json.dumps(result))
+
+
+def cmd_bench_serve(args) -> None:
+    """Online serving benchmark against a RUNNING server: random-token
+    prompts arrive on a Poisson clock at --request-rate QPS; per-request
+    TTFT and inter-token latencies come from the streaming endpoint
+    (reference: benchmarks/benchmark_serving.py — the random dataset +
+    fixed-QPS mode of the nightly suite)."""
+    import asyncio
+    import numpy as np
+
+    async def one(session, url, prompt_ids, out_len, rec):
+        t0 = time.perf_counter()
+        ticks = []
+        try:
+            async with session.post(
+                    url.rstrip("/") + "/completions",
+                    json={"prompt": prompt_ids, "max_tokens": out_len,
+                          "temperature": 0.0, "ignore_eos": True,
+                          "stream": True}) as resp:
+                if resp.status != 200:
+                    rec["errors"] += 1
+                    return
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if (line.startswith("data: ")
+                            and line != "data: [DONE]"):
+                        ticks.append(time.perf_counter())
+        except Exception:  # noqa: BLE001 - count, keep benchmarking
+            rec["errors"] += 1
+            return
+        if not ticks:
+            rec["errors"] += 1
+            return
+        rec["ttft"].append(ticks[0] - t0)
+        rec["itl"].extend(b - a for a, b in zip(ticks, ticks[1:]))
+        rec["e2e"].append(ticks[-1] - t0)
+        rec["tokens"] += len(ticks)
+
+    async def run():
+        import aiohttp
+        rng = np.random.default_rng(args.bench_seed)
+        hi = max(args.prompt_vocab, 11)
+        prompts = [[int(x) for x in rng.integers(10, hi,
+                                                 size=args.input_len)]
+                   for _ in range(args.num_prompts)]
+        rec = {"ttft": [], "itl": [], "e2e": [], "tokens": 0,
+               "errors": 0}
+        t0 = time.perf_counter()
+        async with aiohttp.ClientSession() as session:
+            tasks = []
+            for p in prompts:
+                tasks.append(asyncio.create_task(
+                    one(session, args.url, p, args.output_len, rec)))
+                if args.request_rate > 0:
+                    await asyncio.sleep(
+                        rng.exponential(1.0 / args.request_rate))
+            await asyncio.gather(*tasks)
+        rec["wall"] = time.perf_counter() - t0
+        return rec
+
+    rec = asyncio.run(run())
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 2) if xs else None
+
+    print(json.dumps({
+        "mode": "serve",
+        "num_prompts": args.num_prompts,
+        "request_rate_qps": args.request_rate,
+        "completed": len(rec["e2e"]),
+        "errors": rec["errors"],
+        "output_tokens": rec["tokens"],
+        "throughput_tok_s": round(rec["tokens"] / rec["wall"], 2),
+        "ttft_ms": {"p50": pct(rec["ttft"], 50),
+                    "p90": pct(rec["ttft"], 90),
+                    "p99": pct(rec["ttft"], 99)},
+        "itl_ms": {"p50": pct(rec["itl"], 50),
+                   "p90": pct(rec["itl"], 90),
+                   "p99": pct(rec["itl"], 99)},
+        "e2e_ms": {"p50": pct(rec["e2e"], 50),
+                   "p99": pct(rec["e2e"], 99)},
+    }))
 
 
 def _add_run_batch(sub) -> None:
