@@ -117,22 +117,37 @@ def cmd_bench_serve(args) -> None:
     import numpy as np
 
     async def one(session, url, prompt_ids, out_len, rec):
+        body = {"prompt": prompt_ids, "max_tokens": out_len,
+                "temperature": 0.0, "ignore_eos": True, "stream": True}
+        if getattr(args, "model", None):
+            # OpenAI-compatible servers require it; also selects a
+            # served LoRA adapter.
+            body["model"] = args.model
         t0 = time.perf_counter()
         ticks = []
+        n_tokens = 0
         try:
-            async with session.post(
-                    url.rstrip("/") + "/completions",
-                    json={"prompt": prompt_ids, "max_tokens": out_len,
-                          "temperature": 0.0, "ignore_eos": True,
-                          "stream": True}) as resp:
+            async with session.post(url.rstrip("/") + "/completions",
+                                    json=body) as resp:
                 if resp.status != 200:
                     rec["errors"] += 1
                     return
                 async for raw in resp.content:
                     line = raw.decode().strip()
-                    if (line.startswith("data: ")
-                            and line != "data: [DONE]"):
+                    if (not line.startswith("data: ")
+                            or line == "data: [DONE]"):
+                        continue
+                    # Count only chunks carrying text (final
+                    # finish_reason-only chunks and coalesced deltas
+                    # would otherwise skew tokens/ITL).
+                    try:
+                        chunk = json.loads(line[len("data: "):])
+                        text = chunk["choices"][0].get("text", "")
+                    except Exception:  # noqa: BLE001
+                        text = ""
+                    if text:
                         ticks.append(time.perf_counter())
+                        n_tokens += 1
         except Exception:  # noqa: BLE001 - count, keep benchmarking
             rec["errors"] += 1
             return
@@ -142,7 +157,7 @@ def cmd_bench_serve(args) -> None:
         rec["ttft"].append(ticks[0] - t0)
         rec["itl"].extend(b - a for a, b in zip(ticks, ticks[1:]))
         rec["e2e"].append(ticks[-1] - t0)
-        rec["tokens"] += len(ticks)
+        rec["tokens"] += n_tokens
 
     async def run():
         import aiohttp
@@ -154,7 +169,10 @@ def cmd_bench_serve(args) -> None:
         rec = {"ttft": [], "itl": [], "e2e": [], "tokens": 0,
                "errors": 0}
         t0 = time.perf_counter()
-        async with aiohttp.ClientSession() as session:
+        # Generous timeout: the benchmark exists to MEASURE the slow
+        # tail, not to drop it (the reference sets multi-hour limits).
+        timeout = aiohttp.ClientTimeout(total=6 * 3600)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
             tasks = []
             for p in prompts:
                 tasks.append(asyncio.create_task(

@@ -98,6 +98,8 @@ def _structured_from_request(body: dict) -> Optional[dict]:
     as extra body fields."""
     if body.get("guided_regex") is not None:
         return {"regex": str(body["guided_regex"])}
+    if body.get("guided_grammar") is not None:
+        return {"grammar": str(body["guided_grammar"])}
     if body.get("guided_choice") is not None:
         return {"choice": [str(c) for c in body["guided_choice"]]}
     if body.get("guided_json") is not None:

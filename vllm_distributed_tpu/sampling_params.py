@@ -111,11 +111,13 @@ class SamplingParams:
                     "entries")
         if self.structured is not None:
             keys = set(self.structured) & {"regex", "choice", "json",
+                                           "grammar",
                                            "json_object"}
             if len(keys) != 1:
                 raise ValueError(
                     "structured needs exactly one of regex / choice / "
-                    f"json / json_object, got {sorted(self.structured)}")
+                    "json / grammar / json_object, got "
+                    f"{sorted(self.structured)}")
         if self.allowed_token_ids is not None:
             if not self.allowed_token_ids:
                 raise ValueError("allowed_token_ids must be non-empty")

@@ -1,0 +1,194 @@
+"""Non-recursive EBNF/Lark grammar -> regex, for guided_grammar.
+
+Reference surface: the ``guided_grammar`` option of GuidedDecodingParams
+(the reference delegates to xgrammar/outlines, which accept Lark-style
+EBNF). This slice compiles the NON-RECURSIVE subset onto the engine's
+own regex->DFA machinery (structured_output/fsm.py): every rule is
+inlined into its references, so any recursive rule (directly or through
+a cycle) is rejected honestly rather than approximated — matching this
+codebase's fail-fast convention for unsupported config space.
+
+Accepted syntax per rule line ``name : alternatives``:
+  "literal" / 'literal'     terminal strings (escaped into the regex)
+  /regex/                   inline regex terminal (passed through)
+  rule_name                 reference (inlined; must be non-recursive)
+  ( ... )                   grouping
+  [ ... ]                   optional group
+  x? x* x+                  the usual repetitions
+  a | b                     alternatives
+Comments (// ... or # ...) and blank lines are ignored. The start rule
+is ``start`` when present, else the first rule.
+"""
+
+from __future__ import annotations
+
+import re as _re
+
+
+class GrammarError(ValueError):
+    pass
+
+
+_RULE_RE = _re.compile(r"^\s*([a-zA-Z_][\w]*)\s*:\s*(.+)$")
+_TOKEN_RE = _re.compile(
+    r"\s*(\"(?:\\.|[^\"\\])*\""      # "literal"
+    r"|'(?:\\.|[^'\\])*'"            # 'literal'
+    r"|/(?:\\.|[^/\\])+/"            # /regex/
+    r"|[a-zA-Z_][\w]*"               # rule ref
+    r"|[()\[\]|?*+])")
+
+
+def _tokenize(body: str) -> list[str]:
+    out, i = [], 0
+    while i < len(body):
+        if body[i].isspace():
+            i += 1
+            continue
+        m = _TOKEN_RE.match(body, i)
+        if not m:
+            raise GrammarError(f"bad grammar syntax at {body[i:]!r}")
+        out.append(m.group(1))
+        i = m.end()
+    return out
+
+
+class _Parser:
+    """Recursive-descent over one rule body -> regex fragment (rule
+    references resolved through ``resolve``)."""
+
+    def __init__(self, tokens: list[str], resolve) -> None:
+        self.toks = tokens
+        self.i = 0
+        self.resolve = resolve
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def alternatives(self) -> str:
+        parts = [self.sequence()]
+        while self.peek() == "|":
+            self.next()
+            parts.append(self.sequence())
+        if len(parts) == 1:
+            return parts[0]
+        return "(" + "|".join(parts) + ")"
+
+    def sequence(self) -> str:
+        out = []
+        while self.peek() is not None and self.peek() not in ("|", ")",
+                                                              "]"):
+            out.append(self.atom())
+        return "".join(out)
+
+    def atom(self) -> str:
+        t = self.next()
+        if t == "(":
+            inner = self.alternatives()
+            if self.next() != ")":
+                raise GrammarError("unbalanced '('")
+            frag = "(" + inner + ")"
+        elif t == "[":
+            inner = self.alternatives()
+            if self.next() != "]":
+                raise GrammarError("unbalanced '['")
+            frag = "(" + inner + ")?"
+        elif t[0] in "\"'":
+            lit = _unescape(t[1:-1])
+            frag = _re.escape(lit)
+        elif t[0] == "/":
+            frag = "(" + t[1:-1] + ")"
+        elif _RULE_RE.match(t + " : x"):
+            frag = self.resolve(t)
+        else:
+            raise GrammarError(f"unexpected token {t!r}")
+        while self.peek() in ("?", "*", "+"):
+            frag = "(" + frag + ")" + self.next()
+        return frag
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing // comment, skipping quoted strings and /regex/
+    terminals (so "http://x" literals survive)."""
+    i, n = 0, len(line)
+    while i < n:
+        ch = line[i]
+        if ch in "\"'/":
+            if ch == "/" and i + 1 < n and line[i + 1] == "/":
+                return line[:i]
+            close = ch
+            i += 1
+            while i < n and line[i] != close:
+                i += 2 if line[i] == "\\" else 1
+            i += 1
+        else:
+            i += 1
+    return line
+
+
+def _unescape(s: str) -> str:
+    # Char-by-char so "\\n" (escaped backslash + n) never turns into a
+    # newline.
+    out, i = [], 0
+    table = {"n": "\n", "t": "\t", '"': '"', "'": "'", "\\": "\\"}
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(table.get(s[i + 1], s[i + 1]))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def ebnf_to_regex(grammar: str) -> str:
+    """Compile a non-recursive EBNF grammar to one regex."""
+    rules: dict[str, str] = {}
+    order: list[str] = []
+    for raw in grammar.splitlines():
+        line = _strip_comment(raw)
+        if line.lstrip().startswith("#"):
+            continue
+        if not line.strip():
+            continue
+        m = _RULE_RE.match(line)
+        if not m:
+            raise GrammarError(f"expected 'name : body', got {raw!r}")
+        name, body = m.group(1), m.group(2)
+        if name in rules:
+            raise GrammarError(f"duplicate rule {name!r}")
+        rules[name] = body
+        order.append(name)
+    if not rules:
+        raise GrammarError("empty grammar")
+
+    compiled: dict[str, str] = {}
+    in_progress: set[str] = set()
+
+    def resolve(name: str) -> str:
+        if name in compiled:
+            return compiled[name]
+        if name not in rules:
+            raise GrammarError(f"undefined rule {name!r}")
+        if name in in_progress:
+            raise GrammarError(
+                f"rule {name!r} is recursive; only non-recursive "
+                f"grammars compile onto the regex DFA (use a regex or "
+                f"json schema spec for unbounded nesting)")
+        in_progress.add(name)
+        parser = _Parser(_tokenize(rules[name]), resolve)
+        frag = parser.alternatives()
+        if parser.peek() is not None:
+            raise GrammarError(
+                f"trailing tokens in rule {name!r}: "
+                f"{parser.toks[parser.i:]}")
+        in_progress.discard(name)
+        compiled[name] = "(" + frag + ")"
+        return compiled[name]
+
+    start = "start" if "start" in rules else order[0]
+    return resolve(start)

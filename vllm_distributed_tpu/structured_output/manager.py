@@ -41,6 +41,10 @@ def spec_to_regex(spec: dict) -> str:
         return schema_to_regex(spec["json"])
     if spec.get("json_object"):
         return json_object_regex()
+    if "grammar" in spec:
+        from vllm_distributed_tpu.structured_output.ebnf import \
+            ebnf_to_regex
+        return ebnf_to_regex(spec["grammar"])
     raise ValueError(f"unsupported structured spec {spec!r}")
 
 
