@@ -287,3 +287,26 @@ def test_dbrx_matches_hf(tmp_path_factory):
     got = run_engine(path, PROMPTS, max_tokens=6)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_gpt_oss_matches_hf(tmp_path_factory):
+    """gpt-oss: attention sinks, alternating sliding/full layers,
+    biased router + clamped-GLU experts with interleaved gate_up
+    tensors (reference: models/gpt_oss.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.GptOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=8,
+        max_position_embeddings=64, head_dim=16, eos_token_id=1)
+    torch.manual_seed(13)
+    hf = transformers.GptOssForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_gptoss"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"

@@ -125,7 +125,6 @@ def cmd_bench_serve(args) -> None:
             body["model"] = args.model
         t0 = time.perf_counter()
         ticks = []
-        n_tokens = 0
         try:
             async with session.post(url.rstrip("/") + "/completions",
                                     json=body) as resp:
@@ -147,7 +146,6 @@ def cmd_bench_serve(args) -> None:
                         text = ""
                     if text:
                         ticks.append(time.perf_counter())
-                        n_tokens += 1
         except Exception:  # noqa: BLE001 - count, keep benchmarking
             rec["errors"] += 1
             return
@@ -157,7 +155,7 @@ def cmd_bench_serve(args) -> None:
         rec["ttft"].append(ticks[0] - t0)
         rec["itl"].extend(b - a for a, b in zip(ticks, ticks[1:]))
         rec["e2e"].append(ticks[-1] - t0)
-        rec["tokens"] += n_tokens
+        rec["tokens"] += len(ticks)
 
     async def run():
         import aiohttp

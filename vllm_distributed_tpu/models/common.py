@@ -213,7 +213,28 @@ def make_inv_freq(head_dim: int, rope_theta: float,
             jnp.where(wavelen < high_wavelen, inv_freq,
                       (1 - smooth) * inv_freq / factor + smooth * inv_freq))
         inv_freq = scaled
+    if rope_scaling and rtype == "yarn":
+        # NTK-by-parts scaling (gpt-oss, Qwen long-context checkpoints);
+        # the attention factor is applied by compute_rope_cos_sin.
+        orig = rope_scaling.get("original_max_position_embeddings")
+        if not orig:
+            raise ValueError(
+                "yarn rope_scaling needs original_max_position_"
+                "embeddings")
+        inv_freq, _ = yarn_inv_freq(head_dim, rope_theta, rope_scaling,
+                                    orig)
     return inv_freq
+
+
+def _rope_attention_factor(rope_scaling: dict | None) -> float:
+    """YaRN's mscale: multiplies cos/sin (reference: the
+    attention_scaling of modeling_rope_utils._compute_yarn_parameters).
+    Shares yarn_inv_freq's formula (yarn_attention_factor)."""
+    rtype = (rope_scaling or {}).get(
+        "rope_type", (rope_scaling or {}).get("type"))
+    if not rope_scaling or rtype != "yarn":
+        return 1.0
+    return yarn_attention_factor(rope_scaling)
 
 
 def compute_rope_cos_sin(positions: jax.Array, head_dim: int,
@@ -223,9 +244,11 @@ def compute_rope_cos_sin(positions: jax.Array, head_dim: int,
     """cos/sin tables for the given positions, HF-llama layout: inv_freq
     over even dims, duplicated across both halves of the head."""
     inv_freq = make_inv_freq(head_dim, rope_theta, rope_scaling)
+    att = _rope_attention_factor(rope_scaling)
     freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [T, D]
-    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+    return (jnp.cos(emb).astype(dtype) * att,
+            jnp.sin(emb).astype(dtype) * att)
 
 
 def _rotate_half(x: jax.Array) -> jax.Array:
@@ -254,6 +277,25 @@ def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
     return (gate * (x @ up_w)) @ down_w
 
 
+def yarn_attention_factor(scaling: dict) -> float:
+    """YaRN's attention (mscale) factor — the cos/sin multiplier
+    (reference: modeling_rope_utils._compute_yarn_parameters)."""
+    import math
+    factor = scaling["factor"]
+    af = scaling.get("attention_factor")
+    if af is not None:
+        return float(af)
+
+    def g(scale: float, m: float = 1.0) -> float:
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    mscale = scaling.get("mscale")
+    msd = scaling.get("mscale_all_dim")
+    if mscale and msd:
+        return float(g(factor, mscale) / g(factor, msd))
+    return float(g(factor))
+
+
 def yarn_inv_freq(head_dim: int, rope_theta: float, scaling: dict,
                   max_position_embeddings: int) -> tuple[jax.Array, float]:
     """YaRN NTK-by-parts inverse frequencies -> (inv_freq, attention
@@ -264,22 +306,9 @@ def yarn_inv_freq(head_dim: int, rope_theta: float, scaling: dict,
     cos/sin downstream."""
     import math
     factor = scaling["factor"]
-    attention_factor = scaling.get("attention_factor")
-    mscale = scaling.get("mscale")
-    mscale_all_dim = scaling.get("mscale_all_dim")
     orig = (scaling.get("original_max_position_embeddings")
             or max_position_embeddings)
-
-    def get_mscale(scale: float, ms: float = 1.0) -> float:
-        return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
-
-    if attention_factor is None:
-        if mscale and mscale_all_dim:
-            attention_factor = float(
-                get_mscale(factor, mscale) / get_mscale(factor,
-                                                        mscale_all_dim))
-        else:
-            attention_factor = get_mscale(factor)
+    attention_factor = yarn_attention_factor(scaling)
     beta_fast = scaling.get("beta_fast") or 32
     beta_slow = scaling.get("beta_slow") or 1
 

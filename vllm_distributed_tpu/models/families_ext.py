@@ -8,8 +8,10 @@ non-gated MLP, multipliers); these subclasses set the knobs and map the
 checkpoint tensor names onto the canonical layout."""
 
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
-from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
+                                               LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
 
@@ -199,6 +201,141 @@ class DbrxForCausalLM(MixtralForCausalLM):
                 # transpose.
                 alias[moe + f"experts.{e}.w2.weight"] = w2[rows].T
         return super().params_from_hf_state_dict(alias)
+
+
+class GptOssForCausalLM(MixtralForCausalLM):
+    """OpenAI gpt-oss: attention sinks, alternating sliding/full
+    layers, biased projections, MoE with interleaved gate_up expert
+    tensors, per-expert biases and the clamped (up+1)*glu activation
+    (reference: models/gpt_oss.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.num_experts = hf.num_local_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.norm_topk_prob = True  # topk-then-softmax == renormalized
+        arch.moe_intermediate_size = hf.intermediate_size
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.attn_sinks = True
+        arch.moe_bias = True
+        arch.router_bias = True
+        # Clamped-GLU activation constants (HF GptOssExperts).
+        arch.glu_alpha = 1.702
+        arch.glu_limit = float(getattr(hf, "swiglu_limit", 7.0))
+
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        layer = specs["layers"]
+        layer["sinks"] = P(None, MODEL_AXIS)
+        layer["router_b"] = P(None, None)
+        ax = layer["w_gate"]  # [L, E, H, I] spec; biases follow it
+        layer["b_gate"] = P(ax[0], ax[1], ax[3])
+        layer["b_up"] = P(ax[0], ax[1], ax[3])
+        layer["b_down"] = P(ax[0], ax[1], None)
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        import jax.numpy as jnp
+        params = super().init_params(rng, scale)
+        c = self.cfg
+        L, E = c.num_layers, c.num_experts
+        I = c.moe_intermediate_size
+        layers = params["layers"]
+        layers["sinks"] = jnp.zeros((L, c.num_q_heads), c.dtype)
+        layers["router_b"] = jnp.zeros((L, E), c.dtype)
+        layers["b_gate"] = jnp.zeros((L, E, I), c.dtype)
+        layers["b_up"] = jnp.zeros((L, E, I), c.dtype)
+        layers["b_down"] = jnp.zeros((L, E, c.hidden_size), c.dtype)
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        import jax.numpy as jnp
+        c = self.cfg
+        L, E = c.num_layers, c.num_experts
+        I = c.moe_intermediate_size
+        alias = dict(tensors)
+        gu = np.stack([np.asarray(
+            alias.pop(f"model.layers.{i}.mlp.experts.gate_up_proj"))
+            for i in range(L)])              # [L, E, H, 2I]
+        gub = np.stack([np.asarray(
+            alias.pop(f"model.layers.{i}.mlp.experts.gate_up_proj_bias"))
+            for i in range(L)])              # [L, E, 2I]
+        dn = np.stack([np.asarray(
+            alias.pop(f"model.layers.{i}.mlp.experts.down_proj"))
+            for i in range(L)])              # [L, E, I, H]
+        dnb = np.stack([np.asarray(
+            alias.pop(f"model.layers.{i}.mlp.experts.down_proj_bias"))
+            for i in range(L)])              # [L, E, H]
+        for i in range(L):
+            # Base mapper wants dense-MLP + per-expert w1/w2/w3 names;
+            # hand over torch-Linear [out, in] layouts (it transposes).
+            pre = f"model.layers.{i}."
+            alias[pre + "block_sparse_moe.gate.weight"] = np.asarray(
+                alias.pop(pre + "mlp.router.weight"))
+            for e in range(E):
+                alias[pre + f"block_sparse_moe.experts.{e}.w1.weight"] \
+                    = gu[i, e, :, ::2].T
+                alias[pre + f"block_sparse_moe.experts.{e}.w3.weight"] \
+                    = gu[i, e, :, 1::2].T
+                alias[pre + f"block_sparse_moe.experts.{e}.w2.weight"] \
+                    = dn[i, e].T
+        params = super().params_from_hf_state_dict(alias)
+        layers = params["layers"]
+        layers["sinks"] = jnp.asarray(np.stack([
+            np.asarray(tensors[f"model.layers.{i}.self_attn.sinks"])
+            for i in range(L)]), c.dtype)
+        layers["router_b"] = jnp.asarray(np.stack([
+            np.asarray(tensors[f"model.layers.{i}.mlp.router.bias"])
+            for i in range(L)]), c.dtype)
+        layers["b_gate"] = jnp.asarray(gub[..., ::2], c.dtype)
+        layers["b_up"] = jnp.asarray(gub[..., 1::2], c.dtype)
+        layers["b_down"] = jnp.asarray(dnb, c.dtype)
+        return params
+
+    def _moe_dense(self, lp, x, top_idx, top_vals):
+        raise ValueError(
+            "VDT_MOE_BACKEND=dense is not wired for gpt-oss (its "
+            "experts carry biases + a clamped GLU the dense einsum "
+            "baseline lacks); unset the env var")
+
+    def _route(self, lp: dict, x):
+        import jax
+        import jax.numpy as jnp
+        c = self.cfg
+        logits = (x.astype(jnp.float32)
+                  @ lp["router"].astype(jnp.float32)
+                  + lp["router_b"].astype(jnp.float32))
+        # HF gpt-oss: top-k over logits, softmax over the selected k.
+        top_logits, top_idx = jax.lax.top_k(logits,
+                                            c.num_experts_per_tok)
+        top_vals = jax.nn.softmax(top_logits, axis=-1)
+        return top_idx, top_vals
+
+    def _expert_ffn(self, lp: dict, xs, group_sizes):
+        import jax
+        import jax.numpy as jnp
+        c = self.cfg
+        rows = xs.shape[0]
+        # Expert id per sorted row, for the per-expert biases.
+        bounds = jnp.cumsum(group_sizes)
+        row_e = jnp.searchsorted(bounds,
+                                 jnp.arange(rows, dtype=jnp.int32),
+                                 side="right")
+        row_e = jnp.minimum(row_e, group_sizes.shape[0] - 1)
+        g = (jax.lax.ragged_dot(xs, self._w(lp, "w_gate"), group_sizes)
+             + lp["b_gate"][row_e])
+        u = (jax.lax.ragged_dot(xs, self._w(lp, "w_up"), group_sizes)
+             + lp["b_up"][row_e])
+        # Clamped GLU (HF GptOssExperts): gate capped above, up capped
+        # both ways, sigmoid(alpha * gate) gating, (up + 1) residual.
+        limit, alpha = c.glu_limit, c.glu_alpha
+        g = jnp.minimum(g, limit)
+        u = jnp.clip(u, -limit, limit)
+        glu = g * jax.nn.sigmoid(g * alpha)
+        y = jax.lax.ragged_dot(((u + 1.0) * glu).astype(xs.dtype),
+                               self._w(lp, "w_down"), group_sizes)
+        return y + lp["b_down"][row_e]
 
 
 class Starcoder2ForCausalLM(LlamaForCausalLM):

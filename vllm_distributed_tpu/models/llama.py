@@ -186,6 +186,9 @@ class LlamaArchConfig:
     # encoder-decoder stacks like BART, whose last sublayer already
     # normalized).
     final_norm: bool = True
+    # Learned per-head attention-sink logits joining each softmax
+    # denominator (gpt-oss; params carry layers["sinks"] [L, heads]).
+    attn_sinks: bool = False
     # LayerNorm directly after the embedding lookup (Bloom's
     # word_embeddings_layernorm).
     embed_ln: bool = False
@@ -1085,7 +1088,9 @@ class LlamaForCausalLM:
                                    sm_scale=sm_scale, layer=layer_idx,
                                    window=window,
                                    logit_cap=c.attn_logit_softcap,
-                                   alibi_slopes=slopes)
+                                   alibi_slopes=slopes,
+                                   sinks=(lp["sinks"] if c.attn_sinks
+                                          else None))
             attn2d = attn.reshape(T, -1)
             attn_out = (self._mm(lp, "wo", attn2d) +
                         self._lora_delta(lp, "wo", attn2d, lora_ctx))

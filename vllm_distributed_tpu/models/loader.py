@@ -98,6 +98,16 @@ def get_model(config: EngineConfig, mesh,
         raise ValueError(
             "w8a8 is not wired for MoE expert layers yet; use "
             "--quantization int8 (weight-only) for MoE models")
+    if getattr(arch, "moe_bias", False) and (
+            config.parallel_config.enable_expert_parallel
+            or config.parallel_config.num_redundant_experts):
+        # The EP all-to-all / EPLB paths run the plain SwiGLU expert
+        # kernels without per-expert biases or the clamped GLU
+        # (gpt-oss); serving through them would be silently wrong.
+        raise ValueError(
+            "expert parallelism / EPLB for biased-expert MoE (gpt-oss) "
+            "is not wired yet; disable enable_expert_parallel / "
+            "num_redundant_experts")
     if arch.num_experts and config.parallel_config.num_redundant_experts:
         arch.num_physical_experts = (
             arch.num_experts +
@@ -180,7 +190,7 @@ def get_model(config: EngineConfig, mesh,
                 f"{', '.join(bad)} (no KV cache, no decode steps); "
                 f"drop those options")
     if ((arch.sliding_window or arch.window_pattern
-         or arch.attn_logit_softcap or arch.alibi)
+         or arch.attn_logit_softcap or arch.alibi or arch.attn_sinks)
             and config.parallel_config.token_parallel_size > 1):
         raise ValueError(
             "sliding-window attention / attention logit soft-capping / "

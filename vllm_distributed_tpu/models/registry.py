@@ -21,6 +21,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       DbrxForCausalLM,
                                                       FalconForCausalLM,
                                                       GlmForCausalLM,
+                                                      GptOssForCausalLM,
                                                       GraniteMoeForCausalLM,
                                                       OlmoeForCausalLM,
                                                       OlmoForCausalLM,
@@ -84,6 +85,8 @@ _REGISTRY: dict[str, type] = {
     "GraniteForCausalLM": GraniteForCausalLM,
     "GraniteMoeForCausalLM": GraniteMoeForCausalLM,
     "DbrxForCausalLM": DbrxForCausalLM,
+    # Attention sinks + clamped-GLU MoE (models/families_ext.py).
+    "GptOssForCausalLM": GptOssForCausalLM,
     "Qwen3MoeForCausalLM": Qwen3MoeForCausalLM,
     "Starcoder2ForCausalLM": Starcoder2ForCausalLM,
     "StableLmForCausalLM": StableLmForCausalLM,

@@ -107,6 +107,7 @@ def ragged_paged_attention(
     window: int = 0,  # sliding window size; 0 = full causal
     logit_cap: float = 0.0,  # Gemma2 attn soft-capping; 0 = off
     alibi_slopes: tuple = None,  # per-q-head ALiBi slopes; None = off
+    sinks: jax.Array = None,  # [num_q_heads] attention-sink logits
 ) -> jax.Array:  # [T, num_q_heads, head_dim]
     """Unified ragged attention: token t attends to kv positions
     0..q_pos[t] of request req_idx[t] (causal over the paged cache);
@@ -164,6 +165,12 @@ def ragged_paged_attention(
     (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0),
                                   jnp.arange(pages_per_req,
                                              dtype=jnp.int32))
+    if sinks is not None:
+        # A learned per-head virtual key joins the softmax denominator
+        # only (gpt-oss attention sinks; softmax shift-invariance makes
+        # the running max of the REAL scores a valid reference point).
+        sk = sinks.astype(jnp.float32).reshape(num_kv_heads, group)
+        l = l + jnp.exp(sk[None, :, :, None] - m)
     out = acc / jnp.maximum(l, 1e-20)
     return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
 
@@ -297,6 +304,7 @@ def naive_ragged_attention(
     window: int = 0,
     logit_cap: float = 0.0,
     alibi_slopes: tuple = None,
+    sinks: jax.Array = None,
 ) -> jax.Array:
     """O(T * max_kv) dense-gather reference used only by unit tests."""
     T, num_q_heads, head_dim = q.shape
@@ -326,7 +334,15 @@ def naive_ragged_attention(
     if window > 0:
         valid &= kv_pos[None, :] > (q_pos[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
-    weights = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        sk = sinks.astype(jnp.float32).reshape(num_kv_heads, group)
+        m = scores.max(axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        denom = p.sum(axis=-1, keepdims=True) + jnp.exp(
+            sk[None, :, :, None] - m)
+        weights = p / denom
+    else:
+        weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("thgj,thjd->thgd", weights, v_all.astype(jnp.float32))
     return out.reshape(T, num_q_heads, head_dim).astype(q.dtype)
 
@@ -575,6 +591,7 @@ def paged_attention(
     window: int = 0,  # sliding window; 0 = full causal
     logit_cap: float = 0.0,  # attn logit soft-capping; 0 = off
     alibi_slopes: tuple = None,  # Bloom/MPT ALiBi; None = off
+    sinks: jax.Array = None,  # gpt-oss attention sinks; None = off
 ) -> jax.Array:
     """Unified entry used by every model's attention layer; dispatches to
     the Pallas kernel or the XLA reference path per backend selection.
@@ -589,9 +606,9 @@ def paged_attention(
     if layer is None:
         layer = jnp.zeros((1, ), jnp.int32)
     if getattr(batch, "tknp", None) is not None:
-        if window or logit_cap or alibi_slopes:
+        if window or logit_cap or alibi_slopes or sinks is not None:
             raise NotImplementedError(
-                "sliding window / logit softcap / ALiBi under token "
+                "sliding window / logit softcap / ALiBi / sinks under token "
                 "parallelism (the per-rank attention path carries none "
                 "of these; models/loader.py get_model rejects the "
                 "combinations at admission — this trace-time guard is "
@@ -599,6 +616,7 @@ def paged_attention(
         return _paged_attention_tknp(q, k_pages, v_pages, batch,
                                      sm_scale=sm_scale, layer=layer)
     if (window == 0 and logit_cap == 0 and alibi_slopes is None
+            and sinks is None
             and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (
@@ -644,6 +662,7 @@ def paged_attention(
     else:
         k_layer, v_layer = k_pages, v_pages
     if (window == 0 and logit_cap == 0 and alibi_slopes is None
+            and sinks is None
             and getattr(batch, "cascade_shared_ids", None) is not None):
         return cascade_ragged_paged_attention(
             q, k_layer, v_layer, batch.block_tables, batch.req_idx,
@@ -653,4 +672,4 @@ def paged_attention(
                                   batch.req_idx, batch.positions,
                                   sm_scale=sm_scale, window=window,
                                   logit_cap=logit_cap,
-                                  alibi_slopes=alibi_slopes)
+                                  alibi_slopes=alibi_slopes, sinks=sinks)

@@ -29,6 +29,11 @@ class GrammarError(ValueError):
     pass
 
 
+# Hard bound on the total inlined-regex size (DoS guard: rule inlining
+# is exponential in chained references).
+_MAX_EXPANSION = 512 * 1024
+
+
 _RULE_RE = _re.compile(r"^\s*([a-zA-Z_][\w]*)\s*:\s*(.+)$")
 _TOKEN_RE = _re.compile(
     r"\s*(\"(?:\\.|[^\"\\])*\""      # "literal"
@@ -117,6 +122,8 @@ def _strip_comment(line: str) -> str:
     i, n = 0, len(line)
     while i < n:
         ch = line[i]
+        if ch == "#":
+            return line[:i]
         if ch in "\"'/":
             if ch == "/" and i + 1 < n and line[i + 1] == "/":
                 return line[:i]
@@ -188,6 +195,14 @@ def ebnf_to_regex(grammar: str) -> str:
                 f"{parser.toks[parser.i:]}")
         in_progress.discard(name)
         compiled[name] = "(" + frag + ")"
+        total = sum(len(v) for v in compiled.values())
+        if total > _MAX_EXPANSION:
+            # Inlining is exponential for chained doubling rules; cap
+            # before a 1 KB grammar can balloon into a GB-scale regex
+            # (admission-time DoS through guided_grammar).
+            raise GrammarError(
+                f"grammar expansion exceeds {_MAX_EXPANSION} regex "
+                f"chars; restructure (or use a regex spec)")
         return compiled[name]
 
     start = "start" if "start" in rules else order[0]
