@@ -263,3 +263,52 @@ def test_gemma2_int8_quant_keeps_top1(tmp_path_factory):
     # quantized tiny model; divergence-at-step-0 would mean the scales
     # or extra-norm keys broke).
     assert fp[0][0] == q8[0][0]
+
+
+def test_phi3_longrope_matches_hf(tmp_path_factory):
+    """Phi-3 128k LongRoPE: per-dim long/short factors + the sqrt
+    attention factor (reference: the longrope path of
+    modeling_rope_utils, silently ignored before this test's feature)."""
+    from transformers import Phi3Config
+    from transformers import Phi3ForCausalLM as HFPhi3
+
+    hd2 = 8  # head_dim 16 -> 8 factors
+    cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        original_max_position_embeddings=64,
+        rope_scaling={"type": "longrope",
+                      "long_factor": [1.5 + 0.5 * i for i in range(hd2)],
+                      "short_factor": [1.0 + 0.1 * i
+                                       for i in range(hd2)]},
+        eos_token_id=1, pad_token_id=0)
+    torch.manual_seed(31)
+    hf = HFPhi3(cfg).eval()
+    path, hf = _save(tmp_path_factory, "tiny_phi3_longrope", hf)
+    got = run(path, PROMPTS, max_model_len=128,
+              max_num_batched_tokens=128)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_qwen2_yarn_matches_hf(tmp_path_factory):
+    """YaRN on the general decoder path (regression: it was silently
+    ignored outside DeepSeek until the gpt-oss drive exposed it)."""
+    from transformers import Qwen2Config
+    from transformers import Qwen2ForCausalLM as HFQwen2
+
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        eos_token_id=1)
+    torch.manual_seed(32)
+    hf = HFQwen2(cfg).eval()
+    path, hf = _save(tmp_path_factory, "tiny_qwen2_yarn", hf)
+    got = run(path, PROMPTS, max_model_len=128,
+              max_num_batched_tokens=128)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"

@@ -223,6 +223,22 @@ def make_inv_freq(head_dim: int, rope_theta: float,
                 "embeddings")
         inv_freq, _ = yarn_inv_freq(head_dim, rope_theta, rope_scaling,
                                     orig)
+    if rope_scaling and rtype == "longrope":
+        # Phi-3 LongRoPE: per-dim rescale factors, long set active when
+        # the serving window exceeds the pretraining window (reference:
+        # modeling_rope_utils._compute_longrope_parameters; from_hf_
+        # config folds the two window fields into the dict).
+        orig = rope_scaling.get("original_max_position_embeddings")
+        maxp = rope_scaling.get("max_position_embeddings")
+        if not orig or not maxp:
+            raise ValueError(
+                "longrope rope_scaling needs original_ and "
+                "max_position_embeddings (from_hf_config adds them)")
+        ext = (rope_scaling["long_factor"] if maxp > orig
+               else rope_scaling["short_factor"])
+        inv_freq = 1.0 / (
+            jnp.asarray(ext, jnp.float32) * rope_theta ** (
+                jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
     return inv_freq
 
 
@@ -230,11 +246,22 @@ def _rope_attention_factor(rope_scaling: dict | None) -> float:
     """YaRN's mscale: multiplies cos/sin (reference: the
     attention_scaling of modeling_rope_utils._compute_yarn_parameters).
     Shares yarn_inv_freq's formula (yarn_attention_factor)."""
+    import math
     rtype = (rope_scaling or {}).get(
         "rope_type", (rope_scaling or {}).get("type"))
-    if not rope_scaling or rtype != "yarn":
-        return 1.0
-    return yarn_attention_factor(rope_scaling)
+    if rope_scaling and rtype == "yarn":
+        return yarn_attention_factor(rope_scaling)
+    if rope_scaling and rtype == "longrope":
+        af = rope_scaling.get("attention_factor")
+        if af is not None:
+            return float(af)
+        orig = rope_scaling["original_max_position_embeddings"]
+        factor = (rope_scaling.get("factor")
+                  or rope_scaling["max_position_embeddings"] / orig)
+        if factor <= 1.0:
+            return 1.0
+        return math.sqrt(1 + math.log(factor) / math.log(orig))
+    return 1.0
 
 
 def compute_rope_cos_sin(positions: jax.Array, head_dim: int,

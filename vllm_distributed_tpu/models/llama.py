@@ -241,6 +241,19 @@ class LlamaArchConfig:
         head_dim = getattr(hf, "head_dim", None) or (
             hf.hidden_size // hf.num_attention_heads)
         sliding_window, window_pattern = cls._resolve_sliding_window(hf)
+        rope_scaling = getattr(hf, "rope_scaling", None)
+        rtype = (rope_scaling or {}).get(
+            "rope_type", (rope_scaling or {}).get("type"))
+        if rtype == "longrope":
+            # LongRoPE selects long/short factors by the serving window
+            # vs the pretraining window; fold both config-level fields
+            # into the dict so the rope math stays self-contained.
+            rope_scaling = dict(
+                rope_scaling,
+                original_max_position_embeddings=getattr(
+                    hf, "original_max_position_embeddings",
+                    hf.max_position_embeddings),
+                max_position_embeddings=hf.max_position_embeddings)
         return cls(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
@@ -254,7 +267,7 @@ class LlamaArchConfig:
                                  hf.num_attention_heads),
             head_dim=head_dim,
             rope_theta=getattr(hf, "rope_theta", 10000.0),
-            rope_scaling=getattr(hf, "rope_scaling", None),
+            rope_scaling=rope_scaling,
             rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
             tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
             attention_bias=getattr(hf, "attention_bias", False),
