@@ -140,3 +140,36 @@ def test_int8_quant_error_bounded():
     recon = q.astype(np.float32) * s
     err = np.abs(recon - w)
     assert float(err.max()) <= float(s.max()) * 0.5 + 1e-6
+
+
+def test_fp8_kv_cache_parity_and_footprint(checkpoint):
+    """--kv-cache-dtype fp8: halved KV bytes, outputs within quant
+    tolerance (reference: the kv_cache_dtype flag + fp8 cache
+    kernels; scale 1.0)."""
+    fp = make_engine(checkpoint)
+    q = make_engine(checkpoint, kv_cache_dtype="fp8")
+    lp_fp = first_logprobs(fp, PROMPT)
+    lp_q = first_logprobs(q, PROMPT)
+    assert max(lp_fp, key=lp_fp.get) == max(lp_q, key=lp_q.get)
+    common = set(lp_fp) & set(lp_q)
+    for tok in common:
+        assert abs(lp_fp[tok] - lp_q[tok]) < 0.15
+
+    def cache_bytes(engine):
+        runner = engine.engine_core.engine_core.executor.worker \
+            .model_runner
+        return sum(x.nbytes
+                   for x in jax.tree_util.tree_leaves(runner.kv_caches))
+
+    assert cache_bytes(q) <= 0.3 * cache_bytes(fp)  # fp8 vs float32
+
+    # Greedy decode stays stable over several tokens.
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    q.add_request("d", PROMPT, sp)
+    for _ in range(60):
+        done = [o for o in q.step() if o.finished]
+        if done:
+            assert len(done[0].outputs[0].token_ids) == 6
+            break
+    else:
+        raise AssertionError("fp8 decode did not finish")

@@ -29,6 +29,7 @@ class EngineArgs:
     max_model_len: Optional[int] = None
 
     block_size: int = 16
+    kv_cache_dtype: str = "auto"
     gpu_memory_utilization: float = 0.90
     num_gpu_blocks_override: Optional[int] = None
     enable_prefix_caching: bool = True
@@ -101,6 +102,7 @@ class EngineArgs:
                 gpu_memory_utilization=self.gpu_memory_utilization,
                 num_gpu_blocks_override=self.num_gpu_blocks_override,
                 enable_prefix_caching=self.enable_prefix_caching,
+                cache_dtype=self.kv_cache_dtype,
             ),
             parallel_config=ParallelConfig(
                 tensor_parallel_size=self.tensor_parallel_size,

@@ -648,6 +648,13 @@ class LlamaForCausalLM:
                 layers[name] = _replicate_kv_heads(
                     layers[name], c.num_kv_heads, c.num_kv_head_replicas)
 
+    def cache_dtype(self):
+        """KV-page dtype: the model dtype, or fp8 under
+        --kv-cache-dtype fp8 (reference: the kv_cache_dtype flag and
+        csrc fp8 cache kernels; values dequantize at the attention
+        read, scale 1.0 like the reference default)."""
+        return getattr(self.cfg, "kv_cache_dtype", None) or self.cfg.dtype
+
     def kv_cache_page_bytes(self, page_size: int) -> int:
         """HBM bytes one page costs across all layers (the worker sizes
         the pool from this; models with non-K/V cache layouts override)."""
@@ -655,7 +662,7 @@ class LlamaForCausalLM:
         c = self.cfg
         return (2 * c.num_layers * page_size * c.total_kv_heads *
                 storage_head_dim(c.head_dim) *
-                jnp.dtype(c.dtype).itemsize)
+                jnp.dtype(self.cache_dtype()).itemsize)
 
     def slice_layer_params(self, layers: dict, start: int,
                            end: int) -> dict:
@@ -673,7 +680,7 @@ class LlamaForCausalLM:
         depth = num_layers if num_layers is not None else c.num_layers
         shape = (depth, num_pages, c.total_kv_heads,
                  page_size, storage_head_dim(c.head_dim))
-        dtype = cache_dtype or c.dtype
+        dtype = cache_dtype or self.cache_dtype()
         return {
             "k": jnp.zeros(shape, dtype),
             "v": jnp.zeros(shape, dtype),

@@ -90,6 +90,34 @@ def get_model(config: EngineConfig, mesh,
         config.parallel_config.enable_sequence_parallel
         and config.parallel_config.tensor_parallel_size > 1)
     arch.quantization = config.model_config.quantization
+    kv_dtype = config.cache_config.cache_dtype
+    if kv_dtype not in ("auto", None):
+        if kv_dtype not in ("fp8", "fp8_e4m3"):
+            raise ValueError(
+                f"unsupported kv cache dtype {kv_dtype!r} "
+                "(supported: auto, fp8)")
+        if (getattr(model_cls, "STATEFUL", False)
+                or getattr(model_cls, "ENCODER_ONLY", False)
+                or getattr(arch, "mla", False)):
+            raise ValueError(
+                "--kv-cache-dtype fp8 is wired for standard paged K/V "
+                "only (SSM state rows / MLA latent pages / encoder "
+                "models keep the model dtype); drop the flag")
+        if config.kv_transfer_config.kv_connector:
+            raise ValueError(
+                "--kv-cache-dtype fp8 with KV transfer is not wired "
+                "(the connectors' wire layout carries model-dtype "
+                "pages); drop one")
+        if config.parallel_config.token_parallel_size > 1:
+            raise ValueError(
+                "--kv-cache-dtype fp8 under token parallelism is not "
+                "wired (the per-rank attention path has no fp8 "
+                "dequant); drop one")
+        arch.kv_cache_dtype = jnp.float8_e4m3fn
+        logger.warning(
+            "fp8 KV cache: attention and cache writes run the XLA "
+            "path (the Pallas kernels' fp8 dequant is a follow-up) — "
+            "halved KV HBM, some per-step throughput cost on TPU")
     if arch.quantization == "w8a8" and getattr(arch, "num_experts", 0):
         # The MoE expert dots (the dominant FLOPs) run through
         # ragged_dot/shard_map paths that dequantize weights (w8a16);

@@ -74,6 +74,12 @@ def write_kv_pages(
     T = k_new.shape[0]
     k_new = _pad_last_dim(k_new, head_dim)
     v_new = _pad_last_dim(v_new, head_dim)
+    if k_pages.dtype == jnp.float8_e4m3fn:
+        # Saturate like the reference fp8 cache kernels: a bare astype
+        # maps overflow to NaN, and one NaN row poisons its page.
+        lim = float(jnp.finfo(jnp.float8_e4m3fn).max)
+        k_new = jnp.clip(k_new.astype(jnp.float32), -lim, lim)
+        v_new = jnp.clip(v_new.astype(jnp.float32), -lim, lim)
     page = slot_mapping // page_size
     off = slot_mapping % page_size
     # Flat row per (token, head): ((page * KVH) + h) * PS + off.
@@ -458,6 +464,7 @@ def write_kv_cache(
                                     layer)
     L, N, KVH, PS, D = k_all.shape
     if (resolve_attention_backend() == "pallas"
+            and k_all.dtype != jnp.float8_e4m3fn
             and getattr(batch, "kv_runs", None) is not None):
         from vllm_distributed_tpu.ops.pallas_kv_write import (
             write_kv_pages_pallas)
@@ -606,9 +613,10 @@ def paged_attention(
     if layer is None:
         layer = jnp.zeros((1, ), jnp.int32)
     if getattr(batch, "tknp", None) is not None:
-        if window or logit_cap or alibi_slopes or sinks is not None:
+        if (window or logit_cap or alibi_slopes or sinks is not None
+                or k_pages.dtype == jnp.float8_e4m3fn):
             raise NotImplementedError(
-                "sliding window / logit softcap / ALiBi / sinks under token "
+                "sliding window / softcap / ALiBi / sinks / fp8 KV under token "
                 "parallelism (the per-rank attention path carries none "
                 "of these; models/loader.py get_model rejects the "
                 "combinations at admission — this trace-time guard is "
@@ -617,6 +625,7 @@ def paged_attention(
                                      sm_scale=sm_scale, layer=layer)
     if (window == 0 and logit_cap == 0 and alibi_slopes is None
             and sinks is None
+            and k_pages.dtype != jnp.float8_e4m3fn
             and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (
