@@ -173,3 +173,22 @@ def test_fp8_kv_cache_parity_and_footprint(checkpoint):
             break
     else:
         raise AssertionError("fp8 decode did not finish")
+
+
+def test_fp8_kv_cache_under_tp2(checkpoint):
+    """fp8 pages + GSPMD TP: the head-sharded cache keeps parity with
+    the single-device fp8 engine."""
+    base = make_engine(checkpoint, kv_cache_dtype="fp8")
+    tp2 = make_engine(checkpoint, kv_cache_dtype="fp8",
+                      tensor_parallel_size=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(engine):
+        engine.add_request("r", PROMPT, sp)
+        for _ in range(100):
+            for out in engine.step():
+                if out.finished:
+                    return out.outputs[0].token_ids
+        raise AssertionError("did not finish")
+
+    assert run(base) == run(tp2)

@@ -106,3 +106,11 @@ def test_prompt_logprobs_absent_when_not_requested(checkpoint):
     engine = make_engine(path)
     out = run_one(engine, PROMPT)
     assert out.prompt_logprobs is None
+
+
+def test_prompt_logprobs_under_pipeline_parallelism(checkpoint):
+    """plp scoring runs on the last stage's sub-mesh under PP."""
+    path, hf = checkpoint
+    engine = make_engine(path, pipeline_parallel_size=2)
+    out = run_one(engine, PROMPT, prompt_logprobs=3)
+    _check(out, hf, PROMPT, k=3)

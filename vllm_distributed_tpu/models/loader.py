@@ -92,10 +92,10 @@ def get_model(config: EngineConfig, mesh,
     arch.quantization = config.model_config.quantization
     kv_dtype = config.cache_config.cache_dtype
     if kv_dtype not in ("auto", None):
-        if kv_dtype not in ("fp8", "fp8_e4m3"):
+        if kv_dtype not in ("fp8", "fp8_e4m3", "fp8_e5m2"):
             raise ValueError(
                 f"unsupported kv cache dtype {kv_dtype!r} "
-                "(supported: auto, fp8)")
+                "(supported: auto, fp8, fp8_e4m3, fp8_e5m2)")
         if (getattr(model_cls, "STATEFUL", False)
                 or getattr(model_cls, "ENCODER_ONLY", False)
                 or getattr(arch, "mla", False)):
@@ -113,7 +113,9 @@ def get_model(config: EngineConfig, mesh,
                 "--kv-cache-dtype fp8 under token parallelism is not "
                 "wired (the per-rank attention path has no fp8 "
                 "dequant); drop one")
-        arch.kv_cache_dtype = jnp.float8_e4m3fn
+        arch.kv_cache_dtype = (jnp.float8_e5m2
+                               if kv_dtype == "fp8_e5m2"
+                               else jnp.float8_e4m3fn)
         logger.warning(
             "fp8 KV cache: attention and cache writes run the XLA "
             "path (the Pallas kernels' fp8 dequant is a follow-up) — "

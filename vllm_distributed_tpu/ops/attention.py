@@ -35,6 +35,10 @@ from vllm_distributed_tpu import envs
 # produce 0-weight rows instead of NaNs.
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+# fp8 cache payload dtypes (--kv-cache-dtype): these route the XLA
+# attention/write paths (Pallas fp8 dequant is a follow-up).
+_FP8_DTYPES = (jnp.float8_e4m3fn, jnp.float8_e5m2)
+
 
 def storage_head_dim(head_dim: int) -> int:
     """Head dim used for KV-cache storage: padded to the 128-lane tile on
@@ -74,10 +78,10 @@ def write_kv_pages(
     T = k_new.shape[0]
     k_new = _pad_last_dim(k_new, head_dim)
     v_new = _pad_last_dim(v_new, head_dim)
-    if k_pages.dtype == jnp.float8_e4m3fn:
+    if k_pages.dtype in _FP8_DTYPES:
         # Saturate like the reference fp8 cache kernels: a bare astype
         # maps overflow to NaN, and one NaN row poisons its page.
-        lim = float(jnp.finfo(jnp.float8_e4m3fn).max)
+        lim = float(jnp.finfo(k_pages.dtype).max)
         k_new = jnp.clip(k_new.astype(jnp.float32), -lim, lim)
         v_new = jnp.clip(v_new.astype(jnp.float32), -lim, lim)
     page = slot_mapping // page_size
@@ -464,7 +468,7 @@ def write_kv_cache(
                                     layer)
     L, N, KVH, PS, D = k_all.shape
     if (resolve_attention_backend() == "pallas"
-            and k_all.dtype != jnp.float8_e4m3fn
+            and k_all.dtype not in _FP8_DTYPES
             and getattr(batch, "kv_runs", None) is not None):
         from vllm_distributed_tpu.ops.pallas_kv_write import (
             write_kv_pages_pallas)
@@ -614,7 +618,7 @@ def paged_attention(
         layer = jnp.zeros((1, ), jnp.int32)
     if getattr(batch, "tknp", None) is not None:
         if (window or logit_cap or alibi_slopes or sinks is not None
-                or k_pages.dtype == jnp.float8_e4m3fn):
+                or k_pages.dtype in _FP8_DTYPES):
             raise NotImplementedError(
                 "sliding window / softcap / ALiBi / sinks / fp8 KV under token "
                 "parallelism (the per-rank attention path carries none "
@@ -625,7 +629,7 @@ def paged_attention(
                                      sm_scale=sm_scale, layer=layer)
     if (window == 0 and logit_cap == 0 and alibi_slopes is None
             and sinks is None
-            and k_pages.dtype != jnp.float8_e4m3fn
+            and k_pages.dtype not in _FP8_DTYPES
             and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
         from vllm_distributed_tpu.ops.pallas_attention import (
