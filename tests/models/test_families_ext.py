@@ -310,3 +310,26 @@ def test_gpt_oss_matches_hf(tmp_path_factory):
     got = run_engine(path, PROMPTS, max_tokens=6)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_phimoe_sparsemixer_matches_hf(tmp_path_factory):
+    """Phi-3.5-MoE: sparsemixer routing (argmax over jitter-thresholded
+    scores, softmax over survivors) must match HF exactly at
+    inference (reference: models/phimoe.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.PhimoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        attention_bias=True, eos_token_id=1)
+    torch.manual_seed(14)
+    hf = transformers.PhimoeForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_phimoe"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"

@@ -256,8 +256,13 @@ def _rope_attention_factor(rope_scaling: dict | None) -> float:
         if af is not None:
             return float(af)
         orig = rope_scaling["original_max_position_embeddings"]
-        factor = (rope_scaling.get("factor")
-                  or rope_scaling["max_position_embeddings"] / orig)
+        maxp = rope_scaling["max_position_embeddings"]
+        # Phi-3.5-MoE carries explicit per-regime mscales.
+        mscale = (rope_scaling.get("long_mscale") if maxp > orig
+                  else rope_scaling.get("short_mscale"))
+        if mscale:
+            return float(mscale)
+        factor = rope_scaling.get("factor") or maxp / orig
         if factor <= 1.0:
             return 1.0
         return math.sqrt(1 + math.log(factor) / math.log(orig))

@@ -203,6 +203,58 @@ class DbrxForCausalLM(MixtralForCausalLM):
         return super().params_from_hf_state_dict(alias)
 
 
+class PhimoeForCausalLM(MixtralForCausalLM):
+    """Phi-3.5-MoE: Mixtral expert layout + LayerNorm blocks, biased
+    projections, and SPARSEMIXER routing — each of the two experts is
+    the argmax over jitter-thresholded scores, weighted by a softmax
+    over the surviving entries (reference: models/phimoe.py
+    phimoe_routing_function; deterministic at inference)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        if hf.num_experts_per_tok != 2:
+            raise ValueError("sparsemixer routing requires top_k == 2")
+        arch.num_experts = hf.num_local_experts
+        arch.num_experts_per_tok = 2
+        arch.moe_intermediate_size = hf.intermediate_size
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.attention_bias = bool(getattr(hf, "attention_bias", True))
+        arch.attention_out_bias = arch.attention_bias
+        arch.router_jitter_eps = float(
+            getattr(hf, "router_jitter_eps", 0.01))
+        if getattr(hf, "lm_head_bias", False):
+            raise ValueError("Phimoe lm_head_bias checkpoints are not "
+                             "supported yet")
+
+    def _route(self, lp: dict, x):
+        import jax
+        import jax.numpy as jnp
+        eps = self.cfg.router_jitter_eps
+        scores = (x.astype(jnp.float32)
+                  @ lp["router"].astype(jnp.float32))  # [T, E]
+        neg = jnp.float32(-jnp.inf)
+
+        def pick(cand):
+            # cand: scores with already-taken experts at -inf. The
+            # threshold compares against the ORIGINAL scores (HF
+            # sparsemixer semantics).
+            mx = cand.max(axis=-1, keepdims=True)
+            factor = jnp.maximum(jnp.abs(scores), mx)
+            drop = ((mx - scores) / factor) > (2 * eps)
+            gates = jnp.where(drop, neg, cand)
+            sel = jnp.argmax(cand, axis=-1)
+            w = jnp.take_along_axis(jax.nn.softmax(gates, axis=-1),
+                                    sel[:, None], axis=-1)[:, 0]
+            return sel, w
+
+        sel1, w1 = pick(scores)
+        masked = scores.at[jnp.arange(scores.shape[0]), sel1].set(neg)
+        sel2, w2 = pick(masked)
+        return (jnp.stack([sel1, sel2], axis=-1),
+                jnp.stack([w1, w2], axis=-1))
+
+
 class GptOssForCausalLM(MixtralForCausalLM):
     """OpenAI gpt-oss: attention sinks, alternating sliding/full
     layers, biased projections, MoE with interleaved gate_up expert

@@ -31,6 +31,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       Olmo2ForCausalLM,
                                                       PersimmonForCausalLM,
                                                       PhiForCausalLM,
+                                                      PhimoeForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
@@ -87,6 +88,8 @@ _REGISTRY: dict[str, type] = {
     "DbrxForCausalLM": DbrxForCausalLM,
     # Attention sinks + clamped-GLU MoE (models/families_ext.py).
     "GptOssForCausalLM": GptOssForCausalLM,
+    # Sparsemixer routing (models/families_ext.py PhimoeForCausalLM).
+    "PhimoeForCausalLM": PhimoeForCausalLM,
     "Qwen3MoeForCausalLM": Qwen3MoeForCausalLM,
     "Starcoder2ForCausalLM": Starcoder2ForCausalLM,
     "StableLmForCausalLM": StableLmForCausalLM,
