@@ -183,3 +183,29 @@ def test_exaone_renamed_equivalence(llama_base, tmp_path_factory):
                          "ExaoneForCausalLM", out,
                          {"activation_function": "silu"})
     assert _run(path) == _run(llama_base)
+
+@pytest.mark.parametrize("arch,cfg_name,kw", [
+    ("helium", "HeliumConfig", dict()),
+    ("ernie45", "Ernie4_5Config", dict(use_bias=True)),
+    ("seed_oss", "SeedOssConfig", dict(attention_bias=True)),
+    ("arcee", "ArceeConfig", dict()),
+])
+def test_llama_math_forks_match_hf(tmp_path_factory, arch, cfg_name, kw):
+    """Helium / ERNIE 4.5 / Seed-OSS / Arcee: Llama-shaped forks with
+    bias or MLP twists (reference: their models/*.py entries)."""
+    import transformers
+
+    cfg_cls = getattr(transformers, cfg_name)
+    model_cls = getattr(transformers,
+                        cfg_name.replace("Config", "ForCausalLM"))
+    cfg = cfg_cls(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=64,
+                  head_dim=16, eos_token_id=1, pad_token_id=0, **kw)
+    torch.manual_seed(41)
+    hf = model_cls(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_{arch}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"

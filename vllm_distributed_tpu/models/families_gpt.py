@@ -433,6 +433,40 @@ class MiniCPMForCausalLM(LlamaForCausalLM):
         arch.logit_multiplier = base / arch.hidden_size
 
 
+class Ernie45ForCausalLM(LlamaForCausalLM):
+    """Baidu ERNIE 4.5 dense: Llama math with use_bias on the qkv
+    projections (reference: models/ernie45.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.attention_bias = bool(getattr(hf, "use_bias", False))
+
+
+class SeedOssForCausalLM(LlamaForCausalLM):
+    """ByteDance Seed-OSS: Llama math with qkv biases (no output
+    bias; reference: models/seed_oss.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.attention_bias = bool(getattr(hf, "attention_bias", True))
+
+
+class ArceeForCausalLM(LlamaForCausalLM):
+    """Arcee AFM: Llama attention over a NON-gated relu^2 MLP
+    (reference: models/arcee.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.mlp_gated = False
+        arch.hidden_act = getattr(hf, "hidden_act", "relu2")
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        return super().params_from_hf_state_dict(_rename(tensors, [
+            (".mlp.up_proj.", ".mlp.fc1."),
+            (".mlp.down_proj.", ".mlp.fc2."),
+        ]))
+
+
 class ExaoneForCausalLM(LlamaForCausalLM):
     """LG EXAONE 3: Llama block under transformer.h naming
     (reference: models/exaone.py)."""

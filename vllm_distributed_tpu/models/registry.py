@@ -35,8 +35,11 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       Qwen3MoeForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
-from vllm_distributed_tpu.models.families_gpt import (BloomForCausalLM,
+from vllm_distributed_tpu.models.families_gpt import (ArceeForCausalLM,
+                                                      BloomForCausalLM,
+                                                      Ernie45ForCausalLM,
                                                       ExaoneForCausalLM,
+                                                      SeedOssForCausalLM,
                                                       GPT2LMHeadModel,
                                                       GPTBigCodeForCausalLM,
                                                       GPTJForCausalLM,
@@ -119,6 +122,11 @@ _REGISTRY: dict[str, type] = {
     "OPTForCausalLM": OPTForCausalLM,
     "MiniCPMForCausalLM": MiniCPMForCausalLM,
     "ExaoneForCausalLM": ExaoneForCausalLM,
+    # Llama-math forks with bias/MLP twists (models/families_gpt.py).
+    "HeliumForCausalLM": LlamaForCausalLM,
+    "Ernie4_5ForCausalLM": Ernie45ForCausalLM,
+    "SeedOssForCausalLM": SeedOssForCausalLM,
+    "ArceeForCausalLM": ArceeForCausalLM,
     # ALiBi families (slope bias in ops/attention.py).
     "BloomForCausalLM": BloomForCausalLM,
     "MptForCausalLM": MPTForCausalLM,
