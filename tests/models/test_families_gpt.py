@@ -187,8 +187,11 @@ def test_exaone_renamed_equivalence(llama_base, tmp_path_factory):
 @pytest.mark.parametrize("arch,cfg_name,kw", [
     ("helium", "HeliumConfig", dict()),
     ("ernie45", "Ernie4_5Config", dict(use_bias=True)),
-    ("seed_oss", "SeedOssConfig", dict(attention_bias=True)),
-    ("arcee", "ArceeConfig", dict()),
+    ("seed_oss", "SeedOssConfig", dict(attention_bias=True,
+                                       attention_out_bias=True,
+                                       mlp_bias=True)),
+    ("arcee", "ArceeConfig", dict(attention_bias=True,
+                                  mlp_bias=True)),
 ])
 def test_llama_math_forks_match_hf(tmp_path_factory, arch, cfg_name, kw):
     """Helium / ERNIE 4.5 / Seed-OSS / Arcee: Llama-shaped forks with
@@ -204,6 +207,12 @@ def test_llama_math_forks_match_hf(tmp_path_factory, arch, cfg_name, kw):
                   head_dim=16, eos_token_id=1, pad_token_id=0, **kw)
     torch.manual_seed(41)
     hf = model_cls(cfg).eval()
+    # HF zero-inits Linear biases: randomize so dropped-bias bugs
+    # actually change outputs (a zero bias is vacuously "loaded").
+    with torch.no_grad():
+        for name, par in hf.named_parameters():
+            if name.endswith(".bias"):
+                par.normal_(0.0, 0.2)
     path = str(tmp_path_factory.mktemp(f"tiny_{arch}"))
     hf.save_pretrained(path, safe_serialization=True)
     got = run_engine(path, PROMPTS, max_tokens=6)

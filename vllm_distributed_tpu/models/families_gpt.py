@@ -434,21 +434,28 @@ class MiniCPMForCausalLM(LlamaForCausalLM):
 
 
 class Ernie45ForCausalLM(LlamaForCausalLM):
-    """Baidu ERNIE 4.5 dense: Llama math with use_bias on the qkv
-    projections (reference: models/ernie45.py)."""
+    """Baidu ERNIE 4.5 dense: Llama math; use_bias puts biases on
+    EVERY projection — qkv, output, and the gated MLP (reference:
+    models/ernie45.py)."""
 
     @classmethod
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
-        arch.attention_bias = bool(getattr(hf, "use_bias", False))
+        bias = bool(getattr(hf, "use_bias", False))
+        arch.attention_bias = bias
+        arch.attention_out_bias = bias
+        arch.mlp_bias = bias
 
 
 class SeedOssForCausalLM(LlamaForCausalLM):
-    """ByteDance Seed-OSS: Llama math with qkv biases (no output
-    bias; reference: models/seed_oss.py)."""
+    """ByteDance Seed-OSS: Llama math; qkv / output / MLP biases each
+    follow their own config flag (reference: models/seed_oss.py)."""
 
     @classmethod
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
         arch.attention_bias = bool(getattr(hf, "attention_bias", True))
+        arch.attention_out_bias = bool(
+            getattr(hf, "attention_out_bias", False))
+        arch.mlp_bias = bool(getattr(hf, "mlp_bias", False))
 
 
 class ArceeForCausalLM(LlamaForCausalLM):
@@ -459,6 +466,10 @@ class ArceeForCausalLM(LlamaForCausalLM):
     def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
         arch.mlp_gated = False
         arch.hidden_act = getattr(hf, "hidden_act", "relu2")
+        bias = bool(getattr(hf, "attention_bias", False))
+        arch.attention_bias = bias
+        arch.attention_out_bias = bias
+        arch.mlp_bias = bool(getattr(hf, "mlp_bias", False))
 
     def params_from_hf_state_dict(self, tensors) -> dict:
         return super().params_from_hf_state_dict(_rename(tensors, [

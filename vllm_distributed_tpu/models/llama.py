@@ -422,6 +422,10 @@ class LlamaForCausalLM:
                 "up": P(None, None, MODEL_AXIS),
                 "down": P(None, MODEL_AXIS, None),
             })
+            if c.mlp_bias:
+                layer.update({"gate_bias": P(None, MODEL_AXIS),
+                              "up_bias": P(None, MODEL_AXIS),
+                              "down_bias": P(None, None)})
         else:
             layer.update({
                 "fc1": P(None, None, MODEL_AXIS),
@@ -565,6 +569,12 @@ class LlamaForCausalLM:
                 "up": norm(next(keys), (L, H, I)),
                 "down": norm(next(keys), (L, I, H)),
             })
+            if c.mlp_bias:
+                layers.update({
+                    "gate_bias": jnp.zeros((L, I), c.dtype),
+                    "up_bias": jnp.zeros((L, I), c.dtype),
+                    "down_bias": jnp.zeros((L, H), c.dtype),
+                })
         else:
             layers.update({
                 "fc1": norm(next(keys), (L, H, I)),
@@ -736,6 +746,15 @@ class LlamaForCausalLM:
                 "up": stack("model.layers.{}.mlp.up_proj.weight"),
                 "down": stack("model.layers.{}.mlp.down_proj.weight"),
             })
+            if c.mlp_bias:
+                layers.update({
+                    "gate_bias": stack(
+                        "model.layers.{}.mlp.gate_proj.bias", False),
+                    "up_bias": stack(
+                        "model.layers.{}.mlp.up_proj.bias", False),
+                    "down_bias": stack(
+                        "model.layers.{}.mlp.down_proj.bias", False),
+                })
         else:
             # Canonical plain-MLP names; family subclasses rename their
             # checkpoint tensors (dense_h_to_4h, c_fc, ...) onto these.
@@ -881,15 +900,19 @@ class LlamaForCausalLM:
             if c.mlp_bias:
                 h = h + lp["fc2_b"]
             return h
+        gb = lp.get("gate_bias", 0) if c.mlp_bias else 0
+        ub = lp.get("up_bias", 0) if c.mlp_bias else 0
+        db = lp.get("down_bias", 0) if c.mlp_bias else 0
         if lora_ctx is None or ("gate_a") not in lp:
-            g = self._act(self._mm(lp, "gate", x))
-            return self._mm(lp, "down", g * self._mm(lp, "up", x))
-        g = self._act(self._mm(lp, "gate", x) +
+            g = self._act(self._mm(lp, "gate", x) + gb)
+            return self._mm(lp, "down",
+                            g * (self._mm(lp, "up", x) + ub)) + db
+        g = self._act(self._mm(lp, "gate", x) + gb +
                       self._lora_delta(lp, "gate", x, lora_ctx))
-        u = (self._mm(lp, "up", x) +
+        u = (self._mm(lp, "up", x) + ub +
              self._lora_delta(lp, "up", x, lora_ctx))
         gu = g * u
-        return (self._mm(lp, "down", gu) +
+        return (self._mm(lp, "down", gu) + db +
                 self._lora_delta(lp, "down", gu, lora_ctx))
 
     def embed(self, params: dict, token_ids: jax.Array,
