@@ -333,3 +333,49 @@ def test_phimoe_sparsemixer_matches_hf(tmp_path_factory):
     got = run_engine(path, PROMPTS, max_tokens=6)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_glm4_sandwich_norms_match_hf(tmp_path_factory):
+    """GLM-4-0414: GLM block + sandwich norms on sub-block outputs
+    (reference: models/glm4.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.Glm4Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        head_dim=16, pad_token_id=0, eos_token_id=1)
+    torch.manual_seed(15)
+    hf = transformers.Glm4ForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_glm4"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=6)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_olmo3_windows_match_hf(tmp_path_factory):
+    """OLMo-3: the OLMo-2 post-norm block with per-layer sliding
+    windows (reference: models/olmo3.py)."""
+    import transformers
+
+    from tests.models._engine_harness import hf_greedy, run_engine
+
+    cfg = transformers.Olmo3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        sliding_window=8, layer_types=["sliding_attention",
+                                       "full_attention"] * 2,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        eos_token_id=1)
+    torch.manual_seed(16)
+    hf = transformers.Olmo3ForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_olmo3"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = run_engine(path, PROMPTS, max_tokens=8)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 8), f"prompt {p}"

@@ -553,6 +553,21 @@ class Olmo2ForCausalLM(LlamaForCausalLM):
     # olmo2's checkpoint naming — no override needed.
 
 
+class Olmo3ForCausalLM(Olmo2ForCausalLM):
+    """OLMo-3: the OLMo-2 post-norm block + per-layer sliding windows;
+    rope SCALING applies only to full-attention layers — sliding
+    layers keep the default unscaled rope (reference: models/olmo3.py
+    building separate rotary tables per layer type)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        Olmo2ForCausalLM.configure_arch(arch, hf)
+        if arch.rope_scaling is not None:
+            # Same base, no scaling, on the windowed layers (the
+            # rope_theta_local table carries no scaling by design).
+            arch.rope_theta_local = arch.rope_theta
+
+
 class NemotronForCausalLM(LlamaForCausalLM):
     """Nemotron: LayerNorm1p (weight+1, folded at load), relu^2
     non-gated MLP, partial rotary (reference: models/nemotron.py)."""
@@ -633,6 +648,9 @@ class GlmForCausalLM(LlamaForCausalLM):
                               float(getattr(hf, "partial_rotary_factor",
                                             0.5)))
         arch.attention_bias = bool(getattr(hf, "attention_bias", True))
+        # GLM's o_proj is bias-free even when attention_bias is set
+        # (HF GlmAttention hardcodes bias=False on o_proj).
+        arch.attention_out_bias = False
 
     def params_from_hf_state_dict(self, tensors) -> dict:
         # GLM fuses gate|up like Phi-3; split for the base layout.
@@ -644,6 +662,27 @@ class GlmForCausalLM(LlamaForCausalLM):
             out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:half]
             out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[half:]
         return super().params_from_hf_state_dict(out)
+
+
+class Glm4ForCausalLM(GlmForCausalLM):
+    """GLM-4-0414: the GLM block plus Gemma2-style sandwich norms on
+    each sub-block's output (post_self_attn / post_mlp layernorms;
+    reference: models/glm4.py)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        GlmForCausalLM.configure_arch(arch, hf)
+        arch.extra_layer_norms = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        # Role renames onto the Gemma2-style 4-norm canonical layout;
+        # ORDER matters (the true pre-MLP norm carries the name the
+        # attention-output norm must end up with).
+        return super().params_from_hf_state_dict(_rename(tensors, [
+            (".post_attention_layernorm.", ".pre_feedforward_layernorm."),
+            (".post_self_attn_layernorm.", ".post_attention_layernorm."),
+            (".post_mlp_layernorm.", ".post_feedforward_layernorm."),
+        ]))
 
 
 class FalconForCausalLM(LlamaForCausalLM):

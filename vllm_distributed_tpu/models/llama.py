@@ -275,6 +275,12 @@ class LlamaArchConfig:
             window_pattern=window_pattern,
             num_experts=getattr(hf, "num_local_experts", 0),
             num_experts_per_tok=getattr(hf, "num_experts_per_tok", 2),
+            # HF Llama semantics: attention_bias also biases o_proj and
+            # mlp_bias biases the gated MLP (families whose HF code
+            # deviates override in configure_arch).
+            attention_out_bias=bool(getattr(hf, "attention_bias",
+                                            False)),
+            mlp_bias=bool(getattr(hf, "mlp_bias", False)),
             dtype=dtype,
         )
 
@@ -709,6 +715,20 @@ class LlamaForCausalLM:
         """
         c = self.cfg
         L = c.num_layers
+        # Auto-detect bias tensors the config did not declare (Qwen2
+        # hardcodes qkv biases with no attention_bias attr; dropping
+        # them silently would mis-serve real checkpoints). cfg flags
+        # are trace-time statics, so flipping them before param_specs
+        # keeps specs/forward consistent.
+        if (not c.attention_bias
+                and "model.layers.0.self_attn.q_proj.bias" in tensors):
+            c.attention_bias = True
+        if (not c.attention_out_bias
+                and "model.layers.0.self_attn.o_proj.bias" in tensors):
+            c.attention_out_bias = True
+        if (not c.mlp_bias and c.mlp_gated
+                and "model.layers.0.mlp.gate_proj.bias" in tensors):
+            c.mlp_bias = True
 
         def t(name):
             return np.asarray(tensors[name])
@@ -900,9 +920,9 @@ class LlamaForCausalLM:
             if c.mlp_bias:
                 h = h + lp["fc2_b"]
             return h
-        gb = lp.get("gate_bias", 0) if c.mlp_bias else 0
-        ub = lp.get("up_bias", 0) if c.mlp_bias else 0
-        db = lp.get("down_bias", 0) if c.mlp_bias else 0
+        gb = lp["gate_bias"] if c.mlp_bias else 0
+        ub = lp["up_bias"] if c.mlp_bias else 0
+        db = lp["down_bias"] if c.mlp_bias else 0
         if lora_ctx is None or ("gate_a") not in lp:
             g = self._act(self._mm(lp, "gate", x) + gb)
             return self._mm(lp, "down",

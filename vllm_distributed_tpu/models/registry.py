@@ -20,6 +20,7 @@ from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
 from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       DbrxForCausalLM,
                                                       FalconForCausalLM,
+                                                      Glm4ForCausalLM,
                                                       GlmForCausalLM,
                                                       GptOssForCausalLM,
                                                       GraniteMoeForCausalLM,
@@ -29,6 +30,7 @@ from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
                                                       GraniteForCausalLM,
                                                       NemotronForCausalLM,
                                                       Olmo2ForCausalLM,
+                                                      Olmo3ForCausalLM,
                                                       PersimmonForCausalLM,
                                                       PhiForCausalLM,
                                                       PhimoeForCausalLM,
@@ -104,6 +106,10 @@ _REGISTRY: dict[str, type] = {
     "OlmoForCausalLM": OlmoForCausalLM,
     "OlmoeForCausalLM": OlmoeForCausalLM,
     "GlmForCausalLM": GlmForCausalLM,
+    "Glm4ForCausalLM": Glm4ForCausalLM,
+    # OLMo-3: OLMo-2 post-norm block + windows + rope scaling only on
+    # full-attention layers (models/families_ext.py).
+    "Olmo3ForCausalLM": Olmo3ForCausalLM,
     "FalconForCausalLM": FalconForCausalLM,
     "PersimmonForCausalLM": PersimmonForCausalLM,
     # Selective state-space family (segmented-scan SSM; models/mamba.py).
