@@ -312,3 +312,27 @@ def test_qwen2_yarn_matches_hf(tmp_path_factory):
               max_num_batched_tokens=128)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+def test_qwen2_hardcoded_qkv_biases_load(tmp_path_factory):
+    """Qwen2 hardcodes qkv biases with NO attention_bias config attr;
+    the loader's auto-detection must pick them up (regression: they
+    were silently dropped — zero-init biases made parity vacuous)."""
+    from transformers import Qwen2Config
+    from transformers import Qwen2ForCausalLM as HFQwen2
+
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        eos_token_id=1)
+    torch.manual_seed(33)
+    hf = HFQwen2(cfg).eval()
+    with torch.no_grad():
+        for name, par in hf.named_parameters():
+            if name.endswith(".bias"):
+                par.normal_(0.0, 0.3)  # make dropped biases visible
+    path, hf = _save(tmp_path_factory, "tiny_qwen2_bias", hf)
+    got = run(path, PROMPTS)
+    for p, toks in zip(PROMPTS, got):
+        assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
