@@ -110,3 +110,28 @@ def test_sharded_state_round_trip_int8_tp2(checkpoint, tmp_path):
               for x in jax.tree_util.tree_leaves(runner.params)}
     assert "int8" in dtypes
     assert run_one(reloaded, PROMPT, "b") == before
+
+
+def test_sharded_state_round_trip_gpt_oss(tmp_path_factory, tmp_path):
+    """Extended param trees (sinks, router bias, per-expert biases)
+    survive the orbax save/restore + generalized placement."""
+    import transformers
+
+    cfg = transformers.GptOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=8,
+        max_position_embeddings=64, head_dim=16, eos_token_id=1)
+    torch.manual_seed(17)
+    hf = transformers.GptOssForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_gptoss_ckpt"))
+    hf.save_pretrained(path, safe_serialization=True)
+
+    engine = make_engine(path)
+    before = run_one(engine, PROMPT, "a")
+    ckpt = str(tmp_path / "sharded_oss")
+    engine.engine_core.call_utility("save_sharded_state", ckpt)
+    reloaded = make_engine(path, load_format="sharded_state",
+                           sharded_state_path=ckpt)
+    assert run_one(reloaded, PROMPT, "b") == before
