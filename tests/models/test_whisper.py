@@ -108,3 +108,15 @@ def test_whisper_audio_on_decoder_only_model_rejected(tmp_path_factory):
             "a-0", [2, 5], SamplingParams(max_tokens=2),
             multi_modal_data={"input_features": np.zeros((8, 32),
                                                          np.float32)})
+
+
+def test_whisper_tp2_matches_single_device(ckpt):
+    """Cross-attention state rows + head sharding under GSPMD TP."""
+    path, _ = ckpt
+    rng = np.random.default_rng(3)
+    mel = rng.standard_normal((8, 32)).astype(np.float32)
+    single = _make_engine(path)
+    tp2 = _make_engine(path, tensor_parallel_size=2)
+    a = _run(single, [([2, 5, 7], mel)], n=5)[0]
+    b = _run(tp2, [([2, 5, 7], mel)], n=5)[0]
+    assert a == b
