@@ -119,3 +119,49 @@ def test_transcription_rejects_wrong_rate(whisper_server):
                                    "audio/wav")})
     assert r.status_code == 400
     assert "16 kHz" in r.text
+
+
+@pytest.fixture(scope="module")
+def bart_server(tmp_path_factory):
+    from tests.entrypoints.test_encoder_server import (_save_tokenizer,
+                                                       _serve)
+    cfg = transformers.BartConfig(
+        vocab_size=96, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, scale_embedding=True,
+        activation_function="gelu", decoder_start_token_id=2,
+        eos_token_id=1, pad_token_id=0, bos_token_id=3,
+        forced_eos_token_id=None)
+    torch.manual_seed(1)
+    hf = transformers.BartForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_bart_served"))
+    hf.save_pretrained(path, safe_serialization=True)
+    _save_tokenizer(path)
+    base, holder, t = _serve(path)
+    yield base, hf
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    t.join(timeout=30)
+
+
+def test_completions_with_encoder_text(bart_server):
+    """encoder-decoder text over HTTP: the source document rides the
+    encoder_text body field (BART summarization-style serving)."""
+    base, hf = bart_server
+    r = httpx.post(f"{base}/v1/completions", timeout=300, json={
+        "prompt": [2, 3], "max_tokens": 5, "temperature": 0.0,
+        "ignore_eos": True, "encoder_text": "w3 w17 w45",
+    })
+    assert r.status_code == 200, r.text
+    text = r.json()["choices"][0]["text"]
+    assert text.strip(), r.text
+    # Parity with HF forced on the same source ids.
+    src = [3, 17, 45]
+    ids = [2, 3]
+    with torch.no_grad():
+        for _ in range(5):
+            out = hf(input_ids=torch.tensor([src]),
+                     decoder_input_ids=torch.tensor([ids]))
+            ids.append(int(out.logits[0, -1].argmax()))
+    want = " ".join(f"w{t}" for t in ids[2:])
+    assert text.strip() == want

@@ -273,11 +273,21 @@ class Processor:
                 raise ValueError(
                     "encoder inputs need a local BART checkpoint "
                     "(the front-end encoder loads model.encoder.*)")
-        hidden = self._text_encoder.encode(ids)
+        # Small memo so a fan-out (n > 1 / multi-prompt) or repeated
+        # request encodes each source document once.
+        key = tuple(ids)
+        hidden = self._enc_text_cache.get(key)
+        if hidden is None:
+            hidden = self._text_encoder.encode(ids)
+            if len(self._enc_text_cache) >= 32:
+                self._enc_text_cache.pop(
+                    next(iter(self._enc_text_cache)))
+            self._enc_text_cache[key] = hidden
         return [MultiModalInput(embeds=hidden, offset=-1)], \
             prompt_token_ids
 
     _text_encoder = None
+    _enc_text_cache: dict = {}
 
     def _extract_audio_features(self, audio) -> "np.ndarray":
         """Raw waveform -> log-mel features via the checkpoint's

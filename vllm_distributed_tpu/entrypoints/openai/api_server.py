@@ -561,6 +561,20 @@ async def completions(request: web.Request) -> web.StreamResponse:
         # index follows OpenAI semantics (prompt-major, then n). Seeded
         # requests offset the seed per child so samples differ.
         lora = _resolve_lora(request.app, body)
+        # Encoder-decoder text (BART): the source document rides an
+        # extra body field and encodes once at admission (reference:
+        # the encoder_prompt of the reference's encoder-decoder
+        # serving).
+        enc_mm = None
+        if body.get("encoder_text") is not None:
+            enc_mm = {"encoder_text": str(body["encoder_text"])}
+        elif body.get("encoder_input_ids") is not None:
+            ids = body["encoder_input_ids"]
+            if (not isinstance(ids, list)
+                    or not all(isinstance(t, int) for t in ids)):
+                raise RequestError(
+                    "encoder_input_ids must be a list of token ids")
+            enc_mm = {"encoder_input_ids": ids}
         gens = []
         for pi, prompt in enumerate(prompts):
             for s in range(n):
@@ -572,7 +586,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                     child.seed = params.seed + s
                 gens.append((idx, engine.generate(
                     prompt, child, request_id=f"{cid}-{idx}",
-                    lora_request=lora)))
+                    lora_request=lora, multi_modal_data=enc_mm)))
 
         if stream:
             return await _stream_completions(request, cid, created, model,
