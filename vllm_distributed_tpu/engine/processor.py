@@ -55,6 +55,9 @@ class Processor:
         self.is_encoder_only = resolve_encoder_only(config.model_config)
         self.is_cross_encoder, self.encoder_token_limit = \
             resolve_encoder_limits(config.model_config)
+        # Per-INSTANCE memo (a class-level dict would collide across
+        # engines serving different checkpoints in one process).
+        self._enc_text_cache: dict = {}
         self.eos_token_id: Optional[int] = None
         if tokenizer is not None:
             self.eos_token_id = tokenizer.eos_token_id
@@ -287,7 +290,6 @@ class Processor:
             prompt_token_ids
 
     _text_encoder = None
-    _enc_text_cache: dict = {}
 
     def _extract_audio_features(self, audio) -> "np.ndarray":
         """Raw waveform -> log-mel features via the checkpoint's
