@@ -81,8 +81,10 @@ def _hf_score(hf, tok, q, d):
     tt = enc.get("token_type_ids")
     tt = torch.tensor([tt if tt else [0] * ids.shape[1]], dtype=torch.long)
     with torch.no_grad():
-        return float(hf(input_ids=ids, token_type_ids=tt)
-                     .logits.numpy()[0, 0])
+        # Single-logit cross-encoders score through sigmoid (HF's
+        # get_cross_encoder_activation_function for num_labels == 1).
+        return float(torch.sigmoid(
+            hf(input_ids=ids, token_type_ids=tt).logits[0, 0]))
 
 
 def test_score_endpoint_matches_hf(cross_server):

@@ -103,9 +103,11 @@ def test_bert_cross_encoder_score_matches_hf():
                  attention_mask=torch.tensor(valid, dtype=torch.long))
     np.testing.assert_allclose(np.asarray(pooled["logits"]),
                                out.logits.numpy(), atol=2e-4, rtol=2e-3)
-    np.testing.assert_allclose(np.asarray(pooled["score"]),
-                               out.logits.numpy()[:, 0], atol=2e-4,
-                               rtol=2e-3)
+    # Single-logit heads score through sigmoid, matching HF's
+    # get_cross_encoder_activation_function for num_labels == 1.
+    np.testing.assert_allclose(
+        np.asarray(pooled["score"]),
+        torch.sigmoid(out.logits[:, 0]).numpy(), atol=2e-4, rtol=2e-3)
 
 
 def test_roberta_position_offset_matches_hf():
@@ -261,7 +263,8 @@ def test_llm_score_uses_cross_encoder_head(cross_encoder_ckpt):
     with torch.no_grad():
         ids = torch.tensor([q + d], dtype=torch.long)
         tt = torch.tensor([[0] * len(q) + [1] * len(d)], dtype=torch.long)
-        ref = hf(input_ids=ids, token_type_ids=tt).logits.numpy()[0, 0]
+        ref = torch.sigmoid(
+            hf(input_ids=ids, token_type_ids=tt).logits[0, 0]).item()
     assert len(scores) == 1
     np.testing.assert_allclose(scores[0], ref, atol=5e-4, rtol=5e-3)
 
@@ -277,8 +280,9 @@ def test_cross_encoder_e2e_score_matches_hf(cross_encoder_ckpt):
         out = hf(input_ids=torch.tensor([pair], dtype=torch.long),
                  token_type_ids=torch.tensor([tt], dtype=torch.long))
     assert len(embs[0]) == 1
-    np.testing.assert_allclose(embs[0][0], out.logits.numpy()[0, 0],
-                               atol=5e-4, rtol=5e-3)
+    np.testing.assert_allclose(
+        embs[0][0], torch.sigmoid(out.logits[0, 0]).item(),
+        atol=5e-4, rtol=5e-3)
 
 
 def test_encoder_e2e_tp2_matches_single_device(bert_ckpt):

@@ -110,6 +110,17 @@ def test_whisper_audio_on_decoder_only_model_rejected(tmp_path_factory):
                                                          np.float32)})
 
 
+def test_whisper_request_without_audio_rejected(ckpt):
+    """An enc-dec request with no encoder payload must 400 at admission:
+    admitted, it would cross-attend to whatever audio a reused batch row
+    last held (cross-request leakage)."""
+    path, _ = ckpt
+    engine = _make_engine(path)
+    with pytest.raises(ValueError, match="requires an encoder input"):
+        engine.add_request("noaud-0", [2, 5, 7],
+                           SamplingParams(temperature=0.0, max_tokens=2))
+
+
 def test_whisper_tp2_matches_single_device(ckpt):
     """Cross-attention state rows + head sharding under GSPMD TP."""
     path, _ = ckpt

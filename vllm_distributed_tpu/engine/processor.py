@@ -55,6 +55,24 @@ class Processor:
         self.is_encoder_only = resolve_encoder_only(config.model_config)
         self.is_cross_encoder, self.encoder_token_limit = \
             resolve_encoder_limits(config.model_config)
+        self._score_num_labels = 0
+        if self.is_cross_encoder:
+            hf = config.model_config.maybe_load_hf_config()
+            self._score_num_labels = int(getattr(hf, "num_labels", 2))
+        # Encoder-decoder checkpoints REQUIRE an encoder payload: a
+        # plain text request would cross-attend to whatever audio/
+        # document states the reused batch row last held (cross-request
+        # leakage). Mirrors the reference, which refuses enc-dec
+        # requests without encoder input.
+        self.cross_modality = None
+        try:
+            from vllm_distributed_tpu.models.registry import \
+                resolve_architecture
+            cls = resolve_architecture(
+                config.model_config.maybe_load_hf_config())
+            self.cross_modality = getattr(cls, "CROSS_MODALITY", None)
+        except Exception:  # noqa: BLE001 - tokenizer-free toy configs
+            pass
         # Per-INSTANCE memo (a class-level dict would collide across
         # engines serving different checkpoints in one process).
         self._enc_text_cache: dict = {}
@@ -94,6 +112,15 @@ class Processor:
         if multi_modal_data:
             mm_inputs, prompt_token_ids = self._process_mm(
                 multi_modal_data, prompt_token_ids)
+        if self.cross_modality is not None and not any(
+                inp.offset < 0 for inp in (mm_inputs or ())):
+            kind = ("'audio'/'input_features'"
+                    if self.cross_modality == "audio"
+                    else "'encoder_text'/'encoder_input_ids'")
+            raise ValueError(
+                f"this encoder-decoder model requires an encoder input "
+                f"({kind} in multi_modal_data); decoder-only requests "
+                f"are not admissible")
         if self.is_encoder_only and pooling_params is None:
             raise ValueError(
                 "this model is encoder-only: it serves embedding/"
@@ -114,6 +141,14 @@ class Processor:
                         "score pooling needs a classification "
                         "checkpoint (e.g. BertForSequenceClassification)"
                         "; this model only embeds")
+                if ptype == "score" and self._score_num_labels > 2:
+                    # Which class means "relevant" is undefined for
+                    # multi-label heads; reject instead of silently
+                    # scoring an arbitrary column.
+                    raise ValueError(
+                        f"score pooling needs a 1- or 2-label "
+                        f"classification head, this checkpoint has "
+                        f"{self._score_num_labels} labels")
                 clean = {"type": ptype}
                 tt = pooling_params.get("token_type_ids")
                 if tt is not None:

@@ -152,6 +152,40 @@ def _score_pairs(engine, queries, documents):
             for q, d in zip(queries, documents)]
 
 
+async def _pair_scores(engine, queries, documents):
+    """(scores, prompt_tokens) for query x document pairs.
+
+    Cross-encoder checkpoints run each pair through the classification
+    head; embedding models fall back to cosine similarity over the
+    encode path — the same two modes LLM.score serves (reference:
+    serving_score.py supports both over HTTP)."""
+    import math
+    if engine.processor.is_cross_encoder:
+        pairs = _score_pairs(engine, queries, documents)
+        results = await asyncio.gather(
+            *(engine.encode(ids, pooling_params=pooling)
+              for ids, pooling in pairs))
+        return ([out.embedding[0] for out in results],
+                sum(out.num_prompt_tokens for out in results))
+    # Embedding model: encode each distinct text once, score by cosine.
+    unique: dict = {}
+    for text in list(queries) + list(documents):
+        unique.setdefault(text, None)
+    texts = list(unique)
+    results = await asyncio.gather(
+        *(engine.encode(t) for t in texts))
+    by_text = {t: out.embedding for t, out in zip(texts, results)}
+
+    def cos(a, b):
+        dot = sum(x * y for x, y in zip(a, b))
+        return dot / (math.sqrt(sum(x * x for x in a)) *
+                      math.sqrt(sum(x * x for x in b)) + 1e-12)
+
+    return ([cos(by_text[q], by_text[d])
+             for q, d in zip(queries, documents)],
+            sum(out.num_prompt_tokens for out in results))
+
+
 async def score(request: web.Request) -> web.Response:
     """/v1/score: cross-encoder relevance of text_1 x text_2 pairs
     (reference: serving_score.py)."""
@@ -177,16 +211,12 @@ async def score(request: web.Request) -> web.Response:
             raise RequestError(
                 f"text_1 x text_2 must match (or broadcast); got "
                 f"{len(t1)} x {len(t2)}")
-        pairs = _score_pairs(engine, t1, t2)
-        results = await asyncio.gather(
-            *(engine.encode(ids, pooling_params=pooling)
-              for ids, pooling in pairs))
+        scores, prompt_tokens = await _pair_scores(engine, t1, t2)
         data = [{
             "object": "score",
             "index": i,
-            "score": out.embedding[0],
-        } for i, out in enumerate(results)]
-        prompt_tokens = sum(out.num_prompt_tokens for out in results)
+            "score": s,
+        } for i, s in enumerate(scores)]
         return web.json_response({
             "object": "list",
             "data": data,
@@ -216,20 +246,16 @@ async def rerank(request: web.Request) -> web.Response:
             documents = [documents]
         if query is None or not documents:
             raise RequestError("rerank needs 'query' and 'documents'")
-        pairs = _score_pairs(engine, [query] * len(documents), documents)
-        results = await asyncio.gather(
-            *(engine.encode(ids, pooling_params=pooling)
-              for ids, pooling in pairs))
-        ranked = sorted(
-            ((out.embedding[0], i) for i, out in enumerate(results)),
-            reverse=True)
+        scores, prompt_tokens = await _pair_scores(
+            engine, [query] * len(documents), documents)
+        ranked = sorted(((s, i) for i, s in enumerate(scores)),
+                        reverse=True)
         top_n = body.get("top_n", len(documents))
         data = [{
             "index": i,
             "relevance_score": s,
             "document": {"text": documents[i]},
         } for s, i in ranked[:top_n]]
-        prompt_tokens = sum(out.num_prompt_tokens for out in results)
         return web.json_response({
             "model": body.get("model", model),
             "results": data,

@@ -372,9 +372,16 @@ class BertEmbeddingModel(LlamaForCausalLM):
             logits = (pooled @ params["cls_w"].astype(jnp.float32) +
                       params["cls_b"].astype(jnp.float32))
             if self.cfg.num_labels == 1:
-                score = logits[:, 0]
+                # HF's get_cross_encoder_activation_function returns
+                # Sigmoid for single-logit heads (reference
+                # transformers_utils/config.py:787) — scores land in [0,1].
+                score = jax.nn.sigmoid(logits[:, 0])
             else:
-                score = jax.nn.softmax(logits, axis=-1)[:, -1]
+                # Two-label heads: probability of the positive class
+                # (index 1). Checkpoints with >2 labels are rejected at
+                # admission (see Processor) — the "relevance" class is
+                # undefined for them.
+                score = jax.nn.softmax(logits, axis=-1)[:, 1]
             out["score"] = score
             out["logits"] = logits
         return out

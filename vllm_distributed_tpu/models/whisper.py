@@ -304,7 +304,20 @@ class WhisperForConditionalGeneration(LlamaForCausalLM):
             jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32))
         return kv_caches
 
+    def clear_cross_states(self, kv_caches: dict, slot: int) -> dict:
+        """Zero a row's valid-frame count so a reused batch row can
+        never cross-attend to a previous occupant's installed states
+        (defense in depth behind the Processor's admission check)."""
+        if self._clear_fn is None:
+            self._clear_fn = jax.jit(
+                lambda xlen, slot: xlen.at[slot].set(0),
+                donate_argnums=(0, ))
+        kv_caches["xlen"] = self._clear_fn(
+            kv_caches["xlen"], jnp.asarray(slot, jnp.int32))
+        return kv_caches
+
     _install_fn = None
+    _clear_fn = None
     params_ref: dict = None  # set by the runner after load
 
     # ------------------------------------------------------------------

@@ -404,16 +404,23 @@ class TPUModelRunner:
             self.input_batch.remove_request(req_id)
         for new_req in scheduler_output.scheduled_new_reqs:
             row = self.input_batch.add_request(new_req)
-            if (getattr(self.model, "CROSS_ATTENTION", False)
-                    and new_req.mm_inputs):
+            if getattr(self.model, "CROSS_ATTENTION", False):
                 # Encoder-decoder (whisper): project the audio
                 # encoder's hidden states into this request's
                 # cross-KV state row (offset=-1 payloads; reference:
-                # the cross-attn KV fill of models/whisper.py).
-                for inp in new_req.mm_inputs:
+                # the cross-attn KV fill of models/whisper.py). A row
+                # claimed WITHOUT a payload must have its stale state
+                # masked — the previous occupant's audio/document would
+                # otherwise leak into this request's cross-attention.
+                installed = False
+                for inp in (new_req.mm_inputs or ()):
                     if inp.offset < 0:
                         self.kv_caches = self.model.install_cross_states(
                             self.kv_caches, row, inp.embeds)
+                        installed = True
+                if not installed:
+                    self.kv_caches = self.model.clear_cross_states(
+                        self.kv_caches, row)
             if new_req.lora_request is not None:
                 if self.lora_manager is None:
                     raise ValueError(
