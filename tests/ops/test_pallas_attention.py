@@ -107,6 +107,52 @@ def test_multi_q_tile_long_prefill():
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+def test_batched_decode_many_seqs():
+    """The SB-batched decode kernel: enough sequences for several grid
+    programs, ragged kv lens, an sb that does not divide num_seqs."""
+    rng = np.random.default_rng(7)
+    seqs = [(1, k) for k in (1, 5, 17, 32, 9, 25, 13, 2, 31, 8, 20)]
+    case = build_case(rng, seqs=seqs, page_size=8, pages_per_req=4,
+                      num_q_heads=8, num_kv_heads=4, head_dim=128,
+                      max_q=1)
+    got, want = run_both(case)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_batched_decode_scattered_q_start():
+    """Decode rows addressed through q_start, not the run index — the
+    layout token parallelism's per-rank compacted seq lists produce."""
+    rng = np.random.default_rng(8)
+    case = build_case(rng, seqs=[(1, 7), (1, 19), (1, 3)], page_size=8,
+                      pages_per_req=4, num_q_heads=8, num_kv_heads=4,
+                      head_dim=128, max_q=1)
+    # Scatter the three queries to rows 3, 0, 2 of the token array.
+    si = np.asarray(case["seq_info"]).copy()
+    perm = [3, 0, 2]
+    q_old = np.asarray(case["q"])
+    q_new = np.zeros_like(q_old)
+    req_idx = np.full((q_old.shape[0], ), len(perm), np.int32)
+    q_pos = np.zeros((q_old.shape[0], ), np.int32)
+    for r, row in enumerate(perm):
+        q_new[row] = q_old[si[r, 0]]
+        si[r, 0] = row
+        req_idx[row] = r
+        q_pos[row] = si[r, 2] - 1
+    out = ragged_paged_attention_pallas(
+        jnp.asarray(q_new), case["k_pages"], case["v_pages"],
+        jnp.asarray(si), case["num_seqs"], case["block_tables"],
+        sm_scale=0.125, max_q=1, interpret=True)
+    want = naive_ragged_attention(
+        jnp.asarray(q_new), case["k_pages"], case["v_pages"],
+        case["block_tables"], jnp.asarray(req_idx), jnp.asarray(q_pos),
+        sm_scale=0.125)
+    got = np.asarray(out)
+    want = np.asarray(want)
+    for row in perm:
+        np.testing.assert_allclose(got[row], want[row], rtol=2e-3,
+                                   atol=2e-3)
+
+
 def test_inactive_rows_and_bf16():
     rng = np.random.default_rng(3)
     case = build_case(rng, seqs=[(1, 9), (1, 3)], page_size=8,

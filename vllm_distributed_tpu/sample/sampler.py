@@ -2,10 +2,22 @@
 
 Reference: vllm/v1/sample/sampler.py:18 and
 v1/sample/ops/topk_topp_sampler.py:296. TPU-native design: one fused
-static-shape computation over the padded request batch — a single
-descending sort serves top-k, top-p and min-p masking, and sampling is
-Gumbel-argmax over the masked, sorted logits (no host sync, no dynamic
-shapes, vmapped per-request PRNG via fold_in).
+static-shape computation over the padded request batch, built around
+what the TPU is fast at (elementwise O(V) scans, small-k top_k) and
+avoiding what it is slow at (a full vocab sort per step):
+
+* Greedy-only batches (the common serving case) short-circuit to a
+  single argmax under ``lax.cond`` — no sort, no Gumbel.
+* Sampled batches derive top-k / top-p / min-p as per-row THRESHOLD
+  VALUES from a top-``_K_CAP`` partial top_k, mask the full vocab with
+  one compare, and sample by Gumbel-argmax (no [R, V] sort or gather).
+* Rows the prefix cannot resolve exactly (top_k > _K_CAP, or a top-p
+  whose nucleus spills past the prefix) flip a ``lax.cond`` to a
+  full-sort path that computes the SAME thresholds exactly, so the
+  sampled distribution never degrades — it only costs more on the
+  rare batch that needs it. Ties at a threshold keep all tied tokens
+  (the sorted formulation split them by sort order); with float32
+  logits exact ties are measure-zero.
 """
 
 from functools import partial
@@ -22,6 +34,42 @@ _NEG_INF = float("-inf")
 # computes this many so K adds no compile-lattice dimension.
 MAX_LOGPROBS = 20
 
+# Truncation prefix width: top-k/top-p thresholds resolve from a
+# top-_K_CAP partial top_k when the request's filters fit inside it
+# (virtually always in practice); wider filters take the exact
+# full-sort fallback branch.
+_K_CAP = 128
+
+
+def _truncation_thresholds(scaled, topv, top_k, top_p, kcap):
+    """Per-row keep-threshold in scaled-logit space from the descending
+    prefix ``topv`` [R, kcap] (kcap == V makes this exact for any
+    filter). A token survives iff scaled >= threshold.
+
+    top-k: threshold is the k-th largest value. top-p (nucleus): the
+    value of the last entry of the smallest prefix whose mass reaches
+    top_p; computed with the full-vocab softmax normalizer so prefix
+    masses are true probabilities."""
+    R = scaled.shape[0]
+    V = scaled.shape[1]
+    rows = jnp.arange(R, dtype=jnp.int32)
+    # -- top-k threshold (top_k <= 0 or >= V disables).
+    k_on = (top_k > 0) & (top_k < V)
+    k_idx = jnp.clip(top_k - 1, 0, kcap - 1)
+    kth = jnp.where(k_on & (top_k <= kcap), topv[rows, k_idx], _NEG_INF)
+    # -- top-p threshold over true probabilities.
+    logz = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+    p_pref = jnp.exp(topv - logz)  # [R, kcap] true probs of the prefix
+    cum_before = jnp.cumsum(p_pref, axis=-1) - p_pref
+    keep_sorted = cum_before < top_p[:, None]
+    # Value of the last kept prefix entry = min over kept values.
+    cut_p = jnp.min(jnp.where(keep_sorted, topv, jnp.inf), axis=-1)
+    covered = (cum_before[:, -1] + p_pref[:, -1]) >= top_p
+    cut_p = jnp.where((top_p < 1.0) & covered, cut_p, _NEG_INF)
+    resolved = ((~k_on | (top_k <= kcap)) &
+                ((top_p >= 1.0) | covered))
+    return jnp.maximum(kth, cut_p), resolved
+
 
 def _sample_from_logits(
     logits: jax.Array,  # [R, V] float32
@@ -36,41 +84,46 @@ def _sample_from_logits(
 
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # Temperature scale (guard greedy rows against /0; their result is
-    # discarded by the final where()).
-    temp = jnp.maximum(md.temperature, 1e-6)[:, None]
-    scaled = logits / temp
+    def sampled_branch(_):
+        # Temperature scale (guard greedy rows against /0; their result
+        # is discarded by the final where()).
+        temp = jnp.maximum(md.temperature, 1e-6)[:, None]
+        scaled = logits / temp
+        kcap = min(_K_CAP, V)
 
-    # One descending sort powers all three truncations.
-    sorted_logits, sorted_idx = jax.lax.top_k(scaled, V)
+        topv, _idx = jax.lax.top_k(scaled, kcap)
+        thr, resolved = _truncation_thresholds(
+            scaled, topv, md.top_k, md.top_p, kcap)
+        if kcap < V:
+            def exact(_):
+                full, _i = jax.lax.top_k(scaled, V)
+                t, _r = _truncation_thresholds(
+                    scaled, full, md.top_k, md.top_p, V)
+                return t
 
-    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
-    # top-k: keep the first k sorted entries (k=0 -> keep all).
-    k = jnp.where(md.top_k > 0, md.top_k, V)[:, None]
-    keep = ranks < k
+            thr = jax.lax.cond(jnp.all(resolved),
+                               lambda _: thr, exact, None)
+        # min-p in scaled space: p_i >= min_p * p_max  <=>
+        # scaled_i >= log(min_p) + scaled_max (min_p = 0 -> -inf).
+        cut_m = (jnp.log(jnp.maximum(md.min_p, 0.0)) +
+                 scaled.max(axis=-1))
+        thr = jnp.maximum(thr, cut_m)
+        masked = jnp.where(scaled >= thr[:, None], scaled, _NEG_INF)
 
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    # top-p: keep the smallest prefix with cumulative prob >= top_p.
-    # (cumsum - prob) is the mass strictly before each entry; once that
-    # reaches top_p the entry is dropped.
-    cum_before = jnp.cumsum(probs, axis=-1) - probs
-    keep &= cum_before < md.top_p[:, None]
-    # min-p: drop tokens below min_p * max_prob.
-    keep &= probs >= (md.min_p[:, None] * probs[:, 0:1])
+        # Gumbel-argmax over the masked vocab; per-request keys.
+        base = jax.random.PRNGKey(0)
+        keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+            md.seeds.astype(jnp.uint32))
+        gumbel = jax.vmap(
+            lambda key: jax.random.gumbel(key, (V, )))(keys)
+        sampled_ids = jnp.argmax(masked + gumbel,
+                                 axis=-1).astype(jnp.int32)
+        return jnp.where(md.temperature < 1e-5, greedy_ids, sampled_ids)
 
-    masked = jnp.where(keep, sorted_logits, _NEG_INF)
-
-    # Gumbel-argmax over the masked sorted logits; per-request keys.
-    base = jax.random.PRNGKey(0)
-    keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
-        md.seeds.astype(jnp.uint32))
-    gumbel = jax.vmap(
-        lambda key, row: jax.random.gumbel(key, row.shape))(keys, masked)
-    choice_rank = jnp.argmax(masked + gumbel, axis=-1)
-    sampled_ids = jnp.take_along_axis(sorted_idx, choice_rank[:, None],
-                                      axis=1)[:, 0].astype(jnp.int32)
-
-    token_ids = jnp.where(md.temperature < 1e-5, greedy_ids, sampled_ids)
+    # Greedy-only batches (temperature 0 everywhere) skip the whole
+    # truncation/Gumbel pipeline — one argmax.
+    token_ids = jax.lax.cond(jnp.any(md.temperature >= 1e-5),
+                             sampled_branch, lambda _: greedy_ids, None)
 
     # Logprob of the chosen token under the raw (untempered, untruncated)
     # distribution.
