@@ -229,3 +229,104 @@ def test_extended_no_op_matches_plain():
                                                                        0]),
                                rtol=1e-5)
     assert top_ids[:, 0].tolist() == ids.tolist()
+
+
+# ---------------------------------------------------------------------------
+# Rejection-sampling spec verification (sampler.spec_verify_rejection)
+# ---------------------------------------------------------------------------
+
+def _verify_md(R, S1, temperature):
+    """Per-row metadata with [R*S1] per-position seeds (the layout the
+    runner's dispatch builds)."""
+    seeds = (np.arange(R, dtype=np.int64)[:, None] * 131 +
+             7919 * np.arange(S1)[None, :])
+    return SamplingMetadata(
+        temperature=jnp.full((R, ), temperature, jnp.float32),
+        top_k=jnp.zeros((R, ), jnp.int32),
+        top_p=jnp.ones((R, ), jnp.float32),
+        min_p=jnp.zeros((R, ), jnp.float32),
+        seeds=jnp.asarray(seeds.reshape(-1)),
+    )
+
+
+def test_spec_verify_rejection_distribution_exact():
+    """Emitted first tokens must be distributed exactly as the tempered
+    target regardless of the draft distribution q: the accept test is
+    min(1, p/q) and the rejection resample uses the exact residual
+    max(p - q, 0)/Z."""
+    from vllm_distributed_tpu.sample.sampler import spec_verify_rejection
+    rng = np.random.default_rng(0)
+    V, S, K, temp = 8, 1, 8, 1.0
+    R = 20000  # rows = independent trials (distinct seeds per row)
+    S1 = S + 1
+
+    target_logits = rng.standard_normal(V).astype(np.float32) * 1.5
+    q_logits = rng.standard_normal(V).astype(np.float32) * 1.5
+    p = np.exp(target_logits) / np.exp(target_logits).sum()
+    q = np.exp(q_logits) / np.exp(q_logits).sum()
+
+    drafts = rng.choice(V, size=(R, S), p=q).astype(np.int32)
+    q_ids = np.tile(np.arange(V, dtype=np.int32), (R, S, 1))
+    q_probs = np.tile(q.astype(np.float32), (R, S, 1))
+    logits = np.tile(target_logits, (R, S1, 1))
+
+    accept, residual, _bonus, _lpc, _lpb = spec_verify_rejection(
+        jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(q_ids),
+        jnp.asarray(q_probs), _verify_md(R, S1, temp))
+    accept = np.asarray(accept)
+    residual = np.asarray(residual)
+
+    emitted = np.where(accept[:, 0], drafts[:, 0], residual[:, 0])
+    freq = np.bincount(emitted, minlength=V) / R
+    # Exactness: empirical distribution matches p within Monte-Carlo
+    # noise (3 sigma ~ 3*sqrt(p(1-p)/R) < 0.011 for any p).
+    np.testing.assert_allclose(freq, p, atol=0.015)
+    # Acceptance must beat the prefix-match rate sum(p*q) when q is
+    # closer to p than independence: the expected accept prob is
+    # sum(min(p, q)) > sum(p*q).
+    accept_rate = accept[:, 0].mean()
+    np.testing.assert_allclose(accept_rate, np.minimum(p, q).sum(),
+                               atol=0.02)
+    assert accept_rate > float((p * q).sum()) + 0.05
+
+
+def test_spec_verify_greedy_rows_prefix_match():
+    """temperature = 0 rows accept iff the target argmax equals the
+    draft and emit the argmax on rejection."""
+    from vllm_distributed_tpu.sample.sampler import spec_verify_rejection
+    V, S, K = 8, 2, 4
+    R, S1 = 2, S + 1
+    logits = np.zeros((R, S1, V), np.float32)
+    logits[:, :, 5] = 3.0  # argmax = 5 at every position
+    drafts = np.asarray([[5, 5], [5, 2]], np.int32)
+    q_ids = np.zeros((R, S, K), np.int32)
+    q_ids[..., 0] = drafts
+    q_probs = np.zeros((R, S, K), np.float32)
+    q_probs[..., 0] = 1.0
+    accept, residual, bonus, _lpc, _lpb = spec_verify_rejection(
+        jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(q_ids),
+        jnp.asarray(q_probs), _verify_md(R, S1, 0.0))
+    assert np.asarray(accept).tolist() == [[True, True], [True, False]]
+    assert int(np.asarray(bonus)[0]) == 5
+    assert int(np.asarray(residual)[1, 1]) == 5
+
+
+def test_spec_verify_no_draft_rows_emit_plain_sample():
+    """Rows with no drafts (all -1, zero q) reject at position 0 and the
+    residual IS a plain tempered-target sample (q = 0 -> residual = p)."""
+    from vllm_distributed_tpu.sample.sampler import spec_verify_rejection
+    rng = np.random.default_rng(1)
+    V, S, K = 16, 2, 4
+    R, S1 = 8000, S + 1
+    target = rng.standard_normal(V).astype(np.float32)
+    p = np.exp(target) / np.exp(target).sum()
+    logits = np.tile(target, (R, S1, 1))
+    drafts = np.full((R, S), -1, np.int32)
+    q_ids = np.zeros((R, S, K), np.int32)
+    q_probs = np.zeros((R, S, K), np.float32)
+    accept, residual, _b, _lpc, _lpb = spec_verify_rejection(
+        jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(q_ids),
+        jnp.asarray(q_probs), _verify_md(R, S1, 1.0))
+    assert not np.asarray(accept).any()
+    freq = np.bincount(np.asarray(residual)[:, 0], minlength=V) / R
+    np.testing.assert_allclose(freq, p, atol=0.02)

@@ -220,6 +220,101 @@ def sample_tokens_extended(
     return token_ids, chosen_logprob, top_vals, top_ids.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=())
+def spec_verify_rejection(
+    logits: jax.Array,  # [R, S1, V] target logits (S1 = S drafts + 1)
+    drafts: jax.Array,  # [R, S] int32 proposed tokens (-1 = no draft)
+    q_ids: jax.Array,  # [R, S, K] int32 draft support token ids
+    q_probs: jax.Array,  # [R, S, K] f32 draft probs on the support
+    md: SamplingMetadata,  # per-row (R); seeds [R, S1] per position
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """True stochastic rejection sampling for learned drafters
+    (reference: v1/sample/rejection_sampler.py:23).
+
+    The drafter samples from a truncated distribution q with support
+    ``q_ids`` (K tokens) and probabilities ``q_probs``; position s of a
+    row is accepted with prob min(1, p(d)/q(d)) under the TEMPERED
+    target p, and the first rejected position resamples from the exact
+    residual max(p - q, 0)/Z — together emitting tokens distributed
+    exactly as p (Leviathan et al.; the reference kernel implements the
+    same test). Greedy rows (temperature < 1e-5) accept iff the target
+    argmax equals the draft — the deterministic limit of the same rule.
+
+    Returns (accept [R, S] bool, residual [R, S] int32, bonus [R] int32,
+    lp_cand [R, S, 2] raw logprobs of (draft, residual) per position,
+    lp_bonus [R]) — everything the host needs to assemble the emitted
+    prefix, with only O(R*S) transferred.
+    """
+    R, S1, V = logits.shape
+    S = S1 - 1
+    temp = jnp.maximum(md.temperature, 1e-6)[:, None, None]
+    logp = jax.nn.log_softmax(logits / temp, axis=-1)  # tempered target
+    p = jnp.exp(logp)
+
+    rowsR = jnp.arange(R, dtype=jnp.int32)[:, None]
+    sidx = jnp.arange(S, dtype=jnp.int32)[None, :]
+    d_safe = jnp.maximum(drafts, 0)
+    p_d = p[rowsR, sidx, d_safe]  # [R, S] target prob of each draft
+    # Draft prob of its own sample: find d in the support row.
+    match = q_ids == drafts[..., None]  # [R, S, K]
+    q_d = jnp.where(match, q_probs, 0.0).sum(-1)  # [R, S]
+
+    base = jax.random.PRNGKey(1)
+    seeds = md.seeds.reshape(R, S1).astype(jnp.uint32)
+    ukeys = jax.vmap(jax.vmap(lambda s: jax.random.fold_in(base, s)))(
+        seeds)
+    u = jax.vmap(jax.vmap(
+        lambda k: jax.random.uniform(k, ())))(ukeys)  # [R, S1]
+
+    greedy = md.temperature < 1e-5
+    argmax_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R,S1]
+    # u < p/q without the divide; q_d == 0 (draft outside its own
+    # support — impossible for a well-formed proposer) never accepts.
+    accept_stoch = jnp.logical_and(u[:, :S] * q_d < p_d, q_d > 0)
+    accept_greedy = argmax_tok[:, :S] == drafts
+    accept = jnp.where(greedy[:, None], accept_greedy, accept_stoch)
+    accept = jnp.logical_and(accept, drafts >= 0)
+
+    # Exact residual: scatter q onto the vocab, r = max(p - q, 0).
+    q_full = jnp.zeros((R, S, V), p.dtype).at[
+        rowsR[..., None], sidx[..., None], q_ids].add(
+            q_probs, mode="drop")
+    resid = jnp.maximum(p[:, :S] - q_full, 0.0)
+    # Gumbel over log-residual; per-(row, pos) keys derived from the
+    # same seeds with a distinct stream constant.
+    rbase = jax.random.PRNGKey(2)
+    rkeys = jax.vmap(jax.vmap(lambda s: jax.random.fold_in(rbase, s)))(
+        seeds[:, :S])
+    g = jax.vmap(jax.vmap(
+        lambda k: jax.random.gumbel(k, (V, ))))(rkeys)
+    log_resid = jnp.where(resid > 0, jnp.log(jnp.maximum(resid, 1e-30)),
+                          _NEG_INF)
+    residual = jnp.argmax(log_resid + g, axis=-1).astype(jnp.int32)
+    # Degenerate rows (p <= q everywhere numerically): fall back to the
+    # tempered target sample so an emit is always valid.
+    any_resid = (resid > 0).any(axis=-1)
+    fallback = jnp.argmax(
+        logp[:, :S] + g, axis=-1).astype(jnp.int32)
+    residual = jnp.where(any_resid, residual, fallback)
+    residual = jnp.where(greedy[:, None], argmax_tok[:, :S], residual)
+
+    # Bonus token (all drafts accepted): regular sample at position S.
+    bkeys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+        seeds[:, S])
+    bg = jax.vmap(lambda k: jax.random.gumbel(k, (V, )))(bkeys)
+    bonus = jnp.argmax(logp[:, S] + bg, axis=-1).astype(jnp.int32)
+    bonus = jnp.where(greedy, argmax_tok[:, S], bonus)
+
+    # Raw (untempered) logprobs of every candidate emit: drafts,
+    # residuals, bonus — the host assembles the emitted prefix.
+    raw_lp = jax.nn.log_softmax(logits, axis=-1)
+    lp_draft = raw_lp[rowsR, sidx, d_safe]
+    lp_resid = raw_lp[rowsR, sidx, residual]
+    lp_bonus = raw_lp[jnp.arange(R), S, bonus]
+    return (accept, residual, bonus,
+            jnp.stack([lp_draft, lp_resid], axis=-1), lp_bonus)
+
+
 def compute_topk_logprobs(logits: jax.Array,
                           num_logprobs: int) -> tuple[jax.Array, jax.Array]:
     """Top-k logprobs for API `logprobs=k` requests (reference:

@@ -14,9 +14,15 @@ checkpoint). TPU-first re-design:
   contexts for zero persistent draft state (the reference instead runs
   its drafter against its own KV cache, eagle.py:120).
 * Proposals are batched over all requests needing drafts ([R, W] in one
-  jit keyed by the R bucket) and sampled greedily — verification by the
-  existing S+1-position prefix-match sampler keeps the output
-  distribution exactly the target's regardless of draft quality.
+  jit keyed by the R bucket). Rows with temperature > 0 SAMPLE their
+  drafts from the top-``SUPPORT_K`` truncated tempered draft
+  distribution and carry that support (token ids + probabilities) back
+  as q-metadata, so verification can run true stochastic rejection
+  sampling (accept-with-prob min(1, p/q) + exact residual resample —
+  reference: v1/sample/rejection_sampler.py:23) instead of the
+  strictly-lower-acceptance prefix match. Greedy rows draft greedily
+  with a delta support; either way the emitted distribution is exactly
+  the target's.
 * The draft runs the XLA attention path against a throwaway in-jit
   cache (tiny shapes; the Pallas kernel would add nothing at window
   scale).
@@ -37,6 +43,37 @@ from vllm_distributed_tpu.utils import cdiv, make_buckets, pad_to_bucket
 logger = init_logger(__name__)
 
 _PAGE = 8  # draft-cache page size (kernel-independent; XLA path)
+
+# Truncated draft-distribution support width: the proposer samples from
+# its top-SUPPORT_K renormalized distribution and reports (ids, probs)
+# on that support. Rejection sampling is exact w.r.t. this truncated q
+# regardless of the width — K only bounds how spread proposals can be.
+SUPPORT_K = 8
+
+
+def sample_draft_step(logits, temps, seeds, step):
+    """One stochastic draft sample per row from the top-SUPPORT_K
+    truncated tempered distribution. Returns (token [R], support ids
+    [R, K], support probs [R, K]); greedy rows (temp < 1e-5) emit their
+    argmax with a delta support."""
+    R, V = logits.shape
+    kcap = min(SUPPORT_K, V)
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    topv, topi = jax.lax.top_k(logits / temp, kcap)  # [R, K]
+    probs = jax.nn.softmax(topv, axis=-1)  # renormalized on the support
+    base = jax.random.PRNGKey(3)
+    keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+        (seeds + 104729 * step).astype(jnp.uint32))
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (kcap, )))(keys)
+    choice = jnp.argmax(jnp.log(jnp.maximum(probs, 1e-30)) + g, axis=-1)
+    rows = jnp.arange(R, dtype=jnp.int32)
+    tok = topi[rows, choice].astype(jnp.int32)
+    greedy = temps < 1e-5
+    tok = jnp.where(greedy, topi[:, 0].astype(jnp.int32), tok)
+    delta = jnp.zeros((R, kcap),
+                      probs.dtype).at[:, 0].set(1.0)
+    probs = jnp.where(greedy[:, None], delta, probs)
+    return tok, topi.astype(jnp.int32), probs
 
 
 class DraftModelProposer:
@@ -79,7 +116,9 @@ class DraftModelProposer:
         for R in self.req_buckets:
             drafts = self._fn(self.params,
                               jnp.zeros((R, self.window), jnp.int32),
-                              jnp.ones((R, ), jnp.int32), R=R)
+                              jnp.ones((R, ), jnp.int32),
+                              jnp.zeros((R, ), jnp.float32),
+                              jnp.zeros((R, ), jnp.int64), R=R)
             jax.block_until_ready(drafts)
         return len(self.req_buckets)
 
@@ -89,7 +128,7 @@ class DraftModelProposer:
         W, k = self.window, self.k
         ppr = cdiv(W + k, _PAGE)
 
-        def propose(params, windows, lens, *, R):
+        def propose(params, windows, lens, temps, seeds, *, R):
             # [R, W] left-aligned token windows, lens in [1, W].
             caches = model.make_kv_caches(R * ppr, _PAGE)
             bt = (jnp.arange(R, dtype=jnp.int32)[:, None] * ppr +
@@ -110,9 +149,9 @@ class DraftModelProposer:
             hidden, caches = model.forward(params, caches, tok, batch)
             last = (jnp.arange(R, dtype=jnp.int32) * W + lens - 1)
             logits = model.compute_logits(params, hidden[last])
-            t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t0, ids0, p0 = sample_draft_step(logits, temps, seeds, 0)
 
-            def step(carry, _):
+            def step(carry, j):
                 caches, tok_r, pos_r = carry
                 slot_r = jnp.arange(R, dtype=jnp.int32) * (ppr * _PAGE) \
                     + pos_r
@@ -122,22 +161,30 @@ class DraftModelProposer:
                     block_tables=bt, seq_lens=pos_r + 1)
                 h, caches = model.forward(params, caches, tok_r, b)
                 lg = model.compute_logits(params, h)
-                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                return (caches, nxt, pos_r + 1), nxt
+                nxt, ids_j, p_j = sample_draft_step(lg, temps, seeds, j)
+                return (caches, nxt, pos_r + 1), (nxt, ids_j, p_j)
 
-            (_, _, _), rest = jax.lax.scan(
-                step, (caches, t0, lens), None, length=k - 1)
-            drafts = jnp.concatenate(
-                [t0[None], rest], axis=0).T  # [R, k]
-            return drafts
+            (_, _, _), (rest, ids_r, p_r) = jax.lax.scan(
+                step, (caches, t0, lens),
+                jnp.arange(1, k, dtype=jnp.int32))
+            drafts = jnp.concatenate([t0[None], rest], axis=0).T  # [R,k]
+            q_ids = jnp.concatenate(
+                [ids0[None], ids_r], axis=0).transpose(1, 0, 2)
+            q_probs = jnp.concatenate(
+                [p0[None], p_r], axis=0).transpose(1, 0, 2)
+            return drafts, q_ids, q_probs
 
         return propose
 
     # ------------------------------------------------------------------
-    def propose_batch(self, histories: list[np.ndarray]) -> list[list[int]]:
-        """One window per request history -> k greedy draft tokens each."""
+    def propose_batch(self, histories: list[np.ndarray],
+                      temps: Optional[np.ndarray] = None,
+                      seeds: Optional[np.ndarray] = None):
+        """One window per request history -> k draft tokens each, plus
+        the truncated draft-support metadata ([k, K] ids and probs per
+        request) rejection-sampling verification consumes."""
         if not histories:
-            return []
+            return [], []
         n = len(histories)
         R = pad_to_bucket(n, self.req_buckets)
         W = self.window
@@ -147,6 +194,16 @@ class DraftModelProposer:
             w = h[-W:]
             windows[i, :len(w)] = w
             lens[i] = len(w)
-        drafts = np.asarray(self._fn(self.params, jnp.asarray(windows),
-                                     jnp.asarray(lens), R=R))
-        return [[int(t) for t in drafts[i]] for i in range(n)]
+        temps_a = np.zeros((R, ), np.float32)
+        if temps is not None:
+            temps_a[:n] = temps
+        seeds_a = np.zeros((R, ), np.int64)
+        if seeds is not None:
+            seeds_a[:n] = seeds
+        drafts, q_ids, q_probs = self._fn(
+            self.params, jnp.asarray(windows), jnp.asarray(lens),
+            jnp.asarray(temps_a), jnp.asarray(seeds_a), R=R)
+        drafts = np.asarray(drafts)
+        meta = list(zip(np.asarray(q_ids), np.asarray(q_probs)))
+        return ([[int(t) for t in drafts[i]] for i in range(n)],
+                meta[:n])

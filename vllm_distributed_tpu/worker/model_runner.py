@@ -123,6 +123,11 @@ class TPUModelRunner:
                                                    "draft_model") else 0)
         self.proposer = None
         self._draft_spec = None
+        # Per-request truncated draft-support metadata ([S, K] ids and
+        # probs) written at proposal time, read by next step's
+        # rejection verifier (see sample/sampler.py
+        # spec_verify_rejection).
+        self._draft_meta: dict[str, tuple] = {}
         if self.spec_k and spec.method == "ngram":
             from vllm_distributed_tpu.spec_decode.ngram_proposer import \
                 NgramProposer
@@ -341,12 +346,38 @@ class TPUModelRunner:
                 lp, min(MAX_LOGPROBS, lp.shape[-1]))
             return tgt, topv, topi
 
+        def spec_verify(params, hidden_sel, drafts, q_ids, q_probs,
+                        sampling_md: SamplingMetadata):
+            """Logits + true rejection-sampling verification in one
+            graph (reference: v1/sample/rejection_sampler.py:23); keyed
+            by the R bucket like the plain sampler."""
+            import dataclasses as _dc
+
+            from vllm_distributed_tpu.sample.sampler import \
+                spec_verify_rejection
+            logits = model.compute_logits(params, hidden_sel)
+            R = drafts.shape[0]
+            S1 = hidden_sel.shape[0] // R
+            # The dispatch path builds [R*S1]-expanded metadata (the
+            # plain sampler's layout); the verifier wants per-row fields
+            # and the per-position seeds.
+            md_r = _dc.replace(
+                sampling_md,
+                temperature=sampling_md.temperature.reshape(R, S1)[:, 0],
+                top_k=sampling_md.top_k.reshape(R, S1)[:, 0],
+                top_p=sampling_md.top_p.reshape(R, S1)[:, 0],
+                min_p=sampling_md.min_p.reshape(R, S1)[:, 0])
+            return spec_verify_rejection(
+                logits.reshape(R, S1, logits.shape[-1]), drafts, q_ids,
+                q_probs, md_r)
+
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
         self._plp_fn = jax.jit(prompt_lp)
         self._sample_fn = jax.jit(sample)
         self._sample_ext_fn = jax.jit(sample_ext,
                                       static_argnames=("want_topk", ))
+        self._spec_verify_fn = jax.jit(spec_verify)
         self._build_multi_step_fn()
 
     def _build_multi_step_fn(self) -> None:
@@ -401,6 +432,7 @@ class TPUModelRunner:
                 if row is not None and self.input_batch.lora_slot[row]:
                     self.lora_manager.release(
                         int(self.input_batch.lora_slot[row]))
+            self._draft_meta.pop(req_id, None)
             self.input_batch.remove_request(req_id)
         for new_req in scheduler_output.scheduled_new_reqs:
             row = self.input_batch.add_request(new_req)
@@ -576,17 +608,36 @@ class TPUModelRunner:
             # (the committed token + its drafts), padded to S+1 rows by
             # repeating the last index; drafts pad with -1 (never equal a
             # sampled token, so padding positions reject).
+            from vllm_distributed_tpu.spec_decode.draft_model import \
+                SUPPORT_K
             verify_idx = np.zeros((R, S1), np.int32)
             drafts_arr = np.full((R, self.spec_k), -1, np.int32)
+            # Draft-support metadata for rejection-sampling verification:
+            # proposers that sampled stochastically recorded their
+            # truncated support; deterministic proposals (ngram, greedy
+            # drafts) are a delta at the draft token — min(1, p/q) with
+            # q = 1 accepts with exactly prob p(d), the same rate the
+            # old prefix match achieved, so one verifier serves all.
+            q_ids = np.zeros((R, self.spec_k, SUPPORT_K), np.int32)
+            q_probs = np.zeros((R, self.spec_k, SUPPORT_K), np.float32)
             for i, li in enumerate(logits_idx):
                 D = len(spec_drafts[i])
                 verify_idx[i] = li  # default: repeat the last position
                 verify_idx[i, :D + 1] = np.arange(li - D, li + 1)
                 if D:
                     drafts_arr[i, :D] = spec_drafts[i]
+                    meta = self._draft_meta.get(sampling_req_ids[i])
+                    if meta is not None and meta[0].shape[1] == SUPPORT_K:
+                        m_ids, m_probs = meta
+                        q_ids[i, :D] = m_ids[:D]
+                        q_probs[i, :D] = m_probs[:D]
+                    else:
+                        q_ids[i, :D, 0] = spec_drafts[i]
+                        q_probs[i, :D, 0] = 1.0
             logits_indices = verify_idx.reshape(-1)
         else:
             drafts_arr = None
+            q_ids = q_probs = None
             logits_indices = np.asarray(logits_idx + [0] *
                                         (R - len(logits_idx)), np.int32)
 
@@ -743,7 +794,8 @@ class TPUModelRunner:
             plp = (jnp.asarray(rows_np), jnp.asarray(tgt_np), plp_meta)
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
-                sampling_req_ids, (T, max_q, G), R, drafts_arr, ext_md,
+                sampling_req_ids, (T, max_q, G), R,
+                (drafts_arr, q_ids, q_probs), ext_md,
                 want_topk, vocab_mask, plp)
 
     # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
@@ -881,8 +933,9 @@ class TPUModelRunner:
             return {"ready": self._execute_multi_step(scheduler_output)}
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
-         fwd_shape, R, drafts_arr, ext_md, want_topk, vocab_mask,
+         fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
          plp) = self._prepare_inputs(scheduler_output)
+        drafts_arr, q_ids, q_probs = spec_pack
 
         kv_meta = scheduler_output.kv_connector_metadata
         if self.kv_connector is not None and kv_meta is not None:
@@ -890,12 +943,22 @@ class TPUModelRunner:
             # (reference: maybe_setup_kv_connector/start_load_kv).
             self.kv_connector.start_load_kv(kv_meta, self)
 
+        # Rejection-sampling verification handles every spec batch
+        # except extended/structured ones (those rows never carry
+        # drafts; the plain expanded sampler + host prefix match stays
+        # exact for them).
+        spec_q = None
+        if (self.spec_k and ext_md is None and vocab_mask is None):
+            spec_q = (jnp.asarray(drafts_arr), jnp.asarray(q_ids),
+                      jnp.asarray(q_probs))
         dev = self._launch_device_step(token_ids, batch, logits_indices,
                                        sampling_md, fwd_shape, ext_md,
-                                       want_topk, vocab_mask, plp=plp)
+                                       want_topk, vocab_mask, plp=plp,
+                                       spec_q=spec_q)
         return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
                 "sampling_req_ids": sampling_req_ids,
                 "drafts_arr": drafts_arr, "R": R,
+                "specv": spec_q is not None,
                 "plp_meta": plp[2] if plp else None}
 
     def wait_model(self, handle: dict) -> ModelRunnerOutput:
@@ -909,7 +972,15 @@ class TPUModelRunner:
         drafts_arr = handle["drafts_arr"]
         R = handle["R"]
 
-        tokens_np, logprobs_np, topk_np = self._fetch_sample(handle["dev"])
+        if handle.get("specv"):
+            verify = handle["dev"][0]
+            (accept_np, residual_np, bonus_np, lp_cand_np,
+             lp_bonus_np) = (np.asarray(jax.device_get(x))
+                             for x in verify)
+            tokens_np = logprobs_np = topk_np = None
+        else:
+            tokens_np, logprobs_np, topk_np = self._fetch_sample(
+                handle["dev"])
 
         # Embedding requests: the pooled hidden state of the sampled row
         # is the result; no token is emitted (reference: pooling path of
@@ -950,15 +1021,55 @@ class TPUModelRunner:
 
         req_ids, sampled, lps = [], [], []
         spec_out: Optional[list[list[int]]] = [] if self.spec_k else None
-        if self.spec_k:
+        if self.spec_k and handle.get("specv"):
+            # Rejection-sampling verification (reference:
+            # v1/sample/rejection_sampler.py): the longest accepted
+            # draft prefix, then either the exact-residual resample at
+            # the first rejection or the bonus sample after a clean
+            # sweep. Emitted tokens are distributed exactly as the
+            # target regardless of draft quality.
+            S = self.spec_k
+            n_acc = np.cumprod(accept_np.astype(np.int64),
+                               axis=1).sum(axis=1)
+            for i, req_id in enumerate(sampling_req_ids):
+                n_draft = int((drafts_arr[i] >= 0).sum())
+                if n_draft:
+                    self.spec_num_drafts += 1
+                    self.spec_num_draft_tokens += n_draft
+                    self.spec_num_accepted_tokens += int(n_acc[i])
+                if req_id in pooled:
+                    req_ids.append(req_id)
+                    sampled.append([])
+                    lps.append([])
+                    continue
+                na = int(n_acc[i])
+                emitted = [int(t) for t in drafts_arr[i, :na]]
+                elps = [float(x) for x in lp_cand_np[i, :na, 0]]
+                if na == S:
+                    emitted.append(int(bonus_np[i]))
+                    elps.append(float(lp_bonus_np[i]))
+                else:
+                    emitted.append(int(residual_np[i, na]))
+                    elps.append(float(lp_cand_np[i, na, 1]))
+                for tok in emitted:
+                    self.input_batch.append_token(req_id, tok)
+                req_ids.append(req_id)
+                sampled.append(emitted)
+                lps.append([{tok: lp}
+                            for tok, lp in zip(emitted, elps)])
+            draft_map = self._propose_drafts_all(
+                [r for r in sampling_req_ids if r not in pooled])
+            spec_out.extend(draft_map.get(r, []) if r not in pooled
+                            else [] for r in sampling_req_ids)
+        elif self.spec_k:
             S1 = self.spec_k + 1
             toks = tokens_np.reshape(R, S1)
             lp2 = logprobs_np.reshape(R, S1)
-            # Accept the longest draft prefix the per-position target
-            # samples agree with; position i's sample IS the emitted
-            # token, so the output distribution equals non-spec sampling
-            # (reference: v1/sample/rejection_sampler.py semantics for
-            # deterministic ngram drafts).
+            # Extended/structured batches: accept the longest draft
+            # prefix the per-position target samples agree with;
+            # position i's sample IS the emitted token, so the output
+            # distribution equals non-spec sampling (the deterministic
+            # limit of rejection sampling).
             match = toks[:, :self.spec_k] == drafts_arr
             accepted = np.cumprod(match.astype(np.int64), axis=1)
             num_emitted = 1 + accepted.sum(axis=1)
@@ -1096,7 +1207,7 @@ class TPUModelRunner:
 
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
-                            vocab_mask=None, plp=None):
+                            vocab_mask=None, plp=None, spec_q=None):
         """Enqueue one step's device work WITHOUT blocking: JAX dispatch
         is asynchronous, so the host returns as soon as the programs are
         queued. The pipeline-parallel engine core exploits this to keep
@@ -1110,13 +1221,16 @@ class TPUModelRunner:
                     self.params, self.kv_caches, token_ids, batch)
             return self._launch_sample(hidden, logits_indices, sampling_md,
                                        ext_md, want_topk, self.mesh,
-                                       vocab_mask, plp=plp)
+                                       vocab_mask, plp=plp, spec_q=spec_q)
 
     def _launch_sample(self, hidden, logits_indices, sampling_md, ext_md,
-                       want_topk, mesh, vocab_mask=None, plp=None):
+                       want_topk, mesh, vocab_mask=None, plp=None,
+                       spec_q=None):
         """Row gather + (extended) sampling on ``mesh``, dispatch only;
         shared by the single-program and pipeline-parallel step paths.
-        Returns device arrays (tokens, logprobs, (topv, topi) | None)."""
+        Returns device arrays (tokens, logprobs, (topv, topi) | None);
+        with ``spec_q`` the first slot instead carries the rejection
+        verifier's output tuple."""
         n_rows = logits_indices.shape[0]  # R or R*(S+1) with spec
         topk_dev = None
         plp_dev = None
@@ -1127,6 +1241,13 @@ class TPUModelRunner:
                 plp_dev = self._plp_fn(self.params, sel, targets)
         hidden_sel = self._gather_sample_rows(hidden, logits_indices,
                                               mesh=mesh)
+        if spec_q is not None:
+            drafts_d, q_ids_d, q_probs_d = spec_q
+            with self._compile_watch(("specv", n_rows)):
+                verify = self._spec_verify_fn(
+                    self.params, hidden_sel, drafts_d, q_ids_d,
+                    q_probs_d, sampling_md)
+            return verify, None, None, hidden_sel, plp_dev
         if ext_md is not None:
             with self._compile_watch(("sampleX", n_rows, want_topk,
                                       vocab_mask is not None)):
@@ -1197,8 +1318,23 @@ class TPUModelRunner:
         if not eligible:
             return {}
         if hasattr(self.proposer, "propose_batch"):
-            drafts = self.proposer.propose_batch(
-                [h for _, h in eligible])
+            ib = self.input_batch
+            rows = [ib.req_id_to_index[rid] for rid, _ in eligible]
+            # Stochastic proposals sample with each request's own
+            # temperature; the support metadata feeds next step's
+            # rejection verifier (seed stream distinct from the
+            # verifier's so draft and accept randomness stay
+            # independent for seeded requests).
+            temps = ib.temperature[rows].astype(np.float32)
+            user_seed = ib.seed[rows]
+            seeds = np.where(
+                user_seed >= 0,
+                user_seed * 999983 + ib.num_tokens[rows],
+                self._rng.integers(0, 2**31 - 1, size=len(rows)))
+            drafts, meta = self.proposer.propose_batch(
+                [h for _, h in eligible], temps, seeds)
+            for (rid, _), m in zip(eligible, meta):
+                self._draft_meta[rid] = m
             return {rid: d for (rid, _), d in zip(eligible, drafts)}
         return {rid: self.proposer.propose(h) for rid, h in eligible}
 
@@ -1442,6 +1578,19 @@ class TPUModelRunner:
                 tokens, _ = self._sample_fn(self.params, hidden_sel, md)
             jax.block_until_ready(tokens)
             n += 1
+            if self.spec_k:
+                from vllm_distributed_tpu.spec_decode.draft_model import \
+                    SUPPORT_K
+                with self._compile_watch(("specv", rows)):
+                    verify = self._spec_verify_fn(
+                        self.params, hidden_sel,
+                        jnp.full((R, self.spec_k), -1, jnp.int32),
+                        jnp.zeros((R, self.spec_k, SUPPORT_K),
+                                  jnp.int32),
+                        jnp.zeros((R, self.spec_k, SUPPORT_K),
+                                  jnp.float32), md)
+                jax.block_until_ready(verify[0])
+                n += 1
             ext = ExtendedSamplingMetadata(
                 hist_tokens=jnp.zeros((rows, self.max_model_len),
                                       jnp.int32),

@@ -156,7 +156,7 @@ class PPModelRunner(TPUModelRunner):
 
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
-                            vocab_mask=None, plp=None):
+                            vocab_mask=None, plp=None, spec_q=None):
         sm0 = self.stage_meshes[0]
         with global_mesh(sm0), sm0:
             with self._compile_watch(("embed", fwd_shape[0])):
@@ -183,7 +183,8 @@ class PPModelRunner(TPUModelRunner):
         with global_mesh(sml), sml:
             return self._launch_sample(hidden, logits_indices,
                                        sampling_md, ext_md, want_topk,
-                                       sml, vocab_mask, plp=plp)
+                                       sml, vocab_mask, plp=plp,
+                                       spec_q=spec_q)
 
     # ------------------------------------------------------------------
     def precompile(self) -> None:
