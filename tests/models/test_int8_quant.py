@@ -192,3 +192,27 @@ def test_fp8_kv_cache_under_tp2(checkpoint):
         raise AssertionError("did not finish")
 
     assert run(base) == run(tp2)
+
+
+def test_quant_decode_via_fused_pallas_kernel(checkpoint, monkeypatch):
+    """With the pallas backend on one chip, decode-sized weight-only
+    dots route through the fused dequant-GEMM kernel
+    (ops/pallas_quant_matmul.py): greedy output must match the XLA
+    dequant-in-dot path exactly."""
+    monkeypatch.setenv("VDT_ATTENTION_BACKEND", "xla")
+    base = make_engine(checkpoint, quantization="int4")
+    sp = [SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)]
+
+    def run_one(engine):
+        engine.add_request("k", PROMPT, sp[0])
+        for _ in range(100):
+            for out in engine.step():
+                if out.finished:
+                    return out.outputs[0].token_ids
+        raise AssertionError("did not finish")
+
+    want = run_one(base)
+    monkeypatch.setenv("VDT_ATTENTION_BACKEND", "pallas")
+    fused = make_engine(checkpoint, quantization="int4")
+    got = run_one(fused)
+    assert got == want

@@ -1,0 +1,54 @@
+"""Fused dequant-GEMM kernel vs the XLA dequantize-then-dot reference
+(reference capability: csrc/quantization/gptq_marlin fused kernels)."""
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from vllm_distributed_tpu.ops.pallas_quant_matmul import quant_matmul
+
+
+def _quantize(w32, scheme):
+    absmax = np.max(np.abs(w32), axis=0, keepdims=True)
+    if scheme == "int8":
+        scale = np.maximum(absmax / 127.0, 1e-8)
+        q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    elif scheme == "int4":
+        scale = np.maximum(absmax / 7.0, 1e-8)
+        q = np.clip(np.round(w32 / scale), -8, 7).astype(ml_dtypes.int4)
+    else:
+        scale = np.maximum(absmax / 448.0, 1e-8)
+        q = (w32 / scale).astype(ml_dtypes.float8_e4m3fn)
+    return q, scale.astype(np.float32)
+
+
+@pytest.mark.parametrize("scheme", ["int4", "int8", "fp8"])
+@pytest.mark.parametrize("shape", [(8, 256, 128), (17, 512, 384),
+                                   (4, 64, 64)])
+def test_matches_dequant_reference(scheme, shape):
+    T, K, N = shape
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((T, K)).astype(np.float32)
+    w32 = rng.standard_normal((K, N)).astype(np.float32)
+    q, scale = _quantize(w32, scheme)
+
+    got = quant_matmul(jnp.asarray(x), jnp.asarray(q),
+                       jnp.asarray(scale), interpret=True)
+    want = x @ (np.asarray(q, np.float32) * scale)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2,
+                               atol=2e-2 * np.abs(want).max())
+
+
+def test_bf16_activations():
+    rng = np.random.default_rng(1)
+    T, K, N = 8, 256, 128
+    x = rng.standard_normal((T, K)).astype(np.float32)
+    w32 = rng.standard_normal((K, N)).astype(np.float32)
+    q, scale = _quantize(w32, "int4")
+    got = quant_matmul(jnp.asarray(x, jnp.bfloat16), jnp.asarray(q),
+                       jnp.asarray(scale), interpret=True)
+    want = x @ (np.asarray(q, np.float32) * scale)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.float32(np.asarray(got)), want,
+                               rtol=5e-2, atol=5e-2 * np.abs(want).max())

@@ -340,7 +340,7 @@ class LoadConfig:
 class SpeculativeConfig:
     """Speculative decoding (reference: vllm/config.py:2502)."""
 
-    method: Optional[str] = None  # ngram | draft_model | None
+    method: Optional[str] = None  # ngram | draft_model | eagle | None
     num_speculative_tokens: int = 0
     # ngram proposer window (reference: v1/spec_decode/ngram_proposer.py).
     prompt_lookup_max: int = 4
@@ -467,6 +467,12 @@ class EngineConfig:
                 # The burst's scanned decode graph carries no per-token
                 # adapter slots.
                 ("LoRA", self.lora_config.enable_lora),
+                # The burst calls the target forward alone — EAGLE's
+                # in-step draft-KV advance would be skipped, starving
+                # the drafter of context.
+                ("EAGLE speculative decoding",
+                 self.speculative_config is not None
+                 and self.speculative_config.method == "eagle"),
         ):
             if incompatible and self.scheduler_config.num_scheduler_steps > 1:
                 logger.warning(
@@ -480,6 +486,14 @@ class EngineConfig:
             raise ValueError(
                 f"num_gpu_blocks_override={override} must be a positive "
                 f"multiple of token_parallel_size={tknp}")
+        if (self.speculative_config is not None
+                and self.speculative_config.method == "eagle"
+                and self.parallel_config.pipeline_parallel_size > 1):
+            raise ValueError(
+                "EAGLE speculative decoding is not supported with "
+                "pipeline parallelism (the draft layers stack onto the "
+                "single-program cache; stage-sliced caches don't carry "
+                "them)")
 
     def compute_hash(self) -> str:
         """Stable hash of the config for compilation-cache keys."""

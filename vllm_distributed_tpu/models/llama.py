@@ -374,6 +374,19 @@ class LlamaForCausalLM:
 
     _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn, jnp.int4)
 
+    def _use_quant_kernel(self) -> bool:
+        """Fused dequant-GEMM eligibility: pallas backend on one chip
+        (pallas_call is opaque to GSPMD — sharded dots keep the XLA
+        dequant-in-dot path, whose convert fuses into the operand
+        load)."""
+        from vllm_distributed_tpu.ops.attention import \
+            resolve_attention_backend
+        from vllm_distributed_tpu.parallel import mesh as mesh_state
+        if resolve_attention_backend() != "pallas":
+            return False
+        return (not mesh_state.has_global_mesh()
+                or mesh_state.tp_size() == 1)
+
     def _w(self, lp: dict, name: str) -> jax.Array:
         """Dequantizing weight accessor: identity for fp weights."""
         w = lp[name]
@@ -388,8 +401,21 @@ class LlamaForCausalLM:
         the dot runs int8 x int8 -> int32 on the MXU, rescaled by
         act_scale * weight_scale; every other scheme dequantizes the
         weight into a normal fp dot (reference: the per-token dynamic
-        activation quant of csrc/quantization/ int8 kernels)."""
+        activation quant of csrc/quantization/ int8 kernels). Small
+        (decode-sized) weight-only dots on a single chip take the fused
+        Pallas dequant-GEMM so only packed bytes stream from HBM
+        (ops/pallas_quant_matmul.py; reference capability:
+        csrc/quantization/gptq_marlin)."""
         w = lp[name]
+        if (w.dtype in self._QUANT_DTYPES
+                and self.cfg.quantization != "w8a8"
+                and x.ndim == 2 and x.shape[0] <= 64
+                and self._use_quant_kernel()):
+            from vllm_distributed_tpu.ops.pallas_quant_matmul import \
+                quant_matmul
+            from vllm_distributed_tpu import envs
+            return quant_matmul(x, w, lp[name + "_scale"],
+                                interpret=envs.VDT_PALLAS_INTERPRET)
         if self.cfg.quantization == "w8a8" and w.dtype == jnp.int8:
             amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
                            keepdims=True)
@@ -1009,6 +1035,7 @@ class LlamaForCausalLM:
         hidden: jax.Array,  # [T, H]
         batch: AttentionBatch,
         first_layer: int = 0,
+        cache_layer_offset: int = 0,
     ) -> tuple[jax.Array, dict]:
         """Run a contiguous slice of decoder layers over the hidden
         states. ``layer_params`` is a stacked [Ls, ...] subtree and
@@ -1017,7 +1044,10 @@ class LlamaForCausalLM:
         (reference: the per-stage module list built by get_pp_indices,
         distributed/utils.py:89). ``first_layer`` is the slice's global
         offset, selecting the right rows of mixed window layouts
-        (static — PP keys its stage jit on it for patterned models)."""
+        (static — PP keys its stage jit on it for patterned models).
+        ``cache_layer_offset`` shifts KV reads/writes into deeper rows
+        of a taller stacked cache — the EAGLE drafter's layers append
+        to the target's cache stack and index past its depth."""
         c = self.cfg
         T = hidden.shape[0]
         if c.sm_scale_override is not None:
@@ -1184,7 +1214,8 @@ class LlamaForCausalLM:
 
         windows = self._layer_windows(first_layer, num_layers)
         segments = self._plan_window_segments(windows)
-        layer_ids = jnp.arange(num_layers, dtype=jnp.int32)[:, None]
+        layer_ids = (jnp.arange(num_layers, dtype=jnp.int32)[:, None]
+                     + cache_layer_offset)
         carry = (sp(hidden), kv_caches["k"], kv_caches["v"])
         for start, count, pattern in segments:
             if len(segments) == 1:

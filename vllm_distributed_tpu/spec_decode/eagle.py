@@ -1,0 +1,253 @@
+"""EAGLE-class learned drafter with persistent draft KV.
+
+Reference: vllm/v1/spec_decode/eagle.py:26 (EagleProposer: a small
+draft transformer fed the target's hidden states, advancing its own KV
+cache in-step, proposing k tokens per decode step). TPU-first
+re-design rather than a port:
+
+* The draft KV lives as EXTRA LAYERS of the target's stacked paged
+  cache ([L_target + L_eagle, pages, ...]) addressed through the same
+  block tables and slot mapping — no second cache manager, no draft
+  block tables in the scheduler. ``run_layers(cache_layer_offset=L)``
+  makes the drafter's reads/writes land past the target's depth.
+* The drafter ADVANCES inside the target's jitted forward: every
+  scheduled token's (embedding, target hidden) pair runs through the
+  eagle layers in the same XLA program (one fused step, no extra
+  dispatch), writing draft KV for exactly the positions the target
+  wrote — speculative positions are re-written next step when their
+  tokens are actually processed, so stale draft KV can never be read.
+* Proposal is a separate tiny jit after verification: k sequential
+  draft-attention steps over the paged draft KV. Proposed positions
+  beyond the request's allocated pages park on slot -1 (the write
+  drops); their KV is simply absent for later propose steps — a
+  quality (never correctness) trade at page boundaries.
+* Drafts are sampled from the top-K truncated tempered draft
+  distribution (spec_decode/draft_model.py sample_draft_step) and the
+  support rides back as q-metadata for exact rejection-sampling
+  verification (sample/sampler.py spec_verify_rejection).
+
+Checkpoint format: a local HF Llama-style directory whose config
+declares the (few) draft layers, with the same hidden/head geometry as
+the target, plus an ``fc.weight`` ([H, 2H] torch layout) combining
+[token embedding; target hidden] -> H. Missing embed/lm_head/final
+norm tensors fall back to sharing the target's (the official EAGLE
+weights share them).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.config import SpeculativeConfig
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.models.common import AttentionBatch
+from vllm_distributed_tpu.spec_decode.draft_model import sample_draft_step
+from vllm_distributed_tpu.utils import make_buckets, pad_to_bucket
+
+logger = init_logger(__name__)
+
+
+class EagleDrafter:
+    """Draft layers stacked onto the target's paged KV cache."""
+
+    def __init__(self, config: SpeculativeConfig, target_model,
+                 max_num_reqs: int, page_size: int) -> None:
+        assert config.model, ("speculative method 'eagle' needs "
+                              "speculative_model (a local checkpoint)")
+        from transformers import AutoConfig
+
+        from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
+                                                       LlamaForCausalLM)
+        self.k = config.num_speculative_tokens
+        self.page_size = page_size
+        tcfg = target_model.cfg
+        hf = AutoConfig.from_pretrained(config.model)
+        arch = LlamaArchConfig.from_hf_config(hf, dtype=tcfg.dtype)
+        if (arch.hidden_size != tcfg.hidden_size
+                or arch.head_dim != tcfg.head_dim):
+            raise ValueError(
+                f"eagle drafter geometry ({arch.hidden_size}/"
+                f"{arch.head_dim}) must match the target "
+                f"({tcfg.hidden_size}/{tcfg.head_dim})")
+        self.model = LlamaForCausalLM(arch)
+        self.num_layers = arch.num_layers
+        self.layer_offset = tcfg.num_layers
+        self.ckpt = config.model
+        self.req_buckets = make_buckets(4, max_num_reqs)
+        self._propose_fn = jax.jit(self._build_propose(),
+                                   donate_argnums=(1, ),
+                                   static_argnames=("R", ))
+
+    # ------------------------------------------------------------------
+    def load_params(self, target_params: dict) -> dict:
+        """Eagle param tree from the checkpoint; embed/lm_head/final_ln
+        fall back to the target's arrays (shared, not copied)."""
+        from vllm_distributed_tpu.models.loader import load_hf_state_dict
+        tensors = load_hf_state_dict(self.ckpt)
+        c = self.model.cfg
+        have = set(tensors)
+        if "model.embed_tokens.weight" not in have:
+            tensors["model.embed_tokens.weight"] = np.zeros(
+                (c.vocab_size, c.hidden_size), np.float32)
+        if "lm_head.weight" not in have:
+            tensors["lm_head.weight"] = np.zeros(
+                (c.vocab_size, c.hidden_size), np.float32)
+        if "model.norm.weight" not in have:
+            tensors["model.norm.weight"] = np.ones(
+                (c.hidden_size, ), np.float32)
+        params = self.model.params_from_hf_state_dict(tensors)
+        if "model.embed_tokens.weight" not in have:
+            params["embed"] = target_params["embed"]
+        if "lm_head.weight" not in have:
+            params["lm_head"] = target_params["lm_head"]
+        if "model.norm.weight" not in have:
+            params["final_ln"] = target_params["final_ln"]
+        fc = tensors.get("fc.weight")
+        if fc is None:
+            raise ValueError(
+                "eagle checkpoint is missing fc.weight ([H, 2H]): the "
+                "[embedding; hidden] combiner is what makes it EAGLE")
+        params["fc"] = jnp.asarray(np.asarray(fc).T, c.dtype)
+        if "fc.bias" in tensors:
+            params["fc_b"] = jnp.asarray(tensors["fc.bias"], c.dtype)
+        return params
+
+    def param_specs(self) -> dict:
+        specs = self.model.param_specs()
+        from jax.sharding import PartitionSpec as P
+        specs["fc"] = P(None, None)
+        specs["fc_b"] = P(None)
+        return specs
+
+    # ------------------------------------------------------------------
+    def combine(self, eparams: dict, token_ids: jax.Array,
+                positions: jax.Array, hidden: jax.Array) -> jax.Array:
+        """fc([embedding; target hidden]) -> drafter input rows."""
+        emb = self.model.embed(eparams, token_ids, positions)
+        x = jnp.concatenate([emb, hidden.astype(emb.dtype)], axis=-1)
+        x = x @ eparams["fc"]
+        if "fc_b" in eparams:
+            x = x + eparams["fc_b"]
+        return x
+
+    def advance(self, eparams: dict, kv_caches: dict,
+                token_ids: jax.Array, hidden: jax.Array,
+                batch: AttentionBatch) -> dict:
+        """In-jit piece of the target step: run every scheduled token
+        through the eagle layers, writing draft KV at the same slots
+        the target wrote (cache rows [layer_offset, +num_layers))."""
+        x = self.combine(eparams, token_ids, batch.positions, hidden)
+        _g, kv_caches = self.model.run_layers(
+            eparams["layers"], kv_caches, x, batch,
+            cache_layer_offset=self.layer_offset)
+        return kv_caches
+
+    # ------------------------------------------------------------------
+    def _build_propose(self):
+        model = self.model
+        k = self.k
+        ps = self.page_size
+        L_off = self.layer_offset
+
+        def propose(eparams, kv_caches, h_tgt, tok, pos, block_tables,
+                    num_blocks, temps, seeds, num_active, *, R):
+            """k sequential draft steps. ``tok``/``pos``: the last
+            emitted token and its position (its draft KV is written by
+            step j=0); ``h_tgt``: target hidden at pos-1 (the state
+            that produced ``tok``)."""
+            rows = jnp.arange(R, dtype=jnp.int32)
+            ones = jnp.ones((R, ), jnp.int32)
+            h = h_tgt
+            drafts, ids_l, probs_l = [], [], []
+            for j in range(k):
+                active = rows < num_active[0]
+                page_idx = pos // ps
+                in_range = jnp.logical_and(active,
+                                           page_idx < num_blocks)
+                page = block_tables[rows, jnp.minimum(
+                    page_idx, block_tables.shape[1] - 1)]
+                slot = jnp.where(in_range, page * ps + pos % ps, -1)
+                kv_runs = jnp.stack(
+                    [page, pos % ps, rows - pos % ps + ps,
+                     jnp.where(in_range, 1, 0)], axis=1)
+                seq_info = jnp.stack([rows, ones, pos + 1, rows], axis=1)
+                batch = AttentionBatch(
+                    req_idx=rows, positions=pos, slot_mapping=slot,
+                    block_tables=block_tables, seq_lens=pos + 1,
+                    seq_info=seq_info, num_seqs=num_active,
+                    kv_runs=kv_runs, num_kv_runs=num_active, max_q=1)
+                x = self.combine(eparams, tok, pos, h)
+                g, kv_caches = model.run_layers(
+                    eparams["layers"], kv_caches, x, batch,
+                    cache_layer_offset=L_off)
+                logits = model.compute_logits(eparams, g)
+                d, ids_j, p_j = sample_draft_step(logits, temps, seeds,
+                                                  j + 17)
+                drafts.append(d)
+                ids_l.append(ids_j)
+                probs_l.append(p_j)
+                tok, h, pos = d, g, pos + 1
+            return (kv_caches, jnp.stack(drafts, axis=1),
+                    jnp.stack(ids_l, axis=1), jnp.stack(probs_l, axis=1))
+
+        return propose
+
+    # ------------------------------------------------------------------
+    def propose_batch(self, kv_caches: dict, entries: list,
+                      hidden_sel: jax.Array, temps: np.ndarray,
+                      seeds: np.ndarray, block_table: np.ndarray,
+                      num_blocks: np.ndarray):
+        """entries: (req_id, flat_hidden_row, last_token, last_pos) per
+        eligible request. Returns (updated caches, drafts per request,
+        support metadata per request)."""
+        n = len(entries)
+        R = pad_to_bucket(n, self.req_buckets)
+        idx = np.zeros((R, ), np.int32)
+        tok = np.zeros((R, ), np.int32)
+        pos = np.zeros((R, ), np.int32)
+        temps_a = np.zeros((R, ), np.float32)
+        seeds_a = np.zeros((R, ), np.int64)
+        bt = np.zeros((R, block_table.shape[1]), np.int32)
+        nb = np.zeros((R, ), np.int32)
+        for i, (_rid, flat, t, p) in enumerate(entries):
+            idx[i], tok[i], pos[i] = flat, t, p
+        temps_a[:n] = temps
+        seeds_a[:n] = seeds
+        bt[:n] = block_table
+        nb[:n] = num_blocks
+        h_tgt = hidden_sel[jnp.asarray(idx)]
+        kv_caches, drafts, q_ids, q_probs = self._propose_fn(
+            self.eparams, kv_caches, h_tgt, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(bt), jnp.asarray(nb),
+            jnp.asarray(temps_a), jnp.asarray(seeds_a),
+            jnp.asarray([n], np.int32), R=R)
+        drafts = np.asarray(drafts)
+        meta = list(zip(np.asarray(q_ids), np.asarray(q_probs)))
+        return (kv_caches,
+                [[int(t) for t in drafts[i]] for i in range(n)],
+                meta[:n])
+
+    def precompile(self, kv_caches: dict, hidden_size, dtype,
+                   pages_per_req: int) -> tuple:
+        """Warm the propose graph per R bucket (with the serving block
+        table width so no shape leaks); returns (kv_caches, n) — the
+        caches are donated through each call."""
+        n = 0
+        for R in self.req_buckets:
+            kv_caches, d, _, _ = self._propose_fn(
+                self.eparams, kv_caches,
+                jnp.zeros((R, hidden_size), dtype),
+                jnp.zeros((R, ), jnp.int32),
+                jnp.zeros((R, ), jnp.int32),
+                jnp.zeros((R, pages_per_req), jnp.int32),
+                jnp.zeros((R, ), jnp.int32),
+                jnp.zeros((R, ), jnp.float32),
+                jnp.zeros((R, ), jnp.int64),
+                jnp.zeros((1, ), jnp.int32), R=R)
+            jax.block_until_ready(d)
+            n += 1
+        return kv_caches, n
+
+    eparams: Optional[dict] = None  # placed by the runner after load

@@ -119,10 +119,12 @@ class TPUModelRunner:
         # position IS the target sample) and zero extra device code.
         spec = config.speculative_config
         self.spec_k = (spec.num_speculative_tokens
-                       if spec and spec.method in ("ngram",
-                                                   "draft_model") else 0)
+                       if spec and spec.method in ("ngram", "draft_model",
+                                                   "eagle") else 0)
         self.proposer = None
         self._draft_spec = None
+        self._eagle_spec = None
+        self._eagle = None
         # Per-request truncated draft-support metadata ([S, K] ids and
         # probs) written at proposal time, read by next step's
         # rejection verifier (see sample/sampler.py
@@ -132,6 +134,11 @@ class TPUModelRunner:
             from vllm_distributed_tpu.spec_decode.ngram_proposer import \
                 NgramProposer
             self.proposer = NgramProposer(spec)
+        elif self.spec_k and spec.method == "eagle":
+            # EAGLE drafter builds with the target model (load_model
+            # knows the geometry); its KV layers stack onto the
+            # target's cache.
+            self._eagle_spec = spec
         elif self.spec_k:
             # Draft model loads with the target model (load_model knows
             # the dtype); until then proposals are empty.
@@ -182,6 +189,25 @@ class TPUModelRunner:
             self.proposer = DraftModelProposer(
                 self._draft_spec, self.model.cfg.dtype,
                 max_num_reqs=self.max_num_reqs)
+        if self._eagle_spec is not None:
+            from jax.sharding import NamedSharding
+
+            from vllm_distributed_tpu.spec_decode.eagle import EagleDrafter
+            self._eagle = EagleDrafter(self._eagle_spec, self.model,
+                                       self.max_num_reqs, self.page_size)
+            host = self._eagle.load_params(self.params)
+            specs = self._eagle.param_specs()
+            with self.mesh:
+                def place(p, key_specs):
+                    if isinstance(p, dict):
+                        return {k: place(v, key_specs[k])
+                                for k, v in p.items()}
+                    return jax.device_put(
+                        p, NamedSharding(self.mesh, key_specs))
+
+                placed = place(host, specs)
+            self.params["eagle"] = placed
+            self._eagle.eparams = placed
 
     def _init_lora_manager(self) -> None:
         if self.config.lora_config.enable_lora:
@@ -198,7 +224,14 @@ class TPUModelRunner:
     def _make_sharded_caches(self, num_pages: int) -> dict:
         from jax.sharding import NamedSharding
         with self.mesh:
-            caches = self.model.make_kv_caches(num_pages, self.page_size)
+            depth = None
+            if self._eagle is not None:
+                # EAGLE's draft KV layers stack onto the target's cache
+                # (same pages/block tables; see spec_decode/eagle.py).
+                depth = (self.model.cfg.num_layers +
+                         self._eagle.num_layers)
+            caches = self.model.make_kv_caches(num_pages, self.page_size,
+                                               num_layers=depth)
             specs = self.model.kv_cache_specs()
             return jax.tree.map(
                 lambda x, s: jax.device_put(
@@ -270,6 +303,8 @@ class TPUModelRunner:
             # top-level keys (embed_pos, embed_ln, encoder heads) and
             # some drop final_ln (post-norm BART).
             specs = self.model.param_specs()
+            if self._eagle is not None and "eagle" in self._host_params:
+                specs["eagle"] = self._eagle.param_specs()
 
             def place(p, s):
                 if isinstance(p, dict):
@@ -289,6 +324,8 @@ class TPUModelRunner:
                 self._init_lora_manager()
         if getattr(self.model, "CROSS_ATTENTION", False):
             self.model.params_ref = self.params  # old arrays deleted
+        if self._eagle is not None and "eagle" in (self.params or {}):
+            self._eagle.eparams = self.params["eagle"]
         self.kv_caches = self._make_sharded_caches(self.num_pages)
         self._sleeping = False
         logger.info("awake: weights restored, KV cache reset")
@@ -296,7 +333,11 @@ class TPUModelRunner:
     def kv_cache_bytes_per_page(self) -> int:
         # The model owns its cache layout (MLA stores one latent row per
         # token instead of per-head K/V).
-        return self.model.kv_cache_page_bytes(self.page_size)
+        bytes_ = self.model.kv_cache_page_bytes(self.page_size)
+        if self._eagle is not None:
+            L = self.model.cfg.num_layers
+            bytes_ = bytes_ * (L + self._eagle.num_layers) // L
+        return bytes_
 
     def model_fixed_cache_bytes(self) -> int:
         """Per-request fixed state bytes (SSM rows); 0 for paged-KV-only
@@ -312,10 +353,18 @@ class TPUModelRunner:
         reference's per-shape warm-up suite (tpu_model_runner.py:1248).
         The [R]-row gather between them runs op-by-op (one XLA gather)."""
         model = self.model
+        eagle = self._eagle
 
         def forward(params, kv_caches, token_ids, batch: AttentionBatch):
             hidden, kv_caches = model.forward(params, kv_caches, token_ids,
                                               batch)
+            if eagle is not None:
+                # The drafter advances its KV in the SAME program: every
+                # scheduled token's (embedding, target hidden) runs the
+                # eagle layers, writing cache rows past the target's
+                # depth (reference: eagle.py:120 advances per step).
+                kv_caches = eagle.advance(params["eagle"], kv_caches,
+                                          token_ids, hidden, batch)
             return kv_caches, hidden
 
         def sample(params, hidden_sel, sampling_md: SamplingMetadata):
@@ -1031,6 +1080,7 @@ class TPUModelRunner:
             S = self.spec_k
             n_acc = np.cumprod(accept_np.astype(np.int64),
                                axis=1).sum(axis=1)
+            emitted_map: dict[str, list[int]] = {}
             for i, req_id in enumerate(sampling_req_ids):
                 n_draft = int((drafts_arr[i] >= 0).sum())
                 if n_draft:
@@ -1053,12 +1103,17 @@ class TPUModelRunner:
                     elps.append(float(lp_cand_np[i, na, 1]))
                 for tok in emitted:
                     self.input_batch.append_token(req_id, tok)
+                emitted_map[req_id] = emitted
                 req_ids.append(req_id)
                 sampled.append(emitted)
                 lps.append([{tok: lp}
                             for tok, lp in zip(emitted, elps)])
-            draft_map = self._propose_drafts_all(
-                [r for r in sampling_req_ids if r not in pooled])
+            if self._eagle is not None:
+                draft_map = self._propose_drafts_eagle(
+                    sampling_req_ids, emitted_map, handle)
+            else:
+                draft_map = self._propose_drafts_all(
+                    [r for r in sampling_req_ids if r not in pooled])
             spec_out.extend(draft_map.get(r, []) if r not in pooled
                             else [] for r in sampling_req_ids)
         elif self.spec_k:
@@ -1338,6 +1393,44 @@ class TPUModelRunner:
             return {rid: d for (rid, _), d in zip(eligible, drafts)}
         return {rid: self.proposer.propose(h) for rid, h in eligible}
 
+    def _propose_drafts_eagle(self, sampling_req_ids: list[str],
+                              emitted_map: dict[str, list[int]],
+                              handle: dict) -> dict[str, list[int]]:
+        """EAGLE proposals for next step: one batched jit consuming the
+        target hidden states already on device (handle's hidden_sel
+        rows) — the draft KV advanced in-step during the forward, so
+        proposing is k tiny decode steps over the eagle layers
+        (reference: eagle.py propose per verified step)."""
+        ib = self.input_batch
+        S1 = self.spec_k + 1
+        entries, rows_l = [], []
+        for i, req_id in enumerate(sampling_req_ids):
+            emitted = emitted_map.get(req_id)
+            if not emitted or self._draft_eligible(req_id) is None:
+                continue
+            row = ib.req_id_to_index[req_id]
+            flat = i * S1 + (len(emitted) - 1)
+            pos_last = int(ib.num_tokens[row]) - 1
+            entries.append((req_id, flat, emitted[-1], pos_last))
+            rows_l.append(row)
+        if not entries:
+            return {}
+        rows_a = np.asarray(rows_l)
+        temps = ib.temperature[rows_a].astype(np.float32)
+        user_seed = ib.seed[rows_a]
+        seeds = np.where(
+            user_seed >= 0,
+            user_seed * 999983 + ib.num_tokens[rows_a],
+            self._rng.integers(0, 2**31 - 1, size=len(rows_a)))
+        hidden_sel = handle["dev"][3]
+        with self.mesh:
+            self.kv_caches, drafts, meta = self._eagle.propose_batch(
+                self.kv_caches, entries, hidden_sel, temps, seeds,
+                ib.block_table[rows_a], ib.num_blocks[rows_a])
+        for (rid, *_), m in zip(entries, meta):
+            self._draft_meta[rid] = m
+        return {rid: d for (rid, *_), d in zip(entries, drafts)}
+
     # ------------------------------------------------------------------
     def _execute_multi_step(
             self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
@@ -1534,6 +1627,11 @@ class TPUModelRunner:
             if self.proposer is not None and hasattr(
                     self.proposer, "precompile"):
                 n += self.proposer.precompile()
+            if self._eagle is not None:
+                self.kv_caches, ne = self._eagle.precompile(
+                    self.kv_caches, self.model.cfg.hidden_size,
+                    self.model.cfg.dtype, self.max_pages_per_req)
+                n += ne
         self._precompiled = True
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
