@@ -174,3 +174,22 @@ def test_eagle_seeded_reproducible(target_ckpt, eagle_ckpt):
                          num_speculative_tokens=2),
              prompts, sp, "r2")[0].outputs[0].token_ids
     assert o1 == o2
+
+
+def test_eagle_tp2_matches_single_device(target_ckpt, eagle_ckpt):
+    """EAGLE under GSPMD TP: the draft layers' advance/propose run on
+    the sharded mesh; greedy output must match tp=1 exactly."""
+    sps = [SamplingParams(temperature=0.0, max_tokens=12,
+                          ignore_eos=True) for _ in PROMPTS]
+    single = make_engine(target_ckpt, speculative_method="eagle",
+                         speculative_model=eagle_ckpt,
+                         num_speculative_tokens=2)
+    want = [o.outputs[0].token_ids
+            for o in run(single, PROMPTS, sps, "t1")]
+    tp2 = make_engine(target_ckpt, speculative_method="eagle",
+                      speculative_model=eagle_ckpt,
+                      num_speculative_tokens=2,
+                      tensor_parallel_size=2)
+    got = [o.outputs[0].token_ids
+           for o in run(tp2, PROMPTS, sps, "t2")]
+    assert got == want

@@ -52,3 +52,28 @@ def test_bf16_activations():
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.float32(np.asarray(got)), want,
                                rtol=5e-2, atol=5e-2 * np.abs(want).max())
+
+
+@pytest.mark.parametrize("shape,group", [((8, 256, 128), 128),
+                                         ((17, 512, 256), 64),
+                                         ((4, 64, 64), 64)])
+def test_grouped_matches_reference(shape, group):
+    from vllm_distributed_tpu.ops.pallas_quant_matmul import \
+        quant_matmul_grouped
+    T, K, N = shape
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((T, K)).astype(np.float32)
+    w32 = rng.standard_normal((K, N)).astype(np.float32)
+    G = K // group
+    wg = w32.reshape(G, group, N)
+    wmin = wg.min(axis=1)
+    scale = np.maximum((wg.max(axis=1) - wmin) / 15.0, 1e-8)
+    q = np.clip(np.round((wg - wmin[:, None]) / scale[:, None]), 0,
+                15).astype(ml_dtypes.uint4)
+    want = x @ (np.asarray(q, np.float32).reshape(G, group, N) *
+                scale[:, None] + wmin[:, None]).reshape(K, N)
+    got = quant_matmul_grouped(
+        jnp.asarray(x), jnp.asarray(np.asarray(q).reshape(K, N)),
+        jnp.asarray(scale), jnp.asarray(wmin), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2,
+                               atol=2e-2 * np.abs(want).max())

@@ -37,9 +37,11 @@ class ModelConfig:
     trust_remote_code: bool = False
     dtype: str = "bfloat16"  # bfloat16 | float32 (TPU-native dtypes)
     # Quantization: None (full precision), weight-only "int4" / "int8" /
-    # "fp8", or "w8a8" (int8 weights + dynamic int8 activations)
-    # (float8_e4m3fn) — w8a16 quantize-on-load with per-output-channel
-    # scales (reference: quantization/tpu_int8.py + fp8 configs).
+    # "fp8", "w8a8" (int8 weights + dynamic int8 activations), or
+    # "int4g" (group-wise asymmetric uint4, group 128 — the scheme that
+    # preserves GPTQ/AWQ checkpoints' group structure losslessly;
+    # "gptq"/"awq" are accepted aliases) (reference:
+    # quantization/tpu_int8.py + fp8 configs + gptq_marlin serving).
     quantization: Optional[str] = None
     seed: int = 0
     max_model_len: Optional[int] = None
@@ -54,11 +56,13 @@ class ModelConfig:
             self.tokenizer = self.model
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unsupported dtype {self.dtype!r}")
+        if self.quantization in ("gptq", "awq"):
+            self.quantization = "int4g"
         if self.quantization not in (None, "int4", "int8", "fp8",
-                                     "w8a8"):
+                                     "w8a8", "int4g"):
             raise ValueError(
                 f"unsupported quantization {self.quantization!r} "
-                "(supported: int4, int8, fp8, w8a8)")
+                "(supported: int4, int4g/gptq/awq, int8, fp8, w8a8)")
 
     def maybe_load_hf_config(self) -> Any:
         """Load (and cache) the HF config for the model.

@@ -99,6 +99,10 @@ class LlamaArchConfig:
     # Weight quantization scheme (None | "int8" | "fp8"); see
     # quantize_params.
     quantization: Optional[str] = None
+    # int4g group width along the input dim; set from the checkpoint's
+    # quantization_config.group_size so a GPTQ/AWQ re-quantization
+    # reuses the original group lattice (lossless).
+    quant_group_size: int = 128
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
@@ -341,6 +345,8 @@ class LlamaForCausalLM:
         scheme = self.cfg.quantization
         if scheme == "w8a8":
             scheme = "int8"  # same weight payloads; _mm changes the dot
+        if scheme == "int4g":
+            return self._quantize_groupwise(params)
         if scheme not in ("int4", "int8", "fp8"):
             return params
         layers = params["layers"]
@@ -372,6 +378,40 @@ class LlamaForCausalLM:
             layers[name + "_scale"] = jnp.asarray(scale, jnp.float32)
         return params
 
+    GROUP_SIZE = 128  # int4g quantization group along the input dim
+
+    def _quantize_groupwise(self, params: dict) -> dict:
+        """Group-wise asymmetric uint4 ("int4g"): per (128-input-row
+        group, output channel) scale/min. A GPTQ/AWQ checkpoint's
+        load-time fp reconstruction lies exactly on each group's
+        4-bit lattice, so this re-quantization recovers the original
+        packed values bit-exactly (up to fp rounding) — the 4-bit HBM
+        footprint and group fidelity survive into serving (reference:
+        the gptq_marlin W4A16 serving path)."""
+        import ml_dtypes
+        layers = params["layers"]
+        for name in self.QUANT_TARGETS:
+            w = layers.get(name)
+            if w is None:
+                continue
+            w32 = np.asarray(w, np.float32)  # [L, K, N]
+            K = w32.shape[-2]
+            g = self.cfg.quant_group_size
+            if K % g:
+                g = self.GROUP_SIZE if K % self.GROUP_SIZE == 0 else K
+            shp = w32.shape[:-2] + (K // g, g) + w32.shape[-1:]
+            wg = w32.reshape(shp)
+            wmin = wg.min(axis=-2)  # [L, G, N]
+            wmax = wg.max(axis=-2)
+            scale = np.maximum((wmax - wmin) / 15.0, 1e-8)
+            q = np.clip(
+                np.round((wg - wmin[..., None, :]) / scale[..., None, :]),
+                0, 15).astype(ml_dtypes.uint4)
+            layers[name] = jnp.asarray(q.reshape(w32.shape))
+            layers[name + "_gscale"] = jnp.asarray(scale, jnp.float32)
+            layers[name + "_gmin"] = jnp.asarray(wmin, jnp.float32)
+        return params
+
     _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn, jnp.int4)
 
     def _use_quant_kernel(self) -> bool:
@@ -390,6 +430,16 @@ class LlamaForCausalLM:
     def _w(self, lp: dict, name: str) -> jax.Array:
         """Dequantizing weight accessor: identity for fp weights."""
         w = lp[name]
+        if w.dtype == jnp.uint4:
+            # int4g group-wise: w = q * scale[g] + min[g] along the
+            # input dim (XLA fuses the reshape/broadcast into the dot).
+            K, N = w.shape[-2], w.shape[-1]
+            G = lp[name + "_gscale"].shape[-2]
+            g = K // G
+            wq = w.astype(jnp.float32).reshape(*w.shape[:-2], G, g, N)
+            wf = (wq * lp[name + "_gscale"][..., :, None, :] +
+                  lp[name + "_gmin"][..., :, None, :])
+            return wf.reshape(w.shape).astype(self.cfg.dtype)
         if w.dtype in self._QUANT_DTYPES:
             return (w.astype(self.cfg.dtype) *
                     lp[name + "_scale"].astype(self.cfg.dtype))
@@ -407,13 +457,21 @@ class LlamaForCausalLM:
         (ops/pallas_quant_matmul.py; reference capability:
         csrc/quantization/gptq_marlin)."""
         w = lp[name]
+        if (w.dtype == jnp.uint4 and x.ndim == 2 and x.shape[0] <= 64
+                and self._use_quant_kernel()):
+            from vllm_distributed_tpu import envs
+            from vllm_distributed_tpu.ops.pallas_quant_matmul import \
+                quant_matmul_grouped
+            return quant_matmul_grouped(
+                x, w, lp[name + "_gscale"], lp[name + "_gmin"],
+                interpret=envs.VDT_PALLAS_INTERPRET)
         if (w.dtype in self._QUANT_DTYPES
                 and self.cfg.quantization != "w8a8"
                 and x.ndim == 2 and x.shape[0] <= 64
                 and self._use_quant_kernel()):
+            from vllm_distributed_tpu import envs
             from vllm_distributed_tpu.ops.pallas_quant_matmul import \
                 quant_matmul
-            from vllm_distributed_tpu import envs
             return quant_matmul(x, w, lp[name + "_scale"],
                                 interpret=envs.VDT_PALLAS_INTERPRET)
         if self.cfg.quantization == "w8a8" and w.dtype == jnp.int8:
@@ -553,17 +611,24 @@ class LlamaForCausalLM:
         weight specs here are written at full rank, so the scale keeps
         the spec with only the second-to-last entry cleared."""
         for name in list(layer):
-            if name.endswith("_scale"):
+            if name.endswith(("_scale", "_gscale", "_gmin")):
                 del layer[name]
-        if self.cfg.quantization not in ("int4", "int8", "fp8", "w8a8"):
+        if self.cfg.quantization not in ("int4", "int8", "fp8", "w8a8",
+                                         "int4g"):
             return
         for name in self.QUANT_TARGETS:
             spec = layer.get(name)
             if spec is None:
                 continue
-            entries = list(spec)
-            entries[-2] = None
-            layer[name + "_scale"] = P(*entries)
+            if self.cfg.quantization == "int4g":
+                # The group dim subdivides the input dim, so it shards
+                # exactly as the weight's input axis does.
+                layer[name + "_gscale"] = spec
+                layer[name + "_gmin"] = spec
+            else:
+                entries = list(spec)
+                entries[-2] = None
+                layer[name + "_scale"] = P(*entries)
 
     def kv_cache_specs(self) -> dict:
         # [L, pages, kv_heads, page_size, head_dim]: pages shard on the

@@ -90,6 +90,15 @@ def get_model(config: EngineConfig, mesh,
         config.parallel_config.enable_sequence_parallel
         and config.parallel_config.tensor_parallel_size > 1)
     arch.quantization = config.model_config.quantization
+    qcfg = getattr(hf_config, "quantization_config", None)
+    if qcfg is not None:
+        get = (qcfg.get if isinstance(qcfg, dict)
+               else lambda k, d=None: getattr(qcfg, k, d))
+        gs = int(get("group_size", get("q_group_size", 0)) or 0)
+        if gs > 0:
+            # int4g reuses the checkpoint's own group lattice so the
+            # re-quantization after the load-time dequant is lossless.
+            arch.quant_group_size = gs
     kv_dtype = config.cache_config.cache_dtype
     if kv_dtype not in ("auto", None):
         if kv_dtype not in ("fp8", "fp8_e4m3", "fp8_e5m2"):

@@ -65,6 +65,91 @@ def _kernel(x_vmem, scale_vmem, w_hbm, out_vmem, w_vmem, sems,
                      ).astype(out_vmem.dtype)
 
 
+def _gkernel(x_vmem, gs_vmem, gm_vmem, w_hbm, out_vmem, w_vmem, sems,
+             *, bk: int, bn: int, dtype, g: int):
+    """Group-wise variant: w = q * scale[group] + min[group] (uint4
+    int4g payloads, GPTQ/AWQ group structure preserved)."""
+    n = pl.program_id(0)
+    K = x_vmem.shape[1]
+    num_k = K // bk
+
+    def fetch(k, slot):
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(k * bk, bk), pl.ds(n * bn, bn)],
+            w_vmem.at[slot], sems.at[slot]).start()
+
+    fetch(0, 0)
+    ng = bk // g
+
+    def body(k, acc):
+        slot = jax.lax.rem(k, 2)
+
+        @pl.when(k + 1 < num_k)
+        def _prefetch():
+            fetch(k + 1, jax.lax.rem(k + 1, 2))
+
+        pltpu.make_async_copy(
+            w_hbm.at[pl.ds(0, bk), pl.ds(0, bn)], w_vmem.at[slot],
+            sems.at[slot]).wait()
+        w_blk = w_vmem[slot].astype(jnp.float32)  # [bk, bn]
+        gs = gs_vmem[pl.ds(k * ng, ng), :]  # [ng, bn]
+        gm = gm_vmem[pl.ds(k * ng, ng), :]
+        wf = (w_blk.reshape(ng, g, bn) * gs[:, None, :] +
+              gm[:, None, :]).reshape(bk, bn)
+        x_blk = x_vmem[:, pl.ds(k * bk, bk)].astype(jnp.float32)
+        return acc + jax.lax.dot_general(
+            x_blk, wf, (((1, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(
+        0, num_k, body,
+        jnp.zeros((x_vmem.shape[0], bn), jnp.float32))
+    out_vmem[...] = acc.astype(out_vmem.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", ))
+def quant_matmul_grouped(x: jax.Array,  # [T, K]
+                         w_q: jax.Array,  # [K, N] uint4
+                         gscale: jax.Array,  # [G, N] f32
+                         gmin: jax.Array,  # [G, N] f32
+                         *, interpret: bool = False) -> jax.Array:
+    """x @ (w_q * gscale[group] + gmin[group]); packed-bytes streaming
+    with per-group dequant inside the pipeline."""
+    T, K = x.shape
+    _, N = w_q.shape
+    G = gscale.shape[0]
+    g = K // G
+    bn = 128 if N % 128 == 0 else N
+    bk = K
+    for cand in (2048, 1024, 512, 256, 128):
+        if K % cand == 0 and cand % g == 0:
+            bk = cand
+            break
+    kernel = functools.partial(_gkernel, bk=bk, bn=bn, dtype=x.dtype,
+                               g=g)
+    grid = (N // bn, )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((T, K), lambda n: (0, 0)),
+                pl.BlockSpec((G, bn), lambda n: (0, n)),
+                pl.BlockSpec((G, bn), lambda n: (0, n)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec((T, bn), lambda n: (0, n)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bk, bn), w_q.dtype),
+                pltpu.SemaphoreType.DMA((2, )),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, N), x.dtype),
+        interpret=interpret,
+    )(x, gscale, gmin, w_q)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", ))
 def quant_matmul(x: jax.Array,  # [T, K] activations (bf16/f32)
                  w_q: jax.Array,  # [K, N] int4 | int8 | float8_e4m3fn
