@@ -1,0 +1,182 @@
+"""P2P dynamic-membership disaggregation (reference:
+kv_transfer/kv_connector/v1/p2p/p2p_nccl_connector.py): instances
+register with a TTL'd registry, a decode instance joins MID-RUN with
+zero static peer config, pulls KV by producer instance id, serves, and
+leaves cleanly."""
+
+import time
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.distributed.kv_transfer.p2p_registry import (
+    P2PRegistryClient, P2PRegistryServer)
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_p2p")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+@pytest.fixture()
+def registry():
+    srv = P2PRegistryServer()
+    yield srv
+    srv.shutdown()
+
+
+def make_engine(path, registry, role, instance_id, **overrides):
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True,
+                kv_connector="P2PDcnConnector", kv_role=role,
+                kv_connector_extra_config={
+                    "pull_port": 0,
+                    "registry_addr": registry.address,
+                    "instance_id": instance_id,
+                    "registry_ttl": 3.0,
+                })
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def run(engine, prompts, tag, max_tokens=6, kv_params=None):
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"{tag}-{i}", p, sp,
+                           kv_transfer_params=(kv_params[i]
+                                               if kv_params else None))
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    return [done[k] for k in sorted(done,
+                                    key=lambda s: int(s.split("-")[-1]))]
+
+
+def _pump_until(consumer, producer, n, max_iters=2000):
+    done = {}
+    for _ in range(max_iters):
+        for out in consumer.step():
+            if out.finished:
+                done[out.request_id] = out
+        producer.step()
+        if len(done) == n:
+            break
+    assert len(done) == n
+    return [done[k] for k in sorted(done,
+                                    key=lambda s: int(s.split("-")[-1]))]
+
+
+PROMPTS = [
+    [3, 17, 92, 45, 8, 21, 33, 64, 90],
+    [5, 9, 33, 71, 14, 62, 77, 80, 6, 41, 93, 2, 54],
+]
+
+
+def test_registry_register_expire_and_leave():
+    srv = P2PRegistryServer()
+    try:
+        a = P2PRegistryClient(srv.address, "inst-a", "producer",
+                              ttl=0.5)
+        a.register(("127.0.0.1", 1234), heartbeat=False)
+        b = P2PRegistryClient(srv.address, "inst-b", "consumer",
+                              ttl=30.0)
+        b.register(("0.0.0.0", 0), heartbeat=False)
+        members = b.list()
+        assert set(members) == {"inst-a", "inst-b"}
+        assert b.resolve("inst-a") == ("127.0.0.1", 1234)
+        assert set(b.list("producer")) == {"inst-a"}
+        # TTL expiry drops a dead instance.
+        time.sleep(0.8)
+        assert "inst-a" not in b.list()
+        # Explicit leave.
+        b.leave()
+        assert b.list() == {}
+    finally:
+        srv.shutdown()
+
+
+def test_decode_instance_joins_pulls_serves_leaves(checkpoint, registry):
+    baseline_engine = LLMEngine(EngineArgs(
+        model=checkpoint, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True).create_engine_config())
+    baseline = [o.outputs[0].token_ids
+                for o in run(baseline_engine, PROMPTS, "base")]
+
+    # Producer joins the deployment.
+    producer = make_engine(checkpoint, registry, "kv_producer", "prefill-0")
+    assert "prefill-0" in registry.members("producer")
+
+    # Prefill both prompts; the finished params route by INSTANCE id.
+    prod_outs = run(producer, PROMPTS, "prod", max_tokens=1)
+    params = [dict(o.kv_transfer_params) for o in prod_outs]
+    assert all(p["remote_instance"] == "prefill-0" for p in params)
+    for p in params:
+        # Dynamic membership is the point: drop the static coordinates,
+        # the consumer must resolve them through the registry.
+        p.pop("pull_host", None)
+        p.pop("pull_port", None)
+
+    # Decode instance A joins and serves the first prompt.
+    cons_a = make_engine(checkpoint, registry, "kv_consumer", "decode-a")
+    assert "decode-a" in registry.members("consumer")
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    cons_a.add_request("a-0", PROMPTS[0], sp, kv_transfer_params=params[0])
+    out_a = _pump_until(cons_a, producer, 1)
+    assert out_a[0].outputs[0].token_ids == baseline[0]
+    assert out_a[0].num_cached_tokens == 8  # pulled, not recomputed
+
+    # Decode instance B joins MID-RUN and serves the second prompt.
+    cons_b = make_engine(checkpoint, registry, "kv_consumer", "decode-b")
+    assert set(registry.members("consumer")) == {"decode-a", "decode-b"}
+    cons_b.add_request("b-0", PROMPTS[1], sp, kv_transfer_params=params[1])
+    out_b = _pump_until(cons_b, producer, 1)
+    assert out_b[0].outputs[0].token_ids == baseline[1]
+    assert out_b[0].num_cached_tokens == 12
+
+    # B leaves cleanly; membership reflects it immediately.
+    sched_conn = cons_b.engine_core.engine_core.scheduler.kv_connector
+    assert sched_conn is not None
+    sched_conn.shutdown()
+    assert "decode-b" not in registry.members("consumer")
+    assert "decode-a" in registry.members("consumer")
+
+
+def test_unknown_producer_falls_back_to_local_prefill(checkpoint,
+                                                      registry):
+    baseline_engine = LLMEngine(EngineArgs(
+        model=checkpoint, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True).create_engine_config())
+    baseline = [o.outputs[0].token_ids
+                for o in run(baseline_engine, [PROMPTS[0]], "base")]
+
+    consumer = make_engine(checkpoint, registry, "kv_consumer",
+                           "decode-x")
+    params = {"remote_req_id": "ghost", "num_tokens": 8,
+              "remote_instance": "prefill-gone"}
+    outs = run(consumer, [PROMPTS[0]], "solo", kv_params=[params])
+    assert outs[0].outputs[0].token_ids == baseline[0]

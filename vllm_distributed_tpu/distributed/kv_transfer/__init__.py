@@ -27,6 +27,10 @@ def create_kv_connector(config: EngineConfig, role: KVConnectorRole,
         from vllm_distributed_tpu.distributed.kv_transfer.dcn_pull \
             import DCNPullConnector
         return DCNPullConnector(config, role)
+    if name == "P2PDcnConnector":
+        from vllm_distributed_tpu.distributed.kv_transfer.p2p_registry \
+            import P2PDcnConnector
+        return P2PDcnConnector(config, role)
     if name == "MultiConnector":
         from vllm_distributed_tpu.distributed.kv_transfer \
             .multi_connector import MultiConnector

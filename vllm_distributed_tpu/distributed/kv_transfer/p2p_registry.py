@@ -1,0 +1,288 @@
+"""Dynamic-membership registry + P2P connector for disaggregated serving.
+
+Reference: vllm/distributed/kv_transfer/kv_connector/v1/p2p/
+p2p_nccl_connector.py (+ its proxy discovery): prefill and decode
+instances JOIN and LEAVE a running deployment dynamically — a decode
+instance spun up mid-run discovers live prefill instances, pulls KV from
+them, and its registration expires when it dies. The reference moves
+pages over per-pair NCCL channels brokered by an HTTP proxy; the
+TPU-native equivalent keeps the DCN-socket page transport of
+``dcn_pull.py`` and adds the membership layer:
+
+* ``P2PRegistryServer`` — a tiny msgpack/TCP service holding
+  {instance_id -> role, address, expiry}. Registrations carry a TTL and
+  must be heartbeat-renewed; a dead instance vanishes on expiry (the
+  reference's proxy tracks liveness the same way).
+* ``P2PRegistryClient`` — register/heartbeat/list/deregister.
+* ``P2PDcnConnector`` — DCNPullConnector subclass. Producers register
+  their page-server address under their instance id and stamp
+  ``remote_instance`` into each finished request's kv_transfer_params;
+  consumers register as members and RESOLVE the producer's current
+  address through the registry at pull-admission time, so requests
+  routed by instance id keep working across producer restarts and new
+  decode instances need zero static peer configuration.
+"""
+
+import socket
+import threading
+import time
+from typing import Optional
+
+import msgpack
+
+from vllm_distributed_tpu.distributed.kv_transfer.base import (
+    KVConnectorRole)
+from vllm_distributed_tpu.distributed.kv_transfer.dcn_pull import (
+    DCNPullConnector, _recv_msg, _send_msg)
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class P2PRegistryServer:
+    """Membership table with TTL expiry (run one per deployment)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._lock = threading.Lock()
+        # instance_id -> (role, (host, port), expires_at)
+        self._members: dict[str, tuple[str, tuple[str, int], float]] = {}
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(32)
+        self.host, self.port = srv.getsockname()
+        self._srv = srv
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="p2p-registry", daemon=True)
+        self._thread.start()
+        logger.info("P2P registry listening on %s:%d", self.host,
+                    self.port)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def members(self, role: Optional[str] = None) -> dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            self._members = {k: v for k, v in self._members.items()
+                             if v[2] > now}
+            return {
+                k: {"role": r, "addr": list(a), "expires": e}
+                for k, (r, a, e) in self._members.items()
+                if role is None or r == role
+            }
+
+    def _loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn, ),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "register":
+                    ttl = float(msg.get("ttl", 10.0))
+                    with self._lock:
+                        self._members[msg["instance"]] = (
+                            msg.get("role", "producer"),
+                            (msg["addr"][0], int(msg["addr"][1])),
+                            time.time() + ttl)
+                    _send_msg(conn, {"ok": True})
+                elif op == "deregister":
+                    with self._lock:
+                        self._members.pop(msg["instance"], None)
+                    _send_msg(conn, {"ok": True})
+                elif op == "list":
+                    _send_msg(conn, {
+                        "ok": True,
+                        "instances": self.members(msg.get("role")),
+                    })
+                else:
+                    _send_msg(conn, {"ok": False,
+                                     "error": f"unknown op {op!r}"})
+        except (OSError, msgpack.UnpackException,
+                msgpack.exceptions.ExtraData):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class P2PRegistryClient:
+    """One instance's view of the registry (fresh socket per call —
+    calls are rare and short; liveness rides the heartbeat TTL)."""
+
+    def __init__(self, registry_addr: str, instance_id: str,
+                 role: str, ttl: float = 10.0) -> None:
+        host, port = registry_addr.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.instance_id = instance_id
+        self.role = role
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        self._my_addr: Optional[tuple[str, int]] = None
+
+    def _call(self, msg: dict, timeout: float = 5.0) -> dict:
+        with socket.create_connection(self._addr, timeout=timeout) as s:
+            _send_msg(s, msg)
+            resp = _recv_msg(s)
+            return resp or {"ok": False, "error": "closed"}
+
+    def register(self, addr: tuple[str, int],
+                 heartbeat: bool = True) -> None:
+        self._my_addr = addr
+        self._call({"op": "register", "instance": self.instance_id,
+                    "role": self.role, "addr": list(addr),
+                    "ttl": self.ttl})
+        if heartbeat and self._hb is None:
+            self._hb = threading.Thread(target=self._heartbeat_loop,
+                                        name="p2p-heartbeat",
+                                        daemon=True)
+            self._hb.start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                self._call({"op": "register",
+                            "instance": self.instance_id,
+                            "role": self.role,
+                            "addr": list(self._my_addr),
+                            "ttl": self.ttl})
+            except OSError:
+                pass  # registry briefly unreachable; TTL decides
+
+    def list(self, role: Optional[str] = None) -> dict[str, dict]:
+        try:
+            resp = self._call({"op": "list", "role": role})
+        except OSError:
+            return {}
+        return resp.get("instances", {})
+
+    def resolve(self, instance_id: str) -> Optional[tuple[str, int]]:
+        info = self.list().get(instance_id)
+        if info is None:
+            return None
+        return info["addr"][0], int(info["addr"][1])
+
+    def leave(self) -> None:
+        self._stop.set()
+        try:
+            self._call({"op": "deregister",
+                        "instance": self.instance_id})
+        except OSError:
+            pass
+
+
+class P2PDcnConnector(DCNPullConnector):
+    """DCN pull with dynamic membership (see module docstring).
+
+    Extra config: ``registry_addr`` ("host:port", required),
+    ``instance_id`` (defaults to role-pid), ``registry_ttl``.
+    """
+
+    def __init__(self, config, role: KVConnectorRole) -> None:
+        super().__init__(config, role)
+        import os
+        extra = config.kv_transfer_config.kv_connector_extra_config or {}
+        registry_addr = extra.get("registry_addr")
+        if not registry_addr:
+            raise ValueError(
+                "P2PDcnConnector needs kv_connector_extra_config."
+                "registry_addr (host:port of the membership registry)")
+        my_role = "producer" if self.is_producer else "consumer"
+        self.instance_id = str(
+            extra.get("instance_id", f"{my_role}-{os.getpid()}"))
+        self.registry = P2PRegistryClient(
+            registry_addr, self.instance_id, my_role,
+            ttl=float(extra.get("registry_ttl", 10.0)))
+        if role == KVConnectorRole.WORKER and self.is_producer:
+            # _start_server (super().__init__) bound the page server;
+            # join under its address and keep the membership alive.
+            self.registry.register((self.pull_host, self.pull_port))
+        elif role == KVConnectorRole.SCHEDULER and not self.is_producer:
+            # Consumers are members too (the deployment can see them
+            # join/leave); they serve no pages, so any address works.
+            self.registry.register(("0.0.0.0", 0))
+
+    # ---- scheduler side -------------------------------------------------
+    @staticmethod
+    def _valid_params(params) -> bool:
+        if not isinstance(params, dict):
+            return False
+        try:
+            if not (bool(params.get("remote_req_id"))
+                    and int(params["num_tokens"]) > 0):
+                return False
+        except (KeyError, TypeError, ValueError):
+            return False
+        # Either explicit coordinates or a resolvable instance id.
+        if params.get("remote_instance"):
+            return True
+        try:
+            return int(params.get("pull_port", 0)) > 0
+        except (TypeError, ValueError):
+            return False
+
+    def update_state_after_alloc(self, request, block_ids,
+                                 num_external_tokens) -> None:
+        params = request.kv_transfer_params
+        if (self.is_consumer and num_external_tokens
+                and isinstance(params, dict)
+                and params.get("remote_instance")
+                and not params.get("pull_port")):
+            addr = self.registry.resolve(str(params["remote_instance"]))
+            if addr is None:
+                # Producer left between finish and pull: fall back to
+                # local prefill by leaving the params invalid.
+                logger.warning(
+                    "producer instance %r not in registry; request %s "
+                    "recomputes locally", params["remote_instance"],
+                    request.request_id)
+                request.kv_transfer_params = None
+                return
+            params["pull_host"], params["pull_port"] = addr[0], addr[1]
+        super().update_state_after_alloc(request, block_ids,
+                                         num_external_tokens)
+
+    def request_finished(self, request, block_ids):
+        defer, params = super().request_finished(request, block_ids)
+        if params is not None:
+            # Route by instance id: consumers resolve the CURRENT
+            # address at pull time (survives producer restarts; new
+            # consumers need no static peer config).
+            params["remote_instance"] = self.instance_id
+        return defer, params
+
+    def get_num_new_matched_tokens(self, request, num_computed_tokens):
+        params = request.kv_transfer_params
+        if (self.is_consumer and isinstance(params, dict)
+                and params.get("remote_instance")
+                and not params.get("pull_port")
+                and self.registry.resolve(
+                    str(params["remote_instance"])) is None):
+            # Unknown producer: admit as a plain local-prefill request.
+            return 0, False
+        return super().get_num_new_matched_tokens(request,
+                                                  num_computed_tokens)
+
+    def shutdown(self) -> None:
+        self.registry.leave()
+        if hasattr(self, "_shutdown"):  # worker role owns the server
+            super().shutdown()
