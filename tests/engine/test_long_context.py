@@ -1,0 +1,82 @@
+"""Long-context proof at 128k+ tokens (VERDICT r4 #6; reference
+capability: dual_chunk_flash_attn.py serves 1M-token contexts).
+
+A 131k-token prompt runs through the real engine stack — chunked
+prefill over the bucket lattice, paged KV across ~8200 pages, decode
+afterwards — asserting the compile lattice stays bounded (no
+recompile storm as kv_len grows: shapes key on the TOKEN bucket, never
+on sequence length) and recording TTFT. The model is deliberately tiny
+(1 layer) so the quadratic attention cost, not the machinery, is the
+only scale factor on this CPU host.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+CTX = 131072
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=1,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=CTX + 1024,
+                      rope_theta=500000.0, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_128k")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def test_128k_prompt_through_the_lattice(ckpt):
+    engine = LLMEngine(EngineArgs(
+        model=ckpt, dtype="float32", block_size=16,
+        num_gpu_blocks_override=CTX // 16 + 64,
+        max_model_len=CTX,
+        max_num_batched_tokens=8192, max_num_seqs=4,
+        enable_prefix_caching=False,
+        skip_tokenizer_init=True).create_engine_config())
+    runner = engine.engine_core.engine_core.executor.worker.model_runner
+
+    rng = np.random.default_rng(0)
+    prompt = [int(t) for t in rng.integers(2, 250, size=CTX - 64)]
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    engine.add_request("long-0", prompt, sp)
+
+    compiled_before = len(runner._compiled_shapes)
+    t0 = time.perf_counter()
+    ttft = None
+    tokens = []
+    # Budget: chunked prefill is ~16 x 8192-token steps of a 1-layer
+    # model; a recompile storm or O(len^2)-per-step bug would blow far
+    # past this.
+    deadline = t0 + 1800
+    while engine.has_unfinished_requests():
+        assert time.perf_counter() < deadline, (
+            "128k prefill exceeded the wall-clock budget")
+        for out in engine.step():
+            if out.outputs[0].token_ids and ttft is None:
+                ttft = time.perf_counter() - t0
+            if out.finished:
+                tokens = out.outputs[0].token_ids
+    assert len(tokens) == 4
+    assert ttft is not None
+    # The compile lattice must NOT grow with sequence length: the
+    # handful of new (T, R) buckets this request touches is all that
+    # compiles (shapes key on token buckets, kv_len stays dynamic).
+    compiled_after = len(runner._compiled_shapes)
+    assert compiled_after - compiled_before <= 8, (
+        runner._compiled_shapes)
+    print(f"TTFT@{CTX - 64} tokens: {ttft:.1f}s, "
+          f"{compiled_after - compiled_before} new graphs")

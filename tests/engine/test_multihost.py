@@ -22,8 +22,11 @@ from vllm_distributed_tpu.utils import get_open_port
 _CHILD = r"""
 import os, sys
 rank = int(sys.argv[1]); port = sys.argv[2]
+n_hosts = int(sys.argv[3]); dev_per_host = int(sys.argv[4])
+tp = int(sys.argv[5]); pp = int(sys.argv[6])
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={dev_per_host}")
 os.environ["VDT_PALLAS_INTERPRET"] = "1"
 os.environ["VDT_PLATFORM"] = "cpu"
 import jax
@@ -50,8 +53,8 @@ config = EngineConfig(
                                      max_num_seqs=8, max_model_len=64),
     load_config=LoadConfig(load_format="dummy"),
     parallel_config=ParallelConfig(
-        tensor_parallel_size=8,       # spans BOTH processes' devices
-        num_hosts=2, host_rank=rank,
+        tensor_parallel_size=tp, pipeline_parallel_size=pp,
+        num_hosts=n_hosts, host_rank=rank,
         coordinator_address=f"127.0.0.1:{port}"),
 )
 config.model_config.hf_config = LlamaConfig(**config.model_config.hf_overrides)
@@ -59,8 +62,8 @@ config.model_config.hf_config = LlamaConfig(**config.model_config.hf_overrides)
 # Multi-controller SPMD: every host runs the identical engine program on
 # the identical request stream; collectives tie the step together.
 engine = LLMEngine(config, load_tokenizer=False)
-assert jax.process_count() == 2, jax.process_count()
-assert len(jax.devices()) == 8, jax.devices()
+assert jax.process_count() == n_hosts, jax.process_count()
+assert len(jax.devices()) == n_hosts * dev_per_host, jax.devices()
 
 sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
 engine.add_request("mh-0", [3, 17, 92, 45, 8], sp)
@@ -176,19 +179,20 @@ def test_scheduler_broadcast_executor(tmp_path, transport):
     assert driver_line and "mh-0" in driver_line[0]
 
 
-def test_two_process_spmd_engine_step(tmp_path):
+def _run_spmd(n_hosts, dev_per_host, tp, pp, timeout=600):
     port = get_open_port()
     procs = [
         subprocess.Popen([sys.executable, "-c", _CHILD, str(rank),
-                          str(port)],
+                          str(port), str(n_hosts), str(dev_per_host),
+                          str(tp), str(pp)],
                          stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True)
-        for rank in range(2)
+        for rank in range(n_hosts)
     ]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -201,5 +205,21 @@ def test_two_process_spmd_engine_step(tmp_path):
         lines = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
         assert lines, out[-2000:]
         results.append(lines[0].split(" ", 2)[2])
-    # Both controllers computed the identical step results.
-    assert results[0] == results[1]
+    # Every controller computed the identical step results.
+    assert all(r == results[0] for r in results)
+
+
+def test_two_process_spmd_engine_step(tmp_path):
+    _run_spmd(n_hosts=2, dev_per_host=4, tp=8, pp=1)
+
+
+def test_four_process_tp_lattice(tmp_path):
+    """4 controller processes x 2 virtual devices, one TP=8 mesh
+    (VERDICT r4 #8: the multihost path beyond 2 processes)."""
+    _run_spmd(n_hosts=4, dev_per_host=2, tp=8, pp=1)
+
+
+def test_four_process_pp_tp_lattice(tmp_path):
+    """4 processes, PP=2 stages x TP=4: the staged sub-meshes each span
+    two processes, activations hand off across the stage boundary."""
+    _run_spmd(n_hosts=4, dev_per_host=2, tp=4, pp=2)

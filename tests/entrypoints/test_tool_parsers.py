@@ -1,0 +1,103 @@
+"""Tool-call dialect parsers (reference: the per-model parsers under
+vllm/entrypoints/openai/tool_parsers/ and their unit tests)."""
+
+import json
+
+import pytest
+
+from vllm_distributed_tpu.entrypoints.openai.tool_parsers import (
+    get_tool_parser)
+
+
+def test_hermes_blocks_with_content():
+    p = get_tool_parser("hermes")
+    text = ('I will check the weather.\n<tool_call>\n'
+            '{"name": "get_weather", "arguments": {"city": "SF"}}\n'
+            '</tool_call>\n<tool_call>\n'
+            '{"name": "get_time", "arguments": {"tz": "PST"}}\n'
+            '</tool_call>')
+    content, calls = p.parse(text)
+    assert content == "I will check the weather."
+    assert calls == [
+        {"name": "get_weather", "arguments": {"city": "SF"}},
+        {"name": "get_time", "arguments": {"tz": "PST"}},
+    ]
+
+
+def test_hermes_no_markers_passthrough():
+    p = get_tool_parser("hermes")
+    content, calls = p.parse("just an answer")
+    assert content == "just an answer" and calls is None
+
+
+def test_mistral_array():
+    p = get_tool_parser("mistral")
+    text = ('[TOOL_CALLS] [{"name": "f", "arguments": {"x": 1}}, '
+            '{"name": "g", "arguments": {}}]')
+    content, calls = p.parse(text)
+    assert content == ""
+    assert calls == [{"name": "f", "arguments": {"x": 1}},
+                     {"name": "g", "arguments": {}}]
+
+
+def test_mistral_content_before_marker():
+    p = get_tool_parser("mistral")
+    content, calls = p.parse(
+        'Sure. [TOOL_CALLS] [{"name": "f", "arguments": {"x": 1}}]')
+    assert content == "Sure."
+    assert calls[0]["name"] == "f"
+
+
+def test_llama3_json_with_python_tag_and_semicolons():
+    p = get_tool_parser("llama3_json")
+    text = ('<|python_tag|>{"name": "a", "parameters": {"q": "x"}}; '
+            '{"name": "b", "parameters": {}}')
+    content, calls = p.parse(text)
+    assert content == ""
+    assert calls == [{"name": "a", "arguments": {"q": "x"}},
+                     {"name": "b", "arguments": {}}]
+
+
+def test_llama3_json_plain_text_passthrough():
+    p = get_tool_parser("llama3_json")
+    content, calls = p.parse("The answer is 4.")
+    assert calls is None and content == "The answer is 4."
+
+
+def test_pythonic_calls():
+    p = get_tool_parser("pythonic")
+    content, calls = p.parse(
+        "[get_weather(city='SF', units=2), noop()]")
+    assert content == ""
+    assert calls == [
+        {"name": "get_weather", "arguments": {"city": "SF", "units": 2}},
+        {"name": "noop", "arguments": {}},
+    ]
+
+
+def test_pythonic_rejects_non_literal_args():
+    p = get_tool_parser("pythonic")
+    content, calls = p.parse("[f(x=os.system('rm'))]")
+    assert calls is None  # non-literal arguments never evaluate
+
+
+def test_json_default_dialect():
+    p = get_tool_parser(None)
+    content, calls = p.parse(
+        '{"name": "f", "arguments": {"a": true}}')
+    assert content == "" and calls == [{"name": "f",
+                                        "arguments": {"a": True}}]
+
+
+def test_unknown_parser_rejected():
+    with pytest.raises(ValueError, match="unknown tool-call parser"):
+        get_tool_parser("clippy")
+
+
+def test_wire_wrapping():
+    from vllm_distributed_tpu.entrypoints.openai import protocol
+    wire = protocol.wrap_tool_calls(
+        [{"name": "f", "arguments": {"x": 1}}])
+    assert wire[0]["type"] == "function"
+    assert wire[0]["function"]["name"] == "f"
+    assert json.loads(wire[0]["function"]["arguments"]) == {"x": 1}

@@ -73,6 +73,18 @@ class ModelConfig:
         from the overrides so dummy-weight runs never need the network.
         """
         if self.hf_config is None:
+            if self.model.endswith(".gguf"):
+                # Single-file GGUF: the architecture config lives in
+                # the file's own metadata (reference: gguf_loader.py).
+                from transformers import LlamaConfig
+
+                from vllm_distributed_tpu.models.gguf import (
+                    hf_config_dict_from_gguf, read_gguf)
+                meta, tensors = read_gguf(self.model)
+                cfg = hf_config_dict_from_gguf(meta, tensors)
+                cfg.update(self.hf_overrides)
+                self.hf_config = LlamaConfig(**cfg)
+                return self.hf_config
             try:
                 from transformers import AutoConfig
                 try:

@@ -20,6 +20,12 @@ def _add_serve(sub) -> None:
                    metavar="NAME=PATH",
                    help="served LoRA adapters; request them via the "
                         "'model' field (requires --enable-lora)")
+    p.add_argument("--tool-call-parser", default=None,
+                   choices=["json", "hermes", "mistral", "llama3_json",
+                            "pythonic"],
+                   help="model-specific tool-call dialect for "
+                        "tool_choice=auto (reference: "
+                        "openai/tool_parsers/)")
     EngineArgs.add_cli_args(p)
 
 
@@ -62,7 +68,8 @@ def cmd_serve(args) -> None:
         raise SystemExit("--lora-modules requires --enable-lora")
     engine_args = EngineArgs.from_cli_args(args)
     run_server(engine_args, host=args.host, port=args.port,
-               lora_modules=lora_modules or None)
+               lora_modules=lora_modules or None,
+               tool_call_parser=args.tool_call_parser)
 
 
 def cmd_bench(args) -> None:

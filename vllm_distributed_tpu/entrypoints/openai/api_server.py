@@ -25,6 +25,7 @@ logger = init_logger(__name__)
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLM)
 MODEL_KEY = web.AppKey("model_name", str)
+TOOL_PARSER_KEY = web.AppKey("tool_parser", object)
 # Served LoRA adapters: name -> checkpoint path (reference: the
 # --lora-modules serve flag; requests select one via the "model" field).
 LORA_MODULES_KEY = web.AppKey("lora_modules", dict)
@@ -835,10 +836,23 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             text = final.outputs[0].text
             parse_tools = (None if body.get("tool_choice") == "none"
                            else body.get("tools"))
-            tool_calls = protocol.parse_tool_calls(
-                text, forced_tool, parse_tools)
+            tool_calls = None
+            content = text
+            dialect = request.app[TOOL_PARSER_KEY]
+            if (forced_tool is None and parse_tools
+                    and dialect is not None):
+                # Model-specific dialect parser (reference:
+                # tool_parsers/): splits content from the dialect's
+                # tool-call wrapping.
+                content, calls = dialect.parse(text)
+                if calls:
+                    tool_calls = protocol.wrap_tool_calls(calls)
+            else:
+                tool_calls = protocol.parse_tool_calls(
+                    text, forced_tool, parse_tools)
             if tool_calls is not None:
-                message = {"role": "assistant", "content": None,
+                message = {"role": "assistant",
+                           "content": content or None,
                            "tool_calls": tool_calls}
                 finish = "tool_calls"
             else:
@@ -915,11 +929,18 @@ def _resolve_lora(app: web.Application, body: dict) -> Optional[dict]:
 
 
 def build_app(engine: AsyncLLM, model_name: str,
-              lora_modules: Optional[dict] = None) -> web.Application:
+              lora_modules: Optional[dict] = None,
+              tool_call_parser: Optional[str] = None) -> web.Application:
     app = web.Application(middlewares=[_auth_middleware_factory])
     app[ENGINE_KEY] = engine
     app[MODEL_KEY] = model_name
     app[LORA_MODULES_KEY] = dict(lora_modules or {})
+    if tool_call_parser:
+        from vllm_distributed_tpu.entrypoints.openai.tool_parsers import \
+            get_tool_parser
+        app[TOOL_PARSER_KEY] = get_tool_parser(tool_call_parser)
+    else:
+        app[TOOL_PARSER_KEY] = None
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/metrics", metrics)
@@ -941,10 +962,12 @@ def build_app(engine: AsyncLLM, model_name: str,
 async def serve(engine: AsyncLLM, model_name: str, host: str,
                 port: int, ready_event=None,
                 stop_event: Optional[asyncio.Event] = None,
-                lora_modules: Optional[dict] = None) -> None:
+                lora_modules: Optional[dict] = None,
+                tool_call_parser: Optional[str] = None) -> None:
     """Run until stop_event (or forever); graceful engine shutdown on
     exit (reference: entrypoints/launcher.py serve_http)."""
-    app = build_app(engine, model_name, lora_modules)
+    app = build_app(engine, model_name, lora_modules,
+                    tool_call_parser=tool_call_parser)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
@@ -964,8 +987,10 @@ async def serve(engine: AsyncLLM, model_name: str, host: str,
 
 
 def run_server(engine_args, host: str = "0.0.0.0", port: int = 8000,
-               lora_modules: Optional[dict] = None) -> None:
+               lora_modules: Optional[dict] = None,
+               tool_call_parser: Optional[str] = None) -> None:
     """Blocking entry used by the CLI (reference: api_server.py:1672)."""
     engine = AsyncLLM.from_engine_args(engine_args)
     asyncio.run(serve(engine, engine_args.model, host, port,
-                      lora_modules=lora_modules))
+                      lora_modules=lora_modules,
+                      tool_call_parser=tool_call_parser))

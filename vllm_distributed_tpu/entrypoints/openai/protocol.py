@@ -6,6 +6,7 @@ dependency here: plain dict parsing with explicit validation keeps the
 server dependency-light; the wire shapes match the reference.
 """
 
+import json
 import time
 from typing import Any, Optional
 
@@ -208,6 +209,16 @@ def parse_tool_calls(text: str, forced_tool: Optional[str],
         "function": {"name": name,
                      "arguments": _json.dumps(arguments)},
     }]
+
+
+def wrap_tool_calls(calls: list[dict]) -> list[dict]:
+    """Canonical parsed calls -> OpenAI wire tool_calls entries."""
+    return [{
+        "id": f"call-{random_uuid()[:24]}",
+        "type": "function",
+        "function": {"name": c["name"],
+                     "arguments": json.dumps(c["arguments"])},
+    } for c in calls]
 
 
 def completion_id() -> str:

@@ -1,0 +1,181 @@
+"""Model-specific tool-call parsers (reference:
+vllm/entrypoints/openai/tool_parsers/ — hermes_tool_parser.py,
+mistral_tool_parser.py, llama_tool_parser.py, pythonic_tool_parser.py).
+
+Each dialect knows how its model family wraps function calls in
+generated text; ``parse(text)`` returns (content, tool_calls) where
+``content`` is the text with tool sections removed and ``tool_calls``
+is a list of {"name": str, "arguments": dict} (None when the text
+contains no calls). Selected per server via ``--tool-call-parser``;
+the default "json" dialect is the generic bare-JSON behavior the
+grammar-forced path produces.
+"""
+
+import ast
+import json
+import re
+from typing import Optional
+
+_Calls = Optional[list[dict]]
+
+
+class ToolParser:
+    """Base: no dialect markers — a bare JSON object IS the call."""
+
+    name = "json"
+
+    def parse(self, text: str) -> tuple[str, _Calls]:
+        try:
+            obj = json.loads(text)
+        except (ValueError, TypeError):
+            return text, None
+        call = self._normalize(obj)
+        return ("", [call]) if call else (text, None)
+
+    @staticmethod
+    def _normalize(obj) -> Optional[dict]:
+        """{"name", "arguments"|"parameters"} -> canonical call."""
+        if not isinstance(obj, dict) or not isinstance(
+                obj.get("name"), str):
+            return None
+        args = obj.get("arguments", obj.get("parameters"))
+        if isinstance(args, str):
+            try:
+                args = json.loads(args)
+            except ValueError:
+                return None
+        if not isinstance(args, dict):
+            return None
+        return {"name": obj["name"], "arguments": args}
+
+
+class HermesToolParser(ToolParser):
+    """NousResearch Hermes: ``<tool_call>{json}</tool_call>`` blocks,
+    any number, interleaved with plain content (reference:
+    hermes_tool_parser.py)."""
+
+    name = "hermes"
+    _RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+
+    def parse(self, text: str) -> tuple[str, _Calls]:
+        calls = []
+        for m in self._RE.finditer(text):
+            call = self._normalize(self._loads(m.group(1)))
+            if call:
+                calls.append(call)
+        if not calls:
+            return text, None
+        content = self._RE.sub("", text).strip()
+        return content, calls
+
+    @staticmethod
+    def _loads(s):
+        try:
+            return json.loads(s)
+        except (ValueError, TypeError):
+            return None
+
+
+class MistralToolParser(ToolParser):
+    """Mistral: ``[TOOL_CALLS]`` token followed by a JSON array of
+    calls (reference: mistral_tool_parser.py)."""
+
+    name = "mistral"
+    _MARK = "[TOOL_CALLS]"
+
+    def parse(self, text: str) -> tuple[str, _Calls]:
+        if self._MARK not in text:
+            return text, None
+        before, _, after = text.partition(self._MARK)
+        try:
+            arr = json.loads(after.strip())
+        except (ValueError, TypeError):
+            return text, None
+        if isinstance(arr, dict):
+            arr = [arr]
+        calls = [c for c in (self._normalize(o) for o in arr) if c]
+        if not calls:
+            return text, None
+        return before.strip(), calls
+
+
+class Llama3JsonToolParser(ToolParser):
+    """Llama-3.x JSON-style calls: the message is one (or several
+    ``;``-separated) ``{"name": ..., "parameters": {...}}`` objects,
+    optionally behind the ``<|python_tag|>`` marker (reference:
+    llama_tool_parser.py)."""
+
+    name = "llama3_json"
+    _TAG = "<|python_tag|>"
+
+    def parse(self, text: str) -> tuple[str, _Calls]:
+        body = text
+        if self._TAG in body:
+            body = body.split(self._TAG, 1)[1]
+        body = body.strip()
+        if not body.startswith("{"):
+            return text, None
+        calls = []
+        for part in body.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                obj = json.loads(part)
+            except (ValueError, TypeError):
+                return text, None
+            call = self._normalize(obj)
+            if call is None:
+                return text, None
+            calls.append(call)
+        return ("", calls) if calls else (text, None)
+
+
+class PythonicToolParser(ToolParser):
+    """Pythonic calls (Llama-4 / functionary style): a list of python
+    call expressions ``[f(x=1), g(y="a")]`` (reference:
+    pythonic_tool_parser.py). Arguments must be literals."""
+
+    name = "pythonic"
+
+    def parse(self, text: str) -> tuple[str, _Calls]:
+        body = text.strip()
+        if not (body.startswith("[") and body.endswith("]")):
+            return text, None
+        try:
+            tree = ast.parse(body, mode="eval")
+        except SyntaxError:
+            return text, None
+        if not isinstance(tree.body, ast.List):
+            return text, None
+        calls = []
+        for node in tree.body.elts:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and not node.args):
+                return text, None
+            try:
+                args = {kw.arg: ast.literal_eval(kw.value)
+                        for kw in node.keywords if kw.arg}
+            except (ValueError, SyntaxError):
+                return text, None
+            calls.append({"name": node.func.id, "arguments": args})
+        return ("", calls) if calls else (text, None)
+
+
+_PARSERS = {
+    cls.name: cls
+    for cls in (ToolParser, HermesToolParser, MistralToolParser,
+                Llama3JsonToolParser, PythonicToolParser)
+}
+
+
+def get_tool_parser(name: Optional[str]) -> ToolParser:
+    if not name:
+        name = "json"
+    try:
+        return _PARSERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tool-call parser {name!r} "
+            f"(available: {sorted(_PARSERS)})") from None

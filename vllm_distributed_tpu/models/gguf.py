@@ -1,0 +1,320 @@
+"""GGUF checkpoint loading (reference:
+vllm/model_executor/model_loader/gguf_loader.py — vLLM mounts GGUF
+files through gguf-py and torch dequant kernels; here the format is
+parsed directly and dequantized host-side into the standard fp load
+path, like the GPTQ/AWQ loaders).
+
+Scope: GGUF v3, llama-family architecture, tensor types F32 / F16 /
+BF16 / Q8_0 (the lossless-ish formats; K-quants can be added as pure
+numpy dequants later). The llama.cpp conversion permutes q/k
+projection rows for GGML's interleaved-rope convention
+(convert_hf_to_gguf.py ``permute``); loading inverts it so weights
+match the HF layout the model code expects.
+
+A minimal writer (``write_gguf``) exists for tests: it produces real
+GGUF v3 bytes with llama.cpp tensor names and the q/k permute applied,
+so the loader is exercised against the actual on-disk convention.
+"""
+
+import struct
+from typing import Any, BinaryIO
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_MAGIC = b"GGUF"
+
+# Metadata value types (ggml spec).
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, \
+    _F64 = range(13)
+_SCALAR = {
+    _U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
+    _I32: "<i", _F32: "<f", _BOOL: "<?", _U64: "<Q", _I64: "<q",
+    _F64: "<d",
+}
+
+# Tensor dtypes.
+_T_F32, _T_F16 = 0, 1
+_T_Q8_0 = 8
+_T_BF16 = 30
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    return struct.unpack(fmt, f.read(size))[0]
+
+
+def _read_str(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    return f.read(n).decode("utf-8")
+
+
+def _read_value(f: BinaryIO, vtype: int):
+    if vtype in _SCALAR:
+        return _read(f, _SCALAR[vtype])
+    if vtype == _STR:
+        return _read_str(f)
+    if vtype == _ARR:
+        etype = _read(f, "<I")
+        count = _read(f, "<Q")
+        return [_read_value(f, etype) for _ in range(count)]
+    raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+def _dequant(raw: bytes, dtype: int, shape: tuple[int, ...]) -> np.ndarray:
+    n = int(np.prod(shape))
+    if dtype == _T_F32:
+        arr = np.frombuffer(raw, np.float32, n)
+    elif dtype == _T_F16:
+        arr = np.frombuffer(raw, np.float16, n).astype(np.float32)
+    elif dtype == _T_BF16:
+        import ml_dtypes
+        arr = np.frombuffer(raw, ml_dtypes.bfloat16, n).astype(np.float32)
+    elif dtype == _T_Q8_0:
+        # Blocks of 32: f16 scale + 32 int8 payloads (34 bytes).
+        nb = n // 32
+        blocks = np.frombuffer(raw, np.uint8, nb * 34).reshape(nb, 34)
+        scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        q = blocks[:, 2:].view(np.int8).astype(np.float32)
+        arr = (q * scales).reshape(-1)
+    else:
+        raise ValueError(f"unsupported gguf tensor type {dtype} "
+                         "(supported: F32, F16, BF16, Q8_0)")
+    return arr.reshape(shape)
+
+
+def read_gguf(path: str) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
+    """(metadata, tensors). Tensor shapes come out numpy-style (GGML
+    stores dims innermost-first; they are reversed here)."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError(f"{path} is not a GGUF file")
+        version = _read(f, "<I")
+        if version < 2:
+            raise ValueError(f"GGUF v{version} is too old (need >= 2)")
+        n_tensors = _read(f, "<Q")
+        n_kv = _read(f, "<Q")
+        meta: dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = _read_str(f)
+            vtype = _read(f, "<I")
+            meta[key] = _read_value(f, vtype)
+        infos = []
+        for _ in range(n_tensors):
+            name = _read_str(f)
+            n_dims = _read(f, "<I")
+            dims = [_read(f, "<Q") for _ in range(n_dims)]
+            dtype = _read(f, "<I")
+            offset = _read(f, "<Q")
+            infos.append((name, tuple(reversed(dims)), dtype, offset))
+        align = int(meta.get("general.alignment", 32))
+        base = f.tell()
+        base = (base + align - 1) // align * align
+        tensors = {}
+        for name, shape, dtype, offset in infos:
+            f.seek(base + offset)
+            nbytes = _tensor_nbytes(dtype, shape)
+            tensors[name] = _dequant(f.read(nbytes), dtype, shape)
+    return meta, tensors
+
+
+def _tensor_nbytes(dtype: int, shape: tuple[int, ...]) -> int:
+    n = int(np.prod(shape))
+    if dtype == _T_F32:
+        return n * 4
+    if dtype in (_T_F16, _T_BF16):
+        return n * 2
+    if dtype == _T_Q8_0:
+        return n // 32 * 34
+    raise ValueError(f"unsupported gguf tensor type {dtype}")
+
+
+def _permute_inv(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Invert llama.cpp's q/k row permute (convert_hf_to_gguf.py):
+    forward = reshape(h, 2, d/2, in).swapaxes(1, 2).reshape."""
+    out = w.shape[0]
+    d = out // n_head
+    return (w.reshape(n_head, d // 2, 2, *w.shape[1:])
+            .swapaxes(1, 2).reshape(w.shape))
+
+
+def permute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
+    """llama.cpp's forward permute (used by the test writer)."""
+    out = w.shape[0]
+    d = out // n_head
+    return (w.reshape(n_head, 2, d // 2, *w.shape[1:])
+            .swapaxes(1, 2).reshape(w.shape))
+
+
+_DIRECT = {
+    "token_embd.weight": "model.embed_tokens.weight",
+    "output_norm.weight": "model.norm.weight",
+    "output.weight": "lm_head.weight",
+}
+_LAYER = {
+    "attn_norm.weight": "input_layernorm.weight",
+    "attn_q.weight": "self_attn.q_proj.weight",
+    "attn_k.weight": "self_attn.k_proj.weight",
+    "attn_v.weight": "self_attn.v_proj.weight",
+    "attn_output.weight": "self_attn.o_proj.weight",
+    "ffn_gate.weight": "mlp.gate_proj.weight",
+    "ffn_up.weight": "mlp.up_proj.weight",
+    "ffn_down.weight": "mlp.down_proj.weight",
+    "ffn_norm.weight": "post_attention_layernorm.weight",
+}
+
+
+def gguf_to_hf_state_dict(meta: dict,
+                          tensors: dict[str, np.ndarray]) -> dict:
+    """llama.cpp tensor names -> HF names, q/k permute inverted."""
+    n_head = int(meta["llama.attention.head_count"])
+    n_kv = int(meta.get("llama.attention.head_count_kv", n_head))
+    out = {}
+    for name, arr in tensors.items():
+        if name in _DIRECT:
+            out[_DIRECT[name]] = arr
+            continue
+        if not name.startswith("blk."):
+            logger.warning("gguf: skipping unknown tensor %r", name)
+            continue
+        _, idx, rest = name.split(".", 2)
+        hf_suffix = _LAYER.get(rest)
+        if hf_suffix is None:
+            logger.warning("gguf: skipping unknown tensor %r", name)
+            continue
+        if rest == "attn_q.weight":
+            arr = _permute_inv(arr, n_head)
+        elif rest == "attn_k.weight":
+            arr = _permute_inv(arr, n_kv)
+        out[f"model.layers.{idx}.{hf_suffix}"] = arr
+    if "lm_head.weight" not in out and "model.embed_tokens.weight" in out:
+        out["lm_head.weight"] = out["model.embed_tokens.weight"]
+    return out
+
+
+def hf_config_dict_from_gguf(meta: dict,
+                             tensors: dict[str, np.ndarray]) -> dict:
+    """LlamaConfig kwargs from GGUF metadata (reference: the config
+    extraction of gguf_loader.py)."""
+    H = int(meta["llama.embedding_length"])
+    heads = int(meta["llama.attention.head_count"])
+    return dict(
+        architectures=["LlamaForCausalLM"],
+        model_type="llama",
+        vocab_size=int(tensors["token_embd.weight"].shape[0]),
+        hidden_size=H,
+        intermediate_size=int(meta["llama.feed_forward_length"]),
+        num_hidden_layers=int(meta["llama.block_count"]),
+        num_attention_heads=heads,
+        num_key_value_heads=int(
+            meta.get("llama.attention.head_count_kv", heads)),
+        max_position_embeddings=int(
+            meta.get("llama.context_length", 2048)),
+        rms_norm_eps=float(
+            meta.get("llama.attention.layer_norm_rms_epsilon", 1e-5)),
+        rope_theta=float(meta.get("llama.rope.freq_base", 10000.0)),
+        tie_word_embeddings="output.weight" not in tensors,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Minimal writer (tests): real GGUF v3 bytes from an HF llama state dict
+# ---------------------------------------------------------------------------
+
+def _write_str(f: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack("<Q", len(b)) + b)
+
+
+def _kv(f: BinaryIO, key: str, vtype: int, value) -> None:
+    _write_str(f, key)
+    f.write(struct.pack("<I", vtype))
+    if vtype in _SCALAR:
+        f.write(struct.pack(_SCALAR[vtype], value))
+    elif vtype == _STR:
+        _write_str(f, value)
+    else:
+        raise ValueError(vtype)
+
+
+def write_gguf(path: str, hf_config, state_dict: dict,
+               quant: str = "f32") -> None:
+    """HF llama tensors -> a GGUF v3 file with llama.cpp naming and the
+    q/k permute applied (what convert_hf_to_gguf.py emits)."""
+    inv_layer = {v: k for k, v in _LAYER.items()}
+    inv_direct = {v: k for k, v in _DIRECT.items()}
+    n_head = hf_config.num_attention_heads
+    n_kv = hf_config.num_key_value_heads
+
+    entries = []
+    for name, w in state_dict.items():
+        arr = np.asarray(w, np.float32)
+        if name in inv_direct:
+            gname = inv_direct[name]
+        elif name.startswith("model.layers."):
+            _m, _l, idx, rest = name.split(".", 3)
+            suffix = inv_layer.get(rest)
+            if suffix is None:
+                continue
+            if rest == "self_attn.q_proj.weight":
+                arr = permute_qk(arr, n_head)
+            elif rest == "self_attn.k_proj.weight":
+                arr = permute_qk(arr, n_kv)
+            gname = f"blk.{idx}.{suffix}"
+        else:
+            continue
+        if quant == "q8_0" and arr.ndim == 2 and arr.size % 32 == 0:
+            flat = arr.reshape(-1, 32)
+            scale = (np.abs(flat).max(axis=1, keepdims=True) /
+                     127.0).astype(np.float32)
+            scale = np.maximum(scale, 1e-8)
+            q = np.clip(np.round(flat / scale), -127,
+                        127).astype(np.int8)
+            payload = np.concatenate(
+                [scale.astype(np.float16).view(np.uint8),
+                 q.view(np.uint8)], axis=1).tobytes()
+            entries.append((gname, arr.shape, _T_Q8_0, payload))
+        else:
+            entries.append((gname, arr.shape, _T_F32, arr.tobytes()))
+
+    meta = [
+        ("general.architecture", _STR, "llama"),
+        ("llama.embedding_length", _U32, hf_config.hidden_size),
+        ("llama.block_count", _U32, hf_config.num_hidden_layers),
+        ("llama.feed_forward_length", _U32, hf_config.intermediate_size),
+        ("llama.attention.head_count", _U32, n_head),
+        ("llama.attention.head_count_kv", _U32, n_kv),
+        ("llama.attention.layer_norm_rms_epsilon", _F32,
+         hf_config.rms_norm_eps),
+        ("llama.rope.freq_base", _F32,
+         getattr(hf_config, "rope_theta", 10000.0)),
+        ("llama.context_length", _U32,
+         hf_config.max_position_embeddings),
+        ("general.alignment", _U32, 32),
+    ]
+
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", 3))
+        f.write(struct.pack("<Q", len(entries)))
+        f.write(struct.pack("<Q", len(meta)))
+        for key, vtype, value in meta:
+            _kv(f, key, vtype, value)
+        offset = 0
+        for gname, shape, dtype, payload in entries:
+            _write_str(f, gname)
+            f.write(struct.pack("<I", len(shape)))
+            for d in reversed(shape):
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<I", dtype))
+            f.write(struct.pack("<Q", offset))
+            offset += (len(payload) + 31) // 32 * 32
+        pos = f.tell()
+        f.write(b"\x00" * ((pos + 31) // 32 * 32 - pos))
+        for _gname, _shape, _dtype, payload in entries:
+            f.write(payload)
+            pad = (len(payload) + 31) // 32 * 32 - len(payload)
+            f.write(b"\x00" * pad)

@@ -42,6 +42,15 @@ def load_hf_state_dict(model_path: str,
     def want(name: str) -> bool:
         return not prefixes or name.startswith(prefixes)
 
+    if model_path.endswith(".gguf"):
+        # GGUF single-file checkpoints (reference: gguf_loader.py);
+        # dequantized host-side into the standard fp path.
+        from vllm_distributed_tpu.models.gguf import (
+            gguf_to_hf_state_dict, read_gguf)
+        meta, raw = read_gguf(model_path)
+        return {k: v for k, v in
+                gguf_to_hf_state_dict(meta, raw).items() if want(k)}
+
     st_files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
     tensors: dict[str, np.ndarray] = {}
     if st_files:
@@ -275,8 +284,10 @@ def get_model(config: EngineConfig, mesh,
         params = ocp.StandardCheckpointer().restore(
             os.path.abspath(ckpt_dir))
         logger.info("restored sharded state from %s", ckpt_dir)
-    elif load_format == "dummy" or (load_format == "auto"
-                                    and not os.path.isdir(model_path)):
+    elif load_format == "dummy" or (
+            load_format == "auto" and not os.path.isdir(model_path)
+            and not (model_path.endswith(".gguf")
+                     and os.path.isfile(model_path))):
         if load_format != "dummy":
             logger.warning(
                 "%s is not a local directory; using dummy weights "

@@ -46,7 +46,26 @@ def build_mesh(parallel_config: ParallelConfig,
         raise ValueError(
             f"mesh {dict(shape)} needs {world} devices, "
             f"only {len(devices)} available")
-    dev_array = np.array(devices[:world]).reshape(sizes)
+    pp = shape.get(MESH_AXIS_PIPE, 1) if isinstance(shape, dict) else 1
+    try:
+        procs = jax.process_count()
+    except Exception:  # noqa: BLE001 - uninitialized backends
+        procs = 1
+    dpp = world // max(procs, 1)
+    if pp > 1 and procs > 1 and dpp % pp == 0:
+        # Multi-process pipeline: carve stages out of each process's
+        # LOCAL devices so every process contributes devices to every
+        # stage — the per-stage activation handoff (pp_runner's
+        # device_put) then only moves data between locally-addressable
+        # shards. Stages that own whole processes would strand the
+        # handoff: the destination process holds no source shard.
+        d, k, p_, m = (sizes[AXIS_ORDER.index(a)] for a in AXIS_ORDER)
+        arr = np.array(devices[:world]).reshape(procs, pp, dpp // pp)
+        stage_major = arr.transpose(1, 0, 2).reshape(pp, world // pp)
+        dev_array = stage_major.reshape(pp, d, k, m).transpose(1, 2, 0,
+                                                               3)
+    else:
+        dev_array = np.array(devices[:world]).reshape(sizes)
     return Mesh(dev_array, AXIS_ORDER)
 
 

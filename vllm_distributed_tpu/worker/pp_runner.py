@@ -124,12 +124,19 @@ class PPModelRunner(TPUModelRunner):
         model = self.model
 
         def embed(params, token_ids, positions=None):
-            return model.embed(params, token_ids, positions)
+            h = model.embed(params, token_ids, positions)
+            # Replicate INSIDE the jit (GSPMD all-gather over the stage
+            # mesh, where collectives are legal) so the inter-stage hop
+            # only moves locally-complete values — multi-controller
+            # device_put cannot gather across hosts (see _hop).
+            return jax.lax.with_sharding_constraint(h, PartitionSpec())
 
         def stage(layer_params, kv_caches, hidden, batch, first_layer=0):
             hidden, kv_caches = model.run_layers(layer_params, kv_caches,
                                                  hidden, batch,
                                                  first_layer=first_layer)
+            hidden = jax.lax.with_sharding_constraint(
+                hidden, PartitionSpec())
             return kv_caches, hidden
 
         self._embed_fn = jax.jit(embed)
@@ -154,6 +161,24 @@ class PPModelRunner(TPUModelRunner):
         return (self.layer_ranges[p][0]
                 if self.model.cfg.window_pattern else 0)
 
+    def _hop(self, hidden, sm):
+        """Activation handoff onto stage ``sm`` (reference analogue:
+        IntermediateTensors send/recv). Single-controller: one async
+        device_put over ICI. Multi-controller: the stage jits emit the
+        activation REPLICATED (each process holds the full value on its
+        own stage devices), so the hop rebuilds the array from the
+        local shard — no cross-host device_put, which multi-controller
+        JAX restricts to identical device sets."""
+        target = NamedSharding(sm, PartitionSpec())
+        if jax.process_count() == 1:
+            return jax.device_put(hidden, target)
+        if hidden.sharding.device_set == target.device_set:
+            return jax.device_put(hidden, target)
+        import numpy as np
+        local = np.asarray(hidden.addressable_shards[0].data)
+        return jax.make_array_from_callback(
+            hidden.shape, target, lambda idx: local[idx])
+
     def _launch_device_step(self, token_ids, batch, logits_indices,
                             sampling_md, fwd_shape, ext_md, want_topk,
                             vocab_mask=None, plp=None, spec_q=None):
@@ -165,15 +190,12 @@ class PPModelRunner(TPUModelRunner):
         for p in range(self.pp):
             sm = self.stage_meshes[p]
             # Activation handoff: ICI/DCN copy to the next stage's
-            # sub-mesh (reference analogue: IntermediateTensors
-            # send/recv). Replicated over the stage's (token, model)
-            # axes; GSPMD re-partitions inside as needed. Dispatch is
-            # async end-to-end: nothing here blocks the host, so when
-            # the engine core keeps multiple batches in flight, stage p
-            # of batch i+1 runs under stage p+1 of batch i (each stage's
-            # KV cache chains only to ITS OWN previous-batch output).
-            hidden = jax.device_put(
-                hidden, NamedSharding(sm, PartitionSpec()))
+            # sub-mesh. Dispatch is async end-to-end on one controller:
+            # nothing here blocks the host, so when the engine core
+            # keeps multiple batches in flight, stage p of batch i+1
+            # runs under stage p+1 of batch i (each stage's KV cache
+            # chains only to ITS OWN previous-batch output).
+            hidden = self._hop(hidden, sm)
             with global_mesh(sm), sm:
                 with self._compile_watch(("stage", p) + fwd_shape):
                     self.kv_caches[p], hidden = self._stage_fn(
@@ -202,8 +224,7 @@ class PPModelRunner(TPUModelRunner):
                                  batch.positions)
             for p in range(self.pp):
                 sm = self.stage_meshes[p]
-                hidden = jax.device_put(
-                    hidden, NamedSharding(sm, PartitionSpec()))
+                hidden = self._hop(hidden, sm)
                 with global_mesh(sm), sm:
                     with self._compile_watch(("stage", p, T, max_q, G)):
                         self.kv_caches[p], hidden = self._stage_fn(
@@ -247,8 +268,7 @@ class PPModelRunner(TPUModelRunner):
                                  batch.positions)
         for p in range(self.pp):
             sm = self.stage_meshes[p]
-            hidden = jax.device_put(hidden,
-                                    NamedSharding(sm, PartitionSpec()))
+            hidden = self._hop(hidden, sm)
             with global_mesh(sm), sm:
                 scratch[p], hidden = self._stage_fn(
                     self.stage_params[p], scratch[p], hidden, batch,
