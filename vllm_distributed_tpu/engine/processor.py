@@ -389,12 +389,18 @@ class Processor:
                 or "encoder_text" in multi_modal_data):
             return self._process_encoder_text(multi_modal_data,
                                               prompt_token_ids)
+        if ("image_grid_thw" in multi_modal_data
+                or "pixel_values_videos" in multi_modal_data
+                or "video_grid_thw" in multi_modal_data):
+            return self._process_qwen2_vl(multi_modal_data,
+                                          prompt_token_ids)
         unknown = set(multi_modal_data) - {"image_embeds", "pixel_values"}
         if unknown:
             raise ValueError(
                 f"unsupported multi_modal_data keys {sorted(unknown)}; "
                 "this engine accepts 'image_embeds' (pre-computed), "
-                "'pixel_values' (in-engine vision tower), or "
+                "'pixel_values' (+ 'image_grid_thw' for Qwen2-VL), "
+                "'pixel_values_videos'/'video_grid_thw' (video), or "
                 "'audio'/'input_features' (Whisper-family models)")
         if "pixel_values" in multi_modal_data:
             if "image_embeds" in multi_modal_data:
@@ -430,3 +436,85 @@ class Processor:
                 f"request needs {n_enc} encoder tokens; the engine's "
                 f"encoder_cache_budget is {budget}")
         return mm_inputs, expanded
+
+    _qwen_vision = None
+
+    def _process_qwen2_vl(self, multi_modal_data: dict,
+                          prompt_token_ids: list[int]):
+        """Qwen2-VL images AND videos: HF-image-processor-style inputs
+        (flattened patches + grid_thw per input) run the dynamic-
+        resolution tower at admission; each placeholder expands to its
+        merged-token count and carries its (t, h', w') grid for M-RoPE
+        (reference: the qwen2_vl multimodal processor +
+        get_rope_index)."""
+        import numpy as np
+
+        from vllm_distributed_tpu.multimodal import MultiModalInput
+        hf = self.config.model_config.maybe_load_hf_config()
+        from vllm_distributed_tpu.models.registry import \
+            resolve_architecture
+        cls = resolve_architecture(hf)
+        if getattr(cls, "VISION_STYLE", None) != "qwen2_vl":
+            raise ValueError(
+                "grid_thw-style vision inputs need a Qwen2-VL-family "
+                "model")
+        if self._qwen_vision is None:
+            from vllm_distributed_tpu.multimodal.qwen2_vision import \
+                build_qwen2_vision_encoder
+            self._qwen_vision = build_qwen2_vision_encoder(
+                self.config.model_config.model, hf)
+            if self._qwen_vision is None:
+                raise ValueError(
+                    "qwen2-vl vision inputs need a local checkpoint "
+                    "with the visual.* tower tensors")
+        enc = self._qwen_vision
+        m = enc.merge
+
+        def encode(pix_key, grid_key):
+            pix = multi_modal_data.get(pix_key)
+            if pix is None:
+                return []
+            grids = multi_modal_data.get(grid_key)
+            if grids is None:
+                raise ValueError(f"{pix_key} needs {grid_key}")
+            grids = [tuple(int(v) for v in g) for g in np.asarray(grids)]
+            embeds = enc.encode(np.asarray(pix, np.float32), grids)
+            return [(e, (t, h // m, w // m))
+                    for e, (t, h, w) in zip(embeds, grids)]
+
+        images = encode("pixel_values", "image_grid_thw")
+        videos = encode("pixel_values_videos", "video_grid_thw")
+
+        image_tok = int(getattr(hf, "image_token_id", -1))
+        video_tok = int(getattr(hf, "video_token_id", -2))
+        n_img = sum(1 for t in prompt_token_ids if t == image_tok)
+        n_vid = sum(1 for t in prompt_token_ids if t == video_tok)
+        if n_img != len(images) or n_vid != len(videos):
+            raise ValueError(
+                f"prompt has {n_img} image / {n_vid} video placeholder "
+                f"tokens but {len(images)} images / {len(videos)} "
+                f"videos were provided")
+        queues = {image_tok: list(images), video_tok: list(videos)}
+        out: list[int] = []
+        mm_inputs: list[MultiModalInput] = []
+        for t in prompt_token_ids:
+            q = queues.get(t)
+            if q:
+                embeds, grid = q.pop(0)
+                mm_inputs.append(MultiModalInput(
+                    embeds=embeds, offset=len(out), grid=grid))
+                out.extend([t] * embeds.shape[0])
+            else:
+                out.append(t)
+        leftover = sum(len(q) for q in queues.values())
+        if leftover:
+            raise ValueError(
+                f"{leftover} image/video inputs had no matching "
+                f"placeholder token in the prompt")
+        budget = self.config.scheduler_config.encoder_cache_budget
+        n_enc = sum(mi.num_tokens for mi in mm_inputs)
+        if n_enc > budget:
+            raise ValueError(
+                f"request needs {n_enc} encoder tokens; the engine's "
+                f"encoder_cache_budget is {budget}")
+        return mm_inputs, out

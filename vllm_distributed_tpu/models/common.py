@@ -97,6 +97,11 @@ class AttentionBatch:
     # like every other static flag).
     mm_embeds: Optional[jax.Array] = None
     mm_mask: Optional[jax.Array] = None
+    # M-RoPE (Qwen2-VL): [T, 3] (temporal, height, width) rotary ids;
+    # None = all three equal `positions` (plain rope — exact for
+    # text-only requests). Reference: the mrope position ids of
+    # model_executor/models/qwen2_vl.py get_rope_index.
+    mrope_positions: Optional[jax.Array] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
     # changing it recompiles, like every other shape bucket.
     max_q: int = 1
@@ -281,6 +286,32 @@ def compute_rope_cos_sin(positions: jax.Array, head_dim: int,
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [T, D]
     return (jnp.cos(emb).astype(dtype) * att,
             jnp.sin(emb).astype(dtype) * att)
+
+
+def compute_mrope_cos_sin(mrope_positions: jax.Array,  # [T, 3]
+                          head_dim: int, rope_theta: float,
+                          sections: tuple,
+                          dtype=jnp.float32) -> tuple[jax.Array,
+                                                      jax.Array]:
+    """Multimodal (3D) rotary tables, Qwen2-VL layout (reference:
+    apply_multimodal_rotary_pos_emb of qwen2_vl.py): frequency index i
+    reads its angle from the (temporal | height | width) position id
+    its ``mrope_section`` assigns it; text-only ids (all three equal)
+    reduce exactly to plain rope."""
+    inv_freq = make_inv_freq(head_dim, rope_theta, None)
+    half = inv_freq.shape[0]
+    assert sum(sections) == half, (sections, half)
+    # [3, T, half] angle per position stream.
+    freqs = (mrope_positions.astype(jnp.float32).T[:, :, None] *
+             inv_freq[None, None, :])
+    parts = []
+    start = 0
+    for k, width in enumerate(sections):
+        parts.append(freqs[k, :, start:start + width])
+        start += width
+    sel = jnp.concatenate(parts, axis=-1)  # [T, half]
+    emb = jnp.concatenate([sel, sel], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
 
 
 def _rotate_half(x: jax.Array) -> jax.Array:

@@ -103,6 +103,10 @@ class LlamaArchConfig:
     # quantization_config.group_size so a GPTQ/AWQ re-quantization
     # reuses the original group lattice (lossless).
     quant_group_size: int = 128
+    # M-RoPE (Qwen2-VL): per-frequency (temporal, height, width) section
+    # widths over the half head dim; None = plain rope (reference:
+    # rope_scaling.mrope_section of qwen2_vl.py).
+    mrope_section: Optional[tuple] = None
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
@@ -1123,6 +1127,13 @@ class LlamaForCausalLM:
         rd = c.rotary_dim or c.head_dim
         if c.pos_embedding != "rope":
             cos = sin = cos_l = sin_l = None
+        elif (c.mrope_section is not None
+              and getattr(batch, "mrope_positions", None) is not None):
+            from vllm_distributed_tpu.models.common import \
+                compute_mrope_cos_sin
+            cos, sin = compute_mrope_cos_sin(
+                batch.mrope_positions, rd, c.rope_theta,
+                tuple(c.mrope_section))
         elif c.rope_interleaved:
             from vllm_distributed_tpu.models.common import \
                 compute_rope_cos_sin_pairwise

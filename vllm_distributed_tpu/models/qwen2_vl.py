@@ -1,0 +1,51 @@
+"""Qwen2-VL: dynamic-resolution vision-language model with M-RoPE.
+
+Reference: vllm/model_executor/models/qwen2_vl.py. The engine serves
+the Qwen2 text decoder with MULTIMODAL rotary embeddings (3D
+temporal/height/width position ids; models/common.py
+compute_mrope_cos_sin) and runs the dynamic-resolution vision tower
+(multimodal/qwen2_vision.py) at admission — images and VIDEOS become
+pre-positioned embedding rows with an (t, h, w) grid that drives both
+the placeholder expansion and the rotary id table
+(multimodal/__init__.py compute_mrope_positions).
+"""
+
+import numpy as np
+
+from vllm_distributed_tpu.models.llama import LlamaForCausalLM
+
+
+class Qwen2VLForConditionalGeneration(LlamaForCausalLM):
+
+    MROPE = True
+    # Vision payload keys accepted by the processor for this family.
+    VISION_STYLE = "qwen2_vl"
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        return hf.text_config
+
+    @classmethod
+    def configure_arch(cls, arch, hf) -> None:
+        tc = hf.text_config
+        super().configure_arch(arch, tc)
+        rs = getattr(tc, "rope_scaling", None) or {}
+        section = rs.get("mrope_section")
+        if section:
+            arch.mrope_section = tuple(int(s) for s in section)
+        # The mrope dict is not a frequency-scaling rule; the plain
+        # inv_freq table applies (reference: qwen2_vl.py uses default
+        # rope frequencies under mrope).
+        arch.rope_scaling = None
+
+    def params_from_hf_state_dict(self, tensors: dict[str, np.ndarray],
+                                  ) -> dict:
+        renamed = {}
+        for name, t in tensors.items():
+            if ".visual." in name or name.startswith("visual."):
+                continue  # the tower runs front-end side
+            name = name.replace("model.language_model.", "model.")
+            name = name.replace("language_model.model.", "model.")
+            name = name.replace("language_model.lm_head.", "lm_head.")
+            renamed[name] = t
+        return super().params_from_hf_state_dict(renamed)

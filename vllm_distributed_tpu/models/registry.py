@@ -53,6 +53,8 @@ from vllm_distributed_tpu.models.bert import (BertEmbeddingModel,
                                               RobertaEmbeddingModel,
                                               RobertaForSequenceClassification)
 from vllm_distributed_tpu.models.llava import LlavaForConditionalGeneration
+from vllm_distributed_tpu.models.qwen2_vl import \
+    Qwen2VLForConditionalGeneration
 from vllm_distributed_tpu.models.bart import BartForConditionalGeneration
 from vllm_distributed_tpu.models.whisper import \
     WhisperForConditionalGeneration
@@ -87,6 +89,9 @@ _REGISTRY: dict[str, type] = {
     "DeepseekV3ForCausalLM": DeepseekV3ForCausalLM,
     # Image+text (pre-computed projector embeddings; models/llava.py).
     "LlavaForConditionalGeneration": LlavaForConditionalGeneration,
+    # Qwen2-VL family: M-RoPE decoder + dynamic-resolution tower with
+    # video inputs (models/qwen2_vl.py).
+    "Qwen2VLForConditionalGeneration": Qwen2VLForConditionalGeneration,
     # Families on the generic block knobs (models/families_ext.py).
     "GraniteForCausalLM": GraniteForCausalLM,
     "GraniteMoeForCausalLM": GraniteMoeForCausalLM,

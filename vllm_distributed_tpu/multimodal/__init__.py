@@ -25,6 +25,10 @@ class MultiModalInput:
     embeds: np.ndarray
     # Index of the first placeholder position in the EXPANDED prompt.
     offset: int
+    # M-RoPE grid (t, h, w) in MERGED token units for Qwen2-VL-style
+    # vision segments (embeds rows raster over it); None for 1-D
+    # placeholder families (llava).
+    grid: "tuple[int, int, int] | None" = None
 
     @property
     def num_tokens(self) -> int:
@@ -33,6 +37,44 @@ class MultiModalInput:
     def content_hash(self) -> bytes:
         return hashlib.sha256(
             np.ascontiguousarray(self.embeds).tobytes()).digest()
+
+
+def compute_mrope_positions(
+        prompt_len: int,
+        mm_inputs: "list[MultiModalInput] | None",
+) -> tuple[np.ndarray, int]:
+    """([prompt_len, 3] (t, h, w) rotary ids, decode delta) for a
+    Qwen2-VL-style prompt (reference: qwen2_vl.py get_rope_index).
+
+    Text tokens advance all three ids together; a vision segment's
+    tokens raster (frame, row, col) starting at the running id, after
+    which the running id jumps past max(t, h, w). ``delta`` is what
+    decode positions add to their sequence index (st_max - prompt_len).
+    """
+    pos = np.zeros((prompt_len, 3), np.int64)
+    st = 0
+    p = 0
+    for inp in sorted(mm_inputs or [], key=lambda i: i.offset):
+        if inp.offset < 0 or inp.grid is None:
+            continue
+        # Text run before this vision segment.
+        span = inp.offset - p
+        pos[p:inp.offset] = (st + np.arange(span))[:, None]
+        st += span
+        t, h, w = inp.grid
+        n = t * h * w
+        tt = np.repeat(np.arange(t), h * w)
+        hh = np.tile(np.repeat(np.arange(h), w), t)
+        ww = np.tile(np.arange(w), t * h)
+        pos[inp.offset:inp.offset + n, 0] = st + tt
+        pos[inp.offset:inp.offset + n, 1] = st + hh
+        pos[inp.offset:inp.offset + n, 2] = st + ww
+        st += max(t, h, w)
+        p = inp.offset + n
+    span = prompt_len - p
+    pos[p:] = (st + np.arange(span))[:, None]
+    st += span
+    return pos, int(st - prompt_len)
 
 
 def expand_image_placeholders(

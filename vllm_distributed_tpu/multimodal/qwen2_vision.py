@@ -1,0 +1,211 @@
+"""Qwen2-VL vision tower: dynamic-resolution ViT with 2D rotary
+embeddings and a 2x2 spatial patch merger.
+
+Reference: vllm/model_executor/models/qwen2_vl.py (Qwen2VisionModel:
+patch embed :303, rotary :345, blocks :405, PatchMerger :270). JAX
+re-design, run at ADMISSION like the CLIP tower (multimodal/vision.py):
+inputs are the HF image processor's flattened patches
+([n_patches, C * temporal_patch * patch^2]) plus per-image/video
+``grid_thw`` (t, h, w in PATCH units); output is
+[n_patches / merge^2, text_hidden] embedding rows.
+
+Semantics matched to HF Qwen2VLForConditionalGeneration.model.visual:
+
+* The patch stream arrives in MERGE-GROUP order (the processor emits
+  each 2x2 spatial group contiguously); the rotary (h, w) ids are
+  built with the same grouped permutation, and the merger simply
+  reshapes consecutive merge^2 rows together.
+* Attention is full (bidirectional) but BLOCK-DIAGONAL per image/video
+  (cu_seqlens): patches never attend across inputs in one batch.
+* 2D rotary: half the rotary dims rotate by the h id, half by the w id
+  (head_dim/4 frequencies each), applied rotate-half style to q and k.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def _ln(x, w, b, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+class Qwen2VisionEncoder:
+    """Tower + merger from a Qwen2-VL checkpoint's ``visual.*``."""
+
+    PREFIXES = ("model.visual.", "visual.")
+
+    def __init__(self, tensors: dict, hf_config) -> None:
+        vc = hf_config.vision_config
+        self.depth = vc.depth
+        self.embed_dim = int(getattr(vc, "embed_dim", None)
+                             or vc.hidden_size)
+        self.heads = vc.num_heads
+        self.head_dim = self.embed_dim // self.heads
+        self.merge = int(getattr(vc, "spatial_merge_size", 2))
+        self.patch = vc.patch_size
+        self.temporal_patch = int(getattr(vc, "temporal_patch_size", 2))
+        self.params = self._load(tensors)
+        self._fn = jax.jit(self._forward,
+                           static_argnames=("grid_thw", ))
+
+    # ------------------------------------------------------------------
+    def _load(self, tensors: dict) -> dict:
+        def t(name):
+            for p in self.PREFIXES:
+                if p + name in tensors:
+                    return np.asarray(tensors[p + name], np.float32)
+            raise KeyError(f"visual tensor {name!r} missing")
+
+        p = {
+            "patch": t("patch_embed.proj.weight").reshape(
+                self.embed_dim, -1).T,  # [C*tp*ps*ps, E]
+            "ln_q": t("merger.ln_q.weight"),
+            "ln_q_b": t("merger.ln_q.bias"),
+            "m0": t("merger.mlp.0.weight").T,
+            "m0_b": t("merger.mlp.0.bias"),
+            "m2": t("merger.mlp.2.weight").T,
+            "m2_b": t("merger.mlp.2.bias"),
+            "layers": [],
+        }
+        for i in range(self.depth):
+            b = f"blocks.{i}."
+            p["layers"].append({
+                "n1": t(b + "norm1.weight"), "n1_b": t(b + "norm1.bias"),
+                "n2": t(b + "norm2.weight"), "n2_b": t(b + "norm2.bias"),
+                "qkv": t(b + "attn.qkv.weight").T,
+                "qkv_b": t(b + "attn.qkv.bias"),
+                "proj": t(b + "attn.proj.weight").T,
+                "proj_b": t(b + "attn.proj.bias"),
+                "fc1": t(b + "mlp.fc1.weight").T,
+                "fc1_b": t(b + "mlp.fc1.bias"),
+                "fc2": t(b + "mlp.fc2.weight").T,
+                "fc2_b": t(b + "mlp.fc2.bias"),
+            })
+        p["layers"] = jax.tree.map(
+            lambda *xs: np.stack(xs), *p["layers"])
+        return jax.tree.map(jnp.asarray, p)
+
+    # ------------------------------------------------------------------
+    def _rot_ids(self, grid_thw) -> np.ndarray:
+        """[n_patches, 2] (h, w) rotary ids in merge-group order —
+        matches HF rot_pos_emb (qwen2_vl.py:345)."""
+        out = []
+        m = self.merge
+        for t, h, w in grid_thw:
+            hp = (np.repeat(np.arange(h), w).reshape(h, w)
+                  .reshape(h // m, m, w // m, m)
+                  .transpose(0, 2, 1, 3).reshape(-1))
+            wp = (np.tile(np.arange(w), h).reshape(h, w)
+                  .reshape(h // m, m, w // m, m)
+                  .transpose(0, 2, 1, 3).reshape(-1))
+            ids = np.stack([hp, wp], axis=-1)
+            out.append(np.tile(ids, (t, 1)))
+        return np.concatenate(out, axis=0)
+
+    def _forward(self, params, x, rot_ids, seg_ids, *, grid_thw):
+        E, Hh, D = self.embed_dim, self.heads, self.head_dim
+        n = x.shape[0]
+        h = (x @ params["patch"]).astype(jnp.float32)  # [n, E]
+
+        # 2D rotary tables: head_dim/4 freqs each for h and w ids.
+        quarter = D // 4
+        inv = 1.0 / (10000.0 ** (np.arange(0, quarter * 2, 2) / (
+            quarter * 2)))
+        inv = jnp.asarray(inv, jnp.float32)  # [quarter]
+        fh = rot_ids[:, 0:1].astype(jnp.float32) * inv[None]
+        fw = rot_ids[:, 1:2].astype(jnp.float32) * inv[None]
+        emb = jnp.concatenate([fh, fw], axis=-1)  # [n, D/2]
+        emb = jnp.concatenate([emb, emb], axis=-1)  # [n, D]
+        cos, sin = jnp.cos(emb)[:, None, :], jnp.sin(emb)[:, None, :]
+
+        # Block-diagonal mask per image/video segment.
+        mask = seg_ids[:, None] == seg_ids[None, :]  # [n, n]
+        bias = jnp.where(mask, 0.0, -1e9)
+
+        def layer(h, lp):
+            x1 = _ln(h, lp["n1"], lp["n1_b"])
+            qkv = (x1 @ lp["qkv"] + lp["qkv_b"]).reshape(n, 3, Hh, D)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            q = q * cos + _rotate_half(q) * sin
+            k = k * cos + _rotate_half(k) * sin
+            s = jnp.einsum("inh,jnh->nij", q, k) / np.sqrt(D)
+            probs = jax.nn.softmax(s + bias[None], axis=-1)
+            ctx = jnp.einsum("nij,jnh->inh", probs, v).reshape(n, E)
+            h = h + ctx @ lp["proj"] + lp["proj_b"]
+            x2 = _ln(h, lp["n2"], lp["n2_b"])
+            m = jax.nn.gelu(x2 @ lp["fc1"] + lp["fc1_b"],
+                            approximate=False)
+            return h + m @ lp["fc2"] + lp["fc2_b"], None
+
+        h, _ = jax.lax.scan(layer, h, params["layers"])
+
+        # Patch merger: merge^2 consecutive rows -> one text token.
+        g = self.merge ** 2
+        hq = _ln(h, params["ln_q"], params["ln_q_b"]).reshape(
+            n // g, g * E)
+        out = jax.nn.gelu(hq @ params["m0"] + params["m0_b"],
+                          approximate=False)
+        return out @ params["m2"] + params["m2_b"]
+
+    # ------------------------------------------------------------------
+    def encode(self, pixel_values: np.ndarray,
+               grid_thw) -> list[np.ndarray]:
+        """Flattened patches + per-input grids -> one [n_merged, H]
+        embedding array per image/video."""
+        grids = [tuple(int(v) for v in g) for g in grid_thw]
+        counts = [t * h * w for t, h, w in grids]
+        if sum(counts) != int(pixel_values.shape[0]):
+            raise ValueError(
+                f"pixel_values rows ({pixel_values.shape[0]}) do not "
+                f"match grid_thw patch count ({sum(counts)})")
+        rot = self._rot_ids(grids)
+        # Attention is per FRAME: HF's cu_seqlens repeat h*w per
+        # temporal patch (qwen2_vl.py rot_pos_emb/cu_seqlens), so a
+        # video's frames do not attend each other either.
+        seg_parts = []
+        sid = 0
+        for t, h, w in grids:
+            seg_parts.append(np.repeat(np.arange(sid, sid + t), h * w))
+            sid += t
+        seg = np.concatenate(seg_parts)
+        out = np.asarray(self._fn(
+            self.params, jnp.asarray(pixel_values, jnp.float32),
+            jnp.asarray(rot), jnp.asarray(seg),
+            grid_thw=tuple(grids)))
+        m2 = self.merge ** 2
+        splits = np.cumsum([c // m2 for c in counts])[:-1]
+        return [np.ascontiguousarray(a)
+                for a in np.split(out, splits)]
+
+
+def build_qwen2_vision_encoder(model_path: str,
+                               hf_config) -> Optional[Qwen2VisionEncoder]:
+    import os
+    if not os.path.isdir(model_path):
+        return None
+    from vllm_distributed_tpu.models.loader import load_hf_state_dict
+    try:
+        tensors = load_hf_state_dict(model_path,
+                                     prefixes=("model.visual.",
+                                               "visual."))
+        if not tensors:
+            return None
+        return Qwen2VisionEncoder(tensors, hf_config)
+    except (FileNotFoundError, KeyError) as e:
+        logger.warning("qwen2 vision tower unavailable: %s", e)
+        return None

@@ -156,6 +156,10 @@ class TPUModelRunner:
 
         self._forward_fn = None
         self._sample_fn = None
+        # M-RoPE (Qwen2-VL): per-row ([prompt_len, 3] id table, decode
+        # delta); active when the model declares mrope_section.
+        self._mrope: dict[int, tuple] = {}
+        self._mrope_on = False
         self._rng = np.random.default_rng(config.model_config.seed)
         # Spec-decode acceptance counters (reference:
         # v1/metrics SpecDecodingStats).
@@ -178,6 +182,8 @@ class TPUModelRunner:
         """Build the model and load weights per LoadConfig."""
         from vllm_distributed_tpu.models.loader import get_model
         self.model, self.params = get_model(self.config, self.mesh)
+        self._mrope_on = bool(
+            getattr(self.model.cfg, "mrope_section", None))
         if getattr(self.model, "CROSS_ATTENTION", False):
             # install_cross_states projects through the loaded cross
             # weights at admission time.
@@ -438,9 +444,11 @@ class TPUModelRunner:
 
         model = self.model
         page_size = self.page_size
+        mrope_on = self._mrope_on
 
         def multi_step(params, kv_caches, tok0, pos0, block_tables,
-                       sampling_md: SamplingMetadata, seeds, num_active):
+                       sampling_md: SamplingMetadata, seeds, num_active,
+                       mrope_deltas):
             R = tok0.shape[0]
             rows = jnp.arange(R, dtype=jnp.int32)
             ones = jnp.ones((R, ), jnp.int32)
@@ -456,11 +464,18 @@ class TPUModelRunner:
                 kv_runs = jnp.stack(
                     [page, off, rows - off + page_size,
                      jnp.where(active, 1, 0)], axis=1)
+                mrope = None
+                if mrope_on:
+                    # Decode ids continue at position + delta on all
+                    # three rotary dims (qwen2_vl get_rope_index).
+                    mrope = jnp.broadcast_to(
+                        (pos + mrope_deltas)[:, None], (R, 3))
                 batch = AttentionBatch(
                     req_idx=rows, positions=pos, slot_mapping=slot,
                     block_tables=block_tables, seq_lens=pos + 1,
                     seq_info=seq_info, num_seqs=num_active,
-                    kv_runs=kv_runs, num_kv_runs=num_active, max_q=1)
+                    kv_runs=kv_runs, num_kv_runs=num_active,
+                    mrope_positions=mrope, max_q=1)
                 hidden, kv = model.forward(params, kv, tok, batch)
                 logits = model.compute_logits(params, hidden)
                 md_t = dataclasses.replace(sampling_md, seeds=seeds_t)
@@ -485,6 +500,15 @@ class TPUModelRunner:
             self.input_batch.remove_request(req_id)
         for new_req in scheduler_output.scheduled_new_reqs:
             row = self.input_batch.add_request(new_req)
+            if self._mrope_on:
+                from vllm_distributed_tpu.multimodal import \
+                    compute_mrope_positions
+                if new_req.mm_inputs:
+                    self._mrope[row] = compute_mrope_positions(
+                        len(new_req.prompt_token_ids),
+                        new_req.mm_inputs)
+                else:
+                    self._mrope[row] = (None, 0)
             if getattr(self.model, "CROSS_ATTENTION", False):
                 # Encoder-decoder (whisper): project the audio
                 # encoder's hidden states into this request's
@@ -544,6 +568,8 @@ class TPUModelRunner:
         positions = np.zeros((T, ), np.int32)
         req_idx = np.zeros((T, ), np.int32)
         slot_mapping = np.full((T, ), -1, np.int32)
+        mrope_np = (np.zeros((T, 3), np.int32) if self._mrope_on
+                    else None)
         seq_info = np.zeros((self.max_num_reqs, 4), np.int32)
         kv_runs: list[tuple[int, int, int, int]] = []
         ps = self.page_size
@@ -590,6 +616,17 @@ class TPUModelRunner:
             token_ids[t:t + n] = ib.token_ids[row, start:end]
             positions[t:t + n] = np.arange(start, end, dtype=np.int32)
             req_idx[t:t + n] = row
+            if mrope_np is not None:
+                # Prompt positions read the request's 3D id table;
+                # generated positions continue at position + delta on
+                # all three dims (reference: qwen2_vl get_rope_index).
+                table, delta = self._mrope.get(row, (None, 0))
+                seg = np.arange(start, end)
+                vals = np.repeat((seg + delta)[:, None], 3, axis=1)
+                if table is not None:
+                    in_prompt = seg < table.shape[0]
+                    vals[in_prompt] = table[seg[in_prompt]]
+                mrope_np[t:t + n] = vals
             pos = np.arange(start, end)
             slot_mapping[t:t + n] = (
                 ib.block_table[row, pos // ps] * ps + pos % ps)
@@ -831,6 +868,8 @@ class TPUModelRunner:
             cascade_shared_ids=cascade_ids,
             mm_embeds=mm_embeds,
             mm_mask=mm_mask,
+            mrope_positions=(jnp.asarray(mrope_np)
+                             if mrope_np is not None else None),
             max_q=max_q,
         )
         plp = None
@@ -1464,13 +1503,18 @@ class TPUModelRunner:
             seeds=jnp.asarray(seeds[0]),
         )
 
+        deltas = np.zeros((R, ), np.int32)
+        if self._mrope_on:
+            for i, r in enumerate(rows):
+                deltas[i] = self._mrope.get(int(r), (None, 0))[1]
         with self.mesh:
             with self._compile_watch(("multi", n_steps, R)):
                 self.kv_caches, toks, lps = self._multi_step_fn(
                     self.params, self.kv_caches, jnp.asarray(tok0),
                     jnp.asarray(pos0), jnp.asarray(block_tables),
                     sampling_md, jnp.asarray(seeds),
-                    jnp.asarray([num_active], np.int32))
+                    jnp.asarray([num_active], np.int32),
+                    jnp.asarray(deltas))
 
         toks_np = np.asarray(jax.device_get(toks))  # [n_steps, R]
         lps_np = np.asarray(jax.device_get(lps))
@@ -1557,6 +1601,8 @@ class TPUModelRunner:
             num_kv_runs=jnp.zeros((1, ), jnp.int32),
             tknp=tknp,
             lora=self._dummy_lora_batch(T),
+            mrope_positions=(jnp.zeros((T, 3), jnp.int32)
+                             if self._mrope_on else None),
             max_q=max_q,
         )
         return jnp.zeros((T, ), jnp.int32), batch
@@ -1727,7 +1773,8 @@ class TPUModelRunner:
                 jnp.zeros((R, ), jnp.int32),
                 jnp.zeros((R, self.max_pages_per_req), jnp.int32), md,
                 jnp.zeros((n_steps, R), jnp.int64),
-                jnp.zeros((1, ), jnp.int32))
+                jnp.zeros((1, ), jnp.int32),
+                jnp.zeros((R, ), jnp.int32))
         jax.block_until_ready(toks)
 
     def get_stats(self) -> dict[str, float]:
