@@ -379,3 +379,49 @@ def test_olmo3_windows_match_hf(tmp_path_factory):
     got = run_engine(path, PROMPTS, max_tokens=8)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 8), f"prompt {p}"
+
+
+@pytest.mark.parametrize("family", ["ministral", "vaultgemma",
+                                    "smollm3", "cohere2", "exaone4"])
+def test_round5_families_match_hf(family, tmp_path_factory):
+    """Round-5 additions: uniform-sliding Ministral, Gemma2-knob
+    VaultGemma, and the NoPE layouts (SmolLM3 every-4th-layer NoPE;
+    Cohere2/EXAONE-4 hybrids whose full-attention layers skip
+    rotary)."""
+    from transformers import (Cohere2Config, Cohere2ForCausalLM,
+                              Exaone4Config, Exaone4ForCausalLM,
+                              MinistralConfig, MinistralForCausalLM,
+                              SmolLM3Config, SmolLM3ForCausalLM,
+                              VaultGemmaConfig, VaultGemmaForCausalLM)
+    cases = {
+        "ministral": (MinistralForCausalLM, MinistralConfig(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, sliding_window=8,
+            layer_types=["sliding_attention"] * 2)),
+        "vaultgemma": (VaultGemmaForCausalLM, VaultGemmaConfig(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, sliding_window=8, query_pre_attn_scalar=16,
+            final_logit_softcapping=30.0,
+            layer_types=["sliding_attention", "full_attention"])),
+        "smollm3": (SmolLM3ForCausalLM, SmolLM3Config(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, pad_token_id=0, no_rope_layers=[1, 0],
+            no_rope_layer_interval=2)),
+        "cohere2": (Cohere2ForCausalLM, Cohere2Config(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            logit_scale=0.25, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention"],
+            sliding_window_pattern=2)),
+        "exaone4": (Exaone4ForCausalLM, Exaone4Config(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention"])),
+    }
+    hf_cls, cfg = cases[family]
+    torch.manual_seed(0)
+    hf = hf_cls(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_{family}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, family)
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want, family

@@ -819,3 +819,79 @@ class PersimmonForCausalLM(LlamaForCausalLM):
             out[A + "k_proj.bias"] = kb.reshape(-1)
             out[A + "v_proj.bias"] = vb.reshape(-1)
         return super().params_from_hf_state_dict(out)
+
+
+class Cohere2ForCausalLM(CohereForCausalLM):
+    """Cohere2 / Command-R7B (reference: models/commandr.py Cohere2
+    variant): the Cohere parallel block + 3:1 sliding/full interleave
+    where the FULL-attention layers are NoPE — rotary applies only
+    under the sliding window (modeling_cohere2.Cohere2Attention gates
+    apply_rotary_pos_emb on sliding_window)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        super().configure_arch(arch, hf)
+        if arch.window_pattern is not None:
+            arch.nope_layers = tuple(
+                w == 0 for w in arch.window_pattern)
+
+
+class SmolLM3ForCausalLM(LlamaForCausalLM):
+    """SmolLM3 (reference: models/smollm3.py): llama block with every
+    fourth layer NoPE (config.no_rope_layers, 0 = skip rotary)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        nrl = getattr(hf, "no_rope_layers", None)
+        if nrl:
+            arch.nope_layers = tuple(not bool(v) for v in nrl)
+
+
+class Exaone4ForCausalLM(LlamaForCausalLM):
+    """EXAONE-4 (reference: models/exaone4.py): POST-norm block (the
+    sublayer output is normed before the residual add — the Olmo2
+    layout), per-head q/k RMSNorm ahead of rope, and a 3:1
+    sliding/full hybrid whose full-attention layers are NoPE
+    ("global NoPE", modeling_exaone4.Exaone4Attention)."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.pre_norm = False
+        arch.extra_layer_norms = True
+        arch.qk_norm = True
+        if arch.window_pattern is not None:
+            arch.nope_layers = tuple(
+                w == 0 for w in arch.window_pattern)
+
+
+class VaultGemmaForCausalLM(LlamaForCausalLM):
+    """VaultGemma (reference: models/vaultgemma.py): the Gemma block
+    (scaled embeddings, gelu-tanh, +1-offset RMSNorm weights,
+    query_pre_attn_scalar, attention + final logit soft-capping,
+    alternating windows) but WITHOUT Gemma2's sandwich norms — the MLP
+    pre-norm ships as ``pre_feedforward_layernorm``."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        import math
+        arch.embed_scale = math.sqrt(arch.hidden_size)
+        arch.hidden_act = "gelu_tanh"
+        arch.tie_word_embeddings = True
+        arch.attn_logit_softcap = float(
+            getattr(hf, "attn_logit_softcapping", None) or 0.0)
+        arch.final_logit_softcap = float(
+            getattr(hf, "final_logit_softcapping", None) or 0.0)
+        qpas = getattr(hf, "query_pre_attn_scalar", None)
+        arch.query_pre_attn_scalar = float(qpas) if qpas else None
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = {}
+        for name, t in tensors.items():
+            renamed[name.replace("pre_feedforward_layernorm",
+                                 "post_attention_layernorm")] = t
+        params = super().params_from_hf_state_dict(renamed)
+        layers = params["layers"]
+        for key in ("input_ln", "post_ln"):
+            layers[key] = layers[key] + 1.0
+        params["final_ln"] = params["final_ln"] + 1.0
+        return params

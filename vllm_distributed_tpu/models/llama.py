@@ -107,6 +107,11 @@ class LlamaArchConfig:
     # widths over the half head dim; None = plain rope (reference:
     # rope_scaling.mrope_section of qwen2_vl.py).
     mrope_section: Optional[tuple] = None
+    # Per-layer NoPE mask (True = this layer skips rotary): SmolLM3's
+    # no_rope_layers, and the hybrid families whose FULL-attention
+    # layers are position-free while sliding layers rope (Cohere2,
+    # EXAONE-4). None = rotary everywhere.
+    nope_layers: Optional[tuple] = None
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
@@ -1216,7 +1221,8 @@ class LlamaForCausalLM:
 
         rm = c.residual_multiplier
 
-        def layer_body(h, k_all, v_all, lp, layer_idx, window):
+        def layer_body(h, k_all, v_all, lp, layer_idx, window,
+                       nope=False):
             if c.pre_norm:
                 x = self._norm(h, lp["input_ln"], lp.get("input_ln_b"))
             else:
@@ -1249,8 +1255,9 @@ class LlamaForCausalLM:
                 k = self._norm(k, lp["k_norm"], lp.get("k_norm_b"))
             v = v.reshape(T, c.total_kv_heads, c.head_dim)
             local_rope = bool(window) and c.rope_theta_local is not None
-            q = apply_rotary(q, local=local_rope)
-            k = apply_rotary(k, local=local_rope)
+            if not nope:
+                q = apply_rotary(q, local=local_rope)
+                k = apply_rotary(k, local=local_rope)
             k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
                                           layer_idx)
             attn = paged_attention(q, k_all, v_all, batch,
@@ -1289,7 +1296,17 @@ class LlamaForCausalLM:
             return h, k_all, v_all
 
         windows = self._layer_windows(first_layer, num_layers)
-        segments = self._plan_window_segments(windows)
+        # Per-layer static attributes segment TOGETHER: the scan
+        # pattern keys on (window, nope) pairs so a NoPE/rope layout
+        # (SmolLM3, Cohere2, EXAONE-4 hybrids) plans like a window
+        # layout.
+        if c.nope_layers is not None:
+            nope = tuple(bool(c.nope_layers[first_layer + i])
+                         for i in range(num_layers))
+        else:
+            nope = (False, ) * num_layers
+        layer_keys = tuple(zip(windows, nope))
+        segments = self._plan_window_segments(layer_keys)
         layer_ids = (jnp.arange(num_layers, dtype=jnp.int32)[:, None]
                      + cache_layer_offset)
         carry = (sp(hidden), kv_caches["k"], kv_caches["v"])
@@ -1309,10 +1326,11 @@ class LlamaForCausalLM:
             def scan_fn(car, xs, pattern=pattern):
                 h, k_all, v_all = car
                 lp_grp, id_grp = xs
-                for j, w in enumerate(pattern):
+                for j, (w, np_) in enumerate(pattern):
                     lp_j = jax.tree.map(lambda a: a[j], lp_grp)
                     h, k_all, v_all = layer_body(h, k_all, v_all, lp_j,
-                                                 id_grp[j], w)
+                                                 id_grp[j], w,
+                                                 nope=np_)
                 return (h, k_all, v_all), None
 
             carry, _ = jax.lax.scan(scan_fn, carry, (lp_seg, ids_seg))

@@ -17,8 +17,12 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
                                                   DeepseekV3ForCausalLM)
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
-from vllm_distributed_tpu.models.families_ext import (CohereForCausalLM,
+from vllm_distributed_tpu.models.families_ext import (Cohere2ForCausalLM,
+                                                      VaultGemmaForCausalLM,
+                                                      CohereForCausalLM,
                                                       DbrxForCausalLM,
+                                                      Exaone4ForCausalLM,
+                                                      SmolLM3ForCausalLM,
                                                       FalconForCausalLM,
                                                       Glm4ForCausalLM,
                                                       GlmForCausalLM,
@@ -69,6 +73,9 @@ from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
 _REGISTRY: dict[str, type] = {
     "LlamaForCausalLM": LlamaForCausalLM,
     "MistralForCausalLM": LlamaForCausalLM,
+    # Ministral: llama block + uniform sliding window via layer_types
+    # (the generic window resolver covers it).
+    "MinistralForCausalLM": LlamaForCausalLM,
     "Qwen2ForCausalLM": LlamaForCausalLM,
     # Llama-weight-compatible forks (identical tensor naming + math).
     "AquilaForCausalLM": LlamaForCausalLM,
@@ -106,6 +113,15 @@ _REGISTRY: dict[str, type] = {
     "GPTNeoXForCausalLM": GPTNeoXForCausalLM,
     "PhiForCausalLM": PhiForCausalLM,
     "CohereForCausalLM": CohereForCausalLM,
+    # Cohere2 / Command-R7B: sliding/full interleave, full layers NoPE.
+    "Cohere2ForCausalLM": Cohere2ForCausalLM,
+    # SmolLM3: llama block, every fourth layer NoPE.
+    "SmolLM3ForCausalLM": SmolLM3ForCausalLM,
+    # EXAONE-4: post-norm + per-head qk norm + hybrid global-NoPE.
+    "Exaone4ForCausalLM": Exaone4ForCausalLM,
+    # VaultGemma: Gemma block + softcaps/query scaling, no sandwich
+    # norms (families_ext.py).
+    "VaultGemmaForCausalLM": VaultGemmaForCausalLM,
     "Olmo2ForCausalLM": Olmo2ForCausalLM,
     "NemotronForCausalLM": NemotronForCausalLM,
     "OlmoForCausalLM": OlmoForCausalLM,
