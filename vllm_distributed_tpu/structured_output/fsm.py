@@ -511,7 +511,9 @@ class TokenMaskTable:
                 mat[i, :len(bs)] = np.frombuffer(bs, np.uint8)
         self._tok_mat = mat
 
-    def _compute(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+    def _compute_raw(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        """Byte-level mask: token t allowed iff its bytes land in a
+        byte-LIVE DFA state."""
         V, L = self._tok_mat.shape
         cur = np.full(V, state, np.int32)
         for j in range(L):
@@ -525,6 +527,65 @@ class TokenMaskTable:
         # so generation always makes progress.
         empty = self._tok_mat[:, 0] < 0
         allow = allow & ~empty
+        return allow, cur
+
+    _raw_cache: dict = field(default_factory=dict)
+    _live_cache: dict = field(default_factory=dict)
+    # Token-closure exploration bound: past this many states the
+    # refinement assumes live (= the byte-level answer), never the
+    # other way — masks only ever get STRICTER than byte liveness.
+    _LIVE_CAP = 4096
+
+    def _raw(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        if state not in self._raw_cache:
+            self._raw_cache[state] = self._compute_raw(state)
+        return self._raw_cache[state]
+
+    def _token_live(self, state: int) -> bool:
+        """Can an ACCEPTING state be reached via whole-token emissions?
+        Byte liveness is not enough when the vocabulary lacks the
+        bridging bytes (a token may be a valid PREFIX whose required
+        continuation byte exists in no token — emitting it would strand
+        the generation). BFS over the token closure, memoized."""
+        cached = self._live_cache.get(state)
+        if cached is not None:
+            return cached
+        seen = {state}
+        frontier = [state]
+        live = False
+        while frontier:
+            s = frontier.pop()
+            if self.dfa.accept[s]:
+                live = True
+                break
+            if len(seen) > self._LIVE_CAP:
+                live = True  # give up safely: byte-level answer
+                break
+            allow, cur = self._raw(s)
+            for s2 in np.unique(cur[allow]):
+                s2 = int(s2)
+                if self._live_cache.get(s2):
+                    live = True
+                    frontier = []
+                    break
+                if s2 not in seen:
+                    seen.add(s2)
+                    frontier.append(s2)
+        if not live and len(seen) <= self._LIVE_CAP:
+            # Everything reachable from a dead state is dead too.
+            for s in seen:
+                self._live_cache[s] = False
+        self._live_cache[state] = live
+        return live
+
+    def _compute(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        allow, cur = self._raw(state)
+        # Token-level refinement: drop tokens stranding the generation
+        # in a byte-live but token-dead state (reference behavior: the
+        # grammar engine guarantees every emission can still complete).
+        for s2 in np.unique(cur[allow]):
+            if not self._token_live(int(s2)):
+                allow = allow & (cur != s2)
         return allow, cur
 
     def allow(self, state: int) -> np.ndarray:
