@@ -1,0 +1,177 @@
+"""Qwen2-VL over the chat API: image_url and video_url (frame-list)
+content parts through the dynamic-resolution tower + M-RoPE decoder
+(reference: chat_utils media parts + multimodal/video.py)."""
+
+import asyncio
+import base64
+import io
+import json
+import threading
+
+import httpx
+import numpy as np
+import pytest
+import torch
+from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+from vllm_distributed_tpu.utils import get_open_port
+
+VOCAB = 160
+IMG_TOK, VID_TOK = 151, 152
+
+
+def _save_ckpt(path):
+    torch.manual_seed(0)
+    cfg = Qwen2VLConfig(
+        text_config=dict(
+            vocab_size=VOCAB, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            rope_theta=10000.0, eos_token_id=1),
+        vision_config=dict(depth=2, embed_dim=32, hidden_size=64,
+                           num_heads=2, in_channels=3, patch_size=4,
+                           spatial_merge_size=2, temporal_patch_size=2),
+        image_token_id=IMG_TOK, video_token_id=VID_TOK,
+        vision_start_token_id=153, vision_end_token_id=154)
+    hf = Qwen2VLForConditionalGeneration(cfg).eval()
+    hf.save_pretrained(path, safe_serialization=True)
+
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(140)}
+    vocab.update({"<|image_pad|>": IMG_TOK, "<|video_pad|>": VID_TOK,
+                  "<|vision_start|>": 153, "<|vision_end|>": 154,
+                  "<unk>": 158, "</s>": 1})
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token="<unk>", eos_token="</s>")
+    fast.save_pretrained(path)
+    return hf
+
+
+def _data_url(rng, w=8, h=8):
+    from PIL import Image
+    arr = rng.integers(0, 255, size=(h, w, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return ("data:image/png;base64," +
+            base64.b64encode(buf.getvalue()).decode())
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tiny_qwen2vl_served"))
+    _save_ckpt(path)
+    engine_args = EngineArgs(model=path, dtype="float32", block_size=4,
+                             num_gpu_blocks_override=128,
+                             max_model_len=128,
+                             max_num_batched_tokens=128, max_num_seqs=8)
+    engine = AsyncLLM(engine_args.create_engine_config())
+    port = get_open_port()
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import \
+            serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["stop"], holder["loop"] = stop, loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready,
+                                      stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=180), "server did not start"
+    yield f"http://127.0.0.1:{port}"
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    t.join(timeout=30)
+
+
+def _chat(base, content, max_tokens=5):
+    r = httpx.post(f"{base}/v1/chat/completions", timeout=300, json={
+        "model": "m",
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+        "temperature": 0,
+    })
+    return r
+
+
+def test_chat_image_url(server):
+    rng = np.random.default_rng(0)
+    content = [
+        {"type": "text", "text": "w5 w6 "},
+        {"type": "image_url", "image_url": {"url": _data_url(rng)}},
+        {"type": "text", "text": " w7"},
+    ]
+    r1 = _chat(server, content)
+    assert r1.status_code == 200, r1.text
+    msg = r1.json()["choices"][0]["message"]["content"]
+    assert msg
+    # Deterministic: the same request reproduces (the tower ran, the
+    # placeholder expanded, M-RoPE ids applied — same everything).
+    r2 = _chat(server, content)
+    assert r2.json()["choices"][0]["message"]["content"] == msg
+
+
+def test_chat_video_frames(server):
+    rng = np.random.default_rng(1)
+    frames = [_data_url(rng) for _ in range(2)]
+    content = [
+        {"type": "text", "text": "w9 "},
+        {"type": "video_url", "video_url": {"url": frames}},
+        {"type": "text", "text": " w11"},
+    ]
+    r = _chat(server, content)
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["choices"][0]["message"]["content"]
+    # Video and image requests see different media -> generally
+    # different continuations; at minimum the server accepted and
+    # generated under the video placeholder.
+    assert body["usage"]["completion_tokens"] > 0
+
+
+def test_video_rejected_on_non_vl_model(tmp_path_factory):
+    """A llama-served chat must 400 on video parts, not crash."""
+    from transformers import LlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    path = str(tmp_path_factory.mktemp("tiny_novideo"))
+    torch.manual_seed(0)
+    HFLlama(LlamaConfig(vocab_size=VOCAB, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=1,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=64,
+                        eos_token_id=1)).save_pretrained(
+        path, safe_serialization=True)
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(VOCAB - 2)}
+    vocab["<unk>"] = VOCAB - 2
+    vocab["</s>"] = 1
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(path)
+
+    from vllm_distributed_tpu.entrypoints.openai.api_server import (
+        RequestError, _chat_prompt)
+    engine_args = EngineArgs(model=path, dtype="float32", block_size=4,
+                             num_gpu_blocks_override=64,
+                             max_model_len=64,
+                             max_num_batched_tokens=64, max_num_seqs=4)
+    engine = AsyncLLM(engine_args.create_engine_config())
+    with pytest.raises(RequestError, match="video"):
+        _chat_prompt(engine, [{
+            "role": "user",
+            "content": [{"type": "video_url",
+                         "video_url": {"url": ["data:,x"]}}],
+        }])

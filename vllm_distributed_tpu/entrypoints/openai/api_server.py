@@ -740,15 +740,28 @@ def _chat_prompt(engine: AsyncLLM, messages: list):
     tokenizer = engine.tokenizer
     if tokenizer is None:
         raise RequestError("chat requires a tokenizer for this model")
+    hf = engine.config.model_config.maybe_load_hf_config()
+    try:
+        from vllm_distributed_tpu.models.registry import \
+            resolve_architecture
+        qwen_vl = getattr(resolve_architecture(hf), "VISION_STYLE",
+                          None) == "qwen2_vl"
+    except Exception:  # noqa: BLE001 - toy configs
+        qwen_vl = False
     image_urls: list[str] = []
+    video_frames: list[list[str]] = []
     flat: list[dict] = []
     for m in messages:
         content = m.get("content")
         if isinstance(content, list):
             from vllm_distributed_tpu.multimodal.image_processing import \
                 image_token_string
-            hf = engine.config.model_config.maybe_load_hf_config()
             tok = image_token_string(tokenizer, hf)
+            vtok = None
+            if qwen_vl:
+                from vllm_distributed_tpu.multimodal.qwen2_vl_processing \
+                    import media_token_strings
+                tok, vtok = media_token_strings(tokenizer, hf)
             parts: list[str] = []
             for part in content:
                 ptype = part.get("type")
@@ -761,6 +774,17 @@ def _chat_prompt(engine: AsyncLLM, messages: list):
                     image_urls.append(
                         (part.get("image_url") or {}).get("url", ""))
                     parts.append(tok)
+                elif ptype == "video_url":
+                    # Videos arrive as FRAME LISTS of data-URL images
+                    # (what the reference's video loader produces after
+                    # container decode; multimodal/video.py).
+                    if vtok is None:
+                        raise RequestError(
+                            "this model does not accept video inputs")
+                    url = (part.get("video_url") or {}).get("url")
+                    frames = url if isinstance(url, list) else [url]
+                    video_frames.append([f or "" for f in frames])
+                    parts.append(vtok)
                 else:
                     raise RequestError(
                         f"unsupported content part type {ptype!r}")
@@ -768,7 +792,16 @@ def _chat_prompt(engine: AsyncLLM, messages: list):
         else:
             flat.append(m)
     mm = None
-    if image_urls:
+    if qwen_vl and (image_urls or video_frames):
+        from vllm_distributed_tpu.multimodal.qwen2_vl_processing import \
+            preprocess_chat_media
+        try:
+            mm = preprocess_chat_media(image_urls, video_frames, hf)
+        except ValueError as e:
+            raise RequestError(str(e)) from e
+    elif video_frames:
+        raise RequestError("this model does not accept video inputs")
+    elif image_urls:
         from vllm_distributed_tpu.multimodal.image_processing import \
             preprocess_data_urls
         try:
@@ -802,9 +835,11 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             raise RequestError("`messages` must be a non-empty list")
         prompt, mm = _chat_prompt(engine, messages)
         n = int(body.get("n", 1) or 1)
-        if mm is not None:
-            # Encode pixels ONCE; the n samples (and the scheduler)
-            # reuse the embeddings instead of n vision-tower passes.
+        if mm is not None and "image_grid_thw" not in mm \
+                and "video_grid_thw" not in mm:
+            # llava path: encode pixels ONCE; the n samples (and the
+            # scheduler) reuse the embeddings instead of n vision-tower
+            # passes. (Qwen2-VL grid payloads encode at admission.)
             mm = {"image_embeds": engine.processor._encode_pixels(
                 mm["pixel_values"])}
         max_len = engine.config.scheduler_config.max_model_len

@@ -1,0 +1,91 @@
+"""Chat-API media preprocessing for Qwen2-VL-family models.
+
+Reference: the image/video input pipeline of vllm's chat_utils +
+multimodal/video.py — image_url parts (and video frames) turn into the
+HF Qwen2VLImageProcessor's flattened-patch layout, which the engine's
+admission path (engine/processor.py _process_qwen2_vl) consumes
+directly. Videos arrive as FRAME LISTS (data-URL images); container
+decoding is out of scope in this image-less environment — the frame
+path is exactly what the reference's video loader produces after
+decode.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_PROCESSORS: dict = {}
+
+
+def _processor(hf_config):
+    key = id(hf_config)
+    proc = _PROCESSORS.get(key)
+    if proc is None:
+        from transformers.models.qwen2_vl.image_processing_qwen2_vl import \
+            Qwen2VLImageProcessor
+        vc = hf_config.vision_config
+        patch = int(vc.patch_size)
+        merge = int(getattr(vc, "spatial_merge_size", 2))
+        tile = patch * merge
+        proc = Qwen2VLImageProcessor(
+            patch_size=patch,
+            merge_size=merge,
+            temporal_patch_size=int(getattr(vc, "temporal_patch_size",
+                                            2)),
+            # Bounds in PIXELS; keep the floor at one merged tile so
+            # tiny test images survive, cap at ~4k tiles.
+            min_pixels=tile * tile,
+            max_pixels=tile * tile * 4096,
+        )
+        _PROCESSORS[key] = proc
+    return proc
+
+
+def preprocess_chat_media(image_urls: list[str],
+                          video_frame_lists: list[list[str]],
+                          hf_config) -> Optional[dict]:
+    """data-URL images / frame lists -> the engine's qwen2-vl
+    multi_modal_data dict (flattened patches + grid_thw)."""
+    from vllm_distributed_tpu.multimodal.image_processing import \
+        decode_data_url
+    if not image_urls and not video_frame_lists:
+        return None
+    proc = _processor(hf_config)
+    mm: dict = {}
+    if image_urls:
+        images = [decode_data_url(u).convert("RGB")
+                  for u in image_urls]
+        out = proc(images=images, return_tensors="np")
+        mm["pixel_values"] = np.asarray(out["pixel_values"], np.float32)
+        mm["image_grid_thw"] = np.asarray(out["image_grid_thw"])
+    if video_frame_lists:
+        videos = []
+        for frames in video_frame_lists:
+            if not frames:
+                raise ValueError("video content part has no frames")
+            videos.append([np.asarray(
+                decode_data_url(u).convert("RGB")) for u in frames])
+        out = proc(images=None, videos=videos, return_tensors="np")
+        mm["pixel_values_videos"] = np.asarray(
+            out["pixel_values_videos"], np.float32)
+        mm["video_grid_thw"] = np.asarray(out["video_grid_thw"])
+    return mm
+
+
+def media_token_strings(tokenizer, hf_config):
+    """(image_token, video_token) string forms, None where absent."""
+    out = []
+    for attr in ("image_token_id", "video_token_id"):
+        idx = getattr(hf_config, attr, None)
+        tok = None
+        if idx is not None and tokenizer is not None:
+            try:
+                tok = tokenizer.convert_ids_to_tokens(int(idx))
+            except Exception:  # noqa: BLE001
+                tok = None
+        out.append(tok)
+    return tuple(out)
