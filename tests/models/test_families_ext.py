@@ -425,3 +425,36 @@ def test_round5_families_match_hf(family, tmp_path_factory):
     got = _run_engine(path, PROMPTS, family)
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want, family
+
+
+@pytest.mark.parametrize("family", ["hunyuan", "flexolmo",
+                                    "granitemoeshared"])
+def test_round5_moe_families_match_hf(family, tmp_path_factory):
+    """Second round-5 wave: per-head-qk-norm HunYuan, post-norm MoE
+    FlexOlmo, and GraniteMoe + ungated shared MLP."""
+    from transformers import (FlexOlmoConfig, FlexOlmoForCausalLM,
+                              GraniteMoeSharedConfig,
+                              GraniteMoeSharedForCausalLM,
+                              HunYuanDenseV1Config,
+                              HunYuanDenseV1ForCausalLM)
+    cases = {
+        "hunyuan": (HunYuanDenseV1ForCausalLM, HunYuanDenseV1Config(
+            **_COMMON, intermediate_size=128, num_key_value_heads=2,
+            head_dim=16, pad_token_id=0)),
+        "flexolmo": (FlexOlmoForCausalLM, FlexOlmoConfig(
+            **_COMMON, intermediate_size=96, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2, pad_token_id=0)),
+        "granitemoeshared": (GraniteMoeSharedForCausalLM,
+                             GraniteMoeSharedConfig(
+            **_COMMON, intermediate_size=96, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            shared_intermediate_size=64, pad_token_id=0)),
+    }
+    hf_cls, cfg = cases[family]
+    torch.manual_seed(0)
+    hf = hf_cls(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_{family}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, family)
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want, family

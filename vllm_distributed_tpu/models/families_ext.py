@@ -895,3 +895,113 @@ class VaultGemmaForCausalLM(LlamaForCausalLM):
             layers[key] = layers[key] + 1.0
         params["final_ln"] = params["final_ln"] + 1.0
         return params
+
+
+class HunYuanDenseV1ForCausalLM(LlamaForCausalLM):
+    """Tencent HunYuan dense v1 (reference: models/hunyuan_v1.py): the
+    llama block + per-head q/k RMSNorm ahead of rope, shipped as
+    ``query_layernorm``/``key_layernorm``."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.qk_norm = True
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = {}
+        for name, t in tensors.items():
+            name = name.replace(".query_layernorm.", ".q_norm.")
+            name = name.replace(".key_layernorm.", ".k_norm.")
+            renamed[name] = t
+        return super().params_from_hf_state_dict(renamed)
+
+
+class FlexOlmoForCausalLM(MixtralForCausalLM):
+    """FlexOlmo (reference: models/flex_olmo.py): the OLMo-2 POST-norm
+    block (output norms before the residual adds, full-row q/k norms)
+    with OLMoE-style routed experts."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.pre_norm = False
+        arch.extra_layer_norms = True
+        arch.qk_norm_full = True
+        arch.num_experts = hf.num_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.norm_topk_prob = bool(getattr(hf, "norm_topk_prob", False))
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        return super().params_from_hf_state_dict(_alias_moe_experts(
+            tensors, self.cfg.num_layers, self.cfg.num_experts))
+
+
+class GraniteMoeSharedForCausalLM(GraniteMoeForCausalLM):
+    """GraniteMoeShared (reference: models/granitemoeshared.py): the
+    GraniteMoe block plus an UNGATED dense shared MLP added to every
+    token's routed output, shipped fused like the experts
+    (shared_mlp.input_linear packs [gate; up])."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        super().configure_arch(arch, hf)
+        arch.shared_expert_intermediate_size = int(
+            getattr(hf, "shared_intermediate_size", 0) or 0)
+
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        if self.cfg.shared_expert_intermediate_size:
+            specs["layers"].update({
+                "shared_gate": P(None, None, MODEL_AXIS),
+                "shared_up": P(None, None, MODEL_AXIS),
+                "shared_down": P(None, MODEL_AXIS, None),
+            })
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        import jax
+        import jax.numpy as jnp
+        params = super().init_params(rng, scale)
+        c = self.cfg
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            L, H = c.num_layers, c.hidden_size
+            keys = iter(jax.random.split(jax.random.fold_in(rng, 29), 3))
+
+            def norm(key, shape):
+                return (scale * jax.random.normal(
+                    key, shape, jnp.float32)).astype(c.dtype)
+
+            params["layers"].update({
+                "shared_gate": norm(next(keys), (L, H, Is)),
+                "shared_up": norm(next(keys), (L, H, Is)),
+                "shared_down": norm(next(keys), (L, Is, H)),
+            })
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        import jax.numpy as jnp
+        c = self.cfg
+        params = super().params_from_hf_state_dict(tensors)
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            gates, ups, downs = [], [], []
+            for i in range(c.num_layers):
+                pre = f"model.layers.{i}.shared_mlp."
+                fused = np.asarray(tensors[pre + "input_linear.weight"])
+                gates.append(fused[:Is].T)   # [H, Is]
+                ups.append(fused[Is:].T)
+                downs.append(np.asarray(
+                    tensors[pre + "output_linear.weight"]).T)
+            params["layers"].update({
+                "shared_gate": jnp.asarray(np.stack(gates), c.dtype),
+                "shared_up": jnp.asarray(np.stack(ups), c.dtype),
+                "shared_down": jnp.asarray(np.stack(downs), c.dtype),
+            })
+        return params
+
+    def mlp_block(self, lp: dict, x, lora_ctx=None):
+        routed = super().mlp_block(lp, x, lora_ctx)
+        if not self.cfg.shared_expert_intermediate_size:
+            return routed
+        from vllm_distributed_tpu.models.common import swiglu
+        return routed + swiglu(x, lp["shared_gate"], lp["shared_up"],
+                               lp["shared_down"], act=self._act)

@@ -18,6 +18,9 @@ from vllm_distributed_tpu.models.deepseek import (DeepseekV2ForCausalLM,
 from vllm_distributed_tpu.models.llama import (LlamaArchConfig,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.families_ext import (Cohere2ForCausalLM,
+                                                      FlexOlmoForCausalLM,
+                                                      GraniteMoeSharedForCausalLM,
+                                                      HunYuanDenseV1ForCausalLM,
                                                       VaultGemmaForCausalLM,
                                                       CohereForCausalLM,
                                                       DbrxForCausalLM,
@@ -102,6 +105,12 @@ _REGISTRY: dict[str, type] = {
     # Families on the generic block knobs (models/families_ext.py).
     "GraniteForCausalLM": GraniteForCausalLM,
     "GraniteMoeForCausalLM": GraniteMoeForCausalLM,
+    # GraniteMoe + ungated dense shared MLP (families_ext.py).
+    "GraniteMoeSharedForCausalLM": GraniteMoeSharedForCausalLM,
+    # Tencent HunYuan dense: llama + per-head qk RMSNorm.
+    "HunYuanDenseV1ForCausalLM": HunYuanDenseV1ForCausalLM,
+    # FlexOlmo: OLMo-2 post-norm block + OLMoE routed experts.
+    "FlexOlmoForCausalLM": FlexOlmoForCausalLM,
     "DbrxForCausalLM": DbrxForCausalLM,
     # Attention sinks + clamped-GLU MoE (models/families_ext.py).
     "GptOssForCausalLM": GptOssForCausalLM,
