@@ -429,3 +429,18 @@ def test_tokenize_messages_path(server):
     # Template-less fallback: role-prefixed prompt, same path chat
     # generation uses; the real ids 3 and 17 appear in the encoding.
     assert body["count"] == len(body["tokens"]) > 0
+
+
+def test_spec_stats_render_in_metrics():
+    """Spec-decode counters surface in the Prometheus text (reference:
+    the vllm:spec_decode_* family of v1/metrics)."""
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics({
+        "spec_num_draft_tokens": 30,
+        "spec_num_accepted_tokens": 21,
+        "spec_num_drafts": 10,
+        "spec_acceptance_rate": 0.7,
+    })
+    assert "vdt:spec_decode_num_draft_tokens_total 30.0" in text
+    assert "vdt:spec_decode_num_accepted_tokens_total 21.0" in text
+    assert "vdt:spec_decode_acceptance_rate 0.7" in text

@@ -15,6 +15,8 @@ _GAUGES = {
                          "Number of requests waiting to be scheduled"),
     "kv_cache_usage": ("vdt:kv_cache_usage_perc",
                        "Fraction of KV pages in use"),
+    "spec_acceptance_rate": ("vdt:spec_decode_acceptance_rate",
+                             "Accepted / proposed draft tokens"),
 }
 
 _COUNTERS = {
@@ -24,6 +26,15 @@ _COUNTERS = {
              "Cumulative prefix-cache token hits"),
     "queries": ("vdt:prefix_cache_queries_total",
                 "Cumulative prefix-cache token queries"),
+    # Spec decode (reference: v1/metrics SpecDecodingStats -> the
+    # vllm:spec_decode_* family).
+    "spec_num_draft_tokens": ("vdt:spec_decode_num_draft_tokens_total",
+                              "Cumulative proposed draft tokens"),
+    "spec_num_accepted_tokens": (
+        "vdt:spec_decode_num_accepted_tokens_total",
+        "Cumulative accepted draft tokens"),
+    "spec_num_drafts": ("vdt:spec_decode_num_drafts_total",
+                        "Cumulative draft proposals"),
 }
 
 
