@@ -458,3 +458,52 @@ def test_round5_moe_families_match_hf(family, tmp_path_factory):
     got = _run_engine(path, PROMPTS, family)
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want, family
+
+
+def test_ernie45_moe_dense_prefix_matches_hf(tmp_path_factory):
+    """ERNIE-4.5 MoE: layer 0 dense, routed layers with
+    bias-for-selection softmax routing + ungated shared experts
+    (models/moe_mixed.py dense-prefix machinery)."""
+    from transformers import Ernie4_5_MoeConfig, Ernie4_5_MoeForCausalLM
+    cfg = Ernie4_5_MoeConfig(
+        **_COMMON, intermediate_size=128, num_key_value_heads=2,
+        moe_num_experts=4, moe_k=2, moe_intermediate_size=48,
+        moe_num_shared_experts=1, moe_layer_start_index=1,
+        pad_token_id=0)
+    torch.manual_seed(0)
+    hf = Ernie4_5_MoeForCausalLM(cfg).eval()
+    # Non-zero correction bias so the selection-vs-weighting split is
+    # actually exercised.
+    with torch.no_grad():
+        for layer in hf.model.layers[1:]:
+            layer.mlp.moe_statics.e_score_correction_bias.copy_(
+                torch.randn(1, 4) * 0.5)
+    path = str(tmp_path_factory.mktemp("tiny_ernie45moe"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "ernie45moe")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want
+
+
+def test_glm4_moe_dense_prefix_matches_hf(tmp_path_factory):
+    """GLM-4-MoE: first_k_dense_replace dense prefix + V3-style
+    sigmoid/bias routing + shared experts + partial rotary + per-head
+    qk norm."""
+    from transformers import Glm4MoeConfig, Glm4MoeForCausalLM
+    cfg = Glm4MoeConfig(
+        **_COMMON, intermediate_size=128, num_key_value_heads=2,
+        n_routed_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, n_shared_experts=1,
+        first_k_dense_replace=1, head_dim=16, use_qk_norm=True,
+        partial_rotary_factor=0.5, routed_scaling_factor=1.5,
+        norm_topk_prob=True, n_group=2, topk_group=2, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = Glm4MoeForCausalLM(cfg).eval()
+    with torch.no_grad():
+        hf.model.layers[1].mlp.gate.e_score_correction_bias.copy_(
+            torch.randn(4) * 0.5)
+    path = str(tmp_path_factory.mktemp("tiny_glm4moe"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "glm4moe")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

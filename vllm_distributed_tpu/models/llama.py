@@ -112,6 +112,12 @@ class LlamaArchConfig:
     # layers are position-free while sliding layers rope (Cohere2,
     # EXAONE-4). None = rotary everywhere.
     nope_layers: Optional[tuple] = None
+    # Mixed MoE layouts (ERNIE-4.5 / GLM-4-MoE): this many leading
+    # layers are PLAIN dense decoder blocks in their own stacked
+    # subtree (models/moe_mixed.py); 0 = uniform stack.
+    dense_prefix: int = 0
+    # ERNIE routing-weight normalizer clamp (moe_norm_min).
+    moe_norm_min: float = 1e-12
     # Multi-LoRA slots (0 disables; see models/lora.py).
     max_loras: int = 0
     max_lora_rank: int = 16
@@ -1356,6 +1362,18 @@ class LlamaForCausalLM:
             hidden = jnp.where(batch.mm_mask[:, None],
                                batch.mm_embeds.astype(hidden.dtype),
                                hidden)
+        dense = params.get("layers_dense")
+        if dense is not None:
+            # Mixed layouts (Ernie-4.5-MoE / GLM-4-MoE style): a dense
+            # PREFIX of plain decoder layers runs first from its own
+            # stacked subtree, then the sparse stack continues with its
+            # cache rows offset past the prefix.
+            k = jax.tree_util.tree_leaves(dense)[0].shape[0]
+            hidden, kv_caches = self.run_layers(dense, kv_caches,
+                                                hidden, batch)
+            return self.run_layers(params["layers"], kv_caches, hidden,
+                                   batch, first_layer=k,
+                                   cache_layer_offset=k)
         return self.run_layers(params["layers"], kv_caches, hidden, batch)
 
     def compute_logits(self, params: dict,

@@ -83,6 +83,16 @@ def get_model(config: EngineConfig, mesh,
         model_cls.arch_config_source(hf_config), dtype=dtype)
     model_cls.configure_arch(arch, hf_config)
     arch.expert_parallel = config.parallel_config.enable_expert_parallel
+    if getattr(arch, "dense_prefix", 0):
+        if config.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError(
+                "mixed dense/sparse MoE layouts are not wired for "
+                "pipeline parallelism (stage slicing assumes one "
+                "uniform layer stack)")
+        if config.lora_config.enable_lora:
+            raise ValueError(
+                "LoRA for mixed dense/sparse MoE layouts is not wired "
+                "(adapter buffers assume one uniform layer stack)")
     if (config.parallel_config.enable_sequence_parallel
             and config.parallel_config.token_parallel_size > 1):
         raise ValueError(

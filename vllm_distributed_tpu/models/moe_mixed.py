@@ -1,0 +1,365 @@
+"""Mixed dense/sparse MoE decoders (dense prefix + routed rest).
+
+Reference: vllm/model_executor/models/ernie45_moe.py and glm4_moe.py —
+modern MoE families run their first layer(s) as PLAIN dense decoder
+blocks (first_k_dense_replace / moe_layer_start_index) before the
+routed stack. TPU-first mechanism: the dense prefix is its own stacked
+subtree (``layers_dense``) built by a throwaway dense submodel and run
+through ``run_layers`` first; the sparse stack follows with
+``cache_layer_offset`` shifting its KV rows past the prefix
+(models/llama.py forward). No per-layer branching inside the scan —
+each stack keeps uniform leaves.
+
+Constraints: pipeline parallelism and LoRA are rejected for mixed
+layouts (stage slicing and adapter buffers assume one uniform layer
+stack); weight quantization applies to the sparse stack only.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
+                                               LlamaArchConfig,
+                                               LlamaForCausalLM)
+from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
+
+
+class _DensePrefixMoe(MixtralForCausalLM):
+    """Shared machinery: ``cfg.dense_prefix`` plain layers, then the
+    Mixtral-style routed stack."""
+
+    def _submodels(self):
+        c = self.cfg
+        k = c.dense_prefix
+        dense_cfg = dataclasses.replace(
+            c, num_layers=k, num_experts=0, dense_prefix=0)
+        sparse_cfg = dataclasses.replace(
+            c, num_layers=c.num_layers - k, dense_prefix=0)
+        return (LlamaForCausalLM(dense_cfg),
+                type(self)(sparse_cfg))
+
+    @staticmethod
+    def _shift_layer_names(tensors: dict, start: int,
+                           count: int) -> dict:
+        out = {}
+        for name, t in tensors.items():
+            if name.startswith("model.layers."):
+                rest = name[len("model.layers."):]
+                idx, _, tail = rest.partition(".")
+                i = int(idx)
+                if start <= i < start + count:
+                    out[f"model.layers.{i - start}.{tail}"] = t
+            else:
+                out[name] = t
+        return out
+
+    # ------------------------------------------------------------------
+    def param_specs(self) -> dict:
+        if not self.cfg.dense_prefix:
+            return super().param_specs()
+        dense_m, sparse_m = self._submodels()
+        specs = sparse_m.param_specs()
+        specs["layers_dense"] = dense_m.param_specs()["layers"]
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        if not self.cfg.dense_prefix:
+            return super().init_params(rng, scale)
+        dense_m, sparse_m = self._submodels()
+        params = sparse_m.init_params(rng, scale)
+        params["layers_dense"] = dense_m.init_params(
+            jax.random.fold_in(rng, 31), scale)["layers"]
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        if not self.cfg.dense_prefix:
+            return super().params_from_hf_state_dict(tensors)
+        c = self.cfg
+        k = c.dense_prefix
+        dense_m, sparse_m = self._submodels()
+        params = sparse_m.params_from_hf_state_dict(
+            self._shift_layer_names(tensors, k, c.num_layers - k))
+        params["layers_dense"] = dense_m.params_from_hf_state_dict(
+            self._shift_layer_names(tensors, 0, k))["layers"]
+        return params
+
+    def quantize_params(self, params: dict) -> dict:
+        if self.cfg.quantization and self.cfg.dense_prefix:
+            raise ValueError(
+                "weight quantization for mixed dense/sparse MoE "
+                "layouts is not wired; drop --quantization")
+        return super().quantize_params(params)
+
+
+class Ernie45MoeForCausalLM(_DensePrefixMoe):
+    """Baidu ERNIE-4.5 MoE (reference: models/ernie45_moe.py): dense
+    prefix (moe_layer_start_index), softmax routing with an
+    e_score_correction_bias applied for SELECTION only (weights are the
+    raw softmax probs of the selected experts, normalized with a
+    moe_norm_min clamp), plus an ungated dense shared expert of width
+    moe_intermediate_size * moe_num_shared_experts."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        L = arch.num_layers
+        arch.num_experts = hf.moe_num_experts
+        arch.num_experts_per_tok = hf.moe_k
+        arch.moe_intermediate_size = hf.moe_intermediate_size
+        arch.shared_expert_intermediate_size = (
+            hf.moe_intermediate_size *
+            int(getattr(hf, "moe_num_shared_experts", 0) or 0))
+        start = int(getattr(hf, "moe_layer_start_index", 0) or 0)
+        end = getattr(hf, "moe_layer_end_index", None)
+        end = L - 1 if end is None else int(end)
+        if (int(getattr(hf, "moe_layer_interval", 1) or 1) != 1
+                or end != L - 1):
+            raise ValueError(
+                "only contiguous dense-prefix ERNIE MoE layouts are "
+                "supported (moe_layer_interval=1, moe_layer_end_index "
+                "= last layer)")
+        arch.dense_prefix = start
+        arch.moe_norm_min = float(getattr(hf, "moe_norm_min", 1e-12))
+        if bool(getattr(hf, "use_bias", False)):
+            raise ValueError("ERNIE use_bias checkpoints are not "
+                             "supported")
+
+    # ---- routing ------------------------------------------------------
+    def _route(self, lp, x):
+        c = self.cfg
+        logits = (x.astype(jnp.float32)
+                  @ lp["router"].astype(jnp.float32))  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        _, top_idx = jax.lax.top_k(
+            probs + lp["router_bias"].astype(jnp.float32)[None, :],
+            c.num_experts_per_tok)
+        top_vals = jnp.take_along_axis(probs, top_idx, axis=-1)
+        top_vals = top_vals / jnp.maximum(
+            top_vals.sum(axis=-1, keepdims=True), c.moe_norm_min)
+        return top_idx, top_vals
+
+    def mlp_block(self, lp: dict, x, lora_ctx=None):
+        if "router" not in lp:  # dense-prefix subtree
+            return LlamaForCausalLM.mlp_block(self, lp, x, lora_ctx)
+        routed = super().mlp_block(lp, x, lora_ctx)
+        if not self.cfg.shared_expert_intermediate_size:
+            return routed
+        from vllm_distributed_tpu.models.common import swiglu
+        return routed + swiglu(x, lp["shared_gate"], lp["shared_up"],
+                               lp["shared_down"], act=self._act)
+
+    # ---- params (the dense-prefix case delegates entirely to the
+    # sparse submodel inside _DensePrefixMoe, which re-enters these
+    # methods with dense_prefix == 0) -----------------------------------
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        if self.cfg.dense_prefix:
+            return specs
+        layer = specs["layers"]
+        layer["router_bias"] = P(None, None)  # [L, E]
+        if self.cfg.shared_expert_intermediate_size:
+            layer.update({
+                "shared_gate": P(None, None, MODEL_AXIS),
+                "shared_up": P(None, None, MODEL_AXIS),
+                "shared_down": P(None, MODEL_AXIS, None),
+            })
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        params = super().init_params(rng, scale)
+        c = self.cfg
+        if c.dense_prefix:
+            return params
+        Ls = c.num_layers
+        layers = params["layers"]
+        layers["router_bias"] = jnp.zeros((Ls, c.num_experts),
+                                          jnp.float32)
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            keys = iter(jax.random.split(jax.random.fold_in(rng, 37), 3))
+
+            def norm(key, shape):
+                return (scale * jax.random.normal(
+                    key, shape, jnp.float32)).astype(c.dtype)
+
+            layers.update({
+                "shared_gate": norm(next(keys), (Ls, c.hidden_size, Is)),
+                "shared_up": norm(next(keys), (Ls, c.hidden_size, Is)),
+                "shared_down": norm(next(keys), (Ls, Is, c.hidden_size)),
+            })
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        if c.dense_prefix:
+            return super().params_from_hf_state_dict(tensors)
+        # Sparse stack (possibly the submodel for the sparse slice):
+        # map ERNIE names onto the Mixtral layout + side tensors.
+        from vllm_distributed_tpu.models.families_ext import \
+            _alias_moe_experts
+        L = c.num_layers
+        params = MixtralForCausalLM.params_from_hf_state_dict(
+            self, _alias_moe_experts(tensors, L, c.num_experts))
+        layers = params["layers"]
+        layers["router_bias"] = jnp.asarray(np.stack([
+            np.asarray(tensors[f"model.layers.{i}.mlp.moe_statics."
+                               f"e_score_correction_bias"]).reshape(-1)
+            for i in range(L)
+        ]), jnp.float32)
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            def stack(fmt):
+                return jnp.asarray(np.stack([
+                    np.asarray(tensors[fmt.format(i)]).T
+                    for i in range(L)
+                ]), c.dtype)
+
+            layers.update({
+                "shared_gate": stack("model.layers.{}.mlp."
+                                     "shared_experts.gate_proj.weight"),
+                "shared_up": stack("model.layers.{}.mlp."
+                                   "shared_experts.up_proj.weight"),
+                "shared_down": stack("model.layers.{}.mlp."
+                                     "shared_experts.down_proj.weight"),
+            })
+        return params
+
+
+class Glm4MoeForCausalLM(_DensePrefixMoe):
+    """GLM-4-MoE (reference: models/glm4_moe.py): dense prefix
+    (first_k_dense_replace), DeepSeek-V3-style routing (sigmoid scores,
+    e_score_correction_bias for SELECTION with top-2-sum group
+    limiting, weights from the raw sigmoid, optional renormalize,
+    routed_scaling_factor), ungated shared experts, partial rotary and
+    optional per-head qk norm on a standard-attention llama block."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        arch.num_experts = hf.n_routed_experts
+        arch.num_experts_per_tok = hf.num_experts_per_tok
+        arch.moe_intermediate_size = hf.moe_intermediate_size
+        arch.shared_expert_intermediate_size = (
+            hf.moe_intermediate_size *
+            int(getattr(hf, "n_shared_experts", 0) or 0))
+        arch.dense_prefix = int(
+            getattr(hf, "first_k_dense_replace", 0) or 0)
+        arch.norm_topk_prob = bool(getattr(hf, "norm_topk_prob", True))
+        arch.routed_scaling_factor = float(
+            getattr(hf, "routed_scaling_factor", 1.0) or 1.0)
+        arch.n_group = int(getattr(hf, "n_group", 1) or 1)
+        arch.topk_group = int(getattr(hf, "topk_group", 1) or 1)
+        arch.qk_norm = bool(getattr(hf, "use_qk_norm", False))
+        arch.attention_bias = bool(getattr(hf, "attention_bias", False))
+        factor = float(getattr(hf, "partial_rotary_factor", 1.0) or 1.0)
+        if factor != 1.0:
+            arch.rotary_dim = int(arch.head_dim * factor)
+
+    # ---- routing (DeepSeek-V3 noaux_tc on sigmoid scores) -------------
+    def _route(self, lp, x):
+        from vllm_distributed_tpu.models.deepseek import \
+            DeepseekV2ForCausalLM
+        c = self.cfg
+        T = x.shape[0]
+        E = c.num_experts
+        logits = (x.astype(jnp.float32)
+                  @ lp["router"].astype(jnp.float32))
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + lp["router_bias"].astype(jnp.float32)[None, :]
+        G = c.n_group
+        grp = choice.reshape(T, G, E // G)
+        top2 = jax.lax.top_k(grp, min(2, E // G))[0].sum(axis=-1)
+        sel = DeepseekV2ForCausalLM._group_mask(top2, c.topk_group, G, E)
+        masked = jnp.where(sel, choice, 0.0)
+        top_idx = jax.lax.top_k(masked, c.num_experts_per_tok)[1]
+        top_vals = jnp.take_along_axis(scores, top_idx, axis=-1)
+        if c.norm_topk_prob:
+            top_vals = top_vals / (
+                top_vals.sum(axis=-1, keepdims=True) + 1e-20)
+        return top_idx, top_vals * c.routed_scaling_factor
+
+    def mlp_block(self, lp: dict, x, lora_ctx=None):
+        if "router" not in lp:  # dense-prefix subtree
+            return LlamaForCausalLM.mlp_block(self, lp, x, lora_ctx)
+        routed = super().mlp_block(lp, x, lora_ctx)
+        if not self.cfg.shared_expert_intermediate_size:
+            return routed
+        from vllm_distributed_tpu.models.common import swiglu
+        return routed + swiglu(x, lp["shared_gate"], lp["shared_up"],
+                               lp["shared_down"], act=self._act)
+
+    # ---- params -------------------------------------------------------
+    def param_specs(self) -> dict:
+        specs = super().param_specs()
+        if self.cfg.dense_prefix:
+            return specs
+        layer = specs["layers"]
+        layer["router_bias"] = P(None, None)
+        if self.cfg.shared_expert_intermediate_size:
+            layer.update({
+                "shared_gate": P(None, None, MODEL_AXIS),
+                "shared_up": P(None, None, MODEL_AXIS),
+                "shared_down": P(None, MODEL_AXIS, None),
+            })
+        return specs
+
+    def init_params(self, rng, scale: float = 0.02) -> dict:
+        params = super().init_params(rng, scale)
+        c = self.cfg
+        if c.dense_prefix:
+            return params
+        layers = params["layers"]
+        layers["router_bias"] = jnp.zeros((c.num_layers, c.num_experts),
+                                          jnp.float32)
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            keys = iter(jax.random.split(jax.random.fold_in(rng, 41), 3))
+
+            def norm(key, shape):
+                return (scale * jax.random.normal(
+                    key, shape, jnp.float32)).astype(c.dtype)
+
+            layers.update({
+                "shared_gate": norm(next(keys),
+                                    (c.num_layers, c.hidden_size, Is)),
+                "shared_up": norm(next(keys),
+                                  (c.num_layers, c.hidden_size, Is)),
+                "shared_down": norm(next(keys),
+                                    (c.num_layers, Is, c.hidden_size)),
+            })
+        return params
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        if c.dense_prefix:
+            return super().params_from_hf_state_dict(tensors)
+        from vllm_distributed_tpu.models.families_ext import \
+            _alias_moe_experts
+        L = c.num_layers
+        params = MixtralForCausalLM.params_from_hf_state_dict(
+            self, _alias_moe_experts(tensors, L, c.num_experts))
+        layers = params["layers"]
+        layers["router_bias"] = jnp.asarray(np.stack([
+            np.asarray(tensors[f"model.layers.{i}.mlp.gate."
+                               f"e_score_correction_bias"]).reshape(-1)
+            for i in range(L)
+        ]), jnp.float32)
+        Is = c.shared_expert_intermediate_size
+        if Is:
+            def stack(fmt):
+                return jnp.asarray(np.stack([
+                    np.asarray(tensors[fmt.format(i)]).T
+                    for i in range(L)
+                ]), c.dtype)
+
+            layers.update({
+                "shared_gate": stack("model.layers.{}.mlp."
+                                     "shared_experts.gate_proj.weight"),
+                "shared_up": stack("model.layers.{}.mlp."
+                                   "shared_experts.up_proj.weight"),
+                "shared_down": stack("model.layers.{}.mlp."
+                                     "shared_experts.down_proj.weight"),
+            })
+        return params

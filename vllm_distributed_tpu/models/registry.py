@@ -70,6 +70,8 @@ from vllm_distributed_tpu.models.jamba import JambaForCausalLM
 from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
                                                Mamba2ForCausalLM,
                                                MambaForCausalLM)
+from vllm_distributed_tpu.models.moe_mixed import (Ernie45MoeForCausalLM,
+                                                   Glm4MoeForCausalLM)
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
 
@@ -111,6 +113,12 @@ _REGISTRY: dict[str, type] = {
     "HunYuanDenseV1ForCausalLM": HunYuanDenseV1ForCausalLM,
     # FlexOlmo: OLMo-2 post-norm block + OLMoE routed experts.
     "FlexOlmoForCausalLM": FlexOlmoForCausalLM,
+    # ERNIE-4.5 MoE: dense prefix + bias-selected softmax routing +
+    # ungated shared experts (models/moe_mixed.py).
+    "Ernie4_5_MoeForCausalLM": Ernie45MoeForCausalLM,
+    # GLM-4-MoE: dense prefix + DeepSeek-V3-style sigmoid routing +
+    # shared experts on a standard-attention block (moe_mixed.py).
+    "Glm4MoeForCausalLM": Glm4MoeForCausalLM,
     "DbrxForCausalLM": DbrxForCausalLM,
     # Attention sinks + clamped-GLU MoE (models/families_ext.py).
     "GptOssForCausalLM": GptOssForCausalLM,
