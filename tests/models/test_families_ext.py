@@ -507,3 +507,28 @@ def test_glm4_moe_dense_prefix_matches_hf(tmp_path_factory):
     got = _run_engine(path, PROMPTS, "glm4moe")
     want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
     assert got == want
+
+
+def test_dots1_dense_prefix_matches_hf(tmp_path_factory):
+    """dots.llm1: GLM-4-MoE recipe + always-on per-head qk norm +
+    sliding layer_types."""
+    from transformers import Dots1Config, Dots1ForCausalLM
+    cfg = Dots1Config(
+        **_COMMON, intermediate_size=128, num_key_value_heads=2,
+        n_routed_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, n_shared_experts=1,
+        first_k_dense_replace=1, routed_scaling_factor=1.5,
+        n_group=2, topk_group=2, norm_topk_prob=True,
+        sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        pad_token_id=0)
+    torch.manual_seed(0)
+    hf = Dots1ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        hf.model.layers[1].mlp.gate.e_score_correction_bias.copy_(
+            torch.randn(4) * 0.5)
+    path = str(tmp_path_factory.mktemp("tiny_dots1"))
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _run_engine(path, PROMPTS, "dots1")
+    want = [_hf_greedy(hf, p, 6) for p in PROMPTS]
+    assert got == want

@@ -363,3 +363,17 @@ class Glm4MoeForCausalLM(_DensePrefixMoe):
                                      "shared_experts.down_proj.weight"),
             })
         return params
+
+
+class Dots1ForCausalLM(Glm4MoeForCausalLM):
+    """rednote dots.llm1 (reference: models/dots1.py): the GLM-4-MoE
+    recipe — dense prefix, V3-style sigmoid/group routing with
+    e_score_correction_bias, shared experts — with ALWAYS-on per-head
+    q/k RMSNorm, full rotary, and optional sliding layer_types through
+    the generic window resolver."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        super().configure_arch(arch, hf)
+        arch.qk_norm = True
+        arch.rotary_dim = None  # full rotary (no partial factor)

@@ -70,7 +70,8 @@ from vllm_distributed_tpu.models.jamba import JambaForCausalLM
 from vllm_distributed_tpu.models.mamba import (FalconMambaForCausalLM,
                                                Mamba2ForCausalLM,
                                                MambaForCausalLM)
-from vllm_distributed_tpu.models.moe_mixed import (Ernie45MoeForCausalLM,
+from vllm_distributed_tpu.models.moe_mixed import (Dots1ForCausalLM,
+                                                   Ernie45MoeForCausalLM,
                                                    Glm4MoeForCausalLM)
 from vllm_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                  Qwen2MoeForCausalLM)
@@ -119,6 +120,8 @@ _REGISTRY: dict[str, type] = {
     # GLM-4-MoE: dense prefix + DeepSeek-V3-style sigmoid routing +
     # shared experts on a standard-attention block (moe_mixed.py).
     "Glm4MoeForCausalLM": Glm4MoeForCausalLM,
+    # dots.llm1: the GLM-4-MoE recipe + always-on per-head qk norm.
+    "Dots1ForCausalLM": Dots1ForCausalLM,
     "DbrxForCausalLM": DbrxForCausalLM,
     # Attention sinks + clamped-GLU MoE (models/families_ext.py).
     "GptOssForCausalLM": GptOssForCausalLM,
