@@ -218,3 +218,35 @@ def test_llama_math_forks_match_hf(tmp_path_factory, arch, cfg_name, kw):
     got = run_engine(path, PROMPTS, max_tokens=6)
     for p, toks in zip(PROMPTS, got):
         assert toks == hf_greedy(hf, p, 6), f"prompt {p}"
+
+
+@pytest.mark.parametrize("family", ["biogpt", "xglm"])
+def test_opt_shaped_round5_families_match_hf(family, tmp_path_factory):
+    """BioGPT (learned positions + gelu + scaled embeddings) and XGLM
+    (fixed sinusoidal positions materialized at load)."""
+    from transformers import (BioGptConfig, BioGptForCausalLM,
+                              XGLMConfig, XGLMForCausalLM)
+    if family == "biogpt":
+        cfg = BioGptConfig(vocab_size=128, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4,
+                           max_position_embeddings=64, pad_token_id=0,
+                           eos_token_id=1)
+        hf_cls = BioGptForCausalLM
+    else:
+        cfg = XGLMConfig(vocab_size=128, d_model=64, ffn_dim=128,
+                         num_layers=2, attention_heads=4,
+                         max_position_embeddings=64, pad_token_id=1,
+                         eos_token_id=1)
+        hf_cls = XGLMForCausalLM
+    torch.manual_seed(0)
+    hf = hf_cls(cfg).eval()
+    path = str(tmp_path_factory.mktemp(f"tiny_{family}"))
+    hf.save_pretrained(path, safe_serialization=True)
+    prompts = [[3, 17, 92, 45, 8], [5, 9, 33, 71]]
+    got = run_engine(path, prompts)
+    with torch.no_grad():
+        want = [hf.generate(torch.tensor([p]), max_new_tokens=6,
+                            do_sample=False, eos_token_id=None
+                            )[0].tolist()[len(p):] for p in prompts]
+    assert got == want, family

@@ -521,3 +521,126 @@ class ExaoneForCausalLM(LlamaForCausalLM):
             (".mlp.c_proj.", ".mlp.down_proj."),
         ])
         return super().params_from_hf_state_dict(renamed)
+
+
+class BioGptForCausalLM(OPTForCausalLM):
+    """BioGPT (reference: the OPT-shaped decoder of models/biogpt
+    support in HF): the OPT block — learned positions from offset 2,
+    biased projections, LayerNorm — with gelu MLP, sqrt(H) embedding
+    scaling, and ``biogpt.*`` checkpoint naming."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        import math
+        arch.pos_embedding = "learned"
+        arch.pos_offset = 2
+        arch.max_position_embeddings = int(
+            hf.max_position_embeddings) + 2
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "hidden_act", "gelu")
+        arch.rms_norm_eps = float(getattr(hf, "layer_norm_eps", 1e-12))
+        if bool(getattr(hf, "scale_embedding", True)):
+            arch.embed_scale = math.sqrt(arch.hidden_size)
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        from types import SimpleNamespace
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=hf.num_attention_heads,
+            head_dim=hf.hidden_size // hf.num_attention_heads,
+            rms_norm_eps=float(getattr(hf, "layer_norm_eps", 1e-12)),
+            tie_word_embeddings=True,
+        )
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        renamed = _rename(tensors, [
+            ("biogpt.layers.", "model.layers."),
+            ("biogpt.embed_tokens.", "model.embed_tokens."),
+            ("biogpt.embed_positions.", "model.embed_positions."),
+            ("biogpt.layer_norm.", "model.norm."),
+            ("output_projection.", "lm_head."),
+            (".self_attn.out_proj.", ".self_attn.o_proj."),
+            (".self_attn_layer_norm.", ".input_layernorm."),
+            (".final_layer_norm.", ".post_attention_layernorm."),
+            (".fc1.", ".mlp.fc1."),
+            (".fc2.", ".mlp.fc2."),
+        ])
+        return LlamaForCausalLM.params_from_hf_state_dict(self, renamed)
+
+
+class XGLMForCausalLM(OPTForCausalLM):
+    """XGLM (reference: the OPT-shaped multilingual decoder): the OPT
+    block with gelu MLP, sqrt(H) embedding scaling, and SINUSOIDAL
+    positions — the fixed fairseq table (offset 2, half sin / half
+    cos) materializes into the learned-position slot at load."""
+
+    @classmethod
+    def configure_arch(cls, arch: LlamaArchConfig, hf) -> None:
+        import math
+        arch.pos_embedding = "learned"
+        arch.pos_offset = 2
+        arch.max_position_embeddings = int(
+            hf.max_position_embeddings) + 2
+        arch.norm_type = "layernorm"
+        arch.norm_bias = True
+        arch.mlp_gated = False
+        arch.mlp_bias = True
+        arch.attention_bias = True
+        arch.attention_out_bias = True
+        arch.hidden_act = getattr(hf, "activation_function", "gelu")
+        if bool(getattr(hf, "scale_embedding", True)):
+            arch.embed_scale = math.sqrt(arch.hidden_size)
+
+    @classmethod
+    def arch_config_source(cls, hf):
+        from types import SimpleNamespace
+        return SimpleNamespace(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.d_model,
+            intermediate_size=hf.ffn_dim,
+            num_hidden_layers=hf.num_layers,
+            num_attention_heads=hf.attention_heads,
+            num_key_value_heads=hf.attention_heads,
+            head_dim=hf.d_model // hf.attention_heads,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def _sinusoid_table(n_pos: int, dim: int,
+                        padding_idx: int = 1) -> np.ndarray:
+        """fairseq/XGLMSinusoidalPositionalEmbedding.get_embedding."""
+        import math
+        half = dim // 2
+        freq = np.exp(np.arange(half, dtype=np.float64) *
+                      -(math.log(10000.0) / (half - 1)))
+        ang = np.arange(n_pos, dtype=np.float64)[:, None] * freq[None]
+        emb = np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+        if dim % 2:
+            emb = np.concatenate([emb, np.zeros((n_pos, 1))], axis=1)
+        emb[padding_idx] = 0.0
+        return emb.astype(np.float32)
+
+    def params_from_hf_state_dict(self, tensors) -> dict:
+        c = self.cfg
+        renamed = _rename(tensors, [
+            ("model.layer_norm.", "model.norm."),
+            (".self_attn.out_proj.", ".self_attn.o_proj."),
+            (".self_attn_layer_norm.", ".input_layernorm."),
+            (".final_layer_norm.", ".post_attention_layernorm."),
+            (".fc1.", ".mlp.fc1."),
+            (".fc2.", ".mlp.fc2."),
+        ])
+        renamed["model.embed_positions.weight"] = self._sinusoid_table(
+            c.max_position_embeddings, c.hidden_size)
+        return LlamaForCausalLM.params_from_hf_state_dict(self, renamed)

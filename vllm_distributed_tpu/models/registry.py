@@ -45,6 +45,8 @@ from vllm_distributed_tpu.models.families_ext import (Cohere2ForCausalLM,
                                                       StableLmForCausalLM,
                                                       Starcoder2ForCausalLM)
 from vllm_distributed_tpu.models.families_gpt import (ArceeForCausalLM,
+                                                      BioGptForCausalLM,
+                                                      XGLMForCausalLM,
                                                       BloomForCausalLM,
                                                       Ernie45ForCausalLM,
                                                       ExaoneForCausalLM,
@@ -167,6 +169,11 @@ _REGISTRY: dict[str, type] = {
     "GPTJForCausalLM": GPTJForCausalLM,
     "GPTBigCodeForCausalLM": GPTBigCodeForCausalLM,
     "OPTForCausalLM": OPTForCausalLM,
+    # OPT-shaped decoders: BioGPT (learned positions, gelu, scaled
+    # embeddings) and XGLM (fixed sinusoidal positions materialized at
+    # load) — models/families_gpt.py.
+    "BioGptForCausalLM": BioGptForCausalLM,
+    "XGLMForCausalLM": XGLMForCausalLM,
     "MiniCPMForCausalLM": MiniCPMForCausalLM,
     "ExaoneForCausalLM": ExaoneForCausalLM,
     # Llama-math forks with bias/MLP twists (models/families_gpt.py).
