@@ -135,3 +135,40 @@ def test_draft_model_spec_matches_greedy_and_beats_ngram(checkpoint):
                 max(1, s["spec_num_draft_tokens"]))
     assert rate(d_stats) > rate(n_stats)
     assert rate(d_stats) > 0.8, d_stats
+
+
+def test_spec_composes_with_prefix_caching(checkpoint):
+    """Spec drafts + prefix-cache hits on a shared prompt prefix: the
+    second request reuses cached pages while draft verification
+    continues to match plain greedy output exactly."""
+    long_prefix = [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8, 9]
+    prompts = [long_prefix + [3], long_prefix + [5]]
+    sps = [SamplingParams(temperature=0.0, max_tokens=16,
+                          ignore_eos=True) for _ in prompts]
+
+    base = make_engine(checkpoint, enable_prefix_caching=True)
+    expect = [o.outputs[0].token_ids
+              for o in run(base, prompts, sps, "pcbase")]
+
+    spec = make_engine(checkpoint, speculative_method="ngram",
+                       num_speculative_tokens=3,
+                       enable_prefix_caching=True)
+    # Serve sequentially so the second prompt actually hits the cache.
+    got0 = run(spec, [prompts[0]], [sps[0]], "pc0")[0]
+    got1 = run(spec, [prompts[1]], [sps[1]], "pc1")[0]
+    assert [got0.outputs[0].token_ids,
+            got1.outputs[0].token_ids] == expect
+    stats = spec.get_stats()
+    assert stats["spec_num_draft_tokens"] > 0
+    assert stats["hits"] > 0  # the prefix cache actually engaged
+
+
+def test_eagle_token_parallel_rejected(checkpoint):
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs as EA
+    with pytest.raises(ValueError, match="token parallelism"):
+        EA(model=checkpoint, dtype="float32", block_size=4,
+           num_gpu_blocks_override=64, max_model_len=64,
+           max_num_batched_tokens=64, max_num_seqs=8,
+           skip_tokenizer_init=True, token_parallel_size=2,
+           speculative_method="eagle", speculative_model=checkpoint,
+           num_speculative_tokens=1).create_engine_config()

@@ -510,6 +510,13 @@ class EngineConfig:
                 "pipeline parallelism (the draft layers stack onto the "
                 "single-program cache; stage-sliced caches don't carry "
                 "them)")
+        if (self.speculative_config is not None
+                and self.speculative_config.method == "eagle"
+                and self.parallel_config.token_parallel_size > 1):
+            raise ValueError(
+                "EAGLE speculative decoding is not supported with "
+                "token parallelism (the propose path reads the draft "
+                "cache without the per-rank TKNP metadata)")
 
     def compute_hash(self) -> str:
         """Stable hash of the config for compilation-cache keys."""
