@@ -193,3 +193,24 @@ def test_eagle_tp2_matches_single_device(target_ckpt, eagle_ckpt):
     got = [o.outputs[0].token_ids
            for o in run(tp2, PROMPTS, sps, "t2")]
     assert got == want
+
+
+def test_eagle_survives_preemption(target_ckpt, eagle_ckpt):
+    """A page pool too small for both requests forces preemption and
+    resume mid-generation; EAGLE's draft KV is rebuilt by the re-run
+    prefill's in-step advance and greedy output stays exact."""
+    sps = [SamplingParams(temperature=0.0, max_tokens=16,
+                          ignore_eos=True) for _ in PROMPTS[:2]]
+    baseline = make_engine(target_ckpt)
+    want = [o.outputs[0].token_ids
+            for o in run(baseline, PROMPTS[:2], sps, "pb")]
+
+    tight = make_engine(target_ckpt, speculative_method="eagle",
+                        speculative_model=eagle_ckpt,
+                        num_speculative_tokens=2,
+                        num_gpu_blocks_override=10)  # < 2 full requests
+    got = [o.outputs[0].token_ids
+           for o in run(tight, PROMPTS[:2], sps, "pe")]
+    assert got == want
+    sched = tight.engine_core.engine_core.scheduler
+    assert sched.get_stats()["num_preemptions"] > 0

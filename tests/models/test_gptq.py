@@ -255,3 +255,34 @@ def test_gptq_native_int4g_serving_matches_fp(tmp_path_factory):
             np2.asarray(lq["wq_gmin"])[:, :, None, :]).reshape(w.shape)
     np2.testing.assert_allclose(wrec, np2.asarray(lf["wq"]), rtol=1e-4,
                                 atol=1e-5)
+
+
+def test_gptq_native_int4g_under_tp2(tmp_path_factory):
+    """int4g group-wise serving under GSPMD TP=2: the group dim shards
+    with the weight's input axis (the kernel path gates off; the XLA
+    dequant-in-dot must agree with tp=1)."""
+    torch.manual_seed(5)
+    hf = HFLlama(LlamaConfig(**CFG))
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    packed_sd = {}
+    for name, w in sd.items():
+        if any(name.endswith(f"{t}.weight") for t in TARGETS):
+            base = name[:-len(".weight")]
+            packed, _ = quantize_gptq(w.astype(np.float32))
+            for suffix, arr in packed.items():
+                packed_sd[f"{base}.{suffix}"] = arr
+        else:
+            packed_sd[name] = w
+    path = str(tmp_path_factory.mktemp("tiny_gptq_tp2"))
+    save_file({k: np.ascontiguousarray(v) for k, v in packed_sd.items()},
+              os.path.join(path, "model.safetensors"))
+    cfg = dict(CFG, architectures=["LlamaForCausalLM"],
+               model_type="llama")
+    cfg["quantization_config"] = {
+        "quant_method": "gptq", "bits": BITS, "group_size": GROUP,
+        "desc_act": False, "sym": False}
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    single = _run(path, quantization="gptq")
+    tp2 = _run(path, quantization="gptq", tensor_parallel_size=2)
+    assert tp2 == single
