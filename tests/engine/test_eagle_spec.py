@@ -214,3 +214,20 @@ def test_eagle_survives_preemption(target_ckpt, eagle_ckpt):
     assert got == want
     sched = tight.engine_core.engine_core.scheduler
     assert sched.get_stats()["num_preemptions"] > 0
+
+
+def test_eagle_sleep_wake_roundtrip(target_ckpt, eagle_ckpt):
+    """Sleep level 1 offloads the param tree INCLUDING the eagle
+    subtree; wake re-places it (the specs['eagle'] branch of
+    model_runner.wake_up) and generation resumes exactly."""
+    sps = [SamplingParams(temperature=0.0, max_tokens=10,
+                          ignore_eos=True)]
+    engine = make_engine(target_ckpt, speculative_method="eagle",
+                         speculative_model=eagle_ckpt,
+                         num_speculative_tokens=1)
+    before = run(engine, [PROMPTS[0]], sps, "sw0")[0].outputs[0].token_ids
+    freed = engine.sleep(level=1)
+    assert freed > 0
+    engine.wake_up()
+    after = run(engine, [PROMPTS[0]], sps, "sw1")[0].outputs[0].token_ids
+    assert after == before

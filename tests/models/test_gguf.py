@@ -79,3 +79,12 @@ def test_gguf_q8_0_generates_consistently(hf_and_paths):
     # Q8_0 is near-lossless: the greedy prefix survives quantization.
     assert got[:3] == want[:3]
     assert len(got) == 6
+
+
+def test_gguf_composes_with_requantization(hf_and_paths):
+    """GGUF load -> --quantization int8 (requantize after the host
+    dequant): the same composition the safetensors path supports."""
+    st, f32, _q8 = hf_and_paths
+    got = _run(f32, quantization="int8")
+    want = _run(st, quantization="int8")
+    assert got == want

@@ -176,3 +176,44 @@ def test_text_only_matches_hf(ckpt, hf_model):
     got = _run_engine(ckpt, prompt, None)
     want = _hf_greedy(hf_model, prompt, 6)
     assert got == want
+
+
+def test_qwen2_vl_prefix_cache_salted_by_media(ckpt):
+    """Two requests with the SAME video share prefix-cache pages; a
+    DIFFERENT video must not (the mm content hash salts the block
+    hashes), and M-RoPE tables stay per-request correct across cache
+    hits."""
+    rng = np.random.default_rng(5)
+    vid_a = _patches(rng, 2, 4, 4)
+    vid_b = _patches(rng, 2, 4, 4)
+    vgrid = [(2, 4, 4)]
+    prompt = [9, VSTART, VID_TOK, VEND, 11, 12, 13, 14]
+
+    def mm(v):
+        return {"pixel_values_videos": v, "video_grid_thw": vgrid}
+
+    engine = LLMEngine(EngineArgs(
+        model=ckpt, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=128,
+        max_num_batched_tokens=128, max_num_seqs=8,
+        enable_prefix_caching=True,
+        skip_tokenizer_init=True).create_engine_config())
+    sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
+
+    def one(tag, v):
+        engine.add_request(tag, prompt, sp, multi_modal_data=mm(v))
+        for _ in range(200):
+            for out in engine.step():
+                if out.finished:
+                    return out
+        raise AssertionError("did not finish")
+
+    first = one("va-0", vid_a)
+    again = one("va-1", vid_a)
+    other = one("vb-0", vid_b)
+    # Same video: identical output AND a cache hit; different video:
+    # different continuation (same token prompt!) — no false sharing.
+    assert again.outputs[0].token_ids == first.outputs[0].token_ids
+    assert again.num_cached_tokens > 0
+    assert other.num_cached_tokens == 0
+    assert other.outputs[0].token_ids != first.outputs[0].token_ids
