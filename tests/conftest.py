@@ -118,7 +118,13 @@ _SMOKE = {
     "entrypoints/test_tool_parsers.py": None,
     "kv_transfer/test_shared_storage.py": {
         "test_producer_saves_consumer_skips_and_matches"},
-    "entrypoints/test_openai_server.py": {"test_completion_token_parity"},
+    "entrypoints/test_openai_server.py": {"test_completion_token_parity",
+                                          "test_spec_stats_render_in_metrics"},
+    # Round-5 subsystems, engine-free fast slices.
+    "kv_transfer/test_p2p_registry.py": {
+        "test_registry_register_expire_and_leave"},
+    "models/test_gguf.py": {"test_reader_roundtrip"},
+    "models/test_qwen2_vl.py": {"test_mrope_positions_match_hf"},
 }
 
 
