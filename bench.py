@@ -370,6 +370,20 @@ def main() -> None:
     if not is_tpu and _PROBE_LOG:
         record["probe_log"] = _PROBE_LOG[-4:]
 
+    # Robustness overhead tracking: the fault-tolerance layer's counters
+    # ride every BENCH_*.json so a regression that starts tripping the
+    # watchdog (or burning pull retries) on the bench workload is
+    # visible next to the throughput it costs.
+    try:
+        rstats = engine.get_stats()
+        record["watchdog_timeouts"] = int(
+            rstats.get("watchdog_timeouts", 0))
+        record["kv_pull_retries"] = int(rstats.get("kv_pull_retries", 0))
+        record["kv_pull_failures"] = int(
+            rstats.get("kv_pull_failures", 0))
+    except Exception:  # noqa: BLE001 - diagnostic leg only
+        pass
+
     if is_tpu and not TINY:
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming

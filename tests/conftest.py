@@ -125,6 +125,13 @@ _SMOKE = {
         "test_registry_register_expire_and_leave"},
     "models/test_gguf.py": {"test_reader_roundtrip"},
     "models/test_qwen2_vl.py": {"test_mrope_positions_match_hf"},
+    # Fault-tolerance layer: the engine-free slices (scheduler watchdog
+    # unit + registry truncate survival) run in seconds.
+    "test_fault_tolerance.py": {
+        "test_watchdog_sweeps_stuck_remote_kv_hold",
+        "test_registry_truncate_does_not_kill_heartbeat",
+        "test_retry_policy_classification",
+    },
 }
 
 

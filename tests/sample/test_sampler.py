@@ -311,6 +311,54 @@ def test_spec_verify_greedy_rows_prefix_match():
     assert int(np.asarray(residual)[1, 1]) == 5
 
 
+def test_spec_verify_respects_topk_topp_truncation():
+    """A request's top-k/top-p/min-p truncation applies to the TARGET
+    in spec verification (ADVICE r5 high): a draft outside the
+    truncated support must never be accepted, and residual/bonus
+    emits must stay inside the support — matching the non-spec
+    sampler's distribution."""
+    import dataclasses
+
+    from vllm_distributed_tpu.sample.sampler import spec_verify_rejection
+    rng = np.random.default_rng(2)
+    V, S, K, temp = 16, 1, 16, 1.0
+    R, S1 = 4000, S + 1
+
+    target = rng.standard_normal(V).astype(np.float32)
+    top2 = set(np.argsort(target)[-2:].tolist())
+    # Drafter q: uniform over the WHOLE vocab — mostly outside the
+    # top_k=2 truncated target support.
+    q = np.full(V, 1.0 / V, np.float32)
+    drafts = rng.choice(V, size=(R, S), p=q).astype(np.int32)
+    q_ids = np.tile(np.arange(V, dtype=np.int32), (R, S, 1))
+    q_probs = np.tile(q, (R, S, 1))
+    logits = np.tile(target, (R, S1, 1))
+
+    md = dataclasses.replace(
+        _verify_md(R, S1, temp),
+        top_k=jnp.full((R, ), 2, jnp.int32))
+    accept, residual, bonus, _lpc, _lpb = spec_verify_rejection(
+        jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(q_ids),
+        jnp.asarray(q_probs), md)
+    accept = np.asarray(accept)
+    residual = np.asarray(residual)
+    bonus = np.asarray(bonus)
+
+    emitted = np.where(accept[:, 0], drafts[:, 0], residual[:, 0])
+    assert set(np.unique(emitted).tolist()) <= top2, \
+        "spec decode emitted a token outside the top-k support"
+    # Bonus tokens (rows whose draft was accepted) obey it too.
+    assert set(np.unique(bonus[accept[:, 0]]).tolist()) <= top2
+    # Accepted drafts are necessarily in-support.
+    assert set(np.unique(drafts[accept]).tolist()) <= top2
+    # The emitted distribution matches the truncated renormalized p.
+    p = np.exp(target) / np.exp(target).sum()
+    p_trunc = np.where(np.isin(np.arange(V), list(top2)), p, 0.0)
+    p_trunc /= p_trunc.sum()
+    freq = np.bincount(emitted, minlength=V) / R
+    np.testing.assert_allclose(freq, p_trunc, atol=0.03)
+
+
 def test_spec_verify_no_draft_rows_emit_plain_sample():
     """Rows with no drafts (all -1, zero q) reject at position 0 and the
     residual IS a plain tempered-target sample (q = 0 -> residual = p)."""

@@ -430,6 +430,59 @@ class ObservabilityConfig:
     collect_detailed_traces: bool = False
 
 
+@dataclass
+class FaultToleranceConfig:
+    """Timeouts and retry budgets for the fault-tolerance layer: the
+    scheduler's remote-KV watchdog, the KV-transfer retry policy, and
+    the engine-core health monitor. Degradation order for a failed
+    remote pull: retry the pull (bounded) -> local prefill recompute ->
+    request error; an unresponsive engine core fails pending requests
+    with EngineDeadError instead of blocking forever."""
+
+    # Watchdog: max seconds a request may sit in WAITING_FOR_REMOTE_KVS
+    # before the sweep fails the pull (0 disables the sweep).
+    kv_pull_timeout_s: float = 120.0
+    # Request-level pull retries before degrading to local recompute.
+    kv_pull_max_retries: int = 1
+    # Socket-level retry policy for one pull / registry call.
+    retry_max_attempts: int = 3
+    retry_base_delay_s: float = 0.05
+    retry_max_delay_s: float = 2.0
+    # Backstop (seconds) after which pages parked for a timed-out,
+    # still-in-flight pull are reclaimed even without a worker report.
+    # Safe regardless of transfer duration: the sweep issues a
+    # cancel_pull, and the worker discards (never applies) a transfer
+    # whose id was cancelled — the backstop only covers connectors/
+    # pulls that never report at all.
+    kv_pull_abandon_timeout_s: float = 240.0
+    # Engine-core liveness: heartbeat send period (0 disables the
+    # beater) and the staleness window after which the client declares
+    # the core dead. The window is deliberately generous by default —
+    # first-compile stalls are legitimate; tests tighten it.
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 300.0
+
+    def __post_init__(self) -> None:
+        if (self.kv_pull_timeout_s < 0 or self.heartbeat_interval_s < 0
+                or self.heartbeat_timeout_s < 0
+                or self.kv_pull_abandon_timeout_s < 0
+                or self.retry_base_delay_s < 0
+                or self.retry_max_delay_s < 0):
+            raise ValueError("fault-tolerance timeouts must be >= 0")
+        if self.kv_pull_max_retries < 0:
+            raise ValueError("kv_pull_max_retries must be >= 0")
+        if self.retry_max_attempts < 1:
+            # 0 would make every retried IO call fail without a single
+            # attempt ("no retries" is retry_max_attempts=1).
+            raise ValueError("retry_max_attempts must be >= 1")
+        if self.heartbeat_interval_s == 0 and self.heartbeat_timeout_s > 0:
+            # No beater -> the client-side staleness window would fire
+            # on any quiet-but-healthy stretch; disable it together.
+            logger.warning("heartbeat_interval_s=0 disables the beater; "
+                           "disabling heartbeat_timeout_s with it")
+            self.heartbeat_timeout_s = 0.0
+
+
 # ---------------------------------------------------------------------------
 # Aggregate
 # ---------------------------------------------------------------------------
@@ -455,6 +508,8 @@ class EngineConfig:
         default_factory=KVEventsConfig)
     observability_config: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    fault_tolerance_config: FaultToleranceConfig = field(
+        default_factory=FaultToleranceConfig)
 
     def __post_init__(self) -> None:
         # Clamp scheduler limits to the model context window once known,

@@ -253,6 +253,22 @@ class KVCacheManager:
         because preempted requests keep hashes for re-prefill)."""
         self.req_to_block_hashes.pop(request.request_id, None)
 
+    def transfer_ownership(self, old_id: str, new_id: str) -> None:
+        """Re-key a request's page ownership (scheduler watchdog: pages
+        of a timed-out-but-still-in-flight pull are parked under a
+        tombstone id until the worker reports, so the request can be
+        re-queued with fresh pages under its own id). Block hashes stay
+        with the original id — they describe the request's content, not
+        the parked pages."""
+        if old_id in self.req_to_blocks:
+            self.req_to_blocks[new_id] = self.req_to_blocks.pop(old_id)
+        if old_id in self.num_cached_block:
+            self.num_cached_block[new_id] = \
+                self.num_cached_block.pop(old_id)
+        if old_id in self._num_window_freed:
+            self._num_window_freed[new_id] = \
+                self._num_window_freed.pop(old_id)
+
     def get_block_ids(self, request_id: str) -> list[int]:
         # Window-freed slots keep a position-aligned placeholder id; the
         # attention window mask guarantees those positions are never
@@ -384,6 +400,15 @@ class TokenParallelKVCacheManager:
     def get_block_ids(self, request_id: str) -> list[int]:
         return self.managers[self.req_rank[request_id]].get_block_ids(
             request_id)
+
+    def transfer_ownership(self, old_id: str, new_id: str) -> None:
+        """Re-key page ownership within the owning rank's pool (see
+        KVCacheManager.transfer_ownership)."""
+        rank = self.req_rank.pop(old_id, None)
+        if rank is None:
+            return
+        self.managers[rank].transfer_ownership(old_id, new_id)
+        self.req_rank[new_id] = rank
 
     def reset_prefix_cache(self) -> bool:
         return all([m.reset_prefix_cache() for m in self.managers])

@@ -69,6 +69,22 @@ class EngineCoreOutput:
         return self.finish_reason is not None
 
 
+class _PageTombstone:
+    """Stand-in owner for pages of a timed-out remote-KV pull that may
+    still be written by an in-flight transfer: the watchdog re-keys the
+    pages to a tombstone so the request can re-queue with fresh pages
+    while the old ones stay out of the pool until the worker reports
+    (or the abandon backstop expires)."""
+
+    __slots__ = ("request_id", "tknp_rank", "expires_at")
+
+    def __init__(self, request_id: str, tknp_rank: Optional[int],
+                 expires_at: float) -> None:
+        self.request_id = request_id
+        self.tknp_rank = tknp_rank
+        self.expires_at = expires_at
+
+
 class Scheduler:
 
     def __init__(
@@ -196,9 +212,20 @@ class Scheduler:
         self.in_flight_req_ids: set[str] = set()
         self._deferred_finishes: dict[str, RequestStatus] = {}
 
+        # Remote-KV watchdog (fault-tolerance layer): requests held in
+        # WAITING_FOR_REMOTE_KVS past this deadline are swept into the
+        # failed-pull requeue path instead of hanging forever.
+        ft_cfg = config.fault_tolerance_config
+        self.kv_pull_timeout_s = ft_cfg.kv_pull_timeout_s
+        self.kv_pull_max_retries = ft_cfg.kv_pull_max_retries
+        self.kv_pull_abandon_timeout_s = ft_cfg.kv_pull_abandon_timeout_s
+
         # Stats for the metrics subsystem.
         self.num_scheduled_steps = 0
         self.num_preemptions = 0
+        self.watchdog_timeouts = 0
+        self.kv_pull_retries = 0
+        self.kv_pull_failures = 0
 
     # ------------------------------------------------------------------
     # Request intake / teardown
@@ -241,9 +268,15 @@ class Scheduler:
             elif request.status == RequestStatus.WAITING_FOR_REMOTE_KVS:
                 # The worker's pull is still in flight; keep the pages
                 # alive until it reports in, then free (see
-                # _update_kv_transfer_state).
+                # _update_kv_transfer_state). The abandon backstop
+                # covers this hold too — a silently-dropped pull for an
+                # aborted request must not leak its pages forever.
                 self.waiting_for_remote_kv.pop(req_id, None)
                 request.status = status
+                request.expires_at = (time.monotonic() +
+                                      self.kv_pull_abandon_timeout_s)
+                if self.kv_connector is not None:
+                    self.kv_connector.cancel_pull(req_id)
                 self.cancelled_remote_kv[req_id] = request
                 if self.structured_manager is not None:
                     self.structured_manager.remove_request(req_id)
@@ -550,6 +583,11 @@ class Scheduler:
                         self.kv_cache_manager.get_block_ids(
                             request.request_id),
                         num_external)
+                    # Monotonic: a wall-clock step (NTP, VM resume) must
+                    # not mass-fire the sweep or the abandon backstop.
+                    request.remote_kv_deadline = (
+                        time.monotonic() + self.kv_pull_timeout_s
+                        if self.kv_pull_timeout_s > 0 else None)
                     self.waiting_for_remote_kv[request.request_id] = request
                     continue
 
@@ -925,20 +963,15 @@ class Scheduler:
             if request is None:
                 continue
             # The span's pages were allocated but never written. Free
-            # everything and rejoin the queue as a fresh request: local
-            # prefill recomputes the whole prompt (the connector
-            # remembers the request and won't re-stage a pull). Freeing
-            # matters for ordering — keeping the unwritten span pages
-            # while re-running the prefix lookup could append
-            # later-cached prefix blocks AFTER them, corrupting the
-            # request's page order.
-            logger.warning(
-                "KV pull failed for %s; recomputing %d tokens locally",
-                req_id, request.num_external_computed_tokens)
+            # everything and rejoin the queue as a fresh request
+            # (retrying the pull or recomputing locally — see
+            # _handle_failed_pull). Freeing matters for ordering —
+            # keeping the unwritten span pages while re-running the
+            # prefix lookup could append later-cached prefix blocks
+            # AFTER them, corrupting the request's page order.
             self.kv_cache_manager.free(request)
-            request.num_computed_tokens = 0
-            request.num_external_computed_tokens = 0
-            self._requeue_after_hold(request)
+            self._handle_failed_pull(request, pull_resolved=True,
+                                     reason="worker reported pull failure")
         for req_id in (runner_output.finished_sending or ()):
             request = self.reqs_pending_send.pop(req_id, None)
             if request is not None:
@@ -966,6 +999,112 @@ class Scheduler:
                     del self.reqs_pending_send[req_id]
                     self.kv_cache_manager.free(request)
                     self.kv_cache_manager.free_block_hashes(request)
+        self._sweep_remote_kv_holds()
+
+    # ------------------------------------------------------------------
+    # Remote-KV watchdog (fault-tolerance layer)
+    # ------------------------------------------------------------------
+    def _sweep_remote_kv_holds(self) -> None:
+        """Per-step deadline sweep over WAITING_FOR_REMOTE_KVS: the
+        reference scheduler trusts the worker to eventually report every
+        pull, so a dropped transfer (or a connector whose admission-time
+        producer resolution failed after alloc) parks the request
+        forever. The sweep fails such holds through the same requeue
+        path as a worker-reported pull failure."""
+        # Connector-reported admission failures (e.g. P2P producer
+        # resolution failed after alloc): no pull was ever staged, so
+        # freeing the pages immediately is unconditionally safe.
+        if self.kv_connector is not None and self.waiting_for_remote_kv:
+            for req_id in self.kv_connector.take_alloc_failures():
+                request = self.waiting_for_remote_kv.pop(req_id, None)
+                if request is None:
+                    continue
+                self.kv_cache_manager.free(request)
+                self._handle_failed_pull(
+                    request, pull_resolved=True,
+                    reason="connector admission failure")
+        # Deadline sweep. A swept hold's pull may still be in flight at
+        # the worker, so its pages are parked, not freed.
+        if self.waiting_for_remote_kv and self.kv_pull_timeout_s > 0:
+            now = time.monotonic()
+            for req_id in list(self.waiting_for_remote_kv):
+                request = self.waiting_for_remote_kv[req_id]
+                deadline = request.remote_kv_deadline
+                if deadline is None or now <= deadline:
+                    continue
+                del self.waiting_for_remote_kv[req_id]
+                self.watchdog_timeouts += 1
+                self._park_timed_out_pages(request)
+                self._handle_failed_pull(
+                    request, pull_resolved=False,
+                    reason=f"no pull completion within "
+                           f"{self.kv_pull_timeout_s:.1f}s")
+        # Backstop: parked pages whose worker report never arrived are
+        # reclaimed once the abandon window expires. Safe against a
+        # late-but-live transfer because the sweep/abort issued a
+        # cancel_pull: the worker discards (never applies) a completed
+        # pull for a cancelled id, so after the cancel lands no write
+        # into these pages can happen (see DCNPullConnector.cancel_pull).
+        if self.cancelled_remote_kv:
+            now = time.monotonic()
+            for req_id in list(self.cancelled_remote_kv):
+                holder = self.cancelled_remote_kv[req_id]
+                expires = getattr(holder, "expires_at", None)
+                if expires is not None and now > expires:
+                    logger.warning(
+                        "parked pages for timed-out pull %s expired "
+                        "unreported; reclaiming", req_id)
+                    del self.cancelled_remote_kv[req_id]
+                    self.kv_cache_manager.free(holder)
+                    self.kv_cache_manager.free_block_hashes(holder)
+
+    def _park_timed_out_pages(self, request: Request) -> None:
+        """The timed-out hold's pull may still be in flight; a late
+        apply writes the pages allocated at admission, so they must stay
+        out of the pool until the worker reports. Ownership moves to a
+        tombstone registered in cancelled_remote_kv — the same
+        late-report protocol aborted holds use."""
+        tomb = _PageTombstone(
+            request_id=f"{request.request_id}#wd{self.watchdog_timeouts}",
+            tknp_rank=request.tknp_rank,
+            expires_at=time.monotonic() + self.kv_pull_abandon_timeout_s)
+        self.kv_cache_manager.transfer_ownership(request.request_id,
+                                                 tomb.request_id)
+        if self.kv_connector is not None:
+            # Tell the worker to DISCARD (never apply) this pull if it
+            # completes later: after the cancel lands, nothing can write
+            # the parked pages, so the abandon backstop's free is safe.
+            self.kv_connector.cancel_pull(request.request_id)
+        self.cancelled_remote_kv[request.request_id] = tomb
+
+    def _handle_failed_pull(self, request: Request, *, pull_resolved: bool,
+                            reason: str) -> None:
+        """Requeue after a failed/timed-out pull. Degradation order:
+        retry the remote pull (bounded, and only when the connector can
+        cleanly re-stage one — ``pull_resolved`` says no transfer for
+        this id can still be in flight), then local prefill recompute."""
+        self.kv_pull_failures += 1
+        request.num_computed_tokens = 0
+        request.num_external_computed_tokens = 0
+        request.remote_kv_deadline = None
+        retry = (request.kv_transfer_params is not None
+                 and request.num_kv_pull_retries < self.kv_pull_max_retries
+                 and self.kv_connector is not None
+                 and self.kv_connector.reset_for_retry(request,
+                                                       pull_resolved))
+        if retry:
+            request.num_kv_pull_retries += 1
+            self.kv_pull_retries += 1
+            logger.warning(
+                "KV pull for %s failed (%s); retrying pull %d/%d",
+                request.request_id, reason, request.num_kv_pull_retries,
+                self.kv_pull_max_retries)
+        else:
+            logger.warning(
+                "KV pull for %s failed (%s); degrading to local prefill "
+                "recompute", request.request_id, reason)
+            request.kv_transfer_params = None
+        self._requeue_after_hold(request)
 
     def _requeue_after_hold(self, request: Request) -> None:
         request.status = RequestStatus.WAITING
@@ -998,6 +1137,9 @@ class Scheduler:
             "num_waiting_reqs": len(self.waiting),
             "kv_cache_usage": self.kv_cache_manager.usage,
             "num_preemptions": self.num_preemptions,
+            "watchdog_timeouts": self.watchdog_timeouts,
+            "kv_pull_retries": self.kv_pull_retries,
+            "kv_pull_failures": self.kv_pull_failures,
             **self.kv_cache_manager.make_prefix_cache_stats(),
         }
         if self.tknp_size > 1:

@@ -76,6 +76,30 @@ class KVConnectorBase:
         (reference: nixl_connector.py:295)."""
         return False, None
 
+    def take_alloc_failures(self) -> set[str]:
+        """Drain request ids whose external load failed at/after
+        admission WITHOUT a pull ever being staged (e.g. producer
+        resolution failed after alloc). The scheduler's watchdog sweep
+        routes them through the failed-pull requeue path instead of
+        leaving them parked in WAITING_FOR_REMOTE_KVS forever."""
+        return set()
+
+    def reset_for_retry(self, request: "Request",
+                        pull_resolved: bool) -> bool:
+        """Scheduler asks whether a failed pull can be cleanly re-staged
+        at the request's next admission. ``pull_resolved`` is True when
+        the worker definitively reported the pull finished/failed (no
+        transfer for this id can still be in flight). Return False to
+        make the scheduler degrade to local prefill recompute."""
+        return False
+
+    def cancel_pull(self, req_id: str) -> None:
+        """Scheduler abandoned this request's in-flight pull (watchdog
+        timeout or abort): the worker side must DISCARD — never apply —
+        a transfer for this id that completes later, because the pages
+        it targeted will eventually be reclaimed. Async connectors ship
+        the cancel to the worker in their next metadata."""
+
     # ------------------------------------------------------------------
     # Worker side
     # ------------------------------------------------------------------

@@ -55,6 +55,8 @@ from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorBase, KVConnectorRole)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.request import Request
+from vllm_distributed_tpu.utils import fault_injection
+from vllm_distributed_tpu.utils.retry import RetryPolicy, call_with_retry
 
 logger = init_logger(__name__)
 
@@ -120,6 +122,10 @@ class DCNPullConnectorMetadata:
     # DONE lands, a late pull gets an error instead of silently reading
     # pages the scheduler may have reallocated to another request.
     register: list[_SendRegistration] = field(default_factory=list)
+    # Consumer: abandoned pulls (watchdog timeout / abort). The worker
+    # discards — never applies — a transfer for these ids that lands
+    # later: its target pages will eventually be reclaimed.
+    cancels: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -158,6 +164,15 @@ class DCNPullConnector(KVConnectorBase):
         self.is_consumer = kv_cfg.is_kv_consumer
         self.pull_host = extra.get("pull_host", "127.0.0.1")
         self.pull_port = int(extra.get("pull_port", 0))
+        ft_cfg = config.fault_tolerance_config
+        # Socket-level retry for one pull attempt (transient transport
+        # errors only; protocol errors surface as a failed pull).
+        self.retry_policy = RetryPolicy(
+            max_attempts=ft_cfg.retry_max_attempts,
+            base_delay_s=ft_cfg.retry_base_delay_s,
+            max_delay_s=ft_cfg.retry_max_delay_s)
+        # Stats: socket-level pull retries (tests/observability).
+        self.num_pull_retries = 0
 
         if role == KVConnectorRole.SCHEDULER:
             # ---- scheduler-side state ----
@@ -167,6 +182,7 @@ class DCNPullConnector(KVConnectorBase):
             self._staged_pulls: list[_PullInstruction] = []
             self._pulled: set[str] = set()
             self._staged_registrations: list[_SendRegistration] = []
+            self._staged_cancels: list[str] = []
             # Producer: finished requests' page counts (stats/tests).
             self.num_deferred_frees = 0
         else:
@@ -176,6 +192,15 @@ class DCNPullConnector(KVConnectorBase):
             self._finished_pulls: "queue.Queue[_FinishedPull]" = queue.Queue()
             # Pulls mid-way through the chunked apply (see get_finished).
             self._applying: list[_FinishedPull] = []
+            # Abandoned pulls: completed transfers for these ids are
+            # discarded instead of applied (their pages get reclaimed).
+            # Dict req_id -> monotonic expiry so entries whose transfer
+            # never reports (the watchdog's own trigger case) cannot
+            # accumulate forever on a long-lived consumer.
+            self._cancelled_pulls: dict[str, float] = {}
+            # Pulls that never started (injected drop): a cancel for
+            # one needs no discard entry — nothing will ever apply.
+            self._never_started: set[str] = set()
             # Stats: pages applied on the largest single step (tests).
             self.max_pages_applied_per_step = 0
             # Producer: currently-serveable deferred pages.
@@ -258,9 +283,33 @@ class DCNPullConnector(KVConnectorBase):
         if self._staged_registrations:
             meta.register = self._staged_registrations
             self._staged_registrations = []
+        if self._staged_cancels:
+            meta.cancels = self._staged_cancels
+            self._staged_cancels = []
         for req_id in scheduler_output.finished_req_ids:
             self._pulled.discard(req_id)
         return meta
+
+    def cancel_pull(self, req_id: str) -> None:
+        # A cancel for a pull still sitting in _staged_pulls (never
+        # shipped) can drop the instruction outright; otherwise the
+        # worker gets the discard order with the next metadata.
+        before = len(self._staged_pulls)
+        self._staged_pulls = [p for p in self._staged_pulls
+                              if p.req_id != req_id]
+        if len(self._staged_pulls) == before:
+            self._staged_cancels.append(req_id)
+
+    def reset_for_retry(self, request: Request,
+                        pull_resolved: bool) -> bool:
+        """A resolved pull (worker reported) can always be re-staged;
+        an UNRESOLVED one (watchdog timeout) cannot — a second pull
+        under the same wire id would alias the late worker report of
+        the first, so the scheduler degrades to local recompute."""
+        if not pull_resolved and request.request_id in self._pulled:
+            return False
+        self._pulled.discard(request.request_id)
+        return True
 
     def request_finished(
             self, request: Request,
@@ -387,73 +436,113 @@ class DCNPullConnector(KVConnectorBase):
     def start_load_kv(self, metadata, runner) -> None:
         if not isinstance(metadata, DCNPullConnectorMetadata):
             return
+        import time
         for reg in metadata.register:
             self._registrations[reg.req_id] = reg
+        for req_id in metadata.cancels:
+            if req_id in self._never_started:
+                self._never_started.discard(req_id)
+                continue
+            # Bounded retention: long past any plausible transfer
+            # lifetime the entry only leaks memory (the scheduler's
+            # abandon backstop reclaimed the pages far earlier).
+            self._cancelled_pulls[req_id] = time.monotonic() + 3600.0
+        if self._cancelled_pulls:
+            now = time.monotonic()
+            self._cancelled_pulls = {
+                rid: exp for rid, exp in self._cancelled_pulls.items()
+                if exp > now
+            }
         for pull in metadata.pulls:
+            if fault_injection.should_fire("kv_pull.drop"):
+                # Silent drop: no thread, no report — only the
+                # scheduler's watchdog sweep recovers the request.
+                logger.error("fault injection dropped KV pull for %s",
+                             pull.req_id)
+                self._never_started.add(pull.req_id)
+                continue
             threading.Thread(target=self._pull_worker,
                              args=(pull, runner),
                              name=f"dcn-pull-{pull.req_id}",
                              daemon=True).start()
 
     def _pull_worker(self, pull: _PullInstruction, runner) -> None:
-        """Background thread: socket IO only. Fetch the remote pages,
-        queue them for main-thread application, notify the producer."""
-        delivered = False
+        """Background thread: socket IO only. Fetch the remote pages
+        (with transient-error retry/backoff), queue them for main-thread
+        application, notify the producer."""
+        fault_injection.maybe_delay("kv_pull.delay")
+
+        def count_retry(attempt, delay, err) -> None:
+            self.num_pull_retries += 1
+
         try:
-            with socket.create_connection((pull.host, pull.port),
-                                          timeout=120.0) as sock:
-                _send_msg(sock, {"op": "pull",
-                                 "req_id": pull.remote_req_id,
-                                 "page_ids": pull.remote_page_ids})
-                reply = _recv_msg(sock)
-                if reply is None or not reply.get("ok"):
-                    raise RuntimeError(
-                        (reply or {}).get("error", "connection dropped"))
-                k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
-                    reply["k_shape"])
-                v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
-                    reply["v_shape"])
-                n = len(pull.local_page_ids)
-                if k.shape[1] < n:
-                    raise RuntimeError(
-                        f"producer served {k.shape[1]} pages, "
-                        f"consumer allocated {n}")
-                # Stage host->device ON THIS THREAD: the PCIe copy
-                # overlaps the main thread's compute, and the main
-                # thread's apply is then just the donated scatter.
-                try:
-                    k_s, v_s = page_io.stage_pages(runner, k[:, :n],
-                                                   v[:, :n])
-                except Exception as stage_err:  # noqa: BLE001
-                    logger.warning(
-                        "KV pull for %s: device staging failed (%s); "
-                        "host fallback", pull.req_id, stage_err)
-                    k_s, v_s = page_io.stage_pages(runner, k[:, :n],
-                                                   v[:, :n],
-                                                   on_device=False)
-                self._finished_pulls.put(
-                    _FinishedPull(req_id=pull.req_id,
-                                  page_ids=pull.local_page_ids,
-                                  k=k_s, v=v_s))
-                delivered = True
-                _send_msg(sock, {"op": "done",
-                                 "req_id": pull.remote_req_id})
-                _recv_msg(sock)  # ack
+            k_s, v_s = call_with_retry(
+                lambda: self._fetch_and_stage(pull, runner),
+                policy=self.retry_policy,
+                description=f"KV pull for {pull.req_id}",
+                on_retry=count_retry)
         except Exception as e:  # noqa: BLE001 - surfaced via error pull
-            if delivered:
-                # The pages landed; only the producer's DONE handshake
-                # failed (it expires the registration on its own). A
-                # second, errored report for the same request would
-                # double-handle it (resume AND local recompute).
-                logger.warning(
-                    "KV pull for %s: done-notification failed after a "
-                    "successful transfer: %s", pull.req_id, e)
-                return
             logger.error("KV pull for %s failed: %s", pull.req_id, e)
             self._finished_pulls.put(
                 _FinishedPull(req_id=pull.req_id,
                               page_ids=pull.local_page_ids,
                               k=None, v=None, error=str(e)))
+            return
+        self._finished_pulls.put(
+            _FinishedPull(req_id=pull.req_id,
+                          page_ids=pull.local_page_ids,
+                          k=k_s, v=v_s))
+        # The pages landed; a failed DONE handshake is only a deferred
+        # producer free (its registration expires on its own), never an
+        # errored pull — a second, errored report for the same request
+        # would double-handle it (resume AND local recompute).
+        try:
+            with socket.create_connection((pull.host, pull.port),
+                                          timeout=120.0) as sock:
+                _send_msg(sock, {"op": "done",
+                                 "req_id": pull.remote_req_id})
+                _recv_msg(sock)  # ack
+        except Exception as e:  # noqa: BLE001 - deferred-free only
+            logger.warning(
+                "KV pull for %s: done-notification failed after a "
+                "successful transfer: %s", pull.req_id, e)
+
+    def _fetch_and_stage(self, pull: _PullInstruction, runner):
+        """One pull attempt: fetch the remote pages and stage them for
+        the main thread's donated scatter. Transient socket errors
+        propagate as OSError (retried by the caller's policy); protocol
+        rejections raise RuntimeError (fatal — e.g. the producer's
+        registration expired, so retrying cannot help)."""
+        with socket.create_connection((pull.host, pull.port),
+                                      timeout=120.0) as sock:
+            _send_msg(sock, {"op": "pull",
+                             "req_id": pull.remote_req_id,
+                             "page_ids": pull.remote_page_ids})
+            reply = _recv_msg(sock)
+            if reply is None:
+                raise ConnectionResetError("connection dropped mid-pull")
+            if not reply.get("ok"):
+                raise RuntimeError(reply.get("error", "pull rejected"))
+            k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
+                reply["k_shape"])
+            v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
+                reply["v_shape"])
+            n = len(pull.local_page_ids)
+            if k.shape[1] < n:
+                raise RuntimeError(
+                    f"producer served {k.shape[1]} pages, "
+                    f"consumer allocated {n}")
+            # Stage host->device ON THIS THREAD: the PCIe copy overlaps
+            # the main thread's compute, and the main thread's apply is
+            # then just the donated scatter.
+            try:
+                return page_io.stage_pages(runner, k[:, :n], v[:, :n])
+            except Exception as stage_err:  # noqa: BLE001
+                logger.warning(
+                    "KV pull for %s: device staging failed (%s); "
+                    "host fallback", pull.req_id, stage_err)
+                return page_io.stage_pages(runner, k[:, :n], v[:, :n],
+                                           on_device=False)
 
     # ==================================================================
     # Worker side: main-thread device access
@@ -514,6 +603,18 @@ class DCNPullConnector(KVConnectorBase):
         pages_this_step = 0
         still_applying: list[_FinishedPull] = []
         for done in self._applying:
+            if done.req_id in self._cancelled_pulls:
+                # Abandoned by the scheduler (watchdog timeout/abort):
+                # the target pages will be reclaimed, so the transfer
+                # must never touch them. Discard and report, so the
+                # scheduler can free the parked pages promptly.
+                self._cancelled_pulls.pop(done.req_id, None)
+                logger.warning(
+                    "discarding completed pull for cancelled request %s "
+                    "(%d pages, applied %d before the cancel landed)",
+                    done.req_id, len(done.page_ids), done.applied)
+                finished_recving.add(done.req_id)
+                continue
             if done.error is not None:
                 logger.error(
                     "request %s: external KV unavailable (%s); span will "

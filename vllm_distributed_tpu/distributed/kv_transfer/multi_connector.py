@@ -82,6 +82,22 @@ class MultiConnector(KVConnectorBase):
                 params = child_params
         return defer, params
 
+    def take_alloc_failures(self) -> set[str]:
+        failed: set[str] = set()
+        for child in self.children:
+            failed |= child.take_alloc_failures()
+        return failed
+
+    def reset_for_retry(self, request, pull_resolved: bool) -> bool:
+        owner = self._owner.pop(request.request_id, None)
+        if owner is None:
+            return False
+        return owner.reset_for_retry(request, pull_resolved)
+
+    def cancel_pull(self, req_id: str) -> None:
+        for child in self.children:
+            child.cancel_pull(req_id)
+
     # -- worker side ----------------------------------------------------
     def start_load_kv(self, metadata, runner) -> None:
         for child, meta in zip(self.children, metadata or []):

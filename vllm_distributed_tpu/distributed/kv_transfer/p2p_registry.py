@@ -33,8 +33,11 @@ import msgpack
 from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorRole)
 from vllm_distributed_tpu.distributed.kv_transfer.dcn_pull import (
-    DCNPullConnector, _recv_msg, _send_msg)
+    _LEN, DCNPullConnector, _recv_msg, _send_msg)
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import fault_injection
+from vllm_distributed_tpu.utils.retry import (RetryBudgetExceeded,
+                                              RetryPolicy, call_with_retry)
 
 logger = init_logger(__name__)
 
@@ -90,6 +93,13 @@ class P2PRegistryServer:
                 if msg is None:
                     return
                 op = msg.get("op")
+                if fault_injection.should_fire("registry.truncate"):
+                    # Malformed response: a correct length prefix whose
+                    # payload is not msgpack (0xc1 is reserved), so the
+                    # client's decoder raises — the failure mode a
+                    # half-written proxy response produces.
+                    conn.sendall(_LEN.pack(4) + b"\xc1\xc1\xc1\xc1")
+                    continue
                 if op == "register":
                     ttl = float(msg.get("ttl", 10.0))
                     with self._lock:
@@ -129,21 +139,33 @@ class P2PRegistryClient:
     calls are rare and short; liveness rides the heartbeat TTL)."""
 
     def __init__(self, registry_addr: str, instance_id: str,
-                 role: str, ttl: float = 10.0) -> None:
+                 role: str, ttl: float = 10.0,
+                 retry_policy: Optional[RetryPolicy] = None) -> None:
         host, port = registry_addr.rsplit(":", 1)
         self._addr = (host, int(port))
         self.instance_id = instance_id
         self.role = role
         self.ttl = ttl
+        # Registry calls are control-plane: retry transient socket
+        # errors briefly, then let the caller's fallback (TTL expiry,
+        # local prefill) decide.
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.5)
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
         self._my_addr: Optional[tuple[str, int]] = None
 
-    def _call(self, msg: dict, timeout: float = 5.0) -> dict:
+    def _call_once(self, msg: dict, timeout: float = 5.0) -> dict:
         with socket.create_connection(self._addr, timeout=timeout) as s:
             _send_msg(s, msg)
             resp = _recv_msg(s)
             return resp or {"ok": False, "error": "closed"}
+
+    def _call(self, msg: dict, timeout: float = 5.0) -> dict:
+        return call_with_retry(
+            lambda: self._call_once(msg, timeout),
+            policy=self.retry_policy,
+            description=f"registry {msg.get('op')}")
 
     def register(self, addr: tuple[str, int],
                  heartbeat: bool = True) -> None:
@@ -158,20 +180,31 @@ class P2PRegistryClient:
             self._hb.start()
 
     def _heartbeat_loop(self) -> None:
+        # Catch EVERYTHING except the stop signal: one malformed
+        # response (msgpack decode error on a truncated payload) must
+        # not permanently end heartbeating — the instance would expire
+        # from the registry while still alive and consumers would stop
+        # routing to it (ADVICE r5).
         while not self._stop.wait(self.ttl / 3.0):
+            if fault_injection.should_fire("heartbeat.stall"):
+                continue  # injected stall: skip this beat
             try:
                 self._call({"op": "register",
                             "instance": self.instance_id,
                             "role": self.role,
                             "addr": list(self._my_addr),
                             "ttl": self.ttl})
-            except OSError:
-                pass  # registry briefly unreachable; TTL decides
+            except Exception as e:  # noqa: BLE001 - keep beating
+                logger.warning(
+                    "registry heartbeat for %s failed (%s); retrying "
+                    "next interval", self.instance_id, e)
 
     def list(self, role: Optional[str] = None) -> dict[str, dict]:
         try:
             resp = self._call({"op": "list", "role": role})
-        except OSError:
+        except Exception as e:  # noqa: BLE001 - degrade to "nobody home"
+            logger.warning("registry list failed (%s); treating as empty",
+                           e)
             return {}
         return resp.get("instances", {})
 
@@ -186,8 +219,11 @@ class P2PRegistryClient:
         try:
             self._call({"op": "deregister",
                         "instance": self.instance_id})
-        except OSError:
-            pass
+        except Exception as e:  # noqa: BLE001 - best-effort teardown;
+            # a malformed response must not abort engine shutdown (the
+            # TTL expires the registration anyway).
+            logger.warning("registry deregister for %s failed (%s)",
+                           self.instance_id, e)
 
 
 class P2PDcnConnector(DCNPullConnector):
@@ -211,7 +247,12 @@ class P2PDcnConnector(DCNPullConnector):
             extra.get("instance_id", f"{my_role}-{os.getpid()}"))
         self.registry = P2PRegistryClient(
             registry_addr, self.instance_id, my_role,
-            ttl=float(extra.get("registry_ttl", 10.0)))
+            ttl=float(extra.get("registry_ttl", 10.0)),
+            retry_policy=self.retry_policy)
+        # Scheduler side: requests whose producer resolution failed
+        # AFTER pages were allocated (drained by the scheduler's
+        # watchdog sweep into the failed-pull requeue path).
+        self._alloc_failed: set[str] = set()
         if role == KVConnectorRole.WORKER and self.is_producer:
             # _start_server (super().__init__) bound the page server;
             # join under its address and keep the membership alive.
@@ -250,12 +291,18 @@ class P2PDcnConnector(DCNPullConnector):
             addr = self.registry.resolve(str(params["remote_instance"]))
             if addr is None:
                 # Producer left between finish and pull: fall back to
-                # local prefill by leaving the params invalid.
+                # local prefill. The scheduler has already parked the
+                # request in WAITING_FOR_REMOTE_KVS and no worker
+                # report will ever arrive, so SURFACE the failure
+                # (take_alloc_failures) instead of only nulling the
+                # params — silent nulling left the request parked
+                # forever (ADVICE r5).
                 logger.warning(
                     "producer instance %r not in registry; request %s "
                     "recomputes locally", params["remote_instance"],
                     request.request_id)
                 request.kv_transfer_params = None
+                self._alloc_failed.add(request.request_id)
                 return
             params["pull_host"], params["pull_port"] = addr[0], addr[1]
         super().update_state_after_alloc(request, block_ids,
@@ -269,6 +316,10 @@ class P2PDcnConnector(DCNPullConnector):
             # consumers need no static peer config).
             params["remote_instance"] = self.instance_id
         return defer, params
+
+    def take_alloc_failures(self) -> set[str]:
+        failed, self._alloc_failed = self._alloc_failed, set()
+        return failed
 
     def get_num_new_matched_tokens(self, request, num_computed_tokens):
         params = request.kv_transfer_params

@@ -27,6 +27,7 @@ from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorBase, KVConnectorRole)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.request import Request
+from vllm_distributed_tpu.utils.retry import RetryPolicy, call_with_retry
 
 logger = init_logger(__name__)
 
@@ -68,6 +69,13 @@ class SharedStorageConnector(KVConnectorBase):
         self.block_size = config.cache_config.block_size
         self.is_producer = config.kv_transfer_config.is_kv_producer
         self.is_consumer = config.kv_transfer_config.is_kv_consumer
+        # Transient filesystem errors (NFS hiccups on a genuinely shared
+        # directory) retry briefly; persistent failures surface.
+        ft_cfg = config.fault_tolerance_config
+        self.retry_policy = RetryPolicy(
+            max_attempts=ft_cfg.retry_max_attempts,
+            base_delay_s=ft_cfg.retry_base_delay_s,
+            max_delay_s=ft_cfg.retry_max_delay_s)
 
         # Scheduler-side state.
         self._reqs: dict[str, Request] = {}
@@ -83,6 +91,16 @@ class SharedStorageConnector(KVConnectorBase):
     # ------------------------------------------------------------------
     def _file(self, hash_hex: str) -> str:
         return os.path.join(self.path, f"{hash_hex}.npz")
+
+    def _read_page_file(self, key: str):
+        with np.load(self._file(key)) as f:
+            return f["k"], f["v"]
+
+    def _write_page_file(self, key: str, k_np, v_np) -> None:
+        tmp = self._file(key) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, k=k_np, v=v_np)
+        os.replace(tmp, self._file(key))
 
     # ------------------------------------------------------------------
     # Scheduler side
@@ -194,9 +212,12 @@ class SharedStorageConnector(KVConnectorBase):
         for load in metadata.loads:
             ks, vs = [], []
             for key in load.hashes:
-                with np.load(self._file(key)) as f:
-                    ks.append(f["k"])
-                    vs.append(f["v"])
+                k_arr, v_arr = call_with_retry(
+                    lambda key=key: self._read_page_file(key),
+                    policy=self.retry_policy,
+                    description=f"KV page load {key[:12]}")
+                ks.append(k_arr)
+                vs.append(v_arr)
             # Files hold [L, KVH, PS, D] per page; stack to wire layout
             # [L, n, KVH, PS, D].
             page_io.scatter_pages(runner, load.page_ids,
@@ -218,10 +239,11 @@ class SharedStorageConnector(KVConnectorBase):
             k_np, v_np = page_io.gather_pages(
                 runner, [pid for pid, _ in todo])
             for i, (_, key) in enumerate(todo):
-                tmp = self._file(key) + f".tmp{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    np.savez(f, k=k_np[:, i], v=v_np[:, i])
-                os.replace(tmp, self._file(key))
+                call_with_retry(
+                    lambda i=i, key=key: self._write_page_file(
+                        key, k_np[:, i], v_np[:, i]),
+                    policy=self.retry_policy,
+                    description=f"KV page save {key[:12]}")
             self.num_pages_saved += len(todo)
             logger.info("saved %d KV pages for %s", len(todo),
                         save.req_id)

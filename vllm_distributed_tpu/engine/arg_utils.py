@@ -9,7 +9,9 @@ from dataclasses import dataclass, fields
 from typing import Optional
 
 from vllm_distributed_tpu.config import (CacheConfig, DeviceConfig,
-                                         EngineConfig, KVEventsConfig,
+                                         EngineConfig,
+                                         FaultToleranceConfig,
+                                         KVEventsConfig,
                                          KVTransferConfig, LoadConfig,
                                          LoRAConfig, ModelConfig,
                                          ObservabilityConfig,
@@ -77,6 +79,12 @@ class EngineArgs:
     kv_connector_extra_config: Optional[dict] = None
 
     otlp_traces_endpoint: Optional[str] = None
+
+    # Fault tolerance: remote-KV watchdog + engine health monitor.
+    kv_pull_timeout_s: float = 120.0
+    kv_pull_max_retries: int = 1
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 300.0
 
     # KV cache event publishing (external prefix-aware routers).
     enable_kv_cache_events: bool = False
@@ -158,6 +166,12 @@ class EngineArgs:
             ),
             observability_config=ObservabilityConfig(
                 otlp_traces_endpoint=self.otlp_traces_endpoint),
+            fault_tolerance_config=FaultToleranceConfig(
+                kv_pull_timeout_s=self.kv_pull_timeout_s,
+                kv_pull_max_retries=self.kv_pull_max_retries,
+                heartbeat_interval_s=self.heartbeat_interval_s,
+                heartbeat_timeout_s=self.heartbeat_timeout_s,
+            ),
         )
 
     # ------------------------------------------------------------------

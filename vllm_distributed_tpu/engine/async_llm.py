@@ -101,6 +101,11 @@ class AsyncLLM:
             try:
                 item = self.core.output_queue.get(timeout=timeout_s)
             except queue.Empty:
+                # Nothing arrived: make sure that is "idle", not "the
+                # core thread is dead/wedged" (health monitor raises
+                # EngineDeadError; the pump then fails pending
+                # requests instead of blocking forever).
+                self.core.check_health()
                 return None
             if isinstance(item, Exception):
                 raise item
@@ -123,7 +128,13 @@ class AsyncLLM:
                 self.request_queues.pop(ro.request_id, None)
 
     def _fail_all(self, err: Exception) -> None:
+        # Pending requests always surface a STRUCTURED EngineDeadError
+        # (the OpenAI server maps it to 503 + detail), whatever the
+        # core's terminal exception actually was.
+        if not isinstance(err, EngineDeadError):
+            err = EngineDeadError(f"{type(err).__name__}: {err}")
         self._dead_error = err
+        self.output_processor.stats.num_engine_deaths += 1
         logger.error("engine core died: %s", err)
         for q in self.request_queues.values():
             q.put_nowait(err)

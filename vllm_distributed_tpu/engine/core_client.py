@@ -9,6 +9,7 @@ in-process client wraps EngineCore directly (CPU tests, offline runs).
 
 import os
 import tempfile
+import time
 import uuid
 from typing import Optional
 
@@ -21,7 +22,19 @@ logger = init_logger(__name__)
 
 
 class EngineDeadError(RuntimeError):
-    """The engine core process died (reference: v1/engine/exceptions.py)."""
+    """The engine core died or stopped responding (reference:
+    v1/engine/exceptions.py EngineDeadError). Structured: ``reason``
+    carries the detection detail and ``replica`` the DP rank it came
+    from (None for a single-core engine), so the OpenAI server can
+    surface both in its 503 body."""
+
+    def __init__(self, reason: str = "engine core is dead",
+                 replica: Optional[int] = None) -> None:
+        self.reason = reason
+        self.replica = replica
+        detail = (f"[dp replica {replica}] {reason}"
+                  if replica is not None else reason)
+        super().__init__(detail)
 
 
 class EngineCoreClient:
@@ -158,6 +171,13 @@ class SyncMPClient(EngineCoreClient):
         self._pending_outputs: list[list[EngineCoreOutput]] = []
         # Utility-RPC results stashed by recv_outputs (async/pump mode).
         self._results: dict[int, object] = {}
+        # Health monitor: every received message (including the core's
+        # dedicated heartbeat beats) refreshes liveness; a stale window
+        # with work in flight means the core process is wedged even
+        # though the OS still reports it alive.
+        self.heartbeat_timeout_s = \
+            config.fault_tolerance_config.heartbeat_timeout_s
+        self._last_alive = time.monotonic()
 
     # ------------------------------------------------------------------
     def _kill(self) -> None:
@@ -177,11 +197,29 @@ class SyncMPClient(EngineCoreClient):
             if not self.output_sock.poll(deadline_poll):
                 if not self.proc.is_alive():
                     raise EngineDeadError("engine core process died")
+                self._check_heartbeat()
                 return None
             msg = self._serial.unpack(self.output_sock.recv(zmq.NOBLOCK))
+            self._last_alive = time.monotonic()
             if msg.get("t") == "dead":
                 raise EngineDeadError(msg.get("error", "engine core died"))
+            if msg.get("t") == "hb":
+                # Liveness beat only; nothing for the caller.
+                return None
             return msg
+
+    def _check_heartbeat(self) -> None:
+        """Wedged-process detection: the core's heartbeat thread beats
+        through long compiles, so staleness past the window with work in
+        flight means the process is hung, not slow."""
+        if self.heartbeat_timeout_s <= 0 or not self._live:
+            return
+        stale = time.monotonic() - self._last_alive
+        if stale > self.heartbeat_timeout_s:
+            raise EngineDeadError(
+                f"engine core unresponsive for {stale:.1f}s (heartbeat "
+                f"window {self.heartbeat_timeout_s:.1f}s) with requests "
+                f"in flight")
 
     # ------------------------------------------------------------------
     def _mark_finished(self, outs: list[EngineCoreOutput]) -> None:
@@ -259,11 +297,16 @@ class SyncMPClient(EngineCoreClient):
         call_id = self._call_id
         self._send({"t": "call", "method": method, "args": list(args),
                     "call_id": call_id})
-        deadline_ms = int(envs.VDT_RPC_TIMEOUT * 1000)
+        deadline = time.monotonic() + envs.VDT_RPC_TIMEOUT
         while True:
-            msg = self._recv(timeout_ms=deadline_ms)
-            if msg is None:
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0:
                 raise EngineDeadError(f"RPC {method} timed out")
+            # Bounded polls: heartbeat beats and output batches arrive
+            # between polls without consuming the whole RPC budget.
+            msg = self._recv(timeout_ms=min(remaining_ms, 1000))
+            if msg is None:
+                continue
             if msg["t"] == "result" and msg["call_id"] == call_id:
                 if msg.get("error") is not None:
                     raise RuntimeError(

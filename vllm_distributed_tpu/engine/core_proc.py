@@ -19,6 +19,7 @@ import zmq
 from vllm_distributed_tpu.engine import serial
 from vllm_distributed_tpu.engine.core import EngineCore
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import fault_injection
 
 logger = init_logger(__name__)
 
@@ -40,12 +41,24 @@ def run_engine_core(config, input_addr: str, output_addr: str) -> None:
     out.connect(output_addr)
 
     core = None
+    hb_stop = threading.Event()
     try:
         core = EngineCore(config)
         out.send(serial.pack({
             "t": "ready",
             "num_pages": config.cache_config.num_gpu_blocks,
         }))
+        # Liveness heartbeat on its own thread + its own PUSH socket
+        # (zmq sockets are not thread-safe; multiple PUSH sockets may
+        # connect to one PULL endpoint). It keeps beating through long
+        # compiles — XLA releases the GIL — so the client's staleness
+        # window only fires when the whole process is wedged or dead.
+        interval = config.fault_tolerance_config.heartbeat_interval_s
+        if interval > 0:
+            threading.Thread(target=_heartbeat_loop,
+                             args=(ctx, output_addr, interval, hb_stop),
+                             name="engine-core-heartbeat",
+                             daemon=True).start()
         _busy_loop(core, inp, out)
     except _Shutdown:
         pass
@@ -61,11 +74,42 @@ def run_engine_core(config, input_addr: str, output_addr: str) -> None:
         except Exception:
             pass
     finally:
+        hb_stop.set()
         if core is not None:
             core.shutdown()
         inp.close(linger=0)
         out.close(linger=0)
         ctx.term()
+
+
+def _heartbeat_loop(ctx: zmq.Context, output_addr: str, interval: float,
+                    stop: threading.Event) -> None:
+    """Liveness beats to the client (reference analogue: the reference
+    core's EngineCoreProc monitor thread / process liveness checks)."""
+    sock = ctx.socket(zmq.PUSH)
+    # Bounded send: a PUSH with no live peer blocks forever by default,
+    # which would wedge this thread (and ctx.term) after parent death.
+    sock.setsockopt(zmq.SNDTIMEO, 1000)
+    sock.connect(output_addr)
+    try:
+        while not stop.wait(interval):
+            if fault_injection.should_fire("heartbeat.stall"):
+                continue  # injected stall: skip this beat
+            try:
+                sock.send(serial.pack({"t": "hb", "ts": time.time()}))
+            except zmq.Again:
+                # Transient: the client hasn't drained in a while (idle
+                # sync user) and the HWM is full. Keep beating — exiting
+                # here would later declare a HEALTHY core dead on its
+                # first legitimate long stall.
+                continue
+            except zmq.ZMQError:
+                return  # terminal (ctx terminated / socket closed)
+    finally:
+        try:
+            sock.close(linger=0)
+        except Exception:  # noqa: BLE001 - teardown race with ctx.term
+            pass
 
 
 def _try_add(core: EngineCore, req):
@@ -134,6 +178,7 @@ def _busy_loop(core: EngineCore, inp: zmq.Socket, out: zmq.Socket) -> None:
     poller = zmq.Poller()
     poller.register(inp, zmq.POLLIN)
     while True:
+        fault_injection.fire_or_raise("engine_core.die")
         busy = (core.has_unfinished_requests()
                 or core.has_kv_transfer_work())
         timeout = 0 if busy else _IDLE_POLL_MS
@@ -180,11 +225,27 @@ class BackgroundEngineCore:
                                         name="engine-core")
         self._thread.start()
 
+    def check_health(self) -> None:
+        """Raise EngineDeadError when the core thread died without
+        reporting its error (reference: v1 core_client engine-dead
+        detection; here the thread-transport analogue). No staleness
+        window for the thread transport: in-process, a wedged step is
+        indistinguishable from a legitimate long first compile (the
+        subprocess transport gets stall detection from its dedicated
+        heartbeat thread, which keeps beating through compiles)."""
+        from vllm_distributed_tpu.engine.core_client import EngineDeadError
+        if self._dead:
+            return  # the terminal error is already in the output queue
+        if not self._thread.is_alive():
+            raise EngineDeadError(
+                "engine core thread exited without reporting")
+
     def _run(self) -> None:
         try:
             has_kv_connector = \
                 self.core.scheduler.kv_connector is not None
             while True:
+                fault_injection.fire_or_raise("engine_core.die")
                 busy = (self.core.has_unfinished_requests()
                         or self.core.has_kv_transfer_work())
                 block = not busy

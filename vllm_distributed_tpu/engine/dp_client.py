@@ -27,12 +27,21 @@ from typing import Optional
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
 from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
+                                                     EngineDeadError,
                                                      InprocClient,
                                                      SyncMPClient)
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.request import EngineCoreRequest
 
 logger = init_logger(__name__)
+
+
+def _tag_replica(e: EngineDeadError, rank: int) -> EngineDeadError:
+    """Re-raise a child client's death with its DP rank attached so the
+    front-end (and the server's 503 body) can say WHICH replica died."""
+    if e.replica is not None:
+        return e
+    return EngineDeadError(getattr(e, "reason", str(e)), replica=rank)
 
 
 def make_replica_config(config: EngineConfig, rank: int) -> EngineConfig:
@@ -112,13 +121,15 @@ class DPEngineClient(EngineCoreClient):
         self._live[i].add(request.request_id)
         try:
             self.clients[i].add_request(request)
-        except Exception:
+        except Exception as e:
             # Unwind the admission accounting (route() already
             # incremented the coordinator's count).
             self._owner.pop(request.request_id, None)
             self._live[i].discard(request.request_id)
             if self.coordinator is not None:
                 self.coordinator.report(i, -1)
+            if isinstance(e, EngineDeadError):
+                raise _tag_replica(e, i) from e
             raise
 
     def abort_requests(self, request_ids: list[str]) -> None:
@@ -166,7 +177,10 @@ class DPEngineClient(EngineCoreClient):
             for i, client in enumerate(self.clients):
                 if not self._live[i]:
                     continue
-                batch = client.recv_outputs(timeout_ms=20)
+                try:
+                    batch = client.recv_outputs(timeout_ms=20)
+                except EngineDeadError as e:
+                    raise _tag_replica(e, i) from e
                 if batch:
                     outs.extend(batch)
             if outs:
@@ -181,8 +195,11 @@ class DPEngineClient(EngineCoreClient):
         assert self.is_mp, "recv_outputs requires subprocess replicas"
         per = max(timeout_ms // len(self.clients), 1)
         outs: list[EngineCoreOutput] = []
-        for client in self.clients:
-            batch = client.recv_outputs(timeout_ms=per)
+        for i, client in enumerate(self.clients):
+            try:
+                batch = client.recv_outputs(timeout_ms=per)
+            except EngineDeadError as e:
+                raise _tag_replica(e, i) from e
             if batch:
                 outs.extend(batch)
         self._mark_finished(outs)

@@ -35,9 +35,14 @@ def _error_response(e: Exception) -> web.Response:
     if isinstance(e, RequestError):
         return web.json_response(e.json(), status=e.code)
     if isinstance(e, EngineDeadError):
-        return web.json_response(
-            {"error": {"message": str(e), "type": "internal_server_error",
-                       "code": 500}}, status=500)
+        # 503: the engine is gone/unresponsive — a load balancer should
+        # stop routing here; the structured detail says why (and which
+        # DP replica, when one died).
+        detail = {"message": str(e), "type": "engine_unavailable",
+                  "code": 503}
+        if getattr(e, "replica", None) is not None:
+            detail["replica"] = e.replica
+        return web.json_response({"error": detail}, status=503)
     if isinstance(e, ValueError):
         # Admission-time validation (processor rejects) is the client's
         # fault: 400, matching the reference server's error mapping.
@@ -71,7 +76,8 @@ async def _auth_middleware_factory(app, handler):
 async def health(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
     if engine.errored:
-        return web.Response(status=500, text="engine dead")
+        return web.Response(status=503,
+                            text=f"engine dead: {engine.dead_error}")
     return web.Response(text="OK")
 
 

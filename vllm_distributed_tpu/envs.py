@@ -118,6 +118,13 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # kept for CLI parity).
     "VDT_NO_USAGE_STATS":
     lambda: os.getenv("VDT_NO_USAGE_STATS", "1") == "1",
+    # Deterministic fault injection: "name:rate[@delay_s],..." over the
+    # named fault points of utils/fault_injection.py (kv_pull.drop,
+    # kv_pull.delay, registry.truncate, engine_core.die,
+    # heartbeat.stall). Read at process start (spawned engine cores
+    # inherit it); "" disables. Robustness drills/tests only.
+    "VDT_FAULT_INJECT":
+    lambda: os.getenv("VDT_FAULT_INJECT", ""),
 }
 
 

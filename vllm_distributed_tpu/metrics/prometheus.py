@@ -35,6 +35,15 @@ _COUNTERS = {
         "Cumulative accepted draft tokens"),
     "spec_num_drafts": ("vdt:spec_decode_num_drafts_total",
                         "Cumulative draft proposals"),
+    # Fault-tolerance layer (scheduler watchdog + KV-pull retry).
+    "watchdog_timeouts": ("vdt:watchdog_timeouts_total",
+                          "Requests swept out of WAITING_FOR_REMOTE_KVS "
+                          "by the watchdog deadline"),
+    "kv_pull_retries": ("vdt:kv_pull_retries_total",
+                        "Request-level remote-KV pull retries"),
+    "kv_pull_failures": ("vdt:kv_pull_failures_total",
+                         "Failed remote-KV pulls (each requeued for "
+                         "retry or local recompute)"),
 }
 
 

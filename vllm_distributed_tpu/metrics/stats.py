@@ -76,6 +76,9 @@ class FrontendStats:
     num_prompt_tokens: int = 0
     num_generation_tokens: int = 0
     num_finished: int = 0
+    # Engine-core death/restart events detected by the health monitor
+    # (AsyncLLM increments when it fails pending requests).
+    num_engine_deaths: int = 0
     # Periodic logging window (LoggingStatLogger equivalent).
     _window_start: float = field(default_factory=time.monotonic)
     _window_gen_tokens: int = 0
@@ -138,7 +141,27 @@ class FrontendStats:
              self.num_generation_tokens),
             ("vdt:request_success_total",
              "Cumulative finished requests", self.num_finished),
+            ("vdt:engine_restarts_total",
+             "Engine-core death/restart events detected by the health "
+             "monitor", self.num_engine_deaths),
         ):
             lines += [f"# HELP {name} {help_text}",
                       f"# TYPE {name} counter", f"{name} {value}"]
+        lines += render_fault_injections()
         return "\n".join(lines) + "\n"
+
+
+def render_fault_injections() -> list[str]:
+    """Per-fault-point fire counters (empty when no faults configured),
+    so robustness drills show up on the same /metrics scrape as their
+    effects."""
+    from vllm_distributed_tpu.utils import fault_injection
+    counts = fault_injection.counters()
+    if not counts:
+        return []
+    name = "vdt:fault_injections_total"
+    lines = [f"# HELP {name} Injected fault fires per fault point",
+             f"# TYPE {name} counter"]
+    lines += [f'{name}{{point="{point}"}} {n}'
+              for point, n in sorted(counts.items())]
+    return lines

@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from vllm_distributed_tpu.models.llama import (MODEL_AXIS,
                                                LlamaForCausalLM)
+from vllm_distributed_tpu.parallel.mesh import shard_map
 
 
 class MixtralForCausalLM(LlamaForCausalLM):
@@ -400,7 +401,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 jnp.zeros((1, 1), jnp.int32))
         erep = (lp["expert_replicas"] if eplb else
                 jnp.ones((1, ), jnp.int32))
-        out = jax.shard_map(
+        out = shard_map(
             rank_fn, mesh=mesh,
             in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS, None, None),
                       P(MODEL_AXIS, None, None), P(), P(), P(), P(), P()),
@@ -444,7 +445,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             y = y[jnp.argsort(part)]  # back to expert-sorted order
             return jax.lax.psum(y, MODEL_AXIS)
 
-        return jax.shard_map(
+        return shard_map(
             rank_fn, mesh=mesh,
             in_specs=(P(MODEL_AXIS, None, None), P(MODEL_AXIS, None, None),
                       P(MODEL_AXIS, None, None), P(), P(), P()),

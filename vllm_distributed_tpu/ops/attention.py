@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from vllm_distributed_tpu import envs
+from vllm_distributed_tpu.parallel.mesh import shard_map
 
 # Set to a large negative number rather than -inf so fully-masked rows
 # produce 0-weight rows instead of NaNs.
@@ -438,7 +439,7 @@ def _write_kv_cache_tknp(k_all, v_all, k_new, v_new, batch, layer):
         return _scatter_kv_flat(k_all_, v_all_, k_new_, v_new_, slot_,
                                 layer, PS)
 
-    return jax.shard_map(
+    return shard_map(
         call, mesh=mesh_state.get_global_mesh(),
         in_specs=(cache_spec, cache_spec, new_spec, new_spec,
                   P(token_axis, None, None), P(token_axis, None),
@@ -490,7 +491,7 @@ def write_kv_cache(
             from jax.sharding import PartitionSpec as P
             cache_spec = P(None, None, MESH_AXIS_MODEL, None, None)
             new_spec = P(None, MESH_AXIS_MODEL, None)
-            return jax.shard_map(
+            return shard_map(
                 call, mesh=mesh_state.get_global_mesh(),
                 in_specs=(cache_spec, cache_spec, new_spec, new_spec),
                 out_specs=(cache_spec, cache_spec),
@@ -544,7 +545,7 @@ def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
         out = jnp.where((slot_ >= 0)[:, None, None], out, 0)
         return jax.lax.psum(out, token_axis)
 
-    return jax.shard_map(
+    return shard_map(
         call, mesh=mesh_state.get_global_mesh(),
         in_specs=(head_spec, cache_spec, cache_spec,
                   P(token_axis, None, None), P(token_axis, None),
@@ -664,7 +665,7 @@ def paged_attention(
             from jax.sharding import PartitionSpec as P
             head_spec = P(None, MESH_AXIS_MODEL, None)
             kv_spec = P(None, None, MESH_AXIS_MODEL, None, None)
-            return jax.shard_map(
+            return shard_map(
                 call, mesh=mesh_state.get_global_mesh(),
                 in_specs=(head_spec, kv_spec, kv_spec),
                 out_specs=head_spec, check_vma=False)(q, k_pages, v_pages)

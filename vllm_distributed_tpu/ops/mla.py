@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from vllm_distributed_tpu.ops.attention import _MASK_VALUE, _pad_last_dim
+from vllm_distributed_tpu.parallel.mesh import shard_map
 
 
 def latent_storage_dim(kv_lora_rank: int, rope_dim: int) -> int:
@@ -162,7 +163,7 @@ def latent_attention(q_absorbed, q_pe, c_all, batch, *, sm_scale,
             # replicated, so each rank runs the kernel on its head
             # slice against the full cache.
             head_spec = P(None, MESH_AXIS_MODEL, None)
-            return jax.shard_map(
+            return shard_map(
                 call, mesh=mesh_state.get_global_mesh(),
                 in_specs=(head_spec, ),
                 out_specs=head_spec, check_vma=False)(qc)

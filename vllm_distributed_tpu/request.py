@@ -136,6 +136,11 @@ class Request:
         # (WAITING_FOR_REMOTE_KVS bookkeeping; applied by the scheduler
         # when the worker reports finished_recving).
         self.num_external_computed_tokens = 0
+        # Watchdog bookkeeping for the WAITING_FOR_REMOTE_KVS hold:
+        # sweep deadline (unix seconds; set at hold entry) and how many
+        # times the pull was retried before degrading to local prefill.
+        self.remote_kv_deadline: Optional[float] = None
+        self.num_kv_pull_retries = 0
         # Number of preemptions experienced (stats).
         self.num_preemptions = 0
         # Token-parallel rank owning this request's KV (assigned by the

@@ -71,6 +71,32 @@ def _truncation_thresholds(scaled, topv, top_k, top_p, kcap):
     return jnp.maximum(kth, cut_p), resolved
 
 
+def _apply_truncation(scaled: jax.Array, top_k: jax.Array,
+                      top_p: jax.Array, min_p: jax.Array) -> jax.Array:
+    """Mask temperature-scaled logits [N, V] to each row's top-k/top-p/
+    min-p support (-inf outside). Thresholds resolve from a top-_K_CAP
+    prefix with an exact full-sort fallback (see module docstring);
+    shared by the plain sampler and the spec-decode verifier so both
+    truncate identically."""
+    V = scaled.shape[1]
+    kcap = min(_K_CAP, V)
+    topv, _idx = jax.lax.top_k(scaled, kcap)
+    thr, resolved = _truncation_thresholds(scaled, topv, top_k, top_p,
+                                           kcap)
+    if kcap < V:
+        def exact(_):
+            full, _i = jax.lax.top_k(scaled, V)
+            t, _r = _truncation_thresholds(scaled, full, top_k, top_p, V)
+            return t
+
+        thr = jax.lax.cond(jnp.all(resolved), lambda _: thr, exact, None)
+    # min-p in scaled space: p_i >= min_p * p_max  <=>
+    # scaled_i >= log(min_p) + scaled_max (min_p = 0 -> -inf).
+    cut_m = jnp.log(jnp.maximum(min_p, 0.0)) + scaled.max(axis=-1)
+    thr = jnp.maximum(thr, cut_m)
+    return jnp.where(scaled >= thr[:, None], scaled, _NEG_INF)
+
+
 def _sample_from_logits(
     logits: jax.Array,  # [R, V] float32
     md: SamplingMetadata,
@@ -89,26 +115,7 @@ def _sample_from_logits(
         # is discarded by the final where()).
         temp = jnp.maximum(md.temperature, 1e-6)[:, None]
         scaled = logits / temp
-        kcap = min(_K_CAP, V)
-
-        topv, _idx = jax.lax.top_k(scaled, kcap)
-        thr, resolved = _truncation_thresholds(
-            scaled, topv, md.top_k, md.top_p, kcap)
-        if kcap < V:
-            def exact(_):
-                full, _i = jax.lax.top_k(scaled, V)
-                t, _r = _truncation_thresholds(
-                    scaled, full, md.top_k, md.top_p, V)
-                return t
-
-            thr = jax.lax.cond(jnp.all(resolved),
-                               lambda _: thr, exact, None)
-        # min-p in scaled space: p_i >= min_p * p_max  <=>
-        # scaled_i >= log(min_p) + scaled_max (min_p = 0 -> -inf).
-        cut_m = (jnp.log(jnp.maximum(md.min_p, 0.0)) +
-                 scaled.max(axis=-1))
-        thr = jnp.maximum(thr, cut_m)
-        masked = jnp.where(scaled >= thr[:, None], scaled, _NEG_INF)
+        masked = _apply_truncation(scaled, md.top_k, md.top_p, md.min_p)
 
         # Gumbel-argmax over the masked vocab; per-request keys.
         base = jax.random.PRNGKey(0)
@@ -220,13 +227,14 @@ def sample_tokens_extended(
     return token_ids, chosen_logprob, top_vals, top_ids.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=("truncate", ))
 def spec_verify_rejection(
     logits: jax.Array,  # [R, S1, V] target logits (S1 = S drafts + 1)
     drafts: jax.Array,  # [R, S] int32 proposed tokens (-1 = no draft)
     q_ids: jax.Array,  # [R, S, K] int32 draft support token ids
     q_probs: jax.Array,  # [R, S, K] f32 draft probs on the support
     md: SamplingMetadata,  # per-row (R); seeds [R, S1] per position
+    truncate: bool = True,  # static: any row has top-k/top-p/min-p on
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """True stochastic rejection sampling for learned drafters
     (reference: v1/sample/rejection_sampler.py:23).
@@ -248,7 +256,23 @@ def spec_verify_rejection(
     R, S1, V = logits.shape
     S = S1 - 1
     temp = jnp.maximum(md.temperature, 1e-6)[:, None, None]
-    logp = jax.nn.log_softmax(logits / temp, axis=-1)  # tempered target
+    # Tempered target TRUNCATED to each request's top-k/top-p/min-p
+    # support (ADVICE r5 high; reference: rejection_sampler
+    # compute_probs applies top-k/top-p to target logits before the
+    # accept test): the accept probability, exact residual, and bonus
+    # sample all derive from the truncated p, so spec decode can never
+    # emit a token the non-spec sampler would have masked. ``truncate``
+    # is a STATIC flag the runner sets only when some batch row has a
+    # filter active — the default-sampling case (where the thresholds
+    # would resolve to -inf and mask nothing) skips the top_k pass.
+    scaled = logits / temp
+    if truncate:
+        scaled = _apply_truncation(
+            scaled.reshape(R * S1, V),
+            jnp.repeat(md.top_k, S1),
+            jnp.repeat(md.top_p, S1),
+            jnp.repeat(md.min_p, S1)).reshape(R, S1, V)
+    logp = jax.nn.log_softmax(scaled, axis=-1)  # tempered target
     p = jnp.exp(logp)
 
     rowsR = jnp.arange(R, dtype=jnp.int32)[:, None]

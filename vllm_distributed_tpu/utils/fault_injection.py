@@ -1,0 +1,180 @@
+"""Deterministic fault-injection registry for robustness testing.
+
+A small process-global table of NAMED fault points threaded through the
+fault-tolerance layers. Production code asks ``should_fire(name)`` /
+``maybe_delay(name)`` at each point; with no faults configured every
+check is a dict lookup on an empty table (one ``if`` on the hot path).
+
+Activation is deterministic — a fault with rate r fires on exactly the
+calls where ``floor(n*r)`` increments (rate 1.0 = every call, 0.5 =
+every other call) — so a test that injects ``kv_pull.drop`` at 100%
+observes the same failure sequence on every run, with no RNG seeding.
+
+Configure programmatically (tests)::
+
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    fi.inject("kv_pull.drop")                 # rate 1.0
+    fi.inject("kv_pull.delay", delay_s=0.2)   # sleep 200ms per fire
+    ...
+    fi.clear()
+
+or via the environment (survives engine-core subprocess spawn)::
+
+    VDT_FAULT_INJECT="kv_pull.drop:1.0,kv_pull.delay:0.5@0.2"
+
+(``name:rate`` entries, optional ``@delay_seconds`` suffix.)
+
+Known points (layers consult this module; an unknown name is accepted
+but never fired by production code):
+
+* ``kv_pull.drop``      — consumer silently drops a staged KV pull (no
+  worker report ever arrives; only the scheduler watchdog recovers).
+* ``kv_pull.delay``     — injects ``delay_s`` of latency into a pull.
+* ``registry.truncate`` — the P2P registry server answers one request
+  with a malformed (non-msgpack) payload.
+* ``engine_core.die``   — the engine-core busy loop raises on its next
+  iteration (subprocess sends the dead sentinel; thread core surfaces
+  the error through its output queue).
+* ``heartbeat.stall``   — heartbeat senders (P2P registry client,
+  engine-core liveness thread) skip their sends while active.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+FAULT_POINTS = (
+    "kv_pull.drop",
+    "kv_pull.delay",
+    "registry.truncate",
+    "engine_core.die",
+    "heartbeat.stall",
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by fault points that surface as errors. Deliberately NOT a
+    subclass of OSError: the retry layer classifies it fatal, so an
+    injected fault exercises the failure path, not the retry path."""
+
+
+@dataclass
+class _FaultSpec:
+    name: str
+    rate: float = 1.0
+    delay_s: float = 0.0
+    # Stop firing after this many fires (None = unlimited).
+    max_fires: Optional[int] = None
+    calls: int = 0
+    fires: int = 0
+
+
+@dataclass
+class FaultRegistry:
+    """Per-process fault table (module-level singleton below)."""
+
+    _specs: dict[str, _FaultSpec] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    # Cumulative fires per point, kept across clear() for metrics.
+    counters: dict[str, int] = field(default_factory=dict)
+
+    def inject(self, name: str, rate: float = 1.0, delay_s: float = 0.0,
+               max_fires: Optional[int] = None) -> None:
+        with self._lock:
+            self._specs[name] = _FaultSpec(name=name, rate=rate,
+                                           delay_s=delay_s,
+                                           max_fires=max_fires)
+        logger.warning("fault injection ARMED: %s rate=%.2f delay=%.3fs",
+                       name, rate, delay_s)
+
+    def clear(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(name, None)
+
+    @property
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    def should_fire(self, name: str) -> bool:
+        """One call at the named point; True when the fault fires."""
+        if not self._specs:
+            return False
+        with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                return False
+            if spec.max_fires is not None and spec.fires >= spec.max_fires:
+                return False
+            spec.calls += 1
+            fire = int(spec.calls * spec.rate) > int(
+                (spec.calls - 1) * spec.rate)
+            if fire:
+                spec.fires += 1
+                self.counters[name] = self.counters.get(name, 0) + 1
+        if fire:
+            logger.warning("fault injection FIRED: %s (fire %d)", name,
+                           self.counters[name])
+        return fire
+
+    def maybe_delay(self, name: str) -> float:
+        """Fire a delay-style fault: sleeps and returns the injected
+        seconds (0.0 when the fault does not fire)."""
+        if not self._specs:
+            return 0.0
+        with self._lock:
+            spec = self._specs.get(name)
+        if spec is None or spec.delay_s <= 0:
+            return 0.0
+        if not self.should_fire(name):
+            return 0.0
+        time.sleep(spec.delay_s)
+        return spec.delay_s
+
+    def fire_or_raise(self, name: str) -> None:
+        if self.should_fire(name):
+            raise InjectedFault(f"injected fault: {name}")
+
+    def delay_of(self, name: str) -> float:
+        spec = self._specs.get(name)
+        return spec.delay_s if spec is not None else 0.0
+
+
+def _from_env() -> FaultRegistry:
+    from vllm_distributed_tpu import envs
+    reg = FaultRegistry()
+    spec_str = envs.VDT_FAULT_INJECT
+    for entry in filter(None, (s.strip() for s in spec_str.split(","))):
+        try:
+            name, _, tail = entry.partition(":")
+            rate_s, _, delay_s = tail.partition("@")
+            reg.inject(name.strip(), rate=float(rate_s or 1.0),
+                       delay_s=float(delay_s or 0.0))
+        except ValueError:
+            logger.error("ignoring malformed VDT_FAULT_INJECT entry %r",
+                         entry)
+    return reg
+
+
+# Process-global registry; engine-core subprocesses rebuild it from the
+# inherited VDT_FAULT_INJECT environment at import time.
+registry = _from_env()
+
+# Module-level conveniences (the names production code imports).
+inject = registry.inject
+clear = registry.clear
+should_fire = registry.should_fire
+maybe_delay = registry.maybe_delay
+fire_or_raise = registry.fire_or_raise
+
+
+def counters() -> dict[str, int]:
+    """Cumulative fires per fault point (metrics/bench)."""
+    return dict(registry.counters)

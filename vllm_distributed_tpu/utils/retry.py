@@ -1,0 +1,91 @@
+"""Shared retry/backoff policy for KV-transfer and registry network calls.
+
+Reference analogue: the connection-retry loops scattered through the
+reference's distributed bootstrap (StatelessProcessGroup.create retries,
+the P2P proxy's re-register loop). Here the policy is one reusable
+object so every connector classifies errors the same way: transient
+transport errors (socket resets, refused connections, timeouts) retry
+with exponential backoff + jitter under a wall-clock deadline; anything
+else — protocol violations, injected faults, programming errors — is
+fatal and surfaces immediately.
+"""
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Transient transport errors worth retrying. OSError covers
+# ConnectionError/TimeoutError/socket.timeout subclasses.
+RETRYABLE_ERRORS: tuple[type[BaseException], ...] = (OSError, )
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """All attempts (or the deadline) were exhausted; ``__cause__`` holds
+    the last underlying error."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + jitter under an attempt cap and an optional
+    wall-clock deadline."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    # Fraction of each delay randomized (0 = deterministic backoff).
+    jitter: float = 0.25
+    # Total wall-clock budget across attempts (None = attempts only).
+    deadline_s: Optional[float] = None
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        delay = min(self.base_delay_s * (self.multiplier ** (attempt - 1)),
+                    self.max_delay_s)
+        if self.jitter > 0:
+            delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(delay, 0.0)
+
+
+def call_with_retry(
+    fn: Callable,
+    *,
+    policy: RetryPolicy = RetryPolicy(),
+    retryable: tuple[type[BaseException], ...] = RETRYABLE_ERRORS,
+    description: str = "call",
+    on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
+):
+    """Run ``fn()``; retry classified-transient failures per ``policy``.
+
+    Non-retryable exceptions propagate unchanged. Exhausting the attempt
+    cap or the deadline raises RetryBudgetExceeded chained to the last
+    transient error, so callers can distinguish "network kept flaking"
+    from a genuine protocol failure.
+    """
+    start = time.monotonic()
+    last_err: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except retryable as e:  # noqa: PERF203 - retry loop
+            last_err = e
+            if attempt >= policy.max_attempts:
+                break
+            delay = policy.delay_for(attempt)
+            if (policy.deadline_s is not None
+                    and time.monotonic() + delay - start > policy.deadline_s):
+                break
+            if on_retry is not None:
+                on_retry(attempt, delay, e)
+            logger.debug("%s failed (%s); retry %d/%d in %.2fs",
+                         description, e, attempt, policy.max_attempts - 1,
+                         delay)
+            time.sleep(delay)
+    raise RetryBudgetExceeded(
+        f"{description} failed after {policy.max_attempts} attempts: "
+        f"{last_err}") from last_err

@@ -402,7 +402,8 @@ class TPUModelRunner:
             return tgt, topv, topi
 
         def spec_verify(params, hidden_sel, drafts, q_ids, q_probs,
-                        sampling_md: SamplingMetadata):
+                        sampling_md: SamplingMetadata,
+                        truncate: bool = False):
             """Logits + true rejection-sampling verification in one
             graph (reference: v1/sample/rejection_sampler.py:23); keyed
             by the R bucket like the plain sampler."""
@@ -424,7 +425,7 @@ class TPUModelRunner:
                 min_p=sampling_md.min_p.reshape(R, S1)[:, 0])
             return spec_verify_rejection(
                 logits.reshape(R, S1, logits.shape[-1]), drafts, q_ids,
-                q_probs, md_r)
+                q_probs, md_r, truncate=truncate)
 
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
@@ -432,7 +433,8 @@ class TPUModelRunner:
         self._sample_fn = jax.jit(sample)
         self._sample_ext_fn = jax.jit(sample_ext,
                                       static_argnames=("want_topk", ))
-        self._spec_verify_fn = jax.jit(spec_verify)
+        self._spec_verify_fn = jax.jit(spec_verify,
+                                       static_argnames=("truncate", ))
         self._build_multi_step_fn()
 
     def _build_multi_step_fn(self) -> None:
@@ -880,10 +882,17 @@ class TPUModelRunner:
             rows_np[:len(plp_rows)] = plp_rows
             tgt_np[:len(plp_targets)] = plp_targets
             plp = (jnp.asarray(rows_np), jnp.asarray(tgt_np), plp_meta)
+        # Verifier truncation only when some batch row needs it (static
+        # jit arg: the default-sampling serving case keeps the cheaper
+        # untruncated verify graph; padding rows sit at the no-op
+        # defaults so they never flip it).
+        spec_truncate = bool(self.spec_k) and bool(
+            (ib.top_k[rows] > 0).any() or (ib.top_p[rows] < 1.0).any()
+            or (ib.min_p[rows] > 0.0).any())
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
                 sampling_req_ids, (T, max_q, G), R,
-                (drafts_arr, q_ids, q_probs), ext_md,
+                (drafts_arr, q_ids, q_probs, spec_truncate), ext_md,
                 want_topk, vocab_mask, plp)
 
     # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
@@ -1023,7 +1032,7 @@ class TPUModelRunner:
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
          fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
          plp) = self._prepare_inputs(scheduler_output)
-        drafts_arr, q_ids, q_probs = spec_pack
+        drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
 
         kv_meta = scheduler_output.kv_connector_metadata
         if self.kv_connector is not None and kv_meta is not None:
@@ -1038,7 +1047,7 @@ class TPUModelRunner:
         spec_q = None
         if (self.spec_k and ext_md is None and vocab_mask is None):
             spec_q = (jnp.asarray(drafts_arr), jnp.asarray(q_ids),
-                      jnp.asarray(q_probs))
+                      jnp.asarray(q_probs), spec_truncate)
         dev = self._launch_device_step(token_ids, batch, logits_indices,
                                        sampling_md, fwd_shape, ext_md,
                                        want_topk, vocab_mask, plp=plp,
@@ -1336,11 +1345,11 @@ class TPUModelRunner:
         hidden_sel = self._gather_sample_rows(hidden, logits_indices,
                                               mesh=mesh)
         if spec_q is not None:
-            drafts_d, q_ids_d, q_probs_d = spec_q
-            with self._compile_watch(("specv", n_rows)):
+            drafts_d, q_ids_d, q_probs_d, truncate = spec_q
+            with self._compile_watch(("specv", n_rows, truncate)):
                 verify = self._spec_verify_fn(
                     self.params, hidden_sel, drafts_d, q_ids_d,
-                    q_probs_d, sampling_md)
+                    q_probs_d, sampling_md, truncate=truncate)
             return verify, None, None, hidden_sel, plp_dev
         if ext_md is not None:
             with self._compile_watch(("sampleX", n_rows, want_topk,
