@@ -381,6 +381,18 @@ def main() -> None:
         record["kv_pull_retries"] = int(rstats.get("kv_pull_retries", 0))
         record["kv_pull_failures"] = int(
             rstats.get("kv_pull_failures", 0))
+        # Recovery-layer counters (PR 2): replica failovers show up in
+        # DP bench legs; replay/shed stay 0 offline but keep the record
+        # schema aligned with the serving /metrics families.
+        record["replica_failovers"] = int(
+            rstats.get("replica_failovers", 0))
+        fstats = getattr(engine, "output_processor", None)
+        record["requests_replayed"] = int(
+            getattr(getattr(fstats, "stats", None),
+                    "num_requests_replayed", 0))
+        record["requests_shed"] = int(
+            getattr(getattr(fstats, "stats", None),
+                    "num_requests_shed", 0))
     except Exception:  # noqa: BLE001 - diagnostic leg only
         pass
 

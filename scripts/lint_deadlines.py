@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Ban wall-clock deadline arithmetic in framework code.
+
+``time.time()`` is an NTP-steppable clock: deadlines, TTLs, and timeout
+windows computed from it mass-expire (or immortalize) when the host
+clock steps — the regression class PR 1/2's monotonic migration removed.
+This linter keeps it removed mechanically: every ``time.time()`` (and
+``default_factory=time.time``) occurrence under ``vllm_distributed_tpu/``
+must carry a ``wallclock-ok`` marker comment on its own line or the line
+directly above, asserting it is a timestamp-only use (API ``created``
+fields, stats epochs, informational heartbeat payloads) — never deadline
+arithmetic.
+
+Usage::
+
+    python scripts/lint_deadlines.py [--root DIR]
+
+Exit 0 when clean; exit 1 listing offending file:line pairs otherwise.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+# What counts as a wall-clock read. Catches the call form and the
+# dataclass default_factory reference (evaluated at instance creation).
+WALLCLOCK_RE = re.compile(
+    r"time\.time\(\)|default_factory\s*=\s*time\.time\b")
+MARKER = "wallclock-ok"
+
+DEFAULT_PACKAGE = "vllm_distributed_tpu"
+
+
+def find_violations(root: Path) -> list[tuple[Path, int, str]]:
+    violations: list[tuple[Path, int, str]] = []
+    for path in sorted(root.rglob("*.py")):
+        lines = path.read_text(encoding="utf-8").splitlines()
+        for lineno, line in enumerate(lines, start=1):
+            if not WALLCLOCK_RE.search(line):
+                continue
+            prev = lines[lineno - 2] if lineno >= 2 else ""
+            if MARKER in line or MARKER in prev:
+                continue
+            violations.append((path, lineno, line.strip()))
+    return violations
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--root", type=Path,
+        default=Path(__file__).resolve().parent.parent / DEFAULT_PACKAGE,
+        help="directory tree to lint (default: the framework package)")
+    args = parser.parse_args(argv)
+    if not args.root.is_dir():
+        print(f"lint_deadlines: no such directory: {args.root}",
+              file=sys.stderr)
+        return 2
+    violations = find_violations(args.root)
+    if not violations:
+        return 0
+    print("wall-clock reads without a 'wallclock-ok' marker (use "
+          "time.monotonic() for deadlines/TTLs, or annotate "
+          "timestamp-only uses):", file=sys.stderr)
+    for path, lineno, line in violations:
+        print(f"  {path}:{lineno}: {line}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

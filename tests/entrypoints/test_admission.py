@@ -1,0 +1,236 @@
+"""API admission control: watermark shedding, KV pressure, per-request
+deadlines, drain mode — controller units plus a live-server overload
+drill (429 + Retry-After, counters on /metrics)."""
+
+import asyncio
+import threading
+import types
+
+import httpx
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.entrypoints.openai.admission import (
+    AdmissionController, AdmissionRejected)
+from vllm_distributed_tpu.metrics.stats import FrontendStats
+from vllm_distributed_tpu.utils import fault_injection as fi
+from vllm_distributed_tpu.utils import get_open_port
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _stub_engine(kv_usage: float = 0.0):
+    async def get_stats():
+        return {"kv_cache_usage": kv_usage}
+
+    return types.SimpleNamespace(
+        output_processor=types.SimpleNamespace(stats=FrontendStats()),
+        get_stats=get_stats)
+
+
+def _controller(engine=None, high=4, low=0, kv_high=0.0):
+    return AdmissionController(engine or _stub_engine(),
+                               high_watermark=high, low_watermark=low,
+                               kv_high=kv_high, retry_after_s=7)
+
+
+# ---------------------------------------------------------------------------
+# Controller units
+# ---------------------------------------------------------------------------
+
+def test_watermark_shed_with_hysteresis():
+    engine = _stub_engine()
+    ctrl = _controller(engine, high=4, low=2)
+
+    async def run():
+        for _ in range(4):
+            await ctrl.acquire()
+        with pytest.raises(AdmissionRejected) as ei:
+            await ctrl.acquire()  # depth 4 >= high -> shed
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s == 7
+        ctrl.release()  # depth 3: still above low -> keep shedding
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire()
+        ctrl.release()  # depth 2 == low -> recovered
+        await ctrl.acquire()
+        assert ctrl.depth == 3
+
+    asyncio.run(run())
+    assert engine.output_processor.stats.num_requests_shed == 2
+
+
+def test_kv_pressure_sheds():
+    ctrl = _controller(_stub_engine(kv_usage=0.97), high=100,
+                       kv_high=0.9)
+
+    async def run():
+        with pytest.raises(AdmissionRejected) as ei:
+            await ctrl.acquire()
+        assert "KV cache pressure" in str(ei.value)
+
+    asyncio.run(run())
+
+
+def test_admission_stall_fault_builds_pressure():
+    ctrl = _controller(high=2, low=1)
+    fi.inject("admission.stall")
+
+    async def run():
+        await ctrl.acquire()  # stall leaks a slot: depth 2 after admit
+        assert ctrl.depth == 2
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire()  # leaked slot pushed depth to the high
+
+    asyncio.run(run())
+    assert fi.counters().get("admission.stall", 0) >= 2
+
+
+def test_drain_mode_refuses_and_completes():
+    engine = _stub_engine()
+    ctrl = _controller(engine, high=4)
+
+    async def run():
+        await ctrl.acquire()
+        ctrl.begin_drain()
+        with pytest.raises(AdmissionRejected) as ei:
+            await ctrl.acquire()
+        assert ei.value.status == 503
+        ctrl.release()  # last in-flight request finishes
+        duration = await ctrl.wait_drained(timeout_s=5.0)
+        assert duration < 5.0
+
+    asyncio.run(run())
+    assert engine.output_processor.stats.drain_duration_seconds > 0
+
+
+def test_disabled_controller_admits_everything():
+    ctrl = _controller(high=0)
+
+    async def run():
+        for _ in range(100):
+            await ctrl.acquire()
+        # Depth still tracked (drain needs it); nothing is ever shed.
+        assert ctrl.depth == 100
+        for _ in range(100):
+            ctrl.release()
+        assert ctrl.depth == 0
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Live server: overload 429 + Retry-After, deadline 408, /metrics
+# ---------------------------------------------------------------------------
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import os
+
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+
+    path = str(tmp_path_factory.mktemp("tiny_admission"))
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    HFLlama(cfg).eval().save_pretrained(path, safe_serialization=True)
+
+    saved = {k: os.environ.get(k) for k in
+             ("VDT_ADMISSION_HIGH_WATERMARK",
+              "VDT_ADMISSION_LOW_WATERMARK")}
+    os.environ["VDT_ADMISSION_HIGH_WATERMARK"] = "2"
+    os.environ["VDT_ADMISSION_LOW_WATERMARK"] = "1"
+
+    engine = AsyncLLM(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True).create_engine_config(),
+        load_tokenizer=False)
+    port = get_open_port()
+    ready = threading.Event()
+    stop_holder = {}
+
+    def run():
+        from vllm_distributed_tpu.entrypoints.openai.api_server import \
+            serve
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        stop_holder["stop"] = stop
+        stop_holder["loop"] = loop
+        loop.run_until_complete(serve(engine, path, "127.0.0.1", port,
+                                      ready_event=ready,
+                                      stop_event=stop))
+        loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=120), "server did not start"
+    yield f"http://127.0.0.1:{port}"
+    stop_holder["loop"].call_soon_threadsafe(stop_holder["stop"].set)
+    t.join(timeout=30)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+BODY = {"model": "tiny", "prompt": [3, 17, 92], "max_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True}
+
+
+def test_per_request_deadline_aborts_with_408(server):
+    # (Runs before the overload drill: admission.stall leaks slots into
+    # the module-scoped server's gate, shedding everything after it.)
+    body = dict(BODY, max_tokens=48, timeout_s=0.001)
+    r = httpx.post(f"{server}/v1/completions", timeout=300, json=body)
+    assert r.status_code == 408, r.text
+    assert r.json()["error"]["type"] == "timeout_error"
+    # The aborted request released its slot and the engine still serves.
+    r = httpx.post(f"{server}/v1/completions", timeout=300, json=BODY)
+    assert r.status_code == 200, r.text
+
+
+def test_overload_sheds_429_with_retry_after(server):
+    # Warm path: under the watermark everything is served.
+    r = httpx.post(f"{server}/v1/completions", timeout=300, json=BODY)
+    assert r.status_code == 200, r.text
+
+    # admission.stall leaks one slot per request: the second request
+    # finds the queue at the high watermark and is shed.
+    fi.inject("admission.stall")
+    r1 = httpx.post(f"{server}/v1/completions", timeout=300, json=BODY)
+    assert r1.status_code == 200, r1.text
+    r2 = httpx.post(f"{server}/v1/completions", timeout=300, json=BODY)
+    assert r2.status_code == 429, r2.text
+    assert "Retry-After" in r2.headers
+    assert int(r2.headers["Retry-After"]) >= 1
+    assert r2.json()["error"]["type"] == "overloaded"
+    fi.clear()
+
+    # Shed + queue metrics are on /metrics.
+    scrape = httpx.get(f"{server}/metrics", timeout=60).text
+    assert "vdt:requests_shed_total" in scrape
+    shed = [ln for ln in scrape.splitlines()
+            if ln.startswith("vdt:requests_shed_total")]
+    assert float(shed[0].split()[-1]) >= 1
+    assert "vdt:admission_queue_depth" in scrape
+    assert "vdt:requests_replayed_total" in scrape
+    assert "vdt:drain_duration_seconds" in scrape

@@ -1,0 +1,239 @@
+"""Crash recovery: restart supervisor + in-flight request replay.
+
+Deterministic drills over the named fault points: ``engine_core.die``
+mid-decode must resume token-identically after a supervisor respawn,
+``restart.storm`` must burn the restart budget down to the terminal
+EngineDeadError circuit breaker, and ``core_proc.spawn_fail`` must make
+respawns themselves count against the budget."""
+
+import asyncio
+import time
+
+import pytest
+
+from vllm_distributed_tpu.engine.core_client import (EngineDeadError,
+                                                     RestartSupervisor)
+from vllm_distributed_tpu.request import (EngineCoreRequest,
+                                          continuation_request)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# RestartSupervisor unit
+# ---------------------------------------------------------------------------
+
+def test_supervisor_backoff_and_budget():
+    sup = RestartSupervisor(max_attempts=3, window_s=60.0,
+                            backoff_base_s=0.5, backoff_max_s=30.0)
+    assert sup.next_delay() == 0.5
+    assert sup.next_delay() == 1.0
+    assert sup.next_delay() == 2.0
+    assert sup.next_delay() is None  # budget burnt -> circuit breaker
+    assert sup.exhausted
+
+
+def test_supervisor_window_slides():
+    sup = RestartSupervisor(max_attempts=1, window_s=0.05,
+                            backoff_base_s=0.0, backoff_max_s=0.0)
+    assert sup.next_delay() == 0.0
+    assert sup.next_delay() is None
+    time.sleep(0.06)  # the attempt ages out of the window
+    assert not sup.exhausted
+    assert sup.next_delay() == 0.0
+
+
+def test_supervisor_disabled_refuses_immediately():
+    sup = RestartSupervisor(max_attempts=0, window_s=60.0,
+                            backoff_base_s=0.5, backoff_max_s=30.0)
+    assert sup.next_delay() is None
+
+
+def test_supervisor_backoff_is_capped():
+    sup = RestartSupervisor(max_attempts=10, window_s=600.0,
+                            backoff_base_s=1.0, backoff_max_s=4.0)
+    delays = [sup.next_delay() for _ in range(5)]
+    assert delays == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# continuation_request unit
+# ---------------------------------------------------------------------------
+
+def _req(prompt, max_tokens=16, **sp):
+    return EngineCoreRequest(
+        request_id="r0", prompt_token_ids=list(prompt),
+        sampling_params=SamplingParams(temperature=0.0,
+                                       max_tokens=max_tokens, **sp))
+
+
+def test_continuation_absorbs_generated_tokens():
+    orig = _req([1, 2, 3], max_tokens=10)
+    cont = continuation_request(orig, [7, 8])
+    assert cont.prompt_token_ids == [1, 2, 3, 7, 8]
+    assert cont.sampling_params.max_tokens == 8
+    # The original is untouched (it may be journaled again).
+    assert orig.prompt_token_ids == [1, 2, 3]
+    assert orig.sampling_params.max_tokens == 10
+
+
+def test_continuation_with_no_progress_is_the_original():
+    orig = _req([1, 2, 3], max_tokens=10)
+    cont = continuation_request(orig, [])
+    assert cont.prompt_token_ids == [1, 2, 3]
+    assert cont.sampling_params.max_tokens == 10
+
+
+def test_continuation_keeps_at_least_one_token():
+    orig = _req([1, 2], max_tokens=3)
+    cont = continuation_request(orig, [5, 6, 7])
+    assert cont.sampling_params.max_tokens == 1
+
+
+def test_continuation_shrinks_min_tokens():
+    orig = _req([1, 2], max_tokens=8, min_tokens=4)
+    cont = continuation_request(orig, [5, 6])
+    assert cont.sampling_params.min_tokens == 2
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: die mid-decode -> respawn -> token-identical resume
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_recovery")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+PROMPT = [3, 17, 92, 45, 8, 21, 33, 64, 90]
+
+
+def _make_async_engine(checkpoint, **overrides):
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    args = dict(model=checkpoint, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True,
+                restart_backoff_base_s=0.01, restart_backoff_max_s=0.05)
+    args.update(overrides)
+    return AsyncLLM(EngineArgs(**args).create_engine_config(),
+                    load_tokenizer=False)
+
+
+async def _collect(engine, request_id, max_tokens=24, die_after=None):
+    """Stream one greedy request; optionally arm engine_core.die after
+    the first output arrives (i.e. mid-decode)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    final = None
+    got_first = False
+    async for out in engine.generate(PROMPT, sp, request_id=request_id):
+        if not got_first:
+            got_first = True
+            if die_after:
+                fi.inject("engine_core.die", max_fires=1)
+        final = out
+    assert final is not None and final.finished
+    return final.outputs[0].token_ids
+
+
+def test_die_mid_decode_resumes_token_identical(checkpoint):
+    """Acceptance: kill the core mid-decode; the supervisor respawns it,
+    the journaled request replays as a continuation prefill, and the
+    greedy stream finishes token-identical to an uninterrupted run."""
+    baseline_engine = _make_async_engine(checkpoint)
+    try:
+        baseline = asyncio.run(asyncio.wait_for(
+            _collect(baseline_engine, "base-0"), timeout=120.0))
+    finally:
+        baseline_engine.shutdown()
+    assert len(baseline) == 24
+
+    engine = _make_async_engine(checkpoint)
+    try:
+        resumed = asyncio.run(asyncio.wait_for(
+            _collect(engine, "die-0", die_after=True), timeout=180.0))
+        assert resumed == baseline, (
+            "resumed stream diverged from the uninterrupted run")
+        assert not engine.errored
+        stats = engine.output_processor.stats
+        assert stats.num_requests_replayed >= 1
+        assert stats.num_engine_deaths >= 1
+        assert fi.counters().get("engine_core.die", 0) >= 1
+        # The engine keeps serving after recovery.
+        again = asyncio.run(asyncio.wait_for(
+            _collect(engine, "after-0"), timeout=120.0))
+        assert again == baseline
+    finally:
+        engine.shutdown()
+
+
+def test_restart_storm_circuit_breaks(checkpoint):
+    """Acceptance: every respawned core dies again immediately
+    (restart.storm); after restart_max_attempts the supervisor
+    circuit-breaks and pending requests surface EngineDeadError."""
+    engine = _make_async_engine(checkpoint, restart_max_attempts=2)
+
+    async def run():
+        sp = SamplingParams(temperature=0.0, max_tokens=32,
+                            ignore_eos=True)
+        got_first = False
+        async for _ in engine.generate(PROMPT, sp, request_id="storm-0"):
+            if not got_first:
+                got_first = True
+                fi.inject("restart.storm")  # every restart re-dies
+                fi.inject("engine_core.die", max_fires=1)
+
+    try:
+        with pytest.raises(EngineDeadError):
+            asyncio.run(asyncio.wait_for(run(), timeout=180.0))
+        assert engine.errored
+        # The budget granted exactly restart_max_attempts respawns.
+        assert fi.counters().get("restart.storm", 0) == 2
+    finally:
+        engine.shutdown()
+
+
+def test_spawn_fail_burns_restart_budget(checkpoint):
+    """core_proc.spawn_fail: the respawn itself fails, consuming the
+    budget without ever producing a live core -> terminal death."""
+    engine = _make_async_engine(checkpoint, restart_max_attempts=2)
+
+    async def run():
+        sp = SamplingParams(temperature=0.0, max_tokens=32,
+                            ignore_eos=True)
+        got_first = False
+        async for _ in engine.generate(PROMPT, sp, request_id="sf-0"):
+            if not got_first:
+                got_first = True
+                fi.inject("core_proc.spawn_fail")
+                fi.inject("engine_core.die", max_fires=1)
+
+    try:
+        with pytest.raises(EngineDeadError):
+            asyncio.run(asyncio.wait_for(run(), timeout=180.0))
+        assert engine.errored
+        assert fi.counters().get("core_proc.spawn_fail", 0) == 2
+    finally:
+        engine.shutdown()

@@ -404,11 +404,14 @@ def test_engine_core_die_fails_pending_requests(checkpoint):
     from vllm_distributed_tpu.engine.core_client import EngineDeadError
     from vllm_distributed_tpu.sampling_params import SamplingParams
 
+    # restart_max_attempts=0: this test pins the TERMINAL death path
+    # (recovery disabled); tests/test_crash_recovery.py covers the
+    # supervisor respawn + replay path.
     engine = AsyncLLM(EngineArgs(
         model=checkpoint, dtype="float32", block_size=4,
         num_gpu_blocks_override=64, max_model_len=64,
         max_num_batched_tokens=64, max_num_seqs=8,
-        skip_tokenizer_init=True,
+        skip_tokenizer_init=True, restart_max_attempts=0,
         heartbeat_timeout_s=5.0).create_engine_config(),
         load_tokenizer=False)
 
@@ -455,8 +458,8 @@ def test_background_core_silent_death_detected(checkpoint):
     engine = AsyncLLM(EngineArgs(
         model=checkpoint, dtype="float32", block_size=4,
         num_gpu_blocks_override=64, max_model_len=64,
-        max_num_batched_tokens=64, max_num_seqs=8,
-        skip_tokenizer_init=True).create_engine_config(),
+        max_num_batched_tokens=64, max_num_seqs=8, skip_tokenizer_init=True,
+        restart_max_attempts=0).create_engine_config(),
         load_tokenizer=False)
 
     async def run():

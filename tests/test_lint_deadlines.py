@@ -1,0 +1,59 @@
+"""CI guard: wall-clock deadline arithmetic stays banned.
+
+Runs scripts/lint_deadlines.py over the framework package (the tier-1
+mechanical check for the monotonic-clock migration) and unit-tests the
+linter's flag/allowlist behavior on synthetic trees."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_deadlines.py"
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_package_has_no_wallclock_deadlines():
+    res = _run()
+    assert res.returncode == 0, (
+        f"wall-clock deadline arithmetic crept back in:\n{res.stderr}")
+
+
+def test_linter_flags_deadline_arithmetic(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n"
+                   "deadline = time.time() + 30.0\n")
+    res = _run("--root", str(tmp_path))
+    assert res.returncode == 1
+    assert "bad.py:2" in res.stderr
+
+
+def test_linter_flags_default_factory(tmp_path):
+    bad = tmp_path / "factory.py"
+    bad.write_text("import time\n"
+                   "from dataclasses import dataclass, field\n"
+                   "@dataclass\n"
+                   "class T:\n"
+                   "    expires: float = field(default_factory=time.time)\n")
+    res = _run("--root", str(tmp_path))
+    assert res.returncode == 1
+    assert "factory.py:5" in res.stderr
+
+
+def test_marker_allowlists_timestamp_uses(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import time\n"
+                  "created = int(time.time())  # wallclock-ok\n"
+                  "# wallclock-ok: epoch stat for the API body\n"
+                  "arrival = time.time()\n")
+    res = _run("--root", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_missing_root_is_a_usage_error(tmp_path):
+    res = _run("--root", str(tmp_path / "nope"))
+    assert res.returncode == 2

@@ -461,16 +461,35 @@ class FaultToleranceConfig:
     # first-compile stalls are legitimate; tests tighten it.
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 300.0
+    # Restart supervisor: when the health monitor declares a core dead,
+    # respawn it with exponential backoff, at most restart_max_attempts
+    # times within restart_window_s before circuit-breaking to the
+    # terminal EngineDeadError (0 attempts disables recovery — death
+    # stays terminal, the pre-supervisor behavior).
+    restart_max_attempts: int = 3
+    restart_window_s: float = 300.0
+    restart_backoff_base_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    # DP failover: how often the front-end probes a downed replica for
+    # resurrection (0 disables probing — a failed-over replica stays
+    # out of rotation for the process lifetime).
+    replica_probe_interval_s: float = 10.0
 
     def __post_init__(self) -> None:
         if (self.kv_pull_timeout_s < 0 or self.heartbeat_interval_s < 0
                 or self.heartbeat_timeout_s < 0
                 or self.kv_pull_abandon_timeout_s < 0
                 or self.retry_base_delay_s < 0
-                or self.retry_max_delay_s < 0):
+                or self.retry_max_delay_s < 0
+                or self.restart_window_s < 0
+                or self.restart_backoff_base_s < 0
+                or self.restart_backoff_max_s < 0
+                or self.replica_probe_interval_s < 0):
             raise ValueError("fault-tolerance timeouts must be >= 0")
         if self.kv_pull_max_retries < 0:
             raise ValueError("kv_pull_max_retries must be >= 0")
+        if self.restart_max_attempts < 0:
+            raise ValueError("restart_max_attempts must be >= 0")
         if self.retry_max_attempts < 1:
             # 0 would make every retried IO call fail without a single
             # attempt ("no retries" is retry_max_attempts=1).

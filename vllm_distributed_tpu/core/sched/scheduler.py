@@ -982,7 +982,7 @@ class Scheduler:
         # finished_sending; this 2x backstop only fires if the worker
         # poll itself is wedged, so pages still can't leak forever.
         if self.reqs_pending_send:
-            now = time.time()
+            now = time.monotonic()
             timeout = 2 * (self.config.kv_transfer_config
                            .kv_connector_extra_config
                            .get("send_timeout_s", 300.0)

@@ -84,7 +84,7 @@ class KVEventPublisher:
     # -- producer side (scheduler thread) ------------------------------
     def publish(self, events: list) -> None:
         if events:
-            self._queue.put(EventBatch(ts=time.time(),
+            self._queue.put(EventBatch(ts=time.time(),  # wallclock-ok
                                        events=list(events)))
 
     # -- background IO --------------------------------------------------

@@ -331,11 +331,13 @@ class DCNPullConnector(KVConnectorBase):
         extra = self.config.kv_transfer_config.kv_connector_extra_config \
             or {}
         import time
+        # Monotonic deadline: an NTP step must not expire (or immortalize)
+        # a deferred-free registration.
         self._staged_registrations.append(
             _SendRegistration(
                 req_id=request.request_id,
                 page_ids=block_ids[:n_full],
-                deadline=time.time() +
+                deadline=time.monotonic() +
                 float(extra.get("send_timeout_s", 300.0))))
         return True, {
             "remote_req_id": request.request_id,
@@ -421,8 +423,8 @@ class DCNPullConnector(KVConnectorBase):
         polling briefly in case the registration is still riding the
         scheduler->worker metadata (dict reads are GIL-safe)."""
         import time
-        deadline = time.time() + grace_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
             if req_id in self._registrations:
                 return True
             if self._shutdown.is_set():
@@ -574,7 +576,7 @@ class DCNPullConnector(KVConnectorBase):
             finished_sending.add(req_id)
         if self._registrations:
             import time
-            now = time.time()
+            now = time.monotonic()
             for req_id in list(self._registrations):
                 if now > self._registrations[req_id].deadline:
                     logger.warning(

@@ -67,7 +67,10 @@ class P2PRegistryServer:
         return f"{self.host}:{self.port}"
 
     def members(self, role: Optional[str] = None) -> dict[str, dict]:
-        now = time.time()
+        # Monotonic TTL arithmetic: a wall-clock (NTP) step must neither
+        # mass-expire healthy members nor immortalize dead ones. The
+        # "expires" value shipped in list replies is server-relative.
+        now = time.monotonic()
         with self._lock:
             self._members = {k: v for k, v in self._members.items()
                              if v[2] > now}
@@ -106,7 +109,7 @@ class P2PRegistryServer:
                         self._members[msg["instance"]] = (
                             msg.get("role", "producer"),
                             (msg["addr"][0], int(msg["addr"][1])),
-                            time.time() + ttl)
+                            time.monotonic() + ttl)
                     _send_msg(conn, {"ok": True})
                 elif op == "deregister":
                     with self._lock:

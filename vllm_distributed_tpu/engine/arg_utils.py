@@ -80,11 +80,17 @@ class EngineArgs:
 
     otlp_traces_endpoint: Optional[str] = None
 
-    # Fault tolerance: remote-KV watchdog + engine health monitor.
+    # Fault tolerance: remote-KV watchdog + engine health monitor +
+    # restart supervisor (0 attempts = death stays terminal).
     kv_pull_timeout_s: float = 120.0
     kv_pull_max_retries: int = 1
     heartbeat_interval_s: float = 1.0
     heartbeat_timeout_s: float = 300.0
+    restart_max_attempts: int = 3
+    restart_window_s: float = 300.0
+    restart_backoff_base_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    replica_probe_interval_s: float = 10.0
 
     # KV cache event publishing (external prefix-aware routers).
     enable_kv_cache_events: bool = False
@@ -171,6 +177,11 @@ class EngineArgs:
                 kv_pull_max_retries=self.kv_pull_max_retries,
                 heartbeat_interval_s=self.heartbeat_interval_s,
                 heartbeat_timeout_s=self.heartbeat_timeout_s,
+                restart_max_attempts=self.restart_max_attempts,
+                restart_window_s=self.restart_window_s,
+                restart_backoff_base_s=self.restart_backoff_base_s,
+                restart_backoff_max_s=self.restart_backoff_max_s,
+                replica_probe_interval_s=self.replica_probe_interval_s,
             ),
         )
 

@@ -10,11 +10,14 @@ equivalent of the reference's asyncio socket handler.
 """
 
 import asyncio
+import os
 import threading
+import time
 from typing import AsyncGenerator, Optional, Union
 
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.engine.core_client import (EngineDeadError,
+                                                     RestartSupervisor,
                                                      SyncMPClient)
 from vllm_distributed_tpu.engine.core_proc import BackgroundEngineCore
 from vllm_distributed_tpu.engine.llm_engine import _load_tokenizer
@@ -60,7 +63,23 @@ class AsyncLLM:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pump: Optional[threading.Thread] = None
         self._stopped = False
+        # Event form of _stopped so recovery backoff sleeps wake
+        # immediately on shutdown (a plain sleep would let the pump
+        # respawn a core AFTER shutdown already tore the old one down,
+        # leaking the fresh subprocess).
+        self._stop_event = threading.Event()
         self._dead_error: Optional[Exception] = None
+
+        # Crash-recovery state: the journal holds every unfinished
+        # request's original EngineCoreRequest (tokens generated so far
+        # live in output_processor.request_states); after a supervisor
+        # restart each entry is resubmitted as a continuation prefill.
+        # The core lock serializes submissions against restart+replay so
+        # a request can never vanish into a dead incarnation unjournaled.
+        self._journal: dict[str, "EngineCoreRequest"] = {}
+        self._journal_lock = threading.Lock()
+        self._core_lock = threading.Lock()
+        self._supervisor = RestartSupervisor.from_config(config)
 
     @classmethod
     def from_engine_args(cls, engine_args) -> "AsyncLLM":
@@ -76,24 +95,176 @@ class AsyncLLM:
         return self._dead_error or EngineDeadError("engine is dead")
 
     def _ensure_pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._loop is None or (self._loop is not loop
+                                  and self._loop.is_closed()):
+            # A recovered engine outlives asyncio.run() loops (the pump
+            # thread survives core restarts): re-bind to the caller's
+            # live loop once the old one is gone.
+            self._loop = loop
         if self._pump is not None:
             return
-        self._loop = asyncio.get_running_loop()
         self._pump = threading.Thread(target=self._pump_outputs,
                                       daemon=True, name="output-pump")
         self._pump.start()
 
+    def _post(self, callback, *args) -> bool:
+        """Schedule a callback onto the bound event loop from the pump
+        thread; False when the loop is closed (a new generate() call
+        will re-bind before more work arrives)."""
+        try:
+            self._loop.call_soon_threadsafe(callback, *args)
+            return True
+        except RuntimeError:
+            return False
+
     def _pump_outputs(self) -> None:
         """Blocking-side reader: ships each output batch into the event
-        loop (reference: async_llm.py:361 _run_output_handler)."""
+        loop (reference: async_llm.py:361 _run_output_handler). A core
+        death enters the recovery ladder (supervisor restart + journal
+        replay) before giving up and failing pending requests."""
         while not self._stopped:
             try:
                 outs = self._blocking_recv(timeout_s=0.2)
             except Exception as e:  # noqa: BLE001 - engine died
-                self._loop.call_soon_threadsafe(self._fail_all, e)
+                if self._stopped:
+                    return
+                self.output_processor.stats.num_engine_deaths += 1
+                if self._try_recover(e):
+                    continue
+                if not self._post(self._fail_all, e):
+                    # Loop gone (consumer's asyncio.run ended): apply
+                    # the terminal state inline so errored/dead_error
+                    # reflect reality for the next caller.
+                    self._fail_all(e)
                 return
             if outs:
-                self._loop.call_soon_threadsafe(self._process_batch, outs)
+                while not self._post(self._process_batch, outs):
+                    # Bound loop closed between asyncio.run() calls:
+                    # wait for a new consumer to re-bind it.
+                    if self._stopped:
+                        return
+                    time.sleep(0.05)
+
+    # ------------------------------------------------------------------
+    # Crash recovery: restart supervisor + in-flight request replay
+    # ------------------------------------------------------------------
+    def _try_recover(self, err: Exception) -> bool:
+        """Respawn the dead core within the supervisor's restart budget
+        and replay journaled requests as continuation prefills. Returns
+        False once the budget circuit-breaks (the caller then fails
+        pending requests with the terminal EngineDeadError)."""
+        from vllm_distributed_tpu.utils import fault_injection
+        while not self._stopped:
+            delay = self._supervisor.next_delay()
+            if delay is None:
+                if self._supervisor.max_attempts > 0:
+                    logger.error(
+                        "engine core restart budget exhausted (%d in "
+                        "%.0fs); circuit-breaking to EngineDeadError",
+                        self._supervisor.max_attempts,
+                        self._supervisor.window_s)
+                return False
+            logger.warning("engine core died (%s); restarting in %.2fs",
+                           err, delay)
+            if self._stop_event.wait(delay) or self._stopped:
+                return False  # shutdown won the race: do NOT respawn
+            # Make sure every output batch shipped BEFORE the death has
+            # been applied to the output-processor state: the replay
+            # prompt below embeds "tokens generated so far", and a
+            # still-queued batch would otherwise be double-generated.
+            if not self._drain_loop_callbacks():
+                return False  # shutdown while waiting on the barrier
+            with self._core_lock:
+                if self._stopped:
+                    return False
+                storm = fault_injection.should_fire("restart.storm")
+                if storm:
+                    # Storm drill: the fresh core dies again immediately,
+                    # burning through the restart budget. Armed both
+                    # in-process (thread cores read this registry) and
+                    # via the environment (a respawned SUBPROCESS core
+                    # rebuilds its registry from VDT_FAULT_INJECT at
+                    # start, not from the parent's memory).
+                    fault_injection.inject("engine_core.die", max_fires=1)
+                    prev_env = os.environ.get("VDT_FAULT_INJECT")
+                    os.environ["VDT_FAULT_INJECT"] = (
+                        (prev_env + "," if prev_env else "")
+                        + "engine_core.die:1.0")
+                try:
+                    self.core.restart()
+                except Exception as e:  # noqa: BLE001 - spawn failed
+                    logger.error("engine core restart failed: %s", e)
+                    err = e
+                    continue
+                finally:
+                    if storm:
+                        if prev_env is None:
+                            os.environ.pop("VDT_FAULT_INJECT", None)
+                        else:
+                            os.environ["VDT_FAULT_INJECT"] = prev_env
+                self._replay_journal()
+            return True
+        return False
+
+    def _drain_loop_callbacks(self) -> bool:
+        """Barrier: returns True once every callback already scheduled
+        onto the event loop (queued _process_batch calls) has run —
+        replaying before they land would double-generate their tokens.
+        A closed loop counts as drained (its queued callbacks are
+        discarded, so those tokens were never delivered and MUST be
+        regenerated). Only a shutdown aborts the wait (False)."""
+        done = threading.Event()
+        if not self._post(done.set):
+            return True  # loop closed: queued callbacks never run
+        while not done.wait(timeout=10):
+            if self._stopped:
+                return False
+            if self._loop.is_closed():
+                # The loop accepted the barrier callback but closed
+                # before running it (asyncio.run teardown): discarded
+                # callbacks can never land, so the state IS drained.
+                return True
+            logger.warning("event loop has not drained its callback "
+                           "queue in 10s; delaying the journal replay")
+        return True
+
+    def _replay_journal(self) -> None:
+        """Resubmit every unfinished journaled request to the fresh core
+        as a continuation prefill: prompt = original prompt + tokens
+        already delivered, remaining token budget adjusted. With greedy
+        sampling the resumed stream is token-identical to an
+        uninterrupted run."""
+        with self._journal_lock:
+            pending = list(self._journal.items())
+        for rid, orig in pending:
+            req = self._continuation_request(rid, orig)
+            try:
+                self.core.add_request(req)
+            except Exception as e:  # noqa: BLE001 - fail THIS request
+                # (leaving it journaled-but-unsubmitted would hang its
+                # consumer forever while the fresh core serves others).
+                logger.error("replay of %s failed: %s", rid, e)
+                with self._journal_lock:
+                    self._journal.pop(rid, None)
+                replay_err = EngineDeadError(
+                    f"request {rid} could not be replayed after an "
+                    f"engine restart: {e}")
+                if not self._post(self._fail_request, rid, replay_err):
+                    self._fail_request(rid, replay_err)
+                continue
+            self.output_processor.stats.num_requests_replayed += 1
+            logger.info("replayed request %s (%d tokens already "
+                        "delivered)", rid,
+                        len(req.prompt_token_ids) -
+                        len(orig.prompt_token_ids))
+
+    def _continuation_request(self, rid: str, orig):
+        from vllm_distributed_tpu.request import continuation_request
+        state = self.output_processor.request_states.get(rid)
+        generated = (list(state.output_token_ids)
+                     if state is not None else [])
+        return continuation_request(orig, generated)
 
     def _blocking_recv(self, timeout_s: float):
         if isinstance(self.core, BackgroundEngineCore):
@@ -114,11 +285,17 @@ class AsyncLLM:
 
     def _process_batch(self, core_outputs) -> None:
         processed = self.output_processor.process_outputs(core_outputs)
-        if processed.reqs_to_abort:
-            try:
-                self.core.abort_requests(processed.reqs_to_abort)
-            except Exception:  # noqa: BLE001 - core racing shutdown
-                pass
+        self._abort_in_core(processed.reqs_to_abort)
+        # Journal reaping keys off the RAW core outputs plus front-end
+        # finishes (stop strings): even a request whose front-end state
+        # is already gone (abort races, replayed ghosts) must leave the
+        # journal once the core finishes it.
+        done = [o.req_id for o in core_outputs if o.finished]
+        done += processed.reqs_to_abort
+        if done:
+            with self._journal_lock:
+                for rid in done:
+                    self._journal.pop(rid, None)
         for ro in processed.request_outputs:
             q = self.request_queues.get(ro.request_id)
             if q is None:
@@ -127,6 +304,14 @@ class AsyncLLM:
             if ro.finished:
                 self.request_queues.pop(ro.request_id, None)
 
+    def _fail_request(self, request_id: str, err: Exception) -> None:
+        """Terminal error for ONE request (replay rejection) while the
+        engine itself stays healthy."""
+        self.output_processor.abort_requests([request_id])
+        q = self.request_queues.pop(request_id, None)
+        if q is not None:
+            q.put_nowait(err)
+
     def _fail_all(self, err: Exception) -> None:
         # Pending requests always surface a STRUCTURED EngineDeadError
         # (the OpenAI server maps it to 503 + detail), whatever the
@@ -134,8 +319,9 @@ class AsyncLLM:
         if not isinstance(err, EngineDeadError):
             err = EngineDeadError(f"{type(err).__name__}: {err}")
         self._dead_error = err
-        self.output_processor.stats.num_engine_deaths += 1
         logger.error("engine core died: %s", err)
+        with self._journal_lock:
+            self._journal.clear()
         for q in self.request_queues.values():
             q.put_nowait(err)
         self.request_queues.clear()
@@ -168,8 +354,13 @@ class AsyncLLM:
         self.request_queues[request_id] = queue
         self.output_processor.add_request(
             core_req, prompt=prompt if isinstance(prompt, str) else None)
-        self.core.add_request(core_req)
         try:
+            # Submission runs off-loop: during a supervisor restart the
+            # core lock is held for the respawn's duration, and the
+            # event loop must stay responsive (health checks, other
+            # consumers) while this add waits its turn.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._submit_to_core, core_req)
             while True:
                 item = await queue.get()
                 if item is _ABORTED:
@@ -182,19 +373,54 @@ class AsyncLLM:
         finally:
             if self.request_queues.pop(request_id, None) is not None:
                 # Consumer cancelled / errored mid-stream: abort upstream.
+                with self._journal_lock:
+                    self._journal.pop(request_id, None)
                 self.output_processor.abort_requests([request_id])
+                self._abort_in_core([request_id])
+
+    def _submit_to_core(self, core_req) -> None:
+        with self._core_lock:
+            self.core.add_request(core_req)
+            # Journaled only once the add landed in the CURRENT core
+            # incarnation (both under the core lock): a restart+replay
+            # can then never race this submission into a double add.
+            with self._journal_lock:
+                self._journal[core_req.request_id] = core_req
+
+    def _abort_in_core(self, request_ids: list[str]) -> None:
+        """Core-side abort from the event loop. The abort must never be
+        DROPPED (a request left decoding to max_tokens holds KV pages
+        for its whole budget), but the loop must also never stall on the
+        core lock for a restart's duration — so the lock wait happens on
+        an executor thread. Ordering with a concurrent restart is safe
+        either way: the journal entries are already popped, so a replay
+        skips these requests, and aborting an id the fresh core never
+        saw is a scheduler no-op."""
+        if not request_ids:
+            return
+
+        def _do() -> None:
+            with self._core_lock:
                 try:
-                    self.core.abort_requests([request_id])
-                except Exception:  # noqa: BLE001
+                    self.core.abort_requests(request_ids)
+                except Exception:  # noqa: BLE001 - dead/racing shutdown
                     pass
+
+        try:
+            asyncio.get_running_loop().run_in_executor(None, _do)
+        except RuntimeError:
+            # No running loop (teardown path): do it inline.
+            _do()
 
     async def abort(self, request_id: str) -> None:
         q = self.request_queues.pop(request_id, None)
         if q is not None:
             # Wake any generate() consumer blocked on this queue.
             q.put_nowait(_ABORTED)
+        with self._journal_lock:
+            self._journal.pop(request_id, None)
         self.output_processor.abort_requests([request_id])
-        self.core.abort_requests([request_id])
+        self._abort_in_core([request_id])
 
     async def encode(self, prompt,
                      request_id: Optional[str] = None,
@@ -219,12 +445,20 @@ class AsyncLLM:
         dir, or a per-replica list under multiprocess DP."""
         return await self._utility("profile", action)
 
+    def _send_utility_locked(self, method: str, args: tuple) -> int:
+        # Same discipline as _submit_to_core: the zmq input socket is
+        # not thread-safe, and submissions/aborts/restarts all touch it
+        # under _core_lock from other threads.
+        with self._core_lock:
+            return self.core.send_utility(method, *args)
+
     async def _utility(self, method: str, *args):
         if isinstance(self.core, BackgroundEngineCore):
             return getattr(self.core.core, method)(*args)
         # MP core: the pump thread owns the output socket; poll for the
-        # stashed result.
-        call_id = self.core.send_utility(method, *args)
+        # stashed result. The send runs off-loop under the core lock.
+        call_id = await asyncio.get_running_loop().run_in_executor(
+            None, self._send_utility_locked, method, args)
         sentinel = object()
         for _ in range(500):
             value = self.core.fetch_result(call_id, sentinel)
@@ -237,6 +471,11 @@ class AsyncLLM:
 
     def shutdown(self) -> None:
         self._stopped = True
+        self._stop_event.set()
         if self._pump is not None:
             self._pump.join(timeout=5)
-        self.core.shutdown()
+        # Under the core lock: a supervisor restart already in flight
+        # must finish before teardown, or the freshly respawned core
+        # would outlive this shutdown with no owner.
+        with self._core_lock:
+            self.core.shutdown()

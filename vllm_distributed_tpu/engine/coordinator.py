@@ -38,6 +38,7 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
     sock = ctx.socket(zmq.REP)
     sock.bind(addr)
     counts = [0] * num_engines
+    healthy = [True] * num_engines
     try:
         while True:
             raw = sock.recv()
@@ -53,13 +54,28 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
                     counts[engine] += int(msg["delta"])
                     reply = {"ok": True}
                 elif op == "route":
-                    engine = min(range(num_engines),
-                                 key=counts.__getitem__)
+                    live = [i for i in range(num_engines) if healthy[i]]
+                    if not live:
+                        raise ValueError("no healthy engines to route to")
+                    engine = min(live, key=counts.__getitem__)
                     counts[engine] += 1  # route implies one admission
                     reply = {"engine": engine}
+                elif op == "health":
+                    # DP failover/resurrection: a downed engine leaves
+                    # the routing set, and clearing its count unwinds
+                    # the admissions its death stranded (the front-end
+                    # re-routes that load, which re-reports it).
+                    engine = int(msg["engine"])
+                    if not 0 <= engine < num_engines:
+                        raise ValueError(f"engine {engine} out of range")
+                    healthy[engine] = bool(msg["up"])
+                    if msg.get("clear"):
+                        counts[engine] = 0
+                    reply = {"ok": True}
                 elif op == "counts":
                     reply = {"counts": list(counts),
-                             "engines_running": [c > 0 for c in counts]}
+                             "engines_running": [c > 0 for c in counts],
+                             "healthy": list(healthy)}
                 elif op == "shutdown":
                     sock.send(pack({"ok": True}))
                     break
@@ -115,6 +131,16 @@ class DPCoordinatorClient:
 
     def report(self, engine: int, delta: int) -> None:
         self._call(op="report", engine=engine, delta=delta)
+
+    def set_health(self, engine: int, up: bool, *,
+                   clear: bool = False) -> None:
+        """Take an engine out of (or return it to) the routing set;
+        ``clear`` zeroes its admission count (failover migrates the
+        load, re-reporting it against the replicas that absorb it)."""
+        self._call(op="health", engine=engine, up=up, clear=clear)
+
+    def healthy(self) -> list[bool]:
+        return list(self._call(op="counts")["healthy"])
 
     def counts(self) -> list[int]:
         return list(self._call(op="counts")["counts"])

@@ -37,6 +37,54 @@ class EngineDeadError(RuntimeError):
         super().__init__(detail)
 
 
+class RestartSupervisor:
+    """Restart budget + backoff policy for a dead engine core.
+
+    The recovery ladder's "respawn" rung: each death asks
+    ``next_delay()``; the supervisor grants at most ``max_attempts``
+    restarts inside a sliding ``window_s`` window, with exponential
+    backoff between grants, and returns None once the budget is burnt —
+    the caller then circuit-breaks to the terminal EngineDeadError
+    (reference analogue: the crash-loop backoff any production
+    supervisor, e.g. systemd's StartLimitIntervalSec, applies)."""
+
+    def __init__(self, max_attempts: int, window_s: float,
+                 backoff_base_s: float, backoff_max_s: float) -> None:
+        self.max_attempts = max_attempts
+        self.window_s = window_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._attempts: list[float] = []  # monotonic grant times
+
+    @classmethod
+    def from_config(cls, config: EngineConfig) -> "RestartSupervisor":
+        ft = config.fault_tolerance_config
+        return cls(ft.restart_max_attempts, ft.restart_window_s,
+                   ft.restart_backoff_base_s, ft.restart_backoff_max_s)
+
+    @property
+    def exhausted(self) -> bool:
+        self._expire()
+        return len(self._attempts) >= self.max_attempts
+
+    def _expire(self) -> None:
+        cutoff = time.monotonic() - self.window_s
+        self._attempts = [t for t in self._attempts if t > cutoff]
+
+    def next_delay(self) -> Optional[float]:
+        """Grant one restart attempt: the backoff to sleep before it,
+        or None when the budget inside the window is exhausted (the
+        circuit breaker). max_attempts=0 always refuses (recovery
+        disabled)."""
+        self._expire()
+        if len(self._attempts) >= self.max_attempts:
+            return None
+        delay = min(self.backoff_base_s * (2 ** len(self._attempts)),
+                    self.backoff_max_s)
+        self._attempts.append(time.monotonic())
+        return delay
+
+
 class EngineCoreClient:
 
     @staticmethod
@@ -81,7 +129,22 @@ class InprocClient(EngineCoreClient):
 
     def __init__(self, config: EngineConfig) -> None:
         from vllm_distributed_tpu.engine.core import EngineCore
+        from vllm_distributed_tpu.utils import fault_injection
+        fault_injection.fire_or_raise("core_proc.spawn_fail")
+        self.config = config
         self.engine_core = EngineCore(config)
+
+    def restart(self) -> None:
+        """Rebuild the in-process core (DP resurrection probe). The old
+        core's requests are gone — the caller replays its journal."""
+        from vllm_distributed_tpu.engine.core import EngineCore
+        from vllm_distributed_tpu.utils import fault_injection
+        fault_injection.fire_or_raise("core_proc.spawn_fail")
+        try:
+            self.engine_core.shutdown()
+        except Exception:  # noqa: BLE001 - the dead core may be torn
+            pass
+        self.engine_core = EngineCore(self.config)
 
     def add_request(self, request: EngineCoreRequest) -> None:
         self.engine_core.add_request(request)
@@ -122,46 +185,17 @@ class SyncMPClient(EngineCoreClient):
     """
 
     def __init__(self, config: EngineConfig) -> None:
-        import multiprocessing
-
         import zmq
 
-        from vllm_distributed_tpu import envs
         from vllm_distributed_tpu.engine import serial
         self._serial = serial
+        self.config = config
 
-        rid = uuid.uuid4().hex[:8]
         self._sock_dir = tempfile.mkdtemp(prefix="vdt-zmq-")
-        input_addr = f"ipc://{self._sock_dir}/input-{rid}"
-        output_addr = f"ipc://{self._sock_dir}/output-{rid}"
-
         self.ctx = zmq.Context()
-        self.input_sock = self.ctx.socket(zmq.PUSH)
-        self.input_sock.bind(input_addr)
-        self.output_sock = self.ctx.socket(zmq.PULL)
-        self.output_sock.bind(output_addr)
-
-        # spawn (not fork): the child must initialize its own JAX backend.
-        mp_ctx = multiprocessing.get_context("spawn")
-        from vllm_distributed_tpu.engine.core_proc import run_engine_core
-        self.proc = mp_ctx.Process(
-            target=run_engine_core,
-            args=(config, input_addr, output_addr),
-            daemon=True, name="vdt-engine-core")
-        self.proc.start()
-
-        # Ready handshake (the child compiles/loads weights first).
-        timeout_ms = int(envs.VDT_RPC_TIMEOUT * 1000)
-        if not self.output_sock.poll(timeout_ms):
-            self._kill()
-            raise EngineDeadError(
-                f"engine core did not become ready in {timeout_ms} ms")
-        msg = serial.unpack(self.output_sock.recv())
-        if msg.get("t") != "ready":
-            self._kill()
-            raise EngineDeadError(f"bad handshake: {msg}")
-        config.cache_config.num_gpu_blocks = msg.get("num_pages")
-        logger.info("engine core proc ready (pid %d)", self.proc.pid)
+        self.input_sock = None
+        self.output_sock = None
+        self.proc = None
 
         # Live request ids (NOT a counter: a client-side stop abort can
         # race a core-side finish for the same request; set-discard makes
@@ -179,9 +213,69 @@ class SyncMPClient(EngineCoreClient):
             config.fault_tolerance_config.heartbeat_timeout_s
         self._last_alive = time.monotonic()
 
+        self._spawn()
+
+    def _spawn(self) -> None:
+        """Spawn the core subprocess and run the ready handshake. Each
+        incarnation gets FRESH ipc endpoints: messages buffered toward
+        (or from) a dead incarnation must never reach its replacement —
+        the journal replay, not the socket backlog, is the source of
+        truth after a restart."""
+        import multiprocessing
+
+        import zmq
+
+        from vllm_distributed_tpu import envs
+        from vllm_distributed_tpu.utils import fault_injection
+        fault_injection.fire_or_raise("core_proc.spawn_fail")
+
+        for sock in (self.input_sock, self.output_sock):
+            if sock is not None:
+                sock.close(linger=0)
+        rid = uuid.uuid4().hex[:8]
+        input_addr = f"ipc://{self._sock_dir}/input-{rid}"
+        output_addr = f"ipc://{self._sock_dir}/output-{rid}"
+        self.input_sock = self.ctx.socket(zmq.PUSH)
+        self.input_sock.bind(input_addr)
+        self.output_sock = self.ctx.socket(zmq.PULL)
+        self.output_sock.bind(output_addr)
+
+        # spawn (not fork): the child must initialize its own JAX backend.
+        mp_ctx = multiprocessing.get_context("spawn")
+        from vllm_distributed_tpu.engine.core_proc import run_engine_core
+        self.proc = mp_ctx.Process(
+            target=run_engine_core,
+            args=(self.config, input_addr, output_addr),
+            daemon=True, name="vdt-engine-core")
+        self.proc.start()
+
+        # Ready handshake (the child compiles/loads weights first).
+        timeout_ms = int(envs.VDT_RPC_TIMEOUT * 1000)
+        if not self.output_sock.poll(timeout_ms):
+            self._kill()
+            raise EngineDeadError(
+                f"engine core did not become ready in {timeout_ms} ms")
+        msg = self._serial.unpack(self.output_sock.recv())
+        if msg.get("t") != "ready":
+            self._kill()
+            raise EngineDeadError(f"bad handshake: {msg}")
+        self.config.cache_config.num_gpu_blocks = msg.get("num_pages")
+        self._last_alive = time.monotonic()
+        logger.info("engine core proc ready (pid %d)", self.proc.pid)
+
+    def restart(self) -> None:
+        """Respawn a dead core subprocess. In-flight state is gone —
+        the caller (AsyncLLM's supervisor / the DP failover path)
+        replays its journal afterwards."""
+        self._kill()
+        self._live.clear()
+        self._pending_outputs.clear()
+        self._results.clear()
+        self._spawn()
+
     # ------------------------------------------------------------------
     def _kill(self) -> None:
-        if self.proc.is_alive():
+        if self.proc is not None and self.proc.is_alive():
             self.proc.terminate()
             self.proc.join(timeout=5)
 
@@ -319,7 +413,7 @@ class SyncMPClient(EngineCoreClient):
 
     def shutdown(self) -> None:
         try:
-            if self.proc.is_alive():
+            if self.proc is not None and self.proc.is_alive():
                 self.input_sock.send(self._serial.pack({"t": "shutdown"}))
                 self.proc.join(timeout=10)
         except Exception:

@@ -96,7 +96,8 @@ def _heartbeat_loop(ctx: zmq.Context, output_addr: str, interval: float,
             if fault_injection.should_fire("heartbeat.stall"):
                 continue  # injected stall: skip this beat
             try:
-                sock.send(serial.pack({"t": "hb", "ts": time.time()}))
+                sock.send(serial.pack(  # wallclock-ok: informational beat ts
+                    {"t": "hb", "ts": time.time()}))
             except zmq.Again:
                 # Transient: the client hasn't drained in a while (idle
                 # sync user) and the HWM is full. Keep beating — exiting
@@ -217,9 +218,34 @@ class BackgroundEngineCore:
     """
 
     def __init__(self, config) -> None:
+        fault_injection.fire_or_raise("core_proc.spawn_fail")
+        self.config = config
         self.core = EngineCore(config)
         self.input_queue: "queue.Queue[tuple]" = queue.Queue()
         self.output_queue: "queue.Queue[object]" = queue.Queue()
+        self._dead = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="engine-core")
+        self._thread.start()
+
+    def restart(self) -> None:
+        """Rebuild the core + run thread after a death. The queue
+        OBJECTS survive (an add_request racing the restart lands in the
+        same input queue the fresh thread drains); stale items queued
+        before the restart are discarded first. In-flight request state
+        is gone — the caller replays its journal."""
+        fault_injection.fire_or_raise("core_proc.spawn_fail")
+        try:
+            self.core.shutdown()
+        except Exception:  # noqa: BLE001 - dead core teardown
+            pass
+        for q in (self.input_queue, self.output_queue):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        self.core = EngineCore(self.config)
         self._dead = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="engine-core")

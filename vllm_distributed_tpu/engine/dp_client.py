@@ -22,6 +22,9 @@ hosts and keeps replicas isolated on TPU hosts.
 """
 
 import copy
+import queue
+import threading
+import time
 from typing import Optional
 
 from vllm_distributed_tpu.config import EngineConfig
@@ -29,9 +32,11 @@ from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
 from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
                                                      EngineDeadError,
                                                      InprocClient,
+                                                     RestartSupervisor,
                                                      SyncMPClient)
 from vllm_distributed_tpu.logger import init_logger
-from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.request import (EngineCoreRequest,
+                                          continuation_request)
 
 logger = init_logger(__name__)
 
@@ -99,55 +104,123 @@ class DPEngineClient(EngineCoreClient):
         self._util_id = 0
         self._pending_util: dict[int, list[tuple]] = {}
         self._util_partial: dict[int, dict[int, object]] = {}
+        # Balancer-state lock: admissions arrive from AsyncLLM executor
+        # threads while failover/finish bookkeeping runs on the pump
+        # thread — every _owner/_live/_down/journal mutation (and the
+        # client add_request sends they guard) happens under this RLock
+        # (reentrant: _admit and _failover call each other). Output
+        # POLLS stay outside it so admissions never wait on a poll.
+        self._lock = threading.RLock()
+        # Failover state: per-request journal (original request +
+        # tokens delivered so far) for continuation-prefill migration,
+        # replicas currently out of rotation, and a per-replica restart
+        # budget gating the resurrection probe.
+        self._requests: dict[str, EngineCoreRequest] = {}
+        self._progress: dict[str, list[int]] = {}
+        self._down: set[int] = set()
+        self._supervisors = [RestartSupervisor.from_config(config)
+                             for _ in range(n)]
+        self._probe_interval = \
+            config.fault_tolerance_config.replica_probe_interval_s
+        self._next_probe: dict[int, float] = {}
+        # In-flight resurrection probes (restart runs on a thread; the
+        # result queue hands completion back to the caller's thread).
+        self._probing: set[int] = set()
+        self._probe_results: "queue.Queue[tuple[int, bool]]" = \
+            queue.Queue()
+        self.replica_failovers = 0
+        self.replica_resurrections = 0
 
     # ------------------------------------------------------------------
     def _pick_replica(self) -> int:
+        if len(self._down) == len(self.clients):
+            raise EngineDeadError("all DP replicas are dead")
         if self.coordinator is not None:
-            # The coordinator's route() already accounts the admission.
+            # The coordinator's route() already accounts the admission
+            # (and skips replicas reported down via set_health).
             return self.coordinator.route()
         n = len(self.clients)
         best, best_load = None, None
         for off in range(n):
             i = (self._rr + off) % n
+            if i in self._down:
+                continue
             load = len(self._live[i])
             if best_load is None or load < best_load:
                 best, best_load = i, load
+        if best is None:
+            raise EngineDeadError("all DP replicas are dead")
         self._rr = (best + 1) % n
         return best
 
     def add_request(self, request: EngineCoreRequest) -> None:
-        i = self._pick_replica()
-        self._owner[request.request_id] = i
-        self._live[i].add(request.request_id)
-        try:
-            self.clients[i].add_request(request)
-        except Exception as e:
-            # Unwind the admission accounting (route() already
-            # incremented the coordinator's count).
-            self._owner.pop(request.request_id, None)
-            self._live[i].discard(request.request_id)
-            if self.coordinator is not None:
-                self.coordinator.report(i, -1)
-            if isinstance(e, EngineDeadError):
-                raise _tag_replica(e, i) from e
-            raise
+        with self._lock:
+            self._requests[request.request_id] = request
+            try:
+                self._admit(request)
+            except Exception:
+                self._requests.pop(request.request_id, None)
+                self._progress.pop(request.request_id, None)
+                raise
+
+    def _admit(self, request: EngineCoreRequest) -> None:
+        """Place a request on a healthy replica, failing over any
+        replica found dead at admission time (its own journaled load
+        migrates too), until the request lands or no replica is left."""
+        while True:
+            i = self._pick_replica()
+            try:
+                self.clients[i].add_request(request)
+            except Exception as e:
+                # Unwind the admission accounting (route() already
+                # incremented the coordinator's count).
+                if self.coordinator is not None:
+                    self.coordinator.report(i, -1)
+                if isinstance(e, EngineDeadError):
+                    # Dead replica discovered at admission: take it out
+                    # of rotation, migrate its load, then retry THIS
+                    # request on whatever remains.
+                    self._failover(i, e)
+                    continue
+                raise
+            self._owner[request.request_id] = i
+            self._live[i].add(request.request_id)
+            return
 
     def abort_requests(self, request_ids: list[str]) -> None:
-        by_replica: dict[int, list[str]] = {}
-        for rid in request_ids:
-            i = self._owner.pop(rid, None)
-            if i is not None:
-                self._live[i].discard(rid)
-                by_replica.setdefault(i, []).append(rid)
-        for i, rids in by_replica.items():
-            self.clients[i].abort_requests(rids)
-            if self.coordinator is not None:
-                self.coordinator.report(i, -len(rids))
+        with self._lock:
+            by_replica: dict[int, list[str]] = {}
+            for rid in request_ids:
+                self._requests.pop(rid, None)
+                self._progress.pop(rid, None)
+                i = self._owner.pop(rid, None)
+                if i is not None:
+                    self._live[i].discard(rid)
+                    by_replica.setdefault(i, []).append(rid)
+            for i, rids in by_replica.items():
+                try:
+                    self.clients[i].abort_requests(rids)
+                except Exception:  # noqa: BLE001 - replica dead; its
+                    # journal entries are gone, so failover skips them.
+                    pass
+                if self.coordinator is not None:
+                    self.coordinator.report(i, -len(rids))
 
     def _mark_finished(self, outs: list[EngineCoreOutput]) -> None:
+        with self._lock:
+            self._mark_finished_locked(outs)
+
+    def _mark_finished_locked(self, outs: list[EngineCoreOutput]) -> None:
         finished_per: dict[int, int] = {}
         for o in outs:
+            if o.new_token_ids and o.req_id in self._requests:
+                # Failover journal: tokens already delivered downstream
+                # (a migrated continuation must not regenerate them).
+                self._progress.setdefault(o.req_id,
+                                          []).extend(o.new_token_ids)
             if o.finished:
+                self._requests.pop(o.req_id, None)
+                self._progress.pop(o.req_id, None)
                 i = self._owner.pop(o.req_id, None)
                 if i is not None:
                     self._live[i].discard(o.req_id)
@@ -158,33 +231,168 @@ class DPEngineClient(EngineCoreClient):
                 self.coordinator.report(i, -k)
 
     # ------------------------------------------------------------------
+    # Replica failover + resurrection
+    # ------------------------------------------------------------------
+    def _failover(self, i: int, err: Exception) -> None:
+        """Take replica ``i`` out of rotation and migrate its journaled
+        requests to healthy replicas as continuation prefills. Raises
+        (tagged) only when no healthy replica remains."""
+        with self._lock:
+            self._failover_locked(i, err)
+
+    def _failover_locked(self, i: int, err: Exception) -> None:
+        if i in self._down:
+            return
+        self._down.add(i)
+        self.replica_failovers += 1
+        self._next_probe[i] = time.monotonic() + self._probe_interval
+        stranded = [rid for rid, owner in self._owner.items()
+                    if owner == i]
+        logger.error(
+            "DP replica %d died (%s); failing over %d in-flight "
+            "request(s)", i, err, len(stranded))
+        if self.coordinator is not None:
+            # Out of the routing set; clearing the count unwinds the
+            # stranded admissions (migration re-reports them against
+            # the replicas that absorb the load).
+            self.coordinator.set_health(i, False, clear=True)
+        for rid in stranded:
+            self._owner.pop(rid, None)
+            self._live[i].discard(rid)
+        for rid in stranded:
+            orig = self._requests.get(rid)
+            if orig is None:
+                continue
+            req = continuation_request(orig,
+                                       self._progress.get(rid, []))
+            try:
+                self._admit(req)
+            except EngineDeadError:
+                # No healthy replica absorbed it: every replica is down.
+                raise
+            logger.info("migrated request %s to replica %d", rid,
+                        self._owner[rid])
+
+    def _check_any_alive(self) -> None:
+        """Terminal check: with EVERY replica out of rotation the output
+        paths would otherwise poll nothing forever — surface the
+        deployment-wide death so the upstream supervisor (AsyncLLM) can
+        attempt a full-fleet restart, or fail pending requests. Held
+        back while a resurrection probe is in flight: a fleet restart
+        would race the probe thread's exclusive use of that replica's
+        transport."""
+        if len(self._down) == len(self.clients) and not self._probing:
+            raise EngineDeadError("all DP replicas are dead")
+
+    def _maybe_resurrect(self) -> None:
+        """Periodic probe: try to restart downed replicas, budgeted by
+        their per-replica supervisor. The restart itself (spawn +
+        ready handshake — minutes for a real core) runs on a probe
+        THREAD so the output path keeps pumping healthy replicas;
+        results apply here, on the caller's thread. A downed replica's
+        sockets are untouched by the output path (it is skipped while
+        in _down), so the probe thread has exclusive access."""
+        with self._lock:
+            while True:  # apply finished probe results first
+                try:
+                    i, ok = self._probe_results.get_nowait()
+                except queue.Empty:
+                    break
+                self._probing.discard(i)
+                if not ok:
+                    continue
+                self._down.discard(i)
+                self.replica_resurrections += 1
+                if self.coordinator is not None:
+                    self.coordinator.set_health(i, True)
+                logger.info("DP replica %d resurrected; back in "
+                            "rotation", i)
+            if not self._down or self._probe_interval <= 0:
+                return
+            now = time.monotonic()
+            for i in sorted(self._down):
+                if i in self._probing or now < self._next_probe.get(i, 0):
+                    continue
+                self._next_probe[i] = now + self._probe_interval
+                if self._supervisors[i].next_delay() is None:
+                    continue  # budget burnt until the window slides
+                self._probing.add(i)
+                threading.Thread(target=self._probe_restart, args=(i,),
+                                 name=f"dp-resurrect-{i}",
+                                 daemon=True).start()
+
+    def _probe_restart(self, i: int) -> None:
+        try:
+            self.clients[i].restart()
+        except Exception as e:  # noqa: BLE001 - still dead
+            logger.warning("DP replica %d resurrection failed: %s", i, e)
+            self._probe_results.put((i, False))
+            return
+        self._probe_results.put((i, True))
+
+    def restart(self) -> None:
+        """Full-fleet restart (AsyncLLM's supervisor calls this once
+        every replica is dead): every replica respawns and all balancer
+        state clears — the upstream journal replays the load."""
+        with self._lock:
+            for i, client in enumerate(self.clients):
+                client.restart()
+                if self.coordinator is not None:
+                    self.coordinator.set_health(i, True, clear=True)
+            self._owner.clear()
+            self._requests.clear()
+            self._progress.clear()
+            self._down.clear()
+            self._next_probe.clear()
+            for live in self._live:
+                live.clear()
+
+    # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
         """Merged next outputs across replicas.
 
         In-process replicas are stepped inline (each busy replica once);
         subprocess replicas are polled, blocking until at least one batch
         arrives while any request is live."""
+        self._maybe_resurrect()
+        self._check_any_alive()
         outs: list[EngineCoreOutput] = []
         if not self.is_mp:
             for i, client in enumerate(self.clients):
+                if i in self._down:
+                    continue
                 if self._live[i] or self._has_kv_work(client):
                     # KV-transfer work (deferred sends, held pulls)
                     # needs step-polls even with no live requests.
-                    outs.extend(client.get_output())
+                    try:
+                        outs.extend(client.get_output())
+                    except Exception as e:  # noqa: BLE001 - one
+                        # replica's step failure is that replica's
+                        # death, not the deployment's: fail over.
+                        self._failover(i, e)
             self._mark_finished(outs)
             return outs
         while any(self._live):
+            polled = False
             for i, client in enumerate(self.clients):
-                if not self._live[i]:
+                if not self._live[i] or i in self._down:
                     continue
+                polled = True
                 try:
                     batch = client.recv_outputs(timeout_ms=20)
                 except EngineDeadError as e:
-                    raise _tag_replica(e, i) from e
+                    self._failover(i, _tag_replica(e, i))
+                    continue
                 if batch:
                     outs.extend(batch)
             if outs:
                 break
+            if not polled:
+                # All live work sits on downed replicas (probe in
+                # flight): pace the loop instead of spinning.
+                time.sleep(0.02)
+                self._maybe_resurrect()
+                self._check_any_alive()
         self._mark_finished(outs)
         return outs
 
@@ -193,15 +401,28 @@ class DPEngineClient(EngineCoreClient):
         """Pump-thread receive (AsyncLLM): poll every replica once within
         the timeout budget; None when nothing arrived."""
         assert self.is_mp, "recv_outputs requires subprocess replicas"
+        self._maybe_resurrect()
+        self._check_any_alive()
         per = max(timeout_ms // len(self.clients), 1)
         outs: list[EngineCoreOutput] = []
+        polled = False
         for i, client in enumerate(self.clients):
+            if i in self._down:
+                continue
+            polled = True
             try:
                 batch = client.recv_outputs(timeout_ms=per)
             except EngineDeadError as e:
-                raise _tag_replica(e, i) from e
+                self._failover(i, _tag_replica(e, i))
+                continue
             if batch:
                 outs.extend(batch)
+        if not polled:
+            # Every replica is down (resurrection probe in flight):
+            # honor the caller's poll budget instead of busy-spinning
+            # the pump thread for the probe's whole duration.
+            time.sleep(timeout_ms / 1000)
+            return None
         self._mark_finished(outs)
         return outs or None
 
@@ -215,6 +436,7 @@ class DPEngineClient(EngineCoreClient):
         self._pending_util[self._util_id] = [
             (idx, c, c.send_utility(method, *args))
             for idx, c in enumerate(self.clients)
+            if idx not in self._down
         ]
         self._util_partial[self._util_id] = {}
         return self._util_id
@@ -255,8 +477,10 @@ class DPEngineClient(EngineCoreClient):
         """Blocking fan-out RPC (sleep/wake_up/profile/...): every
         replica runs it; dict results aggregate, others come back as a
         per-replica list."""
-        values = [c.call_utility(method, *args) for c in self.clients]
-        if all(isinstance(v, dict) for v in values):
+        values = [c.call_utility(method, *args)
+                  for i, c in enumerate(self.clients)
+                  if i not in self._down]
+        if values and all(isinstance(v, dict) for v in values):
             return self._aggregate_stats(values)
         return values
 
@@ -268,16 +492,29 @@ class DPEngineClient(EngineCoreClient):
     def _aggregate_stats(self, per: list[dict]) -> dict:
         agg: dict = {"dp_size": len(self.clients),
                      "dp_request_counts": self.request_counts(),
-                     "dp_replicas": per}
-        # Sum numeric leaves across replicas for the headline counters.
+                     "dp_replicas": per,
+                     "dp_replicas_down": sorted(self._down),
+                     "replica_failovers": self.replica_failovers,
+                     "replica_resurrections":
+                         self.replica_resurrections}
+        # Sum numeric leaves across replicas for the headline counters;
+        # ratio gauges average instead (a 4-replica deployment at 25%
+        # KV usage is at 25%, not 100% — the admission gate's KV shed
+        # reads this value).
+        ratio_gauges = ("kv_cache_usage", "spec_acceptance_rate")
         for stats in per:
             for k, v in stats.items():
                 if isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
+        for k in ratio_gauges:
+            if k in agg and per:
+                agg[k] = agg[k] / len(per)
         return agg
 
     def get_stats(self) -> dict:
-        return self._aggregate_stats([c.get_stats() for c in self.clients])
+        return self._aggregate_stats([c.get_stats()
+                                      for i, c in enumerate(self.clients)
+                                      if i not in self._down])
 
     def shutdown(self) -> None:
         if self.coordinator is not None:

@@ -221,7 +221,7 @@ class Processor:
             prompt_token_ids=prompt_token_ids,
             sampling_params=sampling_params,
             eos_token_id=self.eos_token_id,
-            arrival_time=arrival_time or time.time(),
+            arrival_time=arrival_time or time.time(),  # wallclock-ok
             priority=priority,
             kv_transfer_params=kv_transfer_params,
             lora_request=lora_request,

@@ -1,0 +1,186 @@
+"""API-level admission control, overload shedding, and graceful drain.
+
+Reference: the reference server's --max-concurrent-requests /
+api_server_count front-door limits plus the scheduler's own waiting
+queue; here the OpenAI server gets an explicit bounded admission gate so
+overload degrades into fast 429s with ``Retry-After`` instead of an
+unbounded queue whose tail latency IS the outage. Two pressure signals
+feed the gate:
+
+* **queue depth** — concurrent admitted generation requests, with
+  high/low watermark hysteresis (above high: shed; keep shedding until
+  depth falls back to low), and
+* **free-KV-page pressure** — the engine's ``kv_cache_usage`` gauge,
+  sampled at most twice a second, so a KV-saturated engine sheds before
+  its waiting queue does.
+
+SIGTERM flips the gate into **drain mode**: no new admissions (503 +
+``Retry-After``), in-flight requests run to completion, and the server
+exits once the gate is empty or the drain deadline passes. The
+``admission.stall`` fault point leaks one slot per fire, building
+deterministic queue-depth pressure for overload drills.
+"""
+
+import asyncio
+import time
+from typing import Optional
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.utils import fault_injection
+
+logger = init_logger(__name__)
+
+
+class AdmissionRejected(Exception):
+    """Raised by acquire() when the gate refuses the request; carries
+    the HTTP status (429 overload / 503 drain) and Retry-After hint."""
+
+    def __init__(self, message: str, status: int,
+                 retry_after_s: int) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Bounded admission gate for the OpenAI server's generation
+    endpoints. All state lives on the event loop thread — handlers call
+    acquire()/release() without extra locking."""
+
+    def __init__(self, engine, *, high_watermark: int,
+                 low_watermark: int = 0, kv_high: float = 0.0,
+                 retry_after_s: int = 1) -> None:
+        self.engine = engine
+        self.high_watermark = high_watermark
+        self.low_watermark = (low_watermark if low_watermark > 0 else
+                              max(1, (3 * high_watermark) // 4))
+        self.kv_high = kv_high
+        # KV hysteresis floor: stop shedding once usage drops 5 points.
+        self.kv_low = max(0.0, kv_high - 0.05)
+        self.retry_after_s = retry_after_s
+
+        self.depth = 0  # admitted, unfinished generation requests
+        self.max_depth_seen = 0
+        self._shedding = False
+        self.draining = False
+        self._drain_started: Optional[float] = None
+        self._drain_done = asyncio.Event()
+        # Cached KV usage sample (refreshed at most every 0.5 s).
+        self._kv_usage = 0.0
+        self._kv_sampled_at = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.high_watermark > 0
+
+    # ------------------------------------------------------------------
+    async def _kv_pressure(self) -> float:
+        if self.kv_high <= 0:
+            return 0.0
+        now = time.monotonic()
+        if now - self._kv_sampled_at >= 0.5:
+            self._kv_sampled_at = now
+            try:
+                # Hard-bounded: a slow stats RPC (e.g. an MP core whose
+                # pump thread hasn't started yet) must never stall the
+                # admission path — keep the stale sample instead.
+                stats = await asyncio.wait_for(self.engine.get_stats(),
+                                               timeout=0.2)
+                self._kv_usage = float(stats.get("kv_cache_usage", 0.0))
+            except Exception:  # noqa: BLE001 - engine busy/restarting;
+                # keep the stale sample rather than blocking admission.
+                pass
+        return self._kv_usage
+
+    def _reject(self, message: str, status: int = 429) -> None:
+        stats = getattr(self.engine.output_processor, "stats", None)
+        if stats is not None:
+            stats.num_requests_shed += 1
+        raise AdmissionRejected(message, status, self.retry_after_s)
+
+    async def acquire(self) -> None:
+        """Admit one generation request or raise AdmissionRejected.
+        The caller MUST pair a successful acquire with release().
+        Depth is tracked even with shedding disabled (high_watermark=0)
+        — the SIGTERM drain needs an accurate in-flight count either
+        way."""
+        if self.draining:
+            self._reject("server is draining for shutdown", status=503)
+        if not self.enabled:
+            self.depth += 1
+            return
+        if fault_injection.should_fire("admission.stall"):
+            # Drill: a slot that is admitted but never released —
+            # deterministic queue-depth pressure toward the watermark.
+            self.depth += 1
+            self.max_depth_seen = max(self.max_depth_seen, self.depth)
+        kv = await self._kv_pressure()
+        if self._shedding:
+            # Hysteresis: shedding continues until BOTH signals fall to
+            # their low watermarks, so the gate flaps once per overload
+            # episode instead of once per request.
+            if (self.depth > self.low_watermark
+                    or (self.kv_high > 0 and kv > self.kv_low)):
+                self._reject(
+                    f"shedding until load falls below the low "
+                    f"watermark (depth {self.depth}/"
+                    f"{self.low_watermark}, kv {kv:.2f})")
+            self._shedding = False
+        if self.depth >= self.high_watermark:
+            self._shedding = True
+            self._reject(
+                f"admission queue full ({self.depth}/"
+                f"{self.high_watermark})")
+        if self.kv_high > 0 and kv >= self.kv_high:
+            self._shedding = True
+            self._reject(
+                f"KV cache pressure {kv:.2f} >= {self.kv_high:.2f}")
+        self.depth += 1
+        self.max_depth_seen = max(self.max_depth_seen, self.depth)
+
+    def release(self) -> None:
+        self.depth = max(0, self.depth - 1)
+        if self.draining and self.depth == 0:
+            self._drain_done.set()
+
+    # ------------------------------------------------------------------
+    # Graceful drain (SIGTERM)
+    # ------------------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting; release() of the last in-flight request (or
+        the drain deadline) completes the drain."""
+        if self.draining:
+            return
+        self.draining = True
+        self._drain_started = time.monotonic()
+        if self.depth == 0:
+            self._drain_done.set()
+        logger.warning("drain mode: admission stopped, %d request(s) "
+                       "in flight", self.depth)
+
+    async def wait_drained(self, timeout_s: float) -> float:
+        """Block until in-flight work finishes or the deadline passes;
+        returns (and records) the drain duration."""
+        try:
+            await asyncio.wait_for(self._drain_done.wait(), timeout_s)
+        except asyncio.TimeoutError:
+            logger.error("drain deadline (%.0fs) passed with %d "
+                         "request(s) still in flight", timeout_s,
+                         self.depth)
+        duration = time.monotonic() - (self._drain_started or
+                                       time.monotonic())
+        stats = getattr(self.engine.output_processor, "stats", None)
+        if stats is not None:
+            stats.drain_duration_seconds = duration
+        return duration
+
+    @classmethod
+    def from_envs(cls, engine) -> "AdmissionController":
+        from vllm_distributed_tpu import envs
+        return cls(
+            engine,
+            high_watermark=envs.VDT_ADMISSION_HIGH_WATERMARK,
+            low_watermark=envs.VDT_ADMISSION_LOW_WATERMARK,
+            kv_high=envs.VDT_ADMISSION_KV_HIGH,
+            retry_after_s=envs.VDT_RETRY_AFTER_S,
+        )

@@ -9,6 +9,7 @@ engine death — reference: entrypoints/launcher.py).
 
 import asyncio
 import json
+import signal
 import time
 from typing import Optional
 
@@ -17,6 +18,8 @@ from aiohttp import web
 from vllm_distributed_tpu.engine.async_llm import AsyncLLM
 from vllm_distributed_tpu.engine.core_client import EngineDeadError
 from vllm_distributed_tpu.entrypoints.openai import protocol
+from vllm_distributed_tpu.entrypoints.openai.admission import (
+    AdmissionController, AdmissionRejected)
 from vllm_distributed_tpu.entrypoints.openai.protocol import RequestError
 from vllm_distributed_tpu.logger import init_logger
 from vllm_distributed_tpu.utils import random_uuid
@@ -29,6 +32,16 @@ TOOL_PARSER_KEY = web.AppKey("tool_parser", object)
 # Served LoRA adapters: name -> checkpoint path (reference: the
 # --lora-modules serve flag; requests select one via the "model" field).
 LORA_MODULES_KEY = web.AppKey("lora_modules", dict)
+# Admission gate (overload shedding + drain mode) for the generation
+# endpoints below; health/metrics stay exempt so operators can observe
+# an overloaded or draining server.
+ADMISSION_KEY = web.AppKey("admission", AdmissionController)
+
+GENERATION_PATHS = frozenset({
+    "/v1/completions", "/v1/chat/completions", "/v1/embeddings",
+    "/v1/score", "/v1/rerank", "/rerank", "/v1/responses",
+    "/v1/audio/transcriptions",
+})
 
 
 def _error_response(e: Exception) -> web.Response:
@@ -53,6 +66,87 @@ def _error_response(e: Exception) -> web.Response:
         {"error": {"message": f"{type(e).__name__}: {e}",
                    "type": "internal_server_error", "code": 500}},
         status=500)
+
+
+# Monotonic instant (stashed on the request by the admission
+# middleware) past which a STREAMING handler must abort its pumps; the
+# pumps enforce it because a fresh 408 response cannot be written once
+# the SSE stream has started.
+DEADLINE_AT_KEY = "vdt_deadline_at"
+
+
+async def _request_deadline_s(request: web.Request) -> tuple[float, bool]:
+    """Per-request wall-clock deadline: the JSON body's ``timeout_s``
+    overrides VDT_REQUEST_TIMEOUT_S; 0 disables. Also reports whether
+    the request asked for streaming."""
+    from vllm_distributed_tpu import envs
+    deadline = envs.VDT_REQUEST_TIMEOUT_S
+    stream = False
+    if request.content_type == "application/json":
+        try:
+            # Cheap byte scan first: most requests carry neither key,
+            # and a full json.loads here would double the parse cost of
+            # every body (the handler parses the cached bytes again).
+            raw = await request.read()
+            if b'"timeout_s"' in raw or b'"stream"' in raw:
+                body = await request.json()
+                if isinstance(body, dict):
+                    stream = bool(body.get("stream"))
+                    if body.get("timeout_s") is not None:
+                        deadline = float(body["timeout_s"])
+        except Exception:  # noqa: BLE001 - handler reports bad JSON
+            pass
+    return max(0.0, deadline), stream
+
+
+async def _admission_middleware_factory(app, handler):
+    """Overload protection for the generation endpoints: bounded
+    admission with watermark shedding (429 + Retry-After), drain-mode
+    refusal (503 + Retry-After), and a per-request deadline that aborts
+    overdue work through the engine's abort path (cancelling the
+    handler unwinds every generate() into AsyncLLM.abort)."""
+
+    async def middleware(request: web.Request):
+        ctrl = request.app.get(ADMISSION_KEY)
+        if (ctrl is None or request.method != "POST"
+                or request.path not in GENERATION_PATHS):
+            return await handler(request)
+        try:
+            await ctrl.acquire()
+        except AdmissionRejected as e:
+            kind = ("service_unavailable" if e.status == 503
+                    else "overloaded")
+            return web.json_response(
+                {"error": {"message": str(e), "type": kind,
+                           "code": e.status}},
+                status=e.status,
+                headers={"Retry-After": str(e.retry_after_s)})
+        try:
+            deadline, stream = await _request_deadline_s(request)
+            if deadline > 0 and stream:
+                # A 408 cannot be written once the SSE stream begins:
+                # the stream pumps poll this instant and end the stream
+                # cleanly (abort + [DONE]-less EOF) when it passes.
+                request[DEADLINE_AT_KEY] = time.monotonic() + deadline
+            elif deadline > 0:
+                try:
+                    return await asyncio.wait_for(handler(request),
+                                                  deadline)
+                except asyncio.TimeoutError:
+                    logger.warning("request on %s exceeded its %.1fs "
+                                   "deadline; aborted", request.path,
+                                   deadline)
+                    return web.json_response(
+                        {"error": {
+                            "message": f"request exceeded its "
+                                       f"{deadline:.1f}s deadline",
+                            "type": "timeout_error", "code": 408}},
+                        status=408)
+            return await handler(request)
+        finally:
+            ctrl.release()
+
+    return middleware
 
 
 async def _auth_middleware_factory(app, handler):
@@ -106,6 +200,17 @@ async def metrics(request: web.Request) -> web.Response:
     processor = getattr(engine, "output_processor", None)
     if processor is not None:
         text += processor.stats.render()
+    ctrl = request.app.get(ADMISSION_KEY)
+    if ctrl is not None and ctrl.enabled:
+        text += (
+            "# HELP vdt:admission_queue_depth Admitted, unfinished "
+            "generation requests at the API gate\n"
+            "# TYPE vdt:admission_queue_depth gauge\n"
+            f"vdt:admission_queue_depth {ctrl.depth}\n"
+            "# HELP vdt:admission_draining 1 while the server is in "
+            "SIGTERM drain mode\n"
+            "# TYPE vdt:admission_draining gauge\n"
+            f"vdt:admission_draining {int(ctrl.draining)}\n")
     return web.Response(text=text, content_type="text/plain")
 
 
@@ -409,7 +514,7 @@ async def responses(request: web.Request) -> web.Response:
         return web.json_response({
             "id": rid,
             "object": "response",
-            "created_at": int(time.time()),
+            "created_at": int(time.time()),  # wallclock-ok
             "model": body.get("model", model),
             "status": "completed",
             "output": [{
@@ -588,7 +693,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 for p in prompts
             ]
         cid = protocol.completion_id()
-        created = int(time.time())
+        created = int(time.time())  # wallclock-ok
 
         # Fan out: one engine request per (prompt, sample) pair; choice
         # index follows OpenAI semantics (prompt-major, then n). Seeded
@@ -698,6 +803,72 @@ def _completion_choice(idx: int, out, body: dict,
     return choice
 
 
+def _client_disconnected(request: web.Request) -> bool:
+    """A dropped client closes the transport; the stream loops poll this
+    so generation stops instead of running to completion unwatched
+    (reference: the is_disconnected() checks of serving_completion)."""
+    transport = request.transport
+    return transport is None or transport.is_closing()
+
+
+def _check_stream_alive(request: web.Request) -> None:
+    """Stream guard: raises on client disconnect or on an expired
+    per-request deadline (both end the stream through the engine's
+    abort path)."""
+    if _client_disconnected(request):
+        raise ConnectionResetError("client disconnected")
+    deadline_at = request.get(DEADLINE_AT_KEY)
+    if deadline_at is not None and time.monotonic() > deadline_at:
+        raise asyncio.TimeoutError("stream exceeded its deadline")
+
+
+async def _stream_outputs(request: web.Request, gen):
+    """Iterate an engine stream, enforcing the liveness guard once a
+    second even when NO output arrives — a request stalled in the
+    engine (queued, remote-KV hold) must still honor disconnects and
+    deadlines instead of keeping its slot until the next token. The
+    pending __anext__ survives across polls; it is cancelled only when
+    the guard trips, which unwinds generate() into the abort path."""
+    aiter = gen.__aiter__()
+    task = None
+    try:
+        while True:
+            if task is None:
+                task = asyncio.ensure_future(aiter.__anext__())
+            done, _ = await asyncio.wait({task}, timeout=1.0)
+            _check_stream_alive(request)
+            if not done:
+                continue
+            try:
+                out = task.result()
+            except StopAsyncIteration:
+                task = None
+                return
+            task = None
+            yield out
+    finally:
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                # Let the cancellation reach generate()'s finally (the
+                # upstream abort) before the handler returns.
+                await task
+            except BaseException:  # noqa: BLE001 - cancelled/aborted
+                pass
+
+
+async def _abort_stream(request: web.Request, cid: str,
+                        gens: list) -> None:
+    """Abort every child request of a dropped stream through the
+    engine's abort path (frees their KV pages and scheduler slots)."""
+    engine = request.app[ENGINE_KEY]
+    for idx, _gen in gens:
+        try:
+            await engine.abort(f"{cid}-{idx}")
+        except Exception:  # noqa: BLE001 - engine dead/racing shutdown
+            pass
+
+
 async def _stream_completions(request, cid, created, model,
                               gens) -> web.StreamResponse:
     resp = web.StreamResponse(headers={
@@ -708,7 +879,7 @@ async def _stream_completions(request, cid, created, model,
 
     async def pump(idx, gen):
         sent = 0
-        async for out in gen:
+        async for out in _stream_outputs(request, gen):
             text = out.outputs[0].text
             delta = text[sent:]
             sent = len(text)
@@ -731,8 +902,10 @@ async def _stream_completions(request, cid, created, model,
     try:
         await asyncio.gather(*(pump(idx, gen) for idx, gen in gens))
         await resp.write(b"data: [DONE]\n\n")
-    except (EngineDeadError, ConnectionResetError) as e:
+    except (EngineDeadError, ConnectionResetError,
+            asyncio.TimeoutError) as e:
         logger.warning("stream aborted: %s", e)
+        await _abort_stream(request, cid, gens)
     await resp.write_eof()
     return resp
 
@@ -852,7 +1025,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         params = protocol.sampling_params_from_request(body, max_len)
         stream = bool(body.get("stream", False))
         cid = protocol.chat_id()
-        created = int(time.time())
+        created = int(time.time())  # wallclock-ok
         lora = _resolve_lora(request.app, body)
         forced_tool = protocol.apply_tool_constraints(body, params)
         if stream and forced_tool is not None:
@@ -939,7 +1112,7 @@ async def _stream_chat(request, cid, created, model,
                      "delta": {"role": "assistant", "content": ""},
                      "finish_reason": None}])
         sent = 0
-        async for out in gen:
+        async for out in _stream_outputs(request, gen):
             text = out.outputs[0].text
             delta = text[sent:]
             sent = len(text)
@@ -952,8 +1125,10 @@ async def _stream_chat(request, cid, created, model,
     try:
         await asyncio.gather(*(pump(idx, gen) for idx, gen in gens))
         await resp.write(b"data: [DONE]\n\n")
-    except (EngineDeadError, ConnectionResetError) as e:
+    except (EngineDeadError, ConnectionResetError,
+            asyncio.TimeoutError) as e:
         logger.warning("stream aborted: %s", e)
+        await _abort_stream(request, cid, gens)
     await resp.write_eof()
     return resp
 
@@ -972,10 +1147,12 @@ def _resolve_lora(app: web.Application, body: dict) -> Optional[dict]:
 def build_app(engine: AsyncLLM, model_name: str,
               lora_modules: Optional[dict] = None,
               tool_call_parser: Optional[str] = None) -> web.Application:
-    app = web.Application(middlewares=[_auth_middleware_factory])
+    app = web.Application(middlewares=[_auth_middleware_factory,
+                                       _admission_middleware_factory])
     app[ENGINE_KEY] = engine
     app[MODEL_KEY] = model_name
     app[LORA_MODULES_KEY] = dict(lora_modules or {})
+    app[ADMISSION_KEY] = AdmissionController.from_envs(engine)
     if tool_call_parser:
         from vllm_distributed_tpu.entrypoints.openai.tool_parsers import \
             get_tool_parser
@@ -1000,13 +1177,30 @@ def build_app(engine: AsyncLLM, model_name: str,
     return app
 
 
+async def drain_and_stop(controller: AdmissionController,
+                         stop_event: asyncio.Event,
+                         timeout_s: Optional[float] = None) -> float:
+    """SIGTERM path: stop admitting, let in-flight requests finish (up
+    to the drain deadline), then stop the server. Returns the drain
+    duration (also recorded as vdt:drain_duration_seconds)."""
+    from vllm_distributed_tpu import envs
+    if timeout_s is None:
+        timeout_s = envs.VDT_DRAIN_TIMEOUT_S
+    controller.begin_drain()
+    duration = await controller.wait_drained(timeout_s)
+    logger.warning("graceful drain finished in %.2fs; stopping server",
+                   duration)
+    stop_event.set()
+    return duration
+
+
 async def serve(engine: AsyncLLM, model_name: str, host: str,
                 port: int, ready_event=None,
                 stop_event: Optional[asyncio.Event] = None,
                 lora_modules: Optional[dict] = None,
                 tool_call_parser: Optional[str] = None) -> None:
-    """Run until stop_event (or forever); graceful engine shutdown on
-    exit (reference: entrypoints/launcher.py serve_http)."""
+    """Run until stop_event (or SIGTERM drain); graceful engine
+    shutdown on exit (reference: entrypoints/launcher.py serve_http)."""
     app = build_app(engine, model_name, lora_modules,
                     tool_call_parser=tool_call_parser)
     runner = web.AppRunner(app)
@@ -1014,15 +1208,34 @@ async def serve(engine: AsyncLLM, model_name: str, host: str,
     site = web.TCPSite(runner, host, port)
     await site.start()
     logger.info("serving on http://%s:%d", host, port)
+    if stop_event is None:
+        stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    drain_task = None
+
+    def _on_sigterm() -> None:
+        nonlocal drain_task
+        if drain_task is None:
+            drain_task = asyncio.ensure_future(
+                drain_and_stop(app[ADMISSION_KEY], stop_event))
+
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, ValueError, RuntimeError):
+        # Non-main-thread loops (tests) and platforms without signal
+        # support: drain stays reachable via drain_and_stop directly.
+        pass
     if ready_event is not None:
         ready_event.set()
     try:
-        if stop_event is None:
-            while True:
-                await asyncio.sleep(3600)
-        else:
-            await stop_event.wait()
+        await stop_event.wait()
     finally:
+        if drain_task is not None:
+            drain_task.cancel()
+        try:
+            loop.remove_signal_handler(signal.SIGTERM)
+        except (NotImplementedError, ValueError, RuntimeError):
+            pass
         await runner.cleanup()
         engine.shutdown()
 

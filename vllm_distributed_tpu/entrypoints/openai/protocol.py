@@ -241,6 +241,6 @@ def model_card(model: str) -> dict:
     return {
         "id": model,
         "object": "model",
-        "created": int(time.time()),
+        "created": int(time.time()),  # wallclock-ok
         "owned_by": "vllm-distributed-tpu",
     }

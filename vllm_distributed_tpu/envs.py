@@ -118,10 +118,40 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # kept for CLI parity).
     "VDT_NO_USAGE_STATS":
     lambda: os.getenv("VDT_NO_USAGE_STATS", "1") == "1",
+    # --- API admission control / overload protection -------------------
+    # High watermark: concurrent admitted HTTP generation requests above
+    # which the server sheds load with 429 + Retry-After. 0 disables
+    # admission control entirely.
+    "VDT_ADMISSION_HIGH_WATERMARK":
+    lambda: int(os.getenv("VDT_ADMISSION_HIGH_WATERMARK", "256")),
+    # Low watermark (hysteresis): once shedding starts it continues
+    # until depth falls to this level. 0 = derive as 3/4 of the high
+    # watermark.
+    "VDT_ADMISSION_LOW_WATERMARK":
+    lambda: int(os.getenv("VDT_ADMISSION_LOW_WATERMARK", "0")),
+    # Free-KV-page pressure shed: fraction of KV pages in use above
+    # which admission sheds (sampled from engine stats at most twice a
+    # second). 0.0 disables the KV-pressure trigger.
+    "VDT_ADMISSION_KV_HIGH":
+    lambda: float(os.getenv("VDT_ADMISSION_KV_HIGH", "0")),
+    # Retry-After seconds advertised on shed (429) and drain (503).
+    "VDT_RETRY_AFTER_S":
+    lambda: max(1, int(os.getenv("VDT_RETRY_AFTER_S", "1"))),
+    # Per-request wall-clock deadline (seconds) for generation
+    # endpoints; overdue requests abort through the engine's abort path
+    # and answer 408. 0 disables; a request body's "timeout_s" field
+    # overrides per call.
+    "VDT_REQUEST_TIMEOUT_S":
+    lambda: float(os.getenv("VDT_REQUEST_TIMEOUT_S", "0")),
+    # SIGTERM drain deadline: seconds to let in-flight requests finish
+    # after admission stops before the server exits anyway.
+    "VDT_DRAIN_TIMEOUT_S":
+    lambda: float(os.getenv("VDT_DRAIN_TIMEOUT_S", "30")),
     # Deterministic fault injection: "name:rate[@delay_s],..." over the
     # named fault points of utils/fault_injection.py (kv_pull.drop,
     # kv_pull.delay, registry.truncate, engine_core.die,
-    # heartbeat.stall). Read at process start (spawned engine cores
+    # heartbeat.stall, core_proc.spawn_fail, restart.storm,
+    # admission.stall). Read at process start (spawned engine cores
     # inherit it); "" disables. Robustness drills/tests only.
     "VDT_FAULT_INJECT":
     lambda: os.getenv("VDT_FAULT_INJECT", ""),

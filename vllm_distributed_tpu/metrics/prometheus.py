@@ -44,6 +44,13 @@ _COUNTERS = {
     "kv_pull_failures": ("vdt:kv_pull_failures_total",
                          "Failed remote-KV pulls (each requeued for "
                          "retry or local recompute)"),
+    # DP front-end recovery (dp_client failover + resurrection).
+    "replica_failovers": ("vdt:replica_failovers_total",
+                          "Dead DP replicas taken out of rotation with "
+                          "their journaled requests migrated"),
+    "replica_resurrections": ("vdt:replica_resurrections_total",
+                              "Downed DP replicas successfully "
+                              "restarted and returned to rotation"),
 }
 
 

@@ -79,6 +79,14 @@ class FrontendStats:
     # Engine-core death/restart events detected by the health monitor
     # (AsyncLLM increments when it fails pending requests).
     num_engine_deaths: int = 0
+    # Recovery-layer counters: journaled requests resubmitted to a
+    # freshly restarted core as continuation prefills, and requests
+    # refused at the API admission gate (429/503 shed).
+    num_requests_replayed: int = 0
+    num_requests_shed: int = 0
+    # Wall seconds the last SIGTERM drain took from "stop admitting" to
+    # "in-flight work finished" (0 until a drain runs).
+    drain_duration_seconds: float = 0.0
     # Periodic logging window (LoggingStatLogger equivalent).
     _window_start: float = field(default_factory=time.monotonic)
     _window_gen_tokens: int = 0
@@ -144,9 +152,21 @@ class FrontendStats:
             ("vdt:engine_restarts_total",
              "Engine-core death/restart events detected by the health "
              "monitor", self.num_engine_deaths),
+            ("vdt:requests_replayed_total",
+             "Journaled requests resubmitted to a restarted engine core "
+             "as continuation prefills", self.num_requests_replayed),
+            ("vdt:requests_shed_total",
+             "Requests refused at the API admission gate (overload "
+             "shed / drain mode)", self.num_requests_shed),
         ):
             lines += [f"# HELP {name} {help_text}",
                       f"# TYPE {name} counter", f"{name} {value}"]
+        lines += [
+            "# HELP vdt:drain_duration_seconds Duration of the last "
+            "SIGTERM graceful drain",
+            "# TYPE vdt:drain_duration_seconds gauge",
+            f"vdt:drain_duration_seconds {self.drain_duration_seconds}",
+        ]
         lines += render_fault_injections()
         return "\n".join(lines) + "\n"
 

@@ -56,7 +56,8 @@ class EngineCoreRequest:
     prompt_token_ids: list[int]
     sampling_params: SamplingParams
     eos_token_id: Optional[int] = None
-    arrival_time: float = field(default_factory=time.time)
+    # Epoch timestamp (user-facing stats), never deadline arithmetic.
+    arrival_time: float = field(default_factory=time.time)  # wallclock-ok
     priority: int = 0
     # Disaggregated prefill routing (reference: kv_transfer_params on the
     # request, nixl_connector.py:205).
@@ -71,6 +72,31 @@ class EngineCoreRequest:
     # (multimodal/__init__.py MultiModalInput; reference: the mm_inputs
     # of v1/engine/__init__.py EngineCoreRequest).
     mm_inputs: Optional[list] = None
+
+
+def continuation_request(orig: EngineCoreRequest,
+                         generated: list[int]) -> EngineCoreRequest:
+    """Continuation prefill for a crash-recovery replay: the journaled
+    request's prompt absorbs the tokens already delivered downstream and
+    the sampling budget shrinks by the same amount, so a respawned core
+    (or a failover replica) resumes exactly where the dead one stopped —
+    with greedy sampling the resumed stream is token-identical to an
+    uninterrupted run."""
+    req = copy.deepcopy(orig)
+    # Never replay a remote-KV pull: by replay time the producer's
+    # deferred-free registration is consumed or expired, so re-entering
+    # WAITING_FOR_REMOTE_KVS would only burn the watchdog ladder before
+    # degrading anyway — go straight to local (re)compute.
+    req.kv_transfer_params = None
+    if not generated:
+        return req
+    req.prompt_token_ids = list(orig.prompt_token_ids) + list(generated)
+    sp = req.sampling_params
+    if sp.max_tokens is not None:
+        sp.max_tokens = max(1, sp.max_tokens - len(generated))
+    if getattr(sp, "min_tokens", 0):
+        sp.min_tokens = max(0, sp.min_tokens - len(generated))
+    return req
 
 
 class Request:
@@ -96,7 +122,7 @@ class Request:
         self.sampling_params = copy.deepcopy(sampling_params)
         sampling_params = self.sampling_params
         self.eos_token_id = eos_token_id
-        self.arrival_time = (time.time()
+        self.arrival_time = (time.time()  # wallclock-ok: epoch stat
                              if arrival_time is None else arrival_time)
         self.priority = priority
         self.kv_transfer_params = kv_transfer_params

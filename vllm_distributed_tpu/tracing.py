@@ -55,7 +55,7 @@ class JsonlTracer(RequestTracer):
         logger.info("request tracing -> %s (jsonl)", path)
 
     def emit(self, attributes: dict) -> None:
-        record = {"name": "llm_request", "ts": time.time(),
+        record = {"name": "llm_request", "ts": time.time(),  # wallclock-ok
                   "attributes": attributes}
         with self._lock, open(self.path, "a") as f:
             f.write(json.dumps(record) + "\n")

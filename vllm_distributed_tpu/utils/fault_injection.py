@@ -37,6 +37,14 @@ but never fired by production code):
   the error through its output queue).
 * ``heartbeat.stall``   — heartbeat senders (P2P registry client,
   engine-core liveness thread) skip their sends while active.
+* ``core_proc.spawn_fail`` — engine-core construction (initial spawn or
+  a supervisor restart) raises before the core comes up.
+* ``restart.storm``     — each supervisor restart succeeds and then the
+  fresh core immediately dies again (re-arms ``engine_core.die``),
+  driving the restart budget to its circuit breaker.
+* ``admission.stall``   — the API admission controller leaks one queue
+  slot per fire (admitted work that never completes), deterministically
+  building queue-depth pressure toward the shed watermark.
 """
 
 import threading
@@ -54,6 +62,9 @@ FAULT_POINTS = (
     "registry.truncate",
     "engine_core.die",
     "heartbeat.stall",
+    "core_proc.spawn_fail",
+    "restart.storm",
+    "admission.stall",
 )
 
 
