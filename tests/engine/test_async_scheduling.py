@@ -1,0 +1,406 @@
+"""Async scheduling: the depth-2 in-flight batch pipeline on the
+non-PP path (reference: the V1 --async-scheduling overlap of host
+scheduling/input-prep with device execution).
+
+Acceptance contract: the async path is token-identical to sync under
+greedy sampling (the same contract crash-replay locked in PR 2), abort
+and preemption stay safe with batches in flight, the zero-token-grant
+contract extends to the async queue, and incompatible features force
+sync (config-level auto-off + per-request fallback)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_async")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+PROMPTS = [
+    [3, 17, 92, 45, 8],
+    [7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7],
+    [120, 44],
+    [1, 2, 3, 4, 5, 6],
+]
+
+_TAG = [0]
+
+
+def run(engine, prompts, sps):
+    _TAG[0] += 1
+    for i, (p, sp) in enumerate(zip(prompts, sps)):
+        engine.add_request(f"as{_TAG[0]}-{i}", p, sp)
+    done = {}
+    for _ in range(500):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+    return [done[k].outputs[0].token_ids for k in order]
+
+
+def greedy_sps(n, max_tokens=8, **kw):
+    return [SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                           ignore_eos=True, **kw) for _ in range(n)]
+
+
+def core_of(engine):
+    return engine.engine_core.engine_core
+
+
+# ---------------------------------------------------------------------------
+# Greedy token parity + overlap actually happening
+# ---------------------------------------------------------------------------
+
+def test_async_greedy_matches_sync(checkpoint):
+    baseline = run(make_engine(checkpoint), PROMPTS, greedy_sps(4))
+    engine = make_engine(checkpoint, async_scheduling=True)
+    core = core_of(engine)
+    assert core.async_scheduling
+    assert core.batch_queue is not None and core.batch_queue_size == 2
+    got = run(engine, PROMPTS, greedy_sps(4))
+    assert got == baseline
+    # The pipeline really ran ahead: >= 2 batches in flight at once and
+    # speculative grants were issued.
+    assert core.max_concurrent_batches >= 2
+    assert core.scheduler.num_async_spec_grants > 0
+    assert core.steps_overlapped > 0
+    # No pages leaked through the pending-retire path.
+    pool = core.scheduler.kv_cache_manager.block_pool
+    assert pool.get_num_free_blocks() == pool.num_blocks
+    assert not core.scheduler._finished_pending_retire
+    assert not core.scheduler.in_flight_req_ids
+
+
+def test_async_chunked_prefill_matches_sync(checkpoint):
+    prompt = [int(x) for x in
+              np.random.default_rng(0).integers(2, 127, size=40)]
+    baseline = run(make_engine(checkpoint, max_num_batched_tokens=16),
+                   [prompt], greedy_sps(1, max_tokens=5))
+    got = run(make_engine(checkpoint, max_num_batched_tokens=16,
+                          async_scheduling=True),
+              [prompt], greedy_sps(1, max_tokens=5))
+    assert got == baseline
+
+
+def test_async_stop_token_lags_but_truncates_exactly(checkpoint):
+    """EOS/stop detection lags one step under async (the over-issued
+    position's sample is discarded); the emitted stream must still stop
+    on exactly the same token as sync."""
+    sync = make_engine(checkpoint)
+    base = run(sync, [PROMPTS[0]], greedy_sps(1, max_tokens=10))[0]
+    stop_tok = base[4]
+    sps = [SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True,
+                          stop_token_ids=[stop_tok])]
+    expect = run(sync, [PROMPTS[0]], sps)
+    got = run(make_engine(checkpoint, async_scheduling=True),
+              [PROMPTS[0]], sps)
+    assert got == expect
+    # Truncated at the FIRST occurrence of the stop token, exactly.
+    assert got[0] == base[:base.index(stop_tok) + 1]
+
+
+def test_async_mixed_sync_fallback_requests(checkpoint):
+    """A batch mixing plain greedy rows (chained device-to-device) with
+    requests that need host-synchronous sampling (penalties) stays
+    token-identical to the sync engine for every stream."""
+    sps = [
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True,
+                       repetition_penalty=1.3),
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True,
+                       presence_penalty=0.8),
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    ]
+    baseline = run(make_engine(checkpoint), PROMPTS, sps)
+    got = run(make_engine(checkpoint, async_scheduling=True), PROMPTS, sps)
+    assert got == baseline
+
+
+def test_async_sync_only_requests_never_speculate(checkpoint):
+    """A workload of ONLY host-synchronous requests degrades to
+    PP-style one-batch-at-a-time scheduling: no speculative grants."""
+    engine = make_engine(checkpoint, async_scheduling=True)
+    sps = [SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
+                          repetition_penalty=1.2) for _ in range(2)]
+    run(engine, PROMPTS[:2], sps)
+    core = core_of(engine)
+    assert core.scheduler.num_async_spec_grants == 0
+
+
+# ---------------------------------------------------------------------------
+# Abort / preemption with batches in flight
+# ---------------------------------------------------------------------------
+
+def test_async_abort_in_flight_is_safe(checkpoint):
+    engine = make_engine(checkpoint, async_scheduling=True)
+    core = core_of(engine)
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    for i, p in enumerate(PROMPTS):
+        engine.add_request(f"abort-{i}", p, sp)
+    aborted = None
+    for _ in range(50):
+        engine.step()
+        if core.scheduler.in_flight_req_ids:
+            aborted = next(iter(core.scheduler.in_flight_req_ids))
+            engine.abort_request([aborted])
+            break
+    assert aborted is not None
+    done = set()
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done.add(out.request_id)
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    assert done == {f"abort-{i}" for i in range(4)} - {aborted}
+    assert not core.scheduler._deferred_finishes
+    assert not core.scheduler._finished_pending_retire
+    pool = core.scheduler.kv_cache_manager.block_pool
+    assert pool.get_num_free_blocks() == pool.num_blocks
+
+
+def test_async_preemption_with_batch_in_flight(checkpoint):
+    """A page pool too small for the full batch forces preemption while
+    the pipeline is active: in-flight requests are never evicted (their
+    pages are being written), and the greedy output still matches an
+    ample-pool baseline exactly (preempted requests recompute)."""
+    prompts = [[i * 11 + j for j in range(1, 9)] for i in range(3)]
+    baseline = run(make_engine(checkpoint), prompts,
+                   greedy_sps(3, max_tokens=12))
+    # 12 pages x 4 tokens = 48-token capacity < 3 x (8 prompt + 12 out)
+    # = 60 tokens needed -> at least one preemption is forced.
+    engine = make_engine(checkpoint, async_scheduling=True,
+                         num_gpu_blocks_override=12)
+    got = run(engine, prompts, greedy_sps(3, max_tokens=12))
+    assert got == baseline
+    core = core_of(engine)
+    assert core.scheduler.num_preemptions >= 1
+    pool = core.scheduler.kv_cache_manager.block_pool
+    assert pool.get_num_free_blocks() == pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# Zero-token-grant contract (async sibling of
+# test_zero_token_dispatch_does_no_device_work)
+# ---------------------------------------------------------------------------
+
+def test_async_zero_token_dispatch_does_no_device_work(checkpoint):
+    """The async queue keeps the PP queue's contract: an empty grant
+    resolves entirely at dispatch time (no device work that could
+    interleave with in-flight speculative batches)."""
+    from vllm_distributed_tpu.core.sched.output import SchedulerOutput
+    engine = make_engine(checkpoint, async_scheduling=True)
+    core = core_of(engine)
+    assert core.batch_queue is not None  # the async queue is active
+    runner = core.executor.worker.model_runner
+    handle = runner.dispatch_model(SchedulerOutput(async_scheduled=True))
+    assert "ready" in handle and "dev" not in handle
+    out = runner.wait_model(handle)
+    assert not out.sampled_token_ids
+
+
+# ---------------------------------------------------------------------------
+# Auto-fallback matrix: incompatible features force sync
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("overrides", [
+    dict(speculative_method="ngram", num_speculative_tokens=3),
+    dict(pipeline_parallel_size=2),
+    dict(num_scheduler_steps=4),
+    dict(kv_connector="SharedStorageConnector", kv_role="kv_both"),
+    dict(token_parallel_size=2),
+    dict(num_hosts=2),
+])
+def test_async_auto_off_matrix(overrides):
+    """Config-level auto-off: features whose step contract conflicts
+    with run-ahead grants force async_scheduling back to False at
+    config normalization (spec decode / PP / multi-step / KV connector
+    / token parallelism / multi-host). Build only the config (no
+    engine): normalization happens in EngineConfig.__post_init__."""
+    from vllm_distributed_tpu.config import (EngineConfig, KVTransferConfig,
+                                             ModelConfig, ParallelConfig,
+                                             SchedulerConfig,
+                                             SpeculativeConfig)
+    config = EngineConfig(
+        model_config=ModelConfig(model="dummy", max_model_len=64),
+        scheduler_config=SchedulerConfig(
+            async_scheduling=True,
+            num_scheduler_steps=overrides.get("num_scheduler_steps", 1),
+            max_model_len=64),
+        parallel_config=ParallelConfig(
+            pipeline_parallel_size=overrides.get(
+                "pipeline_parallel_size", 1),
+            token_parallel_size=overrides.get("token_parallel_size", 1),
+            num_hosts=overrides.get("num_hosts", 1)),
+        speculative_config=SpeculativeConfig(
+            method=overrides.get("speculative_method"),
+            num_speculative_tokens=overrides.get(
+                "num_speculative_tokens", 0)),
+        kv_transfer_config=KVTransferConfig(
+            kv_connector=overrides.get("kv_connector"),
+            kv_role=overrides.get("kv_role")),
+    )
+    assert config.scheduler_config.async_scheduling is False
+
+
+def test_async_stays_on_for_plain_config():
+    from vllm_distributed_tpu.config import (EngineConfig, ModelConfig,
+                                             SchedulerConfig)
+    config = EngineConfig(
+        model_config=ModelConfig(model="dummy", max_model_len=64),
+        scheduler_config=SchedulerConfig(async_scheduling=True,
+                                         max_model_len=64),
+    )
+    assert config.scheduler_config.async_scheduling is True
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: overlap through AsyncLLM on a toy model (tier-1-safe)
+# ---------------------------------------------------------------------------
+
+def _make_async_llm(checkpoint, **overrides):
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    args = dict(model=checkpoint, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True, async_scheduling=True,
+                restart_backoff_base_s=0.01, restart_backoff_max_s=0.05)
+    args.update(overrides)
+    return AsyncLLM(EngineArgs(**args).create_engine_config(),
+                    load_tokenizer=False)
+
+
+async def _collect_one(engine, prompt, request_id, max_tokens=16,
+                       arm_fault=None):
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    final = None
+    got_first = False
+    async for out in engine.generate(prompt, sp, request_id=request_id):
+        if not got_first:
+            got_first = True
+            if arm_fault:
+                arm_fault()
+        final = out
+    assert final is not None and final.finished
+    return final.outputs[0].token_ids
+
+
+def test_asyncllm_overlap_smoke(checkpoint):
+    """CPU smoke for the tentpole: a toy model served through AsyncLLM
+    must actually keep >= 2 batches in flight (max_concurrent_batches),
+    proving the overlap engages outside hand-driven step() loops."""
+    engine = _make_async_llm(checkpoint)
+
+    async def go():
+        return await asyncio.gather(*[
+            _collect_one(engine, PROMPTS[i], f"smoke-{i}")
+            for i in range(4)
+        ])
+
+    try:
+        outs = asyncio.run(asyncio.wait_for(go(), timeout=120.0))
+        assert all(len(o) == 16 for o in outs)
+        core = engine.core.core  # BackgroundEngineCore -> EngineCore
+        assert core.max_concurrent_batches >= 2
+        stats = core.get_stats()
+        assert stats["decode_overlap_frac"] > 0
+        assert stats["step_host_gap_seconds"]["count"] > 0
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Faults: the crash-recovery ladder still fires with batches in flight
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+@pytest.mark.faults
+def test_reconcile_stall_death_recovers_mid_pipeline(checkpoint,
+                                                     _clean_faults):
+    """step.reconcile_stall (raise mode) kills the core at the batch
+    queue's reconcile point — i.e. with speculative batches in flight.
+    The PR 1/2 ladder (health monitor -> supervisor respawn -> journal
+    replay) must recover token-identically."""
+    base_engine = _make_async_llm(checkpoint)
+    try:
+        baseline = asyncio.run(asyncio.wait_for(
+            _collect_one(base_engine, PROMPTS[0], "rs-base",
+                         max_tokens=20), timeout=120.0))
+    finally:
+        base_engine.shutdown()
+
+    engine = _make_async_llm(checkpoint)
+    try:
+        resumed = asyncio.run(asyncio.wait_for(
+            _collect_one(
+                engine, PROMPTS[0], "rs-die", max_tokens=20,
+                arm_fault=lambda: fi.inject("step.reconcile_stall",
+                                            max_fires=1)),
+            timeout=180.0))
+        assert resumed == baseline
+        assert not engine.errored
+        stats = engine.output_processor.stats
+        assert stats.num_engine_deaths >= 1
+        assert stats.num_requests_replayed >= 1
+        assert fi.counters().get("step.reconcile_stall", 0) >= 1
+    finally:
+        engine.shutdown()
+
+
+@pytest.mark.faults
+def test_reconcile_stall_delay_is_survived(checkpoint, _clean_faults):
+    """Delay mode: a host stall between device completion and
+    reconciliation is absorbed (paced, not fatal) — the stream
+    completes and the engine stays healthy."""
+    engine = _make_async_llm(checkpoint)
+    try:
+        fi.inject("step.reconcile_stall", rate=0.25, delay_s=0.02)
+        out = asyncio.run(asyncio.wait_for(
+            _collect_one(engine, PROMPTS[0], "rs-delay", max_tokens=12),
+            timeout=120.0))
+        assert len(out) == 12
+        assert not engine.errored
+        assert engine.output_processor.stats.num_engine_deaths == 0
+        assert fi.counters().get("step.reconcile_stall", 0) >= 1
+    finally:
+        engine.shutdown()

@@ -317,6 +317,21 @@ class SchedulerConfig:
     # v1/core/encoder_cache_manager.py); image requests past the budget
     # wait.
     encoder_cache_budget: int = 8192
+    # Async scheduling (reference: the V1 --async-scheduling path):
+    # overlap host scheduling/input-prep with device execution by
+    # keeping a depth-2 batch pipeline in flight on the non-PP path.
+    # The scheduler grants step N+1 (advancing each running request by
+    # one speculative position) while step N executes; the runner
+    # chains decode input tokens device-to-device so the host never
+    # round-trips sampled tokens on the hot path. Stop/EOS detection
+    # lags one step (the over-issued position's work is discarded).
+    # Auto-disabled (see EngineConfig.__post_init__) with pipeline
+    # parallelism, speculative decoding, multi-step bursts, KV-transfer
+    # connectors, token parallelism, and multi-host execution; requests
+    # needing host-synchronous sampling state (structured output,
+    # prompt_logprobs, pooling, penalties/min-tokens) individually fall
+    # back to synchronous one-step-lag scheduling.
+    async_scheduling: bool = False
 
     def __post_init__(self) -> None:
         if self.policy not in ("fcfs", "priority"):
@@ -570,6 +585,41 @@ class EngineConfig:
                     "forcing single-step scheduling",
                     self.scheduler_config.num_scheduler_steps, reason)
                 self.scheduler_config.num_scheduler_steps = 1
+        if self.scheduler_config.async_scheduling:
+            for reason, incompatible in (
+                    # The PP batch queue already pipelines microbatches;
+                    # layering speculative grants on top would re-grant
+                    # stage-straddling requests.
+                    ("pipeline parallelism (the PP batch queue already "
+                     "overlaps)",
+                     self.parallel_config.pipeline_parallel_size > 1),
+                    # Draft tokens round-trip through the host between
+                    # steps (propose -> schedule -> verify).
+                    ("speculative decoding",
+                     self.speculative_config is not None
+                     and self.speculative_config.method is not None),
+                    # The fused burst is the deeper device-side answer to
+                    # the same host gap; both at once would double-grant.
+                    ("multi-step decode bursts (num_scheduler_steps > 1)",
+                     self.scheduler_config.num_scheduler_steps > 1),
+                    # Connector load/save + deferred-free hooks assume
+                    # step-synchronous page ownership.
+                    ("a KV-transfer connector",
+                     bool(self.kv_transfer_config.kv_connector)),
+                    # Per-rank pool accounting under speculative grants is
+                    # unvalidated; keep the TKNP path synchronous.
+                    ("token parallelism",
+                     self.parallel_config.token_parallel_size > 1),
+                    # The broadcast executor has no async dispatch path.
+                    ("multi-host execution",
+                     self.parallel_config.num_hosts > 1),
+            ):
+                if incompatible:
+                    logger.warning(
+                        "async scheduling is incompatible with %s; "
+                        "falling back to synchronous stepping", reason)
+                    self.scheduler_config.async_scheduling = False
+                    break
         override = self.cache_config.num_gpu_blocks_override
         tknp = self.parallel_config.token_parallel_size
         if override and tknp > 1 and (override % tknp or override < tknp):

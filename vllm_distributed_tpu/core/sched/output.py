@@ -86,6 +86,11 @@ class SchedulerOutput:
     # roundtrip per burst instead of per token). Slots for all steps are
     # pre-allocated via num_lookahead_tokens.
     multi_step: int = 1
+    # True when the scheduler granted this batch under async scheduling:
+    # request.num_computed_tokens was already advanced AT SCHEDULE TIME
+    # (so step N+1 could be granted while step N executes), and
+    # update_from_output must not advance it again.
+    async_scheduled: bool = False
 
 
 EMPTY_MODEL_RUNNER_OUTPUT: "ModelRunnerOutput"

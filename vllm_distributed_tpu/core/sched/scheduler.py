@@ -14,7 +14,7 @@ worker, which pads this scheduler's ragged output to bucketed shapes.
 """
 
 import time
-from collections import deque
+from collections import Counter, deque
 from typing import Iterable, Optional
 
 from vllm_distributed_tpu.config import EngineConfig
@@ -204,13 +204,31 @@ class Scheduler:
         # the worker reports the (now moot) pull finished, so a late
         # apply can never write into reallocated pages.
         self.cancelled_remote_kv: dict[str, Request] = {}
-        # Pipeline-parallel batch queue (managed by the engine core):
-        # requests inside a dispatched-but-unretired batch. They are
-        # skipped by schedule() (their next token depends on in-flight
-        # device work), protected from preemption (that work is writing
-        # their pages), and external finishes defer until retirement.
-        self.in_flight_req_ids: set[str] = set()
+        # Engine-core batch queue (PP microbatches, or the async
+        # depth-2 pipeline): requests inside a dispatched-but-unretired
+        # batch, REFCOUNTED — under async scheduling one request can sit
+        # in two in-flight batches at once. In-flight requests are
+        # protected from preemption (device work is writing their
+        # pages) and external finishes defer until every batch holding
+        # them retires; under PP (sync) they are also skipped by
+        # schedule(), while async scheduling re-grants them
+        # speculatively (see the schedule() running loop).
+        self.in_flight_req_ids: Counter = Counter()
         self._deferred_finishes: dict[str, RequestStatus] = {}
+        # Async scheduling: overlap host scheduling with device
+        # execution. schedule() advances num_computed_tokens at GRANT
+        # time (so the next schedule() can run ahead), grants one
+        # speculative position per running decode request whose sampled
+        # token is still on device (the runner chains it
+        # device-to-device), and update_from_output reconciles when the
+        # token lands — stop/EOS detection lags one step, and a request
+        # finishing with a batch still in flight parks here until that
+        # batch retires (its pages are being written).
+        self.async_scheduling = getattr(sched_cfg, "async_scheduling",
+                                        False)
+        self._finished_pending_retire: dict[str, Request] = {}
+        # Speculative (run-ahead) decode grants issued (stats).
+        self.num_async_spec_grants = 0
 
         # Remote-KV watchdog (fault-tolerance layer): requests held in
         # WAITING_FOR_REMOTE_KVS past this deadline are swept into the
@@ -340,12 +358,68 @@ class Scheduler:
 
     def has_schedulable_requests(self) -> bool:
         """Work the next schedule() call could actually grant tokens to
-        (in-flight requests excluded) — gates dispatching another batch
-        in the engine core's PP batch queue."""
+        — gates dispatching another batch in the engine core's batch
+        queue. Under PP (sync) in-flight requests are excluded; under
+        async scheduling a request with known-token backlog or exactly
+        one in-flight sample is speculatively re-grantable."""
         if self.waiting:
             return True
+        if self.async_scheduling:
+            return any(self._async_schedulable(r) for r in self.running)
         return any(r.request_id not in self.in_flight_req_ids
                    for r in self.running)
+
+    # ------------------------------------------------------------------
+    # In-flight batch bookkeeping (engine-core batch queue)
+    # ------------------------------------------------------------------
+    def mark_in_flight(self, req_ids: Iterable[str]) -> None:
+        for req_id in req_ids:
+            self.in_flight_req_ids[req_id] += 1
+
+    def unmark_in_flight(self, req_ids: Iterable[str]) -> None:
+        for req_id in req_ids:
+            n = self.in_flight_req_ids[req_id] - 1
+            if n > 0:
+                self.in_flight_req_ids[req_id] = n
+            else:
+                del self.in_flight_req_ids[req_id]
+
+    # ------------------------------------------------------------------
+    # Async-scheduling predicates
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _needs_sync_step(request: Request) -> bool:
+        """Requests whose next grant depends on host-side state from the
+        previous step's sampled token: grammar masks (structured output)
+        advance on the emitted tokens, penalty/bias sampling reads the
+        host token history, prompt_logprobs and pooling key off exact
+        prompt accounting. These fall back to PP-style one-batch-at-a-
+        time scheduling (skip while in flight, never run ahead)."""
+        sp = request.sampling_params
+        return (request.pooling_params is not None
+                or sp.structured is not None
+                or sp.prompt_logprobs is not None
+                or sp.needs_extended_static
+                or sp.min_tokens > 0)
+
+    def _can_speculate(self, request: Request) -> bool:
+        """One speculative run-ahead position may be granted iff every
+        known token is computed (== exactly one sample is owed by an
+        in-flight batch; the runner chains it device-to-device), the
+        context window has room, and the owed sample won't already cap
+        max_tokens (the extra position would be guaranteed waste)."""
+        return (request.num_computed_tokens == request.num_tokens
+                and not request.spec_token_ids
+                and request.num_computed_tokens < self.max_model_len
+                and (request.num_output_tokens + 1
+                     < request.sampling_params.max_tokens))
+
+    def _async_schedulable(self, request: Request) -> bool:
+        if self._needs_sync_step(request):
+            return request.request_id not in self.in_flight_req_ids
+        if request.num_tokens_with_spec > request.num_computed_tokens:
+            return True  # known-token backlog (prefill chunks)
+        return self._can_speculate(request)
 
     def has_kv_transfer_work(self) -> bool:
         """True while async KV transfers are in flight: held consumer
@@ -403,14 +477,27 @@ class Scheduler:
         req_index = 0
         while req_index < len(self.running) and token_budget > 0:
             request = self.running[req_index]
-            if request.request_id in self.in_flight_req_ids:
+            if (request.request_id in self.in_flight_req_ids
+                    and (not self.async_scheduling
+                         or self._needs_sync_step(request))):
                 # Another dispatched batch owns this request's next
-                # token (PP batch queue); it becomes schedulable when
-                # that batch retires.
+                # token (PP batch queue, or an async request that needs
+                # host-synchronous sampling state); it becomes
+                # schedulable when that batch retires.
                 req_index += 1
                 continue
             num_new_tokens = (request.num_tokens_with_spec -
                               request.num_computed_tokens)
+            speculative = False
+            if (num_new_tokens <= 0 and self.async_scheduling
+                    and not self._needs_sync_step(request)
+                    and self._can_speculate(request)):
+                # Async run-ahead: every known token is computed, so the
+                # only thing missing is the sample an in-flight batch
+                # owes. Grant the NEXT position now; the runner feeds it
+                # the on-device sampled token (device-to-device chain).
+                num_new_tokens = 1
+                speculative = True
             if self.long_prefill_token_threshold > 0:
                 num_new_tokens = min(num_new_tokens,
                                      self.long_prefill_token_threshold)
@@ -424,6 +511,7 @@ class Scheduler:
                 continue
 
             scheduled = True
+            skipped = False
             while True:
                 new_blocks = self.kv_cache_manager.allocate_slots(
                     request, num_new_tokens,
@@ -442,11 +530,23 @@ class Scheduler:
                 # scheduled one would leave SchedulerOutput entries
                 # pointing at freed pages).
                 victim = self._select_preemption_victim(req_index, request)
+                if (victim is request
+                        and request.request_id in self.in_flight_req_ids):
+                    # Async: the only preemptable candidate is this
+                    # request itself, but an in-flight batch is writing
+                    # its pages — evicting it would corrupt them. Skip
+                    # the grant; pressure resolves once batches retire
+                    # (an empty queue restores normal preemption).
+                    skipped = True
+                    break
                 self._preempt(victim)
                 preempted.append(victim)
                 if victim is request:
                     scheduled = False
                     break
+            if skipped:
+                req_index += 1
+                continue
             if not scheduled:
                 # The current request itself was preempted; its slot in
                 # self.running is gone — do not advance req_index.
@@ -473,6 +573,13 @@ class Scheduler:
             cached_reqs.new_block_ids.append(new_blocks.get_block_ids())
             cached_reqs.num_computed_tokens.append(
                 request.num_computed_tokens)
+            if self.async_scheduling:
+                # Advance AT GRANT TIME so the next schedule() call can
+                # run ahead of this batch; update_from_output skips the
+                # advance for async_scheduled batches.
+                request.num_computed_tokens += num_new_tokens
+                if speculative:
+                    self.num_async_spec_grants += 1
             req_index += 1
 
         # ---- 2. Waiting requests (new or resumed-from-preemption) ----
@@ -660,6 +767,10 @@ class Scheduler:
                             pooling_params=request.pooling_params,
                             mm_inputs=request.mm_inputs,
                         ))
+                if self.async_scheduling:
+                    # Grant-time advance (see the running loop): the
+                    # wire data above carries the pre-advance count.
+                    request.num_computed_tokens += num_new_tokens
 
         self.num_scheduled_steps += 1
         total = sum(num_scheduled_tokens.values())
@@ -694,6 +805,7 @@ class Scheduler:
             multi_step=multi_step if num_scheduled_tokens else 1,
             token_parallel_allocation=tknp_alloc,
             structured_masks=structured_masks,
+            async_scheduled=self.async_scheduling,
         )
         self.finished_req_ids = set()
         if self.kv_connector is not None:
@@ -824,6 +936,17 @@ class Scheduler:
                 self.finish_requests(req_id,
                                      self._deferred_finishes.pop(req_id))
 
+        # Async scheduling: requests that FINISHED at reconcile time
+        # while a later speculative batch was still writing their pages.
+        # That batch has now retired (the engine core unmarks before
+        # calling here), so the parked pages can finally be freed — the
+        # free also queues the worker-side row cleanup.
+        if self._finished_pending_retire:
+            for req_id in [r for r in self._finished_pending_retire
+                           if r not in self.in_flight_req_ids]:
+                self._free_request(
+                    self._finished_pending_retire.pop(req_id))
+
         pooled_map = runner_output.pooled or {}
         plp_map = runner_output.prompt_logprobs or {}
         outputs: list[EngineCoreOutput] = []
@@ -844,7 +967,8 @@ class Scheduler:
             if req_id in pooled_map:
                 # Embedding request: the prompt finished this step; the
                 # pooled hidden state IS the result (no sampling).
-                request.num_computed_tokens += scheduled
+                if not scheduler_output.async_scheduled:
+                    request.num_computed_tokens += scheduled
                 request.status = RequestStatus.FINISHED_STOPPED
                 finished.append(request)
                 outputs.append(EngineCoreOutput(
@@ -865,7 +989,11 @@ class Scheduler:
             if num_spec > 0:
                 num_rejected = num_spec + 1 - len(generated)
                 scheduled -= max(num_rejected, 0)
-            request.num_computed_tokens += scheduled
+            if not scheduler_output.async_scheduled:
+                # Async batches advanced num_computed at grant time
+                # (spec decode is config-gated off there, so the
+                # rejection adjustment never applies to them).
+                request.num_computed_tokens += scheduled
             request.spec_token_ids = spec_by_req.get(req_id, [])
 
             if not generated:
@@ -917,6 +1045,14 @@ class Scheduler:
 
         for request in finished:
             self.running.remove(request)
+            if request.request_id in self.in_flight_req_ids:
+                # A later (speculative) batch is still writing this
+                # request's pages: the finish is emitted to the client
+                # now, but the free waits until that batch retires (see
+                # the pending-retire sweep above). Its discarded sample
+                # is dropped there because the request left `running`.
+                self._finished_pending_retire[request.request_id] = request
+                continue
             params = self._free_request(request)
             if params is not None:
                 # Producer handoff coordinates ride on the final output
@@ -1137,6 +1273,7 @@ class Scheduler:
             "num_waiting_reqs": len(self.waiting),
             "kv_cache_usage": self.kv_cache_manager.usage,
             "num_preemptions": self.num_preemptions,
+            "num_async_spec_grants": self.num_async_spec_grants,
             "watchdog_timeouts": self.watchdog_timeouts,
             "kv_pull_retries": self.kv_pull_retries,
             "kv_pull_failures": self.kv_pull_failures,

@@ -56,7 +56,12 @@ def _compile_lib() -> str:
             if f.read() == src_mtime:
                 return so
     tmp = so + f".tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    # -lrt: shm_open/shm_unlink live in librt on glibc < 2.34 (a no-op
+    # link on newer glibc where they merged into libc). Without it the
+    # .so only loads when some earlier import already mapped librt into
+    # the process — load-order-dependent dlopen failures.
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp,
+           "-lrt"]
     logger.info("building shm ring: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so)  # atomic vs concurrent builders

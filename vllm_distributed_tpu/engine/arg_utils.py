@@ -64,6 +64,10 @@ class EngineArgs:
     scheduling_policy: str = "fcfs"
     num_scheduler_steps: int = 1
     encoder_cache_budget: int = 8192
+    # Overlap host scheduling with device execution (depth-2 in-flight
+    # batch pipeline; auto-off with spec decode / PP / multi-step /
+    # KV connectors — see SchedulerConfig.async_scheduling).
+    async_scheduling: bool = False
 
     device: str = "auto"
     load_format: str = "auto"
@@ -143,6 +147,7 @@ class EngineArgs:
                 policy=self.scheduling_policy,
                 num_scheduler_steps=self.num_scheduler_steps,
                 encoder_cache_budget=self.encoder_cache_budget,
+                async_scheduling=self.async_scheduling,
             ),
             device_config=DeviceConfig(device=self.device),
             load_config=LoadConfig(

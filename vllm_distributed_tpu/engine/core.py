@@ -5,17 +5,26 @@ _initialize_kv_caches:133; the multiprocess EngineCoreProc/DPEngineCoreProc
 variants layer transport on top — here the in-process core comes first and
 the ZMQ front-ends reuse it unchanged, mirroring InprocClient).
 
-Pipeline parallelism gets its throughput from the batch queue
-(reference: core.py:242 ``step_with_batch_queue``): up to
-pipeline_parallel_size scheduler outputs are dispatched before blocking
-on the oldest, so stage p of batch i+1 executes under stage p+1 of batch
-i. On TPU the overlap itself comes from JAX async dispatch — the runner's
-dispatch half enqueues per-stage programs without blocking, and each
-stage's KV cache chains only to its own previous-batch output, so the
-device runtime pipelines the stages; the queue's job is to keep the host
-from blocking and the scheduler from re-granting in-flight requests.
+The batch queue (reference: core.py:242 ``step_with_batch_queue``)
+serves two overlap modes with one loop:
+
+* **Pipeline parallelism** (depth = pipeline_parallel_size): up to one
+  scheduler output per stage is dispatched before blocking on the
+  oldest, so stage p of batch i+1 executes under stage p+1 of batch i;
+  in-flight requests are skipped by the scheduler.
+* **Async scheduling** (non-PP, depth 2; reference: the V1
+  --async-scheduling path): the scheduler grants step N+1 — advancing
+  each running decode request by one speculative position — while step
+  N executes on device; the runner chains the unknown input token
+  device-to-device and ``update_from_output`` reconciles when the
+  sampled tokens land (stop/EOS detection lags one step).
+
+On TPU the overlap itself comes from JAX async dispatch — the runner's
+dispatch half enqueues programs without blocking; the queue's job is to
+keep the host from blocking and the scheduler's grant state coherent.
 """
 
+import time
 from collections import deque
 from typing import Optional
 
@@ -24,8 +33,10 @@ from vllm_distributed_tpu.core.sched.scheduler import (EngineCoreOutput,
                                                        Scheduler)
 from vllm_distributed_tpu.executor import Executor
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics.stats import HOST_GAP_BUCKETS, Histogram
 from vllm_distributed_tpu.request import (EngineCoreRequest, Request,
                                           RequestStatus)
+from vllm_distributed_tpu.utils import fault_injection
 
 logger = init_logger(__name__)
 
@@ -51,16 +62,29 @@ class EngineCore:
         kv_connector = create_kv_connector(config, KVConnectorRole.SCHEDULER)
         self.scheduler = Scheduler(config, num_blocks=num_pages,
                                    kv_connector=kv_connector)
-        # PP microbatch overlap: in-flight (scheduler_output, handle)
-        # pairs, newest first; depth = stage count (a deeper queue only
-        # adds latency once every stage has work).
-        self.batch_queue_size = \
-            config.parallel_config.pipeline_parallel_size
+        # Batch queue: in-flight (scheduler_output, handle) pairs,
+        # newest first. Depth = max(pp, 2): the stage count under
+        # pipeline parallelism (a deeper queue only adds latency once
+        # every stage has work), 2 for async scheduling (one batch
+        # executing while the next is scheduled/dispatched).
+        pp = config.parallel_config.pipeline_parallel_size
+        self.async_scheduling = config.scheduler_config.async_scheduling
+        self.batch_queue_size = (max(pp, 2)
+                                 if pp > 1 or self.async_scheduling else 1)
         self.batch_queue: Optional[deque] = (
             deque(maxlen=self.batch_queue_size)
             if self.batch_queue_size > 1 else None)
         # Peak in-flight depth (tests/metrics: proves overlap happened).
         self.max_concurrent_batches = 0
+        # Overlap observability: dispatches issued while another batch
+        # was already in flight, and the host gap between a wait_model
+        # return and the next dispatch (the time the device sits idle
+        # waiting on host scheduling/input prep — the async path exists
+        # to drive this toward zero).
+        self.steps_dispatched = 0
+        self.steps_overlapped = 0
+        self.step_host_gap = Histogram(HOST_GAP_BUCKETS)
+        self._last_wait_done: Optional[float] = None
         # Structured output: the grammar layer needs a token-bytes table
         # (a tokenizer load + per-token decode sweep). Prefetch it off
         # the busy loop so the FIRST structured request doesn't stall
@@ -172,18 +196,25 @@ class EngineCore:
                                                  runner_output)
 
     def step_with_batch_queue(self) -> list[EngineCoreOutput]:
-        """One iteration of the pipeline-parallel batch queue
-        (reference: core.py:242): dispatch a fresh batch whenever there
-        is room and schedulable work; otherwise retire the oldest. Each
-        call does at most one of the two, so dispatches outnumber waits
-        until the pipeline fills."""
+        """One iteration of the batch queue (PP microbatches or the
+        async depth-2 pipeline; reference: core.py:242): dispatch a
+        fresh batch whenever there is room and schedulable work;
+        otherwise retire the oldest. Each call does at most one of the
+        two, so dispatches outnumber waits until the pipeline fills."""
         self.last_step_scheduled = False
         if (len(self.batch_queue) < self.batch_queue_size
                 and self.scheduler.has_schedulable_requests()):
             scheduler_output = self.scheduler.schedule()
             if scheduler_output.total_num_scheduled_tokens > 0:
-                self.scheduler.in_flight_req_ids.update(
+                self.scheduler.mark_in_flight(
                     scheduler_output.num_scheduled_tokens)
+                now = time.perf_counter()
+                if self._last_wait_done is not None:
+                    self.step_host_gap.observe(now - self._last_wait_done)
+                    self._last_wait_done = None
+                self.steps_dispatched += 1
+                if self.batch_queue:
+                    self.steps_overlapped += 1
                 handle = self.executor.execute_model_async(
                     scheduler_output)
                 self.batch_queue.appendleft((scheduler_output, handle))
@@ -217,14 +248,35 @@ class EngineCore:
                     scheduler_output, runner_output)
             return []
         scheduler_output, handle = self.batch_queue.pop()
+        if fault_injection.registry.active:
+            # step.reconcile_stall: with delay_s it stalls the host
+            # between device completion and reconciliation (the window
+            # the async pipeline keeps covered); without a delay it
+            # kills the core mid-pipeline so the crash-recovery ladder
+            # is exercised with batches in flight.
+            if fault_injection.registry.delay_of("step.reconcile_stall"):
+                fault_injection.maybe_delay("step.reconcile_stall")
+            else:
+                fault_injection.fire_or_raise("step.reconcile_stall")
         runner_output = self.executor.wait_model(handle)
-        self.scheduler.in_flight_req_ids.difference_update(
+        self._last_wait_done = time.perf_counter()
+        self.scheduler.unmark_in_flight(
             scheduler_output.num_scheduled_tokens)
         return self.scheduler.update_from_output(scheduler_output,
                                                  runner_output)
 
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.has_unfinished_requests()
+        # A non-empty batch queue counts as work even when every live
+        # request already finished: a trailing speculative batch must
+        # still retire (its wait frees the pages parked on it).
+        return (self.scheduler.has_unfinished_requests()
+                or bool(self.batch_queue))
+
+    def has_inflight_batches(self) -> bool:
+        """Dispatched-but-unretired batches — busy loops must not pace
+        (sleep) while a wait is pending, or the retire lags the device
+        by the sleep quantum."""
+        return bool(self.batch_queue)
 
     def has_kv_transfer_work(self) -> bool:
         """Async KV transfers needing step-polls even with no live
@@ -234,6 +286,20 @@ class EngineCore:
     def get_stats(self) -> dict:
         stats = self.scheduler.get_stats()
         stats.update(self.executor.get_stats())
+        stats["inflight_batches"] = (len(self.batch_queue)
+                                     if self.batch_queue is not None else 0)
+        stats["max_concurrent_batches"] = self.max_concurrent_batches
+        stats["steps_dispatched"] = self.steps_dispatched
+        stats["steps_overlapped"] = self.steps_overlapped
+        stats["decode_overlap_frac"] = (
+            self.steps_overlapped / max(self.steps_dispatched, 1))
+        g = self.step_host_gap
+        stats["step_host_gap_seconds"] = {
+            "buckets": list(g.buckets),
+            "counts": list(g.counts),
+            "sum": g.total,
+            "count": g.count,
+        }
         return stats
 
     def save_sharded_state(self, path: str) -> None:
@@ -247,7 +313,7 @@ class EngineCore:
         reference: EngineCore.sleep -> CuMemAllocator discard/offload,
         core.py:312-319 + cumem.py:106). Requires an idle engine —
         in-flight KV would be lost."""
-        if self.scheduler.has_requests():
+        if self.scheduler.has_requests() or self.batch_queue:
             raise ValueError("cannot sleep with in-flight requests")
         if self.config.parallel_config.pipeline_parallel_size > 1:
             raise ValueError("sleep/wake under pipeline parallelism "

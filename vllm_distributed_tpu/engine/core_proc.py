@@ -195,11 +195,14 @@ def _busy_loop(core: EngineCore, inp: zmq.Socket, out: zmq.Socket) -> None:
                 "t": "outputs",
                 "outs": [serial.encode_output(o) for o in outputs],
             }))
-        elif not core.last_step_scheduled:
+        elif (not core.last_step_scheduled
+              and not core.has_inflight_batches()):
             # Nothing ran on device (all requests held on async KV
             # transfers / deferred sends): each step is a host-only
             # poll, so pace it instead of busy-spinning a core for the
-            # transfer's duration.
+            # transfer's duration. Never pace while a dispatched batch
+            # awaits its wait_model — sleeping there would park the
+            # retire (and the next dispatch) behind the sleep quantum.
             time.sleep(0.005)
 
 
@@ -298,9 +301,12 @@ class BackgroundEngineCore:
                 outputs = self.core.step()
                 if outputs:
                     self.output_queue.put(outputs)
-                elif busy and not self.core.last_step_scheduled:
+                elif (busy and not self.core.last_step_scheduled
+                      and not self.core.has_inflight_batches()):
                     # Host-only poll step (async KV transfer in
-                    # flight): pace instead of spinning.
+                    # flight): pace instead of spinning. A pending
+                    # wait_model is NOT paced — the retire must chase
+                    # the device, not a sleep quantum.
                     time.sleep(0.005)
         except Exception as e:  # noqa: BLE001
             logger.error("background engine core died: %s", e)

@@ -500,15 +500,38 @@ class DPEngineClient(EngineCoreClient):
         # Sum numeric leaves across replicas for the headline counters;
         # ratio gauges average instead (a 4-replica deployment at 25%
         # KV usage is at 25%, not 100% — the admission gate's KV shed
-        # reads this value).
-        ratio_gauges = ("kv_cache_usage", "spec_acceptance_rate")
+        # reads this value), and peak gauges take the max (summing
+        # per-replica peaks would fabricate overlap that never
+        # happened: 4 sync replicas at depth 1 are depth 1, not 4).
+        ratio_gauges = ("kv_cache_usage", "spec_acceptance_rate",
+                        "decode_overlap_frac")
+        max_gauges = ("max_concurrent_batches", )
         for stats in per:
             for k, v in stats.items():
-                if isinstance(v, (int, float)):
+                if k in max_gauges:
+                    agg[k] = max(agg.get(k, 0), v)
+                elif isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
         for k in ratio_gauges:
             if k in agg and per:
                 agg[k] = agg[k] / len(per)
+        # Histogram-shaped entries (step_host_gap_seconds) merge
+        # element-wise so DP /metrics renders the fleet histogram
+        # instead of silently dropping it.
+        hists = [s["step_host_gap_seconds"] for s in per
+                 if isinstance(s.get("step_host_gap_seconds"), dict)]
+        if hists:
+            merged = {"buckets": list(hists[0]["buckets"]),
+                      "counts": [0] * len(hists[0]["counts"]),
+                      "sum": 0.0, "count": 0}
+            for h in hists:
+                if list(h["buckets"]) != merged["buckets"]:
+                    continue  # mixed versions mid-upgrade: skip
+                merged["counts"] = [a + b for a, b in
+                                    zip(merged["counts"], h["counts"])]
+                merged["sum"] += h["sum"]
+                merged["count"] += h["count"]
+            agg["step_host_gap_seconds"] = merged
         return agg
 
     def get_stats(self) -> dict:

@@ -17,6 +17,17 @@ _GAUGES = {
                        "Fraction of KV pages in use"),
     "spec_acceptance_rate": ("vdt:spec_decode_acceptance_rate",
                              "Accepted / proposed draft tokens"),
+    # Engine-core batch pipeline (PP microbatches / async scheduling).
+    "inflight_batches": ("vdt:inflight_batches",
+                         "Dispatched-but-unretired batches in the "
+                         "engine core's pipeline right now"),
+    "max_concurrent_batches": ("vdt:max_concurrent_batches",
+                               "Peak in-flight batch depth since start "
+                               "(>= 2 proves host/device overlap "
+                               "happened)"),
+    "decode_overlap_frac": ("vdt:decode_overlap_frac",
+                            "Fraction of dispatches issued while "
+                            "another batch was already executing"),
 }
 
 _COUNTERS = {
@@ -44,6 +55,15 @@ _COUNTERS = {
     "kv_pull_failures": ("vdt:kv_pull_failures_total",
                          "Failed remote-KV pulls (each requeued for "
                          "retry or local recompute)"),
+    # Engine-core batch pipeline throughput accounting.
+    "steps_dispatched": ("vdt:engine_steps_dispatched_total",
+                         "Batches dispatched by the engine core"),
+    "steps_overlapped": ("vdt:engine_steps_overlapped_total",
+                         "Batches dispatched while another was already "
+                         "in flight"),
+    "num_async_spec_grants": ("vdt:async_spec_grants_total",
+                              "Speculative run-ahead decode grants "
+                              "issued by the async scheduler"),
     # DP front-end recovery (dp_client failover + resurrection).
     "replica_failovers": ("vdt:replica_failovers_total",
                           "Dead DP replicas taken out of rotation with "
@@ -52,6 +72,25 @@ _COUNTERS = {
                               "Downed DP replicas successfully "
                               "restarted and returned to rotation"),
 }
+
+
+# Histogram-valued stats entries: the engine ships them as
+# {"buckets": [...], "counts": [...], "sum": s, "count": n} dicts
+# (counts has one extra +Inf slot), rendered here in full exposition
+# shape.
+_HISTOGRAMS = {
+    "step_host_gap_seconds": (
+        "vdt:step_host_gap_seconds",
+        "Host gap between a step's wait_model return and the next "
+        "dispatch (device idle time the async scheduler hides)"),
+}
+
+
+def _render_histogram(name: str, help_text: str, h: dict) -> list[str]:
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    return render_histogram_lines(name, help_text, h.get("buckets", ()),
+                                  h.get("counts", ()), h.get("sum", 0.0),
+                                  h.get("count", 0))
 
 
 def render_metrics(stats: dict) -> str:
@@ -66,4 +105,8 @@ def render_metrics(stats: dict) -> str:
             lines += [f"# HELP {name} {help_text}",
                       f"# TYPE {name} counter",
                       f"{name} {float(stats[key])}"]
+    for key, (name, help_text) in _HISTOGRAMS.items():
+        value = stats.get(key)
+        if isinstance(value, dict):
+            lines += _render_histogram(name, help_text, value)
     return "\n".join(lines) + "\n"

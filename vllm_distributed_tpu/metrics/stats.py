@@ -23,6 +23,32 @@ ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1,
                0.25, 0.5, 1.0, 2.5, 5.0)
 E2E_BUCKETS = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 30.0,
                40.0, 50.0, 60.0, 120.0, 240.0, 480.0, 960.0)
+# Engine-core host gap (wait_model return -> next dispatch): the device
+# idle window async scheduling exists to hide; sub-millisecond when a
+# batch was already waiting, tens of milliseconds when the host
+# schedules synchronously between steps.
+HOST_GAP_BUCKETS = (0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.02,
+                    0.05, 0.1, 0.25, 1.0)
+
+
+def render_histogram_lines(name: str, help_text: str, buckets, counts,
+                           total: float, count: int) -> list[str]:
+    """Prometheus exposition lines for one histogram family: cumulative
+    ``_bucket`` series (``counts`` carries one trailing +Inf slot),
+    ``_sum`` and ``_count``. Single source of truth for the shape —
+    shared by live Histogram objects and the serialized-dict stats
+    entries engines ship over the stats RPC."""
+    lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
+    cumulative = 0
+    for b, c in zip(buckets, counts):
+        cumulative += int(c)
+        lines.append(f'{name}_bucket{{le="{b}"}} {cumulative}')
+    if counts:
+        cumulative += int(counts[-1])
+    lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+    lines.append(f"{name}_sum {total}")
+    lines.append(f"{name}_count {count}")
+    return lines
 
 
 class Histogram:
@@ -44,16 +70,8 @@ class Histogram:
         self.counts[-1] += 1
 
     def render(self, name: str, help_text: str) -> list[str]:
-        lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
-        cumulative = 0
-        for b, c in zip(self.buckets, self.counts):
-            cumulative += c
-            lines.append(f'{name}_bucket{{le="{b}"}} {cumulative}')
-        cumulative += self.counts[-1]
-        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-        lines.append(f"{name}_sum {self.total}")
-        lines.append(f"{name}_count {self.count}")
-        return lines
+        return render_histogram_lines(name, help_text, self.buckets,
+                                      self.counts, self.total, self.count)
 
 
 @dataclass

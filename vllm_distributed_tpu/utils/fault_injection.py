@@ -45,6 +45,12 @@ but never fired by production code):
 * ``admission.stall``   — the API admission controller leaks one queue
   slot per fire (admitted work that never completes), deterministically
   building queue-depth pressure toward the shed watermark.
+* ``step.reconcile_stall`` — fired at the engine core's batch-queue
+  reconcile point (wait_model of the oldest in-flight batch). With a
+  ``delay_s`` it stalls the host between device completion and
+  reconciliation; without one it raises, killing the core with batches
+  still in flight — the drill proving the crash-recovery ladder works
+  mid-pipeline.
 """
 
 import threading
@@ -65,6 +71,7 @@ FAULT_POINTS = (
     "core_proc.spawn_fail",
     "restart.storm",
     "admission.stall",
+    "step.reconcile_stall",
 )
 
 

@@ -156,8 +156,11 @@ class InputBatch:
 
     def append_token(self, req_id: str, token_id: int) -> None:
         """Record a token sampled this step (so the next step's input
-        includes it)."""
-        row = self.req_id_to_index[req_id]
+        includes it). A request already removed (its finish raced a
+        trailing async batch's retirement) is a no-op."""
+        row = self.req_id_to_index.get(req_id)
+        if row is None:
+            return
         n = self.num_tokens[row]
         if n < self.max_model_len:
             self.token_ids[row, n] = token_id

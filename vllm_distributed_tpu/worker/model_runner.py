@@ -63,6 +63,25 @@ class TPUModelRunner:
         pos = jnp.where(valid, pos, dev.shape[1])
         return dev.at[rowm, pos].set(d_toks, mode="drop")
 
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _chain_record(last, rows, tokens):
+        """Async scheduling: scatter this step's sampled tokens (still
+        on device) into the per-row last-sampled mirror at DISPATCH
+        time; padding rows carry an out-of-range index and drop."""
+        return last.at[rows].set(tokens, mode="drop")
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _chain_apply(token_ids, pos, last, rows):
+        """Async scheduling: overwrite the flat input positions whose
+        token the host does not know yet with the previous step's
+        on-device sample — step N+1's input rows read step N's output
+        without a host round-trip (the same device-to-device chaining
+        the multi-step lax.scan burst does within a burst). Padding
+        entries point one past the array and drop."""
+        return token_ids.at[pos].set(last[rows], mode="drop")
+
     def __init__(self, config: EngineConfig, mesh,
                  model=None, params=None) -> None:
         self.config = config
@@ -76,6 +95,13 @@ class TPUModelRunner:
         self.model = model
         self.params = params
         self.kv_caches: Optional[dict] = None
+        # Async scheduling: device-resident [max_num_reqs] mirror of
+        # each row's most recently sampled token, written at dispatch
+        # time (_chain_record) and read by the next dispatch's input
+        # chain (_chain_apply) — the host never round-trips decode
+        # tokens on the hot path.
+        self._async_chain = config.scheduler_config.async_scheduling
+        self._last_sampled_dev: Optional[jax.Array] = None
         # Device-resident sampling-history mirror (see _hist_rows_device).
         self._hist_dev: Optional[jax.Array] = None
         self._hist_len = np.zeros((self.max_num_reqs, ), np.int32)
@@ -288,6 +314,9 @@ class TPUModelRunner:
             self._hist_dev.delete()
             self._hist_dev = None
             self._hist_ver[:] = -1
+        if self._last_sampled_dev is not None:
+            self._last_sampled_dev.delete()
+            self._last_sampled_dev = None
         for leaf in jax.tree_util.tree_leaves(self.params):
             leaf.delete()
         for leaf in jax.tree_util.tree_leaves(self.kv_caches):
@@ -555,6 +584,43 @@ class TPUModelRunner:
                           self.kv_run_buckets)
         return T, max_q, G
 
+    def _fast_decode_rows(self, scheduler_output: SchedulerOutput):
+        """Vectorized-prep eligibility: a pure single-token decode batch
+        with no new/resumed rows and none of the per-token features the
+        general loop handles (spec drafts, M-RoPE tables, LoRA slot
+        grouping, token-parallel rank views, prompt-logprob scoring, mm
+        placeholder substitution). Returns (rows, req_ids) when every
+        scheduled request is one decode token past its prompt, else
+        None — _prepare_inputs then fills the flat arrays with numpy
+        gathers instead of the per-request python loop (delta-style
+        prep for the decode steady state, where host time is the
+        throughput ceiling)."""
+        num_sched = scheduler_output.num_scheduled_tokens
+        if (self.spec_k or self.tknp_size > 1 or self._mrope_on
+                or self.lora_manager is not None
+                or scheduler_output.scheduled_new_reqs
+                or scheduler_output.total_num_scheduled_tokens
+                != len(num_sched)):
+            return None
+        ib = self.input_batch
+        req_ids = list(num_sched)
+        rows = np.fromiter((ib.req_id_to_index[r] for r in req_ids),
+                           np.int32, count=len(req_ids))
+        starts = ib.num_computed[rows]
+        # Every row past its prompt (no plp entries, no mm windows) and
+        # sampling this step (start+1 reaches all committed tokens —
+        # continuation prefills with backlog take the general loop).
+        if not (np.all(starts >= ib.prompt_len[rows])
+                and np.all(starts + 1 >= ib.num_tokens[rows])):
+            return None
+        return rows, req_ids
+
+    def _ensure_last_sampled(self) -> jax.Array:
+        if self._last_sampled_dev is None:
+            self._last_sampled_dev = jnp.zeros((self.max_num_reqs, ),
+                                               jnp.int32)
+        return self._last_sampled_dev
+
     def _prepare_inputs(self, scheduler_output: SchedulerOutput):
         """Flatten the scheduled requests into padded per-token arrays."""
         ib = self.input_batch
@@ -601,13 +667,60 @@ class TPUModelRunner:
         plp_targets: list[int] = []
         # (req_id, entry_index, k, target_token) per scored position.
         plp_meta: list[tuple[str, int, int, int]] = []
+        # Async scheduling: flat positions whose input token is still on
+        # device (step N's sample, not yet landed on the host) and the
+        # batch row to chain it from (_chain_apply).
+        chain_pos: list[int] = []
+        chain_rows: list[int] = []
 
-        t = 0
-        num_runs = 0
-        for req_id, n in num_sched.items():
+        fast = self._fast_decode_rows(scheduler_output)
+        if fast is not None:
+            # Pure single-token decode: fill the flat arrays with
+            # vectorized gathers against the persistent batch instead
+            # of the per-request python loop — the decode steady state
+            # is where per-step host time matters most.
+            rows_np, fast_req_ids = fast
+            N = len(rows_np)
+            starts = ib.num_computed[rows_np].astype(np.int32)
+            idx = np.arange(N, dtype=np.int32)
+            token_ids[:N] = ib.token_ids[rows_np, starts]
+            positions[:N] = starts
+            req_idx[:N] = rows_np
+            pages = ib.block_table[rows_np, starts // ps]
+            offs = starts % ps
+            slot_mapping[:N] = pages * ps + offs
+            seq_info[:N] = np.stack(
+                [idx, np.ones(N, np.int32), starts + 1, rows_np], axis=1)
+            num_runs = N
+            kv_runs_arr = np.zeros((G, 4), np.int32)
+            kv_runs_arr[:N] = np.stack(
+                [pages, offs, idx - offs + ps,
+                 np.ones(N, np.int32)], axis=1)
+            n_kv_runs = N
+            sampling_rows = [int(r) for r in rows_np]
+            sampling_req_ids = fast_req_ids
+            logits_idx = [int(i) for i in idx]
+            if self._async_chain:
+                chained = starts >= ib.num_tokens[rows_np]
+                chain_pos = [int(i) for i in idx[chained]]
+                chain_rows = [int(r) for r in rows_np[chained]]
+            t = N
+            loop_items = ()
+        else:
+            loop_items = num_sched.items()
+            t = 0
+            num_runs = 0
+        for req_id, n in loop_items:
             row = ib.req_id_to_index[req_id]
             start = ib.num_computed[row]
             end = start + n
+            if self._async_chain:
+                # Positions past the host's committed tokens take the
+                # previous step's on-device sample (async run-ahead).
+                known = int(ib.num_tokens[row])
+                for p in range(max(start, known), end):
+                    chain_pos.append(t + (p - start))
+                    chain_rows.append(row)
             drafts = (scheduler_output.scheduled_spec_decode_tokens.get(
                 req_id, []) if self.spec_k else [])
             if drafts:
@@ -683,9 +796,11 @@ class TPUModelRunner:
                 spec_drafts.append(drafts)
             t += n
 
-        kv_runs_arr = np.zeros((G, 4), np.int32)
-        if kv_runs:
-            kv_runs_arr[:len(kv_runs)] = kv_runs
+        if fast is None:
+            kv_runs_arr = np.zeros((G, 4), np.int32)
+            if kv_runs:
+                kv_runs_arr[:len(kv_runs)] = kv_runs
+            n_kv_runs = len(kv_runs)
 
         S1 = self.spec_k + 1  # sampled positions per sampling request
         R = pad_to_bucket(max(len(sampling_rows), 1), self.req_buckets)
@@ -864,7 +979,7 @@ class TPUModelRunner:
             seq_info=jnp.asarray(seq_info),
             num_seqs=jnp.asarray([num_runs], np.int32),
             kv_runs=jnp.asarray(kv_runs_arr),
-            num_kv_runs=jnp.asarray([len(kv_runs)], np.int32),
+            num_kv_runs=jnp.asarray([n_kv_runs], np.int32),
             tknp=tknp,
             lora=lora_ctx,
             cascade_shared_ids=cascade_ids,
@@ -889,11 +1004,21 @@ class TPUModelRunner:
         spec_truncate = bool(self.spec_k) and bool(
             (ib.top_k[rows] > 0).any() or (ib.top_p[rows] < 1.0).any()
             or (ib.min_p[rows] > 0.0).any())
+        chain = None
+        if chain_pos:
+            # Padded to the request bucket; pad positions point one past
+            # the token array so _chain_apply drops them.
+            C = pad_to_bucket(len(chain_pos), self.req_buckets)
+            cp = np.full((C, ), T, np.int32)
+            cr = np.zeros((C, ), np.int32)
+            cp[:len(chain_pos)] = chain_pos
+            cr[:len(chain_rows)] = chain_rows
+            chain = (jnp.asarray(cp), jnp.asarray(cr))
         return (jnp.asarray(token_ids), batch,
                 jnp.asarray(logits_indices), sampling_md,
                 sampling_req_ids, (T, max_q, G), R,
                 (drafts_arr, q_ids, q_probs, spec_truncate), ext_md,
-                want_topk, vocab_mask, plp)
+                want_topk, vocab_mask, plp, chain)
 
     # Fixed sparse-bias width; keeps the graph keyed by R. Admission-time
     # validation in SamplingParams guarantees every request fits.
@@ -1031,8 +1156,18 @@ class TPUModelRunner:
 
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
          fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
-         plp) = self._prepare_inputs(scheduler_output)
+         plp, chain) = self._prepare_inputs(scheduler_output)
         drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
+        if chain is not None:
+            # Async run-ahead rows: substitute the previous dispatch's
+            # on-device samples for the host-unknown input tokens. JAX
+            # program order serializes this gather after the previous
+            # step's _chain_record scatter, so the value is always the
+            # real sampled token by the time the forward reads it.
+            with self.mesh:
+                token_ids = self._chain_apply(
+                    token_ids, chain[0], self._ensure_last_sampled(),
+                    chain[1])
 
         kv_meta = scheduler_output.kv_connector_metadata
         if self.kv_connector is not None and kv_meta is not None:
@@ -1052,6 +1187,18 @@ class TPUModelRunner:
                                        sampling_md, fwd_shape, ext_md,
                                        want_topk, vocab_mask, plp=plp,
                                        spec_q=spec_q)
+        if self._async_chain and spec_q is None:
+            # Record this step's samples for the next dispatch's chain
+            # (device-to-device; no host sync). Padding rows scatter out
+            # of range and drop.
+            rows_pad = np.full((R, ), self.max_num_reqs, np.int32)
+            rows_pad[:len(sampling_req_ids)] = [
+                self.input_batch.req_id_to_index[r]
+                for r in sampling_req_ids]
+            with self.mesh:
+                self._last_sampled_dev = self._chain_record(
+                    self._ensure_last_sampled(), jnp.asarray(rows_pad),
+                    dev[0])
         return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
                 "sampling_req_ids": sampling_req_ids,
                 "drafts_arr": drafts_arr, "R": R,
@@ -1084,11 +1231,13 @@ class TPUModelRunner:
         # the runner, v1/pool/). "last" pooling = the final prompt
         # position's hidden state, exact under chunked prefill too.
         pooled: dict[str, list[float]] = {}
+        # .get: under async scheduling a trailing speculative batch can
+        # retire after its request finished and left the input batch.
         pool_rows = [
             (i, rid)
             for i, rid in enumerate(handle["sampling_req_ids"])
-            if self.input_batch.pooling[
-                self.input_batch.req_id_to_index[rid]] is not None
+            if (row := self.input_batch.req_id_to_index.get(rid))
+            is not None and self.input_batch.pooling[row] is not None
         ]
         if pool_rows:
             S1 = self.spec_k + 1
@@ -1383,8 +1532,10 @@ class TPUModelRunner:
         processor's cumulative-logprob reads the first value), then the
         request's `logprobs=k` top entries when requested."""
         d = {int(token): float(chosen_lp)}
-        row = self.input_batch.req_id_to_index[req_id]
-        k = int(self.input_batch.num_logprobs[row])
+        # Row may be gone when a trailing async batch retires after its
+        # request finished; the scheduler drops the output anyway.
+        row = self.input_batch.req_id_to_index.get(req_id)
+        k = 0 if row is None else int(self.input_batch.num_logprobs[row])
         if topk_np is not None and k > 0:
             vals, ids = topk_np
             for v, t in zip(vals[flat_row, :k], ids[flat_row, :k]):
