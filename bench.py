@@ -257,6 +257,78 @@ def _async_overlap_legs(config, prompts, sp, record) -> None:
         gc.collect()
 
 
+def _phase_percentiles(engine, record) -> None:
+    """p50/p95/p99 per lifecycle phase (queue/prefill/decode/...) from
+    the output processor's timeline-derived durations — the per-request
+    attribution the flat throughput number can't give."""
+    processor = getattr(engine, "output_processor", None)
+    banks = getattr(processor, "phase_durations", None) or {}
+    for phase, samples in sorted(banks.items()):
+        if not samples:
+            continue
+        arr = np.asarray(samples, np.float64) * 1e3  # ms
+        for pct in (50, 95, 99):
+            record[f"phase_{phase}_p{pct}_ms"] = round(
+                float(np.percentile(arr, pct)), 3)
+
+
+def _timeline_overhead_legs(config, prompts, sp, record) -> None:
+    """Acceptance leg: the same decode workload with the lifecycle
+    timeline enabled and disabled, both recorded, so the event
+    recorder's overhead is bounded by measurement (target: within 2%).
+
+    The 2-core container's run-to-run variance (~15% between identical
+    legs) swamps a single-shot A/B, so each leg runs several timed
+    rounds with the first DISCARDED (the first-timed engine pays
+    residual compile/cache effects that would be misread as timeline
+    overhead) and reports best-of-rest. Engines live sequentially —
+    keeping two full-size KV pools resident skews whichever engine was
+    built first."""
+    import gc
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    batch = len(prompts)
+    saved = os.environ.get("VDT_REQUEST_TIMELINE")
+    try:
+        for leg, flag in (("timeline_on", "1"), ("timeline_off", "0")):
+            os.environ["VDT_REQUEST_TIMELINE"] = flag
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                cache_config=CacheConfig(block_size=16),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=2048, max_num_seqs=64,
+                    max_model_len=2048, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            best = 0.0
+            for rnd in range(4):
+                tok_s, _ = _time_decode(engine, prompts, sp,
+                                        f"{leg}-r{rnd}")
+                if rnd > 0:
+                    best = max(best, tok_s)
+            record[f"{leg}_steps_per_s"] = round(best / batch, 2)
+            if flag == "1" and not any(k.startswith("phase_")
+                                       for k in record):
+                # Fallback attribution only: when the headline run
+                # already recorded phase percentiles (timeline on, the
+                # default), this toy leg must not overwrite them.
+                _phase_percentiles(engine, record)
+            del engine
+            gc.collect()
+        on = record.get("timeline_on_steps_per_s")
+        off = record.get("timeline_off_steps_per_s")
+        if on and off:
+            record["timeline_overhead_frac"] = round(1.0 - on / off, 4)
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_REQUEST_TIMELINE", None)
+        else:
+            os.environ["VDT_REQUEST_TIMELINE"] = saved
+
+
 def _find_runner(engine):
     """The model runner behind an in-process engine (None when the
     engine core runs out-of-process)."""
@@ -435,6 +507,10 @@ def main() -> None:
     if not is_tpu and _PROBE_LOG:
         record["probe_log"] = _PROBE_LOG[-4:]
 
+    # Per-phase latency attribution of the headline run (queue/prefill/
+    # decode p50/p95/p99 from the request-lifecycle timeline).
+    _phase_percentiles(engine, record)
+
     # Robustness overhead tracking: the fault-tolerance layer's counters
     # ride every BENCH_*.json so a regression that starts tripping the
     # watchdog (or burning pull retries) on the bench workload is
@@ -471,6 +547,12 @@ def main() -> None:
             _async_overlap_legs(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["async_leg_error"] = f"{type(e).__name__}: {e}"
+        # Timeline-overhead legs (observability acceptance: steps_per_s
+        # with the event recorder on within 2% of off).
+        try:
+            _timeline_overhead_legs(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["timeline_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -513,6 +595,10 @@ def main() -> None:
             _async_overlap_legs(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["async_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _timeline_overhead_legs(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["timeline_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

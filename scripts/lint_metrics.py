@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Ban undocumented (and orphaned) ``vdt:`` metrics.
+
+Every metric name the package emits must be (a) exposed with HELP/TYPE
+lines and (b) listed in the README metrics table — otherwise dashboards
+silently miss new families and the README rots. Mechanically:
+
+* **emitted** — every quoted ``"vdt:..."`` string literal under the
+  package tree (metric names only ever cross the code as literals).
+* **has exposition** — the name appears in ``metrics/prometheus.py`` or
+  ``metrics/stats.py`` (their render paths emit HELP/TYPE for every
+  name they carry), or some package file contains a literal
+  ``# HELP <name>`` (ad-hoc exposition blocks, e.g. the admission gauges
+  in the API server).
+* **documented** — the name appears in the README metrics table
+  (any backticked ``vdt:...`` token in the README counts).
+
+Failures: emitted without exposition, emitted without a README row, or
+a README row naming a metric nothing emits (orphan).
+
+Usage::
+
+    python scripts/lint_metrics.py [--package DIR] [--readme FILE]
+
+Exit 0 when clean; exit 1 listing violations otherwise.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+METRIC_LITERAL_RE = re.compile(r"""["'](vdt:[a-z0-9_]+)""")
+METRIC_NAME_RE = re.compile(r"`(vdt:[a-z0-9_]+)")
+
+# Modules whose registries/render helpers always emit HELP/TYPE for the
+# names they carry.
+EXPOSITION_MODULES = ("metrics/prometheus.py", "metrics/stats.py")
+
+
+def collect(package: Path) -> tuple[set, set]:
+    """-> (emitted names, names with HELP/TYPE exposition)."""
+    emitted: set[str] = set()
+    exposed: set[str] = set()
+    registry_text = ""
+    for rel in EXPOSITION_MODULES:
+        path = package / rel
+        if path.is_file():
+            registry_text += path.read_text(encoding="utf-8")
+    for path in sorted(package.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for name in METRIC_LITERAL_RE.findall(text):
+            emitted.add(name)
+            if f"# HELP {name}" in text or name in registry_text:
+                exposed.add(name)
+    return emitted, exposed
+
+
+def readme_metrics(readme: Path) -> set:
+    return set(METRIC_NAME_RE.findall(readme.read_text(encoding="utf-8")))
+
+
+def main(argv: list[str]) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--package", type=Path,
+                        default=repo / "vllm_distributed_tpu",
+                        help="package tree to scan for emitted metrics")
+    parser.add_argument("--readme", type=Path,
+                        default=repo / "README.md",
+                        help="README carrying the metrics table")
+    args = parser.parse_args(argv)
+    if not args.package.is_dir():
+        print(f"lint_metrics: no such directory: {args.package}",
+              file=sys.stderr)
+        return 2
+    if not args.readme.is_file():
+        print(f"lint_metrics: no such file: {args.readme}",
+              file=sys.stderr)
+        return 2
+
+    emitted, exposed = collect(args.package)
+    documented = readme_metrics(args.readme)
+    problems: list[str] = []
+    for name in sorted(emitted - exposed):
+        problems.append(f"{name}: emitted without HELP/TYPE exposition "
+                        f"(add it to metrics/prometheus.py or an "
+                        f"explicit '# HELP {name}' block)")
+    for name in sorted(emitted - documented):
+        problems.append(f"{name}: missing from the README metrics table "
+                        f"({args.readme.name})")
+    for name in sorted(documented - emitted):
+        problems.append(f"{name}: in the README metrics table but "
+                        f"emitted nowhere (orphaned row)")
+    if not problems:
+        return 0
+    print("vdt: metric documentation drift:", file=sys.stderr)
+    for p in problems:
+        print(f"  {p}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

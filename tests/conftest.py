@@ -9,6 +9,12 @@ xla_force_host_platform_device_count).
 
 import os
 
+# The container has no DNS: hub lookups only ever time out, and the
+# retry backoff costs ~72s per ModelConfig load. Force offline mode
+# before transformers/huggingface_hub import anywhere in the session.
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
 # Force CPU even when the environment pre-sets a TPU platform: unit tests
 # must run on the 8-device virtual CPU mesh, never the real chip.
 os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,7 +1,11 @@
 """Request tracing spans (reference: vllm/tracing.py + tests/tracing/):
-one span per finished request with latency/usage attributes, via the
-built-in JSONL exporter."""
+one parent span per finished request with latency/usage attributes AND
+child phase spans (queue/prefill/decode/...) stitched from the
+request-lifecycle timeline, via the built-in JSONL exporter. A request
+replayed through an engine restart keeps its original request id and
+carries the journal/replay events."""
 
+import asyncio
 import json
 
 import pytest
@@ -12,6 +16,26 @@ from transformers import LlamaForCausalLM as HFLlama
 from vllm_distributed_tpu.engine.arg_utils import EngineArgs
 from vllm_distributed_tpu.engine.llm_engine import LLMEngine
 from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+
+def test_jsonl_tracer_follows_rotation(tmp_path):
+    """The persistent handle must not defeat logrotate: a rename out
+    from under the tracer redirects the NEXT span to a fresh file at
+    the configured path (writes to the renamed inode would succeed, so
+    only the inode check can catch this)."""
+    import os
+
+    from vllm_distributed_tpu.tracing import JsonlTracer
+    path = tmp_path / "spans.jsonl"
+    tracer = JsonlTracer(str(path))
+    tracer.emit({"req": 1})
+    os.rename(path, tmp_path / "spans.jsonl.1")
+    tracer.emit({"req": 2})
+    tracer.shutdown()
+    assert len(path.read_text().splitlines()) == 1
+    assert len((tmp_path / "spans.jsonl.1")
+               .read_text().splitlines()) == 1
 
 
 @pytest.fixture(scope="module")
@@ -52,3 +76,78 @@ def test_spans_written_per_request(checkpoint, tmp_path):
         assert a["gen_ai.latency.e2e"] >= \
             a["gen_ai.latency.time_to_first_token"]
         assert a["gen_ai.response.finish_reason"] == "length"
+        # Phase child spans under the parent: a plain request shows at
+        # least queue -> prefill -> decode, each with a non-negative
+        # in-parent offset and duration.
+        phases = {p["phase"]: p for p in span["phases"]}
+        assert {"queue", "prefill", "decode"} <= set(phases)
+        for p in span["phases"]:
+            assert p["start_s"] >= 0 and p["duration_s"] >= 0
+        assert phases["queue"]["start_s"] <= phases["prefill"]["start_s"]
+        assert (phases["prefill"]["start_s"]
+                <= phases["decode"]["start_s"])
+        # The raw timeline rides along for forensics.
+        names = [e[1] for e in span["events"]]
+        assert "arrived" in names and "scheduled" in names
+        assert "first_token" in names and "finished" in names
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery: the trace survives an engine restart
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_replayed_request_trace_links_original_id(checkpoint, tmp_path):
+    """Kill the core mid-decode (PR2 harness): the journaled request
+    replays as a continuation into the respawned core, and the emitted
+    trace is ONE parent span under the ORIGINAL request id whose
+    timeline carries the engine_death/journal_replay events."""
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    fi.clear()
+    trace_file = str(tmp_path / "replay_spans.jsonl")
+    engine = AsyncLLM(EngineArgs(
+        model=checkpoint, dtype="float32", block_size=4,
+        num_gpu_blocks_override=64, max_model_len=64,
+        max_num_batched_tokens=64, max_num_seqs=8,
+        skip_tokenizer_init=True,
+        restart_backoff_base_s=0.01, restart_backoff_max_s=0.05,
+        otlp_traces_endpoint=f"file://{trace_file}",
+    ).create_engine_config(), load_tokenizer=False)
+
+    async def run():
+        sp = SamplingParams(temperature=0.0, max_tokens=24,
+                            ignore_eos=True)
+        got_first = False
+        final = None
+        async for out in engine.generate([3, 17, 92, 45, 8],
+                                         sp, request_id="traced-0"):
+            if not got_first:
+                got_first = True
+                fi.inject("engine_core.die", max_fires=1)
+            final = out
+        assert final is not None and final.finished
+        return final.outputs[0].token_ids
+
+    try:
+        tokens = asyncio.run(asyncio.wait_for(run(), timeout=180.0))
+        assert len(tokens) == 24
+        assert engine.output_processor.stats.num_requests_replayed >= 1
+        spans = [json.loads(line) for line in open(trace_file)]
+        mine = [s for s in spans
+                if s["attributes"]["gen_ai.request.id"] == "traced-0"]
+        # ONE parent span for the whole request, original id, replay
+        # visible on its timeline.
+        assert len(mine) == 1
+        span = mine[0]
+        assert span["attributes"]["gen_ai.usage.completion_tokens"] == 24
+        names = [e[1] for e in span["events"]]
+        assert "engine_death" in names
+        assert "journal_replay" in names
+        phase_names = {p["phase"] for p in span["phases"]}
+        assert {"queue", "prefill", "decode"} <= phase_names
+        # The death -> replay window surfaces as a stall child span.
+        assert "stall" in phase_names
+    finally:
+        fi.clear()
+        engine.shutdown()

@@ -29,7 +29,7 @@ def _clean_faults():
 
 
 def _stub_engine(kv_usage: float = 0.0):
-    async def get_stats():
+    async def get_stats(include_events=True):
         return {"kv_cache_usage": kv_usage}
 
     return types.SimpleNamespace(

@@ -1,0 +1,200 @@
+"""Request-lifecycle event recorder + phase stitching + histogram
+mechanics (metrics/events.py, metrics/stats.py)."""
+
+import random
+
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.metrics.stats import (ITL_BUCKETS,
+                                                STEP_PHASE_BUCKETS,
+                                                TTFT_BUCKETS, Histogram,
+                                                merge_histogram_dicts,
+                                                render_histogram_lines)
+
+# ---------------------------------------------------------------------------
+# EventRecorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_record_drain_snapshot():
+    r = ev.EventRecorder(enabled=True)
+    r.record("r1", ev.QUEUED, {"prompt_tokens": 4})
+    r.record("r1", ev.SCHEDULED, None)
+    r.record("r2", ev.QUEUED, None)
+    assert len(r) == 3
+    snap = r.snapshot()
+    assert len(snap) == 3 and len(r) == 3  # snapshot does not clear
+    drained = r.drain()
+    assert [e[1:3] for e in drained] == [["r1", ev.QUEUED],
+                                         ["r1", ev.SCHEDULED],
+                                         ["r2", ev.QUEUED]]
+    assert drained[0][3] == {"prompt_tokens": 4}
+    assert len(r) == 0 and r.drain() == []
+    # Timestamps are monotonic-clock floats in order.
+    assert drained[0][0] <= drained[1][0] <= drained[2][0]
+
+
+def test_recorder_overflow_drops_oldest():
+    r = ev.EventRecorder(maxlen=4, enabled=True)
+    for i in range(10):
+        r.record(f"r{i}", ev.QUEUED, None)
+    assert len(r) == 4
+    assert r.num_dropped >= 1
+    assert [e[1] for e in r.drain()] == ["r6", "r7", "r8", "r9"]
+
+
+def test_recorder_disabled_records_nothing():
+    r = ev.EventRecorder(enabled=False)
+    r.record("r1", ev.QUEUED, None)
+    assert len(r) == 0 and r.drain() == []
+
+
+def test_merge_event_lists_sorts_by_timestamp():
+    a = [[2.0, "a", ev.QUEUED, None], [5.0, "a", ev.FINISHED, None]]
+    b = [[1.0, "b", ev.QUEUED, None], [3.0, "b", ev.FINISHED, None]]
+    merged = ev.merge_event_lists(a, b, None, [])
+    assert [e[0] for e in merged] == [1.0, 2.0, 3.0, 5.0]
+
+
+# ---------------------------------------------------------------------------
+# Phase stitching
+# ---------------------------------------------------------------------------
+
+
+def _tl(*entries):
+    return [(float(ts), event, detail) for ts, event, detail in entries]
+
+
+def test_phases_plain_request():
+    tl = _tl((10, ev.ARRIVED, None), (12, ev.SCHEDULED, None),
+             (14, ev.FIRST_TOKEN, None), (20, ev.FINISHED, None))
+    phases = {p["phase"]: (p["start"], p["end"])
+              for p in ev.phases_from_timeline(tl)}
+    assert phases == {"queue": (10, 12), "prefill": (12, 14),
+                      "decode": (14, 20)}
+
+
+def test_phases_with_kv_pull_and_preemption():
+    tl = _tl((0, ev.ARRIVED, None), (1, ev.KV_PULL_WAIT, None),
+             (4, ev.KV_PULL_DONE, None), (5, ev.SCHEDULED, None),
+             (6, ev.FIRST_TOKEN, None), (8, ev.PREEMPTED, None),
+             (9, ev.RESUMED, None), (12, ev.FINISHED, None))
+    phases = ev.phases_from_timeline(tl)
+    by_name = {p["phase"]: p for p in phases}
+    assert by_name["queue"]["end"] == 1  # queue ends at the hold
+    assert (by_name["kv_pull"]["start"],
+            by_name["kv_pull"]["end"]) == (1, 4)
+    assert (by_name["stall"]["start"], by_name["stall"]["end"]) == (8, 9)
+    assert by_name["decode"]["end"] == 12
+
+
+def test_phases_replay_window_is_a_stall():
+    tl = _tl((0, ev.ARRIVED, None), (1, ev.SCHEDULED, None),
+             (2, ev.FIRST_TOKEN, None), (3, ev.ENGINE_DEATH, None),
+             (7, ev.JOURNAL_REPLAY, None), (9, ev.FINISHED, None))
+    stalls = [p for p in ev.phases_from_timeline(tl)
+              if p["phase"] == "stall"]
+    assert len(stalls) == 1
+    assert (stalls[0]["start"], stalls[0]["end"]) == (3, 7)
+
+
+def test_phases_open_request_ends_at_now():
+    tl = _tl((0, ev.ARRIVED, None), (1, ev.SCHEDULED, None),
+             (2, ev.FIRST_TOKEN, None))
+    by_name = {p["phase"]: p for p in ev.phases_from_timeline(tl, now=6)}
+    assert by_name["decode"]["end"] == 6
+    assert ev.current_phase(tl) == "decode"
+
+
+def test_current_phase_transitions():
+    assert ev.current_phase(_tl((0, ev.ARRIVED, None))) == "queued"
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.KV_PULL_WAIT, None))) == "kv_pull"
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.SCHEDULED, None),
+        (2, ev.PREEMPTED, None))) == "preempted"
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.ENGINE_DEATH, None))) == "replaying"
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.FINISHED, None))) == "finished"
+    # A decode-stage request resumed after preemption (or replayed) is
+    # still DECODING — re-grants must not read as prefill forever.
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.SCHEDULED, None),
+        (2, ev.FIRST_TOKEN, None), (3, ev.PREEMPTED, None),
+        (4, ev.RESUMED, None))) == "decode"
+    assert ev.current_phase(_tl(
+        (0, ev.ARRIVED, None), (1, ev.SCHEDULED, None),
+        (2, ev.FIRST_TOKEN, None), (3, ev.ENGINE_DEATH, None),
+        (4, ev.JOURNAL_REPLAY, None))) == "decode"
+
+
+def test_phase_durations_sums_stalls():
+    phases = [{"phase": "stall", "start": 1.0, "end": 2.0},
+              {"phase": "stall", "start": 4.0, "end": 7.0},
+              {"phase": "decode", "start": 0.0, "end": 10.0}]
+    durs = ev.phase_durations(phases)
+    assert durs["stall"] == 4.0 and durs["decode"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# Histogram: bisect observe parity + serialized round-trip (satellites)
+# ---------------------------------------------------------------------------
+
+
+def _linear_reference(buckets, values):
+    counts = [0] * (len(buckets) + 1)
+    for v in values:
+        for i, b in enumerate(buckets):
+            if v <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    return counts
+
+
+def test_observe_bisect_matches_linear_scan():
+    rng = random.Random(0)
+    for buckets in (TTFT_BUCKETS, ITL_BUCKETS, STEP_PHASE_BUCKETS):
+        h = Histogram(buckets)
+        values = [rng.random() * buckets[-1] * 2 for _ in range(500)]
+        # Exact bucket edges are the classic off-by-one trap for a
+        # bisect rewrite: value == bound must land IN that bucket.
+        values += list(buckets)
+        for v in values:
+            h.observe(v)
+        assert h.counts == _linear_reference(buckets, values)
+        assert h.count == len(values)
+
+
+def test_render_round_trip_is_byte_identical():
+    """render_histogram_lines over a live Histogram and over its
+    serialized-dict stats form (what engines ship over the stats RPC)
+    must produce byte-identical exposition."""
+    h = Histogram(TTFT_BUCKETS)
+    rng = random.Random(1)
+    for _ in range(200):
+        h.observe(rng.random() * 50)
+    live = h.render("vdt:test_seconds", "help text")
+    d = h.to_dict()
+    wire = render_histogram_lines("vdt:test_seconds", "help text",
+                                  d["buckets"], d["counts"], d["sum"],
+                                  d["count"])
+    assert "\n".join(live) == "\n".join(wire)
+
+
+def test_merge_histogram_dicts():
+    a = Histogram(ITL_BUCKETS)
+    b = Histogram(ITL_BUCKETS)
+    for i in range(50):
+        a.observe(i * 0.01)
+        b.observe(i * 0.02)
+    merged = merge_histogram_dicts([a.to_dict(), b.to_dict(), None])
+    assert merged["count"] == 100
+    assert merged["counts"] == [x + y for x, y in zip(a.counts, b.counts)]
+    # Mismatched layouts are skipped, not mis-summed.
+    other = Histogram(TTFT_BUCKETS)
+    other.observe(1.0)
+    merged2 = merge_histogram_dicts([a.to_dict(), other.to_dict()])
+    assert merged2["count"] == a.count
+    assert merge_histogram_dicts([None, {}]) is None

@@ -1,0 +1,78 @@
+"""CI guard: every emitted vdt: metric stays documented.
+
+Runs scripts/lint_metrics.py over the real package + README (tier-1
+mechanical check) and unit-tests the linter's failure modes on
+synthetic trees: an emitted-but-undocumented metric, a metric without
+HELP/TYPE exposition, and an orphaned README row."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_metrics.py"
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _tree(tmp_path, source: str, readme: str):
+    pkg = tmp_path / "pkg"
+    (pkg / "metrics").mkdir(parents=True)
+    (pkg / "emitter.py").write_text(source)
+    readme_path = tmp_path / "README.md"
+    readme_path.write_text(readme)
+    return pkg, readme_path
+
+
+def test_package_metrics_are_documented():
+    res = _run()
+    assert res.returncode == 0, (
+        f"vdt: metric documentation drifted:\n{res.stderr}")
+
+
+def test_undocumented_metric_is_caught(tmp_path):
+    """A metric emitted with exposition but missing its README row."""
+    src = ('LINES = ["# HELP vdt:bogus_total x",\n'
+           '         "# TYPE vdt:bogus_total counter",\n'
+           '         "vdt:bogus_total 1"]\n')
+    pkg, readme = _tree(tmp_path, src, "# nothing here\n")
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "vdt:bogus_total" in res.stderr
+    assert "missing from the README" in res.stderr
+
+
+def test_unexposed_metric_is_caught(tmp_path):
+    """A metric emitted as a bare literal with no HELP/TYPE anywhere."""
+    pkg, readme = _tree(tmp_path, 'NAME = "vdt:sneaky_total"\n',
+                        "| `vdt:sneaky_total` | counter | x |\n")
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "without HELP/TYPE exposition" in res.stderr
+
+
+def test_orphaned_readme_row_is_caught(tmp_path):
+    pkg, readme = _tree(tmp_path, "x = 1\n",
+                        "| `vdt:ghost_total` | counter | gone |\n")
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "orphaned row" in res.stderr
+
+
+def test_clean_synthetic_tree_passes(tmp_path):
+    src = ('LINES = ["# HELP vdt:ok_total x",\n'
+           '         "# TYPE vdt:ok_total counter",\n'
+           '         "vdt:ok_total 1"]\n')
+    pkg, readme = _tree(tmp_path, src,
+                        "| `vdt:ok_total` | counter | fine |\n")
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr
+
+
+def test_missing_package_is_a_usage_error(tmp_path):
+    res = _run("--package", str(tmp_path / "nope"),
+               "--readme", str(tmp_path / "also-nope"))
+    assert res.returncode == 2

@@ -26,6 +26,7 @@ from vllm_distributed_tpu.core.sched.output import (CachedRequestData,
                                                     SchedulerOutput,
                                                     TokenParallelAllocation)
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics import events as ev
 from vllm_distributed_tpu.request import Request, RequestStatus
 
 logger = init_logger(__name__)
@@ -37,7 +38,7 @@ class EngineCoreOutput:
 
     __slots__ = ("req_id", "new_token_ids", "finish_reason", "stop_reason",
                  "num_cached_tokens", "logprobs", "kv_transfer_params",
-                 "pooled", "prompt_logprobs")
+                 "pooled", "prompt_logprobs", "events")
 
     def __init__(self, req_id: str, new_token_ids: list[int],
                  finish_reason: Optional[str] = None,
@@ -46,7 +47,8 @@ class EngineCoreOutput:
                  logprobs: Optional[list[dict[int, float]]] = None,
                  kv_transfer_params: Optional[dict] = None,
                  pooled: Optional[list[float]] = None,
-                 prompt_logprobs: Optional[list] = None) -> None:
+                 prompt_logprobs: Optional[list] = None,
+                 events: Optional[list[tuple]] = None) -> None:
         self.req_id = req_id
         self.new_token_ids = new_token_ids
         self.finish_reason = finish_reason
@@ -63,6 +65,10 @@ class EngineCoreOutput:
         # request's FIRST emitted output once the prompt completes
         # (reference: prompt_logprobs on the engine-core output path).
         self.prompt_logprobs = prompt_logprobs
+        # Core-side lifecycle events (metrics/events.py) accumulated on
+        # the request since its previous output; the front-end stitches
+        # them into the request's phase timeline.
+        self.events = events
 
     @property
     def finished(self) -> bool:
@@ -244,6 +250,34 @@ class Scheduler:
         self.watchdog_timeouts = 0
         self.kv_pull_retries = 0
         self.kv_pull_failures = 0
+        # Request-lifecycle timeline (metrics/events.py): the scheduler's
+        # local ring buffer, drained over the stats RPC; the per-request
+        # event lists additionally ride EngineCoreOutput to the
+        # front-end. `events_enabled` is cached (the envs registry
+        # re-reads os.environ per access).
+        self.events = ev.EventRecorder()
+        self.events_enabled = self.events.enabled
+        # Batch composition of the most recent non-empty step (gauges).
+        self.last_step_prefill_tokens = 0
+        self.last_step_decode_tokens = 0
+
+    def _record_event(self, request: Request, event: str,
+                      detail: Optional[dict] = None) -> None:
+        """One lifecycle transition: onto the request's own event list
+        (ships with its next output) and the scheduler's ring buffer
+        (ships with get_stats)."""
+        if not self.events_enabled:
+            return
+        ts = time.monotonic()
+        request.events.append((ts, event, detail))
+        self.events.record(request.request_id, event, detail, ts=ts)
+
+    def _take_events(self, request: Request) -> Optional[list[tuple]]:
+        if not request.events:
+            return None
+        taken = request.events
+        request.events = []
+        return taken
 
     # ------------------------------------------------------------------
     # Request intake / teardown
@@ -252,6 +286,9 @@ class Scheduler:
         assert request.request_id not in self.requests
         self.requests[request.request_id] = request
         request.status = RequestStatus.WAITING
+        self._record_event(request, ev.QUEUED,
+                           {"prompt_tokens": request.num_prompt_tokens,
+                            "priority": request.priority})
         if self.policy == "priority":
             self._insert_by_priority(request)
         else:
@@ -445,6 +482,9 @@ class Scheduler:
         scheduled_spec_tokens: dict[str, list[int]] = {}
         token_budget = self.max_num_batched_tokens
         preempted: list[Request] = []
+        # Batch composition (prefill vs decode tokens) of this step.
+        prefill_tokens = 0
+        decode_tokens = 0
 
         # Multi-step decode burst: when every running request is in plain
         # decode and nothing is waiting, the worker can run N fused decode
@@ -554,6 +594,16 @@ class Scheduler:
 
             num_scheduled_tokens[request.request_id] = num_new_tokens
             token_budget -= num_new_tokens
+            if request.num_computed_tokens < request.num_prompt_tokens:
+                # Ongoing chunked prefill (num_computed is pre-advance
+                # here even under async scheduling).
+                prefill_tokens += num_new_tokens
+                self._record_event(
+                    request, ev.PREFILL_CHUNK,
+                    {"computed": request.num_computed_tokens,
+                     "granted": num_new_tokens})
+            else:
+                decode_tokens += num_new_tokens
             if request.spec_token_ids:
                 # Trim drafts to the granted token count (1 committed token
                 # + at most num_new_tokens-1 drafts); publishing untrimmed
@@ -580,6 +630,11 @@ class Scheduler:
                 request.num_computed_tokens += num_new_tokens
                 if speculative:
                     self.num_async_spec_grants += 1
+                    if not request.async_spec_granted:
+                        # Timeline transition: entered run-ahead mode
+                        # (once per request; grants recur per step).
+                        request.async_spec_granted = True
+                        self._record_event(request, ev.SPEC_GRANT, None)
             req_index += 1
 
         # ---- 2. Waiting requests (new or resumed-from-preemption) ----
@@ -683,6 +738,8 @@ class Scheduler:
                     self.waiting.popleft()
                     self._commit_encoder_budget(request)
                     request.status = RequestStatus.WAITING_FOR_REMOTE_KVS
+                    self._record_event(request, ev.KV_PULL_WAIT,
+                                       {"external_tokens": num_external})
                     request.num_computed_tokens = num_computed_tokens
                     request.num_external_computed_tokens = num_external
                     self.kv_connector.update_state_after_alloc(
@@ -741,9 +798,19 @@ class Scheduler:
                     num_computed_tokens += num_external
                     request.num_computed_tokens = num_computed_tokens
                 self.running.append(request)
+                self._record_event(request,
+                                   ev.RESUMED if resumed else ev.SCHEDULED,
+                                   {"computed": num_computed_tokens,
+                                    "granted": num_new_tokens})
 
                 num_scheduled_tokens[request.request_id] = num_new_tokens
                 token_budget -= num_new_tokens
+                if num_computed_tokens < request.num_prompt_tokens:
+                    prefill_tokens += num_new_tokens
+                else:
+                    # Whole prompt already computed (e.g. remote-KV
+                    # pull landed everything): this grant is decode.
+                    decode_tokens += num_new_tokens
 
                 all_block_ids = self.kv_cache_manager.get_block_ids(
                     request.request_id)
@@ -774,6 +841,9 @@ class Scheduler:
 
         self.num_scheduled_steps += 1
         total = sum(num_scheduled_tokens.values())
+        if num_scheduled_tokens:
+            self.last_step_prefill_tokens = prefill_tokens
+            self.last_step_decode_tokens = decode_tokens
         tknp_alloc = None
         if self.tknp_size > 1:
             req_to_rank = {
@@ -887,6 +957,8 @@ class Scheduler:
         request.spec_token_ids = []
         request.num_preemptions += 1
         self.num_preemptions += 1
+        self._record_event(request, ev.PREEMPTED,
+                           {"num_preemptions": request.num_preemptions})
         if self.policy == "priority":
             self._insert_by_priority(request)
         else:
@@ -975,7 +1047,8 @@ class Scheduler:
                     req_id=req_id, new_token_ids=[],
                     finish_reason=request.get_finished_reason(),
                     num_cached_tokens=max(request.num_cached_tokens, 0),
-                    pooled=pooled_map[req_id]))
+                    pooled=pooled_map[req_id],
+                    events=self._take_events(request)))
                 continue
             if scheduler_output.multi_step > 1:
                 # The worker computed KV for one token per fused step.
@@ -1041,6 +1114,7 @@ class Scheduler:
                     num_cached_tokens=max(request.num_cached_tokens, 0),
                     logprobs=logprobs,
                     prompt_logprobs=prompt_lps,
+                    events=self._take_events(request),
                 ))
 
         for request in finished:
@@ -1088,6 +1162,7 @@ class Scheduler:
                 max(request.num_cached_tokens, 0) +
                 request.num_external_computed_tokens)
             request.num_external_computed_tokens = 0
+            self._record_event(request, ev.KV_PULL_DONE, None)
             self._requeue_after_hold(request)
         for req_id in (runner_output.failed_recving or ()):
             cancelled = self.cancelled_remote_kv.pop(req_id, None)
@@ -1170,6 +1245,8 @@ class Scheduler:
                     continue
                 del self.waiting_for_remote_kv[req_id]
                 self.watchdog_timeouts += 1
+                self._record_event(request, ev.KV_PULL_TIMEOUT,
+                                   {"timeout_s": self.kv_pull_timeout_s})
                 self._park_timed_out_pages(request)
                 self._handle_failed_pull(
                     request, pull_resolved=False,
@@ -1231,6 +1308,9 @@ class Scheduler:
         if retry:
             request.num_kv_pull_retries += 1
             self.kv_pull_retries += 1
+            self._record_event(request, ev.KV_PULL_RETRY,
+                               {"attempt": request.num_kv_pull_retries,
+                                "reason": reason})
             logger.warning(
                 "KV pull for %s failed (%s); retrying pull %d/%d",
                 request.request_id, reason, request.num_kv_pull_retries,
@@ -1240,6 +1320,8 @@ class Scheduler:
                 "KV pull for %s failed (%s); degrading to local prefill "
                 "recompute", request.request_id, reason)
             request.kv_transfer_params = None
+            self._record_event(request, ev.KV_PULL_LOCAL,
+                               {"reason": reason})
         self._requeue_after_hold(request)
 
     def _requeue_after_hold(self, request: Request) -> None:
@@ -1277,6 +1359,8 @@ class Scheduler:
             "watchdog_timeouts": self.watchdog_timeouts,
             "kv_pull_retries": self.kv_pull_retries,
             "kv_pull_failures": self.kv_pull_failures,
+            "last_step_prefill_tokens": self.last_step_prefill_tokens,
+            "last_step_decode_tokens": self.last_step_decode_tokens,
             **self.kv_cache_manager.make_prefix_cache_stats(),
         }
         if self.tknp_size > 1:
@@ -1285,3 +1369,65 @@ class Scheduler:
                 stats[f"tknp_free_blocks_rank{r}"] = \
                     self.kv_cache_manager.free_blocks_on_rank(r)
         return stats
+
+    def _num_blocks_of(self, req_id: str) -> Optional[int]:
+        try:
+            if req_id in getattr(self.kv_cache_manager,
+                                 "req_to_blocks", {}):
+                return len(self.kv_cache_manager.get_block_ids(req_id))
+            mgrs = getattr(self.kv_cache_manager, "managers", None)
+            if mgrs is not None:  # token-parallel: per-rank managers
+                for m in mgrs:
+                    if req_id in getattr(m, "req_to_blocks", {}):
+                        return len(m.get_block_ids(req_id))
+        except Exception:  # noqa: BLE001 - debug surface, never raise
+            pass
+        return None
+
+    def get_debug_state(self) -> dict:
+        """Live scheduler introspection for the /debug endpoints and the
+        SIGUSR1 dump: every tracked request with its status, progress,
+        page footprint and in-flight refcount, plus queue/hold summary.
+        Read-only and cheap — safe to call while requests are in
+        flight. On the in-proc/background-thread paths this runs on the
+        CALLER's thread while the core thread mutates the containers,
+        so take C-level (GIL-atomic) list/dict snapshots before any
+        Python-level iteration — iterating the live dict/deque raises
+        "changed size during iteration" mid-step."""
+        waiting = list(self.waiting)
+        running = list(self.running)
+        reqs = []
+        for request in list(self.requests.values()):
+            reqs.append({
+                "request_id": request.request_id,
+                "status": request.status.name,
+                "priority": request.priority,
+                "num_prompt_tokens": request.num_prompt_tokens,
+                "num_output_tokens": request.num_output_tokens,
+                "num_computed_tokens": request.num_computed_tokens,
+                "num_cached_tokens": max(request.num_cached_tokens, 0),
+                "num_preemptions": request.num_preemptions,
+                "num_kv_pull_retries": request.num_kv_pull_retries,
+                "inflight_refcount":
+                    self.in_flight_req_ids.get(request.request_id, 0),
+                "kv_blocks": self._num_blocks_of(request.request_id),
+                "tknp_rank": request.tknp_rank,
+            })
+        return {
+            "requests": reqs,
+            "num_waiting": len(waiting),
+            "num_running": len(running),
+            "waiting_req_ids": [r.request_id for r in waiting],
+            "running_req_ids": [r.request_id for r in running],
+            "waiting_for_remote_kvs":
+                list(self.waiting_for_remote_kv),
+            "reqs_pending_send": list(self.reqs_pending_send),
+            "cancelled_remote_kv": list(self.cancelled_remote_kv),
+            "finished_pending_retire":
+                list(self._finished_pending_retire),
+            "deferred_finishes": list(self._deferred_finishes),
+            "kv_cache_usage": self.kv_cache_manager.usage,
+            "num_preemptions": self.num_preemptions,
+            "last_step_prefill_tokens": self.last_step_prefill_tokens,
+            "last_step_decode_tokens": self.last_step_decode_tokens,
+        }

@@ -24,6 +24,7 @@ from vllm_distributed_tpu.engine.llm_engine import _load_tokenizer
 from vllm_distributed_tpu.engine.output_processor import OutputProcessor
 from vllm_distributed_tpu.engine.processor import Processor
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics import events as ev
 from vllm_distributed_tpu.outputs import RequestOutput
 from vllm_distributed_tpu.sampling_params import SamplingParams
 
@@ -155,6 +156,15 @@ class AsyncLLM:
         False once the budget circuit-breaks (the caller then fails
         pending requests with the terminal EngineDeadError)."""
         from vllm_distributed_tpu.utils import fault_injection
+        # Timeline: the death reaches every journaled request's trace.
+        # (Pump-thread appends race loop-thread reads only as GIL-atomic
+        # list appends; the finish path sorts a copy.)
+        if self.output_processor.timeline_enabled:
+            with self._journal_lock:
+                journaled = list(self._journal)
+            for rid in journaled:
+                self.output_processor.record_event(
+                    rid, ev.ENGINE_DEATH, {"error": str(err)})
         while not self._stopped:
             delay = self._supervisor.next_delay()
             if delay is None:
@@ -254,10 +264,12 @@ class AsyncLLM:
                     self._fail_request(rid, replay_err)
                 continue
             self.output_processor.stats.num_requests_replayed += 1
+            delivered = (len(req.prompt_token_ids)
+                         - len(orig.prompt_token_ids))
+            self.output_processor.record_event(
+                rid, ev.JOURNAL_REPLAY, {"delivered": delivered})
             logger.info("replayed request %s (%d tokens already "
-                        "delivered)", rid,
-                        len(req.prompt_token_ids) -
-                        len(orig.prompt_token_ids))
+                        "delivered)", rid, delivered)
 
     def _continuation_request(self, rid: str, orig):
         from vllm_distributed_tpu.request import continuation_request
@@ -436,8 +448,49 @@ class AsyncLLM:
                 return out
         raise RuntimeError("encode stream ended without a result")
 
-    async def get_stats(self) -> dict:
-        return await self._utility("get_stats")
+    async def get_stats(self, include_events: bool = True) -> dict:
+        """include_events=False skips the core-side event-ring drain —
+        REQUIRED for callers that may cancel the await (wait_for
+        timeouts): the drain is destructive, and an abandoned response
+        silently discards the drained batch."""
+        stats = await self._utility("get_stats", include_events)
+        # Core-side lifecycle events are drained (destructively) per
+        # stats poll; retain them front-side for /debug recent-events.
+        events = stats.pop("timeline_events", None)
+        if events:
+            self.output_processor.core_events.absorb(events)
+        return stats
+
+    async def get_debug_state(self) -> dict:
+        """Live engine-core introspection (scheduler queues, per-request
+        progress, batch-pipeline occupancy) for the /debug endpoints
+        and the SIGUSR1 dump."""
+        return await self._utility("get_debug_state")
+
+    def supervisor_state(self) -> dict:
+        """Restart-supervisor snapshot for /debug/engine. Uses the
+        supervisor's read-only peek(): _expire()/exhausted REBUILD the
+        attempts list, and this runs on the event-loop thread while the
+        death handler may be inside next_delay() — a concurrent rebuild
+        could discard a just-granted attempt and weaken the circuit
+        breaker."""
+        sup = self._supervisor
+        in_window, exhausted = sup.peek()
+        return {
+            "max_attempts": sup.max_attempts,
+            "window_s": sup.window_s,
+            "attempts_in_window": in_window,
+            "exhausted": exhausted,
+            "engine_deaths": self.stats_engine_deaths(),
+            "journal_depth": len(self._journal),
+            "errored": self.errored,
+            "dead_error": (str(self._dead_error)
+                           if self._dead_error is not None else None),
+            "core": type(self.core).__name__,
+        }
+
+    def stats_engine_deaths(self) -> int:
+        return self.output_processor.stats.num_engine_deaths
 
     async def profile(self, action: str = "start"):
         """Start/stop a device trace on the core (reference:

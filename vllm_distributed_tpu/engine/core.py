@@ -26,6 +26,7 @@ keep the host from blocking and the scheduler's grant state coherent.
 
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Optional
 
 from vllm_distributed_tpu.config import EngineConfig
@@ -33,12 +34,21 @@ from vllm_distributed_tpu.core.sched.scheduler import (EngineCoreOutput,
                                                        Scheduler)
 from vllm_distributed_tpu.executor import Executor
 from vllm_distributed_tpu.logger import init_logger
-from vllm_distributed_tpu.metrics.stats import HOST_GAP_BUCKETS, Histogram
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.metrics.stats import (HOST_GAP_BUCKETS,
+                                                STEP_PHASE_BUCKETS,
+                                                Histogram)
 from vllm_distributed_tpu.request import (EngineCoreRequest, Request,
                                           RequestStatus)
 from vllm_distributed_tpu.utils import fault_injection
 
 logger = init_logger(__name__)
+
+# Step phases the engine core times directly. prepare_inputs is timed
+# inside the model runner (it happens under dispatch) and merged into
+# the same family by get_stats. The sync (no batch queue) path folds
+# dispatch+wait into "wait" — the device wait dominates it.
+STEP_PHASES = ("schedule", "dispatch", "wait", "update")
 
 
 class EngineCore:
@@ -85,6 +95,24 @@ class EngineCore:
         self.steps_overlapped = 0
         self.step_host_gap = Histogram(HOST_GAP_BUCKETS)
         self._last_wait_done: Optional[float] = None
+        # Step-phase profiler: where each engine iteration's wall time
+        # goes (schedule / dispatch / device wait / update); rendered as
+        # vdt:step_phase_seconds{phase=...} next to the host-gap
+        # histogram. Always on — a perf_counter pair and one bisect per
+        # phase per step.
+        self.step_phases = {p: Histogram(STEP_PHASE_BUCKETS)
+                            for p in STEP_PHASES}
+        # Engine-level lifecycle events (batch dispatch/retire); the
+        # scheduler keeps its own recorder for request transitions.
+        self.events = ev.EventRecorder()
+        # Opt-in TPU timeline annotation: wraps every dispatch in a
+        # jax.profiler.StepTraceAnnotation so a trace captured via the
+        # profile RPC shows per-step boundaries (trace dump dir:
+        # VDT_PROFILER_DIR). Cached — the envs registry re-reads
+        # os.environ per access.
+        from vllm_distributed_tpu import envs
+        self._profile_steps = envs.VDT_PROFILE_STEPS
+        self._step_seq = 0
         # Structured output: the grammar layer needs a token-bytes table
         # (a tokenizer load + per-token decode sweep). Prefetch it off
         # the busy loop so the FIRST structured request doesn't stall
@@ -180,6 +208,24 @@ class EngineCore:
         self.scheduler.finish_requests(request_ids,
                                        RequestStatus.FINISHED_ABORTED)
 
+    def _observe_phase(self, phase: str, start: float) -> float:
+        """Record one step-phase duration; returns the new timestamp so
+        call sites chain them without extra clock reads."""
+        now = time.perf_counter()
+        self.step_phases[phase].observe(now - start)
+        return now
+
+    def _step_annotation(self):
+        """jax.profiler.StepTraceAnnotation around a dispatch when
+        VDT_PROFILE_STEPS is set (TPU timeline capture); no-op
+        otherwise."""
+        if not self._profile_steps:
+            return nullcontext()
+        import jax
+        self._step_seq += 1
+        return jax.profiler.StepTraceAnnotation("vdt_step",
+                                                step_num=self._step_seq)
+
     def step(self) -> list[EngineCoreOutput]:
         """One scheduling iteration (reference: core.py:223)."""
         if self.batch_queue is not None:
@@ -188,12 +234,18 @@ class EngineCore:
         if not (self.scheduler.has_requests()
                 or self.scheduler.has_kv_transfer_work()):
             return []
+        t = time.perf_counter()
         scheduler_output = self.scheduler.schedule()
+        t = self._observe_phase("schedule", t)
         self.last_step_scheduled = \
             scheduler_output.total_num_scheduled_tokens > 0
-        runner_output = self.executor.execute_model(scheduler_output)
-        return self.scheduler.update_from_output(scheduler_output,
-                                                 runner_output)
+        with self._step_annotation():
+            runner_output = self.executor.execute_model(scheduler_output)
+        t = self._observe_phase("wait", t)
+        outputs = self.scheduler.update_from_output(scheduler_output,
+                                                    runner_output)
+        self._observe_phase("update", t)
+        return outputs
 
     def step_with_batch_queue(self) -> list[EngineCoreOutput]:
         """One iteration of the batch queue (PP microbatches or the
@@ -204,7 +256,9 @@ class EngineCore:
         self.last_step_scheduled = False
         if (len(self.batch_queue) < self.batch_queue_size
                 and self.scheduler.has_schedulable_requests()):
+            t = time.perf_counter()
             scheduler_output = self.scheduler.schedule()
+            t = self._observe_phase("schedule", t)
             if scheduler_output.total_num_scheduled_tokens > 0:
                 self.scheduler.mark_in_flight(
                     scheduler_output.num_scheduled_tokens)
@@ -215,8 +269,18 @@ class EngineCore:
                 self.steps_dispatched += 1
                 if self.batch_queue:
                     self.steps_overlapped += 1
-                handle = self.executor.execute_model_async(
-                    scheduler_output)
+                with self._step_annotation():
+                    handle = self.executor.execute_model_async(
+                        scheduler_output)
+                self._observe_phase("dispatch", now)
+                if self.events.enabled:
+                    self.events.record("", ev.BATCH_DISPATCH, {
+                        "reqs": len(
+                            scheduler_output.num_scheduled_tokens),
+                        "tokens":
+                            scheduler_output.total_num_scheduled_tokens,
+                        "depth": len(self.batch_queue) + 1,
+                    })
                 self.batch_queue.appendleft((scheduler_output, handle))
                 self.last_step_scheduled = True
                 self.max_concurrent_batches = max(
@@ -258,12 +322,20 @@ class EngineCore:
                 fault_injection.maybe_delay("step.reconcile_stall")
             else:
                 fault_injection.fire_or_raise("step.reconcile_stall")
+        t = time.perf_counter()
         runner_output = self.executor.wait_model(handle)
-        self._last_wait_done = time.perf_counter()
+        self._last_wait_done = t = self._observe_phase("wait", t)
+        if self.events.enabled:
+            self.events.record("", ev.BATCH_RETIRE, {
+                "reqs": len(scheduler_output.num_scheduled_tokens),
+                "depth": len(self.batch_queue),
+            })
         self.scheduler.unmark_in_flight(
             scheduler_output.num_scheduled_tokens)
-        return self.scheduler.update_from_output(scheduler_output,
-                                                 runner_output)
+        outputs = self.scheduler.update_from_output(scheduler_output,
+                                                    runner_output)
+        self._observe_phase("update", t)
+        return outputs
 
     def has_unfinished_requests(self) -> bool:
         # A non-empty batch queue counts as work even when every live
@@ -283,7 +355,7 @@ class EngineCore:
         requests (a producer's deferred frees)."""
         return self.scheduler.has_kv_transfer_work()
 
-    def get_stats(self) -> dict:
+    def get_stats(self, include_events: bool = True) -> dict:
         stats = self.scheduler.get_stats()
         stats.update(self.executor.get_stats())
         stats["inflight_batches"] = (len(self.batch_queue)
@@ -293,14 +365,42 @@ class EngineCore:
         stats["steps_overlapped"] = self.steps_overlapped
         stats["decode_overlap_frac"] = (
             self.steps_overlapped / max(self.steps_dispatched, 1))
-        g = self.step_host_gap
-        stats["step_host_gap_seconds"] = {
-            "buckets": list(g.buckets),
-            "counts": list(g.counts),
-            "sum": g.total,
-            "count": g.count,
-        }
+        stats["step_host_gap_seconds"] = self.step_host_gap.to_dict()
+        # Step-phase profiler family. The runner times prepare_inputs
+        # itself (it happens under dispatch); fold it into the family so
+        # /metrics renders one labeled histogram.
+        phases = {name: h.to_dict()
+                  for name, h in self.step_phases.items()}
+        prep = stats.pop("prepare_inputs_seconds", None)
+        if isinstance(prep, dict):
+            phases["prepare_inputs"] = prep
+        stats["step_phase_seconds"] = phases
+        # Lifecycle timeline: drained per stats poll, shipped over the
+        # stats RPC (DP-merged by the front-end client). The drain is
+        # DESTRUCTIVE — callers that may abandon the response mid-RPC
+        # (the admission gate's hard-timeout poll) pass
+        # include_events=False so a cancelled poll can't discard a
+        # batch of events.
+        if include_events:
+            stats["timeline_events"] = ev.merge_event_lists(
+                self.scheduler.events.drain(), self.events.drain())
+        stats["timeline_events_dropped"] = (
+            self.scheduler.events.num_dropped + self.events.num_dropped)
         return stats
+
+    def get_debug_state(self) -> dict:
+        """Live engine-core introspection (the /debug endpoints and the
+        SIGUSR1 dump): scheduler state plus the batch pipeline's
+        occupancy. Read-only."""
+        return {
+            "scheduler": self.scheduler.get_debug_state(),
+            "batch_queue_depth": (len(self.batch_queue)
+                                  if self.batch_queue is not None else 0),
+            "batch_queue_size": self.batch_queue_size,
+            "async_scheduling": self.async_scheduling,
+            "steps_dispatched": self.steps_dispatched,
+            "max_concurrent_batches": self.max_concurrent_batches,
+        }
 
     def save_sharded_state(self, path: str) -> None:
         """Persist the (sharded, post-quantization) weights for fast

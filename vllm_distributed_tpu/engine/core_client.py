@@ -71,6 +71,15 @@ class RestartSupervisor:
         cutoff = time.monotonic() - self.window_s
         self._attempts = [t for t in self._attempts if t > cutoff]
 
+    def peek(self) -> tuple[int, bool]:
+        """Read-only ``(attempts_in_window, exhausted)`` for debug
+        surfaces: computed from a C-level (GIL-atomic) copy so a poll
+        from another thread never rebuilds ``_attempts`` under a
+        concurrent ``next_delay()``."""
+        cutoff = time.monotonic() - self.window_s
+        in_window = sum(1 for t in list(self._attempts) if t > cutoff)
+        return in_window, in_window >= self.max_attempts
+
     def next_delay(self) -> Optional[float]:
         """Grant one restart attempt: the backoff to sleep before it,
         or None when the budget inside the window is exhausted (the

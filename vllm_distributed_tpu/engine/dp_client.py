@@ -480,6 +480,13 @@ class DPEngineClient(EngineCoreClient):
         values = [c.call_utility(method, *args)
                   for i, c in enumerate(self.clients)
                   if i not in self._down]
+        if method == "get_debug_state":
+            # Introspection dicts must NOT be stats-aggregated: summing
+            # per-replica config/bool fields (async_scheduling,
+            # batch_queue_size, ...) fabricates values. Hand back the
+            # raw per-replica states under the key _core_debug_states
+            # already consumes.
+            return {"dp_replicas": values}
         if values and all(isinstance(v, dict) for v in values):
             return self._aggregate_stats(values)
         return values
@@ -515,23 +522,31 @@ class DPEngineClient(EngineCoreClient):
         for k in ratio_gauges:
             if k in agg and per:
                 agg[k] = agg[k] / len(per)
-        # Histogram-shaped entries (step_host_gap_seconds) merge
-        # element-wise so DP /metrics renders the fleet histogram
-        # instead of silently dropping it.
-        hists = [s["step_host_gap_seconds"] for s in per
-                 if isinstance(s.get("step_host_gap_seconds"), dict)]
-        if hists:
-            merged = {"buckets": list(hists[0]["buckets"]),
-                      "counts": [0] * len(hists[0]["counts"]),
-                      "sum": 0.0, "count": 0}
-            for h in hists:
-                if list(h["buckets"]) != merged["buckets"]:
-                    continue  # mixed versions mid-upgrade: skip
-                merged["counts"] = [a + b for a, b in
-                                    zip(merged["counts"], h["counts"])]
-                merged["sum"] += h["sum"]
-                merged["count"] += h["count"]
-            agg["step_host_gap_seconds"] = merged
+        # Histogram-shaped entries merge element-wise so DP /metrics
+        # renders the fleet histogram instead of silently dropping it.
+        from vllm_distributed_tpu.metrics.stats import \
+            merge_histogram_dicts
+        merged_gap = merge_histogram_dicts(
+            [s.get("step_host_gap_seconds") for s in per])
+        if merged_gap is not None:
+            agg["step_host_gap_seconds"] = merged_gap
+        # Step-phase family: {phase -> histogram dict}, merged per phase.
+        phase_maps = [s["step_phase_seconds"] for s in per
+                      if isinstance(s.get("step_phase_seconds"), dict)]
+        if phase_maps:
+            merged_phases = {}
+            for phase in sorted({p for m in phase_maps for p in m}):
+                h = merge_histogram_dicts(
+                    [m.get(phase) for m in phase_maps])
+                if h is not None:
+                    merged_phases[phase] = h
+            agg["step_phase_seconds"] = merged_phases
+        # Lifecycle timelines: one fleet-wide event stream, time-sorted.
+        from vllm_distributed_tpu.metrics.events import merge_event_lists
+        events = merge_event_lists(
+            *(s.get("timeline_events") or [] for s in per))
+        if events:
+            agg["timeline_events"] = events
         return agg
 
     def get_stats(self) -> dict:

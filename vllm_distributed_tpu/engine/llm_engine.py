@@ -90,7 +90,13 @@ class LLMEngine:
                 or self.output_processor.has_unfinished_requests())
 
     def get_stats(self) -> dict:
-        return self.engine_core.get_stats()
+        stats = self.engine_core.get_stats()
+        # Same retention as AsyncLLM.get_stats: the core rings drain
+        # destructively per poll; keep the events reachable front-side.
+        events = stats.pop("timeline_events", None)
+        if events:
+            self.output_processor.core_events.absorb(events)
+        return stats
 
     def sleep(self, level: int = 1) -> int:
         """Release device memory while idle (RLHF colocation; see

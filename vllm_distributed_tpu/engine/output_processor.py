@@ -11,12 +11,17 @@ from typing import Optional
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
 from vllm_distributed_tpu.engine.detokenizer import IncrementalDetokenizer
+from vllm_distributed_tpu.metrics import events as ev
 from vllm_distributed_tpu.metrics.stats import RequestTimes
 from vllm_distributed_tpu.outputs import (CompletionOutput,
                                           PoolingOutput,
                                           RequestOutput)
 from vllm_distributed_tpu.request import EngineCoreRequest
 from vllm_distributed_tpu.sampling_params import SamplingParams
+
+# Completed-phase duration samples kept per phase for percentile
+# reporting (bench); oldest dropped beyond this.
+_MAX_PHASE_SAMPLES = 8192
 
 
 @dataclass
@@ -37,6 +42,11 @@ class RequestState:
     # the core after the prompt completes.
     prompt_logprobs: Optional[list] = None
     times: Optional["RequestTimes"] = None
+    # Merged lifecycle timeline: (monotonic_ts, event, detail) — the
+    # front-end's own events (arrived/first_token/replay/finished) plus
+    # the core-side events riding each EngineCoreOutput. Stitched into
+    # phase child spans when the request finishes.
+    timeline: list[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -63,6 +73,19 @@ class OutputProcessor:
         from vllm_distributed_tpu.tracing import init_tracer
         self.tracer = init_tracer(
             config.observability_config.otlp_traces_endpoint)
+        # Front-end lifecycle ledger (arrivals, sheds, deaths, replays)
+        # for the /debug endpoints; per-request timelines live on the
+        # RequestState. Cached enable flag — envs re-reads os.environ.
+        self.events = ev.EventRecorder()
+        self.timeline_enabled = self.events.enabled
+        # Core-side events (scheduler/engine rings) absorbed from the
+        # stats RPC by the engine's get_stats — retained here so the
+        # /debug recent-events view spans every component. Always
+        # enabled: absorption only happens when recording was on.
+        self.core_events = ev.EventRecorder(enabled=True)
+        # Completed per-phase durations (seconds) for percentile
+        # reporting; bounded FIFO per phase.
+        self.phase_durations: dict[str, list[float]] = {}
 
     def add_request(self, request: EngineCoreRequest,
                     prompt: Optional[str] = None) -> None:
@@ -72,18 +95,67 @@ class OutputProcessor:
             detok = IncrementalDetokenizer(self.tokenizer, params,
                                            request.prompt_token_ids)
         import time as _time
-        self.request_states[request.request_id] = RequestState(
+        arrival = _time.monotonic()
+        state = RequestState(
             request_id=request.request_id,
             prompt=prompt,
             prompt_token_ids=request.prompt_token_ids,
             params=params,
             detokenizer=detok,
-            times=RequestTimes(arrival=_time.monotonic()),
+            times=RequestTimes(arrival=arrival),
         )
+        if self.timeline_enabled:
+            state.timeline.append((arrival, ev.ARRIVED, None))
+            self.events.record(request.request_id, ev.ARRIVED,
+                               {"prompt_tokens":
+                                len(request.prompt_token_ids)},
+                               ts=arrival)
+        self.request_states[request.request_id] = state
 
     def abort_requests(self, request_ids: list[str]) -> None:
         for req_id in request_ids:
-            self.request_states.pop(req_id, None)
+            state = self.request_states.pop(req_id, None)
+            if state is not None and self.timeline_enabled:
+                self.events.record(req_id, ev.ABORTED, None)
+
+    def record_event(self, request_id: str, event: str,
+                     detail: Optional[dict] = None) -> None:
+        """External lifecycle events (AsyncLLM's engine-death/replay,
+        the admission gate's sheds) onto the request's timeline and the
+        front-end ledger."""
+        if not self.timeline_enabled:
+            return
+        import time as _time
+        ts = _time.monotonic()
+        state = self.request_states.get(request_id)
+        if state is not None:
+            state.timeline.append((ts, event, detail))
+        self.events.record(request_id, event, detail, ts=ts)
+
+    def _finish_timeline(self, state: RequestState,
+                         event: str = ev.FINISHED
+                         ) -> Optional[list[dict]]:
+        """Close a request's timeline: append the terminal event,
+        compute its phase intervals, and bank per-phase durations for
+        percentile reporting. Returns the phases (None when the
+        timeline is disabled)."""
+        if not self.timeline_enabled:
+            return None
+        import time as _time
+        now = _time.monotonic()
+        state.timeline.append((now, event,
+                               {"reason": state.finish_reason}))
+        # Sort a COPY and swap it in (_emit_span reuses it): the
+        # AsyncLLM pump thread may append ENGINE_DEATH concurrently,
+        # and an in-place sort of a mutating list raises ValueError.
+        state.timeline = sorted(state.timeline, key=lambda e: e[0])
+        phases = ev.phases_from_timeline(state.timeline, now=now)
+        for name, dur in ev.phase_durations(phases).items():
+            bank = self.phase_durations.setdefault(name, [])
+            bank.append(dur)
+            if len(bank) > _MAX_PHASE_SAMPLES:
+                del bank[:len(bank) - _MAX_PHASE_SAMPLES]
+        return phases
 
     def get_num_unfinished_requests(self) -> int:
         return len(self.request_states)
@@ -100,14 +172,18 @@ class OutputProcessor:
             state = self.request_states.get(out.req_id)
             if state is None:
                 continue  # aborted while output was in flight
+            if out.events and self.timeline_enabled:
+                # Core-side lifecycle events riding this output.
+                state.timeline.extend(out.events)
             if out.pooled is not None:
                 # Embedding request: one terminal pooled result.
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
                 state.finished = True
                 state.finish_reason = out.finish_reason
+                phases = self._finish_timeline(state)
                 if self.tracer is not None:
-                    self._emit_span(state)
+                    self._emit_span(state, phases)
                 request_outputs.append(PoolingOutput(
                     request_id=out.req_id, embedding=out.pooled,
                     num_prompt_tokens=len(state.prompt_token_ids)))
@@ -115,7 +191,11 @@ class OutputProcessor:
                 continue
             state.output_token_ids.extend(out.new_token_ids)
             if out.new_token_ids:
+                first = state.times.first_token is None
                 self.stats.on_tokens(state.times, len(out.new_token_ids))
+                if first and self.timeline_enabled:
+                    state.timeline.append(
+                        (state.times.first_token, ev.FIRST_TOKEN, None))
             if out.logprobs:
                 state.logprobs.extend(out.logprobs)
             state.num_cached_tokens = out.num_cached_tokens
@@ -147,8 +227,11 @@ class OutputProcessor:
             if finished:
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
+                phases = self._finish_timeline(
+                    state, ev.ABORTED if finish_reason == "abort"
+                    else ev.FINISHED)
                 if self.tracer is not None:
-                    self._emit_span(state)
+                    self._emit_span(state, phases)
                 if state.detokenizer is not None:
                     # Emit any text held back waiting for more context.
                     state.detokenizer.flush()
@@ -158,12 +241,24 @@ class OutputProcessor:
                 del self.request_states[out.req_id]
         return ProcessedOutputs(request_outputs, reqs_to_abort)
 
-    def _emit_span(self, state: RequestState) -> None:
+    def _emit_span(self, state: RequestState,
+                   phases: Optional[list[dict]] = None) -> None:
+        """One parent span per request; the lifecycle timeline's phase
+        intervals (queue, kv_pull, prefill, decode, stalls) ride as
+        child spans. A replayed continuation keeps its original request
+        id, so the parent span survives an engine restart with the
+        journal/replay events on its timeline."""
         import time as _time
 
         from vllm_distributed_tpu.tracing import SpanAttributes as SA
         now = _time.monotonic()
         t = state.times
+        events = None
+        if state.timeline:
+            # _finish_timeline already sorted the timeline in place.
+            t0 = state.timeline[0][0]
+            events = [[round(ts - t0, 6), event, detail]
+                      for ts, event, detail in state.timeline]
         self.tracer.emit({
             SA.GEN_AI_REQUEST_ID: state.request_id,
             SA.GEN_AI_REQUEST_MAX_TOKENS: state.params.max_tokens,
@@ -176,7 +271,7 @@ class OutputProcessor:
                  if t and t.first_token is not None else None),
             SA.GEN_AI_LATENCY_E2E: (now - t.arrival) if t else None,
             SA.GEN_AI_RESPONSE_FINISH_REASON: state.finish_reason,
-        })
+        }, phases=phases, events=events)
 
     def _make_request_output(self, state: RequestState) -> RequestOutput:
         text = (state.detokenizer.output_text

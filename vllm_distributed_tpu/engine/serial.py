@@ -75,12 +75,13 @@ def decode_request(d: dict) -> EngineCoreRequest:
 def encode_output(out: EngineCoreOutput) -> list:
     return [out.req_id, out.new_token_ids, out.finish_reason,
             out.stop_reason, out.num_cached_tokens, out.logprobs,
-            out.kv_transfer_params, out.pooled, out.prompt_logprobs]
+            out.kv_transfer_params, out.pooled, out.prompt_logprobs,
+            ([list(e) for e in out.events] if out.events else None)]
 
 
 def decode_output(v: list) -> EngineCoreOutput:
     (req_id, new_token_ids, finish_reason, stop_reason, cached, lps,
-     kv_params, pooled, prompt_lps) = v
+     kv_params, pooled, prompt_lps, events) = v
     return EngineCoreOutput(
         req_id=req_id,
         new_token_ids=list(new_token_ids),
@@ -91,4 +92,5 @@ def decode_output(v: list) -> EngineCoreOutput:
         kv_transfer_params=kv_params,
         pooled=pooled,
         prompt_logprobs=prompt_lps,
+        events=([tuple(e) for e in events] if events else None),
     )

@@ -84,8 +84,13 @@ class AdmissionController:
                 # Hard-bounded: a slow stats RPC (e.g. an MP core whose
                 # pump thread hasn't started yet) must never stall the
                 # admission path — keep the stale sample instead.
-                stats = await asyncio.wait_for(self.engine.get_stats(),
-                                               timeout=0.2)
+                # include_events=False: this wait_for may abandon the
+                # RPC mid-flight, and the event-ring drain is
+                # destructive — a cancelled poll must not cost the
+                # /debug recent-events history for the incident window.
+                stats = await asyncio.wait_for(
+                    self.engine.get_stats(include_events=False),
+                    timeout=0.2)
                 self._kv_usage = float(stats.get("kv_cache_usage", 0.0))
             except Exception:  # noqa: BLE001 - engine busy/restarting;
                 # keep the stale sample rather than blocking admission.
@@ -93,9 +98,16 @@ class AdmissionController:
         return self._kv_usage
 
     def _reject(self, message: str, status: int = 429) -> None:
-        stats = getattr(self.engine.output_processor, "stats", None)
+        processor = getattr(self.engine, "output_processor", None)
+        stats = getattr(processor, "stats", None)
         if stats is not None:
             stats.num_requests_shed += 1
+        # Timeline ledger: sheds happen before a request id exists.
+        recorder = getattr(processor, "events", None)
+        if recorder is not None:
+            from vllm_distributed_tpu.metrics import events as ev
+            recorder.record("", ev.SHED,
+                            {"status": status, "reason": message})
         raise AdmissionRejected(message, status, self.retry_after_s)
 
     async def acquire(self) -> None:

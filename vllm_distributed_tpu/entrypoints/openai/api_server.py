@@ -219,6 +219,206 @@ def _profile_dirs(result) -> list[str]:
     return result if isinstance(result, list) else [result]
 
 
+# ---------------------------------------------------------------------------
+# Debug introspection: GET /debug/requests, GET /debug/engine, SIGUSR1.
+# GET routes are outside GENERATION_PATHS, so the admission gate never
+# sheds them — an overloaded or draining server stays observable.
+# ---------------------------------------------------------------------------
+async def _core_debug_states(engine: AsyncLLM) -> list[dict]:
+    """Per-core get_debug_state dicts (one per DP replica). Bounded and
+    failure-tolerant: a dead/busy core degrades the dump to the
+    front-end view instead of 500ing the endpoint."""
+    try:
+        dbg = await asyncio.wait_for(engine.get_debug_state(), timeout=2.0)
+    except Exception:  # noqa: BLE001 - core dead/restarting/slow
+        return []
+    if not isinstance(dbg, dict):
+        return []
+    if "dp_replicas" in dbg:
+        # DP fan-out aggregates dicts; the raw per-replica states
+        # survive under dp_replicas.
+        return [d for d in dbg["dp_replicas"] if isinstance(d, dict)]
+    return [dbg]
+
+
+def _phase_from_status(status: Optional[str], computed: Optional[int],
+                       prompt_tokens: int) -> Optional[str]:
+    """Map a scheduler RequestStatus name to a timeline phase name —
+    the fallback when the front-end's per-request timeline is behind
+    the core (events ride outputs, which stalled requests don't emit)
+    or disabled."""
+    if status == "WAITING_FOR_REMOTE_KVS":
+        return "kv_pull"
+    if status == "PREEMPTED":
+        return "preempted"
+    if status == "WAITING":
+        return "queued"
+    if status == "RUNNING":
+        return ("prefill" if (computed or 0) < prompt_tokens
+                else "decode")
+    return None
+
+
+async def _debug_requests_json(engine: AsyncLLM) -> dict:
+    from vllm_distributed_tpu.metrics import events as ev
+    core_reqs: dict[str, dict] = {}
+    core_states = await _core_debug_states(engine)
+    # AFTER the (up-to-2s) core RPC: events recorded during the await
+    # would otherwise postdate `now` and phases_from_timeline would
+    # silently drop the open phase they start.
+    now = time.monotonic()
+    for i, core in enumerate(core_states):
+        for entry in core.get("scheduler", {}).get("requests", ()):
+            entry = dict(entry, replica=i if len(core_states) > 1
+                         else None)
+            core_reqs[entry["request_id"]] = entry
+    requests = []
+    for rid, state in list(engine.output_processor.request_states.items()):
+        timeline = sorted(state.timeline, key=lambda e: e[0])
+        phases = ev.phases_from_timeline(timeline, now=now)
+        times = state.times
+        entry = {
+            "request_id": rid,
+            "phase": ev.current_phase(timeline),
+            "age_s": round(now - times.arrival, 3) if times else None,
+            "phase_age_s": {p: round(d, 4) for p, d in
+                            ev.phase_durations(phases).items()},
+            "prompt_tokens": len(state.prompt_token_ids),
+            "tokens_emitted": len(state.output_token_ids),
+            "num_events": len(timeline),
+        }
+        core = core_reqs.pop(rid, None)
+        if core is not None:
+            entry.update({
+                "status": core["status"],
+                "tokens_computed": core["num_computed_tokens"],
+                "kv_blocks": core["kv_blocks"],
+                "inflight_refcount": core["inflight_refcount"],
+                "num_preemptions": core["num_preemptions"],
+                "replica": core.get("replica"),
+            })
+            if entry["phase"] in (None, "queued"):
+                # Core-side events only reach the front-end riding an
+                # EngineCoreOutput, which a request stuck mid-prefill
+                # or in a KV-pull hold never emits — exactly the
+                # requests this endpoint must diagnose. When the
+                # timeline lags (or is disabled), derive the phase from
+                # the authoritative scheduler status instead.
+                entry["phase"] = _phase_from_status(
+                    core["status"], core["num_computed_tokens"],
+                    entry["prompt_tokens"]) or entry["phase"]
+        requests.append(entry)
+    # Core-only requests (e.g. a replay the front-end already dropped).
+    for rid, core in core_reqs.items():
+        requests.append(dict(core, phase=None, core_only=True))
+    return {"now_monotonic": now, "num_requests": len(requests),
+            "requests": requests}
+
+
+async def _debug_engine_json(app: web.Application) -> dict:
+    from vllm_distributed_tpu.metrics import events as ev
+    engine = app[ENGINE_KEY]
+    core_states = await _core_debug_states(engine)
+    schedulers = []
+    for core in core_states:
+        sched = dict(core.get("scheduler", {}))
+        sched.pop("requests", None)  # per-request detail lives in
+        # /debug/requests; keep this endpoint a queue/pipeline summary.
+        schedulers.append({
+            "scheduler": sched,
+            "batch_queue_depth": core.get("batch_queue_depth"),
+            "batch_queue_size": core.get("batch_queue_size"),
+            "async_scheduling": core.get("async_scheduling"),
+            "steps_dispatched": core.get("steps_dispatched"),
+            "max_concurrent_batches":
+                core.get("max_concurrent_batches"),
+        })
+    try:
+        # include_events=False: the drain is destructive and this
+        # wait_for may abandon the RPC — a timed-out debug poll (the
+        # wedged-engine case) must not discard the incident window's
+        # events. The /metrics scrape is the draining consumer.
+        stats = await asyncio.wait_for(
+            engine.get_stats(include_events=False), timeout=2.0)
+    except Exception:  # noqa: BLE001 - engine busy/dead
+        stats = {}
+    ctrl = app.get(ADMISSION_KEY)
+    admission = None
+    if ctrl is not None:
+        admission = {
+            "enabled": ctrl.enabled,
+            "depth": ctrl.depth,
+            "max_depth_seen": ctrl.max_depth_seen,
+            "high_watermark": ctrl.high_watermark,
+            "low_watermark": ctrl.low_watermark,
+            "kv_high": ctrl.kv_high,
+            "shedding": ctrl._shedding,
+            "draining": ctrl.draining,
+        }
+    return {
+        "supervisor": engine.supervisor_state(),
+        "engine_cores": schedulers,
+        "kv_cache_usage": stats.get("kv_cache_usage"),
+        "num_running_reqs": stats.get("num_running_reqs"),
+        "num_waiting_reqs": stats.get("num_waiting_reqs"),
+        "inflight_batches": stats.get("inflight_batches"),
+        "admission": admission,
+        # Front-end ledger merged with the core-side events absorbed
+        # from /metrics scrapes (the draining stats consumer).
+        "recent_events": ev.merge_event_lists(
+            engine.output_processor.events.snapshot(100),
+            engine.output_processor.core_events.snapshot(100)),
+    }
+
+
+async def debug_requests(request: web.Request) -> web.Response:
+    """Live per-request state: current phase, per-phase ages from the
+    lifecycle timeline, progress counters, KV footprint."""
+    return web.json_response(
+        await _debug_requests_json(request.app[ENGINE_KEY]))
+
+
+async def debug_engine(request: web.Request) -> web.Response:
+    """Live engine state: scheduler queues, batch pipeline, KV usage,
+    restart-supervisor state, admission watermarks."""
+    return web.json_response(await _debug_engine_json(request.app))
+
+
+def _thread_stacks() -> str:
+    import sys
+    import threading
+    import traceback
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for ident, frame in frames.items():
+        chunks.append(f"--- thread {names.get(ident, '?')} ({ident}) ---\n"
+                      + "".join(traceback.format_stack(frame)))
+    return "\n".join(chunks)
+
+
+async def _dump_debug_to_log(app: web.Application) -> None:
+    """SIGUSR1 forensics: the same JSON the /debug endpoints serve, plus
+    every thread's stack, to the log — for the hung-server case where
+    HTTP may no longer answer (a hung engine core or blocked handler;
+    the loop itself stays alive since engine work runs off-loop).
+    Never raises, never blocks serving. The signal callback logs the
+    thread stacks synchronously BEFORE scheduling this coroutine, so
+    the await-free part of the dump lands even when the engine calls
+    in here stall."""
+    try:
+        engine_state = await _debug_engine_json(app)
+        request_state = await _debug_requests_json(app[ENGINE_KEY])
+        logger.warning(
+            "SIGUSR1 debug dump:\n/debug/engine: %s\n/debug/requests: "
+            "%s\nthread stacks:\n%s",
+            json.dumps(engine_state, default=str),
+            json.dumps(request_state, default=str),
+            _thread_stacks())
+    except Exception:  # noqa: BLE001 - forensics must not kill serving
+        logger.exception("SIGUSR1 debug dump failed")
+
+
 async def embeddings(request: web.Request) -> web.Response:
     """OpenAI /v1/embeddings over the pooling path (reference:
     serving_embedding.py)."""
@@ -1162,6 +1362,8 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/engine", debug_engine)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)
@@ -1219,11 +1421,24 @@ async def serve(engine: AsyncLLM, model_name: str, host: str,
             drain_task = asyncio.ensure_future(
                 drain_and_stop(app[ADMISSION_KEY], stop_event))
 
+    def _on_sigusr1() -> None:
+        # Hung-server forensics. Thread stacks first and SYNCHRONOUSLY
+        # — they need no awaits, so they land even when the engine is
+        # wedged and the async state dump below would stall on it.
+        try:
+            logger.warning("SIGUSR1 thread stacks:\n%s",
+                           _thread_stacks())
+        except Exception:  # noqa: BLE001 - forensics must not kill
+            logger.exception("SIGUSR1 stack dump failed")
+        asyncio.ensure_future(_dump_debug_to_log(app))
+
     try:
         loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+        loop.add_signal_handler(signal.SIGUSR1, _on_sigusr1)
     except (NotImplementedError, ValueError, RuntimeError):
         # Non-main-thread loops (tests) and platforms without signal
-        # support: drain stays reachable via drain_and_stop directly.
+        # support: drain stays reachable via drain_and_stop directly,
+        # the debug dump via _dump_debug_to_log.
         pass
     if ready_event is not None:
         ready_event.set()
@@ -1234,6 +1449,7 @@ async def serve(engine: AsyncLLM, model_name: str, host: str,
             drain_task.cancel()
         try:
             loop.remove_signal_handler(signal.SIGTERM)
+            loop.remove_signal_handler(signal.SIGUSR1)
         except (NotImplementedError, ValueError, RuntimeError):
             pass
         await runner.cleanup()

@@ -97,6 +97,20 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (reference: VLLM_TORCH_PROFILER_DIR).
     "VDT_PROFILER_DIR":
     lambda: os.getenv("VDT_PROFILER_DIR", "/tmp/vdt_profile"),
+    # Request-lifecycle event timeline (metrics/events.py): per-request
+    # phase attribution (queue/kv_pull/prefill/decode/stalls) recorded
+    # at lifecycle transitions and stitched into child phase spans by
+    # the tracer. "0" disables all recording (bench runs both legs to
+    # bound the overhead). Read ONCE per component at construction.
+    "VDT_REQUEST_TIMELINE":
+    lambda: os.getenv("VDT_REQUEST_TIMELINE", "1") == "1",
+    # Step-phase TPU timeline capture: "1" wraps every engine-core
+    # dispatch in jax.profiler.StepTraceAnnotation so a trace captured
+    # via the profile RPC (dump dir: VDT_PROFILER_DIR) shows per-step
+    # boundaries on the device timeline. Opt-in: the annotation costs a
+    # TraceMe on the hot path.
+    "VDT_PROFILE_STEPS":
+    lambda: os.getenv("VDT_PROFILE_STEPS", "0") == "1",
     # Persistent XLA compilation cache directory ("" disables). On the
     # tunnelled TPU, first compiles are the dominant bench cost and the
     # tunnel can drop mid-run; caching makes retried runs resume almost

@@ -28,6 +28,17 @@ _GAUGES = {
     "decode_overlap_frac": ("vdt:decode_overlap_frac",
                             "Fraction of dispatches issued while "
                             "another batch was already executing"),
+    # Step batch composition (most recent non-empty step). Under DP
+    # these sum across replicas — the fleet's current step mix, same
+    # as PromQL sum() over per-instance gauges.
+    "last_step_prefill_tokens": ("vdt:step_prefill_tokens",
+                                 "Prefill tokens granted in the most "
+                                 "recent non-empty scheduler step "
+                                 "(summed across DP replicas)"),
+    "last_step_decode_tokens": ("vdt:step_decode_tokens",
+                                "Decode tokens granted in the most "
+                                "recent non-empty scheduler step "
+                                "(summed across DP replicas)"),
 }
 
 _COUNTERS = {
@@ -71,6 +82,10 @@ _COUNTERS = {
     "replica_resurrections": ("vdt:replica_resurrections_total",
                               "Downed DP replicas successfully "
                               "restarted and returned to rotation"),
+    # Request-lifecycle timeline (metrics/events.py).
+    "timeline_events_dropped": ("vdt:timeline_events_dropped_total",
+                                "Lifecycle events dropped by full ring "
+                                "buffers (oldest-first overflow)"),
 }
 
 
@@ -93,6 +108,26 @@ def _render_histogram(name: str, help_text: str, h: dict) -> list[str]:
                                   h.get("count", 0))
 
 
+def _render_step_phases(phases: dict) -> list[str]:
+    """One labeled histogram family for the engine step-phase profiler:
+    vdt:step_phase_seconds{phase="schedule"|"prepare_inputs"|"dispatch"
+    |"wait"|"update"}. HELP/TYPE once, then the per-phase series —
+    bucket/+Inf shape comes from the shared exposition helper."""
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    name = "vdt:step_phase_seconds"
+    lines = [f"# HELP {name} Wall seconds per engine-core step phase",
+             f"# TYPE {name} histogram"]
+    for phase in sorted(phases):
+        h = phases[phase]
+        if not isinstance(h, dict):
+            continue
+        lines += render_histogram_lines(
+            name, "", h.get("buckets", ()), h.get("counts", ()),
+            h.get("sum", 0.0), h.get("count", 0),
+            label=f'phase="{phase}"', header=False)
+    return lines
+
+
 def render_metrics(stats: dict) -> str:
     lines: list[str] = []
     for key, (name, help_text) in _GAUGES.items():
@@ -109,4 +144,7 @@ def render_metrics(stats: dict) -> str:
         value = stats.get(key)
         if isinstance(value, dict):
             lines += _render_histogram(name, help_text, value)
+    step_phases = stats.get("step_phase_seconds")
+    if isinstance(step_phases, dict) and step_phases:
+        lines += _render_step_phases(step_phases)
     return "\n".join(lines) + "\n"

@@ -9,6 +9,7 @@ the global registry complicates multi-engine tests.
 """
 
 import time
+from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -29,25 +30,37 @@ E2E_BUCKETS = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 30.0,
 # schedules synchronously between steps.
 HOST_GAP_BUCKETS = (0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.02,
                     0.05, 0.1, 0.25, 1.0)
+# Engine-core step phases (schedule / prepare_inputs / dispatch /
+# device wait / update_from_output): microseconds for the host control
+# plane up to seconds for a first-compile device wait.
+STEP_PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                      0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 10.0)
 
 
 def render_histogram_lines(name: str, help_text: str, buckets, counts,
-                           total: float, count: int) -> list[str]:
+                           total: float, count: int, label: str = "",
+                           header: bool = True) -> list[str]:
     """Prometheus exposition lines for one histogram family: cumulative
     ``_bucket`` series (``counts`` carries one trailing +Inf slot),
     ``_sum`` and ``_count``. Single source of truth for the shape —
     shared by live Histogram objects and the serialized-dict stats
-    entries engines ship over the stats RPC."""
-    lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
+    entries engines ship over the stats RPC. ``label`` (e.g.
+    ``phase="dispatch"``) renders one labeled series of a family;
+    pass ``header=False`` for every series after the first."""
+    lines = ([f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
+             if header else [])
+    lbl = f"{label}," if label else ""
+    suffix = f"{{{label}}}" if label else ""
     cumulative = 0
     for b, c in zip(buckets, counts):
         cumulative += int(c)
-        lines.append(f'{name}_bucket{{le="{b}"}} {cumulative}')
+        lines.append(f'{name}_bucket{{{lbl}le="{b}"}} {cumulative}')
     if counts:
         cumulative += int(counts[-1])
-    lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-    lines.append(f"{name}_sum {total}")
-    lines.append(f"{name}_count {count}")
+    lines.append(f'{name}_bucket{{{lbl}le="+Inf"}} {cumulative}')
+    lines.append(f"{name}_sum{suffix} {total}")
+    lines.append(f"{name}_count{suffix} {count}")
     return lines
 
 
@@ -63,15 +76,40 @@ class Histogram:
     def observe(self, value: float) -> None:
         self.count += 1
         self.total += value
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        # bisect_left finds the first bucket with value <= bound (runs
+        # per token on the ITL path; a linear scan of ~20 bounds costs
+        # more than the observation it records).
+        self.counts[bisect_left(self.buckets, value)] += 1
 
     def render(self, name: str, help_text: str) -> list[str]:
         return render_histogram_lines(name, help_text, self.buckets,
                                       self.counts, self.total, self.count)
+
+    def to_dict(self) -> dict:
+        """Serialized stats-RPC form; render_histogram_lines over this
+        dict is byte-identical to render() on the live object."""
+        return {"buckets": list(self.buckets), "counts": list(self.counts),
+                "sum": self.total, "count": self.count}
+
+
+def merge_histogram_dicts(hists: list[dict]) -> Optional[dict]:
+    """Element-wise merge of serialized histogram dicts (DP stats
+    aggregation). Mismatched bucket layouts (mixed versions mid-upgrade)
+    are skipped rather than mis-summed."""
+    hists = [h for h in hists if isinstance(h, dict) and h.get("buckets")]
+    if not hists:
+        return None
+    merged = {"buckets": list(hists[0]["buckets"]),
+              "counts": [0] * len(hists[0]["counts"]),
+              "sum": 0.0, "count": 0}
+    for h in hists:
+        if list(h["buckets"]) != merged["buckets"]:
+            continue
+        merged["counts"] = [a + b for a, b in zip(merged["counts"],
+                                                  h["counts"])]
+        merged["sum"] += h["sum"]
+        merged["count"] += h["count"]
+    return merged
 
 
 @dataclass

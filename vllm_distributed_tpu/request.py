@@ -140,6 +140,16 @@ class Request:
         self.status = RequestStatus.WAITING
         self.stop_reason: Optional[int | str] = None
 
+        # Lifecycle timeline (metrics/events.py): (monotonic_ts, event,
+        # detail) tuples recorded by the scheduler at every transition,
+        # drained onto the next EngineCoreOutput for this request so
+        # the front-end can stitch phase spans. Appended only at
+        # lifecycle TRANSITIONS, never per token — which is why the
+        # async run-ahead grant is recorded once (first grant), not per
+        # speculative step.
+        self.events: list[tuple] = []
+        self.async_spec_granted = False
+
         # All token ids: prompt + generated. The scheduler appends sampled
         # tokens in update_from_output.
         self._all_token_ids: list[int] = list(prompt_token_ids)

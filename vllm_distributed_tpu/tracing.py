@@ -5,6 +5,15 @@ and the engine emits one span per finished request with SpanAttributes
 (:98) covering queue/prefill/e2e latencies and token counts, enabled by
 ObservabilityConfig.otlp_traces_endpoint.
 
+Beyond the reference's single flat span, ``emit`` takes the request's
+phase intervals (computed by ``metrics/events.phases_from_timeline``
+from the lifecycle timeline) and renders them as CHILD spans — queue,
+kv_pull, prefill, decode, stalls — under one parent span per request,
+so "where did this request's 4 seconds go" is answerable per request.
+A replayed continuation (crash recovery) keeps the original request id,
+so its trace survives the engine restart as one parent span whose
+timeline carries the journal/replay events.
+
 This environment ships only the opentelemetry API shim (no SDK), so the
 tracer degrades gracefully: an ``http(s)://``/``grpc://`` endpoint uses
 the OTel SDK when importable, and a ``file://`` (or bare path) endpoint
@@ -12,6 +21,7 @@ appends one JSON line per span — same attribute names, no dependency.
 """
 
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -36,9 +46,12 @@ class SpanAttributes:
 
 
 class RequestTracer:
-    """Emits one span per finished request."""
+    """Emits one parent span (with optional phase child spans) per
+    finished request."""
 
-    def emit(self, attributes: dict) -> None:
+    def emit(self, attributes: dict,
+             phases: Optional[list[dict]] = None,
+             events: Optional[list] = None) -> None:
         raise NotImplementedError
 
     def shutdown(self) -> None:
@@ -47,18 +60,90 @@ class RequestTracer:
 
 class JsonlTracer(RequestTracer):
     """Dependency-free exporter: one JSON object per span, appended to a
-    file (endpoint "file:///path" or a bare path)."""
+    file (endpoint "file:///path" or a bare path). Keeps a persistent
+    file handle (reopening per span is wasteful under load) but follows
+    log rotation: each emit compares the path's (dev, inode) against
+    the open handle (one stat, logging.WatchedFileHandler's trick —
+    writes to a renamed/unlinked file still SUCCEED, so failure-driven
+    reopening alone would strand spans on the rotated inode). Never
+    raises out of ``emit`` — a full disk or bad path degrades tracing
+    instead of killing the output processor."""
 
     def __init__(self, path: str) -> None:
         self.path = path
         self._lock = threading.Lock()
+        self._file = None
+        self._broken = False
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
         logger.info("request tracing -> %s (jsonl)", path)
 
-    def emit(self, attributes: dict) -> None:
+    def emit(self, attributes: dict,
+             phases: Optional[list[dict]] = None,
+             events: Optional[list] = None) -> None:
         record = {"name": "llm_request", "ts": time.time(),  # wallclock-ok
                   "attributes": attributes}
-        with self._lock, open(self.path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        if phases:
+            # Child phase spans, start/duration relative to the parent
+            # span's start (the earliest phase start).
+            t0 = min(p["start"] for p in phases)
+            record["phases"] = [{
+                "phase": p["phase"],
+                "start_s": round(p["start"] - t0, 6),
+                "duration_s": round(p["end"] - p["start"], 6),
+            } for p in phases]
+        if events:
+            record["events"] = events
+        try:
+            with self._lock:
+                self._ensure_file_locked()
+                self._file.write(json.dumps(record) + "\n")
+                self._file.flush()
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            # Drop the handle so the next emit reopens the path — a
+            # transiently bad handle (ENOSPC recovery, closed fd) must
+            # not divert spans forever.
+            with self._lock:
+                if self._file is not None:
+                    try:
+                        self._file.close()
+                    except Exception:  # noqa: BLE001 - already broken
+                        pass
+                    self._file = None
+            if not self._broken:
+                self._broken = True
+                logger.warning("trace emit to %s failed (%s); further "
+                               "failures logged at debug", self.path, e)
+            else:
+                logger.debug("trace emit failed: %s", e)
+
+    def _ensure_file_locked(self) -> None:
+        """Open (or re-open after rotation) the span file. Caller holds
+        the lock. Rotation check: the handle's inode no longer matches
+        the path's (renamed) or the path is gone (unlinked)."""
+        if self._file is not None:
+            try:
+                st = os.stat(self.path)
+                fst = os.fstat(self._file.fileno())
+                if (st.st_dev, st.st_ino) == (fst.st_dev, fst.st_ino):
+                    return
+            except OSError:
+                pass  # path missing/unstattable: reopen below
+            try:
+                self._file.close()
+            except Exception:  # noqa: BLE001 - stale handle
+                pass
+            self._file = None
+        self._file = open(self.path, "a")
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+                self._file = None
 
 
 class OtelTracer(RequestTracer):
@@ -76,10 +161,24 @@ class OtelTracer(RequestTracer):
                                         tracer_provider=provider)
         logger.info("request tracing -> %s (otlp)", endpoint)
 
-    def emit(self, attributes: dict) -> None:
-        with self._tracer.start_as_current_span("llm_request") as span:
-            for key, value in attributes.items():
-                span.set_attribute(key, value)
+    def emit(self, attributes: dict,
+             phases: Optional[list[dict]] = None,
+             events: Optional[list] = None) -> None:
+        try:
+            with self._tracer.start_as_current_span("llm_request") as span:
+                for key, value in attributes.items():
+                    span.set_attribute(key, value)
+                for p in (phases or ()):
+                    # Child span per phase under the active parent; the
+                    # monotonic interval is carried as attributes (OTLP
+                    # span times are wall-clock epoch ns).
+                    with self._tracer.start_as_current_span(
+                            f"phase.{p['phase']}") as child:
+                        child.set_attribute("phase", p["phase"])
+                        child.set_attribute("duration_s",
+                                            p["end"] - p["start"])
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            logger.debug("otel trace emit failed: %s", e)
 
     def shutdown(self) -> None:
         self._provider.shutdown()
@@ -100,4 +199,9 @@ def init_tracer(endpoint: Optional[str]) -> Optional[RequestTracer]:
             return None
     path = endpoint[len("file://"):] if endpoint.startswith("file://") \
         else endpoint
-    return JsonlTracer(path)
+    try:
+        return JsonlTracer(path)
+    except Exception as e:  # noqa: BLE001 - bad path degrades tracing
+        logger.warning("jsonl tracer at %s unavailable (%s); tracing "
+                       "disabled", path, e)
+        return None

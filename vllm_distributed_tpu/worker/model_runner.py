@@ -29,6 +29,8 @@ from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.sched.output import (ModelRunnerOutput,
                                                     SchedulerOutput)
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics.stats import (STEP_PHASE_BUCKETS,
+                                                Histogram)
 from vllm_distributed_tpu.models.common import (AttentionBatch,
                                                 TknpAttentionBatch)
 from vllm_distributed_tpu.ops.attention import resolve_attention_backend
@@ -136,6 +138,11 @@ class TPUModelRunner:
         self.token_buckets = make_buckets(
             16, sched_cfg.max_num_batched_tokens)
         self.req_buckets = make_buckets(8, self.max_num_reqs)
+
+        # Step-phase profiler share: host-side input prep per dispatch
+        # (merged into vdt:step_phase_seconds{phase="prepare_inputs"} by
+        # the engine core's get_stats).
+        self.prepare_inputs_hist = Histogram(STEP_PHASE_BUCKETS)
 
         # Speculative decoding (ngram drafts verified in-step; reference:
         # v1/spec_decode/ngram_proposer.py + rejection_sampler.py). The
@@ -1154,9 +1161,11 @@ class TPUModelRunner:
         if scheduler_output.multi_step > 1:
             return {"ready": self._execute_multi_step(scheduler_output)}
 
+        t_prep = time.perf_counter()
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
          fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
          plp, chain) = self._prepare_inputs(scheduler_output)
+        self.prepare_inputs_hist.observe(time.perf_counter() - t_prep)
         drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
         if chain is not None:
             # Async run-ahead rows: substitute the previous dispatch's
@@ -1939,17 +1948,21 @@ class TPUModelRunner:
 
     def get_stats(self) -> dict[str, float]:
         """Runner-side stats (spec-decode acceptance; reference:
-        v1/metrics/stats.py SpecDecodingStats)."""
-        if not self.spec_k:
-            return {}
-        return {
-            "spec_num_drafts": self.spec_num_drafts,
-            "spec_num_draft_tokens": self.spec_num_draft_tokens,
-            "spec_num_accepted_tokens": self.spec_num_accepted_tokens,
-            "spec_acceptance_rate":
-            (self.spec_num_accepted_tokens /
-             max(self.spec_num_draft_tokens, 1)),
+        v1/metrics/stats.py SpecDecodingStats) plus the input-prep share
+        of the step-phase profiler."""
+        stats: dict = {
+            "prepare_inputs_seconds": self.prepare_inputs_hist.to_dict(),
         }
+        if self.spec_k:
+            stats.update({
+                "spec_num_drafts": self.spec_num_drafts,
+                "spec_num_draft_tokens": self.spec_num_draft_tokens,
+                "spec_num_accepted_tokens": self.spec_num_accepted_tokens,
+                "spec_acceptance_rate":
+                (self.spec_num_accepted_tokens /
+                 max(self.spec_num_draft_tokens, 1)),
+            })
+        return stats
 
     def profile_memory_bytes(self) -> int:
         """Bytes of HBM available for KV pages, from a MEASURED peak: run
