@@ -290,10 +290,17 @@ def _timeline_overhead_legs(config, prompts, sp, record) -> None:
                                              LoadConfig, SchedulerConfig)
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     batch = len(prompts)
-    saved = os.environ.get("VDT_REQUEST_TIMELINE")
+    # The off leg drops the WHOLE observability surface (lifecycle
+    # timeline + device + transport telemetry), so
+    # timeline_overhead_frac bounds the full telemetry plane, not just
+    # the event recorder.
+    _SWITCHES = ("VDT_REQUEST_TIMELINE", "VDT_DEVICE_TELEMETRY",
+                 "VDT_TRANSPORT_TELEMETRY")
+    saved = {k: os.environ.get(k) for k in _SWITCHES}
     try:
         for leg, flag in (("timeline_on", "1"), ("timeline_off", "0")):
-            os.environ["VDT_REQUEST_TIMELINE"] = flag
+            for k in _SWITCHES:
+                os.environ[k] = flag
             cfg = EngineConfig(
                 model_config=config.model_config,
                 cache_config=CacheConfig(block_size=16),
@@ -323,10 +330,11 @@ def _timeline_overhead_legs(config, prompts, sp, record) -> None:
         if on and off:
             record["timeline_overhead_frac"] = round(1.0 - on / off, 4)
     finally:
-        if saved is None:
-            os.environ.pop("VDT_REQUEST_TIMELINE", None)
-        else:
-            os.environ["VDT_REQUEST_TIMELINE"] = saved
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _find_runner(engine):
@@ -380,6 +388,12 @@ def main() -> None:
     from transformers import LlamaConfig
     config.model_config.hf_config = LlamaConfig(
         **config.model_config.hf_overrides)
+
+    # SLO goodput leg: score the headline workload against TTFT/TPOT
+    # targets (defaults sized for the TPU bench shape; operator-set
+    # targets win). Read by the OutputProcessor at engine construction.
+    os.environ.setdefault("VDT_SLO_TTFT_MS", "2000")
+    os.environ.setdefault("VDT_SLO_TPOT_MS", "200")
 
     engine = LLMEngine(config, load_tokenizer=False)
     rng = np.random.default_rng(0)
@@ -534,6 +548,32 @@ def main() -> None:
         record["requests_shed"] = int(
             getattr(getattr(fstats, "stats", None),
                     "num_requests_shed", 0))
+        # Telemetry plane (PR 5): SLO attainment at the measured load,
+        # the device-memory high-water mark, and total KV-transfer
+        # bytes (0 unless a connector leg ran).
+        fe = getattr(fstats, "stats", None)
+        if fe is not None and fe.slo_enabled:
+            record["slo_ttft_target_ms"] = fe.slo_ttft_ms
+            record["slo_tpot_target_ms"] = fe.slo_tpot_ms
+            record["slo_requests_scored"] = fe.slo_scored
+            record["slo_goodput_frac"] = round(
+                fe.slo_good / max(fe.slo_scored, 1), 4)
+        workers = rstats.get("workers") or {}
+        peaks = [w.get("device_memory_peak_bytes", 0)
+                 for w in workers.values() if isinstance(w, dict)]
+        record["device_memory_peak_bytes"] = (max(peaks) if any(peaks)
+                                              else None)
+        record["recompiles"] = sum(
+            int(w.get("num_recompiles", 0)) for w in workers.values()
+            if isinstance(w, dict))
+        # "page_io" is the device-side gather/scatter leg of the SAME
+        # payloads the network/filesystem connectors move — summing it
+        # in would double-count every transferred byte.
+        kv_conn = (rstats.get("transport") or {}).get("kv") or {}
+        record["kv_transfer_total_bytes"] = sum(
+            int(e.get("tx_bytes", 0)) + int(e.get("rx_bytes", 0))
+            for conn, e in kv_conn.items()
+            if isinstance(e, dict) and conn != "page_io")
     except Exception:  # noqa: BLE001 - diagnostic leg only
         pass
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Ban undocumented (and orphaned) ``vdt:`` metrics.
+"""Ban undocumented (and orphaned) ``vdt:`` metrics and label sets.
 
 Every metric name the package emits must be (a) exposed with HELP/TYPE
 lines and (b) listed in the README metrics table — otherwise dashboards
@@ -14,9 +14,16 @@ silently miss new families and the README rots. Mechanically:
   in the API server).
 * **documented** — the name appears in the README metrics table
   (any backticked ``vdt:...`` token in the README counts).
+* **labels documented** — every family in the ``LABELED_METRICS``
+  registry of ``metrics/prometheus.py`` (the single source of truth
+  for label names) must appear in the README with its exact label set,
+  as ``` `vdt:name{label1,label2}` ```; a README row carrying labels
+  the registry does not declare is equally a failure.
 
-Failures: emitted without exposition, emitted without a README row, or
-a README row naming a metric nothing emits (orphan).
+Failures: emitted without exposition, emitted without a README row, a
+README row naming a metric nothing emits (orphan), a labeled family
+whose README row is missing its label set, or a README label set the
+registry does not declare.
 
 Usage::
 
@@ -31,7 +38,13 @@ import sys
 from pathlib import Path
 
 METRIC_LITERAL_RE = re.compile(r"""["'](vdt:[a-z0-9_]+)""")
-METRIC_NAME_RE = re.compile(r"`(vdt:[a-z0-9_]+)")
+# Backticked README token, optionally carrying a {label1,label2} set.
+METRIC_NAME_RE = re.compile(
+    r"`(vdt:[a-z0-9_]+)(?:\{([a-z_][a-z_,]*)\})?")
+# One LABELED_METRICS entry: "vdt:name": ("label", ...),
+REGISTRY_ENTRY_RE = re.compile(
+    r'"(vdt:[a-z0-9_]+)":\s*\(([^)]*)\)')
+LABEL_NAME_RE = re.compile(r'"([a-z_]+)"')
 
 # Modules whose registries/render helpers always emit HELP/TYPE for the
 # names they carry.
@@ -56,8 +69,35 @@ def collect(package: Path) -> tuple[set, set]:
     return emitted, exposed
 
 
-def readme_metrics(readme: Path) -> set:
-    return set(METRIC_NAME_RE.findall(readme.read_text(encoding="utf-8")))
+def labeled_registry(package: Path) -> dict[str, frozenset]:
+    """The LABELED_METRICS literal of metrics/prometheus.py, parsed
+    textually (this linter runs without importing the package)."""
+    path = package / "metrics" / "prometheus.py"
+    if not path.is_file():
+        return {}
+    text = path.read_text(encoding="utf-8")
+    marker = text.find("LABELED_METRICS")
+    if marker < 0:
+        return {}
+    # Stop at the end of the dict literal so stray tuples elsewhere in
+    # the module can't parse as registry entries.
+    block = text[marker:text.find("}", marker)]
+    return {
+        name: frozenset(LABEL_NAME_RE.findall(labels))
+        for name, labels in REGISTRY_ENTRY_RE.findall(block)
+    }
+
+
+def readme_metrics(readme: Path) -> dict[str, set]:
+    """-> {name: set of documented label frozensets} (an unlabeled
+    mention contributes an empty frozenset)."""
+    out: dict[str, set] = {}
+    for name, labels in METRIC_NAME_RE.findall(
+            readme.read_text(encoding="utf-8")):
+        sets = out.setdefault(name, set())
+        sets.add(frozenset(labels.split(",")) if labels
+                 else frozenset())
+    return out
 
 
 def main(argv: list[str]) -> int:
@@ -81,17 +121,39 @@ def main(argv: list[str]) -> int:
 
     emitted, exposed = collect(args.package)
     documented = readme_metrics(args.readme)
+    registry = labeled_registry(args.package)
     problems: list[str] = []
     for name in sorted(emitted - exposed):
         problems.append(f"{name}: emitted without HELP/TYPE exposition "
                         f"(add it to metrics/prometheus.py or an "
                         f"explicit '# HELP {name}' block)")
-    for name in sorted(emitted - documented):
+    for name in sorted(emitted - documented.keys()):
         problems.append(f"{name}: missing from the README metrics table "
                         f"({args.readme.name})")
-    for name in sorted(documented - emitted):
+    for name in sorted(documented.keys() - emitted):
         problems.append(f"{name}: in the README metrics table but "
                         f"emitted nowhere (orphaned row)")
+    # Labeled families: the registry's label set must appear verbatim
+    # in the README, and the README must not invent label sets.
+    for name in sorted(registry):
+        labels = registry[name]
+        if not labels or name not in documented:
+            continue  # missing row already reported above
+        if labels not in documented[name]:
+            want = ",".join(sorted(labels))
+            problems.append(
+                f"{name}: emitted with labels {{{want}}} but the "
+                f"README row does not document them (write "
+                f"`{name}{{{want}}}` in the metrics table)")
+    for name in sorted(documented):
+        declared = registry.get(name, frozenset())
+        for labels in documented[name]:
+            if labels and labels != declared:
+                got = ",".join(sorted(labels))
+                problems.append(
+                    f"{name}: README documents labels {{{got}}} but "
+                    f"the LABELED_METRICS registry declares "
+                    f"{sorted(declared) if declared else 'none'}")
     if not problems:
         return 0
     print("vdt: metric documentation drift:", file=sys.stderr)

@@ -165,6 +165,39 @@ def test_debug_endpoints_live_json_and_admission_exempt(server):
     assert ok.status_code == 200, ok.text
 
 
+def test_debug_kv_cache_live_mid_request(server):
+    """GET /debug/kv_cache serves live block-pool state while a
+    request is in flight (and, like the other debug GETs, bypasses the
+    admission gate — the stream below holds the single slot)."""
+    import time as _time
+    url, _engine = server
+    with _InflightStream(url):
+        data = {}
+        for _ in range(100):
+            r = httpx.get(f"{url}/debug/kv_cache", timeout=60)
+            assert r.status_code == 200, r.text
+            data = r.json()
+            cores = data.get("engine_cores") or []
+            if cores and any(req.get("kv_blocks")
+                             for req in cores[0]["requests"]):
+                break
+            _time.sleep(0.1)
+        assert cores, data
+        kv = cores[0]["kv_cache"]
+        assert kv["total_blocks"] > 0
+        assert kv["free_blocks"] + kv["used_blocks"] == \
+            kv["total_blocks"]
+        assert kv["used_blocks"] >= 1  # the in-flight request's pages
+        assert 0.0 <= kv["fragmentation_frac"] <= 1.0
+        assert 0.0 <= kv["window_hit_rate"] <= 1.0
+        assert isinstance(kv["preemption_causes"], dict)
+        req = next(r for r in cores[0]["requests"]
+                   if r.get("kv_blocks"))
+        assert req["kv_blocks"] >= 1
+        assert req["status"] in ("WAITING", "RUNNING", "PREEMPTED",
+                                 "WAITING_FOR_REMOTE_KVS")
+
+
 def test_debug_endpoints_idle_shapes(server):
     url, _engine = server
     data = httpx.get(f"{url}/debug/requests", timeout=60).json()
@@ -206,6 +239,11 @@ def test_sigusr1_dump_logs_without_disturbing_serving(server):
     assert len(dump) == 1
     message = dump[0].getMessage()
     assert "/debug/engine" in message and "thread stacks" in message
+    # The KV summary rides the same dump.
+    assert "/debug/kv_cache" in message
+    kv_payload = message.split("/debug/kv_cache: ", 1)[1].split(
+        "\nthread stacks", 1)[0]
+    assert "engine_cores" in json.loads(kv_payload)
     # The dumped engine state is valid JSON with supervisor detail.
     payload = message.split("/debug/engine: ", 1)[1].split(
         "\n/debug/requests:", 1)[0]

@@ -1,0 +1,340 @@
+"""Cluster telemetry plane (metrics/telemetry.py + the labeled
+/metrics families): recorder semantics, exact DP merging (labels
+preserved, counters summed exactly once), kill switches, SLO goodput
+scoring, and dead-replica aggregation."""
+
+import threading
+
+import pytest
+
+from vllm_distributed_tpu.metrics import prometheus, telemetry
+from vllm_distributed_tpu.metrics.stats import (FrontendStats,
+                                                RequestTimes)
+from vllm_distributed_tpu.metrics.telemetry import (
+    TransportRecorder, merge_kv_cache_stats, merge_transport_snapshots,
+    merge_worker_telemetry, worker_label)
+
+
+class _PC:
+    def __init__(self, dp=0, host=0):
+        self.data_parallel_rank = dp
+        self.host_rank = host
+
+
+# ---------------------------------------------------------------------------
+# TransportRecorder
+# ---------------------------------------------------------------------------
+def test_recorder_records_and_snapshots():
+    r = TransportRecorder(enabled=True)
+    r.record_transfer("dcn_pull", "rx", 1000, seconds=0.01)
+    r.record_transfer("dcn_pull", "rx", 24, seconds=0.02)
+    r.record_transfer("dcn_pull", "tx", 512)
+    r.record_failure("dcn_pull")
+    r.adjust_inflight("dcn_pull", +2)
+    r.adjust_inflight("dcn_pull", -1)
+    r.record_shm("write", 0.001)
+    r.record_shm("read", 0.1, lag=7)
+    snap = r.snapshot()
+    conn = snap["kv"]["dcn_pull"]
+    assert conn["rx_bytes"] == 1024
+    assert conn["tx_bytes"] == 512
+    assert conn["failures"] == 1
+    assert conn["inflight"] == 1
+    assert conn["seconds"]["count"] == 2
+    assert snap["shm"]["read"]["messages"] == 1
+    assert snap["shm_lag_chunks"] == 7
+    # Inflight never goes negative (a restart can drop the +1 side).
+    r.adjust_inflight("dcn_pull", -10)
+    assert r.snapshot()["kv"]["dcn_pull"]["inflight"] == 0
+
+
+def test_recorder_kill_switch(monkeypatch):
+    monkeypatch.setenv("VDT_TRANSPORT_TELEMETRY", "0")
+    r = TransportRecorder()  # env-driven
+    r.record_transfer("dcn_pull", "rx", 100)
+    r.record_shm("write", 0.1)
+    assert r.snapshot() == {"kv": {}, "shm": {}, "shm_lag_chunks": 0}
+    monkeypatch.setenv("VDT_TRANSPORT_TELEMETRY", "1")
+    r.record_transfer("dcn_pull", "rx", 100)
+    assert r.snapshot()["kv"]["dcn_pull"]["rx_bytes"] == 100
+
+
+def test_recorder_thread_safety():
+    r = TransportRecorder(enabled=True)
+
+    def work():
+        for _ in range(500):
+            r.record_transfer("c", "rx", 1, seconds=0.001)
+            r.adjust_inflight("c", +1)
+            r.adjust_inflight("c", -1)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = r.snapshot()
+    assert snap["kv"]["c"]["rx_bytes"] == 2000
+    assert snap["kv"]["c"]["seconds"]["count"] == 2000
+    assert snap["kv"]["c"]["inflight"] == 0
+
+
+def test_install_recorder_scopes_current():
+    default = telemetry.current_recorder()
+    mine = TransportRecorder(enabled=True)
+    restore = telemetry.install_recorder(mine)
+    try:
+        assert telemetry.current_recorder() is mine
+    finally:
+        restore()
+    assert telemetry.current_recorder() is default
+
+
+# ---------------------------------------------------------------------------
+# Merges: labels preserved, counters summed exactly once
+# ---------------------------------------------------------------------------
+def test_worker_label_is_fleet_unique():
+    labels = {worker_label(_PC(dp, h))
+              for dp in range(3) for h in range(2)}
+    assert len(labels) == 6
+    assert worker_label(_PC(1, 0)) == "dp1-h0"
+
+
+def test_merge_worker_telemetry_union_never_sums():
+    a = {"dp0-h0": {"num_recompiles": 2,
+                    "device_memory_peak_bytes": 100}}
+    b = {"dp1-h0": {"num_recompiles": 5}}
+    merged = merge_worker_telemetry([a, b, None, "junk"])
+    assert merged == {"dp0-h0": a["dp0-h0"], "dp1-h0": b["dp1-h0"]}
+    # A pathological label collision keeps the first — never adds.
+    clash = merge_worker_telemetry(
+        [a, {"dp0-h0": {"num_recompiles": 99}}])
+    assert clash["dp0-h0"]["num_recompiles"] == 2
+
+
+def test_merge_transport_snapshots_exact():
+    r1 = TransportRecorder(enabled=True)
+    r2 = TransportRecorder(enabled=True)
+    r1.record_transfer("dcn_pull", "rx", 100, seconds=0.01)
+    r1.record_shm("read", 0.001, lag=2)
+    r2.record_transfer("dcn_pull", "rx", 11, seconds=0.5)
+    r2.record_transfer("shared_storage", "tx", 7)
+    r2.record_shm("read", 0.2, lag=9)
+    merged = merge_transport_snapshots(
+        [r1.snapshot(), r2.snapshot(), None])
+    assert merged["kv"]["dcn_pull"]["rx_bytes"] == 111
+    assert merged["kv"]["dcn_pull"]["seconds"]["count"] == 2
+    assert merged["kv"]["shared_storage"]["tx_bytes"] == 7
+    assert merged["shm"]["read"]["messages"] == 2
+    assert merged["shm_lag_chunks"] == 9  # max, not sum
+    assert merge_transport_snapshots([]) is None
+
+
+def test_merge_kv_cache_stats_counts_sum_ratios_exact():
+    """Ratios recompute from the summed tallies: an idle replica
+    (zero queries, zero held pages) must not dilute the fleet hit
+    rate or fragmentation."""
+    merged = merge_kv_cache_stats([
+        {"total_blocks": 8, "free_blocks": 4, "used_blocks": 4,
+         "held_blocks": 4, "fragmentation_frac": 0.5,
+         "window_queries": 10, "window_hits": 10,
+         "window_hit_rate": 1.0,
+         "preemption_causes": {"capacity": 1}},
+        {"total_blocks": 8, "free_blocks": 8, "used_blocks": 0,
+         "held_blocks": 0, "fragmentation_frac": 0.0,
+         "window_queries": 0, "window_hits": 0,
+         "window_hit_rate": 0.0,
+         "preemption_causes": {"capacity": 2, "self": 1}},
+    ])
+    assert merged["total_blocks"] == 16
+    assert merged["used_blocks"] == 4
+    # All held pages live on replica 0 at fragmentation 0.5; the idle
+    # replica holds nothing and must not halve the figure.
+    assert merged["fragmentation_frac"] == pytest.approx(0.5)
+    # 10/10 hits fleet-wide: the idle replica's 0.0 ratio is ignored.
+    assert merged["window_hit_rate"] == pytest.approx(1.0)
+    assert merged["window_queries"] == 10
+    assert merged["preemption_causes"] == {"capacity": 3, "self": 1}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering of the labeled families
+# ---------------------------------------------------------------------------
+def _full_stats():
+    r = TransportRecorder(enabled=True)
+    r.record_transfer("dcn_pull", "rx", 64, seconds=0.01)
+    r.record_shm("write", 0.0001)
+    return {
+        "workers": {
+            "dp0-h0": {"num_recompiles": 1,
+                       "device_memory_peak_bytes": 2048,
+                       "device_memory_in_use_bytes": 1024,
+                       "device_wait_seconds": {
+                           "buckets": [0.01, 0.1], "counts": [1, 0, 0],
+                           "sum": 0.005, "count": 1}},
+        },
+        "transport": r.snapshot(),
+        "kv_cache": {"total_blocks": 8, "free_blocks": 5,
+                     "used_blocks": 3, "tombstoned_blocks": 1,
+                     "cached_free_blocks": 2,
+                     "fragmentation_frac": 0.125,
+                     "window_queries": 4, "window_hit_rate": 0.75,
+                     "preemption_causes": {"capacity": 2}},
+    }
+
+
+def test_render_metrics_labeled_families():
+    text = prometheus.render_metrics(_full_stats())
+    for needle in (
+        'vdt:recompiles_total{worker="dp0-h0"} 1.0',
+        'vdt:device_memory_peak_bytes{worker="dp0-h0"} 2048.0',
+        'vdt:device_wait_seconds_bucket{worker="dp0-h0",le="+Inf"} 1',
+        'vdt:kv_transfer_bytes_total{connector="dcn_pull",'
+        'direction="rx"} 64',
+        'vdt:kv_transfer_inflight{connector="dcn_pull"} 0',
+        'vdt:kv_transfer_seconds_count{connector="dcn_pull"} 1',
+        'vdt:shm_ring_messages_total{side="write"} 1',
+        "vdt:shm_ring_lag_chunks 0",
+        'vdt:kv_blocks{state="free"} 5',
+        'vdt:kv_blocks{state="tombstoned"} 1',
+        "vdt:kv_fragmentation_frac 0.125",
+        "vdt:prefix_cache_hit_rate_window 0.75",
+        'vdt:preemptions_by_cause_total{cause="capacity"} 2',
+    ):
+        assert needle in text, f"missing {needle!r} in:\n{text}"
+    # Every rendered labeled family must be declared in the registry
+    # (the lint script cross-checks the registry against the README).
+    import re
+    for name, label in re.findall(
+            r"^(vdt:[a-z0-9_]+?)(?:_bucket|_sum|_count)?"
+            r"\{([a-z_]+)=", text, re.M):
+        assert name in prometheus.LABELED_METRICS, name
+        assert label in prometheus.LABELED_METRICS[name], (name, label)
+
+
+def test_render_metrics_empty_sections_render_nothing():
+    text = prometheus.render_metrics({"num_running_reqs": 0})
+    assert "vdt:kv_transfer" not in text
+    assert "vdt:recompiles_total" not in text
+    assert "vdt:kv_blocks" not in text
+
+
+# ---------------------------------------------------------------------------
+# SLO goodput scoring (FrontendStats.on_slo)
+# ---------------------------------------------------------------------------
+def _times(ttft_s, tpot_s, n):
+    return RequestTimes(arrival=0.0, first_token=ttft_s,
+                        last_token=ttft_s + tpot_s * (n - 1))
+
+
+def test_slo_scoring_and_render():
+    fs = FrontendStats()
+    fs.slo_ttft_ms = 100.0
+    fs.slo_tpot_ms = 10.0
+    fs.on_slo(_times(0.05, 0.005, 10), 10)   # both met
+    fs.on_slo(_times(0.5, 0.005, 10), 10)    # ttft miss
+    fs.on_slo(_times(0.05, 0.5, 10), 10)     # tpot miss
+    fs.on_slo(RequestTimes(arrival=0.0), 0)  # no token: not scored
+    assert fs.slo_scored == 3 and fs.slo_good == 1
+    assert fs.slo_ttft_misses == 1 and fs.slo_tpot_misses == 1
+    out = fs.render()
+    assert "vdt:slo_goodput_frac 0.333333" in out
+    assert "vdt:slo_requests_scored_total 3" in out
+
+
+def test_slo_single_token_with_only_tpot_is_not_scored():
+    """Only TPOT enabled and a 1-token request: no enabled target was
+    evaluable, so the request must not count toward goodput (counting
+    it as good would read 1.0 on a workload the target never saw)."""
+    fs = FrontendStats()
+    fs.slo_tpot_ms = 1.0  # 1 ms: any measured tpot would miss
+    fs.on_slo(RequestTimes(arrival=0.0, first_token=1.0,
+                           last_token=1.0), 1)
+    assert fs.slo_scored == 0 and fs.slo_good == 0
+    # With TTFT also enabled the same request scores on TTFT alone.
+    fs.slo_ttft_ms = 5000.0
+    fs.on_slo(RequestTimes(arrival=0.0, first_token=1.0,
+                           last_token=1.0), 1)
+    assert fs.slo_scored == 1 and fs.slo_good == 1
+
+
+def test_slo_disabled_renders_nothing():
+    fs = FrontendStats()
+    fs.on_slo(_times(9.0, 9.0, 5), 5)
+    out = fs.render()
+    assert fs.slo_scored == 0
+    assert "vdt:slo_goodput_frac" not in out
+
+
+# ---------------------------------------------------------------------------
+# DP aggregation: executor fan-in + replica merge, dead replica
+# mid-scrape (satellite: labels preserved, counters never
+# double-counted)
+# ---------------------------------------------------------------------------
+class _FakeClient:
+    def __init__(self, stats=None, dead=False):
+        self._stats = stats or {}
+        self._dead = dead
+
+    def get_stats(self):
+        if self._dead:
+            raise RuntimeError("replica is dead; scrape must not "
+                               "touch it")
+        return dict(self._stats)
+
+
+def _dp(clients, down=()):
+    from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+    dp = DPEngineClient.__new__(DPEngineClient)
+    dp.clients = clients
+    dp._live = [set() for _ in clients]
+    dp._down = set(down)
+    dp.replica_failovers = len(down)
+    dp.replica_resurrections = 0
+    return dp
+
+
+def _replica_stats(label, recompiles, rx):
+    rec = TransportRecorder(enabled=True)
+    rec.record_transfer("dcn_pull", "rx", rx, seconds=0.01)
+    return {
+        "num_preemptions": 1,
+        "workers": {label: {"num_recompiles": recompiles}},
+        "transport": rec.snapshot(),
+        "kv_cache": {"total_blocks": 4, "free_blocks": 2,
+                     "used_blocks": 2, "held_blocks": 2,
+                     "fragmentation_frac": 0.5,
+                     "window_queries": 2, "window_hits": 1,
+                     "window_hit_rate": 0.5,
+                     "preemption_causes": {"capacity": 1}},
+    }
+
+
+def test_dp_aggregation_preserves_labels_and_sums_once():
+    dp = _dp([_FakeClient(_replica_stats("dp0-h0", 1, 100)),
+              _FakeClient(_replica_stats("dp1-h0", 2, 11))])
+    agg = dp.get_stats()
+    # Worker maps union — every replica's series survives unsummed.
+    assert agg["workers"]["dp0-h0"]["num_recompiles"] == 1
+    assert agg["workers"]["dp1-h0"]["num_recompiles"] == 2
+    # Transport sums exactly once per label.
+    assert agg["transport"]["kv"]["dcn_pull"]["rx_bytes"] == 111
+    assert agg["transport"]["kv"]["dcn_pull"]["seconds"]["count"] == 2
+    # Flat counters sum; kv gauges average/sum per kind.
+    assert agg["num_preemptions"] == 2
+    assert agg["kv_cache"]["total_blocks"] == 8
+    assert agg["kv_cache"]["fragmentation_frac"] == pytest.approx(0.5)
+    assert agg["kv_cache"]["preemption_causes"] == {"capacity": 2}
+
+
+def test_dp_aggregation_skips_dead_replica_mid_scrape():
+    """A replica failed over mid-scrape: its client must not be
+    scraped (the fake raises if touched) and the survivors' stats must
+    come through intact, with the failover visible."""
+    dp = _dp([_FakeClient(_replica_stats("dp0-h0", 3, 64)),
+              _FakeClient(dead=True)], down={1})
+    agg = dp.get_stats()
+    assert agg["workers"] == {"dp0-h0": {"num_recompiles": 3}}
+    assert agg["transport"]["kv"]["dcn_pull"]["rx_bytes"] == 64
+    assert agg["dp_replicas_down"] == [1]
+    assert agg["replica_failovers"] == 1

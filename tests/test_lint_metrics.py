@@ -18,10 +18,12 @@ def _run(*args: str) -> subprocess.CompletedProcess:
                           capture_output=True, text=True, timeout=60)
 
 
-def _tree(tmp_path, source: str, readme: str):
+def _tree(tmp_path, source: str, readme: str, prometheus: str = ""):
     pkg = tmp_path / "pkg"
     (pkg / "metrics").mkdir(parents=True)
     (pkg / "emitter.py").write_text(source)
+    if prometheus:
+        (pkg / "metrics" / "prometheus.py").write_text(prometheus)
     readme_path = tmp_path / "README.md"
     readme_path.write_text(readme)
     return pkg, readme_path
@@ -76,3 +78,46 @@ def test_missing_package_is_a_usage_error(tmp_path):
     res = _run("--package", str(tmp_path / "nope"),
                "--readme", str(tmp_path / "also-nope"))
     assert res.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# Labeled families: LABELED_METRICS registry <-> README label sets.
+# ---------------------------------------------------------------------------
+_LABELED_PROM = (
+    '# HELP vdt:labeled_total x\n'
+    '# TYPE vdt:labeled_total counter\n'
+    'LABELED_METRICS = {\n'
+    '    "vdt:labeled_total": ("conn", "dir"),\n'
+    '}\n')
+
+
+def test_undocumented_label_set_is_caught(tmp_path):
+    """A labeled family whose README row lacks its {label} set."""
+    pkg, readme = _tree(tmp_path, "x = 1\n",
+                        "| `vdt:labeled_total` | counter | x |\n",
+                        prometheus=_LABELED_PROM)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "does not document them" in res.stderr
+    assert "vdt:labeled_total{conn,dir}" in res.stderr
+
+
+def test_spurious_readme_labels_are_caught(tmp_path):
+    """A README label set the registry never declared."""
+    pkg, readme = _tree(
+        tmp_path, "x = 1\n",
+        "| `vdt:labeled_total{conn,dir}` | counter | x |\n"
+        "| `vdt:labeled_total{bogus}` | counter | dup |\n",
+        prometheus=_LABELED_PROM)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "registry declares" in res.stderr
+
+
+def test_clean_labeled_tree_passes(tmp_path):
+    pkg, readme = _tree(
+        tmp_path, "x = 1\n",
+        "| `vdt:labeled_total{conn,dir}` | counter | x |\n",
+        prometheus=_LABELED_PROM)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr

@@ -131,6 +131,25 @@ class BlockPool:
     def usage(self) -> float:
         return 1.0 - self.get_num_free_blocks() / self.num_blocks
 
+    def get_stats(self) -> dict[str, int]:
+        """Pool-occupancy telemetry for the stats poll / debug dump.
+        ``cached_free_blocks`` are ref-0 pages still advertising their
+        hash — reclaimable prefix cache, the pool's soft headroom.
+        O(cached index) per call; runs at scrape cadence, never on the
+        allocation path. The stats RPC runs on the CALLER's thread
+        while the core thread mutates the index — take a GIL-atomic
+        list() snapshot before iterating or a concurrent insert raises
+        "dictionary changed size during iteration" mid-scrape."""
+        cached_blocks = list(self.cached_block_hash_to_block.values())
+        cached = len(cached_blocks)
+        cached_free = sum(1 for b in cached_blocks if b.ref_cnt == 0)
+        return {
+            "total_blocks": self.num_blocks,
+            "free_blocks": self.get_num_free_blocks(),
+            "cached_blocks": cached,
+            "cached_free_blocks": cached_free,
+        }
+
     # ------------------------------------------------------------------
     def get_cached_block(self, block_hash: BlockHash) -> Optional[KVCacheBlock]:
         return self.cached_block_hash_to_block.get(block_hash.hash_value)

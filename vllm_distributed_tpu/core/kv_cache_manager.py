@@ -70,9 +70,14 @@ class KVCacheManager:
         # req_id -> number of pages already registered in the prefix cache.
         self.num_cached_block: dict[str, int] = {}
 
-        # Stats (reference: PrefixCacheStats).
+        # Stats (reference: PrefixCacheStats). Lifetime counters plus a
+        # sliding window of recent lookup outcomes: the lifetime ratio
+        # of a week-old server can't show that the cache stopped
+        # hitting an hour ago, the window can.
         self.prefix_cache_queries = 0
         self.prefix_cache_hits = 0
+        from collections import deque
+        self._recent_queries: "deque[int]" = deque(maxlen=256)
 
     @property
     def usage(self) -> float:
@@ -111,6 +116,7 @@ class KVCacheManager:
             computed.append(block)
         if computed:
             self.prefix_cache_hits += 1
+        self._recent_queries.append(1 if computed else 0)
         return KVCacheBlocks(computed), len(computed) * self.block_size
 
     def allocate_slots(
@@ -285,6 +291,23 @@ class KVCacheManager:
             "hits": self.prefix_cache_hits,
         }
 
+    def kv_telemetry(self) -> dict:
+        """Block-pool introspection for the telemetry plane: pool
+        occupancy, the request-held block/token footprint the scheduler
+        turns into a fragmentation figure, and the windowed hit rate.
+        Runs on the stats-RPC caller's thread while the core thread
+        allocates/frees — every container is list()-snapshotted
+        (GIL-atomic) before Python-level iteration."""
+        stats = dict(self.block_pool.get_stats())
+        held = 0
+        for blocks in list(self.req_to_blocks.values()):
+            held += sum(1 for b in list(blocks) if b is not None)
+        stats["held_blocks"] = held
+        recent = list(self._recent_queries)
+        stats["window_queries"] = len(recent)
+        stats["window_hits"] = sum(recent)
+        return stats
+
 
 class TokenParallelKVCacheManager:
     """Partitioned KV management for token parallelism: the global page
@@ -418,3 +441,13 @@ class TokenParallelKVCacheManager:
             "queries": sum(m.prefix_cache_queries for m in self.managers),
             "hits": sum(m.prefix_cache_hits for m in self.managers),
         }
+
+    def kv_telemetry(self) -> dict:
+        """Per-rank pools summed — one fleet view of the partitioned
+        page array (per-rank free counts already ride get_stats as
+        tknp_free_blocks_rank*)."""
+        merged: dict = {}
+        for m in self.managers:
+            for k, v in m.kv_telemetry().items():
+                merged[k] = merged.get(k, 0) + v
+        return merged

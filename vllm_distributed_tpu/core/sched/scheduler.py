@@ -247,6 +247,11 @@ class Scheduler:
         # Stats for the metrics subsystem.
         self.num_scheduled_steps = 0
         self.num_preemptions = 0
+        # Preemption attribution: "capacity" = a lower-priority victim
+        # was evicted for another request's pages, "self" = the request
+        # could find no victim (token-parallel rank exhausted, or every
+        # candidate in flight) and preempted itself.
+        self.preemption_causes: dict[str, int] = {}
         self.watchdog_timeouts = 0
         self.kv_pull_retries = 0
         self.kv_pull_failures = 0
@@ -579,7 +584,9 @@ class Scheduler:
                     # (an empty queue restores normal preemption).
                     skipped = True
                     break
-                self._preempt(victim)
+                self._preempt(victim,
+                              cause=("self" if victim is request
+                                     else "capacity"))
                 preempted.append(victim)
                 if victim is request:
                     scheduled = False
@@ -949,7 +956,7 @@ class Scheduler:
                        key=lambda r: (r.priority, r.arrival_time))
         return candidates[-1]
 
-    def _preempt(self, request: Request) -> None:
+    def _preempt(self, request: Request, cause: str = "capacity") -> None:
         self.running.remove(request)
         self.kv_cache_manager.free(request)
         request.status = RequestStatus.PREEMPTED
@@ -957,8 +964,11 @@ class Scheduler:
         request.spec_token_ids = []
         request.num_preemptions += 1
         self.num_preemptions += 1
+        self.preemption_causes[cause] = \
+            self.preemption_causes.get(cause, 0) + 1
         self._record_event(request, ev.PREEMPTED,
-                           {"num_preemptions": request.num_preemptions})
+                           {"num_preemptions": request.num_preemptions,
+                            "cause": cause})
         if self.policy == "priority":
             self._insert_by_priority(request)
         else:
@@ -1349,11 +1359,59 @@ class Scheduler:
         return False, None
 
     # ------------------------------------------------------------------
+    def _kv_cache_telemetry(self) -> dict:
+        """Paged-KV introspection (get_stats / /debug/kv_cache /
+        SIGUSR1): pool occupancy, tombstone-parked pages, internal
+        fragmentation and the windowed prefix-cache hit rate. All reads
+        of GIL-atomic containers — safe from the stats RPC while the
+        core thread mutates."""
+        kv = self.kv_cache_manager.kv_telemetry()
+        total = kv.get("total_blocks", 0) or 1
+        free = kv.get("free_blocks", 0)
+        # Pages parked under watchdog/abort tombstones: allocated, but
+        # owned by no live request until the worker reports (or the
+        # abandon backstop reclaims them).
+        tombstoned = 0
+        for holder in list(self.cancelled_remote_kv.values()):
+            tombstoned += self._num_blocks_of(holder.request_id) or 0
+        # Internal fragmentation: the fraction of request-held page
+        # slots not covered by computed tokens (partially-filled tail
+        # pages + lookahead). High steady-state fragmentation says the
+        # page size is too coarse for the traffic.
+        held = kv.get("held_blocks", 0)
+        live_tokens = sum(r.num_computed_tokens
+                          for r in list(self.requests.values()))
+        frag = 0.0
+        if held > 0:
+            page = self.config.cache_config.block_size
+            covered = min(live_tokens / (held * page), 1.0)
+            frag = 1.0 - covered
+        wq = kv.get("window_queries", 0)
+        return {
+            "total_blocks": total,
+            "free_blocks": free,
+            "used_blocks": total - free,
+            "held_blocks": held,
+            "tombstoned_blocks": tombstoned,
+            "cached_blocks": kv.get("cached_blocks", 0),
+            "cached_free_blocks": kv.get("cached_free_blocks", 0),
+            "fragmentation_frac": round(frag, 6),
+            # Raw window tallies ship alongside the ratio so the DP
+            # merge can compute the EXACT fleet hit rate from sums
+            # instead of diluting it with idle replicas' zeros.
+            "window_queries": wq,
+            "window_hits": kv.get("window_hits", 0),
+            "window_hit_rate": (kv.get("window_hits", 0) / wq
+                                if wq else 0.0),
+            "preemption_causes": dict(self.preemption_causes),
+        }
+
     def get_stats(self) -> dict[str, float]:
         stats = {
             "num_running_reqs": len(self.running),
             "num_waiting_reqs": len(self.waiting),
             "kv_cache_usage": self.kv_cache_manager.usage,
+            "kv_cache": self._kv_cache_telemetry(),
             "num_preemptions": self.num_preemptions,
             "num_async_spec_grants": self.num_async_spec_grants,
             "watchdog_timeouts": self.watchdog_timeouts,
@@ -1426,6 +1484,7 @@ class Scheduler:
             "finished_pending_retire":
                 list(self._finished_pending_retire),
             "deferred_finishes": list(self._deferred_finishes),
+            "kv_cache": self._kv_cache_telemetry(),
             "kv_cache_usage": self.kv_cache_manager.usage,
             "num_preemptions": self.num_preemptions,
             "last_step_prefill_tokens": self.last_step_prefill_tokens,

@@ -155,8 +155,15 @@ class _FinishedPull:
 class DCNPullConnector(KVConnectorBase):
     """NIXL-equivalent async pull connector (see module docstring)."""
 
+    # Connector label on the vdt:kv_transfer_* telemetry families.
+    telemetry_name = "dcn_pull"
+
     def __init__(self, config, role: KVConnectorRole) -> None:
         super().__init__(config, role)
+        # Captured at construction: the engine core installs its own
+        # recorder only for its construction window.
+        from vllm_distributed_tpu.metrics import telemetry
+        self._telemetry = telemetry.current_recorder()
         kv_cfg = config.kv_transfer_config
         extra = kv_cfg.kv_connector_extra_config or {}
         self.block_size = config.cache_config.block_size
@@ -477,6 +484,7 @@ class DCNPullConnector(KVConnectorBase):
         def count_retry(attempt, delay, err) -> None:
             self.num_pull_retries += 1
 
+        self._telemetry.adjust_inflight(self.telemetry_name, +1)
         try:
             k_s, v_s = call_with_retry(
                 lambda: self._fetch_and_stage(pull, runner),
@@ -485,11 +493,14 @@ class DCNPullConnector(KVConnectorBase):
                 on_retry=count_retry)
         except Exception as e:  # noqa: BLE001 - surfaced via error pull
             logger.error("KV pull for %s failed: %s", pull.req_id, e)
+            self._telemetry.record_failure(self.telemetry_name)
             self._finished_pulls.put(
                 _FinishedPull(req_id=pull.req_id,
                               page_ids=pull.local_page_ids,
                               k=None, v=None, error=str(e)))
             return
+        finally:
+            self._telemetry.adjust_inflight(self.telemetry_name, -1)
         self._finished_pulls.put(
             _FinishedPull(req_id=pull.req_id,
                           page_ids=pull.local_page_ids,
@@ -515,6 +526,8 @@ class DCNPullConnector(KVConnectorBase):
         propagate as OSError (retried by the caller's policy); protocol
         rejections raise RuntimeError (fatal — e.g. the producer's
         registration expired, so retrying cannot help)."""
+        from vllm_distributed_tpu.metrics import telemetry
+        t0 = telemetry.now()
         with socket.create_connection((pull.host, pull.port),
                                       timeout=120.0) as sock:
             _send_msg(sock, {"op": "pull",
@@ -525,6 +538,10 @@ class DCNPullConnector(KVConnectorBase):
                 raise ConnectionResetError("connection dropped mid-pull")
             if not reply.get("ok"):
                 raise RuntimeError(reply.get("error", "pull rejected"))
+            self._telemetry.record_transfer(
+                self.telemetry_name, "rx",
+                len(reply["k"]) + len(reply["v"]),
+                seconds=telemetry.now() - t0)
             k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
                 reply["k_shape"])
             v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
@@ -660,7 +677,12 @@ class DCNPullConnector(KVConnectorBase):
             return {"ok": False,
                     "error": f"pages {page_ids} not registered for "
                              f"{job.remote_req_id}"}
+        from vllm_distributed_tpu.metrics import telemetry
+        t0 = telemetry.now()
         k, v = page_io.gather_pages(runner, page_ids)
+        self._telemetry.record_transfer(self.telemetry_name, "tx",
+                                        k.nbytes + v.nbytes,
+                                        seconds=telemetry.now() - t0)
         return {
             "ok": True,
             "k": k.tobytes(),

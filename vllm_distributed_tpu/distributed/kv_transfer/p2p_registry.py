@@ -236,6 +236,10 @@ class P2PDcnConnector(DCNPullConnector):
     ``instance_id`` (defaults to role-pid), ``registry_ttl``.
     """
 
+    # Inherited page transfers report under this connector's own label
+    # so a p2p deployment's bytes are attributable to the dynamic path.
+    telemetry_name = "p2p"
+
     def __init__(self, config, role: KVConnectorRole) -> None:
         super().__init__(config, role)
         import os
@@ -304,6 +308,7 @@ class P2PDcnConnector(DCNPullConnector):
                     "producer instance %r not in registry; request %s "
                     "recomputes locally", params["remote_instance"],
                     request.request_id)
+                self._telemetry.record_failure(self.telemetry_name)
                 request.kv_transfer_params = None
                 self._alloc_failed.add(request.request_id)
                 return

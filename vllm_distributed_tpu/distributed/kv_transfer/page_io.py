@@ -14,6 +14,21 @@ def _replication(runner) -> int:
     return getattr(runner.model.cfg, "num_kv_head_replicas", 1)
 
 
+def _record(runner, direction: str, num_bytes: int, t0: float) -> None:
+    """Device-side page movement telemetry, labeled connector="page_io"
+    — distinct from the network/filesystem legs the connectors record,
+    so HBM gather/scatter cost is attributable separately (sums per
+    label stay exact). ``runner._telemetry`` is the owning engine
+    core's recorder, captured at runner construction; standalone tools
+    fall back to the process default."""
+    rec = getattr(runner, "_telemetry", None)
+    if rec is None:
+        return
+    from vllm_distributed_tpu.metrics import telemetry
+    rec.record_transfer("page_io", direction, num_bytes,
+                        seconds=telemetry.now() - t0)
+
+
 def _stage_views(runner):
     """[(cache_dict, (layer_lo, layer_hi), store)] — one entry for the
     flat runner, one per stage for the pipeline-parallel runner (whose
@@ -41,6 +56,9 @@ def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
     [L, n_pages, KVH_checkpoint, page_size, head_dim] (stages
     concatenated on the layer dim under pipeline parallelism)."""
     import jax
+
+    from vllm_distributed_tpu.metrics import telemetry
+    t0 = telemetry.now()
     pages = np.asarray(page_ids, np.int32)
     r = _replication(runner)
     # Dispatch every stage's gather before fetching any: the N
@@ -49,7 +67,10 @@ def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
               for cache, _, _ in _stage_views(runner)]
     ks = [np.asarray(jax.device_get(k))[:, :, ::r] for k, _ in slices]
     vs = [np.asarray(jax.device_get(v))[:, :, ::r] for _, v in slices]
-    return np.concatenate(ks, axis=0), np.concatenate(vs, axis=0)
+    k_out = np.concatenate(ks, axis=0)
+    v_out = np.concatenate(vs, axis=0)
+    _record(runner, "tx", k_out.nbytes + v_out.nbytes, t0)
+    return k_out, v_out
 
 
 def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
@@ -57,6 +78,8 @@ def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
     heads for this deployment's replication factor. Updates
     ``runner.kv_caches`` in place (new arrays; the old buffers are
     donated away by the next jitted step)."""
+    from vllm_distributed_tpu.metrics import telemetry
+    t0 = telemetry.now()
     pages = np.asarray(page_ids, np.int32)
     k, v = stage_pages(runner, k, v, on_device=False)
     for cache, (lo, hi), put in _stage_views(runner):
@@ -65,6 +88,7 @@ def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
             "k": k_all.at[:, pages].set(k[lo:hi].astype(k_all.dtype)),
             "v": v_all.at[:, pages].set(v[lo:hi].astype(v_all.dtype)),
         })
+    _record(runner, "rx", k.nbytes + v.nbytes, t0)
 
 
 _scatter_donated_fn = None  # built lazily (module import stays jax-free)
@@ -114,6 +138,10 @@ def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
     """Apply pages [lo, lo+chunk) of a staged pull via the donated
     scatter; page id padding (for the fixed chunk shape) drops."""
     import jax.numpy as jnp
+
+    from vllm_distributed_tpu.metrics import telemetry
+    t0 = telemetry.now()
+    nbytes = 0
     n = len(page_ids)
     take = min(chunk, n - lo)
     views = _stage_views(runner)
@@ -128,6 +156,8 @@ def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
         k_all, v_all = cache["k"], cache["v"]
         k_c = jnp.pad(k_dev[llo:lhi, lo:lo + take], pad)
         v_c = jnp.pad(v_dev[llo:lhi, lo:lo + take], pad)
+        nbytes += k_c.nbytes + v_c.nbytes
         k_new, v_new = _scatter_donated()(k_all, v_all, ids_dev,
                                           k_c, v_c)
         put({"k": k_new, "v": v_new})
+    _record(runner, "rx", nbytes, t0)

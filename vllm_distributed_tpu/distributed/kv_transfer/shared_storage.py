@@ -61,8 +61,15 @@ class SharedStorageConnectorMetadata:
 
 class SharedStorageConnector(KVConnectorBase):
 
+    # Connector label on the vdt:kv_transfer_* telemetry families.
+    telemetry_name = "shared_storage"
+
     def __init__(self, config, role: KVConnectorRole) -> None:
         super().__init__(config, role)
+        # Captured at construction (the engine core's recorder install
+        # window only spans construction).
+        from vllm_distributed_tpu.metrics import telemetry
+        self._telemetry = telemetry.current_recorder()
         extra = config.kv_transfer_config.kv_connector_extra_config or {}
         self.path = extra.get("shared_storage_path", DEFAULT_STORAGE_PATH)
         os.makedirs(self.path, exist_ok=True)
@@ -209,20 +216,28 @@ class SharedStorageConnector(KVConnectorBase):
         # Stored pages always hold CHECKPOINT kv heads (wire layout,
         # page_io): the store stays TP-invariant, so a tp=16 producer
         # and a tp=8 consumer exchange pages fine.
+        from vllm_distributed_tpu.metrics import telemetry
         for load in metadata.loads:
+            t0 = telemetry.now()
             ks, vs = [], []
-            for key in load.hashes:
-                k_arr, v_arr = call_with_retry(
-                    lambda key=key: self._read_page_file(key),
-                    policy=self.retry_policy,
-                    description=f"KV page load {key[:12]}")
-                ks.append(k_arr)
-                vs.append(v_arr)
+            try:
+                for key in load.hashes:
+                    k_arr, v_arr = call_with_retry(
+                        lambda key=key: self._read_page_file(key),
+                        policy=self.retry_policy,
+                        description=f"KV page load {key[:12]}")
+                    ks.append(k_arr)
+                    vs.append(v_arr)
+            except Exception:
+                self._telemetry.record_failure(self.telemetry_name)
+                raise
             # Files hold [L, KVH, PS, D] per page; stack to wire layout
             # [L, n, KVH, PS, D].
-            page_io.scatter_pages(runner, load.page_ids,
-                                  np.stack(ks, axis=1),
-                                  np.stack(vs, axis=1))
+            k_np, v_np = np.stack(ks, axis=1), np.stack(vs, axis=1)
+            self._telemetry.record_transfer(
+                self.telemetry_name, "rx", k_np.nbytes + v_np.nbytes,
+                seconds=telemetry.now() - t0)
+            page_io.scatter_pages(runner, load.page_ids, k_np, v_np)
             self.num_pages_loaded += len(load.page_ids)
             logger.info("loaded %d external KV pages for %s",
                         len(load.page_ids), load.req_id)
@@ -230,20 +245,29 @@ class SharedStorageConnector(KVConnectorBase):
     def save_kv(self, metadata, runner) -> None:
         if not metadata or not metadata.saves:
             return
+        from vllm_distributed_tpu.metrics import telemetry
         for save in metadata.saves:
             todo = [(pid, key)
                     for pid, key in zip(save.page_ids, save.hashes)
                     if not os.path.exists(self._file(key))]
             if not todo:
                 continue
+            t0 = telemetry.now()
             k_np, v_np = page_io.gather_pages(
                 runner, [pid for pid, _ in todo])
-            for i, (_, key) in enumerate(todo):
-                call_with_retry(
-                    lambda i=i, key=key: self._write_page_file(
-                        key, k_np[:, i], v_np[:, i]),
-                    policy=self.retry_policy,
-                    description=f"KV page save {key[:12]}")
+            try:
+                for i, (_, key) in enumerate(todo):
+                    call_with_retry(
+                        lambda i=i, key=key: self._write_page_file(
+                            key, k_np[:, i], v_np[:, i]),
+                        policy=self.retry_policy,
+                        description=f"KV page save {key[:12]}")
+            except Exception:
+                self._telemetry.record_failure(self.telemetry_name)
+                raise
+            self._telemetry.record_transfer(
+                self.telemetry_name, "tx", k_np.nbytes + v_np.nbytes,
+                seconds=telemetry.now() - t0)
             self.num_pages_saved += len(todo)
             logger.info("saved %d KV pages for %s", len(todo),
                         save.req_id)

@@ -131,6 +131,12 @@ class MessageQueue:
         self._ready = False
         self._broken = False
         self._buf = ctypes.create_string_buffer(self._chunk)
+        # Ring telemetry (metrics/telemetry.py): per-message wall time
+        # spent blocked in the native write/read calls, plus the reader
+        # backlog (writer_seq - reader_seq). Captured at construction —
+        # the engine core installs its recorder only for that window.
+        from vllm_distributed_tpu.metrics import telemetry
+        self._telemetry = telemetry.current_recorder()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -191,6 +197,8 @@ class MessageQueue:
                 "timed out mid-message, readers are desynced")
         if not self._ready:
             self._wait_ready(timeout)
+        import time
+        t0 = time.perf_counter()
         stream = len(payload).to_bytes(8, "little") + payload
         for off in range(0, len(stream), self._chunk):
             piece = stream[off:off + self._chunk]
@@ -209,6 +217,7 @@ class MessageQueue:
                     f"enqueue timed out: a reader of {self._name!r} has "
                     f"not drained the ring in {timeout}s")
             raise ShmRingError(f"shm_ring_write failed rc={rc}")
+        self._telemetry.record_shm("write", time.perf_counter() - t0)
 
     def enqueue(self, obj, timeout: float = 30.0) -> None:
         self.enqueue_bytes(
@@ -216,12 +225,21 @@ class MessageQueue:
 
     def dequeue_bytes(self, timeout: float = 30.0) -> bytes:
         assert not self._is_writer
+        import time
+        t0 = time.perf_counter()
         first = self._read_chunk(timeout)
         total = int.from_bytes(first[:8], "little")
         data = first[8:8 + total]
         while len(data) < total:
             piece = self._read_chunk(timeout)
             data += piece[:total - len(data)]
+        # Backlog AFTER consuming this message: chunks the writer has
+        # published that this reader has not yet dequeued (a persistent
+        # positive lag means this reader is the pod's straggler).
+        lag = max(
+            int(self._lib.shm_ring_writer_seq(self._h)) - self._seq, 0)
+        self._telemetry.record_shm("read", time.perf_counter() - t0,
+                                   lag=lag)
         return data
 
     def dequeue(self, timeout: float = 30.0):

@@ -60,18 +60,31 @@ class EngineCore:
         # pace themselves when steps degenerate to host-only polls
         # (async KV transfers in flight, requests held on a pull).
         self.last_step_scheduled = False
-        executor_class = executor_class or Executor.get_class(config)
-        self.executor = executor_class(config)
+        # Transport telemetry (metrics/telemetry.py): ONE recorder per
+        # engine core, installed for the construction window so every
+        # connector / message queue / runner built below captures it.
+        # In-process DP replicas therefore record into disjoint
+        # recorders and the DP stats merge can sum per label exactly.
+        from vllm_distributed_tpu.metrics import telemetry
+        self.transport = telemetry.TransportRecorder()
+        restore = telemetry.install_recorder(self.transport)
+        try:
+            executor_class = executor_class or Executor.get_class(config)
+            self.executor = executor_class(config)
 
-        num_pages = self._initialize_kv_caches()
-        config.cache_config.num_gpu_blocks = num_pages
-        # Scheduler-side KV connector (disaggregated prefill; reference:
-        # core.py constructs the connector beside the scheduler).
-        from vllm_distributed_tpu.distributed.kv_transfer import (
-            KVConnectorRole, create_kv_connector)
-        kv_connector = create_kv_connector(config, KVConnectorRole.SCHEDULER)
-        self.scheduler = Scheduler(config, num_blocks=num_pages,
-                                   kv_connector=kv_connector)
+            num_pages = self._initialize_kv_caches()
+            config.cache_config.num_gpu_blocks = num_pages
+            # Scheduler-side KV connector (disaggregated prefill;
+            # reference: core.py constructs the connector beside the
+            # scheduler).
+            from vllm_distributed_tpu.distributed.kv_transfer import (
+                KVConnectorRole, create_kv_connector)
+            kv_connector = create_kv_connector(config,
+                                               KVConnectorRole.SCHEDULER)
+            self.scheduler = Scheduler(config, num_blocks=num_pages,
+                                       kv_connector=kv_connector)
+        finally:
+            restore()
         # Batch queue: in-flight (scheduler_output, handle) pairs,
         # newest first. Depth = max(pp, 2): the stage count under
         # pipeline parallelism (a deeper queue only adds latency once
@@ -375,6 +388,10 @@ class EngineCore:
         if isinstance(prep, dict):
             phases["prepare_inputs"] = prep
         stats["step_phase_seconds"] = phases
+        # Transport telemetry: per-connector KV-transfer bytes/latency/
+        # inflight and shm-ring wait/lag, recorded by everything built
+        # inside this core's construction window.
+        stats["transport"] = self.transport.snapshot()
         # Lifecycle timeline: drained per stats poll, shipped over the
         # stats RPC (DP-merged by the front-end client). The drain is
         # DESTRUCTIVE — callers that may abandon the response mid-RPC

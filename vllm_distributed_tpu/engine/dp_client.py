@@ -541,6 +541,25 @@ class DPEngineClient(EngineCoreClient):
                 if h is not None:
                     merged_phases[phase] = h
             agg["step_phase_seconds"] = merged_phases
+        # Telemetry plane: per-worker maps union (labels are
+        # fleet-unique, so no counter is ever summed twice), transport
+        # snapshots merge per connector/side label, block-pool stats
+        # sum counts / average ratios. None of these ride the flat
+        # numeric-sum loop above — summing a peak HBM gauge or a
+        # replica's inflight map would fabricate fleet state.
+        from vllm_distributed_tpu.metrics import telemetry
+        workers = telemetry.merge_worker_telemetry(
+            [s.get("workers") for s in per])
+        if workers:
+            agg["workers"] = workers
+        transport = telemetry.merge_transport_snapshots(
+            [s.get("transport") for s in per])
+        if transport is not None:
+            agg["transport"] = transport
+        kv_cache = telemetry.merge_kv_cache_stats(
+            [s.get("kv_cache") for s in per])
+        if kv_cache is not None:
+            agg["kv_cache"] = kv_cache
         # Lifecycle timelines: one fleet-wide event stream, time-sorted.
         from vllm_distributed_tpu.metrics.events import merge_event_lists
         events = merge_event_lists(

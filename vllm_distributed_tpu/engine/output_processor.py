@@ -66,8 +66,14 @@ class OutputProcessor:
         # Front-end latency/throughput stats (reference:
         # v1/metrics/stats.py IterationStats maintained in the output
         # path); rendered into /metrics beside the core's stats.
+        from vllm_distributed_tpu import envs
         from vllm_distributed_tpu.metrics.stats import FrontendStats
         self.stats = FrontendStats()
+        # SLO goodput targets, read ONCE at construction (the envs
+        # registry re-reads os.environ per access; scoring runs per
+        # finished request).
+        self.stats.slo_ttft_ms = envs.VDT_SLO_TTFT_MS
+        self.stats.slo_tpot_ms = envs.VDT_SLO_TPOT_MS
         # Per-request spans (reference: tracing.py spans emitted from
         # the output path; gated by otlp_traces_endpoint).
         from vllm_distributed_tpu.tracing import init_tracer
@@ -227,6 +233,8 @@ class OutputProcessor:
             if finished:
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
+                self.stats.on_slo(state.times,
+                                  len(state.output_token_ids))
                 phases = self._finish_timeline(
                     state, ev.ABORTED if finish_reason == "abort"
                     else ev.FINISHED)

@@ -371,11 +371,48 @@ async def _debug_engine_json(app: web.Application) -> dict:
     }
 
 
+async def _debug_kv_cache_json(engine: AsyncLLM) -> dict:
+    """Live block-pool state per engine core: pool occupancy
+    (free/used/tombstoned/cached-free pages), fragmentation, the
+    windowed prefix-cache hit rate, preemption causes, and each
+    request's page footprint — the paged-KV view of the same scheduler
+    snapshot /debug/requests reads."""
+    cores = []
+    for i, core in enumerate(await _core_debug_states(engine)):
+        sched = core.get("scheduler", {})
+        cores.append({
+            "replica": i,
+            "kv_cache": sched.get("kv_cache"),
+            "kv_cache_usage": sched.get("kv_cache_usage"),
+            "requests": [
+                {"request_id": r.get("request_id"),
+                 "status": r.get("status"),
+                 "kv_blocks": r.get("kv_blocks"),
+                 "num_computed_tokens": r.get("num_computed_tokens"),
+                 "tknp_rank": r.get("tknp_rank")}
+                for r in sched.get("requests", ())
+            ],
+            "waiting_for_remote_kvs":
+                sched.get("waiting_for_remote_kvs"),
+            "cancelled_remote_kv": sched.get("cancelled_remote_kv"),
+        })
+    return {"now_monotonic": time.monotonic(), "engine_cores": cores}
+
+
 async def debug_requests(request: web.Request) -> web.Response:
     """Live per-request state: current phase, per-phase ages from the
     lifecycle timeline, progress counters, KV footprint."""
     return web.json_response(
         await _debug_requests_json(request.app[ENGINE_KEY]))
+
+
+async def debug_kv_cache(request: web.Request) -> web.Response:
+    """Live paged-KV introspection: block-pool occupancy,
+    fragmentation, windowed prefix-cache hit rate, preemption causes,
+    per-request page footprints. Admission-exempt GET — a server
+    shedding for KV pressure stays diagnosable."""
+    return web.json_response(
+        await _debug_kv_cache_json(request.app[ENGINE_KEY]))
 
 
 async def debug_engine(request: web.Request) -> web.Response:
@@ -409,11 +446,13 @@ async def _dump_debug_to_log(app: web.Application) -> None:
     try:
         engine_state = await _debug_engine_json(app)
         request_state = await _debug_requests_json(app[ENGINE_KEY])
+        kv_state = await _debug_kv_cache_json(app[ENGINE_KEY])
         logger.warning(
             "SIGUSR1 debug dump:\n/debug/engine: %s\n/debug/requests: "
-            "%s\nthread stacks:\n%s",
+            "%s\n/debug/kv_cache: %s\nthread stacks:\n%s",
             json.dumps(engine_state, default=str),
             json.dumps(request_state, default=str),
+            json.dumps(kv_state, default=str),
             _thread_stacks())
     except Exception:  # noqa: BLE001 - forensics must not kill serving
         logger.exception("SIGUSR1 debug dump failed")
@@ -1364,6 +1403,7 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/engine", debug_engine)
+    app.router.add_get("/debug/kv_cache", debug_kv_cache)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)

@@ -161,6 +161,25 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # after admission stops before the server exits anyway.
     "VDT_DRAIN_TIMEOUT_S":
     lambda: float(os.getenv("VDT_DRAIN_TIMEOUT_S", "30")),
+    # --- Telemetry plane ------------------------------------------------
+    # SLO targets scored by the output processor over the request
+    # timeline: time-to-first-token and time-per-output-token budgets in
+    # milliseconds. 0 disables that target; both 0 disables goodput
+    # accounting entirely (vdt:slo_* families are then not rendered).
+    "VDT_SLO_TTFT_MS":
+    lambda: float(os.getenv("VDT_SLO_TTFT_MS", "0")),
+    "VDT_SLO_TPOT_MS":
+    lambda: float(os.getenv("VDT_SLO_TPOT_MS", "0")),
+    # Device/compilation telemetry (per-worker recompile counter,
+    # device-wait timer, jax device-memory high-water mark). Read once
+    # per worker at construction.
+    "VDT_DEVICE_TELEMETRY":
+    lambda: os.getenv("VDT_DEVICE_TELEMETRY", "1") == "1",
+    # Transport telemetry (KV-transfer bytes/latency/inflight, shm-ring
+    # wait/lag). Checked per record so the bench harness can flip it
+    # between legs of one process.
+    "VDT_TRANSPORT_TELEMETRY":
+    lambda: os.getenv("VDT_TRANSPORT_TELEMETRY", "1") == "1",
     # Deterministic fault injection: "name:rate[@delay_s],..." over the
     # named fault points of utils/fault_injection.py (kv_pull.drop,
     # kv_pull.delay, registry.truncate, engine_core.die,

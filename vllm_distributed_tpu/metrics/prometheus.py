@@ -101,6 +101,182 @@ _HISTOGRAMS = {
 }
 
 
+# Label names per labeled vdt: family — the single source of truth for
+# the renderers below AND scripts/lint_metrics.py (which parses this
+# literal and cross-checks every entry against the README metrics
+# table, so an undocumented label set fails tier-1).
+LABELED_METRICS = {
+    "vdt:step_phase_seconds": ("phase", ),
+    "vdt:fault_injections_total": ("point", ),
+    # Telemetry plane: per-worker device/compilation series.
+    "vdt:recompiles_total": ("worker", ),
+    "vdt:device_memory_peak_bytes": ("worker", ),
+    "vdt:device_memory_in_use_bytes": ("worker", ),
+    "vdt:device_wait_seconds": ("worker", ),
+    # Telemetry plane: per-connector KV transfer + shm ring.
+    "vdt:kv_transfer_bytes_total": ("connector", "direction"),
+    "vdt:kv_transfer_failures_total": ("connector", ),
+    "vdt:kv_transfer_inflight": ("connector", ),
+    "vdt:kv_transfer_seconds": ("connector", ),
+    "vdt:shm_ring_messages_total": ("side", ),
+    "vdt:shm_ring_wait_seconds": ("side", ),
+    # Telemetry plane: block-pool introspection.
+    "vdt:kv_blocks": ("state", ),
+    "vdt:preemptions_by_cause_total": ("cause", ),
+}
+
+
+def _render_worker_telemetry(workers: dict) -> list[str]:
+    """Per-worker device/compilation series from the DP-merged
+    ``{worker_label: stats}`` map (labels are fleet-unique, so every
+    series survives the merge unsummed)."""
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    lines: list[str] = []
+    families = (
+        ("num_recompiles", "vdt:recompiles_total", "counter",
+         "Graphs compiled AFTER precompile warm-up (a steady-state "
+         "recompile is a shape-lattice leak)"),
+        ("device_memory_peak_bytes", "vdt:device_memory_peak_bytes",
+         "gauge", "Peak device HBM bytes in use (weights + workspace "
+         "+ KV high-water mark)"),
+        ("device_memory_in_use_bytes", "vdt:device_memory_in_use_bytes",
+         "gauge", "Device HBM bytes in use at the last stats poll"),
+    )
+    for key, name, kind, help_text in families:
+        series = [(w, s[key]) for w, s in sorted(workers.items())
+                  if isinstance(s, dict) and key in s]
+        if not series:
+            continue
+        lines += [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+        lines += [f'{name}{{worker="{w}"}} {float(v)}'
+                  for w, v in series]
+    hist_name = "vdt:device_wait_seconds"
+    first = True
+    for worker, s in sorted(workers.items()):
+        h = s.get("device_wait_seconds") if isinstance(s, dict) else None
+        if not isinstance(h, dict):
+            continue
+        if first:
+            lines += [f"# HELP {hist_name} Wall seconds the worker "
+                      "blocked fetching a step's device results",
+                      f"# TYPE {hist_name} histogram"]
+            first = False
+        lines += render_histogram_lines(
+            hist_name, "", h.get("buckets", ()), h.get("counts", ()),
+            h.get("sum", 0.0), h.get("count", 0),
+            label=f'worker="{worker}"', header=False)
+    return lines
+
+
+def _render_transport(transport: dict) -> list[str]:
+    """Per-connector KV-transfer and shm-ring families from a (possibly
+    DP-merged) TransportRecorder snapshot."""
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    lines: list[str] = []
+    kv = {c: e for c, e in (transport.get("kv") or {}).items()
+          if isinstance(e, dict)}
+    if kv:
+        name = "vdt:kv_transfer_bytes_total"
+        lines += [f"# HELP {name} Bytes moved per KV-transfer "
+                  "connector and direction (tx = served/saved, rx = "
+                  "pulled/loaded)",
+                  f"# TYPE {name} counter"]
+        for conn in sorted(kv):
+            for direction in ("tx", "rx"):
+                lines.append(
+                    f'{name}{{connector="{conn}",'
+                    f'direction="{direction}"}} '
+                    f'{int(kv[conn].get(f"{direction}_bytes", 0))}')
+        name = "vdt:kv_transfer_failures_total"
+        lines += [f"# HELP {name} Failed transfers per connector",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{connector="{c}"}} '
+                  f'{int(kv[c].get("failures", 0))}' for c in sorted(kv)]
+        name = "vdt:kv_transfer_inflight"
+        lines += [f"# HELP {name} Transfers in flight right now per "
+                  "connector",
+                  f"# TYPE {name} gauge"]
+        lines += [f'{name}{{connector="{c}"}} '
+                  f'{int(kv[c].get("inflight", 0))}' for c in sorted(kv)]
+        name = "vdt:kv_transfer_seconds"
+        lines += [f"# HELP {name} Wall seconds per transfer, by "
+                  "connector",
+                  f"# TYPE {name} histogram"]
+        for conn in sorted(kv):
+            h = kv[conn].get("seconds")
+            if isinstance(h, dict):
+                lines += render_histogram_lines(
+                    name, "", h.get("buckets", ()), h.get("counts", ()),
+                    h.get("sum", 0.0), h.get("count", 0),
+                    label=f'connector="{conn}"', header=False)
+    shm = {s: e for s, e in (transport.get("shm") or {}).items()
+           if isinstance(e, dict)}
+    if shm:
+        name = "vdt:shm_ring_messages_total"
+        lines += [f"# HELP {name} Messages through the shm broadcast "
+                  "ring, by side",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{side="{s}"}} '
+                  f'{int(shm[s].get("messages", 0))}'
+                  for s in sorted(shm)]
+        name = "vdt:shm_ring_wait_seconds"
+        lines += [f"# HELP {name} Wall seconds blocked in the native "
+                  "ring write/read per message",
+                  f"# TYPE {name} histogram"]
+        for side in sorted(shm):
+            h = shm[side].get("wait_seconds")
+            if isinstance(h, dict):
+                lines += render_histogram_lines(
+                    name, "", h.get("buckets", ()), h.get("counts", ()),
+                    h.get("sum", 0.0), h.get("count", 0),
+                    label=f'side="{side}"', header=False)
+    if kv or shm:
+        name = "vdt:shm_ring_lag_chunks"
+        lines += [f"# HELP {name} Reader backlog in ring CHUNKS "
+                  "(writer_seq - reader_seq; a multi-chunk message "
+                  "counts once per chunk) at the last dequeue; max "
+                  "across DP replicas",
+                  f"# TYPE {name} gauge",
+                  f'{name} {int(transport.get("shm_lag_chunks", 0))}']
+    return lines
+
+
+def _render_kv_cache(kv: dict) -> list[str]:
+    """Block-pool introspection families (free/used/tombstoned pages,
+    fragmentation, windowed prefix-cache hit rate, preemption
+    causes)."""
+    lines: list[str] = []
+    name = "vdt:kv_blocks"
+    lines += [f"# HELP {name} KV pages by pool state (cached_free = "
+              "reclaimable prefix-cache pages inside free)",
+              f"# TYPE {name} gauge"]
+    for state, key in (("free", "free_blocks"), ("used", "used_blocks"),
+                       ("tombstoned", "tombstoned_blocks"),
+                       ("cached_free", "cached_free_blocks")):
+        lines.append(f'{name}{{state="{state}"}} '
+                     f'{int(kv.get(key, 0))}')
+    for name, key, help_text in (
+        ("vdt:kv_fragmentation_frac", "fragmentation_frac",
+         "Request-held page slots not covered by computed tokens "
+         "(internal fragmentation)"),
+        ("vdt:prefix_cache_hit_rate_window", "window_hit_rate",
+         "Prefix-cache hit rate over the most recent lookups "
+         "(sliding window)"),
+    ):
+        lines += [f"# HELP {name} {help_text}", f"# TYPE {name} gauge",
+                  f"{name} {round(float(kv.get(key, 0.0)), 6)}"]
+    causes = kv.get("preemption_causes")
+    if isinstance(causes, dict) and causes:
+        name = "vdt:preemptions_by_cause_total"
+        lines += [f"# HELP {name} Preempted requests by cause "
+                  "(capacity = evicted for another request's pages, "
+                  "self = no eligible victim)",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{cause="{c}"}} {int(n)}'
+                  for c, n in sorted(causes.items())]
+    return lines
+
+
 def _render_histogram(name: str, help_text: str, h: dict) -> list[str]:
     from vllm_distributed_tpu.metrics.stats import render_histogram_lines
     return render_histogram_lines(name, help_text, h.get("buckets", ()),
@@ -147,4 +323,15 @@ def render_metrics(stats: dict) -> str:
     step_phases = stats.get("step_phase_seconds")
     if isinstance(step_phases, dict) and step_phases:
         lines += _render_step_phases(step_phases)
+    # Telemetry plane (worker device/compilation, transport, KV cache):
+    # nested dicts shipped up the stats RPC, labeled at the source.
+    workers = stats.get("workers")
+    if isinstance(workers, dict) and workers:
+        lines += _render_worker_telemetry(workers)
+    transport = stats.get("transport")
+    if isinstance(transport, dict):
+        lines += _render_transport(transport)
+    kv_cache = stats.get("kv_cache")
+    if isinstance(kv_cache, dict) and kv_cache:
+        lines += _render_kv_cache(kv_cache)
     return "\n".join(lines) + "\n"

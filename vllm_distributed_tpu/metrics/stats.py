@@ -143,6 +143,18 @@ class FrontendStats:
     # Wall seconds the last SIGTERM drain took from "stop admitting" to
     # "in-flight work finished" (0 until a drain runs).
     drain_duration_seconds: float = 0.0
+    # SLO goodput accounting: TTFT / TPOT budgets in milliseconds
+    # (VDT_SLO_TTFT_MS / VDT_SLO_TPOT_MS; 0 disables that target, both
+    # 0 disables scoring — the vdt:slo_* families are then not
+    # rendered). A request is GOOD when it met every enabled target;
+    # goodput_frac = good / scored, the paper-standard "fraction of
+    # traffic that met its latency target at this load".
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    slo_scored: int = 0
+    slo_good: int = 0
+    slo_ttft_misses: int = 0
+    slo_tpot_misses: int = 0
     # Periodic logging window (LoggingStatLogger equivalent).
     _window_start: float = field(default_factory=time.monotonic)
     _window_gen_tokens: int = 0
@@ -174,6 +186,44 @@ class FrontendStats:
         self.e2e.observe(now - times.arrival)
         self.num_prompt_tokens += num_prompt_tokens
         self.num_finished += 1
+
+    @property
+    def slo_enabled(self) -> bool:
+        return self.slo_ttft_ms > 0 or self.slo_tpot_ms > 0
+
+    def on_slo(self, times: RequestTimes, num_output_tokens: int) -> None:
+        """Score one finished request against the configured SLO
+        targets. Only token-producing requests score (an aborted
+        request that never emitted is an availability event, not a
+        latency one); TPOT needs >= 2 tokens to be defined. A request
+        where NO enabled target was evaluable (e.g. only TPOT enabled
+        and max_tokens=1) is not scored at all — counting it as good
+        would inflate goodput with requests the targets never saw."""
+        if not self.slo_enabled:
+            return
+        if times is None or times.first_token is None:
+            return
+        evaluated = False
+        good = True
+        if self.slo_ttft_ms > 0:
+            evaluated = True
+            ttft_ms = (times.first_token - times.arrival) * 1e3
+            if ttft_ms > self.slo_ttft_ms:
+                self.slo_ttft_misses += 1
+                good = False
+        if (self.slo_tpot_ms > 0 and num_output_tokens > 1
+                and times.last_token is not None):
+            evaluated = True
+            tpot_ms = ((times.last_token - times.first_token) * 1e3
+                       / (num_output_tokens - 1))
+            if tpot_ms > self.slo_tpot_ms:
+                self.slo_tpot_misses += 1
+                good = False
+        if not evaluated:
+            return
+        self.slo_scored += 1
+        if good:
+            self.slo_good += 1
 
     def _maybe_log(self, now: float) -> None:
         dt = now - self._window_start
@@ -223,6 +273,28 @@ class FrontendStats:
             "# TYPE vdt:drain_duration_seconds gauge",
             f"vdt:drain_duration_seconds {self.drain_duration_seconds}",
         ]
+        if self.slo_enabled:
+            goodput = self.slo_good / max(self.slo_scored, 1)
+            lines += [
+                "# HELP vdt:slo_goodput_frac Fraction of scored "
+                "requests that met every enabled SLO target "
+                "(VDT_SLO_TTFT_MS / VDT_SLO_TPOT_MS)",
+                "# TYPE vdt:slo_goodput_frac gauge",
+                f"vdt:slo_goodput_frac {round(goodput, 6)}",
+                "# HELP vdt:slo_requests_scored_total Finished "
+                "token-producing requests scored against the SLO "
+                "targets",
+                "# TYPE vdt:slo_requests_scored_total counter",
+                f"vdt:slo_requests_scored_total {self.slo_scored}",
+                "# HELP vdt:slo_ttft_misses_total Requests whose time "
+                "to first token exceeded VDT_SLO_TTFT_MS",
+                "# TYPE vdt:slo_ttft_misses_total counter",
+                f"vdt:slo_ttft_misses_total {self.slo_ttft_misses}",
+                "# HELP vdt:slo_tpot_misses_total Requests whose mean "
+                "time per output token exceeded VDT_SLO_TPOT_MS",
+                "# TYPE vdt:slo_tpot_misses_total counter",
+                f"vdt:slo_tpot_misses_total {self.slo_tpot_misses}",
+            ]
         lines += render_fault_injections()
         return "\n".join(lines) + "\n"
 

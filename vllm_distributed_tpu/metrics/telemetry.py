@@ -1,0 +1,305 @@
+"""Cluster telemetry plane: worker identity + transport-level recorders.
+
+The request-lifecycle timeline (metrics/events.py) makes the REQUEST
+legible; this module makes the layers the paper actually scales legible —
+the TPU device, the collectives/transports, and the paged KV cache.
+EQuARX (PAPERS.md) shows collective cost is a first-order term in
+distributed serving, and the disaggregated-prefill KV path we already
+run (dcn_pull / shared_storage / p2p) was entirely dark: no bytes, no
+latency, no inflight count.
+
+Three pieces, all flowing up the EXISTING ``get_stats`` RPC (no new
+channel):
+
+* ``worker_label`` — one stable identity string per worker
+  (``dp<rank>-h<host>``), stamped at the SOURCE so per-worker stats
+  survive executor fan-in and DP merge without re-keying (merging is a
+  dict union; counters can never double-count because every worker's
+  key is unique fleet-wide).
+* ``TransportRecorder`` — a lock-guarded, process-local recorder of
+  per-connector transfer bytes/latency/inflight and shm-ring
+  wait/lag. Each engine core owns ONE recorder (installed around its
+  construction via ``install_recorder`` so the connectors and message
+  queues built inside capture it) — in-process DP replicas therefore
+  record into DISJOINT recorders and the DP merge can sum per label.
+* ``device_memory_stats`` — the jax device memory high-water mark
+  (weights + workspace + KV), read per stats poll, never on the hot
+  path.
+
+Kill switches: ``VDT_TRANSPORT_TELEMETRY=0`` stops all transport
+recording (checked per record — the bench harness toggles it between
+legs); ``VDT_DEVICE_TELEMETRY=0`` disables the device-memory reads and
+the runner's device-wait timer (read once per runner).
+"""
+
+import threading
+import time
+from typing import Callable, Optional
+
+from vllm_distributed_tpu.metrics.stats import (Histogram,
+                                                merge_histogram_dicts)
+
+# One KV-page transfer (socket pull, file load, device scatter chunk):
+# sub-millisecond for a local file hit up to minutes for a cross-DC pull
+# riding a congested DCN.
+TRANSFER_SECONDS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                            30.0, 60.0, 120.0)
+# shm ring enqueue/dequeue wait: nanoseconds when the slot is free /
+# a message is waiting, up to the full handshake timeout when a reader
+# stalls or the writer laps.
+SHM_WAIT_BUCKETS = (0.000001, 0.000005, 0.00001, 0.00005, 0.0001,
+                    0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                    5.0, 30.0)
+
+_DIRECTIONS = ("tx", "rx")
+
+
+def worker_label(parallel_config) -> str:
+    """Fleet-unique worker identity for telemetry labels: DP replica
+    rank + host rank (the two axes along which workers multiply)."""
+    return (f"dp{parallel_config.data_parallel_rank}"
+            f"-h{parallel_config.host_rank}")
+
+
+def device_telemetry_enabled() -> bool:
+    from vllm_distributed_tpu import envs
+    return envs.VDT_DEVICE_TELEMETRY
+
+
+def device_memory_stats(mesh) -> dict:
+    """Device HBM telemetry from the mesh's first device (SPMD: one
+    process sees the whole slice; per-chip skew is an XLA bug, not an
+    ops signal). Empty on platforms without memory stats (CPU tests)."""
+    try:
+        dev = next(iter(mesh.devices.flat))
+        stats = dev.memory_stats() or {}
+    except Exception:  # pragma: no cover - platform specific
+        return {}
+    out = {}
+    if stats.get("peak_bytes_in_use"):
+        out["device_memory_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    if stats.get("bytes_in_use"):
+        out["device_memory_in_use_bytes"] = int(stats["bytes_in_use"])
+    return out
+
+
+class TransportRecorder:
+    """Per-engine-core transport stats (see module docstring).
+
+    Thread-safe: connector pull threads, shm reader threads and the
+    stats RPC all touch it. ``enabled`` consults the env per record
+    unless forced — the bench harness flips VDT_TRANSPORT_TELEMETRY
+    between legs of one process."""
+
+    def __init__(self, enabled: Optional[bool] = None) -> None:
+        self._forced = enabled
+        self._lock = threading.Lock()
+        # connector -> {tx_bytes, rx_bytes, failures, inflight, seconds}
+        self._kv: dict[str, dict] = {}
+        # side ("write"/"read") -> {messages, wait_seconds}
+        self._shm: dict[str, dict] = {}
+        # Reader backlog (writer_seq - reader_seq) at the last dequeue.
+        self._shm_lag = 0
+
+    @property
+    def enabled(self) -> bool:
+        if self._forced is not None:
+            return self._forced
+        from vllm_distributed_tpu import envs
+        return envs.VDT_TRANSPORT_TELEMETRY
+
+    # -- KV-transfer connectors ----------------------------------------
+    def _conn(self, connector: str) -> dict:
+        entry = self._kv.get(connector)
+        if entry is None:
+            entry = {"tx_bytes": 0, "rx_bytes": 0, "failures": 0,
+                     "inflight": 0,
+                     "seconds": Histogram(TRANSFER_SECONDS_BUCKETS)}
+            self._kv[connector] = entry
+        return entry
+
+    def record_transfer(self, connector: str, direction: str,
+                        num_bytes: int,
+                        seconds: Optional[float] = None) -> None:
+        assert direction in _DIRECTIONS, direction
+        if not self.enabled:
+            return
+        with self._lock:
+            entry = self._conn(connector)
+            entry[f"{direction}_bytes"] += int(num_bytes)
+            if seconds is not None:
+                entry["seconds"].observe(seconds)
+
+    def record_failure(self, connector: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._conn(connector)["failures"] += 1
+
+    def adjust_inflight(self, connector: str, delta: int) -> None:
+        # Deliberately NOT gated on ``enabled``: the flag is checked
+        # per record and may flip between a transfer's +1 and its
+        # finally-block -1 (the bench harness flips it between legs) —
+        # a gated -1 would no-op and wedge the gauge nonzero forever.
+        # One lock+dict op per transfer (not per byte) is negligible.
+        with self._lock:
+            entry = self._conn(connector)
+            entry["inflight"] = max(entry["inflight"] + delta, 0)
+
+    # -- shm broadcast ring --------------------------------------------
+    def record_shm(self, side: str, wait_s: float,
+                   lag: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            entry = self._shm.get(side)
+            if entry is None:
+                entry = {"messages": 0,
+                         "wait_seconds": Histogram(SHM_WAIT_BUCKETS)}
+                self._shm[side] = entry
+            entry["messages"] += 1
+            entry["wait_seconds"].observe(wait_s)
+            if lag is not None:
+                self._shm_lag = int(lag)
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Serializable (msgpack-clean) snapshot for the stats RPC."""
+        with self._lock:
+            kv = {
+                conn: {"tx_bytes": e["tx_bytes"],
+                       "rx_bytes": e["rx_bytes"],
+                       "failures": e["failures"],
+                       "inflight": e["inflight"],
+                       "seconds": e["seconds"].to_dict()}
+                for conn, e in self._kv.items()
+            }
+            shm = {
+                side: {"messages": e["messages"],
+                       "wait_seconds": e["wait_seconds"].to_dict()}
+                for side, e in self._shm.items()
+            }
+            return {"kv": kv, "shm": shm,
+                    "shm_lag_chunks": self._shm_lag}
+
+
+# Process default (standalone tools, follower processes, tests);
+# engine cores install their own so in-process DP replicas never share
+# one registry (shared totals would double-count under the DP sum).
+recorder = TransportRecorder()
+_current = recorder
+_install_lock = threading.Lock()
+
+
+def current_recorder() -> TransportRecorder:
+    return _current
+
+
+def install_recorder(rec: TransportRecorder) -> Callable[[], None]:
+    """Point ``current_recorder`` at ``rec`` for the duration of an
+    engine core's construction (the connectors / message queues built
+    inside capture it); returns the restore callable. Serialized —
+    cores are constructed sequentially even with in-process DP."""
+    global _current
+    _install_lock.acquire()
+    prev, _current = _current, rec
+
+    def restore() -> None:
+        global _current
+        _current = prev
+        _install_lock.release()
+
+    return restore
+
+
+def now() -> float:
+    return time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# DP-merge helpers (labels preserved; counters summed exactly once)
+# ---------------------------------------------------------------------------
+
+def merge_worker_telemetry(maps: list) -> dict:
+    """Union of per-replica ``{worker_label: stats}`` maps. Labels are
+    fleet-unique by construction (dp rank + host rank), so a plain
+    union preserves every worker's series without summing anything
+    twice; a pathological collision keeps the first seen."""
+    merged: dict = {}
+    for m in maps:
+        if not isinstance(m, dict):
+            continue
+        for worker, stats in m.items():
+            if worker not in merged:
+                merged[worker] = stats
+    return merged
+
+
+def merge_kv_cache_stats(maps: list) -> Optional[dict]:
+    """Fleet view of per-replica block-pool telemetry: page counts and
+    window tallies sum (each replica owns a disjoint pool), ratio
+    gauges recompute from the summed tallies — an unweighted average
+    would let idle replicas' zeros dilute the fleet hit rate — and the
+    preemption-cause tallies merge by summed cause."""
+    maps = [m for m in maps if isinstance(m, dict)]
+    if not maps:
+        return None
+    merged: dict = {}
+    causes: dict = {}
+    frag_weighted = 0.0
+    for m in maps:
+        for cause, n in (m.get("preemption_causes") or {}).items():
+            causes[cause] = causes.get(cause, 0) + int(n)
+        # Weight each replica's fragmentation by the pages it holds
+        # (the exact fleet figure; an empty replica contributes 0/0).
+        frag_weighted += (float(m.get("fragmentation_frac", 0.0))
+                          * m.get("held_blocks", 0))
+        for k, v in m.items():
+            if k in ("preemption_causes", "fragmentation_frac",
+                     "window_hit_rate") or not isinstance(
+                         v, (int, float)):
+                continue
+            merged[k] = merged.get(k, 0) + v
+    held = merged.get("held_blocks", 0)
+    merged["fragmentation_frac"] = (frag_weighted / held
+                                    if held else 0.0)
+    wq = merged.get("window_queries", 0)
+    merged["window_hit_rate"] = (merged.get("window_hits", 0) / wq
+                                 if wq else 0.0)
+    merged["preemption_causes"] = causes
+    return merged
+
+
+def merge_transport_snapshots(snaps: list) -> Optional[dict]:
+    """Element-wise merge of per-replica TransportRecorder snapshots.
+    Connector/side labels are preserved; numeric leaves sum (each
+    replica's recorder is disjoint, so the sum is exact) and latency
+    histograms merge bucket-wise."""
+    snaps = [s for s in snaps if isinstance(s, dict)]
+    if not snaps:
+        return None
+    kv: dict = {}
+    shm: dict = {}
+    lag = 0
+    for snap in snaps:
+        for conn, e in (snap.get("kv") or {}).items():
+            tgt = kv.setdefault(conn, {"tx_bytes": 0, "rx_bytes": 0,
+                                       "failures": 0, "inflight": 0,
+                                       "seconds": None})
+            for k in ("tx_bytes", "rx_bytes", "failures", "inflight"):
+                tgt[k] += int(e.get(k, 0))
+            merged = merge_histogram_dicts(
+                [tgt["seconds"], e.get("seconds")])
+            if merged is not None:
+                tgt["seconds"] = merged
+        for side, e in (snap.get("shm") or {}).items():
+            tgt = shm.setdefault(side, {"messages": 0,
+                                        "wait_seconds": None})
+            tgt["messages"] += int(e.get("messages", 0))
+            merged = merge_histogram_dicts(
+                [tgt["wait_seconds"], e.get("wait_seconds")])
+            if merged is not None:
+                tgt["wait_seconds"] = merged
+        lag = max(lag, int(snap.get("shm_lag_chunks", 0)))
+    return {"kv": kv, "shm": shm, "shm_lag_chunks": lag}

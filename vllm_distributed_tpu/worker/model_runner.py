@@ -143,6 +143,19 @@ class TPUModelRunner:
         # (merged into vdt:step_phase_seconds{phase="prepare_inputs"} by
         # the engine core's get_stats).
         self.prepare_inputs_hist = Histogram(STEP_PHASE_BUCKETS)
+        # Device/compilation telemetry (metrics/telemetry.py): the
+        # blocking device-fetch wait per step and the recompile counter
+        # behind vdt:recompiles_total — a steady-state recompile is the
+        # classic silent TPU perf killer, so it must be a counter an
+        # alert can watch, not only a log line. The enable flag and the
+        # engine core's transport recorder are captured ONCE at
+        # construction (the envs registry re-reads os.environ per
+        # access; the recorder install window only spans construction).
+        from vllm_distributed_tpu.metrics import telemetry
+        self._device_telemetry = telemetry.device_telemetry_enabled()
+        self._telemetry = telemetry.current_recorder()
+        self.device_wait_hist = Histogram(STEP_PHASE_BUCKETS)
+        self.num_recompiles = 0
 
         # Speculative decoding (ngram drafts verified in-step; reference:
         # v1/spec_decode/ngram_proposer.py + rejection_sampler.py). The
@@ -1225,6 +1238,10 @@ class TPUModelRunner:
         drafts_arr = handle["drafts_arr"]
         R = handle["R"]
 
+        # Device-vs-host attribution: this fetch is where the host
+        # blocks on the device (everything since dispatch ran async), so
+        # its duration IS the step's device wait as seen by this worker.
+        t_wait = time.perf_counter() if self._device_telemetry else 0.0
         if handle.get("specv"):
             verify = handle["dev"][0]
             (accept_np, residual_np, bonus_np, lp_cand_np,
@@ -1234,6 +1251,8 @@ class TPUModelRunner:
         else:
             tokens_np, logprobs_np, topk_np = self._fetch_sample(
                 handle["dev"])
+        if self._device_telemetry:
+            self.device_wait_hist.observe(time.perf_counter() - t_wait)
 
         # Embedding requests: the pooled hidden state of the sampled row
         # is the result; no token is emitted (reference: pooling path of
@@ -1717,6 +1736,10 @@ class TPUModelRunner:
         if new:
             if self._precompiled:
                 from vllm_distributed_tpu import envs
+                # Counted BEFORE the assert gate so vdt:recompiles_total
+                # reflects the violation either way (the raise is a test
+                # harness mode; production watches the counter).
+                self.num_recompiles += 1
                 msg = (f"compiling shape {key} AFTER precompile warm-up - "
                        "the shape lattice is leaking")
                 if envs.VDT_ASSERT_NO_RECOMPILE:
@@ -1949,10 +1972,16 @@ class TPUModelRunner:
     def get_stats(self) -> dict[str, float]:
         """Runner-side stats (spec-decode acceptance; reference:
         v1/metrics/stats.py SpecDecodingStats) plus the input-prep share
-        of the step-phase profiler."""
+        of the step-phase profiler and the device/compilation telemetry
+        (recompiles, device wait, HBM high-water mark)."""
         stats: dict = {
             "prepare_inputs_seconds": self.prepare_inputs_hist.to_dict(),
+            "num_recompiles": self.num_recompiles,
         }
+        if self._device_telemetry:
+            from vllm_distributed_tpu.metrics import telemetry
+            stats["device_wait_seconds"] = self.device_wait_hist.to_dict()
+            stats.update(telemetry.device_memory_stats(self.mesh))
         if self.spec_k:
             stats.update({
                 "spec_num_drafts": self.spec_num_drafts,

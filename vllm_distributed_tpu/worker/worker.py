@@ -200,4 +200,25 @@ class TPUWorker:
             return self.model_runner.wait_model(handle)
 
     def get_stats(self) -> dict:
-        return self.model_runner.get_stats()
+        """Runner stats plus this worker's labeled telemetry entry.
+
+        The per-worker keys MOVE into ``workers[label]`` (they are not
+        left flat): the DP aggregator sums flat numeric leaves, and a
+        summed "peak device memory" or a twice-counted recompile would
+        fabricate fleet state. ``num_recompiles`` stays flat as well as
+        labeled — it is a counter, so the flat DP sum is the correct
+        fleet total while the labeled copy says WHICH worker leaked a
+        shape."""
+        stats = self.model_runner.get_stats()
+        from vllm_distributed_tpu.metrics import telemetry
+        per_worker = {}
+        for key in ("device_wait_seconds", "device_memory_peak_bytes",
+                    "device_memory_in_use_bytes"):
+            if key in stats:
+                per_worker[key] = stats.pop(key)
+        if "num_recompiles" in stats:
+            per_worker["num_recompiles"] = stats["num_recompiles"]
+        if per_worker:
+            label = telemetry.worker_label(self.config.parallel_config)
+            stats["workers"] = {label: per_worker}
+        return stats
