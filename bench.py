@@ -105,6 +105,90 @@ def _install_backstop() -> None:
     signal.alarm(int(_TOTAL_DEADLINE))
 
 
+# A hung experimental-platform plugin emits ONLY this class of stderr
+# line and then blocks jax.devices() forever (BENCH_r05.json probe_log:
+# two full 120 s timeouts with nothing but the 'Platform ... is
+# experimental' warning). Warning-only output that has gone quiet is a
+# liveness VERDICT, not a timeout: the plugin loaded, device init hung,
+# and a retry will hang identically — fall back to CPU in seconds.
+_WARNING_LINE = ("warning", "experimental")
+# Seconds of warning-only stderr silence before the probe concludes the
+# platform is hung (well under the 120 s per-attempt timeout).
+_PROBE_LIVENESS = float(os.environ.get("VDT_BENCH_PROBE_LIVENESS", "15"))
+
+
+def _stderr_warning_only(text: str) -> bool:
+    """True when every non-empty stderr line is a warning (the
+    experimental-platform banner class) — no traceback, no error."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    return bool(lines) and all(
+        any(tok in ln.lower() for tok in _WARNING_LINE) for ln in lines)
+
+
+def _probe_attempt(timeout: float,
+                   liveness: float | None = None) -> tuple[str, str]:
+    """One streamed probe subprocess. Returns (verdict, detail):
+    'accel' | 'cpu' (clean results), 'hung-warning' (warning-only
+    stderr went quiet for ``liveness`` seconds), 'fail' | 'timeout'
+    (retryable).
+
+    The child's pipes are polled with os.pread: Popen dup2s the fds, so
+    the child SHARES the file description (and offset) with the parent —
+    a seek+read here would move the shared offset under a concurrent
+    child write and corrupt the capture."""
+    import tempfile
+    if liveness is None:
+        liveness = _PROBE_LIVENESS
+    with tempfile.TemporaryFile("w+b") as out_f, \
+            tempfile.TemporaryFile("w+b") as err_f:
+
+        def snap(f) -> str:
+            return os.pread(f.fileno(), 1 << 20, 0).decode(
+                "utf-8", "replace")
+
+        proc = subprocess.Popen([sys.executable, "-c", _PROBE],
+                                stdout=out_f, stderr=err_f)
+        start = time.monotonic()
+        last_growth = start
+        last_len = 0
+        try:
+            while True:
+                try:
+                    proc.wait(timeout=1.0)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if time.monotonic() - start >= timeout:
+                    proc.kill()
+                    proc.wait()
+                    return ("timeout",
+                            f"after {timeout:.0f}s: "
+                            f"{snap(err_f).strip()[-300:]}")
+                err_txt = snap(err_f)
+                if len(err_txt) != last_len:
+                    last_len = len(err_txt)
+                    last_growth = time.monotonic()
+                quiet = time.monotonic() - last_growth
+                if (err_txt and _stderr_warning_only(err_txt)
+                        and quiet >= liveness
+                        and time.monotonic() - start >= liveness):
+                    proc.kill()
+                    proc.wait()
+                    return ("hung-warning",
+                            f"warning-only stderr quiet for "
+                            f"{quiet:.0f}s: {err_txt.strip()[-300:]}")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        stdout, stderr = snap(out_f), snap(err_f)
+    if proc.returncode == 0 and "PLATFORM=" in stdout:
+        platform = stdout.split("PLATFORM=")[1].split()[0]
+        verdict = "cpu" if platform == "cpu" else "accel"
+        return (verdict, stdout.strip())
+    return ("fail", f"rc={proc.returncode}: {stderr.strip()[-300:]}")
+
+
 def _probe_accelerator() -> bool:
     """Check in a SUBPROCESS that the default JAX backend initializes AND
     executes a matmul: the tunnelled TPU plugin can hang jax.devices()
@@ -113,35 +197,41 @@ def _probe_accelerator() -> bool:
     per-process in jax, so every retry must be a fresh subprocess.
 
     Total wall clock here is hard-capped at _PROBE_BUDGET regardless of
-    the per-attempt timeout."""
+    the per-attempt timeout, and a hung experimental platform (warning-
+    only stderr, then silence) short-circuits the whole probe so the
+    CPU fallback starts in seconds rather than after 2x120 s timeouts."""
     from vllm_distributed_tpu import envs
     deadline = time.monotonic() + _PROBE_BUDGET
+    liveness = _PROBE_LIVENESS
+    hung_once = False
     for attempt, backoff in enumerate((20, 40, 0)):
         remaining = deadline - time.monotonic()
         if remaining <= 5:
             _PROBE_LOG.append(f"probe budget ({_PROBE_BUDGET}s) exhausted "
                               f"before attempt {attempt}")
             break
-        timeout = min(envs.VDT_TPU_PROBE_TIMEOUT, remaining)
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", _PROBE],
-                capture_output=True, text=True, timeout=timeout)
-            if out.returncode == 0 and "PLATFORM=" in out.stdout:
-                platform = out.stdout.split("PLATFORM=")[1].split()[0]
-                _PROBE_LOG.append(f"attempt {attempt}: {out.stdout.strip()}")
-                if platform != "cpu":
-                    return True
-                return False  # only CPU available; use the fallback path
-            msg = (f"attempt {attempt} rc={out.returncode}: "
-                   f"{out.stderr.strip()[-300:]}")
-            _PROBE_LOG.append(msg)
-            print(f"bench: probe {msg}", file=sys.stderr)
-        except subprocess.TimeoutExpired as e:
-            msg = (f"attempt {attempt} timed out after {timeout}s: "
-                   f"{((e.stderr or b'').decode() if isinstance(e.stderr, bytes) else (e.stderr or ''))[-300:]}")
-            _PROBE_LOG.append(msg)
-            print(f"bench: probe {msg}", file=sys.stderr)
+        verdict, detail = _probe_attempt(
+            min(envs.VDT_TPU_PROBE_TIMEOUT, remaining),
+            liveness=liveness)
+        msg = f"attempt {attempt} {verdict}: {detail}"
+        _PROBE_LOG.append(msg)
+        if verdict == "accel":
+            return True
+        if verdict == "cpu":
+            return False  # only CPU available; use the fallback path
+        print(f"bench: probe {msg}", file=sys.stderr)
+        if verdict == "hung-warning":
+            if hung_once:
+                # Confirmed: alive but wedged twice, even with the
+                # extended window — further retries hang identically.
+                return False
+            # A healthy tunnelled init can also be warning-then-silent
+            # for a while: confirm the hang ONCE with a 4x liveness
+            # window (still far cheaper than a full attempt timeout)
+            # before concluding.
+            hung_once = True
+            liveness = liveness * 4
+            continue
         if backoff:
             time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
     return False
@@ -345,6 +435,96 @@ def _find_runner(engine):
                 .worker.model_runner)
     except AttributeError:
         return None
+
+
+def _mixed_batch_leg(config, prompts, sp, record) -> None:
+    """Mega-kernel acceptance leg: decode tok/s while a chunked-prefill
+    chunk shares every wave (the mixed-batch dispatch the unified kernel
+    exists for), next to the same engine's pure-decode rate, plus the
+    precompile lattice size and warmup seconds (the collapsed lattice
+    must show up as fewer graphs / less warmup at unchanged buckets)."""
+    import gc
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    batch = len(prompts)
+    saved = os.environ.get("VDT_PRECOMPILE")
+    os.environ["VDT_PRECOMPILE"] = "1"
+    try:
+        cfg = EngineConfig(
+            model_config=config.model_config,
+            cache_config=CacheConfig(block_size=16),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=256, max_num_seqs=64,
+                max_model_len=2048, num_scheduler_steps=1),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+        t0 = time.perf_counter()
+        engine = LLMEngine(cfg, load_tokenizer=False)
+        record["precompile_seconds"] = round(time.perf_counter() - t0, 1)
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_PRECOMPILE", None)
+        else:
+            os.environ["VDT_PRECOMPILE"] = saved
+    runner = _find_runner(engine)
+    if runner is not None:
+        record["precompile_graphs"] = int(
+            getattr(runner, "precompile_graphs", 0))
+
+    # Pure-decode reference on THIS engine (single-step scheduling, so
+    # the comparison is decode-vs-decode at identical bucket configs).
+    tok_s, _ = _time_decode(engine, prompts, sp, "mixpure")
+    record["mixed_leg_pure_decode_tok_s"] = round(tok_s, 1)
+
+    # Mixed waves: the decode streams run while ONE long prompt is
+    # always chunk-prefilling alongside (max_tokens=1; replaced the
+    # moment it finishes), so nearly every wave carries a prefill chunk
+    # plus the running decodes.
+    rng = np.random.default_rng(3)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"mixd-{i}", p, sp)
+    prod = {f"mixd-{i}": 0 for i in range(batch)}
+    while any(v == 0 for v in prod.values()):
+        for o in engine.step():
+            if o.request_id in prod:
+                prod[o.request_id] = len(o.outputs[0].token_ids)
+    sp1 = SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True)
+    start_toks = sum(prod.values())
+    pending = None
+    n_prefills = 0
+    t0 = time.perf_counter()
+    while any(v < sp.max_tokens for v in prod.values()):
+        if pending is None:
+            pending = f"mixp-{n_prefills}"
+            n_prefills += 1
+            engine.add_request(
+                pending,
+                [int(x) for x in rng.integers(10, 1000, size=512)], sp1)
+        for o in engine.step():
+            if o.request_id in prod:
+                prod[o.request_id] = len(o.outputs[0].token_ids)
+            elif o.finished and o.request_id == pending:
+                pending = None
+    mixed_time = time.perf_counter() - t0
+    mixed_toks = sum(prod.values()) - start_toks
+    while engine.has_unfinished_requests():
+        engine.step()
+    record["mixed_decode_tok_s"] = round(mixed_toks / mixed_time, 1)
+    record["mixed_prefill_interference_frac"] = round(
+        1.0 - (mixed_toks / mixed_time) / max(tok_s, 1e-9), 4)
+    record["mixed_concurrent_prefills"] = n_prefills
+    try:
+        calls = engine.get_stats().get("attn_kernel_calls")
+        if isinstance(calls, dict) and calls:
+            record["attn_kernel_calls"] = {
+                k: int(v) for k, v in sorted(calls.items())}
+    except Exception:  # noqa: BLE001 - diagnostic only
+        pass
+    del engine
+    gc.collect()
 
 
 def main() -> None:
@@ -593,6 +773,12 @@ def main() -> None:
             _timeline_overhead_legs(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["timeline_leg_error"] = f"{type(e).__name__}: {e}"
+        # Mixed-batch leg: decode tok/s under chunked-prefill
+        # interference + precompile graph count / warmup seconds.
+        try:
+            _mixed_batch_leg(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -639,6 +825,10 @@ def main() -> None:
             _timeline_overhead_legs(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["timeline_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _mixed_batch_leg(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

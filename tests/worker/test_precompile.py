@@ -27,6 +27,24 @@ def test_forward_shapes_closed_lattice(checkpoint, monkeypatch):
         assert r._batch_shape(total, 2) in shapes
 
 
+def test_unified_lattice_strictly_smaller_than_legacy(checkpoint):
+    """ISSUE 6 acceptance: at unchanged bucket configs, the mega-kernel
+    lattice (one forward shape per token bucket — composition lives in
+    the partition descriptor) warms strictly fewer graphs than the
+    legacy decode/prefill split (max_q keyed to the token bucket)."""
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16)
+    r = _runner(engine)
+    assert r._use_unified()
+    unified = r.forward_shapes()
+    # Every unified shape pins max_q == 1: no composition static.
+    assert {s[1] for s in unified} == {1}
+    r._unified = False  # same buckets, legacy composition-split shapes
+    legacy = r.forward_shapes()
+    r._unified = True
+    assert len(unified) < len(legacy)
+
+
 def test_no_recompile_after_warmup(checkpoint, monkeypatch):
     """Mixed traffic (ragged prefills, chunked prefill, decode, stops)
     after precompile() must never compile a new graph."""

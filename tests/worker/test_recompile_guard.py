@@ -65,6 +65,82 @@ def test_steady_state_decode_reports_zero_recompiles(checkpoint,
     assert 'vdt:kv_blocks{state="free"}' in text
 
 
+def test_mixed_wave_zero_recompiles_after_precompile(checkpoint,
+                                                     monkeypatch):
+    """ROADMAP item #1's acceptance test: after precompile(), a wave
+    mixing a chunked-prefill chunk with running decodes must trigger 0
+    recompiles — the mega-kernel batch shape carries the composition in
+    the partition descriptor, not in any static."""
+    monkeypatch.setenv("VDT_PRECOMPILE", "1")
+    monkeypatch.setenv("VDT_ASSERT_NO_RECOMPILE", "1")
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=4)
+    runner = _runner(engine)
+    assert runner._precompiled
+    rng = np.random.default_rng(11)
+    # Two short prompts reach decode first; then a 40-token prompt
+    # chunk-prefills across >= 3 waves (budget 16) while they decode,
+    # so several waves mix a prefill chunk with running decode rows.
+    for i in range(2):
+        engine.add_request(
+            f"mx{i}", [int(x) for x in rng.integers(2, 127, size=3)],
+            SamplingParams(temperature=0.0, max_tokens=14,
+                           ignore_eos=True))
+    for _ in range(3):
+        engine.step()
+    engine.add_request(
+        "mx-long", [int(x) for x in rng.integers(2, 127, size=40)],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+    for _ in range(200):
+        engine.step()  # raises RuntimeError on any post-warmup compile
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    stats = engine.get_stats()
+    assert stats["num_recompiles"] == 0
+    assert stats["workers"]["dp0-h0"]["num_recompiles"] == 0
+    # The warmed lattice is itself observable (and collapsed: one
+    # forward graph per token bucket — see test_precompile).
+    assert stats["precompile_graphs"] > 0
+    assert (f'vdt:precompile_graphs_total '
+            f'{float(stats["precompile_graphs"])}'
+            in render_metrics(stats))
+
+
+def test_mixed_wave_dispatches_unified_kernel(checkpoint, monkeypatch):
+    """Acceptance: mixed prefill+decode waves dispatch to the unified
+    mega-kernel, asserted via vdt:attn_kernel_calls_total through the
+    full stats path (interpret-mode Pallas backend on CPU)."""
+    monkeypatch.setenv("VDT_ATTENTION_BACKEND", "pallas")
+    path, _ = checkpoint
+    engine = make_engine(path, max_num_batched_tokens=16, max_num_seqs=4)
+    rng = np.random.default_rng(12)
+    for i in range(2):
+        engine.add_request(
+            f"uk{i}", [int(x) for x in rng.integers(2, 127, size=3)],
+            SamplingParams(temperature=0.0, max_tokens=10,
+                           ignore_eos=True))
+    for _ in range(3):
+        engine.step()
+    engine.add_request(
+        "uk-long", [int(x) for x in rng.integers(2, 127, size=24)],
+        SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True))
+    for _ in range(200):
+        engine.step()
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    stats = engine.get_stats()
+    calls = stats["attn_kernel_calls"]
+    # Every step (decode-only, prefill-only, and the mixed waves) rides
+    # the ONE unified kernel; no step fell back to the per-composition
+    # legacy kernels.
+    assert calls.get("unified", 0) > 0
+    assert "general" not in calls and "decode" not in calls
+    text = render_metrics(stats)
+    assert 'vdt:attn_kernel_calls_total{kernel="unified"}' in text
+
+
 def test_unwarmed_shape_reports_recompiles(checkpoint, monkeypatch):
     """An empty warm-up set marked as precompiled: every compile the
     traffic triggers is, by the guard's contract, a recompile — the

@@ -530,6 +530,17 @@ class DPEngineClient(EngineCoreClient):
             [s.get("step_host_gap_seconds") for s in per])
         if merged_gap is not None:
             agg["step_host_gap_seconds"] = merged_gap
+        # Attention kernel dispatch counts: {kernel: steps}, summed per
+        # kernel label across replicas (a dict, so the flat numeric-sum
+        # loop above skipped it).
+        call_maps = [s["attn_kernel_calls"] for s in per
+                     if isinstance(s.get("attn_kernel_calls"), dict)]
+        if call_maps:
+            merged_calls: dict = {}
+            for m in call_maps:
+                for k, v in m.items():
+                    merged_calls[k] = merged_calls.get(k, 0) + int(v)
+            agg["attn_kernel_calls"] = merged_calls
         # Step-phase family: {phase -> histogram dict}, merged per phase.
         phase_maps = [s["step_phase_seconds"] for s in per
                       if isinstance(s.get("step_phase_seconds"), dict)]

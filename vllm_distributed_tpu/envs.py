@@ -73,6 +73,11 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # Run Pallas kernels in interpret mode (CPU tests).
     "VDT_PALLAS_INTERPRET":
     lambda: os.getenv("VDT_PALLAS_INTERPRET", "0") == "1",
+    # Fuse the per-layer KV-page write into the attention mega-kernel
+    # (one pass over the cache per mixed step) when the layout permits;
+    # "0" keeps the separate write-then-attend pair for debugging.
+    "VDT_FUSED_KV_WRITE":
+    lambda: os.getenv("VDT_FUSED_KV_WRITE", "1") == "1",
     # Fraction of HBM usable for weights+KV (analogue of gpu_memory_utilization
     # default source).
     "VDT_MEMORY_FRACTION":

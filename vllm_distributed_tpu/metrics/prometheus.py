@@ -86,6 +86,12 @@ _COUNTERS = {
     "timeline_events_dropped": ("vdt:timeline_events_dropped_total",
                                 "Lifecycle events dropped by full ring "
                                 "buffers (oldest-first overflow)"),
+    # Compile-lattice size: graphs warmed by precompile() (summed across
+    # DP replicas; the mega-kernel's collapsed lattice shows up here as
+    # a smaller warm-up at unchanged bucket configs).
+    "precompile_graphs": ("vdt:precompile_graphs_total",
+                          "XLA graphs compiled by the precompile "
+                          "warm-up suite"),
 }
 
 
@@ -123,6 +129,9 @@ LABELED_METRICS = {
     # Telemetry plane: block-pool introspection.
     "vdt:kv_blocks": ("state", ),
     "vdt:preemptions_by_cause_total": ("cause", ),
+    # Attention dispatch: which kernel family each step ran
+    # (unified|decode|general|cascade|naive).
+    "vdt:attn_kernel_calls_total": ("kernel", ),
 }
 
 
@@ -323,6 +332,18 @@ def render_metrics(stats: dict) -> str:
     step_phases = stats.get("step_phase_seconds")
     if isinstance(step_phases, dict) and step_phases:
         lines += _render_step_phases(step_phases)
+    # Attention kernel dispatch counts ({kernel: steps} from the runner,
+    # summed per kernel across DP replicas).
+    calls = stats.get("attn_kernel_calls")
+    if isinstance(calls, dict) and calls:
+        name = "vdt:attn_kernel_calls_total"
+        lines += [f"# HELP {name} Steps dispatched per attention kernel "
+                  "family (unified = mixed-batch mega-kernel, decode = "
+                  "SB-batched decode, general = per-sequence tiles, "
+                  "cascade = shared-prefix, naive = XLA reference)",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{kernel="{k}"}} {int(calls[k])}'
+                  for k in sorted(calls)]
     # Telemetry plane (worker device/compilation, transport, KV cache):
     # nested dicts shipped up the stats RPC, labeled at the source.
     workers = stats.get("workers")

@@ -41,6 +41,11 @@ class TknpAttentionBatch:
     # [K, G, 4] / [K, 1]: per-rank KV-write runs with local page ids.
     kv_runs: jax.Array
     num_kv_runs: jax.Array
+    # [K, P, 3] / [K, max_reqs]: per-rank mega-kernel partition
+    # descriptors over the rank's compacted seq runs (None routes the
+    # legacy per-composition kernels).
+    desc: Optional[jax.Array] = None
+    decode_list: Optional[jax.Array] = None
 
 
 jax.tree_util.register_dataclass(
@@ -102,9 +107,25 @@ class AttentionBatch:
     # text-only requests). Reference: the mrope position ids of
     # model_executor/models/qwen2_vl.py get_rope_index.
     mrope_positions: Optional[jax.Array] = None
+    # Mega-kernel partition descriptor ([P, 3] int32) + decode row list
+    # ([max_reqs] int32): the host-built program partition consumed by
+    # ops/pallas_attention.py's unified kernel (kv-write runs, prefill
+    # q-tiles, SB decode groups — see the descriptor contract there).
+    # None routes legacy per-composition dispatch (in-jit batches built
+    # by the multi-step scan / EAGLE, and MLA models).
+    attn_desc: Optional[jax.Array] = None
+    decode_list: Optional[jax.Array] = None
     # Static: per-sequence query-length bucket (1 for pure decode);
-    # changing it recompiles, like every other shape bucket.
+    # changing it recompiles, like every other shape bucket. Ignored by
+    # the unified kernel (pinned to 1 by the runner when a descriptor is
+    # present), still consulted by the legacy dispatch and MLA.
     max_q: int = 1
+    # Static mega-kernel tile parameters (prefill_tile_size /
+    # decode_group_size): fixed per model+sharding, passed through the
+    # batch so the host descriptor builder and the kernel can never
+    # disagree. 0 when no descriptor is attached.
+    attn_bq: int = 0
+    attn_sb: int = 0
 
 
 @dataclasses.dataclass
@@ -134,9 +155,9 @@ jax.tree_util.register_dataclass(
     AttentionBatch,
     data_fields=[
         f.name for f in dataclasses.fields(AttentionBatch)
-        if f.name != "max_q"
+        if f.name not in ("max_q", "attn_bq", "attn_sb")
     ],
-    meta_fields=["max_q"],
+    meta_fields=["max_q", "attn_bq", "attn_sb"],
 )
 
 

@@ -24,8 +24,7 @@ from jax.sharding import PartitionSpec as P
 from vllm_distributed_tpu.models.common import (AttentionBatch,
                                                 compute_rope_cos_sin,
                                                 rms_norm)
-from vllm_distributed_tpu.ops.attention import (paged_attention,
-                                                write_kv_cache)
+from vllm_distributed_tpu.ops.attention import write_kv_and_attend
 
 MODEL_AXIS = "model"
 TOKEN_AXIS = "token"
@@ -1264,15 +1263,15 @@ class LlamaForCausalLM:
             if not nope:
                 q = apply_rotary(q, local=local_rope)
                 k = apply_rotary(k, local=local_rope)
-            k_all, v_all = write_kv_cache(k_all, v_all, k, v, batch,
-                                          layer_idx)
-            attn = paged_attention(q, k_all, v_all, batch,
-                                   sm_scale=sm_scale, layer=layer_idx,
-                                   window=window,
-                                   logit_cap=c.attn_logit_softcap,
-                                   alibi_slopes=slopes,
-                                   sinks=(lp["sinks"] if c.attn_sinks
-                                          else None))
+            # One fused Pallas pass writes the step's K/V pages and
+            # attends in the same kernel call where the layout permits
+            # (mega-kernel descriptor batches); otherwise this is the
+            # classic write-then-attend pair.
+            k_all, v_all, attn = write_kv_and_attend(
+                q, k_all, v_all, k, v, batch, sm_scale=sm_scale,
+                layer=layer_idx, window=window,
+                logit_cap=c.attn_logit_softcap, alibi_slopes=slopes,
+                sinks=(lp["sinks"] if c.attn_sinks else None))
             attn2d = attn.reshape(T, -1)
             attn_out = (self._mm(lp, "wo", attn2d) +
                         self._lora_delta(lp, "wo", attn2d, lora_ctx))

@@ -524,12 +524,22 @@ def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
     cache_spec, head_spec, token_axis = _tknp_cache_specs()
     from jax.sharding import PartitionSpec as P
 
-    def call(q_, k_, v_, seq_info_, num_seqs_, bt_, slot_):
+    unified = use_pallas and getattr(tk, "desc", None) is not None
+
+    def call(q_, k_, v_, seq_info_, num_seqs_, bt_, slot_, desc_, dl_):
         seq_info_ = seq_info_[0]
         num_seqs_ = num_seqs_[0]
         bt_ = bt_[0]
         slot_ = slot_[0]
-        if use_pallas:
+        if unified:
+            from vllm_distributed_tpu.ops.pallas_attention import (
+                unified_ragged_paged_attention_pallas)
+            q_p = _pad_last_dim(q_, k_.shape[-1])
+            out = unified_ragged_paged_attention_pallas(
+                q_p, k_, v_, desc_[0], seq_info_, dl_[0], bt_, layer,
+                sm_scale=sm_scale, bq=batch.attn_bq,
+                sb=batch.attn_sb)[..., :head_dim]
+        elif use_pallas:
             from vllm_distributed_tpu.ops.pallas_attention import (
                 ragged_paged_attention_pallas)
             q_p = _pad_last_dim(q_, k_.shape[-1])
@@ -545,14 +555,19 @@ def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
         out = jnp.where((slot_ >= 0)[:, None, None], out, 0)
         return jax.lax.psum(out, token_axis)
 
+    K = tk.seq_info.shape[0]
+    desc = tk.desc if unified else jnp.zeros((K, 1, 3), jnp.int32)
+    dl = (tk.decode_list if unified
+          else jnp.zeros((K, tk.seq_info.shape[1]), jnp.int32))
     return shard_map(
         call, mesh=mesh_state.get_global_mesh(),
         in_specs=(head_spec, cache_spec, cache_spec,
                   P(token_axis, None, None), P(token_axis, None),
+                  P(token_axis, None, None), P(token_axis, None),
                   P(token_axis, None, None), P(token_axis, None)),
         out_specs=head_spec,
         check_vma=False)(q, k_pages, v_pages, tk.seq_info, tk.num_seqs,
-                         tk.block_tables, tk.slot_mapping)
+                         tk.block_tables, tk.slot_mapping, desc, dl)
 
 
 def _pallas_cascade(q, q_p, k_all, v_all, batch, layer, sm_scale,
@@ -564,9 +579,10 @@ def _pallas_cascade(q, q_p, k_all, v_all, batch, layer, sm_scale,
     with the shared slots stripped and kv_len shifted (relative
     causality is preserved), and the kernel's exported (m, l) state
     merges the two exactly (reference: flash_attn.py cascade +
-    merge_attn_states.cu)."""
-    from vllm_distributed_tpu.ops.pallas_attention import (
-        ragged_paged_attention_pallas)
+    merge_attn_states.cu). With a partition descriptor on the batch the
+    suffix phase runs the mega-kernel (decode rows keep SB batching and
+    export their state too); the descriptor is reused verbatim — only
+    kv_len shifts, which the kernel reads dynamically."""
     shared = batch.cascade_shared_ids
     S = shared.shape[0]
     page_size = k_all.shape[3]
@@ -579,10 +595,21 @@ def _pallas_cascade(q, q_p, k_all, v_all, batch, layer, sm_scale,
     shift = S * page_size
     si = batch.seq_info
     si_sfx = si.at[:, 2].set(jnp.maximum(si[:, 2] - shift, 0))
-    out_sf, st_sf = ragged_paged_attention_pallas(
-        q_p, k_all, v_all, si_sfx, batch.num_seqs,
-        batch.block_tables[:, S:], layer, sm_scale=sm_scale,
-        max_q=batch.max_q, emit_state=True)
+    if getattr(batch, "attn_desc", None) is not None:
+        from vllm_distributed_tpu.ops.pallas_attention import (
+            unified_ragged_paged_attention_pallas)
+        out_sf, st_sf = unified_ragged_paged_attention_pallas(
+            q_p, k_all, v_all, batch.attn_desc, si_sfx,
+            batch.decode_list, batch.block_tables[:, S:], layer,
+            sm_scale=sm_scale, bq=batch.attn_bq, sb=batch.attn_sb,
+            emit_state=True)
+    else:
+        from vllm_distributed_tpu.ops.pallas_attention import (
+            ragged_paged_attention_pallas)
+        out_sf, st_sf = ragged_paged_attention_pallas(
+            q_p, k_all, v_all, si_sfx, batch.num_seqs,
+            batch.block_tables[:, S:], layer, sm_scale=sm_scale,
+            max_q=batch.max_q, emit_state=True)
     m_sf = st_sf[..., 0:1]                      # [T, QH, 1] f32
     l_sf = st_sf[..., D // 2:D // 2 + 1]
     acc_sf = out_sf[..., :head_dim].astype(jnp.float32) * l_sf
@@ -633,9 +660,6 @@ def paged_attention(
             and k_pages.dtype not in _FP8_DTYPES
             and resolve_attention_backend() == "pallas"
             and batch.seq_info is not None):
-        from vllm_distributed_tpu.ops.pallas_attention import (
-            ragged_paged_attention_pallas)
-
         head_dim = q.shape[-1]
 
         def call(q_, k_, v_):
@@ -646,7 +670,22 @@ def paged_attention(
             if shared is not None:
                 out = _pallas_cascade(q_, q_p, k_, v_, batch, layer,
                                       sm_scale, head_dim)
+            elif getattr(batch, "attn_desc", None) is not None:
+                # Mixed-batch mega-kernel: one call, prefill q-tiles +
+                # SB decode groups partitioned by the host descriptor —
+                # decode rows keep MXU-filling batching even when a
+                # chunked-prefill chunk shares the wave, and no kernel
+                # static depends on the batch composition.
+                from vllm_distributed_tpu.ops.pallas_attention import (
+                    unified_ragged_paged_attention_pallas)
+                out = unified_ragged_paged_attention_pallas(
+                    q_p, k_, v_, batch.attn_desc, batch.seq_info,
+                    batch.decode_list, batch.block_tables, layer,
+                    sm_scale=sm_scale, bq=batch.attn_bq,
+                    sb=batch.attn_sb)[..., :head_dim]
             else:
+                from vllm_distributed_tpu.ops.pallas_attention import (
+                    ragged_paged_attention_pallas)
                 out = ragged_paged_attention_pallas(
                     q_p, k_, v_, batch.seq_info, batch.num_seqs,
                     batch.block_tables, layer, sm_scale=sm_scale,
@@ -687,3 +726,84 @@ def paged_attention(
                                   sm_scale=sm_scale, window=window,
                                   logit_cap=logit_cap,
                                   alibi_slopes=alibi_slopes, sinks=sinks)
+
+
+def write_kv_and_attend(
+    q: jax.Array,  # [T, num_q_heads, head_dim]
+    k_pages: jax.Array,  # [L, N, KVH, PS, D] stacked cache
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [T, KVH, head_dim]
+    v_new: jax.Array,
+    batch,  # AttentionBatch
+    *,
+    sm_scale: float,
+    layer: jax.Array,  # [1] int32
+    window: int = 0,
+    logit_cap: float = 0.0,
+    alibi_slopes: tuple = None,
+    sinks: jax.Array = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """KV-page write + attention for one layer, fused into ONE Pallas
+    pass over the cache when the layout permits: the mega-kernel's
+    kind-3 programs land the step's new K/V pages in place (aliased),
+    and the attention programs that follow in the sequential grid read
+    them — a mixed step makes one pass over the KV cache instead of two.
+    Returns (k_pages, v_pages, attn_out).
+
+    Falls back to write_kv_cache + paged_attention whenever any feature
+    the fused kernel does not carry is active (sliding window / softcap
+    / ALiBi / sinks / fp8 KV / token parallelism / cascade), when the
+    batch has no partition descriptor (in-jit batches from the
+    multi-step scan or EAGLE), or when VDT_FUSED_KV_WRITE=0."""
+    fused = (envs.VDT_FUSED_KV_WRITE and window == 0 and logit_cap == 0
+             and alibi_slopes is None and sinks is None
+             and k_pages.dtype not in _FP8_DTYPES
+             and getattr(batch, "tknp", None) is None
+             and getattr(batch, "cascade_shared_ids", None) is None
+             and getattr(batch, "attn_desc", None) is not None
+             and getattr(batch, "kv_runs", None) is not None
+             and resolve_attention_backend() == "pallas")
+    if not fused:
+        k_pages, v_pages = write_kv_cache(k_pages, v_pages, k_new, v_new,
+                                          batch, layer)
+        out = paged_attention(q, k_pages, v_pages, batch,
+                              sm_scale=sm_scale, layer=layer,
+                              window=window, logit_cap=logit_cap,
+                              alibi_slopes=alibi_slopes, sinks=sinks)
+        return k_pages, v_pages, out
+
+    from vllm_distributed_tpu.ops.pallas_attention import (
+        unified_write_attend_pallas)
+    L, N, KVH, PS, D = k_pages.shape
+    head_dim = q.shape[-1]
+
+    def call(q_, k_, v_, kn_, vn_):
+        pad = [(0, 0), (PS, 2 * PS), (0, 0)]
+        k_hl = jnp.pad(_pad_last_dim(kn_, D).swapaxes(0, 1),
+                       pad).astype(k_.dtype)
+        v_hl = jnp.pad(_pad_last_dim(vn_, D).swapaxes(0, 1),
+                       pad).astype(v_.dtype)
+        q_p = _pad_last_dim(q_, D)
+        out, k2, v2 = unified_write_attend_pallas(
+            q_p, k_, v_, k_hl, v_hl, batch.attn_desc, batch.seq_info,
+            batch.decode_list, batch.kv_runs, batch.block_tables, layer,
+            sm_scale=sm_scale, bq=batch.attn_bq, sb=batch.attn_sb)
+        out = out[..., :head_dim]
+        # Rows no program wrote (padding tokens) are uninitialized HBM;
+        # zero them so garbage can't reach later layers' projections.
+        valid = (batch.slot_mapping >= 0)[:, None, None]
+        return k2, v2, jnp.where(valid, out, 0)
+
+    from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    if mesh_state.has_global_mesh() and mesh_state.tp_size() > 1:
+        from jax.sharding import PartitionSpec as P
+        head_spec = P(None, MESH_AXIS_MODEL, None)
+        cache_spec = P(None, None, MESH_AXIS_MODEL, None, None)
+        return shard_map(
+            call, mesh=mesh_state.get_global_mesh(),
+            in_specs=(head_spec, cache_spec, cache_spec, head_spec,
+                      head_spec),
+            out_specs=(cache_spec, cache_spec, head_spec),
+            check_vma=False)(q, k_pages, v_pages, k_new, v_new)
+    return call(q, k_pages, v_pages, k_new, v_new)

@@ -42,12 +42,140 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from vllm_distributed_tpu import envs
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# ---------------------------------------------------------------------------
+# Mega-kernel partition descriptor
+#
+# One Pallas call consumes an arbitrary mixed prefill+decode batch: the
+# grid is a flat program list and a host-built descriptor row tells each
+# program what it is. Programs execute in order (the TPU grid is
+# sequential), which is what lets KV-write programs land the step's new
+# K/V pages before any attention program reads them.
+#
+#   desc[p] = (kind, a, b) int32
+#     kind 0 (noop)     — padding row, program does nothing.
+#     kind 1 (prefill)  — a = seq_info row r, b = tile start within the
+#                         sequence's q run; runs the flash loop over a
+#                         fixed ``bq`` q tile (independent of the token
+#                         bucket). Writeback is EXACT (8-row chunks + a
+#                         per-row tail), so tiles never spill into
+#                         neighbouring rows and program order between
+#                         attention programs does not matter.
+#     kind 2 (decode)   — a = start index into ``decode_list``, b =
+#                         number of active slots (<= sb); stacks sb
+#                         single-token sequences as virtual heads so one
+#                         MXU dot scores every sequence at once (the
+#                         _decode_kernel trick), even when prefill tiles
+#                         share the wave.
+#     kind 3 (kv write) — a = row into ``kv_runs``; the in-place paged
+#                         RMW of ops/pallas_kv_write.py, compiled into
+#                         the kernel only for the fused write+attend
+#                         variant (attention-only calls treat kind 3 as
+#                         a noop).
+#
+# ``decode_list`` holds the seq_info row indices of every q_len == 1
+# sequence; any single-token run (a decode step OR a one-token chunked-
+# prefill tail — the attention math is identical) lands there.
+#
+# The compile-lattice math: descriptor length and q padding are
+# deterministic functions of the token bucket, and no kernel static
+# depends on the batch composition — the forward graph count collapses
+# from O(|T| x compositions) kernel variants to one kernel x |T| input
+# shapes.
+# ---------------------------------------------------------------------------
+
+KIND_NOOP = 0
+KIND_PREFILL = 1
+KIND_DECODE = 2
+KIND_KV_WRITE = 3
+
+# Token arrays carry this many padding rows past the token bucket: a
+# prefill tile's final 8-row read chunk may start at the last valid row
+# (q reads are 8-row-aligned; writes are exact and never need it).
+Q_TILE_PAD = 8
+
+
+def prefill_tile_size(num_q_heads: int, head_dim: int) -> int:
+    """Static prefill q-tile rows. Fixed (never a function of the token
+    bucket) so the kernel has no per-composition statics; 32 rows fold to
+    32*group score rows per kv head — MXU-filling for GQA groups >= 4.
+    Shrinks (staying a multiple of 8, the IO chunk) for wide-head models
+    so per-program staging stays inside the VMEM budget."""
+    bq = 32
+    while bq > 8 and bq * num_q_heads * head_dim * 32 > 12 * 1024**2:
+        bq //= 2
+    return bq
+
+
+def decode_group_size(num_q_heads: int, num_kv_heads: int) -> int:
+    """Static decode-group width (sequences stacked as virtual heads per
+    program). Independent of the runtime batch size — inactive slots are
+    masked — and sized against the worst-case 128-position kv block so
+    the same sb is valid for every caller (the cascade suffix call sees
+    a shorter block table than the main call)."""
+    sb = max(1, min(8, 128 // max(1, num_q_heads // 4)))
+    while sb > 1 and (sb * num_q_heads) * (sb * num_kv_heads * 128) * 8 \
+            > 8 * 1024**2:
+        sb //= 2
+    return sb
+
+
+def num_partition_programs(t_bucket: int, max_num_reqs: int, *, bq: int,
+                           sb: int, num_kv_writes: int = 0) -> int:
+    """Descriptor length bound as a deterministic function of the token
+    bucket: worst-case prefill tiles (every sequence pays one partial
+    tile) + decode groups + kv-write rows. Adds no lattice dimension."""
+    return (num_kv_writes + -(-t_bucket // bq) + max_num_reqs +
+            -(-max_num_reqs // sb))
+
+
+def build_partition_descriptor(
+    seq_info: np.ndarray,  # [R, 4] int32 host copy
+    num_seqs: int,
+    *,
+    bq: int,
+    sb: int,
+    num_programs: int,
+    num_kv_writes: int = 0,
+    decode_rows: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side partition of a step into mega-kernel programs.
+
+    Returns ``(desc [num_programs, 3], decode_list [R])``. Pass
+    ``decode_rows`` (row indices into seq_info) to skip the q_len scan —
+    the runner's pure-decode fast path feeds its row vector directly."""
+    R = seq_info.shape[0]
+    desc = np.zeros((num_programs, 3), np.int32)
+    dl = np.zeros((R, ), np.int32)
+    p = num_kv_writes
+    if num_kv_writes:
+        desc[:p, 0] = KIND_KV_WRITE
+        desc[:p, 1] = np.arange(num_kv_writes, dtype=np.int32)
+    if decode_rows is None:
+        q_lens = seq_info[:num_seqs, 1]
+        decode_rows = np.nonzero(q_lens == 1)[0]
+        for r in np.nonzero(q_lens > 1)[0]:
+            nt = -(-int(q_lens[r]) // bq)
+            desc[p:p + nt, 0] = KIND_PREFILL
+            desc[p:p + nt, 1] = r
+            desc[p:p + nt, 2] = np.arange(nt, dtype=np.int32) * bq
+            p += nt
+    n_dec = len(decode_rows)
+    dl[:n_dec] = decode_rows
+    starts = np.arange(0, n_dec, sb, dtype=np.int32)
+    ng = len(starts)
+    assert p + ng <= num_programs, "partition descriptor overflow"
+    desc[p:p + ng, 0] = KIND_DECODE
+    desc[p:p + ng, 1] = starts
+    desc[p:p + ng, 2] = np.minimum(sb, n_dec - starts)
+    return desc, dl
 
 
 def _kernel(
@@ -606,3 +734,557 @@ def ragged_paged_attention_pallas(
     if emit_state:
         return tuple(result)
     return result[0]
+
+
+# ---------------------------------------------------------------------------
+# Mixed-batch attention mega-kernel
+# ---------------------------------------------------------------------------
+
+
+def _mega_kernel(
+    # scalar prefetch
+    desc_ref,  # [P, 3] int32: (kind, a, b) — see module header
+    seq_info_ref,  # [R, 4] int32: q_start, q_len, kv_len, batch_row
+    dl_ref,  # [R] int32: seq_info rows of q_len == 1 sequences
+    kv_runs_ref,  # [G, 4] int32 page-write runs (fuse_write only)
+    layer_ref,  # [1] int32
+    block_tables_ref,  # [max_reqs, pages_per_req] int32
+    *refs,
+    sm_scale: float,
+    bq: int,
+    sb: int,
+    ppb: int,
+    page_size: int,
+    group: int,
+    emit_state: bool,
+    fuse_write: bool,
+):
+    """One program list, three program types (see the partition
+    descriptor contract in the module docstring). Prefill tiles run the
+    general flash loop at a FIXED bq; decode groups keep the SB
+    virtual-head batching even when prefill tiles share the wave; kv
+    writes (fused variant) land first so attention reads this step's
+    pages."""
+    if fuse_write:
+        (q_hbm, k_new, v_new, _k_in, _v_in,
+         out_hbm, k_cache, v_cache,
+         q_vmem, k_vmem, v_vmem, out_stage,
+         k_page, v_page, k_win, v_win,
+         q_sems, kv_sems, out_sems, w_sems) = refs
+        state_hbm = state_stage = state_sems = None
+    elif emit_state:
+        (q_hbm, k_cache, v_cache, out_hbm, state_hbm,
+         q_vmem, k_vmem, v_vmem, out_stage, state_stage,
+         q_sems, kv_sems, out_sems, state_sems) = refs
+    else:
+        (q_hbm, k_cache, v_cache, out_hbm,
+         q_vmem, k_vmem, v_vmem, out_stage,
+         q_sems, kv_sems, out_sems) = refs
+        state_hbm = state_stage = state_sems = None
+
+    p = pl.program_id(0)
+    kind = desc_ref[p, 0]
+    a = desc_ref[p, 1]
+    b = desc_ref[p, 2]
+    layer = layer_ref[0]
+    QH = q_vmem.shape[1]
+    KVH = k_vmem.shape[2]
+    D = q_vmem.shape[2]
+    blk = ppb * page_size
+    half = D // 2
+    nck = bq // 8  # 8-row IO chunks per prefill tile
+
+    if fuse_write:
+
+        @pl.when(kind == KIND_KV_WRITE)
+        def _kv_write():
+            # The page-RMW body shared with ops/pallas_kv_write.py
+            # (page-aligned 2*PS window + one-hot shift matmul). Runs
+            # precede every attention program in the descriptor, and the
+            # grid executes in order, so attention below reads the
+            # freshly written pages — through the aliased OUTPUT refs.
+            from vllm_distributed_tpu.ops.pallas_kv_write import page_rmw
+            run_len = kv_runs_ref[a, 3]
+
+            @pl.when(run_len > 0)
+            def _run():
+                page_rmw(kv_runs_ref[a, 0], kv_runs_ref[a, 1],
+                         kv_runs_ref[a, 2], run_len, layer, k_new,
+                         v_new, k_cache, v_cache, k_page, v_page, k_win,
+                         v_win, w_sems, page_size=page_size)
+
+    @pl.when(kind == KIND_PREFILL)
+    def _prefill():
+        r = a
+        tile_start = b
+        q_start = seq_info_ref[r, 0]
+        q_len = seq_info_ref[r, 1]
+        kv_len = seq_info_ref[r, 2]
+        row = seq_info_ref[r, 3]
+        n_valid = jnp.minimum(q_len - tile_start, bq)
+        q_pos_max = kv_len - q_len + tile_start + n_valid - 1
+        num_blocks = q_pos_max // blk + 1
+
+        # q tile read in 8-row chunks: chunks starting past q_len are
+        # skipped (their stale VMEM rows are masked out of the scores),
+        # so reads never pass q_start + q_len + 7 — inside the token
+        # array's Q_TILE_PAD padding even for the layout's last tile.
+        for c in range(nck):
+            @pl.when(tile_start + 8 * c < q_len)
+            def _rd(c=c):
+                pltpu.make_async_copy(
+                    q_hbm.at[pl.ds(q_start + tile_start + 8 * c, 8)],
+                    q_vmem.at[pl.ds(8 * c, 8)], q_sems.at[c]).start()
+
+        def fetch(bi, slot):
+            for i in range(ppb):
+                page_id = block_tables_ref[row, bi * ppb + i]
+                pltpu.make_async_copy(
+                    k_cache.at[layer, page_id],
+                    k_vmem.at[slot, 0, :, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[slot, 0, 0, i]).start()
+                pltpu.make_async_copy(
+                    v_cache.at[layer, page_id],
+                    v_vmem.at[slot, 0, :, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[slot, 1, 0, i]).start()
+
+        fetch(0, 0)  # overlaps the q chunk DMAs in flight
+        for c in range(nck):
+            @pl.when(tile_start + 8 * c < q_len)
+            def _rdw(c=c):
+                pltpu.make_async_copy(
+                    q_hbm.at[pl.ds(0, 8)], q_vmem.at[pl.ds(8 * c, 8)],
+                    q_sems.at[c]).wait()
+
+        q_tile = q_vmem[...][:bq].astype(jnp.float32) * sm_scale
+        q_heads = [
+            q_tile[:, h * group:(h + 1) * group, :].reshape(
+                bq * group, D) for h in range(KVH)
+        ]
+        rows = bq * group
+        row_pos = (kv_len - q_len + tile_start +
+                   jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0) //
+                   group)
+        col_base = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+        row_valid = (jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0) //
+                     group + tile_start) < q_len
+
+        def body(bi, carry):
+            ms, ls, accs = carry
+            kv_start = bi * blk
+            slot = jax.lax.rem(bi, 2)
+
+            @pl.when(bi + 1 < num_blocks)
+            def _prefetch():
+                fetch(bi + 1, jax.lax.rem(bi + 1, 2))
+
+            for i in range(ppb):
+                pltpu.make_async_copy(
+                    k_cache.at[0, 0],
+                    k_vmem.at[slot, 0, :, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[slot, 0, 0, i]).wait()
+                pltpu.make_async_copy(
+                    v_cache.at[0, 0],
+                    v_vmem.at[slot, 0, :, pl.ds(i * page_size, page_size)],
+                    kv_sems.at[slot, 1, 0, i]).wait()
+            k_blk = k_vmem[slot, 0]  # [KVH, BLK, D]
+            v_blk = v_vmem[slot, 0]
+            kv_pos = kv_start + col_base
+            mask = jnp.logical_and(kv_pos <= row_pos, row_valid)
+            new_ms, new_ls, new_accs = [], [], []
+            for h in range(KVH):
+                s = jax.lax.dot_general(
+                    q_heads[h], k_blk[h].astype(jnp.float32),
+                    dimension_numbers=(((1, ), (1, )), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s = jnp.where(mask, s, _MASK_VALUE)
+                m_prev, l_prev, acc_prev = ms[h], ls[h], accs[h]
+                m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+                pr = jnp.exp(s - m_new)
+                alpha = jnp.exp(m_prev - m_new)
+                l_new = l_prev * alpha + pr.sum(axis=-1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    pr.astype(v_blk.dtype), v_blk[h],
+                    dimension_numbers=(((1, ), (0, )), ((), ())),
+                    preferred_element_type=jnp.float32)
+                new_ms.append(m_new)
+                new_ls.append(l_new)
+                new_accs.append(acc_prev * alpha + pv)
+            return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+        init = (
+            tuple(jnp.full((rows, 1), _MASK_VALUE, jnp.float32)
+                  for _ in range(KVH)),
+            tuple(jnp.zeros((rows, 1), jnp.float32) for _ in range(KVH)),
+            tuple(jnp.zeros((rows, D), jnp.float32) for _ in range(KVH)),
+        )
+        ms, ls, accs = jax.lax.fori_loop(0, num_blocks, body, init)
+
+        for h in range(KVH):
+            o_h = accs[h] / jnp.maximum(ls[h], 1e-20)
+            out_stage[0:bq, h * group:(h + 1) * group, :] = (
+                o_h.reshape(bq, group, D).astype(out_stage.dtype))
+            if emit_state:
+                st = jnp.concatenate([
+                    jnp.broadcast_to(ms[h], (rows, half)),
+                    jnp.broadcast_to(ls[h], (rows, D - half)),
+                ], axis=-1)
+                state_stage[0:bq, h * group:(h + 1) * group, :] = (
+                    st.reshape(bq, group, D))
+
+        # EXACT writeback: full 8-row chunks, then a per-row tail for
+        # the partial chunk — a tile never writes a row it does not own,
+        # so program order between attention programs is irrelevant and
+        # the token array needs no bq-sized spill padding.
+        def flush(stage, hbm, sems):
+            for c in range(nck):
+                @pl.when(8 * (c + 1) <= n_valid)
+                def _wc(c=c):
+                    pltpu.make_async_copy(
+                        stage.at[pl.ds(8 * c, 8)],
+                        hbm.at[pl.ds(q_start + tile_start + 8 * c, 8)],
+                        sems.at[c]).start()
+            for rr in range(bq):
+                @pl.when(jnp.logical_and(rr // 8 == n_valid // 8,
+                                         rr < n_valid))
+                def _wr(rr=rr):
+                    pltpu.make_async_copy(
+                        stage.at[pl.ds(rr, 1)],
+                        hbm.at[pl.ds(q_start + tile_start + rr, 1)],
+                        sems.at[rr]).start()
+            for c in range(nck):
+                @pl.when(8 * (c + 1) <= n_valid)
+                def _wcw(c=c):
+                    pltpu.make_async_copy(
+                        stage.at[pl.ds(8 * c, 8)],
+                        hbm.at[pl.ds(0, 8)], sems.at[c]).wait()
+            for rr in range(bq):
+                @pl.when(jnp.logical_and(rr // 8 == n_valid // 8,
+                                         rr < n_valid))
+                def _wrw(rr=rr):
+                    pltpu.make_async_copy(
+                        stage.at[pl.ds(rr, 1)],
+                        hbm.at[pl.ds(0, 1)], sems.at[rr]).wait()
+
+        flush(out_stage, out_hbm, out_sems)
+        if emit_state:
+            flush(state_stage, state_hbm, state_sems)
+
+    @pl.when(kind == KIND_DECODE)
+    def _decode():
+        # SB-batched decode (see _decode_kernel): the group's sequences
+        # x kv heads stack as virtual heads; ONE dot scores every
+        # sequence, a block-diagonal mask recovers per-sequence
+        # attention. Slots address sequences through decode_list, so
+        # decode rows keep MXU-filling batching in mixed waves.
+        cnt = b
+        R_dl = dl_ref.shape[0]
+        idx = [dl_ref[jnp.minimum(a + i, R_dl - 1)] for i in range(sb)]
+        kv_lens = [
+            jnp.where(jnp.asarray(i) < cnt, seq_info_ref[idx[i], 2], 0)
+            for i in range(sb)
+        ]
+        rows_ = [seq_info_ref[idx[i], 3] for i in range(sb)]
+        q_starts = [seq_info_ref[idx[i], 0] for i in range(sb)]
+        max_kv = kv_lens[0]
+        for i in range(1, sb):
+            max_kv = jnp.maximum(max_kv, kv_lens[i])
+        num_blocks = jax.lax.div(max_kv - 1, blk) + 1
+        ROWS = sb * QH
+        C = sb * KVH * blk
+
+        for i in range(sb):
+            pltpu.make_async_copy(
+                q_hbm.at[pl.ds(q_starts[i], 1)],
+                q_vmem.at[pl.ds(i, 1)], q_sems.at[i]).start()
+
+        def fetch(bi, slot):
+            for i in range(sb):
+                ci = jnp.clip(bi, 0,
+                              jnp.maximum(
+                                  jax.lax.div(kv_lens[i] - 1, blk), 0))
+                for j in range(ppb):
+                    page_id = block_tables_ref[rows_[i], ci * ppb + j]
+                    pltpu.make_async_copy(
+                        k_cache.at[layer, page_id],
+                        k_vmem.at[slot, i, :,
+                                  pl.ds(j * page_size, page_size)],
+                        kv_sems.at[slot, 0, i, j]).start()
+                    pltpu.make_async_copy(
+                        v_cache.at[layer, page_id],
+                        v_vmem.at[slot, i, :,
+                                  pl.ds(j * page_size, page_size)],
+                        kv_sems.at[slot, 1, i, j]).start()
+
+        fetch(0, 0)
+        for i in range(sb):
+            pltpu.make_async_copy(
+                q_hbm.at[pl.ds(0, 1)], q_vmem.at[pl.ds(i, 1)],
+                q_sems.at[i]).wait()
+        q_all = (q_vmem[...][:sb].astype(jnp.float32) *
+                 sm_scale).reshape(ROWS, D)
+
+        vh_r = jax.lax.broadcasted_iota(jnp.int32, (ROWS, C), 0) // group
+        vh_c = jax.lax.broadcasted_iota(jnp.int32, (ROWS, C), 1) // blk
+        diag = vh_r == vh_c
+        col_off = jax.lax.broadcasted_iota(jnp.int32, (ROWS, C), 1) % blk
+        kvlen_rows = jnp.concatenate(
+            [jnp.full((QH, ), kv_lens[i], jnp.int32) for i in range(sb)])
+
+        def body(bi, carry):
+            m_prev, l_prev, acc_prev = carry
+            slot = jax.lax.rem(bi, 2)
+
+            @pl.when(bi + 1 < num_blocks)
+            def _prefetch():
+                fetch(bi + 1, jax.lax.rem(bi + 1, 2))
+
+            for i in range(sb):
+                for j in range(ppb):
+                    pltpu.make_async_copy(
+                        k_cache.at[0, 0],
+                        k_vmem.at[slot, i, :,
+                                  pl.ds(j * page_size, page_size)],
+                        kv_sems.at[slot, 0, i, j]).wait()
+                    pltpu.make_async_copy(
+                        v_cache.at[0, 0],
+                        v_vmem.at[slot, i, :,
+                                  pl.ds(j * page_size, page_size)],
+                        kv_sems.at[slot, 1, i, j]).wait()
+            k_all = k_vmem[slot].reshape(C, D)
+            v_all = v_vmem[slot].reshape(C, D)
+            s = jax.lax.dot_general(
+                q_all, k_all.astype(jnp.float32),
+                dimension_numbers=(((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32)
+            mask = jnp.logical_and(
+                diag, bi * blk + col_off < kvlen_rows[:, None])
+            s = jnp.where(mask, s, _MASK_VALUE)
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            pr = jnp.exp(s - m_new)
+            pr = jnp.where(mask, pr, 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + pr.sum(axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                pr.astype(v_all.dtype), v_all,
+                dimension_numbers=(((1, ), (0, )), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_prev * alpha + pv
+
+        init = (
+            jnp.full((ROWS, 1), _MASK_VALUE, jnp.float32),
+            jnp.zeros((ROWS, 1), jnp.float32),
+            jnp.zeros((ROWS, D), jnp.float32),
+        )
+        m_fin, l_fin, acc = jax.lax.fori_loop(0, num_blocks, body, init)
+        out = acc / jnp.maximum(l_fin, 1e-20)
+        out_stage[0:sb, :, :] = out.reshape(sb, QH, D).astype(
+            out_stage.dtype)
+        if emit_state:
+            st = jnp.concatenate([
+                jnp.broadcast_to(m_fin, (ROWS, half)),
+                jnp.broadcast_to(l_fin, (ROWS, D - half)),
+            ], axis=-1)
+            state_stage[0:sb, :, :] = st.reshape(sb, QH, D)
+        # Per-sequence writeback through q_start; inactive slots MUST
+        # NOT write (their q_start aliases a real token's row).
+        for i in range(sb):
+            @pl.when(jnp.asarray(i) < cnt)
+            def _wb(i=i):
+                pltpu.make_async_copy(
+                    out_stage.at[pl.ds(i, 1)],
+                    out_hbm.at[pl.ds(q_starts[i], 1)],
+                    out_sems.at[i]).start()
+                if emit_state:
+                    pltpu.make_async_copy(
+                        state_stage.at[pl.ds(i, 1)],
+                        state_hbm.at[pl.ds(q_starts[i], 1)],
+                        state_sems.at[i]).start()
+        for i in range(sb):
+            @pl.when(jnp.asarray(i) < cnt)
+            def _wbw(i=i):
+                pltpu.make_async_copy(
+                    out_stage.at[pl.ds(i, 1)],
+                    out_hbm.at[pl.ds(0, 1)], out_sems.at[i]).wait()
+                if emit_state:
+                    pltpu.make_async_copy(
+                        state_stage.at[pl.ds(i, 1)],
+                        state_hbm.at[pl.ds(0, 1)], state_sems.at[i]).wait()
+
+
+def _mega_call(q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
+               block_tables, layer, k_new_hl, v_new_hl, *, sm_scale, bq,
+               sb, interpret, emit_state, fuse_write):
+    """Shared launcher for the attention-only and fused write+attend
+    variants of the mega-kernel."""
+    T_pad, num_q_heads, head_dim = q.shape
+    _, _, num_kv_heads, page_size, _ = k_pages.shape
+    assert num_q_heads % num_kv_heads == 0
+    assert bq % 8 == 0 and bq >= 8
+    group = num_q_heads // num_kv_heads
+    pages_per_req = block_tables.shape[1]
+    ppb = max(1, min(128 // page_size, pages_per_req))
+    while pages_per_req % ppb:
+        ppb -= 1
+    blk = ppb * page_size
+    stage_rows = max(bq, sb)
+
+    kernel = functools.partial(
+        _mega_kernel, sm_scale=sm_scale, bq=bq, sb=sb, ppb=ppb,
+        page_size=page_size, group=group, emit_state=emit_state,
+        fuse_write=fuse_write)
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]  # q
+    operands = [q]
+    if fuse_write:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [k_new_hl, v_new_hl]
+    in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+    operands += [k_pages, v_pages]
+
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]
+    aliases = {}
+    if fuse_write:
+        out_shape += [
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        # Flat operand indices: 6 scalar-prefetch args, then q, k_new,
+        # v_new, k_pages (9), v_pages (10) alias outputs 1 and 2.
+        aliases = {9: 1, 10: 2}
+    if emit_state:
+        out_shape.append(jax.ShapeDtypeStruct(q.shape, jnp.float32))
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+
+    scratch = [
+        pltpu.VMEM((stage_rows, num_q_heads, head_dim), q.dtype),
+        pltpu.VMEM((2, sb, num_kv_heads, blk, head_dim), k_pages.dtype),
+        pltpu.VMEM((2, sb, num_kv_heads, blk, head_dim), v_pages.dtype),
+        pltpu.VMEM((stage_rows, num_q_heads, head_dim), q.dtype),
+    ]
+    if emit_state:
+        scratch.append(
+            pltpu.VMEM((stage_rows, num_q_heads, head_dim), jnp.float32))
+    if fuse_write:
+        scratch += [
+            pltpu.VMEM((num_kv_heads, page_size, head_dim),
+                       k_pages.dtype),
+            pltpu.VMEM((num_kv_heads, page_size, head_dim),
+                       v_pages.dtype),
+            pltpu.VMEM((num_kv_heads, 2 * page_size, head_dim),
+                       k_pages.dtype),
+            pltpu.VMEM((num_kv_heads, 2 * page_size, head_dim),
+                       v_pages.dtype),
+        ]
+    scratch += [
+        pltpu.SemaphoreType.DMA((max(sb, bq // 8), )),  # q reads
+        pltpu.SemaphoreType.DMA((2, 2, sb, ppb)),  # kv double buffer
+        pltpu.SemaphoreType.DMA((stage_rows, )),  # out flush
+    ]
+    if emit_state:
+        scratch.append(pltpu.SemaphoreType.DMA((stage_rows, )))
+    if fuse_write:
+        scratch.append(pltpu.SemaphoreType.DMA((4, )))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(desc.shape[0], ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    result = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(desc, seq_info, decode_list, kv_runs, layer, block_tables,
+      *operands)
+    return tuple(result)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "bq", "sb", "interpret", "emit_state"))
+def unified_ragged_paged_attention_pallas(
+    q: jax.Array,  # [T_pad, QH, D]; T_pad >= T + Q_TILE_PAD
+    k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] stacked cache
+    v_pages: jax.Array,
+    desc: jax.Array,  # [P, 3] int32 partition descriptor
+    seq_info: jax.Array,  # [R, 4] int32 (q_start, q_len, kv_len, row)
+    decode_list: jax.Array,  # [R] int32
+    block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
+    layer: jax.Array | None = None,  # [1] int32
+    *,
+    sm_scale: float,
+    bq: int,
+    sb: int,
+    interpret: bool | None = None,
+    emit_state: bool = False,
+):
+    """Mixed-batch attention in ONE kernel call, partitioned by ``desc``
+    (see the module docstring for the descriptor contract). No static
+    depends on the batch composition: ``bq``/``sb`` are fixed per model
+    (prefill_tile_size / decode_group_size), so the compile lattice is
+    one kernel x |T| input shapes. Rows the descriptor does not cover
+    (padding tokens) are left unwritten — callers mask them.
+
+    ``emit_state=True`` additionally returns the online-softmax partial
+    state as an f32 [T_pad, QH, D] array (row max broadcast over lanes
+    [0, D/2), exp-sum over [D/2, D)) for exact cascade merging, from
+    BOTH prefill tiles and decode groups."""
+    if interpret is None:
+        interpret = envs.VDT_PALLAS_INTERPRET
+    if k_pages.ndim == 4:
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+    if layer is None:
+        layer = jnp.zeros((1, ), jnp.int32)
+    result = _mega_call(
+        q, k_pages, v_pages, desc, seq_info, decode_list,
+        jnp.zeros((1, 4), jnp.int32), block_tables, layer, None, None,
+        sm_scale=sm_scale, bq=bq, sb=sb, interpret=interpret,
+        emit_state=emit_state, fuse_write=False)
+    if emit_state:
+        return result  # (out, state)
+    return result[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "bq", "sb", "interpret"))
+def unified_write_attend_pallas(
+    q: jax.Array,  # [T_pad, QH, D]
+    k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] (aliased in place)
+    v_pages: jax.Array,
+    k_new_hl: jax.Array,  # [KVH, T_pad + 3*PS, D] head-leading, padded
+    v_new_hl: jax.Array,
+    desc: jax.Array,  # [P, 3] with kind-3 kv-write rows FIRST
+    seq_info: jax.Array,
+    decode_list: jax.Array,
+    kv_runs: jax.Array,  # [G, 4] int32 (page, off, window_start, len)
+    block_tables: jax.Array,
+    layer: jax.Array,  # [1] int32
+    *,
+    sm_scale: float,
+    bq: int,
+    sb: int,
+    interpret: bool | None = None,
+):
+    """Fused KV-page write + mixed-batch attention: ONE pass over the
+    cache per layer. The descriptor's kind-3 programs land the step's
+    new K/V pages in place (input/output aliasing), and because the TPU
+    grid executes programs in order, every attention program reads the
+    freshly written pages. Returns (out, k_pages, v_pages)."""
+    if interpret is None:
+        interpret = envs.VDT_PALLAS_INTERPRET
+    if layer is None:
+        layer = jnp.zeros((1, ), jnp.int32)
+    out, k2, v2 = _mega_call(
+        q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
+        block_tables, layer, k_new_hl, v_new_hl, sm_scale=sm_scale,
+        bq=bq, sb=sb, interpret=interpret, emit_state=False,
+        fuse_write=True)
+    return out, k2, v2

@@ -35,6 +35,89 @@ from jax.experimental.pallas import tpu as pltpu
 from vllm_distributed_tpu import envs
 
 
+def page_rmw(page, off_start, window_start, run_len, layer, k_new, v_new,
+             k_dst, v_dst, k_page, v_page, k_win, v_win, sems, *,
+             page_size: int):
+    """Read-modify-write ONE cache page with a run of new K/V rows —
+    the body shared by the standalone write kernel below and the
+    attention mega-kernel's fused kind-3 programs
+    (ops/pallas_attention.py). Traced scalars + refs in, DMAs out; the
+    caller guards activity (run_len > 0) with pl.when."""
+    full = run_len == page_size
+    # Mosaic requires provably tile-aligned starts when slicing the
+    # sublane dim of an HBM ref: fetch a page-aligned 2*PS window and
+    # shift to the exact rows in-register below.
+    aligned = pl.multiple_of(
+        (window_start // page_size) * page_size, page_size)
+    shift = window_start - aligned
+    kw = pltpu.make_async_copy(
+        k_new.at[:, pl.ds(aligned, 2 * page_size)], k_win, sems.at[0])
+    vw = pltpu.make_async_copy(
+        v_new.at[:, pl.ds(aligned, 2 * page_size)], v_win, sems.at[1])
+    kw.start()
+    vw.start()
+
+    @pl.when(jnp.logical_not(full))
+    def _read_page():
+        kp = pltpu.make_async_copy(k_dst.at[layer, page], k_page,
+                                   sems.at[2])
+        vp = pltpu.make_async_copy(v_dst.at[layer, page], v_page,
+                                   sems.at[3])
+        kp.start()
+        vp.start()
+        kp.wait()
+        vp.wait()
+
+    kw.wait()
+    vw.wait()
+
+    # Shift the 2*PS window down by `shift` rows via a one-hot
+    # selection matmul (Mosaic has no dynamic_slice on values; the
+    # 0/1 matrix keeps the selection exact in any dtype).
+    num_kv_heads = k_page.shape[0]
+    w_ids = jax.lax.broadcasted_iota(jnp.int32,
+                                     (page_size, 2 * page_size), 1)
+    p_ids = jax.lax.broadcasted_iota(jnp.int32,
+                                     (page_size, 2 * page_size), 0)
+    sel = (w_ids == p_ids + shift).astype(jnp.float32)
+
+    # Window rows outside the run hold neighbouring flat-batch tokens
+    # (or padding garbage, possibly NaN/Inf): zero them before the
+    # selection matmul — 0 * NaN = NaN would otherwise poison every
+    # selected row of the page.
+    w_row = jax.lax.broadcasted_iota(jnp.int32, (2 * page_size, 1), 0)
+    w_valid = jnp.logical_and(w_row >= shift + off_start,
+                              w_row < shift + off_start + run_len)
+
+    def shifted(win_ref):
+        return jnp.stack([
+            jax.lax.dot(sel,
+                        jnp.where(w_valid,
+                                  win_ref[h].astype(jnp.float32), 0.0),
+                        preferred_element_type=jnp.float32)
+            for h in range(num_kv_heads)
+        ]).astype(k_page.dtype)
+
+    k_rows = shifted(k_win)
+    v_rows = shifted(v_win)
+    row = jax.lax.broadcasted_iota(jnp.int32,
+                                   (1, page_size, 1), 1)
+    mask = jnp.logical_and(row >= off_start,
+                           row < off_start + run_len)
+    mask = jnp.logical_or(full, mask)
+    k_page[...] = jnp.where(mask, k_rows, k_page[...])
+    v_page[...] = jnp.where(mask, v_rows, v_page[...])
+
+    kb = pltpu.make_async_copy(k_page, k_dst.at[layer, page],
+                               sems.at[2])
+    vb = pltpu.make_async_copy(v_page, v_dst.at[layer, page],
+                               sems.at[3])
+    kb.start()
+    vb.start()
+    kb.wait()
+    vb.wait()
+
+
 def _kernel(
     # scalar prefetch
     runs_ref,  # [G, 4] int32: page, off_start, window_start, run_len
@@ -64,82 +147,12 @@ def _kernel(
     run_len = runs_ref[g, 3]
     layer = layer_ref[0]
     active = jnp.logical_and(g < num_runs_ref[0], run_len > 0)
-    full = run_len == page_size
 
     @pl.when(active)
     def _run():
-        # Mosaic requires provably tile-aligned starts when slicing the
-        # sublane dim of an HBM ref: fetch a page-aligned 2*PS window and
-        # shift to the exact rows in-register below.
-        aligned = pl.multiple_of(
-            (window_start // page_size) * page_size, page_size)
-        shift = window_start - aligned
-        kw = pltpu.make_async_copy(
-            k_new.at[:, pl.ds(aligned, 2 * page_size)], k_win, sems.at[0])
-        vw = pltpu.make_async_copy(
-            v_new.at[:, pl.ds(aligned, 2 * page_size)], v_win, sems.at[1])
-        kw.start()
-        vw.start()
-
-        @pl.when(jnp.logical_not(full))
-        def _read_page():
-            kp = pltpu.make_async_copy(k_out.at[layer, page], k_page,
-                                       sems.at[2])
-            vp = pltpu.make_async_copy(v_out.at[layer, page], v_page,
-                                       sems.at[3])
-            kp.start()
-            vp.start()
-            kp.wait()
-            vp.wait()
-
-        kw.wait()
-        vw.wait()
-
-        # Shift the 2*PS window down by `shift` rows via a one-hot
-        # selection matmul (Mosaic has no dynamic_slice on values; the
-        # 0/1 matrix keeps the selection exact in any dtype).
-        num_kv_heads = k_page.shape[0]
-        w_ids = jax.lax.broadcasted_iota(jnp.int32,
-                                         (page_size, 2 * page_size), 1)
-        p_ids = jax.lax.broadcasted_iota(jnp.int32,
-                                         (page_size, 2 * page_size), 0)
-        sel = (w_ids == p_ids + shift).astype(jnp.float32)
-
-        # Window rows outside the run hold neighbouring flat-batch tokens
-        # (or padding garbage, possibly NaN/Inf): zero them before the
-        # selection matmul — 0 * NaN = NaN would otherwise poison every
-        # selected row of the page.
-        w_row = jax.lax.broadcasted_iota(jnp.int32, (2 * page_size, 1), 0)
-        w_valid = jnp.logical_and(w_row >= shift + off_start,
-                                  w_row < shift + off_start + run_len)
-
-        def shifted(win_ref):
-            return jnp.stack([
-                jax.lax.dot(sel,
-                            jnp.where(w_valid,
-                                      win_ref[h].astype(jnp.float32), 0.0),
-                            preferred_element_type=jnp.float32)
-                for h in range(num_kv_heads)
-            ]).astype(k_page.dtype)
-
-        k_rows = shifted(k_win)
-        v_rows = shifted(v_win)
-        row = jax.lax.broadcasted_iota(jnp.int32,
-                                       (1, page_size, 1), 1)
-        mask = jnp.logical_and(row >= off_start,
-                               row < off_start + run_len)
-        mask = jnp.logical_or(full, mask)
-        k_page[...] = jnp.where(mask, k_rows, k_page[...])
-        v_page[...] = jnp.where(mask, v_rows, v_page[...])
-
-        kb = pltpu.make_async_copy(k_page, k_out.at[layer, page],
-                                   sems.at[2])
-        vb = pltpu.make_async_copy(v_page, v_out.at[layer, page],
-                                   sems.at[3])
-        kb.start()
-        vb.start()
-        kb.wait()
-        vb.wait()
+        page_rmw(page, off_start, window_start, run_len, layer, k_new,
+                 v_new, k_out, v_out, k_page, v_page, k_win, v_win,
+                 sems, page_size=page_size)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", ))

@@ -222,6 +222,20 @@ class TPUModelRunner:
         # tpu_model_runner.py:318 _update_num_xla_graphs).
         self._compiled_shapes: set[tuple] = set()
         self._precompiled = False
+        # Mega-kernel partition parameters: resolved once the model is
+        # loaded (None until then). _unified gates the collapsed compile
+        # lattice + descriptor batches; (bq, sb) are the fixed
+        # prefill-tile / decode-group sizes shared by the host
+        # descriptor builder and the kernel.
+        self._unified: Optional[bool] = None
+        self._tile_params_memo: Optional[tuple[int, int]] = None
+        self._xla_route_memo: Optional[bool] = None
+        # Kernel-dispatch observability: one count per step per kernel
+        # family (unified|decode|general|cascade|naive) behind
+        # vdt:attn_kernel_calls_total, plus the warmed-graph count
+        # behind vdt:precompile_graphs_total.
+        self.attn_kernel_calls: dict[str, int] = {}
+        self.precompile_graphs = 0
 
     # ------------------------------------------------------------------
     def load_model(self) -> None:
@@ -588,18 +602,63 @@ class TPUModelRunner:
                         new_req.lora_request["path"], self)
         self.input_batch.update_cached(scheduler_output.scheduled_cached_reqs)
 
+    def _use_unified(self) -> bool:
+        """Mega-kernel (partition-descriptor) batches: on for every
+        model with the standard K/V page layout. MLA models (latent
+        cache, own kernel keyed by max_q) keep the legacy composition-
+        split shapes."""
+        if self._unified is None:
+            if self.model is None:
+                return False  # don't memoize before the model exists
+            self._unified = "k" in self.model.kv_cache_specs()
+        return self._unified
+
+    def _tile_params(self) -> tuple[int, int]:
+        """The fixed (prefill tile rows, decode group width) of the
+        mega-kernel, computed from LOCAL head counts (the kernel runs
+        per-shard under tensor parallelism) and the storage head dim.
+        The same values ride the batch as statics so the host-built
+        descriptor and the kernel can never disagree."""
+        if self._tile_params_memo is None:
+            from vllm_distributed_tpu.ops.attention import \
+                storage_head_dim
+            from vllm_distributed_tpu.ops.pallas_attention import (
+                decode_group_size, prefill_tile_size)
+            cfg = self.model.cfg
+            tp = max(1, self.config.parallel_config.tensor_parallel_size)
+            qh = max(1, cfg.num_q_heads // tp)
+            kvh = max(1, getattr(cfg, "total_kv_heads",
+                                 cfg.num_kv_heads) // tp)
+            hd = storage_head_dim(cfg.head_dim)
+            self._tile_params_memo = (prefill_tile_size(qh, hd),
+                                      decode_group_size(qh, kvh))
+        return self._tile_params_memo
+
     def _batch_shape(self, total_tokens: int,
                      max_sched: int) -> tuple[int, int, int]:
-        """Static (T, max_q, G) for a step. ``max_q`` (the per-sequence
-        query bucket of the attention kernel) is 1 for pure decode, else
-        the token bucket itself — the kernel's grid skips tiles past each
-        sequence's q_len at negligible cost, and tying max_q to T keeps
-        the compile lattice one-dimensional. G (KV-write run bucket) is a
-        deterministic function of T for the same reason."""
+        """Static (T, max_q, G) for a step.
+
+        Unified (mega-kernel) models: the batch composition is carried
+        by the partition descriptor, NOT by any static — ``max_q`` is
+        pinned to 1 and T = t_bucket + Q_TILE_PAD for every mix of
+        prefill and decode, so the forward lattice is exactly one graph
+        per token bucket (decode buckets coincide with small token
+        buckets and dedupe away).
+
+        Legacy (MLA) models: ``max_q`` is 1 for pure decode, else the
+        token bucket (the kernel grid skips tiles past each sequence's
+        q_len), splitting each bucket into a decode and a prefill
+        variant. G (KV-write run bucket) is a deterministic function of
+        T in both modes."""
         t_bucket = pad_to_bucket(total_tokens, self.token_buckets)
-        max_q = 1 if max_sched <= 1 else t_bucket
-        q_tile = min(max_q, 128)
-        T = t_bucket + q_tile
+        if self._use_unified():
+            from vllm_distributed_tpu.ops.pallas_attention import \
+                Q_TILE_PAD
+            max_q = 1
+            T = t_bucket + Q_TILE_PAD
+        else:
+            max_q = 1 if max_sched <= 1 else t_bucket
+            T = t_bucket + min(max_q, 128)
         G = pad_to_bucket(cdiv(T, self.page_size) + self.max_num_reqs,
                           self.kv_run_buckets)
         return T, max_q, G
@@ -822,6 +881,35 @@ class TPUModelRunner:
                 kv_runs_arr[:len(kv_runs)] = kv_runs
             n_kv_runs = len(kv_runs)
 
+        # Mega-kernel partition descriptor: kv-write rows first (the
+        # fused write+attend pass needs them to precede every attention
+        # program), then prefill q-tiles and SB decode groups. The fast
+        # decode path feeds its row vector directly (no q_len scan).
+        attn_desc = decode_list_arr = None
+        bq = sb = 0
+        if self._use_unified():
+            from vllm_distributed_tpu.ops.pallas_attention import (
+                Q_TILE_PAD, build_partition_descriptor,
+                num_partition_programs)
+            bq, sb = self._tile_params()
+            P_desc = num_partition_programs(
+                T - Q_TILE_PAD, self.max_num_reqs, bq=bq, sb=sb,
+                num_kv_writes=G)
+            desc_np, dl_np = build_partition_descriptor(
+                seq_info, num_runs, bq=bq, sb=sb,
+                num_programs=P_desc, num_kv_writes=n_kv_runs,
+                decode_rows=(np.arange(num_runs, dtype=np.int32)
+                             if fast is not None else None))
+            attn_desc = jnp.asarray(desc_np)
+            decode_list_arr = jnp.asarray(dl_np)
+            if K > 1:
+                tk_desc = np.zeros((K, P_desc, 3), np.int32)
+                tk_dl = np.zeros((K, self.max_num_reqs), np.int32)
+                for kk in range(K):
+                    tk_desc[kk], tk_dl[kk] = build_partition_descriptor(
+                        tk_seq_info[kk], int(tk_num_seqs[kk, 0]),
+                        bq=bq, sb=sb, num_programs=P_desc)
+
         S1 = self.spec_k + 1  # sampled positions per sampling request
         R = pad_to_bucket(max(len(sampling_rows), 1), self.req_buckets)
         rows = np.asarray(sampling_rows +
@@ -929,6 +1017,10 @@ class TPUModelRunner:
                 num_seqs=jnp.asarray(tk_num_seqs),
                 kv_runs=jnp.asarray(tk_kv_runs),
                 num_kv_runs=jnp.asarray(tk_num_kv_runs),
+                desc=(jnp.asarray(tk_desc) if attn_desc is not None
+                      else None),
+                decode_list=(jnp.asarray(tk_dl)
+                             if attn_desc is not None else None),
             )
         cascade_ids = self._detect_cascade(scheduler_output)
         lora_ctx = None
@@ -1007,7 +1099,11 @@ class TPUModelRunner:
             mm_mask=mm_mask,
             mrope_positions=(jnp.asarray(mrope_np)
                              if mrope_np is not None else None),
+            attn_desc=attn_desc,
+            decode_list=decode_list_arr,
             max_q=max_q,
+            attn_bq=bq,
+            attn_sb=sb,
         )
         plp = None
         if plp_rows:
@@ -1179,6 +1275,7 @@ class TPUModelRunner:
          fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
          plp, chain) = self._prepare_inputs(scheduler_output)
         self.prepare_inputs_hist.observe(time.perf_counter() - t_prep)
+        self._count_attn_dispatch(self._attn_kernel_label(batch))
         drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
         if chain is not None:
             # Async run-ahead rows: substitute the previous dispatch's
@@ -1663,6 +1760,14 @@ class TPUModelRunner:
             self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         """Run scheduler_output.multi_step fused decode steps (pure-decode
         batch; one host roundtrip for the whole burst)."""
+        from vllm_distributed_tpu.ops.attention import \
+            resolve_attention_backend
+        # The burst's in-jit batches carry no partition descriptor, so
+        # they ride the legacy SB decode kernel on the Pallas backend.
+        self._count_attn_dispatch(
+            "decode" if (resolve_attention_backend() == "pallas"
+                         and not self._model_routes_xla())
+            else "naive")
         ib = self.input_batch
         n_steps = scheduler_output.multi_step
         req_ids = list(scheduler_output.num_scheduled_tokens)
@@ -1726,6 +1831,46 @@ class TPUModelRunner:
         return out
 
     # ------------------------------------------------------------------
+    def _model_routes_xla(self) -> bool:
+        """True when the model carries a feature the Pallas kernels do
+        not (sliding window / logit softcap / ALiBi / sinks / fp8 KV):
+        paged_attention then takes the XLA reference path regardless of
+        backend and descriptor, and the kernel-calls metric must say so
+        rather than report a mega-kernel that never ran."""
+        if getattr(self, "_xla_route_memo", None) is None:
+            cfg = self.model.cfg if self.model is not None else None
+            if cfg is None:
+                return False  # don't memoize before the model exists
+            self._xla_route_memo = bool(
+                getattr(cfg, "sliding_window", None)
+                or getattr(cfg, "attn_logit_softcap", 0)
+                or getattr(cfg, "alibi", False)
+                or getattr(cfg, "attn_sinks", False)
+                or "fp8" in str(
+                    self.config.cache_config.cache_dtype).lower())
+        return self._xla_route_memo
+
+    def _attn_kernel_label(self, batch) -> str:
+        """Which attention kernel family this step's batch dispatches to
+        (mirrors the ops/attention.py routing, including the feature
+        gates that force the XLA path): the vdt:attn_kernel_calls_total
+        {kernel} observability for the dispatch layer."""
+        from vllm_distributed_tpu.ops.attention import \
+            resolve_attention_backend
+        if (resolve_attention_backend() != "pallas"
+                or self._model_routes_xla()):
+            return "naive"
+        if getattr(batch, "cascade_shared_ids", None) is not None:
+            return "cascade"
+        if getattr(batch, "attn_desc", None) is not None:
+            return "unified"
+        return "decode" if batch.max_q == 1 else "general"
+
+    def _count_attn_dispatch(self, label: str) -> None:
+        self.attn_kernel_calls[label] = (
+            self.attn_kernel_calls.get(label, 0) + 1)
+
+    # ------------------------------------------------------------------
     @contextmanager
     def _compile_watch(self, key: tuple):
         """Track/log compilations; after precompile() has run, any new
@@ -1766,8 +1911,21 @@ class TPUModelRunner:
 
     def _dummy_step_inputs(self, T: int, max_q: int, G: int):
         """Inert inputs for one forward at shape (T, max_q, G): padding
-        slots (-1) and zero run/seq counts make every write a no-op."""
+        slots (-1) and zero run/seq counts make every write a no-op (an
+        all-noop partition descriptor likewise runs zero programs)."""
         K = self.tknp_size
+        attn_desc = decode_list = None
+        bq = sb = 0
+        P_desc = 0
+        if self._use_unified():
+            from vllm_distributed_tpu.ops.pallas_attention import (
+                Q_TILE_PAD, num_partition_programs)
+            bq, sb = self._tile_params()
+            P_desc = num_partition_programs(
+                T - Q_TILE_PAD, self.max_num_reqs, bq=bq, sb=sb,
+                num_kv_writes=G)
+            attn_desc = jnp.zeros((P_desc, 3), jnp.int32)
+            decode_list = jnp.zeros((self.max_num_reqs, ), jnp.int32)
         tknp = None
         if K > 1:
             tknp = TknpAttentionBatch(
@@ -1779,6 +1937,11 @@ class TPUModelRunner:
                 num_seqs=jnp.zeros((K, 1), jnp.int32),
                 kv_runs=jnp.zeros((K, G, 4), jnp.int32),
                 num_kv_runs=jnp.zeros((K, 1), jnp.int32),
+                desc=(jnp.zeros((K, P_desc, 3), jnp.int32)
+                      if attn_desc is not None else None),
+                decode_list=(jnp.zeros((K, self.max_num_reqs),
+                                       jnp.int32)
+                             if attn_desc is not None else None),
             )
         batch = AttentionBatch(
             req_idx=jnp.zeros((T, ), jnp.int32),
@@ -1795,7 +1958,11 @@ class TPUModelRunner:
             lora=self._dummy_lora_batch(T),
             mrope_positions=(jnp.zeros((T, 3), jnp.int32)
                              if self._mrope_on else None),
+            attn_desc=attn_desc,
+            decode_list=decode_list,
             max_q=max_q,
+            attn_bq=bq,
+            attn_sb=sb,
         )
         return jnp.zeros((T, ), jnp.int32), batch
 
@@ -1816,9 +1983,12 @@ class TPUModelRunner:
         )
 
     def forward_shapes(self) -> set[tuple[int, int, int]]:
-        """Every (T, max_q, G) the runner can present: decode shapes (one
-        per request bucket) plus prefill/mixed shapes (one per token
-        bucket)."""
+        """Every (T, max_q, G) the runner can present. Unified
+        (mega-kernel) models: composition is descriptor-carried, so
+        decode shapes coincide with the small token buckets and the set
+        collapses to one shape per token bucket — strictly fewer warmed
+        graphs than the legacy decode+prefill split at the same bucket
+        config. Legacy (MLA) models keep both variants."""
         shapes = set()
         for r in self.req_buckets:
             shapes.add(self._batch_shape(r, 1))
@@ -1871,6 +2041,7 @@ class TPUModelRunner:
                     self.model.cfg.dtype, self.max_pages_per_req)
                 n += ne
         self._precompiled = True
+        self.precompile_graphs = n
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
 
@@ -1977,6 +2148,10 @@ class TPUModelRunner:
         stats: dict = {
             "prepare_inputs_seconds": self.prepare_inputs_hist.to_dict(),
             "num_recompiles": self.num_recompiles,
+            # Kernel-dispatch + lattice observability (vdt:attn_kernel_
+            # calls_total{kernel} / vdt:precompile_graphs_total).
+            "attn_kernel_calls": dict(self.attn_kernel_calls),
+            "precompile_graphs": self.precompile_graphs,
         }
         if self._device_telemetry:
             from vllm_distributed_tpu.metrics import telemetry

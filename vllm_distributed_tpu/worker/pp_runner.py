@@ -215,6 +215,7 @@ class PPModelRunner(TPUModelRunner):
         assert self.kv_caches is not None, "initialize_kv_cache first"
         import time
         start = time.perf_counter()
+        n = 0
         for T, max_q, G in sorted(self.forward_shapes()):
             token_ids, batch = self._dummy_step_inputs(T, max_q, G)
             sm0 = self.stage_meshes[0]
@@ -222,6 +223,7 @@ class PPModelRunner(TPUModelRunner):
                 with self._compile_watch(("embed", T)):
                     hidden = self._embed_fn(self.embed_params, token_ids,
                                  batch.positions)
+            n += 1
             for p in range(self.pp):
                 sm = self.stage_meshes[p]
                 hidden = self._hop(hidden, sm)
@@ -231,12 +233,14 @@ class PPModelRunner(TPUModelRunner):
                             self.stage_params[p], self.kv_caches[p],
                             hidden, batch,
                             first_layer=self._stage_first_layer(p))
+                n += 1
             jax.block_until_ready(hidden)
         sml = self.stage_meshes[-1]
         with global_mesh(sml), sml:
             self._precompile_samplers(sml)
             self._precompile_plp(sml)
         self._precompiled = True
+        self.precompile_graphs = n
         logger.info("PP precompile done in %.1fs",
                     time.perf_counter() - start)
 
