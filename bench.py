@@ -25,6 +25,16 @@ import traceback
 # Keep the engine quiet so stdout stays a single JSON line.
 os.environ.setdefault("VDT_LOGGING_LEVEL", "WARNING")
 
+# The routing leg drives a 2-replica DP fleet; the CPU platform exposes
+# one device unless told otherwise, and the flag only takes effect
+# before the first jax import in this process. Irrelevant on TPU (it
+# shapes the HOST platform only).
+if ("xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
 import numpy as np  # noqa: E402
 
 TINY = os.environ.get("VDT_BENCH_TINY", "0") == "1"  # CPU smoke mode
@@ -345,6 +355,98 @@ def _async_overlap_legs(config, prompts, sp, record) -> None:
             record["sync_steps_per_s"] = round(tok_s / batch, 2)
         del engine
         gc.collect()
+
+
+def _routing_leg(config, record) -> None:
+    """Routing-tier leg (ROADMAP item 3 acceptance): a 2-replica
+    in-process DP fleet under repeated-session traffic — each turn's
+    prompt extends the previous turn's full sequence, the chat pattern
+    prefix-affinity exists for — measured with the router ON vs the
+    VDT_ROUTER=0 round-robin balancer on IDENTICAL traffic. Reports the
+    fleet-merged prefix-cache window hit rate, SLO goodput, and turn
+    throughput per leg: the hit-rate delta is the multi-replica
+    prefix-reuse win, directly comparable to the
+    vdt:prefix_cache_hit_rate_window gauge in production."""
+    import gc
+
+    import jax
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    if len(jax.devices()) < 2:
+        record["routing_leg_error"] = (
+            "needs >= 2 devices for a 2-replica DP fleet")
+        return
+    # Odd session count on purpose: an even wave re-aligns with the
+    # round-robin cursor every turn and would hand RR accidental
+    # affinity, understating the router's win.
+    sessions, turns, gen_tokens = 5, 4, 16
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_tokens,
+                        ignore_eos=True)
+    rng = np.random.default_rng(7)
+    base = {s: [int(x) for x in rng.integers(10, 5000, size=64)]
+            for s in range(sessions)}
+    # Pre-drawn per-turn user tokens so both legs replay byte-identical
+    # traffic (greedy generation makes the rest deterministic).
+    extra = {(t, s): int(rng.integers(10, 5000))
+             for t in range(turns) for s in range(sessions)}
+    saved = os.environ.get("VDT_ROUTER")
+    try:
+        for leg, flag in (("routed", "1"), ("rr", "0")):
+            os.environ["VDT_ROUTER"] = flag
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                cache_config=CacheConfig(block_size=16,
+                                         num_gpu_blocks=256),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=2048, max_num_seqs=64,
+                    max_model_len=2048, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            cfg.parallel_config.data_parallel_size = 2
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            prompts = {s: list(base[s]) for s in range(sessions)}
+            t0 = time.perf_counter()
+            for t in range(turns):
+                done = {}
+                for s in range(sessions):
+                    engine.add_request(f"{leg}-{t}-{s}",
+                                       list(prompts[s]), sp)
+                while engine.has_unfinished_requests():
+                    for o in engine.step():
+                        if o.finished:
+                            done[o.request_id] = o
+                for s in range(sessions):
+                    toks = list(
+                        done[f"{leg}-{t}-{s}"].outputs[0].token_ids)
+                    prompts[s] = prompts[s] + toks + [extra[(t, s)]]
+            wall = time.perf_counter() - t0
+            stats = engine.get_stats()
+            kv = stats.get("kv_cache") or {}
+            record[f"routing_{leg}_hit_rate_window"] = round(
+                float(kv.get("window_hit_rate", 0.0)), 4)
+            record[f"routing_{leg}_turns_per_s"] = round(
+                sessions * turns / wall, 2)
+            fe = getattr(engine.output_processor, "stats", None)
+            if fe is not None and fe.slo_enabled and fe.slo_scored:
+                record[f"routing_{leg}_goodput_frac"] = round(
+                    fe.slo_good / fe.slo_scored, 4)
+            if flag == "1":
+                router = stats.get("router") or {}
+                record["routing_affinity_hits"] = int(
+                    router.get("affinity_hits", 0))
+                record["routing_spillovers"] = int(
+                    router.get("spillovers", 0))
+            engine.shutdown()
+            del engine
+            gc.collect()
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_ROUTER", None)
+        else:
+            os.environ["VDT_ROUTER"] = saved
 
 
 def _phase_percentiles(engine, record) -> None:
@@ -779,6 +881,11 @@ def main() -> None:
             _mixed_batch_leg(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
+        # Routing leg: 2-replica fleet prefix-reuse, router vs RR.
+        try:
+            _routing_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["routing_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -829,6 +936,10 @@ def main() -> None:
             _mixed_batch_leg(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _routing_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["routing_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

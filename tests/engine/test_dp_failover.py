@@ -199,10 +199,13 @@ class _FakeCoordinator:
         self.counts = [0] * n
         self.healthy = [True] * n
 
-    def route(self) -> int:
+    def route(self, prefer=None) -> int:
         live = [i for i in range(len(self.counts)) if self.healthy[i]]
         assert live, "route() with no healthy engines"
-        i = min(live, key=self.counts.__getitem__)
+        if prefer is not None and self.healthy[prefer]:
+            i = prefer
+        else:
+            i = min(live, key=self.counts.__getitem__)
         self.counts[i] += 1
         return i
 

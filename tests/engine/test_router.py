@@ -1,0 +1,293 @@
+"""Cluster routing tier (engine/router.py): prefix-affinity placement,
+SLO-aware spillover, stale-stats degradation (the ``router.stale_stats``
+fault drill), failover re-homing, the VDT_ROUTER kill switch, and the
+vdt:router_*/vdt:dp_replica_load metric families."""
+
+import time
+
+import pytest
+
+from tests.conftest import make_config
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.engine import dp_client as dp_mod
+from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
+                                                     EngineDeadError)
+from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.faults
+
+BLOCK = 4  # make_config block_size
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+class _StubReplica(EngineCoreClient):
+    """Scriptable replica exposing the in-process stats surface the
+    router refreshes from (``engine_core`` marker + call_utility)."""
+
+    def __init__(self, config) -> None:
+        self.config = config
+        self.engine_core = object()  # marks the inproc refresh path
+        self.stats = {"num_running_reqs": 0, "num_waiting_reqs": 0,
+                      "kv_cache_usage": 0.0}
+        self.added: list[EngineCoreRequest] = []
+        self.outputs: list[list[EngineCoreOutput]] = []
+        self.dead = False
+
+    def _check(self) -> None:
+        if self.dead:
+            raise EngineDeadError("stub replica is dead")
+
+    def add_request(self, request: EngineCoreRequest) -> None:
+        self._check()
+        self.added.append(request)
+
+    def abort_requests(self, request_ids: list[str]) -> None:
+        self._check()
+
+    def recv_outputs(self, timeout_ms: int):
+        self._check()
+        return self.outputs.pop(0) if self.outputs else None
+
+    def call_utility(self, method: str, *args):
+        self._check()
+        assert method == "get_stats"
+        return dict(self.stats)
+
+    def get_stats(self) -> dict:
+        return dict(self.stats)
+
+    def restart(self) -> None:
+        self.dead = False
+
+    def shutdown(self) -> None:
+        pass
+
+
+def _dp2(monkeypatch, **env) -> DPEngineClient:
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    config = make_config()
+    config.parallel_config.data_parallel_size = 2
+    config.fault_tolerance_config.replica_probe_interval_s = 3600
+    monkeypatch.setattr(dp_mod, "SyncMPClient", _StubReplica)
+    return DPEngineClient(config, force_mp=True)
+
+
+def _req(rid: str, prompt: list[int],
+         max_tokens: int = 8) -> EngineCoreRequest:
+    return EngineCoreRequest(
+        request_id=rid, prompt_token_ids=list(prompt),
+        sampling_params=SamplingParams(temperature=0.0,
+                                       max_tokens=max_tokens))
+
+
+SESSION = list(range(100, 100 + 3 * BLOCK))  # 3 full pages
+
+
+def _finish(dp, rid: str, tokens: list[int]) -> None:
+    owner = dp._owner[rid]
+    dp.clients[owner].outputs.append([EngineCoreOutput(
+        req_id=rid, new_token_ids=tokens, finish_reason="stop")])
+    dp.recv_outputs(timeout_ms=10)
+
+
+# ---------------------------------------------------------------------------
+# Prefix affinity
+# ---------------------------------------------------------------------------
+
+def test_session_turn_routes_back_to_home(monkeypatch):
+    dp = _dp2(monkeypatch)
+    assert dp.router is not None
+    dp.add_request(_req("t1", SESSION))
+    home = dp._owner["t1"]
+    _finish(dp, "t1", [7, 8, 9, 10])
+    # Next turn: previous prompt + generated + new user tokens. The
+    # 4 generated tokens complete page 4, which on_finish indexed.
+    turn2 = SESSION + [7, 8, 9, 10] + [55, 56]
+    dp.add_request(_req("t2", turn2))
+    assert dp._owner["t2"] == home
+    assert dp.router.affinity_hits >= 1
+
+
+def test_distinct_prompts_balance_across_replicas(monkeypatch):
+    dp = _dp2(monkeypatch)
+    for i in range(4):
+        dp.add_request(_req(f"r{i}", [i * 50 + j for j in range(8)]))
+    assert dp.request_counts() == [2, 2]
+
+
+def test_pressured_home_spills_over(monkeypatch):
+    dp = _dp2(monkeypatch, VDT_ROUTER_STATS_TTL_S="0")
+    dp.add_request(_req("t1", SESSION))
+    home = dp._owner["t1"]
+    _finish(dp, "t1", [7])
+    # The home replica's KV pool pressure crosses the spill threshold
+    # (but not the eviction-decay one): affinity credit is forfeited
+    # and the session turn spills to the healthy replica.
+    dp.clients[home].stats["kv_cache_usage"] = 0.90
+    dp.add_request(_req("t2", SESSION + [200, 201]))
+    assert dp._owner["t2"] == 1 - home
+    assert dp.router.spillovers >= 1
+
+
+def test_eviction_pressure_halves_residency_index(monkeypatch):
+    dp = _dp2(monkeypatch)
+    dp.add_request(_req("t1", SESSION))
+    home = dp._owner["t1"]
+    before = len(dp.router._residency[home])
+    assert before >= 3
+    # The replica reports near-saturation: half our hints about it are
+    # presumed evicted and dropped (oldest first).
+    dp.router.observe_stats(home, {"num_running_reqs": 1,
+                                   "kv_cache_usage": 0.99})
+    assert len(dp.router._residency[home]) == before - before // 2
+
+
+def test_mm_requests_skip_affinity(monkeypatch):
+    dp = _dp2(monkeypatch)
+    req = _req("mm", SESSION)
+    req.mm_inputs = [object()]
+    assert dp.router.request_hashes(req) == []
+
+
+# ---------------------------------------------------------------------------
+# Stale-stats degradation (router.stale_stats fault drill)
+# ---------------------------------------------------------------------------
+
+def test_stale_stats_degrades_to_load_balancing(monkeypatch):
+    dp = _dp2(monkeypatch, VDT_ROUTER_STATS_TTL_S="0",
+              VDT_ROUTER_STALE_S="0.05")
+    # Seed affinity: a finished session lives on one replica.
+    dp.add_request(_req("t1", SESSION))
+    home = dp._owner["t1"]
+    _finish(dp, "t1", [7])
+    # Healthy signals: same-prefix turns herd onto the home replica.
+    dp.add_request(_req("warm", SESSION + [1, 2]))
+    assert dp._owner["warm"] == home
+    dp.abort_requests(["warm"])
+    # Drill: freeze the signal plane and let every snapshot expire.
+    fi.inject("router.stale_stats")
+    time.sleep(0.08)
+    for i in range(4):
+        dp.add_request(_req(f"s{i}", SESSION + [10 + i]))
+    # Degraded routing spreads by live count instead of herding the
+    # whole session wave onto the (blind) home replica.
+    assert dp.request_counts() == [2, 2]
+    assert dp.router.stale_degradations >= 4
+    assert fi.counters().get("router.stale_stats", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Failover re-homing
+# ---------------------------------------------------------------------------
+
+def test_failover_rehomes_session_affinity(monkeypatch):
+    dp = _dp2(monkeypatch)
+    dp.add_request(_req("a", SESSION, max_tokens=10))
+    home = dp._owner["a"]
+    survivor = 1 - home
+    # Two pages of tokens stream out, then the home replica dies.
+    dp.clients[home].outputs.append([EngineCoreOutput(
+        req_id="a", new_token_ids=list(range(2 * BLOCK)))])
+    dp.recv_outputs(timeout_ms=10)
+    dp.clients[home].dead = True
+    dp.recv_outputs(timeout_ms=10)
+    assert home in dp._down
+    # The dead replica's residency index is gone...
+    assert len(dp.router._residency[home]) == 0
+    # ...and the migrated continuation re-homed its prefix: a new turn
+    # over the same session routes to the survivor.
+    assert dp._owner["a"] == survivor
+    _finish(dp, "a", [3])
+    dp.add_request(_req("b", SESSION + list(range(2 * BLOCK))))
+    assert dp._owner["b"] == survivor
+    assert dp.router.affinity_hits >= 1
+
+
+# ---------------------------------------------------------------------------
+# Kill switch + metrics
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_restores_round_robin(monkeypatch):
+    dp = _dp2(monkeypatch, VDT_ROUTER="0")
+    assert dp.router is None
+    # Same-prefix traffic balances by live count exactly like the
+    # pre-router balancer (no affinity, no scoring).
+    for i in range(4):
+        dp.add_request(_req(f"r{i}", SESSION))
+    assert dp.request_counts() == [2, 2]
+    stats = dp.get_stats()
+    assert "router" not in stats
+    # The balancer-state gauges render with the router OFF too (they
+    # exist to debug either path).
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics(stats)
+    assert 'vdt:dp_replica_load{replica="0"} 2' in text
+    assert "vdt:replicas_in_rotation 2" in text
+    assert "vdt:router_requests_routed_total" not in text
+
+
+def test_router_metrics_render(monkeypatch):
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    dp = _dp2(monkeypatch)
+    dp.add_request(_req("t1", SESSION))
+    _finish(dp, "t1", [7])
+    dp.add_request(_req("t2", SESSION + [1, 2]))
+    text = render_metrics(dp.get_stats())
+    assert "vdt:router_requests_routed_total 2" in text
+    assert "vdt:router_affinity_hits_total 1" in text
+    assert 'vdt:dp_replica_load{replica="0"}' in text
+    assert 'vdt:dp_replica_load{replica="1"}' in text
+    assert "vdt:replicas_in_rotation 2" in text
+    assert 'vdt:router_prefix_index_entries{replica=' in text
+
+
+def test_stats_feed_updates_router_snapshots(monkeypatch):
+    """The DP stats aggregation path IS the router's passive signal
+    feed (the 'existing get_stats RPC' channel)."""
+    dp = _dp2(monkeypatch, VDT_ROUTER_STATS_TTL_S="3600")
+    assert dp.router._stats_at[0] == float("-inf")
+    dp.clients[0].stats["kv_cache_usage"] = 0.5
+    dp.get_stats()
+    assert dp.router._stats[0]["kv_cache_usage"] == 0.5
+    assert dp.router._stats_at[0] > 0
+
+
+def test_coordinator_honors_router_preference(monkeypatch):
+    dp = _dp2(monkeypatch)
+
+    class _Coord:
+        def __init__(self):
+            self.counts = [0, 0]
+            self.healthy = [True, True]
+
+        def route(self, prefer=None):
+            i = (prefer if prefer is not None and self.healthy[prefer]
+                 else min(range(2), key=self.counts.__getitem__))
+            self.counts[i] += 1
+            return i
+
+        def report(self, engine, delta):
+            self.counts[engine] += delta
+
+        def set_health(self, engine, up, *, clear=False):
+            self.healthy[engine] = up
+            if clear:
+                self.counts[engine] = 0
+
+    dp.coordinator = _Coord()
+    dp.add_request(_req("t1", SESSION))
+    home = dp._owner["t1"]
+    _finish(dp, "t1", [7, 8, 9, 10])
+    dp.add_request(_req("t2", SESSION + [7, 8, 9, 10, 1]))
+    assert dp._owner["t2"] == home
+    assert dp.coordinator.counts[home] == 1

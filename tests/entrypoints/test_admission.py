@@ -234,3 +234,151 @@ def test_overload_sheds_429_with_retry_after(server):
     assert "vdt:admission_queue_depth" in scrape
     assert "vdt:requests_replayed_total" in scrape
     assert "vdt:drain_duration_seconds" in scrape
+
+
+# ---------------------------------------------------------------------------
+# Weighted per-class shedding (tenant fairness)
+# ---------------------------------------------------------------------------
+
+def test_weighted_shed_evicts_best_effort_first():
+    """Overload must 429 best-effort traffic (priority > 0) while
+    interactive traffic still admits, with the same Retry-After
+    contract."""
+    engine = _stub_engine()
+    ctrl = AdmissionController(engine, high_watermark=4, low_watermark=3,
+                               retry_after_s=7, best_effort_frac=0.5)
+
+    async def run():
+        for _ in range(2):
+            await ctrl.acquire()  # interactive, depth -> 2
+        # Best-effort watermark is 4*0.5 = 2: shed, Retry-After intact.
+        with pytest.raises(AdmissionRejected) as ei:
+            await ctrl.acquire(priority=5)
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s == 7
+        # Interactive traffic is NOT in shedding mode: still admits.
+        await ctrl.acquire()
+        assert ctrl.depth == 3
+        # Best-effort hysteresis: keeps shedding until depth <= its
+        # low watermark (3*0.5 = 1).
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire(priority=1)
+        for _ in range(2):
+            ctrl.release()  # depth 1 == best-effort low
+        await ctrl.acquire(priority=1)  # recovered
+
+    asyncio.run(run())
+    assert ctrl.shed_by_class == {"best_effort": 2}
+    assert engine.output_processor.stats.num_requests_shed == 2
+
+
+def test_interactive_shed_counts_by_class():
+    ctrl = _controller(high=2, low=1)
+
+    async def run():
+        await ctrl.acquire()
+        await ctrl.acquire(priority=-3)  # negative = still interactive
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire()
+
+    asyncio.run(run())
+    assert ctrl.shed_by_class == {"interactive": 1}
+
+
+def test_request_class_boundaries():
+    assert AdmissionController.request_class(0) == "interactive"
+    assert AdmissionController.request_class(-1) == "interactive"
+    assert AdmissionController.request_class(1) == "best_effort"
+
+
+def test_best_effort_frac_one_disables_weighting():
+    ctrl = AdmissionController(_stub_engine(), high_watermark=4,
+                               best_effort_frac=1.0)
+    assert ctrl._thresholds("best_effort") == ctrl._thresholds(
+        "interactive")
+
+
+# ---------------------------------------------------------------------------
+# Tenant/priority plumbing: OpenAI body -> EngineCoreRequest -> msgpack
+# ---------------------------------------------------------------------------
+
+def test_priority_tenant_from_openai_body():
+    from vllm_distributed_tpu.entrypoints.openai.api_server import \
+        _priority_tenant
+    assert _priority_tenant({}) == (0, None)
+    assert _priority_tenant({"priority": 3, "tenant": "acme"}) == \
+        (3, "acme")
+    # The standard OpenAI "user" field doubles as tenant identity.
+    assert _priority_tenant({"user": "u-17"}) == (0, "u-17")
+    assert _priority_tenant({"tenant": "t", "user": "u"}) == (0, "t")
+    from vllm_distributed_tpu.entrypoints.openai.protocol import \
+        RequestError
+    with pytest.raises(RequestError):
+        _priority_tenant({"priority": "not-an-int"})
+
+
+def test_priority_tenant_serial_round_trip():
+    """EngineCoreRequest carries priority/tenant across the msgpack
+    engine-core boundary byte-exactly, and a decoder missing the tenant
+    key (old wire) degrades to None."""
+    from vllm_distributed_tpu.engine.serial import (decode_request,
+                                                    encode_request, pack,
+                                                    unpack)
+    from vllm_distributed_tpu.request import EngineCoreRequest, Request
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    req = EngineCoreRequest(
+        request_id="rt-1", prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4),
+        priority=7, tenant="tenant-a")
+    back = decode_request(unpack(pack(encode_request(req))))
+    assert back.priority == 7
+    assert back.tenant == "tenant-a"
+    # Scheduler-side record keeps both.
+    sched_req = Request.from_engine_core_request(back)
+    assert sched_req.priority == 7 and sched_req.tenant == "tenant-a"
+    # Old wire format without the tenant key.
+    d = encode_request(req)
+    d.pop("tenant")
+    assert decode_request(unpack(pack(d))).tenant is None
+
+
+def test_shed_by_class_metrics_block():
+    """/metrics renders vdt:requests_shed_by_class_total{class} with
+    exact per-class counts once any shed happened."""
+    ctrl = _controller(high=1, low=1)
+
+    async def run():
+        await ctrl.acquire()
+        for priority in (2, 0):
+            with pytest.raises(AdmissionRejected):
+                await ctrl.acquire(priority=priority)
+
+    asyncio.run(run())
+    assert ctrl.shed_by_class == {"best_effort": 1, "interactive": 1}
+
+
+def test_best_effort_inherits_interactive_shedding():
+    """Drain-down must never invert priority: while interactive traffic
+    is still in shedding hysteresis, best-effort requests stay shed
+    even though their own class never tripped."""
+    ctrl = AdmissionController(_stub_engine(), high_watermark=4,
+                               low_watermark=1, best_effort_frac=0.75)
+
+    async def run():
+        for _ in range(4):
+            await ctrl.acquire()  # interactive, depth -> 4
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire()  # trips ONLY the interactive class
+        ctrl.release()
+        ctrl.release()  # depth 2: above low=1, interactive still shed
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire()
+        # A best-effort request at the same depth must NOT slip in
+        # ahead of the interactive traffic being drained.
+        with pytest.raises(AdmissionRejected):
+            await ctrl.acquire(priority=9)
+        ctrl.release()  # depth 1 == low: both classes recover
+        await ctrl.acquire(priority=9)
+
+    asyncio.run(run())
+    assert ctrl.shed_by_class == {"interactive": 2, "best_effort": 1}

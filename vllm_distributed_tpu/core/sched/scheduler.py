@@ -1460,6 +1460,7 @@ class Scheduler:
                 "request_id": request.request_id,
                 "status": request.status.name,
                 "priority": request.priority,
+                "tenant": request.tenant,
                 "num_prompt_tokens": request.num_prompt_tokens,
                 "num_output_tokens": request.num_output_tokens,
                 "num_computed_tokens": request.num_computed_tokens,

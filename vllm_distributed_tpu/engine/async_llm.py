@@ -345,6 +345,7 @@ class AsyncLLM:
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         priority: int = 0,
+        tenant: Optional[str] = None,
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
         multi_modal_data: Optional[dict] = None,
@@ -360,7 +361,8 @@ class AsyncLLM:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
-            lora_request=lora_request, pooling_params=pooling_params,
+            tenant=tenant, lora_request=lora_request,
+            pooling_params=pooling_params,
             multi_modal_data=multi_modal_data)
         queue: asyncio.Queue = asyncio.Queue()
         self.request_queues[request_id] = queue

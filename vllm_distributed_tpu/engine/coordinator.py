@@ -57,7 +57,17 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
                     live = [i for i in range(num_engines) if healthy[i]]
                     if not live:
                         raise ValueError("no healthy engines to route to")
-                    engine = min(live, key=counts.__getitem__)
+                    # The routing tier's placement (prefix affinity /
+                    # SLO scoring happens front-end-side) rides along
+                    # as a preference, honored while that engine is
+                    # healthy; the coordinator stays the single owner
+                    # of the cross-front-end admission counts.
+                    prefer = msg.get("prefer")
+                    if (prefer is not None and 0 <= int(prefer) <
+                            num_engines and healthy[int(prefer)]):
+                        engine = int(prefer)
+                    else:
+                        engine = min(live, key=counts.__getitem__)
                     counts[engine] += 1  # route implies one admission
                     reply = {"engine": engine}
                 elif op == "health":
@@ -126,8 +136,13 @@ class DPCoordinatorClient:
             raise RuntimeError(f"DP coordinator: {reply['error']}")
         return reply
 
-    def route(self) -> int:
-        return int(self._call(op="route")["engine"])
+    def route(self, prefer: Optional[int] = None) -> int:
+        """Least-loaded healthy engine, or ``prefer`` (the front-end
+        routing tier's pick) while that engine is healthy."""
+        msg = {"op": "route"}
+        if prefer is not None:
+            msg["prefer"] = int(prefer)
+        return int(self._call(**msg)["engine"])
 
     def report(self, engine: int, delta: int) -> None:
         self._call(op="report", engine=engine, delta=delta)

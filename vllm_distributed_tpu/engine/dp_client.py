@@ -95,6 +95,15 @@ class DPEngineClient(EngineCoreClient):
             self._coord_addr = addr
             self.coordinator = DPCoordinatorClient(addr)
             logger.info("DP coordinator process at %s", addr)
+        # Routing tier (engine/router.py): prefix-affinity + SLO-aware
+        # placement over the alive replicas. VDT_ROUTER=0 removes it,
+        # reverting placement to the live-count round-robin below. With
+        # a coordinator, the router computes the preferred replica and
+        # the coordinator keeps the (multi-front-end) admission counts.
+        self.router = None
+        if envs.VDT_ROUTER:
+            from vllm_distributed_tpu.engine.router import ReplicaRouter
+            self.router = ReplicaRouter(n, config)
         # Balancer state: request ownership + live counts per replica
         # (the coordinator's published queue lengths, client-side).
         self._owner: dict[str, int] = {}
@@ -132,13 +141,23 @@ class DPEngineClient(EngineCoreClient):
         self.replica_resurrections = 0
 
     # ------------------------------------------------------------------
-    def _pick_replica(self) -> int:
+    def _pick_replica(
+            self, request: Optional[EngineCoreRequest] = None) -> int:
         if len(self._down) == len(self.clients):
             raise EngineDeadError("all DP replicas are dead")
+        prefer = None
+        if self.router is not None:
+            self.router.maybe_refresh(self.clients, self._down)
+            prefer = self.router.route(request, self.request_counts(),
+                                       self._down)
         if self.coordinator is not None:
             # The coordinator's route() already accounts the admission
-            # (and skips replicas reported down via set_health).
-            return self.coordinator.route()
+            # (and skips replicas reported down via set_health); the
+            # router's pick rides along as a preference it honors while
+            # that replica is healthy.
+            return self.coordinator.route(prefer=prefer)
+        if prefer is not None:
+            return prefer
         n = len(self.clients)
         best, best_load = None, None
         for off in range(n):
@@ -168,7 +187,7 @@ class DPEngineClient(EngineCoreClient):
         replica found dead at admission time (its own journaled load
         migrates too), until the request lands or no replica is left."""
         while True:
-            i = self._pick_replica()
+            i = self._pick_replica(request)
             try:
                 self.clients[i].add_request(request)
             except Exception as e:
@@ -185,6 +204,12 @@ class DPEngineClient(EngineCoreClient):
                 raise
             self._owner[request.request_id] = i
             self._live[i].add(request.request_id)
+            if self.router is not None:
+                # Residency bookkeeping: the request's prompt pages will
+                # live (and prefix-cache) on this replica. Migrated
+                # continuations pass through here too — that re-admit IS
+                # the affinity re-homing after a failover.
+                self.router.on_admit(request, i)
             return
 
     def abort_requests(self, request_ids: list[str]) -> None:
@@ -219,12 +244,17 @@ class DPEngineClient(EngineCoreClient):
                 self._progress.setdefault(o.req_id,
                                           []).extend(o.new_token_ids)
             if o.finished:
-                self._requests.pop(o.req_id, None)
-                self._progress.pop(o.req_id, None)
+                orig = self._requests.pop(o.req_id, None)
+                progress = self._progress.pop(o.req_id, None)
                 i = self._owner.pop(o.req_id, None)
                 if i is not None:
                     self._live[i].discard(o.req_id)
                     finished_per[i] = finished_per.get(i, 0) + 1
+                    if self.router is not None and orig is not None:
+                        # The finished sequence stays prefix-cached on
+                        # its replica: index prompt+generated so the
+                        # session's NEXT turn routes home page-exactly.
+                        self.router.on_finish(orig, progress or [], i)
         if self.coordinator is not None:
             # One batched delta per replica (output hot path).
             for i, k in finished_per.items():
@@ -245,6 +275,11 @@ class DPEngineClient(EngineCoreClient):
             return
         self._down.add(i)
         self.replica_failovers += 1
+        if self.router is not None:
+            # The dead replica's KV pool died with it: drop every
+            # affinity hint pointing there. Migrated requests re-home
+            # as their continuation re-admits register the new owner.
+            self.router.on_replica_down(i)
         self._next_probe[i] = time.monotonic() + self._probe_interval
         stranded = [rid for rid, owner in self._owner.items()
                     if owner == i]
@@ -346,6 +381,8 @@ class DPEngineClient(EngineCoreClient):
             self._next_probe.clear()
             for live in self._live:
                 live.clear()
+            if self.router is not None:
+                self.router.reset()
 
     # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
@@ -457,12 +494,15 @@ class DPEngineClient(EngineCoreClient):
             return default
         del self._pending_util[call_id]
         by_idx = self._util_partial.pop(call_id)
-        values = [by_idx[i] for i in range(len(pending))]
+        # Key by the REPLICA index recorded at send time: with a
+        # replica down, positions and replica indices diverge.
+        indices = [idx for idx, _, _ in pending]
+        values = [by_idx[idx] for idx in indices]
         for v in values:
             if isinstance(v, Exception):
                 return v
         if all(isinstance(v, dict) for v in values):
-            return self._aggregate_stats(values)
+            return self._aggregate_stats(values, indices=indices)
         return values
 
     @staticmethod
@@ -477,9 +517,10 @@ class DPEngineClient(EngineCoreClient):
         """Blocking fan-out RPC (sleep/wake_up/profile/...): every
         replica runs it; dict results aggregate, others come back as a
         per-replica list."""
-        values = [c.call_utility(method, *args)
-                  for i, c in enumerate(self.clients)
-                  if i not in self._down]
+        alive = [i for i in range(len(self.clients))
+                 if i not in self._down]
+        values = [self.clients[i].call_utility(method, *args)
+                  for i in alive]
         if method == "get_debug_state":
             # Introspection dicts must NOT be stats-aggregated: summing
             # per-replica config/bool fields (async_scheduling,
@@ -488,7 +529,7 @@ class DPEngineClient(EngineCoreClient):
             # already consumes.
             return {"dp_replicas": values}
         if values and all(isinstance(v, dict) for v in values):
-            return self._aggregate_stats(values)
+            return self._aggregate_stats(values, indices=alive)
         return values
 
     def request_counts(self) -> list[int]:
@@ -496,7 +537,18 @@ class DPEngineClient(EngineCoreClient):
         load snapshot; exposed for /metrics and tests)."""
         return [len(s) for s in self._live]
 
-    def _aggregate_stats(self, per: list[dict]) -> dict:
+    def _aggregate_stats(self, per: list[dict],
+                         indices: Optional[list[int]] = None) -> dict:
+        # getattr: stats-aggregation tests build this client via
+        # __new__ with only the balancer fields they exercise.
+        router = getattr(self, "router", None)
+        if router is not None and indices is not None:
+            # Passive routing-signal feed: every stats poll that already
+            # flows through here (the /metrics scrape, the admission
+            # gate's KV sampler) refreshes the router's per-replica load
+            # snapshots — the "existing get_stats RPC" channel.
+            for i, stats in zip(indices, per):
+                router.observe_stats(i, stats)
         agg: dict = {"dp_size": len(self.clients),
                      "dp_request_counts": self.request_counts(),
                      "dp_replicas": per,
@@ -577,12 +629,17 @@ class DPEngineClient(EngineCoreClient):
             *(s.get("timeline_events") or [] for s in per))
         if events:
             agg["timeline_events"] = events
+        # Routing tier: ONE router instance owns the whole fleet's
+        # placement, so its counters attach exactly — nothing to merge.
+        if router is not None:
+            agg["router"] = router.get_stats()
         return agg
 
     def get_stats(self) -> dict:
-        return self._aggregate_stats([c.get_stats()
-                                      for i, c in enumerate(self.clients)
-                                      if i not in self._down])
+        alive = [i for i in range(len(self.clients))
+                 if i not in self._down]
+        return self._aggregate_stats(
+            [self.clients[i].get_stats() for i in alive], indices=alive)
 
     def shutdown(self) -> None:
         if self.coordinator is not None:

@@ -59,6 +59,7 @@ class LLMEngine:
         prompt: Union[str, list[int]],
         sampling_params: Optional[SamplingParams] = None,
         priority: int = 0,
+        tenant: Optional[str] = None,
         kv_transfer_params: Optional[dict] = None,
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
@@ -67,7 +68,7 @@ class LLMEngine:
         sampling_params = sampling_params or SamplingParams()
         core_req = self.processor.process_inputs(
             request_id, prompt, sampling_params, priority=priority,
-            kv_transfer_params=kv_transfer_params,
+            tenant=tenant, kv_transfer_params=kv_transfer_params,
             lora_request=lora_request, pooling_params=pooling_params,
             multi_modal_data=multi_modal_data)
         self.output_processor.add_request(

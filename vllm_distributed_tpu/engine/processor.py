@@ -99,6 +99,7 @@ class Processor:
         lora_request: Optional[dict] = None,
         pooling_params: Optional[dict] = None,
         multi_modal_data: Optional[dict] = None,
+        tenant: Optional[str] = None,
     ) -> EngineCoreRequest:
         if isinstance(prompt, str):
             assert self.tokenizer is not None, \
@@ -223,6 +224,7 @@ class Processor:
             eos_token_id=self.eos_token_id,
             arrival_time=arrival_time or time.time(),  # wallclock-ok
             priority=priority,
+            tenant=tenant,
             kv_transfer_params=kv_transfer_params,
             lora_request=lora_request,
             pooling_params=pooling_params,

@@ -39,6 +39,7 @@ def encode_request(req: EngineCoreRequest) -> dict:
         "eos_token_id": req.eos_token_id,
         "arrival_time": req.arrival_time,
         "priority": req.priority,
+        "tenant": req.tenant,
         "kv_transfer_params": req.kv_transfer_params,
         "lora_request": req.lora_request,
         "pooling_params": req.pooling_params,
@@ -59,6 +60,7 @@ def decode_request(d: dict) -> EngineCoreRequest:
         eos_token_id=d["eos_token_id"],
         arrival_time=d["arrival_time"],
         priority=d["priority"],
+        tenant=d.get("tenant"),
         kv_transfer_params=d["kv_transfer_params"],
         lora_request=d.get("lora_request"),
         pooling_params=d.get("pooling_params"),

@@ -14,6 +14,15 @@ feed the gate:
   sampled at most twice a second, so a KV-saturated engine sheds before
   its waiting queue does.
 
+Shedding is **class-weighted** for tenant fairness: requests carry a
+priority (body field, lower = more important; > 0 marks best-effort
+traffic) and an optional tenant identity, and best-effort requests
+evaluate BOTH pressure signals against watermarks scaled down by
+``VDT_ADMISSION_BEST_EFFORT_FRAC`` — so overload always evicts
+best-effort traffic before interactive traffic, with the same
+``Retry-After`` contract. Per-class shed counts render as
+``vdt:requests_shed_by_class_total{class}``.
+
 SIGTERM flips the gate into **drain mode**: no new admissions (503 +
 ``Retry-After``), in-flight requests run to completion, and the server
 exits once the gate is empty or the drain deadline passes. The
@@ -49,7 +58,8 @@ class AdmissionController:
 
     def __init__(self, engine, *, high_watermark: int,
                  low_watermark: int = 0, kv_high: float = 0.0,
-                 retry_after_s: int = 1) -> None:
+                 retry_after_s: int = 1,
+                 best_effort_frac: float = 1.0) -> None:
         self.engine = engine
         self.high_watermark = high_watermark
         self.low_watermark = (low_watermark if low_watermark > 0 else
@@ -58,10 +68,20 @@ class AdmissionController:
         # KV hysteresis floor: stop shedding once usage drops 5 points.
         self.kv_low = max(0.0, kv_high - 0.05)
         self.retry_after_s = retry_after_s
+        # Weighted per-class shedding: best-effort traffic (priority >
+        # 0) evaluates every threshold scaled by this fraction, so it
+        # sheds first and recovers last under overload.
+        self.best_effort_frac = min(1.0, max(0.05, best_effort_frac))
 
         self.depth = 0  # admitted, unfinished generation requests
         self.max_depth_seen = 0
-        self._shedding = False
+        # 429/503 refusals per class ("interactive"/"best_effort"),
+        # rendered as vdt:requests_shed_by_class_total{class}.
+        self.shed_by_class: dict[str, int] = {}
+        # Classes currently in shedding mode. PER CLASS: best-effort
+        # tripping its (lower) watermark must not flip interactive
+        # traffic into hysteresis shedding.
+        self._shedding: set[str] = set()
         self.draining = False
         self._drain_started: Optional[float] = None
         self._drain_done = asyncio.Event()
@@ -97,27 +117,77 @@ class AdmissionController:
                 pass
         return self._kv_usage
 
-    def _reject(self, message: str, status: int = 429) -> None:
+    @staticmethod
+    def request_class(priority: int) -> str:
+        """Priority -> shed class: lower is more important (matching
+        the scheduler's priority policy); > 0 marks best-effort."""
+        return "best_effort" if priority > 0 else "interactive"
+
+    def _thresholds(self, cls: str) -> tuple[int, int, float, float]:
+        """(high, low, kv_high, kv_low) watermarks for one class:
+        best-effort evaluates every signal against fractions of the
+        interactive thresholds, so it sheds first, recovers last."""
+        if cls != "best_effort" or self.best_effort_frac >= 1.0:
+            return (self.high_watermark, self.low_watermark,
+                    self.kv_high, self.kv_low)
+        f = self.best_effort_frac
+        high = max(1, int(self.high_watermark * f))
+        low = min(max(1, int(self.low_watermark * f)), high - 1) \
+            if high > 1 else 0
+        kv_high = self.kv_high * f if self.kv_high > 0 else 0.0
+        return high, low, kv_high, max(0.0, kv_high - 0.05)
+
+    def _reject(self, message: str, status: int = 429,
+                cls: str = "interactive") -> None:
         processor = getattr(self.engine, "output_processor", None)
         stats = getattr(processor, "stats", None)
         if stats is not None:
             stats.num_requests_shed += 1
+        self.shed_by_class[cls] = self.shed_by_class.get(cls, 0) + 1
         # Timeline ledger: sheds happen before a request id exists.
         recorder = getattr(processor, "events", None)
         if recorder is not None:
             from vllm_distributed_tpu.metrics import events as ev
             recorder.record("", ev.SHED,
-                            {"status": status, "reason": message})
+                            {"status": status, "reason": message,
+                             "class": cls})
         raise AdmissionRejected(message, status, self.retry_after_s)
 
-    async def acquire(self) -> None:
+    def class_sensitive(self) -> bool:
+        """True when the NEXT admission's outcome may depend on its
+        priority class — some class is in shedding hysteresis, or depth
+        / the cached KV sample is NEAR the best-effort thresholds. The
+        middleware uses this to skip reading the request body before
+        acquire() when classing cannot change the answer: a shed storm
+        must stay O(1) per refusal, not O(body) (the body is read
+        post-admission anyway for admitted requests). The margins below
+        (a few depth slots, 0.1 of KV) absorb the signals moving while
+        concurrent admissions land or the 0.5 s KV sample refreshes;
+        a ramp steeper than that can class one request conservatively
+        as interactive for one window — an accepted trade for not
+        buffering bodies on every refusal."""
+        if not self.enabled or self.best_effort_frac >= 1.0:
+            return False
+        if self._shedding:
+            return True
+        high, _, kv_high, kv_low = self._thresholds("best_effort")
+        return (self.depth + 4 >= high
+                or (kv_high > 0 and self._kv_usage >= kv_low - 0.1))
+
+    async def acquire(self, priority: int = 0) -> None:
         """Admit one generation request or raise AdmissionRejected.
         The caller MUST pair a successful acquire with release().
         Depth is tracked even with shedding disabled (high_watermark=0)
         — the SIGTERM drain needs an accurate in-flight count either
-        way."""
+        way. ``priority`` comes from the request body and picks which
+        watermark set applies (weighted shedding); the Retry-After
+        contract is identical for every class. Tenant identity does
+        not enter the gate — it rides EngineCoreRequest for the
+        scheduler and debug introspection."""
+        cls = self.request_class(priority)
         if self.draining:
-            self._reject("server is draining for shutdown", status=503)
+            self._reject("server is draining for shutdown", status=503,
+                         cls=cls)
         if not self.enabled:
             self.depth += 1
             return
@@ -126,27 +196,37 @@ class AdmissionController:
             # deterministic queue-depth pressure toward the watermark.
             self.depth += 1
             self.max_depth_seen = max(self.max_depth_seen, self.depth)
+        high, low, kv_high, kv_low = self._thresholds(cls)
         kv = await self._kv_pressure()
-        if self._shedding:
-            # Hysteresis: shedding continues until BOTH signals fall to
-            # their low watermarks, so the gate flaps once per overload
-            # episode instead of once per request.
-            if (self.depth > self.low_watermark
-                    or (self.kv_high > 0 and kv > self.kv_low)):
+        # Best-effort INHERITS interactive's shedding state: while
+        # more-important traffic is still being refused by hysteresis,
+        # admitting best-effort work would invert the priority order
+        # (and push the depth interactive is waiting to drain back up).
+        shedding = (cls in self._shedding
+                    or (cls == "best_effort"
+                        and "interactive" in self._shedding))
+        if shedding:
+            # Hysteresis: the class keeps shedding until BOTH signals
+            # fall to ITS low watermarks, so the gate flaps once per
+            # overload episode instead of once per request — and
+            # best-effort traffic stays shed while interactive traffic
+            # is already being re-admitted.
+            if (self.depth > low or (kv_high > 0 and kv > kv_low)):
                 self._reject(
                     f"shedding until load falls below the low "
-                    f"watermark (depth {self.depth}/"
-                    f"{self.low_watermark}, kv {kv:.2f})")
-            self._shedding = False
-        if self.depth >= self.high_watermark:
-            self._shedding = True
+                    f"watermark (depth {self.depth}/{low}, "
+                    f"kv {kv:.2f}, class {cls})", cls=cls)
+            self._shedding.discard(cls)
+        if self.depth >= high:
+            self._shedding.add(cls)
             self._reject(
-                f"admission queue full ({self.depth}/"
-                f"{self.high_watermark})")
-        if self.kv_high > 0 and kv >= self.kv_high:
-            self._shedding = True
+                f"admission queue full ({self.depth}/{high}, "
+                f"class {cls})", cls=cls)
+        if kv_high > 0 and kv >= kv_high:
+            self._shedding.add(cls)
             self._reject(
-                f"KV cache pressure {kv:.2f} >= {self.kv_high:.2f}")
+                f"KV cache pressure {kv:.2f} >= {kv_high:.2f} "
+                f"(class {cls})", cls=cls)
         self.depth += 1
         self.max_depth_seen = max(self.max_depth_seen, self.depth)
 
@@ -195,4 +275,5 @@ class AdmissionController:
             low_watermark=envs.VDT_ADMISSION_LOW_WATERMARK,
             kv_high=envs.VDT_ADMISSION_KV_HIGH,
             retry_after_s=envs.VDT_RETRY_AFTER_S,
+            best_effort_frac=envs.VDT_ADMISSION_BEST_EFFORT_FRAC,
         )

@@ -75,28 +75,37 @@ def _error_response(e: Exception) -> web.Response:
 DEADLINE_AT_KEY = "vdt_deadline_at"
 
 
-async def _request_deadline_s(request: web.Request) -> tuple[float, bool]:
-    """Per-request wall-clock deadline: the JSON body's ``timeout_s``
-    overrides VDT_REQUEST_TIMEOUT_S; 0 disables. Also reports whether
-    the request asked for streaming."""
+async def _admission_fields(
+        request: web.Request) -> tuple[float, bool, int]:
+    """Admission-relevant body fields: the per-request wall-clock
+    deadline (the JSON body's ``timeout_s`` overrides
+    VDT_REQUEST_TIMEOUT_S; 0 disables), whether the request asked for
+    streaming, and its priority class (``priority`` body field, lower =
+    more important; > 0 marks best-effort traffic the weighted shed
+    gate evicts first)."""
     from vllm_distributed_tpu import envs
     deadline = envs.VDT_REQUEST_TIMEOUT_S
     stream = False
+    priority = 0
     if request.content_type == "application/json":
         try:
-            # Cheap byte scan first: most requests carry neither key,
-            # and a full json.loads here would double the parse cost of
-            # every body (the handler parses the cached bytes again).
+            # Cheap byte scan first: most requests carry none of these
+            # keys, and a full json.loads here would double the parse
+            # cost of every body (the handler parses the cached bytes
+            # again).
             raw = await request.read()
-            if b'"timeout_s"' in raw or b'"stream"' in raw:
+            if (b'"timeout_s"' in raw or b'"stream"' in raw
+                    or b'"priority"' in raw):
                 body = await request.json()
                 if isinstance(body, dict):
                     stream = bool(body.get("stream"))
                     if body.get("timeout_s") is not None:
                         deadline = float(body["timeout_s"])
+                    if body.get("priority") is not None:
+                        priority = int(body["priority"])
         except Exception:  # noqa: BLE001 - handler reports bad JSON
             pass
-    return max(0.0, deadline), stream
+    return max(0.0, deadline), stream, priority
 
 
 async def _admission_middleware_factory(app, handler):
@@ -111,8 +120,16 @@ async def _admission_middleware_factory(app, handler):
         if (ctrl is None or request.method != "POST"
                 or request.path not in GENERATION_PATHS):
             return await handler(request)
+        # Read the body BEFORE acquire only when the gate's answer can
+        # actually depend on the priority class: a shed storm must stay
+        # O(1) per refusal (no body buffering/parsing for requests the
+        # gate refuses regardless of class). Admitted requests reuse
+        # the parse (or do the one parse) right after.
+        fields = None
+        if ctrl.class_sensitive():
+            fields = await _admission_fields(request)
         try:
-            await ctrl.acquire()
+            await ctrl.acquire(priority=fields[2] if fields else 0)
         except AdmissionRejected as e:
             kind = ("service_unavailable" if e.status == 503
                     else "overloaded")
@@ -122,7 +139,9 @@ async def _admission_middleware_factory(app, handler):
                 status=e.status,
                 headers={"Retry-After": str(e.retry_after_s)})
         try:
-            deadline, stream = await _request_deadline_s(request)
+            if fields is None:
+                fields = await _admission_fields(request)
+            deadline, stream, _ = fields
             if deadline > 0 and stream:
                 # A 408 cannot be written once the SSE stream begins:
                 # the stream pumps poll this instant and end the stream
@@ -211,6 +230,16 @@ async def metrics(request: web.Request) -> web.Response:
             "SIGTERM drain mode\n"
             "# TYPE vdt:admission_draining gauge\n"
             f"vdt:admission_draining {int(ctrl.draining)}\n")
+        if ctrl.shed_by_class:
+            text += (
+                "# HELP vdt:requests_shed_by_class_total Requests "
+                "refused at the admission gate per priority class "
+                "(weighted shedding evicts best_effort first)\n"
+                "# TYPE vdt:requests_shed_by_class_total counter\n")
+            text += "".join(
+                f'vdt:requests_shed_by_class_total{{class="{c}"}} '
+                f"{n}\n"
+                for c, n in sorted(ctrl.shed_by_class.items()))
     return web.Response(text=text, content_type="text/plain")
 
 
@@ -352,7 +381,7 @@ async def _debug_engine_json(app: web.Application) -> dict:
             "high_watermark": ctrl.high_watermark,
             "low_watermark": ctrl.low_watermark,
             "kv_high": ctrl.kv_high,
-            "shedding": ctrl._shedding,
+            "shedding": sorted(ctrl._shedding),
             "draining": ctrl.draining,
         }
     return {
@@ -745,8 +774,11 @@ async def responses(request: web.Request) -> web.Response:
                 mm["pixel_values"])}
         lora = _resolve_lora(request.app, body)
         rid = protocol.completion_id().replace("cmpl", "resp")
+        priority, tenant = _priority_tenant(body)
         final = await _drain(engine.generate(prompt, params,
                                              request_id=rid,
+                                             priority=priority,
+                                             tenant=tenant,
                                              lora_request=lora,
                                              multi_modal_data=mm))
         text = final.outputs[0].text
@@ -900,6 +932,23 @@ def _gen_prompts(body: dict) -> list:
     raise RequestError("`prompt` must be a string or list")
 
 
+def _priority_tenant(body: dict) -> tuple[int, Optional[str]]:
+    """Scheduling class + tenant identity off an OpenAI request body:
+    ``priority`` (int, lower = more important, > 0 = best-effort) and
+    ``tenant`` (falling back to the standard OpenAI ``user`` field).
+    Both ride EngineCoreRequest: priority drives the scheduler's
+    priority policy and the admission gate's weighted shedding, tenant
+    labels introspection."""
+    try:
+        priority = int(body.get("priority", 0) or 0)
+    except (TypeError, ValueError) as e:
+        raise RequestError(f"invalid priority: {e}") from e
+    tenant = body.get("tenant", body.get("user"))
+    if tenant is not None:
+        tenant = str(tenant)
+    return priority, tenant
+
+
 async def completions(request: web.Request) -> web.StreamResponse:
     engine = request.app[ENGINE_KEY]
     model = request.app[MODEL_KEY]
@@ -933,6 +982,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
             ]
         cid = protocol.completion_id()
         created = int(time.time())  # wallclock-ok
+        priority, tenant = _priority_tenant(body)
 
         # Fan out: one engine request per (prompt, sample) pair; choice
         # index follows OpenAI semantics (prompt-major, then n). Seeded
@@ -963,6 +1013,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                     child.seed = params.seed + s
                 gens.append((idx, engine.generate(
                     prompt, child, request_id=f"{cid}-{idx}",
+                    priority=priority, tenant=tenant,
                     lora_request=lora, multi_modal_data=enc_mm)))
 
         if stream:
@@ -1271,8 +1322,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             raise RequestError(
                 "streaming with a forced tool_choice is not supported "
                 "yet; set stream=false")
+        priority, tenant = _priority_tenant(body)
         gens = [(i, engine.generate(prompt, params,
                                     request_id=f"{cid}-{i}",
+                                    priority=priority, tenant=tenant,
                                     lora_request=lora,
                                     multi_modal_data=mm))
                 for i in range(n)]

@@ -137,6 +137,42 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # kept for CLI parity).
     "VDT_NO_USAGE_STATS":
     lambda: os.getenv("VDT_NO_USAGE_STATS", "1") == "1",
+    # --- Cluster routing tier (engine/router.py) ------------------------
+    # Prefix-affinity + SLO-aware replica placement for the DP front
+    # end. "0" reverts DPEngineClient to the pure live-count round-robin
+    # balancer (byte-identical to the pre-router behavior).
+    "VDT_ROUTER":
+    lambda: os.getenv("VDT_ROUTER", "1") == "1",
+    # Freshness budget (seconds) for the per-replica stats snapshots the
+    # router scores with. In-process replicas refresh synchronously on
+    # the admission path once the TTL expires; subprocess replicas are
+    # fed passively by the server's existing get_stats polls (/metrics,
+    # admission KV sampler) — never a new channel.
+    "VDT_ROUTER_STATS_TTL_S":
+    lambda: float(os.getenv("VDT_ROUTER_STATS_TTL_S", "1.0")),
+    # Staleness horizon: when EVERY replica's load snapshot is older
+    # than this, the router degrades to pure least-loaded balancing
+    # (affinity with blind load signals would herd session traffic onto
+    # one replica).
+    "VDT_ROUTER_STALE_S":
+    lambda: float(os.getenv("VDT_ROUTER_STALE_S", "5.0")),
+    # Max leading prompt pages hashed for the affinity score (bounds
+    # per-admission hashing cost for very long prompts).
+    "VDT_ROUTER_PREFIX_PAGES":
+    lambda: max(1, int(os.getenv("VDT_ROUTER_PREFIX_PAGES", "64"))),
+    # Per-replica bound on the prefix-residency index (LRU entries).
+    "VDT_ROUTER_PREFIX_CAPACITY":
+    lambda: max(16, int(os.getenv("VDT_ROUTER_PREFIX_CAPACITY", "8192"))),
+    # Seconds a residency entry stays credible without being touched
+    # (a replica under steady traffic has almost certainly recycled the
+    # pages by then).
+    "VDT_ROUTER_PREFIX_TTL_S":
+    lambda: float(os.getenv("VDT_ROUTER_PREFIX_TTL_S", "600")),
+    # Pressure (blended KV usage / queue score, 0..1) above which the
+    # affinity home is overridden and the request spills to the
+    # least-cost healthy replica.
+    "VDT_ROUTER_SPILL_PRESSURE":
+    lambda: float(os.getenv("VDT_ROUTER_SPILL_PRESSURE", "0.85")),
     # --- API admission control / overload protection -------------------
     # High watermark: concurrent admitted HTTP generation requests above
     # which the server sheds load with 429 + Retry-After. 0 disables
@@ -153,6 +189,13 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # second). 0.0 disables the KV-pressure trigger.
     "VDT_ADMISSION_KV_HIGH":
     lambda: float(os.getenv("VDT_ADMISSION_KV_HIGH", "0")),
+    # Weighted per-class shedding: fraction of the high/low watermarks
+    # at which BEST-EFFORT traffic (request priority > 0) sheds, so
+    # overload evicts best-effort requests before interactive ones.
+    # 1.0 disables the distinction (all classes share one watermark).
+    "VDT_ADMISSION_BEST_EFFORT_FRAC":
+    lambda: min(1.0, max(0.05, float(
+        os.getenv("VDT_ADMISSION_BEST_EFFORT_FRAC", "0.75")))),
     # Retry-After seconds advertised on shed (429) and drain (503).
     "VDT_RETRY_AFTER_S":
     lambda: max(1, int(os.getenv("VDT_RETRY_AFTER_S", "1"))),

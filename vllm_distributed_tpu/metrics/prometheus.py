@@ -132,7 +132,63 @@ LABELED_METRICS = {
     # Attention dispatch: which kernel family each step ran
     # (unified|decode|general|cascade|naive).
     "vdt:attn_kernel_calls_total": ("kernel", ),
+    # DP balancer + routing tier (engine/dp_client.py, engine/router.py).
+    "vdt:dp_replica_load": ("replica", ),
+    "vdt:router_prefix_index_entries": ("replica", ),
+    # Weighted admission shedding (entrypoints/openai/admission.py).
+    "vdt:requests_shed_by_class_total": ("class", ),
 }
+
+
+def _render_dp_balancer(stats: dict) -> list[str]:
+    """DP front-end balancer gauges: per-replica live request counts
+    and the alive-replica count. Rendered whenever the stats flowed
+    through DPEngineClient — with the router ON or OFF, so replica
+    imbalance stays visible while debugging either path."""
+    counts = stats.get("dp_request_counts")
+    if not isinstance(counts, list) or not counts:
+        return []
+    lines = ["# HELP vdt:dp_replica_load Live requests owned by each "
+             "DP replica (the balancer's routing load signal)",
+             "# TYPE vdt:dp_replica_load gauge"]
+    lines += [f'vdt:dp_replica_load{{replica="{i}"}} {int(n)}'
+              for i, n in enumerate(counts)]
+    down = stats.get("dp_replicas_down") or []
+    lines += ["# HELP vdt:replicas_in_rotation DP replicas currently "
+              "alive and accepting placements",
+              "# TYPE vdt:replicas_in_rotation gauge",
+              f"vdt:replicas_in_rotation {len(counts) - len(down)}"]
+    return lines
+
+
+def _render_router(router: dict) -> list[str]:
+    """Routing-tier families from the front-end ReplicaRouter (one
+    instance owns fleet placement, so values are exact, not merged)."""
+    lines: list[str] = []
+    for name, key, help_text in (
+        ("vdt:router_requests_routed_total", "requests_routed",
+         "Admissions placed by the routing tier"),
+        ("vdt:router_affinity_hits_total", "affinity_hits",
+         "Admissions routed to a replica already holding part of "
+         "their prefix"),
+        ("vdt:router_spillovers_total", "spillovers",
+         "Admissions whose affinity home was overridden because it "
+         "was pressured"),
+        ("vdt:router_stale_degradations_total", "stale_degradations",
+         "Admissions placed by pure load balancing because every "
+         "load snapshot was stale"),
+    ):
+        lines += [f"# HELP {name} {help_text}", f"# TYPE {name} counter",
+                  f"{name} {int(router.get(key, 0))}"]
+    entries = router.get("prefix_index_entries")
+    if isinstance(entries, list) and entries:
+        name = "vdt:router_prefix_index_entries"
+        lines += [f"# HELP {name} Prefix-residency index entries per "
+                  "replica (bounded LRU of page hashes)",
+                  f"# TYPE {name} gauge"]
+        lines += [f'{name}{{replica="{i}"}} {int(n)}'
+                  for i, n in enumerate(entries)]
+    return lines
 
 
 def _render_worker_telemetry(workers: dict) -> list[str]:
@@ -355,4 +411,10 @@ def render_metrics(stats: dict) -> str:
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:
         lines += _render_kv_cache(kv_cache)
+    # DP balancer load gauges + routing-tier counters (dp_client /
+    # router stats entries; absent on single-replica deployments).
+    lines += _render_dp_balancer(stats)
+    router = stats.get("router")
+    if isinstance(router, dict):
+        lines += _render_router(router)
     return "\n".join(lines) + "\n"

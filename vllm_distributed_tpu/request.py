@@ -58,7 +58,14 @@ class EngineCoreRequest:
     eos_token_id: Optional[int] = None
     # Epoch timestamp (user-facing stats), never deadline arithmetic.
     arrival_time: float = field(default_factory=time.time)  # wallclock-ok
+    # Priority class (lower = more important, matching the scheduler's
+    # priority policy): <= 0 is interactive, > 0 is best-effort — the
+    # admission gate sheds best-effort traffic first under overload.
     priority: int = 0
+    # Tenant identity (the OpenAI body's "tenant"/"user" field): labels
+    # per-class shedding and debug introspection; never trusted for
+    # isolation.
+    tenant: Optional[str] = None
     # Disaggregated prefill routing (reference: kv_transfer_params on the
     # request, nixl_connector.py:205).
     kv_transfer_params: Optional[dict[str, Any]] = None
@@ -114,6 +121,7 @@ class Request:
         lora_request: Optional[dict[str, str]] = None,
         pooling_params: Optional[dict[str, Any]] = None,
         mm_inputs: Optional[list] = None,
+        tenant: Optional[str] = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -125,6 +133,7 @@ class Request:
         self.arrival_time = (time.time()  # wallclock-ok: epoch stat
                              if arrival_time is None else arrival_time)
         self.priority = priority
+        self.tenant = tenant
         self.kv_transfer_params = kv_transfer_params
         self.lora_request = lora_request
         self.pooling_params = pooling_params
@@ -202,6 +211,7 @@ class Request:
             lora_request=req.lora_request,
             pooling_params=req.pooling_params,
             mm_inputs=req.mm_inputs,
+            tenant=req.tenant,
         )
 
     # ------------------------------------------------------------------

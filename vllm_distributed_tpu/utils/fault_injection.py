@@ -51,6 +51,10 @@ but never fired by production code):
   reconciliation; without one it raises, killing the core with batches
   still in flight — the drill proving the crash-recovery ladder works
   mid-pipeline.
+* ``router.stale_stats`` — the DP routing tier treats every replica's
+  load snapshot as expired (refreshes are suppressed while armed), so
+  tests can prove the router degrades to pure load balancing instead
+  of herding affinity traffic onto one replica on blind signals.
 """
 
 import threading
@@ -72,6 +76,7 @@ FAULT_POINTS = (
     "restart.storm",
     "admission.stall",
     "step.reconcile_stall",
+    "router.stale_stats",
 )
 
 
