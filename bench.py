@@ -449,6 +449,134 @@ def _routing_leg(config, record) -> None:
             os.environ["VDT_ROUTER"] = saved
 
 
+def _ssm_leg(record) -> None:
+    """SSM state-cache leg (ROADMAP item 5 acceptance): multi-turn
+    session traffic on a tiny dummy-weight Mamba model, state cache on
+    vs VDT_SSM_STATE_CACHE=0 on byte-identical traffic. Reports turn
+    throughput, resume-prefill tokens saved (the O(prompt) work the
+    snapshot restores skipped), and — with the checkpoint journal
+    armed — recovery-replay wall time after an injected
+    engine_core.die, comparable across cache on/off because the tail
+    re-prefill is the only difference."""
+    import asyncio
+    import gc
+    import shutil
+    import tempfile
+
+    from transformers import MambaConfig
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, ModelConfig,
+                                             SchedulerConfig)
+    from vllm_distributed_tpu.engine.async_llm import AsyncLLM
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.utils import fault_injection as fi
+
+    def make_config():
+        mc = ModelConfig(model="dummy-ssm-bench", dtype="float32",
+                         max_model_len=2048, skip_tokenizer_init=True)
+        mc.hf_config = MambaConfig(
+            vocab_size=2048, hidden_size=256, state_size=16,
+            num_hidden_layers=4, conv_kernel=4, expand=2,
+            time_step_rank=16, use_conv_bias=True, use_bias=False,
+            architectures=["MambaForCausalLM"])
+        cfg = EngineConfig(
+            model_config=mc,
+            cache_config=CacheConfig(block_size=16, num_gpu_blocks=512),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=1024, max_num_seqs=16,
+                max_model_len=2048, num_scheduler_steps=1),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+        cfg.fault_tolerance_config.restart_backoff_base_s = 0.01
+        cfg.fault_tolerance_config.restart_backoff_max_s = 0.05
+        return cfg
+
+    sessions, turns, gen_tokens = 4, 4, 16
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_tokens,
+                        ignore_eos=True)
+    rng = np.random.default_rng(11)
+    base = {s: [int(x) for x in rng.integers(10, 2000, size=256)]
+            for s in range(sessions)}
+    extra = {(t, s): int(rng.integers(10, 2000))
+             for t in range(turns) for s in range(sessions)}
+    ckpt_dir = tempfile.mkdtemp(prefix="vdt_ssm_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("VDT_SSM_STATE_CACHE", "VDT_SSM_CKPT_INTERVAL",
+                       "VDT_SSM_CKPT_DIR")}
+    try:
+        os.environ["VDT_SSM_CKPT_INTERVAL"] = "64"
+        for leg, flag in (("on", "1"), ("off", "0")):
+            os.environ["VDT_SSM_STATE_CACHE"] = flag
+            os.environ.pop("VDT_SSM_CKPT_DIR", None)
+            engine = LLMEngine(make_config(), load_tokenizer=False)
+            prompts = {s: list(base[s]) for s in range(sessions)}
+            t0 = time.perf_counter()
+            for t in range(turns):
+                done = {}
+                for s in range(sessions):
+                    engine.add_request(f"ssm-{leg}-{t}-{s}",
+                                       list(prompts[s]), sp)
+                while engine.has_unfinished_requests():
+                    for o in engine.step():
+                        if o.finished:
+                            done[o.request_id] = o
+                for s in range(sessions):
+                    toks = list(
+                        done[f"ssm-{leg}-{t}-{s}"].outputs[0].token_ids)
+                    prompts[s] = prompts[s] + toks + [extra[(t, s)]]
+            wall = time.perf_counter() - t0
+            stats = engine.get_stats()
+            record[f"ssm_{leg}_turns_per_s"] = round(
+                sessions * turns / wall, 2)
+            if flag == "1":
+                record["ssm_resume_tokens_saved"] = int(
+                    stats.get("ssm_resume_tokens_saved", 0))
+                record["ssm_state_cache_hits"] = int(
+                    stats.get("ssm_state_cache_hits", 0))
+                record["ssm_checkpoints"] = int(
+                    stats.get("ssm_checkpoints", 0))
+            engine.shutdown()
+            del engine
+            gc.collect()
+
+        # Recovery leg: die mid-decode on a long prompt; the journal
+        # checkpoint bounds the replayed prefill to the interval tail.
+        async def run_once(engine, rid):
+            final, first = None, False
+            async for out in engine.generate(
+                    list(base[0]), sp, request_id=rid):
+                if not first:
+                    first = True
+                    fi.inject("engine_core.die", max_fires=1)
+                final = out
+            return final
+
+        for leg, flag in (("on", "1"), ("off", "0")):
+            os.environ["VDT_SSM_STATE_CACHE"] = flag
+            os.environ["VDT_SSM_CKPT_DIR"] = ckpt_dir
+            engine = AsyncLLM(make_config(), load_tokenizer=False)
+            try:
+                t0 = time.perf_counter()
+                asyncio.run(asyncio.wait_for(
+                    run_once(engine, f"ssm-rec-{leg}"), timeout=300))
+                record[f"ssm_recovery_{leg}_wall_s"] = round(
+                    time.perf_counter() - t0, 3)
+            finally:
+                fi.clear("engine_core.die")
+                engine.shutdown()
+                del engine
+                gc.collect()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _phase_percentiles(engine, record) -> None:
     """p50/p95/p99 per lifecycle phase (queue/prefill/decode/...) from
     the output processor's timeline-derived durations — the per-request
@@ -886,6 +1014,12 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        # SSM state-cache leg: multi-turn session traffic on a mamba
+        # model, cache on vs off + recovery-replay wall time.
+        try:
+            _ssm_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -940,6 +1074,10 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _ssm_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

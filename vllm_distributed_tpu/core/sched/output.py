@@ -86,6 +86,17 @@ class SchedulerOutput:
     # roundtrip per burst instead of per token). Slots for all steps are
     # pre-allocated via num_lookahead_tokens.
     multi_step: int = 1
+    # SSM state cache (core/state_cache.py): snapshot copies the runner
+    # executes AFTER this step's forward (each request's state rows ->
+    # its assigned pool slot; preempt-parks ride here too — a parked
+    # request runs no tokens, so pre/post makes no difference for it),
+    # and restores it executes BEFORE the forward (pool slot or host
+    # checkpoint file -> the request's state rows, so the segmented
+    # scan re-enters mid-sequence via its has_init carry path). Only
+    # attached to outputs with scheduled tokens (the zero-token
+    # dispatch path does no device work by contract).
+    state_saves: "list | None" = None
+    state_restores: "list | None" = None
     # True when the scheduler granted this batch under async scheduling:
     # request.num_computed_tokens was already advanced AT SCHEDULE TIME
     # (so step N+1 could be granted while step N executes), and

@@ -140,11 +140,63 @@ class Scheduler:
                             "prefix caching disabled")
             self.enable_chunked_prefill = False
             enable_caching = False
-        if enable_caching and resolve_stateful(config.model_config):
-            # SSM state cannot re-enter at a cached page boundary; the
-            # reference disables prefix caching for mamba models too.
-            logger.info("stateful (SSM) model: prefix caching disabled")
-            enable_caching = False
+        # SSM state cache (core/state_cache.py): give fixed-size state
+        # snapshots the same rights paged KV has. A snapshot at a token
+        # boundary is a complete resume point, so "prefix caching" for
+        # stateful models = restore the state at the shared boundary.
+        self.state_cache = None
+        if resolve_stateful(config.model_config):
+            from vllm_distributed_tpu.core.state_cache import (
+                StateCacheManager, resolve_ckpt_interval,
+                resolve_state_slots, state_cache_enabled)
+            from vllm_distributed_tpu.models.loader import \
+                resolve_state_only
+            if (state_cache_enabled(config, True)
+                    and kv_connector is None):
+                from vllm_distributed_tpu import envs as _envs
+                paged = not resolve_state_only(config.model_config)
+                if paged and not enable_caching:
+                    # Hybrid (Jamba/Bamba): a state restore must re-enter
+                    # coherently with the attention KV of the same
+                    # prefix, so the page prefix cache MUST index those
+                    # pages.
+                    logger.info("hybrid SSM model: prefix caching forced "
+                                "on for the state cache")
+                    enable_caching = True
+                elif not paged:
+                    # Pure SSM: pages carry no bytes; the state cache
+                    # keys its own hash chains.
+                    enable_caching = False
+                self.state_cache = StateCacheManager(
+                    num_slots=resolve_state_slots(config),
+                    block_size=config.cache_config.block_size,
+                    interval=resolve_ckpt_interval(config),
+                    paged_kv=paged,
+                    journal_dir=_envs.VDT_SSM_CKPT_DIR)
+                logger.info(
+                    "SSM state cache: %d slots, checkpoint every %d "
+                    "tokens%s", self.state_cache.num_slots,
+                    self.state_cache.interval,
+                    f", journal {self.state_cache.journal_dir}"
+                    if self.state_cache.journal_dir else "")
+                if getattr(sched_cfg, "num_scheduler_steps", 1) > 1:
+                    # Fused decode bursts advance state mid-burst past
+                    # snapshot boundaries; keep the cadence exact.
+                    logger.info("SSM state cache: multi-step decode "
+                                "bursts disabled")
+                    self.num_scheduler_steps = 1
+            else:
+                # SSM state cannot re-enter at a cached page boundary;
+                # without the state cache the reference behavior stands
+                # (prefix caching disabled for mamba models).
+                logger.info("stateful (SSM) model: prefix caching "
+                            "disabled (state cache off)")
+                enable_caching = False
+        # Save directives not yet attached to an output (a preempt-park
+        # on a step whose grant came up empty defers to the next
+        # non-empty output — the zero-token dispatch path does no
+        # device work by contract).
+        self._deferred_state_saves: list = []
         if self.tknp_size > 1:
             self.kv_cache_manager = TokenParallelKVCacheManager(
                 block_size=config.cache_config.block_size,
@@ -387,6 +439,12 @@ class Scheduler:
             self.kv_cache_manager.free_block_hashes(request)
         if self.structured_manager is not None:
             self.structured_manager.remove_request(request.request_id)
+        if self.state_cache is not None:
+            # Uncommitted saves die with the request (their row is about
+            # to be recycled); committed snapshots outlive it — they ARE
+            # the multi-turn prefix cache.
+            self.state_cache.abort_pending(request.request_id)
+            self.state_cache.drop_request(request.request_id)
         self.finished_req_ids.add(request.request_id)
         del self.requests[request.request_id]
         return params
@@ -490,6 +548,10 @@ class Scheduler:
         # Batch composition (prefill vs decode tokens) of this step.
         prefill_tokens = 0
         decode_tokens = 0
+        # SSM state-cache directives accumulated this step (plus any
+        # deferred from empty outputs).
+        state_saves: list = []
+        state_restores: list = []
 
         # Multi-step decode burst: when every running request is in plain
         # decode and nothing is waiting, the worker can run N fused decode
@@ -551,6 +613,11 @@ class Scheduler:
             num_new_tokens = min(
                 num_new_tokens,
                 self.max_model_len - request.num_computed_tokens)
+            if self.state_cache is not None and num_new_tokens > 0:
+                # Land prefill chunks exactly on snapshot boundaries so
+                # the state rows hold boundary state when the copy runs.
+                num_new_tokens = self.state_cache.clip_grant(
+                    request.num_computed_tokens, num_new_tokens)
             if num_new_tokens <= 0:
                 req_index += 1
                 continue
@@ -630,6 +697,16 @@ class Scheduler:
             cached_reqs.new_block_ids.append(new_blocks.get_block_ids())
             cached_reqs.num_computed_tokens.append(
                 request.num_computed_tokens)
+            if self.state_cache is not None:
+                # Snapshot when this grant lands exactly on a boundary
+                # (committed in update_from_output once the step's
+                # tokens reconcile — an async run-ahead that stops
+                # short never enters the index).
+                directive = self.state_cache.maybe_save(
+                    request,
+                    request.num_computed_tokens + num_new_tokens)
+                if directive is not None:
+                    state_saves.append(directive)
             if self.async_scheduling:
                 # Advance AT GRANT TIME so the next schedule() call can
                 # run ahead of this batch; update_from_output skips the
@@ -701,6 +778,8 @@ class Scheduler:
 
                 num_computed_tokens = request.num_computed_tokens
                 new_computed_blocks: Optional[KVCacheBlocks] = None
+                state_restore = None
+                state_only_admit = False
                 if (num_computed_tokens == 0
                         and request.sampling_params.prompt_logprobs
                         is None):
@@ -708,8 +787,31 @@ class Scheduler:
                     # prompt_logprobs requests — cached positions never
                     # run a forward, so their entries could not be
                     # scored (the reference likewise recomputes).
-                    new_computed_blocks, num_computed_tokens = \
-                        self.kv_cache_manager.get_computed_blocks(request)
+                    if self.state_cache is not None:
+                        # Stateful models: the longest prefix with a
+                        # live state snapshot (and, for hybrid models,
+                        # its attention pages still cached) is a
+                        # complete resume point — admit as a
+                        # continuation at the boundary.
+                        blocks, boundary, state_restore = \
+                            self.state_cache.get_computed_state(
+                                request, self._block_pools()[0])
+                        if boundary:
+                            num_computed_tokens = boundary
+                            if blocks:
+                                new_computed_blocks = KVCacheBlocks(
+                                    blocks)
+                            else:
+                                # Pure-SSM models need no prefix pages;
+                                # the boundary is marked computed just
+                                # before allocation so allocate_slots
+                                # covers the whole token range with
+                                # fresh (content-free) pages.
+                                state_only_admit = True
+                    else:
+                        new_computed_blocks, num_computed_tokens = \
+                            self.kv_cache_manager.get_computed_blocks(
+                                request)
                     if request.num_cached_tokens < 0:
                         request.num_cached_tokens = num_computed_tokens
 
@@ -771,12 +873,23 @@ class Scheduler:
                     if not self.enable_chunked_prefill:
                         break  # must fit in one step
                     num_new_tokens = token_budget
+                if (self.state_cache is not None
+                        and self.enable_chunked_prefill):
+                    num_new_tokens = self.state_cache.clip_grant(
+                        num_computed_tokens, num_new_tokens)
                 assert num_new_tokens > 0
 
+                if state_only_admit:
+                    request.num_computed_tokens = num_computed_tokens
                 new_blocks = self.kv_cache_manager.allocate_slots(
                     request, num_external + num_new_tokens,
                     new_computed_blocks)
                 if new_blocks is None:
+                    if state_only_admit:
+                        # Still WAITING: the next attempt re-runs the
+                        # lookup (the snapshot may have been evicted by
+                        # then, so the hit must not be sticky).
+                        request.num_computed_tokens = 0
                     # Out of pages; retry next step. A fresh token-parallel
                     # request holding nothing un-pins from its rank so the
                     # next attempt re-picks by load (a full rank must not
@@ -809,9 +922,27 @@ class Scheduler:
                                    ev.RESUMED if resumed else ev.SCHEDULED,
                                    {"computed": num_computed_tokens,
                                     "granted": num_new_tokens})
+                if self.state_cache is not None:
+                    # This grant rewrites the recurrence from
+                    # `num_computed_tokens`; any uncommitted park of an
+                    # older boundary no longer describes the row.
+                    self.state_cache.abort_pending(request.request_id)
+                    if state_restore is not None:
+                        state_restores.append(state_restore)
+                        # Hit accounting lives HERE (not in the lookup):
+                        # a blocked queue head re-runs the lookup every
+                        # step and must not inflate the hit rate.
+                        self.state_cache.hits += 1
+                        self.state_cache.resume_tokens_saved += \
+                            num_computed_tokens
 
                 num_scheduled_tokens[request.request_id] = num_new_tokens
                 token_budget -= num_new_tokens
+                if self.state_cache is not None:
+                    directive = self.state_cache.maybe_save(
+                        request, num_computed_tokens + num_new_tokens)
+                    if directive is not None:
+                        state_saves.append(directive)
                 if num_computed_tokens < request.num_prompt_tokens:
                     prefill_tokens += num_new_tokens
                 else:
@@ -884,6 +1015,23 @@ class Scheduler:
             structured_masks=structured_masks,
             async_scheduled=self.async_scheduling,
         )
+        if self.state_cache is not None:
+            saves = self._deferred_state_saves + state_saves
+            if num_scheduled_tokens:
+                # Aborted parks (their request restarted from scratch
+                # or finished) must not reach the runner — the row no
+                # longer holds the boundary's state. Owed journal
+                # writes of already-committed async saves ride along
+                # as persist_only directives.
+                output.state_saves = ([
+                    d for d in saves if self.state_cache.is_pending(d)
+                ] + self.state_cache.take_persists()) or None
+                output.state_restores = state_restores or None
+                self._deferred_state_saves = []
+            else:
+                # The zero-token dispatch path does no device work by
+                # contract; park copies wait for the next real batch.
+                self._deferred_state_saves = saves
         self.finished_req_ids = set()
         if self.kv_connector is not None:
             output.kv_connector_metadata = \
@@ -958,6 +1106,18 @@ class Scheduler:
 
     def _preempt(self, request: Request, cause: str = "capacity") -> None:
         self.running.remove(request)
+        if self.state_cache is not None:
+            # Park the state instead of discarding: when the eviction
+            # boundary is snapshot-aligned the resume restores it and
+            # re-prefills nothing; otherwise the latest periodic
+            # snapshot bounds the re-prefill to the tail since the
+            # last checkpoint. (The copy rides the next non-empty
+            # output; the parked request runs no tokens until resume,
+            # so its rows stay exactly at the parked state.)
+            directive = self.state_cache.maybe_save(
+                request, request.num_computed_tokens)
+            if directive is not None:
+                self._deferred_state_saves.append(directive)
         self.kv_cache_manager.free(request)
         request.status = RequestStatus.PREEMPTED
         request.num_computed_tokens = 0
@@ -1126,6 +1286,18 @@ class Scheduler:
                     prompt_logprobs=prompt_lps,
                     events=self._take_events(request),
                 ))
+
+        # Commit this step's state snapshots now that its tokens have
+        # reconciled: a snapshot enters the lookup index only when the
+        # request really committed tokens through its boundary (an
+        # async run-ahead that stopped short is discarded). Runs before
+        # the finished frees below so a request that finished AT the
+        # boundary still commits — its snapshot is the next turn's
+        # resume point.
+        if self.state_cache is not None and scheduler_output.state_saves:
+            for directive in scheduler_output.state_saves:
+                self.state_cache.commit_save(
+                    directive, self.requests.get(directive.req_id))
 
         for request in finished:
             self.running.remove(request)
@@ -1421,6 +1593,8 @@ class Scheduler:
             "last_step_decode_tokens": self.last_step_decode_tokens,
             **self.kv_cache_manager.make_prefix_cache_stats(),
         }
+        if self.state_cache is not None:
+            stats.update(self.state_cache.stats())
         if self.tknp_size > 1:
             for r, n in enumerate(self.tknp_tokens_per_rank):
                 stats[f"tknp_tokens_rank{r}"] = n

@@ -244,7 +244,14 @@ class AsyncLLM:
         as a continuation prefill: prompt = original prompt + tokens
         already delivered, remaining token budget adjusted. With greedy
         sampling the resumed stream is token-identical to an
-        uninterrupted run."""
+        uninterrupted run.
+
+        Stateful (SSM) models: the fresh core's admission consults the
+        state-cache checkpoint journal (core/state_cache.py,
+        VDT_SSM_CKPT_DIR), so a replayed request resumes from its last
+        checksummed checkpoint and re-prefills at most
+        VDT_SSM_CKPT_INTERVAL tokens instead of the whole continuation
+        prompt — O(1) recovery where re-prefill used to be O(prompt)."""
         with self._journal_lock:
             pending = list(self._journal.items())
         for rid, orig in pending:

@@ -83,6 +83,19 @@ class EngineCore:
                                                KVConnectorRole.SCHEDULER)
             self.scheduler = Scheduler(config, num_blocks=num_pages,
                                        kv_connector=kv_connector)
+            if self.scheduler.state_cache is not None:
+                # The scheduler never touches device arrays; hand it the
+                # runner's per-slot pool bytes so vdt:ssm_state_bytes_held
+                # reports real HBM. A runner without a pool (executor
+                # variants the gate excludes) leaves it at 0.
+                runner = getattr(getattr(self.executor, "worker", None),
+                                 "model_runner", None)
+                if runner is not None:
+                    self.scheduler.state_cache.bytes_per_slot = getattr(
+                        runner, "state_pool_slot_bytes", lambda: 0)()
+                    self.scheduler.state_cache.journal_fingerprint = \
+                        getattr(runner, "_state_fingerprint",
+                                lambda: b"")()
         finally:
             restore()
         # Batch queue: in-flight (scheduler_output, handle) pairs,
@@ -441,6 +454,10 @@ class EngineCore:
         # zeroed pages (reference: sleep implies reset_prefix_cache).
         if not self.scheduler.kv_cache_manager.reset_prefix_cache():
             logger.warning("prefix cache reset failed during sleep")
+        if self.scheduler.state_cache is not None:
+            # Same rule for SSM snapshots: the pool's HBM was released,
+            # so the index must forget every slot.
+            self.scheduler.state_cache.reset()
         return freed
 
     def wake_up(self) -> None:

@@ -556,7 +556,10 @@ class DPEngineClient(EngineCoreClient):
                      "replica_failovers": self.replica_failovers,
                      "replica_resurrections":
                          self.replica_resurrections}
-        # Sum numeric leaves across replicas for the headline counters;
+        # Sum numeric leaves across replicas for the headline counters
+        # (this loop is also what merges the flat vdt:ssm_* state-cache
+        # families — hits/queries/evictions/checkpoints sum, and
+        # bytes_held sums to the fleet's snapshot footprint);
         # ratio gauges average instead (a 4-replica deployment at 25%
         # KV usage is at 25%, not 100% — the admission gate's KV shed
         # reads this value), and peak gauges take the max (summing

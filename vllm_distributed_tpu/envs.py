@@ -173,6 +173,29 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # least-cost healthy replica.
     "VDT_ROUTER_SPILL_PRESSURE":
     lambda: float(os.getenv("VDT_ROUTER_SPILL_PRESSURE", "0.85")),
+    # --- SSM state cache (core/state_cache.py) --------------------------
+    # First-class state checkpoint/restore for stateful (Mamba/Jamba)
+    # models: prefix-style admission at snapshot boundaries, preemption
+    # that parks state instead of recomputing, and O(1) crash-recovery
+    # resume. "0" reverts wholesale to the pre-cache behavior (prefix
+    # caching disabled for stateful models, preemption recomputes from
+    # token 0, journal replay re-prefills the whole prompt).
+    "VDT_SSM_STATE_CACHE":
+    lambda: os.getenv("VDT_SSM_STATE_CACHE", "1") == "1",
+    # Snapshot-pool slots (device rows per state array). 0 = auto:
+    # max(2 * max_num_seqs, 8).
+    "VDT_SSM_STATE_CACHE_SLOTS":
+    lambda: max(0, int(os.getenv("VDT_SSM_STATE_CACHE_SLOTS", "0"))),
+    # Checkpoint cadence in tokens (rounded up to a page multiple so
+    # every snapshot boundary is also a block-hash boundary). Crash
+    # recovery re-prefills at most this many tokens.
+    "VDT_SSM_CKPT_INTERVAL":
+    lambda: max(1, int(os.getenv("VDT_SSM_CKPT_INTERVAL", "256"))),
+    # Host checkpoint-journal directory for crash recovery ("" keeps
+    # snapshots device-only). Files use the shared_storage connector's
+    # atomic tmp+rename discipline, one .npz per snapshot boundary.
+    "VDT_SSM_CKPT_DIR":
+    lambda: os.getenv("VDT_SSM_CKPT_DIR", ""),
     # --- API admission control / overload protection -------------------
     # High watermark: concurrent admitted HTTP generation requests above
     # which the server sheds load with 429 + Retry-After. 0 disables

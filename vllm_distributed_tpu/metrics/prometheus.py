@@ -39,6 +39,11 @@ _GAUGES = {
                                 "Decode tokens granted in the most "
                                 "recent non-empty scheduler step "
                                 "(summed across DP replicas)"),
+    # SSM state cache (core/state_cache.py; stateful models only —
+    # the scheduler omits the key otherwise).
+    "ssm_state_bytes_held": ("vdt:ssm_state_bytes_held",
+                             "Device bytes held by live SSM state "
+                             "snapshots (summed across DP replicas)"),
 }
 
 _COUNTERS = {
@@ -92,6 +97,20 @@ _COUNTERS = {
     "precompile_graphs": ("vdt:precompile_graphs_total",
                           "XLA graphs compiled by the precompile "
                           "warm-up suite"),
+    # SSM state cache (core/state_cache.py): prefix-style admission at
+    # snapshot boundaries for stateful (Mamba/Jamba) models.
+    "ssm_state_cache_hits": ("vdt:ssm_state_cache_hits_total",
+                             "Stateful admissions resumed from a state "
+                             "snapshot instead of token 0"),
+    "ssm_state_cache_queries": ("vdt:ssm_state_cache_queries_total",
+                                "Stateful admission lookups against "
+                                "the state-snapshot index"),
+    "ssm_state_cache_evictions": ("vdt:ssm_state_cache_evictions_total",
+                                  "State snapshots evicted (LRU) to "
+                                  "make room for new checkpoints"),
+    "ssm_checkpoints": ("vdt:ssm_checkpoints_total",
+                        "SSM state snapshots committed at checkpoint "
+                        "boundaries (periodic cadence + preempt parks)"),
 }
 
 

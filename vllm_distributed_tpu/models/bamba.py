@@ -244,6 +244,10 @@ class BambaForCausalLM(JambaForCausalLM):
         }
 
     # ------------------------------------------------------------------
+    # state_shapes() (snapshot-pool geometry for core/state_cache.py)
+    # is inherited from Jamba: mamba-stack depth with THIS override's
+    # Mamba-2 arrays (conv + conv_bc + ssm), so hybrid Mamba-2
+    # checkpoints snapshot all three state tensors coherently.
     def _state_shapes(self, depth: int) -> dict:
         # Must match the Mamba-2 mixer's state layout exactly: delegate
         # to the single source of truth in models/mamba.py.

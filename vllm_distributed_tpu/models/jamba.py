@@ -49,6 +49,10 @@ class JambaForCausalLM(MixtralForCausalLM):
     QUANT_TARGETS = ()
     LORA_TARGETS = ()
     STATEFUL = True
+    # Hybrid stack: state restores must re-enter coherently with the
+    # attention layers' cached KV pages (core/state_cache.py requires
+    # every prefix page resident for a hit).
+    STATE_ONLY = False
 
     def quantize_params(self, params: dict) -> dict:
         if self.cfg.quantization:
@@ -338,6 +342,12 @@ class JambaForCausalLM(MixtralForCausalLM):
             "ssm": ((depth, S, c.d_inner, c.ssm_state_size),
                     jnp.float32),
         }
+
+    def state_shapes(self) -> dict:
+        """Snapshot-pool geometry (core/state_cache.py): the mamba
+        stack's state arrays only — paged K/V re-enters through the
+        ordinary prefix cache."""
+        return self._state_shapes(len(self._mamba_layers))
 
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None,

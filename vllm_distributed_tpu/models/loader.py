@@ -389,6 +389,19 @@ def resolve_stateful(model_config) -> bool:
     return bool(getattr(model_cls, "STATEFUL", False))
 
 
+def resolve_state_only(model_config) -> bool:
+    """True for pure-SSM stacks (Mamba family): pages carry no KV
+    bytes, so a state snapshot alone is a complete resume point and the
+    state cache skips the page-residency requirement hybrid stacks
+    (Jamba/Bamba) need for coherent re-entry."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+    except Exception:  # noqa: BLE001 - conservative
+        return False
+    return bool(getattr(model_cls, "STATE_ONLY", False))
+
+
 def resolve_free_window(model_config) -> Optional[int]:
     """Token window below which KV pages can be freed mid-request: the
     minimum layer window when EVERY attention layer is windowed, else

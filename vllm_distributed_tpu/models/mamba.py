@@ -59,6 +59,10 @@ class MambaForCausalLM(LlamaForCausalLM):
     QUANT_TARGETS = ()  # weight quantization for SSM stacks: follow-up
     LORA_TARGETS = ()
     STATEFUL = True
+    # Pure-SSM stack: pages carry no bytes, so a state snapshot alone is
+    # a complete resume point (hybrid stacks set this False — their
+    # restores must re-enter coherently with cached attention pages).
+    STATE_ONLY = True
 
     @classmethod
     def arch_config_source(cls, hf):
@@ -275,6 +279,13 @@ class MambaForCausalLM(LlamaForCausalLM):
             "ssm": ((depth, S, c.d_inner, c.ssm_state_size),
                     jnp.float32),
         }
+
+    def state_shapes(self) -> dict:
+        """State-array geometry for the snapshot pool
+        (core/state_cache.py): {name: ((depth, S+1, ...), dtype)} —
+        axis 1 is the per-request slot axis the runner's snapshot
+        copies gather/scatter along."""
+        return self._state_shapes(self.cfg.num_layers)
 
     def make_kv_caches(self, num_pages: int, page_size: int,
                        cache_dtype=None,

@@ -33,6 +33,13 @@ request, so paging buys nothing — this is the TPU form of the
 reference's MambaSpec "one block per request" cache
 (vllm/v1/kv_cache_interface.py MambaSpec, block_size = max_model_len).
 Row S (= max_reqs) is a dump slot for padding writes.
+
+The state cache (core/state_cache.py) re-enters the scan mid-sequence
+through exactly this machinery: a restore fills the request's state
+rows before the forward, and because the restored request is admitted
+as a continuation (chunk_pos0 > 0), ``build_segment_info`` raises its
+``has_init`` flag and the scan folds the restored carry into the
+chunk's first token — no scan-side special case exists or is needed.
 """
 
 from __future__ import annotations

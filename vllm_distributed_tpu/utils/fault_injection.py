@@ -55,6 +55,10 @@ but never fired by production code):
   load snapshot as expired (refreshes are suppressed while armed), so
   tests can prove the router degrades to pure load balancing instead
   of herding affinity traffic onto one replica on blind signals.
+* ``ssm.restore_corrupt`` — a restored SSM state checkpoint fails its
+  checksum verification (core/state_cache.read_journal), proving the
+  scheduler degrades the admission to a full re-prefill (counted in
+  ``ssm_restore_corruptions``) instead of resuming from corrupt state.
 """
 
 import threading
@@ -77,6 +81,7 @@ FAULT_POINTS = (
     "admission.stall",
     "step.reconcile_stall",
     "router.stale_stats",
+    "ssm.restore_corrupt",
 )
 
 

@@ -67,6 +67,31 @@ class TPUModelRunner:
 
     @staticmethod
     @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _state_row_to_pool(pool, cache, row, slot):
+        """SSM state snapshot: copy one request's state rows (axis 1 of
+        every layer) into a snapshot-pool slot. Dispatched AFTER the
+        step's forward, so program order guarantees the copied state is
+        exactly the post-step (boundary) state."""
+        return pool.at[:, slot].set(cache[:, row])
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _state_pool_to_row(cache, pool, row, slot):
+        """SSM state restore: fill a request's state rows from a pool
+        slot. Dispatched BEFORE the forward — the segmented scan then
+        re-enters mid-sequence through its has_init carry path
+        (ops/mamba.build_segment_info)."""
+        return cache.at[:, row].set(pool[:, slot])
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
+    def _state_put_row(cache, value, row):
+        """SSM state restore from a host checkpoint (crash recovery):
+        upload the journaled state directly into the request's rows."""
+        return cache.at[:, row].set(value)
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, ))
     def _chain_record(last, rows, tokens):
         """Async scheduling: scatter this step's sampled tokens (still
         on device) into the per-row last-sampled mirror at DISPATCH
@@ -236,6 +261,16 @@ class TPUModelRunner:
         # behind vdt:precompile_graphs_total.
         self.attn_kernel_calls: dict[str, int] = {}
         self.precompile_graphs = 0
+        # SSM state-snapshot pool (core/state_cache.py): per-state-array
+        # device buffers of `resolve_state_slots` slots, written/read by
+        # the scheduler's state_saves/state_restores directives. Built
+        # in initialize_kv_cache once the model (and its state
+        # geometry) exists; None for stateless models or with the cache
+        # disabled.
+        self._state_pool: Optional[dict] = None
+        self._state_keys: list[str] = []
+        self.num_state_checkpoints = 0
+        self.num_state_restores = 0
 
     # ------------------------------------------------------------------
     def load_model(self) -> None:
@@ -308,8 +343,147 @@ class TPUModelRunner:
         assert self.model is not None
         self.num_pages = num_pages
         self.kv_caches = self._make_sharded_caches(num_pages)
+        self._init_state_pool()
         if self._forward_fn is None:
             self._build_step_fn()
+
+    # ------------------------------------------------------------------
+    # SSM state-snapshot pool (core/state_cache.py device half)
+    # ------------------------------------------------------------------
+    def _state_cache_active(self) -> bool:
+        if self.model is None or not getattr(self.model, "STATEFUL",
+                                             False):
+            return False
+        from vllm_distributed_tpu.core.state_cache import \
+            state_cache_enabled
+        return state_cache_enabled(self.config, True)
+
+    def _init_state_pool(self) -> None:
+        if not self._state_cache_active():
+            return
+        from jax.sharding import NamedSharding
+
+        from vllm_distributed_tpu.core.state_cache import \
+            resolve_state_slots
+        n_slots = resolve_state_slots(self.config)
+        shapes = self.model.state_shapes()
+        specs = self.model.kv_cache_specs()
+        self._state_keys = sorted(shapes)
+        with self.mesh:
+            self._state_pool = {
+                name: jax.device_put(
+                    jnp.zeros((shape[0], n_slots) + shape[2:], dtype),
+                    NamedSharding(self.mesh, specs[name]))
+                for name, (shape, dtype) in shapes.items()
+            }
+        logger.info("SSM state pool: %d slots, %.2f MiB",
+                    n_slots, self.state_pool_bytes() / 2**20)
+
+    def _state_fingerprint(self) -> bytes:
+        """Journal geometry fingerprint (core/state_cache.py): stamped
+        into every checkpoint file and checked at lookup so a shared
+        VDT_SSM_CKPT_DIR never serves a shape-foreign snapshot."""
+        from vllm_distributed_tpu.core.state_cache import \
+            state_fingerprint
+        return state_fingerprint(self.model.state_shapes())
+
+    def state_pool_slot_bytes(self) -> int:
+        """Device bytes of ONE snapshot (all state arrays, all layers)."""
+        if not self._state_cache_active():
+            return 0
+        return sum(
+            int(np.prod((shape[0], ) + shape[2:]))
+            * jnp.dtype(dtype).itemsize
+            for shape, dtype in self.model.state_shapes().values())
+
+    def state_pool_bytes(self) -> int:
+        """Total pool footprint, charged against the fixed-state HBM
+        budget by worker.determine_num_available_blocks."""
+        if not self._state_cache_active():
+            return 0
+        from vllm_distributed_tpu.core.state_cache import \
+            resolve_state_slots
+        return resolve_state_slots(self.config) * \
+            self.state_pool_slot_bytes()
+
+    def _apply_state_restores(self, scheduler_output) -> None:
+        """Execute state_restores BEFORE the forward: the restored rows
+        are the carry the segmented scan re-enters with."""
+        restores = getattr(scheduler_output, "state_restores", None)
+        if not restores or self._state_pool is None:
+            return
+        from vllm_distributed_tpu.core.state_cache import read_journal
+        with self.mesh:
+            self._run_state_restores(restores, read_journal)
+
+    def _run_state_restores(self, restores, read_journal) -> None:
+        for d in restores:
+            row = self.input_batch.req_id_to_index.get(d.req_id)
+            if row is None:
+                logger.warning("state restore for unknown request %s",
+                               d.req_id)
+                continue
+            if d.slot >= 0:
+                for name in self._state_keys:
+                    with self._compile_watch(("ssm_restore", name)):
+                        self.kv_caches[name] = self._state_pool_to_row(
+                            self.kv_caches[name], self._state_pool[name],
+                            row, d.slot)
+            else:
+                # Crash-recovery journal hit: the scheduler verified the
+                # checksum at lookup and carried the payload on the
+                # (in-proc) directive. A re-read that fails must fail
+                # loudly — uploading nothing would silently resume from
+                # another request's state.
+                arrays = d.arrays or read_journal(d.journal)
+                if arrays is None:
+                    raise RuntimeError(
+                        f"SSM checkpoint {d.journal} became unreadable "
+                        f"between scheduler lookup and restore")
+                for name in self._state_keys:
+                    with self._compile_watch(("ssm_put", name)):
+                        self.kv_caches[name] = self._state_put_row(
+                            self.kv_caches[name],
+                            jnp.asarray(arrays[name]), row)
+            self.num_state_restores += 1
+
+    def _apply_state_saves(self, scheduler_output) -> None:
+        """Execute state_saves AFTER the forward dispatch: program order
+        on the cache arrays guarantees the copy sees the post-step
+        (exact-boundary) state. Journal-tagged saves additionally
+        serialize the slot to the host checkpoint journal (a blocking
+        device fetch — only taken when VDT_SSM_CKPT_DIR is set)."""
+        saves = getattr(scheduler_output, "state_saves", None)
+        if not saves or self._state_pool is None:
+            return
+        from vllm_distributed_tpu.core.state_cache import write_journal
+        with self.mesh:
+            self._run_state_saves(saves, write_journal)
+
+    def _run_state_saves(self, saves, write_journal) -> None:
+        for d in saves:
+            if not getattr(d, "persist_only", False):
+                row = self.input_batch.req_id_to_index.get(d.req_id)
+                if row is None:
+                    logger.warning("state save for unknown request %s",
+                                   d.req_id)
+                    continue
+                for name in self._state_keys:
+                    with self._compile_watch(("ssm_save", name)):
+                        self._state_pool[name] = self._state_row_to_pool(
+                            self._state_pool[name], self.kv_caches[name],
+                            row, d.slot)
+                self.num_state_checkpoints += 1
+            if d.journal:
+                # persist_only: journal an already-committed slot whose
+                # key (async save) only resolved at commit time.
+                arrays = {
+                    name: np.asarray(
+                        jax.device_get(self._state_pool[name][:, d.slot]))
+                    for name in self._state_keys
+                }
+                write_journal(d.journal, arrays, d.num_tokens,
+                              fingerprint=self._state_fingerprint())
 
     # ------------------------------------------------------------------
     # Sharded-state checkpoints (reference: model_loader/
@@ -351,6 +525,13 @@ class TPUModelRunner:
         if self._last_sampled_dev is not None:
             self._last_sampled_dev.delete()
             self._last_sampled_dev = None
+        if self._state_pool is not None:
+            # Snapshots die with the HBM; the engine core resets the
+            # scheduler-side index so no stale slot is ever restored.
+            freed += sum(x.nbytes for x in self._state_pool.values())
+            for leaf in self._state_pool.values():
+                leaf.delete()
+            self._state_pool = None
         for leaf in jax.tree_util.tree_leaves(self.params):
             leaf.delete()
         for leaf in jax.tree_util.tree_leaves(self.kv_caches):
@@ -396,6 +577,7 @@ class TPUModelRunner:
         if self._eagle is not None and "eagle" in (self.params or {}):
             self._eagle.eparams = self.params["eagle"]
         self.kv_caches = self._make_sharded_caches(self.num_pages)
+        self._init_state_pool()
         self._sleeping = False
         logger.info("awake: weights restored, KV cache reset")
 
@@ -1256,6 +1438,10 @@ class TPUModelRunner:
         step_with_batch_queue); requests in a dispatched batch are
         excluded from scheduling until their batch retires."""
         self._update_states(scheduler_output)
+        # State restores BEFORE the forward (the scan's re-entry carry);
+        # zero-token outputs never carry them (scheduler invariant: the
+        # zero-token path does no device work).
+        self._apply_state_restores(scheduler_output)
         if scheduler_output.total_num_scheduled_tokens == 0:
             # Nothing to run, but async KV transfers may need servicing:
             # hand queued peer reads / completed pulls to the connector
@@ -1318,6 +1504,9 @@ class TPUModelRunner:
                 self._last_sampled_dev = self._chain_record(
                     self._ensure_last_sampled(), jnp.asarray(rows_pad),
                     dev[0])
+        # State snapshots AFTER the forward dispatch: program order on
+        # the (donated) cache arrays makes the copy read post-step rows.
+        self._apply_state_saves(scheduler_output)
         return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
                 "sampling_req_ids": sampling_req_ids,
                 "drafts_arr": drafts_arr, "R": R,
@@ -2027,7 +2216,14 @@ class TPUModelRunner:
                     n += 1
             n += self._precompile_samplers(self.mesh)
             n += self._precompile_plp(self.mesh)
+            n += self._precompile_state_cache()
             n_steps = self.config.scheduler_config.num_scheduler_steps
+            # The scheduler forces multi-step to 1 for stateful models
+            # with the state cache on (fused bursts would cross
+            # snapshot boundaries mid-burst): don't warm burst graphs
+            # that can never dispatch.
+            if self._state_cache_active():
+                n_steps = 1
             if n_steps > 1:
                 for R in self.req_buckets:
                     self._precompile_multi_step(n_steps, R)
@@ -2044,6 +2240,32 @@ class TPUModelRunner:
         self.precompile_graphs = n
         logger.info("precompiled %d graphs in %.1fs", n,
                     time.perf_counter() - start)
+
+    def _precompile_state_cache(self) -> int:
+        """Warm the SSM snapshot/restore copies (one graph per state
+        array per direction) so a serving-time checkpoint is never a
+        recompile-guard violation. Copies between slot 0 and row 0 of
+        the zero-initialized arrays are inert."""
+        if self._state_pool is None:
+            return 0
+        n = 0
+        shapes = self.model.state_shapes()
+        for name in self._state_keys:
+            with self._compile_watch(("ssm_save", name)):
+                self._state_pool[name] = self._state_row_to_pool(
+                    self._state_pool[name], self.kv_caches[name], 0, 0)
+            with self._compile_watch(("ssm_restore", name)):
+                self.kv_caches[name] = self._state_pool_to_row(
+                    self.kv_caches[name], self._state_pool[name], 0, 0)
+            shape, dtype = shapes[name]
+            value = jnp.asarray(
+                np.zeros((shape[0], ) + shape[2:], jnp.dtype(dtype)))
+            with self._compile_watch(("ssm_put", name)):
+                self.kv_caches[name] = self._state_put_row(
+                    self.kv_caches[name], value, 0)
+            jax.block_until_ready(self.kv_caches[name])
+            n += 3
+        return n
 
     def _precompile_plp(self, mesh) -> int:
         """Warm the prompt-logprob graphs — one per P bucket (the row

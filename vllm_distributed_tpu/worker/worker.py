@@ -141,15 +141,19 @@ class TPUWorker:
         with global_mesh(self.mesh):
             avail = self.model_runner.profile_memory_bytes()
         page_bytes = self.model_runner.kv_cache_bytes_per_page()
-        # Fixed-size per-request state (SSM conv/ssm rows) is charged up
+        # Fixed-size per-request state (SSM conv/ssm rows) PLUS the
+        # state-snapshot pool (core/state_cache.py) are charged up
         # front; the page pool only gets what remains.
-        fixed = self.model_runner.model_fixed_cache_bytes()
+        fixed = (self.model_runner.model_fixed_cache_bytes() +
+                 getattr(self.model_runner, "state_pool_bytes",
+                         lambda: 0)())
         if avail > 0 and fixed > avail:
             raise RuntimeError(
-                f"per-request SSM state ({fixed / 2**30:.2f} GiB for "
+                f"per-request SSM state + snapshot pool "
+                f"({fixed / 2**30:.2f} GiB for "
                 f"{self.config.scheduler_config.max_num_seqs} slots) "
                 f"exceeds free HBM ({avail / 2**30:.2f} GiB); lower "
-                f"max_num_seqs")
+                f"max_num_seqs or VDT_SSM_STATE_CACHE_SLOTS")
         avail -= fixed
         if page_bytes == 0:
             # Stateful-only models (pure Mamba): pages carry no bytes, so
