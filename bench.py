@@ -577,6 +577,130 @@ def _ssm_leg(record) -> None:
                 os.environ[k] = v
 
 
+def _qcomm_leg(record) -> None:
+    """Quantized-communication leg (ROADMAP item 2 acceptance):
+    disaggregated prefill over the dcn_pull connector with the
+    block-scaled int8 KV codec on vs VDT_QCOMM=0, on byte-identical
+    traffic. Reports connector transfer bytes (the >= 3.5x reduction
+    gate), greedy token parity, decode tokens/s on the consumer, and
+    the consumer-side bytes-saved counter (credited after a successful
+    decode)."""
+    import gc
+    import shutil
+    import tempfile
+
+    import torch
+    from transformers import LlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.parallel import collectives
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    ckpt = tempfile.mkdtemp(prefix="vdt_qcomm_bench_")
+    torch.manual_seed(0)
+    HFLlama(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=512,
+        eos_token_id=1)).eval().save_pretrained(
+            ckpt, safe_serialization=True)
+
+    def make_engine(role):
+        return LLMEngine(EngineArgs(
+            model=ckpt, dtype="float32", block_size=16,
+            num_gpu_blocks_override=256, max_model_len=512,
+            max_num_batched_tokens=512, max_num_seqs=8,
+            skip_tokenizer_init=True, kv_connector="DCNPullConnector",
+            kv_role=role,
+            kv_connector_extra_config={"pull_port": 0},
+        ).create_engine_config())
+
+    def run(engine, prompts, tag, max_tokens):
+        sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                            ignore_eos=True)
+        for i, p in enumerate(prompts):
+            engine.add_request(f"{tag}-{i}", p, sp)
+        done = {}
+        for _ in range(4000):
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out
+            if not engine.has_unfinished_requests():
+                break
+        order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+        return [done[k] for k in order]
+
+    def transfer_bytes(engine):
+        kv = (engine.get_stats().get("transport") or {}).get("kv") or {}
+        return sum(int(e.get("tx_bytes", 0)) + int(e.get("rx_bytes", 0))
+                   for conn, e in kv.items()
+                   if isinstance(e, dict) and conn != "page_io")
+
+    rng = np.random.default_rng(7)
+    prompts = [[int(x) for x in rng.integers(2, 250, size=128)]
+               for _ in range(8)]
+    gen_tokens = 16
+    saved = os.environ.get("VDT_QCOMM")
+    outputs = {}
+    try:
+        for leg, flag in (("off", "0"), ("on", "1")):
+            os.environ["VDT_QCOMM"] = flag
+            collectives.refresh()
+            producer = make_engine("kv_producer")
+            prod_outs = run(producer, prompts, f"qprod-{leg}",
+                            max_tokens=1)
+            params = [o.kv_transfer_params for o in prod_outs]
+            consumer = make_engine("kv_consumer")
+            sp = SamplingParams(temperature=0.0, max_tokens=gen_tokens,
+                                ignore_eos=True)
+            t0 = time.perf_counter()
+            for i, (p, kvp) in enumerate(zip(prompts, params)):
+                consumer.add_request(f"qcons-{leg}-{i}", p, sp,
+                                     kv_transfer_params=kvp)
+            done = {}
+            for _ in range(8000):
+                for out in consumer.step():
+                    if out.finished:
+                        done[out.request_id] = out
+                producer.step()
+                if len(done) == len(prompts):
+                    break
+            wall = time.perf_counter() - t0
+            outputs[leg] = [done[k].outputs[0].token_ids
+                            for k in sorted(done)]
+            record[f"qcomm_{leg}_transfer_bytes"] = (
+                transfer_bytes(producer) + transfer_bytes(consumer))
+            record[f"qcomm_{leg}_decode_tok_s"] = round(
+                len(done) * gen_tokens / wall, 1)
+            if flag == "1":
+                # Savings are credited consumer-side on successful
+                # decode (a degraded pull never counts).
+                qc = (consumer.get_stats().get("transport")
+                      or {}).get("qcomm") or {}
+                record["qcomm_bytes_saved"] = int(
+                    qc.get("dcn_pull", {}).get("bytes_saved", 0))
+                record["qcomm_fallbacks"] = int(
+                    qc.get("dcn_pull", {}).get("fallbacks", 0))
+            producer.engine_core.shutdown()
+            consumer.engine_core.shutdown()
+            del producer, consumer
+            gc.collect()
+        on_b = max(record.get("qcomm_on_transfer_bytes", 0), 1)
+        record["qcomm_transfer_bytes_ratio"] = round(
+            record.get("qcomm_off_transfer_bytes", 0) / on_b, 2)
+        record["qcomm_token_parity"] = outputs.get("on") == \
+            outputs.get("off")
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_QCOMM", None)
+        else:
+            os.environ["VDT_QCOMM"] = saved
+        collectives.refresh()
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+
 def _phase_percentiles(engine, record) -> None:
     """p50/p95/p99 per lifecycle phase (queue/prefill/decode/...) from
     the output processor's timeline-derived durations — the per-request
@@ -1020,6 +1144,12 @@ def main() -> None:
             _ssm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
+        # Quantized-communication leg: dcn_pull transfer bytes + parity
+        # with the int8 KV codec on vs off.
+        try:
+            _qcomm_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["qcomm_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -1078,6 +1208,10 @@ def main() -> None:
             _ssm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _qcomm_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["qcomm_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

@@ -53,7 +53,9 @@ def test_recorder_kill_switch(monkeypatch):
     r = TransportRecorder()  # env-driven
     r.record_transfer("dcn_pull", "rx", 100)
     r.record_shm("write", 0.1)
-    assert r.snapshot() == {"kv": {}, "shm": {}, "shm_lag_chunks": 0}
+    r.record_qcomm("dcn_pull", 100)
+    assert r.snapshot() == {"kv": {}, "shm": {}, "shm_lag_chunks": 0,
+                            "qcomm": {}}
     monkeypatch.setenv("VDT_TRANSPORT_TELEMETRY", "1")
     r.record_transfer("dcn_pull", "rx", 100)
     assert r.snapshot()["kv"]["dcn_pull"]["rx_bytes"] == 100
@@ -338,3 +340,52 @@ def test_dp_aggregation_skips_dead_replica_mid_scrape():
     assert agg["transport"]["kv"]["dcn_pull"]["rx_bytes"] == 64
     assert agg["dp_replicas_down"] == [1]
     assert agg["replica_failovers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Quantized communication plane counters
+# ---------------------------------------------------------------------------
+
+def test_recorder_qcomm_counters_and_merge():
+    rec_a = TransportRecorder(enabled=True)
+    rec_a.record_qcomm("dcn_pull", 3000)
+    rec_a.record_qcomm("dcn_pull", 1000)
+    rec_a.record_qcomm_fallback("dcn_pull")
+    rec_b = TransportRecorder(enabled=True)
+    rec_b.record_qcomm("shared_storage", 500)
+    snap_a, snap_b = rec_a.snapshot(), rec_b.snapshot()
+    assert snap_a["qcomm"]["dcn_pull"] == {"bytes_saved": 4000,
+                                           "fallbacks": 1}
+    merged = telemetry.merge_transport_snapshots([snap_a, snap_b,
+                                                  snap_a])
+    # Per-path sums are exact (each recorder is disjoint; the repeated
+    # snapshot models a second DP replica's identical counters).
+    assert merged["qcomm"]["dcn_pull"] == {"bytes_saved": 8000,
+                                           "fallbacks": 2}
+    assert merged["qcomm"]["shared_storage"] == {"bytes_saved": 500,
+                                                 "fallbacks": 0}
+
+
+def test_qcomm_render_merges_transport_and_traced():
+    from vllm_distributed_tpu.parallel import collectives
+    collectives.reset_counters()
+    collectives._note_saved("tknp", 1234)
+    collectives.note_fallback("tp")
+    try:
+        rec = TransportRecorder(enabled=True)
+        rec.record_qcomm("dcn_pull", 4000)
+        text = prometheus.render_metrics({"transport": rec.snapshot()})
+        assert 'vdt:qcomm_bytes_saved_total{path="dcn_pull"} 4000' \
+            in text
+        assert 'vdt:qcomm_bytes_saved_total{path="tknp"} 1234' in text
+        assert "vdt:qcomm_fallbacks_total 1" in text
+    finally:
+        collectives.reset_counters()
+
+
+def test_qcomm_render_silent_when_plane_never_fired():
+    from vllm_distributed_tpu.parallel import collectives
+    collectives.reset_counters()
+    rec = TransportRecorder(enabled=True)
+    text = prometheus.render_metrics({"transport": rec.snapshot()})
+    assert "qcomm" not in text

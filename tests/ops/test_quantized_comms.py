@@ -1,0 +1,359 @@
+"""Quantized communication plane: parity gates per in-graph path.
+
+Every quantized collective must stay within one block-scaled int8
+round-trip of its exact counterpart (bounded divergence), and the
+engine-level greedy decode must be TOKEN-IDENTICAL on the CPU smoke
+configs with a fine scale block (VDT_QCOMM_BLOCK=16 — at toy-model
+scale the random-weight logit gaps sit near the coarse-block noise
+floor; real checkpoints tolerate the default 256, which is what the
+EQuARX quality results are about). VDT_QCOMM=0 must revert every path
+byte-identically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import PartitionSpec as P
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.config import ParallelConfig
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.parallel import collectives
+from vllm_distributed_tpu.parallel.mesh import (build_mesh, global_mesh,
+                                                shard_map)
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture()
+def qcomm_on(monkeypatch):
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    collectives.refresh()
+    yield
+    collectives.refresh()
+
+
+@pytest.fixture(autouse=True)
+def _refresh_after(monkeypatch):
+    # Every test leaves the cached env gating the way it found it.
+    yield
+    collectives.refresh()
+
+
+def _mesh(k: int):
+    return build_mesh(ParallelConfig(tensor_parallel_size=k),
+                      devices=jax.devices("cpu")[:k])
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher gating
+# ---------------------------------------------------------------------------
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("VDT_QCOMM", raising=False)
+    collectives.refresh()
+    for path in ("tknp", "ep", "tp", "dcn_pull"):
+        assert not collectives.enabled(path)
+
+
+def test_path_override(monkeypatch):
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp,kv")
+    collectives.refresh()
+    assert collectives.enabled("tknp")
+    assert not collectives.enabled("ep")
+    assert not collectives.enabled("tp")
+    # "kv" is the group token for every connector payload path.
+    assert collectives.enabled("dcn_pull")
+    assert collectives.enabled("p2p")
+    assert collectives.enabled("shared_storage")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "")
+    collectives.refresh()
+    assert all(collectives.enabled(p)
+               for p in ("tknp", "ep", "tp", "shared_storage"))
+
+
+def test_psum_off_is_exact_lax_psum(monkeypatch):
+    monkeypatch.setenv("VDT_QCOMM", "0")
+    collectives.refresh()
+    mesh = _mesh(2)
+    x = np.arange(2 * 24, dtype=np.float32).reshape(2, 24)
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.psum(x_[0], "model", path="tknp"),
+            mesh=mesh, in_specs=(P("model", None), ), out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), x.sum(axis=0))
+
+
+def test_divisor_block():
+    assert collectives.divisor_block(64, 256) == 64
+    assert collectives.divisor_block(256, 256) == 256
+    assert collectives.divisor_block(96, 64) == 48
+    assert collectives.divisor_block(7, 4) == 1
+
+
+# ---------------------------------------------------------------------------
+# Quantized psum (the TKNP decode merge + EP combine + TP reduce form)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_quantized_psum_bounded_divergence(qcomm_on, k):
+    mesh = _mesh(k)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(k, 37, 64)).astype(np.float32)
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.psum(x_[0], "model", path="ep"),
+            mesh=mesh, in_specs=(P("model", None, None), ),
+            out_specs=P(), check_vma=False)(jnp.asarray(x))
+    want = x.sum(axis=0)
+    # One int8 block round-trip per leg: 2 * amax/127 per contributing
+    # rank, summed — loose analytic bound.
+    bound = 2.0 * (k + 1) * np.max(np.abs(x)) / 127.0
+    assert np.max(np.abs(np.asarray(got) - want)) < bound
+
+
+def test_quantized_psum_disjoint_rows_like_tknp(qcomm_on):
+    """The TKNP merge shape: each rank owns disjoint rows, foreign rows
+    are zero. All-zero blocks must contribute exactly zero."""
+    mesh = _mesh(2)
+    rng = np.random.default_rng(1)
+    full = rng.normal(size=(8, 4, 16)).astype(np.float32)
+    per_rank = np.zeros((2, ) + full.shape, np.float32)
+    per_rank[0, :4] = full[:4]
+    per_rank[1, 4:] = full[4:]
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.psum(x_[0], "model", path="tknp"),
+            mesh=mesh, in_specs=(P("model", None, None, None), ),
+            out_specs=P(), check_vma=False)(jnp.asarray(per_rank))
+    bound = 2.0 * 3 * np.max(np.abs(full)) / 127.0
+    assert np.max(np.abs(np.asarray(got) - full)) < bound
+
+
+def test_quantized_psum_zeros_exact(qcomm_on):
+    mesh = _mesh(2)
+    z = np.zeros((2, 5, 33), np.float32)
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.psum(x_[0], "model", path="ep"),
+            mesh=mesh, in_specs=(P("model", None, None), ),
+            out_specs=P(), check_vma=False)(jnp.asarray(z))
+    np.testing.assert_array_equal(np.asarray(got), z[0])
+
+
+def test_psum_integer_operand_falls_back_exact(qcomm_on):
+    """Lossy rounding of integer sums is silently wrong — the drop-in
+    must take the exact psum (counted as a fallback) for non-floats."""
+    collectives.reset_counters()
+    mesh = _mesh(2)
+    x = np.arange(2 * 1000, dtype=np.int32).reshape(2, 1000) * 1000
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.psum(x_[0], "model", path="ep"),
+            mesh=mesh, in_specs=(P("model", None), ), out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), x.sum(axis=0))
+    assert collectives.traced_snapshot()["fallbacks"].get("ep") == 1
+
+
+def test_all_to_all_no_win_falls_back_exact(qcomm_on):
+    """A bf16 payload with a 2-wide feature dim would ship MORE bytes
+    quantized (scales outweigh the dtype shrink) — must stay raw."""
+    import ml_dtypes
+    collectives.reset_counters()
+    k = 2
+    mesh = _mesh(k)
+    y = (np.arange(k * k * 4 * 2).reshape(k, k, 4, 2)
+         .astype(ml_dtypes.bfloat16))
+    specs = (P("model", None, None, None), )
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda y_: collectives.all_to_all(y_[0], "model", 0, 0,
+                                              path="ep"),
+            mesh=mesh, in_specs=specs, out_specs=specs[0],
+            check_vma=False)(jnp.asarray(y))
+        want = shard_map(
+            lambda y_: jax.lax.all_to_all(y_[0], "model", 0, 0),
+            mesh=mesh, in_specs=specs, out_specs=specs[0],
+            check_vma=False)(jnp.asarray(y))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert collectives.traced_snapshot()["fallbacks"].get("ep") == 1
+
+
+def test_trace_counters_record_savings(qcomm_on):
+    collectives.reset_counters()
+    mesh = _mesh(2)
+    x = np.ones((2, 16, 64), np.float32)
+    with global_mesh(mesh), mesh:
+        shard_map(lambda x_: collectives.psum(x_[0], "model", path="ep"),
+                  mesh=mesh, in_specs=(P("model", None, None), ),
+                  out_specs=P(), check_vma=False)(jnp.asarray(x))
+    snap = collectives.traced_snapshot()
+    assert snap["bytes_saved"].get("ep", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Quantized all_to_all (the MoE-EP dispatch/combine shuffle)
+# ---------------------------------------------------------------------------
+
+def test_quantized_all_to_all_bounded_divergence(qcomm_on):
+    k = 4
+    mesh = _mesh(k)
+    rng = np.random.default_rng(2)
+    y = rng.normal(size=(k, k, 8, 64)).astype(np.float32)
+    specs = (P("model", None, None, None), )
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda y_: collectives.all_to_all(y_[0], "model", 0, 0,
+                                              path="ep"),
+            mesh=mesh, in_specs=specs, out_specs=specs[0],
+            check_vma=False)(jnp.asarray(y))
+        want = shard_map(
+            lambda y_: jax.lax.all_to_all(y_[0], "model", 0, 0),
+            mesh=mesh, in_specs=specs, out_specs=specs[0],
+            check_vma=False)(jnp.asarray(y))
+    bound = np.max(np.abs(y)) / 127.0 + 1e-6
+    assert np.max(np.abs(np.asarray(got) - np.asarray(want))) < bound
+
+
+# ---------------------------------------------------------------------------
+# EP MoE block: quantized dispatch/combine vs exact, both EP modes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ep_setup():
+    from vllm_distributed_tpu.models.llama import LlamaArchConfig
+    from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
+    ep = 4
+    T, H, I, E = 8, 32, 16, 4
+    mesh = _mesh(ep)
+    cfg = LlamaArchConfig(
+        vocab_size=64, hidden_size=H, intermediate_size=I,
+        num_layers=1, num_q_heads=4, num_kv_heads=4, head_dim=8,
+        num_experts=E, num_experts_per_tok=2, norm_topk_prob=True,
+        expert_parallel=True, expert_parallel_ranks=ep,
+        dtype=jnp.float32)
+    model = MixtralForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    lp = {
+        "router": jnp.asarray(rng.normal(size=(H, E)), jnp.float32),
+        "w_gate": jnp.asarray(rng.normal(size=(E, H, I)) * 0.1,
+                              jnp.float32),
+        "w_up": jnp.asarray(rng.normal(size=(E, H, I)) * 0.1,
+                            jnp.float32),
+        "w_down": jnp.asarray(rng.normal(size=(E, I, H)) * 0.1,
+                              jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(T, H)), jnp.float32)
+    return mesh, model, lp, x
+
+
+@pytest.mark.parametrize("mode", ["a2a", "replicate"])
+def test_moe_ep_quantized_bounded_divergence(ep_setup, monkeypatch,
+                                             mode):
+    mesh, model, lp, x = ep_setup
+    monkeypatch.setenv("VDT_MOE_EP_MODE", mode)
+    with global_mesh(mesh), mesh:
+        monkeypatch.setenv("VDT_QCOMM", "1")
+        collectives.refresh()
+        got = np.asarray(model.mlp_block(lp, x))
+        monkeypatch.setenv("VDT_QCOMM", "0")
+        collectives.refresh()
+        want = np.asarray(model.mlp_block(lp, x))
+    assert np.max(np.abs(got - want)) < 0.05 * (np.max(np.abs(want))
+                                                + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level greedy parity (CPU smoke config, fine scale block)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_qcomm")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+PROMPTS = [
+    [3, 17, 92, 45, 8],
+    [5, 9, 33, 71],
+    [11, 12, 13, 14, 15, 16],
+    [7, 44, 101, 13, 2, 64, 99],
+]
+
+
+def _make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+def _run(engine, prompts, tag, max_tokens=8):
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"{tag}-{i}", p, sp)
+    done = {}
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    order = sorted(done, key=lambda s: int(s.split("-")[-1]))
+    return [done[k].outputs[0].token_ids for k in order]
+
+
+@pytest.fixture(scope="module")
+def baseline(checkpoint):
+    return _run(_make_engine(checkpoint), PROMPTS, "base")
+
+
+@pytest.fixture()
+def qcomm_fine_block(monkeypatch):
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_BLOCK", "16")
+    collectives.refresh()
+    yield
+    collectives.refresh()
+
+
+def test_tknp_engine_greedy_parity(checkpoint, baseline,
+                                   qcomm_fine_block):
+    got = _run(_make_engine(checkpoint, token_parallel_size=2), PROMPTS,
+               "qtknp")
+    assert got == baseline
+
+
+def test_tp_engine_greedy_parity(checkpoint, baseline,
+                                 qcomm_fine_block):
+    got = _run(_make_engine(checkpoint, tensor_parallel_size=2), PROMPTS,
+               "qtp")
+    assert got == baseline
+
+
+def test_tp_engine_qcomm_off_reverts(checkpoint, baseline, monkeypatch):
+    """VDT_QCOMM=1 with the tp path excluded keeps the GSPMD reduce:
+    byte-identical greedy to the stock engine."""
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "ep")
+    collectives.refresh()
+    got = _run(_make_engine(checkpoint, tensor_parallel_size=2), PROMPTS,
+               "qtpoff")
+    assert got == baseline

@@ -56,7 +56,7 @@ def test_steady_state_decode_reports_zero_recompiles(checkpoint,
     # core's transport snapshot (empty — no connector configured) and
     # the scheduler's block-pool introspection.
     assert stats["transport"] == {"kv": {}, "shm": {},
-                                  "shm_lag_chunks": 0}
+                                  "shm_lag_chunks": 0, "qcomm": {}}
     kv = stats["kv_cache"]
     assert kv["total_blocks"] == 128
     assert kv["free_blocks"] + kv["used_blocks"] == kv["total_blocks"]

@@ -50,7 +50,7 @@ from typing import Any, Optional
 import msgpack
 import numpy as np
 
-from vllm_distributed_tpu.distributed.kv_transfer import page_io
+from vllm_distributed_tpu.distributed.kv_transfer import page_io, quant
 from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorBase, KVConnectorRole)
 from vllm_distributed_tpu.logger import init_logger
@@ -137,6 +137,12 @@ class _ServeJob:
     request_pages: Optional[list[int]] = None
     reply: dict = field(default_factory=dict)
     done: threading.Event = field(default_factory=threading.Event)
+    # Quantized-payload negotiation: the consumer advertises its codec
+    # version ("accept_qcomm"); 0 / absent (old consumers) always gets
+    # the raw format. "want_raw" is a fallback re-request after a
+    # failed quantized decode — it must be answered raw.
+    accept_qcomm: int = 0
+    want_raw: bool = False
 
 
 @dataclass
@@ -407,8 +413,11 @@ class DCNPullConnector(KVConnectorBase):
                                      "(never deferred, already pulled, "
                                      "or expired)"})
                         continue
-                    job = _ServeJob(remote_req_id=msg["req_id"],
-                                    request_pages=msg["page_ids"])
+                    job = _ServeJob(
+                        remote_req_id=msg["req_id"],
+                        request_pages=msg["page_ids"],
+                        accept_qcomm=int(msg.get("accept_qcomm", 0)),
+                        want_raw=bool(msg.get("raw", False)))
                     self._serve_queue.put(job)
                     # Wait for the main thread to read HBM (bounded so a
                     # dead engine can't wedge the peer forever).
@@ -530,22 +539,46 @@ class DCNPullConnector(KVConnectorBase):
         t0 = telemetry.now()
         with socket.create_connection((pull.host, pull.port),
                                       timeout=120.0) as sock:
+            # Advertise the codec only when THIS side's plane is on:
+            # a VDT_QCOMM=0 consumer must stay byte-identical to the
+            # unquantized plane even against an enabled producer.
+            accept = (quant.WIRE_VERSION
+                      if quant.payload_enabled(self.telemetry_name)
+                      else 0)
             _send_msg(sock, {"op": "pull",
                              "req_id": pull.remote_req_id,
-                             "page_ids": pull.remote_page_ids})
+                             "page_ids": pull.remote_page_ids,
+                             "accept_qcomm": accept})
             reply = _recv_msg(sock)
             if reply is None:
                 raise ConnectionResetError("connection dropped mid-pull")
             if not reply.get("ok"):
                 raise RuntimeError(reply.get("error", "pull rejected"))
+            nbytes, k, v = self._decode_reply(reply)
+            if k is None:
+                # Quantized payload failed validation (corrupt scale
+                # header / geometry): degrade to the raw-precision
+                # format on the same connection. The failed payload's
+                # bytes still moved — keep them in the rx accounting.
+                self._telemetry.record_qcomm_fallback(
+                    self.telemetry_name)
+                _send_msg(sock, {"op": "pull",
+                                 "req_id": pull.remote_req_id,
+                                 "page_ids": pull.remote_page_ids,
+                                 "raw": True})
+                reply = _recv_msg(sock)
+                if reply is None:
+                    raise ConnectionResetError(
+                        "connection dropped mid-fallback-pull")
+                if not reply.get("ok"):
+                    raise RuntimeError(reply.get("error",
+                                                 "fallback pull rejected"))
+                raw_bytes, k, v = self._decode_reply(reply,
+                                                     allow_codec=False)
+                nbytes += raw_bytes
             self._telemetry.record_transfer(
-                self.telemetry_name, "rx",
-                len(reply["k"]) + len(reply["v"]),
+                self.telemetry_name, "rx", nbytes,
                 seconds=telemetry.now() - t0)
-            k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
-                reply["k_shape"])
-            v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
-                reply["v_shape"])
             n = len(pull.local_page_ids)
             if k.shape[1] < n:
                 raise RuntimeError(
@@ -562,6 +595,39 @@ class DCNPullConnector(KVConnectorBase):
                     "host fallback", pull.req_id, stage_err)
                 return page_io.stage_pages(runner, k[:, :n], v[:, :n],
                                            on_device=False)
+
+    def _decode_reply(self, reply: dict, allow_codec: bool = True):
+        """One pull reply -> (wire_bytes, k, v) host arrays in wire
+        layout. A quantized payload that fails validation returns
+        (wire_bytes, None, None) so the caller can degrade to a raw
+        re-request; a raw (pre-codec / VDT_QCOMM=0 / fallback) reply
+        decodes exactly as before the codec existed."""
+        payload = reply.get("codec")
+        if quant.is_encoded(payload):
+            nbytes = quant.encoded_nbytes(payload)
+            if not allow_codec:
+                raise RuntimeError(
+                    "producer answered a raw-format request with a "
+                    "quantized payload")
+            try:
+                k, v = quant.decode_pages(payload)
+            except quant.QuantCodecError as e:
+                logger.warning(
+                    "quantized KV payload failed validation (%s); "
+                    "re-requesting raw precision", e)
+                return nbytes, None, None
+            # Savings are credited HERE, after a successful decode — a
+            # payload that fails validation and degrades to a raw
+            # re-request moved quantized+raw bytes (worse than raw
+            # alone) and must never count as a saving.
+            self._telemetry.record_qcomm(
+                self.telemetry_name, quant.raw_nbytes(payload) - nbytes)
+            return nbytes, k, v
+        k = np.frombuffer(reply["k"], dtype=reply["dtype"]).reshape(
+            reply["k_shape"])
+        v = np.frombuffer(reply["v"], dtype=reply["dtype"]).reshape(
+            reply["v_shape"])
+        return len(reply["k"]) + len(reply["v"]), k, v
 
     # ==================================================================
     # Worker side: main-thread device access
@@ -680,17 +746,29 @@ class DCNPullConnector(KVConnectorBase):
         from vllm_distributed_tpu.metrics import telemetry
         t0 = telemetry.now()
         k, v = page_io.gather_pages(runner, page_ids)
+        if (not job.want_raw and job.accept_qcomm >= quant.WIRE_VERSION
+                and quant.payload_enabled(self.telemetry_name, k.dtype)):
+            # bytes_saved is credited by the CONSUMER after a
+            # successful decode — crediting at encode would overstate
+            # savings exactly when a corrupt payload degrades to a raw
+            # re-request.
+            payload = quant.encode_pages(k, v)
+            nbytes = quant.encoded_nbytes(payload)
+            reply = {"ok": True, "codec": payload}
+        else:
+            nbytes = k.nbytes + v.nbytes
+            reply = {
+                "ok": True,
+                "k": k.tobytes(),
+                "v": v.tobytes(),
+                "k_shape": list(k.shape),
+                "v_shape": list(v.shape),
+                "dtype": str(k.dtype),
+            }
         self._telemetry.record_transfer(self.telemetry_name, "tx",
-                                        k.nbytes + v.nbytes,
+                                        nbytes,
                                         seconds=telemetry.now() - t0)
-        return {
-            "ok": True,
-            "k": k.tobytes(),
-            "v": v.tobytes(),
-            "k_shape": list(k.shape),
-            "v_shape": list(v.shape),
-            "dtype": str(k.dtype),
-        }
+        return reply
 
     def shutdown(self) -> None:
         self._shutdown.set()

@@ -1,0 +1,163 @@
+"""Quantized KV-transfer payload codec (block-scaled int8, versioned).
+
+Every KV connector exchanges pages in the TP-invariant wire layout
+``[L, n_pages, KVH, page_size, head_dim]`` (page_io.py). This module is
+the single home of the quantized form of that payload: symmetric
+per-block int8 values with fp32 scales, where the block size is clipped
+to a divisor of the per-page-per-head span (``page_size * head_dim``)
+so no scale ever crosses a page or head boundary — a consumer can
+dequantize any page subset independently.
+
+Wire format (a flat msgpack-friendly dict; np.savez stores the same
+fields for the shared_storage on-disk form):
+
+* header — ``version`` (this file's ``WIRE_VERSION``; decoders reject
+  newer versions so old engines degrade to the raw format instead of
+  misreading), ``dtype``/``k_shape``/``v_shape`` (original geometry,
+  restored bit-exactly), ``block`` (elements per scale).
+* payload — ``qk``/``qv`` int8 bytes, ``ks``/``vs`` fp32 scale bytes.
+* integrity — ``scale_crc``: CRC32 over the canonical header plus both
+  scale buffers. A corrupted scale (or geometry) header turns 1-byte
+  wire damage into full-page garbage after dequantization, so decode
+  verifies BEFORE touching the values and raises
+  :class:`QuantCodecError`; connectors degrade to re-requesting the
+  raw-precision payload (fault drill: ``qcomm.scale_corrupt``).
+
+The raw format (``k``/``v`` bytes + dtype/shape, dcn_pull.py) remains
+valid — ``VDT_QCOMM=0`` producers, pre-codec producers and fallback
+replies all decode unchanged.
+"""
+
+import json
+import math
+import zlib
+
+import ml_dtypes  # noqa: F401 - registers bfloat16 etc. with np.dtype
+import numpy as np
+
+from vllm_distributed_tpu.utils import fault_injection
+
+WIRE_VERSION = 1
+
+_HEADER_FIELDS = ("version", "dtype", "k_shape", "v_shape", "block")
+
+
+class QuantCodecError(RuntimeError):
+    """Quantized payload failed validation (version, geometry or scale
+    checksum). Deliberately NOT an OSError: retrying the same bytes
+    cannot help — the caller degrades to the raw-precision payload."""
+
+
+def payload_enabled(connector: str, dtype=None) -> bool:
+    """Should ``connector`` ship quantized payloads? Gated per connector
+    (or the "kv" group token) via VDT_QCOMM_PATHS; sub-byte caches
+    (fp8) are already as small as the codec output and stay raw."""
+    from vllm_distributed_tpu.parallel import collectives
+    if not collectives.enabled(connector):
+        return False
+    return dtype is None or np.dtype(dtype).itemsize > 1
+
+
+def _span(shape: tuple) -> int:
+    """Per-page-per-head element span: the last two dims (page_size *
+    head_dim) of the wire layout; trailing dim for anything flatter."""
+    if len(shape) >= 2:
+        return int(shape[-1]) * int(shape[-2])
+    return int(shape[-1]) if shape else 1
+
+
+def _crc(header: dict, ks: bytes, vs: bytes) -> int:
+    canon = json.dumps({f: header[f] for f in _HEADER_FIELDS},
+                       sort_keys=True).encode()
+    return zlib.crc32(vs, zlib.crc32(ks, zlib.crc32(canon)))
+
+
+def _quantize(a: np.ndarray, block: int):
+    flat = np.ascontiguousarray(a, dtype=np.float32).reshape(-1, block)
+    amax = np.max(np.abs(flat), axis=1, keepdims=True)
+    scale = np.maximum(amax / 127.0, 1e-30).astype(np.float32)
+    q = np.clip(np.rint(flat / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def encode_pages(k: np.ndarray, v: np.ndarray,
+                 block: int = None) -> dict:
+    """Wire-layout page stacks -> quantized payload dict."""
+    from vllm_distributed_tpu.parallel import collectives
+    k = np.asarray(k)
+    v = np.asarray(v)
+    assert k.dtype == v.dtype, (k.dtype, v.dtype)
+    block = collectives.divisor_block(_span(k.shape), block)
+    qk, ks = _quantize(k, block)
+    qv, vs = _quantize(v, block)
+    ks_b, vs_b = ks.tobytes(), vs.tobytes()
+    header = {
+        "version": WIRE_VERSION,
+        "dtype": str(k.dtype),
+        "k_shape": [int(d) for d in k.shape],
+        "v_shape": [int(d) for d in v.shape],
+        "block": int(block),
+    }
+    crc = _crc(header, ks_b, vs_b)
+    if fault_injection.should_fire("qcomm.scale_corrupt"):
+        # Flip one scale byte AFTER the checksum: the consumer's decode
+        # must detect it and degrade to the raw payload.
+        ks_b = bytes([ks_b[0] ^ 0xFF]) + ks_b[1:]
+    return {**header, "qk": qk.tobytes(), "qv": qv.tobytes(),
+            "ks": ks_b, "vs": vs_b, "scale_crc": crc}
+
+
+def is_encoded(payload) -> bool:
+    return isinstance(payload, dict) and "qk" in payload \
+        and "version" in payload
+
+
+def encoded_nbytes(payload: dict) -> int:
+    return sum(len(payload[f]) for f in ("qk", "qv", "ks", "vs"))
+
+
+def raw_nbytes(payload: dict) -> int:
+    itemsize = np.dtype(payload["dtype"]).itemsize
+    return itemsize * (math.prod(payload["k_shape"])
+                       + math.prod(payload["v_shape"]))
+
+
+def _dequantize(q_bytes: bytes, s_bytes: bytes, shape: list,
+                block: int, dtype) -> np.ndarray:
+    n = math.prod(shape)
+    if len(q_bytes) != n or len(s_bytes) != (n // block) * 4:
+        raise QuantCodecError(
+            f"payload geometry mismatch: {len(q_bytes)} value bytes / "
+            f"{len(s_bytes)} scale bytes for shape {shape} block {block}")
+    q = np.frombuffer(q_bytes, np.int8).reshape(-1, block)
+    s = np.frombuffer(s_bytes, np.float32).reshape(-1, 1)
+    return (q.astype(np.float32) * s).reshape(shape).astype(dtype)
+
+
+def decode_pages(payload: dict) -> tuple[np.ndarray, np.ndarray]:
+    """Quantized payload dict -> (k, v) numpy stacks in the original
+    geometry and dtype. Raises :class:`QuantCodecError` on any
+    version / geometry / checksum mismatch."""
+    try:
+        version = int(payload["version"])
+        block = int(payload["block"])
+        k_shape = [int(d) for d in payload["k_shape"]]
+        v_shape = [int(d) for d in payload["v_shape"]]
+        dtype = np.dtype(payload["dtype"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise QuantCodecError(f"malformed quantized payload: {e}") from e
+    if version > WIRE_VERSION:
+        raise QuantCodecError(
+            f"payload version {version} is newer than this decoder "
+            f"({WIRE_VERSION})")
+    if block <= 0 or _span(tuple(k_shape)) % block:
+        raise QuantCodecError(
+            f"block {block} does not divide the page span of {k_shape}")
+    header = {"version": version, "dtype": payload["dtype"],
+              "k_shape": k_shape, "v_shape": v_shape, "block": block}
+    if _crc(header, payload["ks"], payload["vs"]) != \
+            int(payload.get("scale_crc", -1)):
+        raise QuantCodecError("scale/geometry checksum mismatch")
+    k = _dequantize(payload["qk"], payload["ks"], k_shape, block, dtype)
+    v = _dequantize(payload["qv"], payload["vs"], v_shape, block, dtype)
+    return k, v

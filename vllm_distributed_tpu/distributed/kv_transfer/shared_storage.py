@@ -15,6 +15,7 @@ on the shared page prefix. Files are one .npz per page, written
 atomically (tmp + rename) so concurrent engines never read torn pages.
 """
 
+import json
 import os
 from dataclasses import dataclass, field
 from typing import Optional
@@ -22,7 +23,7 @@ from typing import Optional
 import numpy as np
 
 from vllm_distributed_tpu.core.kv_cache_utils import hash_request_tokens
-from vllm_distributed_tpu.distributed.kv_transfer import page_io
+from vllm_distributed_tpu.distributed.kv_transfer import page_io, quant
 from vllm_distributed_tpu.distributed.kv_transfer.base import (
     KVConnectorBase, KVConnectorRole)
 from vllm_distributed_tpu.logger import init_logger
@@ -100,14 +101,56 @@ class SharedStorageConnector(KVConnectorBase):
         return os.path.join(self.path, f"{hash_hex}.npz")
 
     def _read_page_file(self, key: str):
+        """One page file -> (k, v) arrays [L, KVH, PS, D]. Three formats
+        coexist in a store: quantized codec files (kv_transfer/quant.py
+        fields under npz keys), zlib-compressed raw (VDT_QCOMM=0
+        writers), and the legacy uncompressed raw — old artifacts keep
+        decoding forever. A quantized file that fails validation raises
+        QuantCodecError (fatal for the caller's retry policy, like any
+        other corrupt artifact)."""
         with np.load(self._file(key)) as f:
+            if "qcomm_meta" in f:
+                meta = json.loads(f["qcomm_meta"].tobytes().decode())
+                payload = {**meta,
+                           "qk": f["qk"].tobytes(),
+                           "qv": f["qv"].tobytes(),
+                           "ks": f["ks"].tobytes(),
+                           "vs": f["vs"].tobytes()}
+                return quant.decode_pages(payload)
             return f["k"], f["v"]
 
-    def _write_page_file(self, key: str, k_np, v_np) -> None:
+    def _write_page_file(self, key: str, k_np, v_np) -> tuple[int, int]:
+        """Atomic (tmp + rename) page-file write -> (disk_bytes,
+        bytes_saved vs the raw uncompressed artifact). Quantized codec
+        payload when the plane is on; zlib-compressed raw otherwise —
+        either way on-disk KV artifacts shrink."""
         tmp = self._file(key) + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.savez(f, k=k_np, v=v_np)
+        raw_bytes = k_np.nbytes + v_np.nbytes
+        quantized = quant.payload_enabled(self.telemetry_name,
+                                          k_np.dtype)
+        if quantized:
+            payload = quant.encode_pages(k_np, v_np)
+            meta = {f: payload[f]
+                    for f in ("version", "dtype", "k_shape", "v_shape",
+                              "block", "scale_crc")}
+            # Meta rides as raw JSON bytes — a unicode npy entry costs
+            # 4 bytes/char, which matters at small page geometries.
+            with open(tmp, "wb") as f:
+                np.savez(f, qcomm_meta=np.frombuffer(
+                             json.dumps(meta).encode(), np.uint8),
+                         qk=np.frombuffer(payload["qk"], np.int8),
+                         qv=np.frombuffer(payload["qv"], np.int8),
+                         ks=np.frombuffer(payload["ks"], np.float32),
+                         vs=np.frombuffer(payload["vs"], np.float32))
+        else:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(f, k=k_np, v=v_np)
+        disk_bytes = os.path.getsize(tmp)
         os.replace(tmp, self._file(key))
+        # Savings attribute to the quantized plane only — zlib shrink
+        # with the plane off is real but is not a qcomm counter.
+        saved = max(raw_bytes - disk_bytes, 0) if quantized else 0
+        return disk_bytes, saved
 
     # ------------------------------------------------------------------
     # Scheduler side
@@ -220,6 +263,7 @@ class SharedStorageConnector(KVConnectorBase):
         for load in metadata.loads:
             t0 = telemetry.now()
             ks, vs = [], []
+            disk_bytes = 0
             try:
                 for key in load.hashes:
                     k_arr, v_arr = call_with_retry(
@@ -228,14 +272,17 @@ class SharedStorageConnector(KVConnectorBase):
                         description=f"KV page load {key[:12]}")
                     ks.append(k_arr)
                     vs.append(v_arr)
+                    disk_bytes += os.path.getsize(self._file(key))
             except Exception:
                 self._telemetry.record_failure(self.telemetry_name)
                 raise
             # Files hold [L, KVH, PS, D] per page; stack to wire layout
-            # [L, n, KVH, PS, D].
+            # [L, n, KVH, PS, D]. Transfer bytes are the ARTIFACT bytes
+            # actually read (quantized/compressed files count what they
+            # cost the shared filesystem, not their decoded size).
             k_np, v_np = np.stack(ks, axis=1), np.stack(vs, axis=1)
             self._telemetry.record_transfer(
-                self.telemetry_name, "rx", k_np.nbytes + v_np.nbytes,
+                self.telemetry_name, "rx", disk_bytes,
                 seconds=telemetry.now() - t0)
             page_io.scatter_pages(runner, load.page_ids, k_np, v_np)
             self.num_pages_loaded += len(load.page_ids)
@@ -255,19 +302,25 @@ class SharedStorageConnector(KVConnectorBase):
             t0 = telemetry.now()
             k_np, v_np = page_io.gather_pages(
                 runner, [pid for pid, _ in todo])
+            disk_bytes = saved_bytes = 0
             try:
                 for i, (_, key) in enumerate(todo):
-                    call_with_retry(
+                    nbytes, saved = call_with_retry(
                         lambda i=i, key=key: self._write_page_file(
                             key, k_np[:, i], v_np[:, i]),
                         policy=self.retry_policy,
                         description=f"KV page save {key[:12]}")
+                    disk_bytes += nbytes
+                    saved_bytes += saved
             except Exception:
                 self._telemetry.record_failure(self.telemetry_name)
                 raise
             self._telemetry.record_transfer(
-                self.telemetry_name, "tx", k_np.nbytes + v_np.nbytes,
+                self.telemetry_name, "tx", disk_bytes,
                 seconds=telemetry.now() - t0)
+            if saved_bytes:
+                self._telemetry.record_qcomm(self.telemetry_name,
+                                             saved_bytes)
             self.num_pages_saved += len(todo)
             logger.info("saved %d KV pages for %s", len(todo),
                         save.req_id)

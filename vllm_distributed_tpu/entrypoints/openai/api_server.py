@@ -384,9 +384,17 @@ async def _debug_engine_json(app: web.Application) -> dict:
             "shedding": sorted(ctrl._shedding),
             "draining": ctrl.draining,
         }
+    transport = stats.get("transport")
+    from vllm_distributed_tpu.parallel import collectives
+    qcomm = collectives.merged_qcomm_view(
+        (transport or {}).get("qcomm")
+        if isinstance(transport, dict) else None)
     return {
         "supervisor": engine.supervisor_state(),
         "engine_cores": schedulers,
+        # Quantized communication plane: per-path bytes saved +
+        # raw-precision fallbacks (empty when the plane never fired).
+        "qcomm": qcomm,
         "kv_cache_usage": stats.get("kv_cache_usage"),
         "num_running_reqs": stats.get("num_running_reqs"),
         "num_waiting_reqs": stats.get("num_waiting_reqs"),

@@ -232,6 +232,30 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # after admission stops before the server exits anyway.
     "VDT_DRAIN_TIMEOUT_S":
     lambda: float(os.getenv("VDT_DRAIN_TIMEOUT_S", "30")),
+    # --- Quantized communication plane (parallel/collectives.py +
+    # distributed/kv_transfer/quant.py) ----------------------------------
+    # Master switch: "1" ships cross-device bytes block-scaled int8
+    # (EQuARX-style in-graph collectives for the TKNP decode psum, the
+    # MoE-EP all-to-alls and the dense-TP row-parallel reduce, plus the
+    # quantized KV-transfer payload codec for dcn_pull / p2p /
+    # shared_storage). "0" (default) keeps every path byte-identical to
+    # the unquantized plane. In-graph gating is read at TRACE time —
+    # flip it before building an engine, not mid-serving.
+    "VDT_QCOMM":
+    lambda: os.getenv("VDT_QCOMM", "0") == "1",
+    # Per-path override: comma list of paths to quantize when VDT_QCOMM
+    # is on ("" = all paths). Tokens: "tknp" (token-axis attention
+    # psum), "ep" (MoE expert-parallel all-to-all + combine psum), "tp"
+    # (dense-model row-parallel output reduce), "kv" (every KV-transfer
+    # connector payload) or an individual connector name
+    # ("dcn_pull"/"p2p"/"shared_storage").
+    "VDT_QCOMM_PATHS":
+    lambda: os.getenv("VDT_QCOMM_PATHS", ""),
+    # Quantization block (elements per fp32 scale). Payload codecs clip
+    # it to the per-page-per-head span so no scale ever crosses a page
+    # or head boundary; in-graph collectives use it as-is.
+    "VDT_QCOMM_BLOCK":
+    lambda: max(16, int(os.getenv("VDT_QCOMM_BLOCK", "256"))),
     # --- Telemetry plane ------------------------------------------------
     # SLO targets scored by the output processor over the request
     # timeline: time-to-first-token and time-per-output-token budgets in

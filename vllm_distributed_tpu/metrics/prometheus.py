@@ -151,6 +151,9 @@ LABELED_METRICS = {
     # Attention dispatch: which kernel family each step ran
     # (unified|decode|general|cascade|naive).
     "vdt:attn_kernel_calls_total": ("kernel", ),
+    # Quantized communication plane (parallel/collectives.py +
+    # kv_transfer/quant.py): per-path wire/disk bytes saved.
+    "vdt:qcomm_bytes_saved_total": ("path", ),
     # DP balancer + routing tier (engine/dp_client.py, engine/router.py).
     "vdt:dp_replica_load": ("replica", ),
     "vdt:router_prefix_index_entries": ("replica", ),
@@ -325,6 +328,36 @@ def _render_transport(transport: dict) -> list[str]:
     return lines
 
 
+def _render_qcomm(transport_qcomm) -> list[str]:
+    """Quantized-communication plane counters. Two sources merge here:
+    the (possibly DP-merged) per-core telemetry recorders carry the
+    connector payload paths exactly, and parallel/collectives.py's
+    process-local trace-time counters carry the in-graph tknp/ep/tp
+    paths (analytic per-traced-collective savings — see that module;
+    subprocess cores' in-graph traces are not visible, same limitation
+    as vdt:fault_injections_total)."""
+    from vllm_distributed_tpu.parallel import collectives
+    merged = collectives.merged_qcomm_view(
+        transport_qcomm if isinstance(transport_qcomm, dict) else None)
+    if not merged:
+        return []
+    name = "vdt:qcomm_bytes_saved_total"
+    lines = [f"# HELP {name} Wire/disk bytes the quantized "
+             "communication plane saved vs raw precision, per path "
+             "(connector paths exact; in-graph paths analytic "
+             "per-traced-collective)",
+             f"# TYPE {name} counter"]
+    lines += [f'{name}{{path="{p}"}} {int(merged[p]["bytes_saved"])}'
+              for p in sorted(merged)]
+    name = "vdt:qcomm_fallbacks_total"
+    lines += [f"# HELP {name} Quantized payloads/collectives that "
+              "degraded to raw precision (corrupt scale header, "
+              "inapplicable axis, sub-byte dtype)",
+              f"# TYPE {name} counter",
+              f"{name} {sum(int(e['fallbacks']) for e in merged.values())}"]
+    return lines
+
+
 def _render_kv_cache(kv: dict) -> list[str]:
     """Block-pool introspection families (free/used/tombstoned pages,
     fragmentation, windowed prefix-cache hit rate, preemption
@@ -427,6 +460,8 @@ def render_metrics(stats: dict) -> str:
     transport = stats.get("transport")
     if isinstance(transport, dict):
         lines += _render_transport(transport)
+    lines += _render_qcomm((transport or {}).get("qcomm")
+                           if isinstance(transport, dict) else None)
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:
         lines += _render_kv_cache(kv_cache)

@@ -101,6 +101,12 @@ class TransportRecorder:
         self._shm: dict[str, dict] = {}
         # Reader backlog (writer_seq - reader_seq) at the last dequeue.
         self._shm_lag = 0
+        # Quantized-communication plane: per-path exact payload savings
+        # and raw-precision fallbacks (path = connector label; the
+        # in-graph tknp/ep/tp paths count through
+        # parallel/collectives.py instead — unreachable per step inside
+        # jit — and merge at render time).
+        self._qcomm: dict[str, dict] = {}
 
     @property
     def enabled(self) -> bool:
@@ -147,6 +153,34 @@ class TransportRecorder:
             entry = self._conn(connector)
             entry["inflight"] = max(entry["inflight"] + delta, 0)
 
+    # -- quantized communication plane ---------------------------------
+    def _qcomm_entry(self, path: str) -> dict:
+        entry = self._qcomm.get(path)
+        if entry is None:
+            entry = {"bytes_saved": 0, "fallbacks": 0}
+            self._qcomm[path] = entry
+        return entry
+
+    def record_qcomm(self, path: str, bytes_saved: int) -> None:
+        """Exact wire/disk bytes a quantized payload saved vs its raw
+        form. Credited where the OUTCOME is known: the consumer after a
+        successful wire decode (dcn_pull/p2p — a degraded pull must
+        never count), the writer for storage artifacts (a write either
+        lands or raises)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._qcomm_entry(path)["bytes_saved"] += max(
+                int(bytes_saved), 0)
+
+    def record_qcomm_fallback(self, path: str) -> None:
+        """A quantized payload failed validation and the raw-precision
+        form was (re)requested instead."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._qcomm_entry(path)["fallbacks"] += 1
+
     # -- shm broadcast ring --------------------------------------------
     def record_shm(self, side: str, wait_s: float,
                    lag: Optional[int] = None) -> None:
@@ -181,7 +215,9 @@ class TransportRecorder:
                 for side, e in self._shm.items()
             }
             return {"kv": kv, "shm": shm,
-                    "shm_lag_chunks": self._shm_lag}
+                    "shm_lag_chunks": self._shm_lag,
+                    "qcomm": {path: dict(e)
+                              for path, e in self._qcomm.items()}}
 
 
 # Process default (standalone tools, follower processes, tests);
@@ -281,8 +317,14 @@ def merge_transport_snapshots(snaps: list) -> Optional[dict]:
         return None
     kv: dict = {}
     shm: dict = {}
+    qcomm: dict = {}
     lag = 0
     for snap in snaps:
+        for path, e in (snap.get("qcomm") or {}).items():
+            tgt = qcomm.setdefault(path, {"bytes_saved": 0,
+                                          "fallbacks": 0})
+            tgt["bytes_saved"] += int(e.get("bytes_saved", 0))
+            tgt["fallbacks"] += int(e.get("fallbacks", 0))
         for conn, e in (snap.get("kv") or {}).items():
             tgt = kv.setdefault(conn, {"tx_bytes": 0, "rx_bytes": 0,
                                        "failures": 0, "inflight": 0,
@@ -302,4 +344,5 @@ def merge_transport_snapshots(snaps: list) -> Optional[dict]:
             if merged is not None:
                 tgt["wait_seconds"] = merged
         lag = max(lag, int(snap.get("shm_lag_chunks", 0)))
-    return {"kv": kv, "shm": shm, "shm_lag_chunks": lag}
+    return {"kv": kv, "shm": shm, "shm_lag_chunks": lag,
+            "qcomm": qcomm}

@@ -427,6 +427,9 @@ class LlamaForCausalLM:
         return params
 
     _QUANT_DTYPES = (jnp.int8, jnp.float8_e4m3fn, jnp.int4)
+    # Row-parallel projections whose combining all-reduce the quantized
+    # communication plane may take over (see _mm).
+    _ROW_PARALLEL = ("wo", "down", "fc2")
 
     def _use_quant_kernel(self) -> bool:
         """Fused dequant-GEMM eligibility: pallas backend on one chip
@@ -469,8 +472,25 @@ class LlamaForCausalLM:
         (decode-sized) weight-only dots on a single chip take the fused
         Pallas dequant-GEMM so only packed bytes stream from HBM
         (ops/pallas_quant_matmul.py; reference capability:
-        csrc/quantization/gptq_marlin)."""
+        csrc/quantization/gptq_marlin).
+
+        Row-parallel output projections (wo / down / fc2: input dim
+        sharded over the model axis, the dot's combining all-reduce is
+        the dense-TP wire cost) route through the explicit quantized
+        reduce when VDT_QCOMM enables the "tp" path — shard_map makes
+        GSPMD's implicit psum OURS to quantize
+        (parallel/collectives.row_parallel_dot). Quantized-weight
+        layouts and sequence parallelism (whose reduce is already
+        rewritten to reduce_scatter + all_gather) keep the GSPMD
+        path."""
         w = lp[name]
+        if (name in self._ROW_PARALLEL and w.ndim == 2 and x.ndim == 2
+                and not self.cfg.sequence_parallel
+                and w.dtype not in self._QUANT_DTYPES
+                and w.dtype != jnp.uint4):
+            from vllm_distributed_tpu.parallel import collectives
+            if collectives.tp_reduce_applicable():
+                return collectives.row_parallel_dot(x, w)
         if (w.dtype == jnp.uint4 and x.ndim == 2 and x.shape[0] <= 64
                 and self._use_quant_kernel()):
             from vllm_distributed_tpu import envs

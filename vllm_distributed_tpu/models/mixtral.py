@@ -365,9 +365,13 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 flat_e[order] % E_local)
             send_w = jnp.zeros((ep * Rk, ), flat_w.dtype).at[slot].set(
                 flat_w[order])
-            # Rows travel to their expert owner...
-            recv_x = jax.lax.all_to_all(
-                send_x.reshape(ep, Rk, H), MODEL_AXIS, 0, 0)
+            # Rows travel to their expert owner... (the [ep, Rk, H]
+            # activation shuffle is the dominant EP wire cost; VDT_QCOMM
+            # ships it block-scaled int8 — routing ids/weights stay raw,
+            # they are a K/H fraction of the volume).
+            from vllm_distributed_tpu.parallel import collectives
+            recv_x = collectives.all_to_all(
+                send_x.reshape(ep, Rk, H), MODEL_AXIS, 0, 0, path="ep")
             recv_e = jax.lax.all_to_all(
                 send_e.reshape(ep, Rk), MODEL_AXIS, 0, 0).reshape(-1)
             recv_w = jax.lax.all_to_all(
@@ -385,9 +389,9 @@ class MixtralForCausalLM(LlamaForCausalLM):
             y = y[jnp.argsort(order2)]                       # recv order
             # ...and back to their owner (all_to_all is positionally an
             # involution here: my receive slice j returns as slice j).
-            back = jax.lax.all_to_all(
-                y.reshape(ep, Rk, H), MODEL_AXIS, 0, 0).reshape(
-                    ep * Rk, H)
+            back = collectives.all_to_all(
+                y.reshape(ep, Rk, H), MODEL_AXIS, 0, 0,
+                path="ep").reshape(ep * Rk, H)
             # Combine this rank's k rows per token; slot layout gives
             # each row's source token.
             src_tok = jnp.full((ep * Rk, ), Tl, jnp.int32).at[slot].set(
@@ -443,7 +447,8 @@ class MixtralForCausalLM(LlamaForCausalLM):
             y = jax.lax.ragged_dot(g * u, w_down, group_sizes)
             y = y * w[:, None].astype(y.dtype)
             y = y[jnp.argsort(part)]  # back to expert-sorted order
-            return jax.lax.psum(y, MODEL_AXIS)
+            from vllm_distributed_tpu.parallel import collectives
+            return collectives.psum(y, MODEL_AXIS, path="ep")
 
         return shard_map(
             rank_fn, mesh=mesh,

@@ -551,9 +551,12 @@ def _paged_attention_tknp(q, k_pages, v_pages, batch, *, sm_scale, layer):
                 q_, k_[layer[0]], v_[layer[0]], bt_, batch.req_idx,
                 batch.positions, sm_scale=sm_scale)
         # Zero rows this rank does not own (incl. padding / kernel spill),
-        # then merge the disjoint rank outputs.
+        # then merge the disjoint rank outputs. The psum is the decode
+        # hot path's dominant wire cost; VDT_QCOMM ships it block-scaled
+        # int8 (parallel/collectives.py).
         out = jnp.where((slot_ >= 0)[:, None, None], out, 0)
-        return jax.lax.psum(out, token_axis)
+        from vllm_distributed_tpu.parallel import collectives
+        return collectives.psum(out, token_axis, path="tknp")
 
     K = tk.seq_info.shape[0]
     desc = tk.desc if unified else jnp.zeros((K, 1, 3), jnp.int32)

@@ -1,0 +1,323 @@
+"""Quantized in-graph collectives: block-scaled int8 over ICI/DCN.
+
+Grounded in PAPERS.md "EQuARX: Efficient Quantized AllReduce in XLA":
+cross-device bytes — not FLOPs — cap distributed decode throughput, and
+a block-scaled int8 all-reduce composed INSIDE the sharded program (so
+XLA fuses the quantize/dequantize casts into the collective schedule)
+recovers most of the 4x wire reduction with negligible quality loss.
+
+This module is the single home of that plane for the in-graph paths:
+
+* ``psum(x, axis, path=...)`` — drop-in ``jax.lax.psum`` dispatcher.
+  When the path is enabled it runs the EQuARX-shaped two-phase reduce:
+  chunk the operand K ways, quantize each chunk (symmetric per-block
+  int8, fp32 scales), ``all_to_all`` the chunks to their owner rank
+  (the reduce-scatter leg), dequantize-accumulate in fp32, requantize
+  the owned chunk, ``all_gather`` it back and dequantize. Both legs
+  ship int8 + per-block scales instead of full-precision words.
+* ``all_to_all(x, axis, ...)`` — quantized ``lax.all_to_all`` for the
+  MoE expert-parallel dispatch/combine shuffles: payload rows quantize
+  along their feature dim, the int8 payload and fp32 scales travel as
+  two small collectives, and rows dequantize on the receiving rank.
+* ``row_parallel_dot(x, w)`` — explicit reduce hook for the
+  GSPMD-sharded dense-TP path: the row-parallel matmul runs under
+  shard_map so its combining all-reduce is OURS to quantize instead of
+  an implicit GSPMD psum.
+
+Gating: ``VDT_QCOMM`` (default off) with per-path ``VDT_QCOMM_PATHS``
+(see envs.py). The config is cached and read at TRACE time — a flipped
+env var takes effect on the next trace (fresh engine), not mid-graph;
+tests and the bench harness call :func:`refresh` between legs.
+
+Accounting: collectives execute inside jitted graphs where per-step
+host counters are unreachable, so the module records the analytic
+per-execution wire savings of each TRACED quantized collective
+(path-labeled, rendered into ``vdt:qcomm_bytes_saved_total``). The
+KV-payload paths (kv_transfer/quant.py) count exact wire bytes through
+the per-core telemetry recorder instead; both sources merge at render
+time (metrics/prometheus.py).
+"""
+
+import math
+import threading
+from typing import Optional
+
+_KV_PATHS = frozenset({"dcn_pull", "p2p", "shared_storage"})
+_SCALE_BYTES = 4  # fp32 scale per quantized block
+
+_lock = threading.Lock()
+_config_cache: Optional[tuple] = None  # (enabled, paths|None, block)
+_trace_bytes_saved: dict[str, int] = {}
+_trace_fallbacks: dict[str, int] = {}
+
+
+def _config() -> tuple:
+    global _config_cache
+    if _config_cache is None:
+        from vllm_distributed_tpu import envs
+        tokens = frozenset(
+            t.strip() for t in envs.VDT_QCOMM_PATHS.split(",")
+            if t.strip())
+        _config_cache = (envs.VDT_QCOMM, tokens or None,
+                         envs.VDT_QCOMM_BLOCK)
+    return _config_cache
+
+
+def refresh() -> None:
+    """Re-read the VDT_QCOMM* env gating (tests / bench legs). Does not
+    touch counters; note that already-compiled graphs keep the plane
+    they were traced with."""
+    global _config_cache
+    _config_cache = None
+
+
+def enabled(path: str) -> bool:
+    """Is the quantized plane on for ``path``? Connector paths also
+    answer to the "kv" group token."""
+    on, paths, _ = _config()
+    if not on:
+        return False
+    if paths is None:
+        return True
+    if path in paths:
+        return True
+    return path in _KV_PATHS and "kv" in paths
+
+
+def block_size() -> int:
+    return _config()[2]
+
+
+def divisor_block(span: int, cap: Optional[int] = None) -> int:
+    """Largest divisor of ``span`` not exceeding ``cap`` (the env block
+    by default) — payload codecs use it so no scale block ever crosses
+    a page/head boundary."""
+    cap = min(span, cap if cap is not None else block_size())
+    for b in range(cap, 0, -1):
+        if span % b == 0:
+            return b
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Trace-time accounting (see module docstring: exact byte counters are
+# unreachable inside jit, so each newly traced quantized collective
+# records its analytic per-execution savings).
+# ---------------------------------------------------------------------------
+
+def _note_saved(path: str, nbytes: int) -> None:
+    with _lock:
+        _trace_bytes_saved[path] = (_trace_bytes_saved.get(path, 0)
+                                    + max(int(nbytes), 0))
+
+
+def note_fallback(path: str) -> None:
+    """A path asked for the quantized plane but could not use it (axis
+    size 1, payload already <= 1 byte/element, corrupt-scale degrade)."""
+    with _lock:
+        _trace_fallbacks[path] = _trace_fallbacks.get(path, 0) + 1
+
+
+def traced_snapshot() -> dict:
+    """Process-local in-graph counters (like fault_injection.counters:
+    read at render time by the front end; subprocess engine cores'
+    traces are not visible here — their KV-payload savings still ride
+    the per-core telemetry recorder)."""
+    with _lock:
+        return {"bytes_saved": dict(_trace_bytes_saved),
+                "fallbacks": dict(_trace_fallbacks)}
+
+
+def reset_counters() -> None:
+    with _lock:
+        _trace_bytes_saved.clear()
+        _trace_fallbacks.clear()
+
+
+def merged_qcomm_view(transport_qcomm: Optional[dict]) -> dict:
+    """One {path: {bytes_saved, fallbacks}} map combining the per-core
+    telemetry recorders' exact payload counters (possibly DP-merged)
+    with this process's trace-time in-graph counters — the shape the
+    /metrics renderer and the /debug/engine dump share."""
+    merged: dict[str, dict] = {}
+    for path, e in (transport_qcomm or {}).items():
+        if isinstance(e, dict):
+            merged[path] = {"bytes_saved": int(e.get("bytes_saved", 0)),
+                            "fallbacks": int(e.get("fallbacks", 0))}
+    traced = traced_snapshot()
+    for path, n in traced["bytes_saved"].items():
+        merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
+        merged[path]["bytes_saved"] += int(n)
+    for path, n in traced["fallbacks"].items():
+        merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
+        merged[path]["fallbacks"] += int(n)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# Block quantize / dequantize (jnp; traced inside the sharded program)
+# ---------------------------------------------------------------------------
+
+def _block_quantize(x32, block: int):
+    """[..., n] fp32 (n % block == 0) -> int8 [..., n/block, block] +
+    fp32 scales [..., n/block, 1] (symmetric absmax/127 per block)."""
+    import jax.numpy as jnp
+    xb = x32.reshape(x32.shape[:-1] + (x32.shape[-1] // block, block))
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _block_dequantize(q, scale):
+    """Inverse of _block_quantize, flattened back to [..., n] fp32."""
+    x = q.astype(scale.dtype) * scale
+    return x.reshape(x.shape[:-2] + (x.shape[-2] * x.shape[-1], ))
+
+
+def _axis_size(axis_name) -> int:
+    """Static size of a (possibly tuple) shard_map axis, from the
+    registered global mesh — collectives here are only reachable inside
+    shard_map over that mesh."""
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    if not mesh_state.has_global_mesh():
+        return 1
+    mesh = mesh_state.get_global_mesh()
+    names = (axis_name, ) if isinstance(axis_name, str) else tuple(axis_name)
+    size = 1
+    for name in names:
+        size *= mesh.shape[name]
+    return size
+
+
+# ---------------------------------------------------------------------------
+# Quantized collectives
+# ---------------------------------------------------------------------------
+
+def quantized_psum(x, axis_name, *, axis_size: int,
+                   block: Optional[int] = None):
+    """EQuARX-shaped all-reduce: quantized reduce-scatter (all_to_all of
+    int8 chunks + scales, fp32 accumulate) then quantized all-gather.
+    Exact for all-zero inputs; otherwise error is bounded by one
+    round-trip of per-block int8 rounding per leg."""
+    import jax.numpy as jnp
+    from jax import lax
+    block = block or block_size()
+    orig_dtype, orig_shape = x.dtype, x.shape
+    n = math.prod(orig_shape) if orig_shape else 1
+    K = axis_size
+    per = max(-(-n // (K * block)), 1) * block  # chunk len, % block == 0
+    flat = jnp.pad(x.astype(jnp.float32).reshape(-1), (0, K * per - n))
+    q, s = _block_quantize(flat.reshape(K, per), block)
+    # Reduce-scatter leg: chunk r of every rank lands on rank r.
+    q_t = lax.all_to_all(q, axis_name, 0, 0)
+    s_t = lax.all_to_all(s, axis_name, 0, 0)
+    part = jnp.sum(q_t.astype(jnp.float32) * s_t, axis=0)  # [nb, block]
+    # All-gather leg: requantize the owned (reduced) chunk and share it.
+    q2, s2 = _block_quantize(part.reshape(per), block)
+    qg = lax.all_gather(q2, axis_name)
+    sg = lax.all_gather(s2, axis_name)
+    full = _block_dequantize(qg, sg).reshape(K * per)[:n]
+    return full.reshape(orig_shape).astype(orig_dtype)
+
+
+def psum(x, axis_name, *, path: str):
+    """``jax.lax.psum`` drop-in: quantized when ``path`` is enabled and
+    the operand actually wins — non-float operands (lossy rounding of
+    integer sums is silently wrong) and payloads whose quantized form
+    would be no smaller (sub-byte floats, tiny operands dominated by
+    padding/scales) fall back to the exact psum, counted."""
+    import jax.numpy as jnp
+    from jax import lax
+    if not enabled(path):
+        return lax.psum(x, axis_name)
+    K = _axis_size(axis_name)
+    if K <= 1 or not jnp.issubdtype(x.dtype, jnp.floating):
+        note_fallback(path)
+        return lax.psum(x, axis_name)
+    block = block_size()
+    n = math.prod(x.shape) if x.shape else 1
+    per = max(-(-n // (K * block)), 1) * block
+    # Ring all-reduce moves ~2*(K-1)/K * payload per device; both
+    # quantized legs ship int8 + one fp32 scale per block over the
+    # PADDED chunk layout instead.
+    raw = 2 * (K - 1) * n * x.dtype.itemsize // K
+    quant = 2 * (K - 1) * per * (block + _SCALE_BYTES) // block
+    if quant >= raw:
+        note_fallback(path)
+        return lax.psum(x, axis_name)
+    _note_saved(path, raw - quant)
+    return quantized_psum(x, axis_name, axis_size=K, block=block)
+
+
+def all_to_all(x, axis_name, split_axis: int = 0, concat_axis: int = 0,
+               *, path: str):
+    """``jax.lax.all_to_all`` drop-in for [K, rows, feature] payloads:
+    quantized along the trailing feature dim when ``path`` is enabled
+    and it wins — non-float payloads, and feature dims whose divisor
+    block is so small the scales outweigh the dtype shrink (tiny or
+    prime-ish spans), fall back to the raw shuffle, counted."""
+    import jax.numpy as jnp
+    from jax import lax
+    if not enabled(path):
+        return lax.all_to_all(x, axis_name, split_axis, concat_axis)
+    K = _axis_size(axis_name)
+    feat = x.shape[-1]
+    n = math.prod(x.shape)
+    block = divisor_block(feat)
+    raw = (K - 1) * n * x.dtype.itemsize // K
+    quant = (K - 1) * (n + (n // block) * _SCALE_BYTES) // K
+    if (K <= 1 or quant >= raw
+            or not jnp.issubdtype(x.dtype, jnp.floating)):
+        note_fallback(path)
+        return lax.all_to_all(x, axis_name, split_axis, concat_axis)
+    q, s = _block_quantize(x.astype("float32"), block)
+    q_t = lax.all_to_all(q, axis_name, split_axis, concat_axis)
+    s_t = lax.all_to_all(s, axis_name, split_axis, concat_axis)
+    _note_saved(path, raw - quant)
+    return _block_dequantize(q_t, s_t).reshape(q_t.shape[:-2] + (feat, )
+                                               ).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense-TP explicit reduce hook
+# ---------------------------------------------------------------------------
+
+def tp_reduce_applicable() -> bool:
+    """Should the dense row-parallel projections take the explicit
+    quantized reduce instead of GSPMD's implicit all-reduce? Requires
+    the tp path enabled, a registered mesh with model-axis > 1, and the
+    serving-engine data-axis layout (batch unsharded — an in_spec of
+    replicated x must not force a gather)."""
+    from vllm_distributed_tpu.config import (MESH_AXIS_DATA,
+                                             MESH_AXIS_MODEL)
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    if not enabled("tp") or not mesh_state.has_global_mesh():
+        return False
+    mesh = mesh_state.get_global_mesh()
+    return (mesh.shape[MESH_AXIS_MODEL] > 1
+            and mesh.shape[MESH_AXIS_DATA] == 1)
+
+
+def row_parallel_dot(x, w):
+    """``x @ w`` for a row-parallel weight (input dim sharded over the
+    model axis) with the combining all-reduce expressed EXPLICITLY so
+    it can be quantized. The activation enters sharded on its feature
+    dim — exactly the layout the preceding column-parallel matmul
+    (attention heads / gated-MLP intermediate) already produced, so the
+    shard_map boundary moves no data: each rank contracts its feature
+    slice against its weight slab and the partial products merge
+    through the quantized psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    from vllm_distributed_tpu.parallel.mesh import shard_map
+
+    def rank_fn(x_, w_):
+        return psum(x_ @ w_, MESH_AXIS_MODEL, path="tp")
+
+    return shard_map(
+        rank_fn, mesh=mesh_state.get_global_mesh(),
+        in_specs=(P(None, MESH_AXIS_MODEL), P(MESH_AXIS_MODEL, None)),
+        out_specs=P(), check_vma=False)(x, w)

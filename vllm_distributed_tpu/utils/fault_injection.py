@@ -59,6 +59,12 @@ but never fired by production code):
   checksum verification (core/state_cache.read_journal), proving the
   scheduler degrades the admission to a full re-prefill (counted in
   ``ssm_restore_corruptions``) instead of resuming from corrupt state.
+* ``qcomm.scale_corrupt`` — the quantized KV-payload codec corrupts a
+  scale header AFTER its checksum is computed (kv_transfer/quant.py
+  encode), so the consumer's decode detects a CRC mismatch and
+  degrades to re-requesting the raw-precision payload (counted in
+  ``vdt:qcomm_fallbacks_total``), proving the recovery ladder holds
+  under the quantized wire format.
 """
 
 import threading
@@ -82,6 +88,7 @@ FAULT_POINTS = (
     "step.reconcile_stall",
     "router.stale_stats",
     "ssm.restore_corrupt",
+    "qcomm.scale_corrupt",
 )
 
 
