@@ -577,6 +577,118 @@ def _ssm_leg(record) -> None:
                 os.environ[k] = v
 
 
+def _mla_leg(record) -> None:
+    """TPLA latent-sharding leg (ROADMAP item 4 acceptance): the same
+    dummy DeepSeek config served TPLA on vs VDT_TPLA=0 at TP=2, with
+    the latent page pool sized from ONE fixed synthetic HBM budget per
+    leg (CPU exposes no memory stats, so the budget applies the
+    worker's real per-rank page-bytes accounting explicitly). Reports
+    pages fitted, max admitted concurrent MLA requests, decode tok/s
+    and greedy token parity — the capacity headroom is the point; a
+    real-TPU capture rides the standard record when a tunnel window
+    opens."""
+    import gc
+
+    from transformers import DeepseekV2Config
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, ModelConfig,
+                                             ParallelConfig,
+                                             SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    tp = 2
+    budget = 1 << 20  # synthetic per-device HBM budget for the pool
+    n_reqs, prompt_len, gen_tokens = 24, 64, 16
+    saved = os.environ.get("VDT_TPLA")
+
+    def make_config(pages):
+        mc = ModelConfig(model="dummy-dsv2-bench", dtype="float32",
+                         max_model_len=256, skip_tokenizer_init=True)
+        mc.hf_config = DeepseekV2Config(
+            vocab_size=2048, hidden_size=128, intermediate_size=256,
+            moe_intermediate_size=128, num_hidden_layers=3,
+            num_attention_heads=8, num_key_value_heads=8,
+            q_lora_rank=None, kv_lora_rank=64, qk_nope_head_dim=16,
+            qk_rope_head_dim=8, v_head_dim=16, n_routed_experts=4,
+            num_experts_per_tok=2, n_shared_experts=1,
+            first_k_dense_replace=1, routed_scaling_factor=1.0,
+            topk_method="greedy", n_group=1, topk_group=1,
+            norm_topk_prob=False, max_position_embeddings=256,
+            eos_token_id=1, head_dim=8,
+            architectures=["DeepseekV2ForCausalLM"])
+        return EngineConfig(
+            model_config=mc,
+            cache_config=CacheConfig(block_size=16,
+                                     num_gpu_blocks_override=pages),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=256, max_num_seqs=n_reqs,
+                max_model_len=256),
+            parallel_config=ParallelConfig(tensor_parallel_size=tp),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+
+    rng = np.random.default_rng(23)
+    prompts = [[int(x) for x in rng.integers(10, 2000, size=prompt_len)]
+               for _ in range(n_reqs)]
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_tokens,
+                       ignore_eos=True)
+    outputs = {}
+    try:
+        for leg, flag in (("tpla", "1"), ("repl", "0")):
+            os.environ["VDT_TPLA"] = flag
+            # Pool sized by the worker's accounting at a FIXED budget:
+            # page bytes shrink ~TP-fold with the latent sharded, so
+            # the same budget fits ~TP x the pages -> more admitted
+            # concurrency.
+            cfg = make_config(16)  # probe config for page-bytes only
+            probe = LLMEngine(cfg, load_tokenizer=False)
+            runner = probe.engine_core.engine_core.executor.worker \
+                .model_runner
+            page_bytes = runner.model.kv_cache_page_bytes(16)
+            shards = runner.model.tpla_shards
+            probe.shutdown()
+            del probe
+            gc.collect()
+            pages = budget // page_bytes
+            record[f"mla_{leg}_page_bytes"] = int(page_bytes)
+            record[f"mla_{leg}_pages"] = int(pages)
+            record[f"mla_{leg}_latent_shards"] = int(shards)
+
+            engine = LLMEngine(make_config(pages), load_tokenizer=False)
+            for i, p in enumerate(prompts):
+                engine.add_request(f"mla-{leg}-{i}", list(p), sp)
+            done = {}
+            max_running = 0
+            t0 = time.perf_counter()
+            while engine.has_unfinished_requests():
+                for o in engine.step():
+                    if o.finished:
+                        done[o.request_id] = list(o.outputs[0].token_ids)
+                max_running = max(
+                    max_running,
+                    int(engine.get_stats().get("num_running_reqs", 0)))
+            wall = time.perf_counter() - t0
+            outputs[leg] = [done[f"mla-{leg}-{i}"]
+                            for i in range(n_reqs)]
+            record[f"mla_{leg}_max_concurrent"] = max_running
+            record[f"mla_{leg}_decode_tok_s"] = round(
+                n_reqs * gen_tokens / wall, 1)
+            engine.shutdown()
+            del engine
+            gc.collect()
+        record["mla_capacity_ratio"] = round(
+            record["mla_tpla_pages"] / max(record["mla_repl_pages"], 1),
+            2)
+        record["mla_token_parity"] = outputs["tpla"] == outputs["repl"]
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_TPLA", None)
+        else:
+            os.environ["VDT_TPLA"] = saved
+
+
 def _qcomm_leg(record) -> None:
     """Quantized-communication leg (ROADMAP item 2 acceptance):
     disaggregated prefill over the dcn_pull connector with the
@@ -1150,6 +1262,12 @@ def main() -> None:
             _qcomm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["qcomm_leg_error"] = f"{type(e).__name__}: {e}"
+        # TPLA leg: MLA latent-pool capacity + decode tok/s, sharded vs
+        # replicated latent cache at a fixed HBM budget.
+        try:
+            _mla_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["mla_leg_error"] = f"{type(e).__name__}: {e}"
         # int4 leg: the fused dequant-GEMM path must BEAT bf16 decode
         # on-chip (VERDICT r4 #3's done criterion) — weight streaming
         # drops from 2 bytes to 4 bits per param.
@@ -1212,6 +1330,10 @@ def main() -> None:
             _qcomm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["qcomm_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _mla_leg(record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["mla_leg_error"] = f"{type(e).__name__}: {e}"
     _emit(record)
 
 

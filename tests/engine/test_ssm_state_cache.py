@@ -462,6 +462,72 @@ def test_journal_roundtrip_and_corruption(tmp_path):
         fi.clear("ssm.restore_corrupt")
 
 
+def test_journal_gc_reclaims_orphans_keeps_live(tmp_path, monkeypatch):
+    """Retention sweep (PR8 known gap: content-addressed journal files
+    were never deleted): TTL-expired orphans and over-budget old files
+    are reclaimed on manager init and on reset()/sleep; fresh and
+    still-referenced checkpoints survive both passes."""
+    import time
+
+    from vllm_distributed_tpu.core.state_cache import (journal_path,
+                                                       sweep_journal,
+                                                       write_journal)
+    jd = str(tmp_path / "journal")
+    os.makedirs(jd)
+    arrays = {"conv": np.arange(8, dtype=np.float32)}
+
+    def make_file(tag, age_s=0.0, size=0):
+        path = journal_path(jd, tag.encode())
+        write_journal(path, arrays, num_tokens=8)
+        if size:
+            with open(path, "ab") as f:  # inflate for budget tests
+                f.write(b"\0" * size)
+        if age_s:
+            old = time.time() - age_s
+            os.utime(path, (old, old))
+        return path
+
+    # TTL pass: a week-old orphan dies at init, fresh files survive.
+    expired = make_file("expired", age_s=8 * 86400)
+    fresh = make_file("fresh")
+    monkeypatch.setenv("VDT_SSM_CKPT_TTL_S", "604800")
+    monkeypatch.setenv("VDT_SSM_CKPT_MAX_MB", "1024")
+    m = _mgr(journal_dir=jd)
+    assert not os.path.exists(expired)
+    assert os.path.exists(fresh)
+    assert m.journal_files_reclaimed == 1
+    assert m.stats()["ssm_journal_reclaimed"] == 1
+
+    # Budget pass at sleep: oldest-first eviction down to the budget —
+    # but a checkpoint a pending persist still OWES is never reclaimed,
+    # whatever its age.
+    owed = make_file("owed", age_s=3600, size=1 << 20)
+    bulk = [make_file(f"bulk{i}", age_s=1800 - i, size=1 << 20)
+            for i in range(3)]
+
+    class _Persist:
+        journal = owed
+
+    m.pending_persists.append(_Persist())
+    monkeypatch.setenv("VDT_SSM_CKPT_MAX_MB", "2")
+    m.reset()
+    assert os.path.exists(owed)  # referenced: survives over-budget
+    survivors = [p for p in bulk if os.path.exists(p)]
+    total = sum(os.path.getsize(p) for p in (owed, fresh, *survivors))
+    assert len(survivors) < len(bulk)  # oldest bulk files reclaimed
+    # Unreferenced files were evicted oldest-first until the
+    # unprotected remainder fit the budget.
+    assert sum(os.path.getsize(p) for p in survivors) <= 2 << 20
+    assert m.journal_files_reclaimed > 1
+
+    # Direct sweep unit: keep-set beats both TTL and budget.
+    kept = make_file("kept", age_s=30 * 86400)
+    removed, _ = sweep_journal(jd, max_bytes=1, ttl_s=60,
+                               keep={kept, owed, fresh})
+    assert os.path.exists(kept) and os.path.exists(owed)
+    assert removed >= len(survivors)
+
+
 def test_dp_merge_sums_ssm_counters():
     """The vdt:ssm_* families merge across DP replicas through the
     aggregator's numeric-sum loop — flat keys, no special cases."""

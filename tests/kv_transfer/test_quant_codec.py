@@ -105,6 +105,92 @@ def test_scale_corrupt_fault_point_trips_decode():
     assert k2.shape == k.shape
 
 
+# ---------------------------------------------------------------------------
+# Latent (MLA/TPLA) wire format: versioned geometry, old-decoder
+# rejection, and the shard/unshard transform round-trip
+# ---------------------------------------------------------------------------
+
+_LATENT_META = {"kv_lora_rank": 32, "rope_dim": 8, "tp_shard": 2}
+
+
+def _latent_pages(dtype=np.float32, pages=3):
+    rng = np.random.default_rng(7)
+    kv = rng.normal(size=(2, pages, 4, 32)).astype(dtype)
+    pe = rng.normal(size=(2, pages, 4, 8)).astype(dtype)
+    return kv, pe
+
+
+def test_latent_codec_roundtrip_carries_geometry():
+    kv, pe = _latent_pages()
+    payload = quant.encode_pages(kv, pe, latent=_LATENT_META)
+    assert payload["version"] == quant.LATENT_WIRE_VERSION
+    assert quant.latent_meta(payload) == _LATENT_META
+    k2, v2 = quant.decode_pages(payload)
+    assert k2.shape == kv.shape and v2.shape == pe.shape
+    amax = np.max(np.abs(kv))
+    assert np.max(np.abs(kv - k2)) <= amax / 127.0
+    # The scale block divides BOTH stacks' per-page spans (the rope
+    # sidecar span, 4*8=32, is the binding one here).
+    assert (4 * 32) % payload["block"] == 0
+    assert (4 * 8) % payload["block"] == 0
+
+
+def test_latent_payload_rejected_by_pre_tpla_decoder(monkeypatch):
+    # An old engine's decoder (MAX_DECODE_VERSION=1) must REJECT a
+    # latent payload — degrade to rejection, never silent corruption.
+    kv, pe = _latent_pages()
+    payload = quant.encode_pages(kv, pe, latent=_LATENT_META)
+    monkeypatch.setattr(quant, "MAX_DECODE_VERSION", 1)
+    with pytest.raises(quant.QuantCodecError):
+        quant.decode_pages(payload)
+
+
+def test_latent_geometry_in_crc():
+    kv, pe = _latent_pages()
+    payload = quant.encode_pages(kv, pe, latent=_LATENT_META)
+    payload["kv_lora_rank"] = 64  # header tamper must fail the CRC
+    with pytest.raises(quant.QuantCodecError):
+        quant.decode_pages(payload)
+
+
+def test_standard_payloads_keep_wire_version_1():
+    # Old consumers must keep decoding standard payloads unchanged.
+    k, v = _pages(np.float32)
+    payload = quant.encode_pages(k, v)
+    assert payload["version"] == quant.WIRE_VERSION == 1
+    assert quant.latent_meta(payload) is None
+
+
+@pytest.mark.parametrize("producer_shards,consumer_shards",
+                         [(1, 2), (2, 4), (4, 1), (2, 2)])
+def test_latent_shard_transform_roundtrip_bit_exact(producer_shards,
+                                                    consumer_shards):
+    """A producer mesh's cache layout -> wire -> a DIFFERENT TP
+    degree's cache layout -> wire again: the full latent rows survive
+    bit-exactly (the acceptance criterion for cross-degree transfer)."""
+    from vllm_distributed_tpu.distributed.kv_transfer.page_io import (
+        _latent_to_wire, _wire_to_latent)
+    lkv, rope = 32, 8
+    kv, pe = _latent_pages()
+
+    def cache_of(shards):
+        if shards == 1:
+            # Replicated layout: one concatenated row, no sidecar.
+            return _wire_to_latent(kv, pe, lkv, rope, 1, lkv + rope,
+                                   None)
+        return _wire_to_latent(kv, pe, lkv, rope, shards, lkv, rope)
+
+    c_p, pe_p = cache_of(producer_shards)
+    k_w, v_w = _latent_to_wire(c_p, pe_p, lkv, rope, producer_shards)
+    assert np.array_equal(k_w, kv) and np.array_equal(v_w, pe)
+    c_c, pe_c = _wire_to_latent(
+        k_w, v_w, lkv, rope, consumer_shards,
+        lkv + rope if consumer_shards == 1 else lkv,
+        None if consumer_shards == 1 else rope)
+    k2, v2 = _latent_to_wire(c_c, pe_c, lkv, rope, consumer_shards)
+    assert np.array_equal(k2, kv) and np.array_equal(v2, pe)
+
+
 def test_payload_enabled_gating(monkeypatch):
     monkeypatch.setenv("VDT_QCOMM", "1")
     collectives.refresh()
@@ -332,6 +418,7 @@ def test_shared_storage_legacy_format_still_loads(tmp_path, monkeypatch):
     v = rng.normal(size=(2, 2, 4, 16)).astype(np.float32)
     with open(conn._file("deadbeef"), "wb") as f:
         np.savez(f, k=k, v=v)
-    k2, v2 = conn._read_page_file("deadbeef")
+    k2, v2, latent = conn._read_page_file("deadbeef")
     np.testing.assert_array_equal(k2, k)
     np.testing.assert_array_equal(v2, v)
+    assert latent is None

@@ -222,6 +222,63 @@ def test_quantized_all_to_all_bounded_divergence(qcomm_on):
 
 
 # ---------------------------------------------------------------------------
+# Quantized all_gather (the MoE-EP re-replicate step; path "ep" —
+# and the TPLA "tpla" path's gating rides the same dispatcher)
+# ---------------------------------------------------------------------------
+
+def test_quantized_all_gather_bounded_divergence(qcomm_on):
+    k = 4
+    mesh = _mesh(k)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(k * 8, 64)).astype(np.float32)
+    in_specs = (P("model", None), )
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.all_gather(x_, "model", tiled=True,
+                                              path="ep"),
+            mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+        want = shard_map(
+            lambda x_: jax.lax.all_gather(x_, "model", tiled=True),
+            mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(want), x)
+    bound = np.max(np.abs(x)) / 127.0 + 1e-6
+    assert np.max(np.abs(np.asarray(got) - x)) < bound
+    assert collectives.traced_snapshot()["bytes_saved"].get("ep", 0) > 0
+
+
+def test_all_gather_off_is_exact_lax(monkeypatch):
+    monkeypatch.delenv("VDT_QCOMM", raising=False)
+    collectives.refresh()
+    k = 2
+    mesh = _mesh(k)
+    x = np.arange(k * 4 * 8, dtype=np.float32).reshape(k * 4, 8)
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.all_gather(x_, "model", tiled=True,
+                                              path="ep"),
+            mesh=mesh, in_specs=(P("model", None), ), out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), x)
+
+
+def test_all_gather_integer_operand_falls_back_exact(qcomm_on):
+    collectives.reset_counters()
+    k = 2
+    mesh = _mesh(k)
+    x = np.arange(k * 4 * 8, dtype=np.int32).reshape(k * 4, 8)
+    with global_mesh(mesh), mesh:
+        got = shard_map(
+            lambda x_: collectives.all_gather(x_, "model", tiled=True,
+                                              path="ep"),
+            mesh=mesh, in_specs=(P("model", None), ), out_specs=P(),
+            check_vma=False)(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), x)
+    assert collectives.traced_snapshot()["fallbacks"].get("ep") == 1
+
+
+# ---------------------------------------------------------------------------
 # EP MoE block: quantized dispatch/combine vs exact, both EP modes
 # ---------------------------------------------------------------------------
 

@@ -107,6 +107,71 @@ def journal_path(journal_dir: str, key: bytes) -> str:
     return os.path.join(journal_dir, f"ssm_{key.hex()}.npz")
 
 
+def sweep_journal(journal_dir: str, *, max_bytes: int, ttl_s: float,
+                  keep=frozenset(), now: float = None) -> tuple[int, int]:
+    """Bounded-retention sweep of a checkpoint-journal directory:
+    deletes ``ssm_*.npz`` files older than ``ttl_s`` seconds (0 = no
+    TTL), then — if the survivors still exceed ``max_bytes`` (0 =
+    unbounded) — the oldest first until the directory fits. Paths in
+    ``keep`` (checkpoints an unshipped persist directive still owes, or
+    the blocked-admission memo) are never reclaimed; neither is
+    anything that is not a journal file. Returns (files_removed,
+    bytes_removed).
+
+    Content-addressed journal files deliberately outlive their requests
+    (they ARE the crash-recovery tier), so this sweep — run at manager
+    init and on sleep() — is the only thing bounding the directory."""
+    import time as _time
+    if not journal_dir or not os.path.isdir(journal_dir):
+        return 0, 0
+    if now is None:
+        now = _time.time()
+    entries = []
+    for name in os.listdir(journal_dir):
+        if not (name.startswith("ssm_") and name.endswith(".npz")):
+            continue
+        path = os.path.join(journal_dir, name)
+        if path in keep:
+            continue
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, path))
+    entries.sort()  # oldest first
+    removed = removed_bytes = 0
+
+    def reclaim(mtime, size, path) -> bool:
+        nonlocal removed, removed_bytes
+        try:
+            os.remove(path)
+        except OSError:
+            return False
+        removed += 1
+        removed_bytes += size
+        return True
+
+    survivors = []
+    for mtime, size, path in entries:
+        if ttl_s > 0 and now - mtime > ttl_s:
+            reclaim(mtime, size, path)
+        else:
+            survivors.append((mtime, size, path))
+    if max_bytes > 0:
+        total = sum(size for _, size, _ in survivors)
+        for mtime, size, path in survivors:
+            if total <= max_bytes:
+                break
+            if reclaim(mtime, size, path):
+                total -= size
+    if removed:
+        logger.info(
+            "SSM checkpoint journal sweep: reclaimed %d files "
+            "(%.1f MiB) from %s", removed, removed_bytes / 2**20,
+            journal_dir)
+    return removed, removed_bytes
+
+
 def state_fingerprint(shapes: dict) -> bytes:
     """Geometry fingerprint of a model's state arrays ({name: ((shape),
     dtype)}): stored in every journal file and checked at lookup so a
@@ -278,11 +343,30 @@ class StateCacheManager:
     checkpoints: int = 0
     resume_tokens_saved: int = 0
     restore_corruptions: int = 0
+    journal_files_reclaimed: int = 0
 
     def __post_init__(self) -> None:
         self.free_slots = list(range(self.num_slots - 1, -1, -1))
         if self.journal_dir:
             os.makedirs(self.journal_dir, exist_ok=True)
+            # Retention sweep at init: expired / over-budget files from
+            # prior runs are reclaimed BEFORE any of them could serve a
+            # replay (recent checkpoints — the ones recovery actually
+            # wants — sort last and survive).
+            self._sweep_journal()
+
+    def _sweep_journal(self) -> None:
+        from vllm_distributed_tpu import envs
+        keep = {s.journal for s in self.by_key.values() if s.journal}
+        keep.update(d.journal for d in self.pending_persists
+                    if getattr(d, "journal", None))
+        if self._last_journal is not None:
+            keep.add(self._last_journal[0])
+        removed, _ = sweep_journal(
+            self.journal_dir,
+            max_bytes=envs.VDT_SSM_CKPT_MAX_MB * 2**20,
+            ttl_s=envs.VDT_SSM_CKPT_TTL_S, keep=keep)
+        self.journal_files_reclaimed += removed
 
     # ------------------------------------------------------------------
     # Hash chains
@@ -562,7 +646,14 @@ class StateCacheManager:
 
     def reset(self) -> None:
         """Forget every snapshot (sleep/wake released the pool's HBM).
-        Counters survive — they are lifetime totals."""
+        Counters survive — they are lifetime totals. The sleep boundary
+        also runs the journal retention sweep: an idle engine is the
+        cheapest moment to reclaim expired / over-budget checkpoint
+        files. The sweep runs BEFORE the bookkeeping clears so a
+        checkpoint an unshipped persist directive still references is
+        protected at the moment of the sweep."""
+        if self.journal_dir:
+            self._sweep_journal()
         self.by_key.clear()
         self.by_slot.clear()
         self.pending.clear()
@@ -581,4 +672,5 @@ class StateCacheManager:
             "ssm_state_bytes_held": len(self.by_key) * self.bytes_per_slot,
             "ssm_resume_tokens_saved": self.resume_tokens_saved,
             "ssm_restore_corruptions": self.restore_corruptions,
+            "ssm_journal_reclaimed": self.journal_files_reclaimed,
         }

@@ -541,8 +541,12 @@ class DCNPullConnector(KVConnectorBase):
                                       timeout=120.0) as sock:
             # Advertise the codec only when THIS side's plane is on:
             # a VDT_QCOMM=0 consumer must stay byte-identical to the
-            # unquantized plane even against an enabled producer.
-            accept = (quant.WIRE_VERSION
+            # unquantized plane even against an enabled producer. The
+            # advertised number is the NEWEST payload version this
+            # decoder accepts (latent payloads stamp a higher one), so
+            # a pre-TPLA consumer advertising 1 never receives a
+            # latent-format codec payload it would have to reject.
+            accept = (quant.MAX_DECODE_VERSION
                       if quant.payload_enabled(self.telemetry_name)
                       else 0)
             _send_msg(sock, {"op": "pull",
@@ -579,6 +583,14 @@ class DCNPullConnector(KVConnectorBase):
             self._telemetry.record_transfer(
                 self.telemetry_name, "rx", nbytes,
                 seconds=telemetry.now() - t0)
+            # Latent-aware wire format: cross-check the payload's
+            # geometry (codec header or raw-reply meta) against this
+            # engine's model BEFORE staging — a foreign store fails the
+            # pull cleanly (local recompute), never corrupts pages.
+            codec = reply.get("codec")
+            meta = (quant.latent_meta(codec) if quant.is_encoded(codec)
+                    else reply.get("latent"))
+            page_io.check_latent_wire(runner, k, v, meta)
             n = len(pull.local_page_ids)
             if k.shape[1] < n:
                 raise RuntimeError(
@@ -746,13 +758,21 @@ class DCNPullConnector(KVConnectorBase):
         from vllm_distributed_tpu.metrics import telemetry
         t0 = telemetry.now()
         k, v = page_io.gather_pages(runner, page_ids)
-        if (not job.want_raw and job.accept_qcomm >= quant.WIRE_VERSION
+        # MLA latent pages ship the versioned latent wire format: full
+        # unsharded rows + geometry meta, so a consumer mesh of any TP
+        # degree re-slices on receipt. Latent codec payloads need the
+        # consumer to accept LATENT_WIRE_VERSION (a pre-TPLA consumer
+        # advertising 1 gets the raw form instead).
+        latent = page_io.latent_wire_meta(runner)
+        need = (quant.LATENT_WIRE_VERSION if latent is not None
+                else quant.WIRE_VERSION)
+        if (not job.want_raw and job.accept_qcomm >= need
                 and quant.payload_enabled(self.telemetry_name, k.dtype)):
             # bytes_saved is credited by the CONSUMER after a
             # successful decode — crediting at encode would overstate
             # savings exactly when a corrupt payload degrades to a raw
             # re-request.
-            payload = quant.encode_pages(k, v)
+            payload = quant.encode_pages(k, v, latent=latent)
             nbytes = quant.encoded_nbytes(payload)
             reply = {"ok": True, "codec": payload}
         else:
@@ -765,6 +785,8 @@ class DCNPullConnector(KVConnectorBase):
                 "v_shape": list(v.shape),
                 "dtype": str(k.dtype),
             }
+            if latent is not None:
+                reply["latent"] = latent
         self._telemetry.record_transfer(self.telemetry_name, "tx",
                                         nbytes,
                                         seconds=telemetry.now() - t0)

@@ -1,10 +1,27 @@
 """Paged-cache <-> host page movement shared by KV connectors.
 
-Every connector exchanges pages in a TP-invariant wire layout: checkpoint
-KV heads only (replica heads added for tp > num_kv_heads are identical by
-construction, models/llama.py kv-head replication). These helpers own the
-de-replicate / re-replicate transform and the device gather/scatter so
-the layout lives in exactly one place.
+Every connector exchanges pages in a TP-invariant wire layout:
+
+* Standard K/V caches: checkpoint KV heads only (replica heads added
+  for tp > num_kv_heads are identical by construction, models/llama.py
+  kv-head replication).
+* MLA latent caches (models/deepseek.py): FULL UNSHARDED latent rows —
+  the "k" slot of every (k, v) pair carries the kv_c latent stack
+  [L, n_pages, page_size, kv_lora_rank] and the "v" slot the rope
+  sidecar [L, n_pages, page_size, rope_dim], both unpadded. A producer
+  serving the TPLA-sharded layout (ops/mla.py, kv_lora_rank/TP lanes
+  per rank) re-assembles full rows on gather and a consumer of ANY TP
+  degree re-slices them into its own layout on scatter — that
+  prefill/decode asymmetry is what lets a TP=1 prefill engine feed a
+  TP=8 TPLA decode engine (and vice versa) bit-exactly. Payload
+  geometry (kv_lora_rank, rope_dim, tp_shard) rides the versioned wire
+  format (quant.py latent headers, raw-reply "latent" meta) and is
+  cross-checked by check_latent_wire before any scatter: a mismatched
+  store is a clean rejection, never silent corruption.
+
+These helpers own the de-replicate / re-replicate and shard / unshard
+transforms and the device gather/scatter so the layout lives in exactly
+one place.
 """
 
 import numpy as np
@@ -12,6 +29,59 @@ import numpy as np
 
 def _replication(runner) -> int:
     return getattr(runner.model.cfg, "num_kv_head_replicas", 1)
+
+
+def _latent_geometry(runner):
+    """(kv_lora_rank, rope_dim, shards) when the runner serves an MLA
+    latent cache, else None."""
+    cfg = getattr(runner.model, "cfg", None)
+    if cfg is None or not getattr(cfg, "mla", False):
+        return None
+    return (int(cfg.kv_lora_rank), int(cfg.qk_rope_head_dim),
+            max(1, int(getattr(cfg, "tpla_shards", 1) or 1)))
+
+
+def latent_wire_meta(runner):
+    """Latent wire-format geometry dict for payload headers (None for
+    standard K/V models)."""
+    geo = _latent_geometry(runner)
+    if geo is None:
+        return None
+    lkv, rope, shards = geo
+    return {"kv_lora_rank": lkv, "rope_dim": rope, "tp_shard": shards}
+
+
+def check_latent_wire(runner, k: np.ndarray, v: np.ndarray,
+                      meta=None) -> None:
+    """Reject a wire payload whose layout does not fit this runner's
+    cache BEFORE any scatter: a latent payload into a standard-KV
+    engine (or vice versa), or latent geometry from a different model.
+    Raises RuntimeError — connectors surface it as a failed pull, so
+    the span recomputes locally instead of reading corrupt pages."""
+    geo = _latent_geometry(runner)
+    if geo is None:
+        if meta is not None or k.ndim == 4:
+            raise RuntimeError(
+                "latent-format KV payload offered to a standard-KV "
+                "engine; rejecting (producer/consumer models disagree)")
+        return
+    lkv, rope, _ = geo
+    if meta is not None and (int(meta.get("kv_lora_rank", -1)) != lkv
+                             or int(meta.get("rope_dim", -1)) != rope):
+        raise RuntimeError(
+            f"latent payload geometry (kv_lora_rank="
+            f"{meta.get('kv_lora_rank')}, rope_dim={meta.get('rope_dim')}"
+            f") does not match this model ({lkv}, {rope}); rejecting")
+    # The layer count must match EXACTLY: scatter's k[lo:hi] stage
+    # slicing would silently truncate a same-geometry-but-deeper
+    # model's stack into this cache (wrong-model KV, no error).
+    layers = int(runner.model.cfg.num_layers)
+    if (k.ndim != 4 or k.shape[-1] != lkv or v.shape[-1] != rope
+            or k.shape[0] != layers or v.shape[0] != layers):
+        raise RuntimeError(
+            f"KV payload shapes {k.shape}/{v.shape} are not this "
+            f"model's latent wire layout [{layers}, n, page, {lkv}]/"
+            f"[{layers}, n, page, {rope}]; rejecting")
 
 
 def _record(runner, direction: str, num_bytes: int, t0: float) -> None:
@@ -27,6 +97,16 @@ def _record(runner, direction: str, num_bytes: int, t0: float) -> None:
     from vllm_distributed_tpu.metrics import telemetry
     rec.record_transfer("page_io", direction, num_bytes,
                         seconds=telemetry.now() - t0)
+
+
+def _cache_keys(cache: dict) -> tuple:
+    """Cache-dict keys a connector moves, in wire (k, v) slot order:
+    ("k", "v") for the standard layout, ("c", "pe") for the TPLA latent
+    layout, ("c", ) for the replicated latent layout (the rope key
+    lives inside the "c" row)."""
+    if "k" in cache:
+        return ("k", "v")
+    return ("c", "pe") if "pe" in cache else ("c", )
 
 
 def _stage_views(runner):
@@ -48,23 +128,79 @@ def _stage_views(runner):
     def put(new):
         runner.kv_caches = new
 
-    return [(kv, (0, kv["k"].shape[0]), put)]
+    return [(kv, (0, kv[_cache_keys(kv)[0]].shape[0]), put)]
+
+
+def _latent_to_wire(c_np: np.ndarray, pe_np, lkv: int, rope: int,
+                    shards: int) -> tuple[np.ndarray, np.ndarray]:
+    """CACHE-layout latent pages -> wire layout (full unsharded rows):
+    strips per-shard lane padding and re-interleaves the TPLA shard
+    slices back into contiguous kv_lora_rank rows."""
+    if pe_np is None:
+        # Replicated layout: one concatenated (kv_c ++ k_pe) row.
+        return (np.ascontiguousarray(c_np[..., :lkv]),
+                np.ascontiguousarray(c_np[..., lkv:lkv + rope]))
+    L, P, PS = c_np.shape[:3]
+    shard_pad = c_np.shape[-1] // shards
+    lkv_local = lkv // shards
+    kv = c_np.reshape(L, P, PS, shards, shard_pad)[..., :lkv_local]
+    return (np.ascontiguousarray(kv.reshape(L, P, PS, lkv)),
+            np.ascontiguousarray(pe_np[..., :rope]))
+
+
+def _wire_to_latent(k: np.ndarray, v: np.ndarray, lkv: int, rope: int,
+                    shards: int, c_lanes: int, pe_lanes):
+    """Wire-layout latent pages -> this deployment's CACHE layout
+    (re-slice for the local TPLA shard count — the producer's TP degree
+    is irrelevant, wire rows are always full)."""
+    L, P, PS = k.shape[:3]
+    if pe_lanes is None:
+        row = np.concatenate([k, v], axis=-1)
+        if c_lanes > row.shape[-1]:
+            row = np.pad(row, [(0, 0)] * 3 + [(0, c_lanes - row.shape[-1])])
+        return row, None
+    shard_pad = c_lanes // shards
+    lkv_local = lkv // shards
+    kv = k.reshape(L, P, PS, shards, lkv_local)
+    if shard_pad > lkv_local:
+        kv = np.pad(kv, [(0, 0)] * 4 + [(0, shard_pad - lkv_local)])
+    pe = v
+    if pe_lanes > pe.shape[-1]:
+        pe = np.pad(pe, [(0, 0)] * 3 + [(0, pe_lanes - pe.shape[-1])])
+    return kv.reshape(L, P, PS, c_lanes), pe
 
 
 def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
-    """Read pages out of the device cache as host numpy in wire layout:
-    [L, n_pages, KVH_checkpoint, page_size, head_dim] (stages
-    concatenated on the layer dim under pipeline parallelism)."""
+    """Read pages out of the device cache as host numpy in wire layout
+    (stages concatenated on the layer dim under pipeline parallelism):
+    [L, n_pages, KVH_checkpoint, page_size, head_dim] K/V stacks for
+    standard caches, or [L, n_pages, page_size, kv_lora_rank] latent +
+    [L, n_pages, page_size, rope_dim] rope stacks for MLA."""
     import jax
 
     from vllm_distributed_tpu.metrics import telemetry
     t0 = telemetry.now()
     pages = np.asarray(page_ids, np.int32)
+    geo = _latent_geometry(runner)
+    views = _stage_views(runner)
+    if geo is not None:
+        lkv, rope, shards = geo
+        slices = [(cache["c"][:, pages],
+                   cache["pe"][:, pages] if "pe" in cache else None)
+                  for cache, _, _ in views]
+        parts = [_latent_to_wire(
+            np.asarray(jax.device_get(c)),
+            None if pe is None else np.asarray(jax.device_get(pe)),
+            lkv, rope, shards) for c, pe in slices]
+        k_out = np.concatenate([p[0] for p in parts], axis=0)
+        v_out = np.concatenate([p[1] for p in parts], axis=0)
+        _record(runner, "tx", k_out.nbytes + v_out.nbytes, t0)
+        return k_out, v_out
     r = _replication(runner)
     # Dispatch every stage's gather before fetching any: the N
     # device->host copies are independent and overlap.
     slices = [(cache["k"][:, pages], cache["v"][:, pages])
-              for cache, _, _ in _stage_views(runner)]
+              for cache, _, _ in views]
     ks = [np.asarray(jax.device_get(k))[:, :, ::r] for k, _ in slices]
     vs = [np.asarray(jax.device_get(v))[:, :, ::r] for _, v in slices]
     k_out = np.concatenate(ks, axis=0)
@@ -75,20 +211,26 @@ def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
 
 def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:
     """Write wire-layout pages into the device cache, re-expanding KV
-    heads for this deployment's replication factor. Updates
-    ``runner.kv_caches`` in place (new arrays; the old buffers are
-    donated away by the next jitted step)."""
+    heads (standard) or re-slicing latent rows for the local TPLA shard
+    count (MLA). Updates ``runner.kv_caches`` in place (new arrays; the
+    old buffers are donated away by the next jitted step)."""
     from vllm_distributed_tpu.metrics import telemetry
     t0 = telemetry.now()
     pages = np.asarray(page_ids, np.int32)
+    check_latent_wire(runner, k, v)
     k, v = stage_pages(runner, k, v, on_device=False)
+    nbytes = k.nbytes + (0 if v is None else v.nbytes)
     for cache, (lo, hi), put in _stage_views(runner):
-        k_all, v_all = cache["k"], cache["v"]
-        put({
-            "k": k_all.at[:, pages].set(k[lo:hi].astype(k_all.dtype)),
-            "v": v_all.at[:, pages].set(v[lo:hi].astype(v_all.dtype)),
-        })
-    _record(runner, "rx", k.nbytes + v.nbytes, t0)
+        keys = _cache_keys(cache)
+        a_all = cache[keys[0]]
+        new = {keys[0]: a_all.at[:, pages].set(
+            k[lo:hi].astype(a_all.dtype))}
+        if v is not None:
+            b_all = cache[keys[1]]
+            new[keys[1]] = b_all.at[:, pages].set(
+                v[lo:hi].astype(b_all.dtype))
+        put(new)
+    _record(runner, "rx", nbytes, t0)
 
 
 _scatter_donated_fn = None  # built lazily (module import stays jax-free)
@@ -115,28 +257,63 @@ def _scatter_donated():
     return _scatter_donated_fn
 
 
+_scatter_donated_one_fn = None
+
+
+def _scatter_donated_one():
+    """Single-array donated page scatter (the replicated latent layout
+    moves one "c" array instead of a k/v pair)."""
+    global _scatter_donated_one_fn
+    if _scatter_donated_one_fn is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, ))
+        def fn(c_all, pages, c):
+            return c_all.at[:, pages].set(c.astype(c_all.dtype),
+                                          mode="drop")
+
+        _scatter_donated_one_fn = fn
+    return _scatter_donated_one_fn
+
+
 def stage_pages(runner, k: np.ndarray, v: np.ndarray,
                 on_device: bool = True):
-    """Wire-layout pages -> CACHE layout (replication re-applied) — the
-    single home of that transform for the staging path. With
-    ``on_device`` the result is device arrays; safe from a transfer
-    thread (only dispatches an async host->device copy, overlapping
-    PCIe with the main thread's compute). ``on_device=False`` keeps
-    host numpy (fallback when a thread cannot touch the device)."""
-    r = _replication(runner)
-    if r > 1:
-        k = np.repeat(k, r, axis=2)
-        v = np.repeat(v, r, axis=2)
+    """Wire-layout pages -> CACHE layout (replication re-applied for
+    standard K/V; latent rows re-sliced/padded for the local TPLA shard
+    count, second element None for the replicated latent layout whose
+    single "c" row carries the rope key too) — the single home of that
+    transform for the staging path. With ``on_device`` the result is
+    device arrays; safe from a transfer thread (only dispatches an
+    async host->device copy, overlapping PCIe with the main thread's
+    compute). ``on_device=False`` keeps host numpy (fallback when a
+    thread cannot touch the device)."""
+    geo = _latent_geometry(runner)
+    if geo is not None:
+        lkv, rope, shards = geo
+        cache, _, _ = _stage_views(runner)[0]
+        pe_lanes = (cache["pe"].shape[-1] if "pe" in cache else None)
+        k, v = _wire_to_latent(k, v, lkv, rope, shards,
+                               cache["c"].shape[-1], pe_lanes)
+    else:
+        r = _replication(runner)
+        if r > 1:
+            k = np.repeat(k, r, axis=2)
+            v = np.repeat(v, r, axis=2)
     if not on_device:
         return k, v
     import jax.numpy as jnp
-    return jnp.asarray(k), jnp.asarray(v)
+    return jnp.asarray(k), (None if v is None else jnp.asarray(v))
 
 
 def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
                         chunk: int) -> None:
     """Apply pages [lo, lo+chunk) of a staged pull via the donated
-    scatter; page id padding (for the fixed chunk shape) drops."""
+    scatter; page id padding (for the fixed chunk shape) drops. The
+    staged arrays are already in CACHE layout (stage_pages), so the
+    same donated scatter serves the standard ("k"/"v"), TPLA latent
+    ("c"/"pe") and replicated latent ("c" only, v_dev None) layouts."""
     import jax.numpy as jnp
 
     from vllm_distributed_tpu.metrics import telemetry
@@ -145,19 +322,25 @@ def scatter_pages_chunk(runner, page_ids, k_dev, v_dev, lo: int,
     n = len(page_ids)
     take = min(chunk, n - lo)
     views = _stage_views(runner)
+    keys = _cache_keys(views[0][0])
     # Every stage shares the pool geometry; build the padded id vector
     # (out-of-range sentinel drops) and upload it once.
-    num_pages = views[0][0]["k"].shape[1]
+    num_pages = views[0][0][keys[0]].shape[1]
     ids = np.full((chunk, ), num_pages, np.int32)
     ids[:take] = np.asarray(page_ids[lo:lo + take], np.int32)
     ids_dev = jnp.asarray(ids)
     pad = [(0, 0), (0, chunk - take)] + [(0, 0)] * (k_dev.ndim - 2)
     for cache, (llo, lhi), put in views:
-        k_all, v_all = cache["k"], cache["v"]
+        k_all = cache[keys[0]]
         k_c = jnp.pad(k_dev[llo:lhi, lo:lo + take], pad)
+        nbytes += k_c.nbytes
+        if v_dev is None:
+            put({keys[0]: _scatter_donated_one()(k_all, ids_dev, k_c)})
+            continue
+        v_all = cache[keys[1]]
         v_c = jnp.pad(v_dev[llo:lhi, lo:lo + take], pad)
-        nbytes += k_c.nbytes + v_c.nbytes
+        nbytes += v_c.nbytes
         k_new, v_new = _scatter_donated()(k_all, v_all, ids_dev,
                                           k_c, v_c)
-        put({"k": k_new, "v": v_new})
+        put({keys[0]: k_new, keys[1]: v_new})
     _record(runner, "rx", nbytes, t0)

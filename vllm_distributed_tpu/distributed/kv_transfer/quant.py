@@ -11,10 +11,18 @@ dequantize any page subset independently.
 Wire format (a flat msgpack-friendly dict; np.savez stores the same
 fields for the shared_storage on-disk form):
 
-* header — ``version`` (this file's ``WIRE_VERSION``; decoders reject
-  newer versions so old engines degrade to the raw format instead of
-  misreading), ``dtype``/``k_shape``/``v_shape`` (original geometry,
-  restored bit-exactly), ``block`` (elements per scale).
+* header — ``version`` (standard K/V payloads stamp ``WIRE_VERSION``;
+  MLA latent payloads stamp ``LATENT_WIRE_VERSION``; decoders reject
+  versions newer than ``MAX_DECODE_VERSION`` so old engines degrade to
+  the raw format / a clean rejection instead of misreading),
+  ``dtype``/``k_shape``/``v_shape`` (original geometry, restored
+  bit-exactly), ``block`` (elements per scale). Latent payloads
+  additionally carry ``kind="latent"`` plus the latent geometry
+  ``kv_lora_rank``/``rope_dim``/``tp_shard`` (the PRODUCER's TPLA shard
+  count — informational: the wire rows are always full unsharded
+  latent rows, so a consumer mesh of ANY TP degree re-slices on
+  receipt; the geometry fields let it reject a shape-foreign store
+  before touching values).
 * payload — ``qk``/``qv`` int8 bytes, ``ks``/``vs`` fp32 scale bytes.
 * integrity — ``scale_crc``: CRC32 over the canonical header plus both
   scale buffers. A corrupted scale (or geometry) header turns 1-byte
@@ -38,8 +46,25 @@ import numpy as np
 from vllm_distributed_tpu.utils import fault_injection
 
 WIRE_VERSION = 1
+# MLA latent-page payloads (wire rows = kv_c latent in "k", rope k_pe
+# sidecar in "v") stamp a HIGHER version: a pre-TPLA decoder rejects
+# them outright (QuantCodecError -> raw re-request / failed pull) —
+# rejection, never silent corruption. Standard payloads keep stamping
+# WIRE_VERSION so old consumers interop unchanged.
+LATENT_WIRE_VERSION = 2
+MAX_DECODE_VERSION = 2
 
 _HEADER_FIELDS = ("version", "dtype", "k_shape", "v_shape", "block")
+_LATENT_FIELDS = ("kind", "kv_lora_rank", "rope_dim", "tp_shard")
+
+
+def header_fields(version: int) -> tuple:
+    """CRC-covered header fields for a payload version (the canonical
+    set both encode and decode hash — and the set shared_storage
+    persists into its npz meta)."""
+    if version >= LATENT_WIRE_VERSION:
+        return _HEADER_FIELDS + _LATENT_FIELDS
+    return _HEADER_FIELDS
 
 
 class QuantCodecError(RuntimeError):
@@ -67,7 +92,8 @@ def _span(shape: tuple) -> int:
 
 
 def _crc(header: dict, ks: bytes, vs: bytes) -> int:
-    canon = json.dumps({f: header[f] for f in _HEADER_FIELDS},
+    fields = header_fields(int(header["version"]))
+    canon = json.dumps({f: header[f] for f in fields},
                        sort_keys=True).encode()
     return zlib.crc32(vs, zlib.crc32(ks, zlib.crc32(canon)))
 
@@ -80,14 +106,21 @@ def _quantize(a: np.ndarray, block: int):
     return q, scale
 
 
-def encode_pages(k: np.ndarray, v: np.ndarray,
-                 block: int = None) -> dict:
-    """Wire-layout page stacks -> quantized payload dict."""
+def encode_pages(k: np.ndarray, v: np.ndarray, block: int = None,
+                 latent: dict = None) -> dict:
+    """Wire-layout page stacks -> quantized payload dict. ``latent``
+    (page_io.latent_wire_meta) marks an MLA latent payload: the header
+    gains the latent geometry and stamps LATENT_WIRE_VERSION. The scale
+    block is clipped to a divisor of the SMALLER per-page span of the
+    two stacks (for latent payloads the rope sidecar span is narrower
+    than the latent span), so no scale crosses a page boundary in
+    either stack."""
     from vllm_distributed_tpu.parallel import collectives
     k = np.asarray(k)
     v = np.asarray(v)
     assert k.dtype == v.dtype, (k.dtype, v.dtype)
-    block = collectives.divisor_block(_span(k.shape), block)
+    block = collectives.divisor_block(
+        math.gcd(_span(k.shape), _span(v.shape)), block)
     qk, ks = _quantize(k, block)
     qv, vs = _quantize(v, block)
     ks_b, vs_b = ks.tobytes(), vs.tobytes()
@@ -98,6 +131,14 @@ def encode_pages(k: np.ndarray, v: np.ndarray,
         "v_shape": [int(d) for d in v.shape],
         "block": int(block),
     }
+    if latent is not None:
+        header.update({
+            "version": LATENT_WIRE_VERSION,
+            "kind": "latent",
+            "kv_lora_rank": int(latent["kv_lora_rank"]),
+            "rope_dim": int(latent["rope_dim"]),
+            "tp_shard": int(latent.get("tp_shard", 1)),
+        })
     crc = _crc(header, ks_b, vs_b)
     if fault_injection.should_fire("qcomm.scale_corrupt"):
         # Flip one scale byte AFTER the checksum: the consumer's decode
@@ -110,6 +151,19 @@ def encode_pages(k: np.ndarray, v: np.ndarray,
 def is_encoded(payload) -> bool:
     return isinstance(payload, dict) and "qk" in payload \
         and "version" in payload
+
+
+def latent_meta(payload: dict) -> "dict | None":
+    """Latent geometry of an encoded payload (None for standard K/V
+    payloads) — the consumer cross-checks it against its own model
+    before scattering (page_io.check_latent_wire)."""
+    if int(payload.get("version", 0)) < LATENT_WIRE_VERSION:
+        return None
+    if payload.get("kind") != "latent":
+        return None
+    return {"kv_lora_rank": int(payload["kv_lora_rank"]),
+            "rope_dim": int(payload["rope_dim"]),
+            "tp_shard": int(payload.get("tp_shard", 1))}
 
 
 def encoded_nbytes(payload: dict) -> int:
@@ -146,15 +200,28 @@ def decode_pages(payload: dict) -> tuple[np.ndarray, np.ndarray]:
         dtype = np.dtype(payload["dtype"])
     except (KeyError, TypeError, ValueError) as e:
         raise QuantCodecError(f"malformed quantized payload: {e}") from e
-    if version > WIRE_VERSION:
+    if version > MAX_DECODE_VERSION:
         raise QuantCodecError(
             f"payload version {version} is newer than this decoder "
-            f"({WIRE_VERSION})")
-    if block <= 0 or _span(tuple(k_shape)) % block:
+            f"({MAX_DECODE_VERSION})")
+    if block <= 0 or _span(tuple(k_shape)) % block \
+            or _span(tuple(v_shape)) % block:
         raise QuantCodecError(
-            f"block {block} does not divide the page span of {k_shape}")
+            f"block {block} does not divide the page span of "
+            f"{k_shape}/{v_shape}")
     header = {"version": version, "dtype": payload["dtype"],
               "k_shape": k_shape, "v_shape": v_shape, "block": block}
+    if version >= LATENT_WIRE_VERSION:
+        try:
+            header.update({
+                "kind": str(payload["kind"]),
+                "kv_lora_rank": int(payload["kv_lora_rank"]),
+                "rope_dim": int(payload["rope_dim"]),
+                "tp_shard": int(payload["tp_shard"]),
+            })
+        except (KeyError, TypeError, ValueError) as e:
+            raise QuantCodecError(
+                f"latent payload missing geometry: {e}") from e
     if _crc(header, payload["ks"], payload["vs"]) != \
             int(payload.get("scale_crc", -1)):
         raise QuantCodecError("scale/geometry checksum mismatch")

@@ -101,13 +101,16 @@ class SharedStorageConnector(KVConnectorBase):
         return os.path.join(self.path, f"{hash_hex}.npz")
 
     def _read_page_file(self, key: str):
-        """One page file -> (k, v) arrays [L, KVH, PS, D]. Three formats
-        coexist in a store: quantized codec files (kv_transfer/quant.py
-        fields under npz keys), zlib-compressed raw (VDT_QCOMM=0
-        writers), and the legacy uncompressed raw — old artifacts keep
-        decoding forever. A quantized file that fails validation raises
-        QuantCodecError (fatal for the caller's retry policy, like any
-        other corrupt artifact)."""
+        """One page file -> (k, v, latent_meta) — arrays [L, KVH, PS, D]
+        (or the latent wire slices for MLA stores) plus the latent
+        geometry dict when the file carries one (None for standard
+        pages / legacy artifacts). Three formats coexist in a store:
+        quantized codec files (kv_transfer/quant.py fields under npz
+        keys), zlib-compressed raw (VDT_QCOMM=0 writers), and the
+        legacy uncompressed raw — old artifacts keep decoding forever.
+        A quantized file that fails validation raises QuantCodecError
+        (fatal for the caller's retry policy, like any other corrupt
+        artifact)."""
         with np.load(self._file(key)) as f:
             if "qcomm_meta" in f:
                 meta = json.loads(f["qcomm_meta"].tobytes().decode())
@@ -116,23 +119,31 @@ class SharedStorageConnector(KVConnectorBase):
                            "qv": f["qv"].tobytes(),
                            "ks": f["ks"].tobytes(),
                            "vs": f["vs"].tobytes()}
-                return quant.decode_pages(payload)
-            return f["k"], f["v"]
+                k, v = quant.decode_pages(payload)
+                return k, v, quant.latent_meta(payload)
+            latent = None
+            if "latent_meta" in f:
+                latent = json.loads(f["latent_meta"].tobytes().decode())
+            return f["k"], f["v"], latent
 
-    def _write_page_file(self, key: str, k_np, v_np) -> tuple[int, int]:
+    def _write_page_file(self, key: str, k_np, v_np,
+                         latent=None) -> tuple[int, int]:
         """Atomic (tmp + rename) page-file write -> (disk_bytes,
         bytes_saved vs the raw uncompressed artifact). Quantized codec
         payload when the plane is on; zlib-compressed raw otherwise —
-        either way on-disk KV artifacts shrink."""
+        either way on-disk KV artifacts shrink. ``latent``
+        (page_io.latent_wire_meta) stamps MLA latent pages with the
+        versioned latent geometry so a pre-TPLA engine REJECTS the file
+        at decode instead of misreading it."""
         tmp = self._file(key) + f".tmp{os.getpid()}"
         raw_bytes = k_np.nbytes + v_np.nbytes
         quantized = quant.payload_enabled(self.telemetry_name,
                                           k_np.dtype)
         if quantized:
-            payload = quant.encode_pages(k_np, v_np)
+            payload = quant.encode_pages(k_np, v_np, latent=latent)
             meta = {f: payload[f]
-                    for f in ("version", "dtype", "k_shape", "v_shape",
-                              "block", "scale_crc")}
+                    for f in quant.header_fields(payload["version"])
+                    + ("scale_crc", )}
             # Meta rides as raw JSON bytes — a unicode npy entry costs
             # 4 bytes/char, which matters at small page geometries.
             with open(tmp, "wb") as f:
@@ -144,7 +155,13 @@ class SharedStorageConnector(KVConnectorBase):
                          vs=np.frombuffer(payload["vs"], np.float32))
         else:
             with open(tmp, "wb") as f:
-                np.savez_compressed(f, k=k_np, v=v_np)
+                if latent is not None:
+                    np.savez_compressed(
+                        f, k=k_np, v=v_np,
+                        latent_meta=np.frombuffer(
+                            json.dumps(latent).encode(), np.uint8))
+                else:
+                    np.savez_compressed(f, k=k_np, v=v_np)
         disk_bytes = os.path.getsize(tmp)
         os.replace(tmp, self._file(key))
         # Savings attribute to the quantized plane only — zlib shrink
@@ -263,27 +280,35 @@ class SharedStorageConnector(KVConnectorBase):
         for load in metadata.loads:
             t0 = telemetry.now()
             ks, vs = [], []
+            latent = None
             disk_bytes = 0
             try:
                 for key in load.hashes:
-                    k_arr, v_arr = call_with_retry(
+                    k_arr, v_arr, meta = call_with_retry(
                         lambda key=key: self._read_page_file(key),
                         policy=self.retry_policy,
                         description=f"KV page load {key[:12]}")
                     ks.append(k_arr)
                     vs.append(v_arr)
+                    latent = latent or meta
                     disk_bytes += os.path.getsize(self._file(key))
             except Exception:
                 self._telemetry.record_failure(self.telemetry_name)
                 raise
-            # Files hold [L, KVH, PS, D] per page; stack to wire layout
-            # [L, n, KVH, PS, D]. Transfer bytes are the ARTIFACT bytes
-            # actually read (quantized/compressed files count what they
-            # cost the shared filesystem, not their decoded size).
+            # Files hold one page's wire slice ([L, KVH, PS, D], or
+            # [L, PS, kv_lora_rank]/[L, PS, rope_dim] for MLA latent
+            # stores); stack to wire layout on the page axis. Transfer
+            # bytes are the ARTIFACT bytes actually read (quantized/
+            # compressed files count what they cost the shared
+            # filesystem, not their decoded size).
             k_np, v_np = np.stack(ks, axis=1), np.stack(vs, axis=1)
             self._telemetry.record_transfer(
                 self.telemetry_name, "rx", disk_bytes,
                 seconds=telemetry.now() - t0)
+            # Cross-check the store's stamped latent geometry (when any
+            # file carried one) against THIS model before the scatter's
+            # own shape check — a foreign store fails the load cleanly.
+            page_io.check_latent_wire(runner, k_np, v_np, latent)
             page_io.scatter_pages(runner, load.page_ids, k_np, v_np)
             self.num_pages_loaded += len(load.page_ids)
             logger.info("loaded %d external KV pages for %s",
@@ -302,12 +327,13 @@ class SharedStorageConnector(KVConnectorBase):
             t0 = telemetry.now()
             k_np, v_np = page_io.gather_pages(
                 runner, [pid for pid, _ in todo])
+            latent = page_io.latent_wire_meta(runner)
             disk_bytes = saved_bytes = 0
             try:
                 for i, (_, key) in enumerate(todo):
                     nbytes, saved = call_with_retry(
                         lambda i=i, key=key: self._write_page_file(
-                            key, k_np[:, i], v_np[:, i]),
+                            key, k_np[:, i], v_np[:, i], latent=latent),
                         policy=self.retry_policy,
                         description=f"KV page save {key[:12]}")
                     disk_bytes += nbytes

@@ -196,6 +196,26 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # atomic tmp+rename discipline, one .npz per snapshot boundary.
     "VDT_SSM_CKPT_DIR":
     lambda: os.getenv("VDT_SSM_CKPT_DIR", ""),
+    # Checkpoint-journal retention: files are content-addressed and
+    # deliberately outlive their requests (they ARE the crash-recovery
+    # tier), so a sweep on manager init and on sleep() bounds the
+    # directory instead of per-request deletes. Max total MiB (oldest
+    # files reclaimed first past the budget; 0 = unbounded) and max file
+    # age in seconds (0 = no TTL). Files still referenced by an
+    # unshipped journal write are never reclaimed.
+    "VDT_SSM_CKPT_MAX_MB":
+    lambda: max(0, int(os.getenv("VDT_SSM_CKPT_MAX_MB", "1024"))),
+    "VDT_SSM_CKPT_TTL_S":
+    lambda: max(0.0, float(os.getenv("VDT_SSM_CKPT_TTL_S", "604800"))),
+    # --- TPLA: tensor-parallel latent attention (ops/mla.py) ------------
+    # Shard the MLA (DeepSeek) latent KV cache over the TP axis (PAPERS.md
+    # "TPLA"): each rank stores kv_lora_rank/TP of every latent row (the
+    # rope k_pe sidecar stays replicated), so the per-rank latent pool is
+    # ~1/TP the bytes and MLA concurrency scales ~TP-fold. Default on for
+    # TP>1 MLA models; "0" reverts wholesale to the replicated layout
+    # (byte-identical cache, head-sharded attention). Read at model load.
+    "VDT_TPLA":
+    lambda: os.getenv("VDT_TPLA", "1") == "1",
     # --- API admission control / overload protection -------------------
     # High watermark: concurrent admitted HTTP generation requests above
     # which the server sheds load with 429 + Retry-After. 0 disables
@@ -245,10 +265,11 @@ environment_variables: dict[str, Callable[[], Any]] = {
     lambda: os.getenv("VDT_QCOMM", "0") == "1",
     # Per-path override: comma list of paths to quantize when VDT_QCOMM
     # is on ("" = all paths). Tokens: "tknp" (token-axis attention
-    # psum), "ep" (MoE expert-parallel all-to-all + combine psum), "tp"
-    # (dense-model row-parallel output reduce), "kv" (every KV-transfer
-    # connector payload) or an individual connector name
-    # ("dcn_pull"/"p2p"/"shared_storage").
+    # psum), "ep" (MoE expert-parallel all-to-all + combine psum + the
+    # re-replicate all-gather), "tp" (dense-model row-parallel output
+    # reduce), "tpla" (TPLA latent-attention output combine), "kv"
+    # (every KV-transfer connector payload) or an individual connector
+    # name ("dcn_pull"/"p2p"/"shared_storage").
     "VDT_QCOMM_PATHS":
     lambda: os.getenv("VDT_QCOMM_PATHS", ""),
     # Quantization block (elements per fp32 scale). Payload codecs clip

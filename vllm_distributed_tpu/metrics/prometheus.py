@@ -111,6 +111,10 @@ _COUNTERS = {
     "ssm_checkpoints": ("vdt:ssm_checkpoints_total",
                         "SSM state snapshots committed at checkpoint "
                         "boundaries (periodic cadence + preempt parks)"),
+    "ssm_journal_reclaimed": ("vdt:ssm_journal_reclaimed_total",
+                              "Checkpoint-journal files reclaimed by "
+                              "the retention sweep (TTL expiry + "
+                              "size-budget eviction at init/sleep)"),
 }
 
 
@@ -138,6 +142,9 @@ LABELED_METRICS = {
     "vdt:device_memory_peak_bytes": ("worker", ),
     "vdt:device_memory_in_use_bytes": ("worker", ),
     "vdt:device_wait_seconds": ("worker", ),
+    # TPLA latent-pool geometry (ops/mla.py; MLA models only).
+    "vdt:tpla_latent_shards": ("worker", ),
+    "vdt:mla_latent_page_bytes": ("worker", ),
     # Telemetry plane: per-connector KV transfer + shm ring.
     "vdt:kv_transfer_bytes_total": ("connector", "direction"),
     "vdt:kv_transfer_failures_total": ("connector", ),
@@ -228,6 +235,15 @@ def _render_worker_telemetry(workers: dict) -> list[str]:
          "+ KV high-water mark)"),
         ("device_memory_in_use_bytes", "vdt:device_memory_in_use_bytes",
          "gauge", "Device HBM bytes in use at the last stats poll"),
+        # MLA latent-pool geometry (ops/mla.py TPLA layout; present only
+        # for MLA models): shards > 1 = the latent cache is TP-sharded,
+        # page bytes = PER-RANK HBM one latent page costs this worker.
+        ("tpla_latent_shards", "vdt:tpla_latent_shards", "gauge",
+         "TP shards of the MLA latent KV cache (1 = replicated layout "
+         "/ VDT_TPLA off)"),
+        ("mla_latent_page_bytes", "vdt:mla_latent_page_bytes", "gauge",
+         "Per-rank HBM bytes one MLA latent page costs (1/TP of the "
+         "replicated row under TPLA, plus the rope sidecar)"),
     )
     for key, name, kind, help_text in families:
         series = [(w, s[key]) for w, s in sorted(workers.items())

@@ -42,8 +42,11 @@ from vllm_distributed_tpu.models.llama import (MODEL_AXIS, TOKEN_AXIS,
                                                LlamaForCausalLM)
 from vllm_distributed_tpu.models.mixtral import MixtralForCausalLM
 from vllm_distributed_tpu.ops.mla import (latent_attention,
+                                          latent_shard_dim,
                                           latent_storage_dim,
-                                          write_latent_cache)
+                                          tpla_latent_attention,
+                                          write_latent_cache,
+                                          write_latent_cache_tpla)
 
 _DENSE_KEYS = frozenset({"gate", "up", "down"})
 _MOE_KEYS = frozenset({"router", "router_bias", "w_gate", "w_up", "w_down",
@@ -95,6 +98,13 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
     # Parameter layout
     # ------------------------------------------------------------------
     @property
+    def tpla_shards(self) -> int:
+        """TP shards of the latent cache (ops/mla.py TPLA layout); 1 =
+        replicated (VDT_TPLA=0 / TP 1 / indivisible kv_lora_rank —
+        models/loader.py decides once at load)."""
+        return max(1, int(getattr(self.cfg, "tpla_shards", 1) or 1))
+
+    @property
     def _n_dense(self) -> int:
         return min(self.cfg.first_k_dense_replace, self.cfg.num_layers)
 
@@ -113,21 +123,39 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
             "post_ln": P(None, None),
             # Latent projections: the down-projections and the shared
             # latent path are replicated (their outputs are per-token,
-            # not per-head); the up-projections shard on the head dim.
+            # not per-head); the up-projections shard on the head dim —
+            # or, under TPLA, on the LATENT dim (the paper's layout:
+            # every rank runs all heads against its kv_lora_rank/TP
+            # slice, so W_UK/W_UV shard where the cache does and the
+            # absorbed ql comes out latent-sharded with no collective).
             "kv_a": P(None, None, None),
             "kv_a_ln": P(None, None),
-            "w_uk": P(None, None, MODEL_AXIS, None),
-            "w_uv": P(None, None, MODEL_AXIS, None),
-            "wo": P(None, MODEL_AXIS, None),
         }
+        if self.tpla_shards > 1:
+            layer.update({
+                "w_uk": P(None, MODEL_AXIS, None, None),
+                "w_uv": P(None, MODEL_AXIS, None, None),
+                # q projections and wo replicate under TPLA (all heads
+                # on every rank; weight bytes are O(params), the latent
+                # pool — the concurrency bottleneck — is what shards).
+                "wo": P(None, None, None),
+            })
+        else:
+            layer.update({
+                "w_uk": P(None, None, MODEL_AXIS, None),
+                "w_uv": P(None, None, MODEL_AXIS, None),
+                "wo": P(None, MODEL_AXIS, None),
+            })
+        q_out = (P(None, None, None) if self.tpla_shards > 1
+                 else P(None, None, MODEL_AXIS))
         if c.q_lora_rank:
             layer.update({
                 "q_a": P(None, None, None),
                 "q_a_ln": P(None, None),
-                "q_b": P(None, None, MODEL_AXIS),
+                "q_b": q_out,
             })
         else:
-            layer["wq"] = P(None, None, MODEL_AXIS)
+            layer["wq"] = q_out
         if self._n_dense:
             layer.update({
                 "gate": P(None, None, MODEL_AXIS),
@@ -341,9 +369,15 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
     # KV cache: one latent row per token
     # ------------------------------------------------------------------
     def kv_cache_specs(self) -> dict:
-        # Latent rows are shared by every head (MQA), so the cache
-        # replicates over the model axis; pages shard over the token
-        # axis like the standard cache.
+        # Replicated layout: latent rows are shared by every head (MQA),
+        # so the cache replicates over the model axis; pages shard over
+        # the token axis like the standard cache. TPLA layout: the "c"
+        # lanes shard over the model axis (each rank holds its
+        # kv_lora_rank/TP slice of every row) and the rope sidecar "pe"
+        # replicates.
+        if self.tpla_shards > 1:
+            return {"c": P(None, TOKEN_AXIS, None, MODEL_AXIS),
+                    "pe": P(None, TOKEN_AXIS, None, None)}
         return {"c": P(None, TOKEN_AXIS, None, None)}
 
     def make_kv_caches(self, num_pages: int, page_size: int,
@@ -351,14 +385,33 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
                        num_layers: Optional[int] = None) -> dict:
         c = self.cfg
         depth = num_layers if num_layers is not None else c.num_layers
+        S = self.tpla_shards
+        if S > 1:
+            Cs = S * latent_shard_dim(c.kv_lora_rank, S)
+            Rs = latent_storage_dim(c.qk_rope_head_dim, 0)
+            dtype = cache_dtype or c.dtype
+            return {
+                "c": jnp.zeros((depth, num_pages, page_size, Cs), dtype),
+                "pe": jnp.zeros((depth, num_pages, page_size, Rs), dtype),
+            }
         Cs = latent_storage_dim(c.kv_lora_rank, c.qk_rope_head_dim)
         return {"c": jnp.zeros((depth, num_pages, page_size, Cs),
                                cache_dtype or c.dtype)}
 
     def kv_cache_page_bytes(self, page_size: int) -> int:
+        """PER-RANK HBM bytes one page costs (what the worker divides a
+        device's free HBM by). Replicated layout: the full latent row on
+        every rank. TPLA: one kv_lora_rank/TP latent shard plus the
+        replicated rope sidecar — ~1/TP the bytes, so ~TP x the pages
+        fit the same per-device budget."""
         c = self.cfg
-        Cs = latent_storage_dim(c.kv_lora_rank, c.qk_rope_head_dim)
-        return (c.num_layers * page_size * Cs *
+        S = self.tpla_shards
+        if S > 1:
+            lanes = (latent_shard_dim(c.kv_lora_rank, S) +
+                     latent_storage_dim(c.qk_rope_head_dim, 0))
+        else:
+            lanes = latent_storage_dim(c.kv_lora_rank, c.qk_rope_head_dim)
+        return (c.num_layers * page_size * lanes *
                 jnp.dtype(c.dtype).itemsize)
 
     def quantize_params(self, params: dict) -> dict:
@@ -472,7 +525,9 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
             batch.positions, R, c.rope_theta, c.rope_scaling,
             c.max_position_embeddings)
 
-        def attn_block(lp, h, cache, layer_idx):
+        tpla = self.tpla_shards
+
+        def attn_block(lp, h, caches, layer_idx):
             x = rms_norm(h, lp["input_ln"], c.rms_norm_eps)
             if c.q_lora_rank:
                 qc = rms_norm(x @ self._w(lp, "q_a"), lp["q_a_ln"],
@@ -490,20 +545,35 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
                 sin)[:, 0].astype(c.dtype)
             q_pe = apply_rope_pairwise(q_pe.astype(jnp.float32), cos,
                                        sin).astype(c.dtype)
-            cache = write_latent_cache(
-                cache, jnp.concatenate([kv_c, k_pe], axis=-1), batch,
-                layer_idx)
             # Absorb W_UK into the query: MQA over the latent cache.
+            # Under TPLA w_uk is latent-sharded, so ql comes out sharded
+            # on its last dim — exactly the layout the sharded cache
+            # attention consumes (no collective here).
             ql = jnp.einsum("tnp,knp->tnk", q_nope.astype(jnp.float32),
                             self._w(lp, "w_uk").astype(jnp.float32))
-            out_l = latent_attention(
-                ql.astype(c.dtype), q_pe, cache, batch,
-                sm_scale=sm_scale, kv_lora_rank=Lkv, rope_dim=R,
-                layer=layer_idx)
-            v = jnp.einsum("tnk,knv->tnv", out_l.astype(jnp.float32),
-                           self._w(lp, "w_uv").astype(jnp.float32))
+            if tpla > 1:
+                c_all, pe_all = write_latent_cache_tpla(
+                    caches["c"], caches["pe"], kv_c, k_pe, batch,
+                    layer_idx, shards=tpla, kv_lora_rank=Lkv)
+                caches = {"c": c_all, "pe": pe_all}
+                v = tpla_latent_attention(
+                    ql.astype(c.dtype), q_pe, c_all, pe_all, batch,
+                    self._w(lp, "w_uv"), sm_scale=sm_scale,
+                    kv_lora_rank=Lkv, rope_dim=R, shards=tpla,
+                    layer=layer_idx).astype(jnp.float32)
+            else:
+                cache = write_latent_cache(
+                    caches["c"], jnp.concatenate([kv_c, k_pe], axis=-1),
+                    batch, layer_idx)
+                caches = {"c": cache}
+                out_l = latent_attention(
+                    ql.astype(c.dtype), q_pe, cache, batch,
+                    sm_scale=sm_scale, kv_lora_rank=Lkv, rope_dim=R,
+                    layer=layer_idx)
+                v = jnp.einsum("tnk,knv->tnv", out_l.astype(jnp.float32),
+                               self._w(lp, "w_uv").astype(jnp.float32))
             o = v.reshape(T, N * V).astype(c.dtype) @ self._w(lp, "wo")
-            return h + o, cache
+            return h + o, caches
 
         attn_keys = [k for k in layer_params
                      if k not in _DENSE_KEYS and k not in _MOE_KEYS]
@@ -531,24 +601,24 @@ class DeepseekV2ForCausalLM(MixtralForCausalLM):
                              dtype=jnp.int32)[:, None]
 
             def body(car, xs):
-                h, cache = car
+                h, caches = car
                 a_lp, m_lp, layer_idx = xs
-                h, cache = attn_block(a_lp, h, cache, layer_idx)
+                h, caches = attn_block(a_lp, h, caches, layer_idx)
                 x2 = rms_norm(h, a_lp["post_ln"], c.rms_norm_eps)
                 if kind == "dense":
                     mlp_out = LlamaForCausalLM.mlp_block(self, m_lp, x2)
                 else:
                     mlp_out = self.mlp_block(m_lp, x2)
-                return (h + mlp_out, cache), None
+                return (h + mlp_out, caches), None
 
             carry, _ = jax.lax.scan(body, carry, (attn_lp, mlp_lp, ids))
             return carry
 
-        carry = (hidden, kv_caches["c"])
+        carry = (hidden, dict(kv_caches))
         carry = seg_scan(carry, 0, nd_local, "dense")
         carry = seg_scan(carry, nd_local, num_layers - nd_local, "moe")
-        hidden, cache = carry
-        return hidden, {"c": cache}
+        hidden, caches = carry
+        return hidden, caches
 
 
 class DeepseekV3ForCausalLM(DeepseekV2ForCausalLM):

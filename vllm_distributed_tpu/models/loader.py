@@ -266,6 +266,33 @@ def get_model(config: EngineConfig, mesh,
             raise ValueError(
                 "EPLB redundant experts are not wired for the DeepSeek "
                 "family yet")
+        # TPLA (ops/mla.py): shard the latent cache over the TP axis so
+        # the per-rank latent pool is ~1/TP the bytes. Decided ONCE here
+        # (weights, cache layout and attention all key on it); VDT_TPLA=0
+        # reverts wholesale to the replicated layout.
+        from vllm_distributed_tpu import envs as _envs
+        from vllm_distributed_tpu.ops.mla import tpla_applicable
+        mla_tp = config.parallel_config.tensor_parallel_size
+        arch.tpla_shards = 1
+        if _envs.VDT_TPLA and mla_tp > 1:
+            if config.parallel_config.pipeline_parallel_size > 1:
+                logger.info(
+                    "TPLA disabled under pipeline parallelism (stage "
+                    "sub-meshes don't carry the latent shard_map); "
+                    "serving the replicated latent layout")
+            elif not tpla_applicable(arch.kv_lora_rank, mla_tp):
+                logger.warning(
+                    "TPLA disabled: kv_lora_rank=%d does not divide "
+                    "tensor_parallel_size=%d; serving the replicated "
+                    "latent layout", arch.kv_lora_rank, mla_tp)
+            else:
+                arch.tpla_shards = mla_tp
+                logger.info(
+                    "TPLA: latent cache sharded %d ways over the TP "
+                    "axis (%d lanes/rank of kv_lora_rank=%d + %d rope "
+                    "lanes replicated)", mla_tp,
+                    arch.kv_lora_rank // mla_tp, arch.kv_lora_rank,
+                    arch.qk_rope_head_dim)
     # KV-head replication when TP exceeds the checkpoint's KV-head count
     # (reference: QKVParallelLinear kv replication, layers/linear.py):
     # repeat heads to the lcm so the kv-head dim divides the model axis.

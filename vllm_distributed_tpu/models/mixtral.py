@@ -317,7 +317,8 @@ class MixtralForCausalLM(LlamaForCausalLM):
         rows to their expert owners, runs the grouped GEMMs locally,
         `all_to_all`s the weighted outputs back, combines its own
         tokens' k rows, and one tiled all_gather re-replicates the
-        output for the (activation-replicated) engine.
+        output for the (activation-replicated) engine (all three
+        shuffles ride the quantized plane under VDT_QCOMM_PATHS "ep").
 
         ICI volume per MoE layer is O(T*k*H) each way plus the [T, H]
         gather — vs the replicate+psum path's O(ep * T * k * H) psum.
@@ -398,8 +399,12 @@ class MixtralForCausalLM(LlamaForCausalLM):
                 flat_tok[order])
             out_local = jax.ops.segment_sum(back, src_tok,
                                             num_segments=Tl + 1)[:Tl]
-            # Re-replicate for the activation-replicated engine.
-            return jax.lax.all_gather(out_local, MODEL_AXIS, tiled=True)
+            # Re-replicate for the activation-replicated engine — the
+            # [T, H] gather is the EP path's remaining wire cost after
+            # the quantized a2a legs; VDT_QCOMM ships it block-scaled
+            # int8 under the same "ep" path.
+            return collectives.all_gather(out_local, MODEL_AXIS,
+                                          tiled=True, path="ep")
 
         emap = (lp["expert_map"] if eplb else
                 jnp.zeros((1, 1), jnp.int32))

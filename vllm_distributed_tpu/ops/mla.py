@@ -19,18 +19,48 @@ prefill form is bounded by (Lkv+R)/(P+R) on the score matmul, which the
 MXU absorbs at these widths. A Pallas kernel can later replace the page
 scan without changing this interface.
 
-Sharding: the latent cache is REPLICATED over the model (TP) axis —
-kv_c/k_pe are shared by all heads (that is the point of MLA), so each
-TP rank attends with its local head shard against the full cache, and
-GSPMD needs no collective inside the op. Pages still shard over the
-token-parallel axis like the standard cache (not yet wired: the loader
-rejects MLA x TKNP).
+Sharding — two layouts, selected at load (models/loader.py sets
+``arch.tpla_shards``):
+
+* **Replicated** (``VDT_TPLA=0`` or TP == 1): kv_c/k_pe are shared by
+  all heads (that is the point of MLA), so each TP rank attends with
+  its local head shard against the full cache, and GSPMD needs no
+  collective inside the op. This is the pre-TPLA layout, byte-identical
+  under the kill switch.
+* **TPLA** (PAPERS.md "TPLA: Tensor Parallel Latent Attention"): the
+  latent dimension of every cache row shards over the model (TP) axis —
+  rank r stores lanes [r*Lkv/TP, (r+1)*Lkv/TP) of kv_c in the "c" pages
+  while the rope key k_pe lives in a small replicated "pe" sidecar (the
+  paper's layout: latent sharded, rope broadcast). The per-rank latent
+  pool is ~1/TP the bytes, so max concurrent MLA requests scales
+  ~TP-fold at fixed HBM. Attention runs EXACTLY (token-identical to the
+  replicated layout): inside a shard_map each rank computes partial
+  scores ql_shard·kv_c_shard per page block, a psum over the model axis
+  plus the locally-computed q_pe·k_pe reassembles the full scores, the
+  per-block (m, l, acc) state merges through the cascade emit-state
+  machinery (ops/attention.merge_attention_states) with the value
+  accumulator carrying only the rank's latent slice, and the absorbed
+  W_UV output projection contracts each rank's slice with its W_UV
+  shard — that final [T, N, V] combine is the layer's one reduced
+  collective and rides the quantized plane under VDT_QCOMM_PATHS
+  "tpla" (parallel/collectives.py). The score psum itself stays exact
+  (lax.psum): pre-softmax logits are the one tensor a block-scaled
+  round-trip can visibly move.
+
+Pages still shard over the token-parallel axis like the standard cache
+(not yet wired: the loader rejects MLA x TKNP). A TPLA-aware Pallas
+latent kernel needs the score psum between its two MXU matmuls
+(a two-kernel split); until then the TPLA path runs this module's
+blockwise scan on every backend and the Pallas kernel keeps serving the
+replicated layout.
 """
 
 import jax
 import jax.numpy as jnp
 
-from vllm_distributed_tpu.ops.attention import _MASK_VALUE, _pad_last_dim
+from vllm_distributed_tpu.ops.attention import (_MASK_VALUE,
+                                                _pad_last_dim,
+                                                merge_attention_states)
 from vllm_distributed_tpu.parallel.mesh import shard_map
 
 
@@ -41,6 +71,20 @@ def latent_storage_dim(kv_lora_rank: int, rope_dim: int) -> int:
     if jax.default_backend() == "tpu":
         return -(-c // 128) * 128
     return c
+
+
+def latent_shard_dim(kv_lora_rank: int, shards: int) -> int:
+    """Per-rank storage lanes of one TPLA latent shard: Lkv/shards,
+    padded to the 128-lane tile on TPU so each rank's slice DMAs whole
+    tiles. The global "c" last dim is ``shards *`` this."""
+    assert kv_lora_rank % shards == 0, (kv_lora_rank, shards)
+    return latent_storage_dim(kv_lora_rank // shards, 0)
+
+
+def tpla_applicable(kv_lora_rank: int, shards: int) -> bool:
+    """Can the latent dim split evenly over ``shards`` ranks? The loader
+    falls back to the replicated layout (with a log) when not."""
+    return shards > 1 and kv_lora_rank % shards == 0
 
 
 def write_latent_cache(
@@ -113,6 +157,124 @@ def ragged_latent_attention(
         body, (m0, l0, acc0),
         jnp.arange(pages_per_req, dtype=jnp.int32))
     out = acc / jnp.maximum(l, 1e-20)
+    return out.astype(ql.dtype)
+
+
+def write_latent_cache_tpla(
+    c_all: jax.Array,  # [L, NP, PS, shards * shard_pad] latent-sharded
+    pe_all: jax.Array,  # [L, NP, PS, R_pad] replicated rope sidecar
+    kv_c: jax.Array,  # [T, Lkv] new latent rows
+    k_pe: jax.Array,  # [T, R] new rope keys
+    batch,  # AttentionBatch
+    layer: jax.Array,  # [1] int32
+    *,
+    shards: int,
+    kv_lora_rank: int,
+) -> tuple[jax.Array, jax.Array]:
+    """TPLA cache write: scatter each rank's latent slice into its "c"
+    shard and the shared rope key into the replicated "pe" sidecar. The
+    new rows are re-laid out [T, shards, Lkv/shards] -> per-shard lane
+    padding -> [T, shards * shard_pad], so the (elementwise on the lane
+    dim) scatter writes every rank's slice locally — GSPMD moves no
+    data."""
+    shard_pad = c_all.shape[-1] // shards
+    lkv_local = kv_lora_rank // shards
+    T = kv_c.shape[0]
+    rows = kv_c.reshape(T, shards, lkv_local)
+    if shard_pad > lkv_local:
+        rows = jnp.pad(rows, ((0, 0), (0, 0), (0, shard_pad - lkv_local)))
+    c_new = rows.reshape(T, shards * shard_pad)
+    c_all = write_latent_cache(c_all, c_new, batch, layer)
+    pe_all = write_latent_cache(pe_all, k_pe, batch, layer)
+    return c_all, pe_all
+
+
+def tpla_latent_attention(
+    ql: jax.Array,  # [T, N, Lkv] absorbed queries, latent-dim sharded
+    q_pe: jax.Array,  # [T, N, R] rope queries, replicated
+    c_all: jax.Array,  # [L, NP, PS, shards * shard_pad] latent-sharded
+    pe_all: jax.Array,  # [L, NP, PS, R_pad] replicated rope sidecar
+    batch,  # AttentionBatch
+    w_uv: jax.Array,  # [Lkv, N, V] this layer's W_UV, latent-dim sharded
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    rope_dim: int,
+    shards: int,
+    layer: jax.Array,  # [1] int32
+) -> jax.Array:  # [T, N, V] replicated value-space output
+    """TPLA ragged latent attention + absorbed W_UV, exact (see module
+    docstring): per-block partial scores psum over the model axis, the
+    rope term computed locally from the replicated sidecar, online
+    softmax carried through merge_attention_states, per-rank latent
+    value slices contracted against the rank's W_UV shard and combined
+    with ONE psum (quantized plane path "tpla")."""
+    from jax.sharding import PartitionSpec as P
+
+    from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+    from vllm_distributed_tpu.parallel import collectives
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    if getattr(batch, "tknp", None) is not None:
+        raise NotImplementedError(
+            "MLA under token parallelism is not wired (per-rank latent "
+            "page pools); models/loader.py rejects the combination")
+    lkv_local = kv_lora_rank // shards
+    shard_pad = c_all.shape[-1] // shards
+    PS = c_all.shape[2]
+    pages_per_req = batch.block_tables.shape[1]
+
+    def rank_fn(ql_, qpe_, c_, pe_, bt_, req_idx_, q_pos_, wuv_, layer_):
+        # ql_ [T, N, lkv_local]; c_ [L, NP, PS, shard_pad] (this rank's
+        # latent lanes); pe_ replicated; wuv_ [lkv_local, N, V].
+        c_layer = c_[layer_[0]]
+        pe_layer = pe_[layer_[0]]
+        ql32 = ql_.astype(jnp.float32) * sm_scale
+        qpe32 = qpe_.astype(jnp.float32) * sm_scale
+        token_pages = bt_[req_idx_]  # [T, pages_per_req]
+        T, N = ql_.shape[0], ql_.shape[1]
+
+        def body(carry, page_i):
+            page_ids = token_pages[:, page_i]  # [T]
+            c_blk = c_layer[page_ids, :, :lkv_local].astype(jnp.float32)
+            pe_blk = pe_layer[page_ids, :, :rope_dim].astype(jnp.float32)
+            # Partial scores from this rank's latent slice; the psum
+            # over the model axis reassembles the full ql·kv_c term so
+            # every rank softmaxes the EXACT scores (identical m/l).
+            part = jnp.einsum("tnc,tpc->tnp", ql32, c_blk)
+            s = jax.lax.psum(part, MESH_AXIS_MODEL)
+            s = s + jnp.einsum("tnr,tpr->tnp", qpe32, pe_blk)
+            kv_pos = page_i * PS + jnp.arange(PS, dtype=jnp.int32)
+            valid = kv_pos[None, :] <= q_pos_[:, None]  # [T, PS] causal
+            s = jnp.where(valid[:, None, :], s, _MASK_VALUE)
+            # Per-block dense state, folded into the carry through the
+            # cascade m/l emit-state merge (a fully-masked block's
+            # m = _MASK_VALUE, so its alpha underflows to exactly 0).
+            m_b = s.max(axis=-1, keepdims=True)
+            p = jnp.exp(s - m_b)
+            l_b = p.sum(axis=-1, keepdims=True)
+            acc_b = jnp.einsum("tnp,tpl->tnl", p, c_blk)
+            return merge_attention_states(carry, (m_b, l_b, acc_b)), None
+
+        init = (jnp.full((T, N, 1), _MASK_VALUE, jnp.float32),
+                jnp.zeros((T, N, 1), jnp.float32),
+                jnp.zeros((T, N, lkv_local), jnp.float32))
+        (m, l, acc), _ = jax.lax.scan(
+            body, init, jnp.arange(pages_per_req, dtype=jnp.int32))
+        out_r = acc / jnp.maximum(l, 1e-20)  # [T, N, lkv_local]
+        # Absorbed W_UV on the rank's slice; the combine is the layer's
+        # one reduced collective — quantized plane path "tpla".
+        v_r = jnp.einsum("tnk,knv->tnv", out_r,
+                         wuv_.astype(jnp.float32))
+        return collectives.psum(v_r, MESH_AXIS_MODEL, path="tpla")
+
+    M = MESH_AXIS_MODEL
+    out = shard_map(
+        rank_fn, mesh=mesh_state.get_global_mesh(),
+        in_specs=(P(None, None, M), P(), P(None, None, None, M), P(),
+                  P(), P(), P(), P(M, None, None), P()),
+        out_specs=P(), check_vma=False)(
+            ql, q_pe, c_all, pe_all, batch.block_tables, batch.req_idx,
+            batch.positions, w_uv, layer)
     return out.astype(ql.dtype)
 
 

@@ -250,6 +250,37 @@ def psum(x, axis_name, *, path: str):
     return quantized_psum(x, axis_name, axis_size=K, block=block)
 
 
+def all_gather(x, axis_name, *, tiled: bool = False, path: str):
+    """``jax.lax.all_gather`` drop-in: the local shard quantizes along
+    its trailing feature dim (divisor block, like the all_to_all
+    payload) and the int8 values + fp32 scales gather as two small
+    collectives, dequantized on every rank. Used by the MoE-EP
+    re-replicate step (each rank contributes its token slice of the
+    combined expert output). Non-float payloads and feature dims whose
+    divisor block is too small to win fall back to the raw gather,
+    counted."""
+    import jax.numpy as jnp
+    from jax import lax
+    if not enabled(path):
+        return lax.all_gather(x, axis_name, tiled=tiled)
+    K = _axis_size(axis_name)
+    feat = x.shape[-1]
+    n = math.prod(x.shape)
+    block = divisor_block(feat)
+    # Ring all-gather ships each rank's local shard to the K-1 others.
+    raw = (K - 1) * n * x.dtype.itemsize
+    quant = (K - 1) * (n + (n // block) * _SCALE_BYTES)
+    if (K <= 1 or quant >= raw
+            or not jnp.issubdtype(x.dtype, jnp.floating)):
+        note_fallback(path)
+        return lax.all_gather(x, axis_name, tiled=tiled)
+    q, s = _block_quantize(x.astype("float32"), block)
+    qg = lax.all_gather(q, axis_name, tiled=tiled)
+    sg = lax.all_gather(s, axis_name, tiled=tiled)
+    _note_saved(path, raw - quant)
+    return _block_dequantize(qg, sg).astype(x.dtype)
+
+
 def all_to_all(x, axis_name, split_axis: int = 0, concat_axis: int = 0,
                *, path: str):
     """``jax.lax.all_to_all`` drop-in for [K, rows, feature] payloads:

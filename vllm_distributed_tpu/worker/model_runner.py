@@ -2375,6 +2375,17 @@ class TPUModelRunner:
             "attn_kernel_calls": dict(self.attn_kernel_calls),
             "precompile_graphs": self.precompile_graphs,
         }
+        if self.model is not None and getattr(self.model.cfg, "mla",
+                                              False):
+            # MLA latent-pool geometry (vdt:tpla_latent_shards /
+            # vdt:mla_latent_page_bytes{worker}): shards > 1 proves the
+            # TPLA layout is live; page bytes is the PER-RANK cost one
+            # latent page charges against this worker's HBM — together
+            # with vdt:kv_blocks they quantify the ~TP x capacity win.
+            stats["tpla_latent_shards"] = int(
+                getattr(self.model.cfg, "tpla_shards", 1) or 1)
+            stats["mla_latent_page_bytes"] = int(
+                self.model.kv_cache_page_bytes(self.page_size))
         if self._device_telemetry:
             from vllm_distributed_tpu.metrics import telemetry
             stats["device_wait_seconds"] = self.device_wait_hist.to_dict()

@@ -170,8 +170,21 @@ class TPUWorker:
             logger.info("no memory stats; defaulting to %d KV pages", pages)
             return rounded(pages)
         pages = avail // page_bytes
-        logger.info("HBM for KV: %.2f GiB -> %d pages of %d bytes",
-                    avail / 2**30, pages, page_bytes)
+        shards = getattr(
+            getattr(self.model_runner.model, "cfg", None),
+            "tpla_shards", 1) or 1
+        if shards > 1:
+            # TPLA (ops/mla.py): page_bytes is the PER-RANK cost of a
+            # latent page (1/TP of the replicated row plus the rope
+            # sidecar), so the same per-device budget admits ~TP x the
+            # pages — the capacity win this layout exists for.
+            logger.info(
+                "HBM for KV: %.2f GiB -> %d latent pages of %d "
+                "bytes/rank (TPLA x%d sharding)",
+                avail / 2**30, pages, page_bytes, shards)
+        else:
+            logger.info("HBM for KV: %.2f GiB -> %d pages of %d bytes",
+                        avail / 2**30, pages, page_bytes)
         return rounded(pages)
 
     def initialize_kv_cache(self, num_pages: int) -> None:
@@ -217,7 +230,8 @@ class TPUWorker:
         from vllm_distributed_tpu.metrics import telemetry
         per_worker = {}
         for key in ("device_wait_seconds", "device_memory_peak_bytes",
-                    "device_memory_in_use_bytes"):
+                    "device_memory_in_use_bytes", "tpla_latent_shards",
+                    "mla_latent_page_bytes"):
             if key in stats:
                 per_worker[key] = stats.pop(key)
         if "num_recompiles" in stats:
