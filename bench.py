@@ -983,14 +983,124 @@ def _mixed_batch_leg(config, prompts, sp, record) -> None:
         1.0 - (mixed_toks / mixed_time) / max(tok_s, 1e-9), 4)
     record["mixed_concurrent_prefills"] = n_prefills
     try:
-        calls = engine.get_stats().get("attn_kernel_calls")
+        stats = engine.get_stats()
+        calls = stats.get("attn_kernel_calls")
         if isinstance(calls, dict) and calls:
             record["attn_kernel_calls"] = {
                 k: int(v) for k, v in sorted(calls.items())}
+            # Per-LAYER dispatch counts: every layer of a step runs the
+            # step's kernel family, so layers = steps x depth — the
+            # number the fused-block leg compares against (how many
+            # per-layer kernel invocations each family absorbed).
+            runner = _find_runner(engine)
+            depth = (int(runner.model.cfg.num_layers)
+                     if runner is not None and runner.model is not None
+                     else 0)
+            if depth:
+                record["kernel_dispatch_per_layer"] = {
+                    k: int(v) * depth for k, v in sorted(calls.items())}
+        if "block_fusion_calls" in stats:
+            record["mixed_block_fusion_calls"] = int(
+                stats["block_fusion_calls"])
+            record["mixed_block_fusion_fallbacks"] = {
+                k: int(v) for k, v in sorted(
+                    (stats.get("block_fusion_fallbacks") or {}).items())}
     except Exception:  # noqa: BLE001 - diagnostic only
         pass
     del engine
     gc.collect()
+
+
+def _block_fusion_leg(config, prompts, sp, record) -> None:
+    """Fused decode-block acceptance leg (ISSUE 11): greedy decode
+    tok/s and the per-layer kernel dispatch mix with VDT_BLOCK_FUSION
+    on vs off, token parity asserted. On CPU this is a smoke (the
+    Pallas kernels run in interpret mode, so the tok/s ratio is NOT the
+    hardware story — the dispatch counts and parity are the signal);
+    the real-TPU capture rides ROADMAP item 5."""
+    import gc
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    import jax as _jax
+    on_tpu = _jax.default_backend() == "tpu"
+    keys = ("VDT_BLOCK_FUSION", "VDT_ATTENTION_BACKEND",
+            "VDT_PALLAS_INTERPRET")
+    saved = {k: os.environ.get(k) for k in keys}
+    sp_g = SamplingParams(temperature=0.0, max_tokens=sp.max_tokens,
+                          ignore_eos=True)
+    tokens_by_leg = {}
+    # f32 for the parity gate: this leg runs DUMMY random weights whose
+    # greedy logit gaps sit near the bf16 rounding floor — at f32 the
+    # gaps dwarf interpret-vs-XLA accumulation-order noise, so token
+    # parity tests the kernel, not tie-breaking luck. (Real checkpoints
+    # hold parity at serving dtype — the tier-1 engine gate pins that.)
+    import dataclasses as _dc
+    model_cfg = _dc.replace(config.model_config, dtype="float32")
+    try:
+        if not on_tpu:
+            # The fused path only dispatches on the Pallas backend.
+            os.environ["VDT_ATTENTION_BACKEND"] = "pallas"
+            os.environ["VDT_PALLAS_INTERPRET"] = "1"
+        for leg, flag in (("block_fusion_off", "0"),
+                          ("block_fusion_on", "1")):
+            os.environ["VDT_BLOCK_FUSION"] = flag
+            cfg = EngineConfig(
+                model_config=model_cfg,
+                cache_config=CacheConfig(block_size=16),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=256, max_num_seqs=64,
+                    max_model_len=2048, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            for i, p in enumerate(prompts):
+                engine.add_request(f"{leg}-{i}", p, sp_g)
+            toks = {f"{leg}-{i}": [] for i in range(len(prompts))}
+            t0 = time.perf_counter()
+            n_out = 0
+            while engine.has_unfinished_requests():
+                for o in engine.step():
+                    if o.request_id in toks:
+                        new = o.outputs[0].token_ids
+                        n_out += len(new) - len(toks[o.request_id])
+                        toks[o.request_id] = list(new)
+            dt = time.perf_counter() - t0
+            tokens_by_leg[leg] = [toks[f"{leg}-{i}"]
+                                  for i in range(len(prompts))]
+            record[f"{leg}_decode_tok_s"] = round(n_out / dt, 1)
+            stats = engine.get_stats()
+            calls = stats.get("attn_kernel_calls") or {}
+            depth = 0
+            runner = _find_runner(engine)
+            if runner is not None and runner.model is not None:
+                depth = int(runner.model.cfg.num_layers)
+            record[f"{leg}_dispatch"] = {
+                k: int(v) for k, v in sorted(calls.items())}
+            if depth:
+                record[f"{leg}_dispatch_per_layer"] = {
+                    k: int(v) * depth for k, v in sorted(calls.items())}
+            if flag == "1":
+                record["block_fusion_calls"] = int(
+                    stats.get("block_fusion_calls", 0))
+                record["block_fusion_fallbacks"] = {
+                    k: int(v) for k, v in sorted(
+                        (stats.get("block_fusion_fallbacks")
+                         or {}).items())}
+            del engine
+            gc.collect()
+        parity = (tokens_by_leg["block_fusion_on"]
+                  == tokens_by_leg["block_fusion_off"])
+        record["block_fusion_token_parity"] = parity
+        assert parity, "block fusion changed greedy output"
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def main() -> None:
@@ -1245,6 +1355,12 @@ def main() -> None:
             _mixed_batch_leg(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
+        # Fused decode-block leg: tok/s + dispatch mix, fusion on vs
+        # off, greedy token parity asserted (ISSUE 11).
+        try:
+            _block_fusion_leg(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["block_fusion_leg_error"] = f"{type(e).__name__}: {e}"
         # Routing leg: 2-replica fleet prefix-reuse, router vs RR.
         try:
             _routing_leg(config, record)
@@ -1318,6 +1434,10 @@ def main() -> None:
             _mixed_batch_leg(config, prompts, sp, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["mixed_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _block_fusion_leg(config, prompts, sp, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["block_fusion_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

@@ -1,0 +1,192 @@
+"""Fused transformer-block decode kernel vs the XLA-composed reference.
+
+Interpret-mode parity matrix for ops/pallas_block.py (ISSUE 11
+tentpole): the whole layer — RMSNorm -> fused QKV -> rope -> KV-page
+write -> paged attention (current token folded in register) -> O-proj
+-> RMSNorm -> gated MLP — in ONE Pallas call, across dtypes, GQA
+shapes, ragged decode groups and the window/softcap/ALiBi/sinks
+feature matrix. The reference composes the same math from the XLA ops
+(flat-scatter KV write + ragged attention)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_distributed_tpu.models.common import (alibi_slopes,
+                                                compute_rope_cos_sin)
+from vllm_distributed_tpu.ops.pallas_block import (fused_block_decode_pallas,
+                                                   fused_block_decode_xla,
+                                                   weight_tile)
+
+
+def build_case(rng, *, kv_lens, H=64, I=128, QH=8, KVH=4, hd=32, PS=8,
+               pages_per_req=6, dtype=jnp.float32, L=2, layer=1):
+    """Decode-only case: seq r's token row is row r, position
+    kv_len - 1 (this step's token is NOT yet in the cache — the layer
+    writes it)."""
+    R = len(kv_lens)
+    max_reqs = R + 2
+    num_pages = max_reqs * pages_per_req
+    T_pad = max_reqs + 8
+    k_pages = jnp.asarray(rng.standard_normal(
+        (L, num_pages, KVH, PS, hd)), dtype)
+    v_pages = jnp.asarray(rng.standard_normal(
+        (L, num_pages, KVH, PS, hd)), dtype)
+    hidden = jnp.asarray(rng.standard_normal((T_pad, H)), dtype)
+    bt = np.zeros((max_reqs, pages_per_req), np.int32)
+    for r in range(max_reqs):
+        bt[r] = np.arange(r * pages_per_req, (r + 1) * pages_per_req)
+    seq_info = np.zeros((max_reqs, 4), np.int32)
+    pos = np.zeros((T_pad, ), np.int32)
+    for r, kl in enumerate(kv_lens):
+        seq_info[r] = (r, 1, kl, r)
+        pos[r] = kl - 1
+    Dq, Dkv = QH * hd, KVH * hd
+    sc = 0.1
+    cos, sin = compute_rope_cos_sin(jnp.asarray(pos), hd, 10000.0, None)
+    return dict(
+        hidden=hidden, k=k_pages, v=v_pages,
+        wqkv=jnp.asarray(rng.standard_normal((H, Dq + 2 * Dkv)) * sc,
+                         dtype),
+        wo=jnp.asarray(rng.standard_normal((Dq, H)) * sc, dtype),
+        wg=jnp.asarray(rng.standard_normal((H, I)) * sc, dtype),
+        wu=jnp.asarray(rng.standard_normal((H, I)) * sc, dtype),
+        wd=jnp.asarray(rng.standard_normal((I, H)) * sc, dtype),
+        ln_w=jnp.asarray(1.0 + 0.1 * rng.standard_normal((2, H)), dtype),
+        rope=jnp.stack([cos, sin]),
+        seq_info=jnp.asarray(seq_info),
+        num_seqs=jnp.asarray([R], np.int32),
+        bt=jnp.asarray(bt),
+        layer=jnp.asarray([layer], np.int32),
+        QH=QH, hd=hd, R=R,
+    )
+
+
+def run_both(case, rng, *, window=0, logit_cap=0.0, has_alibi=False,
+             has_sinks=False):
+    QH = case["QH"]
+    feat = jnp.stack([
+        jnp.asarray(alibi_slopes(QH) if has_alibi else np.zeros(QH),
+                    jnp.float32),
+        jnp.asarray(rng.standard_normal(QH) if has_sinks else
+                    np.zeros(QH), jnp.float32),
+    ])
+    args = (case["hidden"], case["k"], case["v"], case["wqkv"],
+            case["wo"], case["wg"], case["wu"], case["wd"],
+            case["ln_w"], case["rope"], feat, case["seq_info"],
+            case["num_seqs"], case["bt"], case["layer"])
+    kw = dict(sm_scale=case["hd"] ** -0.5, eps=1e-6,
+              num_q_heads=QH, head_dim=case["hd"], window=window,
+              logit_cap=logit_cap, has_alibi=has_alibi,
+              has_sinks=has_sinks)
+    got = fused_block_decode_pallas(*args, interpret=True, **kw)
+    want = fused_block_decode_xla(*args, **kw)
+    return got, want
+
+
+def assert_parity(case, got, want, tol=2e-4):
+    R = case["R"]
+    h_p, k_p, v_p = (np.asarray(x) for x in got)
+    h_x, k_x, v_x = (np.asarray(x) for x in want)
+    np.testing.assert_allclose(np.float32(h_p[:R]), np.float32(h_x[:R]),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.float32(k_p), np.float32(k_x),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.float32(v_p), np.float32(v_x),
+                               rtol=tol, atol=tol)
+    # Padding rows pass through the aliased buffer untouched.
+    np.testing.assert_array_equal(h_p[R:],
+                                  np.asarray(case["hidden"])[R:])
+
+
+def test_plain_ragged_groups():
+    """Ragged kv lens spanning multiple pages and a group count that
+    does not divide the batch."""
+    rng = np.random.default_rng(0)
+    case = build_case(rng, kv_lens=[2, 9, 17, 40, 3])
+    got, want = run_both(case, rng)
+    assert_parity(case, got, want)
+
+
+def test_single_sequence_and_fresh_page():
+    """One sequence whose new token opens a fresh page (kv_len - 1 on a
+    page boundary)."""
+    rng = np.random.default_rng(1)
+    case = build_case(rng, kv_lens=[9])  # PS=8: position 8 = page 1 row 0
+    got, want = run_both(case, rng)
+    assert_parity(case, got, want)
+
+
+def test_zero_cached_positions():
+    """kv_len == 1 for every sequence (empty prefix: the step's token
+    IS the whole context): the cached-block loop runs zero iterations
+    and the warm-up fetch must not start DMAs nothing waits on."""
+    rng = np.random.default_rng(8)
+    case = build_case(rng, kv_lens=[1, 1, 1])
+    got, want = run_both(case, rng)
+    assert_parity(case, got, want)
+
+
+def test_mha_and_mqa_groups():
+    rng = np.random.default_rng(2)
+    for kvh in (1, 8):
+        case = build_case(rng, kv_lens=[2, 20, 33], KVH=kvh)
+        got, want = run_both(case, rng)
+        assert_parity(case, got, want)
+
+
+def test_window_and_softcap():
+    rng = np.random.default_rng(3)
+    case = build_case(rng, kv_lens=[2, 9, 17, 40])
+    got, want = run_both(case, rng, window=7, logit_cap=5.0)
+    assert_parity(case, got, want)
+
+
+def test_alibi_and_sinks():
+    rng = np.random.default_rng(4)
+    case = build_case(rng, kv_lens=[2, 9, 17, 40])
+    got, want = run_both(case, rng, has_alibi=True, has_sinks=True)
+    assert_parity(case, got, want)
+
+
+def test_weight_streaming_tiles():
+    """Dims larger than the tile cap force multi-tile weight streams
+    (the QKV/O-proj/MLP loops actually iterate)."""
+    rng = np.random.default_rng(5)
+    case = build_case(rng, kv_lens=[5, 26], H=64, I=256, QH=16, KVH=4,
+                      hd=32)
+    assert weight_tile(case["wqkv"].shape[1], cap=128) < \
+        case["wqkv"].shape[1]
+    got, want = run_both(case, rng)
+    assert_parity(case, got, want)
+
+
+@pytest.mark.slow
+def test_bf16_parity():
+    rng = np.random.default_rng(6)
+    case = build_case(rng, kv_lens=[2, 9, 17, 40, 3],
+                      dtype=jnp.bfloat16)
+    got, want = run_both(case, rng)
+    assert_parity(case, got, want, tol=5e-2)
+
+
+@pytest.mark.slow
+def test_full_feature_matrix():
+    """Every window/cap/alibi/sinks combination on one ragged case."""
+    rng = np.random.default_rng(7)
+    case = build_case(rng, kv_lens=[2, 9, 17, 40, 3, 11, 26])
+    for window in (0, 9):
+        for cap in (0.0, 4.0):
+            for alibi in (False, True):
+                for sinks in (False, True):
+                    got, want = run_both(case, rng, window=window,
+                                         logit_cap=cap,
+                                         has_alibi=alibi,
+                                         has_sinks=sinks)
+                    assert_parity(case, got, want)
+
+
+def test_weight_tile_divides():
+    for n in (64, 128, 384, 512, 1024, 14336, 6144):
+        t = weight_tile(n)
+        assert n % t == 0 and t <= max(512, n if n <= 512 else 512)

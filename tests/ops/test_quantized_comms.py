@@ -66,6 +66,13 @@ def test_path_override(monkeypatch):
     assert collectives.enabled("tknp")
     assert not collectives.enabled("ep")
     assert not collectives.enabled("tp")
+    assert not collectives.enabled("tknp_kv")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp_kv")
+    collectives.refresh()
+    assert collectives.enabled("tknp_kv")
+    assert not collectives.enabled("tknp")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp,kv")
+    collectives.refresh()
     # "kv" is the group token for every connector payload path.
     assert collectives.enabled("dcn_pull")
     assert collectives.enabled("p2p")
@@ -276,6 +283,134 @@ def test_all_gather_integer_operand_falls_back_exact(qcomm_on):
             check_vma=False)(jnp.asarray(x))
     np.testing.assert_array_equal(np.asarray(got), x)
     assert collectives.traced_snapshot()["fallbacks"].get("ep") == 1
+
+
+# ---------------------------------------------------------------------------
+# TKNP KV-write shuffle (path "tknp_kv") — the last raw collective of
+# ROADMAP item 5: the step's new K/V rows crossing the token-axis
+# shard_map boundary ship block-scaled int8.
+# ---------------------------------------------------------------------------
+
+
+def test_kv_shuffle_quantize_bounded_divergence(monkeypatch):
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp_kv")
+    monkeypatch.setenv("VDT_QCOMM_BLOCK", "16")
+    collectives.refresh()
+    collectives.reset_counters()
+    rng = np.random.default_rng(5)
+    k_new = rng.normal(size=(12, 4, 32)).astype(np.float32)
+    v_new = rng.normal(size=(12, 4, 32)).astype(np.float32)
+    pack = collectives.kv_shuffle_quantize(jnp.asarray(k_new),
+                                           jnp.asarray(v_new), 2)
+    assert pack is not None
+    k_d, v_d = collectives.kv_shuffle_dequantize(*pack, jnp.float32)
+    bound = np.max(np.abs(np.stack([k_new, v_new]))) / 127.0 + 1e-6
+    assert np.max(np.abs(np.asarray(k_d) - k_new)) < bound
+    assert np.max(np.abs(np.asarray(v_d) - v_new)) < bound
+    assert collectives.traced_snapshot()["bytes_saved"]["tknp_kv"] > 0
+
+
+def test_kv_shuffle_no_win_falls_back(monkeypatch):
+    """Axis size 1 (no shuffle) and integer payloads must keep the raw
+    path, counted as fallbacks."""
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp_kv")
+    collectives.refresh()
+    collectives.reset_counters()
+    x = jnp.ones((4, 2, 16), jnp.float32)
+    assert collectives.kv_shuffle_quantize(x, x, 1) is None
+    xi = jnp.ones((4, 2, 16), jnp.int32)
+    assert collectives.kv_shuffle_quantize(xi, xi, 2) is None
+    assert collectives.traced_snapshot()["fallbacks"]["tknp_kv"] == 2
+
+
+def test_kv_shuffle_off_is_inert(monkeypatch):
+    monkeypatch.delenv("VDT_QCOMM", raising=False)
+    collectives.refresh()
+    x = jnp.ones((4, 2, 16), jnp.float32)
+    assert collectives.kv_shuffle_quantize(x, x, 2) is None
+
+
+def test_tknp_kv_write_parity_through_ops(monkeypatch):
+    """The full _write_kv_cache_tknp path on a 2-rank token mesh:
+    quantized writes stay within one int8 block round-trip of the raw
+    writes, untouched pages stay byte-identical."""
+    from vllm_distributed_tpu.models.common import (AttentionBatch,
+                                                    TknpAttentionBatch)
+    from vllm_distributed_tpu.ops.attention import write_kv_cache
+    K, L, Nl, KVH, PS, D = 2, 1, 8, 2, 4, 16
+    N = K * Nl
+    rng = np.random.default_rng(9)
+    k_all = jnp.zeros((L, N, KVH, PS, D), jnp.float32)
+    v_all = jnp.zeros((L, N, KVH, PS, D), jnp.float32)
+    T = 4
+    k_new = jnp.asarray(rng.normal(size=(T, KVH, D)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(T, KVH, D)), jnp.float32)
+    # Two tokens per rank: rank 0 owns pages [0, Nl), rank 1 the rest.
+    slots = np.full((K, T), -1, np.int32)
+    kv_runs = np.zeros((K, 4, 4), np.int32)
+    n_runs = np.zeros((K, 1), np.int32)
+    for t in range(T):
+        owner = t % K
+        local_page, off = t, 1
+        slots[owner, t] = local_page * PS + off
+        g = n_runs[owner, 0]
+        kv_runs[owner, g] = (local_page, off, t - off + PS, 1)
+        n_runs[owner, 0] = g + 1
+    tk = TknpAttentionBatch(
+        slot_mapping=jnp.asarray(slots),
+        block_tables=jnp.zeros((K, 4, 4), jnp.int32),
+        seq_info=jnp.zeros((K, 4, 4), jnp.int32),
+        num_seqs=jnp.zeros((K, 1), jnp.int32),
+        kv_runs=jnp.asarray(kv_runs),
+        num_kv_runs=jnp.asarray(n_runs),
+    )
+    batch = AttentionBatch(
+        req_idx=jnp.zeros((T, ), jnp.int32),
+        positions=jnp.zeros((T, ), jnp.int32),
+        slot_mapping=jnp.zeros((T, ), jnp.int32),
+        block_tables=jnp.zeros((4, 4), jnp.int32),
+        seq_lens=jnp.zeros((4, ), jnp.int32),
+        tknp=tk,
+    )
+    from vllm_distributed_tpu.config import ParallelConfig
+    mesh = build_mesh(
+        ParallelConfig(token_parallel_size=K),
+        devices=jax.devices("cpu")[:K])
+    layer = jnp.zeros((1, ), jnp.int32)
+    with global_mesh(mesh), mesh:
+        monkeypatch.delenv("VDT_QCOMM", raising=False)
+        collectives.refresh()
+        k_raw, v_raw = write_kv_cache(k_all, v_all, k_new, v_new,
+                                      batch, layer)
+        monkeypatch.setenv("VDT_QCOMM", "1")
+        monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp_kv")
+        monkeypatch.setenv("VDT_QCOMM_BLOCK", "16")
+        collectives.refresh()
+        collectives.reset_counters()
+        k_q, v_q = write_kv_cache(k_all, v_all, k_new, v_new, batch,
+                                  layer)
+    bound = np.max(np.abs(np.asarray(k_new))) / 127.0 + 1e-6
+    assert np.max(np.abs(np.asarray(k_q) - np.asarray(k_raw))) < bound
+    bound_v = np.max(np.abs(np.asarray(v_new))) / 127.0 + 1e-6
+    assert np.max(np.abs(np.asarray(v_q) - np.asarray(v_raw))) < bound_v
+    # The raw leg actually wrote the rows it claims to have written.
+    assert np.max(np.abs(np.asarray(k_raw))) > 0
+    assert collectives.traced_snapshot()["bytes_saved"]["tknp_kv"] > 0
+
+
+def test_tknp_kv_engine_greedy_parity(checkpoint, baseline,
+                                      monkeypatch):
+    """Engine-level: the quantized KV-write shuffle keeps greedy decode
+    token-identical at the fine scale block (like the other paths)."""
+    monkeypatch.setenv("VDT_QCOMM", "1")
+    monkeypatch.setenv("VDT_QCOMM_PATHS", "tknp_kv")
+    monkeypatch.setenv("VDT_QCOMM_BLOCK", "16")
+    collectives.refresh()
+    got = _run(_make_engine(checkpoint, token_parallel_size=2), PROMPTS,
+               "qtknpkv")
+    assert got == baseline
 
 
 # ---------------------------------------------------------------------------

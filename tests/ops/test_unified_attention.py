@@ -254,6 +254,97 @@ def test_fused_write_attend_matches_write_then_naive():
 
 
 # ---------------------------------------------------------------------------
+# Attention features (sliding window / softcap / ALiBi / sinks) folded
+# into the mega-kernel — ISSUE 11 satellite: Gemma/Mistral/Bloom/
+# gpt-oss-class models stop forcing the XLA fallback.
+# ---------------------------------------------------------------------------
+
+
+def run_both_feat(case, sm_scale=0.125, *, window=0, logit_cap=0.0,
+                  slopes=None, sinks=None):
+    QH = case["q"].shape[1]
+    feat = jnp.stack([
+        jnp.asarray(slopes if slopes is not None else np.zeros(QH),
+                    jnp.float32),
+        jnp.asarray(sinks if sinks is not None else np.zeros(QH),
+                    jnp.float32),
+    ])
+    out = unified_ragged_paged_attention_pallas(
+        case["q"], case["k_pages"], case["v_pages"], case["desc"],
+        case["seq_info"], case["decode_list"], case["block_tables"],
+        None, feat, sm_scale=sm_scale, bq=case["bq"], sb=case["sb"],
+        interpret=True, window=window, logit_cap=logit_cap,
+        has_alibi=slopes is not None, has_sinks=sinks is not None)
+    want = naive_ragged_attention(
+        case["q"], case["k_pages"], case["v_pages"],
+        case["block_tables"], case["req_idx"], case["q_pos"],
+        sm_scale=sm_scale, window=window, logit_cap=logit_cap,
+        alibi_slopes=(tuple(slopes) if slopes is not None else None),
+        sinks=(jnp.asarray(sinks) if sinks is not None else None))
+    T = case["T"]
+    return np.asarray(out)[:T], np.asarray(want)[:T]
+
+
+MIXED_SEQS = [(1, 13), (12, 12), (1, 30), (5, 21)]
+
+
+def test_sliding_window_mixed_wave():
+    rng = np.random.default_rng(10)
+    case = build_case(rng, seqs=MIXED_SEQS, page_size=8,
+                      pages_per_req=4, num_q_heads=8, num_kv_heads=4,
+                      head_dim=128)
+    got, want = run_both_feat(case, window=9)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    # The window genuinely restricts attention (long kv sequences
+    # diverge from full-causal).
+    got_full, _ = run_both_feat(case)
+    assert np.max(np.abs(got - got_full)) > 1e-3
+
+
+def test_softcap_mixed_wave():
+    rng = np.random.default_rng(11)
+    case = build_case(rng, seqs=MIXED_SEQS, page_size=8,
+                      pages_per_req=4, num_q_heads=8, num_kv_heads=4,
+                      head_dim=128)
+    got, want = run_both_feat(case, logit_cap=5.0)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_alibi_mixed_wave():
+    from vllm_distributed_tpu.models.common import alibi_slopes
+    rng = np.random.default_rng(12)
+    case = build_case(rng, seqs=MIXED_SEQS, page_size=8,
+                      pages_per_req=4, num_q_heads=8, num_kv_heads=4,
+                      head_dim=128)
+    got, want = run_both_feat(case, slopes=np.asarray(alibi_slopes(8)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_sinks_mixed_wave():
+    rng = np.random.default_rng(13)
+    case = build_case(rng, seqs=MIXED_SEQS, page_size=8,
+                      pages_per_req=4, num_q_heads=8, num_kv_heads=4,
+                      head_dim=128)
+    got, want = run_both_feat(
+        case, sinks=rng.standard_normal(8).astype(np.float32))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_all_features_together_mixed_wave():
+    from vllm_distributed_tpu.models.common import alibi_slopes
+    rng = np.random.default_rng(14)
+    seqs = [(1, 13), (12, 12), (1, 30), (5, 21), (1, 9), (8, 17)]
+    case = build_case(rng, seqs=seqs, page_size=8, pages_per_req=4,
+                      num_q_heads=8, num_kv_heads=4, head_dim=128)
+    got, want = run_both_feat(
+        case, window=11, logit_cap=4.0,
+        slopes=np.asarray(alibi_slopes(8)),
+        sinks=rng.standard_normal(8).astype(np.float32))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
 # Descriptor builder
 # ---------------------------------------------------------------------------
 

@@ -596,6 +596,17 @@ class DPEngineClient(EngineCoreClient):
                 for k, v in m.items():
                     merged_calls[k] = merged_calls.get(k, 0) + int(v)
             agg["attn_kernel_calls"] = merged_calls
+        # Fused-block fallback reasons: {reason: steps}, summed like the
+        # kernel dispatch map (block_fusion_calls itself is a flat
+        # numeric and already summed above).
+        fb_maps = [s["block_fusion_fallbacks"] for s in per
+                   if isinstance(s.get("block_fusion_fallbacks"), dict)]
+        if fb_maps:
+            merged_fb: dict = {}
+            for m in fb_maps:
+                for k, v in m.items():
+                    merged_fb[k] = merged_fb.get(k, 0) + int(v)
+            agg["block_fusion_fallbacks"] = merged_fb
         # Step-phase family: {phase -> histogram dict}, merged per phase.
         phase_maps = [s["step_phase_seconds"] for s in per
                       if isinstance(s.get("step_phase_seconds"), dict)]

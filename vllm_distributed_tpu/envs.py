@@ -78,6 +78,16 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # "0" keeps the separate write-then-attend pair for debugging.
     "VDT_FUSED_KV_WRITE":
     lambda: os.getenv("VDT_FUSED_KV_WRITE", "1") == "1",
+    # Fused transformer-block decode (ops/pallas_block.py): decode-only
+    # waves on an eligible dense model run each layer as ONE Pallas call
+    # (RMSNorm -> fused QKV -> rope + KV-page write + attention ->
+    # O-proj -> residual -> RMSNorm -> gated MLP -> residual), keeping
+    # activations in VMEM across the layer. Default OFF until the parity
+    # gates pin it; "0" reverts wholesale to the per-op mega-kernel
+    # path. Eligibility is decided ONCE in models/loader.py (arch shape,
+    # TP=1); read at model load.
+    "VDT_BLOCK_FUSION":
+    lambda: os.getenv("VDT_BLOCK_FUSION", "0") == "1",
     # Fraction of HBM usable for weights+KV (analogue of gpu_memory_utilization
     # default source).
     "VDT_MEMORY_FRACTION":
@@ -267,7 +277,9 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # is on ("" = all paths). Tokens: "tknp" (token-axis attention
     # psum), "ep" (MoE expert-parallel all-to-all + combine psum + the
     # re-replicate all-gather), "tp" (dense-model row-parallel output
-    # reduce), "tpla" (TPLA latent-attention output combine), "kv"
+    # reduce), "tpla" (TPLA latent-attention output combine), "tknp_kv"
+    # (the TKNP KV-write shuffle: the step's new K/V rows crossing the
+    # token-axis shard_map boundary to the page-owning rank), "kv"
     # (every KV-transfer connector payload) or an individual connector
     # name ("dcn_pull"/"p2p"/"shared_storage").
     "VDT_QCOMM_PATHS":

@@ -58,6 +58,21 @@ def _pad_last_dim(x: jax.Array, target: int) -> jax.Array:
     return jnp.pad(x, pad)
 
 
+def build_head_feat(num_q_heads: int, alibi_slopes, sinks) -> jax.Array:
+    """The mega-kernel's per-head feature sidecar: [2, QH] f32 with
+    ALiBi slopes in row 0 and attention-sink logits in row 1 (zeros for
+    disabled features — the has_alibi/has_sinks statics gate the math,
+    so the zero rows are never read). An ARRAY rather than statics so
+    learned sinks stay traced and TP shard_maps slice per-rank head
+    ranges naturally."""
+    zeros = jnp.zeros((num_q_heads, ), jnp.float32)
+    return jnp.stack([
+        (jnp.asarray(alibi_slopes, jnp.float32)
+         if alibi_slopes is not None else zeros),
+        (sinks.astype(jnp.float32) if sinks is not None else zeros),
+    ])
+
+
 def write_kv_pages(
     k_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
     v_pages: jax.Array,  # [num_pages, num_kv_heads, page_size, head_dim]
@@ -411,33 +426,56 @@ def _tknp_cache_specs():
     return cache, heads, MESH_AXIS_TOKEN
 
 
+def _tknp_apply_new_kv(k_all_, v_all_, k_new_, v_new_, kv_runs_,
+                       n_runs_, slot_, layer, use_pallas):
+    """Apply one rank's KV-write runs/slots to its cache shard — the
+    per-rank body shared by the raw and quantized (tknp_kv) shuffle
+    paths, so the halo-pad layout and the pallas-vs-scatter branch
+    can never diverge between them."""
+    PS, D = k_all_.shape[3], k_all_.shape[4]
+    if use_pallas:
+        from vllm_distributed_tpu.ops.pallas_kv_write import (
+            write_kv_pages_pallas)
+        pad = [(0, 0), (PS, 2 * PS), (0, 0)]
+        k_hl = jnp.pad(_pad_last_dim(k_new_, D).swapaxes(0, 1), pad)
+        v_hl = jnp.pad(_pad_last_dim(v_new_, D).swapaxes(0, 1), pad)
+        return write_kv_pages_pallas(
+            k_all_, v_all_, k_hl.astype(k_all_.dtype),
+            v_hl.astype(v_all_.dtype), kv_runs_, n_runs_, layer)
+    return _scatter_kv_flat(k_all_, v_all_, k_new_, v_new_, slot_,
+                            layer, PS)
+
+
 def _write_kv_cache_tknp(k_all, v_all, k_new, v_new, batch, layer):
     """Token-parallel KV write: the cache page axis is sharded over the
     ``token`` mesh axis; each rank applies only its own KV-write runs /
     slots (local page ids, prepared by the runner — TPU analogue of the
-    fork's per-rank KV write path)."""
+    fork's per-rank KV write path).
+
+    The KV-write SHUFFLE — the step's new K/V rows crossing the
+    shard_map boundary to the page-owning rank — is the last raw
+    collective of ROADMAP item 5: under VDT_QCOMM_PATHS "tknp_kv" the
+    payload crosses as block-scaled int8 + fp32 scales (quantized
+    BEFORE the boundary, dequantized per-rank after), with the standard
+    no-win fallback counting."""
+    from vllm_distributed_tpu.parallel import collectives
     from vllm_distributed_tpu.parallel import mesh as mesh_state
     tk = batch.tknp
-    L, N, KVH, PS, D = k_all.shape
     use_pallas = resolve_attention_backend() == "pallas"
     cache_spec, new_spec, token_axis = _tknp_cache_specs()
     from jax.sharding import PartitionSpec as P
 
+    q_pack = collectives.kv_shuffle_quantize(
+        k_new, v_new, mesh_state.tknp_size())
+    if q_pack is not None:
+        return _write_kv_cache_tknp_quant(k_all, v_all, q_pack,
+                                          k_new.dtype, batch, layer,
+                                          use_pallas)
+
     def call(k_all_, v_all_, k_new_, v_new_, kv_runs_, n_runs_, slot_):
-        kv_runs_ = kv_runs_[0]
-        n_runs_ = n_runs_[0]
-        slot_ = slot_[0]
-        if use_pallas:
-            from vllm_distributed_tpu.ops.pallas_kv_write import (
-                write_kv_pages_pallas)
-            pad = [(0, 0), (PS, 2 * PS), (0, 0)]
-            k_hl = jnp.pad(_pad_last_dim(k_new_, D).swapaxes(0, 1), pad)
-            v_hl = jnp.pad(_pad_last_dim(v_new_, D).swapaxes(0, 1), pad)
-            return write_kv_pages_pallas(
-                k_all_, v_all_, k_hl.astype(k_all_.dtype),
-                v_hl.astype(v_all_.dtype), kv_runs_, n_runs_, layer)
-        return _scatter_kv_flat(k_all_, v_all_, k_new_, v_new_, slot_,
-                                layer, PS)
+        return _tknp_apply_new_kv(k_all_, v_all_, k_new_, v_new_,
+                                  kv_runs_[0], n_runs_[0], slot_[0],
+                                  layer, use_pallas)
 
     return shard_map(
         call, mesh=mesh_state.get_global_mesh(),
@@ -446,6 +484,44 @@ def _write_kv_cache_tknp(k_all, v_all, k_new, v_new, batch, layer):
                   P(token_axis, None)),
         out_specs=(cache_spec, cache_spec),
         check_vma=False)(k_all, v_all, k_new, v_new, tk.kv_runs,
+                         tk.num_kv_runs, tk.slot_mapping)
+
+
+def _write_kv_cache_tknp_quant(k_all, v_all, q_pack, new_dtype, batch,
+                               layer, use_pallas):
+    """Quantized TKNP KV-write shuffle: the int8 payload + fp32 scales
+    cross the token-axis shard_map boundary instead of the model-dtype
+    K/V rows; each rank dequantizes and applies its own page runs.
+    Cache writes land the quantized round-trip of the new rows — the
+    same bounded per-block divergence the other VDT_QCOMM paths carry
+    (tests/ops/test_quantized_comms.py pins the bound)."""
+    from vllm_distributed_tpu.config import MESH_AXIS_MODEL
+    from vllm_distributed_tpu.parallel import collectives
+    from vllm_distributed_tpu.parallel import mesh as mesh_state
+    tk = batch.tknp
+    cache_spec, _new_spec, token_axis = _tknp_cache_specs()
+    from jax.sharding import PartitionSpec as P
+    k_q, k_s, v_q, v_s = q_pack
+    # Payload [T, KVH, D/b, b] + scales [T, KVH, D/b, 1]: kv heads stay
+    # sharded over the model axis, replication over the token axis is
+    # the (now int8) shuffle leg.
+    pay_spec = P(None, MESH_AXIS_MODEL, None, None)
+
+    def call(k_all_, v_all_, k_q_, k_s_, v_q_, v_s_, kv_runs_, n_runs_,
+             slot_):
+        k_new_, v_new_ = collectives.kv_shuffle_dequantize(
+            k_q_, k_s_, v_q_, v_s_, new_dtype)
+        return _tknp_apply_new_kv(k_all_, v_all_, k_new_, v_new_,
+                                  kv_runs_[0], n_runs_[0], slot_[0],
+                                  layer, use_pallas)
+
+    return shard_map(
+        call, mesh=mesh_state.get_global_mesh(),
+        in_specs=(cache_spec, cache_spec, pay_spec, pay_spec, pay_spec,
+                  pay_spec, P(token_axis, None, None),
+                  P(token_axis, None), P(token_axis, None)),
+        out_specs=(cache_spec, cache_spec),
+        check_vma=False)(k_all, v_all, k_q, k_s, v_q, v_s, tk.kv_runs,
                          tk.num_kv_runs, tk.slot_mapping)
 
 
@@ -658,14 +734,25 @@ def paged_attention(
                 "the backstop)")
         return _paged_attention_tknp(q, k_pages, v_pages, batch,
                                      sm_scale=sm_scale, layer=layer)
-    if (window == 0 and logit_cap == 0 and alibi_slopes is None
-            and sinks is None
-            and k_pages.dtype not in _FP8_DTYPES
+    # Sliding window / softcap / ALiBi / sinks fold into the unified
+    # mega-kernel (per-layer statics + the [2, QH] head-feature sidecar)
+    # — Gemma/Mistral/Bloom/gpt-oss-class models reach the Pallas path
+    # whenever the batch carries a partition descriptor. Feature waves
+    # WITHOUT a descriptor (in-jit multi-step/EAGLE batches) and fp8 KV
+    # keep the XLA reference below.
+    features = bool(window or logit_cap or alibi_slopes is not None
+                    or sinks is not None)
+    if (k_pages.dtype not in _FP8_DTYPES
             and resolve_attention_backend() == "pallas"
-            and batch.seq_info is not None):
+            and batch.seq_info is not None
+            and (not features
+                 or (getattr(batch, "attn_desc", None) is not None
+                     and getattr(batch, "cascade_shared_ids", None)
+                     is None))):
         head_dim = q.shape[-1]
+        feat = build_head_feat(q.shape[1], alibi_slopes, sinks)
 
-        def call(q_, k_, v_):
+        def call(q_, k_, v_, feat_):
             # Cache storage may be lane-padded (storage_head_dim); pad q to
             # match and slice the padding back off the output.
             q_p = _pad_last_dim(q_, k_.shape[-1])
@@ -683,9 +770,12 @@ def paged_attention(
                     unified_ragged_paged_attention_pallas)
                 out = unified_ragged_paged_attention_pallas(
                     q_p, k_, v_, batch.attn_desc, batch.seq_info,
-                    batch.decode_list, batch.block_tables, layer,
+                    batch.decode_list, batch.block_tables, layer, feat_,
                     sm_scale=sm_scale, bq=batch.attn_bq,
-                    sb=batch.attn_sb)[..., :head_dim]
+                    sb=batch.attn_sb, window=window,
+                    logit_cap=logit_cap,
+                    has_alibi=alibi_slopes is not None,
+                    has_sinks=sinks is not None)[..., :head_dim]
             else:
                 from vllm_distributed_tpu.ops.pallas_attention import (
                     ragged_paged_attention_pallas)
@@ -709,9 +799,11 @@ def paged_attention(
             kv_spec = P(None, None, MESH_AXIS_MODEL, None, None)
             return shard_map(
                 call, mesh=mesh_state.get_global_mesh(),
-                in_specs=(head_spec, kv_spec, kv_spec),
-                out_specs=head_spec, check_vma=False)(q, k_pages, v_pages)
-        return call(q, k_pages, v_pages)
+                in_specs=(head_spec, kv_spec, kv_spec,
+                          P(None, MESH_AXIS_MODEL)),
+                out_specs=head_spec, check_vma=False)(q, k_pages,
+                                                      v_pages, feat)
+        return call(q, k_pages, v_pages, feat)
     if k_pages.ndim == 5:
         k_layer = k_pages[layer[0]]
         v_layer = v_pages[layer[0]]
@@ -753,13 +845,13 @@ def write_kv_and_attend(
     them — a mixed step makes one pass over the KV cache instead of two.
     Returns (k_pages, v_pages, attn_out).
 
-    Falls back to write_kv_cache + paged_attention whenever any feature
-    the fused kernel does not carry is active (sliding window / softcap
-    / ALiBi / sinks / fp8 KV / token parallelism / cascade), when the
-    batch has no partition descriptor (in-jit batches from the
-    multi-step scan or EAGLE), or when VDT_FUSED_KV_WRITE=0."""
-    fused = (envs.VDT_FUSED_KV_WRITE and window == 0 and logit_cap == 0
-             and alibi_slopes is None and sinks is None
+    Falls back to write_kv_cache + paged_attention whenever the layout
+    rules the fused kernel out (fp8 KV / token parallelism / cascade),
+    when the batch has no partition descriptor (in-jit batches from the
+    multi-step scan or EAGLE), or when VDT_FUSED_KV_WRITE=0. Sliding
+    window / softcap / ALiBi / sinks ride the kernel's per-layer
+    statics + head-feature sidecar and no longer force the XLA path."""
+    fused = (envs.VDT_FUSED_KV_WRITE
              and k_pages.dtype not in _FP8_DTYPES
              and getattr(batch, "tknp", None) is None
              and getattr(batch, "cascade_shared_ids", None) is None
@@ -779,8 +871,9 @@ def write_kv_and_attend(
         unified_write_attend_pallas)
     L, N, KVH, PS, D = k_pages.shape
     head_dim = q.shape[-1]
+    feat = build_head_feat(q.shape[1], alibi_slopes, sinks)
 
-    def call(q_, k_, v_, kn_, vn_):
+    def call(q_, k_, v_, kn_, vn_, feat_):
         pad = [(0, 0), (PS, 2 * PS), (0, 0)]
         k_hl = jnp.pad(_pad_last_dim(kn_, D).swapaxes(0, 1),
                        pad).astype(k_.dtype)
@@ -790,7 +883,10 @@ def write_kv_and_attend(
         out, k2, v2 = unified_write_attend_pallas(
             q_p, k_, v_, k_hl, v_hl, batch.attn_desc, batch.seq_info,
             batch.decode_list, batch.kv_runs, batch.block_tables, layer,
-            sm_scale=sm_scale, bq=batch.attn_bq, sb=batch.attn_sb)
+            feat_, sm_scale=sm_scale, bq=batch.attn_bq,
+            sb=batch.attn_sb, window=window, logit_cap=logit_cap,
+            has_alibi=alibi_slopes is not None,
+            has_sinks=sinks is not None)
         out = out[..., :head_dim]
         # Rows no program wrote (padding tokens) are uninitialized HBM;
         # zero them so garbage can't reach later layers' projections.
@@ -806,7 +902,7 @@ def write_kv_and_attend(
         return shard_map(
             call, mesh=mesh_state.get_global_mesh(),
             in_specs=(head_spec, cache_spec, cache_spec, head_spec,
-                      head_spec),
+                      head_spec, P(None, MESH_AXIS_MODEL)),
             out_specs=(cache_spec, cache_spec, head_spec),
-            check_vma=False)(q, k_pages, v_pages, k_new, v_new)
-    return call(q, k_pages, v_pages, k_new, v_new)
+            check_vma=False)(q, k_pages, v_pages, k_new, v_new, feat)
+    return call(q, k_pages, v_pages, k_new, v_new, feat)

@@ -758,26 +758,41 @@ def _mega_kernel(
     group: int,
     emit_state: bool,
     fuse_write: bool,
+    window: int,
+    logit_cap: float,
+    has_alibi: bool,
+    has_sinks: bool,
 ):
     """One program list, three program types (see the partition
     descriptor contract in the module docstring). Prefill tiles run the
     general flash loop at a FIXED bq; decode groups keep the SB
     virtual-head batching even when prefill tiles share the wave; kv
     writes (fused variant) land first so attention reads this step's
-    pages."""
+    pages.
+
+    Per-model attention features ride along so windowed / soft-capped /
+    ALiBi / sink models reach this kernel instead of the XLA fallback:
+    ``window`` (sliding-window bound) and ``logit_cap`` (tanh
+    soft-capping) are per-layer STATICS like the model's scan-segment
+    plan; ALiBi slopes and sink logits arrive in the tiny ``feat``
+    input ([2, QH] f32: row 0 slopes, row 1 sinks) so learned sinks and
+    TP-sharded head slices stay dynamic. Masking is feature-complete
+    but the page loop still walks the full block table — window layers
+    discard out-of-window blocks by mask, not by loop bounds (loop
+    trimming is a profiled follow-up)."""
     if fuse_write:
-        (q_hbm, k_new, v_new, _k_in, _v_in,
+        (q_hbm, k_new, v_new, _k_in, _v_in, feat_ref,
          out_hbm, k_cache, v_cache,
          q_vmem, k_vmem, v_vmem, out_stage,
          k_page, v_page, k_win, v_win,
          q_sems, kv_sems, out_sems, w_sems) = refs
         state_hbm = state_stage = state_sems = None
     elif emit_state:
-        (q_hbm, k_cache, v_cache, out_hbm, state_hbm,
+        (q_hbm, k_cache, v_cache, feat_ref, out_hbm, state_hbm,
          q_vmem, k_vmem, v_vmem, out_stage, state_stage,
          q_sems, kv_sems, out_sems, state_sems) = refs
     else:
-        (q_hbm, k_cache, v_cache, out_hbm,
+        (q_hbm, k_cache, v_cache, feat_ref, out_hbm,
          q_vmem, k_vmem, v_vmem, out_stage,
          q_sems, kv_sems, out_sems) = refs
         state_hbm = state_stage = state_sems = None
@@ -868,6 +883,15 @@ def _mega_kernel(
         col_base = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
         row_valid = (jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 0) //
                      group + tile_start) < q_len
+        feat_val = (feat_ref[...].astype(jnp.float32)
+                    if (has_alibi or has_sinks) else None)
+        # Per-row head feature vectors: tile rows are q-row-major then
+        # group (row r belongs to q head h*group + r % group).
+        if has_alibi:
+            slopes_rows = [
+                jnp.tile(feat_val[0, h * group:(h + 1) * group],
+                         (bq, ))[:, None] for h in range(KVH)
+            ]
 
         def body(bi, carry):
             ms, ls, accs = carry
@@ -891,12 +915,19 @@ def _mega_kernel(
             v_blk = v_vmem[slot, 0]
             kv_pos = kv_start + col_base
             mask = jnp.logical_and(kv_pos <= row_pos, row_valid)
+            if window > 0:
+                mask = jnp.logical_and(mask, kv_pos > row_pos - window)
             new_ms, new_ls, new_accs = [], [], []
             for h in range(KVH):
                 s = jax.lax.dot_general(
                     q_heads[h], k_blk[h].astype(jnp.float32),
                     dimension_numbers=(((1, ), (1, )), ((), ())),
                     preferred_element_type=jnp.float32)
+                if logit_cap > 0:
+                    s = logit_cap * jnp.tanh(s / logit_cap)
+                if has_alibi:
+                    s = s + slopes_rows[h] * (
+                        kv_pos - row_pos).astype(jnp.float32)
                 s = jnp.where(mask, s, _MASK_VALUE)
                 m_prev, l_prev, acc_prev = ms[h], ls[h], accs[h]
                 m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -920,6 +951,15 @@ def _mega_kernel(
         )
         ms, ls, accs = jax.lax.fori_loop(0, num_blocks, body, init)
 
+        if has_sinks:
+            # Learned per-head virtual key joining only the softmax
+            # denominator (softmax shift-invariance makes the running
+            # max of the REAL scores a valid reference point).
+            ls = tuple(
+                ls[h] + jnp.exp(
+                    jnp.tile(feat_val[1, h * group:(h + 1) * group],
+                             (bq, ))[:, None] - ms[h])
+                for h in range(KVH))
         for h in range(KVH):
             o_h = accs[h] / jnp.maximum(ls[h], 1e-20)
             out_stage[0:bq, h * group:(h + 1) * group, :] = (
@@ -1030,6 +1070,11 @@ def _mega_kernel(
         col_off = jax.lax.broadcasted_iota(jnp.int32, (ROWS, C), 1) % blk
         kvlen_rows = jnp.concatenate(
             [jnp.full((QH, ), kv_lens[i], jnp.int32) for i in range(sb)])
+        feat_val = (feat_ref[...].astype(jnp.float32)
+                    if (has_alibi or has_sinks) else None)
+        if has_alibi:
+            # Decode rows are seq-major then q-head-major: row i*QH + qh.
+            slope_rows = jnp.tile(feat_val[0], (sb, ))[:, None]
 
         def body(bi, carry):
             m_prev, l_prev, acc_prev = carry
@@ -1057,8 +1102,19 @@ def _mega_kernel(
                 q_all, k_all.astype(jnp.float32),
                 dimension_numbers=(((1, ), (1, )), ((), ())),
                 preferred_element_type=jnp.float32)
+            if logit_cap > 0:
+                s = logit_cap * jnp.tanh(s / logit_cap)
+            if has_alibi:
+                # Decode q position is kv_len - 1 per sequence.
+                s = s + slope_rows * (
+                    bi * blk + col_off -
+                    (kvlen_rows[:, None] - 1)).astype(jnp.float32)
             mask = jnp.logical_and(
                 diag, bi * blk + col_off < kvlen_rows[:, None])
+            if window > 0:
+                mask = jnp.logical_and(
+                    mask,
+                    bi * blk + col_off > kvlen_rows[:, None] - 1 - window)
             s = jnp.where(mask, s, _MASK_VALUE)
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
             pr = jnp.exp(s - m_new)
@@ -1077,6 +1133,9 @@ def _mega_kernel(
             jnp.zeros((ROWS, D), jnp.float32),
         )
         m_fin, l_fin, acc = jax.lax.fori_loop(0, num_blocks, body, init)
+        if has_sinks:
+            l_fin = l_fin + jnp.exp(
+                jnp.tile(feat_val[1], (sb, ))[:, None] - m_fin)
         out = acc / jnp.maximum(l_fin, 1e-20)
         out_stage[0:sb, :, :] = out.reshape(sb, QH, D).astype(
             out_stage.dtype)
@@ -1114,7 +1173,9 @@ def _mega_kernel(
 
 def _mega_call(q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
                block_tables, layer, k_new_hl, v_new_hl, *, sm_scale, bq,
-               sb, interpret, emit_state, fuse_write):
+               sb, interpret, emit_state, fuse_write, feat=None,
+               window=0, logit_cap=0.0, has_alibi=False,
+               has_sinks=False):
     """Shared launcher for the attention-only and fused write+attend
     variants of the mega-kernel."""
     T_pad, num_q_heads, head_dim = q.shape
@@ -1128,11 +1189,14 @@ def _mega_call(q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
         ppb -= 1
     blk = ppb * page_size
     stage_rows = max(bq, sb)
+    if feat is None:
+        feat = jnp.zeros((2, num_q_heads), jnp.float32)
 
     kernel = functools.partial(
         _mega_kernel, sm_scale=sm_scale, bq=bq, sb=sb, ppb=ppb,
         page_size=page_size, group=group, emit_state=emit_state,
-        fuse_write=fuse_write)
+        fuse_write=fuse_write, window=window, logit_cap=logit_cap,
+        has_alibi=has_alibi, has_sinks=has_sinks)
 
     in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]  # q
     operands = [q]
@@ -1141,6 +1205,10 @@ def _mega_call(q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
         operands += [k_new_hl, v_new_hl]
     in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
     operands += [k_pages, v_pages]
+    # Head-feature sidecar (ALiBi slopes / sink logits): whole-array
+    # VMEM block, read as a value by the attention bodies.
+    in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)]
+    operands += [feat]
 
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]
@@ -1208,7 +1276,8 @@ def _mega_call(q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "bq", "sb", "interpret", "emit_state"))
+    static_argnames=("sm_scale", "bq", "sb", "interpret", "emit_state",
+                     "window", "logit_cap", "has_alibi", "has_sinks"))
 def unified_ragged_paged_attention_pallas(
     q: jax.Array,  # [T_pad, QH, D]; T_pad >= T + Q_TILE_PAD
     k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] stacked cache
@@ -1218,12 +1287,17 @@ def unified_ragged_paged_attention_pallas(
     decode_list: jax.Array,  # [R] int32
     block_tables: jax.Array,  # [max_reqs, pages_per_req] int32
     layer: jax.Array | None = None,  # [1] int32
+    feat: jax.Array | None = None,  # [2, QH] f32 (slopes, sinks)
     *,
     sm_scale: float,
     bq: int,
     sb: int,
     interpret: bool | None = None,
     emit_state: bool = False,
+    window: int = 0,
+    logit_cap: float = 0.0,
+    has_alibi: bool = False,
+    has_sinks: bool = False,
 ):
     """Mixed-batch attention in ONE kernel call, partitioned by ``desc``
     (see the module docstring for the descriptor contract). No static
@@ -1247,14 +1321,18 @@ def unified_ragged_paged_attention_pallas(
         q, k_pages, v_pages, desc, seq_info, decode_list,
         jnp.zeros((1, 4), jnp.int32), block_tables, layer, None, None,
         sm_scale=sm_scale, bq=bq, sb=sb, interpret=interpret,
-        emit_state=emit_state, fuse_write=False)
+        emit_state=emit_state, fuse_write=False, feat=feat,
+        window=window, logit_cap=logit_cap, has_alibi=has_alibi,
+        has_sinks=has_sinks)
     if emit_state:
         return result  # (out, state)
     return result[0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "bq", "sb", "interpret"))
+    jax.jit, static_argnames=("sm_scale", "bq", "sb", "interpret",
+                              "window", "logit_cap", "has_alibi",
+                              "has_sinks"))
 def unified_write_attend_pallas(
     q: jax.Array,  # [T_pad, QH, D]
     k_pages: jax.Array,  # [L, num_pages, KVH, PS, D] (aliased in place)
@@ -1267,11 +1345,16 @@ def unified_write_attend_pallas(
     kv_runs: jax.Array,  # [G, 4] int32 (page, off, window_start, len)
     block_tables: jax.Array,
     layer: jax.Array,  # [1] int32
+    feat: jax.Array | None = None,  # [2, QH] f32 (slopes, sinks)
     *,
     sm_scale: float,
     bq: int,
     sb: int,
     interpret: bool | None = None,
+    window: int = 0,
+    logit_cap: float = 0.0,
+    has_alibi: bool = False,
+    has_sinks: bool = False,
 ):
     """Fused KV-page write + mixed-batch attention: ONE pass over the
     cache per layer. The descriptor's kind-3 programs land the step's
@@ -1286,5 +1369,6 @@ def unified_write_attend_pallas(
         q, k_pages, v_pages, desc, seq_info, decode_list, kv_runs,
         block_tables, layer, k_new_hl, v_new_hl, sm_scale=sm_scale,
         bq=bq, sb=sb, interpret=interpret, emit_state=False,
-        fuse_write=True)
+        fuse_write=True, feat=feat, window=window, logit_cap=logit_cap,
+        has_alibi=has_alibi, has_sinks=has_sinks)
     return out, k2, v2

@@ -311,6 +311,48 @@ def all_to_all(x, axis_name, split_axis: int = 0, concat_axis: int = 0,
 
 
 # ---------------------------------------------------------------------------
+# TKNP KV-write shuffle payload (path "tknp_kv")
+# ---------------------------------------------------------------------------
+
+def kv_shuffle_quantize(k_new, v_new, axis_size: int):
+    """Quantize the step's new K/V rows for the TKNP KV-write shuffle —
+    the [T, KVH, D] payloads crossing the token-axis shard_map boundary
+    to the page-owning ranks (ops/attention._write_kv_cache_tknp). The
+    last raw collective of ROADMAP item 5: the boundary reshard ships
+    int8 + per-block fp32 scales instead of model-dtype words.
+
+    Blocks divide D exactly (divisor block), so no scale ever crosses a
+    head boundary. Returns ``(k_q, k_s, v_q, v_s)`` or ``None`` when
+    the path is off or quantization would not win (non-float payload,
+    sub-byte dtype, scales outweighing the shrink) — counted as the
+    standard fallback."""
+    import jax.numpy as jnp
+    if not enabled("tknp_kv"):
+        return None
+    feat = k_new.shape[-1]
+    n = math.prod(k_new.shape)
+    block = divisor_block(feat)
+    # Broadcast-to-owners model: each of the other K-1 token ranks
+    # receives the payload it did not produce.
+    raw = 2 * (axis_size - 1) * n * k_new.dtype.itemsize
+    quant = 2 * (axis_size - 1) * (n + (n // block) * _SCALE_BYTES)
+    if (axis_size <= 1 or quant >= raw
+            or not jnp.issubdtype(k_new.dtype, jnp.floating)):
+        note_fallback("tknp_kv")
+        return None
+    k_q, k_s = _block_quantize(k_new.astype(jnp.float32), block)
+    v_q, v_s = _block_quantize(v_new.astype(jnp.float32), block)
+    _note_saved("tknp_kv", raw - quant)
+    return k_q, k_s, v_q, v_s
+
+
+def kv_shuffle_dequantize(k_q, k_s, v_q, v_s, dtype):
+    """Inverse of kv_shuffle_quantize on the receiving rank."""
+    return (_block_dequantize(k_q, k_s).astype(dtype),
+            _block_dequantize(v_q, v_s).astype(dtype))
+
+
+# ---------------------------------------------------------------------------
 # Dense-TP explicit reduce hook
 # ---------------------------------------------------------------------------
 

@@ -256,11 +256,19 @@ class TPUModelRunner:
         self._tile_params_memo: Optional[tuple[int, int]] = None
         self._xla_route_memo: Optional[bool] = None
         # Kernel-dispatch observability: one count per step per kernel
-        # family (unified|decode|general|cascade|naive) behind
-        # vdt:attn_kernel_calls_total, plus the warmed-graph count
-        # behind vdt:precompile_graphs_total.
+        # family (fused_block|unified|decode|general|cascade|naive)
+        # behind vdt:attn_kernel_calls_total, plus the warmed-graph
+        # count behind vdt:precompile_graphs_total.
         self.attn_kernel_calls: dict[str, int] = {}
         self.precompile_graphs = 0
+        # Fused decode-block dispatch (ops/pallas_block.py): steps that
+        # ran the fused path vs steps that fell back (by reason) while
+        # fusion was enabled+eligible — vdt:block_fusion_calls_total /
+        # vdt:block_fusion_fallbacks_total{reason}. Eligibility is the
+        # loader's once-per-load decision; None until the model exists.
+        self._block_fusion_memo: Optional[bool] = None
+        self.block_fusion_calls = 0
+        self.block_fusion_fallbacks: dict[str, int] = {}
         # SSM state-snapshot pool (core/state_cache.py): per-state-array
         # device buffers of `resolve_state_slots` slots, written/read by
         # the scheduler's state_saves/state_restores directives. Built
@@ -795,6 +803,39 @@ class TPUModelRunner:
             self._unified = "k" in self.model.kv_cache_specs()
         return self._unified
 
+    def _block_fusion_active(self) -> bool:
+        """Can this engine dispatch the fused decode-block path at all?
+        The loader decided arch eligibility once (cfg.block_fusion,
+        VDT_BLOCK_FUSION-gated); the runner adds the dispatch-side
+        requirements: the unified (descriptor) batch layout, no token
+        parallelism, and the Pallas backend (the XLA-composed reference
+        exists for tests, not serving — on the XLA backend the per-op
+        path IS the reference)."""
+        if self._block_fusion_memo is None:
+            if self.model is None:
+                return False  # don't memoize before the model exists
+            self._block_fusion_memo = bool(
+                getattr(self.model.cfg, "block_fusion", False)
+                and self._use_unified()
+                and self.tknp_size == 1
+                and resolve_attention_backend() == "pallas")
+        return self._block_fusion_memo
+
+    def _count_block_fusion(self, batch=None, reason: str = None) -> None:
+        """Per-step fused-dispatch accounting, only while fusion is
+        enabled+eligible so the families stay silent otherwise."""
+        if not self._block_fusion_active():
+            return
+        if reason is None and batch is not None:
+            if getattr(batch, "block_fused", False):
+                self.block_fusion_calls += 1
+                return
+            reason = ("cascade"
+                      if getattr(batch, "cascade_shared_ids", None)
+                      is not None else "mixed_wave")
+        self.block_fusion_fallbacks[reason] = (
+            self.block_fusion_fallbacks.get(reason, 0) + 1)
+
     def _tile_params(self) -> tuple[int, int]:
         """The fixed (prefill tile rows, decode group width) of the
         mega-kernel, computed from LOCAL head counts (the kernel runs
@@ -1286,6 +1327,13 @@ class TPUModelRunner:
             max_q=max_q,
             attn_bq=bq,
             attn_sb=sb,
+            # Fused decode-block dispatch: the vectorized-prep fast path
+            # already proves this wave is pure single-token decode with
+            # none of the per-token features (spec drafts / M-RoPE /
+            # LoRA / tknp / plp / mm) the fused kernel would miss.
+            block_fused=bool(self._block_fusion_active()
+                             and fast is not None
+                             and cascade_ids is None),
         )
         plp = None
         if plp_rows:
@@ -1462,6 +1510,7 @@ class TPUModelRunner:
          plp, chain) = self._prepare_inputs(scheduler_output)
         self.prepare_inputs_hist.observe(time.perf_counter() - t_prep)
         self._count_attn_dispatch(self._attn_kernel_label(batch))
+        self._count_block_fusion(batch)
         drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
         if chain is not None:
             # Async run-ahead rows: substitute the previous dispatch's
@@ -1782,7 +1831,9 @@ class TPUModelRunner:
         pipeline-parallel runner overrides only the forward half."""
         with self.mesh:
             cascade = batch.cascade_shared_ids is not None
-            with self._compile_watch(("fwd", ) + fwd_shape + (cascade, )):
+            fused = bool(getattr(batch, "block_fused", False))
+            with self._compile_watch(("fwd", ) + fwd_shape +
+                                     (cascade, fused)):
                 self.kv_caches, hidden = self._forward_fn(
                     self.params, self.kv_caches, token_ids, batch)
             return self._launch_sample(hidden, logits_indices, sampling_md,
@@ -1952,11 +2003,15 @@ class TPUModelRunner:
         from vllm_distributed_tpu.ops.attention import \
             resolve_attention_backend
         # The burst's in-jit batches carry no partition descriptor, so
-        # they ride the legacy SB decode kernel on the Pallas backend.
+        # they ride the legacy SB decode kernel on the Pallas backend
+        # (and window/softcap/ALiBi/sink models the XLA path — those
+        # features reach Pallas only through the descriptor).
         self._count_attn_dispatch(
             "decode" if (resolve_attention_backend() == "pallas"
-                         and not self._model_routes_xla())
+                         and not self._model_routes_xla()
+                         and not self._model_has_attn_features())
             else "naive")
+        self._count_block_fusion(reason="multi_step")
         ib = self.input_batch
         n_steps = scheduler_output.multi_step
         req_ids = list(scheduler_output.num_scheduled_tokens)
@@ -2022,22 +2077,32 @@ class TPUModelRunner:
     # ------------------------------------------------------------------
     def _model_routes_xla(self) -> bool:
         """True when the model carries a feature the Pallas kernels do
-        not (sliding window / logit softcap / ALiBi / sinks / fp8 KV):
-        paged_attention then takes the XLA reference path regardless of
-        backend and descriptor, and the kernel-calls metric must say so
-        rather than report a mega-kernel that never ran."""
+        not: since sliding window / softcap / ALiBi / sinks folded into
+        the mega-kernel's per-layer statics + head-feature sidecar, the
+        only remaining model-level XLA forcer is an fp8 KV cache (the
+        kernels' fp8 dequant is a follow-up)."""
         if getattr(self, "_xla_route_memo", None) is None:
             cfg = self.model.cfg if self.model is not None else None
             if cfg is None:
                 return False  # don't memoize before the model exists
             self._xla_route_memo = bool(
-                getattr(cfg, "sliding_window", None)
-                or getattr(cfg, "attn_logit_softcap", 0)
-                or getattr(cfg, "alibi", False)
-                or getattr(cfg, "attn_sinks", False)
-                or "fp8" in str(
+                "fp8" in str(
                     self.config.cache_config.cache_dtype).lower())
         return self._xla_route_memo
+
+    def _model_has_attn_features(self) -> bool:
+        """Sliding window / softcap / ALiBi / sinks anywhere in the
+        model: these reach the Pallas path only through the mega-kernel
+        descriptor, so descriptor-less batches still fall back."""
+        cfg = self.model.cfg if self.model is not None else None
+        if cfg is None:
+            return False
+        return bool(
+            getattr(cfg, "sliding_window", None)
+            or getattr(cfg, "window_pattern", None)
+            or getattr(cfg, "attn_logit_softcap", 0)
+            or getattr(cfg, "alibi", False)
+            or getattr(cfg, "attn_sinks", False))
 
     def _attn_kernel_label(self, batch) -> str:
         """Which attention kernel family this step's batch dispatches to
@@ -2049,10 +2114,15 @@ class TPUModelRunner:
         if (resolve_attention_backend() != "pallas"
                 or self._model_routes_xla()):
             return "naive"
+        if getattr(batch, "block_fused", False):
+            return "fused_block"
         if getattr(batch, "cascade_shared_ids", None) is not None:
-            return "cascade"
+            return ("naive" if self._model_has_attn_features()
+                    else "cascade")
         if getattr(batch, "attn_desc", None) is not None:
             return "unified"
+        if self._model_has_attn_features():
+            return "naive"  # descriptor-less legacy path keeps XLA
         return "decode" if batch.max_q == 1 else "general"
 
     def _count_attn_dispatch(self, label: str) -> None:
@@ -2194,21 +2264,42 @@ class TPUModelRunner:
         start = time.perf_counter()
         n = 0
         with self.mesh:
+            # Pure-decode waves can present any token bucket up to the
+            # request ceiling; those buckets additionally warm the
+            # fused-block variant when fusion is on.
+            fusion_t_max = (pad_to_bucket(self.max_num_reqs,
+                                          self.token_buckets)
+                            if self._block_fusion_active() else -1)
             for T, max_q, G in sorted(self.forward_shapes()):
                 token_ids, batch = self._dummy_step_inputs(T, max_q, G)
-                with self._compile_watch(("fwd", T, max_q, G, False)):
+                with self._compile_watch(("fwd", T, max_q, G, False,
+                                          False)):
                     self.kv_caches, hidden = self._forward_fn(
                         self.params, self.kv_caches, token_ids, batch)
                 jax.block_until_ready(hidden)
                 n += 1
+                import dataclasses as _dc
+
                 from vllm_distributed_tpu import envs as _envs
+                from vllm_distributed_tpu.ops.pallas_attention import \
+                    Q_TILE_PAD
+                if (max_q == 1 and batch.attn_desc is not None
+                        and 0 <= T - Q_TILE_PAD <= fusion_t_max):
+                    fbatch = _dc.replace(batch, block_fused=True)
+                    with self._compile_watch(("fwd", T, max_q, G, False,
+                                              True)):
+                        self.kv_caches, hidden = self._forward_fn(
+                            self.params, self.kv_caches, token_ids,
+                            fbatch)
+                    jax.block_until_ready(hidden)
+                    n += 1
                 if _envs.VDT_CASCADE_ATTENTION:
-                    import dataclasses as _dc
                     S = _envs.VDT_CASCADE_SHARED_PAGES
                     cbatch = _dc.replace(
                         batch,
                         cascade_shared_ids=jnp.zeros((S, ), jnp.int32))
-                    with self._compile_watch(("fwd", T, max_q, G, True)):
+                    with self._compile_watch(("fwd", T, max_q, G, True,
+                                              False)):
                         self.kv_caches, hidden = self._forward_fn(
                             self.params, self.kv_caches, token_ids,
                             cbatch)
@@ -2375,6 +2466,15 @@ class TPUModelRunner:
             "attn_kernel_calls": dict(self.attn_kernel_calls),
             "precompile_graphs": self.precompile_graphs,
         }
+        if self.model is not None and getattr(self.model.cfg,
+                                              "block_fusion", False):
+            # Fused decode-block dispatch (vdt:block_fusion_calls_total
+            # / vdt:block_fusion_fallbacks_total{reason}): rendered only
+            # while the loader enabled fusion, so the families are a
+            # positive signal that the flag is live.
+            stats["block_fusion_calls"] = self.block_fusion_calls
+            stats["block_fusion_fallbacks"] = dict(
+                self.block_fusion_fallbacks)
         if self.model is not None and getattr(self.model.cfg, "mla",
                                               False):
             # MLA latent-pool geometry (vdt:tpla_latent_shards /
