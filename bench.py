@@ -25,15 +25,15 @@ import traceback
 # Keep the engine quiet so stdout stays a single JSON line.
 os.environ.setdefault("VDT_LOGGING_LEVEL", "WARNING")
 
-# The routing leg drives a 2-replica DP fleet; the CPU platform exposes
-# one device unless told otherwise, and the flag only takes effect
-# before the first jax import in this process. Irrelevant on TPU (it
-# shapes the HOST platform only).
+# The routing/disagg legs drive 2-3-replica DP fleets; the CPU platform
+# exposes one device unless told otherwise, and the flag only takes
+# effect before the first jax import in this process. Irrelevant on TPU
+# (it shapes the HOST platform only).
 if ("xla_force_host_platform_device_count"
         not in os.environ.get("XLA_FLAGS", "")):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2").strip()
+        + " --xla_force_host_platform_device_count=4").strip()
 
 import numpy as np  # noqa: E402
 
@@ -447,6 +447,229 @@ def _routing_leg(config, record) -> None:
             os.environ.pop("VDT_ROUTER", None)
         else:
             os.environ["VDT_ROUTER"] = saved
+
+
+def _disagg_leg(config, record) -> None:
+    """Disagg serving-tier leg (ROADMAP item 2 acceptance): a mixed
+    long-prompt/chat workload on the SAME total device budget (a
+    2-replica in-process DP fleet), disagg (1 prefill + 1 decode pool,
+    VDT_DISAGG=1) vs monolithic, on byte-identical traffic. Records
+    chat decode tok/s under long-prompt interference (the number disagg
+    exists to protect — monolithic mixed waves pad every co-resident
+    decode token to the prefill chunk's big bucket), TTFT p50/p95,
+    handoff count/latency, fallback counters, and greedy parity. Two
+    recovery drills ride along: disagg.handoff_stall -> local
+    re-prefill on the decode home, and a prefill-replica death ->
+    re-admission, each with its fallback counter recorded."""
+    import gc
+
+    import jax
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    if len(jax.devices()) < 2:
+        record["disagg_leg_error"] = (
+            "needs >= 2 devices for a 2-replica fleet")
+        return
+    rng = np.random.default_rng(11)
+    chat_n, long_n = 4, 4
+    chat_len, long_len = 16, 768
+    chat_tokens, long_tokens = 32, 2
+    budget = 256  # long prompts chunk through 3 waves each
+    chat_sp = SamplingParams(temperature=0.0, max_tokens=chat_tokens,
+                             ignore_eos=True)
+    long_sp = SamplingParams(temperature=0.0, max_tokens=long_tokens,
+                             ignore_eos=True)
+    chats = [[int(x) for x in rng.integers(10, 5000, size=chat_len)]
+             for _ in range(chat_n)]
+    longs = [[int(x) for x in rng.integers(10, 5000, size=long_len)]
+             for _ in range(long_n)]
+
+    def build_engine():
+        cfg = EngineConfig(
+            model_config=config.model_config,
+            cache_config=CacheConfig(block_size=16, num_gpu_blocks=512),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=budget, max_num_seqs=16,
+                max_model_len=2048, num_scheduler_steps=1),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+        cfg.parallel_config.data_parallel_size = 2
+        return LLMEngine(cfg, load_tokenizer=False)
+
+    def drive(engine, tag):
+        """One mixed wave: all requests at t0; returns (tokens by rid,
+        chat decode tok/s, ttft list)."""
+        t_add = {}
+        for i, p in enumerate(longs):
+            rid = f"{tag}-long-{i}"
+            engine.add_request(rid, list(p), long_sp)
+            t_add[rid] = time.perf_counter()
+        for i, p in enumerate(chats):
+            rid = f"{tag}-chat-{i}"
+            engine.add_request(rid, list(p), chat_sp)
+            t_add[rid] = time.perf_counter()
+        first_tok, done, toks = {}, {}, {}
+        chat_done_at = None
+        while engine.has_unfinished_requests():
+            outs = engine.step()
+            now = time.perf_counter()
+            for o in outs:
+                rid = o.request_id
+                if rid not in first_tok and o.outputs[0].token_ids:
+                    first_tok[rid] = now
+                if o.finished:
+                    done[rid] = now
+                    toks[rid] = list(o.outputs[0].token_ids)
+                    if ("chat" in rid and all(
+                            f"{tag}-chat-{i}" in done
+                            for i in range(chat_n))):
+                        chat_done_at = now
+        t0 = min(first_tok[f"{tag}-chat-{i}"] for i in range(chat_n))
+        chat_toks = sum(len(toks[f"{tag}-chat-{i}"]) - 1
+                        for i in range(chat_n))
+        tok_s = chat_toks / max(chat_done_at - t0, 1e-9)
+        ttfts = sorted(first_tok[r] - t_add[r] for r in first_tok)
+        return toks, tok_s, ttfts
+
+    saved = os.environ.get("VDT_DISAGG")
+    outputs = {}
+    try:
+        for leg, flag in (("mono", "0"), ("disagg", "1")):
+            os.environ["VDT_DISAGG"] = flag
+            engine = build_engine()
+            drive(engine, f"{leg}warm")  # compile every shape first
+            # Snapshot disagg stats AFTER the warm pass: its handoffs
+            # pay XLA compilation on their first decode steps (seconds,
+            # not ms) and would swamp the steady-state mean.
+            warm = (engine.get_stats().get("disagg") or {}
+                    if flag == "1" else {})
+            toks, tok_s, ttfts = drive(engine, leg)
+            outputs[leg] = {k.split("-", 1)[1]: v
+                            for k, v in toks.items()}
+            record[f"disagg_{leg}_chat_decode_tok_s"] = round(tok_s, 1)
+            record[f"disagg_{leg}_ttft_p50_ms"] = round(
+                ttfts[len(ttfts) // 2] * 1e3, 1)
+            p95 = min(len(ttfts) - 1, -(-len(ttfts) * 19 // 20) - 1)
+            record[f"disagg_{leg}_ttft_p95_ms"] = round(
+                ttfts[p95] * 1e3, 1)
+            if flag == "1":
+                d = engine.get_stats().get("disagg") or {}
+                wh = d.get("handoff_seconds") or {}
+                w0 = warm.get("handoff_seconds") or {}
+                record["disagg_handoffs"] = (
+                    int(d.get("handoffs", 0))
+                    - int(warm.get("handoffs", 0)))
+                count = wh.get("count", 0) - w0.get("count", 0)
+                if count > 0:
+                    record["disagg_handoff_mean_ms"] = round(
+                        (wh.get("sum", 0.0) - w0.get("sum", 0.0))
+                        / count * 1e3, 1)
+                record["disagg_fallbacks"] = d.get("fallbacks", {})
+            engine.shutdown()
+            del engine
+            gc.collect()
+        record["disagg_parity"] = outputs["mono"] == outputs["disagg"]
+        record["disagg_vs_mono_chat_tok_s"] = round(
+            record["disagg_disagg_chat_decode_tok_s"] /
+            max(record["disagg_mono_chat_decode_tok_s"], 1e-9), 3)
+
+        # --- drill 1: stalled handoff pull -> local re-prefill -------
+        from vllm_distributed_tpu.utils import fault_injection as fi
+        os.environ["VDT_DISAGG"] = "1"
+        drill_prompts = [[int(x) for x in rng.integers(10, 5000,
+                                                       size=48)]
+                         for _ in range(2)]
+        sp = SamplingParams(temperature=0.0, max_tokens=6,
+                            ignore_eos=True)
+
+        def drill_run(engine, tag):
+            for i, p in enumerate(drill_prompts):
+                engine.add_request(f"{tag}-{i}", list(p), sp)
+            out = {}
+            while engine.has_unfinished_requests():
+                for o in engine.step():
+                    if o.finished:
+                        out[o.request_id] = list(
+                            o.outputs[0].token_ids)
+                time.sleep(0.001)
+            return [out[f"{tag}-{i}"]
+                    for i in range(len(drill_prompts))]
+
+        os.environ["VDT_DISAGG"] = "0"
+        engine = build_engine()
+        drill_base = drill_run(engine, "dbase")
+        engine.shutdown()
+        os.environ["VDT_DISAGG"] = "1"
+        engine = build_engine()
+        engine.engine_core.clients[1].engine_core.scheduler \
+            .kv_pull_timeout_s = 2.0
+        fi.inject("disagg.handoff_stall")
+        try:
+            got = drill_run(engine, "dstall")
+        finally:
+            fi.clear("disagg.handoff_stall")
+        d = engine.get_stats().get("disagg") or {}
+        record["disagg_drill_stall_local_reprefill"] = int(
+            (d.get("fallbacks") or {}).get("local_reprefill", 0))
+        record["disagg_drill_stall_parity"] = got == drill_base
+        engine.shutdown()
+        gc.collect()
+
+        # --- drill 2: prefill death mid-handoff -> re-admission ------
+        if len(jax.devices()) < 3:
+            record["disagg_drill_death_readmissions"] = (
+                "needs >= 3 devices")
+            return
+        from vllm_distributed_tpu.engine.core_client import \
+            EngineDeadError
+
+        class _DeadProxy:
+            def __getattr__(self, name):
+                def _boom(*a, **k):
+                    raise EngineDeadError("killed by bench drill")
+                return _boom
+
+        os.environ["VDT_DISAGG_PREFILL_REPLICAS"] = "2"
+        cfg = EngineConfig(
+            model_config=config.model_config,
+            cache_config=CacheConfig(block_size=16, num_gpu_blocks=512),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=budget, max_num_seqs=16,
+                max_model_len=2048, num_scheduler_steps=1),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+        cfg.parallel_config.data_parallel_size = 3
+        engine = LLMEngine(cfg, load_tokenizer=False)
+        client = engine.engine_core
+        for i, p in enumerate(drill_prompts):
+            engine.add_request(f"ddeath-{i}", list(p), sp)
+        victim = min(client._owner[f"ddeath-{i}"]
+                     for i in range(len(drill_prompts)))
+        client.clients[victim] = _DeadProxy()
+        out = {}
+        while engine.has_unfinished_requests():
+            for o in engine.step():
+                if o.finished:
+                    out[o.request_id] = list(o.outputs[0].token_ids)
+            time.sleep(0.001)
+        d = engine.get_stats().get("disagg") or {}
+        record["disagg_drill_death_readmissions"] = int(
+            (d.get("fallbacks") or {}).get("prefill_death", 0))
+        record["disagg_drill_death_parity"] = [
+            out[f"ddeath-{i}"] for i in range(len(drill_prompts))
+        ] == drill_base
+        engine.shutdown()
+        del engine
+        gc.collect()
+    finally:
+        os.environ.pop("VDT_DISAGG_PREFILL_REPLICAS", None)
+        if saved is None:
+            os.environ.pop("VDT_DISAGG", None)
+        else:
+            os.environ["VDT_DISAGG"] = saved
 
 
 def _ssm_leg(record) -> None:
@@ -1366,6 +1589,12 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        # Disagg leg: two-pool fleet vs monolithic on a mixed
+        # long-prompt/chat workload + both recovery drills.
+        try:
+            _disagg_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["disagg_leg_error"] = f"{type(e).__name__}: {e}"
         # SSM state-cache leg: multi-turn session traffic on a mamba
         # model, cache on vs off + recovery-replay wall time.
         try:
@@ -1442,6 +1671,10 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _disagg_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["disagg_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _ssm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Ban undocumented (and orphaned) ``VDT_*`` environment flags.
+
+Every flag registered in ``envs.py`` must have a row in the README's
+environment-flag table, and every table row must name a registered
+flag — otherwise operator-facing knobs ship silently (several PR 9-11
+flags did) and the README rots. Mechanically:
+
+* **registered** — every key of the ``environment_variables`` dict
+  literal in ``envs.py`` (flags only ever enter the registry as
+  string-literal keys), parsed textually so the linter runs without
+  importing the package.
+* **documented** — every README table row whose first cell is a
+  backticked ``VDT_*`` token (``| `VDT_FOO` | ... |``). Prose mentions
+  do not count: the table is the reference surface dashboards and
+  operators read.
+
+Failures: a registered flag without a table row, or a table row naming
+a flag the registry does not know (orphaned row).
+
+Usage::
+
+    python scripts/lint_env_flags.py [--envs FILE] [--readme FILE]
+
+Exit 0 when clean; exit 1 listing violations otherwise.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+# One registry entry: an (indented) string-literal dict key.
+REGISTRY_KEY_RE = re.compile(r'^\s*"(VDT_[A-Z0-9_]+)":', re.M)
+# One README table row whose first cell is a backticked flag name.
+README_ROW_RE = re.compile(r"^\|\s*`(VDT_[A-Z0-9_]+)`", re.M)
+
+
+def registered_flags(envs_path: Path) -> set[str]:
+    text = envs_path.read_text(encoding="utf-8")
+    marker = text.find("environment_variables")
+    if marker < 0:
+        return set()
+    # Scope to the registry dict literal so stray string keys elsewhere
+    # in the module can't parse as flags.
+    end = text.find("\n}", marker)
+    block = text[marker:end if end > 0 else len(text)]
+    return set(REGISTRY_KEY_RE.findall(block))
+
+
+def documented_flags(readme_path: Path) -> set[str]:
+    return set(README_ROW_RE.findall(
+        readme_path.read_text(encoding="utf-8")))
+
+
+def main(argv: list[str]) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--envs", type=Path,
+                        default=repo / "vllm_distributed_tpu" / "envs.py",
+                        help="environment-variable registry module")
+    parser.add_argument("--readme", type=Path,
+                        default=repo / "README.md",
+                        help="README carrying the env-flag table")
+    args = parser.parse_args(argv)
+    if not args.envs.is_file():
+        print(f"lint_env_flags: no such file: {args.envs}",
+              file=sys.stderr)
+        return 2
+    if not args.readme.is_file():
+        print(f"lint_env_flags: no such file: {args.readme}",
+              file=sys.stderr)
+        return 2
+
+    registered = registered_flags(args.envs)
+    documented = documented_flags(args.readme)
+    problems: list[str] = []
+    for name in sorted(registered - documented):
+        problems.append(f"{name}: registered in envs.py but missing "
+                        f"from the README env-flag table "
+                        f"({args.readme.name})")
+    for name in sorted(documented - registered):
+        problems.append(f"{name}: in the README env-flag table but "
+                        f"not registered in envs.py (orphaned row)")
+    if not problems:
+        return 0
+    print("VDT_* env-flag documentation drift:", file=sys.stderr)
+    for p in problems:
+        print(f"  {p}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,518 @@
+"""Disaggregated prefill/decode serving tier (engine/disagg.py).
+
+E2e two-pool fleets (in-process replicas on the virtual CPU mesh)
+pinning the acceptance criteria: greedy token parity with the
+monolithic balancer, the handoff recovery ladder (stalled pull ->
+local re-prefill on the decode home; prefill death mid-handoff ->
+re-admission) with its fallback counters, per-role precompile-lattice
+pruning, asymmetric TP=1 prefill -> TP=2 TPLA decode handoff
+bit-exactness, and the VDT_DISAGG=0 wholesale revert. Deterministic
+stub-replica drills cover the interception state machine itself."""
+
+import time
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_disagg")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=64, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config())
+
+
+PROMPTS = [
+    [3, 17, 92, 45, 8, 21, 33, 64, 90],                # 2 full pages
+    [5, 9, 33, 71, 14, 62, 77, 80, 6, 41, 93, 2, 54],  # 3 full pages
+    [11, 12, 13, 14, 15, 16],
+    [7, 7, 7, 21],                                     # 1 full page
+]
+
+
+def run(engine, tag, prompts=None, max_tokens=6, max_iters=20000):
+    """Drive the engine to completion; the disagg pull threads need
+    GIL slots, hence the tiny sleep."""
+    prompts = PROMPTS if prompts is None else prompts
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"{tag}-{i}", list(p), sp)
+    done = {}
+    for _ in range(max_iters):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+        time.sleep(0.001)
+    assert not engine.has_unfinished_requests(), \
+        f"{tag}: finished only {sorted(done)}"
+    return [done[f"{tag}-{i}"].outputs[0].token_ids
+            for i in range(len(prompts))]
+
+
+@pytest.fixture
+def disagg_env(monkeypatch):
+    monkeypatch.setenv("VDT_DISAGG", "1")
+    yield monkeypatch
+
+
+@pytest.fixture(scope="module")
+def monolithic_tokens(checkpoint):
+    """Greedy outputs of the monolithic 2-replica balancer — the parity
+    reference every disagg fleet must reproduce token-identically."""
+    import os
+    assert os.environ.get("VDT_DISAGG", "0") == "0"
+    engine = make_engine(checkpoint, data_parallel_size=2)
+    assert engine.engine_core.disagg is None  # VDT_DISAGG=0 revert
+    toks = run(engine, "mono")
+    engine.shutdown()
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# E2e: two-pool fleet parity + handoff accounting + decode-home
+# residency registration (the router bugfix).
+# ---------------------------------------------------------------------------
+def test_two_pool_fleet_token_parity_and_handoff_accounting(
+        checkpoint, monolithic_tokens, disagg_env):
+    engine = make_engine(checkpoint, data_parallel_size=2)
+    client: DPEngineClient = engine.engine_core
+    assert client.disagg is not None
+    assert client.disagg.prefill_pool == [0]
+    assert client.disagg.decode_pool == [1]
+    # Per-role replica configs: producer/consumer split, decode token
+    # budget capped (deep decode batches, small compiled ladder).
+    rc0 = client.clients[0].config
+    rc1 = client.clients[1].config
+    assert rc0.kv_transfer_config.kv_role == "kv_producer"
+    assert rc0.kv_transfer_config.pool_role == "prefill"
+    assert rc1.kv_transfer_config.kv_role == "kv_consumer"
+    assert rc1.kv_transfer_config.pool_role == "decode"
+    assert (rc1.scheduler_config.max_num_batched_tokens
+            < rc0.scheduler_config.max_num_batched_tokens)
+
+    got = run(engine, "dis")
+    assert got == monolithic_tokens  # placement must never change tokens
+
+    stats = engine.get_stats()
+    d = stats["disagg"]
+    assert d["handoffs"] == len(PROMPTS)
+    assert d["handoff_seconds"]["count"] == len(PROMPTS)
+    assert d["pool_occupancy"] == {"prefill": 0, "decode": 0}
+    # No recovery rung fired on the happy path.
+    assert d["fallbacks"].get("local_reprefill", 0) == 0
+    assert stats.get("kv_pull_failures", 0) == 0
+
+    # Decode-home residency registration (the on_finish bugfix): the
+    # finished sequences' pages live on the DECODE home, so the full
+    # prompt+generated page chain must score higher affinity there
+    # than on the admitting prefill replica (which only ever held the
+    # prompt pages, and whose pages left with the pull).
+    router = client.router
+    full = list(PROMPTS[1]) + list(got[1])
+    hashes = router._page_hashes(full)
+    assert router._affinity(1, hashes) > router._affinity(0, hashes)
+
+    # /metrics rendering of the new families.
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics(stats)
+    assert f"vdt:disagg_handoffs_total {len(PROMPTS)}" in text
+    assert 'vdt:pool_occupancy{pool="decode"} 0' in text
+    assert "vdt:disagg_handoff_seconds_count" in text
+    engine.shutdown()
+
+
+def test_disagg_off_reverts_to_monolithic_balancer(monkeypatch):
+    """VDT_DISAGG=0 (the default): no coordinator, no pool configs, no
+    connector — byte-identical to the pre-disagg balancer. (The
+    monolithic_tokens fixture additionally proves it on a real fleet;
+    this covers the config surface on the cheap stub transport.)"""
+    from tests.conftest import make_config
+    from vllm_distributed_tpu.engine import dp_client as dp_mod
+    monkeypatch.setenv("VDT_DISAGG", "0")
+    config = make_config()
+    config.parallel_config.data_parallel_size = 2
+    monkeypatch.setattr(dp_mod, "SyncMPClient", _StubReplica)
+    client = DPEngineClient(config, force_mp=True)
+    assert client.disagg is None
+    for c in client.clients:
+        assert c.config.kv_transfer_config.kv_connector is None
+        assert c.config.kv_transfer_config.pool_role is None
+    assert "disagg" not in client._aggregate_stats([{}, {}],
+                                                   indices=[0, 1])
+
+
+# ---------------------------------------------------------------------------
+# Recovery drills
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("timeline", ["1", "0"])
+def test_handoff_stall_degrades_to_local_reprefill(
+        checkpoint, monolithic_tokens, disagg_env, timeline):
+    """disagg.handoff_stall breaks every handoff's pull coordinates:
+    the decode home must ride the scheduler ladder (bounded retries ->
+    local re-prefill) to token-identical output, with the fallback
+    counted — including with the request-timeline recorder OFF (the
+    recovery-ladder accounting must not ride a telemetry kill switch:
+    the scheduler force-ships KV_PULL_RETRY/KV_PULL_LOCAL events)."""
+    disagg_env.setenv("VDT_REQUEST_TIMELINE", timeline)
+    engine = make_engine(checkpoint, data_parallel_size=2,
+                         kv_pull_timeout_s=1.0)
+    fi.inject("disagg.handoff_stall")
+    try:
+        got = run(engine, "stall", prompts=PROMPTS[:2])
+    finally:
+        fi.clear("disagg.handoff_stall")
+    assert got == monolithic_tokens[:2]
+    d = engine.get_stats()["disagg"]
+    # Every multi-page handoff degraded to a local re-prefill on its
+    # decode home (single-page-or-less prompts may resolve through
+    # no_pull_coords instead of a failed pull).
+    assert d["fallbacks"].get("local_reprefill", 0) >= 2
+    engine.shutdown()
+
+
+def test_prefill_death_mid_handoff_readmits(checkpoint, monolithic_tokens,
+                                            disagg_env):
+    """A prefill replica dying with prefill-stage requests in flight:
+    the failover path re-admits them as fresh prefill-stage copies on
+    the surviving prefill pool, counted as prefill_death fallbacks,
+    and greedy output is unchanged."""
+    from vllm_distributed_tpu.engine.core_client import EngineDeadError
+    disagg_env.setenv("VDT_DISAGG_PREFILL_REPLICAS", "2")
+    engine = make_engine(checkpoint, data_parallel_size=3)
+    client: DPEngineClient = engine.engine_core
+    assert client.disagg.prefill_pool == [0, 1]
+    assert client.disagg.decode_pool == [2]
+
+    prompts = PROMPTS[:2]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    for i, p in enumerate(prompts):
+        engine.add_request(f"pd-{i}", list(p), sp)
+    owners = {client._owner[f"pd-{i}"] for i in range(len(prompts))}
+    assert owners <= {0, 1}  # everything admitted to the prefill pool
+    victim = min(owners)
+
+    class _DeadProxy:
+        """Every call answers EngineDeadError, like a crashed core."""
+
+        def __getattr__(self, name):
+            def _boom(*a, **k):
+                raise EngineDeadError("killed by test")
+            return _boom
+
+    alive_client = client.clients[victim]
+    client.clients[victim] = _DeadProxy()
+    try:
+        done = {}
+        for _ in range(20000):
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out
+            if not engine.has_unfinished_requests():
+                break
+            time.sleep(0.001)
+        assert len(done) == len(prompts)
+        got = [done[f"pd-{i}"].outputs[0].token_ids
+               for i in range(len(prompts))]
+        assert got == monolithic_tokens[:2]
+        stats = engine.get_stats()
+        assert stats["disagg"]["fallbacks"].get("prefill_death", 0) >= 1
+        assert stats["replica_failovers"] == 1
+        assert victim in client._down
+    finally:
+        client.clients[victim] = alive_client
+        engine.shutdown()
+
+
+@pytest.mark.slow
+def test_disagg_over_shared_storage_connector(checkpoint,
+                                              monolithic_tokens,
+                                              disagg_env, tmp_path):
+    """A parent config that pins SharedStorageConnector keeps it: the
+    handoff then rides content-hash page files instead of a pull (no
+    kv_transfer_params at all), and parity still holds — the 'existing
+    connectors' contract covers all three transports."""
+    engine = make_engine(
+        checkpoint, data_parallel_size=2,
+        kv_connector="SharedStorageConnector",
+        kv_connector_extra_config={
+            "shared_storage_path": str(tmp_path)})
+    client = engine.engine_core
+    assert (client.clients[0].config.kv_transfer_config.kv_connector
+            == "SharedStorageConnector")
+    got = run(engine, "ss")
+    assert got == monolithic_tokens
+    d = engine.get_stats()["disagg"]
+    assert d["handoffs"] == len(PROMPTS)
+    # Hash-addressed handoffs carry no pull coordinates by design —
+    # that is not a fallback.
+    assert d["fallbacks"].get("no_pull_coords", 0) == 0
+    # The prefill pool really produced page files for the store.
+    assert any(tmp_path.iterdir())
+    engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Per-role precompile pruning: each pool warms a strict subset of the
+# monolithic lattice.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # three engines with full CPU precompile warm-ups
+def test_pool_precompile_lattices_are_strict_subsets(checkpoint,
+                                                     disagg_env):
+    disagg_env.setenv("VDT_PRECOMPILE", "1")
+    kw = dict(max_num_batched_tokens=32, num_scheduler_steps=2)
+
+    disagg_env.setenv("VDT_DISAGG", "0")
+    mono = make_engine(checkpoint, **kw)
+    mono_graphs = int(mono.get_stats()["precompile_graphs"])
+    mono.shutdown()
+
+    disagg_env.setenv("VDT_DISAGG", "1")
+    fleet = make_engine(checkpoint, data_parallel_size=2, **kw)
+    per = fleet.get_stats()["dp_replicas"]
+    prefill_graphs = int(per[0]["precompile_graphs"])
+    decode_graphs = int(per[1]["precompile_graphs"])
+    fleet.shutdown()
+
+    # Each pool's warmed lattice is a strict subset of the monolithic
+    # one: the prefill pool drops the decode-burst (multi-step) and
+    # fused-block variants; the decode pool additionally shrinks the
+    # token-bucket ladder to its capped budget and skips the
+    # prompt-logprob graphs.
+    assert 0 < prefill_graphs < mono_graphs
+    assert 0 < decode_graphs < prefill_graphs
+
+
+# ---------------------------------------------------------------------------
+# Asymmetric meshes: TP=1 prefill producer -> TP=2 TPLA decode
+# consumer over the same handoff params a disagg fleet ships.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # three MLA engines incl. a TP=2 mesh
+def test_asymmetric_tp1_prefill_to_tp2_tpla_decode_bit_exact():
+    from tests.models.test_tpla import make_config
+    from vllm_distributed_tpu.config import KVTransferConfig
+
+    def engine(tp, role=None, tpla=True):
+        cfg = make_config(tp=tp, tpla=tpla)
+        if role is not None:
+            cfg.kv_transfer_config = KVTransferConfig(
+                kv_connector="DCNPullConnector", kv_role=role,
+                kv_connector_extra_config={"pull_port": 0})
+        return LLMEngine(cfg, load_tokenizer=False)
+
+    prompts = [[3, 17, 92, 45, 8, 21, 33, 64, 90],
+               [5, 9, 33, 71, 14, 62, 77, 80, 6]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    baseline = run(engine(tp=1), "base", prompts=prompts)
+
+    producer = engine(tp=1, role="kv_producer", tpla=False)
+    for i, p in enumerate(prompts):
+        producer.add_request(
+            f"prod-{i}", list(p),
+            SamplingParams(temperature=0.0, max_tokens=1,
+                           ignore_eos=True))
+    params = {}
+    for _ in range(500):
+        for out in producer.step():
+            if out.finished:
+                params[out.request_id] = out.kv_transfer_params
+        if not producer.has_unfinished_requests():
+            break
+    assert all(params.get(f"prod-{i}") for i in range(len(prompts)))
+
+    consumer = engine(tp=2, role="kv_consumer")
+    runner = (consumer.engine_core.engine_core.executor
+              .worker.model_runner)
+    assert runner.model.tpla_shards == 2  # latent cache TP-sharded
+    for i, p in enumerate(prompts):
+        consumer.add_request(f"cons-{i}", list(p), sp,
+                             kv_transfer_params=params[f"prod-{i}"])
+    done = {}
+    for _ in range(20000):
+        for out in consumer.step():
+            if out.finished:
+                done[out.request_id] = out
+        producer.step()  # serve the pulls
+        if len(done) == len(prompts):
+            break
+        time.sleep(0.001)
+    got = [done[f"cons-{i}"].outputs[0].token_ids
+           for i in range(len(prompts))]
+    assert got == baseline  # bit-exact across the TP-degree change
+    # The latent pages really were pulled, not recomputed.
+    assert all(done[f"cons-{i}"].num_cached_tokens > 0
+               for i in range(len(prompts)))
+    producer.shutdown()
+    consumer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic stub drills over the interception state machine.
+# ---------------------------------------------------------------------------
+class _StubReplica:
+    def __init__(self, config) -> None:
+        self.config = config
+        self.added: list[EngineCoreRequest] = []
+
+    def add_request(self, request: EngineCoreRequest) -> None:
+        self.added.append(request)
+
+    def abort_requests(self, request_ids) -> None:
+        pass
+
+    def recv_outputs(self, timeout_ms: int):
+        return None
+
+    def shutdown(self) -> None:
+        pass
+
+
+@pytest.fixture
+def stub_fleet(monkeypatch):
+    from tests.conftest import make_config
+    from vllm_distributed_tpu.engine import dp_client as dp_mod
+    monkeypatch.setenv("VDT_DISAGG", "1")
+    config = make_config()
+    config.parallel_config.data_parallel_size = 2
+    monkeypatch.setattr(dp_mod, "SyncMPClient", _StubReplica)
+    return DPEngineClient(config, force_mp=True)
+
+
+def _req(rid, max_tokens=8):
+    return EngineCoreRequest(
+        request_id=rid, prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(temperature=0.0,
+                                       max_tokens=max_tokens))
+
+
+def test_stub_handoff_moves_request_with_pull_params(stub_fleet):
+    dp = stub_fleet
+    dp.add_request(_req("a"))
+    # Admitted to the prefill pool as a one-token prefill-stage copy.
+    assert dp._owner["a"] == 0
+    (staged, ) = dp.clients[0].added
+    assert staged.sampling_params.max_tokens == 1
+    assert dp._requests["a"].sampling_params.max_tokens == 8  # journal
+
+    coords = {"remote_req_id": "a", "pull_host": "h", "pull_port": 7,
+              "num_tokens": 4, "remote_page_ids": [0]}
+    out = EngineCoreOutput(req_id="a", new_token_ids=[42],
+                           finish_reason="length",
+                           kv_transfer_params=coords)
+    delivered = dp._mark_finished([out])
+    # The prefill finish is swallowed (its token is regenerated by the
+    # decode home) and the request re-admitted to the decode pool with
+    # the pull coordinates and its FULL budget.
+    assert delivered == []
+    assert dp._owner["a"] == 1
+    (cont, ) = dp.clients[1].added
+    assert cont.kv_transfer_params == coords
+    assert cont.sampling_params.max_tokens == 8
+    assert "a" not in dp._progress  # the swallowed token never journaled
+    assert dp.disagg.handoffs == 1
+    # The decode home's finish flows through normally.
+    delivered = dp._mark_finished(
+        [EngineCoreOutput(req_id="a", new_token_ids=[5, 6],
+                          finish_reason="stop")])
+    assert len(delivered) == 1
+    assert dp.request_counts() == [0, 0]
+    assert "a" not in dp.disagg._stage
+
+
+def test_stub_coordinator_honors_pool_restriction(stub_fleet):
+    """With a DP coordinator process attached, disagg placement must
+    stay pool-restricted: the coordinator's fleet-wide least-loaded
+    route() cannot honor pools, so the pick is made locally and the
+    admission accounted to it explicitly via report()."""
+    dp = stub_fleet
+
+    class _FakeCoordinator:
+        def __init__(self):
+            self.reports = []
+
+        def route(self, prefer=None):
+            raise AssertionError(
+                "coordinator.route() must not place disagg admissions")
+
+        def report(self, engine, delta):
+            self.reports.append((engine, delta))
+
+    dp.coordinator = _FakeCoordinator()
+    dp.add_request(_req("c"))
+    assert dp._owner["c"] == 0  # prefill pool despite the coordinator
+    assert (0, 1) in dp.coordinator.reports  # admission accounted
+    out = EngineCoreOutput(
+        req_id="c", new_token_ids=[42], finish_reason="length",
+        kv_transfer_params={"remote_req_id": "c", "pull_host": "h",
+                            "pull_port": 7, "num_tokens": 4,
+                            "remote_page_ids": [0]})
+    dp._mark_finished([out])
+    assert dp._owner["c"] == 1  # decode pool, still coordinator-safe
+    assert (1, 1) in dp.coordinator.reports
+    # The handoff unwound the prefill-side accounting.
+    assert (0, -1) in dp.coordinator.reports
+
+
+def test_stub_pool_down_falls_back_to_any_alive(stub_fleet):
+    dp = stub_fleet
+    dp._down.add(0)  # the whole prefill pool
+    dp.add_request(_req("x"))
+    assert dp._owner["x"] == 1  # placed on the decode replica anyway
+    assert dp.disagg.fallbacks.get("pool_down", 0) == 1
+
+
+def test_stub_prefill_only_requests_are_not_staged(stub_fleet):
+    dp = stub_fleet
+    dp.add_request(_req("one", max_tokens=1))
+    assert dp._owner["one"] == 0  # prefill pool, monolithic service
+    assert "one" not in dp.disagg._stage
+    (admitted, ) = dp.clients[0].added
+    assert admitted is dp._requests["one"]  # no staging copy
+    # Its finish passes through unintercepted.
+    delivered = dp._mark_finished(
+        [EngineCoreOutput(req_id="one", new_token_ids=[9],
+                          finish_reason="length")])
+    assert len(delivered) == 1
+
+
+def test_stub_abort_clears_handoff_state(stub_fleet):
+    dp = stub_fleet
+    dp.add_request(_req("a"))
+    dp.abort_requests(["a"])
+    assert "a" not in dp.disagg._stage
+    # A late prefill finish for the aborted request causes no ghost
+    # re-admission (the front end already dropped the request; the
+    # stray output is harmless downstream).
+    dp._mark_finished(
+        [EngineCoreOutput(req_id="a", new_token_ids=[1],
+                          finish_reason="length")])
+    assert dp.clients[1].added == []
+    assert "a" not in dp._owner

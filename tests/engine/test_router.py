@@ -291,3 +291,45 @@ def test_coordinator_honors_router_preference(monkeypatch):
     dp.add_request(_req("t2", SESSION + [7, 8, 9, 10, 1]))
     assert dp._owner["t2"] == home
     assert dp.coordinator.counts[home] == 1
+
+
+# ---------------------------------------------------------------------------
+# Two-stage disagg placement (engine/disagg.py): pool restriction +
+# explicit least-loaded mode on route().
+# ---------------------------------------------------------------------------
+
+def test_route_pool_restriction_scores_inside_the_pool():
+    from vllm_distributed_tpu.engine.router import ReplicaRouter
+    router = ReplicaRouter(4, make_config())
+    for i in range(4):
+        router.observe_stats(i, {"num_running_reqs": 0,
+                                 "num_waiting_reqs": 0,
+                                 "kv_cache_usage": 0.0})
+    # Replica 0 holds the session prefix, but it is outside the pool:
+    # the pick must come from {2, 3}, by cost.
+    router.on_admit(_req("seed", SESSION), 0)
+    req = _req("x", SESSION)
+    pick = router.route(req, [0, 5, 3, 1], set(), pool=[2, 3])
+    assert pick == 3  # lowest live count inside the pool
+    router.on_admit(req, pick)
+    assert router.stale_degradations == 0
+
+
+def test_route_least_loaded_mode_ignores_affinity():
+    from vllm_distributed_tpu.engine.router import ReplicaRouter
+    router = ReplicaRouter(2, make_config())
+    for i in range(2):
+        router.observe_stats(i, {"num_running_reqs": 0,
+                                 "num_waiting_reqs": 0,
+                                 "kv_cache_usage": 0.0})
+    router.on_admit(_req("seed", SESSION), 0)
+    # Replica 0 holds the prefix but carries more live requests: the
+    # prefill-pool placement mode (least_loaded=True) must ignore the
+    # affinity credit — produced pages leave with the pull anyway.
+    req = _req("y", SESSION)
+    pick = router.route(req, [2, 0], set(), least_loaded=True)
+    assert pick == 1
+    router.on_admit(req, pick)
+    # Not a stale degradation, and no phantom affinity hit.
+    assert router.stale_degradations == 0
+    assert router.affinity_hits == 0

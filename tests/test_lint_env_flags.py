@@ -1,0 +1,91 @@
+"""CI guard: every VDT_* env flag in envs.py stays documented.
+
+Runs scripts/lint_env_flags.py over the real registry + README (the
+tier-1 mechanical check that caught the undocumented PR 9-11 flags)
+and unit-tests the linter's failure modes on synthetic files."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_env_flags.py"
+
+_ENVS = '''\
+environment_variables = {
+    "VDT_GOOD_FLAG":
+    lambda: "1",
+    "VDT_OTHER_FLAG":
+    lambda: "x",
+}
+
+
+def unrelated():
+    return {"VDT_NOT_A_FLAG": 1}
+'''
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _files(tmp_path, envs: str, readme: str):
+    envs_path = tmp_path / "envs.py"
+    envs_path.write_text(envs)
+    readme_path = tmp_path / "README.md"
+    readme_path.write_text(readme)
+    return envs_path, readme_path
+
+
+def test_package_env_flags_are_documented():
+    res = _run()
+    assert res.returncode == 0, (
+        f"VDT_* env-flag documentation drifted:\n{res.stderr}")
+
+
+def test_missing_readme_row_is_caught(tmp_path):
+    envs, readme = _files(
+        tmp_path, _ENVS, "| `VDT_GOOD_FLAG` | 1 | fine |\n")
+    res = _run("--envs", str(envs), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "VDT_OTHER_FLAG" in res.stderr
+    assert "missing from the README" in res.stderr
+
+
+def test_orphaned_readme_row_is_caught(tmp_path):
+    envs, readme = _files(
+        tmp_path, _ENVS,
+        "| `VDT_GOOD_FLAG` | 1 | fine |\n"
+        "| `VDT_OTHER_FLAG` | x | fine |\n"
+        "| `VDT_GHOST` | ? | removed long ago |\n")
+    res = _run("--envs", str(envs), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "VDT_GHOST" in res.stderr
+    assert "orphaned row" in res.stderr
+
+
+def test_keys_outside_registry_are_ignored(tmp_path):
+    """Only the environment_variables dict counts — stray VDT_* string
+    keys elsewhere in the module are not flags."""
+    envs, readme = _files(
+        tmp_path, _ENVS,
+        "| `VDT_GOOD_FLAG` | 1 | fine |\n"
+        "| `VDT_OTHER_FLAG` | x | fine |\n")
+    res = _run("--envs", str(envs), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr
+
+
+def test_prose_mention_does_not_count_as_documentation(tmp_path):
+    envs, readme = _files(
+        tmp_path, _ENVS,
+        "Set `VDT_GOOD_FLAG` and `VDT_OTHER_FLAG` for fun.\n")
+    res = _run("--envs", str(envs), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "VDT_GOOD_FLAG" in res.stderr
+
+
+def test_missing_file_is_a_usage_error(tmp_path):
+    res = _run("--envs", str(tmp_path / "nope.py"),
+               "--readme", str(tmp_path / "nope.md"))
+    assert res.returncode == 2

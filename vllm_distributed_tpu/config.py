@@ -232,6 +232,11 @@ class ParallelConfig:
     # This replica's rank under "engine" mode (set by the DP front-end;
     # selects the replica's device slice).
     data_parallel_rank: int = 0
+    # Explicit first-device index of this replica's slice (set by the
+    # disagg pool planner when pools have asymmetric TP degrees, so
+    # replica world sizes differ and rank * world_size no longer
+    # addresses the right devices). None = legacy rank-based slicing.
+    data_parallel_device_offset: Optional[int] = None
     # Route DP requests through a separate coordinator PROCESS (the
     # reference's DPCoordinator, v1/engine/coordinator.py) instead of
     # front-end-local accounting — the serving-plane scale-out hook.
@@ -394,6 +399,13 @@ class KVTransferConfig:
     kv_connector: Optional[str] = None
     kv_role: Optional[str] = None  # kv_producer | kv_consumer | kv_both
     kv_connector_extra_config: dict[str, Any] = field(default_factory=dict)
+    # Disaggregated serving tier (engine/disagg.py): which pool this
+    # engine replica belongs to — "prefill" | "decode" | None
+    # (monolithic). Read by the model runner to prune the precompile
+    # lattice per role (a prefill replica never warms decode-burst or
+    # fused-block graph variants; a decode replica's token ladder is
+    # capped by its pool config).
+    pool_role: Optional[str] = None
 
     @property
     def is_kv_producer(self) -> bool:

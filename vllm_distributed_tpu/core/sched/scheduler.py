@@ -319,15 +319,21 @@ class Scheduler:
         self.last_step_decode_tokens = 0
 
     def _record_event(self, request: Request, event: str,
-                      detail: Optional[dict] = None) -> None:
+                      detail: Optional[dict] = None, *,
+                      force: bool = False) -> None:
         """One lifecycle transition: onto the request's own event list
         (ships with its next output) and the scheduler's ring buffer
-        (ships with get_stats)."""
-        if not self.events_enabled:
+        (ships with get_stats). ``force`` bypasses the timeline kill
+        switch for the per-request list only — recovery-ladder events
+        feed ACCOUNTING at the front end (disagg fallback counters),
+        which must not ride a telemetry flag; the ring buffer stays
+        gated."""
+        if not self.events_enabled and not force:
             return
         ts = time.monotonic()
         request.events.append((ts, event, detail))
-        self.events.record(request.request_id, event, detail, ts=ts)
+        if self.events_enabled:
+            self.events.record(request.request_id, event, detail, ts=ts)
 
     def _take_events(self, request: Request) -> Optional[list[tuple]]:
         if not request.events:
@@ -1492,7 +1498,7 @@ class Scheduler:
             self.kv_pull_retries += 1
             self._record_event(request, ev.KV_PULL_RETRY,
                                {"attempt": request.num_kv_pull_retries,
-                                "reason": reason})
+                                "reason": reason}, force=True)
             logger.warning(
                 "KV pull for %s failed (%s); retrying pull %d/%d",
                 request.request_id, reason, request.num_kv_pull_retries,
@@ -1503,7 +1509,7 @@ class Scheduler:
                 "recompute", request.request_id, reason)
             request.kv_transfer_params = None
             self._record_event(request, ev.KV_PULL_LOCAL,
-                               {"reason": reason})
+                               {"reason": reason}, force=True)
         self._requeue_after_hold(request)
 
     def _requeue_after_hold(self, request: Request) -> None:

@@ -125,7 +125,7 @@ def sweep_journal(journal_dir: str, *, max_bytes: int, ttl_s: float,
     if not journal_dir or not os.path.isdir(journal_dir):
         return 0, 0
     if now is None:
-        now = _time.time()
+        now = _time.time()  # wallclock-ok: compared against os.stat mtimes
     entries = []
     for name in os.listdir(journal_dir):
         if not (name.startswith("ssm_") and name.endswith(".npz")):

@@ -70,9 +70,22 @@ class DPEngineClient(EngineCoreClient):
             force_mp = (config.parallel_config.multiprocess_engine_core
                         or envs.VDT_ENABLE_MP_ENGINE)
         self.is_mp = bool(force_mp)
+        # Disaggregated serving tier (engine/disagg.py): when VDT_DISAGG
+        # is set, the fleet splits into a prefill pool and a decode pool
+        # — the plan is computed BEFORE replica construction so each
+        # replica's config is specialized for its role (connector side,
+        # token budget, precompile lattice, device offset).
+        disagg_plan = None
+        if envs.VDT_DISAGG:
+            from vllm_distributed_tpu.engine.disagg import (
+                DisaggCoordinator, specialize_replica_config)
+            disagg_plan = DisaggCoordinator.plan_replicas(config)
         self.clients: list[EngineCoreClient] = []
         for rank in range(n):
             rc = make_replica_config(config, rank)
+            if disagg_plan is not None:
+                role, offset = disagg_plan[rank]
+                specialize_replica_config(rc, role, offset)
             client = SyncMPClient(rc) if self.is_mp else InprocClient(rc)
             self.clients.append(client)
             # Propagate the replica-profiled KV pool size so the parent
@@ -104,6 +117,12 @@ class DPEngineClient(EngineCoreClient):
         if envs.VDT_ROUTER:
             from vllm_distributed_tpu.engine.router import ReplicaRouter
             self.router = ReplicaRouter(n, config)
+        # Disagg handoff state machine: placement goes two-stage (least-
+        # loaded prefill admission, affinity-scored decode home at
+        # handoff) and finished prefills re-admit as pull continuations.
+        self.disagg = None
+        if disagg_plan is not None:
+            self.disagg = DisaggCoordinator(self, config)
         # Balancer state: request ownership + live counts per replica
         # (the coordinator's published queue lengths, client-side).
         self._owner: dict[str, int] = {}
@@ -141,28 +160,59 @@ class DPEngineClient(EngineCoreClient):
         self.replica_resurrections = 0
 
     # ------------------------------------------------------------------
-    def _pick_replica(
-            self, request: Optional[EngineCoreRequest] = None) -> int:
+    def _pick_replica(self, request: Optional[EngineCoreRequest] = None,
+                      count_fallbacks: bool = True) -> int:
         if len(self._down) == len(self.clients):
             raise EngineDeadError("all DP replicas are dead")
+        pool, least_loaded = None, False
+        if self.disagg is not None and request is not None:
+            # Two-stage disagg placement: fresh requests go to the
+            # prefill pool (least-loaded), handoff continuations to the
+            # decode pool (affinity + load). An entirely-down pool
+            # degrades to any-alive placement (counted once per
+            # admission — retries after a failover don't re-count).
+            pool = self.disagg.usable_pool(
+                self.disagg.target_pool(request), self._down,
+                count=count_fallbacks)
+            least_loaded = (pool is not None and
+                            self.disagg.prefill_least_loaded(request))
         prefer = None
         if self.router is not None:
             self.router.maybe_refresh(self.clients, self._down)
             prefer = self.router.route(request, self.request_counts(),
-                                       self._down)
+                                       self._down, pool=pool,
+                                       least_loaded=least_loaded)
         if self.coordinator is not None:
-            # The coordinator's route() already accounts the admission
-            # (and skips replicas reported down via set_health); the
-            # router's pick rides along as a preference it honors while
-            # that replica is healthy.
-            return self.coordinator.route(prefer=prefer)
+            if pool is None:
+                # The coordinator's route() already accounts the
+                # admission (and skips replicas reported down via
+                # set_health); the router's pick rides along as a
+                # preference it honors while that replica is healthy.
+                return self.coordinator.route(prefer=prefer)
+            # Disagg: the coordinator's fleet-wide least-loaded pick
+            # (and its healthy-override of `prefer`) cannot honor the
+            # pool restriction, so the pick stays local and the
+            # admission is accounted to it explicitly — keeping the
+            # invariant _admit's unwind relies on (route() would have
+            # +1'd the same way).
+            pick = (prefer if prefer is not None
+                    else self._local_least_loaded(set(pool)))
+            self.coordinator.report(pick, 1)
+            return pick
         if prefer is not None:
             return prefer
+        return self._local_least_loaded(
+            set(pool) if pool is not None else None)
+
+    def _local_least_loaded(self, members: Optional[set]) -> int:
+        """Least-live-count replica with rotation tie-break, optionally
+        restricted to a member subset (the disagg pool)."""
         n = len(self.clients)
         best, best_load = None, None
         for off in range(n):
             i = (self._rr + off) % n
-            if i in self._down:
+            if i in self._down or (members is not None
+                                   and i not in members):
                 continue
             load = len(self._live[i])
             if best_load is None or load < best_load:
@@ -175,19 +225,30 @@ class DPEngineClient(EngineCoreClient):
     def add_request(self, request: EngineCoreRequest) -> None:
         with self._lock:
             self._requests[request.request_id] = request
+            admit_req = request
+            if self.disagg is not None:
+                # Handoff-eligible requests enter as their one-token
+                # prefill-stage copy; the journal keeps the ORIGINAL
+                # (the decode-home continuation and any failover replay
+                # derive from it).
+                admit_req = self.disagg.on_new_request(request)
             try:
-                self._admit(request)
+                self._admit(admit_req)
             except Exception:
                 self._requests.pop(request.request_id, None)
                 self._progress.pop(request.request_id, None)
+                if self.disagg is not None:
+                    self.disagg.forget(request.request_id)
                 raise
 
     def _admit(self, request: EngineCoreRequest) -> None:
         """Place a request on a healthy replica, failing over any
         replica found dead at admission time (its own journaled load
         migrates too), until the request lands or no replica is left."""
+        first_pick = True
         while True:
-            i = self._pick_replica(request)
+            i = self._pick_replica(request, count_fallbacks=first_pick)
+            first_pick = False
             try:
                 self.clients[i].add_request(request)
             except Exception as e:
@@ -218,6 +279,8 @@ class DPEngineClient(EngineCoreClient):
             for rid in request_ids:
                 self._requests.pop(rid, None)
                 self._progress.pop(rid, None)
+                if self.disagg is not None:
+                    self.disagg.forget(rid)
                 i = self._owner.pop(rid, None)
                 if i is not None:
                     self._live[i].discard(rid)
@@ -231,11 +294,26 @@ class DPEngineClient(EngineCoreClient):
                 if self.coordinator is not None:
                     self.coordinator.report(i, -len(rids))
 
-    def _mark_finished(self, outs: list[EngineCoreOutput]) -> None:
+    def _mark_finished(
+            self,
+            outs: list[EngineCoreOutput]) -> list[EngineCoreOutput]:
         with self._lock:
-            self._mark_finished_locked(outs)
+            return self._mark_finished_locked(outs)
 
-    def _mark_finished_locked(self, outs: list[EngineCoreOutput]) -> None:
+    def _mark_finished_locked(
+            self,
+            outs: list[EngineCoreOutput]) -> list[EngineCoreOutput]:
+        if self.disagg is not None:
+            # Disagg interception BEFORE any journal/owner bookkeeping:
+            # a finished prefill-stage output is swallowed (its sampled
+            # token is regenerated by the decode home) and re-admitted
+            # to the decode pool with the producer's pull coordinates.
+            # Crediting it here instead would register prompt+generated
+            # residency against the PREFILL replica — whose pages leave
+            # with the pull — so next-turn affinity would route to a
+            # replica that holds nothing (the decode-home registration
+            # fix).
+            outs = self.disagg.intercept(outs)
         finished_per: dict[int, int] = {}
         for o in outs:
             if o.new_token_ids and o.req_id in self._requests:
@@ -259,6 +337,7 @@ class DPEngineClient(EngineCoreClient):
             # One batched delta per replica (output hot path).
             for i, k in finished_per.items():
                 self.coordinator.report(i, -k)
+        return outs
 
     # ------------------------------------------------------------------
     # Replica failover + resurrection
@@ -298,8 +377,18 @@ class DPEngineClient(EngineCoreClient):
             orig = self._requests.get(rid)
             if orig is None:
                 continue
-            req = continuation_request(orig,
-                                       self._progress.get(rid, []))
+            req = None
+            if self.disagg is not None:
+                # A prefill-stage casualty re-enters as a fresh
+                # prefill-stage copy (nothing was delivered yet); a
+                # decode-stage casualty takes the normal continuation
+                # below and stays homed to the decode pool. Both are
+                # counted as disagg fallbacks by cause.
+                req = self.disagg.readmission_for(
+                    rid, orig, self._progress.get(rid, []))
+            if req is None:
+                req = continuation_request(orig,
+                                           self._progress.get(rid, []))
             try:
                 self._admit(req)
             except EngineDeadError:
@@ -383,6 +472,8 @@ class DPEngineClient(EngineCoreClient):
                 live.clear()
             if self.router is not None:
                 self.router.reset()
+            if self.disagg is not None:
+                self.disagg.reset()
 
     # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
@@ -407,8 +498,7 @@ class DPEngineClient(EngineCoreClient):
                         # replica's step failure is that replica's
                         # death, not the deployment's: fail over.
                         self._failover(i, e)
-            self._mark_finished(outs)
-            return outs
+            return self._mark_finished(outs)
         while any(self._live):
             polled = False
             for i, client in enumerate(self.clients):
@@ -430,8 +520,7 @@ class DPEngineClient(EngineCoreClient):
                 time.sleep(0.02)
                 self._maybe_resurrect()
                 self._check_any_alive()
-        self._mark_finished(outs)
-        return outs
+        return self._mark_finished(outs)
 
     def recv_outputs(
             self, timeout_ms: int) -> Optional[list[EngineCoreOutput]]:
@@ -460,7 +549,7 @@ class DPEngineClient(EngineCoreClient):
             # the pump thread for the probe's whole duration.
             time.sleep(timeout_ms / 1000)
             return None
-        self._mark_finished(outs)
+        outs = self._mark_finished(outs)
         return outs or None
 
     # ------------------------------------------------------------------
@@ -647,6 +736,11 @@ class DPEngineClient(EngineCoreClient):
         # placement, so its counters attach exactly — nothing to merge.
         if router is not None:
             agg["router"] = router.get_stats()
+        # Disagg serving tier: one coordinator owns every handoff, so
+        # its counters/histogram attach exactly too.
+        disagg = getattr(self, "disagg", None)
+        if disagg is not None:
+            agg["disagg"] = disagg.get_stats(self.request_counts())
         return agg
 
     def get_stats(self) -> dict:

@@ -327,22 +327,36 @@ class ReplicaRouter:
         return max(kv, min(queue, 1.0))
 
     def route(self, request: Optional[EngineCoreRequest],
-              live_counts: list[int], down: set) -> int:
+              live_counts: list[int], down: set,
+              pool: Optional[list[int]] = None,
+              least_loaded: bool = False) -> int:
         """Pick the replica with the best expected outcome for this
         admission. Caller guarantees at least one replica is alive.
         Counters do NOT move here — the decision record is stashed and
         committed by on_admit() against the landing replica (a failover
-        retry re-enters here; a coordinator may override the pick)."""
-        alive = [i for i in range(self.n) if i not in down]
-        assert alive, "route() with every replica down"
+        retry re-enters here; a coordinator may override the pick).
+
+        ``pool`` restricts candidates to a replica subset — the disagg
+        tier's two-stage placement (engine/disagg.py): prefill-pool
+        admissions additionally pass ``least_loaded=True`` (affinity
+        buys nothing on a pool whose pages leave with the pull), while
+        the decode-home pick at handoff time scores the decode pool
+        with the full prefix-affinity + load blend."""
+        members = (set(pool) if pool is not None
+                   else set(range(self.n)))
+        alive = [i for i in range(self.n)
+                 if i not in down and i in members]
+        assert alive, "route() with every candidate replica down"
         rid = request.request_id if request is not None else None
-        if self._stale(alive):
-            # Degraded: pure least-live-count with rotation tie-break
-            # (identical placement to the pre-router balancer).
+        if least_loaded or self._stale(alive):
+            # Least-live-count with rotation tie-break: the explicit
+            # two-stage prefill placement, or the degraded stale-stats
+            # mode (identical to the pre-router balancer).
             best = self._least_loaded(alive, live_counts)
             self._rr = (best + 1) % self.n
             self._pending_route = {"rid": rid, "hashes": [],
-                                   "degraded": True, "home": None,
+                                   "degraded": not least_loaded,
+                                   "home": None,
                                    "home_pressured": False}
             return best
         hashes = (self.request_hashes(request)
@@ -351,7 +365,7 @@ class ReplicaRouter:
         home, home_aff, home_pressured = None, 0.0, False
         for off in range(self.n):
             i = (self._rr + off) % self.n
-            if i in down:
+            if i in down or i not in members:
                 continue
             queue, kv, wait = self._load_terms(i, live_counts)
             affinity = self._affinity(i, hashes)

@@ -183,6 +183,33 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # least-cost healthy replica.
     "VDT_ROUTER_SPILL_PRESSURE":
     lambda: float(os.getenv("VDT_ROUTER_SPILL_PRESSURE", "0.85")),
+    # --- Disaggregated prefill/decode serving tier (engine/disagg.py) ---
+    # Master switch: "1" splits a DP fleet (data_parallel_size > 1) into
+    # a prefill pool (chunked-prefill producers, big token buckets) and
+    # a decode pool (deep decode batches, pull consumers) with routed KV
+    # handoff between them. "0" (default) keeps the monolithic DP
+    # balancer byte-identical to the pre-disagg behavior.
+    "VDT_DISAGG":
+    lambda: os.getenv("VDT_DISAGG", "0") == "1",
+    # Replicas assigned to the prefill pool (the first k DP ranks).
+    # 0 = auto: half the fleet, at least 1, always leaving >= 1 decode
+    # replica.
+    "VDT_DISAGG_PREFILL_REPLICAS":
+    lambda: max(0, int(os.getenv("VDT_DISAGG_PREFILL_REPLICAS", "0"))),
+    # Decode-pool scheduler token budget (max_num_batched_tokens of the
+    # decode replicas). Bounds both the decode wave depth and the
+    # chunk size of the local re-prefill fallback, and therefore the
+    # decode pool's precompiled token-bucket ladder. 0 = auto:
+    # max(max_num_seqs, 2 * block_size), clipped to the parent budget.
+    "VDT_DISAGG_DECODE_TOKENS":
+    lambda: max(0, int(os.getenv("VDT_DISAGG_DECODE_TOKENS", "0"))),
+    # Per-pool tensor-parallel degree (0 = inherit the parent config).
+    # Asymmetric meshes work because the KV handoff rides the versioned
+    # standard/latent wire formats, which re-slice on receipt.
+    "VDT_DISAGG_PREFILL_TP":
+    lambda: max(0, int(os.getenv("VDT_DISAGG_PREFILL_TP", "0"))),
+    "VDT_DISAGG_DECODE_TP":
+    lambda: max(0, int(os.getenv("VDT_DISAGG_DECODE_TP", "0"))),
     # --- SSM state cache (core/state_cache.py) --------------------------
     # First-class state checkpoint/restore for stateful (Mamba/Jamba)
     # models: prefix-style admission at snapshot boundaries, preemption

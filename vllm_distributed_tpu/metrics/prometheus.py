@@ -173,6 +173,9 @@ LABELED_METRICS = {
     # DP balancer + routing tier (engine/dp_client.py, engine/router.py).
     "vdt:dp_replica_load": ("replica", ),
     "vdt:router_prefix_index_entries": ("replica", ),
+    # Disaggregated serving tier (engine/disagg.py).
+    "vdt:disagg_fallbacks_total": ("reason", ),
+    "vdt:pool_occupancy": ("pool", ),
     # Weighted admission shedding (entrypoints/openai/admission.py).
     "vdt:requests_shed_by_class_total": ("class", ),
 }
@@ -226,6 +229,46 @@ def _render_router(router: dict) -> list[str]:
                   f"# TYPE {name} gauge"]
         lines += [f'{name}{{replica="{i}"}} {int(n)}'
                   for i, n in enumerate(entries)]
+    return lines
+
+
+def _render_disagg(disagg: dict) -> list[str]:
+    """Disagg serving-tier families from the DisaggCoordinator (one
+    coordinator owns every handoff, so values are exact)."""
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    name = "vdt:disagg_handoffs_total"
+    lines = [f"# HELP {name} Prefill->decode handoffs admitted by the "
+             "disagg coordinator",
+             f"# TYPE {name} counter",
+             f"{name} {int(disagg.get('handoffs', 0))}"]
+    name = "vdt:disagg_fallbacks_total"
+    fallbacks = disagg.get("fallbacks") or {}
+    lines += [f"# HELP {name} Disagg recovery-ladder fallbacks by "
+              "reason (local_reprefill = failed/stalled pull recomputed "
+              "on the decode home, pull_retry = bounded re-pull, "
+              "prefill_death / decode_death = replica died mid-stage "
+              "and the request re-admitted, pool_down = whole pool out "
+              "of rotation, no_pull_coords = prompt under one page)",
+              f"# TYPE {name} counter"]
+    lines += [f'{name}{{reason="{r}"}} {int(n)}'
+              for r, n in sorted(fallbacks.items())]
+    h = disagg.get("handoff_seconds")
+    if isinstance(h, dict):
+        name = "vdt:disagg_handoff_seconds"
+        lines += render_histogram_lines(
+            name, "Wall seconds from handoff interception to the decode "
+            "home's first token (routing + KV pull or its fallback + "
+            "requeue + first decode step)",
+            h.get("buckets", ()), h.get("counts", ()),
+            h.get("sum", 0.0), h.get("count", 0))
+    occ = disagg.get("pool_occupancy") or {}
+    if occ:
+        name = "vdt:pool_occupancy"
+        lines += [f"# HELP {name} Live requests owned by each disagg "
+                  "pool (prefill/decode)",
+                  f"# TYPE {name} gauge"]
+        lines += [f'{name}{{pool="{p}"}} {int(n)}'
+                  for p, n in sorted(occ.items())]
     return lines
 
 
@@ -509,4 +552,7 @@ def render_metrics(stats: dict) -> str:
     router = stats.get("router")
     if isinstance(router, dict):
         lines += _render_router(router)
+    disagg = stats.get("disagg")
+    if isinstance(disagg, dict):
+        lines += _render_disagg(disagg)
     return "\n".join(lines) + "\n"

@@ -65,6 +65,13 @@ but never fired by production code):
   degrades to re-requesting the raw-precision payload (counted in
   ``vdt:qcomm_fallbacks_total``), proving the recovery ladder holds
   under the quantized wire format.
+* ``disagg.handoff_stall`` — the disagg coordinator hands the decode
+  home broken pull coordinates (the producer will reject every pull
+  for them), so the handoff's KV pull can never complete and the
+  decode home is driven through the scheduler's full recovery ladder:
+  bounded pull retries, then local re-prefill recompute (counted in
+  ``vdt:disagg_fallbacks_total{reason="local_reprefill"}``). Greedy
+  output must stay token-identical throughout.
 """
 
 import threading
@@ -89,6 +96,7 @@ FAULT_POINTS = (
     "router.stale_stats",
     "ssm.restore_corrupt",
     "qcomm.scale_corrupt",
+    "disagg.handoff_stall",
 )
 
 

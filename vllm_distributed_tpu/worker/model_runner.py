@@ -163,6 +163,13 @@ class TPUModelRunner:
         self.token_buckets = make_buckets(
             16, sched_cfg.max_num_batched_tokens)
         self.req_buckets = make_buckets(8, self.max_num_reqs)
+        # Disagg pool role ("prefill" | "decode" | None): prunes the
+        # precompile lattice per role — a prefill replica skips the
+        # fused-block/multi-step decode variants, a decode replica
+        # skips the prompt-logprob graphs (plp requests are exempt from
+        # handoff and serve on the prefill pool); the decode pool's
+        # token ladder itself is already capped by its pool config.
+        self.pool_role = config.kv_transfer_config.pool_role
 
         # Step-phase profiler share: host-side input prep per dispatch
         # (merged into vdt:step_phase_seconds{phase="prepare_inputs"} by
@@ -814,8 +821,13 @@ class TPUModelRunner:
         if self._block_fusion_memo is None:
             if self.model is None:
                 return False  # don't memoize before the model exists
+            # Disagg prefill-pool replicas never see a pure-decode wave
+            # (their requests finish at the first sampled token), so
+            # fusion neither warms its graph variants nor dispatches —
+            # the per-role precompile-lattice prune (engine/disagg.py).
             self._block_fusion_memo = bool(
                 getattr(self.model.cfg, "block_fusion", False)
+                and self.pool_role != "prefill"
                 and self._use_unified()
                 and self.tknp_size == 1
                 and resolve_attention_backend() == "pallas")
@@ -2361,7 +2373,12 @@ class TPUModelRunner:
     def _precompile_plp(self, mesh) -> int:
         """Warm the prompt-logprob graphs — one per P bucket (the row
         gather runs outside the jit, so the lattice is additive with
-        the forward shapes)."""
+        the forward shapes). Disagg decode-pool replicas skip the
+        family: prompt_logprobs requests are exempt from handoff and
+        serve monolithically on the prefill pool (a pool_down degraded
+        placement compiles lazily with a recompile warning)."""
+        if self.pool_role == "decode":
+            return 0
         n = 0
         for P_ in self.token_buckets:
             sel = self._gather_sample_rows(

@@ -64,13 +64,19 @@ class TPUWorker:
             except Exception as e:  # pragma: no cover - jax internals
                 logger.warning("compile cache unavailable: %s", e)
         pc = self.config.parallel_config
-        if pc.data_parallel_mode == "engine" and pc.data_parallel_rank:
+        if pc.data_parallel_mode == "engine" and (
+                pc.data_parallel_rank
+                or pc.data_parallel_device_offset is not None):
             # Engine-replicated DP: each replica owns a disjoint
             # contiguous device slice (requires all replica devices
             # visible in-process — single host; multi-host DP carves by
-            # process instead).
+            # process instead). The disagg pool planner sets an explicit
+            # offset when pools have asymmetric TP degrees (replica
+            # world sizes differ, so rank * world_size is wrong).
             per = pc.world_size
-            start = pc.data_parallel_rank * per
+            start = (pc.data_parallel_device_offset
+                     if pc.data_parallel_device_offset is not None
+                     else pc.data_parallel_rank * per)
             if start + per > len(devices):
                 raise ValueError(
                     f"DP rank {pc.data_parallel_rank} needs devices "
