@@ -449,6 +449,234 @@ def _routing_leg(config, record) -> None:
             os.environ["VDT_ROUTER"] = saved
 
 
+def _qos_leg(config, record) -> None:
+    """Per-tenant QoS leg (ISSUE 13 acceptance): a two-tenant
+    adversarial flood on ONE engine — an interactive tenant's short
+    chat turns against a flood tenant's long-prompt greedy-max_tokens
+    requests — QoS on vs ``VDT_QOS=0`` on byte-identical traffic.
+    Reports the interactive tenant's p50/p99 inter-token latency
+    (user-perceived: each back-to-back turn's queue wait counts as its
+    first gap), per-tenant goodput against a fixed worst-stall target,
+    and quota preemption counts per leg:
+    the interactive p99 delta is the execution-isolation win the
+    scheduler's DRR + quota machinery buys (fair placement and fair
+    admission cannot provide it — this is in-scheduler starvation),
+    directly comparable to ``vdt:tenant_goodput_frac`` in
+    production."""
+    import gc
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+
+    rng = np.random.default_rng(13)
+    # Rolling flood pipeline: a fresh 1408-token prompt (11 full budget
+    # chunks at 128 tokens/step, then 4 decode tokens) is queued the
+    # moment one finishes, 2 in flight, and floods keep coming until
+    # every chat turn is done — so there is ALWAYS a flood
+    # chunk-prefilling around the chat turns, the positional-starvation
+    # shape the pre-QoS scheduler cannot defend (a budget-exhausting
+    # chunk walls off every request behind it in the running list, and
+    # the waiting loop never runs).  Prompts are unique per flood so
+    # prefix caching cannot deduplicate the prefill work.
+    flood_len, max_floods, flood_cap = 1408, 2, 60
+    sessions, turns = 4, 4
+    chat_prompts = {(s, t): [int(x) for x in
+                             rng.integers(10, 5000, size=24)]
+                    for s in range(sessions) for t in range(turns)}
+    flood_sp = SamplingParams(temperature=0.0, max_tokens=4,
+                              ignore_eos=True)
+    chat_sp = SamplingParams(temperature=0.0, max_tokens=16,
+                             ignore_eos=True)
+    # Per-tenant goodput target: a chat turn is GOOD when no
+    # inter-token stall exceeds this bound (computed bench-side for
+    # BOTH legs so the off leg — whose metric plane is off by
+    # definition — compares). Gap streams are USER-PERCEIVED: each
+    # turn's first gap runs from add_request to its first token —
+    # sessions issue turns back to back, so that queue wait IS the
+    # inter-token stall the session sees, and it is exactly where the
+    # pre-QoS scheduler hurts (a chunking flood walls the budget so
+    # the waiting loop never runs; once admitted, arrival order
+    # protects a decode in BOTH modes). ~2-3x a healthy CPU-smoke
+    # step under a 128-token flood chunk.
+    tpot_target_s = 1.0
+    leg_wall_cap_s = 150.0
+    saved = os.environ.get("VDT_QOS")
+    try:
+        for leg, flag in (("on", "1"), ("off", "0")):
+            # Fresh identically-seeded stream per leg: flood/warmup
+            # draw counts depend on leg timing, so a shared stream
+            # would hand the second leg different prompt bytes.
+            rng = np.random.default_rng(131)
+            os.environ["VDT_QOS"] = flag
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                # Pool sized BELOW the rolling steady footprint
+                # (one full 89-page flood + the next flood's first
+                # chunks + four chat turns want ~110 pages) so
+                # allocation fails under pressure and preemption must
+                # run: QoS on quota-evicts the flood tenant (one flood
+                # is alone over the soft 50% quota of 52 pages; chat
+                # far under), QoS off capacity-evicts the newest
+                # request — routinely an interactive chat turn.
+                cache_config=CacheConfig(block_size=16,
+                                         num_gpu_blocks_override=104),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=128, max_num_seqs=16,
+                    max_model_len=2048, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            engine = LLMEngine(cfg, load_tokenizer=False)
+
+            flood_idx = 0
+            floods_alive: set[str] = set()
+            done_turns = 0
+
+            def add_flood():
+                nonlocal flood_idx
+                if flood_idx >= flood_cap or done_turns >= sessions * turns:
+                    return
+                rid = f"qos{leg}-flood-{flood_idx}"
+                prompt = [int(x) for x in
+                          rng.integers(10, 5000, size=flood_len)]
+                engine.add_request(rid, prompt, flood_sp, priority=1,
+                                   tenant="flood")
+                floods_alive.add(rid)
+                flood_idx += 1
+
+            # Warmup wave (unmeasured): the SAME mixed composition as
+            # the measured phase — 2 floods chunk-prefilling around 4
+            # chat turns — so every graph bucket the measurement hits
+            # (chunk + decode-batch mixes, preemption resumes) is
+            # compiled here and first-compile stalls don't pollute p99.
+            warm_alive = set()
+            for _ in range(max_floods):
+                add_flood()
+            for s in range(sessions):
+                rid = f"warm{leg}chat{s}"
+                engine.add_request(rid,
+                                   [int(x) for x in
+                                    rng.integers(10, 5000, size=24)],
+                                   chat_sp, priority=0, tenant="chat")
+                warm_alive.add(rid)
+            warm_alive |= floods_alive
+            warm_deadline = time.perf_counter() + leg_wall_cap_s
+            while (engine.has_unfinished_requests()
+                   and time.perf_counter() < warm_deadline):
+                for out in engine.step():
+                    if out.finished:
+                        warm_alive.discard(out.request_id)
+                        floods_alive.discard(out.request_id)
+            if warm_alive:  # wall-capped: nothing warm may leak into
+                engine.abort_request(sorted(warm_alive))  # measurement
+            floods_alive.clear()
+            flood_idx = 0  # rids are namespaced per leg phase below
+
+            def add_flood():  # noqa: F811 - measured-phase ids
+                nonlocal flood_idx
+                if flood_idx >= flood_cap or done_turns >= sessions * turns:
+                    return
+                rid = f"qos{leg}-mflood-{flood_idx}"
+                prompt = [int(x) for x in
+                          rng.integers(10, 5000, size=flood_len)]
+                engine.add_request(rid, prompt, flood_sp, priority=1,
+                                   tenant="flood")
+                floods_alive.add(rid)
+                flood_idx += 1
+
+            # Floods first — chat turns always queue BEHIND a flood.
+            for _ in range(max_floods):
+                add_flood()
+            add_times: dict[str, float] = {}
+            for s in range(sessions):
+                rid = f"qos{leg}-chat-{s}-0"
+                add_times[rid] = time.perf_counter()
+                engine.add_request(rid, list(chat_prompts[(s, 0)]),
+                                   chat_sp, priority=0, tenant="chat")
+            token_times: dict[str, list[float]] = {}
+            deadline = time.perf_counter() + leg_wall_cap_s
+            for _ in range(20000):
+                if (done_turns >= sessions * turns
+                        or time.perf_counter() > deadline):
+                    break
+                for out in engine.step():
+                    rid = out.request_id
+                    if "-chat-" in rid:
+                        n = len(out.outputs[0].token_ids)
+                        ts = token_times.setdefault(rid, [])
+                        ts.extend([time.perf_counter()] * max(
+                            n - len(ts), 0))
+                    if not out.finished:
+                        continue
+                    if rid in floods_alive:
+                        floods_alive.discard(rid)
+                        add_flood()  # keep the interference rolling
+                    elif "-chat-" in rid:
+                        done_turns += 1
+                        s, t = map(int, rid.rsplit("-", 2)[-2:])
+                        if t + 1 < turns:
+                            nxt = f"qos{leg}-chat-{s}-{t + 1}"
+                            add_times[nxt] = time.perf_counter()
+                            engine.add_request(
+                                nxt, list(chat_prompts[(s, t + 1)]),
+                                chat_sp, priority=0, tenant="chat")
+            if floods_alive:
+                engine.abort_request(sorted(floods_alive))
+            tpots: list[float] = []
+            per_turn_worst: dict[str, float] = {}
+            for req, ts in token_times.items():
+                # First gap: add_request -> first token (the queue
+                # wait the session experiences between turns).
+                gaps = [ts[0] - add_times[req]]
+                gaps += [b - a for a, b in zip(ts, ts[1:])]
+                gaps = [g for g in gaps if g > 0]  # same-step batches
+                if gaps:
+                    tpots += gaps
+                    per_turn_worst[req] = max(gaps)
+            tpots.sort()
+            if tpots:
+                record[f"qos_{leg}_chat_tpot_p50_ms"] = round(
+                    1e3 * tpots[len(tpots) // 2], 1)
+                record[f"qos_{leg}_chat_tpot_p99_ms"] = round(
+                    1e3 * tpots[min(int(len(tpots) * 0.99),
+                                    len(tpots) - 1)], 1)
+            if add_times:
+                # Denominator = every ISSUED turn: a turn that never
+                # produced a token inside the wall cap (total
+                # starvation, the worst outcome) counts as not-good.
+                good = sum(1 for v in per_turn_worst.values()
+                           if v <= tpot_target_s)
+                record[f"qos_{leg}_chat_goodput_frac"] = round(
+                    good / len(add_times), 3)
+            # Wall-capped legs report partial turns — the off leg may
+            # never finish the chat work inside the cap; that IS the
+            # starvation result, so record how far it got.
+            record[f"qos_{leg}_chat_turns_done"] = done_turns
+            stats = engine.get_stats()
+            causes = ((stats.get("kv_cache") or {})
+                      .get("preemption_causes") or {})
+            record[f"qos_{leg}_quota_preemptions"] = int(
+                causes.get("quota", 0))
+            record[f"qos_{leg}_preemptions"] = int(
+                stats.get("num_preemptions", 0))
+            tenants = stats.get("tenants") or {}
+            for t in ("flood", "chat"):
+                if t in tenants:
+                    record[f"qos_{leg}_{t}_granted_tokens"] = int(
+                        tenants[t]["granted_tokens"])
+                    record[f"qos_{leg}_{t}_tenant_preemptions"] = int(
+                        tenants[t]["preemptions"])
+            engine.shutdown()
+            del engine
+            gc.collect()
+    finally:
+        if saved is None:
+            os.environ.pop("VDT_QOS", None)
+        else:
+            os.environ["VDT_QOS"] = saved
+
+
 def _disagg_leg(config, record) -> None:
     """Disagg serving-tier leg (ROADMAP item 2 acceptance): a mixed
     long-prompt/chat workload on the SAME total device budget (a
@@ -1589,6 +1817,11 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        # QoS leg: two-tenant adversarial flood, VDT_QOS on vs off.
+        try:
+            _qos_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["qos_leg_error"] = f"{type(e).__name__}: {e}"
         # Disagg leg: two-pool fleet vs monolithic on a mixed
         # long-prompt/chat workload + both recovery drills.
         try:
@@ -1671,6 +1904,10 @@ def main() -> None:
             _routing_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["routing_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _qos_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["qos_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _disagg_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

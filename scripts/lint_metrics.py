@@ -25,6 +25,14 @@ README row naming a metric nothing emits (orphan), a labeled family
 whose README row is missing its label set, or a README label set the
 registry does not declare.
 
+* **dynamic labels need a cardinality note** — label names whose
+  values come from TRAFFIC rather than a fixed enum (today:
+  ``tenant``) are a series-explosion hazard; every registry family
+  carrying one must document, on its README table row, the mechanism
+  that bounds the label space (for ``tenant``: the
+  ``VDT_QOS_MAX_TRACKED_TENANTS`` hash/bucket cap). A row that names
+  such a family without naming its bound fails.
+
 Usage::
 
     python scripts/lint_metrics.py [--package DIR] [--readme FILE]
@@ -49,6 +57,11 @@ LABEL_NAME_RE = re.compile(r'"([a-z_]+)"')
 # Modules whose registries/render helpers always emit HELP/TYPE for the
 # names they carry.
 EXPOSITION_MODULES = ("metrics/prometheus.py", "metrics/stats.py")
+
+# Label names whose value space comes from traffic (not a fixed enum):
+# a family carrying one must document its cardinality bound — the
+# named token must appear on the metric's README table row.
+DYNAMIC_LABELS = {"tenant": "VDT_QOS_MAX_TRACKED_TENANTS"}
 
 
 def collect(package: Path) -> tuple[set, set]:
@@ -154,6 +167,21 @@ def main(argv: list[str]) -> int:
                     f"{name}: README documents labels {{{got}}} but "
                     f"the LABELED_METRICS registry declares "
                     f"{sorted(declared) if declared else 'none'}")
+    # Dynamic (traffic-valued) labels: the family's README table row
+    # must name the mechanism bounding the label space.
+    readme_lines = args.readme.read_text(encoding="utf-8").splitlines()
+    for name in sorted(registry):
+        bounds = sorted({DYNAMIC_LABELS[lb] for lb in registry[name]
+                         if lb in DYNAMIC_LABELS})
+        if not bounds or name not in documented:
+            continue
+        rows = [ln for ln in readme_lines if f"`{name}{{" in ln]
+        for bound in bounds:
+            if rows and not any(bound in ln for ln in rows):
+                problems.append(
+                    f"{name}: carries a dynamic label but its README "
+                    f"row has no cardinality note (mention `{bound}`, "
+                    f"the bucketing bound, on the row)")
     if not problems:
         return 0
     print("vdt: metric documentation drift:", file=sys.stderr)

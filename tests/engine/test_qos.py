@@ -1,0 +1,97 @@
+"""Engine-level per-tenant QoS gates.
+
+Acceptance contract (ISSUE 13): ``VDT_QOS=0`` (the default) must stay
+byte-identical to pre-QoS scheduling — the scheduler constructs no QoS
+state and no ``tenants`` entry reaches the stats RPC — and turning QoS
+ON must reorder only the *schedule*, never the *tokens*: greedy
+outputs stay token-identical per request while the vdt:tenant_*
+accounting lights up end to end (scheduler -> get_stats -> /metrics
+render). The scheduler-level drills (DRR splits, quota preemption,
+flood step-gaps, quota_thrash hysteresis) live in
+tests/core/test_sched_qos.py where they run without a model."""
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_qos")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def make_engine(path) -> LLMEngine:
+    return LLMEngine(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=128, max_model_len=128,
+        max_num_batched_tokens=32, max_num_seqs=8,
+        skip_tokenizer_init=True).create_engine_config())
+
+
+# Two tenants, adversarially shaped: a flood tenant with long prompts
+# and greedy max_tokens against short interactive turns.
+WORK = [
+    ("flood-0", "flood", [3 + (i % 90) for i in range(70)], 12),
+    ("chat-0", "chat", [5, 9, 2, 44], 8),
+    ("flood-1", "flood", [7 + (i % 80) for i in range(60)], 12),
+    ("chat-1", "chat", [91, 17, 3], 8),
+    ("anon-0", None, [12, 13, 14, 15, 16], 6),
+]
+
+
+def run(engine):
+    for req_id, tenant, prompt, max_tokens in WORK:
+        engine.add_request(
+            req_id, list(prompt),
+            SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                           ignore_eos=True),
+            tenant=tenant)
+    done = {}
+    for _ in range(500):
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    return {k: list(v.outputs[0].token_ids) for k, v in done.items()}
+
+
+def test_qos_off_default_and_on_token_parity(checkpoint, monkeypatch):
+    # OFF (default env): no QoS state anywhere in the stats plane.
+    engine = make_engine(checkpoint)
+    baseline = run(engine)
+    stats = engine.get_stats()
+    assert "tenants" not in stats
+    engine.shutdown()
+
+    # ON: same traffic, token-identical greedy outputs, and the
+    # per-tenant accounting reaches get_stats and the /metrics render.
+    monkeypatch.setenv("VDT_QOS", "1")
+    engine = make_engine(checkpoint)
+    routed = run(engine)
+    assert routed == baseline
+    tenants = engine.get_stats()["tenants"]
+    total_prompt = {t: 0 for t in ("flood", "chat", "_anon")}
+    for _, tenant, prompt, max_tokens in WORK:
+        total_prompt[tenant or "_anon"] += len(prompt) + max_tokens - 1
+    for key, want in total_prompt.items():
+        assert tenants[key]["granted_tokens"] >= want, (key, tenants)
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics(engine.get_stats())
+    assert 'vdt:tenant_granted_tokens_total{tenant="flood"}' in text
+    assert 'vdt:tenant_kv_blocks{tenant="chat"}' in text
+    engine.shutdown()

@@ -121,3 +121,38 @@ def test_clean_labeled_tree_passes(tmp_path):
         prometheus=_LABELED_PROM)
     res = _run("--package", str(pkg), "--readme", str(readme))
     assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# Dynamic (traffic-valued) labels: the {tenant} family must carry a
+# bounded-cardinality note naming VDT_QOS_MAX_TRACKED_TENANTS.
+# ---------------------------------------------------------------------------
+_TENANT_PROM = (
+    '# HELP vdt:tenant_x_total x\n'
+    '# TYPE vdt:tenant_x_total counter\n'
+    'LABELED_METRICS = {\n'
+    '    "vdt:tenant_x_total": ("tenant", ),\n'
+    '}\n')
+
+
+def test_dynamic_label_without_cardinality_note_is_caught(tmp_path):
+    """A {tenant} family documented with its label set but WITHOUT the
+    bucketing-bound note on the row: series-explosion hazard."""
+    pkg, readme = _tree(
+        tmp_path, "x = 1\n",
+        "| `vdt:tenant_x_total{tenant}` | counter | per tenant |\n",
+        prometheus=_TENANT_PROM)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "cardinality note" in res.stderr
+    assert "VDT_QOS_MAX_TRACKED_TENANTS" in res.stderr
+
+
+def test_dynamic_label_with_cardinality_note_passes(tmp_path):
+    pkg, readme = _tree(
+        tmp_path, "x = 1\n",
+        "| `vdt:tenant_x_total{tenant}` | counter | per tenant "
+        "(bounded by `VDT_QOS_MAX_TRACKED_TENANTS`) |\n",
+        prometheus=_TENANT_PROM)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr

@@ -1,0 +1,350 @@
+"""Per-tenant QoS state for the scheduler (``VDT_QOS``).
+
+Execution-time fairness — the third rung of the multi-tenant ladder
+after fair *placement* (the routing tier's per-class weighted shedding)
+and fair *admission* (the API gate's watermarks): once requests are in
+the scheduler, a single tenant with long prompts and greedy
+``max_tokens`` could previously monopolize the token budget, the KV
+page pool and the batch slots, moving every other tenant's p99 TPOT.
+
+Three mechanisms, all scoped to this module so the scheduler's hooks
+stay one-line ``if self.qos is not None`` guards:
+
+* **Weighted fair queueing** via deficit round robin on *granted
+  tokens*: each scheduler step replenishes every active tenant's
+  deficit counter in proportion to its weight (``VDT_QOS_WEIGHTS``,
+  default equal; the routing tier's interactive/best_effort classes map
+  through the ``interactive``/``best_effort`` spec keys), every granted
+  token is charged against the counter, and chunked-prefill grants clip
+  to the remaining deficit while another tenant competes for prefill
+  bandwidth. Decode grants are never clipped (stalling a running decode
+  moves everyone's TPOT) — instead each prefill grant leaves headroom
+  for the other tenants' running decodes (``_decode_need``), so a flood
+  tenant's prompt chunks can no longer starve an interactive tenant's
+  decode tokens. Work-conserving: with no competitor the clips are
+  waived and a sole tenant still gets the whole budget; unused deficit
+  carries over (bounded by ``DEFICIT_CARRY_STEPS`` step budgets).
+
+* **Soft KV page quotas** (``VDT_QOS_KV_QUOTA_FRAC`` of the pool per
+  tenant): free until the pool pressures, then (a) a tenant over its
+  quota waits at admission while an under-quota tenant has waiting
+  work, and (b) when pages run out, preemption evicts the
+  most-over-quota tenant's lowest-priority request first (preemption
+  cause ``quota``, riding the existing preemption machinery — SSM state
+  parks, tombstoned pages and cause attribution all apply). A
+  per-tenant cooldown (``QUOTA_COOLDOWN_STEPS``) is the hysteresis: a
+  tenant oscillating around its quota falls back to ordinary capacity
+  preemption between quota evictions instead of livelocking the
+  scheduler in evict/resume cycles (drill: fault point
+  ``sched.quota_thrash``).
+
+* **Per-tenant accounting** for the ``vdt:tenant_*`` metric families.
+  Label cardinality is bounded by ``VDT_QOS_MAX_TRACKED_TENANTS``:
+  tenants beyond the cap hash into a fixed set of overflow buckets
+  (``~<n>``), tenantless requests share the ``_anon`` bucket.
+
+``VDT_QOS=0`` (the default) constructs no state at all — the scheduler
+keeps its pre-QoS behavior byte-identical.
+"""
+
+import zlib
+from typing import Iterable, Optional
+
+from vllm_distributed_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# Tenantless requests share one deficit/quota bucket.
+DEFAULT_KEY = "_anon"
+# Tenants past VDT_QOS_MAX_TRACKED_TENANTS hash into this many shared
+# overflow buckets, bounding metric-label cardinality at cap + this.
+OVERFLOW_BUCKETS = 8
+# Deficit bounds, in step budgets: unused credit carries over up to
+# this many steps' worth; work-conserving over-grants may run the
+# counter the same amount into debt before it saturates.
+DEFICIT_CARRY_STEPS = 4
+# Pool usage at/above which the soft quota gates *admission* of
+# over-quota tenants (eviction-side quota enforcement needs no
+# threshold — it only ever runs on an allocation failure).
+QUOTA_PRESSURE = 0.9
+# Quota-preemption hysteresis: a tenant is not quota-victimized again
+# within this many scheduler steps of its last quota eviction — the
+# gap falls back to ordinary capacity preemption, so an oscillating
+# tenant cannot livelock the scheduler in evict/resume cycles.
+QUOTA_COOLDOWN_STEPS = 8
+
+
+def parse_weights(spec: str) -> dict[str, float]:
+    """``VDT_QOS_WEIGHTS`` parser: comma list of ``name:weight``.
+    ``name`` is a tenant id, or one of the class keys ``interactive`` /
+    ``best_effort`` (PR 7's priority classes) / ``default``. Malformed
+    or non-positive entries are dropped with a log, never raised — a
+    bad operator spec must not take the scheduler down."""
+    out: dict[str, float] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        name, sep, raw = entry.rpartition(":")
+        try:
+            weight = float(raw) if sep else float("nan")
+        except ValueError:
+            weight = float("nan")
+        if not sep or not name.strip() or not weight > 0:
+            logger.warning("ignoring malformed VDT_QOS_WEIGHTS entry %r",
+                           entry)
+            continue
+        out[name.strip()] = weight
+    return out
+
+
+def bucket_tenant(tenant: Optional[str], tracked: set,
+                  max_tracked: int) -> str:
+    """Bounded-cardinality tenant key: the tenant id itself while the
+    tracked set has room (first come, first tracked), a stable hash
+    bucket ``~<n>`` past the cap, ``_anon`` for tenantless requests.
+    Shared by the scheduler's QosState and the front end's per-tenant
+    goodput accounting so both label spaces stay bounded and agree."""
+    if not tenant:
+        return DEFAULT_KEY
+    if tenant in tracked:
+        return tenant
+    if len(tracked) < max_tracked:
+        tracked.add(tenant)
+        return tenant
+    return "~%d" % (zlib.crc32(tenant.encode("utf-8", "replace"))
+                    % OVERFLOW_BUCKETS)
+
+
+class QosState:
+    """Per-tenant DRR deficits, soft KV quotas and accounting. One
+    instance per scheduler; every method is called with the scheduler's
+    own thread discipline (the stats RPC reads GIL-atomic dicts)."""
+
+    def __init__(self, token_budget: int, total_blocks: int, *,
+                 weights_spec: Optional[str] = None,
+                 quota_frac: Optional[float] = None,
+                 max_tracked: Optional[int] = None) -> None:
+        from vllm_distributed_tpu import envs
+        if weights_spec is None:
+            weights_spec = envs.VDT_QOS_WEIGHTS
+        if quota_frac is None:
+            quota_frac = envs.VDT_QOS_KV_QUOTA_FRAC
+        if max_tracked is None:
+            max_tracked = envs.VDT_QOS_MAX_TRACKED_TENANTS
+        self.token_budget = max(1, int(token_budget))
+        self.total_blocks = int(total_blocks)
+        self.weights = parse_weights(weights_spec)
+        # Soft per-tenant page quota; 0 disables quota enforcement
+        # (DRR still applies). frac == 1 is a vacuous quota and is
+        # treated as disabled too.
+        self.quota_blocks = (int(quota_frac * total_blocks)
+                             if 0 < quota_frac < 1 else 0)
+        self.max_tracked = max(1, int(max_tracked))
+
+        self._tracked: set[str] = set()
+        self._bucket_weight: dict[str, float] = {}
+        self.deficit: dict[str, float] = {}
+        # Cumulative accounting (vdt:tenant_* families).
+        self.granted_tokens: dict[str, int] = {}
+        self.preemptions: dict[str, int] = {}
+        # Per-step working state (begin_step).
+        self._competing: set[str] = set()
+        self._decode_need: dict[str, int] = {}
+        self.held: dict[str, int] = {}
+        # key -> num_scheduled_steps of its last quota eviction.
+        self._last_quota_preempt: dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def weight_of(self, key: str, priority: int) -> float:
+        """Explicit tenant entry first, then the request's priority
+        class (interactive <= 0 < best_effort), then ``default``."""
+        w = self.weights.get(key)
+        if w is None:
+            cls = "best_effort" if priority > 0 else "interactive"
+            w = self.weights.get(cls, self.weights.get("default", 1.0))
+        return w
+
+    def key_of(self, request) -> str:
+        key = bucket_tenant(request.tenant, self._tracked,
+                            self.max_tracked)
+        # Memo the bucket's weight from the traffic actually seen (a
+        # bucket mixing classes takes the latest request's class).
+        self._bucket_weight[key] = self.weight_of(key, request.priority)
+        return key
+
+    # ------------------------------------------------------------------
+    # Per-step DRR bookkeeping
+    # ------------------------------------------------------------------
+    def begin_step(self, waiting: Iterable, running: Iterable,
+                   held_by_tenant: Optional[dict[str, int]]) -> None:
+        """Replenish deficits for every tenant with live work, snapshot
+        who competes for prefill bandwidth and how many decode tokens
+        each tenant's running requests will want this step."""
+        active: set[str] = set()
+        competing: set[str] = set()
+        decode_need: dict[str, int] = {}
+        for r in waiting:
+            k = self.key_of(r)
+            active.add(k)
+            competing.add(k)
+        for r in running:
+            k = self.key_of(r)
+            active.add(k)
+            if r.num_computed_tokens < r.num_prompt_tokens:
+                competing.add(k)
+            else:
+                decode_need[k] = decode_need.get(k, 0) + 1
+        self._competing = competing
+        self._decode_need = decode_need
+        self.held = held_by_tenant or {}
+        if not active:
+            return
+        total_w = sum(self._bucket_weight.get(k, 1.0) for k in active)
+        cap = DEFICIT_CARRY_STEPS * self.token_budget
+        for k in active:
+            quantum = (self.token_budget
+                       * self._bucket_weight.get(k, 1.0) / total_w)
+            self.deficit[k] = min(self.deficit.get(k, 0.0) + quantum, cap)
+
+    def charge(self, key: str, tokens: int, decode: bool = False) -> None:
+        """Every granted token draws down the tenant's deficit (floored
+        so work-conserving over-grants can't build unbounded debt)."""
+        self.granted_tokens[key] = (self.granted_tokens.get(key, 0)
+                                    + int(tokens))
+        floor = -DEFICIT_CARRY_STEPS * self.token_budget
+        self.deficit[key] = max(self.deficit.get(key, 0.0) - tokens, floor)
+        if decode and self._decode_need.get(key, 0) > 0:
+            # This tenant's decode headroom was consumed; later prefill
+            # grants this step no longer reserve for it.
+            self._decode_need[key] -= 1
+
+    def prefill_allowance(self, key: str, want: int,
+                          budget_left: int) -> int:
+        """Clip for a RUNNING chunked-prefill grant. Two caps, both
+        waived when nobody needs the headroom: the DRR deficit while
+        another tenant with credit competes for prefill bandwidth, and
+        a reservation of one decode token per OTHER tenant's running
+        decode request still unserved this step (positional budget
+        exhaustion must not starve decodes sitting later in the
+        running list)."""
+        allowed = want
+        if any(k != key and self.deficit.get(k, 0.0) > 0.0
+               for k in self._competing):
+            allowed = min(allowed, max(0, int(self.deficit.get(key, 0.0))))
+        reserve = sum(n for k, n in self._decode_need.items() if k != key)
+        if reserve > 0:
+            allowed = min(allowed, max(0, budget_left - reserve))
+        return allowed
+
+    def admission_allowance(self, key: str, want: int) -> int:
+        """Clip for a WAITING-loop (first) chunked-prefill grant. The
+        caller picked the max-deficit tenant, so deficit <= 0 means no
+        waiting tenant holds credit — grant in full (work conserving);
+        otherwise clip to the deficit, never below one token (the
+        selected tenant must make progress)."""
+        d = self.deficit.get(key, 0.0)
+        if d <= 0:
+            return want
+        return max(1, min(want, int(d)))
+
+    def pick_waiting_tenant(self, keys_in_order: list[str],
+                            usage: float) -> str:
+        """The waiting tenant to admit next: largest deficit wins, ties
+        go to the earliest queue position. Under pool pressure
+        (``usage >= QUOTA_PRESSURE``) tenants over their soft KV quota
+        are passed over while an under-quota tenant is waiting."""
+        candidates = keys_in_order
+        if self.quota_blocks > 0 and usage >= QUOTA_PRESSURE:
+            under = [k for k in keys_in_order
+                     if self.held.get(k, 0) <= self.quota_blocks]
+            if under:
+                candidates = under
+        best = candidates[0]
+        for k in candidates[1:]:
+            if self.deficit.get(k, 0.0) > self.deficit.get(best, 0.0):
+                best = k
+        return best
+
+    # ------------------------------------------------------------------
+    # Quota-aware preemption
+    # ------------------------------------------------------------------
+    def quota_victim(self, candidates: list, key_of, step: int):
+        """Among the preemption candidates, the lowest-priority request
+        of the most-over-quota tenant — or None, handing victim choice
+        back to the ordinary capacity policy. Only ever called on an
+        allocation failure, so "soft until pressure" needs no extra
+        threshold here. The ``sched.quota_thrash`` fault point forces
+        an effective quota of zero (every page-holding tenant is
+        over), driving a preemption storm the cooldown hysteresis must
+        bound."""
+        from vllm_distributed_tpu.utils import fault_injection
+        quota = self.quota_blocks
+        if fault_injection.should_fire("sched.quota_thrash"):
+            quota = 0
+        elif quota <= 0:
+            return None
+        groups: dict[str, list] = {}
+        for r in candidates:
+            groups.setdefault(key_of(r), []).append(r)
+        best_key, best_over = None, 0
+        for k in groups:
+            over = self.held.get(k, 0) - quota
+            if over <= 0:
+                continue
+            if step - self._last_quota_preempt.get(k, -(1 << 30)) \
+                    < QUOTA_COOLDOWN_STEPS:
+                continue  # hysteresis: recently quota-evicted
+            if over > best_over:
+                best_key, best_over = k, over
+        if best_key is None:
+            return None
+        self._last_quota_preempt[best_key] = step
+        return max(groups[best_key],
+                   key=lambda r: (r.priority, r.arrival_time))
+
+    def note_preemption(self, key: str) -> None:
+        self.preemptions[key] = self.preemptions.get(key, 0) + 1
+
+    # ------------------------------------------------------------------
+    # Accounting surfaces
+    # ------------------------------------------------------------------
+    def stats(self, held_by_tenant: dict[str, int]) -> dict[str, dict]:
+        """The per-tenant entry of scheduler.get_stats(): flat numeric
+        leaves per tenant so the DP merge can sum them per label."""
+        keys = (set(self.granted_tokens) | set(self.preemptions)
+                | set(held_by_tenant))
+        return {
+            k: {
+                "granted_tokens": int(self.granted_tokens.get(k, 0)),
+                "kv_blocks": int(held_by_tenant.get(k, 0)),
+                "preemptions": int(self.preemptions.get(k, 0)),
+            }
+            for k in keys
+        }
+
+    def debug(self) -> dict:
+        """Live introspection for /debug/requests and the SIGUSR1 dump
+        (GIL-atomic snapshots; safe from the stats thread)."""
+        return {
+            "quota_blocks": self.quota_blocks,
+            "deficit": {k: round(v, 1) for k, v in dict(
+                self.deficit).items()},
+            "weights": dict(self._bucket_weight),
+            "kv_blocks": dict(self.held),
+        }
+
+
+def maybe_qos_state(token_budget: int,
+                    total_blocks: int) -> Optional[QosState]:
+    """The scheduler's one read of ``VDT_QOS`` (at construction — the
+    envs registry re-reads os.environ per access). None = QoS off and
+    every scheduler hook short-circuits."""
+    from vllm_distributed_tpu import envs
+    if not envs.VDT_QOS:
+        return None
+    state = QosState(token_budget, total_blocks)
+    logger.info(
+        "per-tenant QoS on: DRR over %d-token steps, quota %d/%d pages"
+        "%s, tracking <= %d tenants", state.token_budget,
+        state.quota_blocks, state.total_blocks,
+        " (quota off)" if state.quota_blocks == 0 else "",
+        state.max_tracked)
+    return state

@@ -20,6 +20,7 @@ from typing import Iterable, Optional
 from vllm_distributed_tpu.config import EngineConfig
 from vllm_distributed_tpu.core.kv_cache_manager import (
     KVCacheBlocks, KVCacheManager, TokenParallelKVCacheManager)
+from vllm_distributed_tpu.core.sched import qos as qos_mod
 from vllm_distributed_tpu.core.sched.output import (CachedRequestData,
                                                     ModelRunnerOutput,
                                                     NewRequestData,
@@ -296,6 +297,18 @@ class Scheduler:
         self.kv_pull_max_retries = ft_cfg.kv_pull_max_retries
         self.kv_pull_abandon_timeout_s = ft_cfg.kv_pull_abandon_timeout_s
 
+        # Per-tenant QoS (core/sched/qos.py): deficit-round-robin
+        # weighted fair queueing over tenants, soft KV page quotas with
+        # quota-aware preemption, and the per-tenant accounting behind
+        # the vdt:tenant_* families. None when VDT_QOS=0 (the default)
+        # — every hook below is then a short-circuited None check and
+        # scheduling stays byte-identical to the pre-QoS behavior.
+        self.qos = qos_mod.maybe_qos_state(self.max_num_batched_tokens,
+                                           num_blocks)
+        # Per-step {tenant: deque of waiting requests in queue order},
+        # built lazily by _qos_pick_waiting, popped by _waiting_remove.
+        self._qos_waiting_by_tenant: Optional[dict[str, deque]] = None
+
         # Stats for the metrics subsystem.
         self.num_scheduled_steps = 0
         self.num_preemptions = 0
@@ -545,6 +558,15 @@ class Scheduler:
     # The hot loop
     # ------------------------------------------------------------------
     def schedule(self) -> SchedulerOutput:
+        if self.qos is not None:
+            # Replenish per-tenant deficits and snapshot who competes
+            # for prefill bandwidth / holds pages this step. The
+            # per-tenant waiting-queue view is rebuilt lazily by the
+            # first _qos_pick_waiting of the step (the queue may gain
+            # requests between steps).
+            self.qos.begin_step(self.waiting, self.running,
+                                self._qos_held_by_tenant())
+            self._qos_waiting_by_tenant = None
         scheduled_new_reqs: list[NewRequestData] = []
         cached_reqs = CachedRequestData()
         num_scheduled_tokens: dict[str, int] = {}
@@ -619,6 +641,19 @@ class Scheduler:
             num_new_tokens = min(
                 num_new_tokens,
                 self.max_model_len - request.num_computed_tokens)
+            in_prefill = (request.num_computed_tokens
+                          < request.num_prompt_tokens)
+            if (self.qos is not None and num_new_tokens > 0
+                    and in_prefill):
+                # DRR: an ongoing chunked-prefill grant clips to the
+                # tenant's remaining deficit while another tenant with
+                # credit competes, and always leaves one-token headroom
+                # per OTHER tenant's unserved running decode (decode
+                # grants themselves are never clipped — stalling a
+                # running decode moves everyone's TPOT).
+                num_new_tokens = self.qos.prefill_allowance(
+                    self.qos.key_of(request), num_new_tokens,
+                    token_budget)
             if self.state_cache is not None and num_new_tokens > 0:
                 # Land prefill chunks exactly on snapshot boundaries so
                 # the state rows hold boundary state when the copy runs.
@@ -647,7 +682,8 @@ class Scheduler:
                 # that has NOT been scheduled this step (evicting a
                 # scheduled one would leave SchedulerOutput entries
                 # pointing at freed pages).
-                victim = self._select_preemption_victim(req_index, request)
+                victim, cause = self._select_preemption_victim(
+                    req_index, request)
                 if (victim is request
                         and request.request_id in self.in_flight_req_ids):
                     # Async: the only preemptable candidate is this
@@ -657,9 +693,13 @@ class Scheduler:
                     # (an empty queue restores normal preemption).
                     skipped = True
                     break
+                # "self" overrides only the capacity pick (the pre-QoS
+                # no-eligible-victim semantics); a quota eviction keeps
+                # its cause even when the over-quota tenant's lowest-
+                # priority request IS the requester.
                 self._preempt(victim,
                               cause=("self" if victim is request
-                                     else "capacity"))
+                                     and cause == "capacity" else cause))
                 preempted.append(victim)
                 if victim is request:
                     scheduled = False
@@ -674,7 +714,10 @@ class Scheduler:
 
             num_scheduled_tokens[request.request_id] = num_new_tokens
             token_budget -= num_new_tokens
-            if request.num_computed_tokens < request.num_prompt_tokens:
+            if self.qos is not None:
+                self.qos.charge(self.qos.key_of(request), num_new_tokens,
+                                decode=not in_prefill)
+            if in_prefill:
                 # Ongoing chunked prefill (num_computed is pre-advance
                 # here even under async scheduling).
                 prefill_tokens += num_new_tokens
@@ -732,7 +775,8 @@ class Scheduler:
         if not preempted:
             while (self.waiting and token_budget > 0
                    and len(self.running) < self.max_num_seqs):
-                request = self.waiting[0]
+                request = (self.waiting[0] if self.qos is None
+                           else self._qos_pick_waiting())
 
                 if not self._lora_admittable(request):
                     # Admitting would need more distinct adapters than
@@ -750,7 +794,7 @@ class Scheduler:
                         "max_model_len (%d); ignoring.",
                         request.request_id, request.num_prompt_tokens,
                         self.max_model_len)
-                    self.waiting.popleft()
+                    self._waiting_remove(request)
                     request.status = RequestStatus.FINISHED_IGNORED
                     self._free_request(request)
                     continue
@@ -766,7 +810,7 @@ class Scheduler:
                             "budget is %d; ignoring.",
                             request.request_id, n_enc,
                             self.encoder_cache.budget)
-                        self.waiting.popleft()
+                        self._waiting_remove(request)
                         request.status = RequestStatus.FINISHED_IGNORED
                         self._free_request(request)
                         continue
@@ -850,7 +894,7 @@ class Scheduler:
                         delay_caching=True)
                     if new_blocks is None:
                         break  # no room; retry next step
-                    self.waiting.popleft()
+                    self._waiting_remove(request)
                     self._commit_encoder_budget(request)
                     request.status = RequestStatus.WAITING_FOR_REMOTE_KVS
                     self._record_event(request, ev.KV_PULL_WAIT,
@@ -879,6 +923,12 @@ class Scheduler:
                     if not self.enable_chunked_prefill:
                         break  # must fit in one step
                     num_new_tokens = token_budget
+                if self.qos is not None and self.enable_chunked_prefill:
+                    # DRR: the first chunk of the picked (max-deficit)
+                    # tenant clips to its deficit — never below one
+                    # token, so the selected tenant always progresses.
+                    num_new_tokens = self.qos.admission_allowance(
+                        self.qos.key_of(request), num_new_tokens)
                 if (self.state_cache is not None
                         and self.enable_chunked_prefill):
                     num_new_tokens = self.state_cache.clip_grant(
@@ -907,7 +957,7 @@ class Scheduler:
                         self.kv_cache_manager.release_rank(request)
                     break
 
-                self.waiting.popleft()
+                self._waiting_remove(request)
                 self._commit_encoder_budget(request)
                 resumed = request.status == RequestStatus.PREEMPTED
                 request.status = RequestStatus.RUNNING
@@ -944,6 +994,9 @@ class Scheduler:
 
                 num_scheduled_tokens[request.request_id] = num_new_tokens
                 token_budget -= num_new_tokens
+                if self.qos is not None:
+                    self.qos.charge(self.qos.key_of(request),
+                                    num_new_tokens)
                 if self.state_cache is not None:
                     directive = self.state_cache.maybe_save(
                         request, num_computed_tokens + num_new_tokens)
@@ -1085,12 +1138,18 @@ class Scheduler:
         logger.debug("request %s -> token-parallel rank %d",
                      request.request_id, request.tknp_rank)
 
-    def _select_preemption_victim(self, req_index: int,
-                                  request: Request) -> Request:
+    def _select_preemption_victim(
+            self, req_index: int,
+            request: Request) -> tuple[Request, str]:
         """Pick a victim among requests not yet scheduled this step
-        (self.running[req_index:]). Under the priority policy the
-        lowest-priority *unscheduled* request is chosen — a request already
-        granted tokens this step is never evicted mid-step.
+        (self.running[req_index:]) and the preemption cause it will be
+        attributed. Under the priority policy the lowest-priority
+        *unscheduled* request is chosen — a request already granted
+        tokens this step is never evicted mid-step. With QoS on, the
+        quota policy is consulted first: the most-over-quota tenant's
+        lowest-priority request goes before any in-quota victim
+        (cause "quota"; cooldown hysteresis inside quota_victim keeps
+        an oscillating tenant from livelocking in evict/resume cycles).
 
         Token parallelism: only same-rank victims free pages in the
         exhausted rank's pool partition, so other ranks' requests are
@@ -1099,16 +1158,75 @@ class Scheduler:
         candidates = [r for r in self.running[req_index:]
                       if r.request_id not in self.in_flight_req_ids]
         if not candidates:
-            return request
+            return request, "self"
         if self.tknp_size > 1:
             candidates = [r for r in candidates
                           if r.tknp_rank == request.tknp_rank]
             if not candidates:
-                return request
+                return request, "self"
+        if self.qos is not None:
+            victim = self.qos.quota_victim(candidates, self.qos.key_of,
+                                           self.num_scheduled_steps)
+            if victim is not None:
+                return victim, "quota"
         if self.policy == "priority":
             return max(candidates,
-                       key=lambda r: (r.priority, r.arrival_time))
-        return candidates[-1]
+                       key=lambda r: (r.priority, r.arrival_time)), \
+                "capacity"
+        return candidates[-1], "capacity"
+
+    # ------------------------------------------------------------------
+    # Per-tenant QoS hooks (no-ops when VDT_QOS=0: self.qos is None)
+    # ------------------------------------------------------------------
+    def _qos_held_by_tenant(self) -> dict[str, int]:
+        """KV pages currently held per tenant bucket, across every live
+        request (running, waiting-with-pages, remote-KV holds)."""
+        held: dict[str, int] = {}
+        for r in list(self.requests.values()):
+            n = self._num_blocks_of(r.request_id)
+            if n:
+                k = self.qos.key_of(r)
+                held[k] = held.get(k, 0) + n
+        return held
+
+    def _qos_pick_waiting(self) -> Request:
+        """The waiting request QoS admits next: the earliest queued
+        request of the tenant pick_waiting_tenant chooses (largest
+        deficit; over-quota tenants passed over under pool pressure).
+        Queue order within a tenant is untouched, so priority/arrival
+        still decide among a tenant's own requests. The per-tenant
+        queue view is built ONCE per step and popped incrementally by
+        _waiting_remove — rescanning the whole deque on every
+        admission iteration would make the loop O(waiting^2)."""
+        if self._qos_waiting_by_tenant is None:
+            by_tenant: dict[str, deque] = {}
+            for r in self.waiting:
+                by_tenant.setdefault(self.qos.key_of(r),
+                                     deque()).append(r)
+            self._qos_waiting_by_tenant = by_tenant
+        keys = [k for k, q in self._qos_waiting_by_tenant.items() if q]
+        best = self.qos.pick_waiting_tenant(keys,
+                                            self.kv_cache_manager.usage)
+        return self._qos_waiting_by_tenant[best][0]
+
+    def _waiting_remove(self, request: Request) -> None:
+        """Remove an admitted/rejected request from the waiting queue.
+        QoS off always operates on the queue head (the pre-QoS popleft);
+        QoS may have picked a mid-queue request of another tenant and
+        also owes its per-tenant queue view the matching pop."""
+        if self.waiting and self.waiting[0] is request:
+            self.waiting.popleft()
+        else:
+            self.waiting.remove(request)
+        if self.qos is not None and self._qos_waiting_by_tenant:
+            q = self._qos_waiting_by_tenant.get(self.qos.key_of(request))
+            if q and q[0] is request:
+                q.popleft()
+            elif q is not None:
+                try:
+                    q.remove(request)
+                except ValueError:
+                    pass
 
     def _preempt(self, request: Request, cause: str = "capacity") -> None:
         self.running.remove(request)
@@ -1132,6 +1250,11 @@ class Scheduler:
         self.num_preemptions += 1
         self.preemption_causes[cause] = \
             self.preemption_causes.get(cause, 0) + 1
+        if self.qos is not None:
+            # vdt:tenant_preemptions_total counts EVERY eviction the
+            # tenant suffered, whatever the cause — operators read it
+            # next to kv_blocks to see who is being squeezed.
+            self.qos.note_preemption(self.qos.key_of(request))
         self._record_event(request, ev.PREEMPTED,
                            {"num_preemptions": request.num_preemptions,
                             "cause": cause})
@@ -1601,6 +1724,11 @@ class Scheduler:
         }
         if self.state_cache is not None:
             stats.update(self.state_cache.stats())
+        if self.qos is not None:
+            # {tenant: {granted_tokens, kv_blocks, preemptions}} — flat
+            # numeric leaves per tenant so the DP aggregation can sum
+            # them per label (vdt:tenant_* families).
+            stats["tenants"] = self.qos.stats(self._qos_held_by_tenant())
         if self.tknp_size > 1:
             for r, n in enumerate(self.tknp_tokens_per_rank):
                 stats[f"tknp_tokens_rank{r}"] = n
@@ -1654,6 +1782,7 @@ class Scheduler:
             })
         return {
             "requests": reqs,
+            "qos": (self.qos.debug() if self.qos is not None else None),
             "num_waiting": len(waiting),
             "num_running": len(running),
             "waiting_req_ids": [r.request_id for r in waiting],

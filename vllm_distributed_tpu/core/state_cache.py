@@ -107,6 +107,30 @@ def journal_path(journal_dir: str, key: bytes) -> str:
     return os.path.join(journal_dir, f"ssm_{key.hex()}.npz")
 
 
+def _fs_now(dirpath: str) -> Optional[float]:
+    """Current time on the FILESYSTEM's clock: the mtime of a
+    just-written probe file. Journal TTLs compare against os.stat
+    mtimes, so age arithmetic must read the clock that stamped them —
+    never the process wall clock, whose view can skew from the
+    filesystem's (remote mounts, clock steps between writer and
+    sweeper)."""
+    import tempfile
+    try:
+        fd, path = tempfile.mkstemp(prefix=".sweep_probe_", dir=dirpath)
+    except OSError:
+        return None
+    try:
+        os.close(fd)
+        return os.stat(path).st_mtime
+    except OSError:
+        return None
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def sweep_journal(journal_dir: str, *, max_bytes: int, ttl_s: float,
                   keep=frozenset(), now: float = None) -> tuple[int, int]:
     """Bounded-retention sweep of a checkpoint-journal directory:
@@ -121,11 +145,18 @@ def sweep_journal(journal_dir: str, *, max_bytes: int, ttl_s: float,
     Content-addressed journal files deliberately outlive their requests
     (they ARE the crash-recovery tier), so this sweep — run at manager
     init and on sleep() — is the only thing bounding the directory."""
-    import time as _time
     if not journal_dir or not os.path.isdir(journal_dir):
         return 0, 0
     if now is None:
-        now = _time.time()  # wallclock-ok: compared against os.stat mtimes
+        # File ages only compare meaningfully on the clock that stamped
+        # the mtimes. A probe write reads "filesystem now" from that
+        # same clock — the monotonic-clock policy's answer for file
+        # TTLs, where a process wall-clock read would re-introduce the
+        # process-vs-filesystem skew the deadline lint bans. A failed
+        # probe (read-only or FULL disk — exactly when reclamation
+        # matters most) skips only the TTL pass below; the size prune
+        # is mtime-ORDER only and needs no clock, so it still runs.
+        now = _fs_now(journal_dir)
     entries = []
     for name in os.listdir(journal_dir):
         if not (name.startswith("ssm_") and name.endswith(".npz")):
@@ -153,7 +184,7 @@ def sweep_journal(journal_dir: str, *, max_bytes: int, ttl_s: float,
 
     survivors = []
     for mtime, size, path in entries:
-        if ttl_s > 0 and now - mtime > ttl_s:
+        if ttl_s > 0 and now is not None and now - mtime > ttl_s:
             reclaim(mtime, size, path)
         else:
             survivors.append((mtime, size, path))

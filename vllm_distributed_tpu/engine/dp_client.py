@@ -696,6 +696,29 @@ class DPEngineClient(EngineCoreClient):
                 for k, v in m.items():
                     merged_fb[k] = merged_fb.get(k, 0) + int(v)
             agg["block_fusion_fallbacks"] = merged_fb
+        # Per-tenant QoS families: {tenant: {granted_tokens, kv_blocks,
+        # preemptions}}, summed per tenant per leaf across replicas
+        # (every scheduler buckets through qos.bucket_tenant so each
+        # replica's key space is bounded; note the first-come tracked
+        # set is per replica, so past VDT_QOS_MAX_TRACKED_TENANTS a
+        # tenant routed to several replicas may appear tracked-by-name
+        # on one and as an overflow "~n" bucket on another — the merge
+        # stays bounded but such a tenant's series split across the
+        # two labels. Counters and the kv_blocks gauge both sum — a
+        # tenant's fleet page footprint is the sum of its per-replica
+        # footprints).
+        tenant_maps = [s["tenants"] for s in per
+                       if isinstance(s.get("tenants"), dict)]
+        if tenant_maps:
+            merged_tenants: dict = {}
+            for m in tenant_maps:
+                for t, leaves in m.items():
+                    if not isinstance(leaves, dict):
+                        continue
+                    dst = merged_tenants.setdefault(t, {})
+                    for k, v in leaves.items():
+                        dst[k] = dst.get(k, 0) + int(v)
+            agg["tenants"] = merged_tenants
         # Step-phase family: {phase -> histogram dict}, merged per phase.
         phase_maps = [s["step_phase_seconds"] for s in per
                       if isinstance(s.get("step_phase_seconds"), dict)]

@@ -42,6 +42,9 @@ class RequestState:
     # the core after the prompt completes.
     prompt_logprobs: Optional[list] = None
     times: Optional["RequestTimes"] = None
+    # Bucketed QoS tenant key (qos.bucket_tenant; None when the QoS
+    # plane is off) for the per-tenant goodput family.
+    tenant: Optional[str] = None
     # Merged lifecycle timeline: (monotonic_ts, event, detail) — the
     # front-end's own events (arrived/first_token/replay/finished) plus
     # the core-side events riding each EngineCoreOutput. Stitched into
@@ -74,6 +77,13 @@ class OutputProcessor:
         # finished request).
         self.stats.slo_ttft_ms = envs.VDT_SLO_TTFT_MS
         self.stats.slo_tpot_ms = envs.VDT_SLO_TPOT_MS
+        # Per-tenant goodput accounting (vdt:tenant_goodput_frac) rides
+        # the QoS plane: bucketing shares qos.bucket_tenant with the
+        # scheduler so both label spaces stay bounded and agree. Read
+        # once, like the SLO targets.
+        self._qos_tenants = envs.VDT_QOS
+        self._tenant_tracked: set = set()
+        self._max_tracked_tenants = envs.VDT_QOS_MAX_TRACKED_TENANTS
         # Per-request spans (reference: tracing.py spans emitted from
         # the output path; gated by otlp_traces_endpoint).
         from vllm_distributed_tpu.tracing import init_tracer
@@ -102,6 +112,11 @@ class OutputProcessor:
                                            request.prompt_token_ids)
         import time as _time
         arrival = _time.monotonic()
+        tenant = None
+        if self._qos_tenants:
+            from vllm_distributed_tpu.core.sched.qos import bucket_tenant
+            tenant = bucket_tenant(request.tenant, self._tenant_tracked,
+                                   self._max_tracked_tenants)
         state = RequestState(
             request_id=request.request_id,
             prompt=prompt,
@@ -109,6 +124,7 @@ class OutputProcessor:
             params=params,
             detokenizer=detok,
             times=RequestTimes(arrival=arrival),
+            tenant=tenant,
         )
         if self.timeline_enabled:
             state.timeline.append((arrival, ev.ARRIVED, None))
@@ -234,7 +250,8 @@ class OutputProcessor:
                 self.stats.on_finished(state.times,
                                        len(state.prompt_token_ids))
                 self.stats.on_slo(state.times,
-                                  len(state.output_token_ids))
+                                  len(state.output_token_ids),
+                                  tenant=state.tenant)
                 phases = self._finish_timeline(
                     state, ev.ABORTED if finish_reason == "abort"
                     else ev.FINISHED)

@@ -289,6 +289,33 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # after admission stops before the server exits anyway.
     "VDT_DRAIN_TIMEOUT_S":
     lambda: float(os.getenv("VDT_DRAIN_TIMEOUT_S", "30")),
+    # --- Per-tenant QoS (core/sched/qos.py) ----------------------------
+    # Scheduler-level execution fairness: "1" turns on deficit-round-
+    # robin weighted fair queueing over tenants (granted tokens draw
+    # down per-tenant deficit counters; chunked-prefill grants clip to
+    # them), soft per-tenant KV page quotas with quota-aware preemption
+    # (cause "quota"), and the vdt:tenant_* metric families. "0" (the
+    # default) constructs no QoS state — scheduling is byte-identical
+    # to the pre-QoS behavior. Read once at scheduler construction.
+    "VDT_QOS":
+    lambda: os.getenv("VDT_QOS", "0") == "1",
+    # Weight spec: comma list of "name:weight" where name is a tenant
+    # id or a class key ("interactive"/"best_effort"/"default").
+    # Unlisted tenants take their priority class's weight, then
+    # "default", then 1.0 (equal shares).
+    "VDT_QOS_WEIGHTS":
+    lambda: os.getenv("VDT_QOS_WEIGHTS", ""),
+    # Soft per-tenant KV quota as a fraction of the page pool. Free
+    # until pressure: enforced only when the pool is pressured
+    # (admission gating at >= 0.9 usage; preemption victim choice on
+    # allocation failure). Values outside (0, 1) disable quotas.
+    "VDT_QOS_KV_QUOTA_FRAC":
+    lambda: float(os.getenv("VDT_QOS_KV_QUOTA_FRAC", "0.5")),
+    # Cardinality bound for the vdt:tenant_* label space: tenants past
+    # this many distinct ids hash into 8 shared overflow buckets
+    # ("~<n>"); tenantless requests share "_anon".
+    "VDT_QOS_MAX_TRACKED_TENANTS":
+    lambda: max(1, int(os.getenv("VDT_QOS_MAX_TRACKED_TENANTS", "64"))),
     # --- Quantized communication plane (parallel/collectives.py +
     # distributed/kv_transfer/quant.py) ----------------------------------
     # Master switch: "1" ships cross-device bytes block-scaled int8

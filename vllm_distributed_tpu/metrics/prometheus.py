@@ -178,6 +178,16 @@ LABELED_METRICS = {
     "vdt:pool_occupancy": ("pool", ),
     # Weighted admission shedding (entrypoints/openai/admission.py).
     "vdt:requests_shed_by_class_total": ("class", ),
+    # Per-tenant QoS (core/sched/qos.py; VDT_QOS=1). Label cardinality
+    # is bounded: tenants past VDT_QOS_MAX_TRACKED_TENANTS hash into 8
+    # shared "~<n>" overflow buckets, tenantless traffic shares
+    # "_anon" (qos.bucket_tenant is the shared bucketing function;
+    # each component's first-come tracked set is its own, so overflow
+    # assignment can differ per replica past the cap).
+    "vdt:tenant_granted_tokens_total": ("tenant", ),
+    "vdt:tenant_kv_blocks": ("tenant", ),
+    "vdt:tenant_preemptions_total": ("tenant", ),
+    "vdt:tenant_goodput_frac": ("tenant", ),
 }
 
 
@@ -462,6 +472,28 @@ def _render_kv_cache(kv: dict) -> list[str]:
     return lines
 
 
+def _render_tenants(tenants: dict) -> list[str]:
+    """Per-tenant QoS families ({tenant: {granted_tokens, kv_blocks,
+    preemptions}} from the scheduler's stats, summed per tenant across
+    DP replicas). Cardinality is bounded at the source (qos.py
+    bucket_tenant), so one series per bucket is safe to render."""
+    lines: list[str] = []
+    for name, key, kind, help_text in (
+        ("vdt:tenant_granted_tokens_total", "granted_tokens", "counter",
+         "Scheduler token grants per tenant bucket (the DRR charge "
+         "stream)"),
+        ("vdt:tenant_kv_blocks", "kv_blocks", "gauge",
+         "KV pages currently held per tenant bucket"),
+        ("vdt:tenant_preemptions_total", "preemptions", "counter",
+         "Preemptions suffered per tenant bucket (all causes)"),
+    ):
+        lines += [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+        lines += [f'{name}{{tenant="{t}"}} {int(tenants[t].get(key, 0))}'
+                  for t in sorted(tenants)
+                  if isinstance(tenants[t], dict)]
+    return lines
+
+
 def _render_histogram(name: str, help_text: str, h: dict) -> list[str]:
     from vllm_distributed_tpu.metrics.stats import render_histogram_lines
     return render_histogram_lines(name, help_text, h.get("buckets", ()),
@@ -546,6 +578,9 @@ def render_metrics(stats: dict) -> str:
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:
         lines += _render_kv_cache(kv_cache)
+    tenants = stats.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        lines += _render_tenants(tenants)
     # DP balancer load gauges + routing-tier counters (dp_client /
     # router stats entries; absent on single-replica deployments).
     lines += _render_dp_balancer(stats)

@@ -155,6 +155,12 @@ class FrontendStats:
     slo_good: int = 0
     slo_ttft_misses: int = 0
     slo_tpot_misses: int = 0
+    # Per-tenant goodput (vdt:tenant_goodput_frac{tenant}; QoS plane):
+    # {tenant bucket: [scored, good]}. Fed only when the output
+    # processor runs with VDT_QOS=1 — keys are already
+    # bounded-cardinality buckets (qos.bucket_tenant), so rendering one
+    # series per key is safe.
+    slo_by_tenant: dict = field(default_factory=dict)
     # Periodic logging window (LoggingStatLogger equivalent).
     _window_start: float = field(default_factory=time.monotonic)
     _window_gen_tokens: int = 0
@@ -191,14 +197,18 @@ class FrontendStats:
     def slo_enabled(self) -> bool:
         return self.slo_ttft_ms > 0 or self.slo_tpot_ms > 0
 
-    def on_slo(self, times: RequestTimes, num_output_tokens: int) -> None:
+    def on_slo(self, times: RequestTimes, num_output_tokens: int,
+               tenant: Optional[str] = None) -> None:
         """Score one finished request against the configured SLO
         targets. Only token-producing requests score (an aborted
         request that never emitted is an availability event, not a
         latency one); TPOT needs >= 2 tokens to be defined. A request
         where NO enabled target was evaluable (e.g. only TPOT enabled
         and max_tokens=1) is not scored at all — counting it as good
-        would inflate goodput with requests the targets never saw."""
+        would inflate goodput with requests the targets never saw.
+        ``tenant`` (an already-bucketed QoS tenant key, or None when
+        the QoS plane is off) additionally banks the verdict into the
+        per-tenant goodput family."""
         if not self.slo_enabled:
             return
         if times is None or times.first_token is None:
@@ -224,6 +234,11 @@ class FrontendStats:
         self.slo_scored += 1
         if good:
             self.slo_good += 1
+        if tenant is not None:
+            bank = self.slo_by_tenant.setdefault(tenant, [0, 0])
+            bank[0] += 1
+            if good:
+                bank[1] += 1
 
     def _maybe_log(self, now: float) -> None:
         dt = now - self._window_start
@@ -295,6 +310,21 @@ class FrontendStats:
                 "# TYPE vdt:slo_tpot_misses_total counter",
                 f"vdt:slo_tpot_misses_total {self.slo_tpot_misses}",
             ]
+            if self.slo_by_tenant:
+                name = "vdt:tenant_goodput_frac"
+                lines += [
+                    f"# HELP {name} Fraction of a tenant bucket's "
+                    "scored requests that met every enabled SLO target "
+                    "(QoS plane; bucketing bounded by "
+                    "VDT_QOS_MAX_TRACKED_TENANTS)",
+                    f"# TYPE {name} gauge",
+                ]
+                lines += [
+                    f'{name}{{tenant="{t}"}} '
+                    f"{round(good / max(scored, 1), 6)}"
+                    for t, (scored, good)
+                    in sorted(self.slo_by_tenant.items())
+                ]
         lines += render_fault_injections()
         return "\n".join(lines) + "\n"
 

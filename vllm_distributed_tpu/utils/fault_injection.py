@@ -72,6 +72,15 @@ but never fired by production code):
   bounded pull retries, then local re-prefill recompute (counted in
   ``vdt:disagg_fallbacks_total{reason="local_reprefill"}``). Greedy
   output must stay token-identical throughout.
+* ``sched.quota_thrash`` — the QoS quota-preemption victim picker
+  (core/sched/qos.py quota_victim, consulted on every allocation
+  failure; requires ``VDT_QOS=1``) treats the per-tenant KV quota as
+  ZERO, so every page-holding tenant reads as over-quota and each
+  capacity preemption becomes a quota preemption targeting the
+  biggest holder — a forced quota-preemption storm. The drill proves
+  the per-tenant cooldown hysteresis bounds it: a tenant oscillating
+  around its quota falls back to ordinary capacity preemption between
+  quota evictions instead of livelocking in evict/resume cycles.
 """
 
 import threading
@@ -97,6 +106,7 @@ FAULT_POINTS = (
     "ssm.restore_corrupt",
     "qcomm.scale_corrupt",
     "disagg.handoff_stall",
+    "sched.quota_thrash",
 )
 
 
