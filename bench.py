@@ -46,22 +46,10 @@ PROMPT_LEN = 16 if TINY else 128
 DECODE_STEPS = 24 if TINY else 100
 BASELINE_TOKS_PER_S = 360.0
 
-# Peak dense bf16 FLOP/s per chip by device generation (public specs).
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-# Peak HBM bandwidth per chip (public specs, bytes/s) — the decode
-# roofline (decode is weight/KV-bandwidth-bound, not FLOP-bound).
-_PEAK_HBM = {
-    "v4": 1228e9,
-    "v5e": 819e9,
-    "v5p": 2765e9,
-    "v6e": 1638e9,
-}
+# Peak FLOP/s and HBM-bandwidth tables moved to
+# vllm_distributed_tpu/metrics/costmodel.py (PEAK_FLOPS_PER_CHIP /
+# PEAK_HBM_PER_CHIP) — one source for bench records and the in-engine
+# vdt:mfu / vdt:mbu plane; _peak_flops()/_peak_hbm() below delegate.
 
 _PROBE = ("import jax, time; t0=time.time(); d = jax.devices(); "
           "import jax.numpy as jnp; "
@@ -272,24 +260,71 @@ def _model_params(hf: dict) -> int:
     return L * per_layer + 2 * V * H + H
 
 
-def _peak_flops() -> float:
+def _bench_cost_model(hf: dict):
+    """The engine's analytic cost model priced for the bench dims
+    (metrics/costmodel.py — the same arithmetic the in-engine
+    vdt:mfu/vdt:mbu plane charges with, so bench records and /metrics
+    stay directly comparable)."""
     import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for gen, peak in _PEAK_FLOPS.items():
-        if gen in kind:
-            return peak
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    return _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
+
+    from vllm_distributed_tpu.metrics.costmodel import CostModel
+    dev = jax.devices()[0]
+    return CostModel.from_hf_dims(
+        hf, dtype_bytes=2,
+        device_kind=getattr(dev, "device_kind", dev.platform),
+        num_chips=1)
+
+
+def _stamp_engine_perf(record: dict, prefix: str, engine=None,
+                       stats=None, hf: dict = None, tok_s=None,
+                       avg_ctx=None) -> None:
+    """Stamp one leg's engine-sourced MFU/MBU (max across workers —
+    DP replicas share identical hardware). Falls back to the analytic
+    cost model at the leg's measured tok/s when the telemetry plane
+    was off for the leg (VDT_PERF_ATTRIB=0 / off-legs), so every
+    record row carries comparable utilization numbers either way."""
+    try:
+        if stats is None and engine is not None:
+            stats = engine.get_stats()
+        workers = (stats or {}).get("workers") or {}
+        mfus = [w.get("mfu") for w in workers.values()
+                if isinstance(w, dict) and w.get("mfu") is not None]
+        mbus = [w.get("mbu") for w in workers.values()
+                if isinstance(w, dict) and w.get("mbu") is not None]
+        if mfus:
+            record[f"{prefix}_mfu"] = round(max(mfus), 6)
+            record[f"{prefix}_mbu"] = round(max(mbus or [0.0]), 6)
+            record[f"{prefix}_mfu_source"] = "engine"
+            return
+        if hf is not None and tok_s:
+            cm = _bench_cost_model(hf)
+            ctx = (avg_ctx if avg_ctx is not None
+                   else PROMPT_LEN + DECODE_STEPS / 2)
+            record[f"{prefix}_mfu"] = round(
+                tok_s * cm.decode_flops_per_token(ctx) / cm.peak_flops,
+                6)
+            record[f"{prefix}_mbu"] = round(
+                cm.decode_step_bytes(BATCH, ctx) * (tok_s / BATCH)
+                / cm.peak_hbm, 6)
+            record[f"{prefix}_mfu_source"] = "analytic"
+    except Exception:  # noqa: BLE001 - diagnostic stamp only
+        pass
+
+
+def _peak_flops() -> float:
+    # Single source with the in-engine plane (metrics/costmodel.py)
+    # so bench records and /metrics use identical denominators.
+    import jax
+
+    from vllm_distributed_tpu.metrics.costmodel import peak_flops_per_chip
+    return peak_flops_per_chip(jax.devices()[0].device_kind)
 
 
 def _peak_hbm() -> float:
     import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for gen, peak in _PEAK_HBM.items():
-        if gen in kind:
-            return peak
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    return _PEAK_HBM.get(gen, _PEAK_HBM["v5e"])
+
+    from vllm_distributed_tpu.metrics.costmodel import peak_hbm_per_chip
+    return peak_hbm_per_chip(jax.devices()[0].device_kind)
 
 
 def _time_decode(engine, prompts, sp, tag):
@@ -344,6 +379,7 @@ def _async_overlap_legs(config, prompts, sp, record) -> None:
         engine = LLMEngine(cfg, load_tokenizer=False)
         tok_s, _ = _time_decode(engine, prompts, sp, leg)
         stats = engine.get_stats()
+        _stamp_engine_perf(record, leg, stats=stats)
         if flag:
             record["steps_per_s"] = round(tok_s / batch, 2)
             record["async_decode_tok_s"] = round(tok_s, 1)
@@ -424,6 +460,7 @@ def _routing_leg(config, record) -> None:
                     prompts[s] = prompts[s] + toks + [extra[(t, s)]]
             wall = time.perf_counter() - t0
             stats = engine.get_stats()
+            _stamp_engine_perf(record, f"routing_{leg}", stats=stats)
             kv = stats.get("kv_cache") or {}
             record[f"routing_{leg}_hit_rate_window"] = round(
                 float(kv.get("window_hit_rate", 0.0)), 4)
@@ -667,6 +704,7 @@ def _qos_leg(config, record) -> None:
                         tenants[t]["granted_tokens"])
                     record[f"qos_{leg}_{t}_tenant_preemptions"] = int(
                         tenants[t]["preemptions"])
+            _stamp_engine_perf(record, f"qos_{leg}", engine=engine)
             engine.shutdown()
             del engine
             gc.collect()
@@ -796,6 +834,7 @@ def _disagg_leg(config, record) -> None:
                         (wh.get("sum", 0.0) - w0.get("sum", 0.0))
                         / count * 1e3, 1)
                 record["disagg_fallbacks"] = d.get("fallbacks", {})
+            _stamp_engine_perf(record, f"disagg_{leg}", engine=engine)
             engine.shutdown()
             del engine
             gc.collect()
@@ -979,6 +1018,7 @@ def _ssm_leg(record) -> None:
                     prompts[s] = prompts[s] + toks + [extra[(t, s)]]
             wall = time.perf_counter() - t0
             stats = engine.get_stats()
+            _stamp_engine_perf(record, f"ssm_{leg}", stats=stats)
             record[f"ssm_{leg}_turns_per_s"] = round(
                 sessions * turns / wall, 2)
             if flag == "1":
@@ -1126,6 +1166,7 @@ def _mla_leg(record) -> None:
             record[f"mla_{leg}_max_concurrent"] = max_running
             record[f"mla_{leg}_decode_tok_s"] = round(
                 n_reqs * gen_tokens / wall, 1)
+            _stamp_engine_perf(record, f"mla_{leg}", engine=engine)
             engine.shutdown()
             del engine
             gc.collect()
@@ -1246,6 +1287,7 @@ def _qcomm_leg(record) -> None:
                     qc.get("dcn_pull", {}).get("bytes_saved", 0))
                 record["qcomm_fallbacks"] = int(
                     qc.get("dcn_pull", {}).get("fallbacks", 0))
+            _stamp_engine_perf(record, f"qcomm_{leg}", engine=consumer)
             producer.engine_core.shutdown()
             consumer.engine_core.shutdown()
             del producer, consumer
@@ -1298,11 +1340,11 @@ def _timeline_overhead_legs(config, prompts, sp, record) -> None:
     from vllm_distributed_tpu.engine.llm_engine import LLMEngine
     batch = len(prompts)
     # The off leg drops the WHOLE observability surface (lifecycle
-    # timeline + device + transport telemetry), so
-    # timeline_overhead_frac bounds the full telemetry plane, not just
-    # the event recorder.
+    # timeline + device + transport telemetry + the perf-attribution
+    # plane), so timeline_overhead_frac bounds the full telemetry
+    # plane, not just the event recorder.
     _SWITCHES = ("VDT_REQUEST_TIMELINE", "VDT_DEVICE_TELEMETRY",
-                 "VDT_TRANSPORT_TELEMETRY")
+                 "VDT_TRANSPORT_TELEMETRY", "VDT_PERF_ATTRIB")
     saved = {k: os.environ.get(k) for k in _SWITCHES}
     try:
         for leg, flag in (("timeline_on", "1"), ("timeline_off", "0")):
@@ -1324,6 +1366,11 @@ def _timeline_overhead_legs(config, prompts, sp, record) -> None:
                 if rnd > 0:
                     best = max(best, tok_s)
             record[f"{leg}_steps_per_s"] = round(best / batch, 2)
+            # Off leg: the plane is disabled, so the stamp exercises
+            # the analytic fallback path (mfu_source = "analytic").
+            _stamp_engine_perf(record, leg, engine=engine,
+                               hf=config.model_config.hf_overrides,
+                               tok_s=best)
             if flag == "1" and not any(k.startswith("phase_")
                                        for k in record):
                 # Fallback attribution only: when the headline run
@@ -1433,6 +1480,7 @@ def _mixed_batch_leg(config, prompts, sp, record) -> None:
     record["mixed_prefill_interference_frac"] = round(
         1.0 - (mixed_toks / mixed_time) / max(tok_s, 1e-9), 4)
     record["mixed_concurrent_prefills"] = n_prefills
+    _stamp_engine_perf(record, "mixed", engine=engine)
     try:
         stats = engine.get_stats()
         calls = stats.get("attn_kernel_calls")
@@ -1540,6 +1588,7 @@ def _block_fusion_leg(config, prompts, sp, record) -> None:
                     k: int(v) for k, v in sorted(
                         (stats.get("block_fusion_fallbacks")
                          or {}).items())}
+            _stamp_engine_perf(record, leg, stats=stats)
             del engine
             gc.collect()
         parity = (tokens_by_leg["block_fusion_on"]
@@ -1689,22 +1738,27 @@ def main() -> None:
     backend = jax.devices()[0].platform
     is_tpu = backend not in ("cpu", )
     params = _model_params(hf_dims)
-    # Decode MFU: 2 FLOPs per param per generated token over peak.
-    mfu = (decode_tok_s * 2 * params) / _peak_flops() if is_tpu else None
-    # Decode MBU: bytes the step must stream (weights once + the live
-    # KV window per sequence) over peak HBM bandwidth.
-    hd = hf_dims.get("head_dim") or (
-        hf_dims["hidden_size"] // hf_dims["num_attention_heads"])
-    kv_per_tok = (2 * hf_dims["num_hidden_layers"] *
-                  hf_dims["num_key_value_heads"] * hd * 2)
+    # Decode MFU/MBU from the engine's analytic cost model (ISSUE 14):
+    # FLOPs credit attention at the run's average context (the old
+    # 2*params formula ignored attention and KV traffic entirely —
+    # the unattributable 0.0068 of BENCH_tpu.json), bytes credit the
+    # weight stream + per-sequence KV window + activations. The legacy
+    # 2*params figure rides along as decode_mfu_2np so the old
+    # scoreboard rows stay comparable.
+    cm = _bench_cost_model(hf_dims)
     avg_ctx = PROMPT_LEN + DECODE_STEPS / 2
-    step_bytes = params * 2 + BATCH * kv_per_tok * avg_ctx
+    mfu = (decode_tok_s * cm.decode_flops_per_token(avg_ctx)
+           / cm.peak_flops) if is_tpu else None
+    mfu_2np = ((decode_tok_s * 2 * params) / _peak_flops()
+               if is_tpu else None)
     steps_per_s = decode_tok_s / BATCH
-    mbu = (step_bytes * steps_per_s) / _peak_hbm() if is_tpu else None
+    mbu = (cm.decode_step_bytes(BATCH, avg_ctx) * steps_per_s
+           / cm.peak_hbm) if is_tpu else None
 
     dev_s = device_decode["s"]
     record = {
         "metric": "decode_throughput_llama1b_bs8",
+        "schema_version": 2,
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
@@ -1715,6 +1769,8 @@ def main() -> None:
             (2 * params * BATCH * PROMPT_LEN) /
             (prefill_ms / 1e3) / _peak_flops(), 4) if is_tpu else None,
         "decode_mfu": round(mfu, 4) if mfu is not None else None,
+        "decode_mfu_2np": (round(mfu_2np, 4)
+                           if mfu_2np is not None else None),
         "decode_mbu": round(mbu, 4) if mbu is not None else None,
         "decode_device_s": round(dev_s, 3) if dev_s else None,
         "decode_host_s": round(decode_time - dev_s, 3)
@@ -1773,6 +1829,13 @@ def main() -> None:
         record["recompiles"] = sum(
             int(w.get("num_recompiles", 0)) for w in workers.values()
             if isinstance(w, dict))
+        # Engine-sourced utilization (ISSUE 14): the runner's own
+        # charged-FLOPs-over-measured-device-time MFU/MBU — what a
+        # real-TPU capture should be compared against, analytic
+        # fallback when the plane is off.
+        _stamp_engine_perf(record, "engine", stats=rstats, hf=hf_dims,
+                           tok_s=decode_tok_s, avg_ctx=avg_ctx)
+        record["model_flops_total"] = rstats.get("model_flops")
         # "page_io" is the device-side gather/scatter leg of the SAME
         # payloads the network/filesystem connectors move — summing it
         # in would double-count every transferred byte.

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Validate BENCH_*.json records against the bench schema.
+
+The BENCH files are the project's scoreboard: ROADMAP item 5 compares
+real-TPU captures against them, and the perf-attribution plane (ISSUE
+14) makes CPU-smoke and TPU records directly comparable ONLY if every
+record keeps the machine-readable fields. This linter fails tier-1 when
+a record drifts:
+
+* **Every record** is a single JSON object with ``metric`` (str),
+  ``value`` (finite number >= 0), ``unit`` (non-empty str) and
+  ``backend`` in {tpu, cpu-fallback, cpu}.
+* **tpu-backend records** must carry a numeric ``decode_mfu`` in
+  (0, 1] — the scoreboard's roofline axis.
+* **schema_version >= 2 records** (everything bench.py writes since
+  the perf-attribution plane) must additionally carry ``decode_mbu``
+  on tpu-backend records (decode is bandwidth-bound; MBU is the honest
+  headline) and engine-sourced ``engine_mfu``/``engine_mbu`` on every
+  backend (analytic fallback values count — the keys must exist and be
+  numeric). Records WITHOUT ``schema_version`` are grandfathered
+  pre-plane captures and validate against the v1 rules only.
+
+Usage::
+
+    python scripts/lint_bench.py [--dir REPO_ROOT]
+
+Exit 0 when clean; exit 1 listing violations otherwise.
+"""
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+BACKENDS = {"tpu", "cpu-fallback", "cpu"}
+REQUIRED = ("metric", "value", "unit", "backend")
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and math.isfinite(x)
+
+
+def check_record(name: str, rec) -> list:
+    errs = []
+    if not isinstance(rec, dict):
+        return [f"{name}: not a JSON object"]
+    for key in REQUIRED:
+        if key not in rec:
+            errs.append(f"{name}: missing required field {key!r}")
+    if "metric" in rec and not (isinstance(rec["metric"], str)
+                                and rec["metric"]):
+        errs.append(f"{name}: metric must be a non-empty string")
+    if "value" in rec and not (_is_num(rec["value"])
+                               and rec["value"] >= 0):
+        errs.append(f"{name}: value must be a finite number >= 0")
+    if "unit" in rec and not (isinstance(rec["unit"], str)
+                              and rec["unit"]):
+        errs.append(f"{name}: unit must be a non-empty string")
+    backend = rec.get("backend")
+    if backend is not None and backend not in BACKENDS:
+        errs.append(f"{name}: backend {backend!r} not in "
+                    f"{sorted(BACKENDS)}")
+    is_tpu = backend == "tpu"
+    if is_tpu:
+        mfu = rec.get("decode_mfu")
+        if not (_is_num(mfu) and 0 < mfu <= 1):
+            errs.append(f"{name}: tpu record needs decode_mfu in "
+                        f"(0, 1], got {mfu!r}")
+    version = rec.get("schema_version")
+    if version is not None:
+        if not (_is_num(version) and version >= 1):
+            errs.append(f"{name}: schema_version must be a number >= 1")
+            return errs
+        if version >= 2:
+            if is_tpu and not _is_num(rec.get("decode_mbu")):
+                errs.append(f"{name}: schema>=2 tpu record needs a "
+                            "numeric decode_mbu next to decode_mfu")
+            for key in ("engine_mfu", "engine_mbu"):
+                if not _is_num(rec.get(key)):
+                    errs.append(
+                        f"{name}: schema>=2 record needs engine-"
+                        f"sourced {key} (analytic fallback counts), "
+                        f"got {rec.get(key)!r}")
+    return errs
+
+
+def main(argv) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=repo,
+                        help="directory holding BENCH_*.json")
+    args = parser.parse_args(argv)
+    paths = sorted(glob.glob(os.path.join(args.dir, "BENCH_*.json")))
+    errors: list = []
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except Exception as e:  # noqa: BLE001 - that IS the finding
+            errors.append(f"{name}: unparseable JSON ({e})")
+            continue
+        if isinstance(rec, dict) and "parsed" in rec:
+            # Driver wrapper shape: {"n", "cmd", "rc", "tail",
+            # "parsed": <bench record>}. A failed capture (rc != 0)
+            # legitimately carries parsed=null — the schema binds the
+            # RECORD, not the driver's failure bookkeeping.
+            payload = rec.get("parsed")
+            if payload is None:
+                if rec.get("rc", 1) == 0:
+                    errors.append(
+                        f"{name}: rc=0 wrapper with no parsed record")
+                continue
+            rec = payload
+        errors.extend(check_record(name, rec))
+    if errors:
+        print(f"lint_bench: {len(errors)} violation(s) in "
+              f"{len(paths)} record(s):", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"lint_bench: {len(paths)} record(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

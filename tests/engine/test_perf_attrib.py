@@ -1,0 +1,231 @@
+"""Engine-level gates for the performance-attribution plane (ISSUE 14).
+
+Acceptance contract: a CPU-smoke greedy run with telemetry on reports
+mfu > 0 / mbu > 0, total charged FLOPs within 2% of the analytic cost
+model applied to the run's exact composition, /metrics renders the new
+families, /debug/perf returns a non-empty self-consistent table;
+``VDT_PERF_ATTRIB=0`` constructs no cost model and adds no stats keys
+(token-identical outputs). Plus the hardened profiler capture: one
+capture at a time, auto-named dirs, and the ``perf.capture_stall``
+drill proving a wedged xprof session is bounded by VDT_PROFILE_MAX_S
+without wedging serving."""
+
+import asyncio
+import time
+
+import pytest
+from transformers import LlamaConfig
+
+from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                         LoadConfig, ModelConfig,
+                                         SchedulerConfig)
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+HF = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4,
+          num_key_value_heads=2, max_position_embeddings=256,
+          architectures=["LlamaForCausalLM"])
+
+B, P, D = 3, 10, 5
+
+
+def make_engine() -> LLMEngine:
+    config = EngineConfig(
+        model_config=ModelConfig(model="tiny-perf-dummy",
+                                 dtype="float32", max_model_len=256,
+                                 hf_overrides=HF,
+                                 skip_tokenizer_init=True),
+        cache_config=CacheConfig(block_size=4,
+                                 num_gpu_blocks_override=256),
+        scheduler_config=SchedulerConfig(max_num_batched_tokens=256,
+                                         max_num_seqs=8,
+                                         max_model_len=256),
+        load_config=LoadConfig(load_format="dummy"))
+    config.model_config.hf_config = LlamaConfig(**HF)
+    return LLMEngine(config, load_tokenizer=False)
+
+
+def run_greedy(engine) -> dict:
+    # DISTINCT prompts: identical prompts prefix-cache-hit and the
+    # engine honestly charges the smaller computed composition, which
+    # would make the closed-form prediction below wrong.
+    sp = SamplingParams(temperature=0.0, max_tokens=D, ignore_eos=True)
+    for i in range(B):
+        engine.add_request(f"r{i}",
+                           [2 + i * 17 + j for j in range(P)], sp)
+    toks = {}
+    for _ in range(200):
+        for out in engine.step():
+            if out.finished:
+                toks[out.request_id] = list(out.outputs[0].token_ids)
+        if not engine.has_unfinished_requests():
+            break
+    assert len(toks) == B
+    return toks
+
+
+def _runner(engine):
+    return engine.engine_core.engine_core.executor.worker.model_runner
+
+
+def expected_flops(cm) -> float:
+    """Closed-form analytic prediction for the fixture workload: one
+    un-chunked prefill wave (budget >= B*P) + D-1 decode waves, every
+    wave sampling one row per scheduled request."""
+    total = 0.0
+    for _ in range(B):
+        total += (P * cm.linear_flops_per_token +
+                  (P * (P + 1) / 2) * cm.attn_flops_per_token_kv +
+                  cm.lm_head_flops_per_row)
+        for j in range(1, D):
+            total += (cm.linear_flops_per_token +
+                      (P + j) * cm.attn_flops_per_token_kv +
+                      cm.lm_head_flops_per_row)
+    return total
+
+
+def test_greedy_run_reports_mfu_mbu_and_matches_analytic():
+    engine = make_engine()
+    try:
+        run_greedy(engine)
+        stats = engine.get_stats()
+        cm = _runner(engine).model.cfg.cost_model
+        assert cm is not None
+        # Totals match the analytic model on the exact composition.
+        exp = expected_flops(cm)
+        assert stats["model_flops"] == pytest.approx(exp, rel=0.02)
+        # Utilization gauges live and positive, per labeled worker.
+        workers = stats["workers"]
+        (label, w), = workers.items()
+        assert w["mfu"] > 0 and w["mbu"] > 0
+        hbm = stats["hbm_bytes"]
+        assert set(hbm) == {"weights", "kv_read", "kv_write",
+                            "activations"}
+        assert all(v > 0 for v in hbm.values())
+        # Attribution table keyed kernel/phase/bucket, both phases hit.
+        phases = {k.split("/")[1] for k in stats["perf_attrib"]}
+        assert {"prefill", "decode"} <= phases
+        assert set(stats["perf_phases"]) >= {"prefill", "decode"}
+        # /metrics renders every new family.
+        from vllm_distributed_tpu.metrics.prometheus import \
+            render_metrics
+        text = render_metrics(stats)
+        for needle in (f'vdt:mfu{{worker="{label}"}}',
+                       f'vdt:mbu{{worker="{label}"}}',
+                       'vdt:hbm_bytes_total{kind="kv_read"}',
+                       'vdt:roofline_bound{phase="decode"}',
+                       "vdt:model_flops_total"):
+            assert needle in text, needle
+    finally:
+        engine.engine_core.shutdown()
+
+
+def test_debug_perf_table_is_self_consistent():
+    engine = make_engine()
+    try:
+        run_greedy(engine)
+        stats = engine.get_stats()
+
+        class _Stub:
+            async def get_stats(self, include_events=True):
+                assert include_events is False
+                return stats
+
+        from vllm_distributed_tpu.entrypoints.openai.api_server import \
+            _debug_perf_json
+        perf = asyncio.run(_debug_perf_json(_Stub()))
+        rows = perf["attribution"]
+        assert rows, "attribution table must not be empty"
+        assert rows == sorted(rows, key=lambda r: r["device_seconds"],
+                              reverse=True)
+        table_flops = sum(r["flops"] for r in rows)
+        assert table_flops == pytest.approx(
+            perf["totals"]["model_flops"], rel=0.02)
+        assert perf["utilization"]
+        assert set(perf["roofline_bound"]) >= {"prefill", "decode"}
+        for r in rows:
+            assert r["kernel"] and r["phase"] in ("prefill", "decode",
+                                                  "mixed")
+    finally:
+        engine.engine_core.shutdown()
+
+
+def test_perf_attrib_off_is_clean_and_token_identical(monkeypatch):
+    engine_on = make_engine()
+    try:
+        base = run_greedy(engine_on)
+    finally:
+        engine_on.engine_core.shutdown()
+    monkeypatch.setenv("VDT_PERF_ATTRIB", "0")
+    engine = make_engine()
+    try:
+        toks = run_greedy(engine)
+        assert toks == base
+        assert _runner(engine).model.cfg.cost_model is None
+        stats = engine.get_stats()
+        for key in ("model_flops", "hbm_bytes", "perf_attrib",
+                    "perf_phases", "perf_peaks"):
+            assert key not in stats, key
+        workers = stats.get("workers") or {}
+        for w in workers.values():
+            assert "mfu" not in w and "mbu" not in w
+        from vllm_distributed_tpu.metrics.prometheus import \
+            render_metrics
+        text = render_metrics(stats)
+        assert "vdt:mfu" not in text
+        assert "vdt:roofline_bound" not in text
+    finally:
+        engine.engine_core.shutdown()
+
+
+def test_profiler_capture_hardening(monkeypatch, tmp_path):
+    monkeypatch.setenv("VDT_PROFILER_DIR", str(tmp_path))
+    engine = make_engine()
+    core = engine.engine_core.engine_core
+    try:
+        with pytest.raises(ValueError, match="no profiler capture"):
+            core.profile("stop")
+        d1 = core.profile("start")
+        assert str(tmp_path) in d1
+        with pytest.raises(ValueError, match="already active"):
+            core.profile("start")
+        assert core.profile("stop") == d1
+        # Auto-naming: a second capture gets a DIFFERENT directory.
+        d2 = core.profile("start")
+        assert d2 != d1
+        core.profile("stop")
+    finally:
+        engine.engine_core.shutdown()
+
+
+def test_capture_stall_drill_bounded_by_deadline(monkeypatch,
+                                                 tmp_path):
+    """perf.capture_stall: the stop RPC is lost (wedged xprof client);
+    the VDT_PROFILE_MAX_S deadline force-stops the capture from the
+    step loop while serving keeps producing tokens, and the fault fire
+    is counted."""
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    monkeypatch.setenv("VDT_PROFILER_DIR", str(tmp_path))
+    monkeypatch.setenv("VDT_PROFILE_MAX_S", "0.2")
+    engine = make_engine()
+    core = engine.engine_core.engine_core
+    fi.inject("perf.capture_stall")
+    try:
+        core.profile("start")
+        assert core._profile_stalled
+        with pytest.raises(RuntimeError, match="wedged"):
+            core.profile("stop")
+        assert core._profile_dir is not None
+        time.sleep(0.25)
+        toks = run_greedy(engine)  # serving survives the wedge
+        assert all(len(t) == D for t in toks.values())
+        assert core._profile_dir is None, "deadline must force-stop"
+        assert fi.counters().get("perf.capture_stall", 0) >= 1
+        # The lane is free again: a fresh capture starts cleanly.
+        fi.clear("perf.capture_stall")
+        d = core.profile("start")
+        assert core.profile("stop") == d
+    finally:
+        fi.clear()
+        engine.engine_core.shutdown()

@@ -209,6 +209,37 @@ def test_debug_endpoints_idle_shapes(server):
     assert names & {"arrived", "finished", "aborted", "shed"}
 
 
+def test_debug_perf_attribution_mid_request(server):
+    """GET /debug/perf serves the performance-attribution table —
+    non-empty once waves dispatched, totals self-consistent with its
+    own rows — and, like the other debug GETs, bypasses the admission
+    gate (the stream below holds the single slot)."""
+    import time as _time
+    url, _engine = server
+    with _InflightStream(url):
+        perf = {}
+        for _ in range(100):
+            r = httpx.get(f"{url}/debug/perf", timeout=60)
+            assert r.status_code == 200, r.text
+            perf = r.json()
+            if perf.get("attribution"):
+                break
+            _time.sleep(0.1)
+    rows = perf["attribution"]
+    assert rows, perf
+    table_flops = sum(r["flops"] for r in rows)
+    assert table_flops > 0
+    if not perf["rows_dropped"]:
+        assert table_flops == pytest.approx(
+            perf["totals"]["model_flops"], rel=0.02)
+    assert perf["utilization"], "per-worker mfu/mbu expected"
+    for w in perf["utilization"].values():
+        assert w["mfu"] > 0 and w["mbu"] > 0
+    assert set(perf["roofline_bound"]) <= {"prefill", "decode",
+                                           "mixed"}
+    assert perf["peaks"].get("flops", 0) > 0
+
+
 def test_sigusr1_dump_logs_without_disturbing_serving(server):
     """The SIGUSR1 path (exercised directly — the test server's loop
     runs off the main thread, where signal handlers cannot register)

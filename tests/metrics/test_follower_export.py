@@ -1,0 +1,88 @@
+"""Follower-process telemetry export (PR 5 named gap): the shm ring's
+READ side records in multi-host follower processes with no stats RPC —
+followers publish snapshots to VDT_FOLLOWER_STATS_DIR, host 0's
+executor folds them into the standard worker/transport merges, and
+vdt:shm_ring_*{side="read"} reaches /metrics through the engine core's
+existing transport key."""
+
+import json
+
+from vllm_distributed_tpu.metrics import telemetry
+from vllm_distributed_tpu.metrics.prometheus import render_metrics
+
+
+class _FakeWorker:
+    def __init__(self, label):
+        self._label = label
+
+    def get_stats(self):
+        return {"workers": {self._label: {"num_recompiles": 0,
+                                          "device_memory_peak_bytes":
+                                          123}}}
+
+
+def _reader_recorder() -> telemetry.TransportRecorder:
+    rec = telemetry.TransportRecorder(enabled=True)
+    for lag in (0, 2, 5):
+        rec.record_shm("read", 0.001, lag=lag)
+    return rec
+
+
+def test_publish_and_collect_round_trip(tmp_path, monkeypatch):
+    rec = _reader_recorder()
+    monkeypatch.setattr(telemetry, "_current", rec)
+    path = telemetry.publish_follower_stats(str(tmp_path), 1,
+                                            _FakeWorker("dp0-h1"))
+    assert path and path.endswith("follower-h1.json")
+    snaps = telemetry.collect_follower_stats(str(tmp_path))
+    assert len(snaps) == 1
+    snap = snaps[0]
+    assert snap["host_rank"] == 1
+    assert snap["workers"]["dp0-h1"]["device_memory_peak_bytes"] == 123
+    shm = snap["transport"]["shm"]
+    assert shm["read"]["messages"] == 3
+    assert snap["transport"]["shm_lag_chunks"] == 5
+    # Republish overwrites in place (one file per host rank).
+    telemetry.publish_follower_stats(str(tmp_path), 1,
+                                     _FakeWorker("dp0-h1"))
+    assert len(telemetry.collect_follower_stats(str(tmp_path))) == 1
+
+
+def test_collect_skips_torn_files_and_off(tmp_path):
+    assert telemetry.collect_follower_stats("") == []
+    assert telemetry.collect_follower_stats(str(tmp_path)) == []
+    (tmp_path / "follower-h2.json").write_text("{torn")
+    (tmp_path / "follower-h3.json").write_text(
+        json.dumps({"host_rank": 3, "workers": {}, "transport":
+                    {"kv": {}, "shm": {}, "shm_lag_chunks": 0,
+                     "qcomm": {}}}))
+    snaps = telemetry.collect_follower_stats(str(tmp_path))
+    assert [s["host_rank"] for s in snaps] == [3]
+
+
+def test_follower_read_side_renders_through_standard_merge(tmp_path,
+                                                           monkeypatch):
+    """The core's own recorder (write side) + a follower snapshot
+    (read side) merge per label and render both sides of
+    vdt:shm_ring_* — exactly the DP-merge shape, one level earlier."""
+    rec = _reader_recorder()
+    monkeypatch.setattr(telemetry, "_current", rec)
+    telemetry.publish_follower_stats(str(tmp_path), 1,
+                                     _FakeWorker("dp0-h1"))
+    host0 = telemetry.TransportRecorder(enabled=True)
+    host0.record_shm("write", 0.002)
+    snaps = telemetry.collect_follower_stats(str(tmp_path))
+    merged = telemetry.merge_transport_snapshots(
+        [host0.snapshot()] + [s["transport"] for s in snaps])
+    assert merged["shm"]["read"]["messages"] == 3
+    assert merged["shm"]["write"]["messages"] == 1
+    assert merged["shm_lag_chunks"] == 5
+    text = render_metrics({"transport": merged})
+    assert 'vdt:shm_ring_messages_total{side="read"} 3' in text
+    assert 'vdt:shm_ring_messages_total{side="write"} 1' in text
+    assert "vdt:shm_ring_lag_chunks 5" in text
+    # Follower worker labels union into the standard per-worker map.
+    workers = telemetry.merge_worker_telemetry(
+        [{"dp0-h0": {"num_recompiles": 1}}] +
+        [s["workers"] for s in snaps])
+    assert set(workers) == {"dp0-h0", "dp0-h1"}

@@ -1,0 +1,111 @@
+"""CI guard: BENCH_*.json scoreboard records stay machine-comparable.
+
+Runs scripts/lint_bench.py over the real repo records (tier-1
+mechanical check) and unit-tests the linter's failure modes on
+synthetic records: a tpu capture without decode_mfu, a schema>=2 tpu
+capture without decode_mbu / engine-sourced fields, unparseable JSON,
+and the driver-wrapper shape."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_bench.py"
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _write(tmp_path, name: str, rec) -> None:
+    (tmp_path / name).write_text(
+        rec if isinstance(rec, str) else json.dumps(rec))
+
+
+GOOD_V2_TPU = {
+    "metric": "decode_throughput", "value": 448.1, "unit": "tok/s",
+    "backend": "tpu", "schema_version": 2, "decode_mfu": 0.21,
+    "decode_mbu": 0.63, "engine_mfu": 0.2, "engine_mbu": 0.6,
+}
+
+
+def test_repo_records_are_clean():
+    res = _run()
+    assert res.returncode == 0, (
+        f"BENCH record schema drifted:\n{res.stderr}")
+
+
+def test_good_v2_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V2_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_tpu_record_without_mfu_fails(tmp_path):
+    rec = dict(GOOD_V2_TPU)
+    del rec["decode_mfu"]
+    del rec["schema_version"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "decode_mfu" in res.stderr
+
+
+def test_v2_tpu_record_without_mbu_fails(tmp_path):
+    rec = dict(GOOD_V2_TPU)
+    del rec["decode_mbu"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "decode_mbu" in res.stderr
+
+
+def test_v2_record_without_engine_perf_fails(tmp_path):
+    rec = dict(GOOD_V2_TPU)
+    del rec["engine_mbu"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "engine_mbu" in res.stderr
+
+
+def test_v1_cpu_record_is_grandfathered(tmp_path):
+    # Pre-plane records carry no schema_version and no mfu on CPU.
+    _write(tmp_path, "BENCH_old.json", {
+        "metric": "decode_throughput", "value": 385.0,
+        "unit": "tok/s", "backend": "cpu-fallback"})
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_unparseable_and_bad_backend_fail(tmp_path):
+    _write(tmp_path, "BENCH_broken.json", "{not json")
+    _write(tmp_path, "BENCH_weird.json", {
+        "metric": "m", "value": 1.0, "unit": "tok/s",
+        "backend": "quantum"})
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "unparseable" in res.stderr
+    assert "quantum" in res.stderr
+
+
+def test_wrapper_shape_validates_payload(tmp_path):
+    # rc!=0 with parsed=null is a capture failure, not schema drift...
+    _write(tmp_path, "BENCH_fail.json",
+           {"n": 1, "rc": 1, "parsed": None})
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    # ...but an rc=0 wrapper must carry a record, and the payload is
+    # held to the same schema.
+    _write(tmp_path, "BENCH_empty.json",
+           {"n": 2, "rc": 0, "parsed": None})
+    bad = dict(GOOD_V2_TPU, decode_mfu=7.0)
+    _write(tmp_path, "BENCH_wrap.json", {"n": 3, "rc": 0,
+                                         "parsed": bad})
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "no parsed record" in res.stderr
+    assert "decode_mfu" in res.stderr

@@ -139,6 +139,16 @@ class EngineCore:
         from vllm_distributed_tpu import envs
         self._profile_steps = envs.VDT_PROFILE_STEPS
         self._step_seq = 0
+        # Hardened on-demand profiler capture (the profile RPC):
+        # exactly one capture at a time, auto-named trace dirs, and a
+        # monotonic deadline (VDT_PROFILE_MAX_S) after which the step
+        # loop force-stops an unstopped trace — a wedged xprof client
+        # (perf.capture_stall drill) can never wedge serving.
+        self._profile_dir: Optional[str] = None
+        self._profile_deadline = 0.0
+        self._profile_seq = 0
+        self._profile_stalled = False
+        self._profile_stop_failures = 0
         # Structured output: the grammar layer needs a token-bytes table
         # (a tokenizer load + per-token decode sweep). Prefetch it off
         # the busy loop so the FIRST structured request doesn't stall
@@ -252,8 +262,54 @@ class EngineCore:
         return jax.profiler.StepTraceAnnotation("vdt_step",
                                                 step_num=self._step_seq)
 
+    # Bounded retries for a force-stop whose stop_trace itself fails
+    # (full disk mid-export): retry once per window, then declare the
+    # jax profiler state unknown and release the capture lane.
+    _PROFILE_STOP_RETRIES = 3
+
+    def _maybe_expire_profile(self) -> None:
+        """Force-stop a profiler capture whose stop never arrived once
+        its VDT_PROFILE_MAX_S window closes (checked per step and per
+        stats poll — one None check on the hot path). State clears
+        only AFTER stop_trace succeeds: clearing first would disarm
+        this sweep while the jax trace kept running — exactly the
+        wedged state the deadline exists to prevent. A stop_trace that
+        itself fails re-arms the deadline for a bounded retry."""
+        if (self._profile_dir is None
+                or time.monotonic() < self._profile_deadline):
+            return
+        trace_dir = self._profile_dir
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - a broken trace must
+            # never take the step loop down with it.
+            self._profile_stop_failures += 1
+            if self._profile_stop_failures < self._PROFILE_STOP_RETRIES:
+                from vllm_distributed_tpu import envs
+                self._profile_deadline = (time.monotonic() +
+                                          envs.VDT_PROFILE_MAX_S)
+                logger.warning(
+                    "force-stopping overdue profiler capture failed "
+                    "(%s); retrying next window", e)
+                return
+            logger.warning(
+                "force-stopping overdue profiler capture failed %d "
+                "times (%s); releasing the capture lane with the jax "
+                "profiler state unknown", self._profile_stop_failures,
+                e)
+        else:
+            logger.warning(
+                "profiler capture exceeded its window; force-stopped "
+                "-> %s", trace_dir)
+        self._profile_dir = None
+        self._profile_stalled = False
+        self._profile_stop_failures = 0
+
     def step(self) -> list[EngineCoreOutput]:
         """One scheduling iteration (reference: core.py:223)."""
+        if self._profile_dir is not None:
+            self._maybe_expire_profile()
         if self.batch_queue is not None:
             return self.step_with_batch_queue()
         self.last_step_scheduled = False
@@ -382,6 +438,10 @@ class EngineCore:
         return self.scheduler.has_kv_transfer_work()
 
     def get_stats(self, include_events: bool = True) -> dict:
+        if self._profile_dir is not None:
+            # A wedged capture on an IDLE engine (no steps running the
+            # sweep) still expires on the next stats poll / scrape.
+            self._maybe_expire_profile()
         stats = self.scheduler.get_stats()
         stats.update(self.executor.get_stats())
         stats["inflight_batches"] = (len(self.batch_queue)
@@ -403,8 +463,19 @@ class EngineCore:
         stats["step_phase_seconds"] = phases
         # Transport telemetry: per-connector KV-transfer bytes/latency/
         # inflight and shm-ring wait/lag, recorded by everything built
-        # inside this core's construction window.
-        stats["transport"] = self.transport.snapshot()
+        # inside this core's construction window. Multi-host follower
+        # snapshots (the shm ring's read side lives in those
+        # processes) arrive from the executor and merge per label —
+        # the standard DP-merge shape, one level earlier.
+        snap = self.transport.snapshot()
+        followers = stats.pop("follower_transport", None)
+        if followers:
+            from vllm_distributed_tpu.metrics import telemetry
+            merged = telemetry.merge_transport_snapshots(
+                [snap] + list(followers))
+            if merged is not None:
+                snap = merged
+        stats["transport"] = snap
         # Lifecycle timeline: drained per stats poll, shipped over the
         # stats RPC (DP-merged by the front-end client). The drain is
         # DESTRUCTIVE — callers that may abandon the response mid-RPC
@@ -466,17 +537,59 @@ class EngineCore:
     def profile(self, action: str = "start") -> str:
         """Start/stop a device trace (reference: EngineCore.profile RPC,
         core.py:297; TPU variant tpu_worker.py:246-256 — here
-        jax.profiler, viewable in TensorBoard/XProf)."""
+        jax.profiler, viewable in TensorBoard/XProf).
+
+        Hardened for transient-tunnel use: each capture gets its own
+        auto-named directory under VDT_PROFILER_DIR (captures never
+        overwrite each other), a second concurrent start is rejected,
+        and every capture carries a VDT_PROFILE_MAX_S deadline the step
+        loop enforces — so one RPC pair always yields a self-contained
+        xplane dump even if the client (or the tunnel) dies before the
+        stop lands. Fault point ``perf.capture_stall`` simulates that
+        wedged client: the stop RPC fails and the deadline is what ends
+        the capture, counted in vdt:fault_injections_total."""
+        import os
+
         import jax
 
         from vllm_distributed_tpu import envs
-        trace_dir = envs.VDT_PROFILER_DIR
         if action == "start":
+            if self._profile_dir is not None:
+                raise ValueError(
+                    f"profiler capture already active "
+                    f"({self._profile_dir}); stop it first")
+            self._profile_seq += 1
+            trace_dir = os.path.join(
+                envs.VDT_PROFILER_DIR,
+                f"trace-{os.getpid()}-{self._profile_seq:03d}")
             jax.profiler.start_trace(trace_dir)
-            logger.info("profiling started -> %s", trace_dir)
-        else:
-            jax.profiler.stop_trace()
-            logger.info("profiling stopped -> %s", trace_dir)
+            self._profile_dir = trace_dir
+            self._profile_deadline = (time.monotonic() +
+                                      envs.VDT_PROFILE_MAX_S)
+            self._profile_stalled = (
+                fault_injection.registry.active
+                and fault_injection.registry.should_fire(
+                    "perf.capture_stall"))
+            logger.info("profiling started -> %s (window %.0fs)",
+                        trace_dir, envs.VDT_PROFILE_MAX_S)
+            return trace_dir
+        if self._profile_dir is None:
+            raise ValueError("no profiler capture active")
+        if self._profile_stalled:
+            # Drill: the xprof session is wedged — the stop is lost and
+            # only the capture-window deadline ends the trace.
+            raise RuntimeError(
+                "profiler capture is wedged (perf.capture_stall); the "
+                "capture-window deadline will force-stop it")
+        trace_dir = self._profile_dir
+        # Stop FIRST, clear after: if stop_trace raises (full disk
+        # mid-xplane-export), the capture stays armed so the deadline
+        # sweep keeps owning the cleanup instead of orphaning a live
+        # jax trace with the sweep disarmed.
+        jax.profiler.stop_trace()
+        self._profile_dir = None
+        self._profile_stop_failures = 0
+        logger.info("profiling stopped -> %s", trace_dir)
         return trace_dir
 
     def shutdown(self) -> None:

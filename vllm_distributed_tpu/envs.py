@@ -112,6 +112,30 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # (reference: VLLM_TORCH_PROFILER_DIR).
     "VDT_PROFILER_DIR":
     lambda: os.getenv("VDT_PROFILER_DIR", "/tmp/vdt_profile"),
+    # Hardened profiler capture window: seconds after which an
+    # unstopped jax.profiler trace is force-stopped by the engine core
+    # (a wedged xprof client must never wedge serving; the
+    # perf.capture_stall fault drill pins this).
+    "VDT_PROFILE_MAX_S":
+    lambda: float(os.getenv("VDT_PROFILE_MAX_S", "120")),
+    # Performance-attribution plane (metrics/costmodel.py): "1" builds
+    # the analytic per-dispatch cost model at model load and charges
+    # every runner dispatch with FLOPs/HBM bytes against measured
+    # device time (vdt:mfu / vdt:mbu / vdt:hbm_bytes_total /
+    # vdt:roofline_bound + GET /debug/perf). "0" reverts wholesale:
+    # no cost model is constructed and the runner's per-step charge is
+    # a single None check.
+    "VDT_PERF_ATTRIB":
+    lambda: os.getenv("VDT_PERF_ATTRIB", "1") == "1",
+    # Row cap of the GET /debug/perf attribution table (rows ranked by
+    # device-seconds; the response reports how many were dropped).
+    "VDT_PERF_TOPN":
+    lambda: max(1, int(os.getenv("VDT_PERF_TOPN", "20"))),
+    # Directory where multi-host follower processes publish their
+    # telemetry snapshots (shm-ring read side + device stats) for host
+    # 0's stats plane to fold in; "" disables the export.
+    "VDT_FOLLOWER_STATS_DIR":
+    lambda: os.getenv("VDT_FOLLOWER_STATS_DIR", ""),
     # Request-lifecycle event timeline (metrics/events.py): per-request
     # phase attribution (queue/kv_pull/prefill/decode/stalls) recorded
     # at lifecycle transitions and stitched into child phase spans by

@@ -122,6 +122,31 @@ class MultiHostExecutor(UniProcExecutor):
         self._broadcast(pickle.dumps(("num_blocks", num)))
         return num
 
+    def get_stats(self) -> dict:
+        """Local worker stats plus any follower-published snapshots
+        (VDT_FOLLOWER_STATS_DIR): follower worker labels union into the
+        standard per-worker map (labels are fleet-unique — dp rank +
+        host rank) and follower transport snapshots ride
+        ``follower_transport`` for the engine core to merge into its
+        own recorder's snapshot — this is where the shm ring's READ
+        side (recorded only in follower processes) reaches /metrics."""
+        stats = super().get_stats()
+        from vllm_distributed_tpu import envs
+        from vllm_distributed_tpu.metrics import telemetry
+        snaps = telemetry.collect_follower_stats(
+            envs.VDT_FOLLOWER_STATS_DIR)
+        if snaps:
+            workers = telemetry.merge_worker_telemetry(
+                [stats.get("workers")] +
+                [s.get("workers") for s in snaps])
+            if workers:
+                stats["workers"] = workers
+            transports = [s.get("transport") for s in snaps
+                          if isinstance(s.get("transport"), dict)]
+            if transports:
+                stats["follower_transport"] = transports
+        return stats
+
     def shutdown(self) -> None:
         try:
             self._broadcast(_STOP)
@@ -163,6 +188,25 @@ def run_worker_follower(config: EngineConfig,
     worker.load_model()
     worker.determine_num_available_blocks()  # mirrors host 0's profile
 
+    # Telemetry export (VDT_FOLLOWER_STATS_DIR): this process is where
+    # the shm ring's read side records (the MessageQueue above captured
+    # the process recorder) — publish snapshots so host 0's executor
+    # can fold them into the standard stats merge.
+    from vllm_distributed_tpu import envs
+    from vllm_distributed_tpu.metrics import telemetry
+    stats_dir = envs.VDT_FOLLOWER_STATS_DIR
+    _PUBLISH_EVERY = 32
+
+    def publish() -> None:
+        if not stats_dir:
+            return
+        try:
+            telemetry.publish_follower_stats(stats_dir, pc.host_rank,
+                                             worker)
+        except Exception as e:  # noqa: BLE001 - telemetry must never
+            # kill a follower mid-pod.
+            logger.warning("follower stats publish failed: %s", e)
+
     steps = 0
     while True:
         payload = sub.recv()
@@ -174,10 +218,14 @@ def run_worker_follower(config: EngineConfig,
         if isinstance(msg, tuple) and msg[0] == "init_kv":
             worker.initialize_kv_cache(msg[1])
             worker.compile_or_warm_up_model()
+            publish()
             continue
         worker.execute_model(msg)  # output identical to host 0's; drop
         steps += 1
+        if steps % _PUBLISH_EVERY == 0:
+            publish()
         if max_steps is not None and steps >= max_steps:
             break
+    publish()
     logger.info("follower done after %d steps", steps)
     return steps

@@ -121,6 +121,12 @@ _COUNTERS = {
                               "Checkpoint-journal files reclaimed by "
                               "the retention sweep (TTL expiry + "
                               "size-budget eviction at init/sleep)"),
+    # Performance-attribution plane (metrics/costmodel.py): analytic
+    # model FLOPs charged per dispatch, summed across DP replicas.
+    "model_flops": ("vdt:model_flops_total",
+                    "Analytic model FLOPs charged for dispatched "
+                    "waves (useful FLOPs over real tokens; the "
+                    "vdt:mfu numerator)"),
 }
 
 
@@ -151,6 +157,13 @@ LABELED_METRICS = {
     # TPLA latent-pool geometry (ops/mla.py; MLA models only).
     "vdt:tpla_latent_shards": ("worker", ),
     "vdt:mla_latent_page_bytes": ("worker", ),
+    # Performance-attribution plane (metrics/costmodel.py): per-worker
+    # utilization ratios, analytic HBM traffic by kind, and the
+    # per-phase roofline placement.
+    "vdt:mfu": ("worker", ),
+    "vdt:mbu": ("worker", ),
+    "vdt:hbm_bytes_total": ("kind", ),
+    "vdt:roofline_bound": ("phase", ),
     # Telemetry plane: per-connector KV transfer + shm ring.
     "vdt:kv_transfer_bytes_total": ("connector", "direction"),
     "vdt:kv_transfer_failures_total": ("connector", ),
@@ -306,6 +319,14 @@ def _render_worker_telemetry(workers: dict) -> list[str]:
         ("mla_latent_page_bytes", "vdt:mla_latent_page_bytes", "gauge",
          "Per-rank HBM bytes one MLA latent page costs (1/TP of the "
          "replicated row under TPLA, plus the rope sidecar)"),
+        # Performance-attribution plane: this worker's analytic FLOPs
+        # / bytes over its measured device time against its mesh peak.
+        ("mfu", "vdt:mfu", "gauge",
+         "Model FLOPs utilization: analytic useful FLOPs over "
+         "measured device seconds x mesh peak FLOP/s"),
+        ("mbu", "vdt:mbu", "gauge",
+         "Memory-bandwidth utilization: analytic HBM bytes over "
+         "measured device seconds x mesh peak bandwidth"),
     )
     for key, name, kind, help_text in families:
         series = [(w, s[key]) for w, s in sorted(workers.items())
@@ -433,6 +454,46 @@ def _render_qcomm(transport_qcomm) -> list[str]:
               "inapplicable axis, sub-byte dtype)",
               f"# TYPE {name} counter",
               f"{name} {sum(int(e['fallbacks']) for e in merged.values())}"]
+    return lines
+
+
+def _render_perf(stats: dict) -> list[str]:
+    """Performance-attribution families: analytic HBM traffic by kind
+    and the per-phase roofline placement, classified at RENDER time
+    from the (possibly DP-merged) phase accumulators + hardware peaks
+    — classifications are never merged, only recomputed."""
+    lines: list[str] = []
+    hbm = stats.get("hbm_bytes")
+    if isinstance(hbm, dict) and hbm:
+        name = "vdt:hbm_bytes_total"
+        lines += [f"# HELP {name} Analytic HBM bytes charged for "
+                  "dispatched waves, by traffic kind (weights = "
+                  "streamed parameters, kv_read/kv_write = paged KV + "
+                  "SSM state rows, activations = residual stream + "
+                  "logits)",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{kind="{k}"}} {int(hbm[k])}'
+                  for k in sorted(hbm)
+                  if isinstance(hbm[k], (int, float))]
+    phases = stats.get("perf_phases")
+    peaks = stats.get("perf_peaks")
+    if (isinstance(phases, dict) and phases
+            and isinstance(peaks, dict)):
+        from vllm_distributed_tpu.metrics.costmodel import (
+            ROOFLINE_CODES, classify_roofline)
+        name = "vdt:roofline_bound"
+        lines += [f"# HELP {name} Roofline placement of each step "
+                  "phase from measured device time vs analytic "
+                  "FLOPs/bytes (0 = host-bound, 1 = bandwidth-bound, "
+                  "2 = compute-bound)",
+                  f"# TYPE {name} gauge"]
+        for phase in sorted(phases):
+            entry = phases[phase]
+            if not isinstance(entry, dict):
+                continue
+            bound = classify_roofline(entry, peaks)
+            lines.append(f'{name}{{phase="{phase}"}} '
+                         f'{ROOFLINE_CODES[bound]}')
     return lines
 
 
@@ -575,6 +636,7 @@ def render_metrics(stats: dict) -> str:
         lines += _render_transport(transport)
     lines += _render_qcomm((transport or {}).get("qcomm")
                            if isinstance(transport, dict) else None)
+    lines += _render_perf(stats)
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:
         lines += _render_kv_cache(kv_cache)

@@ -254,6 +254,63 @@ def now() -> float:
 
 
 # ---------------------------------------------------------------------------
+# Follower-process stats export (closes the PR 5 named gap: the shm
+# ring's READ side lives in multi-host follower processes that have no
+# stats RPC — they publish snapshots to a shared directory and host 0's
+# executor folds them into the standard worker/transport merges, so
+# vdt:shm_ring_*{side="read"} and follower device telemetry reach
+# /metrics like any DP leg).
+# ---------------------------------------------------------------------------
+
+def publish_follower_stats(stats_dir: str, host_rank: int,
+                           worker) -> Optional[str]:
+    """Atomically write one follower's telemetry snapshot (its labeled
+    worker map + its process recorder, which captured the shm-ring
+    dequeues) to ``stats_dir``. tmp+rename so host 0 never reads a
+    torn file; one file per host rank so republishing overwrites in
+    place."""
+    import json
+    import os
+    if not stats_dir:
+        return None
+    stats = worker.get_stats() if worker is not None else {}
+    payload = {
+        "host_rank": int(host_rank),
+        "workers": stats.get("workers") or {},
+        "transport": current_recorder().snapshot(),
+    }
+    os.makedirs(stats_dir, exist_ok=True)
+    path = os.path.join(stats_dir, f"follower-h{host_rank}.json")
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def collect_follower_stats(stats_dir: str) -> list:
+    """Read every published follower snapshot under ``stats_dir``
+    (empty list when the export is off or nothing published yet);
+    unreadable/torn files are skipped, never fatal to a stats poll."""
+    import glob
+    import json
+    import os
+    if not stats_dir or not os.path.isdir(stats_dir):
+        return []
+    out = []
+    for path in sorted(glob.glob(os.path.join(stats_dir,
+                                              "follower-h*.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                snap = json.load(f)
+        except Exception:  # noqa: BLE001 - mid-write/corrupt file
+            continue
+        if isinstance(snap, dict):
+            out.append(snap)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # DP-merge helpers (labels preserved; counters summed exactly once)
 # ---------------------------------------------------------------------------
 

@@ -81,6 +81,13 @@ but never fired by production code):
   the per-tenant cooldown hysteresis bounds it: a tenant oscillating
   around its quota falls back to ordinary capacity preemption between
   quota evictions instead of livelocking in evict/resume cycles.
+* ``perf.capture_stall`` — the profiler capture started by the
+  profile RPC (engine/core.py) behaves as a WEDGED xprof session: the
+  stop RPC fails (the stop is "lost") and only the VDT_PROFILE_MAX_S
+  capture-window deadline, enforced by the step loop and stats polls,
+  force-stops the trace. The drill proves a profiler client that dies
+  (or a tunnel that drops) mid-capture can never wedge serving, with
+  the fire counted in ``vdt:fault_injections_total``.
 """
 
 import threading
@@ -107,6 +114,7 @@ FAULT_POINTS = (
     "qcomm.scale_corrupt",
     "disagg.handoff_stall",
     "sched.quota_thrash",
+    "perf.capture_stall",
 )
 
 

@@ -276,6 +276,24 @@ class TPUModelRunner:
         self._block_fusion_memo: Optional[bool] = None
         self.block_fusion_calls = 0
         self.block_fusion_fallbacks: dict[str, int] = {}
+        # Performance-attribution plane (metrics/costmodel.py): the
+        # loader priced the model once (arch.cost_model; None when
+        # VDT_PERF_ATTRIB=0). Every dispatch is charged analytic FLOPs
+        # and HBM bytes keyed by (kernel family, phase, token bucket)
+        # and reconciled against the measured device wait in
+        # wait_model — the numerators behind vdt:mfu / vdt:mbu /
+        # vdt:hbm_bytes_total{kind} / vdt:roofline_bound{phase} and the
+        # GET /debug/perf table. All dict-bump accounting on the
+        # single engine-core thread; get_stats snapshots read
+        # GIL-atomically like the other runner counters.
+        self._perf_memo: Optional[bool] = None
+        self._perf_attrib: dict[str, dict] = {}
+        self._perf_phases: dict[str, dict] = {}
+        self._perf_bytes = {"weights": 0.0, "kv_read": 0.0,
+                            "kv_write": 0.0, "activations": 0.0}
+        self._perf_flops = 0.0
+        self._perf_device_s = 0.0
+        self._perf_dispatches = 0
         # SSM state-snapshot pool (core/state_cache.py): per-state-array
         # device buffers of `resolve_state_slots` slots, written/read by
         # the scheduler's state_saves/state_restores directives. Built
@@ -1514,15 +1532,33 @@ class TPUModelRunner:
             self._poll_kv_connector(scheduler_output, out)
             return {"ready": out}
         if scheduler_output.multi_step > 1:
-            return {"ready": self._execute_multi_step(scheduler_output)}
+            # Perf attribution: the burst blocks for its device results
+            # inside _execute_multi_step, so the elapsed wall here IS
+            # the dispatch's device time as this worker sees it (the
+            # same approximation vdt:device_wait_seconds makes).
+            pending = self._perf_charge(
+                scheduler_output, self._multi_step_label(),
+                pad_to_bucket(len(scheduler_output.num_scheduled_tokens),
+                              self.req_buckets),
+                n_steps=scheduler_output.multi_step)
+            t_burst = time.perf_counter() if pending is not None else 0.0
+            out = self._execute_multi_step(scheduler_output)
+            if pending is not None:
+                self._perf_commit(pending,
+                                  time.perf_counter() - t_burst)
+            return {"ready": out}
 
         t_prep = time.perf_counter()
         (token_ids, batch, logits_indices, sampling_md, sampling_req_ids,
          fwd_shape, R, spec_pack, ext_md, want_topk, vocab_mask,
          plp, chain) = self._prepare_inputs(scheduler_output)
-        self.prepare_inputs_hist.observe(time.perf_counter() - t_prep)
-        self._count_attn_dispatch(self._attn_kernel_label(batch))
+        prep_s = time.perf_counter() - t_prep
+        self.prepare_inputs_hist.observe(prep_s)
+        attn_label = self._attn_kernel_label(batch)
+        self._count_attn_dispatch(attn_label)
         self._count_block_fusion(batch)
+        perf = self._perf_charge(scheduler_output, attn_label,
+                                 fwd_shape[0])
         drafts_arr, q_ids, q_probs, spec_truncate = spec_pack
         if chain is not None:
             # Async run-ahead rows: substitute the previous dispatch's
@@ -1572,7 +1608,8 @@ class TPUModelRunner:
                 "sampling_req_ids": sampling_req_ids,
                 "drafts_arr": drafts_arr, "R": R,
                 "specv": spec_q is not None,
-                "plp_meta": plp[2] if plp else None}
+                "plp_meta": plp[2] if plp else None,
+                "perf": perf, "perf_prep_s": prep_s}
 
     def wait_model(self, handle: dict) -> ModelRunnerOutput:
         """Blocking half: fetch the sampled tokens, fold them into the
@@ -1588,7 +1625,11 @@ class TPUModelRunner:
         # Device-vs-host attribution: this fetch is where the host
         # blocks on the device (everything since dispatch ran async), so
         # its duration IS the step's device wait as seen by this worker.
-        t_wait = time.perf_counter() if self._device_telemetry else 0.0
+        # The perf-attribution plane rides the same timing pair to
+        # charge the dispatch's analytic FLOPs/bytes against it.
+        perf = handle.get("perf")
+        timing = self._device_telemetry or perf is not None
+        t_wait = time.perf_counter() if timing else 0.0
         if handle.get("specv"):
             verify = handle["dev"][0]
             (accept_np, residual_np, bonus_np, lp_cand_np,
@@ -1598,8 +1639,13 @@ class TPUModelRunner:
         else:
             tokens_np, logprobs_np, topk_np = self._fetch_sample(
                 handle["dev"])
-        if self._device_telemetry:
-            self.device_wait_hist.observe(time.perf_counter() - t_wait)
+        if timing:
+            wait_s = time.perf_counter() - t_wait
+            if self._device_telemetry:
+                self.device_wait_hist.observe(wait_s)
+            if perf is not None:
+                self._perf_commit(perf, wait_s,
+                                  handle.get("perf_prep_s", 0.0))
 
         # Embedding requests: the pooled hidden state of the sampled row
         # is the result; no token is emitted (reference: pooling path of
@@ -2012,17 +2058,7 @@ class TPUModelRunner:
             self, scheduler_output: SchedulerOutput) -> ModelRunnerOutput:
         """Run scheduler_output.multi_step fused decode steps (pure-decode
         batch; one host roundtrip for the whole burst)."""
-        from vllm_distributed_tpu.ops.attention import \
-            resolve_attention_backend
-        # The burst's in-jit batches carry no partition descriptor, so
-        # they ride the legacy SB decode kernel on the Pallas backend
-        # (and window/softcap/ALiBi/sink models the XLA path — those
-        # features reach Pallas only through the descriptor).
-        self._count_attn_dispatch(
-            "decode" if (resolve_attention_backend() == "pallas"
-                         and not self._model_routes_xla()
-                         and not self._model_has_attn_features())
-            else "naive")
+        self._count_attn_dispatch(self._multi_step_label())
         self._count_block_fusion(reason="multi_step")
         ib = self.input_batch
         n_steps = scheduler_output.multi_step
@@ -2140,6 +2176,109 @@ class TPUModelRunner:
     def _count_attn_dispatch(self, label: str) -> None:
         self.attn_kernel_calls[label] = (
             self.attn_kernel_calls.get(label, 0) + 1)
+
+    # ------------------------------------------------------------------
+    # Performance-attribution plane (metrics/costmodel.py)
+    # ------------------------------------------------------------------
+    def _cost_model(self):
+        """The loader-attached analytic cost model; None = plane off
+        (VDT_PERF_ATTRIB=0) and every per-step perf hook is this one
+        memoized check."""
+        memo = self._perf_memo
+        if memo is None:
+            if self.model is None:
+                return None
+            self._perf_cm = getattr(self.model.cfg, "cost_model", None)
+            self._perf_memo = memo = self._perf_cm is not None
+        return self._perf_cm if memo else None
+
+    def _multi_step_label(self) -> str:
+        """Kernel family the fused multi-step burst dispatches: the
+        in-jit batches carry no partition descriptor, so they ride the
+        legacy SB decode kernel on the Pallas backend (and
+        window/softcap/ALiBi/sink models the XLA path)."""
+        from vllm_distributed_tpu.ops.attention import \
+            resolve_attention_backend
+        return ("decode" if (resolve_attention_backend() == "pallas"
+                             and not self._model_routes_xla()
+                             and not self._model_has_attn_features())
+                else "naive")
+
+    def _perf_charge(self, scheduler_output, label: str, bucket: int,
+                     n_steps: int = 1):
+        """Analytic price of one dispatch, from the scheduler grant +
+        the input batch's pre-step context lengths: (attribution key,
+        phase, WaveCost) — or None with the plane off / nothing
+        scheduled. FLOPs count real (unpadded) tokens; attention pairs
+        clamp to a uniform sliding window; a multi-step burst charges
+        n_steps in-graph decode steps with the KV span growing per
+        step."""
+        cm = self._cost_model()
+        if cm is None:
+            return None
+        ib = self.input_batch
+        prefill_toks = 0
+        decode_toks = 0
+        kv_terms = 0.0
+        for rid, n in scheduler_output.num_scheduled_tokens.items():
+            row = ib.req_id_to_index.get(rid)
+            if row is not None:
+                ctx = float(ib.num_computed[row])
+                # Phase by the PROMPT boundary, not the grant width: a
+                # spec-decode verify wave grants 1+k tokens but is
+                # decode, and a chunked prefill's final 1-token chunk
+                # is still prefill — the grant-width heuristic would
+                # mislabel both and corrupt the roofline buckets.
+                generating = ctx >= float(ib.prompt_len[row])
+            else:
+                ctx, generating = 0.0, False
+            if n_steps > 1:
+                n = n_steps
+            kv_terms += cm.span_sum(ctx, n)
+            if generating:
+                decode_toks += n
+            else:
+                prefill_toks += n
+        total = prefill_toks + decode_toks
+        if total == 0:
+            return None
+        rows = len(scheduler_output.num_scheduled_tokens) * n_steps
+        cost = cm.wave_cost(total, kv_terms, rows, passes=n_steps)
+        phase = ("decode" if prefill_toks == 0
+                 else "prefill" if decode_toks == 0 else "mixed")
+        return (f"{label}/{phase}/b{bucket}", phase, cost)
+
+    def _perf_commit(self, pending, device_s: float,
+                     host_s: float = 0.0) -> None:
+        """Reconcile one priced dispatch against its measured device
+        wait. Single engine-core thread; stats polls snapshot with
+        GIL-atomic dict copies."""
+        key, phase, cost = pending
+        e = self._perf_attrib.get(key)
+        if e is None:
+            e = self._perf_attrib[key] = {
+                "device_seconds": 0.0, "flops": 0.0, "bytes": 0.0,
+                "dispatches": 0}
+        e["device_seconds"] += device_s
+        e["flops"] += cost.flops
+        e["bytes"] += cost.total_bytes
+        e["dispatches"] += 1
+        p = self._perf_phases.get(phase)
+        if p is None:
+            p = self._perf_phases[phase] = {
+                "device_seconds": 0.0, "host_seconds": 0.0,
+                "flops": 0.0, "bytes": 0.0}
+        p["device_seconds"] += device_s
+        p["host_seconds"] += host_s
+        p["flops"] += cost.flops
+        p["bytes"] += cost.total_bytes
+        self._perf_bytes["weights"] += cost.weight_bytes
+        self._perf_bytes["kv_read"] += cost.kv_read_bytes
+        self._perf_bytes["kv_write"] += cost.kv_write_bytes
+        self._perf_bytes["activations"] += cost.act_bytes
+        self._perf_flops += cost.flops
+        self._perf_device_s += device_s
+        self._perf_dispatches += 1
 
     # ------------------------------------------------------------------
     @contextmanager
@@ -2503,6 +2642,25 @@ class TPUModelRunner:
                 getattr(self.model.cfg, "tpla_shards", 1) or 1)
             stats["mla_latent_page_bytes"] = int(
                 self.model.kv_cache_page_bytes(self.page_size))
+        cm = self._cost_model()
+        if cm is not None and self._perf_dispatches:
+            # Performance-attribution plane: analytic totals + the
+            # per-(kernel, phase, bucket) attribution table and phase
+            # accumulators (roofline classification happens at render
+            # time from the DP-merged accumulators, never by merging
+            # classifications). mfu/mbu move into workers[label] at the
+            # worker layer — the DP numeric-sum must not add ratios.
+            dev_s = self._perf_device_s
+            stats["model_flops"] = self._perf_flops
+            stats["hbm_bytes"] = dict(self._perf_bytes)
+            stats["perf_attrib"] = {k: dict(v)
+                                    for k, v in self._perf_attrib.items()}
+            stats["perf_phases"] = {k: dict(v)
+                                    for k, v in self._perf_phases.items()}
+            stats["perf_peaks"] = {"flops": cm.peak_flops,
+                                   "hbm": cm.peak_hbm}
+            stats["mfu"] = cm.mfu(self._perf_flops, dev_s)
+            stats["mbu"] = cm.mbu(sum(self._perf_bytes.values()), dev_s)
         if self._device_telemetry:
             from vllm_distributed_tpu.metrics import telemetry
             stats["device_wait_seconds"] = self.device_wait_hist.to_dict()
