@@ -1603,6 +1603,182 @@ def _block_fusion_leg(config, prompts, sp, record) -> None:
                 os.environ[k] = v
 
 
+def _hist_percentile_ms(h, q: float):
+    """Approximate percentile (ms) from a serialized histogram dict:
+    the upper bound of the bucket where the cumulative count crosses
+    q. +Inf tail falls back to the last finite bound."""
+    if not isinstance(h, dict) or not h.get("count"):
+        return None
+    target = q * h["count"]
+    cum = 0
+    for bound, c in zip(h["buckets"], h["counts"]):
+        cum += int(c)
+        if cum >= target:
+            return round(float(bound) * 1e3, 3)
+    return round(float(h["buckets"][-1]) * 1e3, 3)
+
+
+def _tiering_leg(config, record) -> None:
+    """Hierarchical KV-memory acceptance leg (ISSUE 15): multi-turn
+    session traffic whose combined prefix working set runs well past a
+    PINNED device page budget (num_gpu_blocks_override — plain
+    num_gpu_blocks is overwritten by profiling, the PR 13 trap), with
+    VDT_KV_TIERING on vs off on byte-identical traffic. The host
+    budget is sized to ~half the device pool so host-pool eviction
+    exercises the disk tier too. Reports window hit rate, turns/s,
+    promotion p50/p95, demotion bytes by tier, greedy parity, and the
+    corrupt-spill drill (every disk read corrupted for one extra
+    turn -> recompute, token-identical, misses counted)."""
+    import gc
+    import shutil
+    import tempfile
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.utils import fault_injection as fi
+
+    hf = config.model_config.hf_config
+    block_size, pool_pages = 16, 64
+    # Analytic per-page KV bytes of the bench model (checkpoint KV
+    # heads, serving dtype) -> host budget = half the device pool, so
+    # the session working set spills through BOTH tiers.
+    head_dim = getattr(hf, "head_dim", None) or (
+        hf.hidden_size // hf.num_attention_heads)
+    dtype_bytes = 2 if config.model_config.dtype == "bfloat16" else 4
+    page_bytes = (2 * hf.num_hidden_layers * hf.num_key_value_heads *
+                  block_size * head_dim * dtype_bytes)
+    host_mb = (pool_pages // 2) * page_bytes / 2**20
+
+    # 8 sessions x 256-token base prompts = 2x the 1024-token pool at
+    # turn 0, ~2.7x by the last turn: with tiering OFF every returning
+    # session re-prefills (its pages were evicted for the other
+    # sessions), ON restores the prefix from the tiers.
+    sessions, turns = 8, 4
+    rng = np.random.default_rng(15)
+    base_prompts = [[int(x) for x in rng.integers(10, 5000, size=256)]
+                    for _ in range(sessions)]
+    sp_g = SamplingParams(temperature=0.0, max_tokens=16,
+                          ignore_eos=True)
+    keys = ("VDT_KV_TIERING", "VDT_KV_TIER_HOST_MB", "VDT_KV_TIER_DIR",
+            "VDT_KV_TIER_DEMOTE_PAGES")
+    saved = {k: os.environ.get(k) for k in keys}
+    tier_dir = tempfile.mkdtemp(prefix="vdt_bench_kv_tier_")
+
+    def run_turn(engine, prompts, outs, leg, turn):
+        for s in range(sessions):
+            engine.add_request(f"{leg}-s{s}t{turn}", list(prompts[s]),
+                               sp_g)
+        while engine.has_unfinished_requests():
+            for o in engine.step():
+                if o.finished:
+                    outs[f"s{o.request_id.split('-s')[1]}"] = \
+                        list(o.outputs[0].token_ids)
+        for s in range(sessions):
+            gen = outs[f"s{s}t{turn}"]
+            prompts[s] = prompts[s] + gen + [
+                int(x) for x in rng.integers(10, 5000, size=16)]
+
+    outputs = {}
+    prompts_by_leg = {}
+    engines = {}
+    try:
+        for leg, flag in (("off", "0"), ("on", "1")):
+            rng = np.random.default_rng(151)
+            os.environ["VDT_KV_TIERING"] = flag
+            os.environ["VDT_KV_TIER_HOST_MB"] = f"{host_mb:.4f}"
+            os.environ["VDT_KV_TIER_DIR"] = tier_dir
+            # A session wave can evict >64 pages in one admission
+            # round; the default per-step demote cap would drop the
+            # tail and starve the tiers the leg measures.
+            os.environ["VDT_KV_TIER_DEMOTE_PAGES"] = "256"
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                cache_config=CacheConfig(
+                    block_size=block_size,
+                    num_gpu_blocks_override=pool_pages),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=256, max_num_seqs=8,
+                    max_model_len=2048, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            engines[leg] = engine
+            prompts = [list(p) for p in base_prompts]
+            outs: dict = {}
+            # Warmup turn (unmeasured): compiles every bucket the
+            # measured turns hit; its prefixes also SEED the tier so
+            # the measured window includes tier restores.
+            run_turn(engine, prompts, outs, leg, 0)
+            t0 = time.perf_counter()
+            for turn in range(1, turns):
+                run_turn(engine, prompts, outs, leg, turn)
+            wall = time.perf_counter() - t0
+            outputs[leg] = dict(outs)
+            prompts_by_leg[leg] = prompts
+            n_turns = sessions * (turns - 1)
+            record[f"tiering_{leg}_turns_per_s"] = round(
+                n_turns / wall, 3)
+            stats = engine.get_stats()
+            kv = stats.get("kv_cache") or {}
+            record[f"tiering_{leg}_hit_rate_window"] = round(
+                kv.get("window_hits", 0)
+                / max(kv.get("window_queries", 0), 1), 4)
+            if flag == "1":
+                tier = stats.get("kv_tier") or {}
+                record["tiering_promote_p50_ms"] = _hist_percentile_ms(
+                    tier.get("promotion_seconds"), 0.50)
+                record["tiering_promote_p95_ms"] = _hist_percentile_ms(
+                    tier.get("promotion_seconds"), 0.95)
+                for t in ("host", "disk"):
+                    record[f"tiering_demotion_bytes_{t}"] = int(
+                        (tier.get("demotion_bytes") or {}).get(t, 0))
+                    record[f"tiering_promotions_{t}"] = int(
+                        (tier.get("promotions") or {}).get(t, 0))
+                record["tiering_pages_host"] = int(
+                    (tier.get("pages") or {}).get("host", 0))
+                record["tiering_pages_disk"] = int(
+                    (tier.get("pages") or {}).get("disk", 0))
+        # Session working set vs the pinned pool (the leg's premise).
+        total_tokens = sum(len(p) for p in prompts_by_leg["on"])
+        record["tiering_working_set_x"] = round(
+            total_tokens / (pool_pages * block_size), 2)
+        record["tiering_parity"] = outputs["on"] == outputs["off"]
+
+        # Corrupt-spill drill: one extra turn with EVERY disk read
+        # corrupted — tiering must degrade to recompute and stay
+        # token-identical to the untiered engine's same turn.
+        fi.registry.inject("kv_tier.spill_corrupt", rate=1.0)
+        try:
+            drill: dict = {}
+            for leg in ("off", "on"):
+                rng = np.random.default_rng(1515)
+                outs: dict = {}
+                run_turn(engines[leg], prompts_by_leg[leg], outs, leg,
+                         turns)
+                drill[leg] = outs
+        finally:
+            fi.clear("kv_tier.spill_corrupt")
+        record["tiering_drill_spill_corrupt_parity"] = (
+            drill["on"] == drill["off"])
+        on_stats = engines["on"].get_stats()
+        record["tiering_drill_disk_misses"] = int(
+            ((on_stats.get("kv_tier") or {}).get("misses")
+             or {}).get("disk", 0))
+    finally:
+        for e in engines.values():
+            del e
+        engines.clear()
+        gc.collect()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> None:
     from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
                                              LoadConfig, ModelConfig,
@@ -1758,7 +1934,9 @@ def main() -> None:
     dev_s = device_decode["s"]
     record = {
         "metric": "decode_throughput_llama1b_bs8",
-        "schema_version": 2,
+        # v3: _tiering_leg fields (or tiering_leg_error) are required —
+        # scripts/lint_bench.py keeps future records machine-comparable.
+        "schema_version": 3,
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
@@ -1897,6 +2075,12 @@ def main() -> None:
             _ssm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
+        # Hierarchical KV-memory leg: session working set past the
+        # pinned device pool, tiering on vs off + corrupt-spill drill.
+        try:
+            _tiering_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["tiering_leg_error"] = f"{type(e).__name__}: {e}"
         # Quantized-communication leg: dcn_pull transfer bytes + parity
         # with the int8 KV codec on vs off.
         try:
@@ -1979,6 +2163,10 @@ def main() -> None:
             _ssm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["ssm_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _tiering_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["tiering_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _qcomm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

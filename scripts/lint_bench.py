@@ -19,6 +19,12 @@ a record drifts:
   backend (analytic fallback values count — the keys must exist and be
   numeric). Records WITHOUT ``schema_version`` are grandfathered
   pre-plane captures and validate against the v1 rules only.
+* **schema_version >= 3 records** (the hierarchical KV-memory plane)
+  must additionally carry the ``_tiering_leg`` comparison — turns/s
+  and window hit rate for both legs plus the greedy-parity flag — or
+  an explicit ``tiering_leg_error`` string recording why the leg
+  could not run. A parity field that is present must be ``true``:
+  tiering is contractually token-invisible.
 
 Usage::
 
@@ -84,6 +90,39 @@ def check_record(name: str, rec) -> list:
                         f"{name}: schema>=2 record needs engine-"
                         f"sourced {key} (analytic fallback counts), "
                         f"got {rec.get(key)!r}")
+        if version >= 3:
+            errs.extend(_check_tiering_fields(name, rec))
+    return errs
+
+
+# _tiering_leg comparison fields required on schema >= 3 records
+# ((validator, description) per field; see bench.py _tiering_leg).
+TIERING_FIELDS = {
+    "tiering_on_turns_per_s": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "tiering_off_turns_per_s": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "tiering_on_hit_rate_window": (
+        lambda v: _is_num(v) and 0 <= v <= 1, "number in [0, 1]"),
+    "tiering_off_hit_rate_window": (
+        lambda v: _is_num(v) and 0 <= v <= 1, "number in [0, 1]"),
+    "tiering_parity": (lambda v: v is True,
+                       "true (tiering must be token-invisible)"),
+}
+
+
+def _check_tiering_fields(name: str, rec: dict) -> list:
+    err = rec.get("tiering_leg_error")
+    if err is not None:
+        if isinstance(err, str) and err:
+            return []  # leg failed and says why — valid record
+        return [f"{name}: tiering_leg_error must be a non-empty "
+                f"string, got {err!r}"]
+    errs = []
+    for key, (ok, want) in TIERING_FIELDS.items():
+        if not ok(rec.get(key)):
+            errs.append(f"{name}: schema>=3 record needs {key} "
+                        f"({want}), got {rec.get(key)!r}")
     return errs
 
 

@@ -1,0 +1,590 @@
+"""Hierarchical KV/state memory (core/kv_tier.py, ISSUE 15).
+
+Acceptance contract: ``VDT_KV_TIERING=0`` (the default) constructs no
+tier state anywhere (byte-identical revert); with tiering ON, demote/
+promote round-trips are bit-exact (fp32 + bf16), host/disk budgets
+hold, LRU order governs spills, a corrupt spill file degrades to a
+clean recompute (fault point ``kv_tier.spill_corrupt``) — never wrong
+tokens — SSM snapshot eviction demotes to the checkpoint journal, the
+router scores residency by restore cost, and an engine serving a
+session working set past its pinned device pool shows a strictly
+higher prefix window hit rate with greedy outputs token-identical to
+the untiered engine."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_config, make_request
+from vllm_distributed_tpu.core.kv_cache_utils import hash_block_tokens
+from vllm_distributed_tpu.core.kv_tier import (TIER_DISK, TIER_GONE,
+                                               TIER_HOST, KVTierManager,
+                                               maybe_kv_tier)
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _chain(n: int, salt: int = 0):
+    """n chained BlockHashes (page size 2 tokens)."""
+    out, parent = [], None
+    for i in range(n):
+        bh = hash_block_tokens(parent, (salt * 1000 + 2 * i,
+                                        salt * 1000 + 2 * i + 1))
+        out.append(bh)
+        parent = bh.hash_value
+    return out
+
+
+def _page(seed: int, dtype=np.float32):
+    """One wire-layout page pair [L, KVH, PS, D]."""
+    rng = np.random.default_rng(seed)
+    shape = (2, 2, 4, 8)
+    k = rng.standard_normal(shape, np.float32)
+    v = rng.standard_normal(shape, np.float32)
+    return k.astype(dtype), v.astype(dtype)
+
+
+def _dtype_params():
+    import ml_dtypes
+    return [np.float32, ml_dtypes.bfloat16]
+
+
+PAGE_BYTES = 2 * (2 * 2 * 4 * 8) * 4  # one fp32 page pair
+
+
+# ---------------------------------------------------------------------------
+# Tier-manager units
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", _dtype_params(),
+                         ids=["fp32", "bf16"])
+def test_host_round_trip_bit_exact(dtype):
+    mgr = KVTierManager(host_budget_bytes=1 << 20)
+    (bh, ) = _chain(1)
+    k, v = _page(0, dtype)
+    mgr.insert_host(bh.hash_value, k, v)
+    tier, k2, v2 = mgr.lookup(bh)
+    assert tier == "host"
+    assert k2.dtype == k.dtype and v2.dtype == v.dtype
+    assert k2.tobytes() == k.tobytes()
+    assert v2.tobytes() == v.tobytes()
+    assert mgr.demotions == {"host": 1, "disk": 0}
+
+
+@pytest.mark.parametrize("dtype", _dtype_params(),
+                         ids=["fp32", "bf16"])
+def test_disk_round_trip_bit_exact(tmp_path, dtype):
+    # Host pool fits ONE page: the second insert spills the first to
+    # disk, and the spill file (shared_storage page-file format)
+    # restores bit-exact — including bfloat16, which rides the raw-
+    # bytes sidecar codec because numpy cannot round-trip it as .npy.
+    k0, v0 = _page(0, dtype)
+    mgr = KVTierManager(host_budget_bytes=k0.nbytes + v0.nbytes,
+                        disk_dir=str(tmp_path))
+    a, b = _chain(2)
+    mgr.insert_host(a.hash_value, k0, v0)
+    mgr.insert_host(b.hash_value, *_page(1, dtype))
+    tier, k2, v2 = mgr.lookup(a)
+    assert tier == "disk"
+    assert k2.dtype == k0.dtype
+    assert k2.tobytes() == k0.tobytes()
+    assert v2.tobytes() == v0.tobytes()
+    assert mgr.demotions["disk"] == 1
+    assert os.path.exists(os.path.join(
+        str(tmp_path), f"{a.hash_value.hex()}.npz"))
+
+
+def test_lru_spill_order(tmp_path):
+    # Budget holds two pages; A (oldest) spills first. Touching B via
+    # lookup moves it most-recently-used, so the next insert spills C.
+    mgr = KVTierManager(host_budget_bytes=2 * PAGE_BYTES,
+                        disk_dir=str(tmp_path))
+    a, b, c, d = _chain(4)
+    for i, bh in enumerate((a, b, c)):
+        mgr.insert_host(bh.hash_value, *_page(i))
+    assert set(mgr._disk) == {a.hash_value}
+    assert mgr.lookup(b)[0] == "host"  # touch -> MRU
+    mgr.insert_host(d.hash_value, *_page(3))
+    assert set(mgr._disk) == {a.hash_value, c.hash_value}
+    assert set(mgr._host) == {b.hash_value, d.hash_value}
+
+
+def test_budget_enforcement(tmp_path):
+    host_budget = 2 * PAGE_BYTES
+    mgr = KVTierManager(host_budget_bytes=host_budget,
+                        disk_dir=str(tmp_path),
+                        # Disk fits ~2 compressed pages at most.
+                        disk_budget_bytes=2 * PAGE_BYTES)
+    hashes = _chain(8)
+    for i, bh in enumerate(hashes):
+        mgr.insert_host(bh.hash_value, *_page(i))
+        assert mgr._host_bytes <= host_budget
+    assert mgr._disk_bytes <= 2 * PAGE_BYTES
+    # Oldest spill files were deleted past the disk budget.
+    on_disk = {n for n in os.listdir(str(tmp_path))
+               if n.endswith(".npz")}
+    assert len(on_disk) == len(mgr._disk) < 6
+    stats = mgr.stats()
+    assert stats["pages"]["host"] == 2
+    assert stats["bytes"]["host"] == mgr._host_bytes
+    # Transitions recorded host demotions, disk spills and evictions.
+    codes = {c for _, c in stats["transitions"]}
+    assert {TIER_HOST, TIER_DISK, TIER_GONE} <= codes
+
+
+def test_spill_corrupt_drill_degrades_to_miss(tmp_path):
+    k0, v0 = _page(0)
+    mgr = KVTierManager(host_budget_bytes=k0.nbytes + v0.nbytes,
+                        disk_dir=str(tmp_path))
+    a, b = _chain(2)
+    mgr.insert_host(a.hash_value, k0, v0)
+    mgr.insert_host(b.hash_value, *_page(1))  # spills a to disk
+    fi.registry.inject("kv_tier.spill_corrupt", rate=1.0, max_fires=1)
+    assert mgr.lookup(a) is None  # clean miss, never bad bytes
+    assert mgr.misses["disk"] == 1
+    # Quarantined: the corrupt file is gone, later lookups miss fast.
+    assert not os.path.exists(os.path.join(
+        str(tmp_path), f"{a.hash_value.hex()}.npz"))
+    assert mgr.lookup(a) is None
+    assert fi.counters().get("kv_tier.spill_corrupt") == 1
+
+
+def test_shape_foreign_spill_is_miss_not_deleted(tmp_path):
+    mgr = KVTierManager(host_budget_bytes=1 << 20,
+                        disk_dir=str(tmp_path))
+    mgr.wire_shapes = ((2, 2, 4, 8), (2, 2, 4, 8))
+    (a, ) = _chain(1)
+    # A foreign store's page: same key namespace, different geometry.
+    from vllm_distributed_tpu.distributed.kv_transfer import \
+        shared_storage
+    k = np.zeros((3, 2, 4, 8), np.float32)
+    shared_storage.write_page_file(
+        os.path.join(str(tmp_path), f"{a.hash_value.hex()}.npz"), k, k)
+    assert mgr.lookup(a) is None
+    assert mgr.misses["disk"] == 1
+    # Someone else's valid page: ignored, never deleted.
+    assert os.path.exists(os.path.join(
+        str(tmp_path), f"{a.hash_value.hex()}.npz"))
+
+
+def test_foreign_spill_rejection_keeps_bytes_accounting(tmp_path):
+    # De-indexing a shape-foreign file must subtract its bytes: a
+    # bare pop would leave phantom bytes that eventually convince the
+    # budget sweep to delete the tier's own valid spills.
+    mgr = KVTierManager(host_budget_bytes=1 << 20,
+                        disk_dir=str(tmp_path))
+    mgr.wire_shapes = ((2, 2, 4, 8), (2, 2, 4, 8))
+    (a, ) = _chain(1)
+    from vllm_distributed_tpu.distributed.kv_transfer import \
+        shared_storage
+    k = np.zeros((3, 2, 4, 8), np.float32)
+    shared_storage.write_page_file(
+        os.path.join(str(tmp_path), f"{a.hash_value.hex()}.npz"), k, k)
+    # Warm start indexes the foreign file (its shape is unknowable
+    # without reading it)...
+    fresh = KVTierManager(host_budget_bytes=1 << 20,
+                          disk_dir=str(tmp_path))
+    fresh.wire_shapes = ((2, 2, 4, 8), (2, 2, 4, 8))
+    assert fresh._disk_bytes > 0
+    # ...and the rejecting lookup de-indexes it bytes and all.
+    assert fresh.lookup(a) is None
+    assert fresh._disk_bytes == 0 and not fresh._disk
+
+
+def test_re_eviction_of_tiered_page_retags_router():
+    # Demote -> promote -> evict again: the dedup path must still
+    # emit the tier transition or the router scores the page at full
+    # HBM credit forever.
+    mgr = KVTierManager(host_budget_bytes=1 << 20)
+    (a, ) = _chain(1)
+    mgr.insert_host(a.hash_value, *_page(0))
+    mgr.stats()  # drain the demotion transition
+    mgr.note_evicted(7, a)
+    assert mgr.take_demotes(True) is None  # content-addressed dedupe
+    assert mgr.stats()["transitions"] == [(a.hash_value.hex(),
+                                           TIER_HOST)]
+
+
+def test_match_prefix_stages_and_memoizes(tmp_path):
+    k0, v0 = _page(0)
+    mgr = KVTierManager(host_budget_bytes=k0.nbytes + v0.nbytes,
+                        disk_dir=str(tmp_path))
+    hashes = _chain(4)
+    mgr.insert_host(hashes[2].hash_value, k0, v0)
+    mgr.insert_host(hashes[3].hash_value, *_page(1))  # spills [2]
+    # Device holds pages [0, 1]; the tier serves [2, 3]; page size 2,
+    # prompt 9 tokens -> max 8 cacheable tokens = all 4 pages.
+    n = mgr.match_prefix("r1", hashes, start=2, max_tokens=8,
+                         block_size=2)
+    assert n == 2
+    # Memoized retry: corrupt the spill file under the stash — the
+    # blocked-queue-head retry must NOT re-read disk (content-
+    # addressed arrays never go stale).
+    path = os.path.join(str(tmp_path),
+                        f"{hashes[2].hash_value.hex()}.npz")
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    assert mgr.match_prefix("r1", hashes, start=2, max_tokens=8,
+                            block_size=2) == 2
+    hits = mgr.take_hits("r1")
+    assert [h[0] for h in hits] == [hashes[2].hash_value,
+                                    hashes[3].hash_value]
+    assert {h[1] for h in hits} == {"host", "disk"}
+    assert hits[0][2].tobytes() == k0.tobytes()
+    assert mgr.take_hits("r1") is None  # consumed
+
+
+def test_last_token_never_served_from_tier():
+    mgr = KVTierManager(host_budget_bytes=1 << 20)
+    hashes = _chain(2)
+    for i, bh in enumerate(hashes):
+        mgr.insert_host(bh.hash_value, *_page(i))
+    # Prompt of exactly 4 tokens (2 pages): the last token must still
+    # be computed to produce a logit, so only page 0 may hit.
+    assert mgr.match_prefix("r1", hashes, start=0, max_tokens=3,
+                            block_size=2) == 1
+
+
+def test_disk_warm_start(tmp_path):
+    k0, v0 = _page(0)
+    mgr = KVTierManager(host_budget_bytes=k0.nbytes + v0.nbytes,
+                        disk_dir=str(tmp_path))
+    a, b = _chain(2)
+    mgr.insert_host(a.hash_value, k0, v0)
+    mgr.insert_host(b.hash_value, *_page(1))
+    # A respawned engine scans the surviving spill files and serves
+    # them — fleet-scale session memory across restarts.
+    fresh = KVTierManager(host_budget_bytes=1 << 20,
+                          disk_dir=str(tmp_path))
+    assert a.hash_value in fresh._disk
+    tier, k2, _ = fresh.lookup(a)
+    assert tier == "disk" and k2.tobytes() == k0.tobytes()
+
+
+def test_demote_cap_drops_excess():
+    mgr = KVTierManager(host_budget_bytes=1 << 20,
+                        demote_pages_per_step=2)
+    for i, bh in enumerate(_chain(5)):
+        mgr.note_evicted(i, bh)
+    directive = mgr.take_demotes(True)
+    assert len(directive.page_ids) == 2
+    assert mgr.demotes_dropped == 3
+    # A (defensive) zero-work step drops queued demotes instead of
+    # gathering stale device contents.
+    for i, bh in enumerate(_chain(2, salt=1)):
+        mgr.note_evicted(i, bh)
+    assert mgr.take_demotes(False) is None
+    assert mgr.demotes_dropped == 5
+
+
+# ---------------------------------------------------------------------------
+# Kill switch / construction gates
+# ---------------------------------------------------------------------------
+def test_maybe_kv_tier_default_off_and_gates(monkeypatch):
+    config = make_config()
+    assert maybe_kv_tier(config) is None  # default env: no tier state
+    monkeypatch.setenv("VDT_KV_TIERING", "1")
+    assert maybe_kv_tier(config) is not None
+    assert maybe_kv_tier(config, kv_connector=object()) is None
+    config.parallel_config.token_parallel_size = 2
+    assert maybe_kv_tier(config) is None
+
+
+def test_scheduler_off_by_default_constructs_nothing():
+    from vllm_distributed_tpu.core.sched.scheduler import Scheduler
+    sched = Scheduler(make_config())
+    assert sched.kv_tier is None
+    assert sched.kv_cache_manager.tier is None
+    assert sched.kv_cache_manager.block_pool.on_evict is None
+    assert "kv_tier" not in sched.get_stats()
+
+
+def test_scheduler_tier_wiring(monkeypatch, tmp_path):
+    monkeypatch.setenv("VDT_KV_TIERING", "1")
+    monkeypatch.setenv("VDT_KV_TIER_DIR", str(tmp_path))
+    from vllm_distributed_tpu.core.sched.scheduler import Scheduler
+    sched = Scheduler(make_config())
+    assert sched.kv_tier is not None
+    assert sched.kv_cache_manager.tier is sched.kv_tier
+    assert (sched.kv_cache_manager.block_pool.on_evict
+            == sched.kv_tier.note_evicted)
+    assert "kv_tier" in sched.get_stats()
+
+
+# ---------------------------------------------------------------------------
+# SSM snapshot journal-demotion (state_cache second tier)
+# ---------------------------------------------------------------------------
+def test_ssm_eviction_demotes_to_journal_and_restores(tmp_path):
+    import ml_dtypes
+
+    from vllm_distributed_tpu.core.state_cache import (StateCacheManager,
+                                                       write_journal)
+    mgr = StateCacheManager(num_slots=1, block_size=4, interval=4,
+                            paged_kv=False, journal_dir=str(tmp_path),
+                            demote_on_evict=True)
+    req1 = make_request(num_tokens=8, token_ids=list(range(10, 18)))
+    d1 = mgr.maybe_save(req1, 4)
+    assert d1 is not None
+    mgr.commit_save(d1, req1)  # committed; journal file NOT yet written
+
+    # Pool full + journal file missing: eviction DEMOTES (owes a
+    # persist_only directive, slot pinned) instead of discarding.
+    req2 = make_request(num_tokens=8, token_ids=list(range(50, 58)))
+    assert mgr.maybe_save(req2, 4) is None  # no slot until it ships
+    assert mgr.journal_demotions == 1
+    persists = mgr.take_persists()
+    assert len(persists) == 1 and persists[0].persist_only
+    # Simulate the runner shipping the owed journal write.
+    arrays = {"conv": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "ssm": np.ones((2, 2), ml_dtypes.bfloat16)}
+    write_journal(persists[0].journal, arrays, 4)
+
+    # With the file on disk the LRU victim now evicts normally...
+    d2 = mgr.maybe_save(req2, 4)
+    assert d2 is not None
+    assert mgr.evictions == 1
+    mgr.commit_save(d2, req2)
+
+    # ...and a returning session restores the DEMOTED snapshot from
+    # the journal, bit-exact (fp32 + bf16 rows).
+    req1b = make_request(num_tokens=8, token_ids=list(range(10, 18)))
+    blocks, boundary, restore = mgr.get_computed_state(req1b, None)
+    assert boundary == 4 and restore is not None
+    assert restore.slot == -1 and restore.journal
+    got = restore.arrays
+    assert got["conv"].tobytes() == arrays["conv"].tobytes()
+    assert got["ssm"].tobytes() == arrays["ssm"].tobytes()
+    assert got["ssm"].dtype == arrays["ssm"].dtype
+
+
+def test_ssm_no_demote_without_flag(tmp_path):
+    from vllm_distributed_tpu.core.state_cache import StateCacheManager
+    mgr = StateCacheManager(num_slots=1, block_size=4, interval=4,
+                            paged_kv=False, journal_dir=str(tmp_path))
+    req1 = make_request(num_tokens=8, token_ids=list(range(10, 18)))
+    d1 = mgr.maybe_save(req1, 4)
+    mgr.commit_save(d1, req1)
+    req2 = make_request(num_tokens=8, token_ids=list(range(50, 58)))
+    # Pre-tiering behavior: the victim is discarded outright.
+    assert mgr.maybe_save(req2, 4) is not None
+    assert mgr.journal_demotions == 0
+    assert mgr.evictions == 1
+
+
+# ---------------------------------------------------------------------------
+# Router tier-aware scoring
+# ---------------------------------------------------------------------------
+def _router(n=2):
+    from vllm_distributed_tpu.engine.router import ReplicaRouter
+    return ReplicaRouter(n, make_config())
+
+
+def test_router_tier_credits_order():
+    r = _router(3)
+    hashes = [bh.hash_value for bh in _chain(4)]
+    for rep in range(3):
+        r._register(rep, hashes)
+    r.on_demote(1, hashes, 1)  # whole prefix in host RAM
+    r.on_demote(2, hashes, 2)  # whole prefix on disk
+    a0, a1, a2 = (r._affinity(i, hashes) for i in range(3))
+    assert a0 == pytest.approx(1.0)
+    assert a0 > a1 > a2 > 0.0  # device > host > disk > nothing
+    # Promotion back to HBM restores full credit.
+    r.on_demote(2, hashes, 0)
+    assert r._affinity(2, hashes) == pytest.approx(1.0)
+
+
+def test_router_on_evict_drops_and_ignores_unknown():
+    r = _router()
+    hashes = [bh.hash_value for bh in _chain(2)]
+    r._register(0, hashes)
+    r.on_evict(0, [hashes[0]])
+    assert r._affinity(0, hashes) == 0.0  # leading page gone
+    # Demoting a hash we never tracked must not insert it.
+    unknown = _chain(1, salt=9)[0].hash_value
+    r.on_demote(0, [unknown], 1)
+    assert unknown not in r._residency[0]
+
+
+def test_router_observe_stats_applies_transition_feed():
+    r = _router()
+    hashes = [bh.hash_value for bh in _chain(2)]
+    r._register(0, hashes)
+    stats = {"num_running_reqs": 0, "kv_cache_usage": 0.0,
+             "kv_tier": {"transitions": [
+                 (hashes[0].hex(), 2),
+                 (hashes[1].hex(), -1),
+                 ("zz-not-hex", 1),  # garbage entries are ignored
+             ]}}
+    r.observe_stats(0, stats)
+    assert r._residency[0][hashes[0]][1] == TIER_DISK
+    assert hashes[1] not in r._residency[0]
+
+
+def test_router_routes_to_cheapest_restore():
+    r = _router()
+    for i in range(2):
+        r.observe_stats(i, {"num_running_reqs": 0,
+                            "num_waiting_reqs": 0,
+                            "kv_cache_usage": 0.0})
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    from vllm_distributed_tpu.request import EngineCoreRequest
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    req = EngineCoreRequest(request_id="r", prompt_token_ids=prompt,
+                            sampling_params=SamplingParams())
+    hashes = r.request_hashes(req)
+    assert hashes
+    r._register(0, hashes)
+    r._register(1, hashes)
+    r.on_demote(0, hashes, 2)  # replica 0 only has it on disk
+    assert r.route(req, [0, 0], set()) == 1
+    r.on_admit(req, 1)
+    assert r.affinity_hits == 1
+
+
+def test_dp_merge_sums_kv_tier_per_leaf():
+    """DP aggregation: per-tier leaves sum, the promotion histogram
+    merges element-wise, and the (router-consumed) transition feed
+    never reaches the merged view."""
+    from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+    dp = DPEngineClient.__new__(DPEngineClient)
+    dp.clients = [object(), object()]
+    dp._live = [set(), set()]
+    dp._down = set()
+    dp.replica_failovers = 0
+    dp.replica_resurrections = 0
+
+    def tier_stats(n):
+        return {"pages": {"host": n, "disk": 2 * n},
+                "demotions": {"host": 3 * n, "disk": n},
+                "demotes_dropped": n,
+                "promotion_seconds": {"buckets": [0.01, 0.1],
+                                      "counts": [n, 0, 0],
+                                      "sum": 0.01 * n, "count": n},
+                "transitions": [("ab" * 16, 1)]}
+
+    agg = dp._aggregate_stats([{"kv_tier": tier_stats(1)},
+                               {"kv_tier": tier_stats(2)}])
+    tier = agg["kv_tier"]
+    assert tier["pages"] == {"host": 3, "disk": 6}
+    assert tier["demotions"] == {"host": 9, "disk": 3}
+    assert tier["demotes_dropped"] == 3
+    assert tier["promotion_seconds"]["count"] == 3
+    assert "transitions" not in tier
+
+
+# ---------------------------------------------------------------------------
+# Engine-level gate: greedy token parity + strictly better window hit
+# rate with the session working set past the pinned device pool, both
+# tiers exercised, corrupt-spill drill degrading to recompute.
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=512, eos_token_id=1)
+    hf = HFLlama(cfg).eval()
+    path = tmp_path_factory.mktemp("tiny_llama_tier")
+    hf.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def _make_engine(path):
+    from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    return LLMEngine(EngineArgs(
+        model=path, dtype="float32", block_size=4,
+        num_gpu_blocks_override=24, max_model_len=256,
+        max_num_batched_tokens=64, max_num_seqs=4,
+        skip_tokenizer_init=True).create_engine_config())
+
+
+def _run_turns(engine, prompts, outs, turn):
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    for s in range(len(prompts)):
+        engine.add_request(
+            f"s{s}t{turn}", list(prompts[s]),
+            SamplingParams(temperature=0.0, max_tokens=4,
+                           ignore_eos=True))
+    for _ in range(300):
+        for out in engine.step():
+            if out.finished:
+                outs[out.request_id] = list(out.outputs[0].token_ids)
+        if not engine.has_unfinished_requests():
+            break
+    assert not engine.has_unfinished_requests()
+    for s in range(len(prompts)):
+        prompts[s] = prompts[s] + outs[f"s{s}t{turn}"] + [90 + s, 91]
+
+
+def _run_sessions(engine, turns=3):
+    """3 sessions x N turns of growing prompts: the combined prefix
+    working set runs well past the 24-page (96-token) device pool."""
+    outs: dict = {}
+    prompts = [[2 + s] * 40 for s in range(3)]
+    for turn in range(turns):
+        _run_turns(engine, prompts, outs, turn)
+    return outs, prompts
+
+
+def test_engine_parity_and_hit_rate_tiering_on_vs_off(
+        checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("VDT_KV_TIERING", "0")
+    e_off = _make_engine(checkpoint)
+    base, base_prompts = _run_sessions(e_off)
+    off_stats = e_off.get_stats()
+    assert "kv_tier" not in off_stats
+
+    monkeypatch.setenv("VDT_KV_TIERING", "1")
+    # Host pool ~10 pages: forces host->disk spills so BOTH tiers
+    # serve promotions.
+    monkeypatch.setenv("VDT_KV_TIER_HOST_MB", "0.02")
+    monkeypatch.setenv("VDT_KV_TIER_DIR", str(tmp_path))
+    e_on = _make_engine(checkpoint)
+    tiered, on_prompts = _run_sessions(e_on)
+    assert tiered == base  # greedy token-identical, tier on vs off
+    on_stats = e_on.get_stats()
+    tier = on_stats["kv_tier"]
+    assert tier["demotions"]["host"] > 0
+    assert tier["demotions"]["disk"] > 0
+    assert (tier["promotions"]["host"] + tier["promotions"]["disk"]) > 0
+    assert tier["promotion_seconds"]["count"] > 0
+
+    # Strictly better prefix window hit rate with tiering on.
+    kv_off, kv_on = off_stats["kv_cache"], on_stats["kv_cache"]
+    rate_off = kv_off["window_hits"] / max(kv_off["window_queries"], 1)
+    rate_on = kv_on["window_hits"] / max(kv_on["window_queries"], 1)
+    assert rate_on > rate_off
+
+    # Metrics render end to end.
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics(on_stats)
+    assert 'vdt:kv_tier_pages{tier="host"}' in text
+    assert 'vdt:kv_tier_demotions_total{tier="disk"}' in text
+    assert "vdt:kv_tier_promotion_seconds_count" in text
+
+    # Corrupt-spill drill: with every disk read corrupted, the next
+    # turn DEGRADES to recompute — outputs stay identical to the
+    # untiered engine's same turn, never wrong tokens.
+    fi.registry.inject("kv_tier.spill_corrupt", rate=1.0)
+    outs_off: dict = {}
+    outs_on: dict = {}
+    _run_turns(e_off, base_prompts, outs_off, 3)
+    _run_turns(e_on, on_prompts, outs_on, 3)
+    assert outs_on == outs_off
+    assert e_on.get_stats()["kv_tier"]["misses"]["disk"] > 0
+    e_off.shutdown()
+    e_on.shutdown()

@@ -31,6 +31,13 @@ GOOD_V2_TPU = {
     "decode_mbu": 0.63, "engine_mfu": 0.2, "engine_mbu": 0.6,
 }
 
+GOOD_V3_TPU = {
+    **GOOD_V2_TPU, "schema_version": 3,
+    "tiering_on_turns_per_s": 1.4, "tiering_off_turns_per_s": 1.1,
+    "tiering_on_hit_rate_window": 0.7,
+    "tiering_off_hit_rate_window": 0.4, "tiering_parity": True,
+}
+
 
 def test_repo_records_are_clean():
     res = _run()
@@ -109,3 +116,44 @@ def test_wrapper_shape_validates_payload(tmp_path):
     assert res.returncode == 1
     assert "no parsed record" in res.stderr
     assert "decode_mfu" in res.stderr
+
+
+def test_good_v3_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V3_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_v3_record_without_tiering_fields_fails(tmp_path):
+    rec = dict(GOOD_V3_TPU)
+    del rec["tiering_on_turns_per_s"]
+    del rec["tiering_parity"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "tiering_on_turns_per_s" in res.stderr
+    assert "tiering_parity" in res.stderr
+
+
+def test_v3_parity_false_fails(tmp_path):
+    # Tiering is contractually token-invisible: a recorded parity
+    # failure is schema drift, not a shrug.
+    _write(tmp_path, "BENCH_x.json",
+           dict(GOOD_V3_TPU, tiering_parity=False))
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "token-invisible" in res.stderr
+
+
+def test_v3_leg_error_is_accepted(tmp_path):
+    rec = {k: v for k, v in GOOD_V3_TPU.items()
+           if not k.startswith("tiering_")}
+    rec["tiering_leg_error"] = "RuntimeError: no devices"
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    # ...but an empty error string is not an excuse.
+    rec["tiering_leg_error"] = ""
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1

@@ -116,6 +116,13 @@ class BlockPool:
         # scheduler drains them each step into the KV event publisher
         # (reference: block_pool's kv_cache_events plumbing).
         self.pending_events: Optional[list] = None
+        # Hierarchical KV tiering (core/kv_tier.py): called with
+        # (block_id, block_hash) when a hashed free page is popped for
+        # reuse, BEFORE the hash is dropped — the tier queues the
+        # page's content for a pre-forward demotion gather instead of
+        # letting the prefix vanish. None = pages evict silently
+        # (pre-tiering behavior).
+        self.on_evict = None
 
     def enable_events(self) -> None:
         self.pending_events = []
@@ -178,6 +185,12 @@ class BlockPool:
 
     def _maybe_evict_cached_block(self, block: KVCacheBlock) -> None:
         if block.block_hash is not None:
+            if self.on_evict is not None:
+                # Demote instead of discard: the tier snapshots this
+                # page's content pre-forward (the popped page is handed
+                # to its new owner this very step, so the callback must
+                # fire at the pop, not later).
+                self.on_evict(block.block_id, block.block_hash)
             self.cached_block_hash_to_block.pop(
                 block.block_hash.hash_value, None)
             if self.pending_events is not None:

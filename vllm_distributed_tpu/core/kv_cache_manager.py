@@ -44,10 +44,19 @@ class KVCacheManager:
         enable_caching: bool = True,
         id_offset: int = 0,
         free_window: Optional[int] = None,
+        tier=None,
     ) -> None:
         self.block_size = block_size
         self.enable_caching = enable_caching
         self.block_pool = BlockPool(num_blocks, enable_caching, id_offset)
+        # Hierarchical KV tiering (core/kv_tier.py): evicted prefix
+        # pages demote to host RAM / disk instead of vanishing, and
+        # get_computed_blocks extends a device-cached prefix with
+        # tier-resident continuation pages. None = untiered
+        # (byte-identical pre-tiering behavior).
+        self.tier = tier
+        if tier is not None:
+            self.block_pool.on_evict = tier.note_evicted
         # Sliding-window page freeing (reference: the SlidingWindowManager
         # of v1/core/single_type_kv_cache_manager.py:444 replacing
         # out-of-window blocks with the null block): when EVERY attention
@@ -114,10 +123,23 @@ class KVCacheManager:
             if block is None:
                 break
             computed.append(block)
-        if computed:
+        # Tier continuation (core/kv_tier.py): extend the device-
+        # resident prefix with pages whose content lives in host RAM /
+        # disk. The hit arrays are staged on the tier manager under the
+        # request id; the scheduler allocates device pages for the span
+        # and ships a promote directive the runner executes before the
+        # forward. The span counts as computed tokens — it is, the
+        # bytes just live one tier down.
+        num_tier = 0
+        if self.tier is not None:
+            num_tier = self.tier.match_prefix(
+                request.request_id, block_hashes, len(computed),
+                max_cache_hit_tokens, self.block_size)
+        if computed or num_tier:
             self.prefix_cache_hits += 1
-        self._recent_queries.append(1 if computed else 0)
-        return KVCacheBlocks(computed), len(computed) * self.block_size
+        self._recent_queries.append(1 if (computed or num_tier) else 0)
+        return (KVCacheBlocks(computed),
+                (len(computed) + num_tier) * self.block_size)
 
     def allocate_slots(
         self,
@@ -258,6 +280,8 @@ class KVCacheManager:
         """Forget the request's hash list (on finish — distinct from free()
         because preempted requests keep hashes for re-prefill)."""
         self.req_to_block_hashes.pop(request.request_id, None)
+        if self.tier is not None:
+            self.tier.drop_request(request.request_id)
 
     def transfer_ownership(self, old_id: str, new_id: str) -> None:
         """Re-key a request's page ownership (scheduler watchdog: pages

@@ -1,0 +1,488 @@
+"""Hierarchical KV memory: host-RAM and disk spill tiers behind the
+device page pool.
+
+HBM is the admission ceiling everywhere in the stack (ROADMAP item 3):
+TPLA bought ~TP× latent-page capacity and then stopped, and an evicted
+prefix page was simply discarded — the prefix cache was a per-replica
+LRU caching minutes, not hours, of session history. This module gives
+``BlockPool`` a spill hierarchy behind the device pool:
+
+* **T1 — pinned host RAM** (budget ``VDT_KV_TIER_HOST_MB``): a prefix
+  page evicted by ``BlockPool._maybe_evict_cached_block`` demotes its
+  CONTENT to a bounded host pool instead of vanishing. The device->host
+  copy rides ``page_io.gather_pages_start`` pre-forward (program order
+  guarantees the pre-overwrite bytes) and completes off the hot path,
+  overlapping the step's forward.
+* **T2 — disk** (``VDT_KV_TIER_DIR``, budget ``VDT_KV_TIER_DISK_MB``):
+  host-pool eviction demotes to one page file per page, reusing the
+  shared_storage connector's page-file format + CRC + quantized-codec
+  machinery (``distributed/kv_transfer/shared_storage.py`` /
+  ``quant.py``) under the same content-addressed ``BlockHash`` keys —
+  disagg handoffs, shared-storage stores and tier restores share ONE
+  namespace, and a respawned engine warm-starts from whatever spill
+  files survive.
+
+Promotion is the reverse path: ``KVCacheManager.get_computed_blocks``
+extends a WAITING request's device-cached prefix with tier-resident
+pages; the scheduler allocates fresh device pages for the span and the
+runner scatters the staged content back (batched host->device via the
+existing ``page_io`` device leg) BEFORE the forward. A corrupt or
+missing spill file (fault point ``kv_tier.spill_corrupt``) is detected
+at the scheduler-side lookup — a clean miss that recomputes, never
+wrong tokens.
+
+Everything here is content-addressed: equal ``BlockHash`` chains imply
+equal token prefixes, so a demoted page's bytes never go stale, and a
+promotion back to the device re-registers the same hash in the prefix
+index. The manager is pure host-side control+data plane (numpy only,
+no jax): the scheduler owns the bookkeeping and ships
+``kv_demotes``/``kv_promotes`` directives on ``SchedulerOutput``; the
+runner executes the device legs.
+
+``VDT_KV_TIERING=0`` (the default) constructs nothing — every hook is
+a short-circuited None check and behavior is byte-identical.
+"""
+
+import os
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics.stats import Histogram
+from vllm_distributed_tpu.utils import fault_injection
+
+logger = init_logger(__name__)
+
+# Router-facing tier codes (engine/router.py residency tagging).
+TIER_DEVICE = 0
+TIER_HOST = 1
+TIER_DISK = 2
+TIER_GONE = -1
+
+# Promotion-latency buckets: host promotions are sub-millisecond page
+# scatters, disk promotions pay a file read + decode first.
+_PROMOTE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def maybe_kv_tier(config, kv_connector=None) -> "Optional[KVTierManager]":
+    """Construct the tier manager when ``VDT_KV_TIERING=1`` and the
+    deployment shape supports it; None otherwise (every scheduler/
+    runner hook is then a short-circuited None check — byte-identical
+    revert). The tier needs one scheduler driving one flat runner
+    (no token-parallel page partitions whose ids live in per-rank
+    pools, no PP stage split runner-side, single host) and no KV
+    connector (a connector's delay_caching/deferred-free lifecycle
+    would race the tier's eviction hook over the same pages), plus
+    prefix caching on (no hashes, nothing to key spills by)."""
+    from vllm_distributed_tpu import envs
+    if not envs.VDT_KV_TIERING:
+        return None
+    pc = config.parallel_config
+    if (pc.token_parallel_size > 1 or pc.pipeline_parallel_size > 1
+            or pc.num_hosts > 1 or kv_connector is not None
+            or not config.cache_config.enable_prefix_caching):
+        logger.info("KV tiering requested but unsupported for this "
+                    "deployment shape (tknp/pp/multi-host/connector/"
+                    "caching-off); running untiered")
+        return None
+    page_tokens = 0
+    try:
+        page_tokens = int(config.cache_config.block_size)
+    except (TypeError, ValueError):
+        pass
+    mgr = KVTierManager(
+        host_budget_bytes=int(envs.VDT_KV_TIER_HOST_MB * 2**20),
+        disk_dir=envs.VDT_KV_TIER_DIR,
+        disk_budget_bytes=int(envs.VDT_KV_TIER_DISK_MB * 2**20),
+        demote_pages_per_step=envs.VDT_KV_TIER_DEMOTE_PAGES)
+    logger.info(
+        "KV tiering on: host budget %g MiB%s (page size %d tokens)",
+        envs.VDT_KV_TIER_HOST_MB,
+        f", disk tier {mgr.disk_dir} ({envs.VDT_KV_TIER_DISK_MB:g} MiB)"
+        if mgr.disk_dir else ", disk tier off", page_tokens)
+    return mgr
+
+
+@dataclass
+class DemoteDirective:
+    """One step's batched demotion: the runner gathers ``page_ids``
+    (device pages just evicted+reassigned this step — their pre-forward
+    contents are the evicted prefixes) and inserts each page's wire
+    slice into the host tier under its content hash."""
+
+    page_ids: list[int]
+    keys: list[bytes]
+
+
+@dataclass
+class PromoteDirective:
+    """One admitted request's tier restore: scatter ``arrays`` (wire-
+    layout per-page (k, v) pairs, staged by the scheduler-side lookup
+    so a host-pool eviction between admission and dispatch cannot
+    invalidate the hit) into the freshly allocated ``page_ids`` BEFORE
+    the forward. ``tiers`` records each page's source ("host"/"disk")
+    for the promotion counters."""
+
+    req_id: str
+    page_ids: list[int]
+    keys: list[bytes]
+    tiers: list[str]
+    arrays: list  # [(k_np, v_np)] aligned with page_ids
+
+
+@dataclass
+class _HostPage:
+    k: np.ndarray
+    v: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+@dataclass
+class KVTierManager:
+    """Bookkeeping + host data plane for the two spill tiers. Lives on
+    the scheduler; the runner holds the same (in-proc) reference for
+    the device legs. All mutation happens on the engine-core thread
+    (schedule() and dispatch run on one thread); ``stats()`` runs on
+    the stats-RPC caller's thread and therefore snapshots containers
+    GIL-atomically before iterating."""
+
+    host_budget_bytes: int = 512 * 2**20
+    disk_dir: str = ""
+    disk_budget_bytes: int = 4096 * 2**20
+    demote_pages_per_step: int = 64
+
+    # T1: content hash -> host page, LRU order (oldest first).
+    _host: "OrderedDict[bytes, _HostPage]" = field(
+        default_factory=OrderedDict)
+    _host_bytes: int = 0
+    # T2 index: content hash -> file bytes, insertion order (oldest
+    # first — the budget sweep's eviction order).
+    _disk: "OrderedDict[bytes, int]" = field(default_factory=OrderedDict)
+    _disk_bytes: int = 0
+    # Wire-layout per-page shapes ((k, v), page axis removed), wired by
+    # the engine core from the runner at init. Disk files (possibly
+    # written by another engine sharing the directory) are validated
+    # against these before a hit is admitted.
+    wire_shapes: Optional[tuple] = None
+    # Evictions observed this schedule() (BlockPool on_evict hook),
+    # drained into one DemoteDirective per step.
+    _pending_demotes: list = field(default_factory=list)
+    # req_id -> [(key, tier, k, v)] staged tier hits (get_computed_
+    # blocks lookup; consumed at admission, dropped on finish).
+    _pending_hits: dict = field(default_factory=dict)
+    # Tier transitions for the router's residency index ((hex, code)),
+    # drained via get_stats -> router.observe_stats. Bounded: overflow
+    # drops oldest — the router's hints degrade, nothing breaks.
+    _transitions: deque = field(
+        default_factory=lambda: deque(maxlen=1024))
+
+    # Counters (stats()).
+    demotions: dict = field(
+        default_factory=lambda: {"host": 0, "disk": 0})
+    demotion_bytes: dict = field(
+        default_factory=lambda: {"host": 0, "disk": 0})
+    promotions: dict = field(
+        default_factory=lambda: {"host": 0, "disk": 0})
+    misses: dict = field(default_factory=lambda: {"host": 0, "disk": 0})
+    demotes_dropped: int = 0
+    promotion_hist: Histogram = field(
+        default_factory=lambda: Histogram(_PROMOTE_BUCKETS))
+
+    def __post_init__(self) -> None:
+        if self.disk_dir:
+            os.makedirs(self.disk_dir, exist_ok=True)
+            self._scan_disk()
+
+    # ------------------------------------------------------------------
+    # T2 file namespace (the shared_storage page-file namespace: one
+    # <hash hex>.npz per page, content-addressed).
+    # ------------------------------------------------------------------
+    def _file(self, key: bytes) -> str:
+        return os.path.join(self.disk_dir, f"{key.hex()}.npz")
+
+    def _scan_disk(self) -> None:
+        """Warm-start the T2 index from surviving spill files (mtime
+        order, so the budget sweep still evicts oldest-first). Files
+        from a previous incarnation — or another replica sharing the
+        directory — ARE the fleet-scale session memory; content
+        addressing makes them safe to serve once their shape checks."""
+        entries = []
+        for name in os.listdir(self.disk_dir):
+            if not name.endswith(".npz") or name.startswith("ssm_"):
+                continue
+            try:
+                key = bytes.fromhex(name[:-4])
+            except ValueError:
+                continue
+            path = os.path.join(self.disk_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, key, st.st_size))
+        entries.sort()
+        for _, key, size in entries:
+            self._disk[key] = size
+            self._disk_bytes += size
+        if entries:
+            logger.info("KV tier warm start: %d spill pages (%.1f MiB) "
+                        "in %s", len(entries),
+                        self._disk_bytes / 2**20, self.disk_dir)
+
+    # ------------------------------------------------------------------
+    # Demotion (BlockPool eviction hook -> directive -> runner insert)
+    # ------------------------------------------------------------------
+    def note_evicted(self, block_id: int, block_hash) -> None:
+        """BlockPool._maybe_evict_cached_block callback: the page id is
+        being reassigned this step; queue its content for a pre-forward
+        gather unless the hash is already tiered (content-addressed
+        dedupe — a re-demotion would buy nothing). A deduped eviction
+        still emits the tier transition: the DEVICE copy is gone, and
+        without the retag the router would keep scoring a promoted-
+        then-re-evicted page at full HBM credit forever."""
+        key = block_hash.hash_value
+        if key in self._host:
+            self._transitions.append((key.hex(), TIER_HOST))
+            return
+        if key in self._disk:
+            self._transitions.append((key.hex(), TIER_DISK))
+            return
+        if len(self._pending_demotes) >= self.demote_pages_per_step:
+            # Bound the pre-forward gather; pages past the cap cannot
+            # defer (their device content is overwritten this step),
+            # so the demotion opportunity is dropped and counted.
+            self.demotes_dropped += 1
+            return
+        self._pending_demotes.append((block_id, key))
+
+    def take_demotes(self, step_has_work: bool) -> \
+            Optional[DemoteDirective]:
+        """Drain this step's eviction queue into one batched directive.
+        Evictions only happen inside successful allocations, so a step
+        with demotes always dispatches — but if a zero-token step ever
+        carries them (defensive), they are dropped: the directive is
+        only valid against this step's pre-forward device state."""
+        if not self._pending_demotes:
+            return None
+        pending, self._pending_demotes = self._pending_demotes, []
+        if not step_has_work:
+            self.demotes_dropped += len(pending)
+            return None
+        return DemoteDirective(page_ids=[p for p, _ in pending],
+                               keys=[k for _, k in pending])
+
+    def insert_host(self, key: bytes, k_np: np.ndarray,
+                    v_np: np.ndarray) -> None:
+        """Runner-side: land one demoted page in the host pool (most-
+        recently-used position), spilling LRU pages to disk past the
+        host budget. Arrays are wire layout (page axis removed)."""
+        if key in self._host or key in self._disk:
+            return
+        if self.wire_shapes is None:
+            self.wire_shapes = (tuple(k_np.shape), tuple(v_np.shape))
+        page = _HostPage(k=np.ascontiguousarray(k_np),
+                         v=np.ascontiguousarray(v_np))
+        self._host[key] = page
+        self._host_bytes += page.nbytes
+        self.demotions["host"] += 1
+        self.demotion_bytes["host"] += page.nbytes
+        self._transitions.append((key.hex(), TIER_HOST))
+        while self._host_bytes > self.host_budget_bytes \
+                and len(self._host) > 1:
+            old_key, old = self._host.popitem(last=False)
+            self._host_bytes -= old.nbytes
+            self._spill_to_disk(old_key, old)
+
+    def _spill_to_disk(self, key: bytes, page: _HostPage) -> None:
+        """T1 eviction: demote to a page file (shared_storage format)
+        when the disk tier is configured, else the content is gone."""
+        if not self.disk_dir:
+            self._transitions.append((key.hex(), TIER_GONE))
+            return
+        from vllm_distributed_tpu.distributed.kv_transfer import \
+            shared_storage
+        try:
+            nbytes, _ = shared_storage.write_page_file(
+                self._file(key), page.k, page.v, connector="kv_tier")
+        except OSError as e:
+            logger.warning("KV tier disk spill failed for %s: %s",
+                           key.hex()[:12], e)
+            self._transitions.append((key.hex(), TIER_GONE))
+            return
+        self._disk[key] = nbytes
+        self._disk_bytes += nbytes
+        self.demotions["disk"] += 1
+        self.demotion_bytes["disk"] += nbytes
+        self._transitions.append((key.hex(), TIER_DISK))
+        while self._disk_bytes > self.disk_budget_bytes \
+                and len(self._disk) > 1:
+            victim, size = self._disk.popitem(last=False)
+            self._disk_bytes -= size
+            try:
+                os.remove(self._file(victim))
+            except OSError:
+                pass
+            self._transitions.append((victim.hex(), TIER_GONE))
+
+    # ------------------------------------------------------------------
+    # Lookup / promotion (scheduler side)
+    # ------------------------------------------------------------------
+    def _read_disk(self, key: bytes):
+        """Read+validate one spill file -> (k, v) or None (corrupt /
+        missing / shape-foreign -> counted miss, file dropped when it
+        exists but is bad). The CRC lives in the quantized codec or the
+        zlib container; the deterministic ``kv_tier.spill_corrupt``
+        fault point simulates a failed check so the degrade-to-
+        recompute path can be drilled."""
+        from vllm_distributed_tpu.distributed.kv_transfer import \
+            shared_storage
+        path = self._file(key)
+        try:
+            if fault_injection.should_fire("kv_tier.spill_corrupt"):
+                raise OSError("injected spill corruption")
+            k, v, _latent = shared_storage.read_page_file(path)
+            k, v = np.asarray(k), np.asarray(v)
+        except Exception as e:  # noqa: BLE001 - any decode failure
+            logger.warning("KV tier spill %s unreadable (%s); "
+                           "treating as a miss", key.hex()[:12], e)
+            self._drop_disk(key, remove_file=True)
+            self.misses["disk"] += 1
+            return None
+        if self.wire_shapes is not None and (
+                tuple(k.shape) != self.wire_shapes[0]
+                or tuple(v.shape) != self.wire_shapes[1]):
+            # Shape-foreign artifact (another model's store sharing the
+            # directory): miss WITHOUT deleting — it may be someone
+            # else's valid page.
+            logger.warning(
+                "KV tier spill %s has foreign wire shapes %s/%s "
+                "(want %s); ignoring", key.hex()[:12], k.shape, v.shape,
+                self.wire_shapes)
+            # De-index (with its bytes — a bare pop would leave
+            # phantom bytes inflating the budget accounting forever)
+            # but keep the file: it may be someone else's valid page.
+            self._drop_disk(key, remove_file=False)
+            self.misses["disk"] += 1
+            return None
+        return k, v
+
+    def _drop_disk(self, key: bytes, remove_file: bool = False) -> None:
+        size = self._disk.pop(key, None)
+        if size is not None:
+            self._disk_bytes -= size
+        if remove_file:
+            try:
+                os.remove(self._file(key))
+            except OSError:
+                pass
+        self._transitions.append((key.hex(), TIER_GONE))
+
+    def lookup(self, block_hash):
+        """(tier, k, v) for a content hash, or None. Host hits return
+        the pooled arrays by reference; disk hits read+verify the spill
+        file NOW (scheduler-side) so admission never gambles on a later
+        runner-side read — the state-cache journal's verified-payload
+        idiom."""
+        key = block_hash.hash_value
+        entry = self._host.get(key)
+        if entry is not None:
+            self._host.move_to_end(key)
+            return "host", entry.k, entry.v
+        if self.disk_dir and (key in self._disk
+                              or os.path.exists(self._file(key))):
+            got = self._read_disk(key)
+            if got is None:
+                return None
+            if key not in self._disk:
+                # Cross-replica file discovered by the exists() probe.
+                try:
+                    self._disk[key] = os.path.getsize(self._file(key))
+                    self._disk_bytes += self._disk[key]
+                except OSError:
+                    pass
+            return ("disk", ) + got
+        return None
+
+    def match_prefix(self, req_id: str, block_hashes, start: int,
+                     max_tokens: int, block_size: int) -> int:
+        """Extend a device-cached prefix of ``start`` pages with tier-
+        resident continuation pages: walks ``block_hashes[start:]``
+        while each hash resolves in T1/T2 and the page still leaves at
+        least one prompt token to compute. Stages the hit arrays under
+        ``req_id`` (pinned until admission or finish — a blocked queue
+        head retries every step without re-reading disk, and a host
+        eviction between lookup and dispatch cannot invalidate the
+        admitted hit) and returns the number of tier pages matched."""
+        stash = self._pending_hits.get(req_id)
+        hits = []
+        j = start
+        while ((j + 1) * block_size <= max_tokens
+               and j < len(block_hashes)):
+            key = block_hashes[j].hash_value
+            if stash is not None and len(hits) < len(stash) \
+                    and stash[len(hits)][0] == key:
+                hits.append(stash[len(hits)])  # memoized (content-
+                j += 1                         # addressed: never stale)
+                continue
+            got = self.lookup(block_hashes[j])
+            if got is None:
+                break
+            tier, k, v = got
+            hits.append((key, tier, k, v))
+            j += 1
+        if hits:
+            self._pending_hits[req_id] = hits
+        else:
+            self._pending_hits.pop(req_id, None)
+        return len(hits)
+
+    def pending_hit_count(self, req_id: str) -> int:
+        return len(self._pending_hits.get(req_id, ()))
+
+    def take_hits(self, req_id: str) -> Optional[list]:
+        return self._pending_hits.pop(req_id, None)
+
+    def drop_request(self, req_id: str) -> None:
+        self._pending_hits.pop(req_id, None)
+
+    def record_promotion(self, directive: PromoteDirective,
+                         seconds: float) -> None:
+        """Runner-side: account one executed promote directive."""
+        for key, tier in zip(directive.keys, directive.tiers):
+            self.promotions[tier] += 1
+            self._transitions.append((key.hex(), TIER_DEVICE))
+        self.promotion_hist.observe(seconds)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Tier telemetry for the stats RPC ("kv_tier" entry →
+        vdt:kv_tier_* families). Runs on the stats caller's thread:
+        every container is snapshotted GIL-atomically. ``transitions``
+        is a destructive drain feeding the router's residency index
+        (engine/router.py observe_stats); non-router consumers ignore
+        it."""
+        transitions = []
+        while True:
+            try:
+                transitions.append(self._transitions.popleft())
+            except IndexError:
+                break
+        return {
+            "pages": {"host": len(self._host), "disk": len(self._disk)},
+            "bytes": {"host": self._host_bytes,
+                      "disk": self._disk_bytes},
+            "demotions": dict(self.demotions),
+            "demotion_bytes": dict(self.demotion_bytes),
+            "promotions": dict(self.promotions),
+            "misses": dict(self.misses),
+            "demotes_dropped": self.demotes_dropped,
+            "promotion_seconds": self.promotion_hist.to_dict(),
+            "transitions": transitions,
+        }

@@ -97,6 +97,17 @@ class SchedulerOutput:
     # dispatch path does no device work by contract).
     state_saves: "list | None" = None
     state_restores: "list | None" = None
+    # Hierarchical KV tiering (core/kv_tier.py), in-proc only like the
+    # state directives. ``kv_demotes`` is ONE batched DemoteDirective:
+    # pages evicted+reassigned this step whose contents the runner
+    # gathers to the host tier BEFORE the forward overwrites them
+    # (the gather's DMA overlaps the forward). ``kv_promotes`` are
+    # per-request PromoteDirectives: staged tier-hit pages scattered
+    # into freshly allocated device pages before the forward, also in
+    # dispatch program order AFTER the demote gather (a promote target
+    # may be the very page a demote is reading).
+    kv_demotes: "object | None" = None
+    kv_promotes: "list | None" = None
     # True when the scheduler granted this batch under async scheduling:
     # request.num_computed_tokens was already advanced AT SCHEDULE TIME
     # (so step N+1 could be granted while step N executes), and

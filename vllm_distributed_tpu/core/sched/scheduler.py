@@ -150,10 +150,11 @@ class Scheduler:
             from vllm_distributed_tpu.core.state_cache import (
                 StateCacheManager, resolve_ckpt_interval,
                 resolve_state_slots, state_cache_enabled)
-            from vllm_distributed_tpu.models.loader import \
-                resolve_state_only
+            from vllm_distributed_tpu.models.loader import (
+                resolve_state_only, resolve_state_snapshotable)
             if (state_cache_enabled(config, True)
-                    and kv_connector is None):
+                    and kv_connector is None
+                    and resolve_state_snapshotable(config.model_config)):
                 from vllm_distributed_tpu import envs as _envs
                 paged = not resolve_state_only(config.model_config)
                 if paged and not enable_caching:
@@ -168,12 +169,23 @@ class Scheduler:
                     # Pure SSM: pages carry no bytes; the state cache
                     # keys its own hash chains.
                     enable_caching = False
+                # Hierarchical tiering (VDT_KV_TIERING): snapshot
+                # eviction demotes to the journal instead of
+                # discarding; without an explicit checkpoint dir the
+                # journal homes under the KV tier's spill directory.
+                journal_dir = _envs.VDT_SSM_CKPT_DIR
+                tiering = _envs.VDT_KV_TIERING
+                if tiering and not journal_dir and _envs.VDT_KV_TIER_DIR:
+                    import os as _os
+                    journal_dir = _os.path.join(_envs.VDT_KV_TIER_DIR,
+                                                "ssm")
                 self.state_cache = StateCacheManager(
                     num_slots=resolve_state_slots(config),
                     block_size=config.cache_config.block_size,
                     interval=resolve_ckpt_interval(config),
                     paged_kv=paged,
-                    journal_dir=_envs.VDT_SSM_CKPT_DIR)
+                    journal_dir=journal_dir,
+                    demote_on_evict=tiering)
                 logger.info(
                     "SSM state cache: %d slots, checkpoint every %d "
                     "tokens%s", self.state_cache.num_slots,
@@ -198,6 +210,18 @@ class Scheduler:
         # non-empty output — the zero-token dispatch path does no
         # device work by contract).
         self._deferred_state_saves: list = []
+        # Hierarchical KV tiering (core/kv_tier.py): host-RAM + disk
+        # spill tiers behind the device pool. Gated to the plain paged
+        # path — stateful models' second tier is the state-cache
+        # journal (their admission bypasses get_computed_blocks), and
+        # sliding-window models free pages the mask forbids ever
+        # reading again (demoting dead-window pages would resurrect
+        # unreadable content). None = untiered, byte-identical.
+        self.kv_tier = None
+        if (self.tknp_size == 1 and enable_caching
+                and self.state_cache is None and free_window is None):
+            from vllm_distributed_tpu.core.kv_tier import maybe_kv_tier
+            self.kv_tier = maybe_kv_tier(config, kv_connector)
         if self.tknp_size > 1:
             self.kv_cache_manager = TokenParallelKVCacheManager(
                 block_size=config.cache_config.block_size,
@@ -213,6 +237,7 @@ class Scheduler:
                 num_blocks=num_blocks,
                 enable_caching=enable_caching,
                 free_window=free_window,
+                tier=self.kv_tier,
             )
         # Structured output (reference: the engine core's
         # StructuredOutputManager beside the scheduler,
@@ -580,6 +605,8 @@ class Scheduler:
         # deferred from empty outputs).
         state_saves: list = []
         state_restores: list = []
+        # KV-tier promote directives staged by this step's admissions.
+        kv_promotes: list = []
 
         # Multi-step decode burst: when every running request is in plain
         # decode and nothing is waiting, the worker can run N fused decode
@@ -830,6 +857,7 @@ class Scheduler:
                 new_computed_blocks: Optional[KVCacheBlocks] = None
                 state_restore = None
                 state_only_admit = False
+                num_tier_pages = 0
                 if (num_computed_tokens == 0
                         and request.sampling_params.prompt_logprobs
                         is None):
@@ -862,6 +890,15 @@ class Scheduler:
                         new_computed_blocks, num_computed_tokens = \
                             self.kv_cache_manager.get_computed_blocks(
                                 request)
+                        if self.kv_tier is not None:
+                            # Trailing pages of the hit live in a spill
+                            # tier: their span counts as computed, but
+                            # device pages must still be ALLOCATED for
+                            # them (below) and a promote directive
+                            # scatters the content back pre-forward.
+                            num_tier_pages = \
+                                self.kv_tier.pending_hit_count(
+                                    request.request_id)
                     if request.num_cached_tokens < 0:
                         request.num_cached_tokens = num_computed_tokens
 
@@ -937,8 +974,14 @@ class Scheduler:
 
                 if state_only_admit:
                     request.num_computed_tokens = num_computed_tokens
+                # Tier-hit pages need device pages allocated even
+                # though their tokens count as computed (the content
+                # scatters back pre-forward); the span rides the
+                # allocation but never the token grant.
+                tier_span = (num_tier_pages *
+                             self.kv_cache_manager.block_size)
                 new_blocks = self.kv_cache_manager.allocate_slots(
-                    request, num_external + num_new_tokens,
+                    request, num_external + tier_span + num_new_tokens,
                     new_computed_blocks)
                 if new_blocks is None:
                     if state_only_admit:
@@ -978,6 +1021,22 @@ class Scheduler:
                                    ev.RESUMED if resumed else ev.SCHEDULED,
                                    {"computed": num_computed_tokens,
                                     "granted": num_new_tokens})
+                if self.kv_tier is not None and num_tier_pages:
+                    # Commit the staged tier hit: the runner scatters
+                    # the (already-verified, already-pinned) arrays
+                    # into the first tier-span pages of this
+                    # allocation before the forward.
+                    hits = self.kv_tier.take_hits(request.request_id)
+                    if hits:
+                        from vllm_distributed_tpu.core.kv_tier import \
+                            PromoteDirective
+                        kv_promotes.append(PromoteDirective(
+                            req_id=request.request_id,
+                            page_ids=new_blocks.get_block_ids()
+                            [:len(hits)],
+                            keys=[h[0] for h in hits],
+                            tiers=[h[1] for h in hits],
+                            arrays=[(h[2], h[3]) for h in hits]))
                 if self.state_cache is not None:
                     # This grant rewrites the recurrence from
                     # `num_computed_tokens`; any uncommitted park of an
@@ -1091,6 +1150,14 @@ class Scheduler:
                 # The zero-token dispatch path does no device work by
                 # contract; park copies wait for the next real batch.
                 self._deferred_state_saves = saves
+        if self.kv_tier is not None:
+            # Demotes drain every step (evictions only happen inside
+            # successful allocations, so a step carrying them always
+            # dispatched work; the guard is defensive). Promotes were
+            # staged by this step's admissions.
+            output.kv_demotes = self.kv_tier.take_demotes(
+                bool(num_scheduled_tokens))
+            output.kv_promotes = kv_promotes or None
         self.finished_req_ids = set()
         if self.kv_connector is not None:
             output.kv_connector_metadata = \
@@ -1724,6 +1791,12 @@ class Scheduler:
         }
         if self.state_cache is not None:
             stats.update(self.state_cache.stats())
+        if self.kv_tier is not None:
+            # Nested tier dict ({pages,bytes,demotions,promotions,
+            # misses} by tier + promotion histogram + the router's
+            # transition feed) — merged per leaf in dp_client, never
+            # by the flat numeric-sum loop.
+            stats["kv_tier"] = self.kv_tier.stats()
         if self.qos is not None:
             # {tenant: {granted_tokens, kv_blocks, preemptions}} — flat
             # numeric leaves per tenant so the DP aggregation can sum

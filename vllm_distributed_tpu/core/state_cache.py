@@ -346,6 +346,14 @@ class StateCacheManager:
     # touches device arrays).
     bytes_per_slot: int = 0
     journal_fingerprint: bytes = b""
+    # Hierarchical KV/state tiering (VDT_KV_TIERING): LRU eviction
+    # DEMOTES a committed snapshot to the checkpoint journal (one owed
+    # persist_only directive; the slot stays pinned until it ships)
+    # instead of discarding device-only state — the journal is the
+    # snapshot pool's second tier, closing the "eviction discards"
+    # gap. Restores of demoted snapshots ride the existing journal
+    # fallback of get_computed_state.
+    demote_on_evict: bool = False
 
     by_key: dict[bytes, StateSnapshot] = field(default_factory=dict)
     by_slot: dict[int, StateSnapshot] = field(default_factory=dict)
@@ -375,6 +383,7 @@ class StateCacheManager:
     resume_tokens_saved: int = 0
     restore_corruptions: int = 0
     journal_files_reclaimed: int = 0
+    journal_demotions: int = 0
 
     def __post_init__(self) -> None:
         self.free_slots = list(range(self.num_slots - 1, -1, -1))
@@ -664,16 +673,38 @@ class StateCacheManager:
         # references the old content, and device program order
         # serializes them (restores run pre-forward, saves
         # post-forward).
-        committed = [s for s in self.by_slot.values()
-                     if s.key is not None and s.key in self.by_key
-                     and self.by_key[s.key] is s
-                     and not s.journal_pending]
-        if not committed:
-            return None
-        victim = min(committed, key=lambda s: s.last_used)
-        self._release(victim)
-        self.evictions += 1
-        return self.free_slots.pop()
+        while True:
+            committed = [s for s in self.by_slot.values()
+                         if s.key is not None and s.key in self.by_key
+                         and self.by_key[s.key] is s
+                         and not s.journal_pending]
+            if not committed:
+                return None
+            victim = min(committed, key=lambda s: s.last_used)
+            if (self.demote_on_evict and self.journal_dir
+                    and victim.key is not None):
+                # Journal-as-second-tier (VDT_KV_TIERING): a victim
+                # whose checkpoint file is missing (journal written
+                # lazily, or reclaimed by the sweep) is DEMOTED, not
+                # discarded — owe its journal write as a persist_only
+                # directive and pin the slot until it ships; the LRU
+                # walk picks another victim this round. Once the file
+                # exists the slot evicts normally and the journal
+                # fallback of get_computed_state serves restores.
+                if victim.journal is None:
+                    victim.journal = journal_path(self.journal_dir,
+                                                  victim.key)
+                if not os.path.exists(victim.journal):
+                    victim.journal_pending = True
+                    self.pending_persists.append(SaveDirective(
+                        req_id="", slot=victim.slot,
+                        num_tokens=victim.num_tokens,
+                        journal=victim.journal, persist_only=True))
+                    self.journal_demotions += 1
+                    continue
+            self._release(victim)
+            self.evictions += 1
+            return self.free_slots.pop()
 
     def reset(self) -> None:
         """Forget every snapshot (sleep/wake released the pool's HBM).
@@ -704,4 +735,5 @@ class StateCacheManager:
             "ssm_resume_tokens_saved": self.resume_tokens_saved,
             "ssm_restore_corruptions": self.restore_corruptions,
             "ssm_journal_reclaimed": self.journal_files_reclaimed,
+            "ssm_journal_demotions": self.journal_demotions,
         }

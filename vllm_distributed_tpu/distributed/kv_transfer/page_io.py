@@ -170,43 +170,93 @@ def _wire_to_latent(k: np.ndarray, v: np.ndarray, lkv: int, rope: int,
     return kv.reshape(L, P, PS, c_lanes), pe
 
 
-def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
-    """Read pages out of the device cache as host numpy in wire layout
-    (stages concatenated on the layer dim under pipeline parallelism):
-    [L, n_pages, KVH_checkpoint, page_size, head_dim] K/V stacks for
-    standard caches, or [L, n_pages, page_size, kv_lora_rank] latent +
-    [L, n_pages, page_size, rope_dim] rope stacks for MLA."""
-    import jax
+def wire_page_shapes(runner) -> tuple[tuple, tuple]:
+    """Per-page wire-layout shapes (the page axis removed): one page's
+    k slice is [L, KVH_checkpoint, PS, D] for standard caches or
+    [L, PS, kv_lora_rank] for MLA latent stores. The KV tier
+    (core/kv_tier.py) validates disk spill files against these BEFORE
+    admitting a tier hit, so a shape-foreign artifact in a shared
+    spill directory is a clean miss, never a corrupt scatter."""
+    geo = _latent_geometry(runner)
+    views = _stage_views(runner)
+    L = sum(hi - lo for _, (lo, hi), _ in views)
+    cache = views[0][0]
+    if geo is not None:
+        lkv, rope, _ = geo
+        ps = cache["c"].shape[2]
+        return (L, ps, lkv), (L, ps, rope)
+    r = _replication(runner)
+    _, _, kvh, ps, d = cache["k"].shape
+    return (L, kvh // r, ps, d), (L, kvh // r, ps, d)
 
+
+def gather_pages_start(runner, page_ids) -> dict:
+    """Non-blocking half of a page gather: slice the pages out of the
+    device cache and START the device->host copies, returning a handle
+    for ``gather_pages_finish``. The slices enqueue in device program
+    order BEFORE any dispatch issued after this call, so a forward that
+    immediately overwrites the pages (a demotion's evicted pages are
+    handed straight to their new owner) still reads the pre-forward
+    contents — while the DMA itself overlaps that forward's compute."""
     from vllm_distributed_tpu.metrics import telemetry
     t0 = telemetry.now()
     pages = np.asarray(page_ids, np.int32)
     geo = _latent_geometry(runner)
     views = _stage_views(runner)
     if geo is not None:
-        lkv, rope, shards = geo
         slices = [(cache["c"][:, pages],
                    cache["pe"][:, pages] if "pe" in cache else None)
                   for cache, _, _ in views]
+    else:
+        slices = [(cache["k"][:, pages], cache["v"][:, pages])
+                  for cache, _, _ in views]
+    for a, b in slices:
+        for x in (a, b):
+            if x is None:
+                continue
+            try:
+                x.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # backend without async host copies: finish blocks
+    return {"slices": slices, "geo": geo, "t0": t0}
+
+
+def gather_pages_finish(runner, handle) -> tuple[np.ndarray, np.ndarray]:
+    """Blocking half of a page gather: fetch the (already in-flight)
+    copies and apply the cache->wire layout transform."""
+    import jax
+    geo = handle["geo"]
+    slices = handle["slices"]
+    if geo is not None:
+        lkv, rope, shards = geo
         parts = [_latent_to_wire(
             np.asarray(jax.device_get(c)),
             None if pe is None else np.asarray(jax.device_get(pe)),
             lkv, rope, shards) for c, pe in slices]
         k_out = np.concatenate([p[0] for p in parts], axis=0)
         v_out = np.concatenate([p[1] for p in parts], axis=0)
-        _record(runner, "tx", k_out.nbytes + v_out.nbytes, t0)
-        return k_out, v_out
-    r = _replication(runner)
-    # Dispatch every stage's gather before fetching any: the N
-    # device->host copies are independent and overlap.
-    slices = [(cache["k"][:, pages], cache["v"][:, pages])
-              for cache, _, _ in views]
-    ks = [np.asarray(jax.device_get(k))[:, :, ::r] for k, _ in slices]
-    vs = [np.asarray(jax.device_get(v))[:, :, ::r] for _, v in slices]
-    k_out = np.concatenate(ks, axis=0)
-    v_out = np.concatenate(vs, axis=0)
-    _record(runner, "tx", k_out.nbytes + v_out.nbytes, t0)
+    else:
+        r = _replication(runner)
+        ks = [np.asarray(jax.device_get(k))[:, :, ::r]
+              for k, _ in slices]
+        vs = [np.asarray(jax.device_get(v))[:, :, ::r]
+              for _, v in slices]
+        k_out = np.concatenate(ks, axis=0)
+        v_out = np.concatenate(vs, axis=0)
+    _record(runner, "tx", k_out.nbytes + v_out.nbytes, handle["t0"])
     return k_out, v_out
+
+
+def gather_pages(runner, page_ids) -> tuple[np.ndarray, np.ndarray]:
+    """Read pages out of the device cache as host numpy in wire layout
+    (stages concatenated on the layer dim under pipeline parallelism):
+    [L, n_pages, KVH_checkpoint, page_size, head_dim] K/V stacks for
+    standard caches, or [L, n_pages, page_size, kv_lora_rank] latent +
+    [L, n_pages, page_size, rope_dim] rope stacks for MLA. All stage
+    copies dispatch before any fetch, so the device->host legs
+    overlap."""
+    return gather_pages_finish(runner,
+                               gather_pages_start(runner, page_ids))
 
 
 def scatter_pages(runner, page_ids, k: np.ndarray, v: np.ndarray) -> None:

@@ -35,6 +35,121 @@ logger = init_logger(__name__)
 DEFAULT_STORAGE_PATH = "/tmp/vdt_kv_storage"
 
 
+_NATIVE_NPZ_DTYPES = frozenset(
+    "float16 float32 float64 int8 uint8 int16 uint16 int32 uint32 "
+    "int64 uint64 bool".split())
+
+
+def _needs_bytes_codec(dtype) -> bool:
+    """True for dtypes numpy cannot round-trip through .npy entries
+    (ml_dtypes bfloat16 et al.: savez succeeds but np.load explodes
+    parsing the descr). Those arrays ride as raw bytes + (shape,
+    dtype-name) sidecars — the state-cache journal's discipline."""
+    try:
+        return np.dtype(dtype).name not in _NATIVE_NPZ_DTYPES
+    except TypeError:
+        return True
+
+
+def _decode_bytes_entry(f, slot: str) -> np.ndarray:
+    data = f[f"{slot}_raw"].tobytes()
+    shape = tuple(int(x) for x in f[f"{slot}_shape"])
+    dtype_name = bytes(f[f"{slot}_dtype"]).decode()
+    try:
+        dtype = np.dtype(dtype_name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16 et al.
+        dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+    return np.frombuffer(data, dtype).reshape(shape)
+
+
+def read_page_file(path: str):
+    """One page file -> (k, v, latent_meta) — arrays [L, KVH, PS, D]
+    (or the latent wire slices for MLA stores) plus the latent
+    geometry dict when the file carries one (None for standard
+    pages / legacy artifacts). Three formats coexist in a store:
+    quantized codec files (kv_transfer/quant.py fields under npz
+    keys), zlib-compressed raw (VDT_QCOMM=0 writers), and the
+    legacy uncompressed raw — old artifacts keep decoding forever.
+    A quantized file that fails validation raises QuantCodecError
+    (fatal for the caller's retry policy, like any other corrupt
+    artifact). Module-level: the hierarchical KV tier's disk spill
+    files (core/kv_tier.py) share this exact format + namespace, so
+    a tier restore and a disagg handoff read the same artifacts."""
+    with np.load(path) as f:
+        if "qcomm_meta" in f:
+            meta = json.loads(f["qcomm_meta"].tobytes().decode())
+            payload = {**meta,
+                       "qk": f["qk"].tobytes(),
+                       "qv": f["qv"].tobytes(),
+                       "ks": f["ks"].tobytes(),
+                       "vs": f["vs"].tobytes()}
+            k, v = quant.decode_pages(payload)
+            return k, v, quant.latent_meta(payload)
+        latent = None
+        if "latent_meta" in f:
+            latent = json.loads(f["latent_meta"].tobytes().decode())
+        if "k_raw" in f:
+            # Non-native dtype (bfloat16): raw bytes + sidecars.
+            return (_decode_bytes_entry(f, "k"),
+                    _decode_bytes_entry(f, "v"), latent)
+        return f["k"], f["v"], latent
+
+
+def write_page_file(path: str, k_np, v_np, latent=None,
+                    connector: str = "shared_storage") -> tuple[int, int]:
+    """Atomic (tmp + rename) page-file write -> (disk_bytes,
+    bytes_saved vs the raw uncompressed artifact). Quantized codec
+    payload when the plane is on for ``connector``; zlib-compressed
+    raw otherwise — either way on-disk KV artifacts shrink.
+    ``latent`` (page_io.latent_wire_meta) stamps MLA latent pages
+    with the versioned latent geometry so a pre-TPLA engine REJECTS
+    the file at decode instead of misreading it."""
+    tmp = path + f".tmp{os.getpid()}"
+    raw_bytes = k_np.nbytes + v_np.nbytes
+    quantized = quant.payload_enabled(connector, k_np.dtype)
+    if quantized:
+        payload = quant.encode_pages(k_np, v_np, latent=latent)
+        meta = {f: payload[f]
+                for f in quant.header_fields(payload["version"])
+                + ("scale_crc", )}
+        # Meta rides as raw JSON bytes — a unicode npy entry costs
+        # 4 bytes/char, which matters at small page geometries.
+        with open(tmp, "wb") as f:
+            np.savez(f, qcomm_meta=np.frombuffer(
+                         json.dumps(meta).encode(), np.uint8),
+                     qk=np.frombuffer(payload["qk"], np.int8),
+                     qv=np.frombuffer(payload["qv"], np.int8),
+                     ks=np.frombuffer(payload["ks"], np.float32),
+                     vs=np.frombuffer(payload["vs"], np.float32))
+    else:
+        entries: dict = {}
+        if _needs_bytes_codec(k_np.dtype):
+            # bfloat16 (ml_dtypes) arrays do not survive a .npy
+            # round-trip; store raw bytes + (shape, dtype) sidecars so
+            # they come back bit-exact.
+            for slot, a in (("k", k_np), ("v", v_np)):
+                a = np.ascontiguousarray(a)
+                entries[f"{slot}_raw"] = np.frombuffer(
+                    a.tobytes(), np.uint8)
+                entries[f"{slot}_shape"] = np.asarray(a.shape, np.int64)
+                entries[f"{slot}_dtype"] = np.frombuffer(
+                    a.dtype.name.encode(), np.uint8)
+        else:
+            entries["k"], entries["v"] = k_np, v_np
+        if latent is not None:
+            entries["latent_meta"] = np.frombuffer(
+                json.dumps(latent).encode(), np.uint8)
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **entries)
+    disk_bytes = os.path.getsize(tmp)
+    os.replace(tmp, path)
+    # Savings attribute to the quantized plane only — zlib shrink
+    # with the plane off is real but is not a qcomm counter.
+    saved = max(raw_bytes - disk_bytes, 0) if quantized else 0
+    return disk_bytes, saved
+
+
 @dataclass
 class _ReqLoad:
     """One request's pending external load."""
@@ -101,73 +216,16 @@ class SharedStorageConnector(KVConnectorBase):
         return os.path.join(self.path, f"{hash_hex}.npz")
 
     def _read_page_file(self, key: str):
-        """One page file -> (k, v, latent_meta) — arrays [L, KVH, PS, D]
-        (or the latent wire slices for MLA stores) plus the latent
-        geometry dict when the file carries one (None for standard
-        pages / legacy artifacts). Three formats coexist in a store:
-        quantized codec files (kv_transfer/quant.py fields under npz
-        keys), zlib-compressed raw (VDT_QCOMM=0 writers), and the
-        legacy uncompressed raw — old artifacts keep decoding forever.
-        A quantized file that fails validation raises QuantCodecError
-        (fatal for the caller's retry policy, like any other corrupt
-        artifact)."""
-        with np.load(self._file(key)) as f:
-            if "qcomm_meta" in f:
-                meta = json.loads(f["qcomm_meta"].tobytes().decode())
-                payload = {**meta,
-                           "qk": f["qk"].tobytes(),
-                           "qv": f["qv"].tobytes(),
-                           "ks": f["ks"].tobytes(),
-                           "vs": f["vs"].tobytes()}
-                k, v = quant.decode_pages(payload)
-                return k, v, quant.latent_meta(payload)
-            latent = None
-            if "latent_meta" in f:
-                latent = json.loads(f["latent_meta"].tobytes().decode())
-            return f["k"], f["v"], latent
+        """See module-level ``read_page_file`` (shared with the KV
+        tier's disk spill so both read one page-file format)."""
+        return read_page_file(self._file(key))
 
     def _write_page_file(self, key: str, k_np, v_np,
                          latent=None) -> tuple[int, int]:
-        """Atomic (tmp + rename) page-file write -> (disk_bytes,
-        bytes_saved vs the raw uncompressed artifact). Quantized codec
-        payload when the plane is on; zlib-compressed raw otherwise —
-        either way on-disk KV artifacts shrink. ``latent``
-        (page_io.latent_wire_meta) stamps MLA latent pages with the
-        versioned latent geometry so a pre-TPLA engine REJECTS the file
-        at decode instead of misreading it."""
-        tmp = self._file(key) + f".tmp{os.getpid()}"
-        raw_bytes = k_np.nbytes + v_np.nbytes
-        quantized = quant.payload_enabled(self.telemetry_name,
-                                          k_np.dtype)
-        if quantized:
-            payload = quant.encode_pages(k_np, v_np, latent=latent)
-            meta = {f: payload[f]
-                    for f in quant.header_fields(payload["version"])
-                    + ("scale_crc", )}
-            # Meta rides as raw JSON bytes — a unicode npy entry costs
-            # 4 bytes/char, which matters at small page geometries.
-            with open(tmp, "wb") as f:
-                np.savez(f, qcomm_meta=np.frombuffer(
-                             json.dumps(meta).encode(), np.uint8),
-                         qk=np.frombuffer(payload["qk"], np.int8),
-                         qv=np.frombuffer(payload["qv"], np.int8),
-                         ks=np.frombuffer(payload["ks"], np.float32),
-                         vs=np.frombuffer(payload["vs"], np.float32))
-        else:
-            with open(tmp, "wb") as f:
-                if latent is not None:
-                    np.savez_compressed(
-                        f, k=k_np, v=v_np,
-                        latent_meta=np.frombuffer(
-                            json.dumps(latent).encode(), np.uint8))
-                else:
-                    np.savez_compressed(f, k=k_np, v=v_np)
-        disk_bytes = os.path.getsize(tmp)
-        os.replace(tmp, self._file(key))
-        # Savings attribute to the quantized plane only — zlib shrink
-        # with the plane off is real but is not a qcomm counter.
-        saved = max(raw_bytes - disk_bytes, 0) if quantized else 0
-        return disk_bytes, saved
+        """See module-level ``write_page_file``."""
+        return write_page_file(self._file(key), k_np, v_np,
+                               latent=latent,
+                               connector=self.telemetry_name)
 
     # ------------------------------------------------------------------
     # Scheduler side

@@ -96,6 +96,29 @@ class EngineCore:
                     self.scheduler.state_cache.journal_fingerprint = \
                         getattr(runner, "_state_fingerprint",
                                 lambda: b"")()
+            if self.scheduler.kv_tier is not None:
+                # Hierarchical KV tiering: the runner executes the
+                # device legs against the scheduler's (in-proc) tier
+                # manager, and the manager validates disk spill files
+                # against this model's wire-layout page shapes before
+                # admitting a tier hit. An executor variant without a
+                # reachable flat runner cannot run the directives —
+                # drop the tier (byte-identical untiered behavior).
+                runner = getattr(getattr(self.executor, "worker", None),
+                                 "model_runner", None)
+                if runner is not None and hasattr(runner, "kv_caches"):
+                    from vllm_distributed_tpu.distributed.kv_transfer \
+                        import page_io
+                    runner.kv_tier = self.scheduler.kv_tier
+                    self.scheduler.kv_tier.wire_shapes = \
+                        page_io.wire_page_shapes(runner)
+                else:
+                    logger.info("KV tiering: no flat runner reachable; "
+                                "running untiered")
+                    self.scheduler.kv_tier = None
+                    self.scheduler.kv_cache_manager.tier = None
+                    for pool in self.scheduler._block_pools():
+                        pool.on_evict = None
         finally:
             restore()
         # Batch queue: in-flight (scheduler_output, handle) pairs,

@@ -778,6 +778,33 @@ class DPEngineClient(EngineCoreClient):
             [s.get("kv_cache") for s in per])
         if kv_cache is not None:
             agg["kv_cache"] = kv_cache
+        # Hierarchical KV tiering: {pages/bytes/demotions/promotions/
+        # misses: {tier: n}} sum per tier per leaf, the promotion
+        # histogram merges element-wise, and the (destructively
+        # drained) router transition feed was already consumed by
+        # router.observe_stats above — it never reaches the merged
+        # view.
+        tier_maps = [s["kv_tier"] for s in per
+                     if isinstance(s.get("kv_tier"), dict)]
+        if tier_maps:
+            merged_tier: dict = {}
+            for m in tier_maps:
+                for k, v in m.items():
+                    if k in ("transitions", "promotion_seconds"):
+                        continue
+                    if isinstance(v, dict):
+                        dst = merged_tier.setdefault(k, {})
+                        for tier_name, n in v.items():
+                            if isinstance(n, (int, float)):
+                                dst[tier_name] = \
+                                    dst.get(tier_name, 0) + n
+                    elif isinstance(v, (int, float)):
+                        merged_tier[k] = merged_tier.get(k, 0) + v
+            promo = merge_histogram_dicts(
+                [m.get("promotion_seconds") for m in tier_maps])
+            if promo is not None:
+                merged_tier["promotion_seconds"] = promo
+            agg["kv_tier"] = merged_tier
         # Lifecycle timelines: one fleet-wide event stream, time-sorted.
         from vllm_distributed_tpu.metrics.events import merge_event_lists
         events = merge_event_lists(

@@ -21,6 +21,13 @@ the two signals that dominate chat-serving economics at scale:
     balancer already maintains (registered at admission, extended with
     the generated tokens at finish, dropped wholesale on failover,
     halved under replica eviction pressure, TTL-expired otherwise).
+    With hierarchical KV tiering on (core/kv_tier.py), entries are
+    additionally TAGGED BY TIER — the replica's drained tier-transition
+    stream rides its existing kv_tier stats entry through
+    ``observe_stats`` — and each matched page scores its restore-cost
+    credit (``TIER_CREDITS``: HBM 1.0, host RAM 0.8, disk 0.55), so a
+    returning session routes to the replica that can *restore* its
+    prefix cheapest, not only one still holding it in HBM.
     The index is a HINT: a false positive only costs the prefill the
     old balancer would have paid anyway — each replica's own block
     pool re-verifies every page hash before reuse.
@@ -72,6 +79,14 @@ logger = init_logger(__name__)
 # replica's block pool is evicting prefix pages, so half our hints there
 # are already dead weight.
 _EVICTION_PRESSURE = 0.95
+# Tier-aware affinity credit per matched page (core/kv_tier.py tier
+# codes): a prefix the replica still holds in HBM is free to reuse, a
+# host-RAM-tiered page costs one PCIe scatter, a disk-tiered page a
+# file read + decode + scatter — all far cheaper than recomputing the
+# prefill, which is what a miss costs. The credits ARE the restore-cost
+# model: a returning session routes to the replica that can restore its
+# prefix cheapest, not only one still holding it in HBM.
+TIER_CREDITS = {0: 1.0, 1: 0.8, 2: 0.55}
 # Normalization ceiling (seconds) for the mean device-wait step phase.
 _WAIT_CEILING_S = 0.5
 # Cost margin below which two replicas tie and the rotation cursor
@@ -98,9 +113,10 @@ class ReplicaRouter:
         self.prefix_capacity = envs.VDT_ROUTER_PREFIX_CAPACITY
         self.prefix_ttl_s = envs.VDT_ROUTER_PREFIX_TTL_S
         self.spill_pressure = envs.VDT_ROUTER_SPILL_PRESSURE
-        # Per-replica prefix-residency index: page hash -> last touch
-        # (monotonic). OrderedDict in LRU order (oldest first).
-        self._residency: list["OrderedDict[bytes, float]"] = [
+        # Per-replica prefix-residency index: page hash -> (last touch
+        # (monotonic), tier code 0=device/1=host/2=disk). OrderedDict
+        # in LRU order (oldest first).
+        self._residency: list["OrderedDict[bytes, tuple]"] = [
             OrderedDict() for _ in range(num_replicas)
         ]
         # Per-replica load snapshot + fetch instant (monotonic).
@@ -157,16 +173,56 @@ class ReplicaRouter:
     # ------------------------------------------------------------------
     # Residency index bookkeeping (fed by the balancer's owner state)
     # ------------------------------------------------------------------
-    def _register(self, replica: int, hashes: list[bytes]) -> None:
+    def _register(self, replica: int, hashes: list[bytes],
+                  tier: int = 0) -> None:
         if not hashes:
             return
         index = self._residency[replica]
         now = time.monotonic()
         for h in hashes:
             index.pop(h, None)
-            index[h] = now  # most-recently-used position
+            index[h] = (now, tier)  # most-recently-used position
         while len(index) > self.prefix_capacity:
             index.popitem(last=False)
+
+    # -- Tier transitions (core/kv_tier.py feed via observe_stats) -----
+    def on_demote(self, replica: int, hashes: list[bytes],
+                  tier: int) -> None:
+        """Pages left the replica's device pool for a spill tier (or
+        came back: tier 0 = promoted to HBM). Entries we track retag
+        in place — keeping their recency — so affinity scores the
+        RESTORE cost instead of pretending the prefix is still free
+        (or gone). Hashes we never indexed are ignored: the feed is a
+        hint stream, not an index bootstrap."""
+        index = self._residency[replica]
+        for h in hashes:
+            at = index.get(h)
+            if at is not None:
+                index[h] = (at[0], tier)
+
+    def on_evict(self, replica: int, hashes: list[bytes]) -> None:
+        """Pages fell off the replica's last tier: drop the hints."""
+        index = self._residency[replica]
+        for h in hashes:
+            index.pop(h, None)
+
+    def observe_tier_transitions(self, replica: int,
+                                 transitions) -> None:
+        """Apply one drained (hash hex, tier code) transition stream
+        from the replica's kv_tier stats entry (rides the existing
+        get_stats feed — see observe_stats)."""
+        if not transitions:
+            return
+        for entry in transitions:
+            try:
+                hex_key, code = entry
+                key = bytes.fromhex(hex_key)
+            except (TypeError, ValueError):
+                continue
+            if code < 0:
+                self.on_evict(replica, [key])
+            else:
+                self.on_demote(replica, [key], int(code))
 
     def on_admit(self, request: EngineCoreRequest, replica: int,
                  hashes: Optional[list[bytes]] = None) -> None:
@@ -224,22 +280,27 @@ class ReplicaRouter:
             self.on_replica_down(i)
 
     def _affinity(self, replica: int, hashes: list[bytes]) -> float:
-        """Matched leading pages / hashed pages, honoring the entry TTL
-        (expired entries are pruned as they are seen)."""
+        """Tier-weighted matched leading pages / hashed pages, honoring
+        the entry TTL (expired entries are pruned as they are seen). A
+        device-resident page scores full credit; host/disk-tiered
+        pages score their restore-cost discount (TIER_CREDITS), so two
+        replicas holding the same prefix in different tiers rank by
+        how cheaply each can actually serve it."""
         if not hashes:
             return 0.0
         index = self._residency[replica]
         now = time.monotonic()
-        matched = 0
+        credit = 0.0
         for h in hashes:
             at = index.get(h)
             if at is None:
                 break
-            if now - at > self.prefix_ttl_s:
+            ts, tier = at
+            if now - ts > self.prefix_ttl_s:
                 index.pop(h, None)
                 break
-            matched += 1
-        return matched / len(hashes)
+            credit += TIER_CREDITS.get(tier, TIER_CREDITS[2])
+        return credit / len(hashes)
 
     # ------------------------------------------------------------------
     # Load snapshots (existing get_stats RPC, short TTL)
@@ -269,6 +330,14 @@ class ReplicaRouter:
                 # fresh histogram — restart the interval baseline.
                 self._wait_interval_s[replica] = 0.0
             self._wait_prev[replica] = (s, c)
+        # Tier transitions (hierarchical KV memory): the replica's
+        # kv_tier stats entry carries a drained (hash, tier) stream —
+        # demoted pages retag to their restore-cost tier, tier-evicted
+        # pages drop, promoted pages regain full device credit.
+        kv_tier = stats.get("kv_tier")
+        if isinstance(kv_tier, dict):
+            self.observe_tier_transitions(
+                replica, kv_tier.get("transitions"))
         if (float(stats.get("kv_cache_usage", 0.0)) >= _EVICTION_PRESSURE
                 and self._residency[replica]):
             # The replica is evicting prefix pages; drop the oldest half

@@ -234,6 +234,39 @@ environment_variables: dict[str, Callable[[], Any]] = {
     lambda: max(0, int(os.getenv("VDT_DISAGG_PREFILL_TP", "0"))),
     "VDT_DISAGG_DECODE_TP":
     lambda: max(0, int(os.getenv("VDT_DISAGG_DECODE_TP", "0"))),
+    # --- Hierarchical KV/state memory (core/kv_tier.py) -----------------
+    # Master switch: "1" gives the page pool a spill hierarchy — prefix
+    # pages evicted from HBM demote to a bounded pinned-host-RAM pool
+    # (T1), host-pool eviction demotes to disk page files (T2, the
+    # shared_storage format + CRC + quantized codec under the same
+    # content-addressed BlockHash keys), and WAITING requests whose
+    # prefix lives in a tier promote it back before the forward. SSM
+    # state-cache eviction likewise demotes snapshots to the checkpoint
+    # journal instead of discarding. "0" (default) constructs no tier
+    # state anywhere — byte-identical revert. Read at engine build.
+    "VDT_KV_TIERING":
+    lambda: os.getenv("VDT_KV_TIERING", "0") == "1",
+    # T1 budget: MiB of host RAM the demoted-page pool may hold before
+    # spilling its LRU pages to the disk tier (fractions allowed —
+    # tiny-geometry tests/bench force spills with sub-MiB budgets).
+    "VDT_KV_TIER_HOST_MB":
+    lambda: max(0.001, float(os.getenv("VDT_KV_TIER_HOST_MB", "512"))),
+    # T2 spill directory ("" disables the disk tier; host-pool eviction
+    # then discards). Content-addressed page files — safe to share with
+    # a shared_storage store or across replicas of the SAME model
+    # (namespace discipline is the operator's, as with shared_storage).
+    "VDT_KV_TIER_DIR":
+    lambda: os.getenv("VDT_KV_TIER_DIR", ""),
+    # T2 budget: MiB of spill files kept on disk (oldest evicted past
+    # the budget; fractions allowed like the host budget).
+    "VDT_KV_TIER_DISK_MB":
+    lambda: max(0.001, float(os.getenv("VDT_KV_TIER_DISK_MB", "4096"))),
+    # Demotion cap: pages gathered device->host per engine step. The
+    # gather is dispatched pre-forward (its DMA overlaps the step);
+    # evictions past the cap lose their demotion (counted) because the
+    # new page owner overwrites the content this very step.
+    "VDT_KV_TIER_DEMOTE_PAGES":
+    lambda: max(1, int(os.getenv("VDT_KV_TIER_DEMOTE_PAGES", "64"))),
     # --- SSM state cache (core/state_cache.py) --------------------------
     # First-class state checkpoint/restore for stateful (Mamba/Jamba)
     # models: prefix-style admission at snapshot boundaries, preemption

@@ -121,6 +121,11 @@ _COUNTERS = {
                               "Checkpoint-journal files reclaimed by "
                               "the retention sweep (TTL expiry + "
                               "size-budget eviction at init/sleep)"),
+    "ssm_journal_demotions": ("vdt:ssm_journal_demotions_total",
+                              "Evicted state snapshots demoted to the "
+                              "checkpoint journal instead of discarded "
+                              "(hierarchical tiering's journal-as-"
+                              "second-tier; VDT_KV_TIERING=1)"),
     # Performance-attribution plane (metrics/costmodel.py): analytic
     # model FLOPs charged per dispatch, summed across DP replicas.
     "model_flops": ("vdt:model_flops_total",
@@ -174,6 +179,14 @@ LABELED_METRICS = {
     # Telemetry plane: block-pool introspection.
     "vdt:kv_blocks": ("state", ),
     "vdt:preemptions_by_cause_total": ("cause", ),
+    # Hierarchical KV memory (core/kv_tier.py; VDT_KV_TIERING=1):
+    # spill-tier occupancy and flow, by tier (host|disk).
+    "vdt:kv_tier_pages": ("tier", ),
+    "vdt:kv_tier_bytes": ("tier", ),
+    "vdt:kv_tier_demotions_total": ("tier", ),
+    "vdt:kv_tier_demotion_bytes_total": ("tier", ),
+    "vdt:kv_tier_promotions_total": ("tier", ),
+    "vdt:kv_tier_misses_total": ("tier", ),
     # Attention dispatch: which kernel family each step ran
     # (fused_block|unified|decode|general|cascade|naive).
     "vdt:attn_kernel_calls_total": ("kernel", ),
@@ -533,6 +546,44 @@ def _render_kv_cache(kv: dict) -> list[str]:
     return lines
 
 
+def _render_kv_tier(tier: dict) -> list[str]:
+    """Hierarchical KV-memory families (core/kv_tier.py "kv_tier"
+    stats entry, summed per tier across DP replicas)."""
+    lines: list[str] = []
+    for name, key, kind, help_text in (
+        ("vdt:kv_tier_pages", "pages", "gauge",
+         "Prefix pages currently held per spill tier (host = pinned "
+         "host-RAM pool, disk = spill files)"),
+        ("vdt:kv_tier_bytes", "bytes", "gauge",
+         "Bytes currently held per spill tier"),
+        ("vdt:kv_tier_demotions_total", "demotions", "counter",
+         "Pages demoted into each tier (HBM eviction -> host, "
+         "host-pool eviction -> disk)"),
+        ("vdt:kv_tier_demotion_bytes_total", "demotion_bytes",
+         "counter", "Bytes demoted into each tier"),
+        ("vdt:kv_tier_promotions_total", "promotions", "counter",
+         "Tier-resident pages promoted back into device pages at "
+         "admission"),
+        ("vdt:kv_tier_misses_total", "misses", "counter",
+         "Tier lookups that failed despite an index entry (corrupt / "
+         "missing / shape-foreign spill file -> clean recompute)"),
+    ):
+        per_tier = tier.get(key)
+        if not isinstance(per_tier, dict):
+            continue
+        lines += [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+        lines += [f'{name}{{tier="{t}"}} {int(per_tier[t])}'
+                  for t in sorted(per_tier)
+                  if isinstance(per_tier[t], (int, float))]
+    h = tier.get("promotion_seconds")
+    if isinstance(h, dict):
+        lines += _render_histogram(
+            "vdt:kv_tier_promotion_seconds",
+            "Host-side seconds to stage+dispatch one request's tier "
+            "promotion (the scatter itself overlaps the forward)", h)
+    return lines
+
+
 def _render_tenants(tenants: dict) -> list[str]:
     """Per-tenant QoS families ({tenant: {granted_tokens, kv_blocks,
     preemptions}} from the scheduler's stats, summed per tenant across
@@ -640,6 +691,9 @@ def render_metrics(stats: dict) -> str:
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:
         lines += _render_kv_cache(kv_cache)
+    kv_tier = stats.get("kv_tier")
+    if isinstance(kv_tier, dict) and kv_tier:
+        lines += _render_kv_tier(kv_tier)
     tenants = stats.get("tenants")
     if isinstance(tenants, dict) and tenants:
         lines += _render_tenants(tenants)

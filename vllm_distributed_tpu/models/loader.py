@@ -508,6 +508,22 @@ def resolve_stateful(model_config) -> bool:
     return bool(getattr(model_cls, "STATEFUL", False))
 
 
+def resolve_state_snapshotable(model_config) -> bool:
+    """True when the model's per-request state is SNAPSHOTABLE — it
+    exposes ``state_shapes()`` (SSM conv/ssm rows, Mamba/Jamba/Bamba),
+    so the state cache can checkpoint/restore it. STATEFUL alone is
+    not enough: Whisper/BART are stateful (fixed cross-attention state
+    rows, no prefix caching) but carry no re-enterable recurrence
+    state — activating the snapshot pool for them crashes the runner
+    at ``state_shapes`` and buys nothing."""
+    try:
+        hf_config = model_config.maybe_load_hf_config()
+        model_cls = resolve_architecture(hf_config)
+    except Exception:  # noqa: BLE001 - conservative
+        return False
+    return hasattr(model_cls, "state_shapes")
+
+
 def resolve_state_only(model_config) -> bool:
     """True for pure-SSM stacks (Mamba family): pages carry no KV
     bytes, so a state snapshot alone is a complete resume point and the

@@ -37,10 +37,14 @@ Design notes:
   statics + [2, QH] head-feature sidecar as the mega-kernel, so
   feature models that pass the block-shape eligibility keep the fused
   path.
-* Weight tiles DMA synchronously (single-buffered): decode is
-  bandwidth-bound, so overlap buys little until the real-TPU profiling
-  campaign (ROADMAP item 5) says otherwise. Eligibility (decided once
-  in models/loader.py) pins TP=1 and the standard dense block, so no
+* Weight tile streams are DOUBLE-BUFFERED: every stream (fused-QKV
+  columns, O-proj rows, the gate/up/down MLP trio) prefetches tile
+  i+1 into its second VMEM slot while tile i multiplies, so the HBM
+  weight read — the bandwidth bound of decode — overlaps the MXU
+  work instead of serializing with it. Two slots per stream keep the
+  VMEM footprint flat by halving the per-tile cap (weight_tile cap
+  256 vs the single-buffered 512). Eligibility (decided once in
+  models/loader.py) pins TP=1 and the standard dense block, so no
   shard_map wrapping is needed here.
 
 ``fused_block_decode_xla`` is the XLA-composed correctness reference:
@@ -61,11 +65,14 @@ from vllm_distributed_tpu import envs
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def weight_tile(n: int, cap: int = 512) -> int:
+def weight_tile(n: int, cap: int = 256) -> int:
     """Streaming tile width along a weight dimension: the largest
     divisor of ``n`` that is <= cap and lane-aligned (multiple of 128)
     when one exists, else the largest divisor <= cap. Small dims (CPU
-    tests) stream as one tile."""
+    tests) stream as one tile. The cap is half the single-buffered
+    512: each stream now holds TWO tiles in VMEM (double buffering),
+    so the finer tile keeps the footprint flat and pipelines the HBM
+    read behind the previous tile's matmul."""
     if n <= cap:
         return n
     for t in range(cap, 0, -128):
@@ -123,11 +130,11 @@ def _kernel(
     # scratch
     x_vmem,  # [sb, H] io dtype
     rope_buf,  # [2, sb, hd] f32
-    col_buf,  # [H, TQ] weight dtype (QKV column tiles)
-    row_buf,  # [TO, H] weight dtype (O-proj row tiles)
-    wg_buf,  # [H, TI]
-    wu_buf,  # [H, TI]
-    wd_buf,  # [TI, H]
+    col_buf,  # [2, H, TQ] weight dtype (QKV column tiles, 2 slots)
+    row_buf,  # [2, TO, H] weight dtype (O-proj row tiles, 2 slots)
+    wg_buf,  # [2, H, TI] (double-buffered MLP streams)
+    wu_buf,  # [2, H, TI]
+    wd_buf,  # [2, TI, H]
     kbuf,  # [2, sb, KVH, blk, D] cache dtype
     vbuf,
     kpage,  # [KVH, PS, D]
@@ -135,7 +142,7 @@ def _kernel(
     out_stage,  # [sb, H] io dtype
     x_sems,  # DMA [sb]
     rope_sems,  # DMA [2, sb]
-    w_sems,  # DMA [5]
+    w_sems,  # DMA [5, 2] (per weight stream x buffer slot)
     kv_sems,  # DMA [2, 2, sb, ppb]
     page_sems,  # DMA [2]
     out_sems,  # DMA [sb]
@@ -214,18 +221,27 @@ def _kernel(
             return ((x32 * jax.lax.rsqrt(var + eps)) *
                     lnw[w_row][None, :]).astype(io_dtype)
 
-        # ---- RMSNorm -> fused QKV (streamed column tiles) -----------
+        # ---- RMSNorm -> fused QKV (double-buffered column tiles) ----
+        # Tile t+1's DMA streams into the other VMEM slot while tile
+        # t multiplies; the wait() below re-constructs the matching
+        # copy descriptor (only the semaphore/slot identity matters).
         xn = rms(h0, 0).astype(w_dtype)
+        nq = Dtot // tq
+
+        def qkv_copy(t):
+            return pltpu.make_async_copy(
+                wqkv_hbm.at[:, pl.ds(t * tq, tq)], col_buf.at[t % 2],
+                w_sems.at[0, t % 2])
+
+        qkv_copy(0).start()
         parts = []
-        for t in range(Dtot // tq):
-            cp = pltpu.make_async_copy(
-                wqkv_hbm.at[:, pl.ds(t * tq, tq)], col_buf,
-                w_sems.at[0])
-            cp.start()
-            cp.wait()
+        for t in range(nq):
+            if t + 1 < nq:
+                qkv_copy(t + 1).start()
+            qkv_copy(t).wait()
             parts.append(
                 jax.lax.dot_general(
-                    xn, col_buf[...],
+                    xn, col_buf[t % 2],
                     dimension_numbers=(((1, ), (0, )), ((), ())),
                     preferred_element_type=jnp.float32))
         qkv = jnp.concatenate(parts, axis=-1).astype(io_dtype)
@@ -417,15 +433,22 @@ def _kernel(
         attn = (acc2 / jnp.maximum(l2, 1e-20)).astype(io_dtype)
         attn2d = attn.reshape(sb, Dq).astype(w_dtype)
 
-        # ---- O-projection (streamed contraction tiles) + residual ---
+        # ---- O-projection (double-buffered contraction tiles) -------
         acc_h = jnp.zeros((sb, H), jnp.float32)
-        for t in range(Dq // to):
-            cp = pltpu.make_async_copy(
-                wo_hbm.at[pl.ds(t * to, to)], row_buf, w_sems.at[1])
-            cp.start()
-            cp.wait()
+        no = Dq // to
+
+        def o_copy(t):
+            return pltpu.make_async_copy(
+                wo_hbm.at[pl.ds(t * to, to)], row_buf.at[t % 2],
+                w_sems.at[1, t % 2])
+
+        o_copy(0).start()
+        for t in range(no):
+            if t + 1 < no:
+                o_copy(t + 1).start()
+            o_copy(t).wait()
             acc_h = acc_h + jax.lax.dot_general(
-                attn2d[:, t * to:(t + 1) * to], row_buf[...],
+                attn2d[:, t * to:(t + 1) * to], row_buf[t % 2],
                 dimension_numbers=(((1, ), (0, )), ((), ())),
                 preferred_element_type=jnp.float32)
         h1 = h0 + acc_h
@@ -435,30 +458,46 @@ def _kernel(
         # [sb, I] intermediate never exists outside this loop body.
         x2 = rms(h1, 1).astype(w_dtype)
         acc_mlp = jnp.zeros((sb, H), jnp.float32)
-        for t in range(I // ti):
-            cg = pltpu.make_async_copy(
-                wg_hbm.at[:, pl.ds(t * ti, ti)], wg_buf, w_sems.at[2])
-            cu = pltpu.make_async_copy(
-                wu_hbm.at[:, pl.ds(t * ti, ti)], wu_buf, w_sems.at[3])
-            cd = pltpu.make_async_copy(
-                wd_hbm.at[pl.ds(t * ti, ti)], wd_buf, w_sems.at[4])
-            cg.start()
-            cu.start()
-            cd.start()
+        ni = I // ti
+
+        def mlp_copies(t):
+            s = t % 2
+            return (
+                pltpu.make_async_copy(
+                    wg_hbm.at[:, pl.ds(t * ti, ti)], wg_buf.at[s],
+                    w_sems.at[2, s]),
+                pltpu.make_async_copy(
+                    wu_hbm.at[:, pl.ds(t * ti, ti)], wu_buf.at[s],
+                    w_sems.at[3, s]),
+                pltpu.make_async_copy(
+                    wd_hbm.at[pl.ds(t * ti, ti)], wd_buf.at[s],
+                    w_sems.at[4, s]),
+            )
+
+        for cp in mlp_copies(0):
+            cp.start()
+        for t in range(ni):
+            if t + 1 < ni:
+                # Prefetch the NEXT tile's gate/up/down trio into the
+                # other slot while this tile's three matmuls run.
+                for cp in mlp_copies(t + 1):
+                    cp.start()
+            s = t % 2
+            cg, cu, cd = mlp_copies(t)
             cg.wait()
             cu.wait()
             g_t = jax.lax.dot_general(
-                x2, wg_buf[...],
+                x2, wg_buf[s],
                 dimension_numbers=(((1, ), (0, )), ((), ())),
                 preferred_element_type=jnp.float32)
             u_t = jax.lax.dot_general(
-                x2, wu_buf[...],
+                x2, wu_buf[s],
                 dimension_numbers=(((1, ), (0, )), ((), ())),
                 preferred_element_type=jnp.float32)
             gu_t = (jax.nn.silu(g_t) * u_t).astype(io_dtype)
             cd.wait()
             acc_mlp = acc_mlp + jax.lax.dot_general(
-                gu_t.astype(w_dtype), wd_buf[...],
+                gu_t.astype(w_dtype), wd_buf[s],
                 dimension_numbers=(((1, ), (0, )), ((), ())),
                 preferred_element_type=jnp.float32)
         h2 = h1 + acc_mlp
@@ -568,11 +607,14 @@ def fused_block_decode_pallas(
         scratch_shapes=[
             pltpu.VMEM((sb, H), hidden.dtype),
             pltpu.VMEM((2, sb, head_dim), jnp.float32),
-            pltpu.VMEM((H, tq), wqkv.dtype),
-            pltpu.VMEM((to, H), wo.dtype),
-            pltpu.VMEM((H, ti), w_gate.dtype),
-            pltpu.VMEM((H, ti), w_up.dtype),
-            pltpu.VMEM((ti, H), w_down.dtype),
+            # Weight streams carry TWO tile slots each (double
+            # buffering): tile t+1 DMAs into slot (t+1)%2 while tile
+            # t multiplies out of slot t%2.
+            pltpu.VMEM((2, H, tq), wqkv.dtype),
+            pltpu.VMEM((2, to, H), wo.dtype),
+            pltpu.VMEM((2, H, ti), w_gate.dtype),
+            pltpu.VMEM((2, H, ti), w_up.dtype),
+            pltpu.VMEM((2, ti, H), w_down.dtype),
             pltpu.VMEM((2, sb, KVH, blk, D), k_pages.dtype),
             pltpu.VMEM((2, sb, KVH, blk, D), v_pages.dtype),
             pltpu.VMEM((KVH, PS, D), k_pages.dtype),
@@ -580,7 +622,7 @@ def fused_block_decode_pallas(
             pltpu.VMEM((sb, H), hidden.dtype),
             pltpu.SemaphoreType.DMA((sb, )),
             pltpu.SemaphoreType.DMA((2, sb)),
-            pltpu.SemaphoreType.DMA((5, )),
+            pltpu.SemaphoreType.DMA((5, 2)),
             pltpu.SemaphoreType.DMA((2, 2, sb, ppb)),
             pltpu.SemaphoreType.DMA((2, )),
             pltpu.SemaphoreType.DMA((sb, )),

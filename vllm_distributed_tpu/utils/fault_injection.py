@@ -115,6 +115,7 @@ FAULT_POINTS = (
     "disagg.handoff_stall",
     "sched.quota_thrash",
     "perf.capture_stall",
+    "kv_tier.spill_corrupt",
 )
 
 

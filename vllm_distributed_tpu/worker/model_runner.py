@@ -304,6 +304,12 @@ class TPUModelRunner:
         self._state_keys: list[str] = []
         self.num_state_checkpoints = 0
         self.num_state_restores = 0
+        # Hierarchical KV tiering (core/kv_tier.py): the scheduler's
+        # tier manager, shared in-proc (wired by the engine core after
+        # construction). The runner executes the device legs — the
+        # pre-forward demotion gather / promotion scatter directives
+        # riding SchedulerOutput. None = untiered.
+        self.kv_tier = None
 
     # ------------------------------------------------------------------
     def load_model(self) -> None:
@@ -384,8 +390,13 @@ class TPUModelRunner:
     # SSM state-snapshot pool (core/state_cache.py device half)
     # ------------------------------------------------------------------
     def _state_cache_active(self) -> bool:
-        if self.model is None or not getattr(self.model, "STATEFUL",
-                                             False):
+        # Snapshotable state only: Whisper/BART are STATEFUL (fixed
+        # cross-attention rows) but expose no state_shapes() — the
+        # snapshot pool must not activate for them (same gate the
+        # scheduler applies via loader.resolve_state_snapshotable).
+        if (self.model is None
+                or not getattr(self.model, "STATEFUL", False)
+                or not hasattr(self.model, "state_shapes")):
             return False
         from vllm_distributed_tpu.core.state_cache import \
             state_cache_enabled
@@ -479,6 +490,58 @@ class TPUModelRunner:
                             self.kv_caches[name],
                             jnp.asarray(arrays[name]), row)
             self.num_state_restores += 1
+
+    # ------------------------------------------------------------------
+    # Hierarchical KV tiering (core/kv_tier.py device legs)
+    # ------------------------------------------------------------------
+    def _apply_kv_tier_pre(self, scheduler_output):
+        """Pre-forward KV-tier device legs. The demotion gather
+        dispatches FIRST — device program order pins the evicted
+        pages' pre-overwrite contents while the actual device->host
+        DMA overlaps the forward (the host fetch happens in
+        ``_apply_kv_tier_post``). Promote scatters follow: staged
+        wire-layout arrays land in their freshly allocated pages via
+        the existing page_io staging + chunked donated scatter, all
+        before the forward reads them."""
+        tier = self.kv_tier
+        if tier is None:
+            return None
+        demote = getattr(scheduler_output, "kv_demotes", None)
+        promotes = getattr(scheduler_output, "kv_promotes", None)
+        if demote is None and not promotes:
+            return None
+        from vllm_distributed_tpu import envs
+        from vllm_distributed_tpu.distributed.kv_transfer import page_io
+        handle = None
+        if demote is not None:
+            handle = page_io.gather_pages_start(self, demote.page_ids)
+        for d in promotes or ():
+            t0 = time.perf_counter()
+            k_np = np.stack([kv[0] for kv in d.arrays], axis=1)
+            v_np = np.stack([kv[1] for kv in d.arrays], axis=1)
+            k_dev, v_dev = page_io.stage_pages(self, k_np, v_np)
+            chunk = max(1, int(envs.VDT_KV_APPLY_CHUNK_PAGES))
+            for lo in range(0, len(d.page_ids), chunk):
+                page_io.scatter_pages_chunk(self, d.page_ids, k_dev,
+                                            v_dev, lo, chunk)
+            # Histogram records the host-side dispatch cost (the
+            # scatter itself overlaps the forward; correctness rides
+            # program order, not completion).
+            tier.record_promotion(d, time.perf_counter() - t0)
+        return (demote, handle) if handle is not None else None
+
+    def _apply_kv_tier_post(self, pending) -> None:
+        """Post-dispatch half of a demotion: complete the (already
+        in-flight) device->host copies and land each page in the host
+        tier — the fetch, and any host->disk spill it triggers, run
+        while the forward executes on device."""
+        if pending is None:
+            return
+        demote, handle = pending
+        from vllm_distributed_tpu.distributed.kv_transfer import page_io
+        k_np, v_np = page_io.gather_pages_finish(self, handle)
+        for i, key in enumerate(demote.keys):
+            self.kv_tier.insert_host(key, k_np[:, i], v_np[:, i])
 
     def _apply_state_saves(self, scheduler_output) -> None:
         """Execute state_saves AFTER the forward dispatch: program order
@@ -1520,6 +1583,9 @@ class TPUModelRunner:
         # zero-token outputs never carry them (scheduler invariant: the
         # zero-token path does no device work).
         self._apply_state_restores(scheduler_output)
+        # KV-tier demotion gather + promotion scatter, also pre-forward
+        # (and, like state ops, never on zero-token outputs).
+        tier_pending = self._apply_kv_tier_pre(scheduler_output)
         if scheduler_output.total_num_scheduled_tokens == 0:
             # Nothing to run, but async KV transfers may need servicing:
             # hand queued peer reads / completed pulls to the connector
@@ -1546,6 +1612,7 @@ class TPUModelRunner:
             if pending is not None:
                 self._perf_commit(pending,
                                   time.perf_counter() - t_burst)
+            self._apply_kv_tier_post(tier_pending)
             return {"ready": out}
 
         t_prep = time.perf_counter()
@@ -1604,6 +1671,10 @@ class TPUModelRunner:
         # State snapshots AFTER the forward dispatch: program order on
         # the (donated) cache arrays makes the copy read post-step rows.
         self._apply_state_saves(scheduler_output)
+        # Demotion host fetch AFTER the forward dispatch: the copies
+        # were started pre-forward, so they complete while the device
+        # runs the step.
+        self._apply_kv_tier_post(tier_pending)
         return {"so": scheduler_output, "dev": dev, "kv_meta": kv_meta,
                 "sampling_req_ids": sampling_req_ids,
                 "drafts_arr": drafts_arr, "R": R,
