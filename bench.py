@@ -1779,6 +1779,144 @@ def _tiering_leg(config, record) -> None:
                 os.environ[k] = v
 
 
+def _fleet_leg(config, record) -> None:
+    """Elastic-fleet acceptance leg (ISSUE 16): a diurnal two-phase
+    trace — an interactive peak wave, a trough, a second peak — on a
+    2-replica in-process DP fleet with the controller ON (walks
+    2 -> 1 -> 2: scale-in to the floor during the trough with the
+    live stragglers journal-migrated, warm scale-out back into the
+    retired slot at the second peak) vs ``VDT_FLEET=0`` (static 2
+    replicas) on byte-identical traffic. Reports the settled replica
+    count per phase, scale/freeze/wedge counters, warm-start pages,
+    peak-phase request-latency p50/p99 per leg (the elastic leg's p99
+    honestly includes the inline provisioning stall), and greedy token
+    parity across the migrations — elasticity is contractually
+    token-invisible."""
+    import gc
+
+    import jax
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    if len(jax.devices()) < 2:
+        record["fleet_leg_error"] = (
+            "needs >= 2 devices for a 2-replica DP fleet")
+        return
+    phases = (("peak1", 8), ("trough", 2), ("peak2", 8))
+    sp = SamplingParams(temperature=0.0, max_tokens=16,
+                        ignore_eos=True)
+    rng = np.random.default_rng(16)
+    prompts = {(ph, s): [int(x) for x in rng.integers(10, 5000,
+                                                      size=64)]
+               for ph, n in phases for s in range(n)}
+    keys = ("VDT_FLEET", "VDT_FLEET_TICK_S", "VDT_FLEET_EVAL_TICKS",
+            "VDT_FLEET_STALE_S", "VDT_FLEET_DRAIN_S",
+            "VDT_FLEET_MIN_REPLICAS", "VDT_FLEET_MAX_REPLICAS",
+            "VDT_FLEET_HIGH_WATERMARK", "VDT_FLEET_LOW_WATERMARK",
+            "VDT_FLEET_ACTIONS")
+    saved = {k: os.environ.get(k) for k in keys}
+    outputs: dict = {}
+    try:
+        for leg, flag in (("on", "1"), ("off", "0")):
+            os.environ.update({
+                "VDT_FLEET": flag,
+                "VDT_FLEET_TICK_S": "0",
+                "VDT_FLEET_EVAL_TICKS": "3",
+                "VDT_FLEET_STALE_S": "0",
+                # Zero drain grace: retirement mid-trough must
+                # journal-migrate the live stragglers; the parity
+                # flag below is what proves that path token-exact.
+                "VDT_FLEET_DRAIN_S": "0",
+                "VDT_FLEET_MIN_REPLICAS": "1",
+                "VDT_FLEET_MAX_REPLICAS": "2",
+                # Peak occupancy on ONE replica is 1.0, on two it is
+                # 0.5; the trough sits near 0.12 — the watermarks
+                # bracket exactly the 2 -> 1 -> 2 walk.
+                "VDT_FLEET_HIGH_WATERMARK": "0.7",
+                "VDT_FLEET_LOW_WATERMARK": "0.2",
+                "VDT_FLEET_ACTIONS": "20",
+            })
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                cache_config=CacheConfig(block_size=16,
+                                         num_gpu_blocks=256),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=1024, max_num_seqs=8,
+                    max_model_len=512, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            cfg.parallel_config.data_parallel_size = 2
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            outs: dict = {}
+            peak_lat: list = []
+            timeline: list = []
+            t0 = time.perf_counter()
+            for ph, n in phases:
+                t_add = {}
+                for s in range(n):
+                    rid = f"{leg}-{ph}-{s}"
+                    engine.add_request(rid, list(prompts[(ph, s)]), sp)
+                    t_add[rid] = time.perf_counter()
+                while engine.has_unfinished_requests():
+                    for o in engine.step():
+                        if o.finished:
+                            outs[o.request_id] = list(
+                                o.outputs[0].token_ids)
+                            if ph != "trough":
+                                peak_lat.append(
+                                    (time.perf_counter()
+                                     - t_add[o.request_id]) * 1e3)
+                fleet = getattr(engine.engine_core, "fleet", None)
+                if fleet is not None:
+                    timeline.append(fleet.get_stats()["replicas"])
+                    # Idle ticks settle an in-progress drain so the
+                    # next phase starts from the converged fleet (the
+                    # trace ends at the last phase: no trailing ticks,
+                    # or the counters would show a post-trace retire).
+                    if ph != phases[-1][0]:
+                        for _ in range(8):
+                            engine.engine_core._tick()
+            wall = time.perf_counter() - t0
+            outputs[leg] = outs
+            n_reqs = sum(n for _, n in phases)
+            record[f"fleet_{leg}_reqs_per_s"] = round(n_reqs / wall, 2)
+            record[f"fleet_{leg}_req_p50_ms"] = round(
+                float(np.percentile(peak_lat, 50)), 1)
+            record[f"fleet_{leg}_req_p99_ms"] = round(
+                float(np.percentile(peak_lat, 99)), 1)
+            if flag == "1":
+                stats = engine.get_stats()
+                fs = stats.get("fleet") or {}
+                record["fleet_replica_timeline"] = timeline
+                record["fleet_scale_outs"] = int(fs.get("scale_outs",
+                                                        0))
+                record["fleet_scale_ins"] = int(fs.get("scale_ins", 0))
+                record["fleet_warm_start_pages"] = int(
+                    fs.get("warm_start_pages", 0))
+                record["fleet_wedge_cycles"] = int(
+                    fs.get("wedge_cycles", 0))
+                record["fleet_freezes"] = {
+                    k: int(v)
+                    for k, v in (fs.get("freezes") or {}).items()}
+                record["fleet_replica_failovers"] = int(
+                    stats.get("replica_failovers", 0))
+            engine.shutdown()
+            del engine
+            gc.collect()
+        on = {k.split("-", 1)[1]: v for k, v in outputs["on"].items()}
+        off = {k.split("-", 1)[1]: v
+               for k, v in outputs["off"].items()}
+        record["fleet_parity"] = on == off
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> None:
     from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
                                              LoadConfig, ModelConfig,
@@ -1934,9 +2072,10 @@ def main() -> None:
     dev_s = device_decode["s"]
     record = {
         "metric": "decode_throughput_llama1b_bs8",
-        # v3: _tiering_leg fields (or tiering_leg_error) are required —
-        # scripts/lint_bench.py keeps future records machine-comparable.
-        "schema_version": 3,
+        # v4: _fleet_leg fields (or fleet_leg_error) join the v3
+        # _tiering_leg requirements — scripts/lint_bench.py keeps
+        # future records machine-comparable.
+        "schema_version": 4,
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
@@ -2081,6 +2220,12 @@ def main() -> None:
             _tiering_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["tiering_leg_error"] = f"{type(e).__name__}: {e}"
+        # Elastic-fleet leg: diurnal 2 -> 1 -> 2 walk, controller on
+        # vs static fleet, token parity across the migrations.
+        try:
+            _fleet_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
         # Quantized-communication leg: dcn_pull transfer bytes + parity
         # with the int8 KV codec on vs off.
         try:
@@ -2167,6 +2312,10 @@ def main() -> None:
             _tiering_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["tiering_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _fleet_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _qcomm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

@@ -25,6 +25,12 @@ a record drifts:
   an explicit ``tiering_leg_error`` string recording why the leg
   could not run. A parity field that is present must be ``true``:
   tiering is contractually token-invisible.
+* **schema_version >= 4 records** (the elastic fleet) must carry the
+  ``_fleet_leg`` comparison — request throughput and peak-phase
+  p50/p99 for both legs, the scale counters, the replica timeline and
+  the greedy-parity flag — or an explicit ``fleet_leg_error`` string.
+  ``fleet_parity`` must be ``true``: elasticity is contractually
+  token-invisible, migrations included.
 
 Usage::
 
@@ -92,6 +98,8 @@ def check_record(name: str, rec) -> list:
                         f"got {rec.get(key)!r}")
         if version >= 3:
             errs.extend(_check_tiering_fields(name, rec))
+        if version >= 4:
+            errs.extend(_check_fleet_fields(name, rec))
     return errs
 
 
@@ -122,6 +130,45 @@ def _check_tiering_fields(name: str, rec: dict) -> list:
     for key, (ok, want) in TIERING_FIELDS.items():
         if not ok(rec.get(key)):
             errs.append(f"{name}: schema>=3 record needs {key} "
+                        f"({want}), got {rec.get(key)!r}")
+    return errs
+
+
+# _fleet_leg comparison fields required on schema >= 4 records
+# ((validator, description) per field; see bench.py _fleet_leg).
+FLEET_FIELDS = {
+    "fleet_on_reqs_per_s": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "fleet_off_reqs_per_s": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "fleet_on_req_p99_ms": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "fleet_off_req_p99_ms": (
+        lambda v: _is_num(v) and v > 0, "positive number"),
+    "fleet_scale_outs": (
+        lambda v: _is_num(v) and v >= 0, "number >= 0"),
+    "fleet_scale_ins": (
+        lambda v: _is_num(v) and v >= 0, "number >= 0"),
+    "fleet_replica_timeline": (
+        lambda v: (isinstance(v, list) and v
+                   and all(_is_num(x) and x >= 1 for x in v)),
+        "non-empty list of replica counts >= 1"),
+    "fleet_parity": (lambda v: v is True,
+                     "true (elasticity must be token-invisible)"),
+}
+
+
+def _check_fleet_fields(name: str, rec: dict) -> list:
+    err = rec.get("fleet_leg_error")
+    if err is not None:
+        if isinstance(err, str) and err:
+            return []  # leg failed and says why — valid record
+        return [f"{name}: fleet_leg_error must be a non-empty "
+                f"string, got {err!r}"]
+    errs = []
+    for key, (ok, want) in FLEET_FIELDS.items():
+        if not ok(rec.get(key)):
+            errs.append(f"{name}: schema>=4 record needs {key} "
                         f"({want}), got {rec.get(key)!r}")
     return errs
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Ban fault points no test exercises.
+
+Every name in ``FAULT_POINTS`` (utils/fault_injection.py) is a
+contract: some production code path consults it, and some drill proves
+the degradation it triggers stays on its recovery ladder. A point that
+no test references is an untested failure mode wearing a tested one's
+uniform — the injection site can rot (or the recovery path regress)
+with tier-1 staying green. Mechanically:
+
+* **registered** — every string literal inside the ``FAULT_POINTS``
+  tuple, parsed textually so the linter runs without importing the
+  package (same approach as lint_env_flags.py's registry parse).
+* **exercised** — the point's name appears as a string literal in at
+  least one file under ``tests/``. A grep is deliberately the bar:
+  drills arm points via ``inject("<name>", ...)`` / ``fire_or_raise``
+  assertions / counters() lookups, all of which carry the literal.
+
+Failures: a registered point with zero test-file references, or a
+test referencing a point the registry does not know (typo'd drill —
+``inject`` on an unregistered name raises at runtime, but only if that
+test actually runs; the linter catches it statically).
+
+Usage::
+
+    python scripts/lint_faults.py [--registry FILE] [--tests DIR]
+
+Exit 0 when clean; exit 1 listing violations otherwise.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+# One registered point: a string literal inside the FAULT_POINTS tuple.
+POINT_RE = re.compile(r'"([a-z0-9_]+\.[a-z0-9_]+)"')
+
+
+def registered_points(registry_path: Path) -> set[str]:
+    text = registry_path.read_text(encoding="utf-8")
+    marker = text.find("FAULT_POINTS")
+    if marker < 0:
+        return set()
+    start = text.find("(", marker)
+    end = text.find(")", start)
+    if start < 0 or end < 0:
+        return set()
+    return set(POINT_RE.findall(text[start:end]))
+
+
+def test_references(tests_dir: Path,
+                    points: set[str]) -> dict[str, list[str]]:
+    """Map each point name to the test files whose text contains it
+    as a quoted literal (single- or double-quoted)."""
+    refs: dict[str, list[str]] = {p: [] for p in points}
+    for path in sorted(tests_dir.rglob("*.py")):
+        text = path.read_text(encoding="utf-8", errors="replace")
+        for point in points:
+            if f'"{point}"' in text or f"'{point}'" in text:
+                refs[point].append(str(path.relative_to(tests_dir)))
+    return refs
+
+
+def unknown_references(tests_dir: Path,
+                       points: set[str]) -> list[tuple[str, str]]:
+    """(file, name) pairs for quoted dotted names passed to the
+    injection API that are NOT registered points."""
+    arm_re = re.compile(
+        r'(?:inject|fire_or_raise|should_fire|maybe_delay)\(\s*'
+        r'["\']([a-z0-9_]+\.[a-z0-9_]+)["\']')
+    unknown: list[tuple[str, str]] = []
+    for path in sorted(tests_dir.rglob("*.py")):
+        text = path.read_text(encoding="utf-8", errors="replace")
+        for name in arm_re.findall(text):
+            if name not in points:
+                unknown.append((str(path.relative_to(tests_dir)), name))
+    return unknown
+
+
+def main(argv: list[str]) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--registry", type=Path,
+        default=(repo / "vllm_distributed_tpu" / "utils" /
+                 "fault_injection.py"),
+        help="module carrying the FAULT_POINTS tuple")
+    parser.add_argument("--tests", type=Path, default=repo / "tests",
+                        help="test tree to grep for point references")
+    args = parser.parse_args(argv)
+    if not args.registry.is_file():
+        print(f"lint_faults: no such file: {args.registry}",
+              file=sys.stderr)
+        return 2
+    if not args.tests.is_dir():
+        print(f"lint_faults: no such directory: {args.tests}",
+              file=sys.stderr)
+        return 2
+
+    points = registered_points(args.registry)
+    if not points:
+        print("lint_faults: could not parse FAULT_POINTS "
+              f"from {args.registry}", file=sys.stderr)
+        return 2
+    refs = test_references(args.tests, points)
+    problems: list[str] = []
+    for point in sorted(points):
+        if not refs[point]:
+            problems.append(
+                f"{point}: registered in FAULT_POINTS but exercised by "
+                f"no file under {args.tests.name}/ (untested failure "
+                f"mode)")
+    for rel, name in unknown_references(args.tests, points):
+        problems.append(
+            f"{name}: armed by {args.tests.name}/{rel} but not in "
+            f"FAULT_POINTS (typo'd drill)")
+    if not problems:
+        return 0
+    print("Fault-point drill coverage drift:", file=sys.stderr)
+    for p in problems:
+        print(f"  {p}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,715 @@
+"""Elastic-fleet control loop (engine/fleet.py).
+
+Deterministic stub-replica drills over ``FleetController``: scale-out
+under pressure (with the ``fleet.scale_stall`` drill and the at-max /
+budget freezes), zero-loss scale-in through the drain -> journal-migrate
+ladder, wedge cycling counted on exactly the ``wedge_cycles`` rung, the
+verified resurrection probe (satellite: a replica that reconnects but
+fails its stats probe is NOT a resurrection), live prefill->decode pool
+re-splits proven by pool-occupancy metrics, and the ``VDT_FLEET=0``
+inert default. The chaos soaks at the bottom run the 2->3->1 schedule
+under a seeded fault sequence with continuous traffic and a
+deterministic per-session token function, so zero-loss/zero-duplication
+and greedy-parity are exact assertions, not spot checks."""
+
+import time
+
+import pytest
+
+from tests.conftest import make_config
+from vllm_distributed_tpu.core.sched.scheduler import EngineCoreOutput
+from vllm_distributed_tpu.engine import dp_client as dp_mod
+from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
+                                                     EngineDeadError)
+from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.faults
+
+PROMPT = [1, 2, 3]
+
+
+def _tok(session: int, pos: int) -> int:
+    """Deterministic greedy token for a session's pos-th output token:
+    the parity oracle. A migrated continuation resumes at the position
+    its delivered prefix encodes, so any lost, duplicated, or reordered
+    token breaks the exact-match assertion."""
+    return 3 + (session * 131 + pos * 17) % 97
+
+
+def _expected(session: int, max_tokens: int) -> list[int]:
+    return [_tok(session, p) for p in range(max_tokens)]
+
+
+def _session_of(rid: str) -> int:
+    return int(rid.split("-")[-1])
+
+
+def _coords(rid: str, req: EngineCoreRequest) -> dict:
+    return {"remote_req_id": rid, "pull_host": "h", "pull_port": 7,
+            "num_tokens": len(req.prompt_token_ids),
+            "remote_page_ids": [0]}
+
+
+class _FleetStub(EngineCoreClient):
+    """Scriptable replica with a deterministic token engine.
+
+    ``engine_core`` is set so the controller's inline snapshot refresh
+    polls it like an in-process engine; ``serve()`` queues one output
+    batch (one next token per pending request) the way a real step
+    would, computing each token from the PURE position function — a
+    request re-admitted elsewhere (drain, wedge, death) resumes
+    mid-stream token-identically or not at all."""
+
+    warm_pages = 0  # scripted kv_tier warm-start page count
+
+    def __init__(self, config) -> None:
+        self.config = config
+        self.engine_core = self  # inline stats refresh marker
+        self.role = config.kv_transfer_config.pool_role
+        self.added: list[EngineCoreRequest] = []
+        self.aborted: list[str] = []
+        self.outputs: list[list[EngineCoreOutput]] = []
+        self.pending: dict[str, list] = {}  # rid -> [request, emitted]
+        self.stats = {"num_running_reqs": 0, "num_waiting_reqs": 0,
+                      "steps_dispatched": 0}
+        self.dead = False
+        self.fail_restart = False
+        self.fail_stats = False
+        self.die_consult = False  # soak: consult engine_core.die
+        self.restarts = 0
+        self.shutdowns = 0
+
+    def _check(self) -> None:
+        if self.dead:
+            raise EngineDeadError("stub replica is dead")
+
+    def add_request(self, request: EngineCoreRequest) -> None:
+        self._check()
+        self.added.append(request)
+        self.pending[request.request_id] = [request, 0]
+
+    def abort_requests(self, request_ids) -> None:
+        self._check()
+        self.aborted.extend(request_ids)
+        for rid in request_ids:
+            self.pending.pop(rid, None)
+
+    def recv_outputs(self, timeout_ms: int):
+        if self.die_consult and not self.dead:
+            try:
+                fi.fire_or_raise("engine_core.die")
+            except fi.InjectedFault as e:
+                self.dead = True
+                self.pending.clear()
+                self.outputs.clear()
+                raise EngineDeadError(str(e)) from e
+        self._check()
+        return self.outputs.pop(0) if self.outputs else None
+
+    def get_stats(self) -> dict:
+        if self.fail_stats:
+            raise RuntimeError("stub stats probe failed")
+        s = dict(self.stats)
+        s["num_running_reqs"] = len(self.pending)
+        s["kv_tier"] = {"warm_start_pages": type(self).warm_pages}
+        return s
+
+    def restart(self) -> None:
+        if self.fail_restart:
+            raise EngineDeadError("stub replica refuses to restart")
+        self.dead = False
+        self.restarts += 1
+        # A restarted engine is EMPTY (the balancer journal re-admits).
+        self.pending.clear()
+        self.outputs.clear()
+
+    def shutdown(self) -> None:
+        self.shutdowns += 1
+
+    # -- deterministic token engine -------------------------------------
+    def serve(self) -> None:
+        """Queue one step's output batch for every pending request."""
+        if self.dead:
+            return
+        self.stats["steps_dispatched"] += 1
+        if not self.pending:
+            return
+        batch: list[EngineCoreOutput] = []
+        for rid in list(self.pending):
+            req, emitted = self.pending[rid]
+            session = _session_of(rid)
+            if self.role == "prefill":
+                # Prefill-stage copy: one token, finish with the pull
+                # coordinates (the handoff swallows the token and the
+                # decode home regenerates the stream from position 0).
+                batch.append(EngineCoreOutput(
+                    req_id=rid, new_token_ids=[_tok(session, 0)],
+                    finish_reason="length",
+                    kv_transfer_params=_coords(rid, req)))
+                self.pending.pop(rid)
+                continue
+            # Decode stage (or plain DP): a handoff copy carries the
+            # original prompt (resume at 0); a migrated continuation's
+            # prompt absorbed its delivered prefix (resume past it).
+            pos = len(req.prompt_token_ids) - len(PROMPT) + emitted
+            events = None
+            params = req.kv_transfer_params or {}
+            if (emitted == 0 and str(params.get("remote_req_id", ""))
+                    .endswith("#stalled")):
+                # Stalled pull coordinates: a real decode home rides
+                # the retry -> local-re-prefill ladder and ships the
+                # KV_PULL_LOCAL event; disagg counts the rung from it.
+                events = [(time.monotonic(), ev.KV_PULL_LOCAL, None)]
+            finished = emitted + 1 >= req.sampling_params.max_tokens
+            batch.append(EngineCoreOutput(
+                req_id=rid, new_token_ids=[_tok(session, pos)],
+                finish_reason="length" if finished else None,
+                events=events))
+            if finished:
+                self.pending.pop(rid)
+            else:
+                self.pending[rid][1] = emitted + 1
+        if batch:
+            self.outputs.append(batch)
+
+
+FLEET_ENV = {
+    "VDT_FLEET": "1",
+    "VDT_FLEET_TICK_S": "0",      # every _tick() evaluates
+    "VDT_FLEET_EVAL_TICKS": "1",  # no hysteresis unless a test wants it
+    "VDT_FLEET_STALE_S": "0",     # stale freeze off unless tested
+    "VDT_FLEET_WEDGE_S": "1000",  # only the drill forces a wedge
+    "VDT_FLEET_DRAIN_S": "0",     # drain deadlines land immediately
+    "VDT_FLEET_MIN_REPLICAS": "1",
+    "VDT_FLEET_MAX_REPLICAS": "3",
+    "VDT_FLEET_ACTIONS": "50",
+    "VDT_FLEET_ACTION_WINDOW_S": "300",
+    # Deterministic placement (live-count round-robin): the fleet tests
+    # assert exact owners; the router has its own suite.
+    "VDT_ROUTER": "0",
+}
+
+
+def make_fleet(monkeypatch, n: int = 2, **env) -> DPEngineClient:
+    for key, val in {**FLEET_ENV, **env}.items():
+        monkeypatch.setenv(key, val)
+    config = make_config()
+    config.parallel_config.data_parallel_size = n
+    ft = config.fault_tolerance_config
+    ft.replica_probe_interval_s = 0.01
+    ft.restart_backoff_base_s = 0.0
+    ft.restart_max_attempts = 100
+    monkeypatch.setattr(dp_mod, "SyncMPClient", _FleetStub)
+    return DPEngineClient(config, force_mp=True)
+
+
+def _req(rid: str, max_tokens: int = 8) -> EngineCoreRequest:
+    return EngineCoreRequest(
+        request_id=rid, prompt_token_ids=list(PROMPT),
+        sampling_params=SamplingParams(temperature=0.0,
+                                       max_tokens=max_tokens))
+
+
+def _pressure(dp, waiting: int) -> None:
+    for c in dp.clients:
+        c.stats["num_waiting_reqs"] = waiting
+
+
+def _tick(dp, n: int = 1) -> None:
+    for _ in range(n):
+        dp._tick()
+
+
+# ---------------------------------------------------------------------------
+# Inert default
+# ---------------------------------------------------------------------------
+def test_fleet_off_is_inert(monkeypatch):
+    """VDT_FLEET unset: no controller, the legacy resurrection probe
+    owns the output path, no fleet stats entry, no fleet state."""
+    monkeypatch.setenv("VDT_ROUTER", "0")
+    config = make_config()
+    config.parallel_config.data_parallel_size = 2
+    config.fault_tolerance_config.replica_probe_interval_s = 0.01
+    config.fault_tolerance_config.restart_backoff_base_s = 0.0
+    monkeypatch.setattr(dp_mod, "SyncMPClient", _FleetStub)
+    dp = DPEngineClient(config, force_mp=True)
+    assert dp.fleet is None
+    assert dp._retired == set() and dp._no_place == set()
+    agg = dp._aggregate_stats([{}, {}], indices=[0, 1])
+    assert "fleet" not in agg
+    # Legacy probe path still resurrects (the fold is fleet-on only).
+    dp.clients[0].dead = True
+    dp.add_request(_req("x-0"))
+    assert 0 in dp._down and dp.replica_failovers == 1
+    deadline = time.monotonic() + 5.0
+    while 0 in dp._down and time.monotonic() < deadline:
+        time.sleep(0.02)
+        dp.recv_outputs(timeout_ms=10)
+    assert 0 not in dp._down
+    assert dp.replica_resurrections == 1
+
+
+# ---------------------------------------------------------------------------
+# Scale-out (+ scale_stall / at_max / budget freezes, warm start)
+# ---------------------------------------------------------------------------
+def test_scale_out_under_pressure_with_scale_stall_drill(monkeypatch):
+    dp = make_fleet(monkeypatch)
+    monkeypatch.setattr(_FleetStub, "warm_pages", 5)
+    _pressure(dp, 20)  # occupancy 40/16 >> high watermark
+    fi.inject("fleet.scale_stall", max_fires=1)
+    try:
+        _tick(dp)
+        # First attempt stalls: budget consumed, fleet intact.
+        assert len(dp.clients) == 2
+        assert dp.fleet.freezes.get("scale_stall") == 1
+        _tick(dp)
+    finally:
+        fi.clear("fleet.scale_stall")
+    assert len(dp.clients) == 3
+    assert dp.fleet.scale_outs == 1
+    stats = dp.fleet.get_stats()
+    assert stats["replicas"] == 3
+    # Warm start from the shared T2 namespace, counted.
+    assert stats["warm_start_pages"] == 5
+    # The appended replica grew the balancer state and takes traffic.
+    assert len(dp._live) == 3 and len(dp._supervisors) == 3
+    for i in range(3):
+        dp.add_request(_req(f"r-{i}"))
+    assert {dp._owner[f"r-{i}"] for i in range(3)} == {0, 1, 2}
+    # Sustained pressure at the device budget: frozen at_max, not grown.
+    _tick(dp)
+    assert len(dp.clients) == 3
+    assert dp.fleet.freezes.get("at_max", 0) >= 1
+
+
+def test_budget_exhaustion_freezes_actuation(monkeypatch):
+    dp = make_fleet(monkeypatch, VDT_FLEET_ACTIONS="1",
+                    VDT_FLEET_MAX_REPLICAS="4")
+    _pressure(dp, 20)
+    _tick(dp)
+    assert len(dp.clients) == 3  # first action consumed the budget
+    _tick(dp)
+    assert len(dp.clients) == 3
+    assert dp.fleet.freezes.get("budget", 0) >= 1
+
+
+def test_stale_stats_freeze_actuation(monkeypatch):
+    """A replica whose stats went quiet freezes ALL actuation (never
+    reshape the fleet on blind signals); fresh stats thaw it."""
+    dp = make_fleet(monkeypatch, VDT_FLEET_STALE_S="1000")
+    _pressure(dp, 20)
+    dp.clients[1].fail_stats = True  # its snapshot never lands
+    _tick(dp, 3)
+    assert len(dp.clients) == 2
+    assert dp.fleet.freezes.get("stale_stats", 0) >= 1
+    dp.clients[1].fail_stats = False
+    _tick(dp, 2)  # snapshot lands, then actuation resumes
+    assert len(dp.clients) == 3
+
+
+# ---------------------------------------------------------------------------
+# Scale-in: drain -> journal-migrate -> retire, zero loss
+# ---------------------------------------------------------------------------
+def test_scale_in_drains_and_migrates_zero_loss(monkeypatch):
+    dp = make_fleet(monkeypatch, VDT_FLEET_DRAIN_S="60")
+    dp.add_request(_req("s-0", max_tokens=10))
+    dp.add_request(_req("s-1", max_tokens=10))
+    assert dp._owner["s-0"] == 0 and dp._owner["s-1"] == 1
+    # Low occupancy: (2 live + 0 waiting) / 16 < low watermark. Equal
+    # load ties retire the HIGHER index: replica 1 drains.
+    _tick(dp)
+    assert 1 in dp._no_place and 1 in dp.fleet._draining
+    assert dp.fleet._draining[1]["mode"] == "retire"
+    # Draining replica leaves PLACEMENT but keeps serving.
+    dp.add_request(_req("s-2", max_tokens=10))
+    assert dp._owner["s-2"] == 0
+    vstub = dp.clients[1]
+    vstub.serve()
+    delivered = dp.recv_outputs(timeout_ms=10) or []
+    assert [o.req_id for o in delivered] == ["s-1"]
+    assert dp._progress["s-1"] == [_tok(1, 0)]
+    # Past the drain deadline: the straggler journal-migrates as a
+    # token-identical continuation. No failover counted.
+    dp.fleet._draining[1]["deadline"] = 0.0
+    _tick(dp)
+    assert 1 in dp._retired and 1 in dp._down
+    assert dp.replica_failovers == 0
+    assert dp.fleet.scale_ins == 1
+    assert dp.fleet.get_stats()["replicas"] == 1
+    assert "s-1" in vstub.aborted
+    cont = next(r for r in dp.clients[0].added if r.request_id == "s-1")
+    assert cont.prompt_token_ids == PROMPT + [_tok(1, 0)]
+    assert cont.sampling_params.max_tokens == 9
+    # Zero loss: the migrated session finishes with the exact stream.
+    tokens = list(dp._progress["s-1"])
+    deadline = time.monotonic() + 5.0
+    while "s-1" in dp._owner and time.monotonic() < deadline:
+        dp.clients[0].serve()
+        for out in dp.recv_outputs(timeout_ms=10) or []:
+            if out.req_id == "s-1":
+                tokens.extend(out.new_token_ids)
+    assert tokens == _expected(1, 10)
+    # At the min-replica floor nothing more retires.
+    _tick(dp, 3)
+    assert dp.fleet.get_stats()["replicas"] == 1
+    # Retired slots never probe (the slot is reserved for scale-out).
+    time.sleep(0.05)
+    _tick(dp)
+    assert vstub.restarts == 0
+
+
+def test_scale_out_reuses_retired_slot(monkeypatch):
+    dp = make_fleet(monkeypatch)
+    _tick(dp)   # retire replica 1 (occupancy 0)
+    _tick(dp)   # empty drain completes immediately
+    assert dp._retired == {1}
+    assert dp.fleet.get_stats()["replicas"] == 1
+    old_stub = dp.clients[1]
+    _pressure(dp, 20)
+    _tick(dp)
+    # The retired slot was reused, not appended.
+    assert len(dp.clients) == 2
+    assert dp._retired == set() and 1 not in dp._down
+    assert dp.clients[1] is not old_stub
+    assert dp.fleet.scale_outs == 1
+
+
+# ---------------------------------------------------------------------------
+# Wedge cycling: exactly one ladder rung
+# ---------------------------------------------------------------------------
+def test_wedge_cycle_counts_on_exactly_one_rung(monkeypatch):
+    dp = make_fleet(monkeypatch, VDT_FLEET_LOW_WATERMARK="0")
+    dp.add_request(_req("w-0", max_tokens=6))
+    assert dp._owner["w-0"] == 0
+    vstub = dp.clients[0]
+    vstub.serve()
+    delivered = dp.recv_outputs(timeout_ms=10)
+    assert delivered and delivered[0].new_token_ids == [_tok(0, 0)]
+    fi.inject("fleet.replica_wedge", max_fires=1)
+    try:
+        _tick(dp)
+    finally:
+        fi.clear("fleet.replica_wedge")
+    # The wedge rung and ONLY the wedge rung.
+    assert dp.fleet.wedge_cycles == 1
+    assert dp.replica_failovers == 0
+    assert 0 in dp._down and 0 not in dp._retired
+    assert "w-0" in vstub.aborted
+    cont = next(r for r in dp.clients[1].added if r.request_id == "w-0")
+    assert cont.prompt_token_ids == PROMPT + [_tok(0, 0)]
+    # The folded probe force-cycles it back through the restart budget.
+    deadline = time.monotonic() + 5.0
+    while 0 in dp._down and time.monotonic() < deadline:
+        time.sleep(0.02)
+        _tick(dp)
+    assert 0 not in dp._down
+    assert vstub.restarts == 1
+    # The in-flight session still finishes token-identically.
+    tokens = list(dp._progress["w-0"])
+    deadline = time.monotonic() + 5.0
+    while "w-0" in dp._owner and time.monotonic() < deadline:
+        dp.clients[1].serve()
+        for out in dp.recv_outputs(timeout_ms=10) or []:
+            if out.req_id == "w-0":
+                tokens.extend(out.new_token_ids)
+    assert tokens == _expected(0, 6)
+
+
+# ---------------------------------------------------------------------------
+# Verified resurrection (satellite accounting fix)
+# ---------------------------------------------------------------------------
+def test_resurrection_not_counted_until_health_verified(monkeypatch):
+    dp = make_fleet(monkeypatch)
+    dp.clients[0].dead = True
+    dp.add_request(_req("x-0"))  # discovers the death, fails over
+    assert 0 in dp._down and dp.replica_failovers == 1
+    # The probe reconnects (restart succeeds) but the replica cannot
+    # answer its stats probe: NOT a resurrection, still down.
+    dp.clients[0].fail_stats = True
+    deadline = time.monotonic() + 5.0
+    while dp.clients[0].restarts == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+        _tick(dp)
+    time.sleep(0.05)
+    _tick(dp)
+    assert dp.clients[0].restarts >= 1
+    assert 0 in dp._down
+    assert dp.replica_resurrections == 0
+    # Health restored: the next probe verifies and counts exactly once.
+    dp.clients[0].fail_stats = False
+    deadline = time.monotonic() + 5.0
+    while 0 in dp._down and time.monotonic() < deadline:
+        time.sleep(0.02)
+        _tick(dp)
+    assert 0 not in dp._down
+    assert dp.replica_resurrections == 1
+
+
+# ---------------------------------------------------------------------------
+# Live pool re-split
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def disagg_fleet(monkeypatch):
+    monkeypatch.setenv("VDT_DISAGG", "1")
+    monkeypatch.setenv("VDT_DISAGG_PREFILL_REPLICAS", "2")
+    return make_fleet(monkeypatch, n=3,
+                      VDT_FLEET_MIN_REPLICAS="2",
+                      VDT_FLEET_HIGH_WATERMARK="100",
+                      VDT_FLEET_LOW_WATERMARK="0.1")
+
+
+def test_live_resplit_converts_prefill_to_decode(disagg_fleet):
+    dp = disagg_fleet
+    assert dp.disagg.prefill_pool == [0, 1]
+    assert dp.disagg.decode_pool == [2]
+    # In-flight prefill-stage work on one pool member.
+    dp.add_request(_req("c-0", max_tokens=6))
+    victim = dp._owner["c-0"]
+    assert victim in (0, 1)
+    other = 1 - victim
+    occ_before = dp.disagg.get_stats(dp.request_counts())
+    assert occ_before["pool_occupancy"]["prefill"] == 1
+    # Decode pool pressured: occupancy 20/8 >> prefill * ratio.
+    dp.clients[2].stats["num_waiting_reqs"] = 20
+    _tick(dp)
+    # The convert victim is the LEAST-LOADED donor — the prefill
+    # replica without the live request.
+    assert other in dp.fleet._draining
+    assert dp.fleet._draining[other]["mode"] == "convert"
+    _tick(dp)  # drain (no live work) completes -> rebuild as decode
+    assert dp.disagg.prefill_pool == [victim]
+    assert sorted(dp.disagg.decode_pool) == sorted([other, 2])
+    assert dp.disagg.resplits == 1
+    assert dp.fleet.get_stats()["resplits"] == 1
+    # Role-appropriate respawn: the new engine is a consumer.
+    rc = dp.clients[other].config
+    assert rc.kv_transfer_config.kv_role == "kv_consumer"
+    assert rc.kv_transfer_config.pool_role == "decode"
+    # The in-flight prefill-stage request survived on the old pool.
+    assert dp._owner["c-0"] == victim
+    assert dp.replica_failovers == 0
+    occ_after = dp.disagg.get_stats(dp.request_counts())
+    assert occ_after["pools"] == {"prefill": [victim],
+                                  "decode": sorted([other, 2])}
+    assert occ_after["pool_occupancy"]["prefill"] == 1
+
+
+def test_resplit_drains_in_flight_work_to_pool_peer(disagg_fleet):
+    """A convert victim still holding prefill-stage work past the
+    drain deadline journal-migrates it to the surviving prefill
+    member as a fresh stage copy — nothing dropped, no death rung."""
+    dp = disagg_fleet
+    dp.add_request(_req("c-0", max_tokens=6))
+    dp.add_request(_req("c-1", max_tokens=6))
+    assert {dp._owner["c-0"], dp._owner["c-1"]} == {0, 1}
+    dp.clients[2].stats["num_waiting_reqs"] = 20
+    _tick(dp)
+    # Equal donor load: ties convert the higher index.
+    assert 1 in dp.fleet._draining
+    moved = next(rid for rid in ("c-0", "c-1") if dp._owner[rid] == 1)
+    _tick(dp)  # past the (zero-second) deadline: migrate + rebuild
+    assert dp.disagg.prefill_pool == [0]
+    assert dp._owner[moved] == 0
+    copies = [r for r in dp.clients[0].added if r.request_id == moved]
+    # Re-admitted as a fresh one-token prefill-stage copy.
+    assert copies[-1].sampling_params.max_tokens == 1
+    assert dp.replica_failovers == 0
+    assert dp.disagg.fallbacks.get("prefill_death", 0) == 0
+
+
+def test_asymmetric_role_tp_freezes_resplit(disagg_fleet, monkeypatch):
+    dp = disagg_fleet
+    monkeypatch.setattr(dp.disagg, "symmetric_roles", lambda: False)
+    dp.clients[2].stats["num_waiting_reqs"] = 20
+    _tick(dp, 2)
+    assert dp.fleet._draining == {}
+    assert dp.disagg.resplits == 0
+    assert dp.fleet.freezes.get("asym_tp", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Stats aggregation + prometheus rendering + timeline events
+# ---------------------------------------------------------------------------
+def test_fleet_stats_aggregate_and_render(monkeypatch):
+    dp = make_fleet(monkeypatch, VDT_ROUTER="1")  # scale grows the router
+    _pressure(dp, 20)
+    _tick(dp, 2)  # scale out to 3, then freeze at_max
+    assert len(dp.clients) == 3
+    agg = dp._aggregate_stats([{}, {}, {}], indices=[0, 1, 2])
+    assert agg["fleet"]["replicas"] == 3
+    assert agg["fleet"]["scale_outs"] == 1
+    assert agg["fleet"]["freezes"].get("at_max", 0) >= 1
+    # The scale-out landed on the shared timeline.
+    assert any(e[2] == ev.FLEET_SCALE_OUT
+               for e in agg.get("timeline_events", []))
+    from vllm_distributed_tpu.metrics.prometheus import render_metrics
+    text = render_metrics(agg)
+    assert "vdt:fleet_replicas 3" in text
+    assert "vdt:fleet_scale_outs_total 1" in text
+    assert 'vdt:fleet_freezes_total{reason="at_max"}' in text
+
+
+# ---------------------------------------------------------------------------
+# Chaos soaks: 2 -> 3 -> 1 under a seeded fault sequence
+# ---------------------------------------------------------------------------
+class _Collector:
+    """Delivered-output ledger: the zero-loss / zero-duplication and
+    parity oracle. finished counts must end at exactly 1 per session."""
+
+    def __init__(self) -> None:
+        self.tokens: dict[str, list[int]] = {}
+        self.finishes: dict[str, int] = {}
+
+    def take(self, outs) -> None:
+        for out in outs or []:
+            self.tokens.setdefault(out.req_id, []).extend(
+                out.new_token_ids)
+            if out.finished:
+                self.finishes[out.req_id] = \
+                    self.finishes.get(out.req_id, 0) + 1
+
+    def assert_exact(self, rid: str, max_tokens: int) -> None:
+        assert self.finishes.get(rid) == 1, \
+            f"{rid}: finished {self.finishes.get(rid, 0)} times"
+        assert self.tokens[rid] == _expected(_session_of(rid),
+                                             max_tokens), rid
+
+
+def _pump(dp, collector) -> None:
+    for c in dp.clients:
+        if isinstance(c, _FleetStub):
+            c.serve()
+    collector.take(dp.recv_outputs(timeout_ms=10))
+    time.sleep(0.001)
+
+
+def _drive_until(dp, collector, done, timeout_s: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not done() and time.monotonic() < deadline:
+        _pump(dp, collector)
+    assert done(), "soak phase did not converge"
+
+
+def test_chaos_mini_soak_scale_schedule(monkeypatch):
+    """Tier-1 soak: the full 2 -> 3 -> 1 replica schedule with
+    continuous traffic and the fleet drills armed (scale_stall on the
+    way up, replica_wedge mid-flight), every session token-exact."""
+    dp = make_fleet(monkeypatch)
+    col = _Collector()
+    n_sessions, mt = 8, 6
+    for i in range(n_sessions):
+        dp.add_request(_req(f"s-{i}", max_tokens=mt))
+    # Surge: pressure 2 -> 3 through one provisioning stall.
+    _pressure(dp, 20)
+    fi.inject("fleet.scale_stall", max_fires=1)
+    fi.inject("fleet.replica_wedge", max_fires=1)
+    try:
+        _drive_until(dp, col, lambda: len(dp.clients) == 3)
+        _drive_until(dp, col, lambda: dp.fleet.wedge_cycles == 1,
+                     timeout_s=5.0)
+        _drive_until(dp, col, lambda: len(col.finishes) == n_sessions)
+    finally:
+        fi.clear("fleet.scale_stall")
+        fi.clear("fleet.replica_wedge")
+    assert dp.fleet.freezes.get("scale_stall") == 1
+    assert dp.fleet.scale_outs == 1
+    # One rung each: the wedge never counted as a failover.
+    assert dp.fleet.wedge_cycles == 1
+    assert dp.replica_failovers == 0
+    # Quiesce: the fleet walks down to the min-replica floor.
+    _pressure(dp, 0)
+    _drive_until(dp, col,
+                 lambda: dp.fleet.get_stats()["replicas"] == 1,
+                 timeout_s=10.0)
+    assert dp.fleet.scale_ins >= 2
+    # Zero lost, zero duplicated, token-exact — every session.
+    for i in range(n_sessions):
+        col.assert_exact(f"s-{i}", mt)
+
+
+@pytest.mark.slow
+def test_chaos_soak_seeded_faults(monkeypatch):
+    """Heaviest soak, two stages under seeded faults with continuous
+    traffic. Stage 1 (disaggregated 1P+1D fleet): ``engine_core.die``
+    and ``disagg.handoff_stall`` fire mid-stream while pressure scales
+    the fleet to 3. Stage 2 (plain DP fleet): the full 2 -> 3 -> 1
+    schedule with ``engine_core.die`` + ``fleet.replica_wedge``. Every
+    degradation lands on exactly one ladder rung and every session is
+    token-exact with zero lost/duplicated requests."""
+    # ---- Stage 1: disagg fleet, handoff_stall then die ----
+    monkeypatch.setenv("VDT_DISAGG", "1")
+    dp = make_fleet(monkeypatch, n=2, VDT_FLEET_MIN_REPLICAS="2")
+    assert dp.disagg.prefill_pool == [0]
+    assert dp.disagg.decode_pool == [1]
+    col = _Collector()
+    mt = 6
+    fi.inject("disagg.handoff_stall", max_fires=2)
+    try:
+        for i in range(6):
+            dp.add_request(_req(f"s-{i}", max_tokens=mt))
+        # Both stalled handoffs degrade to local re-prefill and the
+        # first wave completes before the deaths start.
+        _drive_until(dp, col, lambda: len(col.finishes) == 6,
+                     timeout_s=20.0)
+        assert dp.disagg.fallbacks.get("local_reprefill", 0) == 2
+        # Surge: scale to 3 (grows the pressured pool).
+        _pressure(dp, 20)
+        _drive_until(dp, col, lambda: len(dp.clients) == 3)
+        # Now seed the death: the consult rides the output poll
+        # exactly like the engine-core busy loop's.
+        fi.inject("engine_core.die", max_fires=1)
+        for c in dp.clients:
+            c.die_consult = True
+        for i in range(6, 12):
+            dp.add_request(_req(f"s-{i}", max_tokens=mt))
+        _drive_until(dp, col, lambda: dp.replica_failovers >= 1,
+                     timeout_s=5.0)
+        _drive_until(dp, col, lambda: len(col.finishes) == 12,
+                     timeout_s=20.0)
+    finally:
+        fi.clear("disagg.handoff_stall")
+        fi.clear("engine_core.die")
+    # One rung each: the stalled handoffs degraded to local re-prefill
+    # (not a death), the death counted one failover (not a wedge).
+    assert dp.disagg.fallbacks.get("local_reprefill", 0) == 2
+    assert dp.replica_failovers == 1
+    assert dp.fleet.wedge_cycles == 0
+    assert dp.fleet.scale_outs >= 1
+    for i in range(12):
+        col.assert_exact(f"s-{i}", mt)
+
+    # ---- Stage 2: plain DP fleet, 2 -> 3 -> 1 with die + wedge ----
+    monkeypatch.setenv("VDT_DISAGG", "0")
+    dp2 = make_fleet(monkeypatch, n=2)
+    col2 = _Collector()
+    for i in range(8):
+        dp2.add_request(_req(f"s-{i}", max_tokens=mt))
+    _pressure(dp2, 20)
+    fi.inject("fleet.replica_wedge", max_fires=1)
+    fi.inject("engine_core.die", max_fires=1)
+    try:
+        _drive_until(dp2, col2, lambda: len(dp2.clients) == 3)
+        for c in dp2.clients:
+            c.die_consult = True
+        _drive_until(dp2, col2,
+                     lambda: (dp2.fleet.wedge_cycles == 1
+                              and dp2.replica_failovers >= 1),
+                     timeout_s=10.0)
+        _drive_until(dp2, col2, lambda: len(col2.finishes) == 8,
+                     timeout_s=20.0)
+    finally:
+        fi.clear("fleet.replica_wedge")
+        fi.clear("engine_core.die")
+    assert dp2.fleet.wedge_cycles == 1
+    assert dp2.replica_failovers == 1
+    _pressure(dp2, 0)
+    _drive_until(dp2, col2,
+                 lambda: dp2.fleet.get_stats()["replicas"] == 1,
+                 timeout_s=10.0)
+    assert dp2.fleet.scale_ins >= 2
+    for i in range(8):
+        col2.assert_exact(f"s-{i}", mt)

@@ -134,6 +134,40 @@ def test_async_pull_lifecycle_and_parity(checkpoint):
     assert free_after > free_before
 
 
+@pytest.mark.faults
+def test_delayed_pull_keeps_token_parity(checkpoint):
+    """Armed ``kv_pull.delay`` stalls every pull worker at entry (the
+    slow-WAN drill): requests sit in WAITING_FOR_REMOTE_KVS longer but
+    the async-pull lifecycle must absorb the latency — same tokens as
+    the local baseline, no local-recompute fallback."""
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    baseline = [o.outputs[0].token_ids
+                for o in run(make_engine(checkpoint), PROMPTS, "base")]
+    producer = make_engine(checkpoint, role="kv_producer")
+    prod_outs = run(producer, PROMPTS, "prod", max_tokens=1)
+    params = [o.kv_transfer_params for o in prod_outs]
+
+    consumer = make_engine(checkpoint, role="kv_consumer")
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    before = fi.counters().get("kv_pull.delay", 0)
+    fi.inject("kv_pull.delay", delay_s=0.05)
+    try:
+        for i, (p, kvp) in enumerate(zip(PROMPTS, params)):
+            consumer.add_request(f"cons-{i}", p, sp,
+                                 kv_transfer_params=kvp)
+        outs = _pump_until(consumer, producer, "cons", len(PROMPTS))
+    finally:
+        fi.clear("kv_pull.delay")
+    got = [o.outputs[0].token_ids for o in outs]
+    assert got == baseline
+    # One delay per pull worker fired; the pulled spans still skipped
+    # local prefill (no degraded local-recompute path).
+    assert fi.counters().get("kv_pull.delay", 0) >= before + 2
+    assert [o.num_cached_tokens for o in outs] == [8, 12]
+    csched = scheduler(consumer)
+    assert not csched.waiting_for_remote_kv
+
+
 def test_other_requests_progress_while_pull_held(checkpoint):
     """The hold-until-loaded state must not stall the engine: a local
     request keeps decoding while another waits on a pull from a peer

@@ -116,6 +116,41 @@ def test_registry_register_expire_and_leave():
         srv.shutdown()
 
 
+@pytest.mark.faults
+def test_heartbeat_stall_fault_expires_then_recovers():
+    """Armed ``heartbeat.stall`` skips every beat: the registration
+    ages out of the registry while the client still lives (consumers
+    stop routing to it). Clearing the fault lets the next beat
+    re-register — the loop must survive the stall, not exit."""
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    srv = P2PRegistryServer()
+    a = P2PRegistryClient(srv.address, "inst-a", "producer", ttl=0.6)
+    before = fi.counters().get("heartbeat.stall", 0)
+    try:
+        fi.inject("heartbeat.stall")
+        a.register(("127.0.0.1", 1234), heartbeat=True)
+        b = P2PRegistryClient(srv.address, "inst-b", "consumer",
+                              ttl=30.0)
+        b.register(("0.0.0.0", 0), heartbeat=False)
+        assert "inst-a" in b.list()
+        # Every beat stalled -> the initial registration expires.
+        deadline = time.monotonic() + 10.0
+        while "inst-a" in b.list() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert "inst-a" not in b.list()
+        assert fi.counters().get("heartbeat.stall", 0) > before
+        # Stall ends: the surviving loop re-registers the instance.
+        fi.clear("heartbeat.stall")
+        deadline = time.monotonic() + 10.0
+        while "inst-a" not in b.list() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert b.resolve("inst-a") == ("127.0.0.1", 1234)
+    finally:
+        fi.clear("heartbeat.stall")
+        a.leave()
+        srv.shutdown()
+
+
 def test_decode_instance_joins_pulls_serves_leaves(checkpoint, registry):
     baseline_engine = LLMEngine(EngineArgs(
         model=checkpoint, dtype="float32", block_size=4,

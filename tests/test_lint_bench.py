@@ -38,6 +38,14 @@ GOOD_V3_TPU = {
     "tiering_off_hit_rate_window": 0.4, "tiering_parity": True,
 }
 
+GOOD_V4_TPU = {
+    **GOOD_V3_TPU, "schema_version": 4,
+    "fleet_on_reqs_per_s": 3.1, "fleet_off_reqs_per_s": 3.4,
+    "fleet_on_req_p99_ms": 410.0, "fleet_off_req_p99_ms": 350.0,
+    "fleet_scale_outs": 1, "fleet_scale_ins": 1,
+    "fleet_replica_timeline": [2, 1, 2], "fleet_parity": True,
+}
+
 
 def test_repo_records_are_clean():
     res = _run()
@@ -143,6 +151,46 @@ def test_v3_parity_false_fails(tmp_path):
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 1
     assert "token-invisible" in res.stderr
+
+
+def test_good_v4_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V4_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_v4_record_without_fleet_fields_fails(tmp_path):
+    rec = dict(GOOD_V4_TPU)
+    del rec["fleet_on_reqs_per_s"]
+    del rec["fleet_replica_timeline"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "fleet_on_reqs_per_s" in res.stderr
+    assert "fleet_replica_timeline" in res.stderr
+
+
+def test_v4_fleet_parity_false_fails(tmp_path):
+    # Elasticity is contractually token-invisible — a migration that
+    # changed a token is a correctness bug the scoreboard must flag.
+    _write(tmp_path, "BENCH_x.json",
+           dict(GOOD_V4_TPU, fleet_parity=False))
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "token-invisible" in res.stderr
+
+
+def test_v4_fleet_leg_error_is_accepted(tmp_path):
+    rec = {k: v for k, v in GOOD_V4_TPU.items()
+           if not k.startswith("fleet_")}
+    rec["fleet_leg_error"] = "RuntimeError: needs >= 2 devices"
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    rec["fleet_leg_error"] = ""
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
 
 
 def test_v3_leg_error_is_accepted(tmp_path):

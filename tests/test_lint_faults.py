@@ -1,0 +1,92 @@
+"""CI guard: every FAULT_POINTS name stays exercised by a test.
+
+Runs scripts/lint_faults.py over the real registry + tests/ tree (so a
+new fault point cannot land without a drill) and unit-tests the
+linter's failure modes on synthetic trees."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_faults.py"
+
+_REGISTRY = '''\
+FAULT_POINTS = (
+    "engine.die",  # comment survives the parse
+    "pull.delay",
+)
+'''
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _arm(name: str, tail: str = "") -> str:
+    """Synthetic ``fi.inject("<name>")`` line, assembled so THIS file
+    never contains a contiguous armed literal — the real-tree run in
+    test_package_fault_points_are_exercised scans tests/ including this
+    wrapper, and the fixture names must not read as typo'd drills."""
+    return "fi.inject(" + f'"{name}"{tail})\n'
+
+
+def _tree(tmp_path, registry: str, tests: dict[str, str]):
+    reg = tmp_path / "fault_injection.py"
+    reg.write_text(registry)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    for name, text in tests.items():
+        (tests_dir / name).write_text(text)
+    return reg, tests_dir
+
+
+def test_package_fault_points_are_exercised():
+    res = _run()
+    assert res.returncode == 0, (
+        f"fault-point drill coverage drifted:\n{res.stderr}")
+
+
+def test_unexercised_point_is_caught(tmp_path):
+    reg, tests = _tree(tmp_path, _REGISTRY, {
+        "test_a.py": _arm("engine.die", ", max_fires=1")})
+    res = _run("--registry", str(reg), "--tests", str(tests))
+    assert res.returncode == 1
+    assert "pull.delay" in res.stderr
+    assert "untested failure mode" in res.stderr
+
+
+def test_single_quoted_reference_counts(tmp_path):
+    reg, tests = _tree(tmp_path, _REGISTRY, {
+        "test_a.py": "fi.inject(" + "'engine.die')\n",
+        "test_b.py": "assert counters()['pull.delay'] == 1\n"})
+    res = _run("--registry", str(reg), "--tests", str(tests))
+    assert res.returncode == 0, res.stderr
+
+
+def test_typoed_drill_is_caught(tmp_path):
+    reg, tests = _tree(tmp_path, _REGISTRY, {
+        "test_a.py": (_arm("engine.die") + _arm("pull.delay")
+                      + _arm("engine.dye"))})
+    res = _run("--registry", str(reg), "--tests", str(tests))
+    assert res.returncode == 1
+    assert "engine.dye" in res.stderr
+    assert "typo'd drill" in res.stderr
+
+
+def test_dotted_strings_outside_injection_api_are_not_typos(tmp_path):
+    """Only names armed via the injection API count as drill
+    references for the typo check — a dotted module path in an import
+    or monkeypatch target must not trip it."""
+    reg, tests = _tree(tmp_path, _REGISTRY, {
+        "test_a.py": (_arm("engine.die") + _arm("pull.delay")
+                      + 'monkeypatch.setattr("pkg.module", None)\n')})
+    res = _run("--registry", str(reg), "--tests", str(tests))
+    assert res.returncode == 0, res.stderr
+
+
+def test_missing_registry_is_a_usage_error(tmp_path):
+    res = _run("--registry", str(tmp_path / "nope.py"),
+               "--tests", str(tmp_path))
+    assert res.returncode == 2

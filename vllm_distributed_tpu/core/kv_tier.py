@@ -192,6 +192,10 @@ class KVTierManager:
         default_factory=lambda: {"host": 0, "disk": 0})
     misses: dict = field(default_factory=lambda: {"host": 0, "disk": 0})
     demotes_dropped: int = 0
+    # Pages restored from a surviving (or shared) T2 namespace at
+    # construction — the elastic fleet's warm-start signal: a scaled-out
+    # or role-converted replica does not start cold (engine/fleet.py).
+    warm_start_pages: int = 0
     promotion_hist: Histogram = field(
         default_factory=lambda: Histogram(_PROMOTE_BUCKETS))
 
@@ -231,6 +235,7 @@ class KVTierManager:
         for _, key, size in entries:
             self._disk[key] = size
             self._disk_bytes += size
+        self.warm_start_pages = len(entries)
         if entries:
             logger.info("KV tier warm start: %d spill pages (%.1f MiB) "
                         "in %s", len(entries),
@@ -483,6 +488,7 @@ class KVTierManager:
             "promotions": dict(self.promotions),
             "misses": dict(self.misses),
             "demotes_dropped": self.demotes_dropped,
+            "warm_start_pages": self.warm_start_pages,
             "promotion_seconds": self.promotion_hist.to_dict(),
             "transitions": transitions,
         }

@@ -82,6 +82,20 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
                     if msg.get("clear"):
                         counts[engine] = 0
                     reply = {"ok": True}
+                elif op == "resize":
+                    # Elastic scale-out (engine/fleet.py): grow the
+                    # count table for appended engines. New slots start
+                    # healthy with zero admissions. Shrink is refused —
+                    # retirement keeps its slot and leaves via the
+                    # health op, so indices stay stable fleet-wide.
+                    n = int(msg["num_engines"])
+                    if n < num_engines:
+                        raise ValueError(
+                            f"cannot shrink {num_engines} -> {n}")
+                    counts.extend([0] * (n - num_engines))
+                    healthy.extend([True] * (n - num_engines))
+                    num_engines = n
+                    reply = {"ok": True}
                 elif op == "counts":
                     reply = {"counts": list(counts),
                              "engines_running": [c > 0 for c in counts],
@@ -153,6 +167,11 @@ class DPCoordinatorClient:
         ``clear`` zeroes its admission count (failover migrates the
         load, re-reporting it against the replicas that absorb it)."""
         self._call(op="health", engine=engine, up=up, clear=clear)
+
+    def resize(self, num_engines: int) -> None:
+        """Grow the coordinator's engine table (elastic scale-out).
+        New slots start healthy with zero admissions."""
+        self._call(op="resize", num_engines=num_engines)
 
     def healthy(self) -> list[bool]:
         return list(self._call(op="counts")["healthy"])

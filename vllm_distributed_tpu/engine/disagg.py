@@ -168,6 +168,14 @@ class DisaggCoordinator:
         self.prefill_pool, self.decode_pool = plan_pools(n)
         self._prefill_set = set(self.prefill_pool)
         self._decode_set = set(self.decode_pool)
+        # Per-slot device offsets + per-role world sizes: the live
+        # re-planning surface (fleet scale-out / role conversion) needs
+        # to know which device slice a slot owns and whether the two
+        # roles are device-footprint-compatible.
+        self._sizes = self.role_world_sizes(config)
+        self._device_offsets = [off for _, off
+                                in self.plan_replicas(config)]
+        self.resplits = 0
         # rid -> pool stage: PREFILL_POOL while the prefill-stage copy
         # is in flight, DECODE_POOL from handoff admission to finish.
         self._stage: dict[str, str] = {}
@@ -194,18 +202,11 @@ class DisaggCoordinator:
     # Pool planning helpers (used at replica construction)
     # ------------------------------------------------------------------
     @staticmethod
-    def plan_replicas(config: EngineConfig) -> list[tuple[str, int]]:
-        """(role, device_offset) per DP rank. Offsets are cumulative
-        because pools may run asymmetric TP degrees (different replica
-        world sizes), where rank * world_size stops addressing the
-        right device slice."""
+    def role_world_sizes(config: EngineConfig) -> dict:
+        """One replica world size per ROLE (world_size is a derived
+        property, so evaluate it on a scratch copy with the pool's TP
+        degree applied rather than re-deriving its formula here)."""
         from vllm_distributed_tpu import envs
-        n = config.parallel_config.data_parallel_size
-        prefill, _decode = plan_pools(n)
-        prefill_set = set(prefill)
-        # One replica world size per ROLE (world_size is a derived
-        # property, so evaluate it on a scratch copy with the pool's TP
-        # degree applied rather than re-deriving its formula here).
         sizes: dict[str, int] = {}
         for role, tp in ((PREFILL_POOL, envs.VDT_DISAGG_PREFILL_TP),
                          (DECODE_POOL, envs.VDT_DISAGG_DECODE_TP)):
@@ -214,6 +215,18 @@ class DisaggCoordinator:
             if tp:
                 per.tensor_parallel_size = tp
             sizes[role] = per.world_size
+        return sizes
+
+    @staticmethod
+    def plan_replicas(config: EngineConfig) -> list[tuple[str, int]]:
+        """(role, device_offset) per DP rank. Offsets are cumulative
+        because pools may run asymmetric TP degrees (different replica
+        world sizes), where rank * world_size stops addressing the
+        right device slice."""
+        n = config.parallel_config.data_parallel_size
+        prefill, _decode = plan_pools(n)
+        prefill_set = set(prefill)
+        sizes = DisaggCoordinator.role_world_sizes(config)
         out: list[tuple[str, int]] = []
         offset = 0
         for rank in range(n):
@@ -225,6 +238,82 @@ class DisaggCoordinator:
     def role_of(self, replica: int) -> str:
         return (PREFILL_POOL if replica in self._prefill_set
                 else DECODE_POOL)
+
+    # ------------------------------------------------------------------
+    # Live pool re-planning (engine/fleet.py; balancer lock held)
+    # ------------------------------------------------------------------
+    def symmetric_roles(self) -> bool:
+        """True when both pools run the same replica world size, so a
+        replica's device slice stays valid across a role conversion
+        (an asymmetric fleet would need a different device footprint —
+        the fleet controller skips conversions there)."""
+        return self._sizes[PREFILL_POOL] == self._sizes[DECODE_POOL]
+
+    def device_offset_of(self, replica: int) -> Optional[int]:
+        """The device offset the replica was constructed with (slot
+        reuse and role conversions keep it — same devices, new role)."""
+        if replica < len(self._device_offsets):
+            return self._device_offsets[replica]
+        return None
+
+    def next_device_offset(self) -> int:
+        """Device offset for an APPENDED replica: past every existing
+        slot's slice (retired slots keep their reservation — their
+        devices come back via slot reuse, not re-planning)."""
+        ends = [self._device_offsets[i] + self._sizes[self.role_of(i)]
+                for i in range(len(self._device_offsets))]
+        return max(ends, default=0)
+
+    def set_role(self, replica: int, role: str) -> None:
+        """Move a (drained) replica between pools — the live re-split.
+        The caller has already rebuilt the replica's engine with the
+        role-specialized config; this just re-plans membership."""
+        if self.role_of(replica) == role:
+            return
+        self._prefill_set.discard(replica)
+        self._decode_set.discard(replica)
+        for pool in (self.prefill_pool, self.decode_pool):
+            if replica in pool:
+                pool.remove(replica)
+        (self._prefill_set if role == PREFILL_POOL
+         else self._decode_set).add(replica)
+        target = (self.prefill_pool if role == PREFILL_POOL
+                  else self.decode_pool)
+        target.append(replica)
+        target.sort()
+        self.resplits += 1
+        logger.info("disagg re-split: replica %d -> %s pool "
+                    "(prefill %s, decode %s)", replica, role,
+                    self.prefill_pool, self.decode_pool)
+
+    def add_replica(self, replica: int, role: str,
+                    device_offset: Optional[int] = None) -> None:
+        """Enter a new (or slot-reused) replica into a pool."""
+        if replica >= len(self._device_offsets):
+            self._device_offsets.extend(
+                [0] * (replica + 1 - len(self._device_offsets)))
+        if device_offset is not None:
+            self._device_offsets[replica] = device_offset
+        self._prefill_set.discard(replica)
+        self._decode_set.discard(replica)
+        for pool in (self.prefill_pool, self.decode_pool):
+            if replica in pool:
+                pool.remove(replica)
+        (self._prefill_set if role == PREFILL_POOL
+         else self._decode_set).add(replica)
+        target = (self.prefill_pool if role == PREFILL_POOL
+                  else self.decode_pool)
+        target.append(replica)
+        target.sort()
+
+    def remove_replica(self, replica: int) -> None:
+        """Retire a replica from its pool (its slot index stays
+        reserved fleet-wide; only pool membership changes)."""
+        self._prefill_set.discard(replica)
+        self._decode_set.discard(replica)
+        for pool in (self.prefill_pool, self.decode_pool):
+            if replica in pool:
+                pool.remove(replica)
 
     # ------------------------------------------------------------------
     # Admission

@@ -64,6 +64,7 @@ class DPEngineClient(EngineCoreClient):
     def __init__(self, config: EngineConfig, *,
                  force_mp: Optional[bool] = None) -> None:
         from vllm_distributed_tpu import envs
+        self.config = config
         n = config.parallel_config.data_parallel_size
         assert n > 1, "DPEngineClient requires data_parallel_size > 1"
         if force_mp is None:
@@ -158,12 +159,29 @@ class DPEngineClient(EngineCoreClient):
             queue.Queue()
         self.replica_failovers = 0
         self.replica_resurrections = 0
+        # Elastic-fleet state (engine/fleet.py). Retired slots keep
+        # their index (stable fleet-wide addressing) but leave rotation
+        # permanently unless scale-out reuses them; _no_place holds
+        # DRAINING replicas — excluded from placement, still polled.
+        # Both stay empty with the fleet off, so every membership check
+        # below reduces to the pre-fleet behavior.
+        self._retired: set[int] = set()
+        self._no_place: set[int] = set()
+        self.fleet = None
+        if envs.VDT_FLEET:
+            from vllm_distributed_tpu.engine.fleet import FleetController
+            self.fleet = FleetController(self, config)
 
     # ------------------------------------------------------------------
     def _pick_replica(self, request: Optional[EngineCoreRequest] = None,
                       count_fallbacks: bool = True) -> int:
         if len(self._down) == len(self.clients):
             raise EngineDeadError("all DP replicas are dead")
+        # Draining replicas (fleet retire/convert) leave PLACEMENT but
+        # keep serving their live requests; the union is only built
+        # when the fleet actually has a drain in flight.
+        blocked = (self._down | self._no_place if self._no_place
+                   else self._down)
         pool, least_loaded = None, False
         if self.disagg is not None and request is not None:
             # Two-stage disagg placement: fresh requests go to the
@@ -172,7 +190,7 @@ class DPEngineClient(EngineCoreClient):
             # degrades to any-alive placement (counted once per
             # admission — retries after a failover don't re-count).
             pool = self.disagg.usable_pool(
-                self.disagg.target_pool(request), self._down,
+                self.disagg.target_pool(request), blocked,
                 count=count_fallbacks)
             least_loaded = (pool is not None and
                             self.disagg.prefill_least_loaded(request))
@@ -180,7 +198,7 @@ class DPEngineClient(EngineCoreClient):
         if self.router is not None:
             self.router.maybe_refresh(self.clients, self._down)
             prefer = self.router.route(request, self.request_counts(),
-                                       self._down, pool=pool,
+                                       blocked, pool=pool,
                                        least_loaded=least_loaded)
         if self.coordinator is not None:
             if pool is None:
@@ -211,8 +229,8 @@ class DPEngineClient(EngineCoreClient):
         best, best_load = None, None
         for off in range(n):
             i = (self._rr + off) % n
-            if i in self._down or (members is not None
-                                   and i not in members):
+            if i in self._down or i in self._no_place or (
+                    members is not None and i not in members):
                 continue
             load = len(self._live[i])
             if best_load is None or load < best_load:
@@ -454,12 +472,135 @@ class DPEngineClient(EngineCoreClient):
             return
         self._probe_results.put((i, True))
 
+    def _probe_restart_verified(self, i: int) -> None:
+        """Fleet-managed resurrection probe (engine/fleet.py): restart
+        PLUS a health verification — a replica that reconnects but
+        cannot answer a basic stats probe (its warm start failed)
+        reports still-down, so ``replica_resurrections`` only counts
+        replicas that actually came back."""
+        try:
+            self.clients[i].restart()
+            self.clients[i].get_stats()
+        except Exception as e:  # noqa: BLE001 - still dead (or alive
+            # but not serving — same thing to the rotation).
+            logger.warning("DP replica %d resurrection failed: %s", i, e)
+            self._probe_results.put((i, False))
+            return
+        self._probe_results.put((i, True))
+
+    def _tick(self) -> None:
+        """Periodic maintenance hook on the output paths: the fleet
+        controller's loop when VDT_FLEET=1 (which subsumes the
+        resurrection probe), the legacy probe otherwise."""
+        if self.fleet is not None:
+            self.fleet.tick()
+        else:
+            self._maybe_resurrect()
+
+    # ------------------------------------------------------------------
+    # Elastic-fleet primitives (engine/fleet.py; balancer lock held)
+    # ------------------------------------------------------------------
+    def _drain_migrate_locked(self, i: int, report: bool = True) -> None:
+        """Journal-migrate replica ``i``'s unfinished requests to the
+        rest of the fleet as continuations (token-identical under
+        greedy). This is PLANNED movement — a fleet drain deadline or a
+        wedge cycle — so unlike _failover_locked nothing here counts as
+        a failover or a disagg death fallback. ``report=False`` skips
+        the coordinator's negative delta (the wedge path already
+        cleared the replica's count wholesale)."""
+        stranded = [rid for rid, owner in self._owner.items()
+                    if owner == i]
+        if not stranded:
+            return
+        for rid in stranded:
+            self._owner.pop(rid, None)
+            self._live[i].discard(rid)
+        try:
+            self.clients[i].abort_requests(stranded)
+        except Exception:  # noqa: BLE001 - replica unresponsive; its
+            # engine restarts (wedge) or shuts down (retire) anyway.
+            pass
+        if report and self.coordinator is not None:
+            self.coordinator.report(i, -len(stranded))
+        logger.info("fleet drain: migrating %d request(s) off "
+                    "replica %d", len(stranded), i)
+        for rid in stranded:
+            orig = self._requests.get(rid)
+            if orig is None:
+                continue
+            req = None
+            if self.disagg is not None:
+                from vllm_distributed_tpu.engine.disagg import (
+                    PREFILL_POOL, prefill_stage_request)
+                if self.disagg._stage.get(rid) == PREFILL_POOL:
+                    # Prefill-stage work re-enters as a fresh one-token
+                    # copy (nothing was delivered yet).
+                    req = prefill_stage_request(orig)
+            if req is None:
+                req = continuation_request(orig,
+                                           self._progress.get(rid, []))
+            self._admit(req)
+
+    def _spawn_replica(self, i: int,
+                       role: Optional[str]) -> EngineCoreClient:
+        """Build the engine client for slot ``i`` (fleet scale-out or a
+        role conversion), specialized for its disagg role when the
+        fleet is disaggregated. Blocking — the fleet controller budgets
+        and rate-limits the call."""
+        rc = make_replica_config(self.config, i)
+        if self.disagg is not None and role is not None:
+            from vllm_distributed_tpu.engine.disagg import \
+                specialize_replica_config
+            offset = self.disagg.device_offset_of(i)
+            if offset is None:
+                offset = self.disagg.next_device_offset()
+            specialize_replica_config(rc, role, offset)
+        return SyncMPClient(rc) if self.is_mp else InprocClient(rc)
+
+    def _enter_replica(self, i: int, client: EngineCoreClient,
+                       role: Optional[str]) -> None:
+        """Wire a freshly spawned replica into rotation at slot ``i``
+        — either reusing a retired slot or appending a new rank (which
+        grows the router, the coordinator's count table, and the
+        per-replica balancer state)."""
+        if i == len(self.clients):
+            self.clients.append(client)
+            self._live.append(set())
+            self._supervisors.append(
+                RestartSupervisor.from_config(self.config))
+            if self.router is not None:
+                self.router.grow(1)
+            if self.coordinator is not None:
+                self.coordinator.resize(len(self.clients))
+            if self.disagg is not None:
+                self.disagg.add_replica(
+                    i, role,
+                    device_offset=self.disagg.next_device_offset())
+        else:
+            self.clients[i] = client
+            self._retired.discard(i)
+            self._down.discard(i)
+            # A reused slot is a NEW engine: fresh restart budget,
+            # clean router state (on_replica_down also covers the
+            # stale-residency case of a long-retired slot).
+            self._supervisors[i] = \
+                RestartSupervisor.from_config(self.config)
+            if self.router is not None:
+                self.router.on_replica_down(i)
+            if self.coordinator is not None:
+                self.coordinator.set_health(i, True, clear=True)
+            if self.disagg is not None and role is not None:
+                self.disagg.add_replica(i, role)
+
     def restart(self) -> None:
         """Full-fleet restart (AsyncLLM's supervisor calls this once
         every replica is dead): every replica respawns and all balancer
         state clears — the upstream journal replays the load."""
         with self._lock:
             for i, client in enumerate(self.clients):
+                if i in self._retired:
+                    continue  # fleet-retired: already shut down, its
+                    # slot only rejoins via a scale-out reuse.
                 client.restart()
                 if self.coordinator is not None:
                     self.coordinator.set_health(i, True, clear=True)
@@ -467,6 +608,8 @@ class DPEngineClient(EngineCoreClient):
             self._requests.clear()
             self._progress.clear()
             self._down.clear()
+            self._down.update(self._retired)
+            self._no_place.clear()
             self._next_probe.clear()
             for live in self._live:
                 live.clear()
@@ -474,6 +617,8 @@ class DPEngineClient(EngineCoreClient):
                 self.router.reset()
             if self.disagg is not None:
                 self.disagg.reset()
+            if self.fleet is not None:
+                self.fleet.reset()
 
     # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
@@ -482,7 +627,7 @@ class DPEngineClient(EngineCoreClient):
         In-process replicas are stepped inline (each busy replica once);
         subprocess replicas are polled, blocking until at least one batch
         arrives while any request is live."""
-        self._maybe_resurrect()
+        self._tick()
         self._check_any_alive()
         outs: list[EngineCoreOutput] = []
         if not self.is_mp:
@@ -518,7 +663,7 @@ class DPEngineClient(EngineCoreClient):
                 # All live work sits on downed replicas (probe in
                 # flight): pace the loop instead of spinning.
                 time.sleep(0.02)
-                self._maybe_resurrect()
+                self._tick()
                 self._check_any_alive()
         return self._mark_finished(outs)
 
@@ -527,7 +672,7 @@ class DPEngineClient(EngineCoreClient):
         """Pump-thread receive (AsyncLLM): poll every replica once within
         the timeout budget; None when nothing arrived."""
         assert self.is_mp, "recv_outputs requires subprocess replicas"
-        self._maybe_resurrect()
+        self._tick()
         self._check_any_alive()
         per = max(timeout_ms // len(self.clients), 1)
         outs: list[EngineCoreOutput] = []
@@ -631,6 +776,7 @@ class DPEngineClient(EngineCoreClient):
         # getattr: stats-aggregation tests build this client via
         # __new__ with only the balancer fields they exercise.
         router = getattr(self, "router", None)
+        fleet = getattr(self, "fleet", None)
         if router is not None and indices is not None:
             # Passive routing-signal feed: every stats poll that already
             # flows through here (the /metrics scrape, the admission
@@ -638,6 +784,12 @@ class DPEngineClient(EngineCoreClient):
             # snapshots — the "existing get_stats RPC" channel.
             for i, stats in zip(indices, per):
                 router.observe_stats(i, stats)
+        if fleet is not None and indices is not None:
+            # Same passive channel feeds the fleet controller's
+            # occupancy/step-heartbeat signals (subprocess replicas are
+            # never polled by the control loop itself).
+            for i, stats in zip(indices, per):
+                fleet.observe_stats(i, stats)
         agg: dict = {"dp_size": len(self.clients),
                      "dp_request_counts": self.request_counts(),
                      "dp_replicas": per,
@@ -808,7 +960,8 @@ class DPEngineClient(EngineCoreClient):
         # Lifecycle timelines: one fleet-wide event stream, time-sorted.
         from vllm_distributed_tpu.metrics.events import merge_event_lists
         events = merge_event_lists(
-            *(s.get("timeline_events") or [] for s in per))
+            *(s.get("timeline_events") or [] for s in per),
+            *([fleet.drain_events()] if fleet is not None else []))
         if events:
             agg["timeline_events"] = events
         # Routing tier: ONE router instance owns the whole fleet's
@@ -820,6 +973,10 @@ class DPEngineClient(EngineCoreClient):
         disagg = getattr(self, "disagg", None)
         if disagg is not None:
             agg["disagg"] = disagg.get_stats(self.request_counts())
+        # Elastic-fleet controller: one loop owns the whole fleet's
+        # shape, so its counters attach exactly too.
+        if fleet is not None:
+            agg["fleet"] = fleet.get_stats()
         return agg
 
     def get_stats(self) -> dict:

@@ -279,6 +279,19 @@ class ReplicaRouter:
         for i in range(self.n):
             self.on_replica_down(i)
 
+    def grow(self, count: int = 1) -> None:
+        """Elastic scale-out (engine/fleet.py): extend every per-replica
+        array with empty state for ``count`` appended replicas. The new
+        slots start cold (no residency, stats at -inf), exactly like a
+        replica that just came back from on_replica_down."""
+        for _ in range(count):
+            self._residency.append(OrderedDict())
+            self._stats.append({})
+            self._stats_at.append(float("-inf"))
+            self._wait_prev.append((0.0, 0))
+            self._wait_interval_s.append(0.0)
+        self.n += count
+
     def _affinity(self, replica: int, hashes: list[bytes]) -> float:
         """Tier-weighted matched leading pages / hashed pages, honoring
         the entry TTL (expired entries are pruned as they are seen). A

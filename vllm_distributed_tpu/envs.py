@@ -267,6 +267,69 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # new page owner overwrites the content this very step.
     "VDT_KV_TIER_DEMOTE_PAGES":
     lambda: max(1, int(os.getenv("VDT_KV_TIER_DEMOTE_PAGES", "64"))),
+    # --- Elastic fleet controller (engine/fleet.py) ---------------------
+    # Master switch: "1" hosts a FleetController next to the DP balancer
+    # — closed-loop scale-out/in over the replica set, live prefill <->
+    # decode pool re-splits, wedge detection, and the folded resurrection
+    # probe (one actuator, one budget). "0" (default) constructs no
+    # controller: no extra thread, no new RPCs, and the legacy periodic
+    # resurrection probe runs byte-identical to the pre-fleet behavior.
+    "VDT_FLEET":
+    lambda: os.getenv("VDT_FLEET", "0") == "1",
+    # Fleet-size floor/ceiling for scale decisions. MIN bounds scale-in
+    # (never retire below it). MAX bounds scale-out; 0 = auto: the boot
+    # data_parallel_size (scale-out then only refills retired slots —
+    # growing past boot needs devices the operator must provision).
+    "VDT_FLEET_MIN_REPLICAS":
+    lambda: max(1, int(os.getenv("VDT_FLEET_MIN_REPLICAS", "1"))),
+    "VDT_FLEET_MAX_REPLICAS":
+    lambda: max(0, int(os.getenv("VDT_FLEET_MAX_REPLICAS", "0"))),
+    # Seconds between control-loop evaluations (ticks ride the output
+    # path next to the resurrection probe; no dedicated thread).
+    "VDT_FLEET_TICK_S":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_TICK_S", "1.0"))),
+    # Occupancy watermarks (fleet-wide live slots / (active replicas *
+    # max_num_seqs)): sustained occupancy above HIGH scales out, below
+    # LOW scales in. HIGH/LOW must straddle to hysterese.
+    "VDT_FLEET_HIGH_WATERMARK":
+    lambda: float(os.getenv("VDT_FLEET_HIGH_WATERMARK", "0.85")),
+    "VDT_FLEET_LOW_WATERMARK":
+    lambda: float(os.getenv("VDT_FLEET_LOW_WATERMARK", "0.25")),
+    # Consecutive ticks a watermark (or pool-imbalance) signal must hold
+    # before the controller actuates — the hysteresis half of the
+    # anti-thrash story (the action budget is the other half).
+    "VDT_FLEET_EVAL_TICKS":
+    lambda: max(1, int(os.getenv("VDT_FLEET_EVAL_TICKS", "3"))),
+    # Per-replica stats snapshots older than this freeze all actuation
+    # (counted in vdt:fleet_freezes_total{reason="stale_stats"}) — the
+    # router stale_stats idiom: never reshape the fleet on blind signals.
+    "VDT_FLEET_STALE_S":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_STALE_S", "10"))),
+    # A replica with live requests whose steps_dispatched counter has
+    # not advanced for this long is WEDGED (alive-but-not-stepping): its
+    # journaled requests migrate off and it is force-cycled through the
+    # PR-2 restart budget. 0 disables wedge detection.
+    "VDT_FLEET_WEDGE_S":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_WEDGE_S", "30"))),
+    # Drain deadline for a retiring/converting replica: past it, still-
+    # unfinished requests journal-migrate as continuations (token-
+    # identical under greedy) and the drain completes anyway.
+    "VDT_FLEET_DRAIN_S":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_DRAIN_S", "30"))),
+    # Supervisor-style action budget: at most ACTIONS fleet actions
+    # (scale-out/in, re-split, wedge cycle) per rolling WINDOW seconds;
+    # past it actuation freezes (reason="budget") until the window
+    # slides — an oscillating signal cannot thrash the fleet.
+    "VDT_FLEET_ACTIONS":
+    lambda: max(1, int(os.getenv("VDT_FLEET_ACTIONS", "6"))),
+    "VDT_FLEET_ACTION_WINDOW_S":
+    lambda: max(1.0, float(os.getenv("VDT_FLEET_ACTION_WINDOW_S",
+                                     "300"))),
+    # Live pool re-split trigger (VDT_DISAGG fleets): convert one
+    # replica toward the pressured pool when its per-replica occupancy
+    # exceeds the other pool's by this factor. 0 disables re-splits.
+    "VDT_FLEET_RESPLIT_RATIO":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_RESPLIT_RATIO", "3"))),
     # --- SSM state cache (core/state_cache.py) --------------------------
     # First-class state checkpoint/restore for stateful (Mamba/Jamba)
     # models: prefix-style admission at snapshot boundaries, preemption

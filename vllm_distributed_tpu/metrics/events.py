@@ -50,6 +50,12 @@ JOURNAL_REPLAY = "journal_replay"  # replayed as a continuation prefill
 SHED = "shed"  # refused at the admission gate (rid="")
 FINISHED = "finished"
 ABORTED = "aborted"
+# Elastic-fleet control-loop actions (engine/fleet.py; all rid="").
+FLEET_SCALE_OUT = "fleet_scale_out"  # replica entered rotation
+FLEET_SCALE_IN = "fleet_scale_in"  # replica drained and retired
+FLEET_RESPLIT = "fleet_resplit"  # replica converted between pools
+FLEET_WEDGE_CYCLE = "fleet_wedge_cycle"  # stuck replica force-cycled
+FLEET_FREEZE = "fleet_freeze"  # actuation skipped (stale/budget/...)
 
 
 def timeline_enabled() -> bool:

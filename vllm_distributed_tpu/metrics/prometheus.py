@@ -204,6 +204,10 @@ LABELED_METRICS = {
     "vdt:pool_occupancy": ("pool", ),
     # Weighted admission shedding (entrypoints/openai/admission.py).
     "vdt:requests_shed_by_class_total": ("class", ),
+    # Elastic-fleet control loop (engine/fleet.py; VDT_FLEET=1):
+    # ticks/actions skipped, by freeze reason (stale_stats | budget |
+    # scale_stall | at_max | asym_tp).
+    "vdt:fleet_freezes_total": ("reason", ),
     # Per-tenant QoS (core/sched/qos.py; VDT_QOS=1). Label cardinality
     # is bounded: tenants past VDT_QOS_MAX_TRACKED_TENANTS hash into 8
     # shared "~<n>" overflow buckets, tenantless traffic shares
@@ -305,6 +309,46 @@ def _render_disagg(disagg: dict) -> list[str]:
                   f"# TYPE {name} gauge"]
         lines += [f'{name}{{pool="{p}"}} {int(n)}'
                   for p, n in sorted(occ.items())]
+    return lines
+
+
+def _render_fleet(fleet: dict) -> list[str]:
+    """Elastic-fleet control-loop families (engine/fleet.py; present
+    only while VDT_FLEET=1 on a DP deployment)."""
+    lines: list[str] = []
+    for key, name, kind, help_text in (
+        ("replicas", "vdt:fleet_replicas", "gauge",
+         "DP replicas currently in rotation (not down, not retired)"),
+        ("draining", "vdt:fleet_draining", "gauge",
+         "Replicas draining toward retirement or a pool conversion"),
+        ("scale_outs", "vdt:fleet_scale_outs_total", "counter",
+         "Replicas added to rotation by the fleet controller"),
+        ("scale_ins", "vdt:fleet_scale_ins_total", "counter",
+         "Replicas drained and retired under the low watermark"),
+        ("resplits", "vdt:fleet_resplits_total", "counter",
+         "Live prefill<->decode pool conversions completed"),
+        ("wedge_cycles", "vdt:fleet_wedge_cycles_total", "counter",
+         "Alive-but-not-stepping replicas force-cycled (work migrated "
+         "via the continuation journal, then restart-probed)"),
+        ("warm_start_pages", "vdt:fleet_warm_start_pages_total",
+         "counter",
+         "Spill-tier pages found by new/converted replicas warm-"
+         "starting from the shared tier-2 namespace"),
+    ):
+        if key in fleet:
+            lines += [f"# HELP {name} {help_text}",
+                      f"# TYPE {name} {kind}",
+                      f"{name} {int(fleet.get(key, 0))}"]
+    freezes = fleet.get("freezes") or {}
+    name = "vdt:fleet_freezes_total"
+    lines += [f"# HELP {name} Fleet actuation skipped, by reason "
+              "(stale_stats = a rotation member's stats went quiet, "
+              "budget = action budget exhausted, scale_stall = replica "
+              "spawn failed, at_max = device budget reached, asym_tp = "
+              "pools differ in per-replica world size)",
+              f"# TYPE {name} counter"]
+    lines += [f'{name}{{reason="{r}"}} {int(n)}'
+              for r, n in sorted(freezes.items())]
     return lines
 
 
@@ -706,4 +750,7 @@ def render_metrics(stats: dict) -> str:
     disagg = stats.get("disagg")
     if isinstance(disagg, dict):
         lines += _render_disagg(disagg)
+    fleet = stats.get("fleet")
+    if isinstance(fleet, dict) and fleet:
+        lines += _render_fleet(fleet)
     return "\n".join(lines) + "\n"

@@ -88,6 +88,21 @@ but never fired by production code):
   force-stops the trace. The drill proves a profiler client that dies
   (or a tunnel that drops) mid-capture can never wedge serving, with
   the fire counted in ``vdt:fault_injections_total``.
+* ``kv_tier.spill_corrupt`` — a tier-2 spill page file is corrupted
+  after its CRC is computed, so promotion detects the mismatch and
+  degrades to recompute (core/kv_tier.py).
+* ``fleet.scale_stall`` — an elastic-fleet scale-out (engine/fleet.py)
+  stalls at replica construction: the new replica never comes up, the
+  action is counted against the fleet's supervisor budget, and the
+  drill proves hysteresis + the budget stop a wedged provisioner from
+  thrashing the fleet (counted in
+  ``vdt:fleet_freezes_total{reason="scale_stall"}``).
+* ``fleet.replica_wedge`` — the fleet's wedge detector treats a live
+  replica as alive-but-not-stepping (step-phase heartbeat age beyond
+  VDT_FLEET_WEDGE_S): its journaled requests migrate off and the
+  replica is force-cycled through the PR-2 restart budget, counted on
+  exactly the ``vdt:fleet_wedge_cycles_total`` rung (NOT as a
+  failover — the replica never died).
 """
 
 import threading
@@ -116,6 +131,8 @@ FAULT_POINTS = (
     "sched.quota_thrash",
     "perf.capture_stall",
     "kv_tier.spill_corrupt",
+    "fleet.scale_stall",
+    "fleet.replica_wedge",
 )
 
 
