@@ -1917,6 +1917,181 @@ def _fleet_leg(config, record) -> None:
                 os.environ[k] = v
 
 
+def _ha_leg(config, record) -> None:
+    """HA control-plane acceptance leg (ISSUE 17): the fleet leg's
+    diurnal trace on a 2-replica DP fleet with the lease-fenced shared
+    controller ON (``VDT_FLEET_CONTROLLER=1``) and a second front-end
+    controller standing by on the same coordinator socket and journal
+    namespace. The leader is killed mid-scale-in
+    (``fleet.controller_die`` fires between the drain's journaled
+    intent and its completion); the standby acquires the lease within
+    the TTL, replays the journal, finishes the retire, and runs the
+    second peak's scale-out as the new leader. Records the leader
+    transition count, merged fenced-action counters, the observed
+    failover gap, journal replays, the replica timeline, and greedy
+    token parity vs a static ``VDT_FLEET=0`` baseline on
+    byte-identical traffic — leader failover is contractually
+    token-invisible."""
+    import gc
+    import shutil
+    import tempfile
+
+    import jax
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    if len(jax.devices()) < 2:
+        record["ha_leg_error"] = (
+            "needs >= 2 devices for a 2-replica DP fleet")
+        return
+    phases = (("peak1", 8), ("trough", 2), ("peak2", 8))
+    sp = SamplingParams(temperature=0.0, max_tokens=16,
+                        ignore_eos=True)
+    rng = np.random.default_rng(17)
+    prompts = {(ph, s): [int(x) for x in rng.integers(10, 5000,
+                                                      size=64)]
+               for ph, n in phases for s in range(n)}
+    keys = ("VDT_FLEET", "VDT_FLEET_CONTROLLER",
+            "VDT_FLEET_LEASE_TTL_S", "VDT_FLEET_JOURNAL_DIR",
+            "VDT_FLEET_TICK_S", "VDT_FLEET_EVAL_TICKS",
+            "VDT_FLEET_STALE_S", "VDT_FLEET_DRAIN_S",
+            "VDT_FLEET_MIN_REPLICAS", "VDT_FLEET_MAX_REPLICAS",
+            "VDT_FLEET_HIGH_WATERMARK", "VDT_FLEET_LOW_WATERMARK",
+            "VDT_FLEET_ACTIONS")
+    saved = {k: os.environ.get(k) for k in keys}
+    journal_dir = tempfile.mkdtemp(prefix="vdt-bench-ha-journal-")
+    outputs: dict = {}
+    try:
+        for leg in ("on", "off"):
+            os.environ.update({
+                "VDT_FLEET": "1" if leg == "on" else "0",
+                "VDT_FLEET_CONTROLLER": "1" if leg == "on" else "0",
+                "VDT_FLEET_LEASE_TTL_S": "0.3",
+                "VDT_FLEET_JOURNAL_DIR": journal_dir,
+                "VDT_FLEET_TICK_S": "0",
+                "VDT_FLEET_EVAL_TICKS": "3",
+                "VDT_FLEET_STALE_S": "0",
+                "VDT_FLEET_DRAIN_S": "0",
+                "VDT_FLEET_MIN_REPLICAS": "1",
+                "VDT_FLEET_MAX_REPLICAS": "2",
+                "VDT_FLEET_HIGH_WATERMARK": "0.7",
+                # Below the trough's in-flight occupancy (2/16): the
+                # scale-in decision only accumulates on the IDLE ticks
+                # driven manually below, so the leader kill lands
+                # deterministically between the drain's journaled
+                # intent and its completion.
+                "VDT_FLEET_LOW_WATERMARK": "0.05",
+                "VDT_FLEET_ACTIONS": "20",
+            })
+            cfg = EngineConfig(
+                model_config=config.model_config,
+                cache_config=CacheConfig(block_size=16,
+                                         num_gpu_blocks=256),
+                scheduler_config=SchedulerConfig(
+                    max_num_batched_tokens=1024, max_num_seqs=8,
+                    max_model_len=512, num_scheduler_steps=1),
+                load_config=LoadConfig(load_format="dummy"),
+            )
+            cfg.parallel_config.data_parallel_size = 2
+            engine = LLMEngine(cfg, load_tokenizer=False)
+            dp = engine.engine_core
+            standby = None
+            outs: dict = {}
+            timeline: list = []
+
+            def _run_phase(ph: str, n: int) -> None:
+                for s in range(n):
+                    engine.add_request(f"{leg}-{ph}-{s}",
+                                       list(prompts[(ph, s)]), sp)
+                while engine.has_unfinished_requests():
+                    for o in engine.step():
+                        if o.finished:
+                            outs[o.request_id] = list(
+                                o.outputs[0].token_ids)
+                    if standby is not None:
+                        standby.tick()
+
+            _run_phase(*phases[0])
+            _run_phase(*phases[1])
+            if leg == "on":
+                from vllm_distributed_tpu.engine.control_plane import \
+                    HAFleetController
+                primary = dp.fleet
+                timeline.append(primary.get_stats()["replicas"])
+                # Idle ticks walk the trough's scale-in up to (not
+                # past) the drain start: intent journaled, retire
+                # incomplete.
+                for _ in range(50):
+                    dp._tick()
+                    if primary._draining:
+                        break
+                if not primary._draining:
+                    record["ha_leg_error"] = \
+                        "trough scale-in never began a drain"
+                    engine.shutdown()
+                    return
+                # Kill the leader mid-scale-in, then time the standby's
+                # takeover (lease expiry + election + journal replay).
+                fi.inject("fleet.controller_die", max_fires=1)
+                try:
+                    dp._tick()
+                finally:
+                    fi.clear("fleet.controller_die")
+                t_dead = time.perf_counter()
+                standby = HAFleetController(dp, dp.config,
+                                            holder="fe-standby")
+                while (not standby.is_leader
+                       and time.perf_counter() - t_dead < 5.0):
+                    standby.tick()
+                    time.sleep(0.02)
+                if not standby.is_leader:
+                    record["ha_leg_error"] = \
+                        "standby never acquired the lease"
+                    engine.shutdown()
+                    return
+                record["ha_failover_gap_s"] = round(
+                    time.perf_counter() - t_dead, 3)
+                # The successor completes the journaled retire.
+                for _ in range(20):
+                    standby.tick()
+                    if standby.get_stats()["replicas"] == 1:
+                        break
+                timeline.append(standby.get_stats()["replicas"])
+            _run_phase(*phases[2])
+            if leg == "on":
+                st = standby.get_stats()
+                timeline.append(st["replicas"])
+                record["ha_replica_timeline"] = timeline
+                record["ha_leader_transitions"] = int(
+                    st["leader_transitions"])
+                record["ha_journal_replays"] = int(
+                    st["journal_replays"])
+                fenced = dict(primary.fenced_actions)
+                for a, n in st["fenced_actions"].items():
+                    fenced[a] = fenced.get(a, 0) + int(n)
+                record["ha_fenced_actions"] = {
+                    a: int(n) for a, n in sorted(fenced.items())}
+                standby.close()
+            outputs[leg] = outs
+            engine.shutdown()
+            del engine
+            gc.collect()
+        on = {k.split("-", 1)[1]: v for k, v in outputs["on"].items()}
+        off = {k.split("-", 1)[1]: v
+               for k, v in outputs["off"].items()}
+        record["ha_parity"] = on == off
+    finally:
+        shutil.rmtree(journal_dir, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> None:
     from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
                                              LoadConfig, ModelConfig,
@@ -2072,10 +2247,10 @@ def main() -> None:
     dev_s = device_decode["s"]
     record = {
         "metric": "decode_throughput_llama1b_bs8",
-        # v4: _fleet_leg fields (or fleet_leg_error) join the v3
-        # _tiering_leg requirements — scripts/lint_bench.py keeps
-        # future records machine-comparable.
-        "schema_version": 4,
+        # v5: _ha_leg fields (or ha_leg_error) join the v4 _fleet_leg
+        # requirements — scripts/lint_bench.py keeps future records
+        # machine-comparable.
+        "schema_version": 5,
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
@@ -2226,6 +2401,13 @@ def main() -> None:
             _fleet_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
+        # HA control-plane leg: leader killed mid-scale-in, standby
+        # takes over inside the lease TTL, token parity across the
+        # failover.
+        try:
+            _ha_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["ha_leg_error"] = f"{type(e).__name__}: {e}"
         # Quantized-communication leg: dcn_pull transfer bytes + parity
         # with the int8 KV codec on vs off.
         try:
@@ -2316,6 +2498,10 @@ def main() -> None:
             _fleet_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _ha_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["ha_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _qcomm_leg(record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

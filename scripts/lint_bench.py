@@ -31,6 +31,12 @@ a record drifts:
   the greedy-parity flag — or an explicit ``fleet_leg_error`` string.
   ``fleet_parity`` must be ``true``: elasticity is contractually
   token-invisible, migrations included.
+* **schema_version >= 5 records** (the HA fleet control plane) must
+  carry the ``_ha_leg`` failover drill — leader transitions, the
+  fenced-action counters, the observed failover gap, journal replays,
+  the replica timeline and the greedy-parity flag — or an explicit
+  ``ha_leg_error`` string. ``ha_parity`` must be ``true``: leader
+  failover is contractually token-invisible, journal replays included.
 
 Usage::
 
@@ -100,6 +106,8 @@ def check_record(name: str, rec) -> list:
             errs.extend(_check_tiering_fields(name, rec))
         if version >= 4:
             errs.extend(_check_fleet_fields(name, rec))
+        if version >= 5:
+            errs.extend(_check_ha_fields(name, rec))
     return errs
 
 
@@ -169,6 +177,46 @@ def _check_fleet_fields(name: str, rec: dict) -> list:
     for key, (ok, want) in FLEET_FIELDS.items():
         if not ok(rec.get(key)):
             errs.append(f"{name}: schema>=4 record needs {key} "
+                        f"({want}), got {rec.get(key)!r}")
+    return errs
+
+
+# _ha_leg failover-drill fields required on schema >= 5 records
+# ((validator, description) per field; see bench.py _ha_leg).
+HA_FIELDS = {
+    "ha_leader_transitions": (
+        lambda v: _is_num(v) and v >= 2,
+        "number >= 2 (election + the failover takeover)"),
+    "ha_failover_gap_s": (
+        lambda v: _is_num(v) and v >= 0, "number >= 0"),
+    "ha_journal_replays": (
+        lambda v: _is_num(v) and v >= 1,
+        "number >= 1 (the successor replayed the mid-drain intent)"),
+    "ha_fenced_actions": (
+        lambda v: (isinstance(v, dict)
+                   and all(isinstance(k, str) and _is_num(n) and n >= 0
+                           for k, n in v.items())),
+        "dict of action -> rejection count"),
+    "ha_replica_timeline": (
+        lambda v: (isinstance(v, list) and v
+                   and all(_is_num(x) and x >= 1 for x in v)),
+        "non-empty list of replica counts >= 1"),
+    "ha_parity": (lambda v: v is True,
+                  "true (leader failover must be token-invisible)"),
+}
+
+
+def _check_ha_fields(name: str, rec: dict) -> list:
+    err = rec.get("ha_leg_error")
+    if err is not None:
+        if isinstance(err, str) and err:
+            return []  # leg failed and says why — valid record
+        return [f"{name}: ha_leg_error must be a non-empty "
+                f"string, got {err!r}"]
+    errs = []
+    for key, (ok, want) in HA_FIELDS.items():
+        if not ok(rec.get(key)):
+            errs.append(f"{name}: schema>=5 record needs {key} "
                         f"({want}), got {rec.get(key)!r}")
     return errs
 

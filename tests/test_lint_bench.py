@@ -46,6 +46,13 @@ GOOD_V4_TPU = {
     "fleet_replica_timeline": [2, 1, 2], "fleet_parity": True,
 }
 
+GOOD_V5_TPU = {
+    **GOOD_V4_TPU, "schema_version": 5,
+    "ha_leader_transitions": 2, "ha_failover_gap_s": 0.31,
+    "ha_journal_replays": 1, "ha_fenced_actions": {"resurrect": 1},
+    "ha_replica_timeline": [2, 1, 2], "ha_parity": True,
+}
+
 
 def test_repo_records_are_clean():
     res = _run()
@@ -188,6 +195,46 @@ def test_v4_fleet_leg_error_is_accepted(tmp_path):
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 0, res.stderr
     rec["fleet_leg_error"] = ""
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+
+
+def test_good_v5_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V5_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_v5_record_without_ha_fields_fails(tmp_path):
+    rec = dict(GOOD_V5_TPU)
+    del rec["ha_leader_transitions"]
+    del rec["ha_journal_replays"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "ha_leader_transitions" in res.stderr
+    assert "ha_journal_replays" in res.stderr
+
+
+def test_v5_ha_parity_false_fails(tmp_path):
+    # Leader failover is contractually token-invisible — a takeover
+    # that changed a stream is a correctness bug, not a shrug.
+    _write(tmp_path, "BENCH_x.json",
+           dict(GOOD_V5_TPU, ha_parity=False))
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "token-invisible" in res.stderr
+
+
+def test_v5_ha_leg_error_is_accepted(tmp_path):
+    rec = {k: v for k, v in GOOD_V5_TPU.items()
+           if not k.startswith("ha_")}
+    rec["ha_leg_error"] = "RuntimeError: needs >= 2 devices"
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    rec["ha_leg_error"] = ""
     _write(tmp_path, "BENCH_x.json", rec)
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 1

@@ -1,0 +1,298 @@
+"""HA fleet control plane: lease-fenced controller with leader failover.
+
+ROADMAP item 2's named remainder — PR 16's ``FleetController`` lives
+inside one DP client, so the front-end hosting it is a single point of
+failure for the whole fleet's shape, and a second API server would run
+a second, un-coordinated actuator. This module hoists the controller
+behind the existing coordinator RPC socket (engine/coordinator.py grew
+``lease``/``fence``/``lease_info`` ops) with three robustness
+mechanisms, all behind ``VDT_FLEET_CONTROLLER`` (default off =
+byte-identical in-process behavior):
+
+* **Leader election + leases** — every front-end constructs an
+  ``HAFleetController``; each tick it acquires/renews a TTL lease
+  (monotonic coordinator clock) and only the current leaseholder runs
+  the actuation half of the loop. Standbys keep feeding signals and
+  serving; on leader death (``fleet.controller_die``) a standby's next
+  acquire succeeds within the TTL.
+* **Fencing epochs** — the coordinator bumps the lease epoch on every
+  holder CHANGE. Each actuation (spawn/drain/retire/re-split/
+  force-cycle, plus the drain-progress rungs) first runs a ``fence``
+  check stamped with the epoch the controller last held; a
+  paused-then-resumed ex-leader (``fleet.lease_expire``) fails it —
+  the rejection is counted in ``vdt:fleet_fenced_actions_total``
+  (never raised into serving) and the ex-leader demotes itself.
+* **Crash-safe actuation journal** — multi-step actions write a JSON
+  intent record (atomic tmp+rename) to the T2 spill namespace BEFORE
+  each rung (``FleetController._journal_begin`` at drain start,
+  ``_journal_end`` at retire/convert completion). A newly elected
+  leader replays ``pending()`` records — re-entering the drain so the
+  deadline/journal-migrate/retire machinery completes it with token
+  parity — or safely aborts records that no longer apply.
+* **Partition degradation** — a front-end whose coordinator RPCs fail
+  (``coordinator.partition``) keeps serving and routing with frozen
+  placement: lease/fence errors count a ``reason="partition"`` freeze
+  and suppress actuation, mirroring the stale-stats freeze ladder,
+  and the DP client's routing falls back to local least-loaded.
+
+Satellite guard: with the control plane on, a standby front-end's tick
+is a fenced no-op — in particular the legacy resurrection-probe
+opportunity is counted (``action="resurrect"``) instead of actuated,
+so a dead replica is only ever respawned by the leaseholder.
+"""
+
+import json
+import os
+import tempfile
+import time
+import uuid
+from typing import Optional
+
+from vllm_distributed_tpu.config import EngineConfig
+from vllm_distributed_tpu.engine.fleet import (FREEZE_PARTITION,
+                                               FleetController)
+from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.utils import fault_injection
+
+logger = init_logger(__name__)
+
+
+def journal_root() -> str:
+    """Actuation-journal directory: ``VDT_FLEET_JOURNAL_DIR`` when set,
+    else the T2 spill namespace (shared across front-ends exactly like
+    warm-start pages), else a per-process tempdir (single front-end:
+    still crash-safe across leader re-elections within the fleet)."""
+    from vllm_distributed_tpu import envs
+    root = envs.VDT_FLEET_JOURNAL_DIR
+    if root:
+        return root
+    tier = envs.VDT_KV_TIER_DIR
+    if tier:
+        return os.path.join(tier, "fleet_journal")
+    return tempfile.mkdtemp(prefix="vdt-fleet-journal-")
+
+
+class ActuationJournal:
+    """One JSON intent file per in-flight multi-step action, written
+    atomically (tmp + rename) so a reader never sees a torn record.
+    The key is the action's identity (``drain-<replica>``): a rung
+    update overwrites, completion removes."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.json")
+
+    def begin(self, key: str, record: dict) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(record, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(key))
+
+    def end(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def pending(self) -> dict:
+        """All live intent records, key -> record (unreadable strays
+        are skipped — atomic writes make them leftover tmp files or
+        foreign junk, not half-written intents)."""
+        out = {}
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name),
+                          encoding="utf-8") as f:
+                    out[name[:-len(".json")]] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+
+class HAFleetController(FleetController):
+    """Lease-fenced ``FleetController``: the decision/actuation loop is
+    unchanged (inherited), but every tick first settles leadership and
+    every actuation passes the coordinator's epoch fence. Multiple
+    instances — one per front-end — safely share one fleet."""
+
+    ha = True
+
+    def __init__(self, client, config: EngineConfig,
+                 holder: Optional[str] = None) -> None:
+        super().__init__(client, config)
+        from vllm_distributed_tpu import envs
+        assert client.coordinator is not None, \
+            "HA fleet controller needs the coordinator RPC plane"
+        self.coord = client.coordinator
+        self.holder = holder or f"fe-{uuid.uuid4().hex[:8]}"
+        self.ttl_s = envs.VDT_FLEET_LEASE_TTL_S
+        self.journal = ActuationJournal(journal_root())
+        self.is_leader = False
+        # The epoch of the lease we last HELD — actuations are stamped
+        # with it, so after a takeover elsewhere our commands read as
+        # stale to the coordinator no matter what we believe locally.
+        self.epoch = 0
+        self.leader_transitions = 0
+        self.fenced_actions: dict[str, int] = {}
+        self.journal_replays = 0
+        # fleet.controller_die: the controller stops ticking/renewing
+        # entirely, exactly as if its front-end process was killed.
+        self.dead = False
+        logger.info(
+            "HA fleet controller %s: lease TTL %.1fs, journal at %s",
+            self.holder, self.ttl_s, self.journal.root)
+
+    # ------------------------------------------------------------------
+    # Leadership
+    # ------------------------------------------------------------------
+    def _lease_tick(self) -> None:
+        was = self.is_leader
+        if was and fault_injection.should_fire("fleet.lease_expire"):
+            # A paused-then-resumed leader: the renewal is skipped but
+            # the controller still believes it leads — the next fenced
+            # actuation is where reality catches up (epoch check).
+            return
+        try:
+            rep = self.coord.acquire_lease(self.holder, self.ttl_s)
+        except Exception as e:  # noqa: BLE001 - partitioned from the
+            # control plane: keep serving with frozen placement, no
+            # actuation — the stale-stats freeze ladder's idiom.
+            self.is_leader = False
+            self._freeze(FREEZE_PARTITION)
+            logger.warning("fleet controller %s cannot reach the "
+                           "control plane (%s); placement frozen",
+                           self.holder, e)
+            return
+        self.is_leader = bool(rep.get("granted"))
+        self.leader_transitions = int(rep.get("transitions", 0))
+        if self.is_leader:
+            self.epoch = int(rep.get("epoch", 0))
+            if not was:
+                self.events.record("", ev.FLEET_LEADER_TAKEOVER,
+                                   {"holder": self.holder,
+                                    "epoch": self.epoch})
+                logger.info(
+                    "fleet controller %s acquired the lease (epoch %d)",
+                    self.holder, self.epoch)
+                self._replay_journal()
+
+    def tick(self) -> None:
+        if self.dead:
+            return
+        if fault_injection.should_fire("fleet.controller_die"):
+            self.dead = True
+            self.is_leader = False
+            self.events.record("", ev.FLEET_CONTROLLER_DOWN,
+                               {"holder": self.holder})
+            logger.error("fleet controller %s DIED (drill); lease "
+                         "lapses within %.1fs", self.holder, self.ttl_s)
+            return
+        c = self.client
+        with c._lock:
+            self._lease_tick()
+            if not self.is_leader:
+                # Standby (or partitioned): never actuate. The legacy
+                # resurrection-probe opportunity in particular is a
+                # counted fenced no-op — only the leaseholder respawns
+                # a dead replica (single-owner actuation guard).
+                if c._down - c._retired:
+                    self._count_fenced("resurrect")
+                return
+        super().tick()
+
+    # ------------------------------------------------------------------
+    # Fencing
+    # ------------------------------------------------------------------
+    def _count_fenced(self, action: str) -> None:
+        self.fenced_actions[action] = \
+            self.fenced_actions.get(action, 0) + 1
+        self.events.record("", ev.FLEET_FENCED, {"action": action})
+
+    def _fence(self, action: str) -> bool:
+        try:
+            ok = self.coord.fence(self.epoch, action)
+        except Exception:  # noqa: BLE001 - partitioned mid-actuation:
+            # fail safe (no actuation), counted on the freeze ladder.
+            self._freeze(FREEZE_PARTITION)
+            return False
+        if not ok:
+            # Stale epoch (or lapsed lease): we were deposed. Count the
+            # rejection, demote, and let the next tick re-elect.
+            self._count_fenced(action)
+            self.is_leader = False
+            logger.warning(
+                "fleet controller %s: %s fenced off (stale epoch %d)",
+                self.holder, action, self.epoch)
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    # Journal
+    # ------------------------------------------------------------------
+    def _journal_begin(self, i: int, mode: str,
+                       role: Optional[str]) -> None:
+        self.journal.begin(f"drain-{i}", {
+            "action": mode, "replica": i, "role": role,
+            "epoch": self.epoch, "holder": self.holder})
+
+    def _journal_end(self, i: int) -> None:
+        self.journal.end(f"drain-{i}")
+
+    def _replay_journal(self) -> None:
+        """Called on takeover (balancer lock held): complete or abort
+        every half-done multi-step action the previous leader left.
+        Completion re-enters the drain — ``_start_drain`` re-asserts
+        out-of-placement state and a fresh deadline, and the normal
+        journal-migrate machinery finishes the retire/convert with
+        token parity."""
+        c = self.client
+        now = time.monotonic()
+        for key, rec in self.journal.pending().items():
+            i = rec.get("replica")
+            mode = rec.get("action")
+            role = rec.get("role")
+            if (not isinstance(i, int) or not 0 <= i < len(c.clients)
+                    or mode not in ("retire", "convert")
+                    or i in c._retired):
+                # No longer applies (slot already retired, or a record
+                # from an incompatible fleet shape): safe abort.
+                self.journal.end(key)
+                continue
+            self.journal_replays += 1
+            self.events.record("", ev.FLEET_JOURNAL_REPLAY,
+                               {"replica": i, "action": mode})
+            logger.warning(
+                "fleet controller %s: replaying journaled %s of "
+                "replica %d left by %s", self.holder, mode, i,
+                rec.get("holder"))
+            self._start_drain(i, mode, role, now)
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        if self.is_leader and not self.dead:
+            try:
+                self.coord.release_lease(self.holder)
+            except Exception:  # noqa: BLE001 - coordinator already gone
+                pass
+        self.is_leader = False
+
+    def get_stats(self) -> dict:
+        stats = super().get_stats()
+        stats["leader"] = int(self.is_leader and not self.dead)
+        stats["lease_epoch"] = self.epoch
+        stats["leader_transitions"] = self.leader_transitions
+        stats["fenced_actions"] = dict(self.fenced_actions)
+        stats["journal_replays"] = self.journal_replays
+        return stats

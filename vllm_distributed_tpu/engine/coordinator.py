@@ -31,6 +31,8 @@ logger = init_logger(__name__)
 
 
 def _coordinator_loop(addr: str, num_engines: int) -> None:
+    import time
+
     import zmq
 
     from vllm_distributed_tpu.engine.serial import pack, unpack
@@ -39,6 +41,15 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
     sock.bind(addr)
     counts = [0] * num_engines
     healthy = [True] * num_engines
+    # Fleet-controller lease (engine/control_plane.py): exactly one
+    # front-end controller holds the TTL lease and actuates; the epoch
+    # increments on every holder change so a paused-then-resumed
+    # ex-leader's commands are recognizably stale (fencing). Monotonic
+    # server clock — wall-clock jumps cannot expire or extend a lease.
+    lease_holder: Optional[str] = None
+    lease_epoch = 0
+    lease_deadline = 0.0
+    lease_transitions = 0
     try:
         while True:
             raw = sock.recv()
@@ -96,6 +107,60 @@ def _coordinator_loop(addr: str, num_engines: int) -> None:
                     healthy.extend([True] * (n - num_engines))
                     num_engines = n
                     reply = {"ok": True}
+                elif op == "lease":
+                    # Acquire/renew the controller lease. Grants when
+                    # the lease is free, expired, or already held by
+                    # this holder (renewal); the epoch bumps only on a
+                    # holder CHANGE, so renewals keep in-flight fenced
+                    # actions valid. "release" relinquishes voluntarily
+                    # (clean shutdown) without burning an epoch — the
+                    # next grant increments it.
+                    holder = str(msg["holder"])
+                    now = time.monotonic()
+                    if msg.get("release"):
+                        if lease_holder == holder:
+                            lease_holder = None
+                            lease_deadline = 0.0
+                        reply = {"granted": False, "epoch": lease_epoch,
+                                 "holder": lease_holder,
+                                 "transitions": lease_transitions}
+                    else:
+                        ttl_s = float(msg["ttl_s"])
+                        free = (lease_holder is None
+                                or now >= lease_deadline)
+                        if free or lease_holder == holder:
+                            if lease_holder != holder:
+                                lease_epoch += 1
+                                lease_transitions += 1
+                                lease_holder = holder
+                            lease_deadline = now + ttl_s
+                            granted = True
+                        else:
+                            granted = False
+                        reply = {"granted": granted,
+                                 "epoch": lease_epoch,
+                                 "holder": lease_holder,
+                                 "transitions": lease_transitions}
+                elif op == "fence":
+                    # Epoch check for an actuation: valid iff the epoch
+                    # is CURRENT and the lease unexpired. A stale epoch
+                    # is a normal reply (ok=False), not an error — the
+                    # caller counts the rejection and moves on; fencing
+                    # must never raise into the serving path.
+                    now = time.monotonic()
+                    ok = (int(msg["epoch"]) == lease_epoch
+                          and lease_holder is not None
+                          and now < lease_deadline)
+                    reply = {"ok": bool(ok), "epoch": lease_epoch}
+                elif op == "lease_info":
+                    now = time.monotonic()
+                    live = (lease_holder is not None
+                            and now < lease_deadline)
+                    reply = {"holder": lease_holder if live else None,
+                             "epoch": lease_epoch,
+                             "ttl_remaining_s":
+                             max(0.0, lease_deadline - now),
+                             "transitions": lease_transitions}
                 elif op == "counts":
                     reply = {"counts": list(counts),
                              "engines_running": [c > 0 for c in counts],
@@ -138,6 +203,15 @@ class DPCoordinatorClient:
 
     def _call(self, **msg) -> dict:
         import zmq
+
+        from vllm_distributed_tpu.utils import fault_injection
+        if fault_injection.should_fire("coordinator.partition"):
+            # Drill: the control plane is unreachable from THIS
+            # front-end (network partition). Callers degrade — routing
+            # falls back to local least-loaded, the HA controller
+            # freezes placement — and nothing raises into serving.
+            raise RuntimeError(
+                "DP coordinator unreachable (injected partition)")
         with self._lock:
             try:
                 self.sock.send(self._serial.pack(msg))
@@ -172,6 +246,31 @@ class DPCoordinatorClient:
         """Grow the coordinator's engine table (elastic scale-out).
         New slots start healthy with zero admissions."""
         self._call(op="resize", num_engines=num_engines)
+
+    def acquire_lease(self, holder: str, ttl_s: float) -> dict:
+        """Acquire or renew the fleet-controller lease. Returns the
+        coordinator's view: ``{"granted", "epoch", "holder",
+        "transitions"}`` — a renewal by the current holder keeps the
+        epoch, a takeover bumps it."""
+        return self._call(op="lease", holder=holder, ttl_s=ttl_s)
+
+    def release_lease(self, holder: str) -> None:
+        """Voluntarily relinquish the lease (clean shutdown); a no-op
+        unless ``holder`` currently holds it."""
+        self._call(op="lease", holder=holder, release=True)
+
+    def fence(self, epoch: int, action: str) -> bool:
+        """True iff an actuation stamped with ``epoch`` may proceed
+        (epoch current AND lease unexpired). ``action`` rides along
+        for the coordinator's logs; a False return is the stale-epoch
+        rejection path — count it, never raise it."""
+        return bool(self._call(op="fence", epoch=epoch,
+                               action=action)["ok"])
+
+    def lease_info(self) -> dict:
+        """Observability snapshot: ``{"holder", "epoch",
+        "ttl_remaining_s", "transitions"}`` (holder None if expired)."""
+        return self._call(op="lease_info")
 
     def healthy(self) -> list[bool]:
         return list(self._call(op="counts")["healthy"])

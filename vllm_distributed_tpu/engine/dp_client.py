@@ -102,6 +102,11 @@ class DPEngineClient(EngineCoreClient):
         # the aggregated view.
         self.coordinator = None
         self._coord_proc = None
+        # _coord_control_only: set when the ONLY coordinator is the one
+        # spawned below for VDT_FLEET_CONTROLLER (routing didn't ask
+        # for one) — it carries lease/fence/journal ops, never
+        # admission accounting, so placement stays byte-identical.
+        self._coord_control_only = False
         if config.parallel_config.data_parallel_coordinator:
             from vllm_distributed_tpu.engine.coordinator import (
                 DPCoordinatorClient, spawn_coordinator)
@@ -169,8 +174,27 @@ class DPEngineClient(EngineCoreClient):
         self._no_place: set[int] = set()
         self.fleet = None
         if envs.VDT_FLEET:
-            from vllm_distributed_tpu.engine.fleet import FleetController
-            self.fleet = FleetController(self, config)
+            if envs.VDT_FLEET_CONTROLLER:
+                # HA control plane (engine/control_plane.py): lease/
+                # fence/journal ops ride the coordinator socket, so
+                # spawn one for the control plane if routing didn't —
+                # _coord_routes stays False, placement untouched.
+                if self.coordinator is None:
+                    from vllm_distributed_tpu.engine.coordinator import (
+                        DPCoordinatorClient, spawn_coordinator)
+                    self._coord_proc, addr = spawn_coordinator(n)
+                    self._coord_addr = addr
+                    self.coordinator = DPCoordinatorClient(addr)
+                    self._coord_control_only = True
+                    logger.info("DP coordinator (control plane only) "
+                                "at %s", addr)
+                from vllm_distributed_tpu.engine.control_plane import \
+                    HAFleetController
+                self.fleet = HAFleetController(self, config)
+            else:
+                from vllm_distributed_tpu.engine.fleet import \
+                    FleetController
+                self.fleet = FleetController(self, config)
 
     # ------------------------------------------------------------------
     def _pick_replica(self, request: Optional[EngineCoreRequest] = None,
@@ -200,27 +224,67 @@ class DPEngineClient(EngineCoreClient):
             prefer = self.router.route(request, self.request_counts(),
                                        blocked, pool=pool,
                                        least_loaded=least_loaded)
-        if self.coordinator is not None:
-            if pool is None:
-                # The coordinator's route() already accounts the
-                # admission (and skips replicas reported down via
-                # set_health); the router's pick rides along as a
-                # preference it honors while that replica is healthy.
-                return self.coordinator.route(prefer=prefer)
-            # Disagg: the coordinator's fleet-wide least-loaded pick
-            # (and its healthy-override of `prefer`) cannot honor the
-            # pool restriction, so the pick stays local and the
-            # admission is accounted to it explicitly — keeping the
-            # invariant _admit's unwind relies on (route() would have
-            # +1'd the same way).
-            pick = (prefer if prefer is not None
-                    else self._local_least_loaded(set(pool)))
-            self.coordinator.report(pick, 1)
-            return pick
+        if self.coordinator is not None and self._coord_routes:
+            try:
+                if pool is None:
+                    # The coordinator's route() already accounts the
+                    # admission (and skips replicas reported down via
+                    # set_health); the router's pick rides along as a
+                    # preference it honors while that replica is
+                    # healthy.
+                    return self.coordinator.route(prefer=prefer)
+                # Disagg: the coordinator's fleet-wide least-loaded
+                # pick (and its healthy-override of `prefer`) cannot
+                # honor the pool restriction, so the pick stays local
+                # and the admission is accounted to it explicitly —
+                # keeping the invariant _admit's unwind relies on
+                # (route() would have +1'd the same way).
+                pick = (prefer if prefer is not None
+                        else self._local_least_loaded(set(pool)))
+                self.coordinator.report(pick, 1)
+                return pick
+            except RuntimeError:
+                # Coordinator unreachable. With the HA control plane
+                # on this is the coordinator.partition degradation:
+                # keep serving with FROZEN placement (local least-
+                # loaded below, counted on the freeze ladder). Without
+                # it the failure surfaces as before.
+                if not self._coord_partition_degraded():
+                    raise
         if prefer is not None:
             return prefer
         return self._local_least_loaded(
             set(pool) if pool is not None else None)
+
+    @property
+    def _coord_routes(self) -> bool:
+        """Whether routing/admission accounting rides the coordinator.
+        A property (not an init-time snapshot) so a coordinator
+        installed after construction — multi-front-end wiring, test
+        stubs — gets the accounting exactly as before the HA plane."""
+        return self.coordinator is not None \
+            and not self._coord_control_only
+
+    def _coord_partition_degraded(self) -> bool:
+        """True iff a coordinator RPC failure should degrade to local
+        routing instead of raising: only under the HA control plane,
+        whose freeze ladder counts the partition."""
+        fleet = self.fleet
+        if fleet is None or not getattr(fleet, "ha", False):
+            return False
+        from vllm_distributed_tpu.engine.fleet import FREEZE_PARTITION
+        fleet._freeze(FREEZE_PARTITION)
+        return True
+
+    def _coord_report_safe(self, engine: int, delta: int) -> None:
+        """Admission-count delta to the coordinator, partition-tolerant:
+        under the HA control plane a failed RPC degrades (counted on
+        the freeze ladder) instead of raising into the serving path."""
+        try:
+            self.coordinator.report(engine, delta)
+        except RuntimeError:
+            if not self._coord_partition_degraded():
+                raise
 
     def _local_least_loaded(self, members: Optional[set]) -> int:
         """Least-live-count replica with rotation tie-break, optionally
@@ -272,8 +336,8 @@ class DPEngineClient(EngineCoreClient):
             except Exception as e:
                 # Unwind the admission accounting (route() already
                 # incremented the coordinator's count).
-                if self.coordinator is not None:
-                    self.coordinator.report(i, -1)
+                if self.coordinator is not None and self._coord_routes:
+                    self._coord_report_safe(i, -1)
                 if isinstance(e, EngineDeadError):
                     # Dead replica discovered at admission: take it out
                     # of rotation, migrate its load, then retry THIS
@@ -309,8 +373,8 @@ class DPEngineClient(EngineCoreClient):
                 except Exception:  # noqa: BLE001 - replica dead; its
                     # journal entries are gone, so failover skips them.
                     pass
-                if self.coordinator is not None:
-                    self.coordinator.report(i, -len(rids))
+                if self.coordinator is not None and self._coord_routes:
+                    self._coord_report_safe(i, -len(rids))
 
     def _mark_finished(
             self,
@@ -351,10 +415,10 @@ class DPEngineClient(EngineCoreClient):
                         # its replica: index prompt+generated so the
                         # session's NEXT turn routes home page-exactly.
                         self.router.on_finish(orig, progress or [], i)
-        if self.coordinator is not None:
+        if self.coordinator is not None and self._coord_routes:
             # One batched delta per replica (output hot path).
             for i, k in finished_per.items():
-                self.coordinator.report(i, -k)
+                self._coord_report_safe(i, -k)
         return outs
 
     # ------------------------------------------------------------------
@@ -383,11 +447,15 @@ class DPEngineClient(EngineCoreClient):
         logger.error(
             "DP replica %d died (%s); failing over %d in-flight "
             "request(s)", i, err, len(stranded))
-        if self.coordinator is not None:
+        if self.coordinator is not None and self._coord_routes:
             # Out of the routing set; clearing the count unwinds the
             # stranded admissions (migration re-reports them against
             # the replicas that absorb the load).
-            self.coordinator.set_health(i, False, clear=True)
+            try:
+                self.coordinator.set_health(i, False, clear=True)
+            except RuntimeError:
+                if not self._coord_partition_degraded():
+                    raise
         for rid in stranded:
             self._owner.pop(rid, None)
             self._live[i].discard(rid)
@@ -985,7 +1053,20 @@ class DPEngineClient(EngineCoreClient):
         return self._aggregate_stats(
             [self.clients[i].get_stats() for i in alive], indices=alive)
 
+    def observe_goodput(self, fracs: dict) -> None:
+        """Per-tenant goodput feed (metrics/stats.py FrontendStats SLO
+        scoring, wired from the entrypoints' stats path) into the
+        fleet's VDT_FLEET_SIGNALS scale decision. No-op without a
+        fleet controller."""
+        if self.fleet is not None and isinstance(fracs, dict):
+            self.fleet.observe_goodput(fracs)
+
     def shutdown(self) -> None:
+        if self.fleet is not None:
+            try:
+                self.fleet.close()  # HA: relinquish the lease cleanly
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
         if self.coordinator is not None:
             self.coordinator.shutdown_coordinator()
             self.coordinator.close()

@@ -77,6 +77,7 @@ FREEZE_BUDGET = "budget"  # action budget exhausted this window
 FREEZE_SCALE_STALL = "scale_stall"  # replica construction failed
 FREEZE_AT_MAX = "at_max"  # pressure with no slot/devices to grow into
 FREEZE_ASYM_TP = "asym_tp"  # re-split blocked by asymmetric role TP
+FREEZE_PARTITION = "partition"  # control plane unreachable (HA mode)
 
 
 class FleetController:
@@ -99,6 +100,14 @@ class FleetController:
         self.wedge_s = envs.VDT_FLEET_WEDGE_S
         self.drain_s = envs.VDT_FLEET_DRAIN_S
         self.resplit_ratio = envs.VDT_FLEET_RESPLIT_RATIO
+        # Richer scaling signals (VDT_FLEET_SIGNALS): the roofline
+        # phase inflates occupancy for a memory-bound fleet; a tenant
+        # under its goodput floor is scale-out pressure and a scale-in
+        # veto. Off (default) the decision is occupancy-only.
+        self.signals = envs.VDT_FLEET_SIGNALS
+        self.roofline_weight = envs.VDT_FLEET_ROOFLINE_WEIGHT
+        self.goodput_floor = envs.VDT_FLEET_GOODPUT_FLOOR
+        self._goodput: dict[str, float] = {}
         self.max_num_seqs = max(1, config.scheduler_config.max_num_seqs)
         # Supervisor-style ACTION budget (shared across every fleet
         # action): next_delay() consumes one attempt, None = exhausted
@@ -186,9 +195,40 @@ class FleetController:
                 # output path's own poll surfaces it for failover.
                 pass
 
+    def observe_goodput(self, fracs: dict) -> None:
+        """Per-tenant goodput fractions (metrics/stats.py FrontendStats
+        SLO scoring, fed through the entrypoints' stats path). Only
+        consulted when VDT_FLEET_SIGNALS is on."""
+        if isinstance(fracs, dict):
+            for tenant, frac in fracs.items():
+                if isinstance(frac, (int, float)):
+                    self._goodput[str(tenant)] = float(frac)
+
     def _freeze(self, reason: str) -> None:
         self.freezes[reason] = self.freezes.get(reason, 0) + 1
         self.events.record("", ev.FLEET_FREEZE, {"reason": reason})
+
+    # ------------------------------------------------------------------
+    # HA control-plane hooks (engine/control_plane.py overrides these;
+    # the in-process controller is its own single owner, so the base
+    # fence always passes and the journal is a no-op).
+    # ------------------------------------------------------------------
+    def _fence(self, action: str) -> bool:
+        """Epoch check before an actuation. Base: always allowed."""
+        return True
+
+    def _journal_begin(self, i: int, mode: str,
+                       role: Optional[str]) -> None:
+        """Write the intent record for a multi-step action's next rung
+        BEFORE actuating it. Base: no journal."""
+
+    def _journal_end(self, i: int) -> None:
+        """The multi-step action on replica ``i`` reached a terminal
+        state; drop its intent record. Base: no journal."""
+
+    def close(self) -> None:
+        """Release control-plane state (HA override relinquishes the
+        lease); nothing to do in-process."""
 
     def _actuation_allowed(self, now: float) -> bool:
         """Stale/missing stats for ANY in-rotation replica freeze all
@@ -251,7 +291,14 @@ class FleetController:
             c._down.discard(i)
             c.replica_resurrections += 1
             if c.coordinator is not None:
-                c.coordinator.set_health(i, True)
+                try:
+                    c.coordinator.set_health(i, True)
+                except RuntimeError:
+                    # Partitioned from the control plane mid-apply: the
+                    # replica serves locally; the coordinator relearns
+                    # its health from the next successful RPC epoch.
+                    if not c._coord_partition_degraded():
+                        raise
             # Fresh engine: restart the step-phase heartbeat and give
             # the stale-stats check a grace window.
             self._mark_fresh(i)
@@ -307,6 +354,8 @@ class FleetController:
         its PR-2 restart budget."""
         if not self._budget_ok():
             return
+        if not self._fence("force_cycle"):
+            return
         c = self.client
         logger.error(
             "fleet: replica %d WEDGED (steps stalled > %.1fs with %d "
@@ -334,13 +383,50 @@ class FleetController:
                   .get("num_waiting_reqs", 0)) for i in members)
         return (live + waiting) / cap
 
+    def _memory_bound_frac(self, members: list[int]) -> float:
+        """Device-time fraction of the fleet's attributed phases that
+        sit on the bandwidth roof (PR 14's classifier over the
+        per-replica perf_phases/perf_peaks riding the stats feed)."""
+        from vllm_distributed_tpu.metrics.costmodel import \
+            classify_roofline
+        total = bound = 0.0
+        for i in members:
+            stats = self._snap.get(i, ({}, 0.0))[0]
+            phases = stats.get("perf_phases")
+            peaks = stats.get("perf_peaks")
+            if not isinstance(phases, dict) or not isinstance(peaks,
+                                                              dict):
+                continue
+            for entry in phases.values():
+                if not isinstance(entry, dict):
+                    continue
+                dev_s = float(entry.get("device_seconds", 0.0) or 0.0)
+                if dev_s <= 0.0:
+                    continue
+                total += dev_s
+                if classify_roofline(entry, peaks) == "bandwidth":
+                    bound += dev_s
+        return bound / total if total > 0.0 else 0.0
+
     def _evaluate_scaling(self, now: float) -> None:
         active = self._active()
         occ = self._occupancy(active)
-        self._high_ticks = self._high_ticks + 1 if occ >= self.high_wm \
-            else 0
-        self._low_ticks = self._low_ticks + 1 if occ <= self.low_wm \
-            else 0
+        starved = False
+        if self.signals:
+            # Memory-bound waves gain little from batching deeper on
+            # the same replicas — inflate effective occupancy so the
+            # fleet scales out earlier and resists scale-in.
+            occ *= 1.0 + self.roofline_weight \
+                * self._memory_bound_frac(active)
+            # An SLO-starved tenant is scale-out pressure regardless
+            # of occupancy, and vetoes scale-in.
+            if self.goodput_floor > 0 and self._goodput:
+                starved = (min(self._goodput.values())
+                           < self.goodput_floor)
+        self._high_ticks = self._high_ticks + 1 \
+            if occ >= self.high_wm or starved else 0
+        self._low_ticks = self._low_ticks + 1 \
+            if occ <= self.low_wm and not starved else 0
         if self._high_ticks >= self.eval_ticks:
             self._high_ticks = 0
             self._scale_out(now)
@@ -368,6 +454,8 @@ class FleetController:
                                                             PREFILL_POOL)
             role = DECODE_POOL if dp > pp else PREFILL_POOL
         if not self._budget_ok():
+            return
+        if not self._fence("scale_out"):
             return
         try:
             fault_injection.fire_or_raise("fleet.scale_stall")
@@ -403,6 +491,8 @@ class FleetController:
             return
         if not self._budget_ok():
             return
+        if not self._fence("scale_in"):
+            return
         victim = min(victims, key=lambda i: (len(c._live[i]), -i))
         self._start_drain(victim, "retire", None, now)
         logger.info("fleet: retiring replica %d (drain deadline %.1fs)",
@@ -411,6 +501,9 @@ class FleetController:
     def _start_drain(self, i: int, mode: str, role: Optional[str],
                      now: float) -> None:
         c = self.client
+        # Intent BEFORE actuation: a leader that dies between here and
+        # _finish_* leaves a record a successor replays to completion.
+        self._journal_begin(i, mode, role)
         c._no_place.add(i)
         if c.coordinator is not None:
             # Out of the routing set, counts kept: the drain migration
@@ -424,6 +517,12 @@ class FleetController:
         for i in list(self._draining):
             d = self._draining[i]
             if c._live[i] and now < d["deadline"]:
+                continue
+            if not self._fence(d["mode"]):
+                # Deposed mid-drain: abandon the LOCAL record without
+                # touching fleet state — the new leaseholder owns
+                # completion through the journal.
+                self._draining.pop(i)
                 continue
             if c._live[i]:
                 # Past the deadline: journal-migrate the stragglers as
@@ -454,6 +553,7 @@ class FleetController:
         self._snap.pop(i, None)
         self._step_marks.pop(i, None)
         self.scale_ins += 1
+        self._journal_end(i)
         self.events.record("", ev.FLEET_SCALE_IN, {"replica": i})
         logger.info("fleet: scaled IN to %d replicas (replica %d "
                     "retired; zero requests lost)",
@@ -484,6 +584,7 @@ class FleetController:
             if c.coordinator is not None:
                 c.coordinator.set_health(i, False, clear=True)
             c._next_probe[i] = time.monotonic() + c._probe_interval
+            self._journal_end(i)
             return
         c.clients[i] = newc
         c._no_place.discard(i)
@@ -495,6 +596,7 @@ class FleetController:
             c.disagg.set_role(i, role)
         self._count_warm_start(i)
         self._mark_fresh(i)
+        self._journal_end(i)
         self.events.record("", ev.FLEET_RESPLIT,
                            {"replica": i, "role": role})
         logger.info("fleet: replica %d re-entered rotation as %s "
@@ -553,6 +655,8 @@ class FleetController:
         donors = [i for i in self._pool_members(donor_role)
                   if i not in self._draining]
         if len(donors) <= 1:
+            return
+        if not self._fence("resplit"):
             return
         victim = min(donors, key=lambda i: (len(c._live[i]), -i))
         self._start_drain(victim, "convert", direction, now)

@@ -219,6 +219,17 @@ async def metrics(request: web.Request) -> web.Response:
     processor = getattr(engine, "output_processor", None)
     if processor is not None:
         text += processor.stats.render()
+        # Per-tenant goodput feed into the fleet controller's richer
+        # scaling signals (VDT_FLEET_SIGNALS): the front-end's SLO
+        # scoring is the only place goodput exists, and the scrape is
+        # its natural cadence. getattr-guarded — only the DP client
+        # grows observe_goodput.
+        feed = getattr(getattr(engine, "engine_core", None),
+                       "observe_goodput", None)
+        slo = getattr(processor.stats, "slo_by_tenant", None)
+        if feed is not None and slo:
+            feed({t: good / max(scored, 1)
+                  for t, (scored, good) in list(slo.items())})
     ctrl = request.app.get(ADMISSION_KEY)
     if ctrl is not None and ctrl.enabled:
         text += (

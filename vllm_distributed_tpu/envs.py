@@ -330,6 +330,45 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # exceeds the other pool's by this factor. 0 disables re-splits.
     "VDT_FLEET_RESPLIT_RATIO":
     lambda: max(0.0, float(os.getenv("VDT_FLEET_RESPLIT_RATIO", "3"))),
+    # --- HA fleet control plane (engine/control_plane.py) ---------------
+    # Master switch: "1" hoists the FleetController behind the DP
+    # coordinator's lease/fence plane — every front-end hosts a standby
+    # controller, exactly one holds the TTL lease and actuates, every
+    # actuation carries the lease epoch and the coordinator rejects
+    # stale-epoch commands (counted, never raised into serving), and
+    # multi-step actions journal intents so a successor leader can
+    # finish them. "0" (default) keeps the PR-16 in-process controller
+    # byte-identical: no lease RPCs, no journal, no fencing.
+    "VDT_FLEET_CONTROLLER":
+    lambda: os.getenv("VDT_FLEET_CONTROLLER", "0") == "1",
+    # Lease TTL in seconds (monotonic server clock). The leader renews
+    # each tick; a standby takes over within TTL of leader death. Ticks
+    # must run faster than the TTL or leadership flaps.
+    "VDT_FLEET_LEASE_TTL_S":
+    lambda: max(0.001, float(os.getenv("VDT_FLEET_LEASE_TTL_S", "10"))),
+    # Actuation-journal directory ("" = auto: <VDT_KV_TIER_DIR>/
+    # fleet_journal when the T2 spill namespace is configured, else a
+    # per-fleet tempdir). Intent records are one JSON file per in-flight
+    # multi-step action, written atomically before each rung; a newly
+    # elected leader replays or aborts whatever it finds here.
+    "VDT_FLEET_JOURNAL_DIR":
+    lambda: os.getenv("VDT_FLEET_JOURNAL_DIR", ""),
+    # Richer scaling signals: "1" folds the roofline phase (memory- vs
+    # compute-bound fraction, PR 14's cost model) and per-tenant goodput
+    # (PR 13's SLO scoring) into the scale-out/in decision — a memory-
+    # bound or goodput-starved fleet scales out earlier and resists
+    # scale-in. "0" (default) decides on occupancy alone.
+    "VDT_FLEET_SIGNALS":
+    lambda: os.getenv("VDT_FLEET_SIGNALS", "0") == "1",
+    # Signal weights: occupancy is inflated by (1 + WEIGHT *
+    # memory_bound_fraction), and a min per-tenant goodput below FLOOR
+    # counts as scale-out pressure / vetoes scale-in. FLOOR <= 0
+    # disables the goodput term even with signals on.
+    "VDT_FLEET_ROOFLINE_WEIGHT":
+    lambda: max(0.0, float(os.getenv("VDT_FLEET_ROOFLINE_WEIGHT",
+                                     "0.5"))),
+    "VDT_FLEET_GOODPUT_FLOOR":
+    lambda: float(os.getenv("VDT_FLEET_GOODPUT_FLOOR", "0.5")),
     # --- SSM state cache (core/state_cache.py) --------------------------
     # First-class state checkpoint/restore for stateful (Mamba/Jamba)
     # models: prefix-style admission at snapshot boundaries, preemption

@@ -56,6 +56,13 @@ FLEET_SCALE_IN = "fleet_scale_in"  # replica drained and retired
 FLEET_RESPLIT = "fleet_resplit"  # replica converted between pools
 FLEET_WEDGE_CYCLE = "fleet_wedge_cycle"  # stuck replica force-cycled
 FLEET_FREEZE = "fleet_freeze"  # actuation skipped (stale/budget/...)
+# HA control plane (engine/control_plane.py; all rid="").
+FLEET_LEADER_TAKEOVER = "fleet_leader_takeover"  # lease acquired
+FLEET_FENCED = "fleet_fenced"  # stale-epoch actuation rejected
+FLEET_JOURNAL_REPLAY = "fleet_journal_replay"  # successor resumed a
+# half-done drain from the actuation journal
+FLEET_CONTROLLER_DOWN = "fleet_controller_down"  # controller died
+# (fleet.controller_die drill) — standbys take over within the TTL
 
 
 def timeline_enabled() -> bool:

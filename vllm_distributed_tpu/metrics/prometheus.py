@@ -206,8 +206,13 @@ LABELED_METRICS = {
     "vdt:requests_shed_by_class_total": ("class", ),
     # Elastic-fleet control loop (engine/fleet.py; VDT_FLEET=1):
     # ticks/actions skipped, by freeze reason (stale_stats | budget |
-    # scale_stall | at_max | asym_tp).
+    # scale_stall | at_max | asym_tp | partition).
     "vdt:fleet_freezes_total": ("reason", ),
+    # HA control plane (engine/control_plane.py; VDT_FLEET_CONTROLLER
+    # =1): stale-epoch/standby actuations rejected by the coordinator
+    # fence, by action (scale_out | scale_in | retire | convert |
+    # resplit | force_cycle | resurrect) — a fixed enum.
+    "vdt:fleet_fenced_actions_total": ("action", ),
     # Per-tenant QoS (core/sched/qos.py; VDT_QOS=1). Label cardinality
     # is bounded: tenants past VDT_QOS_MAX_TRACKED_TENANTS hash into 8
     # shared "~<n>" overflow buckets, tenantless traffic shares
@@ -345,10 +350,38 @@ def _render_fleet(fleet: dict) -> list[str]:
               "(stale_stats = a rotation member's stats went quiet, "
               "budget = action budget exhausted, scale_stall = replica "
               "spawn failed, at_max = device budget reached, asym_tp = "
-              "pools differ in per-replica world size)",
+              "pools differ in per-replica world size, partition = "
+              "control plane unreachable)",
               f"# TYPE {name} counter"]
     lines += [f'{name}{{reason="{r}"}} {int(n)}'
               for r, n in sorted(freezes.items())]
+    # HA control plane (engine/control_plane.py; keys present only
+    # with VDT_FLEET_CONTROLLER=1).
+    for key, name, kind, help_text in (
+        ("leader", "vdt:fleet_leader", "gauge",
+         "1 while THIS front-end's controller holds the fleet lease "
+         "(0 on standbys and partitioned/dead controllers)"),
+        ("lease_epoch", "vdt:fleet_lease_epoch", "gauge",
+         "Fencing epoch of the lease this controller last held "
+         "(bumped by the coordinator on every holder change)"),
+        ("leader_transitions", "vdt:fleet_leader_transitions_total",
+         "counter",
+         "Lease holder changes since boot (election + every "
+         "failover takeover)"),
+    ):
+        if key in fleet:
+            lines += [f"# HELP {name} {help_text}",
+                      f"# TYPE {name} {kind}",
+                      f"{name} {int(fleet.get(key, 0))}"]
+    if "fenced_actions" in fleet:
+        fenced = fleet.get("fenced_actions") or {}
+        name = "vdt:fleet_fenced_actions_total"
+        lines += [f"# HELP {name} Actuations rejected by the "
+                  "coordinator's epoch fence (stale ex-leader "
+                  "commands) or skipped on a standby, by action",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{action="{a}"}} {int(n)}'
+                  for a, n in sorted(fenced.items())]
     return lines
 
 

@@ -103,6 +103,25 @@ but never fired by production code):
   replica is force-cycled through the PR-2 restart budget, counted on
   exactly the ``vdt:fleet_wedge_cycles_total`` rung (NOT as a
   failover — the replica never died).
+* ``fleet.controller_die`` — the leaseholder fleet controller
+  (engine/control_plane.py) dies mid-tick: it stops ticking, renewing
+  its lease, and actuating, exactly as if its front-end process was
+  killed. The drill proves a standby acquires the lease within the
+  TTL, replays the actuation journal, and finishes half-done
+  drain→retire actions with greedy token parity.
+* ``fleet.lease_expire`` — the leaseholder skips its lease renewal
+  (a paused-then-resumed process: GC stall, SIGSTOP, VM migration)
+  while still believing it leads. A standby takes over, the epoch
+  bumps, and the ex-leader's next actuation fails the coordinator's
+  fence check — rejected and counted in
+  ``vdt:fleet_fenced_actions_total{action=...}``, never raised into
+  serving.
+* ``coordinator.partition`` — the front-end's coordinator RPCs
+  (engine/coordinator.py DPCoordinatorClient) fail as if the network
+  partitioned. The front-end keeps serving and routing with frozen
+  placement (local least-loaded fallback, no actuation — counted in
+  ``vdt:fleet_freezes_total{reason="partition"}``), mirroring the
+  stale-stats freeze ladder.
 """
 
 import threading
@@ -133,6 +152,9 @@ FAULT_POINTS = (
     "kv_tier.spill_corrupt",
     "fleet.scale_stall",
     "fleet.replica_wedge",
+    "fleet.controller_die",
+    "fleet.lease_expire",
+    "coordinator.partition",
 )
 
 
