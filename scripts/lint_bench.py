@@ -37,6 +37,11 @@ a record drifts:
   the replica timeline and the greedy-parity flag — or an explicit
   ``ha_leg_error`` string. ``ha_parity`` must be ``true``: leader
   failover is contractually token-invisible, journal replays included.
+* **schema_version >= 6 records** (the distributed trace plane) must
+  carry the ``_trace_leg`` acceptance — ``trace_overhead_frac`` <= 3%
+  (VDT_TRACE_PLANE on vs off), at least one stitched two-replica
+  disagg trace and at least one Perfetto flow link across the KV
+  handoff — or an explicit ``trace_leg_error`` string.
 
 Usage::
 
@@ -108,6 +113,8 @@ def check_record(name: str, rec) -> list:
             errs.extend(_check_fleet_fields(name, rec))
         if version >= 5:
             errs.extend(_check_ha_fields(name, rec))
+        if version >= 6:
+            errs.extend(_check_trace_fields(name, rec))
     return errs
 
 
@@ -217,6 +224,38 @@ def _check_ha_fields(name: str, rec: dict) -> list:
     for key, (ok, want) in HA_FIELDS.items():
         if not ok(rec.get(key)):
             errs.append(f"{name}: schema>=5 record needs {key} "
+                        f"({want}), got {rec.get(key)!r}")
+    return errs
+
+
+# _trace_leg acceptance fields required on schema >= 6 records
+# ((validator, description) per field; see bench.py _trace_leg).
+TRACE_FIELDS = {
+    "trace_overhead_frac": (
+        lambda v: _is_num(v) and v <= 0.03,
+        "number <= 0.03 (the trace plane may cost at most 3%)"),
+    "trace_stitched_traces": (
+        lambda v: _is_num(v) and v >= 1,
+        "number >= 1 (a disagg request must stitch both replicas "
+        "into one trace)"),
+    "trace_flow_links": (
+        lambda v: _is_num(v) and v >= 1,
+        "number >= 1 (the KV handoff must carry a Perfetto s/f "
+        "flow pair)"),
+}
+
+
+def _check_trace_fields(name: str, rec: dict) -> list:
+    err = rec.get("trace_leg_error")
+    if err is not None:
+        if isinstance(err, str) and err:
+            return []  # leg failed and says why — valid record
+        return [f"{name}: trace_leg_error must be a non-empty "
+                f"string, got {err!r}"]
+    errs = []
+    for key, (ok, want) in TRACE_FIELDS.items():
+        if not ok(rec.get(key)):
+            errs.append(f"{name}: schema>=6 record needs {key} "
                         f"({want}), got {rec.get(key)!r}")
     return errs
 

@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Ban undocumented (and orphaned) lifecycle event names.
+
+The trace plane renders every ``EventRecorder.record`` event name as a
+span type in Perfetto exports and /debug timelines, so an event name
+that drifts undocumented is an unreadable trace lane. The contract
+(lint_metrics applied to the event vocabulary):
+
+* **vocabulary** — every module-level ``NAME = "value"`` constant
+  defined in ``metrics/events.py`` ABOVE the ``EVENT_REGISTRY`` literal
+  (the constants below it — detail keys, thresholds — are not event
+  names).
+* **registered** — each vocabulary constant must appear as a key of
+  ``EVENT_REGISTRY`` with a non-empty one-line doc.
+* **recorded by constant** — ``.record(...)`` / ``_record_event(...)``
+  call sites under the package tree must pass the event as a constant
+  reference, never a raw string literal (a literal bypasses the
+  registry and this linter).
+* **documented** — each registry event name must appear as a backticked
+  token between the ``<!-- lint-events:begin/end -->`` markers in the
+  README (the events table); a backticked name in that section that no
+  registry entry declares is an orphaned row.
+
+Usage::
+
+    python scripts/lint_events.py [--package DIR] [--readme FILE]
+
+Exit 0 when clean; exit 1 listing violations otherwise.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+# Module-level event constant: NAME = "value" at column 0.
+CONSTANT_RE = re.compile(
+    r'^([A-Z][A-Z0-9_]*)\s*=\s*"([a-z][a-z0-9_]*)"', re.MULTILINE)
+# Registry entry: CONSTANT: "doc..." (docs are single-line literals).
+REGISTRY_ENTRY_RE = re.compile(
+    r'^\s*([A-Z][A-Z0-9_]*):\s*"(.*)",\s*$', re.MULTILINE)
+# Event argument of a record call: second positional argument.
+RECORD_CALL_RE = re.compile(
+    r"(?:\.record|_record_event)\(\s*([^,()]*|\([^()]*\)),\s*([^,)\s]+)")
+BACKTICK_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+README_BEGIN = "<!-- lint-events:begin -->"
+README_END = "<!-- lint-events:end -->"
+
+
+def vocabulary(events_py: Path) -> tuple[dict, dict]:
+    """-> (constants {NAME: value} above EVENT_REGISTRY,
+    registry {NAME: doc})."""
+    text = events_py.read_text(encoding="utf-8")
+    marker = text.find("EVENT_REGISTRY")
+    if marker < 0:
+        return {}, {}
+    head = text[marker:]
+    block = head[:head.find("\n}")]
+    constants = {name: value for name, value
+                 in CONSTANT_RE.findall(text[:marker])}
+    registry = dict(REGISTRY_ENTRY_RE.findall(block))
+    return constants, registry
+
+
+def literal_record_sites(package: Path) -> list[str]:
+    """Call sites passing the event as a raw string literal."""
+    problems = []
+    for path in sorted(package.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for _rid_arg, event_arg in RECORD_CALL_RE.findall(text):
+            if event_arg.startswith(('"', "'")):
+                problems.append(
+                    f"{path.relative_to(package.parent)}: records "
+                    f"event {event_arg} as a raw string literal (use "
+                    f"a metrics/events.py constant so the registry "
+                    f"and README stay load-bearing)")
+    return problems
+
+
+def readme_events(readme: Path) -> set[str]:
+    """Backticked names inside the lint-events README section."""
+    text = readme.read_text(encoding="utf-8")
+    begin = text.find(README_BEGIN)
+    end = text.find(README_END)
+    if begin < 0 or end < 0:
+        return set()
+    return set(BACKTICK_RE.findall(text[begin:end]))
+
+
+def main(argv: list[str]) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--package", type=Path,
+                        default=repo / "vllm_distributed_tpu",
+                        help="package tree to scan for record sites")
+    parser.add_argument("--readme", type=Path,
+                        default=repo / "README.md",
+                        help="README carrying the events table")
+    args = parser.parse_args(argv)
+    events_py = args.package / "metrics" / "events.py"
+    if not events_py.is_file():
+        print(f"lint_events: no such file: {events_py}",
+              file=sys.stderr)
+        return 2
+    if not args.readme.is_file():
+        print(f"lint_events: no such file: {args.readme}",
+              file=sys.stderr)
+        return 2
+
+    constants, registry = vocabulary(events_py)
+    documented = readme_events(args.readme)
+    problems: list[str] = []
+    if not constants:
+        problems.append("metrics/events.py: no event constants found "
+                        "above EVENT_REGISTRY (parse drift?)")
+    if not documented:
+        problems.append(
+            f"{args.readme.name}: no '{README_BEGIN}' section (the "
+            f"events table must sit between the lint-events markers)")
+    for name in sorted(set(constants) - set(registry)):
+        problems.append(
+            f"{name} (\"{constants[name]}\"): event constant missing "
+            f"from EVENT_REGISTRY (add a one-line doc entry)")
+    for name, doc in sorted(registry.items()):
+        if not doc.strip():
+            problems.append(f"{name}: EVENT_REGISTRY doc is empty")
+    names = {constants[n] for n in constants if n in registry}
+    for value in sorted(names - documented):
+        if documented:
+            problems.append(
+                f"{value}: missing from the README events table "
+                f"(between the lint-events markers)")
+    for value in sorted(documented - set(constants.values())):
+        problems.append(
+            f"{value}: in the README events table but declared by no "
+            f"event constant (orphaned row)")
+    problems += literal_record_sites(args.package)
+    if not problems:
+        return 0
+    print("vdt: event documentation drift:", file=sys.stderr)
+    for p in problems:
+        print(f"  {p}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -297,6 +297,28 @@ def test_budget_exhaustion_freezes_actuation(monkeypatch):
     assert dp.fleet.freezes.get("budget", 0) >= 1
 
 
+def test_slo_burn_hint_scales_out_and_clears(monkeypatch):
+    """PR 19: the SLO burn-rate watchdog's degraded flag reaches the
+    fleet as a zero-goodput pseudo-tenant — scale-out pressure on an
+    otherwise idle fleet, cleared the moment the burn subsides."""
+    dp = make_fleet(monkeypatch, VDT_FLEET_SIGNALS="1",
+                    VDT_FLEET_GOODPUT_FLOOR="0.5")
+    fleet = dp.fleet
+    # Healthy tenant above the floor + sustained burn: the hint alone
+    # (occupancy is ~0) is starvation pressure.
+    fleet.observe_goodput({"tenantA": 1.0}, degraded=True)
+    assert fleet._goodput["_slo_burn"] == 0.0
+    _pressure(dp, 0)
+    _tick(dp)
+    assert fleet.scale_outs == 1 and len(dp.clients) == 3
+    # Burn subsides: the pseudo-tenant clears and growth stops (the
+    # idle fleet returns to ordinary scale-in consideration).
+    fleet.observe_goodput({"tenantA": 1.0}, degraded=False)
+    assert "_slo_burn" not in fleet._goodput
+    _tick(dp)
+    assert fleet.scale_outs == 1
+
+
 def test_stale_stats_freeze_actuation(monkeypatch):
     """A replica whose stats went quiet freezes ALL actuation (never
     reshape the fleet on blind signals); fresh stats thaw it."""

@@ -1,0 +1,93 @@
+"""Wire tolerance for the trace-plane context (ISSUE 19 satellite).
+
+The ``trace_ctx`` wire key is ADDITIVE: a rolling fleet upgrade runs
+old and new peers against each other in both directions, so
+
+* a trace-stamped request must survive a pre-trace-plane decoder
+  (which constructs from known keys and drops extras), and
+* a trace-plane decoder must accept the old wire, where the key simply
+  never appears (``trace_ctx`` resolves to None).
+
+With VDT_TRACE_PLANE=0 nothing mints a context, and the encoded map —
+hence its msgpack bytes — must be byte-identical to the pre-plane wire.
+"""
+
+import msgpack
+
+from vllm_distributed_tpu.engine import serial
+from vllm_distributed_tpu.request import EngineCoreRequest
+from vllm_distributed_tpu.sampling_params import SamplingParams
+from vllm_distributed_tpu.trace_plane import mint_trace_ctx
+
+
+def _req(rid: str = "req-1", trace_ctx=None) -> EngineCoreRequest:
+    return EngineCoreRequest(
+        request_id=rid, prompt_token_ids=[1, 2, 3],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4),
+        trace_ctx=trace_ctx)
+
+
+def _old_decode(d: dict) -> EngineCoreRequest:
+    """The pre-trace-plane decoder: constructs from its OWN known keys
+    only, never looking at trace_ctx (simulates an old peer)."""
+    return EngineCoreRequest(
+        request_id=d["request_id"],
+        prompt_token_ids=list(d["prompt_token_ids"]),
+        sampling_params=SamplingParams(**d["sampling_params"]),
+        eos_token_id=d["eos_token_id"],
+        arrival_time=d["arrival_time"],
+        priority=d["priority"],
+        tenant=d.get("tenant"),
+        kv_transfer_params=d["kv_transfer_params"],
+    )
+
+
+def test_round_trip_carries_trace_ctx():
+    ctx = mint_trace_ctx("req-1")
+    wire = serial.unpack(serial.pack(serial.encode_request(
+        _req(trace_ctx=ctx))))
+    got = serial.decode_request(wire)
+    assert got.trace_ctx == ctx
+    assert got.request_id == "req-1"
+    assert got.prompt_token_ids == [1, 2, 3]
+
+
+def test_untraced_wire_is_byte_identical_to_pre_plane():
+    # trace_ctx=None (the VDT_TRACE_PLANE=0 default) must not add the
+    # key at all — the bytes on the wire are EXACTLY the old wire.
+    d = serial.encode_request(_req())
+    assert "trace_ctx" not in d
+    pre_plane = {k: v for k, v in d.items() if k != "trace_ctx"}
+    assert serial.pack(d) == msgpack.packb(pre_plane, use_bin_type=True)
+
+
+def test_new_decoder_accepts_old_wire():
+    # Old peer -> new decoder: the key is absent, not null.
+    d = serial.encode_request(_req())
+    wire = serial.unpack(serial.pack(d))
+    assert "trace_ctx" not in wire
+    got = serial.decode_request(wire)
+    assert got.trace_ctx is None
+
+
+def test_old_decoder_accepts_traced_wire():
+    # New peer -> old decoder: the extra key must not break an old
+    # constructor that only reads its known keys.
+    d = serial.encode_request(_req(trace_ctx=mint_trace_ctx("req-1")))
+    assert d["trace_ctx"] == mint_trace_ctx("req-1")
+    got = _old_decode(serial.unpack(serial.pack(d)))
+    assert got.request_id == "req-1"
+    assert got.trace_ctx is None  # old peers simply drop the context
+
+
+def test_minting_is_deterministic_and_wire_safe():
+    # The disagg consumer re-mints from the SAME request id (the
+    # handoff re-admits the original id), so determinism is what makes
+    # both replicas land in one trace even if the ctx were dropped.
+    a, b = mint_trace_ctx("req-x"), mint_trace_ctx("req-x")
+    assert a == b
+    assert a != mint_trace_ctx("req-y")
+    assert set(a) == {"trace_id", "span_id"}
+    assert len(a["trace_id"]) == 16 and len(a["span_id"]) == 8
+    int(a["trace_id"], 16)  # plain hex: survives any JSON/msgpack hop
+    int(a["span_id"], 16)

@@ -1,0 +1,176 @@
+"""End-to-end trace plane through a real engine: VDT_TRACE_PLANE=1
+mints a context at admission, the scheduler stamps its ring events, the
+get_stats drain feeds the front-end assembler, and the Perfetto export
+renders the stitched trace. Off (the default) the plane must leave no
+footprint at all."""
+
+import json
+
+import pytest
+import torch
+from transformers import LlamaConfig
+from transformers import LlamaForCausalLM as HFLlama
+
+from vllm_distributed_tpu import trace_plane as tp
+from vllm_distributed_tpu.engine.arg_utils import EngineArgs
+from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, eos_token_id=1)
+    path = tmp_path_factory.mktemp("tiny_trace")
+    HFLlama(cfg).eval().save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def make_engine(path, **overrides) -> LLMEngine:
+    args = dict(model=path, dtype="float32", block_size=4,
+                num_gpu_blocks_override=128, max_model_len=64,
+                max_num_batched_tokens=64, max_num_seqs=8,
+                skip_tokenizer_init=True)
+    args.update(overrides)
+    return LLMEngine(EngineArgs(**args).create_engine_config(),
+                     load_tokenizer=False)
+
+
+def run_one(engine, rid: str = "req-0", max_tokens: int = 4):
+    engine.add_request(rid, [3, 17, 92, 45],
+                       SamplingParams(temperature=0.0,
+                                      max_tokens=max_tokens,
+                                      ignore_eos=True))
+    for _ in range(200):
+        for out in engine.step():
+            if out.finished:
+                return out
+    raise AssertionError("request never finished")
+
+
+def test_plane_off_leaves_no_footprint(checkpoint, monkeypatch):
+    monkeypatch.delenv("VDT_TRACE_PLANE", raising=False)
+    engine = make_engine(checkpoint)
+    assert engine.processor.trace_enabled is False
+    assert engine.output_processor.assembler is None
+    req = engine.processor.process_inputs(
+        "probe", [1, 2, 3],
+        SamplingParams(temperature=0.0, max_tokens=1))
+    assert req.trace_ctx is None  # nothing minted -> old wire bytes
+
+
+def test_traced_request_assembles_and_exports(checkpoint, monkeypatch):
+    monkeypatch.setenv("VDT_TRACE_PLANE", "1")
+    engine = make_engine(checkpoint)
+    asm = engine.output_processor.assembler
+    assert asm is not None
+    run_one(engine, rid="req-0")
+    # The stats poll drains the core ring into the assembler (the same
+    # path GET /debug/trace uses).
+    engine.get_stats()
+    trace = asm.get(request_id="req-0")
+    assert trace is not None
+    assert trace["trace_id"] == tp.mint_trace_ctx("req-0")["trace_id"]
+    assert trace["request_ids"] == ["req-0"]
+    names = [e[2] for e in trace["events"]]
+    # Front-end admission + the scheduler lifecycle in ONE trace.
+    assert ev.ARRIVED in names
+    assert ev.QUEUED in names and ev.SCHEDULED in names
+    assert ev.FINISHED in names
+    # Core-ring events carry the stamp (that is what crosses replicas).
+    stamped = [e for e in trace["events"]
+               if isinstance(e[3], dict) and ev.TRACE_KEY in e[3]]
+    assert stamped
+    # The export is valid Chrome/Perfetto trace-event JSON, rendered
+    # in time order (the assembler keeps feed order; the exporter
+    # sorts after the epoch rebase).
+    out = tp.perfetto(trace)
+    json.dumps(out)
+    instants = [e["ts"] for e in out["traceEvents"] if e["ph"] == "i"]
+    assert instants == sorted(instants) and instants[0] >= 0
+    assert out["otherData"]["trace_id"] == trace["trace_id"]
+    assert any(e["ph"] == "X" for e in out["traceEvents"])
+    assert any(e["ph"] == "i" and e["tid"] == "scheduler"
+               for e in out["traceEvents"])
+
+
+def test_disagg_handoff_stitches_two_replicas(checkpoint, monkeypatch):
+    """ISSUE 19 acceptance: ONE disagg request yields ONE trace with
+    spans from BOTH replicas (prefill producer + decode consumer) and
+    an explicit Perfetto flow link across the KV handoff."""
+    import time
+
+    monkeypatch.setenv("VDT_TRACE_PLANE", "1")
+    monkeypatch.setenv("VDT_DISAGG", "1")
+    engine = make_engine(checkpoint, data_parallel_size=2,
+                         num_gpu_blocks_override=64)
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    for i in range(2):
+        engine.add_request(f"dh-{i}", [3, 17, 92, 45, 8, 21, 33, 64],
+                           sp)
+    for _ in range(20000):
+        engine.step()
+        if not engine.has_unfinished_requests():
+            break
+        time.sleep(0.001)  # the pull threads need GIL slots
+    assert not engine.has_unfinished_requests()
+    engine.get_stats()  # drain both replicas' rings + the router ring
+    asm = engine.output_processor.assembler
+    trace = asm.get(request_id="dh-0")
+    assert trace is not None
+    # Spans from both replicas stitched under the one trace id.
+    assert asm.replica_count(trace) >= 2
+    names = [e[2] for e in trace["events"]]
+    assert ev.DISAGG_HANDOFF in names
+    assert any(n in names for n in (ev.KV_PULL_WAIT, ev.KV_PULL_DONE,
+                                    ev.KV_PULL_LOCAL))
+    out = tp.perfetto(trace)
+    json.dumps(out)
+    flow_s = [e for e in out["traceEvents"] if e["ph"] == "s"]
+    flow_f = [e for e in out["traceEvents"] if e["ph"] == "f"]
+    assert flow_s and flow_f, "handoff flow arrow missing"
+    assert flow_s[0]["id"] == flow_f[0]["id"]
+    # The producer's and consumer's spans live on different pid lanes.
+    assert {e["pid"] for e in out["traceEvents"]
+            if e["ph"] == "i"} >= {0, 1}
+    engine.shutdown()
+
+
+def test_trace_plane_implies_timeline(checkpoint, monkeypatch):
+    # VDT_TRACE_PLANE=1 with the timeline flag untouched must still
+    # record lifecycle events — an empty trace would be a footgun.
+    monkeypatch.setenv("VDT_TRACE_PLANE", "1")
+    monkeypatch.delenv("VDT_REQUEST_TIMELINE", raising=False)
+    assert ev.timeline_enabled()
+    monkeypatch.setenv("VDT_TRACE_PLANE", "0")
+    monkeypatch.setenv("VDT_REQUEST_TIMELINE", "0")
+    assert not ev.timeline_enabled()
+
+
+def test_burn_watchdog_gated_and_degrades(checkpoint, monkeypatch):
+    # No SLO target -> no watchdog at all.
+    monkeypatch.delenv("VDT_SLO_TTFT_MS", raising=False)
+    monkeypatch.delenv("VDT_SLO_TPOT_MS", raising=False)
+    engine = make_engine(checkpoint)
+    assert engine.output_processor.stats.burn is None
+
+    # An unmeetable TTFT target: every request misses, both burn
+    # windows blow past the threshold, the degraded flag trips and the
+    # gauges render.
+    monkeypatch.setenv("VDT_SLO_TTFT_MS", "0.000001")
+    engine = make_engine(engine.config.model_config.model)
+    stats = engine.output_processor.stats
+    assert stats.burn is not None
+    for i in range(3):
+        run_one(engine, rid=f"burn-{i}")
+    rates = stats.burn.burn_rates()
+    assert rates["1m"] > 2.0 and rates["10m"] > 2.0
+    assert stats.burn.degraded()
+    text = stats.render()
+    assert 'vdt:slo_burn_rate{window="1m"}' in text
+    assert 'vdt:slo_burn_rate{window="10m"}' in text
+    assert "vdt:slo_degraded 1" in text

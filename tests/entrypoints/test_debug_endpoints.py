@@ -209,6 +209,15 @@ def test_debug_endpoints_idle_shapes(server):
     assert names & {"arrived", "finished", "aborted", "shed"}
 
 
+def test_debug_trace_404_when_plane_disabled(server):
+    # This server runs with VDT_TRACE_PLANE unset (the default): the
+    # endpoint must refuse with a hint, not serve an empty trace.
+    url, _engine = server
+    r = httpx.get(f"{url}/debug/trace", timeout=60)
+    assert r.status_code == 404
+    assert "VDT_TRACE_PLANE" in r.json()["error"]
+
+
 def test_debug_perf_attribution_mid_request(server):
     """GET /debug/perf serves the performance-attribution table —
     non-empty once waves dispatched, totals self-consistent with its

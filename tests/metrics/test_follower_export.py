@@ -86,3 +86,39 @@ def test_follower_read_side_renders_through_standard_merge(tmp_path,
         [{"dp0-h0": {"num_recompiles": 1}}] +
         [s["workers"] for s in snaps])
     assert set(workers) == {"dp0-h0", "dp0-h1"}
+
+
+# ---------------------------------------------------------------------------
+# Fleet-exact process-local counters (PR 19): spawned cores export
+# pid-tagged snapshots; the merged remote views fold into /metrics.
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injection_render_folds_remote_counts():
+    from vllm_distributed_tpu.metrics.stats import \
+        render_fault_injections
+    lines = render_fault_injections(
+        {"disagg.handoff_stall": 2, "kv.spill_corrupt": 1})
+    text = "\n".join(lines)
+    assert ('vdt:fault_injections_total{point="disagg.handoff_stall"}'
+            ' 2') in text
+    assert 'point="kv.spill_corrupt"} 1' in text
+    # Remote counts ADD to any local fires at the same point.
+    from vllm_distributed_tpu.utils import fault_injection as fi
+    local = fi.counters().get("disagg.handoff_stall", 0)
+    want = f'point="disagg.handoff_stall"}} {local + 2}'
+    assert any(want in line for line in lines)
+
+
+def test_merged_qcomm_view_folds_remote_snapshot():
+    from vllm_distributed_tpu.parallel import collectives
+    transport = {"dcn_pull": {"bytes_saved": 100, "fallbacks": 0}}
+    remote = {"bytes_saved": {"dcn_pull": 40, "allgather": 7},
+              "fallbacks": {"allgather": 1}}
+    merged = collectives.merged_qcomm_view(transport, remote)
+    assert merged["dcn_pull"]["bytes_saved"] >= 140
+    assert merged["allgather"]["bytes_saved"] >= 7
+    assert merged["allgather"]["fallbacks"] >= 1
+    # Remote None degrades to the old single-process view.
+    solo = collectives.merged_qcomm_view(transport, None)
+    assert solo["dcn_pull"]["bytes_saved"] >= 100

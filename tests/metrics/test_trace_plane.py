@@ -1,0 +1,315 @@
+"""Distributed trace plane mechanics (trace_plane.py, the epoch
+rebase in metrics/events.py, and the SLO burn-rate watchdog in
+metrics/stats.py)."""
+
+import json
+
+from vllm_distributed_tpu import trace_plane as tp
+from vllm_distributed_tpu.metrics import events as ev
+from vllm_distributed_tpu.metrics.stats import BurnRateWatchdog
+
+CTX = tp.mint_trace_ctx("req-1")
+TID = CTX["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# Epoch rebase (restarted-core fresh monotonic epoch)
+# ---------------------------------------------------------------------------
+
+
+def test_rebase_identity_on_sane_timeline():
+    tl = [(100.0, ev.QUEUED, None), (100.5, ev.SCHEDULED, None),
+          (101.0, ev.FINISHED, None)]
+    assert ev.rebase_epochs(tl) == tl
+
+
+def test_rebase_shifts_restarted_epoch_forward():
+    # A core restart hands the timeline a fresh monotonic epoch: the
+    # replay events jump backward by the dead core's uptime. Sorting
+    # raw timestamps would misorder the lifecycle (satellite fix: the
+    # rebase runs BEFORE any sort).
+    tl = [(500.0, ev.QUEUED, None), (501.0, ev.ENGINE_DEATH, None),
+          (3.0, ev.JOURNAL_REPLAY, None), (4.0, ev.FINISHED, None)]
+    out = ev.rebase_epochs(tl)
+    ts = [e[0] for e in out]
+    assert ts == sorted(ts)
+    assert ts[2] > 501.0
+    # Intra-epoch spacing survives the shift.
+    assert abs((ts[3] - ts[2]) - 1.0) < 1e-6
+    # Names/details untouched, shape preserved.
+    assert [e[1] for e in out] == [e[1] for e in tl]
+    assert all(isinstance(e, tuple) for e in out)
+
+
+def test_rebase_tolerates_jitter_and_accumulates_resets():
+    # Backward jitter under the threshold is real reordering across
+    # sources, not a reset — identity.
+    tl = [(100.0, "a", None), (99.9, "b", None)]
+    assert ev.rebase_epochs(tl) == tl
+    # Restart storm: two resets accumulate, order stays monotonic.
+    tl = [(500.0, "a", None), (2.0, "b", None), (400.0, "c", None),
+          (1.0, "d", None)]
+    ts = [e[0] for e in ev.rebase_epochs(tl)]
+    assert ts == sorted(ts) and len(set(ts)) == 4
+
+
+def test_rebase_preserves_wire_list_shape():
+    tl = [[500.0, "r", ev.QUEUED, None], [2.0, "r", ev.FINISHED, None]]
+    out = ev.rebase_epochs(tl)
+    assert all(isinstance(e, list) and len(e) == 4 for e in out)
+    assert out[1][0] > out[0][0]
+
+
+# ---------------------------------------------------------------------------
+# stamp_trace
+# ---------------------------------------------------------------------------
+
+
+def test_stamp_trace_copies_and_merges():
+    detail = {"prompt_tokens": 4}
+    stamped = ev.stamp_trace(detail, CTX)
+    assert stamped[ev.TRACE_KEY] == TID
+    assert stamped["prompt_tokens"] == 4
+    assert ev.TRACE_KEY not in detail  # caller's dict untouched
+    assert ev.stamp_trace(None, CTX) == {ev.TRACE_KEY: TID}
+    assert ev.stamp_trace(detail, None) is detail
+
+
+# ---------------------------------------------------------------------------
+# TraceAssembler
+# ---------------------------------------------------------------------------
+
+
+def test_assembler_stitches_two_replicas_into_one_trace():
+    asm = tp.TraceAssembler(max_traces=8, max_spans=64)
+    asm.note_admission("req-1", CTX)
+    # Front-end event: unstamped, resolved via the rid map.
+    asm.add_event(1.0, "req-1", ev.ARRIVED, None)
+    # Producer (replica 0) and consumer (replica 1) ring events arrive
+    # stamped + replica-tagged through the get_stats drain.
+    asm.feed([[1.1, "req-1", ev.DISAGG_HANDOFF,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 0}],
+              [1.2, "req-1", ev.KV_PULL_WAIT,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}],
+              [1.3, "req-1", ev.KV_PULL_DONE,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}]])
+    t = asm.get(request_id="req-1")
+    assert t is not None and t["trace_id"] == TID
+    assert t["request_ids"] == ["req-1"]
+    assert len(t["events"]) == 4
+    # frontend (None -> -1) + replicas 0 and 1.
+    assert asm.replica_count(t) == 3
+    assert asm.get(trace_id=TID)["trace_id"] == TID
+    assert asm.get(request_id="nope") is None
+
+
+def test_assembler_span_cap_keeps_earliest_and_counts_drops():
+    asm = tp.TraceAssembler(max_traces=8, max_spans=3)
+    asm.note_admission("req-1", CTX)
+    for i in range(6):
+        asm.add_event(float(i), "req-1", ev.SCHEDULED, None)
+    t = asm.get(request_id="req-1")
+    assert [e[0] for e in t["events"]] == [0.0, 1.0, 2.0]
+    assert t["num_dropped"] == 3
+
+
+def test_assembler_evicts_oldest_and_recreates_on_stamped_event():
+    asm = tp.TraceAssembler(max_traces=2, max_spans=16)
+    ctxs = {r: tp.mint_trace_ctx(r) for r in ("a", "b", "c")}
+    for rid in ("a", "b", "c"):
+        asm.note_admission(rid, ctxs[rid])
+    assert asm.get(request_id="a") is None  # oldest evicted
+    assert asm.get(request_id="c") is not None
+    # A stamped event for the evicted trace (late consumer ring drain)
+    # recreates its bucket so stitching still works.
+    asm.add_event(9.0, "a", ev.KV_PULL_DONE,
+                  {ev.TRACE_KEY: ctxs["a"]["trace_id"],
+                   ev.REPLICA_KEY: 1})
+    t = asm.get(trace_id=ctxs["a"]["trace_id"])
+    assert t is not None and len(t["events"]) == 1
+
+
+def test_assembler_folds_anonymous_fleet_events_in_window():
+    asm = tp.TraceAssembler(max_traces=8, max_spans=64)
+    asm.note_admission("req-1", CTX)
+    asm.add_event(1.0, "req-1", ev.ARRIVED, None)
+    asm.add_event(3.0, "req-1", ev.FINISHED, None)
+    # rid="" fleet actuations: inside the window folds in, outside not.
+    asm.add_event(2.0, "", ev.FLEET_SCALE_OUT, None)
+    asm.add_event(9.0, "", ev.FLEET_SCALE_IN, None)
+    names = [e[2] for e in asm.get(request_id="req-1")["events"]]
+    assert ev.FLEET_SCALE_OUT in names
+    assert ev.FLEET_SCALE_IN not in names
+
+
+def test_dp_aggregator_rebases_replica_clocks_and_tags():
+    """Cross-process clock alignment: a subprocess replica's ring
+    events carry ITS monotonic epoch; the front-end aggregator pairs
+    the riding clock_mono with its own clock and re-bases drained
+    events into the front-end epoch, replica-tagging each one."""
+    import time
+
+    from vllm_distributed_tpu.engine.dp_client import DPEngineClient
+    dp = object.__new__(DPEngineClient)
+    dp.trace_enabled = True
+    dp._clock_offsets = {}
+    dp.clients = [object(), object()]
+    dp._down = set()
+    dp.replica_failovers = 0
+    dp.replica_resurrections = 0
+    dp.request_counts = lambda: [0, 0]
+    now = time.monotonic()
+    rep0_clock = now - 100.0  # subprocess booted 100 s "behind"
+    per = [
+        {"clock_mono": rep0_clock,
+         "timeline_events": [[rep0_clock - 0.5, "r1", ev.SCHEDULED,
+                              {ev.TRACE_KEY: TID}]]},
+        {"clock_mono": now,
+         "timeline_events": [[now - 0.2, "r2", ev.QUEUED, None]]},
+    ]
+    agg = dp._aggregate_stats(per, indices=[0, 1])
+    by_rid = {e[1]: e for e in agg["timeline_events"]}
+    # Replica 0's event lands ~0.5 s ago in the FRONT-END epoch, not
+    # 100 s in the past; the estimated offset is recorded.
+    assert abs(by_rid["r1"][0] - (now - 0.5)) < 1.0
+    assert abs(dp._clock_offsets[0] - 100.0) < 1.0
+    # Replica tags added for the assembler's pid lanes; stamps survive.
+    assert by_rid["r1"][3][ev.REPLICA_KEY] == 0
+    assert by_rid["r1"][3][ev.TRACE_KEY] == TID
+    assert by_rid["r2"][3][ev.REPLICA_KEY] == 1
+    # clock_mono is per-process bookkeeping, not a summed fleet stat.
+    assert "clock_mono" not in agg
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def _stitched_trace():
+    asm = tp.TraceAssembler(max_traces=8, max_spans=64)
+    asm.note_admission("req-1", CTX)
+    asm.add_event(1.0, "req-1", ev.ARRIVED, None)
+    asm.feed([[1.05, "req-1", ev.QUEUED,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 0}],
+              [1.1, "req-1", ev.SCHEDULED,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 0}],
+              [1.2, "req-1", ev.DISAGG_HANDOFF,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 0}],
+              [1.3, "req-1", ev.KV_PULL_WAIT,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}],
+              [1.4, "req-1", ev.KV_PULL_DONE,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}],
+              [1.5, "req-1", ev.FIRST_TOKEN,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}],
+              [1.6, "req-1", ev.FINISHED,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 1}]])
+    return asm.get(request_id="req-1")
+
+
+def test_perfetto_shape_and_flow_link():
+    out = tp.perfetto(_stitched_trace())
+    json.dumps(out)  # must be valid JSON end to end
+    evs = out["traceEvents"]
+    assert out["displayTimeUnit"] == "ms"
+    assert out["otherData"]["trace_id"] == TID
+    # Process-name metadata for frontend (-1) and both replicas.
+    meta = {e["pid"]: e["args"]["name"]
+            for e in evs if e["ph"] == "M"}
+    assert meta == {-1: "frontend", 0: "replica 0", 1: "replica 1"}
+    # The handoff flow arrow: "s" on the producer, "f" on the consumer.
+    s = [e for e in evs if e["ph"] == "s"]
+    f = [e for e in evs if e["ph"] == "f"]
+    assert len(s) == 1 and len(f) == 1
+    assert s[0]["pid"] == 0 and f[0]["pid"] == 1
+    assert s[0]["id"] == f[0]["id"] == tp._flow_id(TID)
+    assert f[0]["bp"] == "e"
+    # Instants ride component lanes; timestamps are relative µs.
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert {e["tid"] for e in instants} >= {"frontend", "scheduler",
+                                            "disagg", "kv_transfer"}
+    assert all(e["ts"] >= 0 for e in instants)
+    # Per-replica phase slices exist ("X" on the phases lane).
+    assert any(e["ph"] == "X" and e["tid"] == "phases" for e in evs)
+
+
+def test_perfetto_strips_trace_keys_from_args():
+    out = tp.perfetto(_stitched_trace())
+    for e in out["traceEvents"]:
+        args = e.get("args") or {}
+        assert ev.TRACE_KEY not in args
+        assert ev.REPLICA_KEY not in args
+
+
+def test_perfetto_flow_needs_open_handoff():
+    # A kv_pull event with no preceding handoff must NOT close a flow
+    # that never opened (monolithic pulls, replica-local recompute).
+    asm = tp.TraceAssembler(max_traces=4, max_spans=16)
+    asm.note_admission("req-1", CTX)
+    asm.feed([[1.0, "req-1", ev.KV_PULL_WAIT,
+               {ev.TRACE_KEY: TID, ev.REPLICA_KEY: 0}]])
+    out = tp.perfetto(asm.get(request_id="req-1"))
+    assert not [e for e in out["traceEvents"] if e["ph"] in ("s", "f")]
+
+
+def test_component_of_maps_lanes():
+    assert tp.component_of(ev.ROUTER_PICK) == "router"
+    assert tp.component_of(ev.DISAGG_HANDOFF) == "disagg"
+    assert tp.component_of(ev.KV_TIER_PROMOTE) == "kv_tier"
+    assert tp.component_of(ev.FLEET_SCALE_OUT) == "fleet"
+    assert tp.component_of("future_event") == "events"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rates_scale_miss_fraction_by_budget():
+    w = BurnRateWatchdog(target=0.99, threshold=2.0)  # budget = 1%
+    t0 = 1000.0
+    for i in range(90):
+        w.observe(True, now=t0 + i * 0.1)
+    for i in range(10):
+        w.observe(False, now=t0 + 9.0 + i * 0.1)
+    rates = w.burn_rates(now=t0 + 10.0)
+    assert set(rates) == {"1m", "10m"}
+    # 10% misses against a 1% budget -> burn rate ~10 in both windows.
+    assert 9.0 < rates["1m"] < 11.0
+    assert 9.0 < rates["10m"] < 11.0
+    assert w.degraded(now=t0 + 10.0)
+
+
+def test_degraded_requires_both_windows():
+    # A miss burst that has aged out of the fast window is history, not
+    # a live problem: the 1m window reads 0 -> not degraded.
+    w = BurnRateWatchdog(target=0.99, threshold=2.0)
+    t0 = 2000.0
+    for i in range(20):
+        w.observe(False, now=t0 + i * 0.1)
+    later = t0 + 120.0
+    w.observe(True, now=later)
+    rates = w.burn_rates(now=later)
+    assert rates["10m"] > 2.0
+    assert rates["1m"] < 2.0
+    assert not w.degraded(now=later)
+
+
+def test_empty_windows_and_zero_threshold():
+    w = BurnRateWatchdog(target=0.99, threshold=2.0)
+    # No traffic is not an SLO violation.
+    assert w.burn_rates(now=50.0) == {"1m": 0.0, "10m": 0.0}
+    assert not w.degraded(now=50.0)
+    # threshold <= 0 disables the degraded flag entirely.
+    off = BurnRateWatchdog(target=0.99, threshold=0.0)
+    for i in range(10):
+        off.observe(False, now=100.0 + i)
+    assert not off.degraded(now=110.0)
+
+
+def test_bins_prune_past_slow_window():
+    w = BurnRateWatchdog(target=0.99, threshold=2.0)
+    for i in range(400):
+        w.observe(True, now=1000.0 + i * 5.0)
+    # O(windows) memory: bins older than the 10m horizon are gone.
+    assert len(w._bins) <= int(w._horizon // w.BIN_S) + 2

@@ -53,6 +53,12 @@ GOOD_V5_TPU = {
     "ha_replica_timeline": [2, 1, 2], "ha_parity": True,
 }
 
+GOOD_V6_TPU = {
+    **GOOD_V5_TPU, "schema_version": 6,
+    "trace_overhead_frac": 0.011, "trace_stitched_traces": 2,
+    "trace_flow_links": 2,
+}
+
 
 def test_repo_records_are_clean():
     res = _run()
@@ -235,6 +241,46 @@ def test_v5_ha_leg_error_is_accepted(tmp_path):
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 0, res.stderr
     rec["ha_leg_error"] = ""
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+
+
+def test_good_v6_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V6_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_v6_record_without_trace_fields_fails(tmp_path):
+    rec = dict(GOOD_V6_TPU)
+    del rec["trace_stitched_traces"]
+    del rec["trace_flow_links"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "trace_stitched_traces" in res.stderr
+    assert "trace_flow_links" in res.stderr
+
+
+def test_v6_overhead_above_budget_fails(tmp_path):
+    # The ISSUE 19 acceptance bound: the trace plane may cost at most
+    # 3% of decode steps/s; a hotter capture is a regression.
+    _write(tmp_path, "BENCH_x.json",
+           dict(GOOD_V6_TPU, trace_overhead_frac=0.08))
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "trace_overhead_frac" in res.stderr
+
+
+def test_v6_trace_leg_error_is_accepted(tmp_path):
+    rec = {k: v for k, v in GOOD_V6_TPU.items()
+           if not k.startswith("trace_")}
+    rec["trace_leg_error"] = "RuntimeError: needs >= 2 devices"
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    rec["trace_leg_error"] = ""
     _write(tmp_path, "BENCH_x.json", rec)
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 1

@@ -1,0 +1,129 @@
+"""CI guard: the lifecycle event vocabulary stays documented.
+
+Runs scripts/lint_events.py over the real package + README (tier-1
+mechanical check: every EVENT_REGISTRY entry has a README row between
+the lint-events markers and every record site uses a constant) and
+unit-tests the linter's failure modes on synthetic trees."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "lint_events.py"
+
+GOOD_EVENTS = '''\
+QUEUED = "queued"
+FINISHED = "finished"
+
+EVENT_REGISTRY = {
+    QUEUED: "request admitted to the scheduler queue",
+    FINISHED: "request finished",
+}
+
+DETAIL_KEY = "tr"
+'''
+
+GOOD_README = """\
+# pkg
+
+<!-- lint-events:begin -->
+| event | meaning |
+|---|---|
+| `queued` | admitted |
+| `finished` | done |
+<!-- lint-events:end -->
+"""
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, str(SCRIPT), *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _tree(tmp_path, events: str = GOOD_EVENTS,
+          readme: str = GOOD_README, extra: str = ""):
+    pkg = tmp_path / "pkg"
+    (pkg / "metrics").mkdir(parents=True, exist_ok=True)
+    (pkg / "metrics" / "events.py").write_text(events)
+    if extra:
+        (pkg / "recorder_site.py").write_text(extra)
+    readme_path = tmp_path / "README.md"
+    readme_path.write_text(readme)
+    return pkg, readme_path
+
+
+def test_package_events_are_documented():
+    res = _run()
+    assert res.returncode == 0, (
+        f"vdt: event documentation drifted:\n{res.stderr}")
+
+
+def test_clean_tree_passes(tmp_path):
+    pkg, readme = _tree(tmp_path)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr
+
+
+def test_unregistered_constant_is_caught(tmp_path):
+    events = GOOD_EVENTS.replace(
+        'FINISHED = "finished"',
+        'FINISHED = "finished"\nSNEAKY = "sneaky"')
+    pkg, readme = _tree(tmp_path, events=events)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 1
+    assert "SNEAKY" in res.stderr
+    assert "missing from EVENT_REGISTRY" in res.stderr
+
+
+def test_constants_below_registry_are_not_vocabulary(tmp_path):
+    # DETAIL_KEY sits below the registry literal in the fixture: detail
+    # keys and thresholds must not be mistaken for event names.
+    pkg, readme = _tree(tmp_path)
+    res = _run("--package", str(pkg), "--readme", str(readme))
+    assert res.returncode == 0, res.stderr
+    assert "DETAIL_KEY" not in res.stderr
+
+
+def test_missing_readme_row_is_caught(tmp_path):
+    readme = GOOD_README.replace("| `finished` | done |\n", "")
+    pkg, readme_path = _tree(tmp_path, readme=readme)
+    res = _run("--package", str(pkg), "--readme", str(readme_path))
+    assert res.returncode == 1
+    assert "finished" in res.stderr
+    assert "missing from the README events table" in res.stderr
+
+
+def test_orphaned_readme_row_is_caught(tmp_path):
+    readme = GOOD_README.replace(
+        "| `finished` | done |", "| `finished` | done |\n"
+        "| `ghost_event` | no constant declares me |")
+    pkg, readme_path = _tree(tmp_path, readme=readme)
+    res = _run("--package", str(pkg), "--readme", str(readme_path))
+    assert res.returncode == 1
+    assert "ghost_event" in res.stderr
+    assert "orphaned row" in res.stderr
+
+
+def test_missing_markers_is_caught(tmp_path):
+    pkg, readme_path = _tree(
+        tmp_path, readme="# pkg\n\n| `queued` | x |\n")
+    res = _run("--package", str(pkg), "--readme", str(readme_path))
+    assert res.returncode == 1
+    assert "lint-events:begin" in res.stderr
+
+
+def test_literal_record_site_is_caught(tmp_path):
+    pkg, readme_path = _tree(
+        tmp_path,
+        extra='def f(r, rid):\n    r.record(rid, "queued", None)\n')
+    res = _run("--package", str(pkg), "--readme", str(readme_path))
+    assert res.returncode == 1
+    assert "raw string literal" in res.stderr
+    # ...while a constant reference at the same site is fine.
+    pkg, readme_path = _tree(
+        tmp_path,
+        extra='def f(r, rid, ev):\n'
+              '    r.record(rid, ev.QUEUED, None)\n')
+    res = _run("--package", str(pkg), "--readme", str(readme_path))
+    assert res.returncode == 0, res.stderr

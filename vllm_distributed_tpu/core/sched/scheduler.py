@@ -352,6 +352,12 @@ class Scheduler:
         # re-reads os.environ per access).
         self.events = ev.EventRecorder()
         self.events_enabled = self.events.enabled
+        # Distributed trace plane: when on, every recorded event detail
+        # carries the request's trace id so the front-end assembler can
+        # stitch this replica's spans into the fleet-wide causal trace.
+        # Cached like events_enabled; off means zero stamping work and
+        # byte-identical event details.
+        self.trace_enabled = ev.trace_plane_enabled()
         # Batch composition of the most recent non-empty step (gauges).
         self.last_step_prefill_tokens = 0
         self.last_step_decode_tokens = 0
@@ -369,6 +375,8 @@ class Scheduler:
         if not self.events_enabled and not force:
             return
         ts = time.monotonic()
+        if self.trace_enabled and request.trace_ctx is not None:
+            detail = ev.stamp_trace(detail, request.trace_ctx)
         request.events.append((ts, event, detail))
         if self.events_enabled:
             self.events.record(request.request_id, event, detail, ts=ts)
@@ -1037,6 +1045,10 @@ class Scheduler:
                             keys=[h[0] for h in hits],
                             tiers=[h[1] for h in hits],
                             arrays=[(h[2], h[3]) for h in hits]))
+                        self._record_event(
+                            request, ev.KV_TIER_PROMOTE,
+                            {"pages": len(hits),
+                             "tiers": sorted({h[1] for h in hits})})
                 if self.state_cache is not None:
                     # This grant rewrites the recurrence from
                     # `num_computed_tokens`; any uncommitted park of an
@@ -1158,6 +1170,11 @@ class Scheduler:
             output.kv_demotes = self.kv_tier.take_demotes(
                 bool(num_scheduled_tokens))
             output.kv_promotes = kv_promotes or None
+            if self.events_enabled and output.kv_demotes:
+                # Page-level batch (no single owner request): rid="".
+                self.events.record(
+                    "", ev.KV_TIER_DEMOTE,
+                    {"pages": len(output.kv_demotes.page_ids)})
         self.finished_req_ids = set()
         if self.kv_connector is not None:
             output.kv_connector_metadata = \

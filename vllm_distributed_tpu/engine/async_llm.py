@@ -468,6 +468,8 @@ class AsyncLLM:
         events = stats.pop("timeline_events", None)
         if events:
             self.output_processor.core_events.absorb(events)
+            if self.output_processor.assembler is not None:
+                self.output_processor.assembler.feed(events)
         return stats
 
     async def get_debug_state(self) -> dict:

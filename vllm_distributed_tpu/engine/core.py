@@ -24,6 +24,7 @@ dispatch half enqueues programs without blocking; the queue's job is to
 keep the host from blocking and the scheduler's grant state coherent.
 """
 
+import os
 import time
 from collections import deque
 from contextlib import nullcontext
@@ -510,6 +511,25 @@ class EngineCore:
                 self.scheduler.events.drain(), self.events.drain())
         stats["timeline_events_dropped"] = (
             self.scheduler.events.num_dropped + self.events.num_dropped)
+        # Cross-process clock alignment (trace plane): this process's
+        # monotonic reading at snapshot time. The front-end aggregator
+        # pairs it with its own clock to estimate a per-replica offset
+        # and re-base drained events into the front-end's epoch.
+        stats["clock_mono"] = time.monotonic()
+        # Process-local counter snapshots, pid-tagged so the front-end
+        # merge can dedup in-process cores (which share the front-end's
+        # registries) and sum only true follower processes — the fix
+        # for the fleet-inexact vdt:fault_injections_total /
+        # vdt:qcomm_* noted since PR 9.
+        from vllm_distributed_tpu.parallel import collectives
+        pid = os.getpid()
+        counts = fault_injection.counters()
+        if counts:
+            stats["fault_injection_counts"] = {"pid": pid,
+                                               "counts": counts}
+        traced = collectives.traced_snapshot()
+        if traced["bytes_saved"] or traced["fallbacks"]:
+            stats["qcomm_traced"] = {"pid": pid, **traced}
         return stats
 
     def get_debug_state(self) -> dict:

@@ -433,6 +433,17 @@ class DisaggCoordinator:
         self._stage[rid] = DECODE_POOL
         self._t0[rid] = time.monotonic()
         self.handoffs += 1
+        recorder = getattr(client, "events", None)
+        if recorder is not None and recorder.enabled:
+            # The producer->consumer causal link: this event (stamped
+            # with the request's trace id, tagged with the producer
+            # replica) is where the Perfetto export opens its flow
+            # arrow; the decode home's kv_pull span closes it.
+            detail: dict = {"from_replica": owner,
+                            "pull": params is not None}
+            if getattr(client, "trace_enabled", False):
+                detail = ev.stamp_trace(detail, orig.trace_ctx)
+            recorder.record(rid, ev.DISAGG_HANDOFF, detail)
         client._admit(req)
 
     def _observe_decode_output(self, out) -> None:

@@ -22,6 +22,7 @@ hosts and keeps replicas isolated on TPU hosts.
 """
 
 import copy
+import os
 import queue
 import threading
 import time
@@ -35,6 +36,7 @@ from vllm_distributed_tpu.engine.core_client import (EngineCoreClient,
                                                      RestartSupervisor,
                                                      SyncMPClient)
 from vllm_distributed_tpu.logger import init_logger
+from vllm_distributed_tpu.metrics import events as ev
 from vllm_distributed_tpu.request import (EngineCoreRequest,
                                           continuation_request)
 
@@ -129,6 +131,21 @@ class DPEngineClient(EngineCoreClient):
         self.disagg = None
         if disagg_plan is not None:
             self.disagg = DisaggCoordinator(self, config)
+        # Front-end lifecycle recorder (metrics/events.py): placement
+        # decisions and disagg handoffs, drained into the fleet-wide
+        # timeline merge next to the per-core rings and fleet events.
+        self.events = ev.EventRecorder()
+        # Trace plane: replica-tag + clock-rebase drained core rings in
+        # _aggregate_stats so the front-end assembler can stitch them.
+        # Cached once (the envs registry re-reads os.environ); off
+        # leaves every drained event byte-identical.
+        self.trace_enabled = ev.trace_plane_enabled()
+        # Per-replica monotonic clock offsets (front-end epoch −
+        # replica epoch), estimated from the clock_mono reading riding
+        # each get_stats response. In-process replicas share the clock
+        # (offset ≈ the aggregation delay); subprocess offsets are
+        # upper-bounded by one RPC latency.
+        self._clock_offsets: dict[int, float] = {}
         # Balancer state: request ownership + live counts per replica
         # (the coordinator's published queue lengths, client-side).
         self._owner: dict[str, int] = {}
@@ -347,6 +364,19 @@ class DPEngineClient(EngineCoreClient):
                 raise
             self._owner[request.request_id] = i
             self._live[i].add(request.request_id)
+            if self.events.enabled:
+                # The routing decision, on the request's causal trace:
+                # which replica (and disagg stage, when split) this hop
+                # landed on.
+                detail: dict = {"replica": i}
+                if self.disagg is not None:
+                    stage = self.disagg._stage.get(request.request_id)
+                    if stage is not None:
+                        detail["pool"] = stage
+                if self.trace_enabled:
+                    detail = ev.stamp_trace(detail, request.trace_ctx)
+                self.events.record(request.request_id, ev.ROUTER_PICK,
+                                   detail)
             if self.router is not None:
                 # Residency bookkeeping: the request's prompt pages will
                 # live (and prefix-cache) on this replica. Migrated
@@ -858,6 +888,28 @@ class DPEngineClient(EngineCoreClient):
             # never polled by the control loop itself).
             for i, stats in zip(indices, per):
                 fleet.observe_stats(i, stats)
+        if getattr(self, "trace_enabled", False) and indices is not None:
+            # Cross-process clock alignment: each replica's clock_mono
+            # reading pairs with the front-end clock sampled here. The
+            # estimate over-corrects by up to one RPC latency
+            # (in-process replicas share the clock, so ~0); drained
+            # ring events re-base into the front-end epoch and are
+            # replica-tagged so the trace assembler knows which pid
+            # lane each span belongs to.
+            offsets = getattr(self, "_clock_offsets", {})
+            now = time.monotonic()
+            for i, stats in zip(indices, per):
+                cm = stats.get("clock_mono")
+                if isinstance(cm, (int, float)):
+                    offsets[i] = now - cm
+                evs = stats.get("timeline_events")
+                if evs:
+                    off = offsets.get(i, 0.0)
+                    stats["timeline_events"] = [
+                        [e[0] + off, e[1], e[2],
+                         {**(e[3] if isinstance(e[3], dict) else {}),
+                          ev.REPLICA_KEY: i}]
+                        for e in evs]
         agg: dict = {"dp_size": len(self.clients),
                      "dp_request_counts": self.request_counts(),
                      "dp_replicas": per,
@@ -879,6 +931,8 @@ class DPEngineClient(EngineCoreClient):
         max_gauges = ("max_concurrent_batches", )
         for stats in per:
             for k, v in stats.items():
+                if k == "clock_mono":
+                    continue  # per-process clock reading, not a stat
                 if k in max_gauges:
                     agg[k] = max(agg.get(k, 0), v)
                 elif isinstance(v, (int, float)):
@@ -1025,11 +1079,43 @@ class DPEngineClient(EngineCoreClient):
             if promo is not None:
                 merged_tier["promotion_seconds"] = promo
             agg["kv_tier"] = merged_tier
-        # Lifecycle timelines: one fleet-wide event stream, time-sorted.
+        # Follower-process counter snapshots (pid-tagged by each core's
+        # get_stats): merge once per distinct follower pid, excluding
+        # this process — in-process cores share the front-end's
+        # process-global registries, so summing them would double-count
+        # what render_fault_injections / merged_qcomm_view already read
+        # locally. The merged remote view makes /metrics fleet-exact.
+        merged_fi: dict = {}
+        merged_qc: dict = {"bytes_saved": {}, "fallbacks": {}}
+        seen_fi = {os.getpid()}
+        seen_qc = {os.getpid()}
+        for s in per:
+            snap = s.get("fault_injection_counts")
+            if (isinstance(snap, dict) and snap.get("pid") not in seen_fi
+                    and isinstance(snap.get("counts"), dict)):
+                seen_fi.add(snap["pid"])
+                for k, v in snap["counts"].items():
+                    merged_fi[k] = merged_fi.get(k, 0) + int(v)
+            snap = s.get("qcomm_traced")
+            if isinstance(snap, dict) and snap.get("pid") not in seen_qc:
+                seen_qc.add(snap.get("pid"))
+                for fam in ("bytes_saved", "fallbacks"):
+                    for k, v in (snap.get(fam) or {}).items():
+                        dst = merged_qc[fam]
+                        dst[k] = dst.get(k, 0) + int(v)
+        if merged_fi:
+            agg["fault_injection_counts_remote"] = merged_fi
+        if merged_qc["bytes_saved"] or merged_qc["fallbacks"]:
+            agg["qcomm_traced_remote"] = merged_qc
+        # Lifecycle timelines: one fleet-wide event stream, time-sorted
+        # (per-core rings, the fleet controller's actuations, and the
+        # front-end's own placement/handoff ring).
         from vllm_distributed_tpu.metrics.events import merge_event_lists
         events = merge_event_lists(
             *(s.get("timeline_events") or [] for s in per),
-            *([fleet.drain_events()] if fleet is not None else []))
+            *([fleet.drain_events()] if fleet is not None else []),
+            *([getattr(self, "events", None).drain()]
+              if getattr(self, "events", None) is not None else []))
         if events:
             agg["timeline_events"] = events
         # Routing tier: ONE router instance owns the whole fleet's
@@ -1053,13 +1139,16 @@ class DPEngineClient(EngineCoreClient):
         return self._aggregate_stats(
             [self.clients[i].get_stats() for i in alive], indices=alive)
 
-    def observe_goodput(self, fracs: dict) -> None:
+    def observe_goodput(self, fracs: dict,
+                        degraded: bool = False) -> None:
         """Per-tenant goodput feed (metrics/stats.py FrontendStats SLO
         scoring, wired from the entrypoints' stats path) into the
-        fleet's VDT_FLEET_SIGNALS scale decision. No-op without a
-        fleet controller."""
+        fleet's VDT_FLEET_SIGNALS scale decision. ``degraded`` is the
+        burn-rate watchdog's sustained-burn flag, offered as scale-up
+        pressure on the same channel. No-op without a fleet
+        controller."""
         if self.fleet is not None and isinstance(fracs, dict):
-            self.fleet.observe_goodput(fracs)
+            self.fleet.observe_goodput(fracs, degraded=degraded)
 
     def shutdown(self) -> None:
         if self.fleet is not None:

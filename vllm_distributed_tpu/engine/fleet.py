@@ -195,14 +195,24 @@ class FleetController:
                 # output path's own poll surfaces it for failover.
                 pass
 
-    def observe_goodput(self, fracs: dict) -> None:
+    def observe_goodput(self, fracs: dict,
+                        degraded: bool = False) -> None:
         """Per-tenant goodput fractions (metrics/stats.py FrontendStats
         SLO scoring, fed through the entrypoints' stats path). Only
-        consulted when VDT_FLEET_SIGNALS is on."""
+        consulted when VDT_FLEET_SIGNALS is on. ``degraded`` — the SLO
+        burn-rate watchdog's sustained-burn flag — registers as a
+        zero-goodput pseudo-tenant, so under VDT_FLEET_SIGNALS with a
+        goodput floor it counts as scale-out pressure and a scale-in
+        veto exactly like a starved tenant; it clears as soon as the
+        burn subsides."""
         if isinstance(fracs, dict):
             for tenant, frac in fracs.items():
                 if isinstance(frac, (int, float)):
                     self._goodput[str(tenant)] = float(frac)
+        if degraded:
+            self._goodput["_slo_burn"] = 0.0
+        else:
+            self._goodput.pop("_slo_burn", None)
 
     def _freeze(self, reason: str) -> None:
         self.freezes[reason] = self.freezes.get(reason, 0) + 1

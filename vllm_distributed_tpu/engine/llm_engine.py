@@ -97,6 +97,8 @@ class LLMEngine:
         events = stats.pop("timeline_events", None)
         if events:
             self.output_processor.core_events.absorb(events)
+            if self.output_processor.assembler is not None:
+                self.output_processor.assembler.feed(events)
         return stats
 
     def sleep(self, level: int = 1) -> int:

@@ -50,6 +50,10 @@ class RequestState:
     # the core-side events riding each EngineCoreOutput. Stitched into
     # phase child spans when the request finishes.
     timeline: list[tuple] = field(default_factory=list)
+    # Trace context minted at admission (None when the trace plane is
+    # off): stamped onto front-end events so the assembler can resolve
+    # them even after its request-id map evicts.
+    trace_ctx: Optional[dict] = None
 
 
 @dataclass
@@ -77,6 +81,15 @@ class OutputProcessor:
         # finished request).
         self.stats.slo_ttft_ms = envs.VDT_SLO_TTFT_MS
         self.stats.slo_tpot_ms = envs.VDT_SLO_TPOT_MS
+        # Burn-rate watchdog over the goodput plane: only meaningful
+        # when at least one SLO target is set (otherwise every request
+        # scores good and the burn rate is identically zero).
+        if self.stats.slo_enabled:
+            from vllm_distributed_tpu.metrics.stats import \
+                BurnRateWatchdog
+            self.stats.burn = BurnRateWatchdog(
+                target=envs.VDT_SLO_TARGET,
+                threshold=envs.VDT_SLO_BURN_THRESHOLD)
         # Per-tenant goodput accounting (vdt:tenant_goodput_frac) rides
         # the QoS plane: bucketing shares qos.bucket_tenant with the
         # scheduler so both label spaces stay bounded and agree. Read
@@ -99,6 +112,14 @@ class OutputProcessor:
         # /debug recent-events view spans every component. Always
         # enabled: absorption only happens when recording was on.
         self.core_events = ev.EventRecorder(enabled=True)
+        # Fleet-wide causal trace assembly (VDT_TRACE_PLANE): front-end
+        # events feed it directly; core/router events arrive via the
+        # get_stats drain (already clock-rebased and replica-tagged by
+        # the DP client when running multi-replica).
+        self.assembler = None
+        if ev.trace_plane_enabled():
+            from vllm_distributed_tpu.trace_plane import TraceAssembler
+            self.assembler = TraceAssembler()
         # Completed per-phase durations (seconds) for percentile
         # reporting; bounded FIFO per phase.
         self.phase_durations: dict[str, list[float]] = {}
@@ -125,20 +146,33 @@ class OutputProcessor:
             detokenizer=detok,
             times=RequestTimes(arrival=arrival),
             tenant=tenant,
+            trace_ctx=request.trace_ctx,
         )
+        if self.assembler is not None and request.trace_ctx is not None:
+            self.assembler.note_admission(request.request_id,
+                                          request.trace_ctx)
         if self.timeline_enabled:
             state.timeline.append((arrival, ev.ARRIVED, None))
-            self.events.record(request.request_id, ev.ARRIVED,
-                               {"prompt_tokens":
-                                len(request.prompt_token_ids)},
+            detail = {"prompt_tokens": len(request.prompt_token_ids)}
+            if state.trace_ctx is not None:
+                detail = ev.stamp_trace(detail, state.trace_ctx)
+            self.events.record(request.request_id, ev.ARRIVED, detail,
                                ts=arrival)
+            if self.assembler is not None:
+                self.assembler.add_event(arrival, request.request_id,
+                                         ev.ARRIVED, detail)
         self.request_states[request.request_id] = state
 
     def abort_requests(self, request_ids: list[str]) -> None:
         for req_id in request_ids:
             state = self.request_states.pop(req_id, None)
             if state is not None and self.timeline_enabled:
-                self.events.record(req_id, ev.ABORTED, None)
+                detail = ev.stamp_trace(None, state.trace_ctx)
+                self.events.record(req_id, ev.ABORTED, detail)
+                if self.assembler is not None:
+                    import time as _time
+                    self.assembler.add_event(_time.monotonic(), req_id,
+                                             ev.ABORTED, detail)
 
     def record_event(self, request_id: str, event: str,
                      detail: Optional[dict] = None) -> None:
@@ -152,7 +186,11 @@ class OutputProcessor:
         state = self.request_states.get(request_id)
         if state is not None:
             state.timeline.append((ts, event, detail))
+            if state.trace_ctx is not None:
+                detail = ev.stamp_trace(detail, state.trace_ctx)
         self.events.record(request_id, event, detail, ts=ts)
+        if self.assembler is not None:
+            self.assembler.add_event(ts, request_id, event, detail)
 
     def _finish_timeline(self, state: RequestState,
                          event: str = ev.FINISHED
@@ -165,12 +203,22 @@ class OutputProcessor:
             return None
         import time as _time
         now = _time.monotonic()
-        state.timeline.append((now, event,
-                               {"reason": state.finish_reason}))
-        # Sort a COPY and swap it in (_emit_span reuses it): the
-        # AsyncLLM pump thread may append ENGINE_DEATH concurrently,
-        # and an in-place sort of a mutating list raises ValueError.
-        state.timeline = sorted(state.timeline, key=lambda e: e[0])
+        detail = {"reason": state.finish_reason}
+        state.timeline.append((now, event, detail))
+        if self.assembler is not None:
+            self.assembler.add_event(
+                now, state.request_id, event,
+                ev.stamp_trace(detail, state.trace_ctx))
+        # Re-base BEFORE sorting: events absorbed from a restarted core
+        # carry a fresh monotonic epoch (timestamps behind the old
+        # core's by its whole uptime) — sorting raw would interleave
+        # the replayed lifecycle into the pre-death one and phase math
+        # would go negative. Then sort a COPY and swap it in
+        # (_emit_span reuses it): the AsyncLLM pump thread may append
+        # ENGINE_DEATH concurrently, and an in-place sort of a
+        # mutating list raises ValueError.
+        state.timeline = sorted(ev.rebase_epochs(state.timeline),
+                                key=lambda e: e[0])
         phases = ev.phases_from_timeline(state.timeline, now=now)
         for name, dur in ev.phase_durations(phases).items():
             bank = self.phase_durations.setdefault(name, [])
@@ -284,7 +332,7 @@ class OutputProcessor:
             t0 = state.timeline[0][0]
             events = [[round(ts - t0, 6), event, detail]
                       for ts, event, detail in state.timeline]
-        self.tracer.emit({
+        attrs = {
             SA.GEN_AI_REQUEST_ID: state.request_id,
             SA.GEN_AI_REQUEST_MAX_TOKENS: state.params.max_tokens,
             SA.GEN_AI_REQUEST_TEMPERATURE: state.params.temperature,
@@ -296,7 +344,10 @@ class OutputProcessor:
                  if t and t.first_token is not None else None),
             SA.GEN_AI_LATENCY_E2E: (now - t.arrival) if t else None,
             SA.GEN_AI_RESPONSE_FINISH_REASON: state.finish_reason,
-        }, phases=phases, events=events)
+        }
+        if state.trace_ctx is not None:
+            attrs[SA.GEN_AI_TRACE_ID] = state.trace_ctx.get("trace_id")
+        self.tracer.emit(attrs, phases=phases, events=events)
 
     def _make_request_output(self, state: RequestState) -> RequestOutput:
         text = (state.detokenizer.output_text

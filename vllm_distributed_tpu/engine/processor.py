@@ -76,6 +76,12 @@ class Processor:
         # Per-INSTANCE memo (a class-level dict would collide across
         # engines serving different checkpoints in one process).
         self._enc_text_cache: dict = {}
+        # Cached once: process_inputs sits on the per-request hot path
+        # and must not re-read the environment per call.
+        from vllm_distributed_tpu import trace_plane
+        from vllm_distributed_tpu.metrics import events as ev
+        self.trace_enabled = ev.trace_plane_enabled()
+        self._mint_trace_ctx = trace_plane.mint_trace_ctx
         self.eos_token_id: Optional[int] = None
         if tokenizer is not None:
             self.eos_token_id = tokenizer.eos_token_id
@@ -229,6 +235,10 @@ class Processor:
             lora_request=lora_request,
             pooling_params=pooling_params,
             mm_inputs=mm_inputs,
+            # Minted at admission so every downstream event (router,
+            # scheduler, disagg handoff, replay) shares one trace id.
+            trace_ctx=(self._mint_trace_ctx(request_id)
+                       if self.trace_enabled else None),
         )
 
     def _process_audio(self, multi_modal_data: dict,

@@ -32,7 +32,7 @@ def unpack(data: bytes) -> Any:
 def encode_request(req: EngineCoreRequest) -> dict:
     sp = asdict(req.sampling_params)
     sp.pop("_all_stop_token_ids", None)
-    return {
+    d = {
         "request_id": req.request_id,
         "prompt_token_ids": req.prompt_token_ids,
         "sampling_params": sp,
@@ -50,6 +50,15 @@ def encode_request(req: EngineCoreRequest) -> dict:
             "offset": m.offset,
         } for m in req.mm_inputs] if req.mm_inputs else None),
     }
+    # Additive wire key, emitted ONLY when a trace context exists:
+    # with VDT_TRACE_PLANE=0 nothing mints one, so the encoded map (and
+    # its msgpack bytes) are byte-identical to the pre-trace-plane
+    # wire. Old decoders construct from known keys and ignore extras,
+    # so a trace-stamped request is also accepted by a pre-trace-plane
+    # peer (tolerance pinned by tests/engine/test_serial_trace.py).
+    if req.trace_ctx is not None:
+        d["trace_ctx"] = req.trace_ctx
+    return d
 
 
 def decode_request(d: dict) -> EngineCoreRequest:
@@ -64,6 +73,8 @@ def decode_request(d: dict) -> EngineCoreRequest:
         kv_transfer_params=d["kv_transfer_params"],
         lora_request=d.get("lora_request"),
         pooling_params=d.get("pooling_params"),
+        # .get(): absent on the pre-trace-plane wire (old peer).
+        trace_ctx=d.get("trace_ctx"),
         mm_inputs=([
             MultiModalInput(
                 embeds=np.frombuffer(m["embeds"],

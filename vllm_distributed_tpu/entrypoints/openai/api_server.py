@@ -191,6 +191,14 @@ async def health(request: web.Request) -> web.Response:
     if engine.errored:
         return web.Response(status=503,
                             text=f"engine dead: {engine.dead_error}")
+    # SLO burn-rate verdict: alive-but-degraded stays 200 (the probe
+    # must not take a burning server out of rotation — autoscaling
+    # reacts via the fleet hint), but the body flags it for operators
+    # and external watchdogs.
+    burn = getattr(getattr(getattr(engine, "output_processor", None),
+                           "stats", None), "burn", None)
+    if burn is not None and burn.degraded():
+        return web.Response(text="OK (slo degraded)")
     return web.Response(text="OK")
 
 
@@ -218,18 +226,25 @@ async def metrics(request: web.Request) -> web.Response:
     # v1/metrics/loggers.py:143 PrometheusStatLogger families).
     processor = getattr(engine, "output_processor", None)
     if processor is not None:
-        text += processor.stats.render()
+        # Follower-process counter snapshots (pid-deduped by the DP
+        # aggregator) fold into the front-end's render so /metrics is
+        # fleet-exact, not process-local.
+        text += processor.stats.render(
+            fault_extra=stats.get("fault_injection_counts_remote"))
         # Per-tenant goodput feed into the fleet controller's richer
         # scaling signals (VDT_FLEET_SIGNALS): the front-end's SLO
         # scoring is the only place goodput exists, and the scrape is
         # its natural cadence. getattr-guarded — only the DP client
-        # grows observe_goodput.
+        # grows observe_goodput. A degraded burn-rate verdict rides the
+        # same feed as a scale-up hint.
         feed = getattr(getattr(engine, "engine_core", None),
                        "observe_goodput", None)
         slo = getattr(processor.stats, "slo_by_tenant", None)
         if feed is not None and slo:
+            burn = getattr(processor.stats, "burn", None)
             feed({t: good / max(scored, 1)
-                  for t, (scored, good) in list(slo.items())})
+                  for t, (scored, good) in list(slo.items())},
+                 degraded=(burn is not None and burn.degraded()))
     ctrl = request.app.get(ADMISSION_KEY)
     if ctrl is not None and ctrl.enabled:
         text += (
@@ -314,7 +329,12 @@ async def _debug_requests_json(engine: AsyncLLM) -> dict:
             core_reqs[entry["request_id"]] = entry
     requests = []
     for rid, state in list(engine.output_processor.request_states.items()):
-        timeline = sorted(state.timeline, key=lambda e: e[0])
+        # Re-base epoch resets (restarted core = fresh monotonic clock)
+        # in arrival order BEFORE sorting — a raw sort interleaves the
+        # replayed lifecycle into the pre-death one and both the phase
+        # math and current_phase misreport the request.
+        timeline = sorted(ev.rebase_epochs(state.timeline),
+                          key=lambda e: e[0])
         phases = ev.phases_from_timeline(timeline, now=now)
         times = state.times
         entry = {
@@ -399,7 +419,16 @@ async def _debug_engine_json(app: web.Application) -> dict:
     from vllm_distributed_tpu.parallel import collectives
     qcomm = collectives.merged_qcomm_view(
         (transport or {}).get("qcomm")
-        if isinstance(transport, dict) else None)
+        if isinstance(transport, dict) else None,
+        stats.get("qcomm_traced_remote"))
+    burn = getattr(engine.output_processor.stats, "burn", None)
+    slo = None
+    if burn is not None:
+        slo = {"burn_rates": {w: round(r, 4)
+                              for w, r in burn.burn_rates().items()},
+               "degraded": burn.degraded(),
+               "target": burn.target,
+               "threshold": burn.threshold}
     return {
         "supervisor": engine.supervisor_state(),
         "engine_cores": schedulers,
@@ -411,6 +440,8 @@ async def _debug_engine_json(app: web.Application) -> dict:
         "num_waiting_reqs": stats.get("num_waiting_reqs"),
         "inflight_batches": stats.get("inflight_batches"),
         "admission": admission,
+        # SLO burn-rate watchdog (None when no SLO target is set).
+        "slo_burn": slo,
         # Front-end ledger merged with the core-side events absorbed
         # from /metrics scrapes (the draining stats consumer).
         "recent_events": ev.merge_event_lists(
@@ -543,6 +574,38 @@ async def debug_engine(request: web.Request) -> web.Response:
     """Live engine state: scheduler queues, batch pipeline, KV usage,
     restart-supervisor state, admission watermarks."""
     return web.json_response(await _debug_engine_json(request.app))
+
+
+async def debug_trace(request: web.Request) -> web.Response:
+    """One stitched causal trace as Chrome/Perfetto trace-event JSON
+    (``?request_id=`` or ``?trace_id=``; ``?format=raw`` for the
+    un-rendered event list, no params lists known trace ids). Requires
+    VDT_TRACE_PLANE=1; the stats poll below drains any core-ring
+    events not yet fed to the assembler so a trace requested right
+    after a request finishes is already complete."""
+    from vllm_distributed_tpu import trace_plane
+    engine = request.app[ENGINE_KEY]
+    assembler = getattr(getattr(engine, "output_processor", None),
+                        "assembler", None)
+    if assembler is None:
+        return web.json_response(
+            {"error": "trace plane disabled (set VDT_TRACE_PLANE=1)"},
+            status=404)
+    try:
+        await asyncio.wait_for(engine.get_stats(), timeout=2.0)
+    except Exception:  # noqa: BLE001 - engine busy/dead; serve cached
+        pass
+    rid = request.query.get("request_id")
+    tid = request.query.get("trace_id")
+    if not rid and not tid:
+        return web.json_response({"trace_ids": assembler.trace_ids()})
+    trace = assembler.get(request_id=rid, trace_id=tid)
+    if trace is None:
+        return web.json_response(
+            {"error": f"no trace for {rid or tid!r}"}, status=404)
+    if request.query.get("format") == "raw":
+        return web.json_response(trace)
+    return web.json_response(trace_plane.perfetto(trace))
 
 
 def _thread_stacks() -> str:
@@ -1578,6 +1641,7 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_get("/debug/engine", debug_engine)
     app.router.add_get("/debug/kv_cache", debug_kv_cache)
     app.router.add_get("/debug/perf", debug_perf)
+    app.router.add_get("/debug/trace", debug_trace)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)

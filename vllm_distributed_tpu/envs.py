@@ -521,6 +521,38 @@ environment_variables: dict[str, Callable[[], Any]] = {
     # between legs of one process.
     "VDT_TRANSPORT_TELEMETRY":
     lambda: os.getenv("VDT_TRANSPORT_TELEMETRY", "1") == "1",
+    # --- Distributed trace plane (trace_plane.py) -----------------------
+    # Master switch: "1" mints a trace_id + parent-span context at
+    # admission, carries it on EngineCoreRequest over the msgpack wire
+    # (old-wire tolerant), stamps it onto every EventRecorder event, and
+    # hosts the front-end TraceAssembler + bounded flight recorder +
+    # GET /debug/trace Perfetto export. "0" (default) mints nothing and
+    # stamps nothing — the wire bytes and event details are
+    # byte-identical to the pre-trace-plane behavior. Read ONCE per
+    # component at construction.
+    "VDT_TRACE_PLANE":
+    lambda: os.getenv("VDT_TRACE_PLANE", "0") == "1",
+    # Flight-recorder bound: max distinct traces the assembler retains
+    # (oldest-admitted evicted past the bound) and max spans kept per
+    # trace (earliest kept — a trace's causal root matters most).
+    "VDT_TRACE_MAX_TRACES":
+    lambda: max(8, int(os.getenv("VDT_TRACE_MAX_TRACES", "256"))),
+    "VDT_TRACE_MAX_SPANS":
+    lambda: max(16, int(os.getenv("VDT_TRACE_MAX_SPANS", "512"))),
+    # --- SLO burn-rate watchdog (metrics/stats.py) ----------------------
+    # Burn-rate threshold: a window burns when its miss rate exceeds
+    # threshold * (1 - VDT_SLO_TARGET) (the error budget). The watchdog
+    # runs whenever SLO targets are configured; DEGRADED (both the fast
+    # and slow window burning) surfaces in /health + /debug/engine and
+    # is offered to VDT_FLEET_SIGNALS as scale-out pressure. <= 0
+    # disables the degraded flag while keeping the gauges.
+    "VDT_SLO_BURN_THRESHOLD":
+    lambda: float(os.getenv("VDT_SLO_BURN_THRESHOLD", "2.0")),
+    # SLO availability target the error budget derives from (e.g. 0.99
+    # = 1% of scored requests may miss their latency targets).
+    "VDT_SLO_TARGET":
+    lambda: min(0.9999, max(0.5, float(
+        os.getenv("VDT_SLO_TARGET", "0.99")))),
     # Deterministic fault injection: "name:rate[@delay_s],..." over the
     # named fault points of utils/fault_injection.py (kv_pull.drop,
     # kv_pull.delay, registry.truncate, engine_core.die,

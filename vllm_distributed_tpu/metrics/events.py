@@ -63,13 +63,96 @@ FLEET_JOURNAL_REPLAY = "fleet_journal_replay"  # successor resumed a
 # half-done drain from the actuation journal
 FLEET_CONTROLLER_DOWN = "fleet_controller_down"  # controller died
 # (fleet.controller_die drill) — standbys take over within the TTL
+# Trace-plane additions (front-end + scheduler; PR 19).
+ROUTER_PICK = "router_pick"  # front-end placement decision (replica/pool)
+DISAGG_HANDOFF = "disagg_handoff"  # prefill producer re-admitted the
+# request to its decode home (the consumer's kv_pull span links back)
+KV_TIER_PROMOTE = "kv_tier_promote"  # spill-tier pages scattered back
+KV_TIER_DEMOTE = "kv_tier_demote"  # evicted pages demoted to a tier
+# (page-level batch; rid="")
+
+# Canonical event registry: every name recordable via
+# ``EventRecorder.record`` with a one-line operator-facing doc.
+# scripts/lint_events.py enforces that each module-level event constant
+# above appears here AND as a backticked row in the README event table
+# (the lint_metrics contract, applied to trace span types) — an event
+# name that drifts undocumented fails tier-1.
+EVENT_REGISTRY: dict[str, str] = {
+    ARRIVED: "front-end accepted the request",
+    QUEUED: "entered the scheduler's waiting queue",
+    SCHEDULED: "first tokens granted (prefill start)",
+    PREFILL_CHUNK: "chunked-prefill progress",
+    FIRST_TOKEN: "first output token reached the front-end",
+    KV_PULL_WAIT: "entered WAITING_FOR_REMOTE_KVS",
+    KV_PULL_DONE: "async pull landed; back in the queue",
+    KV_PULL_RETRY: "failed pull re-staged",
+    KV_PULL_TIMEOUT: "watchdog swept the hold",
+    KV_PULL_LOCAL: "degraded to local recompute",
+    PREEMPTED: "pages reclaimed; request parked",
+    RESUMED: "preempted request granted again",
+    SPEC_GRANT: "entered async run-ahead mode (first grant)",
+    BATCH_DISPATCH: "engine-core batch in flight (rid=\"\")",
+    BATCH_RETIRE: "engine-core batch retired (rid=\"\")",
+    ENGINE_DEATH: "core died with this request in flight",
+    JOURNAL_REPLAY: "replayed as a continuation prefill",
+    SHED: "refused at the admission gate (rid=\"\")",
+    FINISHED: "request completed",
+    ABORTED: "request aborted",
+    FLEET_SCALE_OUT: "replica entered rotation",
+    FLEET_SCALE_IN: "replica drained and retired",
+    FLEET_RESPLIT: "replica converted between pools",
+    FLEET_WEDGE_CYCLE: "stuck replica force-cycled",
+    FLEET_FREEZE: "actuation skipped (stale/budget/...)",
+    FLEET_LEADER_TAKEOVER: "lease acquired by this controller",
+    FLEET_FENCED: "stale-epoch actuation rejected",
+    FLEET_JOURNAL_REPLAY: "successor resumed a journaled action",
+    FLEET_CONTROLLER_DOWN: "controller died; standbys take over",
+    ROUTER_PICK: "front-end placement decision (replica/pool)",
+    DISAGG_HANDOFF: "prefill producer handed the request to decode",
+    KV_TIER_PROMOTE: "spill-tier pages scattered back to HBM",
+    KV_TIER_DEMOTE: "evicted pages demoted to a spill tier (rid=\"\")",
+}
 
 
 def timeline_enabled() -> bool:
     """Read once per recorder (NOT per event): the envs registry
-    re-evaluates os.getenv on every attribute access."""
+    re-evaluates os.getenv on every attribute access. The trace plane
+    rides the event stream, so VDT_TRACE_PLANE=1 implies recording even
+    if the operator disabled the plain timeline."""
     from vllm_distributed_tpu import envs
-    return envs.VDT_REQUEST_TIMELINE
+    return envs.VDT_REQUEST_TIMELINE or envs.VDT_TRACE_PLANE
+
+
+def trace_plane_enabled() -> bool:
+    """Read once per component at construction (same discipline as
+    timeline_enabled): the distributed trace plane's master switch."""
+    from vllm_distributed_tpu import envs
+    return envs.VDT_TRACE_PLANE
+
+
+# Reserved detail keys the trace plane merges into event details.
+# Compact on purpose: every stamped event carries them over the stats
+# wire. "tr" = trace id (hex), "rep" = DP replica index the event was
+# drained from (stamped by the front-end aggregator, pid of the
+# Perfetto export), "co" = monotonic clock offset already applied.
+TRACE_KEY = "tr"
+REPLICA_KEY = "rep"
+
+
+def stamp_trace(detail: Optional[dict],
+                trace_ctx: Optional[dict]) -> Optional[dict]:
+    """Merge the compact trace id into an event detail dict. Returns
+    ``detail`` untouched (possibly None) when there is no trace context
+    — the stamped path allocates a fresh dict so callers may share
+    detail literals."""
+    if not trace_ctx:
+        return detail
+    tid = trace_ctx.get("trace_id")
+    if not tid:
+        return detail
+    d = dict(detail) if detail else {}
+    d[TRACE_KEY] = tid
+    return d
 
 
 class EventRecorder:
@@ -141,6 +224,47 @@ class EventRecorder:
 # ---------------------------------------------------------------------------
 # Phase stitching: merged event timeline -> phase intervals
 # ---------------------------------------------------------------------------
+
+# Backward jump (seconds) in an arrival-ordered timeline past which the
+# clock is treated as a fresh monotonic epoch (restarted engine core /
+# another host) rather than cross-recorder jitter. Jitter between the
+# front-end and core recorders is sub-second; an epoch reset jumps back
+# by the old core's whole uptime.
+EPOCH_RESET_S = 30.0
+
+
+def rebase_epochs(timeline: list,
+                  threshold: float = EPOCH_RESET_S) -> list:
+    """Re-base timestamps across monotonic-epoch resets.
+
+    ``timeline`` is one request's events in ARRIVAL order (``(ts, ...)``
+    tuples or wire-shape lists). Events absorbed from a restarted engine
+    core carry a fresh monotonic epoch: their timestamps jump backward
+    by the dead core's uptime, so sorting by ts misorders the lifecycle
+    and phase math goes negative. A backward jump beyond ``threshold``
+    is treated as an epoch reset: the offending event and everything
+    after it in the same epoch shift forward to continue just past the
+    latest re-based timestamp. Sane timelines pass through unchanged
+    (identity for jitter under the threshold); multiple resets (restart
+    storms) accumulate. Element shape (tuple vs list) is preserved.
+    """
+    if not timeline:
+        return timeline
+    out: list = []
+    offset = 0.0
+    high: Optional[float] = None
+    for entry in timeline:
+        ts = entry[0]
+        if high is not None and ts + offset < high - threshold:
+            offset = high - ts + 1e-6
+        rebased = ts + offset
+        if high is None or rebased > high:
+            high = rebased
+        rest = entry[1:]
+        out.append([rebased, *rest] if isinstance(entry, list)
+                   else (rebased, *rest))
+    return out
+
 
 def _first(timeline: list[tuple], *names: str) -> Optional[tuple]:
     for entry in timeline:

@@ -223,6 +223,9 @@ LABELED_METRICS = {
     "vdt:tenant_kv_blocks": ("tenant", ),
     "vdt:tenant_preemptions_total": ("tenant", ),
     "vdt:tenant_goodput_frac": ("tenant", ),
+    # SLO burn-rate watchdog (metrics/stats.py BurnRateWatchdog): error
+    # budget burn per rolling window (a fixed enum: 1m | 10m).
+    "vdt:slo_burn_rate": ("window", ),
 }
 
 
@@ -517,17 +520,19 @@ def _render_transport(transport: dict) -> list[str]:
     return lines
 
 
-def _render_qcomm(transport_qcomm) -> list[str]:
-    """Quantized-communication plane counters. Two sources merge here:
-    the (possibly DP-merged) per-core telemetry recorders carry the
-    connector payload paths exactly, and parallel/collectives.py's
-    process-local trace-time counters carry the in-graph tknp/ep/tp
-    paths (analytic per-traced-collective savings — see that module;
-    subprocess cores' in-graph traces are not visible, same limitation
-    as vdt:fault_injections_total)."""
+def _render_qcomm(transport_qcomm, remote=None) -> list[str]:
+    """Quantized-communication plane counters. Three sources merge
+    here: the (possibly DP-merged) per-core telemetry recorders carry
+    the connector payload paths exactly, parallel/collectives.py's
+    trace-time counters carry this process's in-graph tknp/ep/tp
+    paths, and ``remote`` carries the pid-deduped follower-process
+    in-graph snapshots dp_client merged off the get_stats feed (so
+    spawned cores' savings are no longer invisible — the
+    vdt:fault_injections_total fix rides the same feed)."""
     from vllm_distributed_tpu.parallel import collectives
     merged = collectives.merged_qcomm_view(
-        transport_qcomm if isinstance(transport_qcomm, dict) else None)
+        transport_qcomm if isinstance(transport_qcomm, dict) else None,
+        remote if isinstance(remote, dict) else None)
     if not merged:
         return []
     name = "vdt:qcomm_bytes_saved_total"
@@ -763,7 +768,8 @@ def render_metrics(stats: dict) -> str:
     if isinstance(transport, dict):
         lines += _render_transport(transport)
     lines += _render_qcomm((transport or {}).get("qcomm")
-                           if isinstance(transport, dict) else None)
+                           if isinstance(transport, dict) else None,
+                           stats.get("qcomm_traced_remote"))
     lines += _render_perf(stats)
     kv_cache = stats.get("kv_cache")
     if isinstance(kv_cache, dict) and kv_cache:

@@ -10,6 +10,7 @@ the global registry complicates multi-engine tests.
 
 import time
 from bisect import bisect_left
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -112,6 +113,73 @@ def merge_histogram_dicts(hists: list[dict]) -> Optional[dict]:
     return merged
 
 
+class BurnRateWatchdog:
+    """Multi-window SLO burn-rate watchdog over the goodput plane.
+
+    The SRE-standard burn-rate alert: a window's burn rate is its miss
+    fraction divided by the error budget (1 - VDT_SLO_TARGET), so 1.0
+    means "missing exactly as fast as the budget allows" and 14 means
+    "the whole monthly budget gone in ~2 days". DEGRADED requires BOTH
+    the fast (1 m) and slow (10 m) windows to burn past
+    VDT_SLO_BURN_THRESHOLD — the fast window confirms the problem is
+    live, the slow one that it is sustained, which is what makes the
+    flag safe to feed the fleet controller as scale-out pressure.
+
+    Per-request verdicts bucket into coarse time bins (one
+    [scored, missed] pair per bin, pruned past the slow window), so
+    memory is O(windows), not O(traffic).
+    """
+
+    WINDOWS = (("1m", 60.0), ("10m", 600.0))
+    BIN_S = 5.0
+
+    def __init__(self, target: Optional[float] = None,
+                 threshold: Optional[float] = None) -> None:
+        from vllm_distributed_tpu import envs
+        self.target = (envs.VDT_SLO_TARGET
+                       if target is None else target)
+        self.threshold = (envs.VDT_SLO_BURN_THRESHOLD
+                          if threshold is None else threshold)
+        self.budget = max(1e-6, 1.0 - self.target)
+        self._horizon = max(w for _, w in self.WINDOWS) + self.BIN_S
+        self._bins: "OrderedDict[int, list[int]]" = OrderedDict()
+
+    def observe(self, good: bool, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        key = int(now // self.BIN_S)
+        bucket = self._bins.get(key)
+        if bucket is None:
+            bucket = self._bins[key] = [0, 0]
+            cutoff = key - int(self._horizon // self.BIN_S) - 1
+            while self._bins and next(iter(self._bins)) < cutoff:
+                self._bins.popitem(last=False)
+        bucket[0] += 1
+        if not good:
+            bucket[1] += 1
+
+    def burn_rates(self, now: Optional[float] = None) -> dict[str, float]:
+        """{window: burn rate} (0.0 for an empty window — no traffic
+        is not an SLO violation)."""
+        now = time.monotonic() if now is None else now
+        rates: dict[str, float] = {}
+        for name, w in self.WINDOWS:
+            cutoff = int((now - w) // self.BIN_S)
+            scored = missed = 0
+            for key, (s, m) in self._bins.items():
+                if key >= cutoff:
+                    scored += s
+                    missed += m
+            frac = missed / scored if scored else 0.0
+            rates[name] = frac / self.budget
+        return rates
+
+    def degraded(self, now: Optional[float] = None) -> bool:
+        if self.threshold <= 0:
+            return False
+        rates = self.burn_rates(now)
+        return all(r > self.threshold for r in rates.values())
+
+
 @dataclass
 class RequestTimes:
     """Per-request timestamps the output processor maintains."""
@@ -161,6 +229,10 @@ class FrontendStats:
     # bounded-cardinality buckets (qos.bucket_tenant), so rendering one
     # series per key is safe.
     slo_by_tenant: dict = field(default_factory=dict)
+    # SLO burn-rate watchdog (constructed by the output processor when
+    # any SLO target is enabled; None otherwise): multi-window burn
+    # rates + the degraded flag /health and /debug/engine surface.
+    burn: Optional[BurnRateWatchdog] = None
     # Periodic logging window (LoggingStatLogger equivalent).
     _window_start: float = field(default_factory=time.monotonic)
     _window_gen_tokens: int = 0
@@ -234,6 +306,8 @@ class FrontendStats:
         self.slo_scored += 1
         if good:
             self.slo_good += 1
+        if self.burn is not None:
+            self.burn.observe(good)
         if tenant is not None:
             bank = self.slo_by_tenant.setdefault(tenant, [0, 0])
             bank[0] += 1
@@ -251,7 +325,12 @@ class FrontendStats:
         self._window_gen_tokens = 0
 
     # ------------------------------------------------------------------
-    def render(self) -> str:
+    def render(self, fault_extra: Optional[dict] = None) -> str:
+        """Exposition text. ``fault_extra`` merges follower-process
+        fault-injection counter snapshots (shipped over the get_stats
+        feed and pid-deduped by dp_client) so the
+        vdt:fault_injections_total family is fleet-exact instead of
+        front-end-process-local."""
         lines = self.ttft.render(
             "vdt:time_to_first_token_seconds",
             "Time from request arrival to first output token")
@@ -325,16 +404,38 @@ class FrontendStats:
                     for t, (scored, good)
                     in sorted(self.slo_by_tenant.items())
                 ]
-        lines += render_fault_injections()
+            if self.burn is not None:
+                rates = self.burn.burn_rates()
+                name = "vdt:slo_burn_rate"
+                lines += [
+                    f"# HELP {name} SLO error-budget burn rate per "
+                    "window (miss fraction / (1 - VDT_SLO_TARGET); "
+                    "1.0 = burning exactly at budget)",
+                    f"# TYPE {name} gauge",
+                ]
+                lines += [f'{name}{{window="{w}"}} {round(r, 6)}'
+                          for w, r in sorted(rates.items())]
+                lines += [
+                    "# HELP vdt:slo_degraded 1 when every burn window "
+                    "exceeds VDT_SLO_BURN_THRESHOLD (sustained SLO "
+                    "burn; also surfaced in /health)",
+                    "# TYPE vdt:slo_degraded gauge",
+                    f"vdt:slo_degraded {int(self.burn.degraded())}",
+                ]
+        lines += render_fault_injections(fault_extra)
         return "\n".join(lines) + "\n"
 
 
-def render_fault_injections() -> list[str]:
+def render_fault_injections(extra: Optional[dict] = None) -> list[str]:
     """Per-fault-point fire counters (empty when no faults configured),
     so robustness drills show up on the same /metrics scrape as their
-    effects."""
+    effects. ``extra`` ({point: n}) folds in follower-process snapshots
+    — the in-process registry only sees THIS process's fires, so
+    spawned engine cores' drills were invisible here until PR 19."""
     from vllm_distributed_tpu.utils import fault_injection
-    counts = fault_injection.counters()
+    counts = dict(fault_injection.counters())
+    for point, n in (extra or {}).items():
+        counts[point] = counts.get(point, 0) + int(n)
     if not counts:
         return []
     name = "vdt:fault_injections_total"

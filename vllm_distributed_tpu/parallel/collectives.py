@@ -119,10 +119,11 @@ def note_fallback(path: str) -> None:
 
 
 def traced_snapshot() -> dict:
-    """Process-local in-graph counters (like fault_injection.counters:
-    read at render time by the front end; subprocess engine cores'
-    traces are not visible here — their KV-payload savings still ride
-    the per-core telemetry recorder)."""
+    """Process-local in-graph counters. The front end reads its own at
+    render time; spawned engine cores export theirs (pid-tagged) over
+    the get_stats feed, where dp_client merges the follower snapshots
+    so /metrics is fleet-exact (PR 19 — noted as process-local since
+    PR 9)."""
     with _lock:
         return {"bytes_saved": dict(_trace_bytes_saved),
                 "fallbacks": dict(_trace_fallbacks)}
@@ -134,23 +135,28 @@ def reset_counters() -> None:
         _trace_fallbacks.clear()
 
 
-def merged_qcomm_view(transport_qcomm: Optional[dict]) -> dict:
+def merged_qcomm_view(transport_qcomm: Optional[dict],
+                      remote: Optional[dict] = None) -> dict:
     """One {path: {bytes_saved, fallbacks}} map combining the per-core
     telemetry recorders' exact payload counters (possibly DP-merged)
     with this process's trace-time in-graph counters — the shape the
-    /metrics renderer and the /debug/engine dump share."""
+    /metrics renderer and the /debug/engine dump share. ``remote``
+    (same {"bytes_saved": {path: n}, "fallbacks": {path: n}} shape as
+    traced_snapshot) folds in the pid-deduped follower-process
+    snapshots dp_client merged from the get_stats feed."""
     merged: dict[str, dict] = {}
     for path, e in (transport_qcomm or {}).items():
         if isinstance(e, dict):
             merged[path] = {"bytes_saved": int(e.get("bytes_saved", 0)),
                             "fallbacks": int(e.get("fallbacks", 0))}
     traced = traced_snapshot()
-    for path, n in traced["bytes_saved"].items():
-        merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
-        merged[path]["bytes_saved"] += int(n)
-    for path, n in traced["fallbacks"].items():
-        merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
-        merged[path]["fallbacks"] += int(n)
+    for snap in (traced, remote or {}):
+        for path, n in (snap.get("bytes_saved") or {}).items():
+            merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
+            merged[path]["bytes_saved"] += int(n)
+        for path, n in (snap.get("fallbacks") or {}).items():
+            merged.setdefault(path, {"bytes_saved": 0, "fallbacks": 0})
+            merged[path]["fallbacks"] += int(n)
     return merged
 
 

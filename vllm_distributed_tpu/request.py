@@ -79,6 +79,13 @@ class EngineCoreRequest:
     # (multimodal/__init__.py MultiModalInput; reference: the mm_inputs
     # of v1/engine/__init__.py EngineCoreRequest).
     mm_inputs: Optional[list] = None
+    # Distributed trace plane (VDT_TRACE_PLANE): {"trace_id": hex,
+    # "span_id": hex} minted at admission. Deep-copied by
+    # continuation_request and re-admitted verbatim by the disagg
+    # handoff, so every hop of one request stamps the SAME trace id —
+    # that is the cross-replica causal link. None when the plane is off
+    # (serial.py then omits the key: old-wire byte-identical).
+    trace_ctx: Optional[dict[str, Any]] = None
 
 
 def continuation_request(orig: EngineCoreRequest,
@@ -122,6 +129,7 @@ class Request:
         pooling_params: Optional[dict[str, Any]] = None,
         mm_inputs: Optional[list] = None,
         tenant: Optional[str] = None,
+        trace_ctx: Optional[dict[str, Any]] = None,
     ) -> None:
         self.request_id = request_id
         self.prompt_token_ids = prompt_token_ids
@@ -138,6 +146,7 @@ class Request:
         self.lora_request = lora_request
         self.pooling_params = pooling_params
         self.mm_inputs = mm_inputs
+        self.trace_ctx = trace_ctx
         # Content hash of the images, salted into the block hashes so
         # identical placeholder token ids with different images never
         # share prefix-cache pages (kv_cache_utils.hash_request_tokens).
@@ -212,6 +221,7 @@ class Request:
             pooling_params=req.pooling_params,
             mm_inputs=req.mm_inputs,
             tenant=req.tenant,
+            trace_ctx=req.trace_ctx,
         )
 
     # ------------------------------------------------------------------

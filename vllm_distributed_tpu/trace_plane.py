@@ -1,0 +1,291 @@
+"""Distributed trace plane: fleet-wide causal request tracing.
+
+PR 4's EventRecorder rings are per-replica islands: the router decision
+lives in the front-end ring, the scheduler lifecycle in each core's
+ring, and a disagg request's prefill and decode halves in two DIFFERENT
+replicas' rings — nobody can answer "where did this request's 2 s go"
+across a KV handoff or a mid-drain migration. This module is the
+stitching layer on top of those rings:
+
+* ``mint_trace_ctx`` — a ``{"trace_id", "span_id"}`` context minted at
+  admission and carried on ``EngineCoreRequest`` over the msgpack wire
+  (``serial.py``, old-wire tolerant). The disagg handoff re-admits the
+  ORIGINAL request and crash-recovery replays deep-copy it, so every
+  hop stamps the SAME trace id — that is the causal link; no new RPC
+  exists anywhere in the plane.
+* ``TraceAssembler`` — a bounded rolling flight recorder the front-end
+  feeds with (a) its own lifecycle events and (b) the core rings
+  drained over the existing get_stats feed, already replica-tagged and
+  clock-rebased by the DP aggregator. Buckets events by trace id
+  (falling back to the request-id map for front-end events recorded
+  before the stamp existed).
+* ``perfetto`` — one stitched trace rendered as Chrome/Perfetto
+  trace-event JSON (``GET /debug/trace?request_id=``): pid = replica,
+  tid = component, phase intervals as complete ("X") slices, lifecycle
+  transitions as instants, and an explicit flow arrow (``s``/``f``)
+  from the producer's ``disagg_handoff`` span to the consumer's
+  ``kv_pull`` span.
+
+Everything here is OFF-path: with ``VDT_TRACE_PLANE=0`` no context is
+minted, no event is stamped, and no assembler is constructed — the
+wire bytes and event details are byte-identical to the pre-trace-plane
+behavior.
+"""
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Optional
+
+from vllm_distributed_tpu.metrics import events as ev
+
+# Component lane (Perfetto tid) per event name: which subsystem emitted
+# the event. Unknown (future) events land in "events".
+_COMPONENT_BY_EVENT = {
+    ev.ARRIVED: "frontend",
+    ev.FIRST_TOKEN: "frontend",
+    ev.FINISHED: "frontend",
+    ev.ABORTED: "frontend",
+    ev.SHED: "frontend",
+    ev.ENGINE_DEATH: "frontend",
+    ev.JOURNAL_REPLAY: "frontend",
+    ev.ROUTER_PICK: "router",
+    ev.DISAGG_HANDOFF: "disagg",
+    ev.QUEUED: "scheduler",
+    ev.SCHEDULED: "scheduler",
+    ev.PREFILL_CHUNK: "scheduler",
+    ev.PREEMPTED: "scheduler",
+    ev.RESUMED: "scheduler",
+    ev.SPEC_GRANT: "scheduler",
+    ev.BATCH_DISPATCH: "engine",
+    ev.BATCH_RETIRE: "engine",
+    ev.KV_PULL_WAIT: "kv_transfer",
+    ev.KV_PULL_DONE: "kv_transfer",
+    ev.KV_PULL_RETRY: "kv_transfer",
+    ev.KV_PULL_TIMEOUT: "kv_transfer",
+    ev.KV_PULL_LOCAL: "kv_transfer",
+    ev.KV_TIER_PROMOTE: "kv_tier",
+    ev.KV_TIER_DEMOTE: "kv_tier",
+}
+for _name in (ev.FLEET_SCALE_OUT, ev.FLEET_SCALE_IN, ev.FLEET_RESPLIT,
+              ev.FLEET_WEDGE_CYCLE, ev.FLEET_FREEZE,
+              ev.FLEET_LEADER_TAKEOVER, ev.FLEET_FENCED,
+              ev.FLEET_JOURNAL_REPLAY, ev.FLEET_CONTROLLER_DOWN):
+    _COMPONENT_BY_EVENT[_name] = "fleet"
+
+
+def component_of(event: str) -> str:
+    return _COMPONENT_BY_EVENT.get(event, "events")
+
+
+def mint_trace_ctx(request_id: str) -> dict[str, str]:
+    """Trace context minted once at admission. Deterministic from the
+    request id on purpose: a journal replay or failover re-admission of
+    the same logical request re-mints the SAME trace id even if the
+    carried context were ever lost, so forensic stitching survives the
+    exact failure modes it exists to explain. (Request ids are already
+    unique per logical request — uuid4 at the entrypoints.)"""
+    digest = hashlib.sha256(request_id.encode()).hexdigest()
+    return {"trace_id": digest[:16], "span_id": digest[16:24]}
+
+
+class TraceAssembler:
+    """Bounded rolling flight recorder of stitched traces.
+
+    Buckets incoming events by trace id: the stamped ``tr`` detail key
+    wins; events without a stamp fall back to the request-id -> trace
+    map registered at admission (covers rid="" fleet events only via
+    explicit window queries at export time). Oldest-admitted traces
+    evict past ``max_traces``; a trace keeps its EARLIEST ``max_spans``
+    events (the causal root matters most) and counts the rest.
+    """
+
+    def __init__(self, max_traces: Optional[int] = None,
+                 max_spans: Optional[int] = None) -> None:
+        from vllm_distributed_tpu import envs
+        self.max_traces = (envs.VDT_TRACE_MAX_TRACES
+                           if max_traces is None else max_traces)
+        self.max_spans = (envs.VDT_TRACE_MAX_SPANS
+                          if max_spans is None else max_spans)
+        self._lock = threading.Lock()
+        # trace_id -> {"trace_id", "request_ids": set, "events": list of
+        # (ts, rid, event, detail, replica), "num_dropped": int}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._by_request: dict[str, str] = {}
+        # rid="" events (fleet actuations, batch markers) kept in a
+        # small side ring so exports can fold in the ones overlapping
+        # the trace's time window.
+        self._anon: list[tuple] = []
+        self._anon_max = 512
+
+    # ------------------------------------------------------------------
+    def note_admission(self, request_id: str, trace_ctx: dict) -> None:
+        """Register rid -> trace at admission (front-end)."""
+        tid = (trace_ctx or {}).get("trace_id")
+        if not tid:
+            return
+        with self._lock:
+            bucket = self._traces.get(tid)
+            if bucket is None:
+                bucket = {"trace_id": tid, "request_ids": set(),
+                          "events": [], "num_dropped": 0}
+                self._traces[tid] = bucket
+                while len(self._traces) > self.max_traces:
+                    _, evicted = self._traces.popitem(last=False)
+                    for rid in evicted["request_ids"]:
+                        self._by_request.pop(rid, None)
+            bucket["request_ids"].add(request_id)
+            self._by_request[request_id] = tid
+
+    def add_event(self, ts: float, rid: str, event: str,
+                  detail: Optional[dict],
+                  replica: Optional[int] = None) -> None:
+        tid = None
+        if isinstance(detail, dict):
+            tid = detail.get(ev.TRACE_KEY)
+            if replica is None:
+                replica = detail.get(ev.REPLICA_KEY)
+        with self._lock:
+            if tid is None:
+                tid = self._by_request.get(rid) if rid else None
+            if tid is None:
+                self._anon.append((ts, rid, event, detail, replica))
+                if len(self._anon) > self._anon_max:
+                    del self._anon[:len(self._anon) - self._anon_max]
+                return
+            bucket = self._traces.get(tid)
+            if bucket is None:
+                # Stamped event for a trace the flight recorder already
+                # evicted (or a foreign front-end admitted): recreate a
+                # bucket so cross-replica stitching still works.
+                bucket = {"trace_id": tid, "request_ids": set(),
+                          "events": [], "num_dropped": 0}
+                self._traces[tid] = bucket
+                while len(self._traces) > self.max_traces:
+                    _, evicted = self._traces.popitem(last=False)
+                    for r in evicted["request_ids"]:
+                        self._by_request.pop(r, None)
+            if rid:
+                bucket["request_ids"].add(rid)
+                self._by_request.setdefault(rid, tid)
+            if len(bucket["events"]) >= self.max_spans:
+                bucket["num_dropped"] += 1
+                return
+            bucket["events"].append((ts, rid, event, detail, replica))
+
+    def feed(self, wire_events: Optional[list],
+             replica: Optional[int] = None) -> None:
+        """Absorb wire-shape ``[ts, rid, event, detail]`` lists (the
+        drained core rings the DP aggregator already replica-tagged)."""
+        if not wire_events:
+            return
+        for e in wire_events:
+            try:
+                ts, rid, event, detail = e[0], e[1], e[2], e[3]
+            except (IndexError, TypeError):
+                continue
+            self.add_event(ts, rid, event, detail, replica=replica)
+
+    # ------------------------------------------------------------------
+    def get(self, request_id: Optional[str] = None,
+            trace_id: Optional[str] = None) -> Optional[dict]:
+        """One stitched trace (events in arrival order, epoch-rebased),
+        or None. rid="" side-ring events overlapping the trace's time
+        window fold in so fleet actuations that reshaped the fleet
+        under the request are visible on their own lane."""
+        with self._lock:
+            if trace_id is None and request_id is not None:
+                trace_id = self._by_request.get(request_id)
+            if trace_id is None:
+                return None
+            bucket = self._traces.get(trace_id)
+            if bucket is None:
+                return None
+            events = list(bucket["events"])
+            if events:
+                lo = min(e[0] for e in events)
+                hi = max(e[0] for e in events)
+                events += [e for e in self._anon if lo <= e[0] <= hi]
+            return {"trace_id": trace_id,
+                    "request_ids": sorted(bucket["request_ids"]),
+                    "events": ev.rebase_epochs(events),
+                    "num_dropped": bucket["num_dropped"]}
+
+    def trace_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._traces.keys())
+
+    def replica_count(self, trace: dict) -> int:
+        """Distinct replicas contributing spans to a stitched trace
+        (bench: a disagg handoff must yield >= 2)."""
+        return len({e[4] if e[4] is not None else -1
+                    for e in trace["events"]})
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def _flow_id(trace_id: str) -> int:
+    return int(trace_id[:12], 16)
+
+
+def perfetto(trace: dict) -> dict:
+    """Render one stitched trace as Chrome/Perfetto trace-event JSON
+    (the ``{"traceEvents": [...]}`` object form): pid = replica index
+    (-1 = front-end / untagged), tid = component, per-replica phase
+    intervals as complete ("X") slices, lifecycle transitions as
+    instants ("i"), and a flow arrow ("s" -> "f") from each producer
+    ``disagg_handoff`` to the consumer's next ``kv_pull`` event."""
+    events = sorted(trace["events"], key=lambda e: e[0])
+    out: list[dict] = []
+    base = events[0][0] if events else 0.0
+    replicas = sorted({e[4] if e[4] is not None else -1 for e in events})
+    for rep in replicas:
+        label = "frontend" if rep == -1 else f"replica {rep}"
+        out.append({"name": "process_name", "ph": "M", "pid": rep,
+                    "tid": 0, "args": {"name": label}})
+
+    def us(ts: float) -> float:
+        return round((ts - base) * 1e6, 3)
+
+    flow = _flow_id(trace["trace_id"])
+    flow_open = False
+    for ts, rid, event, detail, replica in events:
+        pid = replica if replica is not None else -1
+        tid = component_of(event)
+        args: dict[str, Any] = {"request_id": rid}
+        if isinstance(detail, dict):
+            args.update({k: v for k, v in detail.items()
+                         if k not in (ev.TRACE_KEY, ev.REPLICA_KEY)})
+        out.append({"name": event, "cat": tid, "ph": "i", "s": "p",
+                    "ts": us(ts), "pid": pid, "tid": tid, "args": args})
+        if event == ev.DISAGG_HANDOFF:
+            out.append({"name": "kv_handoff", "cat": "flow", "ph": "s",
+                        "id": flow, "ts": us(ts), "pid": pid,
+                        "tid": tid})
+            flow_open = True
+        elif flow_open and event in (ev.KV_PULL_WAIT, ev.KV_PULL_DONE,
+                                     ev.KV_PULL_LOCAL):
+            out.append({"name": "kv_handoff", "cat": "flow", "ph": "f",
+                        "bp": "e", "id": flow, "ts": us(ts), "pid": pid,
+                        "tid": tid})
+            flow_open = False
+
+    # Phase slices per replica: each replica's view of the lifecycle
+    # rendered as complete events on a "phases" lane.
+    for rep in replicas:
+        timeline = [(ts, event, detail)
+                    for ts, _rid, event, detail, replica in events
+                    if (replica if replica is not None else -1) == rep]
+        now = max(e[0] for e in events) if events else None
+        for p in ev.phases_from_timeline(timeline, now=now):
+            dur = max(0.0, p["end"] - p["start"]) * 1e6
+            out.append({"name": p["phase"], "cat": "phase", "ph": "X",
+                        "ts": us(p["start"]), "dur": round(dur, 3),
+                        "pid": rep, "tid": "phases"})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace["trace_id"],
+                          "request_ids": trace["request_ids"],
+                          "num_dropped": trace["num_dropped"]}}

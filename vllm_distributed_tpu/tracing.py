@@ -43,6 +43,36 @@ class SpanAttributes:
         "gen_ai.latency.time_to_first_token"
     GEN_AI_LATENCY_E2E = "gen_ai.latency.e2e"
     GEN_AI_RESPONSE_FINISH_REASON = "gen_ai.response.finish_reason"
+    # Distributed trace plane (VDT_TRACE_PLANE): the fleet-wide trace
+    # id minted at admission — join key against the /debug/trace
+    # assembler and any foreign replica's spans.
+    GEN_AI_TRACE_ID = "gen_ai.request.trace_id"
+
+
+# Component lanes rendered as their own child spans when the request's
+# timeline carries matching events (disagg handoffs, fleet actuations,
+# KV-tier moves, router placement) — the cross-subsystem legs the flat
+# per-request span never showed.
+_COMPONENT_SPAN_LANES = ("router", "disagg", "kv_transfer", "kv_tier",
+                         "fleet")
+
+
+def component_events(events: Optional[list]) -> dict[str, list]:
+    """Group a request's relative-timestamp event list by component
+    lane, keeping only the cross-subsystem lanes worth their own child
+    spans. ``events`` rows are ``[rel_ts, event, detail]``."""
+    if not events:
+        return {}
+    from vllm_distributed_tpu.trace_plane import component_of
+    lanes: dict[str, list] = {}
+    for row in events:
+        try:
+            lane = component_of(row[1])
+        except (IndexError, TypeError):
+            continue
+        if lane in _COMPONENT_SPAN_LANES:
+            lanes.setdefault(lane, []).append(row)
+    return lanes
 
 
 class RequestTracer:
@@ -94,6 +124,16 @@ class JsonlTracer(RequestTracer):
             } for p in phases]
         if events:
             record["events"] = events
+            lanes = component_events(events)
+            if lanes:
+                # Cross-subsystem legs as explicit child records: one
+                # per component lane spanning its first->last event.
+                record["components"] = [{
+                    "component": lane,
+                    "start_s": rows[0][0],
+                    "duration_s": round(rows[-1][0] - rows[0][0], 6),
+                    "events": [r[1] for r in rows],
+                } for lane, rows in sorted(lanes.items())]
         try:
             with self._lock:
                 self._ensure_file_locked()
@@ -177,6 +217,18 @@ class OtelTracer(RequestTracer):
                         child.set_attribute("phase", p["phase"])
                         child.set_attribute("duration_s",
                                             p["end"] - p["start"])
+                for lane, rows in sorted(
+                        component_events(events).items()):
+                    # Cross-subsystem legs (router pick, disagg
+                    # handoff, KV-tier moves, fleet actuations) as
+                    # component child spans.
+                    with self._tracer.start_as_current_span(
+                            f"component.{lane}") as child:
+                        child.set_attribute("component", lane)
+                        child.set_attribute(
+                            "duration_s", rows[-1][0] - rows[0][0])
+                        child.set_attribute(
+                            "events", ",".join(r[1] for r in rows))
         except Exception as e:  # noqa: BLE001 - degrade, don't die
             logger.debug("otel trace emit failed: %s", e)
 
