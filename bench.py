@@ -147,7 +147,13 @@ def _probe_attempt(timeout: float,
         proc = subprocess.Popen([sys.executable, "-c", _PROBE],
                                 stdout=out_f, stderr=err_f)
         start = time.monotonic()
-        last_growth = start
+        # last_novel: the last instant stderr grew with NON-warning
+        # content. A hung plugin that re-prints its experimental banner
+        # periodically keeps plain "growth" alive forever (BENCH_r05:
+        # two full 120 s timeouts on exactly that shape), so the
+        # liveness clock must ignore warning-only growth — only novel
+        # content (a traceback, device enumeration) proves progress.
+        last_novel = start
         last_len = 0
         try:
             while True:
@@ -159,22 +165,33 @@ def _probe_attempt(timeout: float,
                 if time.monotonic() - start >= timeout:
                     proc.kill()
                     proc.wait()
+                    err_txt = snap(err_f)
+                    if err_txt and _stderr_warning_only(err_txt):
+                        # Belt and braces: however the liveness clock
+                        # was kept alive, a full attempt that produced
+                        # nothing but warnings is the hung-platform
+                        # signature, not a retryable timeout.
+                        return ("hung-warning",
+                                f"warning-only stderr through full "
+                                f"{timeout:.0f}s attempt: "
+                                f"{err_txt.strip()[-300:]}")
                     return ("timeout",
                             f"after {timeout:.0f}s: "
-                            f"{snap(err_f).strip()[-300:]}")
+                            f"{err_txt.strip()[-300:]}")
                 err_txt = snap(err_f)
                 if len(err_txt) != last_len:
                     last_len = len(err_txt)
-                    last_growth = time.monotonic()
-                quiet = time.monotonic() - last_growth
+                    if not _stderr_warning_only(err_txt):
+                        last_novel = time.monotonic()
+                stalled = time.monotonic() - last_novel
                 if (err_txt and _stderr_warning_only(err_txt)
-                        and quiet >= liveness
+                        and stalled >= liveness
                         and time.monotonic() - start >= liveness):
                     proc.kill()
                     proc.wait()
                     return ("hung-warning",
-                            f"warning-only stderr quiet for "
-                            f"{quiet:.0f}s: {err_txt.strip()[-300:]}")
+                            f"warning-only stderr for "
+                            f"{stalled:.0f}s: {err_txt.strip()[-300:]}")
         finally:
             if proc.poll() is None:
                 proc.kill()
@@ -2028,6 +2045,161 @@ def _fleet_leg(config, record) -> None:
                 os.environ[k] = v
 
 
+def _canary_leg(config, record) -> None:
+    """Correctness-sentinel acceptance leg (ISSUE 20): a 2-replica DP
+    fleet with ``VDT_CORRECTNESS=1`` runs (a) a clean 60-probe canary
+    soak — zero divergences tolerated (the false-positive budget is
+    literally zero: a sentinel that cries wolf gets its quarantine feed
+    ignored); (b) a seeded single-replica corruption drill — replica
+    1's canary outputs are token-perturbed at the absorption point and
+    the vote must isolate it within 3 probes, raise the suspect gauge
+    for replica 1 ONLY, and emit a quarantine hint; (c) a plane-off
+    overhead pair on byte-identical tenant traffic (the always-on cost
+    is the per-step numerics tap; canary probes are interval-paced and
+    amortize out), with greedy token parity — the sentinel is
+    contractually invisible to tenant tokens."""
+    import gc
+
+    import jax
+
+    from vllm_distributed_tpu.config import (CacheConfig, EngineConfig,
+                                             LoadConfig, SchedulerConfig)
+    from vllm_distributed_tpu.engine.llm_engine import LLMEngine
+    from vllm_distributed_tpu.sampling_params import SamplingParams
+    if len(jax.devices()) < 2:
+        record["canary_leg_error"] = (
+            "needs >= 2 devices for a 2-replica DP fleet")
+        return
+    keys = ("VDT_CORRECTNESS", "VDT_CANARY_INTERVAL_S",
+            "VDT_CANARY_QUARANTINE_N", "VDT_NUMERICS_DRIFT_FRAC",
+            "VDT_FLEET")
+    saved = {k: os.environ.get(k) for k in keys}
+
+    def make_engine():
+        cfg = EngineConfig(
+            model_config=config.model_config,
+            cache_config=CacheConfig(block_size=16, num_gpu_blocks=256),
+            scheduler_config=SchedulerConfig(
+                max_num_batched_tokens=1024, max_num_seqs=8,
+                max_model_len=512, num_scheduler_steps=1),
+            load_config=LoadConfig(load_format="dummy"),
+        )
+        cfg.parallel_config.data_parallel_size = 2
+        return LLMEngine(cfg, load_tokenizer=False)
+
+    def pump_probes(engine, plane, n, budget=4000):
+        """Drive the DP output pump until ``n`` more canary probes have
+        finished (get_output's tick both injects due probes and steps
+        the replicas that hold them)."""
+        target = sum(plane.probes.values()) + n
+        while sum(plane.probes.values()) < target and budget > 0:
+            engine.engine_core.get_output()
+            budget -= 1
+        return sum(plane.probes.values()) >= target
+
+    try:
+        os.environ.update({
+            "VDT_CORRECTNESS": "1",
+            # Interval 0: a fresh round every tick — the soak and the
+            # drill are probe-count-paced, not wall-clock-paced.
+            "VDT_CANARY_INTERVAL_S": "0",
+            "VDT_CANARY_QUARANTINE_N": "2",
+            # The drill perturbs tokens, not logits: keep the numerics
+            # drift detector out of the attribution being scored.
+            "VDT_NUMERICS_DRIFT_FRAC": "0",
+            "VDT_FLEET": "0",
+        })
+        engine = make_engine()
+        plane = getattr(engine.engine_core, "correctness", None)
+        if plane is None:
+            record["canary_leg_error"] = (
+                "VDT_CORRECTNESS=1 built no correctness plane "
+                "(single-replica engine?)")
+            return
+        # (a) Clean soak: 60 probes (30 rounds x 2 replicas), the first
+        # round self-seeds the reference journal.
+        if not pump_probes(engine, plane, 60):
+            record["canary_leg_error"] = "soak stalled before 60 probes"
+            return
+        stats = plane.get_stats()
+        record["canary_soak_probes"] = sum(stats["probes"].values())
+        record["canary_false_positives"] = sum(
+            sum(c.values()) for c in stats["divergences"].values())
+        # (b) Corruption drill: perturb replica 1's canary tokens at
+        # the absorption point (same engine — the journal is seeded).
+        orig_absorb = plane.on_output
+
+        def corrupted(out):
+            if plane._replica_of(out.req_id) == 1 and out.new_token_ids:
+                out.new_token_ids = [t + 1 for t in out.new_token_ids]
+            orig_absorb(out)
+
+        plane.on_output = corrupted
+        p0 = plane.probes.get(1, 0)
+        detection = None
+        for _ in range(3):
+            if not pump_probes(engine, plane, 2):
+                break
+            if detection is None and plane.divergences.get(1):
+                detection = plane.probes.get(1, 0) - p0
+        del plane.on_output
+        stats = plane.get_stats()
+        record["canary_detection_probes"] = detection
+        record["canary_vote_attribution"] = (
+            [i for i, v in stats["suspects"].items() if v] == [1])
+        record["canary_quarantine_hint"] = (
+            stats["quarantine_hints"] >= 1)
+        engine.shutdown()
+        del engine
+        gc.collect()
+        # (c) Overhead pair: plane on vs off, identical greedy traffic.
+        # A long interval parks the canary injector so the measured
+        # cost is the always-on numerics tap.
+        os.environ["VDT_CANARY_INTERVAL_S"] = "3600"
+        sp = SamplingParams(temperature=0.0, max_tokens=16,
+                            ignore_eos=True)
+        rng = np.random.default_rng(20)
+        prompts = [[int(x) for x in rng.integers(10, 5000, size=64)]
+                   for _ in range(8)]
+        walls: dict = {}
+        outs: dict = {}
+        for leg, flag in (("on", "1"), ("off", "0")):
+            os.environ["VDT_CORRECTNESS"] = flag
+            engine = make_engine()
+            # Warm pass (untimed: compiles, allocator steady state)
+            # then best-of-3 — the pair measures the plane, not the
+            # process's thermal noise.
+            best = None
+            got: dict = {}
+            for rep in range(4):
+                got = {}
+                for s, p in enumerate(prompts):
+                    engine.add_request(f"{leg}-{rep}-{s}", list(p), sp)
+                t0 = time.perf_counter()
+                while engine.has_unfinished_requests():
+                    for o in engine.step():
+                        if o.finished:
+                            got[o.request_id.rsplit("-", 1)[1]] = list(
+                                o.outputs[0].token_ids)
+                wall = time.perf_counter() - t0
+                if rep > 0 and (best is None or wall < best):
+                    best = wall
+            walls[leg] = best
+            outs[leg] = got
+            engine.shutdown()
+            del engine
+            gc.collect()
+        record["canary_overhead_frac"] = round(
+            walls["on"] / walls["off"] - 1.0, 4)
+        record["canary_parity"] = outs["on"] == outs["off"]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _ha_leg(config, record) -> None:
     """HA control-plane acceptance leg (ISSUE 17): the fleet leg's
     diurnal trace on a 2-replica DP fleet with the lease-fenced shared
@@ -2358,10 +2530,10 @@ def main() -> None:
     dev_s = device_decode["s"]
     record = {
         "metric": "decode_throughput_llama1b_bs8",
-        # v6: _trace_leg fields (or trace_leg_error) join the v5 _ha_leg
-        # requirements — scripts/lint_bench.py keeps future records
-        # machine-comparable.
-        "schema_version": 6,
+        # v7: _canary_leg fields (or canary_leg_error) join the v6
+        # _trace_leg requirements — scripts/lint_bench.py keeps future
+        # records machine-comparable.
+        "schema_version": 7,
         "value": round(decode_tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(decode_tok_s / BASELINE_TOKS_PER_S, 3),
@@ -2518,6 +2690,12 @@ def main() -> None:
             _fleet_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
+        # Correctness-sentinel leg: clean canary soak, seeded
+        # single-replica corruption drill, plane-off overhead pair.
+        try:
+            _canary_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["canary_leg_error"] = f"{type(e).__name__}: {e}"
         # HA control-plane leg: leader killed mid-scale-in, standby
         # takes over inside the lease TTL, token parity across the
         # failover.
@@ -2619,6 +2797,10 @@ def main() -> None:
             _fleet_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only
             record["fleet_leg_error"] = f"{type(e).__name__}: {e}"
+        try:
+            _canary_leg(config, record)
+        except Exception as e:  # noqa: BLE001 - diagnostic leg only
+            record["canary_leg_error"] = f"{type(e).__name__}: {e}"
         try:
             _ha_leg(config, record)
         except Exception as e:  # noqa: BLE001 - diagnostic leg only

@@ -42,6 +42,12 @@ a record drifts:
   (VDT_TRACE_PLANE on vs off), at least one stitched two-replica
   disagg trace and at least one Perfetto flow link across the KV
   handoff — or an explicit ``trace_leg_error`` string.
+* **schema_version >= 7 records** (the correctness sentinel) must
+  carry the ``_canary_leg`` acceptance — a clean soak of >= 60 canary
+  probes with ZERO false positives, the seeded single-replica
+  corruption detected within 3 probes with vote attribution and a
+  quarantine hint, a bounded plane-on overhead fraction and greedy
+  token parity — or an explicit ``canary_leg_error`` string.
 
 Usage::
 
@@ -115,6 +121,8 @@ def check_record(name: str, rec) -> list:
             errs.extend(_check_ha_fields(name, rec))
         if version >= 6:
             errs.extend(_check_trace_fields(name, rec))
+        if version >= 7:
+            errs.extend(_check_canary_fields(name, rec))
     return errs
 
 
@@ -256,6 +264,51 @@ def _check_trace_fields(name: str, rec: dict) -> list:
     for key, (ok, want) in TRACE_FIELDS.items():
         if not ok(rec.get(key)):
             errs.append(f"{name}: schema>=6 record needs {key} "
+                        f"({want}), got {rec.get(key)!r}")
+    return errs
+
+
+# _canary_leg acceptance fields required on schema >= 7 records
+# ((validator, description) per field; see bench.py _canary_leg).
+CANARY_FIELDS = {
+    "canary_soak_probes": (
+        lambda v: _is_num(v) and v >= 60,
+        "number >= 60 (the clean soak must be long enough to trust "
+        "the zero-false-positive claim)"),
+    "canary_false_positives": (
+        lambda v: v == 0 and not isinstance(v, bool),
+        "exactly 0 (a sentinel that cries wolf gets ignored)"),
+    "canary_detection_probes": (
+        lambda v: _is_num(v) and 1 <= v <= 3,
+        "number in [1, 3] (the seeded corruption must be caught "
+        "within 3 probes)"),
+    "canary_vote_attribution": (
+        lambda v: v is True,
+        "true (the vote must isolate exactly the corrupted replica)"),
+    "canary_quarantine_hint": (
+        lambda v: v is True,
+        "true (sustained divergence must emit a quarantine hint)"),
+    "canary_overhead_frac": (
+        lambda v: _is_num(v) and v <= 0.05,
+        "number <= 0.05 (the always-on numerics tap may cost at "
+        "most 5%)"),
+    "canary_parity": (
+        lambda v: v is True,
+        "true (the sentinel must be invisible to tenant tokens)"),
+}
+
+
+def _check_canary_fields(name: str, rec: dict) -> list:
+    err = rec.get("canary_leg_error")
+    if err is not None:
+        if isinstance(err, str) and err:
+            return []  # leg failed and says why — valid record
+        return [f"{name}: canary_leg_error must be a non-empty "
+                f"string, got {err!r}"]
+    errs = []
+    for key, (ok, want) in CANARY_FIELDS.items():
+        if not ok(rec.get(key)):
+            errs.append(f"{name}: schema>=7 record needs {key} "
                         f"({want}), got {rec.get(key)!r}")
     return errs
 

@@ -45,6 +45,13 @@ def _expected(session: int, max_tokens: int) -> list[int]:
 
 
 def _session_of(rid: str) -> int:
+    if rid.startswith("vdt-canary-"):
+        # Canary probes are content-addressed: healthy replicas must
+        # produce the SAME stream for a golden prompt in EVERY round
+        # (the reference journal replays across rounds), so the stub
+        # keys the token function on the prompt slot — rounds rotate
+        # through the 4 golden prompts — never on the replica.
+        return int(rid.split("-")[-2]) % 4
     return int(rid.split("-")[-1])
 
 
@@ -735,3 +742,186 @@ def test_chaos_soak_seeded_faults(monkeypatch):
     assert dp2.fleet.scale_ins >= 2
     for i in range(8):
         col2.assert_exact(f"s-{i}", mt)
+
+
+# ---------------------------------------------------------------------------
+# Correctness sentinel (ISSUE 20): canary probes -> suspicion ->
+# fleet quarantine, the numerics feed, and the inert default.
+# ---------------------------------------------------------------------------
+def _drive_canary_rounds(dp, n: int, timeout_s: float = 5.0) -> None:
+    """Serve every live stub and pump the MP receive path until ``n``
+    more canary rounds have resolved (recv_outputs's tick injects due
+    probes; absorption resolves the round)."""
+    plane = dp.correctness
+    target = plane._round_idx + n
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for c in dp.clients:
+            if not getattr(c, "dead", False):
+                c.serve()
+        dp.recv_outputs(timeout_ms=10)
+        if plane._round_idx >= target and plane._round is None:
+            return
+    raise AssertionError(f"canary rounds did not resolve "
+                         f"(idx={plane._round_idx}, want {target})")
+
+
+def _canary_env(**extra) -> dict:
+    # Pin min replicas so the idle autoscaler can't scale-in a healthy
+    # replica mid-drill (canaries carry no schedulable load).
+    env = {"VDT_CORRECTNESS": "1", "VDT_CANARY_INTERVAL_S": "0",
+           "VDT_CANARY_QUARANTINE_N": "2", "VDT_FLEET_SIGNALS": "1",
+           "VDT_NUMERICS_DRIFT_FRAC": "0.5",
+           "VDT_FLEET_MIN_REPLICAS": "8"}
+    env.update(extra)
+    return env
+
+
+def test_correctness_off_is_inert(monkeypatch):
+    """VDT_CORRECTNESS unset (the default): no plane object, no canary
+    traffic, no correctness/numerics stats keys — the revert pin."""
+    dp = make_fleet(monkeypatch)
+    assert dp.correctness is None
+    dp.add_request(_req("s-0"))
+    for _ in range(10):
+        for c in dp.clients:
+            c.serve()
+        dp.recv_outputs(timeout_ms=10)
+    for c in dp.clients:
+        assert not any(r.request_id.startswith("vdt-canary-")
+                       for r in c.added)
+    agg = dp._aggregate_stats([{}, {}], indices=[0, 1])
+    assert "correctness" not in agg and "numerics" not in agg
+
+
+def test_canary_clean_rounds_self_seed_and_stay_quiet(monkeypatch):
+    """Healthy fleet: the first round per golden prompt self-seeds the
+    reference journal, every later round scores clean — zero
+    divergences, zero suspects (the false-positive budget is zero)."""
+    dp = make_fleet(monkeypatch, **_canary_env())
+    plane = dp.correctness
+    assert plane is not None
+    _drive_canary_rounds(dp, 8)
+    stats = plane.get_stats()
+    assert sum(stats["probes"].values()) >= 16
+    assert stats["divergences"] == {}
+    assert plane.suspects() == {}
+    assert stats["journal_entries"] == 4  # one per golden prompt
+    assert stats["quarantine_hints"] == 0
+    # Canaries never leaked into tenant bookkeeping.
+    assert not dp._requests and not dp._progress
+
+
+def test_canary_flip_token_detection_to_quarantine(monkeypatch):
+    """The e2e drill: ``canary.flip_token`` perturbs replica 1's canary
+    stream -> divergence within the first corrupted probe (<= 3 probe
+    acceptance bound) -> suspect gauge isolates replica 1 only -> a
+    second strike emits the quarantine hint -> the fleet controller
+    force-cycles the replica through the shared wedge rung."""
+    dp = make_fleet(monkeypatch, **_canary_env())
+    plane = dp.correctness
+    # Four clean rounds seed the journal for every golden prompt (a
+    # 2-replica tie needs the reference as tiebreaker).
+    _drive_canary_rounds(dp, 4)
+    assert plane.divergences == {}
+    p0 = plane.probes.get(1, 0)
+    # Absorb order interleaves r0,r1 per cycle: rate 0.5 fires on every
+    # 2nd delta — always replica 1.
+    fi.inject("canary.flip_token", rate=0.5)
+    try:
+        _drive_canary_rounds(dp, 1)
+        assert plane.probes.get(1, 0) - p0 <= 3  # detection bound
+        assert sum(plane.divergences.get(1, {}).values()) >= 1
+        assert plane.suspects() == {1: 1}
+        assert plane.quarantine_hints_emitted == 0  # one strike so far
+        _drive_canary_rounds(dp, 1)  # second strike
+    finally:
+        fi.clear("canary.flip_token")
+    assert plane.quarantine_hints_emitted == 1
+    assert dp.fleet.quarantines == 0  # hint pending, not yet forwarded
+    _tick(dp)  # forwards the hint; fleet.tick() actuates it
+    assert dp.fleet.quarantines == 1
+    assert dp.fleet.get_stats()["quarantines"] == 1
+    assert 1 in dp._down
+    # Replica 0 was never suspected and keeps serving.
+    assert 0 not in dp._down
+    # The cycled slot's suspicion history died with it.
+    assert plane.suspects() == {}
+    # The sentinel actuated through the shared rung: no failover, no
+    # wedge counted.
+    assert dp.replica_failovers == 0
+    assert dp.fleet.wedge_cycles == 0
+
+
+def test_canary_vote_isolates_minority_on_three_replicas(monkeypatch):
+    """With >= 3 replicas the cross-replica vote alone dates the odd
+    one out (cause ``vote``) — no journal reference needed: corruption
+    older than the journal cannot hide."""
+    dp = make_fleet(monkeypatch, n=3, **_canary_env())
+    plane = dp.correctness
+    # Absorb order interleaves r0,r1,r2: rate 1/3 fires on every 3rd
+    # delta — always replica 2. No clean round first: the vote must
+    # work with an unseeded journal.
+    fi.inject("canary.flip_token", rate=1 / 3)
+    try:
+        _drive_canary_rounds(dp, 1)
+    finally:
+        fi.clear("canary.flip_token")
+    assert plane.divergences.get(2, {}).get("vote", 0) >= 1
+    assert plane.suspects() == {2: 1}
+    # The corrupted round never seeded the journal (not unanimous).
+    assert plane.get_stats()["journal_entries"] == 0
+
+
+def test_numerics_nan_inject_feeds_quarantine(monkeypatch):
+    """``numerics.nan_inject`` poisons one replica's tap harvest; the
+    nan_steps delta rides the DP stats merge into the suspicion ladder
+    and (quarantine_n=1) straight to a fleet quarantine hint."""
+    import numpy as np
+
+    from vllm_distributed_tpu.correctness_plane import NumericsTap
+    dp = make_fleet(monkeypatch, **_canary_env(
+        VDT_CANARY_INTERVAL_S="1000", VDT_CANARY_QUARANTINE_N="1"))
+    plane = dp.correctness
+    tap = NumericsTap()
+    clean = np.array([0.0, 1.0, 2.0], dtype=np.float32)
+    fi.inject("numerics.nan_inject", rate=1.0, max_fires=1)
+    try:
+        tap.dispatch(clean)
+        tap.dispatch(clean)  # harvests the poisoned previous step
+    finally:
+        fi.clear("numerics.nan_inject")
+    bad = tap.stats()
+    assert bad["nan_steps"] == 1
+    healthy = {"nan_steps": 0, "entropy_window_mean": 1.0,
+               "window_steps": 4}
+    agg = dp._aggregate_stats(
+        [{"numerics": healthy}, {"numerics": bad}], indices=[0, 1])
+    # Per-replica numerics maps merge keyed by replica, never summed.
+    assert set(agg["numerics"]) == {0, 1}
+    assert agg["numerics"][1]["nan_steps"] == 1
+    assert plane.suspects() == {1: 1}
+    assert plane.divergences[1] == {"nan_logits": 1}
+    assert plane.quarantine_hints_emitted == 1
+    _tick(dp)
+    assert dp.fleet.quarantines == 1
+    assert 1 in dp._down and 0 not in dp._down
+
+
+def test_quarantine_hint_without_signals_is_dropped(monkeypatch):
+    """VDT_FLEET_SIGNALS=0: the sentinel still detects and raises the
+    suspect gauge, but the fleet never actuates — hints are a gated
+    SIGNAL, not a new actuation path."""
+    dp = make_fleet(monkeypatch, **_canary_env(VDT_FLEET_SIGNALS="0"))
+    plane = dp.correctness
+    _drive_canary_rounds(dp, 4)  # seed every golden prompt
+    fi.inject("canary.flip_token", rate=0.5)
+    try:
+        _drive_canary_rounds(dp, 2)
+    finally:
+        fi.clear("canary.flip_token")
+    assert plane.suspects() == {1: 1}
+    assert plane.quarantine_hints_emitted == 1
+    _tick(dp, 3)
+    assert dp.fleet.quarantines == 0
+    assert 1 not in dp._down

@@ -69,6 +69,35 @@ def test_hung_experimental_platform_falls_back_in_seconds(bench,
     assert sum("attempt" in line for line in bench._PROBE_LOG) == 2
 
 
+def test_repeating_warning_banner_is_hung_not_timeout(bench,
+                                                      monkeypatch):
+    """BENCH_r05 regression: a hung plugin that RE-PRINTS its
+    experimental banner every ~0.5 s keeps stderr growing forever, so a
+    quiet-clock based on raw growth never expires and the old probe
+    burned the full attempt timeout ('timeout' verdict) twice.  The
+    liveness clock must only count novel (non-warning) content: the
+    repeating banner probe is classified 'hung-warning' inside the
+    liveness window and abandoned after the single confirmation
+    retry."""
+    monkeypatch.setattr(
+        bench, "_PROBE", (
+            "import sys, time\n"
+            "while True:\n"
+            "    t = time.strftime('%H:%M:%S')\n"
+            "    sys.stderr.write('WARNING:' + t + ':jax._src.xla_bridge"
+            ":905: Platform \\'axon\\' is experimental and not all JAX "
+            "functionality may be correctly supported!\\n')\n"
+            "    sys.stderr.flush()\n"
+            "    time.sleep(0.5)\n"))
+    t0 = time.monotonic()
+    assert bench._probe_accelerator() is False
+    elapsed = time.monotonic() - t0
+    assert elapsed < 25, f"fallback took {elapsed:.1f}s"
+    hung = [line for line in bench._PROBE_LOG if "hung-warning" in line]
+    assert len(hung) == 2  # initial verdict + extended confirmation
+    assert not any("timeout" in line for line in bench._PROBE_LOG)
+
+
 def test_slow_but_healthy_init_survives_first_hung_verdict(bench,
                                                            monkeypatch):
     """A platform that prints the warning, stays silent past the first

@@ -59,6 +59,14 @@ GOOD_V6_TPU = {
     "trace_flow_links": 2,
 }
 
+GOOD_V7_TPU = {
+    **GOOD_V6_TPU, "schema_version": 7,
+    "canary_soak_probes": 60, "canary_false_positives": 0,
+    "canary_detection_probes": 1, "canary_vote_attribution": True,
+    "canary_quarantine_hint": True, "canary_overhead_frac": 0.009,
+    "canary_parity": True,
+}
+
 
 def test_repo_records_are_clean():
     res = _run()
@@ -281,6 +289,49 @@ def test_v6_trace_leg_error_is_accepted(tmp_path):
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 0, res.stderr
     rec["trace_leg_error"] = ""
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+
+
+def test_good_v7_record_passes(tmp_path):
+    _write(tmp_path, "BENCH_x.json", GOOD_V7_TPU)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+
+
+def test_v7_record_without_canary_fields_fails(tmp_path):
+    rec = dict(GOOD_V7_TPU)
+    del rec["canary_soak_probes"]
+    del rec["canary_vote_attribution"]
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "canary_soak_probes" in res.stderr
+    assert "canary_vote_attribution" in res.stderr
+
+
+def test_v7_false_positives_and_slow_detection_fail(tmp_path):
+    # The sentinel's acceptance bounds are hard: ANY false positive on
+    # the clean soak, or detection slower than 3 probes, is drift.
+    _write(tmp_path, "BENCH_a.json",
+           dict(GOOD_V7_TPU, canary_false_positives=1))
+    _write(tmp_path, "BENCH_b.json",
+           dict(GOOD_V7_TPU, canary_detection_probes=7))
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 1
+    assert "canary_false_positives" in res.stderr
+    assert "canary_detection_probes" in res.stderr
+
+
+def test_v7_canary_leg_error_is_accepted(tmp_path):
+    rec = {k: v for k, v in GOOD_V7_TPU.items()
+           if not k.startswith("canary_")}
+    rec["canary_leg_error"] = "RuntimeError: needs >= 2 devices"
+    _write(tmp_path, "BENCH_x.json", rec)
+    res = _run("--dir", str(tmp_path))
+    assert res.returncode == 0, res.stderr
+    rec["canary_leg_error"] = ""
     _write(tmp_path, "BENCH_x.json", rec)
     res = _run("--dir", str(tmp_path))
     assert res.returncode == 1

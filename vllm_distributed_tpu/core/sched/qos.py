@@ -56,6 +56,13 @@ logger = init_logger(__name__)
 
 # Tenantless requests share one deficit/quota bucket.
 DEFAULT_KEY = "_anon"
+# Reserved tenant of correctness-sentinel canary probes
+# (correctness_plane.py). Canaries ride the real serving path but are
+# QoS-exempt end to end: never bucketed, never charged, never clipped,
+# never quota-victimized, dropped from the vdt:tenant_* families — a
+# probe must measure the fleet, not perturb (or be perturbed by) any
+# tenant's fairness accounting.
+CANARY_TENANT = "_canary"
 # Tenants past VDT_QOS_MAX_TRACKED_TENANTS hash into this many shared
 # overflow buckets, bounding metric-label cardinality at cap + this.
 OVERFLOW_BUCKETS = 8
@@ -104,6 +111,8 @@ def bucket_tenant(tenant: Optional[str], tracked: set,
     goodput accounting so both label spaces stay bounded and agree."""
     if not tenant:
         return DEFAULT_KEY
+    if tenant == CANARY_TENANT:
+        return CANARY_TENANT  # reserved; never counts against the cap
     if tenant in tracked:
         return tenant
     if len(tracked) < max_tracked:
@@ -165,6 +174,8 @@ class QosState:
     def key_of(self, request) -> str:
         key = bucket_tenant(request.tenant, self._tracked,
                             self.max_tracked)
+        if key == CANARY_TENANT:
+            return key  # QoS-exempt: no weight memo, no DRR state
         # Memo the bucket's weight from the traffic actually seen (a
         # bucket mixing classes takes the latest request's class).
         self._bucket_weight[key] = self.weight_of(key, request.priority)
@@ -183,10 +194,14 @@ class QosState:
         decode_need: dict[str, int] = {}
         for r in waiting:
             k = self.key_of(r)
+            if k == CANARY_TENANT:
+                continue  # canaries neither earn nor contest deficit
             active.add(k)
             competing.add(k)
         for r in running:
             k = self.key_of(r)
+            if k == CANARY_TENANT:
+                continue
             active.add(k)
             if r.num_computed_tokens < r.num_prompt_tokens:
                 competing.add(k)
@@ -207,6 +222,8 @@ class QosState:
     def charge(self, key: str, tokens: int, decode: bool = False) -> None:
         """Every granted token draws down the tenant's deficit (floored
         so work-conserving over-grants can't build unbounded debt)."""
+        if key == CANARY_TENANT:
+            return
         self.granted_tokens[key] = (self.granted_tokens.get(key, 0)
                                     + int(tokens))
         floor = -DEFICIT_CARRY_STEPS * self.token_budget
@@ -225,6 +242,8 @@ class QosState:
         decode request still unserved this step (positional budget
         exhaustion must not starve decodes sitting later in the
         running list)."""
+        if key == CANARY_TENANT:
+            return want  # admission-exempt: a probe is never clipped
         allowed = want
         if any(k != key and self.deficit.get(k, 0.0) > 0.0
                for k in self._competing):
@@ -240,6 +259,8 @@ class QosState:
         waiting tenant holds credit — grant in full (work conserving);
         otherwise clip to the deficit, never below one token (the
         selected tenant must make progress)."""
+        if key == CANARY_TENANT:
+            return want
         d = self.deficit.get(key, 0.0)
         if d <= 0:
             return want
@@ -250,7 +271,13 @@ class QosState:
         """The waiting tenant to admit next: largest deficit wins, ties
         go to the earliest queue position. Under pool pressure
         (``usage >= QUOTA_PRESSURE``) tenants over their soft KV quota
-        are passed over while an under-quota tenant is waiting."""
+        are passed over while an under-quota tenant is waiting. A
+        waiting canary probe always admits first: it is tiny, rare
+        (one per VDT_CANARY_INTERVAL_S per replica) and its whole point
+        is to measure the serving path, not to queue behind deficit
+        arithmetic it is exempt from."""
+        if CANARY_TENANT in keys_in_order:
+            return CANARY_TENANT
         candidates = keys_in_order
         if self.quota_blocks > 0 and usage >= QUOTA_PRESSURE:
             under = [k for k in keys_in_order
@@ -301,6 +328,8 @@ class QosState:
                    key=lambda r: (r.priority, r.arrival_time))
 
     def note_preemption(self, key: str) -> None:
+        if key == CANARY_TENANT:
+            return
         self.preemptions[key] = self.preemptions.get(key, 0) + 1
 
     # ------------------------------------------------------------------
@@ -311,6 +340,7 @@ class QosState:
         leaves per tenant so the DP merge can sum them per label."""
         keys = (set(self.granted_tokens) | set(self.preemptions)
                 | set(held_by_tenant))
+        keys.discard(CANARY_TENANT)  # probes are not tenant traffic
         return {
             k: {
                 "granted_tokens": int(self.granted_tokens.get(k, 0)),

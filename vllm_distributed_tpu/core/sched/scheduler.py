@@ -1270,6 +1270,8 @@ class Scheduler:
             n = self._num_blocks_of(r.request_id)
             if n:
                 k = self.qos.key_of(r)
+                if k == qos_mod.CANARY_TENANT:
+                    continue  # correctness probes hold no tenant quota
                 held[k] = held.get(k, 0) + n
         return held
 

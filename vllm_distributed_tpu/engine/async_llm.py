@@ -88,6 +88,14 @@ class AsyncLLM:
 
     # ------------------------------------------------------------------
     @property
+    def engine_core(self):
+        """The underlying engine client, under the attribute name the
+        API server's introspection paths probe (health suspicion, the
+        fleet goodput feed, /debug/correctness). The sync LLM engine
+        exposes the same name directly."""
+        return self.core
+
+    @property
     def errored(self) -> bool:
         return self._dead_error is not None
 

@@ -212,6 +212,15 @@ class DPEngineClient(EngineCoreClient):
                 from vllm_distributed_tpu.engine.fleet import \
                     FleetController
                 self.fleet = FleetController(self, config)
+        # Correctness sentinel (correctness_plane.py): canary rounds,
+        # reference journal, numerics drift, quarantine hints. None by
+        # default — VDT_CORRECTNESS=0 constructs nothing and every hook
+        # below is a `is not None` short-circuit.
+        self.correctness = None
+        if envs.VDT_CORRECTNESS:
+            from vllm_distributed_tpu.correctness_plane import \
+                CorrectnessPlane
+            self.correctness = CorrectnessPlane(events=self.events)
 
     # ------------------------------------------------------------------
     def _pick_replica(self, request: Optional[EngineCoreRequest] = None,
@@ -415,6 +424,24 @@ class DPEngineClient(EngineCoreClient):
     def _mark_finished_locked(
             self,
             outs: list[EngineCoreOutput]) -> list[EngineCoreOutput]:
+        if self.correctness is not None:
+            # Canary absorption FIRST — before disagg interception and
+            # before any journal/owner/coordinator bookkeeping. Canary
+            # outputs never leave the DP client (that is what keeps
+            # probes out of SLO scoring and the output processor), and
+            # canaries were never +1'd at the coordinator, so they must
+            # not reach the finished_per negative-delta loop either.
+            kept = []
+            for o in outs:
+                if not self.correctness.owns(o.req_id):
+                    kept.append(o)
+                    continue
+                self.correctness.on_output(o)
+                if o.finished:
+                    i = self._owner.pop(o.req_id, None)
+                    if i is not None:
+                        self._live[i].discard(o.req_id)
+            outs = kept
         if self.disagg is not None:
             # Disagg interception BEFORE any journal/owner bookkeeping:
             # a finished prefill-stage output is swallowed (its sampled
@@ -466,6 +493,10 @@ class DPEngineClient(EngineCoreClient):
             return
         self._down.add(i)
         self.replica_failovers += 1
+        if self.correctness is not None:
+            # The replica's suspicion history (and any in-flight canary)
+            # died with it; a respawn starts clean.
+            self.correctness.forget_replica(i)
         if self.router is not None:
             # The dead replica's KV pool died with it: drop every
             # affinity hint pointing there. Migrated requests re-home
@@ -589,11 +620,46 @@ class DPEngineClient(EngineCoreClient):
     def _tick(self) -> None:
         """Periodic maintenance hook on the output paths: the fleet
         controller's loop when VDT_FLEET=1 (which subsumes the
-        resurrection probe), the legacy probe otherwise."""
+        resurrection probe), the legacy probe otherwise. The
+        correctness sentinel's canary injector rides the same tick —
+        its quarantine hints land BEFORE fleet.tick() so a hint emitted
+        this tick can actuate this tick."""
+        if self.correctness is not None:
+            self._canary_tick()
         if self.fleet is not None:
             self.fleet.tick()
         else:
             self._maybe_resurrect()
+
+    def _canary_tick(self) -> None:
+        """Inject due canary probes and forward quarantine hints. A
+        canary bypasses add_request/_admit entirely: no failover
+        journal entry (a probe must pin to — and die with — its
+        replica), no router residency, no coordinator admission (it is
+        admission-exempt by construction). It IS registered in
+        _owner/_live so the output paths keep stepping and polling its
+        replica like any in-flight request."""
+        plane = self.correctness
+        with self._lock:
+            targets = [i for i in range(len(self.clients))
+                       if i not in self._down and i not in self._retired
+                       and i not in self._no_place]
+            for i, req in plane.due_probes(targets):
+                try:
+                    self.clients[i].add_request(req)
+                except Exception as e:  # noqa: BLE001 - replica dying
+                    # mid-probe; its health is the failover ladder's
+                    # job, the round just proceeds without it.
+                    logger.warning(
+                        "canary submit to replica %d failed: %s", i, e)
+                    plane.on_submit_failed(req.request_id)
+                    continue
+                self._owner[req.request_id] = i
+                self._live[i].add(req.request_id)
+            if self.fleet is not None:
+                hints = plane.quarantine_hints()
+                if hints:
+                    self.fleet.observe_quarantine(hints)
 
     # ------------------------------------------------------------------
     # Elastic-fleet primitives (engine/fleet.py; balancer lock held)
@@ -678,6 +744,9 @@ class DPEngineClient(EngineCoreClient):
             self.clients[i] = client
             self._retired.discard(i)
             self._down.discard(i)
+            if self.correctness is not None:
+                # A reused slot is a NEW engine to the sentinel too.
+                self.correctness.forget_replica(i)
             # A reused slot is a NEW engine: fresh restart budget,
             # clean router state (on_replica_down also covers the
             # stale-residency case of a long-retired slot).
@@ -717,6 +786,9 @@ class DPEngineClient(EngineCoreClient):
                 self.disagg.reset()
             if self.fleet is not None:
                 self.fleet.reset()
+            if self.correctness is not None:
+                for i in range(len(self.clients)):
+                    self.correctness.forget_replica(i)
 
     # ------------------------------------------------------------------
     def get_output(self) -> list[EngineCoreOutput]:
@@ -1131,6 +1203,25 @@ class DPEngineClient(EngineCoreClient):
         # shape, so its counters attach exactly too.
         if fleet is not None:
             agg["fleet"] = fleet.get_stats()
+        # Correctness sentinel: the runners' numerics snapshots are
+        # PER-REPLICA by construction (entropy means, NaN counters,
+        # histograms — a cross-replica numeric sum would erase exactly
+        # the per-replica drift the sentinel exists to see), so they
+        # re-key by replica index here; dead replicas fell out of
+        # ``per`` at the get_stats poll, so a mid-scrape death is
+        # excluded naturally. The same poll drives the plane's
+        # NaN-delta / drift ladders, and the plane's own counters
+        # attach exactly (one plane owns the fleet's canaries).
+        plane = getattr(self, "correctness", None)
+        if indices is not None:
+            numerics = {i: s["numerics"] for i, s in zip(indices, per)
+                        if isinstance(s.get("numerics"), dict)}
+            if numerics:
+                agg["numerics"] = numerics
+                if plane is not None:
+                    plane.observe_numerics(numerics)
+        if plane is not None:
+            agg["correctness"] = plane.get_stats()
         return agg
 
     def get_stats(self) -> dict:

@@ -124,7 +124,12 @@ class FleetController:
         self.scale_ins = 0
         self.wedge_cycles = 0
         self.warm_start_pages = 0
+        self.quarantines = 0
         self.freezes: dict[str, int] = {}
+        # Correctness-sentinel quarantine hints (observe_quarantine):
+        # replica -> cause, consumed by _check_quarantine on the next
+        # tick. Only populated under VDT_FLEET_SIGNALS.
+        self._quarantine_hints: dict[int, str] = {}
         # Per-replica stats snapshot + receipt instant (monotonic);
         # in-process replicas refresh synchronously each tick,
         # subprocess replicas are fed passively by the stats polls that
@@ -214,6 +219,20 @@ class FleetController:
         else:
             self._goodput.pop("_slo_burn", None)
 
+    def observe_quarantine(self, hints: dict) -> None:
+        """Replica-quarantine hints from the correctness sentinel
+        ({replica: cause} — sustained canary divergence or numerics
+        strikes). Gated on VDT_FLEET_SIGNALS like the goodput feed: a
+        hint is a SIGNAL into the existing actuator, never a new
+        actuation path — _check_quarantine drains it through the same
+        force-cycle rung (budget, fence, drain-migrate, probed respawn)
+        the wedge detector uses."""
+        if not self.signals or not isinstance(hints, dict):
+            return
+        for i, cause in hints.items():
+            if isinstance(i, int):
+                self._quarantine_hints[i] = str(cause)
+
     def _freeze(self, reason: str) -> None:
         self.freezes[reason] = self.freezes.get(reason, 0) + 1
         self.events.record("", ev.FLEET_FREEZE, {"reason": reason})
@@ -281,6 +300,7 @@ class FleetController:
             if not self._actuation_allowed(now):
                 return
             self._check_wedges(now)
+            self._check_quarantine(now)
             if not self._draining:
                 # One structural action in flight at a time: scale and
                 # re-split decisions wait for the drain to land.
@@ -362,15 +382,25 @@ class FleetController:
         only rung this degradation lands on is wedge_cycles), take it
         out of rotation, and let the folded probe restart it through
         its PR-2 restart budget."""
-        if not self._budget_ok():
-            return
-        if not self._fence("force_cycle"):
-            return
         c = self.client
         logger.error(
             "fleet: replica %d WEDGED (steps stalled > %.1fs with %d "
             "live request(s)); force-cycling", i, self.wedge_s,
             len(c._live[i]))
+        if self._cycle_out(i, now):
+            self.wedge_cycles += 1
+            self.events.record("", ev.FLEET_WEDGE_CYCLE, {"replica": i})
+
+    def _cycle_out(self, i: int, now: float) -> bool:
+        """The shared force-cycle actuation rung (wedge detector and
+        correctness quarantine): budget, fence, out of rotation,
+        journal-migrate, immediate probed respawn. True when actuated
+        (the caller owns the cause-specific counter/event)."""
+        if not self._budget_ok():
+            return False
+        if not self._fence("force_cycle"):
+            return False
+        c = self.client
         c._down.add(i)
         if c.router is not None:
             c.router.on_replica_down(i)
@@ -378,8 +408,32 @@ class FleetController:
             c.coordinator.set_health(i, False, clear=True)
         c._drain_migrate_locked(i, report=False)
         c._next_probe[i] = now  # probe immediately, through the budget
-        self.wedge_cycles += 1
-        self.events.record("", ev.FLEET_WEDGE_CYCLE, {"replica": i})
+        return True
+
+    def _check_quarantine(self, now: float) -> None:
+        """Drain the correctness sentinel's quarantine hints through
+        the force-cycle rung: drain + respawn via the PR-16 machinery,
+        never a new actuation path. A hint for a replica already out of
+        rotation (or mid-drain) is dropped — its cycle is in flight."""
+        if not self._quarantine_hints:
+            return
+        hints, self._quarantine_hints = self._quarantine_hints, {}
+        c = self.client
+        active = set(self._active())
+        for i, cause in sorted(hints.items()):
+            if i not in active or i in self._draining or i in c._down:
+                continue
+            logger.error(
+                "fleet: replica %d QUARANTINED by correctness sentinel "
+                "(%s); force-cycling", i, cause)
+            if self._cycle_out(i, now):
+                self.quarantines += 1
+                self.events.record("", ev.FLEET_QUARANTINE,
+                                   {"replica": i, "cause": cause})
+                if getattr(c, "correctness", None) is not None:
+                    # The slot respawns as a fresh engine: clear its
+                    # suspicion so the new replica starts clean.
+                    c.correctness.forget_replica(i)
 
     # -- Scaling --------------------------------------------------------
     def _occupancy(self, members: list[int]) -> float:
@@ -695,6 +749,7 @@ class FleetController:
         self._draining.clear()
         self._snap.clear()
         self._step_marks.clear()
+        self._quarantine_hints.clear()
         self._high_ticks = self._low_ticks = self._resplit_ticks = 0
         self._resplit_dir = None
         self._last_tick = float("-inf")
@@ -715,5 +770,6 @@ class FleetController:
                          if c.disagg is not None else 0),
             "wedge_cycles": self.wedge_cycles,
             "warm_start_pages": self.warm_start_pages,
+            "quarantines": self.quarantines,
             "freezes": dict(self.freezes),
         }

@@ -199,6 +199,13 @@ async def health(request: web.Request) -> web.Response:
                            "stats", None), "burn", None)
     if burn is not None and burn.degraded():
         return web.Response(text="OK (slo degraded)")
+    # Correctness sentinel (VDT_CORRECTNESS=1): live replica suspicion
+    # flags the same way — serving continues (quarantine is the fleet
+    # controller's call), the body warns operators.
+    plane = getattr(getattr(engine, "engine_core", None),
+                    "correctness", None)
+    if plane is not None and plane.suspects():
+        return web.Response(text="OK (replica suspect)")
     return web.Response(text="OK")
 
 
@@ -442,6 +449,9 @@ async def _debug_engine_json(app: web.Application) -> dict:
         "admission": admission,
         # SLO burn-rate watchdog (None when no SLO target is set).
         "slo_burn": slo,
+        # Correctness sentinel summary (None while VDT_CORRECTNESS=0;
+        # the full view lives at /debug/correctness).
+        "correctness": stats.get("correctness"),
         # Front-end ledger merged with the core-side events absorbed
         # from /metrics scrapes (the draining stats consumer).
         "recent_events": ev.merge_event_lists(
@@ -606,6 +616,36 @@ async def debug_trace(request: web.Request) -> web.Response:
     if request.query.get("format") == "raw":
         return web.json_response(trace)
     return web.json_response(trace_plane.perfetto(trace))
+
+
+async def debug_correctness(request: web.Request) -> web.Response:
+    """Correctness-sentinel introspection (admission-exempt, like every
+    /debug endpoint — registered outside the admission gate's guarded
+    routes): canary probe/divergence counters, per-replica suspicion,
+    the numerics snapshots and the quarantine tally. Requires
+    VDT_CORRECTNESS=1."""
+    engine = request.app[ENGINE_KEY]
+    plane = getattr(getattr(engine, "engine_core", None),
+                    "correctness", None)
+    if plane is None:
+        return web.json_response(
+            {"error": "correctness sentinel disabled "
+                      "(set VDT_CORRECTNESS=1)"},
+            status=404)
+    try:
+        # include_events=False: the destructive event drain belongs to
+        # the /metrics scrape (the debug_engine discipline).
+        stats = await asyncio.wait_for(
+            engine.get_stats(include_events=False), timeout=2.0)
+    except Exception:  # noqa: BLE001 - engine busy/dead; the plane's
+        # own counters below still serve
+        stats = {}
+    return web.json_response({
+        "correctness": stats.get("correctness") or plane.get_stats(),
+        "numerics": stats.get("numerics"),
+        "fleet_quarantines": (stats.get("fleet") or {}).get(
+            "quarantines"),
+    })
 
 
 def _thread_stacks() -> str:
@@ -1642,6 +1682,7 @@ def build_app(engine: AsyncLLM, model_name: str,
     app.router.add_get("/debug/kv_cache", debug_kv_cache)
     app.router.add_get("/debug/perf", debug_perf)
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/correctness", debug_correctness)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/embeddings", embeddings)

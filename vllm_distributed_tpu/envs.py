@@ -553,6 +553,32 @@ environment_variables: dict[str, Callable[[], Any]] = {
     "VDT_SLO_TARGET":
     lambda: min(0.9999, max(0.5, float(
         os.getenv("VDT_SLO_TARGET", "0.99")))),
+    # --- Correctness sentinel (correctness_plane.py) --------------------
+    # Master switch: "1" constructs the CorrectnessPlane on the DP
+    # front-end (canary probe injector + reference journal +
+    # cross-replica vote + numerics drift watch) and the model runner's
+    # pre-sampling numerics tap. "0" (default) constructs NOTHING — no
+    # injector, no extra jitted program, no new stats keys, old wire
+    # bytes. Read ONCE per component at construction.
+    "VDT_CORRECTNESS":
+    lambda: os.getenv("VDT_CORRECTNESS", "0") == "1",
+    # Seconds between canary probe rounds (each round fans one pinned
+    # greedy golden prompt out to every in-rotation DP replica). <= 0
+    # probes on every maintenance tick (tests/bench drills).
+    "VDT_CANARY_INTERVAL_S":
+    lambda: float(os.getenv("VDT_CANARY_INTERVAL_S", "30")),
+    # Consecutive divergent canary rounds before a replica's suspicion
+    # hardens into a fleet quarantine hint (and the vdt:replica_suspect
+    # gauge latches). 2 keeps detection within <= 3 probes of a seeded
+    # corruption while one transient mismatch never quarantines.
+    "VDT_CANARY_QUARANTINE_N":
+    lambda: max(1, int(os.getenv("VDT_CANARY_QUARANTINE_N", "2"))),
+    # Numerics drift threshold: a replica whose rolling logits-entropy
+    # mean deviates from the fleet mean by more than this fraction of
+    # the fleet mean is drift-suspect. <= 0 disables the drift detector
+    # while keeping the NaN watch and histograms.
+    "VDT_NUMERICS_DRIFT_FRAC":
+    lambda: float(os.getenv("VDT_NUMERICS_DRIFT_FRAC", "0.5")),
     # Deterministic fault injection: "name:rate[@delay_s],..." over the
     # named fault points of utils/fault_injection.py (kv_pull.drop,
     # kv_pull.delay, registry.truncate, engine_core.die,

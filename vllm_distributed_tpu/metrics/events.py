@@ -70,6 +70,12 @@ DISAGG_HANDOFF = "disagg_handoff"  # prefill producer re-admitted the
 KV_TIER_PROMOTE = "kv_tier_promote"  # spill-tier pages scattered back
 KV_TIER_DEMOTE = "kv_tier_demote"  # evicted pages demoted to a tier
 # (page-level batch; rid="")
+# Correctness sentinel (correctness_plane.py; both rid="" — the
+# detail map carries the replica and the divergence cause).
+CANARY_DIVERGENCE = "canary_divergence"  # canary probe strayed from
+# the reference journal / the cross-replica vote
+FLEET_QUARANTINE = "fleet_quarantine"  # suspect replica force-cycled
+# on the sentinel's quarantine hint (VDT_FLEET_SIGNALS)
 
 # Canonical event registry: every name recordable via
 # ``EventRecorder.record`` with a one-line operator-facing doc.
@@ -111,6 +117,8 @@ EVENT_REGISTRY: dict[str, str] = {
     DISAGG_HANDOFF: "prefill producer handed the request to decode",
     KV_TIER_PROMOTE: "spill-tier pages scattered back to HBM",
     KV_TIER_DEMOTE: "evicted pages demoted to a spill tier (rid=\"\")",
+    CANARY_DIVERGENCE: "canary probe strayed from reference/vote",
+    FLEET_QUARANTINE: "suspect replica force-cycled on sentinel hint",
 }
 
 

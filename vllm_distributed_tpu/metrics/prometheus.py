@@ -226,6 +226,17 @@ LABELED_METRICS = {
     # SLO burn-rate watchdog (metrics/stats.py BurnRateWatchdog): error
     # budget burn per rolling window (a fixed enum: 1m | 10m).
     "vdt:slo_burn_rate": ("window", ),
+    # Correctness sentinel (correctness_plane.py; VDT_CORRECTNESS=1).
+    # All per-replica — a cross-replica sum would erase exactly the
+    # per-replica divergence the sentinel exists to expose. Causes are
+    # a fixed enum: reference | logprob | vote | timeout | nan_logits
+    # | numerics_drift.
+    "vdt:canary_probes_total": ("replica", ),
+    "vdt:canary_divergences_total": ("replica", "cause"),
+    "vdt:replica_suspect": ("replica", ),
+    "vdt:logits_nan_steps_total": ("replica", ),
+    "vdt:logits_entropy": ("replica", ),
+    "vdt:logits_top_margin": ("replica", ),
 }
 
 
@@ -342,6 +353,10 @@ def _render_fleet(fleet: dict) -> list[str]:
          "counter",
          "Spill-tier pages found by new/converted replicas warm-"
          "starting from the shared tier-2 namespace"),
+        ("quarantines", "vdt:fleet_quarantines_total", "counter",
+         "Suspect replicas force-cycled on the correctness sentinel's "
+         "quarantine hints (VDT_CORRECTNESS + VDT_FLEET_SIGNALS; same "
+         "drain+respawn rung as a wedge cycle)"),
     ):
         if key in fleet:
             lines += [f"# HELP {name} {help_text}",
@@ -688,6 +703,83 @@ def _render_tenants(tenants: dict) -> list[str]:
     return lines
 
 
+def _render_numerics(numerics: dict) -> list[str]:
+    """In-flight numerics watch (correctness_plane.py NumericsTap;
+    VDT_CORRECTNESS=1). DP ships {replica: snapshot} keyed by the
+    aggregator; a single-engine deployment ships the runner's flat
+    snapshot, rendered as replica 0. Per-replica series — NEVER summed:
+    the drift detector's whole signal is replicas disagreeing."""
+    from vllm_distributed_tpu.metrics.stats import render_histogram_lines
+    if "nan_steps" in numerics:
+        numerics = {0: numerics}
+    per = {i: d for i, d in numerics.items() if isinstance(d, dict)}
+    name = "vdt:logits_nan_steps_total"
+    lines = [f"# HELP {name} Pre-sampling steps whose logits carried "
+             "NaN/Inf, per replica (the poisoned step is excluded from "
+             "the entropy/margin histograms)",
+             f"# TYPE {name} counter"]
+    lines += [f'{name}{{replica="{i}"}} {int(d.get("nan_steps", 0))}'
+              for i, d in sorted(per.items())]
+    for name, key, help_text in (
+        ("vdt:logits_entropy", "entropy",
+         "Per-step mean entropy of the pre-sampling logits, per "
+         "replica (the numerics drift detector's primary signal)"),
+        ("vdt:logits_top_margin", "top_margin",
+         "Per-step mean top-1/top-2 logit margin, per replica (margin "
+         "collapse flags quality degradation below the argmax)"),
+    ):
+        lines += [f"# HELP {name} {help_text}",
+                  f"# TYPE {name} histogram"]
+        for i, d in sorted(per.items()):
+            h = d.get(key)
+            if isinstance(h, dict):
+                lines += render_histogram_lines(
+                    name, "", h.get("buckets", ()), h.get("counts", ()),
+                    h.get("sum", 0.0), h.get("count", 0),
+                    label=f'replica="{i}"', header=False)
+    return lines
+
+
+def _render_correctness(cp: dict) -> list[str]:
+    """Canary-probe families (correctness_plane.py; VDT_CORRECTNESS=1).
+    One plane owns the fleet's canaries, so the counters attach exactly
+    — the per-replica maps are labeled at the source, never merged."""
+    lines: list[str] = []
+    probes = cp.get("probes")
+    if isinstance(probes, dict):
+        name = "vdt:canary_probes_total"
+        lines += [f"# HELP {name} Canary probes completed per replica "
+                  "(pinned greedy golden prompts through the real "
+                  "serving path)",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{replica="{i}"}} {int(n)}'
+                  for i, n in sorted(probes.items())]
+    div = cp.get("divergences")
+    if isinstance(div, dict):
+        name = "vdt:canary_divergences_total"
+        lines += [f"# HELP {name} Correctness divergences per replica, "
+                  "by cause (reference = tokens strayed from the "
+                  "journal, logprob = fingerprint drift, vote = "
+                  "cross-replica minority, timeout = probe unanswered, "
+                  "nan_logits = NaN/Inf step, numerics_drift = entropy "
+                  "window strayed from the fleet mean)",
+                  f"# TYPE {name} counter"]
+        lines += [f'{name}{{replica="{i}",cause="{c}"}} {int(n)}'
+                  for i, causes in sorted(div.items())
+                  if isinstance(causes, dict)
+                  for c, n in sorted(causes.items())]
+    suspects = cp.get("suspects")
+    if isinstance(suspects, dict):
+        name = "vdt:replica_suspect"
+        lines += [f"# HELP {name} 1 while the correctness sentinel "
+                  "holds live suspicion against the replica (any "
+                  "strike ladder >= 1; clears on a clean round)",
+                  f"# TYPE {name} gauge"]
+        lines += [f'{name}{{replica="{i}"}} {int(v)}'
+                  for i, v in sorted(suspects.items())]
+    return lines
+
+
 def _render_histogram(name: str, help_text: str, h: dict) -> list[str]:
     from vllm_distributed_tpu.metrics.stats import render_histogram_lines
     return render_histogram_lines(name, help_text, h.get("buckets", ()),
@@ -792,4 +884,12 @@ def render_metrics(stats: dict) -> str:
     fleet = stats.get("fleet")
     if isinstance(fleet, dict) and fleet:
         lines += _render_fleet(fleet)
+    # Correctness sentinel (correctness_plane.py; keys present only
+    # while VDT_CORRECTNESS=1).
+    numerics = stats.get("numerics")
+    if isinstance(numerics, dict) and numerics:
+        lines += _render_numerics(numerics)
+    correctness = stats.get("correctness")
+    if isinstance(correctness, dict):
+        lines += _render_correctness(correctness)
     return "\n".join(lines) + "\n"

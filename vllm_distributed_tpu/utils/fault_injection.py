@@ -122,6 +122,20 @@ but never fired by production code):
   placement (local least-loaded fallback, no actuation — counted in
   ``vdt:fleet_freezes_total{reason="partition"}``), mirroring the
   stale-stats freeze ladder.
+* ``canary.flip_token`` — one replica's canary-probe output is
+  perturbed in flight (correctness_plane.py absorbs per-replica canary
+  outputs in a fixed order, so rate 0.5 on a 2-replica fleet always
+  corrupts the same replica). The drill proves the correctness
+  sentinel's detection ladder end to end: token mismatch → isolated by
+  the cross-replica vote within <= 3 probes → ``vdt:replica_suspect``
+  gauge → fleet replica-quarantine hint (under ``VDT_FLEET_SIGNALS``),
+  with zero false positives on the clean replicas.
+* ``numerics.nan_inject`` — a single NaN lands in one step's
+  pre-sampling logits (consulted by the NumericsTap harvest, so the
+  poisoned step is counted in ``vdt:logits_nan_steps_total`` and
+  excluded from the entropy/margin histograms). Sustained fires climb
+  the numerics strike ladder into the same quarantine path as the
+  canary vote.
 """
 
 import threading
@@ -155,6 +169,8 @@ FAULT_POINTS = (
     "fleet.controller_die",
     "fleet.lease_expire",
     "coordinator.partition",
+    "canary.flip_token",
+    "numerics.nan_inject",
 )
 
 

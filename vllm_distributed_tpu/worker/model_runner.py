@@ -234,6 +234,21 @@ class TPUModelRunner:
 
         self._forward_fn = None
         self._sample_fn = None
+        # Correctness-sentinel numerics watch (correctness_plane.py):
+        # a tiny jitted logits reduction dispatched every
+        # NUMERICS_TAP_STRIDE sample launches (it re-derives logits, so
+        # per-step would double the lm-head cost), harvested one step
+        # behind. Off (None) by default — VDT_CORRECTNESS=0 must keep
+        # this path byte-identical. The countdown starts at 1 so the
+        # first sample of a fresh runner is tapped (deterministic for
+        # drills) and the stride paces steady state.
+        self._numerics = None
+        self._numerics_fn = None
+        self._numerics_countdown = 1
+        from vllm_distributed_tpu import envs
+        if envs.VDT_CORRECTNESS:
+            from vllm_distributed_tpu.correctness_plane import NumericsTap
+            self._numerics = NumericsTap()
         # M-RoPE (Qwen2-VL): per-row ([prompt_len, 3] id table, decode
         # delta); active when the model declares mrope_section.
         self._mrope: dict[int, tuple] = {}
@@ -768,8 +783,24 @@ class TPUModelRunner:
                 logits.reshape(R, S1, logits.shape[-1]), drafts, q_ids,
                 q_probs, md_r, truncate=truncate)
 
+        def numerics(params, hidden_sel):
+            """Correctness-sentinel reduction over the SAME rows the
+            sampler consumes: [nonfinite logits, mean entropy, mean
+            top-1/top-2 margin]. One extra LM-head matmul per step —
+            the sentinel's documented device cost."""
+            logits = model.compute_logits(params, hidden_sel)
+            bad = jnp.sum(~jnp.isfinite(logits)).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            ent = -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+            top2 = jax.lax.top_k(logits, 2)[0]
+            return jnp.stack([
+                bad, jnp.mean(ent), jnp.mean(top2[:, 0] - top2[:, 1])
+            ])
+
         # Donate the caches: XLA aliases them in place of a copy.
         self._forward_fn = jax.jit(forward, donate_argnums=(1, ))
+        if self._numerics is not None:
+            self._numerics_fn = jax.jit(numerics)
         self._plp_fn = jax.jit(prompt_lp)
         self._sample_fn = jax.jit(sample)
         self._sample_ext_fn = jax.jit(sample_ext,
@@ -1987,6 +2018,22 @@ class TPUModelRunner:
                 plp_dev = self._plp_fn(self.params, sel, targets)
         hidden_sel = self._gather_sample_rows(hidden, logits_indices,
                                               mesh=mesh)
+        if self._numerics_fn is not None:
+            # Dispatch-only like the sampler; the tap harvests the
+            # PREVIOUS step's reduction, so this never blocks the step.
+            # Strided (the reduction re-derives logits — an extra
+            # lm-head pass — so tapping every step would be a ~2x
+            # logits cost). Fused multi-step bursts bypass
+            # _launch_sample and are not tapped (documented sentinel
+            # limitation).
+            self._numerics_countdown -= 1
+            if self._numerics_countdown <= 0:
+                from vllm_distributed_tpu.correctness_plane import \
+                    NUMERICS_TAP_STRIDE
+                self._numerics_countdown = NUMERICS_TAP_STRIDE
+                with self._compile_watch(("numerics", n_rows)):
+                    self._numerics.dispatch(
+                        self._numerics_fn(self.params, hidden_sel))
         if spec_q is not None:
             drafts_d, q_ids_d, q_probs_d, truncate = spec_q
             with self._compile_watch(("specv", n_rows, truncate)):
@@ -2625,6 +2672,14 @@ class TPUModelRunner:
                 tokens, _ = self._sample_fn(self.params, hidden_sel, md)
             jax.block_until_ready(tokens)
             n += 1
+            if self._numerics_fn is not None:
+                # Warm the sentinel reduction on the sampler's own row
+                # lattice (discarded — warm-up must not pollute the
+                # tap's histograms/window).
+                with self._compile_watch(("numerics", rows)):
+                    nm = self._numerics_fn(self.params, hidden_sel)
+                jax.block_until_ready(nm)
+                n += 1
             if self.spec_k:
                 from vllm_distributed_tpu.spec_decode.draft_model import \
                     SUPPORT_K
@@ -2693,6 +2748,10 @@ class TPUModelRunner:
             "attn_kernel_calls": dict(self.attn_kernel_calls),
             "precompile_graphs": self.precompile_graphs,
         }
+        if self._numerics is not None:
+            # Correctness-sentinel numerics (per replica; the DP merge
+            # keys this by replica index, never numeric-summed).
+            stats["numerics"] = self._numerics.stats()
         if self.model is not None and getattr(self.model.cfg,
                                               "block_fusion", False):
             # Fused decode-block dispatch (vdt:block_fusion_calls_total
